@@ -1,0 +1,17 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is unavailable in CI; sharding correctness is validated
+on XLA's host-platform virtual devices (the reference's analogous trick is
+fake-NVML device fixtures — SURVEY.md §4). Must run before jax imports.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

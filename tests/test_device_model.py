@@ -1,0 +1,211 @@
+"""Device model, claim codec, NodeInfo accounting, request parsing.
+
+Mirrors the reference's fake-device unit strategy (SURVEY.md §4; reference
+pkg/device/types.go tests): no TPU runtime needed.
+"""
+
+import time
+
+import pytest
+
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.allocator.request import (
+    MIB, RequestError, build_allocation_request)
+from vtpu_manager.device.claims import (DeviceClaim, PodDeviceClaims,
+                                        try_decode)
+from vtpu_manager.util import consts
+
+
+def make_pod(name="p1", uid="uid-1", containers=None, annotations=None,
+             phase="Pending"):
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": annotations or {}},
+        "spec": {"containers": containers or []},
+        "status": {"phase": phase},
+    }
+
+
+def vtpu_container(name="c0", number=1, cores=50, memory_mib=1024):
+    limits = {consts.vtpu_number_resource(): number}
+    if cores:
+        limits[consts.vtpu_cores_resource()] = cores
+    if memory_mib:
+        limits[consts.vtpu_memory_resource()] = memory_mib
+    return {"name": name, "resources": {"limits": limits}}
+
+
+class TestClaimCodec:
+    def test_roundtrip(self):
+        claims = PodDeviceClaims()
+        claims.add("main", DeviceClaim("TPU-1", 0, 50, 4 * 2**30))
+        claims.add("main", DeviceClaim("TPU-2", 1, 50, 4 * 2**30))
+        claims.add("side", DeviceClaim("TPU-1", 0, 10, 2**20))
+        decoded = PodDeviceClaims.decode(claims.encode())
+        assert decoded.containers == claims.containers
+        assert len(decoded.all_claims()) == 3
+
+    def test_container_order_preserved(self):
+        claims = PodDeviceClaims()
+        for name in ("z", "a", "m"):
+            claims.add(name, DeviceClaim("u", 0, 1, 1))
+        assert list(PodDeviceClaims.decode(claims.encode()).containers) == \
+            ["z", "a", "m"]
+
+    def test_malformed_returns_none(self):
+        assert try_decode(None) is None
+        assert try_decode("") is None
+        assert try_decode("garbage") is None
+        assert try_decode("v1:{bad json") is None
+        # structurally wrong but valid JSON must not raise either
+        assert try_decode('v1:{"c0":5}') is None
+        assert try_decode('v1:{"c0":[["u",0,0,null]]}') is None
+        assert try_decode('v1:[1,2]') is None
+
+    def test_unknown_version_raises(self):
+        with pytest.raises(ValueError):
+            PodDeviceClaims.decode("v9:{}")
+
+
+class TestRegistryCodec:
+    def test_roundtrip(self):
+        reg = dt.fake_registry(8, mesh_shape=(2, 4), chips_per_host=4)
+        decoded = dt.NodeDeviceRegistry.decode(reg.encode())
+        assert decoded.mesh.shape == (2, 4, 1)
+        assert len(decoded.chips) == 8
+        assert decoded.chips[3].coords == (1, 1, 0)
+        assert decoded.chips[5].host_id == 1
+
+    def test_domain_field(self):
+        reg = dt.fake_registry(4)
+        reg.mesh_domain = "slice-abc"
+        assert dt.NodeDeviceRegistry.decode(reg.encode()).mesh_domain == \
+            "slice-abc"
+
+
+class TestNodeInfo:
+    def test_build_counts_resident_pods(self):
+        reg = dt.fake_registry(2)
+        node = dt.fake_node("n1", reg)
+        claims = PodDeviceClaims()
+        claims.add("c0", DeviceClaim(reg.chips[0].uuid, 0, 30, 2 * 2**30))
+        pod = make_pod(annotations={
+            consts.real_allocated_annotation(): claims.encode()})
+        info = dt.NodeInfo.build(node, [pod])
+        usage = info.devices[reg.chips[0].uuid]
+        assert usage.used_number == 1
+        assert usage.used_cores == 30
+        assert usage.used_memory == 2 * 2**30
+        assert usage.free_cores == 70
+        assert info.devices[reg.chips[1].uuid].used_number == 0
+
+    def test_finished_pods_release_capacity(self):
+        reg = dt.fake_registry(1)
+        claims = PodDeviceClaims()
+        claims.add("c0", DeviceClaim(reg.chips[0].uuid, 0, 50, 2**30))
+        pod = make_pod(phase="Succeeded", annotations={
+            consts.real_allocated_annotation(): claims.encode()})
+        info = dt.NodeInfo.build(dt.fake_node("n1", reg), [pod])
+        assert info.devices[reg.chips[0].uuid].used_number == 0
+
+    def test_stuck_preallocation_expires(self):
+        reg = dt.fake_registry(1)
+        claims = PodDeviceClaims()
+        claims.add("c0", DeviceClaim(reg.chips[0].uuid, 0, 50, 2**30))
+        old_ts = str(time.time() - 10_000)
+        pod = make_pod(annotations={
+            consts.pre_allocated_annotation(): claims.encode(),
+            consts.predicate_time_annotation(): old_ts})
+        assert not dt.should_count_pod(pod)
+        fresh = make_pod(annotations={
+            consts.pre_allocated_annotation(): claims.encode(),
+            consts.predicate_time_annotation(): str(time.time())})
+        assert dt.should_count_pod(fresh)
+
+    def test_real_allocation_always_counts(self):
+        reg = dt.fake_registry(1)
+        claims = PodDeviceClaims()
+        claims.add("c0", DeviceClaim(reg.chips[0].uuid, 0, 50, 2**30))
+        pod = make_pod(annotations={
+            consts.real_allocated_annotation(): claims.encode(),
+            consts.predicate_time_annotation(): "1.0"})
+        assert dt.should_count_pod(pod)
+
+    def test_node_without_register_annotation(self):
+        assert dt.NodeInfo.build({"metadata": {"name": "n"}}, []) is None
+
+    def test_structurally_malformed_register_annotation(self):
+        for bad in ('v1:{"chips":[["u",1,"t",16,1,10,5,0,0,1]]}',  # coords scalar
+                    'v1:{"mesh":[1]}',                              # mesh not dict
+                    'v1:[]'):
+            node = {"metadata": {"name": "n", "annotations": {
+                consts.node_device_register_annotation(): bad}}}
+            assert dt.NodeInfo.build(node, []) is None, bad
+
+    def test_assume_pod_bridges_watch_lag(self):
+        info = dt.fake_node_info("n1", 1)
+        uuid = info.registry.chips[0].uuid
+        claims = PodDeviceClaims()
+        claims.add("c0", DeviceClaim(uuid, 0, 40, 2**30))
+        info.assume_pod("uid-9", claims)
+        assert info.devices[uuid].used_cores == 40
+        assert "uid-9" in info.devices[uuid].pods
+
+
+class TestAllocationRequest:
+    def test_basic_parse(self):
+        pod = make_pod(containers=[vtpu_container(number=2, cores=25,
+                                                  memory_mib=4096)])
+        req = build_allocation_request(pod)
+        assert req.total_number() == 2
+        assert req.total_cores() == 2 * 25
+        assert req.total_memory() == 2 * 4096 * MIB
+        assert req.claiming_containers()[0].cores == 25
+
+    def test_init_container_aggregation(self):
+        pod = make_pod(containers=[vtpu_container(number=1, cores=10,
+                                                  memory_mib=100)])
+        pod["spec"]["initContainers"] = [
+            vtpu_container(name="init", number=3, cores=20, memory_mib=200)]
+        req = build_allocation_request(pod)
+        # init runs alone and needs more than the steady state
+        assert req.total_number() == 3
+        assert req.total_cores() == 60
+
+    def test_policy_annotations(self):
+        pod = make_pod(containers=[vtpu_container()], annotations={
+            consts.node_policy_annotation(): "spread",
+            consts.device_policy_annotation(): "spread",
+            consts.topology_mode_annotation(): "ici",
+            consts.compute_policy_annotation(): "balance",
+            consts.memory_oversold_annotation(): "true",
+            consts.exclude_types_annotation(): "tpu-v5p",
+        })
+        req = build_allocation_request(pod)
+        assert req.node_policy == "spread"
+        assert req.topology_mode == "ici"
+        assert req.compute_policy == "balance"
+        assert req.memory_oversold
+        assert req.exclude_types == ("tpu-v5p",)
+
+    def test_invalid_combinations(self):
+        no_number = make_pod(containers=[{
+            "name": "c", "resources": {"limits": {
+                consts.vtpu_cores_resource(): 50}}}])
+        with pytest.raises(RequestError):
+            build_allocation_request(no_number)
+        over_100 = make_pod(containers=[vtpu_container(cores=150)])
+        with pytest.raises(RequestError):
+            build_allocation_request(over_100)
+        bad_policy = make_pod(containers=[vtpu_container()], annotations={
+            consts.node_policy_annotation(): "bogus"})
+        with pytest.raises(RequestError):
+            build_allocation_request(bad_policy)
+
+    def test_string_quantities(self):
+        pod = make_pod(containers=[{
+            "name": "c", "resources": {"limits": {
+                consts.vtpu_number_resource(): "1",
+                consts.vtpu_memory_resource(): "4096"}}}])
+        req = build_allocation_request(pod)
+        assert req.total_memory() == 4096 * MIB

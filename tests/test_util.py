@@ -1,0 +1,125 @@
+"""Feature gates, file locks, domain config."""
+
+import multiprocessing
+import os
+import time
+
+import pytest
+
+from vtpu_manager.util import consts
+from vtpu_manager.util.featuregates import (CLIENT_MODE, RESCHEDULE,
+                                            FeatureGates)
+from vtpu_manager.util.flock import FileLock, LockTimeout, lock_device
+
+
+class TestFeatureGates:
+    def test_defaults_off(self):
+        fg = FeatureGates()
+        assert not fg.enabled(RESCHEDULE)
+
+    def test_parse(self):
+        fg = FeatureGates()
+        fg.parse("Reschedule=true, ClientMode=true")
+        assert fg.enabled(RESCHEDULE)
+        assert fg.enabled(CLIENT_MODE)
+
+    def test_unknown_gate(self):
+        fg = FeatureGates()
+        with pytest.raises(ValueError):
+            fg.parse("NoSuchGate=true")
+        with pytest.raises(ValueError):
+            fg.parse("Reschedule=maybe")
+
+    def test_parse_all_or_nothing(self):
+        fg = FeatureGates()
+        with pytest.raises(ValueError):
+            fg.parse("Reschedule=true,Bogus=x")
+        assert not fg.enabled(RESCHEDULE)  # nothing applied
+
+
+def _hold_lock(path, hold_s, acquired_evt):
+    lk = FileLock(path, timeout_s=1)
+    lk.acquire()
+    acquired_evt.set()
+    time.sleep(hold_s)
+    lk.release()
+
+
+class TestFileLock:
+    def test_basic(self, tmp_path):
+        path = str(tmp_path / "a.lock")
+        with FileLock(path):
+            pass
+        with FileLock(path):
+            pass
+
+    def test_cross_process_exclusion(self, tmp_path):
+        path = str(tmp_path / "b.lock")
+        evt = multiprocessing.Event()
+        proc = multiprocessing.Process(target=_hold_lock,
+                                       args=(path, 0.5, evt))
+        proc.start()
+        assert evt.wait(5)
+        t0 = time.monotonic()
+        with FileLock(path, timeout_s=5):
+            waited = time.monotonic() - t0
+        proc.join()
+        assert waited >= 0.2  # had to wait for the holder
+
+    def test_timeout(self, tmp_path):
+        path = str(tmp_path / "c.lock")
+        evt = multiprocessing.Event()
+        proc = multiprocessing.Process(target=_hold_lock,
+                                       args=(path, 1.5, evt))
+        proc.start()
+        assert evt.wait(5)
+        with pytest.raises(LockTimeout):
+            FileLock(path, timeout_s=0.2).acquire()
+        proc.join()
+
+    def test_device_lock_helper(self, tmp_path):
+        with lock_device(3, lock_dir=str(tmp_path)):
+            assert os.path.exists(str(tmp_path / "vtpu_3.lock"))
+
+
+def _hold_range(path, offset, length, hold_s, acquired_evt):
+    from vtpu_manager.util.flock import byte_range_write_lock
+    fd = os.open(path, os.O_RDWR)
+    with byte_range_write_lock(fd, offset, length, timeout_s=1):
+        acquired_evt.set()
+        time.sleep(hold_s)
+    os.close(fd)
+
+
+class TestByteRangeLock:
+    def test_disjoint_ranges_dont_conflict(self, tmp_path):
+        from vtpu_manager.util.flock import byte_range_write_lock
+        path = str(tmp_path / "r.bin")
+        with open(path, "wb") as f:
+            f.write(b"\0" * 256)
+        evt = multiprocessing.Event()
+        proc = multiprocessing.Process(target=_hold_range,
+                                       args=(path, 0, 64, 0.8, evt))
+        proc.start()
+        assert evt.wait(5)
+        fd = os.open(path, os.O_RDWR)
+        t0 = time.monotonic()
+        with byte_range_write_lock(fd, 64, 64, timeout_s=5):
+            pass  # disjoint: immediate
+        assert time.monotonic() - t0 < 0.5
+        from vtpu_manager.util.flock import LockTimeout
+        with pytest.raises(LockTimeout):
+            with byte_range_write_lock(fd, 0, 64, timeout_s=0.2):
+                pass  # overlapping: blocked by the other process
+        os.close(fd)
+        proc.join()
+
+
+def test_domain_config():
+    assert consts.vtpu_number_resource() == "google.com/vtpu-number"
+    consts.init_global_domain(resource_domain="example.org")
+    try:
+        assert consts.vtpu_number_resource() == "example.org/vtpu-number"
+    finally:
+        consts.init_global_domain(
+            resource_domain=consts.DEFAULT_RESOURCE_DOMAIN)

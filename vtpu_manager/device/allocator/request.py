@@ -1,0 +1,208 @@
+"""AllocationRequest: parse a pod once into a normalized request.
+
+Reference: pkg/device/allocator/request.go:29-156,234-341 — per-container
+number/cores/memory with init-container lifecycle-aware aggregation, node and
+device binpack/spread policies, topology mode, include/exclude filters, gang
+identity. Parsed once per Filter call and threaded through everything.
+
+Units: vtpu-number = vTPU slots; vtpu-cores = TensorCore percent **per
+claimed chip** (0..100); vtpu-memory = HBM MiB per claimed chip (0 = whole
+chip's remaining advertised share — like the reference's "no memory request
+means full split share").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from vtpu_manager.util import consts
+
+MIB = 2**20
+
+
+class RequestError(ValueError):
+    """Raised for malformed vtpu resource combinations (caught by the
+    validating webhook in the admission path; fails Filter otherwise)."""
+
+
+@dataclass(frozen=True)
+class ContainerRequest:
+    name: str
+    number: int          # chips claimed
+    cores: int           # % per chip
+    memory: int          # bytes per chip (0 = proportional split share)
+    is_init: bool = False
+
+    @property
+    def total_cores(self) -> int:
+        return self.number * self.cores
+
+    @property
+    def total_memory(self) -> int:
+        return self.number * self.memory
+
+
+@dataclass
+class AllocationRequest:
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    containers: list[ContainerRequest] = field(default_factory=list)
+    init_containers: list[ContainerRequest] = field(default_factory=list)
+
+    node_policy: str = consts.NODE_POLICY_BINPACK
+    device_policy: str = consts.DEVICE_POLICY_BINPACK
+    topology_mode: str = consts.TOPOLOGY_NONE
+    compute_policy: str = consts.COMPUTE_POLICY_FIXED
+    memory_oversold: bool = False
+
+    include_types: tuple[str, ...] = ()
+    exclude_types: tuple[str, ...] = ()
+    include_uuids: tuple[str, ...] = ()
+    exclude_uuids: tuple[str, ...] = ()
+
+    gang_name: str = ""
+    gang_size: int = 0
+    gang_ordinal: int = -1
+
+    # -- aggregates (init-container lifecycle-aware: init containers run
+    # sequentially and release before the next starts, so the pod's gate is
+    # max(sum(regular), max(init)) — reference request.go Total/Max logic) --
+
+    def claiming_containers(self) -> list[ContainerRequest]:
+        return [c for c in self.containers if c.number > 0]
+
+    def total_number(self) -> int:
+        reg = sum(c.number for c in self.containers)
+        init = max((c.number for c in self.init_containers), default=0)
+        return max(reg, init)
+
+    def total_cores(self) -> int:
+        reg = sum(c.total_cores for c in self.containers)
+        init = max((c.total_cores for c in self.init_containers), default=0)
+        return max(reg, init)
+
+    def total_memory(self) -> int:
+        reg = sum(c.total_memory for c in self.containers)
+        init = max((c.total_memory for c in self.init_containers), default=0)
+        return max(reg, init)
+
+    def is_empty(self) -> bool:
+        return self.total_number() == 0
+
+    def max_single_cores(self) -> int:
+        return max((c.cores for c in self.containers + self.init_containers
+                    if c.number > 0), default=0)
+
+    def max_single_memory(self) -> int:
+        return max((c.memory for c in self.containers + self.init_containers
+                    if c.number > 0), default=0)
+
+
+def _parse_quantity(raw) -> int:
+    """Parse a k8s-style integer quantity (we only accept plain integers and
+    Ki/Mi/Gi suffixes — vtpu resources are counts, percents, and MiB)."""
+    if isinstance(raw, int):
+        return raw
+    s = str(raw).strip()
+    mult = 1
+    for suffix, m in (("Ki", 2**10), ("Mi", 2**20), ("Gi", 2**30), ("k", 10**3),
+                      ("M", 10**6), ("G", 10**9)):
+        if s.endswith(suffix):
+            mult = m
+            s = s[: -len(suffix)]
+            break
+    try:
+        return int(float(s) * mult)
+    except ValueError as e:
+        raise RequestError(f"bad quantity {raw!r}") from e
+
+
+def _container_request(cont: dict, is_init: bool) -> ContainerRequest:
+    limits = ((cont.get("resources") or {}).get("limits") or {})
+    requests = ((cont.get("resources") or {}).get("requests") or {})
+    merged = {**requests, **limits}   # limits win, like the reference
+
+    number = _parse_quantity(merged.get(consts.vtpu_number_resource(), 0))
+    cores = _parse_quantity(merged.get(consts.vtpu_cores_resource(), 0))
+    mem_mib = _parse_quantity(merged.get(consts.vtpu_memory_resource(), 0))
+
+    if number < 0 or cores < 0 or mem_mib < 0:
+        raise RequestError("vtpu resources must be non-negative")
+    if number == 0 and (cores or mem_mib):
+        raise RequestError(
+            f"container {cont.get('name')!r} requests vtpu-cores/memory "
+            "without vtpu-number")
+    if cores > 100:
+        raise RequestError(f"vtpu-cores must be <=100, got {cores}")
+    return ContainerRequest(name=cont.get("name", ""), number=number,
+                            cores=cores, memory=mem_mib * MIB, is_init=is_init)
+
+
+def _csv(val: str | None) -> tuple[str, ...]:
+    if not val:
+        return ()
+    return tuple(v.strip() for v in val.split(",") if v.strip())
+
+
+def build_allocation_request(pod: dict) -> AllocationRequest:
+    """Parse pod spec + annotations into an AllocationRequest.
+
+    Raises RequestError on invalid combinations (the validating webhook runs
+    the same checks at admission so Filter normally never sees them).
+    """
+    meta = pod.get("metadata") or {}
+    spec = pod.get("spec") or {}
+    anns = meta.get("annotations") or {}
+
+    req = AllocationRequest(pod_name=meta.get("name", ""),
+                            pod_namespace=meta.get("namespace", "default"),
+                            pod_uid=meta.get("uid", ""))
+    for cont in spec.get("containers") or []:
+        req.containers.append(_container_request(cont, is_init=False))
+    for cont in spec.get("initContainers") or []:
+        req.init_containers.append(_container_request(cont, is_init=True))
+
+    node_policy = anns.get(consts.node_policy_annotation(),
+                           consts.NODE_POLICY_BINPACK)
+    if node_policy not in consts.NODE_POLICIES:
+        raise RequestError(f"invalid node policy {node_policy!r}")
+    req.node_policy = node_policy
+
+    device_policy = anns.get(consts.device_policy_annotation(),
+                             consts.DEVICE_POLICY_BINPACK)
+    if device_policy not in consts.DEVICE_POLICIES:
+        raise RequestError(f"invalid device policy {device_policy!r}")
+    req.device_policy = device_policy
+
+    topo = anns.get(consts.topology_mode_annotation(), consts.TOPOLOGY_NONE)
+    if topo not in consts.TOPOLOGY_MODES:
+        raise RequestError(f"invalid topology mode {topo!r}")
+    req.topology_mode = topo
+
+    compute = anns.get(consts.compute_policy_annotation(),
+                       consts.COMPUTE_POLICY_FIXED)
+    if compute not in consts.COMPUTE_POLICIES:
+        raise RequestError(f"invalid compute policy {compute!r}")
+    req.compute_policy = compute
+
+    req.memory_oversold = (
+        anns.get(consts.memory_oversold_annotation(), "").lower() == "true")
+
+    req.include_types = _csv(anns.get(consts.include_types_annotation()))
+    req.exclude_types = _csv(anns.get(consts.exclude_types_annotation()))
+    req.include_uuids = _csv(anns.get(consts.include_uuids_annotation()))
+    req.exclude_uuids = _csv(anns.get(consts.exclude_uuids_annotation()))
+
+    req.gang_name = anns.get(consts.gang_name_annotation(), "")
+    if req.gang_name:
+        try:
+            req.gang_size = int(anns.get(consts.gang_size_annotation(), "0"))
+        except ValueError as e:
+            raise RequestError("invalid gang-size") from e
+        try:
+            req.gang_ordinal = int(
+                anns.get(consts.gang_ordinal_annotation(), "-1"))
+        except ValueError as e:
+            raise RequestError("invalid gang-ordinal") from e
+    return req

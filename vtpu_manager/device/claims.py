@@ -1,0 +1,98 @@
+"""Device-claim codec: the annotation wire format between scheduler and node.
+
+The reference moves all allocation state through pod annotations — the
+scheduler extender writes a ``pre-allocated`` claim set, the device plugin
+confirms with ``real-allocated`` (reference: pkg/util/consts.go:90-96 and
+the encode/decode helpers in pkg/device/types.go). We keep that protocol and
+use a versioned, compact JSON encoding.
+
+Wire format (annotation value)::
+
+    v1:{"<container>":[["<uuid>",<host_index>,<cores>,<memory_bytes>],...],...}
+
+Ordering of containers is preserved (JSON object order == insertion order).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+_VERSION_PREFIX = "v1:"
+
+
+@dataclass(frozen=True)
+class DeviceClaim:
+    """One container's claim on one physical chip.
+
+    cores: TensorCore percentage of the chip (0..100; 0 = no core request,
+    meaning "schedulable, unmetered").
+    memory: HBM bytes carved out of the chip.
+    """
+
+    uuid: str
+    host_index: int
+    cores: int
+    memory: int
+
+    def to_wire(self) -> list:
+        return [self.uuid, self.host_index, self.cores, self.memory]
+
+    @staticmethod
+    def from_wire(raw: list) -> "DeviceClaim":
+        if not (isinstance(raw, list) and len(raw) == 4):
+            raise ValueError(f"malformed device claim {raw!r}")
+        uuid, host_index, cores, memory = raw
+        return DeviceClaim(str(uuid), int(host_index), int(cores), int(memory))
+
+
+@dataclass
+class PodDeviceClaims:
+    """Per-container claims for one pod. Insertion order == container order."""
+
+    containers: dict[str, list[DeviceClaim]] = field(default_factory=dict)
+
+    def add(self, container: str, claim: DeviceClaim) -> None:
+        self.containers.setdefault(container, []).append(claim)
+
+    def container_claims(self, container: str) -> list[DeviceClaim]:
+        return self.containers.get(container, [])
+
+    def all_claims(self) -> list[DeviceClaim]:
+        return [c for claims in self.containers.values() for c in claims]
+
+    def is_empty(self) -> bool:
+        return not any(self.containers.values())
+
+    # -- wire codec ---------------------------------------------------------
+
+    def encode(self) -> str:
+        payload = {name: [c.to_wire() for c in claims]
+                   for name, claims in self.containers.items()}
+        return _VERSION_PREFIX + json.dumps(payload, separators=(",", ":"))
+
+    @staticmethod
+    def decode(value: str) -> "PodDeviceClaims":
+        if not value.startswith(_VERSION_PREFIX):
+            raise ValueError(f"unknown claim encoding: {value[:16]!r}")
+        payload = json.loads(value[len(_VERSION_PREFIX):])
+        if not isinstance(payload, dict):
+            raise ValueError("claim payload must be an object")
+        out = PodDeviceClaims()
+        for name, claims in payload.items():
+            out.containers[str(name)] = [DeviceClaim.from_wire(c)
+                                         for c in claims]
+        return out
+
+
+def try_decode(value: str | None) -> PodDeviceClaims | None:
+    """Decode, returning None for absent/malformed values (malformed
+    annotations on resident pods must not wedge the scheduler; the reference
+    cleans them via the webhook instead — pod_mutate.go)."""
+    if not value:
+        return None
+    try:
+        return PodDeviceClaims.decode(value)
+    except (ValueError, TypeError, KeyError, AttributeError,
+            json.JSONDecodeError):
+        return None

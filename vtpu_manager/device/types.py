@@ -1,0 +1,344 @@
+"""Physical-chip model, node device registry codec, and NodeInfo accounting.
+
+TPU-native re-design of the reference's device model (pkg/device/types.go).
+Differences by design:
+
+- A device is a **TPU chip** with TensorCore count, HBM bytes, and a position
+  in the ICI mesh (coordinates + wraparound torus flags) instead of an NVIDIA
+  GPU with an NVLink P2P matrix. Mesh coordinates are the topology primitive:
+  adjacency is *derived* (grid neighborship), not published as an N×N matrix.
+- No MIG analogue: TPUs have no hardware partitioning; all sharing is
+  fractional (core-% + HBM caps), so the MIG plugin family collapses into the
+  vtpu path. DRA partition configs reuse the same fractional model.
+
+NodeInfo is rebuilt per scheduling cycle from the node's register annotation
+plus resident pods' claim annotations, exactly like the reference
+(types.go:421-507,708-1100); state never lives in the scheduler process.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field, replace
+
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims, try_decode
+from vtpu_manager.util import consts
+
+_REG_PREFIX = "v1:"
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Static description of one physical TPU chip as advertised by a node.
+
+    uuid: stable chip id (serial or synthesized `<node>-chip-<i>`).
+    index: host chip index (device plugin / TPU_VISIBLE_DEVICES index space).
+    chip_type: e.g. "tpu-v5e", "tpu-v5p".
+    memory: physical HBM bytes.
+    core_count: TensorCores on the chip (v5e: 1, v5p: 2 per chip... we store
+        the advertised count; quota math is percent-based so the count only
+        scales the shim's token bucket).
+    split_count: how many vTPU slots this chip advertises
+        (reference: DeviceSplitCount, pkg/config/node/node_config.go).
+    coords: (x, y, z) position in the node's ICI mesh; z==0 on 2-D meshes.
+    host_id: host/board identity for multi-board nodes (NUMA analogue).
+    numa: host NUMA node of the chip's PCIe attachment.
+    healthy: health as of the last register heartbeat.
+    """
+
+    uuid: str
+    index: int
+    chip_type: str = "tpu-v5e"
+    memory: int = 16 * 2**30
+    core_count: int = 1
+    split_count: int = 10
+    coords: tuple[int, int, int] = (0, 0, 0)
+    host_id: int = 0
+    numa: int = 0
+    healthy: bool = True
+
+    def to_wire(self) -> list:
+        return [self.uuid, self.index, self.chip_type, self.memory,
+                self.core_count, self.split_count, list(self.coords),
+                self.host_id, self.numa, 1 if self.healthy else 0]
+
+    @staticmethod
+    def from_wire(raw: list) -> "ChipSpec":
+        if not (isinstance(raw, list) and len(raw) == 10):
+            raise ValueError(f"malformed chip spec {raw!r}")
+        return ChipSpec(uuid=str(raw[0]), index=int(raw[1]),
+                        chip_type=str(raw[2]), memory=int(raw[3]),
+                        core_count=int(raw[4]), split_count=int(raw[5]),
+                        coords=tuple(int(v) for v in raw[6]),
+                        host_id=int(raw[7]), numa=int(raw[8]),
+                        healthy=bool(raw[9]))
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """The node-local ICI mesh: shape and torus wrap flags per axis.
+
+    For a v5e-8 host this is shape (2,4); a standalone chip is (1,1). The
+    scheduler uses it to score contiguous sub-meshes (reference scores NVLink
+    partitions instead — pkg/device/gpuallocator/).
+    """
+
+    shape: tuple[int, int, int] = (1, 1, 1)
+    wrap: tuple[bool, bool, bool] = (False, False, False)
+
+    def to_wire(self) -> dict:
+        return {"shape": list(self.shape),
+                "wrap": [1 if w else 0 for w in self.wrap]}
+
+    @staticmethod
+    def from_wire(raw: dict) -> "MeshSpec":
+        shape = tuple(int(v) for v in raw.get("shape", [1, 1, 1]))
+        wrap = tuple(bool(v) for v in raw.get("wrap", [0, 0, 0]))
+        while len(shape) < 3:
+            shape += (1,)
+        while len(wrap) < 3:
+            wrap += (False,)
+        return MeshSpec(shape[:3], wrap[:3])
+
+
+@dataclass
+class NodeDeviceRegistry:
+    """What a node publishes about its chips (register annotation payload).
+
+    Reference: node-device-register / node-device-topology annotations
+    (pkg/device/manager/registry.go:15-113).
+    """
+
+    chips: list[ChipSpec] = field(default_factory=list)
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    mesh_domain: str = ""      # multi-host ICI domain id ("" = none)
+
+    def encode(self) -> str:
+        payload = {"chips": [c.to_wire() for c in self.chips],
+                   "mesh": self.mesh.to_wire()}
+        if self.mesh_domain:
+            payload["domain"] = self.mesh_domain
+        return _REG_PREFIX + json.dumps(payload, separators=(",", ":"))
+
+    @staticmethod
+    def decode(value: str) -> "NodeDeviceRegistry":
+        if not value.startswith(_REG_PREFIX):
+            raise ValueError(f"unknown registry encoding {value[:16]!r}")
+        payload = json.loads(value[len(_REG_PREFIX):])
+        return NodeDeviceRegistry(
+            chips=[ChipSpec.from_wire(c) for c in payload.get("chips", [])],
+            mesh=MeshSpec.from_wire(payload.get("mesh", {})),
+            mesh_domain=str(payload.get("domain", "")))
+
+
+# ---------------------------------------------------------------------------
+# NodeInfo: per-cycle usage accounting
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DeviceUsage:
+    """Mutable usage tally for one chip within a scheduling cycle."""
+
+    spec: ChipSpec
+    used_number: int = 0          # vTPU slots consumed
+    used_cores: int = 0           # summed core-%
+    used_memory: int = 0          # summed HBM bytes
+    pods: set[str] = field(default_factory=set)   # pod UIDs sharing the chip
+
+    @property
+    def free_number(self) -> int:
+        return self.spec.split_count - self.used_number
+
+    @property
+    def free_cores(self) -> int:
+        return 100 - self.used_cores
+
+    @property
+    def free_memory(self) -> int:
+        return self.spec.memory - self.used_memory
+
+    def fits(self, cores: int, memory: int) -> bool:
+        return (self.free_number >= 1 and self.free_cores >= cores
+                and self.free_memory >= memory)
+
+    def assume(self, pod_uid: str, claim: DeviceClaim) -> None:
+        self.used_number += 1
+        self.used_cores += claim.cores
+        self.used_memory += claim.memory
+        self.pods.add(pod_uid)
+
+
+def _pod_phase(pod: dict) -> str:
+    return (pod.get("status") or {}).get("phase", "")
+
+
+def _pod_annotations(pod: dict) -> dict:
+    return (pod.get("metadata") or {}).get("annotations") or {}
+
+
+def should_count_pod(pod: dict, now: float | None = None,
+                     stuck_grace_s: float = consts.DEFAULT_STUCK_GRACE_S) -> bool:
+    """Whether a resident pod's claims still consume capacity.
+
+    Mirrors the reference's ShouldCountPodDeviceAllocation (types.go): pods
+    that finished release capacity; pods whose pre-allocation never became a
+    real allocation stop counting after a grace period (stuck allocations
+    must not leak capacity forever — the reschedule controller cleans the
+    pod itself up).
+    """
+    if _pod_phase(pod) in ("Succeeded", "Failed"):
+        return False
+    anns = _pod_annotations(pod)
+    if anns.get(consts.real_allocated_annotation()):
+        return True
+    pre = anns.get(consts.pre_allocated_annotation())
+    if not pre:
+        return False
+    grace = stuck_grace_s
+    override = anns.get(consts.scheduler_stuck_grace_annotation())
+    if override:
+        try:
+            grace = float(override)
+        except ValueError:
+            pass
+    ts_raw = anns.get(consts.predicate_time_annotation())
+    if not ts_raw:
+        return True
+    try:
+        ts = float(ts_raw)
+    except ValueError:
+        return True
+    now = time.time() if now is None else now
+    return (now - ts) <= grace
+
+
+def get_pod_device_claims(pod: dict) -> PodDeviceClaims | None:
+    """Effective claims for a pod: real allocation wins over pre-allocation
+    (reference: GetPodDeviceClaim, types.go:643)."""
+    anns = _pod_annotations(pod)
+    real = try_decode(anns.get(consts.real_allocated_annotation()))
+    if real is not None:
+        return real
+    return try_decode(anns.get(consts.pre_allocated_annotation()))
+
+
+@dataclass
+class NodeInfo:
+    """Usage-annotated view of one node, built fresh each scheduling pass."""
+
+    name: str
+    registry: NodeDeviceRegistry
+    devices: dict[str, DeviceUsage] = field(default_factory=dict)  # by uuid
+
+    @staticmethod
+    def build(node: dict, resident_pods: list[dict],
+              now: float | None = None) -> "NodeInfo | None":
+        """Decode the node's register annotation and fold in every resident
+        pod's claims (reference: device.NewNodeInfo, types.go:433-507)."""
+        anns = (node.get("metadata") or {}).get("annotations") or {}
+        raw = anns.get(consts.node_device_register_annotation())
+        if not raw:
+            return None
+        try:
+            registry = NodeDeviceRegistry.decode(raw)
+        except (ValueError, TypeError, AttributeError, json.JSONDecodeError):
+            return None
+        name = (node.get("metadata") or {}).get("name", "")
+        info = NodeInfo(name=name, registry=registry)
+        for chip in registry.chips:
+            info.devices[chip.uuid] = DeviceUsage(spec=chip)
+        for pod in resident_pods:
+            if not should_count_pod(pod, now=now):
+                continue
+            claims = get_pod_device_claims(pod)
+            if claims is None:
+                continue
+            uid = (pod.get("metadata") or {}).get("uid", "")
+            for claim in claims.all_claims():
+                usage = info.devices.get(claim.uuid)
+                if usage is not None:
+                    usage.assume(uid, claim)
+        return info
+
+    # -- capacity views -----------------------------------------------------
+
+    def healthy_devices(self) -> list[DeviceUsage]:
+        return [d for d in self.devices.values() if d.spec.healthy]
+
+    def total_free_number(self) -> int:
+        return sum(d.free_number for d in self.healthy_devices())
+
+    def total_free_cores(self) -> int:
+        return sum(max(d.free_cores, 0) for d in self.healthy_devices())
+
+    def total_free_memory(self) -> int:
+        return sum(max(d.free_memory, 0) for d in self.healthy_devices())
+
+    def by_index(self) -> dict[int, DeviceUsage]:
+        return {d.spec.index: d for d in self.devices.values()}
+
+    def assume_pod(self, pod_uid: str, claims: PodDeviceClaims) -> None:
+        """Locally account a just-made allocation so back-to-back filter
+        calls see it before the informer catches up (reference:
+        filter_predicate.go:853-857)."""
+        for claim in claims.all_claims():
+            usage = self.devices.get(claim.uuid)
+            if usage is not None:
+                usage.assume(pod_uid, claim)
+
+
+# ---------------------------------------------------------------------------
+# Fake fixtures (reference: NewFakeDevice/NewFakeNodeInfo, types.go:375-418)
+# ---------------------------------------------------------------------------
+
+def fake_chip(index: int, *, uuid: str | None = None, memory: int = 16 * 2**30,
+              split_count: int = 10, coords: tuple[int, int, int] | None = None,
+              chip_type: str = "tpu-v5e", host_id: int = 0, numa: int = 0,
+              healthy: bool = True, core_count: int = 1) -> ChipSpec:
+    return ChipSpec(uuid=uuid or f"TPU-FAKE-{index:04d}", index=index,
+                    chip_type=chip_type, memory=memory, core_count=core_count,
+                    split_count=split_count,
+                    coords=coords if coords is not None else (index, 0, 0),
+                    host_id=host_id, numa=numa, healthy=healthy)
+
+
+def fake_registry(n_chips: int, *, mesh_shape: tuple[int, int] | None = None,
+                  memory: int = 16 * 2**30, split_count: int = 10,
+                  chip_type: str = "tpu-v5e",
+                  chips_per_host: int = 0) -> NodeDeviceRegistry:
+    """A fake node: n chips laid out row-major on a 2-D mesh."""
+    if mesh_shape is None:
+        mesh_shape = (1, n_chips)
+    sx, sy = mesh_shape
+    chips = []
+    for i in range(n_chips):
+        host = i // chips_per_host if chips_per_host else 0
+        chips.append(fake_chip(i, coords=(i % sx, i // sx, 0), memory=memory,
+                               split_count=split_count, chip_type=chip_type,
+                               host_id=host, numa=host))
+    return NodeDeviceRegistry(chips=chips, mesh=MeshSpec((sx, sy, 1)))
+
+
+def fake_node(name: str, registry: NodeDeviceRegistry,
+              labels: dict | None = None) -> dict:
+    return {"metadata": {"name": name,
+                         "labels": labels or {},
+                         "annotations": {
+                             consts.node_device_register_annotation():
+                                 registry.encode()}},
+            "status": {"allocatable": {}}}
+
+
+def fake_node_info(name: str, n_chips: int, **kw) -> NodeInfo:
+    reg = fake_registry(n_chips, **kw)
+    info = NodeInfo(name=name, registry=reg)
+    for chip in reg.chips:
+        info.devices[chip.uuid] = DeviceUsage(spec=chip)
+    return info
+
+
+__all__ = ["ChipSpec", "MeshSpec", "NodeDeviceRegistry", "DeviceUsage",
+           "NodeInfo", "should_count_pod", "get_pod_device_claims",
+           "fake_chip", "fake_registry", "fake_node", "fake_node_info",
+           "replace"]

@@ -1,0 +1,287 @@
+"""Shared constants: resource names, annotations, env vars, filesystem paths.
+
+TPU-native re-design of the reference's shared constant registry
+(reference: pkg/util/consts.go). The reference virtualizes NVIDIA GPUs
+(``nvidia.com/vgpu-*``); we virtualize TPU chips (``google.com/vtpu-*``)
+with TensorCore-% and HBM-byte caps, and the NVLink/NUMA topology notions
+are replaced by ICI-mesh / host locality.
+
+The resource-name domain and the annotation domain are both configurable at
+process start (reference: util.MustInitGlobalDomain, pkg/util/consts.go:134).
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Domains (mutable at startup via init_global_domain)
+# ---------------------------------------------------------------------------
+
+DEFAULT_RESOURCE_DOMAIN = "google.com"
+DEFAULT_ANNOTATION_DOMAIN = "vtpu-manager.io"
+
+_resource_domain = DEFAULT_RESOURCE_DOMAIN
+_annotation_domain = DEFAULT_ANNOTATION_DOMAIN
+
+
+def init_global_domain(resource_domain: str | None = None,
+                       annotation_domain: str | None = None) -> None:
+    """Override the resource/annotation domains (call once at startup)."""
+    global _resource_domain, _annotation_domain
+    if resource_domain:
+        _resource_domain = resource_domain
+    if annotation_domain:
+        _annotation_domain = annotation_domain
+
+
+def resource_domain() -> str:
+    return _resource_domain
+
+
+def annotation_domain() -> str:
+    return _annotation_domain
+
+
+# ---------------------------------------------------------------------------
+# Extended resource names (reference: nvidia.com/vgpu-{number,cores,memory})
+# ---------------------------------------------------------------------------
+
+def vtpu_number_resource() -> str:
+    return f"{_resource_domain}/vtpu-number"
+
+
+def vtpu_cores_resource() -> str:
+    return f"{_resource_domain}/vtpu-cores"
+
+
+def vtpu_memory_resource() -> str:
+    return f"{_resource_domain}/vtpu-memory"
+
+
+# ---------------------------------------------------------------------------
+# Pod annotations (written by webhook / scheduler / device plugin)
+# ---------------------------------------------------------------------------
+
+def _ann(suffix: str) -> str:
+    return f"{_annotation_domain}/{suffix}"
+
+
+def pre_allocated_annotation() -> str:
+    """Scheduler extender's chosen devices (reference: nvidia.com/pre-allocated)."""
+    return _ann("pre-allocated")
+
+
+def real_allocated_annotation() -> str:
+    """Device plugin's final allocation (reference real-alloc annotation)."""
+    return _ann("real-allocated")
+
+
+def predicate_node_annotation() -> str:
+    return _ann("predicate-node")
+
+
+def predicate_time_annotation() -> str:
+    return _ann("predicate-time")
+
+
+def allocation_status_annotation() -> str:
+    return _ann("allocation-status")
+
+
+def node_policy_annotation() -> str:
+    return _ann("node-policy")
+
+
+def device_policy_annotation() -> str:
+    return _ann("device-policy")
+
+
+def topology_mode_annotation() -> str:
+    return _ann("device-topology-mode")
+
+
+def compute_policy_annotation() -> str:
+    return _ann("compute-policy")
+
+
+def memory_oversold_annotation() -> str:
+    return _ann("memory-oversold")
+
+
+def include_types_annotation() -> str:
+    return _ann("include-device-types")
+
+
+def exclude_types_annotation() -> str:
+    return _ann("exclude-device-types")
+
+
+def include_uuids_annotation() -> str:
+    return _ann("include-device-uuids")
+
+
+def exclude_uuids_annotation() -> str:
+    return _ann("exclude-device-uuids")
+
+
+def gang_name_annotation() -> str:
+    """Cross-pod gang identity for mesh-aligned placement (reference:
+    cross-pod NVLink gang, docs/cross_pod_nvlink_topology_design.md)."""
+    return _ann("gang-name")
+
+
+def gang_size_annotation() -> str:
+    return _ann("gang-size")
+
+
+def gang_ordinal_annotation() -> str:
+    return _ann("gang-ordinal")
+
+
+def scheduler_stuck_grace_annotation() -> str:
+    """Per-pod override of the stuck pre-allocation grace period
+    (reference: SchedulerStuckGracePeriodAnnotation, consts.go:68)."""
+    return _ann("stuck-grace-period")
+
+
+# Node annotations -----------------------------------------------------------
+
+def node_device_register_annotation() -> str:
+    return _ann("node-device-register")
+
+
+def node_device_heartbeat_annotation() -> str:
+    return _ann("node-device-heartbeat")
+
+
+def node_device_topology_annotation() -> str:
+    """ICI-mesh adjacency table (reference publishes an NVLink P2P matrix,
+    pkg/device/manager/registry.go)."""
+    return _ann("node-device-topology")
+
+
+def node_mesh_domain_annotation() -> str:
+    """Multi-host ICI domain id, the analogue of the reference's multi-node
+    NVLink domain (reference: NodeGPUDomainAnnotation, consts.go:62)."""
+    return _ann("node-mesh-domain")
+
+
+def node_config_hash_annotation() -> str:
+    return _ann("node-config-hash")
+
+
+# Allocation status values ---------------------------------------------------
+
+ALLOC_STATUS_SUCCEED = "succeed"
+ALLOC_STATUS_FAILED = "failed"
+ALLOC_STATUS_ALLOCATING = "allocating"
+
+# ---------------------------------------------------------------------------
+# Policies
+# ---------------------------------------------------------------------------
+
+NODE_POLICY_BINPACK = "binpack"
+NODE_POLICY_SPREAD = "spread"
+NODE_POLICIES = (NODE_POLICY_BINPACK, NODE_POLICY_SPREAD)
+
+DEVICE_POLICY_BINPACK = "binpack"
+DEVICE_POLICY_SPREAD = "spread"
+DEVICE_POLICIES = (DEVICE_POLICY_BINPACK, DEVICE_POLICY_SPREAD)
+
+# Topology modes. `ici` packs chips into a contiguous sub-mesh of the ICI
+# fabric (the NVLink `link` analogue); `host` packs chips onto the same host
+# board (the NUMA analogue). `-strict` fails instead of falling back.
+TOPOLOGY_NONE = "none"
+TOPOLOGY_ICI = "ici"
+TOPOLOGY_ICI_STRICT = "ici-strict"
+TOPOLOGY_HOST = "host"
+TOPOLOGY_HOST_STRICT = "host-strict"
+TOPOLOGY_MODES = (TOPOLOGY_NONE, TOPOLOGY_ICI, TOPOLOGY_ICI_STRICT,
+                  TOPOLOGY_HOST, TOPOLOGY_HOST_STRICT)
+
+# Compute (core-quota) policies, reference: fixed/balance/none
+# (pkg/deviceplugin/vgpu/vnum_plugin.go:779-790).
+COMPUTE_POLICY_FIXED = "fixed"      # hard clamp at hard_core
+COMPUTE_POLICY_BALANCE = "balance"  # elastic between hard_core..soft_core
+COMPUTE_POLICY_NONE = "none"        # no core limit
+COMPUTE_POLICIES = (COMPUTE_POLICY_FIXED, COMPUTE_POLICY_BALANCE,
+                    COMPUTE_POLICY_NONE)
+
+# ---------------------------------------------------------------------------
+# Container env vars consumed by the enforcement shim / runtime client
+# (reference: library/src/util.c:14-25, CUDA_MEM_LIMIT etc.)
+# ---------------------------------------------------------------------------
+
+ENV_MEM_LIMIT = "VTPU_MEM_LIMIT"            # + "_<i>" per device, bytes
+ENV_CORE_LIMIT = "VTPU_CORE_LIMIT"          # + "_<i>", percent
+ENV_CORE_SOFT_LIMIT = "VTPU_CORE_SOFT_LIMIT"
+ENV_MEM_RATIO = "VTPU_MEM_RATIO"            # oversold ratio, percent
+ENV_MEM_OVERSOLD = "VTPU_MEM_OVERSOLD"      # "true"/"false"
+ENV_VISIBLE_DEVICES = "MANAGER_VISIBLE_DEVICES"    # host-index / uuid list
+ENV_COMPAT_MODE = "MANAGER_COMPATIBILITY_MODE"
+ENV_DISABLE_CONTROL = "DISABLE_VTPU_CONTROL"
+ENV_REGISTER_UUID = "VTPU_REGISTER_UUID"    # random id for CLIENT-mode match
+ENV_POD_NAME = "VTPU_POD_NAME"
+ENV_POD_NAMESPACE = "VTPU_POD_NAMESPACE"
+ENV_POD_UID = "VTPU_POD_UID"
+ENV_CONTAINER_NAME = "VTPU_CONTAINER_NAME"
+
+# libtpu-facing visibility (the TPU runtime's own device mask).
+ENV_TPU_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"
+# PJRT plugin substitution point: JAX loads the TPU PJRT plugin from this
+# path; the device plugin points it at libvtpu-control.so which chains to the
+# real plugin (the ld.so.preload analogue — reference vnum_plugin.go:872-879).
+ENV_TPU_LIBRARY_PATH = "TPU_LIBRARY_PATH"
+ENV_PJRT_PLUGIN_LIBRARY_PATH = "PJRT_PLUGIN_LIBRARY_PATH"
+ENV_VTPU_REAL_PLUGIN_PATH = "VTPU_REAL_TPU_LIBRARY_PATH"
+
+# Compatibility modes (bitmask, reference: hook.h:386-392).
+COMPAT_HOST = 0x01       # count every process on the chip
+COMPAT_CGROUP = 0x02     # attribute pids via cgroup files under host_proc
+COMPAT_CLIENT = 0x04     # pids from registry-written pids.config
+COMPAT_OPEN_KERNEL = 0x08  # runtime hides foreign processes
+
+# ---------------------------------------------------------------------------
+# Filesystem layout (the L3 node-shared-state ABI; reference §2.1 L3)
+# ---------------------------------------------------------------------------
+
+MANAGER_BASE_DIR = "/etc/vtpu-manager"
+CONTAINER_CONFIG_SUBPATH = "config/vtpu.config"   # under <pod-uid>_<container>
+WATCHER_DIR = f"{MANAGER_BASE_DIR}/watcher"
+TC_UTIL_CONFIG = f"{WATCHER_DIR}/tc_util.config"
+HOST_PROC_DIR = f"{MANAGER_BASE_DIR}/.host_proc"
+REGISTRY_DIR = f"{MANAGER_BASE_DIR}/registry"
+REGISTRY_SOCKET = f"{REGISTRY_DIR}/socket.sock"
+DRIVER_DIR = f"{MANAGER_BASE_DIR}/driver"          # shim install dir on node
+CONTROL_LIBRARY_NAME = "libvtpu-control.so"
+
+LOCK_DIR = "/tmp/.vtpu_lock"                        # per-device OFD locks
+VMEM_DIR = "/tmp/.vmem_node"
+VMEM_NODE_CONFIG = f"{VMEM_DIR}/vmem_node.config"
+PIDS_CONFIG_NAME = "pids.config"
+
+DEVICES_JSON_NAME = "devices.json"                  # plugin-local record
+
+# ---------------------------------------------------------------------------
+# Limits / cadences (reference: hook.h:153,173-174; watcher.go:128)
+# ---------------------------------------------------------------------------
+
+MAX_DEVICE_COUNT = 64          # chips per node (v5p host=4, v5e host=8; headroom)
+MAX_PIDS_PER_DEVICE = 256
+
+TOKEN_TICK_MS = 10             # throttled-launch retry sleep
+WATCHER_INTERVAL_MS = 100      # in-shim utilization watcher budget per cycle
+NODE_WATCHER_INTERVAL_MS = 80  # node-level TC-util watcher
+EXTERNAL_WATCHER_FRESH_S = 5   # mmap staleness before local fallback
+LOCK_TIMEOUT_S = 10
+GAP_THRESHOLD_MS = 200
+GAP_MAX_SLEEP_MS = 500
+
+# Grace period before a stale pre-allocation stops counting against capacity
+# (reference: device.MustInitGlobalStuckGracePeriod).
+DEFAULT_STUCK_GRACE_S = 120
+
+# Scheduler name handled by the extender-configured kube-scheduler profile.
+DEFAULT_SCHEDULER_NAME = "vtpu-scheduler"
+
+# DRA driver name (reference DRA DeviceClass driver).
+DRA_DRIVER_NAME = "vtpu.resource.google.com"

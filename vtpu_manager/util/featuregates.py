@@ -1,0 +1,85 @@
+"""Feature gates, modeled on k8s component-base gates.
+
+Reference: cmd/device-plugin/options/options.go:70-100 (8 gates) and
+pkg/kubeletplugin/featuregates/featuregates.go. Each binary constructs a
+FeatureGates with its defaults and parses ``--feature-gates=a=true,b=false``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# Gate names (reference parity; TPU renames: SMWatcher -> TCWatcher).
+CORE_PLUGIN = "CorePlugin"              # advertise vtpu-cores resource
+MEMORY_PLUGIN = "MemoryPlugin"          # advertise vtpu-memory resource
+RESCHEDULE = "Reschedule"               # failed-allocation eviction controller
+TPU_TOPOLOGY = "TPUTopology"            # publish ICI topology, enable ici mode
+TC_WATCHER = "TCWatcher"                # node-level TensorCore-util watcher
+VMEMORY_NODE = "VMemoryNode"            # cross-process virtual-memory ledger
+CLIENT_MODE = "ClientMode"              # registry-socket pid attribution
+HONOR_PREALLOC_IDS = "HonorPreAllocatedDeviceIDs"
+NRI_SUPPORT = "NRISupport"              # DRA: runtime-hook injection
+SERIAL_FILTER_NODE = "SerialFilterNode"
+SERIAL_BIND_NODE = "SerialBindNode"
+
+_KNOWN = {
+    CORE_PLUGIN: False,
+    MEMORY_PLUGIN: False,
+    RESCHEDULE: False,
+    TPU_TOPOLOGY: False,
+    TC_WATCHER: False,
+    VMEMORY_NODE: False,
+    CLIENT_MODE: False,
+    HONOR_PREALLOC_IDS: False,
+    NRI_SUPPORT: False,
+    SERIAL_FILTER_NODE: False,
+    SERIAL_BIND_NODE: False,
+}
+
+
+@dataclass
+class FeatureGates:
+    """Immutable-after-parse set of boolean gates."""
+
+    gates: dict[str, bool] = field(default_factory=lambda: dict(_KNOWN))
+
+    def enabled(self, name: str) -> bool:
+        if name not in self.gates:
+            raise KeyError(f"unknown feature gate {name!r}")
+        return self.gates[name]
+
+    def set(self, name: str, value: bool) -> None:
+        if name not in self.gates:
+            raise KeyError(f"unknown feature gate {name!r}")
+        self.gates[name] = value
+
+    def parse(self, spec: str) -> None:
+        """Parse ``Gate1=true,Gate2=false`` (k8s --feature-gates syntax).
+
+        All-or-nothing: the whole spec is validated before any gate is
+        applied, and every parse problem (including unknown gate names)
+        raises ValueError so CLI error handling has one exception to catch.
+        """
+        if not spec:
+            return
+        parsed: list[tuple[str, bool]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"invalid feature gate spec {part!r}")
+            name, _, raw = part.partition("=")
+            name = name.strip()
+            raw = raw.strip().lower()
+            if raw not in ("true", "false"):
+                raise ValueError(f"invalid feature gate value {part!r}")
+            if name not in self.gates:
+                raise ValueError(f"unknown feature gate {name!r}")
+            parsed.append((name, raw == "true"))
+        for name, value in parsed:
+            self.set(name, value)
+
+    def known(self) -> list[str]:
+        return sorted(self.gates)

@@ -1,0 +1,134 @@
+"""Cross-process file locks with timeout + backoff.
+
+Python side of the reference's OFD-lock discipline (library/src/lock.c:15-68:
+open-file-description locks, exponential backoff 1..10ms, 10s timeout). The
+C++ shim uses the identical protocol (library/src/lock.cc) so Python daemons
+and in-container shims exclude each other on the same lock files.
+
+We use flock(2) here: Linux flock locks are per-open-file-description by
+definition, giving the same cross-process/atfork semantics the reference gets
+from F_OFD_SETLK, without fcntl's same-process self-deadlock exemption.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import fcntl
+import os
+import time
+
+from vtpu_manager.util import consts
+
+
+class LockTimeout(TimeoutError):
+    pass
+
+
+class FileLock:
+    """A flock-based lock on a dedicated lock file.
+
+    Non-reentrant. Backoff 1ms doubling to 10ms cap; raises LockTimeout after
+    ``timeout_s`` (reference: lock.c:26-28,207-211 — fail the operation
+    rather than hang).
+    """
+
+    def __init__(self, path: str, timeout_s: float = consts.LOCK_TIMEOUT_S):
+        self.path = path
+        self.timeout_s = timeout_s
+        self._fd: int | None = None
+
+    def acquire(self) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o666)
+        deadline = time.monotonic() + self.timeout_s
+        backoff = 0.001
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fd = fd
+                return
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    os.close(fd)
+                    raise
+            if time.monotonic() >= deadline:
+                os.close(fd)
+                raise LockTimeout(f"lock {self.path} not acquired "
+                                  f"within {self.timeout_s}s")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.010)
+
+    def release(self) -> None:
+        if self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self) -> "FileLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def device_lock_path(host_index: int, lock_dir: str = consts.LOCK_DIR) -> str:
+    """Per-device allocation lock (reference: /tmp/.vgpu_lock/vgpu_<i>.lock)."""
+    return os.path.join(lock_dir, f"vtpu_{host_index}.lock")
+
+
+@contextlib.contextmanager
+def lock_device(host_index: int, lock_dir: str = consts.LOCK_DIR,
+                timeout_s: float = consts.LOCK_TIMEOUT_S):
+    """Node-wide critical section for one chip's memory accounting
+    (reference: lock_gpu_device, lock.c:173-214)."""
+    lk = FileLock(device_lock_path(host_index, lock_dir), timeout_s)
+    lk.acquire()
+    try:
+        yield
+    finally:
+        lk.release()
+
+
+# struct flock on Linux x86-64/aarch64: short l_type, short l_whence,
+# long l_start, long l_len, int l_pid (padded). F_OFD_SETLK requires l_pid=0.
+_F_OFD_SETLK = 37
+_STRUCT_FLOCK = "hhqqi4x"
+
+
+def _ofd_lock(fd: int, ltype: int, offset: int, length: int) -> None:
+    import struct as _struct
+    flock = _struct.pack(_STRUCT_FLOCK, ltype, os.SEEK_SET, offset, length, 0)
+    fcntl.fcntl(fd, _F_OFD_SETLK, flock)
+
+
+@contextlib.contextmanager
+def byte_range_write_lock(fd: int, offset: int, length: int,
+                          timeout_s: float = consts.LOCK_TIMEOUT_S):
+    """OFD byte-range write lock on an open mmap'd file — used by the node
+    TC-util watcher for per-device record updates (reference:
+    manager/watcher.go per-device byte-range locks; lock.c:30-68).
+
+    Real F_OFD_SETLK, not POSIX lockf: OFD locks are owned by the open file
+    description, so they are not silently dropped when an unrelated code path
+    in this process closes another fd on the same file, and they conflict
+    properly with the C++ shim's OFD locks.
+    """
+    deadline = time.monotonic() + timeout_s
+    backoff = 0.001
+    while True:
+        try:
+            _ofd_lock(fd, fcntl.F_WRLCK, offset, length)
+            break
+        except OSError as e:
+            if e.errno not in (errno.EAGAIN, errno.EACCES):
+                raise
+        if time.monotonic() >= deadline:
+            raise LockTimeout(f"byte-range lock fd={fd} @{offset}+{length}")
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 0.010)
+    try:
+        yield
+    finally:
+        _ofd_lock(fd, fcntl.F_UNLCK, offset, length)
