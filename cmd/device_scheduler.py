@@ -1,0 +1,81 @@
+"""vtpu device-scheduler: kube-scheduler extender server.
+
+Reference: cmd/device-scheduler (G2). Runs the HTTP extender endpoints
+(filter/bind/preempt) against the cluster API; --fake-client serves a
+synthetic in-memory cluster for local smoke testing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import ssl
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="vtpu scheduler extender")
+    parser.add_argument("--port", type=int, default=8768)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--cert-file")
+    parser.add_argument("--key-file")
+    parser.add_argument("--feature-gates", default="")
+    parser.add_argument("--require-node-label", action="store_true",
+                        help="only consider nodes labeled "
+                             "vtpu-manager-enable=true")
+    parser.add_argument("--fake-client", action="store_true",
+                        help="serve a synthetic 2-node cluster (smoke tests)")
+    parser.add_argument("--fake-chips", type=int, default=4)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from vtpu_manager.scheduler.bind import BindPredicate
+    from vtpu_manager.scheduler.filter import FilterPredicate
+    from vtpu_manager.scheduler.preempt import PreemptPredicate
+    from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
+    from vtpu_manager.scheduler.serial import SerialLocker
+    from vtpu_manager.util.featuregates import SERIAL_BIND_NODE, FeatureGates
+
+    gates = FeatureGates()
+    gates.parse(args.feature_gates)
+
+    if args.fake_client:
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.device import types as dt
+        client = FakeKubeClient(upsert_on_patch=True)
+        for i in range(2):
+            reg = dt.fake_registry(args.fake_chips,
+                                   mesh_shape=(2, args.fake_chips // 2))
+            client.add_node(dt.fake_node(f"fake-node-{i}", reg))
+    else:
+        from vtpu_manager.client.kube import InClusterClient
+        client = InClusterClient()
+
+    bind_locker = SerialLocker(gates.enabled(SERIAL_BIND_NODE))
+    api = SchedulerAPI(
+        FilterPredicate(client,
+                        require_node_label=args.require_node_label),
+        BindPredicate(client, locker=bind_locker),
+        PreemptPredicate(client))
+
+    ssl_ctx = None
+    if args.cert_file and args.key_file:
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(args.cert_file, args.key_file)
+
+    logging.getLogger(__name__).info(
+        "vtpu-scheduler listening on %s:%d (fake=%s)", args.host, args.port,
+        args.fake_client)
+    run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

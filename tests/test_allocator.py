@@ -1,0 +1,285 @@
+"""Allocator + mesh topology + node scoring.
+
+Mirrors the reference's allocator_test.go / besteffort_test.go combinatorics
+on fake devices (SURVEY.md §4): no TPU runtime, pure data structures.
+"""
+
+import pytest
+
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.allocator.allocator import (AllocationFailure,
+                                                     allocate)
+from vtpu_manager.device.allocator.priority import (ScoredNode, node_score,
+                                                    order_nodes)
+from vtpu_manager.device.allocator.request import build_allocation_request
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.device.topology.mesh import (group_by_host, select_host_local,
+                                               select_submesh)
+from vtpu_manager.scheduler import reason as R
+from vtpu_manager.util import consts
+
+
+def pod_requesting(number=1, cores=50, memory_mib=1024, annotations=None,
+                   uid="uid-x"):
+    return {
+        "metadata": {"name": "p", "namespace": "default", "uid": uid,
+                     "annotations": annotations or {}},
+        "spec": {"containers": [{"name": "main", "resources": {"limits": {
+            consts.vtpu_number_resource(): number,
+            consts.vtpu_cores_resource(): cores,
+            consts.vtpu_memory_resource(): memory_mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+class TestMeshSelection:
+    def test_exact_rectangle(self):
+        # 2x4 mesh fully free: 4 chips should come back as a 2x2 square
+        reg = dt.fake_registry(8, mesh_shape=(2, 4))
+        sel = select_submesh(reg.chips, 4, reg.mesh)
+        assert sel.kind == "rect"
+        coords = sorted((c.coords[0], c.coords[1]) for c in sel.chips)
+        xs = {x for x, _ in coords}
+        ys = {y for _, y in coords}
+        assert len(xs) == 2 and len(ys) == 2  # square, not a 1x4 line
+
+    def test_squarer_beats_line(self):
+        reg = dt.fake_registry(16, mesh_shape=(4, 4))
+        sel = select_submesh(reg.chips, 4, reg.mesh)
+        coords = [(c.coords[0], c.coords[1]) for c in sel.chips]
+        assert len({x for x, _ in coords}) == 2
+
+    def test_greedy_fallback_when_fragmented(self):
+        # free cells form an L that contains no 2x2 or 1x4 rectangle
+        reg = dt.fake_registry(8, mesh_shape=(2, 4))
+        free = [c for c in reg.chips
+                if (c.coords[0], c.coords[1]) in
+                [(0, 0), (1, 0), (0, 1), (1, 2)]]
+        sel = select_submesh(free, 4, reg.mesh)
+        assert sel is not None
+        assert sel.kind == "greedy"
+        assert len(sel.chips) == 4
+
+    def test_not_enough_chips(self):
+        reg = dt.fake_registry(2)
+        assert select_submesh(reg.chips, 3, reg.mesh) is None
+
+    def test_torus_wrap_window(self):
+        # 1x4 ring with wrap: cells (0,3) and (0,0) are adjacent
+        reg = dt.fake_registry(4, mesh_shape=(1, 4))
+        mesh = dt.MeshSpec((1, 4, 1), (False, True, False))
+        free = [c for c in reg.chips if c.coords[1] in (0, 3)]
+        sel = select_submesh(free, 2, mesh)
+        assert sel.kind == "rect"
+
+    def test_prefer_origin_alignment(self):
+        reg = dt.fake_registry(16, mesh_shape=(4, 4))
+        sel = select_submesh(reg.chips, 4, reg.mesh, prefer_origin=(2, 2))
+        coords = sorted((c.coords[0], c.coords[1]) for c in sel.chips)
+        assert coords[0] == (2, 2)
+
+    def test_3d_mesh_box(self):
+        # v5p-style 2x2x2 torus: 8 chips differing in z must all be usable
+        chips = []
+        i = 0
+        for z in range(2):
+            for y in range(2):
+                for x in range(2):
+                    chips.append(dt.fake_chip(i, coords=(x, y, z)))
+                    i += 1
+        mesh = dt.MeshSpec((2, 2, 2))
+        sel = select_submesh(chips, 8, mesh)
+        assert sel.kind == "rect"
+        assert len(sel.chips) == 8
+        # 4 chips from a 3-D mesh: 2x2x1 slab beats 1x1x4-ish shapes
+        sel4 = select_submesh(chips, 4, mesh)
+        assert sel4.kind == "rect"
+
+    def test_duplicate_coords_do_not_crash(self):
+        chips = [dt.fake_chip(i, coords=(0, 0, 0)) for i in range(4)]
+        assert select_submesh(chips, 4, dt.MeshSpec((2, 2, 1))) is None
+
+    def test_host_grouping(self):
+        reg = dt.fake_registry(8, chips_per_host=4)
+        groups = group_by_host(reg.chips)
+        assert set(groups) == {0, 1}
+        picked = select_host_local(reg.chips, 3)
+        assert len({c.host_id for c in picked}) == 1
+
+
+class TestAllocator:
+    def test_simple_allocation(self):
+        info = dt.fake_node_info("n1", 2)
+        req = build_allocation_request(pod_requesting(1, 25, 1024))
+        res = allocate(info, req)
+        claims = res.claims.all_claims()
+        assert len(claims) == 1
+        assert claims[0].cores == 25
+        assert claims[0].memory == 1024 * 2**20
+        # original info untouched; result's copy charged
+        assert info.total_free_cores() == 200
+        assert res.node_info.devices[claims[0].uuid].used_cores == 25
+
+    def test_no_memory_request_gets_split_share(self):
+        info = dt.fake_node_info("n1", 1, split_count=4)
+        req = build_allocation_request(pod_requesting(1, 10, 0))
+        res = allocate(info, req)
+        chip = info.registry.chips[0]
+        assert res.claims.all_claims()[0].memory == chip.memory // 4
+
+    def test_insufficient_cores_reason(self):
+        info = dt.fake_node_info("n1", 1)
+        uuid = info.registry.chips[0].uuid
+        held = PodDeviceClaims()
+        held.add("c", DeviceClaim(uuid, 0, 80, 2**30))
+        info.assume_pod("other", held)
+        req = build_allocation_request(pod_requesting(1, 50, 1024))
+        with pytest.raises(AllocationFailure) as ei:
+            allocate(info, req)
+        assert ei.value.reasons.counts[R.INSUFFICIENT_CORES] == 1
+
+    def test_binpack_prefers_used_device(self):
+        info = dt.fake_node_info("n1", 2)
+        first = info.registry.chips[0].uuid
+        held = PodDeviceClaims()
+        held.add("c", DeviceClaim(first, 0, 30, 2**30))
+        info.assume_pod("other", held)
+        req = build_allocation_request(pod_requesting(1, 20, 512))
+        res = allocate(info, req)
+        assert res.claims.all_claims()[0].uuid == first
+
+    def test_spread_prefers_empty_device(self):
+        info = dt.fake_node_info("n1", 2)
+        first = info.registry.chips[0].uuid
+        held = PodDeviceClaims()
+        held.add("c", DeviceClaim(first, 0, 30, 2**30))
+        info.assume_pod("other", held)
+        req = build_allocation_request(pod_requesting(
+            1, 20, 512,
+            annotations={consts.device_policy_annotation(): "spread"}))
+        res = allocate(info, req)
+        assert res.claims.all_claims()[0].uuid != first
+
+    def test_ici_topology_allocates_rectangle(self):
+        info = dt.fake_node_info("n1", 8, mesh_shape=(2, 4))
+        req = build_allocation_request(pod_requesting(
+            4, 10, 512,
+            annotations={consts.topology_mode_annotation(): "ici"}))
+        res = allocate(info, req)
+        assert res.topology_kind == "rect"
+        coords = sorted(info.devices[c.uuid].spec.coords[:2]
+                        for c in res.claims.all_claims())
+        assert len({x for x, _ in coords}) == 2  # 2x2 square
+
+    def test_ici_strict_fails_on_fragmentation(self):
+        info = dt.fake_node_info("n1", 8, mesh_shape=(2, 4))
+        # poison cells so no 4-chip rectangle exists
+        for cell in [(0, 0), (1, 1), (0, 2), (1, 3)]:
+            for usage in info.devices.values():
+                if usage.spec.coords[:2] == cell:
+                    usage.used_number = usage.spec.split_count
+        req = build_allocation_request(pod_requesting(
+            4, 10, 512,
+            annotations={consts.topology_mode_annotation(): "ici-strict"}))
+        with pytest.raises(AllocationFailure) as ei:
+            allocate(info, req)
+        assert ei.value.reasons.counts[R.NODE_TOPOLOGY_UNSATISFIED] == 1
+
+    def test_ici_nonstrict_falls_back_to_greedy(self):
+        info = dt.fake_node_info("n1", 8, mesh_shape=(2, 4))
+        for cell in [(0, 0), (1, 1), (0, 2), (1, 3)]:
+            for usage in info.devices.values():
+                if usage.spec.coords[:2] == cell:
+                    usage.used_number = usage.spec.split_count
+        req = build_allocation_request(pod_requesting(
+            4, 10, 512,
+            annotations={consts.topology_mode_annotation(): "ici"}))
+        res = allocate(info, req)
+        assert res.topology_kind == "greedy"
+        assert len(res.claims.all_claims()) == 4
+
+    def test_host_topology(self):
+        info = dt.fake_node_info("n1", 8, chips_per_host=4)
+        req = build_allocation_request(pod_requesting(
+            2, 10, 512,
+            annotations={consts.topology_mode_annotation(): "host"}))
+        res = allocate(info, req)
+        hosts = {info.devices[c.uuid].spec.host_id
+                 for c in res.claims.all_claims()}
+        assert len(hosts) == 1
+
+    def test_multi_container_charging(self):
+        # two containers each wanting 60% cannot share one chip
+        info = dt.fake_node_info("n1", 2)
+        pod = pod_requesting(1, 60, 512)
+        pod["spec"]["containers"].append({
+            "name": "second", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): 60,
+                consts.vtpu_memory_resource(): 512}}})
+        req = build_allocation_request(pod)
+        res = allocate(info, req)
+        uuids = [c.uuid for c in res.claims.all_claims()]
+        assert uuids[0] != uuids[1]
+
+    def test_unhealthy_excluded(self):
+        info = dt.fake_node_info("n1", 1)
+        uuid = info.registry.chips[0].uuid
+        info.devices[uuid].spec = dt.replace(info.devices[uuid].spec,
+                                             healthy=False)
+        req = build_allocation_request(pod_requesting(1, 10, 512))
+        with pytest.raises(AllocationFailure) as ei:
+            allocate(info, req)
+        assert ei.value.reasons.counts[R.UNHEALTHY] == 1
+
+
+class TestNodeScoring:
+    def test_binpack_prefers_fuller_node(self):
+        req = build_allocation_request(pod_requesting(1, 10, 512))
+        empty = dt.fake_node_info("empty", 4)
+        fullish = dt.fake_node_info("fullish", 4)
+        held = PodDeviceClaims()
+        for chip in fullish.registry.chips[:3]:
+            held.add("c", DeviceClaim(chip.uuid, chip.index, 90,
+                                      14 * 2**30))
+        fullish.assume_pod("o", held)
+        res_e = allocate(empty, req)
+        res_f = allocate(fullish, req)
+        ordered = order_nodes([
+            ScoredNode("empty", node_score(res_e, req), res_e),
+            ScoredNode("fullish", node_score(res_f, req), res_f)])
+        assert ordered[0].name == "fullish"
+
+    def test_spread_prefers_emptier_node(self):
+        ann = {consts.node_policy_annotation(): "spread"}
+        req = build_allocation_request(pod_requesting(1, 10, 512,
+                                                      annotations=ann))
+        empty = dt.fake_node_info("empty", 4)
+        fullish = dt.fake_node_info("fullish", 4)
+        held = PodDeviceClaims()
+        for chip in fullish.registry.chips[:3]:
+            held.add("c", DeviceClaim(chip.uuid, chip.index, 90, 14 * 2**30))
+        fullish.assume_pod("o", held)
+        res_e = allocate(empty, req)
+        res_f = allocate(fullish, req)
+        ordered = order_nodes([
+            ScoredNode("empty", node_score(res_e, req), res_e),
+            ScoredNode("fullish", node_score(res_f, req), res_f)])
+        assert ordered[0].name == "empty"
+
+    def test_rect_topology_dominates_packing(self):
+        ann = {consts.topology_mode_annotation(): "ici"}
+        req = build_allocation_request(pod_requesting(4, 10, 512,
+                                                      annotations=ann))
+        whole = dt.fake_node_info("whole", 8, mesh_shape=(2, 4))
+        frag = dt.fake_node_info("frag", 8, mesh_shape=(2, 4))
+        for cell in [(0, 0), (1, 1), (0, 2), (1, 3)]:
+            for usage in frag.devices.values():
+                if usage.spec.coords[:2] == cell:
+                    usage.used_number = usage.spec.split_count
+        res_w = allocate(whole, req)
+        res_f = allocate(frag, req)
+        ordered = order_nodes([
+            ScoredNode("whole", node_score(res_w, req), res_w),
+            ScoredNode("frag", node_score(res_f, req), res_f)])
+        assert ordered[0].name == "whole"

@@ -1,0 +1,330 @@
+"""Scheduler extender: filter/bind/preempt against the fake clientset.
+
+Mirrors reference filter_predicate_test.go / bind_predicate_test.go /
+preempt_predicate_test.go patterns: synthetic nodes with device annotations,
+end-to-end predicate calls, annotation assertions (SURVEY.md §4).
+"""
+
+import time
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.scheduler import gang
+from vtpu_manager.scheduler.bind import BindPredicate
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.preempt import PreemptPredicate
+from vtpu_manager.util import consts
+
+
+def vtpu_pod(name="p1", uid=None, number=1, cores=25, memory_mib=1024,
+             annotations=None, node_name=None, namespace="default",
+             priority=0):
+    pod = {
+        "metadata": {"name": name, "namespace": namespace,
+                     "uid": uid or f"uid-{name}",
+                     "annotations": annotations or {}},
+        "spec": {"priority": priority, "containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): number,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): memory_mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    return pod
+
+
+def plain_pod(name="plain"):
+    return {"metadata": {"name": name, "namespace": "default",
+                         "uid": f"uid-{name}", "annotations": {}},
+            "spec": {"containers": [{"name": "c", "resources": {}}]},
+            "status": {"phase": "Pending"}}
+
+
+@pytest.fixture
+def cluster():
+    client = FakeKubeClient()
+    for i in range(3):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2))
+        client.add_node(dt.fake_node(f"node-{i}", reg))
+    client.add_node({"metadata": {"name": "no-tpu-node"}})
+    return client
+
+
+class TestFilter:
+    def test_picks_one_node_and_patches(self, cluster):
+        pred = FilterPredicate(cluster)
+        pod = vtpu_pod()
+        cluster.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert not result.error
+        assert len(result.node_names) == 1
+        assert "no-tpu-node" in result.failed_nodes
+        patched = cluster.get_pod("default", "p1")
+        anns = patched["metadata"]["annotations"]
+        claims = PodDeviceClaims.decode(
+            anns[consts.pre_allocated_annotation()])
+        assert claims.all_claims()[0].cores == 25
+        assert anns[consts.predicate_node_annotation()] == \
+            result.node_names[0]
+        assert float(anns[consts.predicate_time_annotation()]) <= time.time()
+
+    def test_non_vtpu_pod_passes_all(self, cluster):
+        pred = FilterPredicate(cluster)
+        pod = plain_pod()
+        result = pred.filter({"Pod": pod})
+        assert not result.error
+        assert len(result.node_names) == 4
+
+    def test_rejection_aggregated_event(self, cluster):
+        pred = FilterPredicate(cluster)
+        pod = vtpu_pod(number=40)  # no node has 40 free slots... (4 chips*10)
+        cluster.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert result.error
+        assert not result.node_names
+        assert len(cluster.events) == 1
+        assert "FilterFailed" == cluster.events[0]["reason"]
+
+    def test_resident_pods_consume_capacity(self, cluster):
+        node = cluster.get_node("node-0")
+        reg = dt.NodeDeviceRegistry.decode(
+            node["metadata"]["annotations"][
+                consts.node_device_register_annotation()])
+        # occupy every chip of every node except node-2's chips with 90%
+        for n in range(2):
+            claims = PodDeviceClaims()
+            node_n = cluster.get_node(f"node-{n}")
+            reg_n = dt.NodeDeviceRegistry.decode(
+                node_n["metadata"]["annotations"][
+                    consts.node_device_register_annotation()])
+            for chip in reg_n.chips:
+                claims.add("c", DeviceClaim(chip.uuid, chip.index, 90,
+                                            2**30))
+            holder = vtpu_pod(name=f"holder-{n}", node_name=f"node-{n}",
+                              annotations={
+                                  consts.real_allocated_annotation():
+                                      claims.encode()})
+            holder["status"]["phase"] = "Running"
+            cluster.add_pod(holder)
+        pred = FilterPredicate(cluster)
+        pod = vtpu_pod(name="newpod", cores=50)
+        cluster.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert result.node_names == ["node-2"]
+
+    def test_nodenames_subset(self, cluster):
+        pred = FilterPredicate(cluster)
+        pod = vtpu_pod()
+        cluster.add_pod(pod)
+        result = pred.filter({"Pod": pod, "NodeNames": ["node-1"]})
+        assert result.node_names == ["node-1"]
+
+    def test_lowercase_nodes_items_wire_format(self, cluster):
+        # real ExtenderArgs serializes as {"pod":..,"nodes":{"items":[..]}}
+        pred = FilterPredicate(cluster)
+        pod = vtpu_pod()
+        cluster.add_pod(pod)
+        result = pred.filter({
+            "pod": pod,
+            "nodes": {"items": [cluster.get_node("node-2")]}})
+        assert result.node_names == ["node-2"]
+
+    def test_back_to_back_filters_share_assumed_state(self, cluster):
+        # Chips have 100 cores; two 60% pods must not share a chip even
+        # though the fake client (like a lagging informer) does not yet
+        # show pod A as resident when pod B filters.
+        client = FakeKubeClient()
+        reg = dt.fake_registry(1)
+        client.add_node(dt.fake_node("solo", reg))
+        pred = FilterPredicate(client)
+        a, b = vtpu_pod(name="a", cores=60), vtpu_pod(name="b", cores=60)
+        client.add_pod(a)
+        client.add_pod(b)
+        ra = pred.filter({"Pod": a})
+        assert ra.node_names == ["solo"]
+        # strip nodeName so pod a is NOT listed as resident on 'solo'
+        # (it has no nodeName yet — exactly the informer-lag window)
+        rb = pred.filter({"Pod": b})
+        assert rb.error  # only one chip, 60+60 > 100
+
+    def test_gang_origin_alignment(self, cluster):
+        pred = FilterPredicate(cluster)
+        sib_ann = {consts.gang_name_annotation(): "g1",
+                   gang.gang_origin_annotation(): "1,1"}
+        sibling = vtpu_pod(name="sib", annotations=sib_ann,
+                           node_name="node-1")
+        cluster.add_pod(sibling)
+        pod = vtpu_pod(name="member2", number=1, annotations={
+            consts.gang_name_annotation(): "g1",
+            consts.topology_mode_annotation(): "ici"})
+        cluster.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert not result.error
+        patched = cluster.get_pod("default", "member2")
+        origin = gang.decode_origin(
+            patched["metadata"]["annotations"][
+                gang.gang_origin_annotation()])
+        assert origin == (1, 1)
+
+
+class TestBind:
+    def _preallocate(self, cluster, pod_name="p1"):
+        pred = FilterPredicate(cluster)
+        pod = vtpu_pod(name=pod_name)
+        cluster.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        return result.node_names[0]
+
+    def test_successful_bind(self, cluster):
+        node = self._preallocate(cluster)
+        res = BindPredicate(cluster).bind(
+            {"PodName": "p1", "PodNamespace": "default", "Node": node})
+        assert not res.error
+        assert cluster.bindings == [("default", "p1", node)]
+        anns = cluster.get_pod("default", "p1")["metadata"]["annotations"]
+        assert anns[consts.allocation_status_annotation()] == "allocating"
+
+    def test_bind_wrong_node_rejected(self, cluster):
+        node = self._preallocate(cluster)
+        other = "node-2" if node != "node-2" else "node-1"
+        res = BindPredicate(cluster).bind(
+            {"PodName": "p1", "PodNamespace": "default", "Node": other})
+        assert "predicate node" in res.error
+        assert not cluster.bindings
+
+    def test_bind_without_preallocation(self, cluster):
+        cluster.add_pod(vtpu_pod(name="fresh"))
+        res = BindPredicate(cluster).bind(
+            {"PodName": "fresh", "PodNamespace": "default", "Node": "node-0"})
+        assert "no vtpu pre-allocation" in res.error
+
+    def test_bind_expired_preallocation(self, cluster):
+        node = self._preallocate(cluster)
+        cluster.patch_pod_annotations("default", "p1", {
+            consts.predicate_time_annotation(): str(time.time() - 10_000)})
+        res = BindPredicate(cluster).bind(
+            {"PodName": "p1", "PodNamespace": "default", "Node": node})
+        assert "expired" in res.error
+
+
+class TestPreempt:
+    def _occupied_cluster(self):
+        client = FakeKubeClient()
+        reg = dt.fake_registry(1)
+        client.add_node(dt.fake_node("node-0", reg))
+        claims = PodDeviceClaims()
+        claims.add("c", DeviceClaim(reg.chips[0].uuid, 0, 80, 12 * 2**30))
+        victim = vtpu_pod(name="victim", node_name="node-0", priority=1,
+                          annotations={
+                              consts.real_allocated_annotation():
+                                  claims.encode()})
+        victim["status"]["phase"] = "Running"
+        client.add_pod(victim)
+        bystander = plain_pod("bystander")
+        bystander["spec"]["nodeName"] = "node-0"
+        client.add_pod(bystander)
+        return client, reg
+
+    def test_victim_needed_is_kept(self):
+        client, _ = self._occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": [
+                client.get_pod("default", "victim")]}}})
+        assert not res.error
+        kept = res.node_to_victims["node-0"]
+        assert [p["metadata"]["name"] for p in kept] == ["victim"]
+
+    def test_unneeded_vtpu_victim_dropped(self):
+        client, reg = self._occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=10, priority=100)
+        # 10% fits beside the 80% victim: victim should be spared
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": [
+                client.get_pod("default", "victim")]}}})
+        assert res.node_to_victims["node-0"] == []
+
+    def test_unsatisfiable_node_removed(self):
+        client, _ = self._occupied_cluster()
+        preemptor = vtpu_pod(name="pre", number=4, priority=100)
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": [
+                client.get_pod("default", "victim")]}}})
+        assert res.error
+
+    def test_missing_victims_added(self):
+        client, reg = self._occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        # kube-scheduler proposed only the bystander (useless for vtpu)
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": [
+                client.get_pod("default", "bystander")]}}})
+        kept = res.node_to_victims["node-0"]
+        names = {p["metadata"]["name"] for p in kept}
+        assert "victim" in names
+
+    def test_meta_victims_wire_format(self):
+        # nodeCacheCapable=true: scheduler sends UIDs only
+        client, _ = self._occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        victim_uid = client.get_pod("default", "victim")["metadata"]["uid"]
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToMetaVictims": {"node-0": {"Pods": [
+                {"UID": victim_uid}]}}})
+        kept = res.node_to_victims["node-0"]
+        assert [p["metadata"]["name"] for p in kept] == ["victim"]
+        wire = res.to_wire()
+        assert wire["NodeNameToMetaVictims"]["node-0"]["Pods"] == [
+            {"UID": victim_uid}]
+
+
+class TestHTTPRoutes:
+    def _api(self, cluster):
+        from vtpu_manager.scheduler.routes import SchedulerAPI
+        return SchedulerAPI(FilterPredicate(cluster), BindPredicate(cluster),
+                            PreemptPredicate(cluster))
+
+    def test_filter_endpoint(self, cluster):
+        import asyncio
+        from aiohttp.test_utils import TestClient, TestServer
+        api = self._api(cluster)
+        pod = vtpu_pod()
+        cluster.add_pod(pod)
+
+        async def scenario():
+            async with TestClient(TestServer(api.build_app())) as client:
+                resp = await client.post("/scheduler/filter",
+                                         json={"Pod": pod})
+                body = await resp.json()
+                assert resp.status == 200
+                assert len(body["NodeNames"]) == 1
+                health = await client.get("/healthz")
+                assert await health.text() == "ok"
+                metrics = await client.get("/metrics")
+                assert "vtpu_scheduler_requests_total" in \
+                    await metrics.text()
+
+        asyncio.run(scenario())
+
+    def test_malformed_body_reports_error(self, cluster):
+        import asyncio
+        from aiohttp.test_utils import TestClient, TestServer
+        api = self._api(cluster)
+
+        async def scenario():
+            async with TestClient(TestServer(api.build_app())) as client:
+                resp = await client.post("/scheduler/filter", data=b"not json")
+                body = await resp.json()
+                assert "Error" in body
+
+        asyncio.run(scenario())

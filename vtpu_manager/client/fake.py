@@ -1,0 +1,132 @@
+"""Fake in-memory KubeClient (the fake-clientset test pattern).
+
+Reference test strategy: scheduler/webhook/controller tests run against
+k8s.io/client-go/kubernetes/fake with real informers (SURVEY.md §4); this is
+the Python equivalent. Thread-safe; records bindings/evictions/events for
+assertions.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+
+from vtpu_manager.client.kube import KubeError
+
+
+class FakeKubeClient:
+    def __init__(self, upsert_on_patch: bool = False):
+        # upsert_on_patch: smoke-server convenience — a patched-but-unknown
+        # pod is created instead of 404ing (tests keep strict semantics).
+        self.upsert_on_patch = upsert_on_patch
+        self._lock = threading.RLock()
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[tuple[str, str], dict] = {}
+        self.bindings: list[tuple[str, str, str]] = []   # (ns, pod, node)
+        self.evictions: list[tuple[str, str]] = []
+        self.deletions: list[tuple[str, str]] = []
+        self.events: list[dict] = []
+
+    # -- fixture helpers ----------------------------------------------------
+
+    def add_node(self, node: dict) -> None:
+        with self._lock:
+            self.nodes[node["metadata"]["name"]] = copy.deepcopy(node)
+
+    def add_pod(self, pod: dict) -> None:
+        meta = pod["metadata"]
+        with self._lock:
+            self.pods[(meta.get("namespace", "default"),
+                       meta["name"])] = copy.deepcopy(pod)
+
+    # -- KubeClient protocol ------------------------------------------------
+
+    def list_nodes(self) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(n) for n in self.nodes.values()]
+
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            if name not in self.nodes:
+                raise KubeError(404, f"node {name} not found")
+            return copy.deepcopy(self.nodes[name])
+
+    def patch_node_annotations(self, name: str, annotations: dict) -> dict:
+        with self._lock:
+            node = self.nodes.get(name)
+            if node is None:
+                raise KubeError(404, f"node {name} not found")
+            anns = node.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            for k, v in annotations.items():
+                if v is None:
+                    anns.pop(k, None)
+                else:
+                    anns[k] = v
+            return copy.deepcopy(node)
+
+    def list_pods(self, namespace=None, node_name=None,
+                  field_selector=None) -> list[dict]:
+        with self._lock:
+            out = []
+            for (ns, _), pod in self.pods.items():
+                if namespace and ns != namespace:
+                    continue
+                if node_name and \
+                        (pod.get("spec") or {}).get("nodeName") != node_name:
+                    continue
+                out.append(copy.deepcopy(pod))
+            return out
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            pod = self.pods.get((namespace, name))
+            if pod is None:
+                raise KubeError(404, f"pod {namespace}/{name} not found")
+            return copy.deepcopy(pod)
+
+    def patch_pod_annotations(self, namespace: str, name: str,
+                              annotations: dict) -> dict:
+        with self._lock:
+            pod = self.pods.get((namespace, name))
+            if pod is None:
+                if not self.upsert_on_patch:
+                    raise KubeError(404, f"pod {namespace}/{name} not found")
+                pod = {"metadata": {"name": name, "namespace": namespace,
+                                    "annotations": {}},
+                       "spec": {}, "status": {"phase": "Pending"}}
+                self.pods[(namespace, name)] = pod
+            anns = pod.setdefault("metadata", {}).setdefault("annotations", {})
+            for k, v in annotations.items():
+                if v is None:
+                    anns.pop(k, None)
+                else:
+                    anns[k] = v
+            return copy.deepcopy(pod)
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        with self._lock:
+            pod = self.pods.get((namespace, name))
+            if pod is None:
+                raise KubeError(404, f"pod {namespace}/{name} not found")
+            pod.setdefault("spec", {})["nodeName"] = node
+            self.bindings.append((namespace, name, node))
+
+    def delete_pod(self, namespace: str, name: str,
+                   grace_seconds=None) -> None:
+        with self._lock:
+            if (namespace, name) not in self.pods:
+                raise KubeError(404, f"pod {namespace}/{name} not found")
+            del self.pods[(namespace, name)]
+            self.deletions.append((namespace, name))
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if (namespace, name) not in self.pods:
+                raise KubeError(404, f"pod {namespace}/{name} not found")
+            del self.pods[(namespace, name)]
+            self.evictions.append((namespace, name))
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        with self._lock:
+            self.events.append(copy.deepcopy(event))

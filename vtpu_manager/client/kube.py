@@ -1,0 +1,147 @@
+"""Minimal Kubernetes API client protocol + in-cluster REST implementation.
+
+Reference: pkg/client (G13) — patch helpers, listers, eviction, binding. The
+Go reference uses client-go; this image has no kubernetes Python package, so
+we implement the few verbs the control plane needs over the REST API with
+stdlib urllib (control-plane QPS is low; no streaming watch — components
+re-list on their own cadence, which the reference also does for NodeInfo).
+
+All objects are plain dicts in k8s JSON shape. Every component takes the
+KubeClient protocol so tests swap in FakeKubeClient (the fake-clientset
+pattern, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Protocol
+
+
+class KubeError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"kube api {status}: {message}")
+        self.status = status
+
+
+class KubeClient(Protocol):
+    def list_nodes(self) -> list[dict]: ...
+    def get_node(self, name: str) -> dict: ...
+    def patch_node_annotations(self, name: str, annotations: dict) -> dict: ...
+    def list_pods(self, namespace: str | None = None,
+                  node_name: str | None = None,
+                  field_selector: str | None = None) -> list[dict]: ...
+    def get_pod(self, namespace: str, name: str) -> dict: ...
+    def patch_pod_annotations(self, namespace: str, name: str,
+                              annotations: dict) -> dict: ...
+    def bind_pod(self, namespace: str, name: str, node: str) -> None: ...
+    def delete_pod(self, namespace: str, name: str,
+                   grace_seconds: int | None = None) -> None: ...
+    def evict_pod(self, namespace: str, name: str) -> None: ...
+    def create_event(self, namespace: str, event: dict) -> None: ...
+
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class InClusterClient:
+    """REST client using the pod service account (in-cluster only)."""
+
+    def __init__(self, api_server: str | None = None,
+                 token_path: str = f"{SERVICE_ACCOUNT_DIR}/token",
+                 ca_path: str = f"{SERVICE_ACCOUNT_DIR}/ca.crt"):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base = api_server or f"https://{host}:{port}"
+        with open(token_path) as f:
+            self._token = f.read().strip()
+        self._ctx = ssl.create_default_context(cafile=ca_path)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 content_type: str = "application/json") -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base + path, data=data,
+                                     method=method)
+        req.add_header("Authorization", f"Bearer {self._token}")
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, context=self._ctx,
+                                        timeout=30) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else {}
+        except urllib.error.HTTPError as e:
+            raise KubeError(e.code, e.read().decode(errors="replace")) from e
+
+    @staticmethod
+    def _merge_patch_annotations(annotations: dict) -> dict:
+        return {"metadata": {"annotations": annotations}}
+
+    # -- verbs --------------------------------------------------------------
+
+    def list_nodes(self) -> list[dict]:
+        return self._request("GET", "/api/v1/nodes").get("items", [])
+
+    def get_node(self, name: str) -> dict:
+        return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def patch_node_annotations(self, name: str, annotations: dict) -> dict:
+        return self._request(
+            "PATCH", f"/api/v1/nodes/{name}",
+            self._merge_patch_annotations(annotations),
+            content_type="application/merge-patch+json")
+
+    def list_pods(self, namespace: str | None = None,
+                  node_name: str | None = None,
+                  field_selector: str | None = None) -> list[dict]:
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        selectors = []
+        if node_name:
+            selectors.append(f"spec.nodeName={node_name}")
+        if field_selector:
+            selectors.append(field_selector)
+        if selectors:
+            path += "?fieldSelector=" + ",".join(selectors)
+        return self._request("GET", path).get("items", [])
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._request("GET",
+                             f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def patch_pod_annotations(self, namespace: str, name: str,
+                              annotations: dict) -> dict:
+        return self._request(
+            "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}",
+            self._merge_patch_annotations(annotations),
+            content_type="application/merge-patch+json")
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        self._request("POST",
+                      f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+                      {"apiVersion": "v1", "kind": "Binding",
+                       "metadata": {"name": name, "namespace": namespace},
+                       "target": {"apiVersion": "v1", "kind": "Node",
+                                  "name": node}})
+
+    def delete_pod(self, namespace: str, name: str,
+                   grace_seconds: int | None = None) -> None:
+        path = f"/api/v1/namespaces/{namespace}/pods/{name}"
+        if grace_seconds is not None:
+            path += f"?gracePeriodSeconds={grace_seconds}"
+        self._request("DELETE", path)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        self._request("POST",
+                      f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+                      {"apiVersion": "policy/v1", "kind": "Eviction",
+                       "metadata": {"name": name, "namespace": namespace}})
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        self._request("POST", f"/api/v1/namespaces/{namespace}/events", event)

@@ -1,0 +1,171 @@
+"""Per-node device allocation: pick concrete chips for each container.
+
+Reference: pkg/device/allocator/allocator.go:65-199 (Allocate), :237-288
+(allocateOne), :349/:764-841 (device filter + per-reason failure counts),
+:379-712 (topology modes), :458-482 (strict vs fallback).
+
+The allocator mutates nothing: it takes a NodeInfo (already charged with
+resident pods) and returns claims + the NodeInfo deltas applied to a copy,
+or a FailureReasons explaining why the node cannot host the pod. Containers
+are allocated in order; each container's picks are charged before the next
+container is considered (multi-container pods share chips only when capacity
+allows).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+
+from vtpu_manager.device.allocator.request import (AllocationRequest,
+                                                   ContainerRequest)
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.device.topology.mesh import (MeshSelection, select_host_local,
+                                               select_submesh)
+from vtpu_manager.device.types import DeviceUsage, NodeInfo
+from vtpu_manager.scheduler import reason as R
+from vtpu_manager.util import consts
+
+
+@dataclass
+class AllocationResult:
+    claims: PodDeviceClaims
+    node_info: NodeInfo                  # post-allocation view (copy)
+    topology_kind: str = "any"           # "rect"/"greedy"/"host"/"any"
+    score: float = 0.0                   # topology fitness (node comparator)
+
+
+@dataclass
+class AllocationFailure(Exception):
+    reasons: R.FailureReasons = field(default_factory=R.FailureReasons)
+
+    def __str__(self) -> str:
+        return self.reasons.summary()
+
+
+def _effective_memory(usage: DeviceUsage, cont: ContainerRequest) -> int:
+    """memory==0 means a proportional split share of the chip (reference:
+    request.go — no memory request means total/split_count)."""
+    if cont.memory:
+        return cont.memory
+    return usage.spec.memory // max(usage.spec.split_count, 1)
+
+
+def _filter_devices(info: NodeInfo, req: AllocationRequest,
+                    cont: ContainerRequest,
+                    reasons: R.FailureReasons) -> list[DeviceUsage]:
+    """Capacity/type/uuid/health gate with per-reason counting
+    (reference: allocator.go:764-841)."""
+    out = []
+    for usage in info.devices.values():
+        spec = usage.spec
+        if not spec.healthy:
+            reasons.add(R.UNHEALTHY, spec.uuid)
+            continue
+        if req.include_types and spec.chip_type not in req.include_types:
+            reasons.add(R.TYPE_EXCLUDED, spec.uuid)
+            continue
+        if req.exclude_types and spec.chip_type in req.exclude_types:
+            reasons.add(R.TYPE_EXCLUDED, spec.uuid)
+            continue
+        if req.include_uuids and spec.uuid not in req.include_uuids:
+            reasons.add(R.UUID_EXCLUDED, spec.uuid)
+            continue
+        if req.exclude_uuids and spec.uuid in req.exclude_uuids:
+            reasons.add(R.UUID_EXCLUDED, spec.uuid)
+            continue
+        if usage.free_number < 1:
+            reasons.add(R.NO_FREE_SLOTS, spec.uuid)
+            continue
+        if usage.free_cores < cont.cores:
+            reasons.add(R.INSUFFICIENT_CORES, spec.uuid)
+            continue
+        if usage.free_memory < _effective_memory(usage, cont):
+            reasons.add(R.INSUFFICIENT_MEMORY, spec.uuid)
+            continue
+        out.append(usage)
+    return out
+
+
+def _sort_by_device_policy(devices: list[DeviceUsage], policy: str) -> None:
+    """binpack: most-used-first so fragments fill up; spread: least-used
+    (reference: priority.go device comparators)."""
+    def used_key(u: DeviceUsage):
+        return (u.used_cores + (100 * u.used_memory // max(u.spec.memory, 1)),
+                u.used_number, u.spec.index)
+    if policy == consts.DEVICE_POLICY_BINPACK:
+        devices.sort(key=lambda u: (-used_key(u)[0], -used_key(u)[1],
+                                    used_key(u)[2]))
+    else:
+        devices.sort(key=used_key)
+
+
+def _allocate_container(info: NodeInfo, req: AllocationRequest,
+                        cont: ContainerRequest,
+                        prefer_origin: tuple[int, int] | None,
+                        reasons: R.FailureReasons
+                        ) -> tuple[list[DeviceUsage], str, float]:
+    candidates = _filter_devices(info, req, cont, reasons)
+    if len(candidates) < cont.number:
+        reasons.add(R.NODE_INSUFFICIENT_CAPACITY, info.name)
+        raise AllocationFailure(reasons)
+
+    mode = req.topology_mode
+    strict = mode.endswith("-strict")
+    base_mode = mode.removesuffix("-strict")
+
+    if base_mode == consts.TOPOLOGY_ICI and cont.number >= 1:
+        free_specs = [u.spec for u in candidates]
+        sel: MeshSelection | None = select_submesh(
+            free_specs, cont.number, info.registry.mesh,
+            prefer_origin=prefer_origin,
+            binpack=req.device_policy == consts.DEVICE_POLICY_BINPACK)
+        if sel is not None and (sel.kind == "rect" or not strict):
+            by_uuid = {u.spec.uuid: u for u in candidates}
+            return ([by_uuid[c.uuid] for c in sel.chips], sel.kind, sel.score)
+        if strict:
+            reasons.add(R.NODE_TOPOLOGY_UNSATISFIED, info.name)
+            raise AllocationFailure(reasons)
+
+    if base_mode == consts.TOPOLOGY_HOST and cont.number > 1:
+        free_specs = [u.spec for u in candidates]
+        picked = select_host_local(
+            free_specs, cont.number,
+            binpack=req.device_policy == consts.DEVICE_POLICY_BINPACK)
+        if picked is not None:
+            by_uuid = {u.spec.uuid: u for u in candidates}
+            return ([by_uuid[c.uuid] for c in picked], "host", 50.0)
+        if strict:
+            reasons.add(R.NODE_TOPOLOGY_UNSATISFIED, info.name)
+            raise AllocationFailure(reasons)
+
+    _sort_by_device_policy(candidates, req.device_policy)
+    return (candidates[:cont.number], "any", 0.0)
+
+
+def allocate(info: NodeInfo, req: AllocationRequest,
+             prefer_origin: tuple[int, int] | None = None) -> AllocationResult:
+    """Allocate every claiming container of the pod on this node.
+
+    Raises AllocationFailure with aggregated reasons when the pod does not
+    fit. On success returns the claims and the charged NodeInfo copy.
+    """
+    work = copy.deepcopy(info)
+    claims = PodDeviceClaims()
+    kind = "any"
+    score = 0.0
+    for cont in req.claiming_containers():
+        reasons = R.FailureReasons()
+        picked, k, s = _allocate_container(work, req, cont, prefer_origin,
+                                           reasons)
+        if k != "any":
+            kind, score = k, max(score, s)
+        for usage in picked:
+            claim = DeviceClaim(uuid=usage.spec.uuid,
+                                host_index=usage.spec.index,
+                                cores=cont.cores,
+                                memory=_effective_memory(usage, cont))
+            claims.add(cont.name, claim)
+            usage.assume(req.pod_uid, claim)
+    return AllocationResult(claims=claims, node_info=work,
+                            topology_kind=kind, score=score)

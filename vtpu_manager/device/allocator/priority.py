@@ -1,0 +1,70 @@
+"""Node scoring and ordering for candidate nodes.
+
+Reference: pkg/device/allocator/priority.go:136-229 — binpack/spread node
+scores weighted by the request's resource profile (a memory-heavy pod weighs
+memory utilization higher), plus topology-fitness comparators (:54-89) so a
+node offering an exact mesh rectangle beats one needing the greedy fallback.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from vtpu_manager.device.allocator.allocator import AllocationResult
+from vtpu_manager.device.allocator.request import AllocationRequest
+from vtpu_manager.device.types import NodeInfo
+from vtpu_manager.util import consts
+
+_TOPO_RANK = {"rect": 3, "host": 2, "greedy": 1, "any": 0}
+
+
+def _utilization(info: NodeInfo) -> tuple[float, float, float]:
+    """(slot, core, memory) used fractions across healthy devices."""
+    devs = info.healthy_devices()
+    if not devs:
+        return (0.0, 0.0, 0.0)
+    slots = sum(d.spec.split_count for d in devs)
+    cores = 100 * len(devs)
+    mem = sum(d.spec.memory for d in devs)
+    return (sum(d.used_number for d in devs) / max(slots, 1),
+            sum(d.used_cores for d in devs) / max(cores, 1),
+            sum(d.used_memory for d in devs) / max(mem, 1))
+
+
+def _request_weights(req: AllocationRequest) -> tuple[float, float, float]:
+    """Weight dimensions by what the pod actually asks for."""
+    n = float(req.total_number())
+    c = float(req.total_cores()) / 100.0
+    m = float(req.total_memory()) / float(16 * 2**30)
+    total = n + c + m
+    if total <= 0:
+        return (1 / 3, 1 / 3, 1 / 3)
+    return (n / total, c / total, m / total)
+
+
+def node_score(result: AllocationResult, req: AllocationRequest) -> float:
+    """Score a successful per-node allocation; higher = better placement.
+
+    Topology fitness dominates (an exact ICI rectangle is worth more than
+    any packing difference), then policy-weighted utilization of the node
+    *after* the allocation: binpack wants the fullest node, spread the
+    emptiest.
+    """
+    wn, wc, wm = _request_weights(req)
+    un, uc, um = _utilization(result.node_info)
+    util = wn * un + wc * uc + wm * um
+    packing = util if req.node_policy == consts.NODE_POLICY_BINPACK \
+        else (1.0 - util)
+    return _TOPO_RANK[result.topology_kind] * 10.0 + packing
+
+
+@dataclass(frozen=True)
+class ScoredNode:
+    name: str
+    score: float
+    result: AllocationResult
+
+
+def order_nodes(scored: list[ScoredNode]) -> list[ScoredNode]:
+    """Best-first, name as deterministic tie-break."""
+    return sorted(scored, key=lambda s: (-s.score, s.name))

@@ -100,22 +100,24 @@ class AllocationRequest:
 
 
 def _parse_quantity(raw) -> int:
-    """Parse a k8s-style integer quantity (we only accept plain integers and
-    Ki/Mi/Gi suffixes — vtpu resources are counts, percents, and MiB)."""
+    """Parse a vtpu resource quantity: plain integers only.
+
+    vtpu resources are counts, percents, and MiB — already denominated.
+    Suffixed k8s quantities ("4Gi") are rejected loudly rather than
+    double-scaled: "4Gi" of a MiB-denominated resource is ambiguous, and
+    silently reading it as 4294967296 MiB would make the pod permanently
+    unschedulable with no hint why.
+    """
     if isinstance(raw, int):
         return raw
     s = str(raw).strip()
-    mult = 1
-    for suffix, m in (("Ki", 2**10), ("Mi", 2**20), ("Gi", 2**30), ("k", 10**3),
-                      ("M", 10**6), ("G", 10**9)):
-        if s.endswith(suffix):
-            mult = m
-            s = s[: -len(suffix)]
-            break
     try:
-        return int(float(s) * mult)
-    except ValueError as e:
-        raise RequestError(f"bad quantity {raw!r}") from e
+        return int(s)
+    except ValueError:
+        raise RequestError(
+            f"bad quantity {raw!r}: vtpu resources take plain integers "
+            "(vtpu-number = chips, vtpu-cores = percent, vtpu-memory = MiB)"
+        ) from None
 
 
 def _container_request(cont: dict, is_init: bool) -> ContainerRequest:

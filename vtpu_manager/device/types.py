@@ -158,10 +158,6 @@ class DeviceUsage:
     def free_memory(self) -> int:
         return self.spec.memory - self.used_memory
 
-    def fits(self, cores: int, memory: int) -> bool:
-        return (self.free_number >= 1 and self.free_cores >= cores
-                and self.free_memory >= memory)
-
     def assume(self, pod_uid: str, claim: DeviceClaim) -> None:
         self.used_number += 1
         self.used_cores += claim.cores
@@ -274,9 +270,6 @@ class NodeInfo:
 
     def total_free_memory(self) -> int:
         return sum(max(d.free_memory, 0) for d in self.healthy_devices())
-
-    def by_index(self) -> dict[int, DeviceUsage]:
-        return {d.spec.index: d for d in self.devices.values()}
 
     def assume_pod(self, pod_uid: str, claims: PodDeviceClaims) -> None:
         """Locally account a just-made allocation so back-to-back filter

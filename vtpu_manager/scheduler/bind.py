@@ -1,0 +1,77 @@
+"""Bind predicate: verify the pre-allocation and create the Binding.
+
+Reference: pkg/scheduler/bind/bind_predicate.go:54-142 — the extender owns
+bind: it re-checks that the node kube-scheduler settled on matches the node
+the filter pre-allocated, that the pre-allocation is still fresh, patches the
+"allocating" status, then creates the Binding object itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.scheduler.serial import SerialLocker
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class BindResult:
+    error: str = ""
+
+    def to_wire(self) -> dict:
+        return {"Error": self.error} if self.error else {}
+
+
+class BindPredicate:
+    def __init__(self, client: KubeClient, locker: SerialLocker | None = None,
+                 freshness_s: float = consts.DEFAULT_STUCK_GRACE_S):
+        self.client = client
+        self.locker = locker or SerialLocker(serialize_all=False)
+        self.freshness_s = freshness_s
+
+    def bind(self, args: dict) -> BindResult:
+        ns = args.get("PodNamespace") or args.get("podNamespace") or "default"
+        name = args.get("PodName") or args.get("podName") or ""
+        node = args.get("Node") or args.get("node") or ""
+        with self.locker.section(f"{ns}/{name}"):
+            return self._bind_locked(ns, name, node)
+
+    def _bind_locked(self, ns: str, name: str, node: str) -> BindResult:
+        try:
+            pod = self.client.get_pod(ns, name)
+        except KubeError as e:
+            return BindResult(error=f"pod fetch failed: {e}")
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+
+        predicate_node = anns.get(consts.predicate_node_annotation())
+        if not predicate_node:
+            return BindResult(error="pod has no vtpu pre-allocation")
+        if predicate_node != node:
+            # kube-scheduler picked a different node than the filter
+            # committed to; binding there would detach the claim from its
+            # devices (reference :54-142 fails the bind the same way).
+            return BindResult(
+                error=f"predicate node {predicate_node!r} != bind "
+                      f"target {node!r}")
+
+        ts_raw = anns.get(consts.predicate_time_annotation(), "")
+        try:
+            ts = float(ts_raw)
+        except ValueError:
+            ts = 0.0
+        if ts and (time.time() - ts) > self.freshness_s:
+            return BindResult(error="pre-allocation expired; re-filter needed")
+
+        try:
+            self.client.patch_pod_annotations(ns, name, {
+                consts.allocation_status_annotation():
+                    consts.ALLOC_STATUS_ALLOCATING})
+            self.client.bind_pod(ns, name, node)
+        except KubeError as e:
+            return BindResult(error=f"bind failed: {e}")
+        return BindResult()

@@ -1,0 +1,287 @@
+"""Filter predicate: the extender's core scheduling pass.
+
+Reference: pkg/scheduler/filter/filter_predicate.go:158-268 (entry),
+:312-415 (nodeFilter), :541-866 (deviceFilter). Flow per Filter call:
+
+1. Parse the pod once into an AllocationRequest.
+2. Pods with no vtpu request pass every node untouched.
+3. nodeFilter: drop nodes without a device registry / failing label gates.
+4. deviceFilter: build NodeInfo for each candidate in parallel from node +
+   resident-pod annotations, pre-gate total capacity, allocate on each
+   surviving node, score, pick the best, and write the pre-allocated +
+   predicate annotations to the pod via the API server. Only the chosen
+   node is returned (the reference also commits to one node at filter time).
+
+State crosses process boundaries via annotations only. Two defenses against
+double-booking (reference: SerialFilterNode gate + local informer mutation,
+filter_predicate.go:853-857):
+- filter passes are serialized by default (`serialize=True`; the perf
+  harness may disable it to measure raw throughput);
+- committed allocations enter an in-process assumed cache that is folded
+  into NodeInfo until the API server's pod list reflects the annotation,
+  bridging list lag even across serialized calls.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.device.allocator.allocator import (AllocationFailure,
+                                                     allocate)
+from vtpu_manager.device.allocator.priority import (ScoredNode, node_score,
+                                                    order_nodes)
+from vtpu_manager.device.allocator.request import (AllocationRequest,
+                                                   RequestError,
+                                                   build_allocation_request)
+from vtpu_manager.device.claims import PodDeviceClaims
+from vtpu_manager.device.types import NodeInfo
+from vtpu_manager.scheduler import gang, reason as R
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+# Nodes must carry this label to be considered when the selector is enabled
+# (reference nodeFilter label gate, filter_predicate.go:312-415).
+NODE_ENABLE_LABEL = "vtpu-manager-enable"
+
+ASSUME_TTL_S = 60.0
+
+
+@dataclass
+class FilterResult:
+    """Mirror of the extender API's ExtenderFilterResult."""
+
+    node_names: list[str] = field(default_factory=list)
+    failed_nodes: dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_wire(self) -> dict:
+        out: dict = {"NodeNames": self.node_names,
+                     "FailedNodes": self.failed_nodes}
+        if self.error:
+            out["Error"] = self.error
+        return out
+
+
+@dataclass
+class _Assumed:
+    node: str
+    claims: PodDeviceClaims
+    ts: float
+
+
+class FilterPredicate:
+    def __init__(self, client: KubeClient, serialize: bool = True,
+                 require_node_label: bool = False, max_workers: int = 8):
+        self.client = client
+        self.serialize = serialize
+        self._serial_lock = threading.Lock()
+        self.require_node_label = require_node_label
+        self.max_workers = max_workers
+        self._assumed: dict[str, _Assumed] = {}   # pod uid -> commit
+        self._assumed_lock = threading.Lock()
+
+    # -- assumed-allocation cache -------------------------------------------
+
+    def _assume(self, pod_uid: str, node: str,
+                claims: PodDeviceClaims) -> None:
+        with self._assumed_lock:
+            self._assumed[pod_uid] = _Assumed(node, claims, time.time())
+
+    def _assumed_for_node(self, node: str,
+                          visible_uids: set[str]) -> list[_Assumed]:
+        """Assumed commits for `node` not yet visible in the pod list.
+        Expired entries (pod deleted before ever appearing) are dropped."""
+        now = time.time()
+        out = []
+        with self._assumed_lock:
+            for uid in list(self._assumed):
+                entry = self._assumed[uid]
+                if uid in visible_uids or now - entry.ts > ASSUME_TTL_S:
+                    del self._assumed[uid]
+                elif entry.node == node:
+                    out.append((uid, entry))
+        return out
+
+    # -- stage 1: node-level gates (cheap, no pod listing) ------------------
+
+    def _node_gate(self, node: dict, req: AllocationRequest) -> str | None:
+        meta = node.get("metadata") or {}
+        if self.require_node_label:
+            labels = meta.get("labels") or {}
+            if labels.get(NODE_ENABLE_LABEL) != "true":
+                return R.NODE_LABEL_MISMATCH
+        anns = meta.get("annotations") or {}
+        if not anns.get(consts.node_device_register_annotation()):
+            return R.NODE_NO_DEVICES
+        return None
+
+    # -- stage 2: device-level allocation -----------------------------------
+
+    def _build_info(self, node: dict, resident: list[dict],
+                    now: float) -> NodeInfo | None:
+        name = (node.get("metadata") or {}).get("name", "")
+        info = NodeInfo.build(node, resident, now=now)
+        if info is None:
+            return None
+        visible = {(p.get("metadata") or {}).get("uid", "") for p in resident}
+        for uid, entry in self._assumed_for_node(name, visible):
+            info.assume_pod(uid, entry.claims)
+        return info
+
+    def _try_node(self, node: dict, resident: list[dict],
+                  req: AllocationRequest, now: float,
+                  prefer_origin) -> tuple[str, ScoredNode | None, str]:
+        name = (node.get("metadata") or {}).get("name", "")
+        info = self._build_info(node, resident, now)
+        if info is None:
+            return (name, None, R.NODE_NO_DEVICES)
+        # capacity pre-gates (reference :682-711): cheap totals before the
+        # expensive allocator run
+        if (info.total_free_number() < req.total_number()
+                or info.total_free_cores() < req.total_cores()
+                or info.total_free_memory() < req.total_memory()):
+            return (name, None, R.NODE_INSUFFICIENT_CAPACITY)
+        try:
+            result = allocate(info, req, prefer_origin=prefer_origin)
+        except AllocationFailure as f:
+            return (name, None, f.reasons.summary() or "allocation failed")
+        return (name, ScoredNode(name, node_score(result, req), result), "")
+
+    # -- entry --------------------------------------------------------------
+
+    def filter(self, args: dict) -> FilterResult:
+        pod = args.get("Pod") or args.get("pod") or {}
+        nodes = self._candidate_nodes(args)
+        try:
+            req = build_allocation_request(pod)
+        except RequestError as e:
+            return FilterResult(error=f"invalid vtpu request: {e}")
+        if req.is_empty():
+            return FilterResult(node_names=[
+                (n.get("metadata") or {}).get("name", "") for n in nodes])
+
+        if self.serialize:
+            with self._serial_lock:
+                return self._filter_locked(pod, req, nodes)
+        return self._filter_locked(pod, req, nodes)
+
+    def _candidate_nodes(self, args: dict) -> list[dict]:
+        # ExtenderArgs with nodeCacheCapable=false carries the full NodeList
+        # (k8s JSON: {"nodes":{"items":[...]}}); with nodeCacheCapable=true
+        # only node names. Accept both Go-field and JSON-tag casing.
+        node_list = args.get("Nodes") or args.get("nodes")
+        if node_list:
+            items = node_list.get("Items") or node_list.get("items")
+            if items:
+                return items
+        names = args.get("NodeNames") or args.get("nodenames")
+        if names is None:
+            return self.client.list_nodes()
+        out = []
+        for name in names:
+            try:
+                out.append(self.client.get_node(name))
+            except KubeError:
+                continue
+        return out
+
+    def _filter_locked(self, pod: dict, req: AllocationRequest,
+                       nodes: list[dict]) -> FilterResult:
+        now = time.time()
+        result = FilterResult()
+        reasons = R.FailureReasons()
+
+        candidates = []
+        for node in nodes:
+            name = (node.get("metadata") or {}).get("name", "")
+            why = self._node_gate(node, req)
+            if why is None:
+                candidates.append(node)
+            else:
+                result.failed_nodes[name] = why
+                reasons.add(why, name)
+
+        # One cluster-wide pod list per pass, partitioned by nodeName —
+        # not one API call per candidate node.
+        all_pods = self.client.list_pods()
+        by_node: dict[str, list[dict]] = {}
+        for p in all_pods:
+            node_name = (p.get("spec") or {}).get("nodeName")
+            if node_name:
+                by_node.setdefault(node_name, []).append(p)
+
+        prefer_origin = None
+        if req.gang_name:
+            prefer_origin = gang.resolve_gang_origin(req.gang_name, all_pods)
+
+        scored: list[ScoredNode] = []
+        if candidates:
+            with ThreadPoolExecutor(
+                    max_workers=min(self.max_workers,
+                                    len(candidates))) as pool:
+                outcomes = list(pool.map(
+                    lambda n: self._try_node(
+                        n, by_node.get(
+                            (n.get("metadata") or {}).get("name", ""), []),
+                        req, now, prefer_origin),
+                    candidates))
+            for name, sn, why in outcomes:
+                if sn is not None:
+                    scored.append(sn)
+                else:
+                    result.failed_nodes[name] = why
+                    reasons.add(why.split(";")[0].split(" x")[0], name)
+
+        if not scored:
+            result.error = reasons.summary() or "no schedulable vtpu node"
+            self._emit_rejection_event(pod, result.error)
+            return result
+
+        best = order_nodes(scored)[0]
+        self._commit(pod, req, best)
+        result.node_names = [best.name]
+        return result
+
+    # -- commit: annotation patch is the only cross-process channel ---------
+
+    def _commit(self, pod: dict, req: AllocationRequest,
+                best: ScoredNode) -> None:
+        meta = pod.get("metadata") or {}
+        anns = {
+            consts.pre_allocated_annotation(): best.result.claims.encode(),
+            consts.predicate_node_annotation(): best.name,
+            consts.predicate_time_annotation(): str(time.time()),
+        }
+        if req.gang_name:
+            origin = gang.chosen_origin(best.result.node_info,
+                                        best.result.claims)
+            if origin is not None:
+                anns[gang.gang_origin_annotation()] = \
+                    gang.encode_origin(origin)
+        self.client.patch_pod_annotations(
+            meta.get("namespace", "default"), meta.get("name", ""), anns)
+        self._assume(meta.get("uid", ""), best.name, best.result.claims)
+
+    def _emit_rejection_event(self, pod: dict, message: str) -> None:
+        """One aggregated event per rejected pod (reference: reason.go)."""
+        meta = pod.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        try:
+            self.client.create_event(ns, {
+                "metadata": {"generateName": "vtpu-filter-"},
+                "involvedObject": {"kind": "Pod", "namespace": ns,
+                                   "name": meta.get("name", ""),
+                                   "uid": meta.get("uid", "")},
+                "reason": "FilterFailed",
+                "message": message[:1024],
+                "type": "Warning",
+            })
+        except KubeError:
+            log.warning("failed to emit rejection event for %s",
+                        meta.get("name"))

@@ -1,0 +1,64 @@
+"""Cross-pod gang mesh alignment.
+
+Reference: cross-pod NVLink gang rail alignment (allocator.go:379-660, gang
+sibling domain resolution filter_predicate.go:475-539, design doc
+docs/cross_pod_nvlink_topology_design.md): pods of one gang landing on
+different nodes should occupy *aligned* device positions so the inter-node
+fabric (there NVLink rails, here inter-host ICI on a multi-host slice) lines
+up neighbor-to-neighbor.
+
+TPU design: the first gang member to schedule records its mesh-window origin
+in a gang-origin annotation; later members prefer the same origin on their
+own hosts. On a v5e/v5p multi-host slice, equal per-host origins mean the
+gang's chips occupy congruent sub-meshes, so cross-host ICI neighbors align.
+"""
+
+from __future__ import annotations
+
+from vtpu_manager.device.types import NodeInfo, get_pod_device_claims
+from vtpu_manager.util import consts
+
+
+def gang_origin_annotation() -> str:
+    return f"{consts.annotation_domain()}/gang-origin"
+
+
+def encode_origin(origin: tuple[int, int]) -> str:
+    return f"{origin[0]},{origin[1]}"
+
+
+def decode_origin(raw: str | None) -> tuple[int, int] | None:
+    if not raw:
+        return None
+    try:
+        x, _, y = raw.partition(",")
+        return (int(x), int(y))
+    except ValueError:
+        return None
+
+
+def resolve_gang_origin(gang_name: str, all_pods: list[dict]
+                        ) -> tuple[int, int] | None:
+    """Find the origin already chosen by any sibling of the gang."""
+    if not gang_name:
+        return None
+    for pod in all_pods:
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        if anns.get(consts.gang_name_annotation()) != gang_name:
+            continue
+        origin = decode_origin(anns.get(gang_origin_annotation()))
+        if origin is not None:
+            return origin
+    return None
+
+
+def chosen_origin(info: NodeInfo, claims) -> tuple[int, int] | None:
+    """Derive the mesh origin (min coords) of a claim set on a node."""
+    coords = []
+    for claim in claims.all_claims():
+        usage = info.devices.get(claim.uuid)
+        if usage is not None:
+            coords.append(usage.spec.coords)
+    if not coords:
+        return None
+    return (min(c[0] for c in coords), min(c[1] for c in coords))

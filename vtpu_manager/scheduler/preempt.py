@@ -1,0 +1,149 @@
+"""Preempt predicate: re-validate kube-scheduler's victim sets.
+
+Reference: pkg/scheduler/preempt/preempt_predicate.go:1-747 — the in-tree
+preemption logic picks victims by pod priority without understanding vtpu
+device occupancy, so the extender corrects it: victims whose eviction frees
+no needed vtpu capacity are dropped, extra vtpu victims are added when the
+proposed set is not enough, and nodes where no victim set makes the pod fit
+are removed entirely. PDB-violation counts are preserved for kept victims.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from vtpu_manager.client.kube import KubeClient
+from vtpu_manager.device.allocator.allocator import (AllocationFailure,
+                                                     allocate)
+from vtpu_manager.device.allocator.request import (RequestError,
+                                                   build_allocation_request)
+from vtpu_manager.device.types import NodeInfo, get_pod_device_claims
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class PreemptResult:
+    node_to_victims: dict[str, list[dict]] = field(default_factory=dict)
+    error: str = ""
+
+    def to_wire(self) -> dict:
+        if self.error:
+            return {"Error": self.error}
+        return {"NodeNameToMetaVictims": {
+            node: {"Pods": [{"UID": (p.get("metadata") or {}).get("uid", "")}
+                            for p in pods]}
+            for node, pods in self.node_to_victims.items()}}
+
+
+def _pod_priority(pod: dict) -> int:
+    return (pod.get("spec") or {}).get("priority", 0)
+
+
+def _pod_uid(pod: dict) -> str:
+    return (pod.get("metadata") or {}).get("uid", "")
+
+
+class PreemptPredicate:
+    def __init__(self, client: KubeClient):
+        self.client = client
+
+    def preempt(self, args: dict) -> PreemptResult:
+        pod = args.get("Pod") or args.get("pod") or {}
+        # kube-scheduler sends NodeNameToVictims (full pods) when
+        # nodeCacheCapable=false and NodeNameToMetaVictims (UIDs only) when
+        # true; accept both, in Go-field or JSON-tag casing.
+        victims_in = (args.get("NodeNameToVictims")
+                      or args.get("nodeNameToVictims"))
+        meta_only = False
+        if victims_in is None:
+            victims_in = (args.get("NodeNameToMetaVictims")
+                          or args.get("nodeNameToMetaVictims") or {})
+            meta_only = True
+        try:
+            req = build_allocation_request(pod)
+        except RequestError as e:
+            return PreemptResult(error=f"invalid vtpu request: {e}")
+        if req.is_empty():
+            # nothing for us to correct; pass the proposal through
+            return PreemptResult(node_to_victims={
+                node: self._proposal_pods(node, v, meta_only)
+                for node, v in victims_in.items()})
+
+        result = PreemptResult()
+        for node_name, proposal in victims_in.items():
+            proposed = self._proposal_pods(node_name, proposal, meta_only)
+            kept = self._validate_node(node_name, req, proposed)
+            if kept is not None:
+                result.node_to_victims[node_name] = kept
+        if not result.node_to_victims:
+            result.error = "no node becomes schedulable by preemption"
+        return result
+
+    def _proposal_pods(self, node_name: str, proposal: dict | None,
+                       meta_only: bool) -> list[dict]:
+        """Resolve a victim proposal to pod dicts. MetaVictims carry only
+        UIDs; resolve them against the node's resident pods."""
+        pods = list((proposal or {}).get("Pods")
+                    or (proposal or {}).get("pods") or [])
+        if not meta_only:
+            return pods
+        uids = {(p.get("UID") or p.get("uid") or "") for p in pods}
+        resident = self.client.list_pods(node_name=node_name)
+        return [p for p in resident if _pod_uid(p) in uids]
+
+    def _validate_node(self, node_name: str, req,
+                       proposed: list[dict]) -> list[dict] | None:
+        try:
+            node = self.client.get_node(node_name)
+        except Exception:
+            return None
+        resident = self.client.list_pods(node_name=node_name)
+
+        def fits(victim_uids: set[str]) -> bool:
+            info = NodeInfo.build(
+                node, [p for p in resident if _pod_uid(p) not in victim_uids])
+            if info is None:
+                return False
+            try:
+                allocate(info, req)
+                return True
+            except AllocationFailure:
+                return False
+
+        proposed_uids = {_pod_uid(v) for v in proposed}
+        victims: dict[str, dict] = {_pod_uid(p): p for p in resident
+                                    if _pod_uid(p) in proposed_uids}
+
+        if not fits(set(victims)):
+            # proposed set insufficient: add vtpu-holding pods, lowest
+            # priority first, until the pod fits or we run out
+            extras = sorted(
+                (p for p in resident
+                 if _pod_uid(p) not in victims
+                 and get_pod_device_claims(p) is not None),
+                key=_pod_priority)
+            ok = False
+            for extra in extras:
+                victims[_pod_uid(extra)] = extra
+                if fits(set(victims)):
+                    ok = True
+                    break
+            if not ok:
+                return None
+
+        # minimize: a victim whose claims are not needed is spared
+        # (reference "drops unneeded victims")
+        for uid, victim in sorted(victims.items(),
+                                  key=lambda kv: _pod_priority(kv[1]),
+                                  reverse=True):
+            if get_pod_device_claims(victim) is None:
+                # non-vtpu victim: not ours to spare — kube-scheduler wants
+                # it for other resources; keep it
+                continue
+            if fits(set(victims) - {uid}):
+                del victims[uid]
+        return [victims[uid] for uid in sorted(victims)]
+
+

@@ -1,0 +1,58 @@
+"""Structured allocation-failure reasons, aggregated for events.
+
+Reference: pkg/scheduler/reason/reason.go:1-387 — per-device and per-node
+failure reasons are counted, bucketed, and collapsed into one human-readable k8s
+event so a 5000-node rejection doesn't produce 5000 events.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+
+# Device-level reasons
+NO_FREE_SLOTS = "NoFreeSlots"
+INSUFFICIENT_CORES = "InsufficientCores"
+INSUFFICIENT_MEMORY = "InsufficientMemory"
+TYPE_EXCLUDED = "TypeExcluded"
+UUID_EXCLUDED = "UuidExcluded"
+UNHEALTHY = "Unhealthy"
+
+# Node-level reasons
+NODE_NO_DEVICES = "NodeNoDevices"
+NODE_INSUFFICIENT_CAPACITY = "NodeInsufficientCapacity"
+NODE_LABEL_MISMATCH = "NodeLabelMismatch"
+NODE_TOPOLOGY_UNSATISFIED = "TopologyUnsatisfied"
+NODE_GANG_UNALIGNED = "GangUnaligned"
+
+
+@dataclass
+class FailureReasons:
+    """Counter of reasons across devices/nodes for one pod's filter pass."""
+
+    counts: Counter = field(default_factory=Counter)
+    samples: dict[str, str] = field(default_factory=dict)  # reason -> example
+
+    def add(self, reason: str, subject: str = "") -> None:
+        self.counts[reason] += 1
+        if subject and reason not in self.samples:
+            self.samples[reason] = subject
+
+    def merge(self, other: "FailureReasons") -> None:
+        self.counts.update(other.counts)
+        for k, v in other.samples.items():
+            self.samples.setdefault(k, v)
+
+    def is_empty(self) -> bool:
+        return not self.counts
+
+    def summary(self) -> str:
+        """One aggregated message, most-frequent first (event text)."""
+        if not self.counts:
+            return ""
+        parts = []
+        for reason, count in self.counts.most_common():
+            sample = self.samples.get(reason)
+            parts.append(f"{reason} x{count}" +
+                         (f" (e.g. {sample})" if sample else ""))
+        return "; ".join(parts)

@@ -1,0 +1,108 @@
+"""HTTP routing for the scheduler extender.
+
+Reference: pkg/route/routes.go:19-232 — POST /scheduler/filter, /bind,
+/preempt (kube-scheduler extender webhooks), plus healthz/readyz/version and
+Prometheus metrics. TLS optional. Request/response bodies are the upstream
+scheduler-extender JSON types, passed as dicts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from aiohttp import web
+
+from vtpu_manager.scheduler.bind import BindPredicate
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.preempt import PreemptPredicate
+
+log = logging.getLogger(__name__)
+
+VERSION = "0.1.0"
+
+
+class SchedulerAPI:
+    def __init__(self, filter_pred: FilterPredicate, bind_pred: BindPredicate,
+                 preempt_pred: PreemptPredicate):
+        self.filter_pred = filter_pred
+        self.bind_pred = bind_pred
+        self.preempt_pred = preempt_pred
+        self.stats = {"filter": 0, "bind": 0, "preempt": 0, "errors": 0}
+        self._started = time.time()
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 2**20)
+        app.router.add_post("/scheduler/filter", self.handle_filter)
+        app.router.add_post("/scheduler/bind", self.handle_bind)
+        app.router.add_post("/scheduler/preempt", self.handle_preempt)
+        app.router.add_get("/healthz", self.handle_healthz)
+        app.router.add_get("/readyz", self.handle_healthz)
+        app.router.add_get("/version", self.handle_version)
+        app.router.add_get("/metrics", self.handle_metrics)
+        return app
+
+    async def _body(self, request: web.Request) -> dict:
+        raw = await request.read()
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("%s body: %s", request.path, raw[:4096])
+        return json.loads(raw)
+
+    async def handle_filter(self, request: web.Request) -> web.Response:
+        self.stats["filter"] += 1
+        try:
+            args = await self._body(request)
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, self.filter_pred.filter, args)
+            return web.json_response(result.to_wire())
+        except Exception as e:   # extender contract: report via Error field
+            self.stats["errors"] += 1
+            log.exception("filter failed")
+            return web.json_response({"Error": str(e)})
+
+    async def handle_bind(self, request: web.Request) -> web.Response:
+        self.stats["bind"] += 1
+        try:
+            args = await self._body(request)
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, self.bind_pred.bind, args)
+            return web.json_response(result.to_wire())
+        except Exception as e:
+            self.stats["errors"] += 1
+            log.exception("bind failed")
+            return web.json_response({"Error": str(e)})
+
+    async def handle_preempt(self, request: web.Request) -> web.Response:
+        self.stats["preempt"] += 1
+        try:
+            args = await self._body(request)
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, self.preempt_pred.preempt, args)
+            return web.json_response(result.to_wire())
+        except Exception as e:
+            self.stats["errors"] += 1
+            log.exception("preempt failed")
+            return web.json_response({"Error": str(e)})
+
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def handle_version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": VERSION,
+                                  "uptime_s": time.time() - self._started})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        lines = ["# TYPE vtpu_scheduler_requests_total counter"]
+        for k, v in self.stats.items():
+            lines.append(
+                f'vtpu_scheduler_requests_total{{endpoint="{k}"}} {v}')
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+
+def run_server(api: SchedulerAPI, host: str = "0.0.0.0", port: int = 8768,
+               ssl_context=None) -> None:
+    web.run_app(api.build_app(), host=host, port=port,
+                ssl_context=ssl_context, print=None)

@@ -1,0 +1,31 @@
+"""Serial-section helpers for the extender endpoints.
+
+Reference: pkg/scheduler/serial/serial.go:1-111 — optional global locking of
+Filter and Bind passes (gated by SerialFilterNode / SerialBindNode) so that
+concurrent extender calls do not double-book devices before annotation
+patches land. Without the gate we still serialize per-pod via a keyed mutex.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class SerialLocker:
+    def __init__(self, serialize_all: bool):
+        self._serialize_all = serialize_all
+        self._global = threading.Lock()
+        self._keyed: dict[str, threading.Lock] = {}
+        self._keyed_guard = threading.Lock()
+
+    @contextlib.contextmanager
+    def section(self, key: str = ""):
+        if self._serialize_all:
+            with self._global:
+                yield
+            return
+        with self._keyed_guard:
+            lock = self._keyed.setdefault(key, threading.Lock())
+        with lock:
+            yield
