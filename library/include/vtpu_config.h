@@ -1,0 +1,146 @@
+/* vtpu_config.h — C++ side of the L3 binary ABI.
+ *
+ * Mirror of vtpu_manager/config/vtpu_config.py (the Python writer) and
+ * tc_watcher.py / vmem.py. The reference keeps the same contract between Go
+ * and C (reference: pkg/config/vgpu/vgpu_config.go:19-57 <-> hook.h:198-226)
+ * and asserts it with layout tests; tests/test_config_abi.py compiles this
+ * header and compares offsets with the Python structs.
+ *
+ * Layout rules: little-endian, explicit padding, 8-byte alignment,
+ * NUL-terminated fixed strings, FNV-1a footer checksum.
+ */
+#ifndef VTPU_CONFIG_H_
+#define VTPU_CONFIG_H_
+
+#include <cstddef>
+#include <cstdint>
+
+namespace vtpu {
+
+constexpr uint32_t kConfigMagic = 0x55505456;  // "VTPU"
+constexpr uint32_t kConfigVersion = 1;
+constexpr int kMaxDeviceCount = 64;
+constexpr int kUuidLen = 64;
+constexpr int kNameLen = 64;
+constexpr int kPodUidLen = 48;
+
+enum CoreLimit : int32_t {
+  kCoreLimitNone = 0,
+  kCoreLimitHard = 1,  // fixed policy: clamp at hard_core
+  kCoreLimitSoft = 2,  // balance policy: elastic hard_core..soft_core
+};
+
+// Compatibility-mode bitmask (reference hook.h:386-392).
+enum CompatMode : int32_t {
+  kCompatHost = 0x01,
+  kCompatCgroup = 0x02,
+  kCompatClient = 0x04,
+  kCompatOpenKernel = 0x08,
+};
+
+struct VtpuDevice {
+  char uuid[kUuidLen];
+  uint64_t total_memory;    // HBM cap bytes (inflated when oversold)
+  uint64_t real_memory;     // physical HBM bytes
+  int32_t hard_core;        // percent
+  int32_t soft_core;        // percent (balance ceiling)
+  int32_t core_limit;       // CoreLimit
+  int32_t memory_limit;     // bool
+  int32_t memory_oversold;  // bool
+  int32_t host_index;
+  int32_t mesh_x;
+  int32_t mesh_y;
+  int32_t mesh_z;
+  int32_t pad_;
+};
+static_assert(sizeof(VtpuDevice) == 120, "VtpuDevice ABI size");
+static_assert(offsetof(VtpuDevice, total_memory) == 64, "ABI");
+static_assert(offsetof(VtpuDevice, hard_core) == 80, "ABI");
+static_assert(offsetof(VtpuDevice, mesh_x) == 104, "ABI");
+
+struct VtpuConfig {
+  uint32_t magic;
+  uint32_t version;
+  char pod_uid[kPodUidLen];
+  char pod_name[kNameLen];
+  char pod_namespace[kNameLen];
+  char container_name[kNameLen];
+  int32_t device_count;
+  int32_t compat_mode;
+  VtpuDevice devices[kMaxDeviceCount];
+  uint32_t checksum;  // FNV-1a over all preceding bytes
+  uint32_t pad_;
+};
+static_assert(offsetof(VtpuConfig, device_count) == 248, "ABI");
+static_assert(offsetof(VtpuConfig, devices) == 256, "ABI");
+static_assert(sizeof(VtpuConfig) == 256 + 64 * 120 + 8, "VtpuConfig ABI");
+
+inline uint32_t Fnv1a(const uint8_t* data, size_t len) {
+  uint32_t h = 0x811C9DC5u;
+  for (size_t i = 0; i < len; ++i) {
+    h ^= data[i];
+    h *= 0x01000193u;
+  }
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// tc_util.config (node watcher feed; seqlock per record)
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kTcUtilMagic = 0x55544356;  // "VCTU"
+constexpr int kMaxProcs = 32;
+
+struct TcProcUtil {
+  int32_t pid;
+  int32_t util;       // percent of the chip
+  uint64_t mem_used;  // bytes
+};
+static_assert(sizeof(TcProcUtil) == 16, "ABI");
+
+struct TcDeviceRecord {
+  uint64_t seq;           // seqlock: odd while writing
+  uint64_t timestamp_ns;  // writer CLOCK_MONOTONIC
+  int32_t device_util;    // chip duty-cycle percent
+  int32_t proc_count;
+  TcProcUtil procs[kMaxProcs];
+};
+static_assert(sizeof(TcDeviceRecord) == 24 + 512, "ABI");
+
+struct TcUtilFile {
+  uint32_t magic;
+  uint32_t version;
+  int32_t device_count;
+  int32_t pad_;
+  TcDeviceRecord records[kMaxDeviceCount];
+};
+static_assert(offsetof(TcUtilFile, records) == 16, "ABI");
+static_assert(sizeof(TcUtilFile) == 16 + 64 * (24 + 512), "ABI");
+
+// ---------------------------------------------------------------------------
+// vmem_node.config (cross-process memory ledger)
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kVmemMagic = 0x4D454D56;  // "VMEM"
+constexpr int kVmemMaxEntries = 1024;
+
+struct VmemEntry {
+  int32_t pid;  // 0 = free slot
+  int32_t host_index;
+  uint64_t bytes;
+  uint64_t last_update_ns;
+};
+static_assert(sizeof(VmemEntry) == 24, "ABI");
+
+struct VmemFile {
+  uint32_t magic;
+  uint32_t version;
+  int32_t max_entries;
+  int32_t pad_;
+  VmemEntry entries[kVmemMaxEntries];
+};
+static_assert(sizeof(VmemFile) == 16 + 1024 * 24, "ABI");
+
+}  // namespace vtpu
+
+#endif  // VTPU_CONFIG_H_

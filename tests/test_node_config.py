@@ -1,0 +1,90 @@
+"""Node config resolution + device-ID store."""
+
+import pytest
+
+from vtpu_manager.config.node_config import (DeviceIDStore, NodeConfig,
+                                             load_node_config)
+
+SAMPLE = """
+default:
+  deviceSplitCount: 8
+  coreScaling: 1.0
+  compatMode: host
+nodes:
+  - name: "tpu-node-1"
+    deviceSplitCount: 4
+    excludeDevices: ["0"]
+  - name: "tpu-pool-*"
+    memoryScaling: 2.0
+    memoryOverused: true
+"""
+
+
+class TestNodeConfig:
+    def test_defaults(self):
+        cfg = load_node_config(None, "anything")
+        assert cfg.device_split_count == 10
+
+    def test_default_section(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(SAMPLE)
+        cfg = load_node_config(str(p), "other-node")
+        assert cfg.device_split_count == 8
+        assert cfg.memory_scaling == 1.0
+
+    def test_exact_override(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(SAMPLE)
+        cfg = load_node_config(str(p), "tpu-node-1")
+        assert cfg.device_split_count == 4
+        assert cfg.excludes("whatever-uuid", 0)
+        assert not cfg.excludes("whatever-uuid", 1)
+
+    def test_layered_merge_glob_then_exact(self, tmp_path):
+        # exact-name node also matched by a glob: glob applies first,
+        # exact keys win on conflict (documented layered merge)
+        p = tmp_path / "cfg.yaml"
+        p.write_text("""
+default: {deviceSplitCount: 8}
+nodes:
+  - name: "tpu-pool-*"
+    deviceSplitCount: 2
+    memoryScaling: 2.0
+  - name: "tpu-pool-9"
+    deviceSplitCount: 4
+""")
+        cfg = load_node_config(str(p), "tpu-pool-9")
+        assert cfg.device_split_count == 4     # exact wins
+        assert cfg.memory_scaling == 2.0       # inherited from glob layer
+
+    def test_glob_override(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(SAMPLE)
+        cfg = load_node_config(str(p), "tpu-pool-west-3")
+        assert cfg.memory_scaling == 2.0
+        assert cfg.memory_overused
+        assert cfg.device_split_count == 8  # from default
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NodeConfig(device_split_count=0).validate()
+        with pytest.raises(ValueError):
+            NodeConfig(compat_mode="bogus").validate()
+
+
+class TestDeviceIDStore:
+    def test_synthetic_ids_stable(self, tmp_path):
+        path = str(tmp_path / "ids.json")
+        store = DeviceIDStore(path)
+        first = store.uuid_for("n1", 0)
+        assert first == "n1-chip-0"
+        # reload: same id
+        store2 = DeviceIDStore(path)
+        assert store2.uuid_for("n1", 0) == first
+
+    def test_hw_serial_wins(self, tmp_path):
+        path = str(tmp_path / "ids.json")
+        store = DeviceIDStore(path)
+        store.uuid_for("n1", 0)
+        assert store.uuid_for("n1", 0, hw_serial="SER123") == "SER123"
+        assert DeviceIDStore(path).uuid_for("n1", 0) == "SER123"

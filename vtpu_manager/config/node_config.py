@@ -1,0 +1,121 @@
+"""Node-level configuration: how this node's chips are split and scaled.
+
+Reference: pkg/config/node/node_config.go:1-516 (+ docs/
+how_to_use_deviceplugin_nodeconfig.md) — a config file with a default
+section and per-node overrides (matched by name or glob), controlling split
+count, core/memory scaling, device exclusions; plus a persistent device-ID
+store (node/id_store.go) so synthetic uuids survive restarts.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+from dataclasses import dataclass, field
+
+import yaml
+
+
+@dataclass
+class NodeConfig:
+    """Effective config for one node."""
+
+    device_split_count: int = 10        # vTPU slots per chip
+    core_scaling: float = 1.0           # advertised cores multiplier
+    memory_scaling: float = 1.0         # advertised HBM multiplier (oversub)
+    memory_overused: bool = False       # allow oversold memory claims
+    exclude_devices: tuple[str, ...] = ()   # uuids or host indices ("0","2")
+    compat_mode: str = "host"           # host|cgroup|client|open-kernel
+
+    def excludes(self, uuid: str, index: int) -> bool:
+        return uuid in self.exclude_devices or \
+            str(index) in self.exclude_devices
+
+    def validate(self) -> None:
+        if self.device_split_count < 1:
+            raise ValueError("deviceSplitCount must be >= 1")
+        if not 0 < self.core_scaling <= 16:
+            raise ValueError("coreScaling out of range (0, 16]")
+        if not 0 < self.memory_scaling <= 16:
+            raise ValueError("memoryScaling out of range (0, 16]")
+        if self.compat_mode not in ("host", "cgroup", "client",
+                                    "open-kernel"):
+            raise ValueError(f"unknown compatMode {self.compat_mode!r}")
+
+
+_FIELDS = {
+    "deviceSplitCount": "device_split_count",
+    "coreScaling": "core_scaling",
+    "memoryScaling": "memory_scaling",
+    "memoryOverused": "memory_overused",
+    "excludeDevices": "exclude_devices",
+    "compatMode": "compat_mode",
+}
+
+
+def _apply(cfg: NodeConfig, section: dict) -> None:
+    for yaml_key, attr in _FIELDS.items():
+        if yaml_key in section:
+            value = section[yaml_key]
+            if attr == "exclude_devices":
+                value = tuple(str(v) for v in value)
+            setattr(cfg, attr, value)
+
+
+def load_node_config(path: str | None, node_name: str) -> NodeConfig:
+    """Resolve the effective config as a layered merge: built-in defaults
+    <- file ``default`` section <- every matching glob override in file
+    order <- the exact-name override last. Later layers only override the
+    keys they set."""
+    cfg = NodeConfig()
+    if not path:
+        return cfg
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    _apply(cfg, doc.get("default") or {})
+    overrides = doc.get("nodes") or []
+    exact = [o for o in overrides if o.get("name") == node_name]
+    globbed = [o for o in overrides
+               if o.get("name") != node_name
+               and fnmatch.fnmatch(node_name, o.get("name", ""))]
+    for section in globbed + exact[:1]:   # exact wins, applied last
+        _apply(cfg, section)
+    cfg.validate()
+    return cfg
+
+
+class DeviceIDStore:
+    """Persistent chip-uuid store so synthetic ids survive restarts
+    (reference: pkg/config/node/id_store.go). Chips discovered without a
+    hardware serial get `<node>-chip-<i>` ids recorded here."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._ids: dict[str, str] = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._ids = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                self._ids = {}
+
+    def uuid_for(self, node_name: str, index: int,
+                 hw_serial: str | None = None) -> str:
+        key = str(index)
+        if hw_serial:
+            if self._ids.get(key) != hw_serial:
+                self._ids[key] = hw_serial
+                self._save()
+            return hw_serial
+        if key not in self._ids:
+            self._ids[key] = f"{node_name}-chip-{index}"
+            self._save()
+        return self._ids[key]
+
+    def _save(self) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._ids, f)
+        os.replace(tmp, self.path)

@@ -1,0 +1,177 @@
+"""``tc_util.config`` mmap ABI: node-level TensorCore utilization feed.
+
+Reference: pkg/config/watcher/sm_watcher.go:15-40 ↔ hook.h:291-304 — the
+node daemon samples per-device, per-process SM utilization every ~80 ms into
+a shared mmap; in-container shims read it instead of hammering NVML
+(reference cuda_hook.c:2206-2241, 5 s freshness window).
+
+TPU redesign: libtpu metrics are chip-level (duty cycle), not per-process —
+the per-process slots are filled from the pid ledger + per-process execute
+accounting reported via the registry (SURVEY.md §7 hard part (c)).
+
+Concurrency: each device record is protected by a **seqlock** (writer bumps
+``seq`` to odd, writes, bumps to even; readers retry on odd/changed seq).
+Readers are lock-free — the shim's watcher thread polls at 100 ms and must
+never block on a daemon held lock. Writer exclusion across daemon restarts
+uses one OFD byte-range lock per record (vtpu_manager.util.flock).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+from dataclasses import dataclass, field
+
+from vtpu_manager.util import consts
+from vtpu_manager.util.flock import byte_range_write_lock
+
+MAGIC = 0x55544356            # "VCTU"
+VERSION = 1
+MAX_DEVICE_COUNT = 64
+MAX_PROCS = 32
+
+# header: magic u32, version u32, device_count i32, pad i32
+_HEADER_FMT = "<IIii"
+HEADER_SIZE = struct.calcsize(_HEADER_FMT)
+assert HEADER_SIZE == 16
+
+# proc entry: pid i32, util i32 (percent), mem_used u64
+_PROC_FMT = "<iiQ"
+PROC_SIZE = struct.calcsize(_PROC_FMT)
+assert PROC_SIZE == 16
+
+# device record: seq u64, timestamp_ns u64, device_util i32, proc_count i32,
+# procs[32]
+_RECORD_HEAD_FMT = "<QQii"
+RECORD_SIZE = struct.calcsize(_RECORD_HEAD_FMT) + MAX_PROCS * PROC_SIZE
+assert RECORD_SIZE == 24 + 512
+
+FILE_SIZE = HEADER_SIZE + MAX_DEVICE_COUNT * RECORD_SIZE
+
+
+@dataclass
+class ProcUtil:
+    pid: int
+    util: int            # percent of the chip this process consumed
+    mem_used: int        # bytes
+
+
+@dataclass
+class DeviceUtil:
+    timestamp_ns: int
+    device_util: int     # chip duty-cycle percent
+    procs: list[ProcUtil] = field(default_factory=list)
+
+    def is_fresh(self, window_s: float = consts.EXTERNAL_WATCHER_FRESH_S,
+                 now_ns: int | None = None) -> bool:
+        """Negative deltas are stale too: the file persists across reboots
+        while CLOCK_MONOTONIC restarts, so a pre-reboot timestamp must not
+        read as fresh (daemons also reset=True at startup)."""
+        now_ns = time.monotonic_ns() if now_ns is None else now_ns
+        return 0 <= (now_ns - self.timestamp_ns) <= window_s * 1e9
+
+
+def record_offset(index: int) -> int:
+    return HEADER_SIZE + index * RECORD_SIZE
+
+
+class TcUtilFile:
+    """Writer/reader over the shared mmap file."""
+
+    def __init__(self, path: str = consts.TC_UTIL_CONFIG,
+                 device_count: int = MAX_DEVICE_COUNT, create: bool = False,
+                 reset: bool = False):
+        """create: build the file if missing/wrong-sized (atomic rename —
+        never truncate in place: concurrent mappers would SIGBUS).
+        reset: zero all records (daemon startup, invalidating pre-reboot
+        timestamps)."""
+        self.path = path
+        if create:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            from vtpu_manager.util.flock import FileLock
+            with FileLock(path + ".create.lock"):
+                if (not os.path.exists(path)
+                        or os.path.getsize(path) != FILE_SIZE):
+                    tmp = f"{path}.tmp.{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(struct.pack(_HEADER_FMT, MAGIC, VERSION,
+                                            device_count, 0))
+                        f.write(b"\0" * (FILE_SIZE - HEADER_SIZE))
+                    os.rename(tmp, path)
+        self._fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(self._fd, FILE_SIZE)
+        except (ValueError, OSError):
+            os.close(self._fd)
+            self._fd = None
+            raise
+        magic, version, self.device_count, _ = struct.unpack_from(
+            _HEADER_FMT, self._mm, 0)
+        if magic != MAGIC or version != VERSION:
+            self.close()
+            raise ValueError(f"bad tc_util file {path}")
+        if reset:
+            empty = DeviceUtil(timestamp_ns=0, device_util=0)
+            for i in range(MAX_DEVICE_COUNT):
+                self.write_device(i, empty)
+
+    def close(self) -> None:
+        if getattr(self, "_mm", None) is not None:
+            self._mm.close()
+            self._mm = None
+        if getattr(self, "_fd", None) is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    # -- writer (node daemon) ----------------------------------------------
+
+    def write_device(self, index: int, util: DeviceUtil) -> None:
+        if not 0 <= index < MAX_DEVICE_COUNT:
+            raise IndexError(index)
+        procs = util.procs[:MAX_PROCS]
+        off = record_offset(index)
+        with byte_range_write_lock(self._fd, off, RECORD_SIZE):
+            seq, = struct.unpack_from("<Q", self._mm, off)
+            # Force odd during the write even if a crashed writer left seq
+            # odd — naive seq+1 would invert parity and let torn reads
+            # validate.
+            wseq = seq | 1
+            struct.pack_into("<Q", self._mm, off, wseq)      # odd: writing
+            struct.pack_into(_RECORD_HEAD_FMT, self._mm, off, wseq,
+                             util.timestamp_ns, util.device_util, len(procs))
+            poff = off + struct.calcsize(_RECORD_HEAD_FMT)
+            for i, p in enumerate(procs):
+                struct.pack_into(_PROC_FMT, self._mm, poff + i * PROC_SIZE,
+                                 p.pid, p.util, p.mem_used)
+            struct.pack_into("<Q", self._mm, off, wseq + 1)  # even: stable
+
+    # -- reader (shim / metrics) -------------------------------------------
+
+    def read_device(self, index: int, retries: int = 8) -> DeviceUtil | None:
+        """Lock-free seqlock read; None if the record is mid-write for all
+        retries (caller falls back to local sampling, reference
+        cuda_hook.c:2215-2239)."""
+        if not 0 <= index < MAX_DEVICE_COUNT:
+            raise IndexError(index)
+        off = record_offset(index)
+        for _ in range(retries):
+            seq1, = struct.unpack_from("<Q", self._mm, off)
+            if seq1 & 1:
+                time.sleep(0.0002)
+                continue
+            _, ts, dev_util, count = struct.unpack_from(
+                _RECORD_HEAD_FMT, self._mm, off)
+            count = max(0, min(count, MAX_PROCS))
+            procs = []
+            poff = off + struct.calcsize(_RECORD_HEAD_FMT)
+            for i in range(count):
+                pid, putil, mem = struct.unpack_from(
+                    _PROC_FMT, self._mm, poff + i * PROC_SIZE)
+                procs.append(ProcUtil(pid, putil, mem))
+            seq2, = struct.unpack_from("<Q", self._mm, off)
+            if seq1 == seq2:
+                return DeviceUtil(timestamp_ns=ts, device_util=dev_util,
+                                  procs=procs)
+        return None

@@ -1,0 +1,175 @@
+"""Cross-process virtual-memory ledger (``vmem_node.config``).
+
+Reference: device_vmemory_t (hook.h:345-358), mmap at
+/tmp/.vmem_node/vmem_node.config (loader.c:1563-1615), with dead-pid cleanup
+(loader.c:1825-1978). Multiple processes sharing a chip each record their
+HBM bytes here so the alloc-path cap check can see usage the TPU runtime's
+chip-level stats cannot attribute per process.
+
+Fixed-slot hash table keyed by (pid, host_index); slot claims/updates happen
+under one file-wide OFD lock (allocation is already serialized per device by
+the device lock, so this lock is uncontended in the hot path). Dead pids are
+reaped by any writer that finds the table full and by the node daemon.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+from dataclasses import dataclass
+
+from vtpu_manager.util import consts
+from vtpu_manager.util.flock import FileLock
+
+MAGIC = 0x4D454D56          # "VMEM"
+VERSION = 1
+MAX_ENTRIES = 1024
+
+_HEADER_FMT = "<IIii"       # magic, version, max_entries, pad
+HEADER_SIZE = struct.calcsize(_HEADER_FMT)
+
+# entry: pid i32, host_index i32, bytes u64, last_update_ns u64
+_ENTRY_FMT = "<iiQQ"
+ENTRY_SIZE = struct.calcsize(_ENTRY_FMT)
+assert ENTRY_SIZE == 24
+
+FILE_SIZE = HEADER_SIZE + MAX_ENTRIES * ENTRY_SIZE
+
+
+@dataclass
+class VmemEntry:
+    pid: int
+    host_index: int
+    bytes: int
+    last_update_ns: int
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+class VmemLedger:
+    def __init__(self, path: str = consts.VMEM_NODE_CONFIG,
+                 create: bool = False):
+        self.path = path
+        self._lock = FileLock(path + ".lock")
+        if create:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with FileLock(path + ".create.lock"):
+                if (not os.path.exists(path)
+                        or os.path.getsize(path) != FILE_SIZE):
+                    tmp = f"{path}.tmp.{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(struct.pack(_HEADER_FMT, MAGIC, VERSION,
+                                            MAX_ENTRIES, 0))
+                        f.write(b"\0" * (FILE_SIZE - HEADER_SIZE))
+                    os.rename(tmp, path)
+        self._fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(self._fd, FILE_SIZE)
+        except (ValueError, OSError):
+            os.close(self._fd)
+            self._fd = None
+            raise
+        magic, version, _, _ = struct.unpack_from(_HEADER_FMT, self._mm, 0)
+        if magic != MAGIC or version != VERSION:
+            self.close()
+            raise ValueError(f"bad vmem ledger {path}")
+
+    def close(self) -> None:
+        if getattr(self, "_mm", None) is not None:
+            self._mm.close()
+            self._mm = None
+        if getattr(self, "_fd", None) is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def _entry(self, i: int) -> VmemEntry:
+        pid, hidx, nbytes, ts = struct.unpack_from(
+            _ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE)
+        return VmemEntry(pid, hidx, nbytes, ts)
+
+    def _write_entry(self, i: int, e: VmemEntry) -> None:
+        struct.pack_into(_ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE,
+                         e.pid, e.host_index, e.bytes, e.last_update_ns)
+
+    # -- API ----------------------------------------------------------------
+
+    def record(self, pid: int, host_index: int, nbytes: int) -> None:
+        """Set this pid's usage on a device (0 clears the slot)."""
+        now = time.monotonic_ns()
+        with self._lock:
+            free_slot = None
+            for i in range(MAX_ENTRIES):
+                e = self._entry(i)
+                if e.pid == pid and e.host_index == host_index:
+                    if nbytes == 0:
+                        self._write_entry(i, VmemEntry(0, 0, 0, 0))
+                    else:
+                        self._write_entry(
+                            i, VmemEntry(pid, host_index, nbytes, now))
+                    return
+                if e.pid == 0 and free_slot is None:
+                    free_slot = i
+            if nbytes == 0:
+                return
+            if free_slot is None:
+                self._reap_locked()
+                for i in range(MAX_ENTRIES):
+                    if self._entry(i).pid == 0:
+                        free_slot = i
+                        break
+            if free_slot is None:
+                raise RuntimeError("vmem ledger full")
+            self._write_entry(free_slot,
+                              VmemEntry(pid, host_index, nbytes, now))
+
+    def device_total(self, host_index: int,
+                     exclude_pid: int | None = None) -> int:
+        """Total live bytes recorded for a device (dead pids skipped)."""
+        total = 0
+        with self._lock:
+            for i in range(MAX_ENTRIES):
+                e = self._entry(i)
+                if e.pid == 0 or e.host_index != host_index:
+                    continue
+                if exclude_pid is not None and e.pid == exclude_pid:
+                    continue
+                if not _pid_alive(e.pid):
+                    self._write_entry(i, VmemEntry(0, 0, 0, 0))
+                    continue
+                total += e.bytes
+        return total
+
+    def entries(self) -> list[VmemEntry]:
+        with self._lock:
+            return [e for i in range(MAX_ENTRIES)
+                    if (e := self._entry(i)).pid != 0]
+
+    def reap_dead(self) -> int:
+        with self._lock:
+            return self._reap_locked()
+
+    def _reap_locked(self) -> int:
+        reaped = 0
+        for i in range(MAX_ENTRIES):
+            e = self._entry(i)
+            if e.pid != 0 and not _pid_alive(e.pid):
+                self._write_entry(i, VmemEntry(0, 0, 0, 0))
+                reaped += 1
+        return reaped
+
+    def clear_pid(self, pid: int) -> None:
+        """atexit/signal-path cleanup (reference loader.c:2527-2543)."""
+        with self._lock:
+            for i in range(MAX_ENTRIES):
+                if self._entry(i).pid == pid:
+                    self._write_entry(i, VmemEntry(0, 0, 0, 0))

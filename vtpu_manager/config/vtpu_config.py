@@ -1,0 +1,188 @@
+"""Binary ``vtpu.config`` ABI: the Go↔C contract, re-done Python↔C++.
+
+Reference: pkg/config/vgpu/vgpu_config.go:19-57 mirrors library/include/
+hook.h:198-226 byte-for-byte (resource_data_t / device_t), asserted by
+vgpu_config_test.go. Here the Python writer and the C++ reader
+(library/include/vtpu_config.h) share this layout; tests/test_config_abi.py
+compiles a C++ probe and asserts identical sizes/offsets, which is the
+cross-language contract test.
+
+Layout rules: little-endian, explicitly padded, 8-byte aligned, fixed-size
+NUL-terminated strings. An FNV-1a checksum over all preceding bytes lets the
+C++ side reject torn/partial writes (files are written atomically via
+rename, but a crashed writer must never produce a silently-valid config).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+MAGIC = 0x55505456          # "VTPU" little-endian
+VERSION = 1
+MAX_DEVICE_COUNT = 64
+UUID_LEN = 64
+NAME_LEN = 64
+POD_UID_LEN = 48
+
+# Core-limit enum (device_t.core_limit analogue; reference hook.h:198-209
+# splits this into hard_limit/core_limit flags — one enum is cleaner)
+CORE_LIMIT_NONE = 0
+CORE_LIMIT_HARD = 1      # fixed policy: clamp at hard_core
+CORE_LIMIT_SOFT = 2      # balance policy: elastic hard_core..soft_core
+
+# vtpu_device_t: uuid[64], total_memory u64, real_memory u64,
+# hard_core i32, soft_core i32, core_limit i32, memory_limit i32,
+# memory_oversold i32, host_index i32, mesh_x/y/z i32, pad i32
+_DEVICE_FMT = "<64sQQ10i"
+DEVICE_SIZE = struct.calcsize(_DEVICE_FMT)
+assert DEVICE_SIZE == 120
+
+# vtpu_config_t header: magic u32, version u32, pod_uid[48], pod_name[64],
+# pod_namespace[64], container_name[64], device_count i32, compat_mode i32
+_HEADER_FMT = "<II48s64s64s64sii"
+HEADER_SIZE = struct.calcsize(_HEADER_FMT)
+assert HEADER_SIZE == 256
+
+_FOOTER_FMT = "<II"        # checksum u32, pad u32
+CONFIG_SIZE = HEADER_SIZE + MAX_DEVICE_COUNT * DEVICE_SIZE + \
+    struct.calcsize(_FOOTER_FMT)
+
+
+def _fnv1a(data: bytes) -> int:
+    h = 0x811C9DC5
+    for b in data:
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def _cstr(s: str, size: int) -> bytes:
+    raw = s.encode()[: size - 1]
+    return raw + b"\0" * (size - len(raw))
+
+
+def _from_cstr(raw: bytes) -> str:
+    return raw.split(b"\0", 1)[0].decode(errors="replace")
+
+
+@dataclass
+class DeviceConfig:
+    """Per-chip enforcement parameters handed to the shim."""
+
+    uuid: str
+    total_memory: int          # HBM cap in bytes (inflated when oversold)
+    real_memory: int           # physical HBM bytes
+    hard_core: int = 100       # percent
+    soft_core: int = 100       # percent (balance ceiling)
+    core_limit: int = CORE_LIMIT_NONE
+    memory_limit: bool = True
+    memory_oversold: bool = False
+    host_index: int = 0
+    mesh: tuple[int, int, int] = (0, 0, 0)
+
+    def pack(self) -> bytes:
+        return struct.pack(
+            _DEVICE_FMT, _cstr(self.uuid, UUID_LEN), self.total_memory,
+            self.real_memory, self.hard_core, self.soft_core,
+            self.core_limit, 1 if self.memory_limit else 0,
+            1 if self.memory_oversold else 0, self.host_index,
+            self.mesh[0], self.mesh[1], self.mesh[2], 0)
+
+    @staticmethod
+    def unpack(raw: bytes) -> "DeviceConfig":
+        (uuid, total, real, hard, soft, climit, mlimit, oversold, hidx,
+         mx, my, mz, _pad) = struct.unpack(_DEVICE_FMT, raw)
+        return DeviceConfig(uuid=_from_cstr(uuid), total_memory=total,
+                            real_memory=real, hard_core=hard, soft_core=soft,
+                            core_limit=climit, memory_limit=bool(mlimit),
+                            memory_oversold=bool(oversold), host_index=hidx,
+                            mesh=(mx, my, mz))
+
+
+@dataclass
+class VtpuConfig:
+    """The whole per-container config file."""
+
+    pod_uid: str = ""
+    pod_name: str = ""
+    pod_namespace: str = ""
+    container_name: str = ""
+    compat_mode: int = 0
+    devices: list[DeviceConfig] = field(default_factory=list)
+
+    def pack(self) -> bytes:
+        if len(self.devices) > MAX_DEVICE_COUNT:
+            raise ValueError(
+                f"{len(self.devices)} devices > {MAX_DEVICE_COUNT}")
+        body = struct.pack(
+            _HEADER_FMT, MAGIC, VERSION, _cstr(self.pod_uid, POD_UID_LEN),
+            _cstr(self.pod_name, NAME_LEN),
+            _cstr(self.pod_namespace, NAME_LEN),
+            _cstr(self.container_name, NAME_LEN),
+            len(self.devices), self.compat_mode)
+        for dev in self.devices:
+            body += dev.pack()
+        body += b"\0" * (DEVICE_SIZE * (MAX_DEVICE_COUNT - len(self.devices)))
+        body += struct.pack(_FOOTER_FMT, _fnv1a(body), 0)
+        assert len(body) == CONFIG_SIZE
+        return body
+
+    @staticmethod
+    def unpack(raw: bytes) -> "VtpuConfig":
+        if len(raw) != CONFIG_SIZE:
+            raise ValueError(f"config size {len(raw)} != {CONFIG_SIZE}")
+        checksum, _ = struct.unpack_from(_FOOTER_FMT,
+                                         raw, CONFIG_SIZE - 8)
+        if _fnv1a(raw[: CONFIG_SIZE - 8]) != checksum:
+            raise ValueError("config checksum mismatch (torn write?)")
+        (magic, version, pod_uid, pod_name, pod_ns, cont_name, count,
+         compat) = struct.unpack_from(_HEADER_FMT, raw, 0)
+        if magic != MAGIC:
+            raise ValueError(f"bad magic {magic:#x}")
+        if version != VERSION:
+            raise ValueError(f"unsupported config version {version}")
+        if not 0 <= count <= MAX_DEVICE_COUNT:
+            raise ValueError(f"bad device count {count}")
+        cfg = VtpuConfig(pod_uid=_from_cstr(pod_uid),
+                         pod_name=_from_cstr(pod_name),
+                         pod_namespace=_from_cstr(pod_ns),
+                         container_name=_from_cstr(cont_name),
+                         compat_mode=compat)
+        for i in range(count):
+            off = HEADER_SIZE + i * DEVICE_SIZE
+            cfg.devices.append(
+                DeviceConfig.unpack(raw[off: off + DEVICE_SIZE]))
+        return cfg
+
+
+def write_config(path: str, cfg: VtpuConfig) -> None:
+    """Atomic write: tmp file + rename (the C++ reader mmaps the final path;
+    rename guarantees it never observes a partial file)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(cfg.pack())
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+
+
+def read_config(path: str) -> VtpuConfig:
+    with open(path, "rb") as f:
+        return VtpuConfig.unpack(f.read())
+
+
+# Layout table consumed by the ABI contract test (field -> offset).
+DEVICE_OFFSETS = {
+    "uuid": 0, "total_memory": 64, "real_memory": 72, "hard_core": 80,
+    "soft_core": 84, "core_limit": 88, "memory_limit": 92,
+    "memory_oversold": 96, "host_index": 100, "mesh_x": 104, "mesh_y": 108,
+    "mesh_z": 112,
+}
+HEADER_OFFSETS = {
+    "magic": 0, "version": 4, "pod_uid": 8, "pod_name": 56,
+    "pod_namespace": 120, "container_name": 184, "device_count": 248,
+    "compat_mode": 252,
+}
