@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/fake-pjrt.dir/test/fake_pjrt_plugin.cc.o"
+  "CMakeFiles/fake-pjrt.dir/test/fake_pjrt_plugin.cc.o.d"
+  "libfake-pjrt.pdb"
+  "libfake-pjrt.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/fake-pjrt.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/shim_test.dir/test/shim_test.cc.o"
+  "CMakeFiles/shim_test.dir/test/shim_test.cc.o.d"
+  "shim_test"
+  "shim_test.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/shim_test.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,21 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/library/src/enforce.cc" "CMakeFiles/vtpu-control.dir/src/enforce.cc.o" "gcc" "CMakeFiles/vtpu-control.dir/src/enforce.cc.o.d"
+  "/root/repo/library/src/error.cc" "CMakeFiles/vtpu-control.dir/src/error.cc.o" "gcc" "CMakeFiles/vtpu-control.dir/src/error.cc.o.d"
+  "/root/repo/library/src/loader.cc" "CMakeFiles/vtpu-control.dir/src/loader.cc.o" "gcc" "CMakeFiles/vtpu-control.dir/src/loader.cc.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

@@ -1,0 +1,15 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/vtpu-control.dir/src/enforce.cc.o"
+  "CMakeFiles/vtpu-control.dir/src/enforce.cc.o.d"
+  "CMakeFiles/vtpu-control.dir/src/error.cc.o"
+  "CMakeFiles/vtpu-control.dir/src/error.cc.o.d"
+  "CMakeFiles/vtpu-control.dir/src/loader.cc.o"
+  "CMakeFiles/vtpu-control.dir/src/loader.cc.o.d"
+  "libvtpu-control.pdb"
+  "libvtpu-control.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/vtpu-control.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,148 @@
+/* shim.h — internal state of libvtpu-control.so, the PJRT interceptor.
+ *
+ * TPU-native re-design of the reference's LD_PRELOAD CUDA/NVML hook library
+ * (reference: library/include/hook.h, library/src/loader.c, cuda_hook.c).
+ * Where CUDA needs dlsym shadowing + cuGetProcAddress route tables
+ * (loader.c:1780, cuda_hook.c:2408), PJRT gives one sanctioned seam: the
+ * plugin's exported GetPjrtApi() returns a table of function pointers. The
+ * shim exports GetPjrtApi, dlopens the real libtpu, copies its table, and
+ * substitutes wrappers for the entries that matter:
+ *
+ *   - PJRT_Client_BufferFromHostBuffer / PJRT_Buffer_Destroy /
+ *     PJRT_LoadedExecutable_Execute outputs -> HBM accounting + cap OOM
+ *   - PJRT_Device_MemoryStats                -> capped view faking
+ *   - PJRT_LoadedExecutable_Execute          -> TensorCore-% throttling
+ *   - PJRT_Error_Destroy/Message/GetCode     -> sentinel errors (the shim
+ *     must mint OOM errors the caller frees via the same API)
+ *
+ * Enforcement parameters come from the mmap'd vtpu.config written by the
+ * device plugin, or are synthesized from env vars when the file is absent
+ * (reference: load_controller_configuration loader.c:2483,
+ * init_g_vgpu_config_by_env loader.c:2357).
+ */
+#ifndef VTPU_SHIM_H_
+#define VTPU_SHIM_H_
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+
+#include "vtpu_config.h"
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace vtpu {
+
+// ---------------------------------------------------------------------------
+// Logging (reference hook.h:443-454: leveled stderr logger, LOGGER_LEVEL)
+// ---------------------------------------------------------------------------
+
+enum LogLevel { kLogError = 0, kLogWarn = 1, kLogInfo = 2, kLogDebug = 3 };
+extern int g_log_level;
+void LogF(LogLevel level, const char* fmt, ...);
+#define VTPU_LOG(level, ...)                         \
+  do {                                               \
+    if ((level) <= ::vtpu::g_log_level) ::vtpu::LogF(level, __VA_ARGS__); \
+  } while (0)
+
+// ---------------------------------------------------------------------------
+// Sampled metrics counters (reference metrics.c: power-of-two sampling)
+// ---------------------------------------------------------------------------
+
+struct Counter {
+  const char* name;
+  std::atomic<uint64_t> count{0};
+  void Bump();  // logs at powers of two
+};
+
+struct Metrics {
+  Counter oom_rejected{"oom_rejected"};
+  Counter mem_charged{"mem_charged"};
+  Counter throttle_waits{"throttle_waits"};
+  Counter gap_throttles{"gap_throttles"};
+  Counter watcher_ticks{"watcher_ticks"};
+  Counter watcher_external{"watcher_external"};
+  Counter watcher_fallback{"watcher_self_estimate"};
+  Counter execs{"execs"};
+  Counter exec_done{"exec_done"};
+  Counter aimd_md_events{"aimd_md_events"};
+};
+extern Metrics g_metrics;
+
+// ---------------------------------------------------------------------------
+// Per-device enforcement state
+// ---------------------------------------------------------------------------
+
+// Cacheline-isolated hot state (reference dev_hot_t, cuda_hook.c:106-119).
+struct alignas(128) DeviceHot {
+  std::atomic<int64_t> used_bytes{0};      // this process's HBM on the chip
+  std::atomic<int64_t> peak_bytes{0};
+  std::atomic<int64_t> tokens_us{0};       // busy-microsecond budget
+  std::atomic<int64_t> grant_us{0};        // current per-window grant
+  std::atomic<uint64_t> last_submit_ns{0};
+  std::atomic<uint64_t> busy_ns_window{0};   // self-measured busy time
+  std::atomic<int64_t> precharged_us{0};     // submit-time token deductions
+  std::atomic<int64_t> inflight{0};
+  std::atomic<int> up_limit{0};            // balance mode elastic target (%)
+  std::atomic<bool> throttled_since_watch{false};
+};
+static_assert(sizeof(DeviceHot) % 128 == 0, "cacheline isolation");
+
+struct ShimState {
+  const PJRT_Api* real_api = nullptr;
+  PJRT_Api wrapped_api;           // copy with substituted entries
+  VtpuConfig config{};            // loaded or env-synthesized
+  bool enforce = false;           // config present and not disabled
+  int device_count = 0;
+  DeviceHot hot[kMaxDeviceCount];
+  // PJRT local device ordinal -> slot in config.devices (-1 = unmanaged)
+  int slot_by_ordinal[kMaxDeviceCount];
+  // buffer -> (slot, bytes) for destroy-time credit
+  std::mutex buffers_mu;
+  std::unordered_map<PJRT_Buffer*, std::pair<int, int64_t>> buffers;
+  // executable -> EMA cost in device-busy microseconds + static facts;
+  // both evicted on PJRT_LoadedExecutable_Destroy (pointer reuse must not
+  // serve a new executable the old one's cost/gate data)
+  std::mutex cost_mu;
+  std::unordered_map<PJRT_LoadedExecutable*, double> exec_cost_us;
+  struct ExecFactsEntry {
+    size_t num_outputs = 0;
+    int64_t gate_bytes = 0;
+  };
+  std::unordered_map<PJRT_LoadedExecutable*, ExecFactsEntry> exec_facts;
+  // tc_util external feed (mapped readonly if present)
+  const TcUtilFile* tc_file = nullptr;
+};
+
+ShimState& State();
+
+// loader.cc
+const PJRT_Api* RealApi();
+bool LoadConfig();                    // vtpu.config mmap or env synthesis
+void StartWatcherOnce();
+int SlotForDevice(PJRT_Device* device);      // -1 if unmanaged
+const VtpuDevice* DeviceCfg(int slot);
+
+// error.cc — sentinel PJRT_Error minting
+PJRT_Error* MakeError(PJRT_Error_Code code, const char* fmt, ...);
+bool IsOurError(const PJRT_Error* err);
+void WrapErrorEntries(PJRT_Api* api);
+// Destroy an error returned by a forwarded real-API call (hot paths must
+// not leak the heap object); returns true if there was an error.
+bool ConsumeError(PJRT_Error* err);
+
+// enforce.cc — memory + compute hooks
+void WrapEnforcementEntries(PJRT_Api* api);
+int64_t OtherProcsBytes(int slot);    // vmem-ledger view of co-tenants
+void RecordOwnBytes(int slot);        // publish to the ledger
+
+// throttle (in enforce.cc)
+void RateLimit(int slot, int64_t cost_us);
+void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
+                   uint64_t end_ns);
+
+uint64_t NowNs();
+
+}  // namespace vtpu
+
+#endif  // VTPU_SHIM_H_

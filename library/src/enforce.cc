@@ -1,0 +1,1099 @@
+// enforce.cc — HBM cap enforcement + TensorCore-% throttling.
+//
+// Reference analogues:
+//   memory: prepare_memory_allocation (cuda_hook.c:278-307) under the
+//     per-device OFD lock (lock.c:173-214), NVML process enumeration
+//     replaced by the vmem ledger (TPU metrics are chip-level; SURVEY.md §7
+//     hard part (c)); view faking _cuMemGetInfo (cuda_hook.c:3235-3309) ->
+//     PJRT_Device_MemoryStats.
+//   compute: rate_limiter token bucket (cuda_hook.c:583-608), watcher
+//     thread refill (utilization_watcher cuda_hook.c:1143-1373), delta /
+//     AIMD controllers (cuda_hook.c:610-675, 801-895), GAP idle-bypass
+//     duty cycling (cuda_hook.c:151-173,1375-1591).
+//
+// TPU-first redesign: TPU programs are whole XLA executables, so the bucket
+// is denominated in *device-busy microseconds* rather than grid threads.
+// Each Execute costs its executable's measured-duration EMA (the analogue
+// of the CUDA-graph per-exec cost cache); refill tracks the core quota via
+// a pluggable controller fed by the node watcher's chip duty-cycle (or a
+// self-estimate from completion events when the feed is absent). A >200 ms
+// idle gap grants bypass (fetch_sub below zero) so the first program after
+// idle starts immediately and its *debt* throttles followers — duty cycling
+// without sleeping on plugin callback threads.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cinttypes>
+#include <condition_variable>
+
+#include "shim.h"
+
+namespace vtpu {
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Tunables (env-overridable, reference util.c:27-85)
+// ---------------------------------------------------------------------------
+
+constexpr int64_t kWindowUs = 100000;        // watcher cadence 100 ms
+constexpr int64_t kTickSleepUs = 10000;      // throttled retry 10 ms
+constexpr int64_t kGapThresholdNs = 200ll * 1000 * 1000;
+constexpr int64_t kDefaultCostUs = 1000;     // cost before first measurement
+constexpr double kCostEmaAlpha = 0.3;
+
+struct DynamicConfig {
+  int controller = 2;        // 0=delta 1=aimd 2=auto
+  double aimd_ai = 0.03;     // additive increase (fraction of base)
+  double aimd_md = 1.5;      // multiplicative decrease divisor
+  int aimd_deadband = 3;     // percent
+  int aimd_cooldown_ticks = 3;
+  double delta_gain = 0.5;
+};
+DynamicConfig g_dyn;
+
+void LoadDynamicConfig() {
+  if (const char* v = getenv("VTPU_SM_CONTROLLER")) {
+    if (!strcmp(v, "delta")) g_dyn.controller = 0;
+    else if (!strcmp(v, "aimd")) g_dyn.controller = 1;
+    else g_dyn.controller = 2;
+  }
+  if (const char* v = getenv("VTPU_AIMD_AI")) g_dyn.aimd_ai = atof(v);
+  if (const char* v = getenv("VTPU_AIMD_MD")) g_dyn.aimd_md = atof(v);
+  if (const char* v = getenv("VTPU_AIMD_DEADBAND"))
+    g_dyn.aimd_deadband = atoi(v);
+  if (const char* v = getenv("VTPU_DELTA_GAIN")) g_dyn.delta_gain = atof(v);
+}
+
+// ---------------------------------------------------------------------------
+// Per-device OFD lock (reference lock.c:15-68: backoff 1->10ms, 10s timeout)
+// ---------------------------------------------------------------------------
+
+int DeviceLockFd(int host_index) {
+  static std::mutex mu;
+  static std::unordered_map<int, int> fds;
+  std::lock_guard<std::mutex> g(mu);
+  auto it = fds.find(host_index);
+  if (it != fds.end()) return it->second;
+  const char* dir = getenv("VTPU_LOCK_DIR");
+  char path[256];
+  snprintf(path, sizeof(path), "%s/vtpu_%d.lock",
+           dir ? dir : "/tmp/.vtpu_lock", host_index);
+  mkdir(dir ? dir : "/tmp/.vtpu_lock", 0777);
+  int fd = open(path, O_CREAT | O_RDWR, 0666);
+  fds[host_index] = fd;
+  return fd;
+}
+
+// Per-device intra-process mutex: flock on a shared fd does not exclude
+// threads of the same process (same open file description), so pair it with
+// a local mutex (the reference pairs pthread mutex + OFD lock the same way).
+std::mutex& DeviceLocalMutex(int host_index) {
+  static std::mutex mu;
+  static std::unordered_map<int, std::mutex*> map;
+  std::lock_guard<std::mutex> g(mu);
+  auto it = map.find(host_index);
+  if (it == map.end()) it = map.emplace(host_index, new std::mutex()).first;
+  return *it->second;
+}
+
+class DeviceLock {
+ public:
+  explicit DeviceLock(int host_index)
+      : local_(DeviceLocalMutex(host_index)), fd_(DeviceLockFd(host_index)) {
+    local_.lock();
+    if (fd_ < 0) return;
+    int64_t deadline = (int64_t)NowNs() + 10ll * 1000 * 1000 * 1000;
+    int backoff_us = 1000;
+    while (flock(fd_, LOCK_EX | LOCK_NB) != 0) {
+      if (errno != EWOULDBLOCK && errno != EINTR) { fd_ = -1; return; }
+      if ((int64_t)NowNs() > deadline) {  // fail, don't hang (lock.c:207)
+        VTPU_LOG(kLogError, "device %d lock timeout", host_index);
+        fd_ = -1;
+        return;
+      }
+      usleep(backoff_us);
+      backoff_us = std::min(backoff_us * 2, 10000);
+    }
+    held_ = true;
+  }
+  ~DeviceLock() {
+    if (held_) flock(fd_, LOCK_UN);
+    local_.unlock();
+  }
+  bool held() const { return held_; }
+
+ private:
+  std::mutex& local_;
+  int fd_;
+  bool held_ = false;
+};
+
+// ---------------------------------------------------------------------------
+// vmem ledger (C++ side of vtpu_manager/config/vmem.py)
+// ---------------------------------------------------------------------------
+
+VmemFile* g_vmem = nullptr;
+int g_vmem_lock_fd = -1;  // flock on <path>.lock — same protocol as the
+                          // Python VmemLedger's FileLock, so C++ and Python
+                          // writers exclude each other
+
+class VmemLock {
+ public:
+  VmemLock() {
+    if (g_vmem_lock_fd < 0) return;
+    if (flock(g_vmem_lock_fd, LOCK_EX) == 0) held_ = true;
+  }
+  ~VmemLock() {
+    if (held_) flock(g_vmem_lock_fd, LOCK_UN);
+  }
+
+ private:
+  bool held_ = false;
+};
+
+void MapVmemLedger() {
+  const char* path = getenv("VTPU_VMEM_PATH");
+  char fallback[] = "/tmp/.vmem_node/vmem_node.config";
+  if (!path) path = fallback;
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return;
+  char lock_path[512];
+  snprintf(lock_path, sizeof(lock_path), "%s.lock", path);
+  g_vmem_lock_fd = open(lock_path, O_CREAT | O_RDWR, 0666);
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size != sizeof(VmemFile)) {
+    close(fd);
+    return;
+  }
+  void* mem =
+      mmap(nullptr, sizeof(VmemFile), PROT_READ | PROT_WRITE, MAP_SHARED,
+           fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return;
+  auto* f = static_cast<VmemFile*>(mem);
+  if (f->magic != kVmemMagic) {
+    munmap(mem, sizeof(VmemFile));
+    return;
+  }
+  g_vmem = f;
+  VTPU_LOG(kLogInfo, "vmem ledger mapped: %s", path);
+}
+
+bool PidAlive(int pid) { return kill(pid, 0) == 0 || errno != ESRCH; }
+
+}  // namespace
+
+int64_t OtherProcsBytes(int slot) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!g_vmem || !cfg) return 0;
+  int64_t total = 0;
+  int me = (int)getpid();
+  for (int i = 0; i < kVmemMaxEntries; i++) {
+    const VmemEntry& e = g_vmem->entries[i];
+    if (e.pid == 0 || e.pid == me || e.host_index != cfg->host_index)
+      continue;
+    if (!PidAlive(e.pid)) continue;
+    total += (int64_t)e.bytes;
+  }
+  return total;
+}
+
+void RecordOwnBytes(int slot) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!g_vmem || !cfg) return;
+  int me = (int)getpid();
+  uint64_t mine =
+      (uint64_t)State().hot[slot].used_bytes.load(std::memory_order_relaxed);
+  // Cross-process lock: two first-time writers must not claim the same free
+  // slot (the loser's record would vanish and co-tenant caps undercount).
+  VmemLock lock;
+  int free_slot = -1;
+  for (int i = 0; i < kVmemMaxEntries; i++) {
+    VmemEntry& e = g_vmem->entries[i];
+    if (e.pid == me && e.host_index == cfg->host_index) {
+      e.bytes = mine;
+      e.last_update_ns = NowNs();
+      return;
+    }
+    if (e.pid == 0 && free_slot < 0) free_slot = i;
+  }
+  if (free_slot >= 0 && mine > 0) {
+    VmemEntry& e = g_vmem->entries[free_slot];
+    e.host_index = cfg->host_index;
+    e.bytes = mine;
+    e.last_update_ns = NowNs();
+    __atomic_store_n(&e.pid, me, __ATOMIC_RELEASE);  // pid last: claims slot
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Memory hooks
+// ---------------------------------------------------------------------------
+
+namespace {
+
+PJRT_Client_BufferFromHostBuffer* g_real_bfhb = nullptr;
+PJRT_Buffer_Destroy* g_real_buf_destroy = nullptr;
+PJRT_Device_MemoryStats* g_real_memstats = nullptr;
+PJRT_LoadedExecutable_Execute* g_real_execute = nullptr;
+PJRT_Buffer_ToHostBuffer* g_real_tohost = nullptr;
+
+int64_t ElementBytes(PJRT_Buffer_Type type) {
+  switch (type) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+    case PJRT_Buffer_Type_F8E5M2:
+    case PJRT_Buffer_Type_F8E4M3FN:
+    case PJRT_Buffer_Type_F8E4M3B11FNUZ:
+    case PJRT_Buffer_Type_F8E5M2FNUZ:
+    case PJRT_Buffer_Type_F8E4M3FNUZ:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 4;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 8;
+    case PJRT_Buffer_Type_C128:
+      return 16;
+    case PJRT_Buffer_Type_S4:
+    case PJRT_Buffer_Type_U4:
+    case PJRT_Buffer_Type_S2:
+    case PJRT_Buffer_Type_U2:
+      return 1;  // sub-byte types round up per element (upper bound)
+    default:
+      return 4;
+  }
+}
+
+int64_t HostBufferBytes(const PJRT_Client_BufferFromHostBuffer_Args* args) {
+  int64_t elems = 1;
+  for (size_t i = 0; i < args->num_dims; i++) elems *= args->dims[i];
+  return elems * ElementBytes(args->type);
+}
+
+void TrackBuffer(PJRT_Buffer* buf, int slot, int64_t bytes) {
+  ShimState& s = State();
+  {
+    std::lock_guard<std::mutex> g(s.buffers_mu);
+    s.buffers[buf] = {slot, bytes};
+  }
+  int64_t used = s.hot[slot].used_bytes.fetch_add(bytes) + bytes;
+  int64_t peak = s.hot[slot].peak_bytes.load();
+  while (used > peak &&
+         !s.hot[slot].peak_bytes.compare_exchange_weak(peak, used)) {
+  }
+  RecordOwnBytes(slot);
+  g_metrics.mem_charged.Bump();
+}
+
+// The alloc-path gate (reference MEMORY_PATH_OOM, cuda_hook.c:290-298):
+// under the cross-process device lock, own + co-tenant + request vs cap.
+PJRT_Error* CheckMemoryFits(int slot, int64_t bytes) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!cfg || !cfg->memory_limit) return nullptr;
+  ShimState& s = State();
+  DeviceLock lock(cfg->host_index);
+  // lock.held()==false after timeout: proceed unsynchronized rather than
+  // deadlock the app; the cap check still runs on our own view.
+  int64_t own = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
+  int64_t others = OtherProcsBytes(slot);
+  int64_t cap = (int64_t)cfg->total_memory;
+  if (own + others + bytes > cap) {
+    g_metrics.oom_rejected.Bump();
+    return MakeError(
+        PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "vtpu-control: HBM cap exceeded on device %d: "
+        "req=%" PRId64 "B used=%" PRId64 "B co-tenants=%" PRId64
+        "B cap=%" PRId64 "B",
+        cfg->host_index, bytes, own, others, cap);
+  }
+  return nullptr;
+}
+
+PJRT_Error* WrappedBufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  int slot = SlotForDevice(args->device);
+  if (slot < 0) return g_real_bfhb(args);
+  int64_t bytes = HostBufferBytes(args);
+  if (PJRT_Error* err = CheckMemoryFits(slot, bytes)) return err;
+  PJRT_Error* err = g_real_bfhb(args);
+  if (!err && args->buffer) TrackBuffer(args->buffer, slot, bytes);
+  return err;
+}
+
+PJRT_Error* WrappedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  ShimState& s = State();
+  std::pair<int, int64_t> rec{-1, 0};
+  {
+    std::lock_guard<std::mutex> g(s.buffers_mu);
+    auto it = s.buffers.find(args->buffer);
+    if (it != s.buffers.end()) {
+      rec = it->second;
+      s.buffers.erase(it);
+    }
+  }
+  PJRT_Error* err = g_real_buf_destroy(args);
+  if (rec.first >= 0) {
+    s.hot[rec.first].used_bytes.fetch_sub(rec.second);
+    RecordOwnBytes(rec.first);
+  }
+  return err;
+}
+
+// Caller-version guard: only touch an out-field if the caller's struct is
+// big enough to contain it (PJRT forward-compat contract).
+#define ARGS_HAS_FIELD(args, Type, field) \
+  ((args)->struct_size >= offsetof(Type, field) + sizeof((args)->field))
+
+// View faking (reference _cuMemGetInfo cuda_hook.c:3235-3309,
+// nvmlDeviceGetMemoryInfo nvml_hook.c:47-103): report the cap as the limit
+// and our accounted usage, not the physical chip's.
+PJRT_Error* WrappedMemoryStats(PJRT_Device_MemoryStats_Args* args) {
+  int slot = SlotForDevice(args->device);
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!cfg || !cfg->memory_limit) {
+    if (g_real_memstats) return g_real_memstats(args);
+    return MakeError(PJRT_Error_Code_UNIMPLEMENTED,
+                     "vtpu-control: no MemoryStats in real plugin");
+  }
+  using ArgsT = PJRT_Device_MemoryStats_Args;
+  bool real_ok = false;
+  if (g_real_memstats) real_ok = !ConsumeError(g_real_memstats(args));
+  if (!real_ok) {
+    // real plugin absent or UNIMPLEMENTED: zero every out-field the
+    // caller's struct actually has, bounded by its struct_size
+    size_t begin = offsetof(ArgsT, bytes_in_use);
+    size_t end = std::min(args->struct_size, sizeof(ArgsT));
+    if (end > begin)
+      memset((char*)args + begin, 0, end - begin);
+  }
+  ShimState& s = State();
+  int64_t own = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
+  int64_t others = OtherProcsBytes(slot);
+
+  if (ARGS_HAS_FIELD(args, ArgsT, bytes_in_use))
+    args->bytes_in_use = own + others;
+  if (ARGS_HAS_FIELD(args, ArgsT, bytes_limit_is_set)) {
+    args->bytes_limit = (int64_t)cfg->total_memory;
+    args->bytes_limit_is_set = true;
+  }
+  if (ARGS_HAS_FIELD(args, ArgsT, peak_bytes_in_use_is_set)) {
+    args->peak_bytes_in_use = s.hot[slot].peak_bytes.load();
+    args->peak_bytes_in_use_is_set = true;
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// Compute throttling
+// ---------------------------------------------------------------------------
+
+pthread_t g_watcher;
+std::atomic<bool> g_watcher_running{false};
+pthread_once_t g_watcher_once = PTHREAD_ONCE_INIT;
+
+int EffectiveLimit(int slot) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!cfg || cfg->core_limit == kCoreLimitNone) return 0;
+  if (cfg->core_limit == kCoreLimitHard) return cfg->hard_core;
+  int up = State().hot[slot].up_limit.load(std::memory_order_relaxed);
+  return up > 0 ? up : cfg->hard_core;
+}
+
+// Measured utilization (%) over the last window for the chip: external
+// watcher feed when fresh (reference cuda_hook.c:2206-2241), else
+// self-estimate from completion timing. busy_us_out always returns this
+// process's own observed busy time (the spend to reconcile).
+int MeasuredUtil(int slot, int64_t window_ns, bool* external,
+                 bool* others_active, int64_t* busy_us_out) {
+  ShimState& s = State();
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  *external = false;
+  *others_active = false;
+  *busy_us_out =
+      (int64_t)(s.hot[slot].busy_ns_window.exchange(0) / 1000);
+  if (s.tc_file && cfg && cfg->host_index < kMaxDeviceCount) {
+    const TcDeviceRecord& rec = s.tc_file->records[cfg->host_index];
+    for (int attempt = 0; attempt < 4; attempt++) {
+      uint64_t seq1 = __atomic_load_n(&rec.seq, __ATOMIC_ACQUIRE);
+      if (seq1 & 1) continue;
+      int util = rec.device_util;
+      uint64_t ts = rec.timestamp_ns;
+      int nproc = std::min(rec.proc_count, (int32_t)kMaxProcs);
+      bool other = false;
+      int me = (int)getpid();
+      for (int i = 0; i < nproc; i++)
+        if (rec.procs[i].pid != me && rec.procs[i].pid != 0) other = true;
+      uint64_t seq2 = __atomic_load_n(&rec.seq, __ATOMIC_ACQUIRE);
+      if (seq1 != seq2) continue;
+      uint64_t now = NowNs();
+      if (now >= ts && now - ts <= 5ull * 1000 * 1000 * 1000) {
+        *external = true;
+        *others_active = other;
+        g_metrics.watcher_external.Bump();
+        return util;
+      }
+      break;  // stale: fall back
+    }
+  }
+  // Self-estimate: busy time accumulated by completion/sync callbacks.
+  g_metrics.watcher_fallback.Bump();
+  if (window_ns <= 0) return 0;
+  int util = (int)(100 * (*busy_us_out * 1000) / window_ns);
+  *others_active = OtherProcsBytes(slot) > 0;
+  return std::min(util, 100);
+}
+
+struct ControllerState {
+  double rate_frac = 0.0;   // granted fraction of wall time (0..2)
+  double util_ema = -1.0;   // smoothed utilization: sync-driven busy
+                            // reports arrive as per-step spikes, and a
+                            // controller fed raw spikes (one 65 ms burst,
+                            // then idle ticks) oscillates between MD and AI
+                            // and equilibrates far below target
+  int cooldown = 0;
+  int exclusive_ticks = 0;  // debounce for auto-switch FSM
+  bool use_aimd = true;
+};
+ControllerState g_ctl[kMaxDeviceCount];
+
+// delta: symmetric proportional step toward the target
+// (reference cuda_hook.c:610-675).
+double DeltaStep(double rate, int target, int used) {
+  double diff = (double)(target - used) / 100.0;
+  return rate + g_dyn.delta_gain * diff;
+}
+
+// AIMD: additive increase inside the band, multiplicative decrease with
+// cooldown on overshoot (reference aimd_controller cuda_hook.c:801-895).
+double AimdStep(ControllerState* cs, double rate, int target, int used) {
+  if (cs->cooldown > 0) {
+    cs->cooldown--;
+    return rate;
+  }
+  if (used > target + g_dyn.aimd_deadband) {
+    cs->cooldown = g_dyn.aimd_cooldown_ticks;
+    g_metrics.aimd_md_events.Bump();
+    return rate / g_dyn.aimd_md;
+  }
+  if (used < target - g_dyn.aimd_deadband) return rate + g_dyn.aimd_ai;
+  return rate;
+}
+
+void WatcherTick(int64_t window_ns) {
+  ShimState& s = State();
+  for (int slot = 0; slot < s.device_count; slot++) {
+    const VtpuDevice* cfg = DeviceCfg(slot);
+    if (!cfg || cfg->core_limit == kCoreLimitNone) continue;
+    bool external = false, others = false;
+    int64_t busy_us = 0;
+    int used = MeasuredUtil(slot, window_ns, &external, &others, &busy_us);
+    // balance/soft mode: climb toward soft_core while alone with headroom,
+    // reset to hard_core when an external process appears
+    // (reference cuda_hook.c:1265-1352).
+    if (cfg->core_limit == kCoreLimitSoft) {
+      int up = s.hot[slot].up_limit.load(std::memory_order_relaxed);
+      if (up == 0) up = cfg->hard_core;
+      if (others) {
+        up = cfg->hard_core;
+      } else if (used >= up - 5 && up < cfg->soft_core) {
+        up = std::min(up + 2, (int)cfg->soft_core);
+      }
+      s.hot[slot].up_limit.store(up, std::memory_order_relaxed);
+    }
+    int target = EffectiveLimit(slot);
+    ControllerState* cs = &g_ctl[slot];
+    double base = (double)target / 100.0;
+    if (cs->rate_frac <= 0) cs->rate_frac = base;
+    if (!external) {
+      // Open loop: without a chip-level measurement there is nothing to
+      // track — our own busy observations already flow through the bucket
+      // reconciliation, which enforces busy/wall == target exactly. A
+      // feedback controller on the same signal double-corrects (each
+      // per-step busy spike reads as overshoot) and collapses the rate.
+      cs->rate_frac = base;
+    } else {
+      // Closed loop on the node watcher's chip duty cycle (the reference's
+      // NVML-utilization path): smooth the signal, then delta or AIMD.
+      if (cs->util_ema < 0) cs->util_ema = used;
+      cs->util_ema = 0.8 * cs->util_ema + 0.2 * used;
+      used = (int)(cs->util_ema + 0.5);
+      // auto FSM: exclusive chip tenancy -> delta (smooth single-tenant
+      // tracking); shared -> AIMD (fast fairness reaction). Debounced
+      // (reference host_index_is_exclusive_debounced cuda_hook.c:943-1010).
+      if (g_dyn.controller == 2) {
+        cs->exclusive_ticks =
+            others ? 0 : std::min(cs->exclusive_ticks + 1, 50);
+        cs->use_aimd = cs->exclusive_ticks < 20;
+      } else {
+        cs->use_aimd = g_dyn.controller == 1;
+      }
+      cs->rate_frac = cs->use_aimd
+                          ? AimdStep(cs, cs->rate_frac, target, used)
+                          : DeltaStep(cs->rate_frac, target, used);
+      cs->rate_frac = std::clamp(cs->rate_frac, 0.01, 2.0 * base + 0.05);
+    }
+    int64_t grant = (int64_t)(cs->rate_frac * (window_ns / 1000));
+    s.hot[slot].grant_us.store(grant, std::memory_order_relaxed);
+    // Reconcile against observed busy time: submissions pre-paid cost-EMA
+    // tokens; the true spend is what the device actually burned. Refund
+    // overcharges, deduct undercharges — duty cycling stays correct even
+    // when per-exec costs are unknowable at submit time.
+    int64_t precharged =
+        s.hot[slot].precharged_us.exchange(0, std::memory_order_relaxed);
+    int64_t correction = busy_us - precharged;
+    int64_t cap = 2 * (int64_t)(base * kWindowUs) + 1000;
+    int64_t floor = -10 * kWindowUs;  // bound the debt: ~1s recovery max
+    int64_t cur = s.hot[slot].tokens_us.load(std::memory_order_relaxed);
+    int64_t next = std::clamp(cur + grant - correction, floor, cap);
+    VTPU_LOG(kLogDebug,
+             "tick slot=%d used=%d target=%d rate=%.3f grant=%" PRId64
+             " busy=%" PRId64 " precharged=%" PRId64 " tokens=%" PRId64
+             "->%" PRId64,
+             slot, used, target, cs->rate_frac, grant, busy_us, precharged,
+             cur, next);
+    s.hot[slot].tokens_us.store(next, std::memory_order_relaxed);
+    s.hot[slot].throttled_since_watch.store(false);
+  }
+  g_metrics.watcher_ticks.Bump();
+}
+
+void* WatcherMain(void*) {
+  // Drift-free absolute-time grid (reference cuda_hook.c:1176-1207).
+  struct timespec next;
+  clock_gettime(CLOCK_MONOTONIC, &next);
+  uint64_t prev = NowNs();
+  while (g_watcher_running.load(std::memory_order_relaxed)) {
+    next.tv_nsec += kWindowUs * 1000;
+    while (next.tv_nsec >= 1000000000) {
+      next.tv_nsec -= 1000000000;
+      next.tv_sec += 1;
+    }
+    clock_nanosleep(CLOCK_MONOTONIC, TIMER_ABSTIME, &next, nullptr);
+    uint64_t now = NowNs();
+    WatcherTick((int64_t)(now - prev));
+    prev = now;
+  }
+  return nullptr;
+}
+
+void StartWatcher() {
+  g_watcher_running.store(true);
+  if (pthread_create(&g_watcher, nullptr, WatcherMain, nullptr) != 0) {
+    // surfaced loudly (reference cuda_hook.c:1592-1604)
+    VTPU_LOG(kLogError, "FATAL: utilization watcher thread failed to start; "
+                        "core limits will stall");
+    g_watcher_running.store(false);
+  }
+}
+
+}  // namespace
+
+void ResetAwaitForFork();  // defined below, near the await-thread state
+
+void ResetWatcherForFork() {
+  g_watcher_running.store(false);
+  pthread_once_t fresh = PTHREAD_ONCE_INIT;
+  memcpy(&g_watcher_once, &fresh, sizeof(fresh));
+  ResetAwaitForFork();
+}
+
+void StartWatcherOnce() {
+  pthread_once(&g_watcher_once, [] { StartWatcher(); });
+}
+
+void RateLimit(int slot, int64_t cost_us) {
+  ShimState& s = State();
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!cfg || cfg->core_limit == kCoreLimitNone) return;
+  StartWatcherOnce();
+  DeviceHot& hot = s.hot[slot];
+  uint64_t now = NowNs();
+  uint64_t last = hot.last_submit_ns.load(std::memory_order_relaxed);
+  hot.last_submit_ns.store(now, std::memory_order_relaxed);
+  // GAP bypass: first program after idle proceeds immediately, paying into
+  // debt (tokens may go negative) so followers are throttled — duty cycling
+  // without sleeping inside plugin callbacks (reference GAP path,
+  // cuda_hook.c:1375-1591).
+  if (last == 0 || now - last > (uint64_t)kGapThresholdNs) {
+    hot.tokens_us.fetch_sub(cost_us, std::memory_order_relaxed);
+    hot.precharged_us.fetch_add(cost_us, std::memory_order_relaxed);
+    g_metrics.gap_throttles.Bump();
+    return;
+  }
+  for (;;) {
+    int64_t cur = hot.tokens_us.load(std::memory_order_relaxed);
+    if (cur >= 0) {
+      // Spend whenever the balance is non-negative (partial credit); the
+      // watcher reconciles the precharge against observed busy time.
+      if (hot.tokens_us.compare_exchange_weak(cur, cur - cost_us,
+                                              std::memory_order_relaxed)) {
+        hot.precharged_us.fetch_add(cost_us, std::memory_order_relaxed);
+        return;
+      }
+      continue;
+    }
+    hot.throttled_since_watch.store(true, std::memory_order_relaxed);
+    g_metrics.throttle_waits.Bump();
+    // Fail open rather than hang (reference lock.c:207-211): if the watcher
+    // is dead or the debt has not cleared in 10s, proceed unthrottled.
+    if (!g_watcher_running.load(std::memory_order_relaxed) ||
+        NowNs() - now > 10ull * 1000 * 1000 * 1000) {
+      VTPU_LOG(kLogError,
+               "rate limiter stuck on device %d (watcher %s); failing open",
+               cfg->host_index,
+               g_watcher_running.load() ? "alive" : "dead");
+      hot.precharged_us.fetch_add(cost_us, std::memory_order_relaxed);
+      return;
+    }
+    usleep(kTickSleepUs);
+  }
+}
+
+void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
+                   uint64_t end_ns) {
+  ShimState& s = State();
+  if (slot < 0 || slot >= s.device_count) return;
+  if (end_ns < start_ns) end_ns = start_ns;
+  g_metrics.exec_done.Bump();
+  if (exe) {
+    s.hot[slot].inflight.fetch_sub(1, std::memory_order_relaxed);
+    // Cost EMA uses the raw duration (coverage clamping below is about
+    // busy accounting, not per-program cost).
+    int64_t raw_us = (int64_t)((end_ns - start_ns) / 1000);
+    std::lock_guard<std::mutex> g(s.cost_mu);
+    auto it = s.exec_cost_us.find(exe);
+    if (it == s.exec_cost_us.end()) {
+      s.exec_cost_us[exe] = (double)raw_us;
+    } else {
+      it->second =
+          (1 - kCostEmaAlpha) * it->second + kCostEmaAlpha * raw_us;
+    }
+  }
+  // Busy-time coverage: multiple observers (await thread, transfer
+  // callbacks) report overlapping spans of the same device activity; credit
+  // only the part of [start, end] past the high-water mark, so contained or
+  // repeated spans count zero instead of double.
+  static std::atomic<uint64_t> covered_until[kMaxDeviceCount];
+  uint64_t prev = covered_until[slot].load(std::memory_order_relaxed);
+  while (end_ns > prev &&
+         !covered_until[slot].compare_exchange_weak(
+             prev, end_ns, std::memory_order_relaxed)) {
+  }
+  if (end_ns <= prev) return;  // fully covered by credited activity
+  if (start_ns < prev) start_ns = prev;
+  s.hot[slot].busy_ns_window.fetch_add(end_ns - start_ns,
+                                       std::memory_order_relaxed);
+  s.hot[slot].last_submit_ns.store(end_ns, std::memory_order_relaxed);
+}
+
+namespace {
+
+int64_t ExecCost(PJRT_LoadedExecutable* exe) {
+  ShimState& s = State();
+  std::lock_guard<std::mutex> g(s.cost_mu);
+  auto it = s.exec_cost_us.find(exe);
+  return it == s.exec_cost_us.end() ? kDefaultCostUs
+                                    : (int64_t)it->second;
+}
+
+struct ExecTiming {
+  int slot;
+  PJRT_LoadedExecutable* exe;
+  uint64_t start_ns;
+  PJRT_Event* owned_event = nullptr;  // we created it; destroy after firing
+};
+
+// Static per-executable facts, resolved once (GetExecutable returns a new
+// PJRT_Executable we must destroy — cache to keep Execute cheap).
+struct ExecFacts {
+  size_t num_outputs = 0;
+  // Admission estimate for one execution: fresh output allocations
+  // (output - donated-alias) plus transient scratch. XLA shapes are static,
+  // so this is exact per executable (the TPU-side analogue of gating
+  // cuMemAlloc before the driver sees it — outputs ARE the allocations on
+  // this path).
+  int64_t gate_bytes = 0;
+};
+
+ExecFacts ExecFactsCached(PJRT_LoadedExecutable* loaded) {
+  ShimState& s = State();
+  {
+    std::lock_guard<std::mutex> g(s.cost_mu);
+    auto it = s.exec_facts.find(loaded);
+    if (it != s.exec_facts.end())
+      return {it->second.num_outputs, it->second.gate_bytes};
+  }
+  ExecFacts facts;
+  PJRT_LoadedExecutable_GetExecutable_Args gargs;
+  memset(&gargs, 0, sizeof(gargs));
+  gargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  gargs.loaded_executable = loaded;
+  if (ConsumeError(s.real_api->PJRT_LoadedExecutable_GetExecutable(&gargs)))
+    return facts;
+  PJRT_Executable* exe = gargs.executable;
+  PJRT_Executable_NumOutputs_Args nargs;
+  memset(&nargs, 0, sizeof(nargs));
+  nargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  nargs.executable = exe;
+  if (!ConsumeError(s.real_api->PJRT_Executable_NumOutputs(&nargs)))
+    facts.num_outputs = nargs.num_outputs;
+  if (s.real_api->PJRT_Executable_GetCompiledMemoryStats) {
+    PJRT_Executable_GetCompiledMemoryStats_Args margs;
+    memset(&margs, 0, sizeof(margs));
+    margs.struct_size =
+        PJRT_Executable_GetCompiledMemoryStats_Args_STRUCT_SIZE;
+    margs.executable = exe;
+    PJRT_Error* err = s.real_api->PJRT_Executable_GetCompiledMemoryStats(&margs);
+    if (!err) {
+      facts.gate_bytes =
+          std::max<int64_t>(0, margs.output_size_in_bytes -
+                                   margs.alias_size_in_bytes) +
+          std::max<int64_t>(0, margs.temp_size_in_bytes);
+    } else {
+      PJRT_Error_Destroy_Args dargs;
+      memset(&dargs, 0, sizeof(dargs));
+      dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      dargs.error = err;
+      s.real_api->PJRT_Error_Destroy(&dargs);
+    }
+  }
+  if (facts.gate_bytes == 0 && facts.num_outputs > 0 &&
+      s.real_api->PJRT_Executable_OutputElementTypes &&
+      s.real_api->PJRT_Executable_OutputDimensions) {
+    // Fallback: sum of output array sizes (no alias/temp info).
+    PJRT_Executable_OutputElementTypes_Args targs;
+    memset(&targs, 0, sizeof(targs));
+    targs.struct_size = PJRT_Executable_OutputElementTypes_Args_STRUCT_SIZE;
+    targs.executable = exe;
+    PJRT_Executable_OutputDimensions_Args dargs2;
+    memset(&dargs2, 0, sizeof(dargs2));
+    dargs2.struct_size = PJRT_Executable_OutputDimensions_Args_STRUCT_SIZE;
+    dargs2.executable = exe;
+    if (!ConsumeError(s.real_api->PJRT_Executable_OutputElementTypes(&targs)) &&
+        !ConsumeError(s.real_api->PJRT_Executable_OutputDimensions(&dargs2)) &&
+        targs.num_output_types == dargs2.num_outputs) {
+      const int64_t* dims = dargs2.dims;
+      for (size_t o = 0; o < dargs2.num_outputs; o++) {
+        int64_t elems = 1;
+        for (size_t k = 0; k < dargs2.dim_sizes[o]; k++) elems *= dims[k];
+        dims += dargs2.dim_sizes[o];
+        facts.gate_bytes += elems * ElementBytes(targs.output_types[o]);
+      }
+    }
+  }
+  if (s.real_api->PJRT_Executable_Destroy) {
+    PJRT_Executable_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+    dargs.executable = exe;
+    s.real_api->PJRT_Executable_Destroy(&dargs);
+  }
+  std::lock_guard<std::mutex> g(s.cost_mu);
+  s.exec_facts[loaded] = {facts.num_outputs, facts.gate_bytes};
+  return facts;
+}
+
+PJRT_LoadedExecutable_Destroy* g_real_loaded_destroy = nullptr;
+
+PJRT_Error* WrappedLoadedExecutableDestroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  ShimState& s = State();
+  {
+    std::lock_guard<std::mutex> g(s.cost_mu);
+    s.exec_cost_us.erase(args->executable);
+    s.exec_facts.erase(args->executable);
+  }
+  return g_real_loaded_destroy ? g_real_loaded_destroy(args) : nullptr;
+}
+
+// Completion timing via a dedicated await thread. OnReady callbacks are
+// unreliable across PJRT transports (some fire at dispatch-accept, not at
+// device completion), but PJRT_Event_Await blocks honestly — it is what
+// block_until_ready rides. TPU executions serialize per chip, so one FIFO
+// await thread recovers per-execution end times in order: the TPU-side
+// replacement for cuEvent timing (reference cuda_hook.c:1375-1591) and the
+// self-estimate source when no external watcher feed exists (SURVEY.md §7
+// hard part (c)).
+struct AwaitItem {
+  ExecTiming timing;
+  AwaitItem* next = nullptr;
+};
+
+std::mutex g_await_mu;
+std::condition_variable g_await_cv;
+AwaitItem* g_await_head = nullptr;
+AwaitItem* g_await_tail = nullptr;
+pthread_t g_await_thread;
+std::atomic<bool> g_await_running{false};
+
+void* AwaitMain(void*) {
+  ShimState& s = State();
+  while (g_await_running.load(std::memory_order_relaxed)) {
+    AwaitItem* item = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(g_await_mu);
+      g_await_cv.wait_for(lk, std::chrono::milliseconds(200),
+                          [] { return g_await_head != nullptr; });
+      if (!g_await_head) continue;
+      item = g_await_head;
+      g_await_head = item->next;
+      if (!g_await_head) g_await_tail = nullptr;
+    }
+    PJRT_Event_Await_Args aargs;
+    memset(&aargs, 0, sizeof(aargs));
+    aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    aargs.event = item->timing.owned_event;
+    PJRT_Error* err = s.real_api->PJRT_Event_Await(&aargs);
+    uint64_t end = NowNs();
+    if (err) {
+      PJRT_Error_Destroy_Args dargs;
+      memset(&dargs, 0, sizeof(dargs));
+      dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      dargs.error = err;
+      s.real_api->PJRT_Error_Destroy(&dargs);
+    }
+    // start the busy interval at the later of submit and the previous
+    // completion: queued executions must not double-count wait time
+    uint64_t start = item->timing.start_ns;
+    VTPU_LOG(kLogDebug, "await done slot=%d dur_us=%lld",
+             item->timing.slot,
+             (long long)((end - start) / 1000));
+    OnExecuteDone(item->timing.slot, item->timing.exe, start, end);
+    PJRT_Event_Destroy_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    eargs.event = item->timing.owned_event;
+    s.real_api->PJRT_Event_Destroy(&eargs);
+    delete item;
+  }
+  return nullptr;
+}
+
+void StartAwaitThread() {
+  if (g_await_running.exchange(true)) return;
+  if (pthread_create(&g_await_thread, nullptr, AwaitMain, nullptr) != 0) {
+    VTPU_LOG(kLogError, "await-timer thread failed to start");
+    g_await_running.store(false);
+  }
+}
+
+bool AttachOwnTiming(PJRT_Buffer* out_buffer, int slot,
+                     PJRT_LoadedExecutable* exe, uint64_t start_ns) {
+  ShimState& s = State();
+  if (!out_buffer || !s.real_api->PJRT_Buffer_ReadyEvent ||
+      !s.real_api->PJRT_Event_Await) {
+    VTPU_LOG(kLogDebug, "own-timing unavailable (buf=%p ready=%p await=%p)",
+             (void*)out_buffer, (void*)s.real_api->PJRT_Buffer_ReadyEvent,
+             (void*)s.real_api->PJRT_Event_Await);
+    return false;
+  }
+  PJRT_Buffer_ReadyEvent_Args rargs;
+  memset(&rargs, 0, sizeof(rargs));
+  rargs.struct_size = PJRT_Buffer_ReadyEvent_Args_STRUCT_SIZE;
+  rargs.buffer = out_buffer;
+  if (ConsumeError(s.real_api->PJRT_Buffer_ReadyEvent(&rargs)) || !rargs.event) {
+    VTPU_LOG(kLogDebug, "ReadyEvent failed for %p", (void*)out_buffer);
+    return false;
+  }
+  StartAwaitThread();
+  auto* item = new AwaitItem{{slot, exe, start_ns, rargs.event}, nullptr};
+  {
+    std::lock_guard<std::mutex> lk(g_await_mu);
+    if (g_await_tail) {
+      g_await_tail->next = item;
+      g_await_tail = item;
+    } else {
+      g_await_head = g_await_tail = item;
+    }
+  }
+  g_await_cv.notify_one();
+  return true;
+}
+
+PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
+  ShimState& s = State();
+  // Device resolution: explicit execute_device, else local ordinals
+  // 0..num_devices-1 (a multi-chip launch occupies each chip).
+  int first_slot = -1;
+  if (args->execute_device) {
+    first_slot = SlotForDevice(args->execute_device);
+  } else if (s.device_count > 0) {
+    first_slot = 0;
+  }
+  if (first_slot >= 0) {
+    // Pre-execute HBM admission: outputs + scratch of this program are the
+    // allocations the execute will make; refuse before the device sees it
+    // (the path jnp.ones()-style on-device materialization takes).
+    ExecFacts facts = ExecFactsCached(args->executable);
+    size_t ndev = args->execute_device ? 1 : args->num_devices;
+    if (facts.gate_bytes > 0) {
+      for (size_t d = 0; d < ndev; d++) {
+        int slot = args->execute_device ? first_slot : (int)d;
+        if (slot >= s.device_count) continue;
+        if (PJRT_Error* err = CheckMemoryFits(slot, facts.gate_bytes))
+          return err;
+      }
+    }
+    int64_t cost = ExecCost(args->executable);
+    for (size_t d = 0; d < ndev; d++) {
+      int slot = args->execute_device ? first_slot : (int)d;
+      if (slot < s.device_count) RateLimit(slot, cost);
+    }
+    g_metrics.execs.Bump();
+  }
+  uint64_t start = NowNs();
+  PJRT_Error* err = g_real_execute(args);
+  if (err || first_slot < 0) return err;
+
+  size_t ndev = args->execute_device ? 1 : args->num_devices;
+  size_t num_outputs = ExecFactsCached(args->executable).num_outputs;
+  for (size_t d = 0; d < ndev; d++) {
+    int slot = args->execute_device ? first_slot : (int)d;
+    if (slot >= s.device_count) continue;
+    s.hot[slot].inflight.fetch_add(1, std::memory_order_relaxed);
+    // Charge execute outputs so allocation pressure is visible
+    // (outputs are the only device allocations Execute makes for us).
+    if (args->output_lists && args->output_lists[d]) {
+      for (size_t o = 0; o < num_outputs; o++) {
+        PJRT_Buffer* buf = args->output_lists[d][o];
+        if (!buf) continue;
+        PJRT_Buffer_OnDeviceSizeInBytes_Args bargs;
+        memset(&bargs, 0, sizeof(bargs));
+        bargs.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+        bargs.buffer = buf;
+        if (ConsumeError(s.real_api->PJRT_Buffer_OnDeviceSizeInBytes(&bargs)))
+          continue;
+        TrackBuffer(buf, slot, (int64_t)bargs.on_device_size_in_bytes);
+      }
+    }
+    // Completion timing: our own ReadyEvent awaited on a dedicated thread.
+    // (Caller-provided device_complete_events are NOT used: some PJRT
+    // transports fire OnReady at dispatch-accept rather than at device
+    // completion, which poisons the busy estimate with ~0 durations.)
+    bool timed = false;
+    if (args->output_lists && args->output_lists[d] && num_outputs > 0) {
+      timed = AttachOwnTiming(args->output_lists[d][0], slot,
+                              args->executable, start);
+    }
+    if (!timed) {
+      OnExecuteDone(slot, args->executable, start,
+                    start + (uint64_t)ExecCost(args->executable) * 1000);
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+// D2H sync timing: a host readback completes only when every execution it
+// depends on has finished, so the time a caller spends blocked on a
+// transfer is an honest lower bound on device busyness — the one signal
+// that survives even transports whose compute-completion events fire at
+// dispatch-accept (SURVEY.md §7 hard part (c)). Sync train loops (read a
+// loss scalar per step) feed the estimator for free.
+int SlotOfBuffer(PJRT_Buffer* buf) {
+  ShimState& s = State();
+  {
+    std::lock_guard<std::mutex> g(s.buffers_mu);
+    auto it = s.buffers.find(buf);
+    if (it != s.buffers.end()) return it->second.first;
+  }
+  if (!s.real_api->PJRT_Buffer_Device) return s.device_count == 1 ? 0 : -1;
+  PJRT_Buffer_Device_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Buffer_Device_Args_STRUCT_SIZE;
+  dargs.buffer = buf;
+  if (ConsumeError(s.real_api->PJRT_Buffer_Device(&dargs))) return -1;
+  return SlotForDevice(dargs.device);
+}
+
+struct TransferTiming {
+  int slot;
+  uint64_t start_ns;
+};
+
+void TransferDoneCallback(PJRT_Error* error, void* user_arg) {
+  auto* t = static_cast<TransferTiming*>(user_arg);
+  uint64_t end = NowNs();
+  VTPU_LOG(kLogDebug, "transfer done slot=%d span_us=%lld", t->slot, (long long)((end - t->start_ns) / 1000));
+  OnExecuteDone(t->slot, nullptr, t->start_ns, end);
+  delete t;
+  if (error) {
+    PJRT_Error_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dargs.error = error;
+    State().wrapped_api.PJRT_Error_Destroy(&dargs);
+  }
+}
+
+PJRT_Error* WrappedToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  int slot = SlotOfBuffer(args->src);
+  uint64_t start = NowNs();
+  PJRT_Error* err = g_real_tohost(args);
+  if (err || slot < 0 || !args->dst || !args->event)
+    return err;  // size query or unmanaged device: nothing to time
+  ShimState& s = State();
+  if (s.real_api->PJRT_Event_OnReady) {
+    auto* timing = new TransferTiming{slot, start};
+    PJRT_Event_OnReady_Args oargs;
+    memset(&oargs, 0, sizeof(oargs));
+    oargs.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    oargs.event = args->event;
+    oargs.callback = TransferDoneCallback;
+    oargs.user_arg = timing;
+    if (s.real_api->PJRT_Event_OnReady(&oargs)) delete timing;
+  }
+  return nullptr;
+}
+
+void ResetAwaitForFork() {
+  // Await thread is gone in the child; drop its queue (events belonged to
+  // the parent's client) and let it restart lazily.
+  g_await_running.store(false);
+  new (&g_await_mu) std::mutex();
+  g_await_head = g_await_tail = nullptr;
+}
+
+void WrapEnforcementEntries(PJRT_Api* api) {
+  LoadDynamicConfig();
+  MapVmemLedger();
+  g_real_bfhb = api->PJRT_Client_BufferFromHostBuffer;
+  g_real_buf_destroy = api->PJRT_Buffer_Destroy;
+  g_real_memstats = api->PJRT_Device_MemoryStats;
+  g_real_execute = api->PJRT_LoadedExecutable_Execute;
+  g_real_tohost = api->PJRT_Buffer_ToHostBuffer;
+  g_real_loaded_destroy = api->PJRT_LoadedExecutable_Destroy;
+  api->PJRT_Client_BufferFromHostBuffer = WrappedBufferFromHostBuffer;
+  api->PJRT_Buffer_Destroy = WrappedBufferDestroy;
+  api->PJRT_Device_MemoryStats = WrappedMemoryStats;
+  api->PJRT_LoadedExecutable_Execute = WrappedExecute;
+  api->PJRT_Buffer_ToHostBuffer = WrappedToHostBuffer;
+  api->PJRT_LoadedExecutable_Destroy = WrappedLoadedExecutableDestroy;
+}
+
+}  // namespace vtpu

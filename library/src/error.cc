@@ -1,0 +1,107 @@
+// error.cc — sentinel PJRT_Error minting.
+//
+// The shim must return errors (OOM) through an API where PJRT_Error is an
+// opaque type owned by the plugin: callers pass it back to
+// PJRT_Error_Destroy / _Message / _GetCode. We mint our own error objects
+// with a magic header and wrap those three entries to recognize them,
+// forwarding everything else to the real plugin. This replaces the
+// reference's ability to simply return CUDA_ERROR_OUT_OF_MEMORY as an enum
+// (cuda_hook.c:290-298) — PJRT errors are objects, not codes.
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "shim.h"
+
+namespace vtpu {
+
+namespace {
+
+constexpr uint64_t kErrMagic = 0x5654505545525231ull;  // "VTPUERR1"
+
+struct OurError {
+  uint64_t magic;
+  PJRT_Error_Code code;
+  char message[512];
+};
+
+PJRT_Error_Destroy* g_real_destroy = nullptr;
+PJRT_Error_Message* g_real_message = nullptr;
+PJRT_Error_GetCode* g_real_getcode = nullptr;
+
+void WrappedDestroy(PJRT_Error_Destroy_Args* args) {
+  if (args && IsOurError(args->error)) {
+    delete reinterpret_cast<OurError*>(args->error);
+    args->error = nullptr;
+    return;
+  }
+  if (g_real_destroy) g_real_destroy(args);
+}
+
+void WrappedMessage(PJRT_Error_Message_Args* args) {
+  if (args && IsOurError(args->error)) {
+    const auto* err = reinterpret_cast<const OurError*>(args->error);
+    args->message = err->message;
+    args->message_size = strlen(err->message);
+    return;
+  }
+  if (g_real_message) g_real_message(args);
+}
+
+PJRT_Error* WrappedGetCode(PJRT_Error_GetCode_Args* args) {
+  if (args && IsOurError(args->error)) {
+    args->code = reinterpret_cast<const OurError*>(args->error)->code;
+    return nullptr;
+  }
+  return g_real_getcode ? g_real_getcode(args) : nullptr;
+}
+
+}  // namespace
+
+bool IsOurError(const PJRT_Error* err) {
+  if (!err) return false;
+  // Alignment: OurError is heap-allocated by us; reading 8 bytes of a real
+  // plugin error is safe only because real errors are also heap objects of
+  // at least pointer size; magic collision probability is negligible.
+  return reinterpret_cast<const OurError*>(err)->magic == kErrMagic;
+}
+
+PJRT_Error* MakeError(PJRT_Error_Code code, const char* fmt, ...) {
+  auto* err = new OurError();
+  err->magic = kErrMagic;
+  err->code = code;
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(err->message, sizeof(err->message), fmt, ap);
+  va_end(ap);
+  return reinterpret_cast<PJRT_Error*>(err);
+}
+
+bool ConsumeError(PJRT_Error* err) {
+  if (!err) return false;
+  if (IsOurError(err)) {
+    delete reinterpret_cast<OurError*>(err);
+    return true;
+  }
+  const PJRT_Api* api = State().real_api;
+  if (api && api->PJRT_Error_Destroy) {
+    PJRT_Error_Destroy_Args args;
+    memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    args.error = err;
+    api->PJRT_Error_Destroy(&args);
+  }
+  return true;
+}
+
+void WrapErrorEntries(PJRT_Api* api) {
+  g_real_destroy = api->PJRT_Error_Destroy;
+  g_real_message = api->PJRT_Error_Message;
+  g_real_getcode = api->PJRT_Error_GetCode;
+  api->PJRT_Error_Destroy = WrappedDestroy;
+  api->PJRT_Error_Message = WrappedMessage;
+  api->PJRT_Error_GetCode = WrappedGetCode;
+}
+
+}  // namespace vtpu

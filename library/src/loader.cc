@@ -1,0 +1,336 @@
+// loader.cc — GetPjrtApi entry, real-plugin dlopen, config load, atfork.
+//
+// Reference analogues: loader.c:1389-1424 (dlopen real driver),
+// loader.c:2483-2557 (load_controller_configuration: mmap vtpu.config or
+// synthesize from env), loader.c:2606-2668 (fork hygiene). The CUDA-side
+// dlsym/cuGetProcAddress machinery (loader.c:1066-1387) has no PJRT
+// equivalent because the plugin API is already one function table.
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cinttypes>
+
+#include "shim.h"
+
+namespace vtpu {
+
+int g_log_level = kLogWarn;
+Metrics g_metrics;
+
+void LogF(LogLevel level, const char* fmt, ...) {
+  static const char* names[] = {"E", "W", "I", "D"};
+  char buf[1024];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  fprintf(stderr, "[vtpu-control %s pid=%d] %s\n", names[level],
+          (int)getpid(), buf);
+}
+
+void Counter::Bump() {
+  uint64_t n = count.fetch_add(1, std::memory_order_relaxed) + 1;
+  if ((n & (n - 1)) == 0) {  // power of two: decimated logging
+    VTPU_LOG(kLogInfo, "counter %s = %" PRIu64, name, n);
+  }
+}
+
+uint64_t NowNs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+ShimState& State() {
+  static ShimState* s = new ShimState();
+  return *s;
+}
+
+// ---------------------------------------------------------------------------
+// Real plugin discovery
+// ---------------------------------------------------------------------------
+
+static void* OpenRealPlugin() {
+  const char* explicit_path = getenv("VTPU_REAL_TPU_LIBRARY_PATH");
+  const char* candidates[] = {
+      explicit_path,
+      "/lib/libtpu.so",
+      "/usr/lib/libtpu.so",
+      "libtpu.so",
+      nullptr,
+  };
+  for (const char* path : candidates) {
+    if (!path || !*path) continue;
+    void* handle = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+    if (handle) {
+      VTPU_LOG(kLogInfo, "real PJRT plugin: %s", path);
+      return handle;
+    }
+    VTPU_LOG(kLogDebug, "dlopen %s: %s", path, dlerror());
+  }
+  return nullptr;
+}
+
+const PJRT_Api* RealApi() { return State().real_api; }
+
+// ---------------------------------------------------------------------------
+// Config: mmap vtpu.config, else synthesize from env (reference
+// loader.c:2357-2481, env names util.c:14-25)
+// ---------------------------------------------------------------------------
+
+static bool LoadConfigFile(const char* path, VtpuConfig* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size != sizeof(VtpuConfig)) {
+    close(fd);
+    VTPU_LOG(kLogWarn, "config %s has wrong size", path);
+    return false;
+  }
+  void* mem = mmap(nullptr, sizeof(VtpuConfig), PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return false;
+  const auto* cfg = static_cast<const VtpuConfig*>(mem);
+  bool ok = cfg->magic == kConfigMagic && cfg->version == kConfigVersion &&
+            cfg->checksum ==
+                Fnv1a(static_cast<const uint8_t*>(mem),
+                      offsetof(VtpuConfig, checksum)) &&
+            cfg->device_count >= 0 && cfg->device_count <= kMaxDeviceCount;
+  if (ok) *out = *cfg;
+  munmap(mem, sizeof(VtpuConfig));
+  if (!ok) VTPU_LOG(kLogError, "config %s failed validation", path);
+  return ok;
+}
+
+static long EnvLong(const char* base, int idx, long fallback) {
+  char name[128];
+  snprintf(name, sizeof(name), "%s_%d", base, idx);
+  const char* v = getenv(name);
+  if (!v) v = getenv(base);  // un-indexed applies to all devices
+  if (!v) return fallback;
+  return strtol(v, nullptr, 10);
+}
+
+static bool SynthesizeFromEnv(VtpuConfig* out) {
+  // Without VTPU_MEM_LIMIT*/VTPU_CORE_LIMIT* there is nothing to enforce.
+  bool any = getenv("VTPU_MEM_LIMIT") || getenv("VTPU_MEM_LIMIT_0") ||
+             getenv("VTPU_CORE_LIMIT") || getenv("VTPU_CORE_LIMIT_0");
+  if (!any) return false;
+  memset(out, 0, sizeof(*out));
+  out->magic = kConfigMagic;
+  out->version = kConfigVersion;
+  const char* visible = getenv("MANAGER_VISIBLE_DEVICES");
+  int count = 0;
+  if (visible && *visible) {
+    // comma-separated host indices; position = local ordinal
+    char tmp[256];
+    snprintf(tmp, sizeof(tmp), "%s", visible);
+    for (char* tok = strtok(tmp, ","); tok && count < kMaxDeviceCount;
+         tok = strtok(nullptr, ",")) {
+      out->devices[count].host_index = atoi(tok);
+      count++;
+    }
+  } else {
+    count = 1;
+    out->devices[0].host_index = 0;
+  }
+  for (int i = 0; i < count; i++) {
+    VtpuDevice& d = out->devices[i];
+    snprintf(d.uuid, sizeof(d.uuid), "env-%d", d.host_index);
+    long mem = EnvLong("VTPU_MEM_LIMIT", i, 0);
+    long core = EnvLong("VTPU_CORE_LIMIT", i, 0);
+    long soft = EnvLong("VTPU_CORE_SOFT_LIMIT", i, core);
+    long ratio = EnvLong("VTPU_MEM_RATIO", i, 100);
+    char oname[64];
+    snprintf(oname, sizeof(oname), "VTPU_MEM_OVERSOLD_%d", i);
+    const char* ov = getenv(oname);
+    if (!ov) ov = getenv("VTPU_MEM_OVERSOLD");
+    d.memory_limit = mem > 0;
+    d.total_memory = (uint64_t)(mem > 0 ? mem : 0);
+    d.real_memory = d.total_memory > 0 ? d.total_memory * 100 / ratio : 0;
+    d.hard_core = (int32_t)core;
+    d.soft_core = (int32_t)soft;
+    d.core_limit = core <= 0       ? kCoreLimitNone
+                   : (soft > core) ? kCoreLimitSoft
+                                   : kCoreLimitHard;
+    d.memory_oversold = ov && strcmp(ov, "true") == 0;
+  }
+  out->device_count = count;
+  const char* compat = getenv("MANAGER_COMPATIBILITY_MODE");
+  out->compat_mode = compat ? atoi(compat) : kCompatHost;
+  return true;
+}
+
+bool LoadConfig() {
+  ShimState& s = State();
+  if (getenv("DISABLE_VTPU_CONTROL")) {
+    VTPU_LOG(kLogInfo, "enforcement disabled by DISABLE_VTPU_CONTROL");
+    return false;
+  }
+  const char* path = getenv("VTPU_CONFIG_PATH");
+  char fallback[] = "/etc/vtpu-manager/config/vtpu.config";
+  if (!path) path = fallback;
+  bool ok = LoadConfigFile(path, &s.config);
+  if (!ok) ok = SynthesizeFromEnv(&s.config);
+  if (!ok) return false;
+  s.device_count = s.config.device_count;
+  for (int i = 0; i < kMaxDeviceCount; i++) s.slot_by_ordinal[i] = -1;
+  for (int i = 0; i < s.device_count && i < kMaxDeviceCount; i++) {
+    s.slot_by_ordinal[i] = i;  // local ordinal i == i-th visible device
+  }
+  for (int i = 0; i < s.device_count; i++) {
+    const VtpuDevice& d = s.config.devices[i];
+    VTPU_LOG(kLogInfo,
+             "device[%d] uuid=%s host=%d cap=%" PRIu64 "MiB core=%d..%d "
+             "limit=%d oversold=%d",
+             i, d.uuid, d.host_index, d.total_memory >> 20, d.hard_core,
+             d.soft_core, d.core_limit, d.memory_oversold);
+  }
+  return true;
+}
+
+// Map tc_util external watcher feed if present (readonly).
+static void MapTcUtil() {
+  const char* path = getenv("VTPU_TC_UTIL_PATH");
+  char fallback[] = "/etc/vtpu-manager/watcher/tc_util.config";
+  if (!path) path = fallback;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size != sizeof(TcUtilFile)) {
+    close(fd);
+    return;
+  }
+  void* mem = mmap(nullptr, sizeof(TcUtilFile), PROT_READ, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return;
+  const auto* f = static_cast<const TcUtilFile*>(mem);
+  if (f->magic != kTcUtilMagic) {
+    munmap(mem, sizeof(TcUtilFile));
+    return;
+  }
+  State().tc_file = f;
+  VTPU_LOG(kLogInfo, "external watcher feed mapped: %s", path);
+}
+
+// ---------------------------------------------------------------------------
+// Device -> slot mapping
+// ---------------------------------------------------------------------------
+
+int SlotForDevice(PJRT_Device* device) {
+  ShimState& s = State();
+  if (!s.enforce || !device) return -1;
+  const PJRT_Api* api = s.real_api;
+  PJRT_Device_GetDescription_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+  dargs.device = device;
+  if (ConsumeError(api->PJRT_Device_GetDescription(&dargs))) return -1;
+  PJRT_DeviceDescription_Id_Args iargs;
+  memset(&iargs, 0, sizeof(iargs));
+  iargs.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
+  iargs.device_description = dargs.device_description;
+  if (ConsumeError(api->PJRT_DeviceDescription_Id(&iargs))) return -1;
+  // Inside the container the runtime only sees the chips the plugin granted
+  // (TPU_VISIBLE_DEVICES), so local ids start at 0 in visibility order —
+  // the same order MANAGER_VISIBLE_DEVICES / config.devices uses.
+  int ordinal = iargs.id;
+  if (ordinal < 0 || ordinal >= kMaxDeviceCount) return -1;
+  return s.slot_by_ordinal[ordinal];
+}
+
+const VtpuDevice* DeviceCfg(int slot) {
+  ShimState& s = State();
+  if (slot < 0 || slot >= s.device_count) return nullptr;
+  return &s.config.devices[slot];
+}
+
+// ---------------------------------------------------------------------------
+// fork hygiene (reference: child_after_fork cuda_hook.c:190,
+// loader_child_after_fork loader.c:2606)
+// ---------------------------------------------------------------------------
+
+static void ChildAfterFork() {
+  ShimState& s = State();
+  // Mutexes may be held by threads that do not exist in the child; the
+  // watcher thread is gone. Reset hot state the child cannot have inherited
+  // meaningfully and let the watcher restart lazily.
+  new (&s.buffers_mu) std::mutex();
+  new (&s.cost_mu) std::mutex();
+  for (int i = 0; i < kMaxDeviceCount; i++) {
+    s.hot[i].inflight.store(0);
+    s.hot[i].busy_ns_window.store(0);
+  }
+  extern void ResetWatcherForFork();
+  ResetWatcherForFork();
+}
+
+// ---------------------------------------------------------------------------
+// Entry: GetPjrtApi
+// ---------------------------------------------------------------------------
+
+static pthread_once_t g_init_once = PTHREAD_ONCE_INIT;
+static const PJRT_Api* g_exported_api = nullptr;
+
+static void InitOnce() {
+  const char* lvl = getenv("VTPU_LOGGER_LEVEL");
+  if (lvl) g_log_level = atoi(lvl);
+
+  void* handle = OpenRealPlugin();
+  if (!handle) {
+    VTPU_LOG(kLogError,
+             "cannot locate real TPU plugin (set "
+             "VTPU_REAL_TPU_LIBRARY_PATH); passing through nullptr");
+    return;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api = (GetApiFn)dlsym(handle, "GetPjrtApi");
+  if (!get_api) {
+    VTPU_LOG(kLogError, "real plugin lacks GetPjrtApi: %s", dlerror());
+    return;
+  }
+  const PJRT_Api* real = get_api();
+  if (!real) return;
+  ShimState& s = State();
+  s.real_api = real;
+  // Copy as much of the table as both sides understand; the wrapped table
+  // advertises the real plugin's struct_size so callers negotiate features
+  // against what actually exists.
+  memset(&s.wrapped_api, 0, sizeof(s.wrapped_api));
+  size_t copy = real->struct_size < sizeof(PJRT_Api) ? real->struct_size
+                                                     : sizeof(PJRT_Api);
+  memcpy(&s.wrapped_api, real, copy);
+
+  s.enforce = LoadConfig();
+  if (s.enforce) {
+    MapTcUtil();
+    WrapErrorEntries(&s.wrapped_api);
+    WrapEnforcementEntries(&s.wrapped_api);
+    pthread_atfork(nullptr, nullptr, ChildAfterFork);
+    VTPU_LOG(kLogInfo, "enforcement active for %d device(s)",
+             s.device_count);
+  } else {
+    VTPU_LOG(kLogInfo, "no config: transparent pass-through");
+  }
+  g_exported_api = &s.wrapped_api;
+}
+
+extern "C" __attribute__((visibility("default"))) const PJRT_Api*
+GetPjrtApi() {
+  pthread_once(&g_init_once, InitOnce);
+  ShimState& s = State();
+  if (g_exported_api) return g_exported_api;
+  return s.real_api;  // may be nullptr if discovery failed
+}
+
+}  // namespace vtpu

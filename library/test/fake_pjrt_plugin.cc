@@ -1,0 +1,277 @@
+// fake_pjrt_plugin.cc — minimal in-memory PJRT plugin for shim tests.
+//
+// The hermetic stand-in for libtpu (the reference tests the CUDA hook
+// against real hardware; we additionally test against this fake so the
+// wrap/accounting/throttle logic runs in CI with no TPU — the analogue of
+// the Python fake-NVML device fixtures). Implements just enough of the
+// PJRT C API: one client, one device with a simulated HBM pool, host->device
+// buffers, and an Execute whose completion events become ready after a
+// configurable simulated duration (FAKE_EXEC_US, default 2000).
+
+#include <pthread.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Fake object model
+// ---------------------------------------------------------------------------
+
+struct FakeError {
+  std::string message;
+  PJRT_Error_Code code;
+};
+
+struct FakeDevice {
+  int id = 0;
+};
+
+struct FakeClient {
+  FakeDevice device;
+  PJRT_Device* device_ptr() {
+    return reinterpret_cast<PJRT_Device*>(&device);
+  }
+  std::atomic<int64_t> bytes_in_use{0};
+  int64_t bytes_limit = 1ll << 30;  // fake physical HBM
+};
+
+FakeClient* g_client = nullptr;
+
+struct FakeBuffer {
+  int64_t size;
+};
+
+struct FakeEvent {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool ready = false;
+  std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> callbacks;
+
+  void MarkReady() {
+    std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> cbs;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      ready = true;
+      cbs.swap(callbacks);
+      cv.notify_all();
+    }
+    for (auto& [cb, arg] : cbs) cb(nullptr, arg);
+  }
+};
+
+int64_t ExecUs() {
+  const char* v = getenv("FAKE_EXEC_US");
+  return v ? atol(v) : 2000;
+}
+
+int64_t OutBytes() {
+  const char* v = getenv("FAKE_OUT_BYTES");
+  return v ? atol(v) : 1024;
+}
+
+// Device busy simulation: executes serialize on the fake chip.
+std::mutex g_exec_mu;
+
+// ---------------------------------------------------------------------------
+// API implementations
+// ---------------------------------------------------------------------------
+
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<FakeError*>(args->error);
+}
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  const auto* err = reinterpret_cast<const FakeError*>(args->error);
+  args->message = err->message.c_str();
+  args->message_size = err->message.size();
+}
+
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = reinterpret_cast<const FakeError*>(args->error)->code;
+  return nullptr;
+}
+
+PJRT_Error* MakeFakeError(PJRT_Error_Code code, const char* msg) {
+  return reinterpret_cast<PJRT_Error*>(new FakeError{msg, code});
+}
+
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  if (!g_client) g_client = new FakeClient();
+  args->client = reinterpret_cast<PJRT_Client*>(g_client);
+  return nullptr;
+}
+
+PJRT_Error* ClientDevices(PJRT_Client_Devices_Args* args) {
+  static PJRT_Device* devs[1];
+  devs[0] = g_client->device_ptr();
+  args->devices = devs;
+  args->num_devices = 1;
+  return nullptr;
+}
+
+PJRT_Error* DeviceGetDescription(PJRT_Device_GetDescription_Args* args) {
+  args->device_description =
+      reinterpret_cast<PJRT_DeviceDescription*>(args->device);
+  return nullptr;
+}
+
+PJRT_Error* DeviceDescriptionId(PJRT_DeviceDescription_Id_Args* args) {
+  args->id =
+      reinterpret_cast<FakeDevice*>(args->device_description)->id;
+  return nullptr;
+}
+
+PJRT_Error* BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  int64_t elems = 1;
+  for (size_t i = 0; i < args->num_dims; i++) elems *= args->dims[i];
+  int64_t size = elems * 4;  // fake: assume 4-byte elements
+  auto* client = reinterpret_cast<FakeClient*>(args->client);
+  if (client->bytes_in_use.load() + size > client->bytes_limit) {
+    return MakeFakeError(PJRT_Error_Code_RESOURCE_EXHAUSTED,
+                         "fake plugin: physical OOM");
+  }
+  client->bytes_in_use.fetch_add(size);
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(new FakeBuffer{size});
+  auto* evt = new FakeEvent();
+  evt->MarkReady();  // host copy "completes" immediately
+  args->done_with_host_buffer = reinterpret_cast<PJRT_Event*>(evt);
+  return nullptr;
+}
+
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  auto* buf = reinterpret_cast<FakeBuffer*>(args->buffer);
+  if (g_client) g_client->bytes_in_use.fetch_sub(buf->size);
+  delete buf;
+  return nullptr;
+}
+
+PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
+  args->on_device_size_in_bytes =
+      (size_t)reinterpret_cast<FakeBuffer*>(args->buffer)->size;
+  return nullptr;
+}
+
+PJRT_Error* DeviceMemoryStats(PJRT_Device_MemoryStats_Args* args) {
+  args->bytes_in_use = g_client ? g_client->bytes_in_use.load() : 0;
+  args->bytes_limit = g_client ? g_client->bytes_limit : 0;
+  args->bytes_limit_is_set = true;
+  return nullptr;
+}
+
+PJRT_Error* EventOnReady(PJRT_Event_OnReady_Args* args) {
+  auto* evt = reinterpret_cast<FakeEvent*>(args->event);
+  bool fire_now = false;
+  {
+    std::lock_guard<std::mutex> g(evt->mu);
+    if (evt->ready) {
+      fire_now = true;
+    } else {
+      evt->callbacks.emplace_back(args->callback, args->user_arg);
+    }
+  }
+  if (fire_now) args->callback(nullptr, args->user_arg);
+  return nullptr;
+}
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
+  // leak-free would need refcounting; tests tolerate the tiny leak
+  (void)args;
+  return nullptr;
+}
+
+PJRT_Error* EventAwait(PJRT_Event_Await_Args* args) {
+  auto* evt = reinterpret_cast<FakeEvent*>(args->event);
+  std::unique_lock<std::mutex> g(evt->mu);
+  evt->cv.wait(g, [&] { return evt->ready; });
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableGetExecutable(
+    PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable =
+      reinterpret_cast<PJRT_Executable*>(args->loaded_executable);
+  return nullptr;
+}
+
+PJRT_Error* ExecutableNumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = 1;
+  return nullptr;
+}
+
+PJRT_Error* ExecutableDestroy(PJRT_Executable_Destroy_Args* args) {
+  (void)args;  // fake executables are caller-fabricated opaque pointers
+  return nullptr;
+}
+
+PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  int64_t dur = ExecUs();
+  // Simulate a serialized device: each execute occupies the chip for `dur`.
+  for (size_t d = 0; d < args->num_devices; d++) {
+    if (args->output_lists && args->output_lists[d]) {
+      args->output_lists[d][0] =
+          reinterpret_cast<PJRT_Buffer*>(new FakeBuffer{OutBytes()});
+      if (g_client) g_client->bytes_in_use.fetch_add(OutBytes());
+    }
+    if (args->device_complete_events) {
+      auto* evt = new FakeEvent();
+      args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(evt);
+      std::thread([evt, dur] {
+        std::lock_guard<std::mutex> g(g_exec_mu);  // device serialization
+        usleep((useconds_t)dur);
+        evt->MarkReady();
+      }).detach();
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Api g_api;
+pthread_once_t g_once = PTHREAD_ONCE_INIT;
+
+void InitApi() {
+  memset(&g_api, 0, sizeof(g_api));
+  g_api.struct_size = sizeof(PJRT_Api);
+  g_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  g_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  g_api.PJRT_Error_Destroy = ErrorDestroy;
+  g_api.PJRT_Error_Message = ErrorMessage;
+  g_api.PJRT_Error_GetCode = ErrorGetCode;
+  g_api.PJRT_Plugin_Initialize = PluginInitialize;
+  g_api.PJRT_Client_Create = ClientCreate;
+  g_api.PJRT_Client_Devices = ClientDevices;
+  g_api.PJRT_Device_GetDescription = DeviceGetDescription;
+  g_api.PJRT_DeviceDescription_Id = DeviceDescriptionId;
+  g_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+  g_api.PJRT_Buffer_Destroy = BufferDestroy;
+  g_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
+  g_api.PJRT_Device_MemoryStats = DeviceMemoryStats;
+  g_api.PJRT_Event_OnReady = EventOnReady;
+  g_api.PJRT_Event_Destroy = EventDestroy;
+  g_api.PJRT_Event_Await = EventAwait;
+  g_api.PJRT_LoadedExecutable_GetExecutable = LoadedExecutableGetExecutable;
+  g_api.PJRT_Executable_NumOutputs = ExecutableNumOutputs;
+  g_api.PJRT_Executable_Destroy = ExecutableDestroy;
+  g_api.PJRT_LoadedExecutable_Execute = Execute;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  pthread_once(&g_once, InitApi);
+  return &g_api;
+}

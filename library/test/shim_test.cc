@@ -1,0 +1,194 @@
+// shim_test.cc — drives libvtpu-control.so against the fake PJRT plugin.
+//
+// Hermetic equivalent of the reference's on-GPU harness (library/test/
+// run_all_tests.sh): env-configured caps, real dlopen of the shim, PASS/FAIL
+// per scenario with rc!=0 on failure.
+//
+// Env contract (set by the pytest wrapper):
+//   SHIM_PATH                      — path to libvtpu-control.so
+//   VTPU_REAL_TPU_LIBRARY_PATH     — path to libfake-pjrt.so
+//   VTPU_MEM_LIMIT_0=1048576       — 1 MiB HBM cap
+//   VTPU_CORE_LIMIT_0=50           — 50% core quota (phase 2 only)
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+static int g_failures = 0;
+
+#define CHECK(cond, ...)                              \
+  do {                                                \
+    if (!(cond)) {                                    \
+      fprintf(stderr, "FAIL %s:%d: ", __FILE__, __LINE__); \
+      fprintf(stderr, __VA_ARGS__);                   \
+      fprintf(stderr, "\n");                          \
+      g_failures++;                                   \
+    }                                                 \
+  } while (0)
+
+static uint64_t NowMs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+static PJRT_Buffer* Alloc(const PJRT_Api* api, PJRT_Client* client,
+                          PJRT_Device* dev, int64_t elems,
+                          PJRT_Error** err_out) {
+  PJRT_Client_BufferFromHostBuffer_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  args.client = client;
+  static float data[1];
+  args.data = data;
+  args.type = PJRT_Buffer_Type_F32;
+  int64_t dims[1] = {elems};
+  args.dims = dims;
+  args.num_dims = 1;
+  args.device = dev;
+  *err_out = api->PJRT_Client_BufferFromHostBuffer(&args);
+  return args.buffer;
+}
+
+static void Destroy(const PJRT_Api* api, PJRT_Buffer* buf) {
+  PJRT_Buffer_Destroy_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  args.buffer = buf;
+  PJRT_Error* err = api->PJRT_Buffer_Destroy(&args);
+  CHECK(!err, "destroy errored");
+}
+
+static void CheckErrorIsOom(const PJRT_Api* api, PJRT_Error* err) {
+  CHECK(err != nullptr, "expected OOM error");
+  if (!err) return;
+  PJRT_Error_GetCode_Args cargs;
+  memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  cargs.error = err;
+  CHECK(!api->PJRT_Error_GetCode(&cargs), "GetCode failed");
+  CHECK(cargs.code == PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "code=%d want RESOURCE_EXHAUSTED", (int)cargs.code);
+  PJRT_Error_Message_Args margs;
+  memset(&margs, 0, sizeof(margs));
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = err;
+  api->PJRT_Error_Message(&margs);
+  CHECK(margs.message && strstr(margs.message, "HBM cap"),
+        "message lacks 'HBM cap': %.*s", (int)margs.message_size,
+        margs.message);
+  printf("  OOM message: %.*s\n", (int)margs.message_size, margs.message);
+  PJRT_Error_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = err;
+  api->PJRT_Error_Destroy(&dargs);
+}
+
+int main() {
+  const char* shim_path = getenv("SHIM_PATH");
+  if (!shim_path) {
+    fprintf(stderr, "SHIM_PATH not set\n");
+    return 2;
+  }
+  void* handle = dlopen(shim_path, RTLD_NOW | RTLD_LOCAL);
+  if (!handle) {
+    fprintf(stderr, "dlopen(%s): %s\n", shim_path, dlerror());
+    return 2;
+  }
+  auto get_api = (const PJRT_Api* (*)())dlsym(handle, "GetPjrtApi");
+  CHECK(get_api, "shim lacks GetPjrtApi");
+  const PJRT_Api* api = get_api();
+  CHECK(api, "GetPjrtApi returned null (fake plugin not found?)");
+  if (!api) return 2;
+
+  PJRT_Client_Create_Args cargs;
+  memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(!api->PJRT_Client_Create(&cargs), "client create failed");
+  PJRT_Client* client = cargs.client;
+
+  PJRT_Client_Devices_Args devargs;
+  memset(&devargs, 0, sizeof(devargs));
+  devargs.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  devargs.client = client;
+  CHECK(!api->PJRT_Client_Devices(&devargs), "devices failed");
+  CHECK(devargs.num_devices == 1, "ndev=%zu", devargs.num_devices);
+  PJRT_Device* dev = devargs.devices[0];
+
+  // --------------------------------------------------------------- memory
+  printf("[1] HBM cap enforcement (cap=1MiB)\n");
+  PJRT_Error* err = nullptr;
+  PJRT_Buffer* bufs[3];
+  for (int i = 0; i < 3; i++) {
+    bufs[i] = Alloc(api, client, dev, 65536, &err);  // 256 KiB each
+    CHECK(!err && bufs[i], "alloc %d should fit", i);
+  }
+  // 768 KiB used; 512 KiB more would exceed the 1 MiB cap
+  PJRT_Buffer* over = Alloc(api, client, dev, 131072, &err);
+  CHECK(over == nullptr || err != nullptr, "overcap alloc must fail");
+  CheckErrorIsOom(api, err);
+  // free one (back to 512 KiB) and retry: fits now
+  Destroy(api, bufs[0]);
+  PJRT_Buffer* retry = Alloc(api, client, dev, 131072, &err);
+  CHECK(!err && retry, "alloc after free should fit");
+  printf("[1] PASS\n");
+
+  // ----------------------------------------------------------- view faking
+  printf("[2] MemoryStats view faking\n");
+  PJRT_Device_MemoryStats_Args margs;
+  memset(&margs, 0, sizeof(margs));
+  margs.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  margs.device = dev;
+  CHECK(!api->PJRT_Device_MemoryStats(&margs), "memstats failed");
+  CHECK(margs.bytes_limit == 1048576,
+        "bytes_limit=%lld want 1 MiB (cap), not the fake's 1 GiB",
+        (long long)margs.bytes_limit);
+  // live buffers here: bufs[1], bufs[2] (256 KiB each) + retry (512 KiB)
+  CHECK(margs.bytes_in_use == 2 * 262144 + 524288,
+        "bytes_in_use=%lld want 1048576", (long long)margs.bytes_in_use);
+  printf("[2] PASS\n");
+
+  // ------------------------------------------------------------- throttle
+  printf("[3] core-quota throttling (limit=50%%, 50 x 2ms programs)\n");
+  auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
+  uint64_t t0 = NowMs();
+  for (int i = 0; i < 50; i++) {
+    PJRT_LoadedExecutable_Execute_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    eargs.executable = fake_exe;
+    eargs.num_devices = 1;
+    eargs.num_args = 0;
+    PJRT_Buffer* outs[1] = {nullptr};
+    PJRT_Buffer** outlists[1] = {outs};
+    eargs.output_lists = outlists;
+    PJRT_Event* events[1] = {nullptr};
+    eargs.device_complete_events = events;
+    err = api->PJRT_LoadedExecutable_Execute(&eargs);
+    CHECK(!err, "execute %d errored", i);
+    // wait for completion like a sync step loop
+    if (events[0]) {
+      PJRT_Event_Await_Args aargs;
+      memset(&aargs, 0, sizeof(aargs));
+      aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+      aargs.event = events[0];
+      api->PJRT_Event_Await(&aargs);
+    }
+    if (outs[0]) Destroy(api, outs[0]);
+  }
+  uint64_t wall = NowMs() - t0;
+  printf("  busy=100ms wall=%llums (quota 50%% => expect >= ~160ms)\n",
+         (unsigned long long)wall);
+  CHECK(wall >= 150, "not throttled: wall=%llu", (unsigned long long)wall);
+  CHECK(wall <= 5000, "over-throttled/wedged: wall=%llu",
+        (unsigned long long)wall);
+  printf("[3] PASS\n");
+
+  printf(g_failures ? "FAILURES: %d\n" : "ALL PASS\n", g_failures);
+  return g_failures ? 1 : 0;
+}

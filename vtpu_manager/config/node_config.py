@@ -59,7 +59,12 @@ def _apply(cfg: NodeConfig, section: dict) -> None:
         if yaml_key in section:
             value = section[yaml_key]
             if attr == "exclude_devices":
-                value = tuple(str(v) for v in value)
+                # a scalar ("10") must become ("10",), never iterate its
+                # characters into ("1", "0")
+                if isinstance(value, (str, int)):
+                    value = (str(value),)
+                else:
+                    value = tuple(str(v) for v in value)
             setattr(cfg, attr, value)
 
 
