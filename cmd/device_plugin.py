@@ -1,0 +1,140 @@
+"""vtpu device-plugin: node agent binary.
+
+Reference: cmd/device-plugin (G1) — wires the device manager, the kubelet
+plugins (vtpu-number, optional cores/memory reporters), the node TC-util
+watcher, the reschedule controller, and node registration, all behind
+feature gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="vtpu device plugin")
+    parser.add_argument("--node-name",
+                        default=os.environ.get("NODE_NAME", ""))
+    parser.add_argument("--node-config")
+    parser.add_argument("--feature-gates", default="")
+    parser.add_argument("--plugin-dir",
+                        default="/var/lib/kubelet/device-plugins")
+    parser.add_argument("--base-dir", default=None)
+    parser.add_argument("--id-store",
+                        default="/etc/vtpu-manager/device_ids.json")
+    parser.add_argument("--fake-chips", type=int, default=0,
+                        help="use a fake discovery backend with N chips")
+    parser.add_argument("--fake-client", action="store_true")
+    parser.add_argument("--mesh-domain", default="")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    log = logging.getLogger("vtpu-device-plugin")
+
+    from vtpu_manager.config.node_config import (DeviceIDStore,
+                                                 load_node_config)
+    from vtpu_manager.controller.reschedule import RescheduleController
+    from vtpu_manager.deviceplugin.base import PluginServer
+    from vtpu_manager.deviceplugin.reporters import VcorePlugin, VmemPlugin
+    from vtpu_manager.deviceplugin.vnum import VnumPlugin
+    from vtpu_manager.manager.device_manager import DeviceManager
+    from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
+    from vtpu_manager.util import consts
+    from vtpu_manager.util.featuregates import (CORE_PLUGIN, MEMORY_PLUGIN,
+                                                RESCHEDULE, TC_WATCHER,
+                                                FeatureGates)
+
+    gates = FeatureGates()
+    gates.parse(args.feature_gates)
+
+    if not args.node_name:
+        log.error("--node-name or NODE_NAME required")
+        return 2
+
+    if args.fake_client:
+        from vtpu_manager.client.fake import FakeKubeClient
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": args.node_name,
+                                      "annotations": {}}})
+    else:
+        from vtpu_manager.client.kube import InClusterClient
+        client = InClusterClient()
+
+    node_config = load_node_config(args.node_config, args.node_name)
+    backends = None
+    if args.fake_chips:
+        from vtpu_manager.tpu.discovery import FakeBackend
+        backends = [FakeBackend(n_chips=args.fake_chips)]
+
+    manager = DeviceManager(
+        args.node_name, client, node_config=node_config,
+        id_store=DeviceIDStore(args.id_store), backends=backends,
+        mesh_domain=args.mesh_domain)
+    chips = manager.init_devices()
+    log.info("discovered %d chip(s): %s", len(chips),
+             [c.uuid for c in chips])
+    manager.register_node()
+    manager.start_heartbeat()
+
+    servers = []
+    vnum = VnumPlugin(manager, client, args.node_name,
+                      node_config=node_config,
+                      base_dir=args.base_dir or consts.MANAGER_BASE_DIR)
+    plugins = [vnum]
+    if gates.enabled(CORE_PLUGIN):
+        plugins.append(VcorePlugin(manager))
+    if gates.enabled(MEMORY_PLUGIN):
+        plugins.append(VmemPlugin(manager))
+    for plugin in plugins:
+        server = PluginServer(plugin, plugin_dir=args.plugin_dir)
+        server.serve()
+        try:
+            server.register()
+        except Exception:
+            log.warning("kubelet registration failed for %s (no kubelet?)",
+                        plugin.resource_name)
+        server.watch_kubelet_restarts()
+        servers.append(server)
+
+    watcher = None
+    if gates.enabled(TC_WATCHER):
+        watcher = TcWatcherDaemon([c.index for c in chips], FakeSampler())
+        watcher.start()
+
+    controller = None
+    if gates.enabled(RESCHEDULE):
+        controller = RescheduleController(
+            client, args.node_name,
+            known_uuids={c.uuid for c in chips})
+        controller.start()
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    log.info("vtpu-device-plugin running")
+    try:
+        while not stop:
+            time.sleep(1)
+    finally:
+        for server in servers:
+            server.stop()
+        if watcher:
+            watcher.stop()
+        if controller:
+            controller.stop()
+        manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

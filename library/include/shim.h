@@ -139,7 +139,7 @@ void RecordOwnBytes(int slot);        // publish to the ledger
 // throttle (in enforce.cc)
 void RateLimit(int slot, int64_t cost_us);
 void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
-                   uint64_t end_ns);
+                   uint64_t end_ns, bool measured = true);
 
 uint64_t NowNs();
 

@@ -37,6 +37,7 @@
 #include <chrono>
 #include <cinttypes>
 #include <condition_variable>
+#include <vector>
 
 #include "shim.h"
 
@@ -294,43 +295,78 @@ int64_t HostBufferBytes(const PJRT_Client_BufferFromHostBuffer_Args* args) {
   return elems * ElementBytes(args->type);
 }
 
+// Cached co-tenant usage, refreshed by the watcher tick — the alloc hot
+// path must not pay a cross-process flock + 1024-entry ledger scan (+ one
+// kill() per live entry) per buffer. The slow, exact scan still runs under
+// the device lock right before declaring OOM.
+std::atomic<int64_t> g_others_cache[kMaxDeviceCount];
+
+void RefreshOthersCache() {
+  ShimState& s = State();
+  for (int slot = 0; slot < s.device_count; slot++) {
+    g_others_cache[slot].store(OtherProcsBytes(slot),
+                               std::memory_order_relaxed);
+  }
+}
+
+void UpdatePeak(int slot, int64_t used) {
+  ShimState& s = State();
+  int64_t peak = s.hot[slot].peak_bytes.load();
+  while (used > peak &&
+         !s.hot[slot].peak_bytes.compare_exchange_weak(peak, used)) {
+  }
+}
+
+// Reserve-then-call: the cap check and the charge are one atomic step (a
+// check-then-charge split would let two concurrent allocations both pass
+// the check and land past the cap). Fast path uses atomics + cached
+// co-tenant bytes; only when that sum would exceed the cap do we take the
+// device lock and redo the check with a fresh ledger scan.
+PJRT_Error* ReserveMemory(int slot, int64_t bytes) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!cfg || !cfg->memory_limit || bytes <= 0) return nullptr;
+  ShimState& s = State();
+  int64_t cap = (int64_t)cfg->total_memory;
+  int64_t own = s.hot[slot].used_bytes.fetch_add(
+                    bytes, std::memory_order_relaxed) + bytes;
+  int64_t others = g_others_cache[slot].load(std::memory_order_relaxed);
+  if (own + others <= cap) {
+    UpdatePeak(slot, own);
+    return nullptr;
+  }
+  // Slow path: exact co-tenant view under the cross-process lock.
+  DeviceLock lock(cfg->host_index);
+  others = OtherProcsBytes(slot);
+  g_others_cache[slot].store(others, std::memory_order_relaxed);
+  if (own + others <= cap) {
+    UpdatePeak(slot, own);
+    return nullptr;
+  }
+  s.hot[slot].used_bytes.fetch_sub(bytes, std::memory_order_relaxed);
+  g_metrics.oom_rejected.Bump();
+  return MakeError(
+      PJRT_Error_Code_RESOURCE_EXHAUSTED,
+      "vtpu-control: HBM cap exceeded on device %d: "
+      "req=%" PRId64 "B used=%" PRId64 "B co-tenants=%" PRId64
+      "B cap=%" PRId64 "B",
+      cfg->host_index, bytes, own - bytes, others, cap);
+}
+
+void UnreserveMemory(int slot, int64_t bytes) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!cfg || !cfg->memory_limit || bytes <= 0) return;
+  State().hot[slot].used_bytes.fetch_sub(bytes, std::memory_order_relaxed);
+}
+
+// Record an already-reserved buffer for destroy-time credit.
 void TrackBuffer(PJRT_Buffer* buf, int slot, int64_t bytes) {
   ShimState& s = State();
   {
     std::lock_guard<std::mutex> g(s.buffers_mu);
     s.buffers[buf] = {slot, bytes};
   }
-  int64_t used = s.hot[slot].used_bytes.fetch_add(bytes) + bytes;
-  int64_t peak = s.hot[slot].peak_bytes.load();
-  while (used > peak &&
-         !s.hot[slot].peak_bytes.compare_exchange_weak(peak, used)) {
-  }
   RecordOwnBytes(slot);
   g_metrics.mem_charged.Bump();
-}
-
-// The alloc-path gate (reference MEMORY_PATH_OOM, cuda_hook.c:290-298):
-// under the cross-process device lock, own + co-tenant + request vs cap.
-PJRT_Error* CheckMemoryFits(int slot, int64_t bytes) {
-  const VtpuDevice* cfg = DeviceCfg(slot);
-  if (!cfg || !cfg->memory_limit) return nullptr;
-  ShimState& s = State();
-  DeviceLock lock(cfg->host_index);
-  // lock.held()==false after timeout: proceed unsynchronized rather than
-  // deadlock the app; the cap check still runs on our own view.
-  int64_t own = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
-  int64_t others = OtherProcsBytes(slot);
-  int64_t cap = (int64_t)cfg->total_memory;
-  if (own + others + bytes > cap) {
-    g_metrics.oom_rejected.Bump();
-    return MakeError(
-        PJRT_Error_Code_RESOURCE_EXHAUSTED,
-        "vtpu-control: HBM cap exceeded on device %d: "
-        "req=%" PRId64 "B used=%" PRId64 "B co-tenants=%" PRId64
-        "B cap=%" PRId64 "B",
-        cfg->host_index, bytes, own, others, cap);
-  }
-  return nullptr;
 }
 
 PJRT_Error* WrappedBufferFromHostBuffer(
@@ -338,10 +374,14 @@ PJRT_Error* WrappedBufferFromHostBuffer(
   int slot = SlotForDevice(args->device);
   if (slot < 0) return g_real_bfhb(args);
   int64_t bytes = HostBufferBytes(args);
-  if (PJRT_Error* err = CheckMemoryFits(slot, bytes)) return err;
+  if (PJRT_Error* err = ReserveMemory(slot, bytes)) return err;
   PJRT_Error* err = g_real_bfhb(args);
-  if (!err && args->buffer) TrackBuffer(args->buffer, slot, bytes);
-  return err;
+  if (err || !args->buffer) {
+    UnreserveMemory(slot, bytes);
+    return err;
+  }
+  TrackBuffer(args->buffer, slot, bytes);
+  return nullptr;
 }
 
 PJRT_Error* WrappedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
@@ -578,6 +618,7 @@ void WatcherTick(int64_t window_ns) {
     s.hot[slot].tokens_us.store(next, std::memory_order_relaxed);
     s.hot[slot].throttled_since_watch.store(false);
   }
+  RefreshOthersCache();
   g_metrics.watcher_ticks.Bump();
 }
 
@@ -674,13 +715,15 @@ void RateLimit(int slot, int64_t cost_us) {
 }
 
 void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
-                   uint64_t end_ns) {
+                   uint64_t end_ns, bool measured) {
   ShimState& s = State();
   if (slot < 0 || slot >= s.device_count) return;
   if (end_ns < start_ns) end_ns = start_ns;
   g_metrics.exec_done.Bump();
   if (exe) {
     s.hot[slot].inflight.fetch_sub(1, std::memory_order_relaxed);
+  }
+  if (exe && measured) {
     // Cost EMA uses the raw duration (coverage clamping below is about
     // busy accounting, not per-program cost).
     int64_t raw_us = (int64_t)((end_ns - start_ns) / 1000);
@@ -944,18 +987,24 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   } else if (s.device_count > 0) {
     first_slot = 0;
   }
+  ExecFacts facts{};
+  std::vector<int> reserved_slots;
   if (first_slot >= 0) {
     // Pre-execute HBM admission: outputs + scratch of this program are the
     // allocations the execute will make; refuse before the device sees it
-    // (the path jnp.ones()-style on-device materialization takes).
-    ExecFacts facts = ExecFactsCached(args->executable);
+    // (the path jnp.ones()-style on-device materialization takes). The
+    // reservation is reconciled against exact output sizes post-execute.
+    facts = ExecFactsCached(args->executable);
     size_t ndev = args->execute_device ? 1 : args->num_devices;
     if (facts.gate_bytes > 0) {
       for (size_t d = 0; d < ndev; d++) {
         int slot = args->execute_device ? first_slot : (int)d;
         if (slot >= s.device_count) continue;
-        if (PJRT_Error* err = CheckMemoryFits(slot, facts.gate_bytes))
+        if (PJRT_Error* err = ReserveMemory(slot, facts.gate_bytes)) {
+          for (int r : reserved_slots) UnreserveMemory(r, facts.gate_bytes);
           return err;
+        }
+        reserved_slots.push_back(slot);
       }
     }
     int64_t cost = ExecCost(args->executable);
@@ -967,7 +1016,10 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   }
   uint64_t start = NowNs();
   PJRT_Error* err = g_real_execute(args);
-  if (err || first_slot < 0) return err;
+  if (err || first_slot < 0) {
+    for (int r : reserved_slots) UnreserveMemory(r, facts.gate_bytes);
+    return err;
+  }
 
   size_t ndev = args->execute_device ? 1 : args->num_devices;
   size_t num_outputs = ExecFactsCached(args->executable).num_outputs;
@@ -975,8 +1027,10 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
     int slot = args->execute_device ? first_slot : (int)d;
     if (slot >= s.device_count) continue;
     s.hot[slot].inflight.fetch_add(1, std::memory_order_relaxed);
-    // Charge execute outputs so allocation pressure is visible
-    // (outputs are the only device allocations Execute makes for us).
+    // Track outputs for destroy-time credit, then settle the reservation:
+    // charged = gate estimate, actual = live output bytes (scratch is
+    // transient), so adjust used by (actual - gate).
+    int64_t tracked = 0;
     if (args->output_lists && args->output_lists[d]) {
       for (size_t o = 0; o < num_outputs; o++) {
         PJRT_Buffer* buf = args->output_lists[d][o];
@@ -987,8 +1041,19 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
         bargs.buffer = buf;
         if (ConsumeError(s.real_api->PJRT_Buffer_OnDeviceSizeInBytes(&bargs)))
           continue;
-        TrackBuffer(buf, slot, (int64_t)bargs.on_device_size_in_bytes);
+        int64_t bytes = (int64_t)bargs.on_device_size_in_bytes;
+        TrackBuffer(buf, slot, bytes);
+        tracked += bytes;
       }
+    }
+    if (facts.gate_bytes > 0 &&
+        std::find(reserved_slots.begin(), reserved_slots.end(), slot) !=
+            reserved_slots.end()) {
+      s.hot[slot].used_bytes.fetch_add(tracked - facts.gate_bytes,
+                                       std::memory_order_relaxed);
+    } else if (tracked > 0) {
+      s.hot[slot].used_bytes.fetch_add(tracked,
+                                       std::memory_order_relaxed);
     }
     // Completion timing: our own ReadyEvent awaited on a dedicated thread.
     // (Caller-provided device_complete_events are NOT used: some PJRT
@@ -1000,8 +1065,11 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
                               args->executable, start);
     }
     if (!timed) {
+      // Synthesized end time: keeps busy accounting alive but must NOT
+      // feed the cost EMA (it would echo the current estimate forever).
       OnExecuteDone(slot, args->executable, start,
-                    start + (uint64_t)ExecCost(args->executable) * 1000);
+                    start + (uint64_t)ExecCost(args->executable) * 1000,
+                    /*measured=*/false);
     }
   }
   return nullptr;
@@ -1066,7 +1134,7 @@ PJRT_Error* WrappedToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
     oargs.event = args->event;
     oargs.callback = TransferDoneCallback;
     oargs.user_arg = timing;
-    if (s.real_api->PJRT_Event_OnReady(&oargs)) delete timing;
+    if (ConsumeError(s.real_api->PJRT_Event_OnReady(&oargs))) delete timing;
   }
   return nullptr;
 }

@@ -151,6 +151,7 @@ static bool SynthesizeFromEnv(VtpuConfig* out) {
     long core = EnvLong("VTPU_CORE_LIMIT", i, 0);
     long soft = EnvLong("VTPU_CORE_SOFT_LIMIT", i, core);
     long ratio = EnvLong("VTPU_MEM_RATIO", i, 100);
+    if (ratio <= 0) ratio = 100;  // bad/0 env value must not SIGFPE init
     char oname[64];
     snprintf(oname, sizeof(oname), "VTPU_MEM_OVERSOLD_%d", i);
     const char* ov = getenv(oname);

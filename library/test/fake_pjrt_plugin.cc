@@ -49,8 +49,10 @@ struct FakeClient {
 
 FakeClient* g_client = nullptr;
 
+struct FakeEvent;
 struct FakeBuffer {
   int64_t size;
+  FakeEvent* ready = nullptr;  // fires when the producing exec completes
 };
 
 struct FakeEvent {
@@ -144,10 +146,23 @@ PJRT_Error* BufferFromHostBuffer(
                          "fake plugin: physical OOM");
   }
   client->bytes_in_use.fetch_add(size);
-  args->buffer = reinterpret_cast<PJRT_Buffer*>(new FakeBuffer{size});
+  auto* buf = new FakeBuffer{size};
+  buf->ready = new FakeEvent();
+  buf->ready->MarkReady();  // host upload: ready immediately
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
   auto* evt = new FakeEvent();
   evt->MarkReady();  // host copy "completes" immediately
   args->done_with_host_buffer = reinterpret_cast<PJRT_Event*>(evt);
+  return nullptr;
+}
+
+PJRT_Error* BufferReadyEvent(PJRT_Buffer_ReadyEvent_Args* args) {
+  auto* buf = reinterpret_cast<FakeBuffer*>(args->buffer);
+  if (!buf->ready) {
+    buf->ready = new FakeEvent();
+    buf->ready->MarkReady();
+  }
+  args->event = reinterpret_cast<PJRT_Event*>(buf->ready);
   return nullptr;
 }
 
@@ -220,20 +235,21 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   int64_t dur = ExecUs();
   // Simulate a serialized device: each execute occupies the chip for `dur`.
   for (size_t d = 0; d < args->num_devices; d++) {
+    FakeEvent* done = new FakeEvent();
     if (args->output_lists && args->output_lists[d]) {
-      args->output_lists[d][0] =
-          reinterpret_cast<PJRT_Buffer*>(new FakeBuffer{OutBytes()});
+      auto* out = new FakeBuffer{OutBytes()};
+      out->ready = done;  // output becomes ready when the exec completes
+      args->output_lists[d][0] = reinterpret_cast<PJRT_Buffer*>(out);
       if (g_client) g_client->bytes_in_use.fetch_add(OutBytes());
     }
     if (args->device_complete_events) {
-      auto* evt = new FakeEvent();
-      args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(evt);
-      std::thread([evt, dur] {
-        std::lock_guard<std::mutex> g(g_exec_mu);  // device serialization
-        usleep((useconds_t)dur);
-        evt->MarkReady();
-      }).detach();
+      args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(done);
     }
+    std::thread([done, dur] {
+      std::lock_guard<std::mutex> g(g_exec_mu);  // device serialization
+      usleep((useconds_t)dur);
+      done->MarkReady();
+    }).detach();
   }
   return nullptr;
 }
@@ -259,6 +275,7 @@ void InitApi() {
   g_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
   g_api.PJRT_Buffer_Destroy = BufferDestroy;
   g_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
+  g_api.PJRT_Buffer_ReadyEvent = BufferReadyEvent;
   g_api.PJRT_Device_MemoryStats = DeviceMemoryStats;
   g_api.PJRT_Event_OnReady = EventOnReady;
   g_api.PJRT_Event_Destroy = EventDestroy;

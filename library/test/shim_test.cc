@@ -89,7 +89,8 @@ static void CheckErrorIsOom(const PJRT_Api* api, PJRT_Error* err) {
   api->PJRT_Error_Destroy(&dargs);
 }
 
-int main() {
+int main(int argc, char** argv) {
+  bool throttle_only = argc > 1 && !strcmp(argv[1], "--throttle-only");
   const char* shim_path = getenv("SHIM_PATH");
   if (!shim_path) {
     fprintf(stderr, "SHIM_PATH not set\n");
@@ -120,9 +121,10 @@ int main() {
   CHECK(devargs.num_devices == 1, "ndev=%zu", devargs.num_devices);
   PJRT_Device* dev = devargs.devices[0];
 
+  PJRT_Error* err = nullptr;
+  if (!throttle_only) {
   // --------------------------------------------------------------- memory
   printf("[1] HBM cap enforcement (cap=1MiB)\n");
-  PJRT_Error* err = nullptr;
   PJRT_Buffer* bufs[3];
   for (int i = 0; i < 3; i++) {
     bufs[i] = Alloc(api, client, dev, 65536, &err);  // 256 KiB each
@@ -153,11 +155,15 @@ int main() {
         "bytes_in_use=%lld want 1048576", (long long)margs.bytes_in_use);
   printf("[2] PASS\n");
 
+  }
   // ------------------------------------------------------------- throttle
-  printf("[3] core-quota throttling (limit=50%%, 50 x 2ms programs)\n");
+  printf("[3] core-quota throttling (50 x simulated programs)\n");
+  {
   auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
+  const char* iters_env = getenv("SHIM_TEST_ITERS");
+  int iters = iters_env ? atoi(iters_env) : 50;
   uint64_t t0 = NowMs();
-  for (int i = 0; i < 50; i++) {
+  for (int i = 0; i < iters; i++) {
     PJRT_LoadedExecutable_Execute_Args eargs;
     memset(&eargs, 0, sizeof(eargs));
     eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
@@ -182,12 +188,16 @@ int main() {
     if (outs[0]) Destroy(api, outs[0]);
   }
   uint64_t wall = NowMs() - t0;
-  printf("  busy=100ms wall=%llums (quota 50%% => expect >= ~160ms)\n",
+  printf("  iters=%d busy=%dms wall=%llums\n", iters, iters * 2,
          (unsigned long long)wall);
-  CHECK(wall >= 150, "not throttled: wall=%llu", (unsigned long long)wall);
-  CHECK(wall <= 5000, "over-throttled/wedged: wall=%llu",
-        (unsigned long long)wall);
-  printf("[3] PASS\n");
+  if (!throttle_only) {
+    CHECK(wall >= 150, "not throttled: wall=%llu",
+          (unsigned long long)wall);
+    CHECK(wall <= 5000, "over-throttled/wedged: wall=%llu",
+          (unsigned long long)wall);
+    printf("[3] PASS\n");
+  }
+  }
 
   printf(g_failures ? "FAILURES: %d\n" : "ALL PASS\n", g_failures);
   return g_failures ? 1 : 0;

@@ -2,16 +2,29 @@
 
 Multi-chip hardware is unavailable in CI; sharding correctness is validated
 on XLA's host-platform virtual devices (the reference's analogous trick is
-fake-NVML device fixtures — SURVEY.md §4). Must run before jax imports.
+fake-NVML device fixtures — SURVEY.md §4).
+
+The environment may register a TPU tunnel PJRT plugin from sitecustomize
+*before* this file runs, and that registration overrides the platform
+selection through jax.config (so JAX_PLATFORMS=cpu in the env is not
+enough — backend init would wedge against the tunnel). Forcing the config
+value here wins over the ambient registration.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax  # noqa: E402  (must come after XLA_FLAGS is set)
+except ImportError:   # jax-free subsets (C++ shim tests) still run
+    jax = None
+if jax is not None:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,78 @@
+"""Reschedule + recovery controller."""
+
+import json
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.controller.reschedule import RescheduleController
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.util import consts
+
+
+def pod_on_node(name, node="node-1", phase="Running", annotations=None):
+    return {"metadata": {"name": name, "namespace": "default",
+                         "uid": f"uid-{name}",
+                         "annotations": annotations or {}},
+            "spec": {"nodeName": node, "containers": [{"name": "c"}]},
+            "status": {"phase": phase}}
+
+
+class TestReschedule:
+    def test_failed_allocation_evicted(self):
+        client = FakeKubeClient()
+        client.add_pod(pod_on_node("bad", annotations={
+            consts.allocation_status_annotation():
+                consts.ALLOC_STATUS_FAILED}))
+        client.add_pod(pod_on_node("good"))
+        ctl = RescheduleController(client, "node-1")
+        assert ctl.reconcile_once() == 1
+        assert ("default", "bad") in client.evictions
+        assert ("default", "good") not in client.evictions
+        assert client.events and client.events[0]["reason"] == \
+            "VtpuReschedule"
+
+    def test_finished_pods_ignored(self):
+        client = FakeKubeClient()
+        client.add_pod(pod_on_node("done", phase="Succeeded", annotations={
+            consts.allocation_status_annotation():
+                consts.ALLOC_STATUS_FAILED}))
+        ctl = RescheduleController(client, "node-1")
+        assert ctl.reconcile_once() == 0
+
+    def test_vanished_device_evicted(self):
+        client = FakeKubeClient()
+        claims = PodDeviceClaims()
+        claims.add("c", DeviceClaim("GONE-UUID", 0, 50, 2**30))
+        client.add_pod(pod_on_node("orphan", annotations={
+            consts.real_allocated_annotation(): claims.encode()}))
+        ctl = RescheduleController(client, "node-1",
+                                   known_uuids={"PRESENT-UUID"})
+        assert ctl.reconcile_once() == 1
+        assert ("default", "orphan") in client.evictions
+
+    def test_checkpoint_ghost_devices_evicted(self, tmp_path):
+        ckpt = tmp_path / "kubelet_internal_checkpoint"
+        ckpt.write_text(json.dumps({"Data": {"PodDeviceEntries": [{
+            "PodUID": "uid-ghost", "ContainerName": "c",
+            "ResourceName": consts.vtpu_number_resource(),
+            "DeviceIDs": {"0": ["OLD-UUID::0"]}}]}}))
+        client = FakeKubeClient()
+        client.add_pod(pod_on_node("ghost"))
+        ctl = RescheduleController(client, "node-1",
+                                   known_uuids={"NEW-UUID"},
+                                   checkpoint_path=str(ckpt))
+        assert ctl.reconcile_once() == 1
+
+    def test_eviction_falls_back_to_delete(self):
+        client = FakeKubeClient()
+
+        def failing_evict(ns, name):
+            from vtpu_manager.client.kube import KubeError
+            raise KubeError(429, "pdb")
+
+        client.evict_pod = failing_evict
+        client.add_pod(pod_on_node("bad", annotations={
+            consts.allocation_status_annotation():
+                consts.ALLOC_STATUS_FAILED}))
+        ctl = RescheduleController(client, "node-1")
+        assert ctl.reconcile_once() == 1
+        assert ("default", "bad") in client.deletions

@@ -1,0 +1,217 @@
+"""Device plugin: advertisement, preferred allocation, Allocate path.
+
+Mirrors the reference's plugin tests on fake devices: the kubelet is
+simulated by calling the servicer directly plus one real gRPC round trip
+over a unix socket (SURVEY.md §4).
+"""
+
+import os
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.node_config import NodeConfig
+from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+from vtpu_manager.deviceplugin.base import PluginServer
+from vtpu_manager.deviceplugin.checkpoint import read_checkpoint
+from vtpu_manager.deviceplugin.reporters import VcorePlugin, VmemPlugin
+from vtpu_manager.deviceplugin.vnum import VnumPlugin, device_id
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.manager.device_manager import DeviceManager
+from vtpu_manager.tpu.discovery import FakeBackend
+from vtpu_manager.util import consts
+
+
+def make_manager(client, n_chips=2, split=4):
+    mgr = DeviceManager("node-1", client,
+                        node_config=NodeConfig(device_split_count=split),
+                        backends=[FakeBackend(n_chips=n_chips)])
+    mgr.init_devices()
+    return mgr
+
+
+def committed_pod(mgr, cores=50, memory=2 * 2**30, name="p1",
+                  container="main", chip_idx=0, annotations=None):
+    chip = mgr.chips[chip_idx]
+    claims = PodDeviceClaims()
+    claims.add(container, DeviceClaim(chip.uuid, chip.index, cores, memory))
+    anns = {consts.pre_allocated_annotation(): claims.encode(),
+            consts.predicate_node_annotation(): "node-1"}
+    anns.update(annotations or {})
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}", "annotations": anns},
+        "spec": {"nodeName": "node-1", "containers": [{"name": container}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    client = FakeKubeClient()
+    mgr = make_manager(client)
+    p = VnumPlugin(mgr, client, "node-1", base_dir=str(tmp_path / "mgr"),
+                   node_config=NodeConfig())
+    return p, client, mgr
+
+
+class TestAdvertisement:
+    def test_split_slots(self, plugin):
+        p, _, mgr = plugin
+        devices = p.list_devices()
+        assert len(devices) == 2 * 4
+        assert all(d.health == "Healthy" for d in devices)
+
+    def test_unhealthy_propagates(self, plugin):
+        p, _, mgr = plugin
+        mgr.mark_unhealthy(mgr.chips[0].uuid)
+        devices = p.list_devices()
+        sick = [d for d in devices if d.health == "Unhealthy"]
+        assert len(sick) == 4
+
+    def test_reporters(self, plugin):
+        _, client, mgr = plugin
+        assert len(VcorePlugin(mgr).list_devices()) == 200
+        mem = VmemPlugin(mgr, mem_unit_mib=1024).list_devices()
+        assert len(mem) == 2 * 16  # 16 GiB per chip / 1 GiB units
+
+
+class TestPreferredAllocation:
+    def test_honors_preallocation(self, plugin):
+        p, client, mgr = plugin
+        pod = committed_pod(mgr, chip_idx=1)
+        client.add_pod(pod)
+        available = [device_id(c.uuid, s) for c in mgr.chips
+                     for s in range(4)]
+        req = pb.PreferredAllocationRequest(container_requests=[
+            pb.ContainerPreferredAllocationRequest(
+                available_deviceIDs=available, allocation_size=1)])
+        resp = p.get_preferred_allocation(req)
+        ids = list(resp.container_responses[0].deviceIDs)
+        assert len(ids) == 1
+        assert ids[0].startswith(mgr.chips[1].uuid)
+
+
+class TestAllocate:
+    def test_full_path(self, plugin, tmp_path):
+        p, client, mgr = plugin
+        pod = committed_pod(mgr, cores=25, memory=4 * 2**30)
+        client.add_pod(pod)
+        chip = mgr.chips[0]
+        req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[device_id(chip.uuid, 0)])])
+        resp = p.allocate(req)
+        cresp = resp.container_responses[0]
+        # envs
+        assert cresp.envs[f"{consts.ENV_MEM_LIMIT}_0"] == str(4 * 2**30)
+        assert cresp.envs[f"{consts.ENV_CORE_LIMIT}_0"] == "25"
+        assert cresp.envs[consts.ENV_VISIBLE_DEVICES] == "0"
+        assert cresp.envs[consts.ENV_TPU_LIBRARY_PATH].endswith(
+            consts.CONTROL_LIBRARY_NAME)
+        # device node
+        assert cresp.devices[0].host_path == "/dev/accel0"
+        # binary config written and readable
+        cfg_mounts = [m for m in cresp.mounts
+                      if m.container_path.endswith("/config")]
+        assert cfg_mounts
+        cfg = vc.read_config(os.path.join(cfg_mounts[0].host_path,
+                                          "vtpu.config"))
+        assert cfg.devices[0].hard_core == 25
+        assert cfg.devices[0].total_memory == 4 * 2**30
+        assert cfg.devices[0].real_memory == chip.memory
+        # pod patched
+        patched = client.get_pod("default", "p1")
+        anns = patched["metadata"]["annotations"]
+        assert anns[consts.allocation_status_annotation()] == "succeed"
+        real = PodDeviceClaims.decode(
+            anns[consts.real_allocated_annotation()])
+        assert real.all_claims()[0].uuid == chip.uuid
+
+    def test_balance_policy_soft_limit(self, plugin):
+        p, client, mgr = plugin
+        pod = committed_pod(mgr, cores=30, annotations={
+            consts.compute_policy_annotation(): "balance"})
+        client.add_pod(pod)
+        chip = mgr.chips[0]
+        resp = p.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[device_id(chip.uuid, 0)])]))
+        envs = resp.container_responses[0].envs
+        assert envs[f"{consts.ENV_CORE_SOFT_LIMIT}_0"] == "100"
+
+    def test_unmatched_devices_served_permissively(self, plugin):
+        p, client, mgr = plugin
+        chip = mgr.chips[0]
+        resp = p.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[device_id(chip.uuid, 2)])]))
+        envs = resp.container_responses[0].envs
+        assert consts.ENV_VISIBLE_DEVICES in envs
+        assert f"{consts.ENV_CORE_LIMIT}_0" not in envs
+
+    def test_prestart_verifies_and_heals(self, plugin, tmp_path):
+        p, client, mgr = plugin
+        pod = committed_pod(mgr)
+        client.add_pod(pod)
+        chip = mgr.chips[0]
+        ids = [device_id(chip.uuid, 0)]
+        p.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=ids)]))
+        # delete the config; prestart must rewrite it
+        cfg_path = os.path.join(p._container_dir("uid-p1", "main"),
+                                "config", "vtpu.config")
+        os.unlink(cfg_path)
+        p.pre_start_container(pb.PreStartContainerRequest(devicesIDs=ids))
+        assert os.path.exists(cfg_path)
+
+    def test_prestart_unknown_devices_fails(self, plugin):
+        p, _, mgr = plugin
+        with pytest.raises(RuntimeError):
+            p.pre_start_container(pb.PreStartContainerRequest(
+                devicesIDs=["ghost::0"]))
+
+
+class TestGrpcRoundTrip:
+    def test_server_over_unix_socket(self, plugin, tmp_path):
+        import grpc
+        p, client, mgr = plugin
+        server = PluginServer(p, plugin_dir=str(tmp_path / "sock"))
+        server.serve()
+        try:
+            with grpc.insecure_channel(
+                    f"unix://{server.socket_path}") as chan:
+                opts = chan.unary_unary(
+                    "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+                    request_serializer=pb.Empty.SerializeToString,
+                    response_deserializer=
+                    pb.DevicePluginOptions.FromString)(pb.Empty(), timeout=5)
+                assert opts.pre_start_required
+                stream = chan.unary_stream(
+                    "/v1beta1.DevicePlugin/ListAndWatch",
+                    request_serializer=pb.Empty.SerializeToString,
+                    response_deserializer=
+                    pb.ListAndWatchResponse.FromString)(pb.Empty(),
+                                                        timeout=5)
+                first = next(iter(stream))
+                assert len(first.devices) == 8
+        finally:
+            server.stop()
+
+
+class TestCheckpoint:
+    def test_read_kubelet_checkpoint(self, tmp_path):
+        import json
+        path = str(tmp_path / "kubelet_internal_checkpoint")
+        with open(path, "w") as f:
+            json.dump({"Data": {"PodDeviceEntries": [{
+                "PodUID": "u1", "ContainerName": "c1",
+                "ResourceName": "google.com/vtpu-number",
+                "DeviceIDs": {"0": ["a::0", "a::1"]}}]}}, f)
+        entries = read_checkpoint(path)
+        assert entries[0].pod_uid == "u1"
+        assert set(entries[0].device_ids) == {"a::0", "a::1"}
+
+    def test_missing_file(self, tmp_path):
+        assert read_checkpoint(str(tmp_path / "nope")) == []

@@ -1,0 +1,132 @@
+"""Reschedule + recovery controllers: failed allocations get evicted.
+
+Reference: pkg/controller/reschedule/reschedule.go:1-131 (evict pods whose
+allocation-status annotation is "failed") and recovery.go:1-224 (evict pods
+whose recorded devices vanished from the kubelet checkpoint — chip swaps,
+uuid changes). Behind the Reschedule feature gate. Eviction (not delete)
+respects PDBs; delete is the fallback when the eviction API is rejected.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.deviceplugin.checkpoint import (KUBELET_CHECKPOINT,
+                                                  devices_for_resource)
+from vtpu_manager.deviceplugin.vnum import device_uuid
+from vtpu_manager.device.types import get_pod_device_claims
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+
+class RescheduleController:
+    def __init__(self, client: KubeClient, node_name: str,
+                 known_uuids: set[str] | None = None,
+                 checkpoint_path: str = KUBELET_CHECKPOINT,
+                 interval_s: float = 15.0):
+        self.client = client
+        self.node_name = node_name
+        self.known_uuids = known_uuids or set()
+        self.checkpoint_path = checkpoint_path
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.evicted: list[tuple[str, str]] = []   # observability for tests
+
+    # -- one reconcile pass -------------------------------------------------
+
+    def reconcile_once(self) -> int:
+        evictions = 0
+        try:
+            pods = self.client.list_pods(node_name=self.node_name)
+        except KubeError:
+            return 0
+        checkpoint = devices_for_resource(consts.vtpu_number_resource(),
+                                          self.checkpoint_path)
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            phase = (pod.get("status") or {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                continue
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            uid = meta.get("uid", "")
+
+            if anns.get(consts.allocation_status_annotation()) == \
+                    consts.ALLOC_STATUS_FAILED:
+                # the device plugin could not fulfil the scheduler's
+                # commitment; send the pod back through scheduling
+                self._evict(ns, name, "allocation failed on node")
+                evictions += 1
+                continue
+
+            if self.known_uuids and anns.get(
+                    consts.real_allocated_annotation()):
+                claims = get_pod_device_claims(pod)
+                missing = [c.uuid for c in (claims.all_claims()
+                                            if claims else [])
+                           if c.uuid not in self.known_uuids]
+                if missing:
+                    self._evict(ns, name,
+                                f"allocated devices gone: {missing}")
+                    evictions += 1
+                    continue
+
+            # recovery: pod holds checkpointed kubelet devices that no
+            # longer exist on this node (chip uuid change across restart)
+            held = checkpoint.get(uid)
+            if held and self.known_uuids:
+                ghost = [d for d in held
+                         if device_uuid(d) not in self.known_uuids]
+                if ghost:
+                    self._evict(ns, name,
+                                f"kubelet checkpoint references missing "
+                                f"devices: {ghost[:4]}")
+                    evictions += 1
+        return evictions
+
+    def _evict(self, namespace: str, name: str, reason: str) -> None:
+        log.warning("evicting %s/%s: %s", namespace, name, reason)
+        try:
+            self.client.evict_pod(namespace, name)
+        except KubeError:
+            try:
+                self.client.delete_pod(namespace, name, grace_seconds=30)
+            except KubeError:
+                log.error("both evict and delete failed for %s/%s",
+                          namespace, name)
+                return
+        self.evicted.append((namespace, name))
+        try:
+            self.client.create_event(namespace, {
+                "metadata": {"generateName": "vtpu-reschedule-"},
+                "involvedObject": {"kind": "Pod", "namespace": namespace,
+                                   "name": name},
+                "reason": "VtpuReschedule",
+                "message": reason[:1024],
+                "type": "Warning",
+            })
+        except KubeError:
+            pass
+
+    # -- loop ---------------------------------------------------------------
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.reconcile_once()
+                except Exception:
+                    log.exception("reschedule reconcile failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtpu-reschedule")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,183 @@
+"""Device-plugin gRPC scaffold: serving, kubelet registration, restarts.
+
+Reference: pkg/deviceplugin/base/plugin_server.go:1-203 (serving scaffold)
+and cmd/device-plugin/main.go:172-230 (kubelet-restart detection via
+fsnotify on kubelet.sock + re-register loop). grpc stubs are hand-wired
+(grpc codegen is unavailable in this image); the wire contract lives in
+api/deviceplugin.proto.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+KUBELET_DIR = "/var/lib/kubelet/device-plugins"
+KUBELET_SOCKET = f"{KUBELET_DIR}/kubelet.sock"
+API_VERSION = "v1beta1"
+
+
+class DevicePluginServicer:
+    """Override per plugin. Default implementations are inert."""
+
+    resource_name = "example.com/none"
+    socket_name = "vtpu-none.sock"
+    pre_start_required = False
+    preferred_allocation_available = False
+
+    def list_devices(self) -> list[pb.Device]:
+        return []
+
+    def watch_devices(self):
+        """Yield device lists; must yield at least once, then on changes."""
+        yield self.list_devices()
+        while True:
+            time.sleep(5)
+            yield self.list_devices()
+
+    def get_preferred_allocation(
+            self, request: pb.PreferredAllocationRequest
+    ) -> pb.PreferredAllocationResponse:
+        return pb.PreferredAllocationResponse()
+
+    def allocate(self, request: pb.AllocateRequest) -> pb.AllocateResponse:
+        return pb.AllocateResponse()
+
+    def pre_start_container(
+            self, request: pb.PreStartContainerRequest
+    ) -> pb.PreStartContainerResponse:
+        return pb.PreStartContainerResponse()
+
+
+def _unary(fn, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString)
+
+
+class PluginServer:
+    """Serves one DevicePluginServicer on a unix socket and keeps it
+    registered with the kubelet."""
+
+    def __init__(self, servicer: DevicePluginServicer,
+                 plugin_dir: str = KUBELET_DIR,
+                 kubelet_socket: str | None = None):
+        self.servicer = servicer
+        self.plugin_dir = plugin_dir
+        self.kubelet_socket = kubelet_socket or os.path.join(
+            plugin_dir, "kubelet.sock")
+        self.socket_path = os.path.join(plugin_dir, servicer.socket_name)
+        self._server: grpc.Server | None = None
+        self._stop = threading.Event()
+
+    # -- grpc plumbing ------------------------------------------------------
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        s = self.servicer
+
+        def options(request, context):
+            return pb.DevicePluginOptions(
+                pre_start_required=s.pre_start_required,
+                get_preferred_allocation_available=
+                s.preferred_allocation_available)
+
+        def list_and_watch(request, context):
+            for devices in s.watch_devices():
+                if self._stop.is_set():
+                    return
+                yield pb.ListAndWatchResponse(devices=devices)
+
+        handlers = {
+            "GetDevicePluginOptions": _unary(options, pb.Empty,
+                                             pb.DevicePluginOptions),
+            "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+                list_and_watch, request_deserializer=pb.Empty.FromString,
+                response_serializer=pb.ListAndWatchResponse.SerializeToString),
+            "GetPreferredAllocation": _unary(
+                lambda req, ctx: s.get_preferred_allocation(req),
+                pb.PreferredAllocationRequest,
+                pb.PreferredAllocationResponse),
+            "Allocate": _unary(lambda req, ctx: s.allocate(req),
+                               pb.AllocateRequest, pb.AllocateResponse),
+            "PreStartContainer": _unary(
+                lambda req, ctx: s.pre_start_container(req),
+                pb.PreStartContainerRequest, pb.PreStartContainerResponse),
+        }
+        return grpc.method_handlers_generic_handler("v1beta1.DevicePlugin",
+                                                    handlers)
+
+    def serve(self) -> None:
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        log.info("%s serving on %s", self.servicer.resource_name,
+                 self.socket_path)
+
+    def register(self) -> None:
+        """Announce to the kubelet (reference RegisterRequest flow)."""
+        with grpc.insecure_channel(f"unix://{self.kubelet_socket}") as chan:
+            stub = chan.unary_unary(
+                "/v1beta1.Registration/Register",
+                request_serializer=pb.RegisterRequest.SerializeToString,
+                response_deserializer=pb.Empty.FromString)
+            stub(pb.RegisterRequest(
+                version=API_VERSION,
+                endpoint=self.servicer.socket_name,
+                resource_name=self.servicer.resource_name,
+                options=pb.DevicePluginOptions(
+                    pre_start_required=self.servicer.pre_start_required,
+                    get_preferred_allocation_available=
+                    self.servicer.preferred_allocation_available)),
+                timeout=10)
+        log.info("registered %s with kubelet",
+                 self.servicer.resource_name)
+
+    def watch_kubelet_restarts(self, poll_s: float = 2.0) -> None:
+        """Re-register when kubelet.sock is recreated (reference: fsnotify
+        + SIGHUP restart loop, main.go:172-230; polling works without
+        inotify deps)."""
+
+        def loop():
+            last_ino = None
+            while not self._stop.wait(poll_s):
+                try:
+                    ino = os.stat(self.kubelet_socket).st_ino
+                except OSError:
+                    continue
+                if last_ino is None:
+                    last_ino = ino
+                    continue
+                if ino != last_ino:
+                    last_ino = ino
+                    log.warning("kubelet restarted; re-registering")
+                    try:
+                        self.register()
+                    except grpc.RpcError:
+                        log.error("re-registration failed")
+
+        threading.Thread(target=loop, daemon=True,
+                         name="vtpu-kubelet-watch").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=1)
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass

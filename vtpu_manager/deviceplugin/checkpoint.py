@@ -1,0 +1,61 @@
+"""Kubelet checkpoint reader: pod <-> device recovery after restarts.
+
+Reference: pkg/deviceplugin/checkpoint/checkpoint.go:11-99 reads the
+kubelet's own kubelet_internal_checkpoint to recover which pods own which
+device IDs (used by the recovery controller when a pod references devices
+that no longer exist — controller/reschedule/recovery.go).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+KUBELET_CHECKPOINT = \
+    "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
+
+
+@dataclass(frozen=True)
+class CheckpointEntry:
+    pod_uid: str
+    container: str
+    resource: str
+    device_ids: tuple[str, ...]
+
+
+def read_checkpoint(path: str = KUBELET_CHECKPOINT) -> list[CheckpointEntry]:
+    """Parse the kubelet device-manager checkpoint (JSON with a Data.
+    PodDeviceEntries list). Malformed/absent files yield []."""
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
+    entries = []
+    for entry in ((doc.get("Data") or {}).get("PodDeviceEntries") or []):
+        ids: list[str] = []
+        dev_map = entry.get("DeviceIDs") or {}
+        if isinstance(dev_map, dict):
+            for chunk in dev_map.values():
+                ids.extend(chunk or [])
+        elif isinstance(dev_map, list):
+            ids = dev_map
+        entries.append(CheckpointEntry(
+            pod_uid=entry.get("PodUID", ""),
+            container=entry.get("ContainerName", ""),
+            resource=entry.get("ResourceName", ""),
+            device_ids=tuple(ids)))
+    return entries
+
+
+def devices_for_resource(resource: str,
+                         path: str = KUBELET_CHECKPOINT) -> dict[str, set]:
+    """pod_uid -> set of device ids held for one resource."""
+    out: dict[str, set] = {}
+    for entry in read_checkpoint(path):
+        if entry.resource == resource:
+            out.setdefault(entry.pod_uid, set()).update(entry.device_ids)
+    return out

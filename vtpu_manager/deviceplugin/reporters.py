@@ -1,0 +1,55 @@
+"""vtpu-cores / vtpu-memory reporter plugins.
+
+Reference: vcore_plugin.go:1-111 / vmem_plugin.go:1-113 behind the
+CorePlugin/MemoryPlugin feature gates — they only *advertise* capacity so
+requests/limits arithmetic works cluster-wide; allocation is carried
+entirely by the vtpu-number plugin.
+"""
+
+from __future__ import annotations
+
+from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+from vtpu_manager.deviceplugin.base import DevicePluginServicer
+from vtpu_manager.manager.device_manager import DeviceManager
+from vtpu_manager.util import consts
+
+MIB = 2**20
+
+
+class VcorePlugin(DevicePluginServicer):
+    """Advertises 100 core-percent units per chip."""
+
+    def __init__(self, manager: DeviceManager):
+        self.manager = manager
+        self.resource_name = consts.vtpu_cores_resource()
+        self.socket_name = "vtpu-cores.sock"
+
+    def list_devices(self) -> list[pb.Device]:
+        out = []
+        for chip in self.manager.chips:
+            health = "Healthy" if chip.healthy else "Unhealthy"
+            for pct in range(100):
+                out.append(pb.Device(ID=f"{chip.uuid}::core-{pct}",
+                                     health=health))
+        return out
+
+
+class VmemPlugin(DevicePluginServicer):
+    """Advertises HBM capacity in MiB units (capped to bound the device
+    list the kubelet must track: 1 unit = mem_unit MiB)."""
+
+    def __init__(self, manager: DeviceManager, mem_unit_mib: int = 256):
+        self.manager = manager
+        self.mem_unit_mib = mem_unit_mib
+        self.resource_name = consts.vtpu_memory_resource()
+        self.socket_name = "vtpu-memory.sock"
+
+    def list_devices(self) -> list[pb.Device]:
+        out = []
+        for chip in self.manager.chips:
+            health = "Healthy" if chip.healthy else "Unhealthy"
+            units = chip.memory // (self.mem_unit_mib * MIB)
+            for unit in range(units):
+                out.append(pb.Device(ID=f"{chip.uuid}::mem-{unit}",
+                                     health=health))
+        return out

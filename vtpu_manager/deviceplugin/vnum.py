@@ -1,0 +1,416 @@
+"""vtpu-number plugin: the real allocation path.
+
+Reference: pkg/deviceplugin/vgpu/vnum_plugin.go:61-1150 —
+- ListAndWatch advertises split_count slots per chip (re-announced on
+  health flips);
+- GetPreferredAllocation honors the scheduler's pre-allocated annotation
+  (:321-502);
+- Allocate (:663-916) finds the pod the scheduler committed, builds env +
+  mounts + device nodes, writes the binary vtpu.config, patches the
+  real-allocated annotation ("succeed"), or patches "failed" for the
+  reschedule controller;
+- PreStartContainer (:1042-1121) verifies recorded devices and rewrites a
+  missing config under the gate, cleaning stale per-container state.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import Counter
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.node_config import NodeConfig
+from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+from vtpu_manager.deviceplugin.base import DevicePluginServicer
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.device.types import ChipSpec, get_pod_device_claims
+from vtpu_manager.manager.device_manager import DeviceManager
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+_COMPAT_BITS = {"host": consts.COMPAT_HOST, "cgroup": consts.COMPAT_CGROUP,
+                "client": consts.COMPAT_CLIENT,
+                "open-kernel": consts.COMPAT_OPEN_KERNEL}
+
+
+def device_id(uuid: str, slot: int) -> str:
+    return f"{uuid}::{slot}"
+
+
+def device_uuid(dev_id: str) -> str:
+    return dev_id.split("::", 1)[0]
+
+
+class VnumPlugin(DevicePluginServicer):
+    pre_start_required = True
+    preferred_allocation_available = True
+
+    def __init__(self, manager: DeviceManager, client: KubeClient,
+                 node_name: str, node_config: NodeConfig | None = None,
+                 base_dir: str = consts.MANAGER_BASE_DIR,
+                 shim_host_dir: str = consts.DRIVER_DIR,
+                 libtpu_path: str = "/lib/libtpu.so",
+                 disable_control: bool = False):
+        self.manager = manager
+        self.client = client
+        self.node_name = node_name
+        self.node_config = node_config or NodeConfig()
+        self.base_dir = base_dir
+        self.shim_host_dir = shim_host_dir
+        self.libtpu_path = libtpu_path
+        self.disable_control = disable_control
+        self.resource_name = consts.vtpu_number_resource()
+        self.socket_name = "vtpu-number.sock"
+        self._update = threading.Event()
+        manager.on_unhealthy(lambda chip: self._update.set())
+        self._served_lock = threading.Lock()
+        self._served: set[tuple[str, str]] = set()   # (pod_uid, container)
+
+    # -- advertisement ------------------------------------------------------
+
+    def list_devices(self) -> list[pb.Device]:
+        out = []
+        for chip in self.manager.chips:
+            health = "Healthy" if chip.healthy else "Unhealthy"
+            topo = pb.TopologyInfo(nodes=[pb.NUMANode(ID=chip.numa)])
+            for slot in range(chip.split_count):
+                out.append(pb.Device(ID=device_id(chip.uuid, slot),
+                                     health=health, topology=topo))
+        return out
+
+    def watch_devices(self):
+        yield self.list_devices()
+        while True:
+            self._update.wait(timeout=10)
+            self._update.clear()
+            yield self.list_devices()
+
+    # -- scheduler-committed pod lookup -------------------------------------
+
+    def _pending_allocations(self) -> list[tuple[dict, str,
+                                                 list[DeviceClaim]]]:
+        """(pod, container_name, claims) for containers the scheduler
+        committed on this node but the plugin has not served yet."""
+        out = []
+        try:
+            pods = self.client.list_pods(node_name=self.node_name)
+        except KubeError:
+            return out
+        # pods bound moments ago may not carry nodeName in the cache yet;
+        # include node-less pods whose predicate-node matches us
+        try:
+            for pod in self.client.list_pods():
+                anns = (pod.get("metadata") or {}).get("annotations") or {}
+                if anns.get(consts.predicate_node_annotation()) == \
+                        self.node_name and pod not in pods:
+                    pods.append(pod)
+        except KubeError:
+            pass
+        with self._served_lock:
+            served = set(self._served)
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            if anns.get(consts.real_allocated_annotation()):
+                continue
+            claims = get_pod_device_claims(pod)
+            if claims is None:
+                continue
+            uid = meta.get("uid", "")
+            for cont, cont_claims in claims.containers.items():
+                if cont_claims and (uid, cont) not in served:
+                    out.append((pod, cont, cont_claims))
+        return out
+
+    # -- GetPreferredAllocation --------------------------------------------
+
+    def get_preferred_allocation(self, request):
+        resp = pb.PreferredAllocationResponse()
+        pending = self._pending_allocations()
+        for creq in request.container_requests:
+            available = list(creq.available_deviceIDs)
+            preferred: list[str] = []
+            for _, _, claims in pending:
+                if len(claims) != creq.allocation_size:
+                    continue
+                picks = self._pick_ids_for_claims(claims, available)
+                if picks is not None:
+                    preferred = picks
+                    break
+            if not preferred:
+                preferred = list(creq.must_include_deviceIDs)
+                for dev in available:
+                    if len(preferred) >= creq.allocation_size:
+                        break
+                    if dev not in preferred:
+                        preferred.append(dev)
+            resp.container_responses.append(
+                pb.ContainerPreferredAllocationResponse(
+                    deviceIDs=preferred[: creq.allocation_size]))
+        return resp
+
+    @staticmethod
+    def _pick_ids_for_claims(claims: list[DeviceClaim],
+                             available: list[str]) -> list[str] | None:
+        by_uuid: dict[str, list[str]] = {}
+        for dev in available:
+            by_uuid.setdefault(device_uuid(dev), []).append(dev)
+        picks = []
+        for claim in claims:
+            pool = by_uuid.get(claim.uuid)
+            if not pool:
+                return None
+            picks.append(pool.pop(0))
+        return picks
+
+    # -- Allocate -----------------------------------------------------------
+
+    def allocate(self, request):
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            resp.container_responses.append(
+                self._allocate_container(list(creq.devicesIDs)))
+        return resp
+
+    def _match_container(self, dev_ids: list[str]
+                         ) -> tuple[dict, str, list[DeviceClaim]] | None:
+        want = Counter(device_uuid(d) for d in dev_ids)
+        for pod, cont, claims in self._pending_allocations():
+            if Counter(c.uuid for c in claims) == want:
+                return (pod, cont, claims)
+        return None
+
+    def _allocate_container(self, dev_ids: list[str]
+                            ) -> pb.ContainerAllocateResponse:
+        match = self._match_container(dev_ids)
+        if match is None:
+            # kubelet allocated devices we cannot tie to a scheduler
+            # commitment (e.g. bypassed scheduler): serve permissively with
+            # no enforcement config, mirroring the reference's fallback.
+            log.warning("allocate without matching pre-allocation: %s",
+                        dev_ids)
+            return self._response_for(None, "", [
+                DeviceClaim(device_uuid(d), self._host_index(device_uuid(d)),
+                            0, 0) for d in dev_ids])
+        pod, cont, claims = match
+        meta = pod.get("metadata") or {}
+        uid = meta.get("uid", "")
+        try:
+            response = self._response_for(pod, cont, claims)
+            self._record_devices(uid, cont, dev_ids, claims)
+            self.client.patch_pod_annotations(
+                meta.get("namespace", "default"), meta.get("name", ""), {
+                    consts.real_allocated_annotation():
+                        self._claims_annotation(pod, cont, claims),
+                    consts.allocation_status_annotation():
+                        consts.ALLOC_STATUS_SUCCEED,
+                })
+            with self._served_lock:
+                self._served.add((uid, cont))
+            return response
+        except Exception:
+            log.exception("allocate failed for %s/%s", uid, cont)
+            try:
+                self.client.patch_pod_annotations(
+                    meta.get("namespace", "default"), meta.get("name", ""),
+                    {consts.allocation_status_annotation():
+                         consts.ALLOC_STATUS_FAILED})
+            except KubeError:
+                pass
+            raise
+
+    def _claims_annotation(self, pod: dict, cont: str,
+                           claims: list[DeviceClaim]) -> str:
+        existing = get_pod_device_claims(pod) or PodDeviceClaims()
+        existing.containers[cont] = claims
+        return existing.encode()
+
+    def _host_index(self, uuid: str) -> int:
+        for chip in self.manager.chips:
+            if chip.uuid == uuid:
+                return chip.index
+        return 0
+
+    def _chip(self, uuid: str) -> ChipSpec | None:
+        for chip in self.manager.chips:
+            if chip.uuid == uuid:
+                return chip
+        return None
+
+    def _container_dir(self, pod_uid: str, cont: str) -> str:
+        return os.path.join(self.base_dir, f"{pod_uid}_{cont}")
+
+    def _response_for(self, pod: dict | None, cont: str,
+                      claims: list[DeviceClaim]
+                      ) -> pb.ContainerAllocateResponse:
+        resp = pb.ContainerAllocateResponse()
+        meta = (pod or {}).get("metadata") or {}
+        anns = meta.get("annotations") or {}
+        uid = meta.get("uid", "")
+        compute_policy = anns.get(consts.compute_policy_annotation(),
+                                  consts.COMPUTE_POLICY_FIXED)
+        oversold = anns.get(consts.memory_oversold_annotation(), "") == "true"
+
+        host_indices = [c.host_index for c in claims]
+        resp.envs[consts.ENV_VISIBLE_DEVICES] = ",".join(
+            str(i) for i in host_indices)
+        resp.envs[consts.ENV_TPU_VISIBLE_DEVICES] = ",".join(
+            str(i) for i in host_indices)
+        devices = []
+        for i, claim in enumerate(claims):
+            if claim.memory:
+                resp.envs[f"{consts.ENV_MEM_LIMIT}_{i}"] = str(claim.memory)
+            if claim.cores:
+                resp.envs[f"{consts.ENV_CORE_LIMIT}_{i}"] = str(claim.cores)
+                soft = claim.cores
+                core_limit = vc.CORE_LIMIT_HARD
+                if compute_policy == consts.COMPUTE_POLICY_BALANCE:
+                    soft = 100
+                    core_limit = vc.CORE_LIMIT_SOFT
+                    resp.envs[f"{consts.ENV_CORE_SOFT_LIMIT}_{i}"] = \
+                        str(soft)
+                elif compute_policy == consts.COMPUTE_POLICY_NONE:
+                    core_limit = vc.CORE_LIMIT_NONE
+            else:
+                soft, core_limit = 0, vc.CORE_LIMIT_NONE
+            chip = self._chip(claim.uuid)
+            real_mem = chip.memory if chip else claim.memory
+            mesh = chip.coords if chip else (0, 0, 0)
+            devices.append(vc.DeviceConfig(
+                uuid=claim.uuid, total_memory=claim.memory,
+                real_memory=real_mem, hard_core=claim.cores,
+                soft_core=soft, core_limit=core_limit,
+                memory_limit=claim.memory > 0, memory_oversold=oversold,
+                host_index=claim.host_index, mesh=mesh))
+            resp.devices.append(pb.DeviceSpec(
+                container_path=f"/dev/accel{claim.host_index}",
+                host_path=f"/dev/accel{claim.host_index}",
+                permissions="rw"))
+
+        compat = _COMPAT_BITS.get(self.node_config.compat_mode,
+                                  consts.COMPAT_HOST)
+        resp.envs[consts.ENV_COMPAT_MODE] = str(compat)
+        resp.envs[consts.ENV_POD_NAME] = meta.get("name", "")
+        resp.envs[consts.ENV_POD_NAMESPACE] = meta.get("namespace", "")
+        resp.envs[consts.ENV_POD_UID] = uid
+        resp.envs[consts.ENV_CONTAINER_NAME] = cont
+
+        if pod is not None and not self.disable_control:
+            cont_dir = self._container_dir(uid, cont)
+            config_host = os.path.join(cont_dir, "config")
+            os.makedirs(config_host, exist_ok=True)
+            cfg = vc.VtpuConfig(pod_uid=uid, pod_name=meta.get("name", ""),
+                                pod_namespace=meta.get("namespace", ""),
+                                container_name=cont, compat_mode=compat,
+                                devices=devices)
+            vc.write_config(os.path.join(config_host, "vtpu.config"), cfg)
+            # mounts: per-container config, the shim, locks, vmem, watcher
+            # (reference vnum_plugin.go:799-879); the PJRT substitution envs
+            # play the role of ld.so.preload (:872-879)
+            resp.mounts.append(pb.Mount(
+                container_path=f"{consts.MANAGER_BASE_DIR}/config",
+                host_path=config_host, read_only=True))
+            resp.mounts.append(pb.Mount(
+                container_path=consts.DRIVER_DIR,
+                host_path=self.shim_host_dir, read_only=True))
+            for path in (consts.LOCK_DIR, consts.VMEM_DIR):
+                resp.mounts.append(pb.Mount(container_path=path,
+                                            host_path=path, read_only=False))
+            resp.mounts.append(pb.Mount(
+                container_path=consts.WATCHER_DIR,
+                host_path=consts.WATCHER_DIR, read_only=True))
+            if compat & consts.COMPAT_CLIENT:
+                resp.mounts.append(pb.Mount(
+                    container_path=consts.REGISTRY_DIR,
+                    host_path=consts.REGISTRY_DIR, read_only=False))
+            shim = os.path.join(consts.DRIVER_DIR,
+                                consts.CONTROL_LIBRARY_NAME)
+            resp.envs[consts.ENV_TPU_LIBRARY_PATH] = shim
+            resp.envs[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
+            resp.envs[consts.ENV_VTPU_REAL_PLUGIN_PATH] = self.libtpu_path
+            resp.envs["VTPU_CONFIG_PATH"] = \
+                f"{consts.MANAGER_BASE_DIR}/config/vtpu.config"
+        return resp
+
+    # -- records + PreStartContainer ---------------------------------------
+
+    def _records_path(self) -> str:
+        return os.path.join(self.base_dir, consts.DEVICES_JSON_NAME)
+
+    def _record_devices(self, pod_uid: str, cont: str, dev_ids: list[str],
+                        claims: list[DeviceClaim]) -> None:
+        path = self._records_path()
+        records = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    records = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                records = {}
+        records[f"{pod_uid}/{cont}"] = {
+            "devices": dev_ids,
+            "claims": [c.to_wire() for c in claims],
+            "ts": time.time(),
+        }
+        os.makedirs(self.base_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(records, f)
+        os.replace(tmp, path)
+
+    def pre_start_container(self, request):
+        """Verify the requested devices belong to a recorded allocation and
+        the config file exists (rewriting it if the Allocate-phase write
+        was lost — reference vnum_plugin.go:1042-1121)."""
+        dev_ids = list(request.devicesIDs)
+        want = Counter(device_uuid(d) for d in dev_ids)
+        path = self._records_path()
+        records = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    records = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                records = {}
+        for key, rec in records.items():
+            claims = [DeviceClaim.from_wire(c) for c in rec.get("claims", [])]
+            if Counter(c.uuid for c in claims) != want:
+                continue
+            pod_uid, _, cont = key.partition("/")
+            cfg_path = os.path.join(self._container_dir(pod_uid, cont),
+                                    "config", "vtpu.config")
+            if not os.path.exists(cfg_path):
+                log.warning("config missing at prestart; rewriting %s",
+                            cfg_path)
+                # minimal rewrite from the recorded claims
+                os.makedirs(os.path.dirname(cfg_path), exist_ok=True)
+                devices = []
+                for claim in claims:
+                    chip = self._chip(claim.uuid)
+                    devices.append(vc.DeviceConfig(
+                        uuid=claim.uuid, total_memory=claim.memory,
+                        real_memory=chip.memory if chip else claim.memory,
+                        hard_core=claim.cores, soft_core=claim.cores,
+                        core_limit=vc.CORE_LIMIT_HARD if claim.cores
+                        else vc.CORE_LIMIT_NONE,
+                        memory_limit=claim.memory > 0,
+                        host_index=claim.host_index))
+                vc.write_config(cfg_path, vc.VtpuConfig(
+                    pod_uid=pod_uid, container_name=cont, devices=devices))
+            # stale per-container state from a previous tenant
+            pids_cfg = os.path.join(self._container_dir(pod_uid, cont),
+                                    consts.PIDS_CONFIG_NAME)
+            if os.path.exists(pids_cfg):
+                try:
+                    os.unlink(pids_cfg)
+                except OSError:
+                    pass
+            return pb.PreStartContainerResponse()
+        raise RuntimeError(
+            f"prestart devices {dev_ids} match no recorded allocation")

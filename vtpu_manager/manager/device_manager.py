@@ -1,0 +1,177 @@
+"""DeviceManager: node-side chip inventory, registration, health.
+
+Reference: pkg/device/manager/device.go:77-556 (discovery + node config
+application), manager/registry.go:15-113 (register/heartbeat/topology
+annotations), manager/health.go:28-264 (health watcher notifying plugins).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import replace
+from typing import Callable
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.config.node_config import DeviceIDStore, NodeConfig
+from vtpu_manager.device.types import (ChipSpec, MeshSpec, NodeDeviceRegistry)
+from vtpu_manager.tpu.discovery import DiscoveryBackend, discover
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+
+class DeviceManager:
+    """Owns the node's chip inventory and its published view."""
+
+    def __init__(self, node_name: str, client: KubeClient,
+                 node_config: NodeConfig | None = None,
+                 id_store: DeviceIDStore | None = None,
+                 backends: list[DiscoveryBackend] | None = None,
+                 mesh_domain: str = ""):
+        self.node_name = node_name
+        self.client = client
+        self.node_config = node_config or NodeConfig()
+        self.id_store = id_store
+        self.backends = backends
+        self.mesh_domain = mesh_domain
+        self.chips: list[ChipSpec] = []
+        self.mesh: MeshSpec = MeshSpec()
+        self._health_listeners: list[Callable[[ChipSpec], None]] = []
+        self._stop = threading.Event()
+        self._heartbeat_thread: threading.Thread | None = None
+
+    # -- inventory ----------------------------------------------------------
+
+    def init_devices(self) -> list[ChipSpec]:
+        """Discover chips and apply the node config: exclusions, split
+        count, core/memory scaling (reference initDevices device.go:230)."""
+        result = discover(self.backends)
+        if result is None:
+            raise RuntimeError("no TPU chips discovered on this node")
+        cfg = self.node_config
+        chips = []
+        for chip in result.chips:
+            uuid = chip.uuid
+            if self.id_store is not None:
+                uuid = self.id_store.uuid_for(self.node_name, chip.index,
+                                              hw_serial=None)
+            if cfg.excludes(uuid, chip.index):
+                log.info("device %s (%d) excluded by node config", uuid,
+                         chip.index)
+                continue
+            chips.append(replace(
+                chip, uuid=uuid,
+                split_count=cfg.device_split_count,
+                memory=int(chip.memory * cfg.memory_scaling)))
+        self.chips = chips
+        self.mesh = result.mesh
+        return chips
+
+    def registry(self) -> NodeDeviceRegistry:
+        return NodeDeviceRegistry(chips=self.chips, mesh=self.mesh,
+                                  mesh_domain=self.mesh_domain)
+
+    # -- registration / heartbeat ------------------------------------------
+
+    def register_node(self) -> None:
+        """Publish the register + topology annotations (reference
+        registry.go:15-113: node-device-register, heartbeat, topology)."""
+        anns = {
+            consts.node_device_register_annotation():
+                self.registry().encode(),
+            consts.node_device_heartbeat_annotation(): str(time.time()),
+        }
+        if self.mesh_domain:
+            anns[consts.node_mesh_domain_annotation()] = self.mesh_domain
+        self.client.patch_node_annotations(self.node_name, anns)
+
+    def start_heartbeat(self, interval_s: float = 30.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.register_node()
+                except KubeError:
+                    log.warning("heartbeat registration failed")
+
+        self._heartbeat_thread = threading.Thread(target=loop, daemon=True,
+                                                  name="vtpu-heartbeat")
+        self._heartbeat_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- health -------------------------------------------------------------
+
+    def on_unhealthy(self, listener: Callable[[ChipSpec], None]) -> None:
+        """Plugins subscribe to re-advertise devices on health flips
+        (reference health.go: unhealthy devices -> re-ListAndWatch)."""
+        self._health_listeners.append(listener)
+
+    def mark_unhealthy(self, uuid: str) -> None:
+        for i, chip in enumerate(self.chips):
+            if chip.uuid == uuid and chip.healthy:
+                self.chips[i] = replace(chip, healthy=False)
+                for listener in self._health_listeners:
+                    listener(self.chips[i])
+                try:
+                    self.register_node()
+                except KubeError:
+                    log.warning("health re-registration failed")
+
+    def mark_healthy(self, uuid: str) -> None:
+        for i, chip in enumerate(self.chips):
+            if chip.uuid == uuid and not chip.healthy:
+                self.chips[i] = replace(chip, healthy=True)
+                for listener in self._health_listeners:
+                    listener(self.chips[i])
+                try:
+                    self.register_node()
+                except KubeError:
+                    log.warning("health re-registration failed")
+
+
+class HealthWatcher:
+    """Poll chip health and drive DeviceManager flips.
+
+    The reference subscribes to NVML XID events with a skip list
+    (health.go:28-264). TPU has no XID stream; health here is probed: a
+    chip is unhealthy when its device node vanishes or the probe callback
+    reports failure. Pluggable probe so tests inject faults.
+    """
+
+    def __init__(self, manager: DeviceManager,
+                 probe: Callable[[ChipSpec], bool],
+                 interval_s: float = 10.0):
+        self.manager = manager
+        self.probe = probe
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def check_once(self) -> None:
+        for chip in list(self.manager.chips):
+            ok = False
+            try:
+                ok = self.probe(chip)
+            except Exception:
+                ok = False
+            if not ok and chip.healthy:
+                log.error("device %s failed health probe", chip.uuid)
+                self.manager.mark_unhealthy(chip.uuid)
+            elif ok and not chip.healthy:
+                log.info("device %s recovered", chip.uuid)
+                self.manager.mark_healthy(chip.uuid)
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.check_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtpu-health")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

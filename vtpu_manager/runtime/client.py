@@ -1,0 +1,127 @@
+"""In-process runtime client: activate enforcement for a JAX tenant.
+
+The shim (libvtpu-control.so) does the enforcing; this module is the
+Python-side activation and introspection layer — the analogue of the
+reference's in-container plumbing that ld.so.preload does implicitly
+(reference vnum_plugin.go:872-879) plus the device-client registration hook
+(reference register.c:14-38):
+
+- install(): point the PJRT plugin search at the shim *before* jax imports
+  (TPU_LIBRARY_PATH / PJRT_PLUGIN_LIBRARY_PATH substitution), remembering
+  the real plugin in VTPU_REAL_TPU_LIBRARY_PATH.
+- effective_limits(): parse the same vtpu.config / env the shim reads so
+  Python code (metrics, tests) can see its own caps.
+- register_client(): CLIENT-compat-mode registration over the registry
+  socket (pid attribution without exposing host /proc).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+from dataclasses import dataclass
+
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.util import consts
+
+
+@dataclass
+class EffectiveLimits:
+    devices: list[vc.DeviceConfig]
+    compat_mode: int
+    source: str              # "config-file" | "env" | "none"
+
+
+def _env_limits() -> EffectiveLimits | None:
+    if not (os.environ.get(consts.ENV_MEM_LIMIT)
+            or os.environ.get(f"{consts.ENV_MEM_LIMIT}_0")
+            or os.environ.get(consts.ENV_CORE_LIMIT)
+            or os.environ.get(f"{consts.ENV_CORE_LIMIT}_0")):
+        return None
+    visible = os.environ.get(consts.ENV_VISIBLE_DEVICES, "0")
+    indices = [int(v) for v in visible.split(",") if v.strip() != ""]
+
+    def env_int(base: str, i: int, default: int) -> int:
+        raw = os.environ.get(f"{base}_{i}", os.environ.get(base))
+        return int(raw) if raw else default
+
+    devices = []
+    for i, host_index in enumerate(indices):
+        mem = env_int(consts.ENV_MEM_LIMIT, i, 0)
+        core = env_int(consts.ENV_CORE_LIMIT, i, 0)
+        soft = env_int(consts.ENV_CORE_SOFT_LIMIT, i, core)
+        limit = (vc.CORE_LIMIT_NONE if core <= 0 else
+                 vc.CORE_LIMIT_SOFT if soft > core else vc.CORE_LIMIT_HARD)
+        devices.append(vc.DeviceConfig(
+            uuid=f"env-{host_index}", total_memory=mem, real_memory=mem,
+            hard_core=core, soft_core=soft, core_limit=limit,
+            memory_limit=mem > 0, host_index=host_index))
+    compat = int(os.environ.get(consts.ENV_COMPAT_MODE, consts.COMPAT_HOST))
+    return EffectiveLimits(devices=devices, compat_mode=compat, source="env")
+
+
+def effective_limits(config_path: str | None = None) -> EffectiveLimits:
+    """What the shim will enforce for this process."""
+    if os.environ.get(consts.ENV_DISABLE_CONTROL):
+        return EffectiveLimits([], 0, "none")
+    path = config_path or os.environ.get(
+        "VTPU_CONFIG_PATH",
+        f"{consts.MANAGER_BASE_DIR}/config/vtpu.config")
+    try:
+        cfg = vc.read_config(path)
+        return EffectiveLimits(devices=cfg.devices,
+                               compat_mode=cfg.compat_mode,
+                               source="config-file")
+    except (OSError, ValueError):
+        pass
+    env = _env_limits()
+    return env if env is not None else EffectiveLimits([], 0, "none")
+
+
+def install(shim_path: str | None = None,
+            real_plugin_path: str | None = None) -> bool:
+    """Substitute the shim as the TPU PJRT plugin. Must run before jax
+    initializes its backends. Returns False when no shim/plugin is found."""
+    shim = shim_path or os.environ.get("VTPU_SHIM_PATH") or os.path.join(
+        consts.DRIVER_DIR, consts.CONTROL_LIBRARY_NAME)
+    if not os.path.exists(shim):
+        return False
+    real = (real_plugin_path
+            or os.environ.get(consts.ENV_VTPU_REAL_PLUGIN_PATH)
+            or os.environ.get(consts.ENV_TPU_LIBRARY_PATH))
+    if real:
+        os.environ[consts.ENV_VTPU_REAL_PLUGIN_PATH] = real
+    os.environ[consts.ENV_TPU_LIBRARY_PATH] = shim
+    os.environ[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
+    return True
+
+
+def register_client(timeout_s: float = 5.0) -> bool:
+    """CLIENT mode: announce this container to the node registry socket so
+    the daemon can resolve our pids into pids.config (reference:
+    cmd/device-client + registry/server.go SO_PEERCRED auth — the kernel
+    attests our pid; we just present pod identity)."""
+    path = consts.REGISTRY_SOCKET
+    if not os.path.exists(path):
+        return False
+    payload = json.dumps({
+        "pod_name": os.environ.get(consts.ENV_POD_NAME, ""),
+        "pod_namespace": os.environ.get(consts.ENV_POD_NAMESPACE, ""),
+        "pod_uid": os.environ.get(consts.ENV_POD_UID, ""),
+        "container": os.environ.get(consts.ENV_CONTAINER_NAME, ""),
+        "register_uuid": os.environ.get(consts.ENV_REGISTER_UUID, ""),
+    }).encode()
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+            sock.settimeout(timeout_s)
+            sock.connect(path)
+            sock.sendall(struct.pack("<I", len(payload)) + payload)
+            raw = sock.recv(4)
+            if len(raw) < 4:
+                return False
+            (status,) = struct.unpack("<i", raw)
+            return status == 0
+    except OSError:
+        return False

@@ -1,0 +1,175 @@
+"""TPU chip discovery backends.
+
+Reference: pkg/device/nvidia (G12) wraps go-nvml/go-nvlib for GPU discovery;
+here discovery is TPU-native with three backends, best available first:
+
+1. SysfsBackend — enumerate /dev/accel* + /sys/class/accel (the TPU VFIO
+   driver's device nodes) and derive chip count; chip type / HBM size from
+   the TPU_ACCELERATOR_TYPE env or the GCE metadata-style env fallbacks the
+   TPU VM images set.
+2. JaxBackend — ask a local JAX process (authoritative when libtpu is
+   importable on the node agent).
+3. FakeBackend — synthetic chips for tests and the fake-client smoke path
+   (the reference's fake-NVML equivalent).
+
+All backends yield (chips, mesh) in the framework's own model
+(vtpu_manager.device.types) with uuids resolved through DeviceIDStore so
+synthetic ids survive restarts.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from dataclasses import dataclass
+from typing import Protocol
+
+from vtpu_manager.device.types import ChipSpec, MeshSpec
+
+# Chip models: (hbm_bytes, cores_per_chip) — public TPU specs.
+CHIP_MODELS = {
+    "tpu-v4": (32 * 2**30, 2),
+    "tpu-v5e": (16 * 2**30, 1),
+    "tpu-v5p": (95 * 2**30, 2),
+    "tpu-v6e": (32 * 2**30, 1),
+}
+DEFAULT_CHIP_TYPE = "tpu-v5e"
+
+
+@dataclass
+class DiscoveryResult:
+    chips: list[ChipSpec]
+    mesh: MeshSpec
+    chip_type: str
+
+
+class DiscoveryBackend(Protocol):
+    def discover(self) -> DiscoveryResult | None: ...
+
+
+def _accel_type_env() -> tuple[str, tuple[int, int]]:
+    """Parse TPU_ACCELERATOR_TYPE ('v5litepod-8') and TPU_TOPOLOGY ('2x4')
+    into (chip_type, host mesh shape)."""
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    topo = os.environ.get("TPU_TOPOLOGY", "")
+    chip_type = DEFAULT_CHIP_TYPE
+    if accel.startswith("v5lite") or accel.startswith("v5e"):
+        chip_type = "tpu-v5e"
+    elif accel.startswith("v5p"):
+        chip_type = "tpu-v5p"
+    elif accel.startswith("v4"):
+        chip_type = "tpu-v4"
+    elif accel.startswith("v6"):
+        chip_type = "tpu-v6e"
+    shape = (0, 0)
+    m = re.match(r"^(\d+)x(\d+)", topo)
+    if m:
+        shape = (int(m.group(1)), int(m.group(2)))
+    return chip_type, shape
+
+
+def _grid_coords(n: int, shape: tuple[int, int]) -> list[tuple[int, int, int]]:
+    sx, sy = shape if shape != (0, 0) else (1, n)
+    return [(i % sx, i // sx, 0) for i in range(n)]
+
+
+class SysfsBackend:
+    """Chip count from the accelerator device nodes."""
+
+    def __init__(self, dev_glob: str = "/dev/accel*"):
+        self.dev_glob = dev_glob
+
+    def discover(self) -> DiscoveryResult | None:
+        nodes = sorted(glob.glob(self.dev_glob))
+        if not nodes:
+            return None
+        n = len(nodes)
+        chip_type, shape = _accel_type_env()
+        hbm, cores = CHIP_MODELS.get(chip_type, CHIP_MODELS[DEFAULT_CHIP_TYPE])
+        if shape == (0, 0):
+            shape = (1, n)
+        coords = _grid_coords(n, shape)
+        chips = [ChipSpec(uuid=f"accel-{i}", index=i, chip_type=chip_type,
+                          memory=hbm, core_count=cores, coords=coords[i])
+                 for i in range(n)]
+        return DiscoveryResult(chips=chips,
+                               mesh=MeshSpec((shape[0], shape[1], 1)),
+                               chip_type=chip_type)
+
+
+class JaxBackend:
+    """Authoritative when libtpu is loadable in the agent process."""
+
+    def discover(self) -> DiscoveryResult | None:
+        try:
+            import jax
+            devices = [d for d in jax.devices() if d.platform != "cpu"]
+        except Exception:
+            return None
+        if not devices:
+            return None
+        chip_type, shape = _accel_type_env()
+        hbm, cores = CHIP_MODELS.get(chip_type, CHIP_MODELS[DEFAULT_CHIP_TYPE])
+        n = len(devices)
+        if shape == (0, 0):
+            shape = (1, n)
+        coords = _grid_coords(n, shape)
+        chips = []
+        for i, dev in enumerate(devices):
+            coord = getattr(dev, "coords", None)
+            if coord is not None and len(coord) >= 2:
+                c = (int(coord[0]), int(coord[1]),
+                     int(coord[2]) if len(coord) > 2 else 0)
+            else:
+                c = coords[i]
+            mem = hbm
+            stats = None
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                pass
+            if stats and stats.get("bytes_limit"):
+                mem = int(stats["bytes_limit"])
+            chips.append(ChipSpec(uuid=f"jax-{dev.id}", index=i,
+                                  chip_type=chip_type, memory=mem,
+                                  core_count=cores, coords=c))
+        return DiscoveryResult(chips=chips,
+                               mesh=MeshSpec((shape[0], shape[1], 1)),
+                               chip_type=chip_type)
+
+
+class FakeBackend:
+    def __init__(self, n_chips: int = 4, chip_type: str = DEFAULT_CHIP_TYPE,
+                 mesh_shape: tuple[int, int] | None = None,
+                 chips_per_host: int = 0):
+        self.n_chips = n_chips
+        self.chip_type = chip_type
+        self.mesh_shape = mesh_shape or (1, n_chips)
+        self.chips_per_host = chips_per_host
+
+    def discover(self) -> DiscoveryResult | None:
+        hbm, cores = CHIP_MODELS.get(self.chip_type,
+                                     CHIP_MODELS[DEFAULT_CHIP_TYPE])
+        coords = _grid_coords(self.n_chips, self.mesh_shape)
+        chips = []
+        for i in range(self.n_chips):
+            host = i // self.chips_per_host if self.chips_per_host else 0
+            chips.append(ChipSpec(uuid=f"fake-{i}", index=i,
+                                  chip_type=self.chip_type, memory=hbm,
+                                  core_count=cores, coords=coords[i],
+                                  host_id=host, numa=host))
+        return DiscoveryResult(
+            chips=chips,
+            mesh=MeshSpec((self.mesh_shape[0], self.mesh_shape[1], 1)),
+            chip_type=self.chip_type)
+
+
+def discover(backends: list[DiscoveryBackend] | None = None
+             ) -> DiscoveryResult | None:
+    """First backend that finds chips wins."""
+    for backend in backends or [SysfsBackend(), JaxBackend()]:
+        result = backend.discover()
+        if result is not None and result.chips:
+            return result
+    return None

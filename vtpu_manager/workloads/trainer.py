@@ -1,0 +1,176 @@
+"""Flagship JAX workload: a small transformer-LM trainer, shardable.
+
+The enforcement framework has no model zoo (the reference manages devices,
+not models — SURVEY.md intro), but it needs a canonical tenant workload:
+the thing a vTPU pod actually runs, used by the benchmarks (bench.py), the
+driver's compile checks (__graft_entry__.py), and the multi-tenant e2e
+scenarios. Designed TPU-first:
+
+- bf16 activations/weights feeding the MXU, fp32 loss/reductions
+- static shapes; layers folded with lax.scan (one trace, compiler-friendly)
+- sharding by a 2-D ("data", "model") mesh via NamedSharding: batch over
+  data, FFN/attention heads over model — ICI-friendly collectives inserted
+  by XLA, nothing hand-scheduled
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def model_config(vocab: int = 256, d_model: int = 128, d_ff: int = 512,
+                 n_layers: int = 2, n_heads: int = 4,
+                 seq_len: int = 64) -> dict:
+    assert d_model % n_heads == 0
+    return dict(vocab=vocab, d_model=d_model, d_ff=d_ff, n_layers=n_layers,
+                n_heads=n_heads, seq_len=seq_len)
+
+
+def init_params(key: jax.Array, cfg: dict) -> dict:
+    """Stacked-layer params: leading axis = layer, so lax.scan folds the
+    whole depth into one compiled loop body."""
+    d, f, v, l = cfg["d_model"], cfg["d_ff"], cfg["vocab"], cfg["n_layers"]
+    k = iter(jax.random.split(key, 8))
+    scale = d ** -0.5
+
+    def init(rng, shape):
+        return (jax.random.normal(rng, shape, jnp.float32) * scale
+                ).astype(jnp.bfloat16)
+
+    return {
+        "embed": init(next(k), (v, d)),
+        "pos": init(next(k), (cfg["seq_len"], d)),
+        "layers": {
+            "wqkv": init(next(k), (l, d, 3 * d)),
+            "wo": init(next(k), (l, d, d)),
+            "w1": init(next(k), (l, d, f)),
+            "w2": init(next(k), (l, f, d)),
+        },
+        "unembed": init(next(k), (d, v)),
+    }
+
+
+def _attention(x: jax.Array, wqkv: jax.Array, wo: jax.Array,
+               n_heads: int) -> jax.Array:
+    b, s, d = x.shape
+    qkv = jnp.einsum("bsd,de->bse", x, wqkv)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, n_heads, d // n_heads).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d // n_heads, jnp.bfloat16))
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1
+                           ).astype(jnp.bfloat16)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, d)
+    return jnp.einsum("bsd,de->bse", out, wo)
+
+
+def _rms_norm(x: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype))
+
+
+def forward(params: dict, tokens: jax.Array, cfg: dict) -> jax.Array:
+    x = params["embed"][tokens] + params["pos"][None, : tokens.shape[1]]
+
+    def layer(x, layer_params):
+        wqkv, wo, w1, w2 = (layer_params["wqkv"], layer_params["wo"],
+                            layer_params["w1"], layer_params["w2"])
+        x = x + _attention(_rms_norm(x), wqkv, wo, cfg["n_heads"])
+        h = jnp.einsum("bsd,df->bsf", _rms_norm(x), w1)
+        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.gelu(h), w2)
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return jnp.einsum("bsd,dv->bsv", _rms_norm(x), params["unembed"])
+
+
+def loss_fn(params: dict, batch: dict, cfg: dict) -> jax.Array:
+    logits = forward(params, batch["tokens"], cfg).astype(jnp.float32)
+    targets = batch["targets"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def sgd_train_step(params: dict, batch: dict, cfg: dict,
+                   lr: float = 1e-2) -> tuple[dict, jax.Array]:
+    loss, grads = jax.value_and_grad(functools.partial(loss_fn, cfg=cfg))(
+        params, batch)
+    new_params = jax.tree.map(
+        lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+                      ).astype(p.dtype), params, grads)
+    return new_params, loss
+
+
+def make_batch(key: jax.Array, cfg: dict, batch_size: int = 8) -> dict:
+    tokens = jax.random.randint(key, (batch_size, cfg["seq_len"]), 0,
+                                cfg["vocab"])
+    return {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+
+# ---------------------------------------------------------------------------
+# Sharded training (dp x tp over a ("data", "model") mesh)
+# ---------------------------------------------------------------------------
+
+def param_shardings(mesh: Mesh) -> dict:
+    """Weights: model-parallel over FFN/head dims; embeddings replicated
+    (small); batch over data."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "embed": ns(),
+        "pos": ns(),
+        "layers": {
+            "wqkv": ns(None, None, "model"),
+            "wo": ns(None, "model", None),
+            "w1": ns(None, None, "model"),
+            "w2": ns(None, "model", None),
+        },
+        "unembed": ns(None, "model"),
+    }
+
+
+def batch_sharding(mesh: Mesh) -> dict:
+    return {"tokens": NamedSharding(mesh, P("data", None)),
+            "targets": NamedSharding(mesh, P("data", None))}
+
+
+def make_sharded_train_step(mesh: Mesh, cfg: dict, lr: float = 1e-2):
+    """jit the train step with explicit in/out shardings over the mesh.
+    XLA inserts the collectives (psum of grads over data, all-gather /
+    reduce-scatter along model) — nothing hand-written."""
+    p_shard = param_shardings(mesh)
+    b_shard = batch_sharding(mesh)
+
+    step = jax.jit(
+        functools.partial(sgd_train_step, cfg=cfg, lr=lr),
+        in_shardings=(p_shard, b_shard),
+        out_shardings=(p_shard, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    return step
+
+
+def make_mesh(devices=None, data: int | None = None,
+              model: int | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if data is None or model is None:
+        model = 2 if n % 2 == 0 and n > 1 else 1
+        data = n // model
+    import numpy as np
+    grid = np.asarray(devices).reshape(data, model)
+    return Mesh(grid, ("data", "model"))
