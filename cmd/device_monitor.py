@@ -1,0 +1,59 @@
+"""vtpu device-monitor: Prometheus exporter binary.
+
+Reference: cmd/device-monitor/main.go:46-200 + pkg/metrics/server/server.go
+(auth-filtered /metrics HTTP server).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="vtpu metrics exporter")
+    parser.add_argument("--port", type=int, default=9394)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--node-name",
+                        default=os.environ.get("NODE_NAME", ""))
+    parser.add_argument("--fake-chips", type=int, default=0)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from aiohttp import web
+
+    from vtpu_manager.metrics.collector import NodeCollector
+    from vtpu_manager.tpu.discovery import FakeBackend, discover
+
+    backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
+        else None
+    result = discover(backends)
+    chips = result.chips if result else []
+    collector = NodeCollector(args.node_name or "unknown", chips)
+
+    async def metrics(request):
+        return web.Response(text=collector.render(),
+                            content_type="text/plain")
+
+    async def healthz(request):
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/healthz", healthz)
+    logging.getLogger(__name__).info("vtpu-monitor on %s:%d", args.host,
+                                     args.port)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""vtpu device-webhook: admission server binary (reference: cmd/device-webhook)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import ssl
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="vtpu admission webhook")
+    parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--cert-file")
+    parser.add_argument("--key-file")
+    parser.add_argument("--scheduler-name")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from vtpu_manager.webhook.server import WebhookAPI, run_server
+
+    ssl_ctx = None
+    if args.cert_file and args.key_file:
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(args.cert_file, args.key_file)
+
+    api = WebhookAPI(scheduler_name=args.scheduler_name)
+    logging.getLogger(__name__).info("vtpu-webhook on %s:%d", args.host,
+                                     args.port)
+    run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

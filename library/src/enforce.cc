@@ -216,8 +216,9 @@ void RecordOwnBytes(int slot) {
   const VtpuDevice* cfg = DeviceCfg(slot);
   if (!g_vmem || !cfg) return;
   int me = (int)getpid();
-  uint64_t mine =
-      (uint64_t)State().hot[slot].used_bytes.load(std::memory_order_relaxed);
+  int64_t raw =
+      State().hot[slot].used_bytes.load(std::memory_order_relaxed);
+  uint64_t mine = raw > 0 ? (uint64_t)raw : 0;
   // Cross-process lock: two first-time writers must not claim the same free
   // slot (the loser's record would vanish and co-tenant caps undercount).
   VmemLock lock;
@@ -295,10 +296,9 @@ int64_t HostBufferBytes(const PJRT_Client_BufferFromHostBuffer_Args* args) {
   return elems * ElementBytes(args->type);
 }
 
-// Cached co-tenant usage, refreshed by the watcher tick — the alloc hot
-// path must not pay a cross-process flock + 1024-entry ledger scan (+ one
-// kill() per live entry) per buffer. The slow, exact scan still runs under
-// the device lock right before declaring OOM.
+// Cached co-tenant usage for the *display* path (MemoryStats); admission
+// uses an exact under-lock scan — the ledger scan costs microseconds and a
+// stale cache would let concurrent tenants jointly overshoot physical HBM.
 std::atomic<int64_t> g_others_cache[kMaxDeviceCount];
 
 void RefreshOthersCache() {
@@ -317,44 +317,44 @@ void UpdatePeak(int slot, int64_t used) {
   }
 }
 
-// Reserve-then-call: the cap check and the charge are one atomic step (a
-// check-then-charge split would let two concurrent allocations both pass
-// the check and land past the cap). Fast path uses atomics + cached
-// co-tenant bytes; only when that sum would exceed the cap do we take the
-// device lock and redo the check with a fresh ledger scan.
+// Reserve-then-call: the cap check and the charge are one atomic step under
+// the cross-process device lock (a check-then-charge split would let two
+// concurrent allocations both pass and land past the cap). Accounting is
+// uniform for unlimited devices too (no cap check, but used_bytes must
+// balance against destroy-time credits).
 PJRT_Error* ReserveMemory(int slot, int64_t bytes) {
   const VtpuDevice* cfg = DeviceCfg(slot);
-  if (!cfg || !cfg->memory_limit || bytes <= 0) return nullptr;
+  if (!cfg || bytes <= 0) return nullptr;
   ShimState& s = State();
+  if (!cfg->memory_limit) {
+    UpdatePeak(slot, s.hot[slot].used_bytes.fetch_add(
+                         bytes, std::memory_order_relaxed) + bytes);
+    return nullptr;
+  }
   int64_t cap = (int64_t)cfg->total_memory;
-  int64_t own = s.hot[slot].used_bytes.fetch_add(
-                    bytes, std::memory_order_relaxed) + bytes;
-  int64_t others = g_others_cache[slot].load(std::memory_order_relaxed);
-  if (own + others <= cap) {
-    UpdatePeak(slot, own);
-    return nullptr;
-  }
-  // Slow path: exact co-tenant view under the cross-process lock.
   DeviceLock lock(cfg->host_index);
-  others = OtherProcsBytes(slot);
+  int64_t own = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
+  int64_t others = OtherProcsBytes(slot);
   g_others_cache[slot].store(others, std::memory_order_relaxed);
-  if (own + others <= cap) {
-    UpdatePeak(slot, own);
-    return nullptr;
+  if (own + others + bytes > cap) {
+    g_metrics.oom_rejected.Bump();
+    return MakeError(
+        PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "vtpu-control: HBM cap exceeded on device %d: "
+        "req=%" PRId64 "B used=%" PRId64 "B co-tenants=%" PRId64
+        "B cap=%" PRId64 "B",
+        cfg->host_index, bytes, own, others, cap);
   }
-  s.hot[slot].used_bytes.fetch_sub(bytes, std::memory_order_relaxed);
-  g_metrics.oom_rejected.Bump();
-  return MakeError(
-      PJRT_Error_Code_RESOURCE_EXHAUSTED,
-      "vtpu-control: HBM cap exceeded on device %d: "
-      "req=%" PRId64 "B used=%" PRId64 "B co-tenants=%" PRId64
-      "B cap=%" PRId64 "B",
-      cfg->host_index, bytes, own - bytes, others, cap);
+  // fetch_add, not store: concurrent destroys may subtract while we hold
+  // the lock (reserves are serialized by the lock; frees only help).
+  UpdatePeak(slot, s.hot[slot].used_bytes.fetch_add(
+                       bytes, std::memory_order_relaxed) + bytes);
+  return nullptr;
 }
 
 void UnreserveMemory(int slot, int64_t bytes) {
   const VtpuDevice* cfg = DeviceCfg(slot);
-  if (!cfg || !cfg->memory_limit || bytes <= 0) return;
+  if (!cfg || bytes <= 0) return;
   State().hot[slot].used_bytes.fetch_sub(bytes, std::memory_order_relaxed);
 }
 

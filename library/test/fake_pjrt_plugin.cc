@@ -235,19 +235,25 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   int64_t dur = ExecUs();
   // Simulate a serialized device: each execute occupies the chip for `dur`.
   for (size_t d = 0; d < args->num_devices; d++) {
+    // Distinct events for the caller (device_complete) and the buffer
+    // (ReadyEvent): both sides destroy their own, so sharing one object
+    // would double-free. EventDestroy is a no-op in this fake, so the
+    // small per-exec leak is intentional.
     FakeEvent* done = new FakeEvent();
+    FakeEvent* out_ready = new FakeEvent();
     if (args->output_lists && args->output_lists[d]) {
       auto* out = new FakeBuffer{OutBytes()};
-      out->ready = done;  // output becomes ready when the exec completes
+      out->ready = out_ready;
       args->output_lists[d][0] = reinterpret_cast<PJRT_Buffer*>(out);
       if (g_client) g_client->bytes_in_use.fetch_add(OutBytes());
     }
     if (args->device_complete_events) {
       args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(done);
     }
-    std::thread([done, dur] {
+    std::thread([done, out_ready, dur] {
       std::lock_guard<std::mutex> g(g_exec_mu);  // device serialization
       usleep((useconds_t)dur);
+      out_ready->MarkReady();
       done->MarkReady();
     }).detach();
   }

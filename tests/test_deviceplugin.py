@@ -129,6 +129,35 @@ class TestAllocate:
             anns[consts.real_allocated_annotation()])
         assert real.all_claims()[0].uuid == chip.uuid
 
+    def test_multi_container_pod_both_enforced(self, plugin):
+        # container B must stay pending after container A's Allocate
+        # patched the real-allocated annotation
+        p, client, mgr = plugin
+        pod = committed_pod(mgr, chip_idx=0)
+        claims = PodDeviceClaims.decode(
+            pod["metadata"]["annotations"][consts.pre_allocated_annotation()])
+        chip1 = mgr.chips[1]
+        claims.add("side", DeviceClaim(chip1.uuid, chip1.index, 20, 2**30))
+        pod["metadata"]["annotations"][consts.pre_allocated_annotation()] = \
+            claims.encode()
+        pod["spec"]["containers"].append({"name": "side"})
+        client.add_pod(pod)
+        chip0 = mgr.chips[0]
+        r1 = p.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[device_id(chip0.uuid, 0)])]))
+        assert f"{consts.ENV_CORE_LIMIT}_0" in \
+            r1.container_responses[0].envs
+        r2 = p.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[device_id(chip1.uuid, 0)])]))
+        envs2 = r2.container_responses[0].envs
+        assert envs2[f"{consts.ENV_CORE_LIMIT}_0"] == "20"  # enforced!
+        real = PodDeviceClaims.decode(
+            client.get_pod("default", "p1")["metadata"]["annotations"][
+                consts.real_allocated_annotation()])
+        assert set(real.containers) == {"main", "side"}
+
     def test_balance_policy_soft_limit(self, plugin):
         p, client, mgr = plugin
         pod = committed_pod(mgr, cores=30, annotations={

@@ -1,0 +1,53 @@
+"""Metrics collector: gauges from configs + watcher feed + ledger."""
+
+import os
+
+from vtpu_manager.config import tc_watcher, vtpu_config as vc
+from vtpu_manager.config.vmem import VmemLedger
+from vtpu_manager.device.types import fake_chip
+from vtpu_manager.metrics.collector import NodeCollector
+
+
+def test_collector_renders_gauges(tmp_path):
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0), fake_chip(1)]
+
+    # a container allocation on chip 0
+    cont_dir = os.path.join(base, "uid-1_main", "config")
+    os.makedirs(cont_dir)
+    vc.write_config(os.path.join(cont_dir, "vtpu.config"), vc.VtpuConfig(
+        pod_uid="uid-1", container_name="main",
+        devices=[vc.DeviceConfig(uuid=chips[0].uuid, total_memory=2**30,
+                                 real_memory=chips[0].memory, hard_core=40,
+                                 host_index=0)]))
+
+    # watcher feed + ledger
+    tc_path = str(tmp_path / "tc_util.config")
+    tc = tc_watcher.TcUtilFile(tc_path, create=True)
+    tc.write_device(0, tc_watcher.DeviceUtil(timestamp_ns=1,
+                                             device_util=37))
+    tc.close()
+    vmem_path = str(tmp_path / "vmem.config")
+    led = VmemLedger(vmem_path, create=True)
+    led.record(os.getpid(), 0, 123456)
+    led.close()
+
+    text = NodeCollector("n1", chips, base_dir=base, tc_path=tc_path,
+                         vmem_path=vmem_path).render()
+    assert 'vtpu_device_memory_total_bytes{node="n1",uuid="TPU-FAKE-0000"' \
+        in text
+    assert 'vtpu_device_utilization_percent{node="n1",' \
+        'uuid="TPU-FAKE-0000",index="0"} 37.0' in text
+    assert 'vtpu_container_core_limit_percent{node="n1",pod_uid="uid-1",' \
+        'container="main",uuid="TPU-FAKE-0000"} 40.0' in text
+    assert 'vtpu_container_memory_used_bytes' in text
+    assert "123456" in text
+    assert 'vtpu_node_slots_total{node="n1"} 20.0' in text
+    assert 'vtpu_node_slots_assigned{node="n1"} 1.0' in text
+
+
+def test_collector_empty_node(tmp_path):
+    text = NodeCollector("n1", [], base_dir=str(tmp_path / "none"),
+                         tc_path="/nonexistent",
+                         vmem_path="/nonexistent").render()
+    assert "vtpu_node_slots_total" in text

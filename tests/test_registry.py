@@ -1,0 +1,95 @@
+"""Registry server: peercred attestation, pid publication, spoof defense.
+
+Mirrors the reference's security tests (pkg/device/registry/
+security_test.go): a client claiming another pod's identity must be
+rejected because the kernel-attested pid's cgroup does not embed that
+pod's uid.
+"""
+
+import os
+
+import pytest
+
+from vtpu_manager.registry.server import (RegistryServer, read_pids_config,
+                                          write_pids_config)
+from vtpu_manager.runtime import client as rt_client
+from vtpu_manager.util import consts
+
+
+@pytest.fixture
+def registry(tmp_path, monkeypatch):
+    base = tmp_path / "mgr"
+    base.mkdir()
+    sock = str(tmp_path / "registry.sock")
+
+    # attested world: our own pid belongs to pod 'uid-good'
+    def cgroup_of_pid(pid):
+        return f"/kubepods/burstable/poduid-good/{pid}"
+
+    def pids_in_cgroup(cgroup):
+        return [os.getpid(), 4242]
+
+    server = RegistryServer(socket_path=sock, base_dir=str(base),
+                            cgroup_of_pid=cgroup_of_pid,
+                            pids_in_cgroup=pids_in_cgroup)
+    server.start()
+    monkeypatch.setattr(consts, "REGISTRY_SOCKET", sock, raising=False)
+    yield server, base, sock
+    server.stop()
+
+
+def register(sock, pod_uid, container, monkeypatch):
+    monkeypatch.setenv(consts.ENV_POD_UID, pod_uid)
+    monkeypatch.setenv(consts.ENV_CONTAINER_NAME, container)
+    monkeypatch.setenv(consts.ENV_POD_NAME, "p")
+    monkeypatch.setenv(consts.ENV_POD_NAMESPACE, "ns")
+    import vtpu_manager.runtime.client as rc
+    import vtpu_manager.util.consts as c
+    orig = c.REGISTRY_SOCKET
+    c.REGISTRY_SOCKET = sock
+    try:
+        return rc.register_client(timeout_s=5)
+    finally:
+        c.REGISTRY_SOCKET = orig
+
+
+class TestRegistry:
+    def test_successful_registration(self, registry, monkeypatch):
+        server, base, sock = registry
+        (base / "uid-good_main").mkdir()
+        assert register(sock, "uid-good", "main", monkeypatch)
+        pids = read_pids_config(
+            str(base / "uid-good_main" / consts.PIDS_CONFIG_NAME))
+        assert os.getpid() in pids and 4242 in pids
+        assert server.registrations[0]["pod_uid"] == "uid-good"
+
+    def test_spoofed_identity_rejected(self, registry, monkeypatch):
+        server, base, sock = registry
+        (base / "uid-other_main").mkdir()
+        # we claim pod uid-other but our cgroup says uid-good
+        assert not register(sock, "uid-other", "main", monkeypatch)
+        assert not os.path.exists(
+            str(base / "uid-other_main" / consts.PIDS_CONFIG_NAME))
+
+    def test_unallocated_container_rejected(self, registry, monkeypatch):
+        server, base, sock = registry
+        # no uid-good_ghost dir was created by any Allocate
+        assert not register(sock, "uid-good", "ghost", monkeypatch)
+
+    def test_malformed_payload(self, registry, monkeypatch):
+        server, base, sock = registry
+        assert not register(sock, "", "", monkeypatch)
+
+
+class TestPidsConfig:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "pids.config")
+        write_pids_config(path, [1, 99, 100000])
+        assert read_pids_config(path) == [1, 99, 100000]
+
+    def test_corrupt(self, tmp_path):
+        path = str(tmp_path / "pids.config")
+        with open(path, "wb") as f:
+            f.write(b"\0" * 16)
+        with pytest.raises(ValueError):
+            read_pids_config(path)

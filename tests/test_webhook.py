@@ -1,0 +1,167 @@
+"""Webhook mutate/validate + AdmissionReview HTTP round trip."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from vtpu_manager.util import consts
+from vtpu_manager.webhook.mutate import mutate_pod, requests_vtpu
+from vtpu_manager.webhook.validate import validate_pod
+
+
+def vtpu_pod(number=1, cores=50, memory=1024, annotations=None, spec=None):
+    pod = {
+        "metadata": {"name": "p", "namespace": "default",
+                     "annotations": annotations},
+        "spec": {"containers": [{"name": "c", "resources": {"limits": {
+            consts.vtpu_number_resource(): number,
+            consts.vtpu_cores_resource(): cores,
+            consts.vtpu_memory_resource(): memory}}}]},
+    }
+    if spec:
+        pod["spec"].update(spec)
+    return pod
+
+
+def apply_patches(pod, patches):
+    """Minimal RFC-6902 applier for assertions."""
+    import copy
+    doc = copy.deepcopy(pod)
+    for patch in patches:
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in patch["path"].lstrip("/").split("/")]
+        parent = doc
+        for key in parts[:-1]:
+            parent = parent[key]
+        if patch["op"] in ("add", "replace"):
+            parent[parts[-1]] = patch["value"]
+        elif patch["op"] == "remove":
+            del parent[parts[-1]]
+    return doc
+
+
+class TestMutate:
+    def test_non_vtpu_untouched(self):
+        pod = {"spec": {"containers": [{"name": "c", "resources": {}}]},
+               "metadata": {}}
+        assert not requests_vtpu(pod)
+        assert mutate_pod(pod).patches == []
+
+    def test_defaults_applied(self):
+        result = mutate_pod(vtpu_pod())
+        mutated = apply_patches(vtpu_pod(), result.patches)
+        anns = mutated["metadata"]["annotations"]
+        assert anns[consts.node_policy_annotation()] == "binpack"
+        assert anns[consts.topology_mode_annotation()] == "none"
+        assert mutated["spec"]["schedulerName"] == \
+            consts.DEFAULT_SCHEDULER_NAME
+
+    def test_invalid_policy_reset(self):
+        pod = vtpu_pod(annotations={
+            consts.node_policy_annotation(): "bogus"})
+        result = mutate_pod(pod)
+        mutated = apply_patches(pod, result.patches)
+        assert mutated["metadata"]["annotations"][
+            consts.node_policy_annotation()] == "binpack"
+        assert result.warnings
+
+    def test_nodename_bypass_converted(self):
+        pod = vtpu_pod(spec={"nodeName": "node-7"})
+        result = mutate_pod(pod)
+        mutated = apply_patches(pod, result.patches)
+        assert "nodeName" not in mutated["spec"]
+        terms = mutated["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"][
+            "nodeSelectorTerms"]
+        assert terms[0]["matchFields"][0]["values"] == ["node-7"]
+
+    def test_stale_allocation_state_cleared(self):
+        pod = vtpu_pod(annotations={
+            consts.pre_allocated_annotation(): "v1:{}",
+            consts.allocation_status_annotation(): "succeed"})
+        result = mutate_pod(pod)
+        mutated = apply_patches(pod, result.patches)
+        anns = mutated["metadata"]["annotations"]
+        assert consts.pre_allocated_annotation() not in anns
+        assert consts.allocation_status_annotation() not in anns
+
+    def test_custom_scheduler_respected(self):
+        pod = vtpu_pod(spec={"schedulerName": "my-sched"})
+        result = mutate_pod(pod)
+        assert not any(p["path"] == "/spec/schedulerName"
+                       for p in result.patches)
+
+
+class TestValidate:
+    def test_valid(self):
+        assert validate_pod(vtpu_pod()).allowed
+
+    def test_cores_out_of_range(self):
+        result = validate_pod(vtpu_pod(cores=150))
+        assert not result.allowed
+        assert "vtpu-cores" in result.message
+
+    def test_cores_without_number(self):
+        pod = {"metadata": {}, "spec": {"containers": [{
+            "name": "c", "resources": {"limits": {
+                consts.vtpu_cores_resource(): 50}}}]}}
+        result = validate_pod(pod)
+        assert not result.allowed
+
+    def test_absurd_number(self):
+        result = validate_pod(vtpu_pod(number=1000))
+        assert not result.allowed
+
+    def test_gang_combination(self):
+        pod = vtpu_pod(annotations={consts.gang_name_annotation(): "g",
+                                    consts.gang_size_annotation(): "0"})
+        result = validate_pod(pod)
+        assert not result.allowed
+
+    def test_oversold_with_ici_denied(self):
+        pod = vtpu_pod(annotations={
+            consts.topology_mode_annotation(): "ici",
+            consts.memory_oversold_annotation(): "true"})
+        assert not validate_pod(pod).allowed
+
+
+class TestAdmissionHTTP:
+    def _review(self, pod):
+        return {"apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": "rev-1", "object": pod}}
+
+    def test_mutate_endpoint(self):
+        from aiohttp.test_utils import TestClient, TestServer
+        from vtpu_manager.webhook.server import WebhookAPI
+
+        async def scenario():
+            api = WebhookAPI()
+            async with TestClient(TestServer(api.build_app())) as client:
+                resp = await client.post("/pods/mutate",
+                                         json=self._review(vtpu_pod()))
+                body = await resp.json()
+                r = body["response"]
+                assert r["uid"] == "rev-1" and r["allowed"]
+                patches = json.loads(base64.b64decode(r["patch"]))
+                assert any(p["path"] == "/spec/schedulerName"
+                           for p in patches)
+
+        asyncio.run(scenario())
+
+    def test_validate_endpoint_denies(self):
+        from aiohttp.test_utils import TestClient, TestServer
+        from vtpu_manager.webhook.server import WebhookAPI
+
+        async def scenario():
+            api = WebhookAPI()
+            async with TestClient(TestServer(api.build_app())) as client:
+                resp = await client.post(
+                    "/pods/validate", json=self._review(vtpu_pod(cores=200)))
+                body = await resp.json()
+                assert not body["response"]["allowed"]
+                assert "vtpu-cores" in body["response"]["status"]["message"]
+
+        asyncio.run(scenario())

@@ -96,35 +96,49 @@ class VnumPlugin(DevicePluginServicer):
     def _pending_allocations(self) -> list[tuple[dict, str,
                                                  list[DeviceClaim]]]:
         """(pod, container_name, claims) for containers the scheduler
-        committed on this node but the plugin has not served yet."""
+        committed on this node but the plugin has not served yet.
+
+        One cluster pod list, filtered locally: bound pods carry nodeName,
+        while freshly-bound ones may only carry the predicate-node
+        annotation (watch lag); dedup by uid. Per-container pending: a pod
+        stays pending for container B after container A's Allocate patched
+        the real-allocated annotation (which then covers only A).
+        """
         out = []
         try:
-            pods = self.client.list_pods(node_name=self.node_name)
+            all_pods = self.client.list_pods()
         except KubeError:
             return out
-        # pods bound moments ago may not carry nodeName in the cache yet;
-        # include node-less pods whose predicate-node matches us
-        try:
-            for pod in self.client.list_pods():
-                anns = (pod.get("metadata") or {}).get("annotations") or {}
-                if anns.get(consts.predicate_node_annotation()) == \
-                        self.node_name and pod not in pods:
-                    pods.append(pod)
-        except KubeError:
-            pass
+        seen_uids: set[str] = set()
+        pods = []
+        for pod in all_pods:
+            meta = pod.get("metadata") or {}
+            uid = meta.get("uid", "")
+            if uid in seen_uids:
+                continue
+            anns = meta.get("annotations") or {}
+            on_node = ((pod.get("spec") or {}).get("nodeName") ==
+                       self.node_name or
+                       anns.get(consts.predicate_node_annotation()) ==
+                       self.node_name)
+            if on_node:
+                seen_uids.add(uid)
+                pods.append(pod)
         with self._served_lock:
             served = set(self._served)
+        from vtpu_manager.device.claims import try_decode
         for pod in pods:
             meta = pod.get("metadata") or {}
             anns = meta.get("annotations") or {}
-            if anns.get(consts.real_allocated_annotation()):
+            pre = try_decode(anns.get(consts.pre_allocated_annotation()))
+            if pre is None:
                 continue
-            claims = get_pod_device_claims(pod)
-            if claims is None:
-                continue
+            real = try_decode(anns.get(consts.real_allocated_annotation()))
+            done_containers = set(real.containers) if real else set()
             uid = meta.get("uid", "")
-            for cont, cont_claims in claims.containers.items():
-                if cont_claims and (uid, cont) not in served:
+            for cont, cont_claims in pre.containers.items():
+                if (cont_claims and cont not in done_containers
+                        and (uid, cont) not in served):
                     out.append((pod, cont, cont_claims))
         return out
 
@@ -227,7 +241,13 @@ class VnumPlugin(DevicePluginServicer):
 
     def _claims_annotation(self, pod: dict, cont: str,
                            claims: list[DeviceClaim]) -> str:
-        existing = get_pod_device_claims(pod) or PodDeviceClaims()
+        """Merge this container into the REAL allocation annotation only —
+        seeding from the pre-allocation would promote other containers'
+        uncommitted claims to 'real'."""
+        from vtpu_manager.device.claims import try_decode
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        existing = try_decode(anns.get(consts.real_allocated_annotation())) \
+            or PodDeviceClaims()
         existing.containers[cont] = claims
         return existing.encode()
 
@@ -353,6 +373,12 @@ class VnumPlugin(DevicePluginServicer):
                     records = json.load(f)
             except (OSError, json.JSONDecodeError):
                 records = {}
+        # prune records no allocation can still reference (a week covers
+        # any kubelet checkpoint lifetime; stale entries must not shadow a
+        # new tenant's record in PreStartContainer)
+        cutoff = time.time() - 7 * 24 * 3600
+        records = {k: v for k, v in records.items()
+                   if v.get("ts", 0) >= cutoff}
         records[f"{pod_uid}/{cont}"] = {
             "devices": dev_ids,
             "claims": [c.to_wire() for c in claims],
@@ -378,7 +404,15 @@ class VnumPlugin(DevicePluginServicer):
                     records = json.load(f)
             except (OSError, json.JSONDecodeError):
                 records = {}
-        for key, rec in records.items():
+        # exact device-id match first (slots included), newest first; a
+        # uuid-multiset fallback only when no exact record exists — a stale
+        # tenant's same-chip record must not shadow the new allocation
+        ordered = sorted(records.items(),
+                         key=lambda kv: kv[1].get("ts", 0), reverse=True)
+        exact = [kv for kv in ordered
+                 if sorted(kv[1].get("devices", [])) == sorted(dev_ids)]
+        candidates = exact or ordered
+        for key, rec in candidates:
             claims = [DeviceClaim.from_wire(c) for c in rec.get("claims", [])]
             if Counter(c.uuid for c in claims) != want:
                 continue

@@ -1,0 +1,162 @@
+"""Prometheus metrics collector for the node monitor.
+
+Reference: pkg/metrics/collector/node_gpu.go:77-972 (~25 gauges: physical
+device memory/util/health, node vTPU totals/assigned, per-vTPU assignment,
+shared-container counts, per-container limits/usage) and
+metrics/lister/container_lister.go (container <-> pod mapping).
+
+The per-container usage source is the per-container vtpu.config (mmap'd by
+the reference; plainly read here — the files are tiny) joined with the vmem
+ledger and the tc_util feed, all node-local.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.tc_watcher import TcUtilFile
+from vtpu_manager.config.vmem import VmemLedger
+from vtpu_manager.device.types import ChipSpec
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+
+class Gauge:
+    def __init__(self, name: str, help_text: str, labels: tuple[str, ...]):
+        self.name = name
+        self.help = help_text
+        self.labels = labels
+        self.samples: list[tuple[tuple[str, ...], float]] = []
+
+    def set(self, label_values: tuple[str, ...], value: float) -> None:
+        self.samples.append((label_values, value))
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        for values, value in self.samples:
+            label_str = ",".join(f'{k}="{v}"'
+                                 for k, v in zip(self.labels, values))
+            lines.append(f"{self.name}{{{label_str}}} {value}")
+        return "\n".join(lines)
+
+
+class NodeCollector:
+    """Collects one scrape's worth of node + container gauges."""
+
+    def __init__(self, node_name: str, chips: list[ChipSpec],
+                 base_dir: str = consts.MANAGER_BASE_DIR,
+                 tc_path: str = consts.TC_UTIL_CONFIG,
+                 vmem_path: str = consts.VMEM_NODE_CONFIG):
+        self.node_name = node_name
+        self.chips = chips
+        self.base_dir = base_dir
+        self.tc_path = tc_path
+        self.vmem_path = vmem_path
+
+    def _container_configs(self) -> list[tuple[str, str, vc.VtpuConfig]]:
+        out = []
+        if not os.path.isdir(self.base_dir):
+            return out
+        for entry in sorted(os.listdir(self.base_dir)):
+            cfg_path = os.path.join(self.base_dir, entry, "config",
+                                    "vtpu.config")
+            if not os.path.exists(cfg_path):
+                continue
+            pod_uid, _, container = entry.partition("_")
+            try:
+                out.append((pod_uid, container, vc.read_config(cfg_path)))
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def collect(self) -> list[Gauge]:
+        gauges: list[Gauge] = []
+
+        g_mem_total = Gauge("vtpu_device_memory_total_bytes",
+                            "Physical HBM per chip",
+                            ("node", "uuid", "index"))
+        g_healthy = Gauge("vtpu_device_healthy",
+                          "Chip health (1 healthy)",
+                          ("node", "uuid", "index"))
+        g_util = Gauge("vtpu_device_utilization_percent",
+                       "Chip duty-cycle percent from the node watcher",
+                       ("node", "uuid", "index"))
+        g_slots_total = Gauge("vtpu_device_slots_total",
+                              "Advertised vTPU slots per chip",
+                              ("node", "uuid", "index"))
+        for chip in self.chips:
+            labels = (self.node_name, chip.uuid, str(chip.index))
+            g_mem_total.set(labels, float(chip.memory))
+            g_healthy.set(labels, 1.0 if chip.healthy else 0.0)
+            g_slots_total.set(labels, float(chip.split_count))
+        gauges += [g_mem_total, g_healthy, g_slots_total]
+
+        # node watcher feed
+        try:
+            tc = TcUtilFile(self.tc_path)
+            for chip in self.chips:
+                rec = tc.read_device(chip.index)
+                if rec is not None:
+                    g_util.set((self.node_name, chip.uuid, str(chip.index)),
+                               float(rec.device_util))
+            tc.close()
+        except (OSError, ValueError):
+            pass
+        gauges.append(g_util)
+
+        # per-container assignment + usage
+        g_climit = Gauge("vtpu_container_core_limit_percent",
+                         "Assigned core percent",
+                         ("node", "pod_uid", "container", "uuid"))
+        g_mlimit = Gauge("vtpu_container_memory_limit_bytes",
+                         "Assigned HBM cap",
+                         ("node", "pod_uid", "container", "uuid"))
+        g_musage = Gauge("vtpu_container_memory_used_bytes",
+                         "HBM bytes recorded by the container's processes",
+                         ("node", "pod_uid", "container", "uuid"))
+        g_assigned = Gauge("vtpu_device_assigned_containers",
+                           "Containers sharing each chip",
+                           ("node", "uuid"))
+        assigned: dict[str, int] = {}
+        vmem = None
+        try:
+            vmem = VmemLedger(self.vmem_path)
+        except (OSError, ValueError):
+            pass
+        per_device_usage: dict[int, int] = {}
+        if vmem is not None:
+            for entry in vmem.entries():
+                per_device_usage[entry.host_index] = \
+                    per_device_usage.get(entry.host_index, 0) + entry.bytes
+        for pod_uid, container, cfg in self._container_configs():
+            for dev in cfg.devices:
+                labels = (self.node_name, pod_uid, container, dev.uuid)
+                g_climit.set(labels, float(dev.hard_core))
+                g_mlimit.set(labels, float(dev.total_memory))
+                g_musage.set(labels,
+                             float(per_device_usage.get(dev.host_index, 0)))
+                assigned[dev.uuid] = assigned.get(dev.uuid, 0) + 1
+        if vmem is not None:
+            vmem.close()
+        for uuid, count in assigned.items():
+            g_assigned.set((self.node_name, uuid), float(count))
+        gauges += [g_climit, g_mlimit, g_musage, g_assigned]
+
+        # node aggregates
+        g_total = Gauge("vtpu_node_slots_total", "Node vTPU slot capacity",
+                        ("node",))
+        g_used = Gauge("vtpu_node_slots_assigned", "Assigned vTPU slots",
+                       ("node",))
+        g_total.set((self.node_name,),
+                    float(sum(c.split_count for c in self.chips)))
+        g_used.set((self.node_name,), float(sum(assigned.values())))
+        gauges += [g_total, g_used]
+        return gauges
+
+    def render(self) -> str:
+        return "\n".join(g.render() for g in self.collect() if g.samples
+                         or True) + "\n"

@@ -1,0 +1,202 @@
+"""Container registry server: kernel-attested pid attribution (ClientMode).
+
+Reference: pkg/device/registry/server.go:72-608 + peercred.go:17-60 — a
+gRPC-over-unix-socket service authenticated by SO_PEERCRED; it resolves the
+calling container and writes its pid set to pids.config so CLIENT-compat
+shims can attribute usage without mounting host /proc into tenants.
+
+Redesign notes: the transport is a length-prefixed JSON protocol over the
+unix socket (the client side lives in vtpu_manager.runtime.client); the
+authentication is identical — the kernel tells us the peer pid, and the
+pid's cgroup path must embed the claimed pod uid (kubelet names pod cgroups
+`...pod<uid>...`), so a container cannot register as another pod. The pid
+set is read from the attested cgroup's cgroup.procs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import socket
+import struct
+import threading
+from typing import Callable
+
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+SO_PEERCRED = getattr(socket, "SO_PEERCRED", 17)
+
+PIDS_MAGIC = 0x53444950  # "PIDS"
+_PIDS_HEADER = "<IIii"   # magic, version, count, pad
+
+
+def write_pids_config(path: str, pids: list[int]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(struct.pack(_PIDS_HEADER, PIDS_MAGIC, 1, len(pids), 0))
+        for pid in pids:
+            f.write(struct.pack("<i", pid))
+    os.replace(tmp, path)
+
+
+def read_pids_config(path: str) -> list[int]:
+    with open(path, "rb") as f:
+        raw = f.read()
+    magic, version, count, _ = struct.unpack_from(_PIDS_HEADER, raw, 0)
+    if magic != PIDS_MAGIC or version != 1 or count < 0:
+        raise ValueError(f"bad pids.config {path}")
+    return [struct.unpack_from("<i", raw, 16 + 4 * i)[0]
+            for i in range(count)]
+
+
+def _peercred(conn: socket.socket) -> tuple[int, int, int]:
+    raw = conn.getsockopt(socket.SOL_SOCKET, SO_PEERCRED,
+                          struct.calcsize("3i"))
+    return struct.unpack("3i", raw)   # pid, uid, gid
+
+
+def default_cgroup_of_pid(pid: int) -> str:
+    try:
+        with open(f"/proc/{pid}/cgroup") as f:
+            for line in f:
+                parts = line.strip().split(":", 2)
+                if len(parts) == 3:
+                    return parts[2]
+    except OSError:
+        pass
+    return ""
+
+
+def default_pids_in_cgroup(cgroup_path: str) -> list[int]:
+    procs = f"/sys/fs/cgroup{cgroup_path}/cgroup.procs"
+    try:
+        with open(procs) as f:
+            return [int(line) for line in f if line.strip()]
+    except OSError:
+        return []
+
+
+def _uid_in_cgroup(cgroup: str, pod_uid: str) -> bool:
+    """kubelet encodes the pod uid in the cgroup path with dashes or
+    underscores; normalize both."""
+    canon = re.sub(r"[-_]", "", cgroup.lower())
+    return re.sub(r"[-_]", "", pod_uid.lower()) in canon
+
+
+class RegistryServer:
+    def __init__(self, socket_path: str = consts.REGISTRY_SOCKET,
+                 base_dir: str = consts.MANAGER_BASE_DIR,
+                 cgroup_of_pid: Callable[[int], str] = default_cgroup_of_pid,
+                 pids_in_cgroup: Callable[[str], list[int]] =
+                 default_pids_in_cgroup):
+        self.socket_path = socket_path
+        self.base_dir = base_dir
+        self.cgroup_of_pid = cgroup_of_pid
+        self.pids_in_cgroup = pids_in_cgroup
+        self._sock: socket.socket | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.registrations: list[dict] = []   # observability for tests
+
+    # -- request handling ---------------------------------------------------
+
+    def handle_request(self, payload: dict, peer_pid: int) -> int:
+        """0 on success; nonzero error codes mirror the reference's status
+        replies. The peer pid is kernel-attested."""
+        pod_uid = str(payload.get("pod_uid", ""))
+        container = str(payload.get("container", ""))
+        if not pod_uid or not container:
+            return 2   # malformed identity
+        cgroup = self.cgroup_of_pid(peer_pid)
+        if not cgroup or not _uid_in_cgroup(cgroup, pod_uid):
+            log.warning("registry spoof attempt: pid %d cgroup %r does not "
+                        "match claimed pod %s", peer_pid, cgroup, pod_uid)
+            return 3   # identity not attested by the kernel
+        pids = self.pids_in_cgroup(cgroup)
+        if peer_pid not in pids:
+            pids.append(peer_pid)
+        cont_dir = os.path.join(self.base_dir, f"{pod_uid}_{container}")
+        if not os.path.isdir(cont_dir):
+            log.warning("registry: no allocation dir for %s/%s", pod_uid,
+                        container)
+            return 4   # not an allocated container on this node
+        write_pids_config(os.path.join(cont_dir, consts.PIDS_CONFIG_NAME),
+                          sorted(set(pids)))
+        self.registrations.append({"pod_uid": pod_uid,
+                                   "container": container,
+                                   "peer_pid": peer_pid,
+                                   "pids": sorted(set(pids))})
+        return 0
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(5)
+            pid, _, _ = _peercred(conn)
+            raw_len = conn.recv(4)
+            if len(raw_len) < 4:
+                return
+            (length,) = struct.unpack("<I", raw_len)
+            if length > 64 * 1024:
+                conn.sendall(struct.pack("<i", 1))
+                return
+            data = b""
+            while len(data) < length:
+                chunk = conn.recv(length - len(data))
+                if not chunk:
+                    return
+                data += chunk
+            try:
+                payload = json.loads(data)
+            except json.JSONDecodeError:
+                conn.sendall(struct.pack("<i", 1))
+                return
+            status = self.handle_request(payload, pid)
+            conn.sendall(struct.pack("<i", status))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        os.chmod(self.socket_path, 0o666)   # tenants must be able to connect
+        self._sock.listen(16)
+        self._sock.settimeout(0.5)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtpu-registry")
+        self._thread.start()
+        log.info("registry serving on %s", self.socket_path)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        if self._sock:
+            self._sock.close()
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass

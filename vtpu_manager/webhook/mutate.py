@@ -1,0 +1,127 @@
+"""Pod mutating admission: normalize vtpu pods before scheduling.
+
+Reference: pkg/webhook/pod/mutate/pod_mutate.go:175-242 — default
+schedulerName, default node/device/topology policy annotations, fix
+nodeName-bypassing pods (:146-156), clean invalid annotations; :244-420
+optionally rewrites vtpu-* extended resources into DRA ResourceClaims.
+
+Mutations are returned as RFC-6902 JSON Patch operations (the admission
+wire contract).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+_POLICY_ANNOTATIONS = {}
+
+
+def _ann_defaults() -> dict[str, tuple[str, tuple[str, ...]]]:
+    return {
+        consts.node_policy_annotation():
+            (consts.NODE_POLICY_BINPACK, consts.NODE_POLICIES),
+        consts.device_policy_annotation():
+            (consts.DEVICE_POLICY_BINPACK, consts.DEVICE_POLICIES),
+        consts.topology_mode_annotation():
+            (consts.TOPOLOGY_NONE, consts.TOPOLOGY_MODES),
+        consts.compute_policy_annotation():
+            (consts.COMPUTE_POLICY_FIXED, consts.COMPUTE_POLICIES),
+    }
+
+
+def requests_vtpu(pod: dict) -> bool:
+    spec = pod.get("spec") or {}
+    for cont in (spec.get("containers") or []) + \
+            (spec.get("initContainers") or []):
+        res = (cont.get("resources") or {})
+        for section in (res.get("limits") or {}), (res.get("requests") or {}):
+            if any(k.startswith(f"{consts.resource_domain()}/vtpu-")
+                   for k in section):
+                return True
+    return False
+
+
+@dataclass
+class MutateResult:
+    patches: list[dict] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+
+
+def _escape(path: str) -> str:
+    return path.replace("~", "~0").replace("/", "~1")
+
+
+def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
+               set_scheduler: bool = True) -> MutateResult:
+    result = MutateResult()
+    if not requests_vtpu(pod):
+        return result
+    meta = pod.get("metadata") or {}
+    spec = pod.get("spec") or {}
+    anns = meta.get("annotations")
+
+    if anns is None:
+        result.patches.append({"op": "add",
+                               "path": "/metadata/annotations",
+                               "value": {}})
+        anns = {}
+
+    # scheduler routing: vtpu pods must pass through the extender-configured
+    # scheduler; a directly-set nodeName bypasses scheduling entirely and
+    # would never receive a device claim
+    if set_scheduler and spec.get("schedulerName") in (None, "",
+                                                       "default-scheduler"):
+        result.patches.append({"op": "add" if "schedulerName" not in spec
+                               else "replace",
+                               "path": "/spec/schedulerName",
+                               "value": scheduler_name})
+    if spec.get("nodeName"):
+        result.warnings.append(
+            f"pod sets spec.nodeName={spec['nodeName']!r} directly; vtpu "
+            "devices cannot be claimed without scheduling — nodeName "
+            "converted to a node affinity")
+        result.patches.append({"op": "remove", "path": "/spec/nodeName"})
+        affinity = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchFields": [{
+                        "key": "metadata.name", "operator": "In",
+                        "values": [spec["nodeName"]]}]}]}}}
+        result.patches.append({"op": "add", "path": "/spec/affinity",
+                               "value": affinity})
+
+    # default / clean policy annotations
+    for ann, (default, valid) in _ann_defaults().items():
+        current = anns.get(ann)
+        if current is None:
+            result.patches.append({
+                "op": "add",
+                "path": f"/metadata/annotations/{_escape(ann)}",
+                "value": default})
+        elif current not in valid:
+            result.warnings.append(
+                f"annotation {ann}={current!r} invalid; reset to "
+                f"{default!r}")
+            result.patches.append({
+                "op": "replace",
+                "path": f"/metadata/annotations/{_escape(ann)}",
+                "value": default})
+
+    # stale allocation state must never be admitted (a re-created pod
+    # carrying old claims would corrupt NodeInfo accounting)
+    for stale in (consts.pre_allocated_annotation(),
+                  consts.real_allocated_annotation(),
+                  consts.predicate_node_annotation(),
+                  consts.predicate_time_annotation(),
+                  consts.allocation_status_annotation()):
+        if stale in anns:
+            result.warnings.append(f"cleared stale annotation {stale}")
+            result.patches.append({
+                "op": "remove",
+                "path": f"/metadata/annotations/{_escape(stale)}"})
+    return result

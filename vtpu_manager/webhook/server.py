@@ -1,0 +1,91 @@
+"""AdmissionReview HTTP server for the mutating/validating webhooks.
+
+Reference: pkg/webhook (G10) — HTTPS admission endpoints /pods/mutate and
+/pods/validate (pod_mutate.go:35, pod_validate.go:41). Speaks
+admission.k8s.io/v1 AdmissionReview; mutations are base64 JSONPatch.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+
+from aiohttp import web
+
+from vtpu_manager.webhook.mutate import mutate_pod
+from vtpu_manager.webhook.validate import validate_pod
+
+log = logging.getLogger(__name__)
+
+
+def _admission_response(uid: str, allowed: bool = True,
+                        message: str = "", patches: list | None = None,
+                        warnings: list[str] | None = None) -> dict:
+    response: dict = {"uid": uid, "allowed": allowed}
+    if message:
+        response["status"] = {"message": message}
+    if patches:
+        response["patchType"] = "JSONPatch"
+        response["patch"] = base64.b64encode(
+            json.dumps(patches).encode()).decode()
+    if warnings:
+        response["warnings"] = warnings
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "response": response}
+
+
+class WebhookAPI:
+    def __init__(self, scheduler_name: str | None = None):
+        from vtpu_manager.util import consts
+        self.scheduler_name = scheduler_name or consts.DEFAULT_SCHEDULER_NAME
+        self.stats = {"mutate": 0, "validate": 0, "errors": 0}
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=16 * 2**20)
+        app.router.add_post("/pods/mutate", self.handle_mutate)
+        app.router.add_post("/pods/validate", self.handle_validate)
+        app.router.add_get("/healthz", self.handle_healthz)
+        app.router.add_get("/readyz", self.handle_healthz)
+        return app
+
+    async def _review(self, request: web.Request) -> tuple[str, dict]:
+        body = await request.json()
+        req = body.get("request") or {}
+        return req.get("uid", ""), (req.get("object") or {})
+
+    async def handle_mutate(self, request: web.Request) -> web.Response:
+        self.stats["mutate"] += 1
+        try:
+            uid, pod = await self._review(request)
+            result = mutate_pod(pod, scheduler_name=self.scheduler_name)
+            return web.json_response(_admission_response(
+                uid, patches=result.patches, warnings=result.warnings))
+        except Exception as e:
+            self.stats["errors"] += 1
+            log.exception("mutate failed")
+            # fail-open on mutation: a webhook outage must not block pods
+            return web.json_response(_admission_response(
+                "", allowed=True, message=str(e)))
+
+    async def handle_validate(self, request: web.Request) -> web.Response:
+        self.stats["validate"] += 1
+        try:
+            uid, pod = await self._review(request)
+            result = validate_pod(pod)
+            return web.json_response(_admission_response(
+                uid, allowed=result.allowed, message=result.message))
+        except Exception as e:
+            self.stats["errors"] += 1
+            log.exception("validate failed")
+            return web.json_response(_admission_response(
+                "", allowed=False, message=f"validation error: {e}"))
+
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+
+def run_server(api: WebhookAPI, host: str = "0.0.0.0", port: int = 8443,
+               ssl_context=None) -> None:
+    web.run_app(api.build_app(), host=host, port=port,
+                ssl_context=ssl_context, print=None)

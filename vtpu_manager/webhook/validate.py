@@ -1,0 +1,67 @@
+"""Pod validating admission: reject malformed vtpu requests at the door.
+
+Reference: pkg/webhook/pod/validate/pod_validate.go:66-1193 — bounds and
+combination checks on vgpu resources, annotation values, DRA claim shapes.
+Runs the same parser the scheduler uses (one source of truth) plus
+admission-only bounds the filter would otherwise discover late.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from vtpu_manager.device.allocator.request import (RequestError,
+                                                   build_allocation_request)
+from vtpu_manager.util import consts
+from vtpu_manager.webhook.mutate import requests_vtpu
+
+MAX_NUMBER_PER_CONTAINER = 64
+MAX_MEMORY_MIB_PER_DEVICE = 1024 * 1024   # 1 TiB: beyond any chip
+
+
+@dataclass
+class ValidateResult:
+    allowed: bool = True
+    reasons: list[str] = field(default_factory=list)
+
+    def deny(self, reason: str) -> None:
+        self.allowed = False
+        self.reasons.append(reason)
+
+    @property
+    def message(self) -> str:
+        return "; ".join(self.reasons)
+
+
+def validate_pod(pod: dict) -> ValidateResult:
+    result = ValidateResult()
+    if not requests_vtpu(pod):
+        return result
+    try:
+        req = build_allocation_request(pod)
+    except RequestError as e:
+        result.deny(str(e))
+        return result
+
+    for cont in req.containers + req.init_containers:
+        if cont.number > MAX_NUMBER_PER_CONTAINER:
+            result.deny(f"container {cont.name!r}: vtpu-number "
+                        f"{cont.number} > {MAX_NUMBER_PER_CONTAINER}")
+        if cont.memory // 2**20 > MAX_MEMORY_MIB_PER_DEVICE:
+            result.deny(f"container {cont.name!r}: vtpu-memory "
+                        f"{cont.memory // 2**20}MiB implausible")
+
+    if req.gang_name:
+        if req.gang_size <= 0:
+            result.deny("gang-name set but gang-size missing/invalid")
+        if req.gang_ordinal >= max(req.gang_size, 0):
+            result.deny(f"gang-ordinal {req.gang_ordinal} >= gang-size "
+                        f"{req.gang_size}")
+
+    if (req.topology_mode in (consts.TOPOLOGY_ICI, consts.TOPOLOGY_ICI_STRICT)
+            and req.memory_oversold):
+        # oversold memory implies fungible placement; strict mesh shapes and
+        # oversubscription interact badly (claims can migrate under UVA-spill
+        # in the reference; here the equivalent is host-RAM offload)
+        result.deny("memory-oversold cannot combine with ici topology mode")
+    return result
