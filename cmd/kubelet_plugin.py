@@ -1,0 +1,101 @@
+"""vtpu kubelet-plugin: DRA driver binary (reference: cmd/kubelet-plugin).
+
+Alternative to the device plugin on clusters with DynamicResourceAllocation:
+serves NodePrepareResources/NodeUnprepareResources, publishes the node's
+ResourceSlice, and exposes the runtime-hook policy core.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="vtpu DRA kubelet plugin")
+    parser.add_argument("--node-name",
+                        default=os.environ.get("NODE_NAME", ""))
+    parser.add_argument("--plugin-dir",
+                        default="/var/lib/kubelet/plugins/vtpu-dra")
+    parser.add_argument("--base-dir")
+    parser.add_argument("--cdi-dir", default="/etc/cdi")
+    parser.add_argument("--registry-dir",
+                        default="/var/lib/kubelet/plugins_registry")
+    parser.add_argument("--fake-chips", type=int, default=0)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    log = logging.getLogger("vtpu-kubelet-plugin")
+    if not args.node_name:
+        log.error("--node-name or NODE_NAME required")
+        return 2
+
+    from vtpu_manager.kubeletplugin.allocatable import build_resource_slice
+    from vtpu_manager.kubeletplugin.device_state import DeviceState
+    from vtpu_manager.kubeletplugin.driver import ClaimSource, DraDriver
+    from vtpu_manager.tpu.discovery import FakeBackend, discover
+    from vtpu_manager.util import consts
+
+    backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
+        else None
+    result = discover(backends)
+    if result is None:
+        log.error("no TPU chips discovered")
+        return 1
+    chips = result.chips
+
+    state = DeviceState(args.node_name, chips,
+                        base_dir=args.base_dir or consts.MANAGER_BASE_DIR,
+                        cdi_dir=args.cdi_dir)
+    try:
+        from vtpu_manager.client.kube import InClusterClient
+        client = InClusterClient()
+    except Exception:
+        client = None
+        log.warning("no API server access; claims must arrive pre-resolved")
+    driver = DraDriver(args.node_name, chips, ClaimSource(client),
+                       state=state, plugin_dir=args.plugin_dir)
+    driver.serve()
+
+    from vtpu_manager.kubeletplugin.registration import (
+        RegistrationServer, publish_resource_slice)
+    registration = RegistrationServer(driver.socket_path,
+                                      registry_dir=args.registry_dir)
+    try:
+        registration.serve()
+    except Exception:
+        log.warning("plugin registration socket unavailable")
+        registration = None
+
+    rs = build_resource_slice(args.node_name, chips)
+    log.info("ResourceSlice: %d devices, %d shared counter sets",
+             len(rs["spec"]["devices"]), len(rs["spec"]["sharedCounters"]))
+    if client is not None:
+        published = publish_resource_slice(client, rs)
+        log.info("ResourceSlice published: %s", published)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    log.info("vtpu DRA driver running on %s", driver.socket_path)
+    try:
+        while not stop:
+            time.sleep(1)
+    finally:
+        driver.stop()
+        if registration is not None:
+            registration.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+"""DRA kubelet plugin: checkpointing, prepare/unprepare, CDI, runtime hook.
+
+Mirrors the reference's step3_allocation_test.go + checkpoint tests
+(SURVEY.md §4) on fake chips; the kubelet is simulated by gRPC calls over a
+unix socket.
+"""
+
+import json
+import os
+
+import grpc
+import pytest
+
+from vtpu_manager.claimresolve.resolve import (PartitionKey, pod_partitions,
+                                               resolve_claim_partitions)
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.device.types import fake_chip
+from vtpu_manager.kubeletplugin import cdi
+from vtpu_manager.kubeletplugin.allocatable import build_resource_slice
+from vtpu_manager.kubeletplugin.api import dra_pb2 as pb
+from vtpu_manager.kubeletplugin.checkpoint import Checkpoint, PreparedClaim
+from vtpu_manager.kubeletplugin.device_state import DeviceState
+from vtpu_manager.kubeletplugin.driver import ClaimSource, DraDriver
+from vtpu_manager.kubeletplugin.nri import RuntimeHook
+from vtpu_manager.util import consts
+
+
+def allocated_claim(uid="claim-1", device="vtpu-0", cores=50,
+                    memory_mib=2048, name="c1", namespace="ml"):
+    return {
+        "metadata": {"uid": uid, "name": name, "namespace": namespace},
+        "status": {"allocation": {"devices": {
+            "results": [{"request": "tpu", "driver": consts.DRA_DRIVER_NAME,
+                         "pool": "node-1", "device": device}],
+            "config": [{"requests": ["tpu"], "opaque": {
+                "driver": consts.DRA_DRIVER_NAME,
+                "parameters": {"cores": cores,
+                               "memoryMiB": memory_mib}}}],
+        }}},
+    }
+
+
+@pytest.fixture
+def state(tmp_path):
+    chips = [fake_chip(0), fake_chip(1)]
+    return DeviceState("node-1", chips, base_dir=str(tmp_path / "mgr"),
+                       cdi_dir=str(tmp_path / "cdi"))
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "ck.json")
+        ck = Checkpoint(path)
+        ck.claims["u1"] = PreparedClaim("u1", "ns", "c",
+                                        devices=[{"device": "vtpu-0"}],
+                                        cdi_devices=["google.com/vtpu=u1"])
+        ck.save()
+        ck2 = Checkpoint(path)
+        ck2.load()
+        assert ck2.claims["u1"].devices[0]["device"] == "vtpu-0"
+
+    def test_checksum_detects_corruption(self, tmp_path):
+        path = str(tmp_path / "ck.json")
+        ck = Checkpoint(path)
+        ck.claims["u1"] = PreparedClaim("u1", "ns", "c")
+        ck.save()
+        doc = json.load(open(path))
+        doc["data"]["claims"]["u1"]["name"] = "tampered"
+        json.dump(doc, open(path, "w"))
+        with pytest.raises(ValueError, match="checksum"):
+            Checkpoint(path).load()
+
+    def test_v1_migration(self, tmp_path):
+        path = str(tmp_path / "ck.json")
+        payload = {"version": 1,
+                   "claims": {"u1": [{"device": "vtpu-0"}]}}
+        json.dump({"checksum": None, "data": payload}, open(path, "w"))
+        # null checksum => legacy file without checksum: accepted
+        doc = json.load(open(path))
+        doc.pop("checksum")
+        json.dump(doc, open(path, "w"))
+        ck = Checkpoint(path)
+        ck.load()
+        assert ck.claims["u1"].devices == [{"device": "vtpu-0"}]
+
+
+class TestDeviceState:
+    def test_prepare_writes_partition_and_cdi(self, state, tmp_path):
+        cdi_ids = state.prepare_claim(allocated_claim())
+        assert cdi_ids == ["google.com/vtpu=claim-1"]
+        spec = json.load(open(cdi.spec_path("claim-1",
+                                            str(tmp_path / "cdi"))))
+        edits = spec["devices"][0]["containerEdits"]
+        assert any("VTPU_CORE_LIMIT_0=50" in e for e in edits["env"])
+        assert any(d["path"] == "/dev/accel0"
+                   for d in edits["deviceNodes"])
+        cfg = vc.read_config(os.path.join(
+            state.base_dir, "claim_claim-1", "config", "vtpu.config"))
+        assert cfg.devices[0].hard_core == 50
+        assert cfg.devices[0].total_memory == 2048 * 2**20
+
+    def test_prepare_idempotent(self, state):
+        first = state.prepare_claim(allocated_claim())
+        second = state.prepare_claim(allocated_claim())
+        assert first == second
+
+    def test_unknown_device_rejected(self, state):
+        from vtpu_manager.kubeletplugin.device_state import PrepareError
+        with pytest.raises(PrepareError, match="not on node"):
+            state.prepare_claim(allocated_claim(device="vtpu-99"))
+
+    def test_unprepare_cleans_up(self, state, tmp_path):
+        state.prepare_claim(allocated_claim())
+        state.unprepare_claim("claim-1")
+        assert not os.path.exists(cdi.spec_path("claim-1",
+                                                str(tmp_path / "cdi")))
+        assert not os.path.exists(os.path.join(state.base_dir,
+                                               "claim_claim-1"))
+        assert state.prepared_uids() == set()
+        state.unprepare_claim("claim-1")   # idempotent
+
+    def test_checkpoint_survives_restart(self, state, tmp_path):
+        state.prepare_claim(allocated_claim())
+        chips = [fake_chip(0), fake_chip(1)]
+        state2 = DeviceState("node-1", chips,
+                             base_dir=str(tmp_path / "mgr"),
+                             cdi_dir=str(tmp_path / "cdi"))
+        assert state2.prepared_uids() == {"claim-1"}
+
+
+class TestDraGrpc:
+    def test_prepare_unprepare_over_socket(self, state, tmp_path):
+        source = ClaimSource()
+        source.local["claim-1"] = allocated_claim()
+        driver = DraDriver("node-1", [], source, state=state,
+                           plugin_dir=str(tmp_path / "sock"))
+        driver.serve()
+        try:
+            with grpc.insecure_channel(
+                    f"unix://{driver.socket_path}") as chan:
+                prep = chan.unary_unary(
+                    "/v1beta1dra.DRAPlugin/NodePrepareResources",
+                    request_serializer=
+                    pb.NodePrepareResourcesRequest.SerializeToString,
+                    response_deserializer=
+                    pb.NodePrepareResourcesResponse.FromString)
+                resp = prep(pb.NodePrepareResourcesRequest(claims=[
+                    pb.Claim(uid="claim-1", name="c1", namespace="ml")]),
+                    timeout=5)
+                entry = resp.claims["claim-1"]
+                assert not entry.error
+                assert entry.devices[0].cdi_device_ids == \
+                    ["google.com/vtpu=claim-1"]
+                missing = prep(pb.NodePrepareResourcesRequest(claims=[
+                    pb.Claim(uid="nope", name="x", namespace="ml")]),
+                    timeout=5)
+                assert "not found" in missing.claims["nope"].error
+                unprep = chan.unary_unary(
+                    "/v1beta1dra.DRAPlugin/NodeUnprepareResources",
+                    request_serializer=
+                    pb.NodeUnprepareResourcesRequest.SerializeToString,
+                    response_deserializer=
+                    pb.NodeUnprepareResourcesResponse.FromString)
+                uresp = unprep(pb.NodeUnprepareResourcesRequest(claims=[
+                    pb.Claim(uid="claim-1")]), timeout=5)
+                assert not uresp.claims["claim-1"].error
+        finally:
+            driver.stop()
+        assert state.prepared_uids() == set()
+
+
+class TestRuntimeHook:
+    def test_valid_claim_injected(self, state):
+        state.prepare_claim(allocated_claim())
+        hook = RuntimeHook(state)
+        adj = hook.create_container(
+            {"uid": "pod-1", "claim_uids": ["claim-1"]},
+            {"name": "c", "env": ["VTPU_CLAIM_UID=claim-1"]})
+        assert not adj.rejected
+        assert adj.env[consts.ENV_REGISTER_UUID] == "claim-1"
+        assert adj.mounts
+
+    def test_spoofed_claim_rejected(self, state):
+        state.prepare_claim(allocated_claim())
+        hook = RuntimeHook(state)
+        # pod does NOT own claim-1 but its env claims it
+        adj = hook.create_container(
+            {"uid": "pod-2", "claim_uids": []},
+            {"name": "c", "env": ["VTPU_CLAIM_UID=claim-1"]})
+        assert adj.rejected
+
+    def test_unprepared_claim_rejected(self, state):
+        hook = RuntimeHook(state)
+        adj = hook.create_container(
+            {"uid": "pod-1", "claim_uids": ["ghost"]},
+            {"name": "c", "env": ["VTPU_CLAIM_UID=ghost"]})
+        assert adj.rejected
+
+    def test_non_tenant_untouched(self, state):
+        hook = RuntimeHook(state)
+        adj = hook.create_container({"uid": "p", "claim_uids": []},
+                                    {"name": "c", "env": []})
+        assert not adj.rejected and not adj.env
+
+
+class TestClaimResolve:
+    def test_resolve_partitions(self):
+        parts = resolve_claim_partitions(allocated_claim())
+        assert parts == [PartitionKey("vtpu-0", 50, 2048)]
+
+    def test_pod_partitions(self):
+        pod = {"metadata": {"namespace": "ml"},
+               "spec": {"resourceClaims": [
+                   {"name": "tpu", "resourceClaimName": "c1"}]},
+               "status": {}}
+        claims = {("ml", "c1"): allocated_claim()}
+        assert pod_partitions(pod, claims) == \
+            [PartitionKey("vtpu-0", 50, 2048)]
+
+    def test_foreign_driver_ignored(self):
+        claim = allocated_claim()
+        claim["status"]["allocation"]["devices"]["results"][0]["driver"] = \
+            "gpu.example.com"
+        assert resolve_claim_partitions(claim) == []
+
+
+class TestResourceSlice:
+    def test_slice_shape(self):
+        chips = [fake_chip(0), fake_chip(1)]
+        rs = build_resource_slice("node-1", chips)
+        assert rs["spec"]["driver"] == consts.DRA_DRIVER_NAME
+        devices = rs["spec"]["devices"]
+        # fractional: split_count slots per chip so claims can share a chip
+        assert len(devices) == 2 * 10
+        cap = devices[0]["basic"]["capacity"]
+        assert cap["coreRatio"]["value"] == "10"
+        assert cap["memoryMiB"]["value"] == str(16 * 1024 // 10)
+        counters = rs["spec"]["sharedCounters"]
+        assert counters[0]["name"] == "chip-0"
+        assert counters[0]["counters"]["coreRatio"]["value"] == "100"

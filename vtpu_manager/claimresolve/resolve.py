@@ -1,0 +1,77 @@
+"""Claim resolution: map DRA allocations back to vtpu partition keys.
+
+Reference: pkg/claimresolve/allocated_vgpu.go:1-182 + partitions.go:1-256 —
+the webhook and monitor need to answer "which chips/fractions does this
+pod hold via DRA claims" without talking to the kubelet plugin.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from vtpu_manager.util import consts
+
+
+@dataclass(frozen=True)
+class PartitionKey:
+    device: str        # DRA device name (vtpu-<index>)
+    cores: int
+    memory_mib: int
+
+
+def pod_claim_names(pod: dict) -> list[tuple[str, str]]:
+    """(namespace, resourceclaim name) referenced by the pod spec (both
+    direct resourceClaimName and generated claims via templates recorded in
+    status.resourceClaimStatuses)."""
+    ns = (pod.get("metadata") or {}).get("namespace", "default")
+    out = []
+    for entry in ((pod.get("spec") or {}).get("resourceClaims") or []):
+        name = entry.get("resourceClaimName")
+        if name:
+            out.append((ns, name))
+    for status in ((pod.get("status") or {}).get("resourceClaimStatuses")
+                   or []):
+        name = status.get("resourceClaimName")
+        if name:
+            out.append((ns, name))
+    return list(dict.fromkeys(out))
+
+
+def resolve_claim_partitions(claim: dict) -> list[PartitionKey]:
+    """Partition keys of one allocated ResourceClaim for our driver."""
+    allocation = ((claim.get("status") or {}).get("allocation") or {})
+    results = ((allocation.get("devices") or {}).get("results") or [])
+    configs = ((allocation.get("devices") or {}).get("config") or [])
+
+    def params_for(result: dict) -> dict:
+        request = result.get("request", "")
+        chosen: dict = {}
+        for entry in configs:
+            opaque = entry.get("opaque") or {}
+            if opaque.get("driver") != consts.DRA_DRIVER_NAME:
+                continue
+            requests = entry.get("requests") or []
+            if not requests or request in requests:
+                chosen = opaque.get("parameters") or {}
+        return chosen
+
+    out = []
+    for result in results:
+        if result.get("driver") != consts.DRA_DRIVER_NAME:
+            continue
+        params = params_for(result)
+        out.append(PartitionKey(
+            device=result.get("device", ""),
+            cores=int(params.get("cores", 100)),
+            memory_mib=int(params.get("memoryMiB", 0))))
+    return out
+
+
+def pod_partitions(pod: dict, claims_by_name: dict[tuple[str, str], dict]
+                   ) -> list[PartitionKey]:
+    out = []
+    for key in pod_claim_names(pod):
+        claim = claims_by_name.get(key)
+        if claim is not None:
+            out.extend(resolve_claim_partitions(claim))
+    return out

@@ -26,6 +26,8 @@ class FakeKubeClient:
         self.evictions: list[tuple[str, str]] = []
         self.deletions: list[tuple[str, str]] = []
         self.events: list[dict] = []
+        self.resourceclaims: dict[tuple[str, str], dict] = {}
+        self.resourceslices: dict[str, dict] = {}
 
     # -- fixture helpers ----------------------------------------------------
 
@@ -130,3 +132,25 @@ class FakeKubeClient:
     def create_event(self, namespace: str, event: dict) -> None:
         with self._lock:
             self.events.append(copy.deepcopy(event))
+
+    # -- DRA objects --------------------------------------------------------
+
+    def add_resourceclaim(self, claim: dict) -> None:
+        meta = claim["metadata"]
+        with self._lock:
+            self.resourceclaims[(meta.get("namespace", "default"),
+                                 meta["name"])] = copy.deepcopy(claim)
+
+    def get_resourceclaim(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            claim = self.resourceclaims.get((namespace, name))
+            if claim is None:
+                raise KubeError(404,
+                                f"resourceclaim {namespace}/{name} not found")
+            return copy.deepcopy(claim)
+
+    def apply_resourceslice(self, slice_doc: dict) -> dict:
+        with self._lock:
+            self.resourceslices[slice_doc["metadata"]["name"]] = \
+                copy.deepcopy(slice_doc)
+            return copy.deepcopy(slice_doc)

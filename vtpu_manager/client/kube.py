@@ -145,3 +145,23 @@ class InClusterClient:
 
     def create_event(self, namespace: str, event: dict) -> None:
         self._request("POST", f"/api/v1/namespaces/{namespace}/events", event)
+
+    # -- DRA objects --------------------------------------------------------
+
+    def get_resourceclaim(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "GET", f"/apis/resource.k8s.io/v1beta1/namespaces/{namespace}"
+                   f"/resourceclaims/{name}")
+
+    def apply_resourceslice(self, slice_doc: dict) -> dict:
+        name = slice_doc["metadata"]["name"]
+        try:
+            return self._request(
+                "PUT", f"/apis/resource.k8s.io/v1beta1/resourceslices/{name}",
+                slice_doc)
+        except KubeError as e:
+            if e.status != 404:
+                raise
+            return self._request(
+                "POST", "/apis/resource.k8s.io/v1beta1/resourceslices",
+                slice_doc)

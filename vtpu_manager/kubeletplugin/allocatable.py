@@ -1,0 +1,86 @@
+"""ResourceSlice publishing: what the DRA scheduler can allocate from.
+
+Reference: pkg/kubeletplugin driver.go:251-372 + allocatable.go:1-378 —
+each chip is advertised as a DRA device carrying coreRatio / memoryRatio
+capacities (vgpu.go:34-120), with shared counters tying fractional vtpu
+devices to the physical chip so the scheduler cannot over-allocate the
+underlying hardware.
+
+Shapes follow resource.k8s.io/v1beta1 ResourceSlice JSON.
+"""
+
+from __future__ import annotations
+
+from vtpu_manager.device.types import ChipSpec
+from vtpu_manager.util import consts
+
+CORE_COUNTER = "coreRatio"      # percent units per chip
+MEMORY_COUNTER = "memoryMiB"
+
+
+def device_entries(chips: list[ChipSpec]) -> list[dict]:
+    """DRA device list: one fractional vtpu device per chip slot, each
+    consuming its proportional share of the chip's shared counters — two
+    claims can then land on the same physical chip (the DRA form of the
+    device plugin's split_count; a single full-chip entry would drain the
+    counters on first allocation and forbid co-tenancy)."""
+    out = []
+    for chip in chips:
+        split = max(chip.split_count, 1)
+        slot_cores = 100 // split
+        slot_mem = (chip.memory // 2**20) // split
+        for slot in range(split):
+            out.append({
+                "name": f"vtpu-{chip.index}-{slot}",
+                "basic": {
+                    "attributes": {
+                        "uuid": {"string": chip.uuid},
+                        "chipType": {"string": chip.chip_type},
+                        "index": {"int": chip.index},
+                        "slot": {"int": slot},
+                        "meshX": {"int": chip.coords[0]},
+                        "meshY": {"int": chip.coords[1]},
+                        "meshZ": {"int": chip.coords[2]},
+                        "healthy": {"bool": chip.healthy},
+                    },
+                    "capacity": {
+                        CORE_COUNTER: {"value": str(slot_cores)},
+                        MEMORY_COUNTER: {"value": str(slot_mem)},
+                    },
+                    "consumesCounters": [{
+                        "counterSet": f"chip-{chip.index}",
+                        "counters": {
+                            CORE_COUNTER: {"value": str(slot_cores)},
+                            MEMORY_COUNTER: {"value": str(slot_mem)},
+                        },
+                    }],
+                },
+            })
+    return out
+
+
+def shared_counter_sets(chips: list[ChipSpec]) -> list[dict]:
+    return [{
+        "name": f"chip-{chip.index}",
+        "counters": {
+            CORE_COUNTER: {"value": "100"},
+            MEMORY_COUNTER: {"value": str(chip.memory // 2**20)},
+        },
+    } for chip in chips]
+
+
+def build_resource_slice(node_name: str, chips: list[ChipSpec],
+                         pool_generation: int = 1) -> dict:
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": f"{node_name}-vtpu"},
+        "spec": {
+            "driver": consts.DRA_DRIVER_NAME,
+            "nodeName": node_name,
+            "pool": {"name": node_name, "generation": pool_generation,
+                     "resourceSliceCount": 1},
+            "sharedCounters": shared_counter_sets(chips),
+            "devices": device_entries(chips),
+        },
+    }

@@ -1,0 +1,85 @@
+"""CDI spec generation for prepared vtpu claims.
+
+Reference: pkg/kubeletplugin/cdi.go:1-403 — writes Container Device
+Interface specs the runtime applies at container creation (env, mounts,
+device nodes). Spec format follows the public CDI 0.6 JSON schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from vtpu_manager.util import consts
+
+CDI_VERSION = "0.6.0"
+CDI_VENDOR = "google.com"
+CDI_CLASS = "vtpu"
+CDI_DIR = "/etc/cdi"
+
+
+def cdi_device_name(claim_uid: str) -> str:
+    return f"{CDI_VENDOR}/{CDI_CLASS}={claim_uid}"
+
+
+def build_spec(claim_uid: str, host_indices: list[int], envs: dict[str, str],
+               config_host_dir: str,
+               shim_host_dir: str = consts.DRIVER_DIR,
+               client_mode: bool = False) -> dict:
+    """One CDI device per claim bundling env + mounts + device nodes (the
+    per-claim analogue of the device plugin's ContainerAllocateResponse)."""
+    env_list = [f"{k}={v}" for k, v in sorted(envs.items())]
+    mounts = [
+        {"hostPath": config_host_dir,
+         "containerPath": f"{consts.MANAGER_BASE_DIR}/config",
+         "options": ["ro", "rbind"]},
+        {"hostPath": shim_host_dir,
+         "containerPath": consts.DRIVER_DIR,
+         "options": ["ro", "rbind"]},
+        {"hostPath": consts.LOCK_DIR, "containerPath": consts.LOCK_DIR,
+         "options": ["rw", "rbind"]},
+        {"hostPath": consts.VMEM_DIR, "containerPath": consts.VMEM_DIR,
+         "options": ["rw", "rbind"]},
+        {"hostPath": consts.WATCHER_DIR,
+         "containerPath": consts.WATCHER_DIR,
+         "options": ["ro", "rbind"]},
+    ]
+    if client_mode:
+        mounts.append({"hostPath": consts.REGISTRY_DIR,
+                       "containerPath": consts.REGISTRY_DIR,
+                       "options": ["rw", "rbind"]})
+    device_nodes = [{"path": f"/dev/accel{i}", "type": "c",
+                     "permissions": "rw"} for i in host_indices]
+    return {
+        "cdiVersion": CDI_VERSION,
+        "kind": f"{CDI_VENDOR}/{CDI_CLASS}",
+        "devices": [{
+            "name": claim_uid,
+            "containerEdits": {
+                "env": env_list,
+                "mounts": mounts,
+                "deviceNodes": device_nodes,
+            },
+        }],
+    }
+
+
+def spec_path(claim_uid: str, cdi_dir: str = CDI_DIR) -> str:
+    return os.path.join(cdi_dir, f"{CDI_VENDOR}-{CDI_CLASS}-{claim_uid}.json")
+
+
+def write_spec(spec: dict, claim_uid: str, cdi_dir: str = CDI_DIR) -> str:
+    path = spec_path(claim_uid, cdi_dir)
+    os.makedirs(cdi_dir, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(spec, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def remove_spec(claim_uid: str, cdi_dir: str = CDI_DIR) -> None:
+    try:
+        os.unlink(spec_path(claim_uid, cdi_dir))
+    except OSError:
+        pass

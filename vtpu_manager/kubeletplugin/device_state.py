@@ -1,0 +1,192 @@
+"""DRA DeviceState: Prepare/Unprepare of ResourceClaims.
+
+Reference: pkg/kubeletplugin/device_state.go:89-1517 — the prepared-claim
+lifecycle: checkpoint read/validate, per-result device preparation (vtpu
+partition config with the same binary ABI — vgpu.go:1-412), CDI spec +
+container edits, checkpoint update; all under a node-global prepare/
+unprepare lock (driver.go:56-59). No MIG/vfio analogues: TPUs have no
+hardware partitioning, so every DRA device is a fractional vtpu partition.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+
+from vtpu_manager.claimresolve.resolve import resolve_claim_partitions
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.node_config import NodeConfig
+from vtpu_manager.device.types import ChipSpec
+from vtpu_manager.kubeletplugin import cdi
+from vtpu_manager.kubeletplugin.checkpoint import Checkpoint, PreparedClaim
+from vtpu_manager.util import consts
+from vtpu_manager.util.flock import FileLock
+
+log = logging.getLogger(__name__)
+
+
+class PrepareError(RuntimeError):
+    pass
+
+
+_COMPAT_BITS = {"host": consts.COMPAT_HOST, "cgroup": consts.COMPAT_CGROUP,
+                "client": consts.COMPAT_CLIENT,
+                "open-kernel": consts.COMPAT_OPEN_KERNEL}
+
+
+class DeviceState:
+    def __init__(self, node_name: str, chips: list[ChipSpec],
+                 base_dir: str = consts.MANAGER_BASE_DIR,
+                 cdi_dir: str = cdi.CDI_DIR,
+                 checkpoint_path: str | None = None,
+                 shim_host_dir: str = consts.DRIVER_DIR,
+                 node_config: NodeConfig | None = None,
+                 libtpu_path: str = "/lib/libtpu.so"):
+        self.node_name = node_name
+        self.node_config = node_config or NodeConfig()
+        self.libtpu_path = libtpu_path
+        self._chips_by_index = {c.index: c for c in chips}
+        self.base_dir = base_dir
+        self.cdi_dir = cdi_dir
+        self.shim_host_dir = shim_host_dir
+        self.checkpoint = Checkpoint(
+            checkpoint_path or os.path.join(base_dir, "dra_checkpoint.json"))
+        self.checkpoint.load()
+        self._lock = FileLock(os.path.join(base_dir, "dra_prepare.lock"))
+
+    def chip_for_device(self, device_name: str) -> ChipSpec | None:
+        """Resolve `vtpu-<index>` or fractional `vtpu-<index>-<slot>`."""
+        if not device_name.startswith("vtpu-"):
+            return None
+        idx_part = device_name[len("vtpu-"):].split("-", 1)[0]
+        try:
+            return self._chips_by_index.get(int(idx_part))
+        except ValueError:
+            return None
+
+    # -- prepare ------------------------------------------------------------
+
+    def prepare_claim(self, claim: dict) -> list[str]:
+        """Prepare one ResourceClaim; returns CDI device names. Idempotent:
+        an already-prepared claim returns its recorded CDI devices
+        (kubelet retries Prepare)."""
+        meta = claim.get("metadata") or {}
+        uid = meta.get("uid", "")
+        if not uid:
+            raise PrepareError("claim without uid")
+        os.makedirs(self.base_dir, exist_ok=True)
+        with self._lock:
+            existing = self.checkpoint.claims.get(uid)
+            if existing is not None:
+                return list(existing.cdi_devices)
+
+            allocation = ((claim.get("status") or {}).get("allocation")
+                          or {})
+            results = ((allocation.get("devices") or {}).get("results")
+                       or [])
+            ours = [r for r in results
+                    if r.get("driver") == consts.DRA_DRIVER_NAME]
+            if not ours:
+                raise PrepareError(
+                    f"claim {uid} has no allocation for "
+                    f"{consts.DRA_DRIVER_NAME}")
+            # one source of truth for opaque-config resolution: the same
+            # claimresolve logic the webhook/monitor use
+            try:
+                partitions = resolve_claim_partitions(claim)
+            except (TypeError, ValueError) as e:
+                raise PrepareError(f"malformed opaque config: {e}") from e
+
+            devices = []
+            host_indices = []
+            envs: dict[str, str] = {}
+            for i, part in enumerate(partitions):
+                chip = self.chip_for_device(part.device)
+                if chip is None:
+                    raise PrepareError(
+                        f"allocated device {part.device!r} not on node")
+                if not 0 < part.cores <= 100:
+                    raise PrepareError(f"cores {part.cores} out of range")
+                memory = part.memory_mib * 2**20 or chip.memory
+                # total beyond physical HBM requires the explicit oversold
+                # opt-in, same contract as the device-plugin path
+                if memory > chip.memory and \
+                        not self.node_config.memory_overused:
+                    raise PrepareError(
+                        f"memoryMiB {part.memory_mib} exceeds chip HBM "
+                        f"{chip.memory // 2**20}MiB (node not configured "
+                        "for memory oversubscription)")
+                envs[f"{consts.ENV_MEM_LIMIT}_{i}"] = str(memory)
+                if part.cores < 100:
+                    envs[f"{consts.ENV_CORE_LIMIT}_{i}"] = str(part.cores)
+                host_indices.append(chip.index)
+                devices.append({
+                    "device": part.device, "uuid": chip.uuid,
+                    "hostIndex": chip.index, "cores": part.cores,
+                    "memory": memory,
+                })
+            envs[consts.ENV_VISIBLE_DEVICES] = ",".join(
+                str(i) for i in host_indices)
+            envs[consts.ENV_TPU_VISIBLE_DEVICES] = \
+                envs[consts.ENV_VISIBLE_DEVICES]
+            shim = os.path.join(consts.DRIVER_DIR,
+                                consts.CONTROL_LIBRARY_NAME)
+            envs[consts.ENV_TPU_LIBRARY_PATH] = shim
+            envs[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
+            envs[consts.ENV_VTPU_REAL_PLUGIN_PATH] = self.libtpu_path
+            envs[consts.ENV_COMPAT_MODE] = str(_COMPAT_BITS.get(
+                self.node_config.compat_mode, consts.COMPAT_HOST))
+            envs["VTPU_CONFIG_PATH"] = \
+                f"{consts.MANAGER_BASE_DIR}/config/vtpu.config"
+
+            # binary partition config, same ABI as the device-plugin path
+            claim_dir = os.path.join(self.base_dir, f"claim_{uid}")
+            config_dir = os.path.join(claim_dir, "config")
+            os.makedirs(config_dir, exist_ok=True)
+            vc.write_config(os.path.join(config_dir, "vtpu.config"),
+                            vc.VtpuConfig(
+                pod_uid=uid, pod_name=meta.get("name", ""),
+                pod_namespace=meta.get("namespace", ""),
+                container_name="dra-claim",
+                compat_mode=_COMPAT_BITS.get(self.node_config.compat_mode,
+                                             consts.COMPAT_HOST),
+                devices=[vc.DeviceConfig(
+                    uuid=d["uuid"], total_memory=d["memory"],
+                    real_memory=self.chip_for_device(d["device"]).memory,
+                    hard_core=d["cores"], soft_core=d["cores"],
+                    core_limit=(vc.CORE_LIMIT_HARD if d["cores"] < 100
+                                else vc.CORE_LIMIT_NONE),
+                    memory_limit=True, host_index=d["hostIndex"],
+                    mesh=self.chip_for_device(d["device"]).coords)
+                    for d in devices]))
+
+            spec = cdi.build_spec(
+                uid, host_indices, envs, config_dir, self.shim_host_dir,
+                client_mode=self.node_config.compat_mode == "client")
+            cdi.write_spec(spec, uid, self.cdi_dir)
+            cdi_names = [cdi.cdi_device_name(uid)]
+
+            before = dict(self.checkpoint.claims)
+            self.checkpoint.claims[uid] = PreparedClaim(
+                claim_uid=uid, namespace=meta.get("namespace", ""),
+                name=meta.get("name", ""), devices=devices,
+                cdi_devices=cdi_names)
+            self.checkpoint.save()
+            self.checkpoint.diff_and_log(before)
+            return cdi_names
+
+    # -- unprepare ----------------------------------------------------------
+
+    def unprepare_claim(self, claim_uid: str) -> None:
+        with self._lock:
+            claim = self.checkpoint.claims.pop(claim_uid, None)
+            if claim is None:
+                return   # idempotent
+            cdi.remove_spec(claim_uid, self.cdi_dir)
+            claim_dir = os.path.join(self.base_dir, f"claim_{claim_uid}")
+            shutil.rmtree(claim_dir, ignore_errors=True)
+            self.checkpoint.save()
+
+    def prepared_uids(self) -> set[str]:
+        return set(self.checkpoint.claims)

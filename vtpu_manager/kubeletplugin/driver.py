@@ -1,0 +1,153 @@
+"""DRA kubelet-plugin driver: gRPC service + ResourceSlice publishing.
+
+Reference: pkg/kubeletplugin/driver.go:87-816 — wires the kubelet DRA gRPC
+(NodePrepareResources/NodeUnprepareResources), DeviceState with its
+checkpoint, ResourceSlice publication, health monitoring, and the runtime
+hook. Claims named in a Prepare call are fetched from the API server to
+read their allocation results.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent import futures
+
+import grpc
+
+from vtpu_manager.client.kube import KubeClient
+from vtpu_manager.device.types import ChipSpec
+from vtpu_manager.kubeletplugin.api import dra_pb2 as pb
+from vtpu_manager.kubeletplugin.device_state import DeviceState, PrepareError
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+DRA_PLUGIN_DIR = "/var/lib/kubelet/plugins/vtpu-dra"
+
+
+class ClaimSource:
+    """Where Prepare fetches claim objects. The real source is the API
+    server; tests inject an in-memory map."""
+
+    def __init__(self, client: KubeClient | None = None):
+        self.client = client
+        self.local: dict[str, dict] = {}    # uid -> claim (tests)
+
+    def get(self, uid: str, name: str, namespace: str) -> dict | None:
+        claim = None
+        if uid in self.local:
+            claim = self.local[uid]
+        elif self.client is not None:
+            getter = getattr(self.client, "get_resourceclaim", None)
+            if getter is not None:
+                try:
+                    claim = getter(namespace, name)
+                except Exception:
+                    claim = None
+        if claim is None:
+            return None
+        # the name may have been recreated with a new uid; preparing the
+        # wrong generation would hand this pod another claim's partition
+        found_uid = (claim.get("metadata") or {}).get("uid", "")
+        if found_uid != uid:
+            log.warning("claim %s/%s uid mismatch: want %s found %s",
+                        namespace, name, uid, found_uid)
+            return None
+        return claim
+
+
+class DraDriver:
+    def __init__(self, node_name: str, chips: list[ChipSpec],
+                 claim_source: ClaimSource,
+                 state: DeviceState | None = None,
+                 plugin_dir: str = DRA_PLUGIN_DIR):
+        self.node_name = node_name
+        self.state = state or DeviceState(node_name, chips)
+        self.claims = claim_source
+        self.plugin_dir = plugin_dir
+        self.socket_path = os.path.join(plugin_dir, "dra.sock")
+        self._server: grpc.Server | None = None
+
+    # -- rpc implementations -----------------------------------------------
+
+    def node_prepare(self, request: pb.NodePrepareResourcesRequest,
+                     context=None) -> pb.NodePrepareResourcesResponse:
+        resp = pb.NodePrepareResourcesResponse()
+        for claim_ref in request.claims:
+            entry = resp.claims[claim_ref.uid]
+            claim = self.claims.get(claim_ref.uid, claim_ref.name,
+                                    claim_ref.namespace)
+            if claim is None:
+                entry.error = (f"claim {claim_ref.namespace}/"
+                               f"{claim_ref.name} not found")
+                continue
+            try:
+                cdi_ids = self.state.prepare_claim(claim)
+            except Exception as e:
+                # one malformed claim (bad opaque params -> ValueError,
+                # disk errors -> OSError) must fail only its own entry,
+                # not the whole kubelet batch
+                if not isinstance(e, PrepareError):
+                    log.exception("prepare %s failed unexpectedly",
+                                  claim_ref.uid)
+                entry.error = str(e)
+                continue
+            device = entry.devices.add()
+            device.pool_name = self.node_name
+            prepared = self.state.checkpoint.claims.get(claim_ref.uid)
+            if prepared and prepared.devices:
+                device.device_name = prepared.devices[0]["device"]
+                for d in prepared.devices[1:]:
+                    extra = entry.devices.add()
+                    extra.pool_name = self.node_name
+                    extra.device_name = d["device"]
+            for cdi_id in cdi_ids:
+                device.cdi_device_ids.append(cdi_id)
+        return resp
+
+    def node_unprepare(self, request: pb.NodeUnprepareResourcesRequest,
+                       context=None) -> pb.NodeUnprepareResourcesResponse:
+        resp = pb.NodeUnprepareResourcesResponse()
+        for claim_ref in request.claims:
+            entry = resp.claims[claim_ref.uid]
+            try:
+                self.state.unprepare_claim(claim_ref.uid)
+            except Exception as e:   # unprepare must not wedge pod teardown
+                entry.error = str(e)
+        return resp
+
+    # -- serving ------------------------------------------------------------
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        def unary(fn, req_cls, resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+
+        return grpc.method_handlers_generic_handler(
+            "v1beta1dra.DRAPlugin", {
+                "NodePrepareResources": unary(
+                    lambda req, ctx: self.node_prepare(req, ctx),
+                    pb.NodePrepareResourcesRequest,
+                    pb.NodePrepareResourcesResponse),
+                "NodeUnprepareResources": unary(
+                    lambda req, ctx: self.node_unprepare(req, ctx),
+                    pb.NodeUnprepareResourcesRequest,
+                    pb.NodeUnprepareResourcesResponse),
+            })
+
+    def serve(self) -> None:
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        log.info("DRA driver serving on %s", self.socket_path)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1)

@@ -1,0 +1,79 @@
+"""Runtime-hook injection cache: validate container claims at create time.
+
+Reference: pkg/kubeletplugin/nri/plugin.go:17-479 + nri/cache.go (design
+docs/dra_nri_integration_design.md) — an NRI plugin intercepts
+CreateContainer, validates the container's claimed UID against the
+*prepared* claims (defense against env spoofing: a container cannot
+claim another tenant's partition by copying its env), then injects the
+partition mounts + registration env.
+
+The transport (NRI rides ttrpc from containerd) is pluggable; this module
+is the policy core the transport calls into, so the validation and
+injection logic is testable hermetically.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from vtpu_manager.kubeletplugin.device_state import DeviceState
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ContainerAdjustment:
+    env: dict[str, str] = field(default_factory=dict)
+    mounts: list[dict] = field(default_factory=list)
+    rejected: bool = False
+    reason: str = ""
+
+
+class RuntimeHook:
+    def __init__(self, state: DeviceState):
+        self.state = state
+
+    def create_container(self, pod_sandbox: dict,
+                         container: dict) -> ContainerAdjustment:
+        """Validate + adjust one container at create time.
+
+        pod_sandbox: {"uid": ..., "claim_uids": [...]} as resolved by the
+        transport from the sandbox's pod object. container: {"name", "env"}.
+        """
+        adj = ContainerAdjustment()
+        claimed = self._claimed_uid(container)
+        if claimed is None:
+            return adj   # not a vtpu tenant; nothing to do
+        prepared = self.state.prepared_uids()
+        if claimed not in prepared:
+            adj.rejected = True
+            adj.reason = (f"container claims unprepared claim {claimed!r}")
+            log.warning("runtime hook rejection: %s", adj.reason)
+            return adj
+        if claimed not in (pod_sandbox.get("claim_uids") or []):
+            # env says claim X but the pod does not own X: spoof attempt
+            adj.rejected = True
+            adj.reason = (f"pod {pod_sandbox.get('uid')} does not own "
+                          f"claim {claimed!r}")
+            log.warning("runtime hook rejection: %s", adj.reason)
+            return adj
+        claim_dir = f"{self.state.base_dir}/claim_{claimed}"
+        adj.mounts.append({
+            "source": f"{claim_dir}/config",
+            "destination": f"{consts.MANAGER_BASE_DIR}/config",
+            "options": ["ro", "rbind"]})
+        adj.env[consts.ENV_REGISTER_UUID] = claimed
+        return adj
+
+    @staticmethod
+    def _claimed_uid(container: dict) -> str | None:
+        for entry in container.get("env") or []:
+            if isinstance(entry, str):
+                key, _, value = entry.partition("=")
+            else:
+                key, value = entry.get("name", ""), entry.get("value", "")
+            if key == "VTPU_CLAIM_UID":
+                return value
+        return None
