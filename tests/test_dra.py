@@ -119,6 +119,49 @@ class TestDeviceState:
         assert state.prepared_uids() == set()
         state.unprepare_claim("claim-1")   # idempotent
 
+    def test_fractional_slots_merge_on_one_chip(self, state):
+        # two 10% slots of chip 0, no opaque config: one merged 20%
+        # partition with slot-default capacities, one device node
+        claim = allocated_claim()
+        claim["status"]["allocation"]["devices"]["results"] = [
+            {"request": "tpu", "driver": consts.DRA_DRIVER_NAME,
+             "pool": "node-1", "device": "vtpu-0-0"},
+            {"request": "tpu", "driver": consts.DRA_DRIVER_NAME,
+             "pool": "node-1", "device": "vtpu-0-1"},
+        ]
+        claim["status"]["allocation"]["devices"]["config"] = []
+        state.prepare_claim(claim)
+        cfg = vc.read_config(os.path.join(
+            state.base_dir, "claim_claim-1", "config", "vtpu.config"))
+        assert len(cfg.devices) == 1
+        assert cfg.devices[0].hard_core == 20
+        assert cfg.devices[0].total_memory == 2 * (16 * 2**30 // 10)
+
+    def test_opaque_config_beyond_slot_denied(self, state):
+        from vtpu_manager.kubeletplugin.device_state import PrepareError
+        claim = allocated_claim(device="vtpu-0-3", cores=50)  # slot is 10%
+        with pytest.raises(PrepareError, match="exceeds allocated"):
+            state.prepare_claim(claim)
+
+    def test_corrupt_checkpoint_quarantined(self, tmp_path):
+        base = tmp_path / "mgr2"
+        base.mkdir()
+        ck_path = str(base / "dra_checkpoint.json")
+        with open(ck_path, "w") as f:
+            f.write('{"checksum": 1, "data": {"version": 2, "claims": {}}}')
+        state = DeviceState("node-1", [fake_chip(0)], base_dir=str(base),
+                            cdi_dir=str(tmp_path / "cdi2"))
+        assert state.prepared_uids() == set()
+        assert os.path.exists(ck_path + ".corrupt")
+
+    def test_claim_uid_env_injected(self, state, tmp_path):
+        state.prepare_claim(allocated_claim())
+        spec = json.load(open(cdi.spec_path("claim-1",
+                                            str(tmp_path / "cdi"))))
+        env = spec["devices"][0]["containerEdits"]["env"]
+        assert "VTPU_CLAIM_UID=claim-1" in env
+        assert f"{consts.ENV_REGISTER_UUID}=claim-1" in env
+
     def test_checkpoint_survives_restart(self, state, tmp_path):
         state.prepare_claim(allocated_claim())
         chips = [fake_chip(0), fake_chip(1)]

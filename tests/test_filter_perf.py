@@ -1,0 +1,131 @@
+"""Scheduler filter perf harness, opt-in via VTPU_PERF=1.
+
+Reference: pkg/scheduler/filter/filter_perf_test.go:29-68 — a matrix of
+nodes x pods x policies with per-pod latency reported (headline 5000 nodes
+x 100k pods). Also a scale-correctness check
+(filter_scale_correctness_test.go): at scale, every accepted pod's claims
+must still fit device capacity exactly.
+
+CI runs a small matrix always (correctness); VTPU_PERF=1 unlocks the big
+matrix and prints the latency table.
+"""
+
+import os
+import time
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.claims import try_decode
+from vtpu_manager.scheduler.bind import BindPredicate
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.util import consts
+
+PERF = os.environ.get("VTPU_PERF") == "1"
+
+
+def make_cluster(n_nodes, chips_per_node=4):
+    client = FakeKubeClient()
+    for i in range(n_nodes):
+        reg = dt.fake_registry(chips_per_node,
+                               mesh_shape=(2, chips_per_node // 2),
+                               uuid_prefix=f"TPU-N{i:05d}")
+        client.add_node(dt.fake_node(f"node-{i:05d}", reg))
+    return client
+
+
+def vtpu_pod(i, cores=25, memory=1024, policy="binpack"):
+    return {
+        "metadata": {"name": f"pod-{i:06d}", "namespace": "default",
+                     "uid": f"uid-{i:06d}",
+                     "annotations": {
+                         consts.node_policy_annotation(): policy}},
+        "spec": {"containers": [{"name": "main", "resources": {"limits": {
+            consts.vtpu_number_resource(): 1,
+            consts.vtpu_cores_resource(): cores,
+            consts.vtpu_memory_resource(): memory}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def run_scenario(n_nodes, n_pods, policy="binpack", chips_per_node=4):
+    client = make_cluster(n_nodes, chips_per_node)
+    pred = FilterPredicate(client)
+    bind = BindPredicate(client)
+    latencies = []
+    placed = 0
+    for i in range(n_pods):
+        pod = vtpu_pod(i, policy=policy)
+        client.add_pod(pod)
+        t0 = time.perf_counter()
+        result = pred.filter({"Pod": pod})
+        latencies.append(time.perf_counter() - t0)
+        if result.node_names:
+            bind.bind({"PodName": pod["metadata"]["name"],
+                       "PodNamespace": "default",
+                       "Node": result.node_names[0]})
+            placed += 1
+    latencies.sort()
+    return {
+        "placed": placed,
+        "p50_ms": 1000 * latencies[len(latencies) // 2],
+        "p99_ms": 1000 * latencies[int(len(latencies) * 0.99)],
+        "client": client,
+    }
+
+
+def assert_no_overcommit(client):
+    """Scale correctness: accepted claims never exceed any chip's capacity
+    (reference filter_scale_correctness_test.go:1-145)."""
+    usage = {}
+    for pod in client.list_pods():
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        claims = try_decode(anns.get(consts.pre_allocated_annotation()))
+        if claims is None:
+            continue
+        for claim in claims.all_claims():
+            cores, mem, number = usage.get(claim.uuid, (0, 0, 0))
+            usage[claim.uuid] = (cores + claim.cores, mem + claim.memory,
+                                 number + 1)
+    for node in client.list_nodes():
+        anns = (node.get("metadata") or {}).get("annotations") or {}
+        raw = anns.get(consts.node_device_register_annotation())
+        if not raw:
+            continue
+        reg = dt.NodeDeviceRegistry.decode(raw)
+        for chip in reg.chips:
+            cores, mem, number = usage.get(chip.uuid, (0, 0, 0))
+            assert cores <= 100, f"{chip.uuid} cores overcommitted: {cores}"
+            assert mem <= chip.memory, f"{chip.uuid} memory overcommitted"
+            assert number <= chip.split_count, \
+                f"{chip.uuid} slots overcommitted"
+
+
+class TestScaleCorrectness:
+    def test_small_matrix(self):
+        # capacity: 8 nodes x 4 chips x (100/25 cores) = 128 placements max;
+        # ask for more to exercise the rejection path too
+        res = run_scenario(n_nodes=8, n_pods=80)
+        assert res["placed"] == 80   # fits: 8*4*4 = 128 slots by cores
+        assert_no_overcommit(res["client"])
+
+    def test_rejects_when_full(self):
+        res = run_scenario(n_nodes=1, n_pods=20, chips_per_node=1)
+        # one chip: 100/25 = 4 core-fits
+        assert res["placed"] == 4
+        assert_no_overcommit(res["client"])
+
+
+@pytest.mark.skipif(not PERF, reason="VTPU_PERF=1 unlocks the perf matrix")
+class TestPerfMatrix:
+    def test_matrix(self):
+        print("\nnodes  pods  policy   placed  p50ms  p99ms")
+        for n_nodes in (100, 1000):
+            for n_pods in (200,):
+                for policy in ("binpack", "spread"):
+                    res = run_scenario(n_nodes, n_pods, policy)
+                    print(f"{n_nodes:5d} {n_pods:5d}  {policy:8s}"
+                          f"{res['placed']:6d} {res['p50_ms']:6.1f} "
+                          f"{res['p99_ms']:6.1f}")
+                    assert_no_overcommit(res["client"])

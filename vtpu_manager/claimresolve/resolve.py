@@ -14,9 +14,9 @@ from vtpu_manager.util import consts
 
 @dataclass(frozen=True)
 class PartitionKey:
-    device: str        # DRA device name (vtpu-<index>)
-    cores: int
-    memory_mib: int
+    device: str               # DRA device name (vtpu-<index>[-<slot>])
+    cores: int | None         # None = no opaque config: consumer applies
+    memory_mib: int | None    # the allocated device's own capacity defaults
 
 
 def pod_claim_names(pod: dict) -> list[tuple[str, str]]:
@@ -60,10 +60,12 @@ def resolve_claim_partitions(claim: dict) -> list[PartitionKey]:
         if result.get("driver") != consts.DRA_DRIVER_NAME:
             continue
         params = params_for(result)
+        cores = params.get("cores")
+        memory = params.get("memoryMiB")
         out.append(PartitionKey(
             device=result.get("device", ""),
-            cores=int(params.get("cores", 100)),
-            memory_mib=int(params.get("memoryMiB", 0))))
+            cores=int(cores) if cores is not None else None,
+            memory_mib=int(memory) if memory is not None else None))
     return out
 
 

@@ -14,7 +14,6 @@ allows).
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass, field
 
 from vtpu_manager.device.allocator.request import (AllocationRequest,
@@ -150,7 +149,7 @@ def allocate(info: NodeInfo, req: AllocationRequest,
     Raises AllocationFailure with aggregated reasons when the pod does not
     fit. On success returns the claims and the charged NodeInfo copy.
     """
-    work = copy.deepcopy(info)
+    work = info.clone()
     claims = PodDeviceClaims()
     kind = "any"
     score = 0.0

@@ -18,6 +18,7 @@ plus resident pods' claim annotations, exactly like the reference
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 from dataclasses import dataclass, field, replace
@@ -165,6 +166,18 @@ class DeviceUsage:
         self.pods.add(pod_uid)
 
 
+@functools.lru_cache(maxsize=8192)
+def _decode_registry_cached(raw: str) -> "NodeDeviceRegistry | None":
+    """Registry annotations change rarely but are re-read every scheduling
+    pass for every node; cache by the raw annotation string. Safe to share:
+    NodeInfo only reads the registry (ChipSpec is frozen), never mutates it.
+    """
+    try:
+        return NodeDeviceRegistry.decode(raw)
+    except (ValueError, TypeError, AttributeError, json.JSONDecodeError):
+        return None
+
+
 def _pod_phase(pod: dict) -> str:
     return (pod.get("status") or {}).get("phase", "")
 
@@ -236,9 +249,8 @@ class NodeInfo:
         raw = anns.get(consts.node_device_register_annotation())
         if not raw:
             return None
-        try:
-            registry = NodeDeviceRegistry.decode(raw)
-        except (ValueError, TypeError, AttributeError, json.JSONDecodeError):
+        registry = _decode_registry_cached(raw)
+        if registry is None:
             return None
         name = (node.get("metadata") or {}).get("name", "")
         info = NodeInfo(name=name, registry=registry)
@@ -271,6 +283,20 @@ class NodeInfo:
     def total_free_memory(self) -> int:
         return sum(max(d.free_memory, 0) for d in self.healthy_devices())
 
+    def clone(self) -> "NodeInfo":
+        """Cheap working copy for allocator what-if charging: ChipSpec and
+        the registry are immutable-by-contract and shared; only the mutable
+        usage tallies are copied (deepcopy here dominates filter latency at
+        1000-node scale)."""
+        info = NodeInfo(name=self.name, registry=self.registry)
+        info.devices = {
+            uuid: DeviceUsage(spec=u.spec, used_number=u.used_number,
+                              used_cores=u.used_cores,
+                              used_memory=u.used_memory,
+                              pods=set(u.pods))
+            for uuid, u in self.devices.items()}
+        return info
+
     def assume_pod(self, pod_uid: str, claims: PodDeviceClaims) -> None:
         """Locally account a just-made allocation so back-to-back filter
         calls see it before the informer catches up (reference:
@@ -298,16 +324,20 @@ def fake_chip(index: int, *, uuid: str | None = None, memory: int = 16 * 2**30,
 
 def fake_registry(n_chips: int, *, mesh_shape: tuple[int, int] | None = None,
                   memory: int = 16 * 2**30, split_count: int = 10,
-                  chip_type: str = "tpu-v5e",
-                  chips_per_host: int = 0) -> NodeDeviceRegistry:
-    """A fake node: n chips laid out row-major on a 2-D mesh."""
+                  chip_type: str = "tpu-v5e", chips_per_host: int = 0,
+                  uuid_prefix: str = "TPU-FAKE") -> NodeDeviceRegistry:
+    """A fake node: n chips laid out row-major on a 2-D mesh. Pass a
+    node-specific uuid_prefix when building multi-node fixtures — real
+    deployments synthesize node-scoped uuids (DeviceIDStore), and duplicate
+    uuids across nodes corrupt any cross-node accounting."""
     if mesh_shape is None:
         mesh_shape = (1, n_chips)
     sx, sy = mesh_shape
     chips = []
     for i in range(n_chips):
         host = i // chips_per_host if chips_per_host else 0
-        chips.append(fake_chip(i, coords=(i % sx, i // sx, 0), memory=memory,
+        chips.append(fake_chip(i, uuid=f"{uuid_prefix}-{i:04d}",
+                               coords=(i % sx, i // sx, 0), memory=memory,
                                split_count=split_count, chip_type=chip_type,
                                host_id=host, numa=host))
     return NodeDeviceRegistry(chips=chips, mesh=MeshSpec((sx, sy, 1)))

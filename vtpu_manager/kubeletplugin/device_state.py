@@ -52,7 +52,19 @@ class DeviceState:
         self.shim_host_dir = shim_host_dir
         self.checkpoint = Checkpoint(
             checkpoint_path or os.path.join(base_dir, "dra_checkpoint.json"))
-        self.checkpoint.load()
+        try:
+            self.checkpoint.load()
+        except ValueError as e:
+            # a torn/corrupt checkpoint must not crashloop the driver:
+            # quarantine it and start empty (kubelet re-prepares live claims)
+            quarantine = f"{self.checkpoint.path}.corrupt"
+            log.error("checkpoint unreadable (%s); quarantined to %s", e,
+                      quarantine)
+            try:
+                os.replace(self.checkpoint.path, quarantine)
+            except OSError:
+                pass
+            self.checkpoint.claims = {}
         self._lock = FileLock(os.path.join(base_dir, "dra_prepare.lock"))
 
     def chip_for_device(self, device_name: str) -> ChipSpec | None:
@@ -64,6 +76,23 @@ class DeviceState:
             return self._chips_by_index.get(int(idx_part))
         except ValueError:
             return None
+
+    @staticmethod
+    def _is_fractional(device_name: str) -> bool:
+        return device_name.count("-") >= 2
+
+    def slot_capacity(self, device_name: str) -> tuple[int, int]:
+        """(cores%, memory bytes) the allocated device actually covers —
+        a fractional slot's proportional share, or the whole chip. Opaque
+        configs may request less but never more than what the scheduler
+        charged against the shared counters."""
+        chip = self.chip_for_device(device_name)
+        if chip is None:
+            return (0, 0)
+        if self._is_fractional(device_name):
+            split = max(chip.split_count, 1)
+            return (100 // split, chip.memory // split)
+        return (100, chip.memory)
 
     # -- prepare ------------------------------------------------------------
 
@@ -99,33 +128,50 @@ class DeviceState:
                 raise PrepareError(f"malformed opaque config: {e}") from e
 
             devices = []
-            host_indices = []
             envs: dict[str, str] = {}
-            for i, part in enumerate(partitions):
+            # merge same-chip partitions: two fractional slots of one chip
+            # are one bigger partition of that chip, not two conflicting
+            # per-index caps
+            merged: dict[int, dict] = {}
+            for part in partitions:
                 chip = self.chip_for_device(part.device)
                 if chip is None:
                     raise PrepareError(
                         f"allocated device {part.device!r} not on node")
-                if not 0 < part.cores <= 100:
-                    raise PrepareError(f"cores {part.cores} out of range")
-                memory = part.memory_mib * 2**20 or chip.memory
-                # total beyond physical HBM requires the explicit oversold
-                # opt-in, same contract as the device-plugin path
-                if memory > chip.memory and \
+                slot_cores, slot_mem = self.slot_capacity(part.device)
+                cores = part.cores if part.cores is not None else slot_cores
+                memory = (part.memory_mib * 2**20
+                          if part.memory_mib is not None else slot_mem)
+                if not 0 < cores <= 100:
+                    raise PrepareError(f"cores {cores} out of range")
+                if cores > slot_cores or memory > slot_mem:
+                    # requesting beyond what the scheduler charged against
+                    # the shared counters would overcommit the chip
+                    raise PrepareError(
+                        f"opaque config ({cores}%, {memory >> 20}MiB) "
+                        f"exceeds allocated device capacity "
+                        f"({slot_cores}%, {slot_mem >> 20}MiB)")
+                entry = merged.setdefault(chip.index, {
+                    "device": part.device, "uuid": chip.uuid,
+                    "hostIndex": chip.index, "cores": 0, "memory": 0})
+                entry["cores"] = min(entry["cores"] + cores, 100)
+                entry["memory"] += memory
+            host_indices = sorted(merged)
+            for index in host_indices:
+                entry = merged[index]
+                chip = self._chips_by_index[index]
+                if entry["memory"] > chip.memory and \
                         not self.node_config.memory_overused:
                     raise PrepareError(
-                        f"memoryMiB {part.memory_mib} exceeds chip HBM "
-                        f"{chip.memory // 2**20}MiB (node not configured "
-                        "for memory oversubscription)")
-                envs[f"{consts.ENV_MEM_LIMIT}_{i}"] = str(memory)
-                if part.cores < 100:
-                    envs[f"{consts.ENV_CORE_LIMIT}_{i}"] = str(part.cores)
-                host_indices.append(chip.index)
-                devices.append({
-                    "device": part.device, "uuid": chip.uuid,
-                    "hostIndex": chip.index, "cores": part.cores,
-                    "memory": memory,
-                })
+                        f"merged memory {entry['memory'] >> 20}MiB exceeds "
+                        f"chip HBM {chip.memory >> 20}MiB (node not "
+                        "configured for memory oversubscription)")
+                devices.append(entry)
+            for i, entry in enumerate(devices):
+                envs[f"{consts.ENV_MEM_LIMIT}_{i}"] = str(entry["memory"])
+                if entry["cores"] < 100:
+                    envs[f"{consts.ENV_CORE_LIMIT}_{i}"] = \
+                        str(entry["cores"])
             envs[consts.ENV_VISIBLE_DEVICES] = ",".join(
                 str(i) for i in host_indices)
             envs[consts.ENV_TPU_VISIBLE_DEVICES] = \
@@ -135,6 +181,8 @@ class DeviceState:
             envs[consts.ENV_TPU_LIBRARY_PATH] = shim
             envs[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
             envs[consts.ENV_VTPU_REAL_PLUGIN_PATH] = self.libtpu_path
+            envs["VTPU_CLAIM_UID"] = uid
+            envs[consts.ENV_REGISTER_UUID] = uid
             envs[consts.ENV_COMPAT_MODE] = str(_COMPAT_BITS.get(
                 self.node_config.compat_mode, consts.COMPAT_HOST))
             envs["VTPU_CONFIG_PATH"] = \

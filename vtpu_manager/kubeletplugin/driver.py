@@ -16,7 +16,7 @@ from concurrent import futures
 
 import grpc
 
-from vtpu_manager.client.kube import KubeClient
+from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.kubeletplugin.api import dra_pb2 as pb
 from vtpu_manager.kubeletplugin.device_state import DeviceState, PrepareError
@@ -25,6 +25,11 @@ from vtpu_manager.util import consts
 log = logging.getLogger(__name__)
 
 DRA_PLUGIN_DIR = "/var/lib/kubelet/plugins/vtpu-dra"
+
+
+class ClaimLookupError(RuntimeError):
+    """Transient API failure — distinct from a claim that does not exist,
+    so the kubelet retries instead of surfacing a misleading not-found."""
 
 
 class ClaimSource:
@@ -44,8 +49,17 @@ class ClaimSource:
             if getter is not None:
                 try:
                     claim = getter(namespace, name)
-                except Exception:
-                    claim = None
+                except KubeError as e:
+                    if e.status == 404:
+                        claim = None
+                    else:
+                        log.warning("claim %s/%s lookup failed: %s",
+                                    namespace, name, e)
+                        raise ClaimLookupError(str(e)) from e
+                except Exception as e:
+                    log.warning("claim %s/%s lookup failed: %s",
+                                namespace, name, e)
+                    raise ClaimLookupError(str(e)) from e
         if claim is None:
             return None
         # the name may have been recreated with a new uid; preparing the
@@ -77,8 +91,12 @@ class DraDriver:
         resp = pb.NodePrepareResourcesResponse()
         for claim_ref in request.claims:
             entry = resp.claims[claim_ref.uid]
-            claim = self.claims.get(claim_ref.uid, claim_ref.name,
-                                    claim_ref.namespace)
+            try:
+                claim = self.claims.get(claim_ref.uid, claim_ref.name,
+                                        claim_ref.namespace)
+            except ClaimLookupError as e:
+                entry.error = f"claim lookup failed (transient): {e}"
+                continue
             if claim is None:
                 entry.error = (f"claim {claim_ref.namespace}/"
                                f"{claim_ref.name} not found")
@@ -121,11 +139,7 @@ class DraDriver:
     # -- serving ------------------------------------------------------------
 
     def _handlers(self) -> grpc.GenericRpcHandler:
-        def unary(fn, req_cls, resp_cls):
-            return grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString,
-                response_serializer=resp_cls.SerializeToString)
-
+        from vtpu_manager.kubeletplugin.grpcutil import unary
         return grpc.method_handlers_generic_handler(
             "v1beta1dra.DRAPlugin", {
                 "NodePrepareResources": unary(

@@ -1,0 +1,11 @@
+"""Shared grpc handler plumbing for the hand-wired services."""
+
+from __future__ import annotations
+
+import grpc
+
+
+def unary(fn, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString)

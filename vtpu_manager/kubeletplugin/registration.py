@@ -59,11 +59,7 @@ class RegistrationServer:
                           request.error)
             return pb.RegistrationStatusResponse()
 
-        def unary(fn, req_cls, resp_cls):
-            return grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString,
-                response_serializer=resp_cls.SerializeToString)
-
+        from vtpu_manager.kubeletplugin.grpcutil import unary
         return grpc.method_handlers_generic_handler(
             "pluginregistration.Registration", {
                 "GetInfo": unary(get_info, pb.InfoRequest, pb.PluginInfo),
