@@ -18,6 +18,7 @@ from concurrent import futures
 import grpc
 
 from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+from vtpu_manager.util.grpcutil import unary as _unary
 
 log = logging.getLogger(__name__)
 
@@ -56,12 +57,6 @@ class DevicePluginServicer:
             self, request: pb.PreStartContainerRequest
     ) -> pb.PreStartContainerResponse:
         return pb.PreStartContainerResponse()
-
-
-def _unary(fn, req_cls, resp_cls):
-    return grpc.unary_unary_rpc_method_handler(
-        fn, request_deserializer=req_cls.FromString,
-        response_serializer=resp_cls.SerializeToString)
 
 
 class PluginServer:

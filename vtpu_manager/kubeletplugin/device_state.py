@@ -54,7 +54,8 @@ class DeviceState:
             checkpoint_path or os.path.join(base_dir, "dra_checkpoint.json"))
         try:
             self.checkpoint.load()
-        except ValueError as e:
+        except (ValueError, TypeError, AttributeError,
+                KeyError) as e:
             # a torn/corrupt checkpoint must not crashloop the driver:
             # quarantine it and start empty (kubelet re-prepares live claims)
             quarantine = f"{self.checkpoint.path}.corrupt"
@@ -144,9 +145,14 @@ class DeviceState:
                           if part.memory_mib is not None else slot_mem)
                 if not 0 < cores <= 100:
                     raise PrepareError(f"cores {cores} out of range")
-                if cores > slot_cores or memory > slot_mem:
-                    # requesting beyond what the scheduler charged against
-                    # the shared counters would overcommit the chip
+                # beyond what the scheduler charged against the shared
+                # counters would overcommit the chip — except whole-chip
+                # memory with the explicit oversold opt-in (HBM spill),
+                # which the merged check below still bounds
+                mem_over = memory > slot_mem and (
+                    self._is_fractional(part.device)
+                    or not self.node_config.memory_overused)
+                if cores > slot_cores or mem_over:
                     raise PrepareError(
                         f"opaque config ({cores}%, {memory >> 20}MiB) "
                         f"exceeds allocated device capacity "

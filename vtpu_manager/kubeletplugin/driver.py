@@ -139,7 +139,7 @@ class DraDriver:
     # -- serving ------------------------------------------------------------
 
     def _handlers(self) -> grpc.GenericRpcHandler:
-        from vtpu_manager.kubeletplugin.grpcutil import unary
+        from vtpu_manager.util.grpcutil import unary
         return grpc.method_handlers_generic_handler(
             "v1beta1dra.DRAPlugin", {
                 "NodePrepareResources": unary(

@@ -59,7 +59,7 @@ class RegistrationServer:
                           request.error)
             return pb.RegistrationStatusResponse()
 
-        from vtpu_manager.kubeletplugin.grpcutil import unary
+        from vtpu_manager.util.grpcutil import unary
         return grpc.method_handlers_generic_handler(
             "pluginregistration.Registration", {
                 "GetInfo": unary(get_info, pb.InfoRequest, pb.PluginInfo),
