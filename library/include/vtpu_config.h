@@ -75,6 +75,15 @@ static_assert(offsetof(VtpuConfig, device_count) == 248, "ABI");
 static_assert(offsetof(VtpuConfig, devices) == 256, "ABI");
 static_assert(sizeof(VtpuConfig) == 256 + 64 * 120 + 8, "VtpuConfig ABI");
 
+inline uint64_t Fnv1a64(const char* data) {
+  uint64_t h = 0xCBF29CE484222325ull;
+  for (const char* p = data; *p; ++p) {
+    h ^= (uint64_t)(unsigned char)*p;
+    h *= 0x100000001B3ull;
+  }
+  return h;
+}
+
 inline uint32_t Fnv1a(const uint8_t* data, size_t len) {
   uint32_t h = 0x811C9DC5u;
   for (size_t i = 0; i < len; ++i) {
@@ -93,10 +102,11 @@ constexpr int kMaxProcs = 32;
 
 struct TcProcUtil {
   int32_t pid;
-  int32_t util;       // percent of the chip
-  uint64_t mem_used;  // bytes
+  int32_t util;          // percent of the chip
+  uint64_t mem_used;     // bytes
+  uint64_t owner_token;  // namespace-independent tenant identity
 };
-static_assert(sizeof(TcProcUtil) == 16, "ABI");
+static_assert(sizeof(TcProcUtil) == 24, "ABI");
 
 struct TcDeviceRecord {
   uint64_t seq;           // seqlock: odd while writing
@@ -105,7 +115,7 @@ struct TcDeviceRecord {
   int32_t proc_count;
   TcProcUtil procs[kMaxProcs];
 };
-static_assert(sizeof(TcDeviceRecord) == 24 + 512, "ABI");
+static_assert(sizeof(TcDeviceRecord) == 24 + 32 * 24, "ABI");
 
 struct TcUtilFile {
   uint32_t magic;
@@ -115,7 +125,7 @@ struct TcUtilFile {
   TcDeviceRecord records[kMaxDeviceCount];
 };
 static_assert(offsetof(TcUtilFile, records) == 16, "ABI");
-static_assert(sizeof(TcUtilFile) == 16 + 64 * (24 + 512), "ABI");
+static_assert(sizeof(TcUtilFile) == 16 + 64 * (24 + 32 * 24), "ABI");
 
 // ---------------------------------------------------------------------------
 // vmem_node.config (cross-process memory ledger)
@@ -125,12 +135,13 @@ constexpr uint32_t kVmemMagic = 0x4D454D56;  // "VMEM"
 constexpr int kVmemMaxEntries = 1024;
 
 struct VmemEntry {
-  int32_t pid;  // 0 = free slot
+  int32_t pid;  // 0 = free slot (pid is namespace-local; identity below)
   int32_t host_index;
   uint64_t bytes;
   uint64_t last_update_ns;
+  uint64_t owner_token;  // namespace-independent tenant identity
 };
-static_assert(sizeof(VmemEntry) == 24, "ABI");
+static_assert(sizeof(VmemEntry) == 32, "ABI");
 
 struct VmemFile {
   uint32_t magic;
@@ -139,7 +150,22 @@ struct VmemFile {
   int32_t pad_;
   VmemEntry entries[kVmemMaxEntries];
 };
-static_assert(sizeof(VmemFile) == 16 + 1024 * 24, "ABI");
+static_assert(sizeof(VmemFile) == 16 + 1024 * 32, "ABI");
+
+// ---------------------------------------------------------------------------
+// pids.config (CLIENT compat mode: registry-attested container pid set)
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kPidsMagic = 0x53444950;  // "PIDS"
+
+struct PidsFileHeader {
+  uint32_t magic;
+  uint32_t version;
+  int32_t count;
+  int32_t pad_;
+  // followed by count little-endian int32 pids
+};
+static_assert(sizeof(PidsFileHeader) == 16, "ABI");
 
 }  // namespace vtpu
 

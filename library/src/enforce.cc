@@ -37,6 +37,8 @@
 #include <chrono>
 #include <cinttypes>
 #include <condition_variable>
+#include <string>
+#include <unordered_set>
 #include <vector>
 
 #include "shim.h"
@@ -147,7 +149,40 @@ class DeviceLock {
 // ---------------------------------------------------------------------------
 
 VmemFile* g_vmem = nullptr;
-int g_vmem_lock_fd = -1;  // flock on <path>.lock — same protocol as the
+int g_vmem_lock_fd = -1;
+uint64_t g_owner_token = 0;  // namespace-independent tenant identity
+
+uint64_t ComputeOwnerToken() {
+  const char* pod_uid = getenv("VTPU_POD_UID");
+  const char* cont = getenv("VTPU_CONTAINER_NAME");
+  if (pod_uid && *pod_uid) {
+    char buf[256];
+    snprintf(buf, sizeof(buf), "%s/%s", pod_uid, cont ? cont : "");
+    return Fnv1a64(buf);
+  }
+  // bare-process fallback: boot-scoped pid identity
+  char buf[128];
+  unsigned long long starttime = 0;
+  FILE* f = fopen("/proc/self/stat", "r");
+  if (f) {
+    char line[1024];
+    if (fgets(line, sizeof(line), f)) {
+      // field 22 (starttime), after the comm field which may contain spaces
+      char* p = strrchr(line, ')');
+      int field = 2;
+      for (char* tok = p ? strtok(p + 1, " ") : nullptr; tok;
+           tok = strtok(nullptr, " ")) {
+        if (++field == 22) {
+          starttime = strtoull(tok, nullptr, 10);
+          break;
+        }
+      }
+    }
+    fclose(f);
+  }
+  snprintf(buf, sizeof(buf), "proc-%d-%llu", (int)getpid(), starttime);
+  return Fnv1a64(buf);
+}  // flock on <path>.lock — same protocol as the
                           // Python VmemLedger's FileLock, so C++ and Python
                           // writers exclude each other
 
@@ -190,10 +225,67 @@ void MapVmemLedger() {
     return;
   }
   g_vmem = f;
-  VTPU_LOG(kLogInfo, "vmem ledger mapped: %s", path);
+  g_owner_token = ComputeOwnerToken();
+  VTPU_LOG(kLogInfo, "vmem ledger mapped: %s (token=%016llx)", path,
+           (unsigned long long)g_owner_token);
 }
 
 bool PidAlive(int pid) { return kill(pid, 0) == 0 || errno != ESRCH; }
+
+// -----------------------------------------------------------------------
+// CLIENT compat: the registry-attested pid set of OUR container, used to
+// classify ledger/watcher pids as self vs co-tenant (reference: CLIENT
+// mode pids.config, util.c:455-505). Refreshed by the watcher tick when
+// the file changes.
+// -----------------------------------------------------------------------
+
+std::mutex g_client_pids_mu;
+std::unordered_set<int> g_client_pids;
+time_t g_client_pids_mtime = 0;
+
+std::string ClientPidsPath() {
+  const char* cfg = getenv("VTPU_CONFIG_PATH");
+  std::string dir = cfg ? cfg : "/etc/vtpu-manager/config/vtpu.config";
+  size_t slash = dir.rfind('/');
+  if (slash == std::string::npos) return "pids.config";
+  return dir.substr(0, slash) + "/pids.config";
+}
+
+void RefreshClientPids() {
+  ShimState& s = State();
+  if (!(s.config.compat_mode & kCompatClient)) return;
+  std::string path = ClientPidsPath();
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0) return;
+  if (st.st_mtime == g_client_pids_mtime) return;
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return;
+  PidsFileHeader header;
+  std::unordered_set<int> pids;
+  if (read(fd, &header, sizeof(header)) == (ssize_t)sizeof(header) &&
+      header.magic == kPidsMagic && header.version == 1 &&
+      header.count >= 0 && header.count < 65536) {
+    for (int i = 0; i < header.count; i++) {
+      int32_t pid;
+      if (read(fd, &pid, sizeof(pid)) != (ssize_t)sizeof(pid)) break;
+      pids.insert(pid);
+    }
+    std::lock_guard<std::mutex> g(g_client_pids_mu);
+    g_client_pids.swap(pids);
+    g_client_pids_mtime = st.st_mtime;
+    VTPU_LOG(kLogInfo, "client pid set refreshed (%zu pids)",
+             g_client_pids.size());
+  }
+  close(fd);
+}
+
+bool PidIsSelf(int pid) {
+  if (pid == (int)getpid()) return true;
+  ShimState& s = State();
+  if (!(s.config.compat_mode & kCompatClient)) return false;
+  std::lock_guard<std::mutex> g(g_client_pids_mu);
+  return g_client_pids.count(pid) > 0;
+}
 
 }  // namespace
 
@@ -201,12 +293,21 @@ int64_t OtherProcsBytes(int slot) {
   const VtpuDevice* cfg = DeviceCfg(slot);
   if (!g_vmem || !cfg) return 0;
   int64_t total = 0;
-  int me = (int)getpid();
+  uint64_t now = NowNs();
   for (int i = 0; i < kVmemMaxEntries; i++) {
     const VmemEntry& e = g_vmem->entries[i];
-    if (e.pid == 0 || e.pid == me || e.host_index != cfg->host_index)
+    if (e.pid == 0 || e.host_index != cfg->host_index) continue;
+    // tenant identity is the token — pids are namespace-local and
+    // meaningless across containers; tokenless legacy entries fall back
+    // to the registry-attested pid set
+    if (e.owner_token != 0 ? e.owner_token == g_owner_token
+                           : PidIsSelf(e.pid))
       continue;
-    if (!PidAlive(e.pid)) continue;
+    // liveness of a foreign namespace's pid is unknowable: count the
+    // entry unless it has also gone stale (the daemon reaps those)
+    if (!PidAlive(e.pid) &&
+        now - e.last_update_ns > 120ull * 1000 * 1000 * 1000)
+      continue;
     total += (int64_t)e.bytes;
   }
   return total;
@@ -225,7 +326,8 @@ void RecordOwnBytes(int slot) {
   int free_slot = -1;
   for (int i = 0; i < kVmemMaxEntries; i++) {
     VmemEntry& e = g_vmem->entries[i];
-    if (e.pid == me && e.host_index == cfg->host_index) {
+    if (e.pid == me && e.host_index == cfg->host_index &&
+        e.owner_token == g_owner_token) {
       e.bytes = mine;
       e.last_update_ns = NowNs();
       return;
@@ -237,6 +339,7 @@ void RecordOwnBytes(int slot) {
     e.host_index = cfg->host_index;
     e.bytes = mine;
     e.last_update_ns = NowNs();
+    e.owner_token = g_owner_token;
     __atomic_store_n(&e.pid, me, __ATOMIC_RELEASE);  // pid last: claims slot
   }
 }
@@ -484,9 +587,14 @@ int MeasuredUtil(int slot, int64_t window_ns, bool* external,
       uint64_t ts = rec.timestamp_ns;
       int nproc = std::min(rec.proc_count, (int32_t)kMaxProcs);
       bool other = false;
-      int me = (int)getpid();
-      for (int i = 0; i < nproc; i++)
-        if (rec.procs[i].pid != me && rec.procs[i].pid != 0) other = true;
+      for (int i = 0; i < nproc; i++) {
+        const TcProcUtil& proc = rec.procs[i];
+        if (proc.pid == 0) continue;
+        bool self = proc.owner_token != 0
+                        ? proc.owner_token == g_owner_token
+                        : PidIsSelf(proc.pid);
+        if (!self) other = true;
+      }
       uint64_t seq2 = __atomic_load_n(&rec.seq, __ATOMIC_ACQUIRE);
       if (seq1 != seq2) continue;
       uint64_t now = NowNs();
@@ -618,6 +726,7 @@ void WatcherTick(int64_t window_ns) {
     s.hot[slot].tokens_us.store(next, std::memory_order_relaxed);
     s.hot[slot].throttled_since_watch.store(false);
   }
+  RefreshClientPids();
   RefreshOthersCache();
   g_metrics.watcher_ticks.Bump();
 }
@@ -1145,6 +1254,20 @@ void ResetAwaitForFork() {
   g_await_running.store(false);
   new (&g_await_mu) std::mutex();
   g_await_head = g_await_tail = nullptr;
+}
+
+__attribute__((destructor)) static void ClearOwnLedgerEntries() {
+  if (!g_vmem) return;
+  int me = (int)getpid();
+  for (int i = 0; i < kVmemMaxEntries; i++) {
+    VmemEntry& e = g_vmem->entries[i];
+    if (e.pid == me && e.owner_token == g_owner_token) {
+      e.bytes = 0;
+      e.last_update_ns = 0;
+      e.owner_token = 0;
+      __atomic_store_n(&e.pid, 0, __ATOMIC_RELEASE);
+    }
+  }
 }
 
 void WrapEnforcementEntries(PJRT_Api* api) {

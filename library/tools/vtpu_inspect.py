@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""vtpu_inspect: dump the node's shared enforcement state.
+
+Reference: library/tools/ (mem_view_tool.c, virt_mem_tool.c ...) — operator
+diagnostics over the L3 files. Shows per-container configs, the vmem
+ledger, and the TC-util watcher feed.
+
+Usage: python library/tools/vtpu_inspect.py [--base /etc/vtpu-manager]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from vtpu_manager.config import tc_watcher, vtpu_config as vc   # noqa: E402
+from vtpu_manager.config.vmem import VmemLedger                 # noqa: E402
+from vtpu_manager.registry.server import read_pids_config       # noqa: E402
+from vtpu_manager.util import consts                            # noqa: E402
+
+
+def dump_configs(base: str) -> None:
+    print(f"== container configs under {base}")
+    found = False
+    if os.path.isdir(base):
+        for entry in sorted(os.listdir(base)):
+            path = os.path.join(base, entry, "config", "vtpu.config")
+            if not os.path.exists(path):
+                continue
+            found = True
+            try:
+                cfg = vc.read_config(path)
+            except (OSError, ValueError) as e:
+                print(f"  {entry}: UNREADABLE ({e})")
+                continue
+            print(f"  {entry}: pod={cfg.pod_namespace}/{cfg.pod_name} "
+                  f"compat={cfg.compat_mode:#x}")
+            for dev in cfg.devices:
+                print(f"    dev[{dev.host_index}] {dev.uuid} "
+                      f"cap={dev.total_memory >> 20}MiB "
+                      f"core={dev.hard_core}..{dev.soft_core} "
+                      f"limit={dev.core_limit} "
+                      f"oversold={int(dev.memory_oversold)}")
+            pids = os.path.join(base, entry, "config",
+                                consts.PIDS_CONFIG_NAME)
+            if os.path.exists(pids):
+                try:
+                    print(f"    pids: {read_pids_config(pids)}")
+                except ValueError:
+                    print("    pids: UNREADABLE")
+    if not found:
+        print("  (none)")
+
+
+def dump_ledger(path: str) -> None:
+    print(f"== vmem ledger {path}")
+    try:
+        ledger = VmemLedger(path)
+    except (OSError, ValueError):
+        print("  (absent)")
+        return
+    entries = ledger.entries()
+    ledger.close()
+    if not entries:
+        print("  (empty)")
+    for e in entries:
+        print(f"  pid={e.pid} device={e.host_index} "
+              f"bytes={e.bytes} ({e.bytes >> 20}MiB)")
+
+
+def dump_watcher(path: str) -> None:
+    print(f"== tc_util feed {path}")
+    try:
+        feed = tc_watcher.TcUtilFile(path)
+    except (OSError, ValueError):
+        print("  (absent)")
+        return
+    shown = 0
+    for i in range(tc_watcher.MAX_DEVICE_COUNT):
+        rec = feed.read_device(i)
+        if rec is None or rec.timestamp_ns == 0:
+            continue
+        shown += 1
+        fresh = "fresh" if rec.is_fresh() else "STALE"
+        print(f"  dev[{i}] util={rec.device_util}% {fresh} "
+              f"procs={[(p.pid, p.util) for p in rec.procs]}")
+    feed.close()
+    if not shown:
+        print("  (no samples)")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--base", default=consts.MANAGER_BASE_DIR)
+    parser.add_argument("--vmem", default=consts.VMEM_NODE_CONFIG)
+    parser.add_argument("--tc", default=consts.TC_UTIL_CONFIG)
+    args = parser.parse_args()
+    dump_configs(args.base)
+    dump_ledger(args.vmem)
+    dump_watcher(args.tc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

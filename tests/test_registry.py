@@ -56,20 +56,20 @@ def register(sock, pod_uid, container, monkeypatch):
 class TestRegistry:
     def test_successful_registration(self, registry, monkeypatch):
         server, base, sock = registry
-        (base / "uid-good_main").mkdir()
+        (base / "uid-good_main" / "config").mkdir(parents=True)
         assert register(sock, "uid-good", "main", monkeypatch)
         pids = read_pids_config(
-            str(base / "uid-good_main" / consts.PIDS_CONFIG_NAME))
+            str(base / "uid-good_main" / "config" / consts.PIDS_CONFIG_NAME))
         assert os.getpid() in pids and 4242 in pids
         assert server.registrations[0]["pod_uid"] == "uid-good"
 
     def test_spoofed_identity_rejected(self, registry, monkeypatch):
         server, base, sock = registry
-        (base / "uid-other_main").mkdir()
+        (base / "uid-other_main" / "config").mkdir(parents=True)
         # we claim pod uid-other but our cgroup says uid-good
         assert not register(sock, "uid-other", "main", monkeypatch)
         assert not os.path.exists(
-            str(base / "uid-other_main" / consts.PIDS_CONFIG_NAME))
+            str(base / "uid-other_main" / "config" / consts.PIDS_CONFIG_NAME))
 
     def test_unallocated_container_rejected(self, registry, monkeypatch):
         server, base, sock = registry

@@ -34,11 +34,14 @@ def apply_patches(pod, patches):
                  for p in patch["path"].lstrip("/").split("/")]
         parent = doc
         for key in parts[:-1]:
-            parent = parent[key]
+            parent = parent[int(key) if isinstance(parent, list) else key]
+        last = parts[-1]
+        if isinstance(parent, list):
+            last = int(last)
         if patch["op"] in ("add", "replace"):
-            parent[parts[-1]] = patch["value"]
+            parent[last] = patch["value"]
         elif patch["op"] == "remove":
-            del parent[parts[-1]]
+            del parent[last]
     return doc
 
 
@@ -165,3 +168,59 @@ class TestAdmissionHTTP:
                 assert "vtpu-cores" in body["response"]["status"]["message"]
 
         asyncio.run(scenario())
+
+
+class TestDraConversion:
+    def test_converts_resources_to_claims(self):
+        from vtpu_manager.webhook.dra_convert import convert_pod_to_dra
+        pod = vtpu_pod(number=2, cores=25, memory=2048)
+        pod["metadata"]["name"] = "train"
+        conv = convert_pod_to_dra(pod)
+        assert len(conv.claim_templates) == 1
+        spec = conv.claim_templates[0]["spec"]["spec"]
+        assert spec["devices"]["requests"][0]["count"] == 2
+        params = spec["devices"]["config"][0]["opaque"]["parameters"]
+        assert params == {"cores": 25, "memoryMiB": 2048}
+        mutated = apply_patches(pod, conv.patches)
+        limits = mutated["spec"]["containers"][0]["resources"]["limits"]
+        assert consts.vtpu_number_resource() not in limits
+        assert mutated["spec"]["containers"][0]["resources"]["claims"] == \
+            [{"name": "vtpu-c"}]
+        template_name = mutated["spec"]["resourceClaims"][0][
+            "resourceClaimTemplateName"]
+        assert template_name.startswith("train-vtpu-c-")
+        assert template_name == conv.claim_templates[0]["metadata"]["name"]
+        # distinct partitions never share a template; identical ones do
+        other = vtpu_pod(number=2, cores=50, memory=2048)
+        other["metadata"]["generateName"] = "train-"
+        del other["metadata"]["name"]
+        conv2 = convert_pod_to_dra(other)
+        assert conv2.claim_templates[0]["metadata"]["name"] != template_name
+
+    def test_non_vtpu_untouched(self):
+        from vtpu_manager.webhook.dra_convert import convert_pod_to_dra
+        pod = {"metadata": {}, "spec": {"containers": [
+            {"name": "c", "resources": {}}]}}
+        conv = convert_pod_to_dra(pod)
+        assert not conv.patches and not conv.claim_templates
+
+    def test_roundtrip_through_claimresolve(self):
+        # the generated claim's opaque config must resolve to the same
+        # partition the device plugin would have enforced
+        from vtpu_manager.claimresolve.resolve import (
+            resolve_claim_partitions)
+        from vtpu_manager.webhook.dra_convert import convert_pod_to_dra
+        pod = vtpu_pod(number=1, cores=40, memory=4096)
+        pod["metadata"]["name"] = "t"
+        conv = convert_pod_to_dra(pod)
+        template_spec = conv.claim_templates[0]["spec"]["spec"]
+        claim = {"metadata": {"uid": "u"}, "status": {"allocation": {
+            "devices": {
+                "results": [{"request": "vtpu",
+                             "driver": consts.DRA_DRIVER_NAME,
+                             "device": "vtpu-0-0"}],
+                "config": template_spec["devices"]["config"],
+            }}}}
+        parts = resolve_claim_partitions(claim)
+        assert parts[0].cores == 40
+        assert parts[0].memory_mib == 4096

@@ -37,16 +37,17 @@ _HEADER_FMT = "<IIii"
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
 assert HEADER_SIZE == 16
 
-# proc entry: pid i32, util i32 (percent), mem_used u64
-_PROC_FMT = "<iiQ"
+# proc entry: pid i32, util i32 (percent), mem_used u64, owner_token u64
+# (tokens, not pids, identify tenants across pid namespaces)
+_PROC_FMT = "<iiQQ"
 PROC_SIZE = struct.calcsize(_PROC_FMT)
-assert PROC_SIZE == 16
+assert PROC_SIZE == 24
 
 # device record: seq u64, timestamp_ns u64, device_util i32, proc_count i32,
 # procs[32]
 _RECORD_HEAD_FMT = "<QQii"
 RECORD_SIZE = struct.calcsize(_RECORD_HEAD_FMT) + MAX_PROCS * PROC_SIZE
-assert RECORD_SIZE == 24 + 512
+assert RECORD_SIZE == 24 + 32 * 24
 
 FILE_SIZE = HEADER_SIZE + MAX_DEVICE_COUNT * RECORD_SIZE
 
@@ -56,6 +57,7 @@ class ProcUtil:
     pid: int
     util: int            # percent of the chip this process consumed
     mem_used: int        # bytes
+    owner_token: int = 0  # namespace-independent tenant identity
 
 
 @dataclass
@@ -144,7 +146,7 @@ class TcUtilFile:
             poff = off + struct.calcsize(_RECORD_HEAD_FMT)
             for i, p in enumerate(procs):
                 struct.pack_into(_PROC_FMT, self._mm, poff + i * PROC_SIZE,
-                                 p.pid, p.util, p.mem_used)
+                                 p.pid, p.util, p.mem_used, p.owner_token)
             struct.pack_into("<Q", self._mm, off, wseq + 1)  # even: stable
 
     # -- reader (shim / metrics) -------------------------------------------
@@ -167,9 +169,9 @@ class TcUtilFile:
             procs = []
             poff = off + struct.calcsize(_RECORD_HEAD_FMT)
             for i in range(count):
-                pid, putil, mem = struct.unpack_from(
+                pid, putil, mem, token = struct.unpack_from(
                     _PROC_FMT, self._mm, poff + i * PROC_SIZE)
-                procs.append(ProcUtil(pid, putil, mem))
+                procs.append(ProcUtil(pid, putil, mem, token))
             seq2, = struct.unpack_from("<Q", self._mm, off)
             if seq1 == seq2:
                 return DeviceUtil(timestamp_ns=ts, device_util=dev_util,

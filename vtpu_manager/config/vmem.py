@@ -26,16 +26,43 @@ from vtpu_manager.util.flock import FileLock
 MAGIC = 0x4D454D56          # "VMEM"
 VERSION = 1
 MAX_ENTRIES = 1024
+STALE_REAP_NS = 120 * 10**9
 
 _HEADER_FMT = "<IIii"       # magic, version, max_entries, pad
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
 
-# entry: pid i32, host_index i32, bytes u64, last_update_ns u64
-_ENTRY_FMT = "<iiQQ"
+# entry: pid i32, host_index i32, bytes u64, last_update_ns u64,
+# owner_token u64 — the pid alone cannot identify a tenant across pid
+# namespaces (a container's getpid() is meaningless to other containers
+# and to the host daemon), so self/other classification keys on a
+# namespace-independent token derived from pod identity
+_ENTRY_FMT = "<iiQQQ"
 ENTRY_SIZE = struct.calcsize(_ENTRY_FMT)
-assert ENTRY_SIZE == 24
+assert ENTRY_SIZE == 32
 
 FILE_SIZE = HEADER_SIZE + MAX_ENTRIES * ENTRY_SIZE
+
+
+def fnv64(data: str) -> int:
+    h = 0xCBF29CE484222325
+    for b in data.encode():
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def owner_token_from_env() -> int:
+    """Stable per-container token: pod uid + container name when the
+    manager injected them; a boot-scoped fallback otherwise."""
+    pod_uid = os.environ.get("VTPU_POD_UID", "")
+    cont = os.environ.get("VTPU_CONTAINER_NAME", "")
+    if pod_uid:
+        return fnv64(f"{pod_uid}/{cont}")
+    try:
+        with open("/proc/self/stat") as f:
+            starttime = f.read().split()[21]
+    except (OSError, IndexError):
+        starttime = "0"
+    return fnv64(f"proc-{os.getpid()}-{starttime}")
 
 
 @dataclass
@@ -44,6 +71,7 @@ class VmemEntry:
     host_index: int
     bytes: int
     last_update_ns: int
+    owner_token: int = 0
 
 
 def _pid_alive(pid: int) -> bool:
@@ -93,29 +121,34 @@ class VmemLedger:
             self._fd = None
 
     def _entry(self, i: int) -> VmemEntry:
-        pid, hidx, nbytes, ts = struct.unpack_from(
+        pid, hidx, nbytes, ts, token = struct.unpack_from(
             _ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE)
-        return VmemEntry(pid, hidx, nbytes, ts)
+        return VmemEntry(pid, hidx, nbytes, ts, token)
 
     def _write_entry(self, i: int, e: VmemEntry) -> None:
         struct.pack_into(_ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE,
-                         e.pid, e.host_index, e.bytes, e.last_update_ns)
+                         e.pid, e.host_index, e.bytes, e.last_update_ns,
+                         e.owner_token)
 
     # -- API ----------------------------------------------------------------
 
-    def record(self, pid: int, host_index: int, nbytes: int) -> None:
+    def record(self, pid: int, host_index: int, nbytes: int,
+               owner_token: int | None = None) -> None:
         """Set this pid's usage on a device (0 clears the slot)."""
         now = time.monotonic_ns()
+        token = owner_token if owner_token is not None \
+            else owner_token_from_env()
         with self._lock:
             free_slot = None
             for i in range(MAX_ENTRIES):
                 e = self._entry(i)
                 if e.pid == pid and e.host_index == host_index:
                     if nbytes == 0:
-                        self._write_entry(i, VmemEntry(0, 0, 0, 0))
+                        self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                     else:
                         self._write_entry(
-                            i, VmemEntry(pid, host_index, nbytes, now))
+                            i, VmemEntry(pid, host_index, nbytes, now,
+                                         token))
                     return
                 if e.pid == 0 and free_slot is None:
                     free_slot = i
@@ -130,12 +163,17 @@ class VmemLedger:
             if free_slot is None:
                 raise RuntimeError("vmem ledger full")
             self._write_entry(free_slot,
-                              VmemEntry(pid, host_index, nbytes, now))
+                              VmemEntry(pid, host_index, nbytes, now,
+                                        token))
 
     def device_total(self, host_index: int,
-                     exclude_pid: int | None = None) -> int:
-        """Total live bytes recorded for a device (dead pids skipped)."""
+                     exclude_pid: int | None = None,
+                     exclude_token: int | None = None) -> int:
+        """Total live bytes recorded for a device. Dead entries (pid gone
+        in OUR namespace AND stale) are reaped — liveness of a foreign
+        pid namespace cannot be probed, so staleness is the arbiter."""
         total = 0
+        now = time.monotonic_ns()
         with self._lock:
             for i in range(MAX_ENTRIES):
                 e = self._entry(i)
@@ -143,8 +181,12 @@ class VmemLedger:
                     continue
                 if exclude_pid is not None and e.pid == exclude_pid:
                     continue
-                if not _pid_alive(e.pid):
-                    self._write_entry(i, VmemEntry(0, 0, 0, 0))
+                if exclude_token is not None and \
+                        e.owner_token == exclude_token:
+                    continue
+                if not _pid_alive(e.pid) and \
+                        now - e.last_update_ns > STALE_REAP_NS:
+                    self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                     continue
                 total += e.bytes
         return total
@@ -160,10 +202,12 @@ class VmemLedger:
 
     def _reap_locked(self) -> int:
         reaped = 0
+        now = time.monotonic_ns()
         for i in range(MAX_ENTRIES):
             e = self._entry(i)
-            if e.pid != 0 and not _pid_alive(e.pid):
-                self._write_entry(i, VmemEntry(0, 0, 0, 0))
+            if e.pid != 0 and not _pid_alive(e.pid) and \
+                    now - e.last_update_ns > STALE_REAP_NS:
+                self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                 reaped += 1
         return reaped
 
@@ -172,4 +216,4 @@ class VmemLedger:
         with self._lock:
             for i in range(MAX_ENTRIES):
                 if self._entry(i).pid == pid:
-                    self._write_entry(i, VmemEntry(0, 0, 0, 0))
+                    self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))

@@ -439,7 +439,7 @@ class VnumPlugin(DevicePluginServicer):
                     pod_uid=pod_uid, container_name=cont, devices=devices))
             # stale per-container state from a previous tenant
             pids_cfg = os.path.join(self._container_dir(pod_uid, cont),
-                                    consts.PIDS_CONFIG_NAME)
+                                    "config", consts.PIDS_CONFIG_NAME)
             if os.path.exists(pids_cfg):
                 try:
                     os.unlink(pids_cfg)

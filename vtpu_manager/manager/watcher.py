@@ -72,7 +72,8 @@ class TcWatcherDaemon:
                 # (equal split absent finer attribution; the shim's own
                 # self-observations refine its local view)
                 share = util // len(residents)
-                procs = [ProcUtil(pid=e.pid, util=share, mem_used=e.bytes)
+                procs = [ProcUtil(pid=e.pid, util=share, mem_used=e.bytes,
+                                  owner_token=e.owner_token)
                          for e in residents]
             self.tc_file.write_device(index, DeviceUtil(
                 timestamp_ns=now_ns, device_util=util, procs=procs))

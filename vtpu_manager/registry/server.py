@@ -124,7 +124,10 @@ class RegistryServer:
             log.warning("registry: no allocation dir for %s/%s", pod_uid,
                         container)
             return 4   # not an allocated container on this node
-        write_pids_config(os.path.join(cont_dir, consts.PIDS_CONFIG_NAME),
+        # inside config/: that subdir is what Allocate mounts into the
+        # container, so the shim can read its own pid set
+        write_pids_config(os.path.join(cont_dir, "config",
+                                       consts.PIDS_CONFIG_NAME),
                           sorted(set(pids)))
         self.registrations.append({"pod_uid": pod_uid,
                                    "container": container,

@@ -1,0 +1,125 @@
+"""DRA conversion: rewrite vtpu-* extended resources into ResourceClaims.
+
+Reference: pod_mutate.go:244-420 — on clusters running the DRA driver, the
+webhook converts a pod's vtpu-number/cores/memory requests into generated
+ResourceClaim references (combined or per-container) against the driver's
+DeviceClass, so users keep the familiar extended-resource UX while
+allocation flows through DRA.
+
+The generated claim template requests N fractional vtpu devices and carries
+the cores/memory partition as the driver's opaque config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from vtpu_manager.device.allocator.request import (RequestError,
+                                                   build_allocation_request)
+from vtpu_manager.util import consts
+
+DEVICE_CLASS = "vtpu.google.com"
+
+
+@dataclass
+class DraConversion:
+    patches: list[dict] = field(default_factory=list)
+    claim_templates: list[dict] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+
+
+def _claim_spec(number: int, cores: int, memory_mib: int) -> dict:
+    """ResourceClaim spec requesting `number` vtpu devices with the
+    partition parameters as opaque driver config."""
+    parameters: dict = {}
+    if cores:
+        parameters["cores"] = cores
+    if memory_mib:
+        parameters["memoryMiB"] = memory_mib
+    spec: dict = {"devices": {"requests": [{
+        "name": "vtpu",
+        "deviceClassName": DEVICE_CLASS,
+        "count": number,
+    }]}}
+    if parameters:
+        spec["devices"]["config"] = [{
+            "requests": ["vtpu"],
+            "opaque": {"driver": consts.DRA_DRIVER_NAME,
+                       "parameters": parameters},
+        }]
+    return spec
+
+
+def convert_pod_to_dra(pod: dict) -> DraConversion:
+    """JSON patches that strip vtpu-* extended resources and add per-
+    container resourceClaims referencing generated claim templates. The
+    caller creates the returned ResourceClaimTemplate objects (or inlines
+    them via pod-level resourceClaims with a template source)."""
+    out = DraConversion()
+    try:
+        req = build_allocation_request(pod)
+    except RequestError as e:
+        out.warnings.append(f"not converted: {e}")
+        return out
+    if req.is_empty():
+        return out
+
+    spec = pod.get("spec") or {}
+    pod_claims = list(spec.get("resourceClaims") or [])
+    containers = spec.get("containers") or []
+
+    for ci, cont_req in enumerate(req.containers):
+        if cont_req.number <= 0:
+            continue
+        claim_name = f"vtpu-{cont_req.name or ci}"
+        # content-addressed template name: generateName pods have no
+        # metadata.name at admission, and distinct partitions must never
+        # share a template while identical ones safely can
+        import hashlib
+        meta = pod.get("metadata") or {}
+        base = meta.get("name") or meta.get("generateName") or "pod"
+        digest = hashlib.sha256(
+            f"{cont_req.number}/{cont_req.cores}/{cont_req.memory}"
+            .encode()).hexdigest()[:8]
+        template_name = f"{base.rstrip('-')}-{claim_name}-{digest}"
+        out.claim_templates.append({
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": template_name,
+                         "namespace": (pod.get("metadata") or {}).get(
+                             "namespace", "default")},
+            "spec": {"spec": _claim_spec(cont_req.number, cont_req.cores,
+                                         cont_req.memory // 2**20)},
+        })
+        pod_claims.append({"name": claim_name,
+                           "resourceClaimTemplateName": template_name})
+        # container: drop the extended resources, reference the claim
+        limits_path = f"/spec/containers/{ci}/resources/limits"
+        for res in (consts.vtpu_number_resource(),
+                    consts.vtpu_cores_resource(),
+                    consts.vtpu_memory_resource()):
+            cont = containers[ci]
+            limits = ((cont.get("resources") or {}).get("limits") or {})
+            requests = ((cont.get("resources") or {}).get("requests") or {})
+            escaped = res.replace("~", "~0").replace("/", "~1")
+            if res in limits:
+                out.patches.append({"op": "remove",
+                                    "path": f"{limits_path}/{escaped}"})
+            if res in requests:
+                out.patches.append({
+                    "op": "remove",
+                    "path": f"/spec/containers/{ci}/resources/requests/"
+                            f"{escaped}"})
+        existing_claims = list(((containers[ci].get("resources") or {})
+                                .get("claims")) or [])
+        out.patches.append({
+            "op": "add",
+            "path": f"/spec/containers/{ci}/resources/claims",
+            "value": existing_claims + [{"name": claim_name}]})
+
+    if out.claim_templates:
+        out.patches.append({
+            "op": "add" if "resourceClaims" not in spec else "replace",
+            "path": "/spec/resourceClaims",
+            "value": pod_claims})
+    return out

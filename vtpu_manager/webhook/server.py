@@ -36,9 +36,12 @@ def _admission_response(uid: str, allowed: bool = True,
 
 
 class WebhookAPI:
-    def __init__(self, scheduler_name: str | None = None):
+    def __init__(self, scheduler_name: str | None = None,
+                 dra_convert: bool = False, client=None):
         from vtpu_manager.util import consts
         self.scheduler_name = scheduler_name or consts.DEFAULT_SCHEDULER_NAME
+        self.dra_convert = dra_convert   # rewrite vtpu-* into ResourceClaims
+        self.client = client             # used to create claim templates
         self.stats = {"mutate": 0, "validate": 0, "errors": 0}
 
     def build_app(self) -> web.Application:
@@ -49,18 +52,44 @@ class WebhookAPI:
         app.router.add_get("/readyz", self.handle_healthz)
         return app
 
-    async def _review(self, request: web.Request) -> tuple[str, dict]:
+    async def _review(self, request: web.Request
+                      ) -> tuple[str, dict, bool]:
         body = await request.json()
         req = body.get("request") or {}
-        return req.get("uid", ""), (req.get("object") or {})
+        return (req.get("uid", ""), (req.get("object") or {}),
+                bool(req.get("dryRun")))
 
     async def handle_mutate(self, request: web.Request) -> web.Response:
         self.stats["mutate"] += 1
         try:
-            uid, pod = await self._review(request)
+            uid, pod, dry_run = await self._review(request)
             result = mutate_pod(pod, scheduler_name=self.scheduler_name)
+            patches = list(result.patches)
+            warnings = list(result.warnings)
+            if self.dra_convert:
+                from vtpu_manager.webhook.dra_convert import (
+                    convert_pod_to_dra)
+                conv = convert_pod_to_dra(pod)
+                patches += conv.patches
+                warnings += conv.warnings
+                creator = getattr(self.client, "create_resourceclaim_template",
+                                  None)
+                for template in conv.claim_templates:
+                    if dry_run:
+                        continue  # sideEffects NoneOnDryRun: no writes
+                    if creator is None:
+                        warnings.append(
+                            f"create ResourceClaimTemplate "
+                            f"{template['metadata']['name']} manually "
+                            "(webhook has no API client)")
+                    else:
+                        try:
+                            creator(template)
+                        except Exception as e:
+                            warnings.append(
+                                f"claim template creation failed: {e}")
             return web.json_response(_admission_response(
-                uid, patches=result.patches, warnings=result.warnings))
+                uid, patches=patches, warnings=warnings))
         except Exception as e:
             self.stats["errors"] += 1
             log.exception("mutate failed")
@@ -71,7 +100,7 @@ class WebhookAPI:
     async def handle_validate(self, request: web.Request) -> web.Response:
         self.stats["validate"] += 1
         try:
-            uid, pod = await self._review(request)
+            uid, pod, _ = await self._review(request)
             result = validate_pod(pod)
             return web.json_response(_admission_response(
                 uid, allowed=result.allowed, message=result.message))

@@ -70,20 +70,27 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = True):
     m = jnp.full_like(qf[..., 0], -jnp.inf)
     l = jnp.zeros_like(qf[..., 0])
 
-    def body(step, carry):
-        o, m, l, k_blk, v_blk = carry
+    def compute(step, o, m, l, k_blk, v_blk):
         k_idx = (my_idx - step) % n        # whose K/V we hold this step
         bias = _block_bias(my_idx, k_idx, seq_shard, causal)
-        o, m, l = _online_block(q.astype(jnp.float32),
-                                k_blk.astype(jnp.float32),
-                                v_blk.astype(jnp.float32), bias, o, m, l)
+        return _online_block(q.astype(jnp.float32),
+                             k_blk.astype(jnp.float32),
+                             v_blk.astype(jnp.float32), bias, o, m, l)
+
+    def body(step, carry):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = compute(step, o, m, l, k_blk, v_blk)
         # rotate K/V one hop around the ring (single-hop ICI neighbor)
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (o, m, l, k_blk, v_blk)
 
-    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    # n-1 compute+rotate rounds, then the final block without the dead
+    # rotation (its transfers would be discarded)
+    o, m, l, k_last, v_last = jax.lax.fori_loop(
+        0, n - 1, body, (o, m, l, k, v))
+    o, m, l = compute(n - 1, o, m, l, k_last, v_last)
     l = jnp.where(l == 0.0, 1.0, l)       # fully-masked rows stay zero
     return (o / l[..., None]).astype(q.dtype)
 
