@@ -1,0 +1,26 @@
+# vtpu-manager image: control-plane binaries + the PJRT enforcement shim.
+# (Reference ships Dockerfile/.base/.dra; one multi-stage image covers all
+# our binaries since they share the Python tree.)
+FROM python:3.12-slim AS shim-build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ cmake make && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir tensorflow-cpu
+# PJRT C API headers come from the tensorflow wheel (CMakeLists auto-detects
+# its include dir); override with --build-arg PJRT_INCLUDE_DIR=<path> to use
+# a vendored header tree instead.
+COPY library /src/library
+ARG PJRT_INCLUDE_DIR=""
+RUN cmake -S /src/library -B /build -DCMAKE_BUILD_TYPE=Release \
+        ${PJRT_INCLUDE_DIR:+-DPJRT_INCLUDE_DIR=${PJRT_INCLUDE_DIR}} \
+    && cmake --build /build
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir aiohttp grpcio protobuf pyyaml
+WORKDIR /app
+COPY vtpu_manager /app/vtpu_manager
+COPY cmd /app/cmd
+COPY --from=shim-build /build/libvtpu-control.so \
+        /app/driver/libvtpu-control.so
+ENV PYTHONPATH=/app
+# default command = device plugin; deployments override per component
+CMD ["python", "cmd/device_plugin.py"]

@@ -76,6 +76,23 @@ def main(argv: list[str] | None = None) -> int:
         from vtpu_manager.tpu.discovery import FakeBackend
         backends = [FakeBackend(n_chips=args.fake_chips)]
 
+    # install the bundled shim where tenant mounts expect it (the image
+    # carries it at /app/driver; containers mount host DRIVER_DIR)
+    import shutil
+    shim_src = os.environ.get("VTPU_SHIM_SOURCE",
+                              "/app/driver/libvtpu-control.so")
+    if os.path.exists(shim_src):
+        try:
+            os.makedirs(consts.DRIVER_DIR, exist_ok=True)
+            dst = os.path.join(consts.DRIVER_DIR,
+                               consts.CONTROL_LIBRARY_NAME)
+            tmp = f"{dst}.tmp.{os.getpid()}"
+            shutil.copy2(shim_src, tmp)
+            os.replace(tmp, dst)   # atomic: tenants may be mid-dlopen
+            log.info("shim installed at %s", dst)
+        except OSError as e:
+            log.warning("shim install failed: %s", e)
+
     manager = DeviceManager(
         args.node_name, client, node_config=node_config,
         id_store=DeviceIDStore(args.id_store), backends=backends,

@@ -63,3 +63,13 @@ class TestRingAttention:
         for g in grads:
             assert bool(jnp.all(jnp.isfinite(g)))
             assert float(jnp.abs(g).sum()) > 0
+    def test_pallas_block_path_matches(self, mesh):
+        from vtpu_manager.workloads import pallas_attention as pa
+        if not pa.HAVE_PALLAS:
+            pytest.skip("pallas unavailable")
+        q, k, v = rand_qkv(jax.random.PRNGKey(4), s=32)
+        ring = make_ring_attention(mesh, causal=True, use_pallas=True)
+        out = ring(q, k, v)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)

@@ -1,0 +1,116 @@
+"""Pallas TPU kernel: fused block attention (flash-style, one K/V block).
+
+The hot op inside ring attention: each ring step attends one query shard
+against one K/V block. XLA already fuses this well, but a Pallas kernel
+keeps the whole block — scores, masking, online softmax, PV matmul — in
+VMEM with MXU-shaped tiles and no HBM round-trips for the intermediates.
+
+Grid: one program per (batch, head); the [S, D] tiles live in VMEM (ring
+shards are sized to fit — that is exactly why the manager hands out
+mesh-contiguous windows with bounded shard sizes). Falls back to the XLA
+path (`interpret=True` on CPU) for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    HAVE_PALLAS = True
+except ImportError:   # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _attn_block_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref,
+                       *, scale: float):
+    """One (batch, head) program: q [Sq, D], k/v [Sk, D], bias [Sq, Sk].
+    Outputs: unnormalized o [Sq, D], running max m [Sq], sum l [Sq] —
+    combinable across ring steps by the caller."""
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    bias = bias_ref[...]
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale + bias
+    m = jnp.max(scores, axis=-1)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(scores - m_safe[:, None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[...] = o
+    m_ref[...] = m
+    l_ref[...] = jnp.sum(p, axis=-1)
+
+
+def attention_block(q: jax.Array, k: jax.Array, v: jax.Array,
+                    bias: jax.Array, interpret: bool = False,
+                    vma: tuple[str, ...] | None = None):
+    """q,k,v: [B, H, S, D]; bias: [Sq, Sk] additive. Returns the
+    flash-style partials (o_unnorm fp32, m, l) for one block. vma: the
+    shard_map varying mesh axes of the inputs (required when called inside
+    shard_map so the outputs carry the same varying type)."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable")
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = d ** -0.5
+
+    kernel = functools.partial(_attn_block_kernel, scale=scale)
+    grid = (b, h)
+
+    def qspec(s):
+        return pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+
+    def sds(shape):
+        if vma is not None:
+            return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                        vma=frozenset(vma))
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    out_shapes = (sds((b, h, sq, d)), sds((b, h, sq)), sds((b, h, sq)))
+    o, m, l = pl.pallas_call(
+        lambda q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref:
+            kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
+                   bias_ref, o_ref.at[0, 0], m_ref.at[0, 0],
+                   l_ref.at[0, 0]),
+        grid=grid,
+        in_specs=[qspec(sq), qspec(sk), qspec(sk),
+                  pl.BlockSpec((sq, sk), lambda i, j: (0, 0))],
+        out_specs=(qspec(sq),
+                   pl.BlockSpec((1, 1, sq), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, 1, sq), lambda i, j: (i, j, 0))),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(q, k, v, bias)
+    return o, m, l
+
+
+def make_pallas_block_fn(axis_name: str):
+    """block_fn for ring_attention_sharded: interpret mode off-TPU so the
+    same code path tests on the virtual CPU mesh; outputs carry the
+    shard_map varying axis."""
+    def block_fn(q, k, v, bias):
+        interpret = jax.default_backend() != "tpu"
+        return attention_block(q, k, v, bias, interpret=interpret,
+                               vma=(axis_name,))
+    return block_fn
+
+
+def combine_blocks(partials: list[tuple[jax.Array, jax.Array, jax.Array]],
+                   out_dtype=jnp.float32) -> jax.Array:
+    """Merge flash partials from several K/V blocks into the final
+    normalized attention output (merge math lives in ring_attention)."""
+    from vtpu_manager.workloads.ring_attention import merge_partials
+
+    o_acc, m_acc, l_acc = partials[0]
+    for o, m, l in partials[1:]:
+        o_acc, m_acc, l_acc = merge_partials(o_acc, m_acc, l_acc, o, m, l)
+    l_acc = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    return (o_acc / l_acc[..., None]).astype(out_dtype)

@@ -23,22 +23,30 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _online_block(q, k, v, bias, o, m, l):
-    """One flash-style block update. q:[B,H,Sq,D] k,v:[B,H,Sk,D]
-    bias:[Sq,Sk] additive (0 or -inf); carry o (unnormalized), m, l."""
+def block_partials(q, k, v, bias):
+    """Flash partials for one K/V block: unnormalized o, running max m,
+    sum l. q:[B,H,Sq,D] k,v:[B,H,Sk,D] bias:[Sq,Sk] additive (0/-inf)."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     scores = scores + bias[None, None, :, :]
-    m_blk = jnp.max(scores, axis=-1)
-    m_new = jnp.maximum(m, m_blk)
+    m = jnp.max(scores, axis=-1)
     # guard fully-masked blocks: exp(-inf - -inf) -> exp(0) must not happen
-    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
     p = jnp.exp(scores - m_safe[..., None])
     p = jnp.where(jnp.isneginf(scores), 0.0, p)
-    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-    o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    l_new = l * alpha + jnp.sum(p, axis=-1)
-    return o_new, m_new, l_new
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, jnp.sum(p, axis=-1)
+
+
+def merge_partials(o1, m1, l1, o2, m2, l2):
+    """Combine two flash partials — the single home of the numerically
+    delicate online-softmax merge (pallas_attention reuses it)."""
+    m_new = jnp.maximum(m1, m2)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    a1 = jnp.where(jnp.isneginf(m1), 0.0, jnp.exp(m1 - m_safe))
+    a2 = jnp.where(jnp.isneginf(m2), 0.0, jnp.exp(m2 - m_safe))
+    return (o1 * a1[..., None] + o2 * a2[..., None], m_new,
+            l1 * a1 + l2 * a2)
 
 
 def _block_bias(q_idx, k_idx, seq_shard: int, causal: bool):
@@ -56,9 +64,14 @@ def _block_bias(q_idx, k_idx, seq_shard: int, causal: bool):
                      jnp.where(k_idx == q_idx, tri, blocked))
 
 
-def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = True):
+def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = True,
+                           block_fn=None):
     """Runs INSIDE shard_map: q,k,v are per-device sequence shards
-    [B,H,S_local,D]. Rotates K/V n-1 times around the ring."""
+    [B,H,S_local,D]. Rotates K/V n-1 times around the ring. block_fn
+    computes flash partials for one block (default: XLA block_partials;
+    the Pallas kernel from pallas_attention is a drop-in)."""
+    if block_fn is None:
+        block_fn = block_partials
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     seq_shard = q.shape[2]
@@ -73,9 +86,10 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = True):
     def compute(step, o, m, l, k_blk, v_blk):
         k_idx = (my_idx - step) % n        # whose K/V we hold this step
         bias = _block_bias(my_idx, k_idx, seq_shard, causal)
-        return _online_block(q.astype(jnp.float32),
-                             k_blk.astype(jnp.float32),
-                             v_blk.astype(jnp.float32), bias, o, m, l)
+        o2, m2, l2 = block_fn(q.astype(jnp.float32),
+                              k_blk.astype(jnp.float32),
+                              v_blk.astype(jnp.float32), bias)
+        return merge_partials(o, m, l, o2, m2, l2)
 
     def body(step, carry):
         o, m, l, k_blk, v_blk = carry
@@ -96,16 +110,29 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = True):
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "data",
-                        causal: bool = True):
+                        causal: bool = True, use_pallas: bool = False):
     """jit-able ring attention over `mesh`: full arrays in, full arrays out,
-    sequence sharded over `axis_name` internally."""
-    from jax.experimental.shard_map import shard_map
+    sequence sharded over `axis_name` internally. use_pallas swaps the
+    per-block compute for the fused VMEM kernel (interpret mode off-TPU)."""
+    shard_map = jax.shard_map
+
+    block_fn = None
+    if use_pallas:
+        from vtpu_manager.workloads.pallas_attention import (
+            make_pallas_block_fn)
+        block_fn = make_pallas_block_fn(axis_name)
 
     spec = P(None, None, axis_name, None)
+    kwargs = {}
+    if use_pallas:
+        # pallas interpret mode mixes unvarying grid slicing with varying
+        # operands, which trips shard_map's vma checker (jax#; harmless
+        # here — every output is sequence-sharded by construction)
+        kwargs["check_vma"] = False
     fn = shard_map(
         functools.partial(ring_attention_sharded, axis_name=axis_name,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          causal=causal, block_fn=block_fn),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
     return jax.jit(fn)
 
 
