@@ -8,9 +8,13 @@
 // buffers, and an Execute whose completion events become ready after a
 // configurable simulated duration (FAKE_EXEC_US, default 2000).
 
+#include <fcntl.h>
 #include <pthread.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -83,8 +87,62 @@ int64_t OutBytes() {
   return v ? atol(v) : 1024;
 }
 
-// Device busy simulation: executes serialize on the fake chip.
+// Device busy simulation: executes serialize on the fake chip. With
+// FAKE_SHARED_STATE set, the chip is shared ACROSS processes: an flock on
+// <path>.lock serializes execution (two co-tenant shims then genuinely
+// contend for the device) and an mmap'd counter accumulates busy time for
+// an external utilization publisher.
 std::mutex g_exec_mu;
+
+struct SharedChip {
+  uint64_t busy_ns;
+  int64_t bytes_in_use;
+};
+SharedChip* g_shared = nullptr;
+int g_shared_lock_fd = -1;
+
+void InitSharedChip() {
+  const char* path = getenv("FAKE_SHARED_STATE");
+  if (!path) return;
+  int fd = open(path, O_CREAT | O_RDWR, 0666);
+  if (fd < 0) return;
+  if (ftruncate(fd, sizeof(SharedChip)) != 0) {
+    close(fd);
+    return;
+  }
+  void* mem = mmap(nullptr, sizeof(SharedChip), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return;
+  g_shared = static_cast<SharedChip*>(mem);
+  char lock_path[512];
+  snprintf(lock_path, sizeof(lock_path), "%s.lock", path);
+  g_shared_lock_fd = open(lock_path, O_CREAT | O_RDWR, 0666);
+}
+
+class ChipBusy {
+ public:
+  ChipBusy() {
+    if (g_shared_lock_fd >= 0) {
+      // cross-process serialization: one program on the chip at a time.
+      // flock is per-open-file-description; each process has its own fd,
+      // and in-process threads serialize via the mutex below.
+      mu_ = &g_exec_mu;
+      mu_->lock();
+      flock(g_shared_lock_fd, LOCK_EX);
+    } else {
+      mu_ = &g_exec_mu;
+      mu_->lock();
+    }
+  }
+  ~ChipBusy() {
+    if (g_shared_lock_fd >= 0) flock(g_shared_lock_fd, LOCK_UN);
+    mu_->unlock();
+  }
+
+ private:
+  std::mutex* mu_;
+};
 
 // ---------------------------------------------------------------------------
 // API implementations
@@ -110,7 +168,10 @@ PJRT_Error* MakeFakeError(PJRT_Error_Code code, const char* msg) {
 }
 
 PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
-  if (!g_client) g_client = new FakeClient();
+  if (!g_client) {
+    g_client = new FakeClient();
+    InitSharedChip();
+  }
   args->client = reinterpret_cast<PJRT_Client*>(g_client);
   return nullptr;
 }
@@ -251,8 +312,13 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
       args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(done);
     }
     std::thread([done, out_ready, dur] {
-      std::lock_guard<std::mutex> g(g_exec_mu);  // device serialization
-      usleep((useconds_t)dur);
+      {
+        ChipBusy busy;   // in-process mutex + cross-process flock
+        usleep((useconds_t)dur);
+        if (g_shared)
+          __atomic_fetch_add(&g_shared->busy_ns,
+                             (uint64_t)dur * 1000, __ATOMIC_RELAXED);
+      }
       out_ready->MarkReady();
       done->MarkReady();
     }).detach();

@@ -1,0 +1,143 @@
+"""Two co-tenant shim processes sharing one (fake) chip — BASELINE
+config[2] hermetically: 2 x 50%-core tenants on a single chip.
+
+The fake plugin's FAKE_SHARED_STATE makes the chip real contention: an
+flock serializes execution across processes and a shared counter
+accumulates busy time, which a publisher thread turns into the tc_util
+feed (playing the node TC-watcher daemon). Each shim must converge to its
+~50% share of the serialized chip.
+
+This is SURVEY §7 "hard part #2": duty-cycling two processes on a
+non-preemptive accelerator through strict alternation.
+"""
+
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from vtpu_manager.config import tc_watcher
+from vtpu_manager.config.vmem import VmemLedger, fnv64
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "build-lib")
+
+
+@pytest.fixture(scope="module")
+def shim_build():
+    if not (os.path.exists(os.path.join(BUILD, "shim_test"))
+            and os.path.exists(os.path.join(BUILD, "libfake-pjrt.so"))):
+        pytest.skip("shim not built")
+    return BUILD
+
+
+def tenant_env(tmp_path, pod_uid, quota, iters, shared):
+    env = dict(os.environ)
+    env.update({
+        "SHIM_PATH": os.path.join(BUILD, "libvtpu-control.so"),
+        "VTPU_REAL_TPU_LIBRARY_PATH": os.path.join(BUILD,
+                                                   "libfake-pjrt.so"),
+        "VTPU_MEM_LIMIT_0": str(1 << 30),
+        "VTPU_CORE_LIMIT_0": str(quota),
+        "VTPU_POD_UID": pod_uid,
+        "VTPU_CONTAINER_NAME": "main",
+        "VTPU_TC_UTIL_PATH": str(tmp_path / "tc_util.config"),
+        "VTPU_VMEM_PATH": str(tmp_path / "vmem.config"),
+        "VTPU_LOCK_DIR": str(tmp_path / "locks"),
+        "VTPU_CONFIG_PATH": "/nonexistent",
+        "FAKE_SHARED_STATE": shared,
+        "FAKE_EXEC_US": "2000",
+        "SHIM_TEST_ITERS": str(iters),
+    })
+    return env
+
+
+def test_two_tenants_share_one_chip(shim_build, tmp_path):
+    shared = str(tmp_path / "chip.state")
+    tc_path = str(tmp_path / "tc_util.config")
+    feed = tc_watcher.TcUtilFile(tc_path, create=True)
+    VmemLedger(str(tmp_path / "vmem.config"), create=True).close()
+    with open(shared, "wb") as f:
+        f.write(b"\0" * 16)
+
+    stop = threading.Event()
+
+    def publisher():
+        """The node TC-watcher daemon role: busy counter -> chip util%."""
+        import struct
+        last_busy = 0
+        last_t = time.monotonic_ns()
+        while not stop.is_set():
+            stop.wait(0.05)
+            with open(shared, "rb") as f:
+                busy, = struct.unpack("<Q", f.read(16)[:8])
+            now = time.monotonic_ns()
+            window = max(now - last_t, 1)
+            util = min(100, int(100 * (busy - last_busy) / window))
+            last_busy, last_t = busy, now
+            feed.write_device(0, tc_watcher.DeviceUtil(
+                timestamp_ns=now, device_util=util,
+                procs=[tc_watcher.ProcUtil(1, util // 2, 0,
+                                           fnv64("uid-a/main")),
+                       tc_watcher.ProcUtil(2, util // 2, 0,
+                                           fnv64("uid-b/main"))]))
+
+    thread = threading.Thread(target=publisher, daemon=True)
+    thread.start()
+    iters = 300    # 600 ms busy demand per tenant; 1.2 s chip-serialized
+    try:
+        t0 = time.monotonic()
+        procs = [subprocess.Popen(
+            [os.path.join(BUILD, "shim_test"), "--throttle-only"],
+            env=tenant_env(tmp_path, uid, 50, iters, shared),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+            for uid in ("uid-a", "uid-b")]
+        walls = []
+        for proc in procs:
+            out, _ = proc.communicate(timeout=300)
+            assert proc.returncode == 0, out
+            for line in out.splitlines():
+                if "wall=" in line:
+                    walls.append(float(line.split("wall=")[1]
+                                       .split("ms")[0]))
+        total = (time.monotonic() - t0) * 1000
+    finally:
+        stop.set()
+        thread.join(timeout=2)
+        feed.close()
+
+    assert len(walls) == 2
+    # both tenants must finish; the serialized busy demand alone is
+    # 2 * 600 ms, so sub-1.2 s walls would mean broken serialization
+    assert min(walls) >= 1000, walls
+    # fairness: equal quotas => similar completion times (loose band:
+    # single-CPU CI timing is noisy)
+    assert max(walls) / min(walls) < 2.0, walls
+    print(f"tenant walls: {walls} total {total:.0f}ms")
+
+
+def test_unequal_quotas_bias_the_chip(shim_build, tmp_path):
+    """75% vs 25%: the high-quota tenant must finish first (same demand)."""
+    shared = str(tmp_path / "chip.state")
+    VmemLedger(str(tmp_path / "vmem.config"), create=True).close()
+    tc_watcher.TcUtilFile(str(tmp_path / "tc_util.config"),
+                          create=True).close()
+    with open(shared, "wb") as f:
+        f.write(b"\0" * 16)
+    iters = 300
+    procs = {}
+    for uid, quota in (("uid-hi", 75), ("uid-lo", 25)):
+        procs[uid] = subprocess.Popen(
+            [os.path.join(BUILD, "shim_test"), "--throttle-only"],
+            env=tenant_env(tmp_path, uid, quota, iters, shared),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    walls = {}
+    for uid, proc in procs.items():
+        out, _ = proc.communicate(timeout=300)
+        assert proc.returncode == 0, out
+        for line in out.splitlines():
+            if "wall=" in line:
+                walls[uid] = float(line.split("wall=")[1].split("ms")[0])
+    assert walls["uid-hi"] < walls["uid-lo"], walls
