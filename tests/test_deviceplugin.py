@@ -244,3 +244,31 @@ class TestCheckpoint:
 
     def test_missing_file(self, tmp_path):
         assert read_checkpoint(str(tmp_path / "nope")) == []
+
+class TestHealthReAdvertisement:
+    def test_listandwatch_streams_health_flip(self, plugin, tmp_path):
+        """Health flip must push a fresh device list to the kubelet
+        (reference: unhealthy devices -> re-ListAndWatch)."""
+        import grpc
+        p, client, mgr = plugin
+        server = PluginServer(p, plugin_dir=str(tmp_path / "hsock"))
+        server.serve()
+        try:
+            with grpc.insecure_channel(
+                    f"unix://{server.socket_path}") as chan:
+                stream = chan.unary_stream(
+                    "/v1beta1.DevicePlugin/ListAndWatch",
+                    request_serializer=pb.Empty.SerializeToString,
+                    response_deserializer=
+                    pb.ListAndWatchResponse.FromString)(pb.Empty(),
+                                                        timeout=30)
+                it = iter(stream)
+                first = next(it)
+                assert all(d.health == "Healthy" for d in first.devices)
+                mgr.mark_unhealthy(mgr.chips[0].uuid)
+                second = next(it)
+                sick = [d for d in second.devices
+                        if d.health == "Unhealthy"]
+                assert len(sick) == 4   # all slots of the flipped chip
+        finally:
+            server.stop()
