@@ -129,3 +129,44 @@ class TestPerfMatrix:
                           f"{res['placed']:6d} {res['p50_ms']:6.1f} "
                           f"{res['p99_ms']:6.1f}")
                     assert_no_overcommit(res["client"])
+
+def test_topology_pod_schedulable_beyond_candidate_limit():
+    """The top-K capacity rank must not reject a pod whose only feasible
+    node (by topology) ranks below the limit."""
+    from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+    client = FakeKubeClient()
+    # many fragmented nodes: on a 2x2 mesh, poison two diagonal chips so no
+    # 2-chip rectangle... actually poison so no contiguous pair: keep only
+    # (0,0) and (1,1) free -> greedy would still pick them; use ici-strict
+    # with 4 chips wanted and only 3 free.
+    for i in range(40):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                               uuid_prefix=f"FRAG-{i:03d}")
+        client.add_node(dt.fake_node(f"frag-{i:03d}", reg))
+        claims = PodDeviceClaims()
+        # occupy one chip fully: no 4-chip rectangle remains
+        chip = reg.chips[0]
+        for s in range(chip.split_count):
+            claims.add("c", DeviceClaim(chip.uuid, chip.index, 0, 0))
+        holder = vtpu_pod(1000 + i)
+        holder["metadata"]["name"] = f"holder-{i}"
+        holder["metadata"]["uid"] = f"uid-holder-{i}"
+        holder["metadata"]["annotations"][
+            consts.real_allocated_annotation()] = claims.encode()
+        holder["spec"]["nodeName"] = f"frag-{i:03d}"
+        holder["status"]["phase"] = "Running"
+        client.add_pod(holder)
+    # one whole node, named to sort last, fully free
+    reg = dt.fake_registry(4, mesh_shape=(2, 2), uuid_prefix="WHOLE")
+    client.add_node(dt.fake_node("zz-whole", reg))
+
+    pred = FilterPredicate(client, candidate_limit=8)
+    pod = vtpu_pod(0, cores=10, memory=64)
+    pod["metadata"]["annotations"][
+        consts.topology_mode_annotation()] = "ici-strict"
+    pod["spec"]["containers"][0]["resources"]["limits"][
+        consts.vtpu_number_resource()] = 4
+    client.add_pod(pod)
+    result = pred.filter({"Pod": pod})
+    assert result.node_names == ["zz-whole"], (result.error,
+                                               result.node_names[:3])

@@ -283,6 +283,19 @@ class NodeInfo:
     def total_free_memory(self) -> int:
         return sum(max(d.free_memory, 0) for d in self.healthy_devices())
 
+    def free_totals(self) -> tuple[int, int, int]:
+        """(slots, cores, memory) free across healthy chips in one pass —
+        the single home of the capacity-accounting rules (the filter's
+        pre-gate and ranking must not drift from other consumers)."""
+        number = cores = memory = 0
+        for usage in self.devices.values():
+            if not usage.spec.healthy:
+                continue
+            number += usage.free_number
+            cores += max(usage.free_cores, 0)
+            memory += max(usage.free_memory, 0)
+        return number, cores, memory
+
     def clone(self) -> "NodeInfo":
         """Cheap working copy for allocator what-if charging: ChipSpec and
         the registry are immutable-by-contract and shared; only the mutable

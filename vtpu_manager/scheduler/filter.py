@@ -27,7 +27,6 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from vtpu_manager.client.kube import KubeClient, KubeError
@@ -77,12 +76,17 @@ class _Assumed:
 
 class FilterPredicate:
     def __init__(self, client: KubeClient, serialize: bool = True,
-                 require_node_label: bool = False, max_workers: int = 8):
+                 require_node_label: bool = False,
+                 candidate_limit: int = 64):
         self.client = client
         self.serialize = serialize
         self._serial_lock = threading.Lock()
         self.require_node_label = require_node_label
-        self.max_workers = max_workers
+        # full allocation runs only on the top-K capacity-ranked nodes;
+        # pure-Python work gains nothing from thread pools (GIL), and
+        # allocating on every node of a 1000+-node cluster per pod is the
+        # dominant filter cost
+        self.candidate_limit = candidate_limit
         self._assumed: dict[str, _Assumed] = {}   # pod uid -> commit
         self._assumed_lock = threading.Lock()
 
@@ -133,25 +137,6 @@ class FilterPredicate:
         for uid, entry in self._assumed_for_node(name, visible):
             info.assume_pod(uid, entry.claims)
         return info
-
-    def _try_node(self, node: dict, resident: list[dict],
-                  req: AllocationRequest, now: float,
-                  prefer_origin) -> tuple[str, ScoredNode | None, str]:
-        name = (node.get("metadata") or {}).get("name", "")
-        info = self._build_info(node, resident, now)
-        if info is None:
-            return (name, None, R.NODE_NO_DEVICES)
-        # capacity pre-gates (reference :682-711): cheap totals before the
-        # expensive allocator run
-        if (info.total_free_number() < req.total_number()
-                or info.total_free_cores() < req.total_cores()
-                or info.total_free_memory() < req.total_memory()):
-            return (name, None, R.NODE_INSUFFICIENT_CAPACITY)
-        try:
-            result = allocate(info, req, prefer_origin=prefer_origin)
-        except AllocationFailure as f:
-            return (name, None, f.reasons.summary() or "allocation failed")
-        return (name, ScoredNode(name, node_score(result, req), result), "")
 
     # -- entry --------------------------------------------------------------
 
@@ -220,23 +205,48 @@ class FilterPredicate:
         if req.gang_name:
             prefer_origin = gang.resolve_gang_origin(req.gang_name, all_pods)
 
+        # Build usage views for every surviving node (cheap), pre-rank by
+        # free capacity in the node policy's direction, then run the full
+        # allocator only on the best candidate_limit nodes.
+        infos = []
+        for node in candidates:
+            name = (node.get("metadata") or {}).get("name", "")
+            info = self._build_info(node, by_node.get(name, []), now)
+            if info is None:
+                result.failed_nodes[name] = R.NODE_NO_DEVICES
+                reasons.add(R.NODE_NO_DEVICES, name)
+                continue
+            free_number, free_cores, free_memory = info.free_totals()
+            if (free_number < req.total_number()
+                    or free_cores < req.total_cores()
+                    or free_memory < req.total_memory()):
+                result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
+                reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
+                continue
+            infos.append((free_cores + (free_memory >> 24) + free_number,
+                          name, info))
+        # binpack wants the least-free node first, spread the most-free
+        infos.sort(key=lambda t: (t[0], t[1]),
+                   reverse=req.node_policy == consts.NODE_POLICY_SPREAD)
+
+        # Full allocation on the top-K ranked nodes; if NONE of them fit
+        # (the capacity rank is blind to topology/uuid constraints), keep
+        # walking the remainder until one succeeds — truncation must trade
+        # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
-        if candidates:
-            with ThreadPoolExecutor(
-                    max_workers=min(self.max_workers,
-                                    len(candidates))) as pool:
-                outcomes = list(pool.map(
-                    lambda n: self._try_node(
-                        n, by_node.get(
-                            (n.get("metadata") or {}).get("name", ""), []),
-                        req, now, prefer_origin),
-                    candidates))
-            for name, sn, why in outcomes:
-                if sn is not None:
-                    scored.append(sn)
-                else:
-                    result.failed_nodes[name] = why
-                    reasons.add(why.split(";")[0].split(" x")[0], name)
+        for rank, (_, name, info) in enumerate(infos):
+            if rank >= self.candidate_limit and scored:
+                break
+            try:
+                alloc_result = allocate(info, req,
+                                        prefer_origin=prefer_origin)
+            except AllocationFailure as f:
+                why = f.reasons.summary() or "allocation failed"
+                result.failed_nodes[name] = why
+                reasons.add(why.split(";")[0].split(" x")[0], name)
+                continue
+            scored.append(ScoredNode(name, node_score(alloc_result, req),
+                                     alloc_result))
 
         if not scored:
             result.error = reasons.summary() or "no schedulable vtpu node"
