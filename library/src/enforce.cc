@@ -52,7 +52,11 @@ namespace {
 // ---------------------------------------------------------------------------
 
 constexpr int64_t kWindowUs = 100000;        // watcher cadence 100 ms
-constexpr int64_t kTickSleepUs = 10000;      // throttled retry 10 ms
+// Throttled-retry granularity. The reference sleeps 10 ms (hook.h:173) —
+// sized for µs-scale CUDA kernels; TPU programs are ms-scale, so a 10 ms
+// quantum adds ~5% systematic overthrottle per window boundary. 2 ms keeps
+// wakeup load trivial while cutting the quantization error ~5x.
+constexpr int64_t kTickSleepUs = 2000;
 constexpr int64_t kGapThresholdNs = 200ll * 1000 * 1000;
 constexpr int64_t kDefaultCostUs = 1000;     // cost before first measurement
 constexpr double kCostEmaAlpha = 0.3;
@@ -995,8 +999,10 @@ struct AwaitItem {
   AwaitItem* next = nullptr;
 };
 
-std::mutex g_await_mu;
-std::condition_variable g_await_cv;
+// leaked deliberately: the await thread may be waiting at process exit,
+// and destroying a cv/mutex with waiters is UB (flaky exit hang)
+std::mutex& g_await_mu = *new std::mutex;
+std::condition_variable& g_await_cv = *new std::condition_variable;
 AwaitItem* g_await_head = nullptr;
 AwaitItem* g_await_tail = nullptr;
 pthread_t g_await_thread;
@@ -1252,6 +1258,8 @@ void ResetAwaitForFork() {
   // Await thread is gone in the child; drop its queue (events belonged to
   // the parent's client) and let it restart lazily.
   g_await_running.store(false);
+  // the parent may have held the (leaked, heap-allocated) mutex at fork;
+  // placement-new re-initializes the child's copy to unlocked
   new (&g_await_mu) std::mutex();
   g_await_head = g_await_tail = nullptr;
 }

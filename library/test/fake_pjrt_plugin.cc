@@ -20,6 +20,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -292,8 +293,71 @@ PJRT_Error* ExecutableDestroy(PJRT_Executable_Destroy_Args* args) {
   return nullptr;
 }
 
+// Persistent device worker: thread-per-exec creation costs ~0.3 ms on a
+// busy box and would be (honestly) measured as device time by the shim,
+// skewing accuracy experiments. One queue-draining thread models the real
+// chip's single execution stream.
+struct ExecJob {
+  FakeEvent* done;
+  FakeEvent* out_ready;
+  int64_t dur;
+};
+// intentionally leaked: a detached worker waits on these forever, and
+// destroying a condition_variable/mutex with waiters at process exit is
+// UB (observed as a flaky futex hang in __run_exit_handlers)
+std::mutex& JobsMu() { static auto* m = new std::mutex; return *m; }
+std::condition_variable& JobsCv() {
+  static auto* cv = new std::condition_variable;
+  return *cv;
+}
+std::deque<ExecJob>& Jobs() {
+  static auto* q = new std::deque<ExecJob>;
+  return *q;
+}
+pthread_once_t g_worker_once = PTHREAD_ONCE_INIT;
+
+bool Trace() {
+  static int t = getenv("FAKE_TRACE") ? 1 : 0;
+  return t;
+}
+
+void* DeviceWorker(void*) {
+  if (Trace()) fprintf(stderr, "[fake] worker up\n");
+  for (;;) {
+    ExecJob job;
+    {
+      std::unique_lock<std::mutex> lk(JobsMu());
+      JobsCv().wait(lk, [] { return !Jobs().empty(); });
+      job = Jobs().front();
+      Jobs().pop_front();
+    }
+    if (Trace()) fprintf(stderr, "[fake] job start\n");
+    {
+      ChipBusy busy;   // in-process mutex + cross-process flock
+      usleep((useconds_t)job.dur);
+      if (g_shared)
+        __atomic_fetch_add(&g_shared->busy_ns,
+                           (uint64_t)job.dur * 1000, __ATOMIC_RELAXED);
+    }
+    job.out_ready->MarkReady();
+    job.done->MarkReady();
+    if (Trace()) fprintf(stderr, "[fake] job done\n");
+  }
+  return nullptr;
+}
+
+void StartWorker() {
+  pthread_t t;
+  if (pthread_create(&t, nullptr, DeviceWorker, nullptr) != 0) {
+    fprintf(stderr, "fake plugin: device worker creation failed; "
+                    "executes would hang\n");
+    abort();   // fail loudly, never silently hang the caller
+  }
+}
+
 PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   int64_t dur = ExecUs();
+  pthread_once(&g_worker_once, StartWorker);
   // Simulate a serialized device: each execute occupies the chip for `dur`.
   for (size_t d = 0; d < args->num_devices; d++) {
     // Distinct events for the caller (device_complete) and the buffer
@@ -311,17 +375,12 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     if (args->device_complete_events) {
       args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(done);
     }
-    std::thread([done, out_ready, dur] {
-      {
-        ChipBusy busy;   // in-process mutex + cross-process flock
-        usleep((useconds_t)dur);
-        if (g_shared)
-          __atomic_fetch_add(&g_shared->busy_ns,
-                             (uint64_t)dur * 1000, __ATOMIC_RELAXED);
-      }
-      out_ready->MarkReady();
-      done->MarkReady();
-    }).detach();
+    {
+      std::lock_guard<std::mutex> lk(JobsMu());
+      Jobs().push_back({done, out_ready, dur});
+    }
+    JobsCv().notify_one();
+    if (Trace()) fprintf(stderr, "[fake] enqueued\n");
   }
   return nullptr;
 }

@@ -275,13 +275,13 @@ class NodeInfo:
         return [d for d in self.devices.values() if d.spec.healthy]
 
     def total_free_number(self) -> int:
-        return sum(d.free_number for d in self.healthy_devices())
+        return self.free_totals()[0]
 
     def total_free_cores(self) -> int:
-        return sum(max(d.free_cores, 0) for d in self.healthy_devices())
+        return self.free_totals()[1]
 
     def total_free_memory(self) -> int:
-        return sum(max(d.free_memory, 0) for d in self.healthy_devices())
+        return self.free_totals()[2]
 
     def free_totals(self) -> tuple[int, int, int]:
         """(slots, cores, memory) free across healthy chips in one pass —
