@@ -736,6 +736,11 @@ void WatcherTick(int64_t window_ns) {
 }
 
 void* WatcherMain(void*) {
+  // Seed one window's grant immediately: without it every tenant starts
+  // with an empty bucket and stalls up to a full window before the first
+  // tick — a fixed ~100 ms startup tax that skews short runs at every
+  // quota.
+  WatcherTick(kWindowUs * 1000);
   // Drift-free absolute-time grid (reference cuda_hook.c:1176-1207).
   struct timespec next;
   clock_gettime(CLOCK_MONOTONIC, &next);
@@ -1258,9 +1263,11 @@ void ResetAwaitForFork() {
   // Await thread is gone in the child; drop its queue (events belonged to
   // the parent's client) and let it restart lazily.
   g_await_running.store(false);
-  // the parent may have held the (leaked, heap-allocated) mutex at fork;
-  // placement-new re-initializes the child's copy to unlocked
+  // the parent may have held the (leaked, heap-allocated) mutex at fork,
+  // and the cv may carry a phantom mid-wait waiter; placement-new resets
+  // both to pristine state in the child
   new (&g_await_mu) std::mutex();
+  new (&g_await_cv) std::condition_variable();
   g_await_head = g_await_tail = nullptr;
 }
 

@@ -92,8 +92,9 @@ int64_t OutBytes() {
 // FAKE_SHARED_STATE set, the chip is shared ACROSS processes: an flock on
 // <path>.lock serializes execution (two co-tenant shims then genuinely
 // contend for the device) and an mmap'd counter accumulates busy time for
-// an external utilization publisher.
-std::mutex g_exec_mu;
+// an external utilization publisher. Leaked: the immortal worker may hold
+// it at exit (destroying a locked mutex is UB).
+std::mutex& g_exec_mu = *new std::mutex;
 
 struct SharedChip {
   uint64_t busy_ns;
@@ -346,8 +347,22 @@ void* DeviceWorker(void*) {
   return nullptr;
 }
 
+void ResetWorkerForFork() {
+  // the worker thread does not survive fork; let it restart lazily and
+  // reset the queue sync state the parent may have held
+  pthread_once_t fresh = PTHREAD_ONCE_INIT;
+  memcpy(&g_worker_once, &fresh, sizeof(fresh));
+  new (&JobsMu()) std::mutex();
+  new (&JobsCv()) std::condition_variable();
+  Jobs().clear();
+}
+
 void StartWorker() {
   pthread_t t;
+  static pthread_once_t atfork_once = PTHREAD_ONCE_INIT;
+  pthread_once(&atfork_once, [] {
+    pthread_atfork(nullptr, nullptr, ResetWorkerForFork);
+  });
   if (pthread_create(&t, nullptr, DeviceWorker, nullptr) != 0) {
     fprintf(stderr, "fake plugin: device worker creation failed; "
                     "executes would hang\n");
