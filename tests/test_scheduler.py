@@ -328,3 +328,63 @@ class TestHTTPRoutes:
                 assert "Error" in body
 
         asyncio.run(scenario())
+
+
+class TestCrossNodeGang:
+    def test_gang_members_align_origins_across_nodes(self):
+        """Two gang members on different hosts of a multi-host slice pick
+        congruent mesh windows (inter-host ICI neighbors line up) — the
+        reference's cross-pod NVLink rail alignment, mesh edition."""
+        client = FakeKubeClient()
+        for i in range(2):
+            reg = dt.fake_registry(8, mesh_shape=(2, 4),
+                                   uuid_prefix=f"HOST{i}")
+            reg.mesh_domain = "slice-1"
+            client.add_node(dt.fake_node(f"host-{i}", reg))
+        pred = FilterPredicate(client)
+        anns = {consts.gang_name_annotation(): "ring",
+                consts.gang_size_annotation(): "2",
+                consts.topology_mode_annotation(): "ici"}
+
+        # member 1: free choice of window
+        m1 = vtpu_pod(name="m1", number=4, cores=20,
+                      annotations=dict(anns))
+        client.add_pod(m1)
+        r1 = pred.filter({"Pod": m1})
+        assert not r1.error
+        node1 = r1.node_names[0]
+        origin1 = gang.decode_origin(
+            client.get_pod("default", "m1")["metadata"]["annotations"][
+                gang.gang_origin_annotation()])
+        assert origin1 is not None
+
+        # occupy the rest of node1 so member 2 must land on the other node
+        node1_reg = dt.NodeDeviceRegistry.decode(
+            client.get_node(node1)["metadata"]["annotations"][
+                consts.node_device_register_annotation()])
+        m1_claims = {c.uuid for c in PodDeviceClaims.decode(
+            client.get_pod("default", "m1")["metadata"]["annotations"][
+                consts.pre_allocated_annotation()]).all_claims()}
+        filler_claims = PodDeviceClaims()
+        for chip in node1_reg.chips:
+            # fill untouched chips to 85% (m1's own chips already hold 20%)
+            cores = 85 if chip.uuid not in m1_claims else 75
+            filler_claims.add("c", DeviceClaim(chip.uuid, chip.index, cores,
+                                               2**30))
+        filler = vtpu_pod(name="filler", node_name=node1, annotations={
+            consts.real_allocated_annotation(): filler_claims.encode()})
+        filler["status"]["phase"] = "Running"
+        client.add_pod(filler)
+
+        m2 = vtpu_pod(name="m2", number=4, cores=20,
+                      annotations=dict(anns))
+        client.add_pod(m2)
+        r2 = pred.filter({"Pod": m2})
+        assert not r2.error
+        node2 = r2.node_names[0]
+        assert node2 != node1    # capacity forces the second host
+        origin2 = gang.decode_origin(
+            client.get_pod("default", "m2")["metadata"]["annotations"][
+                gang.gang_origin_annotation()])
+        # congruent windows: same origin on its own host's mesh
+        assert origin2 == origin1, (origin1, origin2)
