@@ -628,6 +628,7 @@ struct ControllerState {
                             // and equilibrates far below target
   int cooldown = 0;
   int exclusive_ticks = 0;  // debounce for auto-switch FSM
+  int blind_ticks = 0;      // activity but no self-observed busy time
   bool use_aimd = true;
 };
 ControllerState g_ctl[kMaxDeviceCount];
@@ -680,12 +681,23 @@ void WatcherTick(int64_t window_ns) {
     ControllerState* cs = &g_ctl[slot];
     double base = (double)target / 100.0;
     if (cs->rate_frac <= 0) cs->rate_frac = base;
-    if (!external) {
-      // Open loop: without a chip-level measurement there is nothing to
-      // track — our own busy observations already flow through the bucket
-      // reconciliation, which enforces busy/wall == target exactly. A
-      // feedback controller on the same signal double-corrects (each
-      // per-step busy spike reads as overshoot) and collapses the rate.
+    // The reconciling bucket driven by self-observed busy time is exact
+    // (measured MAE <0.5%) whenever self-observation works; a feedback
+    // controller layered on top only adds convergence error (measured
+    // 10-17% MAE when it drives the rate). The controllers exist for the
+    // case the reference built them for: the process is BLIND to its own
+    // device time (completion events lie, no D2H sync) and only the
+    // external chip-level feed knows the truth.
+    bool had_activity =
+        s.hot[slot].precharged_us.load(std::memory_order_relaxed) > 0 ||
+        s.hot[slot].inflight.load(std::memory_order_relaxed) > 0;
+    if (busy_us > 0) {
+      cs->blind_ticks = 0;
+    } else if (had_activity) {
+      cs->blind_ticks++;
+    }
+    bool self_blind = cs->blind_ticks >= 5;
+    if (!external || !self_blind) {
       cs->rate_frac = base;
     } else {
       // Closed loop on the node watcher's chip duty cycle (the reference's

@@ -16,8 +16,11 @@ from __future__ import annotations
 
 import argparse
 import os
+import struct
 import subprocess
 import sys
+import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -27,8 +30,51 @@ QUOTAS = (100, 75, 50, 25)
 CONTROLLERS = ("delta", "aimd", "auto")
 
 
+class FeedPublisher:
+    """Plays the node TC-watcher daemon: translates the fake chip's shared
+    busy counter into the tc_util feed so the shim's closed-loop
+    controllers act on a measured chip duty cycle (the reference's NVML
+    scenario)."""
+
+    def __init__(self, workdir: str):
+        sys.path.insert(0, REPO)
+        from vtpu_manager.config import tc_watcher
+        self.shared = os.path.join(workdir, "chip.state")
+        with open(self.shared, "wb") as f:
+            f.write(b"\0" * 16)
+        self.tc_path = os.path.join(workdir, "tc_util.config")
+        self.feed = tc_watcher.TcUtilFile(self.tc_path, create=True)
+        self.tc_watcher = tc_watcher
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        last_busy, last_t = 0, time.monotonic_ns()
+        while not self._stop.is_set():
+            self._stop.wait(0.05)
+            try:
+                with open(self.shared, "rb") as f:
+                    busy, = struct.unpack("<Q", f.read(16)[:8])
+            except (OSError, struct.error):
+                continue
+            now = time.monotonic_ns()
+            util = min(100, int(100 * (busy - last_busy) /
+                                max(now - last_t, 1)))
+            last_busy, last_t = busy, now
+            self.feed.write_device(0, self.tc_watcher.DeviceUtil(
+                timestamp_ns=now, device_util=util,
+                procs=[self.tc_watcher.ProcUtil(1, util, 0, 12345)]))
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.feed.close()
+
+
 def run_point(controller: str, quota: int, iters: int,
-              exec_us: int) -> float | None:
+              exec_us: int, feed: "FeedPublisher | None" = None
+              ) -> float | None:
     env = dict(os.environ)
     env.update({
         "SHIM_PATH": os.path.join(BUILD, "libvtpu-control.so"),
@@ -42,6 +88,11 @@ def run_point(controller: str, quota: int, iters: int,
         "FAKE_EXEC_US": str(exec_us),
         "SHIM_TEST_ITERS": str(iters),
     })
+    if feed is not None:
+        env["VTPU_TC_UTIL_PATH"] = feed.tc_path
+        env["FAKE_SHARED_STATE"] = feed.shared
+        env["VTPU_POD_UID"] = "uid-ablation"
+        env["VTPU_CONTAINER_NAME"] = "main"
     res = subprocess.run([os.path.join(BUILD, "shim_test"),
                           "--throttle-only"], env=env, capture_output=True,
                          text=True, timeout=600)
@@ -55,6 +106,9 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--iters", type=int, default=400)
     parser.add_argument("--exec-us", type=int, default=2000)
+    parser.add_argument("--with-feed", action="store_true",
+                        help="publish a chip-utilization feed so the "
+                             "closed-loop controllers engage")
     args = parser.parse_args()
 
     if not os.path.exists(os.path.join(BUILD, "shim_test")):
@@ -63,18 +117,25 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
+    feed = None
+    if args.with_feed:
+        import tempfile
+        feed = FeedPublisher(tempfile.mkdtemp(prefix="vtpu-ablation-"))
+        print("closed-loop: controllers act on the published chip feed")
     print(f"iters={args.iters} exec={args.exec_us}us "
           f"busy={args.iters * args.exec_us / 1000:.0f}ms\n")
     print("controller  quota  wall_ms  share%   err")
     maes: dict[str, list[float]] = {}
     for controller in CONTROLLERS:
-        base_wall = run_point(controller, 100, args.iters, args.exec_us)
+        base_wall = run_point(controller, 100, args.iters, args.exec_us,
+                              feed)
         if base_wall is None:
             print(f"{controller:10s}  run failed", file=sys.stderr)
             continue
         for quota in QUOTAS:
             wall = (base_wall if quota == 100 else
-                    run_point(controller, quota, args.iters, args.exec_us))
+                    run_point(controller, quota, args.iters, args.exec_us,
+                              feed))
             if wall is None:
                 continue
             share = 100.0 * base_wall / wall
@@ -87,6 +148,8 @@ def main() -> int:
           "AIMD v5 2.2-2.8%):")
     for controller, errs in maes.items():
         print(f"  {controller:10s} {sum(errs) / len(errs):.2f}%")
+    if feed is not None:
+        feed.stop()
     return 0
 
 
