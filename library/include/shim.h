@@ -82,6 +82,11 @@ struct alignas(128) DeviceHot {
   std::atomic<uint64_t> last_submit_ns{0};
   std::atomic<uint64_t> busy_ns_window{0};   // self-measured busy time
   std::atomic<int64_t> precharged_us{0};     // submit-time token deductions
+  std::atomic<int64_t> submits_window{0};    // Execute submissions this tick
+  std::atomic<int64_t> blind_cost_us{0};     // feed-derived per-submission
+                                             // cost when self-blind
+  std::atomic<bool> blind{true};             // self-observation starved
+                                             // (default: unproven)
   std::atomic<int64_t> inflight{0};
   std::atomic<int> up_limit{0};            // balance mode elastic target (%)
   std::atomic<bool> throttled_since_watch{false};

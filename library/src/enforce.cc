@@ -205,6 +205,8 @@ class VmemLock {
 };
 
 void MapVmemLedger() {
+  // tenant identity is needed even without a ledger (feed attribution)
+  g_owner_token = ComputeOwnerToken();
   const char* path = getenv("VTPU_VMEM_PATH");
   char fallback[] = "/tmp/.vmem_node/vmem_node.config";
   if (!path) path = fallback;
@@ -229,7 +231,6 @@ void MapVmemLedger() {
     return;
   }
   g_vmem = f;
-  g_owner_token = ComputeOwnerToken();
   VTPU_LOG(kLogInfo, "vmem ledger mapped: %s (token=%016llx)", path,
            (unsigned long long)g_owner_token);
 }
@@ -575,11 +576,13 @@ int EffectiveLimit(int slot) {
 // self-estimate from completion timing. busy_us_out always returns this
 // process's own observed busy time (the spend to reconcile).
 int MeasuredUtil(int slot, int64_t window_ns, bool* external,
-                 bool* others_active, int64_t* busy_us_out) {
+                 bool* others_active, int64_t* busy_us_out,
+                 int64_t* attributed_us_out) {
   ShimState& s = State();
   const VtpuDevice* cfg = DeviceCfg(slot);
   *external = false;
   *others_active = false;
+  *attributed_us_out = 0;
   *busy_us_out =
       (int64_t)(s.hot[slot].busy_ns_window.exchange(0) / 1000);
   if (s.tc_file && cfg && cfg->host_index < kMaxDeviceCount) {
@@ -605,6 +608,28 @@ int MeasuredUtil(int slot, int64_t window_ns, bool* external,
       if (now >= ts && now - ts <= 5ull * 1000 * 1000 * 1000) {
         *external = true;
         *others_active = other;
+        // Feed-attributed share of OUR activity: our token's proc entry;
+        // with an empty attribution list, the whole chip counts as ours
+        // only when the ledger confirms we are alone (never charge a
+        // tenant for unattributed co-tenant activity).
+        {
+          int self_share = -1;
+          for (int i = 0; i < nproc; i++) {
+            if (rec.procs[i].pid != 0 &&
+                rec.procs[i].owner_token == g_owner_token) {
+              self_share = rec.procs[i].util;
+              break;
+            }
+          }
+          if (self_share < 0 && nproc == 0 &&
+              OtherProcsBytes(slot) == 0) {
+            self_share = util;
+          }
+          if (self_share > 0) {
+            *attributed_us_out =
+                (int64_t)self_share * (window_ns / 1000) / 100;
+          }
+        }
         g_metrics.watcher_external.Bump();
         return util;
       }
@@ -662,8 +687,9 @@ void WatcherTick(int64_t window_ns) {
     const VtpuDevice* cfg = DeviceCfg(slot);
     if (!cfg || cfg->core_limit == kCoreLimitNone) continue;
     bool external = false, others = false;
-    int64_t busy_us = 0;
-    int used = MeasuredUtil(slot, window_ns, &external, &others, &busy_us);
+    int64_t busy_us = 0, attributed_us = 0;
+    int used = MeasuredUtil(slot, window_ns, &external, &others, &busy_us,
+                            &attributed_us);
     // balance/soft mode: climb toward soft_core while alone with headroom,
     // reset to hard_core when an external process appears
     // (reference cuda_hook.c:1265-1352).
@@ -688,15 +714,52 @@ void WatcherTick(int64_t window_ns) {
     // case the reference built them for: the process is BLIND to its own
     // device time (completion events lie, no D2H sync) and only the
     // external chip-level feed knows the truth.
+    // Blindness = SELF-observation starved despite activity; attribution
+    // must not mask it (it is the replacement signal, not evidence of
+    // working observers).
+    int64_t precharged_now =
+        s.hot[slot].precharged_us.load(std::memory_order_relaxed);
     bool had_activity =
-        s.hot[slot].precharged_us.load(std::memory_order_relaxed) > 0 ||
+        precharged_now > 0 ||
         s.hot[slot].inflight.load(std::memory_order_relaxed) > 0;
-    if (busy_us > 0) {
-      cs->blind_ticks = 0;
-    } else if (had_activity) {
+    // Blind = self-observation materially undercounts reality: either
+    // nothing observed despite activity, or the feed attributes several
+    // times more busy to us than we saw (lying completion events yield
+    // tiny-but-nonzero spans, so a zero-test is not enough). The flag
+    // only changes on evidence; blind-by-default covers the cold start.
+    bool undercount =
+        attributed_us > 4 * busy_us + (int64_t)(window_ns / 100000);
+    // trust requires self-observation to roughly account for the work we
+    // precharged — lying events yield spans orders of magnitude below it
+    bool plausible = busy_us > 0 && 2 * busy_us >= precharged_now;
+    if (had_activity && (busy_us == 0 || undercount || !plausible)) {
       cs->blind_ticks++;
+      if (cs->blind_ticks >= 2)
+        s.hot[slot].blind.store(true, std::memory_order_relaxed);
+    } else if (plausible && !undercount) {
+      cs->blind_ticks = 0;
+      s.hot[slot].blind.store(false, std::memory_order_relaxed);
     }
-    bool self_blind = cs->blind_ticks >= 5;
+    bool self_blind = s.hot[slot].blind.load(std::memory_order_relaxed);
+    // Blind cost learning: with lying events the per-executable EMA is
+    // poisoned toward 0, but attributed_busy / submissions is an honest
+    // per-submission cost — it paces future submissions to quota even
+    // though the device itself cannot be preempted post-submit.
+    int64_t submits =
+        s.hot[slot].submits_window.exchange(0, std::memory_order_relaxed);
+    if (self_blind && attributed_us > 0) {
+      int64_t per_sub = attributed_us / std::max<int64_t>(submits, 1);
+      int64_t prev_bc =
+          s.hot[slot].blind_cost_us.load(std::memory_order_relaxed);
+      s.hot[slot].blind_cost_us.store(
+          prev_bc == 0 ? per_sub : (7 * prev_bc + per_sub) / 8,
+          std::memory_order_relaxed);
+    } else if (!self_blind) {
+      s.hot[slot].blind_cost_us.store(0, std::memory_order_relaxed);
+    }
+    // Spend = the better observer: self when honest, attribution when
+    // blind (they agree when both work).
+    if (attributed_us > busy_us) busy_us = attributed_us;
     if (!external || !self_blind) {
       cs->rate_frac = base;
     } else {
@@ -809,6 +872,19 @@ void RateLimit(int slot, int64_t cost_us) {
   // debt (tokens may go negative) so followers are throttled — duty cycling
   // without sleeping inside plugin callbacks (reference GAP path,
   // cuda_hook.c:1375-1591).
+  hot.submits_window.fetch_add(1, std::memory_order_relaxed);
+  if (hot.blind.load(std::memory_order_relaxed)) {
+    // A blind submitter (lying completion events poison the EMA toward 0)
+    // must pay a real precharge per submission or it outruns every
+    // feedback path: the feed-learned per-submission cost, floored at
+    // 1 ms until learned. Honest slots keep their measured EMA untouched
+    // (a floor there would over-pace genuinely tiny programs).
+    constexpr int64_t kBlindFloorUs = 1000;
+    int64_t blind_cost =
+        hot.blind_cost_us.load(std::memory_order_relaxed);
+    if (blind_cost < kBlindFloorUs) blind_cost = kBlindFloorUs;
+    if (blind_cost > cost_us) cost_us = blind_cost;
+  }
   if (last == 0 || now - last > (uint64_t)kGapThresholdNs) {
     hot.tokens_us.fetch_sub(cost_us, std::memory_order_relaxed);
     hot.precharged_us.fetch_add(cost_us, std::memory_order_relaxed);

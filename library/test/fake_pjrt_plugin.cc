@@ -88,6 +88,15 @@ int64_t OutBytes() {
   return v ? atol(v) : 1024;
 }
 
+bool LyingEvents() {
+  // Model transports whose completion events fire at dispatch-accept
+  // rather than device completion (observed on remote PJRT tunnels): the
+  // chip still gets busy (worker sleeps, shared counter accrues) but no
+  // event ever reflects it — the tenant is blind to its own device time.
+  static int v = getenv("FAKE_LYING_EVENTS") ? 1 : 0;
+  return v == 1;
+}
+
 // Device busy simulation: executes serialize on the fake chip. With
 // FAKE_SHARED_STATE set, the chip is shared ACROSS processes: an flock on
 // <path>.lock serializes execution (two co-tenant shims then genuinely
@@ -390,7 +399,15 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     if (args->device_complete_events) {
       args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(done);
     }
-    {
+    if (LyingEvents()) {
+      // events fire immediately; the device work still queues
+      done->MarkReady();
+      out_ready->MarkReady();
+      FakeEvent* sink_done = new FakeEvent();
+      FakeEvent* sink_ready = new FakeEvent();
+      std::lock_guard<std::mutex> lk(JobsMu());
+      Jobs().push_back({sink_done, sink_ready, dur});
+    } else {
       std::lock_guard<std::mutex> lk(JobsMu());
       Jobs().push_back({done, out_ready, dur});
     }
