@@ -224,3 +224,31 @@ class TestDraConversion:
         parts = resolve_claim_partitions(claim)
         assert parts[0].cores == 40
         assert parts[0].memory_mib == 4096
+
+    def test_templates_created_through_client(self):
+        import asyncio
+        from aiohttp.test_utils import TestClient, TestServer
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.webhook.server import WebhookAPI
+
+        async def scenario():
+            client = FakeKubeClient()
+            api = WebhookAPI(dra_convert=True, client=client)
+            async with TestClient(TestServer(api.build_app())) as http:
+                resp = await http.post("/pods/mutate", json={
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": "u", "object": vtpu_pod()}})
+                body = await resp.json()
+                assert body["response"]["allowed"]
+                assert len(client.resourceclaim_templates) == 1
+                # dryRun must not create anything
+                resp2 = await http.post("/pods/mutate", json={
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": "u2", "dryRun": True,
+                                "object": vtpu_pod(cores=60)}})
+                assert (await resp2.json())["response"]["allowed"]
+                assert len(client.resourceclaim_templates) == 1
+
+        asyncio.run(scenario())

@@ -149,6 +149,18 @@ class FakeKubeClient:
                                 f"resourceclaim {namespace}/{name} not found")
             return copy.deepcopy(claim)
 
+    def create_resourceclaim_template(self, template: dict) -> dict:
+        meta = template["metadata"]
+        key = (meta.get("namespace", "default"), meta["name"])
+        with self._lock:
+            if not hasattr(self, "resourceclaim_templates"):
+                self.resourceclaim_templates = {}
+            if key in self.resourceclaim_templates:
+                from vtpu_manager.client.kube import KubeError
+                raise KubeError(409, f"template {key} exists")
+            self.resourceclaim_templates[key] = copy.deepcopy(template)
+            return copy.deepcopy(template)
+
     def apply_resourceslice(self, slice_doc: dict) -> dict:
         with self._lock:
             self.resourceslices[slice_doc["metadata"]["name"]] = \

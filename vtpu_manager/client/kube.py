@@ -153,6 +153,12 @@ class InClusterClient:
             "GET", f"/apis/resource.k8s.io/v1beta1/namespaces/{namespace}"
                    f"/resourceclaims/{name}")
 
+    def create_resourceclaim_template(self, template: dict) -> dict:
+        ns = template["metadata"].get("namespace", "default")
+        return self._request(
+            "POST", f"/apis/resource.k8s.io/v1beta1/namespaces/{ns}"
+                    "/resourceclaimtemplates", template)
+
     def apply_resourceslice(self, slice_doc: dict) -> dict:
         name = slice_doc["metadata"]["name"]
         try:
