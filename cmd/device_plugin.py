@@ -47,7 +47,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.deviceplugin.base import PluginServer
     from vtpu_manager.deviceplugin.reporters import VcorePlugin, VmemPlugin
     from vtpu_manager.deviceplugin.vnum import VnumPlugin
-    from vtpu_manager.manager.device_manager import DeviceManager
+    from vtpu_manager.manager.device_manager import (DeviceManager,
+                                                     HealthWatcher)
     from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
     from vtpu_manager.util import consts
     from vtpu_manager.util.featuregates import (CORE_PLUGIN, MEMORY_PLUGIN,
@@ -123,6 +124,19 @@ def main(argv: list[str] | None = None) -> int:
         server.watch_kubelet_restarts()
         servers.append(server)
 
+    # health: a chip is unhealthy when its device node vanishes (fake
+    # backends have no nodes and probe healthy); flips re-advertise via
+    # ListAndWatch
+    fake_mode = bool(args.fake_chips)
+
+    def device_node_probe(chip):
+        if fake_mode:
+            return True
+        return os.path.exists(f"/dev/accel{chip.index}")
+
+    health = HealthWatcher(manager, device_node_probe)
+    health.start()
+
     watcher = None
     if gates.enabled(TC_WATCHER):
         watcher = TcWatcherDaemon([c.index for c in chips], FakeSampler())
@@ -149,6 +163,7 @@ def main(argv: list[str] | None = None) -> int:
             watcher.stop()
         if controller:
             controller.stop()
+        health.stop()
         manager.stop()
     return 0
 
