@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import os
 import struct
-import zlib
 from dataclasses import dataclass, field
 
 MAGIC = 0x55505456          # "VTPU" little-endian

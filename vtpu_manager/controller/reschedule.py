@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.deviceplugin.checkpoint import (KUBELET_CHECKPOINT,

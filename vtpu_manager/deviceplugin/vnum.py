@@ -28,7 +28,7 @@ from vtpu_manager.config.node_config import NodeConfig
 from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
 from vtpu_manager.deviceplugin.base import DevicePluginServicer
 from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
-from vtpu_manager.device.types import ChipSpec, get_pod_device_claims
+from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.manager.device_manager import DeviceManager
 from vtpu_manager.util import consts
 

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 from concurrent import futures
 
 import grpc
@@ -20,7 +19,6 @@ from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.kubeletplugin.api import dra_pb2 as pb
 from vtpu_manager.kubeletplugin.device_state import DeviceState, PrepareError
-from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Protocol
+from typing import Protocol
 
 from vtpu_manager.config.tc_watcher import DeviceUtil, ProcUtil, TcUtilFile
 from vtpu_manager.config.vmem import VmemLedger

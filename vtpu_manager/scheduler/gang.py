@@ -15,7 +15,7 @@ gang's chips occupy congruent sub-meshes, so cross-host ICI neighbors align.
 
 from __future__ import annotations
 
-from vtpu_manager.device.types import NodeInfo, get_pod_device_claims
+from vtpu_manager.device.types import NodeInfo
 from vtpu_manager.util import consts
 
 

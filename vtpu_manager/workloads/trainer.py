@@ -16,7 +16,6 @@ scenarios. Designed TPU-first:
 from __future__ import annotations
 
 import functools
-from typing import Any
 
 import jax
 import jax.numpy as jnp
