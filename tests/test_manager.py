@@ -1,0 +1,144 @@
+"""DeviceManager + HealthWatcher lifecycle tests.
+
+Covers the node-agent inventory/registration/health loop (reference:
+pkg/device/manager/device.go:77-556, registry.go:15-113, health.go:28-264):
+discovery through node-config application, the register/heartbeat
+annotations, and health flips notifying plugin listeners.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config.node_config import DeviceIDStore, NodeConfig
+from vtpu_manager.device.types import NodeDeviceRegistry
+from vtpu_manager.manager.device_manager import DeviceManager, HealthWatcher
+from vtpu_manager.tpu.discovery import FakeBackend
+from vtpu_manager.util import consts
+
+
+@pytest.fixture
+def client():
+    c = FakeKubeClient()
+    c.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+    return c
+
+
+def make_manager(client, tmp_path, cfg: NodeConfig | None = None,
+                 n_chips: int = 4) -> DeviceManager:
+    return DeviceManager(
+        "node-a", client, node_config=cfg,
+        id_store=DeviceIDStore(str(tmp_path / "ids.json")),
+        backends=[FakeBackend(n_chips=n_chips)])
+
+
+class TestInitDevices:
+    def test_discovery_applies_node_config(self, client, tmp_path):
+        cfg = NodeConfig(device_split_count=5, memory_scaling=2.0,
+                         exclude_devices=("1",))
+        mgr = make_manager(client, tmp_path, cfg)
+        chips = mgr.init_devices()
+        # chip index 1 excluded, 3 survive
+        assert [c.index for c in chips] == [0, 2, 3]
+        assert all(c.split_count == 5 for c in chips)
+        # v5e = 16 GiB, scaled 2x (oversubscription advertisement)
+        assert chips[0].memory == 32 * 2**30
+
+    def test_id_store_uuids_survive_restart(self, client, tmp_path):
+        mgr = make_manager(client, tmp_path)
+        first = [c.uuid for c in mgr.init_devices()]
+        assert first == [f"node-a-chip-{i}" for i in range(4)]
+        # new manager, same store file: identical synthetic ids
+        again = [c.uuid for c in make_manager(client, tmp_path).init_devices()]
+        assert again == first
+
+
+class TestRegistration:
+    def test_register_publishes_annotations(self, client, tmp_path):
+        mgr = make_manager(client, tmp_path)
+        mgr.mesh_domain = "slice-0"
+        mgr.init_devices()
+        mgr.register_node()
+
+        anns = client.get_node("node-a")["metadata"]["annotations"]
+        reg = NodeDeviceRegistry.decode(
+            anns[consts.node_device_register_annotation()])
+        assert len(reg.chips) == 4
+        assert reg.mesh_domain == "slice-0"
+        assert anns[consts.node_mesh_domain_annotation()] == "slice-0"
+        hb = float(anns[consts.node_device_heartbeat_annotation()])
+        assert abs(hb - time.time()) < 60
+
+    def test_heartbeat_loop_refreshes(self, client, tmp_path):
+        mgr = make_manager(client, tmp_path)
+        mgr.init_devices()
+        mgr.register_node()
+        ann = consts.node_device_heartbeat_annotation()
+        first = client.get_node("node-a")["metadata"]["annotations"][ann]
+        mgr.start_heartbeat(interval_s=0.05)
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                cur = client.get_node("node-a")["metadata"]["annotations"][ann]
+                if cur != first:
+                    break
+                time.sleep(0.02)
+            assert cur != first, "heartbeat never refreshed annotation"
+        finally:
+            mgr.stop()
+
+
+class TestHealth:
+    def test_unhealthy_flip_notifies_and_reregisters(self, client, tmp_path):
+        mgr = make_manager(client, tmp_path)
+        mgr.init_devices()
+        mgr.register_node()
+        flips = []
+        mgr.on_unhealthy(lambda chip: flips.append((chip.uuid, chip.healthy)))
+
+        mgr.mark_unhealthy("node-a-chip-2")
+        assert flips == [("node-a-chip-2", False)]
+        # published registry reflects the flip so the scheduler stops
+        # placing onto the dead chip
+        reg = NodeDeviceRegistry.decode(
+            client.get_node("node-a")["metadata"]["annotations"]
+            [consts.node_device_register_annotation()])
+        assert [c.healthy for c in reg.chips] == [True, True, False, True]
+
+        # idempotent: second mark is a no-op (no duplicate listener call)
+        mgr.mark_unhealthy("node-a-chip-2")
+        assert len(flips) == 1
+
+        mgr.mark_healthy("node-a-chip-2")
+        assert flips[-1] == ("node-a-chip-2", True)
+
+    def test_health_watcher_probe_drives_flips(self, client, tmp_path):
+        mgr = make_manager(client, tmp_path)
+        mgr.init_devices()
+        mgr.register_node()
+        bad: set[str] = set()
+        watcher = HealthWatcher(mgr, probe=lambda c: c.uuid not in bad)
+
+        watcher.check_once()
+        assert all(c.healthy for c in mgr.chips)
+
+        bad.add("node-a-chip-0")
+        watcher.check_once()
+        assert [c.healthy for c in mgr.chips] == [False, True, True, True]
+
+        bad.clear()
+        watcher.check_once()
+        assert all(c.healthy for c in mgr.chips)
+
+    def test_probe_exception_means_unhealthy(self, client, tmp_path):
+        mgr = make_manager(client, tmp_path)
+        mgr.init_devices()
+
+        def probe(chip):
+            raise RuntimeError("libtpu probe crashed")
+
+        HealthWatcher(mgr, probe=probe).check_once()
+        assert not any(c.healthy for c in mgr.chips)

@@ -212,26 +212,29 @@ class TestBind:
         assert "expired" in res.error
 
 
+def occupied_cluster():
+    """One-chip node with an 80%-core victim pod resident on it."""
+    client = FakeKubeClient()
+    reg = dt.fake_registry(1)
+    client.add_node(dt.fake_node("node-0", reg))
+    claims = PodDeviceClaims()
+    claims.add("c", DeviceClaim(reg.chips[0].uuid, 0, 80, 12 * 2**30))
+    victim = vtpu_pod(name="victim", node_name="node-0", priority=1,
+                      annotations={
+                          consts.real_allocated_annotation():
+                              claims.encode()})
+    victim["status"]["phase"] = "Running"
+    client.add_pod(victim)
+    bystander = plain_pod("bystander")
+    bystander["spec"]["nodeName"] = "node-0"
+    client.add_pod(bystander)
+    return client, reg
+
+
 class TestPreempt:
-    def _occupied_cluster(self):
-        client = FakeKubeClient()
-        reg = dt.fake_registry(1)
-        client.add_node(dt.fake_node("node-0", reg))
-        claims = PodDeviceClaims()
-        claims.add("c", DeviceClaim(reg.chips[0].uuid, 0, 80, 12 * 2**30))
-        victim = vtpu_pod(name="victim", node_name="node-0", priority=1,
-                          annotations={
-                              consts.real_allocated_annotation():
-                                  claims.encode()})
-        victim["status"]["phase"] = "Running"
-        client.add_pod(victim)
-        bystander = plain_pod("bystander")
-        bystander["spec"]["nodeName"] = "node-0"
-        client.add_pod(bystander)
-        return client, reg
 
     def test_victim_needed_is_kept(self):
-        client, _ = self._occupied_cluster()
+        client, _ = occupied_cluster()
         preemptor = vtpu_pod(name="pre", cores=50, priority=100)
         res = PreemptPredicate(client).preempt({
             "Pod": preemptor,
@@ -242,7 +245,7 @@ class TestPreempt:
         assert [p["metadata"]["name"] for p in kept] == ["victim"]
 
     def test_unneeded_vtpu_victim_dropped(self):
-        client, reg = self._occupied_cluster()
+        client, reg = occupied_cluster()
         preemptor = vtpu_pod(name="pre", cores=10, priority=100)
         # 10% fits beside the 80% victim: victim should be spared
         res = PreemptPredicate(client).preempt({
@@ -252,7 +255,7 @@ class TestPreempt:
         assert res.node_to_victims["node-0"] == []
 
     def test_unsatisfiable_node_removed(self):
-        client, _ = self._occupied_cluster()
+        client, _ = occupied_cluster()
         preemptor = vtpu_pod(name="pre", number=4, priority=100)
         res = PreemptPredicate(client).preempt({
             "Pod": preemptor,
@@ -261,7 +264,7 @@ class TestPreempt:
         assert res.error
 
     def test_missing_victims_added(self):
-        client, reg = self._occupied_cluster()
+        client, reg = occupied_cluster()
         preemptor = vtpu_pod(name="pre", cores=50, priority=100)
         # kube-scheduler proposed only the bystander (useless for vtpu)
         res = PreemptPredicate(client).preempt({
@@ -274,7 +277,7 @@ class TestPreempt:
 
     def test_meta_victims_wire_format(self):
         # nodeCacheCapable=true: scheduler sends UIDs only
-        client, _ = self._occupied_cluster()
+        client, _ = occupied_cluster()
         preemptor = vtpu_pod(name="pre", cores=50, priority=100)
         victim_uid = client.get_pod("default", "victim")["metadata"]["uid"]
         res = PreemptPredicate(client).preempt({
@@ -313,6 +316,33 @@ class TestHTTPRoutes:
                 metrics = await client.get("/metrics")
                 assert "vtpu_scheduler_requests_total" in \
                     await metrics.text()
+
+        asyncio.run(scenario())
+
+    def test_preempt_and_version_endpoints(self):
+        import asyncio
+        from aiohttp.test_utils import TestClient, TestServer
+        client, _ = occupied_cluster()
+        api = self._api(client)
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+
+        async def scenario():
+            async with TestClient(TestServer(api.build_app())) as http:
+                resp = await http.post("/scheduler/preempt", json={
+                    "Pod": preemptor,
+                    "NodeNameToVictims": {"node-0": {"Pods": [
+                        client.get_pod("default", "victim")]}}})
+                body = await resp.json()
+                assert resp.status == 200
+                # upstream ExtenderPreemptionResult carries meta victims
+                # (UIDs) regardless of the request's victim form
+                uids = [p["UID"] for p in
+                        body["NodeNameToMetaVictims"]["node-0"]["Pods"]]
+                assert uids == ["uid-victim"]
+                version = await (await http.get("/version")).json()
+                assert version["version"] and version["uptime_s"] >= 0
+                metrics = await (await http.get("/metrics")).text()
+                assert 'endpoint="preempt"} 1' in metrics
 
         asyncio.run(scenario())
 
