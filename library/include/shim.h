@@ -90,6 +90,8 @@ struct alignas(128) DeviceHot {
   std::atomic<int64_t> inflight{0};
   std::atomic<int> up_limit{0};            // balance mode elastic target (%)
   std::atomic<bool> throttled_since_watch{false};
+  std::atomic<int> vmem_idx{-1};           // cached own vmem-ledger slot
+  std::atomic<uint64_t> vmem_retry_ns{0};  // ledger-full claim backoff
 };
 static_assert(sizeof(DeviceHot) % 128 == 0, "cacheline isolation");
 

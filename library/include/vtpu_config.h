@@ -132,6 +132,7 @@ static_assert(sizeof(TcUtilFile) == 16 + 64 * (24 + 32 * 24), "ABI");
 // ---------------------------------------------------------------------------
 
 constexpr uint32_t kVmemMagic = 0x4D454D56;  // "VMEM"
+constexpr uint32_t kVmemVersion = 2;
 constexpr int kVmemMaxEntries = 1024;
 
 struct VmemEntry {
@@ -140,8 +141,10 @@ struct VmemEntry {
   uint64_t bytes;
   uint64_t last_update_ns;
   uint64_t owner_token;  // namespace-independent tenant identity
+  uint64_t activity;     // monotonic submit counter; the node watcher
+                         // apportions chip duty-cycle by per-tick deltas
 };
-static_assert(sizeof(VmemEntry) == 32, "ABI");
+static_assert(sizeof(VmemEntry) == 40, "ABI");
 
 struct VmemFile {
   uint32_t magic;
@@ -150,7 +153,7 @@ struct VmemFile {
   int32_t pad_;
   VmemEntry entries[kVmemMaxEntries];
 };
-static_assert(sizeof(VmemFile) == 16 + 1024 * 32, "ABI");
+static_assert(sizeof(VmemFile) == 16 + 1024 * 40, "ABI");
 
 // ---------------------------------------------------------------------------
 // pids.config (CLIENT compat mode: registry-attested container pid set)

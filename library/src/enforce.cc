@@ -226,7 +226,7 @@ void MapVmemLedger() {
   close(fd);
   if (mem == MAP_FAILED) return;
   auto* f = static_cast<VmemFile*>(mem);
-  if (f->magic != kVmemMagic) {
+  if (f->magic != kVmemMagic || f->version != kVmemVersion) {
     munmap(mem, sizeof(VmemFile));
     return;
   }
@@ -318,35 +318,84 @@ int64_t OtherProcsBytes(int slot) {
   return total;
 }
 
-void RecordOwnBytes(int slot) {
-  const VtpuDevice* cfg = DeviceCfg(slot);
-  if (!g_vmem || !cfg) return;
+// Find this tenant's entry, optionally claiming a free slot. Caller must
+// hold VmemLock: two first-time writers must not claim the same free slot
+// (the loser's record would vanish and co-tenant caps undercount). The
+// claim initializes every field before the release-store of pid, which is
+// what publishes the slot to lock-free readers.
+int FindOrClaimOwnEntryLocked(const VtpuDevice* cfg, bool claim) {
   int me = (int)getpid();
-  int64_t raw =
-      State().hot[slot].used_bytes.load(std::memory_order_relaxed);
-  uint64_t mine = raw > 0 ? (uint64_t)raw : 0;
-  // Cross-process lock: two first-time writers must not claim the same free
-  // slot (the loser's record would vanish and co-tenant caps undercount).
-  VmemLock lock;
   int free_slot = -1;
   for (int i = 0; i < kVmemMaxEntries; i++) {
     VmemEntry& e = g_vmem->entries[i];
     if (e.pid == me && e.host_index == cfg->host_index &&
-        e.owner_token == g_owner_token) {
-      e.bytes = mine;
-      e.last_update_ns = NowNs();
-      return;
-    }
+        e.owner_token == g_owner_token)
+      return i;
     if (e.pid == 0 && free_slot < 0) free_slot = i;
   }
-  if (free_slot >= 0 && mine > 0) {
-    VmemEntry& e = g_vmem->entries[free_slot];
-    e.host_index = cfg->host_index;
-    e.bytes = mine;
-    e.last_update_ns = NowNs();
-    e.owner_token = g_owner_token;
-    __atomic_store_n(&e.pid, me, __ATOMIC_RELEASE);  // pid last: claims slot
+  if (!claim || free_slot < 0) return -1;
+  VmemEntry& e = g_vmem->entries[free_slot];
+  e.host_index = cfg->host_index;
+  e.bytes = 0;
+  e.last_update_ns = NowNs();
+  e.owner_token = g_owner_token;
+  e.activity = 0;
+  __atomic_store_n(&e.pid, me, __ATOMIC_RELEASE);  // pid last: claims slot
+  return free_slot;
+}
+
+void RecordOwnBytes(int slot) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!g_vmem || !cfg) return;
+  ShimState& s = State();
+  int64_t raw = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
+  uint64_t mine = raw > 0 ? (uint64_t)raw : 0;
+  VmemLock lock;
+  int idx = FindOrClaimOwnEntryLocked(cfg, /*claim=*/mine > 0);
+  if (idx < 0) return;
+  VmemEntry& e = g_vmem->entries[idx];
+  e.bytes = mine;
+  e.last_update_ns = NowNs();
+  s.hot[slot].vmem_idx.store(idx, std::memory_order_relaxed);
+}
+
+// Per-submission activity tick: the node daemon apportions chip duty-cycle
+// over residents by these deltas (equal split is its only fallback). Hot
+// path is lock-free: the cached index is validated against ownership
+// fields, and last_update_ns is refreshed so an exec-only tenant (zero
+// bytes recorded) is not reaped as stale mid-run. A tenant with no entry
+// yet claims a zero-byte slot under the cross-process lock — executing
+// without allocating must still be visible to attribution. A full ledger
+// backs off for a second instead of paying flock + full scan per submit.
+void BumpActivity(int slot) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!g_vmem || !cfg) return;
+  int me = (int)getpid();
+  DeviceHot& hot = State().hot[slot];
+  uint64_t now = NowNs();
+  int idx = hot.vmem_idx.load(std::memory_order_relaxed);
+  if (idx >= 0 && idx < kVmemMaxEntries) {
+    VmemEntry& e = g_vmem->entries[idx];
+    if (e.pid == me && e.host_index == cfg->host_index &&
+        e.owner_token == g_owner_token) {
+      __atomic_fetch_add(&e.activity, 1, __ATOMIC_RELAXED);
+      e.last_update_ns = now;
+      return;
+    }
+    hot.vmem_idx.store(-1, std::memory_order_relaxed);
   }
+  if (now < hot.vmem_retry_ns.load(std::memory_order_relaxed)) return;
+  VmemLock lock;
+  idx = FindOrClaimOwnEntryLocked(cfg, /*claim=*/true);
+  if (idx < 0) {
+    hot.vmem_retry_ns.store(now + 1000ull * 1000 * 1000,
+                            std::memory_order_relaxed);
+    return;
+  }
+  VmemEntry& e = g_vmem->entries[idx];
+  __atomic_fetch_add(&e.activity, 1, __ATOMIC_RELAXED);
+  e.last_update_ns = now;
+  hot.vmem_idx.store(idx, std::memory_order_relaxed);
 }
 
 // ---------------------------------------------------------------------------
@@ -862,7 +911,12 @@ void StartWatcherOnce() {
 void RateLimit(int slot, int64_t cost_us) {
   ShimState& s = State();
   const VtpuDevice* cfg = DeviceCfg(slot);
-  if (!cfg || cfg->core_limit == kCoreLimitNone) return;
+  if (!cfg) return;
+  // Attribution feeds the daemon regardless of whether THIS tenant is
+  // core-limited: an unlimited tenant's activity still determines how much
+  // of the chip's duty cycle its limited co-tenants are charged for.
+  BumpActivity(slot);
+  if (cfg->core_limit == kCoreLimitNone) return;
   StartWatcherOnce();
   DeviceHot& hot = s.hot[slot];
   uint64_t now = NowNs();
@@ -1368,6 +1422,7 @@ __attribute__((destructor)) static void ClearOwnLedgerEntries() {
       e.bytes = 0;
       e.last_update_ns = 0;
       e.owner_token = 0;
+      e.activity = 0;
       __atomic_store_n(&e.pid, 0, __ATOMIC_RELEASE);
     }
   }

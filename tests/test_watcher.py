@@ -1,0 +1,101 @@
+"""TcWatcherDaemon attribution: chip duty-cycle → per-tenant shares.
+
+Reference: pkg/device/manager/watcher.go:50-252 samples *per-process* SM
+utilization from NVML. libtpu metrics are chip-level only, so the TPU
+daemon differentiates the vmem ledger's per-entry submit counters (bumped
+by the shim each Execute) and apportions the sampled duty cycle by those
+deltas — equal split is only the no-signal fallback.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from vtpu_manager.config import vmem
+from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = TcWatcherDaemon([0], FakeSampler(),
+                        tc_path=str(tmp_path / "tc.config"),
+                        vmem_path=str(tmp_path / "vmem.config"))
+    yield d
+    d.stop()
+
+
+def shares(daemon):
+    rec = daemon.tc_file.read_device(0)
+    return {p.pid: p.util for p in rec.procs}
+
+
+class TestAttribution:
+    def test_equal_split_without_activity(self, daemon):
+        daemon.vmem.record(101, 0, 2**20, owner_token=1)
+        daemon.vmem.record(102, 0, 2**20, owner_token=2)
+        daemon.sampler.values[0] = 80
+        daemon.tick(now_ns=1)
+        assert shares(daemon) == {101: 40, 102: 40}
+
+    def test_activity_deltas_weight_shares(self, daemon):
+        daemon.vmem.record(101, 0, 2**20, owner_token=1)
+        daemon.vmem.record(102, 0, 2**20, owner_token=2)
+        daemon.sampler.values[0] = 80
+        daemon.tick(now_ns=1)   # baseline snapshot (counters first seen)
+
+        daemon.vmem.bump_activity(101, 0, n=30)
+        daemon.vmem.bump_activity(102, 0, n=10)
+        daemon.tick(now_ns=2)
+        assert shares(daemon) == {101: 60, 102: 20}
+
+        # idle tick: no new submits anywhere -> back to equal split
+        daemon.tick(now_ns=3)
+        assert shares(daemon) == {101: 40, 102: 40}
+
+    def test_lopsided_attribution_is_total(self, daemon):
+        daemon.vmem.record(101, 0, 2**20, owner_token=1)
+        daemon.vmem.record(102, 0, 2**20, owner_token=2)
+        daemon.tick(now_ns=1)
+        daemon.vmem.bump_activity(102, 0, n=50)
+        daemon.sampler.values[0] = 100
+        daemon.tick(now_ns=2)
+        assert shares(daemon) == {101: 0, 102: 100}
+
+    def test_departed_resident_baseline_dropped(self, daemon):
+        daemon.vmem.record(101, 0, 2**20, owner_token=1)
+        daemon.vmem.bump_activity(101, 0, n=5)
+        daemon.sampler.values[0] = 50
+        daemon.tick(now_ns=1)
+        daemon.vmem.record(101, 0, 0)       # tenant exits (slot cleared)
+        daemon.tick(now_ns=2)
+        assert (101, 0) not in daemon._last_activity
+
+        # pid recycled on the same chip: must not inherit the old baseline
+        daemon.vmem.record(101, 0, 2**20, owner_token=9)
+        daemon.tick(now_ns=3)
+        assert shares(daemon) == {101: 50}
+
+
+class TestLedgerActivity:
+    def test_record_update_preserves_activity(self, tmp_path):
+        led = vmem.VmemLedger(str(tmp_path / "v.config"), create=True)
+        led.record(os.getpid(), 0, 2**20, owner_token=7)
+        led.bump_activity(os.getpid(), 0, n=3)
+        led.record(os.getpid(), 0, 2**21, owner_token=7)  # resize
+        (entry,) = led.entries()
+        assert entry.activity == 3
+        assert entry.bytes == 2**21
+        led.close()
+
+    def test_clear_resets_activity(self, tmp_path):
+        led = vmem.VmemLedger(str(tmp_path / "v.config"), create=True)
+        led.record(os.getpid(), 0, 2**20)
+        led.bump_activity(os.getpid(), 0)
+        led.record(os.getpid(), 0, 0)       # clears the slot
+        assert led.entries() == []
+        led.record(os.getpid(), 0, 2**20)   # re-claim starts fresh
+        (entry,) = led.entries()
+        assert entry.activity == 0
+        led.close()

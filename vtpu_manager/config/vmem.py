@@ -24,7 +24,7 @@ from vtpu_manager.util import consts
 from vtpu_manager.util.flock import FileLock
 
 MAGIC = 0x4D454D56          # "VMEM"
-VERSION = 1
+VERSION = 2
 MAX_ENTRIES = 1024
 STALE_REAP_NS = 120 * 10**9
 
@@ -32,13 +32,16 @@ _HEADER_FMT = "<IIii"       # magic, version, max_entries, pad
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
 
 # entry: pid i32, host_index i32, bytes u64, last_update_ns u64,
-# owner_token u64 — the pid alone cannot identify a tenant across pid
-# namespaces (a container's getpid() is meaningless to other containers
-# and to the host daemon), so self/other classification keys on a
-# namespace-independent token derived from pod identity
-_ENTRY_FMT = "<iiQQQ"
+# owner_token u64, activity u64 — the pid alone cannot identify a tenant
+# across pid namespaces (a container's getpid() is meaningless to other
+# containers and to the host daemon), so self/other classification keys on
+# a namespace-independent token derived from pod identity; activity is a
+# monotonic submit counter the shim bumps per Execute, which the node
+# watcher differentiates per tick to apportion chip duty-cycle over
+# residents (libtpu metrics are chip-level only)
+_ENTRY_FMT = "<iiQQQQ"
 ENTRY_SIZE = struct.calcsize(_ENTRY_FMT)
-assert ENTRY_SIZE == 32
+assert ENTRY_SIZE == 40
 
 FILE_SIZE = HEADER_SIZE + MAX_ENTRIES * ENTRY_SIZE
 
@@ -72,6 +75,7 @@ class VmemEntry:
     bytes: int
     last_update_ns: int
     owner_token: int = 0
+    activity: int = 0
 
 
 def _pid_alive(pid: int) -> bool:
@@ -121,14 +125,14 @@ class VmemLedger:
             self._fd = None
 
     def _entry(self, i: int) -> VmemEntry:
-        pid, hidx, nbytes, ts, token = struct.unpack_from(
+        pid, hidx, nbytes, ts, token, activity = struct.unpack_from(
             _ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE)
-        return VmemEntry(pid, hidx, nbytes, ts, token)
+        return VmemEntry(pid, hidx, nbytes, ts, token, activity)
 
     def _write_entry(self, i: int, e: VmemEntry) -> None:
         struct.pack_into(_ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE,
                          e.pid, e.host_index, e.bytes, e.last_update_ns,
-                         e.owner_token)
+                         e.owner_token, e.activity)
 
     # -- API ----------------------------------------------------------------
 
@@ -146,9 +150,10 @@ class VmemLedger:
                     if nbytes == 0:
                         self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                     else:
+                        # updates must not reset the submit counter
                         self._write_entry(
                             i, VmemEntry(pid, host_index, nbytes, now,
-                                         token))
+                                         token, e.activity))
                     return
                 if e.pid == 0 and free_slot is None:
                     free_slot = i
@@ -190,6 +195,30 @@ class VmemLedger:
                     continue
                 total += e.bytes
         return total
+
+    def bump_activity(self, pid: int, host_index: int, n: int = 1,
+                      owner_token: int | None = None) -> None:
+        """Python-side submit tick (the C++ shim bumps its own entry
+        lock-free; this is for Python tenants and tests). Mirrors the C++
+        semantics: a tenant with no entry claims a zero-byte slot, so
+        executing without allocating is still visible to attribution."""
+        token = owner_token if owner_token is not None \
+            else owner_token_from_env()
+        now = time.monotonic_ns()
+        with self._lock:
+            free_slot = None
+            for i in range(MAX_ENTRIES):
+                e = self._entry(i)
+                if e.pid == pid and e.host_index == host_index:
+                    e.activity += n
+                    e.last_update_ns = now
+                    self._write_entry(i, e)
+                    return
+                if e.pid == 0 and free_slot is None:
+                    free_slot = i
+            if free_slot is not None:
+                self._write_entry(free_slot, VmemEntry(
+                    pid, host_index, 0, now, token, n))
 
     def entries(self) -> list[VmemEntry]:
         with self._lock:

@@ -59,24 +59,47 @@ class TcWatcherDaemon:
             self.vmem = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # (pid, host_index, owner_token) -> activity counter at the
+        # previous tick, for differentiating the ledger's monotonic submit
+        # counters. The token is part of the key because pids are
+        # namespace-local: two containers' shims can both be "pid 7"
+        self._last_activity: dict[tuple[int, int, int], int] = {}
 
     def tick(self, now_ns: int | None = None) -> None:
         now_ns = time.monotonic_ns() if now_ns is None else now_ns
         entries = self.vmem.entries() if self.vmem is not None else []
+        seen: set[tuple[int, int, int]] = set()
         for index in self.device_indices:
             util = max(0, min(100, self.sampler.sample(index)))
             residents = [e for e in entries if e.host_index == index]
             procs = []
             if residents:
-                # chip-level duty cycle apportioned over resident pids
-                # (equal split absent finer attribution; the shim's own
-                # self-observations refine its local view)
-                share = util // len(residents)
-                procs = [ProcUtil(pid=e.pid, util=share, mem_used=e.bytes,
-                                  owner_token=e.owner_token)
-                         for e in residents]
+                # chip-level duty cycle apportioned over residents by their
+                # submit-activity deltas since the last tick (the shim bumps
+                # a per-entry counter each Execute); equal split only when
+                # nobody submitted this tick — e.g. all work in flight from
+                # before, or Python tenants that never tick the counter
+                deltas = []
+                for e in residents:
+                    key = (e.pid, e.host_index, e.owner_token)
+                    seen.add(key)
+                    prev = self._last_activity.get(key, e.activity)
+                    deltas.append(max(0, e.activity - prev))
+                    self._last_activity[key] = e.activity
+                total = sum(deltas)
+                for e, delta in zip(residents, deltas):
+                    share = (util * delta // total if total
+                             else util // len(residents))
+                    procs.append(ProcUtil(pid=e.pid, util=share,
+                                          mem_used=e.bytes,
+                                          owner_token=e.owner_token))
             self.tc_file.write_device(index, DeviceUtil(
                 timestamp_ns=now_ns, device_util=util, procs=procs))
+        # drop snapshots of departed residents so a recycled pid on the
+        # same chip does not inherit a stale baseline
+        for key in list(self._last_activity):
+            if key not in seen:
+                del self._last_activity[key]
 
     def start(self) -> None:
         def loop():
