@@ -140,6 +140,11 @@ bool ConsumeError(PJRT_Error* err);
 
 // enforce.cc — memory + compute hooks
 void WrapEnforcementEntries(PJRT_Api* api);
+struct LedgerBytes {
+  int64_t siblings;  // our tenant's other processes (share our cap)
+  int64_t others;    // other tenants (count against physical HBM only)
+};
+LedgerBytes ScanLedgerBytes(int slot);
 int64_t OtherProcsBytes(int slot);    // vmem-ledger view of co-tenants
 void RecordOwnBytes(int slot);        // publish to the ledger
 

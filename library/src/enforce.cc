@@ -227,6 +227,12 @@ void MapVmemLedger() {
   if (mem == MAP_FAILED) return;
   auto* f = static_cast<VmemFile*>(mem);
   if (f->magic != kVmemMagic || f->version != kVmemVersion) {
+    // fail-open but LOUD: without the ledger there is no sibling-cap,
+    // physical-HBM, or attribution view (mixed-version node mid-upgrade)
+    VTPU_LOG(kLogWarn,
+             "vmem ledger %s rejected (magic=%08x version=%u want v%u); "
+             "co-tenant accounting disabled",
+             path, f->magic, f->version, kVmemVersion);
     munmap(mem, sizeof(VmemFile));
     return;
   }
@@ -294,10 +300,14 @@ bool PidIsSelf(int pid) {
 
 }  // namespace
 
-int64_t OtherProcsBytes(int slot) {
+// One ledger scan, two sums: bytes held by OUR tenant's other processes
+// (they share our cap) and bytes held by other tenants (they only matter
+// against the chip's physical HBM).
+LedgerBytes ScanLedgerBytes(int slot) {
+  LedgerBytes out{0, 0};
   const VtpuDevice* cfg = DeviceCfg(slot);
-  if (!g_vmem || !cfg) return 0;
-  int64_t total = 0;
+  if (!g_vmem || !cfg) return out;
+  int me = (int)getpid();
   uint64_t now = NowNs();
   for (int i = 0; i < kVmemMaxEntries; i++) {
     const VmemEntry& e = g_vmem->entries[i];
@@ -305,18 +315,20 @@ int64_t OtherProcsBytes(int slot) {
     // tenant identity is the token — pids are namespace-local and
     // meaningless across containers; tokenless legacy entries fall back
     // to the registry-attested pid set
-    if (e.owner_token != 0 ? e.owner_token == g_owner_token
-                           : PidIsSelf(e.pid))
-      continue;
+    bool self_tenant = e.owner_token != 0 ? e.owner_token == g_owner_token
+                                          : PidIsSelf(e.pid);
+    if (self_tenant && e.pid == me) continue;  // own hot-counter covers me
     // liveness of a foreign namespace's pid is unknowable: count the
     // entry unless it has also gone stale (the daemon reaps those)
     if (!PidAlive(e.pid) &&
         now - e.last_update_ns > 120ull * 1000 * 1000 * 1000)
       continue;
-    total += (int64_t)e.bytes;
+    (self_tenant ? out.siblings : out.others) += (int64_t)e.bytes;
   }
-  return total;
+  return out;
 }
+
+int64_t OtherProcsBytes(int slot) { return ScanLedgerBytes(slot).others; }
 
 // Find this tenant's entry, optionally claiming a free slot. Caller must
 // hold VmemLock: two first-time writers must not claim the same free slot
@@ -453,19 +465,6 @@ int64_t HostBufferBytes(const PJRT_Client_BufferFromHostBuffer_Args* args) {
   return elems * ElementBytes(args->type);
 }
 
-// Cached co-tenant usage for the *display* path (MemoryStats); admission
-// uses an exact under-lock scan — the ledger scan costs microseconds and a
-// stale cache would let concurrent tenants jointly overshoot physical HBM.
-std::atomic<int64_t> g_others_cache[kMaxDeviceCount];
-
-void RefreshOthersCache() {
-  ShimState& s = State();
-  for (int slot = 0; slot < s.device_count; slot++) {
-    g_others_cache[slot].store(OtherProcsBytes(slot),
-                               std::memory_order_relaxed);
-  }
-}
-
 void UpdatePeak(int slot, int64_t used) {
   ShimState& s = State();
   int64_t peak = s.hot[slot].peak_bytes.load();
@@ -489,18 +488,31 @@ PJRT_Error* ReserveMemory(int slot, int64_t bytes) {
     return nullptr;
   }
   int64_t cap = (int64_t)cfg->total_memory;
+  int64_t phys = (int64_t)cfg->real_memory;
   DeviceLock lock(cfg->host_index);
   int64_t own = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
-  int64_t others = OtherProcsBytes(slot);
-  g_others_cache[slot].store(others, std::memory_order_relaxed);
-  if (own + others + bytes > cap) {
+  LedgerBytes lb = ScanLedgerBytes(slot);
+  // personal cap: all of THIS tenant's processes together. Other tenants'
+  // bytes never count here — their caps are their own.
+  if (own + lb.siblings + bytes > cap) {
     g_metrics.oom_rejected.Bump();
     return MakeError(
         PJRT_Error_Code_RESOURCE_EXHAUSTED,
         "vtpu-control: HBM cap exceeded on device %d: "
-        "req=%" PRId64 "B used=%" PRId64 "B co-tenants=%" PRId64
+        "req=%" PRId64 "B used=%" PRId64 "B siblings=%" PRId64
         "B cap=%" PRId64 "B",
-        cfg->host_index, bytes, own, others, cap);
+        cfg->host_index, bytes, own, lb.siblings, cap);
+  }
+  // physical pressure: everyone on the chip. Only binds when slots are
+  // oversold — the scheduler keeps sum-of-caps <= physical otherwise.
+  if (phys > 0 && own + lb.siblings + lb.others + bytes > phys) {
+    g_metrics.oom_rejected.Bump();
+    return MakeError(
+        PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "vtpu-control: physical HBM exhausted on device %d: "
+        "req=%" PRId64 "B tenant=%" PRId64 "B co-tenants=%" PRId64
+        "B physical=%" PRId64 "B",
+        cfg->host_index, bytes, own + lb.siblings, lb.others, phys);
   }
   // fetch_add, not store: concurrent destroys may subtract while we hold
   // the lock (reserves are serialized by the lock; frees only help).
@@ -589,10 +601,11 @@ PJRT_Error* WrappedMemoryStats(PJRT_Device_MemoryStats_Args* args) {
   }
   ShimState& s = State();
   int64_t own = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
-  int64_t others = OtherProcsBytes(slot);
-
+  // the tenant's own world: its processes' usage against its cap.
+  // Co-tenant pressure is invisible here (their caps are theirs); a
+  // physically-full chip surfaces as RESOURCE_EXHAUSTED at alloc time.
   if (ARGS_HAS_FIELD(args, ArgsT, bytes_in_use))
-    args->bytes_in_use = own + others;
+    args->bytes_in_use = own + ScanLedgerBytes(slot).siblings;
   if (ARGS_HAS_FIELD(args, ArgsT, bytes_limit_is_set)) {
     args->bytes_limit = (int64_t)cfg->total_memory;
     args->bytes_limit_is_set = true;
@@ -657,19 +670,31 @@ int MeasuredUtil(int slot, int64_t window_ns, bool* external,
       if (now >= ts && now - ts <= 5ull * 1000 * 1000 * 1000) {
         *external = true;
         *others_active = other;
-        // Feed-attributed share of OUR activity: our token's proc entry;
-        // with an empty attribution list, the whole chip counts as ours
-        // only when the ledger confirms we are alone (never charge a
-        // tenant for unattributed co-tenant activity).
+        // Feed-attributed share of OUR activity. Shares are per-pid
+        // (activity-weighted), so a sibling process of our own tenant
+        // carries its own share and naive first-token-match would charge
+        // us for its work. Resolution: exact (pid, token) match first —
+        // the ledger pid is the recording shim's own getpid(), i.e. our
+        // namespace for same-token entries. If the pid view doesn't line
+        // up (a daemon that rewrites pids), a SINGLE entry with our token
+        // is still unambiguously us; several are siblings we must not
+        // guess between. With an empty attribution list, the whole chip
+        // counts as ours only when the ledger confirms we are alone
+        // (never charge a tenant for unattributed co-tenant activity).
         {
           int self_share = -1;
+          int me = (int)getpid();
+          int token_share = -1, token_hits = 0;
           for (int i = 0; i < nproc; i++) {
-            if (rec.procs[i].pid != 0 &&
-                rec.procs[i].owner_token == g_owner_token) {
+            if (rec.procs[i].owner_token != g_owner_token) continue;
+            token_share = rec.procs[i].util;
+            token_hits++;
+            if (rec.procs[i].pid == me) {
               self_share = rec.procs[i].util;
               break;
             }
           }
+          if (self_share < 0 && token_hits == 1) self_share = token_share;
           if (self_share < 0 && nproc == 0 &&
               OtherProcsBytes(slot) == 0) {
             self_share = util;
@@ -855,7 +880,6 @@ void WatcherTick(int64_t window_ns) {
     s.hot[slot].throttled_since_watch.store(false);
   }
   RefreshClientPids();
-  RefreshOthersCache();
   g_metrics.watcher_ticks.Bump();
 }
 

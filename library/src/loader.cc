@@ -158,7 +158,13 @@ static bool SynthesizeFromEnv(VtpuConfig* out) {
     if (!ov) ov = getenv("VTPU_MEM_OVERSOLD");
     d.memory_limit = mem > 0;
     d.total_memory = (uint64_t)(mem > 0 ? mem : 0);
-    d.real_memory = d.total_memory > 0 ? d.total_memory * 100 / ratio : 0;
+    // physical chip HBM: explicit env wins (tests / dev boxes state it
+    // directly); else derived from the oversold ratio. 0 = unknown, which
+    // disables the physical-pressure admission check.
+    long realmem = EnvLong("VTPU_MEM_REAL", i, 0);
+    d.real_memory = realmem > 0 ? (uint64_t)realmem
+                    : d.total_memory > 0 ? d.total_memory * 100 / ratio
+                                         : 0;
     d.hard_core = (int32_t)core;
     d.soft_core = (int32_t)soft;
     d.core_limit = core <= 0       ? kCoreLimitNone

@@ -33,7 +33,7 @@ def shim_build():
     return BUILD
 
 
-def tenant_env(tmp_path, pod_uid, quota, iters, shared):
+def tenant_env(tmp_path, pod_uid, quota, iters, shared, extra=None):
     env = dict(os.environ)
     env.update({
         "SHIM_PATH": os.path.join(BUILD, "libvtpu-control.so"),
@@ -51,6 +51,7 @@ def tenant_env(tmp_path, pod_uid, quota, iters, shared):
         "FAKE_EXEC_US": "2000",
         "SHIM_TEST_ITERS": str(iters),
     })
+    env.update(extra or {})
     return env
 
 
@@ -141,3 +142,59 @@ def test_unequal_quotas_bias_the_chip(shim_build, tmp_path):
             if "wall=" in line:
                 walls[uid] = float(line.split("wall=")[1].split("ms")[0])
     assert walls["uid-hi"] < walls["uid-lo"], walls
+
+class TestHbmCoTenancy:
+    """Admission semantics: a tenant's cap is its own; co-tenants only
+    matter against PHYSICAL HBM (reference: oversold handling in the alloc
+    path; the scheduler keeps sum-of-caps <= physical otherwise)."""
+
+    def _run(self, tmp_path, shared, extra):
+        # full mode: the harness's memory phase asserts a 1 MiB cap
+        env = tenant_env(tmp_path, "uid-t", 50, 50, shared,
+                         extra={"VTPU_MEM_LIMIT_0": str(1 << 20), **extra})
+        proc = subprocess.run([os.path.join(BUILD, "shim_test")],
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+        return proc
+
+    def _seed(self, tmp_path, shared, token_str, nbytes):
+        with open(shared, "wb") as f:
+            f.write(b"\0" * 16)
+        led = VmemLedger(str(tmp_path / "vmem.config"), create=True)
+        if nbytes:
+            # a resident holding HBM; pid = this test runner (alive)
+            led.record(os.getpid(), 0, nbytes,
+                       owner_token=fnv64(token_str))
+        led.close()
+
+    def test_co_tenant_does_not_consume_my_cap(self, shim_build, tmp_path):
+        # another tenant holds 1 MiB, physical is huge: my own 1 MiB cap
+        # must still be fully allocatable
+        self._seed(tmp_path, str(tmp_path / "chip.state"),
+                   "uid-other/main", 1 << 20)
+        proc = self._run(tmp_path, str(tmp_path / "chip.state"),
+                         {"VTPU_MEM_REAL_0": str(1 << 30)})
+        assert proc.returncode == 0, proc.stdout
+
+    def test_physical_pressure_rejects(self, shim_build, tmp_path):
+        # physical 1.5 MiB, co-tenant holds 1 MiB: my cap says 1 MiB but
+        # the chip only has 0.5 MiB left -> the harness's in-cap allocs
+        # must fail (FAILURES reported, nonzero exit)
+        self._seed(tmp_path, str(tmp_path / "chip.state"),
+                   "uid-other/main", 1 << 20)
+        proc = self._run(tmp_path, str(tmp_path / "chip.state"),
+                         {"VTPU_MEM_REAL_0": str(3 << 19)})
+        assert proc.returncode != 0
+        assert "physical HBM exhausted" in proc.stdout or \
+            "FAIL" in proc.stdout, proc.stdout
+
+    def test_sibling_process_shares_my_cap(self, shim_build, tmp_path):
+        # a process of MY OWN tenant (same token) holds 512 KiB: together
+        # with the harness's allocations that exceeds the 1 MiB cap
+        self._seed(tmp_path, str(tmp_path / "chip.state"),
+                   "uid-t/main", 1 << 19)
+        proc = self._run(tmp_path, str(tmp_path / "chip.state"),
+                         {"VTPU_MEM_REAL_0": str(1 << 30)})
+        assert proc.returncode != 0
+        assert "HBM cap exceeded" in proc.stdout or \
+            "FAIL" in proc.stdout, proc.stdout

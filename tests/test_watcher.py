@@ -45,8 +45,8 @@ class TestAttribution:
         daemon.sampler.values[0] = 80
         daemon.tick(now_ns=1)   # baseline snapshot (counters first seen)
 
-        daemon.vmem.bump_activity(101, 0, n=30)
-        daemon.vmem.bump_activity(102, 0, n=10)
+        daemon.vmem.bump_activity(101, 0, n=30, owner_token=1)
+        daemon.vmem.bump_activity(102, 0, n=10, owner_token=2)
         daemon.tick(now_ns=2)
         assert shares(daemon) == {101: 60, 102: 20}
 
@@ -58,14 +58,14 @@ class TestAttribution:
         daemon.vmem.record(101, 0, 2**20, owner_token=1)
         daemon.vmem.record(102, 0, 2**20, owner_token=2)
         daemon.tick(now_ns=1)
-        daemon.vmem.bump_activity(102, 0, n=50)
+        daemon.vmem.bump_activity(102, 0, n=50, owner_token=2)
         daemon.sampler.values[0] = 100
         daemon.tick(now_ns=2)
         assert shares(daemon) == {101: 0, 102: 100}
 
     def test_departed_resident_baseline_dropped(self, daemon):
         daemon.vmem.record(101, 0, 2**20, owner_token=1)
-        daemon.vmem.bump_activity(101, 0, n=5)
+        daemon.vmem.bump_activity(101, 0, n=5, owner_token=1)
         daemon.sampler.values[0] = 50
         daemon.tick(now_ns=1)
         daemon.vmem.record(101, 0, 0)       # tenant exits (slot cleared)
@@ -82,7 +82,7 @@ class TestLedgerActivity:
     def test_record_update_preserves_activity(self, tmp_path):
         led = vmem.VmemLedger(str(tmp_path / "v.config"), create=True)
         led.record(os.getpid(), 0, 2**20, owner_token=7)
-        led.bump_activity(os.getpid(), 0, n=3)
+        led.bump_activity(os.getpid(), 0, n=3, owner_token=7)
         led.record(os.getpid(), 0, 2**21, owner_token=7)  # resize
         (entry,) = led.entries()
         assert entry.activity == 3

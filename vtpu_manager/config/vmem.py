@@ -209,7 +209,10 @@ class VmemLedger:
             free_slot = None
             for i in range(MAX_ENTRIES):
                 e = self._entry(i)
-                if e.pid == pid and e.host_index == host_index:
+                # token is part of the match: pids are namespace-local,
+                # another container's "pid 7" is not this tenant
+                if e.pid == pid and e.host_index == host_index and \
+                        (e.owner_token == 0 or e.owner_token == token):
                     e.activity += n
                     e.last_update_ns = now
                     self._write_entry(i, e)
