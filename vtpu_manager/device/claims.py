@@ -15,6 +15,7 @@ Ordering of containers is preserved (JSON object order == insertion order).
 
 from __future__ import annotations
 
+import functools
 import json
 from dataclasses import dataclass, field
 
@@ -84,13 +85,33 @@ class PodDeviceClaims:
                                          for c in claims]
         return out
 
+    def copy(self) -> "PodDeviceClaims":
+        """Independent mutable copy (per-container lists are copied;
+        DeviceClaim is frozen). Required before mutating anything obtained
+        from try_decode — decoded objects are cached and shared."""
+        out = PodDeviceClaims()
+        out.containers = {c: list(claims)
+                          for c, claims in self.containers.items()}
+        return out
+
 
 def try_decode(value: str | None) -> PodDeviceClaims | None:
     """Decode, returning None for absent/malformed values (malformed
     annotations on resident pods must not wedge the scheduler; the reference
-    cleans them via the webhook instead — pod_mutate.go)."""
+    cleans them via the webhook instead — pod_mutate.go).
+
+    Results are memoized by the raw annotation string: the scheduler
+    re-decodes every resident pod's claims on every filter pass, and claim
+    annotations are immutable once written. Decoded objects are shared —
+    callers must treat them as read-only (allocation results are built
+    fresh, never through this path)."""
     if not value:
         return None
+    return _try_decode_cached(value)
+
+
+@functools.lru_cache(maxsize=4096)
+def _try_decode_cached(value: str) -> PodDeviceClaims | None:
     try:
         return PodDeviceClaims.decode(value)
     except (ValueError, TypeError, KeyError, AttributeError,

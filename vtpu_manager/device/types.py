@@ -132,6 +132,31 @@ class NodeDeviceRegistry:
             mesh=MeshSpec.from_wire(payload.get("mesh", {})),
             mesh_domain=str(payload.get("domain", "")))
 
+    def chip_by_uuid(self) -> dict:
+        """uuid -> ChipSpec, memoized (registry objects are shared via the
+        decode cache and immutable-by-contract)."""
+        m = getattr(self, "_chip_by_uuid", None)
+        if m is None:
+            m = {c.uuid: c for c in self.chips}
+            object.__setattr__(self, "_chip_by_uuid", m)
+        return m
+
+    def healthy_totals(self) -> tuple[int, int, int]:
+        """(slots, cores, memory) over healthy chips with nothing used,
+        memoized — the starting point for fast capacity gating."""
+        t = getattr(self, "_healthy_totals", None)
+        if t is None:
+            number = cores = memory = 0
+            for c in self.chips:
+                if not c.healthy:
+                    continue
+                number += c.split_count
+                cores += 100
+                memory += c.memory
+            t = (number, cores, memory)
+            object.__setattr__(self, "_healthy_totals", t)
+        return t
+
 
 # ---------------------------------------------------------------------------
 # NodeInfo: per-cycle usage accounting
@@ -222,6 +247,62 @@ def should_count_pod(pod: dict, now: float | None = None,
     return (now - ts) <= grace
 
 
+def decode_registry(raw: str | None) -> "NodeDeviceRegistry | None":
+    """Decode a node's register annotation (memoized; None for absent or
+    malformed values) — the one registry-decode rule, shared by
+    NodeInfo.build and the scheduler's fast capacity gate."""
+    if not raw:
+        return None
+    return _decode_registry_cached(raw)
+
+
+def counted_claims(resident_pods: list[dict], now: float | None = None
+                   ) -> list[tuple[str, PodDeviceClaims]]:
+    """(uid, claims) for every resident pod that still consumes capacity —
+    the single home of the which-pods-count rule, shared by NodeInfo.build
+    and the filter's fast capacity gate."""
+    out = []
+    for pod in resident_pods:
+        if not should_count_pod(pod, now=now):
+            continue
+        claims = get_pod_device_claims(pod)
+        if claims is None:
+            continue
+        out.append(((pod.get("metadata") or {}).get("uid", ""), claims))
+    return out
+
+
+def fast_free_totals(registry: "NodeDeviceRegistry",
+                     claim_sets: list[PodDeviceClaims]
+                     ) -> tuple[int, int, int]:
+    """(slots, cores, memory) free — same accounting as
+    NodeInfo.free_totals (per-chip clamping on cores/memory, unclamped
+    slot counts) but computed from the memoized registry totals without
+    materializing DeviceUsage objects. The filter gates and ranks ALL
+    candidate nodes with this; full NodeInfo is built only for the few
+    nodes the allocator actually visits."""
+    per_chip: dict[str, list[int]] = {}
+    for claims in claim_sets:
+        for claim in claims.all_claims():
+            agg = per_chip.get(claim.uuid)
+            if agg is None:
+                agg = per_chip[claim.uuid] = [0, 0, 0]
+            agg[0] += 1
+            agg[1] += claim.cores
+            agg[2] += claim.memory
+    number, cores, memory = registry.healthy_totals()
+    if per_chip:
+        chips = registry.chip_by_uuid()
+        for uuid, (n, c, m) in per_chip.items():
+            chip = chips.get(uuid)
+            if chip is None or not chip.healthy:
+                continue
+            number -= n                      # free_number is unclamped
+            cores -= min(c, 100)             # per-chip clamp at zero free
+            memory -= min(m, chip.memory)
+    return number, cores, memory
+
+
 def get_pod_device_claims(pod: dict) -> PodDeviceClaims | None:
     """Effective claims for a pod: real allocation wins over pre-allocation
     (reference: GetPodDeviceClaim, types.go:643)."""
@@ -246,23 +327,25 @@ class NodeInfo:
         """Decode the node's register annotation and fold in every resident
         pod's claims (reference: device.NewNodeInfo, types.go:433-507)."""
         anns = (node.get("metadata") or {}).get("annotations") or {}
-        raw = anns.get(consts.node_device_register_annotation())
-        if not raw:
-            return None
-        registry = _decode_registry_cached(raw)
+        registry = decode_registry(
+            anns.get(consts.node_device_register_annotation()))
         if registry is None:
             return None
         name = (node.get("metadata") or {}).get("name", "")
+        return NodeInfo.from_registry(
+            name, registry, counted_claims(resident_pods, now=now))
+
+    @staticmethod
+    def from_registry(name: str, registry: "NodeDeviceRegistry",
+                      claim_pairs: list[tuple[str, PodDeviceClaims]]
+                      ) -> "NodeInfo":
+        """Build from an already-decoded registry and already-filtered
+        (uid, claims) pairs — the scheduler computes both during its fast
+        gate and must not pay for them twice."""
         info = NodeInfo(name=name, registry=registry)
         for chip in registry.chips:
             info.devices[chip.uuid] = DeviceUsage(spec=chip)
-        for pod in resident_pods:
-            if not should_count_pod(pod, now=now):
-                continue
-            claims = get_pod_device_claims(pod)
-            if claims is None:
-                continue
-            uid = (pod.get("metadata") or {}).get("uid", "")
+        for uid, claims in claim_pairs:
             for claim in claims.all_claims():
                 usage = info.devices.get(claim.uuid)
                 if usage is not None:
@@ -376,5 +459,6 @@ def fake_node_info(name: str, n_chips: int, **kw) -> NodeInfo:
 
 __all__ = ["ChipSpec", "MeshSpec", "NodeDeviceRegistry", "DeviceUsage",
            "NodeInfo", "should_count_pod", "get_pod_device_claims",
+           "decode_registry", "counted_claims", "fast_free_totals",
            "fake_chip", "fake_registry", "fake_node", "fake_node_info",
            "replace"]

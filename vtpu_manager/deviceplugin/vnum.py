@@ -246,8 +246,9 @@ class VnumPlugin(DevicePluginServicer):
         uncommitted claims to 'real'."""
         from vtpu_manager.device.claims import try_decode
         anns = (pod.get("metadata") or {}).get("annotations") or {}
-        existing = try_decode(anns.get(consts.real_allocated_annotation())) \
-            or PodDeviceClaims()
+        decoded = try_decode(anns.get(consts.real_allocated_annotation()))
+        # decoded objects are cached and shared — copy before mutating
+        existing = decoded.copy() if decoded else PodDeviceClaims()
         existing.containers[cont] = claims
         return existing.encode()
 

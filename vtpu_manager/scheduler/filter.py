@@ -37,6 +37,7 @@ from vtpu_manager.device.allocator.priority import (ScoredNode, node_score,
 from vtpu_manager.device.allocator.request import (AllocationRequest,
                                                    RequestError,
                                                    build_allocation_request)
+from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
 from vtpu_manager.scheduler import gang, reason as R
@@ -125,19 +126,6 @@ class FilterPredicate:
             return R.NODE_NO_DEVICES
         return None
 
-    # -- stage 2: device-level allocation -----------------------------------
-
-    def _build_info(self, node: dict, resident: list[dict],
-                    now: float) -> NodeInfo | None:
-        name = (node.get("metadata") or {}).get("name", "")
-        info = NodeInfo.build(node, resident, now=now)
-        if info is None:
-            return None
-        visible = {(p.get("metadata") or {}).get("uid", "") for p in resident}
-        for uid, entry in self._assumed_for_node(name, visible):
-            info.assume_pod(uid, entry.claims)
-        return info
-
     # -- entry --------------------------------------------------------------
 
     def filter(self, args: dict) -> FilterResult:
@@ -205,38 +193,55 @@ class FilterPredicate:
         if req.gang_name:
             prefer_origin = gang.resolve_gang_origin(req.gang_name, all_pods)
 
-        # Build usage views for every surviving node (cheap), pre-rank by
-        # free capacity in the node policy's direction, then run the full
-        # allocator only on the best candidate_limit nodes.
-        infos = []
+        # Gate + rank every surviving node on fast free totals (memoized
+        # registry totals minus claim sums — no DeviceUsage materialized),
+        # then build the full usage view lazily, only for nodes the
+        # allocator actually visits.
+        ranked = []
         for node in candidates:
-            name = (node.get("metadata") or {}).get("name", "")
-            info = self._build_info(node, by_node.get(name, []), now)
-            if info is None:
+            meta = node.get("metadata") or {}
+            name = meta.get("name", "")
+            registry = dt.decode_registry(
+                (meta.get("annotations") or {}).get(
+                    consts.node_device_register_annotation()))
+            if registry is None:
                 result.failed_nodes[name] = R.NODE_NO_DEVICES
                 reasons.add(R.NODE_NO_DEVICES, name)
                 continue
-            free_number, free_cores, free_memory = info.free_totals()
+            resident = by_node.get(name, [])
+            counted = dt.counted_claims(resident, now=now)
+            visible = {(p.get("metadata") or {}).get("uid", "")
+                       for p in resident}
+            assumed = self._assumed_for_node(name, visible)
+            free_number, free_cores, free_memory = dt.fast_free_totals(
+                registry,
+                [c for _, c in counted] + [e.claims for _, e in assumed])
             if (free_number < req.total_number()
                     or free_cores < req.total_cores()
                     or free_memory < req.total_memory()):
                 result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
                 continue
-            infos.append((free_cores + (free_memory >> 24) + free_number,
-                          name, info))
+            ranked.append((free_cores + (free_memory >> 24) + free_number,
+                           name, registry, counted, assumed))
         # binpack wants the least-free node first, spread the most-free
-        infos.sort(key=lambda t: (t[0], t[1]),
-                   reverse=req.node_policy == consts.NODE_POLICY_SPREAD)
+        ranked.sort(key=lambda t: (t[0], t[1]),
+                    reverse=req.node_policy == consts.NODE_POLICY_SPREAD)
 
         # Full allocation on the top-K ranked nodes; if NONE of them fit
         # (the capacity rank is blind to topology/uuid constraints), keep
         # walking the remainder until one succeeds — truncation must trade
         # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
-        for rank, (_, name, info) in enumerate(infos):
+        for rank, (_, name, registry, counted, assumed) in \
+                enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
+            # the gate already decoded/filtered everything this needs —
+            # build the usage view from its outputs, never recompute
+            info = NodeInfo.from_registry(name, registry, counted)
+            for uid, entry in assumed:
+                info.assume_pod(uid, entry.claims)
             try:
                 alloc_result = allocate(info, req,
                                         prefer_origin=prefer_origin)
