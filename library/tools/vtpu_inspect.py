@@ -69,7 +69,8 @@ def dump_ledger(path: str) -> None:
         print("  (empty)")
     for e in entries:
         print(f"  pid={e.pid} device={e.host_index} "
-              f"bytes={e.bytes} ({e.bytes >> 20}MiB)")
+              f"bytes={e.bytes} ({e.bytes >> 20}MiB) "
+              f"token={e.owner_token:016x} activity={e.activity}")
 
 
 def dump_watcher(path: str) -> None:
