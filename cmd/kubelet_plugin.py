@@ -28,6 +28,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--registry-dir",
                         default="/var/lib/kubelet/plugins_registry")
     parser.add_argument("--fake-chips", type=int, default=0)
+    parser.add_argument("--nri-socket", default="",
+                        help="NRI runtime socket (e.g. /var/run/nri/"
+                             "nri.sock); empty disables the NRI stub")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -75,6 +78,23 @@ def main(argv: list[str] | None = None) -> int:
     except Exception:
         log.warning("plugin registration socket unavailable")
         registration = None
+
+    nri_conn = None
+    if args.nri_socket:
+        from vtpu_manager.kubeletplugin.nri import RuntimeHook
+        from vtpu_manager.kubeletplugin.nri_transport import NriPlugin
+        from vtpu_manager.util.ttrpc import TtrpcError
+        try:
+            nri_conn = NriPlugin(
+                RuntimeHook(state),
+                claim_uids_for_pod=driver.claim_uids_for_pod,
+            ).run(args.nri_socket)
+            log.info("NRI stub registered on %s", args.nri_socket)
+        except (OSError, TtrpcError) as e:
+            # CDI injection still covers the tenant wiring; NRI only adds
+            # the spoof-rejection layer (reference escalation: plugin.go:232)
+            log.warning("NRI socket unavailable (%s); continuing with "
+                        "CDI-only injection", e)
 
     rs = build_resource_slice(args.node_name, chips)
     log.info("ResourceSlice: %d devices, %d shared counter sets",

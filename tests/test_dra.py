@@ -212,6 +212,20 @@ class TestDraGrpc:
         assert state.prepared_uids() == set()
 
 
+class TestClaimOwnership:
+    def test_claim_uids_for_pod_via_reserved_for(self, state, tmp_path):
+        claim = allocated_claim()
+        claim["status"]["reservedFor"] = [
+            {"resource": "pods", "name": "p1", "uid": "pod-owner"}]
+        source = ClaimSource()
+        source.local["claim-1"] = claim
+        state.prepare_claim(claim)
+        driver = DraDriver("node-1", [], source, state=state,
+                           plugin_dir=str(tmp_path / "sock2"))
+        assert driver.claim_uids_for_pod("pod-owner") == ["claim-1"]
+        assert driver.claim_uids_for_pod("someone-else") == []
+
+
 class TestRuntimeHook:
     def test_valid_claim_injected(self, state):
         state.prepare_claim(allocated_claim())

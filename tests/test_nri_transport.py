@@ -1,0 +1,190 @@
+"""NRI ttrpc transport loopback: plugin stub <-> fake runtime over a real
+unix socket with real ttrpc framing.
+
+Reference test strategy: pkg/kubeletplugin/nri/plugin_test.go drives the
+plugin through a stubbed NRI runtime (no containerd needed). Here the
+fake runtime end is a TtrpcServer serving Runtime.RegisterPlugin; after
+the plugin registers, the SAME connection (full-duplex) carries the
+runtime's Plugin-service calls back to the stub.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from vtpu_manager.device.types import fake_chip
+from vtpu_manager.kubeletplugin.api import nri_pb2
+from vtpu_manager.kubeletplugin.device_state import DeviceState
+from vtpu_manager.kubeletplugin.nri import RuntimeHook
+from vtpu_manager.kubeletplugin import nri_transport as nt
+from vtpu_manager.util import consts, ttrpc
+
+
+def allocated_claim(uid="claim-1"):
+    return {
+        "metadata": {"uid": uid, "name": "c1", "namespace": "ml"},
+        "status": {"allocation": {"devices": {
+            "results": [{"request": "tpu", "driver": consts.DRA_DRIVER_NAME,
+                         "pool": "node-1", "device": "vtpu-0"}],
+            "config": [{"requests": ["tpu"], "opaque": {
+                "driver": consts.DRA_DRIVER_NAME,
+                "parameters": {"cores": 50, "memoryMiB": 2048}}}],
+        }}},
+    }
+
+
+@pytest.fixture
+def loop(tmp_path):
+    """(runtime_conn, plugin, registered) — a registered plugin stub and
+    the fake runtime's end of the connection."""
+    state = DeviceState("node-1", [fake_chip(0)],
+                        base_dir=str(tmp_path / "mgr"),
+                        cdi_dir=str(tmp_path / "cdi"))
+    state.prepare_claim(allocated_claim())
+    hook = RuntimeHook(state)
+    plugin = nt.NriPlugin(
+        hook, claim_uids_for_pod=lambda uid:
+        ["claim-1"] if uid == "pod-1" else [])
+
+    registered = []
+
+    def register(raw: bytes) -> bytes:
+        req = nri_pb2.RegisterPluginRequest.FromString(raw)
+        registered.append((req.plugin_name, req.plugin_idx))
+        return nri_pb2.Empty().SerializeToString()
+
+    sock_path = str(tmp_path / "nri.sock")
+    server = ttrpc.TtrpcServer(sock_path, {
+        (nt.RUNTIME_SERVICE, "RegisterPlugin"): register})
+    plugin_conn = plugin.run(sock_path)
+    deadline = time.time() + 5
+    while not server.connections and time.time() < deadline:
+        time.sleep(0.01)
+    runtime_conn = server.connections[0]
+    yield runtime_conn, plugin, registered
+    plugin_conn.close()
+    server.stop()
+
+
+def call(conn, method, msg, resp_cls):
+    raw = conn.call(nt.PLUGIN_SERVICE, method, msg.SerializeToString())
+    return resp_cls.FromString(raw)
+
+
+class TestLoopback:
+    def test_register_and_configure(self, loop):
+        runtime, plugin, registered = loop
+        assert registered == [("vtpu-manager", "10")]
+        resp = call(runtime, "Configure",
+                    nri_pb2.ConfigureRequest(runtime_name="containerd",
+                                             runtime_version="2.0"),
+                    nri_pb2.ConfigureResponse)
+        assert resp.events & nt.EVENT_CREATE_CONTAINER
+        assert plugin.configured
+
+    def test_create_container_injects(self, loop):
+        runtime, _, _ = loop
+        req = nri_pb2.CreateContainerRequest(
+            pod=nri_pb2.PodSandbox(uid="pod-1", name="p", namespace="ml"),
+            container=nri_pb2.Container(
+                name="main", env=["VTPU_CLAIM_UID=claim-1"]))
+        resp = call(runtime, "CreateContainer", req,
+                    nri_pb2.CreateContainerResponse)
+        env = {e.key: e.value for e in resp.adjust.env}
+        assert env[consts.ENV_REGISTER_UUID] == "claim-1"
+        assert resp.adjust.mounts[0].destination == \
+            f"{consts.MANAGER_BASE_DIR}/config"
+        assert "ro" in resp.adjust.mounts[0].options
+
+    def test_spoofed_claim_fails_closed(self, loop):
+        runtime, _, _ = loop
+        # pod-2 does not own claim-1; the wire call must ERROR, not adjust
+        req = nri_pb2.CreateContainerRequest(
+            pod=nri_pb2.PodSandbox(uid="pod-2"),
+            container=nri_pb2.Container(
+                name="main", env=["VTPU_CLAIM_UID=claim-1"]))
+        with pytest.raises(ttrpc.TtrpcError) as e:
+            call(runtime, "CreateContainer", req,
+                 nri_pb2.CreateContainerResponse)
+        assert "does not own" in str(e.value)
+
+    def test_non_tenant_passthrough(self, loop):
+        runtime, _, _ = loop
+        resp = call(runtime, "CreateContainer",
+                    nri_pb2.CreateContainerRequest(
+                        pod=nri_pb2.PodSandbox(uid="pod-9"),
+                        container=nri_pb2.Container(name="app")),
+                    nri_pb2.CreateContainerResponse)
+        assert not resp.adjust.env and not resp.adjust.mounts
+
+    def test_unknown_method_not_found(self, loop):
+        runtime, _, _ = loop
+        with pytest.raises(ttrpc.TtrpcError) as e:
+            runtime.call(nt.PLUGIN_SERVICE, "NoSuchMethod", b"")
+        assert e.value.code == ttrpc.CODE_NOT_FOUND
+
+    def test_concurrent_calls_multiplex(self, loop):
+        runtime, _, _ = loop
+        import threading
+        results = []
+
+        def one(i):
+            resp = call(runtime, "StateChange",
+                        nri_pb2.StateChangeEvent(event=i),
+                        nri_pb2.Empty)
+            results.append(resp)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 8
+
+
+class TestResolverFailure:
+    def test_lookup_failure_aborts_only_tenants(self, tmp_path):
+        """A broken claim resolver must error only for vtpu tenants —
+        non-tenant containers (NRI sees every container on the node) pass
+        through without ever invoking the resolver."""
+        state = DeviceState("node-1", [fake_chip(0)],
+                            base_dir=str(tmp_path / "mgr2"),
+                            cdi_dir=str(tmp_path / "cdi2"))
+
+        def broken(uid):
+            raise RuntimeError("API server down")
+
+        plugin = nt.NriPlugin(RuntimeHook(state),
+                              claim_uids_for_pod=broken)
+        sock_path = str(tmp_path / "nri2.sock")
+        server = ttrpc.TtrpcServer(sock_path, {
+            (nt.RUNTIME_SERVICE, "RegisterPlugin"):
+                lambda raw: nri_pb2.Empty().SerializeToString()})
+        conn = plugin.run(sock_path)
+        deadline = time.time() + 5
+        while not server.connections and time.time() < deadline:
+            time.sleep(0.01)
+        runtime = server.connections[0]
+        try:
+            # non-tenant: resolver never called, passthrough
+            resp = call(runtime, "CreateContainer",
+                        nri_pb2.CreateContainerRequest(
+                            pod=nri_pb2.PodSandbox(uid="p"),
+                            container=nri_pb2.Container(name="app")),
+                        nri_pb2.CreateContainerResponse)
+            assert not resp.adjust.env
+            # tenant: resolver failure fails closed with a clear message
+            with pytest.raises(ttrpc.TtrpcError) as e:
+                call(runtime, "CreateContainer",
+                     nri_pb2.CreateContainerRequest(
+                         pod=nri_pb2.PodSandbox(uid="p"),
+                         container=nri_pb2.Container(
+                             name="t", env=["VTPU_CLAIM_UID=c1"])),
+                     nri_pb2.CreateContainerResponse)
+            assert "ownership lookup failed" in str(e.value)
+        finally:
+            conn.close()
+            server.stop()

@@ -82,6 +82,20 @@ class DraDriver:
         self.socket_path = os.path.join(plugin_dir, "dra.sock")
         self._server: grpc.Server | None = None
 
+    def claim_uids_for_pod(self, pod_uid: str) -> list[str]:
+        """Prepared claims owned by a pod, resolved through the claims'
+        status.reservedFor — the NRI stub's anti-spoof source of truth
+        (reference: sandbox claim resolution, nri/plugin.go:329)."""
+        out = []
+        # snapshot: DRA prepare/unprepare mutate the dict from gRPC threads
+        for uid, prepared in list(self.state.checkpoint.claims.items()):
+            claim = self.claims.get(uid, prepared.name, prepared.namespace)
+            reserved = ((claim or {}).get("status") or {}).get(
+                "reservedFor") or []
+            if any(ref.get("uid") == pod_uid for ref in reserved):
+                out.append(uid)
+        return out
+
     # -- rpc implementations -----------------------------------------------
 
     def node_prepare(self, request: pb.NodePrepareResourcesRequest,

@@ -1,0 +1,225 @@
+"""Minimal ttrpc transport: the RPC containerd's NRI rides on.
+
+Reference: the NRI plugin (pkg/kubeletplugin/nri/plugin.go:17-479) speaks
+ttrpc to containerd via github.com/containerd/nri/pkg/stub. There is no
+ttrpc implementation in this image, so the transport is implemented from
+the public protocol: each message is a 10-byte big-endian header —
+u32 payload length, u32 stream id, u8 message type (1=request,
+2=response), u8 flags — followed by a protobuf payload (``ttrpc.Request``
+on the way in, ``ttrpc.Response`` on the way out; see api/ttrpc.proto).
+
+Request streams carry odd stream ids from the connection initiator. A
+single connection is full-duplex: both ends may originate requests (NRI
+needs this — the plugin calls Runtime.RegisterPlugin while serving Plugin
+service requests on the same socket), so one Connection object owns the
+socket and dispatches inbound requests to a handler map while matching
+inbound responses to outstanding calls.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Callable
+
+from vtpu_manager.kubeletplugin.api import ttrpc_pb2
+
+log = logging.getLogger(__name__)
+
+_HEADER = struct.Struct(">IIBB")
+MSG_REQUEST = 0x1
+MSG_RESPONSE = 0x2
+MAX_MESSAGE = 4 << 20
+
+# google.rpc codes used on the wire
+CODE_OK = 0
+CODE_UNKNOWN = 2
+CODE_NOT_FOUND = 5
+
+
+class TtrpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"ttrpc error {code}: {message}")
+        self.code = code
+        self.message = message
+
+
+# handler: payload bytes -> response payload bytes (raise TtrpcError to
+# report a status)
+Handler = Callable[[bytes], bytes]
+
+
+class Connection:
+    """One full-duplex ttrpc connection (server and client at once)."""
+
+    def __init__(self, sock: socket.socket,
+                 handlers: dict[tuple[str, str], Handler] | None = None,
+                 initiator: bool = True):
+        self._sock = sock
+        self.handlers = handlers or {}
+        self._write_lock = threading.Lock()
+        self._calls_lock = threading.Lock()
+        self._calls: dict[int, "_PendingCall"] = {}
+        # odd ids for connection initiators, even for acceptors, so the
+        # two directions never collide
+        self._next_stream = 1 if initiator else 2
+        self.closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="ttrpc-read")
+        self._reader.start()
+
+    # -- wire ---------------------------------------------------------------
+
+    def _send(self, stream_id: int, msg_type: int, payload: bytes) -> None:
+        frame = _HEADER.pack(len(payload), stream_id, msg_type, 0) + payload
+        with self._write_lock:
+            self._sock.sendall(frame)
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        while True:
+            head = self._recv_exact(_HEADER.size)
+            if head is None:
+                break
+            length, stream_id, msg_type, _flags = _HEADER.unpack(head)
+            if length > MAX_MESSAGE:
+                log.error("ttrpc frame too large (%d bytes)", length)
+                break
+            payload = self._recv_exact(length)
+            if payload is None:
+                break
+            if msg_type == MSG_REQUEST:
+                threading.Thread(target=self._serve_one,
+                                 args=(stream_id, payload),
+                                 daemon=True).start()
+            elif msg_type == MSG_RESPONSE:
+                self._complete(stream_id, payload)
+        self.closed.set()
+        with self._calls_lock:
+            for call in self._calls.values():
+                call.done.set()
+            self._calls.clear()
+
+    # -- inbound requests ---------------------------------------------------
+
+    def _serve_one(self, stream_id: int, raw: bytes) -> None:
+        resp = ttrpc_pb2.Response()
+        try:
+            req = ttrpc_pb2.Request.FromString(raw)
+            handler = self.handlers.get((req.service, req.method))
+            if handler is None:
+                raise TtrpcError(
+                    CODE_NOT_FOUND, f"{req.service}/{req.method}")
+            resp.payload = handler(req.payload)
+        except TtrpcError as e:
+            resp.status.code = e.code
+            resp.status.message = e.message
+        except Exception as e:   # handler bug must not kill the connection
+            log.exception("ttrpc handler failed")
+            resp.status.code = CODE_UNKNOWN
+            resp.status.message = f"{type(e).__name__}: {e}"
+        try:
+            self._send(stream_id, MSG_RESPONSE, resp.SerializeToString())
+        except OSError:
+            pass
+
+    # -- outbound calls -----------------------------------------------------
+
+    def call(self, service: str, method: str, payload: bytes,
+             timeout_s: float = 10.0) -> bytes:
+        with self._calls_lock:
+            stream_id = self._next_stream
+            self._next_stream += 2
+            pending = _PendingCall()
+            self._calls[stream_id] = pending
+        req = ttrpc_pb2.Request(service=service, method=method,
+                                payload=payload,
+                                timeout_nano=int(timeout_s * 1e9))
+        self._send(stream_id, MSG_REQUEST, req.SerializeToString())
+        if not pending.done.wait(timeout_s):
+            with self._calls_lock:
+                self._calls.pop(stream_id, None)
+            raise TtrpcError(CODE_UNKNOWN, f"{service}/{method} timed out")
+        if pending.raw is None:
+            raise TtrpcError(CODE_UNKNOWN, "connection closed")
+        resp = ttrpc_pb2.Response.FromString(pending.raw)
+        if resp.status.code != CODE_OK:
+            raise TtrpcError(resp.status.code, resp.status.message)
+        return resp.payload
+
+    def _complete(self, stream_id: int, raw: bytes) -> None:
+        with self._calls_lock:
+            call = self._calls.pop(stream_id, None)
+        if call is None:
+            log.warning("ttrpc response for unknown stream %d", stream_id)
+            return
+        call.raw = raw
+        call.done.set()
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class _PendingCall:
+    def __init__(self):
+        self.done = threading.Event()
+        self.raw: bytes | None = None
+
+
+class TtrpcServer:
+    """Unix-socket acceptor: every accepted connection is full-duplex."""
+
+    def __init__(self, path: str,
+                 handlers: dict[tuple[str, str], Handler]):
+        self.path = path
+        self.handlers = handlers
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(8)
+        self.connections: list[Connection] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="ttrpc-accept")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break
+            self.connections.append(
+                Connection(sock, self.handlers, initiator=False))
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in self.connections:
+            conn.close()
+
+
+def dial(path: str, handlers: dict[tuple[str, str], Handler] | None = None
+         ) -> Connection:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(path)
+    return Connection(sock, handlers, initiator=True)
