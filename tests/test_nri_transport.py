@@ -3,9 +3,10 @@ unix socket with real ttrpc framing.
 
 Reference test strategy: pkg/kubeletplugin/nri/plugin_test.go drives the
 plugin through a stubbed NRI runtime (no containerd needed). Here the
-fake runtime end is a TtrpcServer serving Runtime.RegisterPlugin; after
-the plugin registers, the SAME connection (full-duplex) carries the
-runtime's Plugin-service calls back to the stub.
+fake runtime end is a mux-mode TtrpcServer serving
+Runtime.RegisterPlugin; after the plugin registers, the same mux-framed
+socket carries the runtime's Plugin-service calls back to the stub on the
+other channel.
 """
 
 from __future__ import annotations
@@ -45,8 +46,8 @@ def loop(tmp_path):
     state.prepare_claim(allocated_claim())
     hook = RuntimeHook(state)
     plugin = nt.NriPlugin(
-        hook, claim_uids_for_pod=lambda uid:
-        ["claim-1"] if uid == "pod-1" else [])
+        hook, claim_uids_for_pod=lambda pod_uid, claim_uid:
+        ["claim-1"] if pod_uid == "pod-1" else [])
 
     registered = []
 
@@ -57,7 +58,7 @@ def loop(tmp_path):
 
     sock_path = str(tmp_path / "nri.sock")
     server = ttrpc.TtrpcServer(sock_path, {
-        (nt.RUNTIME_SERVICE, "RegisterPlugin"): register})
+        (nt.RUNTIME_SERVICE, "RegisterPlugin"): register}, mux=True)
     plugin_conn = plugin.run(sock_path)
     deadline = time.time() + 5
     while not server.connections and time.time() < deadline:
@@ -154,7 +155,7 @@ class TestResolverFailure:
                             base_dir=str(tmp_path / "mgr2"),
                             cdi_dir=str(tmp_path / "cdi2"))
 
-        def broken(uid):
+        def broken(pod_uid, claim_uid):
             raise RuntimeError("API server down")
 
         plugin = nt.NriPlugin(RuntimeHook(state),
@@ -162,7 +163,8 @@ class TestResolverFailure:
         sock_path = str(tmp_path / "nri2.sock")
         server = ttrpc.TtrpcServer(sock_path, {
             (nt.RUNTIME_SERVICE, "RegisterPlugin"):
-                lambda raw: nri_pb2.Empty().SerializeToString()})
+                lambda raw: nri_pb2.Empty().SerializeToString()},
+            mux=True)
         conn = plugin.run(sock_path)
         deadline = time.time() + 5
         while not server.connections and time.time() < deadline:

@@ -82,13 +82,24 @@ class DraDriver:
         self.socket_path = os.path.join(plugin_dir, "dra.sock")
         self._server: grpc.Server | None = None
 
-    def claim_uids_for_pod(self, pod_uid: str) -> list[str]:
-        """Prepared claims owned by a pod, resolved through the claims'
+    def claim_uids_for_pod(self, pod_uid: str,
+                           claim_uid: str | None = None) -> list[str]:
+        """Claims owned by a pod, resolved through the claims'
         status.reservedFor — the NRI stub's anti-spoof source of truth
-        (reference: sandbox claim resolution, nri/plugin.go:329)."""
+        (reference: sandbox claim resolution, nri/plugin.go:329). With
+        claim_uid the lookup is bounded to that one prepared claim (one
+        API GET per tenant container creation, and an unrelated claim's
+        transient lookup error cannot abort this container)."""
+        if claim_uid is not None:
+            prepared = self.state.checkpoint.claims.get(claim_uid)
+            if prepared is None:
+                return []
+            targets = [(claim_uid, prepared)]
+        else:
+            # snapshot: DRA prepare/unprepare mutate from gRPC threads
+            targets = list(self.state.checkpoint.claims.items())
         out = []
-        # snapshot: DRA prepare/unprepare mutate the dict from gRPC threads
-        for uid, prepared in list(self.state.checkpoint.claims.items()):
+        for uid, prepared in targets:
             claim = self.claims.get(uid, prepared.name, prepared.namespace)
             reserved = ((claim or {}).get("status") or {}).get(
                 "reservedFor") or []

@@ -29,9 +29,10 @@ PLUGIN_SERVICE = "nri.pkg.api.v1alpha1.Plugin"
 RUNTIME_SERVICE = "nri.pkg.api.v1alpha1.Runtime"
 DEFAULT_SOCKET = "/var/run/nri/nri.sock"
 
-# EventMask bits (upstream api: 1-based event enum -> 1<<(event-1))
-EVENT_CREATE_CONTAINER = 1 << 7
-EVENT_STOP_CONTAINER = 1 << 11
+# EventMask bits (upstream api: 1-based Event enum, mask = 1<<(event-1);
+# CREATE_CONTAINER=4, STOP_CONTAINER=10)
+EVENT_CREATE_CONTAINER = 1 << 3
+EVENT_STOP_CONTAINER = 1 << 9
 
 
 def _pod_to_dict(pod: nri_pb2.PodSandbox,
@@ -53,9 +54,12 @@ class NriPlugin:
                  plugin_name: str = "vtpu-manager",
                  plugin_idx: str = "10"):
         self.hook = hook
-        # pod uid -> claim uids owned by the pod; resolved by the driver
-        # (ClaimSource) in production, injectable in tests
-        self.claim_uids_for_pod = claim_uids_for_pod or (lambda uid: [])
+        # (pod uid, claimed uid) -> claim uids owned by the pod; resolved
+        # by the driver (ClaimSource) in production, injectable in tests.
+        # The claimed uid bounds the lookup to the one claim the container
+        # names — never a scan of every prepared claim per container.
+        self.claim_uids_for_pod = claim_uids_for_pod or (
+            lambda pod_uid, claim_uid: [])
         self.plugin_name = plugin_name
         self.plugin_idx = plugin_idx
         self.configured = False
@@ -96,9 +100,10 @@ class NriPlugin:
         # only ever abort vtpu tenant containers — NRI sees every
         # container on the node.
         claim_uids: list[str] = []
-        if RuntimeHook._claimed_uid(container) is not None:
+        claimed = RuntimeHook._claimed_uid(container)
+        if claimed is not None:
             try:
-                claim_uids = self.claim_uids_for_pod(req.pod.uid)
+                claim_uids = self.claim_uids_for_pod(req.pod.uid, claimed)
             except Exception as e:
                 raise ttrpc.TtrpcError(
                     ttrpc.CODE_UNKNOWN,
@@ -136,19 +141,43 @@ class NriPlugin:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def run(self, socket_path: str = DEFAULT_SOCKET) -> ttrpc.Connection:
-        """Dial the runtime, register, and serve until disconnect. Returns
-        the live connection (callers own reconnect policy — the reference
-        escalates to CDI-only operation after repeated disconnects,
-        plugin.go:232)."""
-        conn = ttrpc.dial(socket_path, handlers=self.handlers())
+    def run(self, socket_path: str = DEFAULT_SOCKET) -> "NriSession":
+        """Dial the runtime, register, and serve until disconnect. The NRI
+        socket is mux-framed (ttrpc.Mux): the Plugin service is served on
+        one mux channel while Runtime.RegisterPlugin goes out on the
+        other. Returns the live session (callers own reconnect policy —
+        the reference escalates to CDI-only operation after repeated
+        disconnects, plugin.go:232)."""
+        import socket as socketlib
+        sock = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+        sock.connect(socket_path)
+        mux = ttrpc.Mux(sock)
+        serve_conn = ttrpc.Connection(
+            mux.channel(ttrpc.MUX_PLUGIN_CONN), self.handlers(),
+            initiator=False)
+        call_conn = ttrpc.Connection(
+            mux.channel(ttrpc.MUX_RUNTIME_CONN), initiator=True)
         try:
-            conn.call(RUNTIME_SERVICE, "RegisterPlugin",
-                      nri_pb2.RegisterPluginRequest(
-                          plugin_name=self.plugin_name,
-                          plugin_idx=self.plugin_idx).SerializeToString())
+            call_conn.call(RUNTIME_SERVICE, "RegisterPlugin",
+                           nri_pb2.RegisterPluginRequest(
+                               plugin_name=self.plugin_name,
+                               plugin_idx=self.plugin_idx
+                           ).SerializeToString())
         except Exception:
-            conn.close()
+            mux.close()
             raise
         log.info("registered with NRI runtime at %s", socket_path)
-        return conn
+        return NriSession(mux, serve_conn, call_conn)
+
+
+class NriSession:
+    """The plugin's live NRI attachment: the mux plus both directions."""
+
+    def __init__(self, mux: ttrpc.Mux, serve_conn: ttrpc.Connection,
+                 call_conn: ttrpc.Connection):
+        self.mux = mux
+        self.serve_conn = serve_conn
+        self.call_conn = call_conn
+
+    def close(self) -> None:
+        self.mux.close()

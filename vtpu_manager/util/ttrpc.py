@@ -183,17 +183,158 @@ class _PendingCall:
         self.raw: bytes | None = None
 
 
+# ---------------------------------------------------------------------------
+# Connection multiplexing (NRI socket framing)
+# ---------------------------------------------------------------------------
+
+_MUX_HEADER = struct.Struct(">II")   # connection id, payload length
+
+# NRI's conn ids over the mux (containerd/nri pkg/net/multiplex):
+# plugin-service traffic (runtime calls the plugin) rides one id, the
+# runtime service (plugin calls the runtime) the other.
+MUX_PLUGIN_CONN = 1
+MUX_RUNTIME_CONN = 2
+
+
+class MuxChannel:
+    """Socket-like view of one mux connection id: what Connection needs
+    (recv / sendall / shutdown / close)."""
+
+    def __init__(self, mux: "Mux", conn_id: int):
+        self._mux = mux
+        self.conn_id = conn_id
+        self._buf = b""
+        self._pending: list[bytes] = []
+        self._cv = threading.Condition()
+        self._closed = False
+
+    # reader side: frames delivered by the mux read loop
+    def _deliver(self, payload: bytes) -> None:
+        with self._cv:
+            self._pending.append(payload)
+            self._cv.notify_all()
+
+    def _close_read(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def recv(self, n: int) -> bytes:
+        with self._cv:
+            while not self._buf and not self._pending and not self._closed:
+                self._cv.wait()
+            if not self._buf and self._pending:
+                self._buf = b"".join(self._pending)
+                self._pending.clear()
+            out, self._buf = self._buf[:n], self._buf[n:]
+            return out
+
+    def sendall(self, data: bytes) -> None:
+        self._mux.send(self.conn_id, data)
+
+    def shutdown(self, how: int) -> None:
+        pass   # the mux owns the real socket
+
+    def close(self) -> None:
+        self._close_read()
+
+
+class Mux:
+    """The NRI socket framing: every chunk is prefixed with a 4-byte
+    connection id + 4-byte length, multiplexing independent byte streams
+    (each carrying plain ttrpc) over one unix socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._write_lock = threading.Lock()
+        self._channels: dict[int, MuxChannel] = {}
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="ttrpc-mux")
+        self._reader.start()
+
+    def channel(self, conn_id: int) -> MuxChannel:
+        ch = self._channels.get(conn_id)
+        if ch is None:
+            ch = self._channels[conn_id] = MuxChannel(self, conn_id)
+        return ch
+
+    def send(self, conn_id: int, data: bytes) -> None:
+        frame = _MUX_HEADER.pack(conn_id, len(data)) + data
+        with self._write_lock:
+            self._sock.sendall(frame)
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        while True:
+            head = self._recv_exact(_MUX_HEADER.size)
+            if head is None:
+                break
+            conn_id, length = _MUX_HEADER.unpack(head)
+            if length > MAX_MESSAGE:
+                log.error("mux frame too large (%d bytes)", length)
+                break
+            payload = self._recv_exact(length)
+            if payload is None:
+                break
+            self.channel(conn_id)._deliver(payload)
+        for ch in self._channels.values():
+            ch._close_read()
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class MuxedPeer:
+    """A runtime-side view of one muxed NRI connection: serves inbound
+    requests on the runtime-service channel and originates calls on the
+    plugin-service channel."""
+
+    def __init__(self, sock: socket.socket,
+                 handlers: dict[tuple[str, str], Handler]):
+        self.mux = Mux(sock)
+        self.serve_conn = Connection(self.mux.channel(MUX_RUNTIME_CONN),
+                                     handlers, initiator=False)
+        self._call_conn = Connection(self.mux.channel(MUX_PLUGIN_CONN),
+                                     initiator=True)
+
+    def call(self, service: str, method: str, payload: bytes,
+             timeout_s: float = 10.0) -> bytes:
+        return self._call_conn.call(service, method, payload, timeout_s)
+
+    def close(self) -> None:
+        self.mux.close()
+
+
 class TtrpcServer:
-    """Unix-socket acceptor: every accepted connection is full-duplex."""
+    """Unix-socket acceptor. With ``mux=True`` (the NRI socket shape)
+    every accepted socket is mux-framed into the two NRI channels;
+    otherwise each accepted connection is one full-duplex ttrpc stream."""
 
     def __init__(self, path: str,
-                 handlers: dict[tuple[str, str], Handler]):
+                 handlers: dict[tuple[str, str], Handler],
+                 mux: bool = False):
         self.path = path
         self.handlers = handlers
+        self.mux = mux
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(path)
         self._listener.listen(8)
-        self.connections: list[Connection] = []
+        self.connections: list[Connection | MuxedPeer] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True, name="ttrpc-accept")
@@ -205,8 +346,11 @@ class TtrpcServer:
                 sock, _ = self._listener.accept()
             except OSError:
                 break
-            self.connections.append(
-                Connection(sock, self.handlers, initiator=False))
+            if self.mux:
+                self.connections.append(MuxedPeer(sock, self.handlers))
+            else:
+                self.connections.append(
+                    Connection(sock, self.handlers, initiator=False))
 
     def stop(self) -> None:
         self._stop.set()
