@@ -120,15 +120,17 @@ class TestScaleCorrectness:
 @pytest.mark.skipif(not PERF, reason="VTPU_PERF=1 unlocks the perf matrix")
 class TestPerfMatrix:
     def test_matrix(self):
+        # scenario scale mirrors the reference harness's node axis
+        # (filter_perf_test.go:29-68: 100/1000/5000 nodes); pod counts are
+        # bounded for the 1-CPU CI box — the per-pod latency is the metric
         print("\nnodes  pods  policy   placed  p50ms  p99ms")
-        for n_nodes in (100, 1000):
-            for n_pods in (200,):
-                for policy in ("binpack", "spread"):
-                    res = run_scenario(n_nodes, n_pods, policy)
-                    print(f"{n_nodes:5d} {n_pods:5d}  {policy:8s}"
-                          f"{res['placed']:6d} {res['p50_ms']:6.1f} "
-                          f"{res['p99_ms']:6.1f}")
-                    assert_no_overcommit(res["client"])
+        for n_nodes, n_pods in ((100, 200), (1000, 200), (5000, 200)):
+            for policy in ("binpack", "spread"):
+                res = run_scenario(n_nodes, n_pods, policy)
+                print(f"{n_nodes:5d} {n_pods:5d}  {policy:8s}"
+                      f"{res['placed']:6d} {res['p50_ms']:6.1f} "
+                      f"{res['p99_ms']:6.1f}")
+                assert_no_overcommit(res["client"])
 
 def test_topology_pod_schedulable_beyond_candidate_limit():
     """The top-K capacity rank must not reject a pod whose only feasible
