@@ -300,6 +300,21 @@ bool PidIsSelf(int pid) {
 
 }  // namespace
 
+// Dead-entry staleness window (shared contract with Python's
+// VTPU_VMEM_STALE_S): a dead-looking pid is only ignored/reaped once its
+// entry also went stale, since foreign pid namespaces are unprobeable.
+uint64_t StaleReapNs() {
+  static uint64_t ns = [] {
+    const char* v = getenv("VTPU_VMEM_STALE_S");
+    double s = v ? atof(v) : 120.0;
+    if (!(s > 0)) s = 120.0;       // catches 0, negatives and NaN
+    if (s > 1e10) s = 1e10;        // clamp BEFORE the fp->int conversion
+                                   // (overflow there is UB)
+    return (uint64_t)(s * 1e9);
+  }();
+  return ns;
+}
+
 // One ledger scan, two sums: bytes held by OUR tenant's other processes
 // (they share our cap) and bytes held by other tenants (they only matter
 // against the chip's physical HBM).
@@ -320,8 +335,7 @@ LedgerBytes ScanLedgerBytes(int slot) {
     if (self_tenant && e.pid == me) continue;  // own hot-counter covers me
     // liveness of a foreign namespace's pid is unknowable: count the
     // entry unless it has also gone stale (the daemon reaps those)
-    if (!PidAlive(e.pid) &&
-        now - e.last_update_ns > 120ull * 1000 * 1000 * 1000)
+    if (!PidAlive(e.pid) && now - e.last_update_ns > StaleReapNs())
       continue;
     (self_tenant ? out.siblings : out.others) += (int64_t)e.bytes;
   }

@@ -198,3 +198,61 @@ class TestHbmCoTenancy:
         assert proc.returncode != 0
         assert "HBM cap exceeded" in proc.stdout or \
             "FAIL" in proc.stdout, proc.stdout
+
+
+def test_killed_tenant_entry_reaped_and_capacity_recovered(
+        shim_build, tmp_path, monkeypatch):
+    """Failure recovery: a tenant killed -9 skips the shim's destructor and
+    leaves its ledger entry behind. Once the entry goes stale (pid dead in
+    our namespace AND past VTPU_VMEM_STALE_S) the admission path stops
+    charging its bytes against physical HBM and the daemon reaps the slot.
+    Reference: dead-pid cleanup, loader.c:1825-1978."""
+    import signal
+    monkeypatch.setenv("VTPU_VMEM_STALE_S", "1")
+    shared = str(tmp_path / "chip.state")
+    with open(shared, "wb") as f:
+        f.write(b"\0" * 16)
+    VmemLedger(str(tmp_path / "vmem.config"), create=True).close()
+
+    # tenant A: long-running full-mode (allocates ~1 MiB then throttles)
+    env_a = tenant_env(tmp_path, "uid-a", 50, 2000, shared,
+                       extra={"VTPU_MEM_LIMIT_0": str(1 << 20),
+                              "VTPU_MEM_REAL_0": str(3 << 19),
+                              "VTPU_VMEM_STALE_S": "1"})
+    proc_a = subprocess.Popen([os.path.join(BUILD, "shim_test")], env=env_a,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    led = VmemLedger(str(tmp_path / "vmem.config"))
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if any(e.bytes > 0 for e in led.entries()):
+                break
+            time.sleep(0.02)
+        assert any(e.bytes > 0 for e in led.entries()), "A never recorded"
+
+        proc_a.send_signal(signal.SIGKILL)
+        proc_a.wait(timeout=10)
+        # entry survives the kill (no destructor ran)
+        assert led.entries(), "entry vanished without destructor?"
+
+        time.sleep(1.2)   # staleness window
+        # the daemon's sweep reaps the dead+stale slot
+        assert led.reap_dead() >= 1
+        assert led.entries() == []
+        # admission view agrees: no ghost bytes
+        assert led.device_total(0) == 0
+    finally:
+        led.close()
+        if proc_a.poll() is None:
+            proc_a.kill()
+
+    # tenant B now fits where A's ghost would have blocked it
+    # (phys 1.5 MiB: A's 1 MiB ghost + B's 768 KiB would exceed)
+    env_b = tenant_env(tmp_path, "uid-b", 50, 50, shared,
+                       extra={"VTPU_MEM_LIMIT_0": str(1 << 20),
+                              "VTPU_MEM_REAL_0": str(3 << 19),
+                              "VTPU_VMEM_STALE_S": "1"})
+    proc_b = subprocess.run([os.path.join(BUILD, "shim_test")], env=env_b,
+                            capture_output=True, text=True, timeout=300)
+    assert proc_b.returncode == 0, proc_b.stdout + proc_b.stderr

@@ -26,7 +26,22 @@ from vtpu_manager.util.flock import FileLock
 MAGIC = 0x4D454D56          # "VMEM"
 VERSION = 2
 MAX_ENTRIES = 1024
-STALE_REAP_NS = 120 * 10**9
+
+
+def _stale_reap_ns() -> int:
+    """Dead-entry staleness window. A pid that looks dead in OUR
+    namespace is only reaped once its entry also went stale (foreign
+    pid namespaces are unprobeable). Env-tunable so failure-recovery
+    tests do not wait two minutes; the C++ shim reads the same var with
+    the same clamping (<=0 or unparsable -> 120s, huge -> capped)."""
+    try:
+        s = float(os.environ.get("VTPU_VMEM_STALE_S", "120"))
+    except ValueError:
+        s = 120.0
+    if not s > 0:          # catches 0, negatives and NaN
+        s = 120.0
+    s = min(s, 1e10)       # ~317 years: effectively never, still finite
+    return int(s * 1e9)
 
 _HEADER_FMT = "<IIii"       # magic, version, max_entries, pad
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
@@ -179,6 +194,7 @@ class VmemLedger:
         pid namespace cannot be probed, so staleness is the arbiter."""
         total = 0
         now = time.monotonic_ns()
+        stale_ns = _stale_reap_ns()
         with self._lock:
             for i in range(MAX_ENTRIES):
                 e = self._entry(i)
@@ -190,7 +206,7 @@ class VmemLedger:
                         e.owner_token == exclude_token:
                     continue
                 if not _pid_alive(e.pid) and \
-                        now - e.last_update_ns > STALE_REAP_NS:
+                        now - e.last_update_ns > stale_ns:
                     self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                     continue
                 total += e.bytes
@@ -235,10 +251,11 @@ class VmemLedger:
     def _reap_locked(self) -> int:
         reaped = 0
         now = time.monotonic_ns()
+        stale_ns = _stale_reap_ns()
         for i in range(MAX_ENTRIES):
             e = self._entry(i)
             if e.pid != 0 and not _pid_alive(e.pid) and \
-                    now - e.last_update_ns > STALE_REAP_NS:
+                    now - e.last_update_ns > stale_ns:
                 self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                 reaped += 1
         return reaped
