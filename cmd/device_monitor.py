@@ -21,6 +21,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--node-name",
                         default=os.environ.get("NODE_NAME", ""))
     parser.add_argument("--fake-chips", type=int, default=0)
+    from vtpu_manager.util import consts
+    parser.add_argument("--base-dir", default=consts.MANAGER_BASE_DIR,
+                        help="container-config root (default: %(default)s)")
+    parser.add_argument("--tc-path", default=consts.TC_UTIL_CONFIG)
+    parser.add_argument("--vmem-path", default=consts.VMEM_NODE_CONFIG)
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -37,7 +42,9 @@ def main(argv: list[str] | None = None) -> int:
         else None
     result = discover(backends)
     chips = result.chips if result else []
-    collector = NodeCollector(args.node_name or "unknown", chips)
+    collector = NodeCollector(
+        args.node_name or "unknown", chips, base_dir=args.base_dir,
+        tc_path=args.tc_path, vmem_path=args.vmem_path)
 
     async def metrics(request):
         return web.Response(text=collector.render(),

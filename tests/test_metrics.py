@@ -3,7 +3,7 @@
 import os
 
 from vtpu_manager.config import tc_watcher, vtpu_config as vc
-from vtpu_manager.config.vmem import VmemLedger
+from vtpu_manager.config.vmem import VmemLedger, fnv64
 from vtpu_manager.device.types import fake_chip
 from vtpu_manager.metrics.collector import NodeCollector
 
@@ -24,12 +24,18 @@ def test_collector_renders_gauges(tmp_path):
     # watcher feed + ledger
     tc_path = str(tmp_path / "tc_util.config")
     tc = tc_watcher.TcUtilFile(tc_path, create=True)
-    tc.write_device(0, tc_watcher.DeviceUtil(timestamp_ns=1,
-                                             device_util=37))
+    tc.write_device(0, tc_watcher.DeviceUtil(
+        timestamp_ns=1, device_util=37,
+        procs=[tc_watcher.ProcUtil(pid=os.getpid(), util=29,
+                                   mem_used=123456,
+                                   owner_token=fnv64("uid-1/main"))]))
     tc.close()
     vmem_path = str(tmp_path / "vmem.config")
     led = VmemLedger(vmem_path, create=True)
-    led.record(os.getpid(), 0, 123456)
+    led.record(os.getpid(), 0, 123456, owner_token=fnv64("uid-1/main"))
+    # a co-tenant's bytes on the same chip must NOT appear in uid-1's gauge
+    led.record(os.getpid() + 1, 0, 999999,
+               owner_token=fnv64("uid-other/main"))
     led.close()
 
     text = NodeCollector("n1", chips, base_dir=base, tc_path=tc_path,
@@ -38,6 +44,12 @@ def test_collector_renders_gauges(tmp_path):
         in text
     assert 'vtpu_device_utilization_percent{node="n1",' \
         'uuid="TPU-FAKE-0000",index="0"} 37.0' in text
+    assert 'vtpu_container_utilization_percent{node="n1",' \
+        'pod_uid="uid-1",container="main",uuid="TPU-FAKE-0000"} 29.0' \
+        in text
+    # per-tenant attribution: only uid-1's own bytes, not the chip total
+    assert 'vtpu_container_memory_used_bytes{node="n1",pod_uid="uid-1",' \
+        'container="main",uuid="TPU-FAKE-0000"} 123456.0' in text
     assert 'vtpu_container_core_limit_percent{node="n1",pod_uid="uid-1",' \
         'container="main",uuid="TPU-FAKE-0000"} 40.0' in text
     assert 'vtpu_container_memory_used_bytes' in text
@@ -51,3 +63,45 @@ def test_collector_empty_node(tmp_path):
                          tc_path="/nonexistent",
                          vmem_path="/nonexistent").render()
     assert "vtpu_node_slots_total" in text
+
+def test_multi_chip_container_rows_stay_per_device(tmp_path):
+    """A container spanning two chips must report each chip's own bytes
+    and util share — not a cross-device sum duplicated on every row."""
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0), fake_chip(1)]
+    cont_dir = os.path.join(base, "uid-1_main", "config")
+    os.makedirs(cont_dir)
+    vc.write_config(os.path.join(cont_dir, "vtpu.config"), vc.VtpuConfig(
+        pod_uid="uid-1", container_name="main",
+        devices=[
+            vc.DeviceConfig(uuid=chips[0].uuid, total_memory=2**30,
+                            real_memory=chips[0].memory, host_index=0),
+            vc.DeviceConfig(uuid=chips[1].uuid, total_memory=2**30,
+                            real_memory=chips[1].memory, host_index=1),
+        ]))
+    token = fnv64("uid-1/main")
+    tc_path = str(tmp_path / "tc.config")
+    tc = tc_watcher.TcUtilFile(tc_path, create=True)
+    tc.write_device(0, tc_watcher.DeviceUtil(
+        timestamp_ns=1, device_util=60,
+        procs=[tc_watcher.ProcUtil(7, 60, 0, token)]))
+    tc.write_device(1, tc_watcher.DeviceUtil(
+        timestamp_ns=1, device_util=25,
+        procs=[tc_watcher.ProcUtil(7, 25, 0, token)]))
+    tc.close()
+    vmem_path = str(tmp_path / "vmem.config")
+    led = VmemLedger(vmem_path, create=True)
+    led.record(os.getpid(), 0, 111, owner_token=token)
+    led.record(os.getpid(), 1, 222, owner_token=token)
+    led.close()
+
+    text = NodeCollector("n1", chips, base_dir=base, tc_path=tc_path,
+                         vmem_path=vmem_path).render()
+    assert 'vtpu_container_memory_used_bytes{node="n1",pod_uid="uid-1",' \
+        f'container="main",uuid="{chips[0].uuid}"}} 111.0' in text
+    assert 'vtpu_container_memory_used_bytes{node="n1",pod_uid="uid-1",' \
+        f'container="main",uuid="{chips[1].uuid}"}} 222.0' in text
+    assert 'vtpu_container_utilization_percent{node="n1",pod_uid="uid-1",' \
+        f'container="main",uuid="{chips[0].uuid}"}} 60.0' in text
+    assert 'vtpu_container_utilization_percent{node="n1",pod_uid="uid-1",' \
+        f'container="main",uuid="{chips[1].uuid}"}} 25.0' in text

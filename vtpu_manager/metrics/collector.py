@@ -17,7 +17,7 @@ import os
 
 from vtpu_manager.config import vtpu_config as vc
 from vtpu_manager.config.tc_watcher import TcUtilFile
-from vtpu_manager.config.vmem import VmemLedger
+from vtpu_manager.config.vmem import VmemLedger, fnv64
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.util import consts
 
@@ -95,7 +95,11 @@ class NodeCollector:
             g_slots_total.set(labels, float(chip.split_count))
         gauges += [g_mem_total, g_healthy, g_slots_total]
 
-        # node watcher feed
+        # node watcher feed: chip duty cycle + per-tenant attributed
+        # shares (the watcher apportions by ledger submit-activity
+        # deltas). Keyed per (tenant, chip): ProcUtil.util is percent OF
+        # ONE CHIP — summing across chips would exceed 100.
+        util_by_token: dict[tuple[int, int], int] = {}
         try:
             tc = TcUtilFile(self.tc_path)
             for chip in self.chips:
@@ -103,6 +107,10 @@ class NodeCollector:
                 if rec is not None:
                     g_util.set((self.node_name, chip.uuid, str(chip.index)),
                                float(rec.device_util))
+                    for proc in rec.procs:
+                        key = (proc.owner_token, chip.index)
+                        util_by_token[key] = \
+                            util_by_token.get(key, 0) + proc.util
             tc.close()
         except (OSError, ValueError):
             pass
@@ -118,6 +126,9 @@ class NodeCollector:
         g_musage = Gauge("vtpu_container_memory_used_bytes",
                          "HBM bytes recorded by the container's processes",
                          ("node", "pod_uid", "container", "uuid"))
+        g_cutil = Gauge("vtpu_container_utilization_percent",
+                        "Chip duty-cycle share attributed to the container",
+                        ("node", "pod_uid", "container", "uuid"))
         g_assigned = Gauge("vtpu_device_assigned_containers",
                            "Containers sharing each chip",
                            ("node", "uuid"))
@@ -127,24 +138,32 @@ class NodeCollector:
             vmem = VmemLedger(self.vmem_path)
         except (OSError, ValueError):
             pass
-        per_device_usage: dict[int, int] = {}
+        # per-(tenant, chip) attribution: ledger entries carry the owner
+        # token (fnv64 of pod_uid/container) AND the chip, so co-tenants
+        # are never conflated and a multi-chip container's rows stay
+        # per-device (a token-only sum would double every uuid row)
+        usage_by_token: dict[tuple[int, int], int] = {}
         if vmem is not None:
             for entry in vmem.entries():
-                per_device_usage[entry.host_index] = \
-                    per_device_usage.get(entry.host_index, 0) + entry.bytes
+                key = (entry.owner_token, entry.host_index)
+                usage_by_token[key] = \
+                    usage_by_token.get(key, 0) + entry.bytes
         for pod_uid, container, cfg in self._container_configs():
+            token = fnv64(f"{pod_uid}/{container}")
             for dev in cfg.devices:
                 labels = (self.node_name, pod_uid, container, dev.uuid)
                 g_climit.set(labels, float(dev.hard_core))
                 g_mlimit.set(labels, float(dev.total_memory))
-                g_musage.set(labels,
-                             float(per_device_usage.get(dev.host_index, 0)))
+                g_musage.set(labels, float(
+                    usage_by_token.get((token, dev.host_index), 0)))
+                g_cutil.set(labels, float(
+                    util_by_token.get((token, dev.host_index), 0)))
                 assigned[dev.uuid] = assigned.get(dev.uuid, 0) + 1
         if vmem is not None:
             vmem.close()
         for uuid, count in assigned.items():
             g_assigned.set((self.node_name, uuid), float(count))
-        gauges += [g_climit, g_mlimit, g_musage, g_assigned]
+        gauges += [g_climit, g_mlimit, g_musage, g_cutil, g_assigned]
 
         # node aggregates
         g_total = Gauge("vtpu_node_slots_total", "Node vTPU slot capacity",
