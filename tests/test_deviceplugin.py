@@ -272,3 +272,92 @@ class TestHealthReAdvertisement:
                 assert len(sick) == 4   # all slots of the flipped chip
         finally:
             server.stop()
+
+
+class TestKubeletE2E:
+    """Over-the-socket kubelet flow: a fake kubelet Registration gRPC
+    server receives the plugin's Register, then a client consumes
+    ListAndWatch and calls Allocate through the plugin's own socket —
+    the full transport the kubelet exercises (reference main.go
+    serve/register/restart loop)."""
+
+    def test_register_listandwatch_allocate_and_restart(self, plugin,
+                                                        tmp_path):
+        import threading
+        import time as _time
+
+        import grpc
+
+        p, client, mgr = plugin
+        plugin_dir = str(tmp_path / "kubelet-plugins")
+        os.makedirs(plugin_dir)
+        kubelet_sock = os.path.join(plugin_dir, "kubelet.sock")
+
+        registrations = []
+
+        def register(request, context):
+            registrations.append((request.resource_name, request.endpoint,
+                                  request.version))
+            return pb.Empty()
+
+        from concurrent import futures
+
+        from vtpu_manager.util.grpcutil import unary
+
+        def kubelet_server():
+            s = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+            s.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    "v1beta1.Registration", {
+                        "Register": unary(register, pb.RegisterRequest,
+                                          pb.Empty)}),))
+            s.add_insecure_port(f"unix://{kubelet_sock}")
+            s.start()
+            return s
+
+        kubelet = kubelet_server()
+        server = PluginServer(p, plugin_dir=plugin_dir,
+                              kubelet_socket=kubelet_sock)
+        try:
+            server.serve()
+            server.register()
+            assert registrations and \
+                registrations[0][0] == p.resource_name
+
+            with grpc.insecure_channel(
+                    f"unix://{server.socket_path}") as chan:
+                law = chan.unary_stream(
+                    "/v1beta1.DevicePlugin/ListAndWatch",
+                    request_serializer=pb.Empty.SerializeToString,
+                    response_deserializer=
+                    pb.ListAndWatchResponse.FromString)
+                stream = law(pb.Empty(), timeout=10)
+                first = next(iter(stream))
+                assert len(first.devices) == 8    # 2 chips x 4 slots
+
+                client.add_pod(committed_pod(mgr))
+                alloc = chan.unary_unary(
+                    "/v1beta1.DevicePlugin/Allocate",
+                    request_serializer=pb.AllocateRequest.SerializeToString,
+                    response_deserializer=pb.AllocateResponse.FromString)
+                chip = mgr.chips[0]
+                resp = alloc(pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(
+                        devicesIDs=[device_id(chip.uuid, 0)])]), timeout=10)
+                env = resp.container_responses[0].envs
+                assert "VTPU_MEM_LIMIT_0" in env
+
+            # kubelet restart: recreate the socket -> plugin re-registers.
+            # The watcher latches the inode on its first poll, so it must
+            # be running before the restart happens (as in production).
+            server.watch_kubelet_restarts(poll_s=0.05)
+            _time.sleep(0.2)             # let it latch the old inode
+            kubelet.stop(grace=0)        # grpc removes the socket file
+            kubelet = kubelet_server()   # recreates it: new inode
+            deadline = _time.time() + 10
+            while len(registrations) < 2 and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert len(registrations) >= 2, "no re-registration"
+        finally:
+            server.stop()
+            kubelet.stop(grace=0)

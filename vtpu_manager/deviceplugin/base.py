@@ -147,22 +147,30 @@ class PluginServer:
         inotify deps)."""
 
         def loop():
-            last_ino = None
+            last_id = None
             while not self._stop.wait(poll_s):
                 try:
-                    ino = os.stat(self.kubelet_socket).st_ino
+                    st = os.stat(self.kubelet_socket)
                 except OSError:
                     continue
-                if last_ino is None:
-                    last_ino = ino
+                # inode alone is not enough: a recreated socket can reuse
+                # the freed inode number (observed on tmpfs); the creation
+                # time disambiguates
+                sock_id = (st.st_ino, st.st_ctime_ns)
+                if last_id is None:
+                    last_id = sock_id
                     continue
-                if ino != last_ino:
-                    last_ino = ino
+                if sock_id != last_id:
                     log.warning("kubelet restarted; re-registering")
                     try:
                         self.register()
+                        # only remember the new socket once registration
+                        # succeeded — a kubelet whose Registration service
+                        # is not up yet must be retried on the next poll,
+                        # or the plugin silently vanishes from allocatable
+                        last_id = sock_id
                     except grpc.RpcError:
-                        log.error("re-registration failed")
+                        log.error("re-registration failed; will retry")
 
         threading.Thread(target=loop, daemon=True,
                          name="vtpu-kubelet-watch").start()
