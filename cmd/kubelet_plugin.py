@@ -103,6 +103,23 @@ def main(argv: list[str] | None = None) -> int:
         published = publish_resource_slice(client, rs)
         log.info("ResourceSlice published: %s", published)
 
+    # health flips republish the slice so new claims avoid sick chips
+    # (reference: device_health.go -> DeviceTaints)
+    from vtpu_manager.kubeletplugin.health import DraHealthWatcher
+
+    def republish(updated):
+        if client is not None:
+            publish_resource_slice(
+                client, build_resource_slice(args.node_name, updated))
+
+    def device_node_probe(chip):
+        if args.fake_chips:
+            return chip.healthy     # fakes have no device nodes
+        return os.path.exists(f"/dev/accel{chip.index}")
+
+    health = DraHealthWatcher(chips, device_node_probe, republish)
+    health.start()
+
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -111,6 +128,7 @@ def main(argv: list[str] | None = None) -> int:
         while not stop:
             time.sleep(1)
     finally:
+        health.stop()
         driver.stop()
         if registration is not None:
             registration.stop()

@@ -347,11 +347,10 @@ class TestKubeletE2E:
                 env = resp.container_responses[0].envs
                 assert "VTPU_MEM_LIMIT_0" in env
 
-            # kubelet restart: recreate the socket -> plugin re-registers.
-            # The watcher latches the inode on its first poll, so it must
-            # be running before the restart happens (as in production).
+            # kubelet restart: recreate the socket -> plugin re-registers
+            # (the watcher latches the current socket synchronously at
+            # start, so no sleep is needed before the restart)
             server.watch_kubelet_restarts(poll_s=0.05)
-            _time.sleep(0.2)             # let it latch the old inode
             kubelet.stop(grace=0)        # grpc removes the socket file
             kubelet = kubelet_server()   # recreates it: new inode
             deadline = _time.time() + 10

@@ -295,3 +295,66 @@ class TestResourceSlice:
         counters = rs["spec"]["sharedCounters"]
         assert counters[0]["name"] == "chip-0"
         assert counters[0]["counters"]["coreRatio"]["value"] == "100"
+
+class TestDraHealth:
+    def test_flip_republishes_slice(self, state):
+        from vtpu_manager.kubeletplugin.allocatable import \
+            build_resource_slice
+        from vtpu_manager.kubeletplugin.health import DraHealthWatcher
+        chips = [fake_chip(0), fake_chip(1)]
+        published = []
+        bad: set[str] = set()
+        watcher = DraHealthWatcher(
+            chips, probe=lambda c: c.uuid not in bad,
+            on_change=lambda cs: published.append(
+                build_resource_slice("node-1", cs)))
+
+        assert watcher.check_once() == []          # all healthy: no-op
+        assert published == []
+
+        bad.add(chips[0].uuid)
+        assert [c.uuid for c in watcher.check_once()] == [chips[0].uuid]
+        devices = published[-1]["spec"]["devices"]
+        by_health = {}
+        for d in devices:
+            chip_healthy = d["basic"]["attributes"]["healthy"]["bool"]
+            by_health.setdefault(chip_healthy, 0)
+            by_health[chip_healthy] += 1
+        assert by_health[False] > 0 and by_health[True] > 0
+
+        bad.clear()
+        watcher.check_once()                       # recovery
+        devices = published[-1]["spec"]["devices"]
+        assert all(d["basic"]["attributes"]["healthy"]["bool"]
+                   for d in devices)
+
+    def test_probe_exception_is_unhealthy(self, state):
+        from vtpu_manager.kubeletplugin.health import DraHealthWatcher
+        chips = [fake_chip(0)]
+        seen = []
+        watcher = DraHealthWatcher(
+            chips, probe=lambda c: (_ for _ in ()).throw(OSError("io")),
+            on_change=seen.append)
+        watcher.check_once()
+        assert not chips[0].healthy and seen
+
+
+    def test_failed_republish_retried_next_poll(self, state):
+        from vtpu_manager.kubeletplugin.health import DraHealthWatcher
+        chips = [fake_chip(0)]
+        calls = []
+
+        def flaky_publish(cs):
+            calls.append(len(cs))
+            return len(calls) > 1     # first publish fails
+
+        bad = {chips[0].uuid}
+        watcher = DraHealthWatcher(chips,
+                                   probe=lambda c: c.uuid not in bad,
+                                   on_change=flaky_publish)
+        watcher.check_once()          # flip + failed publish
+        assert calls == [1] and watcher._dirty
+        watcher.check_once()          # no new flip, but dirty -> retried
+        assert calls == [1, 1] and not watcher._dirty
+        watcher.check_once()          # clean: no further publishes
+        assert calls == [1, 1]

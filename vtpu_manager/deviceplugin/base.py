@@ -146,8 +146,16 @@ class PluginServer:
         + SIGHUP restart loop, main.go:172-230; polling works without
         inotify deps)."""
 
+        # latch the current socket identity synchronously: a restart in
+        # the window before the thread's first poll must not pass unseen
+        try:
+            st = os.stat(self.kubelet_socket)
+            initial_id = (st.st_ino, st.st_ctime_ns)
+        except OSError:
+            initial_id = None
+
         def loop():
-            last_id = None
+            last_id = initial_id
             while not self._stop.wait(poll_s):
                 try:
                     st = os.stat(self.kubelet_socket)

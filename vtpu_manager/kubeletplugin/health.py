@@ -1,0 +1,105 @@
+"""DRA-side device health: probe chips, republish the ResourceSlice.
+
+Reference: pkg/kubeletplugin/device_health.go:1-453 — the DRA driver
+watches NVML health events and updates device taints so the scheduler
+steers new claims away from sick devices. TPU edition: health is probed
+(device node presence / pluggable callback), and a flip republishes the
+node's ResourceSlice with the refreshed per-device ``healthy`` attribute
+— DeviceClass selectors (`device.attributes["healthy"].BoolValue ==
+true`) then exclude sick chips from new allocations. Existing claims are
+untouched (the reschedule controller owns eviction).
+
+The probe/flip loop itself is manager.HealthWatcher — one
+implementation for both the device-plugin and DRA paths; this module
+only supplies the flip target (a plain chip list instead of a
+DeviceManager) and the publish-with-retry policy.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import replace
+from typing import Callable
+
+from vtpu_manager.device.types import ChipSpec
+from vtpu_manager.manager.device_manager import HealthWatcher
+
+log = logging.getLogger(__name__)
+
+
+class _ChipListTarget:
+    """The HealthWatcher flip interface (chips / mark_unhealthy /
+    mark_healthy) over a bare chip list."""
+
+    def __init__(self, chips: list[ChipSpec]):
+        self.chips = chips
+        self.flipped: list[ChipSpec] = []
+
+    def _flip(self, uuid: str, healthy: bool) -> None:
+        for i, chip in enumerate(self.chips):
+            if chip.uuid == uuid and chip.healthy != healthy:
+                self.chips[i] = replace(chip, healthy=healthy)
+                self.flipped.append(self.chips[i])
+                log.log(logging.INFO if healthy else logging.ERROR,
+                        "device %s -> %s", uuid,
+                        "healthy" if healthy else "UNHEALTHY")
+
+    def mark_unhealthy(self, uuid: str) -> None:
+        self._flip(uuid, False)
+
+    def mark_healthy(self, uuid: str) -> None:
+        self._flip(uuid, True)
+
+    def take_flips(self) -> list[ChipSpec]:
+        out, self.flipped = self.flipped, []
+        return out
+
+
+class DraHealthWatcher:
+    """Polls chip health; flips mutate the shared chip list in place and
+    fire on_change with the updated list. A failed on_change (falsy
+    return or exception) stays dirty and is retried on every later poll
+    — the cluster-visible slice must not stay stale just because the API
+    server blinked during the flip."""
+
+    def __init__(self, chips: list[ChipSpec],
+                 probe: Callable[[ChipSpec], bool],
+                 on_change: Callable[[list[ChipSpec]], object],
+                 interval_s: float = 10.0):
+        self.chips = chips
+        self.on_change = on_change
+        self.interval_s = interval_s
+        self._target = _ChipListTarget(chips)
+        self._watcher = HealthWatcher(self._target, probe,
+                                      interval_s=interval_s)
+        self._dirty = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def check_once(self) -> list[ChipSpec]:
+        """One probe pass; returns the chips that flipped."""
+        self._watcher.check_once()
+        flipped = self._target.take_flips()
+        if flipped:
+            self._dirty = True
+        if self._dirty:
+            try:
+                ok = self.on_change(list(self.chips))
+                self._dirty = ok is False
+            except Exception:
+                log.exception("health on_change failed; will retry")
+                self._dirty = True
+        return flipped
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.check_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtpu-dra-health")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -139,9 +139,13 @@ class HealthWatcher:
     (health.go:28-264). TPU has no XID stream; health here is probed: a
     chip is unhealthy when its device node vanishes or the probe callback
     reports failure. Pluggable probe so tests inject faults.
+
+    ``manager`` is structural: anything with a ``chips`` list and
+    ``mark_unhealthy``/``mark_healthy`` — a DeviceManager here, a plain
+    chip-list target in the DRA path (kubeletplugin.health).
     """
 
-    def __init__(self, manager: DeviceManager,
+    def __init__(self, manager,
                  probe: Callable[[ChipSpec], bool],
                  interval_s: float = 10.0):
         self.manager = manager
