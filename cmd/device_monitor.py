@@ -26,6 +26,8 @@ def main(argv: list[str] | None = None) -> int:
                         help="container-config root (default: %(default)s)")
     parser.add_argument("--tc-path", default=consts.TC_UTIL_CONFIG)
     parser.add_argument("--vmem-path", default=consts.VMEM_NODE_CONFIG)
+    parser.add_argument("--debug-endpoints", action="store_true",
+                        help="expose /debug/stacks (thread dumps)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -56,6 +58,11 @@ def main(argv: list[str] | None = None) -> int:
     app = web.Application()
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
+    if args.debug_endpoints:
+        # stack traces disclose internals; opt-in only (the reference's
+        # metrics server is auth-filtered for the same reason)
+        from vtpu_manager.util.debug import aiohttp_stacks_handler
+        app.router.add_get("/debug/stacks", aiohttp_stacks_handler)
     logging.getLogger(__name__).info("vtpu-monitor on %s:%d", args.host,
                                      args.port)
     web.run_app(app, host=args.host, port=args.port, print=None)

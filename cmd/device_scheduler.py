@@ -29,6 +29,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--fake-client", action="store_true",
                         help="serve a synthetic 2-node cluster (smoke tests)")
     parser.add_argument("--fake-chips", type=int, default=4)
+    parser.add_argument("--debug-endpoints", action="store_true",
+                        help="expose /debug/stacks (thread dumps)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -63,7 +65,8 @@ def main(argv: list[str] | None = None) -> int:
         FilterPredicate(client,
                         require_node_label=args.require_node_label),
         BindPredicate(client, locker=bind_locker),
-        PreemptPredicate(client))
+        PreemptPredicate(client),
+        debug_endpoints=args.debug_endpoints)
 
     ssl_ctx = None
     if args.cert_file and args.key_file:

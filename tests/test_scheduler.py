@@ -295,7 +295,7 @@ class TestHTTPRoutes:
     def _api(self, cluster):
         from vtpu_manager.scheduler.routes import SchedulerAPI
         return SchedulerAPI(FilterPredicate(cluster), BindPredicate(cluster),
-                            PreemptPredicate(cluster))
+                            PreemptPredicate(cluster), debug_endpoints=True)
 
     def test_filter_endpoint(self, cluster):
         import asyncio
@@ -343,6 +343,18 @@ class TestHTTPRoutes:
                 assert version["version"] and version["uptime_s"] >= 0
                 metrics = await (await http.get("/metrics")).text()
                 assert 'endpoint="preempt"} 1' in metrics
+
+        asyncio.run(scenario())
+
+    def test_debug_stacks_endpoint(self, cluster):
+        import asyncio
+        from aiohttp.test_utils import TestClient, TestServer
+        api = self._api(cluster)
+
+        async def scenario():
+            async with TestClient(TestServer(api.build_app())) as client:
+                text = await (await client.get("/debug/stacks")).text()
+                assert "--- thread MainThread" in text
 
         asyncio.run(scenario())
 

@@ -26,10 +26,12 @@ VERSION = "0.1.0"
 
 class SchedulerAPI:
     def __init__(self, filter_pred: FilterPredicate, bind_pred: BindPredicate,
-                 preempt_pred: PreemptPredicate):
+                 preempt_pred: PreemptPredicate,
+                 debug_endpoints: bool = False):
         self.filter_pred = filter_pred
         self.bind_pred = bind_pred
         self.preempt_pred = preempt_pred
+        self.debug_endpoints = debug_endpoints
         self.stats = {"filter": 0, "bind": 0, "preempt": 0, "errors": 0}
         self._started = time.time()
 
@@ -42,6 +44,10 @@ class SchedulerAPI:
         app.router.add_get("/readyz", self.handle_healthz)
         app.router.add_get("/version", self.handle_version)
         app.router.add_get("/metrics", self.handle_metrics)
+        if self.debug_endpoints:
+            # stack traces disclose internals; opt-in only
+            from vtpu_manager.util.debug import aiohttp_stacks_handler
+            app.router.add_get("/debug/stacks", aiohttp_stacks_handler)
         return app
 
     async def _body(self, request: web.Request) -> dict:

@@ -1,0 +1,25 @@
+"""Debug introspection endpoints (the reference wires net/http/pprof into
+its binaries, cmd/device-plugin/main.go:119-124; the Python analogue is a
+live thread-stack dump — enough to diagnose a wedged pass or a stuck
+watcher without attaching a debugger)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+
+
+def format_stacks() -> str:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
+async def aiohttp_stacks_handler(request):
+    """Shared aiohttp handler for /debug/stacks (scheduler + monitor)."""
+    from aiohttp import web
+    return web.Response(text=format_stacks(), content_type="text/plain")
