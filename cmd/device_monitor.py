@@ -28,6 +28,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--vmem-path", default=consts.VMEM_NODE_CONFIG)
     parser.add_argument("--debug-endpoints", action="store_true",
                         help="expose /debug/stacks (thread dumps)")
+    parser.add_argument("--metrics-token-file", default=None,
+                        help="require 'Authorization: Bearer <token>' on "
+                             "/metrics, token read from this file (the "
+                             "reference auth-filters its metrics server; "
+                             "a mounted secret plays that role here)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -48,7 +53,30 @@ def main(argv: list[str] | None = None) -> int:
         args.node_name or "unknown", chips, base_dir=args.base_dir,
         tc_path=args.tc_path, vmem_path=args.vmem_path)
 
+    import hmac
+
+    def read_token() -> str:
+        # re-read per request: kubernetes rotates mounted secrets in
+        # place, and a restart-only token would 401 every scraper after
+        # rotation while the revoked token kept working
+        with open(args.metrics_token_file) as f:
+            return f.read().strip()
+
+    if args.metrics_token_file and not read_token():
+        logging.getLogger(__name__).error(
+            "metrics token file %s is empty; refusing to start with "
+            "silently-broken auth", args.metrics_token_file)
+        return 2
+
+    def authorized(request) -> bool:
+        if not args.metrics_token_file:
+            return True
+        auth = request.headers.get("Authorization", "")
+        return hmac.compare_digest(auth, f"Bearer {read_token()}")
+
     async def metrics(request):
+        if not authorized(request):
+            return web.Response(status=401, text="unauthorized\n")
         return web.Response(text=collector.render(),
                             content_type="text/plain")
 
@@ -59,10 +87,16 @@ def main(argv: list[str] | None = None) -> int:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
     if args.debug_endpoints:
-        # stack traces disclose internals; opt-in only (the reference's
-        # metrics server is auth-filtered for the same reason)
+        # stack traces disclose internals: opt-in AND behind the same
+        # bearer auth as /metrics when a token is configured
         from vtpu_manager.util.debug import aiohttp_stacks_handler
-        app.router.add_get("/debug/stacks", aiohttp_stacks_handler)
+
+        async def stacks(request):
+            if not authorized(request):
+                return web.Response(status=401, text="unauthorized\n")
+            return await aiohttp_stacks_handler(request)
+
+        app.router.add_get("/debug/stacks", stacks)
     logging.getLogger(__name__).info("vtpu-monitor on %s:%d", args.host,
                                      args.port)
     web.run_app(app, host=args.host, port=args.port, print=None)
