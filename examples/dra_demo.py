@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""End-to-end DRA-mode demo: claims, prepare, CDI, NRI — no cluster.
+
+Walks the DRA flow the way a cluster would drive it: a fake 2-chip node
+publishes its ResourceSlice (fractional slots over shared counters) → a
+ResourceClaim is "allocated" (as the scheduler's DRA allocator would) →
+the kubelet plugin prepares it over REAL gRPC (unix socket) → the CDI
+spec + binary vtpu.config land on disk → the NRI runtime hook (REAL
+ttrpc over a mux-framed socket) validates the container and injects the
+config mount, and rejects a spoofing container → unprepare cleans up.
+
+    python examples/dra_demo.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import grpc
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.device.types import fake_chip
+from vtpu_manager.kubeletplugin import cdi, nri_transport as nt
+from vtpu_manager.kubeletplugin.allocatable import build_resource_slice
+from vtpu_manager.kubeletplugin.api import dra_pb2 as pb
+from vtpu_manager.kubeletplugin.api import nri_pb2
+from vtpu_manager.kubeletplugin.device_state import DeviceState
+from vtpu_manager.kubeletplugin.driver import ClaimSource, DraDriver
+from vtpu_manager.kubeletplugin.nri import RuntimeHook
+from vtpu_manager.kubeletplugin.registration import publish_resource_slice
+from vtpu_manager.util import consts, ttrpc
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="vtpu-dra-demo-")
+    try:
+        return run(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run(tmp: str) -> int:
+    client = FakeKubeClient()
+    chips = [fake_chip(0), fake_chip(1)]
+
+    print("== 1. node publishes its ResourceSlice")
+    rs = build_resource_slice("demo-node", chips)
+    publish_resource_slice(client, rs)
+    print(f"   {len(rs['spec']['devices'])} devices "
+          f"({len(rs['spec']['sharedCounters'])} shared counter sets); "
+          f"first: {rs['spec']['devices'][0]['name']}")
+
+    print("== 2. a ResourceClaim is allocated (50% cores / 2GiB of chip 0)")
+    claim = {
+        "metadata": {"uid": "claim-demo", "name": "tpu", "namespace": "ml"},
+        "status": {
+            "reservedFor": [{"resource": "pods", "name": "train",
+                             "uid": "pod-demo"}],
+            "allocation": {"devices": {
+                "results": [{"request": "tpu",
+                             "driver": consts.DRA_DRIVER_NAME,
+                             "pool": "demo-node", "device": "vtpu-0"}],
+                "config": [{"requests": ["tpu"], "opaque": {
+                    "driver": consts.DRA_DRIVER_NAME,
+                    "parameters": {"cores": 50, "memoryMiB": 2048}}}],
+            }},
+        },
+    }
+    source = ClaimSource()
+    source.local["claim-demo"] = claim
+
+    print("== 3. kubelet prepares the claim over gRPC")
+    state = DeviceState("demo-node", chips, base_dir=f"{tmp}/mgr",
+                        cdi_dir=f"{tmp}/cdi")
+    driver = DraDriver("demo-node", chips, source, state=state,
+                       plugin_dir=f"{tmp}/plugin")
+    driver.serve()
+    with grpc.insecure_channel(f"unix://{driver.socket_path}") as chan:
+        prep = chan.unary_unary(
+            "/v1beta1dra.DRAPlugin/NodePrepareResources",
+            request_serializer=pb.NodePrepareResourcesRequest.
+            SerializeToString,
+            response_deserializer=pb.NodePrepareResourcesResponse.
+            FromString)
+        resp = prep(pb.NodePrepareResourcesRequest(claims=[
+            pb.Claim(uid="claim-demo", name="tpu", namespace="ml")]),
+            timeout=10)
+    entry = resp.claims["claim-demo"]
+    assert not entry.error, entry.error
+    print(f"   CDI devices: {list(entry.devices[0].cdi_device_ids)}")
+    spec = json.load(open(cdi.spec_path("claim-demo", f"{tmp}/cdi")))
+    env = spec["devices"][0]["containerEdits"]["env"]
+    print(f"   CDI env: {[e for e in env if 'LIMIT' in e or 'CLAIM' in e]}")
+    cfg = vc.read_config(f"{tmp}/mgr/claim_claim-demo/config/vtpu.config")
+    print(f"   vtpu.config: core={cfg.devices[0].hard_core}% "
+          f"mem={cfg.devices[0].total_memory >> 20}MiB")
+
+    print("== 4. NRI hook validates at container create (real ttrpc)")
+    plugin = nt.NriPlugin(RuntimeHook(state),
+                          claim_uids_for_pod=driver.claim_uids_for_pod)
+    sock = f"{tmp}/nri.sock"
+    runtime_srv = ttrpc.TtrpcServer(sock, {
+        (nt.RUNTIME_SERVICE, "RegisterPlugin"):
+            lambda raw: nri_pb2.Empty().SerializeToString()}, mux=True)
+    session = plugin.run(sock)
+    runtime = runtime_srv.wait_for_connection()
+    raw = runtime.call(nt.PLUGIN_SERVICE, "CreateContainer",
+                       nri_pb2.CreateContainerRequest(
+                           pod=nri_pb2.PodSandbox(uid="pod-demo"),
+                           container=nri_pb2.Container(
+                               name="main",
+                               env=["VTPU_CLAIM_UID=claim-demo"]),
+                       ).SerializeToString())
+    adj = nri_pb2.CreateContainerResponse.FromString(raw).adjust
+    print(f"   injected mount -> {adj.mounts[0].destination} "
+          f"env {[e.key for e in adj.env]}")
+    try:
+        runtime.call(nt.PLUGIN_SERVICE, "CreateContainer",
+                     nri_pb2.CreateContainerRequest(
+                         pod=nri_pb2.PodSandbox(uid="pod-evil"),
+                         container=nri_pb2.Container(
+                             name="evil",
+                             env=["VTPU_CLAIM_UID=claim-demo"]),
+                     ).SerializeToString())
+        print("   !! spoof was NOT rejected")
+        return 1
+    except ttrpc.TtrpcError as e:
+        print(f"   spoof rejected: {e.message}")
+    session.close()
+    runtime_srv.stop()
+
+    print("== 5. unprepare cleans up")
+    state.unprepare_claim("claim-demo")
+    driver.stop()
+    assert state.prepared_uids() == set()
+    assert not os.path.exists(cdi.spec_path("claim-demo", f"{tmp}/cdi"))
+    print("== DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

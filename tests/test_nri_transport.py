@@ -60,10 +60,7 @@ def loop(tmp_path):
     server = ttrpc.TtrpcServer(sock_path, {
         (nt.RUNTIME_SERVICE, "RegisterPlugin"): register}, mux=True)
     plugin_conn = plugin.run(sock_path)
-    deadline = time.time() + 5
-    while not server.connections and time.time() < deadline:
-        time.sleep(0.01)
-    runtime_conn = server.connections[0]
+    runtime_conn = server.wait_for_connection()
     yield runtime_conn, plugin, registered
     plugin_conn.close()
     server.stop()
@@ -166,10 +163,7 @@ class TestResolverFailure:
                 lambda raw: nri_pb2.Empty().SerializeToString()},
             mux=True)
         conn = plugin.run(sock_path)
-        deadline = time.time() + 5
-        while not server.connections and time.time() < deadline:
-            time.sleep(0.01)
-        runtime = server.connections[0]
+        runtime = server.wait_for_connection()
         try:
             # non-tenant: resolver never called, passthrough
             resp = call(runtime, "CreateContainer",

@@ -352,6 +352,19 @@ class TtrpcServer:
                 self.connections.append(
                     Connection(sock, self.handlers, initiator=False))
 
+    def wait_for_connection(self, timeout_s: float = 5.0):
+        """Block until a peer has connected; returns the first
+        connection (TtrpcError on timeout instead of an IndexError at
+        the call site)."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while not self.connections:
+            if time.monotonic() >= deadline:
+                raise TtrpcError(CODE_UNKNOWN,
+                                 "no peer connected within timeout")
+            time.sleep(0.01)
+        return self.connections[0]
+
     def stop(self) -> None:
         self._stop.set()
         try:
