@@ -87,8 +87,9 @@ def dump_watcher(path: str) -> None:
             continue
         shown += 1
         fresh = "fresh" if rec.is_fresh() else "STALE"
-        print(f"  dev[{i}] util={rec.device_util}% {fresh} "
-              f"procs={[(p.pid, p.util) for p in rec.procs]}")
+        procs = [(p.pid, f"{p.util}%", f"{p.owner_token:016x}")
+                 for p in rec.procs]
+        print(f"  dev[{i}] util={rec.device_util}% {fresh} procs={procs}")
     feed.close()
     if not shown:
         print("  (no samples)")

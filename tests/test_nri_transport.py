@@ -11,8 +11,6 @@ other channel.
 
 from __future__ import annotations
 
-import time
-
 import pytest
 
 from vtpu_manager.device.types import fake_chip

@@ -22,6 +22,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Callable
 
 from vtpu_manager.kubeletplugin.api import ttrpc_pb2
@@ -356,7 +357,6 @@ class TtrpcServer:
         """Block until a peer has connected; returns the first
         connection (TtrpcError on timeout instead of an IndexError at
         the call site)."""
-        import time
         deadline = time.monotonic() + timeout_s
         while not self.connections:
             if time.monotonic() >= deadline:
