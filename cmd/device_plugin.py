@@ -91,6 +91,19 @@ def main(argv: list[str] | None = None) -> int:
             shutil.copy2(shim_src, tmp)
             os.replace(tmp, dst)   # atomic: tenants may be mid-dlopen
             log.info("shim installed at %s", dst)
+            # the CLIENT-mode registrar rides along (stdlib-only script;
+            # tenant images lack the vtpu_manager package)
+            dc_src = os.environ.get(
+                "VTPU_DEVICE_CLIENT_SOURCE",
+                os.path.join(os.path.dirname(shim_src),
+                             "vtpu_device_client.py"))
+            if os.path.exists(dc_src):
+                dc_dst = os.path.join(consts.DRIVER_DIR,
+                                      "vtpu_device_client.py")
+                tmp2 = f"{dc_dst}.tmp.{os.getpid()}"
+                shutil.copy2(dc_src, tmp2)
+                os.replace(tmp2, dc_dst)
+                log.info("device-client installed at %s", dc_dst)
         except OSError as e:
             log.warning("shim install failed: %s", e)
 

@@ -15,6 +15,7 @@
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/wait.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -282,6 +283,50 @@ static void ChildAfterFork() {
   ResetWatcherForFork();
 }
 
+// CLIENT compat mode: announce this container to the node registry so the
+// daemon can attest our pids into pids.config (reference: register.c execs
+// cmd/device-client). The registrar is a short-lived helper process —
+// double-forked so init reaps it and the tenant never sees a zombie; the
+// command is overridable for images whose python lives elsewhere.
+static void SpawnDeviceClient() {
+  // Resolution order: explicit override, the stdlib-only script the
+  // device plugin installs next to the shim (tenant images do NOT carry
+  // the vtpu_manager package), then the module as a dev-box fallback.
+  const char* cmd = getenv("VTPU_DEVICE_CLIENT_CMD");
+  char script_cmd[512];
+  if (!cmd) {
+    const char* script = "/etc/vtpu-manager/driver/vtpu_device_client.py";
+    if (access(script, R_OK) == 0) {
+      snprintf(script_cmd, sizeof(script_cmd), "python3 %s", script);
+      cmd = script_cmd;
+    } else {
+      cmd = "python3 -m vtpu_manager.runtime.client";
+    }
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    VTPU_LOG(kLogWarn, "device-client fork failed");
+    return;
+  }
+  if (pid == 0) {
+    pid_t grandchild = fork();
+    if (grandchild != 0) _exit(grandchild > 0 ? 0 : 1);
+    setsid();
+    execlp("/bin/sh", "sh", "-c", cmd, (char*)nullptr);
+    _exit(127);
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);  // reap the intermediate immediately
+  if (WIFEXITED(status) && WEXITSTATUS(status) == 0) {
+    VTPU_LOG(kLogInfo, "device-client spawned: %s", cmd);
+  } else {
+    // the registrar itself retries with backoff; this failure means the
+    // intermediate fork/exec never got that far
+    VTPU_LOG(kLogError, "device-client spawn FAILED (status=%d): %s",
+             status, cmd);
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Entry: GetPjrtApi
 // ---------------------------------------------------------------------------
@@ -324,6 +369,7 @@ static void InitOnce() {
     WrapErrorEntries(&s.wrapped_api);
     WrapEnforcementEntries(&s.wrapped_api);
     pthread_atfork(nullptr, nullptr, ChildAfterFork);
+    if (s.config.compat_mode & kCompatClient) SpawnDeviceClient();
     VTPU_LOG(kLogInfo, "enforcement active for %d device(s)",
              s.device_count);
   } else {

@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""Standalone device-client registrar (stdlib only, no vtpu_manager).
+
+Reference: cmd/device-client/main.go — a tiny static binary the intercept
+library execs inside the tenant container to announce it to the node
+registry (CLIENT compat mode). Tenant images do not carry the
+vtpu_manager package, so this single file is installed next to the shim
+in the driver dir (mounted into every tenant) and the shim runs it with
+whatever python3 the image has. Protocol: length-prefixed JSON over the
+registry's unix socket; the server authenticates via SO_PEERCRED +
+cgroup attestation, we only present pod identity from the env the
+device plugin injected.
+
+Retries briefly: container start races the registry daemon's restart
+window, and a missed registration would silently break per-process
+attribution for the container's lifetime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import sys
+import time
+
+DEFAULT_SOCKET = "/etc/vtpu-manager/registry/socket.sock"
+
+
+def register_once(path: str, timeout_s: float = 5.0) -> bool:
+    payload = json.dumps({
+        "pod_name": os.environ.get("VTPU_POD_NAME", ""),
+        "pod_namespace": os.environ.get("VTPU_POD_NAMESPACE", ""),
+        "pod_uid": os.environ.get("VTPU_POD_UID", ""),
+        "container": os.environ.get("VTPU_CONTAINER_NAME", ""),
+        "register_uuid": os.environ.get("VTPU_REGISTER_UUID", ""),
+    }).encode()
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+            sock.settimeout(timeout_s)
+            sock.connect(path)
+            sock.sendall(struct.pack("<I", len(payload)) + payload)
+            raw = sock.recv(4)
+            if len(raw) < 4:
+                return False
+            (status,) = struct.unpack("<i", raw)
+            return status == 0
+    except OSError:
+        return False
+
+
+def main() -> int:
+    path = os.environ.get("VTPU_REGISTRY_SOCKET", DEFAULT_SOCKET)
+    delay = 0.5
+    for attempt in range(6):
+        if register_once(path):
+            print("vtpu device-client: registered", file=sys.stderr)
+            return 0
+        time.sleep(delay)
+        delay = min(delay * 2, 8.0)
+    print(f"vtpu device-client: registration FAILED after {attempt + 1} "
+          f"attempts ({path})", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

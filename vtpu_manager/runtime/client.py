@@ -103,7 +103,8 @@ def register_client(timeout_s: float = 5.0) -> bool:
     the daemon can resolve our pids into pids.config (reference:
     cmd/device-client + registry/server.go SO_PEERCRED auth — the kernel
     attests our pid; we just present pod identity)."""
-    path = consts.REGISTRY_SOCKET
+    path = os.environ.get(consts.ENV_REGISTRY_SOCKET,
+                          consts.REGISTRY_SOCKET)
     if not os.path.exists(path):
         return False
     payload = json.dumps({
@@ -125,3 +126,19 @@ def register_client(timeout_s: float = 5.0) -> bool:
             return status == 0
     except OSError:
         return False
+
+
+def main() -> int:
+    """The device-client entrypoint the shim execs in CLIENT mode
+    (reference: cmd/device-client/main.go — a tiny registrar process):
+    `python -m vtpu_manager.runtime.client`. Exit 0 on successful
+    registration."""
+    import sys
+    ok = register_client()
+    print(f"vtpu device-client: registration "
+          f"{'succeeded' if ok else 'FAILED'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -220,6 +220,7 @@ ENV_VISIBLE_DEVICES = "MANAGER_VISIBLE_DEVICES"    # host-index / uuid list
 ENV_COMPAT_MODE = "MANAGER_COMPATIBILITY_MODE"
 ENV_DISABLE_CONTROL = "DISABLE_VTPU_CONTROL"
 ENV_REGISTER_UUID = "VTPU_REGISTER_UUID"    # random id for CLIENT-mode match
+ENV_REGISTRY_SOCKET = "VTPU_REGISTRY_SOCKET"  # registry socket override
 ENV_POD_NAME = "VTPU_POD_NAME"
 ENV_POD_NAMESPACE = "VTPU_POD_NAMESPACE"
 ENV_POD_UID = "VTPU_POD_UID"
