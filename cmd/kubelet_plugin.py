@@ -31,6 +31,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--nri-socket", default="",
                         help="NRI runtime socket (e.g. /var/run/nri/"
                              "nri.sock); empty disables the NRI stub")
+    parser.add_argument("--health-port", type=int, default=-1,
+                        help="serve /healthz + /readyz on this port "
+                             "(-1 = disabled, the default; a kubelet "
+                             "httpGet probe needs a fixed port)")
+    parser.add_argument("--health-host", default="0.0.0.0",
+                        help="bind address for the health endpoint "
+                             "(default 0.0.0.0 so kubelet probes reach "
+                             "it on hostNetwork daemonsets)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -69,14 +77,29 @@ def main(argv: list[str] | None = None) -> int:
                        state=state, plugin_dir=args.plugin_dir)
     driver.serve()
 
+    from vtpu_manager.kubeletplugin.readiness import (Readiness,
+                                                      ReadinessServer)
+    readiness = Readiness()
+    readiness.set("driver", True)
+    readyz = None
+    if args.health_port >= 0:
+        try:
+            readyz = ReadinessServer(readiness, port=args.health_port,
+                                     host=args.health_host)
+            readyz.start()
+        except OSError as e:
+            log.warning("readiness endpoint unavailable: %s", e)
+
     from vtpu_manager.kubeletplugin.registration import (
         RegistrationServer, publish_resource_slice)
     registration = RegistrationServer(driver.socket_path,
                                       registry_dir=args.registry_dir)
     try:
         registration.serve()
-    except Exception:
+        readiness.set("registration", True)
+    except Exception as e:
         log.warning("plugin registration socket unavailable")
+        readiness.set("registration", False, f"registration socket: {e}")
         registration = None
 
     nri_conn = None
@@ -90,11 +113,15 @@ def main(argv: list[str] | None = None) -> int:
                 claim_uids_for_pod=driver.claim_uids_for_pod,
             ).run(args.nri_socket)
             log.info("NRI stub registered on %s", args.nri_socket)
+            readiness.set("nri", True)
         except (OSError, TtrpcError) as e:
-            # CDI injection still covers the tenant wiring; NRI only adds
-            # the spoof-rejection layer (reference escalation: plugin.go:232)
+            # CDI injection still covers the tenant wiring, but the operator
+            # asked for the NRI spoof-rejection layer — flip readiness so
+            # the deployment can gate on it instead of scraping logs
+            # (ADVICE r1; reference escalation: plugin.go:232).
             log.warning("NRI socket unavailable (%s); continuing with "
                         "CDI-only injection", e)
+            readiness.set("nri", False, f"requested but not attached: {e}")
 
     rs = build_resource_slice(args.node_name, chips)
     log.info("ResourceSlice: %d devices, %d shared counter sets",
@@ -132,6 +159,8 @@ def main(argv: list[str] | None = None) -> int:
         driver.stop()
         if registration is not None:
             registration.stop()
+        if readyz is not None:
+            readyz.stop()
     return 0
 
 

@@ -201,6 +201,22 @@ class TestAllocate:
             p.pre_start_container(pb.PreStartContainerRequest(
                 devicesIDs=["ghost::0"]))
 
+    def test_prestart_refuses_same_uuid_different_slot(self, plugin):
+        """ADVICE r1 (low): a stale record for the same chip in a different
+        slot must not satisfy prestart — a uuid-multiset fallback would let
+        it select another tenant's record and rewrite their state."""
+        p, client, mgr = plugin
+        pod = committed_pod(mgr)
+        client.add_pod(pod)
+        chip = mgr.chips[0]
+        p.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[device_id(chip.uuid, 0)])]))
+        # same chip uuid, different slot → no exact device-id record
+        with pytest.raises(RuntimeError):
+            p.pre_start_container(pb.PreStartContainerRequest(
+                devicesIDs=[device_id(chip.uuid, 1)]))
+
 
 class TestGrpcRoundTrip:
     def test_server_over_unix_socket(self, plugin, tmp_path):

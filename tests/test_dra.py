@@ -358,3 +358,41 @@ class TestDraHealth:
         assert calls == [1, 1] and not watcher._dirty
         watcher.check_once()          # clean: no further publishes
         assert calls == [1, 1]
+
+
+class TestReadiness:
+    def test_readyz_flips_on_component_failure(self):
+        """ADVICE r1: NRI-requested-but-unattached must be a readiness
+        signal, not a log line."""
+        import json
+        import urllib.request
+
+        from vtpu_manager.kubeletplugin.readiness import (Readiness,
+                                                          ReadinessServer)
+        r = Readiness()
+        r.set("driver", True)
+        srv = ReadinessServer(r, port=0)
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(url + "/readyz") as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(url + "/healthz") as resp:
+                assert resp.status == 200
+            r.set("nri", False, "requested but not attached: ENOENT")
+            try:
+                urllib.request.urlopen(url + "/readyz")
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                body = json.loads(e.read())
+                assert "nri" in body["components"]
+            # liveness unaffected
+            with urllib.request.urlopen(url + "/healthz") as resp:
+                assert resp.status == 200
+            # NRI attaches later (reconnect) -> ready again
+            r.set("nri", True)
+            with urllib.request.urlopen(url + "/readyz") as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()

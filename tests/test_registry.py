@@ -3,17 +3,22 @@
 Mirrors the reference's security tests (pkg/device/registry/
 security_test.go): a client claiming another pod's identity must be
 rejected because the kernel-attested pid's cgroup does not embed that
-pod's uid.
+pod's uid.  Attestation is equality on the UUID extracted from the cgroup
+path (reference peercred.go), not a substring test, so generic claims
+like "kubepods" cannot pass; identities are shape-validated before any
+path construction so they cannot traverse out of the manager base dir.
 """
 
 import os
 
 import pytest
 
-from vtpu_manager.registry.server import (RegistryServer, read_pids_config,
-                                          write_pids_config)
-from vtpu_manager.runtime import client as rt_client
+from vtpu_manager.registry.server import (RegistryServer, pod_uid_from_cgroup,
+                                          read_pids_config, write_pids_config)
 from vtpu_manager.util import consts
+
+UID_GOOD = "11111111-2222-3333-4444-555555555555"
+UID_OTHER = "aaaaaaaa-bbbb-cccc-dddd-eeeeeeeeeeee"
 
 
 @pytest.fixture
@@ -22,9 +27,12 @@ def registry(tmp_path, monkeypatch):
     base.mkdir()
     sock = str(tmp_path / "registry.sock")
 
-    # attested world: our own pid belongs to pod 'uid-good'
+    # attested world: our own pid belongs to pod UID_GOOD, systemd-style
+    # cgroup path (uid dashes become underscores), one leaf per pid
     def cgroup_of_pid(pid):
-        return f"/kubepods/burstable/poduid-good/{pid}"
+        return ("/kubepods.slice/kubepods-burstable.slice/"
+                f"kubepods-burstable-pod{UID_GOOD.replace('-', '_')}.slice/"
+                f"cri-containerd-leaf{pid}.scope")
 
     def pids_in_cgroup(cgroup):
         return [os.getpid(), 4242]
@@ -56,29 +64,79 @@ def register(sock, pod_uid, container, monkeypatch):
 class TestRegistry:
     def test_successful_registration(self, registry, monkeypatch):
         server, base, sock = registry
-        (base / "uid-good_main" / "config").mkdir(parents=True)
-        assert register(sock, "uid-good", "main", monkeypatch)
+        (base / f"{UID_GOOD}_main" / "config").mkdir(parents=True)
+        assert register(sock, UID_GOOD, "main", monkeypatch)
         pids = read_pids_config(
-            str(base / "uid-good_main" / "config" / consts.PIDS_CONFIG_NAME))
+            str(base / f"{UID_GOOD}_main" / "config"
+                / consts.PIDS_CONFIG_NAME))
         assert os.getpid() in pids and 4242 in pids
-        assert server.registrations[0]["pod_uid"] == "uid-good"
+        assert server.registrations[0]["pod_uid"] == UID_GOOD
 
     def test_spoofed_identity_rejected(self, registry, monkeypatch):
         server, base, sock = registry
-        (base / "uid-other_main" / "config").mkdir(parents=True)
-        # we claim pod uid-other but our cgroup says uid-good
-        assert not register(sock, "uid-other", "main", monkeypatch)
+        (base / f"{UID_OTHER}_main" / "config").mkdir(parents=True)
+        # we claim pod UID_OTHER but our cgroup says UID_GOOD
+        assert not register(sock, UID_OTHER, "main", monkeypatch)
         assert not os.path.exists(
-            str(base / "uid-other_main" / "config" / consts.PIDS_CONFIG_NAME))
+            str(base / f"{UID_OTHER}_main" / "config"
+                / consts.PIDS_CONFIG_NAME))
+
+    def test_generic_uid_claim_rejected(self, registry, monkeypatch):
+        """A claim like 'kubepods' that appears as a substring of every
+        cgroup path must not pass attestation (it is not UUID-shaped and
+        does not equal the extracted uid)."""
+        server, base, sock = registry
+        (base / "kubepods_main" / "config").mkdir(parents=True)
+        assert not register(sock, "kubepods", "main", monkeypatch)
+
+    def test_traversal_container_rejected(self, registry, monkeypatch):
+        """ADVICE r1 (high): container='c/../<victim>' must not resolve into
+        another tenant's allocation dir."""
+        server, base, sock = registry
+        victim = base / f"{UID_OTHER}_main" / "config"
+        victim.mkdir(parents=True)
+        write_pids_config(str(victim / consts.PIDS_CONFIG_NAME), [7])
+        (base / f"{UID_GOOD}_c" / "config").mkdir(parents=True)
+        evil = f"c/../../{UID_OTHER}_main"
+        assert not register(sock, UID_GOOD, evil, monkeypatch)
+        # victim's pid set untouched
+        assert read_pids_config(
+            str(victim / consts.PIDS_CONFIG_NAME)) == [7]
 
     def test_unallocated_container_rejected(self, registry, monkeypatch):
         server, base, sock = registry
-        # no uid-good_ghost dir was created by any Allocate
-        assert not register(sock, "uid-good", "ghost", monkeypatch)
+        # no UID_GOOD_ghost dir was created by any Allocate
+        assert not register(sock, UID_GOOD, "ghost", monkeypatch)
 
     def test_malformed_payload(self, registry, monkeypatch):
         server, base, sock = registry
         assert not register(sock, "", "", monkeypatch)
+
+    def test_leaf_cannot_claim_second_container(self, registry, monkeypatch):
+        """Within one pod, a single runtime container (one cgroup leaf) may
+        not register under two different container names."""
+        server, base, sock = registry
+        (base / f"{UID_GOOD}_main" / "config").mkdir(parents=True)
+        (base / f"{UID_GOOD}_side" / "config").mkdir(parents=True)
+        assert register(sock, UID_GOOD, "main", monkeypatch)
+        # same pid → same leaf, now claiming the sibling's name
+        assert not register(sock, UID_GOOD, "side", monkeypatch)
+        # re-registering its own name stays allowed (restart path)
+        assert register(sock, UID_GOOD, "main", monkeypatch)
+
+
+class TestPodUidExtraction:
+    def test_systemd_style(self):
+        cg = ("/kubepods.slice/kubepods-burstable.slice/kubepods-burstable-"
+              "pod11111111_2222_3333_4444_555555555555.slice/x.scope")
+        assert pod_uid_from_cgroup(cg) == UID_GOOD
+
+    def test_cgroupfs_style(self):
+        cg = f"/kubepods/burstable/pod{UID_GOOD}/abcdef"
+        assert pod_uid_from_cgroup(cg) == UID_GOOD
+
+    def test_no_uid(self):
+        assert pod_uid_from_cgroup("/user.slice/user-0.slice") == ""
 
 
 class TestPidsConfig:
@@ -93,3 +151,75 @@ class TestPidsConfig:
             f.write(b"\0" * 16)
         with pytest.raises(ValueError):
             read_pids_config(path)
+
+
+class TestLeafRebinding:
+    """Direct handle_request tests with controlled cgroup/pid functions:
+    a restarted container (new cgroup leaf, old leaf has no live pids)
+    must be able to re-register; a live binding must not be stolen."""
+
+    def _server(self, tmp_path, cgroups, live):
+        base = tmp_path / "mgr"
+        base.mkdir(exist_ok=True)
+        (base / f"{UID_GOOD}_main" / "config").mkdir(parents=True,
+                                                     exist_ok=True)
+        return RegistryServer(
+            socket_path=str(tmp_path / "r.sock"), base_dir=str(base),
+            cgroup_of_pid=lambda pid: cgroups[pid],
+            pids_in_cgroup=lambda cg: live.get(cg, [])), base
+
+    def test_restart_rebinds_after_old_leaf_dies(self, tmp_path):
+        pod_slice = f"/kubepods/pod{UID_GOOD}"
+        cg1, cg2 = f"{pod_slice}/leaf1", f"{pod_slice}/leaf2"
+        cgroups = {100: cg1, 200: cg2}
+        live = {cg1: [100]}
+        server, _ = self._server(tmp_path, cgroups, live)
+        assert server.handle_request(
+            {"pod_uid": UID_GOOD, "container": "main"}, 100) == 0
+        # container restarts: leaf1 dies, new instance in leaf2
+        live.pop(cg1)
+        live[cg2] = [200]
+        assert server.handle_request(
+            {"pod_uid": UID_GOOD, "container": "main"}, 200) == 0
+        assert server._bind[(UID_GOOD, "main")] == cg2
+
+    def test_live_binding_not_stolen(self, tmp_path):
+        pod_slice = f"/kubepods/pod{UID_GOOD}"
+        cg1, cg2 = f"{pod_slice}/leaf1", f"{pod_slice}/leaf2"
+        cgroups = {100: cg1, 200: cg2}
+        live = {cg1: [100], cg2: [200]}
+        server, _ = self._server(tmp_path, cgroups, live)
+        assert server.handle_request(
+            {"pod_uid": UID_GOOD, "container": "main"}, 100) == 0
+        # another live container in the same pod claims main's name
+        assert server.handle_request(
+            {"pod_uid": UID_GOOD, "container": "main"}, 200) == 3
+
+    def test_failed_attempt_does_not_poison_slot(self, tmp_path):
+        pod_slice = f"/kubepods/pod{UID_GOOD}"
+        cg1, cg2 = f"{pod_slice}/leaf1", f"{pod_slice}/leaf2"
+        cgroups = {100: cg1, 200: cg2}
+        live = {cg1: [100], cg2: [200]}
+        server, base = self._server(tmp_path, cgroups, live)
+        # leaf1 claims a name with no allocation dir -> status 4, no binding
+        assert server.handle_request(
+            {"pod_uid": UID_GOOD, "container": "side"}, 100) == 4
+        assert (UID_GOOD, "side") not in server._bind
+        # leaf1 can still register its real name afterwards
+        assert server.handle_request(
+            {"pod_uid": UID_GOOD, "container": "main"}, 100) == 0
+
+    def test_dead_pod_bindings_reaped(self, tmp_path):
+        pod_slice = f"/kubepods/pod{UID_GOOD}"
+        other_slice = f"/kubepods/pod{UID_OTHER}"
+        cg_old, cg_new = f"{other_slice}/leafX", f"{pod_slice}/leaf1"
+        cgroups = {100: cg_old, 200: cg_new}
+        live = {cg_old: [100], cg_new: [200]}
+        server, base = self._server(tmp_path, cgroups, live)
+        (base / f"{UID_OTHER}_main" / "config").mkdir(parents=True)
+        assert server.handle_request(
+            {"pod_uid": UID_OTHER, "container": "main"}, 100) == 0
+        live.pop(cg_old)    # old pod gone
+        assert server.handle_request(
+            {"pod_uid": UID_GOOD, "container": "main"}, 200) == 0
+        assert (UID_OTHER, "main") not in server._bind

@@ -75,10 +75,24 @@ class TestMutate:
         result = mutate_pod(pod)
         mutated = apply_patches(pod, result.patches)
         assert "nodeName" not in mutated["spec"]
-        terms = mutated["spec"]["affinity"]["nodeAffinity"][
-            "requiredDuringSchedulingIgnoredDuringExecution"][
-            "nodeSelectorTerms"]
-        assert terms[0]["matchFields"][0]["values"] == ["node-7"]
+        assert mutated["spec"]["nodeSelector"][
+            "kubernetes.io/hostname"] == "node-7"
+
+    def test_nodename_conversion_preserves_affinity(self):
+        """ADVICE r1 (medium): pre-existing affinity (e.g. podAntiAffinity)
+        must survive the nodeName conversion — and an existing nodeSelector
+        must be merged into, not replaced."""
+        anti = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "kubernetes.io/hostname",
+                 "labelSelector": {"matchLabels": {"app": "x"}}}]}}
+        pod = vtpu_pod(spec={"nodeName": "node-7", "affinity": anti,
+                             "nodeSelector": {"disktype": "ssd"}})
+        result = mutate_pod(pod)
+        mutated = apply_patches(pod, result.patches)
+        assert mutated["spec"]["affinity"] == anti
+        assert mutated["spec"]["nodeSelector"] == {
+            "disktype": "ssd", "kubernetes.io/hostname": "node-7"}
 
     def test_stale_allocation_state_cleared(self):
         pod = vtpu_pod(annotations={

@@ -405,15 +405,20 @@ class VnumPlugin(DevicePluginServicer):
                     records = json.load(f)
             except (OSError, json.JSONDecodeError):
                 records = {}
-        # exact device-id match first (slots included), newest first; a
-        # uuid-multiset fallback only when no exact record exists — a stale
-        # tenant's same-chip record must not shadow the new allocation
+        # Only exact device-id matches (slots included), newest first.  A
+        # uuid-multiset fallback would let a stale record from a previous
+        # tenant of the same chip be selected, rewriting vtpu.config from
+        # the wrong claims and deleting the wrong pids.config (ADVICE r1).
         ordered = sorted(records.items(),
                          key=lambda kv: kv[1].get("ts", 0), reverse=True)
         exact = [kv for kv in ordered
                  if sorted(kv[1].get("devices", [])) == sorted(dev_ids)]
-        candidates = exact or ordered
-        for key, rec in candidates:
+        if not exact and ordered:
+            log.error(
+                "prestart: devices %s match no record exactly; %d records "
+                "exist (same-uuid fallback refused — stale-tenant hazard)",
+                dev_ids, len(ordered))
+        for key, rec in exact:
             claims = [DeviceClaim.from_wire(c) for c in rec.get("claims", [])]
             if Counter(c.uuid for c in claims) != want:
                 continue

@@ -80,11 +80,33 @@ def default_pids_in_cgroup(cgroup_path: str) -> list[int]:
         return []
 
 
+# kubelet embeds the pod uid in the cgroup path as `pod<uid>`, with the
+# uid's dashes kept (cgroupfs driver) or replaced by underscores (systemd
+# driver).  Reference peercred.go extracts the uid by regex and requires
+# equality with the claim — a substring test would let a generic claim like
+# "kubepods" pass attestation.
+_POD_UID_RE = re.compile(
+    r"pod([0-9a-fA-F]{8}[-_][0-9a-fA-F]{4}[-_][0-9a-fA-F]{4}"
+    r"[-_][0-9a-fA-F]{4}[-_][0-9a-fA-F]{12})")
+_UUID_RE = re.compile(
+    r"^[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}"
+    r"-[0-9a-fA-F]{4}-[0-9a-fA-F]{12}$")
+# container names are DNS labels (RFC 1123): lowercase alnum + '-', ≤63.
+_DNS_LABEL_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+
+def pod_uid_from_cgroup(cgroup: str) -> str:
+    """Extract the UUID-shaped pod uid embedded in a kubelet cgroup path,
+    normalized to canonical dashed lowercase; '' if none is present."""
+    m = _POD_UID_RE.search(cgroup)
+    if not m:
+        return ""
+    return m.group(1).replace("_", "-").lower()
+
+
 def _uid_in_cgroup(cgroup: str, pod_uid: str) -> bool:
-    """kubelet encodes the pod uid in the cgroup path with dashes or
-    underscores; normalize both."""
-    canon = re.sub(r"[-_]", "", cgroup.lower())
-    return re.sub(r"[-_]", "", pod_uid.lower()) in canon
+    extracted = pod_uid_from_cgroup(cgroup)
+    return bool(extracted) and extracted == pod_uid.replace("_", "-").lower()
 
 
 class RegistryServer:
@@ -101,8 +123,44 @@ class RegistryServer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.registrations: list[dict] = []   # observability for tests
+        # cgroup-leaf binding: one live runtime container (cgroup leaf) may
+        # only register as one container name at a time, and vice versa.
+        # This NARROWS the within-pod hole (the pod uid attests only the
+        # pod): a leaf cannot claim two names, and a sibling cannot take
+        # over a name after its legitimate owner registered.  It cannot
+        # prevent a first-claim race before the owner registers — the
+        # registry has no runtime source for name↔leaf truth (the reference
+        # resolves this via the container runtime; see NRI hook).  A stale
+        # binding whose cgroup has no live pids is released, so container
+        # restarts (new leaf) re-register cleanly.
+        self._bind: dict[tuple[str, str], str] = {}   # (uid, name) -> cgroup
+        self._bind_lock = threading.Lock()
 
     # -- request handling ---------------------------------------------------
+
+    def _admit_binding(self, pod_uid: str, container: str, cgroup: str,
+                       peer_pid: int) -> bool:
+        """Conflict-check (caller holds _bind_lock; nothing is recorded
+        here — bindings are written only after the full request succeeds).
+        A binding whose cgroup no longer has live pids is stale (the
+        container restarted under a new leaf) and is released."""
+        bound = self._bind.get((pod_uid, container))
+        if bound is not None and bound != cgroup:
+            if self.pids_in_cgroup(bound):
+                log.warning("registry: container %s/%s already bound to "
+                            "live cgroup %r; rejecting pid %d from %r",
+                            pod_uid, container, bound, peer_pid, cgroup)
+                return False
+            log.info("registry: releasing stale binding %s/%s -> %r",
+                     pod_uid, container, bound)
+            del self._bind[(pod_uid, container)]
+        for (uid, name), cg in self._bind.items():
+            if uid == pod_uid and cg == cgroup and name != container:
+                log.warning("registry: cgroup %r already registered as "
+                            "%s/%s; rejecting claim for container %r",
+                            cgroup, pod_uid, name, container)
+                return False
+        return True
 
     def handle_request(self, payload: dict, peer_pid: int) -> int:
         """0 on success; nonzero error codes mirror the reference's status
@@ -111,19 +169,45 @@ class RegistryServer:
         container = str(payload.get("container", ""))
         if not pod_uid or not container:
             return 2   # malformed identity
+        # Shape-validate before any path use: pod_uid must be a UUID and
+        # container a DNS label, so neither can smuggle '/' or '..' into the
+        # allocation-dir join below.
+        if not _UUID_RE.match(pod_uid) or not _DNS_LABEL_RE.match(container):
+            log.warning("registry: malformed identity pod=%r container=%r "
+                        "from pid %d", pod_uid, container, peer_pid)
+            return 2
         cgroup = self.cgroup_of_pid(peer_pid)
         if not cgroup or not _uid_in_cgroup(cgroup, pod_uid):
             log.warning("registry spoof attempt: pid %d cgroup %r does not "
                         "match claimed pod %s", peer_pid, cgroup, pod_uid)
             return 3   # identity not attested by the kernel
+        with self._bind_lock:
+            if not self._admit_binding(pod_uid, container, cgroup, peer_pid):
+                return 3
         pids = self.pids_in_cgroup(cgroup)
         if peer_pid not in pids:
             pids.append(peer_pid)
         cont_dir = os.path.join(self.base_dir, f"{pod_uid}_{container}")
+        # Defense in depth: the resolved dir must live directly under
+        # base_dir even if a symlink was planted inside it.
+        real = os.path.realpath(cont_dir)
+        if os.path.dirname(real) != os.path.realpath(self.base_dir):
+            log.warning("registry: allocation dir %r escapes base dir", real)
+            return 4
         if not os.path.isdir(cont_dir):
             log.warning("registry: no allocation dir for %s/%s", pod_uid,
                         container)
             return 4   # not an allocated container on this node
+        # Record the binding only once every check has passed, so a failed
+        # attempt cannot poison the (pod, container) slot.  Reap bindings
+        # whose cgroups have no live pids while we're here (bounds growth
+        # across pod churn; registrations are rare — container starts).
+        with self._bind_lock:
+            dead = [k for k, cg in self._bind.items()
+                    if cg != cgroup and not self.pids_in_cgroup(cg)]
+            for k in dead:
+                del self._bind[k]
+            self._bind[(pod_uid, container)] = cgroup
         # inside config/: that subdir is what Allocate mounts into the
         # container, so the shim can read its own pid set
         write_pids_config(os.path.join(cont_dir, "config",

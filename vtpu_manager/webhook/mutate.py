@@ -81,19 +81,25 @@ def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
                                "path": "/spec/schedulerName",
                                "value": scheduler_name})
     if spec.get("nodeName"):
+        # Reference fixSpecifiedNodeName (pod_mutate.go:146-156) pins the pod
+        # via spec.nodeSelector["kubernetes.io/hostname"], never touching
+        # affinity: a JSON-Patch `add` of a whole /spec/affinity object would
+        # REPLACE any pre-existing affinity (RFC 6902 §4.1), destroying user
+        # podAntiAffinity/nodeAffinity terms.  nodeSelector merges per-key.
         result.warnings.append(
             f"pod sets spec.nodeName={spec['nodeName']!r} directly; vtpu "
             "devices cannot be claimed without scheduling — nodeName "
-            "converted to a node affinity")
+            "converted to a hostname nodeSelector")
         result.patches.append({"op": "remove", "path": "/spec/nodeName"})
-        affinity = {
-            "nodeAffinity": {
-                "requiredDuringSchedulingIgnoredDuringExecution": {
-                    "nodeSelectorTerms": [{"matchFields": [{
-                        "key": "metadata.name", "operator": "In",
-                        "values": [spec["nodeName"]]}]}]}}}
-        result.patches.append({"op": "add", "path": "/spec/affinity",
-                               "value": affinity})
+        if spec.get("nodeSelector") is None:
+            result.patches.append({
+                "op": "add", "path": "/spec/nodeSelector",
+                "value": {"kubernetes.io/hostname": spec["nodeName"]}})
+        else:
+            result.patches.append({
+                "op": "add",
+                "path": f"/spec/nodeSelector/{_escape('kubernetes.io/hostname')}",
+                "value": spec["nodeName"]})
 
     # default / clean policy annotations
     for ann, (default, valid) in _ann_defaults().items():
