@@ -27,6 +27,7 @@
 #include <cstdint>
 #include <mutex>
 #include <unordered_map>
+#include <vector>
 
 #include "vtpu_config.h"
 #include "xla/pjrt/c/pjrt_c_api.h"
@@ -107,6 +108,17 @@ struct ShimState {
   // buffer -> (slot, bytes) for destroy-time credit
   std::mutex buffers_mu;
   std::unordered_map<PJRT_Buffer*, std::pair<int, int64_t>> buffers;
+  // async H2D transfer managers: bytes are reserved when the manager is
+  // created (CreateBuffersForAsyncHostToDevice); each buffer's share moves
+  // to `buffers` on RetrieveBuffer, and unretrieved shares are credited
+  // back when the manager is destroyed.
+  struct TmRec {
+    int slot = -1;
+    std::vector<int64_t> bytes;
+    std::vector<char> retrieved;
+  };
+  std::mutex tms_mu;
+  std::unordered_map<PJRT_AsyncHostToDeviceTransferManager*, TmRec> tms;
   // executable -> EMA cost in device-busy microseconds + static facts;
   // both evicted on PJRT_LoadedExecutable_Destroy (pointer reuse must not
   // serve a new executable the old one's cost/gate data)

@@ -473,10 +473,15 @@ int64_t ElementBytes(PJRT_Buffer_Type type) {
   }
 }
 
-int64_t HostBufferBytes(const PJRT_Client_BufferFromHostBuffer_Args* args) {
+int64_t DimsBytes(const int64_t* dims, size_t num_dims,
+                  PJRT_Buffer_Type type) {
   int64_t elems = 1;
-  for (size_t i = 0; i < args->num_dims; i++) elems *= args->dims[i];
-  return elems * ElementBytes(args->type);
+  for (size_t i = 0; i < num_dims; i++) elems *= dims[i];
+  return elems * ElementBytes(type);
+}
+
+int64_t HostBufferBytes(const PJRT_Client_BufferFromHostBuffer_Args* args) {
+  return DimsBytes(args->dims, args->num_dims, args->type);
 }
 
 void UpdatePeak(int slot, int64_t used) {
@@ -590,6 +595,284 @@ PJRT_Error* WrappedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
 // big enough to contain it (PJRT forward-compat contract).
 #define ARGS_HAS_FIELD(args, Type, field) \
   ((args)->struct_size >= offsetof(Type, field) + sizeof((args)->field))
+
+// ---------------------------------------------------------------------------
+// Alloc-path coverage beyond BufferFromHostBuffer.
+//
+// Reference parity: cuda_hook.c:2670-3300 hooks EVERY cuMemAlloc* variant
+// (pools, arrays, mipmaps, cuMemCreate) so no allocation escapes the cap.
+// PJRT's allocating client entries in the built-against header (v0.90):
+//   charged here:
+//     PJRT_Client_BufferFromHostBuffer            (above)
+//     PJRT_Client_CreateUninitializedBuffer       WrappedCreateUninitialized
+//     PJRT_Client_CreateViewOfDeviceBuffer        WrappedCreateView
+//     PJRT_Client_CreateBuffersForAsyncHostToDevice WrappedCreateAsyncH2D
+//       + RetrieveBuffer / TransferManager_Destroy settle the reservation
+//     PJRT_Buffer_CopyToDevice                    WrappedCopyToDevice
+//     PJRT_Buffer_CopyToMemory                    WrappedCopyToMemory
+//     PJRT_LoadedExecutable_Execute outputs       WrappedExecute (below)
+//   non-allocating by API contract (left unwrapped deliberately):
+//     PJRT_Client_CreateErrorBuffer     "without allocating memory" (header)
+//     PJRT_Client_CreateAliasBuffer     placeholder; the fulfilling buffer
+//                                       is charged on its own alloc path
+//     PJRT_Client_DmaMap                registers HOST memory
+//     PJRT_AsyncHostToDeviceTransferManager_TransferData/TransferLiteral
+//                                       write into buffers charged at
+//                                       manager creation
+//     PJRT_Buffer_CopyRawToHost(/Future)  D2H readback
+// ---------------------------------------------------------------------------
+
+PJRT_Client_CreateUninitializedBuffer* g_real_create_uninit = nullptr;
+PJRT_Client_CreateViewOfDeviceBuffer* g_real_create_view = nullptr;
+PJRT_Client_CreateBuffersForAsyncHostToDevice* g_real_create_asynch2d =
+    nullptr;
+PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer* g_real_tm_retrieve =
+    nullptr;
+PJRT_AsyncHostToDeviceTransferManager_Destroy* g_real_tm_destroy = nullptr;
+PJRT_Buffer_CopyToDevice* g_real_copy_to_device = nullptr;
+PJRT_Buffer_CopyToMemory* g_real_copy_to_memory = nullptr;
+
+// Memory-space -> slot. Host memory spaces (pinned_host/unpinned_host) are
+// not HBM and stay unmanaged; device spaces resolve through the first
+// addressable device.
+int SlotForMemory(PJRT_Memory* memory) {
+  ShimState& s = State();
+  if (!s.enforce || !memory) return -1;
+  if (s.real_api->PJRT_Memory_Kind) {
+    PJRT_Memory_Kind_Args kargs;
+    memset(&kargs, 0, sizeof(kargs));
+    kargs.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+    kargs.memory = memory;
+    if (!ConsumeError(s.real_api->PJRT_Memory_Kind(&kargs)) &&
+        kargs.kind && kargs.kind_size > 0) {
+      if (std::string(kargs.kind, kargs.kind_size).find("host") !=
+          std::string::npos)
+        return -1;
+    }
+  }
+  if (!s.real_api->PJRT_Memory_AddressableByDevices)
+    return s.device_count == 1 ? 0 : -1;
+  PJRT_Memory_AddressableByDevices_Args aargs;
+  memset(&aargs, 0, sizeof(aargs));
+  aargs.struct_size = PJRT_Memory_AddressableByDevices_Args_STRUCT_SIZE;
+  aargs.memory = memory;
+  if (ConsumeError(s.real_api->PJRT_Memory_AddressableByDevices(&aargs)) ||
+      aargs.num_devices == 0)
+    return s.device_count == 1 ? 0 : -1;
+  PJRT_Device* dev = const_cast<PJRT_Device*>(aargs.devices[0]);
+  // Host spaces are addressable by devices too, so when the kind string
+  // was unavailable the device resolution alone would misclassify
+  // pinned_host as HBM. The device's DEFAULT memory is its HBM space:
+  // any other space on the device is not charged.
+  if (s.real_api->PJRT_Device_DefaultMemory) {
+    PJRT_Device_DefaultMemory_Args dmargs;
+    memset(&dmargs, 0, sizeof(dmargs));
+    dmargs.struct_size = PJRT_Device_DefaultMemory_Args_STRUCT_SIZE;
+    dmargs.device = dev;
+    if (!ConsumeError(s.real_api->PJRT_Device_DefaultMemory(&dmargs)) &&
+        dmargs.memory && dmargs.memory != memory)
+      return -1;
+  }
+  return SlotForDevice(dev);
+}
+
+// Post-call reconciliation shared by the new alloc wraps: the reservation
+// was an estimate; once the real buffer exists, settle to its actual
+// on-device size and record it for destroy-time credit.
+void SettleAndTrack(int slot, int64_t reserved, PJRT_Buffer* buf) {
+  ShimState& s = State();
+  int64_t actual = reserved;
+  if (s.real_api->PJRT_Buffer_OnDeviceSizeInBytes) {
+    PJRT_Buffer_OnDeviceSizeInBytes_Args bargs;
+    memset(&bargs, 0, sizeof(bargs));
+    bargs.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+    bargs.buffer = buf;
+    if (!ConsumeError(s.real_api->PJRT_Buffer_OnDeviceSizeInBytes(&bargs)))
+      actual = (int64_t)bargs.on_device_size_in_bytes;
+  }
+  if (actual != reserved) {
+    s.hot[slot].used_bytes.fetch_add(actual - reserved,
+                                     std::memory_order_relaxed);
+    UpdatePeak(slot, s.hot[slot].used_bytes.load(std::memory_order_relaxed));
+  }
+  TrackBuffer(buf, slot, actual);
+}
+
+PJRT_Error* WrappedCreateUninitialized(
+    PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  int slot = ARGS_HAS_FIELD(args, PJRT_Client_CreateUninitializedBuffer_Args,
+                            memory) && args->memory
+      ? SlotForMemory(args->memory)
+      : SlotForDevice(args->device);
+  if (slot < 0) return g_real_create_uninit(args);
+  int64_t bytes = DimsBytes(args->shape_dims, args->shape_num_dims,
+                            args->shape_element_type);
+  if (PJRT_Error* err = ReserveMemory(slot, bytes)) return err;
+  PJRT_Error* err = g_real_create_uninit(args);
+  if (err || !args->buffer) {
+    UnreserveMemory(slot, bytes);
+    return err;
+  }
+  SettleAndTrack(slot, bytes, args->buffer);
+  return nullptr;
+}
+
+// Views wrap device memory allocated OUTSIDE PJRT (dlpack imports). On TPU
+// every byte of tenant-reachable HBM comes through some PJRT client, so a
+// view usually aliases an already-charged buffer — but a view over a
+// buffer whose owning PJRT_Buffer was destroyed (credited) would otherwise
+// hold HBM outside the cap. Charge views by default; VTPU_CHARGE_VIEWS=0
+// opts out for dlpack-heavy workloads that would double-count.
+bool ChargeViews() {
+  static int v = [] {
+    const char* e = getenv("VTPU_CHARGE_VIEWS");
+    return (e && e[0] == '0') ? 0 : 1;
+  }();
+  return v == 1;
+}
+
+PJRT_Error* WrappedCreateView(
+    PJRT_Client_CreateViewOfDeviceBuffer_Args* args) {
+  int slot = ARGS_HAS_FIELD(args, PJRT_Client_CreateViewOfDeviceBuffer_Args,
+                            memory) && args->memory
+      ? SlotForMemory(args->memory)
+      : SlotForDevice(args->device);
+  if (slot < 0 || !ChargeViews()) return g_real_create_view(args);
+  int64_t bytes = DimsBytes(args->dims, args->num_dims, args->element_type);
+  if (PJRT_Error* err = ReserveMemory(slot, bytes)) return err;
+  PJRT_Error* err = g_real_create_view(args);
+  if (err || !args->buffer) {
+    UnreserveMemory(slot, bytes);
+    return err;
+  }
+  // no SettleAndTrack: a view's OnDeviceSize reflects the underlying
+  // buffer; the shape-derived estimate IS the charge we must credit back
+  TrackBuffer(args->buffer, slot, bytes);
+  return nullptr;
+}
+
+PJRT_Error* WrappedCreateAsyncH2D(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  int slot = SlotForMemory(args->memory);
+  if (slot < 0) return g_real_create_asynch2d(args);
+  ShimState::TmRec rec;
+  rec.slot = slot;
+  int64_t total = 0;
+  for (size_t i = 0; i < args->num_shape_specs; i++) {
+    const PJRT_ShapeSpec& spec = args->shape_specs[i];
+    int64_t b = DimsBytes(spec.dims, spec.num_dims, spec.element_type);
+    rec.bytes.push_back(b);
+    total += b;
+  }
+  rec.retrieved.assign(rec.bytes.size(), 0);
+  if (PJRT_Error* err = ReserveMemory(slot, total)) return err;
+  PJRT_Error* err = g_real_create_asynch2d(args);
+  if (err || !args->transfer_manager) {
+    UnreserveMemory(slot, total);
+    return err;
+  }
+  // Publish to the cross-process ledger NOW: the manager may stream
+  // transfers for a long time before any RetrieveBuffer, and sibling
+  // processes admit against ledger bytes — an unpublished reservation
+  // would let the tenant jointly overshoot its cap.
+  RecordOwnBytes(slot);
+  ShimState& s = State();
+  std::lock_guard<std::mutex> g(s.tms_mu);
+  s.tms[args->transfer_manager] = std::move(rec);
+  return nullptr;
+}
+
+PJRT_Error* WrappedTmRetrieveBuffer(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  PJRT_Error* err = g_real_tm_retrieve(args);
+  if (err || !args->buffer_out) return err;
+  ShimState& s = State();
+  int slot = -1;
+  int64_t bytes = 0;
+  {
+    std::lock_guard<std::mutex> g(s.tms_mu);
+    auto it = s.tms.find(args->transfer_manager);
+    if (it != s.tms.end() && args->buffer_index >= 0 &&
+        (size_t)args->buffer_index < it->second.bytes.size() &&
+        !it->second.retrieved[args->buffer_index]) {
+      it->second.retrieved[args->buffer_index] = 1;
+      slot = it->second.slot;
+      bytes = it->second.bytes[args->buffer_index];
+    }
+  }
+  // ownership of the reserved bytes moves to the buffer record, so
+  // Buffer_Destroy credits them exactly once
+  if (slot >= 0) TrackBuffer(args->buffer_out, slot, bytes);
+  return nullptr;
+}
+
+PJRT_Error* WrappedTmDestroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* args) {
+  ShimState& s = State();
+  int slot = -1;
+  int64_t unretrieved = 0;
+  {
+    std::lock_guard<std::mutex> g(s.tms_mu);
+    auto it = s.tms.find(args->transfer_manager);
+    if (it != s.tms.end()) {
+      slot = it->second.slot;
+      for (size_t i = 0; i < it->second.bytes.size(); i++)
+        if (!it->second.retrieved[i]) unretrieved += it->second.bytes[i];
+      s.tms.erase(it);
+    }
+  }
+  PJRT_Error* err = g_real_tm_destroy(args);
+  if (slot >= 0 && unretrieved > 0) {
+    UnreserveMemory(slot, unretrieved);
+    RecordOwnBytes(slot);   // keep the cross-process ledger in step
+  }
+  return err;
+}
+
+int64_t SourceBufferBytes(PJRT_Buffer* buf) {
+  ShimState& s = State();
+  {
+    std::lock_guard<std::mutex> g(s.buffers_mu);
+    auto it = s.buffers.find(buf);
+    if (it != s.buffers.end()) return it->second.second;
+  }
+  if (!s.real_api->PJRT_Buffer_OnDeviceSizeInBytes) return 0;
+  PJRT_Buffer_OnDeviceSizeInBytes_Args bargs;
+  memset(&bargs, 0, sizeof(bargs));
+  bargs.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  bargs.buffer = buf;
+  if (ConsumeError(s.real_api->PJRT_Buffer_OnDeviceSizeInBytes(&bargs)))
+    return 0;
+  return (int64_t)bargs.on_device_size_in_bytes;
+}
+
+PJRT_Error* WrappedCopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  int slot = SlotForDevice(args->dst_device);
+  if (slot < 0) return g_real_copy_to_device(args);
+  int64_t bytes = SourceBufferBytes(args->buffer);
+  if (PJRT_Error* err = ReserveMemory(slot, bytes)) return err;
+  PJRT_Error* err = g_real_copy_to_device(args);
+  if (err || !args->dst_buffer) {
+    UnreserveMemory(slot, bytes);
+    return err;
+  }
+  SettleAndTrack(slot, bytes, args->dst_buffer);
+  return nullptr;
+}
+
+PJRT_Error* WrappedCopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
+  int slot = SlotForMemory(args->dst_memory);
+  if (slot < 0) return g_real_copy_to_memory(args);
+  int64_t bytes = SourceBufferBytes(args->buffer);
+  if (PJRT_Error* err = ReserveMemory(slot, bytes)) return err;
+  PJRT_Error* err = g_real_copy_to_memory(args);
+  if (err || !args->dst_buffer) {
+    UnreserveMemory(slot, bytes);
+    return err;
+  }
+  SettleAndTrack(slot, bytes, args->dst_buffer);
+  return nullptr;
+}
 
 // View faking (reference _cuMemGetInfo cuda_hook.c:3235-3309,
 // nvmlDeviceGetMemoryInfo nvml_hook.c:47-103): report the cap as the limit
@@ -1481,6 +1764,39 @@ void WrapEnforcementEntries(PJRT_Api* api) {
   api->PJRT_LoadedExecutable_Execute = WrappedExecute;
   api->PJRT_Buffer_ToHostBuffer = WrappedToHostBuffer;
   api->PJRT_LoadedExecutable_Destroy = WrappedLoadedExecutableDestroy;
+  // Remaining alloc paths (see the coverage table above WrappedCreate*).
+  // Each is wrapped only if the real plugin serves it — a null real entry
+  // stays null so callers see the same capability surface.
+  if (api->PJRT_Client_CreateUninitializedBuffer) {
+    g_real_create_uninit = api->PJRT_Client_CreateUninitializedBuffer;
+    api->PJRT_Client_CreateUninitializedBuffer = WrappedCreateUninitialized;
+  }
+  if (api->PJRT_Client_CreateViewOfDeviceBuffer) {
+    g_real_create_view = api->PJRT_Client_CreateViewOfDeviceBuffer;
+    api->PJRT_Client_CreateViewOfDeviceBuffer = WrappedCreateView;
+  }
+  if (api->PJRT_Client_CreateBuffersForAsyncHostToDevice &&
+      api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer &&
+      api->PJRT_AsyncHostToDeviceTransferManager_Destroy) {
+    g_real_create_asynch2d =
+        api->PJRT_Client_CreateBuffersForAsyncHostToDevice;
+    g_real_tm_retrieve =
+        api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer;
+    g_real_tm_destroy = api->PJRT_AsyncHostToDeviceTransferManager_Destroy;
+    api->PJRT_Client_CreateBuffersForAsyncHostToDevice =
+        WrappedCreateAsyncH2D;
+    api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer =
+        WrappedTmRetrieveBuffer;
+    api->PJRT_AsyncHostToDeviceTransferManager_Destroy = WrappedTmDestroy;
+  }
+  if (api->PJRT_Buffer_CopyToDevice) {
+    g_real_copy_to_device = api->PJRT_Buffer_CopyToDevice;
+    api->PJRT_Buffer_CopyToDevice = WrappedCopyToDevice;
+  }
+  if (api->PJRT_Buffer_CopyToMemory) {
+    g_real_copy_to_memory = api->PJRT_Buffer_CopyToMemory;
+    api->PJRT_Buffer_CopyToMemory = WrappedCopyToMemory;
+  }
 }
 
 }  // namespace vtpu

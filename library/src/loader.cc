@@ -275,6 +275,7 @@ static void ChildAfterFork() {
   // meaningfully and let the watcher restart lazily.
   new (&s.buffers_mu) std::mutex();
   new (&s.cost_mu) std::mutex();
+  new (&s.tms_mu) std::mutex();
   for (int i = 0; i < kMaxDeviceCount; i++) {
     s.hot[i].inflight.store(0);
     s.hot[i].busy_ns_window.store(0);

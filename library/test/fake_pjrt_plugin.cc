@@ -41,23 +41,53 @@ struct FakeError {
 
 struct FakeDevice {
   int id = 0;
+  std::atomic<int64_t> bytes_in_use{0};
+  int64_t bytes_limit = 1ll << 30;  // fake physical HBM per chip
 };
 
+struct FakeMemory {
+  FakeDevice* device = nullptr;   // null = host memory space
+  const char* kind = "device";
+};
+
+constexpr int kFakeMaxDevices = 8;
+
+int DeviceCount() {
+  static int n = [] {
+    const char* v = getenv("FAKE_DEVICE_COUNT");
+    int c = v ? atoi(v) : 1;
+    return c < 1 ? 1 : (c > kFakeMaxDevices ? kFakeMaxDevices : c);
+  }();
+  return n;
+}
+
 struct FakeClient {
-  FakeDevice device;
-  PJRT_Device* device_ptr() {
-    return reinterpret_cast<PJRT_Device*>(&device);
+  FakeDevice devices[kFakeMaxDevices];
+  FakeMemory device_mems[kFakeMaxDevices];
+  FakeMemory host_mem{nullptr, "unpinned_host"};
+  FakeClient() {
+    for (int i = 0; i < kFakeMaxDevices; i++) {
+      devices[i].id = i;
+      device_mems[i].device = &devices[i];
+    }
   }
-  std::atomic<int64_t> bytes_in_use{0};
-  int64_t bytes_limit = 1ll << 30;  // fake physical HBM
+  PJRT_Device* device_ptr(int i = 0) {
+    return reinterpret_cast<PJRT_Device*>(&devices[i]);
+  }
 };
 
 FakeClient* g_client = nullptr;
+
+FakeDevice* DeviceOf(PJRT_Device* d) {
+  return d ? reinterpret_cast<FakeDevice*>(d) : &g_client->devices[0];
+}
 
 struct FakeEvent;
 struct FakeBuffer {
   int64_t size;
   FakeEvent* ready = nullptr;  // fires when the producing exec completes
+  int device_id = 0;
+  bool owns = true;            // views do not own (or charge) their bytes
 };
 
 struct FakeEvent {
@@ -188,10 +218,10 @@ PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
 }
 
 PJRT_Error* ClientDevices(PJRT_Client_Devices_Args* args) {
-  static PJRT_Device* devs[1];
-  devs[0] = g_client->device_ptr();
+  static PJRT_Device* devs[kFakeMaxDevices];
+  for (int i = 0; i < DeviceCount(); i++) devs[i] = g_client->device_ptr(i);
   args->devices = devs;
-  args->num_devices = 1;
+  args->num_devices = (size_t)DeviceCount();
   return nullptr;
 }
 
@@ -207,20 +237,35 @@ PJRT_Error* DeviceDescriptionId(PJRT_DeviceDescription_Id_Args* args) {
   return nullptr;
 }
 
-PJRT_Error* BufferFromHostBuffer(
-    PJRT_Client_BufferFromHostBuffer_Args* args) {
-  int64_t elems = 1;
-  for (size_t i = 0; i < args->num_dims; i++) elems *= args->dims[i];
-  int64_t size = elems * 4;  // fake: assume 4-byte elements
-  auto* client = reinterpret_cast<FakeClient*>(args->client);
-  if (client->bytes_in_use.load() + size > client->bytes_limit) {
+// Allocate `size` bytes on `dev`, producing a ready FakeBuffer; shared by
+// every allocating entry so the per-chip OOM check lives in one place.
+PJRT_Error* AllocOnDevice(FakeDevice* dev, int64_t size, FakeBuffer** out) {
+  if (dev->bytes_in_use.load() + size > dev->bytes_limit) {
     return MakeFakeError(PJRT_Error_Code_RESOURCE_EXHAUSTED,
                          "fake plugin: physical OOM");
   }
-  client->bytes_in_use.fetch_add(size);
+  dev->bytes_in_use.fetch_add(size);
   auto* buf = new FakeBuffer{size};
+  buf->device_id = dev->id;
   buf->ready = new FakeEvent();
-  buf->ready->MarkReady();  // host upload: ready immediately
+  buf->ready->MarkReady();
+  *out = buf;
+  return nullptr;
+}
+
+int64_t FakeShapeBytes(const int64_t* dims, size_t num_dims) {
+  int64_t elems = 1;
+  for (size_t i = 0; i < num_dims; i++) elems *= dims[i];
+  return elems * 4;  // fake: assume 4-byte elements
+}
+
+PJRT_Error* BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  FakeBuffer* buf = nullptr;
+  if (PJRT_Error* err = AllocOnDevice(
+          DeviceOf(args->device),
+          FakeShapeBytes(args->dims, args->num_dims), &buf))
+    return err;
   args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
   auto* evt = new FakeEvent();
   evt->MarkReady();  // host copy "completes" immediately
@@ -240,7 +285,8 @@ PJRT_Error* BufferReadyEvent(PJRT_Buffer_ReadyEvent_Args* args) {
 
 PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
   auto* buf = reinterpret_cast<FakeBuffer*>(args->buffer);
-  if (g_client) g_client->bytes_in_use.fetch_sub(buf->size);
+  if (g_client && buf->owns)
+    g_client->devices[buf->device_id].bytes_in_use.fetch_sub(buf->size);
   delete buf;
   return nullptr;
 }
@@ -252,8 +298,9 @@ PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
 }
 
 PJRT_Error* DeviceMemoryStats(PJRT_Device_MemoryStats_Args* args) {
-  args->bytes_in_use = g_client ? g_client->bytes_in_use.load() : 0;
-  args->bytes_limit = g_client ? g_client->bytes_limit : 0;
+  FakeDevice* dev = DeviceOf(args->device);
+  args->bytes_in_use = dev->bytes_in_use.load();
+  args->bytes_limit = dev->bytes_limit;
   args->bytes_limit_is_set = true;
   return nullptr;
 }
@@ -392,9 +439,11 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     FakeEvent* out_ready = new FakeEvent();
     if (args->output_lists && args->output_lists[d]) {
       auto* out = new FakeBuffer{OutBytes()};
+      out->device_id = (int)d < DeviceCount() ? (int)d : 0;
       out->ready = out_ready;
       args->output_lists[d][0] = reinterpret_cast<PJRT_Buffer*>(out);
-      if (g_client) g_client->bytes_in_use.fetch_add(OutBytes());
+      if (g_client)
+        g_client->devices[out->device_id].bytes_in_use.fetch_add(OutBytes());
     }
     if (args->device_complete_events) {
       args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(done);
@@ -414,6 +463,199 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     JobsCv().notify_one();
     if (Trace()) fprintf(stderr, "[fake] enqueued\n");
   }
+  return nullptr;
+}
+
+// --- memory spaces + extended alloc paths ----------------------------------
+// Serve every alloc entry the shim wraps so the per-path cap tests run
+// hermetically (the analogue of the reference's fake-NVML fixtures serving
+// each cuMemAlloc* variant).
+
+PJRT_Error* MemoryKind(PJRT_Memory_Kind_Args* args) {
+  auto* mem = reinterpret_cast<FakeMemory*>(args->memory);
+  args->kind = mem->kind;
+  args->kind_size = strlen(mem->kind);
+  return nullptr;
+}
+
+PJRT_Error* MemoryAddressableByDevices(
+    PJRT_Memory_AddressableByDevices_Args* args) {
+  auto* mem = reinterpret_cast<FakeMemory*>(args->memory);
+  static PJRT_Device* one[1];
+  if (!mem->device) {
+    args->devices = nullptr;
+    args->num_devices = 0;
+    return nullptr;
+  }
+  one[0] = reinterpret_cast<PJRT_Device*>(mem->device);
+  args->devices = one;
+  args->num_devices = 1;
+  return nullptr;
+}
+
+PJRT_Error* DeviceDefaultMemory(PJRT_Device_DefaultMemory_Args* args) {
+  FakeDevice* dev = DeviceOf(args->device);
+  args->memory =
+      reinterpret_cast<PJRT_Memory*>(&g_client->device_mems[dev->id]);
+  return nullptr;
+}
+
+PJRT_Error* DeviceAddressableMemories(
+    PJRT_Device_AddressableMemories_Args* args) {
+  FakeDevice* dev = DeviceOf(args->device);
+  static PJRT_Memory* mems[2];
+  mems[0] = reinterpret_cast<PJRT_Memory*>(&g_client->device_mems[dev->id]);
+  mems[1] = reinterpret_cast<PJRT_Memory*>(&g_client->host_mem);
+  args->memories = mems;
+  args->num_memories = 2;
+  return nullptr;
+}
+
+PJRT_Error* CreateUninitializedBuffer(
+    PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  FakeDevice* dev = args->memory
+      ? reinterpret_cast<FakeMemory*>(args->memory)->device
+      : DeviceOf(args->device);
+  if (!dev) {
+    return MakeFakeError(PJRT_Error_Code_UNIMPLEMENTED,
+                         "fake plugin: host-memory uninit buffers");
+  }
+  FakeBuffer* buf = nullptr;
+  if (PJRT_Error* err = AllocOnDevice(
+          dev, FakeShapeBytes(args->shape_dims, args->shape_num_dims), &buf))
+    return err;
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
+  return nullptr;
+}
+
+PJRT_Error* CreateViewOfDeviceBuffer(
+    PJRT_Client_CreateViewOfDeviceBuffer_Args* args) {
+  FakeDevice* dev = DeviceOf(args->device);
+  // a view is non-owned: no charge against the fake chip's physical pool
+  auto* buf = new FakeBuffer{FakeShapeBytes(args->dims, args->num_dims)};
+  buf->device_id = dev->id;
+  buf->owns = false;
+  buf->ready = new FakeEvent();
+  buf->ready->MarkReady();
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
+  return nullptr;
+}
+
+struct FakeTm {
+  FakeDevice* device;
+  std::vector<FakeBuffer*> bufs;
+  std::vector<bool> retrieved;
+};
+
+PJRT_Error* CreateBuffersForAsyncHostToDevice(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  auto* mem = reinterpret_cast<FakeMemory*>(args->memory);
+  if (!mem || !mem->device) {
+    return MakeFakeError(PJRT_Error_Code_INVALID_ARGUMENT,
+                         "fake plugin: async H2D needs a device memory");
+  }
+  auto* tm = new FakeTm{mem->device, {}, {}};
+  for (size_t i = 0; i < args->num_shape_specs; i++) {
+    FakeBuffer* buf = nullptr;
+    PJRT_Error* err = AllocOnDevice(
+        mem->device,
+        FakeShapeBytes(args->shape_specs[i].dims,
+                       args->shape_specs[i].num_dims),
+        &buf);
+    if (err) {
+      for (auto* b : tm->bufs) {
+        mem->device->bytes_in_use.fetch_sub(b->size);
+        delete b;
+      }
+      delete tm;
+      return err;
+    }
+    tm->bufs.push_back(buf);
+    tm->retrieved.push_back(false);
+  }
+  args->transfer_manager =
+      reinterpret_cast<PJRT_AsyncHostToDeviceTransferManager*>(tm);
+  return nullptr;
+}
+
+PJRT_Error* TmRetrieveBuffer(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  auto* tm = reinterpret_cast<FakeTm*>(args->transfer_manager);
+  if (args->buffer_index < 0 ||
+      (size_t)args->buffer_index >= tm->bufs.size()) {
+    return MakeFakeError(PJRT_Error_Code_INVALID_ARGUMENT,
+                         "fake plugin: bad buffer index");
+  }
+  tm->retrieved[args->buffer_index] = true;
+  args->buffer_out =
+      reinterpret_cast<PJRT_Buffer*>(tm->bufs[args->buffer_index]);
+  return nullptr;
+}
+
+PJRT_Error* TmTransferData(
+    PJRT_AsyncHostToDeviceTransferManager_TransferData_Args* args) {
+  auto* evt = new FakeEvent();
+  evt->MarkReady();
+  args->done_with_h2d_transfer = reinterpret_cast<PJRT_Event*>(evt);
+  return nullptr;
+}
+
+PJRT_Error* TmBufferCount(
+    PJRT_AsyncHostToDeviceTransferManager_BufferCount_Args* args) {
+  args->buffer_count =
+      reinterpret_cast<FakeTm*>(args->transfer_manager)->bufs.size();
+  return nullptr;
+}
+
+PJRT_Error* TmBufferSize(
+    PJRT_AsyncHostToDeviceTransferManager_BufferSize_Args* args) {
+  auto* tm = reinterpret_cast<FakeTm*>(args->transfer_manager);
+  args->buffer_size = (size_t)tm->bufs[args->buffer_index]->size;
+  return nullptr;
+}
+
+PJRT_Error* TmDevice(
+    PJRT_AsyncHostToDeviceTransferManager_Device_Args* args) {
+  args->device_out = reinterpret_cast<PJRT_Device*>(
+      reinterpret_cast<FakeTm*>(args->transfer_manager)->device);
+  return nullptr;
+}
+
+PJRT_Error* TmDestroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* args) {
+  auto* tm = reinterpret_cast<FakeTm*>(args->transfer_manager);
+  if (!tm) return nullptr;
+  for (size_t i = 0; i < tm->bufs.size(); i++) {
+    if (!tm->retrieved[i]) {   // unretrieved buffers die with the manager
+      tm->device->bytes_in_use.fetch_sub(tm->bufs[i]->size);
+      delete tm->bufs[i];
+    }
+  }
+  delete tm;
+  return nullptr;
+}
+
+PJRT_Error* BufferCopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  auto* src = reinterpret_cast<FakeBuffer*>(args->buffer);
+  FakeBuffer* dst = nullptr;
+  if (PJRT_Error* err = AllocOnDevice(DeviceOf(args->dst_device),
+                                      src->size, &dst))
+    return err;
+  args->dst_buffer = reinterpret_cast<PJRT_Buffer*>(dst);
+  return nullptr;
+}
+
+PJRT_Error* BufferCopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
+  auto* mem = reinterpret_cast<FakeMemory*>(args->dst_memory);
+  if (!mem->device) {
+    return MakeFakeError(PJRT_Error_Code_UNIMPLEMENTED,
+                         "fake plugin: copies to host memory");
+  }
+  auto* src = reinterpret_cast<FakeBuffer*>(args->buffer);
+  FakeBuffer* dst = nullptr;
+  if (PJRT_Error* err = AllocOnDevice(mem->device, src->size, &dst))
+    return err;
+  args->dst_buffer = reinterpret_cast<PJRT_Buffer*>(dst);
   return nullptr;
 }
 
@@ -447,6 +689,23 @@ void InitApi() {
   g_api.PJRT_Executable_NumOutputs = ExecutableNumOutputs;
   g_api.PJRT_Executable_Destroy = ExecutableDestroy;
   g_api.PJRT_LoadedExecutable_Execute = Execute;
+  g_api.PJRT_Memory_Kind = MemoryKind;
+  g_api.PJRT_Memory_AddressableByDevices = MemoryAddressableByDevices;
+  g_api.PJRT_Device_AddressableMemories = DeviceAddressableMemories;
+  g_api.PJRT_Device_DefaultMemory = DeviceDefaultMemory;
+  g_api.PJRT_Client_CreateUninitializedBuffer = CreateUninitializedBuffer;
+  g_api.PJRT_Client_CreateViewOfDeviceBuffer = CreateViewOfDeviceBuffer;
+  g_api.PJRT_Client_CreateBuffersForAsyncHostToDevice =
+      CreateBuffersForAsyncHostToDevice;
+  g_api.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer =
+      TmRetrieveBuffer;
+  g_api.PJRT_AsyncHostToDeviceTransferManager_TransferData = TmTransferData;
+  g_api.PJRT_AsyncHostToDeviceTransferManager_BufferCount = TmBufferCount;
+  g_api.PJRT_AsyncHostToDeviceTransferManager_BufferSize = TmBufferSize;
+  g_api.PJRT_AsyncHostToDeviceTransferManager_Device = TmDevice;
+  g_api.PJRT_AsyncHostToDeviceTransferManager_Destroy = TmDestroy;
+  g_api.PJRT_Buffer_CopyToDevice = BufferCopyToDevice;
+  g_api.PJRT_Buffer_CopyToMemory = BufferCopyToMemory;
 }
 
 }  // namespace

@@ -55,6 +55,7 @@ static PJRT_Buffer* Alloc(const PJRT_Api* api, PJRT_Client* client,
 }
 
 static void Destroy(const PJRT_Api* api, PJRT_Buffer* buf) {
+  if (!buf) return;  // a failed alloc in a FAIL-expected scenario
   PJRT_Buffer_Destroy_Args args;
   memset(&args, 0, sizeof(args));
   args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
@@ -89,8 +90,89 @@ static void CheckErrorIsOom(const PJRT_Api* api, PJRT_Error* err) {
   api->PJRT_Error_Destroy(&dargs);
 }
 
+// Multi-chip enforcement: per-chip caps and quotas must be independent
+// (reference: per-device batching in cuda_hook.c:1667-1690 — each GPU's
+// budget is its own). Driven with FAKE_DEVICE_COUNT=2 and distinct
+// VTPU_MEM_LIMIT_0/_1 + VTPU_CORE_LIMIT_0/_1.
+static int RunMultichip(const PJRT_Api* api, PJRT_Client* client,
+                        PJRT_Device* dev0, PJRT_Device* dev1) {
+  PJRT_Error* err = nullptr;
+  printf("[M1] independent per-chip HBM caps (1MiB / 2MiB)\n");
+  // chip 0: 768 KiB fits, +512 KiB breaks the 1 MiB cap
+  PJRT_Buffer* a0 = Alloc(api, client, dev0, 196608, &err);
+  CHECK(!err && a0, "dev0 768KiB should fit");
+  PJRT_Buffer* over0 = Alloc(api, client, dev0, 131072, &err);
+  (void)over0;
+  CheckErrorIsOom(api, err);
+  // chip 1 is untouched by chip 0's pressure: 1.5 MiB fits under 2 MiB
+  PJRT_Buffer* a1 = Alloc(api, client, dev1, 393216, &err);
+  CHECK(!err && a1, "dev1 1.5MiB should fit despite dev0 at cap");
+  PJRT_Buffer* over1 = Alloc(api, client, dev1, 196608, &err);
+  (void)over1;
+  CheckErrorIsOom(api, err);
+  // per-chip MemoryStats views
+  for (int i = 0; i < 2; i++) {
+    PJRT_Device_MemoryStats_Args margs;
+    memset(&margs, 0, sizeof(margs));
+    margs.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+    margs.device = i == 0 ? dev0 : dev1;
+    CHECK(!api->PJRT_Device_MemoryStats(&margs), "memstats dev%d", i);
+    int64_t want_limit = i == 0 ? 1048576 : 2097152;
+    CHECK(margs.bytes_limit == want_limit,
+          "dev%d bytes_limit=%lld want %lld", i,
+          (long long)margs.bytes_limit, (long long)want_limit);
+  }
+  Destroy(api, a0);
+  Destroy(api, a1);
+  printf("[M1] PASS\n");
+
+  printf("[M2] multi-device execute paced by the tighter chip quota\n");
+  {
+    auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
+    int iters = 30;
+    uint64_t t0 = NowMs();
+    for (int i = 0; i < iters; i++) {
+      PJRT_LoadedExecutable_Execute_Args eargs;
+      memset(&eargs, 0, sizeof(eargs));
+      eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+      eargs.executable = fake_exe;
+      eargs.num_devices = 2;
+      PJRT_Buffer* outs0[1] = {nullptr};
+      PJRT_Buffer* outs1[1] = {nullptr};
+      PJRT_Buffer** outlists[2] = {outs0, outs1};
+      eargs.output_lists = outlists;
+      PJRT_Event* events[2] = {nullptr, nullptr};
+      eargs.device_complete_events = events;
+      err = api->PJRT_LoadedExecutable_Execute(&eargs);
+      CHECK(!err, "multichip execute %d errored", i);
+      for (int d = 0; d < 2; d++) {
+        if (!events[d]) continue;
+        PJRT_Event_Await_Args aargs;
+        memset(&aargs, 0, sizeof(aargs));
+        aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+        aargs.event = events[d];
+        api->PJRT_Event_Await(&aargs);
+      }
+      Destroy(api, outs0[0]);
+      Destroy(api, outs1[0]);
+    }
+    uint64_t wall = NowMs() - t0;
+    // 30 execs x 2 ms busy on each chip; chip 1's 10% quota must govern:
+    // 60 ms / 0.10 = 600 ms minimum if its budget is applied per-chip
+    // (a 50/10 average of 30% would finish in ~200 ms).
+    printf("  iters=%d wall=%llums\n", iters, (unsigned long long)wall);
+    CHECK(wall >= 300, "chip-1 quota not applied per-chip: wall=%llu",
+          (unsigned long long)wall);
+    CHECK(wall <= 8000, "wedged: wall=%llu", (unsigned long long)wall);
+    printf("[M2] PASS\n");
+  }
+  printf(g_failures ? "FAILURES: %d\n" : "ALL PASS\n", g_failures);
+  return g_failures ? 1 : 0;
+}
+
 int main(int argc, char** argv) {
   bool throttle_only = argc > 1 && !strcmp(argv[1], "--throttle-only");
+  bool multichip = argc > 1 && !strcmp(argv[1], "--multichip");
   const char* shim_path = getenv("SHIM_PATH");
   if (!shim_path) {
     fprintf(stderr, "SHIM_PATH not set\n");
@@ -118,8 +200,14 @@ int main(int argc, char** argv) {
   devargs.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
   devargs.client = client;
   CHECK(!api->PJRT_Client_Devices(&devargs), "devices failed");
-  CHECK(devargs.num_devices == 1, "ndev=%zu", devargs.num_devices);
+  size_t want_ndev = multichip ? 2 : 1;
+  CHECK(devargs.num_devices == want_ndev, "ndev=%zu want %zu",
+        devargs.num_devices, want_ndev);
   PJRT_Device* dev = devargs.devices[0];
+  if (multichip) {
+    if (devargs.num_devices < 2) return 2;
+    return RunMultichip(api, client, devargs.devices[0], devargs.devices[1]);
+  }
 
   PJRT_Error* err = nullptr;
   if (!throttle_only) {
@@ -155,10 +243,169 @@ int main(int argc, char** argv) {
         "bytes_in_use=%lld want 1048576", (long long)margs.bytes_in_use);
   printf("[2] PASS\n");
 
+  // --------------------------------------------- extended alloc paths
+  // Every allocating PJRT entry must hit the same cap (reference parity:
+  // cuda_hook.c covers every cuMemAlloc* variant). Clean slate first.
+  printf("[4] alloc-path coverage (uninit/view/asyncH2D/copy)\n");
+  Destroy(api, bufs[1]);
+  Destroy(api, bufs[2]);
+  Destroy(api, retry);
+
+  // 4a. CreateUninitializedBuffer charges; over-cap rejected
+  {
+    int64_t dims[1] = {196608};  // 768 KiB
+    PJRT_Client_CreateUninitializedBuffer_Args uargs;
+    memset(&uargs, 0, sizeof(uargs));
+    uargs.struct_size = PJRT_Client_CreateUninitializedBuffer_Args_STRUCT_SIZE;
+    uargs.client = client;
+    uargs.shape_dims = dims;
+    uargs.shape_num_dims = 1;
+    uargs.shape_element_type = PJRT_Buffer_Type_F32;
+    uargs.device = dev;
+    err = api->PJRT_Client_CreateUninitializedBuffer(&uargs);
+    CHECK(!err && uargs.buffer, "uninit 768KiB should fit");
+    PJRT_Buffer* uninit = uargs.buffer;
+    PJRT_Client_CreateUninitializedBuffer_Args uargs2 = uargs;
+    int64_t dims2[1] = {131072};  // 512 KiB -> would exceed 1 MiB
+    uargs2.shape_dims = dims2;
+    uargs2.buffer = nullptr;
+    err = api->PJRT_Client_CreateUninitializedBuffer(&uargs2);
+    CheckErrorIsOom(api, err);
+    Destroy(api, uninit);
+  }
+
+  // 4b. CreateViewOfDeviceBuffer charged by default (VTPU_CHARGE_VIEWS)
+  {
+    int64_t dims[1] = {196608};  // 768 KiB
+    char backing[16];
+    PJRT_Client_CreateViewOfDeviceBuffer_Args vargs;
+    memset(&vargs, 0, sizeof(vargs));
+    vargs.struct_size = PJRT_Client_CreateViewOfDeviceBuffer_Args_STRUCT_SIZE;
+    vargs.client = client;
+    vargs.device_buffer_ptr = backing;
+    vargs.dims = dims;
+    vargs.num_dims = 1;
+    vargs.element_type = PJRT_Buffer_Type_F32;
+    vargs.device = dev;
+    err = api->PJRT_Client_CreateViewOfDeviceBuffer(&vargs);
+    CHECK(!err && vargs.buffer, "view 768KiB should fit");
+    PJRT_Buffer* view = vargs.buffer;
+    PJRT_Client_CreateViewOfDeviceBuffer_Args vargs2 = vargs;
+    int64_t dims2[1] = {131072};  // 512 KiB over cap
+    vargs2.dims = dims2;
+    vargs2.buffer = nullptr;
+    err = api->PJRT_Client_CreateViewOfDeviceBuffer(&vargs2);
+    CheckErrorIsOom(api, err);
+    Destroy(api, view);  // credits the view's charge
+  }
+
+  // 4c. AsyncHostToDevice: reserve at create, settle via retrieve/destroy
+  {
+    PJRT_Device_AddressableMemories_Args amargs;
+    memset(&amargs, 0, sizeof(amargs));
+    amargs.struct_size = PJRT_Device_AddressableMemories_Args_STRUCT_SIZE;
+    amargs.device = dev;
+    CHECK(!api->PJRT_Device_AddressableMemories(&amargs) &&
+          amargs.num_memories > 0, "addressable memories");
+    PJRT_Memory* dev_mem = amargs.memories[0];
+
+    int64_t d1[1] = {131072}, d2[1] = {131072};  // 512 KiB x2 = 1 MiB
+    PJRT_ShapeSpec specs[2];
+    memset(specs, 0, sizeof(specs));
+    specs[0].struct_size = specs[1].struct_size = PJRT_ShapeSpec_STRUCT_SIZE;
+    specs[0].dims = d1;
+    specs[0].num_dims = 1;
+    specs[0].element_type = PJRT_Buffer_Type_F32;
+    specs[1] = specs[0];
+    specs[1].dims = d2;
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args targs;
+    memset(&targs, 0, sizeof(targs));
+    targs.struct_size =
+        PJRT_Client_CreateBuffersForAsyncHostToDevice_Args_STRUCT_SIZE;
+    targs.client = client;
+    targs.shape_specs = specs;
+    targs.num_shape_specs = 2;
+    targs.memory = dev_mem;
+    err = api->PJRT_Client_CreateBuffersForAsyncHostToDevice(&targs);
+    CHECK(!err && targs.transfer_manager, "asyncH2D 1MiB should fit");
+    PJRT_AsyncHostToDeviceTransferManager* tm = targs.transfer_manager;
+    if (tm) {   // skip the rest in FAIL-expected co-tenant scenarios
+
+    // cap is now full: any further alloc must be rejected
+    PJRT_Buffer* over2 = Alloc(api, client, dev, 1024, &err);
+    (void)over2;
+    CheckErrorIsOom(api, err);
+
+    // retrieve buffer 0; its 512 KiB move to the buffer record
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args rargs;
+    memset(&rargs, 0, sizeof(rargs));
+    rargs.struct_size =
+        PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args_STRUCT_SIZE;
+    rargs.transfer_manager = tm;
+    rargs.buffer_index = 0;
+    CHECK(!api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(&rargs)
+          && rargs.buffer_out, "retrieve buffer 0");
+
+    // destroy the manager: buffer 1 (unretrieved) credited back
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size =
+        PJRT_AsyncHostToDeviceTransferManager_Destroy_Args_STRUCT_SIZE;
+    dargs.transfer_manager = tm;
+    CHECK(!api->PJRT_AsyncHostToDeviceTransferManager_Destroy(&dargs),
+          "tm destroy");
+    PJRT_Buffer* half = Alloc(api, client, dev, 131072, &err);  // 512 KiB
+    CHECK(!err && half, "512KiB after tm destroy should fit");
+    Destroy(api, half);
+    Destroy(api, rargs.buffer_out);  // credits the retrieved 512 KiB
+    }
+  }
+
+  // 4d. CopyToDevice charges the destination
+  {
+    PJRT_Buffer* src = Alloc(api, client, dev, 163840, &err);  // 640 KiB
+    CHECK(!err && src, "src alloc");
+    if (src) {
+      PJRT_Buffer_CopyToDevice_Args cargs2;
+      memset(&cargs2, 0, sizeof(cargs2));
+      cargs2.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+      cargs2.buffer = src;
+      cargs2.dst_device = dev;
+      err = api->PJRT_Buffer_CopyToDevice(&cargs2);
+      // 640 KiB src + 640 KiB copy = 1.25 MiB > cap
+      CheckErrorIsOom(api, err);
+      Destroy(api, src);
+    }
+    PJRT_Buffer* small = Alloc(api, client, dev, 65536, &err);  // 256 KiB
+    CHECK(!err && small, "small src");
+    if (small) {
+      PJRT_Buffer_CopyToDevice_Args cargs3;
+      memset(&cargs3, 0, sizeof(cargs3));
+      cargs3.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+      cargs3.buffer = small;
+      cargs3.dst_device = dev;
+      err = api->PJRT_Buffer_CopyToDevice(&cargs3);
+      CHECK(!err && cargs3.dst_buffer, "copy within cap");
+      Destroy(api, small);
+      Destroy(api, cargs3.dst_buffer);
+    }
+  }
+
+  // accounting must balance: the full cap is available again
+  {
+    PJRT_Buffer* full = Alloc(api, client, dev, 262144, &err);  // 1 MiB
+    CHECK(!err && full, "full-cap alloc after balanced credits");
+    Destroy(api, full);
+  }
+  printf("[4] PASS\n");
   }
   // ------------------------------------------------------------- throttle
   printf("[3] core-quota throttling (50 x simulated programs)\n");
   {
+  // a real tenant holds weights while stepping: keep a resident buffer
+  // alive through the loop so ledger observers see steady-state bytes
+  PJRT_Buffer* resident = nullptr;
+  if (!throttle_only) resident = Alloc(api, client, dev, 65536, &err);
   auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
   const char* iters_env = getenv("SHIM_TEST_ITERS");
   int iters = iters_env ? atoi(iters_env) : 50;
@@ -191,12 +438,16 @@ int main(int argc, char** argv) {
   printf("  iters=%d busy=%dms wall=%llums\n", iters, iters * 2,
          (unsigned long long)wall);
   if (!throttle_only) {
-    CHECK(wall >= 150, "not throttled: wall=%llu",
+    // 50 programs x 2 ms at 50% quota => ~200 ms expected. The old bound
+    // accepted up to 5000 ms (a 10x overthrottle would pass); 1200 ms
+    // still allows CI scheduling noise but catches gross overthrottle.
+    CHECK(wall >= 160, "not throttled: wall=%llu",
           (unsigned long long)wall);
-    CHECK(wall <= 5000, "over-throttled/wedged: wall=%llu",
+    CHECK(wall <= 1200, "over-throttled/wedged: wall=%llu",
           (unsigned long long)wall);
     printf("[3] PASS\n");
   }
+  Destroy(api, resident);
   }
 
   printf(g_failures ? "FAILURES: %d\n" : "ALL PASS\n", g_failures);

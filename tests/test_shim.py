@@ -102,3 +102,22 @@ class TestShimHermetic:
         assert res.returncode == 1
         assert "should fit" in res.stderr
         assert "co-tenants=524288B" in res.stdout, res.stdout
+
+    def test_multichip_independent_caps_and_quotas(self, shim_build,
+                                                   tmp_path):
+        """VERDICT r1 #7: run the shim against a 2-device fake plugin;
+        per-chip HBM caps and core quotas must be enforced independently
+        (chip 1's tighter quota governs a 2-device launch)."""
+        env = base_env(shim_build, tmp_path)
+        env.update({
+            "FAKE_DEVICE_COUNT": "2",
+            "MANAGER_VISIBLE_DEVICES": "0,1",
+            "VTPU_MEM_LIMIT_0": "1048576",
+            "VTPU_MEM_LIMIT_1": "2097152",
+            "VTPU_CORE_LIMIT_0": "50",
+            "VTPU_CORE_LIMIT_1": "10",
+        })
+        res = subprocess.run([shim_build["test"], "--multichip"], env=env,
+                             timeout=120, capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "ALL PASS" in res.stdout
