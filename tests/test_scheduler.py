@@ -241,7 +241,7 @@ class TestPreempt:
             "NodeNameToVictims": {"node-0": {"Pods": [
                 client.get_pod("default", "victim")]}}})
         assert not res.error
-        kept = res.node_to_victims["node-0"]
+        kept = res.node_to_victims["node-0"].pods
         assert [p["metadata"]["name"] for p in kept] == ["victim"]
 
     def test_unneeded_vtpu_victim_dropped(self):
@@ -252,7 +252,7 @@ class TestPreempt:
             "Pod": preemptor,
             "NodeNameToVictims": {"node-0": {"Pods": [
                 client.get_pod("default", "victim")]}}})
-        assert res.node_to_victims["node-0"] == []
+        assert res.node_to_victims["node-0"].pods == []
 
     def test_unsatisfiable_node_removed(self):
         client, _ = occupied_cluster()
@@ -271,7 +271,7 @@ class TestPreempt:
             "Pod": preemptor,
             "NodeNameToVictims": {"node-0": {"Pods": [
                 client.get_pod("default", "bystander")]}}})
-        kept = res.node_to_victims["node-0"]
+        kept = res.node_to_victims["node-0"].pods
         names = {p["metadata"]["name"] for p in kept}
         assert "victim" in names
 
@@ -284,11 +284,102 @@ class TestPreempt:
             "Pod": preemptor,
             "NodeNameToMetaVictims": {"node-0": {"Pods": [
                 {"UID": victim_uid}]}}})
-        kept = res.node_to_victims["node-0"]
+        kept = res.node_to_victims["node-0"].pods
         assert [p["metadata"]["name"] for p in kept] == ["victim"]
         wire = res.to_wire()
         assert wire["NodeNameToMetaVictims"]["node-0"]["Pods"] == [
             {"UID": victim_uid}]
+        assert wire["NodeNameToMetaVictims"]["node-0"][
+            "NumPDBViolations"] == 0
+
+    def test_pdb_violations_preserved_for_kept_victims(self):
+        """VERDICT r1 #4: the input's NumPDBViolations survives the
+        MetaVictims round-trip for kept victims (upper-bound semantics:
+        min(original, kept) + added)."""
+        client, _ = occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {
+                "Pods": [client.get_pod("default", "victim")],
+                "NumPDBViolations": 1}}})
+        v = res.node_to_victims["node-0"]
+        assert [p["metadata"]["name"] for p in v.pods] == ["victim"]
+        assert v.num_pdb_violations == 1
+        wire = res.to_wire()
+        assert wire["NodeNameToMetaVictims"]["node-0"][
+            "NumPDBViolations"] == 1
+
+    def test_pdb_count_never_exceeds_victims(self):
+        # all original victims dropped -> carried-over violations go to 0
+        client, _ = occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=10, priority=100)
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {
+                "Pods": [client.get_pod("default", "victim")],
+                "NumPDBViolations": 1}}})
+        v = res.node_to_victims["node-0"]
+        assert v.pods == [] and v.num_pdb_violations == 0
+
+    def test_added_victims_counted_as_potential_violators(self):
+        client, _ = occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        # proposal holds only the bystander; we add the vtpu victim
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": [
+                client.get_pod("default", "bystander")]}}})
+        v = res.node_to_victims["node-0"]
+        names = {p["metadata"]["name"] for p in v.pods}
+        assert "victim" in names
+        added = sum(1 for p in v.pods
+                    if p["metadata"]["name"] != "bystander")
+        assert v.num_pdb_violations == added
+        assert v.num_pdb_violations <= len(v.pods)
+
+    def test_pdb_blocked_pod_not_added_by_us(self):
+        """Pods matching a PDB with zero disruptions left are never chosen
+        as ADDITIONAL victims (reference violationOfPDBs). Two resident
+        80%-core tenants, one PDB-protected: the preemption must land on
+        the unprotected one."""
+        client = FakeKubeClient()
+        reg = dt.fake_registry(2)
+        client.add_node(dt.fake_node("node-0", reg))
+        for idx, (name, labels) in enumerate(
+                [("victim", {}), ("protected", {"app": "quorum"})]):
+            claims = PodDeviceClaims()
+            claims.add("c", DeviceClaim(reg.chips[idx].uuid, idx, 80,
+                                        12 * 2**30))
+            pod = vtpu_pod(name=name, node_name="node-0", priority=1,
+                           annotations={
+                               consts.real_allocated_annotation():
+                                   claims.encode()})
+            pod["status"]["phase"] = "Running"
+            pod["metadata"]["labels"] = labels
+            client.add_pod(pod)
+        client.add_pdb({
+            "metadata": {"name": "quorum-pdb", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": "quorum"}}},
+            "status": {"disruptionsAllowed": 0}})
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        # empty proposal: every victim is chosen by US
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": []}}})
+        assert not res.error, res.error
+        v = res.node_to_victims["node-0"]
+        names = {p["metadata"]["name"] for p in v.pods}
+        assert names == {"victim"}, names
+        # and if the PDB frees up, the protected pod becomes eligible
+        client.pdbs[0]["status"]["disruptionsAllowed"] = 1
+        res2 = PreemptPredicate(client).preempt({
+            "Pod": vtpu_pod(name="pre2", number=2, priority=100),
+            "NodeNameToVictims": {"node-0": {"Pods": []}}})
+        assert not res2.error
+        names2 = {p["metadata"]["name"]
+                  for p in res2.node_to_victims["node-0"].pods}
+        assert names2 == {"victim", "protected"}
 
 
 class TestHTTPRoutes:

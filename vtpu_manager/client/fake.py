@@ -28,12 +28,17 @@ class FakeKubeClient:
         self.events: list[dict] = []
         self.resourceclaims: dict[tuple[str, str], dict] = {}
         self.resourceslices: dict[str, dict] = {}
+        self.pdbs: list[dict] = []
 
     # -- fixture helpers ----------------------------------------------------
 
     def add_node(self, node: dict) -> None:
         with self._lock:
             self.nodes[node["metadata"]["name"]] = copy.deepcopy(node)
+
+    def add_pdb(self, pdb: dict) -> None:
+        with self._lock:
+            self.pdbs.append(copy.deepcopy(pdb))
 
     def add_pod(self, pod: dict) -> None:
         meta = pod["metadata"]
@@ -132,6 +137,12 @@ class FakeKubeClient:
     def create_event(self, namespace: str, event: dict) -> None:
         with self._lock:
             self.events.append(copy.deepcopy(event))
+
+    def list_pdbs(self, namespace=None) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(p) for p in self.pdbs
+                    if not namespace
+                    or p["metadata"].get("namespace", "default") == namespace]
 
     # -- DRA objects --------------------------------------------------------
 

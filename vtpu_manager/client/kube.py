@@ -42,6 +42,7 @@ class KubeClient(Protocol):
                    grace_seconds: int | None = None) -> None: ...
     def evict_pod(self, namespace: str, name: str) -> None: ...
     def create_event(self, namespace: str, event: dict) -> None: ...
+    def list_pdbs(self, namespace: str | None = None) -> list[dict]: ...
 
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -145,6 +146,12 @@ class InClusterClient:
 
     def create_event(self, namespace: str, event: dict) -> None:
         self._request("POST", f"/api/v1/namespaces/{namespace}/events", event)
+
+    def list_pdbs(self, namespace: str | None = None) -> list[dict]:
+        path = (f"/apis/policy/v1/namespaces/{namespace}"
+                "/poddisruptionbudgets" if namespace
+                else "/apis/policy/v1/poddisruptionbudgets")
+        return self._request("GET", path).get("items", [])
 
     # -- DRA objects --------------------------------------------------------
 

@@ -5,7 +5,17 @@ preemption logic picks victims by pod priority without understanding vtpu
 device occupancy, so the extender corrects it: victims whose eviction frees
 no needed vtpu capacity are dropped, extra vtpu victims are added when the
 proposed set is not enough, and nodes where no victim set makes the pod fit
-are removed entirely. PDB-violation counts are preserved for kept victims.
+are removed entirely.
+
+PDB handling mirrors the reference two ways:
+- candidates ADDED by us skip pods that match a PodDisruptionBudget with
+  zero disruptions allowed (violationOfPDBs, preempt_predicate.go:595-620);
+- the response's NumPDBViolations is a conservative upper bound derived
+  from the input count (pdbViolationsUpperBound, :466-496): of the original
+  violators at most min(original, kept-from-input) survived our refinement,
+  and every victim we appended may be a new violator. Err on the high side:
+  under-reporting would make kube-scheduler's pickOneNodeForPreemption
+  prefer our node and inflict more real disruption than it should.
 """
 
 from __future__ import annotations
@@ -24,8 +34,14 @@ log = logging.getLogger(__name__)
 
 
 @dataclass
+class NodeVictims:
+    pods: list[dict] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+
+@dataclass
 class PreemptResult:
-    node_to_victims: dict[str, list[dict]] = field(default_factory=dict)
+    node_to_victims: dict[str, NodeVictims] = field(default_factory=dict)
     error: str = ""
 
     def to_wire(self) -> dict:
@@ -33,8 +49,9 @@ class PreemptResult:
             return {"Error": self.error}
         return {"NodeNameToMetaVictims": {
             node: {"Pods": [{"UID": (p.get("metadata") or {}).get("uid", "")}
-                            for p in pods]}
-            for node, pods in self.node_to_victims.items()}}
+                            for p in v.pods],
+                   "NumPDBViolations": v.num_pdb_violations}
+            for node, v in self.node_to_victims.items()}}
 
 
 def _pod_priority(pod: dict) -> int:
@@ -43,6 +60,33 @@ def _pod_priority(pod: dict) -> int:
 
 def _pod_uid(pod: dict) -> str:
     return (pod.get("metadata") or {}).get("uid", "")
+
+
+def pdb_violations_upper_bound(original: int, kept_from_input: int,
+                               added: int) -> int:
+    """Conservative violator count without per-victim PDB matching; always
+    <= kept_from_input + added so NumPDBViolations <= len(Pods) holds."""
+    return min(original, kept_from_input) + added
+
+
+def _label_selector_matches(selector: dict | None, labels: dict) -> bool:
+    if not selector:
+        return False
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key, op = expr.get("key", ""), expr.get("operator", "")
+        values = expr.get("values") or []
+        if op == "In" and labels.get(key) not in values:
+            return False
+        if op == "NotIn" and labels.get(key) in values:
+            return False
+        if op == "Exists" and key not in labels:
+            return False
+        if op == "DoesNotExist" and key in labels:
+            return False
+    return True
 
 
 class PreemptPredicate:
@@ -66,20 +110,38 @@ class PreemptPredicate:
         except RequestError as e:
             return PreemptResult(error=f"invalid vtpu request: {e}")
         if req.is_empty():
-            # nothing for us to correct; pass the proposal through
-            return PreemptResult(node_to_victims={
-                node: self._proposal_pods(node, v, meta_only)
-                for node, v in victims_in.items()})
+            # nothing for us to correct; pass the proposal through. Clamp
+            # the carried count: unresolvable MetaVictim UIDs (victim
+            # deleted in flight) shrink Pods, and NumPDBViolations must
+            # never exceed it.
+            out: dict[str, NodeVictims] = {}
+            for node, v in victims_in.items():
+                pods = self._proposal_pods(node, v, meta_only)
+                out[node] = NodeVictims(
+                    pods=pods,
+                    num_pdb_violations=min(self._proposal_pdb_count(v),
+                                           len(pods)))
+            return PreemptResult(node_to_victims=out)
 
         result = PreemptResult()
+        pdb_cache: dict[str, list[dict]] = {}   # one list per namespace
         for node_name, proposal in victims_in.items():
             proposed = self._proposal_pods(node_name, proposal, meta_only)
-            kept = self._validate_node(node_name, req, proposed)
+            kept = self._validate_node(
+                node_name, req, proposed,
+                original_pdb=self._proposal_pdb_count(proposal),
+                pdb_cache=pdb_cache)
             if kept is not None:
                 result.node_to_victims[node_name] = kept
         if not result.node_to_victims:
             result.error = "no node becomes schedulable by preemption"
         return result
+
+    @staticmethod
+    def _proposal_pdb_count(proposal: dict | None) -> int:
+        p = proposal or {}
+        return int(p.get("NumPDBViolations")
+                   or p.get("numPDBViolations") or 0)
 
     def _proposal_pods(self, node_name: str, proposal: dict | None,
                        meta_only: bool) -> list[dict]:
@@ -93,8 +155,48 @@ class PreemptPredicate:
         resident = self.client.list_pods(node_name=node_name)
         return [p for p in resident if _pod_uid(p) in uids]
 
-    def _validate_node(self, node_name: str, req,
-                       proposed: list[dict]) -> list[dict] | None:
+    def _pdbs_for_ns(self, ns: str, cache: dict[str, list[dict]]
+                     ) -> list[dict]:
+        """One PDB list per namespace per preempt() call — a per-candidate
+        fetch would be N+1 API requests in the scheduling hot path."""
+        if ns not in cache:
+            try:
+                cache[ns] = self.client.list_pdbs(namespace=ns)
+            except Exception as e:
+                # Matches the reference's lister-failure behavior (assume
+                # no violation) — but say so: an RBAC gap would otherwise
+                # silently disable PDB protection.
+                log.warning("PDB list failed for namespace %s: %s "
+                            "(treating as no PDBs)", ns, e)
+                cache[ns] = []
+        return cache[ns]
+
+    def _violates_pdb(self, pod: dict,
+                      pdb_cache: dict[str, list[dict]]) -> bool:
+        """True when the pod matches a live PDB in its own namespace with
+        no disruptions left (and is not already recorded as disrupted)."""
+        meta = pod.get("metadata") or {}
+        labels = meta.get("labels") or {}
+        ns = meta.get("namespace") or "default"
+        for pdb in self._pdbs_for_ns(ns, pdb_cache):
+            if (pdb.get("metadata") or {}).get("deletionTimestamp"):
+                continue
+            status = pdb.get("status") or {}
+            if meta.get("name") in (status.get("disruptedPods") or {}):
+                continue   # already counted as disrupted
+            spec = pdb.get("spec") or {}
+            if not _label_selector_matches(spec.get("selector"), labels):
+                continue
+            if int(status.get("disruptionsAllowed", 0)) <= 0:
+                return True
+        return False
+
+    def _validate_node(self, node_name: str, req, proposed: list[dict],
+                       original_pdb: int = 0,
+                       pdb_cache: dict[str, list[dict]] | None = None
+                       ) -> NodeVictims | None:
+        if pdb_cache is None:
+            pdb_cache = {}
         try:
             node = self.client.get_node(node_name)
         except Exception:
@@ -118,11 +220,14 @@ class PreemptPredicate:
 
         if not fits(set(victims)):
             # proposed set insufficient: add vtpu-holding pods, lowest
-            # priority first, until the pod fits or we run out
+            # priority first, until the pod fits or we run out. Pods whose
+            # PDB has no disruptions left are never added by US (the
+            # in-tree proposal may still contain them).
             extras = sorted(
                 (p for p in resident
                  if _pod_uid(p) not in victims
-                 and get_pod_device_claims(p) is not None),
+                 and get_pod_device_claims(p) is not None
+                 and not self._violates_pdb(p, pdb_cache)),
                 key=_pod_priority)
             ok = False
             for extra in extras:
@@ -144,6 +249,11 @@ class PreemptPredicate:
                 continue
             if fits(set(victims) - {uid}):
                 del victims[uid]
-        return [victims[uid] for uid in sorted(victims)]
-
-
+        final = [victims[uid] for uid in sorted(victims)]
+        kept_from_input = sum(1 for p in final
+                              if _pod_uid(p) in proposed_uids)
+        added = len(final) - kept_from_input
+        return NodeVictims(
+            pods=final,
+            num_pdb_violations=pdb_violations_upper_bound(
+                original_pdb, kept_from_input, added))
