@@ -18,6 +18,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--cert-file")
     parser.add_argument("--key-file")
     parser.add_argument("--scheduler-name")
+    parser.add_argument("--device-class", default="",
+                        help="DeviceClass name the DRA conversion emits and "
+                             "the claim validator recognizes (default "
+                             "vtpu.google.com; match a renamed chart class)")
+    parser.add_argument("--dra-convert", action="store_true",
+                        help="rewrite vtpu-* extended resources into "
+                             "generated ResourceClaims")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -25,14 +32,30 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
+    from vtpu_manager.util import consts
     from vtpu_manager.webhook.server import WebhookAPI, run_server
+
+    consts.set_dra_device_class(args.device_class)
 
     ssl_ctx = None
     if args.cert_file and args.key_file:
         ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ssl_ctx.load_cert_chain(args.cert_file, args.key_file)
 
-    api = WebhookAPI(scheduler_name=args.scheduler_name)
+    # API client: needed by the DRA conversion (claim-template creation)
+    # and the allocated-claim sharing validation on the status subresource
+    # — without it the sharing rules silently never run.
+    client = None
+    try:
+        from vtpu_manager.client.kube import InClusterClient
+        client = InClusterClient()
+    except Exception:
+        logging.getLogger(__name__).warning(
+            "no API server access; DRA claim-sharing validation and "
+            "claim-template creation are disabled")
+
+    api = WebhookAPI(scheduler_name=args.scheduler_name,
+                     dra_convert=args.dra_convert, client=client)
     logging.getLogger(__name__).info("vtpu-webhook on %s:%d", args.host,
                                      args.port)
     run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)

@@ -1,0 +1,271 @@
+"""User-authored ResourceClaim validation (VERDICT r1 #3).
+
+Mirrors the reference's resourceclaim webhook tests: strict opaque
+parameter decode, CEL selector sanity, capacity bounds against the
+published coreRatio/memoryMiB counters, and the allocated-claim sharing
+rules on the status subresource.
+"""
+
+import pytest
+
+from vtpu_manager.util import consts
+from vtpu_manager.webhook.dra_validate import (validate_allocated_sharing,
+                                               validate_claim_object,
+                                               validate_claim_spec)
+
+DEVICE_CLASS = consts.dra_device_class()
+
+DRIVER = consts.DRA_DRIVER_NAME
+
+
+def claim_spec(count=1, cores=None, memory=None, selectors=None,
+               capacity=None, config_requests=None, extra_params=None):
+    request = {"name": "vtpu", "deviceClassName": DEVICE_CLASS,
+               "count": count}
+    if selectors:
+        request["selectors"] = selectors
+    if capacity:
+        request["capacity"] = {"requests": capacity}
+    spec = {"devices": {"requests": [request]}}
+    params = dict(extra_params or {})
+    if cores is not None:
+        params["cores"] = cores
+    if memory is not None:
+        params["memoryMiB"] = memory
+    if params:
+        spec["devices"]["config"] = [{
+            "requests": config_requests if config_requests is not None
+            else ["vtpu"],
+            "opaque": {"driver": DRIVER, "parameters": params}}]
+    return spec
+
+
+class TestClaimSpec:
+    def test_valid_claim_passes(self):
+        assert validate_claim_spec(claim_spec(cores=50, memory=2048)).allowed
+
+    def test_count_bounds(self):
+        assert not validate_claim_spec(claim_spec(count=0)).allowed
+        assert not validate_claim_spec(claim_spec(count=65)).allowed
+
+    def test_unknown_param_rejected_strict_decode(self):
+        res = validate_claim_spec(claim_spec(
+            cores=50, extra_params={"coresj": 99}))
+        assert not res.allowed and "coresj" in res.message
+
+    def test_cores_bounds(self):
+        assert not validate_claim_spec(claim_spec(cores=0)).allowed
+        assert not validate_claim_spec(claim_spec(cores=101)).allowed
+        assert not validate_claim_spec(claim_spec(cores="50")).allowed
+
+    def test_config_references_unknown_request(self):
+        res = validate_claim_spec(claim_spec(cores=10,
+                                             config_requests=["ghost"]))
+        assert not res.allowed and "ghost" in res.message
+
+    def test_capacity_known_keys_and_bounds(self):
+        assert validate_claim_spec(claim_spec(
+            capacity={"coreRatio": 50, "memoryMiB": 1024})).allowed
+        res = validate_claim_spec(claim_spec(capacity={"coreRatio": 200}))
+        assert not res.allowed
+        res = validate_claim_spec(claim_spec(capacity={"gpuCores": 50}))
+        assert not res.allowed and "gpuCores" in res.message
+
+    def test_capacity_conflicts_with_opaque_params(self):
+        res = validate_claim_spec(claim_spec(
+            cores=30, capacity={"coreRatio": 50}))
+        assert not res.allowed and "conflicts" in res.message
+        assert validate_claim_spec(claim_spec(
+            cores=50, capacity={"coreRatio": 50})).allowed
+
+    def test_cel_selector_sanity(self):
+        ok = [{"cel": {"expression":
+              f'device.attributes["{DRIVER}"].chipType == "v5e"'}}]
+        assert validate_claim_spec(claim_spec(selectors=ok)).allowed
+        unbalanced = [{"cel": {"expression":
+                      'device.attributes["x"].y == (1'}}]
+        assert not validate_claim_spec(
+            claim_spec(selectors=unbalanced)).allowed
+        empty = [{"cel": {"expression": "  "}}]
+        assert not validate_claim_spec(claim_spec(selectors=empty)).allowed
+
+    def test_cel_literals_may_contain_brackets_and_quotes(self):
+        """Delimiters inside string literals must not trip the balance
+        heuristic (code-review r2 finding)."""
+        ok = [{"cel": {"expression":
+              'device.attributes["other.domain"].model.contains('
+              '"v5p (lite)") && device.attributes["x"].note != '
+              '"it\'s [fine]"'}}]
+        assert validate_claim_spec(claim_spec(selectors=ok)).allowed
+        unterminated = [{"cel": {"expression":
+                        'device.attributes["x"].y == "oops'}}]
+        assert not validate_claim_spec(
+            claim_spec(selectors=unterminated)).allowed
+
+    def test_cel_unknown_attribute_for_our_driver(self):
+        bad = [{"cel": {"expression":
+               f'device.attributes["{DRIVER}"].productName == "x"'}}]
+        res = validate_claim_spec(claim_spec(selectors=bad))
+        assert not res.allowed and "productName" in res.message
+        # foreign-driver attributes are not our business
+        foreign = [{"cel": {"expression":
+                   'device.attributes["gpu.nvidia.com"].productName '
+                   '== "x"'}}]
+        assert validate_claim_spec(claim_spec(selectors=foreign)).allowed
+
+    def test_other_drivers_claims_ignored(self):
+        spec = {"devices": {"requests": [{
+            "name": "gpu", "deviceClassName": "gpu.nvidia.com",
+            "count": 9999}]}}
+        assert validate_claim_spec(spec).allowed
+
+    def test_template_nesting(self):
+        template = {"kind": "ResourceClaimTemplate",
+                    "spec": {"spec": claim_spec(cores=101)}}
+        assert not validate_claim_object(template).allowed
+        claim = {"kind": "ResourceClaim", "spec": claim_spec(cores=50)}
+        assert validate_claim_object(claim).allowed
+
+    def test_first_available_subrequests(self):
+        spec = {"devices": {"requests": [{
+            "name": "vtpu",
+            "firstAvailable": [
+                {"deviceClassName": DEVICE_CLASS, "count": 70},
+                {"deviceClassName": DEVICE_CLASS, "count": 1}]}]}}
+        assert not validate_claim_spec(spec).allowed
+
+    def test_duplicate_request_names(self):
+        spec = {"devices": {"requests": [
+            {"name": "a", "deviceClassName": DEVICE_CLASS, "count": 1},
+            {"name": "a", "deviceClassName": DEVICE_CLASS, "count": 1}]}}
+        assert not validate_claim_spec(spec).allowed
+
+
+def allocated_claim(name="c1", ns="default", requests=("vtpu",)):
+    return {
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": ns},
+        "status": {"allocation": {"devices": {"results": [
+            {"driver": DRIVER, "request": r, "device": f"vtpu-0-{i}"}
+            for i, r in enumerate(requests)]}}}}
+
+
+def pod_with_claims(name, containers, init_containers=(), ns="default"):
+    """containers: list of (cname, [claim_ref_names], restartable)."""
+    def cont(c):
+        cname, refs, *rest = c
+        body = {"name": cname,
+                "resources": {"claims": [{"name": r} for r in refs]}}
+        if rest and rest[0]:
+            body["restartPolicy"] = "Always"
+        return body
+    all_refs = sorted({r for c in list(containers) + list(init_containers)
+                       for r in c[1]})
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "resourceClaims": [{"name": r, "resourceClaimName": r}
+                               for r in all_refs],
+            "initContainers": [cont(c) for c in init_containers],
+            "containers": [cont(c) for c in containers]}}
+
+
+class TestAllocatedSharing:
+    def test_two_app_containers_same_request_denied(self):
+        claim = allocated_claim()
+        pod = pod_with_claims("p", [("a", ["c1"]), ("b", ["c1"])])
+        res = validate_allocated_sharing(claim, [pod], {})
+        assert not res.allowed and "multiple app containers" in res.message
+
+    def test_init_containers_may_share(self):
+        claim = allocated_claim()
+        pod = pod_with_claims("p", [("app", ["c1"])],
+                              init_containers=[("i1", ["c1"]),
+                                               ("i2", ["c1"])])
+        assert validate_allocated_sharing(claim, [pod], {}).allowed
+
+    def test_sidecar_must_be_sole_user(self):
+        claim = allocated_claim()
+        pod = pod_with_claims("p", [("app", ["c1"])],
+                              init_containers=[("side", ["c1"], True)])
+        res = validate_allocated_sharing(claim, [pod], {})
+        assert not res.allowed and "sidecar" in res.message
+
+    def test_cross_pod_sharing_denied(self):
+        claim = allocated_claim()
+        p1 = pod_with_claims("p1", [("a", ["c1"])])
+        p2 = pod_with_claims("p2", [("a", ["c1"])])
+        res = validate_allocated_sharing(claim, [p1, p2], {})
+        assert not res.allowed and "multiple pods" in res.message
+
+    def test_one_container_two_vtpu_claims_denied(self):
+        claim = allocated_claim("c1")
+        other = allocated_claim("c2")
+        pod = pod_with_claims("p", [("a", ["c1", "c2"])])
+        res = validate_allocated_sharing(
+            claim, [pod], {("default", "c2"): other})
+        assert not res.allowed and "at most one" in res.message
+
+    def test_unallocated_claim_ignored(self):
+        claim = {"kind": "ResourceClaim",
+                 "metadata": {"name": "c1", "namespace": "default"},
+                 "status": {}}
+        pod = pod_with_claims("p", [("a", ["c1"]), ("b", ["c1"])])
+        assert validate_allocated_sharing(claim, [pod], {}).allowed
+
+
+class TestClaimValidateRoute:
+    @pytest.fixture
+    def api_client(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.webhook.server import WebhookAPI
+        fake = FakeKubeClient()
+        api = WebhookAPI(client=fake)
+        return api, fake, asyncio, TestClient, TestServer
+
+    def test_create_denied_and_allowed(self, api_client):
+        api, fake, asyncio, TestClient, TestServer = api_client
+
+        async def scenario():
+            async with TestClient(TestServer(api.build_app())) as client:
+                bad = {"kind": "ResourceClaim",
+                       "spec": claim_spec(cores=500)}
+                resp = await client.post("/resourceclaims/validate", json={
+                    "request": {"uid": "u1", "operation": "CREATE",
+                                "object": bad}})
+                body = await resp.json()
+                assert body["response"]["allowed"] is False
+                good = {"kind": "ResourceClaim",
+                        "spec": claim_spec(cores=50)}
+                resp = await client.post("/resourceclaims/validate", json={
+                    "request": {"uid": "u2", "operation": "CREATE",
+                                "object": good}})
+                body = await resp.json()
+                assert body["response"]["allowed"] is True
+
+        asyncio.run(scenario())
+
+    def test_status_update_runs_sharing_validation(self, api_client):
+        api, fake, asyncio, TestClient, TestServer = api_client
+        pod = pod_with_claims("p", [("a", ["c1"]), ("b", ["c1"])])
+        fake.add_pod(pod)
+        claim = allocated_claim("c1")
+        claim["spec"] = claim_spec(cores=50)
+        claim["status"]["reservedFor"] = [{"resource": "pods", "name": "p"}]
+        fake.add_resourceclaim(claim)
+
+        async def scenario():
+            async with TestClient(TestServer(api.build_app())) as client:
+                resp = await client.post("/resourceclaims/validate", json={
+                    "request": {"uid": "u3", "operation": "UPDATE",
+                                "subResource": "status", "object": claim}})
+                body = await resp.json()
+                assert body["response"]["allowed"] is False
+                assert "multiple app containers" in \
+                    body["response"]["status"]["message"]
+
+        asyncio.run(scenario())

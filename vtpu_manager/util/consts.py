@@ -286,3 +286,20 @@ DEFAULT_SCHEDULER_NAME = "vtpu-scheduler"
 
 # DRA driver name (reference DRA DeviceClass driver).
 DRA_DRIVER_NAME = "vtpu.resource.google.com"
+
+# DeviceClass users reference from ResourceClaims. One definition shared by
+# the pod-to-DRA conversion, the claim validator, and the kubelet plugin —
+# drift between them would make conversion emit claims the validator does
+# not recognize. Override with --device-class / set_dra_device_class to
+# match a renamed chart DeviceClass.
+_dra_device_class = "vtpu.google.com"
+
+
+def dra_device_class() -> str:
+    return _dra_device_class
+
+
+def set_dra_device_class(name: str) -> None:
+    global _dra_device_class
+    if name:
+        _dra_device_class = name

@@ -18,7 +18,10 @@ from vtpu_manager.device.allocator.request import (RequestError,
                                                    build_allocation_request)
 from vtpu_manager.util import consts
 
-DEVICE_CLASS = "vtpu.google.com"
+def DEVICE_CLASS() -> str:
+    """Shared DeviceClass name (consts.dra_device_class); a function so a
+    --device-class override applies after import."""
+    return consts.dra_device_class()
 
 
 @dataclass
@@ -38,7 +41,7 @@ def _claim_spec(number: int, cores: int, memory_mib: int) -> dict:
         parameters["memoryMiB"] = memory_mib
     spec: dict = {"devices": {"requests": [{
         "name": "vtpu",
-        "deviceClassName": DEVICE_CLASS,
+        "deviceClassName": DEVICE_CLASS(),
         "count": number,
     }]}}
     if parameters:

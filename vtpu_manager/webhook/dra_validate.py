@@ -1,0 +1,391 @@
+"""User-authored ResourceClaim/Template validation for the vtpu driver.
+
+Reference: pkg/webhook/resourceclaim/validate/resourceclaim.go:1-439 (strict
+opaque-parameter decode, allocated-claim sharing rules on the status
+subresource) and pkg/webhook/pod/validate/pod_validate.go:664-1193 (claim
+request shapes, CEL selectors, capacity vs the driver's published
+coreRatio/memoryMiB counters).
+
+Round-1 gap: claims reached the scheduler unvalidated. Everything here is
+pure-dict validation so the policy is testable without an admission chain;
+webhook/server.py owns the AdmissionReview plumbing.
+"""
+
+from __future__ import annotations
+
+import re
+
+from vtpu_manager.kubeletplugin.allocatable import (CORE_COUNTER,
+                                                    MEMORY_COUNTER)
+from vtpu_manager.util import consts
+from vtpu_manager.webhook.validate import (MAX_MEMORY_MIB_PER_DEVICE,
+                                           MAX_NUMBER_PER_CONTAINER,
+                                           ValidateResult)
+
+# Strict decode (reference nvapi.StrictDecoder): unknown opaque-parameter
+# fields are rejected, not ignored — a typo like "coresj" silently granting
+# an unthrottled device is the failure mode this prevents.
+KNOWN_PARAM_KEYS = {"cores", "memoryMiB"}
+# Attribute names published in our ResourceSlice (allocatable.py) — CEL
+# selectors referencing anything else under our driver domain are typos.
+KNOWN_ATTRIBUTES = {"uuid", "chipType", "index", "slot",
+                    "meshX", "meshY", "meshZ", "healthy"}
+KNOWN_CAPACITIES = {CORE_COUNTER, MEMORY_COUNTER}
+MAX_CEL_LENGTH = 10 * 1024   # k8s CELDeviceSelector expression cap
+_DNS_LABEL = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+# device.attributes["<domain>"].<name> — the CEL shape k8s documents
+_CEL_ATTR = re.compile(
+    r"device\.attributes\[\s*[\"']([^\"']+)[\"']\s*\]\s*\.\s*(\w+)")
+_CEL_CAP = re.compile(
+    r"device\.capacity\[\s*[\"']([^\"']+)[\"']\s*\]\s*\.\s*(\w+)")
+
+
+
+
+def _quantity_to_int(value) -> int | None:
+    """Parse the integer k8s quantities our counters use (plain ints or
+    Mi/Gi suffixes); None = unparseable."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value).strip()
+    mult = 1
+    for suffix, m in (("Ki", 2**10), ("Mi", 2**20), ("Gi", 2**30),
+                      ("k", 10**3), ("M", 10**6), ("G", 10**9)):
+        if s.endswith(suffix):
+            s, mult = s[:-len(suffix)], m
+            break
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        return None
+
+
+def _strip_strings(expr: str) -> str | None:
+    """Remove CEL string literals (so brackets/quotes INSIDE them don't
+    trip the balance checks); None = a literal is left unterminated."""
+    out = []
+    i, n = 0, len(expr)
+    while i < n:
+        c = expr[i]
+        if c in ('"', "'"):
+            quote = c
+            i += 1
+            while i < n:
+                if expr[i] == "\\":
+                    i += 2
+                    continue
+                if expr[i] == quote:
+                    break
+                i += 1
+            if i >= n:
+                return None   # unterminated literal
+            i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _check_cel(expr: str, where: str, result: ValidateResult) -> None:
+    if not isinstance(expr, str) or not expr.strip():
+        result.deny(f"{where}: empty CEL expression")
+        return
+    if len(expr) > MAX_CEL_LENGTH:
+        result.deny(f"{where}: CEL expression exceeds {MAX_CEL_LENGTH} "
+                    "bytes")
+        return
+    stripped = _strip_strings(expr)
+    if stripped is None:
+        result.deny(f"{where}: unterminated string literal in CEL "
+                    "expression")
+        return
+    for open_c, close_c in (("(", ")"), ("[", "]"), ("{", "}")):
+        if stripped.count(open_c) != stripped.count(close_c):
+            result.deny(f"{where}: unbalanced {open_c!r}{close_c!r} in CEL "
+                        "expression")
+    if "device." not in stripped:
+        result.deny(f"{where}: CEL expression references no device fields")
+    for domain, name in _CEL_ATTR.findall(expr):
+        if domain in (consts.DRA_DRIVER_NAME, consts.dra_device_class()) \
+                and name not in KNOWN_ATTRIBUTES:
+            result.deny(
+                f"{where}: unknown attribute {name!r} for driver "
+                f"{domain!r} (known: {sorted(KNOWN_ATTRIBUTES)})")
+    for domain, name in _CEL_CAP.findall(expr):
+        if domain in (consts.DRA_DRIVER_NAME, consts.dra_device_class()) \
+                and name not in KNOWN_CAPACITIES:
+            result.deny(
+                f"{where}: unknown capacity {name!r} for driver "
+                f"{domain!r} (known: {sorted(KNOWN_CAPACITIES)})")
+
+
+def _check_params(params: dict, where: str, result: ValidateResult
+                  ) -> dict:
+    """Strict-decode the opaque driver parameters; returns the normalized
+    {cores, memoryMiB} subset that passed."""
+    if not isinstance(params, dict):
+        result.deny(f"{where}: opaque parameters must be an object")
+        return {}
+    unknown = set(params) - KNOWN_PARAM_KEYS
+    if unknown:
+        result.deny(f"{where}: unknown parameter(s) {sorted(unknown)} "
+                    f"(known: {sorted(KNOWN_PARAM_KEYS)})")
+    out = {}
+    cores = params.get("cores")
+    if cores is not None:
+        if not isinstance(cores, int) or isinstance(cores, bool) \
+                or not 1 <= cores <= 100:
+            result.deny(f"{where}: cores must be an integer in [1, 100], "
+                        f"got {cores!r}")
+        else:
+            out["cores"] = cores
+    mem = params.get("memoryMiB")
+    if mem is not None:
+        if not isinstance(mem, int) or isinstance(mem, bool) \
+                or not 1 <= mem <= MAX_MEMORY_MIB_PER_DEVICE:
+            result.deny(f"{where}: memoryMiB must be an integer in "
+                        f"[1, {MAX_MEMORY_MIB_PER_DEVICE}], got {mem!r}")
+        else:
+            out["memoryMiB"] = mem
+    return out
+
+
+def _request_body(request: dict) -> dict:
+    """v1 nests the one-of under 'exactly'; v1beta1 is flat. FirstAvailable
+    subrequests are handled by the caller."""
+    return request.get("exactly") or request
+
+
+def _targets_vtpu(body: dict) -> bool:
+    return body.get("deviceClassName") == consts.dra_device_class()
+
+
+def validate_claim_spec(spec: dict) -> ValidateResult:
+    """Validate one ResourceClaim spec (the .spec of a claim, or .spec.spec
+    of a template)."""
+    result = ValidateResult()
+    devices = spec.get("devices") or {}
+    requests = devices.get("requests") or []
+    names: set[str] = set()
+    vtpu_request_names: set[str] = set()
+    capacity_by_request: dict[str, dict] = {}
+
+    for i, request in enumerate(requests):
+        name = request.get("name", "")
+        where = f"devices.requests[{i}]"
+        if not _DNS_LABEL.match(name or ""):
+            result.deny(f"{where}: request name {name!r} is not a DNS "
+                        "label")
+        if name in names:
+            result.deny(f"{where}: duplicate request name {name!r}")
+        names.add(name)
+
+        subrequests = request.get("firstAvailable") or []
+        bodies = ([(f"{where}.firstAvailable[{j}]", sub)
+                   for j, sub in enumerate(subrequests)]
+                  if subrequests else [(where, _request_body(request))])
+        for sub_where, body in bodies:
+            if not _targets_vtpu(body):
+                continue
+            vtpu_request_names.add(name)
+            count = body.get("count", 1)
+            if not isinstance(count, int) or count < 1 \
+                    or count > MAX_NUMBER_PER_CONTAINER:
+                result.deny(f"{sub_where}: count must be in "
+                            f"[1, {MAX_NUMBER_PER_CONTAINER}], got "
+                            f"{count!r}")
+            mode = body.get("allocationMode", "ExactCount")
+            if mode not in ("ExactCount", "All"):
+                result.deny(f"{sub_where}: unknown allocationMode "
+                            f"{mode!r}")
+            for j, selector in enumerate(body.get("selectors") or []):
+                cel = (selector.get("cel") or {}).get("expression", "")
+                _check_cel(cel, f"{sub_where}.selectors[{j}].cel", result)
+            cap_requests = ((body.get("capacity") or {})
+                            .get("requests") or {})
+            for key, raw in cap_requests.items():
+                cap_where = f"{sub_where}.capacity.requests[{key!r}]"
+                if key not in KNOWN_CAPACITIES:
+                    result.deny(f"{cap_where}: unknown capacity (known: "
+                                f"{sorted(KNOWN_CAPACITIES)})")
+                    continue
+                value = _quantity_to_int(raw)
+                if value is None or value < 1:
+                    result.deny(f"{cap_where}: invalid quantity {raw!r}")
+                elif key == CORE_COUNTER and value > 100:
+                    result.deny(f"{cap_where}: {value} exceeds the "
+                                "per-chip coreRatio of 100")
+                elif key == MEMORY_COUNTER \
+                        and value > MAX_MEMORY_MIB_PER_DEVICE:
+                    result.deny(f"{cap_where}: {value}MiB exceeds any "
+                                "chip's HBM")
+                else:
+                    capacity_by_request.setdefault(name, {})[key] = value
+
+    for i, config in enumerate(devices.get("config") or []):
+        opaque = config.get("opaque") or {}
+        if opaque.get("driver") != consts.DRA_DRIVER_NAME:
+            continue
+        where = f"devices.config[{i}].opaque.parameters"
+        refs = config.get("requests") or []
+        for ref in refs:
+            # "request/subrequest" form selects a FirstAvailable arm
+            base = ref.split("/", 1)[0]
+            if base not in names:
+                result.deny(f"devices.config[{i}]: references unknown "
+                            f"request {ref!r}")
+        params = _check_params(opaque.get("parameters") or {}, where,
+                               result)
+        # coherence: opaque params and capacity requests describe the same
+        # partition — conflicting values would enforce one and bill the
+        # other (reference: capacity vs coreRatio/memoryRatio bounds)
+        targets = ([r.split("/", 1)[0] for r in refs]
+                   if refs else list(vtpu_request_names))
+        for target in targets:
+            caps = capacity_by_request.get(target) or {}
+            if "cores" in params and CORE_COUNTER in caps \
+                    and params["cores"] != caps[CORE_COUNTER]:
+                result.deny(
+                    f"{where}: cores={params['cores']} conflicts with "
+                    f"request {target!r} capacity "
+                    f"{CORE_COUNTER}={caps[CORE_COUNTER]}")
+            if "memoryMiB" in params and MEMORY_COUNTER in caps \
+                    and params["memoryMiB"] != caps[MEMORY_COUNTER]:
+                result.deny(
+                    f"{where}: memoryMiB={params['memoryMiB']} conflicts "
+                    f"with request {target!r} capacity "
+                    f"{MEMORY_COUNTER}={caps[MEMORY_COUNTER]}")
+    return result
+
+
+def validate_claim_object(obj: dict) -> ValidateResult:
+    """Entry for both ResourceClaims and ResourceClaimTemplates (template
+    specs nest one level deeper: spec.spec)."""
+    kind = obj.get("kind") or ""
+    spec = obj.get("spec") or {}
+    if kind == "ResourceClaimTemplate" or (
+            not kind and isinstance(spec.get("spec"), dict)):
+        spec = spec.get("spec") or {}
+    return validate_claim_spec(spec)
+
+
+# ---------------------------------------------------------------------------
+# Allocated-claim sharing rules (status subresource).
+#
+# Reference validateOneReservedPodAgainstAllocatedClaim: three lifecycle
+# classes decide who may share a request — non-restartable init containers
+# are strictly sequential (any number may share); app containers run
+# concurrently (at most one); a sidecar (restartable init) overlaps
+# everything, so it must be the request's sole user. Cross-pod sharing is
+# never allowed, and one container may use at most one allocated vtpu
+# claim (its shim enforces exactly one partition).
+# ---------------------------------------------------------------------------
+
+
+def _allocated_vtpu_requests(claim: dict) -> set[str]:
+    allocation = (claim.get("status") or {}).get("allocation") or {}
+    results = (allocation.get("devices") or {}).get("results") or []
+    return {r.get("request", "").split("/", 1)[0] for r in results
+            if r.get("driver") == consts.DRA_DRIVER_NAME}
+
+
+def _pod_containers(pod: dict):
+    """Yields (container, kind) with kind in {'init', 'sidecar', 'app'}."""
+    spec = pod.get("spec") or {}
+    for cont in spec.get("initContainers") or []:
+        restartable = cont.get("restartPolicy") == "Always"
+        yield cont, ("sidecar" if restartable else "init")
+    for cont in spec.get("containers") or []:
+        yield cont, "app"
+
+
+def _claim_name_for_ref(pod: dict, ref_name: str) -> str | None:
+    """Resolve a container resources.claims[].name through the pod-level
+    spec.resourceClaims entry to the actual ResourceClaim object name."""
+    for entry in (pod.get("spec") or {}).get("resourceClaims") or []:
+        if entry.get("name") != ref_name:
+            continue
+        if entry.get("resourceClaimName"):
+            return entry["resourceClaimName"]
+        for status in ((pod.get("status") or {})
+                       .get("resourceClaimStatuses") or []):
+            if status.get("name") == ref_name:
+                return status.get("resourceClaimName")
+        return None
+    return None
+
+
+def validate_allocated_sharing(claim: dict, reserved_pods: list[dict],
+                               claims_by_name: dict[tuple[str, str], dict]
+                               ) -> ValidateResult:
+    """Validate every reserved pod's container references against this
+    allocated claim. claims_by_name: (namespace, name) -> claim for the
+    OTHER claims the pods reference (one-container-one-claim check)."""
+    result = ValidateResult()
+    current_requests = _allocated_vtpu_requests(claim)
+    if not current_requests:
+        return result
+    claim_ns = (claim.get("metadata") or {}).get("namespace", "default")
+    claim_name = (claim.get("metadata") or {}).get("name", "")
+    # request -> usage sets
+    usage: dict[str, dict[str, set]] = {}
+
+    for pod in reserved_pods:
+        meta = pod.get("metadata") or {}
+        pod_id = f"{meta.get('namespace', 'default')}/{meta.get('name')}"
+        for cont, kind in _pod_containers(pod):
+            cont_id = f"{pod_id}/{cont.get('name')}"
+            hit_claims: set[str] = set()
+            current_hits: set[str] = set()
+            for ref in (cont.get("resources") or {}).get("claims") or []:
+                actual = _claim_name_for_ref(pod, ref.get("name", ""))
+                if actual is None:
+                    continue
+                key = (meta.get("namespace", "default"), actual)
+                other = (claim if actual == claim_name
+                         and key[0] == claim_ns
+                         else claims_by_name.get(key))
+                if other is None:
+                    continue
+                allocated = _allocated_vtpu_requests(other)
+                if not allocated:
+                    continue
+                wanted = ref.get("request")
+                hits = ({wanted.split("/", 1)[0]} & allocated if wanted
+                        else allocated)
+                if hits:
+                    hit_claims.add(actual)
+                if actual == claim_name and key[0] == claim_ns:
+                    current_hits |= hits
+            if len(hit_claims) > 1:
+                result.deny(
+                    f"container {cont_id} uses multiple allocated vtpu "
+                    f"claims {sorted(hit_claims)}; one container can use "
+                    "at most one")
+            for request in sorted(current_hits):
+                u = usage.setdefault(request, {
+                    "pods": set(), "init": set(), "app": set(),
+                    "sidecar": set()})
+                u["pods"].add(pod_id)
+                u[kind].add(cont_id)
+                if len(u["app"]) > 1:
+                    result.deny(
+                        f"allocated vtpu request {request!r} in claim "
+                        f"{claim_ns}/{claim_name} is referenced by "
+                        f"multiple app containers {sorted(u['app'])}")
+                if len(u["sidecar"]) > 1:
+                    result.deny(
+                        f"allocated vtpu request {request!r} is "
+                        f"referenced by multiple sidecars "
+                        f"{sorted(u['sidecar'])}")
+                if u["sidecar"] and (u["init"] or u["app"]):
+                    result.deny(
+                        f"allocated vtpu request {request!r} is "
+                        f"referenced by sidecar {sorted(u['sidecar'])} "
+                        "together with other containers; a sidecar must "
+                        "be the sole user")
+                if len(u["pods"]) > 1:
+                    result.deny(
+                        f"allocated vtpu request {request!r} is shared "
+                        f"by multiple pods {sorted(u['pods'])}")
+    return result

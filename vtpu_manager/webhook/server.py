@@ -48,6 +48,8 @@ class WebhookAPI:
         app = web.Application(client_max_size=16 * 2**20)
         app.router.add_post("/pods/mutate", self.handle_mutate)
         app.router.add_post("/pods/validate", self.handle_validate)
+        app.router.add_post("/resourceclaims/validate",
+                            self.handle_claim_validate)
         app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_get("/readyz", self.handle_healthz)
         return app
@@ -109,6 +111,64 @@ class WebhookAPI:
             log.exception("validate failed")
             return web.json_response(_admission_response(
                 "", allowed=False, message=f"validation error: {e}"))
+
+    async def handle_claim_validate(self, request: web.Request
+                                    ) -> web.Response:
+        """User-authored ResourceClaim/Template admission (reference
+        resourceclaim.go Path=/resourceclaim/validate): spec validation on
+        CREATE/UPDATE, sharing rules on the status subresource."""
+        self.stats["validate"] += 1
+        import asyncio
+
+        from vtpu_manager.webhook.dra_validate import validate_claim_object
+        try:
+            body = await request.json()
+            req = body.get("request") or {}
+            uid = req.get("uid", "")
+            obj = req.get("object") or {}
+            if req.get("operation") in (None, "CREATE", "UPDATE"):
+                result = validate_claim_object(obj)
+                if result.allowed and req.get("subResource") == "status" \
+                        and self.client is not None:
+                    # the sharing walk issues blocking API reads; keep them
+                    # off the event loop so concurrent admissions proceed
+                    result = await asyncio.get_running_loop() \
+                        .run_in_executor(None, self._validate_sharing, obj)
+                return web.json_response(_admission_response(
+                    uid, allowed=result.allowed, message=result.message))
+            return web.json_response(_admission_response(uid))
+        except Exception as e:
+            self.stats["errors"] += 1
+            log.exception("claim validate failed")
+            return web.json_response(_admission_response(
+                "", allowed=False, message=f"validation error: {e}"))
+
+    def _validate_sharing(self, claim: dict):
+        """Resolve the claim's reserved pods + their other claims through
+        the API client, then run the pure sharing validation."""
+        from vtpu_manager.claimresolve.resolve import pod_claim_names
+        from vtpu_manager.webhook.dra_validate import (
+            validate_allocated_sharing)
+        ns = (claim.get("metadata") or {}).get("namespace", "default")
+        reserved = []
+        for ref in ((claim.get("status") or {}).get("reservedFor") or []):
+            if ref.get("resource", "pods") != "pods":
+                continue
+            try:
+                reserved.append(self.client.get_pod(ns, ref.get("name", "")))
+            except Exception:
+                continue   # pod deleted mid-flight: nothing to validate
+        claims_by_name: dict[tuple[str, str], dict] = {}
+        for pod in reserved:
+            for key in pod_claim_names(pod):
+                if key in claims_by_name:
+                    continue
+                try:
+                    claims_by_name[key] = self.client.get_resourceclaim(
+                        key[0], key[1])
+                except Exception:
+                    continue
+        return validate_allocated_sharing(claim, reserved, claims_by_name)
 
     async def handle_healthz(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")
