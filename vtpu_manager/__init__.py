@@ -1,0 +1,3 @@
+"""vtpu-manager: TPU-native device virtualization for Kubernetes."""
+
+__version__ = "0.2.0"

@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
+import vtpu_manager
 from vtpu_manager.config import vtpu_config as vc
 from vtpu_manager.config.tc_watcher import TcUtilFile
 from vtpu_manager.config.vmem import VmemLedger, fnv64
@@ -22,6 +24,14 @@ from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
+
+
+def _age_seconds(ts_monotonic_ns: int, now_ns: int | None = None) -> float:
+    """Age of a monotonic-clock timestamp; negative deltas (pre-reboot
+    stamps) read as very stale, not fresh."""
+    now = time.monotonic_ns() if now_ns is None else now_ns
+    delta = now - ts_monotonic_ns
+    return delta / 1e9 if delta >= 0 else float("inf")
 
 
 class Gauge:
@@ -56,6 +66,9 @@ class NodeCollector:
         self.base_dir = base_dir
         self.tc_path = tc_path
         self.vmem_path = vmem_path
+        # peak concurrent tenancy per chip across this monitor's lifetime
+        # (reference vGPUPeakSharedContainersNumber)
+        self._peak_shared: dict[str, int] = {}
 
     def _container_configs(self) -> list[tuple[str, str, vc.VtpuConfig]]:
         out = []
@@ -75,10 +88,18 @@ class NodeCollector:
 
     def collect(self) -> list[Gauge]:
         gauges: list[Gauge] = []
+        chip_by_index = {c.index: c for c in self.chips}
 
+        # ---- physical chip gauges (reference physical_gpu_device_*) ----
         g_mem_total = Gauge("vtpu_device_memory_total_bytes",
                             "Physical HBM per chip",
                             ("node", "uuid", "index"))
+        g_mem_used = Gauge("vtpu_device_memory_used_bytes",
+                           "HBM in use on the chip across all tenants",
+                           ("node", "uuid", "index"))
+        g_mem_util = Gauge("vtpu_device_memory_utilization_percent",
+                           "Chip HBM utilization (0-100)",
+                           ("node", "uuid", "index"))
         g_healthy = Gauge("vtpu_device_healthy",
                           "Chip health (1 healthy)",
                           ("node", "uuid", "index"))
@@ -88,6 +109,10 @@ class NodeCollector:
         g_slots_total = Gauge("vtpu_device_slots_total",
                               "Advertised vTPU slots per chip",
                               ("node", "uuid", "index"))
+        g_feed_age = Gauge("vtpu_device_feed_age_seconds",
+                           "Age of the node watcher's last sample for the "
+                           "chip (staleness signal)",
+                           ("node", "uuid", "index"))
         for chip in self.chips:
             labels = (self.node_name, chip.uuid, str(chip.index))
             g_mem_total.set(labels, float(chip.memory))
@@ -95,44 +120,34 @@ class NodeCollector:
             g_slots_total.set(labels, float(chip.split_count))
         gauges += [g_mem_total, g_healthy, g_slots_total]
 
-        # node watcher feed: chip duty cycle + per-tenant attributed
-        # shares (the watcher apportions by ledger submit-activity
-        # deltas). Keyed per (tenant, chip): ProcUtil.util is percent OF
-        # ONE CHIP — summing across chips would exceed 100.
+        # node watcher feed: chip duty cycle + per-tenant/per-process
+        # attributed shares (the watcher apportions by ledger submit-
+        # activity deltas). Keyed per (tenant, chip): ProcUtil.util is
+        # percent OF ONE CHIP — summing across chips would exceed 100.
         util_by_token: dict[tuple[int, int], int] = {}
+        proc_utils: list[tuple[int, int, int, int]] = []  # token,chip,pid,%
         try:
             tc = TcUtilFile(self.tc_path)
             for chip in self.chips:
                 rec = tc.read_device(chip.index)
                 if rec is not None:
-                    g_util.set((self.node_name, chip.uuid, str(chip.index)),
-                               float(rec.device_util))
+                    labels = (self.node_name, chip.uuid, str(chip.index))
+                    g_util.set(labels, float(rec.device_util))
+                    if rec.timestamp_ns:
+                        g_feed_age.set(labels,
+                                       _age_seconds(rec.timestamp_ns))
                     for proc in rec.procs:
                         key = (proc.owner_token, chip.index)
                         util_by_token[key] = \
                             util_by_token.get(key, 0) + proc.util
+                        proc_utils.append((proc.owner_token, chip.index,
+                                           proc.pid, proc.util))
             tc.close()
         except (OSError, ValueError):
             pass
-        gauges.append(g_util)
+        gauges += [g_util, g_feed_age]
 
-        # per-container assignment + usage
-        g_climit = Gauge("vtpu_container_core_limit_percent",
-                         "Assigned core percent",
-                         ("node", "pod_uid", "container", "uuid"))
-        g_mlimit = Gauge("vtpu_container_memory_limit_bytes",
-                         "Assigned HBM cap",
-                         ("node", "pod_uid", "container", "uuid"))
-        g_musage = Gauge("vtpu_container_memory_used_bytes",
-                         "HBM bytes recorded by the container's processes",
-                         ("node", "pod_uid", "container", "uuid"))
-        g_cutil = Gauge("vtpu_container_utilization_percent",
-                        "Chip duty-cycle share attributed to the container",
-                        ("node", "pod_uid", "container", "uuid"))
-        g_assigned = Gauge("vtpu_device_assigned_containers",
-                           "Containers sharing each chip",
-                           ("node", "uuid"))
-        assigned: dict[str, int] = {}
+        # ---- vmem ledger: usage + heartbeat ----
         vmem = None
         try:
             vmem = VmemLedger(self.vmem_path)
@@ -143,37 +158,194 @@ class NodeCollector:
         # are never conflated and a multi-chip container's rows stay
         # per-device (a token-only sum would double every uuid row)
         usage_by_token: dict[tuple[int, int], int] = {}
+        used_by_chip: dict[int, int] = {}
+        heartbeat_by_token: dict[int, int] = {}   # newest last_update_ns
+        ledger_entries = []
         if vmem is not None:
-            for entry in vmem.entries():
-                key = (entry.owner_token, entry.host_index)
-                usage_by_token[key] = \
-                    usage_by_token.get(key, 0) + entry.bytes
+            ledger_entries = list(vmem.entries())
+            vmem.close()
+        for entry in ledger_entries:
+            key = (entry.owner_token, entry.host_index)
+            usage_by_token[key] = usage_by_token.get(key, 0) + entry.bytes
+            used_by_chip[entry.host_index] = \
+                used_by_chip.get(entry.host_index, 0) + entry.bytes
+            heartbeat_by_token[entry.owner_token] = max(
+                heartbeat_by_token.get(entry.owner_token, 0),
+                entry.last_update_ns)
+        # every chip gets a row — an idle chip's explicit 0 keeps "no
+        # usage" distinguishable from "exporter broken"
+        for chip in self.chips:
+            used = used_by_chip.get(chip.index, 0)
+            labels = (self.node_name, chip.uuid, str(chip.index))
+            g_mem_used.set(labels, float(used))
+            if chip.memory:
+                g_mem_util.set(labels,
+                               round(100.0 * used / chip.memory, 2))
+        gauges += [g_mem_used, g_mem_util]
+
+        # ---- per-container assignment + usage ----
+        g_climit = Gauge("vtpu_container_core_limit_percent",
+                         "Assigned core percent",
+                         ("node", "pod_uid", "container", "uuid"))
+        g_mlimit = Gauge("vtpu_container_memory_limit_bytes",
+                         "Assigned HBM cap (virtual: oversold claims may "
+                         "sum past the chip)",
+                         ("node", "pod_uid", "container", "uuid"))
+        g_mplimit = Gauge("vtpu_container_memory_limit_physical_bytes",
+                          "Assigned cap clamped to physical chip HBM",
+                          ("node", "pod_uid", "container", "uuid"))
+        g_musage = Gauge("vtpu_container_memory_used_bytes",
+                         "HBM bytes recorded by the container's processes",
+                         ("node", "pod_uid", "container", "uuid"))
+        g_mem_pct = Gauge("vtpu_container_memory_utilization_percent",
+                          "Used bytes over the container's cap (0-100)",
+                          ("node", "pod_uid", "container", "uuid"))
+        g_cutil = Gauge("vtpu_container_utilization_percent",
+                        "Chip duty-cycle share attributed to the container",
+                        ("node", "pod_uid", "container", "uuid"))
+        g_heartbeat = Gauge("vtpu_container_heartbeat_age_seconds",
+                            "Seconds since the container's processes last "
+                            "touched the ledger (staleness signal)",
+                            ("node", "pod_uid", "container"))
+        g_assigned = Gauge("vtpu_device_assigned_containers",
+                           "Containers sharing each chip",
+                           ("node", "uuid"))
+        g_peak = Gauge("vtpu_device_assigned_containers_peak",
+                       "Peak concurrent containers per chip since monitor "
+                       "start",
+                       ("node", "uuid"))
+        g_cores_total = Gauge("vtpu_device_cores_total_percent",
+                              "Allocatable core budget per chip (100)",
+                              ("node", "uuid", "index"))
+        g_cores_assigned = Gauge("vtpu_device_cores_assigned_percent",
+                                 "Sum of assigned core percents per chip",
+                                 ("node", "uuid", "index"))
+        g_dev_assigned_mem = Gauge(
+            "vtpu_device_memory_assigned_bytes",
+            "Sum of assigned caps per chip (virtual)",
+            ("node", "uuid", "index"))
+        g_dev_assigned_pmem = Gauge(
+            "vtpu_device_memory_assigned_physical_bytes",
+            "Sum of physically-clamped assigned caps per chip",
+            ("node", "uuid", "index"))
+        g_proc_mem = Gauge("vtpu_process_memory_used_bytes",
+                           "Per-process HBM bytes from the ledger",
+                           ("node", "pod_uid", "container", "uuid", "pid"))
+        g_proc_util = Gauge("vtpu_process_utilization_percent",
+                            "Per-process duty-cycle share from the feed",
+                            ("node", "pod_uid", "container", "uuid", "pid"))
+
+        assigned: dict[str, int] = {}
+        cores_assigned: dict[int, int] = {}
+        mem_assigned: dict[int, int] = {}
+        pmem_assigned: dict[int, int] = {}
+        tenant_by_token: dict[int, tuple[str, str]] = {}
+        now_ns = time.monotonic_ns()
         for pod_uid, container, cfg in self._container_configs():
             token = fnv64(f"{pod_uid}/{container}")
+            tenant_by_token[token] = (pod_uid, container)
             for dev in cfg.devices:
                 labels = (self.node_name, pod_uid, container, dev.uuid)
+                phys_cap = (min(dev.total_memory, dev.real_memory)
+                            if dev.real_memory else dev.total_memory)
+                used = usage_by_token.get((token, dev.host_index), 0)
                 g_climit.set(labels, float(dev.hard_core))
                 g_mlimit.set(labels, float(dev.total_memory))
-                g_musage.set(labels, float(
-                    usage_by_token.get((token, dev.host_index), 0)))
+                g_mplimit.set(labels, float(phys_cap))
+                g_musage.set(labels, float(used))
+                if dev.total_memory:
+                    g_mem_pct.set(labels,
+                                  round(100.0 * used / dev.total_memory, 2))
                 g_cutil.set(labels, float(
                     util_by_token.get((token, dev.host_index), 0)))
+                if dev.host_index not in chip_by_index:
+                    # stale config naming a removed/undiscovered chip:
+                    # keep the container row (it reflects on-disk truth)
+                    # but keep it OUT of chip/node aggregates, else
+                    # sum(per-device rows) != node totals
+                    continue
                 assigned[dev.uuid] = assigned.get(dev.uuid, 0) + 1
-        if vmem is not None:
-            vmem.close()
+                cores_assigned[dev.host_index] = \
+                    cores_assigned.get(dev.host_index, 0) + dev.hard_core
+                mem_assigned[dev.host_index] = \
+                    mem_assigned.get(dev.host_index, 0) + dev.total_memory
+                pmem_assigned[dev.host_index] = \
+                    pmem_assigned.get(dev.host_index, 0) + phys_cap
+            ts = heartbeat_by_token.get(token)
+            if ts:
+                g_heartbeat.set((self.node_name, pod_uid, container),
+                                round(_age_seconds(ts, now_ns), 3))
+
+        for chip in self.chips:
+            labels = (self.node_name, chip.uuid, str(chip.index))
+            g_cores_total.set(labels, 100.0)
+            g_cores_assigned.set(
+                labels, float(cores_assigned.get(chip.index, 0)))
+            g_dev_assigned_mem.set(
+                labels, float(mem_assigned.get(chip.index, 0)))
+            g_dev_assigned_pmem.set(
+                labels, float(pmem_assigned.get(chip.index, 0)))
         for uuid, count in assigned.items():
             g_assigned.set((self.node_name, uuid), float(count))
-        gauges += [g_climit, g_mlimit, g_musage, g_cutil, g_assigned]
+            self._peak_shared[uuid] = max(self._peak_shared.get(uuid, 0),
+                                          count)
+        for uuid, peak in self._peak_shared.items():
+            g_peak.set((self.node_name, uuid), float(peak))
 
-        # node aggregates
+        # per-process breakdown, attributed through the owner token; rows
+        # whose token matches no live container config are skipped (stale
+        # tenants are the reaper's business, not the scrape's)
+        for entry in ledger_entries:
+            tenant = tenant_by_token.get(entry.owner_token)
+            chip = chip_by_index.get(entry.host_index)
+            if tenant is None or chip is None:
+                continue
+            g_proc_mem.set((self.node_name, tenant[0], tenant[1],
+                            chip.uuid, str(entry.pid)), float(entry.bytes))
+        for token, index, pid, util in proc_utils:
+            tenant = tenant_by_token.get(token)
+            chip = chip_by_index.get(index)
+            if tenant is None or chip is None:
+                continue
+            g_proc_util.set((self.node_name, tenant[0], tenant[1],
+                             chip.uuid, str(pid)), float(util))
+
+        gauges += [g_climit, g_mlimit, g_mplimit, g_musage, g_mem_pct,
+                   g_cutil, g_heartbeat, g_assigned, g_peak, g_cores_total,
+                   g_cores_assigned, g_dev_assigned_mem, g_dev_assigned_pmem,
+                   g_proc_mem, g_proc_util]
+
+        # ---- node aggregates + info ----
         g_total = Gauge("vtpu_node_slots_total", "Node vTPU slot capacity",
                         ("node",))
         g_used = Gauge("vtpu_node_slots_assigned", "Assigned vTPU slots",
                        ("node",))
+        g_node_mem = Gauge("vtpu_node_memory_total_bytes",
+                           "Physical HBM across the node's chips", ("node",))
+        g_node_assigned_mem = Gauge(
+            "vtpu_node_memory_assigned_bytes",
+            "Assigned caps across the node (virtual)", ("node",))
+        g_node_assigned_pmem = Gauge(
+            "vtpu_node_memory_assigned_physical_bytes",
+            "Physically-clamped assigned caps across the node", ("node",))
+        g_info = Gauge("vtpu_node_info",
+                       "Static node/manager build info (value always 1)",
+                       ("node", "version", "resource_domain",
+                        "annotation_domain", "chips"))
         g_total.set((self.node_name,),
                     float(sum(c.split_count for c in self.chips)))
         g_used.set((self.node_name,), float(sum(assigned.values())))
-        gauges += [g_total, g_used]
+        g_node_mem.set((self.node_name,),
+                       float(sum(c.memory for c in self.chips)))
+        g_node_assigned_mem.set((self.node_name,),
+                                float(sum(mem_assigned.values())))
+        g_node_assigned_pmem.set((self.node_name,),
+                                 float(sum(pmem_assigned.values())))
+        g_info.set((self.node_name, vtpu_manager.__version__,
+                    consts.resource_domain(), consts.annotation_domain(),
+                    str(len(self.chips))), 1.0)
+        gauges += [g_total, g_used, g_node_mem, g_node_assigned_mem,
+                   g_node_assigned_pmem, g_info]
         return gauges
 
     def render(self) -> str:
