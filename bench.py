@@ -90,11 +90,14 @@ def tpu_healthy(timeout_s: int = 120) -> bool:
         return False
 
 
-def run_tpu_worker(quota: int) -> float | None:
+def run_tpu_worker(quota: int, no_shim: bool = False) -> float | None:
     """One quota point in a fresh process; returns ms/step."""
+    env = tpu_env(quota)
+    if no_shim:
+        env["VTPU_BENCH_NOSHIM"] = "1"
     try:
         res = subprocess.run(
-            [sys.executable, __file__, "--worker"], env=tpu_env(quota),
+            [sys.executable, __file__, "--worker"], env=env,
             capture_output=True, text=True, timeout=420)
     except subprocess.TimeoutExpired:
         print(f"worker q={quota} timed out", file=sys.stderr)
@@ -108,12 +111,15 @@ def run_tpu_worker(quota: int) -> float | None:
 
 
 def worker_main() -> None:
-    """Runs inside the quota subprocess: sync trainer loop on the TPU."""
+    """Runs inside the quota subprocess: sync trainer loop on the TPU.
+    VTPU_BENCH_NOSHIM=1 loads the real plugin directly (shim-off baseline
+    for the overhead metric)."""
     import uuid
 
     from axon.register import register
+    so = AXON_PLUGIN if os.environ.get("VTPU_BENCH_NOSHIM") == "1" else SHIM
     register(None, f"{os.environ.get('PALLAS_AXON_TPU_GEN', 'v5e')}:1x1x1",
-             so_path=SHIM, session_id=str(uuid.uuid4()),
+             so_path=so, session_id=str(uuid.uuid4()),
              remote_compile=os.environ.get(
                  "PALLAS_AXON_REMOTE_COMPILE", "1") == "1")
     import jax
@@ -198,6 +204,32 @@ def run_fake_sweep() -> dict[int, float] | None:
     return out if len(out) == len(QUOTAS) else None
 
 
+def run_hermetic_overhead() -> float | None:
+    """Per-exec shim overhead in µs: the throttle loop against the fake
+    plugin with zero simulated device time, unthrottled, shim interposed
+    vs the fake plugin loaded directly (shim_test dlopens SHIM_PATH, so
+    pointing it at the fake IS the no-shim baseline). Reuses the ablation
+    harness's shim_test driver."""
+    fake = os.path.join(BUILD, "libfake-pjrt.so")
+    if not (os.path.exists(os.path.join(BUILD, "shim_test"))
+            and os.path.exists(fake)):
+        return None
+    sys.path.insert(0, os.path.join(REPO, "library", "test"))
+    from ablation import run_point
+    iters = 2000
+    walls = {}
+    for label, shim_path in (("shim", SHIM), ("noshim", fake)):
+        try:
+            wall = run_point("auto", 100, iters, exec_us=0,
+                             shim_path=shim_path)
+        except subprocess.TimeoutExpired:
+            return None
+        if wall is None:
+            return None
+        walls[label] = wall
+    return 1000.0 * (walls["shim"] - walls["noshim"]) / iters
+
+
 def tpu_available() -> bool:
     return os.path.exists(AXON_PLUGIN)
 
@@ -213,12 +245,22 @@ def main() -> int:
 
     times: dict[int, float] = {}
     hbm_penalty = 0
+    overhead: dict = {}
     if tpu_available() and tpu_healthy():
         for quota in QUOTAS:
             ms = run_tpu_worker(quota)
             if ms is not None:
                 times[quota] = ms
         hbm_penalty = run_hbm_check()
+        # shim overhead: unthrottled ms/step with vs without the shim
+        noshim = run_tpu_worker(100, no_shim=True)
+        if noshim is not None and 100 in times and noshim > 0:
+            pct = 100.0 * (times[100] - noshim) / noshim
+            overhead = {"shim_overhead_pct": round(pct, 2),
+                        "ms_per_step_shim": round(times[100], 2),
+                        "ms_per_step_noshim": round(noshim, 2)}
+            print(f"shim overhead: {times[100]:.1f} vs {noshim:.1f} "
+                  f"ms/step = {pct:+.2f}%", file=sys.stderr)
     elif tpu_available():
         print("TPU transport unhealthy; using hermetic fallback",
               file=sys.stderr)
@@ -244,9 +286,17 @@ def main() -> int:
     mae = sum(errors) / len(errors) + hbm_penalty
     print(f"ms/step unthrottled={t100:.1f}; MAE={mae:.2f}%",
           file=sys.stderr)
-    print(json.dumps({"metric": "core_quota_tracking_mae",
-                      "value": round(mae, 2), "unit": "percent",
-                      "vs_baseline": round(mae / BASELINE_AIMD_MAE, 3)}))
+    if not overhead:
+        us = run_hermetic_overhead()
+        if us is not None:
+            overhead = {"shim_overhead_us_per_exec_hermetic": round(us, 1)}
+            print(f"hermetic shim overhead: {us:.1f} µs/exec",
+                  file=sys.stderr)
+    line = {"metric": "core_quota_tracking_mae",
+            "value": round(mae, 2), "unit": "percent",
+            "vs_baseline": round(mae / BASELINE_AIMD_MAE, 3)}
+    line.update(overhead)
+    print(json.dumps(line))
     return 0
 
 

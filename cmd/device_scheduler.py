@@ -23,6 +23,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--cert-file")
     parser.add_argument("--key-file")
     parser.add_argument("--feature-gates", default="")
+    parser.add_argument("--pod-snapshot-ttl-ms", type=int, default=250,
+                        help="amortize the cluster-wide pod LIST across "
+                             "filter calls (informer-cache analogue; the "
+                             "assumed cache keeps our own placements "
+                             "fresh). 0 = list per call")
     parser.add_argument("--require-node-label", action="store_true",
                         help="only consider nodes labeled "
                              "vtpu-manager-enable=true")
@@ -63,7 +68,8 @@ def main(argv: list[str] | None = None) -> int:
     bind_locker = SerialLocker(gates.enabled(SERIAL_BIND_NODE))
     api = SchedulerAPI(
         FilterPredicate(client,
-                        require_node_label=args.require_node_label),
+                        require_node_label=args.require_node_label,
+                        pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0),
         BindPredicate(client, locker=bind_locker),
         PreemptPredicate(client),
         debug_endpoints=args.debug_endpoints)

@@ -28,6 +28,15 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--registry-dir",
                         default="/var/lib/kubelet/plugins_registry")
     parser.add_argument("--fake-chips", type=int, default=0)
+    parser.add_argument("--node-config", default="",
+                        help="node-config YAML (same file the device "
+                             "plugin takes): split count, scaling, "
+                             "exclusions shape the ResourceSlice")
+    parser.add_argument("--id-store",
+                        default="/etc/vtpu-manager/device_ids.json",
+                        help="persistent chip-uuid store shared with the "
+                             "device plugin so excludeDevices uuids match "
+                             "across both stacks")
     parser.add_argument("--nri-socket", default="",
                         help="NRI runtime socket (e.g. /var/run/nri/"
                              "nri.sock); empty disables the NRI stub")
@@ -63,6 +72,22 @@ def main(argv: list[str] | None = None) -> int:
         log.error("no TPU chips discovered")
         return 1
     chips = result.chips
+    if args.node_config:
+        from vtpu_manager.config.node_config import (DeviceIDStore,
+                                                     load_node_config,
+                                                     shape_chips)
+        cfg = load_node_config(args.node_config, args.node_name)
+        # same id store as the device plugin: excludeDevices uuids and
+        # published device ids must agree between the two stacks
+        id_store = None
+        try:
+            id_store = DeviceIDStore(args.id_store)
+        except OSError:
+            log.warning("id store %s unavailable; using discovery uuids",
+                        args.id_store)
+        chips = shape_chips(chips, cfg, args.node_name, id_store)
+        log.info("node config applied: %d chips, split=%d",
+                 len(chips), cfg.device_split_count)
 
     state = DeviceState(args.node_name, chips,
                         base_dir=args.base_dir or consts.MANAGER_BASE_DIR,

@@ -75,11 +75,15 @@ class FeedPublisher:
 
 
 def run_point(controller: str, quota: int, iters: int,
-              exec_us: int, feed: "FeedPublisher | None" = None
-              ) -> float | None:
+              exec_us: int, feed: "FeedPublisher | None" = None,
+              shim_path: str | None = None) -> float | None:
+    """One shim_test --throttle-only run; wall ms or None. shim_path
+    overrides the interposed library (bench.py points it at the fake
+    plugin itself for its no-shim overhead baseline)."""
     env = dict(os.environ)
     env.update({
-        "SHIM_PATH": os.path.join(BUILD, "libvtpu-control.so"),
+        "SHIM_PATH": shim_path or os.path.join(BUILD,
+                                               "libvtpu-control.so"),
         "VTPU_REAL_TPU_LIBRARY_PATH": os.path.join(BUILD,
                                                    "libfake-pjrt.so"),
         "VTPU_MEM_LIMIT_0": str(1 << 30),
@@ -90,7 +94,12 @@ def run_point(controller: str, quota: int, iters: int,
         "FAKE_EXEC_US": str(exec_us),
         "SHIM_TEST_ITERS": str(iters),
     })
-    if feed is not None:
+    if feed is None:
+        # hermetic: stale node-daemon files at the default paths must not
+        # leak into the measurement
+        env.setdefault("VTPU_TC_UTIL_PATH", "/nonexistent")
+        env.setdefault("VTPU_VMEM_PATH", "/nonexistent")
+    else:
         env["VTPU_TC_UTIL_PATH"] = feed.tc_path
         env["FAKE_SHARED_STATE"] = feed.shared
         env["VTPU_POD_UID"] = "uid-ablation"

@@ -182,3 +182,58 @@ class TestResolverFailure:
         finally:
             conn.close()
             server.stop()
+
+
+def _load_probe_main():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "cmd", "nri_probe.py")
+    spec = importlib.util.spec_from_file_location("nri_probe", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+class TestProbe:
+    def test_probe_passes_against_loopback_runtime(self, tmp_path):
+        """cmd/nri_probe.py — the operator certification tool — must walk
+        all five steps cleanly against a conforming runtime end."""
+        import threading
+
+        probe_main = _load_probe_main()
+
+        def register(raw: bytes) -> bytes:
+            req = nri_pb2.RegisterPluginRequest.FromString(raw)
+            assert req.plugin_name == "vtpu-nri-probe"
+            return nri_pb2.Empty().SerializeToString()
+
+        sock_path = str(tmp_path / "nri.sock")
+        server = ttrpc.TtrpcServer(sock_path, {
+            (nt.RUNTIME_SERVICE, "RegisterPlugin"): register}, mux=True)
+
+        def runtime_side():
+            conn = server.wait_for_connection()
+            conn.call(nt.PLUGIN_SERVICE, "Configure",
+                      nri_pb2.ConfigureRequest(
+                          runtime_name="fake", runtime_version="2.0"
+                      ).SerializeToString())
+            conn.call(nt.PLUGIN_SERVICE, "Synchronize",
+                      nri_pb2.SynchronizeRequest(pods=[
+                          nri_pb2.PodSandbox(uid="u1", name="p",
+                                             namespace="ns")]
+                      ).SerializeToString())
+
+        t = threading.Thread(target=runtime_side, daemon=True)
+        t.start()
+        rc = probe_main(["--socket", sock_path, "--hold", "0.5",
+                         "--timeout", "5"])
+        t.join(timeout=5)
+        server.stop()
+        assert rc == 0
+
+    def test_probe_fails_without_socket(self, tmp_path):
+        probe_main = _load_probe_main()
+        rc = probe_main(["--socket", str(tmp_path / "missing.sock"),
+                         "--hold", "0.1", "--timeout", "1"])
+        assert rc == 1

@@ -15,10 +15,17 @@ from vtpu_manager.client.kube import KubeError
 
 
 class FakeKubeClient:
-    def __init__(self, upsert_on_patch: bool = False):
+    def __init__(self, upsert_on_patch: bool = False,
+                 copy_on_read: bool = True):
         # upsert_on_patch: smoke-server convenience — a patched-but-unknown
         # pod is created instead of 404ing (tests keep strict semantics).
+        # copy_on_read=False models informer-cache semantics (client-go
+        # informers hand out SHARED objects callers must not mutate) — the
+        # right fidelity for scale harnesses where per-read deepcopy of
+        # 100k pods would swamp the cost being measured. Tests keep the
+        # safe default.
         self.upsert_on_patch = upsert_on_patch
+        self.copy_on_read = copy_on_read
         self._lock = threading.RLock()
         self.nodes: dict[str, dict] = {}
         self.pods: dict[tuple[str, str], dict] = {}
@@ -82,7 +89,7 @@ class FakeKubeClient:
                 if node_name and \
                         (pod.get("spec") or {}).get("nodeName") != node_name:
                     continue
-                out.append(copy.deepcopy(pod))
+                out.append(copy.deepcopy(pod) if self.copy_on_read else pod)
             return out
 
     def get_pod(self, namespace: str, name: str) -> dict:

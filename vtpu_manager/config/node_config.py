@@ -90,6 +90,30 @@ def load_node_config(path: str | None, node_name: str) -> NodeConfig:
     return cfg
 
 
+def shape_chips(chips, cfg: NodeConfig, node_name: str,
+                id_store: "DeviceIDStore | None" = None):
+    """Apply the node config to discovered chips: stable ids, exclusions,
+    split count, memory scaling (reference initDevices device.go:230).
+    Shared by the device plugin's DeviceManager and the DRA driver so both
+    stacks advertise the same shaped inventory."""
+    import logging
+    from dataclasses import replace
+    log = logging.getLogger(__name__)
+    out = []
+    for chip in chips:
+        uuid = chip.uuid
+        if id_store is not None:
+            uuid = id_store.uuid_for(node_name, chip.index, hw_serial=None)
+        if cfg.excludes(uuid, chip.index):
+            log.info("device %s (%d) excluded by node config", uuid,
+                     chip.index)
+            continue
+        out.append(replace(chip, uuid=uuid,
+                           split_count=cfg.device_split_count,
+                           memory=int(chip.memory * cfg.memory_scaling)))
+    return out
+
+
 class DeviceIDStore:
     """Persistent chip-uuid store so synthetic ids survive restarts
     (reference: pkg/config/node/id_store.go). Chips discovered without a
@@ -119,8 +143,15 @@ class DeviceIDStore:
         return self._ids[key]
 
     def _save(self) -> None:
-        os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._ids, f)
-        os.replace(tmp, self.path)
+        # best effort: on a read-only fs the ids stay stable in-process;
+        # losing persistence must not crash device advertisement
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._ids, f)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "device-id store %s not persisted: %s", self.path, e)

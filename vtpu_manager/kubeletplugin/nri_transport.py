@@ -63,6 +63,10 @@ class NriPlugin:
         self.plugin_name = plugin_name
         self.plugin_idx = plugin_idx
         self.configured = False
+        # (pods, containers) decoded from the runtime's Synchronize; the
+        # certification probe (cmd/nri_probe.py) checks the payload
+        # decoded sanely against the assumed field numbers
+        self.synchronized: tuple[list[dict], list[dict]] | None = None
         self.events_seen: list[int] = []
 
     # -- handler map the transport dispatches into --------------------------
@@ -89,7 +93,11 @@ class NriPlugin:
     def _synchronize(self, raw: bytes) -> bytes:
         # existing containers are observed, never adjusted retroactively
         # (reference Synchronize: plugin.go:287)
-        nri_pb2.SynchronizeRequest.FromString(raw)
+        req = nri_pb2.SynchronizeRequest.FromString(raw)
+        self.synchronized = (
+            [{"uid": p.uid, "name": p.name, "namespace": p.namespace}
+             for p in req.pods],
+            [_container_to_dict(c) for c in req.containers])
         return nri_pb2.SynchronizeResponse().SerializeToString()
 
     def _create_container(self, raw: bytes) -> bytes:

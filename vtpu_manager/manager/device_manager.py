@@ -47,27 +47,14 @@ class DeviceManager:
     def init_devices(self) -> list[ChipSpec]:
         """Discover chips and apply the node config: exclusions, split
         count, core/memory scaling (reference initDevices device.go:230)."""
+        from vtpu_manager.config.node_config import shape_chips
         result = discover(self.backends)
         if result is None:
             raise RuntimeError("no TPU chips discovered on this node")
-        cfg = self.node_config
-        chips = []
-        for chip in result.chips:
-            uuid = chip.uuid
-            if self.id_store is not None:
-                uuid = self.id_store.uuid_for(self.node_name, chip.index,
-                                              hw_serial=None)
-            if cfg.excludes(uuid, chip.index):
-                log.info("device %s (%d) excluded by node config", uuid,
-                         chip.index)
-                continue
-            chips.append(replace(
-                chip, uuid=uuid,
-                split_count=cfg.device_split_count,
-                memory=int(chip.memory * cfg.memory_scaling)))
-        self.chips = chips
+        self.chips = shape_chips(result.chips, self.node_config,
+                                 self.node_name, self.id_store)
         self.mesh = result.mesh
-        return chips
+        return self.chips
 
     def registry(self) -> NodeDeviceRegistry:
         return NodeDeviceRegistry(chips=self.chips, mesh=self.mesh,

@@ -78,7 +78,8 @@ class _Assumed:
 class FilterPredicate:
     def __init__(self, client: KubeClient, serialize: bool = True,
                  require_node_label: bool = False,
-                 candidate_limit: int = 64):
+                 candidate_limit: int = 64,
+                 pods_ttl_s: float = 0.0):
         self.client = client
         self.serialize = serialize
         self._serial_lock = threading.Lock()
@@ -90,6 +91,46 @@ class FilterPredicate:
         self.candidate_limit = candidate_limit
         self._assumed: dict[str, _Assumed] = {}   # pod uid -> commit
         self._assumed_lock = threading.Lock()
+        # Pod-snapshot TTL: the reference reads residents from an informer
+        # cache; our analogue amortizes the cluster-wide pod LIST across
+        # filter calls (a per-call LIST is O(pods) against the apiserver —
+        # quadratic over a sustained admission wave). Freshness for OUR
+        # own placements comes from the assumed cache, which overlays the
+        # snapshot until commits become visible; 0 disables (every call
+        # lists fresh — the right default for tests and tiny clusters).
+        self.pods_ttl_s = pods_ttl_s
+        self._pods_cache: tuple[list[dict], dict[str, list[dict]]] | None \
+            = None
+        self._pods_cache_ts = 0.0
+        self._pods_cache_lock = threading.Lock()
+
+    @staticmethod
+    def _partition_by_node(pods: list[dict]) -> dict[str, list[dict]]:
+        by_node: dict[str, list[dict]] = {}
+        for p in pods:
+            node_name = (p.get("spec") or {}).get("nodeName")
+            if node_name:
+                by_node.setdefault(node_name, []).append(p)
+        return by_node
+
+    def _list_pods(self) -> tuple[list[dict], dict[str, list[dict]]]:
+        """(all pods, pods partitioned by nodeName). The partition is built
+        once per snapshot, not per filter call — at 100k pods the per-call
+        walk would dominate every admission."""
+        if self.pods_ttl_s <= 0:
+            pods = self.client.list_pods()
+            return pods, self._partition_by_node(pods)
+        now = time.monotonic()
+        with self._pods_cache_lock:
+            if self._pods_cache is not None and \
+                    now - self._pods_cache_ts < self.pods_ttl_s:
+                return self._pods_cache
+        pods = self.client.list_pods()
+        snapshot = (pods, self._partition_by_node(pods))
+        with self._pods_cache_lock:
+            self._pods_cache = snapshot
+            self._pods_cache_ts = now
+        return snapshot
 
     # -- assumed-allocation cache -------------------------------------------
 
@@ -97,6 +138,13 @@ class FilterPredicate:
                 claims: PodDeviceClaims) -> None:
         with self._assumed_lock:
             self._assumed[pod_uid] = _Assumed(node, claims, time.time())
+        # A commit also patched pod ANNOTATIONS (pre-allocation, gang
+        # origin) that the assumed cache does not carry — drop the pod
+        # snapshot so the next pass (e.g. the next member of a gang
+        # burst) sees them. Refresh cost scales with placement rate, not
+        # filter rate; sustained rejection waves keep the cache.
+        with self._pods_cache_lock:
+            self._pods_cache = None
 
     def _assumed_for_node(self, node: str,
                           visible_uids: set[str]) -> list[_Assumed]:
@@ -180,14 +228,9 @@ class FilterPredicate:
                 result.failed_nodes[name] = why
                 reasons.add(why, name)
 
-        # One cluster-wide pod list per pass, partitioned by nodeName —
-        # not one API call per candidate node.
-        all_pods = self.client.list_pods()
-        by_node: dict[str, list[dict]] = {}
-        for p in all_pods:
-            node_name = (p.get("spec") or {}).get("nodeName")
-            if node_name:
-                by_node.setdefault(node_name, []).append(p)
+        # One cluster-wide pod list per pass (TTL-cached, see _list_pods),
+        # partitioned by nodeName — not one API call per candidate node.
+        all_pods, by_node = self._list_pods()
 
         prefer_origin = None
         if req.gang_name:

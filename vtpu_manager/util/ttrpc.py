@@ -292,6 +292,11 @@ class Mux:
         for ch in self._channels.values():
             ch._close_read()
 
+    def alive(self) -> bool:
+        """Whether the peer still holds the connection (the read loop
+        exits on EOF/error) — the probe's idle-health signal."""
+        return self._reader.is_alive()
+
     def close(self) -> None:
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
