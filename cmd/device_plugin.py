@@ -23,6 +23,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--node-name",
                         default=os.environ.get("NODE_NAME", ""))
     parser.add_argument("--node-config")
+    parser.add_argument("--health-probe-cmd", default="",
+                        help="external per-chip health probe: invoked as "
+                             "<cmd> <index> <uuid>, exit 0 = healthy "
+                             "(default: device-node presence)")
     parser.add_argument("--feature-gates", default="")
     parser.add_argument("--plugin-dir",
                         default="/var/lib/kubelet/device-plugins")
@@ -139,13 +143,18 @@ def main(argv: list[str] | None = None) -> int:
 
     # health: a chip is unhealthy when its device node vanishes (fake
     # backends have no nodes and probe healthy); flips re-advertise via
-    # ListAndWatch
+    # ListAndWatch. No event stream exists on this runtime (the reference
+    # rides NVML's XID events) — --health-probe-cmd plugs in a richer
+    # runtime-metrics probe when one is available.
     fake_mode = bool(args.fake_chips)
-
-    def device_node_probe(chip):
-        if fake_mode:
-            return True
-        return os.path.exists(f"/dev/accel{chip.index}")
+    if args.health_probe_cmd:
+        from vtpu_manager.manager.device_manager import make_external_probe
+        device_node_probe = make_external_probe(args.health_probe_cmd)
+    else:
+        def device_node_probe(chip):
+            if fake_mode:
+                return True
+            return os.path.exists(f"/dev/accel{chip.index}")
 
     health = HealthWatcher(manager, device_node_probe)
     health.start()

@@ -40,6 +40,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--nri-socket", default="",
                         help="NRI runtime socket (e.g. /var/run/nri/"
                              "nri.sock); empty disables the NRI stub")
+    parser.add_argument("--health-probe-cmd", default="",
+                        help="external per-chip health probe: invoked as "
+                             "<cmd> <index> <uuid>, exit 0 = healthy "
+                             "(default: device-node presence)")
     parser.add_argument("--health-port", type=int, default=-1,
                         help="serve /healthz + /readyz on this port "
                              "(-1 = disabled, the default; a kubelet "
@@ -164,10 +168,14 @@ def main(argv: list[str] | None = None) -> int:
             publish_resource_slice(
                 client, build_resource_slice(args.node_name, updated))
 
-    def device_node_probe(chip):
-        if args.fake_chips:
-            return chip.healthy     # fakes have no device nodes
-        return os.path.exists(f"/dev/accel{chip.index}")
+    if args.health_probe_cmd:
+        from vtpu_manager.manager.device_manager import make_external_probe
+        device_node_probe = make_external_probe(args.health_probe_cmd)
+    else:
+        def device_node_probe(chip):
+            if args.fake_chips:
+                return chip.healthy     # fakes have no device nodes
+            return os.path.exists(f"/dev/accel{chip.index}")
 
     health = DraHealthWatcher(chips, device_node_probe, republish)
     health.start()

@@ -22,6 +22,34 @@ from vtpu_manager.util import consts
 log = logging.getLogger(__name__)
 
 
+def make_external_probe(cmd: str, timeout_s: float = 5.0):
+    """Per-chip health probe wrapping an operator-supplied command:
+    ``<cmd> <index> <uuid>``, exit 0 = healthy. No event stream exists on
+    this runtime (the reference rides NVML XID events), so a richer
+    runtime-metrics probe plugs in here. Launch failures are logged (a
+    missing binary would otherwise silently de-advertise every chip) and
+    the timeout stays below the watcher poll interval so one wedged probe
+    cannot stall the whole pass by minutes."""
+    import subprocess
+
+    def probe(chip) -> bool:
+        try:
+            return subprocess.run(
+                [cmd, str(chip.index), chip.uuid],
+                timeout=timeout_s, capture_output=True).returncode == 0
+        except subprocess.TimeoutExpired:
+            log.error("health probe %s timed out (%ss) for chip %s",
+                      cmd, timeout_s, chip.uuid)
+            return False
+        except OSError as e:
+            log.error("health probe %s failed to launch: %s "
+                      "(misconfigured --health-probe-cmd marks every "
+                      "chip unhealthy)", cmd, e)
+            return False
+
+    return probe
+
+
 class DeviceManager:
     """Owns the node's chip inventory and its published view."""
 
