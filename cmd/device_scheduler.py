@@ -10,7 +10,6 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import ssl
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -74,10 +73,8 @@ def main(argv: list[str] | None = None) -> int:
         PreemptPredicate(client),
         debug_endpoints=args.debug_endpoints)
 
-    ssl_ctx = None
-    if args.cert_file and args.key_file:
-        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ssl_ctx.load_cert_chain(args.cert_file, args.key_file)
+    from vtpu_manager.util.tlsreload import serving_context
+    ssl_ctx = serving_context(args.cert_file, args.key_file)
 
     logging.getLogger(__name__).info(
         "vtpu-scheduler listening on %s:%d (fake=%s)", args.host, args.port,

@@ -5,7 +5,6 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import ssl
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -37,10 +36,8 @@ def main(argv: list[str] | None = None) -> int:
 
     consts.set_dra_device_class(args.device_class)
 
-    ssl_ctx = None
-    if args.cert_file and args.key_file:
-        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ssl_ctx.load_cert_chain(args.cert_file, args.key_file)
+    from vtpu_manager.util.tlsreload import serving_context
+    ssl_ctx = serving_context(args.cert_file, args.key_file)
 
     # API client: needed by the DRA conversion (claim-template creation)
     # and the allocated-claim sharing validation on the status subresource

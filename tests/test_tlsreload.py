@@ -1,0 +1,94 @@
+"""Rotating TLS certs without restart: the webhook/scheduler binaries
+serve through a ReloadingSSLContext whose chain follows file changes."""
+
+import os
+import ssl
+import subprocess
+import time
+
+import pytest
+
+from vtpu_manager.util.tlsreload import ReloadingSSLContext
+
+
+def make_cert(path_prefix, cn):
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", f"{path_prefix}.key", "-out", f"{path_prefix}.crt",
+         "-days", "1", "-subj", f"/CN={cn}"],
+        check=True, capture_output=True)
+    return f"{path_prefix}.crt", f"{path_prefix}.key"
+
+
+class TestReloadingSSLContext:
+    def test_reload_on_rotation(self, tmp_path):
+        cert, key = make_cert(str(tmp_path / "a"), "first")
+        ctx = ReloadingSSLContext(cert, key, poll_s=0.05)
+        assert ctx.reloads == 0
+        assert not ctx.check_once()    # unchanged
+        # rotate: new pair swapped into the same paths
+        cert2, key2 = make_cert(str(tmp_path / "b"), "second")
+        os.replace(cert2, cert)
+        os.replace(key2, key)
+        assert ctx.check_once()
+        assert ctx.reloads == 1
+
+    def test_half_written_rotation_keeps_old_pair(self, tmp_path):
+        cert, key = make_cert(str(tmp_path / "a"), "first")
+        ctx = ReloadingSSLContext(cert, key, poll_s=0.05)
+        # cert swapped but key still the OLD one: mismatched pair
+        cert2, key2 = make_cert(str(tmp_path / "b"), "second")
+        os.replace(cert2, cert)
+        assert not ctx.check_once()    # load failed; old pair serves on
+        assert ctx.reloads == 0
+        os.replace(key2, key)
+        assert ctx.check_once()        # rotation completes next poll
+        assert ctx.reloads == 1
+
+    def test_live_handshake_sees_new_cert(self, tmp_path):
+        """New handshakes on the SAME listening context serve the rotated
+        cert (the property that makes restart-free rotation work)."""
+        import socket
+        import threading
+
+        cert, key = make_cert(str(tmp_path / "a"), "first-cn")
+        ctx = ReloadingSSLContext(cert, key, poll_s=0.05)
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(5)
+        port = srv.getsockname()[1]
+        stop = []
+
+        def serve():
+            while not stop:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    ctx.context.wrap_socket(conn, server_side=True).close()
+                except (ssl.SSLError, OSError):
+                    pass
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+
+        def peer_cn():
+            raw = ssl.get_server_certificate(("127.0.0.1", port))
+            import tempfile
+            with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
+                f.write(raw)
+                f.flush()
+                out = subprocess.run(
+                    ["openssl", "x509", "-in", f.name, "-noout",
+                     "-subject"], capture_output=True, text=True)
+            return out.stdout
+
+        assert "first-cn" in peer_cn()
+        cert2, key2 = make_cert(str(tmp_path / "b"), "second-cn")
+        os.replace(cert2, cert)
+        os.replace(key2, key)
+        assert ctx.check_once()
+        assert "second-cn" in peer_cn()
+        stop.append(1)
+        srv.close()
