@@ -55,9 +55,10 @@ def main(argv: list[str] | None = None) -> int:
                                                      HealthWatcher)
     from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import (CORE_PLUGIN, MEMORY_PLUGIN,
-                                                RESCHEDULE, TC_WATCHER,
-                                                FeatureGates)
+    from vtpu_manager.util.featuregates import (CORE_PLUGIN,
+                                                HONOR_PREALLOC_IDS,
+                                                MEMORY_PLUGIN, RESCHEDULE,
+                                                TC_WATCHER, FeatureGates)
 
     gates = FeatureGates()
     gates.parse(args.feature_gates)
@@ -125,6 +126,11 @@ def main(argv: list[str] | None = None) -> int:
     vnum = VnumPlugin(manager, client, args.node_name,
                       node_config=node_config,
                       base_dir=args.base_dir or consts.MANAGER_BASE_DIR)
+    # Reference parity: GetPreferredAllocation is advertised only behind
+    # HonorPreAllocatedDeviceIDs (options.go:70-100) — kubelets that honor
+    # it then ask the plugin to mirror the scheduler's chip choice instead
+    # of picking slots arbitrarily.
+    vnum.preferred_allocation_available = gates.enabled(HONOR_PREALLOC_IDS)
     plugins = [vnum]
     if gates.enabled(CORE_PLUGIN):
         plugins.append(VcorePlugin(manager))

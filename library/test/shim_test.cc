@@ -11,14 +11,17 @@
 //   VTPU_CORE_LIMIT_0=50           — 50% core quota (phase 2 only)
 
 #include <dlfcn.h>
+#include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <time.h>
 
+#include <atomic>
+
 #include "xla/pjrt/c/pjrt_c_api.h"
 
-static int g_failures = 0;
+static std::atomic<int> g_failures{0};  // CHECK runs on stress threads
 
 #define CHECK(cond, ...)                              \
   do {                                                \
@@ -166,8 +169,9 @@ static int RunMultichip(const PJRT_Api* api, PJRT_Client* client,
     CHECK(wall <= 8000, "wedged: wall=%llu", (unsigned long long)wall);
     printf("[M2] PASS\n");
   }
-  printf(g_failures ? "FAILURES: %d\n" : "ALL PASS\n", g_failures);
-  return g_failures ? 1 : 0;
+  int failures = g_failures.load();
+  printf(failures ? "FAILURES: %d\n" : "ALL PASS\n", failures);
+  return failures ? 1 : 0;
 }
 
 int main(int argc, char** argv) {
@@ -398,6 +402,126 @@ int main(int argc, char** argv) {
     Destroy(api, full);
   }
   printf("[4] PASS\n");
+
+  // ------------------------------------------- concurrency stress
+  // 4 threads x mixed alloc/copy/asyncH2D churn against the shared cap:
+  // races in the buffer/transfer-manager tables or reserve/credit paths
+  // show up as a final imbalance (full-cap alloc fails) or a crash.
+  printf("[5] alloc-path concurrency stress\n");
+  {
+    struct StressCtx {
+      const PJRT_Api* api;
+      PJRT_Client* client;
+      PJRT_Device* dev;
+    } ctx{api, client, dev};
+    auto worker = [](void* arg) -> void* {
+      auto* c = (StressCtx*)arg;
+      PJRT_Error* e = nullptr;
+      for (int i = 0; i < 200; i++) {
+        // small alloc (32 KiB): cap is 1 MiB across 4 threads, so some
+        // attempts legitimately OOM — consume the error and move on
+        PJRT_Buffer* buf = Alloc(c->api, c->client, c->dev, 8192, &e);
+        if (e) {
+          PJRT_Error_Destroy_Args d{};
+          d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+          d.error = e;
+          c->api->PJRT_Error_Destroy(&d);
+          continue;
+        }
+        if (i % 3 == 0 && buf) {   // copy path
+          PJRT_Buffer_CopyToDevice_Args ca{};
+          ca.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+          ca.buffer = buf;
+          ca.dst_device = c->dev;
+          e = c->api->PJRT_Buffer_CopyToDevice(&ca);
+          if (e) {
+            PJRT_Error_Destroy_Args d{};
+            d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+            d.error = e;
+            c->api->PJRT_Error_Destroy(&d);
+          } else {
+            Destroy(c->api, ca.dst_buffer);
+          }
+        }
+        if (i % 5 == 0) {          // async H2D path
+          PJRT_Device_AddressableMemories_Args am{};
+          am.struct_size = PJRT_Device_AddressableMemories_Args_STRUCT_SIZE;
+          am.device = c->dev;
+          PJRT_Error* am_err = c->api->PJRT_Device_AddressableMemories(&am);
+          if (am_err) {
+            PJRT_Error_Destroy_Args d{};
+            d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+            d.error = am_err;
+            c->api->PJRT_Error_Destroy(&d);
+          } else if (am.num_memories > 0) {
+            int64_t dims[1] = {4096};  // 16 KiB
+            PJRT_ShapeSpec spec{};
+            spec.struct_size = PJRT_ShapeSpec_STRUCT_SIZE;
+            spec.dims = dims;
+            spec.num_dims = 1;
+            spec.element_type = PJRT_Buffer_Type_F32;
+            PJRT_Client_CreateBuffersForAsyncHostToDevice_Args ta{};
+            ta.struct_size =
+                PJRT_Client_CreateBuffersForAsyncHostToDevice_Args_STRUCT_SIZE;
+            ta.client = c->client;
+            ta.shape_specs = &spec;
+            ta.num_shape_specs = 1;
+            ta.memory = am.memories[0];
+            e = c->api->PJRT_Client_CreateBuffersForAsyncHostToDevice(&ta);
+            if (e) {
+              PJRT_Error_Destroy_Args d{};
+              d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+              d.error = e;
+              c->api->PJRT_Error_Destroy(&d);
+            } else {
+              // retrieve half the time so both settle paths churn
+              if (i % 10 == 0) {
+                PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args
+                    ra{};
+                ra.struct_size =
+                    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args_STRUCT_SIZE;
+                ra.transfer_manager = ta.transfer_manager;
+                ra.buffer_index = 0;
+                PJRT_Error* re =
+                    c->api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(
+                        &ra);
+                if (re) {
+                  PJRT_Error_Destroy_Args d{};
+                  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+                  d.error = re;
+                  c->api->PJRT_Error_Destroy(&d);
+                } else if (ra.buffer_out) {
+                  Destroy(c->api, ra.buffer_out);
+                }
+              }
+              PJRT_AsyncHostToDeviceTransferManager_Destroy_Args da{};
+              da.struct_size =
+                  PJRT_AsyncHostToDeviceTransferManager_Destroy_Args_STRUCT_SIZE;
+              da.transfer_manager = ta.transfer_manager;
+              PJRT_Error* de =
+                  c->api->PJRT_AsyncHostToDeviceTransferManager_Destroy(&da);
+              if (de) {
+                PJRT_Error_Destroy_Args d{};
+                d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+                d.error = de;
+                c->api->PJRT_Error_Destroy(&d);
+              }
+            }
+          }
+        }
+        Destroy(c->api, buf);
+      }
+      return nullptr;
+    };
+    pthread_t threads[4];
+    for (auto& t : threads) pthread_create(&t, nullptr, worker, &ctx);
+    for (auto& t : threads) pthread_join(t, nullptr);
+    // balance check: every reservation was credited back
+    PJRT_Buffer* full = Alloc(api, client, dev, 262144, &err);  // 1 MiB
+    CHECK(!err && full, "full-cap alloc after stress (leaked charge?)");
+    Destroy(api, full);
+    printf("[5] PASS\n");
+  }
   }
   // ------------------------------------------------------------- throttle
   printf("[3] core-quota throttling (50 x simulated programs)\n");
@@ -450,6 +574,7 @@ int main(int argc, char** argv) {
   Destroy(api, resident);
   }
 
-  printf(g_failures ? "FAILURES: %d\n" : "ALL PASS\n", g_failures);
-  return g_failures ? 1 : 0;
+  int failures = g_failures.load();
+  printf(failures ? "FAILURES: %d\n" : "ALL PASS\n", failures);
+  return failures ? 1 : 0;
 }

@@ -49,7 +49,7 @@ def device_uuid(dev_id: str) -> str:
 
 class VnumPlugin(DevicePluginServicer):
     pre_start_required = True
-    preferred_allocation_available = True
+    preferred_allocation_available = False   # gated: HonorPreAllocatedDeviceIDs
 
     def __init__(self, manager: DeviceManager, client: KubeClient,
                  node_name: str, node_config: NodeConfig | None = None,
