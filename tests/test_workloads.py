@@ -124,3 +124,36 @@ class TestRuntimeClient:
         assert client.install(shim_path=str(shim))
         assert os.environ["TPU_LIBRARY_PATH"] == str(shim)
         assert os.environ["VTPU_REAL_TPU_LIBRARY_PATH"] == "/real/libtpu.so"
+
+class TestHostOffload:
+    def test_streamed_forward_keeps_params_in_host_memory(self):
+        """examples/host_offload_demo.py core: offloaded params carry the
+        pinned_host memory kind and the streamed forward matches a plain
+        on-device forward (the oversold-tenant spill pattern; the shim
+        never charges host memories, enforce.cc SlotForMemory)."""
+        import jax
+        import jax.numpy as jnp
+
+        import sys, os
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "examples"))
+        from host_offload_demo import offload_params, streamed_forward
+
+        device = jax.devices()[0]
+        kinds = [m.kind for m in device.addressable_memories()]
+        if "pinned_host" not in kinds:
+            import pytest
+            pytest.skip(f"no pinned_host memory on this backend: {kinds}")
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        params = [jax.random.normal(k, (16, 16), jnp.float32) * 0.1
+                  for k in keys]
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 16), jnp.float32)
+
+        host_params = offload_params(params, device)
+        assert all(p.sharding.memory_kind == "pinned_host"
+                   for p in host_params)
+        y_streamed = streamed_forward(host_params, x, device)
+        y_plain = x
+        for w in params:
+            y_plain = jnp.tanh(y_plain @ w)
+        assert jnp.allclose(y_streamed, y_plain, atol=1e-5)
