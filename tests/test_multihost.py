@@ -1,0 +1,79 @@
+"""Hermetic multi-host e2e: REAL processes over the JAX distributed
+runtime (coordinator + TCP collectives on localhost — the code path DCN
+multi-host uses), not virtual devices in one process.
+
+SURVEY §4 notes the reference has NO hermetic multi-node e2e (multi-node
+behavior is validated only by fake-clientset scale tests); this harness
+closes that gap for the compute side: a dp-sharded train step of the
+flagship trainer across 2 processes must produce the same loss AND the
+same updated parameters as the single-process run — gradient psum across
+the process boundary is the thing being proven.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_world(world: int, port: int) -> list[tuple[float, float]]:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the TPU tunnel
+    env.pop("XLA_FLAGS", None)   # conftest's 8 virtual devices must not
+    env["JAX_PLATFORMS"] = "cpu"   # leak in: one real device per process
+    env["PYTHONPATH"] = REPO
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(rank), str(world), str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for rank in range(world)]
+    return procs
+
+
+def _collect(procs: list) -> list[tuple[float, float]]:
+    """A dead rank leaves its sibling blocked in the distributed-init
+    barrier forever — always kill the whole world on any failure."""
+    results = []
+    try:
+        for rank, proc in enumerate(procs):
+            out, _ = proc.communicate(timeout=300)
+            assert proc.returncode == 0, \
+                f"rank {rank} failed:\n{out[-2000:]}"
+            m = re.search(rf"RANK {rank} loss=([\d.]+) leaf=(-?[\d.]+)",
+                          out)
+            assert m, f"rank {rank} printed no result:\n{out[-1000:]}"
+            results.append((float(m.group(1)), float(m.group(2))))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+    return results
+
+
+def test_two_process_dp_step_matches_single_process():
+    # the world=1 control is independent (own port-less init): run it
+    # alongside the 2-process pair rather than serializing ~20s after
+    pair = _run_world(2, _free_port())
+    control = _run_world(1, 0)
+    two = _collect(pair)
+    one = _collect(control)
+    # every rank observed the same globally-reduced loss…
+    assert two[0] == two[1], two
+    # …and it equals the single-process result: the gradient all-reduce
+    # genuinely crossed the process boundary (a rank training on only its
+    # local half would diverge in both loss and updated params)
+    assert two[0] == pytest.approx(one[0], rel=1e-5), (two, one)
