@@ -93,6 +93,15 @@ struct alignas(128) DeviceHot {
   std::atomic<bool> throttled_since_watch{false};
   std::atomic<int> vmem_idx{-1};           // cached own vmem-ledger slot
   std::atomic<uint64_t> vmem_retry_ns{0};  // ledger-full claim backoff
+  // Observation-overhead calibration: host-observed completion spans carry
+  // a fixed per-op transport+observation latency (remote PJRT tunnels add
+  // ~ms of RTT to every span). An idle-time probe (min of an H2D and a D2H
+  // round trip ≈ zero device work) measures it; isolated spans are
+  // discounted by the min-filtered estimate (a latency FLOOR — downward
+  // moves apply immediately, upward only drifts) so low-quota tenants are
+  // not charged for transport time the chip never saw.
+  std::atomic<int64_t> obs_overhead_us{0};
+  std::atomic<int> obs_samples{0};
 };
 static_assert(sizeof(DeviceHot) % 128 == 0, "cacheline isolation");
 
@@ -131,6 +140,10 @@ struct ShimState {
   std::unordered_map<PJRT_LoadedExecutable*, ExecFactsEntry> exec_facts;
   // tc_util external feed (mapped readonly if present)
   const TcUtilFile* tc_file = nullptr;
+  // Handles captured opportunistically from wrapped calls so the
+  // observation-overhead probe can issue its own (real-API) operations.
+  std::atomic<PJRT_Client*> probe_client{nullptr};
+  std::atomic<PJRT_Device*> probe_device[kMaxDeviceCount]{};
 };
 
 ShimState& State();

@@ -68,6 +68,8 @@ struct DynamicConfig {
   int aimd_deadband = 3;     // percent
   int aimd_cooldown_ticks = 3;
   double delta_gain = 0.5;
+  // operator-calibrated per-op span inflation (µs); -1 = learn via probe
+  int64_t obs_overhead_us = -1;
 };
 DynamicConfig g_dyn;
 
@@ -82,6 +84,8 @@ void LoadDynamicConfig() {
   if (const char* v = getenv("VTPU_AIMD_DEADBAND"))
     g_dyn.aimd_deadband = atoi(v);
   if (const char* v = getenv("VTPU_DELTA_GAIN")) g_dyn.delta_gain = atof(v);
+  if (const char* v = getenv("VTPU_OBS_OVERHEAD_US"))
+    g_dyn.obs_overhead_us = atol(v);
 }
 
 // ---------------------------------------------------------------------------
@@ -1204,6 +1208,225 @@ void* WatcherMain(void*) {
   return nullptr;
 }
 
+// ---------------------------------------------------------------------------
+// Observation-overhead probe.
+//
+// Host-observed completion spans are inflated by a fixed per-op latency:
+// submit-leg (call -> device starts) + observe-leg (device done -> the
+// await thread sees the event). On a local plugin this is ~0; on a remote
+// PJRT tunnel it is milliseconds of RTT per span. Steady-state overlapping
+// spans hide it (the high-water dedup clips each span's front against the
+// previous span's inflated tail), but an *isolated* span — the only kind a
+// low-quota tenant ever produces — charges the full inflation to the
+// tenant, so achieved share falls below quota as quota shrinks (measured:
+// 21.1% at a 25% cap on the v5e tunnel, spans 86.5 ms vs 77.6 ms true).
+//
+// The probe measures the inflation directly: a 4-byte H2D upload and a
+// D2H readback do ~zero device work, so their spans ARE the per-op
+// overhead (min of the two legs per round; see ProbeOnce). It runs only
+// while the device is idle (inflight == 0), through the REAL api (never
+// charged to the tenant), fast until converged then slowly as a drift
+// check. OnExecuteDone discounts isolated spans by the min-filtered
+// estimate, capped at half the span so a transport whose tiny-op RTT
+// exceeds its per-exec overhead cannot flip overcharge into systematic
+// undercharge.
+// ---------------------------------------------------------------------------
+
+pthread_t g_probe_thread;
+std::atomic<bool> g_probe_running{false};
+// Serializes probe PJRT calls against client teardown: WrappedClientDestroy
+// takes this, invalidates the handles, then destroys — so a probe is never
+// mid-call on a dying client, and no probe starts on a dead one.
+std::mutex g_probe_mu;
+// guarded by g_probe_mu: a cached 4-byte device buffer per slot, the
+// readback source for D2H probes (never tracked/charged; freed by client
+// destroy, merely dropped on fork)
+PJRT_Buffer* g_probe_buf[kMaxDeviceCount] = {};
+
+void DestroyEvent(PJRT_Event* event) {
+  if (!event) return;
+  PJRT_Event_Destroy_Args eargs;
+  memset(&eargs, 0, sizeof(eargs));
+  eargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  eargs.event = event;
+  ConsumeError(State().real_api->PJRT_Event_Destroy(&eargs));
+}
+
+bool EnsureProbeBuffer(int slot, PJRT_Client* client, PJRT_Device* dev) {
+  if (g_probe_buf[slot]) return true;
+  static float data[1] = {0.0f};
+  int64_t dims[1] = {1};
+  PJRT_Client_BufferFromHostBuffer_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  args.client = client;
+  args.data = data;
+  args.type = PJRT_Buffer_Type_F32;
+  args.dims = dims;
+  args.num_dims = 1;
+  args.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  args.device = dev;
+  if (ConsumeError(g_real_bfhb(&args)) || !args.buffer) return false;
+  DestroyEvent(args.done_with_host_buffer);
+  g_probe_buf[slot] = args.buffer;
+  return true;
+}
+
+// D2H leg: readback of the cached tiny buffer. Returns span in µs, -1 on
+// failure.
+int64_t ProbeD2H(int slot, PJRT_Client* client, PJRT_Device* dev) {
+  ShimState& s = State();
+  if (!g_real_tohost || !EnsureProbeBuffer(slot, client, dev)) return -1;
+  float out[1];
+  PJRT_Buffer_ToHostBuffer_Args targs;
+  memset(&targs, 0, sizeof(targs));
+  targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  targs.src = g_probe_buf[slot];
+  targs.dst = out;
+  targs.dst_size = sizeof(out);
+  uint64_t start = NowNs();
+  if (ConsumeError(g_real_tohost(&targs))) return -1;
+  if (targs.event) {
+    PJRT_Event_Await_Args aargs;
+    memset(&aargs, 0, sizeof(aargs));
+    aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    aargs.event = targs.event;
+    ConsumeError(s.real_api->PJRT_Event_Await(&aargs));
+    DestroyEvent(targs.event);
+  }
+  return (int64_t)((NowNs() - start) / 1000);
+}
+
+// H2D leg: 4-byte upload + ready-event await.
+int64_t ProbeH2D(PJRT_Client* client, PJRT_Device* dev) {
+  ShimState& s = State();
+  if (!s.real_api->PJRT_Buffer_ReadyEvent) return -1;
+  static float data[1] = {0.0f};
+  int64_t dims[1] = {1};
+  PJRT_Client_BufferFromHostBuffer_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  args.client = client;
+  args.data = data;
+  args.type = PJRT_Buffer_Type_F32;
+  args.dims = dims;
+  args.num_dims = 1;
+  args.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  args.device = dev;
+  uint64_t start = NowNs();
+  if (ConsumeError(g_real_bfhb(&args)) || !args.buffer) return -1;
+  // a failed/absent ready event means the span below would measure only
+  // the submit call — a sample BELOW the true floor, which the min-filter
+  // would adopt permanently. No event, no sample.
+  bool awaited = false;
+  PJRT_Buffer_ReadyEvent_Args rargs;
+  memset(&rargs, 0, sizeof(rargs));
+  rargs.struct_size = PJRT_Buffer_ReadyEvent_Args_STRUCT_SIZE;
+  rargs.buffer = args.buffer;
+  if (!ConsumeError(s.real_api->PJRT_Buffer_ReadyEvent(&rargs)) &&
+      rargs.event) {
+    PJRT_Event_Await_Args aargs;
+    memset(&aargs, 0, sizeof(aargs));
+    aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    aargs.event = rargs.event;
+    ConsumeError(s.real_api->PJRT_Event_Await(&aargs));
+    DestroyEvent(rargs.event);
+    awaited = true;
+  }
+  int64_t span_us = (int64_t)((NowNs() - start) / 1000);
+  DestroyEvent(args.done_with_host_buffer);
+  if (g_real_buf_destroy) {
+    PJRT_Buffer_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = args.buffer;
+    ConsumeError(g_real_buf_destroy(&dargs));
+  }
+  return awaited ? span_us : -1;
+}
+
+// One probe round = the MIN of both legs, and BOTH must succeed. On an
+// honest transport both measure the same per-op round trip. On a
+// pathological one they disagree wildly (measured on the v5e loopback
+// relay: H2D acked in ~0.1 ms, idle D2H stalled ~65 ms behind a flush
+// timer, while real execute spans carry ~14 ms of after-idle inflation) —
+// and a wrong discount is worse than none, so the conservative min wins:
+// the discount degrades to ~0 rather than overshooting into quota
+// violation. A transport serving only one leg gets no discount at all
+// (a lone leg could carry the relay's inverse pathology undetected).
+// Operators who have calibrated the true per-transport penalty
+// (isolated-vs-steady span of a reference program, the node daemon's job)
+// can set VTPU_OBS_OVERHEAD_US to override the probe entirely.
+int64_t ProbeOnce(int slot) {
+  ShimState& s = State();
+  std::lock_guard<std::mutex> g(g_probe_mu);
+  PJRT_Client* client = s.probe_client.load(std::memory_order_relaxed);
+  PJRT_Device* dev = s.probe_device[slot].load(std::memory_order_relaxed);
+  if (!client || !dev || !g_real_bfhb || !s.real_api ||
+      !s.real_api->PJRT_Event_Await)
+    return -1;
+  int64_t d2h = ProbeD2H(slot, client, dev);
+  int64_t h2d = ProbeH2D(client, dev);
+  if (d2h < 0 || h2d < 0) return -1;
+  return std::min(d2h, h2d);
+}
+
+void* ProbeMain(void*) {
+  ShimState& s = State();
+  if (g_dyn.obs_overhead_us >= 0) {
+    // operator calibration overrides the probe (see ProbeOnce comment)
+    for (int slot = 0; slot < s.device_count; slot++) {
+      s.hot[slot].obs_overhead_us.store(g_dyn.obs_overhead_us,
+                                        std::memory_order_relaxed);
+      s.hot[slot].obs_samples.store(1 << 20, std::memory_order_relaxed);
+    }
+    return nullptr;
+  }
+  constexpr int kConverged = 6;
+  while (g_watcher_running.load(std::memory_order_relaxed)) {
+    bool all_converged = true;
+    for (int slot = 0; slot < s.device_count; slot++) {
+      const VtpuDevice* cfg = DeviceCfg(slot);
+      if (!cfg || cfg->core_limit == kCoreLimitNone) continue;
+      DeviceHot& hot = s.hot[slot];
+      int n = hot.obs_samples.load(std::memory_order_relaxed);
+      if (n < kConverged) all_converged = false;
+      // only probe an idle device: a span measured behind tenant work
+      // would include queue wait, not transport overhead
+      if (hot.inflight.load(std::memory_order_relaxed) != 0) continue;
+      int64_t span = ProbeOnce(slot);
+      if (span < 0) continue;
+      // Min-filter, not an EMA: the estimate is a latency FLOOR, and no
+      // observed sample can be below the true floor, so downward moves
+      // apply immediately (this also self-heals a poisoned first sample —
+      // e.g. a probe landing inside the remote-compile window). Upward
+      // drift is slow so stray queue-wait contamination cannot ratchet
+      // the discount up.
+      int64_t ema = hot.obs_overhead_us.load(std::memory_order_relaxed);
+      if (n == 0 || span < ema) {
+        hot.obs_overhead_us.store(span, std::memory_order_relaxed);
+      } else {
+        hot.obs_overhead_us.store(ema + (span - ema) / 16,
+                                  std::memory_order_relaxed);
+      }
+      hot.obs_samples.store(std::min(n + 1, 1 << 20),
+                            std::memory_order_relaxed);
+      VTPU_LOG(kLogDebug, "probe slot=%d span_us=%" PRId64 " oh=%" PRId64,
+               slot, span,
+               hot.obs_overhead_us.load(std::memory_order_relaxed));
+    }
+    // fast until converged, then a slow drift check; short sleeps so the
+    // thread notices shutdown/fork promptly
+    int sleeps = all_converged ? 20 : 1;
+    for (int i = 0; i < sleeps &&
+                    g_watcher_running.load(std::memory_order_relaxed); i++)
+      usleep(250 * 1000);
+  }
+  return nullptr;
+}
+
 void StartWatcher() {
   g_watcher_running.store(true);
   if (pthread_create(&g_watcher, nullptr, WatcherMain, nullptr) != 0) {
@@ -1211,6 +1434,14 @@ void StartWatcher() {
     VTPU_LOG(kLogError, "FATAL: utilization watcher thread failed to start; "
                         "core limits will stall");
     g_watcher_running.store(false);
+    return;
+  }
+  if (!g_probe_running.exchange(true)) {
+    if (pthread_create(&g_probe_thread, nullptr, ProbeMain, nullptr) != 0) {
+      // degraded, not fatal: isolated spans keep their transport inflation
+      VTPU_LOG(kLogWarn, "observation-overhead probe failed to start");
+      g_probe_running.store(false);
+    }
   }
 }
 
@@ -1220,6 +1451,19 @@ void ResetAwaitForFork();  // defined below, near the await-thread state
 
 void ResetWatcherForFork() {
   g_watcher_running.store(false);
+  g_probe_running.store(false);
+  // stale cross-fork PJRT handles; dropped, not destroyed (no PJRT state
+  // is usable in a forked child; the child recaptures via its own
+  // WrappedClientCreate). No lock: the child is single-threaded here and
+  // locking a mutex the parent may have held at fork is UB.
+  for (auto& b : g_probe_buf) b = nullptr;
+  ShimState& s = State();
+  s.probe_client.store(nullptr, std::memory_order_relaxed);
+  for (auto& d : s.probe_device) d.store(nullptr, std::memory_order_relaxed);
+  // the probe thread may have held this at fork; placement-new like
+  // ChildAfterFork does for buffers_mu/cost_mu/tms_mu, or the child's
+  // first WrappedClientCreate deadlocks on a lock owned by no thread
+  new (&g_probe_mu) std::mutex();
   pthread_once_t fresh = PTHREAD_ONCE_INIT;
   memcpy(&g_watcher_once, &fresh, sizeof(fresh));
   ResetAwaitForFork();
@@ -1328,8 +1572,26 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
              prev, end_ns, std::memory_order_relaxed)) {
   }
   if (end_ns <= prev) return;  // fully covered by credited activity
+  uint64_t oh_ns = (uint64_t)s.hot[slot].obs_overhead_us.load(
+                       std::memory_order_relaxed) * 1000;
+  // Isolated = not genuinely pipelined behind prior work. The high-water
+  // itself is inflated by up to oh (it is a host-observed end), so a span
+  // starting within oh of it — the sync-loop boundary, where the next
+  // submit races our own observation of the previous completion — is
+  // isolated, not overlapped.
+  bool isolated = start_ns + oh_ns >= prev;
   if (start_ns < prev) start_ns = prev;
-  s.hot[slot].busy_ns_window.fetch_add(end_ns - start_ns,
+  uint64_t credit_ns = end_ns - start_ns;
+  if (isolated) {
+    // An isolated span carries the full per-op transport/observation
+    // latency (deeply overlapped spans shed it: both their ends are
+    // inflated equally, so end-to-end deltas are true busy). Discount the
+    // probe-learned overhead, capped at half the span — see the probe
+    // block for why the cap.
+    uint64_t disc = oh_ns > credit_ns / 2 ? credit_ns / 2 : oh_ns;
+    credit_ns -= disc;
+  }
+  s.hot[slot].busy_ns_window.fetch_add(credit_ns,
                                        std::memory_order_relaxed);
   s.hot[slot].last_submit_ns.store(end_ns, std::memory_order_relaxed);
 }
@@ -1599,6 +1861,8 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   }
   uint64_t start = NowNs();
   PJRT_Error* err = g_real_execute(args);
+  VTPU_LOG(kLogDebug, "submit call dur_us=%lld",
+           (long long)((NowNs() - start) / 1000));
   if (err || first_slot < 0) {
     for (int r : reserved_slots) UnreserveMemory(r, facts.gate_bytes);
     return err;
@@ -1749,9 +2013,70 @@ __attribute__((destructor)) static void ClearOwnLedgerEntries() {
   }
 }
 
+PJRT_Client_Create* g_real_client_create = nullptr;
+PJRT_Client_Destroy* g_real_client_destroy = nullptr;
+
+// The one guaranteed early seam: every tenant creates a client before any
+// alloc/execute. Capture (client, per-slot device) here so the
+// observation-overhead probe does not depend on which alloc path the
+// tenant's runtime happens to use.
+PJRT_Error* WrappedClientCreate(PJRT_Client_Create_Args* args) {
+  PJRT_Error* err = g_real_client_create(args);
+  if (err || !args->client) return err;
+  ShimState& s = State();
+  if (!s.real_api->PJRT_Client_Devices) return nullptr;
+  PJRT_Client_Devices_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  dargs.client = args->client;
+  if (ConsumeError(s.real_api->PJRT_Client_Devices(&dargs)))
+    return nullptr;
+  std::lock_guard<std::mutex> g(g_probe_mu);
+  if (s.probe_client.load(std::memory_order_relaxed) != args->client) {
+    // cached probe buffers belong to the previous client; drop them so a
+    // probe never readbacks a buffer whose client has been destroyed
+    for (auto& b : g_probe_buf) b = nullptr;
+  }
+  s.probe_client.store(args->client, std::memory_order_relaxed);
+  for (size_t i = 0; i < dargs.num_devices; i++) {
+    PJRT_Device* dev = dargs.devices[i];
+    int slot = SlotForDevice(dev);
+    if (slot >= 0 && slot < kMaxDeviceCount)
+      s.probe_device[slot].store(dev, std::memory_order_relaxed);
+  }
+  return nullptr;
+}
+
+// Probe-handle lifetime: a dying client takes its devices and the cached
+// probe buffers with it. Invalidate under the probe mutex BEFORE the real
+// destroy so no probe is mid-call on a dying client and none starts on a
+// dead one.
+PJRT_Error* WrappedClientDestroy(PJRT_Client_Destroy_Args* args) {
+  ShimState& s = State();
+  {
+    std::lock_guard<std::mutex> g(g_probe_mu);
+    if (s.probe_client.load(std::memory_order_relaxed) == args->client) {
+      s.probe_client.store(nullptr, std::memory_order_relaxed);
+      for (auto& d : s.probe_device)
+        d.store(nullptr, std::memory_order_relaxed);
+      // buffers die with the client; drop, don't destroy
+      for (auto& b : g_probe_buf) b = nullptr;
+    }
+  }
+  return g_real_client_destroy(args);
+}
+
 void WrapEnforcementEntries(PJRT_Api* api) {
   LoadDynamicConfig();
   MapVmemLedger();
+  if (api->PJRT_Client_Create) {
+    g_real_client_create = api->PJRT_Client_Create;
+    api->PJRT_Client_Create = WrappedClientCreate;
+  }
+  if (api->PJRT_Client_Destroy) {
+    g_real_client_destroy = api->PJRT_Client_Destroy;
+    api->PJRT_Client_Destroy = WrappedClientDestroy;
+  }
   g_real_bfhb = api->PJRT_Client_BufferFromHostBuffer;
   g_real_buf_destroy = api->PJRT_Buffer_Destroy;
   g_real_memstats = api->PJRT_Device_MemoryStats;

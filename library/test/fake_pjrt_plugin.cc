@@ -118,6 +118,18 @@ int64_t OutBytes() {
   return v ? atol(v) : 1024;
 }
 
+int64_t ObsLatencyUs() {
+  // Model a remote-tunnel transport: every host-side event await returns a
+  // fixed latency after true completion (submit-leg + observe-leg RTT), so
+  // host-observed spans are inflated by this much. Exercises the shim's
+  // observation-overhead probe + isolated-span discount.
+  static int64_t v = [] {
+    const char* e = getenv("FAKE_OBS_LATENCY_US");
+    return e ? atol(e) : 0;
+  }();
+  return v;
+}
+
 bool LyingEvents() {
   // Model transports whose completion events fire at dispatch-accept
   // rather than device completion (observed on remote PJRT tunnels): the
@@ -297,6 +309,23 @@ PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
   return nullptr;
 }
 
+PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  auto* buf = reinterpret_cast<FakeBuffer*>(args->src);
+  if (!args->dst) {
+    args->dst_size = (size_t)buf->size;
+    return nullptr;
+  }
+  if (args->dst_size < (size_t)buf->size) {
+    return MakeFakeError(PJRT_Error_Code_INVALID_ARGUMENT,
+                         "fake plugin: dst too small");
+  }
+  memset(args->dst, 0, (size_t)buf->size);
+  auto* evt = new FakeEvent();
+  evt->MarkReady();  // data "arrives" now; awaiting it pays ObsLatencyUs
+  args->event = reinterpret_cast<PJRT_Event*>(evt);
+  return nullptr;
+}
+
 PJRT_Error* DeviceMemoryStats(PJRT_Device_MemoryStats_Args* args) {
   FakeDevice* dev = DeviceOf(args->device);
   args->bytes_in_use = dev->bytes_in_use.load();
@@ -328,8 +357,11 @@ PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
 
 PJRT_Error* EventAwait(PJRT_Event_Await_Args* args) {
   auto* evt = reinterpret_cast<FakeEvent*>(args->event);
-  std::unique_lock<std::mutex> g(evt->mu);
-  evt->cv.wait(g, [&] { return evt->ready; });
+  {
+    std::unique_lock<std::mutex> g(evt->mu);
+    evt->cv.wait(g, [&] { return evt->ready; });
+  }
+  if (int64_t lat = ObsLatencyUs()) usleep((useconds_t)lat);
   return nullptr;
 }
 
@@ -681,6 +713,7 @@ void InitApi() {
   g_api.PJRT_Buffer_Destroy = BufferDestroy;
   g_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
   g_api.PJRT_Buffer_ReadyEvent = BufferReadyEvent;
+  g_api.PJRT_Buffer_ToHostBuffer = BufferToHostBuffer;
   g_api.PJRT_Device_MemoryStats = DeviceMemoryStats;
   g_api.PJRT_Event_OnReady = EventOnReady;
   g_api.PJRT_Event_Destroy = EventDestroy;

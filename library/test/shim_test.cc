@@ -16,6 +16,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <time.h>
+#include <unistd.h>
 
 #include <atomic>
 
@@ -174,9 +175,67 @@ static int RunMultichip(const PJRT_Api* api, PJRT_Client* client,
   return failures ? 1 : 0;
 }
 
+// Observation-overhead discount: with FAKE_OBS_LATENCY_US every host-side
+// event await returns that much after true completion, inflating every
+// observed span (the remote-tunnel regime measured on the v5e: 86.5 ms
+// spans for 77.6 ms steps). At a low quota all spans are isolated, so
+// without the idle-probe discount the tenant is throttled as if each
+// program cost exec+latency. Expected here: 100 x 2 ms exec at 25% quota
+// => ~800 ms paced wall; the undiscounted charge (4 ms/step) would take
+// ~1600 ms, and a runaway discount (charging ~0) would finish at the
+// natural ~400 ms.
+static int RunObsLatency(const PJRT_Api* api, PJRT_Client* client,
+                         PJRT_Device* dev) {
+  printf("[O1] isolated-span discount under observation latency\n");
+  PJRT_Error* err = nullptr;
+  // captures the probe's (client, device) handles
+  PJRT_Buffer* resident = Alloc(api, client, dev, 65536, &err);
+  CHECK(!err && resident, "resident alloc");
+  auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
+  auto one_step = [&](int i) {
+    PJRT_LoadedExecutable_Execute_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    eargs.executable = fake_exe;
+    eargs.num_devices = 1;
+    PJRT_Buffer* outs[1] = {nullptr};
+    PJRT_Buffer** outlists[1] = {outs};
+    eargs.output_lists = outlists;
+    PJRT_Event* events[1] = {nullptr};
+    eargs.device_complete_events = events;
+    PJRT_Error* e = api->PJRT_LoadedExecutable_Execute(&eargs);
+    CHECK(!e, "execute %d errored", i);
+    if (events[0]) {
+      PJRT_Event_Await_Args aargs;
+      memset(&aargs, 0, sizeof(aargs));
+      aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+      aargs.event = events[0];
+      api->PJRT_Event_Await(&aargs);
+    }
+    if (outs[0]) Destroy(api, outs[0]);
+  };
+  for (int i = 0; i < 3; i++) one_step(i);  // warmup: starts watcher+probe
+  usleep(1200 * 1000);                      // probe learns the latency
+  int iters = 100;
+  uint64_t t0 = NowMs();
+  for (int i = 0; i < iters; i++) one_step(i);
+  uint64_t wall = NowMs() - t0;
+  printf("  iters=%d wall=%llums (expect ~800)\n", iters,
+         (unsigned long long)wall);
+  CHECK(wall >= 640, "under-throttled (runaway discount?): wall=%llu",
+        (unsigned long long)wall);
+  CHECK(wall <= 1280, "latency charged to tenant (no discount): wall=%llu",
+        (unsigned long long)wall);
+  Destroy(api, resident);
+  int failures = g_failures.load();
+  printf(failures ? "FAILURES: %d\n" : "ALL PASS\n", failures);
+  return failures ? 1 : 0;
+}
+
 int main(int argc, char** argv) {
   bool throttle_only = argc > 1 && !strcmp(argv[1], "--throttle-only");
   bool multichip = argc > 1 && !strcmp(argv[1], "--multichip");
+  bool obs_latency = argc > 1 && !strcmp(argv[1], "--obs-latency");
   const char* shim_path = getenv("SHIM_PATH");
   if (!shim_path) {
     fprintf(stderr, "SHIM_PATH not set\n");
@@ -212,6 +271,7 @@ int main(int argc, char** argv) {
     if (devargs.num_devices < 2) return 2;
     return RunMultichip(api, client, devargs.devices[0], devargs.devices[1]);
   }
+  if (obs_latency) return RunObsLatency(api, client, dev);
 
   PJRT_Error* err = nullptr;
   if (!throttle_only) {
