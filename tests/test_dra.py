@@ -40,6 +40,33 @@ def allocated_claim(uid="claim-1", device="vtpu-0", cores=50,
     }
 
 
+def multi_request_claim(uid="claim-m", train_device="vtpu-0",
+                        eval_device="vtpu-1", train_mem=4096,
+                        eval_mem=2048):
+    """A claim whose allocation spans two named requests — the shape two
+    containers of one pod produce when they bind different requests of a
+    shared claim."""
+    return {
+        "metadata": {"uid": uid, "name": "cm", "namespace": "ml"},
+        "status": {"allocation": {"devices": {
+            "results": [
+                {"request": "train", "driver": consts.DRA_DRIVER_NAME,
+                 "pool": "node-1", "device": train_device},
+                {"request": "eval", "driver": consts.DRA_DRIVER_NAME,
+                 "pool": "node-1", "device": eval_device},
+            ],
+            "config": [
+                {"requests": ["train"], "opaque": {
+                    "driver": consts.DRA_DRIVER_NAME,
+                    "parameters": {"cores": 60, "memoryMiB": train_mem}}},
+                {"requests": ["eval"], "opaque": {
+                    "driver": consts.DRA_DRIVER_NAME,
+                    "parameters": {"cores": 30, "memoryMiB": eval_mem}}},
+            ],
+        }}},
+    }
+
+
 @pytest.fixture
 def state(tmp_path):
     chips = [fake_chip(0), fake_chip(1)]
@@ -142,6 +169,100 @@ class TestDeviceState:
         claim = allocated_claim(device="vtpu-0-3", cores=50)  # slot is 10%
         with pytest.raises(PrepareError, match="exceeds allocated"):
             state.prepare_claim(claim)
+
+    def test_multi_request_claim_gets_per_request_cdi_devices(
+            self, state, tmp_path):
+        """Two containers binding different requests of one shared claim
+        must each get ONLY their request's partition (reference:
+        docs/dra_vgpu_multicontainer_claim_design.md — result-granular
+        injection instead of claim-granular)."""
+        claim = multi_request_claim()
+        cdi_ids = state.prepare_claim(claim)
+        assert cdi_ids == ["google.com/vtpu=claim-m-eval",
+                           "google.com/vtpu=claim-m-train"]
+        spec = json.load(open(cdi.spec_path("claim-m",
+                                            str(tmp_path / "cdi"))))
+        by_name = {d["name"]: d["containerEdits"] for d in spec["devices"]}
+        train = by_name["claim-m-train"]
+        evalc = by_name["claim-m-eval"]
+        assert any("VTPU_CORE_LIMIT_0=60" in e for e in train["env"])
+        assert any("VTPU_CORE_LIMIT_0=30" in e for e in evalc["env"])
+        assert any("MANAGER_VISIBLE_DEVICES=0" in e for e in train["env"])
+        assert any("MANAGER_VISIBLE_DEVICES=1" in e for e in evalc["env"])
+        assert [d["path"] for d in train["deviceNodes"]] == ["/dev/accel0"]
+        assert [d["path"] for d in evalc["deviceNodes"]] == ["/dev/accel1"]
+        # per-request config mounts point at DIFFERENT host dirs with the
+        # request's own limits
+        t_cfg = vc.read_config(os.path.join(
+            state.base_dir, "claim_claim-m", "config_train", "vtpu.config"))
+        e_cfg = vc.read_config(os.path.join(
+            state.base_dir, "claim_claim-m", "config_eval", "vtpu.config"))
+        assert t_cfg.devices[0].hard_core == 60
+        assert t_cfg.devices[0].host_index == 0
+        assert e_cfg.devices[0].hard_core == 30
+        assert e_cfg.devices[0].host_index == 1
+
+    def test_multi_request_prepare_response_maps_requests(
+            self, state, tmp_path):
+        """NodePrepareResources must attribute each CDI device to its
+        request so the kubelet injects per container-request binding."""
+        source = ClaimSource()
+        claim = multi_request_claim()
+        source.local["claim-m"] = claim
+        driver = DraDriver("node-1", [fake_chip(0), fake_chip(1)], source,
+                           state=state,
+                           plugin_dir=str(tmp_path / "plug"))
+        req = pb.NodePrepareResourcesRequest()
+        ref = req.claims.add()
+        ref.uid, ref.name, ref.namespace = "claim-m", "cm", "ml"
+        resp = driver.node_prepare(req)
+        entry = resp.claims["claim-m"]
+        assert not entry.error
+        by_request = {tuple(d.requests): list(d.cdi_device_ids)
+                      for d in entry.devices}
+        assert by_request[("train",)] == ["google.com/vtpu=claim-m-train"]
+        assert by_request[("eval",)] == ["google.com/vtpu=claim-m-eval"]
+
+    def test_single_request_response_keeps_claim_level_device(
+            self, state, tmp_path):
+        source = ClaimSource()
+        source.local["claim-1"] = allocated_claim()
+        driver = DraDriver("node-1", [fake_chip(0), fake_chip(1)], source,
+                           state=state,
+                           plugin_dir=str(tmp_path / "plug"))
+        req = pb.NodePrepareResourcesRequest()
+        ref = req.claims.add()
+        ref.uid, ref.name, ref.namespace = "claim-1", "c1", "ml"
+        resp = driver.node_prepare(req)
+        entry = resp.claims["claim-1"]
+        assert not entry.error
+        assert len(entry.devices) == 1
+        assert list(entry.devices[0].requests) == []
+        assert list(entry.devices[0].cdi_device_ids) == \
+            ["google.com/vtpu=claim-1"]
+
+    def test_multi_request_cross_request_overcommit_denied(self, state):
+        """Each request alone fits the chip, but together they oversubscribe
+        it — the prepare-side backstop behind the scheduler's counters."""
+        from vtpu_manager.kubeletplugin.device_state import PrepareError
+        claim = multi_request_claim(
+            train_device="vtpu-0", eval_device="vtpu-0",
+            train_mem=10240, eval_mem=8192)
+        with pytest.raises(PrepareError, match="together"):
+            state.prepare_claim(claim)
+        # validation runs before any disk write: a failed prepare must not
+        # orphan claim_<uid> (never checkpointed -> unprepare would skip it)
+        assert not os.path.exists(os.path.join(state.base_dir,
+                                               "claim_claim-m"))
+
+    def test_multi_request_unprepare_cleans_all_configs(self, state,
+                                                        tmp_path):
+        state.prepare_claim(multi_request_claim())
+        state.unprepare_claim("claim-m")
+        assert not os.path.exists(os.path.join(state.base_dir,
+                                               "claim_claim-m"))
+        assert not os.path.exists(cdi.spec_path("claim-m",
+                                                str(tmp_path / "cdi")))
 
     def test_corrupt_checkpoint_quarantined(self, tmp_path):
         base = tmp_path / "mgr2"
@@ -259,11 +380,44 @@ class TestRuntimeHook:
                                     {"name": "c", "env": []})
         assert not adj.rejected and not adj.env
 
+    def test_multi_request_container_gets_its_requests_config(self, state):
+        """The request marker (injected by the request's CDI device) must
+        resolve to THAT request's config dir, not the claim level."""
+        state.prepare_claim(multi_request_claim())
+        hook = RuntimeHook(state)
+        adj = hook.create_container(
+            {"uid": "pod-1", "claim_uids": ["claim-m"]},
+            {"name": "trainer", "env": ["VTPU_CLAIM_UID=claim-m",
+                                        "VTPU_CLAIM_REQUEST=train"]})
+        assert not adj.rejected
+        assert adj.mounts[0]["source"].endswith(
+            "claim_claim-m/config_train")
+
+    def test_multi_request_unknown_request_marker_rejected(self, state):
+        state.prepare_claim(multi_request_claim())
+        hook = RuntimeHook(state)
+        adj = hook.create_container(
+            {"uid": "pod-1", "claim_uids": ["claim-m"]},
+            {"name": "c", "env": ["VTPU_CLAIM_UID=claim-m",
+                                  "VTPU_CLAIM_REQUEST=forged"]})
+        assert adj.rejected and "no prepared request" in adj.reason
+
+    def test_multi_request_without_marker_fails_closed(self, state):
+        """A multi-request claim's container with no marker was not wired
+        through any request's CDI device — mounting an arbitrary request's
+        partition would be wrong either way."""
+        state.prepare_claim(multi_request_claim())
+        hook = RuntimeHook(state)
+        adj = hook.create_container(
+            {"uid": "pod-1", "claim_uids": ["claim-m"]},
+            {"name": "c", "env": ["VTPU_CLAIM_UID=claim-m"]})
+        assert adj.rejected and "VTPU_CLAIM_REQUEST" in adj.reason
+
 
 class TestClaimResolve:
     def test_resolve_partitions(self):
         parts = resolve_claim_partitions(allocated_claim())
-        assert parts == [PartitionKey("vtpu-0", 50, 2048)]
+        assert parts == [PartitionKey("vtpu-0", 50, 2048, request="tpu")]
 
     def test_pod_partitions(self):
         pod = {"metadata": {"namespace": "ml"},
@@ -272,7 +426,7 @@ class TestClaimResolve:
                "status": {}}
         claims = {("ml", "c1"): allocated_claim()}
         assert pod_partitions(pod, claims) == \
-            [PartitionKey("vtpu-0", 50, 2048)]
+            [PartitionKey("vtpu-0", 50, 2048, request="tpu")]
 
     def test_foreign_driver_ignored(self):
         claim = allocated_claim()

@@ -213,6 +213,30 @@ class TestAllocatedSharing:
         pod = pod_with_claims("p", [("a", ["c1"]), ("b", ["c1"])])
         assert validate_allocated_sharing(claim, [pod], {}).allowed
 
+    def test_requestless_ref_to_multi_request_claim_denied(self):
+        """A container must name the request when the claim has several —
+        otherwise every request's partition would be injected into it
+        (reference multicontainer design §3.4 rule 4)."""
+        claim = allocated_claim(requests=("train", "eval"))
+        pod = pod_with_claims("p", [("a", ["c1"])])
+        res = validate_allocated_sharing(claim, [pod], {})
+        assert not res.allowed and "without a request name" in res.message
+
+    def test_named_request_refs_to_multi_request_claim_allowed(self):
+        """Two app containers binding DIFFERENT requests of one claim is
+        the multi-container sharing shape this feature exists for."""
+        claim = allocated_claim(requests=("train", "eval"))
+        pod = pod_with_claims("p", [("a", ["c1"]), ("b", ["c1"])])
+        conts = pod["spec"]["containers"]
+        conts[0]["resources"]["claims"][0]["request"] = "train"
+        conts[1]["resources"]["claims"][0]["request"] = "eval"
+        assert validate_allocated_sharing(claim, [pod], {}).allowed
+
+    def test_requestless_ref_to_single_request_claim_allowed(self):
+        claim = allocated_claim(requests=("vtpu",))
+        pod = pod_with_claims("p", [("a", ["c1"])])
+        assert validate_allocated_sharing(claim, [pod], {}).allowed
+
 
 class TestClaimValidateRoute:
     @pytest.fixture

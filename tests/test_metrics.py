@@ -64,6 +64,29 @@ def test_collector_empty_node(tmp_path):
                          vmem_path="/nonexistent").render()
     assert "vtpu_node_slots_total" in text
 
+
+def test_multi_request_dra_claim_partitions_counted(tmp_path):
+    """A multi-request DRA claim writes config_<request> dirs (no plain
+    'config'); each request's partition must appear as its own tenant row
+    instead of the whole claim silently vanishing from monitoring."""
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0), fake_chip(1)]
+    for req, index, cores in (("train", 0, 60), ("eval", 1, 30)):
+        d = os.path.join(base, "claim_cm", f"config_{req}")
+        os.makedirs(d)
+        vc.write_config(os.path.join(d, "vtpu.config"), vc.VtpuConfig(
+            pod_uid="cm", container_name=f"dra-{req}",
+            devices=[vc.DeviceConfig(
+                uuid=chips[index].uuid, total_memory=2**30,
+                real_memory=chips[index].memory, hard_core=cores,
+                host_index=index)]))
+    text = NodeCollector("n1", chips, base_dir=base,
+                         tc_path="/nonexistent",
+                         vmem_path="/nonexistent").render()
+    assert 'container="cm/train"' in text
+    assert 'container="cm/eval"' in text
+    assert 'vtpu_node_slots_assigned{node="n1"} 2.0' in text
+
 def test_multi_chip_container_rows_stay_per_device(tmp_path):
     """A container spanning two chips must report each chip's own bytes
     and util share — not a cross-device sum duplicated on every row."""

@@ -17,6 +17,12 @@ class PartitionKey:
     device: str               # DRA device name (vtpu-<index>[-<slot>])
     cores: int | None         # None = no opaque config: consumer applies
     memory_mib: int | None    # the allocated device's own capacity defaults
+    # which spec.devices.requests[] entry this result satisfies — the key
+    # multi-container claims carve injection by (reference:
+    # docs/dra_vgpu_multicontainer_claim_design.md). Prioritized-list
+    # sub-requests ("parent/sub") collapse to the parent: containers
+    # reference the parent name.
+    request: str = ""
 
 
 def pod_claim_names(pod: dict) -> list[tuple[str, str]]:
@@ -65,7 +71,8 @@ def resolve_claim_partitions(claim: dict) -> list[PartitionKey]:
         out.append(PartitionKey(
             device=result.get("device", ""),
             cores=int(cores) if cores is not None else None,
-            memory_mib=int(memory) if memory is not None else None))
+            memory_mib=int(memory) if memory is not None else None,
+            request=(result.get("request", "") or "").split("/", 1)[0]))
     return out
 
 

@@ -18,16 +18,30 @@ CDI_CLASS = "vtpu"
 CDI_DIR = "/etc/cdi"
 
 
-def cdi_device_name(claim_uid: str) -> str:
-    return f"{CDI_VENDOR}/{CDI_CLASS}={claim_uid}"
+def unqualified_name(claim_uid: str, request_slug: str = "") -> str:
+    """The in-spec device name — the single source of the naming scheme;
+    the qualified id below and build_multi_spec both derive from it so
+    they can never drift apart."""
+    return f"{claim_uid}-{request_slug}" if request_slug else claim_uid
 
 
-def build_spec(claim_uid: str, host_indices: list[int], envs: dict[str, str],
-               config_host_dir: str,
-               shim_host_dir: str = consts.DRIVER_DIR,
-               client_mode: bool = False) -> dict:
-    """One CDI device per claim bundling env + mounts + device nodes (the
-    per-claim analogue of the device plugin's ContainerAllocateResponse)."""
+def cdi_device_name(claim_uid: str, request_slug: str = "") -> str:
+    """Qualified CDI device id. Per-claim by default; multi-request claims
+    append a request slug so each container's request resolves to its own
+    device (reference: docs/dra_vgpu_multicontainer_claim_design.md §5.1 —
+    result-granular CDI naming)."""
+    return f"{CDI_VENDOR}/{CDI_CLASS}={unqualified_name(claim_uid, request_slug)}"
+
+
+def slugify(request: str) -> str:
+    """Normalize a request name into the CDI-safe charset [a-zA-Z0-9._-]."""
+    return "".join(c if c.isalnum() or c in "._-" else "-"
+                   for c in request) or "req"
+
+
+def _device(name: str, host_indices: list[int], envs: dict[str, str],
+            config_host_dir: str, shim_host_dir: str,
+            client_mode: bool) -> dict:
     env_list = [f"{k}={v}" for k, v in sorted(envs.items())]
     mounts = [
         {"hostPath": config_host_dir,
@@ -51,16 +65,44 @@ def build_spec(claim_uid: str, host_indices: list[int], envs: dict[str, str],
     device_nodes = [{"path": f"/dev/accel{i}", "type": "c",
                      "permissions": "rw"} for i in host_indices]
     return {
+        "name": name,
+        "containerEdits": {
+            "env": env_list,
+            "mounts": mounts,
+            "deviceNodes": device_nodes,
+        },
+    }
+
+
+def build_spec(claim_uid: str, host_indices: list[int], envs: dict[str, str],
+               config_host_dir: str,
+               shim_host_dir: str = consts.DRIVER_DIR,
+               client_mode: bool = False) -> dict:
+    """One CDI device per claim bundling env + mounts + device nodes (the
+    per-claim analogue of the device plugin's ContainerAllocateResponse)."""
+    return {
         "cdiVersion": CDI_VERSION,
         "kind": f"{CDI_VENDOR}/{CDI_CLASS}",
-        "devices": [{
-            "name": claim_uid,
-            "containerEdits": {
-                "env": env_list,
-                "mounts": mounts,
-                "deviceNodes": device_nodes,
-            },
-        }],
+        "devices": [_device(claim_uid, host_indices, envs, config_host_dir,
+                            shim_host_dir, client_mode)],
+    }
+
+
+def build_multi_spec(claim_uid: str,
+                     groups: list[tuple[str, list[int], dict, str]],
+                     shim_host_dir: str = consts.DRIVER_DIR,
+                     client_mode: bool = False) -> dict:
+    """One CDI device PER REQUEST of a multi-request claim. Each container
+    binds its own request's device, so env/limits/config never mix across
+    containers sharing the claim. groups: (request_slug, host_indices,
+    envs, config_host_dir)."""
+    return {
+        "cdiVersion": CDI_VERSION,
+        "kind": f"{CDI_VENDOR}/{CDI_CLASS}",
+        "devices": [
+            _device(unqualified_name(claim_uid, slug), idx, envs, cfg_dir,
+                    shim_host_dir, client_mode)
+            for slug, idx, envs, cfg_dir in groups],
     }
 
 

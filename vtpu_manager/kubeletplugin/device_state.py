@@ -97,10 +97,110 @@ class DeviceState:
 
     # -- prepare ------------------------------------------------------------
 
+    def _merge_partitions(self, partitions) -> list[dict]:
+        """Merge same-chip partitions into per-chip device entries: two
+        fractional slots of one chip are one bigger partition of that chip,
+        not two conflicting per-index caps. Validates opaque configs
+        against the allocated slots' capacity."""
+        merged: dict[int, dict] = {}
+        for part in partitions:
+            chip = self.chip_for_device(part.device)
+            if chip is None:
+                raise PrepareError(
+                    f"allocated device {part.device!r} not on node")
+            slot_cores, slot_mem = self.slot_capacity(part.device)
+            cores = part.cores if part.cores is not None else slot_cores
+            memory = (part.memory_mib * 2**20
+                      if part.memory_mib is not None else slot_mem)
+            if not 0 < cores <= 100:
+                raise PrepareError(f"cores {cores} out of range")
+            # beyond what the scheduler charged against the shared
+            # counters would overcommit the chip — except whole-chip
+            # memory with the explicit oversold opt-in (HBM spill),
+            # which the merged check below still bounds
+            mem_over = memory > slot_mem and (
+                self._is_fractional(part.device)
+                or not self.node_config.memory_overused)
+            if cores > slot_cores or mem_over:
+                raise PrepareError(
+                    f"opaque config ({cores}%, {memory >> 20}MiB) "
+                    f"exceeds allocated device capacity "
+                    f"({slot_cores}%, {slot_mem >> 20}MiB)")
+            entry = merged.setdefault(chip.index, {
+                "device": part.device, "uuid": chip.uuid,
+                "hostIndex": chip.index, "cores": 0, "memory": 0})
+            entry["cores"] = min(entry["cores"] + cores, 100)
+            entry["memory"] += memory
+        devices = []
+        for index in sorted(merged):
+            entry = merged[index]
+            chip = self._chips_by_index[index]
+            if entry["memory"] > chip.memory and \
+                    not self.node_config.memory_overused:
+                raise PrepareError(
+                    f"merged memory {entry['memory'] >> 20}MiB exceeds "
+                    f"chip HBM {chip.memory >> 20}MiB (node not "
+                    "configured for memory oversubscription)")
+            devices.append(entry)
+        return devices
+
+    def _group_envs(self, uid: str, devices: list[dict]) -> dict[str, str]:
+        """Injection env for one group of per-chip device entries."""
+        envs: dict[str, str] = {}
+        for i, entry in enumerate(devices):
+            envs[f"{consts.ENV_MEM_LIMIT}_{i}"] = str(entry["memory"])
+            if entry["cores"] < 100:
+                envs[f"{consts.ENV_CORE_LIMIT}_{i}"] = str(entry["cores"])
+        visible = ",".join(str(d["hostIndex"]) for d in devices)
+        envs[consts.ENV_VISIBLE_DEVICES] = visible
+        envs[consts.ENV_TPU_VISIBLE_DEVICES] = visible
+        shim = os.path.join(consts.DRIVER_DIR, consts.CONTROL_LIBRARY_NAME)
+        envs[consts.ENV_TPU_LIBRARY_PATH] = shim
+        envs[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
+        envs[consts.ENV_VTPU_REAL_PLUGIN_PATH] = self.libtpu_path
+        envs["VTPU_CLAIM_UID"] = uid
+        envs[consts.ENV_REGISTER_UUID] = uid
+        envs[consts.ENV_COMPAT_MODE] = str(_COMPAT_BITS.get(
+            self.node_config.compat_mode, consts.COMPAT_HOST))
+        envs["VTPU_CONFIG_PATH"] = \
+            f"{consts.MANAGER_BASE_DIR}/config/vtpu.config"
+        return envs
+
+    def _write_group_config(self, config_dir: str, uid: str, meta: dict,
+                            container_name: str,
+                            devices: list[dict]) -> None:
+        """Binary partition config, same ABI as the device-plugin path."""
+        os.makedirs(config_dir, exist_ok=True)
+        vc.write_config(os.path.join(config_dir, "vtpu.config"),
+                        vc.VtpuConfig(
+            pod_uid=uid, pod_name=meta.get("name", ""),
+            pod_namespace=meta.get("namespace", ""),
+            container_name=container_name,
+            compat_mode=_COMPAT_BITS.get(self.node_config.compat_mode,
+                                         consts.COMPAT_HOST),
+            devices=[vc.DeviceConfig(
+                uuid=d["uuid"], total_memory=d["memory"],
+                real_memory=self.chip_for_device(d["device"]).memory,
+                hard_core=d["cores"], soft_core=d["cores"],
+                core_limit=(vc.CORE_LIMIT_HARD if d["cores"] < 100
+                            else vc.CORE_LIMIT_NONE),
+                memory_limit=True, host_index=d["hostIndex"],
+                mesh=self.chip_for_device(d["device"]).coords)
+                for d in devices]))
+
     def prepare_claim(self, claim: dict) -> list[str]:
         """Prepare one ResourceClaim; returns CDI device names. Idempotent:
         an already-prepared claim returns its recorded CDI devices
-        (kubelet retries Prepare)."""
+        (kubelet retries Prepare).
+
+        Single-request claims get one claim-level CDI device. Claims whose
+        allocation spans MULTIPLE named requests get one CDI device per
+        request, each with its own env/limits/config mount, so containers
+        of one pod binding different requests of a shared claim never see
+        each other's partition (reference:
+        docs/dra_vgpu_multicontainer_claim_design.md — result-granular
+        injection; the webhook enforces that containers name a request
+        when the claim has several)."""
         meta = claim.get("metadata") or {}
         uid = meta.get("uid", "")
         if not uid:
@@ -128,98 +228,74 @@ class DeviceState:
             except (TypeError, ValueError) as e:
                 raise PrepareError(f"malformed opaque config: {e}") from e
 
-            devices = []
-            envs: dict[str, str] = {}
-            # merge same-chip partitions: two fractional slots of one chip
-            # are one bigger partition of that chip, not two conflicting
-            # per-index caps
-            merged: dict[int, dict] = {}
+            by_request: dict[str, list] = {}
             for part in partitions:
-                chip = self.chip_for_device(part.device)
-                if chip is None:
-                    raise PrepareError(
-                        f"allocated device {part.device!r} not on node")
-                slot_cores, slot_mem = self.slot_capacity(part.device)
-                cores = part.cores if part.cores is not None else slot_cores
-                memory = (part.memory_mib * 2**20
-                          if part.memory_mib is not None else slot_mem)
-                if not 0 < cores <= 100:
-                    raise PrepareError(f"cores {cores} out of range")
-                # beyond what the scheduler charged against the shared
-                # counters would overcommit the chip — except whole-chip
-                # memory with the explicit oversold opt-in (HBM spill),
-                # which the merged check below still bounds
-                mem_over = memory > slot_mem and (
-                    self._is_fractional(part.device)
-                    or not self.node_config.memory_overused)
-                if cores > slot_cores or mem_over:
-                    raise PrepareError(
-                        f"opaque config ({cores}%, {memory >> 20}MiB) "
-                        f"exceeds allocated device capacity "
-                        f"({slot_cores}%, {slot_mem >> 20}MiB)")
-                entry = merged.setdefault(chip.index, {
-                    "device": part.device, "uuid": chip.uuid,
-                    "hostIndex": chip.index, "cores": 0, "memory": 0})
-                entry["cores"] = min(entry["cores"] + cores, 100)
-                entry["memory"] += memory
-            host_indices = sorted(merged)
-            for index in host_indices:
-                entry = merged[index]
-                chip = self._chips_by_index[index]
-                if entry["memory"] > chip.memory and \
-                        not self.node_config.memory_overused:
-                    raise PrepareError(
-                        f"merged memory {entry['memory'] >> 20}MiB exceeds "
-                        f"chip HBM {chip.memory >> 20}MiB (node not "
-                        "configured for memory oversubscription)")
-                devices.append(entry)
-            for i, entry in enumerate(devices):
-                envs[f"{consts.ENV_MEM_LIMIT}_{i}"] = str(entry["memory"])
-                if entry["cores"] < 100:
-                    envs[f"{consts.ENV_CORE_LIMIT}_{i}"] = \
-                        str(entry["cores"])
-            envs[consts.ENV_VISIBLE_DEVICES] = ",".join(
-                str(i) for i in host_indices)
-            envs[consts.ENV_TPU_VISIBLE_DEVICES] = \
-                envs[consts.ENV_VISIBLE_DEVICES]
-            shim = os.path.join(consts.DRIVER_DIR,
-                                consts.CONTROL_LIBRARY_NAME)
-            envs[consts.ENV_TPU_LIBRARY_PATH] = shim
-            envs[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
-            envs[consts.ENV_VTPU_REAL_PLUGIN_PATH] = self.libtpu_path
-            envs["VTPU_CLAIM_UID"] = uid
-            envs[consts.ENV_REGISTER_UUID] = uid
-            envs[consts.ENV_COMPAT_MODE] = str(_COMPAT_BITS.get(
-                self.node_config.compat_mode, consts.COMPAT_HOST))
-            envs["VTPU_CONFIG_PATH"] = \
-                f"{consts.MANAGER_BASE_DIR}/config/vtpu.config"
-
-            # binary partition config, same ABI as the device-plugin path
+                by_request.setdefault(part.request, []).append(part)
             claim_dir = os.path.join(self.base_dir, f"claim_{uid}")
-            config_dir = os.path.join(claim_dir, "config")
-            os.makedirs(config_dir, exist_ok=True)
-            vc.write_config(os.path.join(config_dir, "vtpu.config"),
-                            vc.VtpuConfig(
-                pod_uid=uid, pod_name=meta.get("name", ""),
-                pod_namespace=meta.get("namespace", ""),
-                container_name="dra-claim",
-                compat_mode=_COMPAT_BITS.get(self.node_config.compat_mode,
-                                             consts.COMPAT_HOST),
-                devices=[vc.DeviceConfig(
-                    uuid=d["uuid"], total_memory=d["memory"],
-                    real_memory=self.chip_for_device(d["device"]).memory,
-                    hard_core=d["cores"], soft_core=d["cores"],
-                    core_limit=(vc.CORE_LIMIT_HARD if d["cores"] < 100
-                                else vc.CORE_LIMIT_NONE),
-                    memory_limit=True, host_index=d["hostIndex"],
-                    mesh=self.chip_for_device(d["device"]).coords)
-                    for d in devices]))
+            client_mode = self.node_config.compat_mode == "client"
 
-            spec = cdi.build_spec(
-                uid, host_indices, envs, config_dir, self.shim_host_dir,
-                client_mode=self.node_config.compat_mode == "client")
+            if len(by_request) <= 1:
+                devices = self._merge_partitions(partitions)
+                envs = self._group_envs(uid, devices)
+                config_dir = os.path.join(claim_dir, "config")
+                self._write_group_config(config_dir, uid, meta, "dra-claim",
+                                         devices)
+                spec = cdi.build_spec(
+                    uid, [d["hostIndex"] for d in devices], envs,
+                    config_dir, self.shim_host_dir, client_mode=client_mode)
+                cdi_names = [cdi.cdi_device_name(uid)]
+            else:
+                # Validate EVERYTHING before the first disk write: a
+                # PrepareError after partial writes would orphan
+                # claim_<uid> (the claim is never checkpointed, so
+                # unprepare skips it) and kubelet retries re-fail forever.
+                chip_mem: dict[int, int] = {}
+                chip_cores: dict[int, int] = {}
+                devices = []
+                merged_groups: list[tuple[str, str, list[dict]]] = []
+                for request in sorted(by_request):
+                    group = self._merge_partitions(by_request[request])
+                    slug = cdi.slugify(request)
+                    cdi_id = cdi.cdi_device_name(uid, slug)
+                    for d in group:
+                        d["request"] = request
+                        d["cdi"] = cdi_id
+                        chip_mem[d["hostIndex"]] = \
+                            chip_mem.get(d["hostIndex"], 0) + d["memory"]
+                        chip_cores[d["hostIndex"]] = \
+                            chip_cores.get(d["hostIndex"], 0) + d["cores"]
+                    merged_groups.append((request, slug, group))
+                    devices.extend(group)
+                # cross-request totals: requests are carved independently,
+                # but they land on the same physical chips
+                for index, mem in chip_mem.items():
+                    chip = self._chips_by_index[index]
+                    if mem > chip.memory and \
+                            not self.node_config.memory_overused:
+                        raise PrepareError(
+                            f"requests together put {mem >> 20}MiB on chip "
+                            f"{index} ({chip.memory >> 20}MiB HBM, node not "
+                            "configured for memory oversubscription)")
+                    if chip_cores[index] > 100:
+                        raise PrepareError(
+                            f"requests together claim {chip_cores[index]}% "
+                            f"of chip {index} cores")
+                groups = []
+                for request, slug, group in merged_groups:
+                    config_dir = os.path.join(claim_dir, f"config_{slug}")
+                    self._write_group_config(config_dir, uid, meta,
+                                             f"dra-{slug}", group)
+                    envs = self._group_envs(uid, group)
+                    # the runtime hook resolves this back to the request's
+                    # own config dir (nri.py); without it a multi-request
+                    # container could only be wired claim-level
+                    envs["VTPU_CLAIM_REQUEST"] = request
+                    groups.append((slug, [d["hostIndex"] for d in group],
+                                   envs, config_dir))
+                spec = cdi.build_multi_spec(uid, groups, self.shim_host_dir,
+                                            client_mode=client_mode)
+                cdi_names = list(dict.fromkeys(d["cdi"] for d in devices))
             cdi.write_spec(spec, uid, self.cdi_dir)
-            cdi_names = [cdi.cdi_device_name(uid)]
 
             before = dict(self.checkpoint.claims)
             self.checkpoint.claims[uid] = PreparedClaim(

@@ -135,17 +135,41 @@ class DraDriver:
                                   claim_ref.uid)
                 entry.error = str(e)
                 continue
-            device = entry.devices.add()
-            device.pool_name = self.node_name
             prepared = self.state.checkpoint.claims.get(claim_ref.uid)
-            if prepared and prepared.devices:
-                device.device_name = prepared.devices[0]["device"]
-                for d in prepared.devices[1:]:
-                    extra = entry.devices.add()
-                    extra.pool_name = self.node_name
-                    extra.device_name = d["device"]
-            for cdi_id in cdi_ids:
-                device.cdi_device_ids.append(cdi_id)
+            pdevices = prepared.devices if prepared else []
+            # Group by the request each device satisfies. Single-request
+            # (or legacy) claims have no per-device request: one group with
+            # empty `requests`, which the kubelet applies to every
+            # container referencing the claim. Multi-request claims get one
+            # group per request, each carrying only its own CDI device —
+            # the kubelet then injects per container-request binding
+            # (result-granular injection, reference multicontainer design).
+            groups: dict[str, list[dict]] = {}
+            for d in pdevices:
+                groups.setdefault(d.get("request", ""), []).append(d)
+            if not groups:
+                groups[""] = []
+            for request in sorted(groups):
+                first = None
+                for d in groups[request]:
+                    device = entry.devices.add()
+                    device.pool_name = self.node_name
+                    device.device_name = d["device"]
+                    if request:
+                        device.requests.append(request)
+                    if first is None:
+                        first = device
+                if first is None:
+                    first = entry.devices.add()
+                    first.pool_name = self.node_name
+                    if request:
+                        first.requests.append(request)
+                group_cdis = list(dict.fromkeys(
+                    d["cdi"] for d in groups[request] if d.get("cdi")))
+                if not group_cdis and not request:
+                    group_cdis = list(cdi_ids)   # claim-level legacy path
+                for cdi_id in group_cdis:
+                    first.cdi_device_ids.append(cdi_id)
         return resp
 
     def node_unprepare(self, request: pb.NodeUnprepareResourcesRequest,

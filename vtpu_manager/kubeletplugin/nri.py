@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass, field
 
+from vtpu_manager.kubeletplugin.cdi import slugify
 from vtpu_manager.kubeletplugin.device_state import DeviceState
 from vtpu_manager.util import consts
 
@@ -60,20 +61,53 @@ class RuntimeHook:
             log.warning("runtime hook rejection: %s", adj.reason)
             return adj
         claim_dir = f"{self.state.base_dir}/claim_{claimed}"
+        # Multi-request claims carve one config dir per request; the
+        # request marker (injected by the request's own CDI device) picks
+        # the right one, validated against what was actually prepared so
+        # a container cannot cross-mount a co-container's partition by
+        # editing the marker to a request it did not bind.
+        prepared_claim = self.state.checkpoint.claims.get(claimed)
+        prepared_requests = {d.get("request", "")
+                             for d in (prepared_claim.devices
+                                       if prepared_claim else [])}
+        request = self._env_value(container, "VTPU_CLAIM_REQUEST")
+        if request is not None:
+            if request not in prepared_requests:
+                adj.rejected = True
+                adj.reason = (f"claim {claimed!r} has no prepared request "
+                              f"{request!r}")
+                log.warning("runtime hook rejection: %s", adj.reason)
+                return adj
+            config_src = f"{claim_dir}/config_{slugify(request)}"
+        elif prepared_requests - {""}:
+            # multi-request claim but no marker: this container was not
+            # wired through a request's CDI device — fail closed rather
+            # than mount an arbitrary request's partition
+            adj.rejected = True
+            adj.reason = (f"claim {claimed!r} is multi-request; container "
+                          "carries no VTPU_CLAIM_REQUEST marker")
+            log.warning("runtime hook rejection: %s", adj.reason)
+            return adj
+        else:
+            config_src = f"{claim_dir}/config"
         adj.mounts.append({
-            "source": f"{claim_dir}/config",
+            "source": config_src,
             "destination": f"{consts.MANAGER_BASE_DIR}/config",
             "options": ["ro", "rbind"]})
         adj.env[consts.ENV_REGISTER_UUID] = claimed
         return adj
 
     @staticmethod
-    def _claimed_uid(container: dict) -> str | None:
+    def _env_value(container: dict, name: str) -> str | None:
         for entry in container.get("env") or []:
             if isinstance(entry, str):
                 key, _, value = entry.partition("=")
             else:
                 key, value = entry.get("name", ""), entry.get("value", "")
-            if key == "VTPU_CLAIM_UID":
+            if key == name:
                 return value
         return None
+
+    @classmethod
+    def _claimed_uid(cls, container: dict) -> str | None:
+        return cls._env_value(container, "VTPU_CLAIM_UID")

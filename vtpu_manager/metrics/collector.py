@@ -75,15 +75,31 @@ class NodeCollector:
         if not os.path.isdir(self.base_dir):
             return out
         for entry in sorted(os.listdir(self.base_dir)):
-            cfg_path = os.path.join(self.base_dir, entry, "config",
-                                    "vtpu.config")
-            if not os.path.exists(cfg_path):
+            entry_dir = os.path.join(self.base_dir, entry)
+            if not os.path.isdir(entry_dir):
+                continue
+            # claim-level "config" plus one "config_<request>" per request
+            # of a multi-request DRA claim — each is its own tenant
+            # partition and must be counted separately
+            try:
+                config_dirs = sorted(
+                    d for d in os.listdir(entry_dir)
+                    if d == "config" or d.startswith("config_"))
+            except OSError:
                 continue
             pod_uid, _, container = entry.partition("_")
-            try:
-                out.append((pod_uid, container, vc.read_config(cfg_path)))
-            except (OSError, ValueError):
-                continue
+            for config_name in config_dirs:
+                cfg_path = os.path.join(entry_dir, config_name,
+                                        "vtpu.config")
+                if not os.path.exists(cfg_path):
+                    continue
+                suffix = config_name[len("config_"):] \
+                    if config_name != "config" else ""
+                label = f"{container}/{suffix}" if suffix else container
+                try:
+                    out.append((pod_uid, label, vc.read_config(cfg_path)))
+                except (OSError, ValueError):
+                    continue
         return out
 
     def collect(self) -> list[Gauge]:

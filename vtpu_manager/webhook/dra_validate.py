@@ -351,6 +351,19 @@ def validate_allocated_sharing(claim: dict, reserved_pods: list[dict],
                 if not allocated:
                     continue
                 wanted = ref.get("request")
+                if not wanted and len(allocated) > 1:
+                    # a requestless reference to a multi-request claim
+                    # would inject every request's partition into one
+                    # container — mixed limits and devices (reference
+                    # multicontainer design §3.4: allowed only when the
+                    # claim has exactly one vtpu request)
+                    result.deny(
+                        f"container {cont_id} references claim {actual} "
+                        f"without a request name, but it has "
+                        f"{len(allocated)} vtpu requests "
+                        f"({sorted(allocated)}); name one")
+                    continue   # counting it as a user of EVERY request
+                               # would cascade misleading extra denials
                 hits = ({wanted.split("/", 1)[0]} & allocated if wanted
                         else allocated)
                 if hits:
