@@ -222,6 +222,93 @@ class TestAllocator:
         uuids = [c.uuid for c in res.claims.all_claims()]
         assert uuids[0] != uuids[1]
 
+    def test_init_container_allocated_with_peak_charge(self):
+        """A plain init container gets real device claims, reuses the
+        pod's app chip, and the node is charged the phase PEAK — not the
+        sum (reference: init_container_vgpu_support_design.md §3)."""
+        info = dt.fake_node_info("n1", 2)
+        pod = pod_requesting(1, 30, 1024)
+        pod["spec"]["initContainers"] = [{
+            "name": "warmup", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): 60,
+                consts.vtpu_memory_resource(): 2048}}}]
+        req = build_allocation_request(pod)
+        res = allocate(info, req)
+        # the init container has its own claim, on the SAME chip as the app
+        init_claims = res.claims.container_claims("warmup")
+        app_claims = res.claims.container_claims("main")
+        assert len(init_claims) == 1 and len(app_claims) == 1
+        assert init_claims[0].uuid == app_claims[0].uuid
+        assert init_claims[0].cores == 60
+        # annotation order mirrors kubelet's Allocate order (inits first):
+        # the device plugin disambiguates identical uuid multisets by it
+        assert list(res.claims.containers) == ["warmup", "main"]
+        # charge = max(app 30, init 60), not 90
+        usage = res.node_info.devices[app_claims[0].uuid]
+        assert usage.used_cores == 60
+        assert usage.used_memory == 2048 * 2**20
+        assert usage.used_number == 1
+
+    def test_init_peak_fits_where_sum_would_not(self):
+        """App 40 + init 60 on a chip with 70 free: sequential phases both
+        fit (70 and 90 used), the sum (130) would not."""
+        info = dt.fake_node_info("n1", 1)
+        uuid = info.registry.chips[0].uuid
+        held = PodDeviceClaims()
+        held.add("c", DeviceClaim(uuid, 0, 30, 2**30))
+        info.assume_pod("other", held)
+        pod = pod_requesting(1, 40, 1024)
+        pod["spec"]["initContainers"] = [{
+            "name": "init", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): 60,
+                consts.vtpu_memory_resource(): 1024}}}]
+        req = build_allocation_request(pod)
+        res = allocate(info, req)
+        assert res.node_info.devices[uuid].used_cores == 30 + 60
+        # the effective set is what the assumed cache charges
+        eff = res.effective.all_claims()
+        assert sum(c.cores for c in eff if c.uuid == uuid) == 60
+
+    def test_init_beyond_any_phase_capacity_fails(self):
+        info = dt.fake_node_info("n1", 1)
+        uuid = info.registry.chips[0].uuid
+        held = PodDeviceClaims()
+        held.add("c", DeviceClaim(uuid, 0, 50, 2**30))
+        info.assume_pod("other", held)
+        pod = pod_requesting(1, 40, 1024)
+        pod["spec"]["initContainers"] = [{
+            "name": "init", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): 60,   # 50 held + 60 > 100
+                consts.vtpu_memory_resource(): 1024}}}]
+        req = build_allocation_request(pod)
+        with pytest.raises(AllocationFailure):
+            allocate(info, req)
+
+    def test_resident_init_claims_reconstructed_as_peak(self):
+        """A resident pod's annotated init claims must charge the peak on
+        rebuild — the annotation wire stays per-container; the pod spec
+        supplies the lifecycle classification."""
+        claims = PodDeviceClaims()
+        claims.add("main", DeviceClaim("u0", 0, 30, 1 * 2**30))
+        claims.add("init", DeviceClaim("u0", 0, 60, 2 * 2**30))
+        resident = {
+            "metadata": {"uid": "r1", "annotations": {
+                consts.real_allocated_annotation(): claims.encode()}},
+            "spec": {
+                "containers": [{"name": "main"}],
+                "initContainers": [{"name": "init"}]},
+            "status": {"phase": "Running"},
+        }
+        counted = dt.counted_claims([resident])
+        assert len(counted) == 1
+        eff = counted[0][1].all_claims()
+        assert sum(c.cores for c in eff) == 60        # max, not 90
+        assert sum(c.memory for c in eff) == 2 * 2**30
+        assert len(eff) == 1                           # one slot, reused
+
     def test_unhealthy_excluded(self):
         info = dt.fake_node_info("n1", 1)
         uuid = info.registry.chips[0].uuid

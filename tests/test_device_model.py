@@ -160,7 +160,7 @@ class TestAllocationRequest:
         assert req.total_number() == 2
         assert req.total_cores() == 2 * 25
         assert req.total_memory() == 2 * 4096 * MIB
-        assert req.claiming_containers()[0].cores == 25
+        assert req.concurrent_claimers()[0].cores == 25
 
     def test_init_container_aggregation(self):
         pod = make_pod(containers=[vtpu_container(number=1, cores=10,
@@ -171,6 +171,43 @@ class TestAllocationRequest:
         # init runs alone and needs more than the steady state
         assert req.total_number() == 3
         assert req.total_cores() == 60
+
+    def test_sidecar_counts_into_concurrent_phases(self):
+        """K8s PodRequests semantics (reference init-container design §2):
+        a restartable init container (sidecar) runs concurrently with the
+        app phase AND with every plain init started after it — it joins
+        the sum groups, not the sequential-max group."""
+        pod = make_pod(containers=[vtpu_container(name="app", number=1,
+                                                  cores=10, memory_mib=100)])
+        side = vtpu_container(name="side", number=1, cores=30,
+                              memory_mib=100)
+        side["restartPolicy"] = "Always"
+        pod["spec"]["initContainers"] = [
+            # plain init BEFORE the sidecar starts: runs truly alone
+            vtpu_container(name="init-a", number=2, cores=20,
+                           memory_mib=100),
+            side,
+            # plain init AFTER: overlaps the running sidecar
+            vtpu_container(name="init-b", number=1, cores=40,
+                           memory_mib=100),
+        ]
+        req = build_allocation_request(pod)
+        # phases: init-a alone = 2 chips/40 cores; init-b + sidecar =
+        # 2 chips/70 cores; app + sidecar = 2 chips/40 cores
+        assert req.total_number() == 2
+        assert req.total_cores() == 70
+
+    def test_sidecar_only_adds_to_app_phase(self):
+        pod = make_pod(containers=[vtpu_container(name="app", number=1,
+                                                  cores=50, memory_mib=100)])
+        side = vtpu_container(name="side", number=1, cores=20,
+                              memory_mib=50)
+        side["restartPolicy"] = "Always"
+        pod["spec"]["initContainers"] = [side]
+        req = build_allocation_request(pod)
+        assert req.total_number() == 2
+        assert req.total_cores() == 70
+        assert req.total_memory() == 150 * MIB
 
     def test_policy_annotations(self):
         pod = make_pod(containers=[vtpu_container()], annotations={

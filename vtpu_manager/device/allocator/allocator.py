@@ -18,7 +18,8 @@ from dataclasses import dataclass, field
 
 from vtpu_manager.device.allocator.request import (AllocationRequest,
                                                    ContainerRequest)
-from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.device.claims import (DeviceClaim, PodDeviceClaims,
+                                        effective_claims as claims_effective)
 from vtpu_manager.device.topology.mesh import (MeshSelection, select_host_local,
                                                select_submesh)
 from vtpu_manager.device.types import DeviceUsage, NodeInfo
@@ -28,10 +29,13 @@ from vtpu_manager.util import consts
 
 @dataclass
 class AllocationResult:
-    claims: PodDeviceClaims
+    claims: PodDeviceClaims              # per-container (annotation/wire)
     node_info: NodeInfo                  # post-allocation view (copy)
     topology_kind: str = "any"           # "rect"/"greedy"/"host"/"any"
     score: float = 0.0                   # topology fitness (node comparator)
+    # phase-peak charge set (== claims for pods without plain init
+    # containers) — what the assumed cache and capacity accounting use
+    effective: PodDeviceClaims = field(default_factory=PodDeviceClaims)
 
 
 @dataclass
@@ -102,7 +106,8 @@ def _sort_by_device_policy(devices: list[DeviceUsage], policy: str) -> None:
 def _allocate_container(info: NodeInfo, req: AllocationRequest,
                         cont: ContainerRequest,
                         prefer_origin: tuple[int, int] | None,
-                        reasons: R.FailureReasons
+                        reasons: R.FailureReasons,
+                        prefer_uuids: set[str] | None = None
                         ) -> tuple[list[DeviceUsage], str, float]:
     candidates = _filter_devices(info, req, cont, reasons)
     if len(candidates) < cont.number:
@@ -139,12 +144,40 @@ def _allocate_container(info: NodeInfo, req: AllocationRequest,
             raise AllocationFailure(reasons)
 
     _sort_by_device_policy(candidates, req.device_policy)
+    if prefer_uuids:
+        # stable partition: preferred chips first, policy order within each
+        # group (init-container reuse — see allocate())
+        candidates.sort(key=lambda u: u.spec.uuid not in prefer_uuids)
     return (candidates[:cont.number], "any", 0.0)
+
+
+def _request_kinds(req: AllocationRequest
+                   ) -> tuple[dict[str, str], dict[str, int]]:
+    """The effective_claims classification, from the parsed request."""
+    kinds: dict[str, str] = {}
+    init_order: dict[str, int] = {}
+    for i, c in enumerate(req.init_containers):
+        kinds[c.name] = "sidecar" if c.is_sidecar else "init"
+        init_order[c.name] = i
+    for c in req.containers:
+        kinds[c.name] = "app"
+    return kinds, init_order
 
 
 def allocate(info: NodeInfo, req: AllocationRequest,
              prefer_origin: tuple[int, int] | None = None) -> AllocationResult:
     """Allocate every claiming container of the pod on this node.
+
+    Concurrent claimers (app containers + sidecars) are allocated first on
+    one working copy — their claims coexist, so charges accumulate. Plain
+    init containers are then allocated each on its own PHASE VIEW (other
+    pods + this pod's earlier-started sidecars only: apps are not running
+    yet and neither are the other inits), preferring chips the pod already
+    claimed — kubelet reuses a pod's device allocations across its init
+    and app containers, so reuse is free under peak accounting. The
+    result's node_info and `effective` carry the per-chip phase-peak
+    charge, not the sum (reference: init_container_vgpu_support_design.md
+    §3-4: per-physical-device lifecycle peaks).
 
     Raises AllocationFailure with aggregated reasons when the pod does not
     fit. On success returns the claims and the charged NodeInfo copy.
@@ -153,7 +186,7 @@ def allocate(info: NodeInfo, req: AllocationRequest,
     claims = PodDeviceClaims()
     kind = "any"
     score = 0.0
-    for cont in req.claiming_containers():
+    for cont in req.concurrent_claimers():
         reasons = R.FailureReasons()
         picked, k, s = _allocate_container(work, req, cont, prefer_origin,
                                            reasons)
@@ -166,5 +199,71 @@ def allocate(info: NodeInfo, req: AllocationRequest,
                                 memory=_effective_memory(usage, cont))
             claims.add(cont.name, claim)
             usage.assume(req.pod_uid, claim)
+
+    plain_inits = req.plain_init_claimers()
+    for cont in plain_inits:
+        view = info.clone()
+        for sidecar in req.sidecars_before(cont):
+            for claim in claims.container_claims(sidecar.name):
+                usage = view.devices.get(claim.uuid)
+                if usage is not None:
+                    usage.assume(req.pod_uid, claim)
+        # bias toward the pod's own chips: under peak accounting a reused
+        # chip costs only max(init, app) instead of opening a new one. For
+        # topology modes the bias rides prefer_origin — anchoring the init
+        # phase's submesh search at the app phase's origin keeps the
+        # rectangles coincident when capacity allows.
+        pod_chips = {c.uuid for c in claims.all_claims()}
+        init_origin = prefer_origin
+        if init_origin is None and pod_chips:
+            coords = [c.coords for c in info.registry.chips
+                      if c.uuid in pod_chips]
+            if coords:
+                init_origin = (min(c[0] for c in coords),
+                               min(c[1] for c in coords))
+        reasons = R.FailureReasons()
+        picked, _, _ = _allocate_container(view, req, cont, init_origin,
+                                           reasons,
+                                           prefer_uuids=pod_chips)
+        for usage in picked:
+            claim = DeviceClaim(uuid=usage.spec.uuid,
+                                host_index=usage.spec.index,
+                                cores=cont.cores,
+                                memory=_effective_memory(usage, cont))
+            claims.add(cont.name, claim)
+
+    # Annotation container order == kubelet's Allocate order (every init
+    # container in spec order, then app containers): the device plugin
+    # resolves ambiguous uuid-multiset matches by this order, which chip
+    # reuse across init/app phases makes common (same chips, same counts).
+    ordered = PodDeviceClaims()
+    for cont in list(req.init_containers) + list(req.containers):
+        for claim in claims.container_claims(cont.name):
+            ordered.add(cont.name, claim)
+    claims = ordered
+
+    if plain_inits:
+        kinds, init_order = _request_kinds(req)
+        effective = claims_effective(claims, kinds, init_order)
+        final = info.clone()
+        for claim in effective.all_claims():
+            usage = final.devices.get(claim.uuid)
+            if usage is not None:
+                usage.assume(req.pod_uid, claim)
+        # invariant check on the chips WE charged (each phase validated on
+        # its own view, so the per-chip max must fit; scanning unrelated
+        # chips would turn pre-existing drift on them into false rejects)
+        for uuid in {c.uuid for c in effective.all_claims()}:
+            usage = final.devices.get(uuid)
+            if usage is not None and (usage.free_cores < 0
+                                      or usage.free_memory < 0
+                                      or usage.free_number < 0):
+                reasons = R.FailureReasons()
+                reasons.add(R.NODE_INSUFFICIENT_CAPACITY, info.name)
+                raise AllocationFailure(reasons)
+        work = final
+    else:
+        effective = claims
     return AllocationResult(claims=claims, node_info=work,
-                            topology_kind=kind, score=score)
+                            topology_kind=kind, score=score,
+                            effective=effective)

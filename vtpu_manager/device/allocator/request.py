@@ -32,6 +32,7 @@ class ContainerRequest:
     cores: int           # % per chip
     memory: int          # bytes per chip (0 = proportional split share)
     is_init: bool = False
+    is_sidecar: bool = False   # restartable init (restartPolicy: Always)
 
     @property
     def total_cores(self) -> int:
@@ -65,27 +66,60 @@ class AllocationRequest:
     gang_size: int = 0
     gang_ordinal: int = -1
 
-    # -- aggregates (init-container lifecycle-aware: init containers run
-    # sequentially and release before the next starts, so the pod's gate is
-    # max(sum(regular), max(init)) — reference request.go Total/Max logic) --
+    # -- aggregates (init-container lifecycle-aware, the exact K8s
+    # PodRequests semantics the reference's init-container design adopts:
+    # plain init containers run sequentially and release before the next
+    # starts, while sidecars (restartable inits) run from their start
+    # onward, concurrent with later inits AND with the app phase. So the
+    # pod's gate per resource is
+    #   max( sum(apps) + sum(sidecars),
+    #        max over plain init_i ( init_i + sum(sidecars before i) ) )
+    # reference: init_container_vgpu_support_design.md §2 / request.go --
 
-    def claiming_containers(self) -> list[ContainerRequest]:
-        return [c for c in self.containers if c.number > 0]
+    def concurrent_claimers(self) -> list[ContainerRequest]:
+        """Containers whose claims coexist for the pod's whole app phase:
+        app containers plus sidecars."""
+        return ([c for c in self.containers if c.number > 0]
+                + [c for c in self.init_containers
+                   if c.is_sidecar and c.number > 0])
+
+    def plain_init_claimers(self) -> list[ContainerRequest]:
+        """Sequential init containers needing devices, in spec order."""
+        return [c for c in self.init_containers
+                if not c.is_sidecar and c.number > 0]
+
+    def sidecars_before(self, init: ContainerRequest
+                        ) -> list[ContainerRequest]:
+        """Sidecars already running when `init` starts (spec order)."""
+        out = []
+        for c in self.init_containers:
+            if c is init:
+                break
+            if c.is_sidecar and c.number > 0:
+                out.append(c)
+        return out
+
+    def _phase_peak(self, value) -> int:
+        sidecars_sum = sum(value(c) for c in self.init_containers
+                           if c.is_sidecar)
+        app_phase = sum(value(c) for c in self.containers) + sidecars_sum
+        peak = app_phase
+        running_sidecars = 0
+        for c in self.init_containers:
+            if c.is_sidecar:
+                running_sidecars += value(c)
+            else:
+                peak = max(peak, value(c) + running_sidecars)
+        return peak
 
     def total_number(self) -> int:
-        reg = sum(c.number for c in self.containers)
-        init = max((c.number for c in self.init_containers), default=0)
-        return max(reg, init)
+        return self._phase_peak(lambda c: c.number)
 
     def total_cores(self) -> int:
-        reg = sum(c.total_cores for c in self.containers)
-        init = max((c.total_cores for c in self.init_containers), default=0)
-        return max(reg, init)
+        return self._phase_peak(lambda c: c.total_cores)
 
     def total_memory(self) -> int:
-        reg = sum(c.total_memory for c in self.containers)
-        init = max((c.total_memory for c in self.init_containers), default=0)
-        return max(reg, init)
+        return self._phase_peak(lambda c: c.total_memory)
 
     def is_empty(self) -> bool:
         return self.total_number() == 0
@@ -137,8 +171,10 @@ def _container_request(cont: dict, is_init: bool) -> ContainerRequest:
             "without vtpu-number")
     if cores > 100:
         raise RequestError(f"vtpu-cores must be <=100, got {cores}")
-    return ContainerRequest(name=cont.get("name", ""), number=number,
-                            cores=cores, memory=mem_mib * MIB, is_init=is_init)
+    return ContainerRequest(
+        name=cont.get("name", ""), number=number, cores=cores,
+        memory=mem_mib * MIB, is_init=is_init,
+        is_sidecar=is_init and cont.get("restartPolicy") == "Always")
 
 
 def _csv(val: str | None) -> tuple[str, ...]:

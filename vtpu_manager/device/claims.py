@@ -95,6 +95,86 @@ class PodDeviceClaims:
         return out
 
 
+#: container name used for synthesized phase-peak charge entries — never a
+#: real container (real names are DNS labels, which cannot contain '<')
+EFFECTIVE_CONTAINER = "<effective>"
+
+
+def effective_claims(claims: PodDeviceClaims, kinds: dict[str, str],
+                     init_order: dict[str, int]) -> PodDeviceClaims:
+    """Phase-peak charge set for a pod whose claims span init containers.
+
+    Plain init containers run sequentially, each releasing before the next
+    starts and before any app container runs; sidecars (restartable inits)
+    run from their start onward. A chip's true footprint is therefore the
+    MAX over lifecycle phases, not the sum of all claims (reference:
+    init_container_vgpu_support_design.md §3 — per-physical-device phase
+    peaks replacing the scalar K8s max).
+
+    kinds: container -> "app" | "init" | "sidecar" (absent = app).
+    init_order: position of each (plain or sidecar) init container in
+    spec.initContainers, for the "sidecars started before init_i run
+    through its phase" rule.
+
+    Returns `claims` unchanged when no plain init container holds a claim
+    (pure-concurrent pods charge exactly); otherwise a synthesized claim
+    set under EFFECTIVE_CONTAINER whose per-chip sums equal the phase
+    peak, so every sum-based consumer (fast gate, NodeInfo, preempt)
+    charges correctly without knowing about phases."""
+    plain_inits = [n for n in claims.containers if kinds.get(n) == "init"]
+    if not plain_inits:
+        return claims
+    sidecars = [n for n in claims.containers if kinds.get(n) == "sidecar"]
+
+    def phase_totals(names):
+        per: dict[str, list[int]] = {}
+        for n in names:
+            for c in claims.container_claims(n):
+                agg = per.setdefault(c.uuid, [0, 0, 0, c.host_index])
+                agg[0] += 1
+                agg[1] += c.cores
+                agg[2] += c.memory
+        return per
+
+    concurrent = [n for n in claims.containers
+                  if kinds.get(n, "app") in ("app", "sidecar")]
+    phases = [phase_totals(concurrent)]
+    for init in plain_inits:
+        members = [init] + [
+            s for s in sidecars
+            if init_order.get(s, 1 << 30) < init_order.get(init, 0)]
+        phases.append(phase_totals(members))
+
+    eff: dict[str, list[int]] = {}
+    for per in phases:
+        for uuid, (n, c, m, host_index) in per.items():
+            cur = eff.setdefault(uuid, [0, 0, 0, host_index])
+            cur[0] = max(cur[0], n)
+            cur[1] = max(cur[1], c)
+            cur[2] = max(cur[2], m)
+
+    out = PodDeviceClaims()
+    for uuid, (n, c, m, host_index) in eff.items():
+        out.add(EFFECTIVE_CONTAINER, DeviceClaim(uuid, host_index, c, m))
+        for _ in range(n - 1):
+            out.add(EFFECTIVE_CONTAINER, DeviceClaim(uuid, host_index, 0, 0))
+    return out
+
+
+def container_kinds(pod_spec: dict) -> tuple[dict[str, str], dict[str, int]]:
+    """(kinds, init_order) for effective_claims, from a pod spec."""
+    kinds: dict[str, str] = {}
+    init_order: dict[str, int] = {}
+    for i, cont in enumerate(pod_spec.get("initContainers") or []):
+        name = cont.get("name", "")
+        kinds[name] = ("sidecar" if cont.get("restartPolicy") == "Always"
+                       else "init")
+        init_order[name] = i
+    for cont in pod_spec.get("containers") or []:
+        kinds[cont.get("name", "")] = "app"
+    return kinds, init_order
+
+
 def try_decode(value: str | None) -> PodDeviceClaims | None:
     """Decode, returning None for absent/malformed values (malformed
     annotations on resident pods must not wedge the scheduler; the reference

@@ -23,7 +23,9 @@ import json
 import time
 from dataclasses import dataclass, field, replace
 
-from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims, try_decode
+from vtpu_manager.device.claims import (DeviceClaim, PodDeviceClaims,
+                                        container_kinds, effective_claims,
+                                        try_decode)
 from vtpu_manager.util import consts
 
 _REG_PREFIX = "v1:"
@@ -268,6 +270,11 @@ def counted_claims(resident_pods: list[dict], now: float | None = None
         claims = get_pod_device_claims(pod)
         if claims is None:
             continue
+        # init-container claims charge the phase PEAK, not the sum — the
+        # pod dict carries the container classification the annotation
+        # doesn't (claims.py effective_claims)
+        kinds, init_order = container_kinds(pod.get("spec") or {})
+        claims = effective_claims(claims, kinds, init_order)
         out.append(((pod.get("metadata") or {}).get("uid", ""), claims))
     return out
 

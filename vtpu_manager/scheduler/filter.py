@@ -71,8 +71,8 @@ class FilterResult:
 @dataclass
 class _Assumed:
     node: str
-    claims: PodDeviceClaims
-    ts: float
+    claims: PodDeviceClaims   # phase-peak effective set (what capacity
+    ts: float                 # accounting must charge), not per-container
 
 
 class FilterPredicate:
@@ -324,7 +324,7 @@ class FilterPredicate:
                     gang.encode_origin(origin)
         self.client.patch_pod_annotations(
             meta.get("namespace", "default"), meta.get("name", ""), anns)
-        self._assume(meta.get("uid", ""), best.name, best.result.claims)
+        self._assume(meta.get("uid", ""), best.name, best.result.effective)
 
     def _emit_rejection_event(self, pod: dict, message: str) -> None:
         """One aggregated event per rejected pod (reference: reason.go)."""
