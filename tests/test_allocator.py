@@ -95,6 +95,35 @@ class TestMeshSelection:
         sel4 = select_submesh(chips, 4, mesh)
         assert sel4.kind == "rect"
 
+    def test_anchor_cells_pull_window_adjacent(self):
+        """With a sibling anchor, the selected window must be the one
+        touching it — even against the spread tie-break that would
+        otherwise push toward the far end of the mesh."""
+        reg = dt.fake_registry(8, mesh_shape=(1, 8))
+        anchor = {(0, 0, 0), (0, 1, 0)}
+        free = [c for c in reg.chips if c.coords not in anchor]
+        sel = select_submesh(free, 2, reg.mesh, binpack=False,
+                             anchor_cells=anchor)
+        assert sel is not None and sel.kind == "rect"
+        coords = sorted(c.coords for c in sel.chips)
+        assert coords == [(0, 2, 0), (0, 3, 0)], coords
+        # without the anchor the spread tie-break prefers the far end
+        sel2 = select_submesh(free, 2, reg.mesh, binpack=False)
+        assert sorted(c.coords for c in sel2.chips) != coords
+
+    def test_anchor_never_buys_worse_box_shape(self):
+        """The adjacency bonus is capped below one cube-ness step: a 2x2
+        square far from the anchor still beats a 1x4 strip touching it
+        (the square's ICI hop diameter is lower)."""
+        reg = dt.fake_registry(64, mesh_shape=(8, 8))
+        strip = {(0, y, 0) for y in range(1, 5)}       # touches anchor
+        square = {(x, y, 0) for x in (4, 5) for y in (4, 5)}
+        free = [c for c in reg.chips if c.coords in strip | square]
+        sel = select_submesh(free, 4, reg.mesh,
+                             anchor_cells={(0, 0, 0)})
+        assert sel is not None and sel.kind == "rect"
+        assert {c.coords for c in sel.chips} == square
+
     def test_duplicate_coords_do_not_crash(self):
         chips = [dt.fake_chip(i, coords=(0, 0, 0)) for i in range(4)]
         assert select_submesh(chips, 4, dt.MeshSpec((2, 2, 1))) is None

@@ -521,3 +521,90 @@ class TestCrossNodeGang:
                 gang.gang_origin_annotation()])
         # congruent windows: same origin on its own host's mesh
         assert origin2 == origin1, (origin1, origin2)
+
+    def test_same_node_gang_siblings_tile_adjacently(self):
+        """Two gang members sharing a node must land edge-adjacent on the
+        mesh so their collectives ride ICI — the same-node L0 case of the
+        reference's cross-pod NVLink design (siblings in one link
+        component), torus edition."""
+        client = FakeKubeClient()
+        # single node, 8x8 mesh. Sibling g1 is already committed (filter
+        # annotations, not yet bound — the gang-burst window) on a 2x2 at
+        # the LOW corner. For g2 two same-shape 2x2 options remain free:
+        # the cells right above g1 (edge-adjacent) and an island at the
+        # HIGH corner. The spread tie-break prefers the island's high
+        # origin, so only the sibling anchor makes g2 tile adjacently
+        # (verified: disabling sibling_anchor_cells fails this test).
+        reg = dt.fake_registry(64, mesh_shape=(8, 8))
+        client.add_node(dt.fake_node("host-0", reg))
+        by_cell = {c.coords: c for c in reg.chips}
+        g1_cells = {(x, y, 0) for x in (0, 1) for y in (0, 1)}
+        near = {(x, y, 0) for x in (0, 1) for y in (2, 3)}
+        island = {(x, y, 0) for x in (6, 7) for y in (6, 7)}
+        g1_claims = PodDeviceClaims()
+        for cell in sorted(g1_cells):
+            chip = by_cell[cell]
+            g1_claims.add("main", DeviceClaim(chip.uuid, chip.index, 60,
+                                              2**30))
+        g1 = vtpu_pod(name="g1", cores=60, node_name="host-0",
+                      annotations={
+            consts.gang_name_annotation(): "pair",
+            consts.real_allocated_annotation(): g1_claims.encode(),
+        })
+        g1["status"]["phase"] = "Running"
+        client.add_pod(g1)
+        filler_claims = PodDeviceClaims()
+        for chip in reg.chips:
+            if chip.coords not in g1_cells | near | island:
+                filler_claims.add("c", DeviceClaim(chip.uuid, chip.index,
+                                                   60, 2**30))
+        filler = vtpu_pod(name="filler", node_name="host-0", annotations={
+            consts.real_allocated_annotation(): filler_claims.encode()})
+        filler["status"]["phase"] = "Running"
+        client.add_pod(filler)
+        pred = FilterPredicate(client)
+
+        m2 = vtpu_pod(name="g2", number=4, cores=60, annotations={
+            consts.gang_name_annotation(): "pair",
+            consts.gang_size_annotation(): "2",
+            consts.topology_mode_annotation(): "ici",
+            consts.device_policy_annotation(): "spread"})
+        client.add_pod(m2)
+        r2 = pred.filter({"Pod": m2})
+        assert not r2.error
+
+        by_uuid = reg.chip_by_uuid()
+
+        def cells_of_ann(pod_name, ann):
+            claims = PodDeviceClaims.decode(
+                client.get_pod("default", pod_name)["metadata"][
+                    "annotations"][ann])
+            return {by_uuid[c.uuid].coords for c in claims.all_claims()}
+
+        c1 = cells_of_ann("g1", consts.real_allocated_annotation())
+        c2 = cells_of_ann("g2", consts.pre_allocated_annotation())
+        assert not (c1 & c2)
+        # edge-adjacent: some pair of cells at manhattan distance 1
+        assert any(
+            sum(abs(a[i] - b[i]) for i in range(3)) == 1
+            for a in c1 for b in c2), (sorted(c1), sorted(c2))
+
+    def test_anchor_sees_committed_but_unbound_siblings(self):
+        """During a gang burst the sibling that matters is committed via
+        annotations but carries no nodeName yet — attribution must ride
+        the predicate-node annotation (in the live path its capacity is
+        covered by the assumed cache of the same predicate)."""
+        reg = dt.fake_registry(16, mesh_shape=(4, 4))
+        chip = reg.chips[0]
+        claims = PodDeviceClaims()
+        claims.add("main", DeviceClaim(chip.uuid, chip.index, 60, 2**30))
+        unbound = vtpu_pod(name="gb", cores=60, annotations={
+            consts.gang_name_annotation(): "burst",
+            consts.pre_allocated_annotation(): claims.encode(),
+            consts.predicate_node_annotation(): "host-0",
+        })
+        cells = gang.sibling_anchor_cells("burst", "host-0", [unbound], reg)
+        assert cells == {chip.coords}
+        # a different node resolves nothing
+        assert gang.sibling_anchor_cells("burst", "host-9",
+                                         [unbound], reg) is None

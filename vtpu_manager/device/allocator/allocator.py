@@ -107,7 +107,8 @@ def _allocate_container(info: NodeInfo, req: AllocationRequest,
                         cont: ContainerRequest,
                         prefer_origin: tuple[int, int] | None,
                         reasons: R.FailureReasons,
-                        prefer_uuids: set[str] | None = None
+                        prefer_uuids: set[str] | None = None,
+                        anchor_cells: set | None = None
                         ) -> tuple[list[DeviceUsage], str, float]:
     candidates = _filter_devices(info, req, cont, reasons)
     if len(candidates) < cont.number:
@@ -123,7 +124,8 @@ def _allocate_container(info: NodeInfo, req: AllocationRequest,
         sel: MeshSelection | None = select_submesh(
             free_specs, cont.number, info.registry.mesh,
             prefer_origin=prefer_origin,
-            binpack=req.device_policy == consts.DEVICE_POLICY_BINPACK)
+            binpack=req.device_policy == consts.DEVICE_POLICY_BINPACK,
+            anchor_cells=anchor_cells)
         if sel is not None and (sel.kind == "rect" or not strict):
             by_uuid = {u.spec.uuid: u for u in candidates}
             return ([by_uuid[c.uuid] for c in sel.chips], sel.kind, sel.score)
@@ -165,7 +167,8 @@ def _request_kinds(req: AllocationRequest
 
 
 def allocate(info: NodeInfo, req: AllocationRequest,
-             prefer_origin: tuple[int, int] | None = None) -> AllocationResult:
+             prefer_origin: tuple[int, int] | None = None,
+             anchor_cells: set | None = None) -> AllocationResult:
     """Allocate every claiming container of the pod on this node.
 
     Concurrent claimers (app containers + sidecars) are allocated first on
@@ -189,7 +192,8 @@ def allocate(info: NodeInfo, req: AllocationRequest,
     for cont in req.concurrent_claimers():
         reasons = R.FailureReasons()
         picked, k, s = _allocate_container(work, req, cont, prefer_origin,
-                                           reasons)
+                                           reasons,
+                                           anchor_cells=anchor_cells)
         if k != "any":
             kind, score = k, max(score, s)
         for usage in picked:
@@ -224,7 +228,8 @@ def allocate(info: NodeInfo, req: AllocationRequest,
         reasons = R.FailureReasons()
         picked, _, _ = _allocate_container(view, req, cont, init_origin,
                                            reasons,
-                                           prefer_uuids=pod_chips)
+                                           prefer_uuids=pod_chips,
+                                           anchor_cells=anchor_cells)
         for usage in picked:
             claim = DeviceClaim(uuid=usage.spec.uuid,
                                 host_index=usage.spec.index,

@@ -88,9 +88,25 @@ def _pairwise_manhattan(cells: list[Cell], mesh: MeshSpec) -> int:
     return total
 
 
+def _min_dist_to_anchor(cells: list[Cell], anchor: set[Cell],
+                        mesh: MeshSpec) -> int:
+    """Smallest torus-manhattan distance from any window cell to any anchor
+    cell (1 = edge-adjacent: the windows share an ICI link)."""
+    best = 1 << 30
+    for c in cells:
+        for a in anchor:
+            d = sum(_axis_dist(c[i], a[i], mesh.shape[i], mesh.wrap[i])
+                    for i in range(3))
+            if d < best:
+                best = d
+    return best
+
+
 def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                    prefer_origin: tuple[int, int] | None = None,
-                   binpack: bool = True) -> MeshSelection | None:
+                   binpack: bool = True,
+                   anchor_cells: set[Cell] | None = None
+                   ) -> MeshSelection | None:
     """Choose n chips from free_chips forming the best sub-mesh.
 
     prefer_origin: gang alignment hint (x,y) — among free boxes, prefer one
@@ -98,6 +114,15 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
     allocator.go:379-660: siblings of a gang pick link-aligned rails; here
     siblings pick congruent mesh windows on their own hosts so inter-host
     ICI neighbors line up).
+
+    anchor_cells: coords already held by same-gang siblings on THIS node
+    (the same-node cross-pod case, reference
+    cross_pod_nvlink_topology_design.md L0: siblings must land in one
+    NVLink component or their collectives fall off the fabric; on a torus
+    the analogue is an edge-adjacent window — gang traffic then rides ICI
+    instead of host PCIe/DCN). Among equally-shaped free boxes, the one
+    closest to the anchor wins; the bonus is capped below one cube-ness
+    step, so it never trades a worse box shape for adjacency.
 
     Returns None when fewer than n chips are free.
     """
@@ -119,11 +144,19 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                         continue
                     if any(c not in by_cell for c in cells):
                         continue
-                    # Exact free box. Score: cube-ness, alignment, anchoring.
+                    # Exact free box. Score: cube-ness, alignment,
+                    # sibling adjacency, anchoring.
                     score = 1000.0 - (max(shape) - min(shape)) * 10
                     if prefer_origin is not None and \
                             (ox, oy) == tuple(prefer_origin):
                         score += 100
+                    if anchor_cells:
+                        # capped below the 10-point cube-ness step: the
+                        # adjacency bonus breaks ties among equal shapes
+                        # but never buys a worse box (higher ICI diameter)
+                        dist = _min_dist_to_anchor(cells, anchor_cells,
+                                                   mesh)
+                        score += max(0.0, 8.0 - 1.0 * (dist - 1))
                     anchor = (ox + oy + oz) * 0.01
                     score += -anchor if binpack else anchor
                     if best is None or score > best[0]:
@@ -133,7 +166,7 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
 
     # Greedy fallback: grow the most compact cluster from each seed.
     cells = list(by_cell)
-    best_greedy: tuple[int, list[ChipSpec]] | None = None
+    best_greedy: tuple[float, list[ChipSpec]] | None = None
     for seed in cells:
         chosen = [seed]
         remaining = [c for c in cells if c != seed]
@@ -141,7 +174,9 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
             remaining.sort(key=lambda c: min(
                 _pairwise_manhattan([c, ch], mesh) for ch in chosen))
             chosen.append(remaining.pop(0))
-        cost = _pairwise_manhattan(chosen, mesh)
+        cost = float(_pairwise_manhattan(chosen, mesh))
+        if anchor_cells:
+            cost += _min_dist_to_anchor(chosen, anchor_cells, mesh)
         if best_greedy is None or cost < best_greedy[0]:
             best_greedy = (cost, [by_cell[c] for c in chosen])
     assert best_greedy is not None

@@ -285,9 +285,18 @@ class FilterPredicate:
             info = NodeInfo.from_registry(name, registry, counted)
             for uid, entry in assumed:
                 info.assume_pod(uid, entry.claims)
+            # same-node siblings anchor the submesh search so a gang
+            # sharing a node tiles contiguously on the mesh (cross-pod
+            # ICI adjacency — the L0 NVLink-component analogue); resolved
+            # over ALL pods because burst siblings are committed via
+            # annotations before they carry a nodeName
+            anchor = gang.sibling_anchor_cells(
+                req.gang_name, name, all_pods, registry) \
+                if req.gang_name else None
             try:
                 alloc_result = allocate(info, req,
-                                        prefer_origin=prefer_origin)
+                                        prefer_origin=prefer_origin,
+                                        anchor_cells=anchor)
             except AllocationFailure as f:
                 why = f.reasons.summary() or "allocation failed"
                 result.failed_nodes[name] = why

@@ -62,3 +62,40 @@ def chosen_origin(info: NodeInfo, claims) -> tuple[int, int] | None:
     if not coords:
         return None
     return (min(c[0] for c in coords), min(c[1] for c in coords))
+
+
+def sibling_anchor_cells(gang_name: str, node_name: str,
+                         all_pods: list[dict], registry) -> set | None:
+    """Mesh cells held by same-gang siblings already placed on THIS node —
+    the anchor for same-node cross-pod adjacency (reference
+    cross_pod_nvlink_topology_design.md L0: a sibling pair split across
+    NVLink components loses the fabric; the torus analogue is landing the
+    next sibling's window edge-adjacent so gang collectives stay on ICI).
+
+    Placement is attributed by spec.nodeName OR the predicate-node
+    annotation: during a gang burst the siblings that matter most are
+    committed (annotations patched) but not yet bound — nodeName alone
+    would miss exactly them and the anchor would never fire.
+    """
+    if not gang_name:
+        return None
+    from vtpu_manager.device.types import get_pod_device_claims
+    by_uuid = registry.chip_by_uuid()
+    cells = set()
+    for pod in all_pods:
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        if anns.get(consts.gang_name_annotation()) != gang_name:
+            continue
+        on_node = ((pod.get("spec") or {}).get("nodeName") == node_name
+                   or anns.get(consts.predicate_node_annotation())
+                   == node_name)
+        if not on_node:
+            continue
+        claims = get_pod_device_claims(pod)
+        if claims is None:
+            continue
+        for claim in claims.all_claims():
+            chip = by_uuid.get(claim.uuid)
+            if chip is not None:
+                cells.add(chip.coords)
+    return cells or None
