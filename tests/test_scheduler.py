@@ -589,6 +589,56 @@ class TestCrossNodeGang:
             sum(abs(a[i] - b[i]) for i in range(3)) == 1
             for a in c1 for b in c2), (sorted(c1), sorted(c2))
 
+    def test_gang_prefers_siblings_mesh_domain(self):
+        """L2 cross-node affinity: a gang member lands in the multi-host
+        ICI domain its siblings occupy even when an off-slice node scores
+        better on packing — off-slice members pay DCN for every gang
+        collective (reference multinode topology analysis)."""
+        client = FakeKubeClient()
+        for name, domain in (("host-s", "slice-1"), ("host-a", "slice-1"),
+                             ("host-b", "slice-2")):
+            reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                                   uuid_prefix=name.upper())
+            reg.mesh_domain = domain
+            client.add_node(dt.fake_node(name, reg))
+        # sibling runs on host-s (slice-1) and fills it completely
+        s_reg = dt.NodeDeviceRegistry.decode(
+            client.get_node("host-s")["metadata"]["annotations"][
+                consts.node_device_register_annotation()])
+        sib_claims = PodDeviceClaims()
+        for chip in s_reg.chips:
+            sib_claims.add("main", DeviceClaim(chip.uuid, chip.index, 90,
+                                               2**30))
+        sib = vtpu_pod(name="gs", cores=90, node_name="host-s",
+                       annotations={
+                           consts.gang_name_annotation(): "ring",
+                           consts.real_allocated_annotation():
+                               sib_claims.encode()})
+        sib["status"]["phase"] = "Running"
+        client.add_pod(sib)
+        # host-a (slice-1) is partially used; host-b (slice-2) is empty,
+        # so spread packing alone would pick host-b
+        a_reg = dt.NodeDeviceRegistry.decode(
+            client.get_node("host-a")["metadata"]["annotations"][
+                consts.node_device_register_annotation()])
+        filler_claims = PodDeviceClaims()
+        for chip in a_reg.chips[:2]:
+            filler_claims.add("c", DeviceClaim(chip.uuid, chip.index, 50,
+                                               2**30))
+        filler = vtpu_pod(name="af", node_name="host-a", annotations={
+            consts.real_allocated_annotation(): filler_claims.encode()})
+        filler["status"]["phase"] = "Running"
+        client.add_pod(filler)
+
+        pred = FilterPredicate(client)
+        m2 = vtpu_pod(name="gm2", number=1, cores=30, annotations={
+            consts.gang_name_annotation(): "ring",
+            consts.node_policy_annotation(): "spread"})
+        client.add_pod(m2)
+        r = pred.filter({"Pod": m2})
+        assert not r.error
+        assert r.node_names == ["host-a"], r.node_names
+
     def test_anchor_sees_committed_but_unbound_siblings(self):
         """During a gang burst the sibling that matters is committed via
         annotations but carries no nodeName yet — attribution must ride
@@ -603,8 +653,15 @@ class TestCrossNodeGang:
             consts.pre_allocated_annotation(): claims.encode(),
             consts.predicate_node_annotation(): "host-0",
         })
-        cells = gang.sibling_anchor_cells("burst", "host-0", [unbound], reg)
+        sibs = gang.live_siblings("burst", "uid-self", [unbound])
+        cells = gang.sibling_anchor_cells("burst", "host-0", sibs, reg)
         assert cells == {chip.coords}
         # a different node resolves nothing
         assert gang.sibling_anchor_cells("burst", "host-9",
-                                         [unbound], reg) is None
+                                         sibs, reg) is None
+        # the pod being scheduled never anchors to its own commitment
+        assert gang.live_siblings("burst", unbound["metadata"]["uid"],
+                                  [unbound]) == []
+        # a Failed member's lingering annotations stop counting
+        dead = dict(unbound, status={"phase": "Failed"})
+        assert gang.live_siblings("burst", "uid-self", [dead]) == []

@@ -153,9 +153,11 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                     if anchor_cells:
                         # capped below the 10-point cube-ness step: the
                         # adjacency bonus breaks ties among equal shapes
-                        # but never buys a worse box (higher ICI diameter)
-                        dist = _min_dist_to_anchor(cells, anchor_cells,
-                                                   mesh)
+                        # but never buys a worse box (higher ICI diameter).
+                        # dist clamps to >=1 so a window OVERLAPPING stale
+                        # anchor cells never outranks a truly adjacent one
+                        dist = max(1, _min_dist_to_anchor(
+                            cells, anchor_cells, mesh))
                         score += max(0.0, 8.0 - 1.0 * (dist - 1))
                     anchor = (ox + oy + oz) * 0.01
                     score += -anchor if binpack else anchor

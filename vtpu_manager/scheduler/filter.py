@@ -233,8 +233,31 @@ class FilterPredicate:
         all_pods, by_node = self._list_pods()
 
         prefer_origin = None
+        gang_domains: set[str] = set()
+        gang_siblings: list[dict] = []
         if req.gang_name:
             prefer_origin = gang.resolve_gang_origin(req.gang_name, all_pods)
+            # Siblings resolved ONCE per pass (not per candidate node —
+            # the cluster pod list is the 100k-scale structure here),
+            # excluding this pod itself and members that no longer count.
+            gang_siblings = gang.live_siblings(
+                req.gang_name, (pod.get("metadata") or {}).get("uid", ""),
+                all_pods)
+            # L2 cross-node affinity: domains the gang already occupies.
+            # Domain lookup is bounded to the nodes this call can see; a
+            # sibling on a node outside the candidate list contributes no
+            # signal (bias degrades to none, never to a wrong bias).
+            domain_by_node = {}
+            for node in nodes:
+                meta = node.get("metadata") or {}
+                reg = dt.decode_registry(
+                    (meta.get("annotations") or {}).get(
+                        consts.node_device_register_annotation()))
+                if reg is not None and reg.mesh_domain:
+                    domain_by_node[meta.get("name", "")] = reg.mesh_domain
+            gang_domains = gang.sibling_domains(req.gang_name,
+                                                gang_siblings,
+                                                domain_by_node)
 
         # Gate + rank every surviving node on fast free totals (memoized
         # registry totals minus claim sums — no DeviceUsage materialized),
@@ -287,12 +310,12 @@ class FilterPredicate:
                 info.assume_pod(uid, entry.claims)
             # same-node siblings anchor the submesh search so a gang
             # sharing a node tiles contiguously on the mesh (cross-pod
-            # ICI adjacency — the L0 NVLink-component analogue); resolved
-            # over ALL pods because burst siblings are committed via
-            # annotations before they carry a nodeName
+            # ICI adjacency — the L0 NVLink-component analogue); burst
+            # siblings are attributed via the predicate-node annotation
+            # because they are committed before they carry a nodeName
             anchor = gang.sibling_anchor_cells(
-                req.gang_name, name, all_pods, registry) \
-                if req.gang_name else None
+                req.gang_name, name, gang_siblings, registry) \
+                if gang_siblings else None
             try:
                 alloc_result = allocate(info, req,
                                         prefer_origin=prefer_origin,
@@ -302,8 +325,13 @@ class FilterPredicate:
                 result.failed_nodes[name] = why
                 reasons.add(why.split(";")[0].split(" x")[0], name)
                 continue
-            scored.append(ScoredNode(name, node_score(alloc_result, req),
-                                     alloc_result))
+            score = node_score(alloc_result, req)
+            if gang_domains and registry.mesh_domain in gang_domains:
+                # keeping the gang on one multi-host slice outweighs any
+                # per-node topology/packing difference: a member placed
+                # off-slice pays DCN for every gang collective
+                score += 100.0
+            scored.append(ScoredNode(name, score, alloc_result))
 
         if not scored:
             result.error = reasons.summary() or "no schedulable vtpu node"

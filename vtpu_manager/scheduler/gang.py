@@ -64,8 +64,60 @@ def chosen_origin(info: NodeInfo, claims) -> tuple[int, int] | None:
     return (min(c[0] for c in coords), min(c[1] for c in coords))
 
 
+def live_siblings(gang_name: str, self_uid: str,
+                  all_pods: list[dict]) -> list[dict]:
+    """Gang members that still COUNT: same gang annotation, not the pod
+    being scheduled itself (a re-filtered committed pod must not anchor
+    to its own stale pre-allocation), and alive by the same
+    should_count_pod rule capacity accounting uses (a Failed member's
+    lingering annotations must not pull the replacement to its old
+    slice). Resolved ONCE per filter pass — the per-node helpers below
+    take this small list, not the cluster pod list."""
+    if not gang_name:
+        return []
+    from vtpu_manager.device.types import should_count_pod
+    out = []
+    for pod in all_pods:
+        meta = pod.get("metadata") or {}
+        if meta.get("uid", "") == self_uid:
+            continue
+        anns = meta.get("annotations") or {}
+        if anns.get(consts.gang_name_annotation()) != gang_name:
+            continue
+        if not should_count_pod(pod):
+            continue
+        out.append(pod)
+    return out
+
+
+def sibling_node_names(gang_name: str, siblings: list[dict]) -> set[str]:
+    """Nodes hosting (or committed to host) members of the gang."""
+    out = set()
+    if not gang_name:
+        return out
+    for pod in siblings:
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        node = ((pod.get("spec") or {}).get("nodeName")
+                or anns.get(consts.predicate_node_annotation()))
+        if node:
+            out.add(node)
+    return out
+
+
+def sibling_domains(gang_name: str, siblings: list[dict],
+                    domain_by_node: dict[str, str]) -> set[str]:
+    """ICI mesh domains the gang already occupies — the L2 cross-node
+    affinity signal (reference multinode_topology_aware_scheduling
+    _analysis.md: after L0 intra-node adjacency, cluster gang members
+    onto one multi-host slice; members split across domains pay DCN for
+    every collective). domain_by_node: node -> mesh_domain ('' = none)."""
+    return {d for d in (domain_by_node.get(n, "")
+                        for n in sibling_node_names(gang_name, siblings))
+            if d}
+
+
 def sibling_anchor_cells(gang_name: str, node_name: str,
-                         all_pods: list[dict], registry) -> set | None:
+                         siblings: list[dict], registry) -> set | None:
     """Mesh cells held by same-gang siblings already placed on THIS node —
     the anchor for same-node cross-pod adjacency (reference
     cross_pod_nvlink_topology_design.md L0: a sibling pair split across
@@ -75,17 +127,16 @@ def sibling_anchor_cells(gang_name: str, node_name: str,
     Placement is attributed by spec.nodeName OR the predicate-node
     annotation: during a gang burst the siblings that matter most are
     committed (annotations patched) but not yet bound — nodeName alone
-    would miss exactly them and the anchor would never fire.
+    would miss exactly them and the anchor would never fire. `siblings`
+    is the pre-resolved live_siblings() list.
     """
     if not gang_name:
         return None
     from vtpu_manager.device.types import get_pod_device_claims
     by_uuid = registry.chip_by_uuid()
     cells = set()
-    for pod in all_pods:
+    for pod in siblings:
         anns = (pod.get("metadata") or {}).get("annotations") or {}
-        if anns.get(consts.gang_name_annotation()) != gang_name:
-            continue
         on_node = ((pod.get("spec") or {}).get("nodeName") == node_name
                    or anns.get(consts.predicate_node_annotation())
                    == node_name)
