@@ -296,6 +296,25 @@ def main() -> int:
             "value": round(mae, 2), "unit": "percent",
             "vs_baseline": round(mae / BASELINE_AIMD_MAE, 3)}
     line.update(overhead)
+    if "ms_per_step_shim" not in overhead:
+        # hermetic run (no healthy TPU this invocation): label it so the
+        # number is never mistaken for a TPU measurement, and point at the
+        # committed real-hardware capture when present
+        line["hermetic"] = True
+        cap_path = os.path.join(REPO, "BENCH_TPU_CAPTURE_r02.json")
+        if os.path.exists(cap_path):
+            try:
+                with open(cap_path) as f:
+                    cap = json.load(f)
+                line["real_tpu_capture"] = {
+                    "file": os.path.basename(cap_path),
+                    "value": cap.get("value"),
+                    "vs_baseline": cap.get("vs_baseline"),
+                    "shim_overhead_pct": cap.get("shim_overhead_pct"),
+                    "date": cap.get("date"),
+                }
+            except (OSError, ValueError):
+                pass
     print(json.dumps(line))
     return 0
 

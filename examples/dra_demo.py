@@ -136,7 +136,51 @@ def run(tmp: str) -> int:
     session.close()
     runtime_srv.stop()
 
-    print("== 5. unprepare cleans up")
+    print("== 5. multi-container claim: two requests, two containers")
+    multi = {
+        "metadata": {"uid": "claim-mc", "name": "shared", "namespace": "ml"},
+        "status": {"allocation": {"devices": {
+            "results": [
+                {"request": "train", "driver": consts.DRA_DRIVER_NAME,
+                 "pool": "node-demo", "device": "vtpu-0"},
+                {"request": "eval", "driver": consts.DRA_DRIVER_NAME,
+                 "pool": "node-demo", "device": "vtpu-1"},
+            ],
+            "config": [
+                {"requests": ["train"], "opaque": {
+                    "driver": consts.DRA_DRIVER_NAME,
+                    "parameters": {"cores": 60, "memoryMiB": 4096}}},
+                {"requests": ["eval"], "opaque": {
+                    "driver": consts.DRA_DRIVER_NAME,
+                    "parameters": {"cores": 30, "memoryMiB": 2048}}},
+            ]}}},
+    }
+    source.local["claim-mc"] = multi
+    with grpc.insecure_channel(f"unix://{driver.socket_path}") as chan:
+        prep = chan.unary_unary(
+            "/v1beta1dra.DRAPlugin/NodePrepareResources",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=(
+                pb.NodePrepareResourcesResponse.FromString))
+        resp = prep(pb.NodePrepareResourcesRequest(claims=[
+            pb.Claim(uid="claim-mc", name="shared", namespace="ml")]),
+            timeout=10)
+    entry = resp.claims["claim-mc"]
+    assert not entry.error, entry.error
+    for dev in entry.devices:
+        if dev.cdi_device_ids:
+            print(f"   request {list(dev.requests)} -> "
+                  f"{list(dev.cdi_device_ids)}")
+    t_cfg = vc.read_config(
+        f"{tmp}/mgr/claim_claim-mc/config_train/vtpu.config")
+    e_cfg = vc.read_config(
+        f"{tmp}/mgr/claim_claim-mc/config_eval/vtpu.config")
+    print(f"   trainer sees chip {t_cfg.devices[0].host_index} at "
+          f"{t_cfg.devices[0].hard_core}%; evaluator sees chip "
+          f"{e_cfg.devices[0].host_index} at {e_cfg.devices[0].hard_core}%")
+    state.unprepare_claim("claim-mc")
+
+    print("== 6. unprepare cleans up")
     state.unprepare_claim("claim-demo")
     driver.stop()
     assert state.prepared_uids() == set()

@@ -154,10 +154,20 @@ class TestFilter:
 
     def test_gang_origin_alignment(self, cluster):
         pred = FilterPredicate(cluster)
+        # a real committed sibling always carries claims alongside its
+        # gang-origin annotation (live_siblings drops claimless ghosts)
+        reg1 = dt.NodeDeviceRegistry.decode(
+            cluster.get_node("node-1")["metadata"]["annotations"][
+                consts.node_device_register_annotation()])
+        sib_claims = PodDeviceClaims()
+        sib_claims.add("main", DeviceClaim(reg1.chips[3].uuid, 3, 25,
+                                           2**30))
         sib_ann = {consts.gang_name_annotation(): "g1",
-                   gang.gang_origin_annotation(): "1,1"}
+                   gang.gang_origin_annotation(): "1,1",
+                   consts.real_allocated_annotation(): sib_claims.encode()}
         sibling = vtpu_pod(name="sib", annotations=sib_ann,
                            node_name="node-1")
+        sibling["status"]["phase"] = "Running"
         cluster.add_pod(sibling)
         pod = vtpu_pod(name="member2", number=1, annotations={
             consts.gang_name_annotation(): "g1",
@@ -630,7 +640,11 @@ class TestCrossNodeGang:
         filler["status"]["phase"] = "Running"
         client.add_pod(filler)
 
-        pred = FilterPredicate(client)
+        # candidate_limit=1: the emptier off-slice host-b ranks first on
+        # spread capacity, so only rank-order protection (domain nodes
+        # walk first) gets host-a scored at all — the +100 alone cannot
+        # rescue a node truncation never visits
+        pred = FilterPredicate(client, candidate_limit=1)
         m2 = vtpu_pod(name="gm2", number=1, cores=30, annotations={
             consts.gang_name_annotation(): "ring",
             consts.node_policy_annotation(): "spread"})
@@ -654,11 +668,10 @@ class TestCrossNodeGang:
             consts.predicate_node_annotation(): "host-0",
         })
         sibs = gang.live_siblings("burst", "uid-self", [unbound])
-        cells = gang.sibling_anchor_cells("burst", "host-0", sibs, reg)
+        cells = gang.sibling_anchor_cells("host-0", sibs, reg)
         assert cells == {chip.coords}
         # a different node resolves nothing
-        assert gang.sibling_anchor_cells("burst", "host-9",
-                                         sibs, reg) is None
+        assert gang.sibling_anchor_cells("host-9", sibs, reg) is None
         # the pod being scheduled never anchors to its own commitment
         assert gang.live_siblings("burst", unbound["metadata"]["uid"],
                                   [unbound]) == []

@@ -236,13 +236,16 @@ class FilterPredicate:
         gang_domains: set[str] = set()
         gang_siblings: list[dict] = []
         if req.gang_name:
-            prefer_origin = gang.resolve_gang_origin(req.gang_name, all_pods)
             # Siblings resolved ONCE per pass (not per candidate node —
             # the cluster pod list is the 100k-scale structure here),
-            # excluding this pod itself and members that no longer count.
+            # excluding this pod itself and members that no longer count;
+            # every gang signal below (origin, domains, anchors) derives
+            # from this one list so a dead member cannot bias any of them.
             gang_siblings = gang.live_siblings(
                 req.gang_name, (pod.get("metadata") or {}).get("uid", ""),
                 all_pods)
+            prefer_origin = gang.resolve_gang_origin(req.gang_name,
+                                                     gang_siblings)
             # L2 cross-node affinity: domains the gang already occupies.
             # Domain lookup is bounded to the nodes this call can see; a
             # sibling on a node outside the candidate list contributes no
@@ -255,8 +258,7 @@ class FilterPredicate:
                         consts.node_device_register_annotation()))
                 if reg is not None and reg.mesh_domain:
                     domain_by_node[meta.get("name", "")] = reg.mesh_domain
-            gang_domains = gang.sibling_domains(req.gang_name,
-                                                gang_siblings,
+            gang_domains = gang.sibling_domains(gang_siblings,
                                                 domain_by_node)
 
         # Gate + rank every surviving node on fast free totals (memoized
@@ -290,9 +292,15 @@ class FilterPredicate:
                 continue
             ranked.append((free_cores + (free_memory >> 24) + free_number,
                            name, registry, counted, assumed))
-        # binpack wants the least-free node first, spread the most-free
-        ranked.sort(key=lambda t: (t[0], t[1]),
-                    reverse=req.node_policy == consts.NODE_POLICY_SPREAD)
+        # binpack wants the least-free node first, spread the most-free.
+        # Gang-domain nodes walk FIRST regardless: the +100 scoring bonus
+        # is useless if candidate_limit truncation never visits them (a
+        # sibling's partially-used slice sorts last under spread on a big
+        # cluster — exactly the node that must be scored).
+        spread = req.node_policy == consts.NODE_POLICY_SPREAD
+        ranked.sort(key=lambda t: (t[0], t[1]), reverse=spread)
+        if gang_domains:
+            ranked.sort(key=lambda t: t[2].mesh_domain not in gang_domains)
 
         # Full allocation on the top-K ranked nodes; if NONE of them fit
         # (the capacity rank is blind to topology/uuid constraints), keep
@@ -314,8 +322,7 @@ class FilterPredicate:
             # siblings are attributed via the predicate-node annotation
             # because they are committed before they carry a nodeName
             anchor = gang.sibling_anchor_cells(
-                req.gang_name, name, gang_siblings, registry) \
-                if gang_siblings else None
+                name, gang_siblings, registry) if gang_siblings else None
             try:
                 alloc_result = allocate(info, req,
                                         prefer_origin=prefer_origin,

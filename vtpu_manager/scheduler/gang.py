@@ -90,11 +90,10 @@ def live_siblings(gang_name: str, self_uid: str,
     return out
 
 
-def sibling_node_names(gang_name: str, siblings: list[dict]) -> set[str]:
-    """Nodes hosting (or committed to host) members of the gang."""
+def sibling_node_names(siblings: list[dict]) -> set[str]:
+    """Nodes hosting (or committed to host) members of the gang
+    (`siblings` is a pre-resolved live_siblings() list)."""
     out = set()
-    if not gang_name:
-        return out
     for pod in siblings:
         anns = (pod.get("metadata") or {}).get("annotations") or {}
         node = ((pod.get("spec") or {}).get("nodeName")
@@ -104,7 +103,7 @@ def sibling_node_names(gang_name: str, siblings: list[dict]) -> set[str]:
     return out
 
 
-def sibling_domains(gang_name: str, siblings: list[dict],
+def sibling_domains(siblings: list[dict],
                     domain_by_node: dict[str, str]) -> set[str]:
     """ICI mesh domains the gang already occupies — the L2 cross-node
     affinity signal (reference multinode_topology_aware_scheduling
@@ -112,12 +111,12 @@ def sibling_domains(gang_name: str, siblings: list[dict],
     onto one multi-host slice; members split across domains pay DCN for
     every collective). domain_by_node: node -> mesh_domain ('' = none)."""
     return {d for d in (domain_by_node.get(n, "")
-                        for n in sibling_node_names(gang_name, siblings))
+                        for n in sibling_node_names(siblings))
             if d}
 
 
-def sibling_anchor_cells(gang_name: str, node_name: str,
-                         siblings: list[dict], registry) -> set | None:
+def sibling_anchor_cells(node_name: str, siblings: list[dict],
+                         registry) -> set | None:
     """Mesh cells held by same-gang siblings already placed on THIS node —
     the anchor for same-node cross-pod adjacency (reference
     cross_pod_nvlink_topology_design.md L0: a sibling pair split across
@@ -130,8 +129,6 @@ def sibling_anchor_cells(gang_name: str, node_name: str,
     would miss exactly them and the anchor would never fire. `siblings`
     is the pre-resolved live_siblings() list.
     """
-    if not gang_name:
-        return None
     from vtpu_manager.device.types import get_pod_device_claims
     by_uuid = registry.chip_by_uuid()
     cells = set()
