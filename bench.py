@@ -90,9 +90,40 @@ def tpu_healthy(timeout_s: int = 120) -> bool:
         return False
 
 
-def run_tpu_worker(quota: int, no_shim: bool = False) -> float | None:
+def calibrate_obs_overhead() -> str | None:
+    """The node daemon's transport calibration, run through the shipped
+    module (manager/obs_calibrate.py): the gap-indexed span-inflation
+    excess table of a reference program on the plain (shim-less)
+    transport. The sweep workers get it as VTPU_OBS_EXCESS_TABLE, exactly
+    as the device plugin injects it into tenant containers."""
+    from vtpu_manager.manager.obs_calibrate import calibrate_in_subprocess
+    return calibrate_in_subprocess(env=dict(os.environ))
+
+
+def run_tpu_worker_best(quota: int, no_shim: bool = False,
+                        obs_excess_table: str | None = None,
+                        reps: int | None = None) -> float | None:
+    """Min ms/step over `reps` fresh-process runs. The tunnel transport
+    stalls intermittently (measured: unthrottled 70.6 vs 78.6 ms/step
+    across consecutive runs) and a stall only ever ADDS time, so the min
+    is the honest estimate of both capability and paced throughput."""
+    if reps is None:
+        reps = int(os.environ.get("VTPU_BENCH_REPS", "2"))
+    best = None
+    for _ in range(max(1, reps)):
+        ms = run_tpu_worker(quota, no_shim=no_shim,
+                            obs_excess_table=obs_excess_table)
+        if ms is not None and (best is None or ms < best):
+            best = ms
+    return best
+
+
+def run_tpu_worker(quota: int, no_shim: bool = False,
+                   obs_excess_table: str | None = None) -> float | None:
     """One quota point in a fresh process; returns ms/step."""
     env = tpu_env(quota)
+    if obs_excess_table is not None:
+        env["VTPU_OBS_EXCESS_TABLE"] = obs_excess_table
     if no_shim:
         env["VTPU_BENCH_NOSHIM"] = "1"
     try:
@@ -137,10 +168,15 @@ def worker_main() -> None:
         return y, jnp.float32(y[0, 0])
 
     x = jax.random.normal(jax.random.PRNGKey(0), (8192, 8192), jnp.bfloat16)
-    for _ in range(3):     # compile + warmup
+    # Warmup must cover controller convergence, not just compile: the
+    # grant controllers (delta/AIMD) start from a cold grant and need a few
+    # hundred ms of windows to settle at the quota; timing them mid-ramp
+    # under- or over-states the converged share by 2x run-to-run.
+    warmup = int(os.environ.get("VTPU_BENCH_WARMUP", "10"))
+    n = int(os.environ.get("VTPU_BENCH_STEPS", "30"))
+    for _ in range(warmup):
         x, loss = step(x)
         _ = float(loss)
-    n = 15
     t0 = time.perf_counter()
     for _ in range(n):
         x, loss = step(x)
@@ -246,19 +282,25 @@ def main() -> int:
     times: dict[int, float] = {}
     hbm_penalty = 0
     overhead: dict = {}
+    tpu_sweep = False   # explicit: `overhead` keys no longer imply hardware
     if tpu_available() and tpu_healthy():
+        obs_table = calibrate_obs_overhead()
+        if obs_table is not None:
+            print(f"obs excess table calibrated: {obs_table}",
+                  file=sys.stderr)
+            overhead["obs_excess_table_calibrated"] = obs_table
         for quota in QUOTAS:
-            ms = run_tpu_worker(quota)
+            ms = run_tpu_worker_best(quota, obs_excess_table=obs_table)
             if ms is not None:
                 times[quota] = ms
         hbm_penalty = run_hbm_check()
         # shim overhead: unthrottled ms/step with vs without the shim
-        noshim = run_tpu_worker(100, no_shim=True)
+        noshim = run_tpu_worker_best(100, no_shim=True)
         if noshim is not None and 100 in times and noshim > 0:
             pct = 100.0 * (times[100] - noshim) / noshim
-            overhead = {"shim_overhead_pct": round(pct, 2),
-                        "ms_per_step_shim": round(times[100], 2),
-                        "ms_per_step_noshim": round(noshim, 2)}
+            overhead.update({"shim_overhead_pct": round(pct, 2),
+                             "ms_per_step_shim": round(times[100], 2),
+                             "ms_per_step_noshim": round(noshim, 2)})
             print(f"shim overhead: {times[100]:.1f} vs {noshim:.1f} "
                   f"ms/step = {pct:+.2f}%", file=sys.stderr)
     elif tpu_available():
@@ -267,6 +309,9 @@ def main() -> int:
     if len(times) != len(QUOTAS):
         print("TPU sweep incomplete; falling back to hermetic fake sweep",
               file=sys.stderr)
+        # nothing measured on the real transport (calibration table, shim
+        # overhead ms/step) may ride along on a fake-plugin MAE line
+        overhead.clear()
         fake = run_fake_sweep()
         if fake is None:
             print(json.dumps({"metric": "core_quota_tracking_mae",
@@ -274,6 +319,8 @@ def main() -> int:
                               "vs_baseline": None}))
             return 1
         times = fake
+    else:
+        tpu_sweep = True
 
     t100 = times[100]
     errors = []
@@ -286,17 +333,17 @@ def main() -> int:
     mae = sum(errors) / len(errors) + hbm_penalty
     print(f"ms/step unthrottled={t100:.1f}; MAE={mae:.2f}%",
           file=sys.stderr)
-    if not overhead:
+    if not tpu_sweep:
         us = run_hermetic_overhead()
         if us is not None:
-            overhead = {"shim_overhead_us_per_exec_hermetic": round(us, 1)}
+            overhead["shim_overhead_us_per_exec_hermetic"] = round(us, 1)
             print(f"hermetic shim overhead: {us:.1f} µs/exec",
                   file=sys.stderr)
     line = {"metric": "core_quota_tracking_mae",
             "value": round(mae, 2), "unit": "percent",
             "vs_baseline": round(mae / BASELINE_AIMD_MAE, 3)}
     line.update(overhead)
-    if "ms_per_step_shim" not in overhead:
+    if not tpu_sweep:
         # hermetic run (no healthy TPU this invocation): label it so the
         # number is never mistaken for a TPU measurement, and point at the
         # committed real-hardware capture when present

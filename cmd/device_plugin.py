@@ -119,6 +119,15 @@ def main(argv: list[str] | None = None) -> int:
     chips = manager.init_devices()
     log.info("discovered %d chip(s): %s", len(chips),
              [c.uuid for c in chips])
+    # Transport-latency calibration (obs_calibrate.py): runs before serving
+    # while the chips are still free; gated by VTPU_OBS_CALIBRATE.
+    from vtpu_manager.manager.obs_calibrate import maybe_calibrate
+    table = maybe_calibrate(real_chips=not args.fake_chips)
+    if table is not None:
+        manager.calibrate_obs_overhead(table=table)
+        log.info("obs excess table calibrated: %s", table)
+    else:
+        log.info("obs-overhead calibration skipped/unavailable; shim probes")
     manager.register_node()
     manager.start_heartbeat()
 

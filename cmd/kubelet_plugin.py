@@ -93,15 +93,31 @@ def main(argv: list[str] | None = None) -> int:
         log.info("node config applied: %d chips, split=%d",
                  len(chips), cfg.device_split_count)
 
+    # Transport-latency calibration before serving (same gate + path as
+    # cmd/device_plugin.py; the node annotation is published below once
+    # the API client exists)
+    from vtpu_manager.manager.obs_calibrate import maybe_calibrate
+    obs_table = maybe_calibrate(real_chips=not args.fake_chips)
+    log.info("obs-overhead calibration: %s", obs_table or "unavailable")
+
     state = DeviceState(args.node_name, chips,
                         base_dir=args.base_dir or consts.MANAGER_BASE_DIR,
-                        cdi_dir=args.cdi_dir)
+                        cdi_dir=args.cdi_dir,
+                        obs_excess_table=obs_table)
     try:
         from vtpu_manager.client.kube import InClusterClient
         client = InClusterClient()
     except Exception:
         client = None
         log.warning("no API server access; claims must arrive pre-resolved")
+    if client is not None and obs_table is not None:
+        # same observability annotation the device-plugin path publishes
+        try:
+            client.patch_node_annotations(
+                args.node_name,
+                {consts.node_obs_overhead_annotation(): obs_table})
+        except Exception as e:  # noqa: BLE001 - observability only
+            log.warning("obs table annotation publish failed: %s", e)
     driver = DraDriver(args.node_name, chips, ClaimSource(client),
                        state=state, plugin_dir=args.plugin_dir)
     driver.serve()

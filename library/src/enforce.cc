@@ -70,6 +70,26 @@ struct DynamicConfig {
   double delta_gain = 0.5;
   // operator-calibrated per-op span inflation (µs); -1 = learn via probe
   int64_t obs_overhead_us = -1;
+  // Plausibility cap for PROBE-learned discounts (µs). A genuine additive
+  // per-op RTT above this would make any interactive use of the transport
+  // miserable; a probe value beyond it almost certainly measured a
+  // *flush floor* instead (a transport that quantizes tiny readbacks to a
+  // timer tick — observed ~63 ms on the v5e loopback relay). Discounting
+  // a flush floor halves the tenant's charged busy time (the half-span
+  // cap is the only bound) — a 2x quota VIOLATION — so such probes are
+  // treated as "no automatic discount; operator calibration required".
+  // Operator-calibrated values (env/table) are exempt from this cap.
+  int64_t probe_discount_cap_us = 5000;
+  // Gap-indexed excess table: discount(idle-gap) = linear interpolation of
+  // (gap_us -> excess_us) points, the measured inflation of an
+  // after-idle span OVER the back-to-back span of a reference program
+  // (manager/obs_calibrate.py publishes it; VTPU_OBS_EXCESS_TABLE=
+  // "gap:excess,gap:excess,..."). Captures transports whose inflation
+  // grows with idle time (relay flush-timer phase alignment), which no
+  // single per-op constant can express without violating quota in one
+  // regime or starving the tenant in the other.
+  struct ExcessPoint { int64_t gap_us, excess_us; };
+  std::vector<ExcessPoint> excess_table;
 };
 DynamicConfig g_dyn;
 
@@ -86,6 +106,52 @@ void LoadDynamicConfig() {
   if (const char* v = getenv("VTPU_DELTA_GAIN")) g_dyn.delta_gain = atof(v);
   if (const char* v = getenv("VTPU_OBS_OVERHEAD_US"))
     g_dyn.obs_overhead_us = atol(v);
+  if (const char* v = getenv("VTPU_PROBE_DISCOUNT_CAP_US"))
+    g_dyn.probe_discount_cap_us = atol(v);
+  if (const char* v = getenv("VTPU_OBS_EXCESS_TABLE")) {
+    const char* p = v;
+    while (*p) {
+      char* end = nullptr;
+      long long gap = strtoll(p, &end, 10);
+      if (end == p || *end != ':') break;
+      p = end + 1;
+      long long excess = strtoll(p, &end, 10);
+      if (end == p) break;
+      g_dyn.excess_table.push_back({(int64_t)gap, (int64_t)excess});
+      p = (*end == ',') ? end + 1 : end;
+    }
+    std::sort(g_dyn.excess_table.begin(), g_dyn.excess_table.end(),
+              [](const DynamicConfig::ExcessPoint& a,
+                 const DynamicConfig::ExcessPoint& b) {
+                return a.gap_us < b.gap_us;
+              });
+  }
+}
+
+// Interpolated excess at idle-gap `gap_us` (clamped above the table's
+// last point). Below the first point the table is anchored at an implicit
+// (0, 0): a back-to-back span IS the fair charge by definition, so a
+// table published without the explicit 0:0 anchor (raw operator points,
+// e.g. "60000:1800,230000:14000") must interpolate toward zero rather
+// than discount b2b spans by the first point's excess.
+int64_t ExcessAtGap(int64_t gap_us) {
+  const auto& t = g_dyn.excess_table;
+  if (t.empty()) return 0;
+  if (gap_us <= t.front().gap_us) {
+    int64_t g1 = t.front().gap_us;
+    if (g1 <= 0 || gap_us <= 0)
+      return gap_us >= g1 ? t.front().excess_us : 0;
+    return t.front().excess_us * gap_us / g1;
+  }
+  if (gap_us >= t.back().gap_us) return t.back().excess_us;
+  for (size_t i = 1; i < t.size(); i++) {
+    if (gap_us <= t[i].gap_us) {
+      int64_t g0 = t[i - 1].gap_us, g1 = t[i].gap_us;
+      int64_t e0 = t[i - 1].excess_us, e1 = t[i].excess_us;
+      return e0 + (e1 - e0) * (gap_us - g0) / (g1 - g0 ? g1 - g0 : 1);
+    }
+  }
+  return t.back().excess_us;
 }
 
 // ---------------------------------------------------------------------------
@@ -1375,11 +1441,19 @@ int64_t ProbeOnce(int slot) {
 
 void* ProbeMain(void*) {
   ShimState& s = State();
-  if (g_dyn.obs_overhead_us >= 0) {
-    // operator calibration overrides the probe (see ProbeOnce comment)
+  if (g_dyn.obs_overhead_us >= 0 || !g_dyn.excess_table.empty()) {
+    // Operator calibration overrides the probe (see ProbeOnce comment).
+    // With an excess table the hot value is only the isolated-span
+    // CLASSIFICATION tolerance (the discount comes from the table), and
+    // the high-water end inflation is bounded by the table's max excess —
+    // seed that and never probe: on a flush-floor transport the probe
+    // would otherwise keep burning ~2 RTTs per round forever to learn a
+    // bogus value nothing should use.
+    int64_t oh = g_dyn.obs_overhead_us >= 0
+                     ? g_dyn.obs_overhead_us
+                     : g_dyn.excess_table.back().excess_us;
     for (int slot = 0; slot < s.device_count; slot++) {
-      s.hot[slot].obs_overhead_us.store(g_dyn.obs_overhead_us,
-                                        std::memory_order_relaxed);
+      s.hot[slot].obs_overhead_us.store(oh, std::memory_order_relaxed);
       s.hot[slot].obs_samples.store(1 << 20, std::memory_order_relaxed);
     }
     return nullptr;
@@ -1572,24 +1646,51 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
              prev, end_ns, std::memory_order_relaxed)) {
   }
   if (end_ns <= prev) return;  // fully covered by credited activity
-  uint64_t oh_ns = (uint64_t)s.hot[slot].obs_overhead_us.load(
-                       std::memory_order_relaxed) * 1000;
+  int64_t oh_us = s.hot[slot].obs_overhead_us.load(std::memory_order_relaxed);
+  // PROBE-learned values beyond the plausibility cap measured a transport
+  // flush floor, not additive latency: discounting them would halve the
+  // charged busy time (quota violation). Operator-calibrated values
+  // (VTPU_OBS_OVERHEAD_US / VTPU_OBS_EXCESS_TABLE) are trusted as-is.
+  bool operator_calibrated =
+      g_dyn.obs_overhead_us >= 0 || !g_dyn.excess_table.empty();
+  if (!operator_calibrated && oh_us > g_dyn.probe_discount_cap_us) {
+    static std::atomic<bool> warned{false};
+    if (!warned.exchange(true)) {
+      VTPU_LOG(kLogWarn,
+               "probe overhead %" PRId64 " us exceeds plausibility cap "
+               "%" PRId64 " us (flush-floor transport?); no automatic "
+               "span discount — set VTPU_OBS_EXCESS_TABLE (or "
+               "VTPU_OBS_OVERHEAD_US) from node calibration",
+               oh_us, g_dyn.probe_discount_cap_us);
+    }
+    oh_us = 0;
+  }
+  uint64_t oh_ns = (uint64_t)oh_us * 1000;
   // Isolated = not genuinely pipelined behind prior work. The high-water
   // itself is inflated by up to oh (it is a host-observed end), so a span
   // starting within oh of it — the sync-loop boundary, where the next
   // submit races our own observation of the previous completion — is
   // isolated, not overlapped.
   bool isolated = start_ns + oh_ns >= prev;
+  int64_t gap_us = ((int64_t)start_ns - (int64_t)prev) / 1000;
   if (start_ns < prev) start_ns = prev;
   uint64_t credit_ns = end_ns - start_ns;
   if (isolated) {
     // An isolated span carries the full per-op transport/observation
     // latency (deeply overlapped spans shed it: both their ends are
-    // inflated equally, so end-to-end deltas are true busy). Discount the
-    // probe-learned overhead, capped at half the span — see the probe
-    // block for why the cap.
-    uint64_t disc = oh_ns > credit_ns / 2 ? credit_ns / 2 : oh_ns;
-    credit_ns -= disc;
+    // inflated equally, so end-to-end deltas are true busy). Discount,
+    // capped at half the span — see the probe block for why the cap.
+    uint64_t disc_ns = oh_ns;
+    if (!g_dyn.excess_table.empty()) {
+      // Gap-indexed calibration: the observed gap underestimates the true
+      // idle time by the previous span's own inflation, so iterate the
+      // lookup once (monotone table => still conservative).
+      int64_t g0 = gap_us > 0 ? gap_us : 0;
+      int64_t d = ExcessAtGap(g0 + ExcessAtGap(g0));
+      disc_ns = d > 0 ? (uint64_t)d * 1000 : 0;
+    }
+    if (disc_ns > credit_ns / 2) disc_ns = credit_ns / 2;
+    credit_ns -= disc_ns;
   }
   s.hot[slot].busy_ns_window.fetch_add(credit_ns,
                                        std::memory_order_relaxed);

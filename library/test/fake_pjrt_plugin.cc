@@ -94,6 +94,11 @@ struct FakeEvent {
   std::mutex mu;
   std::condition_variable cv;
   bool ready = false;
+  // execute-side completion (vs transfer-side): under FAKE_OBS_ASYM only
+  // execute-side awaits pay the observation latency, modelling transports
+  // whose tiny-transfer RTT hides the execute-path inflation (the v5e
+  // loopback relay: H2D acked ~0.1 ms while execute spans carry ~10 ms)
+  bool exec_side = false;
   std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> callbacks;
 
   void MarkReady() {
@@ -126,6 +131,23 @@ int64_t ObsLatencyUs() {
   static int64_t v = [] {
     const char* e = getenv("FAKE_OBS_LATENCY_US");
     return e ? atol(e) : 0;
+  }();
+  return v;
+}
+
+int AsymmetricObsLatency() {
+  // FAKE_OBS_ASYM=1: only execute-side awaits pay FAKE_OBS_LATENCY_US.
+  // The shim's transfer-leg probe then learns ~0 (its conservative min),
+  // so only the operator-calibrated override (VTPU_OBS_OVERHEAD_US /
+  // VTPU_OBS_EXCESS_TABLE) can restore low-quota accuracy — the regime
+  // obs_calibrate.py exists for.
+  // FAKE_OBS_ASYM=2: only transfer-side awaits pay it — the flush-floor
+  // model (v5e relay: tiny readbacks quantized to ~63 ms while execute
+  // observation is honest). The probe then learns a huge bogus "RTT",
+  // which the shim's plausibility cap must refuse to discount.
+  static int v = [] {
+    const char* e = getenv("FAKE_OBS_ASYM");
+    return e ? atoi(e) : 0;
   }();
   return v;
 }
@@ -357,11 +379,17 @@ PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
 
 PJRT_Error* EventAwait(PJRT_Event_Await_Args* args) {
   auto* evt = reinterpret_cast<FakeEvent*>(args->event);
+  bool exec_side;
   {
     std::unique_lock<std::mutex> g(evt->mu);
     evt->cv.wait(g, [&] { return evt->ready; });
+    exec_side = evt->exec_side;
   }
-  if (int64_t lat = ObsLatencyUs()) usleep((useconds_t)lat);
+  int64_t lat = ObsLatencyUs();
+  int asym = AsymmetricObsLatency();
+  bool pays = asym == 0 || (asym == 1 && exec_side) ||
+              (asym == 2 && !exec_side);
+  if (lat && pays) usleep((useconds_t)lat);
   return nullptr;
 }
 
@@ -469,6 +497,7 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     // small per-exec leak is intentional.
     FakeEvent* done = new FakeEvent();
     FakeEvent* out_ready = new FakeEvent();
+    done->exec_side = out_ready->exec_side = true;
     if (args->output_lists && args->output_lists[d]) {
       auto* out = new FakeBuffer{OutBytes()};
       out->device_id = (int)d < DeviceCount() ? (int)d : 0;

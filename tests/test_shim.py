@@ -122,6 +122,91 @@ class TestShimHermetic:
         assert res.returncode == 0, res.stdout + res.stderr
         assert "ALL PASS" in res.stdout
 
+    def test_asymmetric_transport_probe_stays_conservative(self, shim_build,
+                                                           tmp_path):
+        """FAKE_OBS_ASYM models the v5e loopback relay: transfer-leg RTT ~0
+        while execute spans carry the full latency. The transfer probe's
+        min-of-legs must stay at ~0 discount (a wrong discount is worse
+        than none), so each 2 ms program is charged ~4 ms and the run takes
+        ~2x the ideal wall — the over-throttle is the *correct* conservative
+        behavior without operator calibration."""
+        env = base_env(shim_build, tmp_path)
+        env.update({
+            "VTPU_MEM_LIMIT_0": "1073741824",
+            "VTPU_CORE_LIMIT_0": "25",
+            "FAKE_EXEC_US": "2000",
+            "FAKE_OBS_LATENCY_US": "2000",
+            "FAKE_OBS_ASYM": "1",
+            "SHIM_OBS_EXPECT_MS": "1350,2600",
+        })
+        res = subprocess.run([shim_build["test"], "--obs-latency"], env=env,
+                             timeout=120, capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "ALL PASS" in res.stdout
+
+    def test_operator_calibration_restores_low_quota_accuracy(
+            self, shim_build, tmp_path):
+        """Same asymmetric transport, but with the node-daemon-calibrated
+        VTPU_OBS_OVERHEAD_US injected (manager/obs_calibrate.py -> plugin
+        env): isolated spans shed the inflation and the wall returns to the
+        ideal ~800 ms — the end-to-end contract for the calibration path."""
+        env = base_env(shim_build, tmp_path)
+        env.update({
+            "VTPU_MEM_LIMIT_0": "1073741824",
+            "VTPU_CORE_LIMIT_0": "25",
+            "FAKE_EXEC_US": "2000",
+            "FAKE_OBS_LATENCY_US": "2000",
+            "FAKE_OBS_ASYM": "1",
+            "VTPU_OBS_OVERHEAD_US": "2000",
+            "SHIM_OBS_EXPECT_MS": "640,1280",
+        })
+        res = subprocess.run([shim_build["test"], "--obs-latency"], env=env,
+                             timeout=120, capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "ALL PASS" in res.stdout
+
+    def test_flush_floor_probe_refused_by_plausibility_cap(self, shim_build,
+                                                           tmp_path):
+        """FAKE_OBS_ASYM=2 models the flush-floor transport: tiny
+        transfer readbacks are quantized to a ~60 ms timer while execute
+        observation is honest. The probe learns 60 ms as 'per-op RTT';
+        discounting it would halve every charged span (2x quota
+        violation), so the plausibility cap must refuse it and the run
+        must pace at the undiscounted ~800 ms."""
+        env = base_env(shim_build, tmp_path)
+        env.update({
+            "VTPU_MEM_LIMIT_0": "1073741824",
+            "VTPU_CORE_LIMIT_0": "25",
+            "FAKE_EXEC_US": "2000",
+            "FAKE_OBS_LATENCY_US": "60000",
+            "FAKE_OBS_ASYM": "2",
+            "SHIM_OBS_EXPECT_MS": "640,1280",
+        })
+        res = subprocess.run([shim_build["test"], "--obs-latency"], env=env,
+                             timeout=180, capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "ALL PASS" in res.stdout
+
+    def test_excess_table_discount(self, shim_build, tmp_path):
+        """The gap-indexed calibration path: VTPU_OBS_EXCESS_TABLE drives
+        the isolated-span discount (interpolated at each span's pre-gap).
+        A flat 2 ms table on a uniformly-inflating transport restores the
+        ideal ~800 ms wall, same as the flat override."""
+        env = base_env(shim_build, tmp_path)
+        env.update({
+            "VTPU_MEM_LIMIT_0": "1073741824",
+            "VTPU_CORE_LIMIT_0": "25",
+            "FAKE_EXEC_US": "2000",
+            "FAKE_OBS_LATENCY_US": "2000",
+            "FAKE_OBS_ASYM": "1",
+            "VTPU_OBS_EXCESS_TABLE": "0:2000,100000:2000",
+            "SHIM_OBS_EXPECT_MS": "640,1280",
+        })
+        res = subprocess.run([shim_build["test"], "--obs-latency"], env=env,
+                             timeout=120, capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "ALL PASS" in res.stdout
+
     def test_multichip_independent_caps_and_quotas(self, shim_build,
                                                    tmp_path):
         """VERDICT r1 #7: run the shim against a 2-device fake plugin;

@@ -357,6 +357,12 @@ class VnumPlugin(DevicePluginServicer):
             resp.envs[consts.ENV_VTPU_REAL_PLUGIN_PATH] = self.libtpu_path
             resp.envs["VTPU_CONFIG_PATH"] = \
                 f"{consts.MANAGER_BASE_DIR}/config/vtpu.config"
+            if self.manager.obs_excess_table is not None:
+                # daemon-calibrated span-inflation table: the shim
+                # discounts isolated spans by the interpolated excess
+                # instead of its own transfer-leg probe (obs_calibrate.py)
+                resp.envs[consts.ENV_OBS_EXCESS_TABLE] = \
+                    self.manager.obs_excess_table
         return resp
 
     # -- records + PreStartContainer ---------------------------------------

@@ -42,10 +42,13 @@ class DeviceState:
                  checkpoint_path: str | None = None,
                  shim_host_dir: str = consts.DRIVER_DIR,
                  node_config: NodeConfig | None = None,
-                 libtpu_path: str = "/lib/libtpu.so"):
+                 libtpu_path: str = "/lib/libtpu.so",
+                 obs_excess_table: str | None = None):
         self.node_name = node_name
         self.node_config = node_config or NodeConfig()
         self.libtpu_path = libtpu_path
+        # daemon-calibrated span-inflation table (obs_calibrate.py)
+        self.obs_excess_table = obs_excess_table
         self._chips_by_index = {c.index: c for c in chips}
         self.base_dir = base_dir
         self.cdi_dir = cdi_dir
@@ -164,6 +167,8 @@ class DeviceState:
             self.node_config.compat_mode, consts.COMPAT_HOST))
         envs["VTPU_CONFIG_PATH"] = \
             f"{consts.MANAGER_BASE_DIR}/config/vtpu.config"
+        if self.obs_excess_table is not None:
+            envs[consts.ENV_OBS_EXCESS_TABLE] = self.obs_excess_table
         return envs
 
     def _write_group_config(self, config_dir: str, uid: str, meta: dict,

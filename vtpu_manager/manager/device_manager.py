@@ -66,6 +66,11 @@ class DeviceManager:
         self.mesh_domain = mesh_domain
         self.chips: list[ChipSpec] = []
         self.mesh: MeshSpec = MeshSpec()
+        # per-transport span-inflation table ("gap_us:excess_us,..."),
+        # measured by obs_calibrate at daemon startup; injected into
+        # containers as VTPU_OBS_EXCESS_TABLE (None = uncalibrated: the
+        # shim falls back to its capped in-container probe)
+        self.obs_excess_table: str | None = None
         self._health_listeners: list[Callable[[ChipSpec], None]] = []
         self._stop = threading.Event()
         self._heartbeat_thread: threading.Thread | None = None
@@ -87,6 +92,25 @@ class DeviceManager:
     def registry(self) -> NodeDeviceRegistry:
         return NodeDeviceRegistry(chips=self.chips, mesh=self.mesh,
                                   mesh_domain=self.mesh_domain)
+
+    def calibrate_obs_overhead(self, table: str | None = "",
+                               ) -> str | None:
+        """Measure the transport's span-inflation excess table in a
+        throwaway subprocess (chips must be free — call before serving) and
+        publish it on the node for observability. Pass ``table`` to adopt a
+        pre-measured value instead of measuring. See obs_calibrate.py."""
+        if table == "":
+            from vtpu_manager.manager import obs_calibrate
+            table = obs_calibrate.calibrate_in_subprocess()
+        self.obs_excess_table = table
+        if table is not None:
+            try:
+                self.client.patch_node_annotations(
+                    self.node_name,
+                    {consts.node_obs_overhead_annotation(): table})
+            except Exception:  # noqa: BLE001 - annotation is observability
+                pass
+        return table
 
     # -- registration / heartbeat ------------------------------------------
 

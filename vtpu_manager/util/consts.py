@@ -169,6 +169,13 @@ def node_config_hash_annotation() -> str:
     return _ann("node-config-hash")
 
 
+def node_obs_overhead_annotation() -> str:
+    """Calibrated span-inflation excess table ("gap_us:excess_us,...") for
+    this node's TPU transport (manager/obs_calibrate.py); observability
+    only."""
+    return _ann("node-obs-excess-table")
+
+
 # Allocation status values ---------------------------------------------------
 
 ALLOC_STATUS_SUCCEED = "succeed"
@@ -219,6 +226,10 @@ ENV_MEM_OVERSOLD = "VTPU_MEM_OVERSOLD"      # "true"/"false"
 ENV_VISIBLE_DEVICES = "MANAGER_VISIBLE_DEVICES"    # host-index / uuid list
 ENV_COMPAT_MODE = "MANAGER_COMPATIBILITY_MODE"
 ENV_DISABLE_CONTROL = "DISABLE_VTPU_CONTROL"
+# gap-indexed span-inflation table "gap_us:excess_us,..." measured by
+# manager/obs_calibrate.py and injected by both allocation paths (the shim
+# also honors a flat operator-set VTPU_OBS_OVERHEAD_US, read C-side only)
+ENV_OBS_EXCESS_TABLE = "VTPU_OBS_EXCESS_TABLE"
 ENV_REGISTER_UUID = "VTPU_REGISTER_UUID"    # random id for CLIENT-mode match
 ENV_REGISTRY_SOCKET = "VTPU_REGISTRY_SOCKET"  # registry socket override
 ENV_POD_NAME = "VTPU_POD_NAME"
