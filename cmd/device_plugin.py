@@ -177,6 +177,16 @@ def main(argv: list[str] | None = None) -> int:
     watcher = None
     if gates.enabled(TC_WATCHER):
         watcher = TcWatcherDaemon([c.index for c in chips], FakeSampler())
+        if manager.obs_excess_table is not None:
+            # live channel for the startup calibration; a later manual
+            # recalibration (python -m vtpu_manager.manager.obs_calibrate
+            # piped into publish_calibration) reaches running shims too
+            from vtpu_manager.manager.obs_calibrate import decode_table
+            try:
+                watcher.publish_calibration(
+                    decode_table(manager.obs_excess_table))
+            except ValueError:
+                log.warning("unparseable excess table; feed not seeded")
         watcher.start()
 
     controller = None

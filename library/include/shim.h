@@ -140,6 +140,9 @@ struct ShimState {
   std::unordered_map<PJRT_LoadedExecutable*, ExecFactsEntry> exec_facts;
   // tc_util external feed (mapped readonly if present)
   const TcUtilFile* tc_file = nullptr;
+  // v2 feed's calibration block (daemon-published excess table); read
+  // each watcher tick so live recalibrations reach running shims
+  const TcCalibration* tc_cal = nullptr;
   // Handles captured opportunistically from wrapped calls so the
   // observation-overhead probe can issue its own (real-API) operations.
   std::atomic<PJRT_Client*> probe_client{nullptr};

@@ -127,6 +127,25 @@ struct TcUtilFile {
 static_assert(offsetof(TcUtilFile, records) == 16, "ABI");
 static_assert(sizeof(TcUtilFile) == 16 + 64 * (24 + 32 * 24), "ABI");
 
+// v2 appends one transport-calibration block after the records: the node
+// daemon's measured span-inflation excess table (obs_calibrate.py),
+// live-updatable so running shims follow transport regime changes that
+// env-injected tables (frozen at container start) cannot. One block per
+// host — the transport is per-host, not per-chip. Same seqlock
+// discipline as the device records.
+constexpr uint32_t kTcUtilVersion2 = 2;
+constexpr int kMaxExcessPoints = 8;
+
+struct TcCalibration {
+  uint64_t seq;           // seqlock: odd while writing
+  uint64_t timestamp_ns;  // writer CLOCK_MONOTONIC at calibration time
+  int32_t n_points;
+  int32_t pad_;
+  int64_t gap_us[kMaxExcessPoints];
+  int64_t excess_us[kMaxExcessPoints];
+};
+static_assert(sizeof(TcCalibration) == 24 + 2 * 8 * 8, "ABI");
+
 // ---------------------------------------------------------------------------
 // vmem_node.config (cross-process memory ledger)
 // ---------------------------------------------------------------------------

@@ -125,6 +125,17 @@ void LoadDynamicConfig() {
                  const DynamicConfig::ExcessPoint& b) {
                 return a.gap_us < b.gap_us;
               });
+    if ((int)g_dyn.excess_table.size() > kMaxExcessPoints) {
+      // clamp to the feed-block limit HERE so every consumer sees the
+      // same table: keep the first 7 + the LAST point — the largest-gap
+      // plateau is what big-gap spans clamp to and must survive
+      VTPU_LOG(kLogWarn, "excess table has %zu points; keeping %d "
+               "(first %d + last)", g_dyn.excess_table.size(),
+               kMaxExcessPoints, kMaxExcessPoints - 1);
+      auto last = g_dyn.excess_table.back();
+      g_dyn.excess_table.resize(kMaxExcessPoints - 1);
+      g_dyn.excess_table.push_back(last);
+    }
   }
 }
 
@@ -134,24 +145,123 @@ void LoadDynamicConfig() {
 // table published without the explicit 0:0 anchor (raw operator points,
 // e.g. "60000:1800,230000:14000") must interpolate toward zero rather
 // than discount b2b spans by the first point's excess.
-int64_t ExcessAtGap(int64_t gap_us) {
-  const auto& t = g_dyn.excess_table;
-  if (t.empty()) return 0;
-  if (gap_us <= t.front().gap_us) {
-    int64_t g1 = t.front().gap_us;
+int64_t InterpExcess(const int64_t* gaps, const int64_t* excesses, int n,
+                     int64_t gap_us) {
+  if (n <= 0) return 0;
+  if (gap_us <= gaps[0]) {
+    int64_t g1 = gaps[0];
     if (g1 <= 0 || gap_us <= 0)
-      return gap_us >= g1 ? t.front().excess_us : 0;
-    return t.front().excess_us * gap_us / g1;
+      return gap_us >= g1 ? excesses[0] : 0;
+    return excesses[0] * gap_us / g1;
   }
-  if (gap_us >= t.back().gap_us) return t.back().excess_us;
-  for (size_t i = 1; i < t.size(); i++) {
-    if (gap_us <= t[i].gap_us) {
-      int64_t g0 = t[i - 1].gap_us, g1 = t[i].gap_us;
-      int64_t e0 = t[i - 1].excess_us, e1 = t[i].excess_us;
+  if (gap_us >= gaps[n - 1]) return excesses[n - 1];
+  for (int i = 1; i < n; i++) {
+    if (gap_us <= gaps[i]) {
+      int64_t g0 = gaps[i - 1], g1 = gaps[i];
+      int64_t e0 = excesses[i - 1], e1 = excesses[i];
       return e0 + (e1 - e0) * (gap_us - g0) / (g1 - g0 ? g1 - g0 : 1);
     }
   }
-  return t.back().excess_us;
+  return excesses[n - 1];
+}
+
+// Live feed calibration (tc_util v2 block): the daemon can republish the
+// excess table while tenants run — env-injected tables freeze at
+// container start, and the transport regime changes between sessions.
+// The watcher thread adopts new feed values under a local seqlock;
+// hot-path readers (OnExecuteDone) copy-and-validate without blocking.
+std::atomic<uint64_t> g_feed_cal_gen{0};   // even = stable
+int g_feed_cal_n = 0;                      // writer: watcher thread only
+int64_t g_feed_cal_gap[kMaxExcessPoints];
+int64_t g_feed_cal_excess[kMaxExcessPoints];
+uint64_t g_feed_cal_seen_seq = 0;
+
+void AdoptFeedCalibration() {
+  const TcCalibration* cal = State().tc_cal;
+  if (!cal) return;
+  for (int r = 0; r < 4; r++) {
+    uint64_t s1 = __atomic_load_n(&cal->seq, __ATOMIC_ACQUIRE);
+    if (s1 & 1) continue;
+    int n = cal->n_points;
+    if (n < 0) n = 0;
+    if (n > kMaxExcessPoints) n = kMaxExcessPoints;
+    int64_t gap[kMaxExcessPoints], exc[kMaxExcessPoints];
+    for (int i = 0; i < n; i++) {
+      gap[i] = cal->gap_us[i];
+      exc[i] = cal->excess_us[i];
+    }
+    uint64_t s2 = __atomic_load_n(&cal->seq, __ATOMIC_ACQUIRE);
+    if (s1 != s2) continue;
+    if (n == 0 || s1 == g_feed_cal_seen_seq) return;  // nothing new
+    g_feed_cal_seen_seq = s1;
+    g_feed_cal_gen.fetch_add(1, std::memory_order_acq_rel);  // odd
+    g_feed_cal_n = n;
+    for (int i = 0; i < n; i++) {
+      g_feed_cal_gap[i] = gap[i];
+      g_feed_cal_excess[i] = exc[i];
+    }
+    g_feed_cal_gen.fetch_add(1, std::memory_order_acq_rel);  // even
+    VTPU_LOG(kLogInfo, "feed calibration adopted: %d point(s), max %lld us",
+             n, (long long)exc[n - 1]);
+    return;
+  }
+}
+
+// Discount source precedence: live feed table > env table. Returns the
+// interpolated excess at `gap_us` from whichever is active (0 if none).
+int64_t ActiveExcessAt(int64_t gap_us) {
+  for (int r = 0; r < 4; r++) {
+    uint64_t g1 = g_feed_cal_gen.load(std::memory_order_acquire);
+    if (g1 & 1) continue;
+    int n = g_feed_cal_n;
+    if (n == 0) break;
+    int64_t gap[kMaxExcessPoints], exc[kMaxExcessPoints];
+    for (int i = 0; i < n && i < kMaxExcessPoints; i++) {
+      gap[i] = g_feed_cal_gap[i];
+      exc[i] = g_feed_cal_excess[i];
+    }
+    uint64_t g2 = g_feed_cal_gen.load(std::memory_order_acquire);
+    if (g1 != g2) continue;
+    return InterpExcess(gap, exc, n, gap_us);
+  }
+  const auto& t = g_dyn.excess_table;
+  if (t.empty()) return 0;
+  int64_t gap[kMaxExcessPoints], exc[kMaxExcessPoints];
+  int n = (int)t.size() < kMaxExcessPoints ? (int)t.size()
+                                           : kMaxExcessPoints;
+  for (int i = 0; i < n; i++) {
+    gap[i] = t[i].gap_us;
+    exc[i] = t[i].excess_us;
+  }
+  return InterpExcess(gap, exc, n, gap_us);
+}
+
+bool HasActiveExcessTable() {
+  return g_feed_cal_n > 0 || !g_dyn.excess_table.empty();
+}
+
+// Max excess across the active table: bounds how inflated a host-observed
+// span END can be, which is exactly the tolerance isolated-span
+// classification needs at the sync-loop boundary (next submit racing our
+// own observation of the previous completion). Without it, a feed-
+// delivered table classifies ~half the paced steps as overlapped (the
+// race is a coin flip) and they silently lose the discount.
+int64_t ActiveExcessMax() {
+  int64_t best = 0;
+  for (int r = 0; r < 4; r++) {
+    uint64_t g1 = g_feed_cal_gen.load(std::memory_order_acquire);
+    if (g1 & 1) continue;
+    int n = g_feed_cal_n;
+    if (n == 0) break;
+    int64_t m = 0;
+    for (int i = 0; i < n && i < kMaxExcessPoints; i++)
+      m = std::max(m, g_feed_cal_excess[i]);
+    uint64_t g2 = g_feed_cal_gen.load(std::memory_order_acquire);
+    if (g1 != g2) continue;
+    return m;
+  }
+  for (const auto& p : g_dyn.excess_table) best = std::max(best, p.excess_us);
+  return best;
 }
 
 // ---------------------------------------------------------------------------
@@ -1247,6 +1357,7 @@ void WatcherTick(int64_t window_ns) {
     s.hot[slot].throttled_since_watch.store(false);
   }
   RefreshClientPids();
+  AdoptFeedCalibration();
   g_metrics.watcher_ticks.Bump();
 }
 
@@ -1460,6 +1571,18 @@ void* ProbeMain(void*) {
   }
   constexpr int kConverged = 6;
   while (g_watcher_running.load(std::memory_order_relaxed)) {
+    if (HasActiveExcessTable()) {
+      // A feed-delivered table arrived after startup: same terminal state
+      // as the operator branch above — seed the classification tolerance
+      // and stop probing (on a flush-floor transport every further round
+      // burns ~2 RTTs to learn a value nothing may use).
+      int64_t oh = ActiveExcessMax();
+      for (int slot = 0; slot < s.device_count; slot++) {
+        s.hot[slot].obs_overhead_us.store(oh, std::memory_order_relaxed);
+        s.hot[slot].obs_samples.store(1 << 20, std::memory_order_relaxed);
+      }
+      return nullptr;
+    }
     bool all_converged = true;
     for (int slot = 0; slot < s.device_count; slot++) {
       const VtpuDevice* cfg = DeviceCfg(slot);
@@ -1648,14 +1771,16 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
   if (end_ns <= prev) return;  // fully covered by credited activity
   int64_t oh_us = s.hot[slot].obs_overhead_us.load(std::memory_order_relaxed);
   // PROBE-learned values beyond the plausibility cap measured a transport
-  // flush floor, not additive latency: discounting them would halve the
-  // charged busy time (quota violation). Operator-calibrated values
-  // (VTPU_OBS_OVERHEAD_US / VTPU_OBS_EXCESS_TABLE) are trusted as-is.
-  bool operator_calibrated =
-      g_dyn.obs_overhead_us >= 0 || !g_dyn.excess_table.empty();
-  if (!operator_calibrated && oh_us > g_dyn.probe_discount_cap_us) {
+  // flush floor, not additive latency: discounting (or classifying) by
+  // them would be wrong, so they are zeroed REGARDLESS of table presence
+  // — only the flat operator override is exempt, because only it writes
+  // the per-slot value directly (ProbeMain seeds and exits for both
+  // operator sources, but a feed table can arrive after the probe
+  // already learned a bogus floor).
+  bool flat_operator = g_dyn.obs_overhead_us >= 0;
+  if (!flat_operator && oh_us > g_dyn.probe_discount_cap_us) {
     static std::atomic<bool> warned{false};
-    if (!warned.exchange(true)) {
+    if (!warned.exchange(true) && !HasActiveExcessTable()) {
       VTPU_LOG(kLogWarn,
                "probe overhead %" PRId64 " us exceeds plausibility cap "
                "%" PRId64 " us (flush-floor transport?); no automatic "
@@ -1665,6 +1790,9 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
     }
     oh_us = 0;
   }
+  // Classification tolerance: at least the active table's max excess (a
+  // probe-learned oh can be ~0 while the table says ends inflate by ms).
+  if (HasActiveExcessTable()) oh_us = std::max(oh_us, ActiveExcessMax());
   uint64_t oh_ns = (uint64_t)oh_us * 1000;
   // Isolated = not genuinely pipelined behind prior work. The high-water
   // itself is inflated by up to oh (it is a host-observed end), so a span
@@ -1681,12 +1809,12 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
     // inflated equally, so end-to-end deltas are true busy). Discount,
     // capped at half the span — see the probe block for why the cap.
     uint64_t disc_ns = oh_ns;
-    if (!g_dyn.excess_table.empty()) {
+    if (HasActiveExcessTable()) {
       // Gap-indexed calibration: the observed gap underestimates the true
       // idle time by the previous span's own inflation, so iterate the
       // lookup once (monotone table => still conservative).
       int64_t g0 = gap_us > 0 ? gap_us : 0;
-      int64_t d = ExcessAtGap(g0 + ExcessAtGap(g0));
+      int64_t d = ActiveExcessAt(g0 + ActiveExcessAt(g0));
       disc_ns = d > 0 ? (uint64_t)d * 1000 : 0;
     }
     if (disc_ns > credit_ns / 2) disc_ns = credit_ns / 2;

@@ -215,20 +215,29 @@ static void MapTcUtil() {
   int fd = open(path, O_RDONLY);
   if (fd < 0) return;
   struct stat st;
-  if (fstat(fd, &st) != 0 || (size_t)st.st_size != sizeof(TcUtilFile)) {
+  constexpr size_t kV1Size = sizeof(TcUtilFile);
+  constexpr size_t kV2Size = sizeof(TcUtilFile) + sizeof(TcCalibration);
+  if (fstat(fd, &st) != 0 ||
+      ((size_t)st.st_size != kV1Size && (size_t)st.st_size != kV2Size)) {
     close(fd);
     return;
   }
-  void* mem = mmap(nullptr, sizeof(TcUtilFile), PROT_READ, MAP_SHARED, fd, 0);
+  size_t map_size = (size_t)st.st_size;
+  void* mem = mmap(nullptr, map_size, PROT_READ, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return;
   const auto* f = static_cast<const TcUtilFile*>(mem);
   if (f->magic != kTcUtilMagic) {
-    munmap(mem, sizeof(TcUtilFile));
+    munmap(mem, map_size);
     return;
   }
   State().tc_file = f;
-  VTPU_LOG(kLogInfo, "external watcher feed mapped: %s", path);
+  if (map_size == kV2Size && f->version >= kTcUtilVersion2) {
+    State().tc_cal = reinterpret_cast<const TcCalibration*>(
+        reinterpret_cast<const char*>(mem) + sizeof(TcUtilFile));
+  }
+  VTPU_LOG(kLogInfo, "external watcher feed mapped: %s (v%u)", path,
+           f->version);
 }
 
 // ---------------------------------------------------------------------------

@@ -47,6 +47,10 @@ int main() {
   printf("tc_file_size %zu\n", sizeof(TcUtilFile));
   printf("tc_record_size %zu\n", sizeof(TcDeviceRecord));
   printf("tc_proc_size %zu\n", sizeof(TcProcUtil));
+  printf("tc_cal_size %zu\n", sizeof(TcCalibration));
+  printf("tc_cal.n_points %zu\n", offsetof(TcCalibration, n_points));
+  printf("tc_cal.gap_us %zu\n", offsetof(TcCalibration, gap_us));
+  printf("tc_cal.excess_us %zu\n", offsetof(TcCalibration, excess_us));
   printf("vmem_file_size %zu\n", sizeof(VmemFile));
   printf("vmem_entry_size %zu\n", sizeof(VmemEntry));
   return 0;
@@ -72,7 +76,15 @@ class TestCrossLanguageLayout:
     def test_sizes(self, cxx_layout):
         assert int(cxx_layout["device_size"]) == vc.DEVICE_SIZE
         assert int(cxx_layout["config_size"]) == vc.CONFIG_SIZE
-        assert int(cxx_layout["tc_file_size"]) == tc_watcher.FILE_SIZE
+        # v2 file = v1 record region (sizeof(TcUtilFile)) + calibration
+        # block appended at CAL_OFFSET
+        assert int(cxx_layout["tc_file_size"]) == tc_watcher.CAL_OFFSET
+        assert (int(cxx_layout["tc_file_size"])
+                + int(cxx_layout["tc_cal_size"])) == tc_watcher.FILE_SIZE
+        assert int(cxx_layout["tc_cal_size"]) == tc_watcher.CAL_SIZE
+        assert int(cxx_layout["tc_cal.n_points"]) == 16
+        assert int(cxx_layout["tc_cal.gap_us"]) == 24
+        assert int(cxx_layout["tc_cal.excess_us"]) == 24 + 8 * 8
         assert int(cxx_layout["tc_record_size"]) == tc_watcher.RECORD_SIZE
         assert int(cxx_layout["tc_proc_size"]) == tc_watcher.PROC_SIZE
         assert int(cxx_layout["vmem_file_size"]) == vmem.FILE_SIZE
@@ -181,6 +193,57 @@ class TestTcUtilFile:
         future = tc_watcher.DeviceUtil(timestamp_ns=now + int(60e9),
                                        device_util=1)
         assert not future.is_fresh(now_ns=now)
+
+    def test_calibration_roundtrip(self, tmp_path):
+        path = str(tmp_path / "tc_util.config")
+        f = tc_watcher.TcUtilFile(path, create=True)
+        assert f.read_calibration() is None   # never written
+        table = [(0, 0), (60000, 1800), (250000, 14000)]
+        f.write_calibration(table)
+        assert f.read_calibration() == table
+        # republish (live recalibration) replaces, seq advances
+        f.write_calibration([(0, 0), (60000, 300)])
+        assert f.read_calibration() == [(0, 0), (60000, 300)]
+        seq, = struct.unpack_from("<Q", f._mm, tc_watcher.CAL_OFFSET)
+        assert seq == 4
+        f.close()
+
+    def test_v1_file_upgraded_in_place_not_replaced(self, tmp_path):
+        """Daemon restart over a v1 feed must GROW the file (ftruncate +
+        version bump), never rename-replace it: running shims keep their
+        mmap of the inode, and a replace would orphan them mid-flight."""
+        path = str(tmp_path / "tc_util.config")
+        with open(path, "wb") as fh:
+            fh.write(struct.pack(tc_watcher._HEADER_FMT, tc_watcher.MAGIC,
+                                 1, 4, 0))
+            fh.write(b"\0" * (tc_watcher.CAL_OFFSET
+                              - tc_watcher.HEADER_SIZE))
+        import os
+        ino_before = os.stat(path).st_ino
+        f = tc_watcher.TcUtilFile(path, create=True)
+        assert os.stat(path).st_ino == ino_before   # same inode: grown
+        assert os.path.getsize(path) == tc_watcher.FILE_SIZE
+        f.write_calibration([(0, 0), (60000, 500)])
+        assert f.read_calibration() == [(0, 0), (60000, 500)]
+        f.close()
+
+    def test_v1_file_still_readable_without_calibration(self, tmp_path):
+        """A pre-v2 feed (no calibration block) must stay readable —
+        mixed-version node mid-upgrade."""
+        path = str(tmp_path / "tc_util.config")
+        with open(path, "wb") as fh:
+            fh.write(struct.pack(tc_watcher._HEADER_FMT, tc_watcher.MAGIC,
+                                 1, 4, 0))
+            fh.write(b"\0" * (tc_watcher.CAL_OFFSET
+                              - tc_watcher.HEADER_SIZE))
+        f = tc_watcher.TcUtilFile(path)
+        assert f.read_calibration() is None
+        with pytest.raises(ValueError, match="no calibration"):
+            f.write_calibration([(0, 0)])
+        util = tc_watcher.DeviceUtil(timestamp_ns=5, device_util=12)
+        f.write_device(1, util)
+        assert f.read_device(1).device_util == 12
+        f.close()
 
     def test_crashed_writer_parity_recovers(self, tmp_path):
         path = str(tmp_path / "tc_util.config")

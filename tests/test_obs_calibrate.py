@@ -63,8 +63,14 @@ class TestMeasurement:
 
         assert measure_excess_table(run_once, gaps_ms=(30,)) is None
 
-    def test_encode(self):
-        assert encode_table([(0, 0), (60000, 1800)]) == "0:0,60000:1800"
+    def test_encode_decode_roundtrip(self):
+        from vtpu_manager.manager.obs_calibrate import decode_table
+        table = [(0, 0), (60000, 1800), (250000, 14000)]
+        assert encode_table(table) == "0:0,60000:1800,250000:14000"
+        assert decode_table(encode_table(table)) == table
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            decode_table("garbage")
 
 
 class TestInjection:

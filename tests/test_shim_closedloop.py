@@ -217,3 +217,41 @@ def test_blind_process_enforced_via_external_feed(shim_build, tmp_path):
     # first feedback arrives one watcher window in).
     assert throttled >= 600, throttled   # unthrottled flood is ~100ms;
     # any clear multiple proves gating (band is wide for CI contention)
+
+
+def test_feed_delivered_calibration_drives_discount(shim_build, tmp_path):
+    """tc_util v2 calibration block: the daemon publishes the excess table
+    into the feed and a shim with NO env table must adopt it on a watcher
+    tick and discount isolated spans — the live channel for transports
+    whose regime changes after containers start. Same workload/bounds as
+    the env-table test in test_shim.py: exec-side inflation 2 ms, quota
+    25%, 100 x 2 ms programs => ~800 ms calibrated (~1600 without)."""
+    tc_path = str(tmp_path / "tc_util.config")
+    feed = tc_watcher.TcUtilFile(tc_path, create=True)
+    feed.write_calibration([(0, 2000), (100000, 2000)])
+    try:
+        env = dict(os.environ)
+        env.update({
+            "SHIM_PATH": os.path.join(shim_build, "libvtpu-control.so"),
+            "VTPU_REAL_TPU_LIBRARY_PATH":
+                os.path.join(shim_build, "libfake-pjrt.so"),
+            "VTPU_MEM_LIMIT_0": str(1 << 30),
+            "VTPU_CORE_LIMIT_0": "25",
+            "VTPU_TC_UTIL_PATH": tc_path,
+            "VTPU_VMEM_PATH": "/nonexistent",
+            "VTPU_LOCK_DIR": str(tmp_path / "locks"),
+            "VTPU_CONFIG_PATH": "/nonexistent",
+            "FAKE_EXEC_US": "2000",
+            "FAKE_OBS_LATENCY_US": "2000",
+            "FAKE_OBS_ASYM": "1",
+            "SHIM_OBS_EXPECT_MS": "640,1280",
+            "VTPU_LOGGER_LEVEL": "2",
+        })
+        res = subprocess.run([os.path.join(shim_build, "shim_test"),
+                              "--obs-latency"], env=env, timeout=120,
+                             capture_output=True, text=True)
+    finally:
+        feed.close()
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "feed calibration adopted" in res.stderr, res.stderr[-2000:]
+    assert "ALL PASS" in res.stdout

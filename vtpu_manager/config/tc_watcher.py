@@ -28,9 +28,13 @@ from vtpu_manager.util import consts
 from vtpu_manager.util.flock import byte_range_write_lock
 
 MAGIC = 0x55544356            # "VCTU"
-VERSION = 1
+# v2 appends the transport-calibration block (obs_calibrate excess table)
+# after the records; v1 files (no block) are still readable — the C shim
+# accepts both sizes, and the daemon's create path migrates on restart.
+VERSION = 2
 MAX_DEVICE_COUNT = 64
 MAX_PROCS = 32
+MAX_EXCESS_POINTS = 8
 
 # header: magic u32, version u32, device_count i32, pad i32
 _HEADER_FMT = "<IIii"
@@ -49,7 +53,17 @@ _RECORD_HEAD_FMT = "<QQii"
 RECORD_SIZE = struct.calcsize(_RECORD_HEAD_FMT) + MAX_PROCS * PROC_SIZE
 assert RECORD_SIZE == 24 + 32 * 24
 
-FILE_SIZE = HEADER_SIZE + MAX_DEVICE_COUNT * RECORD_SIZE
+# calibration block (one per host — the transport is per-host): seq u64,
+# timestamp_ns u64, n_points i32, pad i32, gap_us[8] i64, excess_us[8] i64.
+# Live-updatable: env-injected tables freeze at container start, but the
+# transport regime changes (measured: lying-events vs flush-floor on one
+# tunnel across sessions), so running shims read this each watcher tick.
+_CAL_FMT = f"<QQii{MAX_EXCESS_POINTS}q{MAX_EXCESS_POINTS}q"
+CAL_SIZE = struct.calcsize(_CAL_FMT)
+assert CAL_SIZE == 24 + 2 * 8 * MAX_EXCESS_POINTS
+
+CAL_OFFSET = HEADER_SIZE + MAX_DEVICE_COUNT * RECORD_SIZE
+FILE_SIZE = CAL_OFFSET + CAL_SIZE
 
 
 @dataclass
@@ -94,8 +108,25 @@ class TcUtilFile:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             from vtpu_manager.util.flock import FileLock
             with FileLock(path + ".create.lock"):
-                if (not os.path.exists(path)
-                        or os.path.getsize(path) != FILE_SIZE):
+                size = (os.path.getsize(path) if os.path.exists(path)
+                        else -1)
+                if size == CAL_OFFSET and self._magic_ok(path):
+                    # v1 -> v2 upgrade: GROW in place (ftruncate + version
+                    # bump). A rename-replace would orphan every running
+                    # shim's mmap of the old inode, silently killing their
+                    # external feed mid-flight; growing keeps the v1
+                    # record region mapped and valid while new readers see
+                    # the appended calibration block. (Old shim *binaries*
+                    # started after the upgrade reject the larger size,
+                    # but the daemon installs the new shim before serving,
+                    # so that pairing is transient by construction.)
+                    fd = os.open(path, os.O_RDWR)
+                    try:
+                        os.ftruncate(fd, FILE_SIZE)
+                        os.pwrite(fd, struct.pack("<I", VERSION), 4)
+                    finally:
+                        os.close(fd)
+                elif size != FILE_SIZE:
                     tmp = f"{path}.tmp.{os.getpid()}"
                     with open(tmp, "wb") as f:
                         f.write(struct.pack(_HEADER_FMT, MAGIC, VERSION,
@@ -104,20 +135,31 @@ class TcUtilFile:
                     os.rename(tmp, path)
         self._fd = os.open(path, os.O_RDWR)
         try:
-            self._mm = mmap.mmap(self._fd, FILE_SIZE)
+            size = os.fstat(self._fd).st_size
+            self._mm = mmap.mmap(self._fd, min(size, FILE_SIZE))
         except (ValueError, OSError):
             os.close(self._fd)
             self._fd = None
             raise
         magic, version, self.device_count, _ = struct.unpack_from(
             _HEADER_FMT, self._mm, 0)
-        if magic != MAGIC or version != VERSION:
+        if magic != MAGIC or not 1 <= version <= VERSION:
             self.close()
             raise ValueError(f"bad tc_util file {path}")
+        # v1 files lack the calibration block; record surface availability
+        self._has_cal = version >= 2 and len(self._mm) >= FILE_SIZE
         if reset:
             empty = DeviceUtil(timestamp_ns=0, device_util=0)
             for i in range(MAX_DEVICE_COUNT):
                 self.write_device(i, empty)
+
+    @staticmethod
+    def _magic_ok(path: str) -> bool:
+        try:
+            with open(path, "rb") as f:
+                return struct.unpack("<I", f.read(4))[0] == MAGIC
+        except (OSError, struct.error):
+            return False
 
     def close(self) -> None:
         if getattr(self, "_mm", None) is not None:
@@ -148,6 +190,49 @@ class TcUtilFile:
                 struct.pack_into(_PROC_FMT, self._mm, poff + i * PROC_SIZE,
                                  p.pid, p.util, p.mem_used, p.owner_token)
             struct.pack_into("<Q", self._mm, off, wseq + 1)  # even: stable
+
+    def write_calibration(self, table: list[tuple[int, int]],
+                          now_ns: int | None = None) -> None:
+        """Publish the transport span-inflation excess table (one per
+        host). Same seqlock discipline as device records; running shims
+        adopt it on their next watcher tick — the live-update channel that
+        env injection cannot provide."""
+        if not self._has_cal:
+            raise ValueError("tc_util file has no calibration block (v1)")
+        pts = table[:MAX_EXCESS_POINTS]
+        now_ns = time.monotonic_ns() if now_ns is None else now_ns
+        gaps = [g for g, _ in pts] + [0] * (MAX_EXCESS_POINTS - len(pts))
+        exc = [e for _, e in pts] + [0] * (MAX_EXCESS_POINTS - len(pts))
+        with byte_range_write_lock(self._fd, CAL_OFFSET, CAL_SIZE):
+            seq, = struct.unpack_from("<Q", self._mm, CAL_OFFSET)
+            wseq = seq | 1
+            struct.pack_into("<Q", self._mm, CAL_OFFSET, wseq)
+            struct.pack_into(_CAL_FMT, self._mm, CAL_OFFSET, wseq, now_ns,
+                             len(pts), 0, *gaps, *exc)
+            struct.pack_into("<Q", self._mm, CAL_OFFSET, wseq + 1)
+
+    def read_calibration(self, retries: int = 8
+                         ) -> list[tuple[int, int]] | None:
+        """Lock-free seqlock read of the excess table; None when absent
+        (v1 file), never written, or mid-write for all retries."""
+        if not self._has_cal:
+            return None
+        for _ in range(retries):
+            seq1, = struct.unpack_from("<Q", self._mm, CAL_OFFSET)
+            if seq1 & 1:
+                time.sleep(0.0002)
+                continue
+            vals = struct.unpack_from(_CAL_FMT, self._mm, CAL_OFFSET)
+            seq2, = struct.unpack_from("<Q", self._mm, CAL_OFFSET)
+            if seq1 != seq2:
+                continue
+            n = max(0, min(vals[2], MAX_EXCESS_POINTS))
+            if n == 0:
+                return None
+            gaps = vals[4:4 + MAX_EXCESS_POINTS]
+            exc = vals[4 + MAX_EXCESS_POINTS:4 + 2 * MAX_EXCESS_POINTS]
+            return [(gaps[i], exc[i]) for i in range(n)]
+        return None
 
     # -- reader (shim / metrics) -------------------------------------------
 

@@ -105,6 +105,17 @@ def encode_table(table: list[tuple[int, int]]) -> str:
     return ",".join(f"{g}:{e}" for g, e in table)
 
 
+def decode_table(raw: str) -> list[tuple[int, int]]:
+    """Inverse of encode_table ("gap_us:excess_us,..."); raises ValueError
+    on malformed input. The single Python home for the wire format (the C
+    parser in enforce.cc LoadDynamicConfig is the other consumer)."""
+    out = []
+    for part in raw.split(","):
+        gap, _, excess = part.partition(":")
+        out.append((int(gap), int(excess)))
+    return out
+
+
 def _jax_run_once() -> Callable[[], None] | None:
     try:
         import jax

@@ -65,6 +65,12 @@ class TcWatcherDaemon:
         # namespace-local: two containers' shims can both be "pid 7"
         self._last_activity: dict[tuple[int, int, int], int] = {}
 
+    def publish_calibration(self, table: list[tuple[int, int]]) -> None:
+        """Publish the obs_calibrate excess table into the feed's v2
+        calibration block; running shims adopt it on their next tick
+        (the live channel — env injection freezes at container start)."""
+        self.tc_file.write_calibration(table)
+
     def tick(self, now_ns: int | None = None) -> None:
         now_ns = time.monotonic_ns() if now_ns is None else now_ns
         entries = self.vmem.entries() if self.vmem is not None else []
