@@ -229,3 +229,23 @@ def test_unattributed_ledger_rows_skipped(tmp_path):
     # but the chip-level physical usage still counts the ghost's bytes
     assert 'vtpu_device_memory_used_bytes{node="n1",' \
         f'uuid="{chips[0].uuid}",index="0"}} 5000.0' in text
+
+
+def test_calibration_gauges(tmp_path):
+    chips = [fake_chip(0)]
+    tc_path = str(tmp_path / "tc.config")
+    tc = tc_watcher.TcUtilFile(tc_path, create=True)
+    tc.write_calibration([(0, 0), (60000, 730), (250000, 1700)])
+    tc.close()
+    text = NodeCollector("n1", chips, base_dir=str(tmp_path / "none"),
+                         tc_path=tc_path, vmem_path="/nonexistent").render()
+    assert 'vtpu_node_obs_excess_max_us{node="n1"} 1700.0' in text
+    assert 'vtpu_node_obs_calibration_age_seconds{node="n1"}' in text
+
+    # uncalibrated feed: no excess rows (absence = uncalibrated)
+    tc2_path = str(tmp_path / "tc2.config")
+    tc_watcher.TcUtilFile(tc2_path, create=True).close()
+    text2 = NodeCollector("n1", chips, base_dir=str(tmp_path / "none"),
+                          tc_path=tc2_path,
+                          vmem_path="/nonexistent").render()
+    assert "vtpu_node_obs_excess_max_us{" not in text2

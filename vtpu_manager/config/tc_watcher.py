@@ -215,6 +215,16 @@ class TcUtilFile:
                          ) -> list[tuple[int, int]] | None:
         """Lock-free seqlock read of the excess table; None when absent
         (v1 file), never written, or mid-write for all retries."""
+        full = self.read_calibration_full(retries)
+        return full[0] if full is not None else None
+
+    def read_calibration_full(self, retries: int = 8
+                              ) -> tuple[list[tuple[int, int]], int] | None:
+        """(table, timestamp_ns) validated in ONE seqlock window — the
+        timestamp must never be read bare from the mmap: a concurrent
+        write_calibration rewrites the whole block, and a torn timestamp
+        paired with another generation's table misreports calibration
+        age."""
         if not self._has_cal:
             return None
         for _ in range(retries):
@@ -231,7 +241,7 @@ class TcUtilFile:
                 return None
             gaps = vals[4:4 + MAX_EXCESS_POINTS]
             exc = vals[4 + MAX_EXCESS_POINTS:4 + 2 * MAX_EXCESS_POINTS]
-            return [(gaps[i], exc[i]) for i in range(n)]
+            return [(gaps[i], exc[i]) for i in range(n)], vals[1]
         return None
 
     # -- reader (shim / metrics) -------------------------------------------

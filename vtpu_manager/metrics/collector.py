@@ -142,8 +142,23 @@ class NodeCollector:
         # percent OF ONE CHIP — summing across chips would exceed 100.
         util_by_token: dict[tuple[int, int], int] = {}
         proc_utils: list[tuple[int, int, int, int]] = []  # token,chip,pid,%
+        g_cal_max = Gauge("vtpu_node_obs_excess_max_us",
+                          "Max point of the published transport "
+                          "span-inflation excess table (absent = "
+                          "uncalibrated; 0 = calibrated clean transport)",
+                          ("node",))
+        g_cal_age = Gauge("vtpu_node_obs_calibration_age_seconds",
+                          "Age of the feed's calibration block",
+                          ("node",))
         try:
             tc = TcUtilFile(self.tc_path)
+            cal_full = tc.read_calibration_full()
+            if cal_full is not None:
+                cal, cal_ts = cal_full
+                g_cal_max.set((self.node_name,),
+                              float(max(e for _, e in cal)))
+                if cal_ts:
+                    g_cal_age.set((self.node_name,), _age_seconds(cal_ts))
             for chip in self.chips:
                 rec = tc.read_device(chip.index)
                 if rec is not None:
@@ -161,7 +176,7 @@ class NodeCollector:
             tc.close()
         except (OSError, ValueError):
             pass
-        gauges += [g_util, g_feed_age]
+        gauges += [g_util, g_feed_age, g_cal_max, g_cal_age]
 
         # ---- vmem ledger: usage + heartbeat ----
         vmem = None
