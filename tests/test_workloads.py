@@ -157,3 +157,85 @@ class TestHostOffload:
         for w in params:
             y_plain = jnp.tanh(y_plain @ w)
         assert jnp.allclose(y_streamed, y_plain, atol=1e-5)
+
+
+class TestPipelineParallel:
+    def test_matches_sequential_reference(self):
+        from jax.sharding import Mesh
+
+        from vtpu_manager.workloads import pipeline as pp
+
+        devices = jax.devices()
+        if len(devices) < 4:
+            pytest.skip("needs 4 devices")
+        mesh = Mesh(devices[:4], ("stage",))
+        params = pp.stage_params(jax.random.PRNGKey(0), n_stages=4,
+                                 width=16)
+        x = jax.random.normal(jax.random.PRNGKey(1), (6, 3, 16))
+        out = pp.make_pipeline_forward(mesh)(
+            jax.device_put(params, pp.param_shardings(mesh)), x)
+        ref = jax.vmap(lambda m: pp.reference_forward(params, m))(x)
+        import numpy as np
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_bubble_schedule_tick_count(self):
+        """The scan runs exactly n_micro + n_stages - 1 ticks — the GPipe
+        bubble — visible in the jaxpr's scan length."""
+        from jax.sharding import Mesh
+
+        from vtpu_manager.workloads import pipeline as pp
+
+        devices = jax.devices()
+        if len(devices) < 4:
+            pytest.skip("needs 4 devices")
+        mesh = Mesh(devices[:4], ("stage",))
+        params = pp.stage_params(jax.random.PRNGKey(0), 4, 16)
+        x = jnp.zeros((5, 2, 16))
+        jaxpr = jax.make_jaxpr(
+            lambda p, m: pp.make_pipeline_forward(mesh)(p, m))(
+                jax.device_put(params, pp.param_shardings(mesh)), x)
+        # the scan eqn's length param pins the 5 + 4 - 1 tick schedule
+        # (shape digits can't collide with "length=8")
+        assert "length=8" in str(jaxpr), str(jaxpr)[:500]
+
+
+class TestExpertParallel:
+    def _setup(self, n_dev, tokens, n_experts, capacity):
+        from jax.sharding import Mesh
+
+        from vtpu_manager.workloads import moe
+
+        devices = jax.devices()
+        if len(devices) < n_dev:
+            pytest.skip(f"needs {n_dev} devices")
+        mesh = Mesh(devices[:n_dev], ("expert",))
+        params = moe.moe_params(jax.random.PRNGKey(0), n_experts,
+                                width=16, hidden=32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (tokens, 16))
+        return moe, mesh, params, x
+
+    def test_matches_dense_reference_no_drops(self):
+        moe, mesh, params, x = self._setup(4, tokens=32, n_experts=8,
+                                           capacity=8)
+        out = moe.make_moe_forward(mesh, capacity=8)(
+            jax.device_put(params, moe.param_shardings(mesh)), x)
+        ref = moe.reference_moe_per_shard(params, x, 8, 4)
+        import numpy as np
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5,
+                                   rtol=1e-5)
+
+    def test_capacity_drops_match_reference(self):
+        """Overflow tokens must be dropped identically (combine weight 0)
+        in the sharded and dense paths — per-token-shard capacity, the
+        Switch per-device-batch semantics."""
+        moe, mesh, params, x = self._setup(4, tokens=32, n_experts=8,
+                                           capacity=1)
+        out = moe.make_moe_forward(mesh, capacity=1)(
+            jax.device_put(params, moe.param_shardings(mesh)), x)
+        ref = moe.reference_moe_per_shard(params, x, 1, 4)
+        import numpy as np
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5,
+                                   rtol=1e-5)
+        # drops actually happened: some rows are exactly zero in both
+        assert (np.abs(ref).sum(axis=1) == 0).any()

@@ -1,0 +1,108 @@
+"""Pipeline parallelism: layer stages sharded over a mesh axis.
+
+GPipe-style schedule, TPU-idiomatic: every device holds ONE stage's
+weights (stacked params sharded over the ``stage`` axis); activations hop
+stage→stage with ``lax.ppermute`` over the ICI ring inside ``shard_map``;
+microbatches stream through a single ``lax.scan`` of n_micro + n_stages − 1
+ticks (the bubble). Nothing is hand-scheduled beyond the rotation — each
+tick every device runs its stage on whatever the ring delivered, so the
+compute is one fused XLA loop body, not n_stages separate programs.
+
+This is the tenant-side counterpart of the manager's topology allocator:
+a contiguous mesh window makes every stage hop a single-hop ICI transfer.
+
+Verified against the unsharded sequential forward in
+tests/test_workloads.py and dryrun_multichip (__graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stage_params(key: jax.Array, n_stages: int, width: int) -> dict:
+    """Stacked per-stage MLP block params, leading axis = stage."""
+    k1, k2 = jax.random.split(key)
+    scale = width ** -0.5
+    return {
+        "w1": jax.random.normal(k1, (n_stages, width, width)) * scale,
+        "w2": jax.random.normal(k2, (n_stages, width, width)) * scale,
+    }
+
+
+def stage_fn(params_slice: dict, x: jax.Array) -> jax.Array:
+    """One stage's compute: residual MLP block (matmuls — MXU work)."""
+    h = jnp.tanh(x @ params_slice["w1"])
+    return x + h @ params_slice["w2"]
+
+
+def reference_forward(params: dict, x: jax.Array) -> jax.Array:
+    """Sequential (unsharded) forward: stages applied in order."""
+    n_stages = params["w1"].shape[0]
+    for s in range(n_stages):
+        x = stage_fn(jax.tree.map(lambda p: p[s], params), x)
+    return x
+
+
+def param_shardings(mesh: Mesh, axis: str = "stage") -> dict:
+    ns = NamedSharding(mesh, P(axis))
+    return {"w1": ns, "w2": ns}
+
+
+def make_pipeline_forward(mesh: Mesh, axis: str = "stage"):
+    """Forward over [n_micro, micro_batch, width] inputs; microbatches
+    enter stage 0 one per tick and exit stage n−1 in order."""
+    n_stages = mesh.shape[axis]
+    fwd = functools.partial(_pipeline_shard, n_stages=n_stages, axis=axis)
+    mapped = jax.shard_map(
+        fwd, mesh=mesh,
+        in_specs=({"w1": P(axis), "w2": P(axis)}, P(None)),
+        out_specs=P(None))
+    return jax.jit(mapped)
+
+
+def _pipeline_shard(params: dict, x: jax.Array, *, n_stages: int,
+                    axis: str):
+    """Per-device body. params' stage axis is sharded to size 1 here;
+    x:[n_micro, micro, width] is replicated (small activations — the
+    schedule, not the storage, is the point of this workload)."""
+    my_stage = jax.lax.axis_index(axis)
+    local = jax.tree.map(lambda p: p[0], params)
+    n_micro = x.shape[0]
+    ticks = n_micro + n_stages - 1
+    # ring: stage s sends its output to s+1; the last stage's output is
+    # collected, not forwarded (its ppermute slot wraps to 0 and is
+    # overwritten by fresh input there)
+    fwd_perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+    def tick(carry, t):
+        inflight, outputs = carry
+        # stage 0 ingests microbatch t (bubble ticks feed zeros that are
+        # never collected); others take what the ring delivered
+        feed = jnp.where(t < n_micro, x[jnp.minimum(t, n_micro - 1)],
+                         jnp.zeros_like(inflight))
+        cur = jnp.where(my_stage == 0, feed, inflight)
+        out = stage_fn(local, cur)
+        # the last stage completes microbatch t-(n_stages-1) at tick t
+        done_idx = t - (n_stages - 1)
+        is_done = jnp.logical_and(my_stage == n_stages - 1, done_idx >= 0)
+        outputs = jnp.where(
+            is_done,
+            outputs.at[jnp.maximum(done_idx, 0)].set(out),
+            outputs)
+        nxt = jax.lax.ppermute(out, axis, fwd_perm)
+        return (nxt, outputs), None
+
+    # the carry becomes stage-varying inside the body; the zeros init must
+    # be marked varying up front or the scan's carry types mismatch
+    init = jax.lax.pcast((jnp.zeros_like(x[0]), jnp.zeros_like(x)),
+                         (axis,), to="varying")
+    (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+    # outputs live on the last stage; share them (replicated out_spec)
+    return jax.lax.psum(
+        jnp.where(my_stage == n_stages - 1, outputs,
+                  jnp.zeros_like(outputs)), axis)
