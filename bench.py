@@ -73,6 +73,21 @@ def tpu_env(quota: int, mem_limit: int = 0) -> dict:
     return env
 
 
+def register_axon(so_path: str | None = None) -> None:
+    """The axon-tunnel registration incantation, in ONE place (bench
+    workers, the HBM probe, diagnostics, and the real-TPU smoke tests all
+    need it; call BEFORE importing jax)."""
+    import uuid
+
+    from axon.register import register
+    register(None,
+             f"{os.environ.get('PALLAS_AXON_TPU_GEN', 'v5e')}:1x1x1",
+             so_path=so_path or AXON_PLUGIN,
+             session_id=str(uuid.uuid4()),
+             remote_compile=os.environ.get(
+                 "PALLAS_AXON_REMOTE_COMPILE", "1") == "1")
+
+
 def tpu_healthy(timeout_s: int = 120) -> bool:
     """Gate the TPU sweep on a trivial program finishing promptly — the
     tunnel transport can wedge independent of this framework, and three
@@ -145,14 +160,8 @@ def worker_main() -> None:
     """Runs inside the quota subprocess: sync trainer loop on the TPU.
     VTPU_BENCH_NOSHIM=1 loads the real plugin directly (shim-off baseline
     for the overhead metric)."""
-    import uuid
-
-    from axon.register import register
     so = AXON_PLUGIN if os.environ.get("VTPU_BENCH_NOSHIM") == "1" else SHIM
-    register(None, f"{os.environ.get('PALLAS_AXON_TPU_GEN', 'v5e')}:1x1x1",
-             so_path=so, session_id=str(uuid.uuid4()),
-             remote_compile=os.environ.get(
-                 "PALLAS_AXON_REMOTE_COMPILE", "1") == "1")
+    register_axon(so)
     import jax
     import jax.numpy as jnp
 
@@ -189,11 +198,8 @@ def run_hbm_check() -> int:
     """Exact-cap check: 64 MiB cap must reject a 256 MiB materialization.
     Returns 0 on exact enforcement, 100 on violation/unknown."""
     code = (
-        "import os,sys,uuid\n"
-        "from axon.register import register\n"
-        f"register(None, os.environ.get('PALLAS_AXON_TPU_GEN','v5e')+':1x1x1', so_path={SHIM!r},\n"
-        "         session_id=str(uuid.uuid4()),\n"
-        "         remote_compile=os.environ.get('PALLAS_AXON_REMOTE_COMPILE','1')=='1')\n"
+        f"import sys; sys.path.insert(0, {REPO!r})\n"
+        f"from bench import register_axon; register_axon({SHIM!r})\n"
         "import jax, jax.numpy as jnp\n"
         "x = jnp.ones((64,64), jnp.float32); (x@x).block_until_ready()\n"
         "try:\n"

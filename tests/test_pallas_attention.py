@@ -1,5 +1,7 @@
 """Pallas block-attention kernel vs the exact reference (interpret mode)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -59,3 +61,41 @@ class TestPallasBlockAttention:
         ref = reference_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
+
+@pytest.mark.skipif(os.environ.get("VTPU_TPU_TESTS") != "1",
+                    reason="VTPU_TPU_TESTS=1 unlocks real-TPU smoke tests")
+def test_compiled_kernel_on_real_tpu():
+    """Mosaic-compiled (non-interpret) kernel on the real chip, in a
+    subprocess because conftest pins this process to CPU. Tolerance is
+    1e-2, not the CPU 2e-5: TPU default matmul precision feeds bf16
+    multiplicands to both the kernel and the XLA reference and they round
+    differently."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from bench import tpu_env
+    code = """
+import sys
+sys.path.insert(0, %r)
+from bench import register_axon
+register_axon()
+import jax, jax.numpy as jnp
+from vtpu_manager.workloads import pallas_attention as pa
+from vtpu_manager.workloads import ring_attention as ra
+S = 512
+q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, S, 64), jnp.float32)
+k = jax.random.normal(jax.random.PRNGKey(1), q.shape, jnp.float32)
+v = jax.random.normal(jax.random.PRNGKey(2), q.shape, jnp.float32)
+bias = jnp.where(jnp.arange(S)[:, None] >= jnp.arange(S)[None, :],
+                 0.0, -jnp.inf).astype(jnp.float32)
+o, m, l = pa.attention_block(q, k, v, bias, interpret=False)
+out = pa.combine_blocks([(o, m, l)])
+ref = ra.reference_attention(q, k, v, causal=True)
+err = float(jnp.max(jnp.abs(out - ref)))
+assert err < 1e-2, err
+print("PALLAS_TPU_OK", err)
+""" % repo
+    res = subprocess.run([_sys.executable, "-c", code], env=tpu_env(100),
+                         capture_output=True, text=True, timeout=280)
+    assert "PALLAS_TPU_OK" in res.stdout, res.stdout + res.stderr[-800:]

@@ -28,8 +28,10 @@ except ImportError:   # pragma: no cover
 def _attn_block_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref,
                        *, scale: float):
     """One (batch, head) program: q [Sq, D], k/v [Sk, D], bias [Sq, Sk].
-    Outputs: unnormalized o [Sq, D], running max m [Sq], sum l [Sq] —
-    combinable across ring steps by the caller."""
+    Output refs: unnormalized o [Sq, D], running max m [Sq, 1], sum
+    l [Sq, 1] (trailing singleton: Mosaic block-shape rule — see the
+    comment at the writes); attention_block squeezes them back to [Sq]
+    for the callers, which combine across ring steps."""
     q = q_ref[...].astype(jnp.float32)
     k = k_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
@@ -45,8 +47,11 @@ def _attn_block_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref,
     o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     o_ref[...] = o
-    m_ref[...] = m
-    l_ref[...] = jnp.sum(p, axis=-1)
+    # m/l are carried as [Sq, 1]: Mosaic requires the last two block dims
+    # to be (8,128)-divisible or equal to the array dims, which a rank-3
+    # [.., Sq] block with a singleton head dim violates on real TPU
+    m_ref[...] = m[:, None]
+    l_ref[...] = jnp.sum(p, axis=-1)[:, None]
 
 
 def attention_block(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -74,7 +79,8 @@ def attention_block(q: jax.Array, k: jax.Array, v: jax.Array,
                                         vma=frozenset(vma))
         return jax.ShapeDtypeStruct(shape, jnp.float32)
 
-    out_shapes = (sds((b, h, sq, d)), sds((b, h, sq)), sds((b, h, sq)))
+    out_shapes = (sds((b, h, sq, d)), sds((b, h, sq, 1)),
+                  sds((b, h, sq, 1)))
     o, m, l = pl.pallas_call(
         lambda q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref:
             kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
@@ -84,12 +90,12 @@ def attention_block(q: jax.Array, k: jax.Array, v: jax.Array,
         in_specs=[qspec(sq), qspec(sk), qspec(sk),
                   pl.BlockSpec((sq, sk), lambda i, j: (0, 0))],
         out_specs=(qspec(sq),
-                   pl.BlockSpec((1, 1, sq), lambda i, j: (i, j, 0)),
-                   pl.BlockSpec((1, 1, sq), lambda i, j: (i, j, 0))),
+                   pl.BlockSpec((1, 1, sq, 1), lambda i, j: (i, j, 0, 0)),
+                   pl.BlockSpec((1, 1, sq, 1), lambda i, j: (i, j, 0, 0))),
         out_shape=out_shapes,
         interpret=interpret,
     )(q, k, v, bias)
-    return o, m, l
+    return o, m[..., 0], l[..., 0]
 
 
 def make_pallas_block_fn(axis_name: str):
