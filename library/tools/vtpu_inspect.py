@@ -80,6 +80,18 @@ def dump_watcher(path: str) -> None:
     except (OSError, ValueError):
         print("  (absent)")
         return
+    cal = feed.read_calibration_full()
+    if cal is not None:
+        table, ts = cal
+        # _age_seconds maps pre-reboot stamps (negative delta on a fresh
+        # monotonic clock) to inf — "very stale", never a negative age
+        from vtpu_manager.metrics.collector import _age_seconds
+        age = _age_seconds(ts) if ts else None
+        pts = ",".join(f"{g}:{e}" for g, e in table)
+        print(f"  calibration: {pts}"
+              + (f" (age {age:.0f}s)" if age is not None else ""))
+    else:
+        print("  calibration: (none)")
     shown = 0
     for i in range(tc_watcher.MAX_DEVICE_COUNT):
         rec = feed.read_device(i)
