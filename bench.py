@@ -110,9 +110,13 @@ def calibrate_obs_overhead() -> str | None:
     module (manager/obs_calibrate.py): the gap-indexed span-inflation
     excess table of a reference program on the plain (shim-less)
     transport. The sweep workers get it as VTPU_OBS_EXCESS_TABLE, exactly
-    as the device plugin injects it into tenant containers."""
+    as the device plugin injects it into tenant containers. The reference
+    program is sized to the flagship workload (8192² vs the daemon's
+    6144² default) — inflation can depend on program/output size."""
     from vtpu_manager.manager.obs_calibrate import calibrate_in_subprocess
-    return calibrate_in_subprocess(env=dict(os.environ))
+    env = dict(os.environ)
+    env.setdefault("VTPU_OBS_CAL_DIM", "8192")
+    return calibrate_in_subprocess(timeout_s=400, env=env)
 
 
 def run_tpu_worker_best(quota: int, no_shim: bool = False,
