@@ -55,6 +55,9 @@ def _collect(procs: list) -> list[tuple[float, float]]:
             m = re.search(rf"RANK {rank} loss=([\d.]+) leaf=(-?[\d.]+)",
                           out)
             assert m, f"rank {rank} printed no result:\n{out[-1000:]}"
+            # the ring-attention ppermute crossed the process boundary
+            # and every rank's sequence shard matched the dense reference
+            assert f"RANK {rank} ring=OK" in out, out[-1000:]
             results.append((float(m.group(1)), float(m.group(2))))
     finally:
         for proc in procs:
