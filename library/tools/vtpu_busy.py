@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""vtpu_busy: drive a TPU chip at a target duty cycle.
+
+Reference analogue: library/tools/gpu_busy.cu — the operator's manual
+load generator for validating quota enforcement: run it in a tenant
+container at --duty 100 and watch the shim pace it to the container's
+core limit (nvidia-smi's role is played by the device-monitor gauges or
+`vtpu_inspect`).
+
+Duty cycling: each period runs back-to-back matmul steps for
+duty% × period, then sleeps the rest. With the shim loaded the *achieved*
+rate is min(--duty, container core limit); unmanaged it holds --duty.
+
+    python library/tools/vtpu_busy.py --duty 60 --seconds 30
+    python library/tools/vtpu_busy.py --dim 4096 --report-every 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duty", type=int, default=100,
+                        help="target busy percent per period")
+    parser.add_argument("--period-ms", type=int, default=500)
+    parser.add_argument("--seconds", type=float, default=0,
+                        help="0 = run until interrupted")
+    parser.add_argument("--dim", type=int, default=4096,
+                        help="bf16 matmul edge (sizes one step)")
+    parser.add_argument("--report-every", type=float, default=2.0)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(a):
+        return jnp.tanh(a @ a) * 1e-3
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (args.dim, args.dim),
+                          jnp.bfloat16)
+    # warmup + per-step cost estimate (sync via scalar readback so the
+    # measurement is honest on lying-event transports)
+    for _ in range(2):
+        x = step(x)
+        _ = float(x[0, 0])
+    t0 = time.perf_counter()
+    x = step(x)
+    _ = float(x[0, 0])
+    step_s = time.perf_counter() - t0
+
+    period_s = args.period_ms / 1000.0
+    busy_target = period_s * min(max(args.duty, 0), 100) / 100.0
+    deadline = time.time() + args.seconds if args.seconds else None
+    busy_acc = 0.0
+    wall_start = time.perf_counter()
+    last_report = wall_start
+    steps = 0
+    print(f"step ~{step_s * 1000:.1f} ms, duty {args.duty}% of "
+          f"{args.period_ms} ms periods; ctrl-c to stop", flush=True)
+    try:
+        while deadline is None or time.time() < deadline:
+            period_start = time.perf_counter()
+            while time.perf_counter() - period_start < busy_target:
+                t = time.perf_counter()
+                x = step(x)
+                _ = float(x[0, 0])
+                busy_acc += time.perf_counter() - t
+                steps += 1
+            rest = period_s - (time.perf_counter() - period_start)
+            if rest > 0:
+                time.sleep(rest)
+            now = time.perf_counter()
+            if now - last_report >= args.report_every:
+                wall = now - wall_start
+                print(f"achieved {100 * busy_acc / wall:5.1f}% busy "
+                      f"({steps} steps, {wall:.1f}s)", flush=True)
+                last_report = now
+    except KeyboardInterrupt:
+        pass
+    wall = time.perf_counter() - wall_start
+    if wall > 0:
+        print(f"final: {100 * busy_acc / wall:.1f}% busy over {wall:.1f}s "
+              f"({steps} steps)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
