@@ -22,11 +22,20 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))))
-
 
 def main() -> int:
+    # honor an explicit CPU request even under an ambient tunnel
+    # registration (same guard as __graft_entry__: sitecustomize overrides
+    # platform selection through jax.config and a wedged tunnel would
+    # hang a plain JAX_PLATFORMS=cpu run)
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return _main()
+
+
+def _main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--duty", type=int, default=100,
                         help="target busy percent per period")
@@ -47,49 +56,62 @@ def main() -> int:
 
     x = jax.random.normal(jax.random.PRNGKey(0), (args.dim, args.dim),
                           jnp.bfloat16)
-    # warmup + per-step cost estimate (sync via scalar readback so the
-    # measurement is honest on lying-event transports)
-    for _ in range(2):
+    # Warmup (compile + caches; excluded from the counts). The
+    # unthrottled per-step cost is tracked as a RUNNING MIN over every
+    # step of the run: under a core cap most steps are paced, but the
+    # shim's GAP bypass lets the first step after each long idle proceed
+    # unthrottled, so the minimum span keeps converging to the true cost
+    # even inside an enforced container — the yardstick that makes the
+    # effective-share report meaningful (wall time blocked in the rate
+    # limiter must NOT count as busy).
+    step_s = float("inf")
+    for i in range(4):
+        t0 = time.perf_counter()
         x = step(x)
         _ = float(x[0, 0])
-    t0 = time.perf_counter()
-    x = step(x)
-    _ = float(x[0, 0])
-    step_s = time.perf_counter() - t0
+        if i > 0:   # first call carries compile
+            step_s = min(step_s, time.perf_counter() - t0)
 
     period_s = args.period_ms / 1000.0
     busy_target = period_s * min(max(args.duty, 0), 100) / 100.0
     deadline = time.time() + args.seconds if args.seconds else None
-    busy_acc = 0.0
     wall_start = time.perf_counter()
     last_report = wall_start
     steps = 0
+
+    def effective_pct(wall: float) -> float:
+        # device share actually delivered: completed steps x unthrottled
+        # step cost over wall. Wall time spent BLOCKED in the shim's rate
+        # limiter must not count as busy — a naive busy-wall accumulator
+        # would read ~--duty even while enforcement paces the chip.
+        return 100.0 * steps * step_s / wall if wall > 0 else 0.0
+
     print(f"step ~{step_s * 1000:.1f} ms, duty {args.duty}% of "
           f"{args.period_ms} ms periods; ctrl-c to stop", flush=True)
     try:
         while deadline is None or time.time() < deadline:
             period_start = time.perf_counter()
             while time.perf_counter() - period_start < busy_target:
-                t = time.perf_counter()
+                t0 = time.perf_counter()
                 x = step(x)
                 _ = float(x[0, 0])
-                busy_acc += time.perf_counter() - t
+                step_s = min(step_s, time.perf_counter() - t0)
                 steps += 1
             rest = period_s - (time.perf_counter() - period_start)
             if rest > 0:
                 time.sleep(rest)
             now = time.perf_counter()
             if now - last_report >= args.report_every:
-                wall = now - wall_start
-                print(f"achieved {100 * busy_acc / wall:5.1f}% busy "
-                      f"({steps} steps, {wall:.1f}s)", flush=True)
+                print(f"effective {effective_pct(now - wall_start):5.1f}% "
+                      f"of chip ({steps} steps, "
+                      f"{now - wall_start:.1f}s)", flush=True)
                 last_report = now
     except KeyboardInterrupt:
         pass
     wall = time.perf_counter() - wall_start
     if wall > 0:
-        print(f"final: {100 * busy_acc / wall:.1f}% busy over {wall:.1f}s "
-              f"({steps} steps)", flush=True)
+        print(f"final: effective {effective_pct(wall):.1f}% of chip over "
+              f"{wall:.1f}s ({steps} steps)", flush=True)
     return 0
 
 
