@@ -20,8 +20,20 @@ Run (any backend; on a vtpu tenant the cap applies automatically):
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# honor an explicit CPU request even under an ambient tunnel registration
+# (a wedged tunnel would otherwise hang the demo)
+from vtpu_manager.util.jaxplatform import honor_cpu_request  # noqa: E402
+
+honor_cpu_request()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 from jax.sharding import SingleDeviceSharding
 
 

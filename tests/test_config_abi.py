@@ -220,11 +220,21 @@ class TestTcUtilFile:
                               - tc_watcher.HEADER_SIZE))
         import os
         ino_before = os.stat(path).st_ino
+        # a shim that mapped the v1 file BEFORE the upgrade (the
+        # population the grow-in-place exists for)
+        old_reader = tc_watcher.TcUtilFile(path)
+        assert not old_reader._has_cal
         f = tc_watcher.TcUtilFile(path, create=True)
         assert os.stat(path).st_ino == ino_before   # same inode: grown
         assert os.path.getsize(path) == tc_watcher.FILE_SIZE
         f.write_calibration([(0, 0), (60000, 500)])
         assert f.read_calibration() == [(0, 0), (60000, 500)]
+        # the pre-upgrade mapping still sees record writes made through
+        # the post-upgrade handle: the feed never went dark for it
+        f.write_device(2, tc_watcher.DeviceUtil(timestamp_ns=9,
+                                                device_util=41))
+        assert old_reader.read_device(2).device_util == 41
+        old_reader.close()
         f.close()
 
     def test_v1_file_still_readable_without_calibration(self, tmp_path):

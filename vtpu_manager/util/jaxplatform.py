@@ -1,0 +1,26 @@
+"""Honor an explicit JAX_PLATFORMS=cpu request under an ambient tunnel.
+
+The dev/CI image's sitecustomize may register a remote TPU tunnel PJRT
+plugin before user code runs, and that registration overrides platform
+selection through jax.config — so JAX_PLATFORMS=cpu in the env is
+silently ignored and backend init can wedge against a dead tunnel. The
+one home for the counter-measure (callers: __graft_entry__, examples;
+`library/tools/vtpu_busy.py` keeps an inline copy because, like the
+device-client, it must stay stdlib+jax-only for tenant images that lack
+this package).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_request() -> None:
+    """If the caller asked for CPU, make it stick: drop the tunnel
+    auto-registration trigger and force the config value (safe to call
+    before or after `import jax`; before is cheapest)."""
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
