@@ -102,6 +102,13 @@ struct alignas(128) DeviceHot {
   // not charged for transport time the chip never saw.
   std::atomic<int64_t> obs_overhead_us{0};
   std::atomic<int> obs_samples{0};
+  // Discount actually applied to the previous span (0 when it was
+  // classified overlapped): the observed idle gap underestimates true idle
+  // by exactly the previous END's inflation, and the discount we charged
+  // off that span is our estimate of that inflation — feeding it back is
+  // exact where the old gap+excess(gap) proxy over-inflated after a
+  // back-to-back span (advisor r2: bounded over-discount, slope×max-excess).
+  std::atomic<int64_t> last_discount_us{0};
 };
 static_assert(sizeof(DeviceHot) % 128 == 0, "cacheline isolation");
 

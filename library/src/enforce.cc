@@ -1560,9 +1560,11 @@ void* ProbeMain(void*) {
     // seed that and never probe: on a flush-floor transport the probe
     // would otherwise keep burning ~2 RTTs per round forever to learn a
     // bogus value nothing should use.
+    // (max over the table, not back(): gap order is enforced at parse but
+    // excess values need not be monotone in gap)
     int64_t oh = g_dyn.obs_overhead_us >= 0
                      ? g_dyn.obs_overhead_us
-                     : g_dyn.excess_table.back().excess_us;
+                     : ActiveExcessMax();
     for (int slot = 0; slot < s.device_count; slot++) {
       s.hot[slot].obs_overhead_us.store(oh, std::memory_order_relaxed);
       s.hot[slot].obs_samples.store(1 << 20, std::memory_order_relaxed);
@@ -1811,14 +1813,24 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
     uint64_t disc_ns = oh_ns;
     if (HasActiveExcessTable()) {
       // Gap-indexed calibration: the observed gap underestimates the true
-      // idle time by the previous span's own inflation, so iterate the
-      // lookup once (monotone table => still conservative).
+      // idle time by the previous span's END inflation. The discount we
+      // actually applied to that span IS our estimate of its inflation
+      // (0 when it was overlapped — both its ends inflated equally), so
+      // feed it back rather than the old excess(gap) proxy, which
+      // over-inflated after back-to-back spans and over-discounted by up
+      // to table-slope × max-excess.
       int64_t g0 = gap_us > 0 ? gap_us : 0;
-      int64_t d = ActiveExcessAt(g0 + ActiveExcessAt(g0));
+      int64_t prev_disc =
+          s.hot[slot].last_discount_us.load(std::memory_order_relaxed);
+      int64_t d = ActiveExcessAt(g0 + prev_disc);
       disc_ns = d > 0 ? (uint64_t)d * 1000 : 0;
     }
     if (disc_ns > credit_ns / 2) disc_ns = credit_ns / 2;
     credit_ns -= disc_ns;
+    s.hot[slot].last_discount_us.store((int64_t)(disc_ns / 1000),
+                                       std::memory_order_relaxed);
+  } else {
+    s.hot[slot].last_discount_us.store(0, std::memory_order_relaxed);
   }
   s.hot[slot].busy_ns_window.fetch_add(credit_ns,
                                        std::memory_order_relaxed);

@@ -254,6 +254,21 @@ int main(int argc, char** argv) {
     fprintf(stderr, "SHIM_PATH not set\n");
     return 2;
   }
+  // Fail fast on a misconfigured run: without the quota env the shim loads
+  // unenforced and every check below reports a confusing FAIL (the full
+  // suite needs both; --throttle-only and the special modes set their own).
+  if (!throttle_only && !multichip && !obs_latency) {
+    const char* cfg = getenv("VTPU_CONFIG_PATH");
+    bool have_file = cfg && access(cfg, R_OK) == 0;
+    if (!have_file &&
+        (!getenv("VTPU_MEM_LIMIT_0") || !getenv("VTPU_CORE_LIMIT_0"))) {
+      fprintf(stderr,
+              "precondition: VTPU_MEM_LIMIT_0 and VTPU_CORE_LIMIT_0 must be "
+              "set (e.g. VTPU_MEM_LIMIT_0=1048576 VTPU_CORE_LIMIT_0=50); "
+              "the harness checks enforcement, not pass-through\n");
+      return 2;
+    }
+  }
   void* handle = dlopen(shim_path, RTLD_NOW | RTLD_LOCAL);
   if (!handle) {
     fprintf(stderr, "dlopen(%s): %s\n", shim_path, dlerror());

@@ -135,30 +135,27 @@ class TestPerfMatrix:
 SUSTAINED = os.environ.get("VTPU_PERF_SUSTAINED") == "1"
 
 
-@pytest.mark.skipif(not SUSTAINED,
-                    reason="VTPU_PERF_SUSTAINED=1 unlocks the 100k-pod run")
-def test_sustained_volume_100k_pods():
-    """Reference volume (filter_perf_test.go:40-45 goes to 100k pods):
-    a sustained admission wave must keep per-pod latency flat (no O(pods)
-    growth), the assumed cache bounded, and the no-overcommit invariant
-    intact. Uses informer-fidelity settings: snapshot TTL (the reference
-    reads residents from an informer cache) and shared-object reads
-    (client-go informers do not copy per read). Placed pods get their
-    pre-allocation confirmed (real-allocated) as the kubelet would —
-    without that, leases expire mid-run by design."""
+def _sustained_run(n_pods: int, n_nodes: int = 100) -> dict:
+    """Shared driver for the sustained admission wave (reference volume:
+    filter_perf_test.go:40-45 goes to 100k pods). Informer-fidelity
+    settings: snapshot TTL (the reference reads residents from an informer
+    cache) and shared-object reads (client-go informers do not copy per
+    read). Placed pods get their pre-allocation confirmed (real-allocated)
+    as the kubelet would — without that, leases expire mid-run by design.
+    The report interval adapts to n_pods so `rates` is never empty (the
+    fixed 10k stride crashed every run under 10k pods — r2 verdict)."""
     client = FakeKubeClient(copy_on_read=False)
-    for i in range(100):
+    for i in range(n_nodes):
         reg = dt.fake_registry(4, mesh_shape=(2, 2),
                                uuid_prefix=f"TPU-N{i:05d}")
         client.add_node(dt.fake_node(f"node-{i:05d}", reg))
     pred = FilterPredicate(client, pods_ttl_s=0.25)
     bind = BindPredicate(client)
-    n_pods = int(os.environ.get("VTPU_SUSTAINED_PODS", "100000"))
+    report_every = min(n_pods, 10000, max(250, n_pods // 8))
     placed = 0
     window = []
     rates = {}
-    t0 = time.perf_counter()
-    t_win = t0
+    t_win = time.perf_counter()
     for i in range(n_pods):
         pod = vtpu_pod(i)
         client.add_pod(pod)
@@ -177,7 +174,7 @@ def test_sustained_volume_100k_pods():
                 client.patch_pod_annotations("default", name, {
                     consts.real_allocated_annotation(): pre})
             placed += 1
-        if (i + 1) % 10000 == 0:
+        if (i + 1) % report_every == 0 and window:
             now = time.perf_counter()
             window.sort()
             rates[i + 1] = {
@@ -193,17 +190,44 @@ def test_sustained_volume_100k_pods():
                   f"assumed={rates[i+1]['assumed']}", flush=True)
             window = []
             t_win = now
-    # capacity: 100 nodes x 4 chips x 4 core-fits = 1600
-    assert placed == 1600, placed
-    assert_no_overcommit(client)
+    return {"client": client, "pred": pred, "placed": placed,
+            "rates": rates}
+
+
+def _assert_sustained_invariants(res: dict, capacity: int) -> None:
+    assert res["placed"] == capacity, res["placed"]
+    assert_no_overcommit(res["client"])
     # assumed cache bounded (entries are dropped once commits are visible)
-    assert len(pred._assumed) < 2000
-    # flatness: the last window must not be drastically slower than the
-    # steady-state reached after capacity filled (allow 3x for box noise)
+    assert len(res["pred"]._assumed) < 2000
+    rates = res["rates"]
     marks = sorted(rates)
-    steady = rates[marks[len(marks) // 2]]["p50_ms"]
-    final = rates[marks[-1]]["p50_ms"]
-    assert final < 3 * steady + 1.0, (steady, final)
+    # p50 flatness: the last window must not be drastically slower than
+    # the steady state reached after capacity filled (3x for box noise)
+    steady50 = rates[marks[len(marks) // 2]]["p50_ms"]
+    final50 = rates[marks[-1]]["p50_ms"]
+    assert final50 < 3 * steady50 + 1.0, (steady50, final50)
+    # p99 flatness: with the scheduled-only snapshot the rebuild no longer
+    # scans pending pods, so tail latency must not grow with total
+    # admissions either (the r2 run doubled 29.7->57 ms by pod 100k)
+    steady99 = rates[marks[len(marks) // 2]]["p99_ms"]
+    final99 = rates[marks[-1]]["p99_ms"]
+    assert final99 < 3 * steady99 + 5.0, (steady99, final99)
+
+
+def test_sustained_volume_mini():
+    """Always-on slice of the sustained harness (~2k pods): no-overcommit,
+    flat p50/p99, bounded assumed cache, every CI run."""
+    res = _sustained_run(n_pods=2000, n_nodes=100)
+    _assert_sustained_invariants(res, capacity=1600)
+
+
+@pytest.mark.skipif(not SUSTAINED,
+                    reason="VTPU_PERF_SUSTAINED=1 unlocks the 100k-pod run")
+def test_sustained_volume_100k_pods():
+    n_pods = int(os.environ.get("VTPU_SUSTAINED_PODS", "100000"))
+    res = _sustained_run(n_pods=n_pods, n_nodes=100)
+    # capacity: 100 nodes x 4 chips x 4 core-fits = 1600
+    _assert_sustained_invariants(res, capacity=min(1600, n_pods))
 
 
 def _spread_quality(candidate_limit, n_nodes=300, n_pods=400):

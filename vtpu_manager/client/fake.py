@@ -29,6 +29,11 @@ class FakeKubeClient:
         self._lock = threading.RLock()
         self.nodes: dict[str, dict] = {}
         self.pods: dict[tuple[str, str], dict] = {}
+        # fieldSelector index, maintained on API mutations exactly like the
+        # apiserver's spec.nodeName index: list_pods with
+        # field_selector="spec.nodeName!=" walks only scheduled pods, so a
+        # 100k-pending-pod cluster does not tax every scheduled-pod list.
+        self._scheduled: dict[tuple[str, str], dict] = {}
         self.bindings: list[tuple[str, str, str]] = []   # (ns, pod, node)
         self.evictions: list[tuple[str, str]] = []
         self.deletions: list[tuple[str, str]] = []
@@ -49,9 +54,14 @@ class FakeKubeClient:
 
     def add_pod(self, pod: dict) -> None:
         meta = pod["metadata"]
+        key = (meta.get("namespace", "default"), meta["name"])
         with self._lock:
-            self.pods[(meta.get("namespace", "default"),
-                       meta["name"])] = copy.deepcopy(pod)
+            stored = copy.deepcopy(pod)
+            self.pods[key] = stored
+            if (stored.get("spec") or {}).get("nodeName"):
+                self._scheduled[key] = stored
+            else:
+                self._scheduled.pop(key, None)
 
     # -- KubeClient protocol ------------------------------------------------
 
@@ -81,9 +91,11 @@ class FakeKubeClient:
 
     def list_pods(self, namespace=None, node_name=None,
                   field_selector=None) -> list[dict]:
+        scheduled_only = field_selector == "spec.nodeName!="
         with self._lock:
+            source = self._scheduled if scheduled_only else self.pods
             out = []
-            for (ns, _), pod in self.pods.items():
+            for (ns, _), pod in source.items():
                 if namespace and ns != namespace:
                     continue
                 if node_name and \
@@ -124,6 +136,7 @@ class FakeKubeClient:
             if pod is None:
                 raise KubeError(404, f"pod {namespace}/{name} not found")
             pod.setdefault("spec", {})["nodeName"] = node
+            self._scheduled[(namespace, name)] = pod
             self.bindings.append((namespace, name, node))
 
     def delete_pod(self, namespace: str, name: str,
@@ -132,6 +145,7 @@ class FakeKubeClient:
             if (namespace, name) not in self.pods:
                 raise KubeError(404, f"pod {namespace}/{name} not found")
             del self.pods[(namespace, name)]
+            self._scheduled.pop((namespace, name), None)
             self.deletions.append((namespace, name))
 
     def evict_pod(self, namespace: str, name: str) -> None:
@@ -139,6 +153,7 @@ class FakeKubeClient:
             if (namespace, name) not in self.pods:
                 raise KubeError(404, f"pod {namespace}/{name} not found")
             del self.pods[(namespace, name)]
+            self._scheduled.pop((namespace, name), None)
             self.evictions.append((namespace, name))
 
     def create_event(self, namespace: str, event: dict) -> None:

@@ -199,7 +199,18 @@ class TcUtilFile:
         env injection cannot provide."""
         if not self._has_cal:
             raise ValueError("tc_util file has no calibration block (v1)")
-        pts = table[:MAX_EXCESS_POINTS]
+        # Mirror the C env parser (enforce.cc LoadDynamicConfig): the shim's
+        # InterpExcess assumes ascending gap order, and over-long tables keep
+        # first-7-plus-LAST — the largest-gap plateau is what big-gap spans
+        # clamp to and must survive truncation. An unsorted or first-8 table
+        # pushed through the manual-recalibration pipe would make every
+        # running shim interpolate and clamp wrong.
+        by_gap: dict[int, int] = {}
+        for g, e in table:
+            by_gap[g] = e          # last in INPUT order wins on dup gaps
+        pts = sorted(by_gap.items())
+        if len(pts) > MAX_EXCESS_POINTS:
+            pts = pts[:MAX_EXCESS_POINTS - 1] + [pts[-1]]
         now_ns = time.monotonic_ns() if now_ns is None else now_ns
         gaps = [g for g, _ in pts] + [0] * (MAX_EXCESS_POINTS - len(pts))
         exc = [e for _, e in pts] + [0] * (MAX_EXCESS_POINTS - len(pts))

@@ -10,10 +10,13 @@ are removed entirely.
 PDB handling mirrors the reference two ways:
 - candidates ADDED by us skip pods that match a PodDisruptionBudget with
   zero disruptions allowed (violationOfPDBs, preempt_predicate.go:595-620);
-- the response's NumPDBViolations is a conservative upper bound derived
-  from the input count (pdbViolationsUpperBound, :466-496): of the original
-  violators at most min(original, kept-from-input) survived our refinement,
-  and every victim we appended may be a new violator. Err on the high side:
+- the response's NumPDBViolations is computed EXACTLY over the final victim
+  set by budget-decrementing PDB matching (same derivation as the reference,
+  preempt_predicate.go:466-496: walk victims consuming each matched PDB's
+  remaining disruptionsAllowed; a victim whose eviction would exceed some
+  matched PDB's remaining budget is a violator). Only when a PDB lister
+  fails does the node fall back to the conservative upper bound
+  (min(original, kept-from-input) + added) — erring high there is right:
   under-reporting would make kube-scheduler's pickOneNodeForPreemption
   prefer our node and inflict more real disruption than it should.
 """
@@ -124,7 +127,8 @@ class PreemptPredicate:
             return PreemptResult(node_to_victims=out)
 
         result = PreemptResult()
-        pdb_cache: dict[str, list[dict]] = {}   # one list per namespace
+        # one list per namespace; None = lister failed for that namespace
+        pdb_cache: dict[str, list[dict] | None] = {}
         for node_name, proposal in victims_in.items():
             proposed = self._proposal_pods(node_name, proposal, meta_only)
             kept = self._validate_node(
@@ -155,45 +159,91 @@ class PreemptPredicate:
         resident = self.client.list_pods(node_name=node_name)
         return [p for p in resident if _pod_uid(p) in uids]
 
-    def _pdbs_for_ns(self, ns: str, cache: dict[str, list[dict]]
-                     ) -> list[dict]:
+    def _pdbs_for_ns(self, ns: str,
+                     cache: dict[str, list[dict] | None]
+                     ) -> list[dict] | None:
         """One PDB list per namespace per preempt() call — a per-candidate
-        fetch would be N+1 API requests in the scheduling hot path."""
+        fetch would be N+1 API requests in the scheduling hot path. None
+        (cached) on lister failure so callers can distinguish "no PDBs"
+        from "unknown"."""
         if ns not in cache:
             try:
                 cache[ns] = self.client.list_pdbs(namespace=ns)
             except Exception as e:
-                # Matches the reference's lister-failure behavior (assume
-                # no violation) — but say so: an RBAC gap would otherwise
-                # silently disable PDB protection.
-                log.warning("PDB list failed for namespace %s: %s "
-                            "(treating as no PDBs)", ns, e)
-                cache[ns] = []
+                # An RBAC gap would otherwise silently disable PDB
+                # protection — say so, and let the caller pick its
+                # failure posture (skip-add keeps adding; the violation
+                # count falls back to the upper bound).
+                log.warning("PDB list failed for namespace %s: %s", ns, e)
+                cache[ns] = None
         return cache[ns]
 
-    def _violates_pdb(self, pod: dict,
-                      pdb_cache: dict[str, list[dict]]) -> bool:
-        """True when the pod matches a live PDB in its own namespace with
-        no disruptions left (and is not already recorded as disrupted)."""
+    @staticmethod
+    def _matching_pdbs(pod: dict, pdbs: list[dict]) -> list[dict]:
+        """Live PDBs whose selector matches the pod and which have not
+        already recorded it as disrupted."""
         meta = pod.get("metadata") or {}
         labels = meta.get("labels") or {}
-        ns = meta.get("namespace") or "default"
-        for pdb in self._pdbs_for_ns(ns, pdb_cache):
+        out = []
+        for pdb in pdbs:
             if (pdb.get("metadata") or {}).get("deletionTimestamp"):
                 continue
             status = pdb.get("status") or {}
             if meta.get("name") in (status.get("disruptedPods") or {}):
                 continue   # already counted as disrupted
             spec = pdb.get("spec") or {}
-            if not _label_selector_matches(spec.get("selector"), labels):
-                continue
+            if _label_selector_matches(spec.get("selector"), labels):
+                out.append(pdb)
+        return out
+
+    def _violates_pdb(self, pod: dict,
+                      pdb_cache: dict[str, list[dict] | None]) -> bool:
+        """True when the pod matches a live PDB in its own namespace with
+        no disruptions left. Lister failure reads as no violation (the
+        reference's posture for candidate adding; warned in _pdbs_for_ns)."""
+        ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        pdbs = self._pdbs_for_ns(ns, pdb_cache)
+        for pdb in self._matching_pdbs(pod, pdbs or []):
+            status = pdb.get("status") or {}
             if int(status.get("disruptionsAllowed", 0)) <= 0:
                 return True
         return False
 
+    def _count_pdb_violations(self, pods: list[dict],
+                              pdb_cache: dict[str, list[dict] | None]
+                              ) -> int | None:
+        """Exact violator count over a victim set (reference
+        preempt_predicate.go:466-496): evicting the whole set consumes
+        each matched PDB's disruptionsAllowed one victim at a time; a
+        victim that would push some matched PDB past its remaining budget
+        is a violator. None when any victim's namespace lister failed —
+        the caller then uses the conservative upper bound."""
+        budget: dict[tuple[str, str], int] = {}
+        count = 0
+        for pod in pods:
+            ns = (pod.get("metadata") or {}).get("namespace") or "default"
+            pdbs = self._pdbs_for_ns(ns, pdb_cache)
+            if pdbs is None:
+                return None
+            violates = False
+            matched: list[tuple[str, str]] = []
+            for pdb in self._matching_pdbs(pod, pdbs):
+                key = (ns, (pdb.get("metadata") or {}).get("name", ""))
+                if key not in budget:
+                    budget[key] = int((pdb.get("status") or {})
+                                      .get("disruptionsAllowed", 0))
+                if budget[key] <= 0:
+                    violates = True
+                matched.append(key)
+            for key in matched:
+                budget[key] -= 1
+            if violates:
+                count += 1
+        return count
+
     def _validate_node(self, node_name: str, req, proposed: list[dict],
                        original_pdb: int = 0,
-                       pdb_cache: dict[str, list[dict]] | None = None
+                       pdb_cache: dict[str, list[dict] | None] | None = None
                        ) -> NodeVictims | None:
         if pdb_cache is None:
             pdb_cache = {}
@@ -250,10 +300,11 @@ class PreemptPredicate:
             if fits(set(victims) - {uid}):
                 del victims[uid]
         final = [victims[uid] for uid in sorted(victims)]
-        kept_from_input = sum(1 for p in final
-                              if _pod_uid(p) in proposed_uids)
-        added = len(final) - kept_from_input
-        return NodeVictims(
-            pods=final,
-            num_pdb_violations=pdb_violations_upper_bound(
-                original_pdb, kept_from_input, added))
+        exact = self._count_pdb_violations(final, pdb_cache)
+        if exact is None:
+            kept_from_input = sum(1 for p in final
+                                  if _pod_uid(p) in proposed_uids)
+            added = len(final) - kept_from_input
+            exact = pdb_violations_upper_bound(
+                original_pdb, kept_from_input, added)
+        return NodeVictims(pods=final, num_pdb_violations=exact)
