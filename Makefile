@@ -1,0 +1,92 @@
+# vtpu-manager top-level build entry (reference: Makefile + versions.mk —
+# redesigned for the Python/C++ split: cmake builds the PJRT shim, pytest is
+# the suite, helm renders the chart; no Go toolchain).
+
+include $(CURDIR)/versions.mk
+
+SHELL = /usr/bin/env bash -o pipefail
+.SHELLFLAGS = -ec
+
+BUILD_DIR ?= build-lib
+PYTEST ?= python -m pytest
+CONTAINER_TOOL ?= docker
+
+.PHONY: all
+all: build
+
+##@ General
+
+.PHONY: help
+help: ## Show this help
+	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_0-9-]+:.*?##/ \
+	  {printf "  \033[36m%-18s\033[0m %s\n", $$1, $$2} /^##@/ \
+	  {printf "\n\033[1m%s\033[0m\n", substr($$0, 5)}' $(MAKEFILE_LIST)
+
+##@ Build
+
+.PHONY: build
+build: ## Build the PJRT enforcement shim + test harness (cmake)
+	cmake -S library -B $(BUILD_DIR) -DVTPU_BUILD_TESTS=ON \
+	  -DCMAKE_BUILD_TYPE=Release
+	cmake --build $(BUILD_DIR)
+
+.PHONY: protos
+protos: ## Regenerate *_pb2.py from the in-repo .proto sources
+	cd vtpu_manager/kubeletplugin/api && \
+	  protoc -I. --python_out=. nri.proto ttrpc.proto dra.proto \
+	  pluginregistration.proto
+	cd vtpu_manager/deviceplugin/api && \
+	  protoc -I. --python_out=. deviceplugin.proto podresources.proto
+
+.PHONY: clean
+clean: ## Remove build artifacts
+	rm -rf $(BUILD_DIR)
+
+##@ Test
+
+.PHONY: test
+test: build ## Full hermetic suite (pytest; includes the C harness via fixtures)
+	$(PYTEST) tests/ -x -q
+
+.PHONY: test-shim
+test-shim: build ## C harness alone against the fake PJRT plugin
+	SHIM_PATH=$(CURDIR)/$(BUILD_DIR)/libvtpu-control.so \
+	VTPU_REAL_TPU_LIBRARY_PATH=$(CURDIR)/$(BUILD_DIR)/libfake-pjrt.so \
+	VTPU_MEM_LIMIT_0=1048576 VTPU_CORE_LIMIT_0=50 \
+	VTPU_CONFIG_PATH=/nonexistent VTPU_LOCK_DIR=/tmp/.vtpu_make_locks \
+	VTPU_TC_UTIL_PATH=/nonexistent VTPU_VMEM_PATH=/nonexistent \
+	$(BUILD_DIR)/shim_test
+
+.PHONY: test-perf
+test-perf: ## Opt-in perf matrix + sustained harness (VTPU_PERF=1)
+	VTPU_PERF=1 VTPU_PERF_SUSTAINED=1 VTPU_SUSTAINED_PODS=5000 \
+	$(PYTEST) tests/test_filter_perf.py -q -s
+
+.PHONY: bench
+bench: build ## The driver benchmark (one JSON line; TPU when healthy)
+	python bench.py
+
+##@ Deploy
+
+.PHONY: chart
+chart: ## Render the Helm chart to stdout
+	helm template vtpu-manager charts/vtpu-manager
+
+.PHONY: images
+images: ## Build container images (device plugin stack + DRA driver)
+	$(CONTAINER_TOOL) build -t $(IMG) -f Dockerfile .
+	$(CONTAINER_TOOL) build -t $(DRA_IMG) -f Dockerfile.dra .
+
+.PHONY: install
+install: ## Apply the non-chart manifests to the current cluster context
+	kubectl apply -f deploy/vtpu-manager.yaml
+	kubectl apply -f deploy/vtpu-dra-driver.yaml
+
+.PHONY: uninstall
+uninstall: ## Delete the non-chart manifests
+	kubectl delete --ignore-not-found -f deploy/vtpu-dra-driver.yaml
+	kubectl delete --ignore-not-found -f deploy/vtpu-manager.yaml
+
+.PHONY: version
+version: ## Print build metadata
+	@echo "version=$(VERSION) commit=$(GIT_COMMIT) branch=$(GIT_BRANCH) date=$(BUILD_DATE)"

@@ -26,6 +26,14 @@ def main(argv: list[str] | None = None) -> int:
                         help="container-config root (default: %(default)s)")
     parser.add_argument("--tc-path", default=consts.TC_UTIL_CONFIG)
     parser.add_argument("--vmem-path", default=consts.VMEM_NODE_CONFIG)
+    parser.add_argument("--pod-resources-socket", default=None,
+                        help="kubelet pod-resources socket for the "
+                        "container<->pod attribution cross-check "
+                        "(default: the kubelet well-known path)")
+    parser.add_argument("--kubelet-checkpoint", default=None,
+                        help="kubelet device-manager checkpoint used as "
+                        "the cross-check fallback when the socket is "
+                        "unreachable")
     parser.add_argument("--debug-endpoints", action="store_true",
                         help="expose /debug/stacks (thread dumps)")
     parser.add_argument("--metrics-token-file", default=None,
@@ -51,7 +59,9 @@ def main(argv: list[str] | None = None) -> int:
     chips = result.chips if result else []
     collector = NodeCollector(
         args.node_name or "unknown", chips, base_dir=args.base_dir,
-        tc_path=args.tc_path, vmem_path=args.vmem_path)
+        tc_path=args.tc_path, vmem_path=args.vmem_path,
+        pod_resources_socket=args.pod_resources_socket,
+        kubelet_checkpoint=args.kubelet_checkpoint)
 
     import hmac
 

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Init-container installer: sync the shim + tools from the image into the
+# host-mounted manager dir, copying only on content change so running
+# tenants keep their mmap'd inode until the file really differs
+# (reference scripts/install_files.sh: md5-compared copy).
+set -eo pipefail
+
+SRC_DIR="${INSTALL_SRC_DIR:-/installed}"
+DEST_DIR="${HOST_MANAGER_DIR:-/etc/vtpu-manager}"
+
+if [[ ! -d "$SRC_DIR" ]]; then
+    echo "error: source dir $SRC_DIR non-existent" >&2
+    exit 1
+fi
+if [[ ! -d "$DEST_DIR" ]]; then
+    echo "error: target dir $DEST_DIR non-existent (host mount missing?)" >&2
+    exit 1
+fi
+
+find "$SRC_DIR" -type f | while read -r src_file; do
+    rel_path="${src_file#"$SRC_DIR"/}"
+    dest_file="$DEST_DIR/$rel_path"
+    mkdir -p "$(dirname "$dest_file")"
+
+    if [[ -f "$dest_file" ]] && \
+       [[ "$(md5sum < "$src_file")" == "$(md5sum < "$dest_file")" ]]; then
+        echo "skipped: $rel_path (unchanged)"
+        continue
+    fi
+    # write-then-rename: a tenant dlopen()ing mid-copy must never see a
+    # truncated .so
+    tmp_file="$dest_file.tmp.$$"
+    cp -fp "$src_file" "$tmp_file"
+    mv -f "$tmp_file" "$dest_file"
+    echo "installed: $rel_path"
+done

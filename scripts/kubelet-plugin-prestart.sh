@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# DRA driver init-container preflight: yield an ACTIONABLE error when the
+# TPU runtime is not set up, instead of a crash-looping driver pod
+# (reference scripts/kubelet-plugin-prestart.sh checks the NVIDIA driver
+# root; the TPU analogue checks accel device nodes + libtpu).
+
+TPU_LIBRARY_PATH="${TPU_LIBRARY_PATH:-/lib/libtpu.so}"
+
+fail() {
+    printf '%b\n' "$1" >&2
+    exit 1
+}
+
+shopt -s nullglob
+accel=(/dev/accel* /dev/vfio/*)
+if [[ ${#accel[@]} -eq 0 ]]; then
+    fail "Check failed: no TPU device nodes (/dev/accel*, /dev/vfio/*).\n\
+Is this node a TPU VM (gke-tpu nodepool / tpu-vm image)? The DRA driver\n\
+DaemonSet must be scheduled only onto TPU nodes — review the chart's\n\
+nodeSelector (google.com/tpu) and the node's device plugin prerequisites."
+fi
+
+if [[ ! -e "$TPU_LIBRARY_PATH" ]] && ! ldconfig -p | grep -q libtpu; then
+    fail "Check failed: libtpu not found at TPU_LIBRARY_PATH\n\
+('$TPU_LIBRARY_PATH') or in the loader cache. Set TPU_LIBRARY_PATH in\n\
+the driver spec, or install the TPU runtime on the host image."
+fi
+
+echo "preflight OK: ${#accel[@]} accel node(s), libtpu reachable"

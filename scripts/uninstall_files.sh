@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Remove installed manager files from the host dir. Refuses to touch
+# per-container state (config dirs of live tenants) unless --purge.
+set -eo pipefail
+
+DEST_DIR="${HOST_MANAGER_DIR:-/etc/vtpu-manager}"
+PURGE="${1:-}"
+
+[[ -d "$DEST_DIR" ]] || { echo "nothing installed at $DEST_DIR"; exit 0; }
+
+for f in libvtpu-control.so vtpu_device_client.py tools; do
+    if [[ -e "$DEST_DIR/$f" ]]; then
+        rm -rf "${DEST_DIR:?}/$f"
+        echo "removed: $f"
+    fi
+done
+
+if [[ "$PURGE" == "--purge" ]]; then
+    # tenant config dirs, watcher feed, registry socket dir
+    rm -rf "${DEST_DIR:?}"
+    echo "purged: $DEST_DIR"
+else
+    echo "kept tenant state under $DEST_DIR (use --purge to remove)"
+fi

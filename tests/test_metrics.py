@@ -249,3 +249,124 @@ def test_calibration_gauges(tmp_path):
                           tc_path=tc2_path,
                           vmem_path="/nonexistent").render()
     assert "vtpu_node_obs_excess_max_us{" not in text2
+
+
+# ---------------------------------------------------------------------------
+# container<->pod mapping cross-check (VERDICT r2 #7: reference
+# pkg/client/pod_resources.go + metrics/lister/container_lister.go — the
+# kubelet, not our own config-dir names, is the attribution authority)
+# ---------------------------------------------------------------------------
+
+def _mk_config_dir(base, pod_uid, container, chip, dra_request=None):
+    sub = "config" if dra_request is None else f"config_{dra_request}"
+    d = os.path.join(base, f"{pod_uid}_{container}", sub)
+    os.makedirs(d, exist_ok=True)
+    vc.write_config(os.path.join(d, "vtpu.config"), vc.VtpuConfig(
+        pod_uid=pod_uid, container_name=container,
+        devices=[vc.DeviceConfig(uuid=chip.uuid, total_memory=2**30,
+                                 real_memory=chip.memory, hard_core=10,
+                                 host_index=chip.index)]))
+
+
+def _fake_pod_resources_server(socket_path, containers):
+    """Kubelet pod-resources lookalike: /v1alpha1.PodResources/List over a
+    unix socket, reporting `containers` as vtpu-number holders."""
+    from concurrent import futures
+
+    import grpc
+
+    from vtpu_manager.deviceplugin.api import podresources_pb2 as pb
+    from vtpu_manager.util import consts as c
+    from vtpu_manager.util.grpcutil import unary
+
+    def list_rpc(req, ctx):
+        resp = pb.ListPodResourcesResponse()
+        for name in containers:
+            pod = resp.pod_resources.add(name=f"pod-{name}", namespace="ns")
+            cont = pod.containers.add(name=name)
+            cont.devices.add(resource_name=c.vtpu_number_resource(),
+                             device_ids=[f"vtpu-{name}-0"])
+        return resp
+
+    s = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    s.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+        "v1alpha1.PodResources",
+        {"List": unary(list_rpc, pb.ListPodResourcesRequest,
+                       pb.ListPodResourcesResponse)}),))
+    s.add_insecure_port(f"unix://{socket_path}")
+    s.start()
+    return s
+
+
+def test_mapping_crosscheck_pod_resources_socket(tmp_path):
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0)]
+    _mk_config_dir(base, "uid-1", "main", chips[0])      # corroborated
+    _mk_config_dir(base, "uid-2", "ghost", chips[0])     # orphan
+    _mk_config_dir(base, "uid-3", "dra", chips[0], dra_request="r0")  # DRA
+    # single-request DRA claims live under claim_<uid>/config — also never
+    # judgeable through the device-plugin-era pod-resources API
+    _mk_config_dir(base, "claim", "abc-claim-uid", chips[0])
+    sock = str(tmp_path / "podres.sock")
+    server = _fake_pod_resources_server(sock, ["main"])
+    try:
+        text = NodeCollector(
+            "n1", chips, base_dir=base,
+            tc_path=str(tmp_path / "tc"), vmem_path=str(tmp_path / "vm"),
+            pod_resources_socket=sock,
+            kubelet_checkpoint=str(tmp_path / "no-ckpt")).render()
+    finally:
+        server.stop(0)
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-1",container="main"} 0.0') in text
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-2",container="ghost"} 1.0') in text
+    # DRA tenants are not judgeable through the v1alpha1 API: no row for
+    # either the multi-request (config_<req>) or single-request
+    # (claim_<uid>) shape
+    mismatch_block = text.split(
+        "vtpu_container_pod_mapping_mismatch", 1)[1].split("# ", 1)[0]
+    assert 'pod_uid="uid-3"' not in mismatch_block
+    assert 'pod_uid="claim"' not in mismatch_block
+    assert 'vtpu_node_pod_mapping_source{node="n1"} 2.0' in text
+
+
+def test_mapping_crosscheck_checkpoint_fallback(tmp_path):
+    import json
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0)]
+    _mk_config_dir(base, "uid-1", "main", chips[0])
+    _mk_config_dir(base, "uid-9", "main", chips[0])   # same name, wrong uid
+    ckpt_path = str(tmp_path / "kubelet_internal_checkpoint")
+    from vtpu_manager.util import consts as c
+    with open(ckpt_path, "w") as f:
+        json.dump({"Data": {"PodDeviceEntries": [
+            {"PodUID": "uid-1", "ContainerName": "main",
+             "ResourceName": c.vtpu_number_resource(),
+             "DeviceIDs": {"-1": ["vtpu-0-0"]}}]}}, f)
+    text = NodeCollector(
+        "n1", chips, base_dir=base,
+        tc_path=str(tmp_path / "tc"), vmem_path=str(tmp_path / "vm"),
+        pod_resources_socket=str(tmp_path / "no-sock"),
+        kubelet_checkpoint=ckpt_path).render()
+    # UID-keyed source catches what name matching cannot: same container
+    # name under a pod uid the kubelet never allocated for
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-1",container="main"} 0.0') in text
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-9",container="main"} 1.0') in text
+    assert 'vtpu_node_pod_mapping_source{node="n1"} 1.0' in text
+
+
+def test_mapping_crosscheck_no_source(tmp_path):
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0)]
+    _mk_config_dir(base, "uid-1", "main", chips[0])
+    text = NodeCollector(
+        "n1", chips, base_dir=base,
+        tc_path=str(tmp_path / "tc"), vmem_path=str(tmp_path / "vm"),
+        pod_resources_socket=str(tmp_path / "no-sock"),
+        kubelet_checkpoint=str(tmp_path / "no-ckpt")).render()
+    # no source -> cross-check disabled, never alarmed
+    assert 'vtpu_node_pod_mapping_source{node="n1"} 0.0' in text
+    assert "mapping_mismatch{" not in text

@@ -1,0 +1,11 @@
+# Version pins for vtpu-manager builds (reference: versions.mk).
+
+VERSION ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
+GIT_COMMIT ?= $(shell git rev-parse HEAD 2>/dev/null || echo unknown)
+GIT_BRANCH ?= $(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown)
+BUILD_DATE ?= $(shell date -u +%Y-%m-%dT%H:%M:%SZ)
+
+TAG ?= latest
+REGISTRY ?= vtpu-manager
+IMG = $(REGISTRY)/vtpu-manager:$(TAG)
+DRA_IMG = $(REGISTRY)/vtpu-manager-dra:$(TAG)

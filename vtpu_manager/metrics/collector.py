@@ -17,10 +17,12 @@ import os
 import time
 
 import vtpu_manager
+from vtpu_manager.client import pod_resources
 from vtpu_manager.config import vtpu_config as vc
 from vtpu_manager.config.tc_watcher import TcUtilFile
 from vtpu_manager.config.vmem import VmemLedger, fnv64
 from vtpu_manager.device.types import ChipSpec
+from vtpu_manager.deviceplugin import checkpoint as ckpt
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -60,17 +62,35 @@ class NodeCollector:
     def __init__(self, node_name: str, chips: list[ChipSpec],
                  base_dir: str = consts.MANAGER_BASE_DIR,
                  tc_path: str = consts.TC_UTIL_CONFIG,
-                 vmem_path: str = consts.VMEM_NODE_CONFIG):
+                 vmem_path: str = consts.VMEM_NODE_CONFIG,
+                 pod_resources_socket: str | None = None,
+                 kubelet_checkpoint: str | None = None):
         self.node_name = node_name
         self.chips = chips
         self.base_dir = base_dir
         self.tc_path = tc_path
         self.vmem_path = vmem_path
+        # container<->pod attribution cross-check endpoints (reference
+        # pod_resources.go / container_lister.go: the kubelet, not our own
+        # config-dir names, is the authority on which container holds
+        # devices). None = use the well-known paths; tests point elsewhere.
+        self.pod_resources_socket = (
+            pod_resources.POD_RESOURCES_SOCKET
+            if pod_resources_socket is None else pod_resources_socket)
+        self.kubelet_checkpoint = (
+            ckpt.KUBELET_CHECKPOINT
+            if kubelet_checkpoint is None else kubelet_checkpoint)
         # peak concurrent tenancy per chip across this monitor's lifetime
         # (reference vGPUPeakSharedContainersNumber)
         self._peak_shared: dict[str, int] = {}
 
-    def _container_configs(self) -> list[tuple[str, str, vc.VtpuConfig]]:
+    def _container_configs(self) -> list[
+            tuple[str, str, vc.VtpuConfig, bool]]:
+        """(pod_uid_or_claim, container_label, config, is_dra). DRA
+        tenants come from `claim_<uid>` dirs (single-request) or
+        request-suffixed config dirs (multi-request) — flagged because the
+        kubelet's device-plugin-era pod-resources API can never
+        corroborate them (they flow through the DRA path)."""
         out = []
         if not os.path.isdir(self.base_dir):
             return out
@@ -96,8 +116,10 @@ class NodeCollector:
                 suffix = config_name[len("config_"):] \
                     if config_name != "config" else ""
                 label = f"{container}/{suffix}" if suffix else container
+                is_dra = entry.startswith("claim_") or bool(suffix)
                 try:
-                    out.append((pod_uid, label, vc.read_config(cfg_path)))
+                    out.append((pod_uid, label, vc.read_config(cfg_path),
+                                is_dra))
                 except (OSError, ValueError):
                     continue
         return out
@@ -265,6 +287,19 @@ class NodeCollector:
         g_proc_util = Gauge("vtpu_process_utilization_percent",
                             "Per-process duty-cycle share from the feed",
                             ("node", "pod_uid", "container", "uuid", "pid"))
+        g_map_mismatch = Gauge(
+            "vtpu_container_pod_mapping_mismatch",
+            "1 when the kubelet does not corroborate this config-dir's "
+            "pod/container attribution (orphaned dir, spoofed name, or "
+            "plugin/kubelet disagreement); 0 when corroborated. Rows "
+            "appear only for device-plugin tenants while a kubelet "
+            "source is reachable",
+            ("node", "pod_uid", "container"))
+        g_map_source = Gauge(
+            "vtpu_node_pod_mapping_source",
+            "Attribution cross-check source: 2=pod-resources socket, "
+            "1=kubelet checkpoint, 0=none reachable",
+            ("node",))
 
         assigned: dict[str, int] = {}
         cores_assigned: dict[int, int] = {}
@@ -272,7 +307,25 @@ class NodeCollector:
         pmem_assigned: dict[int, int] = {}
         tenant_by_token: dict[int, tuple[str, str]] = {}
         now_ns = time.monotonic_ns()
-        for pod_uid, container, cfg in self._container_configs():
+        view = pod_resources.kubelet_view(self.pod_resources_socket,
+                                          self.kubelet_checkpoint)
+        g_map_source.set((self.node_name,),
+                         {"podresources": 2.0, "checkpoint": 1.0}.get(
+                             view.source, 0.0))
+        for pod_uid, container, cfg, is_dra in self._container_configs():
+            # DRA tenants flow through the kubelet's DRA path, which the
+            # device-plugin-era pod-resources v1alpha1 API does not
+            # report — only device-plugin tenants are judgeable
+            if not is_dra:
+                verdict = view.corroborates(pod_uid, container)
+                if verdict is not None:
+                    g_map_mismatch.set(
+                        (self.node_name, pod_uid, container),
+                        0.0 if verdict else 1.0)
+                    if not verdict:
+                        log.warning(
+                            "config dir %s_%s not corroborated by kubelet "
+                            "%s view", pod_uid, container, view.source)
             token = fnv64(f"{pod_uid}/{container}")
             tenant_by_token[token] = (pod_uid, container)
             for dev in cfg.devices:
@@ -344,7 +397,7 @@ class NodeCollector:
         gauges += [g_climit, g_mlimit, g_mplimit, g_musage, g_mem_pct,
                    g_cutil, g_heartbeat, g_assigned, g_peak, g_cores_total,
                    g_cores_assigned, g_dev_assigned_mem, g_dev_assigned_pmem,
-                   g_proc_mem, g_proc_util]
+                   g_proc_mem, g_proc_util, g_map_mismatch, g_map_source]
 
         # ---- node aggregates + info ----
         g_total = Gauge("vtpu_node_slots_total", "Node vTPU slot capacity",
