@@ -22,6 +22,7 @@ COPY cmd /app/cmd
 COPY --from=shim-build /build/libvtpu-control.so \
         /app/driver/libvtpu-control.so
 COPY library/tools/vtpu_device_client.py /app/driver/vtpu_device_client.py
+COPY scripts /app/scripts
 ENV PYTHONPATH=/app
 # default command = device plugin; deployments override per component
 CMD ["python", "cmd/device_plugin.py"]

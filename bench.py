@@ -142,26 +142,33 @@ def calibrate_obs_overhead(max_cache_age_s: float = 3600.0) -> str | None:
     The result is cached on disk for up to an hour: the ~6-minute
     calibration dominates the capture path, and a same-session recapture
     (e.g. after a health-probe retry loop) sits in the same transport
-    regime. Regimes drift across sessions, so the cache expires; delete
-    CAL_CACHE to force a fresh table."""
+    regime. Regimes drift across sessions, so the cache expires; the
+    cache is also keyed on the calibration settings (stat/dim/gaps) so an
+    operator switching VTPU_OBS_CAL_STAT never silently reuses a table
+    computed under other settings. Delete CAL_CACHE to force fresh."""
+    env = dict(os.environ)
+    env.setdefault("VTPU_OBS_CAL_DIM", "8192")
+    settings = {key: env.get(key, "") for key in
+                ("VTPU_OBS_CAL_STAT", "VTPU_OBS_CAL_DIM",
+                 "VTPU_OBS_CAL_GAPS_MS")}
     try:
         with open(CAL_CACHE) as f:
             cached = json.load(f)
         age = time.time() - float(cached.get("wall_ts", 0))
-        if 0 <= age < max_cache_age_s and cached.get("table"):
+        if 0 <= age < max_cache_age_s and cached.get("table") \
+                and cached.get("settings") == settings:
             print(f"obs calibration reused from cache (age {age:.0f}s)",
                   file=sys.stderr)
             return cached["table"]
     except (OSError, ValueError):
         pass
     from vtpu_manager.manager.obs_calibrate import calibrate_in_subprocess
-    env = dict(os.environ)
-    env.setdefault("VTPU_OBS_CAL_DIM", "8192")
     table = calibrate_in_subprocess(timeout_s=400, env=env)
     if table is not None:
         try:
             with open(CAL_CACHE, "w") as f:
-                json.dump({"table": table, "wall_ts": time.time()}, f)
+                json.dump({"table": table, "wall_ts": time.time(),
+                           "settings": settings}, f)
         except OSError:
             pass
     return table
@@ -211,6 +218,39 @@ def run_tpu_worker(quota: int, no_shim: bool = False,
     print(f"worker q={quota} failed:\n{res.stdout[-400:]}\n"
           f"{res.stderr[-800:]}", file=sys.stderr)
     return None
+
+
+def paired_quota_sweep(quotas: tuple[int, ...] | list[int],
+                       obs_table: str | None, reps: int
+                       ) -> tuple[dict[int, float], dict[int, float]]:
+    """(times ms/step incl. the min t100, paired shares %) for each quota.
+
+    The tunnel's speed drifts minute to minute, so a share computed from
+    a t100 and a t(q) taken at different moments carries that drift. Each
+    rep runs (t100, tq) back-to-back and the least-stalled pair (min
+    summed wall) gives the share — numerator and denominator from one
+    transport moment. Every successful t100 sample still feeds the global
+    min (the no-shim overhead baseline mins over the full sample count,
+    and dropping samples here would reopen that bias). One home for the
+    methodology: bench main() and scripts/capture_hw.py both call it."""
+    times: dict[int, float] = {}
+    shares: dict[int, float] = {}
+    for quota in quotas:
+        best_pair = None
+        for _ in range(max(1, reps)):
+            t100_i = run_tpu_worker(100, obs_excess_table=obs_table)
+            if t100_i is not None and (100 not in times
+                                       or t100_i < times[100]):
+                times[100] = t100_i
+            tq_i = run_tpu_worker(quota, obs_excess_table=obs_table)
+            if t100_i is None or tq_i is None:
+                continue
+            if best_pair is None or t100_i + tq_i < sum(best_pair):
+                best_pair = (t100_i, tq_i)
+        if best_pair is not None:
+            times[quota] = best_pair[1]
+            shares[quota] = 100.0 * best_pair[0] / best_pair[1]
+    return times, shares
 
 
 def worker_main() -> None:
@@ -474,30 +514,9 @@ def main() -> int:
             print(f"obs excess table calibrated: {obs_table}",
                   file=sys.stderr)
             overhead["obs_excess_table_calibrated"] = obs_table
-        # Paired measurement: the tunnel's speed drifts minute to minute,
-        # so a share computed from a t100 and a t(q) taken at different
-        # moments carries that drift. Each rep runs (t100, tq)
-        # back-to-back and the least-stalled pair (min summed wall) gives
-        # the share — numerator and denominator from one transport moment.
         reps = bench_reps()
-        for quota in QUOTAS[1:]:
-            best_pair = None
-            for _ in range(reps):
-                t100_i = run_tpu_worker(100, obs_excess_table=obs_table)
-                # keep every successful t100 sample even when its pair
-                # fails: the no-shim baseline mins over the full sample
-                # count, and dropping samples here reopens the bias
-                if t100_i is not None and (100 not in times
-                                           or t100_i < times[100]):
-                    times[100] = t100_i
-                tq_i = run_tpu_worker(quota, obs_excess_table=obs_table)
-                if t100_i is None or tq_i is None:
-                    continue
-                if best_pair is None or t100_i + tq_i < sum(best_pair):
-                    best_pair = (t100_i, tq_i)
-            if best_pair is not None:
-                times[quota] = best_pair[1]
-                paired_shares[quota] = 100.0 * best_pair[0] / best_pair[1]
+        times, paired_shares = paired_quota_sweep(QUOTAS[1:], obs_table,
+                                                  reps)
         hbm_penalty = run_hbm_check()
         # Shim overhead: unthrottled ms/step with vs without the shim.
         # The shim-on t100 is a min over len(QUOTAS[1:]) * reps paired
@@ -570,22 +589,29 @@ def main() -> int:
         # committed real-hardware capture when present
         line["hermetic"] = True
         import glob as globlib
-        caps = sorted(globlib.glob(
-            os.path.join(REPO, "BENCH_TPU_CAPTURE_r*.json")))
-        cap_path = caps[-1] if caps else ""
-        if cap_path and os.path.exists(cap_path):
+        cap = None
+        cap_path = ""
+        # newest capture with a real MAE; partial captures (value null,
+        # e.g. an --only mfu run) must not shadow a complete older one
+        for candidate in sorted(globlib.glob(
+                os.path.join(REPO, "BENCH_TPU_CAPTURE_r*.json")),
+                reverse=True):
             try:
-                with open(cap_path) as f:
-                    cap = json.load(f)
-                line["real_tpu_capture"] = {
-                    "file": os.path.basename(cap_path),
-                    "value": cap.get("value"),
-                    "vs_baseline": cap.get("vs_baseline"),
-                    "shim_overhead_pct": cap.get("shim_overhead_pct"),
-                    "date": cap.get("date"),
-                }
+                with open(candidate) as f:
+                    loaded = json.load(f)
             except (OSError, ValueError):
-                pass
+                continue
+            if loaded.get("value") is not None:
+                cap, cap_path = loaded, candidate
+                break
+        if cap is not None:
+            line["real_tpu_capture"] = {
+                "file": os.path.basename(cap_path),
+                "value": cap.get("value"),
+                "vs_baseline": cap.get("vs_baseline"),
+                "shim_overhead_pct": cap.get("shim_overhead_pct"),
+                "date": cap.get("date"),
+            }
     print(json.dumps(line))
     return 0
 

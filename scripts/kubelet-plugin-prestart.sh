@@ -3,7 +3,13 @@
 # TPU runtime is not set up, instead of a crash-looping driver pod
 # (reference scripts/kubelet-plugin-prestart.sh checks the NVIDIA driver
 # root; the TPU analogue checks accel device nodes + libtpu).
+#
+# Runs inside a slim container image that never ships libtpu itself, so
+# the library check walks the HOST filesystem: the chart mounts the host
+# root read-only at HOST_ROOT (default /host). With HOST_ROOT=/ (running
+# directly on the node) the loader cache is consulted too.
 
+HOST_ROOT="${HOST_ROOT:-/host}"
 TPU_LIBRARY_PATH="${TPU_LIBRARY_PATH:-/lib/libtpu.so}"
 
 fail() {
@@ -20,10 +26,34 @@ DaemonSet must be scheduled only onto TPU nodes — review the chart's\n\
 nodeSelector (google.com/tpu) and the node's device plugin prerequisites."
 fi
 
-if [[ ! -e "$TPU_LIBRARY_PATH" ]] && ! ldconfig -p | grep -q libtpu; then
-    fail "Check failed: libtpu not found at TPU_LIBRARY_PATH\n\
-('$TPU_LIBRARY_PATH') or in the loader cache. Set TPU_LIBRARY_PATH in\n\
-the driver spec, or install the TPU runtime on the host image."
+if [[ ! -d "$HOST_ROOT" ]]; then
+    fail "Check failed: host root not mounted at '$HOST_ROOT'. The\n\
+preflight inspects the HOST's libtpu installation; mount the node root\n\
+read-only at $HOST_ROOT (the chart does this) or set HOST_ROOT."
 fi
 
-echo "preflight OK: ${#accel[@]} accel node(s), libtpu reachable"
+found=""
+for candidate in "$HOST_ROOT${TPU_LIBRARY_PATH}" \
+                 "$HOST_ROOT"/lib/libtpu.so \
+                 "$HOST_ROOT"/usr/lib/libtpu.so \
+                 "$HOST_ROOT"/usr/local/lib/libtpu.so \
+                 "$HOST_ROOT"/lib/x86_64-linux-gnu/libtpu.so \
+                 "$HOST_ROOT"/home/*/.local/lib/*/site-packages/libtpu/libtpu.so \
+                 "$HOST_ROOT"/usr/lib/python*/site-packages/libtpu/libtpu.so; do
+    if [[ -e "$candidate" ]]; then
+        found="$candidate"
+        break
+    fi
+done
+if [[ -z "$found" ]] && [[ "$HOST_ROOT" == "/" ]] \
+        && ldconfig -p 2>/dev/null | grep -q libtpu; then
+    found="(loader cache)"
+fi
+if [[ -z "$found" ]]; then
+    fail "Check failed: libtpu not found on the host (searched\n\
+$HOST_ROOT$TPU_LIBRARY_PATH and common install paths). Set\n\
+TPU_LIBRARY_PATH in the driver spec to the host's libtpu location, or\n\
+install the TPU runtime on the node image."
+fi
+
+echo "preflight OK: ${#accel[@]} accel node(s), libtpu at ${found#"$HOST_ROOT"}"

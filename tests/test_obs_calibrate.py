@@ -47,6 +47,31 @@ class TestMeasurement:
         assert 3500 <= gaps[30000] <= 7000
         assert 3500 <= gaps[60000] <= 7000
 
+    def test_phase_dependent_inflation_not_certified_clean(self):
+        """VERDICT r2 #5 (q25 residual): after-idle inflation that is
+        flush-phase-dependent — most paced spans inflated, the odd one
+        clean — must NOT calibrate to ~0 (the old min-of-samples did,
+        and the paced tenant then paid ~8 ms/step uncompensated). The
+        median paced span sees the typical cost."""
+        state = {"n": 0}
+
+        def run_once():
+            now = time.perf_counter()
+            gap = now - state.get("last", now) > 0.02
+            state["n"] += 1
+            # every 3rd after-idle span lands phase-aligned (clean);
+            # the rest carry 8 ms of flush-timer inflation
+            base_ms = 5 + (0 if not gap or state["n"] % 3 == 0 else 8)
+            time.sleep(base_ms / 1000.0)
+            state["last"] = time.perf_counter()
+
+        table = measure_excess_table(run_once, gaps_ms=(30,),
+                                     b2b_samples=4, gap_samples=7)
+        assert table is not None
+        # typical paced span is inflated ~8 ms; accept [6, 12] for sleep
+        # jitter. A min-statistic would report ~0 here.
+        assert 6000 <= dict(table)[30000] <= 12000
+
     def test_clean_transport_calibrates_to_zero(self):
         def run_once():
             time.sleep(0.004)

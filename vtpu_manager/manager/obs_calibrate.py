@@ -51,7 +51,7 @@ from typing import Callable
 # Defaults, env-tunable at the call site.
 GAPS_MS = (60, 120, 250)
 B2B_SAMPLES = 8
-GAP_SAMPLES = 5
+GAP_SAMPLES = 9
 WARMUP = 4
 REFERENCE_DIM = 6144           # bf16 matmul edge: ~tens of ms on a v5e chip
 SUBPROCESS_TIMEOUT_S = 180.0   # first compile on a remote transport is slow
@@ -66,27 +66,52 @@ def measure_excess_table(run_once: Callable[[], None] | None = None,
 
     ``run_once`` submits one reference program and blocks until its result
     is host-observed (default: a REFERENCE_DIM² bf16 matmul with a scalar
-    readback via JAX — the tenant sync-loop pattern). Excess uses the MIN
-    span per regime: no sample can be below the true floor, so min-vs-min
-    is the conservative estimate of the additive after-idle inflation.
-    Always anchored at (0, 0): back-to-back spans are the fair charge by
-    definition, so overlapped/zero-gap spans get no discount.
+    readback via JAX — the tenant sync-loop pattern). Per gap the probe
+    loop is PACED — sleep(gap), run, repeat — i.e. the throttled tenant's
+    steady rhythm, and the excess is the MEDIAN paced span over the MIN
+    back-to-back span:
+
+    - the b2b floor stays a min: no sample can be below the true span,
+      and the floor is what a zero-gap span fairly costs;
+    - the paced statistic must NOT be a min: after-idle inflation is
+      flush-timer *phase-dependent* (0..14 ms at one gap in one measured
+      regime), so min-of-a-few catches one lucky aligned sample and
+      certifies the transport clean while a tenant paced at that gap pays
+      the typical inflation on every step — the exact q25 overcharge
+      residual measured in r2 (`docs/controller_accuracy.md`: isolated
+      spans measured clean while paced spans carried ~8 ms). The median
+      tracks the steady-state typical cost and is robust to the tunnel's
+      additive stall spikes. `VTPU_OBS_CAL_STAT=min` restores the old
+      conservative floor estimate.
+
+    The first paced sample per gap is discarded (phase transient entering
+    the rhythm). Always anchored at (0, 0): back-to-back spans are the
+    fair charge by definition, so overlapped/zero-gap spans get no
+    discount.
     """
     if run_once is None:
         run_once = _jax_run_once()
         if run_once is None:
             return None
+    paced_stat = _median if os.environ.get(
+        "VTPU_OBS_CAL_STAT", "median") != "min" else min
     try:
         for _ in range(WARMUP):
             run_once()
         base = min(_spans_us(run_once, b2b_samples, 0.0))
         table: list[tuple[int, int]] = [(0, 0)]
         for gap_ms in gaps_ms:
-            iso = min(_spans_us(run_once, gap_samples, gap_ms / 1000.0))
-            table.append((gap_ms * 1000, max(0, int(iso - base))))
+            spans = _spans_us(run_once, gap_samples + 1, gap_ms / 1000.0)
+            paced = paced_stat(spans[1:])   # drop the entry transient
+            table.append((gap_ms * 1000, max(0, int(paced - base))))
     except Exception:  # noqa: BLE001 - any transport failure => no table
         return None
     return table
+
+
+def _median(vals: list[int]) -> int:
+    import statistics
+    return int(statistics.median(vals))
 
 
 def _spans_us(run_once: Callable[[], None], n: int,

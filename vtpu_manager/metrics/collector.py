@@ -307,16 +307,22 @@ class NodeCollector:
         pmem_assigned: dict[int, int] = {}
         tenant_by_token: dict[int, tuple[str, str]] = {}
         now_ns = time.monotonic_ns()
-        view = pod_resources.kubelet_view(self.pod_resources_socket,
-                                          self.kubelet_checkpoint)
-        g_map_source.set((self.node_name,),
-                         {"podresources": 2.0, "checkpoint": 1.0}.get(
-                             view.source, 0.0))
-        for pod_uid, container, cfg, is_dra in self._container_configs():
+        configs = self._container_configs()
+        # dial the kubelet only when there is something it can judge: a
+        # DRA-only node (or an empty one) must not pay a gRPC List (up to
+        # 2 s) per scrape for a result every tenant would skip
+        view = None
+        if any(not is_dra for _, _, _, is_dra in configs):
+            view = pod_resources.kubelet_view(self.pod_resources_socket,
+                                              self.kubelet_checkpoint)
+            g_map_source.set((self.node_name,),
+                             {"podresources": 2.0, "checkpoint": 1.0}.get(
+                                 view.source, 0.0))
+        for pod_uid, container, cfg, is_dra in configs:
             # DRA tenants flow through the kubelet's DRA path, which the
             # device-plugin-era pod-resources v1alpha1 API does not
             # report — only device-plugin tenants are judgeable
-            if not is_dra:
+            if not is_dra and view is not None:
                 verdict = view.corroborates(pod_uid, container)
                 if verdict is not None:
                     g_map_mismatch.set(
