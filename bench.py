@@ -43,6 +43,29 @@ V5E_PEAK_BF16_FLOPS = 197e12
 CAL_CACHE = os.path.join(REPO, ".vtpu_obs_cal_cache.json")
 
 
+def rounds_by_number(pattern: str, name_re: str) -> list[tuple[int, str]]:
+    """(round, path) pairs for a round-numbered file family, NEWEST
+    first. One scanner for every BENCH_r* family — the round key must be
+    numeric everywhere or 'r09' > 'r10' as strings (ADVICE r3)."""
+    import glob
+    import re
+    out = []
+    for path in glob.glob(os.path.join(REPO, pattern)):
+        match = re.search(name_re, os.path.basename(path))
+        if match:
+            out.append((int(match.group(1)), path))
+    return sorted(out, reverse=True)
+
+
+def current_round() -> int:
+    """Round in progress = newest committed BENCH_r{N}.json + 1 (the
+    driver writes BENCH_r{N} at the END of round N, so while round N is
+    running only rounds < N exist). One source of truth for the watcher,
+    the capture script, and the bench's capture lookup."""
+    rounds = rounds_by_number("BENCH_r*.json", r"^BENCH_r(\d+)\.json$")
+    return (rounds[0][0] if rounds else 0) + 1
+
+
 def ensure_shim() -> bool:
     if os.path.exists(SHIM):
         return True
@@ -406,9 +429,12 @@ def run_mfu_capture(obs_table: str | None, reps: int = 2) -> dict:
     return out
 
 
-def run_hbm_check() -> int:
+def run_hbm_check() -> int | None:
     """Exact-cap check: 64 MiB cap must reject a 256 MiB materialization.
-    Returns 0 on exact enforcement, 100 on violation/unknown."""
+    Returns 0 on exact enforcement, 100 on a genuine violation (the
+    oversized buffer materialized), None when the check could not run
+    (tunnel error, import failure) — callers must not publish an
+    inability-to-measure as a VIOLATION."""
     code = (
         f"import sys; sys.path.insert(0, {REPO!r})\n"
         f"from bench import register_axon; register_axon({SHIM!r})\n"
@@ -420,15 +446,30 @@ def run_hbm_check() -> int:
         "except Exception as e:\n"
         "    ok = 'RESOURCE_EXHAUSTED' in str(e)\n"
         "    print('HBM_OK' if ok else 'HBM_UNEXPECTED:'+str(e)[:120])\n")
-    res = subprocess.run([sys.executable, "-c", code],
-                         env=tpu_env(100, mem_limit=64 * 2**20),
-                         capture_output=True, text=True, timeout=600)
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             env=tpu_env(100, mem_limit=64 * 2**20),
+                             capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        print("HBM-cap check timed out (transport?)", file=sys.stderr)
+        return None
     if "HBM_OK" in res.stdout:
         print("HBM-cap enforcement: exact (error=0)", file=sys.stderr)
         return 0
-    print(f"HBM-cap check failed: {res.stdout[-200:]} {res.stderr[-300:]}",
-          file=sys.stderr)
-    return 100
+    if "HBM_VIOLATION" in res.stdout:
+        print("HBM-cap VIOLATION: oversized buffer materialized",
+              file=sys.stderr)
+        return 100
+    if "HBM_UNEXPECTED" in res.stdout:
+        # the probe RAN and the alloc was rejected, but not with
+        # RESOURCE_EXHAUSTED — an enforcement error-mapping regression,
+        # measured and penalized, not lumped into cannot-run
+        print(f"HBM-cap rejected with wrong error class: "
+              f"{res.stdout[-200:]}", file=sys.stderr)
+        return 100
+    print(f"HBM-cap check could not run: {res.stdout[-200:]} "
+          f"{res.stderr[-300:]}", file=sys.stderr)
+    return None
 
 
 def run_fake_sweep() -> dict[int, float] | None:
@@ -458,12 +499,25 @@ def run_fake_sweep() -> dict[int, float] | None:
     return out if len(out) == len(QUOTAS) else None
 
 
+HERMETIC_OVERHEAD_CEILING_US = 10.0
+
+
 def run_hermetic_overhead() -> float | None:
     """Per-exec shim overhead in µs: the throttle loop against the fake
     plugin with zero simulated device time, unthrottled, shim interposed
     vs the fake plugin loaded directly (shim_test dlopens SHIM_PATH, so
     pointing it at the fake IS the no-shim baseline). Reuses the ablation
-    harness's shim_test driver."""
+    harness's shim_test driver.
+
+    Noise model (the r2→r3 −1.0 → +6.0 µs drift, VERDICT r3 weak #3):
+    each side is a single ~10 ms wall measurement of a 2000-iteration
+    loop on a shared-CPU CI box, so the DIFFERENCE carries a noise floor
+    of several µs/exec — r2's −1.0 (shim faster than no-shim, physically
+    impossible) and r3's +6.0 are both that floor, not a change on the
+    execute path. Min-of-3 on each side squeezes scheduler noise the
+    same way the TPU workers min over reps; the published figure is
+    bounded below the ceiling the bench asserts (a genuine execute-path
+    regression surfaces as `overhead_bound_exceeded`)."""
     fake = os.path.join(BUILD, "libfake-pjrt.so")
     if not (os.path.exists(os.path.join(BUILD, "shim_test"))
             and os.path.exists(fake)):
@@ -473,15 +527,35 @@ def run_hermetic_overhead() -> float | None:
     iters = 2000
     walls = {}
     for label, shim_path in (("shim", SHIM), ("noshim", fake)):
-        try:
-            wall = run_point("auto", 100, iters, exec_us=0,
-                             shim_path=shim_path)
-        except subprocess.TimeoutExpired:
+        best = None
+        for _ in range(3):
+            try:
+                wall = run_point("auto", 100, iters, exec_us=0,
+                                 shim_path=shim_path)
+            except subprocess.TimeoutExpired:
+                continue     # a stalled rep is just a lost sample
+            if wall is not None and (best is None or wall < best):
+                best = wall
+        if best is None:
             return None
-        if wall is None:
-            return None
-        walls[label] = wall
+        walls[label] = best
     return 1000.0 * (walls["shim"] - walls["noshim"]) / iters
+
+
+def previous_round_overhead() -> float | None:
+    """Newest committed BENCH_r*.json's hermetic overhead figure, printed
+    alongside this round's so drift is visible in the bench output."""
+    for _, path in rounds_by_number("BENCH_r*.json",
+                                    r"^BENCH_r(\d+)\.json$"):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            continue
+        val = parsed.get("shim_overhead_us_per_exec_hermetic")
+        if val is not None:
+            return float(val)
+    return None
 
 
 def tpu_available() -> bool:
@@ -517,7 +591,12 @@ def main() -> int:
         reps = bench_reps()
         times, paired_shares = paired_quota_sweep(QUOTAS[1:], obs_table,
                                                   reps)
-        hbm_penalty = run_hbm_check()
+        hbm_result = run_hbm_check()
+        # only a MEASURED violation penalizes; an unrunnable check (None)
+        # is recorded, not punished as if the cap had leaked
+        hbm_penalty = hbm_result if hbm_result is not None else 0
+        if hbm_result is None:
+            overhead["hbm_check"] = "unknown (check could not run)"
         # Shim overhead: unthrottled ms/step with vs without the shim.
         # The shim-on t100 is a min over len(QUOTAS[1:]) * reps paired
         # samples; the no-shim side must min over the SAME count or the
@@ -575,8 +654,16 @@ def main() -> int:
         us = run_hermetic_overhead()
         if us is not None:
             overhead["shim_overhead_us_per_exec_hermetic"] = round(us, 1)
-            print(f"hermetic shim overhead: {us:.1f} µs/exec",
-                  file=sys.stderr)
+            prev = previous_round_overhead()
+            print(f"hermetic shim overhead: {us:.1f} µs/exec"
+                  + (f" (prev round: {prev:.1f})" if prev is not None
+                     else ""), file=sys.stderr)
+            if us > HERMETIC_OVERHEAD_CEILING_US:
+                overhead["overhead_bound_exceeded"] = True
+                print(f"WARNING: hermetic overhead {us:.1f} µs/exec "
+                      f"exceeds the {HERMETIC_OVERHEAD_CEILING_US:.0f} µs "
+                      "ceiling — execute-path regression?",
+                      file=sys.stderr)
     line = {"metric": "core_quota_tracking_mae",
             "value": round(mae, 2), "unit": "percent",
             "vs_baseline": round(mae / BASELINE_AIMD_MAE, 3)}
@@ -588,14 +675,14 @@ def main() -> int:
         # number is never mistaken for a TPU measurement, and point at the
         # committed real-hardware capture when present
         line["hermetic"] = True
-        import glob as globlib
         cap = None
         cap_path = ""
         # newest capture with a real MAE; partial captures (value null,
-        # e.g. an --only mfu run) must not shadow a complete older one
-        for candidate in sorted(globlib.glob(
-                os.path.join(REPO, "BENCH_TPU_CAPTURE_r*.json")),
-                reverse=True):
+        # e.g. an --only mfu run; the non-matching *_partial.json name)
+        # must not shadow a complete one
+        for _, candidate in rounds_by_number(
+                "BENCH_TPU_CAPTURE_r*.json",
+                r"^BENCH_TPU_CAPTURE_r(\d+)\.json$"):
             try:
                 with open(candidate) as f:
                     loaded = json.load(f)

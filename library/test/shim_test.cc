@@ -269,6 +269,20 @@ int main(int argc, char** argv) {
       return 2;
     }
   }
+  if (multichip) {
+    // Same fail-fast-and-explain courtesy for the 2-chip harness: without
+    // this env the fake plugin exposes one device and the run used to die
+    // with a bare "ndev=1 want 2" (VERDICT r3 #9).
+    const char* fake_ndev = getenv("FAKE_DEVICE_COUNT");
+    if (!fake_ndev || atoi(fake_ndev) < 2) {
+      fprintf(stderr,
+              "precondition: --multichip needs FAKE_DEVICE_COUNT=2 (plus "
+              "per-device quotas, e.g. VTPU_CORE_LIMIT_0=50 "
+              "VTPU_CORE_LIMIT_1=25) so the fake plugin exposes two "
+              "devices with independent budgets\n");
+      return 2;
+    }
+  }
   void* handle = dlopen(shim_path, RTLD_NOW | RTLD_LOCAL);
   if (!handle) {
     fprintf(stderr, "dlopen(%s): %s\n", shim_path, dlerror());

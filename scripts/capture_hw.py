@@ -1,23 +1,31 @@
 #!/usr/bin/env python3
 """One-command real-TPU capture for the round's BENCH_TPU_CAPTURE file.
 
-Runs the full hardware matrix (VERDICT r2 #1/#5/#8) against the axon
-tunnel, each section failure-isolated so a flaky transport still lands a
-partial capture:
+Runs the hardware matrix (VERDICT r2 #1/#5/#8, r3 #1) against the axon
+tunnel. Sections run in PRIORITY order — the two headline numbers first,
+so a transport that re-wedges mid-capture still lands what matters most:
 
-  1. quota tracking at 10/25/50/75% (paired t100/tq shares — the 10%
-     point is the GAP/duty-cycle-dominated regime the reference invested
-     most in, cuda_hook.c:1375-1591);
-  2. HBM-cap exactness;
-  3. shim overhead (unthrottled, min-of-reps both sides);
-  4. absolute MFU, shim-on vs shim-off (transport-amortized fori_loop);
-  5. balance (soft-limit) climb: 25%-hard/100%-soft on an idle chip;
-  6. vtpu_busy --duty 100 convergence inside an enforced config;
-  7. host-offload under a cap smaller than the model (pinned_host must
-     stay uncharged or the park itself OOMs).
+  1. mfu      — absolute MFU, shim-on vs shim-off (transport-amortized
+                fori_loop; the round's #1 deliverable);
+  2. quotas   — tracking at 10/25/50/75% (paired t100/tq shares — the
+                10% point is the GAP/duty-cycle regime the reference
+                invested most in, cuda_hook.c:1375-1591);
+  3. overhead — unthrottled shim-on vs shim-off ms/step;
+  4. hbm      — HBM-cap exactness;
+  5. balance  — soft-limit climb: 25%-hard/100%-soft on an idle chip;
+  6. busy     — vtpu_busy --duty 100 convergence inside an enforced
+                config;
+  7. offload  — host-offload under a cap smaller than the model
+                (pinned_host must stay uncharged or the park OOMs).
 
-Usage:  python scripts/capture_hw.py [--out BENCH_TPU_CAPTURE_r03.json]
-        [--only quotas,mfu,...]  [--reps 2]
+Every section is failure-isolated (an exception records the error and
+moves on) and the output JSON is rewritten after EACH section, so a
+wedge mid-capture keeps everything captured so far. Re-running with the
+same --out resumes: sections already recorded in the file are skipped,
+only missing ones run. `--force` re-runs everything.
+
+Usage:  python scripts/capture_hw.py [--out BENCH_TPU_CAPTURE_rNN.json]
+        [--only mfu,quotas,...]  [--reps 2]  [--force]
 """
 
 from __future__ import annotations
@@ -36,6 +44,8 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 QUOTAS = (75, 50, 25, 10)
+SECTIONS = ("mfu", "quotas", "overhead", "hbm", "balance", "busy",
+            "offload")
 
 
 def log(msg: str) -> None:
@@ -58,9 +68,16 @@ def capture_quotas(obs_table: str | None, reps: int) -> dict:
             "err_pct": round(abs(share - quota), 1)})
         log(f"q={quota}: share {share:.1f}% (err "
             f"{abs(share - quota):.1f})")
-    if shares:
+    # mae_pct is the resume predicate AND the published headline: only a
+    # FULL sweep may set it, or a 1-point MAE ships as the round's value
+    # and the missing quotas are never retried
+    out["quota_points_partial"] = bool(shares) and len(shares) < len(QUOTAS)
+    if len(shares) == len(QUOTAS):
         out["mae_pct"] = round(
             sum(abs(s - q) for q, s in shares.items()) / len(shares), 2)
+    elif shares:
+        log(f"quota sweep partial ({len(shares)}/{len(QUOTAS)} points); "
+            "mae withheld, section will be retried")
     if 100 in times:
         out["unthrottled_ms_per_step"] = round(times[100], 2)
     return out
@@ -187,25 +204,65 @@ def capture_host_offload() -> dict:
         **({} if ok else {"stderr": res.stderr.strip()[-300:]})}}
 
 
+def section_recorded(section: str, capture: dict) -> bool:
+    """Whether `capture` (a previously-written output file) already holds
+    this section's result — the resume test. A section that RAN but got
+    nothing (transport flaked) records itself in `sections_failed` and is
+    retried on resume."""
+    detail = capture.get("detail", {})
+    checks = {
+        "mfu": lambda: capture.get("mfu_pct_shim_on") is not None
+        and capture.get("mfu_pct_shim_off") is not None,
+        "quotas": lambda: detail.get("mae_pct") is not None,
+        "overhead": lambda: capture.get("shim_overhead_pct") is not None,
+        "hbm": lambda: "hbm_cap" in detail,
+        "balance": lambda: "balance_mode" in detail,
+        "busy": lambda: "vtpu_busy_convergence" in detail,
+        "offload": lambda: "host_offload" in detail,
+    }
+    return checks[section]()
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default=None)
     parser.add_argument("--reps", type=int, default=2)
     parser.add_argument("--only", default="",
-                        help="comma list: quotas,overhead,mfu,balance,"
-                             "busy,offload,hbm")
+                        help="comma list from: " + ",".join(SECTIONS))
+    parser.add_argument("--force", action="store_true",
+                        help="re-run sections already in --out")
     args = parser.parse_args()
     only = set(args.only.split(",")) if args.only else None
+    if only is not None and not only <= set(SECTIONS):
+        parser.error(f"unknown section(s) {only - set(SECTIONS)}; "
+                     f"choose from {','.join(SECTIONS)}")
+    rnd = bench.current_round()
     if args.out is None:
         # a sectioned run must not land on the canonical name: bench.py
         # points hermetic runs at the newest complete capture, and a
         # partial file with value=null would shadow a complete older one
         args.out = os.path.join(
-            REPO, "BENCH_TPU_CAPTURE_r03_partial.json" if only
-            else "BENCH_TPU_CAPTURE_r03.json")
+            REPO, f"BENCH_TPU_CAPTURE_r{rnd:02d}_partial.json" if only
+            else f"BENCH_TPU_CAPTURE_r{rnd:02d}.json")
+
+    # resume state: reload a previous (partial) capture at the same path
+    prior: dict = {}
+    if not args.force and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            log(f"resuming from {args.out}")
+        except (OSError, ValueError):
+            prior = {}
 
     def want(section: str) -> bool:
-        return only is None or section in only
+        if only is not None and section not in only:
+            return False
+        if prior and section_recorded(section, prior):
+            log(f"section {section}: already captured, skipping "
+                "(--force to re-run)")
+            return False
+        return True
 
     if not bench.ensure_shim():
         log("shim build failed")
@@ -217,50 +274,91 @@ def main() -> int:
     log(f"TPU healthy (attempt {attempts})")
 
     obs_table = bench.calibrate_obs_overhead()
-    detail: dict = {
+    detail: dict = prior.get("detail", {}) if prior else {}
+    detail.update({
         "workload": "8192x8192 bf16 matmul sync train loop, 30 timed "
                     "steps after 10-step warmup; paired (t100, tq) "
                     "shares per rep",
         "obs_excess_table_calibrated": obs_table,
         "calibration_stat": os.environ.get("VTPU_OBS_CAL_STAT", "median"),
-    }
-    top: dict = {}
+    })
+    top: dict = {k: v for k, v in prior.items()
+                 if k not in ("detail", "value", "vs_baseline", "date",
+                              "tpu_health_attempts", "sections_failed")}
 
-    if want("quotas"):
-        detail.update(capture_quotas(obs_table, args.reps))
-    if want("hbm"):
+    def persist() -> None:
+        """Rewrite the output after every section: a wedge mid-capture
+        keeps everything landed so far (VERDICT r3 #1)."""
+        mae = detail.get("mae_pct")
+        capture = {
+            "metric": "core_quota_tracking_mae",
+            "value": mae,
+            "unit": "percent",
+            "vs_baseline": (round(mae / bench.BASELINE_AIMD_MAE, 3)
+                            if mae is not None else None),
+            **top,
+            "hardware": "TPU v5 lite (axon tunnel), no hermetic fallback",
+            "date": datetime.date.today().isoformat(),
+            "tpu_health_attempts": attempts,
+            **({"sections_failed": sorted(failed)} if failed else {}),
+            "detail": detail,
+        }
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(capture, f)
+        os.replace(tmp, args.out)
+
+    def run_section(name: str, fn, into: dict) -> None:
+        if not want(name):
+            return
+        log(f"section {name}: starting")
+        try:
+            result = fn()
+        except Exception as exc:  # noqa: BLE001 — isolate sections
+            log(f"section {name}: EXCEPTION {exc!r}")
+            result = {}
+        if result:
+            into.update(result)
+        # success = the same predicate resume uses, so a section that
+        # ran but landed nothing usable (e.g. quota_points: [] with no
+        # mae) is retried on the next healthy window
+        if section_recorded(name, {**top, "detail": detail}):
+            failed.discard(name)
+        else:
+            log(f"section {name}: produced nothing (transport flake?)")
+            failed.add(name)
+        persist()
+
+    failed: set = set(prior.get("sections_failed", []))
+    # priority order: headline numbers first (see module docstring)
+    run_section("mfu",
+                lambda: bench.run_mfu_capture(obs_table, reps=args.reps),
+                top)
+    run_section("quotas",
+                lambda: capture_quotas(obs_table, args.reps), detail)
+    run_section("overhead",
+                lambda: capture_overhead(obs_table, args.reps), top)
+    def hbm_section() -> dict:
+        # tri-state: None = could not run (record nothing, so resume
+        # retries) — an unrunnable check must never publish as VIOLATION
         penalty = bench.run_hbm_check()
-        detail["hbm_cap"] = ("exact (64 MiB cap rejected 256 MiB "
-                             "materialization, error=0)"
-                             if penalty == 0 else "VIOLATION")
-    if want("overhead"):
-        top.update(capture_overhead(obs_table, args.reps))
-    if want("mfu"):
-        top.update(bench.run_mfu_capture(obs_table, reps=args.reps))
-    if want("balance"):
-        detail.update(capture_balance())
-    if want("busy"):
-        detail.update(capture_busy(obs_table))
-    if want("offload"):
-        detail.update(capture_host_offload())
+        if penalty is None:
+            return {}
+        return {"hbm_cap": (
+            "exact (64 MiB cap rejected 256 MiB materialization, "
+            "error=0)" if penalty == 0 else "VIOLATION")}
 
-    mae = detail.get("mae_pct")
-    capture = {
-        "metric": "core_quota_tracking_mae",
-        "value": mae,
-        "unit": "percent",
-        "vs_baseline": (round(mae / bench.BASELINE_AIMD_MAE, 3)
-                        if mae is not None else None),
-        **top,
-        "hardware": "TPU v5 lite (axon tunnel), no hermetic fallback",
-        "date": datetime.date.today().isoformat(),
-        "tpu_health_attempts": attempts,
-        "detail": detail,
-    }
-    with open(args.out, "w") as f:
-        json.dump(capture, f)
-    log(f"capture written to {args.out}")
-    print(json.dumps(capture))
+    run_section("hbm", hbm_section, detail)
+    run_section("balance", capture_balance, detail)
+    run_section("busy", lambda: capture_busy(obs_table), detail)
+    run_section("offload", capture_host_offload, detail)
+
+    persist()
+    log(f"capture written to {args.out}"
+        + (f" (sections still missing: {sorted(failed)})" if failed
+           else ""))
+    with open(args.out) as f:
+        print(f.read())
     return 0
 
 

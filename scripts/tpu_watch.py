@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Round-long TPU tunnel-recovery watcher (VERDICT r3 #1/#2).
+
+The axon tunnel wedges and recovers on its own, hours-long timescale;
+probing only at round end has now cost two consecutive rounds their
+hardware capture. This watcher runs for the WHOLE round:
+
+  - probes `bench.tpu_healthy()` every --interval seconds (default 600),
+    appending every probe to TPU_PROBE_LOG_r{N}.jsonl — a committed,
+    timestamped record proving continuous coverage of the round even if
+    the tunnel never recovers;
+  - on the FIRST healthy probe, fires `scripts/capture_hw.py` (sections
+    in priority order, partial JSON persisted after each section) to
+    land BENCH_TPU_CAPTURE_r{N}.json;
+  - if the capture lands incomplete (tunnel re-wedged mid-run), keeps
+    probing and re-fires; capture_hw resumes from its partial file and
+    only runs the missing sections;
+  - exits once the capture is complete, leaving the probe log as the
+    coverage record.
+
+A flock on the log file prevents two watchers double-firing the capture.
+
+Usage: nohup python scripts/tpu_watch.py >> tpu_watch.out 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench  # noqa: E402
+
+
+def capture_complete(path: str) -> bool:
+    """Complete = the two headline numbers (quota MAE, MFU pair —
+    VERDICT r3 #1) landed AND every section recorded a result. The
+    headline alone must not stop the watcher: capture_hw's resume
+    finishes the remaining sections at near-zero cost on the next
+    healthy probe."""
+    try:
+        with open(path) as f:
+            cap = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if (cap.get("value") is None
+            or cap.get("mfu_pct_shim_on") is None
+            or cap.get("mfu_pct_shim_off") is None
+            or cap.get("sections_failed")):
+        return False
+    import capture_hw
+    return all(capture_hw.section_recorded(s, cap)
+               for s in capture_hw.SECTIONS)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--interval", type=float, default=600.0,
+                        help="seconds between health probes")
+    parser.add_argument("--round", type=int, default=None)
+    parser.add_argument("--once", action="store_true",
+                        help="single probe + (maybe) capture, then exit")
+    args = parser.parse_args()
+    rnd = args.round if args.round is not None else bench.current_round()
+    log_path = os.path.join(REPO, f"TPU_PROBE_LOG_r{rnd:02d}.jsonl")
+    out_path = os.path.join(REPO, f"BENCH_TPU_CAPTURE_r{rnd:02d}.json")
+
+    log_f = open(log_path, "a")
+    try:
+        fcntl.flock(log_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        print("another watcher holds the probe log; exiting", flush=True)
+        return 0
+
+    def record(event: dict) -> None:
+        event["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        log_f.write(json.dumps(event) + "\n")
+        log_f.flush()
+        print(json.dumps(event), flush=True)
+
+    record({"event": "watcher_start", "round": rnd,
+            "interval_s": args.interval, "pid": os.getpid()})
+    probe_n = 0
+    while True:
+        if capture_complete(out_path):
+            record({"event": "capture_complete", "file":
+                    os.path.basename(out_path), "probes": probe_n})
+            return 0
+        probe_n += 1
+        t0 = time.time()
+        healthy = bench.tpu_healthy()
+        record({"event": "probe", "n": probe_n, "healthy": healthy,
+                "probe_s": round(time.time() - t0, 1)})
+        if healthy:
+            record({"event": "capture_start", "out":
+                    os.path.basename(out_path)})
+            t0 = time.time()
+            # the capture hanging past its budget (tunnel re-wedge — the
+            # exact scenario this watcher exists for) must not kill the
+            # watcher: log it and keep probing; capture_hw resumes from
+            # its partial file on the next healthy window
+            try:
+                res = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "scripts", "capture_hw.py"),
+                     "--out", out_path],
+                    capture_output=True, text=True, timeout=7200)
+                rc, tail = res.returncode, (res.stderr or res.stdout)
+            except subprocess.TimeoutExpired as exc:
+                rc = -1
+                tail = f"capture timed out after 7200s: {exc}"
+            except OSError as exc:
+                rc, tail = -1, f"capture failed to launch: {exc}"
+            record({"event": "capture_done", "rc": rc,
+                    "wall_s": round(time.time() - t0, 1),
+                    "complete": capture_complete(out_path),
+                    "tail": tail[-2000:]})
+            if capture_complete(out_path):
+                record({"event": "capture_complete",
+                        "file": os.path.basename(out_path),
+                        "probes": probe_n})
+                return 0
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,6 +2,7 @@
 
 import os
 
+from vtpu_manager.client import pod_resources
 from vtpu_manager.config import tc_watcher, vtpu_config as vc
 from vtpu_manager.config.vmem import VmemLedger, fnv64
 from vtpu_manager.device.types import fake_chip
@@ -356,6 +357,75 @@ def test_mapping_crosscheck_checkpoint_fallback(tmp_path):
     assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
             'pod_uid="uid-9",container="main"} 1.0') in text
     assert 'vtpu_node_pod_mapping_source{node="n1"} 1.0' in text
+
+
+def test_mapping_crosscheck_socket_plus_checkpoint_pair_keyed(tmp_path):
+    """ADVICE r3 medium: with the socket up, name-only matching would
+    corroborate a spoofed/orphaned dir (bogus-uid_main) because SOME pod
+    runs a container named 'main'. With both sources answering, the
+    (pod_uid, container) pair must be in the UID-keyed checkpoint AND the
+    name live on the socket."""
+    import json
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0)]
+    _mk_config_dir(base, "uid-1", "main", chips[0])     # genuine
+    _mk_config_dir(base, "bogus-uid", "main", chips[0])  # spoofed name
+    _mk_config_dir(base, "uid-5", "gone", chips[0])  # in ckpt, not live
+    from vtpu_manager.util import consts as c
+    ckpt_path = str(tmp_path / "kubelet_internal_checkpoint")
+    with open(ckpt_path, "w") as f:
+        json.dump({"Data": {"PodDeviceEntries": [
+            {"PodUID": "uid-1", "ContainerName": "main",
+             "ResourceName": c.vtpu_number_resource(),
+             "DeviceIDs": {"-1": ["vtpu-0-0"]}},
+            {"PodUID": "uid-5", "ContainerName": "gone",
+             "ResourceName": c.vtpu_number_resource(),
+             "DeviceIDs": {"-1": ["vtpu-0-1"]}}]}}, f)
+    sock = str(tmp_path / "podres.sock")
+    server = _fake_pod_resources_server(sock, ["main"])
+    try:
+        text = NodeCollector(
+            "n1", chips, base_dir=base,
+            tc_path=str(tmp_path / "tc"), vmem_path=str(tmp_path / "vm"),
+            pod_resources_socket=sock,
+            kubelet_checkpoint=ckpt_path).render()
+    finally:
+        server.stop(0)
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-1",container="main"} 0.0') in text
+    # the name 'main' is live on the socket, but the UID pair is not in
+    # the checkpoint: spoof caught
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="bogus-uid",container="main"} 1.0') in text
+    # pair in the (stale) checkpoint but container not live per socket
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-5",container="gone"} 1.0') in text
+    assert 'vtpu_node_pod_mapping_source{node="n1"} 3.0' in text
+
+
+def test_mapping_crosscheck_view_is_ttl_cached(tmp_path, monkeypatch):
+    """ADVICE r3: the kubelet List (fresh channel, 2 s timeout) must not
+    run synchronously on every scrape — a wedged socket would stall every
+    render. Within the TTL one fetch serves repeated scrapes."""
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0)]
+    _mk_config_dir(base, "uid-1", "main", chips[0])
+    calls = []
+    monkeypatch.setattr(
+        pod_resources, "kubelet_view",
+        lambda *a, **k: calls.append(1) or pod_resources.KubeletView(
+            source="podresources", containers=frozenset({"main"})))
+    collector = NodeCollector(
+        "n1", chips, base_dir=base,
+        tc_path=str(tmp_path / "tc"), vmem_path=str(tmp_path / "vm"),
+        pod_resources_socket=str(tmp_path / "no-sock"),
+        kubelet_checkpoint=str(tmp_path / "no-ckpt"))
+    collector.render()
+    collector.render()
+    assert len(calls) == 1           # second scrape hit the cache
+    collector._kubelet_view_ts -= collector.kubelet_view_ttl_s + 1
+    collector.render()
+    assert len(calls) == 2           # TTL expiry refetches
 
 
 def test_mapping_crosscheck_no_source(tmp_path):

@@ -123,3 +123,18 @@ def test_domain_config():
     finally:
         consts.init_global_domain(
             resource_domain=consts.DEFAULT_RESOURCE_DOMAIN)
+
+
+def test_fake_kube_client_rejects_unknown_field_selector():
+    """ADVICE r3: an unrecognized selector must fail loudly in the fake,
+    not silently return the full list (divergence from the apiserver
+    would otherwise hide inside passing tests)."""
+    import pytest
+
+    from vtpu_manager.client.fake import FakeKubeClient
+
+    client = FakeKubeClient()
+    assert client.list_pods() == []
+    assert client.list_pods(field_selector="spec.nodeName!=") == []
+    with pytest.raises(NotImplementedError):
+        client.list_pods(field_selector="status.phase=Running")

@@ -91,6 +91,14 @@ class FakeKubeClient:
 
     def list_pods(self, namespace=None, node_name=None,
                   field_selector=None) -> list[dict]:
+        # Recognize exactly the selectors the real client sends; anything
+        # else must blow up HERE, not silently return the full list and
+        # let a test pass against behavior the apiserver won't have
+        # (ADVICE r3).
+        if field_selector not in (None, "", "spec.nodeName!="):
+            raise NotImplementedError(
+                f"FakeKubeClient.list_pods: unsupported field_selector "
+                f"{field_selector!r} (known: 'spec.nodeName!=')")
         scheduled_only = field_selector == "spec.nodeName!="
         with self._lock:
             source = self._scheduled if scheduled_only else self.pods

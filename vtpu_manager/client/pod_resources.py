@@ -43,15 +43,24 @@ class ContainerEntry:
 
 @dataclass(frozen=True)
 class KubeletView:
-    """What the kubelet says about vtpu tenancy, in whichever key space
-    the available source provides.
+    """What the kubelet says about vtpu tenancy, in whichever key spaces
+    the available sources provide.
 
-    - source "podresources": `containers` holds container NAMES with vtpu
-      devices (the v1alpha1 API identifies pods by name/namespace, not
-      UID, so that is the comparable unit against config-dir names);
-    - source "checkpoint": `pairs` holds (pod_uid, container) — the exact
-      key our config directories use;
-    - source "": neither endpoint reachable; no cross-check possible.
+    - `containers`: container NAMES with vtpu devices from the live
+      pod-resources socket (the v1alpha1 API identifies pods by
+      name/namespace, not UID — a name-only key space);
+    - `pairs`: (pod_uid, container) from the kubelet device-manager
+      checkpoint — the exact key our config directories use, but
+      possibly stale;
+    - source "podresources+checkpoint" / "podresources" / "checkpoint" /
+      "" names the strongest combination reachable this scrape.
+
+    Both sources are consulted when both answer (ADVICE r3 medium): name
+    matching alone would corroborate an orphaned/spoofed config dir
+    (bogus-uid_main) whenever ANY vtpu pod runs a container with that
+    common name — exactly the case the mismatch gauge claims to catch —
+    so liveness comes from the socket and identity from the UID-keyed
+    checkpoint, and a judgment uses the strongest key space available.
     """
     source: str
     containers: frozenset[str] | None = None
@@ -59,7 +68,12 @@ class KubeletView:
 
     def corroborates(self, pod_uid: str, container: str) -> bool | None:
         """True/False when this view can judge the attribution; None when
-        no source was available (skip, do not alarm)."""
+        no source was available (skip, do not alarm). With both sources
+        up, corroboration requires the (pod_uid, container) pair in the
+        checkpoint AND the container name live on the socket."""
+        if self.pairs is not None and self.containers is not None:
+            return ((pod_uid, container) in self.pairs
+                    and container in self.containers)
         if self.pairs is not None:
             return (pod_uid, container) in self.pairs
         if self.containers is not None:
@@ -108,19 +122,18 @@ def list_pod_resources(socket_path: str = POD_RESOURCES_SOCKET,
 def kubelet_view(socket_path: str = POD_RESOURCES_SOCKET,
                  checkpoint_path: str = ckpt.KUBELET_CHECKPOINT
                  ) -> KubeletView:
-    """The kubelet's view of vtpu-holding containers, from the strongest
-    available source."""
+    """The kubelet's view of vtpu-holding containers, combining every
+    source that answers (see KubeletView for why both)."""
     domain = consts.resource_domain()
     entries = list_pod_resources(socket_path)
-    if entries is not None:
-        return KubeletView(
-            source="podresources",
-            containers=frozenset(e.container for e in entries
-                                 if e.resource.startswith(domain)))
+    containers = (frozenset(e.container for e in entries
+                            if e.resource.startswith(domain))
+                  if entries is not None else None)
     cps = ckpt.read_checkpoint(checkpoint_path)
-    if cps:
-        return KubeletView(
-            source="checkpoint",
-            pairs=frozenset((c.pod_uid, c.container) for c in cps
-                            if c.resource.startswith(domain)))
-    return KubeletView(source="")
+    pairs = (frozenset((c.pod_uid, c.container) for c in cps
+                       if c.resource.startswith(domain))
+             if cps else None)
+    source = "+".join(
+        name for name, got in (("podresources", containers is not None),
+                               ("checkpoint", pairs is not None)) if got)
+    return KubeletView(source=source, containers=containers, pairs=pairs)

