@@ -17,6 +17,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -86,6 +87,10 @@ struct FakeEvent;
 struct FakeBuffer {
   int64_t size;
   FakeEvent* ready = nullptr;  // fires when the producing exec completes
+  // true device completion even when `ready` lies (FAKE_LYING_EVENTS
+  // fires `ready` at dispatch-accept; the data dependency is still
+  // real, so D2H readbacks chain on THIS)
+  FakeEvent* true_ready = nullptr;
   int device_id = 0;
   bool owns = true;            // views do not own (or charge) their bytes
 };
@@ -159,6 +164,140 @@ bool LyingEvents() {
   // event ever reflects it — the tenant is blind to its own device time.
   static int v = getenv("FAKE_LYING_EVENTS") ? 1 : 0;
   return v == 1;
+}
+
+// --- trace replay (VERDICT r3 #3) ------------------------------------------
+// Replay RECORDED real-tunnel span pathology instead of synthetic constants,
+// so calibration changes are validated against what the hardware actually
+// did (library/test/traces/*.env hold the recorded regimes):
+//
+//   FAKE_GAP_EXCESS_TABLE="gap_us:excess_us,..." — after-idle inflation:
+//     an execute dispatched after an idle gap G is OBSERVED excess(G)
+//     microseconds late (true completion is honest; the host-side await
+//     returns late). Interpolation matches the shim's reading of
+//     VTPU_OBS_EXCESS_TABLE so a table calibrated on this transport
+//     discounts exactly what the transport adds.
+//   FAKE_FLUSH_FLOOR_US=N — D2H readback events are never observed
+//     before N us after the readback was issued (the v5e relay quantizes
+//     tiny readbacks to a ~63 ms flush): wall-clock floor, not additive.
+
+struct GapExcess {
+  std::vector<std::pair<int64_t, int64_t>> pts;  // (gap_us, excess_us)
+};
+
+const GapExcess& GapTable() {
+  static GapExcess* t = [] {
+    auto* out = new GapExcess();
+    const char* env = getenv("FAKE_GAP_EXCESS_TABLE");
+    if (!env) return out;
+    const char* p = env;
+    while (*p) {
+      char* end = nullptr;
+      long long gap = strtoll(p, &end, 10);
+      if (end == p || *end != ':') break;
+      p = end + 1;
+      long long excess = strtoll(p, &end, 10);
+      if (end == p) break;
+      out->pts.emplace_back((int64_t)gap, (int64_t)excess);
+      p = *end == ',' ? end + 1 : end;
+    }
+    std::sort(out->pts.begin(), out->pts.end());
+    return out;
+  }();
+  return *t;
+}
+
+int64_t GapExcessAt(int64_t gap_us) {
+  const auto& pts = GapTable().pts;
+  if (pts.empty()) return 0;
+  if (gap_us <= pts.front().first) {
+    // ramp from zero below the first knee: back-to-back dispatches carry
+    // no after-idle inflation on the recorded transports
+    return pts.front().first > 0
+        ? pts.front().second * gap_us / pts.front().first
+        : pts.front().second;
+  }
+  if (gap_us >= pts.back().first) return pts.back().second;
+  for (size_t i = 1; i < pts.size(); i++) {
+    if (gap_us <= pts[i].first) {
+      int64_t g0 = pts[i - 1].first, g1 = pts[i].first;
+      int64_t e0 = pts[i - 1].second, e1 = pts[i].second;
+      return e0 + (e1 - e0) * (gap_us - g0) / (g1 - g0 ? g1 - g0 : 1);
+    }
+  }
+  return pts.back().second;
+}
+
+int64_t FlushFloorUs() {
+  static int64_t v = [] {
+    const char* e = getenv("FAKE_FLUSH_FLOOR_US");
+    return e ? atol(e) : 0;
+  }();
+  return v;
+}
+
+int64_t NowMonoUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+
+// last device-completion instant, for the idle-gap lookup (in-process:
+// the replayed pathology is per-tunnel-session, and each tenant process
+// has its own tunnel session on the real transport)
+std::atomic<int64_t> g_last_exec_end_us{0};
+
+// Observation skew is delivered by delaying event READINESS (the shim
+// times spans through PJRT_Event_OnReady callbacks, so skewing only
+// Await would be invisible to it). The chip itself is NOT held — the
+// inflation is transport-side; the next execute proceeds on schedule.
+// One sleeper thread serves every event sharing the wake instant.
+void MarkReadyAt(FakeEvent* evt, int64_t at_us,
+                 FakeEvent* evt2 = nullptr) {
+  int64_t now = NowMonoUs();
+  if (at_us <= now) {
+    evt->MarkReady();
+    if (evt2) evt2->MarkReady();
+    return;
+  }
+  std::thread([evt, evt2, at_us] {
+    int64_t d = at_us - NowMonoUs();
+    if (d > 0) usleep((useconds_t)d);
+    evt->MarkReady();
+    if (evt2) evt2->MarkReady();
+  }).detach();
+}
+
+// Chain `evt` on `producer`'s true readiness, then observe it no earlier
+// than `deadline_us` (0 = as soon as ready): the D2H data dependency is
+// real even on transports whose completion events lie.
+struct ChainArg {
+  FakeEvent* evt;
+  int64_t deadline_us;
+};
+
+void FireChained(PJRT_Error*, void* arg) {
+  auto* chain = static_cast<ChainArg*>(arg);
+  MarkReadyAt(chain->evt, chain->deadline_us);
+  delete chain;
+}
+
+void ReadyAfterProducer(FakeEvent* evt, FakeEvent* producer,
+                        int64_t deadline_us) {
+  if (producer) {
+    bool fire_now = false;
+    {
+      std::lock_guard<std::mutex> g(producer->mu);
+      if (producer->ready) {
+        fire_now = true;
+      } else {
+        producer->callbacks.emplace_back(
+            FireChained, new ChainArg{evt, deadline_us});
+      }
+    }
+    if (!fire_now) return;
+  }
+  MarkReadyAt(evt, deadline_us);
 }
 
 // Device busy simulation: executes serialize on the fake chip. With
@@ -343,7 +482,13 @@ PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
   }
   memset(args->dst, 0, (size_t)buf->size);
   auto* evt = new FakeEvent();
-  evt->MarkReady();  // data "arrives" now; awaiting it pays ObsLatencyUs
+  // the readback completes only after its producer truly finished (the
+  // data dependency holds even when completion events lie), and under
+  // the v5e flush floor it is never OBSERVED before issue-time + floor
+  int64_t floor_us = FlushFloorUs();
+  FakeEvent* producer = buf->true_ready ? buf->true_ready : buf->ready;
+  int64_t deadline = floor_us ? NowMonoUs() + floor_us : 0;
+  ReadyAfterProducer(evt, producer, deadline);
   args->event = reinterpret_cast<PJRT_Event*>(evt);
   return nullptr;
 }
@@ -418,6 +563,7 @@ struct ExecJob {
   FakeEvent* done;
   FakeEvent* out_ready;
   int64_t dur;
+  int64_t extra_obs_us = 0;   // trace replay: after-idle inflation
 };
 // intentionally leaked: a detached worker waits on these forever, and
 // destroying a condition_variable/mutex with waiters at process exit is
@@ -456,8 +602,12 @@ void* DeviceWorker(void*) {
         __atomic_fetch_add(&g_shared->busy_ns,
                            (uint64_t)job.dur * 1000, __ATOMIC_RELAXED);
     }
-    job.out_ready->MarkReady();
-    job.done->MarkReady();
+    int64_t end_us = NowMonoUs();
+    g_last_exec_end_us.store(end_us, std::memory_order_relaxed);
+    // observation of this completion arrives extra_obs_us late (the
+    // recorded after-idle inflation); true completion time above is what
+    // the next dispatch's gap is measured from
+    MarkReadyAt(job.out_ready, end_us + job.extra_obs_us, job.done);
     if (Trace()) fprintf(stderr, "[fake] job done\n");
   }
   return nullptr;
@@ -489,6 +639,14 @@ void StartWorker() {
 PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   int64_t dur = ExecUs();
   pthread_once(&g_worker_once, StartWorker);
+  // trace replay: an execute dispatched after an idle gap is observed
+  // late by the recorded after-idle inflation at that gap
+  int64_t extra_obs = 0;
+  if (!GapTable().pts.empty()) {
+    int64_t last = g_last_exec_end_us.load(std::memory_order_relaxed);
+    int64_t gap = last > 0 ? NowMonoUs() - last : 0;
+    extra_obs = GapExcessAt(gap < 0 ? 0 : gap);
+  }
   // Simulate a serialized device: each execute occupies the chip for `dur`.
   for (size_t d = 0; d < args->num_devices; d++) {
     // Distinct events for the caller (device_complete) and the buffer
@@ -498,28 +656,33 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     FakeEvent* done = new FakeEvent();
     FakeEvent* out_ready = new FakeEvent();
     done->exec_side = out_ready->exec_side = true;
+    // the event that marks TRUE device completion: out_ready normally,
+    // the worker's sink when the observable events lie (the output
+    // buffer's data dependency — D2H chaining — rides on this)
+    FakeEvent* true_done = out_ready;
+    if (LyingEvents()) {
+      // events fire immediately; the device work still queues
+      done->MarkReady();
+      out_ready->MarkReady();
+      FakeEvent* sink_done = new FakeEvent();
+      true_done = new FakeEvent();
+      std::lock_guard<std::mutex> lk(JobsMu());
+      Jobs().push_back({sink_done, true_done, dur, extra_obs});
+    } else {
+      std::lock_guard<std::mutex> lk(JobsMu());
+      Jobs().push_back({done, out_ready, dur, extra_obs});
+    }
     if (args->output_lists && args->output_lists[d]) {
       auto* out = new FakeBuffer{OutBytes()};
       out->device_id = (int)d < DeviceCount() ? (int)d : 0;
       out->ready = out_ready;
+      out->true_ready = true_done;
       args->output_lists[d][0] = reinterpret_cast<PJRT_Buffer*>(out);
       if (g_client)
         g_client->devices[out->device_id].bytes_in_use.fetch_add(OutBytes());
     }
     if (args->device_complete_events) {
       args->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(done);
-    }
-    if (LyingEvents()) {
-      // events fire immediately; the device work still queues
-      done->MarkReady();
-      out_ready->MarkReady();
-      FakeEvent* sink_done = new FakeEvent();
-      FakeEvent* sink_ready = new FakeEvent();
-      std::lock_guard<std::mutex> lk(JobsMu());
-      Jobs().push_back({sink_done, sink_ready, dur});
-    } else {
-      std::lock_guard<std::mutex> lk(JobsMu());
-      Jobs().push_back({done, out_ready, dur});
     }
     JobsCv().notify_one();
     if (Trace()) fprintf(stderr, "[fake] enqueued\n");

@@ -192,6 +192,11 @@ static int RunObsLatency(const PJRT_Api* api, PJRT_Client* client,
   PJRT_Buffer* resident = Alloc(api, client, dev, 65536, &err);
   CHECK(!err && resident, "resident alloc");
   auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
+  // SHIM_OBS_READBACK=1 reads the output back each step — the sync
+  // train-loop shape (`float(loss)` per step). Required to replay the
+  // lying-events regime, where D2H readback spans are the only honest
+  // busy signal the shim can observe.
+  bool readback = getenv("SHIM_OBS_READBACK") != nullptr;
   auto one_step = [&](int i) {
     PJRT_LoadedExecutable_Execute_Args eargs;
     memset(&eargs, 0, sizeof(eargs));
@@ -212,11 +217,30 @@ static int RunObsLatency(const PJRT_Api* api, PJRT_Client* client,
       aargs.event = events[0];
       api->PJRT_Event_Await(&aargs);
     }
+    if (outs[0] && readback) {
+      char dst[1024];
+      PJRT_Buffer_ToHostBuffer_Args targs;
+      memset(&targs, 0, sizeof(targs));
+      targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      targs.src = outs[0];
+      targs.dst = dst;
+      targs.dst_size = sizeof(dst);
+      PJRT_Error* te = api->PJRT_Buffer_ToHostBuffer(&targs);
+      CHECK(!te, "readback %d errored", i);
+      if (!te && targs.event) {
+        PJRT_Event_Await_Args aargs;
+        memset(&aargs, 0, sizeof(aargs));
+        aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+        aargs.event = targs.event;
+        api->PJRT_Event_Await(&aargs);
+      }
+    }
     if (outs[0]) Destroy(api, outs[0]);
   };
   for (int i = 0; i < 3; i++) one_step(i);  // warmup: starts watcher+probe
   usleep(1200 * 1000);                      // probe learns the latency
   int iters = 100;
+  if (const char* it = getenv("SHIM_OBS_ITERS")) iters = atoi(it);
   // SHIM_OBS_EXPECT_MS="lo,hi" overrides the wall bounds so the same
   // scenario also asserts the NEGATIVE regimes: an asymmetric transport
   // (FAKE_OBS_ASYM) where the probe must stay at ~0 discount (~1600 ms),

@@ -129,6 +129,86 @@ def test_templates_render_to_valid_k8s_yaml(overrides):
     assert "DaemonSet" in seen_kinds and "Deployment" in seen_kinds
 
 
+@pytest.mark.parametrize("overrides,profile",
+                         [(None, "defaults"), (ALL_ON, "everything-on")],
+                         ids=["defaults", "everything-on"])
+def test_rendered_form_matches_committed_goldens(overrides, profile):
+    """VERDICT r3 #7: pin the chart's rendered form. A template edit (or
+    a renderer change) must show up as a reviewable manifest diff, and a
+    site with real helm can certify the subset renderer by diffing
+    `helm template` output against these files. Regenerate consciously
+    with scripts/regen_chart_goldens.py."""
+    values = _values(overrides)
+    tdir = os.path.join(CHART, "templates")
+    gdir = os.path.join(CHART, "rendered-goldens")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".yaml"):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            rendered = render(f.read(), values).rstrip("\n") + "\n"
+        golden_path = os.path.join(gdir, f"{profile}__{name}")
+        assert os.path.exists(golden_path), (
+            f"no golden for {name}; run scripts/regen_chart_goldens.py")
+        with open(golden_path) as f:
+            golden = f.read()
+        assert rendered == golden, (
+            f"{name} renders differently from its golden "
+            f"({profile}); if intended, run "
+            "scripts/regen_chart_goldens.py and review the diff")
+    # no stale goldens: every golden must map back to a live template,
+    # or a site diffing `helm template` against this directory sees
+    # phantom manifests
+    templates = {n for n in os.listdir(tdir) if n.endswith(".yaml")}
+    for gname in sorted(os.listdir(gdir)):
+        gprofile, _, tname = gname.partition("__")
+        if gprofile == profile:
+            assert tname in templates, (
+                f"stale golden {gname}: template {tname} no longer "
+                "exists; run scripts/regen_chart_goldens.py")
+
+
+def test_kueue_examples_are_valid_and_use_real_contract_names():
+    """examples/kueue/ (reference example/kueue/ parity): YAML-valid, and
+    every vtpu-manager-facing name (resources, annotations, topology
+    modes, gang keys) must be the one the code actually serves."""
+    from vtpu_manager.util import consts
+
+    kdir = os.path.join(os.path.dirname(CHART), "..", "examples", "kueue")
+    kdir = os.path.normpath(kdir)
+    docs = {}
+    for name in sorted(os.listdir(kdir)):
+        with open(os.path.join(kdir, name)) as f:
+            docs[name] = [d for d in yaml.safe_load_all(f) if d]
+    assert set(docs) == {"configuration.yaml", "sample.yaml",
+                         "topology-aware.yaml"}
+    # transformation inputs are the real extender-only resources
+    transforms = docs["configuration.yaml"][0]["resources"][
+        "transformations"]
+    assert {t["input"] for t in transforms} == {
+        consts.vtpu_cores_resource(), consts.vtpu_memory_resource()}
+    # the fractional sample requests all three real resource names
+    deploy = [d for d in docs["sample.yaml"]
+              if d["kind"] == "Deployment"][0]
+    limits = deploy["spec"]["template"]["spec"]["containers"][0][
+        "resources"]["limits"]
+    assert consts.vtpu_number_resource() in limits
+    assert consts.vtpu_cores_resource() in limits
+    assert consts.vtpu_memory_resource() in limits
+    # the TAS gang job uses the served annotations and a valid mode
+    job = [d for d in docs["topology-aware.yaml"]
+           if d["kind"] == "Job"][0]
+    anns = job["spec"]["template"]["metadata"]["annotations"]
+    assert anns[consts.topology_mode_annotation()] in \
+        consts.TOPOLOGY_MODES
+    assert anns[consts.gang_name_annotation()] == "spmd-train"
+    assert int(anns[consts.gang_size_annotation()]) == \
+        job["spec"]["parallelism"]
+    for d in docs["sample.yaml"] + docs["topology-aware.yaml"]:
+        if d["kind"] in ("Deployment", "Job"):
+            assert d["spec"]["template"]["spec"]["schedulerName"] == \
+                "vtpu-scheduler"
+
+
 def test_dra_daemonset_has_preflight_and_monitor_mounts_pod_resources():
     values = _values(ALL_ON)
     with open(os.path.join(CHART, "templates", "node-agents.yaml")) as f:
