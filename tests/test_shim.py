@@ -246,12 +246,19 @@ class TestShimHermetic:
         return env
 
     @staticmethod
-    def _run_replay(shim_build, env) -> None:
+    def _run_replay(shim_build, env) -> float:
+        """Run the obs-latency scenario; returns the measured wall ms."""
         res = subprocess.run([shim_build["test"], "--obs-latency"],
                              env=env, timeout=180, capture_output=True,
                              text=True)
         assert res.returncode == 0, res.stdout + res.stderr
         assert "ALL PASS" in res.stdout
+        wall = None
+        for line in res.stdout.splitlines():
+            if "wall=" in line:
+                wall = float(line.split("wall=")[1].split("ms")[0])
+        assert wall is not None, res.stdout
+        return wall
 
     def test_trace_replay_uncalibrated_is_conservative(self, shim_build,
                                                        tmp_path):
@@ -315,6 +322,41 @@ class TestShimHermetic:
                              text=True)
         assert res.returncode == 0, res.stdout + res.stderr
         assert "ALL PASS" in res.stdout
+
+    def test_trace_replay_quota_mae_beats_reference_band(self, shim_build,
+                                                         tmp_path):
+        """The round's headline metric, measured against the RECORDED
+        transport: quota tracking at 50/25/10% on the replayed r2 regime
+        (gap inflation + flush floor), calibrated with the recorded
+        table. Iteration counts equalize wall (~8 s each) so the fixed
+        startup burst credit amortizes the same way at every quota (the
+        bench's 10-step warmup serves that role on hardware). Measured
+        errs {1.5, 1.7, 0.9}% -> MAE ~1.4%, consistent with the r2
+        HARDWARE capture (1.21-2.01%); the assert leaves noise margin
+        but still beats the reference's best AIMD band (2.8%,
+        docs/sm_controller_aimd.md)."""
+        regime = self._recorded_regime()
+        exec_us = 70000                  # recorded ~70 ms step
+        errs = []
+        for quota, iters in ((50, 60), (25, 30), (10, 12)):
+            env = base_env(shim_build, tmp_path)
+            env.update({
+                "VTPU_MEM_LIMIT_0": "1073741824",
+                "VTPU_CORE_LIMIT_0": str(quota),
+                "FAKE_EXEC_US": str(exec_us),
+                "FAKE_GAP_EXCESS_TABLE": regime["FAKE_GAP_EXCESS_TABLE"],
+                "FAKE_FLUSH_FLOOR_US": regime["FAKE_FLUSH_FLOOR_US"],
+                "VTPU_OBS_EXCESS_TABLE": regime["FAKE_GAP_EXCESS_TABLE"],
+                "SHIM_OBS_ITERS": str(iters),
+                "SHIM_OBS_EXPECT_MS": "1,999999",
+            })
+            wall = self._run_replay(shim_build, env)
+            share = 100.0 * iters * (exec_us / 1000.0) / wall
+            err = abs(share - quota)
+            errs.append(err)
+            assert err <= 3.5, (quota, share, wall)
+        mae = sum(errs) / len(errs)
+        assert mae <= 2.5, errs          # reference AIMD best band: 2.8
 
     def test_multichip_independent_caps_and_quotas(self, shim_build,
                                                    tmp_path):
