@@ -365,13 +365,29 @@ static void InitOnce() {
   if (!real) return;
   ShimState& s = State();
   s.real_api = real;
-  // Copy as much of the table as both sides understand; the wrapped table
-  // advertises the real plugin's struct_size so callers negotiate features
-  // against what actually exists.
+  // Copy as much of the table as both sides understand. The advertised
+  // struct_size must be the MINIMUM of the two: an older plugin's
+  // (smaller) size rides along via the memcpy, but a NEWER plugin's
+  // larger size must be clamped to what this shim's table actually
+  // holds — advertising the real size would send callers probing
+  // entries past the end of wrapped_api into adjacent memory (libtpu
+  // grows its PJRT table regularly; the reference budgets the same care
+  // for CUDA 13 ABI growth, test_cuda13_abi.c). Features beyond our
+  // compiled-in table are hidden, which is the safe degradation:
+  // callers gate every extension on struct_size.
   memset(&s.wrapped_api, 0, sizeof(s.wrapped_api));
   size_t copy = real->struct_size < sizeof(PJRT_Api) ? real->struct_size
                                                      : sizeof(PJRT_Api);
   memcpy(&s.wrapped_api, real, copy);
+  if (real->struct_size > sizeof(PJRT_Api)) {
+    VTPU_LOG(kLogWarn,
+             "real plugin PJRT table (%zu B, v%d.%d) is newer than this "
+             "shim's (%zu B); clamping advertised struct_size — entries "
+             "beyond the shim's table are hidden from the client",
+             real->struct_size, real->pjrt_api_version.major_version,
+             real->pjrt_api_version.minor_version, sizeof(PJRT_Api));
+    s.wrapped_api.struct_size = sizeof(PJRT_Api);
+  }
 
   s.enforce = LoadConfig();
   if (s.enforce) {

@@ -937,5 +937,12 @@ void InitApi() {
 
 extern "C" const PJRT_Api* GetPjrtApi() {
   pthread_once(&g_once, InitApi);
+  // FAKE_API_OVERSIZE=N: pretend to be a NEWER plugin whose PJRT table
+  // is N bytes larger than the shim's compiled-in one (libtpu grows the
+  // table regularly); the shim must clamp its advertised struct_size or
+  // clients would probe entries past the end of its wrapped table.
+  if (const char* over = getenv("FAKE_API_OVERSIZE")) {
+    g_api.struct_size = sizeof(PJRT_Api) + (size_t)atol(over);
+  }
   return &g_api;
 }

@@ -317,6 +317,15 @@ int main(int argc, char** argv) {
   const PJRT_Api* api = get_api();
   CHECK(api, "GetPjrtApi returned null (fake plugin not found?)");
   if (!api) return 2;
+  if (getenv("FAKE_API_OVERSIZE")) {
+    // the fake is posing as a newer plugin with a larger table: the
+    // shim must clamp what it advertises to its own compiled-in size,
+    // or callers would probe entries past the end of the wrapped table
+    CHECK(api->struct_size <= sizeof(PJRT_Api),
+          "advertised struct_size %zu exceeds the shim's table (%zu): "
+          "clients would read past the wrapped PJRT_Api",
+          api->struct_size, sizeof(PJRT_Api));
+  }
 
   PJRT_Client_Create_Args cargs;
   memset(&cargs, 0, sizeof(cargs));

@@ -103,6 +103,28 @@ class TestShimHermetic:
         assert "should fit" in res.stderr
         assert "co-tenants=524288B" in res.stdout, res.stdout
 
+    def test_newer_plugin_api_table_is_clamped(self, shim_build, tmp_path):
+        """ABI-churn care (SURVEY hard part (a); reference analogue
+        test_cuda13_abi.c): a real plugin built against a NEWER PJRT
+        whose table is larger than the shim's must not leak its
+        struct_size through the wrapped table — callers would probe
+        entries past the end of the shim's PJRT_Api. The full harness
+        must also still pass, proving the known prefix keeps working."""
+        env = base_env(shim_build, tmp_path)
+        env.update({
+            "VTPU_MEM_LIMIT_0": "1048576",
+            "VTPU_CORE_LIMIT_0": "50",
+            "FAKE_API_OVERSIZE": "256",
+        })
+        res = subprocess.run([shim_build["test"]], env=env, timeout=120,
+                             capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "ALL PASS" in res.stdout
+        # not vacuous: the shim must have SEEN the oversized table and
+        # clamped it (warn prints at the default log level); without
+        # this line the oversize plumbing silently stopped working
+        assert "clamping advertised struct_size" in res.stderr, res.stderr
+
     def test_obs_latency_isolated_span_discount(self, shim_build, tmp_path):
         """A transport that inflates every host-observed span by a fixed
         per-op latency (the remote-tunnel regime: spans = exec + RTT) must
@@ -214,14 +236,9 @@ class TestShimHermetic:
         """A committed recording of the real tunnel
         (library/test/traces/): FAKE_* env assignments replaying one
         observed transport regime."""
-        path = os.path.join(REPO, "library", "test", "traces", filename)
-        out = {}
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line and not line.startswith("#"):
-                    key, _, val = line.partition("=")
-                    out[key] = val
+        import bench
+        out = bench.read_trace_env(
+            os.path.join(REPO, "library", "test", "traces", filename))
         assert out, f"empty trace file {filename}"
         return out
 
