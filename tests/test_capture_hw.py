@@ -10,6 +10,7 @@ failure isolation, resume-from-partial, and flag parsing all run in CI.
 
 import json
 import os
+import subprocess
 import sys
 
 import pytest
@@ -59,6 +60,10 @@ def fake_bench(monkeypatch, tmp_path):
     monkeypatch.setattr(capture_hw, "capture_host_offload",
                         lambda: calls.append("offload") or {
                             "host_offload": {"status": "ok"}})
+    monkeypatch.setattr(capture_hw, "capture_pallas",
+                        lambda reps=2: calls.append("pallas") or {
+                            "pallas_attention": {"ms_pallas": 1.0,
+                                                 "ms_xla": 1.2}})
     return calls
 
 
@@ -231,7 +236,8 @@ def _complete_capture_dict():
         "detail": {"mae_pct": 1.0, "hbm_cap": "exact",
                    "balance_mode": {"climbed": True},
                    "vtpu_busy_convergence": {"in_band": True},
-                   "host_offload": {"status": "ok"}}}
+                   "host_offload": {"status": "ok"},
+                   "pallas_attention": {"ms_pallas": 1.0}}}
 
 
 def test_watcher_capture_complete_predicate(tmp_path):
@@ -277,6 +283,153 @@ def test_partial_quota_sweep_withholds_mae(fake_bench, tmp_path,
     assert cap["detail"]["quota_points_partial"] is True
     assert "quotas" in cap["sections_failed"]
     assert len(cap["detail"]["quota_points"]) == 1   # the point it got
+
+
+class TestWatcherLoop:
+    """Drive tpu_watch.main() itself (monkeypatched probe + capture):
+    the watcher is the round's delivery mechanism for the hardware
+    capture, so its loop logic gets the same CI treatment as the
+    capture script."""
+
+    @staticmethod
+    def _run(tmp_path, monkeypatch, *, healthy_seq, capture_effect=None,
+             extra_argv=()):
+        import tpu_watch
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
+        seq = iter(healthy_seq)
+        monkeypatch.setattr(bench, "tpu_healthy",
+                            lambda *a, **k: next(seq))
+        calls = []
+
+        def fake_run(argv, **kw):
+            calls.append(argv)
+            if capture_effect:
+                capture_effect(argv)
+            import types
+            return types.SimpleNamespace(returncode=0, stdout="done",
+                                         stderr="")
+
+        monkeypatch.setattr(tpu_watch.subprocess, "run", fake_run)
+        monkeypatch.setattr(tpu_watch.time, "sleep", lambda s: None)
+        monkeypatch.setattr(
+            sys, "argv", ["tpu_watch.py", "--round", "7", "--once",
+                          *extra_argv])
+        rc = tpu_watch.main()
+        log_path = tmp_path / "TPU_PROBE_LOG_r07.jsonl"
+        events = []
+        if log_path.exists():
+            with open(log_path) as f:
+                events = [json.loads(line) for line in f]
+        return rc, calls, events
+
+    def test_unhealthy_probe_logs_and_exits_once(self, tmp_path,
+                                                 monkeypatch):
+        rc, calls, events = self._run(tmp_path, monkeypatch,
+                                      healthy_seq=[False])
+        assert rc == 0 and not calls
+        kinds = [e["event"] for e in events]
+        assert kinds == ["watcher_start", "probe"]
+        assert events[1]["healthy"] is False
+
+    def test_healthy_probe_fires_capture_with_round_out(self, tmp_path,
+                                                        monkeypatch):
+        def land_capture(argv):
+            out = argv[argv.index("--out") + 1]
+            with open(out, "w") as f:
+                json.dump(_complete_capture_dict(), f)
+
+        rc, calls, events = self._run(tmp_path, monkeypatch,
+                                      healthy_seq=[True],
+                                      capture_effect=land_capture)
+        assert rc == 0
+        assert len(calls) == 1
+        assert calls[0][1].endswith("capture_hw.py")
+        assert calls[0][-1].endswith("BENCH_TPU_CAPTURE_r07.json")
+        kinds = [e["event"] for e in events]
+        assert kinds == ["watcher_start", "probe", "capture_start",
+                         "capture_done", "capture_complete"]
+        assert events[3]["complete"] is True
+
+    def test_partial_capture_keeps_probing(self, tmp_path, monkeypatch):
+        """Capture lands but incomplete (re-wedge mid-run): the watcher
+        must NOT declare victory; next healthy probe re-fires and the
+        resume finishes it."""
+        def land_partial(argv):
+            out = argv[argv.index("--out") + 1]
+            cap = _complete_capture_dict()
+            cap["sections_failed"] = ["busy"]
+            with open(out, "w") as f:
+                json.dump(cap, f)
+
+        rc, calls, events = self._run(tmp_path, monkeypatch,
+                                      healthy_seq=[True],
+                                      capture_effect=land_partial)
+        assert rc == 0
+        assert events[-1]["event"] == "capture_done"
+        assert events[-1]["complete"] is False
+
+    def test_capture_timeout_does_not_kill_watcher(self, tmp_path,
+                                                   monkeypatch):
+        import tpu_watch
+
+        def fake_run(argv, **kw):
+            raise subprocess.TimeoutExpired(argv, 7200)
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
+        monkeypatch.setattr(bench, "tpu_healthy", lambda *a, **k: True)
+        monkeypatch.setattr(tpu_watch.subprocess, "run", fake_run)
+        monkeypatch.setattr(sys, "argv",
+                            ["tpu_watch.py", "--round", "7", "--once"])
+        assert tpu_watch.main() == 0     # survived; logged, no crash
+        with open(tmp_path / "TPU_PROBE_LOG_r07.jsonl") as f:
+            events = [json.loads(line) for line in f]
+        done = [e for e in events if e["event"] == "capture_done"]
+        assert done and done[0]["rc"] == -1
+        assert "timed out" in done[0]["tail"]
+
+    def test_second_watcher_is_locked_out(self, tmp_path, monkeypatch):
+        import fcntl
+
+        import tpu_watch
+        monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
+        holder = open(tmp_path / "TPU_PROBE_LOG_r07.jsonl", "a")
+        fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        monkeypatch.setattr(sys, "argv",
+                            ["tpu_watch.py", "--round", "7", "--once"])
+        try:
+            assert tpu_watch.main() == 0     # exits without probing
+        finally:
+            holder.close()
+
+
+def test_embedded_worker_code_strings_compile(monkeypatch):
+    """The balance/busy/offload/pallas sections ship Python as `-c` code
+    strings that only ever run on a healthy tunnel — a syntax error
+    would burn the round's scarcest resource, a healthy window. Compile
+    every string here."""
+    compiled = []
+
+    def fake_run(argv, **kw):
+        assert argv[1] == "-c"
+        compile(argv[2], "<capture-section>", "exec")
+        compiled.append(argv[2])
+        import types
+        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+
+    monkeypatch.setattr(capture_hw.subprocess, "run", fake_run)
+    monkeypatch.setattr(capture_hw.bench, "tpu_env",
+                        lambda *a, **k: {})
+    capture_hw.capture_balance()
+    capture_hw.capture_busy("0:0")
+    capture_hw.capture_host_offload()
+    capture_hw.capture_pallas(reps=1)
+    # bench's HBM probe ships a code string down the same TPU-only path
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "tpu_env", lambda *a, **k: {})
+    bench.run_hbm_check()
+    assert len(compiled) == 5
 
 
 def test_bench_current_round_numeric():
