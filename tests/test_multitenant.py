@@ -33,6 +33,27 @@ def shim_build():
     return BUILD
 
 
+def run_tenants(tmp_path, specs, shared, iters, extra=None,
+                mode="--throttle-only"):
+    """Spawn one shim_test per (pod_uid, quota) spec concurrently;
+    returns {pod_uid: wall_ms}. One home for the Popen/communicate/
+    wall-parse loop every co-tenancy test repeats."""
+    procs = {uid: subprocess.Popen(
+        [os.path.join(BUILD, "shim_test"), mode],
+        env=tenant_env(tmp_path, uid, quota, iters, shared, extra=extra),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        for uid, quota in specs}
+    walls = {}
+    for uid, proc in procs.items():
+        out, _ = proc.communicate(timeout=300)
+        assert proc.returncode == 0, out
+        for line in out.splitlines():
+            if "wall=" in line:
+                walls[uid] = float(line.split("wall=")[1].split("ms")[0])
+    assert len(walls) == len(specs), walls
+    return walls
+
+
 def tenant_env(tmp_path, pod_uid, quota, iters, shared, extra=None):
     env = dict(os.environ)
     env.update({
@@ -90,19 +111,8 @@ def test_two_tenants_share_one_chip(shim_build, tmp_path):
     iters = 300    # 600 ms busy demand per tenant; 1.2 s chip-serialized
     try:
         t0 = time.monotonic()
-        procs = [subprocess.Popen(
-            [os.path.join(BUILD, "shim_test"), "--throttle-only"],
-            env=tenant_env(tmp_path, uid, 50, iters, shared),
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-            for uid in ("uid-a", "uid-b")]
-        walls = []
-        for proc in procs:
-            out, _ = proc.communicate(timeout=300)
-            assert proc.returncode == 0, out
-            for line in out.splitlines():
-                if "wall=" in line:
-                    walls.append(float(line.split("wall=")[1]
-                                       .split("ms")[0]))
+        walls = list(run_tenants(tmp_path, [("uid-a", 50), ("uid-b", 50)],
+                                 shared, iters).values())
         total = (time.monotonic() - t0) * 1000
     finally:
         stop.set()
@@ -119,6 +129,34 @@ def test_two_tenants_share_one_chip(shim_build, tmp_path):
     print(f"tenant walls: {walls} total {total:.0f}ms")
 
 
+def test_two_tenants_on_recorded_transport_pathology(shim_build, tmp_path):
+    """Hard part #2 meets the recorded regime: two 50% tenants contend
+    for the serialized chip while the transport replays the real
+    tunnel's after-idle span inflation (each tenant's observed spans are
+    inflated at its own dispatch gaps), calibrated with the recorded
+    table. Serialization and fairness must survive the pathology."""
+    import bench
+    regime = bench.read_trace_env(os.path.join(
+        REPO, "library", "test", "traces", "v5e_r2_transport.env"))
+    shared = str(tmp_path / "chip.state")
+    VmemLedger(str(tmp_path / "vmem.config"), create=True).close()
+    tc_watcher.TcUtilFile(str(tmp_path / "tc_util.config"),
+                          create=True).close()
+    with open(shared, "wb") as f:
+        f.write(b"\0" * 16)
+    extra = {
+        "FAKE_GAP_EXCESS_TABLE": regime["FAKE_GAP_EXCESS_TABLE"],
+        "VTPU_OBS_EXCESS_TABLE": regime["FAKE_GAP_EXCESS_TABLE"],
+    }
+    walls = list(run_tenants(tmp_path, [("uid-a", 50), ("uid-b", 50)],
+                             shared, iters=300, extra=extra).values())
+    # serialized busy demand alone is 2 x 600 ms of chip time
+    assert min(walls) >= 1000, walls
+    # fairness band unchanged from the clean-transport test: the
+    # replayed inflation must not break alternation
+    assert max(walls) / min(walls) < 2.0, walls
+
+
 def test_unequal_quotas_bias_the_chip(shim_build, tmp_path):
     """75% vs 25%: the high-quota tenant must finish first (same demand)."""
     shared = str(tmp_path / "chip.state")
@@ -128,19 +166,8 @@ def test_unequal_quotas_bias_the_chip(shim_build, tmp_path):
     with open(shared, "wb") as f:
         f.write(b"\0" * 16)
     iters = 300
-    procs = {}
-    for uid, quota in (("uid-hi", 75), ("uid-lo", 25)):
-        procs[uid] = subprocess.Popen(
-            [os.path.join(BUILD, "shim_test"), "--throttle-only"],
-            env=tenant_env(tmp_path, uid, quota, iters, shared),
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-    walls = {}
-    for uid, proc in procs.items():
-        out, _ = proc.communicate(timeout=300)
-        assert proc.returncode == 0, out
-        for line in out.splitlines():
-            if "wall=" in line:
-                walls[uid] = float(line.split("wall=")[1].split("ms")[0])
+    walls = run_tenants(tmp_path, [("uid-hi", 75), ("uid-lo", 25)],
+                        shared, iters)
     assert walls["uid-hi"] < walls["uid-lo"], walls
 
 class TestHbmCoTenancy:

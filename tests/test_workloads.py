@@ -239,3 +239,18 @@ class TestExpertParallel:
                                    rtol=1e-5)
         # drops actually happened: some rows are exactly zero in both
         assert (np.abs(ref).sum(axis=1) == 0).any()
+
+
+def test_pallas_bench_measure_runs_hermetically():
+    """EXECUTE the capture's pallas-vs-XLA benchmark logic (not just
+    compile it): interpret-mode pallas on CPU, tiny shapes. A logic bug
+    here would otherwise first surface on a healthy tunnel window."""
+    from vtpu_manager.workloads import pallas_attention as pa
+    from vtpu_manager.workloads import pallas_bench
+
+    if not pa.HAVE_PALLAS:
+        import pytest
+        pytest.skip("pallas unavailable")
+    out = pallas_bench.measure(b=1, h=2, s=16, d=8, inner=2, reads=1,
+                               interpret=True)
+    assert out["ms_pallas"] > 0 and out["ms_xla"] > 0
