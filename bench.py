@@ -502,6 +502,17 @@ def run_fake_sweep() -> dict[int, float] | None:
 HERMETIC_OVERHEAD_CEILING_US = 10.0
 
 
+def parse_wall_ms(stdout: str) -> float | None:
+    """Extract `wall=<N>ms` from shim_test output — the one parser for
+    every harness driver (bench replay sweep, pytest replay/co-tenancy
+    wrappers)."""
+    wall = None
+    for line in stdout.splitlines():
+        if "wall=" in line:
+            wall = float(line.split("wall=")[1].split("ms")[0])
+    return wall
+
+
 def read_trace_env(path: str) -> dict:
     """Parse a library/test/traces/*.env recorded-regime file (KEY=VALUE
     lines, # comments). One parser for bench and the replay tests."""
@@ -560,10 +571,7 @@ def run_replay_sweep() -> dict | None:
         except subprocess.TimeoutExpired:
             print(f"replay sweep q={quota} timed out", file=sys.stderr)
             return None
-        wall = None
-        for line in res.stdout.splitlines():
-            if "wall=" in line:
-                wall = float(line.split("wall=")[1].split("ms")[0])
+        wall = parse_wall_ms(res.stdout)
         if res.returncode != 0 or wall is None or wall <= 0:
             print(f"replay sweep q={quota} failed (rc={res.returncode}):"
                   f"\n{res.stdout[-300:]}\n{res.stderr[-300:]}",

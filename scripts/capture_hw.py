@@ -345,9 +345,12 @@ def main() -> int:
         "obs_excess_table_calibrated": obs_table,
         "calibration_stat": os.environ.get("VTPU_OBS_CAL_STAT", "median"),
     })
+    # carry only measured section results into the resume; the metadata
+    # keys are re-derived by persist() every write
     top: dict = {k: v for k, v in prior.items()
                  if k not in ("detail", "value", "vs_baseline", "date",
-                              "tpu_health_attempts", "sections_failed")}
+                              "tpu_health_attempts", "sections_failed",
+                              "metric", "unit", "hardware")}
 
     def persist() -> None:
         """Rewrite the output after every section: a wedge mid-capture

@@ -38,6 +38,7 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 import bench  # noqa: E402
+import capture_hw  # noqa: E402
 
 
 def capture_complete(path: str) -> bool:
@@ -56,7 +57,6 @@ def capture_complete(path: str) -> bool:
             or cap.get("mfu_pct_shim_off") is None
             or cap.get("sections_failed")):
         return False
-    import capture_hw
     return all(capture_hw.section_recorded(s, cap)
                for s in capture_hw.SECTIONS)
 

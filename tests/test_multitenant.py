@@ -43,13 +43,14 @@ def run_tenants(tmp_path, specs, shared, iters, extra=None,
         env=tenant_env(tmp_path, uid, quota, iters, shared, extra=extra),
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
         for uid, quota in specs}
+    import bench
     walls = {}
     for uid, proc in procs.items():
         out, _ = proc.communicate(timeout=300)
         assert proc.returncode == 0, out
-        for line in out.splitlines():
-            if "wall=" in line:
-                walls[uid] = float(line.split("wall=")[1].split("ms")[0])
+        wall = bench.parse_wall_ms(out)
+        if wall is not None:
+            walls[uid] = wall
     assert len(walls) == len(specs), walls
     return walls
 

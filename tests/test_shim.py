@@ -270,10 +270,8 @@ class TestShimHermetic:
                              text=True)
         assert res.returncode == 0, res.stdout + res.stderr
         assert "ALL PASS" in res.stdout
-        wall = None
-        for line in res.stdout.splitlines():
-            if "wall=" in line:
-                wall = float(line.split("wall=")[1].split("ms")[0])
+        import bench
+        wall = bench.parse_wall_ms(res.stdout)
         assert wall is not None, res.stdout
         return wall
 
