@@ -1,0 +1,151 @@
+"""BASELINE.md staged configs, one named scenario each.
+
+BASELINE.json stages the build as five configs; the first four are
+exercised throughout the suite (pointers below), and configs[4] — DRA
+claims + preemption-reschedule on a v5p-64 — gets its integrated
+scenario here: 3-D mesh-window allocation, DRA prepare/checkpoint/CDI
+on the chosen box, chip-swap vanishing, reschedule eviction, and
+re-allocation on the surviving torus.
+
+  configs[0] fake-node binpack, CPU-only  -> tests/test_scheduler.py,
+             tests/test_allocator.py (binpack/spread, NodeInfo)
+  configs[1] 1 chip 25%/4GiB JAX          -> examples/local_demo.py,
+             bench.py on hardware, tests/test_shim*.py hermetically
+  configs[2] 2x50% one chip               -> tests/test_multitenant.py
+             (incl. the recorded-transport-pathology variant)
+  configs[3] ICI topology-aware alloc     -> tests/test_allocator.py
+             mesh-window suite (2-D v5e + 2x2x2 v5p boxes)
+  configs[4] DRA + reschedule on v5p-64   -> THIS FILE
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.controller.reschedule import RescheduleController
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.device.topology.mesh import select_submesh
+from vtpu_manager.kubeletplugin.allocatable import build_resource_slice
+from vtpu_manager.kubeletplugin.device_state import DeviceState
+from vtpu_manager.util import consts
+
+
+def v5p_64_registry() -> dt.NodeDeviceRegistry:
+    """A v5p-64 slice: 4x4x4 torus, 16 hosts x 4 chips (the v5p host
+    granularity), built chip-by-chip since fake_registry is 2-D."""
+    chips = []
+    for i in range(64):
+        x, y, z = i % 4, (i // 4) % 4, i // 16
+        chips.append(dt.fake_chip(
+            i, uuid=f"TPU-V5P-{i:04d}", chip_type="tpu-v5p",
+            coords=(x, y, z), host_id=i // 4, numa=(i // 4) % 2,
+            memory=95 * 2**30))
+    return dt.NodeDeviceRegistry(chips=chips, mesh=dt.MeshSpec((4, 4, 4)))
+
+
+def box_dims(coords: set[tuple]) -> tuple[int, int, int]:
+    xs, ys, zs = ({c[i] for c in coords} for i in range(3))
+    return len(xs), len(ys), len(zs)
+
+
+def test_config4_dra_reschedule_on_v5p64(tmp_path):
+    reg = v5p_64_registry()
+
+    # --- (1) ICI placement: an 8-chip gang must get a 2x2x2 box -------
+    sel = select_submesh(reg.chips, 8, reg.mesh)
+    assert sel is not None and sel.kind == "rect"
+    coords = {c.coords for c in sel.chips}
+    assert box_dims(coords) == (2, 2, 2), coords
+    allocated = [c for c in sel.chips]
+
+    # --- (2) DRA prepare on the chosen box ----------------------------
+    state = DeviceState("node-v5p", reg.chips,
+                        base_dir=str(tmp_path / "mgr"),
+                        cdi_dir=str(tmp_path / "cdi"))
+    claim = {
+        "metadata": {"uid": "claim-v5p", "name": "gang",
+                     "namespace": "ml"},
+        "status": {"allocation": {"devices": {
+            "results": [
+                {"request": "tpu", "driver": consts.DRA_DRIVER_NAME,
+                 "pool": "node-v5p", "device": f"vtpu-{c.index}"}
+                for c in allocated],
+            "config": [],
+        }}},
+    }
+    cdi_ids = state.prepare_claim(claim)
+    assert cdi_ids
+    # every chip of the box is in the checkpointed claim
+    prepared = state.checkpoint.claims["claim-v5p"]
+    held = {d["device"] for d in prepared.devices}
+    assert held == {f"vtpu-{c.index}" for c in allocated}
+
+    # the ResourceSlice advertises the full 64-chip pool
+    slice_obj = build_resource_slice("node-v5p", reg.chips,
+                                     pool_generation=1)
+    devices = slice_obj["spec"]["devices"]
+    assert len(devices) >= 64
+
+    # --- (3) chip swap: two box chips vanish across a node restart ----
+    vanished = {allocated[0].uuid, allocated[1].uuid}
+    surviving_uuids = {c.uuid for c in reg.chips} - vanished
+
+    client = FakeKubeClient()
+    pod_claims = PodDeviceClaims()
+    for c in allocated:
+        pod_claims.add("trainer",
+                       DeviceClaim(c.uuid, c.index, 0, 16 * 2**30))
+    client.add_pod({
+        "metadata": {"name": "gang-0", "namespace": "ml",
+                     "uid": "pod-gang-0",
+                     "annotations": {
+                         consts.real_allocated_annotation():
+                             pod_claims.encode()}},
+        "spec": {"nodeName": "node-v5p"},
+        "status": {"phase": "Running"},
+    })
+    ctl = RescheduleController(client, "node-v5p",
+                               known_uuids=surviving_uuids,
+                               checkpoint_path=str(tmp_path / "no-ckpt"))
+    assert ctl.reconcile_once() == 1
+    assert ("ml", "gang-0") in client.evictions
+    assert client.events and client.events[0]["reason"] == \
+        "VtpuReschedule"
+
+    # --- (4) the evicted gang re-fits on the surviving torus ----------
+    state.unprepare_claim("claim-v5p")
+    free = [c for c in reg.chips if c.uuid in surviving_uuids]
+    sel2 = select_submesh(free, 8, reg.mesh)
+    assert sel2 is not None and sel2.kind == "rect"
+    coords2 = {c.coords for c in sel2.chips}
+    assert box_dims(coords2) == (2, 2, 2)
+    assert not ({c.uuid for c in sel2.chips} & vanished)
+
+
+def test_config4_no_eviction_while_chips_present(tmp_path):
+    """Control: the same pod is NOT evicted while every allocated chip
+    is still known — reschedule must never churn healthy gangs."""
+    reg = v5p_64_registry()
+    client = FakeKubeClient()
+    pod_claims = PodDeviceClaims()
+    for c in reg.chips[:8]:
+        pod_claims.add("trainer",
+                       DeviceClaim(c.uuid, c.index, 0, 16 * 2**30))
+    client.add_pod({
+        "metadata": {"name": "gang-0", "namespace": "ml",
+                     "uid": "pod-gang-0",
+                     "annotations": {
+                         consts.real_allocated_annotation():
+                             pod_claims.encode()}},
+        "spec": {"nodeName": "node-v5p"},
+        "status": {"phase": "Running"},
+    })
+    ctl = RescheduleController(client, "node-v5p",
+                               known_uuids={c.uuid for c in reg.chips},
+                               checkpoint_path=str(tmp_path / "no-ckpt"))
+    assert ctl.reconcile_once() == 0
+    assert not client.evictions
