@@ -293,3 +293,49 @@ class TestClaimValidateRoute:
                     body["response"]["status"]["message"]
 
         asyncio.run(scenario())
+
+
+def test_shipped_dra_examples_pass_admission():
+    """examples/dra/*.yaml (reference example/dra/ parity) must pass the
+    REAL claim validator — a shipped example that the webhook would
+    reject at admission is worse than no example."""
+    import os
+
+    import yaml
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    xdir = os.path.join(repo, "examples", "dra")
+    names = sorted(os.listdir(xdir))
+    assert names == ["pod-multi-vtpu.yaml", "pod-single-vtpu.yaml"]
+    seen_claims = 0
+    for name in names:
+        with open(os.path.join(xdir, name)) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        pod = None
+        for doc in docs:
+            if doc["kind"] in ("ResourceClaim", "ResourceClaimTemplate"):
+                result = validate_claim_object(doc)
+                assert result.allowed, (name, result.message)
+                seen_claims += 1
+            elif doc["kind"] == "Pod":
+                pod = doc
+        assert pod is not None, name
+        # every container claim reference resolves to a declared claim
+        declared = {c["name"] for c in
+                    pod["spec"].get("resourceClaims", [])}
+        for container in pod["spec"]["containers"]:
+            for ref in (container.get("resources", {})
+                        .get("claims") or []):
+                assert ref["name"] in declared, (name, ref)
+    assert seen_claims == 2
+    # the multi-request example's containers each name their request
+    with open(os.path.join(xdir, "pod-multi-vtpu.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    pod = [d for d in docs if d["kind"] == "Pod"][0]
+    tmpl = [d for d in docs
+            if d["kind"] == "ResourceClaimTemplate"][0]
+    req_names = {r["name"] for r in
+                 tmpl["spec"]["spec"]["devices"]["requests"]}
+    for container in pod["spec"]["containers"]:
+        ref = container["resources"]["claims"][0]
+        assert ref["request"] in req_names, container["name"]
