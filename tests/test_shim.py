@@ -280,11 +280,14 @@ class TestShimHermetic:
         """Replaying the recorded after-idle inflation at 10% quota with
         NO calibration: every isolated span carries the transport's
         inflation as charge, so the run paces measurably slower than
-        ideal (measured 2.6-2.7 s vs the 2.0 s ideal for 100 x 2 ms).
-        Over-throttle is the correct conservative failure mode."""
+        ideal (measured 2.6-2.7 s standalone, 2.4 s under full-suite
+        load — scheduler jitter moves the dispatch gap across the
+        recorded table's non-monotonic knee — vs the 2.0 s ideal for
+        100 x 2 ms). Over-throttle is the correct conservative failure
+        mode; the lower bound asserts a >=17% overshoot of ideal."""
         env = self._replay_env(shim_build, tmp_path, calibrated=False,
                                flush_floor=False)
-        env["SHIM_OBS_EXPECT_MS"] = "2450,3400"
+        env["SHIM_OBS_EXPECT_MS"] = "2350,3400"
         self._run_replay(shim_build, env)
 
     def test_trace_replay_calibration_restores_accuracy(self, shim_build,
