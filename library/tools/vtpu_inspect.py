@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
@@ -112,11 +113,30 @@ def main() -> int:
     parser.add_argument("--base", default=consts.MANAGER_BASE_DIR)
     parser.add_argument("--vmem", default=consts.VMEM_NODE_CONFIG)
     parser.add_argument("--tc", default=consts.TC_UTIL_CONFIG)
+    def non_negative(value: str) -> float:
+        sec = float(value)
+        if sec < 0:
+            raise argparse.ArgumentTypeError("must be >= 0")
+        return sec
+
+    parser.add_argument("--watch", type=non_negative, default=0,
+                        metavar="SEC",
+                        help="redraw every SEC seconds (the node "
+                             "operator's live view; ctrl-c to stop)")
     args = parser.parse_args()
-    dump_configs(args.base)
-    dump_ledger(args.vmem)
-    dump_watcher(args.tc)
-    return 0
+    try:
+        while True:
+            if args.watch:
+                print("\x1b[2J\x1b[H", end="")   # clear + home
+                print(time.strftime("vtpu_inspect  %H:%M:%S"))
+            dump_configs(args.base)
+            dump_ledger(args.vmem)
+            dump_watcher(args.tc)
+            if not args.watch:
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0    # ctrl-c anywhere in the redraw is a clean stop
 
 
 if __name__ == "__main__":
