@@ -745,3 +745,117 @@ class TestCrossNodeGang:
         # a Failed member's lingering annotations stop counting
         dead = dict(unbound, status={"phase": "Failed"})
         assert gang.live_siblings("burst", "uid-self", [dead]) == []
+
+
+class TestGangDialects:
+    """Reference PodHasGangName parity (pkg/util/util.go:692-716): gang
+    identity resolves from any ecosystem dialect, so Volcano /
+    coscheduling / Koordinator gangs get mesh alignment without
+    vtpu-specific markup."""
+
+    @staticmethod
+    def _pod(anns=None, labels=None, owner=None, spec=None):
+        meta = {"name": "p", "uid": "u", "annotations": anns or {},
+                "labels": labels or {}}
+        if owner:
+            meta["ownerReferences"] = owner
+        return {"metadata": meta, "spec": spec or {}}
+
+    def test_each_dialect_resolves(self):
+        from vtpu_manager.util import gangname as gn
+        cases = [
+            (self._pod(anns={consts.gang_name_annotation(): "g"}),
+             "g", gn.DIALECT_VTPU),
+            (self._pod(spec={"schedulingGroup": {"podGroupName": "n"}}),
+             "n", gn.DIALECT_NATIVE),
+            (self._pod(labels={gn.COSCHEDULING_POD_GROUP_LABEL: "c1"}),
+             "c1", gn.DIALECT_LABEL),
+            (self._pod(labels={
+                gn.COSCHEDULING_POD_GROUP_NAME_LABEL: "c2"}),
+             "c2", gn.DIALECT_LABEL),
+            (self._pod(anns={gn.KUBE_BATCH_GROUP_ANNOTATION: "kb"}),
+             "kb", gn.DIALECT_ANNOTATION),
+            (self._pod(anns={gn.VOLCANO_GROUP_ANNOTATION: "vc"}),
+             "vc", gn.DIALECT_ANNOTATION),
+            (self._pod(anns={gn.KOORDINATOR_GANG_ANNOTATION: "ko"}),
+             "ko", gn.DIALECT_ANNOTATION),
+            (self._pod(owner=[{"kind": "PodGroup", "name": "og"}]),
+             "og", gn.DIALECT_OWNER),
+            (self._pod(), "", ""),
+        ]
+        for pod, want_name, want_dialect in cases:
+            assert gn.resolve_gang_name(pod) == (want_name, want_dialect)
+
+    def test_explicit_annotation_outranks_ecosystem(self):
+        from vtpu_manager.util import gangname as gn
+        pod = self._pod(
+            anns={consts.gang_name_annotation(): "ours",
+                  gn.VOLCANO_GROUP_ANNOTATION: "theirs"},
+            labels={gn.COSCHEDULING_POD_GROUP_LABEL: "label"})
+        assert gn.resolve_gang_name(pod) == ("ours", gn.DIALECT_VTPU)
+
+    def test_volcano_gang_passes_admission_without_size(self):
+        """Ecosystem gangs carry min-member on the PodGroup object,
+        invisible at pod admission: no size -> still allowed. Our
+        explicit annotation keeps the size contract."""
+        from vtpu_manager.device.allocator.request import \
+            build_allocation_request
+        from vtpu_manager.util import gangname as gn
+        from vtpu_manager.webhook.validate import validate_pod
+
+        def mk(anns):
+            anns = dict(anns)
+            return {"metadata": {"name": "p", "uid": "u",
+                                 "annotations": anns},
+                    "spec": {"containers": [{"name": "c", "resources": {
+                        "limits": {consts.vtpu_number_resource(): "1"}
+                    }}]}}
+
+        volcano_pod = mk({gn.VOLCANO_GROUP_ANNOTATION: "vg"})
+        assert build_allocation_request(volcano_pod).gang_name == "vg"
+        assert validate_pod(volcano_pod).allowed
+        ours_no_size = mk({consts.gang_name_annotation(): "g"})
+        assert not validate_pod(ours_no_size).allowed
+
+    def test_cross_dialect_siblings_align(self):
+        """A Volcano-marked member and a vtpu-marked member of the same
+        group are gang siblings: the second adopts the first's recorded
+        mesh origin."""
+        from vtpu_manager.scheduler import gang as gang_mod
+        from vtpu_manager.util import gangname as gn
+        volcano_member = {
+            "metadata": {"name": "m0", "uid": "u0",
+                         "annotations": {
+                             gn.VOLCANO_GROUP_ANNOTATION: "ring",
+                             gang_mod.gang_origin_annotation(): "2,3",
+                             # counted member: holds a real allocation
+                             consts.real_allocated_annotation(): "x"},
+                         },
+            "spec": {"nodeName": "n1"},
+            "status": {"phase": "Running"}}
+        assert gang_mod.resolve_gang_origin("ring",
+                                            [volcano_member]) == (2, 3)
+        sibs = gang_mod.live_siblings("ring", "me", [volcano_member])
+        assert sibs == [volcano_member]
+
+    def test_same_name_different_namespace_not_siblings(self):
+        """PodGroup names are namespace-scoped: team A's gang 'train'
+        in ns-a must never pull team B's 'train' in ns-b onto its mesh
+        origin."""
+        from vtpu_manager.scheduler import gang as gang_mod
+        from vtpu_manager.util import gangname as gn
+        foreign = {
+            "metadata": {"name": "m0", "uid": "u0", "namespace": "ns-a",
+                         "annotations": {
+                             gn.VOLCANO_GROUP_ANNOTATION: "train",
+                             gang_mod.gang_origin_annotation(): "2,3",
+                             consts.real_allocated_annotation(): "x"}},
+            "spec": {"nodeName": "n1"},
+            "status": {"phase": "Running"}}
+        assert gang_mod.resolve_gang_origin(
+            "train", [foreign], namespace="ns-b") is None
+        assert gang_mod.live_siblings(
+            "train", "me", [foreign], namespace="ns-b") == []
+        # and the genuine namespace still matches
+        assert gang_mod.live_siblings(
+            "train", "me", [foreign], namespace="ns-a") == [foreign]

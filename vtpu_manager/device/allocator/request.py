@@ -63,6 +63,7 @@ class AllocationRequest:
     exclude_uuids: tuple[str, ...] = ()
 
     gang_name: str = ""
+    gang_dialect: str = ""     # which markup named the gang (gangname.py)
     gang_size: int = 0
     gang_ordinal: int = -1
 
@@ -232,7 +233,11 @@ def build_allocation_request(pod: dict) -> AllocationRequest:
     req.include_uuids = _csv(anns.get(consts.include_uuids_annotation()))
     req.exclude_uuids = _csv(anns.get(consts.exclude_uuids_annotation()))
 
-    req.gang_name = anns.get(consts.gang_name_annotation(), "")
+    # gang identity from ANY recognized dialect (reference
+    # PodHasGangName, util.go:692-716): Volcano/coscheduling/Koordinator
+    # gangs get mesh-origin alignment without vtpu-specific markup
+    from vtpu_manager.util.gangname import resolve_gang_name
+    req.gang_name, req.gang_dialect = resolve_gang_name(pod)
     if req.gang_name:
         try:
             req.gang_size = int(anns.get(consts.gang_size_annotation(), "0"))

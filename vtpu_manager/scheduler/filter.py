@@ -276,11 +276,13 @@ class FilterPredicate:
             # from this one list so a dead member cannot bias any of them.
             # Needs the FULL list: burst siblings are committed (and carry
             # the gang/predicate annotations) before they have a nodeName.
+            pod_meta = pod.get("metadata") or {}
+            gang_ns = pod_meta.get("namespace", "default")
             gang_siblings = gang.live_siblings(
-                req.gang_name, (pod.get("metadata") or {}).get("uid", ""),
-                self._list_all_pods())
-            prefer_origin = gang.resolve_gang_origin(req.gang_name,
-                                                     gang_siblings)
+                req.gang_name, pod_meta.get("uid", ""),
+                self._list_all_pods(), namespace=gang_ns)
+            prefer_origin = gang.resolve_gang_origin(
+                req.gang_name, gang_siblings, namespace=gang_ns)
             # L2 cross-node affinity: domains the gang already occupies.
             # Domain lookup is bounded to the nodes this call can see; a
             # sibling on a node outside the candidate list contributes no

@@ -37,15 +37,28 @@ def decode_origin(raw: str | None) -> tuple[int, int] | None:
         return None
 
 
-def resolve_gang_origin(gang_name: str, all_pods: list[dict]
+def _is_sibling(pod: dict, gang_name: str, namespace: str) -> bool:
+    """Same gang = same resolved name AND same namespace. Members may
+    carry the name in DIFFERENT dialects (one via Volcano markup, one
+    via ours) — resolve, don't compare raw annotations. PodGroup names
+    are namespace-scoped in every ecosystem dialect, so two tenants both
+    calling their gang 'train' must never merge."""
+    from vtpu_manager.util.gangname import resolve_gang_name
+    meta = pod.get("metadata") or {}
+    return (resolve_gang_name(pod)[0] == gang_name
+            and meta.get("namespace", "default") == namespace)
+
+
+def resolve_gang_origin(gang_name: str, all_pods: list[dict],
+                        namespace: str = "default"
                         ) -> tuple[int, int] | None:
     """Find the origin already chosen by any sibling of the gang."""
     if not gang_name:
         return None
     for pod in all_pods:
-        anns = (pod.get("metadata") or {}).get("annotations") or {}
-        if anns.get(consts.gang_name_annotation()) != gang_name:
+        if not _is_sibling(pod, gang_name, namespace):
             continue
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
         origin = decode_origin(anns.get(gang_origin_annotation()))
         if origin is not None:
             return origin
@@ -65,7 +78,8 @@ def chosen_origin(info: NodeInfo, claims) -> tuple[int, int] | None:
 
 
 def live_siblings(gang_name: str, self_uid: str,
-                  all_pods: list[dict]) -> list[dict]:
+                  all_pods: list[dict],
+                  namespace: str = "default") -> list[dict]:
     """Gang members that still COUNT: same gang annotation, not the pod
     being scheduled itself (a re-filtered committed pod must not anchor
     to its own stale pre-allocation), and alive by the same
@@ -81,8 +95,7 @@ def live_siblings(gang_name: str, self_uid: str,
         meta = pod.get("metadata") or {}
         if meta.get("uid", "") == self_uid:
             continue
-        anns = meta.get("annotations") or {}
-        if anns.get(consts.gang_name_annotation()) != gang_name:
+        if not _is_sibling(pod, gang_name, namespace):
             continue
         if not should_count_pod(pod):
             continue

@@ -1,0 +1,60 @@
+"""Gang identity across scheduler-ecosystem dialects.
+
+Reference: pkg/util/util.go:692-716 `PodHasGangName` + consts.go:29-34 —
+the reference recognizes native gang scheduling, the two coscheduling
+pod-group labels, the kube-batch/Volcano/Koordinator group annotations,
+and a PodGroup ownerReference, so gangs submitted through any of those
+schedulers get NVLink-aligned placement without extra markup. The vtpu
+edition mirrors that: mesh-origin alignment (scheduler/gang.py) keys on
+whatever gang identity the pod already carries.
+
+Priority: vtpu-manager's explicit annotation first (a direct
+instruction to THIS scheduler outranks ecosystem markup), then the
+native API, then labels, then the ecosystem annotations, then the
+PodGroup owner.
+"""
+
+from __future__ import annotations
+
+from vtpu_manager.util import consts
+
+# ecosystem dialects, in the reference's resolution order
+COSCHEDULING_POD_GROUP_LABEL = "scheduling.x-k8s.io/pod-group"
+COSCHEDULING_POD_GROUP_NAME_LABEL = "pod-group.scheduling.sigs.k8s.io/name"
+KUBE_BATCH_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+VOLCANO_GROUP_ANNOTATION = "scheduling.volcano.sh/group-name"
+KOORDINATOR_GANG_ANNOTATION = "gang.scheduling.koordinator.sh/name"
+
+DIALECT_VTPU = "vtpu-annotation"
+DIALECT_NATIVE = "native-scheduling-group"
+DIALECT_LABEL = "coscheduling-label"
+DIALECT_ANNOTATION = "group-annotation"
+DIALECT_OWNER = "podgroup-owner"
+
+
+def resolve_gang_name(pod: dict) -> tuple[str, str]:
+    """(gang_name, dialect); ("", "") when the pod carries no gang
+    identity in any recognized dialect."""
+    meta = pod.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    labels = meta.get("labels") or {}
+    spec = pod.get("spec") or {}
+
+    name = anns.get(consts.gang_name_annotation(), "")
+    if name:
+        return name, DIALECT_VTPU
+    group = (spec.get("schedulingGroup") or {}).get("podGroupName")
+    if group:
+        return str(group), DIALECT_NATIVE
+    for key in (COSCHEDULING_POD_GROUP_LABEL,
+                COSCHEDULING_POD_GROUP_NAME_LABEL):
+        if labels.get(key):
+            return labels[key], DIALECT_LABEL
+    for key in (KUBE_BATCH_GROUP_ANNOTATION, VOLCANO_GROUP_ANNOTATION,
+                KOORDINATOR_GANG_ANNOTATION):
+        if anns.get(key):
+            return anns[key], DIALECT_ANNOTATION
+    for ref in meta.get("ownerReferences") or []:
+        if ref.get("kind") == "PodGroup" and ref.get("name"):
+            return ref["name"], DIALECT_OWNER
+    return "", ""

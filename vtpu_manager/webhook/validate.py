@@ -52,9 +52,15 @@ def validate_pod(pod: dict) -> ValidateResult:
                         f"{cont.memory // 2**20}MiB implausible")
 
     if req.gang_name:
-        if req.gang_size <= 0:
+        from vtpu_manager.util.gangname import DIALECT_VTPU
+        if req.gang_size <= 0 and req.gang_dialect == DIALECT_VTPU:
+            # only OUR explicit annotation carries the size contract; a
+            # gang named through an ecosystem dialect (Volcano,
+            # coscheduling, ...) keeps its size on the PodGroup object,
+            # which admission cannot see — size 0 = unknown, alignment
+            # still keys on the name
             result.deny("gang-name set but gang-size missing/invalid")
-        if req.gang_ordinal >= max(req.gang_size, 0):
+        if req.gang_size > 0 and req.gang_ordinal >= req.gang_size:
             result.deny(f"gang-ordinal {req.gang_ordinal} >= gang-size "
                         f"{req.gang_size}")
 
