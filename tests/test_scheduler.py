@@ -859,3 +859,54 @@ class TestGangDialects:
         # and the genuine namespace still matches
         assert gang_mod.live_siblings(
             "train", "me", [foreign], namespace="ns-a") == [foreign]
+
+    def test_gang_victim_emits_disruption_warning(self):
+        """Reference preempt_predicate.go EventGangDisrupted parity:
+        evicting a gang member warns which pod group(s) the preemption
+        disrupts; gangless victims emit nothing."""
+        from vtpu_manager.util import gangname as gn
+        client, _ = occupied_cluster()
+        victim = client.get_pod("default", "victim")
+        victim["metadata"].setdefault("annotations", {})[
+            gn.VOLCANO_GROUP_ANNOTATION] = "ring-gang"
+        client.add_pod(victim)     # write the annotation back (the fake
+        # client copies on read; the predicate re-reads resident pods)
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        res = PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": [victim]}}})
+        assert not res.error
+        warnings = [e for e in client.events
+                    if e.get("reason") == "VtpuGangDisrupted"]
+        assert len(warnings) == 1
+        assert "default/ring-gang" in warnings[0]["message"]
+
+    def test_gangless_victims_emit_no_disruption_warning(self):
+        client, _ = occupied_cluster()
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        PreemptPredicate(client).preempt({
+            "Pod": preemptor,
+            "NodeNameToVictims": {"node-0": {"Pods": [
+                client.get_pod("default", "victim")]}}})
+        assert not any(e.get("reason") == "VtpuGangDisrupted"
+                       for e in client.events)
+
+    def test_gang_disruption_warning_deduped_across_retries(self):
+        """Scheduler retry loops re-run preempt every few seconds for a
+        pending preemptor; identical warnings are suppressed within the
+        dedup window."""
+        from vtpu_manager.util import gangname as gn
+        client, _ = occupied_cluster()
+        victim = client.get_pod("default", "victim")
+        victim["metadata"].setdefault("annotations", {})[
+            gn.VOLCANO_GROUP_ANNOTATION] = "ring-gang"
+        client.add_pod(victim)
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        pred = PreemptPredicate(client)
+        for _ in range(3):
+            pred.preempt({
+                "Pod": preemptor,
+                "NodeNameToVictims": {"node-0": {"Pods": [victim]}}})
+        warnings = [e for e in client.events
+                    if e.get("reason") == "VtpuGangDisrupted"]
+        assert len(warnings) == 1

@@ -24,9 +24,10 @@ PDB handling mirrors the reference two ways:
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 
-from vtpu_manager.client.kube import KubeClient
+from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device.allocator.allocator import (AllocationFailure,
                                                      allocate)
 from vtpu_manager.device.allocator.request import (RequestError,
@@ -34,6 +35,10 @@ from vtpu_manager.device.allocator.request import (RequestError,
 from vtpu_manager.device.types import NodeInfo, get_pod_device_claims
 
 log = logging.getLogger(__name__)
+
+# identical gang-disruption warnings for one pending preemptor are
+# suppressed within this window (scheduler retry cadence is seconds)
+_GANG_WARN_WINDOW_S = 300.0
 
 
 @dataclass
@@ -95,6 +100,8 @@ def _label_selector_matches(selector: dict | None, labels: dict) -> bool:
 class PreemptPredicate:
     def __init__(self, client: KubeClient):
         self.client = client
+        # (preemptor uid, group-set) -> monotonic time of last warning
+        self._gang_warned: dict[tuple, float] = {}
 
     def preempt(self, args: dict) -> PreemptResult:
         pod = args.get("Pod") or args.get("pod") or {}
@@ -129,6 +136,7 @@ class PreemptPredicate:
         result = PreemptResult()
         # one list per namespace; None = lister failed for that namespace
         pdb_cache: dict[str, list[dict] | None] = {}
+        victim_pods: list[dict] = []
         for node_name, proposal in victims_in.items():
             proposed = self._proposal_pods(node_name, proposal, meta_only)
             kept = self._validate_node(
@@ -137,9 +145,58 @@ class PreemptPredicate:
                 pdb_cache=pdb_cache)
             if kept is not None:
                 result.node_to_victims[node_name] = kept
+                victim_pods += kept.pods
         if not result.node_to_victims:
             result.error = "no node becomes schedulable by preemption"
+        else:
+            self._warn_disrupted_gangs(pod, victim_pods)
         return result
+
+    def _warn_disrupted_gangs(self, preemptor: dict,
+                              victims: list[dict]) -> None:
+        """One Warning event when candidate victims belong to gangs:
+        evicting a member strands its siblings' aligned placement and
+        likely triggers whole-group rescheduling — operators need the
+        signal (reference preempt_predicate.go EventGangDisrupted).
+        Phrased as CANDIDATES: kube-scheduler picks one of the passing
+        nodes afterwards, so gangs on the non-chosen nodes are never
+        actually touched. Best-effort and deduped per (preemptor,
+        group-set) for a window — scheduler retry loops must not flood
+        etcd with identical warnings."""
+        from vtpu_manager.util.gangname import resolve_gang_name
+        disrupted = sorted({
+            f"{(v.get('metadata') or {}).get('namespace', 'default')}"
+            f"/{name}"
+            for v in victims
+            for name, _ in (resolve_gang_name(v),) if name})
+        if not disrupted:
+            return
+        meta = preemptor.get("metadata") or {}
+        key = (meta.get("uid", ""), tuple(disrupted))
+        now = time.monotonic()
+        last = self._gang_warned.get(key, -_GANG_WARN_WINDOW_S)
+        if now - last < _GANG_WARN_WINDOW_S:
+            return
+        self._gang_warned[key] = now
+        ns = meta.get("namespace", "default")
+        try:
+            self.client.create_event(ns, {
+                "metadata": {"generateName": "vtpu-preempt-"},
+                "involvedObject": {"kind": "Pod", "namespace": ns,
+                                   "name": meta.get("name", "")},
+                "reason": "VtpuGangDisrupted",
+                "message": ("preemption candidate victims include "
+                            "members of pod group(s) "
+                            + ", ".join(disrupted)
+                            + "; evicting them strands their siblings' "
+                              "aligned placement")[:1024],
+                "type": "Warning",
+            })
+        except Exception:          # noqa: BLE001 — best-effort signal:
+            # a failed event POST (HTTP, socket, TLS) must never abort a
+            # preemption cycle whose victim set already validated
+            log.warning("gang-disruption event POST failed",
+                        exc_info=True)
 
     @staticmethod
     def _proposal_pdb_count(proposal: dict | None) -> int:
