@@ -66,6 +66,15 @@ test-perf: ## Opt-in perf matrix + sustained harness (VTPU_PERF=1)
 bench: build ## The driver benchmark (one JSON line; TPU when healthy)
 	python bench.py
 
+.PHONY: capture
+capture: build ## Full real-TPU capture matrix (resumable, MFU-first)
+	python scripts/capture_hw.py
+
+.PHONY: watch-tpu
+watch-tpu: ## Background tunnel watcher: probes health, fires the capture on recovery
+	nohup python scripts/tpu_watch.py >> tpu_watch.out 2>&1 & \
+	  echo "watcher started (log: tpu_watch.out, probes: TPU_PROBE_LOG_r*.jsonl)"
+
 ##@ Deploy
 
 .PHONY: chart
