@@ -108,37 +108,70 @@ def test_stale_feed_falls_back_to_self_estimate(shim_build, tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
     assert "watcher_self_estimate" in res.stderr, res.stderr[-2000:]
 
+def _throttle_wall(shim_build, tmp_path, envextra) -> float:
+    """One --throttle-only run; returns wall ms."""
+    env = dict(os.environ)
+    env.update({
+        "SHIM_PATH": os.path.join(shim_build, "libvtpu-control.so"),
+        "VTPU_REAL_TPU_LIBRARY_PATH":
+            os.path.join(shim_build, "libfake-pjrt.so"),
+        "VTPU_MEM_LIMIT_0": str(1 << 30),
+        "VTPU_LOCK_DIR": str(tmp_path / "locks"),
+        "VTPU_CONFIG_PATH": "/nonexistent",
+        "VTPU_TC_UTIL_PATH": "/nonexistent",
+        "VTPU_VMEM_PATH": "/nonexistent",
+        "SHIM_TEST_ITERS": "400",
+    })
+    env.update(envextra)
+    res = subprocess.run([os.path.join(shim_build, "shim_test"),
+                          "--throttle-only"], env=env, timeout=300,
+                         capture_output=True, text=True)
+    for line in res.stdout.splitlines():
+        if "wall=" in line:
+            return float(line.split("wall=")[1].split("ms")[0])
+    raise AssertionError(res.stdout + res.stderr)
+
+
 def test_balance_mode_climbs_toward_soft_limit(shim_build, tmp_path):
     """Soft (balance) mode: alone on the chip, the effective limit climbs
     from hard_core toward soft_core (reference: elastic up_limits,
     cuda_hook.c:1265-1352) — throughput must beat the fixed hard cap."""
-    def run(envextra):
-        env = dict(os.environ)
-        env.update({
-            "SHIM_PATH": os.path.join(shim_build, "libvtpu-control.so"),
-            "VTPU_REAL_TPU_LIBRARY_PATH":
-                os.path.join(shim_build, "libfake-pjrt.so"),
-            "VTPU_MEM_LIMIT_0": str(1 << 30),
-            "VTPU_LOCK_DIR": str(tmp_path / "locks"),
-            "VTPU_CONFIG_PATH": "/nonexistent",
-            "VTPU_TC_UTIL_PATH": "/nonexistent",
-            "VTPU_VMEM_PATH": "/nonexistent",
-            "SHIM_TEST_ITERS": "400",
-        })
-        env.update(envextra)
-        res = subprocess.run([os.path.join(shim_build, "shim_test"),
-                              "--throttle-only"], env=env, timeout=300,
-                             capture_output=True, text=True)
-        for line in res.stdout.splitlines():
-            if "wall=" in line:
-                return float(line.split("wall=")[1].split("ms")[0])
-        raise AssertionError(res.stdout + res.stderr)
-
-    fixed = run({"VTPU_CORE_LIMIT_0": "25"})
-    balance = run({"VTPU_CORE_LIMIT_0": "25",
-                   "VTPU_CORE_SOFT_LIMIT_0": "90"})
+    fixed = _throttle_wall(shim_build, tmp_path,
+                           {"VTPU_CORE_LIMIT_0": "25"})
+    balance = _throttle_wall(shim_build, tmp_path,
+                             {"VTPU_CORE_LIMIT_0": "25",
+                              "VTPU_CORE_SOFT_LIMIT_0": "90"})
     # 400 x 2ms busy: fixed 25% ~ 3.2s; balance should climb well past it
     assert balance < fixed * 0.8, (fixed, balance)
+
+
+def test_balance_mode_pinned_to_hard_when_cotenant_present(shim_build,
+                                                           tmp_path):
+    """The other half of the balance contract: with a LIVE co-tenant on
+    the chip (vmem-ledger evidence: alive pid, different owner token,
+    nonzero bytes), soft mode must NOT climb — the elastic ceiling
+    exists to harvest idle capacity, never to take a neighbor's
+    (reference snap-back, cuda_hook.c:1265-1352)."""
+    vmem_path = str(tmp_path / "vmem.config")
+    ledger = VmemLedger(vmem_path, create=True)
+    # this pytest process plays the co-tenant: alive, foreign token
+    ledger.record(os.getpid(), 0, 256 * 2**20,
+                  owner_token=fnv64("uid-cotenant/main"))
+    ledger.close()
+    fixed = _throttle_wall(shim_build, tmp_path,
+                           {"VTPU_CORE_LIMIT_0": "25"})
+    pinned = _throttle_wall(shim_build, tmp_path,
+                            {"VTPU_CORE_LIMIT_0": "25",
+                             "VTPU_CORE_SOFT_LIMIT_0": "90",
+                             "VTPU_VMEM_PATH": vmem_path,
+                             "VTPU_POD_UID": "uid-me",
+                             "VTPU_CONTAINER_NAME": "main"})
+    climbed = _throttle_wall(shim_build, tmp_path,
+                             {"VTPU_CORE_LIMIT_0": "25",
+                              "VTPU_CORE_SOFT_LIMIT_0": "90"})
+    # pinned must pace like the hard cap, nowhere near the climbed run
+    assert pinned > fixed * 0.8, (fixed, pinned)
+    assert pinned > climbed * 1.25, (climbed, pinned)
 
 
 def test_blind_process_enforced_via_external_feed(shim_build, tmp_path):
