@@ -309,19 +309,24 @@ def main() -> int:
             else f"BENCH_TPU_CAPTURE_r{rnd:02d}.json")
 
     # resume state: reload a previous (partial) capture at the same path
+    # prior results are ALWAYS carried: --force only re-runs sections
+    # (want() below); it must never blank a file whose measurements a
+    # previous healthy window already landed — a wedge during the forced
+    # run would otherwise destroy them
     prior: dict = {}
-    if not args.force and os.path.exists(args.out):
+    if os.path.exists(args.out):
         try:
             with open(args.out) as f:
                 prior = json.load(f)
-            log(f"resuming from {args.out}")
+            log(f"{'re-running over' if args.force else 'resuming from'} "
+                f"{args.out}")
         except (OSError, ValueError):
             prior = {}
 
     def want(section: str) -> bool:
         if only is not None and section not in only:
             return False
-        if prior and section_recorded(section, prior):
+        if not args.force and prior and section_recorded(section, prior):
             log(f"section {section}: already captured, skipping "
                 "(--force to re-run)")
             return False
@@ -345,6 +350,14 @@ def main() -> int:
         "obs_excess_table_calibrated": obs_table,
         "calibration_stat": os.environ.get("VTPU_OBS_CAL_STAT", "median"),
     })
+    # provenance across resumed runs: a re-fire hours later recalibrates,
+    # so retained sections were measured under an EARLIER table — the
+    # history records which table each invocation ran with, keeping the
+    # artifact honest about what measured what
+    history = detail.setdefault("calibration_history", [])
+    if not history or history[-1].get("table") != obs_table:
+        history.append({"table": obs_table,
+                        "date": datetime.date.today().isoformat()})
     # carry only measured section results into the resume; the metadata
     # keys are re-derived by persist() every write
     top: dict = {k: v for k, v in prior.items()

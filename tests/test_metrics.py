@@ -440,3 +440,36 @@ def test_mapping_crosscheck_no_source(tmp_path):
     # no source -> cross-check disabled, never alarmed
     assert 'vtpu_node_pod_mapping_source{node="n1"} 0.0' in text
     assert "mapping_mismatch{" not in text
+
+
+def test_mapping_crosscheck_cached_view_refetches_before_alarming(
+        tmp_path, monkeypatch):
+    """A tenant that started after the cached kubelet fetch must not
+    raise a false mismatch: the collector refetches once and re-judges
+    before alarming off a stale view."""
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0)]
+    _mk_config_dir(base, "uid-1", "main", chips[0])
+    views = [
+        pod_resources.KubeletView(source="podresources",
+                                  containers=frozenset()),      # stale
+        pod_resources.KubeletView(source="podresources",
+                                  containers=frozenset({"main"})),
+    ]
+    calls = []
+    monkeypatch.setattr(
+        pod_resources, "kubelet_view",
+        lambda *a, **k: calls.append(1) or views[min(len(calls) - 1,
+                                                     len(views) - 1)])
+    collector = NodeCollector(
+        "n1", chips, base_dir=base,
+        tc_path=str(tmp_path / "tc"), vmem_path=str(tmp_path / "vm"),
+        pod_resources_socket=str(tmp_path / "no-sock"),
+        kubelet_checkpoint=str(tmp_path / "no-ckpt"))
+    collector.render()               # fresh fetch: stale view judges...
+    # ...but the fetch was live this scrape, so the mismatch stands for
+    # THIS render (a live view missing the tenant is a real signal)
+    text = collector.render()        # cached stale view -> refetch
+    assert len(calls) == 2
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-1",container="main"} 0.0') in text

@@ -14,6 +14,8 @@ import time
 
 import pytest
 
+import bench
+
 from vtpu_manager.config import tc_watcher
 from vtpu_manager.config.vmem import VmemLedger, fnv64
 
@@ -126,10 +128,10 @@ def _throttle_wall(shim_build, tmp_path, envextra) -> float:
     res = subprocess.run([os.path.join(shim_build, "shim_test"),
                           "--throttle-only"], env=env, timeout=300,
                          capture_output=True, text=True)
-    for line in res.stdout.splitlines():
-        if "wall=" in line:
-            return float(line.split("wall=")[1].split("ms")[0])
-    raise AssertionError(res.stdout + res.stderr)
+    wall = bench.parse_wall_ms(res.stdout)
+    if wall is None:
+        raise AssertionError(res.stdout + res.stderr)
+    return wall
 
 
 def test_balance_mode_climbs_toward_soft_limit(shim_build, tmp_path):
@@ -232,10 +234,10 @@ def test_blind_process_enforced_via_external_feed(shim_build, tmp_path):
                               "--throttle-only"], env=env, timeout=300,
                              capture_output=True, text=True)
         assert res.returncode == 0, res.stdout + res.stderr
-        for line in res.stdout.splitlines():
-            if "wall=" in line:
-                return float(line.split("wall=")[1].split("ms")[0])
-        raise AssertionError(res.stdout)
+        wall = bench.parse_wall_ms(res.stdout)
+        if wall is None:
+            raise AssertionError(res.stdout)
+        return wall
 
     try:
         throttled = run(25, with_feed=True)

@@ -89,16 +89,21 @@ class NodeCollector:
         # reference lister polls on its own cadence for the same reason.
         self._kubelet_view_cache: pod_resources.KubeletView | None = None
         self._kubelet_view_ts: float = -float("inf")
+        self._kubelet_view_was_cached: bool = False
         self.kubelet_view_ttl_s = float(
             os.environ.get("VTPU_KUBELET_VIEW_TTL_S", "10"))
 
-    def _kubelet_view(self) -> pod_resources.KubeletView:
+    def _kubelet_view(self, force: bool = False
+                      ) -> pod_resources.KubeletView:
         now = time.monotonic()
-        if (self._kubelet_view_cache is None
+        if (force or self._kubelet_view_cache is None
                 or now - self._kubelet_view_ts >= self.kubelet_view_ttl_s):
             self._kubelet_view_cache = pod_resources.kubelet_view(
                 self.pod_resources_socket, self.kubelet_checkpoint)
             self._kubelet_view_ts = now
+            self._kubelet_view_was_cached = False
+        else:
+            self._kubelet_view_was_cached = True
         return self._kubelet_view_cache
 
     def _container_configs(self) -> list[
@@ -342,6 +347,12 @@ class NodeCollector:
             # report — only device-plugin tenants are judgeable
             if not is_dra and view is not None:
                 verdict = view.corroborates(pod_uid, container)
+                if verdict is False and self._kubelet_view_was_cached:
+                    # never alarm off a stale view: a tenant started
+                    # after the cached fetch would read as a mismatch
+                    # until the TTL expired — refetch once and re-judge
+                    view = self._kubelet_view(force=True)
+                    verdict = view.corroborates(pod_uid, container)
                 if verdict is not None:
                     g_map_mismatch.set(
                         (self.node_name, pod_uid, container),

@@ -27,7 +27,7 @@ import logging
 import time
 from dataclasses import dataclass, field
 
-from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.client.kube import KubeClient
 from vtpu_manager.device.allocator.allocator import (AllocationFailure,
                                                      allocate)
 from vtpu_manager.device.allocator.request import (RequestError,
@@ -177,6 +177,12 @@ class PreemptPredicate:
         last = self._gang_warned.get(key, -_GANG_WARN_WINDOW_S)
         if now - last < _GANG_WARN_WINDOW_S:
             return
+        # prune expired entries: the predicate lives for the scheduler
+        # process lifetime and preemptor uids churn — the dedup map must
+        # not grow monotonically
+        self._gang_warned = {
+            k: t for k, t in self._gang_warned.items()
+            if now - t < _GANG_WARN_WINDOW_S}
         self._gang_warned[key] = now
         ns = meta.get("namespace", "default")
         try:
