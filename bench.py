@@ -326,13 +326,23 @@ def mfu_worker_main() -> None:
     tflops + mfu_pct; quota comes from the env like every worker."""
     so = AXON_PLUGIN if os.environ.get("VTPU_BENCH_NOSHIM") == "1" else SHIM
     register_axon(so)
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
     n = int(os.environ.get("VTPU_MFU_DIM", "8192"))
     k = int(os.environ.get("VTPU_MFU_INNER", "100"))
     reads = int(os.environ.get("VTPU_MFU_READS", "3"))
+    out = mfu_measure(n=n, inner=k, reads=reads)
+    print(f"WORKER mfu tflops={out['tflops']:.2f} "
+          f"mfu_pct={out['mfu_pct']:.2f} "
+          f"wall_s={out['wall_s']:.2f} inner={k} reads={reads}")
+
+
+def mfu_measure(n: int, inner: int, reads: int) -> dict:
+    """The MFU measurement itself, importable so CI can EXECUTE it on
+    the CPU backend at tiny shapes (the same never-run-hermetically
+    trap the pallas section had): K matmuls per jitted fori_loop with a
+    donated carry, one scalar readback per block, analytic FLOPs."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
 
     from functools import partial
 
@@ -343,7 +353,7 @@ def mfu_worker_main() -> None:
             # cheap elementwise renorm keeps the carry bounded without
             # touching the matmul's MXU residency
             return (y / (1.0 + jnp.abs(y).max())).astype(x.dtype)
-        x = lax.fori_loop(0, k, body, x)
+        x = lax.fori_loop(0, inner, body, x)
         return x, jnp.float32(x[0, 0])
 
     x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
@@ -354,11 +364,10 @@ def mfu_worker_main() -> None:
         x, loss = block(x)
         _ = float(loss)
     dt = time.perf_counter() - t0
-    flops = 2.0 * (n ** 3) * k * reads
-    tflops = flops / dt / 1e12
-    mfu = 100.0 * flops / dt / V5E_PEAK_BF16_FLOPS
-    print(f"WORKER mfu tflops={tflops:.2f} mfu_pct={mfu:.2f} "
-          f"wall_s={dt:.2f} inner={k} reads={reads}")
+    flops = 2.0 * (n ** 3) * inner * reads
+    return {"tflops": flops / dt / 1e12,
+            "mfu_pct": 100.0 * flops / dt / V5E_PEAK_BF16_FLOPS,
+            "wall_s": dt}
 
 
 def _parse_mfu(res_stdout: str) -> dict | None:

@@ -436,3 +436,20 @@ def test_bench_current_round_numeric():
     # BENCH_r01..r03 are committed in the repo root -> round 4; and the
     # key must be numeric (r09 vs r10 ADVICE item)
     assert bench.current_round() >= 4
+
+
+def test_bench_mfu_measure_runs_hermetically():
+    """EXECUTE the MFU worker's measurement logic (the capture's #1
+    section) on CPU at tiny shapes: fori_loop donation, carry dtype,
+    scalar readback, and the analytic-FLOPs arithmetic all run in CI."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    out = bench.mfu_measure(n=64, inner=2, reads=1)
+    assert out["wall_s"] > 0
+    assert out["tflops"] > 0
+    expected_flops = 2.0 * 64 ** 3 * 2 * 1
+    assert out["tflops"] == pytest.approx(
+        expected_flops / out["wall_s"] / 1e12, rel=1e-6)
+    assert out["mfu_pct"] == pytest.approx(
+        100.0 * expected_flops / out["wall_s"]
+        / bench.V5E_PEAK_BF16_FLOPS, rel=1e-6)
