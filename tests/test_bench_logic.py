@@ -1,0 +1,97 @@
+"""Unit tests for bench.py's measurement methodology.
+
+The pairing/min/max selection rules encode the bench's whole defense
+against the drifting tunnel (memory: min-of-reps on latencies,
+max-of-reps on throughputs, least-stalled PAIRS for shares); they only
+ever ran on metal before. Workers are stubbed with scripted sequences
+so each rule is asserted exactly.
+"""
+
+import bench
+import pytest
+
+
+class TestPairedQuotaSweep:
+    def test_share_comes_from_least_stalled_pair(self, monkeypatch):
+        """Rep 1: clean t100, stalled tq. Rep 2: stalled t100, clean tq.
+        Rep 3: both clean — the pair with the smallest SUM must win,
+        not the best individual samples glued together."""
+        seq = {100: iter([70.0, 95.0, 72.0]),
+               50: iter([160.0, 150.0, 140.0])}
+        monkeypatch.setattr(
+            bench, "run_tpu_worker",
+            lambda quota, no_shim=False, obs_excess_table=None:
+            next(seq[quota]))
+        times, shares = bench.paired_quota_sweep((50,), None, reps=3)
+        # winning pair is rep 3 (72 + 140 = 212): share = 72/140
+        assert shares[50] == pytest.approx(100.0 * 72.0 / 140.0)
+        assert times[50] == 140.0
+        # the GLOBAL t100 min still comes from all samples (70.0): the
+        # no-shim overhead comparison mins over the same sample count
+        assert times[100] == 70.0
+
+    def test_failed_rep_skipped_not_fatal(self, monkeypatch):
+        seq = {100: iter([None, 80.0]), 25: iter([320.0, 330.0])}
+        monkeypatch.setattr(
+            bench, "run_tpu_worker",
+            lambda quota, no_shim=False, obs_excess_table=None:
+            next(seq[quota]))
+        times, shares = bench.paired_quota_sweep((25,), None, reps=2)
+        # rep 1's dead t100 kills that pair; rep 2 still lands
+        assert shares[25] == pytest.approx(100.0 * 80.0 / 330.0)
+
+    def test_all_reps_failed_yields_no_share(self, monkeypatch):
+        monkeypatch.setattr(
+            bench, "run_tpu_worker",
+            lambda quota, no_shim=False, obs_excess_table=None: None)
+        times, shares = bench.paired_quota_sweep((50,), None, reps=2)
+        assert shares == {} and times == {}
+
+
+class TestMfuCapture:
+    def test_max_per_metric_and_ratio(self, monkeypatch):
+        """Throughputs max over reps (a stall only ever subtracts);
+        the on/off ratio uses the best of EACH side."""
+        seq = {(100, True): iter([{"tflops": 100.0, "mfu_pct": 50.0},
+                                  {"tflops": 120.0, "mfu_pct": 60.0}]),
+               (100, False): iter([{"tflops": 118.0, "mfu_pct": 59.0},
+                                   {"tflops": 110.0, "mfu_pct": 55.0}]),
+               (50, False): iter([{"tflops": 60.0, "mfu_pct": 30.0},
+                                  {"tflops": 59.0, "mfu_pct": 29.5}])}
+        monkeypatch.setattr(
+            bench, "run_mfu_worker",
+            lambda quota, no_shim=False, obs_excess_table=None:
+            next(seq[(quota, no_shim)]))
+        out = bench.run_mfu_capture(None, reps=2)
+        assert out["tflops_shim_off"] == 120.0
+        assert out["tflops_shim_on"] == 118.0
+        assert out["mfu_shim_on_over_off"] == pytest.approx(
+            118.0 / 120.0, abs=1e-4)
+        assert out["mfu_pct_at_q50"] == 30.0
+        assert out["q50_delivered_share_pct"] == pytest.approx(
+            100.0 * 60.0 / 118.0, abs=0.01)
+
+    def test_missing_side_degrades_gracefully(self, monkeypatch):
+        """Shim-off side dead (e.g. the raw plugin path wedged): the
+        shim-on absolute number still publishes; ratio is absent."""
+        def worker(quota, no_shim=False, obs_excess_table=None):
+            if no_shim:
+                return None
+            return {"tflops": 118.0, "mfu_pct": 59.0}
+        monkeypatch.setattr(bench, "run_mfu_worker", worker)
+        out = bench.run_mfu_capture(None, reps=1)
+        assert out["mfu_pct_shim_on"] == 59.0
+        assert "mfu_pct_shim_off" not in out
+        assert "mfu_shim_on_over_off" not in out
+
+
+class TestParseMfu:
+    def test_parses_worker_line(self):
+        out = bench._parse_mfu(
+            "noise\nWORKER mfu tflops=118.23 mfu_pct=60.01 wall_s=8.5 "
+            "inner=100 reads=3\n")
+        assert out == {"tflops": 118.23, "mfu_pct": 60.01, "wall_s": 8.5,
+                       "inner": 100.0, "reads": 3.0}
+
+    def test_no_line_is_none(self):
+        assert bench._parse_mfu("nothing here") is None
