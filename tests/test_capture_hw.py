@@ -442,8 +442,6 @@ def test_bench_mfu_measure_runs_hermetically():
     """EXECUTE the MFU worker's measurement logic (the capture's #1
     section) on CPU at tiny shapes: fori_loop donation, carry dtype,
     scalar readback, and the analytic-FLOPs arithmetic all run in CI."""
-    import os
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = bench.mfu_measure(n=64, inner=2, reads=1)
     assert out["wall_s"] > 0
     assert out["tflops"] > 0
