@@ -95,3 +95,54 @@ class TestParseMfu:
 
     def test_no_line_is_none(self):
         assert bench._parse_mfu("nothing here") is None
+
+
+class TestCalibrationCache:
+    """The disk cache in bench.calibrate_obs_overhead saves ~6 min of
+    every healthy tunnel window; its reuse/expiry/keying rules have to
+    hold or a capture either wastes the window recalibrating or —
+    worse — silently reuses a table measured under different settings."""
+
+    @staticmethod
+    def _patch(monkeypatch, tmp_path, tables):
+        calls = []
+        monkeypatch.setattr(bench, "CAL_CACHE",
+                            str(tmp_path / "cal_cache.json"))
+
+        def fake_cal(timeout_s=400, env=None):
+            calls.append(1)
+            return tables[min(len(calls) - 1, len(tables) - 1)]
+
+        import vtpu_manager.manager.obs_calibrate as oc
+        monkeypatch.setattr(oc, "calibrate_in_subprocess", fake_cal)
+        return calls
+
+    def test_reuse_within_ttl_and_expiry(self, monkeypatch, tmp_path):
+        calls = self._patch(monkeypatch, tmp_path, ["0:0,60000:2696"])
+        assert bench.calibrate_obs_overhead() == "0:0,60000:2696"
+        assert bench.calibrate_obs_overhead() == "0:0,60000:2696"
+        assert len(calls) == 1            # second call hit the cache
+        import json as jsonlib
+        with open(bench.CAL_CACHE) as f:
+            doc = jsonlib.load(f)
+        doc["wall_ts"] -= 7200            # age the cache past the hour
+        with open(bench.CAL_CACHE, "w") as f:
+            jsonlib.dump(doc, f)
+        bench.calibrate_obs_overhead()
+        assert len(calls) == 2            # expired -> recalibrated
+
+    def test_settings_change_invalidates(self, monkeypatch, tmp_path):
+        calls = self._patch(monkeypatch, tmp_path,
+                            ["0:0,60000:2696", "0:0,60000:999"])
+        assert bench.calibrate_obs_overhead() == "0:0,60000:2696"
+        monkeypatch.setenv("VTPU_OBS_CAL_STAT", "p75")
+        # an operator switching the calibration statistic must never
+        # silently reuse a table computed under the old settings
+        assert bench.calibrate_obs_overhead() == "0:0,60000:999"
+        assert len(calls) == 2
+
+    def test_failed_calibration_not_cached(self, monkeypatch, tmp_path):
+        calls = self._patch(monkeypatch, tmp_path, [None, "0:0,60000:5"])
+        assert bench.calibrate_obs_overhead() is None
+        assert bench.calibrate_obs_overhead() == "0:0,60000:5"
+        assert len(calls) == 2            # None was not cached
