@@ -254,3 +254,27 @@ def test_pallas_bench_measure_runs_hermetically():
     out = pallas_bench.measure(b=1, h=2, s=16, d=8, inner=2, reads=1,
                                interpret=True)
     assert out["ms_pallas"] > 0 and out["ms_xla"] > 0
+
+
+def test_vtpu_busy_tool_runs_hermetically():
+    """The operator's load generator (capture section 6 drives it on
+    metal): a short CPU run must complete and print its final
+    effective-share line — a tool crash would otherwise first surface
+    inside a healthy tunnel window."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "library", "tools", "vtpu_busy.py"),
+         "--duty", "50", "--seconds", "2", "--dim", "64"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    final = [line for line in res.stdout.splitlines()
+             if line.startswith("final: effective")]
+    assert final, res.stdout
+    eff = float(final[0].split("effective", 1)[1].split("%")[0])
+    assert 0.0 < eff <= 100.0
