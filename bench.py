@@ -282,36 +282,42 @@ def worker_main() -> None:
     for the overhead metric)."""
     so = AXON_PLUGIN if os.environ.get("VTPU_BENCH_NOSHIM") == "1" else SHIM
     register_axon(so)
-    import jax
-    import jax.numpy as jnp
-
-    # Compact matmul-dominated step (MXU-bound bf16), chosen over the full
-    # trainer because remote-compile transports make large fwd+bwd graphs
-    # too slow to compile inside the bench budget; quota tracking is a
-    # duty-cycle property, not a model property. A scalar "loss" readback
-    # per step makes it a sync train loop.
-    @jax.jit
-    def step(x):
-        y = jnp.tanh(x @ x) * 1e-3
-        y = y / (1.0 + jnp.abs(y).max())
-        return y, jnp.float32(y[0, 0])
-
-    x = jax.random.normal(jax.random.PRNGKey(0), (8192, 8192), jnp.bfloat16)
     # Warmup must cover controller convergence, not just compile: the
     # grant controllers (delta/AIMD) start from a cold grant and need a few
     # hundred ms of windows to settle at the quota; timing them mid-ramp
     # under- or over-states the converged share by 2x run-to-run.
     warmup = int(os.environ.get("VTPU_BENCH_WARMUP", "10"))
     n = int(os.environ.get("VTPU_BENCH_STEPS", "30"))
+    ms = quota_step_measure(dim=8192, warmup=warmup, steps=n)
+    print(f"WORKER ms_per_step={ms:.3f}")
+
+
+def quota_step_measure(dim: int, warmup: int, steps: int) -> float:
+    """The quota worker's sync train loop, importable so CI executes it
+    on CPU at tiny shapes. Compact matmul-dominated step (MXU-bound
+    bf16), chosen over the full trainer because remote-compile
+    transports make large fwd+bwd graphs too slow to compile inside the
+    bench budget; quota tracking is a duty-cycle property, not a model
+    property. A scalar "loss" readback per step makes it a sync train
+    loop. Returns ms/step over the timed section."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        y = jnp.tanh(x @ x) * 1e-3
+        y = y / (1.0 + jnp.abs(y).max())
+        return y, jnp.float32(y[0, 0])
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (dim, dim), jnp.bfloat16)
     for _ in range(warmup):
         x, loss = step(x)
         _ = float(loss)
     t0 = time.perf_counter()
-    for _ in range(n):
+    for _ in range(steps):
         x, loss = step(x)
         _ = float(loss)
-    dt = time.perf_counter() - t0
-    print(f"WORKER ms_per_step={1000 * dt / n:.3f}")
+    return 1000 * (time.perf_counter() - t0) / steps
 
 
 def mfu_worker_main() -> None:

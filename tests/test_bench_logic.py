@@ -146,3 +146,11 @@ class TestCalibrationCache:
         assert bench.calibrate_obs_overhead() is None
         assert bench.calibrate_obs_overhead() == "0:0,60000:5"
         assert len(calls) == 2            # None was not cached
+
+
+def test_quota_step_measure_runs_hermetically():
+    """Execute the quota worker's sync loop on CPU at a tiny shape: the
+    jitted step's carry dtype, the scalar readback sync, and the
+    per-step timing all run in CI (same pattern as mfu_measure)."""
+    ms = bench.quota_step_measure(dim=64, warmup=1, steps=3)
+    assert ms > 0
