@@ -154,3 +154,70 @@ def test_quota_step_measure_runs_hermetically():
     per-step timing all run in CI (same pattern as mfu_measure)."""
     ms = bench.quota_step_measure(dim=64, warmup=1, steps=3)
     assert ms > 0
+
+
+class TestBenchMainHermeticPath:
+    """bench.main()'s branching: the hermetic fallback must clear
+    TPU-only fields, label itself, and point at the newest COMPLETE
+    committed capture — the last untested orchestration layer."""
+
+    def _run(self, monkeypatch, tmp_path, captures=(), overhead_us=3.0):
+        import json as jsonlib
+        monkeypatch.setattr(bench, "ensure_shim", lambda: True)
+        monkeypatch.setattr(bench, "tpu_available", lambda: True)
+        monkeypatch.setattr(bench, "tpu_healthy_with_retries",
+                            lambda *a, **k: (False, 2))
+        monkeypatch.setattr(bench, "run_fake_sweep",
+                            lambda: {100: 2.0, 50: 4.0, 25: 8.2})
+        monkeypatch.setattr(bench, "run_replay_sweep",
+                            lambda: {"replay_mae_pct": 1.2,
+                                     "replay_regime": "test"})
+        monkeypatch.setattr(bench, "run_hermetic_overhead",
+                            lambda: overhead_us)
+        monkeypatch.setattr(bench, "previous_round_overhead",
+                            lambda: 6.0)
+        monkeypatch.setattr(bench, "REPO", str(tmp_path))
+        for name, doc in captures:
+            with open(tmp_path / name, "w") as f:
+                jsonlib.dump(doc, f)
+        monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+        import io
+        import contextlib
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = bench.main()
+        assert rc == 0
+        return jsonlib.loads(out.getvalue().strip().splitlines()[-1])
+
+    def test_hermetic_line_shape(self, monkeypatch, tmp_path):
+        line = self._run(monkeypatch, tmp_path)
+        assert line["hermetic"] is True
+        assert line["tpu_health_attempts"] == 2
+        assert line["replay_mae_pct"] == 1.2
+        assert line["shim_overhead_us_per_exec_hermetic"] == 3.0
+        # MAE from the fake sweep: shares 50.0 and 24.39 -> errs 0, 0.61
+        assert line["value"] == pytest.approx(0.3, abs=0.05)
+        # nothing TPU-measured may ride along on a hermetic line
+        assert "shim_overhead_pct" not in line
+        assert "mfu_pct_shim_on" not in line
+
+    def test_newest_complete_capture_wins(self, monkeypatch, tmp_path):
+        line = self._run(monkeypatch, tmp_path, captures=[
+            ("BENCH_TPU_CAPTURE_r02.json",
+             {"value": 2.01, "vs_baseline": 0.717, "date": "d2"}),
+            ("BENCH_TPU_CAPTURE_r04.json",
+             {"value": 1.5, "vs_baseline": 0.536, "date": "d4"}),
+            # partials and value-less files must never shadow
+            ("BENCH_TPU_CAPTURE_r05_partial.json",
+             {"value": 0.1, "date": "d5p"}),
+            ("BENCH_TPU_CAPTURE_r06.json", {"value": None}),
+        ])
+        cap = line["real_tpu_capture"]
+        assert cap["file"] == "BENCH_TPU_CAPTURE_r04.json"
+        assert cap["value"] == 1.5
+
+    def test_overhead_bound_flag(self, monkeypatch, tmp_path):
+        line = self._run(monkeypatch, tmp_path, overhead_us=3.0)
+        assert "overhead_bound_exceeded" not in line
+        line = self._run(monkeypatch, tmp_path, overhead_us=14.0)
+        assert line["overhead_bound_exceeded"] is True
