@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Summarize a BENCH_TPU_CAPTURE file for the docs.
+
+When a capture lands (the watcher fires it on tunnel recovery), this
+prints the headline numbers in the shapes the docs use —
+controller_accuracy.md's regime table row, parity_map.md's perf
+paragraph figures, and the README pointer — so folding real numbers in
+is a read-and-paste, not an archaeology session.
+
+Usage: python scripts/capture_report.py [BENCH_TPU_CAPTURE_rNN.json]
+       (default: the newest complete capture, bench.py's own rule)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def newest_complete() -> str | None:
+    for _, path in bench.rounds_by_number(
+            "BENCH_TPU_CAPTURE_r*.json",
+            r"^BENCH_TPU_CAPTURE_r(\d+)\.json$"):
+        try:
+            with open(path) as f:
+                if json.load(f).get("value") is not None:
+                    return path
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else newest_complete()
+    if not path or not os.path.exists(path):
+        print("no complete capture found", file=sys.stderr)
+        return 1
+    with open(path) as f:
+        cap = json.load(f)
+    detail = cap.get("detail", {})
+    name = os.path.basename(path)
+    print(f"== {name} ({cap.get('date')}; "
+          f"health attempts {cap.get('tpu_health_attempts')})")
+    if cap.get("sections_failed"):
+        print(f"  INCOMPLETE — sections still missing: "
+              f"{cap['sections_failed']}")
+
+    if cap.get("value") is not None:
+        points = ", ".join(
+            f"{p['achieved_share_pct']}%@{p['quota_pct']}%"
+            for p in detail.get("quota_points", []))
+        print(f"  quota MAE {cap['value']}% "
+              f"(vs_baseline {cap.get('vs_baseline')}; AIMD band 2.2-2.8)"
+              f"\n    points: {points}")
+    if cap.get("mfu_pct_shim_on") is not None:
+        print(f"  MFU shim-on {cap['mfu_pct_shim_on']}% "
+              f"({cap.get('tflops_shim_on')} TFLOP/s), "
+              f"shim-off {cap.get('mfu_pct_shim_off')}% "
+              f"({cap.get('tflops_shim_off')} TFLOP/s), "
+              f"on/off {cap.get('mfu_shim_on_over_off')}"
+              + (" [>= 0.98 target met]"
+                 if (cap.get("mfu_shim_on_over_off") or 0) >= 0.98
+                 else " [BELOW the 0.98 target]"))
+    if cap.get("q50_delivered_share_pct") is not None:
+        print(f"  MFU@q50 {cap.get('mfu_pct_at_q50')}% -> delivered "
+              f"share {cap['q50_delivered_share_pct']}%")
+    if cap.get("shim_overhead_pct") is not None:
+        print(f"  shim overhead {cap['shim_overhead_pct']:+}% "
+              f"({cap.get('ms_per_step_shim')} vs "
+              f"{cap.get('ms_per_step_noshim')} ms/step)")
+    if "hbm_cap" in detail:
+        print(f"  HBM cap: {detail['hbm_cap']}")
+    if "balance_mode" in detail:
+        b = detail["balance_mode"]
+        print(f"  balance climb: {b.get('early_ms_per_step')} -> "
+              f"{b.get('late_ms_per_step')} ms/step "
+              f"(climbed={b.get('climbed')})")
+    if "vtpu_busy_convergence" in detail:
+        v = detail["vtpu_busy_convergence"]
+        print(f"  vtpu_busy duty={v.get('duty_pct')} under "
+              f"{v.get('quota_pct')}% -> effective "
+              f"{v.get('effective_pct')}% (in_band={v.get('in_band')})")
+    if "host_offload" in detail:
+        print(f"  host offload: {detail['host_offload'].get('status')}")
+    if "pallas_attention" in detail:
+        p = detail["pallas_attention"]
+        print(f"  pallas attention {p.get('ms_pallas')} ms vs XLA "
+              f"{p.get('ms_xla')} ms (ratio {p.get('pallas_over_xla')}; "
+              f"{p.get('shape')})")
+    cal = detail.get("calibration_history")
+    if cal:
+        print(f"  calibration table(s): "
+              + "; ".join(f"{c['table']} ({c['date']})" for c in cal))
+    print("\n  fold into: docs/controller_accuracy.md (regime table), "
+          "docs/parity_map.md (perf paragraph), README BASELINE bullet")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

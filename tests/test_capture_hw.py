@@ -451,3 +451,46 @@ def test_bench_mfu_measure_runs_hermetically():
     assert out["mfu_pct"] == pytest.approx(
         100.0 * expected_flops / out["wall_s"]
         / bench.V5E_PEAK_BF16_FLOPS, rel=1e-6)
+
+
+def test_capture_report_renders_complete_capture(tmp_path, monkeypatch,
+                                                 capsys):
+    """The report script digests a full capture (every section) without
+    crashing and surfaces the headline verdicts."""
+    import capture_report
+    cap = {
+        "value": 1.4, "vs_baseline": 0.5, "date": "2026-07-30",
+        "tpu_health_attempts": 1,
+        "mfu_pct_shim_on": 59.0, "mfu_pct_shim_off": 60.0,
+        "tflops_shim_on": 116.2, "tflops_shim_off": 118.2,
+        "mfu_shim_on_over_off": 0.983,
+        "mfu_pct_at_q50": 29.5, "q50_delivered_share_pct": 50.0,
+        "shim_overhead_pct": 1.2, "ms_per_step_shim": 71.0,
+        "ms_per_step_noshim": 70.2,
+        "detail": {
+            "quota_points": [{"quota_pct": 50, "ms_per_step": 140.0,
+                              "achieved_share_pct": 50.5,
+                              "err_pct": 0.5}],
+            "hbm_cap": "exact",
+            "balance_mode": {"early_ms_per_step": 280,
+                             "late_ms_per_step": 80, "climbed": True},
+            "vtpu_busy_convergence": {"duty_pct": 100, "quota_pct": 50,
+                                      "effective_pct": 51.0,
+                                      "in_band": True},
+            "host_offload": {"status": "ok"},
+            "pallas_attention": {"ms_pallas": 1.0, "ms_xla": 1.2,
+                                 "pallas_over_xla": 0.833,
+                                 "shape": "tiny"},
+            "calibration_history": [{"table": "0:0", "date": "d"}],
+        },
+    }
+    path = tmp_path / "BENCH_TPU_CAPTURE_r09.json"
+    with open(path, "w") as f:
+        json.dump(cap, f)
+    monkeypatch.setattr(sys, "argv", ["capture_report.py", str(path)])
+    assert capture_report.main() == 0
+    out = capsys.readouterr().out
+    assert "quota MAE 1.4%" in out
+    assert "[>= 0.98 target met]" in out
+    assert "pallas attention 1.0 ms" in out
+    assert "balance climb: 280 -> 80" in out
