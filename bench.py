@@ -116,21 +116,68 @@ def register_axon(so_path: str | None = None) -> None:
                  "PALLAS_AXON_REMOTE_COMPILE", "1") == "1")
 
 
+def tpu_probe(timeout_s: int = 120, stage1_timeout_s: int | None = None
+              ) -> dict:
+    """Staged health probe (VERDICT r4 #6). A wedged tunnel hangs inside
+    backend init, so every all-in-one probe burned its full 120 s budget
+    (all 54 r4 probes: probe_s 120.1 — ~17% of the round's wall clock in
+    dead probes). Stage 1 runs only backend init + device enumeration
+    under a short budget; the expensive compiled-program stage runs only
+    if enumeration succeeds. Returns {"healthy", "stage", "stage1_s",
+    "stage2_s"} — "stage" names the stage that decided the verdict, so
+    the probe log distinguishes wedged-at-init from wedged-at-execute.
+
+    Stage-1 budget is tunable via VTPU_PROBE_STAGE1_TIMEOUT_S (default
+    30 s — healthy-tunnel enumeration takes ~2-5 s; compile is what
+    costs 20-40 s, and that is stage 2's job). Set it >= timeout_s to
+    degenerate to the old single-stage behavior. Callers that cannot
+    afford a false wedge verdict (the watcher) should periodically pass
+    stage1_timeout_s=timeout_s as a full-budget fallback, in case a
+    healthy tunnel's init ever runs slower than the cheap budget."""
+    if stage1_timeout_s is None:
+        try:
+            stage1_timeout_s = int(os.environ.get(
+                "VTPU_PROBE_STAGE1_TIMEOUT_S", 30))
+        except ValueError:
+            # a malformed knob must not kill a round-long watcher
+            print("ignoring malformed VTPU_PROBE_STAGE1_TIMEOUT_S="
+                  f"{os.environ['VTPU_PROBE_STAGE1_TIMEOUT_S']!r}",
+                  file=sys.stderr)
+            stage1_timeout_s = 30
+    env = dict(os.environ)
+    out = {"healthy": False, "stage": 1, "stage1_s": 0.0, "stage2_s": 0.0}
+
+    def run_stage(code: str, budget_s: float) -> bool:
+        try:
+            res = subprocess.run([sys.executable, "-c", code], env=env,
+                                 capture_output=True, text=True,
+                                 timeout=budget_s)
+            return "OK" in res.stdout
+        except subprocess.TimeoutExpired:
+            return False
+
+    t0 = time.time()
+    stage1_ok = run_stage("import jax; print('OK', len(jax.devices()))",
+                          min(stage1_timeout_s, timeout_s))
+    out["stage1_s"] = round(time.time() - t0, 1)
+    if not stage1_ok:
+        return out
+    out["stage"] = 2
+    t0 = time.time()
+    out["healthy"] = run_stage(
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((256, 256));"
+        "print('OK', float((x @ x).sum()))",
+        max(1.0, timeout_s - out["stage1_s"]))
+    out["stage2_s"] = round(time.time() - t0, 1)
+    return out
+
+
 def tpu_healthy(timeout_s: int = 120) -> bool:
     """Gate the TPU sweep on a trivial program finishing promptly — the
     tunnel transport can wedge independent of this framework, and three
     full worker timeouts would blow the bench budget."""
-    code = ("import jax, jax.numpy as jnp;"
-            "x = jnp.ones((256, 256));"
-            "print('OK', float((x @ x).sum()))")
-    env = dict(os.environ)
-    try:
-        res = subprocess.run([sys.executable, "-c", code], env=env,
-                             capture_output=True, text=True,
-                             timeout=timeout_s)
-        return "OK" in res.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    return tpu_probe(timeout_s)["healthy"]
 
 
 def tpu_healthy_with_retries(attempts: int = 4, spacing_s: float = 90.0

@@ -5,10 +5,12 @@ The axon tunnel wedges and recovers on its own, hours-long timescale;
 probing only at round end has now cost two consecutive rounds their
 hardware capture. This watcher runs for the WHOLE round:
 
-  - probes `bench.tpu_healthy()` every --interval seconds (default 600),
+  - probes `bench.tpu_probe()` every --interval seconds (default 600),
     appending every probe to TPU_PROBE_LOG_r{N}.jsonl — a committed,
     timestamped record proving continuous coverage of the round even if
-    the tunnel never recovers;
+    the tunnel never recovers; the probe is staged (VERDICT r4 #6) so a
+    wedged tunnel costs ~20 s per probe, not 120 s, permitting a tighter
+    cadence;
   - on the FIRST healthy probe, fires `scripts/capture_hw.py` (sections
     in priority order, partial JSON persisted after each section) to
     land BENCH_TPU_CAPTURE_r{N}.json;
@@ -96,8 +98,17 @@ def main() -> int:
             return 0
         probe_n += 1
         t0 = time.time()
-        healthy = bench.tpu_healthy()
+        # every 6th probe runs single-stage at the full budget: if a
+        # healthy tunnel's backend init ever runs slower than stage 1's
+        # cheap budget, the staged probe alone would misread it as
+        # wedged for the whole round — the scenario the watcher exists
+        # to prevent. At the default cadence this bounds the false-wedge
+        # blind spot to ~30 min for ~5% extra wall.
+        full = probe_n % 6 == 0
+        probe = bench.tpu_probe(stage1_timeout_s=120 if full else None)
+        healthy = probe["healthy"]
         record({"event": "probe", "n": probe_n, "healthy": healthy,
+                "stage": probe["stage"], "full_budget": full,
                 "probe_s": round(time.time() - t0, 1)})
         if healthy:
             record({"event": "capture_start", "out":

@@ -221,3 +221,88 @@ class TestBenchMainHermeticPath:
         assert "overhead_bound_exceeded" not in line
         line = self._run(monkeypatch, tmp_path, overhead_us=14.0)
         assert line["overhead_bound_exceeded"] is True
+
+
+class TestStagedProbe:
+    """VERDICT r4 #6: all 54 r4 probes burned the full 120 s on a tunnel
+    wedged at backend init. The staged probe must settle a wedge at the
+    cheap enumeration stage and only spend the program budget when
+    enumeration succeeds."""
+
+    @staticmethod
+    def _patch_runs(monkeypatch, outcomes):
+        """outcomes: list of 'ok' | 'fail' | 'hang' consumed per
+        subprocess launch; 'hang' raises TimeoutExpired."""
+        import subprocess as sp
+        calls = []
+
+        def fake_run(cmd, env=None, capture_output=True, text=True,
+                     timeout=None):
+            kind = outcomes[min(len(calls), len(outcomes) - 1)]
+            calls.append({"code": cmd[-1], "timeout": timeout})
+            if kind == "hang":
+                raise sp.TimeoutExpired(cmd, timeout)
+
+            class R:
+                stdout = "OK 1\n" if kind == "ok" else "boom\n"
+            return R()
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        return calls
+
+    def test_wedge_settles_at_stage1(self, monkeypatch):
+        calls = self._patch_runs(monkeypatch, ["hang"])
+        probe = bench.tpu_probe(timeout_s=120)
+        assert probe["healthy"] is False and probe["stage"] == 1
+        assert len(calls) == 1  # the expensive stage never launched
+        assert calls[0]["timeout"] == 30  # default cheap budget
+        assert "devices" in calls[0]["code"]
+
+    def test_healthy_runs_both_stages(self, monkeypatch):
+        # stepping clock: each time.time() call advances 5 s, so stage 1
+        # visibly consumes budget and a regression to a fresh 120 s for
+        # stage 2 is distinguishable from the correct remaining budget
+        clock = iter(range(0, 1000, 5))
+        monkeypatch.setattr(bench.time, "time", lambda: float(next(clock)))
+        calls = self._patch_runs(monkeypatch, ["ok", "ok"])
+        probe = bench.tpu_probe(timeout_s=120)
+        assert probe["healthy"] is True and probe["stage"] == 2
+        assert len(calls) == 2
+        # stage 1 burned 5 s on the stepping clock; stage 2 gets the
+        # remainder, not a fresh 120 s on top
+        assert calls[1]["timeout"] == 115.0
+
+    def test_stage2_wedge_reported_as_stage2(self, monkeypatch):
+        calls = self._patch_runs(monkeypatch, ["ok", "hang"])
+        probe = bench.tpu_probe(timeout_s=120)
+        assert probe["healthy"] is False and probe["stage"] == 2
+        assert len(calls) == 2
+
+    def test_stage1_budget_env_override(self, monkeypatch):
+        monkeypatch.setenv("VTPU_PROBE_STAGE1_TIMEOUT_S", "7")
+        calls = self._patch_runs(monkeypatch, ["hang"])
+        bench.tpu_probe(timeout_s=120)
+        assert calls[0]["timeout"] == 7
+
+    def test_malformed_stage1_env_falls_back(self, monkeypatch):
+        """A bad knob value must degrade to the default, never raise —
+        an unguarded ValueError here kills the round-long watcher."""
+        monkeypatch.setenv("VTPU_PROBE_STAGE1_TIMEOUT_S", "20s")
+        calls = self._patch_runs(monkeypatch, ["hang"])
+        probe = bench.tpu_probe(timeout_s=120)
+        assert probe["healthy"] is False
+        assert calls[0]["timeout"] == 30
+
+    def test_stage1_budget_clamped_to_total(self, monkeypatch):
+        """stage1 >= timeout_s degenerates to single-stage behavior
+        without ever exceeding the caller's total budget."""
+        monkeypatch.setenv("VTPU_PROBE_STAGE1_TIMEOUT_S", "500")
+        calls = self._patch_runs(monkeypatch, ["hang"])
+        bench.tpu_probe(timeout_s=120)
+        assert calls[0]["timeout"] == 120
+
+    def test_tpu_healthy_wraps_probe(self, monkeypatch):
+        self._patch_runs(monkeypatch, ["ok", "ok"])
+        assert bench.tpu_healthy() is True
+        self._patch_runs(monkeypatch, ["hang"])
+        assert bench.tpu_healthy() is False

@@ -298,8 +298,10 @@ class TestWatcherLoop:
         monkeypatch.chdir(tmp_path)
         monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
         seq = iter(healthy_seq)
-        monkeypatch.setattr(bench, "tpu_healthy",
-                            lambda *a, **k: next(seq))
+        monkeypatch.setattr(
+            bench, "tpu_probe",
+            lambda *a, **k: {"healthy": next(seq), "stage": 1,
+                             "stage1_s": 0.0, "stage2_s": 0.0})
         calls = []
 
         def fake_run(argv, **kw):
@@ -378,7 +380,10 @@ class TestWatcherLoop:
 
         monkeypatch.chdir(tmp_path)
         monkeypatch.setattr(tpu_watch, "REPO", str(tmp_path))
-        monkeypatch.setattr(bench, "tpu_healthy", lambda *a, **k: True)
+        monkeypatch.setattr(
+            bench, "tpu_probe",
+            lambda *a, **k: {"healthy": True, "stage": 2,
+                             "stage1_s": 0.0, "stage2_s": 0.0})
         monkeypatch.setattr(tpu_watch.subprocess, "run", fake_run)
         monkeypatch.setattr(sys, "argv",
                             ["tpu_watch.py", "--round", "7", "--once"])
