@@ -588,13 +588,96 @@ def read_trace_env(path: str) -> dict:
     return out
 
 
+def learn_replay_table(regime: dict, *, exec_us: int = 2000,
+                       b2b_samples: int = 8, gap_samples: int = 7
+                       ) -> str | None:
+    """Close the calibration LEARNING loop against the replayed recorded
+    regime (VERDICT r4 #2): run manager/obs_calibrate's actual
+    measurement path — paced medians over a min back-to-back floor —
+    with run_once driving `shim_test --cal-server` against the FAKE
+    plugin directly (SHIM_PATH = the fake .so: the node daemon's
+    shim-less view of the transport, exactly how the daemon calibrates
+    on metal). The regime's FAKE_GAP_EXCESS_TABLE is ground truth by
+    construction, so the learned table must match it up to host pacing
+    overhead (~0.3 ms wake latency on this box — cost a real tenant
+    also pays, so the honest measurement); callers then apply the
+    LEARNED table, never the recorded one. Returns the encoded learned
+    table, or None when the harness is missing or the server dies
+    (measure_excess_table maps any transport failure to None).
+    ~6 s: 36 sync steps at ~65 ms (2 ms exec + 63 ms flush floor)."""
+    from vtpu_manager.manager import obs_calibrate
+    test_bin = os.path.join(BUILD, "shim_test")
+    fake = os.path.join(BUILD, "libfake-pjrt.so")
+    if not (os.path.exists(test_bin) and os.path.exists(fake)):
+        return None
+    env = dict(os.environ)
+    env.update({
+        "SHIM_PATH": fake,
+        "FAKE_EXEC_US": str(exec_us),
+        "FAKE_GAP_EXCESS_TABLE": regime.get("FAKE_GAP_EXCESS_TABLE", ""),
+        "FAKE_FLUSH_FLOOR_US": regime.get("FAKE_FLUSH_FLOOR_US", "0"),
+    })
+    import select
+    proc = subprocess.Popen([test_bin, "--cal-server"], env=env,
+                            stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE, text=True)
+
+    def read_line(budget_s: float = 30.0) -> str:
+        # select on the raw fd is safe here because the protocol is
+        # strictly one request -> one response line (nothing ever sits
+        # in the Python-side buffer across calls); a wedged server must
+        # surface as the documented learning-failed fallback, never as
+        # an unbounded readline hang in bench/pytest
+        ready, _, _ = select.select([proc.stdout], [], [], budget_s)
+        if not ready:
+            raise RuntimeError("cal server timed out")
+        return proc.stdout.readline().strip()
+
+    encoded = None
+    try:
+        if read_line() == "ready":
+
+            def run_once() -> None:
+                proc.stdin.write("run\n")
+                proc.stdin.flush()
+                if read_line() != "done":
+                    raise RuntimeError("cal server died mid-step")
+
+            table = obs_calibrate.measure_excess_table(
+                run_once=run_once, b2b_samples=b2b_samples,
+                gap_samples=gap_samples)
+            if table:
+                encoded = obs_calibrate.encode_table(table)
+    except RuntimeError:
+        pass                             # fall through to rc handling
+    finally:
+        try:
+            proc.stdin.write("quit\n")
+            proc.stdin.flush()
+        except OSError:
+            pass
+        try:
+            rc = proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            rc = -1
+    # a server that logged CHECK failures exits nonzero: its spans came
+    # from a broken transport, so the table is garbage, not "learned"
+    return encoded if rc == 0 else None
+
+
 def run_replay_sweep() -> dict | None:
     """Quota tracking against the RECORDED v5e transport pathology
     (library/test/traces/v5e_r2_transport.env replayed by the fake
     plugin: gap-indexed after-idle inflation + 63 ms flush floor),
-    calibrated with the recorded table — the hermetic number that is
-    grounded in hardware behavior rather than a clean fake transport.
-    ~24 s (three wall-equalized ~8 s points at 50/25/10%)."""
+    calibrated with a table the calibrator LEARNED from the replayed
+    transport itself (VERDICT r4 #2) — the hermetic number that is
+    grounded in hardware behavior rather than a clean fake transport,
+    and validates measurement + application end-to-end. Falls back to
+    the recorded table (application-only validation, labeled as such)
+    if learning fails. ~30 s (≈6 s learning + three wall-equalized
+    ~8 s points at 50/25/10%)."""
     test_bin = os.path.join(BUILD, "shim_test")
     fake = os.path.join(BUILD, "libfake-pjrt.so")
     trace = os.path.join(REPO, "library", "test", "traces",
@@ -605,6 +688,11 @@ def run_replay_sweep() -> dict | None:
               file=sys.stderr)
         return None
     regime = read_trace_env(trace)
+    learned = learn_replay_table(regime)
+    if learned is None:
+        print("replay calibration learning failed; falling back to the "
+              "recorded table (application-only validation)",
+              file=sys.stderr)
     exec_us = 70000           # the recorded ~70 ms flagship step
     errs = []
     for quota, iters in ((50, 60), (25, 30), (10, 12)):
@@ -621,8 +709,8 @@ def run_replay_sweep() -> dict | None:
             "FAKE_GAP_EXCESS_TABLE": regime.get("FAKE_GAP_EXCESS_TABLE",
                                                 ""),
             "FAKE_FLUSH_FLOOR_US": regime.get("FAKE_FLUSH_FLOOR_US", "0"),
-            "VTPU_OBS_EXCESS_TABLE": regime.get("FAKE_GAP_EXCESS_TABLE",
-                                                ""),
+            "VTPU_OBS_EXCESS_TABLE": learned if learned is not None
+            else regime.get("FAKE_GAP_EXCESS_TABLE", ""),
             "SHIM_OBS_ITERS": str(iters),
             "SHIM_OBS_EXPECT_MS": "1,999999",
         })
@@ -642,9 +730,14 @@ def run_replay_sweep() -> dict | None:
         share = 100.0 * iters * (exec_us / 1000.0) / wall
         errs.append(abs(share - quota))
     mae = sum(errs) / len(errs)
-    return {"replay_mae_pct": round(mae, 2),
-            "replay_regime": "v5e_r2_transport (recorded gap inflation "
-                             "+ 63 ms flush floor), quotas 50/25/10"}
+    out = {"replay_mae_pct": round(mae, 2),
+           "replay_regime": "v5e_r2_transport (recorded gap inflation "
+                            "+ 63 ms flush floor), quotas 50/25/10",
+           "replay_calibration": "learned" if learned is not None
+                                 else "recorded"}
+    if learned is not None:
+        out["replay_learned_table"] = learned
+    return out
 
 
 def run_hermetic_overhead() -> float | None:

@@ -247,6 +247,24 @@ int64_t NowMonoUs() {
 // has its own tunnel session on the real transport)
 std::atomic<int64_t> g_last_exec_end_us{0};
 
+// last instant the HOST could have observed a completion (an event
+// actually firing). The recorded gap-excess tables were measured by a
+// host-paced loop — sleep(gap) starts when the host observes the
+// previous step, floor included — so faithful replay must index the
+// table by the host-relative gap: under the 63 ms flush floor the
+// device-side anchor alone would shift every host-paced gap by +63 ms
+// and replay the wrong row of the recording (learned-vs-recorded
+// calibration tables disagreed ~2.7x at the 60 ms point until this).
+std::atomic<int64_t> g_last_obs_us{0};
+
+void NoteObserved() {
+  int64_t now = NowMonoUs();
+  int64_t prev = g_last_obs_us.load(std::memory_order_relaxed);
+  while (prev < now && !g_last_obs_us.compare_exchange_weak(
+             prev, now, std::memory_order_relaxed)) {
+  }
+}
+
 // Observation skew is delivered by delaying event READINESS (the shim
 // times spans through PJRT_Event_OnReady callbacks, so skewing only
 // Await would be invisible to it). The chip itself is NOT held — the
@@ -255,7 +273,12 @@ std::atomic<int64_t> g_last_exec_end_us{0};
 void MarkReadyAt(FakeEvent* evt, int64_t at_us,
                  FakeEvent* evt2 = nullptr) {
   int64_t now = NowMonoUs();
+  // anchor update BEFORE MarkReady: MarkReady wakes the awaiting host,
+  // which can dispatch its next execute before this thread runs again —
+  // a stale anchor there reads as a ~full-span idle gap and injects the
+  // 60 ms-row excess into a back-to-back step
   if (at_us <= now) {
+    NoteObserved();
     evt->MarkReady();
     if (evt2) evt2->MarkReady();
     return;
@@ -263,6 +286,7 @@ void MarkReadyAt(FakeEvent* evt, int64_t at_us,
   std::thread([evt, evt2, at_us] {
     int64_t d = at_us - NowMonoUs();
     if (d > 0) usleep((useconds_t)d);
+    NoteObserved();
     evt->MarkReady();
     if (evt2) evt2->MarkReady();
   }).detach();
@@ -643,7 +667,12 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   // late by the recorded after-idle inflation at that gap
   int64_t extra_obs = 0;
   if (!GapTable().pts.empty()) {
+    // host-relative anchor: the later of device completion and the last
+    // event the host observed (see g_last_obs_us) — the recorded tables
+    // are indexed by host pacing gaps
     int64_t last = g_last_exec_end_us.load(std::memory_order_relaxed);
+    int64_t obs = g_last_obs_us.load(std::memory_order_relaxed);
+    if (obs > last) last = obs;
     int64_t gap = last > 0 ? NowMonoUs() - last : 0;
     extra_obs = GapExcessAt(gap < 0 ? 0 : gap);
   }

@@ -184,6 +184,74 @@ static int RunMultichip(const PJRT_Api* api, PJRT_Client* client,
 // => ~800 ms paced wall; the undiscounted charge (4 ms/step) would take
 // ~1600 ms, and a runaway discount (charging ~0) would finish at the
 // natural ~400 ms.
+// One submit → device-complete → (optional) D2H readback round: the
+// tenant sync-loop step (`float(loss)` per step). Shared by the
+// obs-latency scenario and the calibration replay server.
+static void SyncStep(const PJRT_Api* api, bool readback, int i) {
+  auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
+  PJRT_LoadedExecutable_Execute_Args eargs;
+  memset(&eargs, 0, sizeof(eargs));
+  eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  eargs.executable = fake_exe;
+  eargs.num_devices = 1;
+  PJRT_Buffer* outs[1] = {nullptr};
+  PJRT_Buffer** outlists[1] = {outs};
+  eargs.output_lists = outlists;
+  PJRT_Event* events[1] = {nullptr};
+  eargs.device_complete_events = events;
+  PJRT_Error* e = api->PJRT_LoadedExecutable_Execute(&eargs);
+  CHECK(!e, "execute %d errored", i);
+  if (events[0]) {
+    PJRT_Event_Await_Args aargs;
+    memset(&aargs, 0, sizeof(aargs));
+    aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    aargs.event = events[0];
+    api->PJRT_Event_Await(&aargs);
+  }
+  if (outs[0] && readback) {
+    char dst[1024];
+    PJRT_Buffer_ToHostBuffer_Args targs;
+    memset(&targs, 0, sizeof(targs));
+    targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    targs.src = outs[0];
+    targs.dst = dst;
+    targs.dst_size = sizeof(dst);
+    PJRT_Error* te = api->PJRT_Buffer_ToHostBuffer(&targs);
+    CHECK(!te, "readback %d errored", i);
+    if (!te && targs.event) {
+      PJRT_Event_Await_Args aargs;
+      memset(&aargs, 0, sizeof(aargs));
+      aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+      aargs.event = targs.event;
+      api->PJRT_Event_Await(&aargs);
+    }
+  }
+  if (outs[0]) Destroy(api, outs[0]);
+}
+
+// Calibration replay server (VERDICT r4 #2): one sync step per "run"
+// line on stdin, "done" on stdout after each completes. The Python
+// calibrator (manager/obs_calibrate.py measure_excess_table) drives
+// this process as its run_once — with SHIM_PATH pointing at the FAKE
+// plugin directly, i.e. the node daemon's shim-less view of the
+// transport — so the calibration LEARNING path measures the replayed
+// recorded regime instead of being handed the recorded table. Pacing
+// (the sleep between steps) lives on the Python side; the fake plugin
+// sees real wall-clock dispatch gaps and injects the recorded
+// after-idle inflation at each.
+static int RunCalServer(const PJRT_Api* api) {
+  setvbuf(stdout, nullptr, _IOLBF, 0);
+  printf("ready\n");
+  char line[64];
+  int i = 0;
+  while (fgets(line, sizeof line, stdin)) {
+    if (line[0] == 'q') break;  // "quit"
+    SyncStep(api, /*readback=*/true, i++);
+    printf("done\n");
+  }
+  return g_failures.load() ? 1 : 0;
+}
+
 static int RunObsLatency(const PJRT_Api* api, PJRT_Client* client,
                          PJRT_Device* dev) {
   printf("[O1] isolated-span discount under observation latency\n");
@@ -191,52 +259,12 @@ static int RunObsLatency(const PJRT_Api* api, PJRT_Client* client,
   // captures the probe's (client, device) handles
   PJRT_Buffer* resident = Alloc(api, client, dev, 65536, &err);
   CHECK(!err && resident, "resident alloc");
-  auto fake_exe = (PJRT_LoadedExecutable*)0xFEED;
   // SHIM_OBS_READBACK=1 reads the output back each step — the sync
   // train-loop shape (`float(loss)` per step). Required to replay the
   // lying-events regime, where D2H readback spans are the only honest
   // busy signal the shim can observe.
   bool readback = getenv("SHIM_OBS_READBACK") != nullptr;
-  auto one_step = [&](int i) {
-    PJRT_LoadedExecutable_Execute_Args eargs;
-    memset(&eargs, 0, sizeof(eargs));
-    eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-    eargs.executable = fake_exe;
-    eargs.num_devices = 1;
-    PJRT_Buffer* outs[1] = {nullptr};
-    PJRT_Buffer** outlists[1] = {outs};
-    eargs.output_lists = outlists;
-    PJRT_Event* events[1] = {nullptr};
-    eargs.device_complete_events = events;
-    PJRT_Error* e = api->PJRT_LoadedExecutable_Execute(&eargs);
-    CHECK(!e, "execute %d errored", i);
-    if (events[0]) {
-      PJRT_Event_Await_Args aargs;
-      memset(&aargs, 0, sizeof(aargs));
-      aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-      aargs.event = events[0];
-      api->PJRT_Event_Await(&aargs);
-    }
-    if (outs[0] && readback) {
-      char dst[1024];
-      PJRT_Buffer_ToHostBuffer_Args targs;
-      memset(&targs, 0, sizeof(targs));
-      targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-      targs.src = outs[0];
-      targs.dst = dst;
-      targs.dst_size = sizeof(dst);
-      PJRT_Error* te = api->PJRT_Buffer_ToHostBuffer(&targs);
-      CHECK(!te, "readback %d errored", i);
-      if (!te && targs.event) {
-        PJRT_Event_Await_Args aargs;
-        memset(&aargs, 0, sizeof(aargs));
-        aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-        aargs.event = targs.event;
-        api->PJRT_Event_Await(&aargs);
-      }
-    }
-    if (outs[0]) Destroy(api, outs[0]);
-  };
+  auto one_step = [&](int i) { SyncStep(api, readback, i); };
   for (int i = 0; i < 3; i++) one_step(i);  // warmup: starts watcher+probe
   usleep(1200 * 1000);                      // probe learns the latency
   int iters = 100;
@@ -273,6 +301,7 @@ int main(int argc, char** argv) {
   bool throttle_only = argc > 1 && !strcmp(argv[1], "--throttle-only");
   bool multichip = argc > 1 && !strcmp(argv[1], "--multichip");
   bool obs_latency = argc > 1 && !strcmp(argv[1], "--obs-latency");
+  bool cal_server = argc > 1 && !strcmp(argv[1], "--cal-server");
   const char* shim_path = getenv("SHIM_PATH");
   if (!shim_path) {
     fprintf(stderr, "SHIM_PATH not set\n");
@@ -281,7 +310,7 @@ int main(int argc, char** argv) {
   // Fail fast on a misconfigured run: without the quota env the shim loads
   // unenforced and every check below reports a confusing FAIL (the full
   // suite needs both; --throttle-only and the special modes set their own).
-  if (!throttle_only && !multichip && !obs_latency) {
+  if (!throttle_only && !multichip && !obs_latency && !cal_server) {
     const char* cfg = getenv("VTPU_CONFIG_PATH");
     bool have_file = cfg && access(cfg, R_OK) == 0;
     if (!have_file &&
@@ -346,6 +375,7 @@ int main(int argc, char** argv) {
     if (devargs.num_devices < 2) return 2;
     return RunMultichip(api, client, devargs.devices[0], devargs.devices[1]);
   }
+  if (cal_server) return RunCalServer(api);
   if (obs_latency) return RunObsLatency(api, client, dev);
 
   PJRT_Error* err = nullptr;
