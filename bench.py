@@ -608,7 +608,8 @@ def learn_replay_table(regime: dict, *, exec_us: int = 2000,
     from vtpu_manager.manager import obs_calibrate
     test_bin = os.path.join(BUILD, "shim_test")
     fake = os.path.join(BUILD, "libfake-pjrt.so")
-    if not (os.path.exists(test_bin) and os.path.exists(fake)):
+    if not (os.path.exists(test_bin) and os.path.exists(fake)
+            and regime.get("FAKE_GAP_EXCESS_TABLE")):
         return None
     env = dict(os.environ)
     env.update({
@@ -643,9 +644,17 @@ def learn_replay_table(regime: dict, *, exec_us: int = 2000,
                 if read_line() != "done":
                     raise RuntimeError("cal server died mid-step")
 
+            # measure at the RECORDED table's own gap points (the
+            # daemon's published gaps): capture-emitted traces may use
+            # different gaps than the defaults, and learned-vs-recorded
+            # comparison is only meaningful at matching keys
+            recorded = obs_calibrate.decode_table(
+                regime.get("FAKE_GAP_EXCESS_TABLE", ""))
+            gaps_ms = tuple(g // 1000 for g, _ in recorded if g)
             table = obs_calibrate.measure_excess_table(
                 run_once=run_once, b2b_samples=b2b_samples,
-                gap_samples=gap_samples)
+                gap_samples=gap_samples,
+                gaps_ms=gaps_ms or obs_calibrate.GAPS_MS)
             if table:
                 encoded = obs_calibrate.encode_table(table)
     except RuntimeError:

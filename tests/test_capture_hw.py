@@ -64,6 +64,12 @@ def fake_bench(monkeypatch, tmp_path):
                         lambda reps=2: calls.append("pallas") or {
                             "pallas_attention": {"ms_pallas": 1.0,
                                                  "ms_xla": 1.2}})
+    monkeypatch.setattr(capture_hw, "capture_trace",
+                        lambda table, detail, rnd, **kw:
+                        calls.append("trace") or {
+                            "trace": {"file": "library/test/traces/"
+                                              "v5e_r99_transport.env",
+                                      "flush_floor_us": 100}})
     return calls
 
 
@@ -237,7 +243,8 @@ def _complete_capture_dict():
                    "balance_mode": {"climbed": True},
                    "vtpu_busy_convergence": {"in_band": True},
                    "host_offload": {"status": "ok"},
-                   "pallas_attention": {"ms_pallas": 1.0}}}
+                   "pallas_attention": {"ms_pallas": 1.0},
+                   "trace": {"file": "library/test/traces/x.env"}}}
 
 
 def test_watcher_capture_complete_predicate(tmp_path):
@@ -283,6 +290,45 @@ def test_partial_quota_sweep_withholds_mae(fake_bench, tmp_path,
     assert cap["detail"]["quota_points_partial"] is True
     assert "quotas" in cap["sections_failed"]
     assert len(cap["detail"]["quota_points"]) == 1   # the point it got
+
+
+def test_capture_trace_emits_replayable_env(tmp_path, monkeypatch):
+    """The REAL capture_trace (floor-probe subprocess stubbed): the
+    emitted trace must round-trip through bench.read_trace_env with the
+    session's table, measured floor, and step time — the exact contract
+    the parametrized replay/learning tests consume (VERDICT r4 #5)."""
+    monkeypatch.setattr(capture_hw, "REPO", str(tmp_path))
+    os.makedirs(tmp_path / "library" / "test" / "traces")
+    monkeypatch.setattr(
+        capture_hw, "run_code_section",
+        lambda code, env, prefix, timeout=300: {"floor_us": "61000"})
+    out = capture_hw.capture_trace(
+        "0:0,60000:2100,120000:900", {"unthrottled_ms_per_step": 70.64},
+        rnd=9)
+    assert out["trace"]["file"] == (
+        "library/test/traces/v5e_r09_transport.env")
+    regime = bench.read_trace_env(
+        os.path.join(str(tmp_path), out["trace"]["file"]))
+    # FAKE_EXEC_US is device-busy: measured step (70.64 ms) MINUS the
+    # floor, so the fake's exec+floor replay reproduces the step time
+    assert regime == {"FAKE_GAP_EXCESS_TABLE": "0:0,60000:2100,120000:900",
+                      "FAKE_FLUSH_FLOOR_US": "61000",
+                      "FAKE_EXEC_US": "9640"}
+    # a resumed capture (quotas carried from a PRIOR session) must not
+    # pair the stale step time with this session's table/floor
+    out = capture_hw.capture_trace(
+        "0:0,60000:2100", {"unthrottled_ms_per_step": 70.64}, rnd=9,
+        step_fresh=False)
+    regime = bench.read_trace_env(
+        os.path.join(str(tmp_path), out["trace"]["file"]))
+    assert "FAKE_EXEC_US" not in regime
+    # no calibrated table this session -> nothing to emit, section
+    # retried on the next healthy window
+    assert capture_hw.capture_trace(None, {}, rnd=9) == {}
+    # dead floor probe -> nothing emitted either
+    monkeypatch.setattr(capture_hw, "run_code_section",
+                        lambda *a, **k: None)
+    assert capture_hw.capture_trace("0:0,60000:1", {}, rnd=9) == {}
 
 
 class TestWatcherLoop:
