@@ -19,6 +19,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -269,27 +270,101 @@ void NoteObserved() {
 // times spans through PJRT_Event_OnReady callbacks, so skewing only
 // Await would be invisible to it). The chip itself is NOT held — the
 // inflation is transport-side; the next execute proceeds on schedule.
-// One sleeper thread serves every event sharing the wake instant.
-void MarkReadyAt(FakeEvent* evt, int64_t at_us,
-                 FakeEvent* evt2 = nullptr) {
-  int64_t now = NowMonoUs();
+// ONE timer thread serves every delayed event through a deadline queue
+// (ADVICE r4: a detached thread per delayed event meant replay sweeps
+// spawned one per execute, and threads still sleeping at process exit
+// touched leaked events during teardown). Invariant this relies on:
+// OnReady callbacks registered against this fake never block on another
+// event — the real registrants are the shim's span recorder (records
+// timestamps) and FireChained (enqueues, returns); a blocking callback
+// would stall every later deadline, since firing is sequential.
+struct DelayedReady {
+  int64_t at_us;
+  FakeEvent* evt;
+  FakeEvent* evt2;
+};
+
+std::mutex& TimerMu() { static auto* m = new std::mutex; return *m; }
+std::condition_variable& TimerCv() {
+  static auto* cv = new std::condition_variable;
+  return *cv;
+}
+std::vector<DelayedReady>& TimerQueue() {
+  static auto* q = new std::vector<DelayedReady>;
+  return *q;
+}
+pthread_once_t g_timer_once = PTHREAD_ONCE_INIT;
+
+void FireReady(const DelayedReady& d) {
   // anchor update BEFORE MarkReady: MarkReady wakes the awaiting host,
   // which can dispatch its next execute before this thread runs again —
   // a stale anchor there reads as a ~full-span idle gap and injects the
   // 60 ms-row excess into a back-to-back step
-  if (at_us <= now) {
-    NoteObserved();
-    evt->MarkReady();
-    if (evt2) evt2->MarkReady();
+  NoteObserved();
+  d.evt->MarkReady();
+  if (d.evt2) d.evt2->MarkReady();
+}
+
+void* TimerThread(void*) {
+  auto earlier = [](const DelayedReady& a, const DelayedReady& b) {
+    return a.at_us < b.at_us;
+  };
+  std::unique_lock<std::mutex> lk(TimerMu());
+  for (;;) {
+    auto& q = TimerQueue();
+    if (q.empty()) {
+      TimerCv().wait(lk);
+      continue;
+    }
+    auto next = std::min_element(q.begin(), q.end(), earlier);
+    int64_t now = NowMonoUs();
+    if (next->at_us > now) {
+      TimerCv().wait_for(lk,
+                         std::chrono::microseconds(next->at_us - now));
+      continue;  // re-evaluate: a nearer deadline may have arrived
+    }
+    DelayedReady due = *next;
+    q.erase(next);
+    lk.unlock();
+    FireReady(due);   // MarkReady runs callbacks; never under TimerMu
+    lk.lock();
+  }
+  return nullptr;
+}
+
+void ResetTimerForFork() {
+  pthread_once_t fresh = PTHREAD_ONCE_INIT;
+  memcpy(&g_timer_once, &fresh, sizeof(fresh));
+  new (&TimerMu()) std::mutex();
+  new (&TimerCv()) std::condition_variable();
+  TimerQueue().clear();
+}
+
+void StartTimer() {
+  pthread_t t;
+  static pthread_once_t atfork_once = PTHREAD_ONCE_INIT;
+  pthread_once(&atfork_once, [] {
+    pthread_atfork(nullptr, nullptr, ResetTimerForFork);
+  });
+  if (pthread_create(&t, nullptr, TimerThread, nullptr) != 0) {
+    fprintf(stderr, "fake plugin: timer thread creation failed; "
+                    "delayed events would never fire\n");
+    abort();
+  }
+}
+
+void MarkReadyAt(FakeEvent* evt, int64_t at_us,
+                 FakeEvent* evt2 = nullptr) {
+  if (at_us <= NowMonoUs()) {
+    FireReady({at_us, evt, evt2});
     return;
   }
-  std::thread([evt, evt2, at_us] {
-    int64_t d = at_us - NowMonoUs();
-    if (d > 0) usleep((useconds_t)d);
-    NoteObserved();
-    evt->MarkReady();
-    if (evt2) evt2->MarkReady();
-  }).detach();
+  pthread_once(&g_timer_once, StartTimer);
+  {
+    std::lock_guard<std::mutex> lk(TimerMu());
+    TimerQueue().push_back({at_us, evt, evt2});
+  }
+  TimerCv().notify_one();
 }
 
 // Chain `evt` on `producer`'s true readiness, then observe it no earlier

@@ -258,6 +258,20 @@ def test_calibration_gauges(tmp_path):
 # kubelet, not our own config-dir names, is the attribution authority)
 # ---------------------------------------------------------------------------
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_startup_grace(monkeypatch):
+    """Config dirs these tests create are seconds old, so the startup
+    grace window (ADVICE r4: a just-allocated tenant must not publish a
+    transient mismatch while the kubelet checkpoint write lags) would
+    suppress every mismatch verdict under test. Disabled here; the
+    grace itself is covered by test_mapping_startup_grace below."""
+    from vtpu_manager.metrics import collector
+    monkeypatch.setattr(collector, "STARTUP_GRACE_S", 0.0)
+
+
 def _mk_config_dir(base, pod_uid, container, chip, dra_request=None):
     sub = "config" if dra_request is None else f"config_{dra_request}"
     d = os.path.join(base, f"{pod_uid}_{container}", sub)
@@ -330,6 +344,36 @@ def test_mapping_crosscheck_pod_resources_socket(tmp_path):
     assert 'pod_uid="uid-3"' not in mismatch_block
     assert 'pod_uid="claim"' not in mismatch_block
     assert 'vtpu_node_pod_mapping_source{node="n1"} 2.0' in text
+
+
+def test_mapping_startup_grace_skips_fresh_tenants(tmp_path, monkeypatch):
+    """ADVICE r4: a just-allocated tenant whose checkpoint entry lags
+    the allocation must be unjudgeable (no mismatch row), not a
+    transient mismatch=1; an OLD orphan still alarms."""
+    from vtpu_manager.metrics import collector
+    monkeypatch.setattr(collector, "STARTUP_GRACE_S", 60.0)
+    base = str(tmp_path / "mgr")
+    chips = [fake_chip(0)]
+    _mk_config_dir(base, "uid-new", "ghost", chips[0])   # just created
+    _mk_config_dir(base, "uid-old", "ghost", chips[0])   # orphan, aged
+    old_cfg = os.path.join(base, "uid-old_ghost", "config", "vtpu.config")
+    past = os.path.getmtime(old_cfg) - 3600
+    os.utime(old_cfg, (past, past))
+    sock = str(tmp_path / "podres.sock")
+    server = _fake_pod_resources_server(sock, ["main"])
+    try:
+        text = NodeCollector(
+            "n1", chips, base_dir=base,
+            tc_path=str(tmp_path / "tc"), vmem_path=str(tmp_path / "vm"),
+            pod_resources_socket=sock,
+            kubelet_checkpoint=str(tmp_path / "no-ckpt")).render()
+    finally:
+        server.stop(0)
+    assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
+            'pod_uid="uid-old",container="ghost"} 1.0') in text
+    mismatch_block = text.split(
+        "vtpu_container_pod_mapping_mismatch", 1)[1].split("# ", 1)[0]
+    assert 'pod_uid="uid-new"' not in mismatch_block
 
 
 def test_mapping_crosscheck_checkpoint_fallback(tmp_path):

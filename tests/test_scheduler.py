@@ -880,6 +880,43 @@ class TestGangDialects:
                     if e.get("reason") == "VtpuGangDisrupted"]
         assert len(warnings) == 1
         assert "default/ring-gang" in warnings[0]["message"]
+        # the event binds to the preemptor POD OBJECT, not just its name
+        # (ADVICE r4: name alone can rebind to a later pod)
+        assert warnings[0]["involvedObject"]["uid"] == (
+            preemptor["metadata"]["uid"])
+
+    def test_gang_dedup_is_per_group_not_per_victim_set(self):
+        """ADVICE r4: retry loops vary the candidate victim set per
+        cycle; a set-keyed dedup treated every distinct set as new and
+        fired again inside the window. Per-group keying warns once per
+        (preemptor, group): a varying second gang in the set must not
+        re-announce the first, and only genuinely-new groups fire."""
+        from vtpu_manager.util import gangname as gn
+        client, _ = occupied_cluster()
+        victim = client.get_pod("default", "victim")
+        victim["metadata"].setdefault("annotations", {})[
+            gn.VOLCANO_GROUP_ANNOTATION] = "gang-a"
+        client.add_pod(victim)
+        preemptor = vtpu_pod(name="pre", cores=50, priority=100)
+        pred = PreemptPredicate(client)
+        other = dict(victim)
+        other["metadata"] = dict(victim["metadata"],
+                                 name="victim-b", uid="uid-b",
+                                 annotations={
+                                     gn.VOLCANO_GROUP_ANNOTATION:
+                                     "gang-b"})
+        client.add_pod(other)     # resident: the predicate re-reads pods
+        # cycle 1: {gang-a}; cycle 2: {gang-a, gang-b} — a distinct SET
+        for victims in ([victim], [victim, other]):
+            pred.preempt({
+                "Pod": preemptor,
+                "NodeNameToVictims": {"node-0": {"Pods": victims}}})
+        warnings = [e for e in client.events
+                    if e.get("reason") == "VtpuGangDisrupted"]
+        assert len(warnings) == 2                    # not 2x gang-a
+        assert "default/gang-a" in warnings[0]["message"]
+        assert "default/gang-a" not in warnings[1]["message"]
+        assert "default/gang-b" in warnings[1]["message"]
 
     def test_gangless_victims_emit_no_disruption_warning(self):
         client, _ = occupied_cluster()

@@ -138,3 +138,42 @@ def test_fake_kube_client_rejects_unknown_field_selector():
     assert client.list_pods(field_selector="spec.nodeName!=") == []
     with pytest.raises(NotImplementedError):
         client.list_pods(field_selector="status.phase=Running")
+
+
+def test_force_cpu_raises_smaller_ambient_device_count(monkeypatch):
+    """ADVICE r4: the XLA_FLAGS guard was substring-only, so an ambient
+    --xla_force_host_platform_device_count SMALLER than the requested
+    mesh kept its value and the dry run died on a confusing
+    device-count mismatch. A smaller ambient count must be raised, a
+    larger one left alone, an absent flag appended."""
+    from vtpu_manager.util import jaxplatform
+
+    # register the originals with monkeypatch so force_cpu's direct
+    # os.environ writes (JAX_PLATFORMS set, PALLAS_AXON_POOL_IPS pop)
+    # are undone after the test — later tests must not inherit them.
+    # jax.config stays "cpu": conftest pins the whole suite to CPU.
+    for key in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS"):
+        if key in os.environ:
+            monkeypatch.setenv(key, os.environ[key])
+        else:
+            monkeypatch.delenv(key, raising=False)
+
+    def flags_after(ambient: str | None, n: int) -> str:
+        if ambient is None:
+            monkeypatch.delenv("XLA_FLAGS", raising=False)
+        else:
+            monkeypatch.setenv("XLA_FLAGS", ambient)
+        jaxplatform.force_cpu(n)
+        return os.environ.get("XLA_FLAGS", "")
+
+    assert flags_after(None, 8) == (
+        "--xla_force_host_platform_device_count=8")
+    assert flags_after("--xla_force_host_platform_device_count=2", 8) == (
+        "--xla_force_host_platform_device_count=8")
+    # a LARGER ambient count constructs the mesh fine: left alone
+    assert flags_after("--xla_force_host_platform_device_count=16", 8) == (
+        "--xla_force_host_platform_device_count=16")
+    # unrelated ambient flags survive the raise
+    assert flags_after(
+        "--xla_cpu_foo=1 --xla_force_host_platform_device_count=4", 8) == (
+        "--xla_cpu_foo=1 --xla_force_host_platform_device_count=8")

@@ -100,7 +100,9 @@ def _label_selector_matches(selector: dict | None, labels: dict) -> bool:
 class PreemptPredicate:
     def __init__(self, client: KubeClient):
         self.client = client
-        # (preemptor uid, group-set) -> monotonic time of last warning
+        # (preemptor uid, individual group) -> monotonic time of last
+        # warning (per-group, NOT per-victim-set: retry loops vary the
+        # set per cycle — ADVICE r4)
         self._gang_warned: dict[tuple, float] = {}
 
     def preempt(self, args: dict) -> PreemptResult:
@@ -161,8 +163,10 @@ class PreemptPredicate:
         Phrased as CANDIDATES: kube-scheduler picks one of the passing
         nodes afterwards, so gangs on the non-chosen nodes are never
         actually touched. Best-effort and deduped per (preemptor,
-        group-set) for a window — scheduler retry loops must not flood
-        etcd with identical warnings."""
+        INDIVIDUAL group) for a window (ADVICE r4: retry loops vary the
+        candidate victim set per cycle, so a set-keyed dedup treated
+        every distinct set as new and fired again inside the window) —
+        scheduler retry loops must not flood etcd."""
         from vtpu_manager.util.gangname import resolve_gang_name
         disrupted = sorted({
             f"{(v.get('metadata') or {}).get('namespace', 'default')}"
@@ -172,10 +176,12 @@ class PreemptPredicate:
         if not disrupted:
             return
         meta = preemptor.get("metadata") or {}
-        key = (meta.get("uid", ""), tuple(disrupted))
+        uid = meta.get("uid", "")
         now = time.monotonic()
-        last = self._gang_warned.get(key, -_GANG_WARN_WINDOW_S)
-        if now - last < _GANG_WARN_WINDOW_S:
+        fresh = [g for g in disrupted
+                 if now - self._gang_warned.get(
+                     (uid, g), -_GANG_WARN_WINDOW_S) >= _GANG_WARN_WINDOW_S]
+        if not fresh:
             return
         # prune expired entries: the predicate lives for the scheduler
         # process lifetime and preemptor uids churn — the dedup map must
@@ -183,17 +189,21 @@ class PreemptPredicate:
         self._gang_warned = {
             k: t for k, t in self._gang_warned.items()
             if now - t < _GANG_WARN_WINDOW_S}
-        self._gang_warned[key] = now
+        for group in fresh:
+            self._gang_warned[(uid, group)] = now
         ns = meta.get("namespace", "default")
         try:
             self.client.create_event(ns, {
                 "metadata": {"generateName": "vtpu-preempt-"},
+                # uid included so the event binds to THIS pod object,
+                # not a later pod reusing the name (ADVICE r4)
                 "involvedObject": {"kind": "Pod", "namespace": ns,
-                                   "name": meta.get("name", "")},
+                                   "name": meta.get("name", ""),
+                                   **({"uid": uid} if uid else {})},
                 "reason": "VtpuGangDisrupted",
                 "message": ("preemption candidate victims include "
                             "members of pod group(s) "
-                            + ", ".join(disrupted)
+                            + ", ".join(fresh)
                             + "; evicting them strands their siblings' "
                               "aligned placement")[:1024],
                 "type": "Warning",

@@ -36,10 +36,21 @@ def force_cpu(n_devices: int | None = None) -> None:
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
     if n_devices is not None:
+        import re
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                      flags)
+        if m is None:
             os.environ["XLA_FLAGS"] = (
                 f"{flags} --xla_force_host_platform_device_count="
                 f"{n_devices}").strip()
+        elif int(m.group(1)) < n_devices:
+            # RAISE a smaller ambient count (ADVICE r4: a substring-only
+            # guard kept e.g. a caller's =2 and the mesh dry run later
+            # died on a confusing device-count mismatch); an ambient
+            # LARGER count is left alone — the mesh constructs fine
+            os.environ["XLA_FLAGS"] = flags.replace(
+                m.group(0),
+                f"--xla_force_host_platform_device_count={n_devices}")
     import jax
     jax.config.update("jax_platforms", "cpu")
