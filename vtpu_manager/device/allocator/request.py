@@ -113,14 +113,30 @@ class AllocationRequest:
                 peak = max(peak, value(c) + running_sidecars)
         return peak
 
+    # The three totals are re-read per candidate NODE (node gate, capacity
+    # sort, allocator) while the container lists are fixed after parse —
+    # memoized so a 5000-node pass computes each peak once per pod, not
+    # once per node (profiled: ~15% of a large-cluster filter pass was
+    # re-walking these sums).
+    _totals_cache: tuple[int, int, int] | None = \
+        field(default=None, init=False, repr=False, compare=False)
+
+    def _totals(self) -> tuple[int, int, int]:
+        if self._totals_cache is None:
+            self._totals_cache = (
+                self._phase_peak(lambda c: c.number),
+                self._phase_peak(lambda c: c.total_cores),
+                self._phase_peak(lambda c: c.total_memory))
+        return self._totals_cache
+
     def total_number(self) -> int:
-        return self._phase_peak(lambda c: c.number)
+        return self._totals()[0]
 
     def total_cores(self) -> int:
-        return self._phase_peak(lambda c: c.total_cores)
+        return self._totals()[1]
 
     def total_memory(self) -> int:
-        return self._phase_peak(lambda c: c.total_memory)
+        return self._totals()[2]
 
     def is_empty(self) -> bool:
         return self.total_number() == 0

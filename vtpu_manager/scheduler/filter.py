@@ -79,7 +79,8 @@ class FilterPredicate:
     def __init__(self, client: KubeClient, serialize: bool = True,
                  require_node_label: bool = False,
                  candidate_limit: int = 64,
-                 pods_ttl_s: float = 0.0):
+                 pods_ttl_s: float = 0.0,
+                 nodes_ttl_s: float = 0.0):
         self.client = client
         self.serialize = serialize
         self._serial_lock = threading.Lock()
@@ -108,6 +109,16 @@ class FilterPredicate:
         self._all_pods_cache: list[dict] | None = None
         self._all_pods_cache_ts = 0.0
         self._pods_cache_lock = threading.Lock()
+        # Node-snapshot TTL, same informer-fidelity rationale as the pod
+        # snapshot: the list_nodes() fallback (kube-scheduler usually
+        # ships nodes IN the ExtenderArgs, but nodeCacheCapable=false
+        # setups and direct callers hit it) must not LIST a 5000-node
+        # cluster per pod. Node registries change on device
+        # (re-)registration, minutes-scale; capacity freshness comes from
+        # the pod snapshot + assumed overlay, not the node objects.
+        self.nodes_ttl_s = nodes_ttl_s
+        self._nodes_cache: list[dict] | None = None
+        self._nodes_cache_ts = 0.0
 
     @staticmethod
     def _partition_by_node(pods: list[dict]) -> dict[str, list[dict]]:
@@ -124,6 +135,25 @@ class FilterPredicate:
     # sustained run otherwise grows O(total pods) — r2 verdict).
     _SCHEDULED_SELECTOR = "spec.nodeName!="
 
+    def _ttl_cached(self, ttl_s: float, cache_attr: str, ts_attr: str,
+                    fetch):
+        """ONE home for the snapshot-TTL idiom (scheduled pods, full pod
+        list, nodes). time.monotonic() throughout — the idiom existed as
+        three hand-rolled copies until the third (nodes) drifted to
+        time.time() and an NTP step could pin a stale snapshot."""
+        if ttl_s <= 0:
+            return fetch()
+        now = time.monotonic()
+        with self._pods_cache_lock:
+            if getattr(self, cache_attr) is not None and \
+                    now - getattr(self, ts_attr) < ttl_s:
+                return getattr(self, cache_attr)
+        value = fetch()
+        with self._pods_cache_lock:
+            setattr(self, cache_attr, value)
+            setattr(self, ts_attr, now)
+        return value
+
     def _list_pods(self) -> tuple[list[dict], dict[str, list[dict]]]:
         """(scheduled pods, same partitioned by nodeName). The partition is
         built once per snapshot, not per filter call — at 100k pods the
@@ -131,37 +161,21 @@ class FilterPredicate:
         excluded by selector; anything unbound that matters to capacity is
         in the assumed cache, and gang resolution does its own full list
         (siblings are committed before they carry a nodeName)."""
-        if self.pods_ttl_s <= 0:
+
+        def fetch():
             pods = self.client.list_pods(
                 field_selector=self._SCHEDULED_SELECTOR)
             return pods, self._partition_by_node(pods)
-        now = time.monotonic()
-        with self._pods_cache_lock:
-            if self._pods_cache is not None and \
-                    now - self._pods_cache_ts < self.pods_ttl_s:
-                return self._pods_cache
-        pods = self.client.list_pods(field_selector=self._SCHEDULED_SELECTOR)
-        snapshot = (pods, self._partition_by_node(pods))
-        with self._pods_cache_lock:
-            self._pods_cache = snapshot
-            self._pods_cache_ts = now
-        return snapshot
+
+        return self._ttl_cached(self.pods_ttl_s, "_pods_cache",
+                                "_pods_cache_ts", fetch)
 
     def _list_all_pods(self) -> list[dict]:
         """Full cluster pod list (gang paths only), TTL-cached like the
         scheduled snapshot and invalidated on every commit the same way."""
-        if self.pods_ttl_s <= 0:
-            return self.client.list_pods()
-        now = time.monotonic()
-        with self._pods_cache_lock:
-            if self._all_pods_cache is not None and \
-                    now - self._all_pods_cache_ts < self.pods_ttl_s:
-                return self._all_pods_cache
-        pods = self.client.list_pods()
-        with self._pods_cache_lock:
-            self._all_pods_cache = pods
-            self._all_pods_cache_ts = now
-        return pods
+        return self._ttl_cached(self.pods_ttl_s, "_all_pods_cache",
+                                "_all_pods_cache_ts",
+                                self.client.list_pods)
 
     # -- assumed-allocation cache -------------------------------------------
 
@@ -178,20 +192,28 @@ class FilterPredicate:
             self._pods_cache = None
             self._all_pods_cache = None
 
-    def _assumed_for_node(self, node: str,
-                          visible_uids: set[str]) -> list[_Assumed]:
-        """Assumed commits for `node` not yet visible in the pod list.
-        Expired entries (pod deleted before ever appearing) are dropped."""
+    def _assumed_by_node(self) -> dict[str, list]:
+        """One snapshot of live assumed commits per filter PASS,
+        partitioned by node — the per-candidate-node walk of the whole
+        assumed dict was ~15% of a 5000-node pass. Expired entries (pod
+        deleted before ever appearing) are dropped here; entries whose
+        pod became visible in the pod list are dropped by the caller via
+        _drop_assumed, where the per-node resident set exists."""
         now = time.time()
-        out = []
+        out: dict[str, list] = {}
         with self._assumed_lock:
             for uid in list(self._assumed):
                 entry = self._assumed[uid]
-                if uid in visible_uids or now - entry.ts > ASSUME_TTL_S:
+                if now - entry.ts > ASSUME_TTL_S:
                     del self._assumed[uid]
-                elif entry.node == node:
-                    out.append((uid, entry))
+                else:
+                    out.setdefault(entry.node, []).append((uid, entry))
         return out
+
+    def _drop_assumed(self, uids) -> None:
+        with self._assumed_lock:
+            for uid in uids:
+                self._assumed.pop(uid, None)
 
     # -- stage 1: node-level gates (cheap, no pod listing) ------------------
 
@@ -235,7 +257,9 @@ class FilterPredicate:
                 return items
         names = args.get("NodeNames") or args.get("nodenames")
         if names is None:
-            return self.client.list_nodes()
+            return self._ttl_cached(self.nodes_ttl_s, "_nodes_cache",
+                                    "_nodes_cache_ts",
+                                    self.client.list_nodes)
         out = []
         for name in names:
             try:
@@ -303,32 +327,45 @@ class FilterPredicate:
         # then build the full usage view lazily, only for nodes the
         # allocator actually visits.
         ranked = []
+        reg_ann = consts.node_device_register_annotation()
+        assumed_by_node = self._assumed_by_node()
+        now_visible: set[str] = set()
+        req_number, req_cores, req_memory = (
+            req.total_number(), req.total_cores(), req.total_memory())
         for node in candidates:
             meta = node.get("metadata") or {}
             name = meta.get("name", "")
             registry = dt.decode_registry(
-                (meta.get("annotations") or {}).get(
-                    consts.node_device_register_annotation()))
+                (meta.get("annotations") or {}).get(reg_ann))
             if registry is None:
                 result.failed_nodes[name] = R.NODE_NO_DEVICES
                 reasons.add(R.NODE_NO_DEVICES, name)
                 continue
             resident = by_node.get(name, [])
             counted = dt.counted_claims(resident, now=now)
-            visible = {(p.get("metadata") or {}).get("uid", "")
-                       for p in resident}
-            assumed = self._assumed_for_node(name, visible)
+            assumed = assumed_by_node.get(name, [])
+            if assumed:
+                # an assumed commit whose pod reached the pod list is
+                # double-counted if kept; visible ones retire now
+                visible = {(p.get("metadata") or {}).get("uid", "")
+                           for p in resident}
+                retired = [u for u, _ in assumed if u in visible]
+                if retired:
+                    now_visible.update(retired)
+                    assumed = [(u, e) for u, e in assumed
+                               if u not in visible]
             free_number, free_cores, free_memory = dt.fast_free_totals(
                 registry,
                 [c for _, c in counted] + [e.claims for _, e in assumed])
-            if (free_number < req.total_number()
-                    or free_cores < req.total_cores()
-                    or free_memory < req.total_memory()):
+            if (free_number < req_number or free_cores < req_cores
+                    or free_memory < req_memory):
                 result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
                 continue
             ranked.append((free_cores + (free_memory >> 24) + free_number,
                            name, registry, counted, assumed))
+        if now_visible:
+            self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
         # Gang-domain nodes walk FIRST regardless: the +100 scoring bonus
         # is useless if candidate_limit truncation never visits them (a
