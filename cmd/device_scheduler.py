@@ -27,6 +27,13 @@ def main(argv: list[str] | None = None) -> int:
                              "filter calls (informer-cache analogue; the "
                              "assumed cache keeps our own placements "
                              "fresh). 0 = list per call")
+    parser.add_argument("--node-snapshot-ttl-ms", type=int, default=5000,
+                        help="amortize the list_nodes() fallback the same "
+                             "way (only hit when kube-scheduler does not "
+                             "ship nodes in the ExtenderArgs, i.e. "
+                             "nodeCacheCapable=false). Node registries "
+                             "change on device re-registration, "
+                             "minutes-scale. 0 = list per call")
     parser.add_argument("--require-node-label", action="store_true",
                         help="only consider nodes labeled "
                              "vtpu-manager-enable=true")
@@ -68,7 +75,8 @@ def main(argv: list[str] | None = None) -> int:
     api = SchedulerAPI(
         FilterPredicate(client,
                         require_node_label=args.require_node_label,
-                        pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0),
+                        pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0,
+                        nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0),
         BindPredicate(client, locker=bind_locker),
         PreemptPredicate(client),
         debug_endpoints=args.debug_endpoints)

@@ -1,0 +1,304 @@
+"""Seeded wire-level fuzz for the two hand-written transports.
+
+Both parsers read bytes straight off sockets written by OTHER processes
+(the shim's device client on the registry UDS; containerd on the NRI
+ttrpc socket), so malformed frames are an expected input class, not a
+test curiosity. The reference rides containerd's vendored ttrpc stub and
+gRPC for these — our from-the-wire-spec implementations carry the
+robustness burden themselves, the same way the config codec carries it
+(tests/test_codec_fuzz.py, same seeded-corpus discipline).
+
+Invariants fuzzed for:
+- no unhandled exception escapes a connection/read thread;
+- a client always gets a terminal outcome (status/response/clean close)
+  within the timeout — never an indefinite hang;
+- the SERVER outlives every malformed connection: a well-formed request
+  still gets served after the whole corpus has been thrown at it.
+"""
+
+import json
+import random
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from vtpu_manager.util import ttrpc
+from vtpu_manager.util.ttrpc import (MSG_REQUEST, MSG_RESPONSE, _HEADER,
+                                     Connection)
+
+SEED = 0xC0FFEE
+N_CASES = 300
+
+
+# --- ttrpc frame layer ------------------------------------------------------
+
+
+def _echo_handlers():
+    return {("svc.Echo", "Ping"): lambda payload: b"pong:" + payload}
+
+
+def _serve_pair():
+    """(raw client socket, served Connection) over a socketpair."""
+    client, server = socket.socketpair()
+    conn = Connection(server, handlers=_echo_handlers(), initiator=False)
+    return client, conn
+
+
+def _valid_request_frame(stream_id=1, service="svc.Echo", method="Ping",
+                         payload=b"x") -> bytes:
+    from vtpu_manager.kubeletplugin.api import ttrpc_pb2
+    req = ttrpc_pb2.Request()
+    req.service = service
+    req.method = method
+    req.payload = payload
+    raw = req.SerializeToString()
+    return _HEADER.pack(len(raw), stream_id, MSG_REQUEST, 0) + raw
+
+
+def _read_response(sock, timeout=5.0) -> bytes | None:
+    """One RESPONSE frame payload off the raw side, or None on close."""
+    sock.settimeout(timeout)
+    buf = b""
+    try:
+        while len(buf) < _HEADER.size:
+            chunk = sock.recv(_HEADER.size - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        length, _sid, msg_type, _ = _HEADER.unpack(buf)
+        assert msg_type == MSG_RESPONSE
+        payload = b""
+        while len(payload) < length:
+            chunk = sock.recv(length - len(payload))
+            if not chunk:
+                return None
+            payload += chunk
+        return payload
+    except socket.timeout:
+        pytest.fail("ttrpc peer hung: no response and no close")
+
+
+class TestTtrpcFrameFuzz:
+    def test_garbage_headers_close_not_hang(self):
+        """Random header bytes: the read loop must reach a terminal state
+        (serve what parses, close on oversize/short) without an
+        exception and without leaving the peer hanging."""
+        rng = random.Random(SEED)
+        for _ in range(N_CASES):
+            client, conn = _serve_pair()
+            try:
+                blob = bytes(rng.randrange(256) for _ in range(
+                    rng.choice((1, 3, _HEADER.size,
+                                _HEADER.size + rng.randrange(64)))))
+                client.sendall(blob)
+                client.shutdown(socket.SHUT_WR)
+                # terminal: the connection thread must settle (either it
+                # parsed a short/oversize header and broke, or it waits
+                # on a payload that EOF just cut short)
+                assert conn.closed.wait(5.0), "read loop failed to settle"
+            finally:
+                client.close()
+                conn.close()
+
+    def test_oversize_length_rejected(self):
+        client, conn = _serve_pair()
+        try:
+            client.sendall(_HEADER.pack(ttrpc.MAX_MESSAGE + 1, 1,
+                                        MSG_REQUEST, 0))
+            assert conn.closed.wait(5.0)
+        finally:
+            client.close()
+            conn.close()
+
+    def test_invalid_protobuf_payload_gets_error_response(self):
+        """A well-framed REQUEST whose payload is not a Request proto
+        must produce an error RESPONSE on the same stream — the
+        connection survives and serves the next valid call."""
+        from vtpu_manager.kubeletplugin.api import ttrpc_pb2
+        rng = random.Random(SEED + 1)
+        client, conn = _serve_pair()
+        try:
+            for _ in range(20):
+                junk = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 64)))
+                client.sendall(_HEADER.pack(len(junk), 7, MSG_REQUEST, 0)
+                               + junk)
+                raw = _read_response(client)
+                if raw is None:
+                    pytest.fail("connection died on junk payload")
+                resp = ttrpc_pb2.Response.FromString(raw)
+                # junk may accidentally BE a valid (empty-ish) Request;
+                # then NOT_FOUND for its unknown service is the right
+                # answer — any nonzero status code is a correct outcome
+                assert resp.status.code != 0
+            # the same connection still serves a real call
+            client.sendall(_valid_request_frame(stream_id=99))
+            resp = ttrpc_pb2.Response.FromString(_read_response(client))
+            assert resp.payload == b"pong:x"
+        finally:
+            client.close()
+            conn.close()
+
+    def test_unknown_response_stream_is_ignored(self):
+        """A RESPONSE for a stream nobody is waiting on (late reply,
+        peer bug) must not crash the read loop."""
+        from vtpu_manager.kubeletplugin.api import ttrpc_pb2
+        client, conn = _serve_pair()
+        try:
+            resp = ttrpc_pb2.Response()
+            raw = resp.SerializeToString()
+            client.sendall(_HEADER.pack(len(raw), 12345, MSG_RESPONSE, 0)
+                           + raw)
+            client.sendall(_valid_request_frame(stream_id=3))
+            out = ttrpc_pb2.Response.FromString(_read_response(client))
+            assert out.payload == b"pong:x"
+        finally:
+            client.close()
+            conn.close()
+
+    def test_interleaved_fuzz_then_valid_call(self):
+        """Alternate well-framed junk with valid calls on one
+        connection; every valid call must still be answered correctly."""
+        from vtpu_manager.kubeletplugin.api import ttrpc_pb2
+        rng = random.Random(SEED + 2)
+        client, conn = _serve_pair()
+        try:
+            for i in range(30):
+                junk = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 40)))
+                client.sendall(
+                    _HEADER.pack(len(junk), 2 * i + 10, MSG_REQUEST, 0)
+                    + junk)
+                _read_response(client)
+                client.sendall(_valid_request_frame(
+                    stream_id=2 * i + 11, payload=str(i).encode()))
+                resp = ttrpc_pb2.Response.FromString(
+                    _read_response(client))
+                assert resp.payload == f"pong:{i}".encode()
+        finally:
+            client.close()
+            conn.close()
+
+
+# --- registry length-prefixed JSON protocol ---------------------------------
+
+
+@pytest.fixture
+def registry_server(tmp_path):
+    from vtpu_manager.registry.server import RegistryServer
+    sock_path = str(tmp_path / "registry.sock")
+    base = tmp_path / "mgr"
+    base.mkdir()
+    server = RegistryServer(
+        socket_path=sock_path, base_dir=str(base),
+        cgroup_of_pid=lambda pid: "",       # every identity unattested
+        pids_in_cgroup=lambda cgroup: [])
+    server.start()
+    yield server, sock_path
+    server.stop()
+
+
+def _registry_roundtrip(sock_path, blob: bytes,
+                        prefix: bytes | None = None) -> int | None:
+    """Send `blob` (with a correct length prefix unless one is given);
+    return the status int, or None for a clean close/no-reply."""
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.settimeout(8)
+    try:
+        c.connect(sock_path)
+        c.sendall((struct.pack("<I", len(blob)) if prefix is None
+                   else prefix) + blob)
+        raw = c.recv(4)
+        if len(raw) < 4:
+            return None
+        return struct.unpack("<i", raw)[0]
+    except socket.timeout:
+        pytest.fail("registry hung: no status and no close")
+    finally:
+        c.close()
+
+
+class TestRegistryProtocolFuzz:
+    def test_seeded_corpus_terminal_outcomes(self, registry_server):
+        """Garbage JSON, non-object JSON, wrong-typed fields, truncated
+        and oversize frames: every connection must end in a status int
+        or a clean close within the timeout, and the server must still
+        answer a well-formed request afterward."""
+        server, sock_path = registry_server
+        rng = random.Random(SEED + 3)
+        type_pool = (None, 0, 1.5, True, [], {}, "x", "a" * 200,
+                     {"nested": 1}, -1, 2**40)
+        for i in range(N_CASES):
+            mode = rng.randrange(6)
+            if mode == 0:          # raw garbage bytes
+                blob = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(0, 80)))
+                _registry_roundtrip(sock_path, blob)
+            elif mode == 1:        # valid JSON, non-object
+                blob = json.dumps(rng.choice(
+                    ([1, 2], "str", 7, None, True))).encode()
+                assert _registry_roundtrip(sock_path, blob) == 1
+            elif mode == 2:        # object with randomly-typed fields
+                payload = {k: rng.choice(type_pool)
+                           for k in rng.sample(
+                               ("pod_uid", "container", "pids", "junk",
+                                "cgroup", "x" * rng.randrange(1, 30)),
+                               rng.randrange(1, 5))}
+                status = _registry_roundtrip(
+                    sock_path, json.dumps(payload).encode())
+                assert status is not None and status != 0
+            elif mode == 3:        # oversize declared length
+                _registry_roundtrip(sock_path, b"",
+                                    prefix=struct.pack("<I", 10 << 20))
+            elif mode == 4:        # truncated: declare more than sent
+                c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                c.settimeout(8)
+                try:
+                    c.connect(sock_path)
+                    c.sendall(struct.pack("<I", 64) + b"short")
+                    c.shutdown(socket.SHUT_WR)
+                    c.recv(4)      # clean close or status — not a hang
+                except socket.timeout:
+                    pytest.fail("registry hung on truncated payload")
+                finally:
+                    c.close()
+            else:                  # short length prefix then close
+                c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    c.connect(sock_path)
+                    c.sendall(b"\x01")
+                finally:
+                    c.close()
+        # the server survived the corpus: a well-formed (unattested)
+        # request still gets its proper status (3 = not attested)
+        good = json.dumps({
+            "pod_uid": "11111111-2222-3333-4444-555555555555",
+            "container": "main"}).encode()
+        assert _registry_roundtrip(sock_path, good) == 3
+
+    def test_slow_loris_write_times_out_not_wedges(self, registry_server):
+        """A client trickling bytes must be cut off by the server's conn
+        timeout without wedging the accept loop."""
+        server, sock_path = registry_server
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(sock_path)
+        c.sendall(struct.pack("<I", 1000) + b"{")
+        # do NOT send the rest; server's settimeout(5) must reap it.
+        # Meanwhile the server keeps answering others:
+        good = json.dumps({
+            "pod_uid": "11111111-2222-3333-4444-555555555555",
+            "container": "main"}).encode()
+        assert _registry_roundtrip(sock_path, good) == 3
+        t0 = time.time()
+        c.settimeout(10)
+        try:
+            raw = c.recv(4)          # server closes (maybe with status)
+            assert len(raw) in (0, 4)
+        except socket.timeout:
+            pytest.fail("slow-loris connection never reaped")
+        finally:
+            c.close()
+        assert time.time() - t0 < 10

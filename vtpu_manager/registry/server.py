@@ -238,10 +238,25 @@ class RegistryServer:
                 data += chunk
             try:
                 payload = json.loads(data)
-            except json.JSONDecodeError:
+            except ValueError:
+                # ValueError covers both JSONDecodeError and the
+                # UnicodeDecodeError raw non-UTF-8 bytes raise (the wire
+                # fuzz found the latter escaping and killing the thread)
                 conn.sendall(struct.pack("<i", 1))
                 return
-            status = self.handle_request(payload, pid)
+            if not isinstance(payload, dict):
+                # valid JSON that is not an object (list/number/string)
+                # would raise inside handle_request and leave the client
+                # hanging with no status byte
+                conn.sendall(struct.pack("<i", 1))
+                return
+            try:
+                status = self.handle_request(payload, pid)
+            except Exception:  # noqa: BLE001 — a handler bug must answer
+                # the client (it blocks on the status int) and must not
+                # kill this connection thread silently
+                log.exception("registry request handler failed")
+                status = 1
             conn.sendall(struct.pack("<i", status))
         except OSError:
             pass
