@@ -77,6 +77,27 @@ class TestShimHermetic:
         assert res.returncode == 0, res.stdout + res.stderr
         assert "ALL PASS" in res.stdout
 
+    def test_malformed_excess_table_is_ignored_not_fatal(self, shim_build,
+                                                         tmp_path):
+        """VTPU_OBS_EXCESS_TABLE crosses a trust boundary (daemon
+        annotation -> kubelet env injection -> C parser in every tenant
+        process), so garbage must degrade to a truncated/empty table —
+        fewer discounts, conservative — never break enforcement. One
+        harness run per corpus entry (LoadDynamicConfig parses at shim
+        init; enforce.cc strtoll loop + point clamp)."""
+        for table in ("garbage", ":::,,,", "1:2,bad:entry,3:4",
+                      ",".join(["99999999999999999999999:9"] * 3),
+                      ",".join(f"{g}:{g % 7}" for g in range(0, 5000, 5))):
+            env = base_env(shim_build, tmp_path)
+            env.update({"VTPU_MEM_LIMIT_0": "1048576",
+                        "VTPU_CORE_LIMIT_0": "50",
+                        "VTPU_OBS_EXCESS_TABLE": table})
+            res = subprocess.run([shim_build["test"]], env=env,
+                                 timeout=120, capture_output=True,
+                                 text=True)
+            assert res.returncode == 0, (table, res.stdout, res.stderr)
+            assert "ALL PASS" in res.stdout, table
+
     def test_python_written_config_file(self, shim_build, tmp_path):
         from vtpu_manager.config import vtpu_config as vc
         cfg = vc.VtpuConfig(
