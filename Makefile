@@ -78,8 +78,12 @@ watch-tpu: ## Background tunnel watcher: probes health, fires the capture on rec
 ##@ Deploy
 
 .PHONY: chart
-chart: ## Render the Helm chart to stdout
-	helm template vtpu-manager charts/vtpu-manager
+chart: ## Render the Helm chart to stdout (helm, or the certified subset renderer)
+	@if command -v helm >/dev/null 2>&1; then \
+	  helm template vtpu-manager charts/vtpu-manager; \
+	else \
+	  python scripts/render_chart.py; \
+	fi
 
 .PHONY: images
 images: ## Build container images (device plugin stack + DRA driver)

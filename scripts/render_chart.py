@@ -1,0 +1,50 @@
+#!/usr/bin/env python3
+"""Render charts/vtpu-manager to stdout with the certified subset
+renderer — the `make chart` fallback for machines without helm (this CI
+image). Where helm exists its output should be YAML-equal (the renderer
+is certified construct-by-construct in tests/test_chart_templates.py;
+see scripts/regen_chart_goldens.py for the certification story).
+
+Usage: python scripts/render_chart.py [--profile defaults|everything-on]
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from test_chart_templates import ALL_ON, CHART, _values, render  # noqa: E402
+
+
+def main() -> int:
+    # a pager/head closing the pipe must end the render cleanly, like
+    # helm does, not with a BrokenPipeError traceback
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--profile", default="defaults",
+                        choices=("defaults", "everything-on"))
+    parser.add_argument("--release-name", default="vtpu-manager",
+                        help="matches `helm template vtpu-manager ...`")
+    parser.add_argument("--namespace", default="default")
+    args = parser.parse_args()
+    values = _values(ALL_ON if args.profile == "everything-on" else None)
+    tdir = os.path.join(CHART, "templates")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".yaml"):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            rendered = render(f.read(), values,
+                              release_name=args.release_name,
+                              namespace=args.namespace).strip("\n")
+        if not rendered.strip():
+            continue               # helm omits whitespace-only manifests
+        print(f"---\n# Source: vtpu-manager/templates/{name}")
+        print(rendered)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

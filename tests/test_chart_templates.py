@@ -98,9 +98,10 @@ def _eval(expr: str, ctx: dict) -> str:
     return out
 
 
-def render(text: str, values: dict) -> str:
+def render(text: str, values: dict, release_name: str = "rel",
+           namespace: str = "vtpu-system") -> str:
     root = {"Values": values,
-            "Release": {"Name": "rel", "Namespace": "vtpu-system"}}
+            "Release": {"Name": release_name, "Namespace": namespace}}
     ctx = {"$": root}
     out_lines = []
     # stack of [emitting, saved_ctx_or_None (with blocks restore scope)]
