@@ -77,6 +77,19 @@ def tenant_env(tmp_path, pod_uid, quota, iters, shared, extra=None):
     return env
 
 
+def chip_world(tmp_path) -> str:
+    """Fresh shared-chip world: zeroed chip.state + empty vmem ledger +
+    empty tc_util feed. One home for the setup three scenarios repeat —
+    the 16-byte state header must change in exactly one place."""
+    shared = str(tmp_path / "chip.state")
+    VmemLedger(str(tmp_path / "vmem.config"), create=True).close()
+    tc_watcher.TcUtilFile(str(tmp_path / "tc_util.config"),
+                          create=True).close()
+    with open(shared, "wb") as f:
+        f.write(b"\0" * 16)
+    return shared
+
+
 def test_two_tenants_share_one_chip(shim_build, tmp_path):
     shared = str(tmp_path / "chip.state")
     tc_path = str(tmp_path / "tc_util.config")
@@ -139,12 +152,7 @@ def test_two_tenants_on_recorded_transport_pathology(shim_build, tmp_path):
     import bench
     regime = bench.read_trace_env(os.path.join(
         REPO, "library", "test", "traces", "v5e_r2_transport.env"))
-    shared = str(tmp_path / "chip.state")
-    VmemLedger(str(tmp_path / "vmem.config"), create=True).close()
-    tc_watcher.TcUtilFile(str(tmp_path / "tc_util.config"),
-                          create=True).close()
-    with open(shared, "wb") as f:
-        f.write(b"\0" * 16)
+    shared = chip_world(tmp_path)
     extra = {
         "FAKE_GAP_EXCESS_TABLE": regime["FAKE_GAP_EXCESS_TABLE"],
         "VTPU_OBS_EXCESS_TABLE": regime["FAKE_GAP_EXCESS_TABLE"],
@@ -160,16 +168,35 @@ def test_two_tenants_on_recorded_transport_pathology(shim_build, tmp_path):
 
 def test_unequal_quotas_bias_the_chip(shim_build, tmp_path):
     """75% vs 25%: the high-quota tenant must finish first (same demand)."""
-    shared = str(tmp_path / "chip.state")
-    VmemLedger(str(tmp_path / "vmem.config"), create=True).close()
-    tc_watcher.TcUtilFile(str(tmp_path / "tc_util.config"),
-                          create=True).close()
-    with open(shared, "wb") as f:
-        f.write(b"\0" * 16)
+    shared = chip_world(tmp_path)
     iters = 300
     walls = run_tenants(tmp_path, [("uid-hi", 75), ("uid-lo", 25)],
                         shared, iters)
     assert walls["uid-hi"] < walls["uid-lo"], walls
+
+def test_three_tenants_quota_ordering(shim_build, tmp_path):
+    """N>2 alternation (the reference caps tenants per GPU at
+    device-split count, not 2): three tenants at 60/25/10% with equal
+    demand must complete in quota order on the serialized chip, and all
+    must finish — a 3-way flock rotation cannot starve the smallest
+    quota. Demand is sized down (150 x 2 ms each) to keep the
+    chip-serialized floor ~0.9 s on the 1-CPU box."""
+    shared = chip_world(tmp_path)
+    walls = run_tenants(
+        tmp_path, [("uid-hi", 60), ("uid-mid", 25), ("uid-lo", 10)],
+        shared, iters=150)
+    assert walls["uid-hi"] < walls["uid-mid"] < walls["uid-lo"], walls
+    # per-tenant floors, not a shared one: the fastest tenant exits
+    # BEFORE the others' demand serializes behind it, so only its own
+    # quota pacing binds it (300 ms busy / 0.60 = 500 ms, minus the
+    # startup burst credit); the 10% tenant must absorb its own pacing
+    # (~3 s) — far above the 900 ms full-serialization floor
+    assert walls["uid-hi"] >= 350, walls
+    assert walls["uid-lo"] >= 2000, walls
+    # ...but paced, not starved: runaway starvation in a 3-way flock
+    # rotation would blow far past the 10% budget's own ~3 s
+    assert walls["uid-lo"] <= 15000, walls
+
 
 class TestHbmCoTenancy:
     """Admission semantics: a tenant's cap is its own; co-tenants only
