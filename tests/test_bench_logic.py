@@ -62,13 +62,16 @@ class TestMfuCapture:
             bench, "run_mfu_worker",
             lambda quota, no_shim=False, obs_excess_table=None:
             next(seq[(quota, no_shim)]))
-        out = bench.run_mfu_capture(None, reps=2)
+        out = bench.run_mfu_capture(reps=2)
         assert out["tflops_shim_off"] == 120.0
         assert out["tflops_shim_on"] == 118.0
         assert out["mfu_shim_on_over_off"] == pytest.approx(
             118.0 / 120.0, abs=1e-4)
-        assert out["mfu_pct_at_q50"] == 30.0
-        assert out["q50_delivered_share_pct"] == pytest.approx(
+        # q50 is its own separately-persisted capture section; the
+        # delivered-share ratio uses the pair's persisted tflops
+        out50 = bench.run_mfu_q50(None, out["tflops_shim_on"], reps=2)
+        assert out50["mfu_pct_at_q50"] == 30.0
+        assert out50["q50_delivered_share_pct"] == pytest.approx(
             100.0 * 60.0 / 118.0, abs=0.01)
 
     def test_missing_side_degrades_gracefully(self, monkeypatch):
@@ -79,7 +82,7 @@ class TestMfuCapture:
                 return None
             return {"tflops": 118.0, "mfu_pct": 59.0}
         monkeypatch.setattr(bench, "run_mfu_worker", worker)
-        out = bench.run_mfu_capture(None, reps=1)
+        out = bench.run_mfu_capture(reps=1)
         assert out["mfu_pct_shim_on"] == 59.0
         assert "mfu_pct_shim_off" not in out
         assert "mfu_shim_on_over_off" not in out

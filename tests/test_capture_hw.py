@@ -40,6 +40,10 @@ def fake_bench(monkeypatch, tmp_path):
             "tflops_shim_off": 118.2, "tflops_shim_on": 117.2,
             "mfu_shim_on_over_off": 0.9915})
     monkeypatch.setattr(
+        bench, "run_mfu_q50",
+        lambda table, tflops_on, **k: calls.append("mfu_q50") or {
+            "mfu_pct_at_q50": 29.8, "q50_delivered_share_pct": 50.3})
+    monkeypatch.setattr(
         bench, "paired_quota_sweep",
         lambda quotas, table, reps: (
             calls.append("quotas") or
@@ -238,7 +242,7 @@ def test_unhealthy_tunnel_aborts_cleanly(fake_bench, tmp_path,
 def _complete_capture_dict():
     return {
         "value": 1.0, "mfu_pct_shim_on": 59.0, "mfu_pct_shim_off": 60.0,
-        "shim_overhead_pct": 0.5,
+        "mfu_pct_at_q50": 29.8, "shim_overhead_pct": 0.5,
         "detail": {"mae_pct": 1.0, "hbm_cap": "exact",
                    "balance_mode": {"climbed": True},
                    "vtpu_busy_convergence": {"in_band": True},
