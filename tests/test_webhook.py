@@ -184,6 +184,59 @@ class TestAdmissionHTTP:
         asyncio.run(scenario())
 
 
+class TestMalformedBodyFuzz:
+    """The webhook is an HTTPS endpoint on the pod network — anything
+    in-cluster can POST garbage. Failure semantics must hold under
+    malformed bodies (same seeded-corpus discipline as
+    tests/test_transport_fuzz.py): mutate fails OPEN (an outage must
+    not block pods), validate fails CLOSED, the server answers every
+    request and keeps serving well-formed reviews afterward."""
+
+    CORPUS = (b"", b"not json at all", b"\xff\xfe\x80",
+              b"[1, 2, 3]", b'"just a string"', b"null",
+              b'{"request": 7}', b'{"request": {"object": []}}',
+              b'{"request": {"uid": {"nested": 1}, "object": 3}}',
+              b'{"request": {"object": {"spec": "notdict"}}}')
+
+    def test_mutate_fails_open_validate_fails_closed(self):
+        from aiohttp.test_utils import TestClient, TestServer
+        from vtpu_manager.webhook.server import WebhookAPI
+
+        async def scenario():
+            api = WebhookAPI()
+            async with TestClient(TestServer(api.build_app())) as client:
+                for blob in self.CORPUS:
+                    for path, open_on_error in (("/pods/mutate", True),
+                                                ("/pods/validate", False)):
+                        resp = await client.post(
+                            path, data=blob,
+                            headers={"Content-Type": "application/json"})
+                        assert resp.status == 200, (path, blob)
+                        body = await resp.json()
+                        allowed = body["response"]["allowed"]
+                        # some corpus entries are parseable-but-empty
+                        # reviews: an empty pod mutates/validates fine
+                        # (allowed) — the invariant is that mutate is
+                        # NEVER denied and the server never 500s
+                        if open_on_error:
+                            assert allowed is True, (path, blob, body)
+                # still serves a real review after the whole corpus
+                review = {"request": {"uid": "after-fuzz",
+                                      "object": vtpu_pod()}}
+                resp = await client.post("/pods/mutate", json=review)
+                body = await resp.json()
+                assert body["response"]["uid"] == "after-fuzz"
+                assert body["response"]["allowed"]
+                resp = await client.post(
+                    "/pods/validate",
+                    json={"request": {"uid": "x",
+                                      "object": vtpu_pod(cores=200)}})
+                body = await resp.json()
+                assert body["response"]["allowed"] is False
+
+        asyncio.run(scenario())
+
+
 class TestDraConversion:
     def test_converts_resources_to_claims(self):
         from vtpu_manager.webhook.dra_convert import convert_pod_to_dra
