@@ -187,16 +187,23 @@ class TestAdmissionHTTP:
 class TestMalformedBodyFuzz:
     """The webhook is an HTTPS endpoint on the pod network — anything
     in-cluster can POST garbage. Failure semantics must hold under
-    malformed bodies (same seeded-corpus discipline as
-    tests/test_transport_fuzz.py): mutate fails OPEN (an outage must
-    not block pods), validate fails CLOSED, the server answers every
-    request and keeps serving well-formed reviews afterward."""
+    malformed bodies: mutate fails OPEN (an outage must not block
+    pods), validate fails CLOSED, the server answers every request and
+    keeps serving well-formed reviews afterward. A hand-written
+    shape corpus covers the parse branch points; a seeded mutation
+    sweep (the transport-fuzz discipline) covers the space between."""
 
-    CORPUS = (b"", b"not json at all", b"\xff\xfe\x80",
-              b"[1, 2, 3]", b'"just a string"', b"null",
-              b'{"request": 7}', b'{"request": {"object": []}}',
-              b'{"request": {"uid": {"nested": 1}, "object": 3}}',
-              b'{"request": {"object": {"spec": "notdict"}}}')
+    # (blob, is_error): is_error entries raise inside the handlers, so
+    # mutate must allow (fail OPEN) and validate must DENY (fail
+    # CLOSED); non-error entries parse to an empty/benign review, which
+    # both endpoints legitimately allow
+    CORPUS = ((b"", True), (b"not json at all", True),
+              (b"\xff\xfe\x80", True),
+              (b"[1, 2, 3]", True), (b'"just a string"', True),
+              (b"null", True), (b'{"request": 7}', True),
+              (b'{"request": {"object": []}}', False),
+              (b'{"request": {"uid": {"nested": 1}, "object": 3}}', True),
+              (b'{"request": {"object": {"spec": "notdict"}}}', True))
 
     def test_mutate_fails_open_validate_fails_closed(self):
         from aiohttp.test_utils import TestClient, TestServer
@@ -205,7 +212,7 @@ class TestMalformedBodyFuzz:
         async def scenario():
             api = WebhookAPI()
             async with TestClient(TestServer(api.build_app())) as client:
-                for blob in self.CORPUS:
+                for blob, is_error in self.CORPUS:
                     for path, open_on_error in (("/pods/mutate", True),
                                                 ("/pods/validate", False)):
                         resp = await client.post(
@@ -214,12 +221,12 @@ class TestMalformedBodyFuzz:
                         assert resp.status == 200, (path, blob)
                         body = await resp.json()
                         allowed = body["response"]["allowed"]
-                        # some corpus entries are parseable-but-empty
-                        # reviews: an empty pod mutates/validates fine
-                        # (allowed) — the invariant is that mutate is
-                        # NEVER denied and the server never 500s
                         if open_on_error:
+                            # mutate is NEVER denied — not even on junk
                             assert allowed is True, (path, blob, body)
+                        elif is_error:
+                            # the fail-CLOSED invariant, per entry
+                            assert allowed is False, (path, blob, body)
                 # still serves a real review after the whole corpus
                 review = {"request": {"uid": "after-fuzz",
                                       "object": vtpu_pod()}}
@@ -233,6 +240,54 @@ class TestMalformedBodyFuzz:
                                       "object": vtpu_pod(cores=200)}})
                 body = await resp.json()
                 assert body["response"]["allowed"] is False
+
+        asyncio.run(scenario())
+
+    def test_seeded_mutations_of_a_valid_review(self):
+        """Seeded byte-level mutations (truncation, flips, splices) of
+        a well-formed AdmissionReview: every one gets a 200 with mutate
+        allowed (fail-open covers both the junk-raises and the
+        accidentally-still-valid outcomes), and the server survives the
+        sweep."""
+        import random
+
+        from aiohttp.test_utils import TestClient, TestServer
+        from vtpu_manager.webhook.server import WebhookAPI
+
+        rng = random.Random(0xFEED)
+        base = json.dumps({"request": {"uid": "u", "object": vtpu_pod()}}
+                          ).encode()
+
+        def mutate_blob() -> bytes:
+            blob = bytearray(base)
+            for _ in range(rng.randrange(1, 6)):
+                kind = rng.randrange(3)
+                if kind == 0 and len(blob) > 2:          # truncate
+                    del blob[rng.randrange(1, len(blob)):]
+                elif kind == 1 and blob:                 # flip a byte
+                    blob[rng.randrange(len(blob))] = rng.randrange(256)
+                else:                                    # splice junk
+                    at = rng.randrange(len(blob) + 1)
+                    blob[at:at] = bytes(rng.randrange(256) for _ in
+                                        range(rng.randrange(1, 8)))
+            return bytes(blob)
+
+        async def scenario():
+            api = WebhookAPI()
+            async with TestClient(TestServer(api.build_app())) as client:
+                for _ in range(120):
+                    resp = await client.post(
+                        "/pods/mutate", data=mutate_blob(),
+                        headers={"Content-Type": "application/json"})
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["response"]["allowed"] is True
+                resp = await client.post(
+                    "/pods/mutate",
+                    json={"request": {"uid": "post-sweep",
+                                      "object": vtpu_pod()}})
+                body = await resp.json()
+                assert body["response"]["uid"] == "post-sweep"
 
         asyncio.run(scenario())
 
