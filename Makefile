@@ -12,7 +12,7 @@ PYTEST ?= python -m pytest
 CONTAINER_TOOL ?= docker
 
 .PHONY: all
-all: build
+all: build lint
 
 ##@ General
 
@@ -44,9 +44,25 @@ clean: ## Remove build artifacts
 
 ##@ Test
 
+.PHONY: lint
+lint: ## Project-native static analysis (vtlint) + ruff baseline when available
+	python scripts/vtlint.py vtpu_manager/
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check .; \
+	else \
+	  echo "ruff not installed; vtlint-only (baseline config in pyproject.toml)"; \
+	fi
+
+.PHONY: lint-golden
+lint-golden: ## Regenerate the golden ABI layout (the explicit bump for intentional layout changes)
+	python scripts/vtlint.py --update-abi-golden
+
 .PHONY: test
 test: build ## Full hermetic suite (pytest; includes the C harness via fixtures)
 	$(PYTEST) tests/ -x -q
+
+.PHONY: verify
+verify: lint test ## Default verify flow: static analysis, then the suite
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -31,6 +31,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--plugin-dir",
                         default="/var/lib/kubelet/device-plugins")
     parser.add_argument("--base-dir", default=None)
+    parser.add_argument("--registry-socket", default=None,
+                        help="ClientMode registry socket (default: the "
+                             "path tenants mount; override for non-root "
+                             "dev runs alongside --base-dir)")
+    parser.add_argument("--vmem-path", default=None,
+                        help="vmem ledger file (default: the path "
+                             "tenants mount; override for non-root dev "
+                             "runs alongside --base-dir)")
     parser.add_argument("--id-store",
                         default="/etc/vtpu-manager/device_ids.json")
     parser.add_argument("--fake-chips", type=int, default=0,
@@ -55,13 +63,18 @@ def main(argv: list[str] | None = None) -> int:
                                                      HealthWatcher)
     from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import (CORE_PLUGIN,
+    from vtpu_manager.util.featuregates import (CLIENT_MODE, CORE_PLUGIN,
                                                 HONOR_PREALLOC_IDS,
                                                 MEMORY_PLUGIN, RESCHEDULE,
-                                                TC_WATCHER, FeatureGates)
+                                                TC_WATCHER, TPU_TOPOLOGY,
+                                                VMEMORY_NODE, FeatureGates)
 
     gates = FeatureGates()
-    gates.parse(args.feature_gates)
+    try:
+        gates.parse(args.feature_gates)
+    except ValueError as e:
+        log.error("bad --feature-gates: %s", e)
+        return 2
 
     if not args.node_name:
         log.error("--node-name or NODE_NAME required")
@@ -115,7 +128,11 @@ def main(argv: list[str] | None = None) -> int:
     manager = DeviceManager(
         args.node_name, client, node_config=node_config,
         id_store=DeviceIDStore(args.id_store), backends=backends,
-        mesh_domain=args.mesh_domain)
+        # TPUTopology (default on): gates the mesh-domain annotation that
+        # drives cross-node gang affinity; =false keeps non-ICI nodes out
+        # of slice-aware placement
+        mesh_domain=args.mesh_domain if gates.enabled(TPU_TOPOLOGY)
+        else "")
     chips = manager.init_devices()
     log.info("discovered %d chip(s): %s", len(chips),
              [c.uuid for c in chips])
@@ -174,9 +191,38 @@ def main(argv: list[str] | None = None) -> int:
     health = HealthWatcher(manager, device_node_probe)
     health.start()
 
+    # VMemoryNode: pre-create the cross-process vmem ledger so container
+    # shims can map it from their first allocation (the TC watcher also
+    # creates it lazily, but that couples the ledger to the watcher gate)
+    vmem_path = args.vmem_path or consts.VMEM_NODE_CONFIG
+    if gates.enabled(VMEMORY_NODE):
+        from vtpu_manager.config.vmem import VmemLedger
+        try:
+            VmemLedger(vmem_path, create=True).close()
+            log.info("vmem ledger ready at %s", vmem_path)
+        except (OSError, ValueError) as e:
+            log.warning("vmem ledger init failed: %s", e)
+
+    # ClientMode: serve the registry socket for in-container pid
+    # attribution (shims register their pids; kernel-attested via
+    # SO_PEERCRED + cgroup check)
+    registry_srv = None
+    if gates.enabled(CLIENT_MODE):
+        from vtpu_manager.registry.server import RegistryServer
+        registry_srv = RegistryServer(
+            socket_path=args.registry_socket or consts.REGISTRY_SOCKET,
+            base_dir=args.base_dir or consts.MANAGER_BASE_DIR)
+        try:
+            registry_srv.start()
+        except OSError as e:
+            log.warning("registry socket unavailable (%s); client-mode "
+                        "pid attribution disabled", e)
+            registry_srv = None
+
     watcher = None
     if gates.enabled(TC_WATCHER):
-        watcher = TcWatcherDaemon([c.index for c in chips], FakeSampler())
+        watcher = TcWatcherDaemon([c.index for c in chips], FakeSampler(),
+                                  vmem_path=vmem_path)
         if manager.obs_excess_table is not None:
             # live channel for the startup calibration; a later manual
             # recalibration (python -m vtpu_manager.manager.obs_calibrate
@@ -208,6 +254,8 @@ def main(argv: list[str] | None = None) -> int:
             server.stop()
         if watcher:
             watcher.stop()
+        if registry_srv:
+            registry_srv.stop()
         if controller:
             controller.stop()
         health.stop()

@@ -54,10 +54,16 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.preempt import PreemptPredicate
     from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
     from vtpu_manager.scheduler.serial import SerialLocker
-    from vtpu_manager.util.featuregates import SERIAL_BIND_NODE, FeatureGates
+    from vtpu_manager.util.featuregates import (SERIAL_BIND_NODE,
+                                                SERIAL_FILTER_NODE,
+                                                FeatureGates)
 
     gates = FeatureGates()
-    gates.parse(args.feature_gates)
+    try:
+        gates.parse(args.feature_gates)
+    except ValueError as e:
+        logging.getLogger(__name__).error("bad --feature-gates: %s", e)
+        return 2
 
     if args.fake_client:
         from vtpu_manager.client.fake import FakeKubeClient
@@ -73,7 +79,12 @@ def main(argv: list[str] | None = None) -> int:
 
     bind_locker = SerialLocker(gates.enabled(SERIAL_BIND_NODE))
     api = SchedulerAPI(
+        # SerialFilterNode (default on, matching FilterPredicate's own
+        # default): --feature-gates=SerialFilterNode=false trades the
+        # double-booking defense for raw filter throughput (the assumed
+        # cache still covers committed placements)
         FilterPredicate(client,
+                        serialize=gates.enabled(SERIAL_FILTER_NODE),
                         require_node_label=args.require_node_label,
                         pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0,
                         nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0),

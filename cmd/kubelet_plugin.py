@@ -39,7 +39,13 @@ def main(argv: list[str] | None = None) -> int:
                              "across both stacks")
     parser.add_argument("--nri-socket", default="",
                         help="NRI runtime socket (e.g. /var/run/nri/"
-                             "nri.sock); empty disables the NRI stub")
+                             "nri.sock); empty disables the NRI stub "
+                             "unless --feature-gates=NRISupport=true "
+                             "selects the default socket")
+    parser.add_argument("--feature-gates", default="",
+                        help="k8s-style gate spec (NRISupport=true "
+                             "attaches the NRI runtime hook on the "
+                             "default socket)")
     parser.add_argument("--health-probe-cmd", default="",
                         help="external per-chip health probe: invoked as "
                              "<cmd> <index> <uuid>, exit 0 = healthy "
@@ -68,6 +74,19 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.kubeletplugin.driver import ClaimSource, DraDriver
     from vtpu_manager.tpu.discovery import FakeBackend, discover
     from vtpu_manager.util import consts
+    from vtpu_manager.util.featuregates import NRI_SUPPORT, FeatureGates
+
+    gates = FeatureGates()
+    try:
+        gates.parse(args.feature_gates)
+    except ValueError as e:
+        log.error("bad --feature-gates: %s", e)
+        return 2
+    if gates.enabled(NRI_SUPPORT) and not args.nri_socket:
+        # the gate is the declarative way to ask for the runtime hook;
+        # --nri-socket stays as the explicit/override form
+        from vtpu_manager.kubeletplugin.nri_transport import DEFAULT_SOCKET
+        args.nri_socket = DEFAULT_SOCKET
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None

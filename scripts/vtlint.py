@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""vtlint CLI: project-native static analysis for vtpu-manager.
+
+Usage:
+    python scripts/vtlint.py vtpu_manager/            # lint (human output)
+    python scripts/vtlint.py --json vtpu_manager/     # machine output
+    python scripts/vtlint.py --list-rules
+    python scripts/vtlint.py --update-abi-golden      # explicit ABI bump
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vtpu_manager.analysis import all_rules, run_analysis          # noqa: E402
+from vtpu_manager.analysis.core import (load_project, render_human,  # noqa: E402
+                                        render_json)
+from vtpu_manager.analysis.rules import abi_drift                  # noqa: E402
+
+
+def _update_abi_golden(paths: list[str], golden: str | None) -> int:
+    project, errors = load_project(paths)
+    for err in errors:
+        print(err.render(), file=sys.stderr)
+    if errors:
+        # never rewrite the golden from a tree that did not fully parse —
+        # a partial golden would later misreport the bump as missing
+        print("vtlint: refusing to update the golden with parse errors",
+              file=sys.stderr)
+        return 2
+    layout = abi_drift.compute_layout(project)
+    missing = sorted(set(abi_drift.TRACKED) - set(layout))
+    if missing:
+        print(f"vtlint: tracked ABI module(s) {', '.join(missing)} not "
+              f"under {', '.join(paths)}; the golden must cover all of "
+              f"them — run against the package root", file=sys.stderr)
+        return 2
+    path = golden or str(abi_drift.DEFAULT_GOLDEN)
+    with open(path, "w") as f:
+        json.dump(layout, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"vtlint: wrote golden ABI layout to {path} "
+          f"({', '.join(sorted(layout))})")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vtlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to lint "
+                             "(default: vtpu_manager/)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="JSON output")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule names to run "
+                             "(default: all)")
+    parser.add_argument("--disable", default="",
+                        help="comma-separated rule names to skip")
+    parser.add_argument("--abi-golden", default=None,
+                        help="override the golden ABI layout file")
+    parser.add_argument("--update-abi-golden", action="store_true",
+                        help="recompute the golden ABI layout from the "
+                             "tree and write it (the explicit bump step "
+                             "for intentional layout changes)")
+    args = parser.parse_args(argv)
+
+    rules = all_rules(abi_golden=args.abi_golden)
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.name:22s} {rule.description}")
+        return 0
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [os.path.join(repo_root, "vtpu_manager")]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"vtlint: no such path: {path}", file=sys.stderr)
+            return 2
+
+    if args.update_abi_golden:
+        return _update_abi_golden(paths, args.abi_golden)
+
+    selected = {r.strip() for r in args.select.split(",") if r.strip()}
+    disabled = {r.strip() for r in args.disable.split(",") if r.strip()}
+    known = {r.name for r in rules}
+    unknown = (selected | disabled) - known
+    if unknown:
+        # a typo here must NOT silently select zero rules and pass green
+        print(f"vtlint: unknown rule(s): {', '.join(sorted(unknown))} "
+              f"(see --list-rules)", file=sys.stderr)
+        return 2
+    if selected:
+        rules = [r for r in rules if r.name in selected]
+    rules = [r for r in rules if r.name not in disabled]
+
+    findings = run_analysis(paths, rules)
+    print(render_json(findings) if args.as_json
+          else render_human(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,675 @@
+"""vtlint self-tests: per-rule fixtures (positive / negative / suppression)
+plus the meta-tests that keep the live tree clean and the golden ABI in
+lockstep with the real layout modules.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from vtpu_manager.analysis import all_rules, run_analysis
+from vtpu_manager.analysis.core import load_project
+from vtpu_manager.analysis.rules import abi_drift
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "vtpu_manager")
+VTLINT = os.path.join(REPO, "scripts", "vtlint.py")
+
+
+def lint(tmp_path, files: dict[str, str], select: set[str] | None = None,
+         golden: str | None = None):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    rules = all_rules(abi_golden=golden)
+    if select is not None:
+        rules = [r for r in rules if r.name in select]
+    return run_analysis([str(tmp_path)], rules)
+
+
+def rules_hit(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+
+
+class TestLockDiscipline:
+    SELECT = {"lock-discipline"}
+
+    def test_direct_sleep_under_lock(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            import threading, time
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        time.sleep(1)
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"lock-discipline"}
+        assert "time.sleep" in findings[0].message
+
+    def test_transitive_blocking_through_helper(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            import subprocess, threading
+
+            class A:
+                def f(self):
+                    with self._lock:
+                        self._helper()
+
+                def _helper(self):
+                    subprocess.run(["true"])
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"lock-discipline"}
+        assert "_helper" in findings[0].message
+
+    def test_closure_reference_taints_caller(self, tmp_path):
+        # a closure handed to a runner (the filter.py _ttl_cached shape)
+        findings = lint(tmp_path, {"mod.py": """
+            class A:
+                def outer(self):
+                    with self._lock:
+                        self.build()
+
+                def build(self):
+                    def fetch():
+                        return self.client.list_pods()
+                    return self.runner(fetch)
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"lock-discipline"}
+
+    def test_lock_in_closure_resolves_sibling_methods(self, tmp_path):
+        # the lock region lives in a nested closure; the blocking helper
+        # is a sibling METHOD — resolution must go through the class, not
+        # the closure's qualname prefix
+        findings = lint(tmp_path, {"mod.py": """
+            import time
+
+            class A:
+                def slow(self):
+                    time.sleep(1)
+
+                def run(self):
+                    def inner():
+                        with self._lock:
+                            self.slow()
+                    return inner
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"lock-discipline"}
+        assert "slow" in findings[0].message
+
+    def test_api_client_call_under_lock(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            class A:
+                def f(self):
+                    with self._serial_lock:
+                        self.client.patch_pod_annotations("ns", "n", {})
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"lock-discipline"}
+
+    def test_module_level_lock_region_checked(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            import threading, time
+
+            _lock = threading.Lock()
+            with _lock:
+                time.sleep(5)
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"lock-discipline"}
+
+    def test_negative_sleep_outside_lock(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            import time
+
+            class A:
+                def f(self):
+                    with self._lock:
+                        self.x = 1
+                    time.sleep(1)
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            import time
+
+            class A:
+                def f(self):
+                    with self._lock:
+                        # vtlint: disable=lock-discipline — test fixture
+                        time.sleep(1)
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_inconsistent_lock_order(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            class A:
+                def f(self):
+                    with self._alpha_lock:
+                        with self._beta_lock:
+                            pass
+
+                def g(self):
+                    with self._beta_lock:
+                        with self._alpha_lock:
+                            pass
+            """}, select=self.SELECT)
+        assert len(findings) == 2
+        assert all("inconsistent lock order" in f.message
+                   for f in findings)
+
+    def test_consistent_lock_order_clean(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            class A:
+                def f(self):
+                    with self._alpha_lock:
+                        with self._beta_lock:
+                            pass
+
+                def g(self):
+                    with self._alpha_lock:
+                        with self._beta_lock:
+                            pass
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_order_via_called_function(self, tmp_path):
+        # one level of propagation: f holds l1 and calls g which takes l2,
+        # h nests them the other way around
+        findings = lint(tmp_path, {"mod.py": """
+            class A:
+                def f(self):
+                    with self._alpha_lock:
+                        self.g()
+
+                def g(self):
+                    with self._beta_lock:
+                        pass
+
+                def h(self):
+                    with self._beta_lock:
+                        with self._alpha_lock:
+                            pass
+            """}, select=self.SELECT)
+        assert len(findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# seqlock-protocol
+
+_GOOD_WRITER = """
+    import struct
+    from vtpu_manager.util.flock import byte_range_write_lock
+
+    class W:
+        def write(self, off, val):
+            with byte_range_write_lock(self._fd, off, 8):
+                seq, = struct.unpack_from("<Q", self._mm, off)
+                wseq = seq | 1
+                struct.pack_into("<Q", self._mm, off, wseq)
+                struct.pack_into("<Q", self._mm, off + 8, val)
+                struct.pack_into("<Q", self._mm, off, wseq + 1)
+    """
+
+_GOOD_READER = """
+    import struct, time
+
+    class R:
+        def read(self, off):
+            for _ in range(8):
+                seq1, = struct.unpack_from("<Q", self._mm, off)
+                if seq1 & 1:
+                    time.sleep(0.0002)
+                    continue
+                val, = struct.unpack_from("<Q", self._mm, off + 8)
+                seq2, = struct.unpack_from("<Q", self._mm, off)
+                if seq1 == seq2:
+                    return val
+            return None
+    """
+
+
+class TestSeqlockProtocol:
+    SELECT = {"seqlock-protocol"}
+
+    def test_good_writer_and_reader_clean(self, tmp_path):
+        findings = lint(tmp_path, {"w.py": _GOOD_WRITER,
+                                   "r.py": _GOOD_READER},
+                        select=self.SELECT)
+        assert findings == []
+
+    def test_missing_bracket(self, tmp_path):
+        findings = lint(tmp_path, {"w.py": """
+            import struct
+            from vtpu_manager.util.flock import byte_range_write_lock
+
+            class W:
+                def write(self, off, val):
+                    with byte_range_write_lock(self._fd, off, 8):
+                        struct.pack_into("<Q", self._mm, off + 8, val)
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"seqlock-protocol"}
+        assert "without a seqlock bracket" in findings[0].message
+
+    def test_plus_one_parity_inversion(self, tmp_path):
+        src = _GOOD_WRITER.replace("seq | 1", "seq + 1")
+        findings = lint(tmp_path, {"w.py": src}, select=self.SELECT)
+        assert any("inverts parity" in f.message for f in findings)
+
+    def test_missing_even_bump(self, tmp_path):
+        src = _GOOD_WRITER.replace(
+            '                struct.pack_into("<Q", self._mm, off, '
+            'wseq + 1)\n', "")
+        findings = lint(tmp_path, {"w.py": src}, select=self.SELECT)
+        assert any("never returns the seq to even" in f.message
+                   for f in findings)
+
+    def test_write_after_even_bump(self, tmp_path):
+        findings = lint(tmp_path, {"w.py": """
+            import struct
+            from vtpu_manager.util.flock import byte_range_write_lock
+
+            class W:
+                def write(self, off, val):
+                    with byte_range_write_lock(self._fd, off, 8):
+                        seq, = struct.unpack_from("<Q", self._mm, off)
+                        wseq = seq | 1
+                        struct.pack_into("<Q", self._mm, off, wseq)
+                        struct.pack_into("<Q", self._mm, off, wseq + 1)
+                        struct.pack_into("<Q", self._mm, off + 8, val)
+            """}, select=self.SELECT)
+        assert any("after the seq was bumped even" in f.message
+                   for f in findings)
+
+    def test_reader_no_retry_loop(self, tmp_path):
+        findings = lint(tmp_path, {"r.py": """
+            import struct
+
+            class R:
+                def read(self, off):
+                    seq1, = struct.unpack_from("<Q", self._mm, off)
+                    if seq1 & 1:
+                        return None
+                    return struct.unpack_from("<Q", self._mm, off + 8)
+            """}, select=self.SELECT)
+        assert any("outside a retry loop" in f.message for f in findings)
+
+    def test_reader_missing_recheck(self, tmp_path):
+        findings = lint(tmp_path, {"r.py": """
+            import struct
+
+            class R:
+                def read(self, off):
+                    for _ in range(8):
+                        seq1, = struct.unpack_from("<Q", self._mm, off)
+                        if seq1 & 1:
+                            continue
+                        return struct.unpack_from("<Q", self._mm, off + 8)
+                    return None
+            """}, select=self.SELECT)
+        assert any("second seq read" in f.message for f in findings)
+
+    def test_suppression(self, tmp_path):
+        findings = lint(tmp_path, {"w.py": """
+            import struct
+            from vtpu_manager.util.flock import byte_range_write_lock
+
+            class W:
+                def write(self, off, val):
+                    # vtlint: disable=seqlock-protocol — fixture
+                    with byte_range_write_lock(self._fd, off, 8):
+                        struct.pack_into("<Q", self._mm, off + 8, val)
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# abi-drift
+
+
+class TestAbiDrift:
+    SELECT = {"abi-drift"}
+
+    def _real(self, name: str) -> str:
+        with open(os.path.join(PKG, "config", name)) as f:
+            return f.read()
+
+    def test_pristine_copies_match_golden(self, tmp_path):
+        findings = lint(tmp_path, {
+            "config/tc_watcher.py": self._real("tc_watcher.py"),
+            "config/vmem.py": self._real("vmem.py"),
+        }, select=self.SELECT)
+        assert findings == []
+
+    def test_format_change_without_golden_bump_fails(self, tmp_path):
+        src = self._real("tc_watcher.py")
+        assert '_PROC_FMT = "<iiQQ"' in src
+        src = src.replace('_PROC_FMT = "<iiQQ"', '_PROC_FMT = "<iqQQ"')
+        # the assert statements in the module are data to the linter, not
+        # executed — only the folded constants matter
+        findings = lint(tmp_path, {"config/tc_watcher.py": src},
+                        select=self.SELECT)
+        drifted = {f.message.split(" = ")[0].split()[-1]
+                   for f in findings}
+        # the fmt itself plus every size/offset derived from it
+        assert any("_PROC_FMT" in d for d in drifted)
+        assert any("ABI drift" in f.message for f in findings)
+
+    def test_vmem_entry_change_fails(self, tmp_path):
+        src = self._real("vmem.py")
+        src = src.replace('_ENTRY_FMT = "<iiQQQQ"',
+                          '_ENTRY_FMT = "<iiQQQQQ"')
+        findings = lint(tmp_path, {"config/vmem.py": src},
+                        select=self.SELECT)
+        assert any("vmem._ENTRY_FMT" in f.message for f in findings)
+
+    def test_missing_golden_reported(self, tmp_path):
+        findings = lint(tmp_path,
+                        {"config/vmem.py": self._real("vmem.py")},
+                        select=self.SELECT,
+                        golden=str(tmp_path / "nope.json"))
+        assert any("golden ABI file missing" in f.message
+                   for f in findings)
+
+    def test_suppression_is_per_line(self, tmp_path):
+        src = self._real("tc_watcher.py").replace(
+            '_PROC_FMT = "<iiQQ"',
+            '_PROC_FMT = "<iqQQ"  # vtlint: disable=abi-drift')
+        findings = lint(tmp_path, {"config/tc_watcher.py": src},
+                        select=self.SELECT)
+        # the annotated line is suppressed; the derived sizes still drift
+        assert all("_PROC_FMT" not in f.message.split("but")[0]
+                   for f in findings)
+        assert findings   # PROC_SIZE / RECORD_SIZE etc. still caught
+
+
+# ---------------------------------------------------------------------------
+# featuregate-hygiene
+
+_FG_FIXTURE = """
+    GATE_A = "GateA"
+    GATE_B = "GateB"
+    GATE_C = "GateC"
+
+    _KNOWN = {
+        GATE_A: False,
+        GATE_B: False,
+    }
+    """
+
+
+class TestFeaturegateHygiene:
+    SELECT = {"featuregate-hygiene"}
+
+    def test_unregistered_unreferenced_and_literal(self, tmp_path):
+        findings = lint(tmp_path, {
+            "util/featuregates.py": _FG_FIXTURE,
+            "caller.py": """
+                from util.featuregates import GATE_A
+
+                def run(gates):
+                    if gates.enabled(GATE_A):
+                        pass
+                    return gates.enabled("NoSuchGate")
+                """,
+        }, select=self.SELECT)
+        messages = "\n".join(f.message for f in findings)
+        assert "GATE_C is not registered" in messages
+        assert "GATE_B is registered in _KNOWN but referenced nowhere" \
+            in messages
+        assert "'NoSuchGate'" in messages
+
+    def test_clean_fixture(self, tmp_path):
+        findings = lint(tmp_path, {
+            "util/featuregates.py": """
+                GATE_A = "GateA"
+                _KNOWN = {GATE_A: False}
+                """,
+            "caller.py": """
+                from util.featuregates import GATE_A
+
+                def run(gates):
+                    return gates.enabled(GATE_A)
+                """,
+        }, select=self.SELECT)
+        assert findings == []
+
+    def test_parse_spec_literal_checked(self, tmp_path):
+        findings = lint(tmp_path, {
+            "util/featuregates.py": """
+                GATE_A = "GateA"
+                _KNOWN = {GATE_A: False}
+                """,
+            "caller.py": """
+                from util.featuregates import GATE_A
+
+                def run(gates):
+                    gates.parse("GateA=true,Bogus=false")
+                    return GATE_A
+                """,
+        }, select=self.SELECT)
+        assert any("'Bogus'" in f.message for f in findings)
+
+    def test_suppression(self, tmp_path):
+        # RESERVED is deliberately unreferenced: the dead-gate finding
+        # fires on its _KNOWN key line without the suppression...
+        fg = """
+            GATE_A = "GateA"
+            RESERVED = "Reserved"
+
+            _KNOWN = {
+                GATE_A: False,
+                RESERVED: False,
+            }
+            """
+        caller = """
+            from util.featuregates import GATE_A
+            print(GATE_A)
+            """
+        fg_suppressed = """
+            GATE_A = "GateA"
+            RESERVED = "Reserved"
+
+            _KNOWN = {
+                GATE_A: False,
+                # vtlint: disable=featuregate-hygiene — reserved
+                RESERVED: False,
+            }
+            """
+        findings = lint(tmp_path / "bare", {
+            "util/featuregates.py": fg, "caller.py": caller,
+        }, select=self.SELECT)
+        assert any("RESERVED" in f.message for f in findings)
+        # ...and is silenced by the disable comment above the key
+        findings = lint(tmp_path / "supp", {
+            "util/featuregates.py": fg_suppressed, "caller.py": caller,
+        }, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# exception-hygiene
+
+
+class TestExceptionHygiene:
+    SELECT = {"exception-hygiene"}
+
+    def test_silent_broad_except_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"scheduler/mod.py": """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"exception-hygiene"}
+
+    def test_bare_except_always_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"manager/mod.py": """
+            import logging
+            log = logging.getLogger(__name__)
+
+            def f():
+                try:
+                    work()
+                except:
+                    log.warning("x")
+            """}, select=self.SELECT)
+        assert any("bare" in f.message for f in findings)
+
+    def test_logged_or_reraised_clean(self, tmp_path):
+        findings = lint(tmp_path, {"deviceplugin/mod.py": """
+            import logging
+            log = logging.getLogger(__name__)
+
+            def f():
+                try:
+                    work()
+                except Exception:
+                    log.exception("failed")
+
+            def g():
+                try:
+                    work()
+                except Exception as e:
+                    raise RuntimeError("wrapped") from e
+
+            def h():
+                try:
+                    work()
+                except ValueError:
+                    pass     # narrow type: allowed
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_raise_inside_defined_closure_does_not_count(self, tmp_path):
+        # the handler swallows; the raise lives in a closure that only
+        # runs later (if ever)
+        findings = lint(tmp_path, {"scheduler/mod.py": """
+            def f(register):
+                try:
+                    work()
+                except Exception:
+                    def later():
+                        raise ValueError("deferred")
+                    register(later)
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"exception-hygiene"}
+
+    def test_inline_getlogger_counts_as_logging(self, tmp_path):
+        findings = lint(tmp_path, {"scheduler/mod.py": """
+            import logging
+
+            def f():
+                try:
+                    work()
+                except Exception as e:
+                    logging.getLogger(__name__).warning("failed: %s", e)
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_out_of_scope_dir_not_checked(self, tmp_path):
+        findings = lint(tmp_path, {"util/mod.py": """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = lint(tmp_path, {"kubeletplugin/mod.py": """
+            def f():
+                try:
+                    work()
+                # vtlint: disable=exception-hygiene — fixture
+                except Exception:
+                    pass
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI + meta
+
+
+class TestCli:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, VTLINT, *argv],
+            capture_output=True, text=True, cwd=REPO)
+
+    def test_bad_tree_nonzero_with_rule_tag(self, tmp_path):
+        bad = tmp_path / "scheduler"
+        bad.mkdir()
+        (bad / "mod.py").write_text(
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n")
+        proc = self._run(str(tmp_path))
+        assert proc.returncode == 1
+        assert "[exception-hygiene]" in proc.stdout
+
+    def test_json_output(self, tmp_path):
+        bad = tmp_path / "scheduler"
+        bad.mkdir()
+        (bad / "mod.py").write_text(
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n")
+        proc = self._run("--json", str(tmp_path))
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["count"] == 1
+        assert data["findings"][0]["rule"] == "exception-hygiene"
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def f(:\n")
+        proc = self._run(str(tmp_path))
+        assert proc.returncode == 1
+        assert "[parse-error]" in proc.stdout
+
+    def test_list_rules(self):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        for rule in ("lock-discipline", "seqlock-protocol", "abi-drift",
+                     "featuregate-hygiene", "exception-hygiene"):
+            assert rule in proc.stdout
+
+    def test_live_tree_clean_via_cli(self):
+        proc = self._run(PKG)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean" in proc.stdout
+
+
+class TestMeta:
+    def test_live_tree_is_vtlint_clean(self):
+        findings = run_analysis([PKG], all_rules())
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_golden_matches_live_layout(self):
+        project, errors = load_project([PKG])
+        assert errors == []
+        layout = abi_drift.compute_layout(project)
+        golden = json.loads(abi_drift.DEFAULT_GOLDEN.read_text())
+        assert layout == golden
+
+    def test_golden_tracks_every_declared_name(self):
+        golden = json.loads(abi_drift.DEFAULT_GOLDEN.read_text())
+        for key, (_, names) in abi_drift.TRACKED.items():
+            assert set(golden[key]) == set(names)

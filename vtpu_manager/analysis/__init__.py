@@ -1,0 +1,36 @@
+"""vtlint: project-native static analysis for vtpu-manager.
+
+Generic linters cannot see the invariants this codebase actually depends
+on: the mmap'd seqlock ABI between the node daemon and lock-free readers
+(config/tc_watcher.py, config/vmem.py), consistent lock ordering across the
+~20 modules that hold ``threading.Lock``s around shared device state,
+feature-gate registration hygiene, and control-plane exception discipline.
+This package is an AST-based rule framework that checks exactly those:
+
+- ``lock-discipline``    — module-level call/lock graph: no blocking I/O
+  (``time.sleep``, subprocess, sockets, kube API calls) while a lock is
+  held, and no inconsistent lock-acquisition order.
+- ``seqlock-protocol``   — every mmap write under a ``byte_range_write_lock``
+  must bracket its payload with an odd/even seq bump, and seqlock readers
+  must retry on odd seq and re-check after the payload read.
+- ``abi-drift``          — the struct format strings and derived sizes /
+  offsets in tc_watcher.py / vmem.py must match the committed golden layout
+  (``abi_golden.json``); layout changes require an explicit golden bump.
+- ``featuregate-hygiene``— every gate constant is registered in ``_KNOWN``,
+  every registered gate is referenced outside featuregates.py, and no call
+  site passes an undeclared string-literal gate name.
+- ``exception-hygiene``  — no silent broad ``except`` in control-plane
+  paths (scheduler/, manager/, deviceplugin/, kubeletplugin/).
+
+Suppression: ``# vtlint: disable=<rule>[,<rule>...]`` on the flagged line
+or the line directly above, with a written justification.
+
+CLI: ``python scripts/vtlint.py vtpu_manager/`` (also ``make lint``).
+"""
+
+from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
+                                        run_analysis)
+from vtpu_manager.analysis.rules import all_rules
+
+__all__ = ["Finding", "Module", "Project", "Rule", "run_analysis",
+           "all_rules"]

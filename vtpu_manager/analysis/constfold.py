@@ -1,0 +1,109 @@
+"""Restricted constant folding over a module's top-level assignments.
+
+The abi-drift rule needs the *values* of ``_HEADER_FMT`` / ``RECORD_SIZE``
+/ ``CAL_OFFSET`` etc. without importing the module (imports execute code;
+the linter must work on a broken tree). This evaluator handles exactly the
+expression forms those layout constants use: literals, previously-bound
+names, arithmetic/bitwise BinOps, f-strings interpolating constants, and
+``struct.calcsize(...)`` calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+
+
+class Unfoldable(Exception):
+    pass
+
+
+_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Mod: lambda a, b: a % b,
+    ast.LShift: lambda a, b: a << b,
+    ast.RShift: lambda a, b: a >> b,
+    ast.BitOr: lambda a, b: a | b,
+    ast.BitAnd: lambda a, b: a & b,
+    ast.BitXor: lambda a, b: a ^ b,
+    ast.Pow: lambda a, b: a ** b,
+}
+
+
+def fold_expr(node: ast.AST, env: dict[str, object]) -> object:
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise Unfoldable(node.id)
+    if isinstance(node, ast.BinOp):
+        op = _BINOPS.get(type(node.op))
+        if op is None:
+            raise Unfoldable(ast.dump(node.op))
+        return op(fold_expr(node.left, env), fold_expr(node.right, env))
+    if isinstance(node, ast.UnaryOp):
+        val = fold_expr(node.operand, env)
+        if isinstance(node.op, ast.USub):
+            return -val
+        if isinstance(node.op, ast.UAdd):
+            return +val
+        if isinstance(node.op, ast.Invert):
+            return ~val
+        raise Unfoldable("unary")
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for value in node.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            elif isinstance(value, ast.FormattedValue):
+                if value.format_spec is not None:
+                    raise Unfoldable("format spec")
+                parts.append(str(fold_expr(value.value, env)))
+            else:
+                raise Unfoldable("f-string part")
+        return "".join(parts)
+    if isinstance(node, ast.Call):
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "calcsize"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "struct" and len(node.args) == 1
+                and not node.keywords):
+            fmt = fold_expr(node.args[0], env)
+            try:
+                return struct.calcsize(fmt)
+            except (struct.error, TypeError) as e:
+                raise Unfoldable(f"calcsize: {e}") from e
+        raise Unfoldable("call")
+    if isinstance(node, ast.Tuple):
+        # folded as a LIST so values round-trip through the JSON golden
+        # (a tuple would compare unequal to its own regenerated golden)
+        return [fold_expr(elt, env) for elt in node.elts]
+    raise Unfoldable(type(node).__name__)
+
+
+def fold_module_constants(tree: ast.Module) -> dict[str, object]:
+    """Evaluate the module's top-level ``NAME = <expr>`` bindings in order.
+    Unfoldable expressions are skipped (their names simply stay unbound, so
+    later expressions depending on them are skipped too)."""
+    env: dict[str, object] = {}
+    for stmt in tree.body:
+        targets: list[ast.expr] = []
+        value: ast.expr | None = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        try:
+            folded = fold_expr(value, env)
+        except Unfoldable:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name):
+                env[target.id] = folded
+    return env

@@ -1,0 +1,222 @@
+"""vtlint framework core: modules, suppressions, rules, the runner.
+
+Design notes:
+- Pure stdlib (``ast`` + ``tokenize``); no imports of the analyzed code —
+  everything is derived from source text, so the linter can check a broken
+  tree and never executes side effects.
+- Rules get two hooks: ``check_module`` (per file) and ``finalize`` (whole
+  project — cross-module rules like lock ordering and feature-gate
+  reference checks live there).
+- Suppressions are per-rule comments (``# vtlint: disable=rule1,rule2``)
+  honored on the flagged line or the line directly above, mirroring the
+  two places a justification comment naturally sits.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable
+
+_SUPPRESS_RE = re.compile(r"#\s*vtlint:\s*disable=([\w\-, ]+)")
+
+# generated protobuf modules are not hand-maintained code; analyzing them
+# costs time and can only produce noise
+_EXCLUDED_SUFFIXES = ("_pb2.py",)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+class Module:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 suppressions: dict[int, set[str]]):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.suppressions = suppressions
+        # parent links let rules walk ancestors (loop/with containment)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    @classmethod
+    def load(cls, path: str) -> "Module":
+        source = Path(path).read_text()
+        tree = ast.parse(source, filename=path)
+        return cls(path, source, tree, cls._suppressions(source))
+
+    @staticmethod
+    def _suppressions(source: str) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                out.setdefault(tok.start[0], set()).update(rules)
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """A disable comment covers its own line and the next one (i.e. a
+        standalone justification comment directly above the finding)."""
+        for cand in (line, line - 1):
+            if rule in self.suppressions.get(cand, ()):
+                return True
+        return False
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+
+class Project:
+    def __init__(self, modules: list[Module], roots: list[str]):
+        self.modules = modules
+        self.roots = roots
+
+    def find_module(self, relpath_suffix: str) -> Module | None:
+        """First module whose path ends with the given suffix (posix)."""
+        for mod in self.modules:
+            if Path(mod.path).as_posix().endswith(relpath_suffix):
+                return mod
+        return None
+
+
+class Rule:
+    """Base rule. Subclasses set ``name``/``description`` and override one
+    or both hooks; findings they emit are filtered through suppressions by
+    the runner (anchor line decides)."""
+
+    name = ""
+    description = ""
+
+    def check_module(self, module: Module,
+                     project: Project) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+def collect_py_files(paths: Iterable[str]) -> list[str]:
+    files: list[str] = []
+    for path in paths:
+        p = Path(path)
+        if p.is_file() and p.suffix == ".py":
+            files.append(str(p))
+            continue
+        if p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if sub.name.endswith(_EXCLUDED_SUFFIXES):
+                    continue
+                # exclusion applies to components BELOW the given root
+                # only — a workspace that itself sits under a dotted dir
+                # (~/.cache, .worktrees) must still lint
+                rel_parts = sub.relative_to(p).parts
+                if "__pycache__" in rel_parts or any(
+                        part.startswith(".") for part in rel_parts):
+                    continue
+                files.append(str(sub))
+    return files
+
+
+def load_project(paths: Iterable[str]) -> tuple[Project, list[Finding]]:
+    modules: list[Module] = []
+    errors: list[Finding] = []
+    for path in collect_py_files(paths):
+        try:
+            modules.append(Module.load(path))
+        except SyntaxError as e:
+            errors.append(Finding("parse-error", path, e.lineno or 0,
+                                  f"cannot parse: {e.msg}"))
+        except (OSError, UnicodeDecodeError) as e:
+            errors.append(Finding("parse-error", path, 0,
+                                  f"cannot read: {e}"))
+    return Project(modules, [str(p) for p in paths]), errors
+
+
+def run_analysis(paths: Iterable[str], rules: Iterable[Rule],
+                 ) -> list[Finding]:
+    """Run every rule over the given files/dirs; returns findings that
+    survived suppression, sorted by location. Parse errors are findings
+    (rule ``parse-error``) — an unparseable tree must fail the lint, not
+    silently shrink its coverage."""
+    project, findings = load_project(paths)
+    by_path = {mod.path: mod for mod in project.modules}
+    for rule in rules:
+        raw: list[Finding] = []
+        for mod in project.modules:
+            raw.extend(rule.check_module(mod, project))
+        raw.extend(rule.finalize(project))
+        for f in raw:
+            mod = by_path.get(f.path)
+            if mod is not None and mod.is_suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def render_human(findings: list[Finding]) -> str:
+    if not findings:
+        return "vtlint: clean"
+    lines = [f.render() for f in findings]
+    lines.append(f"vtlint: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps({"findings": [f.to_json() for f in findings],
+                       "count": len(findings)}, indent=2)
+
+
+# -- dotted-name helpers shared by rules -----------------------------------
+
+def dotted_parts(node: ast.AST) -> list[str]:
+    """['self', 'client', 'list_pods'] for self.client.list_pods; empty
+    for anything that is not a plain name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        # chain rooted in a call/subscript: keep the attribute path with an
+        # anonymous root so terminal-name heuristics still work
+        parts.append("?")
+    return list(reversed(parts))
+
+
+def dotted_name(node: ast.AST) -> str:
+    return ".".join(dotted_parts(node))

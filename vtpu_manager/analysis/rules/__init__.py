@@ -1,0 +1,23 @@
+"""vtlint rule registry."""
+
+from __future__ import annotations
+
+from vtpu_manager.analysis.core import Rule
+from vtpu_manager.analysis.rules.abi_drift import AbiDriftRule
+from vtpu_manager.analysis.rules.exception_hygiene import \
+    ExceptionHygieneRule
+from vtpu_manager.analysis.rules.featuregate_hygiene import \
+    FeaturegateHygieneRule
+from vtpu_manager.analysis.rules.lock_discipline import LockDisciplineRule
+from vtpu_manager.analysis.rules.seqlock_protocol import SeqlockProtocolRule
+
+
+def all_rules(abi_golden: str | None = None) -> list[Rule]:
+    """Fresh rule instances (rules carry per-run state in finalize)."""
+    return [
+        LockDisciplineRule(),
+        SeqlockProtocolRule(),
+        AbiDriftRule(golden_path=abi_golden),
+        FeaturegateHygieneRule(),
+        ExceptionHygieneRule(),
+    ]

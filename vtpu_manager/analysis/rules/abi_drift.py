@@ -1,0 +1,133 @@
+"""abi-drift: the shared-memory layouts must match the committed golden.
+
+config/tc_watcher.py and config/vmem.py define a binary ABI consumed by
+the C++ shim (library/src/*) and by every running container on a node —
+a daemon upgrade that silently changes ``_CAL_FMT`` or a derived offset
+desynchronizes every mapped reader. The contract tests
+(tests/test_config_abi.py) catch Python<->C++ skew at test time; this rule
+catches *unintentional edits* at lint time by constant-folding the format
+strings and derived sizes/offsets straight out of the AST and comparing
+them to ``vtpu_manager/analysis/abi_golden.json``.
+
+Intentional layout changes are a two-step edit by design: change the
+module AND regenerate the golden (``python scripts/vtlint.py
+--update-abi-golden``), which makes ABI bumps explicit in review.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable
+
+from vtpu_manager.analysis.constfold import fold_module_constants
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule
+
+RULE = "abi-drift"
+
+# module-key -> (relpath suffix, names frozen in the golden)
+TRACKED: dict[str, tuple[str, list[str]]] = {
+    "tc_watcher": ("config/tc_watcher.py", [
+        "MAGIC", "VERSION", "MAX_DEVICE_COUNT", "MAX_PROCS",
+        "MAX_EXCESS_POINTS", "_HEADER_FMT", "HEADER_SIZE", "_PROC_FMT",
+        "PROC_SIZE", "_RECORD_HEAD_FMT", "RECORD_SIZE", "_CAL_FMT",
+        "CAL_SIZE", "CAL_OFFSET", "FILE_SIZE",
+    ]),
+    "vmem": ("config/vmem.py", [
+        "MAGIC", "VERSION", "MAX_ENTRIES", "_HEADER_FMT", "HEADER_SIZE",
+        "_ENTRY_FMT", "ENTRY_SIZE", "FILE_SIZE",
+    ]),
+}
+
+DEFAULT_GOLDEN = Path(__file__).resolve().parent.parent / "abi_golden.json"
+
+
+def compute_layout(project: Project) -> dict[str, dict[str, object]]:
+    """Fold the tracked constants out of the analyzed modules; modules not
+    present in the project are omitted."""
+    layout: dict[str, dict[str, object]] = {}
+    for key, (suffix, names) in TRACKED.items():
+        mod = project.find_module(suffix)
+        if mod is None:
+            continue
+        env = fold_module_constants(mod.tree)
+        layout[key] = {name: env[name] for name in names if name in env}
+    return layout
+
+
+def _assign_line(module: Module, name: str) -> int:
+    import ast
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    return node.lineno
+    return 1
+
+
+class AbiDriftRule(Rule):
+    name = RULE
+    description = ("struct layouts in tc_watcher.py/vmem.py match the "
+                   "committed golden ABI (abi_golden.json)")
+
+    def __init__(self, golden_path: str | None = None):
+        self.golden_path = Path(golden_path) if golden_path \
+            else DEFAULT_GOLDEN
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        tracked_present = {
+            key: project.find_module(suffix)
+            for key, (suffix, _) in TRACKED.items()}
+        if not any(tracked_present.values()):
+            return []   # not linting the config package
+        try:
+            golden = json.loads(self.golden_path.read_text())
+        except FileNotFoundError:
+            mod = next(m for m in tracked_present.values() if m)
+            return [Finding(
+                RULE, mod.path, 1,
+                f"golden ABI file missing at {self.golden_path}; generate "
+                f"it with 'python scripts/vtlint.py --update-abi-golden'")]
+        except (OSError, json.JSONDecodeError) as e:
+            mod = next(m for m in tracked_present.values() if m)
+            return [Finding(RULE, mod.path, 1,
+                            f"golden ABI file unreadable: {e}")]
+
+        layout = compute_layout(project)
+        out: list[Finding] = []
+        for key, module in tracked_present.items():
+            if module is None:
+                continue
+            live = layout.get(key, {})
+            want = golden.get(key)
+            if want is None:
+                out.append(Finding(
+                    RULE, module.path, 1,
+                    f"module '{key}' missing from {self.golden_path.name};"
+                    f" regenerate with --update-abi-golden"))
+                continue
+            _, names = TRACKED[key]
+            for name in names:
+                if name not in live:
+                    out.append(Finding(
+                        RULE, module.path, 1,
+                        f"{key}.{name} is no longer statically "
+                        f"evaluable — the ABI layout must stay "
+                        f"constant-foldable (and in the golden)"))
+                    continue
+                if name not in want:
+                    out.append(Finding(
+                        RULE, module.path, _assign_line(module, name),
+                        f"{key}.{name} = {live[name]!r} is not in the "
+                        f"golden ABI; intentional layout additions need "
+                        f"an --update-abi-golden bump"))
+                elif live[name] != want[name]:
+                    out.append(Finding(
+                        RULE, module.path, _assign_line(module, name),
+                        f"ABI drift: {key}.{name} = {live[name]!r} but "
+                        f"the committed golden says {want[name]!r}. "
+                        f"Shims mapping the old layout would misread "
+                        f"every record — if this change is intentional, "
+                        f"bump the golden: python scripts/vtlint.py "
+                        f"--update-abi-golden"))
+        return out

@@ -1,0 +1,119 @@
+"""exception-hygiene: no silent broad excepts in control-plane paths.
+
+A swallowed exception in the scheduler filter, the device manager, or a
+kubelet plugin doesn't crash anything — it silently mis-schedules pods,
+drops health flips, or wedges allocations, which is strictly worse. In
+the control-plane packages (scheduler/, manager/, deviceplugin/,
+kubeletplugin/) every ``except Exception`` / bare ``except`` must either
+re-raise or log before continuing; bare ``except:`` is always flagged
+(it also eats SystemExit/KeyboardInterrupt).
+
+Handlers that narrow to specific exception types are never flagged —
+narrowing IS the fix when logging would be noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
+                                        dotted_parts)
+
+RULE = "exception-hygiene"
+
+SCOPED_DIRS = ("scheduler", "manager", "deviceplugin", "kubeletplugin")
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def _in_scope(path: str) -> bool:
+    return any(part in SCOPED_DIRS for part in Path(path).parts)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for name in names:
+        if isinstance(name, ast.Name) and name.id in ("Exception",
+                                                      "BaseException"):
+            return True
+    return False
+
+
+def _shallow_walk(handler: ast.ExceptHandler):
+    """Walk the handler body WITHOUT descending into nested defs — a
+    raise/log inside a merely-defined closure runs later (if ever) and
+    does not make the swallow visible."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _handles_visibly(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises or logs."""
+    for node in _shallow_walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if not parts:
+                continue
+            if parts == ["warnings", "warn"]:
+                return True
+            if len(parts) >= 2 and parts[-1] in _LOG_METHODS:
+                if any("log" in p.lower() for p in parts[:-1]):
+                    return True
+                # call-rooted receivers collapse to '?' in dotted_parts;
+                # recognize the inline 'logging.getLogger(...).warning()'
+                # idiom by scanning the receiver expression itself
+                if isinstance(node.func, ast.Attribute) and any(
+                        "log" in n.lower()
+                        for sub in ast.walk(node.func.value)
+                        for n in (
+                            [sub.id] if isinstance(sub, ast.Name)
+                            else [sub.attr] if isinstance(sub,
+                                                          ast.Attribute)
+                            else [])):
+                    return True
+    return False
+
+
+class ExceptionHygieneRule(Rule):
+    name = RULE
+    description = ("broad excepts in scheduler/manager/deviceplugin/"
+                   "kubeletplugin must log or re-raise; bare except "
+                   "never allowed")
+
+    def check_module(self, module: Module,
+                     project: Project) -> Iterable[Finding]:
+        if not _in_scope(module.path):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(Finding(
+                    RULE, module.path, node.lineno,
+                    "bare 'except:' also catches SystemExit/"
+                    "KeyboardInterrupt — catch Exception at the "
+                    "broadest, and log or re-raise"))
+                continue
+            if _is_broad(node) and not _handles_visibly(node):
+                out.append(Finding(
+                    RULE, module.path, node.lineno,
+                    "broad 'except Exception' swallows the error "
+                    "silently — narrow the exception type, or log "
+                    "before continuing (control-plane failures must "
+                    "be observable)"))
+        return out

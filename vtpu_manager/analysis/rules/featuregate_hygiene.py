@@ -1,0 +1,174 @@
+"""featuregate-hygiene: gates declared, registered, referenced, and typed.
+
+vtpu_manager/util/featuregates.py mirrors the k8s component-base pattern:
+string constants + a ``_KNOWN`` registry with defaults. Three failure
+modes creep in over time, none of which raise at import:
+
+- a gate constant added without a ``_KNOWN`` entry parses as "unknown
+  feature gate" at every call site that trusts the constant;
+- a ``_KNOWN`` entry nothing references is dead configuration surface —
+  operators can set it and nothing changes (worse than an error);
+- a call site passing a string literal (``gates.enabled("TcWatcher")``)
+  bypasses the constants and typos silently diverge from the registry.
+
+Reference scanning covers the analyzed modules plus the repo's ``cmd/``
+entrypoints (gates are wired in the binaries, which sit outside the
+package tree).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
+                                        dotted_parts)
+
+RULE = "featuregate-hygiene"
+
+FEATUREGATES_SUFFIX = "util/featuregates.py"
+
+
+class _GateDecls:
+    def __init__(self, module: Module):
+        self.module = module
+        self.constants: dict[str, str] = {}       # NAME -> gate string
+        self.const_lines: dict[str, int] = {}
+        self.known_keys: list[tuple[str, int]] = []   # (const name, line)
+        self.known_line = 1
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target = node.targets[0].id
+                if target.isupper() and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    self.constants[target] = node.value.value
+                    self.const_lines[target] = node.lineno
+                elif target == "_KNOWN" and isinstance(node.value, ast.Dict):
+                    self.known_line = node.lineno
+                    for key in node.value.keys:
+                        if isinstance(key, ast.Name):
+                            self.known_keys.append((key.id, key.lineno))
+                        elif isinstance(key, ast.Constant):
+                            # literal key: still a registered gate, named
+                            # by its value
+                            self.known_keys.append(
+                                (repr(key.value), key.lineno))
+
+    def gate_values(self) -> set[str]:
+        return set(self.constants.values())
+
+
+def _name_refs(tree: ast.Module) -> set[str]:
+    refs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            refs.add(node.id)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                refs.add(alias.name)
+    return refs
+
+
+class FeaturegateHygieneRule(Rule):
+    name = RULE
+    description = ("every gate constant registered in _KNOWN, every "
+                   "_KNOWN gate referenced outside featuregates.py, no "
+                   "undeclared string-literal gate names at call sites")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        fg_mod = project.find_module(FEATUREGATES_SUFFIX)
+        if fg_mod is None:
+            return []
+        decls = _GateDecls(fg_mod)
+        out: list[Finding] = []
+        known_names = {name for name, _ in decls.known_keys}
+
+        # (1) every constant registered
+        for const, line in decls.const_lines.items():
+            if const not in known_names:
+                out.append(Finding(
+                    RULE, fg_mod.path, line,
+                    f"gate constant {const} is not registered in _KNOWN —"
+                    f" every call site using it will raise 'unknown "
+                    f"feature gate'"))
+
+        # (2) every registered gate referenced somewhere real
+        refs: set[str] = set()
+        for mod in project.modules:
+            if mod is fg_mod:
+                continue
+            refs |= _name_refs(mod.tree)
+        refs |= self._cmd_refs(fg_mod)
+        for name, line in decls.known_keys:
+            if name in decls.constants and name not in refs:
+                out.append(Finding(
+                    RULE, fg_mod.path, line,
+                    f"gate {name} is registered in _KNOWN but referenced "
+                    f"nowhere outside featuregates.py — dead "
+                    f"configuration surface (wire it or drop it)"))
+
+        # (3) no undeclared string-literal gate names at call sites
+        values = decls.gate_values()
+        for mod in project.modules:
+            if mod is fg_mod:
+                continue
+            out.extend(self._literal_calls(mod, values))
+        return out
+
+    def _cmd_refs(self, fg_mod: Module) -> set[str]:
+        """Gate references in the repo's cmd/ entrypoints (outside the
+        package tree, where gates are actually wired)."""
+        refs: set[str] = set()
+        # .../vtpu_manager/util/featuregates.py -> repo root
+        root = Path(fg_mod.path).resolve().parent.parent.parent
+        cmd_dir = root / "cmd"
+        if not cmd_dir.is_dir():
+            return refs
+        for path in sorted(cmd_dir.glob("*.py")):
+            try:
+                refs |= _name_refs(ast.parse(path.read_text()))
+            except (OSError, SyntaxError):
+                continue
+        return refs
+
+    def _literal_calls(self, mod: Module,
+                       values: set[str]) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            if attr in ("enabled", "set") and node.args and isinstance(
+                    node.args[0], ast.Constant) and isinstance(
+                    node.args[0].value, str):
+                # .set() is a common method name (events, readiness
+                # probes): only treat it as a gate call on a gate-ish
+                # receiver with the two-arg gate signature
+                if attr == "set":
+                    recv = dotted_parts(node.func.value)
+                    if len(node.args) != 2 or not any(
+                            "gate" in p.lower() for p in recv):
+                        continue
+                gate = node.args[0].value
+                if gate not in values:
+                    out.append(Finding(
+                        RULE, mod.path, node.lineno,
+                        f"string-literal gate name {gate!r} is not a "
+                        f"declared gate constant — typo or undeclared "
+                        f"gate (declare it in featuregates.py and use "
+                        f"the constant)"))
+            elif attr == "parse" and node.args and isinstance(
+                    node.args[0], ast.Constant) and isinstance(
+                    node.args[0].value, str):
+                spec = node.args[0].value
+                for part in spec.split(","):
+                    name = part.split("=", 1)[0].strip()
+                    if name and name not in values:
+                        out.append(Finding(
+                            RULE, mod.path, node.lineno,
+                            f"feature-gate spec literal names unknown "
+                            f"gate {name!r}"))
+        return out

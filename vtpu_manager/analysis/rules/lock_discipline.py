@@ -1,0 +1,318 @@
+"""lock-discipline: blocking calls under locks + lock-order consistency.
+
+Shared device state in this codebase is guarded by ~20 in-process
+``threading.Lock``s, and the hot paths (scheduler filter, device plugin
+Allocate, the watcher tick) must never block while one is held — readers
+like the shim's 100 ms watcher thread poll lock-free precisely because the
+daemon promises not to stall. Two checks:
+
+1. **blocking-under-lock** — inside any ``with <lock>:`` region, flag
+   calls that can block: ``time.sleep``, ``subprocess.*``, socket I/O
+   (connect/accept/recv/sendall/urlopen), ``requests.*``, blocking
+   ``.wait()``, and — project-native — any method on a ``client``
+   attribute (the kube API client). The check is transitive over the
+   module's own call graph: ``with lock: self._helper()`` is flagged when
+   ``_helper`` (or anything it calls or references locally, including
+   nested closures) performs a blocking call.
+2. **lock-order** — every ordered pair (A held, B acquired) observed
+   anywhere in the project (syntactic nesting plus one-level propagation
+   through local calls) must be globally consistent: seeing both (A, B)
+   and (B, A) is a deadlock-shaped finding on both sites.
+
+Lock regions are ``with`` statements whose context expression mentions a
+lock-ish name (``*lock*`` in any dotted part — covers ``self._serial_lock``,
+``byte_range_write_lock(...)``, ``self.locker.section(...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
+                                        dotted_name, dotted_parts)
+
+RULE = "lock-discipline"
+
+_SOCKET_ATTRS = {"connect", "accept", "recv", "recvfrom", "sendall",
+                 "urlopen", "wait", "communicate"}
+_SUBPROCESS_FUNCS = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+def _blocking_desc(call: ast.Call) -> str | None:
+    """Human description when the call is known-blocking, else None."""
+    parts = dotted_parts(call.func)
+    if not parts:
+        return None
+    name = ".".join(parts)
+    if name == "time.sleep":
+        return "time.sleep"
+    if parts[0] == "subprocess" and parts[-1] in _SUBPROCESS_FUNCS:
+        return name
+    if parts[0] == "requests":
+        return f"{name} (HTTP I/O)"
+    # kube API client: any method on a *.client / client.* receiver
+    if len(parts) >= 2 and "client" in parts[:-1]:
+        return f"{name} (API client I/O)"
+    if parts[-1] in _SOCKET_ATTRS:
+        # Event.wait(timeout) in daemon loops is pacing, not contention —
+        # but under a lock it still blocks every other acquirer, so it
+        # stays in the set; justified uses carry a suppression.
+        return f"{name} (blocking call)"
+    return None
+
+
+def _is_lockish(ctx: ast.expr) -> str | None:
+    """Lock name when the with-context looks like a lock, else None."""
+    expr = ctx
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    parts = dotted_parts(expr)
+    if any("lock" in p.lower() for p in parts):
+        terminal = [p for p in parts if p != "self"]
+        return ".".join(terminal) if terminal else parts[-1]
+    return None
+
+
+@dataclass
+class _FuncInfo:
+    qualname: str
+    node: ast.AST
+    # direct blocking calls: (description, lineno)
+    blocking: list[tuple[str, int]] = field(default_factory=list)
+    # locally-resolvable callees/references (keys into the function table)
+    callees: set[str] = field(default_factory=set)
+    # locks this function acquires directly: (lockname, lineno)
+    acquires: list[tuple[str, int]] = field(default_factory=list)
+    # post-fixpoint: exemplar blocking chain (desc, call-path) or None
+    may_block: tuple[str, tuple[str, ...]] | None = None
+    # post-fixpoint: lock names acquired transitively
+    acquires_all: set[str] = field(default_factory=set)
+
+
+class _ModuleGraph:
+    """Per-module function table + local call graph."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.funcs: dict[str, _FuncInfo] = {}
+        self._cls_of: dict[str, str] = {}
+        # two phases: register every function first, THEN scan bodies —
+        # calls to methods defined later in the class must resolve.
+        # Module top-level statements get a synthetic entry so
+        # import-time lock regions are checked like any function body.
+        self._collect(module.tree, prefix="", cls="")
+        self.funcs["<module>"] = _FuncInfo("<module>", module.tree)
+        self._cls_of["<module>"] = ""
+        for info in self.funcs.values():
+            self._scan_body(info.node, info, self._cls_of[info.qualname])
+        self._fixpoint()
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect(self, node: ast.AST, prefix: str, cls: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                self.funcs[qual] = _FuncInfo(qual, child)
+                self._cls_of[qual] = cls
+                self._collect(child, prefix=f"{qual}.", cls=cls)
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, prefix=f"{child.name}.",
+                              cls=child.name)
+            else:
+                self._collect(child, prefix, cls)
+
+    def _scan_body(self, func: ast.AST, info: _FuncInfo, cls: str) -> None:
+        """Record the function's own blocking calls, callees, and lock
+        acquisitions — excluding statements that belong to nested defs
+        (they get their own _FuncInfo; a reference to them links up)."""
+        for node in self._walk_shallow(func):
+            if isinstance(node, ast.Call):
+                desc = _blocking_desc(node)
+                if desc:
+                    info.blocking.append((desc, node.lineno))
+                self._record_callee(node.func, info, cls)
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                # bare reference (callback passed along): link it so a
+                # closure handed to a runner still taints the caller
+                self._link_local(node.id, info, cls)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    lock = _is_lockish(item.context_expr)
+                    if lock:
+                        info.acquires.append((lock, node.lineno))
+
+    def _walk_shallow(self, func: ast.AST) -> Iterable[ast.AST]:
+        """Walk a function body without descending into nested defs."""
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _record_callee(self, func: ast.expr, info: _FuncInfo,
+                       cls: str) -> None:
+        parts = dotted_parts(func)
+        if len(parts) == 2 and parts[0] in ("self", "cls"):
+            self._link_local(parts[1], info, cls)
+        elif len(parts) == 1:
+            self._link_local(parts[0], info, cls)
+
+    def resolve_callee(self, info: _FuncInfo,
+                       func: ast.expr) -> str | None:
+        """Resolve a call expression to a function-table key, from the
+        perspective of ``info`` — the ONE resolution used both when
+        building the graph and when checking lock regions."""
+        parts = dotted_parts(func)
+        if len(parts) == 2 and parts[0] in ("self", "cls"):
+            name = parts[1]
+        elif len(parts) == 1:
+            name = parts[0]
+        else:
+            return None
+        return self._resolve_name(name, info.qualname,
+                                  self._cls_of.get(info.qualname, ""))
+
+    def _resolve_name(self, name: str, qualname: str,
+                      cls: str) -> str | None:
+        """Nested sibling first, then class method, then module func."""
+        for cand in (f"{qualname}.{name}",
+                     f"{cls}.{name}" if cls else name,
+                     name):
+            if cand in self.funcs and cand != qualname:
+                return cand
+        return None
+
+    def _link_local(self, name: str, info: _FuncInfo, cls: str) -> None:
+        cand = self._resolve_name(name, info.qualname, cls)
+        if cand is not None:
+            info.callees.add(cand)
+
+    # -- propagation --------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for info in self.funcs.values():
+            if info.blocking:
+                info.may_block = (info.blocking[0][0], ())
+            info.acquires_all = {lock for lock, _ in info.acquires}
+        changed = True
+        while changed:
+            changed = False
+            for info in self.funcs.values():
+                for callee in info.callees:
+                    sub = self.funcs[callee]
+                    if sub.may_block and not info.may_block:
+                        desc, chain = sub.may_block
+                        info.may_block = (desc, (callee, *chain))
+                        changed = True
+                    extra = sub.acquires_all - info.acquires_all
+                    if extra:
+                        info.acquires_all |= extra
+                        changed = True
+
+
+class LockDisciplineRule(Rule):
+    name = RULE
+    description = ("no blocking I/O while a lock is held; globally "
+                   "consistent lock-acquisition order")
+
+    def __init__(self) -> None:
+        # (outer, inner) -> first (path, line) observed; kept across
+        # modules so ordering is checked project-wide
+        self._pairs: dict[tuple[str, str], tuple[str, int]] = {}
+
+    # -- per-module ---------------------------------------------------------
+
+    def check_module(self, module: Module,
+                     project: Project) -> Iterable[Finding]:
+        graph = _ModuleGraph(module)
+        findings: list[Finding] = []
+        for info in graph.funcs.values():
+            for node in graph._walk_shallow(info.node):
+                if not isinstance(node, ast.With):
+                    continue
+                locks = [(_is_lockish(i.context_expr), node.lineno)
+                         for i in node.items]
+                locks = [(name, ln) for name, ln in locks if name]
+                if not locks:
+                    continue
+                for lock, _ in locks:
+                    findings.extend(self._check_region(
+                        module, graph, info, lock, node))
+        return findings
+
+    def _check_region(self, module: Module, graph: _ModuleGraph,
+                      info: _FuncInfo, lock: str,
+                      region: ast.With) -> list[Finding]:
+        out: list[Finding] = []
+        for node in self._region_walk(region):
+            if isinstance(node, ast.Call):
+                desc = _blocking_desc(node)
+                if desc:
+                    out.append(Finding(RULE, module.path, node.lineno,
+                                       f"blocking call {desc} while "
+                                       f"holding '{lock}'"))
+                    continue
+                callee = graph.resolve_callee(info, node.func)
+                if callee is not None:
+                    sub = graph.funcs[callee]
+                    if sub.may_block:
+                        desc, chain = sub.may_block
+                        path = " -> ".join((callee, *chain)) or callee
+                        out.append(Finding(
+                            RULE, module.path, node.lineno,
+                            f"'{lock}' held across {path}, which "
+                            f"performs blocking {desc}"))
+                    for inner in sub.acquires_all:
+                        self._note_pair(lock, inner, module.path,
+                                        node.lineno)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    inner = _is_lockish(item.context_expr)
+                    if inner:
+                        self._note_pair(lock, inner, module.path,
+                                        node.lineno)
+        return out
+
+    def _region_walk(self, region: ast.With) -> Iterable[ast.AST]:
+        """Walk the with-body (not the context expressions), skipping
+        nested function defs — a closure defined under a lock runs later,
+        not while the lock is held."""
+        stack = list(region.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _note_pair(self, outer: str, inner: str, path: str,
+                   line: int) -> None:
+        if outer == inner:
+            return
+        self._pairs.setdefault((outer, inner), (path, line))
+
+    # -- project-wide -------------------------------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        out = []
+        for (a, b), (path, line) in sorted(self._pairs.items()):
+            if (b, a) in self._pairs and a < b:
+                other_path, other_line = self._pairs[(b, a)]
+                out.append(Finding(
+                    RULE, path, line,
+                    f"inconsistent lock order: '{a}' -> '{b}' here but "
+                    f"'{b}' -> '{a}' at {other_path}:{other_line} "
+                    f"(deadlock hazard)"))
+                out.append(Finding(
+                    RULE, other_path, other_line,
+                    f"inconsistent lock order: '{b}' -> '{a}' here but "
+                    f"'{a}' -> '{b}' at {path}:{line} (deadlock hazard)"))
+        return out

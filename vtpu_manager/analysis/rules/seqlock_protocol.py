@@ -1,0 +1,219 @@
+"""seqlock-protocol: writer bracket + reader retry over the shared mmaps.
+
+The tc_util feed (config/tc_watcher.py) is read lock-free by in-container
+shims at 100 ms cadence; correctness rests entirely on the seqlock
+protocol, which no test can exhaustively exercise (torn reads are timing
+windows). The rule checks the protocol *shape* statically:
+
+Writer side — every ``with byte_range_write_lock(...)`` region that packs
+into an mmap must:
+  - derive the write seq with ``wseq = seq | 1`` (forcing odd even after a
+    crashed writer left seq odd; ``seq + 1`` would invert parity and let
+    torn reads validate),
+  - write the odd seq *first* (before any payload ``pack_into``),
+  - finish with exactly ``wseq + 1`` (back to even) as the last write.
+
+Reader side — any function that both ``struct.unpack_from``s and tests
+``<seq> & 1`` must:
+  - run the parity test inside a retry loop,
+  - retry (``continue``) on odd, never proceed into the payload,
+  - re-read the seq after the payload and compare against the first read.
+
+Plain locked writes (e.g. the vmem ledger, where readers also take the
+file lock) don't opt into the protocol and are not checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
+                                        dotted_parts)
+
+RULE = "seqlock-protocol"
+
+
+def _is_pack_into(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_parts(node.func) == ["struct", "pack_into"])
+
+
+def _is_unpack_from(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_parts(node.func) == ["struct", "unpack_from"])
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _ordered_walk(nodes: list[ast.stmt]) -> Iterable[ast.AST]:
+    """Source-order walk (ast.walk order is unspecified across levels)."""
+    for stmt in nodes:
+        yield stmt
+        for child in ast.iter_child_nodes(stmt):
+            yield from _ordered_walk([child])  # type: ignore[list-item]
+
+
+class SeqlockProtocolRule(Rule):
+    name = RULE
+    description = ("mmap writers bracket payloads with odd/even seq bumps;"
+                   " lock-free readers retry on odd seq and re-check")
+
+    # -- entry --------------------------------------------------------------
+
+    def check_module(self, module: Module,
+                     project: Project) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_reader(module, node))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call):
+                        parts = dotted_parts(ctx.func)
+                        if parts and "write_lock" in parts[-1]:
+                            findings.extend(
+                                self._check_writer(module, node))
+        return findings
+
+    # -- writer -------------------------------------------------------------
+
+    def _check_writer(self, module: Module,
+                      region: ast.With) -> list[Finding]:
+        packs = [n for n in _ordered_walk(region.body) if _is_pack_into(n)]
+        if not packs:
+            return []
+        line = region.lineno
+        out: list[Finding] = []
+
+        # the odd-seq variable: assigned `<x> | 1` inside the region
+        wseq: str | None = None
+        plus_one: str | None = None   # `<x> + 1` misuse
+        for node in _ordered_walk(region.body):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.BinOp) \
+                    and isinstance(node.value.right, ast.Constant) \
+                    and node.value.right.value == 1:
+                if isinstance(node.value.op, ast.BitOr):
+                    wseq = node.targets[0].id
+                elif isinstance(node.value.op, ast.Add) and wseq is None:
+                    plus_one = node.targets[0].id
+
+        if wseq is None:
+            if plus_one is not None and any(
+                    plus_one in _names_in(p) for p in packs):
+                out.append(Finding(
+                    RULE, module.path, line,
+                    f"writer derives its seq as '{plus_one} = ... + 1'; "
+                    f"must be 'seq | 1' — naive +1 inverts parity after "
+                    f"a crashed writer left seq odd, letting torn reads "
+                    f"validate"))
+            else:
+                out.append(Finding(
+                    RULE, module.path, line,
+                    "mmap write under byte_range_write_lock without a "
+                    "seqlock bracket: derive 'wseq = seq | 1', write it "
+                    "before the payload, and finish with 'wseq + 1'"))
+            return out
+
+        # first pack must carry the odd seq; none may precede it
+        first_names = _names_in(packs[0])
+        if wseq not in first_names:
+            out.append(Finding(
+                RULE, module.path, packs[0].lineno,
+                f"payload pack_into before the seq field is marked odd "
+                f"('{wseq}' must be written first)"))
+
+        # last pack must be the even bump: value contains `wseq + 1`
+        def _has_even_bump(call: ast.Call) -> bool:
+            for arg in call.args:
+                for sub in ast.walk(arg):
+                    if (isinstance(sub, ast.BinOp)
+                            and isinstance(sub.op, ast.Add)
+                            and isinstance(sub.left, ast.Name)
+                            and sub.left.id == wseq
+                            and isinstance(sub.right, ast.Constant)
+                            and sub.right.value == 1):
+                        return True
+            return False
+
+        bump_idx = [i for i, p in enumerate(packs) if _has_even_bump(p)]
+        if not bump_idx:
+            out.append(Finding(
+                RULE, module.path, packs[-1].lineno,
+                f"writer never returns the seq to even: the final "
+                f"pack_into must write '{wseq} + 1'"))
+        elif bump_idx[-1] != len(packs) - 1:
+            late = packs[bump_idx[-1] + 1]
+            out.append(Finding(
+                RULE, module.path, late.lineno,
+                f"pack_into after the seq was bumped even ('{wseq} + 1');"
+                f" readers can validate a torn record"))
+        return out
+
+    # -- reader -------------------------------------------------------------
+
+    def _check_reader(self, module: Module,
+                      func: ast.FunctionDef | ast.AsyncFunctionDef
+                      ) -> list[Finding]:
+        has_unpack = any(_is_unpack_from(n) for n in ast.walk(func))
+        if not has_unpack:
+            return []
+        parity_tests = [
+            n for n in ast.walk(func)
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.BitAnd)
+            and isinstance(n.right, ast.Constant) and n.right.value == 1
+            and isinstance(n.left, ast.Name)]
+        if not parity_tests:
+            return []
+        out: list[Finding] = []
+        for test in parity_tests:
+            loop = self._enclosing(module, test, (ast.For, ast.While))
+            if loop is None:
+                out.append(Finding(
+                    RULE, module.path, test.lineno,
+                    "seqlock parity test outside a retry loop: a single "
+                    "odd-seq observation must retry, not fail the read"))
+                continue
+            branch = self._enclosing(module, test, (ast.If,))
+            if branch is None or not any(
+                    isinstance(n, ast.Continue)
+                    for n in ast.walk(branch)):
+                out.append(Finding(
+                    RULE, module.path, test.lineno,
+                    "odd seq must retry the read loop (no 'continue' in "
+                    "the parity branch)"))
+            # recheck: a Compare between two loop-local unpacked names
+            if not self._has_recheck(loop, test.left.id):
+                out.append(Finding(
+                    RULE, module.path, test.lineno,
+                    "reader missing the second seq read + compare after "
+                    "the payload (torn reads would validate)"))
+        return out
+
+    def _enclosing(self, module: Module, node: ast.AST,
+                   kinds: tuple[type, ...]) -> ast.AST | None:
+        for anc in module.ancestors(node):
+            if isinstance(anc, kinds):
+                return anc
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        return None
+
+    def _has_recheck(self, loop: ast.AST, seq1: str) -> bool:
+        unpacked: set[str] = set()
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign) and _is_unpack_from(node.value):
+                for target in node.targets:
+                    unpacked.update(_names_in(target))
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Compare):
+                names = _names_in(node)
+                others = (names & unpacked) - {seq1}
+                if seq1 in names and others:
+                    return True
+        return False

@@ -180,6 +180,7 @@ class DraDriver:
             try:
                 self.state.unprepare_claim(claim_ref.uid)
             except Exception as e:   # unprepare must not wedge pod teardown
+                log.warning("unprepare %s failed: %s", claim_ref.uid, e)
                 entry.error = str(e)
         return resp
 

@@ -109,7 +109,9 @@ class DeviceManager:
                     self.node_name,
                     {consts.node_obs_overhead_annotation(): table})
             except Exception:  # noqa: BLE001 - annotation is observability
-                pass
+                log.warning("obs-overhead annotation patch failed "
+                            "(table still served via allocate env)",
+                            exc_info=True)
         return table
 
     # -- registration / heartbeat ------------------------------------------
@@ -199,7 +201,11 @@ class HealthWatcher:
             try:
                 ok = self.probe(chip)
             except Exception:
-                ok = False
+                # a raising probe reads as unhealthy, but the cause must
+                # be visible — a broken probe binary would otherwise look
+                # identical to a sick chip
+                log.warning("health probe raised for chip %s; treating "
+                            "as unhealthy", chip.uuid, exc_info=True)
             if not ok and chip.healthy:
                 log.error("device %s failed health probe", chip.uuid)
                 self.manager.mark_unhealthy(chip.uuid)

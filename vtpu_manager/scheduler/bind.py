@@ -38,7 +38,11 @@ class BindPredicate:
         ns = args.get("PodNamespace") or args.get("podNamespace") or "default"
         name = args.get("PodName") or args.get("podName") or ""
         node = args.get("Node") or args.get("node") or ""
+        # The serial section exists to order bind's get/patch/bind API
+        # sequence against concurrent binds of the same pod (reference
+        # SerialBindNode) — holding it across the I/O is the feature.
         with self.locker.section(f"{ns}/{name}"):
+            # vtlint: disable=lock-discipline — see above
             return self._bind_locked(ns, name, node)
 
     def _bind_locked(self, ns: str, name: str, node: str) -> BindResult:

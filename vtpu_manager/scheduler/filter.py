@@ -242,7 +242,13 @@ class FilterPredicate:
                 (n.get("metadata") or {}).get("name", "") for n in nodes])
 
         if self.serialize:
+            # Serializing the WHOLE pass including its API I/O is this
+            # lock's purpose (reference SerialFilterNode): two concurrent
+            # filters may not interleave list/allocate/patch, or they
+            # double-book devices. Nothing else ever takes _serial_lock,
+            # so nothing can deadlock on it.
             with self._serial_lock:
+                # vtlint: disable=lock-discipline — see above
                 return self._filter_locked(pod, req, nodes)
         return self._filter_locked(pod, req, nodes)
 

@@ -322,7 +322,12 @@ class PreemptPredicate:
             pdb_cache = {}
         try:
             node = self.client.get_node(node_name)
-        except Exception:
+        except Exception as e:
+            # dropping the node from the victim map is correct (it cannot
+            # be validated), but a systematic lookup failure (RBAC,
+            # apiserver outage) must be visible, not read as "no fit"
+            log.warning("preempt: node %s lookup failed, dropping it "
+                        "from the victim map: %s", node_name, e)
             return None
         resident = self.client.list_pods(node_name=node_name)
 

@@ -27,13 +27,17 @@ _KNOWN = {
     CORE_PLUGIN: False,
     MEMORY_PLUGIN: False,
     RESCHEDULE: False,
-    TPU_TOPOLOGY: False,
+    # Defaults True: topology publication and whole-pass filter
+    # serialization ARE the shipped behavior (filter.py serializes by
+    # default; registries always carried the mesh) — these gates exist to
+    # turn them OFF (perf harnesses, non-ICI nodes), not on.
+    TPU_TOPOLOGY: True,
     TC_WATCHER: False,
     VMEMORY_NODE: False,
     CLIENT_MODE: False,
     HONOR_PREALLOC_IDS: False,
     NRI_SUPPORT: False,
-    SERIAL_FILTER_NODE: False,
+    SERIAL_FILTER_NODE: True,
     SERIAL_BIND_NODE: False,
 }
 

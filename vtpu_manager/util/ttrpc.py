@@ -76,6 +76,10 @@ class Connection:
     def _send(self, stream_id: int, msg_type: int, payload: bytes) -> None:
         frame = _HEADER.pack(len(payload), stream_id, msg_type, 0) + payload
         with self._write_lock:
+            # The write lock exists precisely to serialize whole-frame
+            # socket writes; a torn frame corrupts the ttrpc stream.
+            # Nothing else is guarded by it; the read path never takes it.
+            # vtlint: disable=lock-discipline — see above
             self._sock.sendall(frame)
 
     def _recv_exact(self, n: int) -> bytes | None:
@@ -262,6 +266,9 @@ class Mux:
     def send(self, conn_id: int, data: bytes) -> None:
         frame = _MUX_HEADER.pack(conn_id, len(data)) + data
         with self._write_lock:
+            # Same as Connection._send: the lock serializes whole mux
+            # frames on the shared socket.
+            # vtlint: disable=lock-discipline — see above
             self._sock.sendall(frame)
 
     def _recv_exact(self, n: int) -> bytes | None:
