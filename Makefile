@@ -61,8 +61,12 @@ lint-golden: ## Regenerate the golden ABI layout (the explicit bump for intentio
 test: build ## Full hermetic suite (pytest; includes the C harness via fixtures)
 	$(PYTEST) tests/ -x -q
 
+.PHONY: test-trace
+test-trace: ## vtrace subsystem alone (recorder, assembly, hermetic e2e)
+	$(PYTEST) tests/test_trace.py -q
+
 .PHONY: verify
-verify: lint test ## Default verify flow: static analysis, then the suite
+verify: lint test test-trace ## Default verify flow: static analysis, the suite, then the vtrace e2e
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

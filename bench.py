@@ -357,6 +357,10 @@ def quota_step_measure(dim: int, warmup: int, steps: int) -> float:
         return y, jnp.float32(y[0, 0])
 
     x = jax.random.normal(jax.random.PRNGKey(0), (dim, dim), jnp.bfloat16)
+    # vtrace terminal event: the first device step closes a traced pod's
+    # admission-to-running timeline (no-op unless tracing env is present)
+    from vtpu_manager.runtime.client import mark_first_execute
+    mark_first_execute()
     for _ in range(warmup):
         x, loss = step(x)
         _ = float(loss)

@@ -26,6 +26,11 @@ def main(argv: list[str] | None = None) -> int:
                         help="container-config root (default: %(default)s)")
     parser.add_argument("--tc-path", default=consts.TC_UTIL_CONFIG)
     parser.add_argument("--vmem-path", default=consts.VMEM_NODE_CONFIG)
+    parser.add_argument("--trace-spool-dir", default=consts.TRACE_DIR,
+                        help="vtrace span spool dir: serves /traces and "
+                             "the vtpu_trace_* histograms (default: "
+                             "%(default)s; spools appear only on nodes "
+                             "running with the Tracing gate)")
     parser.add_argument("--pod-resources-socket", default=None,
                         help="kubelet pod-resources socket for the "
                         "container<->pod attribution cross-check "
@@ -84,17 +89,51 @@ def main(argv: list[str] | None = None) -> int:
         auth = request.headers.get("Authorization", "")
         return hmac.compare_digest(auth, f"Bearer {read_token()}")
 
+    from vtpu_manager.trace import assemble as trace_assemble
+    from vtpu_manager.trace.metrics import render_trace_metrics
+    from vtpu_manager.trace.recorder import reap_stale_spools
+
     async def metrics(request):
         if not authorized(request):
             return web.Response(status=401, text="unauthorized\n")
-        return web.Response(text=collector.render(),
-                            content_type="text/plain")
+        text = collector.render()
+        # vtrace aggregate view rides the scrape; rendered fresh from the
+        # node's spools like every other feed the collector reads —
+        # dead-process spools are reaped here so the read set (and the
+        # scrape cost) stays bounded across daemon/tenant churn
+        reap_stale_spools(args.trace_spool_dir)
+        text += render_trace_metrics(args.trace_spool_dir)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def traces(request):
+        # timelines name pods/namespaces: same bearer auth as /metrics
+        if not authorized(request):
+            return web.json_response({"error": "unauthorized"}, status=401)
+        reap_stale_spools(args.trace_spool_dir)
+        spans, drops = trace_assemble.read_spools(args.trace_spool_dir)
+        timelines = trace_assemble.assemble(spans)
+        pod = request.query.get("pod", "")
+        if pod:
+            tl = trace_assemble.find_timeline(timelines, pod)
+            if tl is None:
+                return web.json_response(
+                    {"error": f"no trace for pod {pod}"}, status=404)
+            return web.json_response({
+                "timeline": tl.to_wire(),
+                "critical_path": trace_assemble.critical_path(tl)})
+        return web.json_response({
+            "pods": sorted(timelines),
+            "timelines": [tl.to_wire() for tl in timelines.values()],
+            "outliers": trace_assemble.outliers(spans),
+            "spool_drops": sum(drops.values()),
+        })
 
     async def healthz(request):
         return web.Response(text="ok")
 
     app = web.Application()
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/traces", traces)
     app.router.add_get("/healthz", healthz)
     if args.debug_endpoints:
         # stack traces disclose internals: opt-in AND behind the same

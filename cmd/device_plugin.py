@@ -45,6 +45,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="use a fake discovery backend with N chips")
     parser.add_argument("--fake-client", action="store_true")
     parser.add_argument("--mesh-domain", default="")
+    parser.add_argument("--trace-sampling-rate", type=float, default=1.0,
+                        help="fraction of traced pods whose node-side "
+                             "spans are recorded (Tracing gate)")
+    parser.add_argument("--trace-spool-dir", default=None,
+                        help="vtrace span spool directory (default: the "
+                             "shared node trace dir)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -67,7 +73,8 @@ def main(argv: list[str] | None = None) -> int:
                                                 HONOR_PREALLOC_IDS,
                                                 MEMORY_PLUGIN, RESCHEDULE,
                                                 TC_WATCHER, TPU_TOPOLOGY,
-                                                VMEMORY_NODE, FeatureGates)
+                                                TRACING, VMEMORY_NODE,
+                                                FeatureGates)
 
     gates = FeatureGates()
     try:
@@ -75,6 +82,10 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         log.error("bad --feature-gates: %s", e)
         return 2
+    if gates.enabled(TRACING):
+        from vtpu_manager import trace
+        trace.configure("plugin", spool_dir=args.trace_spool_dir,
+                        sampling_rate=args.trace_sampling_rate)
 
     if not args.node_name:
         log.error("--node-name or NODE_NAME required")

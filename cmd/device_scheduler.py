@@ -42,6 +42,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--fake-chips", type=int, default=4)
     parser.add_argument("--debug-endpoints", action="store_true",
                         help="expose /debug/stacks (thread dumps)")
+    parser.add_argument("--trace-sampling-rate", type=float, default=1.0,
+                        help="fraction of traced pods whose scheduler "
+                             "spans are recorded (Tracing gate)")
+    parser.add_argument("--trace-spool-dir", default=None,
+                        help="vtrace span spool directory (default: the "
+                             "shared node trace dir)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -56,7 +62,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.serial import SerialLocker
     from vtpu_manager.util.featuregates import (SERIAL_BIND_NODE,
                                                 SERIAL_FILTER_NODE,
-                                                FeatureGates)
+                                                TRACING, FeatureGates)
 
     gates = FeatureGates()
     try:
@@ -64,6 +70,10 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         logging.getLogger(__name__).error("bad --feature-gates: %s", e)
         return 2
+    if gates.enabled(TRACING):
+        from vtpu_manager import trace
+        trace.configure("scheduler", spool_dir=args.trace_spool_dir,
+                        sampling_rate=args.trace_sampling_rate)
 
     if args.fake_client:
         from vtpu_manager.client.fake import FakeKubeClient

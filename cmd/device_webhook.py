@@ -24,6 +24,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--dra-convert", action="store_true",
                         help="rewrite vtpu-* extended resources into "
                              "generated ResourceClaims")
+    parser.add_argument("--feature-gates", default="",
+                        help="k8s-style gate spec, e.g. Tracing=true")
+    parser.add_argument("--trace-sampling-rate", type=float, default=1.0,
+                        help="fraction of admitted vtpu pods whose "
+                             "allocation path is traced (Tracing gate)")
+    parser.add_argument("--trace-spool-dir", default=None,
+                        help="vtrace span spool directory (default: the "
+                             "shared node trace dir)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -32,7 +40,19 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
     from vtpu_manager.util import consts
+    from vtpu_manager.util.featuregates import TRACING, FeatureGates
     from vtpu_manager.webhook.server import WebhookAPI, run_server
+
+    gates = FeatureGates()
+    try:
+        gates.parse(args.feature_gates)
+    except ValueError as e:
+        logging.getLogger(__name__).error("bad --feature-gates: %s", e)
+        return 2
+    if gates.enabled(TRACING):
+        from vtpu_manager import trace
+        trace.configure("webhook", spool_dir=args.trace_spool_dir,
+                        sampling_rate=args.trace_sampling_rate)
 
     consts.set_dra_device_class(args.device_class)
 

@@ -58,6 +58,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="bind address for the health endpoint "
                              "(default 0.0.0.0 so kubelet probes reach "
                              "it on hostNetwork daemonsets)")
+    parser.add_argument("--trace-sampling-rate", type=float, default=1.0,
+                        help="fraction of traced pods whose DRA spans "
+                             "are recorded (Tracing gate)")
+    parser.add_argument("--trace-spool-dir", default=None,
+                        help="vtrace span spool directory (default: the "
+                             "shared node trace dir)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -74,7 +80,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.kubeletplugin.driver import ClaimSource, DraDriver
     from vtpu_manager.tpu.discovery import FakeBackend, discover
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import NRI_SUPPORT, FeatureGates
+    from vtpu_manager.util.featuregates import (NRI_SUPPORT, TRACING,
+                                                FeatureGates)
 
     gates = FeatureGates()
     try:
@@ -82,6 +89,10 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         log.error("bad --feature-gates: %s", e)
         return 2
+    if gates.enabled(TRACING):
+        from vtpu_manager import trace
+        trace.configure("dra", spool_dir=args.trace_spool_dir,
+                        sampling_rate=args.trace_sampling_rate)
     if gates.enabled(NRI_SUPPORT) and not args.nri_socket:
         # the gate is the declarative way to ask for the runtime hook;
         # --nri-socket stays as the explicit/override form

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""vtrace CLI: reconstruct a pod's allocation-path timeline from spools.
+
+Usage:
+    python scripts/vtrace.py --pod <uid>           # one pod's critical path
+    python scripts/vtrace.py --list                # traced pods on this node
+    python scripts/vtrace.py --outliers            # stage-level slow spans
+    python scripts/vtrace.py --pod <uid> --json    # machine output
+
+Reads the per-process JSONL spools the Tracing gate produces (default
+dir: the shared node trace dir; --spool-dir for test runs), joins them
+into per-pod timelines, and prints where the admission-to-running time
+went — per-stage durations plus the uninstrumented gaps between stages
+(queueing, kubelet work, watch lag), which are usually the finding.
+
+Exit codes: 0 ok, 1 no matching trace, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vtpu_manager.trace import assemble                        # noqa: E402
+from vtpu_manager.util import consts                           # noqa: E402
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1000.0:9.3f}"
+
+
+def _print_timeline(tl: assemble.Timeline) -> None:
+    print(f"pod {tl.pod_uid or '?'}  trace {tl.trace_id or '?'}  "
+          f"total {tl.total_s() * 1000.0:.3f} ms "
+          f"({len(tl.spans)} spans)")
+    print(f"  {'offset ms':>9}  {'dur ms':>9}  {'gap ms':>9}  stage")
+    rows = assemble.critical_path(tl)
+    slowest = max((row["dur_s"] for row in rows), default=0.0)
+    for row in rows:
+        marker = "  <- slowest" if (slowest and row["dur_s"] == slowest) \
+            else ""
+        attrs = ""
+        if row["attrs"]:
+            attrs = "  " + ",".join(f"{k}={v}"
+                                    for k, v in sorted(row["attrs"].items()))
+        print(f"  {_fmt_ms(row['offset_s'])}  {_fmt_ms(row['dur_s'])}  "
+              f"{_fmt_ms(row['gap_s'])}  {row['stage']}"
+              f" [{row['service']}]{attrs}{marker}")
+    missing = [s for s in ("webhook.mutate", "scheduler.filter",
+                           "scheduler.bind")
+               if s not in tl.stages()]
+    if missing:
+        print(f"  (incomplete: no {', '.join(missing)} span — stage not "
+              f"traced in that process, or spool not on this node)")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vtrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--spool-dir", default=consts.TRACE_DIR)
+    parser.add_argument("--pod", default="",
+                        help="pod uid (or trace id) to reconstruct")
+    parser.add_argument("--list", action="store_true", dest="list_pods",
+                        help="list traced pods with total latency")
+    parser.add_argument("--outliers", action="store_true",
+                        help="flag spans slower than 3x their stage median")
+    parser.add_argument("--outlier-factor", type=float, default=3.0)
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    if not (args.pod or args.list_pods or args.outliers):
+        parser.print_usage(sys.stderr)
+        print("vtrace: one of --pod / --list / --outliers required",
+              file=sys.stderr)
+        return 2
+
+    spans, drops = assemble.read_spools(args.spool_dir)
+    timelines = assemble.assemble(spans)
+    total_drops = sum(drops.values())
+    if total_drops and not args.as_json:
+        print(f"warning: {total_drops} span(s) dropped at record time — "
+              f"timelines may have holes", file=sys.stderr)
+
+    if args.pod:
+        tl = assemble.find_timeline(timelines, args.pod)
+        if tl is None:
+            print(f"vtrace: no trace for pod {args.pod!r} under "
+                  f"{args.spool_dir} ({len(timelines)} pod(s) present)",
+                  file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps({"timeline": tl.to_wire(),
+                              "critical_path": assemble.critical_path(tl)},
+                             indent=2))
+        else:
+            _print_timeline(tl)
+        return 0
+
+    if args.list_pods:
+        ordered = sorted(timelines.values(),
+                         key=lambda t: t.total_s(), reverse=True)
+        if args.as_json:
+            print(json.dumps([t.to_wire() for t in ordered], indent=2))
+        else:
+            print(f"{'total ms':>10}  {'spans':>5}  pod")
+            for tl in ordered:
+                print(f"{tl.total_s() * 1000.0:10.3f}  "
+                      f"{len(tl.spans):5d}  {tl.key()}")
+        return 0
+
+    found = assemble.outliers(spans, factor=args.outlier_factor)
+    if args.as_json:
+        print(json.dumps(found, indent=2))
+    else:
+        if not found:
+            print("no stage-level outliers")
+        for row in found:
+            print(f"{row['stage']}: {row['dur_s'] * 1000.0:.3f} ms "
+                  f"({row['factor']}x the {row['median_s'] * 1000.0:.3f} ms "
+                  f"median) pod={row['pod_uid'] or row['trace_id']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

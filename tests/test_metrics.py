@@ -517,3 +517,33 @@ def test_mapping_crosscheck_cached_view_refetches_before_alarming(
     assert len(calls) == 2
     assert ('vtpu_container_pod_mapping_mismatch{node="n1",'
             'pod_uid="uid-1",container="main"} 0.0') in text
+
+
+def test_trace_metrics_served_with_spool_drops(tmp_path):
+    """The monitor's scrape appends the vtrace block: per-stage duration
+    histograms and the spool drop counter that flags timeline holes."""
+    from vtpu_manager.trace.metrics import render_trace_metrics
+    from vtpu_manager.trace.recorder import Span, SpanRecorder
+
+    spool = str(tmp_path / "trace")
+    rec = SpanRecorder("scheduler", spool, capacity=2, flush_at=99)
+    rec.record(Span(stage="scheduler.filter", trace_id="t", pod_uid="u",
+                    start_s=1.0, dur_s=0.003))
+    rec.record(Span(stage="scheduler.bind", trace_id="t", pod_uid="u",
+                    start_s=2.0, dur_s=0.001))
+    rec.record(Span(stage="scheduler.filter", trace_id="t2", pod_uid="u2",
+                    start_s=3.0, dur_s=0.001))   # ring full: dropped
+    rec.flush()
+
+    text = render_trace_metrics(spool)
+    assert "# TYPE vtpu_trace_spool_dropped_total counter" in text
+    assert 'vtpu_trace_spool_dropped_total{service="scheduler"} 1' in text
+    assert ('vtpu_trace_stage_duration_seconds_count'
+            '{stage="scheduler.filter"} 1') in text
+    assert ('vtpu_trace_stage_duration_seconds_sum'
+            '{stage="scheduler.bind"} 0.001') in text
+    # an empty spool dir renders headers only — the metric family stays
+    # discoverable on untraced nodes, with no bogus series
+    empty = render_trace_metrics(str(tmp_path / "none"))
+    assert "# TYPE vtpu_trace_spool_dropped_total counter" in empty
+    assert "vtpu_trace_spool_dropped_total{" not in empty

@@ -1,0 +1,595 @@
+"""vtrace: recorder unit tests + the hermetic allocation-path e2e.
+
+The e2e drives the REAL fake-clientset pipeline — webhook mutate mints
+the context, the filter commits a node, bind creates the Binding, the
+device plugin Allocates (injecting the trace env), and the tenant
+registers over a real registry socket — then asserts one coherent
+timeline assembles from the spools, the way scripts/vtrace.py and the
+monitor's /traces endpoint read them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from vtpu_manager import trace
+from vtpu_manager.trace import assemble
+from vtpu_manager.trace.metrics import render_trace_metrics
+from vtpu_manager.trace.recorder import Span, SpanRecorder
+from vtpu_manager.util import consts
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# UUID-shaped: the registry's identity validation requires it
+POD_UID = "11111111-2222-3333-4444-555555555555"
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_between_tests():
+    yield
+    trace.reset()
+
+
+def mk_span(stage="s", trace_id="t1", pod_uid="u1", start=100.0, dur=0.001,
+            **attrs):
+    return Span(stage=stage, trace_id=trace_id, pod_uid=pod_uid,
+                start_s=start, dur_s=dur, attrs=attrs)
+
+
+class TestRecorder:
+    def test_ring_bounds_and_drop_counter(self, tmp_path):
+        rec = SpanRecorder("svc", str(tmp_path), capacity=4,
+                           flush_at=99)   # auto-flush disabled
+        for i in range(7):
+            rec.record(mk_span(stage=f"s{i}"))
+        assert rec.pending() == 4
+        assert rec.dropped == 3
+
+    def test_flush_drains_and_spools(self, tmp_path):
+        rec = SpanRecorder("svc", str(tmp_path), capacity=8, flush_at=99)
+        rec.record(mk_span(stage="a"))
+        rec.record(mk_span(stage="b"))
+        assert rec.flush() == 2
+        assert rec.pending() == 0
+        spans, drops = assemble.read_spools(str(tmp_path))
+        assert sorted(s.stage for s in spans) == ["a", "b"]
+        assert drops == {("svc", os.getpid()): 0}
+
+    def test_drop_count_reaches_spool_meta(self, tmp_path):
+        rec = SpanRecorder("svc", str(tmp_path), capacity=2, flush_at=99)
+        for _ in range(5):
+            rec.record(mk_span())
+        rec.flush()
+        _, drops = assemble.read_spools(str(tmp_path))
+        assert drops[("svc", os.getpid())] == 3
+
+    def test_record_does_no_io_only_wakes_flusher(self, tmp_path):
+        rec = SpanRecorder("svc", str(tmp_path), capacity=4)  # wake at 2
+        rec.record(mk_span(stage="a"))
+        assert not rec._wake.is_set()       # below threshold: buffered
+        rec.record(mk_span(stage="b"))
+        assert rec._wake.is_set()           # threshold: flusher woken...
+        assert rec.pending() == 2           # ...but NO inline spool write
+        assert not os.path.exists(rec.spool_path)
+
+    def test_background_flusher_drains_on_wake(self, tmp_path):
+        import threading
+        import time as _time
+        rec = SpanRecorder("svc", str(tmp_path), capacity=4)
+        t = threading.Thread(target=rec.run_flusher, args=(30.0,),
+                             daemon=True)
+        t.start()
+        try:
+            rec.record(mk_span(stage="a"))
+            rec.record(mk_span(stage="b"))  # threshold wake (not interval)
+            deadline = _time.monotonic() + 5.0
+            while rec.pending() and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            assert rec.pending() == 0
+            spans, _ = assemble.read_spools(str(tmp_path))
+            assert sorted(s.stage for s in spans) == ["a", "b"]
+        finally:
+            rec.stop_flusher()
+            t.join(timeout=5)
+
+    def test_unwritable_spool_counts_as_drops(self, tmp_path):
+        blocker = tmp_path / "f"
+        blocker.write_text("")
+        rec = SpanRecorder("svc", str(blocker / "sub"), capacity=8,
+                           flush_at=99)
+        rec.record(mk_span())
+        assert rec.flush() == 0
+        assert rec.dropped == 1
+
+    def test_record_and_flush_never_raise_with_spool_broken(self, tmp_path):
+        blocker = tmp_path / "f"
+        blocker.write_text("")
+        rec = SpanRecorder("svc", str(blocker / "sub"), capacity=4)
+        for _ in range(10):
+            rec.record(mk_span())          # must not raise
+        rec.flush()                        # must not raise either
+
+    def test_spool_rotation_bounds_growth(self, tmp_path):
+        rec = SpanRecorder("svc", str(tmp_path), capacity=64, flush_at=99,
+                           max_spool_bytes=2048)
+
+        def one_round(round_):
+            for i in range(20):
+                rec.record(mk_span(stage=f"s{round_}",
+                                   pod_uid=f"u{round_}-{i}"))
+            rec.flush()
+
+        one_round(0)
+        batch = os.path.getsize(rec.spool_path)   # bytes per flush
+        for round_ in range(1, 8):
+            one_round(round_)
+        names = [n for n in os.listdir(str(tmp_path))
+                 if n.endswith(".jsonl")]
+        assert len(names) == 2             # current + one .prev generation
+        assert any(".prev." in n for n in names)
+        total = sum(os.path.getsize(os.path.join(str(tmp_path), n))
+                    for n in names)
+        # each generation is bounded by cap + one flush batch, and more
+        # rounds never add files — growth is bounded, not linear
+        assert total <= 2 * (2048 + batch)
+        # rotated generation still readable by assembly
+        spans, _ = assemble.read_spools(str(tmp_path))
+        assert len(spans) > 20
+
+    def test_reap_stale_spools(self, tmp_path):
+        from vtpu_manager.trace.recorder import reap_stale_spools
+        rec = SpanRecorder("svc", str(tmp_path), capacity=4, flush_at=99)
+        rec.record(mk_span())
+        rec.flush()
+        old = tmp_path / "dead.999.jsonl"
+        old.write_text('{"kind":"meta","service":"dead","pid":999,'
+                       '"drops":0}\n')
+        os.utime(str(old), (1, 1))          # ancient mtime
+        removed = reap_stale_spools(str(tmp_path), max_age_s=3600)
+        assert removed == 1
+        assert not old.exists()
+        assert os.path.exists(rec.spool_path)   # live spool untouched
+
+
+class TestGateAndSampling:
+    def test_off_is_a_shared_null_span(self):
+        trace.reset()
+        ctx = trace.TraceContext(trace_id="t", pod_uid="u")
+        # the off path returns the module-level singleton: no per-call
+        # object construction, no clock reads — the zero-overhead claim
+        assert trace.span(ctx, "x") is trace._NULL_SPAN
+        assert trace.span(None, "x") is trace._NULL_SPAN
+        assert trace.mint_for_pod({"metadata": {"uid": "u"}}) is None
+        assert trace.context_for_pod({"metadata": {}}) is None
+        assert trace.flush() == 0
+
+    def test_sampling_zero_short_circuits(self, tmp_path):
+        trace.configure("t", str(tmp_path / "spool"), sampling_rate=0.0)
+        ctx = trace.mint_for_pod({"metadata": {"uid": "u1"}})
+        # the decision is minted (and must propagate) even when negative
+        assert ctx is not None and ctx.sampled is False
+        assert trace.annotation_values(ctx)[
+            consts.trace_sampled_annotation()] == "false"
+        assert trace.span(ctx, "scheduler.filter") is trace._NULL_SPAN
+        with trace.span(ctx, "scheduler.filter"):
+            pass
+        trace.event(ctx, "shim.register")
+        assert trace.recorder().pending() == 0
+        assert trace.recorder().dropped == 0
+        trace.flush()
+        spans, _ = assemble.read_spools(str(tmp_path / "spool"))
+        assert spans == []
+
+    def test_sampling_is_deterministic_per_trace_id(self, tmp_path):
+        trace.configure("t", str(tmp_path), sampling_rate=0.5)
+        pod = {"metadata": {"uid": "uid-stable"}}
+        decisions = {trace.mint_for_pod(pod).sampled for _ in range(10)}
+        assert len(decisions) == 1
+
+    def test_unsampled_context_survives_propagation(self, tmp_path):
+        trace.configure("t", str(tmp_path), sampling_rate=0.0)
+        ctx = trace.mint_for_pod({"metadata": {"uid": "u1"}})
+        pod = {"metadata": {"uid": "u1",
+                            "annotations": trace.annotation_values(ctx)}}
+        downstream = trace.context_for_pod(pod)
+        assert downstream is not None and downstream.sampled is False
+
+    def test_uid_joined_stages_recompute_the_sampling_decision(
+            self, tmp_path):
+        """dra/registry stages see no annotation: they recompute the
+        deterministic verdict from the uid and must agree with the mint
+        (all-record-or-all-skip across every process)."""
+        from vtpu_manager.trace import context as tctx
+        trace.configure("t", str(tmp_path), sampling_rate=0.0)
+        assert trace.context_for_uid("any-uid").sampled is False
+        claim = {"metadata": {"uid": "c1"},
+                 "status": {"reservedFor": [{"uid": "p1"}]}}
+        assert trace.context_for_claim(claim).sampled is False
+        trace.configure("t", str(tmp_path), sampling_rate=0.3)
+        for uid in ("u-a", "u-b", "u-c", "u-d", "u-e"):
+            minted = tctx.mint({"metadata": {"uid": uid}}, 0.3)
+            assert trace.context_for_uid(uid).sampled == minted.sampled
+
+    def test_span_records_error_attr(self, tmp_path):
+        trace.configure("t", str(tmp_path), sampling_rate=1.0)
+        ctx = trace.mint_for_pod({"metadata": {"uid": "u1"}})
+        with pytest.raises(ValueError):
+            with trace.span(ctx, "scheduler.filter"):
+                raise ValueError("boom")
+        trace.flush()
+        spans, _ = assemble.read_spools(str(tmp_path))
+        assert spans[0].attrs.get("error") == "ValueError"
+
+
+class TestAssembly:
+    def test_joins_by_uid_without_trace_id(self):
+        spans = [mk_span(stage="scheduler.filter", trace_id="t1",
+                         pod_uid="u1", start=1.0),
+                 mk_span(stage="dra.prepare", trace_id="", pod_uid="u1",
+                         start=2.0),
+                 mk_span(stage="registry.register", trace_id="",
+                         pod_uid="u1", start=3.0)]
+        tls = assemble.assemble(spans)
+        assert set(tls) == {"u1"}
+        assert tls["u1"].trace_id == "t1"
+        assert [s.stage for s in tls["u1"].spans] == [
+            "scheduler.filter", "dra.prepare", "registry.register"]
+
+    def test_joins_by_trace_id_learning_uid(self):
+        # a span that knows both keys teaches the join; uid-less spans
+        # with the same trace id land in the same timeline
+        spans = [mk_span(stage="webhook.mutate", trace_id="t1",
+                         pod_uid="u1"),
+                 mk_span(stage="shim.register", trace_id="t1", pod_uid="",
+                         start=101.0)]
+        tls = assemble.assemble(spans)
+        assert set(tls) == {"u1"}
+        assert len(tls["u1"].spans) == 2
+
+    def test_find_timeline_by_trace_id(self):
+        spans = [mk_span(stage="webhook.mutate", trace_id="tid-9",
+                         pod_uid="u9")]
+        tls = assemble.assemble(spans)
+        assert assemble.find_timeline(tls, "u9") is tls["u9"]
+        assert assemble.find_timeline(tls, "tid-9") is tls["u9"]
+        assert assemble.find_timeline(tls, "nope") is None
+
+    def test_critical_path_gaps(self):
+        spans = [mk_span(stage="scheduler.filter", start=1.0, dur=0.5),
+                 mk_span(stage="scheduler.bind", start=2.0, dur=0.25)]
+        rows = assemble.critical_path(assemble.assemble(spans)["u1"])
+        assert rows[0]["gap_s"] == 0.0
+        assert rows[1]["gap_s"] == pytest.approx(0.5)   # 2.0 - (1.0+0.5)
+        assert rows[1]["offset_s"] == pytest.approx(1.0)
+
+    def test_outliers_flag_only_slow_spans(self):
+        spans = [mk_span(stage="scheduler.filter", dur=0.002,
+                         pod_uid=f"u{i}") for i in range(5)]
+        spans.append(mk_span(stage="scheduler.filter", dur=0.2,
+                             pod_uid="slow"))
+        found = assemble.outliers(spans)
+        assert [o["pod_uid"] for o in found] == ["slow"]
+
+    def test_torn_spool_lines_skipped(self, tmp_path):
+        rec = SpanRecorder("svc", str(tmp_path), capacity=8, flush_at=99)
+        rec.record(mk_span(stage="ok"))
+        rec.flush()
+        with open(rec.spool_path, "a") as f:
+            f.write('{"kind":"span","stage":"torn')   # no newline, cut
+        spans, _ = assemble.read_spools(str(tmp_path))
+        assert [s.stage for s in spans] == ["ok"]
+
+    def test_metrics_render(self, tmp_path):
+        rec = SpanRecorder("plugin", str(tmp_path), capacity=1, flush_at=99)
+        rec.record(mk_span(stage="plugin.allocate", dur=0.004))
+        rec.record(mk_span(stage="plugin.allocate"))   # dropped (full)
+        rec.flush()
+        text = render_trace_metrics(str(tmp_path))
+        assert 'vtpu_trace_spool_dropped_total{service="plugin"} 1' in text
+        assert ('vtpu_trace_stage_duration_seconds_count'
+                '{stage="plugin.allocate"} 1') in text
+        assert ('vtpu_trace_stage_duration_seconds_bucket'
+                '{stage="plugin.allocate",le="0.005"} 1') in text
+
+
+def _apply_annotation_patches(pod: dict, patches: list[dict]) -> None:
+    """Apply the subset of RFC-6902 ops mutate emits against annotations
+    (enough fidelity for the pipeline; the apiserver does this in prod)."""
+    for patch in patches:
+        path = patch["path"]
+        if path == "/metadata/annotations":
+            pod.setdefault("metadata", {}).setdefault("annotations", {})
+            continue
+        prefix = "/metadata/annotations/"
+        if not path.startswith(prefix):
+            continue
+        key = path[len(prefix):].replace("~1", "/").replace("~0", "~")
+        anns = pod.setdefault("metadata", {}).setdefault("annotations", {})
+        if patch["op"] == "remove":
+            anns.pop(key, None)
+        else:
+            anns[key] = patch["value"]
+
+
+class TestEndToEnd:
+    """mutate -> filter -> bind -> Allocate -> register, one timeline."""
+
+    def _run_pipeline(self, tmp_path, monkeypatch) -> str:
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.config.node_config import NodeConfig
+        from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+        from vtpu_manager.deviceplugin.vnum import VnumPlugin, device_id
+        from vtpu_manager.device.claims import PodDeviceClaims
+        from vtpu_manager.manager.device_manager import DeviceManager
+        from vtpu_manager.registry.server import RegistryServer
+        from vtpu_manager.runtime import client as rc
+        from vtpu_manager.scheduler.bind import BindPredicate
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.tpu.discovery import FakeBackend
+        from vtpu_manager.webhook.mutate import mutate_pod
+
+        spool = str(tmp_path / "spool")
+        trace.configure("e2e", spool, sampling_rate=1.0)
+        # node trace dir (the tenant mount source) kept under tmp
+        monkeypatch.setattr(consts, "TRACE_DIR",
+                            str(tmp_path / "node-trace"))
+
+        # node agent side: manager + registered node annotation
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-1", "annotations": {}}})
+        mgr = DeviceManager(
+            "node-1", client,
+            node_config=NodeConfig(device_split_count=4),
+            backends=[FakeBackend(n_chips=2)])
+        mgr.init_devices()
+        mgr.register_node()
+
+        # 1) admission: mutate mints + propagates the context
+        pod = {
+            "metadata": {"name": "p1", "namespace": "default",
+                         "uid": POD_UID, "annotations": {}},
+            "spec": {"containers": [{
+                "name": "main", "resources": {"limits": {
+                    consts.vtpu_number_resource(): 1,
+                    consts.vtpu_cores_resource(): 25,
+                    consts.vtpu_memory_resource(): 1024}}}]},
+            "status": {"phase": "Pending"},
+        }
+        result = mutate_pod(pod)
+        _apply_annotation_patches(pod, result.patches)
+        anns = pod["metadata"]["annotations"]
+        assert anns[consts.trace_id_annotation()] == POD_UID
+        assert anns[consts.trace_sampled_annotation()] == "true"
+        client.add_pod(pod)
+
+        # 2) filter commits a node, 3) bind creates the Binding
+        fresult = FilterPredicate(client).filter({"Pod": pod})
+        assert not fresult.error, fresult.error
+        node = fresult.node_names[0]
+        bresult = BindPredicate(client).bind(
+            {"PodNamespace": "default", "PodName": "p1", "Node": node})
+        assert not bresult.error, bresult.error
+
+        # 4) kubelet Allocate against the committed claims
+        base = str(tmp_path / "mgr")
+        plugin = VnumPlugin(mgr, client, "node-1", base_dir=base,
+                            node_config=NodeConfig())
+        bound = client.get_pod("default", "p1")
+        pre = PodDeviceClaims.decode(
+            bound["metadata"]["annotations"][
+                consts.pre_allocated_annotation()])
+        dev_ids = [device_id(c.uuid, 0) for c in pre.containers["main"]]
+        resp = plugin.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=dev_ids)]))
+        envs = resp.container_responses[0].envs
+        assert envs[consts.ENV_TRACE_ID] == POD_UID
+        assert envs[consts.ENV_TRACE_SAMPLED] == "true"
+        # traced tenants get the node trace dir mounted read-write so
+        # their shim-side spans can spool to the node
+        assert any(m.host_path == consts.TRACE_DIR and not m.read_only
+                   for m in resp.container_responses[0].mounts)
+
+        # 5) tenant registration over a real registry socket (both the
+        # shim-side and daemon-side spans)
+        sock = str(tmp_path / "registry.sock")
+
+        def cgroup_of_pid(pid):
+            return f"/kubepods/burstable/pod{POD_UID}/leaf{pid}"
+
+        server = RegistryServer(socket_path=sock, base_dir=base,
+                                cgroup_of_pid=cgroup_of_pid,
+                                pids_in_cgroup=lambda cg: [os.getpid()])
+        server.start()
+        try:
+            for env_key, value in [(consts.ENV_POD_UID, POD_UID),
+                                   (consts.ENV_POD_NAME, "p1"),
+                                   (consts.ENV_POD_NAMESPACE, "default"),
+                                   (consts.ENV_CONTAINER_NAME, "main"),
+                                   (consts.ENV_REGISTRY_SOCKET, sock),
+                                   (consts.ENV_TRACE_ID,
+                                    envs[consts.ENV_TRACE_ID]),
+                                   (consts.ENV_TRACE_SAMPLED,
+                                    envs[consts.ENV_TRACE_SAMPLED])]:
+                monkeypatch.setenv(env_key, value)
+            assert rc.register_client(timeout_s=5)
+        finally:
+            server.stop()
+
+        trace.flush()
+        return spool
+
+    def test_one_coherent_timeline_assembles(self, tmp_path, monkeypatch):
+        spool = self._run_pipeline(tmp_path, monkeypatch)
+        spans, drops = assemble.read_spools(spool)
+        assert sum(drops.values()) == 0
+        timelines = assemble.assemble(spans)
+        assert POD_UID in timelines
+        tl = timelines[POD_UID]
+        assert tl.trace_id == POD_UID
+        stages = tl.stages()
+        for want in ("webhook.mutate", "scheduler.filter",
+                     "scheduler.bind", "plugin.allocate", "plugin.config",
+                     "registry.register", "shim.register"):
+            assert want in stages, f"missing {want} in {sorted(stages)}"
+        # causal order along the admission path
+        order = [s.stage for s in tl.spans]
+        assert order.index("webhook.mutate") \
+            < order.index("scheduler.filter") \
+            < order.index("scheduler.bind") \
+            < order.index("plugin.allocate")
+        # the bind span carries the filter's commit stamp
+        bind_span = next(s for s in tl.spans
+                         if s.stage == "scheduler.bind")
+        assert bind_span.attrs.get("predicate_time", 0) > 0
+        # nested stages sit inside their parents
+        alloc = next(s for s in tl.spans if s.stage == "plugin.allocate")
+        config = next(s for s in tl.spans if s.stage == "plugin.config")
+        assert alloc.start_s <= config.start_s
+        assert config.dur_s <= alloc.dur_s
+        rows = assemble.critical_path(tl)
+        assert rows[0]["stage"] == "webhook.mutate"
+        assert all(row["gap_s"] >= 0 for row in rows)
+
+    def test_vtrace_cli_reconstructs_timeline(self, tmp_path, monkeypatch):
+        spool = self._run_pipeline(tmp_path, monkeypatch)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts/vtrace.py"),
+             "--spool-dir", spool, "--pod", POD_UID],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        for stage in ("webhook.mutate", "scheduler.filter",
+                      "scheduler.bind", "plugin.allocate",
+                      "registry.register"):
+            assert stage in proc.stdout
+        as_json = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts/vtrace.py"),
+             "--spool-dir", spool, "--pod", POD_UID, "--json"],
+            capture_output=True, text=True, timeout=60)
+        doc = json.loads(as_json.stdout)
+        assert doc["timeline"]["pod_uid"] == POD_UID
+        assert doc["critical_path"]
+
+    def test_gate_off_pipeline_records_nothing(self, tmp_path, monkeypatch):
+        """The whole instrumented pipeline with tracing unconfigured:
+        no annotations minted, no envs injected, no spool created — the
+        short-circuit is asserted end to end, not per call site."""
+        trace.reset()
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.config.node_config import NodeConfig
+        from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+        from vtpu_manager.deviceplugin.vnum import VnumPlugin, device_id
+        from vtpu_manager.device.claims import PodDeviceClaims
+        from vtpu_manager.manager.device_manager import DeviceManager
+        from vtpu_manager.scheduler.bind import BindPredicate
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.tpu.discovery import FakeBackend
+        from vtpu_manager.webhook.mutate import mutate_pod
+
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-1", "annotations": {}}})
+        mgr = DeviceManager("node-1", client,
+                            node_config=NodeConfig(device_split_count=4),
+                            backends=[FakeBackend(n_chips=2)])
+        mgr.init_devices()
+        mgr.register_node()
+        pod = {
+            "metadata": {"name": "p1", "namespace": "default",
+                         "uid": POD_UID, "annotations": {}},
+            "spec": {"containers": [{
+                "name": "main", "resources": {"limits": {
+                    consts.vtpu_number_resource(): 1,
+                    consts.vtpu_cores_resource(): 25,
+                    consts.vtpu_memory_resource(): 1024}}}]},
+            "status": {"phase": "Pending"},
+        }
+        result = mutate_pod(pod)
+        _apply_annotation_patches(pod, result.patches)
+        assert consts.trace_id_annotation() \
+            not in pod["metadata"]["annotations"]
+        client.add_pod(pod)
+        fresult = FilterPredicate(client).filter({"Pod": pod})
+        assert not fresult.error
+        assert not BindPredicate(client).bind(
+            {"PodNamespace": "default", "PodName": "p1",
+             "Node": fresult.node_names[0]}).error
+        plugin = VnumPlugin(mgr, client, "node-1",
+                            base_dir=str(tmp_path / "mgr"),
+                            node_config=NodeConfig())
+        bound = client.get_pod("default", "p1")
+        pre = PodDeviceClaims.decode(
+            bound["metadata"]["annotations"][
+                consts.pre_allocated_annotation()])
+        resp = plugin.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=[
+                device_id(c.uuid, 0) for c in pre.containers["main"]])]))
+        assert consts.ENV_TRACE_ID not in resp.container_responses[0].envs
+        assert not os.path.exists(str(tmp_path / "spool"))
+
+
+class TestTenantSideTracing:
+    """Tenant processes have no gate wiring: the Allocate-injected env
+    is the gate, and the recorder targets the mounted node trace dir."""
+
+    def test_env_auto_configures_and_spools(self, tmp_path, monkeypatch):
+        import vtpu_manager.runtime.client as rc
+        trace.reset()
+        monkeypatch.setattr(rc, "_first_execute_marked", False)
+        monkeypatch.setenv(consts.ENV_TRACE_ID, "tid-1")
+        monkeypatch.setenv(consts.ENV_TRACE_SAMPLED, "true")
+        monkeypatch.setenv(consts.ENV_POD_UID, "u1")
+        monkeypatch.setenv(consts.ENV_TRACE_DIR, str(tmp_path))
+        rc.mark_first_execute()
+        assert trace.is_enabled()
+        trace.flush()
+        spans, _ = assemble.read_spools(str(tmp_path))
+        assert [s.stage for s in spans] == ["shim.first_execute"]
+        assert spans[0].trace_id == "tid-1"
+        assert spans[0].service == "tenant"
+
+    def test_unsampled_tenant_never_configures(self, tmp_path, monkeypatch):
+        import vtpu_manager.runtime.client as rc
+        trace.reset()
+        monkeypatch.setattr(rc, "_first_execute_marked", False)
+        monkeypatch.setenv(consts.ENV_TRACE_ID, "tid-1")
+        monkeypatch.setenv(consts.ENV_TRACE_SAMPLED, "false")
+        monkeypatch.setenv(consts.ENV_TRACE_DIR, str(tmp_path))
+        rc.mark_first_execute()
+        assert not trace.is_enabled()
+        assert not os.listdir(str(tmp_path))
+
+    def test_untraced_tenant_never_configures(self, tmp_path, monkeypatch):
+        import vtpu_manager.runtime.client as rc
+        trace.reset()
+        monkeypatch.setattr(rc, "_first_execute_marked", False)
+        monkeypatch.delenv(consts.ENV_TRACE_ID, raising=False)
+        rc.mark_first_execute()
+        assert not trace.is_enabled()
+
+
+class TestPredicateTimeParse:
+    def test_shared_parser_semantics(self):
+        ann = consts.predicate_time_annotation()
+        assert consts.parse_predicate_time(None) is None
+        assert consts.parse_predicate_time({}) is None
+        assert consts.parse_predicate_time({ann: "garbage"}) is None
+        assert consts.parse_predicate_time({ann: "12.5"}) == 12.5
+
+    def test_bind_tolerates_garbage_stamp(self):
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.scheduler.bind import BindPredicate
+        client = FakeKubeClient()
+        client.add_pod({
+            "metadata": {"name": "p1", "namespace": "default",
+                         "uid": "u1", "annotations": {
+                             consts.predicate_node_annotation(): "n1",
+                             consts.predicate_time_annotation(): "junk"}},
+            "spec": {}, "status": {}})
+        result = BindPredicate(client).bind(
+            {"PodNamespace": "default", "PodName": "p1", "Node": "n1"})
+        assert not result.error
+        assert client.bindings == [("default", "p1", "n1")]

@@ -23,7 +23,8 @@ from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
 
 RULE = "exception-hygiene"
 
-SCOPED_DIRS = ("scheduler", "manager", "deviceplugin", "kubeletplugin")
+SCOPED_DIRS = ("scheduler", "manager", "deviceplugin", "kubeletplugin",
+               "trace")
 
 _LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
                 "critical", "log"}

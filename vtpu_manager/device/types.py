@@ -238,12 +238,10 @@ def should_count_pod(pod: dict, now: float | None = None,
             grace = float(override)
         except ValueError:
             pass
-    ts_raw = anns.get(consts.predicate_time_annotation())
-    if not ts_raw:
-        return True
-    try:
-        ts = float(ts_raw)
-    except ValueError:
+    ts = consts.parse_predicate_time(anns)
+    if ts is None:
+        # absent/garbage stamp: count the pod (never free capacity on a
+        # parse failure) — same semantics the ad-hoc parse had
         return True
     now = time.time() if now is None else now
     return (now - ts) <= grace

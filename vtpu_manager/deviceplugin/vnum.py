@@ -22,6 +22,7 @@ import threading
 import time
 from collections import Counter
 
+from vtpu_manager import trace
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.config import vtpu_config as vc
 from vtpu_manager.config.node_config import NodeConfig
@@ -215,16 +216,19 @@ class VnumPlugin(DevicePluginServicer):
         pod, cont, claims = match
         meta = pod.get("metadata") or {}
         uid = meta.get("uid", "")
+        ctx = trace.context_for_pod(pod)
         try:
-            response = self._response_for(pod, cont, claims)
-            self._record_devices(uid, cont, dev_ids, claims)
-            self.client.patch_pod_annotations(
-                meta.get("namespace", "default"), meta.get("name", ""), {
-                    consts.real_allocated_annotation():
-                        self._claims_annotation(pod, cont, claims),
-                    consts.allocation_status_annotation():
-                        consts.ALLOC_STATUS_SUCCEED,
-                })
+            with trace.span(ctx, "plugin.allocate", container=cont,
+                            devices=len(dev_ids)):
+                response = self._response_for(pod, cont, claims)
+                self._record_devices(uid, cont, dev_ids, claims)
+                self.client.patch_pod_annotations(
+                    meta.get("namespace", "default"), meta.get("name", ""), {
+                        consts.real_allocated_annotation():
+                            self._claims_annotation(pod, cont, claims),
+                        consts.allocation_status_annotation():
+                            consts.ALLOC_STATUS_SUCCEED,
+                    })
             with self._served_lock:
                 self._served.add((uid, cont))
             return response
@@ -322,15 +326,28 @@ class VnumPlugin(DevicePluginServicer):
         resp.envs[consts.ENV_POD_UID] = uid
         resp.envs[consts.ENV_CONTAINER_NAME] = cont
 
+        # vtrace: hand the admission-minted context into the container
+        # (env is the only channel that reaches the shim/runtime client),
+        # carrying the sampling decision so tenants skip coherently
+        ctx = trace.context_for_pod(pod) if pod is not None else None
+        if ctx is not None and ctx.trace_id:
+            resp.envs[consts.ENV_TRACE_ID] = ctx.trace_id
+            resp.envs[consts.ENV_TRACE_SAMPLED] = \
+                "true" if ctx.sampled else "false"
+
         if pod is not None and not self.disable_control:
             cont_dir = self._container_dir(uid, cont)
             config_host = os.path.join(cont_dir, "config")
-            os.makedirs(config_host, exist_ok=True)
-            cfg = vc.VtpuConfig(pod_uid=uid, pod_name=meta.get("name", ""),
-                                pod_namespace=meta.get("namespace", ""),
-                                container_name=cont, compat_mode=compat,
-                                devices=devices)
-            vc.write_config(os.path.join(config_host, "vtpu.config"), cfg)
+            with trace.span(ctx, "plugin.config", container=cont,
+                            devices=len(devices)):
+                os.makedirs(config_host, exist_ok=True)
+                cfg = vc.VtpuConfig(pod_uid=uid,
+                                    pod_name=meta.get("name", ""),
+                                    pod_namespace=meta.get("namespace", ""),
+                                    container_name=cont, compat_mode=compat,
+                                    devices=devices)
+                vc.write_config(os.path.join(config_host, "vtpu.config"),
+                                cfg)
             # mounts: per-container config, the shim, locks, vmem, watcher
             # (reference vnum_plugin.go:799-879); the PJRT substitution envs
             # play the role of ld.so.preload (:872-879)
@@ -343,6 +360,19 @@ class VnumPlugin(DevicePluginServicer):
             for path in (consts.LOCK_DIR, consts.VMEM_DIR):
                 resp.mounts.append(pb.Mount(container_path=path,
                                             host_path=path, read_only=False))
+            if ctx is not None and ctx.sampled:
+                # tenant-side spans (shim register / first-execute) spool
+                # into the node trace dir — mounted read-write like the
+                # lock/vmem dirs so runtime/client's recorder reaches it
+                try:
+                    os.makedirs(consts.TRACE_DIR, exist_ok=True)
+                    resp.mounts.append(pb.Mount(
+                        container_path=consts.TRACE_DIR,
+                        host_path=consts.TRACE_DIR, read_only=False))
+                except OSError as e:
+                    log.warning("trace dir %s unavailable (%s); tenant "
+                                "spans for %s/%s will not spool",
+                                consts.TRACE_DIR, e, uid, cont)
             resp.mounts.append(pb.Mount(
                 container_path=consts.WATCHER_DIR,
                 host_path=consts.WATCHER_DIR, read_only=True))

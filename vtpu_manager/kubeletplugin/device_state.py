@@ -14,6 +14,7 @@ import logging
 import os
 import shutil
 
+from vtpu_manager import trace
 from vtpu_manager.claimresolve.resolve import resolve_claim_partitions
 from vtpu_manager.config import vtpu_config as vc
 from vtpu_manager.config.node_config import NodeConfig
@@ -300,7 +301,9 @@ class DeviceState:
                 spec = cdi.build_multi_spec(uid, groups, self.shim_host_dir,
                                             client_mode=client_mode)
                 cdi_names = list(dict.fromkeys(d["cdi"] for d in devices))
-            cdi.write_spec(spec, uid, self.cdi_dir)
+            with trace.span(trace.context_for_claim(claim), "dra.cdi",
+                            claim=uid, devices=len(cdi_names)):
+                cdi.write_spec(spec, uid, self.cdi_dir)
 
             before = dict(self.checkpoint.claims)
             self.checkpoint.claims[uid] = PreparedClaim(

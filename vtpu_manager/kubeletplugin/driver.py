@@ -15,6 +15,7 @@ from concurrent import futures
 
 import grpc
 
+from vtpu_manager import trace
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.kubeletplugin.api import dra_pb2 as pb
@@ -125,7 +126,11 @@ class DraDriver:
                                f"{claim_ref.name} not found")
                 continue
             try:
-                cdi_ids = self.state.prepare_claim(claim)
+                # joined to the pod's timeline by reservedFor uid (claims
+                # carry no trace annotation — context.py:for_claim)
+                with trace.span(trace.context_for_claim(claim),
+                                "dra.prepare", claim=claim_ref.uid):
+                    cdi_ids = self.state.prepare_claim(claim)
             except Exception as e:
                 # one malformed claim (bad opaque params -> ValueError,
                 # disk errors -> OSError) must fail only its own entry,

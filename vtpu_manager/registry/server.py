@@ -24,6 +24,7 @@ import struct
 import threading
 from typing import Callable
 
+from vtpu_manager import trace
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -184,6 +185,16 @@ class RegistryServer:
         with self._bind_lock:
             if not self._admit_binding(pod_uid, container, cgroup, peer_pid):
                 return 3
+        # vtrace: the registration is the last daemon-side stage of the
+        # allocation path (the tenant is up and announcing itself); joined
+        # by pod uid — the socket protocol carries no trace id
+        with trace.span(trace.context_for_uid(pod_uid), "registry.register",
+                        container=container):
+            return self._register_attested(pod_uid, container, cgroup,
+                                           peer_pid)
+
+    def _register_attested(self, pod_uid: str, container: str, cgroup: str,
+                           peer_pid: int) -> int:
         pids = self.pids_in_cgroup(cgroup)
         if peer_pid not in pids:
             pids.append(peer_pid)

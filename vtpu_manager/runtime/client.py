@@ -13,6 +13,8 @@ reference's in-container plumbing that ld.so.preload does implicitly
   Python code (metrics, tests) can see its own caps.
 - register_client(): CLIENT-compat-mode registration over the registry
   socket (pid attribution without exposing host /proc).
+- mark_first_execute(): vtrace terminal event — the moment the tenant
+  first reaches the device, closing the admission-to-running timeline.
 """
 
 from __future__ import annotations
@@ -23,6 +25,7 @@ import socket
 import struct
 from dataclasses import dataclass
 
+from vtpu_manager import trace
 from vtpu_manager.config import vtpu_config as vc
 from vtpu_manager.util import consts
 
@@ -32,6 +35,24 @@ class EffectiveLimits:
     devices: list[vc.DeviceConfig]
     compat_mode: int
     source: str              # "config-file" | "env" | "none"
+
+
+def _ensure_tenant_trace() -> None:
+    """Configure tracing from the injected env on first use. Tenant
+    processes have no --feature-gates wiring: the Allocate-injected
+    VTPU_TRACE_ID *is* the gate (only pods admitted under Tracing carry
+    it), the sampling decision rides VTPU_TRACE_SAMPLED, and the spool
+    dir is the node trace dir the plugin mounted read-write. Unsampled
+    tenants skip configuration entirely — no recorder, no spool file."""
+    if trace.is_enabled():
+        return
+    if not os.environ.get(consts.ENV_TRACE_ID):
+        return
+    if os.environ.get(consts.ENV_TRACE_SAMPLED, "true") != "true":
+        return
+    trace.configure("tenant",
+                    spool_dir=os.environ.get(consts.ENV_TRACE_DIR)
+                    or consts.TRACE_DIR)
 
 
 def _env_limits() -> EffectiveLimits | None:
@@ -95,6 +116,8 @@ def install(shim_path: str | None = None,
         os.environ[consts.ENV_VTPU_REAL_PLUGIN_PATH] = real
     os.environ[consts.ENV_TPU_LIBRARY_PATH] = shim
     os.environ[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
+    _ensure_tenant_trace()
+    trace.event(trace.context_from_env(), "shim.install", shim=shim)
     return True
 
 
@@ -114,18 +137,40 @@ def register_client(timeout_s: float = 5.0) -> bool:
         "container": os.environ.get(consts.ENV_CONTAINER_NAME, ""),
         "register_uuid": os.environ.get(consts.ENV_REGISTER_UUID, ""),
     }).encode()
-    try:
-        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
-            sock.settimeout(timeout_s)
-            sock.connect(path)
-            sock.sendall(struct.pack("<I", len(payload)) + payload)
-            raw = sock.recv(4)
-            if len(raw) < 4:
-                return False
-            (status,) = struct.unpack("<i", raw)
-            return status == 0
-    except OSError:
-        return False
+    # client-side registration span (env-propagated context): paired with
+    # the daemon's registry.register span, the delta is socket queueing
+    _ensure_tenant_trace()
+    with trace.span(trace.context_from_env(), "shim.register"):
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+                sock.settimeout(timeout_s)
+                sock.connect(path)
+                sock.sendall(struct.pack("<I", len(payload)) + payload)
+                raw = sock.recv(4)
+                if len(raw) < 4:
+                    return False
+                (status,) = struct.unpack("<i", raw)
+                return status == 0
+        except OSError:
+            return False
+
+
+_first_execute_marked = False
+
+
+def mark_first_execute() -> None:
+    """Record the tenant's first-execute moment (idempotent). Python
+    tenants (the trainer, the bench harness) call this right before the
+    first jitted step; the C++ shim's own first Execute is visible to
+    Python only through this hook, so the timeline's terminal event is
+    emitted by whoever drives the runtime."""
+    global _first_execute_marked
+    if _first_execute_marked:
+        return
+    _first_execute_marked = True
+    _ensure_tenant_trace()
+    trace.event(trace.context_from_env(), "shim.first_execute",
+                pid=os.getpid())
 
 
 def main() -> int:

@@ -12,6 +12,7 @@ import logging
 import time
 from dataclasses import dataclass
 
+from vtpu_manager import trace
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.scheduler.serial import SerialLocker
 from vtpu_manager.util import consts
@@ -63,19 +64,21 @@ class BindPredicate:
                 error=f"predicate node {predicate_node!r} != bind "
                       f"target {node!r}")
 
-        ts_raw = anns.get(consts.predicate_time_annotation(), "")
-        try:
-            ts = float(ts_raw)
-        except ValueError:
-            ts = 0.0
+        ts = consts.parse_predicate_time(anns)
         if ts and (time.time() - ts) > self.freshness_s:
             return BindResult(error="pre-allocation expired; re-filter needed")
 
-        try:
-            self.client.patch_pod_annotations(ns, name, {
-                consts.allocation_status_annotation():
-                    consts.ALLOC_STATUS_ALLOCATING})
-            self.client.bind_pod(ns, name, node)
-        except KubeError as e:
-            return BindResult(error=f"bind failed: {e}")
-        return BindResult()
+        # the bind span carries the filter's commit wall time, so the
+        # assembled timeline shows filter-commit -> bind queueing (the
+        # kube-scheduler round trip) without a span of its own
+        ctx = trace.context_for_pod(pod)
+        with trace.span(ctx, "scheduler.bind", node=node,
+                        predicate_time=ts or 0.0):
+            try:
+                self.client.patch_pod_annotations(ns, name, {
+                    consts.allocation_status_annotation():
+                        consts.ALLOC_STATUS_ALLOCATING})
+                self.client.bind_pod(ns, name, node)
+            except KubeError as e:
+                return BindResult(error=f"bind failed: {e}")
+            return BindResult()

@@ -29,6 +29,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from vtpu_manager import trace
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device.allocator.allocator import (AllocationFailure,
                                                      allocate)
@@ -241,16 +242,21 @@ class FilterPredicate:
             return FilterResult(node_names=[
                 (n.get("metadata") or {}).get("name", "") for n in nodes])
 
-        if self.serialize:
-            # Serializing the WHOLE pass including its API I/O is this
-            # lock's purpose (reference SerialFilterNode): two concurrent
-            # filters may not interleave list/allocate/patch, or they
-            # double-book devices. Nothing else ever takes _serial_lock,
-            # so nothing can deadlock on it.
-            with self._serial_lock:
-                # vtlint: disable=lock-discipline — see above
-                return self._filter_locked(pod, req, nodes)
-        return self._filter_locked(pod, req, nodes)
+        # the span opens BEFORE the serial section so serialization wait
+        # (queueing behind other pods' passes) lands in the filter span —
+        # under an admission wave that wait IS the pod's filter latency
+        ctx = trace.context_for_pod(pod)
+        with trace.span(ctx, "scheduler.filter", nodes=len(nodes)):
+            if self.serialize:
+                # Serializing the WHOLE pass including its API I/O is this
+                # lock's purpose (reference SerialFilterNode): two
+                # concurrent filters may not interleave list/allocate/
+                # patch, or they double-book devices. Nothing else ever
+                # takes _serial_lock, so nothing can deadlock on it.
+                with self._serial_lock:
+                    # vtlint: disable=lock-discipline — see above
+                    return self._filter_locked(pod, req, nodes)
+            return self._filter_locked(pod, req, nodes)
 
     def _candidate_nodes(self, args: dict) -> list[dict]:
         # ExtenderArgs with nodeCacheCapable=false carries the full NodeList
@@ -277,6 +283,7 @@ class FilterPredicate:
     def _filter_locked(self, pod: dict, req: AllocationRequest,
                        nodes: list[dict]) -> FilterResult:
         now = time.time()
+        ctx = trace.context_for_pod(pod)
         result = FilterResult()
         reasons = R.FailureReasons()
 
@@ -306,27 +313,33 @@ class FilterPredicate:
             # from this one list so a dead member cannot bias any of them.
             # Needs the FULL list: burst siblings are committed (and carry
             # the gang/predicate annotations) before they have a nodeName.
-            pod_meta = pod.get("metadata") or {}
-            gang_ns = pod_meta.get("namespace", "default")
-            gang_siblings = gang.live_siblings(
-                req.gang_name, pod_meta.get("uid", ""),
-                self._list_all_pods(), namespace=gang_ns)
-            prefer_origin = gang.resolve_gang_origin(
-                req.gang_name, gang_siblings, namespace=gang_ns)
-            # L2 cross-node affinity: domains the gang already occupies.
-            # Domain lookup is bounded to the nodes this call can see; a
-            # sibling on a node outside the candidate list contributes no
-            # signal (bias degrades to none, never to a wrong bias).
-            domain_by_node = {}
-            for node in nodes:
-                meta = node.get("metadata") or {}
-                reg = dt.decode_registry(
-                    (meta.get("annotations") or {}).get(
-                        consts.node_device_register_annotation()))
-                if reg is not None and reg.mesh_domain:
-                    domain_by_node[meta.get("name", "")] = reg.mesh_domain
-            gang_domains = gang.sibling_domains(gang_siblings,
-                                                domain_by_node)
+            # Traced as its own child stage: gang resolution is the one
+            # filter step whose cost scales with the CLUSTER pod list, so
+            # a slow placement must be attributable to it specifically.
+            with trace.span(ctx, "scheduler.gang", gang=req.gang_name):
+                pod_meta = pod.get("metadata") or {}
+                gang_ns = pod_meta.get("namespace", "default")
+                gang_siblings = gang.live_siblings(
+                    req.gang_name, pod_meta.get("uid", ""),
+                    self._list_all_pods(), namespace=gang_ns)
+                prefer_origin = gang.resolve_gang_origin(
+                    req.gang_name, gang_siblings, namespace=gang_ns)
+                # L2 cross-node affinity: domains the gang already
+                # occupies. Domain lookup is bounded to the nodes this
+                # call can see; a sibling on a node outside the candidate
+                # list contributes no signal (bias degrades to none,
+                # never to a wrong bias).
+                domain_by_node = {}
+                for node in nodes:
+                    meta = node.get("metadata") or {}
+                    reg = dt.decode_registry(
+                        (meta.get("annotations") or {}).get(
+                            consts.node_device_register_annotation()))
+                    if reg is not None and reg.mesh_domain:
+                        domain_by_node[meta.get("name", "")] = \
+                            reg.mesh_domain
+                gang_domains = gang.sibling_domains(gang_siblings,
+                                                    domain_by_node)
 
         # Gate + rank every surviving node on fast free totals (memoized
         # registry totals minus claim sums — no DeviceUsage materialized),

@@ -27,6 +27,7 @@ import logging
 import time
 from dataclasses import dataclass, field
 
+from vtpu_manager import trace
 from vtpu_manager.client.kube import KubeClient
 from vtpu_manager.device.allocator.allocator import (AllocationFailure,
                                                      allocate)
@@ -107,6 +108,10 @@ class PreemptPredicate:
 
     def preempt(self, args: dict) -> PreemptResult:
         pod = args.get("Pod") or args.get("pod") or {}
+        with trace.span(trace.context_for_pod(pod), "scheduler.preempt"):
+            return self._preempt(args, pod)
+
+    def _preempt(self, args: dict, pod: dict) -> PreemptResult:
         # kube-scheduler sends NodeNameToVictims (full pods) when
         # nodeCacheCapable=false and NodeNameToMetaVictims (UIDs only) when
         # true; accept both, in Go-field or JSON-tag casing.

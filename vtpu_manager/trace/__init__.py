@@ -1,0 +1,212 @@
+"""vtrace: end-to-end allocation-path tracing across the six binaries.
+
+Answers the question no aggregate gauge can: *where did this pod's
+admission-to-running latency go* — admission mutate, filter scoring, gang
+resolution, bind patch, device-plugin Allocate + config generation, DRA
+prepare/CDI, registry registration, shim startup — across process
+boundaries, joined by a trace id minted at admission (annotation-
+propagated, env-injected into containers) or by pod uid where
+annotations can't reach (DRA claims, the registry socket).
+
+Gated behind the ``Tracing`` feature gate, default off. This module is
+the zero-overhead seam: until ``configure()`` runs, every entry point
+returns a constant after one ``is None`` check — no clock reads, no
+allocation, no recorder. With tracing on but a pod unsampled, ``span()``
+returns the shared null span the same way, so the sampling knob bounds
+the cost at any admission rate.
+
+Usage (instrumented sites)::
+
+    ctx = trace.context_for_pod(pod)          # None when off/untraced
+    with trace.span(ctx, "scheduler.filter", nodes=len(nodes)):
+        ...
+
+Spools are per-process JSONL files (recorder.py); ``scripts/vtrace.py``
+and the monitor's ``/traces`` endpoint assemble them into per-pod
+timelines (assemble.py) and Prometheus histograms (metrics.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+import time
+
+from vtpu_manager.trace import context as _context
+from vtpu_manager.trace.context import TraceContext
+from vtpu_manager.trace.recorder import (DEFAULT_CAPACITY,
+                                         DEFAULT_FLUSH_INTERVAL_S, Span,
+                                         SpanRecorder)
+from vtpu_manager.util import consts
+
+__all__ = ["TraceContext", "Span", "SpanRecorder", "configure", "reset",
+           "is_enabled", "sampling_rate", "recorder", "flush",
+           "mint_for_pod", "context_for_pod", "context_for_claim",
+           "context_for_uid", "context_from_env", "span", "event",
+           "annotation_values"]
+
+
+class _Config:
+    __slots__ = ("service", "rate", "recorder")
+
+    def __init__(self, service: str, rate: float, rec: SpanRecorder):
+        self.service = service
+        self.rate = rate
+        self.recorder = rec
+
+
+_cfg: _Config | None = None
+_atexit_registered = False
+
+
+def configure(service: str, spool_dir: str | None = None,
+              sampling_rate: float = 1.0,
+              capacity: int = DEFAULT_CAPACITY,
+              flush_interval_s: float = DEFAULT_FLUSH_INTERVAL_S) -> None:
+    """Enable tracing for this process (binaries call this when the
+    Tracing gate is on). Starts the background flusher — ALL spool I/O
+    runs on that daemon thread (plus atexit), never on an instrumented
+    thread. Idempotent-by-replacement: reconfiguring swaps recorder and
+    flusher (tests); the final flush is registered once."""
+    global _cfg, _atexit_registered
+    if _cfg is not None:
+        _cfg.recorder.stop_flusher()
+    rate = min(1.0, max(0.0, sampling_rate))
+    rec = SpanRecorder(service, spool_dir or consts.TRACE_DIR,
+                       capacity=capacity)
+    _cfg = _Config(service, rate, rec)
+    threading.Thread(target=rec.run_flusher, args=(flush_interval_s,),
+                     daemon=True, name="vtrace-flush").start()
+    if not _atexit_registered:
+        atexit.register(flush)
+        _atexit_registered = True
+
+
+def reset() -> None:
+    """Disable tracing (tests; restores the zero-overhead path)."""
+    global _cfg
+    if _cfg is not None:
+        _cfg.recorder.stop_flusher()
+    _cfg = None
+
+
+def is_enabled() -> bool:
+    return _cfg is not None
+
+
+def sampling_rate() -> float:
+    return _cfg.rate if _cfg is not None else 0.0
+
+
+def recorder() -> SpanRecorder | None:
+    return _cfg.recorder if _cfg is not None else None
+
+
+def flush() -> int:
+    return _cfg.recorder.flush() if _cfg is not None else 0
+
+
+# -- context factories (all return None when tracing is off) ----------------
+
+def mint_for_pod(pod: dict) -> TraceContext | None:
+    """Admission-time mint (webhook mutate). Returns a context even for
+    unsampled pods — the decision must still propagate so downstream
+    stages skip coherently instead of re-deciding."""
+    if _cfg is None:
+        return None
+    return _context.mint(pod, _cfg.rate)
+
+
+def context_for_pod(pod: dict) -> TraceContext | None:
+    if _cfg is None:
+        return None
+    return _context.from_pod(pod)
+
+
+def context_for_claim(claim: dict) -> TraceContext | None:
+    if _cfg is None:
+        return None
+    return _context.for_claim(claim, _cfg.rate)
+
+
+def context_for_uid(pod_uid: str) -> TraceContext | None:
+    if _cfg is None:
+        return None
+    return _context.for_uid(pod_uid, _cfg.rate)
+
+
+def context_from_env(environ: dict | None = None) -> TraceContext | None:
+    if _cfg is None:
+        return None
+    return _context.from_env(environ)
+
+
+def annotation_values(ctx: TraceContext) -> dict[str, str]:
+    """The annotations that propagate a context between binaries."""
+    return {consts.trace_id_annotation(): ctx.trace_id,
+            consts.trace_sampled_annotation():
+                "true" if ctx.sampled else "false"}
+
+
+# -- span emission ----------------------------------------------------------
+
+class _NullSpan:
+    """Shared no-op context manager for the off/unsampled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_cfg", "_ctx", "_stage", "_attrs", "_start", "_t0")
+
+    def __init__(self, cfg: _Config, ctx: TraceContext, stage: str,
+                 attrs: dict):
+        self._cfg = cfg
+        self._ctx = ctx
+        self._stage = stage
+        self._attrs = attrs
+
+    def __enter__(self) -> TraceContext:
+        self._start = time.time()
+        self._t0 = time.perf_counter()
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        attrs = self._attrs
+        if exc_type is not None:
+            # a failed stage is exactly the span an operator hunts for
+            attrs = dict(attrs, error=exc_type.__name__)
+        self._cfg.recorder.record(Span(
+            stage=self._stage, trace_id=self._ctx.trace_id,
+            pod_uid=self._ctx.pod_uid, service=self._cfg.service,
+            start_s=self._start, dur_s=dur, attrs=attrs))
+        return False
+
+
+def span(ctx: TraceContext | None, stage: str, **attrs):
+    """Timed span context manager. The off path is one attribute load
+    and two ``is``/truth checks — no object construction."""
+    cfg = _cfg
+    if cfg is None or ctx is None or not ctx.sampled:
+        return _NULL_SPAN
+    return _LiveSpan(cfg, ctx, stage, attrs)
+
+
+def event(ctx: TraceContext | None, stage: str, **attrs) -> None:
+    """Zero-duration marker (e.g. shim first-execute)."""
+    cfg = _cfg
+    if cfg is None or ctx is None or not ctx.sampled:
+        return
+    cfg.recorder.record(Span(
+        stage=stage, trace_id=ctx.trace_id, pod_uid=ctx.pod_uid,
+        service=cfg.service, start_s=time.time(), dur_s=0.0, attrs=attrs))

@@ -1,0 +1,117 @@
+"""vtrace trace context: identity + sampling decision, minted once.
+
+The context is created at admission (webhook mutate) and crosses every
+process boundary the allocation path crosses, using the channels the
+framework already has: pod annotations between the control-plane binaries
+(the same channel pre-allocation uses) and container env vars into the
+tenant (the same channel the enforcement limits use). DRA claims and the
+registry socket don't carry annotations — those stages join the timeline
+by pod/claim uid instead (assemble.py joins on either key).
+
+The sampling decision is made ONCE, at mint time, and propagated as an
+annotation: downstream stages must all record or all skip, or a timeline
+assembles with holes that read as latency. Sampling is deterministic in
+the trace id (fnv64 bucket), so a given pod's fate is reproducible and a
+fleet-wide rate needs no coordination.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from vtpu_manager.config.vmem import fnv64
+from vtpu_manager.util import consts
+
+_SAMPLE_BUCKETS = 1 << 20
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str
+    pod_uid: str = ""
+    sampled: bool = True
+
+
+def _sample(trace_id: str, rate: float) -> bool:
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (fnv64(trace_id) % _SAMPLE_BUCKETS) < int(rate * _SAMPLE_BUCKETS)
+
+
+def mint(pod: dict, rate: float = 1.0) -> TraceContext:
+    """New context for a pod at admission. The trace id is derived from
+    the pod uid when the API server already assigned one (CREATE
+    admission usually has it), else random — either way unique per
+    admission attempt is not required, unique per pod is."""
+    meta = pod.get("metadata") or {}
+    uid = meta.get("uid", "")
+    trace_id = uid or os.urandom(8).hex()
+    return TraceContext(trace_id=trace_id, pod_uid=uid,
+                        sampled=_sample(trace_id, rate))
+
+
+def from_pod(pod: dict) -> TraceContext | None:
+    """Context a prior stage propagated via annotations; None when the
+    pod was never admitted under tracing (no annotation = no trace)."""
+    meta = pod.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    trace_id = anns.get(consts.trace_id_annotation())
+    if not trace_id:
+        return None
+    sampled = anns.get(consts.trace_sampled_annotation(), "true") == "true"
+    return TraceContext(trace_id=trace_id, pod_uid=meta.get("uid", ""),
+                        sampled=sampled)
+
+
+def from_env(environ: dict | None = None) -> TraceContext | None:
+    """Context injected into a tenant container (Allocate env vars)."""
+    env = os.environ if environ is None else environ
+    trace_id = env.get(consts.ENV_TRACE_ID, "")
+    if not trace_id:
+        return None
+    return TraceContext(
+        trace_id=trace_id,
+        pod_uid=env.get(consts.ENV_POD_UID, ""),
+        sampled=env.get(consts.ENV_TRACE_SAMPLED, "true") == "true")
+
+
+def for_claim(claim: dict, rate: float = 1.0) -> TraceContext | None:
+    """Context for a DRA claim: claims carry no trace annotation, so the
+    span joins the pod's timeline by uid — the first reservedFor pod (the
+    normal single-consumer case) or, failing that, the claim uid.
+
+    The sampling decision is RECOMPUTED from the uid: sampling is a
+    deterministic function of the trace id, and the admission mint uses
+    the pod uid as the trace id whenever one exists (the normal case),
+    so uid-joined stages reach the same verdict as the webhook without
+    any propagated bit — keeping the all-record-or-all-skip invariant
+    (and the spool-volume bound) intact at the stages annotations can't
+    reach. Only a pod admitted before the API server assigned a uid
+    (random trace id) can diverge, and then only toward a missing
+    dra/registry span, never an orphan timeline."""
+    meta = claim.get("metadata") or {}
+    reserved = ((claim.get("status") or {}).get("reservedFor")) or []
+    pod_uid = ""
+    for ref in reserved:
+        if ref.get("resource", "pods") == "pods" and ref.get("uid"):
+            pod_uid = ref["uid"]
+            break
+    uid = meta.get("uid", "")
+    if not pod_uid and not uid:
+        return None
+    join_uid = pod_uid or uid
+    return TraceContext(trace_id="", pod_uid=join_uid,
+                        sampled=_sample(join_uid, rate))
+
+
+def for_uid(pod_uid: str, rate: float = 1.0) -> TraceContext | None:
+    """Context for a stage that only knows the pod uid (registry
+    registration): joins by uid, no trace id of its own; sampling
+    recomputed from the uid (see for_claim)."""
+    if not pod_uid:
+        return None
+    return TraceContext(trace_id="", pod_uid=pod_uid,
+                        sampled=_sample(pod_uid, rate))

@@ -1,0 +1,221 @@
+"""vtrace span recorder: bounded ring + per-process JSONL spool.
+
+Hot allocation paths (the scheduler filter holds the serial section, the
+plugin's Allocate holds kubelet's attention) must never pay disk I/O to
+be observed, so recording is two-phase, following the node's existing
+shared-state idioms:
+
+- ``record()`` appends to a bounded in-memory ring under a plain
+  ``threading.Lock`` held only for the append (lock-cheap, the
+  seqlock-writer discipline: no I/O, no allocation storms under the
+  lock), and at the half-full threshold merely WAKES the flusher — it
+  never performs I/O itself, so a hung disk cannot stall a filter pass
+  or an Allocate from inside a span exit. A full ring DROPS the span
+  and counts it — backpressure must never reach the instrumented path.
+- ``flush()`` (driven by the background flusher thread the module
+  ``configure()`` starts, and atexit) snapshots-and-clears the ring
+  under that same short lock, then appends JSONL to the per-process
+  spool file under a ``FileLock`` (the flock discipline every
+  cross-process file on the node uses), so concurrent flushers and the
+  monitor's readers never interleave a torn line. Cumulative drop
+  counts ride along as ``meta`` records so the monitor can export
+  ``vtpu_trace_spool_dropped_total`` without asking the process.
+
+One recorder per process (module singleton in ``vtpu_manager.trace``);
+spool files are ``<service>.<pid>.jsonl`` under the trace dir, so
+restarts and multi-process nodes never contend for a file.
+
+Retention: a spool reaching ``max_spool_bytes`` is rotated to a single
+``<service>.<pid>.prev.jsonl`` generation (still read by assembly), so
+one process is bounded at ~2x the cap; spools whose process is long
+gone are reaped by ``reap_stale_spools`` (the monitor calls it before
+each read).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from vtpu_manager.trace.context import TraceContext
+from vtpu_manager.util.flock import FileLock
+
+SPOOL_SUFFIX = ".jsonl"
+DEFAULT_CAPACITY = 512
+DEFAULT_MAX_SPOOL_BYTES = 16 * 2**20
+DEFAULT_FLUSH_INTERVAL_S = 1.0
+# a spool untouched this long belongs to a dead process (live recorders
+# re-stamp their meta line at least every flush interval while tracing)
+DEFAULT_SPOOL_TTL_S = 24 * 3600.0
+
+
+@dataclass
+class Span:
+    """One timed stage of a pod's allocation path."""
+
+    stage: str                 # e.g. "scheduler.filter"
+    trace_id: str = ""
+    pod_uid: str = ""
+    service: str = ""          # emitting process ("scheduler", "plugin"...)
+    start_s: float = 0.0       # wall clock (cross-process join axis)
+    dur_s: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        out = {"kind": "span", "stage": self.stage, "trace": self.trace_id,
+               "pod": self.pod_uid, "service": self.service,
+               "start": round(self.start_s, 6), "dur": round(self.dur_s, 6)}
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+    @classmethod
+    def from_wire(cls, doc: dict) -> "Span":
+        return cls(stage=str(doc.get("stage", "")),
+                   trace_id=str(doc.get("trace", "")),
+                   pod_uid=str(doc.get("pod", "")),
+                   service=str(doc.get("service", "")),
+                   start_s=float(doc.get("start", 0.0)),
+                   dur_s=float(doc.get("dur", 0.0)),
+                   attrs=dict(doc.get("attrs") or {}))
+
+
+class SpanRecorder:
+    def __init__(self, service: str, spool_dir: str,
+                 capacity: int = DEFAULT_CAPACITY,
+                 flush_at: int | None = None,
+                 max_spool_bytes: int = DEFAULT_MAX_SPOOL_BYTES):
+        self.service = service
+        self.spool_dir = spool_dir
+        self.capacity = max(1, capacity)
+        self.max_spool_bytes = max_spool_bytes
+        self.spool_path = os.path.join(
+            spool_dir, f"{service}.{os.getpid()}{SPOOL_SUFFIX}")
+        self._lock = threading.Lock()
+        self._buf: list[Span] = []
+        self._dropped = 0
+        self._flushed_drops = -1   # last drop count written to the spool
+        # wake the flusher when the ring is half full so a burst inside
+        # one long filter pass doesn't hit the drop path before the
+        # interval tick; > capacity disables the early wake (ring tests)
+        self._flush_at = flush_at if flush_at is not None \
+            else max(1, self.capacity // 2)
+        self._wake = threading.Event()
+        self._stop = False
+
+    # -- hot path ------------------------------------------------------------
+
+    def record(self, span: Span) -> bool:
+        """Append to the ring; False (and a drop count) when full. Never
+        performs I/O — a full-enough ring only wakes the flusher."""
+        span.service = span.service or self.service
+        with self._lock:
+            if len(self._buf) >= self.capacity:
+                self._dropped += 1
+                return False
+            self._buf.append(span)
+            pending = len(self._buf)
+        if pending >= self._flush_at:
+            self._wake.set()
+        return True
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    # -- spool ---------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Drain the ring to the spool. Returns spans written. The ring
+        lock covers only the snapshot; the file I/O runs under the spool
+        flock alone (never nested — the recorder promises the hot path
+        the ring lock is always short)."""
+        with self._lock:
+            spans = self._buf
+            self._buf = []
+            drops = self._dropped
+        if not spans and drops == self._flushed_drops:
+            return 0
+        lines = [json.dumps(s.to_wire(), separators=(",", ":"))
+                 for s in spans]
+        lines.append(json.dumps(
+            {"kind": "meta", "service": self.service, "pid": os.getpid(),
+             "drops": drops, "ts": round(time.time(), 3)},
+            separators=(",", ":")))
+        try:
+            os.makedirs(self.spool_dir, exist_ok=True)
+            with FileLock(f"{self.spool_path}.flock"):
+                self._rotate_if_large()
+                with open(self.spool_path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+        except OSError:
+            # spool unavailable (disk full, dir unwritable): the spans
+            # are lost — count them as drops so the loss is visible in
+            # vtpu_trace_spool_dropped_total rather than silent
+            with self._lock:
+                self._dropped += len(spans)
+            return 0
+        self._flushed_drops = drops
+        return len(spans)
+
+    def _rotate_if_large(self) -> None:
+        """Bound this process's spool at ~2x max_spool_bytes: the current
+        file rotates to one .prev generation (named *.jsonl so assembly
+        still reads it) which the next rotation overwrites. Caller holds
+        the spool flock."""
+        try:
+            size = os.path.getsize(self.spool_path)
+        except OSError:
+            return
+        if size < self.max_spool_bytes:
+            return
+        prev = self.spool_path[:-len(SPOOL_SUFFIX)] + f".prev{SPOOL_SUFFIX}"
+        os.replace(self.spool_path, prev)
+
+    # -- flusher thread (started by vtpu_manager.trace.configure) ------------
+
+    def run_flusher(self,
+                    interval_s: float = DEFAULT_FLUSH_INTERVAL_S) -> None:
+        """Flush loop: every ``interval_s``, or immediately when record()
+        wakes us at the ring threshold. All spool I/O happens here (and
+        at atexit) — never on an instrumented thread."""
+        while not self._stop:
+            self._wake.wait(interval_s)
+            self._wake.clear()
+            self.flush()
+
+    def stop_flusher(self) -> None:
+        self._stop = True
+        self._wake.set()
+
+
+def reap_stale_spools(spool_dir: str,
+                      max_age_s: float = DEFAULT_SPOOL_TTL_S) -> int:
+    """Delete spools (and their flocks) untouched for ``max_age_s`` —
+    leftovers of dead processes. Called by the monitor before reads;
+    returns files removed. Live spools are safe: their recorder re-stamps
+    mtime on every flush."""
+    removed = 0
+    if not os.path.isdir(spool_dir):
+        return removed
+    cutoff = time.time() - max_age_s
+    for name in os.listdir(spool_dir):
+        if not (name.endswith(SPOOL_SUFFIX)
+                or name.endswith(f"{SPOOL_SUFFIX}.flock")):
+            continue
+        path = os.path.join(spool_dir, name)
+        try:
+            if os.path.getmtime(path) < cutoff:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            continue
+    return removed

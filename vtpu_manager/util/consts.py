@@ -143,6 +143,33 @@ def scheduler_stuck_grace_annotation() -> str:
     return _ann("stuck-grace-period")
 
 
+def trace_id_annotation() -> str:
+    """vtrace trace id, minted at admission (webhook mutate) and carried
+    through every allocation-path stage; the cross-binary join key."""
+    return _ann("trace-id")
+
+
+def trace_sampled_annotation() -> str:
+    """vtrace sampling decision ("true"/"false"), made once at admission
+    so every downstream stage records or skips coherently."""
+    return _ann("trace-sampled")
+
+
+def parse_predicate_time(annotations: dict | None) -> float | None:
+    """Wall-clock seconds the filter commit stamped into the
+    predicate-time annotation; None when absent or malformed. The ONE
+    parser for this annotation — bind freshness, stuck-grace accounting,
+    and trace timestamps previously each hand-rolled float() parsing and
+    their absent/garbage semantics had quietly diverged."""
+    raw = (annotations or {}).get(predicate_time_annotation())
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return None
+
+
 # Node annotations -----------------------------------------------------------
 
 def node_device_register_annotation() -> str:
@@ -231,6 +258,9 @@ ENV_DISABLE_CONTROL = "DISABLE_VTPU_CONTROL"
 # also honors a flat operator-set VTPU_OBS_OVERHEAD_US, read C-side only)
 ENV_OBS_EXCESS_TABLE = "VTPU_OBS_EXCESS_TABLE"
 ENV_REGISTER_UUID = "VTPU_REGISTER_UUID"    # random id for CLIENT-mode match
+ENV_TRACE_ID = "VTPU_TRACE_ID"              # vtrace id (admission-minted)
+ENV_TRACE_SAMPLED = "VTPU_TRACE_SAMPLED"    # "true"/"false"
+ENV_TRACE_DIR = "VTPU_TRACE_DIR"            # tenant spool dir override
 ENV_REGISTRY_SOCKET = "VTPU_REGISTRY_SOCKET"  # registry socket override
 ENV_POD_NAME = "VTPU_POD_NAME"
 ENV_POD_NAMESPACE = "VTPU_POD_NAMESPACE"
@@ -265,6 +295,8 @@ REGISTRY_DIR = f"{MANAGER_BASE_DIR}/registry"
 REGISTRY_SOCKET = f"{REGISTRY_DIR}/socket.sock"
 DRIVER_DIR = f"{MANAGER_BASE_DIR}/driver"          # shim install dir on node
 CONTROL_LIBRARY_NAME = "libvtpu-control.so"
+
+TRACE_DIR = f"{MANAGER_BASE_DIR}/trace"             # vtrace span spools
 
 LOCK_DIR = "/tmp/.vtpu_lock"                        # per-device OFD locks
 VMEM_DIR = "/tmp/.vmem_node"

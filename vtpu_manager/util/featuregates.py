@@ -22,6 +22,7 @@ HONOR_PREALLOC_IDS = "HonorPreAllocatedDeviceIDs"
 NRI_SUPPORT = "NRISupport"              # DRA: runtime-hook injection
 SERIAL_FILTER_NODE = "SerialFilterNode"
 SERIAL_BIND_NODE = "SerialBindNode"
+TRACING = "Tracing"                     # vtrace allocation-path spans
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -39,6 +40,7 @@ _KNOWN = {
     NRI_SUPPORT: False,
     SERIAL_FILTER_NODE: True,
     SERIAL_BIND_NODE: False,
+    TRACING: False,
 }
 
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass, field
 
+from vtpu_manager import trace
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -61,6 +62,25 @@ def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
     result = MutateResult()
     if not requests_vtpu(pod):
         return result
+    # vtrace origin: admission is where a pod's allocation-path timeline
+    # starts, so the trace context (id + sampling decision) is minted here
+    # and propagated as annotations; every later stage only reads it.
+    ctx = trace.mint_for_pod(pod)
+    with trace.span(ctx, "webhook.mutate"):
+        _apply_mutations(pod, result, scheduler_name, set_scheduler)
+        if ctx is not None:
+            for ann, value in sorted(trace.annotation_values(ctx).items()):
+                # "add" replaces an existing member (RFC 6902 §4.1), so a
+                # recreated pod's stale trace identity is overwritten too
+                result.patches.append({
+                    "op": "add",
+                    "path": f"/metadata/annotations/{_escape(ann)}",
+                    "value": value})
+    return result
+
+
+def _apply_mutations(pod: dict, result: MutateResult,
+                          scheduler_name: str, set_scheduler: bool) -> None:
     meta = pod.get("metadata") or {}
     spec = pod.get("spec") or {}
     anns = meta.get("annotations")
@@ -130,4 +150,3 @@ def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
             result.patches.append({
                 "op": "remove",
                 "path": f"/metadata/annotations/{_escape(stale)}"})
-    return result
