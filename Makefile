@@ -65,8 +65,12 @@ test: build ## Full hermetic suite (pytest; includes the C harness via fixtures)
 test-trace: ## vtrace subsystem alone (recorder, assembly, hermetic e2e)
 	$(PYTEST) tests/test_trace.py -q
 
+.PHONY: test-snapshot
+test-snapshot: ## Scheduler snapshot alone (fake watch, incremental apply, 410 relist, gate parity)
+	$(PYTEST) tests/test_snapshot.py -q
+
 .PHONY: verify
-verify: lint test test-trace ## Default verify flow: static analysis, the suite, then the vtrace e2e
+verify: lint test test-trace test-snapshot ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

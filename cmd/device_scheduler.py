@@ -34,6 +34,11 @@ def main(argv: list[str] | None = None) -> int:
                              "nodeCacheCapable=false). Node registries "
                              "change on device re-registration, "
                              "minutes-scale. 0 = list per call")
+    parser.add_argument("--snapshot-poll-ms", type=int, default=1000,
+                        help="SchedulerSnapshot gate: pacing of the "
+                             "background watch consumer (bounds snapshot "
+                             "apply-lag; the TTL flags above are ignored "
+                             "while the gate is on)")
     parser.add_argument("--require-node-label", action="store_true",
                         help="only consider nodes labeled "
                              "vtpu-manager-enable=true")
@@ -60,7 +65,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.preempt import PreemptPredicate
     from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
     from vtpu_manager.scheduler.serial import SerialLocker
-    from vtpu_manager.util.featuregates import (SERIAL_BIND_NODE,
+    from vtpu_manager.util.featuregates import (SCHEDULER_SNAPSHOT,
+                                                SERIAL_BIND_NODE,
                                                 SERIAL_FILTER_NODE,
                                                 TRACING, FeatureGates)
 
@@ -87,6 +93,16 @@ def main(argv: list[str] | None = None) -> int:
         from vtpu_manager.client.kube import InClusterClient
         client = InClusterClient()
 
+    # SchedulerSnapshot (default off): list+watch incremental cluster
+    # state replaces the TTL-LIST caches; a daemon thread consumes the
+    # watch so filter passes never pay list/decode latency. The TTL path
+    # below stays the shipped fallback while the gate is off.
+    snapshot = None
+    if gates.enabled(SCHEDULER_SNAPSHOT):
+        from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+        snapshot = ClusterSnapshot(client)
+        snapshot.start_background(poll_s=args.snapshot_poll_ms / 1000.0)
+
     bind_locker = SerialLocker(gates.enabled(SERIAL_BIND_NODE))
     api = SchedulerAPI(
         # SerialFilterNode (default on, matching FilterPredicate's own
@@ -97,10 +113,12 @@ def main(argv: list[str] | None = None) -> int:
                         serialize=gates.enabled(SERIAL_FILTER_NODE),
                         require_node_label=args.require_node_label,
                         pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0,
-                        nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0),
+                        nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0,
+                        snapshot=snapshot),
         BindPredicate(client, locker=bind_locker),
-        PreemptPredicate(client),
-        debug_endpoints=args.debug_endpoints)
+        PreemptPredicate(client, snapshot=snapshot),
+        debug_endpoints=args.debug_endpoints,
+        snapshot=snapshot)
 
     from vtpu_manager.util.tlsreload import serving_context
     ssl_ctx = serving_context(args.cert_file, args.key_file)

@@ -20,7 +20,8 @@ This package is an AST-based rule framework that checks exactly those:
   every registered gate is referenced outside featuregates.py, and no call
   site passes an undeclared string-literal gate name.
 - ``exception-hygiene``  — no silent broad ``except`` in control-plane
-  paths (scheduler/, manager/, deviceplugin/, kubeletplugin/).
+  paths (scheduler/, manager/, deviceplugin/, kubeletplugin/, trace/,
+  client/ — the last covering the snapshot watch loop's client side).
 
 Suppression: ``# vtlint: disable=<rule>[,<rule>...]`` on the flagged line
 or the line directly above, with a written justification.

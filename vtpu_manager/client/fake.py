@@ -4,26 +4,46 @@ Reference test strategy: scheduler/webhook/controller tests run against
 k8s.io/client-go/kubernetes/fake with real informers (SURVEY.md §4); this is
 the Python equivalent. Thread-safe; records bindings/evictions/events for
 assertions.
+
+Watch semantics (the informer analogue the scheduler snapshot consumes):
+every API mutation appends an ADDED/MODIFIED/DELETED event to a bounded
+per-kind queue under a single monotonically increasing resourceVersion.
+``watch_pods``/``watch_nodes`` return the events after the given version
+plus a trailing BOOKMARK (so consumers advance their version even when
+idle), and raise ``KubeError(410)`` when the requested version predates
+the retained window — ``compact_watch_events()`` forces that in tests,
+and a retention cap forces it for real when a consumer falls far behind,
+exactly the apiserver contract that makes relist-on-410 load-bearing.
 """
 
 from __future__ import annotations
 
 import copy
 import threading
+from collections import deque
 
 from vtpu_manager.client.kube import KubeError
+
+# Events retained per kind before the oldest are compacted away (a watcher
+# further behind than this gets 410 Gone and must relist). Big enough that
+# only a genuinely wedged consumer hits it; small enough to bound memory in
+# the 100k-pod sustained harness.
+WATCH_RETENTION = 100_000
 
 
 class FakeKubeClient:
     def __init__(self, upsert_on_patch: bool = False,
-                 copy_on_read: bool = True):
+                 copy_on_read: bool = True,
+                 watch_retention: int = WATCH_RETENTION):
         # upsert_on_patch: smoke-server convenience — a patched-but-unknown
         # pod is created instead of 404ing (tests keep strict semantics).
         # copy_on_read=False models informer-cache semantics (client-go
         # informers hand out SHARED objects callers must not mutate) — the
         # right fidelity for scale harnesses where per-read deepcopy of
         # 100k pods would swamp the cost being measured. Tests keep the
-        # safe default.
+        # safe default. Watch events follow the same rule: shared refs in
+        # informer-fidelity mode (a queued event can show a later patch —
+        # benign for last-write-wins consumers), snapshots otherwise.
         self.upsert_on_patch = upsert_on_patch
         self.copy_on_read = copy_on_read
         self._lock = threading.RLock()
@@ -41,12 +61,84 @@ class FakeKubeClient:
         self.resourceclaims: dict[tuple[str, str], dict] = {}
         self.resourceslices: dict[str, dict] = {}
         self.pdbs: list[dict] = []
+        # -- watch machinery ------------------------------------------------
+        self._rv = 0                          # one version for both kinds
+        self._watch_retention = watch_retention
+        self._watch_events: dict[str, deque] = {"pods": deque(),
+                                                "nodes": deque()}
+        self._compacted_rv: dict[str, int] = {"pods": 0, "nodes": 0}
+
+    # -- watch plumbing -----------------------------------------------------
+
+    def _record_event(self, kind: str, type_: str, obj: dict) -> None:
+        """Append one watch event (caller holds self._lock)."""
+        self._rv += 1
+        snap = copy.deepcopy(obj) if self.copy_on_read else obj
+        queue = self._watch_events[kind]
+        queue.append((self._rv, type_, snap))
+        while len(queue) > self._watch_retention:
+            dropped_rv, _, _ = queue.popleft()
+            self._compacted_rv[kind] = dropped_rv
+
+    def compact_watch_events(self, kind: str | None = None) -> None:
+        """Test hook: forget all retained events, so any watcher not fully
+        caught up gets 410 Gone (the apiserver etcd-compaction case)."""
+        with self._lock:
+            for k in ([kind] if kind else ["pods", "nodes"]):
+                self._watch_events[k].clear()
+                self._compacted_rv[k] = self._rv
+
+    def _watch(self, kind: str, resource_version: str,
+               timeout_s: float) -> list[dict]:
+        try:
+            after = int(resource_version or 0)
+        except ValueError as e:
+            raise KubeError(400, f"bad resourceVersion "
+                                 f"{resource_version!r}") from e
+        with self._lock:
+            if after < self._compacted_rv[kind]:
+                raise KubeError(
+                    410, f"too old resource version: {after} "
+                         f"({self._compacted_rv[kind]})")
+            out = [{"type": t, "object": obj, "resourceVersion": str(rv)}
+                   for rv, t, obj in self._watch_events[kind] if rv > after]
+            # trailing bookmark: consumers advance even on idle watches
+            # (and the bookmark-handling path is exercised on every pump)
+            out.append({"type": "BOOKMARK",
+                        "object": {"metadata":
+                                   {"resourceVersion": str(self._rv)}},
+                        "resourceVersion": str(self._rv)})
+            return out
+
+    def watch_pods(self, resource_version: str,
+                   timeout_s: float = 30.0) -> list[dict]:
+        return self._watch("pods", resource_version, timeout_s)
+
+    def watch_nodes(self, resource_version: str,
+                    timeout_s: float = 30.0) -> list[dict]:
+        return self._watch("nodes", resource_version, timeout_s)
+
+    def list_pods_with_version(self) -> tuple[list[dict], str]:
+        with self._lock:
+            items = [copy.deepcopy(p) if self.copy_on_read else p
+                     for p in self.pods.values()]
+            return items, str(self._rv)
+
+    def list_nodes_with_version(self) -> tuple[list[dict], str]:
+        with self._lock:
+            items = [copy.deepcopy(n) if self.copy_on_read else n
+                     for n in self.nodes.values()]
+            return items, str(self._rv)
 
     # -- fixture helpers ----------------------------------------------------
 
     def add_node(self, node: dict) -> None:
         with self._lock:
-            self.nodes[node["metadata"]["name"]] = copy.deepcopy(node)
+            name = node["metadata"]["name"]
+            type_ = "MODIFIED" if name in self.nodes else "ADDED"
+            stored = copy.deepcopy(node)
+            self.nodes[name] = stored
+            self._record_event("nodes", type_, stored)
 
     def add_pdb(self, pdb: dict) -> None:
         with self._lock:
@@ -56,12 +148,14 @@ class FakeKubeClient:
         meta = pod["metadata"]
         key = (meta.get("namespace", "default"), meta["name"])
         with self._lock:
+            type_ = "MODIFIED" if key in self.pods else "ADDED"
             stored = copy.deepcopy(pod)
             self.pods[key] = stored
             if (stored.get("spec") or {}).get("nodeName"):
                 self._scheduled[key] = stored
             else:
                 self._scheduled.pop(key, None)
+            self._record_event("pods", type_, stored)
 
     # -- KubeClient protocol ------------------------------------------------
 
@@ -87,6 +181,7 @@ class FakeKubeClient:
                     anns.pop(k, None)
                 else:
                     anns[k] = v
+            self._record_event("nodes", "MODIFIED", node)
             return copy.deepcopy(node)
 
     def list_pods(self, namespace=None, node_name=None,
@@ -136,6 +231,7 @@ class FakeKubeClient:
                     anns.pop(k, None)
                 else:
                     anns[k] = v
+            self._record_event("pods", "MODIFIED", pod)
             return copy.deepcopy(pod)
 
     def bind_pod(self, namespace: str, name: str, node: str) -> None:
@@ -146,23 +242,26 @@ class FakeKubeClient:
             pod.setdefault("spec", {})["nodeName"] = node
             self._scheduled[(namespace, name)] = pod
             self.bindings.append((namespace, name, node))
+            self._record_event("pods", "MODIFIED", pod)
 
     def delete_pod(self, namespace: str, name: str,
                    grace_seconds=None) -> None:
         with self._lock:
             if (namespace, name) not in self.pods:
                 raise KubeError(404, f"pod {namespace}/{name} not found")
-            del self.pods[(namespace, name)]
+            gone = self.pods.pop((namespace, name))
             self._scheduled.pop((namespace, name), None)
             self.deletions.append((namespace, name))
+            self._record_event("pods", "DELETED", gone)
 
     def evict_pod(self, namespace: str, name: str) -> None:
         with self._lock:
             if (namespace, name) not in self.pods:
                 raise KubeError(404, f"pod {namespace}/{name} not found")
-            del self.pods[(namespace, name)]
+            gone = self.pods.pop((namespace, name))
             self._scheduled.pop((namespace, name), None)
             self.evictions.append((namespace, name))
+            self._record_event("pods", "DELETED", gone)
 
     def create_event(self, namespace: str, event: dict) -> None:
         with self._lock:

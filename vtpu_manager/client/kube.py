@@ -3,8 +3,11 @@
 Reference: pkg/client (G13) — patch helpers, listers, eviction, binding. The
 Go reference uses client-go; this image has no kubernetes Python package, so
 we implement the few verbs the control plane needs over the REST API with
-stdlib urllib (control-plane QPS is low; no streaming watch — components
-re-list on their own cadence, which the reference also does for NodeInfo).
+stdlib urllib (control-plane QPS is low). The scheduler snapshot
+(scheduler/snapshot.py) additionally needs list+watch semantics — the
+client-go informer contract: a versioned LIST to seed, then a WATCH from
+that resourceVersion streaming ADDED/MODIFIED/DELETED/BOOKMARK events, with
+410 Gone meaning "your version was compacted away, relist".
 
 All objects are plain dicts in k8s JSON shape. Every component takes the
 KubeClient protocol so tests swap in FakeKubeClient (the fake-clientset
@@ -14,11 +17,14 @@ pattern, SURVEY.md §4).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import ssl
 import urllib.error
 import urllib.request
-from typing import Protocol
+from typing import Iterable, Protocol
+
+log = logging.getLogger(__name__)
 
 
 class KubeError(RuntimeError):
@@ -43,6 +49,13 @@ class KubeClient(Protocol):
     def evict_pod(self, namespace: str, name: str) -> None: ...
     def create_event(self, namespace: str, event: dict) -> None: ...
     def list_pdbs(self, namespace: str | None = None) -> list[dict]: ...
+    # -- list+watch (scheduler snapshot; SURVEY informer analogue) ----------
+    def list_pods_with_version(self) -> tuple[list[dict], str]: ...
+    def list_nodes_with_version(self) -> tuple[list[dict], str]: ...
+    def watch_pods(self, resource_version: str,
+                   timeout_s: float = 30.0) -> Iterable[dict]: ...
+    def watch_nodes(self, resource_version: str,
+                    timeout_s: float = 30.0) -> Iterable[dict]: ...
 
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -112,6 +125,53 @@ class InClusterClient:
             path += "?fieldSelector=" + ",".join(selectors)
         return self._request("GET", path).get("items", [])
 
+    # -- list+watch (scheduler snapshot) ------------------------------------
+
+    def list_pods_with_version(self) -> tuple[list[dict], str]:
+        doc = self._request("GET", "/api/v1/pods")
+        return (doc.get("items", []),
+                (doc.get("metadata") or {}).get("resourceVersion", ""))
+
+    def list_nodes_with_version(self) -> tuple[list[dict], str]:
+        doc = self._request("GET", "/api/v1/nodes")
+        return (doc.get("items", []),
+                (doc.get("metadata") or {}).get("resourceVersion", ""))
+
+    def watch_pods(self, resource_version: str,
+                   timeout_s: float = 30.0) -> Iterable[dict]:
+        return self._watch("/api/v1/pods", resource_version, timeout_s)
+
+    def watch_nodes(self, resource_version: str,
+                    timeout_s: float = 30.0) -> Iterable[dict]:
+        return self._watch("/api/v1/nodes", resource_version, timeout_s)
+
+    def _watch(self, path: str, resource_version: str,
+               timeout_s: float) -> Iterable[dict]:
+        """Streaming watch: yields decoded watch events (``{"type": ...,
+        "object": ...}``) as the apiserver sends them, returning when the
+        server closes the connection (timeoutSeconds elapsed). Raises
+        KubeError(410) when the resourceVersion was compacted away —
+        either as an HTTP status or as an in-stream ERROR event, both of
+        which the apiserver uses — so the snapshot relists."""
+        query = (f"?watch=true&allowWatchBookmarks=true"
+                 f"&resourceVersion={resource_version}"
+                 f"&timeoutSeconds={max(1, int(timeout_s))}")
+        req = urllib.request.Request(self.base + path + query, method="GET")
+        req.add_header("Authorization", f"Bearer {self._token}")
+        req.add_header("Accept", "application/json")
+        try:
+            resp = urllib.request.urlopen(req, context=self._ctx,
+                                          timeout=timeout_s + 30)
+        except urllib.error.HTTPError as e:
+            raise KubeError(e.code, e.read().decode(errors="replace")) from e
+        with resp:
+            for line in resp:
+                event = parse_watch_line(line)
+                if event is None:
+                    continue
+                raise_on_watch_error(event)
+                yield event
+
     def get_pod(self, namespace: str, name: str) -> dict:
         return self._request("GET",
                              f"/api/v1/namespaces/{namespace}/pods/{name}")
@@ -178,3 +238,29 @@ class InClusterClient:
             return self._request(
                 "POST", "/apis/resource.k8s.io/v1beta1/resourceslices",
                 slice_doc)
+
+
+# -- watch frame helpers (shared by InClusterClient and tests) --------------
+
+def parse_watch_line(line: bytes) -> dict | None:
+    """One newline-delimited watch frame -> event dict, or None for blank/
+    undecodable frames (a torn final line when the server hangs up is
+    normal; the next watch re-syncs from the last applied version)."""
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        return json.loads(line)
+    except json.JSONDecodeError:
+        log.debug("undecodable watch frame (%d bytes), skipping", len(line))
+        return None
+
+
+def raise_on_watch_error(event: dict) -> None:
+    """In-stream ERROR events carry a Status object; 410 Gone must surface
+    as KubeError(410) so consumers relist exactly like the HTTP case."""
+    if event.get("type") != "ERROR":
+        return
+    status = event.get("object") or {}
+    code = int(status.get("code") or 500)
+    raise KubeError(code, str(status.get("message", "watch error")))

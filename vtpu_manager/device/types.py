@@ -247,12 +247,36 @@ def should_count_pod(pod: dict, now: float | None = None,
     return (now - ts) <= grace
 
 
+class DecodeCounters:
+    """Process-wide tallies of annotation decode work. The snapshot's
+    O(changed) contract is *asserted* with these (test_snapshot.py: a
+    filter pass over an unchanged cluster performs zero registry/claims
+    decodes) and exported as Prometheus counters by the scheduler —
+    ``registry`` counts decode_registry() requests (an lru hit still pays
+    a large-string hash per node per pass; the snapshot pays neither),
+    ``claims`` counts get_pod_device_claims() requests (uncached JSON
+    per resident pod). Plain int adds under the GIL; not a hot cost."""
+
+    __slots__ = ("registry", "claims")
+
+    def __init__(self) -> None:
+        self.registry = 0
+        self.claims = 0
+
+    def snapshot(self) -> tuple[int, int]:
+        return self.registry, self.claims
+
+
+DECODE_COUNTERS = DecodeCounters()
+
+
 def decode_registry(raw: str | None) -> "NodeDeviceRegistry | None":
     """Decode a node's register annotation (memoized; None for absent or
     malformed values) — the one registry-decode rule, shared by
     NodeInfo.build and the scheduler's fast capacity gate."""
     if not raw:
         return None
+    DECODE_COUNTERS.registry += 1
     return _decode_registry_cached(raw)
 
 
@@ -311,6 +335,7 @@ def fast_free_totals(registry: "NodeDeviceRegistry",
 def get_pod_device_claims(pod: dict) -> PodDeviceClaims | None:
     """Effective claims for a pod: real allocation wins over pre-allocation
     (reference: GetPodDeviceClaim, types.go:643)."""
+    DECODE_COUNTERS.claims += 1
     anns = _pod_annotations(pod)
     real = try_decode(anns.get(consts.real_allocated_annotation()))
     if real is not None:

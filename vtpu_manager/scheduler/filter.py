@@ -20,6 +20,14 @@ filter_predicate.go:853-857):
 - committed allocations enter an in-process assumed cache that is folded
   into NodeInfo until the API server's pod list reflects the annotation,
   bridging list lag even across serialized calls.
+
+Two data paths feed the pass (SchedulerSnapshot gate):
+- gate OFF (default): TTL-cached cluster-wide LISTs — every refresh
+  re-decodes node registries and resident claims, O(nodes + pods) JSON;
+- gate ON: the watch-driven incremental snapshot (snapshot.py) — decoded
+  registries, counted-claims aggregates and free totals are maintained
+  O(changed) per event, and a pass over an unchanged cluster decodes
+  zero JSON (the reference's informer architecture).
 """
 
 from __future__ import annotations
@@ -42,6 +50,7 @@ from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
 from vtpu_manager.scheduler import gang, reason as R
+from vtpu_manager.scheduler import snapshot as snap_mod
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -74,6 +83,9 @@ class _Assumed:
     node: str
     claims: PodDeviceClaims   # phase-peak effective set (what capacity
     ts: float                 # accounting must charge), not per-container
+                              # — ts is time.monotonic(): TTL expiry must
+                              # not move under an NTP step (predicate_time
+                              # stays wall-clock; it crosses processes)
 
 
 class FilterPredicate:
@@ -81,11 +93,17 @@ class FilterPredicate:
                  require_node_label: bool = False,
                  candidate_limit: int = 64,
                  pods_ttl_s: float = 0.0,
-                 nodes_ttl_s: float = 0.0):
+                 nodes_ttl_s: float = 0.0,
+                 snapshot: "snap_mod.ClusterSnapshot | None" = None):
         self.client = client
         self.serialize = serialize
         self._serial_lock = threading.Lock()
         self.require_node_label = require_node_label
+        # SchedulerSnapshot gate: when a ClusterSnapshot is provided every
+        # cluster read (candidates, residents, gang siblings) comes from
+        # its watch-maintained state and the TTL caches below sit idle;
+        # when None the TTL path runs exactly as before (the fallback).
+        self._snapshot = snapshot
         # full allocation runs only on the top-K capacity-ranked nodes;
         # pure-Python work gains nothing from thread pools (GIL), and
         # allocating on every node of a 1000+-node cluster per pod is the
@@ -110,6 +128,11 @@ class FilterPredicate:
         self._all_pods_cache: list[dict] | None = None
         self._all_pods_cache_ts = 0.0
         self._pods_cache_lock = threading.Lock()
+        # single-flight state for _ttl_cached: which cache keys have a
+        # fetch in progress, plus a condition (same underlying lock) that
+        # fetchers signal so cache-empty waiters wake up
+        self._pods_cache_cond = threading.Condition(self._pods_cache_lock)
+        self._fetch_in_flight: set[str] = set()
         # Node-snapshot TTL, same informer-fidelity rationale as the pod
         # snapshot: the list_nodes() fallback (kube-scheduler usually
         # ships nodes IN the ExtenderArgs, but nodeCacheCapable=false
@@ -141,18 +164,42 @@ class FilterPredicate:
         """ONE home for the snapshot-TTL idiom (scheduled pods, full pod
         list, nodes). time.monotonic() throughout — the idiom existed as
         three hand-rolled copies until the third (nodes) drifted to
-        time.time() and an NTP step could pin a stale snapshot."""
+        time.time() and an NTP step could pin a stale snapshot.
+
+        Single-flight: the fetch runs outside the lock (it is a
+        cluster-wide LIST), so without coordination N callers arriving on
+        an expired cache issue N concurrent LISTs — a thundering herd
+        against the apiserver exactly when the scheduler is busiest. The
+        first expired caller fetches; the rest reuse the stale value (the
+        assumed cache covers our own placements) or, when the cache is
+        empty/invalidated, wait on the condition for the fetcher."""
         if ttl_s <= 0:
             return fetch()
-        now = time.monotonic()
-        with self._pods_cache_lock:
-            if getattr(self, cache_attr) is not None and \
-                    now - getattr(self, ts_attr) < ttl_s:
-                return getattr(self, cache_attr)
-        value = fetch()
-        with self._pods_cache_lock:
+        with self._pods_cache_cond:
+            while True:
+                now = time.monotonic()
+                cached = getattr(self, cache_attr)
+                if cached is not None and \
+                        now - getattr(self, ts_attr) < ttl_s:
+                    return cached
+                if cache_attr not in self._fetch_in_flight:
+                    self._fetch_in_flight.add(cache_attr)
+                    break
+                if cached is not None:
+                    return cached          # stale beats a stampede
+                self._pods_cache_cond.wait()
+        try:
+            value = fetch()
+        except BaseException:
+            with self._pods_cache_cond:
+                self._fetch_in_flight.discard(cache_attr)
+                self._pods_cache_cond.notify_all()
+            raise
+        with self._pods_cache_cond:
             setattr(self, cache_attr, value)
-            setattr(self, ts_attr, now)
+            setattr(self, ts_attr, time.monotonic())
+            self._fetch_in_flight.discard(cache_attr)
+            self._pods_cache_cond.notify_all()
         return value
 
     def _list_pods(self) -> tuple[list[dict], dict[str, list[dict]]]:
@@ -183,7 +230,8 @@ class FilterPredicate:
     def _assume(self, pod_uid: str, node: str,
                 claims: PodDeviceClaims) -> None:
         with self._assumed_lock:
-            self._assumed[pod_uid] = _Assumed(node, claims, time.time())
+            self._assumed[pod_uid] = _Assumed(node, claims,
+                                              time.monotonic())
         # A commit also patched pod ANNOTATIONS (pre-allocation, gang
         # origin) that the assumed cache does not carry — drop the pod
         # snapshot so the next pass (e.g. the next member of a gang
@@ -200,7 +248,7 @@ class FilterPredicate:
         deleted before ever appearing) are dropped here; entries whose
         pod became visible in the pod list are dropped by the caller via
         _drop_assumed, where the per-node resident set exists."""
-        now = time.time()
+        now = time.monotonic()
         out: dict[str, list] = {}
         with self._assumed_lock:
             for uid in list(self._assumed):
@@ -229,10 +277,22 @@ class FilterPredicate:
             return R.NODE_NO_DEVICES
         return None
 
+    def _entry_gate(self, entry) -> str | None:
+        """Snapshot analogue of _node_gate over a precomputed NodeEntry
+        (registry decoded at watch-apply time, labels cached)."""
+        if self.require_node_label and \
+                entry.labels.get(NODE_ENABLE_LABEL) != "true":
+            return R.NODE_LABEL_MISMATCH
+        if entry.registry is None:
+            return R.NODE_NO_DEVICES
+        return None
+
     # -- entry --------------------------------------------------------------
 
     def filter(self, args: dict) -> FilterResult:
         pod = args.get("Pod") or args.get("pod") or {}
+        if self._snapshot is not None:
+            return self._filter_snapshot(args, pod)
         nodes = self._candidate_nodes(args)
         try:
             req = build_allocation_request(pod)
@@ -258,6 +318,82 @@ class FilterPredicate:
                     return self._filter_locked(pod, req, nodes)
             return self._filter_locked(pod, req, nodes)
 
+    def _filter_snapshot(self, args: dict, pod: dict) -> FilterResult:
+        """SchedulerSnapshot entry: same pass, fed from the watch-driven
+        snapshot instead of TTL LISTs. The snapshot pump is its own trace
+        stage so apply-lag is attributable per pod."""
+        snap = self._snapshot
+        ctx = trace.context_for_pod(pod)
+        pump_stats: dict = {}
+        with trace.span(ctx, "scheduler.snapshot", pump=pump_stats):
+            applied, relisted = snap.ensure_fresh()
+            pump_stats.update(applied=applied, relisted=relisted,
+                              staleness_s=round(snap.staleness_s(), 6),
+                              generation=snap.generation)
+        names = self._candidate_names(args)
+        try:
+            req = build_allocation_request(pod)
+        except RequestError as e:
+            return FilterResult(error=f"invalid vtpu request: {e}")
+        if req.is_empty():
+            # non-vtpu pods pass every requested node untouched — the
+            # requested NAMES, not the snapshot's view of them (a node
+            # the watch has not caught up with is none of our business)
+            return FilterResult(node_names=(
+                names if names is not None
+                else list(snap.entries().keys())))
+        missing: list[str] = []
+        candidates = None
+        if names is not None:
+            entries = snap.entries()
+            candidates = []
+            for name in names:
+                entry = entries.get(name)
+                if entry is not None:
+                    candidates.append(entry)
+                else:
+                    # the scheduler's informer can be fresher than our
+                    # watch (apply-lag); surface the gap instead of
+                    # silently shrinking the candidate set
+                    missing.append(name)
+        n_nodes = (len(candidates) if candidates is not None
+                   else len(snap.entries()))
+        with trace.span(ctx, "scheduler.filter", nodes=n_nodes):
+            if self.serialize:
+                # same whole-pass serial section as the TTL path: see
+                # the rationale on filter()'s serialize branch
+                with self._serial_lock:
+                    # vtlint: disable=lock-discipline — see above
+                    result = self._filter_locked(pod, req, candidates,
+                                                 snap=snap)
+            else:
+                result = self._filter_locked(pod, req, candidates,
+                                             snap=snap)
+        for name in missing:
+            result.failed_nodes.setdefault(
+                name, "node not yet in scheduler snapshot")
+        return result
+
+    @staticmethod
+    def _candidate_names(args: dict) -> list[str] | None:
+        """Requested candidate node names from ExtenderArgs. Both wire
+        shapes reduce to names on the snapshot path: with
+        nodeCacheCapable=true the names ARE the payload (no more
+        one-GET-per-name), and full NodeList payloads are treated as a
+        name filter over the snapshot. None = no restriction — the pass
+        walks the snapshot's capacity rank directly, without
+        materializing an O(nodes) list."""
+        node_list = args.get("Nodes") or args.get("nodes")
+        if node_list:
+            items = node_list.get("Items") or node_list.get("items")
+            if items:
+                return [(n.get("metadata") or {}).get("name", "")
+                        for n in items]
+        raw = args.get("NodeNames") or args.get("nodenames")
+        if raw is not None:
+            return list(raw)
+        return None
+
     def _candidate_nodes(self, args: dict) -> list[dict]:
         # ExtenderArgs with nodeCacheCapable=false carries the full NodeList
         # (k8s JSON: {"nodes":{"items":[...]}}); with nodeCacheCapable=true
@@ -268,39 +404,64 @@ class FilterPredicate:
             if items:
                 return items
         names = args.get("NodeNames") or args.get("nodenames")
+        listing = self._ttl_cached(self.nodes_ttl_s, "_nodes_cache",
+                                   "_nodes_cache_ts",
+                                   self.client.list_nodes)
         if names is None:
-            return self._ttl_cached(self.nodes_ttl_s, "_nodes_cache",
-                                    "_nodes_cache_ts",
-                                    self.client.list_nodes)
+            return listing
+        # nodeCacheCapable=true sends names only; resolving them with one
+        # get_node per name was O(N) API round-trips per pass — serve
+        # them from the (TTL-cached) listing instead. A name the cached
+        # listing lacks may be a node newer than the cache (the
+        # scheduler's informer is independent and can be fresher), so
+        # only those few fall back to a fresh GET; a real 404 skips the
+        # name, same as the per-name path did.
+        by_name = {(n.get("metadata") or {}).get("name", ""): n
+                   for n in listing}
         out = []
         for name in names:
-            try:
-                out.append(self.client.get_node(name))
-            except KubeError:
-                continue
+            node = by_name.get(name)
+            if node is None:
+                try:
+                    node = self.client.get_node(name)
+                except KubeError:
+                    continue
+            out.append(node)
         return out
 
     def _filter_locked(self, pod: dict, req: AllocationRequest,
-                       nodes: list[dict]) -> FilterResult:
+                       nodes: list, snap=None) -> FilterResult:
+        """One pass. ``nodes`` carries node dicts on the TTL path and
+        snapshot NodeEntry objects when ``snap`` is set; both converge on
+        the same ranked tuples, so ordering/allocation/commit below are
+        one code path and cannot drift between the modes."""
         now = time.time()
         ctx = trace.context_for_pod(pod)
         result = FilterResult()
         reasons = R.FailureReasons()
 
-        candidates = []
-        for node in nodes:
-            name = (node.get("metadata") or {}).get("name", "")
-            why = self._node_gate(node, req)
-            if why is None:
-                candidates.append(node)
-            else:
-                result.failed_nodes[name] = why
-                reasons.add(why, name)
+        if snap is not None and nodes is None:
+            # unrestricted snapshot pass: no O(nodes) candidate list —
+            # the rank walk gates each visited entry lazily
+            candidates = None
+        else:
+            candidates = []
+            for node in nodes:
+                if snap is not None:
+                    name, why = node.name, self._entry_gate(node)
+                else:
+                    name = (node.get("metadata") or {}).get("name", "")
+                    why = self._node_gate(node, req)
+                if why is None:
+                    candidates.append(node)
+                else:
+                    result.failed_nodes[name] = why
+                    reasons.add(why, name)
 
         # One cluster-wide scheduled-pod list per pass (TTL-cached, see
         # _list_pods), partitioned by nodeName — not one API call per
-        # candidate node.
-        _, by_node = self._list_pods()
+        # candidate node. The snapshot path keeps residents per entry.
+        by_node = {} if snap is not None else self._list_pods()[1]
 
         prefer_origin = None
         gang_domains: set[str] = set()
@@ -314,14 +475,20 @@ class FilterPredicate:
             # Needs the FULL list: burst siblings are committed (and carry
             # the gang/predicate annotations) before they have a nodeName.
             # Traced as its own child stage: gang resolution is the one
-            # filter step whose cost scales with the CLUSTER pod list, so
+            # filter step whose cost scales with the CLUSTER pod list
+            # (snapshot mode: with the gang index, only with the GANG), so
             # a slow placement must be attributable to it specifically.
             with trace.span(ctx, "scheduler.gang", gang=req.gang_name):
                 pod_meta = pod.get("metadata") or {}
                 gang_ns = pod_meta.get("namespace", "default")
-                gang_siblings = gang.live_siblings(
-                    req.gang_name, pod_meta.get("uid", ""),
-                    self._list_all_pods(), namespace=gang_ns)
+                if snap is not None:
+                    gang_siblings = gang.live_siblings_indexed(
+                        snap.gang_members(gang_ns, req.gang_name),
+                        pod_meta.get("uid", ""))
+                else:
+                    gang_siblings = gang.live_siblings(
+                        req.gang_name, pod_meta.get("uid", ""),
+                        self._list_all_pods(), namespace=gang_ns)
                 prefer_origin = gang.resolve_gang_origin(
                     req.gang_name, gang_siblings, namespace=gang_ns)
                 # L2 cross-node affinity: domains the gang already
@@ -330,24 +497,62 @@ class FilterPredicate:
                 # list contributes no signal (bias degrades to none,
                 # never to a wrong bias).
                 domain_by_node = {}
-                for node in nodes:
-                    meta = node.get("metadata") or {}
-                    reg = dt.decode_registry(
-                        (meta.get("annotations") or {}).get(
-                            consts.node_device_register_annotation()))
-                    if reg is not None and reg.mesh_domain:
-                        domain_by_node[meta.get("name", "")] = \
-                            reg.mesh_domain
+                if snap is not None:
+                    pool = (candidates if candidates is not None
+                            else snap.entries().values())
+                    for entry in pool:
+                        if entry.registry is not None \
+                                and entry.registry.mesh_domain:
+                            domain_by_node[entry.name] = \
+                                entry.registry.mesh_domain
+                else:
+                    for node in nodes:
+                        meta = node.get("metadata") or {}
+                        reg = dt.decode_registry(
+                            (meta.get("annotations") or {}).get(
+                                consts.node_device_register_annotation()))
+                        if reg is not None and reg.mesh_domain:
+                            domain_by_node[meta.get("name", "")] = \
+                                reg.mesh_domain
                 gang_domains = gang.sibling_domains(gang_siblings,
                                                     domain_by_node)
 
-        # Gate + rank every surviving node on fast free totals (memoized
-        # registry totals minus claim sums — no DeviceUsage materialized),
-        # then build the full usage view lazily, only for nodes the
-        # allocator actually visits.
+        assumed_by_node = self._assumed_by_node()
+        spread = req.node_policy == consts.NODE_POLICY_SPREAD
+        if snap is not None:
+            # walk the snapshot's incrementally maintained capacity rank
+            # — no per-pass O(nodes) ranking, no decode
+            scored = self._snapshot_scored(
+                snap, req, candidates, assumed_by_node, spread,
+                gang_domains, gang_siblings, prefer_origin, result,
+                reasons, now)
+        else:
+            scored = self._ttl_scored(
+                req, candidates, by_node, assumed_by_node, spread,
+                gang_domains, gang_siblings, prefer_origin, result,
+                reasons, now)
+
+        if not scored:
+            result.error = reasons.summary() or "no schedulable vtpu node"
+            self._emit_rejection_event(pod, result.error)
+            return result
+
+        best = order_nodes(scored)[0]
+        self._commit(pod, req, best)
+        result.node_names = [best.name]
+        return result
+
+    def _ttl_scored(self, req: AllocationRequest, candidates: list[dict],
+                    by_node: dict, assumed_by_node: dict, spread: bool,
+                    gang_domains: set, gang_siblings: list,
+                    prefer_origin, result: FilterResult, reasons,
+                    now: float) -> list[ScoredNode]:
+        """TTL-path ranking: gate + rank every surviving node on fast
+        free totals (memoized registry totals minus claim sums — no
+        DeviceUsage materialized), then build the full usage view lazily,
+        only for nodes the allocator actually visits."""
         ranked = []
         reg_ann = consts.node_device_register_annotation()
-        assumed_by_node = self._assumed_by_node()
         now_visible: set[str] = set()
         req_number, req_cores, req_memory = (
             req.total_number(), req.total_cores(), req.total_memory())
@@ -390,7 +595,6 @@ class FilterPredicate:
         # is useless if candidate_limit truncation never visits them (a
         # sibling's partially-used slice sorts last under spread on a big
         # cluster — exactly the node that must be scored).
-        spread = req.node_policy == consts.NODE_POLICY_SPREAD
         ranked.sort(key=lambda t: (t[0], t[1]), reverse=spread)
         if gang_domains:
             ranked.sort(key=lambda t: t[2].mesh_domain not in gang_domains)
@@ -404,44 +608,155 @@ class FilterPredicate:
                 enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
-            # the gate already decoded/filtered everything this needs —
-            # build the usage view from its outputs, never recompute
-            info = NodeInfo.from_registry(name, registry, counted)
-            for uid, entry in assumed:
-                info.assume_pod(uid, entry.claims)
-            # same-node siblings anchor the submesh search so a gang
-            # sharing a node tiles contiguously on the mesh (cross-pod
-            # ICI adjacency — the L0 NVLink-component analogue); burst
-            # siblings are attributed via the predicate-node annotation
-            # because they are committed before they carry a nodeName
-            anchor = gang.sibling_anchor_cells(
-                name, gang_siblings, registry) if gang_siblings else None
-            try:
-                alloc_result = allocate(info, req,
-                                        prefer_origin=prefer_origin,
-                                        anchor_cells=anchor)
-            except AllocationFailure as f:
-                why = f.reasons.summary() or "allocation failed"
-                result.failed_nodes[name] = why
-                reasons.add(why.split(";")[0].split(" x")[0], name)
+            self._allocate_node(name, registry, counted, assumed, req,
+                                prefer_origin, gang_siblings,
+                                gang_domains, scored, result, reasons)
+        return scored
+
+    def _snapshot_scored(self, snap, req: AllocationRequest,
+                         candidates: list, assumed_by_node: dict,
+                         spread: bool, gang_domains: set,
+                         gang_siblings: list, prefer_origin,
+                         result: FilterResult, reasons,
+                         now: float) -> list[ScoredNode]:
+        """Snapshot-path candidate walk. The capacity rank is maintained
+        by the snapshot O(log n) per event, so the pass walks its head in
+        policy order (ascending for binpack, descending for spread) and
+        stops at candidate_limit successful-capacity visits — the same
+        truncation contract as the TTL sort, without ranking 5000 nodes
+        per pod. Every visited node is re-validated on exact totals
+        (conditional expiries and the assumed overlay folded in), so a
+        stale rank key can cost a visit, never an overcommit. Nodes the
+        walk never reaches don't get failed_nodes entries (the TTL path
+        reports every node); a no-fit pass still walks everything.
+        ``candidates`` None means unrestricted: entries resolve straight
+        off the snapshot and the node gate runs per visit."""
+        req_number, req_cores, req_memory = (
+            req.total_number(), req.total_cores(), req.total_memory())
+        if candidates is None:
+            cand_get = snap.entries().get
+        else:
+            cand_get = {e.name: e for e in candidates}.get
+        # retire assumed commits whose pods reached the snapshot; keep
+        # the leftovers as the per-node overlay for the walk (O(assumed),
+        # not O(candidates))
+        assumed_left: dict[str, list] = {}
+        now_visible: set[str] = set()
+        for name, assumed in assumed_by_node.items():
+            entry = cand_get(name)
+            if entry is not None:
+                retired = [u for u, _ in assumed if u in entry.resident]
+                if retired:
+                    now_visible.update(retired)
+                    assumed = [(u, e) for u, e in assumed
+                               if u not in entry.resident]
+            if assumed:
+                assumed_left[name] = assumed
+        if now_visible:
+            self._drop_assumed(now_visible)
+
+        scored: list[ScoredNode] = []
+        visited = 0
+        lazy_gate = candidates is None
+
+        def visit(entry) -> None:
+            nonlocal visited
+            name = entry.name
+            if lazy_gate:
+                why = self._entry_gate(entry)
+                if why is not None:
+                    result.failed_nodes[name] = why
+                    reasons.add(why, name)
+                    return
+            if entry.conditional and any(now > c[2]
+                                         for c in entry.conditional):
+                # grace expiries have no watch event; prune lazily so
+                # the steady state returns to the precomputed triple
+                snap.prune_expired(name, now)
+                entry = snap.entry(name) or entry
+            assumed = assumed_left.get(name, [])
+            if entry.conditional or assumed:
+                free = snap_mod.entry_free_totals(
+                    entry, [e.claims for _, e in assumed], now)
+            else:
+                free = entry.base_free
+            if (free[0] < req_number or free[1] < req_cores
+                    or free[2] < req_memory):
+                result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
+                reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
+                return
+            visited += 1
+            self._allocate_node(name, entry.registry,
+                                snap_mod.entry_counted(entry, now),
+                                assumed, req, prefer_origin,
+                                gang_siblings, gang_domains, scored,
+                                result, reasons)
+
+        # gang-domain candidates walk first regardless of global rank
+        # (same bump the TTL sort applies): the +100 scoring bonus is
+        # useless if truncation never visits them
+        gang_names: set[str] = set()
+        if gang_domains:
+            pool = (candidates if candidates is not None
+                    else snap.entries().values())
+            bumped = [e for e in pool
+                      if e.registry is not None
+                      and e.registry.mesh_domain in gang_domains]
+            bumped.sort(key=lambda e: (e.rank_key, e.name),
+                        reverse=spread)
+            gang_names = {e.name for e in bumped}
+            for entry in bumped:
+                if visited >= self.candidate_limit and scored:
+                    break
+                visit(entry)
+        rank = snap.rank_items()
+        for _key, name in (reversed(rank) if spread else rank):
+            if visited >= self.candidate_limit and scored:
+                break
+            if name in gang_names:
                 continue
-            score = node_score(alloc_result, req)
-            if gang_domains and registry.mesh_domain in gang_domains:
-                # keeping the gang on one multi-host slice outweighs any
-                # per-node topology/packing difference: a member placed
-                # off-slice pays DCN for every gang collective
-                score += 100.0
-            scored.append(ScoredNode(name, score, alloc_result))
+            entry = cand_get(name)
+            if entry is None:
+                continue
+            visit(entry)
+        return scored
 
-        if not scored:
-            result.error = reasons.summary() or "no schedulable vtpu node"
-            self._emit_rejection_event(pod, result.error)
-            return result
-
-        best = order_nodes(scored)[0]
-        self._commit(pod, req, best)
-        result.node_names = [best.name]
-        return result
+    def _allocate_node(self, name: str, registry, counted: list,
+                       assumed: list, req: AllocationRequest,
+                       prefer_origin, gang_siblings: list,
+                       gang_domains: set, scored: list,
+                       result: FilterResult, reasons) -> None:
+        """Full allocation + scoring for one capacity-gated node — the
+        one body both data paths share, so placement semantics cannot
+        drift between them."""
+        # the gate already decoded/filtered everything this needs —
+        # build the usage view from its outputs, never recompute
+        info = NodeInfo.from_registry(name, registry, counted)
+        for uid, entry in assumed:
+            info.assume_pod(uid, entry.claims)
+        # same-node siblings anchor the submesh search so a gang
+        # sharing a node tiles contiguously on the mesh (cross-pod
+        # ICI adjacency — the L0 NVLink-component analogue); burst
+        # siblings are attributed via the predicate-node annotation
+        # because they are committed before they carry a nodeName
+        anchor = gang.sibling_anchor_cells(
+            name, gang_siblings, registry) if gang_siblings else None
+        try:
+            alloc_result = allocate(info, req,
+                                    prefer_origin=prefer_origin,
+                                    anchor_cells=anchor)
+        except AllocationFailure as f:
+            why = f.reasons.summary() or "allocation failed"
+            result.failed_nodes[name] = why
+            reasons.add(why.split(";")[0].split(" x")[0], name)
+            return
+        score = node_score(alloc_result, req)
+        if gang_domains and registry.mesh_domain in gang_domains:
+            # keeping the gang on one multi-host slice outweighs any
+            # per-node topology/packing difference: a member placed
+            # off-slice pays DCN for every gang collective
+            score += 100.0
+        scored.append(ScoredNode(name, score, alloc_result))
 
     # -- commit: annotation patch is the only cross-process channel ---------
 

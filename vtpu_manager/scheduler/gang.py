@@ -103,6 +103,20 @@ def live_siblings(gang_name: str, self_uid: str,
     return out
 
 
+def live_siblings_indexed(members: list[dict],
+                          self_uid: str) -> list[dict]:
+    """live_siblings() over a pre-resolved same-gang member list (the
+    cluster snapshot's gang index, already keyed by resolved name and
+    namespace) — O(gang) instead of O(cluster). The liveness rule is the
+    same: drop the pod being scheduled and members that no longer count
+    by should_count_pod (the time-dependent part, so it is evaluated at
+    use time, never cached in the index)."""
+    from vtpu_manager.device.types import should_count_pod
+    return [pod for pod in members
+            if (pod.get("metadata") or {}).get("uid", "") != self_uid
+            and should_count_pod(pod)]
+
+
 def sibling_node_names(siblings: list[dict]) -> set[str]:
     """Nodes hosting (or committed to host) members of the gang
     (`siblings` is a pre-resolved live_siblings() list)."""

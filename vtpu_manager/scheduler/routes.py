@@ -27,11 +27,14 @@ VERSION = "0.1.0"
 class SchedulerAPI:
     def __init__(self, filter_pred: FilterPredicate, bind_pred: BindPredicate,
                  preempt_pred: PreemptPredicate,
-                 debug_endpoints: bool = False):
+                 debug_endpoints: bool = False,
+                 snapshot=None):
         self.filter_pred = filter_pred
         self.bind_pred = bind_pred
         self.preempt_pred = preempt_pred
         self.debug_endpoints = debug_endpoints
+        # SchedulerSnapshot gate: exported on /metrics when present
+        self.snapshot = snapshot
         self.stats = {"filter": 0, "bind": 0, "preempt": 0, "errors": 0}
         self._started = time.time()
 
@@ -104,6 +107,24 @@ class SchedulerAPI:
         for k, v in self.stats.items():
             lines.append(
                 f'vtpu_scheduler_requests_total{{endpoint="{k}"}} {v}')
+        if self.snapshot is not None:
+            # watch-driven snapshot health: how much change is flowing,
+            # how often the watch window was lost (relists), how much
+            # decode the O(changed) contract actually paid, and how stale
+            # the state a filter pass reads can be
+            lines.append(
+                "# TYPE vtpu_scheduler_snapshot_events_total counter")
+            for name, value in self.snapshot.stats.as_dict().items():
+                lines.append(
+                    f'vtpu_scheduler_snapshot_events_total'
+                    f'{{kind="{name}"}} {value}')
+            lines.append(
+                "# TYPE vtpu_scheduler_snapshot_staleness_seconds gauge")
+            lines.append(f"vtpu_scheduler_snapshot_staleness_seconds "
+                         f"{self.snapshot.staleness_s():.6f}")
+            lines.append("# TYPE vtpu_scheduler_snapshot_generation gauge")
+            lines.append(f"vtpu_scheduler_snapshot_generation "
+                         f"{self.snapshot.generation}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

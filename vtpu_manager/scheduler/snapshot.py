@@ -1,0 +1,656 @@
+"""Watch-driven incremental cluster snapshot for the scheduler hot path.
+
+Reference: the Go extender reads nodes and resident pods from client-go
+informers (filter_predicate.go:541-866) — decode and indexing happen once
+per *change*, not once per *decision*. The TTL-LIST caches this replaces
+(filter.py) re-decode every node registry and every resident claim set on
+each refresh even when nothing changed: O(nodes + resident pods) JSON per
+filter pass. This module is the informer analogue: one versioned LIST
+seeds the state, a WATCH streams ADDED/MODIFIED/DELETED/BOOKMARK events,
+and each event updates only the structures it touches — the decoded
+registry, the partitioned resident-pod list, the counted-claims
+aggregates, and the ``fast_free_totals`` triple per node, plus a gang
+index keyed by resolved group name. A filter pass over an unchanged
+5000-node cluster decodes zero JSON (asserted via
+``device.types.DECODE_COUNTERS`` in test_snapshot.py).
+
+Consistency model, in line with the reference informer semantics:
+
+- Every mutation swaps a whole immutable-by-convention ``NodeEntry`` into
+  ``_entries`` under ``_lock``; a filter pass reads the live dict (no
+  copy). CPython dict value replacement is safe against concurrent
+  iteration, and node add/remove (the only structural mutations) rebuild
+  the dict object so in-flight iterations keep a coherent older view.
+- Watch I/O and all JSON decode happen OUTSIDE ``_lock`` (vtlint
+  lock-discipline is load-bearing here): events are *prepared* — claims
+  classified, registries decoded — on the pumping thread, and only the
+  dict swaps run under the lock.
+- Relist-on-410: when the watch's resourceVersion has been compacted
+  away the whole state is rebuilt from a fresh versioned LIST, exactly
+  the client-go reflector contract.
+
+Time-dependent counting (should_count_pod's stuck grace) is folded in by
+classifying each pod once at apply time into *unconditional* (counts
+until an event changes it) or *conditional* (counts until a wall-clock
+expiry — pre-allocated but not yet confirmed). The per-node
+``base_free`` covers unconditional claims; passes fold the handful of
+live conditionals (and the filter's assumed overlay) arithmetically,
+with zero decode.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import threading
+import time
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.claims import container_kinds, effective_claims
+from vtpu_manager.util import consts
+from vtpu_manager.util.gangname import resolve_gang_name
+
+log = logging.getLogger(__name__)
+
+_EMPTY_FREE = (0, 0, 0)
+
+
+class NodeEntry:
+    """One node's precomputed scheduling view. Instances are never mutated
+    after publication — every change swaps a fresh entry into the
+    snapshot, so a pass holding a reference sees one coherent state."""
+
+    __slots__ = ("name", "node", "labels", "registry", "resident",
+                 "counted", "conditional", "base_free", "rank_key",
+                 "generation")
+
+    def __init__(self, name: str, node: dict, labels: dict, registry,
+                 resident: dict, counted: list, conditional: list,
+                 base_free: tuple, rank_key: int, generation: int):
+        self.name = name
+        self.node = node                  # raw node object (shared ref)
+        self.labels = labels
+        self.registry = registry          # decoded NodeDeviceRegistry | None
+        self.resident = resident          # uid -> pod (scheduled here)
+        self.counted = counted            # [(uid, claims)] unconditional
+        self.conditional = conditional    # [(uid, claims, expiry_wall_s)]
+        self.base_free = base_free        # free totals over `counted` only
+        # capacity-rank key over free totals INCLUDING build-time-live
+        # conditionals — same formula the filter's TTL path sorts on
+        # (free_cores + (free_memory >> 24) + free_number). A grace
+        # expiry between events makes it pessimistic (node ranked as
+        # less free than it is) until the lazy prune republishes; exact
+        # totals are always recomputed at visit time.
+        self.rank_key = rank_key
+        self.generation = generation
+
+
+class SnapshotStats:
+    """Pump/apply counters, exported as Prometheus counters by routes.py
+    and asserted by the O(changed) tests. GIL-atomic int adds."""
+
+    __slots__ = ("events_applied", "pod_events", "node_events", "bookmarks",
+                 "relists", "watch_errors", "registry_decodes",
+                 "claims_decodes")
+
+    def __init__(self) -> None:
+        self.events_applied = 0
+        self.pod_events = 0
+        self.node_events = 0
+        self.bookmarks = 0
+        self.relists = 0
+        self.watch_errors = 0
+        self.registry_decodes = 0      # decodes performed at apply time
+        self.claims_decodes = 0
+
+    def as_dict(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in self.__slots__}
+
+
+def _classify_pod(pod: dict, stuck_grace_s: float,
+                  stats: SnapshotStats | None = None):
+    """(claims, expiry) mirroring should_count_pod + counted_claims:
+    claims is the phase-peak effective set if the pod can count, else
+    None; expiry None means it counts until an event changes it, else it
+    counts while now <= expiry (wall clock — predicate_time crosses
+    processes). Countedness only *decreases* with time between events
+    (grace expiry); every increase arrives as a watch event."""
+    if (pod.get("status") or {}).get("phase", "") in ("Succeeded", "Failed"):
+        return None, None
+    anns = (pod.get("metadata") or {}).get("annotations") or {}
+    real = anns.get(consts.real_allocated_annotation())
+    pre = anns.get(consts.pre_allocated_annotation())
+    if not real and not pre:
+        return None, None
+    if stats is not None:
+        stats.claims_decodes += 1
+    claims = dt.get_pod_device_claims(pod)
+    if claims is None:
+        return None, None
+    kinds, init_order = container_kinds(pod.get("spec") or {})
+    claims = effective_claims(claims, kinds, init_order)
+    if real:
+        return claims, None
+    ts = consts.parse_predicate_time(anns)
+    if ts is None:
+        # absent/garbage stamp: count forever (never free capacity on a
+        # parse failure — same posture as should_count_pod)
+        return claims, None
+    grace = stuck_grace_s
+    override = anns.get(consts.scheduler_stuck_grace_annotation())
+    if override:
+        try:
+            grace = float(override)
+        except ValueError:
+            pass
+    return claims, ts + grace
+
+
+def entry_counted(entry: NodeEntry, now: float) -> list:
+    """Merged (uid, claims) pairs that count at ``now`` — identical
+    membership to counted_claims() over the node's residents, from
+    pre-decoded state."""
+    if not entry.conditional:
+        return entry.counted
+    return entry.counted + [(uid, claims)
+                            for uid, claims, expiry in entry.conditional
+                            if now <= expiry]
+
+
+def entry_free_totals(entry: NodeEntry, extra_claims: list,
+                      now: float) -> tuple[int, int, int]:
+    """Free totals with the pass's extra (assumed) claim sets folded in.
+    The steady state — no conditionals, no assumed — returns the
+    precomputed triple untouched; otherwise one fast_free_totals over
+    already-decoded claims (per-chip clamping is non-linear, so partial
+    sums cannot simply be subtracted)."""
+    if entry.registry is None:
+        return _EMPTY_FREE
+    if not entry.conditional and not extra_claims:
+        return entry.base_free
+    sets = [claims for _, claims in entry_counted(entry, now)]
+    sets.extend(extra_claims)
+    return dt.fast_free_totals(entry.registry, sets)
+
+
+class ClusterSnapshot:
+    """Incremental list+watch view of nodes and pods for the scheduler.
+
+    Two pump modes share one implementation: tests and the perf harness
+    call ``ensure_fresh()`` at pass start (the fake client's watch
+    returns immediately), while a real deployment runs
+    ``start_background()`` so a daemon thread consumes the streaming
+    watch and passes observe an always-fresh snapshot.
+    """
+
+    def __init__(self, client: KubeClient,
+                 stuck_grace_s: float = consts.DEFAULT_STUCK_GRACE_S,
+                 watch_timeout_s: float = 0.0):
+        self.client = client
+        self.stuck_grace_s = stuck_grace_s
+        self.watch_timeout_s = watch_timeout_s
+        self.stats = SnapshotStats()
+        self.generation = 0
+        # _lock guards every structure below; only dict/list swaps happen
+        # under it (decode + I/O run on the pumping thread outside).
+        self._lock = threading.Lock()
+        self._entries: dict[str, NodeEntry] = {}
+        self._pods: dict[str, dict] = {}              # uid -> pod (ALL pods)
+        self._pod_node: dict[str, str] = {}           # uid -> nodeName | ""
+        self._pod_class: dict[str, tuple] = {}        # uid -> (claims, expiry)
+        self._pod_gang: dict[str, tuple | None] = {}  # uid -> (ns, gang)
+        self._gangs: dict[tuple, dict[str, dict]] = {}
+        self._node_pod_uids: dict[str, set[str]] = {}
+        # incrementally maintained capacity rank: ascending (rank_key,
+        # name) for every node with a decoded registry. The filter's
+        # TTL path sorts all nodes per pass (O(n log n) per decision);
+        # here one event costs a bisect remove+insert and a pass just
+        # walks the head — rank once on change, not once per decision.
+        self._rank: list[tuple[int, str]] = []
+        self._rank_of: dict[str, tuple[int, str]] = {}
+        self._all_pods_cache: list[dict] | None = None
+        self._pods_rv = ""
+        self._nodes_rv = ""
+        # _pump_lock serializes watch consumers (direct pumps vs the
+        # background loop); watch I/O deliberately happens while holding
+        # it — it guards no pass-visible state and is never taken under
+        # _lock (lock order is strictly _pump_lock -> _lock).
+        self._pump_lock = threading.Lock()
+        self._background = False
+        self._stop = threading.Event()
+        self._last_pump_monotonic = 0.0
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Seed the snapshot with one versioned LIST of nodes and pods."""
+        with self._pump_lock:
+            # vtlint: disable=lock-discipline — see _pump_lock comment
+            self._relist()
+        self._started = True
+
+    def start_background(self, poll_s: float = 1.0) -> None:
+        """Continuous watch consumption on a daemon thread (production
+        mode: passes never pay watch latency; apply-lag <= poll_s plus
+        stream delivery)."""
+        if not self._started:
+            self.start()
+        self._background = True
+        threading.Thread(target=self._background_loop, args=(poll_s,),
+                         daemon=True, name="vtpu-snapshot-watch").start()
+
+    def stop_background(self) -> None:
+        self._background = False
+        self._stop.set()
+
+    def _background_loop(self, poll_s: float) -> None:
+        while self._background:
+            try:
+                self.pump(timeout_s=poll_s)
+            except Exception:
+                # a wedged watch must degrade to a stale-but-coherent
+                # snapshot, never take the scheduler down
+                log.warning("snapshot watch pump failed; serving the "
+                            "last coherent state", exc_info=True)
+                self.stats.watch_errors += 1
+            # pacing: poll-style watches (the fake) return immediately,
+            # streaming watches already spent up to poll_s on the wire —
+            # either way the extra wait bounds apply-lag at ~2*poll_s
+            self._stop.wait(poll_s)
+
+    # -- pumping ------------------------------------------------------------
+
+    def ensure_fresh(self) -> tuple[int, bool]:
+        """Apply whatever the watch has pending; (events_applied,
+        relisted). With a background consumer running this is a no-op —
+        the snapshot is already current within the poll interval."""
+        if self._background:
+            return 0, False
+        return self.pump(timeout_s=self.watch_timeout_s)
+
+    def pump(self, timeout_s: float = 0.0) -> tuple[int, bool]:
+        with self._pump_lock:
+            # vtlint: disable=lock-discipline — see _pump_lock comment
+            return self._pump_locked(timeout_s)
+
+    def _pump_locked(self, timeout_s: float) -> tuple[int, bool]:
+        applied = 0
+        relisted = False
+        ok = True
+        for kind in ("nodes", "pods"):
+            try:
+                applied += self._drain(kind, timeout_s)
+            except KubeError as e:
+                if e.status == 410:
+                    # our resourceVersion was compacted away: the watch
+                    # window is gone, rebuild from a fresh LIST
+                    self._relist()
+                    relisted = True
+                else:
+                    log.warning("snapshot %s watch failed (%s); serving "
+                                "the last coherent state", kind, e)
+                    self.stats.watch_errors += 1
+                    ok = False
+        if ok:
+            # only a fully successful pump resets the freshness clock:
+            # staleness_s is the exported how-old-can-my-state-be gauge,
+            # and a failing watch must make it GROW, not read ~0
+            self._last_pump_monotonic = time.monotonic()
+        return applied, relisted
+
+    def _drain(self, kind: str, timeout_s: float) -> int:
+        if kind == "nodes":
+            events = self.client.watch_nodes(self._nodes_rv,
+                                             timeout_s=timeout_s)
+        else:
+            events = self.client.watch_pods(self._pods_rv,
+                                            timeout_s=timeout_s)
+        applied = 0
+        for event in events:
+            self.apply_event(kind, event)
+            applied += 1
+        return applied
+
+    def staleness_s(self) -> float:
+        """Seconds since the last fully successful pump (0 before the
+        first). Grows monotonically while the watch is failing."""
+        if self._last_pump_monotonic == 0.0:
+            return 0.0
+        return max(0.0, time.monotonic() - self._last_pump_monotonic)
+
+    # -- event application --------------------------------------------------
+
+    def apply_event(self, kind: str, event: dict) -> None:
+        """Apply one watch event. Public so failure-mode tests can inject
+        crafted sequences (duplicates, reordering) directly. Decode and
+        classification run before the lock is taken."""
+        type_ = event.get("type", "")
+        obj = event.get("object") or {}
+        rv = (event.get("resourceVersion")
+              or (obj.get("metadata") or {}).get("resourceVersion") or "")
+        if type_ == "BOOKMARK":
+            self.stats.bookmarks += 1
+            self._advance_rv(kind, rv)
+            return
+        if type_ not in ("ADDED", "MODIFIED", "DELETED"):
+            log.warning("snapshot: ignoring unknown %s watch event %r",
+                        kind, type_)
+            return
+        if kind == "nodes":
+            self._apply_node(type_, obj)
+            self.stats.node_events += 1
+        else:
+            self._apply_pod(type_, obj)
+            self.stats.pod_events += 1
+        self.stats.events_applied += 1
+        self._advance_rv(kind, rv)
+
+    def _advance_rv(self, kind: str, rv: str) -> None:
+        if not rv:
+            return
+        if kind == "nodes":
+            self._nodes_rv = rv
+        else:
+            self._pods_rv = rv
+
+    def _apply_node(self, type_: str, node: dict) -> None:
+        meta = node.get("metadata") or {}
+        name = meta.get("name", "")
+        if not name:
+            return
+        if type_ == "DELETED":
+            with self._lock:
+                if name in self._entries:
+                    entries = dict(self._entries)
+                    del entries[name]
+                    self._entries = entries
+                    self._publish_rank_locked(name, None)
+                    self.generation += 1
+            return
+        # decode outside the lock — the one potentially-large JSON parse
+        # on the node path
+        self.stats.registry_decodes += 1
+        registry = dt.decode_registry(
+            (meta.get("annotations") or {}).get(
+                consts.node_device_register_annotation()))
+        labels = meta.get("labels") or {}
+        with self._lock:
+            self.generation += 1
+            entry = self._build_entry_locked(name, node, labels, registry)
+            if name in self._entries:
+                self._entries[name] = entry       # value swap: safe
+            else:
+                self._entries = {**self._entries, name: entry}
+            self._publish_rank_locked(name, entry)
+
+    def _apply_pod(self, type_: str, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        uid = meta.get("uid", "")
+        if not uid:
+            return
+        if type_ == "DELETED":
+            with self._lock:
+                self.generation += 1
+                self._all_pods_cache = None
+                self._pods.pop(uid, None)
+                self._pod_class.pop(uid, None)
+                self._unlink_gang_locked(uid)
+                old_node = self._pod_node.pop(uid, "")
+                if old_node:
+                    self._node_pod_uids.get(old_node, set()).discard(uid)
+                    self._refresh_entry_locked(old_node)
+            return
+        # classification (claims decode + phase-peak fold) outside the lock
+        cls = _classify_pod(pod, self.stuck_grace_s, self.stats)
+        node_name = (pod.get("spec") or {}).get("nodeName") or ""
+        gang_key = self._gang_key(pod)
+        with self._lock:
+            self.generation += 1
+            self._all_pods_cache = None
+            self._pods[uid] = pod
+            self._pod_class[uid] = cls
+            self._relink_gang_locked(uid, gang_key, pod)
+            old_node = self._pod_node.get(uid, "")
+            self._pod_node[uid] = node_name
+            if old_node and old_node != node_name:
+                self._node_pod_uids.get(old_node, set()).discard(uid)
+                self._refresh_entry_locked(old_node)
+            if node_name:
+                self._node_pod_uids.setdefault(node_name, set()).add(uid)
+                self._refresh_entry_locked(node_name)
+
+    @staticmethod
+    def _gang_key(pod: dict) -> tuple | None:
+        name, _ = resolve_gang_name(pod)
+        if not name:
+            return None
+        ns = (pod.get("metadata") or {}).get("namespace", "default")
+        return (ns, name)
+
+    def _relink_gang_locked(self, uid: str, key: tuple | None,
+                            pod: dict) -> None:
+        # member dicts are copy-on-write: gang_members() hands the live
+        # dict to lock-free readers, so a mutation must publish a fresh
+        # one (gangs are small; the copy is O(gang))
+        old = self._pod_gang.get(uid)
+        if old is not None and old != key:
+            self._gang_remove_locked(old, uid)
+        self._pod_gang[uid] = key
+        if key is not None:
+            self._gangs[key] = {**self._gangs.get(key, {}), uid: pod}
+
+    def _unlink_gang_locked(self, uid: str) -> None:
+        key = self._pod_gang.pop(uid, None)
+        if key is not None:
+            self._gang_remove_locked(key, uid)
+
+    def _gang_remove_locked(self, key: tuple, uid: str) -> None:
+        members = self._gangs.get(key)
+        if members is None or uid not in members:
+            return
+        members = {u: p for u, p in members.items() if u != uid}
+        if members:
+            self._gangs[key] = members
+        else:
+            del self._gangs[key]
+
+    def _refresh_entry_locked(self, name: str) -> None:
+        old = self._entries.get(name)
+        if old is None:
+            return      # pods on a node we have not seen yet: tracked
+        entry = self._build_entry_locked(name, old.node, old.labels,
+                                         old.registry)
+        self._entries[name] = entry
+        self._publish_rank_locked(name, entry)
+
+    def _publish_rank_locked(self, name: str,
+                             entry: NodeEntry | None) -> None:
+        """Keep the sorted capacity rank in sync with one entry swap:
+        bisect out the old position, bisect in the new. The list is
+        copy-on-write — passes iterate the published object lock-free
+        (forward AND reversed), so an in-place del/insort pair would
+        transiently shrink it and permanently terminate a concurrent
+        iterator mid-walk. One O(n) copy per event is noise next to the
+        O(n log n) sort per PASS this structure replaces. Entries
+        without a registry never rank (the filter gate fails them)."""
+        rank = self._rank.copy()
+        old = self._rank_of.pop(name, None)
+        if old is not None:
+            i = bisect.bisect_left(rank, old)
+            if i < len(rank) and rank[i] == old:
+                del rank[i]
+        if entry is not None and entry.registry is not None:
+            item = (entry.rank_key, name)
+            bisect.insort(rank, item)
+            self._rank_of[name] = item
+        self._rank = rank
+
+    def _build_entry_locked(self, name: str, node: dict, labels: dict,
+                            registry) -> NodeEntry:
+        """Recompute one node's aggregates from cached per-pod
+        classifications — pure arithmetic, no decode, O(residents)."""
+        resident: dict[str, dict] = {}
+        counted: list = []
+        conditional: list = []
+        for uid in self._node_pod_uids.get(name, ()):
+            pod = self._pods.get(uid)
+            if pod is None:
+                continue
+            resident[uid] = pod
+            claims, expiry = self._pod_class.get(uid, (None, None))
+            if claims is None:
+                continue
+            if expiry is None:
+                counted.append((uid, claims))
+            else:
+                conditional.append((uid, claims, expiry))
+        if registry is None:
+            base_free = _EMPTY_FREE
+            rank_key = 0
+        else:
+            claim_sets = [c for _, c in counted]
+            base_free = dt.fast_free_totals(registry, claim_sets)
+            if conditional:
+                now = time.time()
+                live = [c for _, c, exp in conditional if now <= exp]
+                free = (dt.fast_free_totals(registry, claim_sets + live)
+                        if live else base_free)
+            else:
+                free = base_free
+            rank_key = free[1] + (free[2] >> 24) + free[0]
+        return NodeEntry(name, node, labels, registry, resident, counted,
+                         conditional, base_free, rank_key,
+                         self.generation)
+
+    # -- relist (seed + 410 recovery) ---------------------------------------
+
+    def _relist(self) -> None:
+        """Full rebuild from fresh versioned LISTs. All decode happens
+        before the final swap; readers keep the previous coherent view
+        until the atomic publication at the end."""
+        self.stats.relists += 1
+        nodes, nodes_rv = self.client.list_nodes_with_version()
+        pods, pods_rv = self.client.list_pods_with_version()
+        pod_map: dict[str, dict] = {}
+        pod_node: dict[str, str] = {}
+        pod_class: dict[str, tuple] = {}
+        pod_gang: dict[str, tuple | None] = {}
+        gangs: dict[tuple, dict[str, dict]] = {}
+        node_pod_uids: dict[str, set[str]] = {}
+        for pod in pods:
+            uid = (pod.get("metadata") or {}).get("uid", "")
+            if not uid:
+                continue
+            pod_map[uid] = pod
+            pod_class[uid] = _classify_pod(pod, self.stuck_grace_s,
+                                           self.stats)
+            node_name = (pod.get("spec") or {}).get("nodeName") or ""
+            pod_node[uid] = node_name
+            if node_name:
+                node_pod_uids.setdefault(node_name, set()).add(uid)
+            key = self._gang_key(pod)
+            pod_gang[uid] = key
+            if key is not None:
+                gangs.setdefault(key, {})[uid] = pod
+        with self._lock:
+            self.generation += 1
+            self._pods = pod_map
+            self._pod_node = pod_node
+            self._pod_class = pod_class
+            self._pod_gang = pod_gang
+            self._gangs = gangs
+            self._node_pod_uids = node_pod_uids
+            self._all_pods_cache = None
+            entries: dict[str, NodeEntry] = {}
+            for node in nodes:
+                meta = node.get("metadata") or {}
+                name = meta.get("name", "")
+                if not name:
+                    continue
+                self.stats.registry_decodes += 1
+                registry = dt.decode_registry(
+                    (meta.get("annotations") or {}).get(
+                        consts.node_device_register_annotation()))
+                entries[name] = self._build_entry_locked(
+                    name, node, meta.get("labels") or {}, registry)
+            self._entries = entries
+            self._rank = sorted((entry.rank_key, name)
+                                for name, entry in entries.items()
+                                if entry.registry is not None)
+            self._rank_of = {name: (entry.rank_key, name)
+                             for name, entry in entries.items()
+                             if entry.registry is not None}
+            self._nodes_rv = nodes_rv
+            self._pods_rv = pods_rv
+
+    # -- pass-facing reads (no copy) ----------------------------------------
+
+    def entries(self) -> dict[str, NodeEntry]:
+        """The live name -> NodeEntry mapping. Safe to iterate: values are
+        swapped in place and structural changes publish a new dict."""
+        return self._entries
+
+    def entry(self, name: str) -> NodeEntry | None:
+        return self._entries.get(name)
+
+    def all_pods(self) -> list[dict]:
+        """Every pod in the cluster including pending (the gang paths need
+        unbound burst siblings); list rebuilt lazily after changes."""
+        cached = self._all_pods_cache
+        if cached is not None:
+            return cached
+        with self._lock:
+            if self._all_pods_cache is None:
+                self._all_pods_cache = list(self._pods.values())
+            return self._all_pods_cache
+
+    def gang_members(self, namespace: str, gang_name: str) -> list[dict]:
+        """Pods of one resolved gang — O(gang), replacing the full-list
+        sibling scan (O(cluster)) on the snapshot path."""
+        members = self._gangs.get((namespace, gang_name))
+        if not members:
+            return []
+        return list(members.values())
+
+    def rank_items(self) -> list[tuple[int, str]]:
+        """The published ascending (rank_key, name) capacity rank. The
+        returned list object is immutable (updates publish a fresh
+        copy), so iterating it — forward or reversed — is safe against
+        concurrent events; it may merely be one generation stale, and
+        every visited node is re-validated against exact totals before
+        allocation."""
+        return self._rank
+
+    def prune_expired(self, name: str, now: float) -> None:
+        """Drop conditionals whose grace expired (no watch event marks
+        that moment). They can never count again — a real allocation or
+        new predicate stamp arrives as MODIFIED and reclassifies — so
+        membership-only pruning is safe and base_free is untouched."""
+        entry = self._entries.get(name)
+        if entry is None or not entry.conditional:
+            return
+        live = [c for c in entry.conditional if now <= c[2]]
+        if len(live) == len(entry.conditional):
+            return
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                return
+            live = [c for c in entry.conditional if now <= c[2]]
+            if entry.registry is not None:
+                free = (dt.fast_free_totals(
+                            entry.registry,
+                            [c for _, c in entry.counted]
+                            + [c for _, c, _e in live])
+                        if live else entry.base_free)
+                rank_key = free[1] + (free[2] >> 24) + free[0]
+            else:
+                rank_key = 0
+            pruned = NodeEntry(
+                entry.name, entry.node, entry.labels, entry.registry,
+                entry.resident, entry.counted, live, entry.base_free,
+                rank_key, self.generation)
+            self._entries[name] = pruned
+            self._publish_rank_locked(name, pruned)

@@ -23,6 +23,7 @@ NRI_SUPPORT = "NRISupport"              # DRA: runtime-hook injection
 SERIAL_FILTER_NODE = "SerialFilterNode"
 SERIAL_BIND_NODE = "SerialBindNode"
 TRACING = "Tracing"                     # vtrace allocation-path spans
+SCHEDULER_SNAPSHOT = "SchedulerSnapshot"  # watch-driven cluster snapshot
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -41,6 +42,10 @@ _KNOWN = {
     SERIAL_FILTER_NODE: True,
     SERIAL_BIND_NODE: False,
     TRACING: False,
+    # Default off: the TTL-LIST path stays the shipped fallback until the
+    # watch path has soaked; flipping it on swaps the scheduler's cluster
+    # reads onto the incremental snapshot (scheduler/snapshot.py).
+    SCHEDULER_SNAPSHOT: False,
 }
 
 
