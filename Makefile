@@ -69,8 +69,12 @@ test-trace: ## vtrace subsystem alone (recorder, assembly, hermetic e2e)
 test-snapshot: ## Scheduler snapshot alone (fake watch, incremental apply, 410 relist, gate parity)
 	$(PYTEST) tests/test_snapshot.py -q
 
+.PHONY: test-chaos
+test-chaos: ## Seeded chaos suite: failpoints at every site over the e2e path (CHAOS_SEED=n reproduces one seed)
+	$(PYTEST) tests/test_chaos.py tests/test_resilience.py -q
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite
+verify: lint test test-trace test-snapshot test-chaos ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -89,6 +89,7 @@ def main(argv: list[str] | None = None) -> int:
         auth = request.headers.get("Authorization", "")
         return hmac.compare_digest(auth, f"Bearer {read_token()}")
 
+    from vtpu_manager.resilience.policy import render_resilience_metrics
     from vtpu_manager.trace import assemble as trace_assemble
     from vtpu_manager.trace.metrics import render_trace_metrics
     from vtpu_manager.trace.recorder import reap_stale_spools
@@ -103,6 +104,8 @@ def main(argv: list[str] | None = None) -> int:
         # scrape cost) stays bounded across daemon/tenant churn
         reap_stale_spools(args.trace_spool_dir)
         text += render_trace_metrics(args.trace_spool_dir)
+        # vtfault retry/breaker/failpoint counters for this process
+        text += render_resilience_metrics() + "\n"
         return web.Response(text=text, content_type="text/plain")
 
     async def traces(request):

@@ -51,6 +51,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--trace-spool-dir", default=None,
                         help="vtrace span spool directory (default: the "
                              "shared node trace dir)")
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="serve THIS process's resilience counters "
+                             "(reschedule reconcile failures, retry/"
+                             "breaker, failpoint fires) on /metrics; "
+                             "0 disables. The node monitor exports the "
+                             "device/tenant gauges — those live in its "
+                             "process; these live here")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -70,6 +77,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
     from vtpu_manager.util import consts
     from vtpu_manager.util.featuregates import (CLIENT_MODE, CORE_PLUGIN,
+                                                FAULT_INJECTION,
                                                 HONOR_PREALLOC_IDS,
                                                 MEMORY_PLUGIN, RESCHEDULE,
                                                 TC_WATCHER, TPU_TOPOLOGY,
@@ -86,6 +94,13 @@ def main(argv: list[str] | None = None) -> int:
         from vtpu_manager import trace
         trace.configure("plugin", spool_dir=args.trace_spool_dir,
                         sampling_rate=args.trace_sampling_rate)
+    if gates.enabled(FAULT_INJECTION):
+        # chaos/staging only: VTPU_FAILPOINTS arms seeded injections
+        # (vtfault); with the gate off every site is one dict lookup
+        from vtpu_manager.resilience import failpoints
+        failpoints.enable(
+            seed=int(os.environ.get("VTPU_FAILPOINTS_SEED", "0") or 0))
+        failpoints.arm_spec(os.environ.get("VTPU_FAILPOINTS", ""))
 
     if not args.node_name:
         log.error("--node-name or NODE_NAME required")
@@ -246,11 +261,46 @@ def main(argv: list[str] | None = None) -> int:
                 log.warning("unparseable excess table; feed not seeded")
         watcher.start()
 
+    # process-local resilience counters (vtpu_reschedule_reconcile_
+    # failures_total lives HERE — the reschedule controller runs in this
+    # binary, and module counters are per-process)
+    metrics_srv = None
+    if args.metrics_port:
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from vtpu_manager.resilience.policy import render_resilience_metrics
+
+        class _MetricsHandler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = (render_resilience_metrics() + "\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        metrics_srv = ThreadingHTTPServer(("0.0.0.0", args.metrics_port),
+                                          _MetricsHandler)
+        threading.Thread(target=metrics_srv.serve_forever, daemon=True,
+                         name="vtpu-plugin-metrics").start()
+        log.info("resilience metrics on :%d/metrics", args.metrics_port)
+
     controller = None
     if gates.enabled(RESCHEDULE):
         controller = RescheduleController(
             client, args.node_name,
-            known_uuids={c.uuid for c in chips})
+            known_uuids={c.uuid for c in chips},
+            # ClientMode: the reconcile's live-pod set also reaps the
+            # registry's orphan (pod, container) bindings
+            registry=registry_srv)
         controller.start()
 
     stop = []
@@ -263,6 +313,8 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         for server in servers:
             server.stop()
+        if metrics_srv:
+            metrics_srv.shutdown()
         if watcher:
             watcher.stop()
         if registry_srv:

@@ -65,7 +65,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.preempt import PreemptPredicate
     from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
     from vtpu_manager.scheduler.serial import SerialLocker
-    from vtpu_manager.util.featuregates import (SCHEDULER_SNAPSHOT,
+    from vtpu_manager.util.featuregates import (FAULT_INJECTION,
+                                                SCHEDULER_SNAPSHOT,
                                                 SERIAL_BIND_NODE,
                                                 SERIAL_FILTER_NODE,
                                                 TRACING, FeatureGates)
@@ -80,6 +81,13 @@ def main(argv: list[str] | None = None) -> int:
         from vtpu_manager import trace
         trace.configure("scheduler", spool_dir=args.trace_spool_dir,
                         sampling_rate=args.trace_sampling_rate)
+    if gates.enabled(FAULT_INJECTION):
+        # chaos/staging only: VTPU_FAILPOINTS arms seeded injections
+        # (vtfault); with the gate off every site is one dict lookup
+        from vtpu_manager.resilience import failpoints
+        failpoints.enable(
+            seed=int(os.environ.get("VTPU_FAILPOINTS_SEED", "0") or 0))
+        failpoints.arm_spec(os.environ.get("VTPU_FAILPOINTS", ""))
 
     if args.fake_client:
         from vtpu_manager.client.fake import FakeKubeClient

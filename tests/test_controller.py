@@ -62,17 +62,67 @@ class TestReschedule:
                                    checkpoint_path=str(ckpt))
         assert ctl.reconcile_once() == 1
 
+    def _fast_resilience(self):
+        from random import Random
+        from vtpu_manager.resilience.policy import (KubeResilience,
+                                                    RetryPolicy)
+        return KubeResilience(policy=RetryPolicy(
+            max_attempts=3, base_delay_s=0.0, max_delay_s=0.0,
+            rng=Random(1), sleep=lambda s: None))
+
     def test_eviction_falls_back_to_delete(self):
         client = FakeKubeClient()
+        calls = {"n": 0}
 
         def failing_evict(ns, name):
             from vtpu_manager.client.kube import KubeError
+            calls["n"] += 1
             raise KubeError(429, "pdb")
 
         client.evict_pod = failing_evict
         client.add_pod(pod_on_node("bad", annotations={
             consts.allocation_status_annotation():
                 consts.ALLOC_STATUS_FAILED}))
-        ctl = RescheduleController(client, "node-1")
+        ctl = RescheduleController(client, "node-1",
+                                   resilience=self._fast_resilience())
         assert ctl.reconcile_once() == 1
+        # a 429 is retryable: the policy re-tried the eviction before
+        # falling back to delete
+        assert calls["n"] == 3
         assert ("default", "bad") in client.deletions
+
+    def test_terminal_eviction_rejection_deletes_without_retry(self):
+        client = FakeKubeClient()
+        calls = {"n": 0}
+
+        def forbidden_evict(ns, name):
+            from vtpu_manager.client.kube import KubeError
+            calls["n"] += 1
+            raise KubeError(403, "subresource forbidden")
+
+        client.evict_pod = forbidden_evict
+        client.add_pod(pod_on_node("bad", annotations={
+            consts.allocation_status_annotation():
+                consts.ALLOC_STATUS_FAILED}))
+        ctl = RescheduleController(client, "node-1",
+                                   resilience=self._fast_resilience())
+        assert ctl.reconcile_once() == 1
+        assert calls["n"] == 1     # terminal: no retry before fallback
+        assert ("default", "bad") in client.deletions
+
+    def test_event_failure_does_not_block_eviction(self):
+        client = FakeKubeClient()
+
+        def failing_event(ns, event):
+            from vtpu_manager.client.kube import KubeError
+            raise KubeError(500, "events down")
+
+        client.create_event = failing_event
+        client.add_pod(pod_on_node("bad", annotations={
+            consts.allocation_status_annotation():
+                consts.ALLOC_STATUS_FAILED}))
+        ctl = RescheduleController(client, "node-1",
+                                   resilience=self._fast_resilience())
+        assert ctl.reconcile_once() == 1
+        assert ("default", "bad") in client.evictions
+        assert ctl.evicted == [("default", "bad")]

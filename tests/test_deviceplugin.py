@@ -261,6 +261,66 @@ class TestCheckpoint:
     def test_missing_file(self, tmp_path):
         assert read_checkpoint(str(tmp_path / "nope")) == []
 
+    def test_truncated_json_reads_as_empty(self, tmp_path):
+        import json
+        path = str(tmp_path / "kubelet_internal_checkpoint")
+        full = json.dumps({"Data": {"PodDeviceEntries": [{
+            "PodUID": "u1", "ContainerName": "c1",
+            "ResourceName": "google.com/vtpu-number",
+            "DeviceIDs": {"0": ["a::0"]}}]}})
+        # a mid-write crash leaves any prefix; none may crash or
+        # hallucinate entries
+        for cut in (1, len(full) // 3, len(full) - 2):
+            with open(path, "w") as f:
+                f.write(full[:cut])
+            assert read_checkpoint(path) == []
+
+    def test_wrong_typed_device_ids_degrade_per_entry(self, tmp_path):
+        import json
+        from vtpu_manager.deviceplugin.checkpoint import \
+            devices_for_resource
+        path = str(tmp_path / "kubelet_internal_checkpoint")
+        with open(path, "w") as f:
+            json.dump({"Data": {"PodDeviceEntries": [
+                # a bare STRING chunk must not explode into characters
+                {"PodUID": "u1", "ContainerName": "c",
+                 "ResourceName": "google.com/vtpu-number",
+                 "DeviceIDs": {"0": "a::0"}},
+                # numbers / None / nested junk contribute nothing
+                {"PodUID": "u2", "ContainerName": "c",
+                 "ResourceName": "google.com/vtpu-number",
+                 "DeviceIDs": 42},
+                {"PodUID": "u3", "ContainerName": "c",
+                 "ResourceName": "google.com/vtpu-number",
+                 "DeviceIDs": {"0": [7, None, "b::0"]}},
+                # non-dict entry skipped entirely
+                "garbage",
+                # the one healthy entry still parses
+                {"PodUID": "u4", "ContainerName": "c",
+                 "ResourceName": "google.com/vtpu-number",
+                 "DeviceIDs": {"0": ["c::0"]}},
+            ]}}, f)
+        entries = read_checkpoint(path)
+        by_uid = {e.pod_uid: e for e in entries}
+        assert by_uid["u1"].device_ids == ()
+        assert by_uid["u2"].device_ids == ()
+        assert by_uid["u3"].device_ids == ("b::0",)
+        assert by_uid["u4"].device_ids == ("c::0",)
+        held = devices_for_resource("google.com/vtpu-number", path)
+        assert held["u4"] == {"c::0"}
+        # the ghost-device eviction input never contains non-id garbage
+        assert all(isinstance(d, str) and "::" in d
+                   for ids in held.values() for d in ids)
+
+    def test_wrong_typed_top_level_shapes(self, tmp_path):
+        path = str(tmp_path / "kubelet_internal_checkpoint")
+        for doc in ('[]', '"str"', '{"Data": []}', '{"Data": {"PodDevice'
+                    'Entries": {"not": "a list"}}}'):
+            with open(path, "w") as f:
+                f.write(doc)
+            assert read_checkpoint(path) == []
+
+
 class TestHealthReAdvertisement:
     def test_listandwatch_streams_health_flip(self, plugin, tmp_path):
         """Health flip must push a fresh device list to the kubelet

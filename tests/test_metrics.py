@@ -547,3 +547,42 @@ def test_trace_metrics_served_with_spool_drops(tmp_path):
     empty = render_trace_metrics(str(tmp_path / "none"))
     assert "# TYPE vtpu_trace_spool_dropped_total counter" in empty
     assert "vtpu_trace_spool_dropped_total{" not in empty
+
+
+def test_resilience_metrics_block_renders(tmp_path):
+    """Both scrape surfaces (scheduler routes, node monitor) append the
+    vtfault block: retry/terminal/exhausted counters per op, breaker
+    state, the reschedule failure counter, and failpoint fires."""
+    from random import Random
+
+    from vtpu_manager.client.kube import KubeError
+    from vtpu_manager.resilience import failpoints
+    from vtpu_manager.resilience.policy import (CircuitBreaker,
+                                                RetryPolicy,
+                                                render_resilience_metrics)
+
+    policy = RetryPolicy(max_attempts=2, rng=Random(1),
+                         sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise KubeError(503, "x")
+        return "ok"
+
+    policy.run(flaky, op="metrics.block")
+    failpoints.enable(seed=1)
+    failpoints.arm("kube.request", "latency", latency_s=0.0)
+    failpoints.fire("kube.request", op="x")
+    try:
+        text = render_resilience_metrics(
+            breakers=[CircuitBreaker(name="kube")])
+        assert "# TYPE vtpu_resilience_retries_total counter" in text
+        assert 'vtpu_resilience_retries_total{op="metrics.block"}' in text
+        assert "vtpu_reschedule_reconcile_failures_total" in text
+        assert 'vtpu_circuit_state{name="kube"} 0' in text
+        assert ('vtpu_failpoint_fires_total{site="kube.request"} 1'
+                in text)
+    finally:
+        failpoints.disable()

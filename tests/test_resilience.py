@@ -1,0 +1,632 @@
+"""vtfault unit tests: RetryPolicy / CircuitBreaker semantics, the
+failpoint registry (determinism, actions, fast path, env spec), the
+bind-intent crash trail, and the rewired consumers (reschedule backoff,
+snapshot reconnect counter, registry orphan reap)."""
+
+from __future__ import annotations
+
+import threading
+from random import Random
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.client.kube import KubeError
+from vtpu_manager.controller.reschedule import RescheduleController
+from vtpu_manager.resilience import failpoints, recovery
+from vtpu_manager.resilience.policy import (COUNTERS, CircuitBreaker,
+                                            CircuitOpenError,
+                                            KubeResilience, RetryPolicy,
+                                            is_retryable,
+                                            render_resilience_metrics)
+from vtpu_manager.util import consts
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disable()
+    yield
+    failpoints.disable()
+
+
+def make_policy(**kw):
+    sleeps: list[float] = []
+    kw.setdefault("rng", Random(7))
+    kw.setdefault("sleep", sleeps.append)
+    policy = RetryPolicy(**kw)
+    return policy, sleeps
+
+
+class TestRetryPolicy:
+    def test_retries_transient_then_succeeds(self):
+        policy, sleeps = make_policy(max_attempts=5)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise KubeError(503, "throttle")
+            return "ok"
+
+        assert policy.run(flaky, op="t.flaky") == "ok"
+        assert calls["n"] == 3
+        assert len(sleeps) == 2
+
+    def test_terminal_statuses_never_retry(self):
+        for status in (403, 404, 409, 410, 422):
+            policy, sleeps = make_policy()
+            with pytest.raises(KubeError):
+                policy.run(lambda s=status: (_ for _ in ()).throw(
+                    KubeError(s, "nope")), op="t.term")
+            assert sleeps == []
+
+    def test_retryable_classification(self):
+        for status in (0, 408, 429, 500, 502, 503, 504):
+            assert is_retryable(KubeError(status, "x"))
+        assert is_retryable(ConnectionError())
+        assert not is_retryable(ValueError())
+
+    def test_attempts_exhausted_reraises_last(self):
+        policy, sleeps = make_policy(max_attempts=3)
+        with pytest.raises(KubeError) as exc:
+            policy.run(lambda: (_ for _ in ()).throw(
+                KubeError(503, "still down")), op="t.exh")
+        assert exc.value.status == 503
+        assert len(sleeps) == 2      # n-1 sleeps for n attempts
+
+    def test_backoff_grows_exponentially_with_jitter_and_cap(self):
+        policy = RetryPolicy(base_delay_s=0.1, max_delay_s=0.4,
+                             rng=Random(3))
+        d1 = [policy.backoff_s(1) for _ in range(50)]
+        d4 = [policy.backoff_s(4) for _ in range(50)]
+        assert all(0.05 <= d <= 0.1 for d in d1)     # full jitter in [c/2, c]
+        assert all(0.2 <= d <= 0.4 for d in d4)      # capped at max_delay
+        # deterministic under the same seed
+        a = RetryPolicy(base_delay_s=0.1, rng=Random(9)).backoff_s(2)
+        b = RetryPolicy(base_delay_s=0.1, rng=Random(9)).backoff_s(2)
+        assert a == b
+
+    def test_retry_after_floors_the_delay(self):
+        policy, sleeps = make_policy(max_attempts=2, base_delay_s=0.01,
+                                     deadline_s=60.0)
+        calls = {"n": 0}
+
+        def throttled():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise KubeError(429, "slow down", retry_after=1.5)
+            return "ok"
+
+        assert policy.run(throttled, op="t.ra") == "ok"
+        assert sleeps and sleeps[0] >= 1.5
+
+    def test_deadline_budget_stops_retrying(self):
+        clock = {"t": 0.0}
+        sleeps: list[float] = []
+
+        def sleep(s):
+            sleeps.append(s)
+            clock["t"] += s
+
+        policy = RetryPolicy(max_attempts=100, base_delay_s=1.0,
+                             max_delay_s=1.0, deadline_s=2.5,
+                             rng=Random(1), sleep=sleep,
+                             clock=lambda: clock["t"])
+        with pytest.raises(KubeError):
+            policy.run(lambda: (_ for _ in ()).throw(
+                KubeError(503, "down")), op="t.deadline")
+        # the loop stopped because budget + next delay > deadline, far
+        # below the 100-attempt ceiling
+        assert len(sleeps) < 6
+
+    def test_counters_flow_to_metrics_render(self):
+        policy, _ = make_policy(max_attempts=3)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise KubeError(503, "x")
+            return "ok"
+
+        policy.run(flaky, op="metrics.demo")
+        assert COUNTERS.data[("metrics.demo", "retries")] >= 1
+        text = render_resilience_metrics()
+        assert 'vtpu_resilience_retries_total{op="metrics.demo"}' in text
+        assert "vtpu_reschedule_reconcile_failures_total" in text
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clock = {"t": 0.0}
+        kw.setdefault("clock", lambda: clock["t"])
+        return CircuitBreaker(**kw), clock
+
+    def test_opens_after_threshold_and_rejects(self):
+        br, _ = self.make(failure_threshold=3, reset_timeout_s=10)
+        for _ in range(3):
+            assert br.allow()
+            br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br, _ = self.make(failure_threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_half_open_probe_closes_on_success(self):
+        br, clock = self.make(failure_threshold=1, reset_timeout_s=5)
+        br.record_failure()
+        assert not br.allow()
+        clock["t"] = 6.0
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert br.allow()          # the single probe
+        assert not br.allow()      # everyone else still rejected
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        br, clock = self.make(failure_threshold=1, reset_timeout_s=5)
+        br.record_failure()
+        clock["t"] = 6.0
+        assert br.allow()
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()
+
+    def test_kube_resilience_counts_loop_as_one_failure(self):
+        br, _ = self.make(failure_threshold=2)
+        policy, _ = make_policy(max_attempts=3)
+        res = KubeResilience(policy=policy, breaker=br)
+        for _ in range(2):
+            with pytest.raises(KubeError):
+                res.call(lambda: (_ for _ in ()).throw(
+                    KubeError(503, "down")), op="t.breaker")
+        with pytest.raises(CircuitOpenError):
+            res.call(lambda: "never runs", op="t.breaker")
+
+
+class TestFailpoints:
+    def test_disabled_fast_path_is_one_dict_lookup(self):
+        class CountingDict(dict):
+            gets = 0
+
+            def get(self, key, default=None):
+                CountingDict.gets += 1
+                return super().get(key, default)
+
+        original = failpoints._ARMED
+        failpoints._ARMED = CountingDict()
+        try:
+            for _ in range(100):
+                assert failpoints.fire("kube.request", op="x") is None
+            assert CountingDict.gets == 100
+        finally:
+            failpoints._ARMED = original
+        assert failpoints.stats()["total"] == 0
+        assert failpoints.stats()["evaluations"] == 0
+
+    def test_arm_requires_enable(self):
+        with pytest.raises(RuntimeError):
+            failpoints.arm("kube.request", "error")
+
+    def test_unknown_site_and_action_rejected(self):
+        failpoints.enable(seed=1)
+        with pytest.raises(KeyError):
+            failpoints.arm("no.such.site", "error")
+        with pytest.raises(ValueError):
+            failpoints.arm("kube.request", "explode")
+
+    def test_error_action_raises_kube_error_with_status(self):
+        failpoints.enable(seed=1)
+        failpoints.arm("kube.request", "error", status=429)
+        with pytest.raises(KubeError) as exc:
+            failpoints.fire("kube.request", op="x")
+        assert exc.value.status == 429
+        assert failpoints.stats()["fires"]["kube.request"] == 1
+
+    def test_error_action_custom_exception(self):
+        from vtpu_manager.util.flock import LockTimeout
+        failpoints.enable(seed=1)
+        failpoints.arm("flock.acquire", "error", exc=LockTimeout)
+        with pytest.raises(LockTimeout):
+            failpoints.fire("flock.acquire", path="/x")
+
+    def test_crash_action_is_base_exception(self):
+        failpoints.enable(seed=1)
+        failpoints.arm("plugin.allocate", "crash")
+        try:
+            failpoints.fire("plugin.allocate", pod_uid="u")
+        except Exception:  # noqa: BLE001 — the point under test
+            pytest.fail("CrashFailpoint must not be catchable as "
+                        "Exception (recovery code would survive a "
+                        "'crash')")
+        except BaseException as e:
+            assert isinstance(e, failpoints.CrashFailpoint)
+
+    def test_count_bounds_total_fires(self):
+        failpoints.enable(seed=1)
+        failpoints.arm("kube.request", "error", count=2)
+        for _ in range(2):
+            with pytest.raises(KubeError):
+                failpoints.fire("kube.request", op="x")
+        failpoints.fire("kube.request", op="x")   # exhausted: no raise
+        assert failpoints.stats()["fires"]["kube.request"] == 2
+
+    def test_probability_is_seeded_and_deterministic(self):
+        def run(seed):
+            failpoints.disable()
+            failpoints.enable(seed=seed)
+            failpoints.arm("kube.request", "error", p=0.5)
+            fired = []
+            for i in range(40):
+                try:
+                    failpoints.fire("kube.request", op="x")
+                    fired.append(False)
+                except KubeError:
+                    fired.append(True)
+            return fired
+
+        a, b, c = run(11), run(11), run(12)
+        assert a == b
+        assert a != c
+        assert any(a) and not all(a)
+
+    def test_match_targets_one_op(self):
+        failpoints.enable(seed=1)
+        failpoints.arm("kube.request", "error",
+                       match={"op": "bind_pod"})
+        failpoints.fire("kube.request", op="list_pods")   # no-op
+        with pytest.raises(KubeError):
+            failpoints.fire("kube.request", op="bind_pod")
+
+    def test_latency_action_sleeps_and_returns(self):
+        failpoints.enable(seed=1)
+        failpoints.arm("flock.acquire", "latency", latency_s=0.0)
+        assert failpoints.fire("flock.acquire", path="/x") is None
+        assert failpoints.stats()["fires"]["flock.acquire"] == 1
+
+    def test_partial_write_truncates_then_crashes(self, tmp_path):
+        victim = tmp_path / "vtpu.config"
+        victim.write_bytes(b"A" * 1000)
+        failpoints.enable(seed=5)
+        failpoints.arm("plugin.config_write", "partial-write")
+        with pytest.raises(failpoints.CrashFailpoint):
+            failpoints.fire("plugin.config_write", path=str(victim))
+        torn = victim.read_bytes()
+        assert 0 < len(torn) < 1000
+
+    def test_arm_spec_grammar(self):
+        failpoints.enable(seed=1)
+        failpoints.arm_spec("kube.request=error(429,p=0.5,count=3);"
+                            "flock.acquire=latency(0.002);"
+                            "plugin.allocate=crash(p=0.25)")
+        assert set(failpoints.armed_sites()) == {
+            "kube.request", "flock.acquire", "plugin.allocate"}
+        spec = failpoints._ARMED["kube.request"]
+        assert (spec.status, spec.p, spec.count) == (429, 0.5, 3)
+        assert failpoints._ARMED["flock.acquire"].latency_s == 0.002
+        with pytest.raises(ValueError):
+            failpoints.arm_spec("kube.request=error(503,bogus=1)")
+
+    def test_fires_recorded_as_vtrace_events(self, tmp_path):
+        from vtpu_manager import trace
+        trace.configure("chaos", str(tmp_path), sampling_rate=1.0)
+        try:
+            failpoints.enable(seed=1)
+            failpoints.arm("plugin.allocate", "latency", latency_s=0.0)
+            failpoints.fire("plugin.allocate", pod_uid="pod-uid-1")
+            trace.flush()
+            from vtpu_manager.trace import assemble
+            spans, _ = assemble.read_spools(str(tmp_path))
+            stages = [s.stage for s in spans]
+            assert "failpoint.plugin.allocate" in stages
+        finally:
+            trace.reset()
+
+    def test_render_failpoint_metrics(self):
+        failpoints.enable(seed=1)
+        failpoints.arm("snapshot.apply", "latency", latency_s=0.0)
+        failpoints.fire("snapshot.apply", kind="pods")
+        text = failpoints.render_failpoint_metrics()
+        assert 'vtpu_failpoint_fires_total{site="snapshot.apply"} 1' in text
+
+
+class TestBindIntent:
+    def test_round_trip(self):
+        raw = recovery.encode_bind_intent("node-1", ts=123.5)
+        assert recovery.parse_bind_intent(raw) == ("node-1", 123.5)
+
+    def test_malformed_reads_as_absent(self):
+        for bad in (None, "", "node-1", "@", "node@notatime", "@5.0"):
+            assert recovery.parse_bind_intent(bad) is None
+        assert not recovery.intent_expired(
+            {consts.bind_intent_annotation(): "garbage"}, now=1e9, ttl_s=0)
+
+    def test_expiry(self):
+        anns = {consts.bind_intent_annotation():
+                recovery.encode_bind_intent("n", ts=100.0)}
+        assert not recovery.intent_expired(anns, now=100.5, ttl_s=1.0)
+        assert recovery.intent_expired(anns, now=102.0, ttl_s=1.0)
+
+    def test_bind_stamps_intent_before_binding(self):
+        from vtpu_manager.scheduler.bind import BindPredicate
+        client = FakeKubeClient()
+        client.add_pod({
+            "metadata": {"name": "p", "namespace": "default", "uid": "u1",
+                         "annotations": {
+                             consts.predicate_node_annotation(): "node-1"}},
+            "spec": {}, "status": {"phase": "Pending"}})
+        result = BindPredicate(client).bind(
+            {"PodNamespace": "default", "PodName": "p", "Node": "node-1"})
+        assert not result.error
+        anns = client.get_pod("default", "p")["metadata"]["annotations"]
+        parsed = recovery.parse_bind_intent(
+            anns[consts.bind_intent_annotation()])
+        assert parsed is not None and parsed[0] == "node-1"
+
+    def test_crash_between_patch_and_binding_leaves_intent(self):
+        from vtpu_manager.scheduler.bind import BindPredicate
+        client = FakeKubeClient()
+        client.add_pod({
+            "metadata": {"name": "p", "namespace": "default", "uid": "u1",
+                         "annotations": {
+                             consts.predicate_node_annotation(): "node-1"}},
+            "spec": {}, "status": {"phase": "Pending"}})
+        failpoints.enable(seed=1)
+        failpoints.arm("scheduler.bind_patch", "crash")
+        with pytest.raises(failpoints.CrashFailpoint):
+            BindPredicate(client).bind({"PodNamespace": "default",
+                                        "PodName": "p", "Node": "node-1"})
+        pod = client.get_pod("default", "p")
+        anns = pod["metadata"]["annotations"]
+        # the crash window left the reapable trail: intent + allocating,
+        # but no binding
+        assert recovery.parse_bind_intent(
+            anns[consts.bind_intent_annotation()]) is not None
+        assert anns[consts.allocation_status_annotation()] == \
+            consts.ALLOC_STATUS_ALLOCATING
+        assert not (pod.get("spec") or {}).get("nodeName")
+        assert client.bindings == []
+
+
+def committed_pod(name="stuck", uid=None, node="node-1", intent_ts=0.0,
+                  bound=False, status=consts.ALLOC_STATUS_ALLOCATING):
+    anns = {
+        consts.pre_allocated_annotation(): "{}",
+        consts.predicate_node_annotation(): node,
+        consts.predicate_time_annotation(): str(intent_ts),
+        consts.bind_intent_annotation():
+            recovery.encode_bind_intent(node, ts=intent_ts),
+    }
+    if status:
+        anns[consts.allocation_status_annotation()] = status
+    return {"metadata": {"name": name, "namespace": "default",
+                         "uid": uid or f"uid-{name}", "annotations": anns},
+            "spec": ({"nodeName": node} if bound else {}),
+            "status": {"phase": "Pending"}}
+
+
+class TestCrashWindowRecovery:
+    def test_expired_unbound_commitment_cleared(self):
+        client = FakeKubeClient()
+        client.add_pod(committed_pod(intent_ts=0.0))
+        ctl = RescheduleController(client, "node-1", intent_ttl_s=1.0)
+        ctl.reconcile_once()
+        assert ("default", "stuck") in ctl.requeued
+        anns = client.get_pod("default",
+                              "stuck")["metadata"]["annotations"]
+        for key in (consts.pre_allocated_annotation(),
+                    consts.predicate_node_annotation(),
+                    consts.bind_intent_annotation(),
+                    consts.allocation_status_annotation()):
+            assert key not in anns
+        assert ("default", "stuck") not in client.evictions
+
+    def test_fresh_commitment_left_alone(self):
+        import time as _time
+        client = FakeKubeClient()
+        client.add_pod(committed_pod(intent_ts=_time.time()))
+        ctl = RescheduleController(client, "node-1", intent_ttl_s=3600.0)
+        ctl.reconcile_once()
+        assert ctl.requeued == []
+        anns = client.get_pod("default",
+                              "stuck")["metadata"]["annotations"]
+        assert consts.bind_intent_annotation() in anns
+
+    def test_other_nodes_commitments_ignored(self):
+        client = FakeKubeClient()
+        client.add_pod(committed_pod(node="node-2", intent_ts=0.0))
+        ctl = RescheduleController(client, "node-1", intent_ttl_s=1.0)
+        ctl.reconcile_once()
+        assert ctl.requeued == []
+
+    def test_allocating_stuck_bound_pod_evicted(self):
+        client = FakeKubeClient()
+        client.add_pod(committed_pod(bound=True, intent_ts=0.0))
+        ctl = RescheduleController(client, "node-1", intent_ttl_s=1.0)
+        assert ctl.reconcile_once() == 1
+        assert ("default", "stuck") in client.evictions
+
+    def test_allocated_pod_not_reaped(self):
+        client = FakeKubeClient()
+        pod = committed_pod(bound=True, intent_ts=0.0,
+                            status=consts.ALLOC_STATUS_SUCCEED)
+        pod["metadata"]["annotations"][
+            consts.real_allocated_annotation()] = "{}"
+        client.add_pod(pod)
+        ctl = RescheduleController(client, "node-1", intent_ttl_s=1.0)
+        assert ctl.reconcile_once() == 0
+        assert client.evictions == []
+
+
+class TestRescheduleResilience:
+    def test_list_failure_counts_and_backs_off(self):
+        client = FakeKubeClient()
+        calls = {"n": 0}
+
+        def failing_list(*a, **k):
+            calls["n"] += 1
+            raise KubeError(503, "down")
+
+        client.list_pods = failing_list
+        policy, _ = make_policy(max_attempts=2)
+        ctl = RescheduleController(
+            client, "node-1",
+            resilience=KubeResilience(policy=policy,
+                                      breaker=CircuitBreaker(
+                                          failure_threshold=100)))
+        base = ctl.current_interval_s()
+        assert ctl.reconcile_once() == 0
+        assert ctl.consecutive_failures == 1
+        assert ctl.reconcile_failures_total == 1
+        assert calls["n"] == 2     # the policy retried inside one call
+        assert ctl.current_interval_s() == base * 2
+        for _ in range(10):
+            ctl.reconcile_once()
+        assert ctl.current_interval_s() == base * 32   # capped doubling
+        text = render_resilience_metrics()
+        assert "vtpu_reschedule_reconcile_failures_total" in text
+
+    def test_recovery_resets_backoff(self):
+        client = FakeKubeClient()
+        ctl = RescheduleController(client, "node-1")
+        ctl.consecutive_failures = 4
+        ctl.reconcile_once()
+        assert ctl.consecutive_failures == 0
+        assert ctl.current_interval_s() == ctl.interval_s
+
+    def test_breaker_rejection_counts_as_failure(self):
+        client = FakeKubeClient()
+        breaker = CircuitBreaker(failure_threshold=1,
+                                 reset_timeout_s=3600.0)
+        breaker.record_failure()   # force open
+        ctl = RescheduleController(
+            client, "node-1",
+            resilience=KubeResilience(breaker=breaker))
+        assert ctl.reconcile_once() == 0
+        assert ctl.consecutive_failures == 1
+
+    def test_both_evict_and_delete_failing_is_not_recorded(self):
+        client = FakeKubeClient()
+        client.add_pod({
+            "metadata": {"name": "bad", "namespace": "default",
+                         "uid": "uid-bad", "annotations": {
+                             consts.allocation_status_annotation():
+                                 consts.ALLOC_STATUS_FAILED}},
+            "spec": {"nodeName": "node-1"},
+            "status": {"phase": "Running"}})
+
+        def nope(*a, **k):
+            raise KubeError(500, "api down")
+
+        client.evict_pod = nope
+        client.delete_pod = nope
+        policy, _ = make_policy(max_attempts=2)
+        ctl = RescheduleController(
+            client, "node-1",
+            resilience=KubeResilience(policy=policy))
+        ctl.reconcile_once()
+        assert ctl.evicted == []
+        # the pod is still there for the next pass to retry
+        assert client.get_pod("default", "bad")
+
+    def test_registry_orphans_reaped(self):
+        from vtpu_manager.registry.server import RegistryServer
+        client = FakeKubeClient()
+        client.add_pod({
+            "metadata": {"name": "alive", "namespace": "default",
+                         "uid": "uid-alive", "annotations": {}},
+            "spec": {"nodeName": "node-1"},
+            "status": {"phase": "Running"}})
+        server = RegistryServer.__new__(RegistryServer)
+        server._bind = {("uid-alive", "c"): "/cg/a",
+                        ("uid-gone", "c"): "/cg/b"}
+        server._bind_lock = threading.Lock()
+        server._orphan_suspects = set()
+        ctl = RescheduleController(client, "node-1", registry=server)
+        # two-strike: the first pass only suspects, the second reaps (a
+        # pod registered mid-pass must not be reaped off a stale list)
+        ctl.reconcile_once()
+        assert set(server._bind) == {("uid-alive", "c"),
+                                     ("uid-gone", "c")}
+        ctl.reconcile_once()
+        assert set(server._bind) == {("uid-alive", "c")}
+
+    def test_orphan_suspect_vindicated_by_next_pass(self):
+        from vtpu_manager.registry.server import RegistryServer
+        server = RegistryServer.__new__(RegistryServer)
+        server._bind = {("uid-late", "c"): "/cg/a"}
+        server._bind_lock = threading.Lock()
+        server._orphan_suspects = set()
+        # pass 1: the pod's registration raced the list snapshot
+        server.reap_orphans(set())
+        assert ("uid-late", "c") in server._bind
+        # pass 2: the fresher list knows the pod — suspect cleared
+        server.reap_orphans({"uid-late"})
+        assert ("uid-late", "c") in server._bind
+        assert server._orphan_suspects == set()
+        # and it does not get reaped by a later dead-once sighting alone
+        server.reap_orphans(set())
+        assert ("uid-late", "c") in server._bind
+
+
+class TestSnapshotResilience:
+    def test_background_loop_counts_reconnects_and_recovers(self):
+        from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+        client = FakeKubeClient()
+        client.add_node({"metadata": {"name": "n1", "annotations": {}}})
+        snap = ClusterSnapshot(
+            client,
+            retry_policy=RetryPolicy(base_delay_s=0.001, max_delay_s=0.002,
+                                     rng=Random(1)))
+        snap.start()
+        real_watch = client.watch_pods
+        boom = {"on": True}
+
+        def flaky_watch(rv, timeout_s=30.0):
+            if boom["on"]:
+                raise KubeError(503, "watch down")
+            return real_watch(rv, timeout_s)
+
+        client.watch_pods = flaky_watch
+        snap.start_background(poll_s=0.001)
+        try:
+            deadline = 200
+            while snap.stats.reconnects < 2 and deadline:
+                deadline -= 1
+                import time as _time
+                _time.sleep(0.005)
+            assert snap.stats.reconnects >= 2
+            boom["on"] = False
+            client.add_pod({"metadata": {"name": "p", "namespace": "d",
+                                         "uid": "u"},
+                            "spec": {}, "status": {}})
+            deadline = 200
+            while "u" not in snap._pods and deadline:
+                deadline -= 1
+                import time as _time
+                _time.sleep(0.005)
+            assert "u" in snap._pods   # the loop recovered and applied
+        finally:
+            snap.stop_background()
+
+    def test_apply_failpoint_410_forces_relist(self):
+        from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+        client = FakeKubeClient()
+        client.add_node({"metadata": {"name": "n1", "annotations": {}}})
+        snap = ClusterSnapshot(client)
+        snap.start()
+        relists_before = snap.stats.relists
+        failpoints.enable(seed=1)
+        failpoints.arm("snapshot.apply", "error", status=410, count=1)
+        client.add_pod({"metadata": {"name": "p", "namespace": "d",
+                                     "uid": "u"},
+                        "spec": {}, "status": {}})
+        snap.pump()
+        assert snap.stats.relists == relists_before + 1
+        assert "u" in snap._pods   # the relist rebuilt full state

@@ -601,6 +601,122 @@ class TestExceptionHygiene:
 
 
 # ---------------------------------------------------------------------------
+# retry-hygiene
+
+
+class TestRetryHygiene:
+    SELECT = {"retry-hygiene"}
+
+    def test_naked_pass_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"controller/mod.py": """
+            from vtpu_manager.client.kube import KubeError
+
+            def f(client):
+                try:
+                    client.list_pods()
+                except KubeError:
+                    pass
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"retry-hygiene"}
+        assert "RetryPolicy" in findings[0].message
+
+    def test_naked_constant_return_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"scheduler/mod.py": """
+            from vtpu_manager.client.kube import KubeError
+
+            def f(client):
+                try:
+                    return client.list_pods()
+                except KubeError:
+                    return 0
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"retry-hygiene"}
+
+    def test_naked_continue_in_tuple_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            from vtpu_manager.client.kube import KubeError
+
+            def f(client, names):
+                for name in names:
+                    try:
+                        client.get_node(name)
+                    except (ValueError, KubeError):
+                        continue
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"retry-hygiene"}
+
+    def test_logging_handler_passes(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            import logging
+            from vtpu_manager.client.kube import KubeError
+
+            log = logging.getLogger(__name__)
+
+            def f(client):
+                try:
+                    client.list_pods()
+                except KubeError as e:
+                    log.warning("list failed: %s", e)
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_status_classification_passes(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            from vtpu_manager.client.kube import KubeError
+
+            def f(client):
+                try:
+                    client.get_pod("ns", "p")
+                except KubeError as e:
+                    if e.status != 404:
+                        raise
+                    return None
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_computed_fallback_return_passes(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            from vtpu_manager.client.kube import KubeError
+
+            def f(client):
+                try:
+                    return client.list_pods()
+                except KubeError:
+                    return rebuild_from_cache()
+
+            def rebuild_from_cache():
+                return []
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_resilience_package_exempt(self, tmp_path):
+        findings = lint(tmp_path, {"resilience/policy_like.py": """
+            from vtpu_manager.client.kube import KubeError
+
+            def probe(fn):
+                try:
+                    fn()
+                except KubeError:
+                    return False
+                return True
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_suppression_honored(self, tmp_path):
+        findings = lint(tmp_path, {"mod.py": """
+            from vtpu_manager.client.kube import KubeError
+
+            def f(client):
+                try:
+                    client.list_pods()
+                # vtlint: disable=retry-hygiene — fixture
+                except KubeError:
+                    pass
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # CLI + meta
 
 
@@ -648,7 +764,8 @@ class TestCli:
         proc = self._run("--list-rules")
         assert proc.returncode == 0
         for rule in ("lock-discipline", "seqlock-protocol", "abi-drift",
-                     "featuregate-hygiene", "exception-hygiene"):
+                     "featuregate-hygiene", "exception-hygiene",
+                     "retry-hygiene"):
             assert rule in proc.stdout
 
     def test_live_tree_clean_via_cli(self):

@@ -9,6 +9,7 @@ from vtpu_manager.analysis.rules.exception_hygiene import \
 from vtpu_manager.analysis.rules.featuregate_hygiene import \
     FeaturegateHygieneRule
 from vtpu_manager.analysis.rules.lock_discipline import LockDisciplineRule
+from vtpu_manager.analysis.rules.retry_hygiene import RetryHygieneRule
 from vtpu_manager.analysis.rules.seqlock_protocol import SeqlockProtocolRule
 
 
@@ -20,4 +21,5 @@ def all_rules(abi_golden: str | None = None) -> list[Rule]:
         AbiDriftRule(golden_path=abi_golden),
         FeaturegateHygieneRule(),
         ExceptionHygieneRule(),
+        RetryHygieneRule(),
     ]

@@ -1,0 +1,102 @@
+"""retry-hygiene: no naked KubeError swallowing outside resilience/.
+
+Before the vtfault layer, control-plane code grew ``except KubeError:
+pass`` / ``return 0`` sites one incident at a time — the reschedule
+controller reported zero evictions on a throttled list, the plugin
+silently served an empty pending set. Those handlers hide BOTH failure
+classes the resilience layer distinguishes: a transient 429/5xx that
+RetryPolicy would have absorbed, and a terminal error that must be
+visible.
+
+The rule flags any handler that catches ``KubeError`` whose body is
+nothing but ``pass`` / ``return`` / ``continue`` / ``break`` (constants
+allowed in the return) — no raise, no logging, no inspection of the
+exception. Handlers that log, re-raise, or branch on ``e.status`` are
+deliberate classification and pass. ``vtpu_manager/resilience/`` is
+exempt: it is the one place allowed to reason about raw KubeErrors,
+because routing through it IS the fix.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule
+
+RULE = "retry-hygiene"
+
+EXEMPT_DIRS = ("resilience",)
+
+_TRIVIAL = (ast.Pass, ast.Continue, ast.Break)
+
+
+def _exempt(path: str) -> bool:
+    return any(part in EXEMPT_DIRS for part in Path(path).parts)
+
+
+def _catches_kube_error(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False
+    names = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+    for name in names:
+        if isinstance(name, ast.Name) and name.id == "KubeError":
+            return True
+        if isinstance(name, ast.Attribute) and name.attr == "KubeError":
+            return True
+    return False
+
+
+def _is_naked(handler: ast.ExceptHandler) -> bool:
+    """True when the body only discards control flow: every statement is
+    pass/continue/break or a constant-ish return — nothing raises, logs,
+    calls, or reads the exception."""
+    for stmt in handler.body:
+        if isinstance(stmt, _TRIVIAL):
+            continue
+        if isinstance(stmt, ast.Return):
+            # a return that COMPUTES (calls, comprehensions) is doing
+            # real fallback work; returning a literal/name is a swallow
+            if stmt.value is None or isinstance(
+                    stmt.value, (ast.Constant, ast.Name, ast.Attribute,
+                                 ast.List, ast.Dict, ast.Tuple)):
+                # containers must be empty-literal-shaped to count as
+                # trivial (a populated literal is still a swallow, but
+                # keep the rule conservative: any nested Call rescues)
+                if any(isinstance(sub, ast.Call)
+                       for sub in ast.walk(stmt)):
+                    return False
+                continue
+            return False
+        return False
+    return True
+
+
+class RetryHygieneRule(Rule):
+    name = RULE
+    description = ("'except KubeError: pass/return' outside resilience/ "
+                   "hides both retryable and terminal failures — route "
+                   "the call through resilience.policy (RetryPolicy/"
+                   "CircuitBreaker), or log/classify in the handler")
+
+    def check_module(self, module: Module,
+                     project: Project) -> Iterable[Finding]:
+        if _exempt(module.path):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _catches_kube_error(node):
+                continue
+            if _is_naked(node):
+                out.append(Finding(
+                    RULE, module.path, node.lineno,
+                    "naked 'except KubeError' swallows the failure — "
+                    "route the call through vtpu_manager.resilience."
+                    "policy.RetryPolicy (transients get jittered "
+                    "backoff, terminal errors surface), or log/"
+                    "classify here"))
+        return out

@@ -23,6 +23,7 @@ import threading
 from collections import deque
 
 from vtpu_manager.client.kube import KubeError
+from vtpu_manager.resilience import failpoints
 
 # Events retained per kind before the oldest are compacted away (a watcher
 # further behind than this gets 410 Gone and must relist). Big enough that
@@ -90,6 +91,7 @@ class FakeKubeClient:
 
     def _watch(self, kind: str, resource_version: str,
                timeout_s: float) -> list[dict]:
+        failpoints.fire("kube.watch", op=kind)
         try:
             after = int(resource_version or 0)
         except ValueError as e:
@@ -119,12 +121,14 @@ class FakeKubeClient:
         return self._watch("nodes", resource_version, timeout_s)
 
     def list_pods_with_version(self) -> tuple[list[dict], str]:
+        failpoints.fire("kube.request", op="list_pods_with_version")
         with self._lock:
             items = [copy.deepcopy(p) if self.copy_on_read else p
                      for p in self.pods.values()]
             return items, str(self._rv)
 
     def list_nodes_with_version(self) -> tuple[list[dict], str]:
+        failpoints.fire("kube.request", op="list_nodes_with_version")
         with self._lock:
             items = [copy.deepcopy(n) if self.copy_on_read else n
                      for n in self.nodes.values()]
@@ -160,16 +164,19 @@ class FakeKubeClient:
     # -- KubeClient protocol ------------------------------------------------
 
     def list_nodes(self) -> list[dict]:
+        failpoints.fire("kube.request", op="list_nodes")
         with self._lock:
             return [copy.deepcopy(n) for n in self.nodes.values()]
 
     def get_node(self, name: str) -> dict:
+        failpoints.fire("kube.request", op="get_node")
         with self._lock:
             if name not in self.nodes:
                 raise KubeError(404, f"node {name} not found")
             return copy.deepcopy(self.nodes[name])
 
     def patch_node_annotations(self, name: str, annotations: dict) -> dict:
+        failpoints.fire("kube.request", op="patch_node_annotations")
         with self._lock:
             node = self.nodes.get(name)
             if node is None:
@@ -195,6 +202,7 @@ class FakeKubeClient:
                 f"FakeKubeClient.list_pods: unsupported field_selector "
                 f"{field_selector!r} (known: 'spec.nodeName!=')")
         scheduled_only = field_selector == "spec.nodeName!="
+        failpoints.fire("kube.request", op="list_pods")
         with self._lock:
             source = self._scheduled if scheduled_only else self.pods
             out = []
@@ -208,6 +216,7 @@ class FakeKubeClient:
             return out
 
     def get_pod(self, namespace: str, name: str) -> dict:
+        failpoints.fire("kube.request", op="get_pod")
         with self._lock:
             pod = self.pods.get((namespace, name))
             if pod is None:
@@ -216,6 +225,7 @@ class FakeKubeClient:
 
     def patch_pod_annotations(self, namespace: str, name: str,
                               annotations: dict) -> dict:
+        failpoints.fire("kube.request", op="patch_pod_annotations")
         with self._lock:
             pod = self.pods.get((namespace, name))
             if pod is None:
@@ -235,6 +245,7 @@ class FakeKubeClient:
             return copy.deepcopy(pod)
 
     def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        failpoints.fire("kube.request", op="bind_pod")
         with self._lock:
             pod = self.pods.get((namespace, name))
             if pod is None:
@@ -246,6 +257,7 @@ class FakeKubeClient:
 
     def delete_pod(self, namespace: str, name: str,
                    grace_seconds=None) -> None:
+        failpoints.fire("kube.request", op="delete_pod")
         with self._lock:
             if (namespace, name) not in self.pods:
                 raise KubeError(404, f"pod {namespace}/{name} not found")
@@ -255,6 +267,7 @@ class FakeKubeClient:
             self._record_event("pods", "DELETED", gone)
 
     def evict_pod(self, namespace: str, name: str) -> None:
+        failpoints.fire("kube.request", op="evict_pod")
         with self._lock:
             if (namespace, name) not in self.pods:
                 raise KubeError(404, f"pod {namespace}/{name} not found")
@@ -264,6 +277,7 @@ class FakeKubeClient:
             self._record_event("pods", "DELETED", gone)
 
     def create_event(self, namespace: str, event: dict) -> None:
+        failpoints.fire("kube.request", op="create_event")
         with self._lock:
             self.events.append(copy.deepcopy(event))
 

@@ -24,13 +24,31 @@ import urllib.error
 import urllib.request
 from typing import Iterable, Protocol
 
+from vtpu_manager.resilience import failpoints
+
 log = logging.getLogger(__name__)
 
 
 class KubeError(RuntimeError):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after: float | None = None):
         super().__init__(f"kube api {status}: {message}")
         self.status = status
+        # apiserver pacing hint (Retry-After header on 429/5xx): the
+        # resilience RetryPolicy floors its backoff at this
+        self.retry_after = retry_after
+
+
+def _retry_after_s(headers) -> float | None:
+    """Seconds from a Retry-After header; None when absent/unparseable
+    (HTTP-date form is ignored — the apiserver sends delta-seconds)."""
+    raw = headers.get("Retry-After") if headers is not None else None
+    if not raw:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return None
 
 
 class KubeClient(Protocol):
@@ -78,6 +96,7 @@ class InClusterClient:
 
     def _request(self, method: str, path: str, body: dict | None = None,
                  content_type: str = "application/json") -> dict:
+        failpoints.fire("kube.request", method=method, path=path)
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(self.base + path, data=data,
                                      method=method)
@@ -91,7 +110,13 @@ class InClusterClient:
                 raw = resp.read()
                 return json.loads(raw) if raw else {}
         except urllib.error.HTTPError as e:
-            raise KubeError(e.code, e.read().decode(errors="replace")) from e
+            raise KubeError(e.code, e.read().decode(errors="replace"),
+                            retry_after=_retry_after_s(e.headers)) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            # transport failure (refused/reset/DNS/timeout): status 0 is
+            # the retryable-by-definition class — the request may never
+            # have reached the apiserver
+            raise KubeError(0, f"transport: {e}") from e
 
     @staticmethod
     def _merge_patch_annotations(annotations: dict) -> dict:
@@ -153,6 +178,7 @@ class InClusterClient:
         KubeError(410) when the resourceVersion was compacted away —
         either as an HTTP status or as an in-stream ERROR event, both of
         which the apiserver uses — so the snapshot relists."""
+        failpoints.fire("kube.watch", path=path)
         query = (f"?watch=true&allowWatchBookmarks=true"
                  f"&resourceVersion={resource_version}"
                  f"&timeoutSeconds={max(1, int(timeout_s))}")
@@ -163,7 +189,10 @@ class InClusterClient:
             resp = urllib.request.urlopen(req, context=self._ctx,
                                           timeout=timeout_s + 30)
         except urllib.error.HTTPError as e:
-            raise KubeError(e.code, e.read().decode(errors="replace")) from e
+            raise KubeError(e.code, e.read().decode(errors="replace"),
+                            retry_after=_retry_after_s(e.headers)) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise KubeError(0, f"transport: {e}") from e
         with resp:
             for line in resp:
                 event = parse_watch_line(line)

@@ -5,47 +5,123 @@ allocation-status annotation is "failed") and recovery.go:1-224 (evict pods
 whose recorded devices vanished from the kubelet checkpoint — chip swaps,
 uuid changes). Behind the Reschedule feature gate. Eviction (not delete)
 respects PDBs; delete is the fallback when the eviction API is rejected.
+
+Resilience (vtfault):
+
+- every API call routes through ``KubeResilience`` (RetryPolicy +
+  CircuitBreaker) instead of the old silent ``except KubeError: return
+  0`` — a failing reconcile now counts
+  (``vtpu_reschedule_reconcile_failures_total``), logs, and backs the
+  loop interval off exponentially while the apiserver is unhappy;
+- the crash-window reaper (resilience/recovery.py): pods whose
+  bind-intent expired while still unbound get their dead commitment
+  cleared (scheduler crashed between commit and bind), and bound pods
+  stuck in "allocating" with no real allocation get evicted (plugin
+  crashed mid-Allocate);
+- the registry's per-pod bindings are reaped for pods that no longer
+  exist, fed from the same pod list.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.deviceplugin.checkpoint import (KUBELET_CHECKPOINT,
                                                   devices_for_resource)
 from vtpu_manager.deviceplugin.vnum import device_uuid
 from vtpu_manager.device.types import get_pod_device_claims
+from vtpu_manager.resilience import failpoints, recovery
+from vtpu_manager.resilience.policy import (COUNTERS, CircuitOpenError,
+                                            KubeResilience)
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
+
+# loop-interval backoff cap while reconciles fail (2**5 = 32x interval)
+MAX_BACKOFF_DOUBLINGS = 5
 
 
 class RescheduleController:
     def __init__(self, client: KubeClient, node_name: str,
                  known_uuids: set[str] | None = None,
                  checkpoint_path: str = KUBELET_CHECKPOINT,
-                 interval_s: float = 15.0):
+                 interval_s: float = 15.0,
+                 resilience: KubeResilience | None = None,
+                 intent_ttl_s: float = consts.DEFAULT_STUCK_GRACE_S,
+                 registry=None, intent_scan_every: int = 4):
         self.client = client
         self.node_name = node_name
         self.known_uuids = known_uuids or set()
         self.checkpoint_path = checkpoint_path
         self.interval_s = interval_s
+        self.resilience = resilience or KubeResilience()
+        # how long a bind-intent may sit unresolved before the crash
+        # window it marks is reaped (aligned with the scheduler's stuck
+        # grace: both date the same commitment)
+        self.intent_ttl_s = intent_ttl_s
+        # RegistryServer (ClientMode): fed the live pod-uid set so
+        # bindings of vanished pods are reaped each reconcile
+        self.registry = registry
+        # cadence of the CLUSTER-wide pod list that feeds the
+        # committed-but-unbound reaper (those pods carry only the
+        # predicate-node annotation, which no field selector can reach).
+        # Every other pass uses the server-side nodeName selector —
+        # O(node) not O(cluster), the original load profile. 1 = scan
+        # every pass (the chaos harness does).
+        self.intent_scan_every = max(1, intent_scan_every)
+        self._pass_index = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.evicted: list[tuple[str, str]] = []   # observability for tests
+        self.requeued: list[tuple[str, str]] = []  # cleared commitments
+        self.consecutive_failures = 0
+        self.reconcile_failures_total = 0
 
     # -- one reconcile pass -------------------------------------------------
 
     def reconcile_once(self) -> int:
         evictions = 0
+        self._pass_index += 1
+        cluster_scan = (self._pass_index % self.intent_scan_every) == 1 \
+            or self.intent_scan_every == 1
         try:
-            pods = self.client.list_pods(node_name=self.node_name)
-        except KubeError:
+            if cluster_scan:
+                # the crash-window reaper must see pods COMMITTED to
+                # this node but not yet bound — those carry only the
+                # predicate-node annotation, which no field selector
+                # reaches, so this cadenced pass pays one cluster LIST
+                all_pods = self.resilience.call(self.client.list_pods,
+                                                op="reschedule.list_pods")
+                pods, committed, _ = self._partition(all_pods)
+            else:
+                pods = self.resilience.call(
+                    lambda: self.client.list_pods(
+                        node_name=self.node_name),
+                    op="reschedule.list_pods")
+                committed = []
+        except (KubeError, CircuitOpenError) as e:
+            self.consecutive_failures += 1
+            self.reconcile_failures_total += 1
+            COUNTERS.bump("reschedule.reconcile", "failure")
+            log.warning("reschedule reconcile: pod list failed "
+                        "(consecutive failure #%d): %s",
+                        self.consecutive_failures, e)
             return 0
+        self.consecutive_failures = 0
+        now = time.time()
+        # registrations only exist for pods allocated (hence bound) on
+        # THIS node, so the resident set is the right liveness truth for
+        # the registry reap — node-scoped on every pass
+        resident_uids = {(p.get("metadata") or {}).get("uid", "")
+                         for p in pods}
         checkpoint = devices_for_resource(consts.vtpu_number_resource(),
                                           self.checkpoint_path)
+        # crash window 1: committed-but-unbound pods whose intent expired
+        for pod in committed:
+            self._reap_dead_commitment(pod, now)
         for pod in pods:
             meta = pod.get("metadata") or {}
             anns = meta.get("annotations") or {}
@@ -61,6 +137,17 @@ class RescheduleController:
                 # the device plugin could not fulfil the scheduler's
                 # commitment; send the pod back through scheduling
                 self._evict(ns, name, "allocation failed on node")
+                evictions += 1
+                continue
+
+            # crash window 2: bound, status "allocating", no real
+            # allocation, and the bind-intent (or the predicate stamp)
+            # expired — the plugin died mid-Allocate and could not even
+            # patch "failed"
+            if self._allocating_stuck(anns, now):
+                self._evict(ns, name,
+                            "stuck in allocating past the bind-intent "
+                            "ttl (plugin crash window)")
                 evictions += 1
                 continue
 
@@ -87,20 +174,92 @@ class RescheduleController:
                                 f"kubelet checkpoint references missing "
                                 f"devices: {ghost[:4]}")
                     evictions += 1
+        if self.registry is not None:
+            self.registry.reap_orphans(resident_uids)
         return evictions
+
+    def _partition(self, all_pods: list[dict]
+                   ) -> tuple[list[dict], list[dict], set[str]]:
+        """(resident pods, committed-but-unbound pods, all live uids).
+        Residents carry our nodeName; committed pods carry only the
+        predicate-node annotation (the filter committed, bind never
+        landed)."""
+        resident: list[dict] = []
+        committed: list[dict] = []
+        live_uids: set[str] = set()
+        for pod in all_pods:
+            meta = pod.get("metadata") or {}
+            live_uids.add(meta.get("uid", ""))
+            node = (pod.get("spec") or {}).get("nodeName") or ""
+            if node == self.node_name:
+                resident.append(pod)
+            elif not node and (meta.get("annotations") or {}).get(
+                    consts.predicate_node_annotation()) == self.node_name:
+                committed.append(pod)
+        return resident, committed, live_uids
+
+    def _allocating_stuck(self, anns: dict, now: float) -> bool:
+        if anns.get(consts.allocation_status_annotation()) != \
+                consts.ALLOC_STATUS_ALLOCATING:
+            return False
+        if anns.get(consts.real_allocated_annotation()):
+            return False
+        return recovery.intent_expired(anns, now, self.intent_ttl_s)
+
+    def _reap_dead_commitment(self, pod: dict, now: float) -> bool:
+        """Clear the annotations of a commitment whose bind never landed
+        (scheduler crashed between the intent patch and the Binding
+        POST). Clearing — not evicting — because the pod is still
+        Pending: erasing the dead commitment returns it to the
+        scheduling queue's normal flow."""
+        meta = pod.get("metadata") or {}
+        anns = meta.get("annotations") or {}
+        if anns.get(consts.real_allocated_annotation()):
+            # the plugin fulfilled the commitment (watch-lag Allocate can
+            # complete before the Binding lands): the allocation record
+            # is live state — clearing it would LEAK the devices
+            return False
+        if not recovery.intent_expired(anns, now, self.intent_ttl_s):
+            return False
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        log.warning("reaping dead bind commitment for %s/%s (intent "
+                    "expired unbound)", ns, name)
+        try:
+            self.resilience.call(
+                lambda: self.client.patch_pod_annotations(
+                    ns, name, recovery.commitment_clear_patch()),
+                op="reschedule.clear_commitment")
+        except (KubeError, CircuitOpenError) as e:
+            log.warning("commitment clear failed for %s/%s: %s",
+                        ns, name, e)
+            return False
+        self.requeued.append((ns, name))
+        self._emit_event(ns, name, "dead bind commitment cleared "
+                                   "(scheduler crash window)")
+        return True
 
     def _evict(self, namespace: str, name: str, reason: str) -> None:
         log.warning("evicting %s/%s: %s", namespace, name, reason)
+        failpoints.fire("controller.evict", namespace=namespace, pod=name)
         try:
-            self.client.evict_pod(namespace, name)
-        except KubeError:
+            self.resilience.call(
+                lambda: self.client.evict_pod(namespace, name),
+                op="reschedule.evict")
+        except (KubeError, CircuitOpenError):
             try:
-                self.client.delete_pod(namespace, name, grace_seconds=30)
-            except KubeError:
+                self.resilience.call(
+                    lambda: self.client.delete_pod(namespace, name,
+                                                   grace_seconds=30),
+                    op="reschedule.delete")
+            except (KubeError, CircuitOpenError):
                 log.error("both evict and delete failed for %s/%s",
                           namespace, name)
                 return
         self.evicted.append((namespace, name))
+        self._emit_event(namespace, name, reason)
+
+    def _emit_event(self, namespace: str, name: str, reason: str) -> None:
         try:
             self.client.create_event(namespace, {
                 "metadata": {"generateName": "vtpu-reschedule-"},
@@ -111,13 +270,21 @@ class RescheduleController:
                 "type": "Warning",
             })
         except KubeError:
-            pass
+            log.warning("reschedule event emit failed for %s/%s",
+                        namespace, name)
 
     # -- loop ---------------------------------------------------------------
 
+    def current_interval_s(self) -> float:
+        """Loop pacing: the base interval, doubled per consecutive
+        reconcile failure (capped) — a throttling apiserver gets relief,
+        and the first clean pass snaps back to the base cadence."""
+        doublings = min(self.consecutive_failures, MAX_BACKOFF_DOUBLINGS)
+        return self.interval_s * (2 ** doublings)
+
     def start(self) -> None:
         def loop():
-            while not self._stop.wait(self.interval_s):
+            while not self._stop.wait(self.current_interval_s()):
                 try:
                     self.reconcile_once()
                 except Exception:

@@ -24,29 +24,50 @@ class CheckpointEntry:
     device_ids: tuple[str, ...]
 
 
+def _string_ids(chunk) -> list[str]:
+    """Only a list of strings contributes device ids. Anything else —
+    a bare string (iterating it would yield CHARACTERS), a number, a
+    nested dict — is a malformed entry and contributes nothing, because
+    a garbage id here feeds the recovery controller's ghost-device
+    eviction: mis-parsing must never read as "devices vanished"."""
+    if not isinstance(chunk, list):
+        return []
+    return [d for d in chunk if isinstance(d, str)]
+
+
 def read_checkpoint(path: str = KUBELET_CHECKPOINT) -> list[CheckpointEntry]:
     """Parse the kubelet device-manager checkpoint (JSON with a Data.
-    PodDeviceEntries list). Malformed/absent files yield []."""
+    PodDeviceEntries list). Malformed/absent/truncated files yield [];
+    wrong-typed fields degrade per entry, never crash the reconcile."""
     if not os.path.exists(path):
         return []
     try:
         with open(path) as f:
             doc = json.load(f)
-    except (OSError, json.JSONDecodeError):
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+    data = doc.get("Data")
+    raw_entries = data.get("PodDeviceEntries") if isinstance(data, dict) \
+        else None
+    if not isinstance(raw_entries, list):
         return []
     entries = []
-    for entry in ((doc.get("Data") or {}).get("PodDeviceEntries") or []):
+    for entry in raw_entries:
+        if not isinstance(entry, dict):
+            continue
         ids: list[str] = []
         dev_map = entry.get("DeviceIDs") or {}
         if isinstance(dev_map, dict):
             for chunk in dev_map.values():
-                ids.extend(chunk or [])
-        elif isinstance(dev_map, list):
-            ids = dev_map
+                ids.extend(_string_ids(chunk))
+        else:
+            ids = _string_ids(dev_map)
         entries.append(CheckpointEntry(
-            pod_uid=entry.get("PodUID", ""),
-            container=entry.get("ContainerName", ""),
-            resource=entry.get("ResourceName", ""),
+            pod_uid=str(entry.get("PodUID") or ""),
+            container=str(entry.get("ContainerName") or ""),
+            resource=str(entry.get("ResourceName") or ""),
             device_ids=tuple(ids)))
     return entries
 

@@ -31,6 +31,8 @@ from vtpu_manager.deviceplugin.base import DevicePluginServicer
 from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.manager.device_manager import DeviceManager
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -57,10 +59,21 @@ class VnumPlugin(DevicePluginServicer):
                  base_dir: str = consts.MANAGER_BASE_DIR,
                  shim_host_dir: str = consts.DRIVER_DIR,
                  libtpu_path: str = "/lib/libtpu.so",
-                 disable_control: bool = False):
+                 disable_control: bool = False,
+                 policy: RetryPolicy | None = None):
         self.manager = manager
         self.client = client
         self.node_name = node_name
+        # Allocate runs on kubelet's clock (10s-scale gRPC deadline),
+        # and one Allocate can cross this policy up to THREE times
+        # (pending-scan list, succeed patch, failed patch) on
+        # independent clocks — the per-call deadline must be small
+        # enough that the worst-case SUM still fits inside kubelet's,
+        # or a slow-failing patch could outlive the RPC and land a
+        # "succeed" status on an Allocate kubelet already abandoned
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            base_delay_s=0.05,
+                                            deadline_s=2.0)
         self.node_config = node_config or NodeConfig()
         self.base_dir = base_dir
         self.shim_host_dir = shim_host_dir
@@ -107,8 +120,14 @@ class VnumPlugin(DevicePluginServicer):
         """
         out = []
         try:
-            all_pods = self.client.list_pods()
+            all_pods = self.policy.run(self.client.list_pods,
+                                       op="plugin.list_pods")
         except KubeError:
+            # retries exhausted / terminal: an empty pending set fails
+            # this Allocate visibly (no matching pre-allocation) rather
+            # than mis-serving — log so the cause is attributable
+            log.warning("pod list failed during pending-allocation scan; "
+                        "treating as no pending pods", exc_info=True)
             return out
         seen_uids: set[str] = set()
         pods = []
@@ -220,27 +239,39 @@ class VnumPlugin(DevicePluginServicer):
         try:
             with trace.span(ctx, "plugin.allocate", container=cont,
                             devices=len(dev_ids)):
+                failpoints.fire("plugin.allocate", pod_uid=uid,
+                                container=cont)
                 response = self._response_for(pod, cont, claims)
                 self._record_devices(uid, cont, dev_ids, claims)
-                self.client.patch_pod_annotations(
-                    meta.get("namespace", "default"), meta.get("name", ""), {
-                        consts.real_allocated_annotation():
-                            self._claims_annotation(pod, cont, claims),
-                        consts.allocation_status_annotation():
-                            consts.ALLOC_STATUS_SUCCEED,
-                    })
+                self.policy.run(
+                    lambda: self.client.patch_pod_annotations(
+                        meta.get("namespace", "default"),
+                        meta.get("name", ""), {
+                            consts.real_allocated_annotation():
+                                self._claims_annotation(pod, cont, claims),
+                            consts.allocation_status_annotation():
+                                consts.ALLOC_STATUS_SUCCEED,
+                        }),
+                    op="plugin.allocate_patch")
             with self._served_lock:
                 self._served.add((uid, cont))
             return response
         except Exception:
             log.exception("allocate failed for %s/%s", uid, cont)
             try:
-                self.client.patch_pod_annotations(
-                    meta.get("namespace", "default"), meta.get("name", ""),
-                    {consts.allocation_status_annotation():
-                         consts.ALLOC_STATUS_FAILED})
+                self.policy.run(
+                    lambda: self.client.patch_pod_annotations(
+                        meta.get("namespace", "default"),
+                        meta.get("name", ""),
+                        {consts.allocation_status_annotation():
+                             consts.ALLOC_STATUS_FAILED}),
+                    op="plugin.failed_patch")
             except KubeError:
-                pass
+                # the reschedule controller's allocating-stuck reaper is
+                # the backstop when even the failed patch cannot land
+                log.warning("failed-status patch did not land for %s/%s; "
+                            "relying on the allocating-stuck reaper",
+                            uid, cont, exc_info=True)
             raise
 
     def _claims_annotation(self, pod: dict, cont: str,
@@ -346,8 +377,12 @@ class VnumPlugin(DevicePluginServicer):
                                     pod_namespace=meta.get("namespace", ""),
                                     container_name=cont, compat_mode=compat,
                                     devices=devices)
-                vc.write_config(os.path.join(config_host, "vtpu.config"),
-                                cfg)
+                cfg_path = os.path.join(config_host, "vtpu.config")
+                vc.write_config(cfg_path, cfg)
+                # partial-write action tears the file just written (the
+                # mid-write-crash state PreStartContainer must rewrite)
+                failpoints.fire("plugin.config_write", pod_uid=uid,
+                                path=cfg_path)
             # mounts: per-container config, the shim, locks, vmem, watcher
             # (reference vnum_plugin.go:799-879); the PJRT substitution envs
             # play the role of ld.so.preload (:872-879)
@@ -426,6 +461,7 @@ class VnumPlugin(DevicePluginServicer):
         with open(tmp, "w") as f:
             json.dump(records, f)
         os.replace(tmp, path)
+        failpoints.fire("plugin.record_devices", pod_uid=pod_uid, path=path)
 
     def pre_start_container(self, request):
         """Verify the requested devices belong to a recorded allocation and

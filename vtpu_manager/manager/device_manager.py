@@ -16,6 +16,7 @@ from typing import Callable
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.config.node_config import DeviceIDStore, NodeConfig
 from vtpu_manager.device.types import (ChipSpec, MeshSpec, NodeDeviceRegistry)
+from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.tpu.discovery import DiscoveryBackend, discover
 from vtpu_manager.util import consts
 
@@ -74,6 +75,14 @@ class DeviceManager:
         self._health_listeners: list[Callable[[ChipSpec], None]] = []
         self._stop = threading.Event()
         self._heartbeat_thread: threading.Thread | None = None
+        # node-registry registration retry: the register annotation is
+        # what makes this node schedulable at all — absorb transient
+        # apiserver blips instead of waiting a whole heartbeat interval
+        # with the node invisible (terminal errors still surface to the
+        # logging callers)
+        self._registration_policy = RetryPolicy(max_attempts=3,
+                                                base_delay_s=0.1,
+                                                deadline_s=10.0)
 
     # -- inventory ----------------------------------------------------------
 
@@ -126,7 +135,10 @@ class DeviceManager:
         }
         if self.mesh_domain:
             anns[consts.node_mesh_domain_annotation()] = self.mesh_domain
-        self.client.patch_node_annotations(self.node_name, anns)
+        self._registration_policy.run(
+            lambda: self.client.patch_node_annotations(self.node_name,
+                                                       anns),
+            op="manager.register_node")
 
     def start_heartbeat(self, interval_s: float = 30.0) -> None:
         def loop():

@@ -25,6 +25,7 @@ import threading
 from typing import Callable
 
 from vtpu_manager import trace
+from vtpu_manager.resilience import failpoints
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -136,6 +137,9 @@ class RegistryServer:
         # restarts (new leaf) re-register cleanly.
         self._bind: dict[tuple[str, str], str] = {}   # (uid, name) -> cgroup
         self._bind_lock = threading.Lock()
+        # two-strike ledger for reap_orphans: a binding must look dead
+        # on two CONSECUTIVE reaps before removal (see below)
+        self._orphan_suspects: set[tuple[str, str]] = set()
 
     # -- request handling ---------------------------------------------------
 
@@ -185,6 +189,8 @@ class RegistryServer:
         with self._bind_lock:
             if not self._admit_binding(pod_uid, container, cgroup, peer_pid):
                 return 3
+        failpoints.fire("registry.register", pod_uid=pod_uid,
+                        container=container)
         # vtrace: the registration is the last daemon-side stage of the
         # allocation path (the tenant is up and announcing itself); joined
         # by pod uid — the socket protocol carries no trace id
@@ -229,6 +235,32 @@ class RegistryServer:
                                    "peer_pid": peer_pid,
                                    "pids": sorted(set(pids))})
         return 0
+
+    def reap_orphans(self, live_pod_uids: set[str]) -> int:
+        """Drop bindings whose pod no longer exists (the reschedule
+        controller feeds the live set each reconcile). The existing
+        dead-cgroup reaping inside registration covers churn while
+        registrations keep arriving; this covers the quiet node — a
+        crashed tenant's binding must not squat its (pod, container)
+        slot until the next unrelated registration.
+
+        Two-strike rule: the caller's live set is a snapshot taken at
+        the START of its reconcile, so a pod that registered during the
+        pass looks dead once (TOCTOU). Removal requires looking dead on
+        two consecutive reaps — a genuinely live binding is vindicated
+        by the next pass's fresher list."""
+        removed = 0
+        with self._bind_lock:
+            dead = {key for key in self._bind
+                    if key[0] not in live_pod_uids}
+            confirmed = dead & self._orphan_suspects
+            for key in confirmed:
+                del self._bind[key]
+                removed += 1
+            self._orphan_suspects = dead - confirmed
+        if removed:
+            log.info("registry: reaped %d orphan binding(s)", removed)
+        return removed
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:

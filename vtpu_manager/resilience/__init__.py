@@ -1,0 +1,35 @@
+"""vtfault: failpoint injection + unified retry/backoff resilience.
+
+Three pillars (reference: pkg/controller/reschedule/{reschedule,recovery}.go
+survive node-side failure; this package makes the WHOLE control plane
+survive its own):
+
+- ``failpoints``: an etcd/gofail-style registry of named injection sites
+  wired across every layer (kube client, scheduler commit/bind, snapshot
+  apply, plugin Allocate/config, registry, trace spool, file locks),
+  behind the ``FaultInjection`` feature gate — a disabled site costs one
+  dict lookup.
+- ``policy``: ``RetryPolicy`` (jittered exponential backoff under a
+  deadline budget, Retry-After honored, retryable vs terminal KubeErrors
+  distinguished) and ``CircuitBreaker`` for API-server operations; every
+  previously ad-hoc ``except KubeError: pass`` site routes through them
+  (enforced by the ``retry-hygiene`` vtlint rule).
+- ``recovery``: the bind-intent crash trail — an annotation stamped
+  before the Binding POST so a scheduler crash between predicate commit
+  and bind, or a plugin crash mid-Allocate, leaves state the reschedule
+  controller can reap.
+
+The seeded chaos harness (tests/test_chaos.py, ``make test-chaos``)
+drives the fake-clientset e2e path with failpoints firing at every
+registered site and asserts the invariants that define correctness under
+failure: no double-allocation, no leaked device or claim, every pod
+consistently allocated or evicted/requeued.
+"""
+
+from __future__ import annotations
+
+# Import-free on purpose: client/kube.py calls failpoints.fire() and
+# policy.py imports KubeError from client/kube.py — re-exporting policy
+# here would close that loop into a circular import. Import the
+# submodules directly (vtpu_manager.resilience.{failpoints,policy,
+# recovery}).

@@ -1,0 +1,294 @@
+"""Failpoint registry: named fault-injection sites (etcd gofail pattern).
+
+Every load-bearing step of the allocation path calls
+``failpoints.fire("<site>")`` (the SITES catalog below is the canonical
+list). In production the registry is empty and ``fire`` is one dict
+lookup returning None — the cost contract the FaultInjection gate's
+"default off" promise rests on, asserted by the chaos suite's gate-off
+run. When a test (or a binary with ``FaultInjection=true`` plus the
+``VTPU_FAILPOINTS`` env spec) arms a site, ``fire`` consults the armed
+spec: a seeded RNG decides probabilistically, a count bounds total
+fires, and the action runs:
+
+- ``error``   raise an exception (KubeError with a chosen status for
+              kube-facing sites, or any factory) — the transient-failure
+              case RetryPolicy must absorb;
+- ``latency`` sleep a fixed delay — the slow-dependency case deadlines
+              must bound;
+- ``crash``   raise :class:`CrashFailpoint`, a **BaseException**: broad
+              ``except Exception`` recovery code cannot swallow it, so
+              it propagates exactly like process death at that line
+              (locks still release — the kernel would do the same for
+              flocks on a real crash);
+- ``partial-write`` truncate the file the site just wrote (ctx must
+              carry ``path``) to a seeded fraction, then crash — the
+              torn-file state a mid-write power cut leaves.
+
+Determinism: one ``random.Random(seed)`` per enablement; the same seed
+and the same call sequence replay the same injections (the chaos
+harness logs its seed; ``CHAOS_SEED=n make test-chaos`` reproduces).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from random import Random
+
+log = logging.getLogger(__name__)
+
+# Canonical site catalog: name -> where it fires (docs/resilience.md
+# carries the operator-facing version). Arming an unknown site is an
+# error — a typo must not silently inject nothing.
+SITES: dict[str, str] = {
+    "kube.request": "client/kube.py _request + every FakeKubeClient verb",
+    "kube.watch": "client/kube.py _watch + FakeKubeClient watch streams",
+    "scheduler.filter_commit": "filter.py _commit, after the annotation "
+                               "patch, before the assumed-cache insert",
+    "scheduler.bind_patch": "bind.py, between the allocating/intent patch "
+                            "and the Binding POST (the bind crash window)",
+    "snapshot.apply": "snapshot.py apply_event, before decode/apply",
+    "plugin.allocate": "vnum.py _allocate_container, inside the Allocate "
+                       "try block",
+    "plugin.config_write": "vnum.py, after vtpu.config is written",
+    "plugin.record_devices": "vnum.py _record_devices, after devices.json "
+                             "is written",
+    "registry.register": "registry/server.py handle_request, after "
+                         "attestation, before the registration write",
+    "trace.spool_flush": "trace/recorder.py flush, before spool I/O",
+    "flock.acquire": "util/flock.py FileLock.acquire entry",
+    "controller.evict": "controller/reschedule.py _evict entry",
+}
+
+ACTIONS = ("error", "latency", "crash", "partial-write")
+
+
+class CrashFailpoint(BaseException):
+    """Simulated process death at a failpoint. BaseException on purpose:
+    recovery code that catches ``Exception`` must not be able to survive
+    a crash the way it could never survive a real one."""
+
+    def __init__(self, site: str):
+        super().__init__(f"crash failpoint fired at {site}")
+        self.site = site
+
+
+@dataclass
+class _Spec:
+    action: str
+    p: float = 1.0
+    count: int | None = None          # remaining fires; None = unlimited
+    status: int = 503                 # for error action on kube sites
+    latency_s: float = 0.001
+    exc: type | None = None           # overrides the KubeError default
+    match: dict = field(default_factory=dict)   # ctx subset that must match
+
+
+class _Stats:
+    __slots__ = ("fires", "evaluations")
+
+    def __init__(self) -> None:
+        self.fires: dict[str, int] = {}
+        self.evaluations = 0
+
+    def total(self) -> int:
+        return sum(self.fires.values())
+
+
+# _ARMED is the whole fast-path contract: empty unless enable()+arm()
+# ran, and fire()'s disabled path is exactly one .get() on it.
+_ARMED: dict[str, _Spec] = {}
+_lock = threading.Lock()
+_rng = Random(0)
+_enabled = False
+_stats = _Stats()
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enable(seed: int = 0) -> None:
+    """Turn the registry on (FaultInjection gate). Resets stats and the
+    deterministic RNG; sites still need arm()."""
+    global _enabled, _rng, _stats
+    with _lock:
+        _enabled = True
+        _rng = Random(seed)
+        _stats = _Stats()
+        _ARMED.clear()
+
+
+def disable() -> None:
+    """Back to the fully cold path: clears every armed site and the
+    stats (a disabled registry reports zero, matching its cost)."""
+    global _enabled, _stats
+    with _lock:
+        _enabled = False
+        _ARMED.clear()
+        _stats = _Stats()
+
+
+def arm(site: str, action: str, p: float = 1.0, count: int | None = None,
+        status: int = 503, latency_s: float = 0.001,
+        exc: type | None = None, match: dict | None = None) -> None:
+    if site not in SITES:
+        raise KeyError(f"unknown failpoint site {site!r} "
+                       f"(known: {sorted(SITES)})")
+    if action not in ACTIONS:
+        raise ValueError(f"unknown failpoint action {action!r}")
+    if not _enabled:
+        raise RuntimeError("failpoints disabled: enable() (FaultInjection "
+                           "gate) before arm()")
+    with _lock:
+        _ARMED[site] = _Spec(action=action, p=p, count=count, status=status,
+                             latency_s=latency_s, exc=exc,
+                             match=dict(match or {}))
+
+
+def disarm(site: str) -> None:
+    with _lock:
+        _ARMED.pop(site, None)
+
+
+def armed_sites() -> list[str]:
+    return sorted(_ARMED)
+
+
+def stats() -> dict:
+    with _lock:
+        return {"fires": dict(_stats.fires), "total": _stats.total(),
+                "evaluations": _stats.evaluations}
+
+
+def fire(site: str, **ctx) -> None:
+    """The injection point. Disabled/unarmed cost: this one dict lookup."""
+    spec = _ARMED.get(site)
+    if spec is None:
+        return
+    _fire_armed(site, spec, ctx)
+
+
+def _fire_armed(site: str, spec: _Spec, ctx: dict) -> None:
+    with _lock:
+        _stats.evaluations += 1
+        if spec.match:
+            for key, want in spec.match.items():
+                if ctx.get(key) != want:
+                    return
+        if spec.count is not None and spec.count <= 0:
+            return
+        if spec.p < 1.0 and _rng.random() >= spec.p:
+            return
+        if spec.count is not None:
+            spec.count -= 1
+        _stats.fires[site] = _stats.fires.get(site, 0) + 1
+        frac = 0.1 + 0.8 * _rng.random()     # partial-write cut point
+    log.info("failpoint %s fired: %s %s", site, spec.action,
+             {k: v for k, v in ctx.items() if k != "data"})
+    _record_span(site, spec.action, ctx)
+    if spec.action == "latency":
+        time.sleep(spec.latency_s)
+        return
+    if spec.action == "error":
+        raise _make_error(site, spec)
+    if spec.action == "partial-write":
+        _truncate(ctx.get("path"), frac)
+        raise CrashFailpoint(site)
+    raise CrashFailpoint(site)
+
+
+def _make_error(site: str, spec: _Spec) -> Exception:
+    if spec.exc is not None:
+        return spec.exc(f"failpoint {site} injected error")
+    # KubeError is the lingua franca of the sites this ships for; import
+    # here to keep the module import-light (flock.py imports us)
+    from vtpu_manager.client.kube import KubeError
+    return KubeError(spec.status, f"failpoint {site} injected error")
+
+
+def _truncate(path, frac: float) -> None:
+    if not path:
+        return
+    try:
+        import os
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, int(size * frac)))
+    except OSError:
+        log.warning("partial-write failpoint could not truncate %r", path)
+
+
+def _record_span(site: str, action: str, ctx: dict) -> None:
+    """Injections land in the pod's vtrace timeline so a chaos run (or a
+    staging soak with the gate on) shows WHERE the fault hit. Lazy import:
+    trace -> recorder -> flock -> this module would otherwise cycle."""
+    uid = ctx.get("pod_uid") or ""
+    if not uid:
+        return
+    try:
+        from vtpu_manager import trace
+        trace.event(trace.context_for_uid(uid), f"failpoint.{site}",
+                    action=action)
+    except Exception:  # noqa: BLE001 — observability must never add faults
+        log.debug("failpoint span emit failed", exc_info=True)
+
+
+# -- env spec (binaries: FaultInjection gate + VTPU_FAILPOINTS) -------------
+
+def arm_spec(spec: str) -> None:
+    """Parse ``site=action(arg,k=v,...);site2=...`` and arm each entry.
+    Grammar mirrors gofail's: the one positional arg is the status for
+    ``error`` and the seconds for ``latency``; ``p=``/``count=`` bound
+    the injection. Example::
+
+        VTPU_FAILPOINTS='kube.request=error(503,p=0.01);flock.acquire=latency(0.05)'
+    """
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad failpoint spec {part!r}")
+        site, _, rhs = part.partition("=")
+        site = site.strip()
+        action, _, argstr = rhs.partition("(")
+        action = action.strip()
+        kwargs: dict = {}
+        argstr = argstr.rstrip(")").strip()
+        if argstr:
+            for raw in argstr.split(","):
+                raw = raw.strip()
+                if not raw:
+                    continue
+                if "=" in raw:
+                    key, _, val = raw.partition("=")
+                    key = key.strip()
+                    if key == "p":
+                        kwargs["p"] = float(val)
+                    elif key == "count":
+                        kwargs["count"] = int(val)
+                    else:
+                        raise ValueError(
+                            f"unknown failpoint option {key!r} in {part!r}")
+                elif action == "error":
+                    kwargs["status"] = int(raw)
+                elif action == "latency":
+                    kwargs["latency_s"] = float(raw)
+                else:
+                    raise ValueError(
+                        f"positional arg {raw!r} invalid for {action!r}")
+        arm(site, action, **kwargs)
+
+
+def render_failpoint_metrics() -> str:
+    """Prometheus lines for /metrics (scheduler routes + monitor)."""
+    lines = ["# TYPE vtpu_failpoint_fires_total counter"]
+    snap = stats()
+    for site, count in sorted(snap["fires"].items()):
+        lines.append(f'vtpu_failpoint_fires_total{{site="{site}"}} {count}')
+    lines.append(f"# TYPE vtpu_failpoint_evaluations_total counter\n"
+                 f"vtpu_failpoint_evaluations_total {snap['evaluations']}")
+    return "\n".join(lines)

@@ -1,0 +1,274 @@
+"""RetryPolicy + CircuitBreaker: the one home for control-plane retries.
+
+Before this module every component handled API-server failure its own
+way: the reschedule controller swallowed KubeError and reported zero
+evictions, the snapshot consumer retried in a bare tight loop, the kube
+client issued one-shot calls. Now every KubeError path outside
+``vtpu_manager/resilience/`` must route through here (the
+``retry-hygiene`` vtlint rule enforces it), which gives three uniform
+behaviors:
+
+- **jittered exponential backoff under a deadline budget** — retries
+  never synchronize into a thundering herd (full jitter), and a caller
+  with a latency budget (a filter pass, a bind) stops retrying when the
+  budget would be blown rather than when an attempt counter runs out;
+- **Retry-After honored** — a 429/503 carrying the apiserver's own
+  pacing hint waits at least that long (KubeError.retry_after, parsed
+  from the HTTP header by the real client);
+- **retryable vs terminal distinguished** — 404/403/409/422 mean the
+  WORLD changed, not the wire; retrying them can only mask bugs, so
+  they surface immediately.
+
+``CircuitBreaker`` guards sustained outage: after ``failure_threshold``
+consecutive terminal/exhausted failures the circuit opens and calls are
+rejected locally for ``reset_timeout_s`` (no queue of doomed requests
+against a down apiserver), then one half-open probe decides re-close.
+
+Counters aggregate module-wide (GIL-atomic adds, the SnapshotStats
+idiom) and render via :func:`render_resilience_metrics` on /metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from random import Random
+from typing import Callable
+
+from vtpu_manager.client.kube import KubeError
+
+log = logging.getLogger(__name__)
+
+# Statuses worth retrying: throttling, transient server errors, and
+# status 0 (transport-level failure — connection refused/reset surfaces
+# as KubeError(0) from the client). Everything else is terminal: the
+# request itself is wrong or the object is gone.
+RETRYABLE_STATUSES = frozenset({0, 408, 429, 500, 502, 503, 504})
+
+
+def is_retryable(exc: BaseException) -> bool:
+    if isinstance(exc, KubeError):
+        return exc.status in RETRYABLE_STATUSES
+    return isinstance(exc, (ConnectionError, TimeoutError))
+
+
+class _Counters:
+    """Module-wide counter map: (op, event) -> count. Plain dict adds are
+    GIL-atomic; reads for rendering tolerate a torn view."""
+
+    def __init__(self) -> None:
+        self.data: dict[tuple[str, str], int] = {}
+
+    def bump(self, op: str, event: str, n: int = 1) -> None:
+        key = (op, event)
+        self.data[key] = self.data.get(key, 0) + n
+
+
+COUNTERS = _Counters()
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised instead of calling a dependency whose circuit is open."""
+
+
+class RetryPolicy:
+    """Jittered exponential backoff under a deadline budget.
+
+    ``run(fn, op=...)`` retries retryable failures until the budget
+    (``deadline_s``, monotonic) or ``max_attempts`` is exhausted, then
+    re-raises the last error. Terminal errors re-raise immediately.
+    ``rng`` and ``sleep`` are injectable so tests (and the seeded chaos
+    harness) are deterministic and never actually wait.
+    """
+
+    def __init__(self, max_attempts: int = 5, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0, deadline_s: float = 30.0,
+                 rng: Random | None = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_attempts = max(1, max_attempts)
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.deadline_s = deadline_s
+        self._rng = rng or Random()
+        self._sleep = sleep
+        self._clock = clock
+
+    def backoff_s(self, attempt: int,
+                  retry_after: float | None = None) -> float:
+        """Full-jitter exponential delay for the Nth failure (1-based),
+        floored at the server's Retry-After when one was sent. Public:
+        loop-shaped consumers (the snapshot watch pump) compute their own
+        sleep with it."""
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * (2 ** max(0, attempt - 1)))
+        delay = cap * (0.5 + 0.5 * self._rng.random())
+        if retry_after is not None:
+            delay = max(delay, retry_after)
+        return delay
+
+    def run(self, fn: Callable, op: str = "kube"):
+        start = self._clock()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                result = fn()
+            except Exception as e:  # noqa: BLE001 — classified + re-raised
+                if not is_retryable(e):
+                    COUNTERS.bump(op, "terminal")
+                    raise
+                retry_after = getattr(e, "retry_after", None)
+                delay = self.backoff_s(attempt, retry_after)
+                elapsed = self._clock() - start
+                if attempt >= self.max_attempts or \
+                        elapsed + delay > self.deadline_s:
+                    COUNTERS.bump(op, "exhausted")
+                    log.warning("%s: giving up after %d attempt(s) "
+                                "(%.2fs elapsed): %s", op, attempt,
+                                elapsed, e)
+                    raise
+                COUNTERS.bump(op, "retries")
+                log.debug("%s: attempt %d failed (%s); retrying in %.3fs",
+                          op, attempt, e, delay)
+                self._sleep(delay)
+                continue
+            if attempt > 1:
+                COUNTERS.bump(op, "recovered")
+            return result
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for one dependency (the API server).
+
+    closed -> (``failure_threshold`` consecutive failures) -> open for
+    ``reset_timeout_s`` (calls rejected with CircuitOpenError) -> one
+    half-open probe -> success closes, failure re-opens. Thread-safe;
+    the clock is injectable for tests.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, name: str = "kube", failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._state == self.OPEN and \
+                self._clock() - self._opened_at >= self.reset_timeout_s:
+            self._state = self.HALF_OPEN
+            self._probing = False
+        return self._state
+
+    def allow(self) -> bool:
+        """True when a call may proceed. In half-open exactly one caller
+        gets the probe; the rest stay rejected until it reports."""
+        with self._lock:
+            state = self._state_locked()
+            if state == self.CLOSED:
+                return True
+            if state == self.HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            COUNTERS.bump(self.name, "circuit_rejected")
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state != self.CLOSED:
+                COUNTERS.bump(self.name, "circuit_closed")
+                log.info("circuit %s closed", self.name)
+            self._state = self.CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            state = self._state_locked()
+            self._failures += 1
+            if state == self.HALF_OPEN or (
+                    state == self.CLOSED
+                    and self._failures >= self.failure_threshold):
+                if self._state != self.OPEN:
+                    COUNTERS.bump(self.name, "circuit_opened")
+                    log.warning("circuit %s opened after %d consecutive "
+                                "failure(s)", self.name, self._failures)
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probing = False
+
+    def metrics_value(self) -> int:
+        return {self.CLOSED: 0, self.HALF_OPEN: 1, self.OPEN: 2}[self.state]
+
+
+class KubeResilience:
+    """Retry + breaker composed for one dependency: the breaker gates the
+    WHOLE retried operation (a retry loop is one logical call), and only
+    terminal/exhausted outcomes count as breaker failures — a mid-loop
+    503 the retry absorbed is the system working, not failing."""
+
+    def __init__(self, policy: RetryPolicy | None = None,
+                 breaker: CircuitBreaker | None = None):
+        self.policy = policy or RetryPolicy()
+        self.breaker = breaker or CircuitBreaker()
+
+    def call(self, fn: Callable, op: str = "kube"):
+        if not self.breaker.allow():
+            raise CircuitOpenError(
+                f"{self.breaker.name} circuit open; rejecting {op}")
+        try:
+            result = self.policy.run(fn, op=op)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return result
+
+
+# -- metrics -----------------------------------------------------------------
+
+def render_resilience_metrics(
+        breakers: "list[CircuitBreaker] | None" = None) -> str:
+    """Prometheus rendering of the module counters (+ failpoint fires),
+    appended to /metrics by the scheduler routes and the node monitor."""
+    from vtpu_manager.resilience import failpoints
+    events: dict[str, list[tuple[str, int]]] = {}
+    for (op, event), count in sorted(COUNTERS.data.items()):
+        events.setdefault(event, []).append((op, count))
+    lines: list[str] = []
+    for event, metric in (("retries", "vtpu_resilience_retries_total"),
+                          ("terminal",
+                           "vtpu_resilience_terminal_errors_total"),
+                          ("exhausted", "vtpu_resilience_exhausted_total"),
+                          ("recovered", "vtpu_resilience_recovered_total"),
+                          ("circuit_rejected",
+                           "vtpu_circuit_rejected_total")):
+        lines.append(f"# TYPE {metric} counter")
+        for op, count in events.get(event, ()):
+            lines.append(f'{metric}{{op="{op}"}} {count}')
+    total_failures = sum(
+        count for (op, event), count in COUNTERS.data.items()
+        if op == "reschedule.reconcile" and event == "failure")
+    lines.append("# TYPE vtpu_reschedule_reconcile_failures_total counter\n"
+                 f"vtpu_reschedule_reconcile_failures_total {total_failures}")
+    for breaker in breakers or ():
+        lines.append(f"# TYPE vtpu_circuit_state gauge\n"
+                     f'vtpu_circuit_state{{name="{breaker.name}"}} '
+                     f"{breaker.metrics_value()}")
+    lines.append(failpoints.render_failpoint_metrics())
+    return "\n".join(lines)

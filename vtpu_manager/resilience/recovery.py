@@ -1,0 +1,80 @@
+"""Crash-window recovery: the bind-intent trail.
+
+The allocation path commits state across three processes (scheduler
+filter -> scheduler bind -> node plugin) with annotation patches as the
+only channel. Two crash windows used to leave a pod wedged with nothing
+reconciling it:
+
+1. **filter commit -> Binding POST**: the filter patched the
+   pre-allocation, bind patched "allocating", and then the scheduler
+   died before the Binding POST. The pod stays Pending forever holding a
+   stale commitment (the stuck grace frees the CAPACITY, but the pod's
+   annotations still claim a node and no controller cleared them).
+2. **Binding POST -> Allocate completion**: the pod is bound, status
+   "allocating", and the plugin died mid-Allocate. No "failed" patch was
+   written, so the reschedule controller's failed-status pass never
+   fires.
+
+The fix is one more field in the patch bind already makes: the
+bind-intent annotation, ``<node>@<wall-seconds>``, stamped in the SAME
+patch as the "allocating" status (one API call — no extra failure
+window) and therefore guaranteed present before the Binding POST. The
+reschedule controller then reaps both windows:
+
+- intent expired + pod still unbound  -> scheduler crashed in window 1:
+  clear the whole commitment (pre-allocation, predicate, intent, status)
+  so the pod re-enters scheduling cleanly;
+- intent expired + bound + status "allocating" + no real allocation ->
+  plugin crashed in window 2: evict, sending the pod back through
+  scheduling (the reference reschedule.go posture for unfulfillable
+  commitments).
+
+A successful Allocate patches status "succeed", which retires the intent
+without another write.
+"""
+
+from __future__ import annotations
+
+import time
+
+from vtpu_manager.util import consts
+
+
+def encode_bind_intent(node: str, ts: float | None = None) -> str:
+    return f"{node}@{ts if ts is not None else time.time()}"
+
+
+def parse_bind_intent(value: str | None) -> tuple[str, float] | None:
+    """(node, wall-seconds) or None for absent/malformed. Malformed reads
+    as absent — reaping must never trigger off garbage it cannot date."""
+    if not value:
+        return None
+    node, sep, raw_ts = value.rpartition("@")
+    if not sep or not node:
+        return None
+    try:
+        return node, float(raw_ts)
+    except ValueError:
+        return None
+
+
+def intent_expired(anns: dict, now: float, ttl_s: float) -> bool:
+    parsed = parse_bind_intent(
+        (anns or {}).get(consts.bind_intent_annotation()))
+    if parsed is None:
+        return False
+    _, ts = parsed
+    return now - ts > ttl_s
+
+
+def commitment_clear_patch() -> dict:
+    """Merge-patch annotation map that erases a dead scheduling
+    commitment (None values delete in merge-patch semantics, which both
+    the real client and the fake implement)."""
+    return {
+        consts.pre_allocated_annotation(): None,
+        consts.predicate_node_annotation(): None,
+        consts.predicate_time_annotation(): None,
+        consts.bind_intent_annotation(): None,
+        consts.allocation_status_annotation(): None,
+    }

@@ -14,6 +14,8 @@ from dataclasses import dataclass
 
 from vtpu_manager import trace
 from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.resilience import failpoints, recovery
+from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.scheduler.serial import SerialLocker
 from vtpu_manager.util import consts
 
@@ -30,10 +32,18 @@ class BindResult:
 
 class BindPredicate:
     def __init__(self, client: KubeClient, locker: SerialLocker | None = None,
-                 freshness_s: float = consts.DEFAULT_STUCK_GRACE_S):
+                 freshness_s: float = consts.DEFAULT_STUCK_GRACE_S,
+                 policy: RetryPolicy | None = None):
         self.client = client
         self.locker = locker or SerialLocker(serialize_all=False)
         self.freshness_s = freshness_s
+        # Bind sits on kube-scheduler's binding cycle: keep the retry
+        # budget tight (the scheduler re-dispatches a failed bind anyway)
+        # but absorb one throttle/transient blip instead of bouncing the
+        # pod back through the whole scheduling queue.
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            base_delay_s=0.05,
+                                            deadline_s=5.0)
 
     def bind(self, args: dict) -> BindResult:
         ns = args.get("PodNamespace") or args.get("podNamespace") or "default"
@@ -48,7 +58,8 @@ class BindPredicate:
 
     def _bind_locked(self, ns: str, name: str, node: str) -> BindResult:
         try:
-            pod = self.client.get_pod(ns, name)
+            pod = self.policy.run(lambda: self.client.get_pod(ns, name),
+                                  op="bind.get_pod")
         except KubeError as e:
             return BindResult(error=f"pod fetch failed: {e}")
         anns = (pod.get("metadata") or {}).get("annotations") or {}
@@ -72,13 +83,35 @@ class BindPredicate:
         # assembled timeline shows filter-commit -> bind queueing (the
         # kube-scheduler round trip) without a span of its own
         ctx = trace.context_for_pod(pod)
+        uid = (pod.get("metadata") or {}).get("uid", "")
         with trace.span(ctx, "scheduler.bind", node=node,
                         predicate_time=ts or 0.0):
             try:
-                self.client.patch_pod_annotations(ns, name, {
-                    consts.allocation_status_annotation():
-                        consts.ALLOC_STATUS_ALLOCATING})
-                self.client.bind_pod(ns, name, node)
+                # the plugin may have fulfilled the commitment BEFORE the
+                # Binding lands (its pending scan accepts predicate-node
+                # pods to bridge watch lag): never downgrade a completed
+                # allocation's status back to "allocating" — just bind
+                already_allocated = bool(
+                    anns.get(consts.real_allocated_annotation()))
+                if not already_allocated:
+                    # the bind-intent rides the SAME patch as the
+                    # allocating status: it is on the apiserver before
+                    # the Binding POST, so a crash in the window below
+                    # leaves a reapable trail (resilience/recovery.py)
+                    # instead of a wedged pod
+                    self.policy.run(
+                        lambda: self.client.patch_pod_annotations(
+                            ns, name, {
+                                consts.allocation_status_annotation():
+                                    consts.ALLOC_STATUS_ALLOCATING,
+                                consts.bind_intent_annotation():
+                                    recovery.encode_bind_intent(node)}),
+                        op="bind.patch")
+                failpoints.fire("scheduler.bind_patch", pod_uid=uid,
+                                node=node)
+                self.policy.run(
+                    lambda: self.client.bind_pod(ns, name, node),
+                    op="bind.binding")
             except KubeError as e:
                 return BindResult(error=f"bind failed: {e}")
             return BindResult()

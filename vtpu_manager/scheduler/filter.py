@@ -49,6 +49,8 @@ from vtpu_manager.device.allocator.request import (AllocationRequest,
 from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.scheduler import gang, reason as R
 from vtpu_manager.scheduler import snapshot as snap_mod
 from vtpu_manager.util import consts
@@ -94,11 +96,20 @@ class FilterPredicate:
                  candidate_limit: int = 64,
                  pods_ttl_s: float = 0.0,
                  nodes_ttl_s: float = 0.0,
-                 snapshot: "snap_mod.ClusterSnapshot | None" = None):
+                 snapshot: "snap_mod.ClusterSnapshot | None" = None,
+                 policy: RetryPolicy | None = None):
         self.client = client
         self.serialize = serialize
         self._serial_lock = threading.Lock()
         self.require_node_label = require_node_label
+        # commit-patch retry: the pass already paid its full allocation
+        # cost when the commit patch runs, so absorbing a transient
+        # 429/5xx is far cheaper than failing the pod back through the
+        # scheduling queue. Tight budget — the pass holds the serial
+        # section (other pods queue behind it).
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            base_delay_s=0.05,
+                                            deadline_s=5.0)
         # SchedulerSnapshot gate: when a ClusterSnapshot is provided every
         # cluster read (candidates, residents, gang siblings) comes from
         # its watch-maintained state and the TTL caches below sit idle;
@@ -424,7 +435,12 @@ class FilterPredicate:
             if node is None:
                 try:
                     node = self.client.get_node(name)
-                except KubeError:
+                except KubeError as e:
+                    if e.status != 404:
+                        # only "node really gone" may silently shrink the
+                        # candidate set; a throttle/outage must be visible
+                        log.warning("node %s fetch failed during filter "
+                                    "(%s); skipping it this pass", name, e)
                     continue
             out.append(node)
         return out
@@ -774,8 +790,16 @@ class FilterPredicate:
             if origin is not None:
                 anns[gang.gang_origin_annotation()] = \
                     gang.encode_origin(origin)
-        self.client.patch_pod_annotations(
-            meta.get("namespace", "default"), meta.get("name", ""), anns)
+        self.policy.run(
+            lambda: self.client.patch_pod_annotations(
+                meta.get("namespace", "default"), meta.get("name", ""),
+                anns),
+            op="filter.commit")
+        # crash window: the commitment is on the apiserver but not yet in
+        # the assumed cache — exactly the state a scheduler crash here
+        # leaves, reconciled by stuck-grace + the bind-intent reaper
+        failpoints.fire("scheduler.filter_commit",
+                        pod_uid=meta.get("uid", ""), node=best.name)
         self._assume(meta.get("uid", ""), best.name, best.result.effective)
 
     def _emit_rejection_event(self, pod: dict, message: str) -> None:

@@ -125,6 +125,11 @@ class SchedulerAPI:
             lines.append("# TYPE vtpu_scheduler_snapshot_generation gauge")
             lines.append(f"vtpu_scheduler_snapshot_generation "
                          f"{self.snapshot.generation}")
+        # retry/breaker counters + failpoint fires (vtfault): how often
+        # this process leaned on the resilience layer, and what the
+        # FaultInjection gate injected (zero in production)
+        from vtpu_manager.resilience.policy import render_resilience_metrics
+        lines.append(render_resilience_metrics())
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

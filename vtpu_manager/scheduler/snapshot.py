@@ -48,6 +48,8 @@ import time
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import container_kinds, effective_claims
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.util import consts
 from vtpu_manager.util.gangname import resolve_gang_name
 
@@ -91,8 +93,8 @@ class SnapshotStats:
     and asserted by the O(changed) tests. GIL-atomic int adds."""
 
     __slots__ = ("events_applied", "pod_events", "node_events", "bookmarks",
-                 "relists", "watch_errors", "registry_decodes",
-                 "claims_decodes")
+                 "relists", "watch_errors", "reconnects",
+                 "registry_decodes", "claims_decodes")
 
     def __init__(self) -> None:
         self.events_applied = 0
@@ -101,6 +103,7 @@ class SnapshotStats:
         self.bookmarks = 0
         self.relists = 0
         self.watch_errors = 0
+        self.reconnects = 0            # background-loop recovery cycles
         self.registry_decodes = 0      # decodes performed at apply time
         self.claims_decodes = 0
 
@@ -186,10 +189,15 @@ class ClusterSnapshot:
 
     def __init__(self, client: KubeClient,
                  stuck_grace_s: float = consts.DEFAULT_STUCK_GRACE_S,
-                 watch_timeout_s: float = 0.0):
+                 watch_timeout_s: float = 0.0,
+                 retry_policy: RetryPolicy | None = None):
         self.client = client
         self.stuck_grace_s = stuck_grace_s
         self.watch_timeout_s = watch_timeout_s
+        # shapes the background loop's failure backoff only (the loop
+        # drives its own retries — watch streams are not one-shot calls)
+        self.retry_policy = retry_policy or RetryPolicy(
+            base_delay_s=0.5, max_delay_s=30.0)
         self.stats = SnapshotStats()
         self.generation = 0
         # _lock guards every structure below; only dict/list swaps happen
@@ -221,6 +229,12 @@ class ClusterSnapshot:
         self._stop = threading.Event()
         self._last_pump_monotonic = 0.0
         self._started = False
+        # whether the most recent pump drained every kind cleanly — the
+        # background loop's backoff signal (pump() itself degrades to
+        # the last coherent state instead of raising, by design) — and
+        # the server's pacing hint from the absorbed failure, if any
+        self.last_pump_ok = True
+        self.last_pump_retry_after: float | None = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -246,19 +260,49 @@ class ClusterSnapshot:
         self._stop.set()
 
     def _background_loop(self, poll_s: float) -> None:
+        consecutive_failures = 0
         while self._background:
+            failure: BaseException | None = None
             try:
                 self.pump(timeout_s=poll_s)
-            except Exception:
+                ok = self.last_pump_ok
+            except Exception as e:
                 # a wedged watch must degrade to a stale-but-coherent
-                # snapshot, never take the scheduler down
+                # snapshot, never take the scheduler down (KubeErrors
+                # are already absorbed inside pump; this is the
+                # everything-else backstop)
                 log.warning("snapshot watch pump failed; serving the "
                             "last coherent state", exc_info=True)
                 self.stats.watch_errors += 1
-            # pacing: poll-style watches (the fake) return immediately,
-            # streaming watches already spent up to poll_s on the wire —
-            # either way the extra wait bounds apply-lag at ~2*poll_s
-            self._stop.wait(poll_s)
+                failure = e
+                ok = False
+            if ok:
+                consecutive_failures = 0
+                # pacing: poll-style watches (the fake) return
+                # immediately, streaming watches already spent up to
+                # poll_s on the wire — either way the extra wait bounds
+                # apply-lag at ~2*poll_s
+                self._stop.wait(poll_s)
+                continue
+            # the old bare fixed-interval retry hammered a throttling
+            # apiserver at exactly the wrong moment. Jittered
+            # exponential backoff (Retry-After honored when the failure
+            # carried one), reset on the first clean pump; staleness_s
+            # keeps growing the whole time, so the exported gauge tells
+            # the truth about how old served state can be.
+            consecutive_failures += 1
+            self.stats.reconnects += 1
+            # the pacing hint survives both failure shapes: an escaped
+            # exception carries it directly, an absorbed watch KubeError
+            # left it on last_pump_retry_after
+            retry_after = getattr(failure, "retry_after", None)
+            if retry_after is None:
+                retry_after = self.last_pump_retry_after
+            wait = max(poll_s, self.retry_policy.backoff_s(
+                consecutive_failures, retry_after))
+            log.warning("snapshot watch pump failing (failure #%d); "
+                        "retrying in %.2fs", consecutive_failures, wait)
+            self._stop.wait(wait)
 
     # -- pumping ------------------------------------------------------------
 
@@ -279,6 +323,7 @@ class ClusterSnapshot:
         applied = 0
         relisted = False
         ok = True
+        retry_after = None
         for kind in ("nodes", "pods"):
             try:
                 applied += self._drain(kind, timeout_s)
@@ -293,11 +338,16 @@ class ClusterSnapshot:
                                 "the last coherent state", kind, e)
                     self.stats.watch_errors += 1
                     ok = False
+                    if e.retry_after is not None:
+                        retry_after = max(retry_after or 0.0,
+                                          e.retry_after)
         if ok:
             # only a fully successful pump resets the freshness clock:
             # staleness_s is the exported how-old-can-my-state-be gauge,
             # and a failing watch must make it GROW, not read ~0
             self._last_pump_monotonic = time.monotonic()
+        self.last_pump_ok = ok
+        self.last_pump_retry_after = retry_after
         return applied, relisted
 
     def _drain(self, kind: str, timeout_s: float) -> int:
@@ -326,6 +376,8 @@ class ClusterSnapshot:
         """Apply one watch event. Public so failure-mode tests can inject
         crafted sequences (duplicates, reordering) directly. Decode and
         classification run before the lock is taken."""
+        failpoints.fire("snapshot.apply", kind=kind,
+                        type=event.get("type", ""))
         type_ = event.get("type", "")
         obj = event.get("object") or {}
         rv = (event.get("resourceVersion")

@@ -40,6 +40,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from vtpu_manager.resilience import failpoints
 from vtpu_manager.trace.context import TraceContext
 from vtpu_manager.util.flock import FileLock
 
@@ -151,6 +152,8 @@ class SpanRecorder:
              "drops": drops, "ts": round(time.time(), 3)},
             separators=(",", ":")))
         try:
+            # arm with exc=OSError to drive the spans-become-drops path
+            failpoints.fire("trace.spool_flush", path=self.spool_path)
             os.makedirs(self.spool_dir, exist_ok=True)
             with FileLock(f"{self.spool_path}.flock"):
                 self._rotate_if_large()

@@ -137,6 +137,15 @@ def gang_ordinal_annotation() -> str:
     return _ann("gang-ordinal")
 
 
+def bind_intent_annotation() -> str:
+    """Crash trail for the bind window: ``<node>@<wall-seconds>`` stamped
+    in the same patch as the "allocating" status, before the Binding
+    POST, so a scheduler crash between predicate commit and bind (or a
+    plugin crash mid-Allocate) leaves state the reschedule controller
+    can reap (resilience/recovery.py)."""
+    return _ann("bind-intent")
+
+
 def scheduler_stuck_grace_annotation() -> str:
     """Per-pod override of the stuck pre-allocation grace period
     (reference: SchedulerStuckGracePeriodAnnotation, consts.go:68)."""

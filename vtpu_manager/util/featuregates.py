@@ -24,6 +24,7 @@ SERIAL_FILTER_NODE = "SerialFilterNode"
 SERIAL_BIND_NODE = "SerialBindNode"
 TRACING = "Tracing"                     # vtrace allocation-path spans
 SCHEDULER_SNAPSHOT = "SchedulerSnapshot"  # watch-driven cluster snapshot
+FAULT_INJECTION = "FaultInjection"      # vtfault failpoint registry
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -46,6 +47,10 @@ _KNOWN = {
     # watch path has soaked; flipping it on swaps the scheduler's cluster
     # reads onto the incremental snapshot (scheduler/snapshot.py).
     SCHEDULER_SNAPSHOT: False,
+    # Default off: with the gate off every failpoint site is one dict
+    # lookup; on, VTPU_FAILPOINTS arms seeded injections
+    # (resilience/failpoints.py — chaos/staging only, never production).
+    FAULT_INJECTION: False,
 }
 
 

@@ -18,6 +18,7 @@ import fcntl
 import os
 import time
 
+from vtpu_manager.resilience import failpoints
 from vtpu_manager.util import consts
 
 
@@ -39,6 +40,9 @@ class FileLock:
         self._fd: int | None = None
 
     def acquire(self) -> None:
+        # chaos: latency here models lock contention from a wedged peer;
+        # error (arm with exc=LockTimeout) models the 10s timeout firing
+        failpoints.fire("flock.acquire", path=self.path)
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o666)
         deadline = time.monotonic() + self.timeout_s
