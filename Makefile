@@ -73,8 +73,12 @@ test-snapshot: ## Scheduler snapshot alone (fake watch, incremental apply, 410 r
 test-chaos: ## Seeded chaos suite: failpoints at every site over the e2e path (CHAOS_SEED=n reproduces one seed)
 	$(PYTEST) tests/test_chaos.py tests/test_resilience.py -q
 
+.PHONY: test-telemetry
+test-telemetry: ## vttel suite: step ring ABI + torture, aggregation, pressure hint, hermetic e2e
+	$(PYTEST) tests/test_telemetry.py -q
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants
+verify: lint test test-trace test-snapshot test-chaos test-telemetry ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

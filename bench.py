@@ -359,15 +359,26 @@ def quota_step_measure(dim: int, warmup: int, steps: int) -> float:
     x = jax.random.normal(jax.random.PRNGKey(0), (dim, dim), jnp.bfloat16)
     # vtrace terminal event: the first device step closes a traced pod's
     # admission-to-running timeline (no-op unless tracing env is present)
-    from vtpu_manager.runtime.client import mark_first_execute
+    from vtpu_manager.runtime.client import mark_first_execute, \
+        step_telemetry
     mark_first_execute()
-    for _ in range(warmup):
+    # vttel: per-step records into the shared ring (None unless the
+    # plugin injected the StepTelemetry env — the gate-off cost in this
+    # loop is the `is not None` branch)
+    tel = step_telemetry()
+    for i in range(warmup):
+        s0 = time.monotonic_ns() if tel is not None else 0
         x, loss = step(x)
         _ = float(loss)
+        if tel is not None:
+            tel.record(time.monotonic_ns() - s0, compiled=(i == 0))
     t0 = time.perf_counter()
     for _ in range(steps):
+        s0 = time.monotonic_ns() if tel is not None else 0
         x, loss = step(x)
         _ = float(loss)
+        if tel is not None:
+            tel.record(time.monotonic_ns() - s0)
     return 1000 * (time.perf_counter() - t0) / steps
 
 

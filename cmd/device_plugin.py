@@ -80,9 +80,9 @@ def main(argv: list[str] | None = None) -> int:
                                                 FAULT_INJECTION,
                                                 HONOR_PREALLOC_IDS,
                                                 MEMORY_PLUGIN, RESCHEDULE,
-                                                TC_WATCHER, TPU_TOPOLOGY,
-                                                TRACING, VMEMORY_NODE,
-                                                FeatureGates)
+                                                STEP_TELEMETRY, TC_WATCHER,
+                                                TPU_TOPOLOGY, TRACING,
+                                                VMEMORY_NODE, FeatureGates)
 
     gates = FeatureGates()
     try:
@@ -183,6 +183,9 @@ def main(argv: list[str] | None = None) -> int:
     # it then ask the plugin to mirror the scheduler's chip choice instead
     # of picking slots arbitrarily.
     vnum.preferred_allocation_available = gates.enabled(HONOR_PREALLOC_IDS)
+    # vttel: Allocate mounts the per-container telemetry subdir
+    # read-write and injects the step-ring env; off = nothing injected
+    vnum.step_telemetry_enabled = gates.enabled(STEP_TELEMETRY)
     plugins = [vnum]
     if gates.enabled(CORE_PLUGIN):
         plugins.append(VcorePlugin(manager))
@@ -293,6 +296,20 @@ def main(argv: list[str] | None = None) -> int:
                          name="vtpu-plugin-metrics").start()
         log.info("resilience metrics on :%d/metrics", args.metrics_port)
 
+    # vttel pressure rollup: this daemon (the node-annotation owner)
+    # scans the step rings and patches the node-pressure annotation the
+    # scheduler ingests as a soft scoring hint
+    pressure_pub = None
+    if gates.enabled(STEP_TELEMETRY):
+        from vtpu_manager.telemetry import TenantStepTelemetry
+        from vtpu_manager.telemetry.pressure import PressurePublisher
+        pressure_pub = PressurePublisher(
+            client, args.node_name,
+            TenantStepTelemetry(args.base_dir or consts.MANAGER_BASE_DIR),
+            node_hbm_total=sum(c.memory for c in chips))
+        pressure_pub.start()
+        log.info("step-telemetry pressure publisher running")
+
     controller = None
     if gates.enabled(RESCHEDULE):
         controller = RescheduleController(
@@ -319,6 +336,8 @@ def main(argv: list[str] | None = None) -> int:
             watcher.stop()
         if registry_srv:
             registry_srv.stop()
+        if pressure_pub:
+            pressure_pub.stop()
         if controller:
             controller.stop()
         health.stop()

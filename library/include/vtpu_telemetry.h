@@ -1,0 +1,71 @@
+/* vtpu_telemetry.h — C++ side of the vttel step-ring ABI.
+ *
+ * Mirror of vtpu_manager/telemetry/stepring.py: a fixed-size mmap'd ring
+ * of fixed-width step records under the per-container telemetry dir
+ * (MANAGER_BASE_DIR/telemetry/step_telemetry.ring in-container). The
+ * Python runtime client writes it for Python tenants; the shim's Execute
+ * hook writes the identical layout for C++-driven tenants, and the node
+ * monitor tails either indistinguishably. Layout changes are a two-step
+ * edit: this header's static_asserts AND the committed abi_golden.json
+ * (scripts/vtlint.py --update-abi-golden) both pin the Python module.
+ *
+ * Concurrency: per-record seqlock, same discipline as vtpu_config.h /
+ * the tc_util feed — writer forces (seq | 1) odd before the payload and
+ * bumps to even after; readers retry on odd or changed seq. Writer
+ * exclusion is an open-time OFD write lock on the header range, never a
+ * hot-path lock.
+ */
+#ifndef VTPU_TELEMETRY_H_
+#define VTPU_TELEMETRY_H_
+
+#include <cstddef>
+#include <cstdint>
+
+namespace vtpu {
+
+constexpr uint32_t kStepRingMagic = 0x54535456;  // "VTST"
+constexpr uint32_t kStepRingVersion = 1;
+constexpr int kStepRingCapacity = 256;
+constexpr int kStepTraceIdLen = 48;
+
+// StepRecord.flags
+constexpr uint32_t kStepFlagCompile = 0x1;  // step paid a compile
+
+struct StepRingHeader {
+  uint32_t magic;
+  uint32_t version;
+  int32_t capacity;      // records in the ring (kStepRingCapacity)
+  int32_t record_size;   // sizeof(StepRecord)
+  int32_t writer_pid;
+  int32_t pad_;
+  uint64_t writes;       // total records ever published (ring head)
+  char trace_id[kStepTraceIdLen];  // vtrace join key, NUL-terminated
+};
+static_assert(sizeof(StepRingHeader) == 80, "StepRingHeader ABI size");
+static_assert(offsetof(StepRingHeader, writer_pid) == 16, "ABI");
+static_assert(offsetof(StepRingHeader, writes) == 24, "ABI");
+static_assert(offsetof(StepRingHeader, trace_id) == 32, "ABI");
+
+struct StepRecord {
+  uint64_t seq;          // per-record seqlock (odd = mid-write)
+  uint64_t index;        // monotone step index (slot = index % capacity)
+  uint64_t start_mono_ns;
+  uint64_t duration_ns;
+  uint64_t throttle_wait_ns;   // time stalled in the compute throttle
+  uint64_t hbm_highwater_bytes;
+  uint32_t flags;        // kStepFlag*
+  int32_t pad_;
+};
+static_assert(sizeof(StepRecord) == 56, "StepRecord ABI size");
+static_assert(offsetof(StepRecord, index) == 8, "ABI");
+static_assert(offsetof(StepRecord, duration_ns) == 24, "ABI");
+static_assert(offsetof(StepRecord, throttle_wait_ns) == 32, "ABI");
+static_assert(offsetof(StepRecord, hbm_highwater_bytes) == 40, "ABI");
+static_assert(offsetof(StepRecord, flags) == 48, "ABI");
+
+constexpr size_t kStepRingFileSize =
+    sizeof(StepRingHeader) + kStepRingCapacity * sizeof(StepRecord);
+
+}  // namespace vtpu
+
+#endif  // VTPU_TELEMETRY_H_

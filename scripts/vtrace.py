@@ -63,6 +63,11 @@ def main(argv: list[str] | None = None) -> int:
         prog="vtrace", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--spool-dir", default=consts.TRACE_DIR)
+    parser.add_argument("--steps-dir", default=consts.MANAGER_BASE_DIR,
+                        help="container-config root scanned for vttel "
+                             "step rings; --pod splices steady-state "
+                             "step stats onto the allocation timeline "
+                             "(default: %(default)s)")
     parser.add_argument("--pod", default="",
                         help="pod uid (or trace id) to reconstruct")
     parser.add_argument("--list", action="store_true", dest="list_pods",
@@ -93,12 +98,29 @@ def main(argv: list[str] | None = None) -> int:
                   f"{args.spool_dir} ({len(timelines)} pod(s) present)",
                   file=sys.stderr)
             return 1
+        # vttel splice: the rings carry the same trace id the timeline
+        # joins on, so the admission story and the steady-state step
+        # story print as one report (one directory pass matches either
+        # the trace id or the pod uid)
+        from vtpu_manager.telemetry.aggregate import step_stats_for_pod
+        steps = step_stats_for_pod(args.steps_dir, tl.trace_id,
+                                   tl.pod_uid or args.pod)
         if args.as_json:
             print(json.dumps({"timeline": tl.to_wire(),
-                              "critical_path": assemble.critical_path(tl)},
+                              "critical_path": assemble.critical_path(tl),
+                              "steps": steps},
                              indent=2))
         else:
             _print_timeline(tl)
+            for s in steps:
+                print(f"  steps [{s['container']}]: "
+                      f"{s['steps_total']} total "
+                      f"({s['steps_resident']} resident, "
+                      f"{s['compile_steps']} compile)  "
+                      f"p50 {s['p50_s'] * 1000:.3f} ms  "
+                      f"p99 {s['p99_s'] * 1000:.3f} ms  "
+                      f"throttle-wait {s['throttle_wait_frac'] * 100:.1f}%"
+                      f"  hbm-hw {s['hbm_highwater_bytes']}")
         return 0
 
     if args.list_pods:

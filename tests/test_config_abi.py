@@ -21,6 +21,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBE_SRC = r"""
 #include <cstdio>
 #include "vtpu_config.h"
+#include "vtpu_telemetry.h"
 using namespace vtpu;
 int main() {
   printf("device_size %zu\n", sizeof(VtpuDevice));
@@ -54,6 +55,21 @@ int main() {
   printf("tc_cal.excess_us %zu\n", offsetof(TcCalibration, excess_us));
   printf("vmem_file_size %zu\n", sizeof(VmemFile));
   printf("vmem_entry_size %zu\n", sizeof(VmemEntry));
+  printf("step_header_size %zu\n", sizeof(StepRingHeader));
+  printf("step_record_size %zu\n", sizeof(StepRecord));
+  printf("step_file_size %zu\n", kStepRingFileSize);
+  printf("sh.writer_pid %zu\n", offsetof(StepRingHeader, writer_pid));
+  printf("sh.writes %zu\n", offsetof(StepRingHeader, writes));
+  printf("sh.trace_id %zu\n", offsetof(StepRingHeader, trace_id));
+  printf("sr.seq %zu\n", offsetof(StepRecord, seq));
+  printf("sr.index %zu\n", offsetof(StepRecord, index));
+  printf("sr.start_mono_ns %zu\n", offsetof(StepRecord, start_mono_ns));
+  printf("sr.duration_ns %zu\n", offsetof(StepRecord, duration_ns));
+  printf("sr.throttle_wait_ns %zu\n",
+         offsetof(StepRecord, throttle_wait_ns));
+  printf("sr.hbm_highwater_bytes %zu\n",
+         offsetof(StepRecord, hbm_highwater_bytes));
+  printf("sr.flags %zu\n", offsetof(StepRecord, flags));
   return 0;
 }
 """
@@ -98,6 +114,20 @@ class TestCrossLanguageLayout:
     def test_header_offsets(self, cxx_layout):
         for name, off in vc.HEADER_OFFSETS.items():
             assert int(cxx_layout[f"cfg.{name}"]) == off, name
+
+    def test_step_ring_layout(self, cxx_layout):
+        """vttel: Python writer (telemetry/stepring.py) and the C++
+        mirror (vtpu_telemetry.h) agree byte-for-byte — the shim's
+        Execute hook must be able to write records the monitor reads."""
+        from vtpu_manager.telemetry import stepring
+        assert int(cxx_layout["step_header_size"]) == stepring.HEADER_SIZE
+        assert int(cxx_layout["step_record_size"]) == stepring.RECORD_SIZE
+        assert int(cxx_layout["step_file_size"]) == stepring.FILE_SIZE
+        for name in ("writer_pid", "writes", "trace_id"):
+            assert int(cxx_layout[f"sh.{name}"]) == \
+                stepring.HEADER_OFFSETS[name], name
+        for name, off in stepring.RECORD_OFFSETS.items():
+            assert int(cxx_layout[f"sr.{name}"]) == off, name
 
 
 class TestVtpuConfigRoundtrip:

@@ -333,6 +333,91 @@ class TestDraGrpc:
         assert state.prepared_uids() == set()
 
 
+class TestClaimSourceResilience:
+    """ROADMAP vtfault follow-up: the DRA plugin's claim fetches route
+    through KubeResilience — transient failures retry under a deadline,
+    a sustained outage opens the breaker, and 404 stays a result."""
+
+    class _FlakyClient:
+        def __init__(self, errors):
+            self.errors = list(errors)   # per-call: exception or claim
+            self.calls = 0
+
+        def get_resourceclaim(self, namespace, name):
+            self.calls += 1
+            step = self.errors.pop(0)
+            if isinstance(step, BaseException):
+                raise step
+            return step
+
+    @staticmethod
+    def _fast_resilience(threshold=2):
+        from random import Random
+
+        from vtpu_manager.resilience.policy import (CircuitBreaker,
+                                                    KubeResilience,
+                                                    RetryPolicy)
+        return KubeResilience(
+            policy=RetryPolicy(max_attempts=2, deadline_s=60.0,
+                               rng=Random(1), sleep=lambda s: None),
+            breaker=CircuitBreaker(name="dra.claims",
+                                   failure_threshold=threshold))
+
+    def test_transient_error_retries_then_succeeds(self):
+        from vtpu_manager.client.kube import KubeError
+        claim = allocated_claim()
+        client = self._FlakyClient([KubeError(503, "blip"), claim])
+        source = ClaimSource(client,
+                             resilience=self._fast_resilience())
+        got = source.get("claim-1", "c1", "ml")
+        assert got is claim
+        assert client.calls == 2
+        assert source.resilience.breaker.state == "closed"
+
+    def test_404_is_a_result_not_a_breaker_failure(self):
+        from vtpu_manager.client.kube import KubeError
+        client = self._FlakyClient(
+            [KubeError(404, "gone")] * 5)
+        source = ClaimSource(client,
+                             resilience=self._fast_resilience())
+        for _ in range(5):
+            assert source.get("claim-1", "c1", "ml") is None
+        assert source.resilience.breaker.state == "closed"
+
+    def test_breaker_opens_and_rejects_locally(self):
+        from vtpu_manager.client.kube import KubeError
+        from vtpu_manager.kubeletplugin.driver import ClaimLookupError
+        client = self._FlakyClient([KubeError(503, "down")] * 10)
+        source = ClaimSource(client,
+                             resilience=self._fast_resilience(threshold=2))
+        for _ in range(2):        # 2 exhausted retry loops open it
+            with pytest.raises(ClaimLookupError):
+                source.get("claim-1", "c1", "ml")
+        assert source.resilience.breaker.state == "open"
+        calls_before = client.calls
+        with pytest.raises(ClaimLookupError):
+            source.get("claim-1", "c1", "ml")
+        # rejected locally: no more doomed GETs against the apiserver
+        assert client.calls == calls_before
+
+    def test_breaker_open_surfaces_transient_prepare_error(self, state):
+        """The kubelet sees a transient per-claim error (it retries),
+        never a misleading not-found, while the circuit is open."""
+        from vtpu_manager.client.kube import KubeError
+        client = self._FlakyClient([KubeError(503, "down")] * 10)
+        source = ClaimSource(client,
+                             resilience=self._fast_resilience(threshold=1))
+        driver = DraDriver("node-1", [], source, state=state)
+        resp = driver.node_prepare(pb.NodePrepareResourcesRequest(claims=[
+            pb.Claim(uid="claim-1", name="c1", namespace="ml")]))
+        assert "transient" in resp.claims["claim-1"].error
+        assert source.resilience.breaker.state == "open"
+        resp2 = driver.node_prepare(pb.NodePrepareResourcesRequest(claims=[
+            pb.Claim(uid="claim-1", name="c1", namespace="ml")]))
+        assert "transient" in resp2.claims["claim-1"].error
+        assert "not found" not in resp2.claims["claim-1"].error
+
+
 class TestClaimOwnership:
     def test_claim_uids_for_pod_via_reserved_for(self, state, tmp_path):
         claim = allocated_claim()

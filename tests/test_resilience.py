@@ -313,6 +313,46 @@ class TestFailpoints:
         with pytest.raises(ValueError):
             failpoints.arm_spec("kube.request=error(503,bogus=1)")
 
+    def test_error_carries_retry_after(self):
+        """ROADMAP vtfault follow-up: injected KubeErrors can carry the
+        Retry-After pacing hint real 429s send, so chaos runs exercise
+        the RetryPolicy floor branch."""
+        failpoints.enable(seed=1)
+        failpoints.arm("kube.request", "error", status=429,
+                       retry_after=7.5)
+        with pytest.raises(KubeError) as exc_info:
+            failpoints.fire("kube.request", op="list_pods")
+        assert exc_info.value.status == 429
+        assert exc_info.value.retry_after == 7.5
+
+    def test_arm_spec_retry_after(self):
+        failpoints.enable(seed=1)
+        failpoints.arm_spec("kube.request=error(429,retry_after=2.5)")
+        spec = failpoints._ARMED["kube.request"]
+        assert (spec.status, spec.retry_after) == (429, 2.5)
+        # retry_after only makes sense on the error action
+        with pytest.raises(ValueError):
+            failpoints.arm_spec("flock.acquire=latency(0.1,retry_after=1)")
+
+    def test_injected_retry_after_floors_policy_backoff(self):
+        """End to end through RetryPolicy: the injected hint must floor
+        every retry delay exactly like a real Retry-After header."""
+        failpoints.enable(seed=3)
+        failpoints.arm("kube.request", "error", status=429,
+                       retry_after=4.0, count=2)
+        sleeps: list[float] = []
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                             max_delay_s=0.05, deadline_s=60.0,
+                             rng=Random(7), sleep=sleeps.append)
+
+        def op():
+            failpoints.fire("kube.request", op="get_pod")
+            return "ok"
+
+        assert policy.run(op, op="retry_after.e2e") == "ok"
+        assert len(sleeps) == 2                  # two injected 429s
+        assert all(delay >= 4.0 for delay in sleeps)
+
     def test_fires_recorded_as_vtrace_events(self, tmp_path):
         from vtpu_manager import trace
         trace.configure("chaos", str(tmp_path), sampling_rate=1.0)

@@ -1,0 +1,921 @@
+"""vttel: step ring ABI + aggregation + pressure + the hermetic e2e.
+
+Covers the seqlock ring (torn-read torture with a real writer
+subprocess), the gate-off zero-cost contract, the collector's per-pod
+histogram fold, the pressure annotation round trip into both scheduler
+scoring paths, and the full fake-clientset pipeline: pod allocated ->
+tenant writes steps via runtime/client -> monitor /metrics shows
+matching per-pod series joined to the vtrace timeline by trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vtpu_manager.runtime import client as rc
+from vtpu_manager.telemetry import aggregate, pressure, stepring
+from vtpu_manager.util import consts
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+POD_UID = "11111111-2222-3333-4444-555555555555"
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_between_tests():
+    yield
+    rc._reset_step_telemetry()
+
+
+def _mk_ring_dir(base, pod_uid, container):
+    d = os.path.join(base, f"{pod_uid}_{container}",
+                     consts.TELEMETRY_SUBDIR)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, consts.STEP_RING_NAME)
+
+
+# ---------------------------------------------------------------------------
+# ring ABI
+# ---------------------------------------------------------------------------
+
+class TestStepRing:
+    def test_roundtrip_and_cursor(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path, trace_id="tid-1")
+        for i in range(10):
+            w.record(duration_ns=1_000_000 + i, throttle_wait_ns=i * 3,
+                     hbm_highwater_bytes=i * 7, compiled=(i == 0))
+        r = stepring.StepRingReader(path)
+        recs, cursor, dropped = r.poll(0)
+        assert cursor == 10 and dropped == 0
+        assert [x.index for x in recs] == list(range(10))
+        assert recs[0].compiled and not recs[1].compiled
+        assert all(x.throttle_wait_ns == x.index * 3 for x in recs)
+        assert r.trace_id == "tid-1"
+        # cursor tails: nothing new -> nothing returned, cursor monotone
+        assert r.poll(cursor) == ([], 10, 0)
+        w.record(5)
+        recs2, cursor2, _ = r.poll(cursor)
+        assert [x.index for x in recs2] == [10] and cursor2 == 11
+        w.close()
+        r.close()
+
+    def test_wraparound_counts_overwritten_as_drops(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        n = stepring.RING_CAPACITY + 40
+        for i in range(n):
+            w.record(duration_ns=i)
+        r = stepring.StepRingReader(path)
+        recs, cursor, dropped = r.poll(0)
+        assert cursor == n
+        assert dropped == 40
+        assert len(recs) == stepring.RING_CAPACITY
+        assert recs[0].index == 40 and recs[-1].index == n - 1
+        w.close()
+        r.close()
+
+    def test_writer_restart_continues_sequence(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path, trace_id="t")
+        for _ in range(5):
+            w.record(duration_ns=1)
+        w.close()
+        w2 = stepring.StepRingWriter(path)
+        assert w2.writes == 5
+        w2.record(duration_ns=2)
+        r = stepring.StepRingReader(path)
+        recs, cursor, dropped = r.poll(0)
+        assert cursor == 6 and dropped == 0
+        assert [x.index for x in recs] == list(range(6))
+        assert r.trace_id == "t"      # restart keeps the join key
+        w2.close()
+        r.close()
+
+    def test_crashed_writer_odd_seq_never_validates(self, tmp_path):
+        """A record whose seq a crashed writer left odd must read as
+        mid-write (skipped/dropped), and the restarted writer's `seq|1`
+        bracket must recover the slot."""
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        w.record(duration_ns=111)
+        w.close()
+        # simulate the crash: force slot 0's seq odd
+        with open(path, "r+b") as f:
+            f.seek(stepring.record_offset(0))
+            f.write(struct.pack("<Q", 7))
+        r = stepring.StepRingReader(path)
+        assert r.read_record(0) is None
+        recs, cursor, dropped = r.poll(0)
+        assert recs == [] and cursor == 1 and dropped == 1
+        # restarted writer wraps all the way around back to slot 0
+        w2 = stepring.StepRingWriter(path)
+        for i in range(stepring.RING_CAPACITY):
+            w2.record(duration_ns=i)
+        rec = r.read_record(stepring.RING_CAPACITY)  # slot 0, lap 1
+        assert rec is not None and rec.duration_ns == \
+            stepring.RING_CAPACITY - 1
+        w2.close()
+        r.close()
+
+    def test_second_writer_excluded(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        # the open-time OFD lock rejects a concurrent second writer from
+        # another open file description — simulate via a fresh writer in
+        # a subprocess (same-process OFD locks on separate fds conflict)
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, sys.argv[2])\n"
+             "from vtpu_manager.telemetry import stepring\n"
+             "from vtpu_manager.util.flock import LockTimeout\n"
+             "try:\n"
+             "    stepring.StepRingWriter(sys.argv[1], "
+             "lock_timeout_s=0.2)\n"
+             "except LockTimeout:\n"
+             "    sys.exit(42)\n"
+             "sys.exit(0)\n",
+             path, REPO_ROOT],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 42, proc.stderr
+        w.close()
+
+    def test_unstable_head_skips_poll_instead_of_poisoning_cursor(
+            self, tmp_path, monkeypatch):
+        """Review finding: a head double-read that never stabilizes must
+        skip the poll (cursor unchanged), never bound the scan with a
+        torn value the monotone cursor could get stuck past."""
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        w.record(duration_ns=1)
+        r = stepring.StepRingReader(path)
+        monkeypatch.setattr(r, "_writes", lambda: None)
+        assert r.poll(0) == ([], 0, 0)
+        monkeypatch.undo()
+        recs, cursor, dropped = r.poll(0)      # next poll recovers
+        assert len(recs) == 1 and cursor == 1 and dropped == 0
+        w.close()
+        r.close()
+
+    def test_tenant_controlled_trace_id_is_sanitized(self, tmp_path):
+        """Review finding: the ring is tenant-writable and its trace id
+        lands in a Prometheus label — quotes/newlines must not survive
+        into the exposition (metric injection)."""
+        path = str(tmp_path / "ring")
+        evil = '"} 1\nvtpu_node_pressure_throttle_frac{node="n1"} 1'
+        w = stepring.StepRingWriter(path, trace_id=evil)
+        w.record(duration_ns=1)
+        w.close()
+        r = stepring.StepRingReader(path)
+        assert '"' not in r.trace_id
+        assert "\n" not in r.trace_id
+        assert "{" not in r.trace_id and "}" not in r.trace_id
+        r.close()
+        # benign ids pass through untouched
+        w2 = stepring.StepRingWriter(str(tmp_path / "r2"),
+                                     trace_id="a1b2-c3.d_4")
+        w2.close()
+        r2 = stepring.StepRingReader(str(tmp_path / "r2"))
+        assert r2.trace_id == "a1b2-c3.d_4"
+        r2.close()
+
+    def test_recreated_ring_resets_cursor_instead_of_freezing(
+            self, tmp_path):
+        """Review finding: a deleted+recreated ring (head reset to 0)
+        must restart the tail, not freeze the tenant's telemetry behind
+        a stale high cursor forever."""
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        for _ in range(10):
+            w.record(duration_ns=1)
+        r = stepring.StepRingReader(path)
+        _, cursor, _ = r.poll(0)
+        assert cursor == 10
+        r.close()
+        w.close()
+        os.unlink(path)
+        w2 = stepring.StepRingWriter(path)        # fresh generation
+        w2.record(duration_ns=7)
+        r2 = stepring.StepRingReader(path)
+        recs, new_cursor, dropped = r2.poll(cursor)   # stale cursor 10
+        assert [x.index for x in recs] == [0]
+        assert new_cursor == 1
+        w2.close()
+        r2.close()
+
+    def test_layout_tables_match_struct(self):
+        """The committed offsets (consumed by the C++ mirror's
+        static_asserts and the ABI golden) match the live fmt strings."""
+        assert stepring.HEADER_SIZE == 80
+        assert stepring.RECORD_SIZE == 56
+        assert stepring.HEADER_OFFSETS["writes"] == 24
+        assert stepring.HEADER_OFFSETS["trace_id"] == 32
+        assert stepring.RECORD_OFFSETS["flags"] == 48
+        assert stepring.FILE_SIZE == \
+            stepring.HEADER_SIZE + \
+            stepring.RING_CAPACITY * stepring.RECORD_SIZE
+
+
+_TORTURE_WRITER = """
+import sys, time
+sys.path.insert(0, sys.argv[3])
+from vtpu_manager.telemetry import stepring
+w = stepring.StepRingWriter(sys.argv[1], trace_id="torture")
+n = int(sys.argv[2])
+for i in range(n):
+    # self-checking payload: every field is a known function of the
+    # index, so ANY torn read the reader validates is detectable
+    w.record(duration_ns=i * 1000 + 1, throttle_wait_ns=i * 3,
+             hbm_highwater_bytes=i * 7, compiled=(i % 2 == 0),
+             start_mono_ns=i * 11)
+print("DONE", flush=True)
+w.close()
+"""
+
+
+class TestTortureConcurrentWriterReader:
+    def test_no_torn_reads_and_monotone_cursor(self, tmp_path):
+        """Writer subprocess hammers the ring while this process tails
+        it: every validated record must be internally consistent (zero
+        torn reads) and the cursor must never regress."""
+        path = str(tmp_path / "ring")
+        n = 20000
+        # pre-create so the reader can open immediately
+        stepring.StepRingWriter(path).close()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _TORTURE_WRITER, path, str(n),
+             REPO_ROOT],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            r = stepring.StepRingReader(path)
+            cursor = 0
+            seen = 0
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                recs, new_cursor, _dropped = r.poll(cursor)
+                assert new_cursor >= cursor, "cursor regressed"
+                for rec in recs:
+                    assert rec.duration_ns == rec.index * 1000 + 1, \
+                        f"torn read at {rec.index}: {rec}"
+                    assert rec.throttle_wait_ns == rec.index * 3
+                    assert rec.hbm_highwater_bytes == rec.index * 7
+                    assert rec.start_mono_ns == rec.index * 11
+                    assert rec.compiled == (rec.index % 2 == 0)
+                seen += len(recs)
+                cursor = new_cursor
+                if cursor >= n and proc.poll() is not None:
+                    break
+            assert cursor == n
+            assert seen > 0
+            r.close()
+        finally:
+            proc.wait(timeout=120)
+        assert proc.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# gate-off contract
+# ---------------------------------------------------------------------------
+
+class TestGateOff:
+    def test_no_env_no_writer_no_file(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(consts.ENV_STEP_TELEMETRY, raising=False)
+        monkeypatch.setenv(consts.ENV_STEP_RING_PATH,
+                           str(tmp_path / "ring"))
+        rc._reset_step_telemetry()
+        assert rc.step_telemetry() is None
+        assert not os.path.exists(str(tmp_path / "ring"))
+        # the cached path: no env reads after the first check
+        monkeypatch.setenv(consts.ENV_STEP_TELEMETRY, "true")
+        assert rc.step_telemetry() is None     # still cached off
+
+    def test_off_cost_is_one_branch(self, monkeypatch):
+        """After the first call the gate-off path must touch no env and
+        open no files — the same contract the trace null-span has."""
+        monkeypatch.delenv(consts.ENV_STEP_TELEMETRY, raising=False)
+        rc._reset_step_telemetry()
+        rc.step_telemetry()
+        before = dict(os.environ)
+        calls = []
+        real_get = os.environ.get
+
+        def counting_get(*a, **k):
+            calls.append(a)
+            return real_get(*a, **k)
+
+        monkeypatch.setattr(os.environ.__class__, "get", counting_get)
+        try:
+            for _ in range(100):
+                assert rc.step_telemetry() is None
+        finally:
+            monkeypatch.undo()
+        assert calls == []
+        assert dict(os.environ) == before
+
+    def test_env_arms_writer(self, tmp_path, monkeypatch):
+        ring = str(tmp_path / "tel" / "ring")
+        monkeypatch.setenv(consts.ENV_STEP_TELEMETRY, "true")
+        monkeypatch.setenv(consts.ENV_STEP_RING_PATH, ring)
+        monkeypatch.setenv(consts.ENV_TRACE_ID, "trace-77")
+        rc._reset_step_telemetry()
+        w = rc.step_telemetry()
+        assert w is not None
+        w.record(duration_ns=123)
+        assert rc.step_telemetry() is w        # cached
+        r = stepring.StepRingReader(ring)
+        assert r.trace_id == "trace-77"
+        recs, _, _ = r.poll(0)
+        assert len(recs) == 1
+        r.close()
+
+    def test_broken_mount_degrades_to_none(self, tmp_path, monkeypatch):
+        target = tmp_path / "noperm"
+        target.mkdir()
+        target.chmod(0o500)
+        monkeypatch.setenv(consts.ENV_STEP_TELEMETRY, "true")
+        monkeypatch.setenv(consts.ENV_STEP_RING_PATH,
+                           str(target / "sub" / "ring"))
+        rc._reset_step_telemetry()
+        if os.geteuid() == 0:
+            pytest.skip("running as root; chmod cannot deny")
+        assert rc.step_telemetry() is None
+
+
+# ---------------------------------------------------------------------------
+# aggregation + pressure
+# ---------------------------------------------------------------------------
+
+class TestAggregate:
+    def test_fold_and_render(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        ring = _mk_ring_dir(base, "uid-1", "main")
+        w = stepring.StepRingWriter(ring, trace_id="tr-1")
+        for i in range(20):
+            w.record(duration_ns=10_000_000,            # 10 ms steps
+                     throttle_wait_ns=5_000_000,        # half stalled
+                     hbm_highwater_bytes=1 << 30,
+                     compiled=(i == 0))
+        agg = aggregate.TenantStepTelemetry(base)
+        agg.scan()
+        text = agg.render("n1")
+        assert ('vtpu_tenant_step_duration_seconds_count{node="n1",'
+                'pod_uid="uid-1",container="main"} 20') in text
+        assert ('vtpu_tenant_step_duration_seconds_sum{node="n1",'
+                'pod_uid="uid-1",container="main"} 0.2') in text
+        assert ('vtpu_tenant_throttle_wait_seconds_count{node="n1",'
+                'pod_uid="uid-1",container="main"} 20') in text
+        assert ('vtpu_tenant_throttle_wait_fraction{node="n1",'
+                'pod_uid="uid-1",container="main"} 0.5') in text
+        assert ('vtpu_tenant_step_ring_dropped_total{node="n1",'
+                'pod_uid="uid-1",container="main"} 0') in text
+        assert 'trace_id="tr-1"' in text
+        # histograms are CUMULATIVE across scans: ring drained twice
+        # must not double-count
+        agg.scan()
+        assert ('_count{node="n1",pod_uid="uid-1",container="main"} 20'
+                in agg.render("n1"))
+        w.record(duration_ns=1)
+        agg.scan()
+        assert ('vtpu_tenant_step_duration_seconds_count{node="n1",'
+                'pod_uid="uid-1",container="main"} 21') in agg.render("n1")
+        w.close()
+
+    def test_overwrite_drops_surface(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        ring = _mk_ring_dir(base, "uid-1", "main")
+        w = stepring.StepRingWriter(ring)
+        agg = aggregate.TenantStepTelemetry(base)
+        agg.scan()                       # prime: tail from ring birth
+        for _ in range(stepring.RING_CAPACITY + 30):
+            w.record(duration_ns=1000)
+        agg.scan()
+        assert ('vtpu_tenant_step_ring_dropped_total{node="n1",'
+                'pod_uid="uid-1",container="main"} 30') in agg.render("n1")
+        w.close()
+
+    def test_steps_per_second_counts_lapped_records(self):
+        """Review finding: the rate gauge must count dropped (lapped)
+        records too — a tenant faster than RING_CAPACITY per scrape
+        interval otherwise reads slower than it is."""
+        state = aggregate._TenantState("u", "c")
+        state.fold([], 0, now_monotonic=100.0)       # prime the clock
+        recs = [stepring.StepRecord(i, 0, 1000)
+                for i in range(stepring.RING_CAPACITY)]
+        state.fold(recs, 144, now_monotonic=101.0)   # 1 s window
+        assert state.window_rate == pytest.approx(
+            stepring.RING_CAPACITY + 144)
+        assert state.dropped == 144
+
+    def test_first_poll_baselines_history_not_drops(self, tmp_path):
+        """Review finding: a monitor restart against a long-running
+        tenant must not charge already-overwritten history as reader
+        lag — that would fire data-loss alerts on every restart."""
+        base = str(tmp_path / "mgr")
+        w = stepring.StepRingWriter(_mk_ring_dir(base, "uid-1", "main"))
+        for _ in range(stepring.RING_CAPACITY + 500):
+            w.record(duration_ns=1000)
+        agg = aggregate.TenantStepTelemetry(base)   # "restarted" monitor
+        agg.scan()
+        assert ('vtpu_tenant_step_ring_dropped_total{node="n1",'
+                'pod_uid="uid-1",container="main"} 0') in agg.render("n1")
+        # real lag AFTER the baseline still counts
+        for _ in range(stepring.RING_CAPACITY + 40):
+            w.record(duration_ns=1000)
+        agg.scan()
+        assert ('vtpu_tenant_step_ring_dropped_total{node="n1",'
+                'pod_uid="uid-1",container="main"} 40') in agg.render("n1")
+        w.close()
+
+    def test_pressure_rollup(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        for uid, frac in (("uid-a", 0.25), ("uid-b", 0.75)):
+            w = stepring.StepRingWriter(_mk_ring_dir(base, uid, "main"))
+            for _ in range(5):
+                w.record(duration_ns=1_000_000,
+                         throttle_wait_ns=int(1_000_000 * frac),
+                         hbm_highwater_bytes=100)
+            w.close()
+        agg = aggregate.TenantStepTelemetry(base)
+        agg.scan()
+        frac, headroom = agg.pressure(node_hbm_total=1000)
+        assert frac == pytest.approx(0.75)
+        assert headroom == 800            # 1000 - 2 tenants * 100
+        text = agg.render_pressure("n1", 1000)
+        assert 'vtpu_node_pressure_throttle_frac{node="n1"} 0.75' in text
+        assert ('vtpu_node_pressure_hbm_headroom_bytes{node="n1"} 800'
+                in text)
+
+    def test_step_stats_empty_key_matches_nothing(self, tmp_path):
+        """Review finding: rings written without a trace id store "" —
+        an empty lookup key must return no stats, not every untraced
+        tenant's."""
+        base = str(tmp_path / "mgr")
+        w = stepring.StepRingWriter(_mk_ring_dir(base, "uid-1", "main"))
+        w.record(duration_ns=1)
+        w.close()
+        assert aggregate.step_stats_for_pod(base, "") == []
+        assert aggregate.step_stats_for_pod(base, "uid-1")
+        assert aggregate.step_stats_for_pod(base, "uid-other") == []
+
+    def test_vanished_tenant_series_removed(self, tmp_path):
+        import shutil
+        base = str(tmp_path / "mgr")
+        ring = _mk_ring_dir(base, "uid-1", "main")
+        w = stepring.StepRingWriter(ring)
+        w.record(duration_ns=1)
+        w.close()
+        agg = aggregate.TenantStepTelemetry(base)
+        agg.scan()
+        assert 'pod_uid="uid-1"' in agg.render("n1")
+        shutil.rmtree(os.path.join(base, "uid-1_main"))
+        agg.scan()
+        assert 'pod_uid="uid-1"' not in agg.render("n1")
+
+
+class TestPressurePublisher:
+    def test_publish_once_patches_node_annotation(self, tmp_path):
+        from random import Random
+
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.resilience.policy import RetryPolicy
+        base = str(tmp_path / "mgr")
+        w = stepring.StepRingWriter(_mk_ring_dir(base, "uid-1", "main"))
+        for _ in range(4):
+            w.record(duration_ns=1_000_000, throttle_wait_ns=400_000,
+                     hbm_highwater_bytes=100)
+        w.close()
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "n1", "annotations": {}}})
+        pub = pressure.PressurePublisher(
+            client, "n1", aggregate.TenantStepTelemetry(base),
+            node_hbm_total=1000,
+            policy=RetryPolicy(rng=Random(1), sleep=lambda s: None))
+        published = pub.publish_once()
+        assert published.throttle_frac == pytest.approx(0.4)
+        raw = client.get_node("n1")["metadata"]["annotations"][
+            consts.node_pressure_annotation()]
+        got = pressure.parse_pressure(raw)
+        assert got is not None
+        assert got.throttle_frac == pytest.approx(0.4)
+        assert got.hbm_headroom_bytes == 900
+
+
+class TestPressureCodec:
+    def test_roundtrip(self):
+        p = pressure.NodePressure(0.42, 12345, ts=1000.0)
+        got = pressure.parse_pressure(p.encode(), now=1001.0)
+        assert got is not None
+        assert got.throttle_frac == pytest.approx(0.42)
+        assert got.hbm_headroom_bytes == 12345
+
+    def test_stale_and_garbage_decay_to_none(self):
+        p = pressure.NodePressure(0.9, 1, ts=1000.0)
+        assert pressure.parse_pressure(p.encode(), now=1000.0 + 121) is None
+        assert pressure.parse_pressure(None) is None
+        assert pressure.parse_pressure("") is None
+        assert pressure.parse_pressure("not-a-pressure") is None
+        assert pressure.parse_pressure("0.5:abc@10", now=11.0) is None
+        # review finding: "nan" parses as float but poisons min/max and
+        # every score comparison downstream — must read as no-signal
+        assert pressure.parse_pressure("nan:0@10", now=11.0) is None
+        assert pressure.parse_pressure("inf:0@10", now=11.0) is None
+        assert pressure.parse_pressure("0.5:0@nan", now=11.0) is None
+        # a far-future stamp is no-signal; small skew (encode rounding,
+        # NTP drift between node and scheduler) is tolerated
+        assert pressure.parse_pressure(p.encode(), now=990.0) is None
+        assert pressure.parse_pressure(p.encode(), now=999.9) is not None
+
+    def test_penalty_clamped(self):
+        raw = pressure.NodePressure(7.0, 0, ts=50.0).encode()
+        got = pressure.parse_pressure(raw, now=51.0)
+        assert got.throttle_frac == 1.0
+        assert pressure.pressure_penalty(got, now=51.0) == \
+            pressure.PRESSURE_SCORE_WEIGHT
+        assert pressure.pressure_penalty(None) == 0.0
+
+    def test_penalty_rejudges_staleness_at_use_time(self):
+        """Review finding: the snapshot path caches the parsed pressure
+        on the NodeEntry and a dead publisher emits no further node
+        events — the penalty itself must decay, not only the parse."""
+        p = pressure.NodePressure(1.0, 0, ts=1000.0)
+        assert pressure.pressure_penalty(p, now=1010.0) == \
+            pressure.PRESSURE_SCORE_WEIGHT
+        assert pressure.pressure_penalty(
+            p, now=1000.0 + pressure.MAX_PRESSURE_AGE_S + 1) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# scheduler ingest (both scoring paths)
+# ---------------------------------------------------------------------------
+
+def _two_node_cluster(pressured: str):
+    from vtpu_manager.client.fake import FakeKubeClient
+    from vtpu_manager.config.node_config import NodeConfig
+    from vtpu_manager.manager.device_manager import DeviceManager
+    from vtpu_manager.tpu.discovery import FakeBackend
+
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in ("node-a", "node-b"):
+        client.add_node({"metadata": {"name": name, "annotations": {}}})
+        mgr = DeviceManager(name, client,
+                            node_config=NodeConfig(device_split_count=4),
+                            backends=[FakeBackend(n_chips=2)])
+        mgr.init_devices()
+        mgr.register_node()
+    if pressured:
+        ann = pressure.NodePressure(0.9, 0, ts=time.time()).encode()
+        client.patch_node_annotations(
+            pressured, {consts.node_pressure_annotation(): ann})
+    return client
+
+
+def _vtpu_pod(uid="p-uid-1", name="p1"):
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": {}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): 25,
+                consts.vtpu_memory_resource(): 1024}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+class TestSchedulerPressureHint:
+    @staticmethod
+    def _default_winner(make_filter):
+        """Learn the tie-break winner on the unpressured twin cluster so
+        the assertion tests the penalty, not the tie-break order."""
+        client = _two_node_cluster(pressured="")
+        result = make_filter(client).filter({"Pod": _vtpu_pod()})
+        assert not result.error, result.error
+        return result.node_names[0]
+
+    def test_ttl_path_prefers_unpressured_node(self):
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        winner = self._default_winner(FilterPredicate)
+        other = "node-b" if winner == "node-a" else "node-a"
+        client = _two_node_cluster(pressured=winner)
+        result = FilterPredicate(client).filter({"Pod": _vtpu_pod()})
+        assert not result.error, result.error
+        assert result.node_names == [other]
+
+    def test_snapshot_path_prefers_unpressured_node(self):
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+
+        def make(client):
+            snap = ClusterSnapshot(client)
+            snap.start()
+            return FilterPredicate(client, snapshot=snap)
+
+        winner = self._default_winner(make)
+        other = "node-b" if winner == "node-a" else "node-a"
+        client = _two_node_cluster(pressured=winner)
+        result = make(client).filter({"Pod": _vtpu_pod()})
+        assert not result.error, result.error
+        assert result.node_names == [other]
+
+    def test_pressure_never_vetoes_the_only_fit(self):
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.config.node_config import NodeConfig
+        from vtpu_manager.manager.device_manager import DeviceManager
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.tpu.discovery import FakeBackend
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-a",
+                                      "annotations": {}}})
+        mgr = DeviceManager("node-a", client,
+                            node_config=NodeConfig(device_split_count=4),
+                            backends=[FakeBackend(n_chips=2)])
+        mgr.init_devices()
+        mgr.register_node()
+        ann = pressure.NodePressure(1.0, 0, ts=time.time()).encode()
+        client.patch_node_annotations(
+            "node-a", {consts.node_pressure_annotation(): ann})
+        result = FilterPredicate(client).filter({"Pod": _vtpu_pod()})
+        assert not result.error, result.error
+        assert result.node_names == ["node-a"]
+
+    def test_stale_pressure_ignored(self):
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        client = _two_node_cluster(pressured="node-a")
+        stale = pressure.NodePressure(0.9, 0,
+                                      ts=time.time() - 3600).encode()
+        client.patch_node_annotations(
+            "node-a", {consts.node_pressure_annotation(): stale})
+        result = FilterPredicate(client).filter({"Pod": _vtpu_pod()})
+        assert not result.error
+        # stale signal: binpack tie-break decides, not the annotation —
+        # both nodes identical, so either is acceptable; assert only
+        # that scheduling succeeded and no crash on the stale parse
+        assert result.node_names
+
+
+# ---------------------------------------------------------------------------
+# collector integration + self-observability
+# ---------------------------------------------------------------------------
+
+class TestCollector:
+    def test_rings_surface_on_metrics(self, tmp_path):
+        from vtpu_manager.device.types import fake_chip
+        from vtpu_manager.metrics.collector import NodeCollector
+        base = str(tmp_path / "mgr")
+        w = stepring.StepRingWriter(_mk_ring_dir(base, "uid-1", "main"),
+                                    trace_id="tr-9")
+        for _ in range(7):
+            w.record(duration_ns=2_000_000, throttle_wait_ns=1_000_000,
+                     hbm_highwater_bytes=4096)
+        w.close()
+        chips = [fake_chip(0)]
+        collector = NodeCollector("n1", chips, base_dir=base,
+                                  tc_path="/nonexistent",
+                                  vmem_path="/nonexistent")
+        text = collector.render()
+        assert ('vtpu_tenant_step_duration_seconds_count{node="n1",'
+                'pod_uid="uid-1",container="main"} 7') in text
+        assert 'trace_id="tr-9"' in text
+        assert 'vtpu_node_pressure_throttle_frac{node="n1"} 0.5' in text
+        headroom = sum(c.memory for c in chips) - 4096
+        assert (f'vtpu_node_pressure_hbm_headroom_bytes{{node="n1"}} '
+                f"{headroom}") in text
+
+    def test_self_observability_gauges(self, tmp_path):
+        from vtpu_manager.metrics.collector import NodeCollector
+        collector = NodeCollector("n1", [], base_dir=str(tmp_path / "x"),
+                                  tc_path="/nonexistent",
+                                  vmem_path="/nonexistent")
+        text = collector.render()
+        dur = [line for line in text.splitlines()
+               if line.startswith("vtpu_node_scrape_duration_seconds{")]
+        assert dur and float(dur[0].rsplit(" ", 1)[1]) >= 0
+        # absent feeds are normal, not errors
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="tc_util"} '
+                "0.0") in text
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="vmem"} 0.0'
+                in text)
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="telemetry"}'
+                " 0.0") in text
+
+    def test_wedged_feed_raises_error_gauge(self, tmp_path):
+        from vtpu_manager.metrics.collector import NodeCollector
+        bad_tc = tmp_path / "tc.config"
+        bad_tc.write_bytes(b"garbage-not-a-feed")
+        bad_vmem = tmp_path / "vmem.config"
+        bad_vmem.write_bytes(b"also-garbage")
+        collector = NodeCollector("n1", [], base_dir=str(tmp_path / "x"),
+                                  tc_path=str(bad_tc),
+                                  vmem_path=str(bad_vmem))
+        text = collector.render()
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="tc_util"} '
+                "1.0") in text
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="vmem"} 1.0'
+                in text)
+        # recovery flips it back
+        os.unlink(bad_tc)
+        os.unlink(bad_vmem)
+        text2 = collector.render()
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="tc_util"} '
+                "0.0") in text2
+
+    def test_unreadable_ring_raises_telemetry_error_gauge(self, tmp_path):
+        """Review finding: a ring that EXISTS but won't read must set
+        the telemetry feed's last-scrape-error flag, same as a wedged
+        tc_util/vmem file — its tenant's series are being served
+        stale."""
+        from vtpu_manager.metrics.collector import NodeCollector
+        base = str(tmp_path / "mgr")
+        ring = _mk_ring_dir(base, "uid-1", "main")
+        with open(ring, "wb") as f:
+            f.write(b"truncated-garbage")
+        collector = NodeCollector("n1", [], base_dir=base,
+                                  tc_path="/nonexistent",
+                                  vmem_path="/nonexistent")
+        text = collector.render()
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="telemetry"}'
+                " 1.0") in text
+        # a readable ring clears it
+        os.unlink(ring)
+        w = stepring.StepRingWriter(ring)
+        w.record(duration_ns=1)
+        w.close()
+        text2 = collector.render()
+        assert ('vtpu_node_scrape_last_error{node="n1",feed="telemetry"}'
+                " 0.0") in text2
+
+
+# ---------------------------------------------------------------------------
+# hermetic e2e: allocated pod -> tenant steps -> /metrics + vtrace splice
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    N_STEPS = 9
+
+    def _run_pipeline(self, tmp_path, monkeypatch, gate_on: bool):
+        from vtpu_manager import trace
+        from vtpu_manager.client.fake import FakeKubeClient
+        from vtpu_manager.config.node_config import NodeConfig
+        from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+        from vtpu_manager.deviceplugin.vnum import VnumPlugin, device_id
+        from vtpu_manager.device.claims import PodDeviceClaims
+        from vtpu_manager.manager.device_manager import DeviceManager
+        from vtpu_manager.tpu.discovery import FakeBackend
+        from vtpu_manager.scheduler.bind import BindPredicate
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.webhook.mutate import mutate_pod
+
+        spool = str(tmp_path / "spool")
+        trace.configure("e2e", spool, sampling_rate=1.0)
+        monkeypatch.setattr(consts, "TRACE_DIR",
+                            str(tmp_path / "node-trace"))
+
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-1", "annotations": {}}})
+        mgr = DeviceManager(
+            "node-1", client,
+            node_config=NodeConfig(device_split_count=4),
+            backends=[FakeBackend(n_chips=2)])
+        mgr.init_devices()
+        mgr.register_node()
+
+        pod = _vtpu_pod(uid=POD_UID, name="p1")
+        result = mutate_pod(pod)
+        for patch in result.patches:
+            path = patch["path"]
+            if path == "/metadata/annotations":
+                pod["metadata"].setdefault("annotations", {})
+                continue
+            prefix = "/metadata/annotations/"
+            if path.startswith(prefix):
+                key = path[len(prefix):].replace("~1", "/")
+                pod["metadata"]["annotations"][key] = patch["value"]
+        client.add_pod(pod)
+
+        fresult = FilterPredicate(client).filter({"Pod": pod})
+        assert not fresult.error, fresult.error
+        node = fresult.node_names[0]
+        assert not BindPredicate(client).bind(
+            {"PodNamespace": "default", "PodName": "p1",
+             "Node": node}).error
+
+        base = str(tmp_path / "mgr")
+        plugin = VnumPlugin(mgr, client, "node-1", base_dir=base,
+                            node_config=NodeConfig())
+        plugin.step_telemetry_enabled = gate_on
+        bound = client.get_pod("default", "p1")
+        pre = PodDeviceClaims.decode(
+            bound["metadata"]["annotations"][
+                consts.pre_allocated_annotation()])
+        resp = plugin.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=[
+                device_id(c.uuid, 0) for c in pre.containers["main"]])]))
+        envs = resp.container_responses[0].envs
+        mounts = resp.container_responses[0].mounts
+        tel_host = os.path.join(base, f"{POD_UID}_main",
+                                consts.TELEMETRY_SUBDIR)
+
+        if not gate_on:
+            assert consts.ENV_STEP_TELEMETRY not in envs
+            assert consts.ENV_STEP_RING_PATH not in envs
+            assert not any(consts.TELEMETRY_SUBDIR in m.container_path
+                           for m in mounts)
+            assert not os.path.exists(tel_host)
+            return base, envs
+
+        # gate on: the telemetry subdir is mounted read-write and the
+        # env points the tenant at the in-container ring path
+        assert envs[consts.ENV_STEP_TELEMETRY] == "true"
+        tel_mount = next(m for m in mounts
+                         if m.host_path == tel_host)
+        assert not tel_mount.read_only
+        assert envs[consts.ENV_STEP_RING_PATH].startswith(
+            tel_mount.container_path)
+
+        # tenant side: runtime/client configures itself from the
+        # injected env (the host path stands in for the mount target,
+        # exactly like the trace e2e does for TRACE_DIR)
+        ring_host = os.path.join(tel_host, consts.STEP_RING_NAME)
+        for key, value in [(consts.ENV_STEP_TELEMETRY, "true"),
+                           (consts.ENV_STEP_RING_PATH, ring_host),
+                           (consts.ENV_TRACE_ID,
+                            envs[consts.ENV_TRACE_ID])]:
+            monkeypatch.setenv(key, value)
+        rc._reset_step_telemetry()
+        w = rc.step_telemetry()
+        assert w is not None
+        for i in range(self.N_STEPS):
+            w.record(duration_ns=4_000_000, throttle_wait_ns=1_000_000,
+                     hbm_highwater_bytes=1 << 20, compiled=(i == 0))
+        return base, envs
+
+    def test_steps_reach_metrics_joined_by_trace_id(self, tmp_path,
+                                                    monkeypatch):
+        from vtpu_manager.device.types import fake_chip
+        from vtpu_manager.metrics.collector import NodeCollector
+        base, envs = self._run_pipeline(tmp_path, monkeypatch,
+                                        gate_on=True)
+        text = NodeCollector("node-1", [fake_chip(0), fake_chip(1)],
+                             base_dir=base, tc_path="/nonexistent",
+                             vmem_path="/nonexistent").render()
+        label = f'node="node-1",pod_uid="{POD_UID}",container="main"'
+        assert (f"vtpu_tenant_step_duration_seconds_count{{{label}}} "
+                f"{self.N_STEPS}") in text
+        assert (f"vtpu_tenant_step_duration_seconds_sum{{{label}}} "
+                f"{self.N_STEPS * 0.004:g}") in text
+        assert (f"vtpu_tenant_throttle_wait_seconds_count{{{label}}} "
+                f"{self.N_STEPS}") in text
+        assert f"vtpu_tenant_throttle_wait_fraction{{{label}}} 0.25" \
+            in text
+        assert f"vtpu_tenant_step_ring_dropped_total{{{label}}} 0" in text
+        # the vtrace join: the ring carries the admission-minted id
+        assert (f'vtpu_tenant_step_info{{{label},'
+                f'trace_id="{envs[consts.ENV_TRACE_ID]}"}} 1') in text
+        assert envs[consts.ENV_TRACE_ID] == POD_UID
+        assert 'vtpu_node_pressure_throttle_frac{node="node-1"} 0.25' \
+            in text
+
+    def test_vtrace_cli_splices_step_stats(self, tmp_path, monkeypatch):
+        from vtpu_manager import trace
+        base, _ = self._run_pipeline(tmp_path, monkeypatch, gate_on=True)
+        trace.flush()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts/vtrace.py"),
+             "--spool-dir", str(tmp_path / "spool"),
+             "--steps-dir", base, "--pod", POD_UID],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert "steps [main]:" in proc.stdout
+        assert f"{self.N_STEPS} total" in proc.stdout
+        assert "throttle-wait 25.0%" in proc.stdout
+        as_json = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts/vtrace.py"),
+             "--spool-dir", str(tmp_path / "spool"),
+             "--steps-dir", base, "--pod", POD_UID, "--json"],
+            capture_output=True, text=True, timeout=60)
+        doc = json.loads(as_json.stdout)
+        assert doc["steps"][0]["trace_id"] == POD_UID
+        assert doc["steps"][0]["steps_total"] == self.N_STEPS
+        assert doc["steps"][0]["compile_steps"] == 1
+
+    def test_gate_off_no_ring_no_series(self, tmp_path, monkeypatch):
+        from vtpu_manager.device.types import fake_chip
+        from vtpu_manager.metrics.collector import NodeCollector
+        base, _ = self._run_pipeline(tmp_path, monkeypatch, gate_on=False)
+        monkeypatch.delenv(consts.ENV_STEP_TELEMETRY, raising=False)
+        rc._reset_step_telemetry()
+        assert rc.step_telemetry() is None
+        text = NodeCollector("node-1", [fake_chip(0)], base_dir=base,
+                             tc_path="/nonexistent",
+                             vmem_path="/nonexistent").render()
+        assert "vtpu_tenant_step_duration_seconds_bucket{" not in text
+        assert "vtpu_tenant_step_info{" not in text
+        # the pressure rollup reads 0 pressure / full headroom
+        assert 'vtpu_node_pressure_throttle_frac{node="node-1"} 0' in text

@@ -332,6 +332,68 @@ class TestSeqlockProtocol:
             """}, select=self.SELECT)
         assert findings == []
 
+    # lock-free writers (vttel step ring): the `wseq = seq | 1`
+    # derivation is the opt-in — the bracket checks run without any
+    # write_lock region, so the step ring's writer is NOT vacuously
+    # clean (it was the one seqlock writer the with-trigger missed)
+
+    _LOCKFREE_WRITER = """
+        import struct
+
+        class W:
+            def record(self, off, val):
+                seq, = struct.unpack_from("<Q", self._mm, off)
+                wseq = seq | 1
+                struct.pack_into("<Q", self._mm, off, wseq)
+                struct.pack_into("<Q", self._mm, off + 8, val)
+                struct.pack_into("<Q", self._mm, off, wseq + 1)
+                struct.pack_into("<Q", self._mm, 0, self._head)
+        """
+
+    def test_lockfree_writer_good_shape_clean(self, tmp_path):
+        # trailing head-counter pack after the even bump is allowed:
+        # lock-free writers have no region boundary to scope it by
+        findings = lint(tmp_path, {"w.py": self._LOCKFREE_WRITER},
+                        select=self.SELECT)
+        assert findings == []
+
+    def test_lockfree_writer_payload_before_odd_mark(self, tmp_path):
+        src = self._LOCKFREE_WRITER.replace(
+            'struct.pack_into("<Q", self._mm, off, wseq)\n'
+            '                struct.pack_into("<Q", self._mm, off + 8, '
+            'val)',
+            'struct.pack_into("<Q", self._mm, off + 8, val)\n'
+            '                struct.pack_into("<Q", self._mm, off, wseq)')
+        findings = lint(tmp_path, {"w.py": src}, select=self.SELECT)
+        assert any("must be written first" in f.message for f in findings)
+
+    def test_lockfree_writer_plus_one_inversion(self, tmp_path):
+        src = self._LOCKFREE_WRITER.replace("seq | 1", "seq + 1")
+        findings = lint(tmp_path, {"w.py": src}, select=self.SELECT)
+        assert any("inverts parity" in f.message for f in findings)
+
+    def test_lockfree_writer_missing_even_bump(self, tmp_path):
+        src = self._LOCKFREE_WRITER.replace(
+            '                struct.pack_into("<Q", self._mm, off, '
+            'wseq + 1)\n', "")
+        findings = lint(tmp_path, {"w.py": src}, select=self.SELECT)
+        assert any("never returns the seq to even" in f.message
+                   for f in findings)
+
+    def test_plain_packers_stay_unchecked(self, tmp_path):
+        # no seq derivation = not a seqlock writer (vmem-style locked
+        # writes must not be dragged into the protocol)
+        findings = lint(tmp_path, {"w.py": """
+            import struct
+
+            class W:
+                def write(self, i, val):
+                    nxt = i + 1
+                    struct.pack_into("<Q", self._mm, i * 8, val)
+                    self.count = nxt
+            """}, select=self.SELECT)
+        assert findings == []
+
 
 # ---------------------------------------------------------------------------
 # abi-drift

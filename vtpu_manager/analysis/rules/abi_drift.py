@@ -37,6 +37,11 @@ TRACKED: dict[str, tuple[str, list[str]]] = {
         "MAGIC", "VERSION", "MAX_ENTRIES", "_HEADER_FMT", "HEADER_SIZE",
         "_ENTRY_FMT", "ENTRY_SIZE", "FILE_SIZE",
     ]),
+    "stepring": ("telemetry/stepring.py", [
+        "MAGIC", "VERSION", "RING_CAPACITY", "TRACE_ID_LEN",
+        "_HEADER_FMT", "HEADER_SIZE", "_RECORD_FMT", "RECORD_SIZE",
+        "FILE_SIZE", "FLAG_COMPILE",
+    ]),
 }
 
 DEFAULT_GOLDEN = Path(__file__).resolve().parent.parent / "abi_golden.json"
@@ -67,8 +72,8 @@ def _assign_line(module: Module, name: str) -> int:
 
 class AbiDriftRule(Rule):
     name = RULE
-    description = ("struct layouts in tc_watcher.py/vmem.py match the "
-                   "committed golden ABI (abi_golden.json)")
+    description = ("struct layouts in tc_watcher.py/vmem.py/stepring.py "
+                   "match the committed golden ABI (abi_golden.json)")
 
     def __init__(self, golden_path: str | None = None):
         self.golden_path = Path(golden_path) if golden_path \

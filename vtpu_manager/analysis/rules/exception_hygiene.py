@@ -25,7 +25,7 @@ from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
 RULE = "exception-hygiene"
 
 SCOPED_DIRS = ("scheduler", "manager", "deviceplugin", "kubeletplugin",
-               "trace", "client", "resilience")
+               "trace", "client", "resilience", "telemetry")
 
 _LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
                 "critical", "log"}

@@ -13,6 +13,14 @@ into an mmap must:
   - write the odd seq *first* (before any payload ``pack_into``),
   - finish with exactly ``wseq + 1`` (back to even) as the last write.
 
+Lock-free writers (single-writer rings like vttel's step ring, where
+exclusion is an open-time lock and the hot path takes none) opt in by
+deriving ``<x> | 1`` in a function that packs into an mmap; the same
+bracket checks run over the function body, minus the trailing-pack check
+(a lock-free writer may publish separate fields — the ring-head counter
+— after the record's even bump, and a function body gives no region
+boundary to scope them by).
+
 Reader side — any function that both ``struct.unpack_from``s and tests
 ``<seq> & 1`` must:
   - run the parity test inside a retry loop,
@@ -69,6 +77,7 @@ class SeqlockProtocolRule(Rule):
         for node in ast.walk(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 findings.extend(self._check_reader(module, node))
+                findings.extend(self._check_lockfree_writer(module, node))
             elif isinstance(node, ast.With):
                 for item in node.items:
                     ctx = item.context_expr
@@ -81,18 +90,72 @@ class SeqlockProtocolRule(Rule):
 
     # -- writer -------------------------------------------------------------
 
-    def _check_writer(self, module: Module,
-                      region: ast.With) -> list[Finding]:
-        packs = [n for n in _ordered_walk(region.body) if _is_pack_into(n)]
+    @staticmethod
+    def _has_write_lock_region(func: ast.AST) -> bool:
+        for node in ast.walk(func):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call):
+                        parts = dotted_parts(ctx.func)
+                        if parts and "write_lock" in parts[-1]:
+                            return True
+        return False
+
+    def _check_lockfree_writer(self, module: Module,
+                               func: ast.FunctionDef | ast.AsyncFunctionDef
+                               ) -> list[Finding]:
+        """Single-writer rings (the vttel step ring) run the same seqlock
+        bracket WITHOUT a per-write lock region — the odd-seq derivation
+        (``wseq = seq | 1`` or the ``+ 1`` misuse) is the opt-in marker.
+        The late-pack check is region-scoped by nature and does not
+        apply here: a lock-free writer may legitimately publish separate
+        fields (e.g. the ring-head counter) after the record's even
+        bump, and the function body gives no region boundary to scope
+        them by."""
+        if self._has_write_lock_region(func):
+            return []       # covered per-region by the strict check
+        packs = [n for n in _ordered_walk(func.body) if _is_pack_into(n)]
         if not packs:
             return []
-        line = region.lineno
+        # opt-in markers, mirroring the strict check's wseq detection:
+        # a Name assigned `<x> | 1` (the protocol) or `<x> + 1` that
+        # feeds a pack (the parity-inversion misuse). Plain writers
+        # (no seq derivation) are not seqlock writers and stay unchecked.
+        opted_in = False
+        for n in ast.walk(func):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and isinstance(n.value, ast.BinOp) \
+                    and isinstance(n.value.right, ast.Constant) \
+                    and n.value.right.value == 1:
+                if isinstance(n.value.op, ast.BitOr):
+                    opted_in = True
+                elif isinstance(n.value.op, ast.Add) and any(
+                        n.targets[0].id in _names_in(p) for p in packs):
+                    opted_in = True
+        if not opted_in:
+            return []
+        return self._check_writer_stmts(module, func.lineno, func.body,
+                                        check_late_packs=False)
+
+    def _check_writer(self, module: Module,
+                      region: ast.With) -> list[Finding]:
+        return self._check_writer_stmts(module, region.lineno, region.body,
+                                        check_late_packs=True)
+
+    def _check_writer_stmts(self, module: Module, line: int,
+                            stmts: list[ast.stmt],
+                            check_late_packs: bool) -> list[Finding]:
+        packs = [n for n in _ordered_walk(stmts) if _is_pack_into(n)]
+        if not packs:
+            return []
         out: list[Finding] = []
 
         # the odd-seq variable: assigned `<x> | 1` inside the region
         wseq: str | None = None
         plus_one: str | None = None   # `<x> + 1` misuse
-        for node in _ordered_walk(region.body):
+        for node in _ordered_walk(stmts):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
                     and isinstance(node.value, ast.BinOp) \
@@ -147,7 +210,7 @@ class SeqlockProtocolRule(Rule):
                 RULE, module.path, packs[-1].lineno,
                 f"writer never returns the seq to even: the final "
                 f"pack_into must write '{wseq} + 1'"))
-        elif bump_idx[-1] != len(packs) - 1:
+        elif check_late_packs and bump_idx[-1] != len(packs) - 1:
             late = packs[bump_idx[-1] + 1]
             out.append(Finding(
                 RULE, module.path, late.lineno,

@@ -53,6 +53,7 @@ def device_uuid(dev_id: str) -> str:
 class VnumPlugin(DevicePluginServicer):
     pre_start_required = True
     preferred_allocation_available = False   # gated: HonorPreAllocatedDeviceIDs
+    step_telemetry_enabled = False           # gated: StepTelemetry (vttel)
 
     def __init__(self, manager: DeviceManager, client: KubeClient,
                  node_name: str, node_config: NodeConfig | None = None,
@@ -408,6 +409,26 @@ class VnumPlugin(DevicePluginServicer):
                     log.warning("trace dir %s unavailable (%s); tenant "
                                 "spans for %s/%s will not spool",
                                 consts.TRACE_DIR, e, uid, cont)
+            if self.step_telemetry_enabled:
+                # vttel: the per-container telemetry subdir (next to the
+                # read-only config) is the ONE writable surface the
+                # tenant gets under its own config dir — the step ring
+                # lives there, the monitor tails it by host path
+                tel_host = os.path.join(cont_dir, consts.TELEMETRY_SUBDIR)
+                tel_cont = os.path.join(consts.MANAGER_BASE_DIR,
+                                        consts.TELEMETRY_SUBDIR)
+                try:
+                    os.makedirs(tel_host, exist_ok=True)
+                    resp.mounts.append(pb.Mount(
+                        container_path=tel_cont, host_path=tel_host,
+                        read_only=False))
+                    resp.envs[consts.ENV_STEP_TELEMETRY] = "true"
+                    resp.envs[consts.ENV_STEP_RING_PATH] = os.path.join(
+                        tel_cont, consts.STEP_RING_NAME)
+                except OSError as e:
+                    log.warning("telemetry dir %s unavailable (%s); "
+                                "tenant %s/%s runs untelemetered",
+                                tel_host, e, uid, cont)
             resp.mounts.append(pb.Mount(
                 container_path=consts.WATCHER_DIR,
                 host_path=consts.WATCHER_DIR, read_only=True))

@@ -20,6 +20,9 @@ from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.kubeletplugin.api import dra_pb2 as pb
 from vtpu_manager.kubeletplugin.device_state import DeviceState, PrepareError
+from vtpu_manager.resilience.policy import (CircuitBreaker,
+                                            CircuitOpenError,
+                                            KubeResilience, RetryPolicy)
 
 log = logging.getLogger(__name__)
 
@@ -33,11 +36,23 @@ class ClaimLookupError(RuntimeError):
 
 class ClaimSource:
     """Where Prepare fetches claim objects. The real source is the API
-    server; tests inject an in-memory map."""
+    server; tests inject an in-memory map.
 
-    def __init__(self, client: KubeClient | None = None):
+    API fetches route through KubeResilience (vtfault): transient
+    failures retry under a deadline that fits the kubelet's Prepare
+    budget, and a sustained apiserver outage opens the breaker so a
+    Prepare burst rejects locally (transient errors, kubelet retries)
+    instead of queueing doomed GETs. 404 ("claim not found") is a
+    *result*, not a failure — it neither retries nor counts against the
+    breaker."""
+
+    def __init__(self, client: KubeClient | None = None,
+                 resilience: KubeResilience | None = None):
         self.client = client
         self.local: dict[str, dict] = {}    # uid -> claim (tests)
+        self.resilience = resilience or KubeResilience(
+            policy=RetryPolicy(max_attempts=3, deadline_s=5.0),
+            breaker=CircuitBreaker(name="dra.claims"))
 
     def get(self, uid: str, name: str, namespace: str) -> dict | None:
         claim = None
@@ -46,15 +61,20 @@ class ClaimSource:
         elif self.client is not None:
             getter = getattr(self.client, "get_resourceclaim", None)
             if getter is not None:
+                def fetch():
+                    try:
+                        return getter(namespace, name)
+                    except KubeError as e:
+                        if e.status == 404:
+                            return None
+                        raise
                 try:
-                    claim = getter(namespace, name)
-                except KubeError as e:
-                    if e.status == 404:
-                        claim = None
-                    else:
-                        log.warning("claim %s/%s lookup failed: %s",
-                                    namespace, name, e)
-                        raise ClaimLookupError(str(e)) from e
+                    claim = self.resilience.call(fetch,
+                                                 op="dra.claim_get")
+                except CircuitOpenError as e:
+                    log.warning("claim %s/%s lookup rejected: %s",
+                                namespace, name, e)
+                    raise ClaimLookupError(str(e)) from e
                 except Exception as e:
                     log.warning("claim %s/%s lookup failed: %s",
                                 namespace, name, e)

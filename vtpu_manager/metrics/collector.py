@@ -23,6 +23,7 @@ from vtpu_manager.config.tc_watcher import TcUtilFile
 from vtpu_manager.config.vmem import VmemLedger, fnv64
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.deviceplugin import checkpoint as ckpt
+from vtpu_manager.telemetry import TenantStepTelemetry
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -98,6 +99,15 @@ class NodeCollector:
         self._kubelet_view_was_cached: bool = False
         self.kubelet_view_ttl_s = float(
             os.environ.get("VTPU_KUBELET_VIEW_TTL_S", "10"))
+        # vttel: cursor-tailed step rings folded into cumulative per-pod
+        # histograms across scrapes (the collector is the long-lived
+        # state holder; the rings only remember RING_CAPACITY steps)
+        self.telemetry = TenantStepTelemetry(base_dir)
+        # self-observability: per-feed last-scrape-error flags (a wedged
+        # config/ledger read must be visible, not silently-stale gauges)
+        self._feed_errors: dict[str, float] = {
+            "tc_util": 0.0, "vmem": 0.0, "telemetry": 0.0}
+        self._last_scrape_s: float = 0.0
 
     def _kubelet_view(self, force: bool = False
                       ) -> pod_resources.KubeletView:
@@ -201,6 +211,7 @@ class NodeCollector:
         g_cal_age = Gauge("vtpu_node_obs_calibration_age_seconds",
                           "Age of the feed's calibration block",
                           ("node",))
+        self._feed_errors["tc_util"] = 0.0
         try:
             tc = TcUtilFile(self.tc_path)
             cal_full = tc.read_calibration_full()
@@ -226,15 +237,20 @@ class NodeCollector:
                                            proc.pid, proc.util))
             tc.close()
         except (OSError, ValueError):
-            pass
+            # absent feed (no TCWatcher on this node) is normal; only a
+            # file that EXISTS but cannot be read is a scrape error
+            if os.path.exists(self.tc_path):
+                self._feed_errors["tc_util"] = 1.0
         gauges += [g_util, g_feed_age, g_cal_max, g_cal_age]
 
         # ---- vmem ledger: usage + heartbeat ----
         vmem = None
+        self._feed_errors["vmem"] = 0.0
         try:
             vmem = VmemLedger(self.vmem_path)
         except (OSError, ValueError):
-            pass
+            if os.path.exists(self.vmem_path):
+                self._feed_errors["vmem"] = 1.0
         # per-(tenant, chip) attribution: ledger entries carry the owner
         # token (fnv64 of pod_uid/container) AND the chip, so co-tenants
         # are never conflated and a multi-chip container's rows stay
@@ -491,5 +507,36 @@ class NodeCollector:
         return gauges
 
     def render(self) -> str:
-        return "\n".join(g.render() for g in self.collect() if g.samples
+        t0 = time.perf_counter()
+        text = "\n".join(g.render() for g in self.collect() if g.samples
                          or True) + "\n"
+        # vttel: tail the step rings and append the per-pod histograms +
+        # node pressure rollup. No rings (gate off / no tenants) renders
+        # headers only — zero vttel series, matching the gate-off
+        # contract, while the families stay discoverable.
+        self._feed_errors["telemetry"] = 0.0
+        try:
+            if self.telemetry.scan():
+                # rings that EXIST but won't read: their tenants' series
+                # are being served stale — same posture as a wedged
+                # tc_util/vmem file
+                self._feed_errors["telemetry"] = 1.0
+        except OSError:
+            self._feed_errors["telemetry"] = 1.0
+            log.warning("step-telemetry scan failed", exc_info=True)
+        text += self.telemetry.render(self.node_name)
+        text += self.telemetry.render_pressure(
+            self.node_name, sum(c.memory for c in self.chips))
+        # self-observability: the scrape's own duration and per-feed
+        # last-error flags, rendered last so a wedged feed still reports
+        self._last_scrape_s = time.perf_counter() - t0
+        g_dur = Gauge("vtpu_node_scrape_duration_seconds",
+                      "Wall time of this collector scrape (gauges + "
+                      "telemetry fold)", ("node",))
+        g_dur.set((self.node_name,), round(self._last_scrape_s, 6))
+        g_err = Gauge("vtpu_node_scrape_last_error",
+                      "1 when the feed's last read failed (stale gauges "
+                      "are being served)", ("node", "feed"))
+        for feed in sorted(self._feed_errors):
+            g_err.set((self.node_name, feed), self._feed_errors[feed])
+        return text + g_dur.render() + "\n" + g_err.render() + "\n"

@@ -82,6 +82,7 @@ class _Spec:
     count: int | None = None          # remaining fires; None = unlimited
     status: int = 503                 # for error action on kube sites
     latency_s: float = 0.001
+    retry_after: float | None = None  # Retry-After carried by the KubeError
     exc: type | None = None           # overrides the KubeError default
     match: dict = field(default_factory=dict)   # ctx subset that must match
 
@@ -133,19 +134,22 @@ def disable() -> None:
 
 def arm(site: str, action: str, p: float = 1.0, count: int | None = None,
         status: int = 503, latency_s: float = 0.001,
+        retry_after: float | None = None,
         exc: type | None = None, match: dict | None = None) -> None:
     if site not in SITES:
         raise KeyError(f"unknown failpoint site {site!r} "
                        f"(known: {sorted(SITES)})")
     if action not in ACTIONS:
         raise ValueError(f"unknown failpoint action {action!r}")
+    if retry_after is not None and action != "error":
+        raise ValueError("retry_after only applies to the error action")
     if not _enabled:
         raise RuntimeError("failpoints disabled: enable() (FaultInjection "
                            "gate) before arm()")
     with _lock:
         _ARMED[site] = _Spec(action=action, p=p, count=count, status=status,
-                             latency_s=latency_s, exc=exc,
-                             match=dict(match or {}))
+                             latency_s=latency_s, retry_after=retry_after,
+                             exc=exc, match=dict(match or {}))
 
 
 def disarm(site: str) -> None:
@@ -204,9 +208,12 @@ def _make_error(site: str, spec: _Spec) -> Exception:
     if spec.exc is not None:
         return spec.exc(f"failpoint {site} injected error")
     # KubeError is the lingua franca of the sites this ships for; import
-    # here to keep the module import-light (flock.py imports us)
+    # here to keep the module import-light (flock.py imports us).
+    # retry_after rides the error like a real Retry-After header would,
+    # so injected 429/503s exercise the RetryPolicy floor branch.
     from vtpu_manager.client.kube import KubeError
-    return KubeError(spec.status, f"failpoint {site} injected error")
+    return KubeError(spec.status, f"failpoint {site} injected error",
+                     retry_after=spec.retry_after)
 
 
 def _truncate(path, frac: float) -> None:
@@ -242,9 +249,11 @@ def arm_spec(spec: str) -> None:
     """Parse ``site=action(arg,k=v,...);site2=...`` and arm each entry.
     Grammar mirrors gofail's: the one positional arg is the status for
     ``error`` and the seconds for ``latency``; ``p=``/``count=`` bound
-    the injection. Example::
+    the injection, and ``retry_after=<seconds>`` makes an injected
+    KubeError carry the apiserver pacing hint (the RetryPolicy floor
+    branch real 429s exercise). Example::
 
-        VTPU_FAILPOINTS='kube.request=error(503,p=0.01);flock.acquire=latency(0.05)'
+        VTPU_FAILPOINTS='kube.request=error(429,retry_after=2,p=0.01);flock.acquire=latency(0.05)'
     """
     for part in spec.split(";"):
         part = part.strip()
@@ -270,6 +279,8 @@ def arm_spec(spec: str) -> None:
                         kwargs["p"] = float(val)
                     elif key == "count":
                         kwargs["count"] = int(val)
+                    elif key == "retry_after":
+                        kwargs["retry_after"] = float(val)
                     else:
                         raise ValueError(
                             f"unknown failpoint option {key!r} in {part!r}")

@@ -15,6 +15,9 @@ reference's in-container plumbing that ld.so.preload does implicitly
   socket (pid attribution without exposing host /proc).
 - mark_first_execute(): vtrace terminal event — the moment the tenant
   first reaches the device, closing the admission-to-running timeline.
+- step_telemetry(): vttel step-ring writer, armed only when the plugin
+  injected the StepTelemetry env; the step loop records latency /
+  throttle-wait / HBM high-water into the shared ring the monitor tails.
 """
 
 from __future__ import annotations
@@ -153,6 +156,56 @@ def register_client(timeout_s: float = 5.0) -> bool:
                 return status == 0
         except OSError:
             return False
+
+
+_step_telemetry = None
+_step_telemetry_checked = False
+
+
+def step_telemetry():
+    """The tenant's StepRingWriter, or None when StepTelemetry is off
+    for this pod. The gate-off cost contract: after the first call this
+    is one global load and one branch — no env reads, no imports, no
+    file I/O (tests assert no ring file appears). Callers hold the
+    returned writer across the step loop; ``record()`` is the hot path.
+
+    Failure posture mirrors tenant tracing: a broken telemetry mount
+    must degrade to "no telemetry", never break the training loop."""
+    global _step_telemetry, _step_telemetry_checked
+    if _step_telemetry_checked:
+        return _step_telemetry
+    _step_telemetry_checked = True
+    if os.environ.get(consts.ENV_STEP_TELEMETRY) != "true":
+        return None
+    from vtpu_manager.telemetry import stepring
+    path = os.environ.get(consts.ENV_STEP_RING_PATH) or os.path.join(
+        consts.MANAGER_BASE_DIR, consts.TELEMETRY_SUBDIR,
+        consts.STEP_RING_NAME)
+    try:
+        _step_telemetry = stepring.StepRingWriter(
+            path, trace_id=os.environ.get(consts.ENV_TRACE_ID, ""))
+        # clean unmap/unlock on interpreter exit — otherwise the GC'd
+        # lock context tears down after Python's import machinery and
+        # spams a harmless-but-ugly shutdown traceback
+        import atexit
+        atexit.register(_step_telemetry.close)
+    except (OSError, ValueError) as e:
+        import logging
+        logging.getLogger(__name__).warning(
+            "step telemetry unavailable at %s (%s); running untelemetered",
+            path, e)
+        _step_telemetry = None
+    return _step_telemetry
+
+
+def _reset_step_telemetry() -> None:
+    """Test hook: drop the cached writer so the next step_telemetry()
+    re-reads the env (mirrors trace.reset())."""
+    global _step_telemetry, _step_telemetry_checked
+    if _step_telemetry is not None:
+        _step_telemetry.close()
+    _step_telemetry = None
+    _step_telemetry_checked = False
 
 
 _first_execute_marked = False

@@ -53,6 +53,7 @@ from vtpu_manager.resilience import failpoints
 from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.scheduler import gang, reason as R
 from vtpu_manager.scheduler import snapshot as snap_mod
+from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -602,8 +603,11 @@ class FilterPredicate:
                 result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
                 continue
+            pressure = tel_pressure.parse_pressure(
+                (meta.get("annotations") or {}).get(
+                    consts.node_pressure_annotation()))
             ranked.append((free_cores + (free_memory >> 24) + free_number,
-                           name, registry, counted, assumed))
+                           name, registry, counted, assumed, pressure))
         if now_visible:
             self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
@@ -620,13 +624,14 @@ class FilterPredicate:
         # walking the remainder until one succeeds — truncation must trade
         # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
-        for rank, (_, name, registry, counted, assumed) in \
+        for rank, (_, name, registry, counted, assumed, pressure) in \
                 enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
             self._allocate_node(name, registry, counted, assumed, req,
                                 prefer_origin, gang_siblings,
-                                gang_domains, scored, result, reasons)
+                                gang_domains, scored, result, reasons,
+                                pressure=pressure)
         return scored
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
@@ -706,7 +711,8 @@ class FilterPredicate:
                                 snap_mod.entry_counted(entry, now),
                                 assumed, req, prefer_origin,
                                 gang_siblings, gang_domains, scored,
-                                result, reasons)
+                                result, reasons,
+                                pressure=entry.pressure)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -741,7 +747,8 @@ class FilterPredicate:
                        assumed: list, req: AllocationRequest,
                        prefer_origin, gang_siblings: list,
                        gang_domains: set, scored: list,
-                       result: FilterResult, reasons) -> None:
+                       result: FilterResult, reasons,
+                       pressure=None) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
         drift between them."""
@@ -767,6 +774,11 @@ class FilterPredicate:
             reasons.add(why.split(";")[0].split(" x")[0], name)
             return
         score = node_score(alloc_result, req)
+        # vttel soft hint: tenants on this node are stalling in the
+        # throttle — prefer an equal node whose tenants aren't. A
+        # PENALTY only: pressure can reorder fits, never veto one (a
+        # pressured node with the only free chips still schedules).
+        score -= tel_pressure.pressure_penalty(pressure)
         if gang_domains and registry.mesh_domain in gang_domains:
             # keeping the gang on one multi-host slice outweighs any
             # per-node topology/packing difference: a member placed

@@ -50,6 +50,7 @@ from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import container_kinds, effective_claims
 from vtpu_manager.resilience import failpoints
 from vtpu_manager.resilience.policy import RetryPolicy
+from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
 from vtpu_manager.util.gangname import resolve_gang_name
 
@@ -65,11 +66,12 @@ class NodeEntry:
 
     __slots__ = ("name", "node", "labels", "registry", "resident",
                  "counted", "conditional", "base_free", "rank_key",
-                 "generation")
+                 "generation", "pressure")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
-                 base_free: tuple, rank_key: int, generation: int):
+                 base_free: tuple, rank_key: int, generation: int,
+                 pressure=None):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -78,6 +80,7 @@ class NodeEntry:
         self.counted = counted            # [(uid, claims)] unconditional
         self.conditional = conditional    # [(uid, claims, expiry_wall_s)]
         self.base_free = base_free        # free totals over `counted` only
+        self.pressure = pressure          # vttel NodePressure | None
         # capacity-rank key over free totals INCLUDING build-time-live
         # conditionals — same formula the filter's TTL path sorts on
         # (free_cores + (free_memory >> 24) + free_number). A grace
@@ -204,6 +207,7 @@ class ClusterSnapshot:
         # under it (decode + I/O run on the pumping thread outside).
         self._lock = threading.Lock()
         self._entries: dict[str, NodeEntry] = {}
+        self._node_pressure: dict[str, object] = {}   # name -> NodePressure
         self._pods: dict[str, dict] = {}              # uid -> pod (ALL pods)
         self._pod_node: dict[str, str] = {}           # uid -> nodeName | ""
         self._pod_class: dict[str, tuple] = {}        # uid -> (claims, expiry)
@@ -418,17 +422,22 @@ class ClusterSnapshot:
                     entries = dict(self._entries)
                     del entries[name]
                     self._entries = entries
+                    self._node_pressure.pop(name, None)
                     self._publish_rank_locked(name, None)
                     self.generation += 1
             return
         # decode outside the lock — the one potentially-large JSON parse
-        # on the node path
+        # on the node path (the vttel pressure annotation parses here
+        # for the same reason, staleness judged at ingest)
         self.stats.registry_decodes += 1
+        anns = meta.get("annotations") or {}
         registry = dt.decode_registry(
-            (meta.get("annotations") or {}).get(
-                consts.node_device_register_annotation()))
+            anns.get(consts.node_device_register_annotation()))
+        node_pressure = tel_pressure.parse_pressure(
+            anns.get(consts.node_pressure_annotation()))
         labels = meta.get("labels") or {}
         with self._lock:
+            self._node_pressure[name] = node_pressure
             self.generation += 1
             entry = self._build_entry_locked(name, node, labels, registry)
             if name in self._entries:
@@ -574,7 +583,8 @@ class ClusterSnapshot:
             rank_key = free[1] + (free[2] >> 24) + free[0]
         return NodeEntry(name, node, labels, registry, resident, counted,
                          conditional, base_free, rank_key,
-                         self.generation)
+                         self.generation,
+                         pressure=self._node_pressure.get(name))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -615,6 +625,7 @@ class ClusterSnapshot:
             self._gangs = gangs
             self._node_pod_uids = node_pod_uids
             self._all_pods_cache = None
+            self._node_pressure = {}
             entries: dict[str, NodeEntry] = {}
             for node in nodes:
                 meta = node.get("metadata") or {}
@@ -622,9 +633,11 @@ class ClusterSnapshot:
                 if not name:
                     continue
                 self.stats.registry_decodes += 1
+                anns = meta.get("annotations") or {}
                 registry = dt.decode_registry(
-                    (meta.get("annotations") or {}).get(
-                        consts.node_device_register_annotation()))
+                    anns.get(consts.node_device_register_annotation()))
+                self._node_pressure[name] = tel_pressure.parse_pressure(
+                    anns.get(consts.node_pressure_annotation()))
                 entries[name] = self._build_entry_locked(
                     name, node, meta.get("labels") or {}, registry)
             self._entries = entries
@@ -703,6 +716,6 @@ class ClusterSnapshot:
             pruned = NodeEntry(
                 entry.name, entry.node, entry.labels, entry.registry,
                 entry.resident, entry.counted, live, entry.base_free,
-                rank_key, self.generation)
+                rank_key, self.generation, pressure=entry.pressure)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

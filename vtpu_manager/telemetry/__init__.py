@@ -1,0 +1,21 @@
+"""vttel: tenant-side step telemetry.
+
+What a tenant *experiences* per step — latency, throttle-stall time, HBM
+high-water, compile hits — written from the step loop into a crash-safe
+per-container seqlock shm ring (stepring.py), tailed by the node monitor
+into per-pod Prometheus histograms (aggregate.py), and rolled up into a
+node pressure annotation the scheduler scores against (pressure.py).
+Gated behind the ``StepTelemetry`` feature gate: off, the plugin injects
+nothing and the tenant-side check is one env-var branch.
+
+The limit-side gauges (metrics/collector.py) say what a tenant is
+*allowed*; vttel says what it *got* — the co-located-interference signal
+FlexNPU-style fractional sharing needs (PAPERS.md).
+"""
+
+from vtpu_manager.telemetry.aggregate import TenantStepTelemetry
+from vtpu_manager.telemetry.stepring import (StepRecord, StepRingReader,
+                                             StepRingWriter)
+
+__all__ = ["StepRecord", "StepRingReader", "StepRingWriter",
+           "TenantStepTelemetry"]

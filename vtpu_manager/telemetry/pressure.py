@@ -1,0 +1,145 @@
+"""Node pressure annotation: vttel's feedback edge into the scheduler.
+
+The node daemon publishes a tiny rollup of what its tenants are
+*experiencing* — max throttle-wait fraction over the last window and HBM
+headroom under the step high-waters — as a node annotation, the same
+channel the device registry uses. The scheduler snapshot decodes it at
+event-apply time and the filter folds it into scoring as a **soft
+penalty only**: pressure can reorder otherwise-equal nodes, it can never
+fail the capacity gate (a pressured node with the only free chips still
+schedules).
+
+Wire format is deliberately parse-cheap (the scheduler may parse it per
+node event): ``"<throttle_frac>:<hbm_headroom_bytes>@<wall_ts>"``. The
+timestamp makes staleness explicit — a daemon that stops publishing must
+decay to "no signal", not pin its last panic forever.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass
+
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+# a rollup older than this reads as no-signal (the publisher cadence is
+# seconds; 120 s means "daemon gone for two minutes")
+MAX_PRESSURE_AGE_S = 120.0
+
+# a stamp slightly in the future is node/scheduler clock skew (and the
+# encode's millisecond rounding), not a signal to distrust; beyond this
+# it reads as no-signal like any other garbage
+FUTURE_SKEW_TOLERANCE_S = 5.0
+
+# scoring weight: a fully-stalled node (frac 1.0) loses this many score
+# points — bigger than any packing/topology delta, smaller than the +100
+# gang-domain bonus (gang locality still wins; see filter.node_score)
+PRESSURE_SCORE_WEIGHT = 50.0
+
+
+@dataclass(frozen=True)
+class NodePressure:
+    throttle_frac: float
+    hbm_headroom_bytes: int
+    ts: float
+
+    def encode(self) -> str:
+        return (f"{self.throttle_frac:.4f}:"
+                f"{self.hbm_headroom_bytes}@{self.ts:.3f}")
+
+
+def parse_pressure(raw: str | None,
+                   now: float | None = None,
+                   max_age_s: float = MAX_PRESSURE_AGE_S
+                   ) -> NodePressure | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    every bad shape degrades to no-signal, never to a wrong penalty."""
+    if not raw:
+        return None
+    body, _, ts_raw = raw.partition("@")
+    frac_raw, _, headroom_raw = body.partition(":")
+    try:
+        frac = float(frac_raw)
+        headroom = int(headroom_raw)
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not (math.isfinite(frac) and math.isfinite(ts)):
+        # "nan" parses as float but poisons every comparison downstream:
+        # min/max pass NaN through and a NaN score corrupts the whole
+        # node ordering — garbage must mean no-signal
+        return None
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+        return None
+    return NodePressure(min(max(frac, 0.0), 1.0), max(headroom, 0), ts)
+
+
+def pressure_penalty(pressure: "NodePressure | None",
+                     now: float | None = None) -> float:
+    """Score points to subtract for one node's pressure. Staleness is
+    re-judged HERE, not only at parse time: the snapshot path caches the
+    parsed pressure on the NodeEntry and a dead publisher emits no
+    further node events, so without a use-time check its last panic
+    would pin forever instead of decaying to no-signal."""
+    if pressure is None:
+        return 0.0
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - pressure.ts \
+            <= MAX_PRESSURE_AGE_S:
+        return 0.0
+    return PRESSURE_SCORE_WEIGHT * pressure.throttle_frac
+
+
+class PressurePublisher:
+    """Daemon-side loop: scan the rings, patch the node annotation.
+
+    Runs in the device-plugin daemon (the binary that already owns node
+    annotation publication) behind the StepTelemetry gate. Failures are
+    tolerated per tick — pressure is advisory, and the annotation's own
+    timestamp ages it out if publication stops."""
+
+    def __init__(self, client, node_name: str, aggregator,
+                 node_hbm_total: int, policy=None,
+                 interval_s: float = 15.0):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.aggregator = aggregator
+        self.node_hbm_total = node_hbm_total
+        self.policy = policy or RetryPolicy(max_attempts=3, deadline_s=10.0)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def publish_once(self) -> NodePressure:
+        self.aggregator.scan()
+        frac, headroom = self.aggregator.pressure(self.node_hbm_total)
+        pressure = NodePressure(frac, headroom, time.time())
+        self.policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_pressure_annotation(): pressure.encode()}),
+            op="telemetry.pressure_patch")
+        return pressure
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.publish_once()
+                except Exception:  # noqa: BLE001 — advisory signal; the
+                    # annotation timestamp ages a silent failure out
+                    log.warning("node pressure publish failed",
+                                exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vttel-pressure")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

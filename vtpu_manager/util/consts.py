@@ -212,6 +212,14 @@ def node_obs_overhead_annotation() -> str:
     return _ann("node-obs-excess-table")
 
 
+def node_pressure_annotation() -> str:
+    """vttel node pressure rollup ("<throttle_frac>:<hbm_headroom>@<ts>",
+    telemetry/pressure.py): max tenant throttle-wait fraction + HBM
+    headroom derived from the step-telemetry rings, published by the node
+    daemon and ingested by the scheduler as a soft scoring hint."""
+    return _ann("node-pressure")
+
+
 # Allocation status values ---------------------------------------------------
 
 ALLOC_STATUS_SUCCEED = "succeed"
@@ -270,6 +278,8 @@ ENV_REGISTER_UUID = "VTPU_REGISTER_UUID"    # random id for CLIENT-mode match
 ENV_TRACE_ID = "VTPU_TRACE_ID"              # vtrace id (admission-minted)
 ENV_TRACE_SAMPLED = "VTPU_TRACE_SAMPLED"    # "true"/"false"
 ENV_TRACE_DIR = "VTPU_TRACE_DIR"            # tenant spool dir override
+ENV_STEP_TELEMETRY = "VTPU_STEP_TELEMETRY"  # "true": step ring armed
+ENV_STEP_RING_PATH = "VTPU_STEP_RING_PATH"  # tenant-side ring file path
 ENV_REGISTRY_SOCKET = "VTPU_REGISTRY_SOCKET"  # registry socket override
 ENV_POD_NAME = "VTPU_POD_NAME"
 ENV_POD_NAMESPACE = "VTPU_POD_NAMESPACE"
@@ -306,6 +316,13 @@ DRIVER_DIR = f"{MANAGER_BASE_DIR}/driver"          # shim install dir on node
 CONTROL_LIBRARY_NAME = "libvtpu-control.so"
 
 TRACE_DIR = f"{MANAGER_BASE_DIR}/trace"             # vtrace span spools
+
+# vttel step-telemetry ring: one per tenant container, under the
+# container config dir (host: <base>/<uid>_<cont>/telemetry/<name>;
+# in-container the subdir is mounted read-write at
+# MANAGER_BASE_DIR/telemetry).
+TELEMETRY_SUBDIR = "telemetry"
+STEP_RING_NAME = "step_telemetry.ring"
 
 LOCK_DIR = "/tmp/.vtpu_lock"                        # per-device OFD locks
 VMEM_DIR = "/tmp/.vmem_node"

@@ -25,6 +25,7 @@ SERIAL_BIND_NODE = "SerialBindNode"
 TRACING = "Tracing"                     # vtrace allocation-path spans
 SCHEDULER_SNAPSHOT = "SchedulerSnapshot"  # watch-driven cluster snapshot
 FAULT_INJECTION = "FaultInjection"      # vtfault failpoint registry
+STEP_TELEMETRY = "StepTelemetry"        # vttel per-tenant step rings
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -51,6 +52,11 @@ _KNOWN = {
     # lookup; on, VTPU_FAILPOINTS arms seeded injections
     # (resilience/failpoints.py — chaos/staging only, never production).
     FAULT_INJECTION: False,
+    # Default off: with the gate off Allocate injects no telemetry
+    # mount/env and the tenant-side check is one env-var branch; on,
+    # tenants write per-step records into a seqlock shm ring the monitor
+    # folds into per-pod histograms (vtpu_manager/telemetry/).
+    STEP_TELEMETRY: False,
 }
 
 
