@@ -77,8 +77,13 @@ test-chaos: ## Seeded chaos suite: failpoints at every site over the e2e path (C
 test-telemetry: ## vttel suite: step ring ABI + torture, aggregation, pressure hint, hermetic e2e
 	$(PYTEST) tests/test_telemetry.py -q
 
+.PHONY: test-ha
+test-ha: ## vtha suite: shard leases/fencing units + the multi-scheduler chaos topology (CHAOS_SEED=n CHAOS_TOPOLOGY=multi reproduces one seed)
+	$(PYTEST) tests/test_ha.py -q
+	CHAOS_TOPOLOGY=multi $(PYTEST) tests/test_chaos.py -q -k multi_scheduler
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -51,6 +51,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--trace-spool-dir", default=None,
                         help="vtrace span spool directory (default: the "
                              "shared node trace dir)")
+    parser.add_argument("--lease-namespace", default="vtpu-system",
+                        help="namespace of the vtha shard leases; the "
+                             "reschedule controller's committed-unbound "
+                             "reaper probes them so a live peer "
+                             "scheduler's in-flight bind is never "
+                             "reaped on wall-clock alone (docs/ha.md)")
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="serve THIS process's resilience counters "
                              "(reschedule reconcile failures, retry/"
@@ -312,12 +318,18 @@ def main(argv: list[str] | None = None) -> int:
 
     controller = None
     if gates.enabled(RESCHEDULE):
+        from vtpu_manager.scheduler.lease import read_lease_state
         controller = RescheduleController(
             client, args.node_name,
             known_uuids={c.uuid for c in chips},
             # ClientMode: the reconcile's live-pod set also reaps the
             # registry's orphan (pod, container) bindings
-            registry=registry_srv)
+            registry=registry_srv,
+            # vtha: intents stamped with a shard fence are judged by
+            # fencing token + lease liveness before the wall-clock rule;
+            # unstamped intents (HA off) never trigger the probe
+            lease_probe=lambda shard: read_lease_state(
+                client, shard, namespace=args.lease_namespace))
         controller.start()
 
     stop = []

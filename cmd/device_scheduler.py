@@ -47,6 +47,23 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--fake-chips", type=int, default=4)
     parser.add_argument("--debug-endpoints", action="store_true",
                         help="expose /debug/stacks (thread dumps)")
+    parser.add_argument("--shard-pools", default="",
+                        help="SchedulerHA gate: the cluster partition — "
+                             "semicolon-separated shards, each a comma-"
+                             "list of node-pool label values; '*' is the "
+                             "catch-all shard (appended automatically). "
+                             "EVERY replica must be started with the "
+                             "same value (docs/ha.md)")
+    parser.add_argument("--lease-ttl", type=float, default=15.0,
+                        help="SchedulerHA gate: shard lease TTL seconds. "
+                             "A dead leader's shards are taken over "
+                             "within one TTL; renew cadence is TTL/3")
+    parser.add_argument("--lease-namespace", default="vtpu-system",
+                        help="namespace holding the per-shard "
+                             "coordination Lease objects")
+    parser.add_argument("--scheduler-id", default="",
+                        help="holder identity on shard leases (default: "
+                             "<hostname>-<pid>, unique per incarnation)")
     parser.add_argument("--trace-sampling-rate", type=float, default=1.0,
                         help="fraction of traced pods whose scheduler "
                              "spans are recorded (Tracing gate)")
@@ -66,6 +83,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
     from vtpu_manager.scheduler.serial import SerialLocker
     from vtpu_manager.util.featuregates import (FAULT_INJECTION,
+                                                SCHEDULER_HA,
                                                 SCHEDULER_SNAPSHOT,
                                                 SERIAL_BIND_NODE,
                                                 SERIAL_FILTER_NODE,
@@ -101,32 +119,56 @@ def main(argv: list[str] | None = None) -> int:
         from vtpu_manager.client.kube import InClusterClient
         client = InClusterClient()
 
-    # SchedulerSnapshot (default off): list+watch incremental cluster
-    # state replaces the TTL-LIST caches; a daemon thread consumes the
-    # watch so filter passes never pay list/decode latency. The TTL path
-    # below stays the shipped fallback while the gate is off.
-    snapshot = None
-    if gates.enabled(SCHEDULER_SNAPSHOT):
-        from vtpu_manager.scheduler.snapshot import ClusterSnapshot
-        snapshot = ClusterSnapshot(client)
-        snapshot.start_background(poll_s=args.snapshot_poll_ms / 1000.0)
+    filter_kwargs = dict(
+        serialize=gates.enabled(SERIAL_FILTER_NODE),
+        require_node_label=args.require_node_label,
+        pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0,
+        nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0)
 
-    bind_locker = SerialLocker(gates.enabled(SERIAL_BIND_NODE))
-    api = SchedulerAPI(
-        # SerialFilterNode (default on, matching FilterPredicate's own
-        # default): --feature-gates=SerialFilterNode=false trades the
-        # double-booking defense for raw filter throughput (the assumed
-        # cache still covers committed placements)
-        FilterPredicate(client,
-                        serialize=gates.enabled(SERIAL_FILTER_NODE),
-                        require_node_label=args.require_node_label,
-                        pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0,
-                        nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0,
-                        snapshot=snapshot),
-        BindPredicate(client, locker=bind_locker),
-        PreemptPredicate(client, snapshot=snapshot),
-        debug_endpoints=args.debug_endpoints,
-        snapshot=snapshot)
+    if gates.enabled(SCHEDULER_HA):
+        # vtha (default off): N replicas run active-active over a
+        # node-pool shard plan — each leads the shards whose lease it
+        # holds and hot-stands-by for the rest (scheduler/shard.py).
+        # Every shard gets its own snapshot when SchedulerSnapshot is
+        # also on; the TTL path is shard-scoped via the node-pool gate.
+        import socket
+        from vtpu_manager.scheduler.shard import (ShardPlan,
+                                                  ShardedScheduler)
+        holder = args.scheduler_id or \
+            f"{socket.gethostname()}-{os.getpid()}"
+        sharded = ShardedScheduler(
+            client, ShardPlan.parse(args.shard_pools), holder,
+            lease_ttl_s=args.lease_ttl,
+            lease_namespace=args.lease_namespace,
+            use_snapshot=gates.enabled(SCHEDULER_SNAPSHOT),
+            filter_kwargs=filter_kwargs,
+            bind_locker=SerialLocker(gates.enabled(SERIAL_BIND_NODE)))
+        sharded.start(snapshot_poll_s=args.snapshot_poll_ms / 1000.0)
+        api = SchedulerAPI(sharded, sharded, sharded,
+                           debug_endpoints=args.debug_endpoints,
+                           ha=sharded)
+    else:
+        # SchedulerSnapshot (default off): list+watch incremental cluster
+        # state replaces the TTL-LIST caches; a daemon thread consumes the
+        # watch so filter passes never pay list/decode latency. The TTL
+        # path below stays the shipped fallback while the gate is off.
+        snapshot = None
+        if gates.enabled(SCHEDULER_SNAPSHOT):
+            from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+            snapshot = ClusterSnapshot(client)
+            snapshot.start_background(poll_s=args.snapshot_poll_ms / 1000.0)
+
+        bind_locker = SerialLocker(gates.enabled(SERIAL_BIND_NODE))
+        api = SchedulerAPI(
+            # SerialFilterNode (default on, matching FilterPredicate's own
+            # default): --feature-gates=SerialFilterNode=false trades the
+            # double-booking defense for raw filter throughput (the assumed
+            # cache still covers committed placements)
+            FilterPredicate(client, snapshot=snapshot, **filter_kwargs),
+            BindPredicate(client, locker=bind_locker),
+            PreemptPredicate(client, snapshot=snapshot),
+            debug_endpoints=args.debug_endpoints,
+            snapshot=snapshot)
 
     from vtpu_manager.util.tlsreload import serving_context
     ssl_ctx = serving_context(args.cert_file, args.key_file)

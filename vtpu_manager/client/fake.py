@@ -62,6 +62,11 @@ class FakeKubeClient:
         self.resourceclaims: dict[tuple[str, str], dict] = {}
         self.resourceslices: dict[str, dict] = {}
         self.pdbs: list[dict] = []
+        # vtha coordination leases: (ns, name) -> lease dict. Every write
+        # is appended to lease_history so tests can assert CAS/token
+        # monotonicity over the whole run, not just the final state.
+        self.leases: dict[tuple[str, str], dict] = {}
+        self.lease_history: list[tuple[str, str, dict]] = []
         # -- watch machinery ------------------------------------------------
         self._rv = 0                          # one version for both kinds
         self._watch_retention = watch_retention
@@ -286,6 +291,52 @@ class FakeKubeClient:
             return [copy.deepcopy(p) for p in self.pdbs
                     if not namespace
                     or p["metadata"].get("namespace", "default") == namespace]
+
+    # -- coordination leases (vtha) -----------------------------------------
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        failpoints.fire("kube.request", op="get_lease")
+        with self._lock:
+            lease = self.leases.get((namespace, name))
+            if lease is None:
+                raise KubeError(404, f"lease {namespace}/{name} not found")
+            return copy.deepcopy(lease)
+
+    def create_lease(self, namespace: str, name: str,
+                     annotations: dict) -> dict:
+        failpoints.fire("kube.request", op="create_lease")
+        with self._lock:
+            if (namespace, name) in self.leases:
+                raise KubeError(409, f"lease {namespace}/{name} exists")
+            self._rv += 1
+            lease = {"metadata": {"name": name, "namespace": namespace,
+                                  "annotations": dict(annotations),
+                                  "resourceVersion": str(self._rv)},
+                     "spec": {}}
+            self.leases[(namespace, name)] = lease
+            self.lease_history.append(("create", name, dict(annotations)))
+            return copy.deepcopy(lease)
+
+    def update_lease(self, namespace: str, name: str, annotations: dict,
+                     resource_version: str) -> dict:
+        failpoints.fire("kube.request", op="update_lease")
+        with self._lock:
+            lease = self.leases.get((namespace, name))
+            if lease is None:
+                raise KubeError(404, f"lease {namespace}/{name} not found")
+            current = lease["metadata"].get("resourceVersion", "")
+            if resource_version != current:
+                # the CAS contract: a stale writer (lost a race with
+                # another scheduler) is rejected exactly like the
+                # apiserver's optimistic-concurrency 409
+                raise KubeError(
+                    409, f"lease {namespace}/{name} conflict: have "
+                         f"{current}, got {resource_version}")
+            self._rv += 1
+            lease["metadata"]["annotations"] = dict(annotations)
+            lease["metadata"]["resourceVersion"] = str(self._rv)
+            self.lease_history.append(("update", name, dict(annotations)))
+            return copy.deepcopy(lease)
 
     # -- DRA objects --------------------------------------------------------
 

@@ -74,6 +74,15 @@ class KubeClient(Protocol):
                    timeout_s: float = 30.0) -> Iterable[dict]: ...
     def watch_nodes(self, resource_version: str,
                     timeout_s: float = 30.0) -> Iterable[dict]: ...
+    # -- coordination leases (vtha shard leader election) -------------------
+    # CAS contract: update_lease with a stale resource_version raises
+    # KubeError(409) — the apiserver's optimistic concurrency is the one
+    # serialization point shard leadership rests on (scheduler/lease.py).
+    def get_lease(self, namespace: str, name: str) -> dict: ...
+    def create_lease(self, namespace: str, name: str,
+                     annotations: dict) -> dict: ...
+    def update_lease(self, namespace: str, name: str, annotations: dict,
+                     resource_version: str) -> dict: ...
 
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -241,6 +250,35 @@ class InClusterClient:
                 "/poddisruptionbudgets" if namespace
                 else "/apis/policy/v1/poddisruptionbudgets")
         return self._request("GET", path).get("items", [])
+
+    # -- coordination leases (vtha) -----------------------------------------
+
+    _LEASE_BASE = "/apis/coordination.k8s.io/v1/namespaces"
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "GET", f"{self._LEASE_BASE}/{namespace}/leases/{name}")
+
+    def create_lease(self, namespace: str, name: str,
+                     annotations: dict) -> dict:
+        return self._request(
+            "POST", f"{self._LEASE_BASE}/{namespace}/leases",
+            {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+             "metadata": {"name": name, "namespace": namespace,
+                          "annotations": annotations},
+             "spec": {}})
+
+    def update_lease(self, namespace: str, name: str, annotations: dict,
+                     resource_version: str) -> dict:
+        # PUT with the expected resourceVersion: the apiserver rejects a
+        # stale writer with 409 Conflict — this IS the CAS
+        return self._request(
+            "PUT", f"{self._LEASE_BASE}/{namespace}/leases/{name}",
+            {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+             "metadata": {"name": name, "namespace": namespace,
+                          "annotations": annotations,
+                          "resourceVersion": resource_version},
+             "spec": {}})
 
     # -- DRA objects --------------------------------------------------------
 

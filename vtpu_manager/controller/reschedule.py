@@ -36,6 +36,7 @@ from vtpu_manager.device.types import get_pod_device_claims
 from vtpu_manager.resilience import failpoints, recovery
 from vtpu_manager.resilience.policy import (COUNTERS, CircuitOpenError,
                                             KubeResilience)
+from vtpu_manager.scheduler import lease as lease_mod
 from vtpu_manager.util import consts
 
 log = logging.getLogger(__name__)
@@ -51,9 +52,21 @@ class RescheduleController:
                  interval_s: float = 15.0,
                  resilience: KubeResilience | None = None,
                  intent_ttl_s: float = consts.DEFAULT_STUCK_GRACE_S,
-                 registry=None, intent_scan_every: int = 4):
+                 registry=None, intent_scan_every: int = 4,
+                 lease_probe=None, clock=time.time):
         self.client = client
         self.node_name = node_name
+        # vtha: ``lease_probe(shard) -> LeaseState | None`` (typically
+        # scheduler.lease.read_lease_state). With it, the
+        # committed-unbound reaper keys eligibility off fencing token +
+        # lease LIVENESS: an intent stamped by a scheduler that still
+        # holds its shard lease under the same token belongs to a live —
+        # possibly just slow — peer and is never reaped on wall-clock
+        # alone; a stale token is reapable immediately. None (single
+        # scheduler) keeps the PR 4 wall-clock rule untouched.
+        self.lease_probe = lease_probe
+        self._clock = clock
+        self._lease_states: dict[str, object] = {}
         self.known_uuids = known_uuids or set()
         self.checkpoint_path = checkpoint_path
         self.interval_s = interval_s
@@ -111,7 +124,10 @@ class RescheduleController:
                         self.consecutive_failures, e)
             return 0
         self.consecutive_failures = 0
-        now = time.time()
+        # lease states probed at most once per shard per pass (the
+        # committed list can hold many pods of one shard)
+        self._lease_states: dict[str, object] = {}
+        now = self._clock()
         # registrations only exist for pods allocated (hence bound) on
         # THIS node, so the resident set is the right liveness truth for
         # the registry reap — node-scoped on every pass
@@ -198,6 +214,33 @@ class RescheduleController:
                 committed.append(pod)
         return resident, committed, live_uids
 
+    def _intent_reap_eligible(self, anns: dict, now: float) -> bool:
+        """Whether a committed-but-unbound pod's intent may be reaped.
+        Wall-clock expiry alone is wrong in an active-active deployment:
+        a slow peer's in-flight bind looks identical to a dead one's.
+        With a lease probe, the fencing stamp decides:
+
+        - stamp token == lease token AND the lease is live -> the owning
+          scheduler is alive and may still land this bind: NOT reapable;
+        - stamp token < lease token -> ownership moved on: the stamp's
+          incarnation is fenced off (its commit-time confirm() can no
+          longer succeed) and the commitment is stale by definition —
+          reapable without any wall-clock wait;
+        - no usable lease signal (no stamp, probe failed, lease gone) ->
+          the PR 4 wall-clock rule."""
+        fence = lease_mod.parse_fence(
+            (anns or {}).get(consts.shard_fence_annotation()))
+        if fence is not None and self.lease_probe is not None:
+            if fence[0] not in self._lease_states:
+                self._lease_states[fence[0]] = self.lease_probe(fence[0])
+            state = self._lease_states[fence[0]]
+            if state is not None:
+                if state.token > fence[1]:
+                    return True
+                if state.token == fence[1] and state.live(now):
+                    return False
+        return recovery.intent_expired(anns, now, self.intent_ttl_s)
+
     def _allocating_stuck(self, anns: dict, now: float) -> bool:
         if anns.get(consts.allocation_status_annotation()) != \
                 consts.ALLOC_STATUS_ALLOCATING:
@@ -219,7 +262,7 @@ class RescheduleController:
             # complete before the Binding lands): the allocation record
             # is live state — clearing it would LEAK the devices
             return False
-        if not recovery.intent_expired(anns, now, self.intent_ttl_s):
+        if not self._intent_reap_eligible(anns, now):
             return False
         ns = meta.get("namespace", "default")
         name = meta.get("name", "")

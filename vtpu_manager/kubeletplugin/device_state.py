@@ -21,6 +21,7 @@ from vtpu_manager.config.node_config import NodeConfig
 from vtpu_manager.device.types import ChipSpec
 from vtpu_manager.kubeletplugin import cdi
 from vtpu_manager.kubeletplugin.checkpoint import Checkpoint, PreparedClaim
+from vtpu_manager.resilience import failpoints
 from vtpu_manager.util import consts
 from vtpu_manager.util.flock import FileLock
 
@@ -216,6 +217,10 @@ class DeviceState:
             existing = self.checkpoint.claims.get(uid)
             if existing is not None:
                 return list(existing.cdi_devices)
+            # vtfault: the whole un-prepared branch below is the crash
+            # surface — nothing is on disk yet, so an injected crash here
+            # must leave no trace (kubelet retries re-enter cleanly)
+            failpoints.fire("dra.prepare", claim=uid)
 
             allocation = ((claim.get("status") or {}).get("allocation")
                           or {})
@@ -304,6 +309,15 @@ class DeviceState:
             with trace.span(trace.context_for_claim(claim), "dra.cdi",
                             claim=uid, devices=len(cdi_names)):
                 cdi.write_spec(spec, uid, self.cdi_dir)
+            # vtfault: fires AFTER the spec landed and BEFORE the
+            # checkpoint write — the partial-write action truncates the
+            # just-written spec and crashes, the torn-CDI-spec case. The
+            # claim is NOT in the checkpoint, so the retrying kubelet
+            # re-prepares from scratch and rewrites the spec whole: a
+            # truncated spec can never back a checkpointed (leaked) claim
+            # (asserted in test_chaos.py).
+            failpoints.fire("dra.cdi_write", claim=uid,
+                            path=cdi.spec_path(uid, self.cdi_dir))
 
             before = dict(self.checkpoint.claims)
             self.checkpoint.claims[uid] = PreparedClaim(

@@ -60,6 +60,17 @@ SITES: dict[str, str] = {
     "trace.spool_flush": "trace/recorder.py flush, before spool I/O",
     "flock.acquire": "util/flock.py FileLock.acquire entry",
     "controller.evict": "controller/reschedule.py _evict entry",
+    "lease.acquire": "scheduler/lease.py try_acquire, before the lease "
+                     "GET/create/CAS sequence",
+    "lease.renew": "scheduler/lease.py renew, before the CAS update (the "
+                   "bind-time confirm() rides this site too)",
+    "shard.handoff": "scheduler/shard.py takeover replay entry, after a "
+                     "lease acquisition and before the shard accepts work",
+    "dra.prepare": "kubeletplugin/device_state.py prepare_claim, after "
+                   "the idempotency check, before any disk write",
+    "dra.cdi_write": "kubeletplugin/device_state.py, after the CDI spec "
+                     "lands on disk and before the checkpoint write "
+                     "(partial-write tears the spec the runtime reads)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

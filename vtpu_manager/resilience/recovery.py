@@ -77,4 +77,9 @@ def commitment_clear_patch() -> dict:
         consts.predicate_time_annotation(): None,
         consts.bind_intent_annotation(): None,
         consts.allocation_status_annotation(): None,
+        # vtha: a cleared commitment must also drop its fencing stamp, or
+        # the re-scheduled pod would keep routing to the dead commitment's
+        # shard and the next takeover would re-judge a fresh commitment by
+        # a stale token
+        consts.shard_fence_annotation(): None,
     }

@@ -16,6 +16,7 @@ from vtpu_manager import trace
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.resilience import failpoints, recovery
 from vtpu_manager.resilience.policy import RetryPolicy
+from vtpu_manager.scheduler.lease import LeaseLostError
 from vtpu_manager.scheduler.serial import SerialLocker
 from vtpu_manager.util import consts
 
@@ -33,10 +34,18 @@ class BindResult:
 class BindPredicate:
     def __init__(self, client: KubeClient, locker: SerialLocker | None = None,
                  freshness_s: float = consts.DEFAULT_STUCK_GRACE_S,
-                 policy: RetryPolicy | None = None):
+                 policy: RetryPolicy | None = None,
+                 fence=None):
         self.client = client
         self.locker = locker or SerialLocker(serialize_all=False)
         self.freshness_s = freshness_s
+        # vtha (None = pre-HA behavior, byte-identical): the shard's
+        # ShardLease. The fencing token rides the allocating/intent
+        # patch, and confirm() — a CAS lease renew through the apiserver
+        # — runs between that patch and the Binding POST, so a
+        # paused-then-resumed ex-leader's stale bind is rejected at
+        # commit time (its CAS 409s against the new leader's write).
+        self.fence = fence
         # Bind sits on kube-scheduler's binding cycle: keep the retry
         # budget tight (the scheduler re-dispatches a failed bind anyway)
         # but absorb one throttle/transient blip instead of bouncing the
@@ -99,19 +108,35 @@ class BindPredicate:
                     # the Binding POST, so a crash in the window below
                     # leaves a reapable trail (resilience/recovery.py)
                     # instead of a wedged pod
+                    patch = {
+                        consts.allocation_status_annotation():
+                            consts.ALLOC_STATUS_ALLOCATING,
+                        consts.bind_intent_annotation():
+                            recovery.encode_bind_intent(node)}
+                    if self.fence is not None:
+                        # the fencing token rides the same patch: the
+                        # intent trail names the leader incarnation, so
+                        # a takeover replay reaps by token, not guesswork
+                        patch.update(self.fence.fence_annotations())
                     self.policy.run(
                         lambda: self.client.patch_pod_annotations(
-                            ns, name, {
-                                consts.allocation_status_annotation():
-                                    consts.ALLOC_STATUS_ALLOCATING,
-                                consts.bind_intent_annotation():
-                                    recovery.encode_bind_intent(node)}),
+                            ns, name, patch),
                         op="bind.patch")
                 failpoints.fire("scheduler.bind_patch", pod_uid=uid,
                                 node=node)
+                if self.fence is not None:
+                    # commit-time fence: CAS-confirm the lease between
+                    # the intent patch and the Binding POST. A paused or
+                    # fenced-off ex-leader fails HERE — the Binding never
+                    # lands, and the intent just written is exactly the
+                    # trail the new leader's takeover replay reaps.
+                    self.fence.confirm()
                 self.policy.run(
                     lambda: self.client.bind_pod(ns, name, node),
                     op="bind.binding")
+            except LeaseLostError as e:
+                return BindResult(
+                    error=f"bind rejected at commit (lease fence): {e}")
             except KubeError as e:
                 return BindResult(error=f"bind failed: {e}")
             return BindResult()

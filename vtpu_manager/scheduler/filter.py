@@ -53,6 +53,7 @@ from vtpu_manager.resilience import failpoints
 from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.scheduler import gang, reason as R
 from vtpu_manager.scheduler import snapshot as snap_mod
+from vtpu_manager.scheduler.lease import LeaseLostError
 from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
 
@@ -98,9 +99,19 @@ class FilterPredicate:
                  pods_ttl_s: float = 0.0,
                  nodes_ttl_s: float = 0.0,
                  snapshot: "snap_mod.ClusterSnapshot | None" = None,
-                 policy: RetryPolicy | None = None):
+                 policy: RetryPolicy | None = None,
+                 fence=None, shard_selector=None):
         self.client = client
         self.serialize = serialize
+        # vtha (both default None = pre-HA behavior, byte-identical):
+        # `fence` is the shard's ShardLease — commits stamp its fencing
+        # token in the SAME patch as the pre-allocation, and a locally
+        # expired lease fails the pass instead of committing unstamped.
+        # `shard_selector(labels) -> bool` gates candidates to this
+        # shard's node pools on the TTL path (the snapshot path is
+        # already shard-scoped at the watch).
+        self.fence = fence
+        self.shard_selector = shard_selector
         self._serial_lock = threading.Lock()
         self.require_node_label = require_node_label
         # commit-patch retry: the pass already paid its full allocation
@@ -280,8 +291,11 @@ class FilterPredicate:
 
     def _node_gate(self, node: dict, req: AllocationRequest) -> str | None:
         meta = node.get("metadata") or {}
+        labels = meta.get("labels") or {}
+        if self.shard_selector is not None \
+                and not self.shard_selector(labels):
+            return R.NODE_OUTSIDE_SHARD
         if self.require_node_label:
-            labels = meta.get("labels") or {}
             if labels.get(NODE_ENABLE_LABEL) != "true":
                 return R.NODE_LABEL_MISMATCH
         anns = meta.get("annotations") or {}
@@ -292,6 +306,9 @@ class FilterPredicate:
     def _entry_gate(self, entry) -> str | None:
         """Snapshot analogue of _node_gate over a precomputed NodeEntry
         (registry decoded at watch-apply time, labels cached)."""
+        if self.shard_selector is not None \
+                and not self.shard_selector(entry.labels):
+            return R.NODE_OUTSIDE_SHARD
         if self.require_node_label and \
                 entry.labels.get(NODE_ENABLE_LABEL) != "true":
             return R.NODE_LABEL_MISMATCH
@@ -555,7 +572,15 @@ class FilterPredicate:
             return result
 
         best = order_nodes(scored)[0]
-        self._commit(pod, req, best)
+        try:
+            self._commit(pod, req, best)
+        except LeaseLostError as e:
+            # vtha: the shard lease expired (or was taken over) between
+            # pass start and commit — the pass must fail WITHOUT writing
+            # a commitment another leader could race
+            result.node_names = []
+            result.error = f"shard lease lost before commit: {e}"
+            return result
         result.node_names = [best.name]
         return result
 
@@ -796,6 +821,11 @@ class FilterPredicate:
             consts.predicate_node_annotation(): best.name,
             consts.predicate_time_annotation(): str(time.time()),
         }
+        if self.fence is not None:
+            # the fencing token rides the SAME patch as the commitment:
+            # every pre-allocation names the leader incarnation that made
+            # it, and a locally expired lease raises before any write
+            anns.update(self.fence.fence_annotations())
         if req.gang_name:
             origin = gang.chosen_origin(best.result.node_info,
                                         best.result.claims)

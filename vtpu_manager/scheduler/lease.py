@@ -1,0 +1,421 @@
+"""vtha shard leases: leader election with fencing tokens.
+
+Each scheduler shard (a node-pool partition of the cluster,
+scheduler/shard.py) is led by at most one scheduler process at a time.
+Leadership rests on one Kubernetes Lease object per shard whose
+*annotations* carry the whole protocol state — holder identity, a
+monotonically increasing **fencing token**, the renew wall-stamp, and the
+TTL — and whose ``metadata.resourceVersion`` provides the CAS: every
+acquisition and every renewal is a full-object PUT with the expected
+resourceVersion, so the apiserver's optimistic concurrency (409 Conflict
+on a stale writer) is the single serialization point. No sidecar
+consensus service, no extra dependency: the same machinery client-go's
+leaderelection package uses.
+
+Three clocks, three jobs:
+
+- the **wall clock** stamps ``renew`` into the lease annotations, because
+  expiry must be judged by *other* processes (a standby decides "the
+  leader is dead" by comparing its own wall clock to the stamp);
+- the **monotonic clock** bounds how long this process may believe its
+  own leadership without a confirmed renewal (``held_fresh``). This is
+  the paused-process defense: CLOCK_MONOTONIC keeps advancing while a
+  process is SIGSTOPped or descheduled, so a leader resumed after a long
+  pause observes its own staleness *locally, before any I/O* and refuses
+  to stamp new commitments;
+- the **fencing token** closes the residual window neither clock can:
+  a commitment written just before a pause carries the token of the
+  incarnation that wrote it, the takeover bumps the token, and everything
+  downstream (the reschedule controller's committed-unbound reaper, the
+  new leader's takeover replay) treats an older token as stale by
+  definition — no wall-clock guessing about a peer that might merely be
+  slow.
+
+``held_fresh`` uses a margin of LEASE_FRESH_FRACTION: the local view of
+leadership expires strictly before the takeover threshold other
+processes apply, so the old leader stops writing before a new leader can
+start — the same renewDeadline < leaseDuration contract as client-go.
+
+Commit-time rejection (split-brain-proof binding): the bind path calls
+``confirm()`` between the intent patch and the Binding POST. confirm()
+is a CAS renew through the apiserver — a paused-then-resumed ex-leader
+whose shard was taken over gets 409 (the new leader's acquisition bumped
+the resourceVersion) and the bind aborts *before* the Binding lands. The
+already-written intent annotation is exactly the crash trail PR 4 built:
+the new leader's takeover replay reaps it by token, never double-places.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.policy import RetryPolicy
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+DEFAULT_LEASE_TTL_S = 15.0
+DEFAULT_LEASE_NAMESPACE = "vtpu-system"
+# held_fresh expires at this fraction of the TTL: the local leadership
+# view must die strictly before a standby's takeover threshold (full TTL)
+LEASE_FRESH_FRACTION = 0.8
+
+# Lease annotation keys (the protocol state lives in annotations; the
+# object's resourceVersion is the CAS handle)
+HOLDER_ANN = "vtpu-manager.io/lease-holder"
+TOKEN_ANN = "vtpu-manager.io/lease-token"
+RENEW_ANN = "vtpu-manager.io/lease-renew"
+TTL_ANN = "vtpu-manager.io/lease-ttl"
+
+
+class LeaseLostError(RuntimeError):
+    """This process does not (or can no longer prove it does) hold the
+    shard lease. Raised by the fencing checks; every raiser carries the
+    shard so operators can grep one line."""
+
+
+def lease_object_name(shard: str) -> str:
+    return f"vtpu-scheduler-{shard}"
+
+
+def encode_fence(shard: str, token: int) -> str:
+    """The pod-annotation stamp: ``<shard>:<token>``."""
+    return f"{shard}:{token}"
+
+
+def parse_fence(value: str | None) -> tuple[str, int] | None:
+    """(shard, token) or None for absent/malformed — garbage reads as
+    absent, same posture as parse_bind_intent (a reaper must never act
+    on a stamp it cannot interpret)."""
+    if not value:
+        return None
+    shard, sep, raw = value.rpartition(":")
+    if not sep or not shard:
+        return None
+    try:
+        return shard, int(raw)
+    except ValueError:
+        return None
+
+
+@dataclass
+class LeaseState:
+    """Decoded view of one shard lease, as any process reads it."""
+
+    shard: str
+    holder: str
+    token: int
+    renew_wall: float
+    ttl_s: float
+
+    def live(self, now_wall: float) -> bool:
+        """Whether the stamped holder must still be assumed alive.
+        Judged against the TTL *the lease carries* (the writers agree on
+        it), never the reader's local default."""
+        return (now_wall - self.renew_wall) <= self.ttl_s
+
+
+def decode_lease_state(shard: str, lease: dict | None) -> LeaseState | None:
+    """LeaseState from a lease object; None when the object is absent or
+    its annotations are garbage (an undecodable lease is treated as
+    expired — acquisition overwrites it with a bumped token)."""
+    if lease is None:
+        return None
+    anns = (lease.get("metadata") or {}).get("annotations") or {}
+    holder = anns.get(HOLDER_ANN, "")
+    try:
+        token = int(anns.get(TOKEN_ANN, ""))
+        renew = float(anns.get(RENEW_ANN, ""))
+        ttl = float(anns.get(TTL_ANN, ""))
+    except (TypeError, ValueError):
+        return None
+    if not holder or token < 0:
+        return None
+    return LeaseState(shard=shard, holder=holder, token=token,
+                      renew_wall=renew, ttl_s=ttl)
+
+
+def read_lease_state(client: KubeClient, shard: str,
+                     namespace: str = DEFAULT_LEASE_NAMESPACE
+                     ) -> LeaseState | None:
+    """One-shot probe used by non-scheduler consumers (the reschedule
+    controller's token-aware reaper). None means "no usable signal" —
+    lease absent, undecodable, or the read failed transiently — and the
+    caller falls back to the wall-clock rule."""
+    try:
+        lease = client.get_lease(namespace, lease_object_name(shard))
+    except KubeError as e:
+        if e.status != 404:
+            log.warning("lease probe for shard %s failed (%s); falling "
+                        "back to wall-clock reaping", shard, e)
+        return None
+    return decode_lease_state(shard, lease)
+
+
+class ShardLease:
+    """One shard's leader lease, from one scheduler process's viewpoint.
+
+    Thread model: the maintenance tick (renew/acquire) and the request
+    paths (``fence_annotations``/``confirm`` during filter/bind) may run
+    concurrently; ``_cas_lock`` serializes the GET→PUT sequences so two
+    of our own threads cannot interleave a CAS and misread a self-induced
+    409 as a takeover. ``held``/``token`` reads outside the lock are
+    GIL-atomic attribute loads of immutable values.
+    """
+
+    def __init__(self, client: KubeClient, shard: str, holder: str,
+                 ttl_s: float = DEFAULT_LEASE_TTL_S,
+                 namespace: str = DEFAULT_LEASE_NAMESPACE,
+                 policy: RetryPolicy | None = None,
+                 monotonic: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self.client = client
+        self.shard = shard
+        self.holder = holder
+        self.ttl_s = ttl_s
+        self.namespace = namespace
+        # lease traffic is light (one renew per ttl/3 per shard) but must
+        # absorb throttling blips; conflicts (409) are terminal for the
+        # policy and classified here
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            base_delay_s=0.05,
+                                            deadline_s=5.0)
+        self._mono = monotonic
+        self._wall = wall
+        self._cas_lock = threading.Lock()
+        self.held = False
+        self.token = 0
+        self._version = ""            # resourceVersion of our last write
+        self._renewed_mono = 0.0
+        # last foreign state observed by a failed acquire (diagnostics +
+        # the "led by <holder>" routing error)
+        self.observed: LeaseState | None = None
+        # counters rendered by shard.py's /metrics block
+        self.renewals = 0
+        self.conflicts = 0
+
+    # -- local fencing checks (no I/O) --------------------------------------
+
+    def held_fresh(self) -> bool:
+        """Leadership this process may still act on: held AND the last
+        confirmed renewal is younger than the fresh fraction of the TTL
+        on the MONOTONIC clock. A paused-then-resumed process fails this
+        before it can touch the network."""
+        if not self.held:
+            return False
+        age = self._mono() - self._renewed_mono
+        return age < self.ttl_s * LEASE_FRESH_FRACTION
+
+    def fence_annotations(self) -> dict:
+        """The pod-patch stamp for a commitment made under this lease.
+        Raises LeaseLostError when leadership cannot be locally proven —
+        the caller must fail the pass, not commit unstamped."""
+        if not self.held_fresh():
+            raise LeaseLostError(
+                f"shard {self.shard}: lease not held fresh "
+                f"(held={self.held})")
+        return {consts.shard_fence_annotation():
+                encode_fence(self.shard, self.token)}
+
+    # -- acquisition / renewal (CAS through the apiserver) ------------------
+
+    def _annotations(self, token: int) -> dict:
+        return {HOLDER_ANN: self.holder, TOKEN_ANN: str(token),
+                RENEW_ANN: repr(self._wall()), TTL_ANN: repr(self.ttl_s)}
+
+    def _adopt(self, lease: dict, token: int) -> None:
+        self.held = True
+        self.token = token
+        self._version = (lease.get("metadata") or {}).get(
+            "resourceVersion", "")
+        self._renewed_mono = self._mono()
+
+    def _lose(self, why: str) -> None:
+        if self.held:
+            log.warning("shard %s: lease lost (%s)", self.shard, why)
+        self.held = False
+
+    def _read(self) -> tuple[LeaseState | None, str]:
+        try:
+            lease = self.policy.run(
+                lambda: self.client.get_lease(
+                    self.namespace, lease_object_name(self.shard)),
+                op="lease.get")
+        except KubeError as e:
+            if e.status == 404:
+                return None, ""
+            raise
+        return (decode_lease_state(self.shard, lease),
+                (lease.get("metadata") or {}).get("resourceVersion", ""))
+
+    def try_acquire(self) -> bool:
+        """Attempt to become (or remain) this shard's leader. Returns
+        True when the lease is held after the call. Never blocks on a
+        live foreign lease — active-active means standing by, not
+        spinning."""
+        failpoints.fire("lease.acquire", shard=self.shard)
+        with self._cas_lock:
+            # vtlint: disable=lock-discipline — the CAS sequence IS the
+            # serialized critical section (same posture as bind's serial
+            # section); only this lease's own threads contend on it
+            return self._try_acquire_locked()
+
+    def _try_acquire_locked(self) -> bool:
+        try:
+            state, version = self._read()
+        except KubeError as e:
+            log.warning("shard %s: lease read failed during acquire: %s",
+                        self.shard, e)
+            return self.held_fresh()
+        if state is None and not version:
+            # no lease object yet: first writer wins the create
+            try:
+                created = self.policy.run(
+                    lambda: self.client.create_lease(
+                        self.namespace, lease_object_name(self.shard),
+                        self._annotations(1)),
+                    op="lease.create")
+            except KubeError as e:
+                if e.status == 409:
+                    self.conflicts += 1
+                    return False        # lost the create race
+                log.warning("shard %s: lease create failed: %s",
+                            self.shard, e)
+                return False
+            self._adopt(created, 1)
+            log.info("shard %s: lease created and acquired (token=1) "
+                     "by %s", self.shard, self.holder)
+            return True
+        now = self._wall()
+        if state is not None and state.holder == self.holder \
+                and state.live(now):
+            if self.token == state.token:
+                # our own live lease (renewal path re-entered via
+                # acquire): refresh the stamp, keep the token — same
+                # incarnation
+                return self._cas(self.token, version, takeover=False)
+            # same holder IDENTITY, different incarnation: a process
+            # restarted with a stable --scheduler-id inside the TTL
+            # window. This MUST take over with a bumped token — adopting
+            # the dead incarnation's token would shield its interrupted
+            # bind intents from every reaper (replay skips token >= ours,
+            # the controller sees token-current + lease-live and defers
+            # forever).
+            return self._cas(state.token + 1, version, takeover=True)
+        if state is None or not state.live(now):
+            # expired (or undecodable) lease: take over with a bumped
+            # fencing token — THE line that makes every commitment of the
+            # previous holder provably stale
+            new_token = (state.token if state is not None else 0) + 1
+            return self._cas(new_token, version, takeover=True)
+        self.observed = state
+        self._lose(f"held live by {state.holder} (token={state.token})")
+        return False
+
+    def _cas(self, token: int, version: str, takeover: bool) -> bool:
+        try:
+            updated = self.policy.run(
+                lambda: self.client.update_lease(
+                    self.namespace, lease_object_name(self.shard),
+                    self._annotations(token), version),
+                op="lease.cas")
+        except KubeError as e:
+            if e.status == 409:
+                self.conflicts += 1
+                self._lose("CAS conflict: another scheduler wrote first")
+                return False
+            log.warning("shard %s: lease CAS failed: %s", self.shard, e)
+            return False
+        self._adopt(updated, token)
+        if takeover:
+            log.info("shard %s: lease ACQUIRED by %s (token=%d)",
+                     self.shard, self.holder, token)
+        return True
+
+    def renew(self) -> None:
+        """Refresh the renew stamp via CAS, keeping the token. Raises
+        LeaseLostError on definitive loss (a foreign writer moved the
+        lease) and re-raises KubeError on transient failure — a blip must
+        NOT drop leadership (held_fresh decays it honestly instead)."""
+        failpoints.fire("lease.renew", shard=self.shard)
+        with self._cas_lock:
+            # vtlint: disable=lock-discipline — see try_acquire
+            self._renew_locked()
+
+    def _renew_locked(self) -> None:
+        if not self.held:
+            raise LeaseLostError(f"shard {self.shard}: not held")
+        for attempt in (0, 1):
+            try:
+                updated = self.policy.run(
+                    lambda: self.client.update_lease(
+                        self.namespace, lease_object_name(self.shard),
+                        self._annotations(self.token), self._version),
+                    op="lease.renew")
+            except KubeError as e:
+                if e.status != 409:
+                    raise          # transient: leadership decays locally
+                self.conflicts += 1
+                # conflict: someone wrote since our version. If that
+                # someone was US (a concurrent renew's response got
+                # lost), re-sync and retry once; anyone else took over.
+                state, version = self._read()
+                if attempt == 0 and state is not None \
+                        and state.holder == self.holder \
+                        and state.token == self.token:
+                    self._version = version
+                    continue
+                holder = state.holder if state is not None else "?"
+                token = state.token if state is not None else -1
+                self._lose(f"taken over by {holder} (token={token})")
+                raise LeaseLostError(
+                    f"shard {self.shard}: lease taken over by {holder} "
+                    f"(token={token} > {self.token})") from e
+            self._adopt(updated, self.token)
+            self.renewals += 1
+            return
+
+    def confirm(self) -> None:
+        """Commit-time fence: prove leadership *through the apiserver*
+        immediately before a side-effecting commit (the Binding POST).
+        Local staleness, a takeover, or any inability to prove ownership
+        all read as LeaseLostError — when in doubt, the commit must not
+        happen."""
+        if not self.held_fresh():
+            raise LeaseLostError(
+                f"shard {self.shard}: lease expired locally "
+                "(paused or renewals failing)")
+        try:
+            self.renew()
+        except LeaseLostError:
+            raise
+        except KubeError as e:
+            raise LeaseLostError(
+                f"shard {self.shard}: cannot confirm lease: {e}") from e
+
+    def release(self) -> None:
+        """Best-effort graceful handoff: stamp the lease expired so a
+        standby can take over without waiting out the TTL. Failure is
+        fine — the TTL path covers it."""
+        with self._cas_lock:
+            # vtlint: disable=lock-discipline — see try_acquire
+            if not self.held:
+                return
+            anns = self._annotations(self.token)
+            anns[RENEW_ANN] = "0"
+            try:
+                self.policy.run(
+                    lambda: self.client.update_lease(
+                        self.namespace, lease_object_name(self.shard),
+                        anns, self._version),
+                    op="lease.release")
+            except KubeError as e:
+                log.warning("shard %s: lease release failed (%s); TTL "
+                            "expiry will cover it", self.shard, e)
+            self.held = False

@@ -24,6 +24,7 @@ NODE_INSUFFICIENT_CAPACITY = "NodeInsufficientCapacity"
 NODE_LABEL_MISMATCH = "NodeLabelMismatch"
 NODE_TOPOLOGY_UNSATISFIED = "TopologyUnsatisfied"
 NODE_GANG_UNALIGNED = "GangUnaligned"
+NODE_OUTSIDE_SHARD = "NodeOutsideShard"
 
 
 @dataclass

@@ -28,13 +28,17 @@ class SchedulerAPI:
     def __init__(self, filter_pred: FilterPredicate, bind_pred: BindPredicate,
                  preempt_pred: PreemptPredicate,
                  debug_endpoints: bool = False,
-                 snapshot=None):
+                 snapshot=None, ha=None):
         self.filter_pred = filter_pred
         self.bind_pred = bind_pred
         self.preempt_pred = preempt_pred
         self.debug_endpoints = debug_endpoints
         # SchedulerSnapshot gate: exported on /metrics when present
         self.snapshot = snapshot
+        # SchedulerHA gate: the ShardedScheduler (the three predicates
+        # above are then its routing facade); /metrics grows the
+        # per-shard leader/token/handoff block and each shard's snapshot
+        self.ha = ha
         self.stats = {"filter": 0, "bind": 0, "preempt": 0, "errors": 0}
         self._started = time.time()
 
@@ -107,6 +111,13 @@ class SchedulerAPI:
         for k, v in self.stats.items():
             lines.append(
                 f'vtpu_scheduler_requests_total{{endpoint="{k}"}} {v}')
+        breakers = []
+        if self.ha is not None:
+            # vtha: per-shard leadership, fencing tokens, handoffs, reaps
+            lines.append(self.ha.render_ha_metrics())
+            for unit in self.ha.units:
+                if unit.snapshot is not None:
+                    breakers.extend(unit.snapshot.breakers())
         if self.snapshot is not None:
             # watch-driven snapshot health: how much change is flowing,
             # how often the watch window was lost (relists), how much
@@ -125,11 +136,16 @@ class SchedulerAPI:
             lines.append("# TYPE vtpu_scheduler_snapshot_generation gauge")
             lines.append(f"vtpu_scheduler_snapshot_generation "
                          f"{self.snapshot.generation}")
+            # LIST/watch verb-family breakers (vtfault follow-up):
+            # vtpu_circuit_state tells an operator the snapshot stopped
+            # even TRYING to reach the apiserver, breaker_open in the
+            # events block counts the rejected pumps
+            breakers.extend(self.snapshot.breakers())
         # retry/breaker counters + failpoint fires (vtfault): how often
         # this process leaned on the resilience layer, and what the
         # FaultInjection gate injected (zero in production)
         from vtpu_manager.resilience.policy import render_resilience_metrics
-        lines.append(render_resilience_metrics())
+        lines.append(render_resilience_metrics(breakers or None))
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

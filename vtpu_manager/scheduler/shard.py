@@ -1,0 +1,520 @@
+"""vtha: shard-scoped scheduling units behind per-shard leader leases.
+
+The cluster is partitioned by **node pool** (the ``node_pool_label()``
+node label; unlabeled nodes form the unnamed default pool) into shards.
+Every scheduler process is built with the same ``--shard-pools`` plan and
+runs one :class:`ShardUnit` per shard — its own shard-scoped
+``ClusterSnapshot`` (watch stream, staleness, generation all per shard),
+its own filter/gang/preempt/bind state — but *leads* only the shards
+whose lease (scheduler/lease.py) it holds. For the rest it is a hot
+standby: the snapshot stays warm, so taking over an expired shard is one
+lease CAS plus a bind-intent replay, bounded by one lease TTL.
+
+Pod ownership is deterministic so exactly one leader owns any pod:
+
+- a pod whose ``nodeSelector`` pins the node-pool label belongs to the
+  shard owning that pool;
+- everything else (no-pool pods, gangs spanning pools) routes through a
+  stable home-shard hash — fnv64 of the gang identity when the pod is a
+  gang member (all members of a gang land in ONE shard, preserving gang
+  semantics) or of the pod uid otherwise.
+
+A request for a shard this process does not lead fails fast with the
+observed holder in the error; kube-scheduler's retry lands it on the
+leading replica (every replica serves the same extender endpoints).
+
+Failover safety rides PR 4's machinery: a freshly acquired shard first
+**replays the bind-intent trail** — commitments stamped with an older
+fencing token for this shard are reaped (cleared if unbound; bound ones
+are left to the reschedule controller's allocating-stuck eviction, which
+respects PDBs) — before the shard accepts work, so an interrupted bind
+is reaped, never double-placed. Fencing tokens are stamped into the same
+patches as the pre-allocation and the allocating-status, and the bind
+path CAS-confirms the lease between the intent patch and the Binding
+POST, so a paused-then-resumed ex-leader's stale bind is rejected at
+commit time (lease.py docstring walks the window arithmetic).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.config.vmem import fnv64
+from vtpu_manager.resilience import failpoints, recovery
+from vtpu_manager.resilience.policy import RetryPolicy
+from vtpu_manager.scheduler import lease as lease_mod
+from vtpu_manager.scheduler.bind import BindPredicate, BindResult
+from vtpu_manager.scheduler.filter import FilterPredicate, FilterResult
+from vtpu_manager.scheduler.lease import LeaseLostError, ShardLease
+from vtpu_manager.scheduler.preempt import PreemptPredicate, PreemptResult
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.util import consts
+from vtpu_manager.util.gangname import resolve_gang_name
+
+log = logging.getLogger(__name__)
+
+CATCH_ALL = "*"
+
+
+def node_pool(node: dict) -> str:
+    """A node's pool, from the node-pool label ('' = default pool)."""
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    return labels.get(consts.node_pool_label(), "")
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """One shard of the plan: a set of named pools, or the catch-all
+    (which owns every pool no other shard names, including '')."""
+
+    index: int
+    name: str
+    pools: frozenset
+    catch_all: bool
+
+    def owns_labels(self, labels: dict, named_pools: frozenset) -> bool:
+        pool = (labels or {}).get(consts.node_pool_label(), "")
+        if self.catch_all:
+            return pool not in named_pools
+        return pool in self.pools
+
+
+class ShardPlan:
+    """The shared cluster partition. Every scheduler replica MUST be
+    started with the same ``--shard-pools`` value — the plan defines
+    lease names and the home-shard hash, and replicas with diverging
+    plans would disagree about pod ownership (documented operator
+    contract, docs/ha.md)."""
+
+    def __init__(self, shards: list[ShardSpec]):
+        if not shards:
+            raise ValueError("shard plan needs at least one shard")
+        if sum(1 for s in shards if s.catch_all) != 1:
+            raise ValueError("shard plan needs exactly one catch-all shard")
+        self.shards = shards
+        self.named_pools = frozenset(
+            p for s in shards for p in s.pools)
+        self._by_pool = {p: s for s in shards for p in s.pools}
+        self._catch_all = next(s for s in shards if s.catch_all)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ShardPlan":
+        """``poolA,poolB;poolC;*`` — semicolon-separated shards, each a
+        comma-list of pool names; ``*`` alone is the catch-all shard
+        (appended automatically when absent). Empty spec = one catch-all
+        shard (sharding degenerates to a single HA leader for the whole
+        cluster)."""
+        shards: list[ShardSpec] = []
+        seen: set[str] = set()
+        parts = [p.strip() for p in (spec or "").split(";") if p.strip()]
+        for i, part in enumerate(parts):
+            if part == CATCH_ALL:
+                shards.append(ShardSpec(i, f"shard{i}", frozenset(), True))
+                continue
+            pools = frozenset(x.strip() for x in part.split(",")
+                              if x.strip())
+            if not pools:
+                raise ValueError(f"empty shard in --shard-pools {spec!r}")
+            dup = pools & seen
+            if dup:
+                raise ValueError(
+                    f"pool(s) {sorted(dup)} named by two shards")
+            seen |= pools
+            shards.append(ShardSpec(i, f"shard{i}", pools, False))
+        if not any(s.catch_all for s in shards):
+            shards.append(ShardSpec(len(shards), f"shard{len(shards)}",
+                                    frozenset(), True))
+        return cls(shards)
+
+    def shard_for_pool(self, pool: str) -> ShardSpec:
+        return self._by_pool.get(pool, self._catch_all)
+
+    def home_shard(self, pod: dict) -> ShardSpec:
+        """Deterministic owner of a pod — identical from every replica.
+        Pool-pinned pods go to their pool's shard; gang members hash by
+        gang identity (one shard owns the WHOLE gang); everything else
+        hashes by pod uid (falling back to ns/name for uid-less test
+        pods)."""
+        spec = pod.get("spec") or {}
+        pinned = (spec.get("nodeSelector") or {}).get(
+            consts.node_pool_label())
+        if pinned is not None:
+            return self.shard_for_pool(pinned)
+        meta = pod.get("metadata") or {}
+        gang, _ = resolve_gang_name(pod)
+        if gang:
+            key = f"gang/{meta.get('namespace', 'default')}/{gang}"
+        else:
+            key = meta.get("uid") or (f"{meta.get('namespace', 'default')}"
+                                      f"/{meta.get('name', '')}")
+        return self.shards[fnv64(key) % len(self.shards)]
+
+
+class ShardUnit:
+    """One shard's full scheduling state inside one process."""
+
+    def __init__(self, spec: ShardSpec, lease: ShardLease,
+                 snapshot: ClusterSnapshot | None,
+                 filter_pred: FilterPredicate, bind_pred: BindPredicate,
+                 preempt_pred: PreemptPredicate):
+        self.spec = spec
+        self.lease = lease
+        self.snapshot = snapshot
+        self.filter_pred = filter_pred
+        self.bind_pred = bind_pred
+        self.preempt_pred = preempt_pred
+        # takeover replay completed under the current token; reset on
+        # every acquisition so a re-acquired shard replays again. The
+        # lock keeps the tick thread and an opportunistic request-path
+        # acquire from running two cluster-LIST replays concurrently.
+        self.replayed_token = -1
+        self.replay_lock = threading.Lock()
+        self.handoffs = 0
+        self.takeover_reaps = 0
+        self.fence_rejections = 0
+
+
+class ShardedScheduler:
+    """N shards, one process, active-active with the process's peers.
+
+    Exposes the same ``filter``/``bind``/``preempt`` entry points as the
+    single predicates so routes.py serves it unchanged; each call routes
+    to the owning shard and is served only while this process holds that
+    shard's lease fresh (and has finished the takeover replay).
+    """
+
+    def __init__(self, client: KubeClient, plan: ShardPlan, holder: str,
+                 lease_ttl_s: float = lease_mod.DEFAULT_LEASE_TTL_S,
+                 lease_namespace: str = lease_mod.DEFAULT_LEASE_NAMESPACE,
+                 use_snapshot: bool = False,
+                 filter_kwargs: dict | None = None,
+                 policy_factory=None, snapshot_factory=None,
+                 bind_locker=None,
+                 monotonic=time.monotonic, wall=time.time):
+        self.client = client
+        self.plan = plan
+        self.holder = holder
+        self.lease_ttl_s = lease_ttl_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        make_policy = policy_factory or (lambda: None)
+        filter_kwargs = dict(filter_kwargs or {})
+        self.units: list[ShardUnit] = []
+        for spec in plan.shards:
+            lease = ShardLease(client, spec.name, holder,
+                               ttl_s=lease_ttl_s,
+                               namespace=lease_namespace,
+                               policy=make_policy(),
+                               monotonic=monotonic, wall=wall)
+            selector = self._shard_selector(spec)
+            snapshot = None
+            if use_snapshot:
+                node_selector = (
+                    lambda node, s=spec: s.owns_labels(
+                        (node.get("metadata") or {}).get("labels") or {},
+                        self.plan.named_pools))
+                if snapshot_factory is not None:
+                    # test hook: the chaos harness injects snapshots with
+                    # forgiving breakers / fast policies
+                    snapshot = snapshot_factory(node_selector)
+                else:
+                    snapshot = ClusterSnapshot(self.client,
+                                               node_selector=node_selector)
+            filter_pred = FilterPredicate(
+                client, snapshot=snapshot, fence=lease,
+                shard_selector=selector,
+                policy=make_policy(), **filter_kwargs)
+            # bind_locker is shared across shards on purpose: the
+            # SerialBindNode gate promises GLOBAL bind ordering in this
+            # process, and shard boundaries must not weaken it
+            bind_pred = BindPredicate(client, locker=bind_locker,
+                                      fence=lease,
+                                      policy=make_policy())
+            preempt_pred = PreemptPredicate(client, snapshot=snapshot)
+            self.units.append(ShardUnit(spec, lease, snapshot,
+                                        filter_pred, bind_pred,
+                                        preempt_pred))
+        # takeover replay pages through the cluster pod list; keep its
+        # own retry budget (it runs on the tick thread, not a request)
+        self._replay_policy = make_policy() or RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, deadline_s=5.0)
+
+    def _shard_selector(self, spec: ShardSpec):
+        return lambda labels: spec.owns_labels(labels,
+                                               self.plan.named_pools)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, tick_s: float | None = None,
+              snapshot_poll_s: float = 1.0) -> None:
+        """Production entry: seed + background-watch every shard snapshot
+        (hot standby keeps them warm even for shards we don't lead) and
+        run the lease tick on a daemon thread (default cadence ttl/3)."""
+        for unit in self.units:
+            if unit.snapshot is not None:
+                unit.snapshot.start_background(poll_s=snapshot_poll_s)
+        interval = tick_s if tick_s is not None else self.lease_ttl_s / 3.0
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.tick()
+                except Exception:
+                    log.exception("vtha lease tick failed")
+
+        self.tick()      # first acquisition attempt before serving
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtha-lease-tick")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for unit in self.units:
+            if unit.snapshot is not None:
+                unit.snapshot.stop_background()
+            if unit.lease.held:
+                unit.lease.release()
+
+    # -- leadership maintenance ---------------------------------------------
+
+    def tick(self) -> None:
+        """One maintenance pass over every shard: renew what we hold,
+        try to acquire what is free/expired, replay after acquisition.
+        Deterministic and thread-free by itself — the chaos harness
+        drives it directly."""
+        for unit in self.units:
+            self._maintain(unit)
+
+    def _maintain(self, unit: ShardUnit) -> None:
+        lease = unit.lease
+        if lease.held:
+            try:
+                lease.renew()
+            except LeaseLostError:
+                unit.replayed_token = -1
+            except KubeError as e:
+                # transient: keep leadership, held_fresh decays it if
+                # renewals keep failing
+                log.warning("shard %s: renew failed transiently: %s",
+                            unit.spec.name, e)
+        elif self._try_acquire(unit):
+            unit.handoffs += 1
+            self._replay_takeover(unit)
+        if lease.held and unit.replayed_token != lease.token:
+            # acquisition succeeded earlier but the replay didn't (crash
+            # or API failure mid-replay): retry until the shard may serve
+            self._replay_takeover(unit)
+
+    @staticmethod
+    def _try_acquire(unit: ShardUnit) -> bool:
+        """try_acquire with transient failures absorbed — an acquisition
+        attempt that could not reach the apiserver is a standby staying
+        standby, not an error to surface."""
+        try:
+            return unit.lease.try_acquire()
+        except KubeError as e:
+            log.warning("shard %s: acquire attempt failed transiently: "
+                        "%s", unit.spec.name, e)
+            return False
+
+    def _replay_takeover(self, unit: ShardUnit) -> None:
+        """Replay the bind-intent trail before the shard accepts work:
+        any commitment stamped with an older fencing token for this
+        shard belonged to a dead (or fenced-off) leader. Unbound ones
+        are cleared so the pods re-enter scheduling; bound ones are the
+        reschedule controller's call (eviction respects PDBs). Never
+        touches real-allocated pods — that would leak devices."""
+        if not unit.replay_lock.acquire(blocking=False):
+            return      # a concurrent replay is already running
+        try:
+            self._replay_locked(unit)
+        finally:
+            # released on CrashFailpoint too — a crashed replay must not
+            # wedge the rebuilt process's next attempt
+            unit.replay_lock.release()
+
+    def _replay_locked(self, unit: ShardUnit) -> None:
+        try:
+            failpoints.fire("shard.handoff", shard=unit.spec.name)
+            pods = self._replay_policy.run(self.client.list_pods,
+                                           op="shard.replay_list")
+        except KubeError as e:
+            log.warning("shard %s: takeover replay list failed (%s); "
+                        "shard stays draining until the next tick",
+                        unit.spec.name, e)
+            return
+        my_token = unit.lease.token
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            fence = lease_mod.parse_fence(
+                anns.get(consts.shard_fence_annotation()))
+            if fence is None or fence[0] != unit.spec.name \
+                    or fence[1] >= my_token:
+                continue
+            if anns.get(consts.real_allocated_annotation()):
+                continue
+            if (pod.get("spec") or {}).get("nodeName"):
+                continue
+            if not anns.get(consts.predicate_node_annotation()):
+                continue
+            ns = meta.get("namespace", "default")
+            name = meta.get("name", "")
+            log.warning("shard %s: reaping stale commitment of %s/%s "
+                        "(token %d < %d)", unit.spec.name, ns, name,
+                        fence[1], my_token)
+            try:
+                self._replay_policy.run(
+                    lambda ns=ns, name=name:
+                        self.client.patch_pod_annotations(
+                            ns, name, recovery.commitment_clear_patch()),
+                    op="shard.replay_clear")
+            except KubeError as e:
+                log.warning("shard %s: stale-commitment clear failed for "
+                            "%s/%s (%s); retrying next tick",
+                            unit.spec.name, ns, name, e)
+                return
+            unit.takeover_reaps += 1
+        unit.replayed_token = my_token
+        log.info("shard %s: takeover replay complete (token=%d, "
+                 "reaped=%d)", unit.spec.name, my_token,
+                 unit.takeover_reaps)
+
+    # -- routing ------------------------------------------------------------
+
+    def unit_for_pod(self, pod: dict) -> ShardUnit:
+        """Owning unit. A pod already committed by an HA scheduler
+        carries the fence stamp — routing honors it so the bind/retry of
+        a committed pod lands on the shard that committed it even if the
+        hash would say otherwise (e.g. plan edges during rollouts)."""
+        fence = lease_mod.parse_fence(
+            ((pod.get("metadata") or {}).get("annotations") or {}).get(
+                consts.shard_fence_annotation()))
+        if fence is not None:
+            for unit in self.units:
+                if unit.spec.name == fence[0]:
+                    return unit
+        return self.units[self.plan.home_shard(pod).index]
+
+    def holds_fresh(self, shard_name: str) -> bool:
+        for unit in self.units:
+            if unit.spec.name == shard_name:
+                return unit.lease.held_fresh()
+        return False
+
+    def _serving(self, unit: ShardUnit) -> str | None:
+        """None when this process may serve the shard, else the routing
+        error (observed holder included for operator grep-ability)."""
+        lease = unit.lease
+        if not lease.held_fresh():
+            # opportunistic acquire: a request may arrive before the
+            # first tick (or right after a peer died) — one cheap CAS
+            # attempt instead of an error the extender must retry
+            if self._try_acquire(unit):
+                unit.handoffs += 1
+                self._replay_takeover(unit)
+        if not lease.held_fresh():
+            observed = lease.observed
+            holder = observed.holder if observed is not None else "?"
+            return (f"shard {unit.spec.name} not led by this scheduler "
+                    f"(holder={holder}); retry lands on the leader")
+        if unit.replayed_token != lease.token:
+            return (f"shard {unit.spec.name} draining: takeover replay "
+                    "pending")
+        return None
+
+    # -- predicate facade (what routes.py calls) ----------------------------
+
+    def filter(self, args: dict) -> FilterResult:
+        pod = args.get("Pod") or args.get("pod") or {}
+        unit = self.unit_for_pod(pod)
+        why = self._serving(unit)
+        if why is not None:
+            unit.fence_rejections += 1
+            return FilterResult(error=why)
+        return unit.filter_pred.filter(args)
+
+    def _unit_for_node(self, node_name: str) -> ShardUnit | None:
+        """Owning unit by bind-target node. The filter only places a pod
+        onto its owning shard's nodes, so the node's pool names the
+        shard — and the node appears in exactly that shard's scoped
+        snapshot, making this a local lookup (no apiserver round-trip on
+        the bind cycle). None when snapshots are off or the watch has
+        not caught the node yet."""
+        if not node_name:
+            return None
+        for unit in self.units:
+            if unit.snapshot is not None \
+                    and unit.snapshot.entry(node_name) is not None:
+                return unit
+        return None
+
+    def bind(self, args: dict) -> BindResult:
+        ns = args.get("PodNamespace") or args.get("podNamespace") \
+            or "default"
+        name = args.get("PodName") or args.get("podName") or ""
+        node = args.get("Node") or args.get("node") or ""
+        unit = self._unit_for_node(node)
+        if unit is None:
+            # TTL mode / watch lag: route by the pod's fence stamp (one
+            # GET; BindPredicate re-fetches inside its serial section for
+            # freshness — that second read is the authoritative one)
+            try:
+                pod = self.client.get_pod(ns, name)
+            except KubeError as e:
+                return BindResult(
+                    error=f"pod fetch failed routing bind: {e}")
+            unit = self.unit_for_pod(pod)
+        why = self._serving(unit)
+        if why is not None:
+            unit.fence_rejections += 1
+            return BindResult(error=why)
+        return unit.bind_pred.bind(args)
+
+    def preempt(self, args: dict) -> PreemptResult:
+        pod = args.get("Pod") or args.get("pod") or {}
+        unit = self.unit_for_pod(pod)
+        why = self._serving(unit)
+        if why is not None:
+            unit.fence_rejections += 1
+            return PreemptResult(error=why)
+        return unit.preempt_pred.preempt(args)
+
+    # -- observability ------------------------------------------------------
+
+    def render_ha_metrics(self) -> str:
+        """Prometheus block appended to /metrics by routes.py."""
+        lines = ["# TYPE vtpu_ha_shard_leader gauge"]
+        for unit in self.units:
+            lines.append(f'vtpu_ha_shard_leader{{shard="{unit.spec.name}"'
+                         f'}} {1 if unit.lease.held_fresh() else 0}')
+        lines.append("# TYPE vtpu_ha_lease_token gauge")
+        for unit in self.units:
+            lines.append(f'vtpu_ha_lease_token{{shard="{unit.spec.name}"'
+                         f'}} {unit.lease.token}')
+        for metric, attr in (
+                ("vtpu_ha_handoffs_total", "handoffs"),
+                ("vtpu_ha_takeover_reaps_total", "takeover_reaps"),
+                ("vtpu_ha_fence_rejections_total", "fence_rejections")):
+            lines.append(f"# TYPE {metric} counter")
+            for unit in self.units:
+                lines.append(f'{metric}{{shard="{unit.spec.name}"}} '
+                             f'{getattr(unit, attr)}')
+        lines.append("# TYPE vtpu_ha_lease_conflicts_total counter")
+        for unit in self.units:
+            lines.append(f'vtpu_ha_lease_conflicts_total{{shard='
+                         f'"{unit.spec.name}"}} {unit.lease.conflicts}')
+        if any(u.snapshot is not None for u in self.units):
+            lines.append(
+                "# TYPE vtpu_ha_shard_snapshot_staleness_seconds gauge")
+            for unit in self.units:
+                if unit.snapshot is not None:
+                    lines.append(
+                        f'vtpu_ha_shard_snapshot_staleness_seconds'
+                        f'{{shard="{unit.spec.name}"}} '
+                        f"{unit.snapshot.staleness_s():.6f}")
+        return "\n".join(lines)

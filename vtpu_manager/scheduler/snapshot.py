@@ -49,7 +49,8 @@ from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import container_kinds, effective_claims
 from vtpu_manager.resilience import failpoints
-from vtpu_manager.resilience.policy import RetryPolicy
+from vtpu_manager.resilience.policy import (CircuitBreaker,
+                                            CircuitOpenError, RetryPolicy)
 from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
 from vtpu_manager.util.gangname import resolve_gang_name
@@ -97,7 +98,8 @@ class SnapshotStats:
 
     __slots__ = ("events_applied", "pod_events", "node_events", "bookmarks",
                  "relists", "watch_errors", "reconnects",
-                 "registry_decodes", "claims_decodes")
+                 "registry_decodes", "claims_decodes", "breaker_open",
+                 "filtered_nodes")
 
     def __init__(self) -> None:
         self.events_applied = 0
@@ -109,6 +111,8 @@ class SnapshotStats:
         self.reconnects = 0            # background-loop recovery cycles
         self.registry_decodes = 0      # decodes performed at apply time
         self.claims_decodes = 0
+        self.breaker_open = 0          # LIST/watch rejected by open breaker
+        self.filtered_nodes = 0        # node events outside this shard
 
     def as_dict(self) -> dict[str, int]:
         return {name: getattr(self, name) for name in self.__slots__}
@@ -193,7 +197,10 @@ class ClusterSnapshot:
     def __init__(self, client: KubeClient,
                  stuck_grace_s: float = consts.DEFAULT_STUCK_GRACE_S,
                  watch_timeout_s: float = 0.0,
-                 retry_policy: RetryPolicy | None = None):
+                 retry_policy: RetryPolicy | None = None,
+                 node_selector=None,
+                 list_breaker: CircuitBreaker | None = None,
+                 watch_breaker: CircuitBreaker | None = None):
         self.client = client
         self.stuck_grace_s = stuck_grace_s
         self.watch_timeout_s = watch_timeout_s
@@ -201,6 +208,24 @@ class ClusterSnapshot:
         # drives its own retries — watch streams are not one-shot calls)
         self.retry_policy = retry_policy or RetryPolicy(
             base_delay_s=0.5, max_delay_s=30.0)
+        # vtha shard scoping: nodes failing the predicate are invisible
+        # to this snapshot (their events count as filtered_nodes); pods
+        # stay global — pending pods carry gang signals and resident
+        # pods of foreign nodes are inert without a NodeEntry.
+        self._node_selector = node_selector
+        # vtfault: one breaker per verb-family. A sustained LIST or
+        # watch-open failure opens its breaker so the pump stops queueing
+        # doomed requests against a down apiserver; breaker_open in
+        # SnapshotStats (and vtpu_circuit_state on /metrics) make the
+        # rejection visible. Thresholds are deliberately forgiving — a
+        # relist storm during an apiserver rollout should degrade to
+        # stale-but-coherent serving, not flap.
+        self.list_breaker = list_breaker or CircuitBreaker(
+            name="snapshot.list", failure_threshold=5,
+            reset_timeout_s=10.0)
+        self.watch_breaker = watch_breaker or CircuitBreaker(
+            name="snapshot.watch", failure_threshold=5,
+            reset_timeout_s=10.0)
         self.stats = SnapshotStats()
         self.generation = 0
         # _lock guards every structure below; only dict/list swaps happen
@@ -331,13 +356,21 @@ class ClusterSnapshot:
         for kind in ("nodes", "pods"):
             try:
                 applied += self._drain(kind, timeout_s)
+                self.watch_breaker.record_success()
+            except CircuitOpenError as e:
+                # the watch breaker is open: no request was issued —
+                # serve the last coherent state, staleness keeps growing
+                log.warning("snapshot %s watch rejected: %s", kind, e)
+                ok = False
             except KubeError as e:
                 if e.status == 410:
                     # our resourceVersion was compacted away: the watch
-                    # window is gone, rebuild from a fresh LIST
+                    # window is gone, rebuild from a fresh LIST (not a
+                    # dependency failure — the breaker doesn't count it)
                     self._relist()
                     relisted = True
                 else:
+                    self.watch_breaker.record_failure()
                     log.warning("snapshot %s watch failed (%s); serving "
                                 "the last coherent state", kind, e)
                     self.stats.watch_errors += 1
@@ -355,6 +388,10 @@ class ClusterSnapshot:
         return applied, relisted
 
     def _drain(self, kind: str, timeout_s: float) -> int:
+        if not self.watch_breaker.allow():
+            self.stats.breaker_open += 1
+            raise CircuitOpenError(
+                f"snapshot watch circuit open; skipping {kind} drain")
         if kind == "nodes":
             events = self.client.watch_nodes(self._nodes_rv,
                                              timeout_s=timeout_s)
@@ -366,6 +403,11 @@ class ClusterSnapshot:
             self.apply_event(kind, event)
             applied += 1
         return applied
+
+    def breakers(self) -> list[CircuitBreaker]:
+        """The LIST/watch verb-family breakers, for /metrics
+        (vtpu_circuit_state{name=...})."""
+        return [self.list_breaker, self.watch_breaker]
 
     def staleness_s(self) -> float:
         """Seconds since the last fully successful pump (0 before the
@@ -416,6 +458,16 @@ class ClusterSnapshot:
         name = meta.get("name", "")
         if not name:
             return
+        if self._node_selector is not None and type_ != "DELETED" \
+                and not self._node_selector(node):
+            # out of shard scope. A pool-label move OFF this shard
+            # arrives as MODIFIED, so an existing entry must go the same
+            # way a deletion would.
+            self.stats.filtered_nodes += 1
+            if name in self._entries:
+                type_ = "DELETED"
+            else:
+                return
         if type_ == "DELETED":
             with self._lock:
                 if name in self._entries:
@@ -592,9 +644,22 @@ class ClusterSnapshot:
         """Full rebuild from fresh versioned LISTs. All decode happens
         before the final swap; readers keep the previous coherent view
         until the atomic publication at the end."""
+        if not self.list_breaker.allow():
+            self.stats.breaker_open += 1
+            raise CircuitOpenError(
+                "snapshot list circuit open; relist rejected")
         self.stats.relists += 1
-        nodes, nodes_rv = self.client.list_nodes_with_version()
-        pods, pods_rv = self.client.list_pods_with_version()
+        try:
+            nodes, nodes_rv = self.client.list_nodes_with_version()
+            pods, pods_rv = self.client.list_pods_with_version()
+        except KubeError:
+            self.list_breaker.record_failure()
+            raise
+        self.list_breaker.record_success()
+        if self._node_selector is not None:
+            kept = [n for n in nodes if self._node_selector(n)]
+            self.stats.filtered_nodes += len(nodes) - len(kept)
+            nodes = kept
         pod_map: dict[str, dict] = {}
         pod_node: dict[str, str] = {}
         pod_class: dict[str, tuple] = {}

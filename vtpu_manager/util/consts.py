@@ -146,6 +146,17 @@ def bind_intent_annotation() -> str:
     return _ann("bind-intent")
 
 
+def shard_fence_annotation() -> str:
+    """vtha fencing stamp ``<shard>:<token>`` written by an HA scheduler
+    in the SAME patch as the pre-allocation (filter commit) and the
+    allocating-status/bind-intent (bind), so every commitment names the
+    shard-leader incarnation that made it. A takeover bumps the lease's
+    fencing token; commitments carrying an older token are stale by
+    definition and the reschedule controller / takeover replay may reap
+    them without waiting out the wall-clock TTL (scheduler/lease.py)."""
+    return _ann("shard-fence")
+
+
 def scheduler_stuck_grace_annotation() -> str:
     """Per-pod override of the stuck pre-allocation grace period
     (reference: SchedulerStuckGracePeriodAnnotation, consts.go:68)."""
@@ -177,6 +188,15 @@ def parse_predicate_time(annotations: dict | None) -> float | None:
         return float(raw)
     except (TypeError, ValueError):
         return None
+
+
+# Node labels ----------------------------------------------------------------
+
+def node_pool_label() -> str:
+    """Node-pool membership label: the vtha sharding key. Nodes without
+    the label belong to the unnamed default pool (owned by the catch-all
+    shard)."""
+    return _ann("node-pool")
 
 
 # Node annotations -----------------------------------------------------------

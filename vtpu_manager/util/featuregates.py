@@ -26,6 +26,7 @@ TRACING = "Tracing"                     # vtrace allocation-path spans
 SCHEDULER_SNAPSHOT = "SchedulerSnapshot"  # watch-driven cluster snapshot
 FAULT_INJECTION = "FaultInjection"      # vtfault failpoint registry
 STEP_TELEMETRY = "StepTelemetry"        # vttel per-tenant step rings
+SCHEDULER_HA = "SchedulerHA"            # vtha sharded active-active scheduler
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -57,6 +58,12 @@ _KNOWN = {
     # tenants write per-step records into a seqlock shm ring the monitor
     # folds into per-pod histograms (vtpu_manager/telemetry/).
     STEP_TELEMETRY: False,
+    # Default off: the single-scheduler path runs byte-identical to the
+    # pre-HA code (no leases read or written, no fence annotations). On,
+    # the process partitions the cluster by node pool into shard-scoped
+    # units behind per-shard leader leases (scheduler/shard.py) so N
+    # scheduler replicas run active-active with leased failover.
+    SCHEDULER_HA: False,
 }
 
 
