@@ -57,6 +57,16 @@ def main(argv: list[str] | None = None) -> int:
                              "reaper probes them so a live peer "
                              "scheduler's in-flight bind is never "
                              "reaped on wall-clock alone (docs/ha.md)")
+    parser.add_argument("--compile-cache-budget-mb", type=int, default=4096,
+                        help="CompileCache gate: LRU byte budget of the "
+                             "node-shared executable cache; the daemon "
+                             "runs the evictor so tenants never pay "
+                             "eviction latency on their compile path")
+    parser.add_argument("--compile-cache-evict-interval", type=float,
+                        default=60.0,
+                        help="seconds between compile-cache evictor "
+                             "passes (also reaps crashed writers' temp "
+                             "files and folds dead tenants' stats)")
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="serve THIS process's resilience counters "
                              "(reschedule reconcile failures, retry/"
@@ -82,7 +92,8 @@ def main(argv: list[str] | None = None) -> int:
                                                      HealthWatcher)
     from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import (CLIENT_MODE, CORE_PLUGIN,
+    from vtpu_manager.util.featuregates import (CLIENT_MODE, COMPILE_CACHE,
+                                                CORE_PLUGIN,
                                                 FAULT_INJECTION,
                                                 HONOR_PREALLOC_IDS,
                                                 MEMORY_PLUGIN, RESCHEDULE,
@@ -192,6 +203,9 @@ def main(argv: list[str] | None = None) -> int:
     # vttel: Allocate mounts the per-container telemetry subdir
     # read-write and injects the step-ring env; off = nothing injected
     vnum.step_telemetry_enabled = gates.enabled(STEP_TELEMETRY)
+    # vtcc: Allocate mounts the node-shared compile cache read-write and
+    # injects the arming env + config field; off = nothing injected
+    vnum.compile_cache_enabled = gates.enabled(COMPILE_CACHE)
     plugins = [vnum]
     if gates.enabled(CORE_PLUGIN):
         plugins.append(VcorePlugin(manager))
@@ -302,6 +316,39 @@ def main(argv: list[str] | None = None) -> int:
                          name="vtpu-plugin-metrics").start()
         log.info("resilience metrics on :%d/metrics", args.metrics_port)
 
+    # vtcc janitor: the daemon owns the shared cache's hygiene — LRU
+    # eviction to the byte budget, crashed-writer temp reaping, and
+    # dead-tenant stats folding — so tenant compile paths never pay it
+    cache_evictor_stop = None
+    if gates.enabled(COMPILE_CACHE):
+        import threading
+        from vtpu_manager.compilecache import CompileCache
+        cache_root = os.path.join(args.base_dir or consts.MANAGER_BASE_DIR,
+                                  consts.COMPILE_CACHE_SUBDIR)
+        try:
+            node_cache = CompileCache(cache_root)
+        except OSError as e:
+            log.warning("compile cache root %s unavailable (%s); "
+                        "evictor disabled", cache_root, e)
+            node_cache = None
+        if node_cache is not None:
+            budget = args.compile_cache_budget_mb << 20
+            cache_evictor_stop = threading.Event()
+
+            def _evict_loop():
+                while not cache_evictor_stop.wait(
+                        args.compile_cache_evict_interval):
+                    try:
+                        node_cache.evict(budget)
+                    except OSError:
+                        log.warning("compile cache evictor pass failed",
+                                    exc_info=True)
+
+            threading.Thread(target=_evict_loop, daemon=True,
+                             name="vtcc-evictor").start()
+            log.info("compile cache at %s (budget %d MiB)",
+                     cache_root, args.compile_cache_budget_mb)
+
     # vttel pressure rollup: this daemon (the node-annotation owner)
     # scans the step rings and patches the node-pressure annotation the
     # scheduler ingests as a soft scoring hint
@@ -348,6 +395,8 @@ def main(argv: list[str] | None = None) -> int:
             watcher.stop()
         if registry_srv:
             registry_srv.stop()
+        if cache_evictor_stop is not None:
+            cache_evictor_stop.set()
         if pressure_pub:
             pressure_pub.stop()
         if controller:

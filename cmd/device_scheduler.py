@@ -82,7 +82,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.preempt import PreemptPredicate
     from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
     from vtpu_manager.scheduler.serial import SerialLocker
-    from vtpu_manager.util.featuregates import (FAULT_INJECTION,
+    from vtpu_manager.util.featuregates import (COMPILE_CACHE,
+                                                FAULT_INJECTION,
                                                 SCHEDULER_HA,
                                                 SCHEDULER_SNAPSHOT,
                                                 SERIAL_BIND_NODE,
@@ -123,7 +124,11 @@ def main(argv: list[str] | None = None) -> int:
         serialize=gates.enabled(SERIAL_FILTER_NODE),
         require_node_label=args.require_node_label,
         pods_ttl_s=args.pod_snapshot_ttl_ms / 1000.0,
-        nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0)
+        nodes_ttl_s=args.node_snapshot_ttl_ms / 1000.0,
+        # vtcc: compile-storm spreading rides filter_kwargs so the
+        # SchedulerHA branch's shards inherit it for free (exactly how
+        # they inherit the vttel pressure penalty)
+        anti_storm=gates.enabled(COMPILE_CACHE))
 
     if gates.enabled(SCHEDULER_HA):
         # vtha (default off): N replicas run active-active over a

@@ -40,7 +40,8 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import TRACING, FeatureGates
+    from vtpu_manager.util.featuregates import (COMPILE_CACHE, TRACING,
+                                                FeatureGates)
     from vtpu_manager.webhook.server import WebhookAPI, run_server
 
     gates = FeatureGates()
@@ -72,7 +73,12 @@ def main(argv: list[str] | None = None) -> int:
             "claim-template creation are disabled")
 
     api = WebhookAPI(scheduler_name=args.scheduler_name,
-                     dra_convert=args.dra_convert, client=client)
+                     dra_convert=args.dra_convert, client=client,
+                     # vtcc: mirror the tenant-declared program
+                     # fingerprint into the scheduler-readable
+                     # annotation (gate off = no new patches, byte-
+                     # identical admission behavior)
+                     stamp_fingerprint=gates.enabled(COMPILE_CACHE))
     logging.getLogger(__name__).info("vtpu-webhook on %s:%d", args.host,
                                      args.port)
     run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)

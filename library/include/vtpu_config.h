@@ -18,11 +18,14 @@
 namespace vtpu {
 
 constexpr uint32_t kConfigMagic = 0x55505456;  // "VTPU"
-constexpr uint32_t kConfigVersion = 1;
+// v2: header grew compile_cache_dir[kCacheDirLen] (vtcc); strict
+// version check — plugin and shim ship together per node.
+constexpr uint32_t kConfigVersion = 2;
 constexpr int kMaxDeviceCount = 64;
 constexpr int kUuidLen = 64;
 constexpr int kNameLen = 64;
 constexpr int kPodUidLen = 48;
+constexpr int kCacheDirLen = 64;
 
 enum CoreLimit : int32_t {
   kCoreLimitNone = 0,
@@ -67,13 +70,17 @@ struct VtpuConfig {
   char container_name[kNameLen];
   int32_t device_count;
   int32_t compat_mode;
+  // vtcc: in-container node-shared compile cache mount; empty string =
+  // CompileCache off for this container (the shim arms only when set)
+  char compile_cache_dir[kCacheDirLen];
   VtpuDevice devices[kMaxDeviceCount];
   uint32_t checksum;  // FNV-1a over all preceding bytes
   uint32_t pad_;
 };
 static_assert(offsetof(VtpuConfig, device_count) == 248, "ABI");
-static_assert(offsetof(VtpuConfig, devices) == 256, "ABI");
-static_assert(sizeof(VtpuConfig) == 256 + 64 * 120 + 8, "VtpuConfig ABI");
+static_assert(offsetof(VtpuConfig, compile_cache_dir) == 256, "ABI");
+static_assert(offsetof(VtpuConfig, devices) == 320, "ABI");
+static_assert(sizeof(VtpuConfig) == 320 + 64 * 120 + 8, "VtpuConfig ABI");
 
 inline uint64_t Fnv1a64(const char* data) {
   uint64_t h = 0xCBF29CE484222325ull;

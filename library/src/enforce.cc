@@ -1672,6 +1672,16 @@ void StartWatcherOnce() {
   pthread_once(&g_watcher_once, [] { StartWatcher(); });
 }
 
+// Cumulative wall time this process has spent blocked in the token-wait
+// loop below, exported for the runtime client: the Python step loop
+// cannot tell quota stall from compute (both hide inside the jitted
+// call), so vttel's throttle-wait field reads this counter's deltas.
+std::atomic<uint64_t> g_throttle_wait_ns{0};
+
+extern "C" uint64_t vtpu_throttle_wait_ns_total() {
+  return g_throttle_wait_ns.load(std::memory_order_relaxed);
+}
+
 void RateLimit(int slot, int64_t cost_us) {
   ShimState& s = State();
   const VtpuDevice* cfg = DeviceCfg(slot);
@@ -1734,7 +1744,10 @@ void RateLimit(int slot, int64_t cost_us) {
       hot.precharged_us.fetch_add(cost_us, std::memory_order_relaxed);
       return;
     }
+    uint64_t sleep_start = NowNs();
     usleep(kTickSleepUs);
+    g_throttle_wait_ns.fetch_add(NowNs() - sleep_start,
+                                 std::memory_order_relaxed);
   }
 }
 

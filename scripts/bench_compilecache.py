@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""vtcc bench: N-replica same-program gang cold start, cache off vs on.
+
+Usage:
+    python scripts/bench_compilecache.py [--replicas 8] [--json]
+
+Three measured scenarios, each launching N replica worker PROCESSES
+simultaneously (the gang-cold-start shape), every worker running the
+same program fingerprint:
+
+1. ``off``   — CompileCache disarmed: every replica pays its own XLA
+   compile (the pre-vtcc world; N compiles of redundant work).
+2. ``cold``  — cache armed, empty: single-flight collapses the gang to
+   ONE compile; the other N-1 replicas block cheaply on the lease
+   (sleep-poll, not a busy compile) and load the shared artifact.
+3. ``warm``  — cache armed, populated (a second wave / rescheduled
+   replica / node-local restart): every replica hits; time-to-first-step
+   drops to artifact-load time.
+
+The compile is a REAL XLA compile (jax.jit lower+compile on the CPU
+backend at a bench-unique shape, so no in-process cache can fake it);
+the artifact stored/loaded through the vtcc store is its StableHLO text
+— a stand-in for the serialized executable on TPU nodes, where JAX's
+persistent compilation cache (armed by runtime/client.install() from
+the same mount) carries the actual binary. Reported per scenario:
+compiles executed, hit/wait counts, per-replica time-to-first-step
+(mean/p50/max), and total compile CPU burned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BENCH_DIM = 384          # unique-ish shape: compile is real, not cached
+
+
+def worker_main() -> None:
+    """One gang replica: install-shape arming, then first step."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from vtpu_manager.compilecache import keys
+    from vtpu_manager.runtime import client as rt
+
+    root = os.environ.get("BENCH_CACHE_ROOT", "")
+    fp = os.environ["BENCH_FP"]
+    t0 = time.monotonic()
+
+    def compile_fn() -> bytes:
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            y = jnp.tanh(x @ x) * 0.5
+            return y / (1.0 + jnp.abs(y).max())
+
+        x = jnp.ones((BENCH_DIM, BENCH_DIM), jnp.float32)
+        lowered = jax.jit(step).lower(x)
+        compiled = lowered.compile()        # the real XLA compile
+        del compiled
+        return lowered.as_text().encode()
+
+    if not root:
+        payload = compile_fn()
+        outcome = "uncached"
+    else:
+        cc = rt.compile_cache()
+        assert cc is not None, "BENCH_CACHE_ROOT set but gate not armed"
+        key = keys.entry_key(fp, f"bench-n1-{BENCH_DIM}",
+                             *keys.runtime_versions())
+        payload, outcome = cc.get_or_compile(key, compile_fn,
+                                             timeout_s=300)
+    ttfs = time.monotonic() - t0
+    print(json.dumps({"pid": os.getpid(), "outcome": outcome,
+                      "ttfs_s": round(ttfs, 4),
+                      "artifact_bytes": len(payload)}))
+
+
+def run_wave(n: int, root: str, fp: str) -> list[dict]:
+    env = dict(os.environ, BENCH_FP=fp, JAX_PLATFORMS="cpu")
+    if root:
+        from vtpu_manager.util import consts
+        env[consts.ENV_COMPILE_CACHE] = "true"
+        env[consts.ENV_COMPILE_CACHE_DIR] = root
+        env["BENCH_CACHE_ROOT"] = root
+    else:
+        env.pop("BENCH_CACHE_ROOT", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        stdout=subprocess.PIPE, text=True, env=env) for _ in range(n)]
+    rows = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        if p.returncode != 0:
+            raise RuntimeError(f"worker failed rc={p.returncode}: {out}")
+        rows.append(json.loads(out.strip().splitlines()[-1]))
+    return rows
+
+
+def summarize(name: str, rows: list[dict]) -> dict:
+    ttfs = sorted(r["ttfs_s"] for r in rows)
+    outcomes = [r["outcome"] for r in rows]
+    compiles = sum(1 for o in outcomes if o in ("miss", "uncached",
+                                                "timeout"))
+    return {
+        "scenario": name,
+        "replicas": len(rows),
+        "compiles": compiles,
+        "hits": outcomes.count("hit"),
+        "single_flight_waits": outcomes.count("wait"),
+        "ttfs_mean_s": round(statistics.mean(ttfs), 4),
+        "ttfs_p50_s": round(ttfs[len(ttfs) // 2], 4),
+        "ttfs_max_s": round(ttfs[-1], 4),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--replicas", type=int, default=8)
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--worker", action="store_true",
+                        help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+    if args.worker:
+        worker_main()
+        return 0
+
+    import tempfile
+    results = []
+    with tempfile.TemporaryDirectory(prefix="vtcc-bench-") as root:
+        results.append(summarize(
+            "off", run_wave(args.replicas, "", "bench-prog")))
+        results.append(summarize(
+            "cold", run_wave(args.replicas, root, "bench-prog")))
+        results.append(summarize(
+            "warm", run_wave(args.replicas, root, "bench-prog")))
+
+    off, cold, warm = results
+    # the headline invariant the PR claims: a same-fingerprint gang cold
+    # start performs exactly ONE compile with the cache armed
+    assert cold["compiles"] == 1, results
+    assert warm["compiles"] == 0, results
+    if args.as_json:
+        print(json.dumps(results, indent=2))
+    else:
+        print(f"{'scenario':8} {'compiles':>8} {'hits':>5} {'waits':>6} "
+              f"{'ttfs mean':>10} {'p50':>8} {'max':>8}")
+        for r in results:
+            print(f"{r['scenario']:8} {r['compiles']:8d} {r['hits']:5d} "
+                  f"{r['single_flight_waits']:6d} "
+                  f"{r['ttfs_mean_s']:9.3f}s {r['ttfs_p50_s']:7.3f}s "
+                  f"{r['ttfs_max_s']:7.3f}s")
+        print(f"\ncompile work: {off['compiles']} -> {cold['compiles']} "
+              f"on the cold gang ({args.replicas - 1} single-flight "
+              f"hits); warm-wave time-to-first-step "
+              f"{off['ttfs_p50_s']:.3f}s -> {warm['ttfs_p50_s']:.3f}s p50")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,34 @@ def _print_timeline(tl: assemble.Timeline) -> None:
               f"traced in that process, or spool not on this node)")
 
 
+def _compile_cache_splice(tl: assemble.Timeline) -> list[dict]:
+    """vtcc splice: the shim.compile spans on this pod's timeline, one
+    row per get_or_compile with its hit/miss/wait outcome — next to the
+    step-stat splice, because the FLAG_COMPILE step the ring records is
+    exactly the step whose duration these outcomes explain. The span
+    carries the duration; the paired shim.compile_outcome event carries
+    the verdict (the span's attrs are written at open time)."""
+    # pair the nth compile span of a key with the nth outcome event of
+    # that key, both in start order — one key compiles repeatedly on a
+    # pod's timeline (miss then hit), so a key-only join would overwrite
+    # every earlier outcome with the last one
+    outcomes: dict[str, list[str]] = {}
+    for s in sorted(tl.spans, key=lambda s: s.start_s):
+        if s.stage == "shim.compile_outcome":
+            outcomes.setdefault(s.attrs.get("key", ""), []).append(
+                s.attrs.get("outcome", "?"))
+    rows = []
+    for s in sorted(tl.spans, key=lambda s: s.start_s):
+        if s.stage != "shim.compile":
+            continue
+        key = s.attrs.get("key", "")
+        queue = outcomes.get(key, [])
+        rows.append({"key": key,
+                     "outcome": queue.pop(0) if queue else "?",
+                     "dur_s": s.dur_s, "start_s": s.start_s})
+    return rows
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="vtrace", description=__doc__,
@@ -105,10 +133,12 @@ def main(argv: list[str] | None = None) -> int:
         from vtpu_manager.telemetry.aggregate import step_stats_for_pod
         steps = step_stats_for_pod(args.steps_dir, tl.trace_id,
                                    tl.pod_uid or args.pod)
+        compiles = _compile_cache_splice(tl)
         if args.as_json:
             print(json.dumps({"timeline": tl.to_wire(),
                               "critical_path": assemble.critical_path(tl),
-                              "steps": steps},
+                              "steps": steps,
+                              "compile_cache": compiles},
                              indent=2))
         else:
             _print_timeline(tl)
@@ -121,6 +151,11 @@ def main(argv: list[str] | None = None) -> int:
                       f"p99 {s['p99_s'] * 1000:.3f} ms  "
                       f"throttle-wait {s['throttle_wait_frac'] * 100:.1f}%"
                       f"  hbm-hw {s['hbm_highwater_bytes']}")
+            for c in compiles:
+                print(f"  compile-cache: {c['outcome']} "
+                      f"({c['dur_s'] * 1000:.3f} ms, key {c['key']})"
+                      + ("" if c['outcome'] != 'miss' else
+                         "  <- this tenant compiled; replicas hit"))
         return 0
 
     if args.list_pods:

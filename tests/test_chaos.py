@@ -501,6 +501,12 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
                    count=rng.randint(1, 2))
     failpoints.arm("dra.cdi_write", "partial-write", p=0.3,
                    count=rng.randint(1, 2))
+    # vtcc sites: driven by the dedicated compile-cache chaos tests
+    # (test_compilecache.py — the e2e loop here never compiles), armed
+    # so the full-coverage assertion stays the honest catalog check
+    failpoints.arm("cache.write", "partial-write", p=0.3,
+                   count=rng.randint(1, 2))
+    failpoints.arm("cache.lease", "crash", p=0.2, count=1)
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 

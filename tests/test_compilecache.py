@@ -1,0 +1,782 @@
+"""vtcc suite: content addressing, store crash-safety, single-flight,
+LRU eviction, chaos (torn entries / dead lease holders), the gate-off
+contract, and the anti-storm scheduler term in BOTH data paths.
+
+The headline invariant — an N-replica same-program gang cold start
+performs exactly ONE compile with zero torn reads — is asserted by a
+real multi-process torture (subprocess workers racing get_or_compile on
+one key), the same shape test_telemetry uses for the step ring.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.compilecache import antistorm, keys
+from vtpu_manager.compilecache.cache import (ENTRY_HEADER_SIZE,
+                                             CompileCache, node_totals,
+                                             render_node_metrics)
+from vtpu_manager.device import types as dt
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.failpoints import CrashFailpoint
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.util import consts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+class TestKeys:
+    def test_sanitize(self):
+        assert keys.sanitize_fingerprint("model-v3.2_abc") == \
+            "model-v3.2_abc"
+        assert keys.sanitize_fingerprint('x"\n/../etc{}') == "x..etc"
+        assert keys.sanitize_fingerprint(None) == ""
+        assert len(keys.sanitize_fingerprint("a" * 200)) == \
+            keys.FINGERPRINT_MAX_LEN
+
+    def test_entry_key_deterministic_and_component_isolated(self):
+        base = keys.entry_key("fp", "n4:0/0/0/0", "0.4.37", "1.0")
+        assert base == keys.entry_key("fp", "n4:0/0/0/0", "0.4.37", "1.0")
+        # every component independently changes the key — a jax or
+        # libtpu bump must MISS cleanly (version-key isolation)
+        assert keys.entry_key("fp2", "n4:0/0/0/0", "0.4.37", "1.0") != base
+        assert keys.entry_key("fp", "n8:0/0/0/0", "0.4.37", "1.0") != base
+        assert keys.entry_key("fp", "n4:0/0/0/0", "0.4.38", "1.0") != base
+        assert keys.entry_key("fp", "n4:0/0/0/0", "0.4.37", "1.1") != base
+        # length-prefixing: component boundaries cannot alias
+        assert keys.entry_key("ab", "c", "d", "e") != \
+            keys.entry_key("a", "bc", "d", "e")
+
+    def test_topology_fingerprint(self):
+        from vtpu_manager.config import vtpu_config as vc
+        devs = [vc.DeviceConfig(uuid="a", total_memory=1, real_memory=1,
+                                host_index=1, mesh=(1, 0, 0)),
+                vc.DeviceConfig(uuid="b", total_memory=1, real_memory=1,
+                                host_index=0, mesh=(0, 0, 0))]
+        # order-independent: replicas enumerate devices differently
+        assert keys.topology_fingerprint(devs) == \
+            keys.topology_fingerprint(list(reversed(devs)))
+        assert keys.topology_fingerprint(devs).startswith("n2:")
+
+    def test_runtime_versions_env_override(self, monkeypatch):
+        monkeypatch.setenv("VTPU_JAX_VERSION", "9.9.9")
+        monkeypatch.setenv("VTPU_LIBTPU_VERSION", "8.8.8")
+        assert keys.runtime_versions() == ("9.9.9", "8.8.8")
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+class TestStore:
+    def test_put_get_roundtrip_and_stats(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        key = keys.entry_key("fp", "t", "j", "l")
+        assert cc.get(key) is None
+        cc.put(key, b"EXECUTABLE" * 100)
+        assert cc.get(key) == b"EXECUTABLE" * 100
+        assert cc.stats.hits == 1 and cc.stats.misses == 1
+        # stats flushed for the monitor under this client's pid-token
+        # identity (pid alone collides across container namespaces),
+        # with the flock'd liveness sentinel alongside
+        stats_file = cc._stats_path()
+        assert json.loads(open(stats_file).read())["hits"] == 1
+        assert os.path.exists(cc._stats_sentinel_path())
+
+    def test_corrupt_entry_quarantined_never_loaded(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        key = "k" * 64
+        cc.put(key, b"payload-bytes")
+        # flip a payload byte: checksum must reject, entry must move to
+        # quarantine (an autopsy artifact, not a servable entry)
+        path = cc.entry_path(key)
+        raw = bytearray(open(path, "rb").read())
+        raw[ENTRY_HEADER_SIZE + 3] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(raw)
+        assert cc.get(key) is None
+        assert not os.path.exists(path)
+        assert len(os.listdir(cc.quarantine_dir)) == 1
+        assert cc.stats.quarantined == 1
+
+    def test_truncated_entry_quarantined(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        key = "t" * 64
+        cc.put(key, b"x" * 4096)
+        with open(cc.entry_path(key), "r+b") as f:
+            f.truncate(ENTRY_HEADER_SIZE + 100)   # torn mid-payload
+        assert cc.get(key) is None
+        assert len(os.listdir(cc.quarantine_dir)) == 1
+
+    def test_lru_eviction_under_tight_budget(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        for i in range(4):
+            cc.put(f"key-{i}" + "0" * 58, b"z" * 100)
+            os.utime(cc.entry_path(f"key-{i}" + "0" * 58),
+                     (1000.0 + i, 1000.0 + i))
+        # a hit refreshes key-0: it must survive over colder key-1/2
+        os.utime(cc.entry_path("key-0" + "0" * 58), (2000.0, 2000.0))
+        entry_size = 100 + ENTRY_HEADER_SIZE
+        evicted = cc.evict(budget_bytes=2 * entry_size)
+        assert evicted == 2 and cc.stats.evictions == 2
+        left = set(os.listdir(cc.entries_dir))
+        assert "key-0" + "0" * 58 in left and "key-3" + "0" * 58 in left
+
+    def test_evict_reaps_stale_tmp(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"), stale_lease_s=0.5)
+        stale = os.path.join(cc.tmp_dir, "dead.123")
+        with open(stale, "w") as f:
+            f.write("torn")
+        os.utime(stale, (1.0, 1.0))
+        cc.evict(budget_bytes=1 << 30)
+        assert not os.path.exists(stale)
+
+    def test_node_totals_and_render(self, tmp_path):
+        root = str(tmp_path / "cc")
+        cc = CompileCache(root)
+        cc.put("e" * 64, b"data")
+        cc.get("e" * 64)
+        cc.get("missing" + "0" * 57)
+        # a second (dead) client's counters fold in via its stats file
+        # (aged past the init-race guard, no flock'd sentinel = dead)
+        dead_path = os.path.join(cc.stats_dir, "999999-beef.json")
+        with open(dead_path, "w") as f:
+            json.dump({"hits": 5, "misses": 2, "single_flight_waits": 1,
+                       "evictions": 0, "quarantined": 0}, f)
+        os.utime(dead_path, (1.0, 1.0))
+        totals, count, size = node_totals(root)
+        assert totals["hits"] == 6 and totals["misses"] == 3
+        assert count == 1 and size > len(b"data")
+        text = render_node_metrics(root, "node-1")
+        assert 'vtpu_compile_cache_hits_total{node="node-1"} 6' in text
+        assert 'vtpu_compile_cache_entries{node="node-1"} 1' in text
+        # dead-client fold keeps totals monotone after the reap
+        cc._fold_dead_stats()
+        assert not os.path.exists(dead_path)
+        totals2, _, _ = node_totals(root)
+        assert totals2["hits"] == 6
+
+    def test_absent_root_renders_headers_only(self, tmp_path):
+        text = render_node_metrics(str(tmp_path / "nope"), "n")
+        assert "# TYPE vtpu_compile_cache_hits_total counter" in text
+        assert 'node="n"' not in text
+
+
+# ---------------------------------------------------------------------------
+# single-flight
+# ---------------------------------------------------------------------------
+
+class TestSingleFlight:
+    def test_lease_excludes_live_holder(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        assert cc.try_acquire_lease("k1")
+        assert not cc.try_acquire_lease("k1")   # same pid counts as live
+        cc.release_lease("k1")
+        assert cc.try_acquire_lease("k1")
+
+    def test_stale_age_takeover(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"), stale_lease_s=0.2)
+        path = cc._lease_path("k")
+        with open(path, "w") as f:       # live pid, ancient stamp
+            f.write(f"{os.getpid()}@{time.time() - 10}")
+        assert cc.try_acquire_lease("k")
+
+    def test_dead_pid_takeover(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        with open(cc._lease_path("k"), "w") as f:
+            f.write(f"4000000@{time.time()}")   # fresh stamp, dead pid
+        assert cc.try_acquire_lease("k")
+
+    def test_garbage_lease_is_takeover_able(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        with open(cc._lease_path("k"), "w") as f:
+            f.write("not-a-lease")
+        assert cc.try_acquire_lease("k")
+
+    def test_release_only_own_lease(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        with open(cc._lease_path("k"), "w") as f:
+            f.write(f"4000000@{time.time()}")
+        cc.release_lease("k")            # not ours: must not unlink
+        assert os.path.exists(cc._lease_path("k"))
+
+    def test_get_or_compile_miss_then_hit(self, tmp_path):
+        cc = CompileCache(str(tmp_path / "cc"))
+        calls = []
+        payload, outcome = cc.get_or_compile(
+            "k" * 64, lambda: calls.append(1) or b"exe")
+        assert (payload, outcome) == (b"exe", "miss")
+        payload, outcome = cc.get_or_compile(
+            "k" * 64, lambda: calls.append(1) or b"exe")
+        assert (payload, outcome) == (b"exe", "hit")
+        assert len(calls) == 1
+        assert not os.listdir(cc.lease_dir)   # released both times
+
+    def test_wedged_holder_fails_open_at_deadline(self, tmp_path):
+        """A LIVE-but-wedged holder: fresh lease whose flock is held
+        (liveness is the flock, not the pid number — container PID
+        namespaces make pids meaningless across tenants)."""
+        import fcntl
+        cc = CompileCache(str(tmp_path / "cc"), stale_lease_s=60.0)
+        with open(cc._lease_path("k"), "w") as f:
+            f.write(f"999999@{time.time()}")   # foreign pid, fresh
+            f.flush()
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)   # wedged-but-alive
+            payload, outcome = cc.get_or_compile("k", lambda: b"local",
+                                                 timeout_s=0.3)
+        assert (payload, outcome) == (b"local", "timeout")
+        assert cc.get("k") is None     # fail-open never populates
+
+    def test_unflocked_fresh_lease_is_dead(self, tmp_path):
+        """The namespace-proof liveness signal: a fresh lease whose
+        flock nobody holds (holder died before its stale age, or a
+        foreign-namespace pid that happens to exist here) is taken
+        over immediately — no 300 s wait."""
+        cc = CompileCache(str(tmp_path / "cc"))
+        with open(cc._lease_path("k"), "w") as f:
+            f.write(f"{os.getpid()}@{time.time()}")  # "alive" pid, no flock
+        assert cc.try_acquire_lease("k")
+
+    def test_multiprocess_torture_one_compile_zero_torn(self, tmp_path):
+        """N replica processes race one key: exactly one compile_fn runs,
+        every process reads back the exact payload (a single torn read
+        exits nonzero), and the late arrivals record single-flight
+        waits."""
+        root = str(tmp_path / "cc")
+        key = keys.entry_key("gang-prog", "n4", "j", "l")
+        marker_dir = tmp_path / "compiles"
+        marker_dir.mkdir()
+        payload = (b"EXEC" * 1000) + b"tail"
+        worker = (
+            "import os, sys, time\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from vtpu_manager.compilecache.cache import CompileCache\n"
+            f"cc = CompileCache({root!r})\n"
+            "def compile_fn():\n"
+            f"    open(os.path.join({str(marker_dir)!r}, "
+            "str(os.getpid())), 'w').close()\n"
+            "    time.sleep(0.4)\n"
+            f"    return {payload!r}\n"
+            f"data, outcome = cc.get_or_compile({key!r}, compile_fn, "
+            "timeout_s=30)\n"
+            f"assert data == {payload!r}, 'TORN READ'\n"
+            "print(outcome)\n")
+        procs = [subprocess.Popen([sys.executable, "-c", worker],
+                                  stdout=subprocess.PIPE, text=True)
+                 for _ in range(6)]
+        outcomes = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, out
+            outcomes.append(out.strip())
+        assert len(os.listdir(marker_dir)) == 1, outcomes
+        assert outcomes.count("miss") == 1
+        assert all(o in ("miss", "wait", "hit") for o in outcomes)
+        totals, count, _ = node_totals(root)
+        assert count == 1
+        assert totals["single_flight_waits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos (failpoints)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def armed_failpoints():
+    failpoints.enable(seed=7)
+    yield
+    failpoints.disable()
+
+
+class TestChaos:
+    def test_torn_write_mid_rename_never_served(self, tmp_path,
+                                                armed_failpoints):
+        """cache.write partial-write: the temp entry is torn and the
+        writer crashes before the rename — waiters/later readers see a
+        clean miss, and no entry (torn or whole) lands."""
+        cc = CompileCache(str(tmp_path / "cc"))
+        failpoints.arm("cache.write", "partial-write", count=1)
+        with pytest.raises(CrashFailpoint):
+            cc.get_or_compile("k" * 64, lambda: b"X" * 2048)
+        assert os.listdir(cc.entries_dir) == []
+        assert cc.get("k" * 64) is None      # miss, not a torn payload
+        # recovery: the next compiler (takeover path exercised below)
+        # populates normally and the torn temp is reaped by the evictor
+        cc2 = CompileCache(str(tmp_path / "cc"), stale_lease_s=0.0)
+        payload, outcome = cc2.get_or_compile("k" * 64, lambda: b"fresh")
+        assert (payload, outcome) == (b"fresh", "miss")
+        cc2.evict(budget_bytes=1 << 30, now=time.time() + 10)
+        assert os.listdir(cc2.tmp_dir) == []
+
+    def test_crash_holding_lease_taken_over_within_budget(self, tmp_path):
+        """cache.lease crash in a SEPARATE process (real process death:
+        no release runs, the lease file stays). A waiter must take over
+        within the stale-lease budget and compile — not block to its
+        own deadline."""
+        root = str(tmp_path / "cc")
+        stale_s = 1.0
+        crasher = (
+            "import os, sys\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from vtpu_manager.resilience import failpoints\n"
+            "from vtpu_manager.compilecache.cache import CompileCache\n"
+            "failpoints.enable(seed=1)\n"
+            "failpoints.arm('cache.lease', 'crash', count=1)\n"
+            f"cc = CompileCache({root!r})\n"
+            "try:\n"
+            "    cc.get_or_compile('K', lambda: b'never')\n"
+            "except BaseException:\n"
+            "    os._exit(0)\n"
+            "os._exit(3)\n")
+        res = subprocess.run([sys.executable, "-c", crasher], timeout=60)
+        assert res.returncode == 0
+        cc = CompileCache(root, stale_lease_s=stale_s)
+        assert os.listdir(cc.lease_dir)      # the dead holder's lease
+        t0 = time.monotonic()
+        payload, outcome = cc.get_or_compile("K", lambda: b"recovered",
+                                             timeout_s=30)
+        elapsed = time.monotonic() - t0
+        assert (payload, outcome) == (b"recovered", "miss")
+        # takeover bounded by the stale budget (+ generous slack), far
+        # under the 30 s waiter deadline
+        assert elapsed < stale_s + 5.0
+
+    def test_forced_torn_entry_on_disk_is_quarantined(self, tmp_path):
+        """Even if a torn file somehow lands at the entry path (e.g. a
+        pre-vtcc writer or filesystem corruption), readers quarantine it
+        rather than serve it."""
+        cc = CompileCache(str(tmp_path / "cc"))
+        with open(cc.entry_path("bad"), "wb") as f:
+            f.write(b"\x01\x02garbage-that-is-not-an-entry")
+        assert cc.get("bad") is None
+        assert os.listdir(cc.entries_dir) == []
+        assert len(os.listdir(cc.quarantine_dir)) == 1
+
+
+# ---------------------------------------------------------------------------
+# scheduler anti-storm term
+# ---------------------------------------------------------------------------
+
+def vtpu_pod(name="p1", number=1, cores=25, memory_mib=1024,
+             annotations=None, node_name=None):
+    pod = {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}",
+                     "annotations": annotations or {}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): number,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): memory_mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    return pod
+
+
+def fp_ann(fp):
+    return {consts.program_fingerprint_annotation(): fp}
+
+
+def two_node_cluster():
+    client = FakeKubeClient()
+    for i in range(2):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                               uuid_prefix=f"TPU-N{i}")
+        client.add_node(dt.fake_node(f"node-{i}", reg))
+    return client
+
+
+def place(pred, client, pod):
+    client.add_pod(pod)
+    result = pred.filter({"Pod": pod})
+    assert not result.error, result.error
+    assert len(result.node_names) == 1
+    return result.node_names[0]
+
+
+class TestAntiStorm:
+    def test_penalty_math(self):
+        now = 1000.0
+        recent = [("fpX", now - 1.0), ("fpX", now - 90.0),
+                  ("fpY", now - 1.0), ("fpX", now - 500.0)]
+        p = antistorm.storm_penalty("fpX", recent, now=now)
+        # two in-window fpX placements: ~1.0 + ~0.5 decay weights;
+        # fpY and the expired one contribute nothing
+        assert 10.0 < p < 20.0
+        assert antistorm.storm_penalty("fpZ", recent, now=now) == 0.0
+        assert antistorm.storm_penalty("", recent, now=now) == 0.0
+        many = [("fpX", now)] * 50
+        assert antistorm.storm_penalty("fpX", many, now=now) == \
+            antistorm.STORM_SCORE_CAP
+
+    def test_ttl_wave_spreads_same_fingerprint(self):
+        client = two_node_cluster()
+        pred = FilterPredicate(client, anti_storm=True)
+        first = place(pred, client, vtpu_pod("a", annotations=fp_ann("prog-1")))
+        second = place(pred, client, vtpu_pod("b", annotations=fp_ann("prog-1")))
+        assert second != first          # storm spread beats binpack
+        # a DIFFERENT program binpacks onto the fuller node as always
+        third = place(pred, client, vtpu_pod("c", annotations=fp_ann("prog-2")))
+        assert third == first
+
+    def test_snapshot_wave_spreads_same_fingerprint(self):
+        client = two_node_cluster()
+        snap = ClusterSnapshot(client)
+        snap.start()
+        pred = FilterPredicate(client, snapshot=snap, anti_storm=True)
+        first = place(pred, client, vtpu_pod("a", annotations=fp_ann("prog-1")))
+        second = place(pred, client, vtpu_pod("b", annotations=fp_ann("prog-1")))
+        assert second != first
+
+    def test_snapshot_resident_fingerprints_repel(self):
+        """The watch-fed path: a bound resident pod carrying the stamped
+        fingerprint + a fresh predicate time repels the next replica
+        even with no in-process commit history (fresh scheduler)."""
+        client = two_node_cluster()
+        holder = vtpu_pod("holder", node_name="node-0", annotations={
+            **fp_ann("prog-1"),
+            consts.predicate_time_annotation(): str(time.time()),
+        })
+        client.add_pod(holder)
+        snap = ClusterSnapshot(client)
+        snap.start()
+        pred = FilterPredicate(client, snapshot=snap, anti_storm=True)
+        assert place(pred, client, vtpu_pod("b", annotations=fp_ann("prog-1"))) \
+            == "node-1"
+
+    def test_overlay_retires_when_pod_becomes_visible(self):
+        """A placed pod that surfaces in the resident set contributes
+        through its stamped annotation only — its in-process overlay
+        twin retires (the _assumed pattern), so one placement is never
+        penalized twice."""
+        client = two_node_cluster()
+        pred = FilterPredicate(client, anti_storm=True)
+        now = time.time()
+        pred._record_recent_fp("node-0", "uid-a", "fpX", now)
+        storm = pred._storm_for_node(
+            "node-0", pred._recent_fp_overlay(now), {"uid-a"},
+            [("fpX", now)])   # same pod, now annotation-visible
+        assert storm == [("fpX", now)]          # once, not twice
+        assert "node-0" not in pred._recent_fp  # overlay twin retired
+        # an unseen pod's overlay entry survives and folds in
+        pred._record_recent_fp("node-0", "uid-b", "fpX", now)
+        storm = pred._storm_for_node(
+            "node-0", pred._recent_fp_overlay(now), {"uid-a"}, [])
+        assert storm == [("fpX", now)]
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_soft_preference_never_vetoes_capacity(self, mode):
+        """Capacity-feasibility parity: when ONE node can fit the pod, a
+        same-fingerprint storm on it must not veto — the pod still
+        lands there (in both data paths)."""
+        client = FakeKubeClient()
+        reg = dt.fake_registry(4, mesh_shape=(2, 2))
+        client.add_node(dt.fake_node("solo", reg))
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap, anti_storm=True)
+        for i in range(3):
+            assert place(pred, client, vtpu_pod(f"p{i}",
+                                        annotations=fp_ann("prog"))) \
+                == "solo"
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_gate_off_scores_byte_identical(self, mode, monkeypatch):
+        """anti_storm off (the CompileCache gate's default): the penalty
+        hook must never run, and placements match a fingerprint-free
+        wave exactly — byte-identical scores."""
+        def boom(*a, **k):
+            raise AssertionError("storm_penalty called with gate off")
+        import vtpu_manager.scheduler.filter as filter_mod
+        monkeypatch.setattr(filter_mod.antistorm, "storm_penalty", boom)
+
+        def run(with_fp: bool) -> list[str]:
+            client = two_node_cluster()
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap)   # default off
+            out = []
+            for i in range(4):
+                anns = fp_ann("prog") if with_fp else {}
+                out.append(place(pred, client, vtpu_pod(f"p{i}",
+                                                annotations=anns)))
+            return out
+
+        assert run(True) == run(False)
+
+
+# ---------------------------------------------------------------------------
+# webhook fingerprint stamp
+# ---------------------------------------------------------------------------
+
+class TestWebhookStamp:
+    def _pod_with_env(self, fp=None, ann=None):
+        pod = vtpu_pod("w")
+        if fp is not None:
+            pod["spec"]["containers"][0]["env"] = [
+                {"name": consts.ENV_PROGRAM_FINGERPRINT, "value": fp}]
+        if ann is not None:
+            pod["metadata"]["annotations"][
+                consts.program_fingerprint_annotation()] = ann
+        return pod
+
+    def _stamped(self, result):
+        ann = consts.program_fingerprint_annotation()
+        path = "/metadata/annotations/" + ann.replace("/", "~1")
+        return [p for p in result.patches if p["path"] == path]
+
+    def test_env_mirrored_to_annotation(self):
+        from vtpu_manager.webhook.mutate import mutate_pod
+        result = mutate_pod(self._pod_with_env(fp="prog-v1"),
+                            stamp_fingerprint=True)
+        stamped = self._stamped(result)
+        assert stamped and stamped[0]["value"] == "prog-v1"
+
+    def test_annotation_wins_and_is_sanitized(self):
+        from vtpu_manager.webhook.mutate import mutate_pod
+        result = mutate_pod(
+            self._pod_with_env(fp="env-fp", ann='explicit"fp'),
+            stamp_fingerprint=True)
+        stamped = self._stamped(result)
+        assert stamped and stamped[0]["value"] == "explicitfp"
+
+    def test_garbage_annotation_removed(self):
+        from vtpu_manager.webhook.mutate import mutate_pod
+        result = mutate_pod(self._pod_with_env(ann='"""'),
+                            stamp_fingerprint=True)
+        stamped = self._stamped(result)
+        assert stamped and stamped[0]["op"] == "remove"
+        assert any("sanitized" in w for w in result.warnings)
+
+    def test_gate_off_no_stamp(self):
+        from vtpu_manager.webhook.mutate import mutate_pod
+        result = mutate_pod(self._pod_with_env(fp="prog-v1"))
+        assert not self._stamped(result)
+
+
+# ---------------------------------------------------------------------------
+# plugin Allocate + runtime client: gate contract
+# ---------------------------------------------------------------------------
+
+def make_plugin(tmp_path, gate_on: bool):
+    from vtpu_manager.config.node_config import NodeConfig
+    from vtpu_manager.deviceplugin.vnum import VnumPlugin, device_id
+    from vtpu_manager.manager.device_manager import DeviceManager
+    from vtpu_manager.tpu.discovery import FakeBackend
+    client = FakeKubeClient()
+    mgr = DeviceManager("node-1", client,
+                        node_config=NodeConfig(device_split_count=4),
+                        backends=[FakeBackend(n_chips=2)])
+    mgr.init_devices()
+    plugin = VnumPlugin(mgr, client, "node-1",
+                        base_dir=str(tmp_path / "mgr"),
+                        node_config=NodeConfig())
+    plugin.compile_cache_enabled = gate_on
+    return plugin, client, mgr, device_id
+
+
+def allocate_one(tmp_path, gate_on: bool):
+    from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+    from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+    plugin, client, mgr, device_id = make_plugin(tmp_path, gate_on)
+    chip = mgr.chips[0]
+    claims = PodDeviceClaims()
+    claims.add("main", DeviceClaim(chip.uuid, chip.index, 50, 2 << 30))
+    client.add_pod({
+        "metadata": {"name": "p1", "namespace": "default", "uid": "uid-p1",
+                     "annotations": {
+                         consts.pre_allocated_annotation(): claims.encode(),
+                         consts.predicate_node_annotation(): "node-1"}},
+        "spec": {"nodeName": "node-1", "containers": [{"name": "main"}]},
+        "status": {"phase": "Pending"},
+    })
+    req = pb.AllocateRequest()
+    creq = req.container_requests.add()
+    creq.devicesIDs.append(device_id(chip.uuid, 0))
+    resp = plugin.allocate(req)
+    return resp.container_responses[0], plugin
+
+
+class TestPluginGate:
+    def test_gate_on_mounts_and_arms(self, tmp_path):
+        cresp, plugin = allocate_one(tmp_path, gate_on=True)
+        assert cresp.envs[consts.ENV_COMPILE_CACHE] == "true"
+        assert cresp.envs[consts.ENV_COMPILE_CACHE_DIR] == \
+            consts.COMPILE_CACHE_DIR
+        mounts = {m.container_path: m for m in cresp.mounts}
+        assert consts.COMPILE_CACHE_DIR in mounts
+        m = mounts[consts.COMPILE_CACHE_DIR]
+        assert not m.read_only
+        assert m.host_path == os.path.join(plugin.base_dir,
+                                           consts.COMPILE_CACHE_SUBDIR)
+        assert os.path.isdir(m.host_path)
+        # the binary config carries the same switch for the C++ shim
+        from vtpu_manager.config import vtpu_config as vc
+        cfg = vc.read_config(os.path.join(
+            plugin.base_dir, "uid-p1_main", "config", "vtpu.config"))
+        assert cfg.compile_cache_dir == consts.COMPILE_CACHE_DIR
+
+    def test_gate_off_no_mount_no_env_no_dir(self, tmp_path):
+        cresp, plugin = allocate_one(tmp_path, gate_on=False)
+        assert consts.ENV_COMPILE_CACHE not in cresp.envs
+        assert consts.ENV_COMPILE_CACHE_DIR not in cresp.envs
+        assert consts.COMPILE_CACHE_DIR not in \
+            {m.container_path for m in cresp.mounts}
+        assert not os.path.exists(os.path.join(
+            plugin.base_dir, consts.COMPILE_CACHE_SUBDIR))
+        from vtpu_manager.config import vtpu_config as vc
+        cfg = vc.read_config(os.path.join(
+            plugin.base_dir, "uid-p1_main", "config", "vtpu.config"))
+        assert cfg.compile_cache_dir == ""
+
+
+class TestRuntimeClientGate:
+    def test_gate_off_zero_cache_io(self, tmp_path, monkeypatch):
+        from vtpu_manager.runtime import client as rt
+        monkeypatch.delenv(consts.ENV_COMPILE_CACHE, raising=False)
+        rt._reset_compile_cache()
+        try:
+            assert rt.compile_cache() is None
+            # cached verdict: no env re-reads after the first call
+            monkeypatch.setenv(consts.ENV_COMPILE_CACHE, "true")
+            assert rt.compile_cache() is None
+            assert not os.listdir(tmp_path)   # zero cache I/O anywhere
+        finally:
+            rt._reset_compile_cache()
+
+    def test_gate_on_arms_and_caches(self, tmp_path, monkeypatch):
+        from vtpu_manager.runtime import client as rt
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE, "true")
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE_DIR,
+                           str(tmp_path / "cc"))
+        rt._reset_compile_cache()
+        try:
+            cc = rt.compile_cache()
+            assert cc is not None and rt.compile_cache() is cc
+            payload, outcome = cc.get_or_compile("k", lambda: b"exe")
+            assert (payload, outcome) == (b"exe", "miss")
+            assert cc.get_or_compile("k", lambda: b"exe")[1] == "hit"
+        finally:
+            rt._reset_compile_cache()
+
+    def test_install_arms_jax_persistent_cache(self, tmp_path,
+                                               monkeypatch):
+        from vtpu_manager.runtime import client as rt
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE, "true")
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE_DIR,
+                           str(tmp_path / "cc"))
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        rt._arm_jax_compile_cache()
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == \
+            str(tmp_path / "cc" / "jax")
+        # operator override wins
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/custom")
+        rt._arm_jax_compile_cache()
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == "/custom"
+
+    def test_gate_off_jax_cache_untouched(self, monkeypatch):
+        from vtpu_manager.runtime import client as rt
+        monkeypatch.delenv(consts.ENV_COMPILE_CACHE, raising=False)
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        rt._arm_jax_compile_cache()
+        assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+
+
+# ---------------------------------------------------------------------------
+# vttel satellite: shim token-wait accounting -> throttle-wait ns
+# ---------------------------------------------------------------------------
+
+class TestShimWaitWiring:
+    def test_wrapper_charges_wait_deltas(self, tmp_path):
+        from vtpu_manager.runtime.client import _ShimWaitStepRing
+        from vtpu_manager.telemetry import stepring
+        total = {"ns": 5000}
+        ring = stepring.StepRingWriter(str(tmp_path / "r.ring"))
+        tel = _ShimWaitStepRing(ring, lambda: total["ns"])
+        total["ns"] += 1234
+        tel.record(10_000)                       # auto: delta since last
+        tel.record(10_000, throttle_wait_ns=77)  # explicit wins
+        total["ns"] = 100                        # shim reload: re-baseline
+        tel.record(10_000)
+        tel.close()
+        reader = stepring.StepRingReader(str(tmp_path / "r.ring"))
+        recs, _, _ = reader.poll(0)
+        reader.close()
+        assert [r.throttle_wait_ns for r in recs] == [1234, 77, 0]
+
+    def test_ctypes_source_reads_real_shim_export(self, tmp_path,
+                                                  monkeypatch):
+        """End-to-end over the REAL channel: a stub .so exporting
+        vtpu_throttle_wait_ns_total (the symbol enforce.cc exports),
+        loaded via the same ctypes path the tenant uses; records must
+        carry the counter deltas, and the pressure rollup must see the
+        resulting quota waits."""
+        src = tmp_path / "stub.cc"
+        src.write_text(
+            'extern "C" unsigned long long vtpu_throttle_wait_ns_total()'
+            "{ static unsigned long long v; v += 250000000ULL; return v; }")
+        so = tmp_path / "libstub.so"
+        try:
+            subprocess.run(["g++", "-shared", "-fPIC", str(src),
+                            "-o", str(so)], check=True,
+                           capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            pytest.skip("no g++ on this box")
+        from vtpu_manager.runtime import client as rt
+        base = tmp_path / "base"
+        ring_dir = base / "uid-x_main" / consts.TELEMETRY_SUBDIR
+        ring_dir.mkdir(parents=True)
+        ring_path = ring_dir / consts.STEP_RING_NAME
+        monkeypatch.setenv(consts.ENV_STEP_TELEMETRY, "true")
+        monkeypatch.setenv(consts.ENV_STEP_RING_PATH, str(ring_path))
+        monkeypatch.setenv(consts.ENV_TPU_LIBRARY_PATH, str(so))
+        rt._reset_step_telemetry()
+        try:
+            tel = rt.step_telemetry()
+            assert isinstance(tel, rt._ShimWaitStepRing)
+            for _ in range(4):
+                tel.record(500_000_000)   # 0.5 s steps, 0.25 s waits
+        finally:
+            rt._reset_step_telemetry()
+        from vtpu_manager.telemetry import stepring
+        reader = stepring.StepRingReader(str(ring_path))
+        recs, _, _ = reader.poll(0)
+        reader.close()
+        assert [r.throttle_wait_ns for r in recs] == [250_000_000] * 4
+        # the pressure annotation chain now reflects REAL quota waits
+        from vtpu_manager.telemetry import TenantStepTelemetry
+        agg = TenantStepTelemetry(str(base))
+        agg.scan()
+        frac, _ = agg.pressure(node_hbm_total=16 << 30)
+        assert 0.3 < frac <= 1.0     # ~50% throttle-wait fraction
+
+    def test_no_shim_no_wrapper(self, tmp_path, monkeypatch):
+        from vtpu_manager.runtime import client as rt
+        from vtpu_manager.telemetry import stepring
+        monkeypatch.setenv(consts.ENV_STEP_TELEMETRY, "true")
+        monkeypatch.setenv(consts.ENV_STEP_RING_PATH,
+                           str(tmp_path / "r.ring"))
+        monkeypatch.delenv(consts.ENV_TPU_LIBRARY_PATH, raising=False)
+        monkeypatch.delenv("VTPU_SHIM_PATH", raising=False)
+        rt._reset_step_telemetry()
+        try:
+            tel = rt.step_telemetry()
+            assert isinstance(tel, stepring.StepRingWriter)
+        finally:
+            rt._reset_step_telemetry()

@@ -46,6 +46,8 @@ int main() {
   printf("cfg.container_name %zu\n", offsetof(VtpuConfig, container_name));
   printf("cfg.device_count %zu\n", offsetof(VtpuConfig, device_count));
   printf("cfg.compat_mode %zu\n", offsetof(VtpuConfig, compat_mode));
+  printf("cfg.compile_cache_dir %zu\n",
+         offsetof(VtpuConfig, compile_cache_dir));
   printf("tc_file_size %zu\n", sizeof(TcUtilFile));
   printf("tc_record_size %zu\n", sizeof(TcDeviceRecord));
   printf("tc_proc_size %zu\n", sizeof(TcProcUtil));

@@ -27,6 +27,11 @@ RULE = "abi-drift"
 
 # module-key -> (relpath suffix, names frozen in the golden)
 TRACKED: dict[str, tuple[str, list[str]]] = {
+    "vtpu_config": ("config/vtpu_config.py", [
+        "MAGIC", "VERSION", "MAX_DEVICE_COUNT", "UUID_LEN", "NAME_LEN",
+        "POD_UID_LEN", "CACHE_DIR_LEN", "_DEVICE_FMT", "DEVICE_SIZE",
+        "_HEADER_FMT", "HEADER_SIZE", "CONFIG_SIZE",
+    ]),
     "tc_watcher": ("config/tc_watcher.py", [
         "MAGIC", "VERSION", "MAX_DEVICE_COUNT", "MAX_PROCS",
         "MAX_EXCESS_POINTS", "_HEADER_FMT", "HEADER_SIZE", "_PROC_FMT",

@@ -4,8 +4,8 @@ A swallowed exception in the scheduler filter, the device manager, or a
 kubelet plugin doesn't crash anything — it silently mis-schedules pods,
 drops health flips, or wedges allocations, which is strictly worse. In
 the control-plane packages (scheduler/, manager/, deviceplugin/,
-kubeletplugin/, trace/, client/) every ``except Exception`` / bare
-``except`` must either
+kubeletplugin/, trace/, client/, resilience/, telemetry/,
+compilecache/) every ``except Exception`` / bare ``except`` must either
 re-raise or log before continuing; bare ``except:`` is always flagged
 (it also eats SystemExit/KeyboardInterrupt).
 
@@ -25,7 +25,8 @@ from vtpu_manager.analysis.core import (Finding, Module, Project, Rule,
 RULE = "exception-hygiene"
 
 SCOPED_DIRS = ("scheduler", "manager", "deviceplugin", "kubeletplugin",
-               "trace", "client", "resilience", "telemetry")
+               "trace", "client", "resilience", "telemetry",
+               "compilecache")
 
 _LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
                 "critical", "log"}

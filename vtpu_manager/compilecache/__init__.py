@@ -1,0 +1,31 @@
+"""vtcc: node-local content-addressed compile cache, shared across tenants.
+
+XLA compilation dominates cold start (PAPER §runtime shim: redundant
+per-tenant setup cost is eliminated by node-level sharing enforced below
+the tenant; PAPERS.md PyGraph makes the same move for CUDA Graphs —
+hoist compilation artifacts out of the per-process path). An N-replica
+gang of the same program landing on one node pays N identical compiles;
+this package turns that into ONE compile plus N-1 cache hits:
+
+- ``keys``  — content addressing: program fingerprint + topology +
+  jax/libtpu versions hash to one entry key, so a runtime upgrade can
+  never serve a stale executable.
+- ``cache`` — the store: checksummed entries landed by write-to-temp +
+  atomic rename (a reader can never map a torn executable), population
+  made **single-flight across tenants** by an O_EXCL lease file with
+  crash-safe takeover (stale-lease age + pid liveness), an LRU
+  byte-budget evictor, and corrupt-entry quarantine.
+- ``antistorm`` — the scheduler's compile-storm term: replicas of one
+  program fingerprint that start simultaneously are spread across nodes
+  as a *soft* score preference (recently-placed same-fingerprint pods
+  per node, decayed by wall clock), so one node warms the cache while
+  the wave lands elsewhere — never a capacity veto.
+
+Everything is behind the ``CompileCache`` feature gate, default off:
+gate-off means no mounts, no env, zero cache I/O in tenants, and
+byte-identical scheduler scores.
+"""
+
+from vtpu_manager.compilecache.cache import (CacheStats,  # noqa: F401
+                                             CompileCache)
+from vtpu_manager.compilecache.keys import entry_key  # noqa: F401

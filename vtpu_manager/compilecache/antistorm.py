@@ -1,0 +1,94 @@
+"""vtcc anti-storm scoring: spread simultaneous same-program starts.
+
+A gang of N replicas of one program admitted in the same instant storms
+whatever node the packing policy likes best: N tenants blocked on one
+compile, all hammering the same chips the moment it finishes. The cache
+makes the SECOND wave cheap; this term shapes the FIRST wave — each
+recently-placed pod of a program fingerprint makes the same node less
+attractive for the next replica of that fingerprint, decaying over the
+compile-scale window, so one node warms the shared cache while the wave
+spreads and later replicas land wherever their single-flight hit is
+already waiting.
+
+Strictly a **soft preference**, wired exactly like vttel's pressure
+penalty (filter._allocate_node subtracts from the score after the
+capacity gate): it can reorder nodes that fit, it can never fail one —
+the capacity-feasibility parity test asserts that in both scheduler
+data paths. Signal sources mirror pressure's too: resident pods carry
+the webhook-stamped fingerprint annotation plus their predicate-time
+stamp (the placement moment), and the filter's own just-committed
+placements overlay via an in-process recent list so a same-pass gang
+burst spreads before any watch event lands.
+"""
+
+from __future__ import annotations
+
+import time
+
+from vtpu_manager.compilecache.keys import sanitize_fingerprint
+from vtpu_manager.util import consts
+
+# Decay window: how long a placement keeps repelling same-fingerprint
+# replicas. Compile-scale — by the time it expires the cache is warm and
+# colocation is free again.
+STORM_WINDOW_S = 180.0
+
+# Per-placement weight and total cap. One fresh same-fingerprint pod
+# costs less than a fully-stalled node's pressure penalty (50), and even
+# a saturated storm (cap 40) never outweighs the +100 gang-domain bonus
+# — gang locality and live-pressure signals both rank above storm
+# avoidance, and packing differences rank below it.
+STORM_SCORE_WEIGHT = 10.0
+STORM_SCORE_CAP = 40.0
+
+
+def pod_fingerprint(pod: dict) -> str:
+    """The pod's sanitized program fingerprint, '' when absent."""
+    anns = (pod.get("metadata") or {}).get("annotations") or {}
+    return sanitize_fingerprint(
+        anns.get(consts.program_fingerprint_annotation()))
+
+
+def recent_from_pods(pods, now: float) -> list[tuple[str, float]]:
+    """(fingerprint, placement_ts) for resident pods still inside the
+    storm window. Placement time is the predicate-time stamp (the moment
+    the scheduler committed the pod there); pods without either signal
+    contribute nothing — absent data degrades to no-signal, exactly like
+    an unparseable pressure annotation."""
+    out: list[tuple[str, float]] = []
+    for pod in pods:
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        raw = anns.get(consts.program_fingerprint_annotation())
+        if not raw:
+            continue
+        ts = consts.parse_predicate_time(anns)
+        if ts is None or not 0 <= now - ts <= STORM_WINDOW_S:
+            continue
+        fp = sanitize_fingerprint(raw)
+        if fp:
+            out.append((fp, ts))
+    return out
+
+
+def storm_penalty(fingerprint: str, recent, now: float | None = None
+                  ) -> float:
+    """Score points to subtract for one node. ``recent`` is an iterable
+    of (fingerprint, placement_ts) pairs; only same-fingerprint entries
+    count, each decaying linearly to zero across the window. Decay is
+    judged HERE at use time (not at collection time) for the same reason
+    pressure re-judges staleness: snapshot entries cache the pair list,
+    and a quiet node emits no events to refresh it."""
+    if not fingerprint or not recent:
+        return 0.0
+    now = time.time() if now is None else now
+    total = 0.0
+    for fp, ts in recent:
+        if fp != fingerprint:
+            continue
+        age = now - ts
+        if not 0 <= age <= STORM_WINDOW_S:
+            continue
+        total += STORM_SCORE_WEIGHT * (1.0 - age / STORM_WINDOW_S)
+        if total >= STORM_SCORE_CAP:
+            return STORM_SCORE_CAP
+    return total

@@ -1,0 +1,759 @@
+"""vtcc store: checksummed entries, single-flight population, LRU, quarantine.
+
+Directory layout under one node-shared root (mounted read-write into
+every CompileCache-gated container at the same path it occupies on the
+host, so host-side tooling and in-container clients name identical
+files)::
+
+    <root>/entries/<key>            checksummed executable blobs
+    <root>/quarantine/<key>.<ns>    corrupt entries, moved aside for autopsy
+    <root>/lease/<key>.lease        single-flight population leases
+    <root>/tmp/                     write-side staging (same filesystem)
+    <root>/stats/<id>.json          per-client op counters (monitor folds)
+    <root>/stats/<id>.lock          flock'd liveness sentinel per client
+    <root>/stats/aggregate.json     dead clients' counters, folded under
+                                    stats/aggregate.json.lock
+
+Crash posture, the whole point of the layout:
+
+- **A torn entry can never be loaded.** Entries land by write-to-temp +
+  fsync + atomic rename; every read re-verifies magic, length and an
+  FNV-1a checksum, and anything that fails verification is renamed into
+  ``quarantine/`` (rename succeeds for exactly one racer) and treated
+  as a miss.
+- **A dead compiler can never wedge the key.** The population lease is
+  a link-atomically-created file carrying ``pid@wall_ts`` whose inode
+  the holder keeps **flock'd** for the compile's lifetime — liveness is
+  the kernel's lock table, which survives per-container PID namespaces
+  (a pid number means nothing across containers; a held flock on the
+  shared filesystem does) and is released by the kernel on any process
+  death. Waiters judge a held lease dead when its flock is grabbable,
+  and stale when older than the budget even if flock'd (a wedged live
+  compiler). Takeover is verify-content → unlink → atomic re-create:
+  the link is the single winner, so the theoretical worst case of two
+  racing takeovers is one duplicate compile (last atomic rename wins,
+  identical content) — never a torn entry, never a deadlock.
+- **Observability can never add failures.** Stats writes are
+  best-effort; a put() that fails after a successful compile degrades
+  to serving the in-memory payload uncached (fail-open), never to
+  failing the tenant.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets
+import struct
+import time
+
+from vtpu_manager import trace
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util.flock import FileLock, LockTimeout
+
+log = logging.getLogger(__name__)
+
+MAGIC = 0x43435456            # "VTCC" little-endian
+VERSION = 1
+
+# entry header: magic u32, version u32, payload_len u64, fnv64 u64
+_ENTRY_HEADER_FMT = "<IIQQ"
+ENTRY_HEADER_SIZE = struct.calcsize(_ENTRY_HEADER_FMT)
+assert ENTRY_HEADER_SIZE == 24
+
+# A lease older than this is stale even while its flock is held (a
+# wedged live compiler): nothing we compile takes longer, and a waiter
+# blocked past it must make progress. Env-tunable for tests.
+STALE_LEASE_S = float(os.environ.get("VTPU_CACHE_STALE_LEASE_S", "300"))
+
+# Default eviction budget (device_plugin --compile-cache-budget-mb
+# overrides): executables are MB-scale, 4 GiB holds a node's working set.
+DEFAULT_BUDGET_BYTES = 4 << 30
+
+# Quarantined entries are autopsy artifacts, not data: keep them a day
+# (and never more than a handful) so a flaky disk cannot fill the
+# shared partition with corpses while entries/ reads as under budget.
+QUARANTINE_RETENTION_S = 24 * 3600.0
+QUARANTINE_KEEP_MAX = 64
+
+# A stats json younger than this is never judged dead — belt under the
+# flock sentinel's suspenders against init-order races.
+_STATS_DEAD_AGE_S = 60.0
+
+_POLL_S = 0.05                # waiter poll cadence while a lease is held
+
+STAT_FIELDS = ("hits", "misses", "single_flight_waits", "evictions",
+               "quarantined")
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _flock_nb(fd: int) -> bool:
+    """One non-blocking exclusive flock attempt."""
+    import fcntl
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        return True
+    except OSError:
+        return False
+
+
+def _flock_grabbable(path: str) -> bool | None:
+    """Whether ``path``'s flock is free (holder dead) — the
+    namespace-proof liveness probe. None when the probe itself fails
+    (file vanished / exotic filesystem); callers fall back to softer
+    signals. The probe's own lock is dropped with the fd."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return None
+    try:
+        return _flock_nb(fd)
+    finally:
+        os.close(fd)
+
+
+def _pid_alive(pid: int) -> bool:
+    """Same-namespace pid probe — only a FALLBACK signal: a pid number
+    is meaningless across container PID namespaces (every tenant has
+    its own pid 1), which is why lease/stats liveness is flock-based."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True       # exists, not ours — alive
+    return True
+
+
+class CacheStats:
+    """Per-client op counters. GIL-atomic int adds; flushed to the
+    client's stats file after every op (ops are compile-scale rare —
+    the flush is one tiny tmp+rename, never on a hot path)."""
+
+    __slots__ = STAT_FIELDS
+
+    def __init__(self) -> None:
+        for name in STAT_FIELDS:
+            setattr(self, name, 0)
+
+    def as_dict(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in STAT_FIELDS}
+
+
+class CompileCache:
+    """One process's handle on the node-shared store. Construction makes
+    the subdirectories (idempotent); every method is crash-safe against
+    concurrent clients in other containers."""
+
+    def __init__(self, root: str,
+                 stale_lease_s: float = STALE_LEASE_S):
+        self.root = root
+        self.stale_lease_s = stale_lease_s
+        self.entries_dir = os.path.join(root, "entries")
+        self.quarantine_dir = os.path.join(root, "quarantine")
+        self.lease_dir = os.path.join(root, "lease")
+        self.tmp_dir = os.path.join(root, "tmp")
+        self.stats_dir = os.path.join(root, "stats")
+        for d in (self.entries_dir, self.quarantine_dir, self.lease_dir,
+                  self.tmp_dir, self.stats_dir):
+            os.makedirs(d, exist_ok=True)
+        self.stats = CacheStats()
+        # stats identity: pid alone collides across container PID
+        # namespaces (two tenants' pid-1s would clobber one file), so
+        # the filename carries a random token, and liveness is a held
+        # flock on the .lock sentinel — kernel-released on death,
+        # namespace-independent. Sentinel failure only disables THIS
+        # client's stats, never its cache ops.
+        self._stats_stem = f"{os.getpid()}-{secrets.token_hex(4)}"
+        self._stats_lock_fd: int | None = None
+        try:
+            fd = os.open(self._stats_sentinel_path(),
+                         os.O_CREAT | os.O_RDWR, 0o666)
+            if _flock_nb(fd):
+                self._stats_lock_fd = fd
+            else:
+                os.close(fd)
+        except OSError:
+            log.debug("compile cache stats sentinel unavailable",
+                      exc_info=True)
+        # key -> (open fd holding the lease file's flock, the EXACT
+        # payload we wrote). Ownership at release time is judged by
+        # full-content equality, never by pid number — pid 47 here and
+        # pid 47 in another container's namespace are different
+        # processes, and a pid-only check could unlink a live peer's
+        # takeover lease.
+        self._leases: dict[str, tuple[int, bytes]] = {}
+
+    # -- paths ---------------------------------------------------------------
+
+    def entry_path(self, key: str) -> str:
+        return os.path.join(self.entries_dir, key)
+
+    def _lease_path(self, key: str) -> str:
+        return os.path.join(self.lease_dir, f"{key}.lease")
+
+    # -- read side -----------------------------------------------------------
+
+    def get(self, key: str) -> bytes | None:
+        """The verified payload, or None (miss), counted as one op in
+        the stats. Corrupt entries are quarantined — a torn executable
+        is a miss that leaves evidence, never a deserialization crash
+        in the tenant."""
+        payload = self._lookup(key)
+        if payload is None:
+            self.stats.misses += 1
+        else:
+            self.stats.hits += 1
+        self._flush_stats()
+        return payload
+
+    def _lookup(self, key: str) -> bytes | None:
+        """Verified read WITHOUT op accounting — the single-flight wait
+        loop polls this every tick, and each poll must not register a
+        phantom miss (or rewrite the stats file at poll rate)."""
+        path = self.entry_path(key)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            log.warning("compile cache entry %s unreadable (%s)", key, e)
+            return None
+        payload = self._verify(key, raw)
+        if payload is None:
+            self._quarantine(key)
+            return None
+        # LRU signal: reads refresh mtime so the evictor drops cold
+        # entries first (touch failure is not a miss — read-only callers
+        # racing an eviction just lose the refresh)
+        try:
+            os.utime(path)
+        except OSError:
+            pass
+        return payload
+
+    @staticmethod
+    def _verify(key: str, raw: bytes) -> bytes | None:
+        if len(raw) < ENTRY_HEADER_SIZE:
+            return None
+        magic, version, length, checksum = struct.unpack_from(
+            _ENTRY_HEADER_FMT, raw, 0)
+        if magic != MAGIC or version != VERSION:
+            return None
+        payload = raw[ENTRY_HEADER_SIZE:]
+        if len(payload) != length or _fnv1a64(payload) != checksum:
+            return None
+        return payload
+
+    def _quarantine(self, key: str) -> None:
+        """Move a corrupt entry aside. rename() succeeds for exactly one
+        racer; the destination keeps a timestamp so repeated corruption
+        of one key leaves distinct artifacts (bounded by the evictor's
+        quarantine retention)."""
+        src = self.entry_path(key)
+        dst = os.path.join(self.quarantine_dir,
+                           f"{key}.{time.time_ns()}")
+        try:
+            os.rename(src, dst)
+            self.stats.quarantined += 1
+            self._flush_stats()
+            log.error("compile cache entry %s failed verification; "
+                      "quarantined to %s", key, dst)
+        except OSError:
+            pass    # another client already moved/removed it
+
+    # -- write side ----------------------------------------------------------
+
+    def put(self, key: str, payload: bytes) -> None:
+        """Land one entry atomically: temp file on the same filesystem,
+        fsync, rename. A crash anywhere before the rename leaves only a
+        temp file the evictor reaps; a crash after is a complete entry.
+        The temp name carries a random token — pid alone collides when
+        two containers' compilers (each pid 1 in its own namespace)
+        write the same key, and interleaved writes to one temp file
+        would rename torn bytes into entries/."""
+        tmp = os.path.join(
+            self.tmp_dir, f"{key}.{os.getpid()}.{secrets.token_hex(4)}")
+        header = struct.pack(_ENTRY_HEADER_FMT, MAGIC, VERSION,
+                             len(payload), _fnv1a64(payload))
+        with open(tmp, "wb") as f:
+            f.write(header)
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        # chaos: partial-write tears the temp file and crashes BEFORE the
+        # rename — the torn bytes must never become a servable entry
+        # (and if a torn file ever did land, _verify quarantines it)
+        failpoints.fire("cache.write", key=key, path=tmp)
+        os.rename(tmp, self.entry_path(key))
+
+    # -- single-flight population --------------------------------------------
+
+    def _read_lease(self, path: str) -> tuple[int, float] | None:
+        """(pid, wall_ts) or None when absent. Garbage reads as
+        (0, 0.0): an unparseable lease is maximally stale — it must be
+        takeover-able, not immortal."""
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            return None
+        pid_raw, _, ts_raw = raw.partition("@")
+        try:
+            return int(pid_raw), float(ts_raw)
+        except ValueError:
+            return 0, 0.0
+
+    def _lease_stale(self, path: str, pid: int, ts: float) -> bool:
+        age = time.time() - ts
+        # a far-future stamp is garbage (clock step / corruption); a
+        # wedged live compiler is bounded by the stale budget
+        if age > self.stale_lease_s or age < -self.stale_lease_s:
+            return True
+        # liveness = the holder's flock, which the kernel releases on
+        # any process death and which works across container PID
+        # namespaces (the lease file is born flock'd — see _link_lease)
+        grabbable = _flock_grabbable(path)
+        if grabbable is not None:
+            return grabbable
+        # probe failed (file vanished mid-check / no-flock filesystem):
+        # fall back to the same-namespace pid signal
+        return not _pid_alive(pid)
+
+    def _link_lease(self, path: str) -> tuple[int, bytes] | None:
+        """Atomically create ``path`` already CONTAINING our pid@ts AND
+        already flock'd: the temp inode is locked before link, so no
+        observer can ever see an empty or unlocked lease and misjudge a
+        live holder as dead. Returns (open flock-holding fd, the exact
+        payload written), or None when an existing lease won the race
+        (EEXIST)."""
+        tmp = f"{path}.{os.getpid()}.{secrets.token_hex(4)}.tmp"
+        payload = f"{os.getpid()}@{time.time()}".encode()
+        fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
+        try:
+            os.write(fd, payload)
+            if not _flock_nb(fd):       # fresh private inode: can't fail
+                raise OSError("flock on fresh lease temp failed")
+            os.link(tmp, path)
+        except FileExistsError:
+            os.close(fd)
+            return None
+        except OSError:
+            os.close(fd)
+            raise
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return fd, payload  # fd stays open: the flock IS the liveness
+
+    def try_acquire_lease(self, key: str) -> bool:
+        """One attempt: True when this process now holds the population
+        lease for ``key`` (and its flock). Dead/stale holders are taken
+        over — verify the observed content immediately before unlink,
+        then race the atomic re-create (the link is the one winner)."""
+        path = self._lease_path(key)
+        try:
+            linked = self._link_lease(path)
+        except OSError:
+            return False
+        if linked is not None:
+            self._leases[key] = linked
+            return True
+        held = self._read_lease(path)
+        if held is None:
+            return False    # vanished: holder released; retry later
+        if not self._lease_stale(path, *held):
+            return False
+        # stale/dead: take over, guarding against a fresh holder that
+        # replaced the lease between our read and the unlink
+        if self._read_lease(path) != held:
+            return False
+        try:
+            os.unlink(path)
+        except OSError:
+            return False
+        try:
+            linked = self._link_lease(path)
+        except OSError:
+            return False
+        if linked is None:
+            return False    # another waiter won the takeover race
+        self._leases[key] = linked
+        return True
+
+    def release_lease(self, key: str) -> None:
+        """Drop the flock and the lease file IF still ours — a takeover
+        may have replaced it while we were wedged, and unlinking the
+        new holder's lease would re-open the stampede it just closed.
+        Ownership is the EXACT content we wrote (pid@ts bytes), never
+        the pid number alone: another container's pid 47 taking over
+        from our wedged pid 47 must not lose its lease to our
+        late release."""
+        fd, payload = self._leases.pop(key, (None, None))
+        if fd is not None:
+            try:
+                os.close(fd)        # closes the OFD: flock released
+            except OSError:
+                pass
+        if payload is None:
+            return
+        path = self._lease_path(key)
+        try:
+            with open(path, "rb") as f:
+                current = f.read()
+        except OSError:
+            return
+        if current == payload:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def get_or_compile(self, key: str, compile_fn,
+                       timeout_s: float = 600.0,
+                       ctx=None) -> tuple[bytes, str]:
+        """The tenant entry point: ``(payload, outcome)`` where outcome
+        is ``hit`` (entry already present), ``miss`` (this process
+        compiled), ``wait`` (another tenant compiled while we blocked on
+        its lease) or ``timeout`` (wedged holder; compiled uncached).
+        Emits the ``shim.compile`` vtrace span with the outcome so
+        cold-start timelines show where first-step time went."""
+        with trace.span(ctx, "shim.compile", key=key[:16]) as _:
+            payload, outcome = self._get_or_compile(key, compile_fn,
+                                                    timeout_s)
+        trace.event(ctx, "shim.compile_outcome", outcome=outcome,
+                    key=key[:16])
+        return payload, outcome
+
+    def _get_or_compile(self, key: str, compile_fn,
+                        timeout_s: float) -> tuple[bytes, str]:
+        """Stat contract: one op counts exactly one of hits (served from
+        cache, including after a single-flight wait) or misses (this
+        process compiled — timeout fail-open included); waits add
+        single_flight_waits on top. The polling loop uses the stat-free
+        _lookup so waiting never fabricates misses."""
+        payload = self._lookup(key)
+        if payload is not None:
+            self.stats.hits += 1
+            self._flush_stats()
+            return payload, "hit"
+        deadline = time.monotonic() + timeout_s
+        waited = False
+        while True:
+            if self.try_acquire_lease(key):
+                try:
+                    # a racer may have populated between our miss and
+                    # the lease grant — the re-check keeps one compile
+                    payload = self._lookup(key)
+                    if payload is not None:
+                        self.release_lease(key)
+                        self.stats.hits += 1
+                        self._flush_stats()
+                        return payload, ("wait" if waited else "hit")
+                    # chaos: crash HERE models a compiler dying while
+                    # holding the lease — waiters must take over within
+                    # the stale budget, not block to their deadline
+                    failpoints.fire("cache.lease", key=key)
+                    payload = compile_fn()
+                    try:
+                        self.put(key, payload)
+                    except OSError:
+                        # fail open: the compile SUCCEEDED — a full or
+                        # broken cache mount must cost sharing, never
+                        # the tenant's own executable
+                        log.warning("compile cache put failed for %s; "
+                                    "serving uncached", key,
+                                    exc_info=True)
+                    self.release_lease(key)
+                    self.stats.misses += 1
+                    self._flush_stats()
+                    return payload, "miss"
+                except Exception:
+                    self.release_lease(key)
+                    raise
+                except BaseException:
+                    # process-death semantics (vtfault CrashFailpoint,
+                    # KeyboardInterrupt): a real crash cannot tidy its
+                    # lease file — leave it (the open flock fd dies
+                    # with the process), so the takeover path, not a
+                    # polite release, is what recovery tests exercise
+                    raise
+            if not waited:
+                waited = True
+                self.stats.single_flight_waits += 1
+                self._flush_stats()
+            if time.monotonic() >= deadline:
+                # fail open: a wedged holder must not sink the tenant —
+                # compile locally without populating (the lease owner
+                # still owns the key)
+                log.warning("compile cache lease for %s held past the "
+                            "%.0fs budget; compiling uncached", key,
+                            timeout_s)
+                self.stats.misses += 1
+                self._flush_stats()
+                return compile_fn(), "timeout"
+            time.sleep(_POLL_S)
+            payload = self._lookup(key)
+            if payload is not None:
+                self.stats.hits += 1
+                self._flush_stats()
+                return payload, "wait"
+
+    # -- eviction ------------------------------------------------------------
+
+    def evict(self, budget_bytes: int = DEFAULT_BUDGET_BYTES,
+              now: float | None = None) -> int:
+        """LRU size-budget pass: drop oldest-mtime entries until the
+        entries dir fits the budget. The same janitor pass reaps stale
+        temp files (a crashed writer's staging), ages out quarantine
+        corpses, and folds dead clients' stats. Returns entries
+        evicted. Safe concurrently — unlink of an already-unlinked
+        entry is a no-op."""
+        now = time.time() if now is None else now
+        entries = []
+        total = 0
+        for name in self._listdir(self.entries_dir):
+            path = os.path.join(self.entries_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, path))
+            total += st.st_size
+        evicted = 0
+        entries.sort()
+        for _mtime, size, path in entries:
+            if total <= budget_bytes:
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+        if evicted:
+            self.stats.evictions += evicted
+            self._flush_stats()
+        for name in self._listdir(self.tmp_dir):
+            path = os.path.join(self.tmp_dir, name)
+            try:
+                if now - os.stat(path).st_mtime > self.stale_lease_s:
+                    os.unlink(path)
+            except OSError:
+                continue
+        self._reap_quarantine(now)
+        self._fold_dead_stats()
+        return evicted
+
+    def _reap_quarantine(self, now: float) -> None:
+        """Quarantine is evidence, not data: age corpses out after the
+        retention window and never keep more than the cap, so repeated
+        corruption cannot fill the shared partition while entries/
+        reads as under budget."""
+        corpses = []
+        for name in self._listdir(self.quarantine_dir):
+            path = os.path.join(self.quarantine_dir, name)
+            try:
+                corpses.append((os.stat(path).st_mtime, path))
+            except OSError:
+                continue
+        corpses.sort(reverse=True)      # newest first
+        for i, (mtime, path) in enumerate(corpses):
+            if i < QUARANTINE_KEEP_MAX and \
+                    now - mtime <= QUARANTINE_RETENTION_S:
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+
+    @staticmethod
+    def _listdir(path: str) -> list[str]:
+        try:
+            return os.listdir(path)
+        except OSError:
+            return []
+
+    # -- stats (the monitor's feed) ------------------------------------------
+
+    def _stats_path(self) -> str:
+        return os.path.join(self.stats_dir, f"{self._stats_stem}.json")
+
+    def _stats_sentinel_path(self) -> str:
+        return os.path.join(self.stats_dir, f"{self._stats_stem}.lock")
+
+    def _flush_stats(self) -> None:
+        if self._stats_lock_fd is None:
+            return      # no sentinel = our file would be folded as dead
+        tmp = f"{self._stats_path()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self.stats.as_dict(), f)
+            os.rename(tmp, self._stats_path())
+        except OSError:
+            # observability only: a full/readonly stats dir must never
+            # fail the compile path it is reporting on
+            log.debug("compile cache stats flush failed", exc_info=True)
+
+    def close(self) -> None:
+        """Drop the stats sentinel (tests / orderly shutdown; the
+        kernel does the same on crash). The stats file stays for the
+        janitor to fold."""
+        if self._stats_lock_fd is not None:
+            try:
+                os.close(self._stats_lock_fd)
+            except OSError:
+                pass
+            self._stats_lock_fd = None
+
+    def _fold_dead_stats(self) -> None:
+        """Merge dead clients' counter files into aggregate.json so
+        totals stay monotone across tenant churn without the stats dir
+        growing unboundedly. Deadness = the client's .lock sentinel
+        flock is free (namespace-proof; kernel-released on death) and
+        the file is old enough to rule out init races. The WHOLE fold —
+        aggregate rename AND dead-file unlinks — happens under the
+        stats lock that node_totals() also takes, so a scrape can never
+        observe the dip (file gone, aggregate not yet bumped) or the
+        double-count (both present) windows."""
+        dead: list[str] = []
+        for name in self._listdir(self.stats_dir):
+            stem, dot, ext = name.rpartition(".")
+            if ext != "json" or stem in ("", "aggregate"):
+                continue
+            path = os.path.join(self.stats_dir, name)
+            try:
+                if time.time() - os.stat(path).st_mtime \
+                        < _STATS_DEAD_AGE_S:
+                    continue
+            except OSError:
+                continue
+            sentinel = os.path.join(self.stats_dir, f"{stem}.lock")
+            grabbable = _flock_grabbable(sentinel)
+            if grabbable is None:
+                # no sentinel at all: a pre-sentinel crash — count the
+                # json as dead; an unreadable sentinel skips this pass
+                if os.path.exists(sentinel):
+                    continue
+            elif not grabbable:
+                continue        # held: client alive
+            dead.append(path)
+        if not dead:
+            return
+        agg_path = os.path.join(self.stats_dir, "aggregate.json")
+        try:
+            with FileLock(agg_path + ".lock", timeout_s=2.0):
+                agg = _read_stats_file(agg_path) or \
+                    dict.fromkeys(STAT_FIELDS, 0)
+                folded = []
+                for path in dead:
+                    counts = _read_stats_file(path)
+                    if counts:
+                        for field in STAT_FIELDS:
+                            agg[field] = agg.get(field, 0) + \
+                                int(counts.get(field, 0))
+                    folded.append(path)
+                tmp = agg_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(agg, f)
+                os.rename(tmp, agg_path)
+                for path in folded:
+                    for victim in (path, path[:-len("json")] + "lock"):
+                        try:
+                            os.unlink(victim)
+                        except OSError:
+                            pass
+        except (OSError, LockTimeout):
+            log.debug("compile cache stats fold failed", exc_info=True)
+
+
+def _read_stats_file(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def node_totals(root: str) -> tuple[dict[str, int], int, int]:
+    """(summed counters, entry_count, entry_bytes) across every client
+    that ever wrote stats under ``root`` — the monitor's scrape feed.
+    Live per-client files and the dead-client aggregate both fold in;
+    the sum runs under the same stats lock the janitor's fold holds so
+    a scrape never sees counters mid-fold (lock busy falls back to a
+    lock-free read rather than stalling the scrape)."""
+    totals = dict.fromkeys(STAT_FIELDS, 0)
+    stats_dir = os.path.join(root, "stats")
+    agg_lock = FileLock(os.path.join(stats_dir, "aggregate.json.lock"),
+                        timeout_s=0.5)
+    locked = os.path.isdir(stats_dir)
+    if locked:
+        try:
+            agg_lock.acquire()
+        except (OSError, LockTimeout):
+            locked = False
+    try:
+        try:
+            names = os.listdir(stats_dir)
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            counts = _read_stats_file(os.path.join(stats_dir, name))
+            if counts:
+                for field in STAT_FIELDS:
+                    totals[field] += int(counts.get(field, 0))
+    finally:
+        if locked:
+            agg_lock.release()
+    count = size = 0
+    entries_dir = os.path.join(root, "entries")
+    try:
+        names = os.listdir(entries_dir)
+    except OSError:
+        names = []
+    for name in names:
+        try:
+            size += os.stat(os.path.join(entries_dir, name)).st_size
+            count += 1
+        except OSError:
+            continue
+    return totals, count, size
+
+
+def render_node_metrics(root: str, node_name: str) -> str:
+    """Prometheus block for the monitor: the vtcc counters + size/entry
+    gauges. Absent root (gate off / no tenants yet) renders headers
+    only, keeping the families discoverable at zero series."""
+    lines = [
+        "# TYPE vtpu_compile_cache_hits_total counter",
+        "# TYPE vtpu_compile_cache_misses_total counter",
+        "# TYPE vtpu_compile_cache_single_flight_waits_total counter",
+        "# TYPE vtpu_compile_cache_evictions_total counter",
+        "# TYPE vtpu_compile_cache_quarantined_total counter",
+        "# TYPE vtpu_compile_cache_entries gauge",
+        "# TYPE vtpu_compile_cache_size_bytes gauge",
+    ]
+    if os.path.isdir(root):
+        totals, count, size = node_totals(root)
+        label = f'{{node="{node_name}"}}'
+        for field in STAT_FIELDS:
+            lines.append(
+                f"vtpu_compile_cache_{field}_total{label} {totals[field]}")
+        lines.append(f"vtpu_compile_cache_entries{label} {count}")
+        lines.append(f"vtpu_compile_cache_size_bytes{label} {size}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,87 @@
+"""vtcc content addressing: what makes two compiles "the same compile".
+
+An executable is reusable across tenants only when every input that
+shaped it matches. The entry key folds all of them:
+
+- **program fingerprint** — opaque tenant-declared identity of the XLA
+  program (hash of the jaxpr/HLO, a model revision tag...). Replicas of
+  one gang share it; that is the whole sharing opportunity.
+- **topology** — chip count + mesh coordinates the program was
+  compiled for. A 2x2 submesh executable is garbage on a 1x4.
+- **runtime versions** — jax + libtpu. XLA serialization is not stable
+  across versions; a version bump must MISS cleanly (asserted by the
+  version-key isolation test), never deserialize a stale artifact.
+
+Keys are sha256 hex over a canonical joined string — no structure to
+mis-parse, no length to overflow a filename.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+# Filename-safe charset for tenant-declared fingerprints (same posture
+# as the step ring's untrusted trace id: the annotation and the cache
+# filename both must not carry quotes/slashes/newlines).
+_FP_KEEP = set("abcdefghijklmnopqrstuvwxyz"
+               "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+FINGERPRINT_MAX_LEN = 64
+
+
+def sanitize_fingerprint(raw: str | None) -> str:
+    """Normalize a tenant-declared program fingerprint: keep only the
+    charset real fingerprints use, bound the length. Empty result means
+    "no fingerprint" — garbage degrades to no-signal, never to a forged
+    annotation or a weird cache filename."""
+    if not raw:
+        return ""
+    return "".join(c for c in raw if c in _FP_KEEP)[:FINGERPRINT_MAX_LEN]
+
+
+def topology_fingerprint(devices) -> str:
+    """Canonical topology string from the shim's effective device set
+    (config/vtpu_config.DeviceConfig list): chip count plus sorted mesh
+    coordinates — the shape XLA compiled against."""
+    coords = sorted((d.host_index,) + tuple(d.mesh) for d in devices)
+    return f"n{len(coords)}:" + ",".join(
+        "/".join(str(c) for c in cell) for cell in coords)
+
+
+def runtime_versions() -> tuple[str, str]:
+    """(jax_version, libtpu_version) as key components. Resolution must
+    never import jax (the cache client runs before backend init and in
+    jax-free test processes): the installed distribution metadata is the
+    version that will compile, and env overrides serve pinned images."""
+    jax_v = os.environ.get("VTPU_JAX_VERSION", "")
+    libtpu_v = os.environ.get("VTPU_LIBTPU_VERSION", "")
+    if not jax_v:
+        jax_v = _dist_version("jax")
+    if not libtpu_v:
+        # first-found precedence: a real libtpu dist wins over the
+        # nightly alias so images carrying both key like images
+        # carrying libtpu alone
+        libtpu_v = _dist_version("libtpu") or _dist_version(
+            "libtpu-nightly")
+    return jax_v or "none", libtpu_v or "none"
+
+
+def _dist_version(dist: str) -> str:
+    from importlib import metadata
+    try:
+        return metadata.version(dist)
+    except metadata.PackageNotFoundError:
+        return ""
+
+
+def entry_key(program_fingerprint: str, topology: str,
+              jax_version: str, libtpu_version: str) -> str:
+    """The content address. Components are length-prefixed before
+    hashing so ("ab","c") and ("a","bc") can never collide."""
+    parts = (program_fingerprint, topology, jax_version, libtpu_version)
+    h = hashlib.sha256()
+    for part in parts:
+        raw = part.encode()
+        h.update(f"{len(raw)}:".encode())
+        h.update(raw)
+    return h.hexdigest()

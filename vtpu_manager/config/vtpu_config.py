@@ -20,11 +20,16 @@ import struct
 from dataclasses import dataclass, field
 
 MAGIC = 0x55505456          # "VTPU" little-endian
-VERSION = 1
+# v2: header grew compile_cache_dir[64] (vtcc — the node-shared compile
+# cache mount the shim/runtime client arms on; empty = cache off for
+# this container). Version is checked strictly: a v1 reader also fails
+# the size check first, and plugin + shim ship together per node.
+VERSION = 2
 MAX_DEVICE_COUNT = 64
 UUID_LEN = 64
 NAME_LEN = 64
 POD_UID_LEN = 48
+CACHE_DIR_LEN = 64
 
 # Core-limit enum (device_t.core_limit analogue; reference hook.h:198-209
 # splits this into hard_limit/core_limit flags — one enum is cleaner)
@@ -40,10 +45,11 @@ DEVICE_SIZE = struct.calcsize(_DEVICE_FMT)
 assert DEVICE_SIZE == 120
 
 # vtpu_config_t header: magic u32, version u32, pod_uid[48], pod_name[64],
-# pod_namespace[64], container_name[64], device_count i32, compat_mode i32
-_HEADER_FMT = "<II48s64s64s64sii"
+# pod_namespace[64], container_name[64], device_count i32, compat_mode i32,
+# compile_cache_dir[64]
+_HEADER_FMT = "<II48s64s64s64sii64s"
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
-assert HEADER_SIZE == 256
+assert HEADER_SIZE == 320
 
 _FOOTER_FMT = "<II"        # checksum u32, pad u32
 CONFIG_SIZE = HEADER_SIZE + MAX_DEVICE_COUNT * DEVICE_SIZE + \
@@ -109,6 +115,10 @@ class VtpuConfig:
     pod_namespace: str = ""
     container_name: str = ""
     compat_mode: int = 0
+    # vtcc: in-container path of the node-shared compile cache mount
+    # ("" = CompileCache gate off for this container — the shim arms
+    # only on a non-empty value, same as the env channel)
+    compile_cache_dir: str = ""
     devices: list[DeviceConfig] = field(default_factory=list)
 
     def pack(self) -> bytes:
@@ -120,7 +130,8 @@ class VtpuConfig:
             _cstr(self.pod_name, NAME_LEN),
             _cstr(self.pod_namespace, NAME_LEN),
             _cstr(self.container_name, NAME_LEN),
-            len(self.devices), self.compat_mode)
+            len(self.devices), self.compat_mode,
+            _cstr(self.compile_cache_dir, CACHE_DIR_LEN))
         for dev in self.devices:
             body += dev.pack()
         body += b"\0" * (DEVICE_SIZE * (MAX_DEVICE_COUNT - len(self.devices)))
@@ -132,12 +143,17 @@ class VtpuConfig:
     def unpack(raw: bytes) -> "VtpuConfig":
         if len(raw) != CONFIG_SIZE:
             raise ValueError(f"config size {len(raw)} != {CONFIG_SIZE}")
-        checksum, _ = struct.unpack_from(_FOOTER_FMT,
-                                         raw, CONFIG_SIZE - 8)
+        checksum, pad = struct.unpack_from(_FOOTER_FMT,
+                                           raw, CONFIG_SIZE - 8)
+        if pad != 0:
+            # the footer pad sits AFTER the checksum so it cannot be
+            # covered by it — explicit validation keeps every byte of
+            # the file detection-covered (codec fuzz contract)
+            raise ValueError("nonzero footer padding (corruption?)")
         if _fnv1a(raw[: CONFIG_SIZE - 8]) != checksum:
             raise ValueError("config checksum mismatch (torn write?)")
         (magic, version, pod_uid, pod_name, pod_ns, cont_name, count,
-         compat) = struct.unpack_from(_HEADER_FMT, raw, 0)
+         compat, cache_dir) = struct.unpack_from(_HEADER_FMT, raw, 0)
         if magic != MAGIC:
             raise ValueError(f"bad magic {magic:#x}")
         if version != VERSION:
@@ -148,7 +164,8 @@ class VtpuConfig:
                          pod_name=_from_cstr(pod_name),
                          pod_namespace=_from_cstr(pod_ns),
                          container_name=_from_cstr(cont_name),
-                         compat_mode=compat)
+                         compat_mode=compat,
+                         compile_cache_dir=_from_cstr(cache_dir))
         for i in range(count):
             off = HEADER_SIZE + i * DEVICE_SIZE
             cfg.devices.append(
@@ -183,5 +200,5 @@ DEVICE_OFFSETS = {
 HEADER_OFFSETS = {
     "magic": 0, "version": 4, "pod_uid": 8, "pod_name": 56,
     "pod_namespace": 120, "container_name": 184, "device_count": 248,
-    "compat_mode": 252,
+    "compat_mode": 252, "compile_cache_dir": 256,
 }

@@ -54,6 +54,7 @@ class VnumPlugin(DevicePluginServicer):
     pre_start_required = True
     preferred_allocation_available = False   # gated: HonorPreAllocatedDeviceIDs
     step_telemetry_enabled = False           # gated: StepTelemetry (vttel)
+    compile_cache_enabled = False            # gated: CompileCache (vtcc)
 
     def __init__(self, manager: DeviceManager, client: KubeClient,
                  node_name: str, node_config: NodeConfig | None = None,
@@ -370,6 +371,21 @@ class VnumPlugin(DevicePluginServicer):
         if pod is not None and not self.disable_control:
             cont_dir = self._container_dir(uid, cont)
             config_host = os.path.join(cont_dir, "config")
+            # vtcc: the cache dir must EXIST before anything arms on it
+            # — the config field below and the mount/env both key off
+            # this one verdict, so a failed makedirs can never leave
+            # the C++ shim armed on a path that was never mounted
+            cc_host = os.path.join(self.base_dir,
+                                   consts.COMPILE_CACHE_SUBDIR)
+            cc_ok = False
+            if self.compile_cache_enabled:
+                try:
+                    os.makedirs(cc_host, exist_ok=True)
+                    cc_ok = True
+                except OSError as e:
+                    log.warning("compile cache dir %s unavailable (%s); "
+                                "tenant %s/%s compiles uncached",
+                                cc_host, e, uid, cont)
             with trace.span(ctx, "plugin.config", container=cont,
                             devices=len(devices)):
                 os.makedirs(config_host, exist_ok=True)
@@ -377,6 +393,13 @@ class VnumPlugin(DevicePluginServicer):
                                     pod_name=meta.get("name", ""),
                                     pod_namespace=meta.get("namespace", ""),
                                     container_name=cont, compat_mode=compat,
+                                    # vtcc: non-empty only when the gate
+                                    # is on AND the dir exists — the C++
+                                    # shim's arm switch, mirroring the
+                                    # env the runtime client reads
+                                    compile_cache_dir=(
+                                        consts.COMPILE_CACHE_DIR
+                                        if cc_ok else ""),
                                     devices=devices)
                 cfg_path = os.path.join(config_host, "vtpu.config")
                 vc.write_config(cfg_path, cfg)
@@ -409,6 +432,19 @@ class VnumPlugin(DevicePluginServicer):
                     log.warning("trace dir %s unavailable (%s); tenant "
                                 "spans for %s/%s will not spool",
                                 consts.TRACE_DIR, e, uid, cont)
+            if cc_ok:
+                # vtcc: ONE node-shared executable cache (unlike the
+                # per-container telemetry subdir — cross-tenant sharing
+                # is the point), mounted read-write at the canonical
+                # container path. The env arms the runtime client;
+                # cfg.compile_cache_dir above is the same switch for
+                # the C++ shim.
+                resp.mounts.append(pb.Mount(
+                    container_path=consts.COMPILE_CACHE_DIR,
+                    host_path=cc_host, read_only=False))
+                resp.envs[consts.ENV_COMPILE_CACHE] = "true"
+                resp.envs[consts.ENV_COMPILE_CACHE_DIR] = \
+                    consts.COMPILE_CACHE_DIR
             if self.step_telemetry_enabled:
                 # vttel: the per-container telemetry subdir (next to the
                 # read-only config) is the ONE writable surface the

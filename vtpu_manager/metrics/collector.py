@@ -527,6 +527,14 @@ class NodeCollector:
         text += self.telemetry.render(self.node_name)
         text += self.telemetry.render_pressure(
             self.node_name, sum(c.memory for c in self.chips))
+        # vtcc: node compile-cache counters (summed across every tenant
+        # client's stats file + the dead-process aggregate) and the
+        # entries/size gauges. Absent root (gate off) renders headers
+        # only — zero series, matching the gate-off contract.
+        from vtpu_manager.compilecache.cache import render_node_metrics
+        text += render_node_metrics(
+            os.path.join(self.base_dir, consts.COMPILE_CACHE_SUBDIR),
+            self.node_name)
         # self-observability: the scrape's own duration and per-feed
         # last-error flags, rendered last so a wedged feed still reports
         self._last_scrape_s = time.perf_counter() - t0

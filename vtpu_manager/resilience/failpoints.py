@@ -71,6 +71,14 @@ SITES: dict[str, str] = {
     "dra.cdi_write": "kubeletplugin/device_state.py, after the CDI spec "
                      "lands on disk and before the checkpoint write "
                      "(partial-write tears the spec the runtime reads)",
+    "cache.write": "compilecache/cache.py put, after the temp entry is "
+                   "written and before the atomic rename (partial-write "
+                   "= a torn executable that must be quarantined, never "
+                   "loaded)",
+    "cache.lease": "compilecache/cache.py, after the single-flight lease "
+                   "is acquired and before the compile runs (crash = a "
+                   "dead lease holder waiters must take over within the "
+                   "stale-lease budget)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

@@ -18,6 +18,12 @@ reference's in-container plumbing that ld.so.preload does implicitly
 - step_telemetry(): vttel step-ring writer, armed only when the plugin
   injected the StepTelemetry env; the step loop records latency /
   throttle-wait / HBM high-water into the shared ring the monitor tails.
+  When the shim exports its token-bucket wait counter, records are
+  auto-charged the real quota-wait delta per step.
+- compile_cache(): vtcc node-shared compile cache client, armed only
+  when the plugin injected the CompileCache env; install() also points
+  JAX's own persistent compilation cache into the shared mount so plain
+  jax.jit tenants reuse executables with zero code changes.
 """
 
 from __future__ import annotations
@@ -119,9 +125,27 @@ def install(shim_path: str | None = None,
         os.environ[consts.ENV_VTPU_REAL_PLUGIN_PATH] = real
     os.environ[consts.ENV_TPU_LIBRARY_PATH] = shim
     os.environ[consts.ENV_PJRT_PLUGIN_LIBRARY_PATH] = shim
+    _arm_jax_compile_cache()
     _ensure_tenant_trace()
     trace.event(trace.context_from_env(), "shim.install", shim=shim)
     return True
+
+
+def _arm_jax_compile_cache() -> None:
+    """vtcc transparency path: when the plugin injected the CompileCache
+    env, point JAX's persistent compilation cache at a subdir of the
+    node-shared mount (env only — install() runs before jax imports, and
+    jax reads JAX_COMPILATION_CACHE_DIR at config init). Tenants that
+    never touch vtpu code still share compiled executables node-wide;
+    the vtcc store's single-flight/eviction/quarantine wraps the
+    artifacts driven through compile_cache() explicitly. An operator's
+    own cache-dir setting wins — we only default the knob."""
+    if os.environ.get(consts.ENV_COMPILE_CACHE) != "true":
+        return
+    root = os.environ.get(consts.ENV_COMPILE_CACHE_DIR) or \
+        consts.COMPILE_CACHE_DIR
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(root, "jax"))
 
 
 def register_client(timeout_s: float = 5.0) -> bool:
@@ -158,6 +182,70 @@ def register_client(timeout_s: float = 5.0) -> bool:
             return False
 
 
+def _shim_throttle_wait_source():
+    """ctypes accessor for the shim's cumulative token-bucket wait
+    counter (``vtpu_throttle_wait_ns_total``), or None when no shim is
+    loaded or it predates the export. dlopen of the already-loaded shim
+    resolves to the same handle, so the counter read is the live one the
+    throttle loop is bumping in this very process."""
+    shim = os.environ.get(consts.ENV_TPU_LIBRARY_PATH) or \
+        os.environ.get("VTPU_SHIM_PATH")
+    if not shim or not os.path.exists(shim):
+        return None
+    try:
+        import ctypes
+        lib = ctypes.CDLL(shim)
+        fn = lib.vtpu_throttle_wait_ns_total
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = []
+        fn()   # probe: a broken export must disarm here, not per step
+        return fn
+    except (OSError, AttributeError):
+        return None
+
+
+class _ShimWaitStepRing:
+    """StepRingWriter wrapper charging each record the shim's REAL
+    token-bucket wait since the previous record. Before this, the
+    throttle-wait field was whatever the caller measured (usually 0 —
+    the wait hides inside the jitted step call), so the node pressure
+    annotation understated quota stalls exactly when they mattered.
+    Callers passing an explicit throttle_wait_ns keep their value."""
+
+    __slots__ = ("ring", "_wait_total_fn", "_last_wait_ns")
+
+    def __init__(self, ring, wait_total_fn):
+        self.ring = ring
+        self._wait_total_fn = wait_total_fn
+        self._last_wait_ns = int(wait_total_fn())
+
+    @property
+    def writes(self) -> int:
+        return self.ring.writes
+
+    def record(self, duration_ns: int, throttle_wait_ns: int | None = None,
+               hbm_highwater_bytes: int = 0, compiled: bool = False,
+               start_mono_ns: int | None = None) -> None:
+        # signature mirrors StepRingWriter.record exactly (positional
+        # compatibility included): step_telemetry() swaps this wrapper
+        # in transparently when the shim exports the counter, and a
+        # caller's positional hbm/compiled args must not start raising
+        # after a shim upgrade
+        if throttle_wait_ns is None:
+            total = int(self._wait_total_fn())
+            # a reloaded shim restarts its counter at 0; a negative
+            # delta must re-baseline, never poison the ring
+            delta = total - self._last_wait_ns
+            self._last_wait_ns = total
+            throttle_wait_ns = max(0, delta)
+        self.ring.record(duration_ns, throttle_wait_ns=throttle_wait_ns,
+                         hbm_highwater_bytes=hbm_highwater_bytes,
+                         compiled=compiled, start_mono_ns=start_mono_ns)
+
+    def close(self) -> None:
+        self.ring.close()
+
+
 _step_telemetry = None
 _step_telemetry_checked = False
 
@@ -184,6 +272,13 @@ def step_telemetry():
     try:
         _step_telemetry = stepring.StepRingWriter(
             path, trace_id=os.environ.get(consts.ENV_TRACE_ID, ""))
+        # shim token-wait accounting: when the loaded shim exports its
+        # cumulative wait counter, records are auto-charged the real
+        # quota-wait delta per step (the pressure annotation then
+        # reflects actual token-bucket stalls, not caller guesses)
+        wait_fn = _shim_throttle_wait_source()
+        if wait_fn is not None:
+            _step_telemetry = _ShimWaitStepRing(_step_telemetry, wait_fn)
         # clean unmap/unlock on interpreter exit — otherwise the GC'd
         # lock context tears down after Python's import machinery and
         # spams a harmless-but-ugly shutdown traceback
@@ -206,6 +301,55 @@ def _reset_step_telemetry() -> None:
         _step_telemetry.close()
     _step_telemetry = None
     _step_telemetry_checked = False
+
+
+_compile_cache = None
+_compile_cache_checked = False
+
+
+def compile_cache():
+    """The tenant's CompileCache client, or None when the CompileCache
+    gate is off for this pod. Gate-off cost contract mirrors
+    step_telemetry(): after the first call this is one global load and
+    one branch — no env reads, no imports, no directory I/O (tests
+    assert no cache files appear).
+
+    Explicit use (the measured path)::
+
+        cc = compile_cache()
+        if cc is not None:
+            key = keys.entry_key(fp, topo, *keys.runtime_versions())
+            payload, outcome = cc.get_or_compile(
+                key, compile_fn, ctx=trace.context_from_env())
+
+    Failure posture: a broken cache mount degrades to "no cache" —
+    compilation still happens, sharing just stops."""
+    global _compile_cache, _compile_cache_checked
+    if _compile_cache_checked:
+        return _compile_cache
+    _compile_cache_checked = True
+    if os.environ.get(consts.ENV_COMPILE_CACHE) != "true":
+        return None
+    from vtpu_manager.compilecache import CompileCache
+    root = os.environ.get(consts.ENV_COMPILE_CACHE_DIR) or \
+        consts.COMPILE_CACHE_DIR
+    try:
+        _compile_cache = CompileCache(root)
+    except OSError as e:
+        import logging
+        logging.getLogger(__name__).warning(
+            "compile cache unavailable at %s (%s); compiling uncached",
+            root, e)
+        _compile_cache = None
+    return _compile_cache
+
+
+def _reset_compile_cache() -> None:
+    """Test hook: drop the cached client so the next compile_cache()
+    re-reads the env (mirrors _reset_step_telemetry)."""
+    global _compile_cache, _compile_cache_checked
+    _compile_cache = None
+    _compile_cache_checked = False
 
 
 _first_execute_marked = False

@@ -47,6 +47,7 @@ from vtpu_manager.device.allocator.request import (AllocationRequest,
                                                    RequestError,
                                                    build_allocation_request)
 from vtpu_manager.device import types as dt
+from vtpu_manager.compilecache import antistorm
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
 from vtpu_manager.resilience import failpoints
@@ -100,9 +101,25 @@ class FilterPredicate:
                  nodes_ttl_s: float = 0.0,
                  snapshot: "snap_mod.ClusterSnapshot | None" = None,
                  policy: RetryPolicy | None = None,
-                 fence=None, shard_selector=None):
+                 fence=None, shard_selector=None,
+                 anti_storm: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtcc (CompileCache gate; default off = byte-identical scores):
+        # spread simultaneously-starting replicas of one program
+        # fingerprint as a SOFT preference so one node warms the shared
+        # compile cache while the wave lands elsewhere. Rides
+        # filter_kwargs in the binary, so vtha shards inherit it the
+        # same way they inherit the pressure penalty.
+        self.anti_storm = anti_storm
+        # node -> [(pod_uid, fingerprint, commit_wall_ts)] for THIS
+        # process's own commits: a same-pass gang burst must spread
+        # before any watch event or pod-list refresh surfaces the
+        # annotations. Entries retire the moment their pod becomes
+        # visible in the resident set (the _assumed pattern — keeping
+        # both would double-count the penalty) and expire by wall clock
+        # as the backstop. Guarded by _assumed_lock.
+        self._recent_fp: dict[str, list[tuple[str, str, float]]] = {}
         # vtha (both default None = pre-HA behavior, byte-identical):
         # `fence` is the shard's ShardLease — commits stamp its fencing
         # token in the SAME patch as the pre-allocation, and a locally
@@ -553,18 +570,22 @@ class FilterPredicate:
 
         assumed_by_node = self._assumed_by_node()
         spread = req.node_policy == consts.NODE_POLICY_SPREAD
+        # vtcc anti-storm (gate off => "" => zero extra work, scores
+        # byte-identical): the pod's program fingerprint keys the
+        # recently-placed-same-program penalty both paths apply
+        pod_fp = antistorm.pod_fingerprint(pod) if self.anti_storm else ""
         if snap is not None:
             # walk the snapshot's incrementally maintained capacity rank
             # — no per-pass O(nodes) ranking, no decode
             scored = self._snapshot_scored(
                 snap, req, candidates, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
-                reasons, now)
+                reasons, now, pod_fp=pod_fp)
         else:
             scored = self._ttl_scored(
                 req, candidates, by_node, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
-                reasons, now)
+                reasons, now, pod_fp=pod_fp)
 
         if not scored:
             result.error = reasons.summary() or "no schedulable vtpu node"
@@ -588,7 +609,7 @@ class FilterPredicate:
                     by_node: dict, assumed_by_node: dict, spread: bool,
                     gang_domains: set, gang_siblings: list,
                     prefer_origin, result: FilterResult, reasons,
-                    now: float) -> list[ScoredNode]:
+                    now: float, pod_fp: str = "") -> list[ScoredNode]:
         """TTL-path ranking: gate + rank every surviving node on fast
         free totals (memoized registry totals minus claim sums — no
         DeviceUsage materialized), then build the full usage view lazily,
@@ -598,6 +619,11 @@ class FilterPredicate:
         now_visible: set[str] = set()
         req_number, req_cores, req_memory = (
             req.total_number(), req.total_cores(), req.total_memory())
+        # anti-storm signal sources, collected only for fingerprinted
+        # pods: resident pods' stamped annotations (one dict-get per
+        # resident, alongside the claims walk this loop already does)
+        # plus this process's own recent commits
+        fp_overlay = self._recent_fp_overlay(now) if pod_fp else {}
         for node in candidates:
             meta = node.get("metadata") or {}
             name = meta.get("name", "")
@@ -631,8 +657,15 @@ class FilterPredicate:
             pressure = tel_pressure.parse_pressure(
                 (meta.get("annotations") or {}).get(
                     consts.node_pressure_annotation()))
+            storm = (self._storm_for_node(
+                name, fp_overlay,
+                {(p.get("metadata") or {}).get("uid", "")
+                 for p in resident} if fp_overlay.get(name) else (),
+                antistorm.recent_from_pods(resident, now))
+                if pod_fp else ())
             ranked.append((free_cores + (free_memory >> 24) + free_number,
-                           name, registry, counted, assumed, pressure))
+                           name, registry, counted, assumed, pressure,
+                           storm))
         if now_visible:
             self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
@@ -649,14 +682,15 @@ class FilterPredicate:
         # walking the remainder until one succeeds — truncation must trade
         # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
-        for rank, (_, name, registry, counted, assumed, pressure) in \
-                enumerate(ranked):
+        for rank, (_, name, registry, counted, assumed, pressure,
+                   storm) in enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
             self._allocate_node(name, registry, counted, assumed, req,
                                 prefer_origin, gang_siblings,
                                 gang_domains, scored, result, reasons,
-                                pressure=pressure)
+                                pressure=pressure, storm_fp=pod_fp,
+                                storm_recent=storm)
         return scored
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
@@ -664,7 +698,7 @@ class FilterPredicate:
                          spread: bool, gang_domains: set,
                          gang_siblings: list, prefer_origin,
                          result: FilterResult, reasons,
-                         now: float) -> list[ScoredNode]:
+                         now: float, pod_fp: str = "") -> list[ScoredNode]:
         """Snapshot-path candidate walk. The capacity rank is maintained
         by the snapshot O(log n) per event, so the pass walks its head in
         policy order (ascending for binpack, descending for spread) and
@@ -704,6 +738,7 @@ class FilterPredicate:
         scored: list[ScoredNode] = []
         visited = 0
         lazy_gate = candidates is None
+        fp_overlay = self._recent_fp_overlay(now) if pod_fp else {}
 
         def visit(entry) -> None:
             nonlocal visited
@@ -732,12 +767,17 @@ class FilterPredicate:
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
                 return
             visited += 1
+            storm = (self._storm_for_node(name, fp_overlay,
+                                          entry.resident,
+                                          entry.fp_recent)
+                     if pod_fp else ())
             self._allocate_node(name, entry.registry,
                                 snap_mod.entry_counted(entry, now),
                                 assumed, req, prefer_origin,
                                 gang_siblings, gang_domains, scored,
                                 result, reasons,
-                                pressure=entry.pressure)
+                                pressure=entry.pressure, storm_fp=pod_fp,
+                                storm_recent=storm)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -773,7 +813,8 @@ class FilterPredicate:
                        prefer_origin, gang_siblings: list,
                        gang_domains: set, scored: list,
                        result: FilterResult, reasons,
-                       pressure=None) -> None:
+                       pressure=None, storm_fp: str = "",
+                       storm_recent=()) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
         drift between them."""
@@ -804,6 +845,13 @@ class FilterPredicate:
         # PENALTY only: pressure can reorder fits, never veto one (a
         # pressured node with the only free chips still schedules).
         score -= tel_pressure.pressure_penalty(pressure)
+        # vtcc anti-storm: same soft-only contract as pressure —
+        # recently-placed same-fingerprint pods repel the next replica
+        # so compile storms spread, but a storm-heavy node with the
+        # only free chips still schedules (runs after the capacity
+        # gate; subtracts, never vetoes)
+        if storm_fp:
+            score -= antistorm.storm_penalty(storm_fp, storm_recent)
         if gang_domains and registry.mesh_domain in gang_domains:
             # keeping the gang on one multi-host slice outweighs any
             # per-node topology/packing difference: a member placed
@@ -843,6 +891,64 @@ class FilterPredicate:
         failpoints.fire("scheduler.filter_commit",
                         pod_uid=meta.get("uid", ""), node=best.name)
         self._assume(meta.get("uid", ""), best.name, best.result.effective)
+        if self.anti_storm:
+            fp = antistorm.pod_fingerprint(pod)
+            if fp:
+                self._record_recent_fp(best.name, meta.get("uid", ""),
+                                       fp, time.time())
+
+    # -- vtcc anti-storm: in-process recent-placement overlay ---------------
+
+    def _record_recent_fp(self, node: str, uid: str, fp: str,
+                          now: float) -> None:
+        with self._assumed_lock:
+            entries = [e for e in self._recent_fp.get(node, [])
+                       if now - e[2] <= antistorm.STORM_WINDOW_S]
+            entries.append((uid, fp, now))
+            self._recent_fp[node] = entries
+
+    def _recent_fp_overlay(self, now: float) -> dict[str, list]:
+        """One snapshot of live in-process fingerprint commits per pass,
+        pruned by window — same pattern as _assumed_by_node."""
+        out: dict[str, list] = {}
+        with self._assumed_lock:
+            for node in list(self._recent_fp):
+                live = [e for e in self._recent_fp[node]
+                        if now - e[2] <= antistorm.STORM_WINDOW_S]
+                if live:
+                    self._recent_fp[node] = live
+                    out[node] = live
+                else:
+                    del self._recent_fp[node]
+        return out
+
+    def _storm_for_node(self, name: str, fp_overlay: dict,
+                        resident_uids, annotation_recent) -> list:
+        """Per-node (fingerprint, ts) storm signal: resident pods'
+        stamped annotations plus the in-process overlay MINUS overlay
+        entries whose pod is now visible among the residents — a
+        visible pod contributes through its annotation, and keeping its
+        overlay twin would double the penalty (same retirement rule as
+        the assumed cache)."""
+        overlay = fp_overlay.get(name, [])
+        if overlay:
+            retired = [e[0] for e in overlay if e[0] in resident_uids]
+            if retired:
+                overlay = [e for e in overlay
+                           if e[0] not in resident_uids]
+                self._drop_recent_fp(name, retired)
+        return list(annotation_recent) + [(f, t) for _u, f, t in overlay]
+
+    def _drop_recent_fp(self, node: str, uids) -> None:
+        with self._assumed_lock:
+            entries = self._recent_fp.get(node)
+            if not entries:
+                return
+            live = [e for e in entries if e[0] not in uids]
+            if live:
+                self._recent_fp[node] = live
+            else:
+                self._recent_fp.pop(node, None)
 
     def _emit_rejection_event(self, pod: dict, message: str) -> None:
         """One aggregated event per rejected pod (reference: reason.go)."""

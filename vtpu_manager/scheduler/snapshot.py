@@ -46,6 +46,7 @@ import threading
 import time
 
 from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.compilecache import antistorm
 from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import container_kinds, effective_claims
 from vtpu_manager.resilience import failpoints
@@ -67,12 +68,12 @@ class NodeEntry:
 
     __slots__ = ("name", "node", "labels", "registry", "resident",
                  "counted", "conditional", "base_free", "rank_key",
-                 "generation", "pressure")
+                 "generation", "pressure", "fp_recent")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
                  base_free: tuple, rank_key: int, generation: int,
-                 pressure=None):
+                 pressure=None, fp_recent=()):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -82,6 +83,10 @@ class NodeEntry:
         self.conditional = conditional    # [(uid, claims, expiry_wall_s)]
         self.base_free = base_free        # free totals over `counted` only
         self.pressure = pressure          # vttel NodePressure | None
+        # vtcc anti-storm: residents' (program_fingerprint, placed_ts)
+        # pairs inside the storm window at build time; decay is
+        # re-judged at penalty time (a quiet node emits no events)
+        self.fp_recent = fp_recent
         # capacity-rank key over free totals INCLUDING build-time-live
         # conditionals — same formula the filter's TTL path sorts on
         # (free_cores + (free_memory >> 24) + free_number). A grace
@@ -636,7 +641,9 @@ class ClusterSnapshot:
         return NodeEntry(name, node, labels, registry, resident, counted,
                          conditional, base_free, rank_key,
                          self.generation,
-                         pressure=self._node_pressure.get(name))
+                         pressure=self._node_pressure.get(name),
+                         fp_recent=tuple(antistorm.recent_from_pods(
+                             resident.values(), time.time())))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -781,6 +788,7 @@ class ClusterSnapshot:
             pruned = NodeEntry(
                 entry.name, entry.node, entry.labels, entry.registry,
                 entry.resident, entry.counted, live, entry.base_free,
-                rank_key, self.generation, pressure=entry.pressure)
+                rank_key, self.generation, pressure=entry.pressure,
+                fp_recent=entry.fp_recent)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

@@ -232,6 +232,16 @@ def node_obs_overhead_annotation() -> str:
     return _ann("node-obs-excess-table")
 
 
+def program_fingerprint_annotation() -> str:
+    """vtcc program identity: an opaque tenant-declared fingerprint of
+    the XLA program the pod will compile (hash of the jaxpr/HLO, a model
+    revision, anything stable across replicas of one gang). Stamped by
+    the webhook mutate from the container env (the deployment template
+    is where the tenant already declares it) so the scheduler's
+    anti-storm term never parses pod specs in the hot path."""
+    return _ann("program-fingerprint")
+
+
 def node_pressure_annotation() -> str:
     """vttel node pressure rollup ("<throttle_frac>:<hbm_headroom>@<ts>",
     telemetry/pressure.py): max tenant throttle-wait fraction + HBM
@@ -300,6 +310,12 @@ ENV_TRACE_SAMPLED = "VTPU_TRACE_SAMPLED"    # "true"/"false"
 ENV_TRACE_DIR = "VTPU_TRACE_DIR"            # tenant spool dir override
 ENV_STEP_TELEMETRY = "VTPU_STEP_TELEMETRY"  # "true": step ring armed
 ENV_STEP_RING_PATH = "VTPU_STEP_RING_PATH"  # tenant-side ring file path
+ENV_COMPILE_CACHE = "VTPU_COMPILE_CACHE"    # "true": node compile cache armed
+ENV_COMPILE_CACHE_DIR = "VTPU_COMPILE_CACHE_DIR"  # in-container cache dir
+# tenant-declared program fingerprint (deployment template env); the
+# webhook mirrors it into the program-fingerprint annotation so the
+# scheduler's anti-storm spreading sees it without spec parsing
+ENV_PROGRAM_FINGERPRINT = "VTPU_PROGRAM_FINGERPRINT"
 ENV_REGISTRY_SOCKET = "VTPU_REGISTRY_SOCKET"  # registry socket override
 ENV_POD_NAME = "VTPU_POD_NAME"
 ENV_POD_NAMESPACE = "VTPU_POD_NAMESPACE"
@@ -343,6 +359,12 @@ TRACE_DIR = f"{MANAGER_BASE_DIR}/trace"             # vtrace span spools
 # MANAGER_BASE_DIR/telemetry).
 TELEMETRY_SUBDIR = "telemetry"
 STEP_RING_NAME = "step_telemetry.ring"
+
+# vtcc node-local compile cache: ONE node-shared dir (not per-container —
+# sharing across tenants is the point), mounted read-write into sampled
+# containers at the same path it occupies on the host.
+COMPILE_CACHE_SUBDIR = "compilecache"
+COMPILE_CACHE_DIR = f"{MANAGER_BASE_DIR}/{COMPILE_CACHE_SUBDIR}"
 
 LOCK_DIR = "/tmp/.vtpu_lock"                        # per-device OFD locks
 VMEM_DIR = "/tmp/.vmem_node"

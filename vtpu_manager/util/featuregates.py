@@ -27,6 +27,7 @@ SCHEDULER_SNAPSHOT = "SchedulerSnapshot"  # watch-driven cluster snapshot
 FAULT_INJECTION = "FaultInjection"      # vtfault failpoint registry
 STEP_TELEMETRY = "StepTelemetry"        # vttel per-tenant step rings
 SCHEDULER_HA = "SchedulerHA"            # vtha sharded active-active scheduler
+COMPILE_CACHE = "CompileCache"          # vtcc node-local compile cache
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -64,6 +65,15 @@ _KNOWN = {
     # units behind per-shard leader leases (scheduler/shard.py) so N
     # scheduler replicas run active-active with leased failover.
     SCHEDULER_HA: False,
+    # Default off: byte-identical to the pre-vtcc tree — Allocate mounts
+    # no cache dir and injects no env, tenants do zero cache I/O (the
+    # check is one env-var branch), the webhook stamps no fingerprint
+    # annotation, and the scheduler's anti-storm term is skipped so
+    # scores are byte-identical. On, the node-shared content-addressed
+    # executable cache (vtpu_manager/compilecache/) turns an N-replica
+    # same-program gang cold start into ONE compile, and simultaneous
+    # same-fingerprint starts spread across nodes as a soft preference.
+    COMPILE_CACHE: False,
 }
 
 

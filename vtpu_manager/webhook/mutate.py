@@ -58,7 +58,8 @@ def _escape(path: str) -> str:
 
 
 def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
-               set_scheduler: bool = True) -> MutateResult:
+               set_scheduler: bool = True,
+               stamp_fingerprint: bool = False) -> MutateResult:
     result = MutateResult()
     if not requests_vtpu(pod):
         return result
@@ -68,6 +69,10 @@ def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
     ctx = trace.mint_for_pod(pod)
     with trace.span(ctx, "webhook.mutate"):
         _apply_mutations(pod, result, scheduler_name, set_scheduler)
+        if stamp_fingerprint:
+            # vtcc (CompileCache gate): the scheduler's anti-storm term
+            # keys on this annotation, stamped once at admission
+            _stamp_program_fingerprint(pod, result)
         if ctx is not None:
             for ann, value in sorted(trace.annotation_values(ctx).items()):
                 # "add" replaces an existing member (RFC 6902 §4.1), so a
@@ -77,6 +82,48 @@ def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
                     "path": f"/metadata/annotations/{_escape(ann)}",
                     "value": value})
     return result
+
+
+def _stamp_program_fingerprint(pod: dict, result: MutateResult) -> None:
+    """Mirror the tenant-declared program fingerprint into the
+    program-fingerprint annotation. The deployment template is where a
+    tenant already names its program — a ``VTPU_PROGRAM_FINGERPRINT``
+    container env (FlexNPU-style: no tenant code changes) — and the
+    scheduler must never parse container specs in its hot path, so
+    admission normalizes it into the one annotation the filter reads. A
+    pre-set annotation wins over the env (explicit beats ambient) but is
+    re-sanitized; garbage that sanitizes to nothing is removed with a
+    warning rather than flowing downstream."""
+    from vtpu_manager.compilecache.keys import sanitize_fingerprint
+    meta = pod.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    ann = consts.program_fingerprint_annotation()
+    raw = anns.get(ann)
+    if not raw:
+        for cont in ((pod.get("spec") or {}).get("containers") or []):
+            for env in (cont.get("env") or []):
+                if env.get("name") == consts.ENV_PROGRAM_FINGERPRINT \
+                        and env.get("value"):
+                    raw = env["value"]
+                    break
+            if raw:
+                break
+    if not raw:
+        return
+    clean = sanitize_fingerprint(raw)
+    if not clean:
+        if ann in anns:
+            result.warnings.append(
+                f"annotation {ann} sanitized to nothing; removed")
+            result.patches.append({
+                "op": "remove",
+                "path": f"/metadata/annotations/{_escape(ann)}"})
+        return
+    if anns.get(ann) != clean:
+        result.patches.append({
+            "op": "add",   # add replaces an existing member (RFC 6902)
+            "path": f"/metadata/annotations/{_escape(ann)}",
+            "value": clean})
 
 
 def _apply_mutations(pod: dict, result: MutateResult,
