@@ -86,12 +86,16 @@ test-ha: ## vtha suite: shard leases/fencing units + the multi-scheduler chaos t
 test-compilecache: ## vtcc suite: content addressing, single-flight torture, LRU/quarantine chaos, anti-storm parity in both scheduler modes
 	$(PYTEST) tests/test_compilecache.py -q
 
+.PHONY: test-utilization
+test-utilization: ## vtuse suite: ledger EWMA/burstiness/staleness math, budgeted fold bound, gate-off contract, rollup chaos, vtpu-smi e2e
+	$(PYTEST) tests/test_utilization.py -q
+
 .PHONY: bench-compilecache
 bench-compilecache: ## vtcc headline bench: N-replica gang cold start, cache off/cold/warm (1 compile + N-1 hits asserted)
 	python scripts/bench_compilecache.py
 
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -41,6 +41,17 @@ def main(argv: list[str] | None = None) -> int:
                         "unreachable")
     parser.add_argument("--debug-endpoints", action="store_true",
                         help="expose /debug/stacks (thread dumps)")
+    parser.add_argument("--feature-gates", default="",
+                        help="UtilizationLedger=true arms the vtuse "
+                             "per-tenant utilization ledger: the "
+                             "vtpu_utilization_*/vtpu_reclaimable_* "
+                             "series on /metrics and the /utilization "
+                             "cluster view (default off = no new "
+                             "series, no route)")
+    parser.add_argument("--fake-client", action="store_true",
+                        help="back the /utilization cluster fan-in with "
+                             "an empty in-process fake client instead "
+                             "of the in-cluster apiserver (dev/tests)")
     parser.add_argument("--metrics-token-file", default=None,
                         help="require 'Authorization: Bearer <token>' on "
                              "/metrics, token read from this file (the "
@@ -58,6 +69,17 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.metrics.collector import NodeCollector
     from vtpu_manager.tpu.discovery import FakeBackend, discover
 
+    from vtpu_manager.util.featuregates import (UTILIZATION_LEDGER,
+                                                FeatureGates)
+
+    gates = FeatureGates()
+    try:
+        gates.parse(args.feature_gates)
+    except ValueError as e:
+        logging.getLogger(__name__).error("bad --feature-gates: %s", e)
+        return 2
+    util_on = gates.enabled(UTILIZATION_LEDGER)
+
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
     result = discover(backends)
@@ -66,7 +88,32 @@ def main(argv: list[str] | None = None) -> int:
         args.node_name or "unknown", chips, base_dir=args.base_dir,
         tc_path=args.tc_path, vmem_path=args.vmem_path,
         pod_resources_socket=args.pod_resources_socket,
-        kubelet_checkpoint=args.kubelet_checkpoint)
+        kubelet_checkpoint=args.kubelet_checkpoint,
+        utilization_enabled=util_on)
+
+    # vtuse cluster fan-in (gate on only): node/pod annotations over the
+    # existing registry channel; no client degrades to the local cut
+    rollup = None
+    if util_on:
+        from vtpu_manager.utilization.rollup import ClusterRollup
+        if args.fake_client:
+            from vtpu_manager.client.fake import FakeKubeClient
+            util_client = FakeKubeClient(upsert_on_patch=True)
+        else:
+            try:
+                from vtpu_manager.client.kube import InClusterClient
+                util_client = InClusterClient()
+            except Exception:  # noqa: BLE001 — outside a cluster the
+                # monitor still serves the node-local cut
+                logging.getLogger(__name__).warning(
+                    "no in-cluster client; /utilization serves the "
+                    "node-local cut only")
+                util_client = None
+        rollup = ClusterRollup(
+            collector.util_ledger, client=util_client,
+            cache_root=os.path.join(args.base_dir,
+                                    consts.COMPILE_CACHE_SUBDIR),
+            fold_budget_s=collector.util_fold_budget_s)
 
     import hmac
 
@@ -134,10 +181,38 @@ def main(argv: list[str] | None = None) -> int:
     async def healthz(request):
         return web.Response(text="ok")
 
+    async def utilization(request):
+        # the document names pods/namespaces: same bearer auth as
+        # /metrics. Rollup failures (including injected util.rollup
+        # faults) answer HERE with 503 — the /metrics path never runs
+        # this code. The collect itself (synchronous apiserver LISTs +
+        # the ledger fold) runs in an executor thread: a slow rollup
+        # must not occupy the event loop and stall /metrics//healthz.
+        if not authorized(request):
+            return web.json_response({"error": "unauthorized"},
+                                     status=401)
+        import asyncio
+
+        from vtpu_manager.utilization.rollup import filter_document
+        try:
+            doc = await asyncio.get_running_loop().run_in_executor(
+                None, rollup.collect)
+        except Exception as e:  # noqa: BLE001 — a wedged fan-in serves
+            # an explicit error, never a hang or a half-truth
+            return web.json_response(
+                {"error": f"utilization rollup failed: {e}"}, status=503)
+        return web.json_response(filter_document(
+            doc, node=request.query.get("node", ""),
+            pod=request.query.get("pod", "")))
+
     app = web.Application()
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
     app.router.add_get("/healthz", healthz)
+    if rollup is not None:
+        # gate off = no route at all (404), matching "zero new files/
+        # env/annotations/series" — not an empty document
+        app.router.add_get("/utilization", utilization)
     if args.debug_endpoints:
         # stack traces disclose internals: opt-in AND behind the same
         # bearer auth as /metrics when a token is configured

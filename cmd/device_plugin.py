@@ -99,6 +99,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 MEMORY_PLUGIN, RESCHEDULE,
                                                 STEP_TELEMETRY, TC_WATCHER,
                                                 TPU_TOPOLOGY, TRACING,
+                                                UTILIZATION_LEDGER,
                                                 VMEMORY_NODE, FeatureGates)
 
     gates = FeatureGates()
@@ -363,6 +364,23 @@ def main(argv: list[str] | None = None) -> int:
         pressure_pub.start()
         log.info("step-telemetry pressure publisher running")
 
+    # vtuse headroom rollup: this daemon (the node-annotation owner)
+    # folds the utilization ledger and patches the reclaimable-headroom
+    # annotation both scheduler paths decode as an observe-only score
+    # input (metric + trace span this PR; the quota-market PR flips it)
+    headroom_pub = None
+    if gates.enabled(UTILIZATION_LEDGER):
+        from vtpu_manager.utilization import (HeadroomPublisher,
+                                              UtilizationLedger)
+        headroom_pub = HeadroomPublisher(
+            client, args.node_name,
+            UtilizationLedger(
+                args.node_name, chips,
+                base_dir=args.base_dir or consts.MANAGER_BASE_DIR,
+                tc_path=consts.TC_UTIL_CONFIG))
+        headroom_pub.start()
+        log.info("utilization headroom publisher running")
+
     controller = None
     if gates.enabled(RESCHEDULE):
         from vtpu_manager.scheduler.lease import read_lease_state
@@ -399,6 +417,8 @@ def main(argv: list[str] | None = None) -> int:
             cache_evictor_stop.set()
         if pressure_pub:
             pressure_pub.stop()
+        if headroom_pub:
+            headroom_pub.stop()
         if controller:
             controller.stop()
         health.stop()

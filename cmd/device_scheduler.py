@@ -88,7 +88,9 @@ def main(argv: list[str] | None = None) -> int:
                                                 SCHEDULER_SNAPSHOT,
                                                 SERIAL_BIND_NODE,
                                                 SERIAL_FILTER_NODE,
-                                                TRACING, FeatureGates)
+                                                TRACING,
+                                                UTILIZATION_LEDGER,
+                                                FeatureGates)
 
     gates = FeatureGates()
     try:
@@ -128,7 +130,11 @@ def main(argv: list[str] | None = None) -> int:
         # vtcc: compile-storm spreading rides filter_kwargs so the
         # SchedulerHA branch's shards inherit it for free (exactly how
         # they inherit the vttel pressure penalty)
-        anti_storm=gates.enabled(COMPILE_CACHE))
+        anti_storm=gates.enabled(COMPILE_CACHE),
+        # vtuse: observe-only headroom tap (trace span + metric, never
+        # a score change this PR) — same filter_kwargs ride-along so
+        # vtha shards inherit it
+        utilization_hint=gates.enabled(UTILIZATION_LEDGER))
 
     if gates.enabled(SCHEDULER_HA):
         # vtha (default off): N replicas run active-active over a

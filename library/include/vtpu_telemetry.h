@@ -18,8 +18,16 @@
 #ifndef VTPU_TELEMETRY_H_
 #define VTPU_TELEMETRY_H_
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <cstddef>
 #include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
 
 namespace vtpu {
 
@@ -65,6 +73,167 @@ static_assert(offsetof(StepRecord, flags) == 48, "ABI");
 
 constexpr size_t kStepRingFileSize =
     sizeof(StepRingHeader) + kStepRingCapacity * sizeof(StepRecord);
+
+// ---------------------------------------------------------------------------
+// StepRingWriter — the shim-side mirror of stepring.StepRingWriter.
+//
+// Header-only on purpose: the Execute hook in enforce.cc and the
+// g++-probe regression in tests/test_config_abi.py compile the SAME
+// writer, so the bytes a C++ tenant's shim publishes are asserted
+// byte-compatible with the Python reader without needing the cmake
+// build. Protocol mirror of the Python writer, field for field:
+// atomic create (tmp + rename) so a reader never maps a partial file,
+// open-time OFD write lock on the header for cross-process writer
+// exclusion (a live Python-side writer keeps the lock and this one
+// yields — one winner per ring, the Python runtime client arms first
+// for Python tenants), per-record seqlock (seq|1 odd before the
+// payload, +1 even after), and the sequence continues across writer
+// restarts so the reader's cursor stays monotone.
+// ---------------------------------------------------------------------------
+
+class StepRingWriter {
+ public:
+  explicit StepRingWriter(const char* path, const char* trace_id = nullptr) {
+    if (!path || !*path) return;
+    struct stat st;
+    if (stat(path, &st) != 0 ||
+        (size_t)st.st_size != kStepRingFileSize) {
+      if (!CreateAtomically(path, trace_id)) return;
+    }
+    fd_ = open(path, O_RDWR | O_CLOEXEC);
+    if (fd_ < 0) return;
+    // writer exclusion across container restarts (and across the
+    // language boundary): the kernel releases the lock on crash
+    struct flock fl;
+    memset(&fl, 0, sizeof(fl));
+    fl.l_type = F_WRLCK;
+    fl.l_whence = SEEK_SET;
+    fl.l_start = 0;
+    fl.l_len = (off_t)sizeof(StepRingHeader);
+#ifdef F_OFD_SETLK
+    int lock_cmd = F_OFD_SETLK;
+#else
+    int lock_cmd = F_SETLK;
+#endif
+    if (fcntl(fd_, lock_cmd, &fl) != 0) {
+      Close();
+      return;
+    }
+    void* mm = mmap(nullptr, kStepRingFileSize, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd_, 0);
+    if (mm == MAP_FAILED) {
+      Close();
+      return;
+    }
+    mm_ = (uint8_t*)mm;
+    StepRingHeader* h = Header();
+    if (h->magic != kStepRingMagic || h->version != kStepRingVersion ||
+        h->capacity != kStepRingCapacity ||
+        h->record_size != (int32_t)sizeof(StepRecord)) {
+      munmap(mm_, kStepRingFileSize);
+      mm_ = nullptr;
+      Close();
+      return;
+    }
+    // a restarted writer continues the sequence: the reader's cursor
+    // stays monotone across writer generations
+    writes_ = __atomic_load_n(&h->writes, __ATOMIC_ACQUIRE);
+    h->writer_pid = (int32_t)getpid();
+    if (trace_id && *trace_id) {
+      memset(h->trace_id, 0, kStepTraceIdLen);
+      strncpy(h->trace_id, trace_id, kStepTraceIdLen - 1);
+    }
+  }
+
+  ~StepRingWriter() {
+    if (mm_) {
+      munmap(mm_, kStepRingFileSize);
+      mm_ = nullptr;
+    }
+    Close();  // the kernel drops the OFD lock with the fd
+  }
+
+  StepRingWriter(const StepRingWriter&) = delete;
+  StepRingWriter& operator=(const StepRingWriter&) = delete;
+
+  bool ok() const { return mm_ != nullptr; }
+  uint64_t writes() const { return writes_; }
+
+  // Publish one step record (the hot path: mmap stores only). Seqlock
+  // bracket per the shared-mmap protocol — odd seq first, payload,
+  // even seq last; `seq | 1` so a crashed writer's odd leftover can't
+  // invert parity and let torn reads validate.
+  void Record(uint64_t duration_ns, uint64_t throttle_wait_ns,
+              uint64_t hbm_highwater_bytes, bool compiled,
+              uint64_t start_mono_ns = 0) {
+    if (!mm_) return;
+    if (start_mono_ns == 0) {
+      struct timespec ts;
+      clock_gettime(CLOCK_MONOTONIC, &ts);
+      uint64_t now = (uint64_t)ts.tv_sec * 1000000000ull +
+                     (uint64_t)ts.tv_nsec;
+      start_mono_ns = now > duration_ns ? now - duration_ns : 0;
+    }
+    uint64_t index = writes_;
+    StepRecord* rec = (StepRecord*)(mm_ + sizeof(StepRingHeader) +
+                                    (index % kStepRingCapacity) *
+                                        sizeof(StepRecord));
+    uint64_t seq = __atomic_load_n(&rec->seq, __ATOMIC_RELAXED);
+    uint64_t wseq = seq | 1;
+    __atomic_store_n(&rec->seq, wseq, __ATOMIC_RELEASE);  // odd: writing
+    rec->index = index;
+    rec->start_mono_ns = start_mono_ns;
+    rec->duration_ns = duration_ns;
+    rec->throttle_wait_ns = throttle_wait_ns;
+    rec->hbm_highwater_bytes = hbm_highwater_bytes;
+    rec->flags = compiled ? kStepFlagCompile : 0;
+    rec->pad_ = 0;
+    __atomic_store_n(&rec->seq, wseq + 1, __ATOMIC_RELEASE);  // even
+    writes_ = index + 1;
+    __atomic_store_n(&Header()->writes, writes_, __ATOMIC_RELEASE);
+  }
+
+ private:
+  StepRingHeader* Header() { return (StepRingHeader*)mm_; }
+
+  void Close() {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  static bool CreateAtomically(const char* path, const char* trace_id) {
+    // tmp + rename: a reader mmaping the final path must never observe
+    // a partial file (the Python writer's contract)
+    char tmp[4096];
+    int n = snprintf(tmp, sizeof(tmp), "%s.tmp.%d", path, (int)getpid());
+    if (n < 0 || (size_t)n >= sizeof(tmp)) return false;
+    int fd = open(tmp, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+    if (fd < 0) return false;
+    StepRingHeader h;
+    memset(&h, 0, sizeof(h));
+    h.magic = kStepRingMagic;
+    h.version = kStepRingVersion;
+    h.capacity = kStepRingCapacity;
+    h.record_size = (int32_t)sizeof(StepRecord);
+    h.writer_pid = (int32_t)getpid();
+    if (trace_id && *trace_id)
+      strncpy(h.trace_id, trace_id, kStepTraceIdLen - 1);
+    bool ok = write(fd, &h, sizeof(h)) == (ssize_t)sizeof(h) &&
+              ftruncate(fd, (off_t)kStepRingFileSize) == 0;
+    close(fd);
+    if (!ok || rename(tmp, path) != 0) {
+      unlink(tmp);
+      return false;
+    }
+    return true;
+  }
+
+  int fd_ = -1;
+  uint8_t* mm_ = nullptr;
+  uint64_t writes_ = 0;
+};
 
 }  // namespace vtpu
 

@@ -37,11 +37,13 @@
 #include <chrono>
 #include <cinttypes>
 #include <condition_variable>
+#include <mutex>
 #include <string>
 #include <unordered_set>
 #include <vector>
 
 #include "shim.h"
+#include "vtpu_telemetry.h"
 
 namespace vtpu {
 
@@ -1682,6 +1684,51 @@ extern "C" uint64_t vtpu_throttle_wait_ns_total() {
   return g_throttle_wait_ns.load(std::memory_order_relaxed);
 }
 
+// vttel/vtuse: the Execute hook's step-ring writer, so non-Python
+// tenants (anything driving PJRT through this shim without the Python
+// runtime client) appear in the utilization ledger too. Armed lazily on
+// the first measured Execute from the same env Allocate injects
+// (VTPU_STEP_TELEMETRY/VTPU_STEP_RING_PATH); for Python tenants the
+// runtime client has already taken the ring's OFD writer lock by then,
+// so this writer yields and exactly one step stream exists per ring.
+StepRingWriter* g_step_ring = nullptr;
+std::mutex g_step_ring_mu;
+pthread_once_t g_step_ring_once = PTHREAD_ONCE_INIT;
+uint64_t g_step_ring_last_wait_ns = 0;
+
+void InitStepRingOnce() {
+  const char* armed = getenv("VTPU_STEP_TELEMETRY");
+  const char* path = getenv("VTPU_STEP_RING_PATH");
+  if (!armed || strcmp(armed, "true") != 0 || !path || !*path) return;
+  StepRingWriter* w = new StepRingWriter(path, getenv("VTPU_TRACE_ID"));
+  if (!w->ok()) {
+    // lock held (live Python writer) or unusable path: one writer per
+    // ring, and it isn't us — telemetry still flows from the winner
+    delete w;
+    return;
+  }
+  g_step_ring = w;
+}
+
+// One ring record per measured Execute: duration straight from the
+// span, throttle-wait as the delta of the token-wait counter since the
+// previous record (the same source the Python client reads over
+// ctypes), HBM high-water from the slot's peak accounting.
+void RecordStepRing(int slot, uint64_t start_ns, uint64_t end_ns,
+                    bool compiled) {
+  pthread_once(&g_step_ring_once, InitStepRingOnce);
+  if (!g_step_ring) return;
+  uint64_t wait_total = g_throttle_wait_ns.load(std::memory_order_relaxed);
+  int64_t peak = State().hot[slot].peak_bytes.load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> g(g_step_ring_mu);
+  uint64_t wait_delta = wait_total >= g_step_ring_last_wait_ns
+                            ? wait_total - g_step_ring_last_wait_ns
+                            : 0;
+  g_step_ring_last_wait_ns = wait_total;
+  g_step_ring->Record(end_ns - start_ns, wait_delta,
+                      peak > 0 ? (uint64_t)peak : 0, compiled, start_ns);
+}
+
 void RateLimit(int slot, int64_t cost_us) {
   ShimState& s = State();
   const VtpuDevice* cfg = DeviceCfg(slot);
@@ -1760,6 +1807,7 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
   if (exe) {
     s.hot[slot].inflight.fetch_sub(1, std::memory_order_relaxed);
   }
+  bool first_execute = false;
   if (exe && measured) {
     // Cost EMA uses the raw duration (coverage clamping below is about
     // busy accounting, not per-program cost).
@@ -1767,11 +1815,20 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
     std::lock_guard<std::mutex> g(s.cost_mu);
     auto it = s.exec_cost_us.find(exe);
     if (it == s.exec_cost_us.end()) {
+      first_execute = true;
       s.exec_cost_us[exe] = (double)raw_us;
     } else {
       it->second =
           (1 - kCostEmaAlpha) * it->second + kCostEmaAlpha * raw_us;
     }
+  }
+  if (measured) {
+    // vttel: the step-ring record for C++-driven tenants (one per
+    // measured Execute; FLAG_COMPILE on an executable's first
+    // completion — the compile-paying step, mirroring the Python
+    // client's convention). No-op unless the telemetry env is armed
+    // AND no Python-side writer owns the ring.
+    RecordStepRing(slot, start_ns, end_ns, first_execute);
   }
   // Busy-time coverage: multiple observers (await thread, transfer
   // callbacks) report overlapping spans of the same device activity; credit

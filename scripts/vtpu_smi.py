@@ -1,0 +1,210 @@
+#!/usr/bin/env python3
+"""vtpu-smi: the cluster utilization view (the reference's vgpu-smi).
+
+Usage:
+    python scripts/vtpu_smi.py                          # whole cluster
+    python scripts/vtpu_smi.py --node node-1            # one node
+    python scripts/vtpu_smi.py --pod trainer-0          # one pod's rows
+    python scripts/vtpu_smi.py --watch 5                # refresh loop
+    python scripts/vtpu_smi.py --json                   # machine output
+
+One command renders the cluster as chips x tenants — quota, live use,
+reclaimable headroom, pressure, and compile-cache state — sourced from
+the monitor's /utilization endpoint (UtilizationLedger gate). Per-tenant
+LIVE rows (used %, throttle-wait, high-water) are node-local truth, so
+point --endpoint at the node whose tenants you are inspecting; quota
+rows and per-chip headroom are cluster-wide from one fan-in.
+
+--from-file replays a saved /utilization document (tests, offline
+postmortems). Auth: --token-file sends the same bearer token /metrics
+takes.
+
+Exit codes: 0 ok, 1 endpoint unreachable / no data, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def fetch(endpoint: str, token_file: str | None,
+          node: str, pod: str) -> dict:
+    url = endpoint
+    params = []
+    if node:
+        params.append(f"node={urllib.parse.quote(node)}")
+    if pod:
+        params.append(f"pod={urllib.parse.quote(pod)}")
+    if params:
+        url += ("&" if "?" in url else "?") + "&".join(params)
+    req = urllib.request.Request(url)
+    if token_file:
+        with open(token_file) as f:
+            req.add_header("Authorization", f"Bearer {f.read().strip()}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _pct(v) -> str:
+    return "-" if v is None else f"{v:6.1f}%"
+
+
+def _gib(v) -> str:
+    return "-" if v is None else f"{v / (1 << 30):6.2f}G"
+
+
+def _conf(row: dict) -> str:
+    c = row.get("confidence")
+    if c is None:
+        return "-"
+    if c <= 0.0:
+        return "no-signal"
+    return f"{c:.2f}"
+
+
+def render(doc: dict, out=None) -> None:
+    out = out or sys.stdout
+    cluster = doc.get("cluster") or {}
+    local = doc.get("node") or {}
+    print(f"vtpu-smi  cluster: {cluster.get('nodes', 0)} node(s)  "
+          f"{cluster.get('chips', 0)} chip(s)  "
+          f"reclaimable {cluster.get('reclaimable_core_pct', 0)}% core  "
+          f"({cluster.get('nodes_with_signal', 0)} node(s) reporting)",
+          file=out)
+    for err in doc.get("errors") or []:
+        print(f"  warning: {err}", file=out)
+
+    for nrow in doc.get("nodes") or []:
+        name = nrow.get("node", "?")
+        bits = []
+        if nrow.get("pressure_frac") is not None:
+            bits.append(f"pressure {nrow['pressure_frac']:.2f}")
+        if nrow.get("reclaim_core_pct") is not None:
+            bits.append(f"reclaimable {nrow['reclaim_core_pct']}%")
+        elif nrow.get("headroom_stale"):
+            bits.append("headroom STALE (publisher gone)")
+        else:
+            bits.append("no headroom signal")
+        if nrow.get("local"):
+            cache = local.get("compile_cache")
+            if cache:
+                bits.append(f"cache {cache['entries']} entries/"
+                            f"{cache['size_bytes'] / (1 << 20):.0f}M "
+                            f"({cache['hits']}h/{cache['misses']}m)")
+        print(f"NODE {name}  " + "  ".join(bits), file=out)
+        if nrow.get("chips"):
+            print(f"  {'chip':>4} {'uuid':<20} {'quota':>7} {'used':>7} "
+                  f"{'reclaim':>8} {'hbm-reclaim':>11}", file=out)
+            for ch in nrow["chips"]:
+                print(f"  {ch.get('index', '?'):>4} "
+                      f"{str(ch.get('uuid', ''))[:20]:<20} "
+                      f"{_pct(ch.get('alloc_core_pct')):>7} "
+                      f"{_pct(ch.get('used_core_pct')):>7} "
+                      f"{_pct(ch.get('reclaim_core_pct')):>8} "
+                      f"{_gib(ch.get('reclaim_hbm_bytes')):>11}",
+                      file=out)
+
+    # the document's tenant cut already merges cluster quota rows with
+    # the node-local ledger rows (rollup.collect), so the ?pod=/?node=
+    # filters apply uniformly — no local fallback that would bypass them
+    tenants = doc.get("tenants") or []
+    if tenants:
+        print(f"{'POD':<28} {'container':<12} {'node':<12} {'chip':>4} "
+              f"{'quota':>7} {'used':>7} {'wait':>6} {'hbm-hw':>8} "
+              f"{'conf':>9}", file=out)
+        for t in tenants:
+            pod = t.get("pod_name") or t.get("pod_uid", "?")
+            ns = t.get("pod_namespace", "")
+            label = f"{ns}/{pod}" if ns else pod
+            wait = t.get("throttle_wait_frac")
+            print(f"{label[:28]:<28} {t.get('container', '')[:12]:<12} "
+                  f"{t.get('node', '')[:12]:<12} "
+                  f"{t.get('chip_index', '?'):>4} "
+                  f"{_pct(t.get('allocated_core_pct')):>7} "
+                  f"{_pct(t.get('used_core_pct')):>7} "
+                  f"{'-' if wait is None else f'{wait * 100:4.1f}%':>6} "
+                  f"{_gib(t.get('hbm_highwater_bytes')):>8} "
+                  f"{_conf(t):>9}", file=out)
+    else:
+        print("(no tenant rows)", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vtpu-smi", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--endpoint",
+                        default="http://127.0.0.1:9394/utilization",
+                        help="monitor /utilization URL "
+                             "(default: %(default)s)")
+    parser.add_argument("--token-file", default=None,
+                        help="bearer token for an auth-gated monitor")
+    parser.add_argument("--from-file", default=None,
+                        help="render a saved /utilization JSON document "
+                             "instead of fetching (tests/offline)")
+    parser.add_argument("--node", default="",
+                        help="restrict to one node's chips/tenants")
+    parser.add_argument("--pod", default="",
+                        help="restrict tenant rows to one pod "
+                             "(name or uid)")
+    parser.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                        help="refresh every SEC seconds until interrupted")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="print the raw document")
+    args = parser.parse_args(argv)
+
+    if args.watch and args.from_file:
+        print("vtpu-smi: --watch needs a live --endpoint, not "
+              "--from-file", file=sys.stderr)
+        return 2
+
+    def get() -> dict | None:
+        if args.from_file:
+            try:
+                with open(args.from_file) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"vtpu-smi: cannot read {args.from_file}: {e}",
+                      file=sys.stderr)
+                return None
+            # apply the cuts the live route would have applied
+            from vtpu_manager.utilization.rollup import filter_document
+            return filter_document(doc, node=args.node, pod=args.pod)
+        try:
+            return fetch(args.endpoint, args.token_file, args.node,
+                         args.pod)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"vtpu-smi: {args.endpoint}: {e} (is the monitor "
+                  f"running with UtilizationLedger=true?)",
+                  file=sys.stderr)
+            return None
+
+    while True:
+        doc = get()
+        if doc is None:
+            return 1
+        if args.as_json:
+            print(json.dumps(doc, indent=2))
+        else:
+            render(doc)
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+        if not args.as_json:
+            print("\033[2J\033[H", end="")   # clear between refreshes
+
+
+if __name__ == "__main__":
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.exit(main())

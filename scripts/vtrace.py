@@ -134,11 +134,27 @@ def main(argv: list[str] | None = None) -> int:
         steps = step_stats_for_pod(args.steps_dir, tl.trace_id,
                                    tl.pod_uid or args.pod)
         compiles = _compile_cache_splice(tl)
+        # vtuse splice: used-vs-allocated rows off the same ring+config
+        # join, plus the observe-only headroom the scheduler logged at
+        # placement time (the scheduler.headroom trace event) — the
+        # admission story, the step story, and the utilization story
+        # print as one report keyed by one trace id
+        from vtpu_manager.utilization import utilization_stats_for_pod
+        util = utilization_stats_for_pod(args.steps_dir, tl.trace_id,
+                                         tl.pod_uid or args.pod)
+        placement_headroom = [
+            {"node": s.attrs.get("node", ""),
+             "signal": s.attrs.get("signal"),
+             "score_input": s.attrs.get("score_input"),
+             "reclaim_core_pct": s.attrs.get("reclaim_core_pct")}
+            for s in tl.spans if s.stage == "scheduler.headroom"]
         if args.as_json:
             print(json.dumps({"timeline": tl.to_wire(),
                               "critical_path": assemble.critical_path(tl),
                               "steps": steps,
-                              "compile_cache": compiles},
+                              "compile_cache": compiles,
+                              "utilization": util,
+                              "placement_headroom": placement_headroom},
                              indent=2))
         else:
             _print_timeline(tl)
@@ -156,6 +172,20 @@ def main(argv: list[str] | None = None) -> int:
                       f"({c['dur_s'] * 1000:.3f} ms, key {c['key']})"
                       + ("" if c['outcome'] != 'miss' else
                          "  <- this tenant compiled; replicas hit"))
+            for u in util:
+                print(f"  utilization [{u['container']}]: "
+                      f"used {u['used_core_pct']:.1f}% of "
+                      f"{u['allocated_core_pct']:.0f}% quota  "
+                      f"throttle-wait "
+                      f"{u['throttle_wait_frac'] * 100:.1f}%  "
+                      f"hbm-hw {u['hbm_highwater_bytes']}"
+                      f"/{u['allocated_hbm_bytes']}")
+            for h in placement_headroom:
+                sig = ("reclaimable "
+                       f"{h['reclaim_core_pct']}% core on the node"
+                       if h.get("signal") else "no headroom signal")
+                print(f"  headroom-at-placement [{h['node']}]: {sig} "
+                      f"(observe-only score input {h['score_input']})")
         return 0
 
     if args.list_pods:

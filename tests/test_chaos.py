@@ -507,6 +507,13 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     failpoints.arm("cache.write", "partial-write", p=0.3,
                    count=rng.randint(1, 2))
     failpoints.arm("cache.lease", "crash", p=0.2, count=1)
+    # vtuse sites: driven by the dedicated utilization chaos tests
+    # (test_utilization.py — the e2e loop here never folds the ledger
+    # or serves /utilization), armed so the full-coverage assertion
+    # stays the honest catalog check
+    failpoints.arm("util.fold", "error", p=0.3, count=rng.randint(1, 2))
+    failpoints.arm("util.rollup", "error", p=0.3,
+                   count=rng.randint(1, 2))
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 

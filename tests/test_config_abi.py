@@ -494,3 +494,104 @@ class TestSeqlockLiveRace:
         assert proc.returncode == 0, out
         assert torn == 0, f"{torn} torn reads out of {reads}"
         assert reads > 50, reads
+
+
+# ---------------------------------------------------------------------------
+# vtuse satellite: the C++ shim-side step-ring WRITER (vtpu_telemetry.h)
+# round-trips byte-compatibly through the Python reader — so non-Python
+# tenants (shim Execute hook) appear in the utilization ledger too.
+# ---------------------------------------------------------------------------
+
+WRITER_PROBE_SRC = r"""
+#include <cstdio>
+#include <cstdlib>
+#include "vtpu_telemetry.h"
+using namespace vtpu;
+int main(int argc, char** argv) {
+  // argv: <ring path> <n records> [trace id]
+  StepRingWriter w(argv[1], argc > 3 ? argv[3] : nullptr);
+  if (!w.ok()) return 3;   // lock held (live writer) or unusable path
+  int n = atoi(argv[2]);
+  for (int i = 0; i < n; i++) {
+    // FLAG_COMPILE on the stream's very first record, mirroring the
+    // shim's first-execute convention
+    w.Record(4000000ull, 1000000ull, 1ull << 20, w.writes() == 0,
+             1000000ull * (w.writes() + 1));
+  }
+  printf("%llu\n", (unsigned long long)w.writes());
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cxx_ring_writer(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ringprobe")
+    src = tmp / "writer_probe.cc"
+    src.write_text(WRITER_PROBE_SRC)
+    exe = tmp / "writer_probe"
+    subprocess.run(
+        ["g++", "-std=c++17", f"-I{REPO}/library/include", str(src),
+         "-o", str(exe)], check=True, capture_output=True)
+    return str(exe)
+
+
+class TestCxxStepRingWriter:
+    def test_cxx_writes_python_reads(self, cxx_ring_writer, tmp_path):
+        from vtpu_manager.telemetry import stepring
+        ring = str(tmp_path / "step_telemetry.ring")
+        out = subprocess.run([cxx_ring_writer, ring, "5", "tr-cxx-1"],
+                             check=True, capture_output=True, text=True)
+        assert out.stdout.strip() == "5"
+        reader = stepring.StepRingReader(ring)
+        try:
+            assert reader.trace_id == "tr-cxx-1"
+            records, head, dropped = reader.poll(0)
+            assert head == 5 and dropped == 0
+            assert [r.index for r in records] == list(range(5))
+            assert records[0].compiled and not records[1].compiled
+            assert records[2].duration_ns == 4_000_000
+            assert records[2].throttle_wait_ns == 1_000_000
+            assert records[2].hbm_highwater_bytes == 1 << 20
+            assert records[3].start_mono_ns == 4_000_000
+        finally:
+            reader.close()
+
+    def test_restart_continues_sequence(self, cxx_ring_writer, tmp_path):
+        """A restarted C++ writer continues the monotone sequence, so
+        the monitor's cursor tail never resets (the Python writer's
+        contract, satisfied by the mirror)."""
+        from vtpu_manager.telemetry import stepring
+        ring = str(tmp_path / "step_telemetry.ring")
+        subprocess.run([cxx_ring_writer, ring, "3"], check=True,
+                       capture_output=True)
+        out = subprocess.run([cxx_ring_writer, ring, "2"], check=True,
+                             capture_output=True, text=True)
+        assert out.stdout.strip() == "5"
+        reader = stepring.StepRingReader(ring)
+        try:
+            records, head, dropped = reader.poll(3)   # cursor-tailed
+            assert head == 5 and dropped == 0
+            assert [r.index for r in records] == [3, 4]
+        finally:
+            reader.close()
+
+    def test_yields_to_live_python_writer(self, cxx_ring_writer,
+                                          tmp_path):
+        """Writer exclusion across the language boundary: while the
+        Python runtime client holds the ring's OFD lock, the shim's
+        writer yields (one step stream per ring); the lock's release
+        hands the ring over."""
+        from vtpu_manager.telemetry import stepring
+        ring = str(tmp_path / "step_telemetry.ring")
+        w = stepring.StepRingWriter(ring, trace_id="py-owner")
+        try:
+            w.record(duration_ns=1_000_000)
+            proc = subprocess.run([cxx_ring_writer, ring, "5"],
+                                  capture_output=True)
+            assert proc.returncode == 3, "C++ writer must yield"
+        finally:
+            w.close()
+        out = subprocess.run([cxx_ring_writer, ring, "2"], check=True,
+                             capture_output=True, text=True)
+        assert out.stdout.strip() == "3"   # continues after handover

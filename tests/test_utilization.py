@@ -1,0 +1,945 @@
+"""vtuse suite: utilization ledger, reclaimable headroom, vtpu-smi.
+
+Covers the tentpole contracts:
+- ledger math: EWMA fold, burstiness discount, staleness decay to
+  no-signal, never-sampled quota is never reclaimable;
+- the budgeted fold: a node with dozens of rings stays inside the
+  scrape budget, drops are counted and resumed round-robin;
+- gate-off byte-contract: no new series, no feed label, no route, no
+  annotations, placement byte-identical in both scheduler modes;
+- observe-only scheduler tap: placement parity with the hint on/off,
+  the scheduler.headroom trace event, the /metrics counter;
+- chaos: util.fold / util.rollup injections never block /metrics, and
+  headroom decays to no-signal instead of serving stale claims;
+- the acceptance e2e: a synthetic tenant using 30% of an 80%
+  allocation yields ~50% reclaimable headroom end-to-end through
+  /utilization and vtpu-smi --json, then decays when the writer dies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.node_config import NodeConfig
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.types import fake_chip
+from vtpu_manager.manager.device_manager import DeviceManager
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.telemetry import stepring
+from vtpu_manager.tpu.discovery import FakeBackend
+from vtpu_manager.util import consts
+from vtpu_manager.utilization import (HeadroomPublisher, NodeHeadroom,
+                                      UtilizationLedger,
+                                      headroom_score_input, parse_headroom)
+from vtpu_manager.utilization import headroom as hr_mod
+from vtpu_manager.utilization.ledger import BURST_SIGMA_K, STALENESS_S
+from vtpu_manager.utilization.rollup import ClusterRollup, filter_document
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+POD_UID = "util-pod-uid-1"
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a tenant = one config dir + one step ring
+# ---------------------------------------------------------------------------
+
+def _mk_config(base, pod_uid, container, hard_core=80,
+               total_memory=8 * 2**30, host_index=0,
+               uuid="TPU-FAKE-0000", pod_name="trainer", ns="ml"):
+    path = os.path.join(base, f"{pod_uid}_{container}", "config",
+                        "vtpu.config")
+    vc.write_config(path, vc.VtpuConfig(
+        pod_uid=pod_uid, pod_name=pod_name, pod_namespace=ns,
+        container_name=container,
+        devices=[vc.DeviceConfig(uuid=uuid, total_memory=total_memory,
+                                 real_memory=total_memory,
+                                 hard_core=hard_core,
+                                 host_index=host_index)]))
+    return path
+
+
+def _mk_ring(base, pod_uid, container, trace_id=""):
+    d = os.path.join(base, f"{pod_uid}_{container}",
+                     consts.TELEMETRY_SUBDIR)
+    os.makedirs(d, exist_ok=True)
+    return stepring.StepRingWriter(
+        os.path.join(d, consts.STEP_RING_NAME), trace_id=trace_id)
+
+
+def _write_busy(writer, busy_s, window_s, wait_frac=0.0, hbm=1 << 20,
+                n_steps=10):
+    """n steps whose durations sum to busy_s (the sample the ledger
+    derives over a window_s poll window is 100*busy_s/window_s)."""
+    step_ns = int(busy_s * 1e9 / n_steps)
+    for _ in range(n_steps):
+        writer.record(duration_ns=step_ns,
+                      throttle_wait_ns=int(step_ns * wait_frac),
+                      hbm_highwater_bytes=hbm)
+
+
+def _fold_sample(ledger, writer, busy_s, window_s, t, **kw):
+    """One prime-less fold cycle: write then fold at t+window."""
+    _write_busy(writer, busy_s, window_s, **kw)
+    ledger.fold(now_mono=t + window_s, now_wall=time.time())
+    return t + window_s
+
+
+# ---------------------------------------------------------------------------
+# headroom codec
+# ---------------------------------------------------------------------------
+
+class TestHeadroomCodec:
+    def test_roundtrip(self):
+        hr = NodeHeadroom(chips={
+            0: hr_mod.ChipHeadroom(80.0, 30.0, 42.5, 1 << 30),
+            1: hr_mod.ChipHeadroom(0.0, 0.0, 0.0, 0)}, ts=1000.0)
+        back = parse_headroom(hr.encode(), now=1001.0)
+        assert back is not None
+        assert back.chips[0].reclaim_core_pct == 42.5
+        assert back.chips[0].reclaim_hbm_bytes == 1 << 30
+        assert back.chips[1].alloc_core_pct == 0.0
+        assert back.total_reclaim_core_pct() == 42.5
+
+    def test_stale_and_garbage_decay_to_none(self):
+        hr = NodeHeadroom(chips={0: hr_mod.ChipHeadroom(80, 30, 50, 0)},
+                          ts=1000.0)
+        enc = hr.encode()
+        assert parse_headroom(enc, now=1000.0 + 121) is None  # stale
+        assert parse_headroom(enc, now=1000.0 - 60) is None   # future
+        assert parse_headroom(enc, now=1000.0 - 3) is not None  # skew ok
+        assert parse_headroom("") is None
+        assert parse_headroom(None) is None
+        assert parse_headroom("no-at-sign") is None
+        assert parse_headroom("0:1:2:3@1000", now=1001) is None  # 4 fields
+        assert parse_headroom("0:nan:1:2:3@1000", now=1001) is None
+        assert parse_headroom("x:1:2:3:4@1000", now=1001) is None
+
+    def test_score_input_rejudges_staleness_at_use_time(self):
+        hr = parse_headroom(NodeHeadroom(
+            chips={0: hr_mod.ChipHeadroom(80, 30, 50, 0)},
+            ts=1000.0).encode(), now=1001.0)
+        assert headroom_score_input(hr, now=1010.0) == 50.0
+        # the snapshot caches the parsed value; a dead publisher emits
+        # no more events, so the use-time check is what decays it
+        assert headroom_score_input(hr, now=1000.0 + 500) == 0.0
+        assert headroom_score_input(None) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# ledger math
+# ---------------------------------------------------------------------------
+
+class TestLedgerMath:
+    def test_thirty_of_eighty_yields_fifty_reclaimable(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main", hard_core=80)
+        w = _mk_ring(base, "uid-1", "main", trace_id="tr-1")
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        t = 0.0
+        ledger.fold(now_mono=t, now_wall=time.time())   # prime cursors
+        for _ in range(4):   # steady 30% busy windows -> sigma ~ 0
+            t = _fold_sample(ledger, w, busy_s=3.0, window_s=10.0, t=t)
+        w.close()
+        rollup = ledger.chip_rollup()
+        assert rollup[0]["alloc_core_pct"] == 80.0
+        assert abs(rollup[0]["used_core_pct"] - 30.0) < 1.0
+        assert abs(rollup[0]["reclaim_core_pct"] - 50.0) < 2.0
+        assert rollup[0]["confidence"] > 0.9
+        hr = ledger.headroom()
+        assert abs(hr.chips[0].reclaim_core_pct - 50.0) < 2.0
+
+    def test_burstiness_discounts_spiky_tenant(self, tmp_path):
+        def run(samples):
+            base = str(tmp_path / f"mgr-{samples[0]}-{len(samples)}")
+            _mk_config(base, "uid-1", "main", hard_core=80)
+            w = _mk_ring(base, "uid-1", "main")
+            ledger = UtilizationLedger("n1", [fake_chip(0)],
+                                       base_dir=base)
+            t = 0.0
+            ledger.fold(now_mono=t, now_wall=time.time())
+            for frac in samples:
+                t = _fold_sample(ledger, w, busy_s=frac * 10.0,
+                                 window_s=10.0, t=t)
+            w.close()
+            return ledger.chip_rollup()[0]["reclaim_core_pct"]
+
+        steady = run([0.30] * 8)
+        spiky = run([0.05, 0.55] * 4)          # same 30% mean
+        assert spiky < steady - 5.0, (steady, spiky)
+        # the discount is the sigma envelope, not a zeroing
+        assert spiky >= 0.0
+
+    def test_dead_writer_decays_to_no_signal(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main", hard_core=80)
+        w = _mk_ring(base, "uid-1", "main")
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        t0 = time.time()
+        ledger.fold(now_mono=0.0, now_wall=t0)
+        _write_busy(w, busy_s=3.0, window_s=10.0)
+        ledger.fold(now_mono=10.0, now_wall=t0)
+        w.close()
+        assert ledger.chip_rollup(t0)[0]["reclaim_core_pct"] > 40.0
+        # half the staleness budget: confidence decays linearly
+        mid = ledger.chip_rollup(t0 + STALENESS_S / 2)[0]
+        assert 0.3 < mid["confidence"] < 0.7
+        assert mid["reclaim_core_pct"] < 30.0
+        # past the budget: no-signal, zero reclaimable — stale claims
+        # are never served (writes stopped, fold keeps running)
+        ledger.fold(now_mono=200.0, now_wall=t0 + STALENESS_S + 10)
+        late = ledger.chip_rollup(t0 + STALENESS_S + 10)[0]
+        assert late["confidence"] == 0.0
+        assert late["reclaim_core_pct"] == 0.0
+        assert late["reclaim_hbm_bytes"] == 0
+        row = ledger.to_wire(t0 + STALENESS_S + 10)["tenants"][0]
+        assert row["stale"] is True
+
+    def test_never_sampled_tenant_is_not_reclaimable(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main", hard_core=80)   # no ring
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        ledger.fold()
+        row = ledger.chip_rollup()[0]
+        assert row["alloc_core_pct"] == 80.0
+        assert row["reclaim_core_pct"] == 0.0
+        assert row["confidence"] == 0.0
+
+    def test_throttle_wait_and_hbm_reclaim(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        hbm_cap = 8 * 2**30
+        _mk_config(base, "uid-1", "main", hard_core=80,
+                   total_memory=hbm_cap)
+        w = _mk_ring(base, "uid-1", "main")
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        t0 = time.time()
+        ledger.fold(now_mono=0.0, now_wall=t0)
+        _write_busy(w, busy_s=4.0, window_s=10.0, wait_frac=0.25,
+                    hbm=2 * 2**30)
+        ledger.fold(now_mono=10.0, now_wall=t0)
+        w.close()
+        s = ledger.tenants()[0]
+        assert abs(s.wait_frac - 0.25) < 0.01
+        assert s.hbm_highwater == 2 * 2**30
+        # reclaim hbm = (cap - high-water) * confidence
+        assert abs(ledger.chip_rollup(t0)[0]["reclaim_hbm_bytes"]
+                   - 6 * 2**30) < 2**20
+        # busy fraction EXCLUDES throttle wait: 4s duration at 25% wait
+        # over 10s = 30% real use
+        assert abs(s.used_ewma - 30.0) < 1.0
+
+    def test_removed_tenant_rows_go(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        cfg = _mk_config(base, "uid-1", "main")
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        ledger.fold()
+        assert ledger.tenants()
+        os.unlink(cfg)
+        ledger.fold()
+        assert not ledger.tenants()
+
+    def test_render_series_shapes(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main", hard_core=80)
+        w = _mk_ring(base, "uid-1", "main")
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        t0 = time.time()
+        ledger.fold(now_mono=0.0, now_wall=t0)
+        _write_busy(w, busy_s=3.0, window_s=10.0)
+        ledger.fold(now_mono=10.0, now_wall=t0)
+        w.close()
+        text = ledger.render(now_wall=t0)
+        label = ('node="n1",pod_uid="uid-1",container="main",'
+                 'uuid="TPU-FAKE-0000"')
+        assert f"vtpu_utilization_allocated_core_percent{{{label}}} 80" \
+            in text
+        assert f"vtpu_utilization_used_core_percent{{{label}}} 30" in text
+        assert ('vtpu_reclaimable_headroom_core_percent{node="n1",'
+                'uuid="TPU-FAKE-0000",index="0"} 50') in text
+        assert 'vtpu_utilization_folds_dropped_total{node="n1"} 0' in text
+
+
+# ---------------------------------------------------------------------------
+# the budgeted fold
+# ---------------------------------------------------------------------------
+
+class TestFoldBudget:
+    N_RINGS = 64
+
+    def _populate(self, base):
+        writers = []
+        for i in range(self.N_RINGS):
+            _mk_config(base, f"uid-{i:03d}", "main")
+            w = _mk_ring(base, f"uid-{i:03d}", "main")
+            _write_busy(w, busy_s=1.0, window_s=10.0, n_steps=50)
+            writers.append(w)
+        return writers
+
+    def test_full_node_fold_inside_scrape_budget(self, tmp_path):
+        """Acceptance: a >=64-ring fold fits the existing scrape budget
+        (the collector default, VTPU_UTIL_FOLD_BUDGET_S=0.25)."""
+        base = str(tmp_path / "mgr")
+        writers = self._populate(base)
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        budget = 0.25
+        assert ledger.fold(budget_s=budget) == 0
+        assert ledger.folds_dropped_total == 0, \
+            "64 rings must fold inside one scrape budget"
+        assert ledger.last_fold_s <= budget
+        for w in writers:
+            w.close()
+
+    def test_budget_overrun_drops_and_resumes_round_robin(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        writers = self._populate(base)
+        ledger = UtilizationLedger("n1", [fake_chip(0)], base_dir=base)
+        tiny = 1e-6   # guarantees an overrun after the first ring
+        t0 = time.perf_counter()
+        ledger.fold(budget_s=tiny)
+        first_elapsed = time.perf_counter() - t0
+        assert ledger.folds_dropped_total > 0
+        # the bound: budget + one ring's overshoot + walk overhead,
+        # never the full-node fold (generous for a loaded CI box)
+        assert first_elapsed < 1.0
+        # prime every ring (first poll baselines, no sample yet), then
+        # land fresh records: round-robin resumption must deliver a
+        # sample to EVERY ring across successive tiny-budget folds
+        for _ in range(self.N_RINGS + 2):
+            ledger.fold(budget_s=0.05)
+        for w in writers:
+            _write_busy(w, busy_s=1.0, window_s=10.0)
+        t = time.monotonic() + 100.0
+        for _ in range(self.N_RINGS * 4):
+            ledger.fold(budget_s=0.01, now_mono=t)
+            t += 10.0
+            if all(s.samples > 0 for s in ledger.tenants()):
+                break
+        sampled = [s for s in ledger.tenants() if s.samples > 0]
+        assert len(sampled) == self.N_RINGS, \
+            f"only {len(sampled)}/{self.N_RINGS} rings ever folded"
+        for w in writers:
+            w.close()
+
+
+# ---------------------------------------------------------------------------
+# collector integration + gate-off contract
+# ---------------------------------------------------------------------------
+
+class TestCollectorIntegration:
+    def _collector(self, base, enabled):
+        from vtpu_manager.metrics.collector import NodeCollector
+        return NodeCollector("n1", [fake_chip(0)], base_dir=base,
+                             tc_path="/nonexistent",
+                             vmem_path="/nonexistent",
+                             utilization_enabled=enabled)
+
+    def test_gate_off_zero_new_series(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main")
+        w = _mk_ring(base, "uid-1", "main")
+        _write_busy(w, busy_s=1.0, window_s=10.0)
+        w.close()
+        text = self._collector(base, enabled=False).render()
+        assert "vtpu_utilization_" not in text
+        assert "vtpu_reclaimable_" not in text
+        assert 'feed="utilization"' not in text
+
+    def test_gate_on_series_and_feed_label(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main")
+        w = _mk_ring(base, "uid-1", "main")
+        _write_busy(w, busy_s=1.0, window_s=10.0)
+        w.close()
+        collector = self._collector(base, enabled=True)
+        text = collector.render()
+        assert "vtpu_utilization_allocated_core_percent{" in text
+        assert "vtpu_reclaimable_headroom_core_percent{" in text
+        assert 'vtpu_node_scrape_last_error{node="n1",' \
+               'feed="utilization"} 0.0' in text
+
+    def test_torn_fold_flags_feed_never_blocks_metrics(self, tmp_path):
+        """Chaos: util.fold error -> the scrape completes with every
+        other family intact and the utilization feed error raised."""
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main")
+        collector = self._collector(base, enabled=True)
+        failpoints.enable(seed=7)
+        try:
+            failpoints.arm("util.fold", "error")
+            text = collector.render()
+        finally:
+            failpoints.disable()
+        assert "vtpu_node_slots_total" in text           # scrape intact
+        assert 'vtpu_node_scrape_last_error{node="n1",' \
+               'feed="utilization"} 1.0' in text
+        # recovery: next scrape folds again and the flag clears
+        text = collector.render()
+        assert 'vtpu_node_scrape_last_error{node="n1",' \
+               'feed="utilization"} 0.0' in text
+
+
+# ---------------------------------------------------------------------------
+# publisher + rollup + chaos
+# ---------------------------------------------------------------------------
+
+def _registered_cluster(node_names=("node-a", "node-b"), chips=2):
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in node_names:
+        client.add_node({"metadata": {"name": name, "annotations": {}}})
+        mgr = DeviceManager(name, client,
+                            node_config=NodeConfig(device_split_count=4),
+                            backends=[FakeBackend(n_chips=chips)])
+        mgr.init_devices()
+        mgr.register_node()
+    return client
+
+
+class TestPublisherAndRollup:
+    def test_publisher_patches_annotation(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main", hard_core=80)
+        w = _mk_ring(base, "uid-1", "main")
+        client = _registered_cluster(("node-a",))
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        ledger.fold(now_mono=0.0)
+        _write_busy(w, busy_s=3.0, window_s=10.0)
+        ledger.fold(now_mono=10.0)
+        w.close()
+        pub = HeadroomPublisher(client, "node-a", ledger)
+        pub.publish_once()
+        raw = client.get_node("node-a")["metadata"]["annotations"][
+            consts.node_reclaimable_headroom_annotation()]
+        hr = parse_headroom(raw)
+        assert hr is not None
+        assert abs(hr.chips[0].reclaim_core_pct - 50.0) < 3.0
+
+    def test_rollup_document_and_cuts(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, POD_UID, "main", hard_core=80)
+        client = _registered_cluster()
+        # a claimed pod on node-b: quota row with no live data
+        client.add_pod({
+            "metadata": {"name": "p2", "namespace": "ml", "uid": "uid-2",
+                         "annotations": {
+                             consts.pre_allocated_annotation():
+                             'v1:{"main":[["TPU-FAKE-0000",0,40,1024]]}'}},
+            "spec": {"nodeName": "node-b"}, "status": {}})
+        ann = NodeHeadroom(chips={0: hr_mod.ChipHeadroom(80, 30, 50, 0)},
+                           ts=time.time()).encode()
+        client.patch_node_annotations(
+            "node-a",
+            {consts.node_reclaimable_headroom_annotation(): ann})
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        ledger.fold()
+        doc = ClusterRollup(ledger, client=client).collect()
+        assert doc["cluster"]["nodes"] == 2
+        assert doc["cluster"]["nodes_with_signal"] == 1
+        assert doc["cluster"]["reclaimable_core_pct"] == 50.0
+        row_a = next(r for r in doc["nodes"] if r["node"] == "node-a")
+        assert row_a["local"] and row_a["reclaim_core_pct"] == 50.0
+        assert row_a["chips"][0]["used_core_pct"] == 30.0
+        quota = [t for t in doc["tenants"] if t["pod_uid"] == "uid-2"]
+        assert quota and quota[0]["allocated_core_pct"] == 40
+        assert quota[0]["live"] is False
+        cut = filter_document(doc, node="node-b")
+        assert [r["node"] for r in cut["nodes"]] == ["node-b"]
+        assert all(t["node"] == "node-b" for t in cut["tenants"])
+        cut = filter_document(doc, pod="p2")
+        assert {t["pod_name"] for t in cut["tenants"]} == {"p2"}
+
+    def test_rollup_degrades_without_client_and_on_error(self, tmp_path):
+        ledger = UtilizationLedger("n1", [fake_chip(0)],
+                                   base_dir=str(tmp_path / "mgr"))
+        doc = ClusterRollup(ledger, client=None).collect()
+        assert doc["nodes"] == [] and doc["errors"] == []
+        assert doc["node"]["node"] == "n1"
+
+        class Broken:
+            def list_nodes(self):
+                raise RuntimeError("apiserver down")
+
+            def list_pods(self, *a, **k):
+                raise RuntimeError("apiserver down")
+
+        doc = ClusterRollup(ledger, client=Broken()).collect()
+        assert len(doc["errors"]) == 2
+        assert doc["node"]["node"] == "n1"    # local cut still served
+
+    def test_rollup_chaos_never_reaches_metrics(self, tmp_path):
+        """util.rollup error/latency hit /utilization only: the
+        collector's scrape never runs the rollup, so /metrics is
+        untouched while the route answers 503 (the monitor wraps
+        collect())."""
+        from vtpu_manager.client.kube import KubeError
+        from vtpu_manager.metrics.collector import NodeCollector
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main")
+        collector = NodeCollector("n1", [fake_chip(0)], base_dir=base,
+                                  tc_path="/nonexistent",
+                                  vmem_path="/nonexistent",
+                                  utilization_enabled=True)
+        rollup = ClusterRollup(collector.util_ledger,
+                               client=FakeKubeClient())
+        failpoints.enable(seed=3)
+        try:
+            failpoints.arm("util.rollup", "error")
+            with pytest.raises(KubeError):
+                rollup.collect()          # the route turns this into 503
+            t0 = time.perf_counter()
+            text = collector.render()     # /metrics path: no rollup call
+            scrape_s = time.perf_counter() - t0
+            assert "vtpu_utilization_allocated_core_percent{" in text
+            assert 'feed="utilization"} 0.0' in text
+            # latency injection on the rollup must not slow the scrape
+            failpoints.arm("util.rollup", "latency", latency_s=0.5)
+            t0 = time.perf_counter()
+            collector.render()
+            assert time.perf_counter() - t0 < 0.5 + scrape_s + 0.2
+        finally:
+            failpoints.disable()
+
+    def test_wedged_publisher_decays_on_scheduler_side(self):
+        """A rollup frozen at its last publish must read as no-signal
+        once the annotation ages out — on BOTH the parse path (TTL) and
+        the cached-entry path (snapshot, via score-input re-judging)."""
+        ts = time.time() - (hr_mod.MAX_HEADROOM_AGE_S + 5)
+        stale = NodeHeadroom(
+            chips={0: hr_mod.ChipHeadroom(80, 30, 50, 0)}, ts=ts)
+        assert parse_headroom(stale.encode()) is None
+        fresh_then_frozen = parse_headroom(stale.encode(), now=ts + 1)
+        assert fresh_then_frozen is not None
+        assert headroom_score_input(fresh_then_frozen) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# scheduler observe-only tap
+# ---------------------------------------------------------------------------
+
+def _vtpu_pod(uid=POD_UID, name="p1", cores=80):
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": {}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): 1024}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+class TestSchedulerObserveOnly:
+    def _annotated_cluster(self):
+        client = _registered_cluster()
+        ann = NodeHeadroom(chips={0: hr_mod.ChipHeadroom(80, 30, 50, 0)},
+                           ts=time.time()).encode()
+        client.patch_node_annotations(
+            "node-a",
+            {consts.node_reclaimable_headroom_annotation(): ann})
+        return client
+
+    def test_placement_parity_both_modes(self):
+        """The hint may never change placement: identical pods on
+        identical clusters place identically with the hint off/on, TTL
+        and snapshot paths, annotation present."""
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+        results = {}
+        for mode in ("ttl-off", "ttl-on", "snap-off", "snap-on"):
+            client = self._annotated_cluster()
+            snap = None
+            if mode.startswith("snap"):
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(
+                client, snapshot=snap,
+                utilization_hint=mode.endswith("-on"))
+            r = pred.filter({"Pod": _vtpu_pod()})
+            assert not r.error, (mode, r.error)
+            results[mode] = r.node_names
+        assert results["ttl-off"] == results["ttl-on"]
+        assert results["snap-off"] == results["snap-on"]
+        assert results["ttl-off"] == results["snap-off"]
+
+    def test_observed_counter_and_no_signal(self):
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        client = self._annotated_cluster()
+        pred = FilterPredicate(client, utilization_hint=True)
+        r = pred.filter({"Pod": _vtpu_pod()})
+        assert not r.error
+        # the chosen node may or may not be the annotated one; commit a
+        # second pod so both nodes get chosen across the two passes
+        r2 = pred.filter({"Pod": _vtpu_pod(uid="uid-2", name="p2")})
+        assert not r2.error
+        assert pred.headroom_observed >= 1
+        off = FilterPredicate(self._annotated_cluster())
+        off.filter({"Pod": _vtpu_pod()})
+        assert off.headroom_observed == 0
+
+    def test_trace_event_records_placement_headroom(self, tmp_path):
+        from vtpu_manager import trace
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.trace import assemble
+        from vtpu_manager.webhook.mutate import mutate_pod
+        spool = str(tmp_path / "spool")
+        trace.configure("test-sched", spool, sampling_rate=1.0)
+        client = self._annotated_cluster()
+        pod = _vtpu_pod()
+        result = mutate_pod(pod)
+        for patch in result.patches:
+            path = patch["path"]
+            if path == "/metadata/annotations":
+                continue
+            prefix = "/metadata/annotations/"
+            if path.startswith(prefix):
+                key = path[len(prefix):].replace("~1", "/")
+                pod["metadata"]["annotations"][key] = patch["value"]
+        client.add_pod(pod)
+        pred = FilterPredicate(client, utilization_hint=True)
+        r = pred.filter({"Pod": pod})
+        assert not r.error
+        trace.flush()
+        spans, _ = assemble.read_spools(spool)
+        events = [s for s in spans if s.stage == "scheduler.headroom"]
+        assert events, "observe-only tap must land in the trace"
+        ev = events[0]
+        assert ev.attrs["node"] == r.node_names[0]
+        assert "score_input" in ev.attrs
+
+    def test_metrics_counter_block_gated(self):
+        from vtpu_manager.scheduler.bind import BindPredicate
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.scheduler.preempt import PreemptPredicate
+        from vtpu_manager.scheduler.routes import SchedulerAPI
+        import asyncio
+
+        async def scrape(api):
+            resp = await api.handle_metrics(None)
+            return resp.text
+
+        for hint, want in ((True, True), (False, False)):
+            client = self._annotated_cluster()
+            pred = FilterPredicate(client, utilization_hint=hint)
+            pred.filter({"Pod": _vtpu_pod()})
+            api = SchedulerAPI(pred, BindPredicate(client),
+                               PreemptPredicate(client))
+            text = asyncio.run(scrape(api))
+            assert ("vtpu_scheduler_headroom_observed_total"
+                    in text) is want
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: 30% of an 80% allocation, end to end
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def _schedule_and_allocate(self, tmp_path, monkeypatch):
+        """mutate -> filter (hint on, traced) -> bind -> Allocate,
+        returning (client, base_dir, spool)."""
+        from vtpu_manager import trace
+        from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+        from vtpu_manager.deviceplugin.vnum import VnumPlugin, device_id
+        from vtpu_manager.device.claims import PodDeviceClaims
+        from vtpu_manager.scheduler.bind import BindPredicate
+        from vtpu_manager.scheduler.filter import FilterPredicate
+        from vtpu_manager.webhook.mutate import mutate_pod
+        spool = str(tmp_path / "spool")
+        trace.configure("e2e-util", spool, sampling_rate=1.0)
+        monkeypatch.setattr(consts, "TRACE_DIR",
+                            str(tmp_path / "node-trace"))
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-1",
+                                      "annotations": {}}})
+        mgr = DeviceManager(
+            "node-1", client,
+            node_config=NodeConfig(device_split_count=4),
+            backends=[FakeBackend(n_chips=1)])
+        chips = mgr.init_devices()
+        mgr.register_node()
+        pod = _vtpu_pod(cores=80)
+        result = mutate_pod(pod)
+        for patch in result.patches:
+            path = patch["path"]
+            if path == "/metadata/annotations":
+                continue
+            prefix = "/metadata/annotations/"
+            if path.startswith(prefix):
+                key = path[len(prefix):].replace("~1", "/")
+                pod["metadata"]["annotations"][key] = patch["value"]
+        client.add_pod(pod)
+        fresult = FilterPredicate(
+            client, utilization_hint=True).filter({"Pod": pod})
+        assert not fresult.error, fresult.error
+        assert not BindPredicate(client).bind(
+            {"PodNamespace": "default", "PodName": "p1",
+             "Node": fresult.node_names[0]}).error
+        base = str(tmp_path / "mgr")
+        plugin = VnumPlugin(mgr, client, "node-1", base_dir=base,
+                            node_config=NodeConfig())
+        plugin.step_telemetry_enabled = True
+        bound = client.get_pod("default", "p1")
+        pre = PodDeviceClaims.decode(
+            bound["metadata"]["annotations"][
+                consts.pre_allocated_annotation()])
+        plugin.allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=[
+                device_id(c.uuid, 0) for c in pre.containers["main"]])]))
+        return client, base, spool, chips
+
+    def test_thirty_of_eighty_visible_through_vtpu_smi(self, tmp_path,
+                                                       monkeypatch):
+        client, base, spool, chips = self._schedule_and_allocate(
+            tmp_path, monkeypatch)
+        # the tenant runs: 30% busy windows into the allocated ring
+        ring_path = os.path.join(base, f"{POD_UID}_main",
+                                 consts.TELEMETRY_SUBDIR,
+                                 consts.STEP_RING_NAME)
+        os.makedirs(os.path.dirname(ring_path), exist_ok=True)
+        w = stepring.StepRingWriter(ring_path, trace_id=POD_UID)
+        ledger = UtilizationLedger("node-1", chips, base_dir=base)
+        t = 0.0
+        ledger.fold(now_mono=t)
+        for _ in range(3):
+            _write_busy(w, busy_s=3.0, window_s=10.0)
+            t += 10.0
+            ledger.fold(now_mono=t)
+        w.close()
+
+        # ground truth: 30% of the 80% allocation -> ~50% reclaimable
+        cfg = vc.read_config(os.path.join(base, f"{POD_UID}_main",
+                                          "config", "vtpu.config"))
+        assert cfg.devices[0].hard_core == 80
+        chip_idx = cfg.devices[0].host_index
+        roll = ledger.chip_rollup()[chip_idx]
+        assert abs(roll["used_core_pct"] - 30.0) < 1.5
+        assert abs(roll["reclaim_core_pct"] - 50.0) < 2.5
+
+        # the metric, through the collector render
+        from vtpu_manager.metrics.collector import NodeCollector
+        collector = NodeCollector("node-1", chips, base_dir=base,
+                                  tc_path="/nonexistent",
+                                  vmem_path="/nonexistent",
+                                  utilization_enabled=True)
+        collector.util_ledger = ledger     # deterministic fold history
+        text = collector.render()
+        assert "vtpu_reclaimable_headroom_core_percent{" in text
+        line = next(l for l in text.splitlines()
+                    if l.startswith(
+                        "vtpu_reclaimable_headroom_core_percent{"))
+        assert abs(float(line.rsplit(" ", 1)[1]) - 50.0) < 2.5
+
+        # the annotation (publisher) + /utilization row + vtpu-smi
+        HeadroomPublisher(client, "node-1", ledger).publish_once()
+        doc = ClusterRollup(ledger, client=client).collect()
+        node_row = next(r for r in doc["nodes"]
+                        if r["node"] == "node-1")
+        assert abs(node_row["reclaim_core_pct"] - 50.0) < 2.5
+        ten = next(t for t in doc["tenants"] if t["pod_uid"] == POD_UID)
+        assert ten["allocated_core_pct"] == 80
+        assert abs(ten["used_core_pct"] - 30.0) < 1.5
+        assert ten["live"] is True
+
+        doc_path = str(tmp_path / "util.json")
+        with open(doc_path, "w") as f:
+            json.dump(doc, f)
+        smi = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "scripts/vtpu_smi.py"),
+             "--from-file", doc_path, "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert smi.returncode == 0, smi.stderr
+        out = json.loads(smi.stdout)
+        row = next(t for t in out["tenants"]
+                   if t["pod_uid"] == POD_UID)
+        assert abs(row["used_core_pct"] - 30.0) < 1.5
+        human = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "scripts/vtpu_smi.py"),
+             "--from-file", doc_path],
+            capture_output=True, text=True, timeout=60)
+        assert human.returncode == 0, human.stderr
+        assert "NODE node-1" in human.stdout
+        assert "reclaimable" in human.stdout
+
+        # writer dies: the whole chain decays to no-signal
+        late_wall = time.time() + STALENESS_S + 30
+        ledger.fold(now_mono=t + 300.0, now_wall=late_wall)
+        late_doc = ClusterRollup(ledger, client=client).collect(
+            now=late_wall)
+        assert late_doc["node"]["reclaimable_core_pct"] == 0.0
+        late_ten = next(t2 for t2 in late_doc["tenants"]
+                        if t2["pod_uid"] == POD_UID)
+        assert late_ten["confidence"] == 0.0
+        # the annotation published earlier also ages out
+        raw = client.get_node("node-1")["metadata"]["annotations"][
+            consts.node_reclaimable_headroom_annotation()]
+        assert parse_headroom(raw, now=late_wall) is None
+
+    def test_vtrace_pod_splices_utilization_rows(self, tmp_path,
+                                                 monkeypatch):
+        from vtpu_manager import trace
+        client, base, spool, chips = self._schedule_and_allocate(
+            tmp_path, monkeypatch)
+        ring_path = os.path.join(base, f"{POD_UID}_main",
+                                 consts.TELEMETRY_SUBDIR,
+                                 consts.STEP_RING_NAME)
+        os.makedirs(os.path.dirname(ring_path), exist_ok=True)
+        w = stepring.StepRingWriter(ring_path, trace_id=POD_UID)
+        # 10 steps of 100 ms with 25% throttle wait
+        for _ in range(10):
+            w.record(duration_ns=100_000_000,
+                     throttle_wait_ns=25_000_000,
+                     hbm_highwater_bytes=1 << 20)
+        w.close()
+        trace.flush()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts/vtrace.py"),
+             "--spool-dir", spool, "--steps-dir", base,
+             "--pod", POD_UID, "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["utilization"], "utilization splice missing"
+        row = doc["utilization"][0]
+        assert row["allocated_core_pct"] == 80.0
+        assert row["throttle_wait_frac"] == 0.25
+        # headroom-at-placement from the scheduler.headroom event
+        assert doc["placement_headroom"], \
+            "scheduler.headroom event must splice"
+        assert doc["placement_headroom"][0]["node"] == "node-1"
+        human = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts/vtrace.py"),
+             "--spool-dir", spool, "--steps-dir", base,
+             "--pod", POD_UID],
+            capture_output=True, text=True, timeout=60)
+        assert "utilization [main]:" in human.stdout
+        assert "headroom-at-placement" in human.stdout
+
+
+# ---------------------------------------------------------------------------
+# the live monitor: /utilization route + gate-off 404
+# ---------------------------------------------------------------------------
+
+class TestMonitorRoute:
+    @staticmethod
+    def _free_port():
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    @staticmethod
+    def _wait_healthy(port, proc, deadline_s=30):
+        import urllib.request
+        t0 = time.time()
+        while time.time() - t0 < deadline_s:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"monitor exited rc={proc.returncode}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=1) as r:
+                    if r.status == 200:
+                        return
+            except OSError:
+                time.sleep(0.2)
+        raise AssertionError("monitor never became healthy")
+
+    def _run_monitor(self, tmp_path, gate_on):
+        port = self._free_port()
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-1", "main", hard_core=80)
+        w = _mk_ring(base, "uid-1", "main")
+        _write_busy(w, busy_s=1.0, window_s=10.0)
+        w.close()
+        argv = [sys.executable,
+                os.path.join(REPO_ROOT, "cmd/device_monitor.py"),
+                "--port", str(port), "--host", "127.0.0.1",
+                "--node-name", "node-1", "--fake-chips", "1",
+                "--base-dir", base,
+                "--tc-path", str(tmp_path / "none.tc"),
+                "--vmem-path", str(tmp_path / "none.vmem"),
+                "--trace-spool-dir", str(tmp_path / "spool"),
+                "--fake-client"]
+        if gate_on:
+            argv += ["--feature-gates", "UtilizationLedger=true"]
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        return port, proc
+
+    def test_route_serves_and_smi_fetches(self, tmp_path):
+        import urllib.request
+        port, proc = self._run_monitor(tmp_path, gate_on=True)
+        try:
+            self._wait_healthy(port, proc)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/utilization",
+                    timeout=10) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["node"]["node"] == "node-1"
+            assert doc["node"]["tenants"], "ledger tenants missing"
+            # /metrics carries the new families too
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                metrics = r.read().decode()
+            assert "vtpu_utilization_allocated_core_percent{" in metrics
+            # the CLI against the live endpoint
+            smi = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO_ROOT, "scripts/vtpu_smi.py"),
+                 "--endpoint", f"http://127.0.0.1:{port}/utilization"],
+                capture_output=True, text=True, timeout=60)
+            assert smi.returncode == 0, smi.stderr + smi.stdout
+            assert "vtpu-smi" in smi.stdout
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_gate_off_no_route_no_series(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        port, proc = self._run_monitor(tmp_path, gate_on=False)
+        try:
+            self._wait_healthy(port, proc)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/utilization", timeout=10)
+            assert err.value.code == 404
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                metrics = r.read().decode()
+            assert "vtpu_utilization_" not in metrics
+            assert "vtpu_reclaimable_" not in metrics
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# gate-off: the plugin publishes nothing
+# ---------------------------------------------------------------------------
+
+class TestGateOffAnnotations:
+    def test_no_publisher_no_annotation(self):
+        """The publisher only exists behind the gate (device_plugin
+        wiring); here: a fresh cluster carries no headroom annotation
+        and the snapshot decodes None without cost."""
+        from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+        client = _registered_cluster(("node-a",))
+        anns = client.get_node("node-a")["metadata"]["annotations"]
+        assert consts.node_reclaimable_headroom_annotation() not in anns
+        snap = ClusterSnapshot(client)
+        snap.start()
+        assert snap.entry("node-a").headroom is None

@@ -1,0 +1,56 @@
+"""The one walk over the per-container config root.
+
+``<base>/<entry>/config[_<request>]/vtpu.config`` is the on-disk tenant
+protocol (entry = ``<pod_uid>_<container>`` for device-plugin tenants,
+``claim_<uid>`` for DRA): the metrics collector joins it with the
+vmem/tc feeds, and the vtuse utilization ledger joins it with the step
+rings THROUGH THE SAME owner token (fnv64 of ``pod_uid/label``) — so
+there must be exactly one implementation of the walk and the labeling,
+or the two joins silently desynchronize.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+
+def iter_container_configs(base_dir: str) -> Iterator[
+        tuple[str, str, object, bool, float]]:
+    """Yield ``(pod_uid_or_claim, container_label, config, is_dra,
+    config_mtime)`` per tenant partition. A claim-level "config" plus
+    one "config_<request>" per request of a multi-request DRA claim —
+    each is its own tenant partition (label ``<container>/<request>``)
+    and must be counted separately. ``is_dra`` flags tenants the
+    kubelet's device-plugin-era pod-resources API can never
+    corroborate; ``config_mtime`` is the tenant-age signal for the
+    collector's startup grace. Unreadable entries are skipped (a torn
+    config is the writer's crash window, not the reader's problem)."""
+    from vtpu_manager.config import vtpu_config as vc
+    if not os.path.isdir(base_dir):
+        return
+    for entry in sorted(os.listdir(base_dir)):
+        entry_dir = os.path.join(base_dir, entry)
+        if not os.path.isdir(entry_dir):
+            continue
+        try:
+            config_dirs = sorted(
+                d for d in os.listdir(entry_dir)
+                if d == "config" or d.startswith("config_"))
+        except OSError:
+            continue
+        pod_uid, _, container = entry.partition("_")
+        for config_name in config_dirs:
+            cfg_path = os.path.join(entry_dir, config_name,
+                                    "vtpu.config")
+            if not os.path.exists(cfg_path):
+                continue
+            suffix = config_name[len("config_"):] \
+                if config_name != "config" else ""
+            label = f"{container}/{suffix}" if suffix else container
+            is_dra = entry.startswith("claim_") or bool(suffix)
+            try:
+                yield (pod_uid, label, vc.read_config(cfg_path),
+                       is_dra, os.path.getmtime(cfg_path))
+            except (OSError, ValueError):
+                continue

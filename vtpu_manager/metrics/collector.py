@@ -71,7 +71,8 @@ class NodeCollector:
                  tc_path: str = consts.TC_UTIL_CONFIG,
                  vmem_path: str = consts.VMEM_NODE_CONFIG,
                  pod_resources_socket: str | None = None,
-                 kubelet_checkpoint: str | None = None):
+                 kubelet_checkpoint: str | None = None,
+                 utilization_enabled: bool = False):
         self.node_name = node_name
         self.chips = chips
         self.base_dir = base_dir
@@ -108,6 +109,20 @@ class NodeCollector:
         self._feed_errors: dict[str, float] = {
             "tc_util": 0.0, "vmem": 0.0, "telemetry": 0.0}
         self._last_scrape_s: float = 0.0
+        # vtuse (UtilizationLedger gate; off = no ledger object, no new
+        # series, no feed label — the gate-off contract): the scrape
+        # folds the per-tenant utilization ledger under a time budget so
+        # a node with hundreds of rings can never stall this path —
+        # budget overruns drop ring folds (counted) and resume next
+        # scrape round-robin
+        self.util_ledger = None
+        self.util_fold_budget_s = float(
+            os.environ.get("VTPU_UTIL_FOLD_BUDGET_S", "0.25"))
+        if utilization_enabled:
+            from vtpu_manager.utilization import UtilizationLedger
+            self.util_ledger = UtilizationLedger(
+                node_name, chips, base_dir=base_dir, tc_path=tc_path)
+            self._feed_errors["utilization"] = 0.0
 
     def _kubelet_view(self, force: bool = False
                       ) -> pod_resources.KubeletView:
@@ -125,43 +140,12 @@ class NodeCollector:
     def _container_configs(self) -> list[
             tuple[str, str, vc.VtpuConfig, bool, float]]:
         """(pod_uid_or_claim, container_label, config, is_dra,
-        config_mtime — the tenant-age signal for the startup grace). DRA
-        tenants come from `claim_<uid>` dirs (single-request) or
-        request-suffixed config dirs (multi-request) — flagged because the
-        kubelet's device-plugin-era pod-resources API can never
-        corroborate them (they flow through the DRA path)."""
-        out = []
-        if not os.path.isdir(self.base_dir):
-            return out
-        for entry in sorted(os.listdir(self.base_dir)):
-            entry_dir = os.path.join(self.base_dir, entry)
-            if not os.path.isdir(entry_dir):
-                continue
-            # claim-level "config" plus one "config_<request>" per request
-            # of a multi-request DRA claim — each is its own tenant
-            # partition and must be counted separately
-            try:
-                config_dirs = sorted(
-                    d for d in os.listdir(entry_dir)
-                    if d == "config" or d.startswith("config_"))
-            except OSError:
-                continue
-            pod_uid, _, container = entry.partition("_")
-            for config_name in config_dirs:
-                cfg_path = os.path.join(entry_dir, config_name,
-                                        "vtpu.config")
-                if not os.path.exists(cfg_path):
-                    continue
-                suffix = config_name[len("config_"):] \
-                    if config_name != "config" else ""
-                label = f"{container}/{suffix}" if suffix else container
-                is_dra = entry.startswith("claim_") or bool(suffix)
-                try:
-                    out.append((pod_uid, label, vc.read_config(cfg_path),
-                                is_dra, os.path.getmtime(cfg_path)))
-                except (OSError, ValueError):
-                    continue
-        return out
+        config_mtime — the tenant-age signal for the startup grace).
+        One shared walk (config/tenantdirs.py): the vtuse ledger joins
+        the same dirs through the same owner-token labeling, and the
+        two must never drift."""
+        from vtpu_manager.config.tenantdirs import iter_container_configs
+        return list(iter_container_configs(self.base_dir))
 
     def collect(self) -> list[Gauge]:
         gauges: list[Gauge] = []
@@ -535,6 +519,25 @@ class NodeCollector:
         text += render_node_metrics(
             os.path.join(self.base_dir, consts.COMPILE_CACHE_SUBDIR),
             self.node_name)
+        # vtuse: the budgeted ledger fold + the utilization/headroom
+        # series (gate on only — gate off has no ledger object and this
+        # block is one None check). A failed or torn fold flags the
+        # utilization feed error and keeps serving: the ledger's own
+        # confidence decay is what prevents stale claims, never a
+        # blocked scrape.
+        if self.util_ledger is not None:
+            self._feed_errors["utilization"] = 0.0
+            try:
+                if self.util_ledger.fold(
+                        budget_s=self.util_fold_budget_s):
+                    self._feed_errors["utilization"] = 1.0
+            except Exception:  # noqa: BLE001 — any fold failure
+                # (including an injected util.fold error) must cost the
+                # feed flag, never the scrape
+                self._feed_errors["utilization"] = 1.0
+                log.warning("utilization ledger fold failed",
+                            exc_info=True)
+            text += self.util_ledger.render()
         # self-observability: the scrape's own duration and per-feed
         # last-error flags, rendered last so a wedged feed still reports
         self._last_scrape_s = time.perf_counter() - t0

@@ -79,6 +79,13 @@ SITES: dict[str, str] = {
                    "is acquired and before the compile runs (crash = a "
                    "dead lease holder waiters must take over within the "
                    "stale-lease budget)",
+    "util.fold": "utilization/ledger.py fold entry (the scrape-time "
+                 "ledger fold; error = a torn fold the collector must "
+                 "flag without blocking /metrics, headroom decays to "
+                 "no-signal instead of serving stale claims)",
+    "util.rollup": "utilization/rollup.py ClusterRollup.collect entry "
+                   "(the monitor's /utilization fan-in; error/latency "
+                   "must never reach the /metrics path)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

@@ -57,6 +57,7 @@ from vtpu_manager.scheduler import snapshot as snap_mod
 from vtpu_manager.scheduler.lease import LeaseLostError
 from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
+from vtpu_manager.utilization import headroom as util_headroom
 
 log = logging.getLogger(__name__)
 
@@ -102,9 +103,20 @@ class FilterPredicate:
                  snapshot: "snap_mod.ClusterSnapshot | None" = None,
                  policy: RetryPolicy | None = None,
                  fence=None, shard_selector=None,
-                 anti_storm: bool = False):
+                 anti_storm: bool = False,
+                 utilization_hint: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtuse (UtilizationLedger gate; default off = zero extra work):
+        # OBSERVE-ONLY this PR — after a pass commits, the chosen node's
+        # reclaimable-headroom annotation is decoded and the score input
+        # it WOULD contribute is logged in the pod's trace span and
+        # counted on /metrics, so the elastic-quota PR can flip the real
+        # score term on against recorded evidence. Never touches the
+        # score: placement is byte-identical with the gate on or off.
+        # Rides filter_kwargs in the binary, so vtha shards inherit it.
+        self.utilization_hint = utilization_hint
+        self.headroom_observed = 0
         # vtcc (CompileCache gate; default off = byte-identical scores):
         # spread simultaneously-starting replicas of one program
         # fingerprint as a SOFT preference so one node warms the shared
@@ -603,7 +615,46 @@ class FilterPredicate:
             result.error = f"shard lease lost before commit: {e}"
             return result
         result.node_names = [best.name]
+        if self.utilization_hint:
+            self._observe_headroom(pod, best.name,
+                                   candidates if snap is None else None,
+                                   snap)
         return result
+
+    def _observe_headroom(self, pod: dict, node_name: str,
+                          candidates: list | None, snap) -> None:
+        """vtuse observe-only tap: record the reclaimable-headroom
+        signal the chosen node carried at placement time — the evidence
+        stream ("would this score term have changed anything?") the
+        quota-market PR validates against before flipping it on. A
+        failure here can cost the EVIDENCE, never the placement (the
+        pass already committed)."""
+        try:
+            hr = None
+            if snap is not None:
+                entry = snap.entry(node_name)
+                hr = entry.headroom if entry is not None else None
+            else:
+                for node in candidates or []:
+                    meta = node.get("metadata") or {}
+                    if meta.get("name") == node_name:
+                        hr = util_headroom.parse_headroom(
+                            (meta.get("annotations") or {}).get(
+                                consts.
+                                node_reclaimable_headroom_annotation()))
+                        break
+            score_input = util_headroom.headroom_score_input(hr)
+            if hr is not None:
+                self.headroom_observed += 1
+            trace.event(
+                trace.context_for_pod(pod), "scheduler.headroom",
+                node=node_name, signal=hr is not None,
+                score_input=round(score_input, 2),
+                reclaim_core_pct=round(hr.total_reclaim_core_pct(), 2)
+                if hr else 0.0)
+        except Exception:  # noqa: BLE001 — observability must never
+            # fail a committed pass
+            log.debug("headroom observe failed", exc_info=True)
 
     def _ttl_scored(self, req: AllocationRequest, candidates: list[dict],
                     by_node: dict, assumed_by_node: dict, spread: bool,

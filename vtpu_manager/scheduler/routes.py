@@ -141,6 +141,22 @@ class SchedulerAPI:
             # even TRYING to reach the apiserver, breaker_open in the
             # events block counts the rejected pumps
             breakers.extend(self.snapshot.breakers())
+        # vtuse observe-only tap (UtilizationLedger gate; the block is
+        # emitted only when some filter path is armed, so the gate-off
+        # scrape stays byte-identical): how many committed passes saw a
+        # live reclaimable-headroom signal on the chosen node — the
+        # coverage denominator for the quota-market PR's evidence
+        preds = [self.filter_pred]
+        if self.ha is not None:
+            preds = [u.filter_pred for u in self.ha.units]
+        armed = [p for p in preds
+                 if getattr(p, "utilization_hint", False)]
+        if armed:
+            lines.append(
+                "# TYPE vtpu_scheduler_headroom_observed_total counter")
+            lines.append(
+                f"vtpu_scheduler_headroom_observed_total "
+                f"{sum(p.headroom_observed for p in armed)}")
         # retry/breaker counters + failpoint fires (vtfault): how often
         # this process leaned on the resilience layer, and what the
         # FaultInjection gate injected (zero in production)

@@ -55,6 +55,7 @@ from vtpu_manager.resilience.policy import (CircuitBreaker,
 from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
 from vtpu_manager.util.gangname import resolve_gang_name
+from vtpu_manager.utilization import headroom as util_headroom
 
 log = logging.getLogger(__name__)
 
@@ -68,12 +69,12 @@ class NodeEntry:
 
     __slots__ = ("name", "node", "labels", "registry", "resident",
                  "counted", "conditional", "base_free", "rank_key",
-                 "generation", "pressure", "fp_recent")
+                 "generation", "pressure", "fp_recent", "headroom")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
                  base_free: tuple, rank_key: int, generation: int,
-                 pressure=None, fp_recent=()):
+                 pressure=None, fp_recent=(), headroom=None):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -83,6 +84,11 @@ class NodeEntry:
         self.conditional = conditional    # [(uid, claims, expiry_wall_s)]
         self.base_free = base_free        # free totals over `counted` only
         self.pressure = pressure          # vttel NodePressure | None
+        # vtuse reclaimable-headroom rollup (NodeHeadroom | None),
+        # decoded at event apply/relist like pressure; observe-only
+        # this PR (logged + counted, never scored) and staleness is
+        # re-judged at use time so a dead publisher decays
+        self.headroom = headroom
         # vtcc anti-storm: residents' (program_fingerprint, placed_ts)
         # pairs inside the storm window at build time; decay is
         # re-judged at penalty time (a quiet node emits no events)
@@ -238,6 +244,7 @@ class ClusterSnapshot:
         self._lock = threading.Lock()
         self._entries: dict[str, NodeEntry] = {}
         self._node_pressure: dict[str, object] = {}   # name -> NodePressure
+        self._node_headroom: dict[str, object] = {}   # name -> NodeHeadroom
         self._pods: dict[str, dict] = {}              # uid -> pod (ALL pods)
         self._pod_node: dict[str, str] = {}           # uid -> nodeName | ""
         self._pod_class: dict[str, tuple] = {}        # uid -> (claims, expiry)
@@ -480,6 +487,7 @@ class ClusterSnapshot:
                     del entries[name]
                     self._entries = entries
                     self._node_pressure.pop(name, None)
+                    self._node_headroom.pop(name, None)
                     self._publish_rank_locked(name, None)
                     self.generation += 1
             return
@@ -492,9 +500,12 @@ class ClusterSnapshot:
             anns.get(consts.node_device_register_annotation()))
         node_pressure = tel_pressure.parse_pressure(
             anns.get(consts.node_pressure_annotation()))
+        node_headroom = util_headroom.parse_headroom(
+            anns.get(consts.node_reclaimable_headroom_annotation()))
         labels = meta.get("labels") or {}
         with self._lock:
             self._node_pressure[name] = node_pressure
+            self._node_headroom[name] = node_headroom
             self.generation += 1
             entry = self._build_entry_locked(name, node, labels, registry)
             if name in self._entries:
@@ -643,7 +654,8 @@ class ClusterSnapshot:
                          self.generation,
                          pressure=self._node_pressure.get(name),
                          fp_recent=tuple(antistorm.recent_from_pods(
-                             resident.values(), time.time())))
+                             resident.values(), time.time())),
+                         headroom=self._node_headroom.get(name))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -698,6 +710,7 @@ class ClusterSnapshot:
             self._node_pod_uids = node_pod_uids
             self._all_pods_cache = None
             self._node_pressure = {}
+            self._node_headroom = {}
             entries: dict[str, NodeEntry] = {}
             for node in nodes:
                 meta = node.get("metadata") or {}
@@ -710,6 +723,8 @@ class ClusterSnapshot:
                     anns.get(consts.node_device_register_annotation()))
                 self._node_pressure[name] = tel_pressure.parse_pressure(
                     anns.get(consts.node_pressure_annotation()))
+                self._node_headroom[name] = util_headroom.parse_headroom(
+                    anns.get(consts.node_reclaimable_headroom_annotation()))
                 entries[name] = self._build_entry_locked(
                     name, node, meta.get("labels") or {}, registry)
             self._entries = entries
@@ -789,6 +804,6 @@ class ClusterSnapshot:
                 entry.name, entry.node, entry.labels, entry.registry,
                 entry.resident, entry.counted, live, entry.base_free,
                 rank_key, self.generation, pressure=entry.pressure,
-                fp_recent=entry.fp_recent)
+                fp_recent=entry.fp_recent, headroom=entry.headroom)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

@@ -250,6 +250,17 @@ def node_pressure_annotation() -> str:
     return _ann("node-pressure")
 
 
+def node_reclaimable_headroom_annotation() -> str:
+    """vtuse reclaimable-headroom rollup (same codec family as the
+    pressure annotation, utilization/headroom.py): per-chip
+    allocated/used/reclaimable core % and reclaimable HBM, EWMA-smoothed
+    and burstiness-discounted, published by the node daemon behind the
+    UtilizationLedger gate. This PR the scheduler only decodes it into an
+    observe-only score input (trace span + metric); the elastic-quota PR
+    flips it into a real score term against the recorded evidence."""
+    return _ann("node-reclaimable-headroom")
+
+
 # Allocation status values ---------------------------------------------------
 
 ALLOC_STATUS_SUCCEED = "succeed"

@@ -28,6 +28,7 @@ FAULT_INJECTION = "FaultInjection"      # vtfault failpoint registry
 STEP_TELEMETRY = "StepTelemetry"        # vttel per-tenant step rings
 SCHEDULER_HA = "SchedulerHA"            # vtha sharded active-active scheduler
 COMPILE_CACHE = "CompileCache"          # vtcc node-local compile cache
+UTILIZATION_LEDGER = "UtilizationLedger"  # vtuse per-tenant utilization ledger
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -74,6 +75,15 @@ _KNOWN = {
     # same-program gang cold start into ONE compile, and simultaneous
     # same-fingerprint starts spread across nodes as a soft preference.
     COMPILE_CACHE: False,
+    # Default off: zero new files/env/annotations/series and placement
+    # byte-identical in both scheduler modes. On, the node folds step
+    # rings + configs + the duty feed into a per-tenant utilization
+    # ledger (vtpu_manager/utilization/): reclaimable-headroom metrics
+    # and the node annotation the quota-market PR will consume, the
+    # monitor's /utilization cluster view, and the vtpu-smi CLI. The
+    # scheduler only OBSERVES the signal this PR (trace span + metric);
+    # placement is untouched.
+    UTILIZATION_LEDGER: False,
 }
 
 

@@ -1,0 +1,25 @@
+"""vtuse: per-tenant utilization ledger + reclaimable-headroom accounting.
+
+The measurement substrate for the elastic-quota market and HBM
+oversubscription (ROADMAP): ledger.py folds step rings + vtpu.config +
+the duty feed into per-tenant allocated-vs-used records with
+EWMA-smoothed, burstiness-discounted reclaimable headroom per chip;
+headroom.py is the parse-cheap node-annotation codec (the scheduler's
+observe-only score input this PR); rollup.py joins the node ledgers
+into the monitor's /utilization cluster view that scripts/vtpu_smi.py
+renders. Everything is behind the UtilizationLedger gate, default off
+= byte-identical.
+"""
+
+from vtpu_manager.utilization.headroom import (ChipHeadroom, NodeHeadroom,
+                                               headroom_score_input,
+                                               parse_headroom)
+from vtpu_manager.utilization.ledger import (HeadroomPublisher,
+                                             UtilizationLedger,
+                                             utilization_stats_for_pod)
+
+__all__ = [
+    "ChipHeadroom", "NodeHeadroom", "parse_headroom",
+    "headroom_score_input", "UtilizationLedger", "HeadroomPublisher",
+    "utilization_stats_for_pod",
+]

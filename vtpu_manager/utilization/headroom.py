@@ -1,0 +1,127 @@
+"""Reclaimable-headroom annotation: vtuse's feedback edge into the
+scheduler — same codec family as the vttel node-pressure annotation.
+
+The node daemon (device_plugin, behind the UtilizationLedger gate)
+publishes the ledger's per-chip rollup as a node annotation over the
+existing registry channel. Wire format is parse-cheap on purpose (the
+snapshot path decodes it per node event, the TTL path per candidate):
+
+    "<idx>:<alloc_core>:<used_core>:<reclaim_core>:<reclaim_hbm>;...@<ts>"
+
+one ``;``-separated segment per chip, core fields in percent of one
+chip, HBM in bytes, one wall-clock stamp for the whole rollup. The
+timestamp makes staleness explicit — a daemon that stops publishing
+must decay to "no signal", never pin its last claim forever (exactly
+the pressure-codec rule; a reclaimable-headroom claim that outlives its
+publisher is worse than no claim, because the quota market would lend
+against it).
+
+This PR the decoded signal is **observe-only**: both scheduler paths
+fold it into the candidate state, log the score input it WOULD
+contribute in the pod's trace span, and count it on /metrics — but
+``headroom_score_input`` never reaches the score. The elastic-quota PR
+flips it on against that recorded evidence.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+# staleness budget: the publisher cadence is seconds; a rollup older
+# than this reads as no-signal (same constant family as
+# telemetry/pressure.py — kept separate because the quota market may
+# want a TIGHTER bound here than the soft pressure penalty needs)
+MAX_HEADROOM_AGE_S = 120.0
+
+# a stamp slightly in the future is clock skew plus the encoder's
+# millisecond rounding, not a reason to distrust the rollup
+FUTURE_SKEW_TOLERANCE_S = 5.0
+
+
+@dataclass(frozen=True)
+class ChipHeadroom:
+    alloc_core_pct: float      # sum of assigned core % on the chip
+    used_core_pct: float       # EWMA of measured use (fresh tenants only)
+    reclaim_core_pct: float    # burstiness-discounted reclaimable core %
+    reclaim_hbm_bytes: int     # allocated-minus-high-water HBM
+
+
+@dataclass(frozen=True)
+class NodeHeadroom:
+    chips: dict[int, ChipHeadroom] = field(default_factory=dict)
+    ts: float = 0.0
+
+    def encode(self) -> str:
+        body = ";".join(
+            f"{idx}:{ch.alloc_core_pct:.1f}:{ch.used_core_pct:.1f}:"
+            f"{ch.reclaim_core_pct:.1f}:{ch.reclaim_hbm_bytes}"
+            for idx, ch in sorted(self.chips.items()))
+        return f"{body}@{self.ts:.3f}"
+
+    def total_reclaim_core_pct(self) -> float:
+        return sum(c.reclaim_core_pct for c in self.chips.values())
+
+
+def parse_headroom(raw: str | None, now: float | None = None,
+                   max_age_s: float = MAX_HEADROOM_AGE_S
+                   ) -> NodeHeadroom | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    every bad shape degrades to no-signal, never to a wrong claim."""
+    if not raw:
+        return None
+    body, sep, ts_raw = raw.rpartition("@")
+    if not sep:
+        return None
+    try:
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(ts):
+        return None
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+        return None
+    chips: dict[int, ChipHeadroom] = {}
+    for seg in body.split(";"):
+        if not seg:
+            continue
+        parts = seg.split(":")
+        if len(parts) != 5:
+            return None
+        try:
+            idx = int(parts[0])
+            alloc, used, reclaim = (float(parts[1]), float(parts[2]),
+                                    float(parts[3]))
+            hbm = int(parts[4])
+        except (TypeError, ValueError):
+            return None
+        if not all(math.isfinite(v) for v in (alloc, used, reclaim)):
+            # NaN parses but poisons every min/max downstream — the
+            # same garbage-means-no-signal rule as the pressure codec
+            return None
+        chips[idx] = ChipHeadroom(
+            alloc_core_pct=max(alloc, 0.0),
+            used_core_pct=max(used, 0.0),
+            reclaim_core_pct=min(max(reclaim, 0.0), 100.0 * 64),
+            reclaim_hbm_bytes=max(hbm, 0))
+    return NodeHeadroom(chips=chips, ts=ts)
+
+
+def headroom_score_input(hr: "NodeHeadroom | None",
+                         now: float | None = None) -> float:
+    """The score input the quota-market PR will add: total reclaimable
+    core % across the node's chips (more lendable quota = better home
+    for a burst-class pod). Staleness is re-judged HERE, not only at
+    parse time — the snapshot path caches the parsed value on the
+    NodeEntry and a dead publisher emits no further node events, so a
+    use-time check is what makes the signal decay (the pressure-penalty
+    rule). This PR the return value is logged and counted, never added
+    to a score."""
+    if hr is None:
+        return 0.0
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - hr.ts <= MAX_HEADROOM_AGE_S:
+        return 0.0
+    return hr.total_reclaim_core_pct()

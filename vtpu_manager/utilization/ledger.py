@@ -1,0 +1,641 @@
+"""vtuse per-node utilization ledger: allocated vs actually-used quota.
+
+The measurement substrate the elastic-quota market and the HBM
+oversubscription items consume (ROADMAP): today the raw signals exist —
+step rings carry per-step duration/throttle-wait/HBM-high-water
+(telemetry/stepring.py), the per-container vtpu.config carries the
+assignment, and the tc_util feed carries the watcher's measured duty
+share — but nothing folds them into an answer to "which chips are
+overcommitted on paper but idle in practice, and by how much".
+
+:class:`UtilizationLedger` is that fold, node-local. Per tenant
+(pod_uid, container) x chip it maintains a windowed record of
+
+    allocated_core_pct / used_core_pct / allocated_hbm /
+    hbm_highwater / throttle_wait_frac
+
+where ``used_core_pct`` prefers the tc_util watcher's measured duty
+share and falls back to the ring-derived busy fraction
+((duration - throttle_wait) / wall window), EWMA-smoothed with an EWMA
+variance alongside. **Reclaimable headroom** per chip is
+
+    sum over fresh tenants of
+        max(0, allocated - (used_ewma + K * sigma)) * confidence
+
+— the burstiness discount: a spiky tenant's effective use is its upper
+envelope, not its mean, so its quota is never reported as reclaimable
+just because it idles between bursts. HBM reclaim uses the lifetime
+high-water directly (the high-water IS the burst envelope).
+
+Staleness is explicit, the pressure-codec rule: every tenant carries a
+confidence in [0, 1] that decays linearly to 0 over the staleness
+budget after its last sample, and a no-signal tenant contributes ZERO
+reclaimable — a dead publisher must decay to "don't know", never keep
+serving its last claim (the quota market would lend against it).
+
+The fold is **time-boxed**: ``fold(budget_s=...)`` processes rings
+round-robin from where the previous fold stopped and charges rings it
+could not reach to a dropped-fold counter, so a node with hundreds of
+rings can never stall the monitor's scrape path — staleness accounting
+(not blocking) absorbs the lag.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import time
+
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.telemetry import stepring
+from vtpu_manager.util import consts
+from vtpu_manager.utilization.headroom import ChipHeadroom, NodeHeadroom
+
+log = logging.getLogger(__name__)
+
+# EWMA smoothing for the used-core samples; ~past 3-4 windows dominate
+EWMA_ALPHA = 0.3
+# burstiness discount: reclaimable is judged against mean + K * sigma
+BURST_SIGMA_K = 2.0
+# a tenant with no sample for this long reads as no-signal (confidence
+# 0); linear decay in between so one missed scrape doesn't zero it
+STALENESS_S = 120.0
+
+ALLOC_CORE = "vtpu_utilization_allocated_core_percent"
+USED_CORE = "vtpu_utilization_used_core_percent"
+ALLOC_HBM = "vtpu_utilization_allocated_hbm_bytes"
+HBM_HW = "vtpu_utilization_hbm_highwater_bytes"
+WAIT_FRAC = "vtpu_utilization_throttle_wait_fraction"
+CONFIDENCE = "vtpu_utilization_confidence"
+RECLAIM_CORE = "vtpu_reclaimable_headroom_core_percent"
+RECLAIM_HBM = "vtpu_reclaimable_headroom_hbm_bytes"
+RECLAIM_CONF = "vtpu_reclaimable_headroom_confidence"
+FOLDS_DROPPED = "vtpu_utilization_folds_dropped_total"
+FOLD_SECONDS = "vtpu_utilization_fold_seconds"
+
+
+class _TenantChip:
+    """EWMA state for one (pod_uid, container) x chip partition."""
+
+    __slots__ = ("pod_uid", "container", "pod_name", "pod_namespace",
+                 "trace_id", "host_index", "uuid", "alloc_core_pct",
+                 "alloc_hbm", "used_ewma", "used_var", "wait_frac",
+                 "hbm_highwater", "last_sample_wall", "samples")
+
+    def __init__(self, pod_uid: str, container: str, host_index: int,
+                 uuid: str):
+        self.pod_uid = pod_uid
+        self.container = container
+        self.pod_name = ""
+        self.pod_namespace = ""
+        self.trace_id = ""
+        self.host_index = host_index
+        self.uuid = uuid
+        self.alloc_core_pct = 0.0
+        self.alloc_hbm = 0
+        self.used_ewma = 0.0
+        self.used_var = 0.0
+        self.wait_frac = 0.0
+        self.hbm_highwater = 0
+        self.last_sample_wall = 0.0
+        self.samples = 0
+
+    def observe_used(self, used_pct: float, now_wall: float) -> None:
+        used_pct = min(max(used_pct, 0.0), 100.0)
+        if self.samples == 0:
+            # seed with the first sample: starting the EWMA at 0 would
+            # report a steady tenant as reclaimable for the warm-up
+            # windows — exactly the wrong failure mode for a signal
+            # quota lending trusts
+            self.used_ewma = used_pct
+            self.used_var = 0.0
+        else:
+            delta = used_pct - self.used_ewma
+            self.used_ewma += EWMA_ALPHA * delta
+            self.used_var = ((1.0 - EWMA_ALPHA) * self.used_var
+                             + EWMA_ALPHA * delta * delta)
+        self.samples += 1
+        self.last_sample_wall = now_wall
+
+    def confidence(self, now_wall: float) -> float:
+        """1 fresh -> 0 no-signal, linear over the staleness budget.
+        Never-sampled tenants are 0 by construction (allocated-but-
+        never-observed quota is unknown, not reclaimable)."""
+        if not self.samples or not self.last_sample_wall:
+            return 0.0
+        age = now_wall - self.last_sample_wall
+        if age < 0:
+            return 1.0      # clock step backwards: fresh, not garbage
+        return max(0.0, 1.0 - age / STALENESS_S)
+
+    def reclaim_core_pct(self, now_wall: float) -> float:
+        conf = self.confidence(now_wall)
+        if conf <= 0.0:
+            return 0.0
+        envelope = self.used_ewma + BURST_SIGMA_K * math.sqrt(
+            max(self.used_var, 0.0))
+        return max(0.0, self.alloc_core_pct - envelope) * conf
+
+    def reclaim_hbm_bytes(self, now_wall: float) -> int:
+        conf = self.confidence(now_wall)
+        if conf <= 0.0:
+            return 0
+        return int(max(0, self.alloc_hbm - self.hbm_highwater) * conf)
+
+    def to_wire(self, now_wall: float) -> dict:
+        return {
+            "pod_uid": self.pod_uid,
+            "container": self.container,
+            "pod_name": self.pod_name,
+            "pod_namespace": self.pod_namespace,
+            "trace_id": self.trace_id,
+            "chip_index": self.host_index,
+            "chip_uuid": self.uuid,
+            "allocated_core_pct": round(self.alloc_core_pct, 2),
+            "used_core_pct": round(self.used_ewma, 2),
+            "allocated_hbm_bytes": self.alloc_hbm,
+            "hbm_highwater_bytes": self.hbm_highwater,
+            "throttle_wait_frac": round(self.wait_frac, 4),
+            "reclaimable_core_pct": round(
+                self.reclaim_core_pct(now_wall), 2),
+            "reclaimable_hbm_bytes": self.reclaim_hbm_bytes(now_wall),
+            "confidence": round(self.confidence(now_wall), 3),
+            "stale": self.confidence(now_wall) <= 0.0,
+        }
+
+
+class _RingCursor:
+    __slots__ = ("cursor", "last_poll_monotonic")
+
+    def __init__(self) -> None:
+        self.cursor = 0
+        # None = never polled (the priming state) — NOT 0.0, which is a
+        # legitimate monotonic stamp under an injected test clock
+        self.last_poll_monotonic: float | None = None
+
+
+class UtilizationLedger:
+    """Per-node accountant folding rings + configs + the duty feed."""
+
+    def __init__(self, node_name: str, chips: list,
+                 base_dir: str = consts.MANAGER_BASE_DIR,
+                 tc_path: str | None = None):
+        self.node_name = node_name
+        self.chips = list(chips)
+        self.base_dir = base_dir
+        self.tc_path = tc_path
+        self._states: dict[tuple[str, str, int], _TenantChip] = {}
+        self._cursors: dict[tuple[str, str], _RingCursor] = {}
+        # ring-fold round-robin resume point (budget continuation)
+        self._resume = 0
+        self.folds_dropped_total = 0
+        self.last_fold_s = 0.0
+        self.last_fold_wall = 0.0
+
+    # -- discovery (same dir shapes as the collector's config join) ---------
+
+    def _configs(self) -> list[tuple[str, str, object]]:
+        """(pod_uid, container_label, VtpuConfig) — device-plugin AND
+        DRA tenants via the ONE shared walk (config/tenantdirs.py):
+        the owner-token join with the duty feed only matches the
+        collector's if both produce identical labels."""
+        from vtpu_manager.config.tenantdirs import iter_container_configs
+        return [(pod_uid, label, cfg)
+                for pod_uid, label, cfg, _is_dra, _mtime
+                in iter_container_configs(self.base_dir)]
+
+    def _tc_util_by_token(self) -> dict[tuple[int, int], int]:
+        """(owner_token, chip_index) -> measured duty % from the node
+        watcher feed; empty when the feed is absent (normal on nodes
+        without TCWatcher) or unreadable (the tenant falls back to the
+        ring-derived busy fraction)."""
+        out: dict[tuple[int, int], int] = {}
+        if not self.tc_path:
+            return out
+        try:
+            from vtpu_manager.config.tc_watcher import TcUtilFile
+            tc = TcUtilFile(self.tc_path)
+            try:
+                for chip in self.chips:
+                    rec = tc.read_device(chip.index)
+                    if rec is None:
+                        continue
+                    for proc in rec.procs:
+                        key = (proc.owner_token, chip.index)
+                        out[key] = out.get(key, 0) + proc.util
+            finally:
+                tc.close()
+        except (OSError, ValueError):
+            return {}
+        return out
+
+    # -- the fold ------------------------------------------------------------
+
+    def fold(self, budget_s: float | None = None,
+             now_mono: float | None = None,
+             now_wall: float | None = None) -> int:
+        """One accounting pass: re-read configs (allocation truth),
+        tail each tenant's ring for a window sample, fold the duty feed.
+        Returns how many EXISTING rings could not be read (the feed's
+        last-scrape-error signal). Budget overruns drop ring folds (the
+        counter) and resume round-robin next pass — never block."""
+        failpoints.fire("util.fold", node=self.node_name)
+        t0 = time.perf_counter()
+        now_mono = time.monotonic() if now_mono is None else now_mono
+        now_wall = time.time() if now_wall is None else now_wall
+        failed = 0
+
+        configs = self._configs()
+        live_keys: set[tuple[str, str, int]] = set()
+        ring_keys: list[tuple[str, str]] = []
+        seen_rings: set[tuple[str, str]] = set()
+        from vtpu_manager.config.vmem import fnv64
+        token_of: dict[tuple[str, str], int] = {}
+        devices_of: dict[tuple[str, str], list] = {}
+        for pod_uid, container, cfg in configs:
+            tkey = (pod_uid, container)
+            token_of[tkey] = fnv64(f"{pod_uid}/{container}")
+            devices_of.setdefault(tkey, []).extend(cfg.devices)
+            if tkey not in seen_rings:
+                seen_rings.add(tkey)
+                ring_keys.append(tkey)
+            for dev in cfg.devices:
+                key = (pod_uid, container, dev.host_index)
+                live_keys.add(key)
+                state = self._states.get(key)
+                if state is None:
+                    state = self._states[key] = _TenantChip(
+                        pod_uid, container, dev.host_index, dev.uuid)
+                state.pod_name = cfg.pod_name
+                state.pod_namespace = cfg.pod_namespace
+                state.alloc_core_pct = float(dev.hard_core)
+                state.alloc_hbm = int(dev.total_memory)
+        # a removed tenant's rows go with it (same lifecycle as the
+        # per-container limit gauges — the reaper owns stale dirs)
+        for key in list(self._states):
+            if key not in live_keys:
+                del self._states[key]
+        for tkey in list(self._cursors):
+            if tkey not in seen_rings:
+                del self._cursors[tkey]
+
+        tc_util = self._tc_util_by_token()
+
+        # ring folds, round-robin from the previous budget stop so every
+        # ring is eventually reached even when each pass only affords a
+        # few — budget exhaustion charges the REMAINDER to the dropped
+        # counter rather than stalling the scrape. The budget is checked
+        # AFTER each ring (progress floor: at least one ring folds per
+        # pass even when the config walk already ate the budget, so a
+        # pathological budget degrades to one-ring-per-scrape, never to
+        # a ledger frozen at zero)
+        n = len(ring_keys)
+        folded = 0
+        for i in range(n):
+            tkey = ring_keys[(self._resume + i) % n]
+            folded += 1
+            failed += self._fold_ring(tkey, token_of[tkey],
+                                      devices_of[tkey], tc_util,
+                                      now_mono, now_wall)
+            if budget_s is not None and folded < n and \
+                    time.perf_counter() - t0 > budget_s:
+                self.folds_dropped_total += n - folded
+                self._resume = (self._resume + folded) % max(n, 1)
+                break
+        else:
+            self._resume = 0
+        self.last_fold_s = time.perf_counter() - t0
+        self.last_fold_wall = now_wall
+        return failed
+
+    def _fold_ring(self, tkey: tuple[str, str], token: int,
+                   devices: list, tc_util: dict,
+                   now_mono: float, now_wall: float) -> int:
+        pod_uid, container = tkey
+        entry = f"{pod_uid}_{container.split('/', 1)[0]}"
+        ring_path = os.path.join(self.base_dir, entry,
+                                 consts.TELEMETRY_SUBDIR,
+                                 consts.STEP_RING_NAME)
+        cur = self._cursors.get(tkey)
+        if cur is None:
+            cur = self._cursors[tkey] = _RingCursor()
+        total_alloc = sum(float(d.hard_core) for d in devices) or 1.0
+        total_hbm = sum(int(d.total_memory) for d in devices) or 1
+
+        records: list[stepring.StepRecord] = []
+        trace_id = ""
+        have_ring = os.path.isfile(ring_path)
+        if have_ring:
+            try:
+                reader = stepring.StepRingReader(ring_path)
+            except (OSError, ValueError) as e:
+                log.warning("utilization: ring %s unreadable: %s",
+                            ring_path, e)
+                return 1
+            try:
+                trace_id = reader.trace_id
+                records, cursor, _ = reader.poll(cur.cursor)
+                cur.cursor = cursor
+            finally:
+                reader.close()
+
+        window_s = (now_mono - cur.last_poll_monotonic
+                    if cur.last_poll_monotonic is not None else 0.0)
+        dur_sum = sum(r.duration_ns for r in records) / 1e9
+        wait_sum = sum(r.throttle_wait_ns for r in records) / 1e9
+        hbm_hw = max((r.hbm_highwater_bytes for r in records), default=0)
+        busy_frac = 0.0
+        if window_s > 0:
+            busy_frac = max(0.0, dur_sum - wait_sum) / window_s
+        wait_frac = wait_sum / dur_sum if dur_sum else 0.0
+
+        for dev in devices:
+            key = (pod_uid, container, dev.host_index)
+            state = self._states.get(key)
+            if state is None:
+                continue
+            state.trace_id = trace_id or state.trace_id
+            # measured duty share from the watcher feed wins (it is the
+            # chip's own accounting); the ring-derived busy fraction is
+            # the fallback, apportioned across the tenant's devices by
+            # allocated-core share (the ring is per tenant, not per chip)
+            tc_sample = tc_util.get((token, dev.host_index))
+            if tc_sample is not None:
+                state.observe_used(float(tc_sample), now_wall)
+            elif records and window_s > 0:
+                share = float(dev.hard_core) / total_alloc
+                state.observe_used(100.0 * busy_frac * share, now_wall)
+            # an existing ring with no new records in the window is NOT
+            # a sample: freshness keeps decaying toward no-signal (a
+            # dead writer must never look "steadily idle = reclaimable")
+            if records:
+                state.wait_frac = wait_frac
+                hbm_share = int(dev.total_memory) / total_hbm
+                state.hbm_highwater = max(
+                    state.hbm_highwater, int(hbm_hw * hbm_share))
+        cur.last_poll_monotonic = now_mono
+        return 0
+
+    # -- outputs -------------------------------------------------------------
+
+    def tenants(self) -> list[_TenantChip]:
+        return sorted(self._states.values(),
+                      key=lambda s: (s.pod_uid, s.container, s.host_index))
+
+    def chip_rollup(self, now_wall: float | None = None
+                    ) -> dict[int, dict]:
+        """Per-chip aggregation across tenants: the headroom rollup."""
+        now_wall = time.time() if now_wall is None else now_wall
+        out: dict[int, dict] = {}
+        for chip in self.chips:
+            out[chip.index] = {
+                "index": chip.index, "uuid": chip.uuid,
+                "alloc_core_pct": 0.0, "used_core_pct": 0.0,
+                "alloc_hbm_bytes": 0, "hbm_highwater_bytes": 0,
+                "reclaim_core_pct": 0.0, "reclaim_hbm_bytes": 0,
+                "confidence": 1.0, "tenants": 0,
+            }
+        for s in self._states.values():
+            row = out.get(s.host_index)
+            if row is None:
+                continue        # stale config naming a removed chip
+            conf = s.confidence(now_wall)
+            row["alloc_core_pct"] += s.alloc_core_pct
+            row["used_core_pct"] += s.used_ewma * conf
+            row["alloc_hbm_bytes"] += s.alloc_hbm
+            row["hbm_highwater_bytes"] += s.hbm_highwater
+            row["reclaim_core_pct"] += s.reclaim_core_pct(now_wall)
+            row["reclaim_hbm_bytes"] += s.reclaim_hbm_bytes(now_wall)
+            row["confidence"] = min(row["confidence"], conf)
+            row["tenants"] += 1
+        for row in out.values():
+            row["used_core_pct"] = round(row["used_core_pct"], 2)
+            row["reclaim_core_pct"] = round(row["reclaim_core_pct"], 2)
+            row["alloc_core_pct"] = round(row["alloc_core_pct"], 2)
+            row["confidence"] = round(row["confidence"], 3)
+        return out
+
+    def headroom(self, now_wall: float | None = None) -> NodeHeadroom:
+        """The annotation payload (utilization/headroom.py codec)."""
+        now_wall = time.time() if now_wall is None else now_wall
+        chips = {}
+        for idx, row in self.chip_rollup(now_wall).items():
+            chips[idx] = ChipHeadroom(
+                alloc_core_pct=row["alloc_core_pct"],
+                used_core_pct=row["used_core_pct"],
+                reclaim_core_pct=row["reclaim_core_pct"],
+                reclaim_hbm_bytes=row["reclaim_hbm_bytes"])
+        return NodeHeadroom(chips=chips, ts=now_wall)
+
+    def to_wire(self, now_wall: float | None = None) -> dict:
+        now_wall = time.time() if now_wall is None else now_wall
+        chips = list(self.chip_rollup(now_wall).values())
+        return {
+            "node": self.node_name,
+            "chips": chips,
+            "tenants": [s.to_wire(now_wall) for s in self.tenants()],
+            "reclaimable_core_pct": round(
+                sum(c["reclaim_core_pct"] for c in chips), 2),
+            "reclaimable_hbm_bytes": sum(
+                c["reclaim_hbm_bytes"] for c in chips),
+            "folds_dropped_total": self.folds_dropped_total,
+            "last_fold_s": round(self.last_fold_s, 6),
+        }
+
+    def render(self, now_wall: float | None = None) -> str:
+        """Prometheus text for the monitor's /metrics (gate on only)."""
+        now_wall = time.time() if now_wall is None else now_wall
+        node = self.node_name
+        lines = [
+            f"# HELP {ALLOC_CORE} Assigned core percent "
+            f"(vtuse ledger view)",
+            f"# TYPE {ALLOC_CORE} gauge",
+        ]
+        tenants = self.tenants()
+
+        def tlabels(s: _TenantChip) -> str:
+            return (f'node="{node}",pod_uid="{s.pod_uid}",'
+                    f'container="{s.container}",uuid="{s.uuid}"')
+
+        for s in tenants:
+            lines.append(f"{ALLOC_CORE}{{{tlabels(s)}}} "
+                         f"{s.alloc_core_pct:g}")
+        lines += [f"# HELP {USED_CORE} EWMA of the tenant's measured "
+                  f"core use on the chip",
+                  f"# TYPE {USED_CORE} gauge"]
+        for s in tenants:
+            lines.append(f"{USED_CORE}{{{tlabels(s)}}} "
+                         f"{round(s.used_ewma, 2):g}")
+        lines += [f"# HELP {ALLOC_HBM} Assigned HBM cap "
+                  f"(vtuse ledger view)",
+                  f"# TYPE {ALLOC_HBM} gauge"]
+        for s in tenants:
+            lines.append(f"{ALLOC_HBM}{{{tlabels(s)}}} {s.alloc_hbm}")
+        lines += [f"# HELP {HBM_HW} Step-ring HBM high-water attributed "
+                  f"to the tenant's share of the chip",
+                  f"# TYPE {HBM_HW} gauge"]
+        for s in tenants:
+            lines.append(f"{HBM_HW}{{{tlabels(s)}}} {s.hbm_highwater}")
+        lines += [f"# HELP {WAIT_FRAC} Fraction of step time stalled in "
+                  f"the throttle over the last fold window",
+                  f"# TYPE {WAIT_FRAC} gauge"]
+        for s in tenants:
+            lines.append(f"{WAIT_FRAC}{{{tlabels(s)}}} "
+                         f"{round(s.wait_frac, 4):g}")
+        lines += [f"# HELP {CONFIDENCE} Sample freshness in [0,1]; 0 = "
+                  f"no-signal (dead writer decayed out)",
+                  f"# TYPE {CONFIDENCE} gauge"]
+        for s in tenants:
+            lines.append(f"{CONFIDENCE}{{{tlabels(s)}}} "
+                         f"{round(s.confidence(now_wall), 3):g}")
+
+        rollup = self.chip_rollup(now_wall)
+        lines += [f"# HELP {RECLAIM_CORE} Allocated-but-unused core % "
+                  f"per chip, EWMA + burstiness discounted",
+                  f"# TYPE {RECLAIM_CORE} gauge"]
+        for idx in sorted(rollup):
+            row = rollup[idx]
+            lines.append(f'{RECLAIM_CORE}{{node="{node}",'
+                         f'uuid="{row["uuid"]}",index="{idx}"}} '
+                         f'{row["reclaim_core_pct"]:g}')
+        lines += [f"# HELP {RECLAIM_HBM} Allocated-minus-high-water HBM "
+                  f"per chip, confidence discounted",
+                  f"# TYPE {RECLAIM_HBM} gauge"]
+        for idx in sorted(rollup):
+            row = rollup[idx]
+            lines.append(f'{RECLAIM_HBM}{{node="{node}",'
+                         f'uuid="{row["uuid"]}",index="{idx}"}} '
+                         f'{row["reclaim_hbm_bytes"]}')
+        lines += [f"# HELP {RECLAIM_CONF} Min tenant confidence feeding "
+                  f"the chip's reclaim figures (0 = no-signal)",
+                  f"# TYPE {RECLAIM_CONF} gauge"]
+        for idx in sorted(rollup):
+            row = rollup[idx]
+            conf = row["confidence"] if row["tenants"] else 0.0
+            lines.append(f'{RECLAIM_CONF}{{node="{node}",'
+                         f'uuid="{row["uuid"]}",index="{idx}"}} '
+                         f'{conf:g}')
+        lines += [f"# HELP {FOLDS_DROPPED} Ring folds skipped because "
+                  f"the scrape-time budget ran out (resumed next pass)",
+                  f"# TYPE {FOLDS_DROPPED} counter",
+                  f'{FOLDS_DROPPED}{{node="{node}"}} '
+                  f"{self.folds_dropped_total}",
+                  f"# HELP {FOLD_SECONDS} Wall time of the last ledger "
+                  f"fold",
+                  f"# TYPE {FOLD_SECONDS} gauge",
+                  f'{FOLD_SECONDS}{{node="{node}"}} '
+                  f"{round(self.last_fold_s, 6):g}"]
+        return "\n".join(lines) + "\n"
+
+
+class HeadroomPublisher:
+    """Daemon-side loop: fold the ledger, patch the node annotation.
+
+    Runs in the device-plugin daemon (the node-annotation owner) behind
+    the UtilizationLedger gate — the same shape as vttel's
+    PressurePublisher. Failures are tolerated per tick; the codec's own
+    timestamp ages a silent publisher out on the scheduler side."""
+
+    def __init__(self, client, node_name: str, ledger: UtilizationLedger,
+                 policy=None, interval_s: float = 15.0):
+        import threading
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.ledger = ledger
+        self.policy = policy or RetryPolicy(max_attempts=3, deadline_s=10.0)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = None
+
+    def publish_once(self) -> NodeHeadroom:
+        self.ledger.fold()
+        hr = self.ledger.headroom()
+        self.policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_reclaimable_headroom_annotation():
+                 hr.encode()}),
+            op="utilization.headroom_patch")
+        return hr
+
+    def start(self) -> None:
+        import threading
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.publish_once()
+                except Exception:  # noqa: BLE001 — advisory signal; the
+                    # annotation timestamp ages a silent failure out
+                    log.warning("headroom publish failed", exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtuse-headroom")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def utilization_stats_for_pod(base_dir: str, *keys: str) -> list[dict]:
+    """One pod's used-vs-allocated rows straight off its ring + config —
+    the ``vtrace --pod`` splice. ``keys`` may match the config-dir pod
+    uid or the ring's trace id (the same join contract as
+    telemetry.step_stats_for_pod). Offline one-shot, so the window is
+    the resident records' own span (first start to last end), not a
+    poll interval: a CLI invocation has no second poll to wait for."""
+    from vtpu_manager.config import vtpu_config as vc
+    wanted = {k for k in keys if k}
+    out: list[dict] = []
+    if not wanted or not os.path.isdir(base_dir):
+        return out
+    for entry in sorted(os.listdir(base_dir)):
+        ring_path = os.path.join(base_dir, entry,
+                                 consts.TELEMETRY_SUBDIR,
+                                 consts.STEP_RING_NAME)
+        if not os.path.isfile(ring_path):
+            continue
+        pod_uid, _, container = entry.partition("_")
+        try:
+            reader = stepring.StepRingReader(ring_path)
+        except (OSError, ValueError):
+            continue
+        try:
+            if not (wanted & {pod_uid, reader.trace_id}):
+                continue
+            records, _, _ = reader.poll(0)
+            trace_id = reader.trace_id
+        finally:
+            reader.close()
+        alloc_core = 0.0
+        alloc_hbm = 0
+        cfg_path = os.path.join(base_dir, entry, "config", "vtpu.config")
+        try:
+            cfg = vc.read_config(cfg_path)
+            alloc_core = float(sum(d.hard_core for d in cfg.devices))
+            alloc_hbm = int(sum(d.total_memory for d in cfg.devices))
+        except (OSError, ValueError):
+            pass
+        dur_sum = sum(r.duration_ns for r in records) / 1e9
+        wait_sum = sum(r.throttle_wait_ns for r in records) / 1e9
+        span_s = 0.0
+        if records:
+            first = min(r.start_mono_ns for r in records)
+            last = max(r.start_mono_ns + r.duration_ns for r in records)
+            span_s = max((last - first) / 1e9, 1e-9)
+        used_pct = 100.0 * max(0.0, dur_sum - wait_sum) / span_s \
+            if span_s else 0.0
+        out.append({
+            "pod_uid": pod_uid,
+            "container": container,
+            "trace_id": trace_id,
+            "allocated_core_pct": alloc_core,
+            "used_core_pct": round(min(used_pct, 100.0), 2),
+            "allocated_hbm_bytes": alloc_hbm,
+            "hbm_highwater_bytes": max(
+                (r.hbm_highwater_bytes for r in records), default=0),
+            "throttle_wait_frac": round(
+                wait_sum / dur_sum, 4) if dur_sum else 0.0,
+        })
+    return out

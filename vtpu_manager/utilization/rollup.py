@@ -1,0 +1,255 @@
+"""vtuse cluster rollup: the node ledgers joined into one cluster view.
+
+Served by ``cmd/device_monitor.py`` as ``/utilization`` (auth-gated
+JSON) behind the UtilizationLedger gate. The node -> cluster fan-in is
+pulled over the **existing registry channel** — node annotations the
+device plugin already publishes (device registry, pressure, and the new
+reclaimable-headroom rollup) plus the pod claim annotations the
+scheduler already writes — rather than a new protocol: one apiserver
+LIST answers "which chips are overcommitted on paper but idle in
+practice" for the whole cluster, with no per-node scrape joins.
+
+Per-tenant **live** use (used %, throttle-wait, high-water) is
+node-local truth: it rides this monitor's own ledger for tenants
+resident on this node; remote tenants carry their quota rows (decoded
+from claim annotations) and their chips' rollup, and ``vtpu-smi``
+pointed at a node's monitor shows that node's tenants live. Degrades
+explicitly: no kube client -> node-local cut only, apiserver errors ->
+the local cut plus an ``errors`` list, never a blocked scrape.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.claims import PodDeviceClaims
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.telemetry import pressure as tel_pressure
+from vtpu_manager.util import consts
+from vtpu_manager.utilization import headroom as hr_mod
+from vtpu_manager.utilization.ledger import UtilizationLedger
+
+log = logging.getLogger(__name__)
+
+
+class ClusterRollup:
+    """Fold node annotations + pod claims + the local ledger into the
+    /utilization document."""
+
+    def __init__(self, ledger: UtilizationLedger, client=None,
+                 cache_root: str | None = None,
+                 fold_budget_s: float | None = None):
+        self.ledger = ledger
+        self.client = client
+        self.cache_root = cache_root
+        # same knob the collector's scrape fold uses; parsed ONCE here
+        # (a malformed env value fails at construction, not per request)
+        if fold_budget_s is None:
+            import os
+            fold_budget_s = float(
+                os.environ.get("VTPU_UTIL_FOLD_BUDGET_S", "0.25"))
+        self.fold_budget_s = fold_budget_s
+
+    # -- cluster fan-in ------------------------------------------------------
+
+    def _node_rows(self, now: float) -> tuple[list[dict], list[str]]:
+        rows: list[dict] = []
+        errors: list[str] = []
+        if self.client is None:
+            return rows, errors
+        try:
+            nodes = self.client.list_nodes()
+        except Exception as e:  # noqa: BLE001 — the rollup degrades to
+            # the local cut on ANY apiserver shape of failure; the
+            # error row says so instead of a silent half-view
+            log.warning("utilization rollup node listing failed: %s", e)
+            errors.append(f"list_nodes: {e}")
+            return rows, errors
+        reg_ann = consts.node_device_register_annotation()
+        hr_ann = consts.node_reclaimable_headroom_annotation()
+        pr_ann = consts.node_pressure_annotation()
+        for node in nodes:
+            meta = node.get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            name = meta.get("name", "")
+            registry = dt.decode_registry(anns.get(reg_ann))
+            headroom = hr_mod.parse_headroom(anns.get(hr_ann), now=now)
+            pressure = tel_pressure.parse_pressure(anns.get(pr_ann),
+                                                   now=now)
+            chips = []
+            if registry is not None:
+                for chip in registry.chips:
+                    ch = headroom.chips.get(chip.index) \
+                        if headroom else None
+                    chips.append({
+                        "index": chip.index, "uuid": chip.uuid,
+                        "memory_bytes": chip.memory,
+                        "split_count": chip.split_count,
+                        "healthy": getattr(chip, "healthy", True),
+                        "alloc_core_pct":
+                            ch.alloc_core_pct if ch else None,
+                        "used_core_pct":
+                            ch.used_core_pct if ch else None,
+                        "reclaim_core_pct":
+                            ch.reclaim_core_pct if ch else None,
+                        "reclaim_hbm_bytes":
+                            ch.reclaim_hbm_bytes if ch else None,
+                    })
+            rows.append({
+                "node": name,
+                "local": name == self.ledger.node_name,
+                "chips": chips,
+                "mesh_domain":
+                    registry.mesh_domain if registry else "",
+                "headroom_ts": headroom.ts if headroom else None,
+                "headroom_stale": headroom is None
+                    and bool(anns.get(hr_ann)),
+                "reclaim_core_pct": round(
+                    headroom.total_reclaim_core_pct(), 2)
+                    if headroom else None,
+                "pressure_frac":
+                    pressure.throttle_frac if pressure else None,
+            })
+        return rows, errors
+
+    def _tenant_quota_rows(self, now: float
+                           ) -> tuple[list[dict], list[str]]:
+        """Cluster-wide quota rows from the claim annotations the
+        scheduler/plugin already write — the paper side of the ledger
+        for every node, joined with live use where this node's ledger
+        has it."""
+        rows: list[dict] = []
+        errors: list[str] = []
+        if self.client is None:
+            return rows, errors
+        try:
+            pods = self.client.list_pods()
+        except Exception as e:  # noqa: BLE001 — same degrade-to-local
+            # contract as the node listing
+            log.warning("utilization rollup pod listing failed: %s", e)
+            errors.append(f"list_pods: {e}")
+            return rows, errors
+        real_ann = consts.real_allocated_annotation()
+        pre_ann = consts.pre_allocated_annotation()
+        live = {(s.pod_uid, s.container.split("/", 1)[0], s.host_index): s
+                for s in self.ledger.tenants()}
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            raw = anns.get(real_ann) or anns.get(pre_ann)
+            if not raw:
+                continue
+            try:
+                claims = PodDeviceClaims.decode(raw)
+            except (ValueError, TypeError):
+                continue
+            uid = meta.get("uid", "")
+            node = (pod.get("spec") or {}).get("nodeName", "") or \
+                anns.get(consts.predicate_node_annotation(), "")
+            for container, clist in claims.containers.items():
+                for claim in clist:
+                    state = live.get((uid, container, claim.host_index))
+                    rows.append({
+                        "pod_uid": uid,
+                        "pod_name": meta.get("name", ""),
+                        "pod_namespace": meta.get("namespace", ""),
+                        "container": container,
+                        "node": node,
+                        "chip_index": claim.host_index,
+                        "chip_uuid": claim.uuid,
+                        "allocated_core_pct": claim.cores,
+                        "allocated_hbm_bytes": claim.memory,
+                        "used_core_pct": round(state.used_ewma, 2)
+                            if state else None,
+                        "throttle_wait_frac": round(state.wait_frac, 4)
+                            if state else None,
+                        "hbm_highwater_bytes": state.hbm_highwater
+                            if state else None,
+                        "confidence": round(state.confidence(now), 3)
+                            if state else None,
+                        "live": state is not None,
+                    })
+        return rows, errors
+
+    def _compile_cache_state(self) -> dict | None:
+        if not self.cache_root:
+            return None
+        try:
+            from vtpu_manager.compilecache.cache import node_totals
+            counters, entries, size = node_totals(self.cache_root)
+            return {"entries": entries, "size_bytes": size,
+                    "hits": counters.get("hits", 0),
+                    "misses": counters.get("misses", 0)}
+        except (OSError, ValueError):
+            return None
+
+    # -- the document --------------------------------------------------------
+
+    def collect(self, now: float | None = None) -> dict:
+        """The /utilization document: node-local ledger detail plus the
+        cluster cuts. Raises only what the failpoint injects — callers
+        (the monitor route) wrap it; everything organic degrades to
+        partial data with an ``errors`` list."""
+        failpoints.fire("util.rollup", node=self.ledger.node_name)
+        now = time.time() if now is None else now
+        fold_errors: list[str] = []
+        try:
+            # /utilization must serve fresh local rows even when nothing
+            # scrapes /metrics (same budget discipline as the scrape)
+            self.ledger.fold(budget_s=self.fold_budget_s)
+        except Exception as e:  # noqa: BLE001 — a torn fold serves the
+            # last fold's (confidence-decaying) state plus an error row
+            log.warning("utilization rollup fold failed: %s", e)
+            fold_errors.append(f"fold: {e}")
+        node_rows, node_errors = self._node_rows(now)
+        tenant_rows, pod_errors = self._tenant_quota_rows(now)
+        # local ledger rows the pod listing did not cover (no cluster
+        # client, apiserver error, claim annotation gone) merge in,
+        # shaped like the cluster rows so the ?pod=/?node= filters and
+        # vtpu-smi treat both alike — cluster rows take precedence
+        present = {(t["pod_uid"], t["container"], t["chip_index"])
+                   for t in tenant_rows}
+        for t in self.ledger.to_wire(now)["tenants"]:
+            key = (t["pod_uid"], t["container"].split("/", 1)[0],
+                   t["chip_index"])
+            if key not in present:
+                tenant_rows.append(
+                    dict(t, node=self.ledger.node_name, live=True))
+        local = self.ledger.to_wire(now)
+        local["compile_cache"] = self._compile_cache_state()
+        live_nodes = [r for r in node_rows
+                      if r["reclaim_core_pct"] is not None]
+        doc = {
+            "generated_at": now,
+            "node": local,
+            "nodes": node_rows,
+            "tenants": tenant_rows,
+            "cluster": {
+                "nodes": len(node_rows),
+                "nodes_with_signal": len(live_nodes),
+                "chips": sum(len(r["chips"]) for r in node_rows),
+                "reclaimable_core_pct": round(
+                    sum(r["reclaim_core_pct"] for r in live_nodes), 2),
+                "tenant_rows": len(tenant_rows),
+            },
+            "errors": fold_errors + node_errors + pod_errors,
+        }
+        return doc
+
+
+def filter_document(doc: dict, node: str = "", pod: str = "") -> dict:
+    """Apply the route's ?node= / ?pod= cuts to a collected document —
+    pure function so the HTTP layer stays a thin shell (and tests drive
+    the cuts without a server)."""
+    out = dict(doc)
+    if node:
+        out["nodes"] = [r for r in doc.get("nodes", [])
+                        if r.get("node") == node]
+        out["tenants"] = [r for r in doc.get("tenants", [])
+                         if r.get("node") == node]
+    if pod:
+        out["tenants"] = [r for r in out.get("tenants", [])
+                         if pod in (r.get("pod_uid"), r.get("pod_name"))]
+    return out
