@@ -90,12 +90,16 @@ test-compilecache: ## vtcc suite: content addressing, single-flight torture, LRU
 test-utilization: ## vtuse suite: ledger EWMA/burstiness/staleness math, budgeted fold bound, gate-off contract, rollup chaos, vtpu-smi e2e
 	$(PYTEST) tests/test_utilization.py -q
 
+.PHONY: test-explain
+test-explain: ## vtexplain suite: ring bounds/drops, gate-off contracts, reason-code matrix, score-reproduction e2e, doctor verdicts, victim-ordering satellite, chaos
+	$(PYTEST) tests/test_explain.py -q
+
 .PHONY: bench-compilecache
 bench-compilecache: ## vtcc headline bench: N-replica gang cold start, cache off/cold/warm (1 compile + N-1 hits asserted)
 	python scripts/bench_compilecache.py
 
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization test-explain ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite, vtexplain audit suite
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -46,8 +46,16 @@ def main(argv: list[str] | None = None) -> int:
                              "per-tenant utilization ledger: the "
                              "vtpu_utilization_*/vtpu_reclaimable_* "
                              "series on /metrics and the /utilization "
-                             "cluster view (default off = no new "
-                             "series, no route)")
+                             "cluster view; DecisionExplain=true arms "
+                             "the vtexplain /explain fan-in (decision "
+                             "audit + pending-pod doctor) over the "
+                             "node's explain spools (default off = no "
+                             "new series, no routes)")
+    parser.add_argument("--explain-dir", default=consts.EXPLAIN_DIR,
+                        help="vtexplain decision spool dir served by "
+                             "/explain behind the DecisionExplain gate "
+                             "(default: %(default)s; spools appear only "
+                             "on nodes whose scheduler runs the gate)")
     parser.add_argument("--fake-client", action="store_true",
                         help="back the /utilization cluster fan-in with "
                              "an empty in-process fake client instead "
@@ -69,7 +77,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.metrics.collector import NodeCollector
     from vtpu_manager.tpu.discovery import FakeBackend, discover
 
-    from vtpu_manager.util.featuregates import (UTILIZATION_LEDGER,
+    from vtpu_manager.util.featuregates import (DECISION_EXPLAIN,
+                                                UTILIZATION_LEDGER,
                                                 FeatureGates)
 
     gates = FeatureGates()
@@ -79,6 +88,7 @@ def main(argv: list[str] | None = None) -> int:
         logging.getLogger(__name__).error("bad --feature-gates: %s", e)
         return 2
     util_on = gates.enabled(UTILIZATION_LEDGER)
+    explain_on = gates.enabled(DECISION_EXPLAIN)
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
@@ -91,26 +101,32 @@ def main(argv: list[str] | None = None) -> int:
         kubelet_checkpoint=args.kubelet_checkpoint,
         utilization_enabled=util_on)
 
+    # one registry-channel client shared by the vtuse /utilization and
+    # vtexplain /explain fan-ins; no client degrades both to the
+    # node-local cut
+    def build_fan_client():
+        if args.fake_client:
+            from vtpu_manager.client.fake import FakeKubeClient
+            return FakeKubeClient(upsert_on_patch=True)
+        try:
+            from vtpu_manager.client.kube import InClusterClient
+            return InClusterClient()
+        except Exception:  # noqa: BLE001 — outside a cluster the
+            # monitor still serves the node-local cut
+            logging.getLogger(__name__).warning(
+                "no in-cluster client; cluster fan-ins serve the "
+                "node-local cut only")
+            return None
+
+    fan_client = build_fan_client() if (util_on or explain_on) else None
+
     # vtuse cluster fan-in (gate on only): node/pod annotations over the
-    # existing registry channel; no client degrades to the local cut
+    # existing registry channel
     rollup = None
     if util_on:
         from vtpu_manager.utilization.rollup import ClusterRollup
-        if args.fake_client:
-            from vtpu_manager.client.fake import FakeKubeClient
-            util_client = FakeKubeClient(upsert_on_patch=True)
-        else:
-            try:
-                from vtpu_manager.client.kube import InClusterClient
-                util_client = InClusterClient()
-            except Exception:  # noqa: BLE001 — outside a cluster the
-                # monitor still serves the node-local cut
-                logging.getLogger(__name__).warning(
-                    "no in-cluster client; /utilization serves the "
-                    "node-local cut only")
-                util_client = None
         rollup = ClusterRollup(
-            collector.util_ledger, client=util_client,
+            collector.util_ledger, client=fan_client,
             cache_root=os.path.join(args.base_dir,
                                     consts.COMPILE_CACHE_SUBDIR),
             fold_budget_s=collector.util_fold_budget_s)
@@ -151,6 +167,11 @@ def main(argv: list[str] | None = None) -> int:
         # scrape cost) stays bounded across daemon/tenant churn
         reap_stale_spools(args.trace_spool_dir)
         text += render_trace_metrics(args.trace_spool_dir)
+        if explain_on:
+            # vtexplain spool-drop visibility (gate off = no series):
+            # records lost at the scheduler's ring are counted here too
+            from vtpu_manager.explain import doctor as explain_doctor
+            text += explain_doctor.render_spool_metrics(args.explain_dir)
         # vtfault retry/breaker/failpoint counters for this process
         text += render_resilience_metrics() + "\n"
         return web.Response(text=text, content_type="text/plain")
@@ -205,6 +226,42 @@ def main(argv: list[str] | None = None) -> int:
             doc, node=request.query.get("node", ""),
             pod=request.query.get("pod", "")))
 
+    async def explain_route(request):
+        # decisions name pods/namespaces: same bearer auth as /metrics.
+        # The spool read + registry-channel pod fan-in (one LIST, the
+        # /utilization channel) runs in an executor thread; failures —
+        # including injected explain.rollup faults — answer HERE with
+        # 503, never on the /metrics path.
+        if not authorized(request):
+            return web.json_response({"error": "unauthorized"},
+                                     status=401)
+        import asyncio
+
+        from vtpu_manager.explain import doctor as explain_doctor
+        pod = request.query.get("pod", "")
+        shard = request.query.get("shard", "")
+
+        def collect():
+            pods = None
+            if pod and fan_client is not None:
+                try:
+                    pods = fan_client.list_pods()
+                except Exception as e:  # noqa: BLE001 — the annotation
+                    # join is an enrichment; apiserver trouble degrades
+                    # to the spool-only verdict, never a failed route
+                    logging.getLogger(__name__).warning(
+                        "explain pod fan-in failed: %s", e)
+            return explain_doctor.explain_document(
+                args.explain_dir, pod_key=pod, shard=shard, pods=pods)
+        try:
+            status, doc = await asyncio.get_running_loop() \
+                .run_in_executor(None, collect)
+        except Exception as e:  # noqa: BLE001 — a wedged audit plane
+            # serves an explicit error, never a hang or a half-truth
+            return web.json_response(
+                {"error": f"explain rollup failed: {e}"}, status=503)
+        return web.json_response(doc, status=status)
+
     app = web.Application()
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
@@ -213,6 +270,10 @@ def main(argv: list[str] | None = None) -> int:
         # gate off = no route at all (404), matching "zero new files/
         # env/annotations/series" — not an empty document
         app.router.add_get("/utilization", utilization)
+    if explain_on:
+        # same gate-off contract as /utilization: no route, not an
+        # empty document
+        app.router.add_get("/explain", explain_route)
     if args.debug_endpoints:
         # stack traces disclose internals: opt-in AND behind the same
         # bearer auth as /metrics when a token is configured

@@ -70,6 +70,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--trace-spool-dir", default=None,
                         help="vtrace span spool directory (default: the "
                              "shared node trace dir)")
+    parser.add_argument("--explain-dir", default=None,
+                        help="vtexplain decision spool directory "
+                             "(DecisionExplain gate; default: the "
+                             "shared node explain dir)")
+    parser.add_argument("--explain-token-file", default=None,
+                        help="require 'Authorization: Bearer <token>' "
+                             "on /explain, token read from this file "
+                             "(decisions name pods/namespaces)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -83,6 +91,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
     from vtpu_manager.scheduler.serial import SerialLocker
     from vtpu_manager.util.featuregates import (COMPILE_CACHE,
+                                                DECISION_EXPLAIN,
                                                 FAULT_INJECTION,
                                                 SCHEDULER_HA,
                                                 SCHEDULER_SNAPSHOT,
@@ -102,6 +111,15 @@ def main(argv: list[str] | None = None) -> int:
         from vtpu_manager import trace
         trace.configure("scheduler", spool_dir=args.trace_spool_dir,
                         sampling_rate=args.trace_sampling_rate)
+    explain_dir = None
+    if gates.enabled(DECISION_EXPLAIN):
+        # vtexplain (default off = zero records/spools/series/routes):
+        # every filter/preempt/bind decision leaves an audit record in
+        # the ring -> spool, served as /explain + the doctor
+        from vtpu_manager import explain
+        from vtpu_manager.util import consts
+        explain_dir = args.explain_dir or consts.EXPLAIN_DIR
+        explain.configure("scheduler", spool_dir=explain_dir)
     if gates.enabled(FAULT_INJECTION):
         # chaos/staging only: VTPU_FAILPOINTS arms seeded injections
         # (vtfault); with the gate off every site is one dict lookup
@@ -135,6 +153,13 @@ def main(argv: list[str] | None = None) -> int:
         # a score change this PR) — same filter_kwargs ride-along so
         # vtha shards inherit it
         utilization_hint=gates.enabled(UTILIZATION_LEDGER))
+    # vtexplain satellite: preemption victim ordering gains the vttel/
+    # vtuse utilization inputs behind the same gate as the audit trail
+    # (the ordering applied is recorded per victim, so it is auditable);
+    # rides its own kwargs dict so vtha shards inherit it like
+    # filter_kwargs
+    preempt_kwargs = dict(
+        victim_order_hint=gates.enabled(DECISION_EXPLAIN))
 
     if gates.enabled(SCHEDULER_HA):
         # vtha (default off): N replicas run active-active over a
@@ -153,11 +178,13 @@ def main(argv: list[str] | None = None) -> int:
             lease_namespace=args.lease_namespace,
             use_snapshot=gates.enabled(SCHEDULER_SNAPSHOT),
             filter_kwargs=filter_kwargs,
+            preempt_kwargs=preempt_kwargs,
             bind_locker=SerialLocker(gates.enabled(SERIAL_BIND_NODE)))
         sharded.start(snapshot_poll_s=args.snapshot_poll_ms / 1000.0)
         api = SchedulerAPI(sharded, sharded, sharded,
                            debug_endpoints=args.debug_endpoints,
-                           ha=sharded)
+                           ha=sharded, explain_dir=explain_dir,
+                           explain_token_file=args.explain_token_file)
     else:
         # SchedulerSnapshot (default off): list+watch incremental cluster
         # state replaces the TTL-LIST caches; a daemon thread consumes the
@@ -177,9 +204,10 @@ def main(argv: list[str] | None = None) -> int:
             # cache still covers committed placements)
             FilterPredicate(client, snapshot=snapshot, **filter_kwargs),
             BindPredicate(client, locker=bind_locker),
-            PreemptPredicate(client, snapshot=snapshot),
+            PreemptPredicate(client, snapshot=snapshot, **preempt_kwargs),
             debug_endpoints=args.debug_endpoints,
-            snapshot=snapshot)
+            snapshot=snapshot, explain_dir=explain_dir,
+            explain_token_file=args.explain_token_file)
 
     from vtpu_manager.util.tlsreload import serving_context
     ssl_ctx = serving_context(args.cert_file, args.key_file)

@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""vtpu-explain CLI: why did this pod land where it did — or nowhere.
+
+Usage:
+    python scripts/vtpu_explain.py --pod <uid>          # latest decision
+    python scripts/vtpu_explain.py --why-pending <pod>  # doctor verdict
+    python scripts/vtpu_explain.py --pod <uid> --diff   # last two passes
+    python scripts/vtpu_explain.py --list               # audited pods
+    python scripts/vtpu_explain.py --pod <uid> --json   # machine output
+
+Reads the per-process JSONL decision spools the DecisionExplain gate
+produces (default dir: the shared node explain dir; --explain-dir for
+test runs). ``--pod`` accepts a pod uid, a trace id (the vtrace join
+key), or a pod name. The printed breakdown is the EXACT arithmetic the
+filter applied: total = base - pressure - storm + gang; the headroom
+column is the observe-only vtuse input that was recorded but never
+scored.
+
+Exit codes: 0 ok, 1 no matching records, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vtpu_manager.explain import doctor                        # noqa: E402
+from vtpu_manager.util import consts                           # noqa: E402
+
+
+def _print_decision(rec: dict) -> None:
+    shard = (f"  shard {rec['shard']}(token {rec.get('token')})"
+             if rec.get("shard") else "")
+    gang = f"  gang {rec['gang']}" if rec.get("gang") else ""
+    print(f"pod {rec.get('pod') or rec.get('name') or '?'}  "
+          f"trace {rec.get('trace') or '?'}  mode {rec.get('mode')}  "
+          f"policy {rec.get('policy', '?')}{shard}{gang}")
+    chosen = rec.get("chosen")
+    if chosen:
+        margin = rec.get("margin")
+        print(f"  chosen {chosen}"
+              + (f"  margin {margin:.4f} over the runner-up"
+                 if margin is not None else "  (only fit)"))
+    elif rec.get("error"):
+        print(f"  FAILED: {rec['error']}")
+    for c in sorted(rec.get("candidates") or [],
+                    key=lambda c: -c["total"]):
+        mark = "  <- chosen" if c["node"] == chosen else ""
+        print(f"  candidate {c['node']}: total {c['total']:.4f} = "
+              f"base {c['base']:.4f} - pressure {c['pressure']:.4f} - "
+              f"storm {c['storm']:.4f} + gang {c['gang_bonus']:.4f}  "
+              f"[topology {c['topology']}, headroom-input "
+              f"{c['headroom_input']:.2f} observe-only]{mark}")
+    counts = rec.get("reason_counts") or {}
+    if counts:
+        ranked = sorted(counts.items(), key=lambda kv: -kv[1])
+        print(f"  rejected {sum(counts.values())} node(s): "
+              + ", ".join(f"{code} x{n}" for code, n in ranked))
+
+
+def _print_doctor(verdict: dict) -> None:
+    print(f"doctor: {verdict.get('verdict')} — {verdict.get('summary')}")
+    for r in verdict.get("reasons") or []:
+        stuck = "  [every recorded pass]" if r.get("persistent") else ""
+        print(f"  {r['nodes']} node(s) {r['reason']}"
+              + (f" (e.g. {r['example']})" if r.get("example") else "")
+              + stuck)
+    if verdict.get("passes"):
+        print(f"  {verdict['passes']} recorded pass(es), last "
+              f"{verdict.get('age_s', 0):.1f}s ago")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vtpu-explain", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--explain-dir", default=consts.EXPLAIN_DIR)
+    parser.add_argument("--pod", default="",
+                        help="pod uid / trace id / name to explain")
+    parser.add_argument("--why-pending", default="", metavar="POD",
+                        help="doctor verdict only for this pod")
+    parser.add_argument("--diff", action="store_true",
+                        help="compare the pod's two most recent "
+                             "decisions' breakdowns (needs --pod)")
+    parser.add_argument("--shard", default="",
+                        help="cut the trail to one vtha shard")
+    parser.add_argument("--list", action="store_true", dest="list_pods",
+                        help="list audited pods with verdicts")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    if not (args.pod or args.why_pending or args.list_pods):
+        parser.print_usage(sys.stderr)
+        print("vtpu-explain: one of --pod / --why-pending / --list "
+              "required", file=sys.stderr)
+        return 2
+    if args.diff and not args.pod:
+        print("vtpu-explain: --diff needs --pod", file=sys.stderr)
+        return 2
+
+    if args.list_pods:
+        # collect() reads the spools itself; its spool_drops field is
+        # the same warning signal (no second full-spool read)
+        doc = doctor.collect(args.explain_dir, shard=args.shard)
+        if doc.get("spool_drops") and not args.as_json:
+            print(f"warning: {doc['spool_drops']} record(s) dropped at "
+                  f"the ring — the trail may have holes",
+                  file=sys.stderr)
+        if args.as_json:
+            print(json.dumps(doc, indent=2))
+        else:
+            for row in doc.get("pods", []):
+                print(f"{row['verdict']:>14}  {row['passes']:3d} pass(es)"
+                      f"  {row['pod']}  {row['summary']}")
+        return 0
+
+    records, drops = doctor.read_records(args.explain_dir)
+    if args.shard:
+        records = [r for r in records if r.get("shard") == args.shard]
+    total_drops = sum(drops.values())
+    if total_drops and not args.as_json:
+        print(f"warning: {total_drops} record(s) dropped at the ring — "
+              f"the trail may have holes", file=sys.stderr)
+
+    key = args.pod or args.why_pending
+    trail = doctor.records_for_pod(records, key)
+    if not trail:
+        print(f"vtpu-explain: no decision records for {key!r} under "
+              f"{args.explain_dir}", file=sys.stderr)
+        return 1
+
+    if args.diff:
+        decisions = [r for r in trail if r.get("kind") == "decision"]
+        if len(decisions) < 2:
+            print(f"vtpu-explain: --diff needs two decisions; "
+                  f"{len(decisions)} recorded", file=sys.stderr)
+            return 1
+        delta = doctor.diff_decisions(decisions[-2], decisions[-1])
+        if args.as_json:
+            print(json.dumps(delta, indent=2))
+        else:
+            print(f"pod {key}: pass @{delta['ts'][0]:.3f} vs "
+                  f"@{delta['ts'][1]:.3f}")
+            print(f"  chosen: {delta['chosen'][0] or '-'} -> "
+                  f"{delta['chosen'][1] or '-'}")
+            for row in delta["candidates"]:
+                if "only_in" in row:
+                    which = ("new this pass" if row["only_in"] == "b"
+                             else "gone this pass")
+                    print(f"  {row['node']}: {which}")
+                    continue
+                moved = {k: v for k, v in row["delta"].items() if v}
+                print(f"  {row['node']}: total {row['total'][0]:.4f} -> "
+                      f"{row['total'][1]:.4f}"
+                      + (f"  ({', '.join(f'{k} {v:+.4f}' for k, v in sorted(moved.items()))})"
+                         if moved else "  (unchanged)"))
+            for code, n in sorted(
+                    delta["reason_counts_delta"].items()):
+                print(f"  rejections {code}: {n:+d}")
+        return 0
+
+    verdict = doctor.diagnose(trail)
+    latest = doctor.latest_decision(trail)
+    if args.as_json:
+        print(json.dumps({"pod": key, "decision": latest,
+                          "doctor": verdict,
+                          "records": len(trail)}, indent=2))
+        return 0
+    if args.why_pending:
+        _print_doctor(verdict)
+        return 0
+    if latest is not None:
+        _print_decision(latest)
+    _print_doctor(verdict)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

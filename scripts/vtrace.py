@@ -96,6 +96,10 @@ def main(argv: list[str] | None = None) -> int:
                              "step rings; --pod splices steady-state "
                              "step stats onto the allocation timeline "
                              "(default: %(default)s)")
+    parser.add_argument("--explain-dir", default=consts.EXPLAIN_DIR,
+                        help="vtexplain decision spool dir; --pod "
+                             "splices the placement decision breakdown "
+                             "onto the timeline (default: %(default)s)")
     parser.add_argument("--pod", default="",
                         help="pod uid (or trace id) to reconstruct")
     parser.add_argument("--list", action="store_true", dest="list_pods",
@@ -148,13 +152,26 @@ def main(argv: list[str] | None = None) -> int:
              "score_input": s.attrs.get("score_input"),
              "reclaim_core_pct": s.attrs.get("reclaim_core_pct")}
             for s in tl.spans if s.stage == "scheduler.headroom"]
+        # vtexplain splice: the placement decision that produced the
+        # scheduler.filter span above, joined by the same trace id /
+        # pod uid — the timeline says WHEN the filter ran, the decision
+        # record says WHY it chose what it chose
+        from vtpu_manager.explain import doctor as explain_doctor
+        exp_records, _exp_drops = explain_doctor.read_records(
+            args.explain_dir)
+        exp_trail = explain_doctor.records_for_pod(
+            exp_records, tl.trace_id or tl.pod_uid or args.pod) or \
+            explain_doctor.records_for_pod(exp_records,
+                                           tl.pod_uid or args.pod)
+        decision = explain_doctor.latest_decision(exp_trail)
         if args.as_json:
             print(json.dumps({"timeline": tl.to_wire(),
                               "critical_path": assemble.critical_path(tl),
                               "steps": steps,
                               "compile_cache": compiles,
                               "utilization": util,
-                              "placement_headroom": placement_headroom},
+                              "placement_headroom": placement_headroom,
+                              "placement_decision": decision},
                              indent=2))
         else:
             _print_timeline(tl)
@@ -186,6 +203,26 @@ def main(argv: list[str] | None = None) -> int:
                        if h.get("signal") else "no headroom signal")
                 print(f"  headroom-at-placement [{h['node']}]: {sig} "
                       f"(observe-only score input {h['score_input']})")
+            if decision is not None:
+                chosen = decision.get("chosen")
+                winner = next((c for c in decision.get("candidates") or []
+                               if c["node"] == chosen), None)
+                rejected = sum(
+                    (decision.get("reason_counts") or {}).values())
+                if winner is not None:
+                    margin = decision.get("margin")
+                    print(f"  decision [{chosen}]: total "
+                          f"{winner['total']:.4f} (base "
+                          f"{winner['base']:.4f} - pressure "
+                          f"{winner['pressure']:.4f} - storm "
+                          f"{winner['storm']:.4f} + gang "
+                          f"{winner['gang_bonus']:.4f})"
+                          + (f", margin {margin:.4f}"
+                             if margin is not None else "")
+                          + f"; {rejected} node(s) rejected")
+                elif decision.get("error"):
+                    print(f"  decision: FAILED — {decision['error']} "
+                          f"({rejected} node(s) rejected)")
         return 0
 
     if args.list_pods:

@@ -514,6 +514,14 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     failpoints.arm("util.fold", "error", p=0.3, count=rng.randint(1, 2))
     failpoints.arm("util.rollup", "error", p=0.3,
                    count=rng.randint(1, 2))
+    # vtexplain sites: driven by the dedicated explain chaos tests
+    # (test_explain.py — the e2e loop here runs with the recorder off,
+    # so flush/rollup never execute), armed so the full-coverage
+    # assertion stays the honest catalog check
+    failpoints.arm("explain.record", "error", exc=OSError, p=0.3,
+                   count=rng.randint(1, 2))
+    failpoints.arm("explain.rollup", "error", p=0.3,
+                   count=rng.randint(1, 2))
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 

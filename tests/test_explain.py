@@ -1,0 +1,1098 @@
+"""vtexplain suite: decision-record ring bounds and drop accounting,
+gate-off contracts (zero records/series/routes, placement byte-identical
+in both scheduler modes), the reason-code matrix against per-node ground
+truth, exact winning-score reproduction from the record alone (through
+scripts/vtpu_explain.py --json and a live scheduler's /explain), the
+pending-pod doctor, the preemption victim-ordering satellite (asserted
+against its own recorded reasoning), the TTL-path unbound-commitment
+anti-storm satellite, and chaos coverage proving a wedged explain plane
+never blocks a filter pass or /metrics.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from vtpu_manager import explain
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.explain import doctor
+from vtpu_manager.explain.record import ExplainRecorder
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.scheduler import reason as R
+from vtpu_manager.scheduler.bind import BindPredicate
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.preempt import PreemptPredicate
+from vtpu_manager.scheduler.routes import SchedulerAPI
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.util import consts
+from vtpu_manager.utilization import headroom as hr_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF = os.environ.get("VTPU_PERF") == "1"
+
+
+@pytest.fixture(autouse=True)
+def _reset_explain():
+    yield
+    explain.reset()
+    failpoints.disable()
+
+
+def vtpu_pod(name="p1", number=1, cores=25, memory_mib=1024,
+             annotations=None, node_name=None, priority=0):
+    pod = {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}",
+                     "annotations": annotations or {}},
+        "spec": {"priority": priority, "containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): number,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): memory_mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    return pod
+
+
+def fp_ann(fp):
+    return {consts.program_fingerprint_annotation(): fp}
+
+
+def two_node_cluster():
+    client = FakeKubeClient()
+    for i in range(2):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                               uuid_prefix=f"TPU-N{i}")
+        client.add_node(dt.fake_node(f"node-{i}", reg))
+    return client
+
+
+def place(pred, client, pod):
+    client.add_pod(pod)
+    result = pred.filter({"Pod": pod})
+    assert not result.error, result.error
+    assert len(result.node_names) == 1
+    return result.node_names[0]
+
+
+def flushed_records(explain_dir):
+    explain.flush()
+    records, drops = doctor.read_records(str(explain_dir))
+    return records, drops
+
+
+# ---------------------------------------------------------------------------
+# ring bounds / drop accounting
+# ---------------------------------------------------------------------------
+
+class TestRing:
+    def test_bounds_and_drop_accounting(self, tmp_path):
+        rec = ExplainRecorder("sched", str(tmp_path / "ex"),
+                              capacity=4, flush_at=10**9)
+        for i in range(10):
+            rec.record({"kind": "decision", "pod": f"u{i}",
+                        "reason_counts": {}})
+        assert rec.pending() == 4
+        assert rec.dropped == 6
+        assert rec.flush() == 4
+        records, drops = doctor.read_records(str(tmp_path / "ex"))
+        assert len(records) == 4
+        assert sum(drops.values()) == 6
+        # idle flush with unchanged drop count writes nothing
+        assert rec.flush() == 0
+
+    def test_unwritable_spool_counts_drops_never_raises(self, tmp_path):
+        blocker = tmp_path / "blocker"
+        blocker.write_text("file, not a dir")
+        rec = ExplainRecorder("sched", str(blocker / "sub"),
+                              capacity=8, flush_at=10**9)
+        for i in range(3):
+            rec.record({"kind": "decision", "pod": f"u{i}",
+                        "reason_counts": {}})
+        assert rec.flush() == 0          # spool unavailable
+        assert rec.dropped == 3          # loss counted, not silent
+
+    def test_rotated_spool_drops_not_double_counted(self, tmp_path):
+        """The drop counter is process-cumulative and the rotated .prev
+        generation repeats it — summing by filename would double-count
+        every rotation; the reader keys by (service, pid) and keeps the
+        max (the vtrace rule)."""
+        ex = tmp_path / "ex"
+        ex.mkdir()
+        meta = {"kind": "meta", "service": "scheduler", "pid": 42,
+                "drops": 7, "ts": 1.0}
+        (ex / "scheduler.42.prev.jsonl").write_text(
+            json.dumps(meta) + "\n")
+        (ex / "scheduler.42.jsonl").write_text(
+            json.dumps(dict(meta, drops=10, ts=2.0)) + "\n")
+        _records, drops = doctor.read_records(str(ex))
+        assert sum(drops.values()) == 10
+
+    def test_counters_tally_decisions_and_rejections(self, tmp_path):
+        rec = ExplainRecorder("sched", str(tmp_path / "ex"))
+        rec.record({"kind": "decision", "pod": "a",
+                    "reason_counts": {"NodeNoDevices": 2}})
+        rec.record({"kind": "decision", "pod": "b",
+                    "reason_counts": {"NodeNoDevices": 1,
+                                      "InsufficientCores": 3}})
+        rec.record({"kind": "bind", "pod": "a"})   # not a decision
+        decisions, rejections, dropped = rec.counters()
+        assert decisions == 2
+        assert rejections == {"NodeNoDevices": 3, "InsufficientCores": 3}
+        assert dropped == 0
+
+
+# ---------------------------------------------------------------------------
+# gate-off contracts
+# ---------------------------------------------------------------------------
+
+class TestGateOff:
+    def test_builder_is_none_and_never_constructed(self, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("DecisionBuilder built with gate off")
+        monkeypatch.setattr(explain, "DecisionBuilder", boom)
+        assert explain.pass_builder({"metadata": {}}, "ttl") is None
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        assert place(pred, client, vtpu_pod("a")) in ("node-0", "node-1")
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_placement_byte_identical_on_vs_off(self, mode, tmp_path):
+        """The gate only OBSERVES the filter: a wave placed with the
+        recorder armed matches the gate-off wave exactly, in both data
+        paths."""
+        def run(gate_on: bool) -> list[str]:
+            if gate_on:
+                explain.configure("scheduler",
+                                  spool_dir=str(tmp_path / "ex"),
+                                  flush_at=10**9)
+            else:
+                explain.reset()
+            client = two_node_cluster()
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap,
+                                   anti_storm=True)
+            out = []
+            for i in range(4):
+                anns = fp_ann("prog") if i % 2 else {}
+                out.append(place(pred, client,
+                                 vtpu_pod(f"{mode}-{gate_on}-{i}",
+                                          annotations=anns)))
+            return out
+
+        assert run(True) == run(False)
+
+    def test_zero_series_and_zero_routes_when_off(self, tmp_path):
+        assert explain.render_metrics() == ""
+        client = two_node_cluster()
+        api = SchedulerAPI(FilterPredicate(client),
+                           BindPredicate(client),
+                           PreemptPredicate(client))
+        paths = {r.resource.canonical for r in api.build_app().router
+                 .routes()}
+        assert "/explain" not in paths
+        api_on = SchedulerAPI(FilterPredicate(client),
+                              BindPredicate(client),
+                              PreemptPredicate(client),
+                              explain_dir=str(tmp_path / "ex"))
+        paths_on = {r.resource.canonical for r in api_on.build_app()
+                    .router.routes()}
+        assert "/explain" in paths_on
+
+    def test_metrics_block_gated(self, tmp_path):
+        import asyncio
+        client = two_node_cluster()
+        api = SchedulerAPI(FilterPredicate(client), BindPredicate(client),
+                           PreemptPredicate(client))
+        text = asyncio.run(api.handle_metrics(None)).text
+        assert "vtpu_explain_" not in text
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        text = asyncio.run(api.handle_metrics(None)).text
+        assert "vtpu_explain_decisions_total 0" in text
+        assert "vtpu_explain_ring_dropped_total 0" in text
+
+
+# ---------------------------------------------------------------------------
+# reason-code matrix vs ground truth
+# ---------------------------------------------------------------------------
+
+class TestReasonMatrix:
+    def _matrix_cluster(self):
+        client = FakeKubeClient()
+        client.add_node(dt.fake_node(
+            "node-ok", dt.fake_registry(4, mesh_shape=(2, 2),
+                                        uuid_prefix="TPU-OK"),
+            labels={"pool": "a"}))
+        client.add_node(dt.fake_node(
+            "node-small", dt.fake_registry(1, memory=1 << 20,
+                                           uuid_prefix="TPU-SM"),
+            labels={"pool": "a"}))
+        client.add_node({"metadata": {"name": "node-noreg",
+                                      "labels": {"pool": "a"}}})
+        client.add_node(dt.fake_node(
+            "node-foreign", dt.fake_registry(4, mesh_shape=(2, 2),
+                                             uuid_prefix="TPU-FR"),
+            labels={"pool": "b"}))
+        return client
+
+    def test_codes_match_failed_nodes(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = self._matrix_cluster()
+        pred = FilterPredicate(
+            client, shard_selector=lambda labels:
+            labels.get("pool") == "a")
+        pod = vtpu_pod("matrix")
+        node = place(pred, client, pod)
+        assert node == "node-ok"
+        records, _ = flushed_records(tmp_path / "ex")
+        rec = doctor.latest_decision(
+            doctor.records_for_pod(records, "uid-matrix"))
+        assert rec["chosen"] == "node-ok"
+        by_node = {r["node"]: r["reason"] for r in rec["rejected"]}
+        assert by_node == {
+            "node-small": R.NODE_INSUFFICIENT_CAPACITY,
+            "node-noreg": R.NODE_NO_DEVICES,
+            "node-foreign": R.NODE_OUTSIDE_SHARD,
+        }
+        assert rec["reason_counts"] == {
+            R.NODE_INSUFFICIENT_CAPACITY: 1,
+            R.NODE_NO_DEVICES: 1,
+            R.NODE_OUTSIDE_SHARD: 1,
+        }
+        # the record's rejections and the extender response agree
+        result_truth = {"node-small", "node-noreg", "node-foreign"}
+        assert set(by_node) == result_truth
+
+    def test_allocator_failure_reason_carries_detail(self, tmp_path):
+        """Post-gate allocator rejections (topology/uuid constraints the
+        fast capacity gate cannot see) land with the allocator's own
+        reason code plus the full summary as detail."""
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = FakeKubeClient()
+        client.add_node(dt.fake_node(
+            "solo", dt.fake_registry(2, mesh_shape=(2, 1),
+                                     uuid_prefix="TPU-S")))
+        # every uuid excluded: passes the fast free-totals gate (it is
+        # blind to uuid filters) but the allocator rejects each device
+        pred = FilterPredicate(client)
+        pod = vtpu_pod("excluded", annotations={
+            consts.exclude_uuids_annotation():
+                "TPU-S-0000,TPU-S-0001"})
+        client.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert result.error
+        records, _ = flushed_records(tmp_path / "ex")
+        rec = doctor.latest_decision(
+            doctor.records_for_pod(records, "uid-excluded"))
+        assert rec["chosen"] == ""
+        assert rec["error"] == result.error
+        row = next(r for r in rec["rejected"] if r["node"] == "solo")
+        assert row["reason"] == R.UUID_EXCLUDED
+        assert "UuidExcluded" in row.get("detail", "")
+
+
+# ---------------------------------------------------------------------------
+# exact score reproduction
+# ---------------------------------------------------------------------------
+
+class TestScoreReproduction:
+    def test_breakdown_reproduces_totals_exactly(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        now = time.time()
+        # node-0: live pressure + a resident same-fingerprint pod
+        # (storm); node-1: a reclaimable-headroom rollup (observe-only)
+        node0 = client.get_node("node-0")
+        node0["metadata"]["annotations"][
+            consts.node_pressure_annotation()] = f"0.5000:0@{now:.3f}"
+        client.add_node(node0)
+        node1 = client.get_node("node-1")
+        node1["metadata"]["annotations"][
+            consts.node_reclaimable_headroom_annotation()] = \
+            hr_mod.NodeHeadroom(chips={
+                0: hr_mod.ChipHeadroom(80.0, 20.0, 40.0, 2 << 30)},
+                ts=now).encode()
+        client.add_node(node1)
+        holder = vtpu_pod("holder", node_name="node-0", annotations={
+            **fp_ann("prog-1"),
+            consts.predicate_time_annotation(): str(now)})
+        client.add_pod(holder)
+
+        pred = FilterPredicate(client, anti_storm=True)
+        pod = vtpu_pod("scored", annotations=fp_ann("prog-1"))
+        chosen = place(pred, client, pod)
+
+        records, _ = flushed_records(tmp_path / "ex")
+        rec = doctor.latest_decision(
+            doctor.records_for_pod(records, "uid-scored"))
+        assert rec["chosen"] == chosen
+        cands = {c["node"]: c for c in rec["candidates"]}
+        assert set(cands) == {"node-0", "node-1"}
+        for c in cands.values():
+            # the acceptance bar: the winner's total reproduces from
+            # the record ALONE, exactly (same float ops, same order)
+            assert c["total"] == \
+                c["base"] - c["pressure"] - c["storm"] + c["gang_bonus"]
+        assert cands["node-0"]["pressure"] == pytest.approx(25.0)
+        assert cands["node-0"]["storm"] > 0.0
+        assert cands["node-1"]["pressure"] == 0.0
+        # the observe-only vtuse input is recorded but NOT in the total
+        assert cands["node-1"]["headroom_input"] == pytest.approx(40.0)
+        totals = sorted((c["total"] for c in cands.values()),
+                        reverse=True)
+        assert rec["margin"] == totals[0] - totals[1]
+
+    def test_gang_bonus_recorded(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = FakeKubeClient()
+        for i, domain in enumerate(["slice-a", "slice-b"]):
+            reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                                   uuid_prefix=f"TPU-G{i}")
+            reg.mesh_domain = domain
+            client.add_node(dt.fake_node(f"node-{i}", reg))
+        gang_ann = {consts.gang_name_annotation(): "train",
+                    consts.gang_size_annotation(): "2"}
+        pred = FilterPredicate(client)
+        first = place(pred, client, vtpu_pod("g0", annotations=gang_ann))
+        second = place(pred, client, vtpu_pod("g1", annotations=gang_ann))
+        assert second == first          # gang domain stickiness
+        records, _ = flushed_records(tmp_path / "ex")
+        rec = doctor.latest_decision(
+            doctor.records_for_pod(records, "uid-g1"))
+        winner = next(c for c in rec["candidates"]
+                      if c["node"] == second)
+        assert winner["gang_bonus"] == 100.0
+        assert rec.get("gang") == "train"
+        assert winner["total"] == winner["base"] - winner["pressure"] \
+            - winner["storm"] + 100.0
+
+
+# ---------------------------------------------------------------------------
+# doctor verdicts + CLI
+# ---------------------------------------------------------------------------
+
+class TestDoctor:
+    def _unschedulable_run(self, tmp_path, passes=2):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = FakeKubeClient()
+        for i in range(2):
+            client.add_node(dt.fake_node(
+                f"small-{i}", dt.fake_registry(1, memory=1 << 20,
+                                               uuid_prefix=f"TPU-S{i}")))
+        client.add_node({"metadata": {"name": "noreg"}})
+        pred = FilterPredicate(client)
+        pod = vtpu_pod("stuck", memory_mib=4096)
+        client.add_pod(pod)
+        result = None
+        for _ in range(passes):
+            result = pred.filter({"Pod": pod})
+            assert result.error
+        return client, result
+
+    def test_pending_pod_verdict_matches_per_node_truth(self, tmp_path):
+        _client, result = self._unschedulable_run(tmp_path)
+        records, _ = flushed_records(tmp_path / "ex")
+        trail = doctor.records_for_pod(records, "uid-stuck")
+        verdict = doctor.diagnose(trail)
+        assert verdict["verdict"] == "unschedulable"
+        assert verdict["passes"] == 2
+        by_reason = {r["reason"]: r for r in verdict["reasons"]}
+        assert by_reason[R.NODE_INSUFFICIENT_CAPACITY]["nodes"] == 2
+        assert by_reason[R.NODE_NO_DEVICES]["nodes"] == 1
+        assert all(r["persistent"] for r in verdict["reasons"])
+        assert "unschedulable: 2/3 nodes NodeInsufficientCapacity" in \
+            verdict["summary"]
+        # ground truth: the same nodes the extender failed
+        assert set(result.failed_nodes) == {"small-0", "small-1",
+                                            "noreg"}
+
+    def test_staleness_judged_at_read_time(self, tmp_path):
+        self._unschedulable_run(tmp_path, passes=1)
+        records, _ = flushed_records(tmp_path / "ex")
+        trail = doctor.records_for_pod(records, "uid-stuck")
+        fresh = doctor.diagnose(trail)
+        assert fresh["verdict"] == "unschedulable"
+        # same records, read far in the future: the verdict decays to
+        # stale instead of serving old reason counts as live truth
+        later = doctor.diagnose(trail,
+                                now=time.time()
+                                + doctor.DOCTOR_MAX_AGE_S + 1)
+        assert later["verdict"] == "stale"
+        assert "no fresh decision" in later["summary"]
+
+    def test_why_pending_through_cli_json(self, tmp_path):
+        self._unschedulable_run(tmp_path)
+        explain.flush()
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/vtpu_explain.py"),
+             "--explain-dir", str(tmp_path / "ex"),
+             "--why-pending", "uid-stuck", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr + out.stdout
+        doc = json.loads(out.stdout)
+        assert doc["doctor"]["verdict"] == "unschedulable"
+        by_reason = {r["reason"]: r["nodes"]
+                     for r in doc["doctor"]["reasons"]}
+        assert by_reason == {R.NODE_INSUFFICIENT_CAPACITY: 2,
+                             R.NODE_NO_DEVICES: 1}
+
+    def test_scheduled_breakdown_through_cli_json(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        chosen = place(pred, client, vtpu_pod("winner"))
+        explain.flush()
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/vtpu_explain.py"),
+             "--explain-dir", str(tmp_path / "ex"),
+             "--pod", "uid-winner", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr + out.stdout
+        doc = json.loads(out.stdout)
+        rec = doc["decision"]
+        assert rec["chosen"] == chosen
+        for c in rec["candidates"]:
+            assert c["total"] == c["base"] - c["pressure"] - c["storm"] \
+                + c["gang_bonus"]
+        assert doc["doctor"]["verdict"] == "scheduled"
+
+    def test_diff_two_decisions(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        pod = vtpu_pod("differ")
+        client.add_pod(pod)
+        assert not pred.filter({"Pod": pod}).error
+        # second pass with node-0 pressured: its total must move down
+        node0 = client.get_node("node-0")
+        node0["metadata"]["annotations"][
+            consts.node_pressure_annotation()] = \
+            f"0.8000:0@{time.time():.3f}"
+        client.add_node(node0)
+        assert not pred.filter({"Pod": pod}).error
+        records, _ = flushed_records(tmp_path / "ex")
+        decisions = [r for r in doctor.records_for_pod(records,
+                                                       "uid-differ")
+                     if r["kind"] == "decision"]
+        assert len(decisions) == 2
+        delta = doctor.diff_decisions(decisions[0], decisions[1])
+        row = next(r for r in delta["candidates"]
+                   if r["node"] == "node-0")
+        assert row["delta"]["pressure"] == pytest.approx(40.0)
+        assert row["delta"]["total"] < 0
+
+    def test_scheduled_verdict_decays_to_stale_without_bind(self,
+                                                            tmp_path):
+        """A commit with no bind and no fresh pass must not read as a
+        live 'scheduled' claim forever — the read-time staleness rule
+        applies to the confident branch too (scheduler crashed between
+        commit and bind)."""
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        place(pred, client, vtpu_pod("orphaned"))
+        records, _ = flushed_records(tmp_path / "ex")
+        trail = doctor.records_for_pod(records, "uid-orphaned")
+        assert doctor.diagnose(trail)["verdict"] == "scheduled"
+        later = doctor.diagnose(
+            trail, now=time.time() + doctor.DOCTOR_MAX_AGE_S + 1)
+        assert later["verdict"] == "stale"
+        assert "no bind was recorded" in later["summary"]
+
+    def test_failed_bind_yields_bind_failed_verdict(self, tmp_path):
+        """A rejected bind is exactly the why-is-this-pod-Pending
+        answer — a 'scheduled' verdict would paper over it."""
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        chosen = place(pred, client, vtpu_pod("mismatched"))
+        other = "node-1" if chosen == "node-0" else "node-0"
+        bind = BindPredicate(client)
+        res = bind.bind({"PodNamespace": "default",
+                         "PodName": "mismatched", "Node": other})
+        assert res.error
+        records, _ = flushed_records(tmp_path / "ex")
+        verdict = doctor.diagnose(
+            doctor.records_for_pod(records, "uid-mismatched"))
+        assert verdict["verdict"] == "bind-failed"
+        assert "predicate node" in verdict["summary"]
+
+    def test_preempt_only_trail_is_not_no_records(self):
+        trail = [{"kind": "preempt", "pod": "u1", "ts": 10.0,
+                  "nodes": {}}]
+        verdict = doctor.diagnose(trail, now=11.0)
+        assert verdict["verdict"] == "preempt-only"
+        # ...and the shared route contract serves it as 200, not 404
+        # (explain_document 404s only on a truly unknown pod)
+
+    def test_candidate_cap_keeps_the_winner(self):
+        from vtpu_manager.explain.record import (MAX_CANDIDATES,
+                                                 DecisionBuilder)
+        b = DecisionBuilder({"metadata": {"uid": "u"}}, "ttl")
+        for i in range(MAX_CANDIDATES + 8):
+            b.candidate(f"n{i}", base=float(i), pressure=0.0, storm=0.0,
+                        gang_bonus=0.0, headroom_input=0.0,
+                        topology="any", total=float(i))
+        rec = b.finish()
+        nodes = {c["node"] for c in rec["candidates"]}
+        # the highest-total candidates survive the cap — the winner can
+        # never be evicted from its own record by a raised
+        # candidate_limit — and the truncation is counted, not silent
+        assert f"n{MAX_CANDIDATES + 7}" in nodes
+        assert "n0" not in nodes
+        assert len(rec["candidates"]) == MAX_CANDIDATES
+        assert rec["candidates_dropped"] == 8
+
+    def test_shard_cut_keeps_shardless_bind_records(self, tmp_path):
+        ex = tmp_path / "ex"
+        ex.mkdir()
+        rows = [
+            {"kind": "decision", "pod": "u1", "ts": 1.0, "chosen": "n1",
+             "shard": "shard0", "reason_counts": {}, "candidates": [],
+             "rejected": []},
+            {"kind": "bind", "pod": "u1", "ts": 2.0, "node": "n1",
+             "outcome": "bound", "error": ""},
+            {"kind": "decision", "pod": "u2", "ts": 1.0, "chosen": "n2",
+             "shard": "shard1", "reason_counts": {}, "candidates": [],
+             "rejected": []},
+        ]
+        (ex / "scheduler.1.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n")
+        doc = doctor.collect(str(ex), pod_key="u1", shard="shard0",
+                             now=3.0)
+        assert doc["doctor"]["verdict"] == "bound"     # bind kept
+        idx = doctor.collect(str(ex), shard="shard0", now=3.0)
+        assert [p["pod"] for p in idx["pods"]] == ["u1"]
+
+    def test_spool_drop_tail_read(self, tmp_path):
+        ex = tmp_path / "ex"
+        ex.mkdir()
+        lines = [json.dumps({"kind": "decision", "pod": f"u{i}",
+                             "ts": float(i), "reason_counts": {},
+                             "candidates": [], "rejected": []})
+                 for i in range(200)]
+        lines.append(json.dumps({"kind": "meta", "service": "scheduler",
+                                 "pid": 9, "drops": 4, "ts": 1.0}))
+        (ex / "scheduler.9.jsonl").write_text("\n".join(lines) + "\n")
+        assert doctor.read_spool_drops(str(ex)) == {"scheduler.9": 4}
+        assert "vtpu_explain_spool_dropped_total 4" in \
+            doctor.render_spool_metrics(str(ex))
+
+    def test_bind_outcome_joins_trail(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        chosen = place(pred, client, vtpu_pod("bindme"))
+        bind = BindPredicate(client)
+        res = bind.bind({"PodNamespace": "default", "PodName": "bindme",
+                         "Node": chosen})
+        assert not res.error
+        records, _ = flushed_records(tmp_path / "ex")
+        trail = doctor.records_for_pod(records, "uid-bindme")
+        kinds = [r["kind"] for r in trail]
+        assert kinds.count("decision") == 1
+        assert kinds.count("bind") == 1
+        verdict = doctor.diagnose(trail)
+        assert verdict["verdict"] == "bound"
+
+
+# ---------------------------------------------------------------------------
+# preemption victim ordering (the carried vttel/vtuse satellite)
+# ---------------------------------------------------------------------------
+
+class TestVictimOrdering:
+    def _victim_cluster(self, headroom_ts=None):
+        """One 2-chip node; two equal-priority 90%-core victims, one per
+        chip. The headroom rollup says chip0's tenant is busy (85% used,
+        nothing reclaimable) and chip1's is idle (5% used, smooth)."""
+        client = FakeKubeClient()
+        reg = dt.fake_registry(2, mesh_shape=(2, 1),
+                               uuid_prefix="TPU-V")
+        node = dt.fake_node("node-v", reg)
+        ts = headroom_ts if headroom_ts is not None else time.time()
+        node["metadata"]["annotations"][
+            consts.node_reclaimable_headroom_annotation()] = \
+            hr_mod.NodeHeadroom(chips={
+                0: hr_mod.ChipHeadroom(90.0, 85.0, 0.0, 0),
+                1: hr_mod.ChipHeadroom(90.0, 5.0, 60.0, 0)},
+                ts=ts).encode()
+        client.add_node(node)
+        for name, chip in (("victim-busy", reg.chips[0]),
+                           ("victim-idle", reg.chips[1])):
+            claims = PodDeviceClaims()
+            claims.add("main", DeviceClaim(chip.uuid, chip.index, 90,
+                                           2**30))
+            victim = vtpu_pod(name, node_name="node-v", priority=1,
+                              annotations={
+                                  consts.real_allocated_annotation():
+                                      claims.encode()})
+            victim["status"]["phase"] = "Running"
+            client.add_pod(victim)
+        return client
+
+    def _preempt(self, client, hint):
+        preemptor = vtpu_pod("pre", cores=80, priority=100)
+        pred = PreemptPredicate(client, victim_order_hint=hint)
+        return pred.preempt({"Pod": preemptor, "NodeNameToVictims": {
+            "node-v": {"Pods": []}}})
+
+    def test_hint_prefers_measured_idle_victim(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        res = self._preempt(self._victim_cluster(), hint=True)
+        names = [p["metadata"]["name"]
+                 for p in res.node_to_victims["node-v"].pods]
+        assert names == ["victim-idle"]
+        # ...and the choice is auditable: the recorded reasoning names
+        # the ordering applied and the per-victim inputs it used
+        records, _ = flushed_records(tmp_path / "ex")
+        rec = next(r for r in records if r["kind"] == "preempt")
+        vlog = rec["nodes"]["node-v"]
+        assert vlog["ordering"] == "utilization"
+        assert vlog["headroom_fresh"] is True
+        kept = {v["name"]: v for v in vlog["victims"]}
+        assert kept["victim-idle"]["est_used_core_pct"] == \
+            pytest.approx(5.0)
+        assert kept["victim-idle"]["role"] == "added"
+
+    def test_gate_off_keeps_priority_order(self):
+        """hint off (the DecisionExplain default): byte-identical to the
+        pre-explain tree — equal-priority extras keep list order, so the
+        first resident victim is taken."""
+        res = self._preempt(self._victim_cluster(), hint=False)
+        names = [p["metadata"]["name"]
+                 for p in res.node_to_victims["node-v"].pods]
+        assert names == ["victim-busy"]
+
+    def test_stale_headroom_degrades_to_priority_order(self, tmp_path):
+        """A dead publisher's rollup must not justify an ordering: the
+        use-time freshness check falls back to the priority sort."""
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        stale = time.time() - hr_mod.MAX_HEADROOM_AGE_S - 60
+        res = self._preempt(self._victim_cluster(headroom_ts=stale),
+                            hint=True)
+        names = [p["metadata"]["name"]
+                 for p in res.node_to_victims["node-v"].pods]
+        assert names == ["victim-busy"]          # the hint stood down
+        records, _ = flushed_records(tmp_path / "ex")
+        rec = next(r for r in records if r["kind"] == "preempt")
+        assert rec["nodes"]["node-v"]["ordering"] == "priority"
+
+    def test_priority_still_primary_over_utilization(self, tmp_path):
+        """A lower-priority busy victim is still taken before a
+        higher-priority idle one — the hint orders within a priority
+        class, never across."""
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = self._victim_cluster()
+        busy = client.get_pod("default", "victim-busy")
+        idle = client.get_pod("default", "victim-idle")
+        busy["spec"]["priority"] = 1
+        idle["spec"]["priority"] = 50
+        client.add_pod(busy)
+        client.add_pod(idle)
+        res = self._preempt(client, hint=True)
+        names = [p["metadata"]["name"]
+                 for p in res.node_to_victims["node-v"].pods]
+        assert names == ["victim-busy"]
+
+
+# ---------------------------------------------------------------------------
+# TTL-path anti-storm over unbound commitments (the vtcc satellite)
+# ---------------------------------------------------------------------------
+
+class TestUnboundAntiStorm:
+    def _foreign_commit(self, node="node-0", fp="prog-1",
+                        name="foreign"):
+        """A commitment another (independent, non-HA) scheduler process
+        just wrote: fingerprint + predicate stamps, no nodeName yet."""
+        return vtpu_pod(name, annotations={
+            **fp_ann(fp),
+            consts.predicate_node_annotation(): node,
+            consts.predicate_time_annotation(): str(time.time()),
+        })
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_unbound_commitment_repels_in_both_modes(self, mode):
+        client = two_node_cluster()
+        client.add_pod(self._foreign_commit())
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap, anti_storm=True)
+        assert place(pred, client,
+                     vtpu_pod(f"b-{mode}",
+                              annotations=fp_ann("prog-1"))) == "node-1"
+        # a different program is untouched by prog-1's storm: binpack
+        # sends it to the now-fuller node-1 (the unbound commitment
+        # repels only same-fingerprint replicas, never capacity)
+        assert place(pred, client,
+                     vtpu_pod(f"c-{mode}",
+                              annotations=fp_ann("prog-2"))) == "node-1"
+
+    def test_modes_agree(self):
+        def run(mode):
+            client = two_node_cluster()
+            client.add_pod(self._foreign_commit())
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap,
+                                   anti_storm=True)
+            return [place(pred, client,
+                          vtpu_pod(f"p{mode}{i}",
+                                   annotations=fp_ann("prog-1")))
+                    for i in range(2)]
+        assert run("ttl") == run("snapshot")
+
+    def test_own_overlay_not_double_counted_with_unbound_view(self):
+        """This process's own commit appears BOTH in its in-process
+        overlay and (after the commit patch) in the cluster's unbound
+        view — the overlay twin must retire, or one placement would
+        repel twice as hard as it should."""
+        client = two_node_cluster()
+        pred = FilterPredicate(client, anti_storm=True)
+        place(pred, client, vtpu_pod("first",
+                                     annotations=fp_ann("prog-1")))
+        now = time.time()
+        unbound = pred._unbound_committed_fp(now)
+        assert "node-0" in unbound           # the commit is visible
+        storm = pred._storm_for_node(
+            "node-0", pred._recent_fp_overlay(now), set(), [],
+            unbound=unbound.get("node-0", ()))
+        assert len(storm) == 1               # once, not twice
+        assert "node-0" not in pred._recent_fp
+
+    def test_bound_pod_not_double_counted(self):
+        """Once the foreign pod binds, the resident-annotation scan owns
+        the signal and the unbound view drops it — one placement, one
+        penalty, through the whole lifecycle."""
+        client = two_node_cluster()
+        foreign = self._foreign_commit()
+        foreign["spec"]["nodeName"] = "node-0"
+        client.add_pod(foreign)
+        pred = FilterPredicate(client, anti_storm=True)
+        assert pred._unbound_committed_fp(time.time()) == {}
+
+    def test_snapshot_index_retires_on_bind_and_delete(self):
+        client = two_node_cluster()
+        foreign = self._foreign_commit()
+        client.add_pod(foreign)
+        snap = ClusterSnapshot(client)
+        snap.start()
+        assert snap.unbound_fp("node-0")
+        bound = dict(foreign, spec=dict(foreign["spec"],
+                                        nodeName="node-0"))
+        snap.apply_event("pods", {"type": "MODIFIED", "object": bound})
+        assert snap.unbound_fp("node-0") == ()
+        snap.apply_event("pods", {"type": "MODIFIED",
+                                  "object": foreign})
+        assert snap.unbound_fp("node-0")
+        snap.apply_event("pods", {"type": "DELETED", "object": foreign})
+        assert snap.unbound_fp("node-0") == ()
+
+
+# ---------------------------------------------------------------------------
+# chaos: a wedged explain plane never blocks the decision path
+# ---------------------------------------------------------------------------
+
+class TestChaos:
+    def test_record_zero_io_on_pass_thread(self, tmp_path):
+        """The hot-path contract: record() is ring-only — every spool
+        write happens on the flusher thread, never on the thread running
+        the filter pass (asserted by instrumenting flush itself)."""
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=1)     # every record wakes the flusher
+        rec = explain.recorder()
+        flush_threads: list[str] = []
+        orig = rec.flush
+
+        def spy_flush():
+            flush_threads.append(threading.current_thread().name)
+            return orig()
+        rec.flush = spy_flush
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        for i in range(6):
+            place(pred, client, vtpu_pod(f"io{i}", cores=5))
+        time.sleep(0.1)
+        assert threading.current_thread().name not in flush_threads
+
+    def test_wedged_spool_never_blocks_pass_drops_counted(self,
+                                                          tmp_path):
+        failpoints.enable(seed=7)
+        failpoints.arm("explain.record", "latency", latency_s=1.0)
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=1)     # flusher woken per record
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        t0 = time.perf_counter()
+        for i in range(5):
+            place(pred, client, vtpu_pod(f"w{i}", cores=5))
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.9, \
+            f"wedged flusher leaked into the pass ({elapsed:.3f}s)"
+        # and a spool that FAILS (not just stalls) turns records into
+        # counted drops, surfaced on /metrics
+        failpoints.arm("explain.record", "error", exc=OSError)
+        rec = explain.recorder()
+        pending = rec.pending()
+        rec.flush()
+        assert rec.dropped >= pending
+        assert "vtpu_explain_ring_dropped_total" in \
+            explain.render_metrics()
+
+    def test_torn_spool_line_skipped(self, tmp_path):
+        ex = tmp_path / "ex"
+        ex.mkdir()
+        good = json.dumps({"kind": "decision", "pod": "u1", "ts": 1.0,
+                           "chosen": "n1", "reason_counts": {},
+                           "candidates": [], "rejected": []})
+        (ex / "scheduler.123.jsonl").write_text(
+            good + "\n" + '{"kind":"decision","pod":"u2","cand')
+        records, _ = doctor.read_records(str(ex))
+        assert [r["pod"] for r in records] == ["u1"]
+        verdict = doctor.diagnose(doctor.records_for_pod(records, "u1"),
+                                  now=2.0)
+        assert verdict["verdict"] == "scheduled"
+
+    def test_rollup_fault_hits_explain_only(self, tmp_path):
+        """explain.rollup error answers on the /explain fan-in (the
+        routes wrap collect() into a 503) and never touches /metrics or
+        a scheduling pass."""
+        import asyncio
+
+        from vtpu_manager.client.kube import KubeError
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        pred = FilterPredicate(client)
+        failpoints.enable(seed=11)
+        failpoints.arm("explain.rollup", "error")
+        with pytest.raises(KubeError):
+            doctor.collect(str(tmp_path / "ex"))
+        # the decision path and the scrape are untouched
+        place(pred, client, vtpu_pod("alive", cores=5))
+        api = SchedulerAPI(pred, BindPredicate(client),
+                           PreemptPredicate(client),
+                           explain_dir=str(tmp_path / "ex"))
+        text = asyncio.run(api.handle_metrics(None)).text
+        assert "vtpu_explain_decisions_total 1" in text
+
+
+# ---------------------------------------------------------------------------
+# live scheduler e2e: /explain + CLI against a real process
+# ---------------------------------------------------------------------------
+
+class TestLiveScheduler:
+    @staticmethod
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def test_explain_route_and_cli_reproduce_winner(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        port = self._free_port()
+        ex_dir = str(tmp_path / "ex")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "cmd/device_scheduler.py"),
+             "--port", str(port), "--host", "127.0.0.1", "--fake-client",
+             "--feature-gates", "DecisionExplain=true",
+             "--explain-dir", ex_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"scheduler exited rc={proc.returncode}: "
+                        f"{proc.stdout.read()}")
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1)
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            pod = vtpu_pod("live")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/scheduler/filter",
+                data=json.dumps({"Pod": pod}).encode(),
+                headers={"Content-Type": "application/json"})
+            wire = json.loads(urllib.request.urlopen(
+                req, timeout=10).read())
+            assert wire["NodeNames"], wire
+            chosen = wire["NodeNames"][0]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/explain?pod=uid-live",
+                    timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc["doctor"]["verdict"] == "scheduled"
+            rec = doc["decision"]
+            assert rec["chosen"] == chosen
+            for c in rec["candidates"]:
+                assert c["total"] == c["base"] - c["pressure"] \
+                    - c["storm"] + c["gang_bonus"]
+            # unknown pod: explicit 404, not an empty 200
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/explain?pod=nope",
+                    timeout=10)
+            assert err.value.code == 404
+            # scrape carries the counter block
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                metrics = r.read().decode()
+            assert "vtpu_explain_decisions_total 1" in metrics
+            # the CLI over the same spool reproduces the breakdown
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "scripts/vtpu_explain.py"),
+                 "--explain-dir", ex_dir, "--pod", "uid-live",
+                 "--json"],
+                capture_output=True, text=True, timeout=60)
+            assert out.returncode == 0, out.stderr + out.stdout
+            cli = json.loads(out.stdout)
+            assert cli["decision"]["chosen"] == chosen
+            assert cli["decision"]["candidates"] == rec["candidates"]
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_gate_off_no_route(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        port = self._free_port()
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "cmd/device_scheduler.py"),
+             "--port", str(port), "--host", "127.0.0.1", "--fake-client"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                if proc.poll() is not None:
+                    raise AssertionError("scheduler exited")
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1)
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/explain?pod=x", timeout=10)
+            assert err.value.code == 404
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert "vtpu_explain_" not in r.read().decode()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# vtrace splice
+# ---------------------------------------------------------------------------
+
+class TestVtraceSplice:
+    def test_pod_report_splices_decision(self, tmp_path):
+        from vtpu_manager import trace
+        spool = str(tmp_path / "spool")
+        ex_dir = str(tmp_path / "ex")
+        trace.configure("scheduler", spool_dir=spool)
+        explain.configure("scheduler", spool_dir=ex_dir, flush_at=10**9)
+        try:
+            client = two_node_cluster()
+            pred = FilterPredicate(client)
+            pod = vtpu_pod("spliced", annotations={
+                consts.trace_id_annotation(): "t-splice",
+                consts.trace_sampled_annotation(): "true"})
+            chosen = place(pred, client, pod)
+            trace.flush()
+            explain.flush()
+        finally:
+            trace.reset()
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/vtrace.py"),
+             "--spool-dir", spool, "--steps-dir", str(tmp_path / "none"),
+             "--explain-dir", ex_dir, "--pod", "uid-spliced", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr + out.stdout
+        doc = json.loads(out.stdout)
+        assert doc["placement_decision"]["chosen"] == chosen
+        human = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/vtrace.py"),
+             "--spool-dir", spool, "--steps-dir", str(tmp_path / "none"),
+             "--explain-dir", ex_dir, "--pod", "uid-spliced"],
+            capture_output=True, text=True, timeout=60)
+        assert f"decision [{chosen}]" in human.stdout
+
+
+# ---------------------------------------------------------------------------
+# overhead (the acceptance bound; full 5000-node matrix under VTPU_PERF)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not PERF, reason="VTPU_PERF=1 unlocks the 5000-node "
+                                     "overhead bound")
+def test_5000_node_snapshot_pass_within_10pct(tmp_path):
+    """Acceptance: a 5000-node snapshot-mode pass with explain ON stays
+    within 10% of the PR 3 benchmark path (explain OFF)."""
+    def build():
+        client = FakeKubeClient(copy_on_read=False)
+        for i in range(5000):
+            reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                                   uuid_prefix=f"TPU-N{i:05d}")
+            client.add_node(dt.fake_node(f"node-{i:05d}", reg))
+        snap = ClusterSnapshot(client)
+        snap.start()
+        return client, FilterPredicate(client, snapshot=snap)
+
+    def p50(pred, client, tag, n=60):
+        lat = []
+        for i in range(n):
+            pod = vtpu_pod(f"{tag}-{i}", cores=5)
+            client.add_pod(pod)
+            t0 = time.perf_counter()
+            res = pred.filter({"Pod": pod})
+            lat.append(time.perf_counter() - t0)
+            assert not res.error
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    client, pred = build()
+    off = p50(pred, client, "off")
+    explain.configure("scheduler", spool_dir=str(tmp_path / "ex"))
+    client, pred = build()
+    on = p50(pred, client, "on")
+    assert on <= off * 1.10 + 0.0005, f"explain-on p50 {on:.6f}s vs " \
+                                      f"off {off:.6f}s"

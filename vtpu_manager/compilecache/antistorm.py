@@ -70,6 +70,40 @@ def recent_from_pods(pods, now: float) -> list[tuple[str, float]]:
     return out
 
 
+def unbound_recent_from_pods(pods, now: float
+                             ) -> dict[str, list[tuple[str, str, float]]]:
+    """node -> [(pod_uid, fingerprint, commit_ts)] for committed-but-
+    unbound pods inside the storm window: the fingerprint + predicate
+    annotations are stamped by filter._commit, but until the Binding
+    lands the pod has no ``spec.nodeName`` — so the resident-pod scan
+    (which keys on nodeName) is blind to exactly the in-flight wave an
+    INDEPENDENT scheduler process just placed. Folding these into the
+    per-candidate storm signal lets non-HA schedulers repel each other's
+    in-flight placements the way the in-process overlay already covers a
+    single scheduler's own commits. Bound pods are excluded here and
+    contribute through recent_from_pods — one placement, one signal."""
+    out: dict[str, list[tuple[str, str, float]]] = {}
+    for pod in pods:
+        if (pod.get("spec") or {}).get("nodeName"):
+            continue
+        meta = pod.get("metadata") or {}
+        anns = meta.get("annotations") or {}
+        node = anns.get(consts.predicate_node_annotation())
+        if not node:
+            continue
+        raw = anns.get(consts.program_fingerprint_annotation())
+        if not raw:
+            continue
+        ts = consts.parse_predicate_time(anns)
+        if ts is None or not 0 <= now - ts <= STORM_WINDOW_S:
+            continue
+        fp = sanitize_fingerprint(raw)
+        if fp:
+            out.setdefault(node, []).append(
+                (meta.get("uid", ""), fp, ts))
+    return out
+
+
 def storm_penalty(fingerprint: str, recent, now: float | None = None
                   ) -> float:
     """Score points to subtract for one node. ``recent`` is an iterable

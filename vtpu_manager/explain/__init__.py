@@ -1,0 +1,162 @@
+"""vtexplain: per-decision placement audit trail (DecisionExplain gate).
+
+Answers the questions no aggregate metric or trace span can: *why did
+this pod land on node-3 and not node-7*, *why is this pod Pending*, and
+*what would the headroom term have changed* — by recording, for every
+filter/preempt/bind decision, the exact per-candidate score breakdown
+and per-rejected-node reason codes the pass computed, into a bounded
+ring spooled as per-process JSONL (record.py), folded on demand into a
+pending-pod diagnosis (doctor.py).
+
+This module is the zero-overhead seam, exactly like ``vtpu_manager.
+trace``: until ``configure()`` runs (the binaries call it when the
+DecisionExplain gate is on), ``pass_builder()`` and every other entry
+point return a constant after one ``is None`` check — no clock reads,
+no allocation, no recorder — so the gate-off scheduler executes
+byte-identically in both data-path modes.
+
+Usage (the filter pass)::
+
+    builder = explain.pass_builder(pod, mode="snapshot", fence=lease)
+    ...                                   # builder is None when off
+    if builder is not None:
+        builder.candidate(...)/reject(...)/chosen(...)
+        explain.submit(builder)
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+
+from vtpu_manager.explain.record import (DEFAULT_CAPACITY,
+                                         DEFAULT_FLUSH_INTERVAL_S,
+                                         DecisionBuilder, ExplainRecorder,
+                                         reason_code)
+from vtpu_manager.util import consts
+
+__all__ = ["DecisionBuilder", "ExplainRecorder", "configure", "reset",
+           "is_enabled", "recorder", "flush", "pass_builder", "submit",
+           "record_raw", "routing_rejection", "bind_outcome",
+           "render_metrics", "reason_code"]
+
+_rec: ExplainRecorder | None = None
+_atexit_registered = False
+
+
+def configure(service: str, spool_dir: str | None = None,
+              capacity: int = DEFAULT_CAPACITY,
+              flush_at: int | None = None,
+              flush_interval_s: float = DEFAULT_FLUSH_INTERVAL_S) -> None:
+    """Enable decision recording for this process. Starts the background
+    flusher — ALL spool I/O runs on that daemon thread (plus atexit),
+    never on a scheduling thread. Idempotent-by-replacement (tests)."""
+    global _rec, _atexit_registered
+    if _rec is not None:
+        _rec.stop_flusher()
+    _rec = ExplainRecorder(service, spool_dir or consts.EXPLAIN_DIR,
+                           capacity=capacity, flush_at=flush_at)
+    threading.Thread(target=_rec.run_flusher, args=(flush_interval_s,),
+                     daemon=True, name="vtexplain-flush").start()
+    if not _atexit_registered:
+        atexit.register(flush)
+        _atexit_registered = True
+
+
+def reset() -> None:
+    """Disable recording (tests; restores the zero-overhead path)."""
+    global _rec
+    if _rec is not None:
+        _rec.stop_flusher()
+    _rec = None
+
+
+def is_enabled() -> bool:
+    return _rec is not None
+
+
+def recorder() -> ExplainRecorder | None:
+    return _rec
+
+
+def flush() -> int:
+    return _rec.flush() if _rec is not None else 0
+
+
+# -- pass-facing entry points (all no-ops when off) --------------------------
+
+def pass_builder(pod: dict, mode: str, fence=None
+                 ) -> DecisionBuilder | None:
+    """A builder for one filter pass, or None when the gate is off.
+    ``fence`` (the vtha ShardLease, when the pass runs under HA) stamps
+    the shard + fencing token into the record so per-shard audit trails
+    stay attributable after handoffs."""
+    if _rec is None:
+        return None
+    shard = getattr(fence, "shard", "") if fence is not None else ""
+    token = getattr(fence, "token", None) if fence is not None else None
+    return DecisionBuilder(pod, mode, shard=shard, token=token)
+
+
+def submit(builder: DecisionBuilder) -> None:
+    """Finish + ring-append one pass's record (lock-cheap, zero I/O)."""
+    if _rec is not None:
+        _rec.record(builder.finish())
+
+
+def record_raw(rec: dict) -> None:
+    """Ring-append an already-shaped record (preempt/bind kinds)."""
+    if _rec is not None:
+        _rec.record(rec)
+
+
+def routing_rejection(pod: dict, shard: str, why: str) -> None:
+    """vtha routing refusals are decisions too: a pod stuck bouncing off
+    a non-led shard must diagnose as ShardNotLed, not as silence."""
+    if _rec is None:
+        return
+    from vtpu_manager.scheduler import reason as R
+    builder = DecisionBuilder(pod, mode="routing", shard=shard)
+    builder.error(why, code=R.POD_SHARD_NOT_LED)
+    _rec.record(builder.finish())
+
+
+def bind_outcome(namespace: str, name: str, node: str,
+                 pod_uid: str = "", trace_id: str = "",
+                 error: str = "", shard: str = "") -> None:
+    """The bind verdict joining a decision record to its Binding."""
+    if _rec is None:
+        return
+    import time
+    rec = {"kind": "bind", "pod": pod_uid, "trace": trace_id,
+           "ns": namespace, "name": name, "node": node,
+           "ts": time.time(),
+           "outcome": "error" if error else "bound",
+           "error": error[:512]}
+    if shard:
+        rec["shard"] = shard
+    _rec.record(rec)
+
+
+# -- /metrics ----------------------------------------------------------------
+
+def _label(code: str) -> str:
+    return "".join(c if (c.isalnum() or c in "_-") else "_"
+                   for c in code)[:64]
+
+
+def render_metrics() -> str:
+    """The scheduler-side explain counter block; "" when the gate is
+    off so the gate-off scrape stays byte-identical."""
+    if _rec is None:
+        return ""
+    decisions, rejections, dropped = _rec.counters()
+    lines = ["# TYPE vtpu_explain_decisions_total counter",
+             f"vtpu_explain_decisions_total {decisions}",
+             "# TYPE vtpu_explain_rejections_total counter"]
+    for code in sorted(rejections):
+        lines.append(f'vtpu_explain_rejections_total'
+                     f'{{reason="{_label(code)}"}} {rejections[code]}')
+    lines.append("# TYPE vtpu_explain_ring_dropped_total counter")
+    lines.append(f"vtpu_explain_ring_dropped_total {dropped}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,375 @@
+"""The pending-pod doctor: fold decision records into one diagnosis.
+
+A Pending pod accumulates rejection records across filter passes and
+nodes; each individual record says "node-17: InsufficientMemory" and
+none of them says *why the pod is Pending*. The doctor folds the trail
+into one ranked verdict ("unschedulable: 41/48 nodes insufficient HBM,
+6 pool-mismatched, 1 pressure-penalized below winner") the way the
+pressure/headroom codecs treat their annotations: **staleness is judged
+at read time** — a trail whose latest pass is older than the doctor
+budget reads as "stale", never as a confident claim about the current
+cluster (a scheduler that stopped passing over the pod must decay to
+no-signal, exactly like a dead pressure publisher).
+
+Reads the per-process JSONL spools record.py writes. Torn lines (the
+partial-write failpoint's product, or a mid-write crash) are skipped,
+never fatal — one bad byte must not take down the audit surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from vtpu_manager.explain.record import SPOOL_SUFFIX
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import consts
+
+# a decision trail whose newest pass is older than this no longer
+# describes the current cluster: the verdict says so instead of
+# presenting old reason counts as live truth (the codec staleness rule)
+DOCTOR_MAX_AGE_S = 900.0
+
+
+# -- spool reading -----------------------------------------------------------
+
+def read_records(explain_dir: str) -> tuple[list[dict], dict[str, int]]:
+    """(records, drops-by-recorder) from every explain spool (current +
+    .prev generations). Undecodable lines are skipped — a torn spool
+    degrades to a shorter trail, never to an error. Drop counts key by
+    the meta line's (service, pid), NOT the filename, and keep the max:
+    the counter is process-cumulative and a rotated .prev generation
+    repeats it, so a filename key would double-count every rotation
+    (the vtrace reader's rule, trace/assemble.py)."""
+    records: list[dict] = []
+    drops: dict[str, int] = {}
+    if not os.path.isdir(explain_dir):
+        return records, drops
+    for fname in sorted(os.listdir(explain_dir)):
+        if not fname.endswith(SPOOL_SUFFIX):
+            continue
+        path = os.path.join(explain_dir, fname)
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.splitlines():
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue                      # torn line: skip, don't choke
+            if not isinstance(doc, dict):
+                continue
+            if doc.get("kind") == "meta":
+                key = f"{doc.get('service', '')}.{doc.get('pid', 0)}"
+                drops[key] = max(drops.get(key, 0),
+                                 int(doc.get("drops", 0) or 0))
+            else:
+                records.append(doc)
+    return records, drops
+
+
+def records_for_pod(records: list[dict], key: str) -> list[dict]:
+    """A pod's trail, oldest first. ``key`` matches the pod uid, the
+    trace id (the vtrace join), or the pod name."""
+    if not key:
+        return []
+    out = [r for r in records
+           if key in (r.get("pod"), r.get("trace"), r.get("name"))]
+    out.sort(key=lambda r: r.get("ts", 0.0))
+    return out
+
+
+def latest_decision(trail: list[dict]) -> dict | None:
+    for rec in reversed(trail):
+        if rec.get("kind") == "decision":
+            return rec
+    return None
+
+
+# -- diagnosis ---------------------------------------------------------------
+
+def diagnose(trail: list[dict], now: float | None = None,
+             max_age_s: float = DOCTOR_MAX_AGE_S) -> dict:
+    """One verdict over a pod's accumulated records. The LATEST pass is
+    the primary evidence (it describes the most recent cluster state);
+    pass count and reason persistence across passes ride along so a
+    flapping reason reads differently from a stuck one."""
+    now = time.time() if now is None else now
+    decisions = [r for r in trail if r.get("kind") == "decision"]
+    binds = [r for r in trail if r.get("kind") == "bind"]
+    preempts = [r for r in trail if r.get("kind") == "preempt"]
+    if not decisions and not binds:
+        if preempts:
+            # preempt reasoning exists but the decision records were
+            # ring-dropped or rotated away: say so — "no-records" would
+            # 404 a pod whose evidence is sitting in the spool
+            last_ts = preempts[-1].get("ts", 0.0)
+            return {"verdict": "preempt-only", "passes": 0,
+                    "last_ts": last_ts,
+                    "age_s": round(max(0.0, now - last_ts), 3),
+                    "summary": "preemption reasoning recorded but no "
+                               "filter decisions (decision records "
+                               "ring-dropped or rotated away)"}
+        return {"verdict": "no-records", "summary":
+                "no decision records for this pod on this node",
+                "passes": 0}
+    latest = decisions[-1] if decisions else None
+    last_ts = max(r.get("ts", 0.0) for r in trail)
+    age_s = max(0.0, now - last_ts)
+    out: dict = {"passes": len(decisions), "last_ts": last_ts,
+                 "age_s": round(age_s, 3)}
+    bound = any(b.get("outcome") == "bound" for b in binds)
+    last_bind = binds[-1] if binds else None
+    if latest is not None and latest.get("chosen"):
+        out["chosen"] = latest["chosen"]
+        out["margin"] = latest.get("margin")
+        if latest.get("shard"):
+            out["shard"] = latest["shard"]
+        if bound:
+            # a Binding landed: historical fact, immune to staleness
+            out["verdict"] = "bound"
+            out["summary"] = f"bound: {latest['chosen']} won" + (
+                f" by margin {latest.get('margin')}"
+                if latest.get("margin") is not None else " (only fit)")
+            return out
+        if last_bind is not None \
+                and last_bind.get("outcome") == "error" \
+                and last_bind.get("ts", 0.0) >= latest.get("ts", 0.0):
+            # the commit succeeded but the bind was REJECTED — exactly
+            # the why-is-this-pod-Pending answer a "scheduled" verdict
+            # would paper over
+            out["verdict"] = "bind-failed"
+            out["summary"] = (f"bind failed after commit to "
+                              f"{latest['chosen']}: "
+                              f"{last_bind.get('error', '')}")
+            return out
+        if age_s > max_age_s:
+            # the staleness rule applies to the confident branch too: a
+            # commit with no bind and no fresh pass is not live truth
+            out["verdict"] = "stale"
+            out["summary"] = (
+                f"no fresh decision: last pass chose "
+                f"{latest['chosen']} {age_s:.0f}s ago (budget "
+                f"{max_age_s:.0f}s) and no bind was recorded")
+            return out
+        out["verdict"] = "scheduled"
+        out["summary"] = f"scheduled: {latest['chosen']} won" + (
+            f" by margin {latest.get('margin')}"
+            if latest.get("margin") is not None else " (only fit)")
+        return out
+    if latest is None:
+        out["verdict"] = "bound" if bound else "no-records"
+        out["summary"] = "bind records only (decision spool rotated away)"
+        return out
+    # pending: rank the latest pass's rejection reasons; note which
+    # reasons persisted across EVERY recorded pass (the stuck signal)
+    counts = latest.get("reason_counts") or {}
+    persistent = {
+        code for code in counts
+        if all(code in (d.get("reason_counts") or {}) for d in decisions)}
+    examples: dict[str, str] = {}
+    for row in latest.get("rejected") or []:
+        examples.setdefault(row.get("reason", ""), row.get("node", ""))
+    ranked = [{"reason": code, "nodes": n,
+               "example": examples.get(code, ""),
+               "persistent": code in persistent}
+              for code, n in sorted(counts.items(),
+                                    key=lambda kv: -kv[1])]
+    total = sum(counts.values())
+    out["reasons"] = ranked
+    if age_s > max_age_s:
+        out["verdict"] = "stale"
+        out["summary"] = (f"no fresh decision: last pass "
+                          f"{age_s:.0f}s ago (budget {max_age_s:.0f}s) — "
+                          "scheduler stopped passing over this pod")
+        return out
+    out["verdict"] = "unschedulable"
+    parts = [f"{r['nodes']}/{total} nodes {r['reason']}" if i == 0
+             else f"{r['nodes']} {r['reason']}"
+             for i, r in enumerate(ranked)]
+    if latest.get("error") and not ranked:
+        parts = [latest["error"]]
+    out["summary"] = "unschedulable: " + ", ".join(parts)
+    if latest.get("shard"):
+        out["shard"] = latest["shard"]
+    return out
+
+
+def annotation_state(pod: dict, now: float | None = None) -> dict:
+    """The registry-channel truth about a pod's commitment — what the
+    annotations the scheduler/plugin already write say, joined into the
+    doctor verdict by the monitor's fan-in (a pod can be Pending with a
+    healthy decision trail because the BIND never landed; the spool
+    alone cannot see that)."""
+    now = time.time() if now is None else now
+    meta = pod.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    ts = consts.parse_predicate_time(anns)
+    return {
+        "predicate_node": anns.get(consts.predicate_node_annotation(), ""),
+        "predicate_age_s": round(now - ts, 3) if ts else None,
+        "allocation_status":
+            anns.get(consts.allocation_status_annotation(), ""),
+        "real_allocated":
+            bool(anns.get(consts.real_allocated_annotation())),
+        "bound": bool((pod.get("spec") or {}).get("nodeName")),
+        "fence": anns.get(consts.shard_fence_annotation(), ""),
+    }
+
+
+# -- the fan-in document (scheduler /explain + monitor /explain) -------------
+
+def collect(explain_dir: str, pod_key: str = "", shard: str = "",
+            pods: list[dict] | None = None,
+            now: float | None = None) -> dict:
+    """The /explain document. Without ``pod_key``: an index of audited
+    pods with one-line verdicts. With it: the pod's latest decision,
+    full trail length, the doctor verdict, and (when the caller fanned
+    in pod objects over the registry channel) the annotation truth."""
+    failpoints.fire("explain.rollup", dir=explain_dir)
+    now = time.time() if now is None else now
+    records, drops = read_records(explain_dir)
+    if shard:
+        # the cut keys on decision records' shard stamp; records that
+        # carry no shard (preempt reasoning, pre-HA bind rows) ride
+        # along — dropping them would strip the bind/preempt evidence
+        # out of every per-shard audit view
+        records = [r for r in records
+                   if r.get("shard", "") in ("", shard)]
+    doc: dict = {"generated_at": now,
+                 "spool_drops": sum(drops.values())}
+    if not pod_key:
+        by_pod: dict[str, list[dict]] = {}
+        for rec in records:
+            key = rec.get("pod") or rec.get("name") or ""
+            if key:
+                by_pod.setdefault(key, []).append(rec)
+        pods_out = []
+        for key in sorted(by_pod):
+            trail = sorted(by_pod[key], key=lambda r: r.get("ts", 0.0))
+            verdict = diagnose(trail, now=now)
+            pods_out.append({"pod": key,
+                             "name": trail[-1].get("name", ""),
+                             "verdict": verdict.get("verdict"),
+                             "summary": verdict.get("summary"),
+                             "passes": verdict.get("passes", 0)})
+        doc["pods"] = pods_out
+        return doc
+    trail = records_for_pod(records, pod_key)
+    doc["pod"] = pod_key
+    doc["decision"] = latest_decision(trail)
+    doc["records"] = len(trail)
+    doc["doctor"] = diagnose(trail, now=now)
+    if pods is not None:
+        for pod in pods:
+            meta = pod.get("metadata") or {}
+            if pod_key in (meta.get("uid"), meta.get("name")):
+                doc["annotations"] = annotation_state(pod, now=now)
+                break
+    return doc
+
+
+def diff_decisions(a: dict, b: dict) -> dict:
+    """Compare two decision records' breakdowns (the CLI --diff): which
+    candidates moved, which score terms moved them, and what happened to
+    the choice. ``a`` is the older record."""
+    cand_a = {c["node"]: c for c in a.get("candidates") or []}
+    cand_b = {c["node"]: c for c in b.get("candidates") or []}
+    rows = []
+    for node in sorted(set(cand_a) | set(cand_b)):
+        ca, cb = cand_a.get(node), cand_b.get(node)
+        if ca is None or cb is None:
+            rows.append({"node": node,
+                         "only_in": "b" if ca is None else "a",
+                         "total": (cb or ca).get("total")})
+            continue
+        deltas = {k: round(cb[k] - ca[k], 6)
+                  for k in ("base", "pressure", "storm", "gang_bonus",
+                            "headroom_input", "total")
+                  if isinstance(ca.get(k), (int, float))
+                  and isinstance(cb.get(k), (int, float))}
+        rows.append({"node": node, "total": [ca["total"], cb["total"]],
+                     "delta": deltas})
+    rej_a = a.get("reason_counts") or {}
+    rej_b = b.get("reason_counts") or {}
+    return {
+        "ts": [a.get("ts"), b.get("ts")],
+        "chosen": [a.get("chosen"), b.get("chosen")],
+        "margin": [a.get("margin"), b.get("margin")],
+        "candidates": rows,
+        "reason_counts_delta": {
+            code: rej_b.get(code, 0) - rej_a.get(code, 0)
+            for code in sorted(set(rej_a) | set(rej_b))
+            if rej_b.get(code, 0) != rej_a.get(code, 0)},
+    }
+
+
+# -- the shared /explain response contract -----------------------------------
+
+def explain_document(explain_dir: str, pod_key: str = "",
+                     shard: str = "", pods: list[dict] | None = None,
+                     now: float | None = None) -> tuple[int, dict]:
+    """(http_status, document) — ONE home for the /explain response
+    rule shared by the scheduler route and the monitor fan-in, so the
+    two surfaces cannot drift: an unknown pod is an explicit 404, a
+    known pod (any record kind) is 200."""
+    doc = collect(explain_dir, pod_key=pod_key, shard=shard, pods=pods,
+                  now=now)
+    status = 404 if pod_key and \
+        doc.get("doctor", {}).get("verdict") == "no-records" else 200
+    return status, doc
+
+
+# -- monitor-side spool metrics ----------------------------------------------
+
+def read_spool_drops(explain_dir: str) -> dict[str, int]:
+    """Cumulative ring-drop counts per recorder from each spool's tail
+    only. The flusher appends a meta line at every flush and the counter
+    is cumulative, so the newest meta line near the file tail carries
+    the max — a fixed-size tail read keeps this cheap enough for the
+    scrape path (read_records parses every decision line; at the 16 MiB
+    rotation bound that is scrape-hostile)."""
+    drops: dict[str, int] = {}
+    if not os.path.isdir(explain_dir):
+        return drops
+    for fname in sorted(os.listdir(explain_dir)):
+        if not fname.endswith(SPOOL_SUFFIX):
+            continue
+        path = os.path.join(explain_dir, fname)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 8192))
+                tail = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        for line in reversed(tail.splitlines()):
+            if '"meta"' not in line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue                 # torn/truncated-by-seek line
+            if not isinstance(doc, dict) or doc.get("kind") != "meta":
+                continue
+            key = f"{doc.get('service', '')}.{doc.get('pid', 0)}"
+            drops[key] = max(drops.get(key, 0),
+                             int(doc.get("drops", 0) or 0))
+            break
+    return drops
+
+
+def render_spool_metrics(explain_dir: str) -> str:
+    """The monitor's drop visibility over the node's explain spools —
+    tail-read meta lines only, mirroring
+    vtpu_trace_spool_dropped_total (drops counted, never silent)."""
+    drops = read_spool_drops(explain_dir)
+    lines = ["# TYPE vtpu_explain_spool_dropped_total counter",
+             f"vtpu_explain_spool_dropped_total {sum(drops.values())}"]
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,280 @@
+"""vtexplain decision records: ring recorder + per-pass builder.
+
+Every scheduler decision — accept, reject, preempt, bind — leaves a
+structured record answering the question the aggregate counters cannot:
+*why did this pod land on node-3 and not node-7*, with the exact score
+arithmetic applied. The recording contract mirrors the vtrace span ring
+(recorder.py), because it protects the same hot path:
+
+- :class:`DecisionBuilder` is assembled inside the filter pass (the
+  shared ``_allocate_node`` body feeds it, so the TTL and snapshot paths
+  cannot drift) — plain dict/list appends, no locks, no I/O;
+- ``ExplainRecorder.record()`` appends the finished record to a bounded
+  in-memory ring under one short ``threading.Lock`` (the span-ring
+  pattern: no I/O, no allocation storms under the lock) and at the
+  half-full threshold merely WAKES the flusher. A full ring DROPS the
+  record and counts it — backpressure never reaches a filter pass;
+- ``flush()`` (background flusher thread + atexit) snapshots the ring
+  and appends JSONL to a per-process spool under a ``FileLock``,
+  exactly the vtrace spool discipline (same rotation bound, same
+  ``meta`` drop-count lines, same ``reap_stale_spools`` applies).
+
+Record kinds on the wire:
+
+- ``decision`` — one filter pass: per-candidate score breakdown
+  (base capacity score, pressure penalty, anti-storm penalty, gang
+  bonus, observe-only headroom input), per-rejected-node structured
+  reason codes, the chosen node with its winning margin, and the HA
+  shard + fencing token the pass ran under;
+- ``preempt`` — one preempt pass: per-node kept/added/spared victims
+  with the per-victim ordering inputs (priority, estimated utilization,
+  burstiness) and which ordering was applied;
+- ``bind`` — the bind outcome joining the decision to the Binding;
+- ``meta`` — recorder self-description (pid, cumulative drops).
+
+Records are keyed by pod uid + trace id so they join vtrace timelines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import consts
+from vtpu_manager.util.flock import FileLock
+
+SPOOL_SUFFIX = ".jsonl"
+DEFAULT_CAPACITY = 256
+DEFAULT_MAX_SPOOL_BYTES = 16 * 2**20
+DEFAULT_FLUSH_INTERVAL_S = 1.0
+
+# Bounded record shape: the per-reason counts are always complete (one
+# int per distinct code), but the per-node example lists are capped so a
+# 5000-node rejection cannot produce a 5000-row record in the ring.
+MAX_CANDIDATES = 64
+MAX_REJECTED_EXAMPLES = 128
+
+
+def reason_code(why: str) -> str:
+    """The structured code for a failure string: gate reasons
+    (``NodeNoDevices``...) are already codes; allocator summaries
+    (``InsufficientCores x3 (e.g. chip-1); ...``) reduce to their
+    leading reason — the same derivation FailureReasons aggregation
+    uses, so the record and the k8s event can never disagree."""
+    return why.split(";")[0].split(" x")[0]
+
+
+class DecisionBuilder:
+    """Accumulates one filter pass's audit trail. Created only when the
+    DecisionExplain gate armed the module recorder (the off path is one
+    ``is None`` check per pass) — every touch point in the pass guards
+    on the builder, so the gate-off pass executes byte-identically."""
+
+    __slots__ = ("record",)
+
+    def __init__(self, pod: dict, mode: str, shard: str = "",
+                 token: int | None = None):
+        meta = pod.get("metadata") or {}
+        anns = meta.get("annotations") or {}
+        self.record: dict = {
+            "kind": "decision",
+            "pod": meta.get("uid", ""),
+            "trace": anns.get(consts.trace_id_annotation(), ""),
+            "ns": meta.get("namespace", "default"),
+            "name": meta.get("name", ""),
+            "ts": time.time(),
+            "mode": mode,                      # "ttl" | "snapshot" | "routing"
+            "candidates": [],
+            "rejected": [],
+            "reason_counts": {},
+            "chosen": "",
+            "margin": None,
+            "error": "",
+        }
+        if shard:
+            self.record["shard"] = shard
+            self.record["token"] = token
+
+    def set_request(self, req) -> None:
+        self.record["policy"] = req.node_policy
+        if req.gang_name:
+            self.record["gang"] = req.gang_name
+
+    def candidate(self, node: str, base: float, pressure: float,
+                  storm: float, gang_bonus: float, headroom_input: float,
+                  topology: str, total: float) -> None:
+        """One scored candidate with the EXACT values applied:
+        ``total == base - pressure - storm + gang_bonus`` holds by
+        construction (asserted end-to-end by test_explain), and
+        ``headroom_input`` is the observe-only vtuse signal that never
+        reached the total. Past the cap the record keeps the TOP
+        candidates by total (a raised FilterPredicate.candidate_limit
+        must never evict the eventual winner from its own record — the
+        reproduce-the-winner invariant), and counts what it dropped."""
+        row = {"node": node, "base": base, "pressure": pressure,
+               "storm": storm, "gang_bonus": gang_bonus,
+               "headroom_input": headroom_input,
+               "topology": topology, "total": total}
+        cands = self.record["candidates"]
+        if len(cands) < MAX_CANDIDATES:
+            cands.append(row)
+            return
+        self.record["candidates_dropped"] = \
+            self.record.get("candidates_dropped", 0) + 1
+        lowest = min(range(len(cands)), key=lambda i: cands[i]["total"])
+        if total > cands[lowest]["total"]:
+            cands[lowest] = row
+
+    def reject(self, node: str, code: str, detail: str = "") -> None:
+        counts = self.record["reason_counts"]
+        counts[code] = counts.get(code, 0) + 1
+        rejected = self.record["rejected"]
+        if len(rejected) >= MAX_REJECTED_EXAMPLES:
+            return
+        row = {"node": node, "reason": code}
+        if detail and detail != code:
+            row["detail"] = detail[:256]
+        rejected.append(row)
+
+    def chosen(self, node: str, margin: float | None) -> None:
+        self.record["chosen"] = node
+        self.record["margin"] = margin
+
+    def error(self, message: str, code: str | None = None) -> None:
+        self.record["error"] = message[:1024]
+        if code:
+            counts = self.record["reason_counts"]
+            counts[code] = counts.get(code, 0) + 1
+
+    def finish(self) -> dict:
+        return self.record
+
+
+class ExplainRecorder:
+    """Bounded ring + per-process JSONL spool for decision records —
+    the SpanRecorder discipline applied to the decision plane: record()
+    never performs I/O (a full-enough ring only wakes the flusher), all
+    spool writes run on the background flusher under the spool FileLock,
+    and a full ring drops-and-counts instead of blocking a pass."""
+
+    def __init__(self, service: str, spool_dir: str,
+                 capacity: int = DEFAULT_CAPACITY,
+                 flush_at: int | None = None,
+                 max_spool_bytes: int = DEFAULT_MAX_SPOOL_BYTES):
+        self.service = service
+        self.spool_dir = spool_dir
+        self.capacity = max(1, capacity)
+        self.max_spool_bytes = max_spool_bytes
+        self.spool_path = os.path.join(
+            spool_dir, f"{service}.{os.getpid()}{SPOOL_SUFFIX}")
+        self._lock = threading.Lock()
+        self._buf: list[dict] = []
+        self._dropped = 0
+        self._flushed_drops = -1
+        # /metrics counters, bumped at record time under the ring lock
+        # (GIL-cheap int adds): how many passes were audited, and the
+        # per-reason rejection tallies across every audited pass
+        self.decisions = 0
+        self.rejections: dict[str, int] = {}
+        self._flush_at = flush_at if flush_at is not None \
+            else max(1, self.capacity // 2)
+        self._wake = threading.Event()
+        self._stop = False
+
+    # -- hot path ------------------------------------------------------------
+
+    def record(self, rec: dict) -> bool:
+        """Append one finished record to the ring; False (and a drop
+        count) when full. Never performs I/O."""
+        with self._lock:
+            if rec.get("kind") == "decision":
+                self.decisions += 1
+                for code, n in (rec.get("reason_counts") or {}).items():
+                    self.rejections[code] = self.rejections.get(code, 0) + n
+            if len(self._buf) >= self.capacity:
+                self._dropped += 1
+                return False
+            self._buf.append(rec)
+            pending = len(self._buf)
+        if pending >= self._flush_at:
+            self._wake.set()
+        return True
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def counters(self) -> tuple[int, dict[str, int], int]:
+        """(decisions, rejections-by-reason, dropped) — one consistent
+        snapshot for /metrics rendering."""
+        with self._lock:
+            return self.decisions, dict(self.rejections), self._dropped
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    # -- spool ---------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Drain the ring to the spool; returns records written. Ring
+        lock covers only the snapshot; file I/O runs under the spool
+        flock alone (never nested)."""
+        with self._lock:
+            records = self._buf
+            self._buf = []
+            drops = self._dropped
+        if not records and drops == self._flushed_drops:
+            return 0
+        lines = [json.dumps(r, separators=(",", ":")) for r in records]
+        lines.append(json.dumps(
+            {"kind": "meta", "service": self.service, "pid": os.getpid(),
+             "drops": drops, "ts": round(time.time(), 3)},
+            separators=(",", ":")))
+        try:
+            # arm with exc=OSError (spool unavailable) or partial-write
+            # (torn spool line the doctor must skip, never choke on)
+            failpoints.fire("explain.record", path=self.spool_path)
+            os.makedirs(self.spool_dir, exist_ok=True)
+            with FileLock(f"{self.spool_path}.flock"):
+                self._rotate_if_large()
+                with open(self.spool_path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+        except OSError:
+            # spool unavailable: the records are lost — counted as drops
+            # so the loss shows in vtpu_explain_ring_dropped_total
+            with self._lock:
+                self._dropped += len(records)
+            return 0
+        self._flushed_drops = drops
+        return len(records)
+
+    def _rotate_if_large(self) -> None:
+        """Bound this process's spool at ~2x max_spool_bytes (the vtrace
+        rotation contract: one .prev generation, still read by the
+        doctor). Caller holds the spool flock."""
+        try:
+            size = os.path.getsize(self.spool_path)
+        except OSError:
+            return
+        if size < self.max_spool_bytes:
+            return
+        prev = self.spool_path[:-len(SPOOL_SUFFIX)] + f".prev{SPOOL_SUFFIX}"
+        os.replace(self.spool_path, prev)
+
+    # -- flusher thread ------------------------------------------------------
+
+    def run_flusher(self,
+                    interval_s: float = DEFAULT_FLUSH_INTERVAL_S) -> None:
+        while not self._stop:
+            self._wake.wait(interval_s)
+            self._wake.clear()
+            self.flush()
+
+    def stop_flusher(self) -> None:
+        self._stop = True
+        self._wake.set()

@@ -86,6 +86,16 @@ SITES: dict[str, str] = {
     "util.rollup": "utilization/rollup.py ClusterRollup.collect entry "
                    "(the monitor's /utilization fan-in; error/latency "
                    "must never reach the /metrics path)",
+    "explain.record": "explain/record.py ExplainRecorder.flush, before "
+                      "spool I/O (error = spool unavailable, records "
+                      "become counted drops; partial-write = a torn "
+                      "spool line the doctor must skip). Fires on the "
+                      "background flusher only — a wedged explain "
+                      "plane must never block a filter pass",
+    "explain.rollup": "explain/doctor.py collect entry (the /explain "
+                      "fan-in on scheduler and monitor; error/latency "
+                      "must hit only that route, never /metrics or a "
+                      "scheduling pass)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

@@ -12,7 +12,7 @@ import logging
 import time
 from dataclasses import dataclass
 
-from vtpu_manager import trace
+from vtpu_manager import explain, trace
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.resilience import failpoints, recovery
 from vtpu_manager.resilience.policy import RetryPolicy
@@ -63,30 +63,46 @@ class BindPredicate:
         # SerialBindNode) — holding it across the I/O is the feature.
         with self.locker.section(f"{ns}/{name}"):
             # vtlint: disable=lock-discipline — see above
-            return self._bind_locked(ns, name, node)
+            result, pod = self._bind_locked(ns, name, node)
+        if explain.is_enabled():
+            # the bind verdict closes the pod's decision trail (ring
+            # append only — the serial section is already released)
+            meta = (pod or {}).get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            explain.bind_outcome(
+                ns, name, node, pod_uid=meta.get("uid", ""),
+                trace_id=anns.get(consts.trace_id_annotation(), ""),
+                error=result.error,
+                shard=getattr(self.fence, "shard", "")
+                if self.fence is not None else "")
+        return result
 
-    def _bind_locked(self, ns: str, name: str, node: str) -> BindResult:
+    def _bind_locked(self, ns: str, name: str,
+                     node: str) -> tuple[BindResult, dict | None]:
+        """(result, fetched pod) — the pod rides back so the caller can
+        stamp the explain bind record without a second GET."""
         try:
             pod = self.policy.run(lambda: self.client.get_pod(ns, name),
                                   op="bind.get_pod")
         except KubeError as e:
-            return BindResult(error=f"pod fetch failed: {e}")
+            return BindResult(error=f"pod fetch failed: {e}"), None
         anns = (pod.get("metadata") or {}).get("annotations") or {}
 
         predicate_node = anns.get(consts.predicate_node_annotation())
         if not predicate_node:
-            return BindResult(error="pod has no vtpu pre-allocation")
+            return BindResult(error="pod has no vtpu pre-allocation"), pod
         if predicate_node != node:
             # kube-scheduler picked a different node than the filter
             # committed to; binding there would detach the claim from its
             # devices (reference :54-142 fails the bind the same way).
             return BindResult(
                 error=f"predicate node {predicate_node!r} != bind "
-                      f"target {node!r}")
+                      f"target {node!r}"), pod
 
         ts = consts.parse_predicate_time(anns)
         if ts and (time.time() - ts) > self.freshness_s:
-            return BindResult(error="pre-allocation expired; re-filter needed")
+            return BindResult(
+                error="pre-allocation expired; re-filter needed"), pod
 
         # the bind span carries the filter's commit wall time, so the
         # assembled timeline shows filter-commit -> bind queueing (the
@@ -136,7 +152,7 @@ class BindPredicate:
                     op="bind.binding")
             except LeaseLostError as e:
                 return BindResult(
-                    error=f"bind rejected at commit (lease fence): {e}")
+                    error=f"bind rejected at commit (lease fence): {e}"), pod
             except KubeError as e:
-                return BindResult(error=f"bind failed: {e}")
-            return BindResult()
+                return BindResult(error=f"bind failed: {e}"), pod
+            return BindResult(), pod

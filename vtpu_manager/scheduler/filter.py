@@ -37,7 +37,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from vtpu_manager import trace
+from vtpu_manager import explain, trace
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.device.allocator.allocator import (AllocationFailure,
                                                      allocate)
@@ -502,6 +502,15 @@ class FilterPredicate:
         ctx = trace.context_for_pod(pod)
         result = FilterResult()
         reasons = R.FailureReasons()
+        # vtexplain (DecisionExplain gate; None when off = one is-None
+        # check, pass byte-identical): the per-pass audit record. Every
+        # touch point below guards on the builder, and record() is ring-
+        # only (zero I/O) — the decision hot path never pays disk.
+        explain_b = explain.pass_builder(
+            pod, "snapshot" if snap is not None else "ttl",
+            fence=self.fence)
+        if explain_b is not None:
+            explain_b.set_request(req)
 
         if snap is not None and nodes is None:
             # unrestricted snapshot pass: no O(nodes) candidate list —
@@ -520,6 +529,8 @@ class FilterPredicate:
                 else:
                     result.failed_nodes[name] = why
                     reasons.add(why, name)
+                    if explain_b is not None:
+                        explain_b.reject(name, why)
 
         # One cluster-wide scheduled-pod list per pass (TTL-cached, see
         # _list_pods), partitioned by nodeName — not one API call per
@@ -584,27 +595,36 @@ class FilterPredicate:
         spread = req.node_policy == consts.NODE_POLICY_SPREAD
         # vtcc anti-storm (gate off => "" => zero extra work, scores
         # byte-identical): the pod's program fingerprint keys the
-        # recently-placed-same-program penalty both paths apply
+        # recently-placed-same-program penalty both paths apply; the
+        # uid keeps a re-filtered committed pod from repelling itself
+        # through the unbound-commitment scan
         pod_fp = antistorm.pod_fingerprint(pod) if self.anti_storm else ""
+        pod_uid = (pod.get("metadata") or {}).get("uid", "")
         if snap is not None:
             # walk the snapshot's incrementally maintained capacity rank
             # — no per-pass O(nodes) ranking, no decode
             scored = self._snapshot_scored(
                 snap, req, candidates, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
-                reasons, now, pod_fp=pod_fp)
+                reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
+                explain_b=explain_b)
         else:
             scored = self._ttl_scored(
                 req, candidates, by_node, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
-                reasons, now, pod_fp=pod_fp)
+                reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
+                explain_b=explain_b)
 
         if not scored:
             result.error = reasons.summary() or "no schedulable vtpu node"
+            if explain_b is not None:
+                explain_b.error(result.error)
+                explain.submit(explain_b)
             self._emit_rejection_event(pod, result.error)
             return result
 
-        best = order_nodes(scored)[0]
+        ordered = order_nodes(scored)
+        best = ordered[0]
         try:
             self._commit(pod, req, best)
         except LeaseLostError as e:
@@ -613,8 +633,16 @@ class FilterPredicate:
             # a commitment another leader could race
             result.node_names = []
             result.error = f"shard lease lost before commit: {e}"
+            if explain_b is not None:
+                explain_b.error(result.error, code=R.POD_LEASE_LOST)
+                explain.submit(explain_b)
             return result
         result.node_names = [best.name]
+        if explain_b is not None:
+            explain_b.chosen(best.name,
+                             best.score - ordered[1].score
+                             if len(ordered) > 1 else None)
+            explain.submit(explain_b)
         if self.utilization_hint:
             self._observe_headroom(pod, best.name,
                                    candidates if snap is None else None,
@@ -660,21 +688,28 @@ class FilterPredicate:
                     by_node: dict, assumed_by_node: dict, spread: bool,
                     gang_domains: set, gang_siblings: list,
                     prefer_origin, result: FilterResult, reasons,
-                    now: float, pod_fp: str = "") -> list[ScoredNode]:
+                    now: float, pod_fp: str = "", pod_uid: str = "",
+                    explain_b=None) -> list[ScoredNode]:
         """TTL-path ranking: gate + rank every surviving node on fast
         free totals (memoized registry totals minus claim sums — no
         DeviceUsage materialized), then build the full usage view lazily,
         only for nodes the allocator actually visits."""
         ranked = []
         reg_ann = consts.node_device_register_annotation()
+        hr_ann = consts.node_reclaimable_headroom_annotation()
         now_visible: set[str] = set()
         req_number, req_cores, req_memory = (
             req.total_number(), req.total_cores(), req.total_memory())
         # anti-storm signal sources, collected only for fingerprinted
         # pods: resident pods' stamped annotations (one dict-get per
-        # resident, alongside the claims walk this loop already does)
-        # plus this process's own recent commits
+        # resident, alongside the claims walk this loop already does),
+        # this process's own recent commits, AND committed-but-unbound
+        # pods from the cluster list — the wave an independent scheduler
+        # just placed, invisible to the nodeName-keyed resident scan
+        # (the snapshot path reads the same signal from its index)
         fp_overlay = self._recent_fp_overlay(now) if pod_fp else {}
+        unbound_fp = (self._unbound_committed_fp(now, exclude_uid=pod_uid)
+                      if pod_fp else {})
         for node in candidates:
             meta = node.get("metadata") or {}
             name = meta.get("name", "")
@@ -683,6 +718,8 @@ class FilterPredicate:
             if registry is None:
                 result.failed_nodes[name] = R.NODE_NO_DEVICES
                 reasons.add(R.NODE_NO_DEVICES, name)
+                if explain_b is not None:
+                    explain_b.reject(name, R.NODE_NO_DEVICES)
                 continue
             resident = by_node.get(name, [])
             counted = dt.counted_claims(resident, now=now)
@@ -704,6 +741,8 @@ class FilterPredicate:
                     or free_memory < req_memory):
                 result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
+                if explain_b is not None:
+                    explain_b.reject(name, R.NODE_INSUFFICIENT_CAPACITY)
                 continue
             pressure = tel_pressure.parse_pressure(
                 (meta.get("annotations") or {}).get(
@@ -711,12 +750,22 @@ class FilterPredicate:
             storm = (self._storm_for_node(
                 name, fp_overlay,
                 {(p.get("metadata") or {}).get("uid", "")
-                 for p in resident} if fp_overlay.get(name) else (),
-                antistorm.recent_from_pods(resident, now))
+                 for p in resident}
+                if (fp_overlay.get(name) or unbound_fp.get(name))
+                else (),
+                antistorm.recent_from_pods(resident, now),
+                unbound=unbound_fp.get(name, ()))
                 if pod_fp else ())
+            # headroom rides RAW here (one dict-get) and decodes only
+            # for nodes the allocation loop actually visits — parsing
+            # per ranked node would decode ~cluster-size annotations per
+            # pass to record at most candidate_limit of them. Audit-only
+            # (observe, never scored); the gate-off pass carries None.
+            hr_raw = ((meta.get("annotations") or {}).get(hr_ann)
+                      if explain_b is not None else None)
             ranked.append((free_cores + (free_memory >> 24) + free_number,
                            name, registry, counted, assumed, pressure,
-                           storm))
+                           storm, hr_raw))
         if now_visible:
             self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
@@ -734,14 +783,17 @@ class FilterPredicate:
         # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
         for rank, (_, name, registry, counted, assumed, pressure,
-                   storm) in enumerate(ranked):
+                   storm, hr_raw) in enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
             self._allocate_node(name, registry, counted, assumed, req,
                                 prefer_origin, gang_siblings,
                                 gang_domains, scored, result, reasons,
                                 pressure=pressure, storm_fp=pod_fp,
-                                storm_recent=storm)
+                                storm_recent=storm,
+                                headroom=util_headroom.parse_headroom(
+                                    hr_raw) if hr_raw else None,
+                                explain_b=explain_b)
         return scored
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
@@ -749,7 +801,8 @@ class FilterPredicate:
                          spread: bool, gang_domains: set,
                          gang_siblings: list, prefer_origin,
                          result: FilterResult, reasons,
-                         now: float, pod_fp: str = "") -> list[ScoredNode]:
+                         now: float, pod_fp: str = "", pod_uid: str = "",
+                         explain_b=None) -> list[ScoredNode]:
         """Snapshot-path candidate walk. The capacity rank is maintained
         by the snapshot O(log n) per event, so the pass walks its head in
         policy order (ascending for binpack, descending for spread) and
@@ -799,6 +852,8 @@ class FilterPredicate:
                 if why is not None:
                     result.failed_nodes[name] = why
                     reasons.add(why, name)
+                    if explain_b is not None:
+                        explain_b.reject(name, why)
                     return
             if entry.conditional and any(now > c[2]
                                          for c in entry.conditional):
@@ -816,11 +871,14 @@ class FilterPredicate:
                     or free[2] < req_memory):
                 result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
+                if explain_b is not None:
+                    explain_b.reject(name, R.NODE_INSUFFICIENT_CAPACITY)
                 return
             visited += 1
-            storm = (self._storm_for_node(name, fp_overlay,
-                                          entry.resident,
-                                          entry.fp_recent)
+            storm = (self._storm_for_node(
+                name, fp_overlay, entry.resident, entry.fp_recent,
+                unbound=tuple(e for e in snap.unbound_fp(name)
+                              if e[0] != pod_uid))
                      if pod_fp else ())
             self._allocate_node(name, entry.registry,
                                 snap_mod.entry_counted(entry, now),
@@ -828,7 +886,10 @@ class FilterPredicate:
                                 gang_siblings, gang_domains, scored,
                                 result, reasons,
                                 pressure=entry.pressure, storm_fp=pod_fp,
-                                storm_recent=storm)
+                                storm_recent=storm,
+                                headroom=entry.headroom
+                                if explain_b is not None else None,
+                                explain_b=explain_b)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -865,10 +926,14 @@ class FilterPredicate:
                        gang_domains: set, scored: list,
                        result: FilterResult, reasons,
                        pressure=None, storm_fp: str = "",
-                       storm_recent=()) -> None:
+                       storm_recent=(), headroom=None,
+                       explain_b=None) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
-        drift between them."""
+        drift between them (and so the vtexplain breakdown is assembled
+        HERE, where the actual score arithmetic runs: the record carries
+        the exact values applied, not a re-derivation that could
+        diverge)."""
         # the gate already decoded/filtered everything this needs —
         # build the usage view from its outputs, never recompute
         info = NodeInfo.from_registry(name, registry, counted)
@@ -888,26 +953,48 @@ class FilterPredicate:
         except AllocationFailure as f:
             why = f.reasons.summary() or "allocation failed"
             result.failed_nodes[name] = why
-            reasons.add(why.split(";")[0].split(" x")[0], name)
+            # ONE derivation (explain.reason_code) feeds both the event
+            # aggregation and the audit record — they cannot disagree
+            code = explain.reason_code(why)
+            reasons.add(code, name)
+            if explain_b is not None:
+                explain_b.reject(name, code, detail=why)
             return
-        score = node_score(alloc_result, req)
+        base = node_score(alloc_result, req)
+        score = base
         # vttel soft hint: tenants on this node are stalling in the
         # throttle — prefer an equal node whose tenants aren't. A
         # PENALTY only: pressure can reorder fits, never veto one (a
         # pressured node with the only free chips still schedules).
-        score -= tel_pressure.pressure_penalty(pressure)
+        pressure_pen = tel_pressure.pressure_penalty(pressure)
+        score -= pressure_pen
         # vtcc anti-storm: same soft-only contract as pressure —
         # recently-placed same-fingerprint pods repel the next replica
         # so compile storms spread, but a storm-heavy node with the
         # only free chips still schedules (runs after the capacity
         # gate; subtracts, never vetoes)
+        storm_pen = 0.0
         if storm_fp:
-            score -= antistorm.storm_penalty(storm_fp, storm_recent)
+            storm_pen = antistorm.storm_penalty(storm_fp, storm_recent)
+            score -= storm_pen
+        gang_bonus = 0.0
         if gang_domains and registry.mesh_domain in gang_domains:
             # keeping the gang on one multi-host slice outweighs any
             # per-node topology/packing difference: a member placed
             # off-slice pays DCN for every gang collective
-            score += 100.0
+            gang_bonus = 100.0
+            score += gang_bonus
+        if explain_b is not None:
+            # the audit record gets the exact terms just applied, plus
+            # the observe-only headroom input that was NOT applied —
+            # total == base - pressure - storm + gang_bonus holds by
+            # construction and is asserted end-to-end by test_explain
+            explain_b.candidate(
+                name, base=base, pressure=pressure_pen, storm=storm_pen,
+                gang_bonus=gang_bonus,
+                headroom_input=util_headroom.headroom_score_input(
+                    headroom),
+                topology=alloc_result.topology_kind, total=score)
         scored.append(ScoredNode(name, score, alloc_result))
 
     # -- commit: annotation patch is the only cross-process channel ---------
@@ -974,21 +1061,55 @@ class FilterPredicate:
         return out
 
     def _storm_for_node(self, name: str, fp_overlay: dict,
-                        resident_uids, annotation_recent) -> list:
+                        resident_uids, annotation_recent,
+                        unbound=()) -> list:
         """Per-node (fingerprint, ts) storm signal: resident pods'
-        stamped annotations plus the in-process overlay MINUS overlay
-        entries whose pod is now visible among the residents — a
-        visible pod contributes through its annotation, and keeping its
-        overlay twin would double the penalty (same retirement rule as
-        the assumed cache)."""
+        stamped annotations, committed-but-unbound pods from the
+        cluster view (``unbound``: (uid, fp, ts) triples — another
+        scheduler's in-flight placements), plus the in-process overlay
+        MINUS overlay entries whose pod is now visible among residents
+        OR the unbound set — a visible pod contributes through its
+        annotation, and keeping its overlay twin would double the
+        penalty (same retirement rule as the assumed cache)."""
         overlay = fp_overlay.get(name, [])
         if overlay:
-            retired = [e[0] for e in overlay if e[0] in resident_uids]
+            visible = set(resident_uids)
+            visible.update(u for u, _f, _t in unbound)
+            retired = [e[0] for e in overlay if e[0] in visible]
             if retired:
                 overlay = [e for e in overlay
-                           if e[0] not in resident_uids]
+                           if e[0] not in visible]
                 self._drop_recent_fp(name, retired)
-        return list(annotation_recent) + [(f, t) for _u, f, t in overlay]
+        return (list(annotation_recent)
+                + [(f, t) for _u, f, t in unbound]
+                + [(f, t) for _u, f, t in overlay])
+
+    def _unbound_committed_fp(self, now: float,
+                              exclude_uid: str = "") -> dict:
+        """vtcc TTL-path follow-up: committed-but-unbound fingerprints
+        from the full pod list (TTL-cached like the gang path — one
+        cluster LIST per snapshot window, not per candidate), so
+        independent non-HA scheduler processes repel each other's
+        in-flight placements. Snapshot mode reads the same signal from
+        the ClusterSnapshot's incrementally maintained index instead."""
+        try:
+            pods = self._list_all_pods()
+        except KubeError as e:
+            # soft signal: a throttled LIST degrades to no storm data
+            # for this pass, never to a failed pass
+            log.warning("unbound-commitment scan failed (%s); anti-storm "
+                        "runs on resident signals only this pass", e)
+            return {}
+        out = antistorm.unbound_recent_from_pods(pods, now)
+        if exclude_uid:
+            # a re-filtered committed pod must not repel itself
+            for node in list(out):
+                kept = [e for e in out[node] if e[0] != exclude_uid]
+                if kept:
+                    out[node] = kept
+                else:
+                    del out[node]
+        return out
 
     def _drop_recent_fp(self, node: str, uids) -> None:
         with self._assumed_lock:

@@ -27,13 +27,16 @@ import logging
 import time
 from dataclasses import dataclass, field
 
-from vtpu_manager import trace
+from vtpu_manager import explain, trace
 from vtpu_manager.client.kube import KubeClient
 from vtpu_manager.device.allocator.allocator import (AllocationFailure,
                                                      allocate)
 from vtpu_manager.device.allocator.request import (RequestError,
                                                    build_allocation_request)
 from vtpu_manager.device.types import NodeInfo, get_pod_device_claims
+from vtpu_manager.telemetry import pressure as tel_pressure
+from vtpu_manager.util import consts
+from vtpu_manager.utilization import headroom as hr_mod
 
 log = logging.getLogger(__name__)
 
@@ -99,13 +102,28 @@ def _label_selector_matches(selector: dict | None, labels: dict) -> bool:
 
 
 class PreemptPredicate:
-    def __init__(self, client: KubeClient, snapshot=None):
+    def __init__(self, client: KubeClient, snapshot=None,
+                 victim_order_hint: bool = False):
         self.client = client
         # SchedulerSnapshot gate: node objects and resident pods come
         # from the watch-driven snapshot instead of per-node GET/LIST
         # round-trips (the validate loop was 2 API calls per candidate
         # node); None = legacy client path.
         self._snapshot = snapshot
+        # vtexplain satellite (DecisionExplain gate; default off =
+        # victim choice byte-identical to the pre-explain tree): among
+        # otherwise-equal extra victims, prefer LOW-utilization /
+        # HIGH-burstiness tenants — idle quota is cheap to evict, and a
+        # spiky tenant's quota is exactly what the headroom accounting
+        # refuses to call reclaimable, so eviction is the only way to
+        # free it. Inputs come from the vtuse reclaimable-headroom
+        # annotation (per-chip used/alloc, apportioned to the victim by
+        # quota share) and the vttel node pressure; the ordering applied
+        # and every per-victim input land in the preempt decision record
+        # so the choice is auditable. Priority stays the PRIMARY key —
+        # the hint only orders within a priority class, and a stale/
+        # absent headroom rollup degrades to the old priority-only sort.
+        self.victim_order_hint = victim_order_hint
         # (preemptor uid, individual group) -> monotonic time of last
         # warning (per-group, NOT per-victim-set: retry loops vary the
         # set per cycle — ADVICE r4)
@@ -151,12 +169,19 @@ class PreemptPredicate:
         # one list per namespace; None = lister failed for that namespace
         pdb_cache: dict[str, list[dict] | None] = {}
         victim_pods: list[dict] = []
+        # vtexplain: per-node victim reasoning collected only when the
+        # gate armed the recorder (None = zero extra work)
+        victim_logs: dict[str, dict] | None = \
+            {} if explain.is_enabled() else None
         for node_name, proposal in victims_in.items():
+            vlog: dict | None = {} if victim_logs is not None else None
             proposed = self._proposal_pods(node_name, proposal, meta_only)
             kept = self._validate_node(
                 node_name, req, proposed,
                 original_pdb=self._proposal_pdb_count(proposal),
-                pdb_cache=pdb_cache)
+                pdb_cache=pdb_cache, victim_log=vlog)
+            if victim_logs is not None and vlog:
+                victim_logs[node_name] = vlog
             if kept is not None:
                 result.node_to_victims[node_name] = kept
                 victim_pods += kept.pods
@@ -164,6 +189,19 @@ class PreemptPredicate:
             result.error = "no node becomes schedulable by preemption"
         else:
             self._warn_disrupted_gangs(pod, victim_pods)
+        if victim_logs is not None:
+            meta = pod.get("metadata") or {}
+            anns = meta.get("annotations") or {}
+            explain.record_raw({
+                "kind": "preempt",
+                "pod": meta.get("uid", ""),
+                "trace": anns.get(consts.trace_id_annotation(), ""),
+                "ns": meta.get("namespace", "default"),
+                "name": meta.get("name", ""),
+                "ts": time.time(),
+                "nodes": victim_logs,
+                "error": result.error,
+            })
         return result
 
     def _warn_disrupted_gangs(self, preemptor: dict,
@@ -332,9 +370,80 @@ class PreemptPredicate:
                 count += 1
         return count
 
+    def _node_signals(self, node_name: str, node: dict):
+        """(NodeHeadroom | None, NodePressure | None) for one candidate
+        node — snapshot entries carry both pre-decoded; the TTL path
+        parses the annotations of the node object it already fetched.
+        Called only when the victim hint or explain recording is armed,
+        so the gate-off preempt pass does zero extra work."""
+        if self._snapshot is not None:
+            entry = self._snapshot.entry(node_name)
+            if entry is None:
+                return None, None
+            return entry.headroom, entry.pressure
+        anns = (node.get("metadata") or {}).get("annotations") or {}
+        return (hr_mod.parse_headroom(
+                    anns.get(consts.node_reclaimable_headroom_annotation())),
+                tel_pressure.parse_pressure(
+                    anns.get(consts.node_pressure_annotation())))
+
+    @staticmethod
+    def _victim_inputs(pod: dict, headroom) -> dict:
+        """The per-victim ordering inputs, recorded verbatim in the
+        preempt decision record. Estimated utilization = the chip's
+        measured used % apportioned to this victim by its quota share
+        of the chip's allocation (the vtuse ledger's own fallback
+        apportioning rule); burstiness = the chip's headroom discount
+        (alloc - used - reclaimable), the part of the idle quota the
+        ledger refused to call reclaimable, likewise apportioned."""
+        meta = pod.get("metadata") or {}
+        claims = get_pod_device_claims(pod)
+        row: dict = {"uid": meta.get("uid", ""),
+                     "name": meta.get("name", ""),
+                     "priority": _pod_priority(pod),
+                     "est_used_core_pct": None,
+                     "burst_core_pct": None}
+        if claims is None:
+            return row
+        alloc = 0.0
+        used = burst = 0.0
+        matched = 0
+        for claim in claims.all_claims():
+            alloc += claim.cores
+            ch = (headroom.chips.get(claim.host_index)
+                  if headroom is not None else None)
+            if ch is None or ch.alloc_core_pct <= 0:
+                continue
+            matched += 1
+            share = claim.cores / ch.alloc_core_pct
+            used += ch.used_core_pct * share
+            burst += max(0.0, ch.alloc_core_pct - ch.used_core_pct
+                         - ch.reclaim_core_pct) * share
+        row["alloc_core_pct"] = alloc
+        if matched:
+            row["est_used_core_pct"] = round(used, 2)
+            row["burst_core_pct"] = round(burst, 2)
+        return row
+
+    def _victim_order_key(self, pod: dict, headroom) -> tuple:
+        """Extra-victim ordering under the hint: priority first (the
+        unchanged primary), then measured-idle tenants before busy
+        ones, spikier before smoother among equals, uid for
+        determinism. Victims without a chip-level signal sort after
+        measured ones in their priority class — "prefer low-utilization"
+        requires evidence of low utilization."""
+        row = self._victim_inputs(pod, headroom)
+        est = row["est_used_core_pct"]
+        burst = row["burst_core_pct"]
+        return (row["priority"],
+                est if est is not None else float("inf"),
+                -(burst if burst is not None else 0.0),
+                row["uid"])
+
     def _validate_node(self, node_name: str, req, proposed: list[dict],
                        original_pdb: int = 0,
-                       pdb_cache: dict[str, list[dict] | None] | None = None
+                       pdb_cache: dict[str, list[dict] | None] | None = None,
+                       victim_log: dict | None = None
                        ) -> NodeVictims | None:
         if pdb_cache is None:
             pdb_cache = {}
@@ -358,6 +467,20 @@ class PreemptPredicate:
                             "from the victim map: %s", node_name, e)
                 return None
         resident = self._resident_pods(node_name)
+        # victim-ordering inputs: fetched only when the hint or the
+        # audit record needs them (gate off = zero extra work), and the
+        # cached headroom's freshness is re-judged at use time — a dead
+        # publisher degrades the ordering to priority-only, never to an
+        # ordering justified by stale utilization claims
+        headroom = pressure = None
+        if self.victim_order_hint or victim_log is not None:
+            headroom, pressure = self._node_signals(node_name, node)
+        hr_fresh = hr_mod.headroom_is_fresh(headroom)
+        ordering = ("utilization"
+                    if self.victim_order_hint and hr_fresh
+                    else "priority")
+        added_uids: list[str] = []
+        spared: list[dict] = []
 
         def fits(victim_uids: set[str]) -> bool:
             info = NodeInfo.build(
@@ -378,20 +501,32 @@ class PreemptPredicate:
             # proposed set insufficient: add vtpu-holding pods, lowest
             # priority first, until the pod fits or we run out. Pods whose
             # PDB has no disruptions left are never added by US (the
-            # in-tree proposal may still contain them).
-            extras = sorted(
-                (p for p in resident
-                 if _pod_uid(p) not in victims
-                 and get_pod_device_claims(p) is not None
-                 and not self._violates_pdb(p, pdb_cache)),
-                key=_pod_priority)
+            # in-tree proposal may still contain them). Under the
+            # victim-order hint (DecisionExplain gate) with a FRESH
+            # headroom rollup, equal-priority extras order by measured
+            # utilization instead of list order.
+            pool = (p for p in resident
+                    if _pod_uid(p) not in victims
+                    and get_pod_device_claims(p) is not None
+                    and not self._violates_pdb(p, pdb_cache))
+            if ordering == "utilization":
+                extras = sorted(
+                    pool, key=lambda p: self._victim_order_key(p,
+                                                               headroom))
+            else:
+                extras = sorted(pool, key=_pod_priority)
             ok = False
             for extra in extras:
                 victims[_pod_uid(extra)] = extra
+                added_uids.append(_pod_uid(extra))
                 if fits(set(victims)):
                     ok = True
                     break
             if not ok:
+                if victim_log is not None:
+                    victim_log.update(
+                        result="dropped", ordering=ordering,
+                        considered=len(extras) + len(proposed))
                 return None
 
         # minimize: a victim whose claims are not needed is spared
@@ -404,6 +539,7 @@ class PreemptPredicate:
                 # it for other resources; keep it
                 continue
             if fits(set(victims) - {uid}):
+                spared.append(victim)
                 del victims[uid]
         final = [victims[uid] for uid in sorted(victims)]
         exact = self._count_pdb_violations(final, pdb_cache)
@@ -413,4 +549,21 @@ class PreemptPredicate:
             added = len(final) - kept_from_input
             exact = pdb_violations_upper_bound(
                 original_pdb, kept_from_input, added)
+        if victim_log is not None:
+            added_set = set(added_uids)
+
+            def row(pod: dict, role: str) -> dict:
+                return dict(self._victim_inputs(pod, headroom), role=role)
+
+            victim_log.update(
+                result="kept", ordering=ordering,
+                headroom_fresh=hr_fresh,
+                pressure_frac=pressure.throttle_frac
+                if pressure is not None else None,
+                pdb_violations=exact,
+                victims=[row(p, "added"
+                             if _pod_uid(p) in added_set
+                             and _pod_uid(p) not in proposed_uids
+                             else "kept") for p in final],
+                spared=[row(p, "spared") for p in spared])
         return NodeVictims(pods=final, num_pdb_violations=exact)

@@ -26,6 +26,11 @@ NODE_TOPOLOGY_UNSATISFIED = "TopologyUnsatisfied"
 NODE_GANG_UNALIGNED = "GangUnaligned"
 NODE_OUTSIDE_SHARD = "NodeOutsideShard"
 
+# Pod-level reasons (vtexplain decision records: rejections that hit the
+# whole pass, not one node)
+POD_SHARD_NOT_LED = "ShardNotLed"
+POD_LEASE_LOST = "LeaseLost"
+
 
 @dataclass
 class FailureReasons:

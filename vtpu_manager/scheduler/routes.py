@@ -28,11 +28,22 @@ class SchedulerAPI:
     def __init__(self, filter_pred: FilterPredicate, bind_pred: BindPredicate,
                  preempt_pred: PreemptPredicate,
                  debug_endpoints: bool = False,
-                 snapshot=None, ha=None):
+                 snapshot=None, ha=None,
+                 explain_dir: str | None = None,
+                 explain_token_file: str | None = None):
         self.filter_pred = filter_pred
         self.bind_pred = bind_pred
         self.preempt_pred = preempt_pred
         self.debug_endpoints = debug_endpoints
+        # vtexplain (DecisionExplain gate): when set, GET /explain serves
+        # the per-pod decision audit (latest breakdown + the pending-pod
+        # doctor verdict, ?shard= cut under vtha). Gate off = no route
+        # at all (404), matching the zero-new-surfaces contract.
+        # Decisions name pods/namespaces, so the route is bearer-auth
+        # gated when a token file is configured (the monitor's /metrics
+        # convention — mounted secret, re-read per request).
+        self.explain_dir = explain_dir
+        self.explain_token_file = explain_token_file
         # SchedulerSnapshot gate: exported on /metrics when present
         self.snapshot = snapshot
         # SchedulerHA gate: the ShardedScheduler (the three predicates
@@ -51,6 +62,8 @@ class SchedulerAPI:
         app.router.add_get("/readyz", self.handle_healthz)
         app.router.add_get("/version", self.handle_version)
         app.router.add_get("/metrics", self.handle_metrics)
+        if self.explain_dir:
+            app.router.add_get("/explain", self.handle_explain)
         if self.debug_endpoints:
             # stack traces disclose internals; opt-in only
             from vtpu_manager.util.debug import aiohttp_stacks_handler
@@ -101,6 +114,53 @@ class SchedulerAPI:
 
     async def handle_healthz(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")
+
+    def _explain_authorized(self, request: web.Request) -> bool:
+        if not self.explain_token_file:
+            return True
+        import hmac
+        try:
+            # re-read per request: kubernetes rotates mounted secrets in
+            # place (the monitor's /metrics auth convention)
+            with open(self.explain_token_file) as f:
+                token = f.read().strip()
+        except OSError:
+            return False
+        if not token:
+            return False
+        return hmac.compare_digest(
+            request.headers.get("Authorization", ""), f"Bearer {token}")
+
+    async def handle_explain(self, request: web.Request) -> web.Response:
+        """Per-pod decision audit: the latest breakdown + the doctor
+        verdict (?pod= by uid / trace id / name; ?shard= cuts the trail
+        to one vtha shard; no ?pod= lists audited pods). The spool read
+        runs in an executor thread — a slow disk (or an injected
+        explain.rollup fault) stalls only this route, never the event
+        loop serving filter/bind/preempt."""
+        if not self._explain_authorized(request):
+            return web.json_response({"error": "unauthorized"}, status=401)
+        from vtpu_manager import explain as explain_mod
+        from vtpu_manager.explain import doctor
+        pod = request.query.get("pod", "")
+        shard = request.query.get("shard", "")
+
+        def collect():
+            # flush the in-process ring first so the route serves the
+            # pass that JUST committed, not the one before the flusher's
+            # last tick (this is the recorder's own process)
+            explain_mod.flush()
+            return doctor.explain_document(self.explain_dir,
+                                           pod_key=pod, shard=shard)
+        try:
+            status, doc = await asyncio.get_running_loop() \
+                .run_in_executor(None, collect)
+        except Exception as e:  # noqa: BLE001 — a wedged audit plane
+            # serves an explicit error, never a hang or a half-truth
+            log.warning("explain rollup failed: %s", e)
+            return web.json_response(
+                {"error": f"explain rollup failed: {e}"}, status=503)
+        return web.json_response(doc, status=status)
 
     async def handle_version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": VERSION,
@@ -157,6 +217,14 @@ class SchedulerAPI:
             lines.append(
                 f"vtpu_scheduler_headroom_observed_total "
                 f"{sum(p.headroom_observed for p in armed)}")
+        # vtexplain counters (DecisionExplain gate; "" when off so the
+        # gate-off scrape stays byte-identical): audited passes,
+        # per-reason rejection tallies, and ring drops — the drop
+        # counter is the "records lost, not silent" contract
+        from vtpu_manager import explain as explain_mod
+        explain_block = explain_mod.render_metrics()
+        if explain_block:
+            lines.append(explain_block.rstrip("\n"))
         # retry/breaker counters + failpoint fires (vtfault): how often
         # this process leaned on the resilience layer, and what the
         # FaultInjection gate injected (zero in production)

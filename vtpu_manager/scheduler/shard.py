@@ -42,6 +42,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from vtpu_manager import explain
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.config.vmem import fnv64
 from vtpu_manager.resilience import failpoints, recovery
@@ -192,6 +193,7 @@ class ShardedScheduler:
                  lease_namespace: str = lease_mod.DEFAULT_LEASE_NAMESPACE,
                  use_snapshot: bool = False,
                  filter_kwargs: dict | None = None,
+                 preempt_kwargs: dict | None = None,
                  policy_factory=None, snapshot_factory=None,
                  bind_locker=None,
                  monotonic=time.monotonic, wall=time.time):
@@ -203,6 +205,9 @@ class ShardedScheduler:
         self._thread: threading.Thread | None = None
         make_policy = policy_factory or (lambda: None)
         filter_kwargs = dict(filter_kwargs or {})
+        # preempt_kwargs rides exactly like filter_kwargs so the
+        # vtexplain victim-order hint reaches every shard's predicate
+        preempt_kwargs = dict(preempt_kwargs or {})
         self.units: list[ShardUnit] = []
         for spec in plan.shards:
             lease = ShardLease(client, spec.name, holder,
@@ -234,7 +239,8 @@ class ShardedScheduler:
             bind_pred = BindPredicate(client, locker=bind_locker,
                                       fence=lease,
                                       policy=make_policy())
-            preempt_pred = PreemptPredicate(client, snapshot=snapshot)
+            preempt_pred = PreemptPredicate(client, snapshot=snapshot,
+                                            **preempt_kwargs)
             self.units.append(ShardUnit(spec, lease, snapshot,
                                         filter_pred, bind_pred,
                                         preempt_pred))
@@ -435,6 +441,10 @@ class ShardedScheduler:
         why = self._serving(unit)
         if why is not None:
             unit.fence_rejections += 1
+            # vtexplain: a pod bouncing off a non-led shard must
+            # diagnose as ShardNotLed, not as silence (no-op when the
+            # DecisionExplain gate is off)
+            explain.routing_rejection(pod, unit.spec.name, why)
             return FilterResult(error=why)
         return unit.filter_pred.filter(args)
 

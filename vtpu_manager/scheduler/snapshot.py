@@ -251,6 +251,18 @@ class ClusterSnapshot:
         self._pod_gang: dict[str, tuple | None] = {}  # uid -> (ns, gang)
         self._gangs: dict[tuple, dict[str, dict]] = {}
         self._node_pod_uids: dict[str, set[str]] = {}
+        # vtcc anti-storm over committed-but-unbound pods (the TTL path
+        # reads the same signal via antistorm.unbound_recent_from_pods):
+        # node -> ((uid, fingerprint, commit_ts), ...) for pods carrying
+        # the predicate-node + program-fingerprint stamps but no
+        # nodeName yet. Per-node tuples are copy-on-write so a pass
+        # reads them lock-free; entries retire when the pod binds
+        # (nodeName arrives as MODIFIED and the resident scan takes
+        # over), is reaped, or is deleted — and storm_penalty re-judges
+        # the window at use time, so a stale entry decays to zero even
+        # between events.
+        self._pod_unbound: dict[str, str] = {}        # uid -> node
+        self._unbound_fp_nodes: dict[str, tuple] = {}
         # incrementally maintained capacity rank: ascending (rank_key,
         # name) for every node with a decoded registry. The filter's
         # TTL path sorts all nodes per pass (O(n log n) per decision);
@@ -526,6 +538,7 @@ class ClusterSnapshot:
                 self._pods.pop(uid, None)
                 self._pod_class.pop(uid, None)
                 self._unlink_gang_locked(uid)
+                self._set_unbound_fp_locked(uid, None)
                 old_node = self._pod_node.pop(uid, "")
                 if old_node:
                     self._node_pod_uids.get(old_node, set()).discard(uid)
@@ -535,12 +548,14 @@ class ClusterSnapshot:
         cls = _classify_pod(pod, self.stuck_grace_s, self.stats)
         node_name = (pod.get("spec") or {}).get("nodeName") or ""
         gang_key = self._gang_key(pod)
+        unbound_fp = self._classify_unbound_fp(pod)
         with self._lock:
             self.generation += 1
             self._all_pods_cache = None
             self._pods[uid] = pod
             self._pod_class[uid] = cls
             self._relink_gang_locked(uid, gang_key, pod)
+            self._set_unbound_fp_locked(uid, unbound_fp)
             old_node = self._pod_node.get(uid, "")
             self._pod_node[uid] = node_name
             if old_node and old_node != node_name:
@@ -549,6 +564,61 @@ class ClusterSnapshot:
             if node_name:
                 self._node_pod_uids.setdefault(node_name, set()).add(uid)
                 self._refresh_entry_locked(node_name)
+
+    @staticmethod
+    def _classify_unbound_fp(pod: dict) -> tuple[str, str, float] | None:
+        """(predicate_node, fingerprint, commit_ts) when the pod is a
+        committed-but-unbound anti-storm signal source, else None. Runs
+        outside the lock (annotation parses). Entries older than the
+        storm window at ingest are skipped; ones ingested fresh are
+        retired by the events that end the unbound state, and
+        storm_penalty ignores the expired tail at use time."""
+        if (pod.get("spec") or {}).get("nodeName"):
+            return None
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        node = anns.get(consts.predicate_node_annotation())
+        if not node:
+            return None
+        raw = anns.get(consts.program_fingerprint_annotation())
+        if not raw:
+            return None
+        ts = consts.parse_predicate_time(anns)
+        if ts is None or not 0 <= time.time() - ts \
+                <= antistorm.STORM_WINDOW_S:
+            return None
+        fp = antistorm.sanitize_fingerprint(raw)
+        if not fp:
+            return None
+        return node, fp, ts
+
+    def _set_unbound_fp_locked(self, uid: str,
+                               unb: tuple[str, str, float] | None) -> None:
+        """Maintain the per-node unbound-fingerprint tuples under _lock;
+        each mutated node publishes a fresh tuple (copy-on-write, same
+        contract as the rank list) so passes read lock-free."""
+        old_node = self._pod_unbound.get(uid)
+        new_node = unb[0] if unb is not None else None
+        if old_node is not None and old_node != new_node:
+            kept = tuple(e for e in self._unbound_fp_nodes.get(
+                old_node, ()) if e[0] != uid)
+            if kept:
+                self._unbound_fp_nodes[old_node] = kept
+            else:
+                self._unbound_fp_nodes.pop(old_node, None)
+            del self._pod_unbound[uid]
+        if unb is not None:
+            node, fp, ts = unb
+            kept = tuple(e for e in self._unbound_fp_nodes.get(node, ())
+                         if e[0] != uid)
+            self._unbound_fp_nodes[node] = kept + ((uid, fp, ts),)
+            self._pod_unbound[uid] = node
+
+    def unbound_fp(self, name: str) -> tuple:
+        """((uid, fingerprint, commit_ts), ...) of committed-but-unbound
+        pods targeting this node — the snapshot-path twin of the TTL
+        path's unbound_recent_from_pods scan. Lock-free read of a
+        copy-on-write tuple."""
+        return self._unbound_fp_nodes.get(name, ())
 
     @staticmethod
     def _gang_key(pod: dict) -> tuple | None:
@@ -685,6 +755,8 @@ class ClusterSnapshot:
         pod_gang: dict[str, tuple | None] = {}
         gangs: dict[tuple, dict[str, dict]] = {}
         node_pod_uids: dict[str, set[str]] = {}
+        pod_unbound: dict[str, str] = {}
+        unbound_fp_nodes: dict[str, tuple] = {}
         for pod in pods:
             uid = (pod.get("metadata") or {}).get("uid", "")
             if not uid:
@@ -700,6 +772,11 @@ class ClusterSnapshot:
             pod_gang[uid] = key
             if key is not None:
                 gangs.setdefault(key, {})[uid] = pod
+            unb = self._classify_unbound_fp(pod)
+            if unb is not None:
+                pod_unbound[uid] = unb[0]
+                unbound_fp_nodes[unb[0]] = \
+                    unbound_fp_nodes.get(unb[0], ()) + ((uid,) + unb[1:],)
         with self._lock:
             self.generation += 1
             self._pods = pod_map
@@ -708,6 +785,8 @@ class ClusterSnapshot:
             self._pod_gang = pod_gang
             self._gangs = gangs
             self._node_pod_uids = node_pod_uids
+            self._pod_unbound = pod_unbound
+            self._unbound_fp_nodes = unbound_fp_nodes
             self._all_pods_cache = None
             self._node_pressure = {}
             self._node_headroom = {}

@@ -363,6 +363,7 @@ DRIVER_DIR = f"{MANAGER_BASE_DIR}/driver"          # shim install dir on node
 CONTROL_LIBRARY_NAME = "libvtpu-control.so"
 
 TRACE_DIR = f"{MANAGER_BASE_DIR}/trace"             # vtrace span spools
+EXPLAIN_DIR = f"{MANAGER_BASE_DIR}/explain"         # vtexplain decision spools
 
 # vttel step-telemetry ring: one per tenant container, under the
 # container config dir (host: <base>/<uid>_<cont>/telemetry/<name>;

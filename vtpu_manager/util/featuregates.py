@@ -29,6 +29,7 @@ STEP_TELEMETRY = "StepTelemetry"        # vttel per-tenant step rings
 SCHEDULER_HA = "SchedulerHA"            # vtha sharded active-active scheduler
 COMPILE_CACHE = "CompileCache"          # vtcc node-local compile cache
 UTILIZATION_LEDGER = "UtilizationLedger"  # vtuse per-tenant utilization ledger
+DECISION_EXPLAIN = "DecisionExplain"    # vtexplain per-decision audit trail
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -84,6 +85,16 @@ _KNOWN = {
     # scheduler only OBSERVES the signal this PR (trace span + metric);
     # placement is untouched.
     UTILIZATION_LEDGER: False,
+    # Default off: zero records/spools/series/routes and placement +
+    # preemption byte-identical in both scheduler modes. On, every
+    # filter/preempt/bind decision leaves a structured audit record —
+    # per-candidate score breakdowns, per-rejected-node reason codes,
+    # the chosen node's winning margin (vtpu_manager/explain/) — served
+    # as /explain + the pending-pod doctor, and preemption victim
+    # ordering gains the vttel/vtuse utilization inputs (the one
+    # gate-on behavior change, asserted against its own recorded
+    # reasoning).
+    DECISION_EXPLAIN: False,
 }
 
 

@@ -109,6 +109,18 @@ def parse_headroom(raw: str | None, now: float | None = None,
     return NodeHeadroom(chips=chips, ts=ts)
 
 
+def headroom_is_fresh(hr: "NodeHeadroom | None",
+                      now: float | None = None) -> bool:
+    """Use-time staleness verdict (the pressure-penalty rule): the
+    snapshot path caches the parsed rollup on the NodeEntry and a dead
+    publisher emits no further events, so every consumer of a cached
+    NodeHeadroom must re-judge freshness at the moment it acts on it."""
+    if hr is None:
+        return False
+    now = time.time() if now is None else now
+    return -FUTURE_SKEW_TOLERANCE_S <= now - hr.ts <= MAX_HEADROOM_AGE_S
+
+
 def headroom_score_input(hr: "NodeHeadroom | None",
                          now: float | None = None) -> float:
     """The score input the quota-market PR will add: total reclaimable
