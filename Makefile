@@ -94,12 +94,20 @@ test-utilization: ## vtuse suite: ledger EWMA/burstiness/staleness math, budgete
 test-explain: ## vtexplain suite: ring bounds/drops, gate-off contracts, reason-code matrix, score-reproduction e2e, doctor verdicts, victim-ordering satellite, chaos
 	$(PYTEST) tests/test_explain.py -q
 
+.PHONY: test-quotamarket
+test-quotamarket: ## vtqm suite: class stamping, lease ledger, market policy + conservation invariant, headroom score term both modes, replay/smi CLIs, 24-seed reclaim-under-crash chaos (CHAOS_SEED=n reproduces one seed)
+	$(PYTEST) tests/test_quota.py -q
+
 .PHONY: bench-compilecache
 bench-compilecache: ## vtcc headline bench: N-replica gang cold start, cache off/cold/warm (1 compile + N-1 hits asserted)
 	python scripts/bench_compilecache.py
 
+.PHONY: bench-quotamarket
+bench-quotamarket: ## vtqm headline bench: bursty inference + steady training co-location, market off/on (burst p99 >=2x, training >=95% retained, reclaim bound asserted; writes BENCH_VTQM_r10.json)
+	python scripts/bench_quotamarket.py
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization test-explain ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite, vtexplain audit suite
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization test-explain test-quotamarket ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite, vtexplain audit suite, vtqm market suite
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -78,6 +78,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.tpu.discovery import FakeBackend, discover
 
     from vtpu_manager.util.featuregates import (DECISION_EXPLAIN,
+                                                QUOTA_MARKET,
                                                 UTILIZATION_LEDGER,
                                                 FeatureGates)
 
@@ -89,6 +90,7 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     util_on = gates.enabled(UTILIZATION_LEDGER)
     explain_on = gates.enabled(DECISION_EXPLAIN)
+    quota_on = gates.enabled(QUOTA_MARKET)
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
@@ -129,7 +131,10 @@ def main(argv: list[str] | None = None) -> int:
             collector.util_ledger, client=fan_client,
             cache_root=os.path.join(args.base_dir,
                                     consts.COMPILE_CACHE_SUBDIR),
-            fold_budget_s=collector.util_fold_budget_s)
+            fold_budget_s=collector.util_fold_budget_s,
+            # vtqm: lease state (node ledger + remote annotations)
+            # folds into /utilization only when the market gate is on
+            quota_dir=args.base_dir if quota_on else None)
 
     import hmac
 

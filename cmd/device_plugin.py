@@ -96,7 +96,8 @@ def main(argv: list[str] | None = None) -> int:
                                                 CORE_PLUGIN,
                                                 FAULT_INJECTION,
                                                 HONOR_PREALLOC_IDS,
-                                                MEMORY_PLUGIN, RESCHEDULE,
+                                                MEMORY_PLUGIN,
+                                                QUOTA_MARKET, RESCHEDULE,
                                                 STEP_TELEMETRY, TC_WATCHER,
                                                 TPU_TOPOLOGY, TRACING,
                                                 UTILIZATION_LEDGER,
@@ -207,6 +208,9 @@ def main(argv: list[str] | None = None) -> int:
     # vtcc: Allocate mounts the node-shared compile cache read-write and
     # injects the arming env + config field; off = nothing injected
     vnum.compile_cache_enabled = gates.enabled(COMPILE_CACHE)
+    # vtqm: Allocate stamps the webhook-normalized workload class into
+    # the v3 config ABI; off = WORKLOAD_CLASS_NONE (the zero bytes)
+    vnum.quota_market_enabled = gates.enabled(QUOTA_MARKET)
     plugins = [vnum]
     if gates.enabled(CORE_PLUGIN):
         plugins.append(VcorePlugin(manager))
@@ -381,6 +385,26 @@ def main(argv: list[str] | None = None) -> int:
         headroom_pub.start()
         log.info("utilization headroom publisher running")
 
+    # vtqm quota market: this daemon (the config writer) lends a chip's
+    # measured-idle, confidence-gated headroom between co-tenants in
+    # bounded TTL'd increments, rewriting each party's vtpu.config
+    # (epoch bump = the shim's instant-reclaim trigger). Its OWN vtuse
+    # ledger instance: the headroom publisher's cursors stay private,
+    # so the two daemons never race one fold state.
+    market = None
+    if gates.enabled(QUOTA_MARKET):
+        from vtpu_manager.quota import QuotaMarketManager
+        from vtpu_manager.utilization import UtilizationLedger as _UL
+        market = QuotaMarketManager(
+            args.node_name, args.base_dir or consts.MANAGER_BASE_DIR,
+            _UL(args.node_name, chips,
+                base_dir=args.base_dir or consts.MANAGER_BASE_DIR,
+                tc_path=consts.TC_UTIL_CONFIG),
+            client=client)
+        market.start()
+        log.info("quota market manager running (ledger %s)",
+                 market.ledger.path)
+
     controller = None
     if gates.enabled(RESCHEDULE):
         from vtpu_manager.scheduler.lease import read_lease_state
@@ -419,6 +443,8 @@ def main(argv: list[str] | None = None) -> int:
             pressure_pub.stop()
         if headroom_pub:
             headroom_pub.stop()
+        if market:
+            market.stop()
         if controller:
             controller.stop()
         health.stop()

@@ -93,6 +93,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.util.featuregates import (COMPILE_CACHE,
                                                 DECISION_EXPLAIN,
                                                 FAULT_INJECTION,
+                                                QUOTA_MARKET,
                                                 SCHEDULER_HA,
                                                 SCHEDULER_SNAPSHOT,
                                                 SERIAL_BIND_NODE,
@@ -149,10 +150,14 @@ def main(argv: list[str] | None = None) -> int:
         # SchedulerHA branch's shards inherit it for free (exactly how
         # they inherit the vttel pressure penalty)
         anti_storm=gates.enabled(COMPILE_CACHE),
-        # vtuse: observe-only headroom tap (trace span + metric, never
-        # a score change this PR) — same filter_kwargs ride-along so
-        # vtha shards inherit it
-        utilization_hint=gates.enabled(UTILIZATION_LEDGER))
+        # vtuse: observe-only headroom tap (trace span + metric) —
+        # same filter_kwargs ride-along so vtha shards inherit it
+        utilization_hint=gates.enabled(UTILIZATION_LEDGER),
+        # vtqm: the headroom input becomes a REAL score term for
+        # latency-critical pods (validated against the recorded
+        # observe-only evidence via scripts/vtpu_replay.py); off =
+        # byte-identical placement in both data paths
+        quota_market=gates.enabled(QUOTA_MARKET))
     # vtexplain satellite: preemption victim ordering gains the vttel/
     # vtuse utilization inputs behind the same gate as the audit trail
     # (the ordering applied is recorded per victim, so it is auditable);

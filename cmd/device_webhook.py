@@ -40,7 +40,8 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import (COMPILE_CACHE, TRACING,
+    from vtpu_manager.util.featuregates import (COMPILE_CACHE,
+                                                QUOTA_MARKET, TRACING,
                                                 FeatureGates)
     from vtpu_manager.webhook.server import WebhookAPI, run_server
 
@@ -78,7 +79,12 @@ def main(argv: list[str] | None = None) -> int:
                      # fingerprint into the scheduler-readable
                      # annotation (gate off = no new patches, byte-
                      # identical admission behavior)
-                     stamp_fingerprint=gates.enabled(COMPILE_CACHE))
+                     stamp_fingerprint=gates.enabled(COMPILE_CACHE),
+                     # vtqm: normalize the declared workload class
+                     # into the one annotation the scheduler's
+                     # headroom term and the plugin's config ABI
+                     # stamping read (gate off = no new patches)
+                     stamp_workload_class=gates.enabled(QUOTA_MARKET))
     logging.getLogger(__name__).info("vtpu-webhook on %s:%d", args.host,
                                      args.port)
     run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)

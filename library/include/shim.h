@@ -67,6 +67,13 @@ struct Metrics {
   Counter execs{"execs"};
   Counter exec_done{"exec_done"};
   Counter aimd_md_events{"aimd_md_events"};
+  // vtqm: adopted quota-market lease generations (config re-reads that
+  // actually changed the enforced rates)
+  Counter quota_reloads{"quota_reloads"};
+  // vtcc: Execute-path compile-cache client outcomes (non-Python
+  // tenants arming off the config header's compile_cache_dir)
+  Counter compile_cache_hits{"compile_cache_hits"};
+  Counter compile_cache_misses{"compile_cache_misses"};
 };
 extern Metrics g_metrics;
 

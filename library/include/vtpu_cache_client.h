@@ -1,0 +1,450 @@
+/* vtpu_cache_client.h — the C++ shim's node-shared compile-cache client.
+ *
+ * vtcc follow-up (carried from PR 7): the v2 config header plumbed
+ * compile_cache_dir to the shim, but only Python/jax tenants armed on
+ * it (JAX_COMPILATION_CACHE_DIR). This header is the Execute-path
+ * client for everyone else: a tenant driving PJRT through the shim
+ * without the Python runtime client gets the same one-compile-per-node
+ * behavior via PJRT_Client_Compile interception (enforce.cc).
+ *
+ * The store protocol is byte-compatible with
+ * vtpu_manager/compilecache/cache.py — same directory shape
+ * (entries/ tmp/ lease/ quarantine/), same 24-byte checksummed entry
+ * header (magic "VTCC", version, payload_len u64, fnv1a-64 u64), same
+ * atomic write-tmp-fsync-rename landing, and the same born-flock'd
+ * single-flight lease files ("pid@ts", liveness = the kernel-released
+ * flock on the lease inode) — so the node janitor (LRU/quarantine/
+ * stale-tmp reap) manages C++-written entries exactly like Python
+ * ones, and the two sides' waiters exclude each other. Keys are
+ * sha256 (like the Python side's content keys) over length-prefixed
+ * program code/format/options, prefixed "shim-" — a distinct, non-
+ * colliding namespace: the shim caches platform-serialized
+ * executables, the Python side caches its own artifact shapes.
+ *
+ * Header-only so tests/test_config_abi.py's g++ probe rows compile the
+ * EXACT client the shim ships and round-trip entries + leases against
+ * the Python implementation.
+ */
+#ifndef VTPU_CACHE_CLIENT_H_
+#define VTPU_CACHE_CLIENT_H_
+
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+#include <utime.h>
+
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace vtpu {
+
+// ---------------------------------------------------------------------------
+// sha256 (FIPS 180-4), compact: cache keys must be collision-safe —
+// a weak hash colliding across programs would serve the WRONG
+// executable to a tenant (verified-payload checksums only prove the
+// entry matches itself).
+// ---------------------------------------------------------------------------
+
+class Sha256 {
+ public:
+  Sha256() { Reset(); }
+
+  void Reset() {
+    static const uint32_t kInit[8] = {
+        0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+        0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+    memcpy(h_, kInit, sizeof(h_));
+    len_ = 0;
+    buf_used_ = 0;
+  }
+
+  void Update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    len_ += n;
+    while (n > 0) {
+      size_t take = 64 - buf_used_;
+      if (take > n) take = n;
+      memcpy(buf_ + buf_used_, p, take);
+      buf_used_ += take;
+      p += take;
+      n -= take;
+      if (buf_used_ == 64) {
+        Block(buf_);
+        buf_used_ = 0;
+      }
+    }
+  }
+
+  // 64 lowercase hex chars into out (must hold 65 bytes incl. NUL).
+  void HexDigest(char* out) {
+    uint64_t bits = len_ * 8;
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_used_ != 56) Update(&zero, 1);
+    uint8_t lenbuf[8];
+    for (int i = 0; i < 8; i++)
+      lenbuf[i] = (uint8_t)(bits >> (56 - 8 * i));
+    Update(lenbuf, 8);
+    for (int i = 0; i < 8; i++)
+      snprintf(out + 8 * i, 9, "%08x", h_[i]);
+  }
+
+ private:
+  static uint32_t Rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void Block(const uint8_t* p) {
+    static const uint32_t kK[64] = {
+        0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+        0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+        0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+        0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+        0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+        0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+        0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+        0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+        0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+        0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+        0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+        0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+        0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+             ((uint32_t)p[4 * i + 2] << 8) | (uint32_t)p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^
+                    (w[i - 15] >> 3);
+      uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^
+                    (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h_[0], b = h_[1], c = h_[2], d = h_[3];
+    uint32_t e = h_[4], f = h_[5], g = h_[6], h = h_[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = h + s1 + ch + kK[i] + w[i];
+      uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      h = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h_[0] += a; h_[1] += b; h_[2] += c; h_[3] += d;
+    h_[4] += e; h_[5] += f; h_[6] += g; h_[7] += h;
+  }
+
+  uint32_t h_[8];
+  uint64_t len_ = 0;
+  uint8_t buf_[64];
+  size_t buf_used_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Store client
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kCacheEntryMagic = 0x43435456;  // "VTCC" (cache.py MAGIC)
+constexpr uint32_t kCacheEntryVersion = 1;
+constexpr size_t kCacheEntryHeaderSize = 24;
+
+inline uint64_t CacheFnv1a64(const uint8_t* data, size_t n) {
+  uint64_t h = 0xCBF29CE484222325ull;
+  for (size_t i = 0; i < n; i++) {
+    h ^= data[i];
+    h *= 0x100000001B3ull;
+  }
+  return h;
+}
+
+class CompileCacheClient {
+ public:
+  explicit CompileCacheClient(const char* root) {
+    if (!root || !*root) return;
+    root_ = root;
+    // the plugin's Allocate created the tree; mkdir here only covers
+    // a bare-process run pointed at a fresh dir (tests, probes)
+    ok_ = EnsureDir(root_) && EnsureDir(root_ + "/entries") &&
+          EnsureDir(root_ + "/tmp") && EnsureDir(root_ + "/lease") &&
+          EnsureDir(root_ + "/quarantine");
+    const char* stale = getenv("VTPU_CACHE_STALE_LEASE_S");
+    if (stale) stale_lease_s_ = atof(stale);
+    if (!(stale_lease_s_ > 0)) stale_lease_s_ = 300.0;
+  }
+
+  ~CompileCacheClient() {
+    // close (not release): dropping the flocks mimics process death,
+    // which is exactly what waiters are built to take over from
+    std::lock_guard<std::mutex> g(leases_mu_);
+    for (auto& kv : leases_) close(kv.second.fd);
+  }
+
+  bool ok() const { return ok_; }
+
+  // "shim-" + sha256 over the length-prefixed compile inputs: the code
+  // bytes, their declared format, and the serialized compile options
+  // (sharding/replication change the produced executable).
+  static std::string Key(const void* code, size_t code_size,
+                         const char* format, size_t format_size,
+                         const void* options, size_t options_size) {
+    Sha256 sha;
+    uint64_t lens[3] = {(uint64_t)code_size, (uint64_t)format_size,
+                        (uint64_t)options_size};
+    sha.Update(&lens[0], sizeof(lens[0]));
+    if (code_size) sha.Update(code, code_size);
+    sha.Update(&lens[1], sizeof(lens[1]));
+    if (format_size) sha.Update(format, format_size);
+    sha.Update(&lens[2], sizeof(lens[2]));
+    if (options_size) sha.Update(options, options_size);
+    char hex[65];
+    sha.HexDigest(hex);
+    return std::string("shim-") + hex;
+  }
+
+  // Verified read; corrupt entries are quarantined (rename wins for
+  // exactly one racer, same as cache.py). A hit refreshes mtime (the
+  // janitor's LRU signal).
+  bool Get(const std::string& key, std::string* payload) {
+    std::string path = EntryPath(key);
+    std::string raw;
+    if (!ReadFile(path, &raw)) return false;
+    if (!Verify(raw, payload)) {
+      Quarantine(key);
+      return false;
+    }
+    utime(path.c_str(), nullptr);  // losing the refresh to a race is fine
+    return true;
+  }
+
+  // Atomic landing: tmp (pid + random token in the name) + fsync +
+  // rename. False = the payload did not land (callers serve their
+  // in-memory copy uncached, the cache.py rule).
+  bool Put(const std::string& key, const void* data, size_t len) {
+    char token[32];
+    snprintf(token, sizeof(token), "%d.%08x", (int)getpid(),
+             (unsigned)(NowNsMono() & 0xFFFFFFFFu));
+    std::string tmp = root_ + "/tmp/" + key + "." + token;
+    int fd = open(tmp.c_str(), O_CREAT | O_EXCL | O_WRONLY, 0666);
+    if (fd < 0) return false;
+    uint8_t header[kCacheEntryHeaderSize];
+    uint32_t magic = kCacheEntryMagic, version = kCacheEntryVersion;
+    uint64_t len64 = len;
+    uint64_t sum = CacheFnv1a64(static_cast<const uint8_t*>(data), len);
+    memcpy(header, &magic, 4);
+    memcpy(header + 4, &version, 4);
+    memcpy(header + 8, &len64, 8);
+    memcpy(header + 16, &sum, 8);
+    bool ok = WriteAll(fd, header, sizeof(header)) &&
+              WriteAll(fd, data, len) && fsync(fd) == 0;
+    close(fd);
+    if (ok) ok = rename(tmp.c_str(), EntryPath(key).c_str()) == 0;
+    if (!ok) unlink(tmp.c_str());
+    return ok;
+  }
+
+  // Single-flight population lease, the cache.py protocol: the lease
+  // file is born already containing "pid@ts" AND already flock'd
+  // (write-tmp, flock, link — no observer ever sees an empty or
+  // unlocked lease), liveness is the kernel-released flock, stale/dead
+  // holders are taken over after a verify-content-then-unlink guard.
+  bool TryAcquireLease(const std::string& key) {
+    std::string path = LeasePath(key);
+    Hold hold;
+    if (LinkLease(path, &hold)) {
+      RememberHold(key, hold);
+      return true;
+    }
+    std::string held;
+    if (!ReadFile(path, &held)) return false;  // vanished: retry later
+    if (!LeaseStale(path, held)) return false;
+    std::string again;
+    if (!ReadFile(path, &again) || again != held)
+      return false;  // a fresh holder replaced it between read and unlink
+    if (unlink(path.c_str()) != 0) return false;
+    if (!LinkLease(path, &hold)) return false;  // another waiter won
+    RememberHold(key, hold);
+    return true;
+  }
+
+  void ReleaseLease(const std::string& key) {
+    Hold hold;
+    {
+      // concurrent PJRT_Client_Compile calls share this client: the
+      // map itself needs a lock (the flocks do not)
+      std::lock_guard<std::mutex> g(leases_mu_);
+      auto it = leases_.find(key);
+      if (it == leases_.end()) return;
+      hold = it->second;
+      leases_.erase(it);
+    }
+    close(hold.fd);  // flock released with the OFD
+    std::string path = LeasePath(key), current;
+    // unlink only if still OUR exact content — a takeover's lease must
+    // survive our late release (content equality, never pid equality)
+    if (ReadFile(path, &current) && current == hold.payload)
+      unlink(path.c_str());
+  }
+
+  // True while some other holder's lease looks live (the waiters' poll
+  // predicate between Get() retries).
+  bool LeaseHeldByOther(const std::string& key) {
+    std::string path = LeasePath(key), held;
+    {
+      std::lock_guard<std::mutex> g(leases_mu_);
+      if (leases_.count(key)) return false;
+    }
+    if (!ReadFile(path, &held)) return false;
+    return !LeaseStale(path, held);
+  }
+
+  std::string EntryPath(const std::string& key) const {
+    return root_ + "/entries/" + key;
+  }
+
+ private:
+  struct Hold {
+    int fd = -1;
+    std::string payload;
+  };
+
+  void RememberHold(const std::string& key, const Hold& hold) {
+    std::lock_guard<std::mutex> g(leases_mu_);
+    leases_[key] = hold;
+  }
+
+  static uint64_t NowNsMono() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+  }
+
+  static bool EnsureDir(const std::string& path) {
+    if (mkdir(path.c_str(), 0777) == 0 || errno == EEXIST) return true;
+    return false;
+  }
+
+  static bool ReadFile(const std::string& path, std::string* out) {
+    int fd = open(path.c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    out->clear();
+    char buf[65536];
+    for (;;) {
+      ssize_t n = read(fd, buf, sizeof(buf));
+      if (n < 0) {
+        close(fd);
+        return false;
+      }
+      if (n == 0) break;
+      out->append(buf, (size_t)n);
+    }
+    close(fd);
+    return true;
+  }
+
+  static bool WriteAll(int fd, const void* data, size_t len) {
+    const char* p = static_cast<const char*>(data);
+    while (len > 0) {
+      ssize_t n = write(fd, p, len);
+      if (n <= 0) return false;
+      p += n;
+      len -= (size_t)n;
+    }
+    return true;
+  }
+
+  static bool Verify(const std::string& raw, std::string* payload) {
+    if (raw.size() < kCacheEntryHeaderSize) return false;
+    uint32_t magic, version;
+    uint64_t len64, sum;
+    memcpy(&magic, raw.data(), 4);
+    memcpy(&version, raw.data() + 4, 4);
+    memcpy(&len64, raw.data() + 8, 8);
+    memcpy(&sum, raw.data() + 16, 8);
+    if (magic != kCacheEntryMagic || version != kCacheEntryVersion)
+      return false;
+    size_t n = raw.size() - kCacheEntryHeaderSize;
+    if (n != len64) return false;
+    const uint8_t* body =
+        reinterpret_cast<const uint8_t*>(raw.data()) +
+        kCacheEntryHeaderSize;
+    if (CacheFnv1a64(body, n) != sum) return false;
+    payload->assign(reinterpret_cast<const char*>(body), n);
+    return true;
+  }
+
+  void Quarantine(const std::string& key) {
+    char stamp[32];
+    snprintf(stamp, sizeof(stamp), "%llu",
+             (unsigned long long)NowNsMono());
+    std::string dst = root_ + "/quarantine/" + key + "." + stamp;
+    rename(EntryPath(key).c_str(), dst.c_str());  // one racer wins
+  }
+
+  std::string LeasePath(const std::string& key) const {
+    return root_ + "/lease/" + key + ".lease";
+  }
+
+  bool LinkLease(const std::string& path, Hold* out) {
+    char token[32];
+    snprintf(token, sizeof(token), "%d.%08x", (int)getpid(),
+             (unsigned)(NowNsMono() & 0xFFFFFFFFu));
+    std::string tmp = path + "." + token + ".tmp";
+    char payload[64];
+    snprintf(payload, sizeof(payload), "%d@%.6f", (int)getpid(),
+             (double)time(nullptr));
+    int fd = open(tmp.c_str(), O_CREAT | O_EXCL | O_WRONLY, 0666);
+    if (fd < 0) return false;
+    bool linked = WriteAll(fd, payload, strlen(payload)) &&
+                  flock(fd, LOCK_EX | LOCK_NB) == 0 &&
+                  link(tmp.c_str(), path.c_str()) == 0;
+    unlink(tmp.c_str());
+    if (!linked) {
+      close(fd);
+      return false;
+    }
+    out->fd = fd;  // stays open: the flock IS the liveness
+    out->payload = payload;
+    return true;
+  }
+
+  bool LeaseStale(const std::string& path, const std::string& held) {
+    // "pid@ts"; garbage parses as maximally stale (must be
+    // takeover-able, never immortal)
+    int pid = 0;
+    double ts = 0.0;
+    sscanf(held.c_str(), "%d@%lf", &pid, &ts);
+    double age = (double)time(nullptr) - ts;
+    if (age > stale_lease_s_ || age < -stale_lease_s_) return true;
+    int fd = open(path.c_str(), O_RDONLY);
+    if (fd >= 0) {
+      bool grabbable = flock(fd, LOCK_EX | LOCK_NB) == 0;
+      if (grabbable) flock(fd, LOCK_UN);
+      close(fd);
+      return grabbable;  // nobody holds the flock = holder died
+    }
+    // probe failed (vanished mid-check): same-namespace pid fallback
+    return kill(pid, 0) != 0 && errno == ESRCH;
+  }
+
+  std::string root_;
+  double stale_lease_s_ = 300.0;
+  bool ok_ = false;
+  std::mutex leases_mu_;
+  std::unordered_map<std::string, Hold> leases_;
+};
+
+}  // namespace vtpu
+
+#endif  // VTPU_CACHE_CLIENT_H_

@@ -20,12 +20,24 @@ namespace vtpu {
 constexpr uint32_t kConfigMagic = 0x55505456;  // "VTPU"
 // v2: header grew compile_cache_dir[kCacheDirLen] (vtcc); strict
 // version check — plugin and shim ship together per node.
-constexpr uint32_t kConfigVersion = 2;
+// v3 (vtqm): header grew workload_class + quota_epoch (the quota-market
+// lease generation — the shim's token-wait loop re-reads the config
+// when the on-disk epoch moves, bounding revoke-to-enforcement at one
+// throttle quantum + one re-read); the device pad became lease_core
+// (signed borrowed/lent core-% delta).
+constexpr uint32_t kConfigVersion = 3;
 constexpr int kMaxDeviceCount = 64;
 constexpr int kUuidLen = 64;
 constexpr int kNameLen = 64;
 constexpr int kPodUidLen = 48;
 constexpr int kCacheDirLen = 64;
+
+// Workload classes (vtqm, webhook-stamped).
+enum WorkloadClass : int32_t {
+  kWorkloadNone = 0,        // unclassified: never lends, never borrows
+  kWorkloadLatency = 1,     // latency-critical serving (borrower side)
+  kWorkloadThroughput = 2,  // throughput training (lender side)
+};
 
 enum CoreLimit : int32_t {
   kCoreLimitNone = 0,
@@ -54,12 +66,16 @@ struct VtpuDevice {
   int32_t mesh_x;
   int32_t mesh_y;
   int32_t mesh_z;
-  int32_t pad_;
+  // vtqm: signed quota-lease core-% delta (>0 borrowed, <0 lent; the
+  // v2 pad — 0 means no lease). Effective rate =
+  // clamp(hard_core + lease_core, 0, 100).
+  int32_t lease_core;
 };
 static_assert(sizeof(VtpuDevice) == 120, "VtpuDevice ABI size");
 static_assert(offsetof(VtpuDevice, total_memory) == 64, "ABI");
 static_assert(offsetof(VtpuDevice, hard_core) == 80, "ABI");
 static_assert(offsetof(VtpuDevice, mesh_x) == 104, "ABI");
+static_assert(offsetof(VtpuDevice, lease_core) == 116, "ABI");
 
 struct VtpuConfig {
   uint32_t magic;
@@ -73,14 +89,21 @@ struct VtpuConfig {
   // vtcc: in-container node-shared compile cache mount; empty string =
   // CompileCache off for this container (the shim arms only when set)
   char compile_cache_dir[kCacheDirLen];
+  int32_t workload_class;  // WorkloadClass (vtqm; 0 when gate off)
+  // vtqm lease generation: bumped by the market manager on every
+  // grant/revoke written into this config. The shim compares the
+  // on-disk value against the loaded one in its token-wait loop.
+  uint32_t quota_epoch;
   VtpuDevice devices[kMaxDeviceCount];
   uint32_t checksum;  // FNV-1a over all preceding bytes
   uint32_t pad_;
 };
 static_assert(offsetof(VtpuConfig, device_count) == 248, "ABI");
 static_assert(offsetof(VtpuConfig, compile_cache_dir) == 256, "ABI");
-static_assert(offsetof(VtpuConfig, devices) == 320, "ABI");
-static_assert(sizeof(VtpuConfig) == 320 + 64 * 120 + 8, "VtpuConfig ABI");
+static_assert(offsetof(VtpuConfig, workload_class) == 320, "ABI");
+static_assert(offsetof(VtpuConfig, quota_epoch) == 324, "ABI");
+static_assert(offsetof(VtpuConfig, devices) == 328, "ABI");
+static_assert(sizeof(VtpuConfig) == 328 + 64 * 120 + 8, "VtpuConfig ABI");
 
 inline uint64_t Fnv1a64(const char* data) {
   uint64_t h = 0xCBF29CE484222325ull;

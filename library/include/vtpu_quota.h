@@ -1,0 +1,142 @@
+/* vtpu_quota.h — shim-side quota-market lease adoption (vtqm).
+ *
+ * The device plugin's quota-market manager lends a chip's measured-idle
+ * headroom between co-tenants by rewriting each tenant's vtpu.config
+ * (atomic tmp+rename, the file's own checksum guarding torn writes)
+ * with a new per-device lease_core delta and a bumped header
+ * quota_epoch. The shim cannot keep an mmap of the file — rename swaps
+ * the inode — so *instant reclaim* is a re-read triggered from the
+ * token-wait loop: every throttle quantum (~2 ms) the waiting thread
+ * pays one stat(); only an inode/size/mtime change pays the full
+ * read+verify. That bounds revoke-to-enforcement latency at one
+ * throttle quantum + one config re-read, without any watcher thread in
+ * the reclaim path.
+ *
+ * Header-only on purpose: enforce.cc, the g++ ABI-probe rows in
+ * tests/test_config_abi.py, and library/tools/quota_reclaim_probe.cc
+ * (the bench's real-latency measurement) all compile the same adoption
+ * logic — the measured number and the shipped number cannot drift.
+ */
+#ifndef VTPU_QUOTA_H_
+#define VTPU_QUOTA_H_
+
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "vtpu_config.h"
+
+namespace vtpu {
+
+// Effective TensorCore rate under a lease: the base grant plus the
+// signed lease delta, clamped to a physical chip share. The market
+// manager keeps per-chip sums <= 100 on the grant side; the clamp here
+// is the defense against a torn ledger ever reaching enforcement.
+inline int EffectiveCorePct(int base_core, int lease_core) {
+  int v = base_core + lease_core;
+  if (v < 0) v = 0;
+  if (v > 100) v = 100;
+  return v;
+}
+
+// Watches one vtpu.config path for quota-market generations. Check()
+// is cheap enough for the token-wait loop: one stat() in the common
+// case. A full read runs only when the inode/mtime/size moved, and the
+// result is adopted only when it validates (magic/version/checksum/
+// count) AND carries a different quota_epoch than the last adopted
+// generation — a torn or stale rewrite is ignored, never enforced.
+class QuotaReloader {
+ public:
+  explicit QuotaReloader(const char* path) {
+    path_[0] = 0;
+    if (path) snprintf(path_, sizeof(path_), "%s", path);
+  }
+
+  // Record the generation the shim already loaded (LoadConfig at
+  // startup) so the first Check() does not re-adopt it.
+  void Prime(const VtpuConfig& loaded) {
+    last_epoch_ = loaded.quota_epoch;
+    primed_ = true;
+    struct stat st;
+    if (path_[0] && stat(path_, &st) == 0) RememberStat(st);
+  }
+
+  // Returns true when a NEW valid lease generation was read into *out.
+  bool Check(VtpuConfig* out) {
+    if (path_[0] == 0) return false;
+    struct stat st;
+    if (stat(path_, &st) != 0) return false;
+    if (SameStat(st)) return false;
+    if ((size_t)st.st_size != sizeof(VtpuConfig)) {
+      // mid-rewrite glimpse of a foreign file shape: remember nothing,
+      // so the next tick re-stats (the rename lands a full-size file)
+      return false;
+    }
+    VtpuConfig cfg;
+    if (!ReadAndVerify(&cfg)) return false;
+    RememberStat(st);
+    if (primed_ && cfg.quota_epoch == last_epoch_) return false;
+    last_epoch_ = cfg.quota_epoch;
+    primed_ = true;
+    *out = cfg;
+    return true;
+  }
+
+  uint32_t epoch() const { return last_epoch_; }
+  const char* path() const { return path_; }
+
+ private:
+  // mtime at NANOSECOND granularity: the size never changes and inode
+  // numbers are recycled, so two rewrites inside one second could
+  // otherwise look identical and a revoke would be silently skipped —
+  // breaking the one-quantum reclaim bound the bench asserts
+  bool SameStat(const struct stat& st) const {
+    return seen_stat_ && st.st_ino == last_ino_ &&
+           st.st_size == last_size_ &&
+           st.st_mtim.tv_sec == last_mtime_sec_ &&
+           st.st_mtim.tv_nsec == last_mtime_nsec_;
+  }
+
+  void RememberStat(const struct stat& st) {
+    last_ino_ = st.st_ino;
+    last_size_ = st.st_size;
+    last_mtime_sec_ = st.st_mtim.tv_sec;
+    last_mtime_nsec_ = st.st_mtim.tv_nsec;
+    seen_stat_ = true;
+  }
+
+  bool ReadAndVerify(VtpuConfig* cfg) {
+    int fd = open(path_, O_RDONLY);
+    if (fd < 0) return false;
+    size_t got = 0;
+    char* dst = reinterpret_cast<char*>(cfg);
+    while (got < sizeof(VtpuConfig)) {
+      ssize_t n = read(fd, dst + got, sizeof(VtpuConfig) - got);
+      if (n <= 0) {
+        close(fd);
+        return false;
+      }
+      got += (size_t)n;
+    }
+    close(fd);
+    return cfg->magic == kConfigMagic && cfg->version == kConfigVersion &&
+           cfg->checksum == Fnv1a(reinterpret_cast<const uint8_t*>(cfg),
+                                  offsetof(VtpuConfig, checksum)) &&
+           cfg->device_count >= 0 && cfg->device_count <= kMaxDeviceCount;
+  }
+
+  char path_[512];
+  ino_t last_ino_ = 0;
+  off_t last_size_ = 0;
+  time_t last_mtime_sec_ = 0;
+  long last_mtime_nsec_ = 0;
+  uint32_t last_epoch_ = 0;
+  bool seen_stat_ = false;
+  bool primed_ = false;
+};
+
+}  // namespace vtpu
+
+#endif  // VTPU_QUOTA_H_

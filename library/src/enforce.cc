@@ -43,6 +43,8 @@
 #include <vector>
 
 #include "shim.h"
+#include "vtpu_cache_client.h"
+#include "vtpu_quota.h"
 #include "vtpu_telemetry.h"
 
 namespace vtpu {
@@ -1104,12 +1106,112 @@ pthread_t g_watcher;
 std::atomic<bool> g_watcher_running{false};
 pthread_once_t g_watcher_once = PTHREAD_ONCE_INIT;
 
+// ---------------------------------------------------------------------------
+// vtqm: quota-market lease adoption. The plugin's market manager
+// rewrites this tenant's vtpu.config (atomic rename, bumped
+// quota_epoch) on every grant/revoke; the shim re-reads it from the
+// token-wait loop and RateLimit entry — one stat() per throttle
+// quantum — so a revoke is enforced within one quantum + one re-read
+// (the bound scripts/bench_quotamarket.py measures with the SAME
+// QuotaReloader compiled into its probe).
+// ---------------------------------------------------------------------------
+
+QuotaReloader* g_quota = nullptr;
+std::mutex g_quota_mu;
+std::atomic<uint64_t> g_quota_next_check_ns{0};
+
+void ArmQuotaReloader() {
+  ShimState& s = State();
+  if (!s.enforce || g_quota) return;
+  const char* path = getenv("VTPU_CONFIG_PATH");
+  if (!path) path = "/etc/vtpu-manager/config/vtpu.config";
+  g_quota = new QuotaReloader(path);
+  g_quota->Prime(s.config);
+}
+
+void AdoptQuotaLocked(const VtpuConfig& fresh) {
+  // Numeric-field-only adoption: other threads hold pointers into
+  // s.config.devices, so strings are never rewritten and every store
+  // below is a 4-byte aligned int (word-sized benign races, the same
+  // idiom as the DeviceHot fields). Devices are matched by identity,
+  // not position — a market rewrite preserves order, but a torn ledger
+  // must never move a lease onto the wrong chip.
+  ShimState& s = State();
+  for (int i = 0; i < s.device_count && i < kMaxDeviceCount; i++) {
+    VtpuDevice& dev = s.config.devices[i];
+    const VtpuDevice* nd = nullptr;
+    for (int j = 0; j < fresh.device_count && j < kMaxDeviceCount; j++) {
+      if (fresh.devices[j].host_index == dev.host_index &&
+          strncmp(fresh.devices[j].uuid, dev.uuid, kUuidLen) == 0) {
+        nd = &fresh.devices[j];
+        break;
+      }
+    }
+    if (!nd) continue;
+    int old_eff = EffectiveCorePct(dev.hard_core, dev.lease_core);
+    int new_eff = EffectiveCorePct(nd->hard_core, nd->lease_core);
+    dev.hard_core = nd->hard_core;
+    dev.soft_core = nd->soft_core;
+    dev.core_limit = nd->core_limit;
+    dev.lease_core = nd->lease_core;
+    if (new_eff < old_eff) {
+      // Revoke: accumulated borrowed credit must not outlive the
+      // lease. Clamp the balance to one window's grant at the NEW
+      // rate, so the very next token spend paces at base — this store
+      // is what makes reclaim effective within the quantum that
+      // noticed the epoch, not merely by the next watcher tick.
+      int64_t cap = (int64_t)new_eff * kWindowUs / 100;
+      int64_t cur = s.hot[i].tokens_us.load(std::memory_order_relaxed);
+      while (cur > cap &&
+             !s.hot[i].tokens_us.compare_exchange_weak(
+                 cur, cap, std::memory_order_relaxed)) {
+      }
+      VTPU_LOG(kLogInfo,
+               "quota lease revoked on device %d: eff %d%% -> %d%%",
+               dev.host_index, old_eff, new_eff);
+    } else if (new_eff > old_eff) {
+      VTPU_LOG(kLogInfo,
+               "quota lease granted on device %d: eff %d%% -> %d%%",
+               dev.host_index, old_eff, new_eff);
+    }
+  }
+  s.config.workload_class = fresh.workload_class;
+  s.config.quota_epoch = fresh.quota_epoch;
+}
+
+// Called from the token-wait loop (each ~2 ms quantum), RateLimit
+// entry, and the watcher tick: the atomic gate makes the common case
+// one load+compare, and at most one thread pays the stat() per quantum.
+void MaybeAdoptQuota() {
+  if (!g_quota) return;
+  uint64_t now = NowNs();
+  uint64_t due = g_quota_next_check_ns.load(std::memory_order_relaxed);
+  if (now < due) return;
+  if (!g_quota_next_check_ns.compare_exchange_strong(
+          due, now + (uint64_t)kTickSleepUs * 1000,
+          std::memory_order_relaxed))
+    return;                  // another thread owns this quantum's check
+  VtpuConfig fresh;
+  std::lock_guard<std::mutex> g(g_quota_mu);
+  if (g_quota->Check(&fresh)) {
+    AdoptQuotaLocked(fresh);
+    g_metrics.quota_reloads.Bump();
+  }
+}
+
 int EffectiveLimit(int slot) {
   const VtpuDevice* cfg = DeviceCfg(slot);
   if (!cfg || cfg->core_limit == kCoreLimitNone) return 0;
-  if (cfg->core_limit == kCoreLimitHard) return cfg->hard_core;
-  int up = State().hot[slot].up_limit.load(std::memory_order_relaxed);
-  return up > 0 ? up : cfg->hard_core;
+  int base;
+  if (cfg->core_limit == kCoreLimitHard) {
+    base = cfg->hard_core;
+  } else {
+    int up = State().hot[slot].up_limit.load(std::memory_order_relaxed);
+    base = up > 0 ? up : cfg->hard_core;
+  }
+  // vtqm: the lease delta rides on whichever base the policy chose;
+  // with no lease the clamp is a no-op for every sane config
+  return EffectiveCorePct(base, cfg->lease_core);
 }
 
 // Measured utilization (%) over the last window for the chip: external
@@ -1360,6 +1462,11 @@ void WatcherTick(int64_t window_ns) {
   }
   RefreshClientPids();
   AdoptFeedCalibration();
+  // vtqm: a grant (rate INCREASE) has no waiting thread to notice it —
+  // the tick picks it up so a running borrower speeds up within one
+  // window; revokes never wait for this (the wait-loop/RateLimit
+  // checks own that bound)
+  MaybeAdoptQuota();
   g_metrics.watcher_ticks.Bump();
 }
 
@@ -1629,6 +1736,7 @@ void* ProbeMain(void*) {
 }
 
 void StartWatcher() {
+  ArmQuotaReloader();
   g_watcher_running.store(true);
   if (pthread_create(&g_watcher, nullptr, WatcherMain, nullptr) != 0) {
     // surfaced loudly (reference cuda_hook.c:1592-1604)
@@ -1665,6 +1773,9 @@ void ResetWatcherForFork() {
   // ChildAfterFork does for buffers_mu/cost_mu/tms_mu, or the child's
   // first WrappedClientCreate deadlocks on a lock owned by no thread
   new (&g_probe_mu) std::mutex();
+  // same hazard for the quota-adoption lock (a watcher tick may have
+  // held it at fork); the reloader itself is plain state and stays
+  new (&g_quota_mu) std::mutex();
   pthread_once_t fresh = PTHREAD_ONCE_INIT;
   memcpy(&g_watcher_once, &fresh, sizeof(fresh));
   ResetAwaitForFork();
@@ -1739,6 +1850,10 @@ void RateLimit(int slot, int64_t cost_us) {
   BumpActivity(slot);
   if (cfg->core_limit == kCoreLimitNone) return;
   StartWatcherOnce();
+  // vtqm: an actively-submitting borrower must notice a revoke even
+  // when it never blocks in the wait loop below — one atomic
+  // load+compare in the common case (see MaybeAdoptQuota)
+  MaybeAdoptQuota();
   DeviceHot& hot = s.hot[slot];
   uint64_t now = NowNs();
   uint64_t last = hot.last_submit_ns.load(std::memory_order_relaxed);
@@ -1795,6 +1910,11 @@ void RateLimit(int slot, int64_t cost_us) {
     usleep(kTickSleepUs);
     g_throttle_wait_ns.fetch_add(NowNs() - sleep_start,
                                  std::memory_order_relaxed);
+    // vtqm: the throttled borrower's very next quantum re-reads the
+    // rate when the config's quota_epoch moved — a revoke lands as a
+    // token clamp + lower grants before this loop can spend again,
+    // and a grant shortens the wait it is currently serving
+    MaybeAdoptQuota();
   }
 }
 
@@ -2358,6 +2478,155 @@ PJRT_Error* WrappedClientCreate(PJRT_Client_Create_Args* args) {
   return nullptr;
 }
 
+// ---------------------------------------------------------------------------
+// vtcc Execute-path compile-cache client (carried follow-up from PR 7).
+//
+// Python/jax tenants arm on JAX_COMPILATION_CACHE_DIR (runtime/client);
+// everything else compiling through this shim arms HERE, off the v3
+// config header's compile_cache_dir (env override honored the same
+// way), by intercepting PJRT_Client_Compile: a cache hit deserializes
+// the node-shared platform-serialized executable instead of compiling,
+// a miss compiles under the store's single-flight lease and lands the
+// serialized artifact for the node. Every failure shape (deserialize
+// rejected after a libtpu upgrade, serialize unsupported, store
+// unwritable, wedged lease holder) falls open to the real compile —
+// the cache can only remove work, never a tenant's executable.
+// ---------------------------------------------------------------------------
+
+PJRT_Client_Compile* g_real_compile = nullptr;
+CompileCacheClient* g_cache_client = nullptr;
+pthread_once_t g_cache_client_once = PTHREAD_ONCE_INIT;
+// how long a waiter shadows a LIVE holder's compile before failing
+// open uncached (the cache.py get_or_compile default)
+constexpr uint64_t kCompileWaitNs = 600ull * 1000 * 1000 * 1000;
+
+void InitCacheClientOnce() {
+  ShimState& s = State();
+  const char* dir = nullptr;
+  if (s.enforce && s.config.compile_cache_dir[0])
+    dir = s.config.compile_cache_dir;
+  if (!dir || !*dir) {
+    const char* env = getenv("VTPU_COMPILE_CACHE_DIR");
+    if (env && *env) dir = env;
+  }
+  if (!dir || !*dir) return;
+  auto* client = new CompileCacheClient(dir);
+  if (!client->ok()) {
+    VTPU_LOG(kLogWarn, "compile cache dir %s unusable; shim compiles "
+                       "uncached", dir);
+    delete client;
+    return;
+  }
+  VTPU_LOG(kLogInfo, "shim compile-cache client armed at %s", dir);
+  g_cache_client = client;
+}
+
+// Deserialize a cached payload into a loaded executable; nullptr when
+// the platform rejects it (version skew = a clean miss, never an error
+// surfaced to the tenant).
+PJRT_LoadedExecutable* LoadCachedExecutable(PJRT_Client* client,
+                                            const std::string& payload) {
+  ShimState& s = State();
+  PJRT_Executable_DeserializeAndLoad_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Executable_DeserializeAndLoad_Args_STRUCT_SIZE;
+  dargs.client = client;
+  dargs.serialized_executable = payload.data();
+  dargs.serialized_executable_size = payload.size();
+  if (ConsumeError(s.real_api->PJRT_Executable_DeserializeAndLoad(&dargs)))
+    return nullptr;
+  return dargs.loaded_executable;
+}
+
+// Serialize + land the compiled executable; every failure is only a
+// lost cache entry (the tenant already has its executable).
+void StoreCompiledExecutable(const std::string& key,
+                             PJRT_LoadedExecutable* loaded) {
+  ShimState& s = State();
+  PJRT_LoadedExecutable_GetExecutable_Args gargs;
+  memset(&gargs, 0, sizeof(gargs));
+  gargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  gargs.loaded_executable = loaded;
+  if (ConsumeError(s.real_api->PJRT_LoadedExecutable_GetExecutable(&gargs)))
+    return;
+  PJRT_Executable* exe = gargs.executable;
+  PJRT_Executable_Serialize_Args sargs;
+  memset(&sargs, 0, sizeof(sargs));
+  sargs.struct_size = PJRT_Executable_Serialize_Args_STRUCT_SIZE;
+  sargs.executable = exe;
+  if (!ConsumeError(s.real_api->PJRT_Executable_Serialize(&sargs)) &&
+      sargs.serialized_bytes && sargs.serialized_bytes_size > 0) {
+    if (!g_cache_client->Put(key, sargs.serialized_bytes,
+                             sargs.serialized_bytes_size))
+      VTPU_LOG(kLogWarn, "compile cache put failed for %s", key.c_str());
+    if (sargs.serialized_executable_deleter && sargs.serialized_executable)
+      sargs.serialized_executable_deleter(sargs.serialized_executable);
+  }
+  if (s.real_api->PJRT_Executable_Destroy) {
+    PJRT_Executable_Destroy_Args ddargs;
+    memset(&ddargs, 0, sizeof(ddargs));
+    ddargs.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+    ddargs.executable = exe;
+    ConsumeError(s.real_api->PJRT_Executable_Destroy(&ddargs));
+  }
+}
+
+PJRT_Error* WrappedCompile(PJRT_Client_Compile_Args* args) {
+  pthread_once(&g_cache_client_once, InitCacheClientOnce);
+  ShimState& s = State();
+  if (!g_cache_client || !args->program || !args->program->code ||
+      args->program->code_size == 0 ||
+      !s.real_api->PJRT_Executable_DeserializeAndLoad ||
+      !s.real_api->PJRT_Executable_Serialize ||
+      !s.real_api->PJRT_LoadedExecutable_GetExecutable)
+    return g_real_compile(args);
+  std::string key = CompileCacheClient::Key(
+      args->program->code, args->program->code_size, args->program->format,
+      args->program->format_size, args->compile_options,
+      args->compile_options_size);
+  std::string payload;
+  if (g_cache_client->Get(key, &payload)) {
+    if (PJRT_LoadedExecutable* exe =
+            LoadCachedExecutable(args->client, payload)) {
+      args->executable = exe;
+      g_metrics.compile_cache_hits.Bump();
+      return nullptr;
+    }
+    // entry predates a platform/library change: compile fresh below
+    // (the lease holder's put will overwrite it with a loadable one)
+  }
+  bool lease = g_cache_client->TryAcquireLease(key);
+  if (!lease) {
+    // another tenant is compiling this key: shadow its lease, adopting
+    // the entry the moment it lands; a dead/stale holder is taken over
+    // by TryAcquireLease, and a wedged-but-live one eventually fails
+    // open to an uncached compile
+    uint64_t deadline = NowNs() + kCompileWaitNs;
+    while (!lease && NowNs() < deadline) {
+      usleep(50 * 1000);
+      if (g_cache_client->Get(key, &payload)) {
+        if (PJRT_LoadedExecutable* exe =
+                LoadCachedExecutable(args->client, payload)) {
+          args->executable = exe;
+          g_metrics.compile_cache_hits.Bump();
+          return nullptr;
+        }
+        break;  // landed but unloadable here: compile uncached
+      }
+      if (!g_cache_client->LeaseHeldByOther(key))
+        lease = g_cache_client->TryAcquireLease(key);
+    }
+  }
+  g_metrics.compile_cache_misses.Bump();
+  PJRT_Error* err = g_real_compile(args);
+  if (lease) {
+    if (!err && args->executable)
+      StoreCompiledExecutable(key, args->executable);
+    g_cache_client->ReleaseLease(key);
+  }
+  return err;
+}
+
 // Probe-handle lifetime: a dying client takes its devices and the cached
 // probe buffers with it. Invalidate under the probe mutex BEFORE the real
 // destroy so no probe is mid-call on a dying client and none starts on a
@@ -2387,6 +2656,12 @@ void WrapEnforcementEntries(PJRT_Api* api) {
   if (api->PJRT_Client_Destroy) {
     g_real_client_destroy = api->PJRT_Client_Destroy;
     api->PJRT_Client_Destroy = WrappedClientDestroy;
+  }
+  if (api->PJRT_Client_Compile) {
+    // vtcc Execute-path client: armed lazily off the config header's
+    // compile_cache_dir (or env); unarmed = a straight passthrough
+    g_real_compile = api->PJRT_Client_Compile;
+    api->PJRT_Client_Compile = WrappedCompile;
   }
   g_real_bfhb = api->PJRT_Client_BufferFromHostBuffer;
   g_real_buf_destroy = api->PJRT_Buffer_Destroy;

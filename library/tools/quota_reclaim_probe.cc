@@ -1,0 +1,60 @@
+// quota_reclaim_probe — measures real revoke-to-adoption latency
+// through the SAME QuotaReloader the shim compiles (vtpu_quota.h).
+//
+// The probe mimics the shim's token-wait loop at the throttle quantum:
+// sleep kTickSleepUs (2 ms), then one QuotaReloader::Check() — exactly
+// what a throttled borrower does between token polls. The bench
+// (scripts/bench_quotamarket.py) rewrites the config with a bumped
+// quota_epoch and timestamps the rewrite; each ADOPT line here carries
+// the adoption wall-clock, so the measured gap IS the
+// revoke-to-enforcement bound the acceptance criteria assert: one
+// throttle quantum + one config re-read (+ scheduler noise).
+//
+// Usage: quota_reclaim_probe <config_path> <n_adoptions>
+// Prints: READY <epoch>\n then per adoption: ADOPT <epoch> <wall_ns>
+//         <lease_core_dev0>\n
+// Exit: 0 after n adoptions, 3 on a bad initial config, 4 on timeout.
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <unistd.h>
+
+#include "vtpu_quota.h"
+
+namespace {
+
+uint64_t WallNs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+// the shim's throttled-retry quantum (enforce.cc kTickSleepUs)
+constexpr int64_t kQuantumUs = 2000;
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) return 2;
+  vtpu::QuotaReloader reloader(argv[1]);
+  vtpu::VtpuConfig cfg;
+  if (!reloader.Check(&cfg)) return 3;  // first read adopts the baseline
+  int want = atoi(argv[2]);
+  printf("READY %u\n", cfg.quota_epoch);
+  fflush(stdout);
+  int adopted = 0;
+  // generous overall timeout: the bench drives rewrites promptly
+  int64_t budget_ticks = 30ll * 1000 * 1000 / kQuantumUs;
+  while (adopted < want && budget_ticks-- > 0) {
+    usleep(kQuantumUs);
+    if (reloader.Check(&cfg)) {
+      printf("ADOPT %u %llu %d\n", cfg.quota_epoch,
+             (unsigned long long)WallNs(),
+             cfg.device_count > 0 ? cfg.devices[0].lease_core : 0);
+      fflush(stdout);
+      adopted++;
+    }
+  }
+  return adopted == want ? 0 : 4;
+}

@@ -1,0 +1,177 @@
+#!/usr/bin/env python3
+"""vtpu-replay: re-score recorded decisions with the headroom term on.
+
+Usage:
+    python scripts/vtpu_replay.py --explain-dir /path/to/spools
+    python scripts/vtpu_replay.py --pod <uid-or-name> --json
+    python scripts/vtpu_replay.py --flips-only
+
+The flip-it-on evidence the ROADMAP called for: PR 9's decision spools
+record, per candidate, the exact score terms applied PLUS the
+observe-only vtuse reclaimable-headroom input. This tool replays those
+records with the vtqm score term enabled — the byte-exact formula the
+live filter applies under the QuotaMarket gate
+(``utilization.headroom.headroom_term_from_input``, i.e. the recorded
+input capped at HEADROOM_TERM_CAP) — and reports, per pod-pass, which
+recorded placements would have FLIPPED to a different node and how
+every winner's margin moved.
+
+Records already carrying a nonzero ``headroom_term`` (spools written
+with the gate on) replay as-is minus their own term first, so the tool
+answers the same question against any spool generation.
+
+The replay assumes every recorded pod is latency-critical (the
+borrower class the term applies to) — the upper bound on placement
+churn; pods the webhook would class as throughput simply keep their
+recorded placement under the real gate.
+
+Exit codes: 0 ok, 1 no decision records found, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vtpu_manager.explain import doctor                        # noqa: E402
+from vtpu_manager.utilization.headroom import (                # noqa: E402
+    headroom_term_from_input)
+
+
+def rescore_record(rec: dict) -> dict | None:
+    """One decision record replayed with the headroom term enabled;
+    None when the record cannot be re-scored (no scored candidates).
+    The returned row carries both verdicts and the margin movement."""
+    cands = rec.get("candidates") or []
+    if not cands or not rec.get("chosen"):
+        return None
+    old = sorted(cands, key=lambda c: -float(c.get("total", 0.0)))
+    rescored = []
+    signal = 0
+    for c in cands:
+        inp = float(c.get("headroom_input", 0.0) or 0.0)
+        if inp > 0:
+            signal += 1
+        already = float(c.get("headroom_term", 0.0) or 0.0)
+        new_total = float(c.get("total", 0.0)) - already + \
+            headroom_term_from_input(inp)
+        rescored.append((new_total, c))
+    rescored.sort(key=lambda t: -t[0])
+    old_margin = (float(old[0].get("total", 0.0))
+                  - float(old[1].get("total", 0.0))
+                  if len(old) > 1 else None)
+    new_margin = (rescored[0][0] - rescored[1][0]
+                  if len(rescored) > 1 else None)
+    new_winner = rescored[0][1].get("node", "")
+    recorded_winner = rec.get("chosen", "")
+    return {
+        "pod": rec.get("pod", ""),
+        "name": rec.get("name", ""),
+        "ts": rec.get("ts", 0.0),
+        "mode": rec.get("mode", ""),
+        "recorded_winner": recorded_winner,
+        "replay_winner": new_winner,
+        "flip": new_winner != recorded_winner,
+        "recorded_margin": old_margin,
+        "replay_margin": new_margin,
+        "margin_delta": (round(new_margin - old_margin, 6)
+                         if new_margin is not None
+                         and old_margin is not None else None),
+        "candidates": len(cands),
+        "candidates_with_headroom_signal": signal,
+    }
+
+
+def replay(records: list[dict], pod_key: str = "") -> dict:
+    """The full replay document over a spool's decision records."""
+    rows = []
+    for rec in records:
+        if rec.get("kind") != "decision":
+            continue
+        if pod_key and pod_key not in (rec.get("pod"), rec.get("name"),
+                                       rec.get("trace")):
+            continue
+        row = rescore_record(rec)
+        if row is not None:
+            rows.append(row)
+    rows.sort(key=lambda r: r["ts"])
+    flips = [r for r in rows if r["flip"]]
+    with_signal = [r for r in rows
+                   if r["candidates_with_headroom_signal"] > 0]
+    deltas = [r["margin_delta"] for r in rows
+              if r["margin_delta"] is not None]
+    return {
+        "decisions": len(rows),
+        "decisions_with_headroom_signal": len(with_signal),
+        "flips": len(flips),
+        "flip_rate": round(len(flips) / len(rows), 4) if rows else 0.0,
+        "margin_delta_avg": round(sum(deltas) / len(deltas), 4)
+        if deltas else 0.0,
+        "margin_delta_max": round(max(deltas), 4) if deltas else 0.0,
+        "margin_delta_min": round(min(deltas), 4) if deltas else 0.0,
+        "rows": rows,
+    }
+
+
+def _print_human(doc: dict, flips_only: bool) -> None:
+    print(f"replayed {doc['decisions']} recorded decision(s); "
+          f"{doc['decisions_with_headroom_signal']} carried a live "
+          f"headroom signal")
+    print(f"placement flips with the headroom term on: {doc['flips']} "
+          f"({doc['flip_rate'] * 100:.1f}%)   margin delta "
+          f"avg {doc['margin_delta_avg']:+.2f}  "
+          f"min {doc['margin_delta_min']:+.2f}  "
+          f"max {doc['margin_delta_max']:+.2f}")
+    for row in doc["rows"]:
+        if flips_only and not row["flip"]:
+            continue
+        mark = "FLIP" if row["flip"] else "same"
+        om = ("-" if row["recorded_margin"] is None
+              else f"{row['recorded_margin']:.2f}")
+        nm = ("-" if row["replay_margin"] is None
+              else f"{row['replay_margin']:.2f}")
+        print(f"  [{mark}] {row['name'] or row['pod']}: "
+              f"{row['recorded_winner']} -> {row['replay_winner']}  "
+              f"margin {om} -> {nm}  "
+              f"({row['candidates_with_headroom_signal']}/"
+              f"{row['candidates']} candidates with signal)")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vtpu-replay", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--explain-dir", default=None,
+                        help="decision spool dir (default: the shared "
+                             "node explain dir)")
+    parser.add_argument("--pod", default="",
+                        help="replay one pod's passes (uid, name, or "
+                             "trace id)")
+    parser.add_argument("--flips-only", action="store_true",
+                        help="print only the passes that flip")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine output")
+    args = parser.parse_args(argv)
+
+    from vtpu_manager.util import consts
+    explain_dir = args.explain_dir or consts.EXPLAIN_DIR
+    records, _drops = doctor.read_records(explain_dir)
+    doc = replay(records, pod_key=args.pod)
+    if not doc["decisions"]:
+        print(f"vtpu-replay: no replayable decision records under "
+              f"{explain_dir} (DecisionExplain gate on?)",
+              file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(doc, indent=2))
+    else:
+        _print_human(doc, args.flips_only)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

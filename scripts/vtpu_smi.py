@@ -72,10 +72,17 @@ def render(doc: dict, out=None) -> None:
     out = out or sys.stdout
     cluster = doc.get("cluster") or {}
     local = doc.get("node") or {}
+    quota = doc.get("quota")
+    market = ""
+    if quota is not None:
+        market = (f"  market: {quota.get('leases_active', 0)} lease(s) "
+                  f"/{quota.get('lent_core_pct_total', 0)}% lent "
+                  f"(epoch {quota.get('epoch', 0)})")
     print(f"vtpu-smi  cluster: {cluster.get('nodes', 0)} node(s)  "
           f"{cluster.get('chips', 0)} chip(s)  "
           f"reclaimable {cluster.get('reclaimable_core_pct', 0)}% core  "
-          f"({cluster.get('nodes_with_signal', 0)} node(s) reporting)",
+          f"({cluster.get('nodes_with_signal', 0)} node(s) reporting)"
+          f"{market}",
           file=out)
     for err in doc.get("errors") or []:
         print(f"  warning: {err}", file=out)
@@ -91,6 +98,9 @@ def render(doc: dict, out=None) -> None:
             bits.append("headroom STALE (publisher gone)")
         else:
             bits.append("no headroom signal")
+        if nrow.get("quota_lent_core_pct") is not None:
+            bits.append(f"lent {nrow['quota_lent_core_pct']}% across "
+                        f"{nrow.get('quota_leases', 0)} lease(s)")
         if nrow.get("local"):
             cache = local.get("compile_cache")
             if cache:
@@ -115,14 +125,28 @@ def render(doc: dict, out=None) -> None:
     # filters apply uniformly — no local fallback that would bypass them
     tenants = doc.get("tenants") or []
     if tenants:
+        # lent/borrowed columns appear only when the document carries
+        # market state (QuotaMarket gate on at the monitor) — a gate-off
+        # document renders exactly the pre-market table
+        show_market = quota is not None or any(
+            t.get("lent_core_pct") is not None
+            or t.get("borrowed_core_pct") is not None for t in tenants)
+        market_hdr = f" {'lent':>6} {'borrow':>6}" if show_market else ""
         print(f"{'POD':<28} {'container':<12} {'node':<12} {'chip':>4} "
               f"{'quota':>7} {'used':>7} {'wait':>6} {'hbm-hw':>8} "
-              f"{'conf':>9}", file=out)
+              f"{'conf':>9}{market_hdr}", file=out)
         for t in tenants:
             pod = t.get("pod_name") or t.get("pod_uid", "?")
             ns = t.get("pod_namespace", "")
             label = f"{ns}/{pod}" if ns else pod
             wait = t.get("throttle_wait_frac")
+            market_cols = ""
+            if show_market:
+                lent = t.get("lent_core_pct")
+                borrowed = t.get("borrowed_core_pct")
+                market_cols = (
+                    f" {'-' if lent is None else f'{lent}%':>6}"
+                    f" {'-' if borrowed is None else f'{borrowed}%':>6}")
             print(f"{label[:28]:<28} {t.get('container', '')[:12]:<12} "
                   f"{t.get('node', '')[:12]:<12} "
                   f"{t.get('chip_index', '?'):>4} "
@@ -130,7 +154,7 @@ def render(doc: dict, out=None) -> None:
                   f"{_pct(t.get('used_core_pct')):>7} "
                   f"{'-' if wait is None else f'{wait * 100:4.1f}%':>6} "
                   f"{_gib(t.get('hbm_highwater_bytes')):>8} "
-                  f"{_conf(t):>9}", file=out)
+                  f"{_conf(t):>9}{market_cols}", file=out)
     else:
         print("(no tenant rows)", file=out)
 

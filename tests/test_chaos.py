@@ -522,6 +522,13 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
                    count=rng.randint(1, 2))
     failpoints.arm("explain.rollup", "error", p=0.3,
                    count=rng.randint(1, 2))
+    # vtqm sites: driven by the dedicated reclaim-under-crash chaos
+    # suite (test_quota.py — the e2e loop here runs no market manager),
+    # armed so the full-coverage assertion stays the honest catalog
+    # check
+    failpoints.arm("quota.lease", "crash", p=0.2, count=1)
+    failpoints.arm("quota.revoke", rng.choice(["crash", "partial-write"]),
+                   p=0.2, count=1)
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 

@@ -38,6 +38,7 @@ int main() {
   printf("dev.mesh_x %zu\n", offsetof(VtpuDevice, mesh_x));
   printf("dev.mesh_y %zu\n", offsetof(VtpuDevice, mesh_y));
   printf("dev.mesh_z %zu\n", offsetof(VtpuDevice, mesh_z));
+  printf("dev.lease_core %zu\n", offsetof(VtpuDevice, lease_core));
   printf("cfg.magic %zu\n", offsetof(VtpuConfig, magic));
   printf("cfg.version %zu\n", offsetof(VtpuConfig, version));
   printf("cfg.pod_uid %zu\n", offsetof(VtpuConfig, pod_uid));
@@ -48,6 +49,9 @@ int main() {
   printf("cfg.compat_mode %zu\n", offsetof(VtpuConfig, compat_mode));
   printf("cfg.compile_cache_dir %zu\n",
          offsetof(VtpuConfig, compile_cache_dir));
+  printf("cfg.workload_class %zu\n",
+         offsetof(VtpuConfig, workload_class));
+  printf("cfg.quota_epoch %zu\n", offsetof(VtpuConfig, quota_epoch));
   printf("tc_file_size %zu\n", sizeof(TcUtilFile));
   printf("tc_record_size %zu\n", sizeof(TcDeviceRecord));
   printf("tc_proc_size %zu\n", sizeof(TcProcUtil));
@@ -137,22 +141,37 @@ class TestVtpuConfigRoundtrip:
         return vc.VtpuConfig(
             pod_uid="uid-123", pod_name="trainer", pod_namespace="ml",
             container_name="main", compat_mode=0x05,
+            workload_class=vc.WORKLOAD_CLASS_LATENCY, quota_epoch=42,
             devices=[vc.DeviceConfig(
                 uuid="TPU-ABC", total_memory=8 * 2**30,
                 real_memory=16 * 2**30, hard_core=50, soft_core=80,
                 core_limit=vc.CORE_LIMIT_SOFT, memory_limit=True,
-                memory_oversold=False, host_index=3, mesh=(1, 2, 0))])
+                memory_oversold=False, host_index=3, mesh=(1, 2, 0),
+                lease_core=25)])
 
     def test_pack_unpack(self):
         cfg = self._sample()
         back = vc.VtpuConfig.unpack(cfg.pack())
         assert back.pod_uid == "uid-123"
         assert back.compat_mode == 0x05
+        assert back.workload_class == vc.WORKLOAD_CLASS_LATENCY
+        assert back.quota_epoch == 42
         dev = back.devices[0]
         assert dev.uuid == "TPU-ABC"
         assert dev.total_memory == 8 * 2**30
         assert dev.core_limit == vc.CORE_LIMIT_SOFT
         assert dev.mesh == (1, 2, 0)
+        assert dev.lease_core == 25
+
+    def test_v3_defaults_zero(self):
+        """A gate-off config (no class, no leases) carries zeros in every
+        v3 field — the lease delta is byte-identical to the old pad."""
+        back = vc.VtpuConfig.unpack(vc.VtpuConfig(
+            pod_uid="u", devices=[vc.DeviceConfig(
+                uuid="X", total_memory=1, real_memory=1)]).pack())
+        assert back.workload_class == vc.WORKLOAD_CLASS_NONE
+        assert back.quota_epoch == 0
+        assert back.devices[0].lease_core == 0
 
     def test_file_roundtrip_atomic(self, tmp_path):
         path = str(tmp_path / "cfg" / "vtpu.config")
@@ -534,6 +553,128 @@ def cxx_ring_writer(tmp_path_factory):
         ["g++", "-std=c++17", f"-I{REPO}/library/include", str(src),
          "-o", str(exe)], check=True, capture_output=True)
     return str(exe)
+
+
+# ---------------------------------------------------------------------------
+# vtqm: the C++ quota reloader (vtpu_quota.h — the shim's instant-reclaim
+# re-read) adopts Python-written v3 configs by epoch, and the C++
+# compile-cache client (vtpu_cache_client.h — the Execute-path arming off
+# compile_cache_dir) round-trips entries and excludes leases against the
+# Python store byte-compatibly.
+# ---------------------------------------------------------------------------
+
+QUOTA_PROBE_SRC = r"""
+#include <cstdio>
+#include <cstring>
+#include <unistd.h>
+#include "vtpu_quota.h"
+#include "vtpu_cache_client.h"
+using namespace vtpu;
+int main(int argc, char** argv) {
+  // argv: <config path> <cache root>
+  QuotaReloader qr(argv[1]);
+  VtpuConfig cfg;
+  if (!qr.Check(&cfg)) return 3;     // first read adopts the baseline
+  printf("epoch %u class %d lease %d cache_dir %s eff %d\n",
+         cfg.quota_epoch, cfg.workload_class, cfg.devices[0].lease_core,
+         cfg.compile_cache_dir,
+         EffectiveCorePct(cfg.devices[0].hard_core,
+                          cfg.devices[0].lease_core));
+  if (qr.Check(&cfg)) return 4;      // unchanged: no re-adopt
+  fflush(stdout);
+  // wait (the token-wait loop shape) for the Python side's rewrite
+  for (int i = 0; i < 5000; i++) {
+    usleep(2000);
+    if (qr.Check(&cfg)) {
+      printf("adopt %u lease %d eff %d\n", cfg.quota_epoch,
+             cfg.devices[0].lease_core,
+             EffectiveCorePct(cfg.devices[0].hard_core,
+                              cfg.devices[0].lease_core));
+      fflush(stdout);
+      break;
+    }
+  }
+  // cache client interop against the Python store
+  CompileCacheClient cc(argv[2]);
+  if (!cc.ok()) return 5;
+  std::string payload;
+  if (!cc.Get("py-entry", &payload)) return 6;
+  printf("py_payload %s\n", payload.c_str());
+  if (!cc.Put("cxx-entry", "from-cxx", 8)) return 7;
+  if (!cc.TryAcquireLease("interop-key")) return 8;
+  printf("leased 1\n");
+  fflush(stdout);
+  // hold the lease until stdin closes so Python can probe exclusion
+  char buf[8];
+  (void)!read(0, buf, sizeof(buf));
+  cc.ReleaseLease("interop-key");
+  printf("released 1\n");
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cxx_quota_probe(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("quotaprobe")
+    src = tmp / "quota_probe.cc"
+    src.write_text(QUOTA_PROBE_SRC)
+    exe = tmp / "quota_probe"
+    subprocess.run(
+        ["g++", "-std=c++17", f"-I{REPO}/library/include", str(src),
+         "-o", str(exe)], check=True, capture_output=True)
+    return str(exe)
+
+
+class TestCxxQuotaAndCacheClient:
+    def test_v3_adoption_and_store_interop(self, cxx_quota_probe,
+                                           tmp_path):
+        from vtpu_manager.compilecache.cache import CompileCache
+        cache_root = str(tmp_path / "cache")
+        cache = CompileCache(cache_root)
+        cache.put("py-entry", b"hello-from-python")
+        cfg_path = str(tmp_path / "vtpu.config")
+        dev = vc.DeviceConfig(uuid="TPU-Q", total_memory=1 << 30,
+                              real_memory=1 << 30, hard_core=40,
+                              core_limit=vc.CORE_LIMIT_HARD)
+        cfg = vc.VtpuConfig(
+            pod_uid="uid-q", quota_epoch=7,
+            workload_class=vc.WORKLOAD_CLASS_LATENCY,
+            compile_cache_dir="/cache/mount", devices=[dev])
+        vc.write_config(cfg_path, cfg)
+        proc = subprocess.Popen([cxx_quota_probe, cfg_path, cache_root],
+                                stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline().split()
+            # the C++ reloader reads every v3 field Python wrote
+            assert line == ["epoch", "7", "class", "1", "lease", "0",
+                            "cache_dir", "/cache/mount", "eff", "40"]
+            # quota-market grant: rewrite with a bumped epoch; the
+            # probe's wait loop must adopt it
+            dev.lease_core = 25
+            cfg.quota_epoch = 8
+            vc.write_config(cfg_path, cfg)
+            line = proc.stdout.readline().split()
+            assert line == ["adopt", "8", "lease", "25", "eff", "65"]
+            # store interop: C++ verifies the Python-written entry...
+            assert proc.stdout.readline().strip() == \
+                "py_payload hello-from-python"
+            assert proc.stdout.readline().strip() == "leased 1"
+            # ...and its held lease excludes the Python store's
+            # single-flight acquisition (liveness = the flock)
+            assert not cache.try_acquire_lease("interop-key")
+            proc.stdin.close()
+            assert proc.stdout.readline().strip() == "released 1"
+            assert proc.wait(timeout=10) == 0
+            # release hands the key back to Python
+            assert cache.try_acquire_lease("interop-key")
+            cache.release_lease("interop-key")
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        # the C++-written entry reads back through the Python store
+        assert cache.get("cxx-entry") == b"from-cxx"
 
 
 class TestCxxStepRingWriter:

@@ -1,0 +1,910 @@
+"""vtqm suite: workload-class stamping, the lease ledger, the market
+manager's grant/revoke/expiry policy and its conservation invariant,
+the scheduler's headroom score term (gate-off byte-identical in BOTH
+data paths, stale-degrades-to-pre-market), the quota audit trail,
+scripts/vtpu_replay.py over a canned spool, the /utilization lease fold
++ vtpu-smi lent/borrowed columns, and the 24-seed reclaim-under-crash
+chaos harness (crash holding a grant, torn lease ledger, restart
+mid-revoke: no chip ever exceeds 100% summed effective rate and every
+lease converges revoked-or-expired)."""
+
+import json
+import os
+import subprocess
+import sys
+from random import Random
+
+import pytest
+
+from vtpu_manager import explain
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.node_config import NodeConfig
+from vtpu_manager.device import types as dt
+from vtpu_manager.deviceplugin.vnum import VnumPlugin
+from vtpu_manager.explain import doctor
+from vtpu_manager.manager.device_manager import DeviceManager
+from vtpu_manager.quota import (QuotaLeaseLedger, QuotaMarketManager,
+                                STATE_EXPIRED, STATE_GRANTED,
+                                STATE_REVOKED, effective_core,
+                                parse_lease_summary,
+                                sum_effective_by_chip, workload_class_abi,
+                                workload_class_of)
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.failpoints import CrashFailpoint
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.tpu.discovery import FakeBackend
+from vtpu_manager.util import consts
+from vtpu_manager.utilization import headroom as hr_mod
+from vtpu_manager.webhook.mutate import mutate_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LC = consts.WORKLOAD_CLASS_LATENCY_CRITICAL
+TP = consts.WORKLOAD_CLASS_THROUGHPUT
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    yield
+    explain.reset()
+    failpoints.disable()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def write_tenant(base, uid, cls, hard, chip=0, cont="main",
+                 uuid=None, core_limit=vc.CORE_LIMIT_HARD):
+    d = os.path.join(base, f"{uid}_{cont}", "config")
+    cfg = vc.VtpuConfig(
+        pod_uid=uid, container_name=cont, workload_class=cls,
+        devices=[vc.DeviceConfig(
+            uuid=uuid or f"TPU-{chip}", total_memory=1 << 30,
+            real_memory=1 << 30, hard_core=hard, core_limit=core_limit,
+            host_index=chip)])
+    path = os.path.join(d, "vtpu.config")
+    vc.write_config(path, cfg)
+    return path
+
+
+def read_tenant(base, uid, cont="main"):
+    return vc.read_config(
+        os.path.join(base, f"{uid}_{cont}", "config", "vtpu.config"))
+
+
+class FakeState:
+    """vtuse _TenantChip stand-in with the fields the market reads."""
+
+    def __init__(self, uid, cont, chip, used, var, wait, reclaim,
+                 conf=1.0):
+        self.pod_uid, self.container, self.host_index = uid, cont, chip
+        self.used_ewma, self.used_var, self.wait_frac = used, var, wait
+        self._reclaim, self._conf = reclaim, conf
+
+    def confidence(self, now):
+        return self._conf
+
+    def reclaim_core_pct(self, now):
+        return self._reclaim * self._conf
+
+
+class FakeUtil:
+    def __init__(self, states=None):
+        self.states = states or []
+        self.folds = 0
+
+    def fold(self, **kw):
+        self.folds += 1
+
+    def tenants(self):
+        return self.states
+
+
+def market_pair(tmp_path, lender_reclaim=35.0, borrower_wait=0.6,
+                **kw):
+    """One chip, a throughput lender (60%) + latency borrower (40%)."""
+    base = str(tmp_path)
+    write_tenant(base, "train", vc.WORKLOAD_CLASS_THROUGHPUT, 60)
+    write_tenant(base, "infer", vc.WORKLOAD_CLASS_LATENCY, 40)
+    util = FakeUtil([
+        FakeState("train", "main", 0, 20.0, 1.0, 0.0, lender_reclaim),
+        FakeState("infer", "main", 0, 39.0, 1.0, borrower_wait, 0.0)])
+    return QuotaMarketManager("node-t", base, util, **kw), util, base
+
+
+# ---------------------------------------------------------------------------
+# webhook stamping
+# ---------------------------------------------------------------------------
+
+def wl_pod(value=None, env=None, annotations=None):
+    anns = dict(annotations or {})
+    if value is not None:
+        anns[consts.workload_class_annotation()] = value
+    pod = {
+        "metadata": {"name": "p", "namespace": "d", "uid": "u",
+                     "annotations": anns},
+        "spec": {"containers": [{
+            "name": "main",
+            "env": ([{"name": consts.ENV_WORKLOAD_CLASS,
+                      "value": env}] if env else []),
+            "resources": {"limits": {
+                consts.vtpu_number_resource(): 1}}}]},
+    }
+    return pod
+
+
+class TestWorkloadClassStamping:
+    def _patched(self, result, ann):
+        return {p["path"].rsplit("/", 1)[-1]: p
+                for p in result.patches}.get(ann.replace("/", "~1"))
+
+    def test_annotation_normalized(self):
+        res = mutate_pod(wl_pod(" Latency-Critical "),
+                         stamp_workload_class=True)
+        ann = consts.workload_class_annotation()
+        patch = [p for p in res.patches
+                 if p["path"].endswith(ann.replace("/", "~1"))]
+        assert patch and patch[0]["value"] == LC
+
+    def test_env_fallback(self):
+        res = mutate_pod(wl_pod(env="throughput"),
+                         stamp_workload_class=True)
+        ann = consts.workload_class_annotation()
+        patch = [p for p in res.patches
+                 if p["path"].endswith(ann.replace("/", "~1"))]
+        assert patch and patch[0]["value"] == TP
+
+    def test_annotation_wins_over_env(self):
+        res = mutate_pod(wl_pod("throughput", env="latency-critical"),
+                         stamp_workload_class=True)
+        ann = consts.workload_class_annotation()
+        patches = [p for p in res.patches
+                   if p["path"].endswith(ann.replace("/", "~1"))]
+        assert not patches    # already normalized: no patch needed
+
+    def test_garbage_removed_with_warning(self):
+        res = mutate_pod(wl_pod("real-time"), stamp_workload_class=True)
+        ann = consts.workload_class_annotation()
+        removes = [p for p in res.patches
+                   if p["op"] == "remove"
+                   and p["path"].endswith(ann.replace("/", "~1"))]
+        assert removes
+        assert any("real-time" in w for w in res.warnings)
+
+    def test_gate_off_stamps_nothing(self):
+        res = mutate_pod(wl_pod(env="latency-critical"))
+        ann = consts.workload_class_annotation()
+        assert not [p for p in res.patches
+                    if ann.replace("/", "~1") in p["path"]]
+
+    def test_class_readers(self):
+        assert workload_class_of(wl_pod(LC)) == LC
+        assert workload_class_of(wl_pod("garbage")) == ""
+        assert workload_class_of({}) == ""
+        assert workload_class_abi(LC) == vc.WORKLOAD_CLASS_LATENCY
+        assert workload_class_abi(TP) == vc.WORKLOAD_CLASS_THROUGHPUT
+        assert workload_class_abi("") == vc.WORKLOAD_CLASS_NONE
+
+
+# ---------------------------------------------------------------------------
+# plugin stamps the class into the config ABI
+# ---------------------------------------------------------------------------
+
+class TestPluginStamping:
+    def _alloc(self, tmp_path, gate_on, annotations):
+        from vtpu_manager.device.claims import (DeviceClaim,
+                                                PodDeviceClaims)
+        client = FakeKubeClient()
+        mgr = DeviceManager("node-1", client,
+                            node_config=NodeConfig(device_split_count=4),
+                            backends=[FakeBackend(n_chips=1)])
+        mgr.init_devices()
+        p = VnumPlugin(mgr, client, "node-1",
+                       base_dir=str(tmp_path / "mgr"),
+                       node_config=NodeConfig())
+        p.quota_market_enabled = gate_on
+        chip = mgr.chips[0]
+        claims = PodDeviceClaims()
+        claims.add("main", DeviceClaim(chip.uuid, chip.index, 50,
+                                       1 << 30))
+        pod = {"metadata": {"name": "p1", "namespace": "d",
+                            "uid": "uid-p1",
+                            "annotations": dict(annotations)},
+               "spec": {"containers": [{"name": "main"}]}}
+        p._response_for(pod, "main", claims.containers["main"])
+        return vc.read_config(os.path.join(
+            str(tmp_path / "mgr"), "uid-p1_main", "config",
+            "vtpu.config"))
+
+    def test_gate_on_stamps_class(self, tmp_path):
+        cfg = self._alloc(tmp_path, True,
+                          {consts.workload_class_annotation(): LC})
+        assert cfg.workload_class == vc.WORKLOAD_CLASS_LATENCY
+        assert cfg.quota_epoch == 0
+        assert cfg.devices[0].lease_core == 0
+
+    def test_gate_off_zero_class(self, tmp_path):
+        cfg = self._alloc(tmp_path, False,
+                          {consts.workload_class_annotation(): LC})
+        assert cfg.workload_class == vc.WORKLOAD_CLASS_NONE
+
+
+# ---------------------------------------------------------------------------
+# lease ledger
+# ---------------------------------------------------------------------------
+
+class TestLeaseLedger:
+    def test_grant_settle_roundtrip(self, tmp_path):
+        led = QuotaLeaseLedger(str(tmp_path))
+        lease, epoch = led.grant(0, "t/main", "i/main", 10, 30.0,
+                                 now=100.0)
+        assert epoch == 1 and lease["state"] == STATE_GRANTED
+        assert led.active(now=105.0) and not led.due(now=105.0)
+        assert led.deltas(now=105.0) == {("i/main", 0): 10,
+                                         ("t/main", 0): -10}
+        # TTL ran out: due, no longer active, deltas empty
+        assert led.due(now=131.0) and not led.active(now=131.0)
+        assert led.deltas(now=131.0) == {}
+        e2 = led.settle([lease["id"]], STATE_EXPIRED, now=131.0)
+        assert e2 == 2
+        assert led.leases()[0]["state"] == STATE_EXPIRED
+
+    def test_settle_idempotent_epoch(self, tmp_path):
+        led = QuotaLeaseLedger(str(tmp_path))
+        lease, _ = led.grant(0, "a", "b", 5, 30.0, now=1.0)
+        led.settle([lease["id"]], STATE_REVOKED, now=2.0)
+        before = led.epoch()
+        # settling an already-settled lease bumps nothing
+        led.settle([lease["id"]], STATE_REVOKED, now=3.0)
+        assert led.epoch() == before
+
+    def test_torn_file_recovers_empty(self, tmp_path):
+        led = QuotaLeaseLedger(str(tmp_path))
+        _, pre_epoch = led.grant(0, "a", "b", 5, 30.0, now=1.0)
+        with open(led.path, "w") as f:
+            f.write('{"epoch": 3, "leas')     # torn mid-write
+        doc = led.load()
+        assert doc["leases"] == [] and doc.get("recovered")
+        # a recovered epoch is re-based on wall seconds, NEVER a reuse
+        # of a pre-tear value: the shim skips equal-epoch re-reads, so
+        # a post-tear generation reusing epoch 1 would never be adopted
+        assert doc["epoch"] > pre_epoch
+        # the next mutation rewrites a coherent file, epoch still moving
+        led.settle([], STATE_REVOKED, now=2.0)
+        assert led.load()["epoch"] > doc["epoch"]
+        assert not led.load().get("recovered")
+
+    def test_compact_keeps_granted(self, tmp_path):
+        led = QuotaLeaseLedger(str(tmp_path))
+        l1, _ = led.grant(0, "a", "b", 5, 1e6, now=1.0)
+        l2, _ = led.grant(0, "a", "c", 5, 1e6, now=1.0)
+        led.settle([l2["id"]], STATE_REVOKED, now=2.0)
+        led.compact(retain_s=10.0, now=10_000.0)
+        states = {l["id"]: l["state"] for l in led.leases()}
+        assert states == {l1["id"]: STATE_GRANTED}
+
+    def test_lease_summary_codec(self):
+        assert parse_lease_summary("0:25:2;1:10:1@100.0",
+                                   now=110.0) == {
+            0: {"lent_core_pct": 25, "leases": 2},
+            1: {"lent_core_pct": 10, "leases": 1}}
+        assert parse_lease_summary(None) is None
+        assert parse_lease_summary("0:25:2@100.0", now=500.0) is None
+        assert parse_lease_summary("garbage") is None
+        assert parse_lease_summary("0:a:b@100.0", now=101.0) is None
+
+
+# ---------------------------------------------------------------------------
+# market manager policy
+# ---------------------------------------------------------------------------
+
+class TestMarket:
+    def test_grant_moves_quota_conserving_chip(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        m.tick(now=10.0)
+        infer, train = read_tenant(base, "infer"), read_tenant(base,
+                                                               "train")
+        assert infer.devices[0].lease_core == 10
+        assert train.devices[0].lease_core == -10
+        assert infer.quota_epoch == train.quota_epoch == 1
+        assert sum_effective_by_chip(base)[0] == 100
+
+    def test_no_grant_without_borrower_stall(self, tmp_path):
+        m, util, base = market_pair(tmp_path, borrower_wait=0.05)
+        m.tick(now=10.0)
+        assert m.grants_total == 0
+        assert read_tenant(base, "infer").devices[0].lease_core == 0
+
+    def test_no_grant_from_stale_lender(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        util.states[0]._conf = 0.0     # lender signal decayed out
+        m.tick(now=10.0)
+        assert m.grants_total == 0
+
+    def test_unclassified_tenants_never_participate(self, tmp_path):
+        base = str(tmp_path)
+        write_tenant(base, "plain", vc.WORKLOAD_CLASS_NONE, 60)
+        write_tenant(base, "infer", vc.WORKLOAD_CLASS_LATENCY, 40)
+        util = FakeUtil([
+            FakeState("plain", "main", 0, 5.0, 0.0, 0.0, 50.0),
+            FakeState("infer", "main", 0, 39.0, 1.0, 0.9, 0.0)])
+        m = QuotaMarketManager("n", base, util)
+        m.tick(now=10.0)
+        assert m.grants_total == 0
+
+    def test_unthrottled_borrower_gets_nothing(self, tmp_path):
+        base = str(tmp_path)
+        write_tenant(base, "train", vc.WORKLOAD_CLASS_THROUGHPUT, 60)
+        write_tenant(base, "free", vc.WORKLOAD_CLASS_LATENCY, 0,
+                     core_limit=vc.CORE_LIMIT_NONE)
+        util = FakeUtil([
+            FakeState("train", "main", 0, 10.0, 0.0, 0.0, 40.0),
+            FakeState("free", "main", 0, 50.0, 1.0, 0.9, 0.0)])
+        m = QuotaMarketManager("n", base, util)
+        m.tick(now=10.0)
+        assert m.grants_total == 0
+
+    def test_grants_bounded_by_max_borrow(self, tmp_path):
+        m, util, base = market_pair(tmp_path, lender_reclaim=60.0)
+        m.max_borrow_pct = 15
+        for t in range(1, 6):
+            m.tick(now=float(t))
+        assert read_tenant(base, "infer").devices[0].lease_core <= 15
+
+    def test_revoke_on_lender_demand_and_cooldown(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        m.tick(now=1.0)
+        assert m.grants_total == 1
+        # lender's envelope climbs into the lent range
+        util.states[0].used_ewma = 50.0
+        util.states[0]._reclaim = 5.0
+        m.tick(now=2.0)
+        assert m.revokes_total == 1
+        assert read_tenant(base, "infer").devices[0].lease_core == 0
+        assert read_tenant(base, "train").devices[0].lease_core == 0
+        # lender looks idle again immediately — cooldown blocks the
+        # re-grant until it re-proves idleness across passes
+        util.states[0].used_ewma = 20.0
+        util.states[0]._reclaim = 35.0
+        m.tick(now=3.0)
+        assert m.grants_total == 1
+        m.tick(now=3.0 + m.cooldown_s + 1.0)
+        assert m.grants_total == 2
+
+    def test_revoke_on_stale_signal(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        m.tick(now=1.0)
+        util.states[0]._conf = 0.1     # below the revoke floor
+        m.tick(now=2.0)
+        assert m.revokes_total == 1
+        assert read_tenant(base, "infer").devices[0].lease_core == 0
+
+    def test_expiry_converges(self, tmp_path):
+        m, util, base = market_pair(tmp_path, lease_ttl_s=5.0)
+        m.tick(now=1.0)
+        assert read_tenant(base, "infer").devices[0].lease_core == 10
+        util.states[1].wait_frac = 0.0     # no more stall: no re-grant
+        m.tick(now=20.0)
+        assert m.expiries_total == 1
+        assert read_tenant(base, "infer").devices[0].lease_core == 0
+        assert all(l["state"] == STATE_EXPIRED
+                   for l in m.ledger.leases())
+
+    def test_party_gone_revokes(self, tmp_path):
+        import shutil
+        m, util, base = market_pair(tmp_path)
+        m.tick(now=1.0)
+        shutil.rmtree(os.path.join(base, "infer_main"))
+        m.tick(now=2.0)
+        assert m.revokes_total == 1
+        assert read_tenant(base, "train").devices[0].lease_core == 0
+
+    def test_oversubscribed_ledger_defense(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        # forge a corrupt ledger claiming an impossible grant
+        m.ledger.grant(0, "train/main", "infer/main", 90, 300.0,
+                       now=1.0)
+        m.tick(now=2.0)
+        sums = sum_effective_by_chip(base)
+        assert all(v <= 100 for v in sums.values())
+        assert all(l["state"] != STATE_GRANTED
+                   for l in m.ledger.leases()
+                   if l["pct"] == 90)
+
+    def test_restart_revokes_carried_leases(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        m.tick(now=1.0)
+        assert read_tenant(base, "infer").devices[0].lease_core == 10
+        # a NEW manager (plugin restart) must not trust carried grants
+        m2 = QuotaMarketManager("node-t", base, FakeUtil(util.states))
+        m2.recover()
+        assert read_tenant(base, "infer").devices[0].lease_core == 0
+        assert all(l["state"] in (STATE_REVOKED, STATE_EXPIRED)
+                   for l in m2.ledger.leases())
+
+    def test_torn_ledger_reconciles_to_base(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        m.tick(now=1.0)
+        with open(m.ledger.path, "w") as f:
+            f.write("{torn")
+        # quiet borrower: the pass must reconcile to base, not re-grant
+        util.states[1].wait_frac = 0.0
+        m.tick(now=2.0)
+        assert read_tenant(base, "infer").devices[0].lease_core == 0
+        assert read_tenant(base, "train").devices[0].lease_core == 0
+
+    def test_annotation_roundtrip(self, tmp_path):
+        m, util, base = market_pair(tmp_path)
+        m.tick(now=1.0)
+        summary = parse_lease_summary(m.encode_annotation(1.0), now=2.0)
+        assert summary == {0: {"lent_core_pct": 10, "leases": 1}}
+
+    def test_effective_core_clamps(self):
+        assert effective_core(60, -70) == 0
+        assert effective_core(60, 50) == 100
+        assert effective_core(60, 10) == 70
+
+
+# ---------------------------------------------------------------------------
+# scheduler: the headroom term goes real
+# ---------------------------------------------------------------------------
+
+def two_node_cluster(headroom_on_node1=40.0, ts=None):
+    import time as _t
+    client = FakeKubeClient()
+    for i in range(2):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                               uuid_prefix=f"TPU-N{i}")
+        client.add_node(dt.fake_node(f"node-{i}", reg))
+    if headroom_on_node1:
+        node = client.get_node("node-1")
+        node["metadata"]["annotations"][
+            consts.node_reclaimable_headroom_annotation()] = \
+            hr_mod.NodeHeadroom(chips={0: hr_mod.ChipHeadroom(
+                80.0, 20.0, headroom_on_node1, 2 << 30)},
+                ts=ts if ts is not None else _t.time()).encode()
+        client.add_node(node)
+    return client
+
+
+def vtpu_pod(name="p1", number=1, cores=25, memory_mib=1024,
+             annotations=None):
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}",
+                     "annotations": annotations or {}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): number,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): memory_mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def place(pred, client, pod):
+    client.add_pod(pod)
+    result = pred.filter({"Pod": pod})
+    assert not result.error, result.error
+    assert len(result.node_names) == 1
+    return result.node_names[0]
+
+
+def lc_ann():
+    return {consts.workload_class_annotation(): LC}
+
+
+class TestSchedulerTerm:
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_latency_pod_prefers_headroom_node(self, mode):
+        client = two_node_cluster()
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap, quota_market=True)
+        # equal capacity: the fresh headroom on node-1 breaks the tie
+        assert place(pred, client,
+                     vtpu_pod("lc", annotations=lc_ann())) == "node-1"
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_other_classes_unaffected(self, mode):
+        def run(quota_market):
+            client = two_node_cluster()
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap,
+                                   quota_market=quota_market)
+            out = []
+            for i, anns in enumerate((
+                    {}, {consts.workload_class_annotation(): TP})):
+                out.append(place(pred, client,
+                                 vtpu_pod(f"{mode}-{i}",
+                                          annotations=anns)))
+            return out
+
+        assert run(True) == run(False)
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_gate_off_never_touches_term(self, mode, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("headroom term on a gate-off pass")
+        monkeypatch.setattr(hr_mod, "headroom_score_term", boom)
+        client = two_node_cluster()
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap)
+        place(pred, client, vtpu_pod("off", annotations=lc_ann()))
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_stale_headroom_degrades_to_pre_market(self, mode):
+        import time as _t
+
+        def run(quota_market, ts):
+            client = two_node_cluster(ts=ts)
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap,
+                                   quota_market=quota_market)
+            return place(pred, client,
+                         vtpu_pod(f"st-{quota_market}",
+                                  annotations=lc_ann()))
+
+        stale_ts = _t.time() - 10 * hr_mod.MAX_HEADROOM_AGE_S
+        # a stale signal contributes 0.0: byte-identical to market off
+        assert run(True, stale_ts) == run(False, stale_ts)
+
+    def test_modes_agree_market_on(self):
+        results = {}
+        for mode in ("ttl", "snapshot"):
+            client = two_node_cluster()
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap,
+                                   quota_market=True)
+            results[mode] = [
+                place(pred, client, vtpu_pod(f"{mode}-{i}",
+                                             annotations=lc_ann()))
+                for i in range(3)]
+        assert results["ttl"] == results["snapshot"]
+
+    def test_explain_record_carries_scored_term(self, tmp_path):
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = two_node_cluster()
+        pred = FilterPredicate(client, quota_market=True)
+        chosen = place(pred, client, vtpu_pod("lc",
+                                              annotations=lc_ann()))
+        assert chosen == "node-1"
+        explain.flush()
+        records, _ = doctor.read_records(str(tmp_path / "ex"))
+        rec = doctor.latest_decision(
+            doctor.records_for_pod(records, "uid-lc"))
+        cands = {c["node"]: c for c in rec["candidates"]}
+        assert cands["node-1"]["headroom_term"] == pytest.approx(40.0)
+        assert cands["node-1"]["headroom_input"] == pytest.approx(40.0)
+        assert cands["node-0"]["headroom_term"] == 0.0
+        for c in cands.values():
+            # the scored-term arithmetic reproduces from the record
+            assert c["total"] == pytest.approx(
+                c["base"] - c["pressure"] - c["storm"]
+                + c["gang_bonus"] + c["headroom_term"])
+        assert rec["margin"] == pytest.approx(
+            cands["node-1"]["total"] - cands["node-0"]["total"])
+
+    def test_term_capped(self):
+        import time as _t
+        hr = hr_mod.NodeHeadroom(chips={
+            i: hr_mod.ChipHeadroom(100.0, 0.0, 90.0, 0)
+            for i in range(4)}, ts=_t.time())
+        assert hr_mod.headroom_score_input(hr) == pytest.approx(360.0)
+        assert hr_mod.headroom_score_term(hr) == \
+            hr_mod.HEADROOM_TERM_CAP
+        assert hr_mod.headroom_term_from_input(360.0) == \
+            hr_mod.HEADROOM_TERM_CAP
+        assert hr_mod.headroom_term_from_input(-5.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# audit trail
+# ---------------------------------------------------------------------------
+
+class TestAudit:
+    def test_grant_and_revoke_records(self, tmp_path):
+        explain.configure("plugin", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        m, util, base = market_pair(tmp_path / "node")
+        m.tick(now=1.0)
+        util.states[0]._conf = 0.0
+        m.tick(now=2.0)
+        explain.flush()
+        records, _ = doctor.read_records(str(tmp_path / "ex"))
+        quota = [r for r in records if r.get("kind") == "quota"]
+        ops = [r["op"] for r in quota]
+        assert "grant" in ops and "revoke" in ops
+        g = next(r for r in quota if r["op"] == "grant")
+        assert g["lender"] == "train/main"
+        assert g["borrower"] == "infer/main"
+        assert g["pct"] == 10 and g["chip"] == 0 and g["epoch"] == 1
+        r = next(r for r in quota if r["op"] == "revoke")
+        assert r["why"] == "stale-signal" and r["epoch"] > g["epoch"]
+
+    def test_trace_events_per_party(self, tmp_path):
+        from vtpu_manager import trace
+        trace.configure("plugin", spool_dir=str(tmp_path / "sp"),
+                        sampling_rate=1.0, flush_interval_s=3600.0)
+        try:
+            m, util, base = market_pair(tmp_path / "node")
+            m.tick(now=1.0)
+            trace.flush()
+            spans = []
+            spool_dir = str(tmp_path / "sp")
+            for f in os.listdir(spool_dir):
+                if f.endswith(".jsonl"):
+                    with open(os.path.join(spool_dir, f)) as fh:
+                        spans += [json.loads(l) for l in fh
+                                  if l.strip()]
+            quota_spans = [s for s in spans
+                           if s.get("stage") == "quota.grant"]
+            roles = {s["attrs"]["role"] for s in quota_spans}
+            assert roles == {"lender", "borrower"}
+        finally:
+            trace.reset()
+
+
+# ---------------------------------------------------------------------------
+# replay CLI
+# ---------------------------------------------------------------------------
+
+class TestReplayCLI:
+    def test_canned_spool(self, tmp_path):
+        recs = [
+            {"kind": "decision", "pod": "u1", "name": "p1", "ts": 1.0,
+             "mode": "ttl", "chosen": "n1", "candidates": [
+                 {"node": "n1", "base": 50.0, "pressure": 0.0,
+                  "storm": 0.0, "gang_bonus": 0.0,
+                  "headroom_input": 0.0, "topology": "none",
+                  "total": 50.0},
+                 {"node": "n2", "base": 45.0, "pressure": 0.0,
+                  "storm": 0.0, "gang_bonus": 0.0,
+                  "headroom_input": 30.0, "topology": "none",
+                  "total": 45.0}]},
+            {"kind": "decision", "pod": "u2", "name": "p2", "ts": 2.0,
+             "mode": "snapshot", "chosen": "n1", "candidates": [
+                 {"node": "n1", "base": 50.0, "pressure": 0.0,
+                  "storm": 0.0, "gang_bonus": 0.0,
+                  "headroom_input": 25.0, "topology": "none",
+                  "total": 50.0},
+                 {"node": "n2", "base": 20.0, "pressure": 0.0,
+                  "storm": 0.0, "gang_bonus": 0.0,
+                  "headroom_input": 0.0, "topology": "none",
+                  "total": 20.0}]},
+            {"kind": "bind", "pod": "u1", "ts": 3.0},
+        ]
+        with open(tmp_path / "scheduler.9.jsonl", "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "vtpu_replay.py"),
+             "--explain-dir", str(tmp_path), "--json"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["decisions"] == 2
+        assert doc["flips"] == 1
+        flip = next(r for r in doc["rows"] if r["flip"])
+        assert flip["pod"] == "u1"
+        assert flip["replay_winner"] == "n2"   # 45 + 30 > 50
+        assert flip["recorded_margin"] == pytest.approx(5.0)
+        assert flip["replay_margin"] == pytest.approx(25.0)
+        # human mode + --flips-only
+        out2 = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "vtpu_replay.py"),
+             "--explain-dir", str(tmp_path), "--flips-only"],
+            capture_output=True, text=True)
+        assert out2.returncode == 0
+        assert "FLIP" in out2.stdout and "p2" not in out2.stdout
+
+    def test_no_records_exit_1(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "vtpu_replay.py"),
+             "--explain-dir", str(tmp_path)],
+            capture_output=True, text=True)
+        assert out.returncode == 1
+
+    def test_already_scored_records_replay_fixed_point(self):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import vtpu_replay
+        rec = {"kind": "decision", "pod": "u", "chosen": "n2",
+               "ts": 1.0, "candidates": [
+                   {"node": "n1", "total": 50.0, "headroom_input": 0.0,
+                    "headroom_term": 0.0},
+                   {"node": "n2", "total": 75.0,
+                    "headroom_input": 30.0, "headroom_term": 30.0}]}
+        row = vtpu_replay.rescore_record(rec)
+        assert not row["flip"]
+        assert row["margin_delta"] == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# /utilization lease fold + vtpu-smi columns
+# ---------------------------------------------------------------------------
+
+class TestRollupAndSmi:
+    def _doc(self, tmp_path):
+        from vtpu_manager.utilization.rollup import ClusterRollup
+        from vtpu_manager.utilization.ledger import UtilizationLedger
+
+        class Chip:
+            def __init__(self, i):
+                self.index, self.uuid, self.memory = i, f"TPU-{i}", 1 << 30
+                self.split_count, self.healthy = 4, True
+
+        import time as _t
+        base = str(tmp_path / "node")
+        m, util, _ = market_pair(tmp_path / "node")
+        m.tick(now=_t.time())     # fresh lease: collect() judges TTLs
+        led = UtilizationLedger("node-t", [Chip(0)], base_dir=base)
+        roll = ClusterRollup(led, client=None, quota_dir=base)
+        return roll.collect()
+
+    def test_document_gains_quota_block_and_columns(self, tmp_path):
+        doc = self._doc(tmp_path)
+        assert doc["quota"]["leases_active"] == 1
+        assert doc["quota"]["lent_core_pct_total"] == 10
+        rows = {(t["pod_uid"], t["chip_index"]): t
+                for t in doc["tenants"]}
+        assert rows[("infer", 0)]["borrowed_core_pct"] == 10
+        assert rows[("train", 0)]["lent_core_pct"] == 10
+
+    def test_gate_off_document_unchanged(self, tmp_path):
+        from vtpu_manager.utilization.rollup import ClusterRollup
+        from vtpu_manager.utilization.ledger import UtilizationLedger
+        led = UtilizationLedger("node-t", [], base_dir=str(tmp_path))
+        doc = ClusterRollup(led, client=None).collect()
+        assert "quota" not in doc
+        assert not any("lent_core_pct" in t or "borrowed_core_pct" in t
+                       for t in doc["tenants"])
+
+    def test_smi_renders_lent_borrowed(self, tmp_path):
+        doc = self._doc(tmp_path)
+        path = tmp_path / "doc.json"
+        path.write_text(json.dumps(doc))
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "vtpu_smi.py"),
+             "--from-file", str(path)],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "lent" in out.stdout and "borrow" in out.stdout
+        assert "market: 1 lease(s)" in out.stdout
+        outj = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "vtpu_smi.py"),
+             "--from-file", str(path), "--json"],
+            capture_output=True, text=True)
+        assert outj.returncode == 0
+        parsed = json.loads(outj.stdout)
+        assert parsed["quota"]["leases_active"] == 1
+
+
+# ---------------------------------------------------------------------------
+# reclaim-under-crash chaos (24 seeds)
+# ---------------------------------------------------------------------------
+
+CHAOS_SEEDS = range(24) if "CHAOS_SEED" not in os.environ else \
+    [int(os.environ["CHAOS_SEED"])]
+
+
+class TestReclaimChaos:
+    @pytest.mark.parametrize("seed", CHAOS_SEEDS)
+    def test_crash_torn_restart_converge(self, tmp_path, seed):
+        rng = Random(seed)
+        base = str(tmp_path)
+        # 2-4 tenants over 1-2 chips, random classes/rates
+        n_chips = rng.randint(1, 2)
+        tenants = []
+        free = {c: 100 for c in range(n_chips)}  # the scheduler would
+        for i in range(rng.randint(2, 4)):       # never overcommit hard
+            chip = rng.randrange(n_chips)        # quotas; neither may we
+            cls = rng.choice([vc.WORKLOAD_CLASS_THROUGHPUT,
+                              vc.WORKLOAD_CLASS_LATENCY,
+                              vc.WORKLOAD_CLASS_NONE])
+            hard = min(rng.choice([20, 30, 40]), free[chip])
+            if hard < 10:
+                continue
+            free[chip] -= hard
+            write_tenant(base, f"t{i}", cls, hard, chip=chip)
+            reclaim = rng.uniform(5, hard - 5) \
+                if cls == vc.WORKLOAD_CLASS_THROUGHPUT else 0.0
+            wait = rng.uniform(0.3, 0.9) \
+                if cls == vc.WORKLOAD_CLASS_LATENCY else 0.0
+            tenants.append(FakeState(f"t{i}", "main", chip,
+                                     rng.uniform(5, 15), 1.0, wait,
+                                     reclaim))
+        util = FakeUtil(tenants)
+        m = QuotaMarketManager("chaos", base, util,
+                               lease_ttl_s=rng.uniform(5.0, 20.0))
+
+        failpoints.enable(seed=seed)
+        failpoints.arm("quota.lease",
+                       rng.choice(["crash", "partial-write", "error"]),
+                       p=0.5, count=rng.randint(1, 3))
+        failpoints.arm("quota.revoke",
+                       rng.choice(["crash", "partial-write"]),
+                       p=0.5, count=rng.randint(1, 2))
+
+        now = 0.0
+        crashes = 0
+        for round_no in range(12):
+            now += rng.uniform(2.0, 8.0)
+            # occasionally flip lender demand to force revokes
+            if rng.random() < 0.3:
+                for s in tenants:
+                    if s._reclaim:
+                        s.used_ewma = rng.uniform(25, 60)
+                        s._reclaim = rng.uniform(0, 4)
+            try:
+                m.tick(now=now)
+            except CrashFailpoint:
+                crashes += 1
+                # the manager "process" died; a new one starts and
+                # must recover before any market activity (the
+                # restart rule) — possibly crashing again mid-recovery
+                m = QuotaMarketManager("chaos", base,
+                                       FakeUtil(tenants),
+                                       lease_ttl_s=10.0)
+                try:
+                    m.recover()
+                except CrashFailpoint:
+                    crashes += 1
+                    m = QuotaMarketManager("chaos", base,
+                                           FakeUtil(tenants),
+                                           lease_ttl_s=10.0)
+                except Exception:
+                    pass     # injected error mid-recovery: next pass
+            except Exception:
+                pass     # error-action injection: next pass retries
+            # INVARIANT after every round, mid-chaos: no chip's
+            # on-disk effective rates ever exceed 100 summed
+            sums = sum_effective_by_chip(base)
+            assert all(v <= 100 for v in sums.values()), (seed, sums)
+
+        # convergence: chaos off, demand gone, headroom gone — every
+        # lease must settle revoked-or-expired and configs reach base
+        failpoints.disable()
+        for s in tenants:
+            s._reclaim = 0.0
+            s.wait_frac = 0.0
+            s._conf = 0.0
+        m2 = QuotaMarketManager("chaos", base, FakeUtil(tenants))
+        m2.recover()
+        now += 100.0
+        m2.tick(now=now)
+        for lease in m2.ledger.leases():
+            assert lease["state"] in (STATE_REVOKED, STATE_EXPIRED), \
+                (seed, lease)
+        for uid_dir in os.listdir(base):
+            cfg_path = os.path.join(base, uid_dir, "config",
+                                    "vtpu.config")
+            if not os.path.exists(cfg_path):
+                continue
+            cfg = vc.read_config(cfg_path)
+            assert all(d.lease_core == 0 for d in cfg.devices), \
+                (seed, uid_dir)
+        sums = sum_effective_by_chip(base)
+        assert all(v <= 100 for v in sums.values()), (seed, sums)

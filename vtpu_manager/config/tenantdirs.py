@@ -15,18 +15,14 @@ import os
 from typing import Iterator
 
 
-def iter_container_configs(base_dir: str) -> Iterator[
-        tuple[str, str, object, bool, float]]:
-    """Yield ``(pod_uid_or_claim, container_label, config, is_dra,
-    config_mtime)`` per tenant partition. A claim-level "config" plus
-    one "config_<request>" per request of a multi-request DRA claim —
-    each is its own tenant partition (label ``<container>/<request>``)
-    and must be counted separately. ``is_dra`` flags tenants the
-    kubelet's device-plugin-era pod-resources API can never
-    corroborate; ``config_mtime`` is the tenant-age signal for the
-    collector's startup grace. Unreadable entries are skipped (a torn
-    config is the writer's crash window, not the reader's problem)."""
-    from vtpu_manager.config import vtpu_config as vc
+def iter_container_config_paths(base_dir: str) -> Iterator[
+        tuple[str, str, str, bool]]:
+    """Path layer of the one walk: ``(pod_uid_or_claim,
+    container_label, config_path, is_dra)`` per tenant partition —
+    shared by the decoding iterator below and by writers that must
+    REWRITE a tenant's config in place (the vtqm market manager's
+    grant/revoke path), so the path derivation cannot drift from the
+    labeling."""
     if not os.path.isdir(base_dir):
         return
     for entry in sorted(os.listdir(base_dir)):
@@ -49,8 +45,25 @@ def iter_container_configs(base_dir: str) -> Iterator[
                 if config_name != "config" else ""
             label = f"{container}/{suffix}" if suffix else container
             is_dra = entry.startswith("claim_") or bool(suffix)
-            try:
-                yield (pod_uid, label, vc.read_config(cfg_path),
-                       is_dra, os.path.getmtime(cfg_path))
-            except (OSError, ValueError):
-                continue
+            yield (pod_uid, label, cfg_path, is_dra)
+
+
+def iter_container_configs(base_dir: str) -> Iterator[
+        tuple[str, str, object, bool, float]]:
+    """Yield ``(pod_uid_or_claim, container_label, config, is_dra,
+    config_mtime)`` per tenant partition. A claim-level "config" plus
+    one "config_<request>" per request of a multi-request DRA claim —
+    each is its own tenant partition (label ``<container>/<request>``)
+    and must be counted separately. ``is_dra`` flags tenants the
+    kubelet's device-plugin-era pod-resources API can never
+    corroborate; ``config_mtime`` is the tenant-age signal for the
+    collector's startup grace. Unreadable entries are skipped (a torn
+    config is the writer's crash window, not the reader's problem)."""
+    from vtpu_manager.config import vtpu_config as vc
+    for pod_uid, label, cfg_path, is_dra in \
+            iter_container_config_paths(base_dir):
+        try:
+            yield (pod_uid, label, vc.read_config(cfg_path),
+                   is_dra, os.path.getmtime(cfg_path))
+        except (OSError, ValueError):
+            continue

@@ -24,12 +24,29 @@ MAGIC = 0x55505456          # "VTPU" little-endian
 # cache mount the shim/runtime client arms on; empty = cache off for
 # this container). Version is checked strictly: a v1 reader also fails
 # the size check first, and plugin + shim ship together per node.
-VERSION = 2
+# v3 (vtqm, the elastic quota market): header grew workload_class (i32,
+# the webhook-stamped latency-critical/throughput class) + quota_epoch
+# (u32, bumped by the node's quota-market manager on EVERY lease grant
+# or revoke — the C++ shim's token-wait loop re-reads the config when
+# the epoch moves, which is what bounds revoke-to-enforcement latency
+# at one throttle quantum + one re-read); the device struct's trailing
+# pad became lease_core (i32, signed core-% delta: >0 borrowed from a
+# co-tenant, <0 lent to one; 0 = no lease, byte-identical to the old
+# pad). Size/offset changes only in the header (+8), device layout
+# unchanged.
+VERSION = 3
 MAX_DEVICE_COUNT = 64
 UUID_LEN = 64
 NAME_LEN = 64
 POD_UID_LEN = 48
 CACHE_DIR_LEN = 64
+
+# Workload classes (vtqm): stamped by the webhook from the pod
+# annotation into the config so the shim and the node's quota-market
+# manager agree on which side of the market a tenant sits.
+WORKLOAD_CLASS_NONE = 0          # unclassified: never lends, never borrows
+WORKLOAD_CLASS_LATENCY = 1       # latency-critical serving (borrower side)
+WORKLOAD_CLASS_THROUGHPUT = 2    # throughput training (lender side)
 
 # Core-limit enum (device_t.core_limit analogue; reference hook.h:198-209
 # splits this into hard_limit/core_limit flags — one enum is cleaner)
@@ -39,17 +56,18 @@ CORE_LIMIT_SOFT = 2      # balance policy: elastic hard_core..soft_core
 
 # vtpu_device_t: uuid[64], total_memory u64, real_memory u64,
 # hard_core i32, soft_core i32, core_limit i32, memory_limit i32,
-# memory_oversold i32, host_index i32, mesh_x/y/z i32, pad i32
+# memory_oversold i32, host_index i32, mesh_x/y/z i32, lease_core i32
+# (v3: the former pad — signed borrowed/lent core-% delta)
 _DEVICE_FMT = "<64sQQ10i"
 DEVICE_SIZE = struct.calcsize(_DEVICE_FMT)
 assert DEVICE_SIZE == 120
 
 # vtpu_config_t header: magic u32, version u32, pod_uid[48], pod_name[64],
 # pod_namespace[64], container_name[64], device_count i32, compat_mode i32,
-# compile_cache_dir[64]
-_HEADER_FMT = "<II48s64s64s64sii64s"
+# compile_cache_dir[64], workload_class i32, quota_epoch u32
+_HEADER_FMT = "<II48s64s64s64sii64siI"
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
-assert HEADER_SIZE == 320
+assert HEADER_SIZE == 328
 
 _FOOTER_FMT = "<II"        # checksum u32, pad u32
 CONFIG_SIZE = HEADER_SIZE + MAX_DEVICE_COUNT * DEVICE_SIZE + \
@@ -86,6 +104,11 @@ class DeviceConfig:
     memory_oversold: bool = False
     host_index: int = 0
     mesh: tuple[int, int, int] = (0, 0, 0)
+    # vtqm: signed quota-lease delta in core % (>0 = borrowed from a
+    # co-tenant on the chip, <0 = lent to one); the shim's effective
+    # rate is clamp(hard_core + lease_core, 0, 100). 0 byte-identical
+    # to the pre-v3 pad, so gate-off configs are unchanged on the wire.
+    lease_core: int = 0
 
     def pack(self) -> bytes:
         return struct.pack(
@@ -93,17 +116,17 @@ class DeviceConfig:
             self.real_memory, self.hard_core, self.soft_core,
             self.core_limit, 1 if self.memory_limit else 0,
             1 if self.memory_oversold else 0, self.host_index,
-            self.mesh[0], self.mesh[1], self.mesh[2], 0)
+            self.mesh[0], self.mesh[1], self.mesh[2], self.lease_core)
 
     @staticmethod
     def unpack(raw: bytes) -> "DeviceConfig":
         (uuid, total, real, hard, soft, climit, mlimit, oversold, hidx,
-         mx, my, mz, _pad) = struct.unpack(_DEVICE_FMT, raw)
+         mx, my, mz, lease) = struct.unpack(_DEVICE_FMT, raw)
         return DeviceConfig(uuid=_from_cstr(uuid), total_memory=total,
                             real_memory=real, hard_core=hard, soft_core=soft,
                             core_limit=climit, memory_limit=bool(mlimit),
                             memory_oversold=bool(oversold), host_index=hidx,
-                            mesh=(mx, my, mz))
+                            mesh=(mx, my, mz), lease_core=lease)
 
 
 @dataclass
@@ -119,6 +142,13 @@ class VtpuConfig:
     # ("" = CompileCache gate off for this container — the shim arms
     # only on a non-empty value, same as the env channel)
     compile_cache_dir: str = ""
+    # vtqm: the tenant's workload class (WORKLOAD_CLASS_*; 0 when the
+    # QuotaMarket gate is off or the pod is unclassified)
+    workload_class: int = WORKLOAD_CLASS_NONE
+    # vtqm: lease generation. The market manager bumps it on every
+    # grant/revoke it writes into this config; the shim re-reads the
+    # file when the on-disk epoch differs from the one it loaded.
+    quota_epoch: int = 0
     devices: list[DeviceConfig] = field(default_factory=list)
 
     def pack(self) -> bytes:
@@ -131,7 +161,8 @@ class VtpuConfig:
             _cstr(self.pod_namespace, NAME_LEN),
             _cstr(self.container_name, NAME_LEN),
             len(self.devices), self.compat_mode,
-            _cstr(self.compile_cache_dir, CACHE_DIR_LEN))
+            _cstr(self.compile_cache_dir, CACHE_DIR_LEN),
+            self.workload_class, self.quota_epoch & 0xFFFFFFFF)
         for dev in self.devices:
             body += dev.pack()
         body += b"\0" * (DEVICE_SIZE * (MAX_DEVICE_COUNT - len(self.devices)))
@@ -153,7 +184,8 @@ class VtpuConfig:
         if _fnv1a(raw[: CONFIG_SIZE - 8]) != checksum:
             raise ValueError("config checksum mismatch (torn write?)")
         (magic, version, pod_uid, pod_name, pod_ns, cont_name, count,
-         compat, cache_dir) = struct.unpack_from(_HEADER_FMT, raw, 0)
+         compat, cache_dir, wl_class,
+         quota_epoch) = struct.unpack_from(_HEADER_FMT, raw, 0)
         if magic != MAGIC:
             raise ValueError(f"bad magic {magic:#x}")
         if version != VERSION:
@@ -165,7 +197,9 @@ class VtpuConfig:
                          pod_namespace=_from_cstr(pod_ns),
                          container_name=_from_cstr(cont_name),
                          compat_mode=compat,
-                         compile_cache_dir=_from_cstr(cache_dir))
+                         compile_cache_dir=_from_cstr(cache_dir),
+                         workload_class=wl_class,
+                         quota_epoch=quota_epoch)
         for i in range(count):
             off = HEADER_SIZE + i * DEVICE_SIZE
             cfg.devices.append(
@@ -195,10 +229,11 @@ DEVICE_OFFSETS = {
     "uuid": 0, "total_memory": 64, "real_memory": 72, "hard_core": 80,
     "soft_core": 84, "core_limit": 88, "memory_limit": 92,
     "memory_oversold": 96, "host_index": 100, "mesh_x": 104, "mesh_y": 108,
-    "mesh_z": 112,
+    "mesh_z": 112, "lease_core": 116,
 }
 HEADER_OFFSETS = {
     "magic": 0, "version": 4, "pod_uid": 8, "pod_name": 56,
     "pod_namespace": 120, "container_name": 184, "device_count": 248,
-    "compat_mode": 252, "compile_cache_dir": 256,
+    "compat_mode": 252, "compile_cache_dir": 256, "workload_class": 320,
+    "quota_epoch": 324,
 }

@@ -55,6 +55,7 @@ class VnumPlugin(DevicePluginServicer):
     preferred_allocation_available = False   # gated: HonorPreAllocatedDeviceIDs
     step_telemetry_enabled = False           # gated: StepTelemetry (vttel)
     compile_cache_enabled = False            # gated: CompileCache (vtcc)
+    quota_market_enabled = False             # gated: QuotaMarket (vtqm)
 
     def __init__(self, manager: DeviceManager, client: KubeClient,
                  node_name: str, node_config: NodeConfig | None = None,
@@ -386,6 +387,15 @@ class VnumPlugin(DevicePluginServicer):
                     log.warning("compile cache dir %s unavailable (%s); "
                                 "tenant %s/%s compiles uncached",
                                 cc_host, e, uid, cont)
+            # vtqm: the webhook-normalized workload class rides into the
+            # config ABI so the shim and the node's market manager agree
+            # on which side of the market this tenant sits; gate off =
+            # WORKLOAD_CLASS_NONE = the zero bytes v2 carried
+            wl_class = vc.WORKLOAD_CLASS_NONE
+            if self.quota_market_enabled:
+                from vtpu_manager import quota
+                wl_class = quota.workload_class_abi(
+                    quota.workload_class_of(pod))
             with trace.span(ctx, "plugin.config", container=cont,
                             devices=len(devices)):
                 os.makedirs(config_host, exist_ok=True)
@@ -400,6 +410,7 @@ class VnumPlugin(DevicePluginServicer):
                                     compile_cache_dir=(
                                         consts.COMPILE_CACHE_DIR
                                         if cc_ok else ""),
+                                    workload_class=wl_class,
                                     devices=devices)
                 cfg_path = os.path.join(config_host, "vtpu.config")
                 vc.write_config(cfg_path, cfg)

@@ -105,18 +105,23 @@ class DecisionBuilder:
 
     def candidate(self, node: str, base: float, pressure: float,
                   storm: float, gang_bonus: float, headroom_input: float,
-                  topology: str, total: float) -> None:
+                  topology: str, total: float,
+                  headroom_term: float = 0.0) -> None:
         """One scored candidate with the EXACT values applied:
-        ``total == base - pressure - storm + gang_bonus`` holds by
-        construction (asserted end-to-end by test_explain), and
-        ``headroom_input`` is the observe-only vtuse signal that never
-        reached the total. Past the cap the record keeps the TOP
+        ``total == base - pressure - storm + gang_bonus +
+        headroom_term`` holds by construction (asserted end-to-end by
+        test_explain/test_quota). ``headroom_input`` is the raw vtuse
+        signal; ``headroom_term`` is what the QuotaMarket gate actually
+        scored from it (0.0 when the gate is off, the pod is not
+        latency-critical, or the signal was stale — the observe-only
+        shape PR 8/9 recorded). Past the cap the record keeps the TOP
         candidates by total (a raised FilterPredicate.candidate_limit
         must never evict the eventual winner from its own record — the
         reproduce-the-winner invariant), and counts what it dropped."""
         row = {"node": node, "base": base, "pressure": pressure,
                "storm": storm, "gang_bonus": gang_bonus,
                "headroom_input": headroom_input,
+               "headroom_term": headroom_term,
                "topology": topology, "total": total}
         cands = self.record["candidates"]
         if len(cands) < MAX_CANDIDATES:

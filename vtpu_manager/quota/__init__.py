@@ -1,0 +1,100 @@
+"""vtqm: the elastic quota market (QuotaMarket gate, default off).
+
+The reference enforces a *static* split of each chip; vtqm lets a
+chip's measured-idle TensorCore % flow between co-tenants with instant
+shim-side reclaim:
+
+- workload classes (``latency-critical`` vs ``throughput``) are
+  normalized by the webhook into one pod annotation and stamped by the
+  device plugin into the v3 config ABI;
+- :mod:`ledger` is the node-local FileLock'd lease record whose epoch
+  drives the C++ shim's config re-read;
+- :mod:`market` is the device-plugin daemon granting/revoking bounded
+  TTL'd leases against the vtuse reclaimable-headroom measurement;
+- the scheduler's headroom score input (observe-only since PR 8)
+  becomes a REAL term for latency-critical pods
+  (utilization/headroom.py's ``headroom_score_term``), validated by
+  replaying recorded decisions (scripts/vtpu_replay.py).
+
+Gate off = byte-identical: no annotation stamped, no ledger file, no
+score change, configs carry the zero bytes the pre-v3 layout carried.
+"""
+
+from __future__ import annotations
+
+import math
+
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.quota.ledger import (QuotaLeaseLedger, STATE_EXPIRED,
+                                       STATE_GRANTED, STATE_REVOKED,
+                                       lease_is_active)
+from vtpu_manager.quota.market import (CLASS_TO_ABI, QuotaMarketManager,
+                                       effective_core,
+                                       sum_effective_by_chip)
+from vtpu_manager.util import consts
+
+__all__ = [
+    "QuotaLeaseLedger", "QuotaMarketManager", "CLASS_TO_ABI",
+    "STATE_GRANTED", "STATE_REVOKED", "STATE_EXPIRED",
+    "lease_is_active", "effective_core", "sum_effective_by_chip",
+    "workload_class_of", "workload_class_abi", "parse_lease_summary",
+]
+
+# a lease-summary annotation older than this reads as no-signal (the
+# pressure/headroom staleness rule)
+MAX_LEASE_SUMMARY_AGE_S = 120.0
+
+
+def workload_class_of(pod: dict) -> str:
+    """The pod's normalized workload class ("" = unclassified). Reads
+    ONLY the webhook-stamped annotation — hot paths never parse
+    container specs (the program-fingerprint rule), and an un-admitted
+    value that skipped normalization is ignored rather than trusted."""
+    anns = (pod.get("metadata") or {}).get("annotations") or {}
+    raw = anns.get(consts.workload_class_annotation(), "")
+    return raw if raw in consts.WORKLOAD_CLASSES else ""
+
+
+def workload_class_abi(cls: str) -> int:
+    """Annotation value -> config ABI value (0 for unclassified)."""
+    return CLASS_TO_ABI.get(cls, vc.WORKLOAD_CLASS_NONE)
+
+
+def parse_lease_summary(raw: str | None, now: float | None = None,
+                        max_age_s: float = MAX_LEASE_SUMMARY_AGE_S
+                        ) -> dict[int, dict] | None:
+    """Decode the node lease-summary annotation
+    (``chip:lent:count;…@ts``, market.encode_annotation) into
+    ``{chip: {"lent_core_pct": int, "leases": int}}``; None when
+    absent, malformed, or stale — every bad shape degrades to
+    no-signal, never to a wrong lent/borrowed claim."""
+    import time as _time
+    if raw is None:
+        return None
+    body, sep, ts_raw = raw.rpartition("@")
+    if not sep:
+        return None
+    try:
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(ts):
+        return None
+    now = _time.time() if now is None else now
+    if not -5.0 <= now - ts <= max_age_s:
+        return None
+    out: dict[int, dict] = {}
+    for seg in body.split(";"):
+        if not seg:
+            continue
+        parts = seg.split(":")
+        if len(parts) != 3:
+            return None
+        try:
+            chip, lent, count = int(parts[0]), int(parts[1]), \
+                int(parts[2])
+        except (TypeError, ValueError):
+            return None
+        out[chip] = {"lent_core_pct": max(lent, 0),
+                     "leases": max(count, 0)}
+    return out

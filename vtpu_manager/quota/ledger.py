@@ -1,0 +1,237 @@
+"""vtqm node-local quota-lease ledger.
+
+The durable record of who lent what to whom on this node's chips — the
+vtcc-lease discipline applied to quota: one JSON file under the node's
+base dir, every mutation under a :class:`FileLock` on a sibling
+``.flock`` (so the market manager, a restarted market manager, and any
+diagnostic reader exclude each other), landed atomically via
+tmp+rename. The file carries one monotone ``epoch`` bumped on EVERY
+mutation; the market manager writes that epoch into each affected
+tenant's ``vtpu.config`` header, which is the C++ shim's re-read
+trigger (instant reclaim).
+
+Liveness/crash rules (what the chaos harness asserts):
+
+- every lease carries a wall-clock TTL; a ``granted`` lease past
+  ``granted_at + ttl_s`` is *due* and the next manager pass expires it
+  — a manager that crashes holding grants leaves only TTL-bounded
+  over-grants, never immortal ones;
+- a torn/garbage ledger file (partial-write crash) loads as EMPTY with
+  a bumped epoch, never as a parse error: the reconcile pass then
+  rewrites every config back to base rates — convergence beats
+  recovering half a ledger;
+- on manager start every ``granted`` lease is revoked (the
+  restart-mid-revoke window means their enforcement state is unknown),
+  so the market always restarts from base truth.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from vtpu_manager.util.flock import FileLock
+
+log = logging.getLogger(__name__)
+
+LEDGER_NAME = "quota_leases.json"
+
+STATE_GRANTED = "granted"
+STATE_REVOKED = "revoked"
+STATE_EXPIRED = "expired"
+
+
+def lease_is_active(lease: dict, now: float) -> bool:
+    """Granted and inside its TTL. Both settle paths (revoke, expire)
+    and the due-scan share this one predicate."""
+    if lease.get("state") != STATE_GRANTED:
+        return False
+    return now < float(lease.get("granted_at", 0.0)) + \
+        float(lease.get("ttl_s", 0.0))
+
+
+class QuotaLeaseLedger:
+    """FileLock'd, atomically-rewritten node lease file."""
+
+    def __init__(self, base_dir: str, clock=time.time):
+        self.path = os.path.join(base_dir, LEDGER_NAME)
+        self.clock = clock
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    # -- raw document --------------------------------------------------------
+
+    def load(self) -> dict:
+        """The ledger document; a missing or torn file reads as empty.
+        The caller that observes ``recovered=True`` must treat every
+        on-disk config's lease state as unknown and reconcile to base
+        (market.py's recovery rule). A RECOVERED epoch is re-based on
+        wall seconds, not reset to 0: the shim skips config re-reads
+        whose ``quota_epoch`` equals the one it last adopted, so a
+        post-tear generation must never be able to reuse a pre-tear
+        epoch value (mutation counts live nowhere near wall seconds)."""
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return {"epoch": 0, "leases": []}
+        except (OSError, json.JSONDecodeError, ValueError):
+            log.warning("quota ledger %s unreadable (torn write?); "
+                        "recovering as empty", self.path)
+            return {"epoch": self._recovery_epoch(), "leases": [],
+                    "recovered": True}
+        if not isinstance(doc, dict) or \
+                not isinstance(doc.get("leases"), list):
+            log.warning("quota ledger %s has a foreign shape; "
+                        "recovering as empty", self.path)
+            return {"epoch": self._recovery_epoch(), "leases": [],
+                    "recovered": True}
+        doc.setdefault("epoch", 0)
+        return doc
+
+    def _recovery_epoch(self) -> int:
+        return int(self.clock()) & 0x7FFFFFFF
+
+    def _store(self, doc: dict) -> None:
+        doc.pop("recovered", None)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- mutations (each one locked round-trip, epoch bumped) ---------------
+
+    def grant(self, chip: int, lender: str, borrower: str, pct: int,
+              ttl_s: float, now: float | None = None) -> tuple[dict, int]:
+        """Append one granted lease; returns (lease, new epoch)."""
+        now = self.clock() if now is None else now
+        with FileLock(f"{self.path}.flock"):
+            doc = self.load()
+            doc["epoch"] = int(doc["epoch"]) + 1
+            lease = {
+                "id": f"q{doc['epoch']}-{chip}-{len(doc['leases'])}",
+                "chip": int(chip),
+                "lender": lender,
+                "borrower": borrower,
+                "pct": int(pct),
+                "granted_at": now,
+                "ttl_s": float(ttl_s),
+                "state": STATE_GRANTED,
+                "updated_at": now,
+                "epoch": doc["epoch"],
+            }
+            doc["leases"].append(lease)
+            self._store(doc)
+            return lease, doc["epoch"]
+
+    def settle(self, lease_ids, state: str,
+               now: float | None = None) -> int:
+        """Mark leases revoked/expired; returns the new epoch (bumped
+        once even for a batch — one epoch per ledger mutation is what
+        the shim's re-read keys on, not per lease)."""
+        assert state in (STATE_REVOKED, STATE_EXPIRED), state
+        ids = set(lease_ids)
+        now = self.clock() if now is None else now
+        with FileLock(f"{self.path}.flock"):
+            doc = self.load()
+            touched = False
+            for lease in doc["leases"]:
+                if lease.get("id") in ids and \
+                        lease.get("state") == STATE_GRANTED:
+                    lease["state"] = state
+                    lease["updated_at"] = now
+                    touched = True
+            if touched or doc.get("recovered"):
+                doc["epoch"] = int(doc["epoch"]) + 1
+            self._store(doc)
+            return doc["epoch"]
+
+    def compact(self, retain_s: float = 3600.0,
+                now: float | None = None) -> None:
+        """Drop settled leases older than the retention window so the
+        file stays bounded; never drops granted ones."""
+        now = self.clock() if now is None else now
+        with FileLock(f"{self.path}.flock"):
+            doc = self.load()
+            kept = [l for l in doc["leases"]
+                    if l.get("state") == STATE_GRANTED
+                    or now - float(l.get("updated_at", 0.0)) < retain_s]
+            if len(kept) != len(doc["leases"]):
+                doc["leases"] = kept
+                self._store(doc)
+
+    # -- read-side cuts (no lock: a torn read is a stale read, and
+    # every caller re-reads next pass) --------------------------------------
+
+    def epoch(self) -> int:
+        return int(self.load()["epoch"])
+
+    def leases(self) -> list[dict]:
+        return list(self.load()["leases"])
+
+    def snapshot(self, now: float | None = None) -> "LedgerView":
+        """Epoch, leases, active set, and deltas derived from ONE load
+        — a market-pass phase must see a single ledger generation, not
+        one per accessor (and must not pay one file read per cut)."""
+        now = self.clock() if now is None else now
+        doc = self.load()
+        leases = list(doc["leases"])
+        active = [l for l in leases if lease_is_active(l, now)]
+        return LedgerView(epoch=int(doc["epoch"]), leases=leases,
+                          active=active,
+                          deltas=deltas_from(active))
+
+    def active(self, now: float | None = None,
+               chip: int | None = None) -> list[dict]:
+        now = self.clock() if now is None else now
+        return [l for l in self.load()["leases"]
+                if lease_is_active(l, now)
+                and (chip is None or l.get("chip") == chip)]
+
+    def due(self, now: float | None = None) -> list[dict]:
+        """Granted leases whose TTL ran out — the expiry work list."""
+        now = self.clock() if now is None else now
+        return [l for l in self.load()["leases"]
+                if l.get("state") == STATE_GRANTED
+                and not lease_is_active(l, now)]
+
+    def deltas(self, now: float | None = None
+               ) -> dict[tuple[str, int], int]:
+        """(tenant, chip) -> net signed lease_core from ACTIVE leases —
+        the exact numbers the config rewrite applies, derived in one
+        place so the invariant check and the writer cannot disagree."""
+        now = self.clock() if now is None else now
+        return deltas_from([l for l in self.load()["leases"]
+                            if lease_is_active(l, now)])
+
+
+class LedgerView:
+    """One generation of the ledger, read once (snapshot())."""
+
+    __slots__ = ("epoch", "leases", "active", "deltas")
+
+    def __init__(self, epoch: int, leases: list[dict],
+                 active: list[dict],
+                 deltas: dict[tuple[str, int], int]):
+        self.epoch = epoch
+        self.leases = leases
+        self.active = active
+        self.deltas = deltas
+
+
+def deltas_from(active: list[dict]) -> dict[tuple[str, int], int]:
+    out: dict[tuple[str, int], int] = {}
+    for lease in active:
+        chip = int(lease.get("chip", 0))
+        pct = int(lease.get("pct", 0))
+        bkey = (lease.get("borrower", ""), chip)
+        lkey = (lease.get("lender", ""), chip)
+        out[bkey] = out.get(bkey, 0) + pct
+        out[lkey] = out.get(lkey, 0) - pct
+    return out

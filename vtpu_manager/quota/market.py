@@ -1,0 +1,463 @@
+"""vtqm quota-market manager: the node daemon that lends idle quota.
+
+Runs in the device plugin behind the QuotaMarket gate. Each pass:
+
+1. **fold** its own vtuse :class:`UtilizationLedger` (a private
+   instance — the headroom publisher keeps its own cursors, so the two
+   daemons never race one ledger's state);
+2. **expire** granted leases past their TTL;
+3. **revoke** leases whose lender needs its quota back (measured
+   envelope climbing into the lent range), whose signal went stale
+   (confidence below the floor — a lease must never outlive the
+   evidence it was granted on), or whose parties' configs vanished;
+4. **grant** bounded, TTL'd increments of confidence-gated reclaimable
+   headroom from *throughput*-class tenants to throttle-bound
+   *latency-critical* tenants on the same chip;
+5. **reconcile** every tenant's ``vtpu.config`` to the ledger's active
+   deltas — one writer for grant/revoke/expiry/crash-recovery alike:
+   desired ``lease_core`` per (tenant, chip) comes from
+   :meth:`QuotaLeaseLedger.deltas`, the header ``quota_epoch`` is the
+   ledger epoch, and the write is the same atomic tmp+rename the
+   Allocate path uses. The C++ shim notices the epoch from its
+   token-wait loop and re-reads — that is the instant-reclaim edge;
+6. **publish** a compact per-chip lease summary node annotation (the
+   /utilization fan-in's remote view) and emit one auditable record
+   per grant/revoke/expiry into the vtexplain spool + vtrace timeline.
+
+Safety invariant (chaos-asserted): for every chip, the sum of
+``clamp(hard_core + lease_core, 0, 100)`` across resident tenants
+never exceeds 100 — a lease moves quota, it never mints it. The
+reconcile pass re-derives every delta from the ledger before writing,
+and a torn ledger loads as empty, so every crash converges to base
+rates within one pass.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from vtpu_manager import explain, trace
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.tenantdirs import iter_container_config_paths
+from vtpu_manager.quota.ledger import (QuotaLeaseLedger, STATE_EXPIRED,
+                                       STATE_GRANTED, STATE_REVOKED)
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+# class annotation value -> config ABI value (the plugin stamps the ABI
+# side; the market reads it back from the configs it walks anyway)
+CLASS_TO_ABI = {
+    consts.WORKLOAD_CLASS_LATENCY_CRITICAL: vc.WORKLOAD_CLASS_LATENCY,
+    consts.WORKLOAD_CLASS_THROUGHPUT: vc.WORKLOAD_CLASS_THROUGHPUT,
+}
+
+# how close (core %) the lender's measured envelope may come to its
+# retained rate before the lease is reclaimed
+REVOKE_MARGIN_PCT = 2.0
+# extra headroom a grant must leave ABOVE the revoke margin, so a
+# fresh lease is never born already inside its own revoke band (the
+# grant/revoke hysteresis — without it a lender hovering at the edge
+# oscillates lease-on/lease-off every pass)
+GRANT_HEADROOM_PCT = 5.0
+
+
+def effective_core(hard: int, lease: int) -> int:
+    """clamp(hard + lease, 0, 100) — the C++ EffectiveCorePct mirror."""
+    return max(0, min(100, int(hard) + int(lease)))
+
+
+def sum_effective_by_chip(base_dir: str) -> dict[int, int]:
+    """Per-chip sum of on-disk effective rates — the chaos invariant's
+    ground truth, read straight off the configs the shims read."""
+    out: dict[int, int] = {}
+    for _uid, _label, path, _is_dra in \
+            iter_container_config_paths(base_dir):
+        try:
+            cfg = vc.read_config(path)
+        except (OSError, ValueError):
+            continue
+        for dev in cfg.devices:
+            out[dev.host_index] = out.get(dev.host_index, 0) + \
+                effective_core(dev.hard_core, dev.lease_core)
+    return out
+
+
+class _Tenant:
+    """One (pod_uid, container_label) partition's config view."""
+
+    __slots__ = ("key", "path", "cfg", "by_chip")
+
+    def __init__(self, key: str, path: str, cfg: vc.VtpuConfig):
+        self.key = key
+        self.path = path
+        self.cfg = cfg
+        self.by_chip = {d.host_index: d for d in cfg.devices}
+
+
+class QuotaMarketManager:
+    def __init__(self, node_name: str, base_dir: str, util_ledger,
+                 client=None, policy=None, interval_s: float = 5.0,
+                 grant_step_pct: int = 10, max_borrow_pct: int = 40,
+                 lease_ttl_s: float = 30.0, min_retain_pct: int = 5,
+                 wait_frac_threshold: float = 0.2,
+                 revoke_confidence: float = 0.35,
+                 clock=time.time):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.node_name = node_name
+        self.base_dir = base_dir
+        self.util = util_ledger
+        self.client = client
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            deadline_s=10.0)
+        self.interval_s = interval_s
+        self.grant_step_pct = grant_step_pct
+        self.max_borrow_pct = max_borrow_pct
+        self.lease_ttl_s = lease_ttl_s
+        self.min_retain_pct = min_retain_pct
+        self.wait_frac_threshold = wait_frac_threshold
+        self.revoke_confidence = revoke_confidence
+        self.clock = clock
+        self.ledger = QuotaLeaseLedger(base_dir, clock=clock)
+        self.grants_total = 0
+        self.revokes_total = 0
+        self.expiries_total = 0
+        self.rewrites_total = 0
+        # lender -> no-grants-until wall clock, set on every demand/
+        # staleness revoke: the other half of the hysteresis (a revoked
+        # lender must re-prove idleness across passes, not within one)
+        self._lender_cooldown: dict[str, float] = {}
+        self.cooldown_s = 2.0 * interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- config view ---------------------------------------------------------
+
+    def _tenants(self) -> dict[str, _Tenant]:
+        out: dict[str, _Tenant] = {}
+        for uid, label, path, _is_dra in \
+                iter_container_config_paths(self.base_dir):
+            try:
+                cfg = vc.read_config(path)
+            except (OSError, ValueError):
+                continue     # a writer's crash window; next pass
+            out[f"{uid}/{label}"] = _Tenant(f"{uid}/{label}", path, cfg)
+        return out
+
+    # -- one pass ------------------------------------------------------------
+
+    def tick(self, now: float | None = None) -> None:
+        now = self.clock() if now is None else now
+        try:
+            self.util.fold(now_wall=now)
+        except Exception:  # noqa: BLE001 — a torn fold only costs this
+            # pass its freshness; confidence decay converges the market
+            log.warning("quota market: utilization fold failed",
+                        exc_info=True)
+        tenants = self._tenants()
+        # revoked lenders whose cooldown has lapsed drop out — the
+        # dict must not grow forever over tenant churn
+        self._lender_cooldown = {k: t for k, t
+                                 in self._lender_cooldown.items()
+                                 if t > now}
+        self._expire(now)
+        # one ledger read per phase (each phase may mutate it): every
+        # decision inside a phase sees ONE generation
+        self._revoke_stressed(tenants, now,
+                              self.ledger.snapshot(now))
+        self._grant(tenants, now, self.ledger.snapshot(now))
+        self._reconcile(tenants, now)
+        self._publish(now)
+        # settled-lease retention: the file must not grow forever on a
+        # long-lived node (granted leases are never dropped)
+        self.ledger.compact(now=now)
+
+    def _expire(self, now: float) -> None:
+        due = self.ledger.due(now)
+        if not due:
+            return
+        epoch = self.ledger.settle([l["id"] for l in due],
+                                   STATE_EXPIRED, now)
+        self.expiries_total += len(due)
+        for lease in due:
+            self._audit("expire", lease, epoch, now)
+
+    def _revoke(self, leases: list[dict], now: float,
+                why: str) -> None:
+        if not leases:
+            return
+        epoch = self.ledger.settle([l["id"] for l in leases],
+                                   STATE_REVOKED, now)
+        # crash window: the ledger says revoked but no config reflects
+        # it yet (partial-write tears the ledger itself) — recovery is
+        # the reconcile pass / the restart rule, chaos-asserted
+        failpoints.fire("quota.revoke", path=self.ledger.path,
+                        count_leases=len(leases), why=why)
+        self.revokes_total += len(leases)
+        for lease in leases:
+            self._audit("revoke", lease, epoch, now, why=why)
+
+    def _revoke_stressed(self, tenants: dict[str, _Tenant],
+                         now: float, view) -> None:
+        import math
+        active = view.active
+        if not active:
+            return
+        states = {(s.pod_uid, s.container, s.host_index): s
+                  for s in self.util.tenants()}
+        deltas = view.deltas
+        to_revoke: dict[str, dict] = {}
+        reasons: dict[str, str] = {}
+        for lease in active:
+            lender, borrower = lease["lender"], lease["borrower"]
+            chip = int(lease["chip"])
+            lt = tenants.get(lender)
+            if lt is None or tenants.get(borrower) is None or \
+                    chip not in lt.by_chip:
+                to_revoke[lease["id"]] = lease
+                reasons[lease["id"]] = "party-gone"
+                continue
+            uid, _, label = lender.partition("/")
+            state = states.get((uid, label, chip))
+            conf = state.confidence(now) if state is not None else 0.0
+            if state is None or conf <= self.revoke_confidence:
+                # the staleness rule: a lease never outlives the
+                # evidence it was granted on — no-signal lenders
+                # reclaim to the exact pre-market rates
+                to_revoke[lease["id"]] = lease
+                reasons[lease["id"]] = "stale-signal"
+                continue
+            envelope = state.used_ewma + 2.0 * math.sqrt(
+                max(state.used_var, 0.0))
+            retained = effective_core(
+                lt.by_chip[chip].hard_core,
+                deltas.get((lender, chip), 0))
+            if envelope >= retained - REVOKE_MARGIN_PCT:
+                to_revoke[lease["id"]] = lease
+                reasons[lease["id"]] = "lender-demand"
+        for why in set(reasons.values()):
+            self._revoke([l for lid, l in to_revoke.items()
+                          if reasons[lid] == why], now, why)
+        for lid, lease in to_revoke.items():
+            # hysteresis applies to the lender's OWN signal problems
+            # (demand, staleness) — a counterparty vanishing says
+            # nothing about the lender's idleness
+            if reasons[lid] != "party-gone":
+                self._lender_cooldown[lease["lender"]] = \
+                    now + self.cooldown_s
+
+    def _grant(self, tenants: dict[str, _Tenant], now: float,
+               view) -> None:
+        states = {(s.pod_uid, s.container, s.host_index): s
+                  for s in self.util.tenants()}
+        deltas = view.deltas
+
+        def tenant_state(key: str, chip: int):
+            uid, _, label = key.partition("/")
+            return states.get((uid, label, chip))
+
+        # chip -> tenants resident on it
+        by_chip: dict[int, list[_Tenant]] = {}
+        for t in tenants.values():
+            for chip in t.by_chip:
+                by_chip.setdefault(chip, []).append(t)
+
+        for chip, residents in sorted(by_chip.items()):
+            borrowers = []
+            lenders = []
+            for t in residents:
+                dev = t.by_chip[chip]
+                state = tenant_state(t.key, chip)
+                delta = deltas.get((t.key, chip), 0)
+                cls = t.cfg.workload_class
+                if cls == vc.WORKLOAD_CLASS_LATENCY:
+                    if state is None or state.confidence(now) <= 0.0:
+                        continue    # no fresh evidence of the stall
+                    if state.wait_frac < self.wait_frac_threshold:
+                        continue    # not throttle-bound
+                    if dev.core_limit == vc.CORE_LIMIT_NONE:
+                        continue    # unthrottled: nothing to lend it
+                    room = min(100 - effective_core(dev.hard_core,
+                                                    delta),
+                               self.max_borrow_pct - max(delta, 0))
+                    if room > 0:
+                        borrowers.append((t, dev, state, room))
+                elif cls == vc.WORKLOAD_CLASS_THROUGHPUT:
+                    if state is None:
+                        continue
+                    if now < self._lender_cooldown.get(t.key, 0.0):
+                        continue    # recently reclaimed: re-prove idle
+                    lent = max(-delta, 0)
+                    # GRANT_HEADROOM keeps a new lease outside its own
+                    # revoke band (reclaim already subtracts the
+                    # envelope, so this is margin on top of margin)
+                    lendable = min(
+                        state.reclaim_core_pct(now) - lent
+                        - GRANT_HEADROOM_PCT,
+                        dev.hard_core - lent - self.min_retain_pct)
+                    if lendable >= 1.0:
+                        lenders.append((t, dev, state, lendable))
+            if not borrowers or not lenders:
+                continue
+            # most-stalled borrower first; most-idle lender first
+            borrowers.sort(key=lambda b: -b[2].wait_frac)
+            lenders.sort(key=lambda l: -l[3])
+            for bt, bdev, bstate, room in borrowers:
+                for i, (lt, ldev, lstate, lendable) in \
+                        enumerate(lenders):
+                    pct = int(min(self.grant_step_pct, room, lendable))
+                    if pct < 1:
+                        continue
+                    lease, epoch = self.ledger.grant(
+                        chip, lt.key, bt.key, pct, self.lease_ttl_s,
+                        now)
+                    # crash window: granted in the ledger, not yet in
+                    # any config (partial-write tears the ledger); the
+                    # reconcile/restart rules converge it
+                    failpoints.fire("quota.lease",
+                                    path=self.ledger.path,
+                                    lease_id=lease["id"], chip=chip)
+                    self.grants_total += 1
+                    self._audit("grant", lease, epoch, now)
+                    lenders[i] = (lt, ldev, lstate, lendable - pct)
+                    room -= pct
+                    if room < 1:
+                        break
+
+    def _reconcile(self, tenants: dict[str, _Tenant],
+                   now: float) -> None:
+        """Write the ledger's active deltas into the configs — the ONE
+        writer for every path (grant, revoke, expiry, torn-ledger
+        recovery, restart). Guards the conservation invariant before
+        touching disk: if the desired state would oversubscribe a chip
+        (a corrupt ledger), every lease on that chip is revoked and the
+        pass re-runs against the settled ledger."""
+        view = self.ledger.snapshot(now)
+        desired_sum: dict[int, int] = {}
+        for t in tenants.values():
+            for chip, dev in t.by_chip.items():
+                desired_sum[chip] = desired_sum.get(chip, 0) + \
+                    effective_core(dev.hard_core,
+                                   view.deltas.get((t.key, chip), 0))
+        bad = [chip for chip, total in desired_sum.items()
+               if total > 100]
+        if bad:
+            log.error("quota ledger would oversubscribe chip(s) %s; "
+                      "revoking every lease there", bad)
+            victims = [l for l in view.active
+                       if l.get("chip") in bad]
+            self._revoke(victims, now, "oversubscribed-ledger")
+            view = self.ledger.snapshot(now)
+        # deltas AND epoch from the same load: a config must never
+        # carry one generation's epoch with another's lease values
+        deltas = view.deltas
+        epoch = view.epoch
+        for t in tenants.values():
+            want = {chip: deltas.get((t.key, chip), 0)
+                    for chip in t.by_chip}
+            if all(dev.lease_core == want[chip]
+                   for chip, dev in t.by_chip.items()):
+                continue
+            for chip, dev in t.by_chip.items():
+                dev.lease_core = want[chip]
+            t.cfg.quota_epoch = epoch
+            try:
+                vc.write_config(t.path, t.cfg)
+                self.rewrites_total += 1
+            except OSError:
+                # next pass retries; the shim keeps the old rates until
+                # a coherent file lands (rename is atomic)
+                log.warning("quota config rewrite failed for %s",
+                            t.path, exc_info=True)
+
+    # -- audit + publication -------------------------------------------------
+
+    def _audit(self, op: str, lease: dict, epoch: int, now: float,
+               why: str = "") -> None:
+        rec = {"kind": "quota", "op": op, "node": self.node_name,
+               "lease_id": lease.get("id"), "chip": lease.get("chip"),
+               "lender": lease.get("lender"),
+               "borrower": lease.get("borrower"),
+               "pct": lease.get("pct"), "ttl_s": lease.get("ttl_s"),
+               "epoch": epoch, "ts": now}
+        if why:
+            rec["why"] = why
+        explain.record_raw(rec)
+        for party, role in ((lease.get("borrower", ""), "borrower"),
+                            (lease.get("lender", ""), "lender")):
+            uid = party.partition("/")[0]
+            if uid:
+                trace.event(trace.context_for_uid(uid), f"quota.{op}",
+                            role=role, chip=lease.get("chip"),
+                            pct=lease.get("pct"), epoch=epoch,
+                            **({"why": why} if why else {}))
+
+    def encode_annotation(self, now: float) -> str:
+        """Compact per-chip lease summary: ``chip:lent:count;…@ts`` —
+        the pressure/headroom codec family (stale by timestamp)."""
+        per_chip: dict[int, tuple[int, int]] = {}
+        for lease in self.ledger.active(now):
+            chip = int(lease["chip"])
+            lent, count = per_chip.get(chip, (0, 0))
+            per_chip[chip] = (lent + int(lease["pct"]), count + 1)
+        body = ";".join(f"{chip}:{lent}:{count}"
+                        for chip, (lent, count)
+                        in sorted(per_chip.items()))
+        return f"{body}@{now:.3f}"
+
+    def _publish(self, now: float) -> None:
+        if self.client is None:
+            return
+        try:
+            self.policy.run(
+                lambda: self.client.patch_node_annotations(
+                    self.node_name,
+                    {consts.node_quota_lease_annotation():
+                     self.encode_annotation(now)}),
+                op="quota.lease_patch")
+        except Exception:  # noqa: BLE001 — advisory view; the codec's
+            # timestamp ages a silent publisher out on every reader
+            log.warning("quota lease annotation publish failed",
+                        exc_info=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def recover(self) -> None:
+        """The restart rule: a granted lease's enforcement state is
+        unknown after a crash (we may have died mid-revoke, or between
+        the ledger write and any config rewrite) — settle every carried
+        lease and reconcile, so the market always restarts from base
+        truth. Chaos drives this directly; start() runs it before the
+        first pass."""
+        now = self.clock()
+        # EVERY still-granted lease settles — active ones AND ones
+        # whose TTL ran out while no manager lived (they must not
+        # linger "granted" forever just because nothing expired them)
+        stale = [l for l in self.ledger.leases()
+                 if l.get("state") == STATE_GRANTED]
+        if stale:
+            log.info("quota market restart: revoking %d carried "
+                     "lease(s)", len(stale))
+        self._revoke(stale, now, "manager-restart")
+        self._reconcile(self._tenants(), now)
+
+    def start(self) -> None:
+        self.recover()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — one torn pass must
+                    # not kill the market; TTLs bound any half-state
+                    log.warning("quota market pass failed",
+                                exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtqm-market")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -96,6 +96,18 @@ SITES: dict[str, str] = {
                       "fan-in on scheduler and monitor; error/latency "
                       "must hit only that route, never /metrics or a "
                       "scheduling pass)",
+    "quota.lease": "quota/market.py grant path, after the ledger "
+                   "records the lease and before any config rewrite "
+                   "(crash = manager dies holding a grant no shim "
+                   "enforces yet — TTL + the restart rule converge it; "
+                   "partial-write = a torn lease ledger that must "
+                   "recover as empty and reconcile every config to "
+                   "base rates)",
+    "quota.revoke": "quota/market.py revoke path, after the ledger "
+                    "settles and before the reconcile pass rewrites "
+                    "configs (crash = plugin restart mid-revoke: the "
+                    "start() rule revokes carried leases and restores "
+                    "base truth before new market activity)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

@@ -104,9 +104,19 @@ class FilterPredicate:
                  policy: RetryPolicy | None = None,
                  fence=None, shard_selector=None,
                  anti_storm: bool = False,
-                 utilization_hint: bool = False):
+                 utilization_hint: bool = False,
+                 quota_market: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtqm (QuotaMarket gate; default off = byte-identical scores):
+        # the reclaimable-headroom input both paths have decoded
+        # observe-only since PR 8 becomes a REAL score term — but only
+        # for latency-critical pods (the borrower class), and only
+        # while the signal is fresh (headroom_score_term re-judges
+        # staleness at use time, so a dead publisher degrades to the
+        # exact pre-market placement). Rides filter_kwargs so vtha
+        # shards inherit it like the pressure/storm terms.
+        self.quota_market = quota_market
         # vtuse (UtilizationLedger gate; default off = zero extra work):
         # OBSERVE-ONLY this PR — after a pass commits, the chosen node's
         # reclaimable-headroom annotation is decoded and the score input
@@ -600,6 +610,14 @@ class FilterPredicate:
         # through the unbound-commitment scan
         pod_fp = antistorm.pod_fingerprint(pod) if self.anti_storm else ""
         pod_uid = (pod.get("metadata") or {}).get("uid", "")
+        # vtqm: the headroom term scores only latency-critical pods
+        # (one webhook-normalized annotation read per pass; gate off or
+        # other classes => False => zero extra work, scores identical)
+        hr_term = False
+        if self.quota_market:
+            from vtpu_manager.quota import workload_class_of
+            hr_term = (workload_class_of(pod)
+                       == consts.WORKLOAD_CLASS_LATENCY_CRITICAL)
         if snap is not None:
             # walk the snapshot's incrementally maintained capacity rank
             # — no per-pass O(nodes) ranking, no decode
@@ -607,13 +625,13 @@ class FilterPredicate:
                 snap, req, candidates, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
                 reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
-                explain_b=explain_b)
+                explain_b=explain_b, hr_term=hr_term)
         else:
             scored = self._ttl_scored(
                 req, candidates, by_node, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
                 reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
-                explain_b=explain_b)
+                explain_b=explain_b, hr_term=hr_term)
 
         if not scored:
             result.error = reasons.summary() or "no schedulable vtpu node"
@@ -689,7 +707,8 @@ class FilterPredicate:
                     gang_domains: set, gang_siblings: list,
                     prefer_origin, result: FilterResult, reasons,
                     now: float, pod_fp: str = "", pod_uid: str = "",
-                    explain_b=None) -> list[ScoredNode]:
+                    explain_b=None, hr_term: bool = False
+                    ) -> list[ScoredNode]:
         """TTL-path ranking: gate + rank every surviving node on fast
         free totals (memoized registry totals minus claim sums — no
         DeviceUsage materialized), then build the full usage view lazily,
@@ -759,10 +778,12 @@ class FilterPredicate:
             # headroom rides RAW here (one dict-get) and decodes only
             # for nodes the allocation loop actually visits — parsing
             # per ranked node would decode ~cluster-size annotations per
-            # pass to record at most candidate_limit of them. Audit-only
-            # (observe, never scored); the gate-off pass carries None.
+            # pass to record at most candidate_limit of them. Fetched
+            # for the audit record AND for the vtqm score term
+            # (latency-critical pods under QuotaMarket); every other
+            # pass carries None.
             hr_raw = ((meta.get("annotations") or {}).get(hr_ann)
-                      if explain_b is not None else None)
+                      if explain_b is not None or hr_term else None)
             ranked.append((free_cores + (free_memory >> 24) + free_number,
                            name, registry, counted, assumed, pressure,
                            storm, hr_raw))
@@ -793,7 +814,7 @@ class FilterPredicate:
                                 storm_recent=storm,
                                 headroom=util_headroom.parse_headroom(
                                     hr_raw) if hr_raw else None,
-                                explain_b=explain_b)
+                                explain_b=explain_b, hr_term=hr_term)
         return scored
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
@@ -802,7 +823,8 @@ class FilterPredicate:
                          gang_siblings: list, prefer_origin,
                          result: FilterResult, reasons,
                          now: float, pod_fp: str = "", pod_uid: str = "",
-                         explain_b=None) -> list[ScoredNode]:
+                         explain_b=None, hr_term: bool = False
+                         ) -> list[ScoredNode]:
         """Snapshot-path candidate walk. The capacity rank is maintained
         by the snapshot O(log n) per event, so the pass walks its head in
         policy order (ascending for binpack, descending for spread) and
@@ -888,8 +910,9 @@ class FilterPredicate:
                                 pressure=entry.pressure, storm_fp=pod_fp,
                                 storm_recent=storm,
                                 headroom=entry.headroom
-                                if explain_b is not None else None,
-                                explain_b=explain_b)
+                                if explain_b is not None or hr_term
+                                else None,
+                                explain_b=explain_b, hr_term=hr_term)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -927,7 +950,7 @@ class FilterPredicate:
                        result: FilterResult, reasons,
                        pressure=None, storm_fp: str = "",
                        storm_recent=(), headroom=None,
-                       explain_b=None) -> None:
+                       explain_b=None, hr_term: bool = False) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
         drift between them (and so the vtexplain breakdown is assembled
@@ -984,17 +1007,29 @@ class FilterPredicate:
             # off-slice pays DCN for every gang collective
             gang_bonus = 100.0
             score += gang_bonus
+        headroom_term = 0.0
+        if hr_term:
+            # vtqm (QuotaMarket gate + latency-critical pod): prefer
+            # nodes with fresh lendable headroom — the market can
+            # absorb this pod's bursts there. Soft like pressure/storm
+            # (reorders fits, never vetoes one), and a stale or
+            # no-confidence signal contributes exactly 0.0, i.e. the
+            # byte-identical pre-market score.
+            headroom_term = util_headroom.headroom_score_term(headroom)
+            score += headroom_term
         if explain_b is not None:
             # the audit record gets the exact terms just applied, plus
-            # the observe-only headroom input that was NOT applied —
-            # total == base - pressure - storm + gang_bonus holds by
-            # construction and is asserted end-to-end by test_explain
+            # the raw headroom input — total == base - pressure - storm
+            # + gang_bonus + headroom_term holds by construction
+            # (headroom_term is 0.0 unless the QuotaMarket gate scored
+            # it) and is asserted end-to-end by test_explain/test_quota
             explain_b.candidate(
                 name, base=base, pressure=pressure_pen, storm=storm_pen,
                 gang_bonus=gang_bonus,
                 headroom_input=util_headroom.headroom_score_input(
                     headroom),
-                topology=alloc_result.topology_kind, total=score)
+                topology=alloc_result.topology_kind, total=score,
+                headroom_term=headroom_term)
         scored.append(ScoredNode(name, score, alloc_result))
 
     # -- commit: annotation patch is the only cross-process channel ---------

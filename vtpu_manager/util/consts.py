@@ -250,6 +250,27 @@ def node_pressure_annotation() -> str:
     return _ann("node-pressure")
 
 
+def workload_class_annotation() -> str:
+    """vtqm workload class (QuotaMarket gate): ``latency-critical`` vs
+    ``throughput``, declared on the pod (or via the
+    ``VTPU_WORKLOAD_CLASS`` container env the deployment template
+    already owns) and normalized by the webhook at admission — the one
+    annotation the scheduler's headroom score term and the device
+    plugin's config stamping read, so neither ever parses container
+    specs in a hot path (the program-fingerprint rule)."""
+    return _ann("workload-class")
+
+
+def node_quota_lease_annotation() -> str:
+    """vtqm node lease summary (QuotaMarket gate): compact per-chip
+    lent-core totals + active lease count published by the node's
+    market manager over the registry channel, so the monitor's
+    /utilization fan-in (and vtpu-smi's lent/borrowed columns) see
+    remote nodes' market state without a new protocol. Same
+    staleness-by-timestamp family as the pressure/headroom codecs."""
+    return _ann("node-quota-leases")
+
+
 def node_reclaimable_headroom_annotation() -> str:
     """vtuse reclaimable-headroom rollup (same codec family as the
     pressure annotation, utilization/headroom.py): per-chip
@@ -298,6 +319,13 @@ COMPUTE_POLICY_NONE = "none"        # no core limit
 COMPUTE_POLICIES = (COMPUTE_POLICY_FIXED, COMPUTE_POLICY_BALANCE,
                     COMPUTE_POLICY_NONE)
 
+# vtqm workload classes (QuotaMarket gate): the annotation values the
+# webhook normalizes and the scheduler/plugin read.
+WORKLOAD_CLASS_LATENCY_CRITICAL = "latency-critical"
+WORKLOAD_CLASS_THROUGHPUT = "throughput"
+WORKLOAD_CLASSES = (WORKLOAD_CLASS_LATENCY_CRITICAL,
+                    WORKLOAD_CLASS_THROUGHPUT)
+
 # ---------------------------------------------------------------------------
 # Container env vars consumed by the enforcement shim / runtime client
 # (reference: library/src/util.c:14-25, CUDA_MEM_LIMIT etc.)
@@ -327,6 +355,9 @@ ENV_COMPILE_CACHE_DIR = "VTPU_COMPILE_CACHE_DIR"  # in-container cache dir
 # webhook mirrors it into the program-fingerprint annotation so the
 # scheduler's anti-storm spreading sees it without spec parsing
 ENV_PROGRAM_FINGERPRINT = "VTPU_PROGRAM_FINGERPRINT"
+# tenant-declared workload class (vtqm; same env-to-annotation
+# normalization as the fingerprint — no tenant code changes)
+ENV_WORKLOAD_CLASS = "VTPU_WORKLOAD_CLASS"
 ENV_REGISTRY_SOCKET = "VTPU_REGISTRY_SOCKET"  # registry socket override
 ENV_POD_NAME = "VTPU_POD_NAME"
 ENV_POD_NAMESPACE = "VTPU_POD_NAMESPACE"

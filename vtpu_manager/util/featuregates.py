@@ -30,6 +30,7 @@ SCHEDULER_HA = "SchedulerHA"            # vtha sharded active-active scheduler
 COMPILE_CACHE = "CompileCache"          # vtcc node-local compile cache
 UTILIZATION_LEDGER = "UtilizationLedger"  # vtuse per-tenant utilization ledger
 DECISION_EXPLAIN = "DecisionExplain"    # vtexplain per-decision audit trail
+QUOTA_MARKET = "QuotaMarket"            # vtqm elastic quota market
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -95,6 +96,20 @@ _KNOWN = {
     # gate-on behavior change, asserted against its own recorded
     # reasoning).
     DECISION_EXPLAIN: False,
+    # Default off: byte-identical — the webhook stamps no workload-class
+    # annotation, configs carry workload_class=0/quota_epoch=0/
+    # lease_core=0 (the zero bytes the pre-v3 layout carried), no lease
+    # ledger exists on the node, and the scheduler's headroom input
+    # stays observe-only so placement is byte-identical in BOTH data
+    # paths. On, the node's quota-market manager (vtpu_manager/quota/)
+    # lends a chip's measured-idle, confidence-gated headroom (vtuse)
+    # from throughput tenants to throttle-bound latency-critical ones
+    # in bounded TTL'd increments, the C++ shim's token bucket refills
+    # at base+borrowed rate with instant shim-side reclaim (revoke
+    # epoch re-read in the token-wait loop), and the reclaimable-
+    # headroom signal becomes a REAL score term for latency-critical
+    # pods.
+    QUOTA_MARKET: False,
 }
 
 

@@ -123,17 +123,47 @@ def headroom_is_fresh(hr: "NodeHeadroom | None",
 
 def headroom_score_input(hr: "NodeHeadroom | None",
                          now: float | None = None) -> float:
-    """The score input the quota-market PR will add: total reclaimable
-    core % across the node's chips (more lendable quota = better home
-    for a burst-class pod). Staleness is re-judged HERE, not only at
-    parse time — the snapshot path caches the parsed value on the
-    NodeEntry and a dead publisher emits no further node events, so a
-    use-time check is what makes the signal decay (the pressure-penalty
-    rule). This PR the return value is logged and counted, never added
-    to a score."""
+    """The raw headroom score input: total reclaimable core % across
+    the node's chips (more lendable quota = better home for a
+    burst-class pod). Staleness is re-judged HERE, not only at parse
+    time — the snapshot path caches the parsed value on the NodeEntry
+    and a dead publisher emits no further node events, so a use-time
+    check is what makes the signal decay (the pressure-penalty rule).
+    This is the value the vtexplain records carried observe-only since
+    PR 8/9; the quota market scores ``headroom_score_term`` (the same
+    input, capped) so recorded decisions replay exactly."""
     if hr is None:
         return 0.0
     now = time.time() if now is None else now
     if not -FUTURE_SKEW_TOLERANCE_S <= now - hr.ts <= MAX_HEADROOM_AGE_S:
         return 0.0
     return hr.total_reclaim_core_pct()
+
+
+# the headroom term is a soft preference in the same currency as the
+# pressure penalty (50 * frac <= 50) and strictly below the gang bonus
+# (+100): it may break capacity ties toward lendable nodes, never
+# overrule keeping a gang on its slice. The input SUMS reclaimable %
+# across a node's chips, so a multi-chip node saturates the cap easily
+# — a 100-scale cap would tie the gang bonus and flip gang members
+# off-slice on any base-capacity difference.
+HEADROOM_TERM_CAP = 50.0
+
+
+def headroom_score_term(hr: "NodeHeadroom | None",
+                        now: float | None = None) -> float:
+    """vtqm: the REAL score term the QuotaMarket gate adds for
+    latency-critical pods — ``min(headroom_score_input, cap)``.
+    Defined ON the recorded observe-only input so
+    ``scripts/vtpu_replay.py`` re-scores PR 9 decision spools with the
+    byte-exact arithmetic the live filter applies, and so stale or
+    no-confidence headroom (input 0.0) degrades to the exact
+    pre-market placement."""
+    return min(headroom_score_input(hr, now), HEADROOM_TERM_CAP)
+
+
+def headroom_term_from_input(score_input: float) -> float:
+    """The replay side of ``headroom_score_term``: recorded decisions
+    carry the raw input; applying the cap here keeps the two
+    derivations one formula."""
+    return min(max(score_input, 0.0), HEADROOM_TERM_CAP)

@@ -40,10 +40,15 @@ class ClusterRollup:
 
     def __init__(self, ledger: UtilizationLedger, client=None,
                  cache_root: str | None = None,
-                 fold_budget_s: float | None = None):
+                 fold_budget_s: float | None = None,
+                 quota_dir: str | None = None):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
+        # vtqm (QuotaMarket gate): directory holding the node's lease
+        # ledger. None (gate off) = the document carries no lease
+        # fields at all — byte-identical /utilization
+        self.quota_dir = quota_dir
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -97,7 +102,15 @@ class ClusterRollup:
                         "reclaim_hbm_bytes":
                             ch.reclaim_hbm_bytes if ch else None,
                     })
+            row_extra = {}
+            if self.quota_dir:
+                # raw lease-summary annotation rides to the quota fold
+                # (popped there); absent when the gate is off so the
+                # document stays byte-identical
+                row_extra["_quota_lease_raw"] = anns.get(
+                    consts.node_quota_lease_annotation())
             rows.append({
+                **row_extra,
                 "node": name,
                 "local": name == self.ledger.node_name,
                 "chips": chips,
@@ -173,6 +186,64 @@ class ClusterRollup:
                     })
         return rows, errors
 
+    def _fold_quota_leases(self, tenant_rows: list[dict],
+                           node_rows: list[dict],
+                           now: float) -> dict | None:
+        """vtqm: fold the node-local lease ledger into the tenant rows
+        (lent/borrowed columns for vtpu-smi) and decode remote nodes'
+        lease-summary annotations into the node rows. Local truth comes
+        from the ledger file itself — the same node-local-live rule the
+        used%/wait columns follow."""
+        if not self.quota_dir:
+            return None
+        from vtpu_manager.quota import (QuotaLeaseLedger,
+                                        parse_lease_summary)
+        # remote nodes first (the stashed raw annotation must be popped
+        # whether or not the local ledger read below succeeds)
+        for nrow in node_rows:
+            summary = parse_lease_summary(
+                nrow.pop("_quota_lease_raw", None), now=now)
+            if summary is not None:
+                nrow["quota_lent_core_pct"] = sum(
+                    c["lent_core_pct"] for c in summary.values())
+                nrow["quota_leases"] = sum(
+                    c["leases"] for c in summary.values())
+        # ONE ledger generation for the whole document (a torn file
+        # loads as recovered-empty, never raises): the lent/borrowed
+        # columns, the active list, and the epoch must agree
+        view = QuotaLeaseLedger(self.quota_dir).snapshot(now)
+        leases, active = view.leases, view.active
+        by_tenant_chip: dict[tuple[str, str, int], int] = {}
+        for (tenant, chip), pct in view.deltas.items():
+            uid, _, label = tenant.partition("/")
+            # SUMMED per base container: a multi-request DRA claim's
+            # partitions share the row key, and their net position —
+            # never the iteration-last partition's value — is what the
+            # lent/borrowed columns must show
+            key = (uid, label.split("/", 1)[0], chip)
+            by_tenant_chip[key] = by_tenant_chip.get(key, 0) + pct
+        for row in tenant_rows:
+            key = (row.get("pod_uid", ""),
+                   str(row.get("container", "")).split("/", 1)[0],
+                   row.get("chip_index"))
+            delta = by_tenant_chip.get(key)
+            if delta is None:
+                continue
+            if delta > 0:
+                row["borrowed_core_pct"] = delta
+            elif delta < 0:
+                row["lent_core_pct"] = -delta
+        return {
+            "leases_active": len(active),
+            "lent_core_pct_total": sum(int(l.get("pct", 0))
+                                       for l in active),
+            "epoch": int(view.epoch),
+            "leases": [{k: l.get(k) for k in
+                        ("id", "chip", "lender", "borrower", "pct",
+                         "granted_at", "ttl_s", "state")}
+                       for l in leases[-64:]],
+        }
+
     def _compile_cache_state(self) -> dict | None:
         if not self.cache_root:
             return None
@@ -219,6 +290,7 @@ class ClusterRollup:
                     dict(t, node=self.ledger.node_name, live=True))
         local = self.ledger.to_wire(now)
         local["compile_cache"] = self._compile_cache_state()
+        quota = self._fold_quota_leases(tenant_rows, node_rows, now)
         live_nodes = [r for r in node_rows
                       if r["reclaim_core_pct"] is not None]
         doc = {
@@ -236,6 +308,8 @@ class ClusterRollup:
             },
             "errors": fold_errors + node_errors + pod_errors,
         }
+        if quota is not None:
+            doc["quota"] = quota
         return doc
 
 

@@ -59,7 +59,8 @@ def _escape(path: str) -> str:
 
 def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
                set_scheduler: bool = True,
-               stamp_fingerprint: bool = False) -> MutateResult:
+               stamp_fingerprint: bool = False,
+               stamp_workload_class: bool = False) -> MutateResult:
     result = MutateResult()
     if not requests_vtpu(pod):
         return result
@@ -73,6 +74,11 @@ def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
             # vtcc (CompileCache gate): the scheduler's anti-storm term
             # keys on this annotation, stamped once at admission
             _stamp_program_fingerprint(pod, result)
+        if stamp_workload_class:
+            # vtqm (QuotaMarket gate): the scheduler's headroom score
+            # term and the plugin's config ABI stamping both key on
+            # this one normalized annotation
+            _stamp_workload_class(pod, result)
         if ctx is not None:
             for ann, value in sorted(trace.annotation_values(ctx).items()):
                 # "add" replaces an existing member (RFC 6902 §4.1), so a
@@ -115,6 +121,44 @@ def _stamp_program_fingerprint(pod: dict, result: MutateResult) -> None:
         if ann in anns:
             result.warnings.append(
                 f"annotation {ann} sanitized to nothing; removed")
+            result.patches.append({
+                "op": "remove",
+                "path": f"/metadata/annotations/{_escape(ann)}"})
+        return
+    if anns.get(ann) != clean:
+        result.patches.append({
+            "op": "add",   # add replaces an existing member (RFC 6902)
+            "path": f"/metadata/annotations/{_escape(ann)}",
+            "value": clean})
+
+
+def _stamp_workload_class(pod: dict, result: MutateResult) -> None:
+    """Normalize the tenant-declared workload class into the one
+    annotation downstream readers use (the program-fingerprint rule: a
+    pre-set annotation wins over the ``VTPU_WORKLOAD_CLASS`` container
+    env, both are validated, and garbage is removed with a warning
+    rather than flowing into the scheduler/plugin)."""
+    meta = pod.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    ann = consts.workload_class_annotation()
+    raw = anns.get(ann)
+    if not raw:
+        for cont in ((pod.get("spec") or {}).get("containers") or []):
+            for env in (cont.get("env") or []):
+                if env.get("name") == consts.ENV_WORKLOAD_CLASS \
+                        and env.get("value"):
+                    raw = env["value"]
+                    break
+            if raw:
+                break
+    if not raw:
+        return
+    clean = raw.strip().lower()
+    if clean not in consts.WORKLOAD_CLASSES:
+        result.warnings.append(
+            f"annotation {ann}={raw!r} is not one of "
+            f"{'/'.join(consts.WORKLOAD_CLASSES)}; removed")
+        if ann in anns:
             result.patches.append({
                 "op": "remove",
                 "path": f"/metadata/annotations/{_escape(ann)}"})

@@ -38,7 +38,8 @@ def _admission_response(uid: str, allowed: bool = True,
 class WebhookAPI:
     def __init__(self, scheduler_name: str | None = None,
                  dra_convert: bool = False, client=None,
-                 stamp_fingerprint: bool = False):
+                 stamp_fingerprint: bool = False,
+                 stamp_workload_class: bool = False):
         from vtpu_manager.util import consts
         self.scheduler_name = scheduler_name or consts.DEFAULT_SCHEDULER_NAME
         self.dra_convert = dra_convert   # rewrite vtpu-* into ResourceClaims
@@ -46,6 +47,8 @@ class WebhookAPI:
         # vtcc (CompileCache gate): mirror the tenant's declared program
         # fingerprint into the scheduler-readable annotation
         self.stamp_fingerprint = stamp_fingerprint
+        # vtqm (QuotaMarket gate): normalize the declared workload class
+        self.stamp_workload_class = stamp_workload_class
         self.stats = {"mutate": 0, "validate": 0, "errors": 0}
 
     def build_app(self) -> web.Application:
@@ -69,8 +72,10 @@ class WebhookAPI:
         self.stats["mutate"] += 1
         try:
             uid, pod, dry_run = await self._review(request)
-            result = mutate_pod(pod, scheduler_name=self.scheduler_name,
-                                stamp_fingerprint=self.stamp_fingerprint)
+            result = mutate_pod(
+                pod, scheduler_name=self.scheduler_name,
+                stamp_fingerprint=self.stamp_fingerprint,
+                stamp_workload_class=self.stamp_workload_class)
             patches = list(result.patches)
             warnings = list(result.warnings)
             if self.dra_convert:
