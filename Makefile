@@ -106,8 +106,16 @@ bench-compilecache: ## vtcc headline bench: N-replica gang cold start, cache off
 bench-quotamarket: ## vtqm headline bench: bursty inference + steady training co-location, market off/on (burst p99 >=2x, training >=95% retained, reclaim bound asserted; writes BENCH_VTQM_r10.json)
 	python scripts/bench_quotamarket.py
 
+.PHONY: test-overcommit
+test-overcommit: ## vtovc suite: ratio codec + policy percentiles, virtual admission parity both modes, spill pool chaos (torn copy / budget / crashed-spiller reap), gate-off byte-contracts
+	$(PYTEST) tests/test_overcommit.py -q
+
+.PHONY: bench-overcommit
+bench-overcommit: ## vtovc headline bench: pods-per-chip density gate off/on (>=1.5x at bounded p99 step-time regression, thrash backoff asserted; writes BENCH_VTOVC_r11.json)
+	python scripts/bench_overcommit.py
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization test-explain test-quotamarket ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite, vtexplain audit suite, vtqm market suite
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization test-explain test-quotamarket test-overcommit bench-overcommit ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -78,6 +78,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.tpu.discovery import FakeBackend, discover
 
     from vtpu_manager.util.featuregates import (DECISION_EXPLAIN,
+                                                HBM_OVERCOMMIT,
                                                 QUOTA_MARKET,
                                                 UTILIZATION_LEDGER,
                                                 FeatureGates)
@@ -91,6 +92,7 @@ def main(argv: list[str] | None = None) -> int:
     util_on = gates.enabled(UTILIZATION_LEDGER)
     explain_on = gates.enabled(DECISION_EXPLAIN)
     quota_on = gates.enabled(QUOTA_MARKET)
+    overcommit_on = gates.enabled(HBM_OVERCOMMIT)
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
@@ -101,7 +103,9 @@ def main(argv: list[str] | None = None) -> int:
         tc_path=args.tc_path, vmem_path=args.vmem_path,
         pod_resources_socket=args.pod_resources_socket,
         kubelet_checkpoint=args.kubelet_checkpoint,
-        utilization_enabled=util_on)
+        utilization_enabled=util_on,
+        # vtovc: the vtpu_node_spill_* series (gate off = none)
+        overcommit_enabled=overcommit_on)
 
     # one registry-channel client shared by the vtuse /utilization and
     # vtexplain /explain fan-ins; no client degrades both to the
@@ -134,7 +138,11 @@ def main(argv: list[str] | None = None) -> int:
             fold_budget_s=collector.util_fold_budget_s,
             # vtqm: lease state (node ledger + remote annotations)
             # folds into /utilization only when the market gate is on
-            quota_dir=args.base_dir if quota_on else None)
+            quota_dir=args.base_dir if quota_on else None,
+            # vtovc: per-node oversubscription ratios + spill state
+            # fold into /utilization only when the overcommit gate is
+            # on (off = byte-identical document, the vtqm pattern)
+            overcommit=overcommit_on)
 
     import hmac
 

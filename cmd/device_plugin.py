@@ -67,6 +67,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="seconds between compile-cache evictor "
                              "passes (also reaps crashed writers' temp "
                              "files and folds dead tenants' stats)")
+    parser.add_argument("--spill-budget-gib", type=float, default=16.0,
+                        help="vtovc (HBMOvercommit): node host-RAM spill "
+                             "budget in GiB — the bound on Σ spilled "
+                             "bytes accounted in the vmem ledger")
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="serve THIS process's resilience counters "
                              "(reschedule reconcile failures, retry/"
@@ -95,6 +99,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.util.featuregates import (CLIENT_MODE, COMPILE_CACHE,
                                                 CORE_PLUGIN,
                                                 FAULT_INJECTION,
+                                                HBM_OVERCOMMIT,
                                                 HONOR_PREALLOC_IDS,
                                                 MEMORY_PLUGIN,
                                                 QUOTA_MARKET, RESCHEDULE,
@@ -211,6 +216,12 @@ def main(argv: list[str] | None = None) -> int:
     # vtqm: Allocate stamps the webhook-normalized workload class into
     # the v3 config ABI; off = WORKLOAD_CLASS_NONE (the zero bytes)
     vnum.quota_market_enabled = gates.enabled(QUOTA_MARKET)
+    # vtovc: Allocate stamps virtual_hbm_bytes/spill_budget_bytes into
+    # the v4 config ABI and arms the host spill pool; off = zeros, no
+    # pool, no env (the v3 semantics byte-for-byte)
+    vnum.hbm_overcommit_enabled = gates.enabled(HBM_OVERCOMMIT)
+    if gates.enabled(HBM_OVERCOMMIT):
+        vnum.spill_budget_bytes = int(args.spill_budget_gib * 2**30)
     plugins = [vnum]
     if gates.enabled(CORE_PLUGIN):
         plugins.append(VcorePlugin(manager))
@@ -384,6 +395,36 @@ def main(argv: list[str] | None = None) -> int:
                 tc_path=consts.TC_UTIL_CONFIG))
         headroom_pub.start()
         log.info("utilization headroom publisher running")
+
+    # vtovc overcommit plane: this daemon (the node-annotation owner)
+    # computes per-class safe oversubscription ratios from the vtuse
+    # ledger's HBM high-water percentiles and publishes them (plus the
+    # node's live spill signal) for both scheduler paths to admit
+    # against; it also stamps Allocate-time virtual capacity (the vnum
+    # wiring above) and reaps dead spillers' host-pool files. Its OWN
+    # ledger instance, same privacy rule as the market's.
+    overcommit_pub = None
+    if gates.enabled(HBM_OVERCOMMIT):
+        from vtpu_manager.overcommit import (OvercommitPolicy,
+                                             OvercommitPublisher)
+        from vtpu_manager.overcommit import spill as spill_mod
+        from vtpu_manager.utilization import UtilizationLedger as _OCL
+        oc_policy = OvercommitPolicy(_OCL(
+            args.node_name, chips,
+            base_dir=args.base_dir or consts.MANAGER_BASE_DIR,
+            tc_path=consts.TC_UTIL_CONFIG))
+        vnum.overcommit_policy = oc_policy
+
+        class _ReapingPublisher(OvercommitPublisher):
+            def publish_once(self):
+                spill_mod.reap_pool()       # crashed spillers' bytes
+                return super().publish_once()
+
+        overcommit_pub = _ReapingPublisher(client, args.node_name,
+                                           oc_policy)
+        overcommit_pub.start()
+        log.info("overcommit policy publisher running (budget %.1f GiB)",
+                 args.spill_budget_gib)
 
     # vtqm quota market: this daemon (the config writer) lends a chip's
     # measured-idle, confidence-gated headroom between co-tenants in

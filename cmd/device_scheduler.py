@@ -93,6 +93,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.util.featuregates import (COMPILE_CACHE,
                                                 DECISION_EXPLAIN,
                                                 FAULT_INJECTION,
+                                                HBM_OVERCOMMIT,
                                                 QUOTA_MARKET,
                                                 SCHEDULER_HA,
                                                 SCHEDULER_SNAPSHOT,
@@ -157,7 +158,12 @@ def main(argv: list[str] | None = None) -> int:
         # latency-critical pods (validated against the recorded
         # observe-only evidence via scripts/vtpu_replay.py); off =
         # byte-identical placement in both data paths
-        quota_market=gates.enabled(QUOTA_MARKET))
+        quota_market=gates.enabled(QUOTA_MARKET),
+        # vtovc: virtual-HBM admission (physical × published class
+        # ratio) + the spill-rate thrash-backoff penalty; off =
+        # byte-identical placement in both data paths. Same
+        # filter_kwargs ride-along, so vtha shards inherit it.
+        hbm_overcommit=gates.enabled(HBM_OVERCOMMIT))
     # vtexplain satellite: preemption victim ordering gains the vttel/
     # vtuse utilization inputs behind the same gate as the audit trail
     # (the ordering applied is recorded per victim, so it is auditable);

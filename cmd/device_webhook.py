@@ -41,6 +41,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from vtpu_manager.util import consts
     from vtpu_manager.util.featuregates import (COMPILE_CACHE,
+                                                HBM_OVERCOMMIT,
                                                 QUOTA_MARKET, TRACING,
                                                 FeatureGates)
     from vtpu_manager.webhook.server import WebhookAPI, run_server
@@ -80,11 +81,15 @@ def main(argv: list[str] | None = None) -> int:
                      # annotation (gate off = no new patches, byte-
                      # identical admission behavior)
                      stamp_fingerprint=gates.enabled(COMPILE_CACHE),
-                     # vtqm: normalize the declared workload class
-                     # into the one annotation the scheduler's
-                     # headroom term and the plugin's config ABI
-                     # stamping read (gate off = no new patches)
-                     stamp_workload_class=gates.enabled(QUOTA_MARKET))
+                     # vtqm + vtovc: normalize the declared workload
+                     # class into the one annotation the scheduler's
+                     # headroom term, the overcommit plane's per-class
+                     # ratio selection, and the plugin's config ABI
+                     # stamping all read (both gates off = no new
+                     # patches)
+                     stamp_workload_class=(
+                         gates.enabled(QUOTA_MARKET)
+                         or gates.enabled(HBM_OVERCOMMIT)))
     logging.getLogger(__name__).info("vtpu-webhook on %s:%d", args.host,
                                      args.port)
     run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)

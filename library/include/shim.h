@@ -59,6 +59,12 @@ struct Counter {
 struct Metrics {
   Counter oom_rejected{"oom_rejected"};
   Counter mem_charged{"mem_charged"};
+  // vtovc spill tier: cold-buffer demotions to the host pool, refills
+  // on next touch, and physical-exhaustion rejections the spill arm
+  // could NOT absorb (no cold candidates / budget exhausted)
+  Counter spills{"spills"};
+  Counter fills{"fills"};
+  Counter spill_rejected{"spill_rejected"};
   Counter throttle_waits{"throttle_waits"};
   Counter gap_throttles{"gap_throttles"};
   Counter watcher_ticks{"watcher_ticks"};
@@ -100,6 +106,9 @@ struct alignas(128) DeviceHot {
   std::atomic<bool> throttled_since_watch{false};
   std::atomic<int> vmem_idx{-1};           // cached own vmem-ledger slot
   std::atomic<uint64_t> vmem_retry_ns{0};  // ledger-full claim backoff
+  // vtovc: this process's live host-pool bytes for the chip (published
+  // to the vmem entry's spilled field, bounded by spill_budget_bytes)
+  std::atomic<int64_t> spilled_bytes{0};
   // Observation-overhead calibration: host-observed completion spans carry
   // a fixed per-op transport+observation latency (remote PJRT tunnels add
   // ~ms of RTT to every span). An idle-time probe (min of an H2D and a D2H
@@ -128,9 +137,39 @@ struct ShimState {
   DeviceHot hot[kMaxDeviceCount];
   // PJRT local device ordinal -> slot in config.devices (-1 = unmanaged)
   int slot_by_ordinal[kMaxDeviceCount];
-  // buffer -> (slot, bytes) for destroy-time credit
+  // buffer -> tracking record for destroy-time credit. The vtovc spill
+  // tier extends the record with an LRU key (last Execute-input touch)
+  // and, for buffers whose creation shape was observed, the dims/type
+  // needed to re-materialize them from a host copy — only those are
+  // spill candidates (a buffer we could not recreate must never be
+  // demoted).
+  struct BufRec {
+    int slot = -1;
+    int64_t bytes = 0;
+    uint64_t last_touch_ns = 0;          // LRU by last-Execute touch
+    bool spillable = false;
+    std::vector<int64_t> dims;
+    PJRT_Buffer_Type type = PJRT_Buffer_Type_INVALID;
+  };
   std::mutex buffers_mu;
-  std::unordered_map<PJRT_Buffer*, std::pair<int, int64_t>> buffers;
+  std::unordered_map<PJRT_Buffer*, BufRec> buffers;
+  // vtovc spill tier (armed when VTPU_SPILL_POOL_DIR is injected AND a
+  // device's virtual_hbm_bytes exceeds its physical capacity):
+  // `spilled` holds demoted buffers — original handle -> host copy —
+  // whose HBM was freed via PJRT_Buffer_Delete; `spill_fwd` maps a
+  // demoted-then-refilled original to its live replacement so Execute
+  // argument lists (and D2H readbacks) are transparently rewritten.
+  // Both under spill_mu (never taken inside buffers_mu).
+  struct SpillRec {
+    int slot = -1;
+    int64_t bytes = 0;
+    void* host = nullptr;                // malloc'd host-pool block
+    std::vector<int64_t> dims;
+    PJRT_Buffer_Type type = PJRT_Buffer_Type_INVALID;
+  };
+  std::mutex spill_mu;
+  std::unordered_map<PJRT_Buffer*, SpillRec> spilled;
+  std::unordered_map<PJRT_Buffer*, PJRT_Buffer*> spill_fwd;
   // async H2D transfer managers: bytes are reserved when the manager is
   // created (CreateBuffersForAsyncHostToDevice); each buffer's share moves
   // to `buffers` on RetrieveBuffer, and unretrieved shares are credited

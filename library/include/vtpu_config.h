@@ -14,6 +14,7 @@
 
 #include <cstddef>
 #include <cstdint>
+#include <cstdlib>
 
 namespace vtpu {
 
@@ -25,7 +26,11 @@ constexpr uint32_t kConfigMagic = 0x55505456;  // "VTPU"
 // when the on-disk epoch moves, bounding revoke-to-enforcement at one
 // throttle quantum + one re-read); the device pad became lease_core
 // (signed borrowed/lent core-% delta).
-constexpr uint32_t kConfigVersion = 3;
+// v4 (vtovc): the device struct grew virtual_hbm_bytes (scheduler-
+// admitted VIRTUAL chip capacity; > real_memory arms the spill tier)
+// and spill_budget_bytes (node host-RAM budget bounding Σ spilled in
+// the vmem ledger). Gate off writes zeros — v3 semantics byte-for-byte.
+constexpr uint32_t kConfigVersion = 4;
 constexpr int kMaxDeviceCount = 64;
 constexpr int kUuidLen = 64;
 constexpr int kNameLen = 64;
@@ -70,12 +75,21 @@ struct VtpuDevice {
   // v2 pad — 0 means no lease). Effective rate =
   // clamp(hard_core + lease_core, 0, 100).
   int32_t lease_core;
+  // vtovc (v4; both 0 when HBMOvercommit is off): the chip's VIRTUAL
+  // capacity the scheduler admitted against — when > real_memory the
+  // physical-exhaustion check spills cold buffers to the host pool
+  // instead of hard-failing — and the node's host-RAM spill budget
+  // (bound on Σ spilled bytes across tenants, vmem-ledger accounted).
+  uint64_t virtual_hbm_bytes;
+  uint64_t spill_budget_bytes;
 };
-static_assert(sizeof(VtpuDevice) == 120, "VtpuDevice ABI size");
+static_assert(sizeof(VtpuDevice) == 136, "VtpuDevice ABI size");
 static_assert(offsetof(VtpuDevice, total_memory) == 64, "ABI");
 static_assert(offsetof(VtpuDevice, hard_core) == 80, "ABI");
 static_assert(offsetof(VtpuDevice, mesh_x) == 104, "ABI");
 static_assert(offsetof(VtpuDevice, lease_core) == 116, "ABI");
+static_assert(offsetof(VtpuDevice, virtual_hbm_bytes) == 120, "ABI");
+static_assert(offsetof(VtpuDevice, spill_budget_bytes) == 128, "ABI");
 
 struct VtpuConfig {
   uint32_t magic;
@@ -103,7 +117,7 @@ static_assert(offsetof(VtpuConfig, compile_cache_dir) == 256, "ABI");
 static_assert(offsetof(VtpuConfig, workload_class) == 320, "ABI");
 static_assert(offsetof(VtpuConfig, quota_epoch) == 324, "ABI");
 static_assert(offsetof(VtpuConfig, devices) == 328, "ABI");
-static_assert(sizeof(VtpuConfig) == 328 + 64 * 120 + 8, "VtpuConfig ABI");
+static_assert(sizeof(VtpuConfig) == 328 + 64 * 136 + 8, "VtpuConfig ABI");
 
 inline uint64_t Fnv1a64(const char* data) {
   uint64_t h = 0xCBF29CE484222325ull;
@@ -181,7 +195,11 @@ static_assert(sizeof(TcCalibration) == 24 + 2 * 8 * 8, "ABI");
 // ---------------------------------------------------------------------------
 
 constexpr uint32_t kVmemMagic = 0x4D454D56;  // "VMEM"
-constexpr uint32_t kVmemVersion = 2;
+// v3 (vtovc): entries grew a trailing spilled u64 — the tenant's live
+// host-pool footprint. Resident (`bytes`) and spilled are disjoint: the
+// alloc-path cap check sums resident only, the node spill budget bounds
+// Σ spilled, and a dead+stale entry's reap reclaims both at once.
+constexpr uint32_t kVmemVersion = 3;
 constexpr int kVmemMaxEntries = 1024;
 
 struct VmemEntry {
@@ -192,8 +210,10 @@ struct VmemEntry {
   uint64_t owner_token;  // namespace-independent tenant identity
   uint64_t activity;     // monotonic submit counter; the node watcher
                          // apportions chip duty-cycle by per-tick deltas
+  uint64_t spilled;      // v3: live host-RAM spill-pool bytes
 };
-static_assert(sizeof(VmemEntry) == 40, "ABI");
+static_assert(sizeof(VmemEntry) == 48, "ABI");
+static_assert(offsetof(VmemEntry, spilled) == 40, "ABI");
 
 struct VmemFile {
   uint32_t magic;
@@ -202,7 +222,21 @@ struct VmemFile {
   int32_t pad_;
   VmemEntry entries[kVmemMaxEntries];
 };
-static_assert(sizeof(VmemFile) == 16 + 1024 * 40, "ABI");
+static_assert(sizeof(VmemFile) == 16 + 1024 * 48, "ABI");
+
+// Dead-entry staleness window — the SHARED clamp contract with Python's
+// vmem._stale_reap_ns (VTPU_VMEM_STALE_S): unparsable/<=0/NaN fall back
+// to 120 s, huge values clamp to 1e10 s BEFORE the fp->int conversion
+// (overflow there is UB). Header-inline so enforce.cc and the g++-probe
+// parity row in tests/test_config_abi.py compile the SAME function —
+// the v3 spilled field makes divergent reaping load-bearing: a side
+// that reaps earlier would free spill budget the other still charges.
+inline uint64_t VmemStaleReapNsFromEnv(const char* v) {
+  double s = v ? atof(v) : 120.0;
+  if (!(s > 0)) s = 120.0;  // catches 0, negatives, NaN and garbage
+  if (s > 1e10) s = 1e10;   // ~317 years: effectively never, still finite
+  return (uint64_t)(s * 1e9);
+}
 
 // ---------------------------------------------------------------------------
 // pids.config (CLIENT compat mode: registry-attested container pid set)

@@ -32,7 +32,11 @@
 namespace vtpu {
 
 constexpr uint32_t kStepRingMagic = 0x54535456;  // "VTST"
-constexpr uint32_t kStepRingVersion = 1;
+// v2 (vtovc): records grew a spill block — spilled_bytes gauge +
+// spill/fill event deltas — the channel carrying the shim's host-tier
+// activity to the collector's vtpu_node_spill_* series. Strict version
+// check; rings are recreated per container and ship with the node.
+constexpr uint32_t kStepRingVersion = 2;
 constexpr int kStepRingCapacity = 256;
 constexpr int kStepTraceIdLen = 48;
 
@@ -63,13 +67,20 @@ struct StepRecord {
   uint64_t hbm_highwater_bytes;
   uint32_t flags;        // kStepFlag*
   int32_t pad_;
+  // v2 spill block (vtovc; zeros when HBMOvercommit is off)
+  uint64_t spilled_bytes;  // host-pool footprint at step end (gauge)
+  uint32_t spill_events;   // HBM->host demotions since last record
+  uint32_t fill_events;    // host->HBM promotions since last record
 };
-static_assert(sizeof(StepRecord) == 56, "StepRecord ABI size");
+static_assert(sizeof(StepRecord) == 72, "StepRecord ABI size");
 static_assert(offsetof(StepRecord, index) == 8, "ABI");
 static_assert(offsetof(StepRecord, duration_ns) == 24, "ABI");
 static_assert(offsetof(StepRecord, throttle_wait_ns) == 32, "ABI");
 static_assert(offsetof(StepRecord, hbm_highwater_bytes) == 40, "ABI");
 static_assert(offsetof(StepRecord, flags) == 48, "ABI");
+static_assert(offsetof(StepRecord, spilled_bytes) == 56, "ABI");
+static_assert(offsetof(StepRecord, spill_events) == 64, "ABI");
+static_assert(offsetof(StepRecord, fill_events) == 68, "ABI");
 
 constexpr size_t kStepRingFileSize =
     sizeof(StepRingHeader) + kStepRingCapacity * sizeof(StepRecord);
@@ -165,7 +176,8 @@ class StepRingWriter {
   // invert parity and let torn reads validate.
   void Record(uint64_t duration_ns, uint64_t throttle_wait_ns,
               uint64_t hbm_highwater_bytes, bool compiled,
-              uint64_t start_mono_ns = 0) {
+              uint64_t start_mono_ns = 0, uint64_t spilled_bytes = 0,
+              uint32_t spill_events = 0, uint32_t fill_events = 0) {
     if (!mm_) return;
     if (start_mono_ns == 0) {
       struct timespec ts;
@@ -188,6 +200,9 @@ class StepRingWriter {
     rec->hbm_highwater_bytes = hbm_highwater_bytes;
     rec->flags = compiled ? kStepFlagCompile : 0;
     rec->pad_ = 0;
+    rec->spilled_bytes = spilled_bytes;
+    rec->spill_events = spill_events;
+    rec->fill_events = fill_events;
     __atomic_store_n(&rec->seq, wseq + 1, __ATOMIC_RELEASE);  // even
     writes_ = index + 1;
     __atomic_store_n(&Header()->writes, writes_, __ATOMIC_RELEASE);

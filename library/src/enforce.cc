@@ -487,15 +487,11 @@ bool PidIsSelf(int pid) {
 // Dead-entry staleness window (shared contract with Python's
 // VTPU_VMEM_STALE_S): a dead-looking pid is only ignored/reaped once its
 // entry also went stale, since foreign pid namespaces are unprobeable.
+// The clamp itself lives in vtpu_config.h (VmemStaleReapNsFromEnv) so
+// the test_config_abi parity probe compiles the exact function.
 uint64_t StaleReapNs() {
-  static uint64_t ns = [] {
-    const char* v = getenv("VTPU_VMEM_STALE_S");
-    double s = v ? atof(v) : 120.0;
-    if (!(s > 0)) s = 120.0;       // catches 0, negatives and NaN
-    if (s > 1e10) s = 1e10;        // clamp BEFORE the fp->int conversion
-                                   // (overflow there is UB)
-    return (uint64_t)(s * 1e9);
-  }();
+  static uint64_t ns =
+      VmemStaleReapNsFromEnv(getenv("VTPU_VMEM_STALE_S"));
   return ns;
 }
 
@@ -528,6 +524,25 @@ LedgerBytes ScanLedgerBytes(int slot) {
 
 int64_t OtherProcsBytes(int slot) { return ScanLedgerBytes(slot).others; }
 
+// vtovc: Σ live spilled bytes across the WHOLE node (every tenant,
+// every chip) — the scope the per-node spill budget bounds. Same
+// dead+stale skip rule as the resident scan: a crashed spiller's
+// host-pool claim must not pin budget forever (the daemon reaps the
+// entry; skipping here is the read-side mirror).
+static int64_t ScanLedgerSpilled() {
+  if (!g_vmem) return 0;
+  int64_t total = 0;
+  uint64_t now = NowNs();
+  for (int i = 0; i < kVmemMaxEntries; i++) {
+    const VmemEntry& e = g_vmem->entries[i];
+    if (e.pid == 0) continue;
+    if (!PidAlive(e.pid) && now - e.last_update_ns > StaleReapNs())
+      continue;
+    total += (int64_t)e.spilled;
+  }
+  return total;
+}
+
 // Find this tenant's entry, optionally claiming a free slot. Caller must
 // hold VmemLock: two first-time writers must not claim the same free slot
 // (the loser's record would vanish and co-tenant caps undercount). The
@@ -550,6 +565,7 @@ int FindOrClaimOwnEntryLocked(const VtpuDevice* cfg, bool claim) {
   e.last_update_ns = NowNs();
   e.owner_token = g_owner_token;
   e.activity = 0;
+  e.spilled = 0;
   __atomic_store_n(&e.pid, me, __ATOMIC_RELEASE);  // pid last: claims slot
   return free_slot;
 }
@@ -560,11 +576,18 @@ void RecordOwnBytes(int slot) {
   ShimState& s = State();
   int64_t raw = s.hot[slot].used_bytes.load(std::memory_order_relaxed);
   uint64_t mine = raw > 0 ? (uint64_t)raw : 0;
+  int64_t sraw = s.hot[slot].spilled_bytes.load(std::memory_order_relaxed);
+  uint64_t spilled = sraw > 0 ? (uint64_t)sraw : 0;
   VmemLock lock;
-  int idx = FindOrClaimOwnEntryLocked(cfg, /*claim=*/mine > 0);
+  // a live host-pool footprint keeps the entry claimed even at zero
+  // resident bytes — the budget accounting must survive the dip
+  // (mirrors vmem.py record/record_spilled slot-retention rule)
+  int idx = FindOrClaimOwnEntryLocked(cfg,
+                                      /*claim=*/mine > 0 || spilled > 0);
   if (idx < 0) return;
   VmemEntry& e = g_vmem->entries[idx];
   e.bytes = mine;
+  e.spilled = spilled;
   e.last_update_ns = NowNs();
   s.hot[slot].vmem_idx.store(idx, std::memory_order_relaxed);
 }
@@ -676,6 +699,30 @@ void UpdatePeak(int slot, int64_t used) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// vtovc host-spill tier plumbing (implementation after the probe
+// helpers below — the demotion path reuses their event handling).
+// Armed only when Allocate injected the pool env AND the v4 config
+// gave a device virtual capacity above physical; everything here is
+// one branch on the cold path otherwise.
+// ---------------------------------------------------------------------------
+
+bool SpillTierArmed() {
+  static int armed = [] {
+    const char* d = getenv("VTPU_SPILL_POOL_DIR");
+    return (d && *d) ? 1 : 0;
+  }();
+  return armed == 1;
+}
+
+// step-ring deltas: tier transitions since the last published record
+std::atomic<uint32_t> g_spill_events_window{0};
+std::atomic<uint32_t> g_fill_events_window{0};
+
+bool TrySpillCold(int slot, int64_t need);
+void HandleSpillDestroy(PJRT_Buffer* buf);
+PJRT_Error* WrappedBufferDestroy(PJRT_Buffer_Destroy_Args* args);
+
 // Reserve-then-call: the cap check and the charge are one atomic step under
 // the cross-process device lock (a check-then-charge split would let two
 // concurrent allocations both pass and land past the cap). Accounting is
@@ -706,16 +753,31 @@ PJRT_Error* ReserveMemory(int slot, int64_t bytes) {
         "B cap=%" PRId64 "B",
         cfg->host_index, bytes, own, lb.siblings, cap);
   }
-  // physical pressure: everyone on the chip. Only binds when slots are
-  // oversold — the scheduler keeps sum-of-caps <= physical otherwise.
+  // physical pressure: everyone on the chip. Binds when slots are
+  // oversold or the node runs virtual-HBM overcommit — the scheduler
+  // keeps sum-of-caps <= physical otherwise.
   if (phys > 0 && own + lb.siblings + lb.others + bytes > phys) {
-    g_metrics.oom_rejected.Bump();
-    return MakeError(
-        PJRT_Error_Code_RESOURCE_EXHAUSTED,
-        "vtpu-control: physical HBM exhausted on device %d: "
-        "req=%" PRId64 "B tenant=%" PRId64 "B co-tenants=%" PRId64
-        "B physical=%" PRId64 "B",
-        cfg->host_index, bytes, own + lb.siblings, lb.others, phys);
+    // vtovc spill arm: over physical but under the VIRTUAL capacity
+    // the scheduler admitted against — demote cold buffers (LRU by
+    // last-Execute touch) into the host pool instead of failing. The
+    // arm only ever converts failures into successes: any reason it
+    // cannot (tier unarmed, over virtual too, no cold candidates,
+    // node spill budget exhausted) falls through to the exact pre-v4
+    // rejection.
+    int64_t virt = (int64_t)cfg->virtual_hbm_bytes;
+    int64_t overshoot = own + lb.siblings + lb.others + bytes - phys;
+    bool spilled_through =
+        virt > phys && own + lb.siblings + lb.others + bytes <= virt &&
+        SpillTierArmed() && TrySpillCold(slot, overshoot);
+    if (!spilled_through) {
+      g_metrics.oom_rejected.Bump();
+      return MakeError(
+          PJRT_Error_Code_RESOURCE_EXHAUSTED,
+          "vtpu-control: physical HBM exhausted on device %d: "
+          "req=%" PRId64 "B tenant=%" PRId64 "B co-tenants=%" PRId64
+          "B physical=%" PRId64 "B",
+          cfg->host_index, bytes, own + lb.siblings, lb.others, phys);
+    }
   }
   // fetch_add, not store: concurrent destroys may subtract while we hold
   // the lock (reserves are serialized by the lock; frees only help).
@@ -730,12 +792,25 @@ void UnreserveMemory(int slot, int64_t bytes) {
   State().hot[slot].used_bytes.fetch_sub(bytes, std::memory_order_relaxed);
 }
 
-// Record an already-reserved buffer for destroy-time credit.
-void TrackBuffer(PJRT_Buffer* buf, int slot, int64_t bytes) {
+// Record an already-reserved buffer for destroy-time credit. Buffers
+// whose creation shape was observed (dims + element type) are marked
+// SPILLABLE: the vtovc tier can re-materialize them from a host copy,
+// so they are demotion candidates; everything else is pinned to HBM.
+void TrackBuffer(PJRT_Buffer* buf, int slot, int64_t bytes,
+                 const int64_t* dims = nullptr, size_t num_dims = 0,
+                 PJRT_Buffer_Type type = PJRT_Buffer_Type_INVALID) {
   ShimState& s = State();
   {
     std::lock_guard<std::mutex> g(s.buffers_mu);
-    s.buffers[buf] = {slot, bytes};
+    ShimState::BufRec& rec = s.buffers[buf];
+    rec.slot = slot;
+    rec.bytes = bytes;
+    rec.last_touch_ns = NowNs();
+    if (dims != nullptr && type != PJRT_Buffer_Type_INVALID) {
+      rec.spillable = true;
+      rec.dims.assign(dims, dims + num_dims);
+      rec.type = type;
+    }
   }
   RecordOwnBytes(slot);
   g_metrics.mem_charged.Bump();
@@ -752,25 +827,31 @@ PJRT_Error* WrappedBufferFromHostBuffer(
     UnreserveMemory(slot, bytes);
     return err;
   }
-  TrackBuffer(args->buffer, slot, bytes);
+  TrackBuffer(args->buffer, slot, bytes, args->dims, args->num_dims,
+              args->type);
   return nullptr;
 }
 
 PJRT_Error* WrappedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
   ShimState& s = State();
-  std::pair<int, int64_t> rec{-1, 0};
+  ShimState::BufRec rec;
+  bool tracked = false;
   {
     std::lock_guard<std::mutex> g(s.buffers_mu);
     auto it = s.buffers.find(args->buffer);
     if (it != s.buffers.end()) {
       rec = it->second;
+      tracked = true;
       s.buffers.erase(it);
     }
   }
+  // vtovc: a demoted (or demoted-then-refilled) handle carries host-
+  // pool and replacement state the tenant cannot see; settle it
+  if (SpillTierArmed()) HandleSpillDestroy(args->buffer);
   PJRT_Error* err = g_real_buf_destroy(args);
-  if (rec.first >= 0) {
-    s.hot[rec.first].used_bytes.fetch_sub(rec.second);
-    RecordOwnBytes(rec.first);
+  if (tracked) {
+    s.hot[rec.slot].used_bytes.fetch_sub(rec.bytes);
+    RecordOwnBytes(rec.slot);
   }
   return err;
 }
@@ -863,7 +944,9 @@ int SlotForMemory(PJRT_Memory* memory) {
 // Post-call reconciliation shared by the new alloc wraps: the reservation
 // was an estimate; once the real buffer exists, settle to its actual
 // on-device size and record it for destroy-time credit.
-void SettleAndTrack(int slot, int64_t reserved, PJRT_Buffer* buf) {
+void SettleAndTrack(int slot, int64_t reserved, PJRT_Buffer* buf,
+                    const int64_t* dims = nullptr, size_t num_dims = 0,
+                    PJRT_Buffer_Type type = PJRT_Buffer_Type_INVALID) {
   ShimState& s = State();
   int64_t actual = reserved;
   if (s.real_api->PJRT_Buffer_OnDeviceSizeInBytes) {
@@ -879,7 +962,7 @@ void SettleAndTrack(int slot, int64_t reserved, PJRT_Buffer* buf) {
                                      std::memory_order_relaxed);
     UpdatePeak(slot, s.hot[slot].used_bytes.load(std::memory_order_relaxed));
   }
-  TrackBuffer(buf, slot, actual);
+  TrackBuffer(buf, slot, actual, dims, num_dims, type);
 }
 
 PJRT_Error* WrappedCreateUninitialized(
@@ -897,7 +980,8 @@ PJRT_Error* WrappedCreateUninitialized(
     UnreserveMemory(slot, bytes);
     return err;
   }
-  SettleAndTrack(slot, bytes, args->buffer);
+  SettleAndTrack(slot, bytes, args->buffer, args->shape_dims,
+                 args->shape_num_dims, args->shape_element_type);
   return nullptr;
 }
 
@@ -1018,7 +1102,7 @@ int64_t SourceBufferBytes(PJRT_Buffer* buf) {
   {
     std::lock_guard<std::mutex> g(s.buffers_mu);
     auto it = s.buffers.find(buf);
-    if (it != s.buffers.end()) return it->second.second;
+    if (it != s.buffers.end()) return it->second.bytes;
   }
   if (!s.real_api->PJRT_Buffer_OnDeviceSizeInBytes) return 0;
   PJRT_Buffer_OnDeviceSizeInBytes_Args bargs;
@@ -1754,6 +1838,331 @@ void StartWatcher() {
   }
 }
 
+// ---------------------------------------------------------------------------
+// vtovc host-spill tier implementation.
+//
+// Demotion (SpillOne): synchronous D2H copy of a cold tracked buffer
+// into a malloc'd host block, then PJRT_Buffer_Delete frees its HBM —
+// the handle stays valid for the tenant's eventual Destroy. The bytes
+// move from the hot resident counter to the spilled counter and are
+// published to the vmem ledger's v3 spilled field, where the per-node
+// spill budget bounds the sum across every tenant (the same pre-write
+// guard the Python SpillPool applies).
+//
+// Promotion (FillSpilled): the next Execute (or D2H readback) touching
+// a demoted buffer re-materializes it through the real
+// BufferFromHostBuffer — via ReserveMemory, so a refill may itself
+// cascade-demote colder buffers — and the forwarded-handle table
+// rewrites the tenant's argument lists to the replacement. The tenant
+// keeps using the original pointer; the shim owns the indirection.
+// ---------------------------------------------------------------------------
+
+// chase original -> live replacement (a refilled buffer may itself
+// have been demoted and refilled again; chains stay short)
+PJRT_Buffer* ResolveSpillFwd(PJRT_Buffer* buf) {
+  ShimState& s = State();
+  std::lock_guard<std::mutex> g(s.spill_mu);
+  auto it = s.spill_fwd.find(buf);
+  while (it != s.spill_fwd.end()) {
+    buf = it->second;
+    it = s.spill_fwd.find(buf);
+  }
+  return buf;
+}
+
+// demote one claimed buffer record (already removed from s.buffers by
+// the caller). Returns false with the claim NOT restored — the caller
+// re-tracks on failure.
+bool SpillOne(PJRT_Buffer* buf, const ShimState::BufRec& rec) {
+  ShimState& s = State();
+  if (!s.real_api->PJRT_Buffer_ToHostBuffer ||
+      !s.real_api->PJRT_Buffer_Delete || rec.bytes <= 0)
+    return false;
+  void* host = malloc((size_t)rec.bytes);
+  if (!host) return false;
+  PJRT_Buffer_ToHostBuffer_Args targs;
+  memset(&targs, 0, sizeof(targs));
+  targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  targs.src = buf;
+  targs.dst = host;
+  targs.dst_size = (size_t)rec.bytes;
+  if (ConsumeError(s.real_api->PJRT_Buffer_ToHostBuffer(&targs))) {
+    free(host);
+    return false;
+  }
+  if (targs.event) {
+    // the copy is asynchronous; the demotion must not free HBM until
+    // the host block actually holds the bytes
+    if (!s.real_api->PJRT_Event_Await) {
+      DestroyEvent(targs.event);
+      free(host);
+      return false;
+    }
+    PJRT_Event_Await_Args aargs;
+    memset(&aargs, 0, sizeof(aargs));
+    aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    aargs.event = targs.event;
+    bool failed = ConsumeError(s.real_api->PJRT_Event_Await(&aargs));
+    DestroyEvent(targs.event);
+    if (failed) {
+      free(host);
+      return false;
+    }
+  }
+  PJRT_Buffer_Delete_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Buffer_Delete_Args_STRUCT_SIZE;
+  dargs.buffer = buf;
+  if (ConsumeError(s.real_api->PJRT_Buffer_Delete(&dargs))) {
+    free(host);
+    return false;
+  }
+  {
+    std::lock_guard<std::mutex> g(s.spill_mu);
+    ShimState::SpillRec& sp = s.spilled[buf];
+    sp.slot = rec.slot;
+    sp.bytes = rec.bytes;
+    sp.host = host;
+    sp.dims = rec.dims;
+    sp.type = rec.type;
+  }
+  s.hot[rec.slot].used_bytes.fetch_sub(rec.bytes,
+                                       std::memory_order_relaxed);
+  s.hot[rec.slot].spilled_bytes.fetch_add(rec.bytes,
+                                          std::memory_order_relaxed);
+  RecordOwnBytes(rec.slot);
+  g_metrics.spills.Bump();
+  g_spill_events_window.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+// The ReserveMemory spill arm. The caller holds the device lock, so
+// concurrent reserves cannot double-spend the HBM this frees; the vmem
+// lock is only taken inside RecordOwnBytes.
+bool TrySpillCold(int slot, int64_t need) {
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  ShimState& s = State();
+  if (!cfg || need <= 0) return false;
+  // claim LRU victims out of the tracking map — coldest last-Execute
+  // touch first. An uncoverable need restores every claim and fails
+  // the arm: a partial eviction would thrash without admitting the
+  // allocation that asked for it.
+  std::vector<std::pair<PJRT_Buffer*, ShimState::BufRec>> victims;
+  int64_t covered = 0;
+  {
+    std::lock_guard<std::mutex> g(s.buffers_mu);
+    std::vector<std::pair<uint64_t, PJRT_Buffer*>> order;
+    for (const auto& kv : s.buffers) {
+      if (kv.second.slot == slot && kv.second.spillable &&
+          kv.second.bytes > 0)
+        order.emplace_back(kv.second.last_touch_ns, kv.first);
+    }
+    std::sort(order.begin(), order.end());
+    for (const auto& ob : order) {
+      if (covered >= need) break;
+      auto it = s.buffers.find(ob.second);
+      victims.emplace_back(ob.second, it->second);
+      covered += it->second.bytes;
+      s.buffers.erase(it);
+    }
+    if (covered < need) {
+      for (auto& v : victims) s.buffers.emplace(v.first, v.second);
+      g_metrics.spill_rejected.Bump();
+      return false;
+    }
+  }
+  // pre-write budget guard with the ACTUAL victim bytes (buffer
+  // granularity makes `covered` overshoot `need` by up to one buffer,
+  // and the budget bounds what lands in the pool, not what was asked
+  // for): Σ spilled node-wide (ledger truth, every tenant) + this
+  // demotion must fit. Over budget restores every claim — the same
+  // hard pre-write invariant the Python SpillPool guards.
+  if (cfg->spill_budget_bytes &&
+      ScanLedgerSpilled() + covered > (int64_t)cfg->spill_budget_bytes) {
+    std::lock_guard<std::mutex> g(s.buffers_mu);
+    for (auto& v : victims) s.buffers.emplace(v.first, v.second);
+    g_metrics.spill_rejected.Bump();
+    return false;
+  }
+  int64_t moved = 0;
+  for (auto& v : victims) {
+    if (SpillOne(v.first, v.second)) {
+      moved += v.second.bytes;
+    } else {
+      std::lock_guard<std::mutex> g(s.buffers_mu);
+      s.buffers.emplace(v.first, v.second);   // demotion failed: re-track
+    }
+  }
+  if (moved < need) {
+    g_metrics.spill_rejected.Bump();
+    return false;     // already-moved victims stay consistent (host pool)
+  }
+  VTPU_LOG(kLogInfo,
+           "vtpu-control: spilled %" PRId64
+           " B of cold buffers to host on device %d (virtual %" PRIu64
+           " B over physical)",
+           moved, cfg->host_index, cfg->virtual_hbm_bytes);
+  return true;
+}
+
+// promote one demoted buffer back to HBM. Returns the replacement, or
+// nullptr with *err set when HBM could not be made (the caller fails
+// its operation with that error); nullptr with *err unset means `buf`
+// was not spilled at all.
+PJRT_Error* FillSpilled(PJRT_Buffer* buf, PJRT_Buffer** out) {
+  ShimState& s = State();
+  *out = nullptr;
+  ShimState::SpillRec rec;
+  {
+    std::lock_guard<std::mutex> g(s.spill_mu);
+    auto it = s.spilled.find(buf);
+    if (it == s.spilled.end()) return nullptr;
+    rec = it->second;
+    s.spilled.erase(it);
+  }
+  PJRT_Client* client = s.probe_client.load(std::memory_order_relaxed);
+  PJRT_Device* dev =
+      s.probe_device[rec.slot].load(std::memory_order_relaxed);
+  auto restore = [&]() {
+    std::lock_guard<std::mutex> g(s.spill_mu);
+    s.spilled[buf] = rec;
+  };
+  if (!client || !dev || !g_real_bfhb) {
+    restore();
+    return MakeError(PJRT_Error_Code_INTERNAL,
+                     "vtpu-control: cannot refill spilled buffer on "
+                     "device %d (no captured client)", rec.slot);
+  }
+  if (PJRT_Error* err = ReserveMemory(rec.slot, rec.bytes)) {
+    restore();
+    return err;      // over virtual / budget: the honest failure
+  }
+  PJRT_Client_BufferFromHostBuffer_Args bargs;
+  memset(&bargs, 0, sizeof(bargs));
+  bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  bargs.client = client;
+  bargs.data = rec.host;
+  bargs.type = rec.type;
+  bargs.dims = rec.dims.data();
+  bargs.num_dims = rec.dims.size();
+  // data is copied during the call, so the host block frees right after
+  bargs.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  bargs.device = dev;
+  PJRT_Error* err = g_real_bfhb(&bargs);
+  if (err || !bargs.buffer) {
+    UnreserveMemory(rec.slot, rec.bytes);
+    restore();
+    return err ? err
+               : MakeError(PJRT_Error_Code_INTERNAL,
+                           "vtpu-control: refill produced no buffer");
+  }
+  DestroyEvent(bargs.done_with_host_buffer);
+  free(rec.host);
+  // re-track spillable: a refilled buffer that goes cold again may
+  // round-trip to the host pool again
+  TrackBuffer(bargs.buffer, rec.slot, rec.bytes, rec.dims.data(),
+              rec.dims.size(), rec.type);
+  s.hot[rec.slot].spilled_bytes.fetch_sub(rec.bytes,
+                                          std::memory_order_relaxed);
+  RecordOwnBytes(rec.slot);
+  {
+    std::lock_guard<std::mutex> g(s.spill_mu);
+    s.spill_fwd[buf] = bargs.buffer;
+  }
+  g_metrics.fills.Bump();
+  g_fill_events_window.fetch_add(1, std::memory_order_relaxed);
+  *out = bargs.buffer;
+  return nullptr;
+}
+
+// Destroy-path settlement for a handle with spill state: a still-
+// demoted buffer's host block and budget go with it; a refilled one's
+// live replacement (which the tenant never saw) is destroyed through
+// the wrapped path so ITS tracking/spill state settles recursively.
+void HandleSpillDestroy(PJRT_Buffer* buf) {
+  ShimState& s = State();
+  ShimState::SpillRec rec;
+  bool was_spilled = false;
+  PJRT_Buffer* fwd = nullptr;
+  {
+    std::lock_guard<std::mutex> g(s.spill_mu);
+    auto it = s.spilled.find(buf);
+    if (it != s.spilled.end()) {
+      rec = it->second;
+      was_spilled = true;
+      s.spilled.erase(it);
+    }
+    auto f = s.spill_fwd.find(buf);
+    if (f != s.spill_fwd.end()) {
+      fwd = f->second;
+      s.spill_fwd.erase(f);
+    }
+  }
+  if (was_spilled) {
+    free(rec.host);
+    s.hot[rec.slot].spilled_bytes.fetch_sub(rec.bytes,
+                                            std::memory_order_relaxed);
+    RecordOwnBytes(rec.slot);
+  }
+  if (fwd) {
+    PJRT_Buffer_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = fwd;
+    ConsumeError(WrappedBufferDestroy(&dargs));
+  }
+}
+
+// Execute-input pass: refresh the LRU clock for every tracked input
+// and, when anything is demoted or forwarded, rewrite the argument
+// lists to live replacements (filling demoted inputs through the
+// reserve path). `rewritten`/`rewritten_ptrs` own the substituted
+// lists for the duration of the caller's real-Execute call.
+PJRT_Error* TouchAndFillArguments(
+    PJRT_LoadedExecutable_Execute_Args* args,
+    std::vector<std::vector<PJRT_Buffer*>>* rewritten,
+    std::vector<PJRT_Buffer* const*>* rewritten_ptrs) {
+  ShimState& s = State();
+  if (!args->argument_lists || args->num_devices == 0 ||
+      args->num_args == 0)
+    return nullptr;
+  uint64_t now = NowNs();
+  {
+    std::lock_guard<std::mutex> g(s.buffers_mu);
+    for (size_t d = 0; d < args->num_devices; d++) {
+      for (size_t a = 0; a < args->num_args; a++) {
+        auto it = s.buffers.find(args->argument_lists[d][a]);
+        if (it != s.buffers.end()) it->second.last_touch_ns = now;
+      }
+    }
+  }
+  bool need_rewrite;
+  {
+    std::lock_guard<std::mutex> g(s.spill_mu);
+    need_rewrite = !s.spilled.empty() || !s.spill_fwd.empty();
+  }
+  if (!need_rewrite) return nullptr;
+  rewritten->resize(args->num_devices);
+  for (size_t d = 0; d < args->num_devices; d++) {
+    (*rewritten)[d].assign(args->argument_lists[d],
+                           args->argument_lists[d] + args->num_args);
+    for (size_t a = 0; a < args->num_args; a++) {
+      PJRT_Buffer* cur = ResolveSpillFwd((*rewritten)[d][a]);
+      PJRT_Buffer* filled = nullptr;
+      if (PJRT_Error* err = FillSpilled(cur, &filled)) return err;
+      if (filled) cur = filled;
+      (*rewritten)[d][a] = cur;
+      std::lock_guard<std::mutex> g(s.buffers_mu);
+      auto it = s.buffers.find(cur);
+      if (it != s.buffers.end()) it->second.last_touch_ns = now;
+    }
+    rewritten_ptrs->push_back((*rewritten)[d].data());
+  }
+  args->argument_lists = rewritten_ptrs->data();
+  return nullptr;
+}
+
 }  // namespace
 
 void ResetAwaitForFork();  // defined below, near the await-thread state
@@ -1829,15 +2238,27 @@ void RecordStepRing(int slot, uint64_t start_ns, uint64_t end_ns,
                     bool compiled) {
   pthread_once(&g_step_ring_once, InitStepRingOnce);
   if (!g_step_ring) return;
+  ShimState& s = State();
   uint64_t wait_total = g_throttle_wait_ns.load(std::memory_order_relaxed);
-  int64_t peak = State().hot[slot].peak_bytes.load(std::memory_order_relaxed);
+  int64_t peak = s.hot[slot].peak_bytes.load(std::memory_order_relaxed);
+  // vtovc v2 spill block: live host-pool footprint across this
+  // tenant's slots (a gauge) + the tier transitions since the previous
+  // record (the window counters the collector/policy read as deltas)
+  int64_t spilled_total = 0;
+  for (int i = 0; i < s.device_count && i < kMaxDeviceCount; i++)
+    spilled_total += s.hot[i].spilled_bytes.load(std::memory_order_relaxed);
   std::lock_guard<std::mutex> g(g_step_ring_mu);
   uint64_t wait_delta = wait_total >= g_step_ring_last_wait_ns
                             ? wait_total - g_step_ring_last_wait_ns
                             : 0;
   g_step_ring_last_wait_ns = wait_total;
   g_step_ring->Record(end_ns - start_ns, wait_delta,
-                      peak > 0 ? (uint64_t)peak : 0, compiled, start_ns);
+                      peak > 0 ? (uint64_t)peak : 0, compiled, start_ns,
+                      spilled_total > 0 ? (uint64_t)spilled_total : 0,
+                      g_spill_events_window.exchange(
+                          0, std::memory_order_relaxed),
+                      g_fill_events_window.exchange(
+                          0, std::memory_order_relaxed));
 }
 
 void RateLimit(int slot, int64_t cost_us) {
@@ -2263,6 +2684,18 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   } else if (s.device_count > 0) {
     first_slot = 0;
   }
+  // vtovc: refresh the LRU clock on every tracked input and promote
+  // any demoted argument back to HBM, rewriting the forwarded lists
+  // this call passes down (the vectors own the substituted lists for
+  // the duration of the real call). A refill that cannot make HBM
+  // fails the Execute with the reserve path's honest error.
+  std::vector<std::vector<PJRT_Buffer*>> spill_rewritten;
+  std::vector<PJRT_Buffer* const*> spill_rewritten_ptrs;
+  if (first_slot >= 0 && SpillTierArmed()) {
+    if (PJRT_Error* err = TouchAndFillArguments(args, &spill_rewritten,
+                                                &spill_rewritten_ptrs))
+      return err;
+  }
   ExecFacts facts{};
   std::vector<int> reserved_slots;
   if (first_slot >= 0) {
@@ -2366,7 +2799,7 @@ int SlotOfBuffer(PJRT_Buffer* buf) {
   {
     std::lock_guard<std::mutex> g(s.buffers_mu);
     auto it = s.buffers.find(buf);
-    if (it != s.buffers.end()) return it->second.first;
+    if (it != s.buffers.end()) return it->second.slot;
   }
   if (!s.real_api->PJRT_Buffer_Device) return s.device_count == 1 ? 0 : -1;
   PJRT_Buffer_Device_Args dargs;
@@ -2398,6 +2831,15 @@ void TransferDoneCallback(PJRT_Error* error, void* user_arg) {
 }
 
 PJRT_Error* WrappedToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  // vtovc: a D2H readback of a demoted-or-forwarded buffer reads the
+  // live replacement (filling it first when still in the host pool) —
+  // the tenant's pointer keeps working across tier moves
+  if (SpillTierArmed()) {
+    PJRT_Buffer* cur = ResolveSpillFwd(args->src);
+    PJRT_Buffer* filled = nullptr;
+    if (PJRT_Error* err = FillSpilled(cur, &filled)) return err;
+    args->src = filled ? filled : cur;
+  }
   int slot = SlotOfBuffer(args->src);
   uint64_t start = NowNs();
   PJRT_Error* err = g_real_tohost(args);

@@ -1,0 +1,419 @@
+#!/usr/bin/env python3
+"""vtovc bench: pod density under HBM oversubscription + host spill.
+
+Usage:
+    python scripts/bench_overcommit.py [--json]
+
+The headline scenario the overcommit plane exists for — many small
+tenants (the FlexNPU co-location shape) declaring far more HBM than
+they touch:
+
+- one node, 2 chips x 16 GiB; every tenant declares a 6 GiB HBM cap
+  but its measured working set (step-ring high-water) is 1.5 GiB;
+- **density**: pods admitted per chip with the gate off (physical
+  admission) vs on — the REAL pipeline end to end: tenant configs +
+  v2 step rings -> UtilizationLedger fold -> OvercommitPolicy ratios
+  -> the node-overcommit annotation -> the REAL FilterPredicate
+  admitting pods against physical × ratio, in BOTH scheduler data
+  paths (TTL and snapshot must agree on every admission);
+- **step-time regression**: a virtual-clock step loop over the packed
+  tenants where one tenant's working set periodically spikes past
+  physical; overflow demotes LRU-cold bytes through the REAL SpillPool
+  (vmem-ledger accounted, budget-bounded — payloads scaled 1 MiB -> 1
+  byte so the mechanics are real and the bench stays instant) and a
+  tenant touching demoted bytes pays the host-bandwidth fill before
+  its step. p99 step time on the oversubscribed node must stay inside
+  the asserted bound of the physical-admission baseline;
+- **thrash backoff**: a second node publishing a high spill-rate; the
+  scheduler (gate on) must measurably steer placement away from it;
+- the per-node invariants (Σ resident <= physical per chip, Σ spilled
+  <= node budget) are asserted at EVERY simulated step.
+
+Writes BENCH_VTOVC_r11.json at the repo root. Fully deterministic:
+seeded jitter, virtual clock, no sleeps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from vtpu_manager.client.fake import FakeKubeClient          # noqa: E402
+from vtpu_manager.config import vmem, vtpu_config as vc      # noqa: E402
+from vtpu_manager.config.node_config import NodeConfig       # noqa: E402
+from vtpu_manager.device.types import fake_chip              # noqa: E402
+from vtpu_manager.manager.device_manager import DeviceManager  # noqa: E402
+from vtpu_manager.overcommit import (NodeOvercommit,         # noqa: E402
+                                     OvercommitPolicy, SpillPool,
+                                     assert_node_invariants)
+from vtpu_manager.scheduler.filter import FilterPredicate    # noqa: E402
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot  # noqa: E402
+from vtpu_manager.telemetry import stepring                  # noqa: E402
+from vtpu_manager.tpu.discovery import FakeBackend           # noqa: E402
+from vtpu_manager.util import consts                         # noqa: E402
+from vtpu_manager.utilization import UtilizationLedger       # noqa: E402
+
+GIB = 2**30
+MIB = 2**20
+CHIP_GIB = 16                  # fake v5e HBM
+CHIPS = 2
+DECLARED_MIB = 6 * 1024        # every tenant's declared cap
+WORKING_SET_MIB = 1536         # what it actually touches (1.5 GiB)
+SPIKE_MIB = 6 * 1024           # periodic working-set spike
+BASE_STEP_MS = 20.0
+HBM_BW_GBPS = 819.0            # v5e HBM
+HOST_BW_GBPS = 64.0            # PCIe gen5 x16 host path (the spill cost)
+SPILL_BUDGET_MIB = 8 * 1024
+STEPS = 240
+SEED = 11
+
+P99_REGRESSION_BOUND = 1.35    # p99_on <= bound * p99_off
+DENSITY_MIN = 1.5              # pods-per-chip uplift floor
+
+
+def _pct(vals, q):
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * (len(s) - 1)))]
+
+
+def _cluster(node_names):
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in node_names:
+        client.add_node({"metadata": {"name": name, "annotations": {}}})
+        mgr = DeviceManager(name, client,
+                            node_config=NodeConfig(device_split_count=16),
+                            backends=[FakeBackend(n_chips=CHIPS)])
+        mgr.init_devices()
+        mgr.register_node()
+    return client
+
+
+def _pod(i, mib=DECLARED_MIB):
+    return {
+        "metadata": {"name": f"tenant-{i}", "namespace": "bench",
+                     "uid": f"uid-{i}",
+                     "annotations": {consts.workload_class_annotation():
+                                     consts.WORKLOAD_CLASS_THROUGHPUT}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): 10,
+                consts.vtpu_memory_resource(): mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def measured_ratio(base_dir):
+    """The REAL policy chain: tenant configs + v2 rings whose
+    high-water says 1.5 of 6 GiB -> ledger fold -> per-class ratio."""
+    writers = []
+    for i in range(4):       # the already-resident evidence tenants
+        path = os.path.join(base_dir, f"ev-{i}_main", "config",
+                            "vtpu.config")
+        vc.write_config(path, vc.VtpuConfig(
+            pod_uid=f"ev-{i}", container_name="main",
+            workload_class=vc.WORKLOAD_CLASS_THROUGHPUT,
+            devices=[vc.DeviceConfig(
+                uuid=f"fake-{i % CHIPS}", total_memory=DECLARED_MIB * MIB,
+                real_memory=CHIP_GIB * GIB, hard_core=10,
+                host_index=i % CHIPS)]))
+        ring_dir = os.path.join(base_dir, f"ev-{i}_main",
+                                consts.TELEMETRY_SUBDIR)
+        os.makedirs(ring_dir, exist_ok=True)
+        writers.append(stepring.StepRingWriter(
+            os.path.join(ring_dir, consts.STEP_RING_NAME)))
+    chips = [fake_chip(i) for i in range(CHIPS)]
+    ledger = UtilizationLedger("bench-node", chips, base_dir=base_dir)
+    ledger.fold(now_mono=0.0)            # prime the ring cursors
+    for w in writers:
+        for _ in range(8):
+            w.record(duration_ns=20_000_000,
+                     hbm_highwater_bytes=WORKING_SET_MIB * MIB)
+        w.close()
+    ledger.fold(now_mono=10.0)           # the measured window
+    policy = OvercommitPolicy(ledger)
+    oc = policy.compute()
+    return oc, ledger
+
+
+def admit_density(oc):
+    """Admit identical pods until the node rejects — gate off vs on,
+    both scheduler data paths (which must agree pod for pod)."""
+    out = {}
+    for gate in (False, True):
+        per_mode = []
+        for mode in ("ttl", "snapshot"):
+            client = _cluster(("bench-node",))
+            if oc is not None:
+                client.patch_node_annotations(
+                    "bench-node",
+                    {consts.node_overcommit_annotation(): oc.encode()})
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap,
+                                   hbm_overcommit=gate)
+            placed = 0
+            for i in range(64):
+                pod = _pod(i)
+                r = pred.filter({"Pod": pod})
+                if r.error:
+                    break
+                client.add_pod(pod)
+                placed += 1
+            per_mode.append(placed)
+        assert per_mode[0] == per_mode[1], \
+            f"TTL and snapshot admission disagree: {per_mode}"
+        out[gate] = per_mode[0]
+    return out[False], out[True]
+
+
+class Tenant:
+    """One packed tenant's buffers: four base working-set quarters plus
+    an optional spike buffer; ``touch`` is the LRU clock (the shim's
+    last-Execute-touch analogue)."""
+
+    def __init__(self, idx, chip, pool):
+        self.idx = idx
+        self.chip = chip
+        self.pool = pool
+        # buf_id -> [mib, last_touch_step]; eighth-of-working-set
+        # granularity so LRU eviction robs close to the exact overflow
+        self.bufs: dict[str, list[int]] = {
+            f"b{j}": [WORKING_SET_MIB // 8, 0] for j in range(8)}
+        self.spilled: set[str] = set()
+
+    def resident_mib(self):
+        return sum(m for b, (m, _) in self.bufs.items()
+                   if b not in self.spilled)
+
+
+def simulate_steps(n_tenants_per_chip, tag, results):
+    """Virtual-clock step loop with the REAL SpillPool mechanics (1 MiB
+    -> 1 byte payload scale so the bench stays instant) and the
+    acceptance invariant asserted every round."""
+    rng = random.Random(SEED)
+    tmp = tempfile.mkdtemp(prefix=f"vtovc-{tag}-")
+    ledger = vmem.VmemLedger(os.path.join(tmp, "vmem.config"),
+                             create=True)
+    me = os.getpid()
+    tenants = []
+    for chip in range(CHIPS):
+        for t in range(n_tenants_per_chip):
+            idx = chip * 100 + t
+            pool = SpillPool(os.path.join(tmp, "spill"),
+                             budget_bytes=SPILL_BUDGET_MIB,  # scaled
+                             ledger=ledger, owner_token=1000 + idx,
+                             pid=me)
+            tenants.append(Tenant(idx, chip, pool))
+
+    def publish(t):
+        # scaled ledger rows: 1 unit == 1 MiB — the invariant guard
+        # runs the same arithmetic the full-scale node would
+        ledger.record(me + t.idx + 1, t.chip, t.resident_mib(),
+                      owner_token=1000 + t.idx)
+
+    for t in tenants:
+        publish(t)
+
+    cap_mib = CHIP_GIB * 1024
+    step_ms = []
+    spills = fills = 0
+    spike_owner = tenants[0]
+    by_chip = {c: [t for t in tenants if t.chip == c]
+               for c in range(CHIPS)}
+
+    def evict_to_fit(chip, protect):
+        """The shim's TrySpillCold shape, node-wide: demote LRU-cold
+        bytes (never the tenant mid-step) until residency fits."""
+        nonlocal spills
+        total = sum(o.resident_mib() for o in by_chip[chip])
+        need = total - cap_mib
+        if need <= 0:
+            return
+        cands = []
+        for o in by_chip[chip]:
+            if o is protect:
+                continue
+            for buf, (mib, touch) in o.bufs.items():
+                if buf not in o.spilled:
+                    cands.append((f"{o.idx}:{buf}", mib, touch))
+        for vid in SpillPool.choose_victims(cands, need):
+            oidx, _, buf = vid.partition(":")
+            owner = next(o for o in by_chip[chip]
+                         if o.idx == int(oidx))
+            owner.pool.spill(owner.chip, buf,
+                             b"\0" * owner.bufs[buf][0])
+            owner.spilled.add(buf)
+            spills += 1
+            publish(owner)
+
+    for step in range(STEPS):
+        for t in tenants:
+            # working-set schedule: the spike owner balloons
+            # periodically (the overflow the spill tier absorbs)
+            spiking = t is spike_owner and (step % 60) >= 40
+            if spiking and "spike" not in t.bufs:
+                t.bufs["spike"] = [SPIKE_MIB - WORKING_SET_MIB, step]
+            elif not spiking and "spike" in t.bufs:
+                t.bufs.pop("spike")
+                if "spike" in t.spilled:
+                    # freed while demoted: the budget releases with it
+                    t.spilled.discard("spike")
+                    t.pool.fill(t.chip, "spike")
+            # this step touches the whole working set: demoted bytes
+            # pay the host-bandwidth fill first (and the refill may
+            # need room — evict cold co-tenant bytes to make it)
+            fill_mib = 0
+            for buf in sorted(t.spilled):
+                mib = t.bufs[buf][0]
+                t.pool.fill(t.chip, buf)
+                t.spilled.discard(buf)
+                fills += 1
+                fill_mib += mib
+            for buf in t.bufs:
+                t.bufs[buf][1] = step
+            publish(t)
+            evict_to_fit(t.chip, protect=t)
+            fill_ms = (fill_mib / 1024.0) / HOST_BW_GBPS * 1000.0
+            hbm_ms = (t.resident_mib() / 1024.0) / HBM_BW_GBPS * 1000.0
+            step_ms.append(BASE_STEP_MS + hbm_ms + fill_ms
+                           + rng.uniform(0.0, 1.0))
+            # the acceptance invariant, EVERY round: Σ resident <=
+            # physical per chip and Σ spilled <= the node budget
+            # (scaled units throughout)
+            assert_node_invariants(
+                ledger, {c: cap_mib for c in range(CHIPS)},
+                SPILL_BUDGET_MIB)
+    ledger.close()
+    results[tag] = {
+        "tenants": len(tenants),
+        "steps": len(step_ms),
+        "p50_ms": round(_pct(step_ms, 0.50), 3),
+        "p90_ms": round(_pct(step_ms, 0.90), 3),
+        "p99_ms": round(_pct(step_ms, 0.99), 3),
+        "spill_events": spills,
+        "fill_events": fills,
+    }
+    return results[tag]
+
+
+def thrash_backoff():
+    """Gate on, node-a publishing a live spill-rate: placements must
+    steer to the quiet node."""
+    client = _cluster(("node-thrash", "node-quiet"))
+    now = time.time()
+    client.patch_node_annotations(
+        "node-thrash",
+        {consts.node_overcommit_annotation(): NodeOvercommit(
+            ratios={"def": 1.5}, spill_frac=0.7,
+            spilled_bytes=4 * GIB, ts=now).encode()})
+    client.patch_node_annotations(
+        "node-quiet",
+        {consts.node_overcommit_annotation(): NodeOvercommit(
+            ratios={"def": 1.5}, spill_frac=0.0, ts=now).encode()})
+    placements = {"node-thrash": 0, "node-quiet": 0}
+    pred = FilterPredicate(client, hbm_overcommit=True)
+    for i in range(8):
+        pod = _pod(500 + i, mib=2048)
+        r = pred.filter({"Pod": pod})
+        assert not r.error, r.error
+        client.add_pod(pod)
+        placements[r.node_names[0]] += 1
+    return placements
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    t0 = time.perf_counter()
+
+    base_dir = tempfile.mkdtemp(prefix="vtovc-policy-")
+    oc, _ledger = measured_ratio(base_dir)
+    ratio = oc.ratios["thr"]
+
+    off_total, on_total = admit_density(oc)
+    density_off = off_total / CHIPS
+    density_on = on_total / CHIPS
+    density_x = density_on / max(density_off, 1e-9)
+
+    results: dict = {}
+    simulate_steps(int(density_off), "steps_off", results)
+    simulate_steps(int(density_on), "steps_on", results)
+    p99_off = results["steps_off"]["p99_ms"]
+    p99_on = results["steps_on"]["p99_ms"]
+
+    placements = thrash_backoff()
+
+    doc = {
+        "bench": "overcommit",
+        "revision": 11,
+        "scenario": {
+            "node": f"{CHIPS} chips x {CHIP_GIB} GiB",
+            "tenant": f"declares {DECLARED_MIB} MiB, touches "
+                      f"{WORKING_SET_MIB} MiB (spikes to {SPIKE_MIB})",
+            "spill_budget_mib": SPILL_BUDGET_MIB,
+            "steps": STEPS, "seed": SEED,
+        },
+        "policy": {
+            "measured_ratio_thr": ratio,
+            "ratios": oc.ratios,
+        },
+        "density": {
+            "pods_per_chip_off": density_off,
+            "pods_per_chip_on": density_on,
+            "uplift_x": round(density_x, 2),
+        },
+        "step_time": {
+            "off": results["steps_off"],
+            "on": results["steps_on"],
+            "p99_regression_x": round(p99_on / p99_off, 3),
+        },
+        "thrash_backoff": placements,
+        "asserts": {
+            "density_uplift_x": round(density_x, 2),
+            "density_uplift_min": DENSITY_MIN,
+            "p99_regression_x": round(p99_on / p99_off, 3),
+            "p99_regression_bound": P99_REGRESSION_BOUND,
+            "thrash_quiet_share": placements["node-quiet"] / 8.0,
+            "thrash_quiet_share_min": 0.75,
+        },
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+    assert ratio > 1.5, f"policy ratio {ratio} too small for the bench"
+    assert density_x >= DENSITY_MIN, \
+        f"density uplift {density_x:.2f}x < {DENSITY_MIN}x"
+    assert p99_on <= p99_off * P99_REGRESSION_BOUND, \
+        f"p99 {p99_on}ms > {P99_REGRESSION_BOUND}x baseline {p99_off}ms"
+    assert placements["node-quiet"] >= 6, \
+        f"thrash backoff did not steer placement: {placements}"
+
+    out_path = os.path.join(REPO, "BENCH_VTOVC_r11.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(f"density {density_off:.0f} -> {density_on:.0f} pods/chip "
+              f"({density_x:.2f}x) at p99 {p99_off:.1f} -> "
+              f"{p99_on:.1f} ms ({p99_on / p99_off:.2f}x); "
+              f"thrash backoff {placements}")
+        print(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,19 @@ def render(doc: dict, out=None) -> None:
         bits = []
         if nrow.get("pressure_frac") is not None:
             bits.append(f"pressure {nrow['pressure_frac']:.2f}")
+        # vtovc: oversubscription-ratio line (overcommit documents
+        # only — a gate-off document renders exactly the prior table)
+        if nrow.get("overcommit_ratio") is not None:
+            ratios = nrow.get("overcommit_ratios") or {}
+            per_class = ",".join(f"{k}:{r:.2f}x"
+                                 for k, r in sorted(ratios.items()))
+            bits.append(f"oversub {nrow['overcommit_ratio']:.2f}x"
+                        + (f" ({per_class})" if per_class else ""))
+        if nrow.get("spill_frac") is not None and (
+                nrow["spill_frac"] > 0 or nrow.get("spilled_bytes")):
+            bits.append(f"spilling {nrow['spill_frac'] * 100:.0f}% "
+                        f"of steps/{_gib(nrow.get('spilled_bytes', 0))}"
+                        .strip())
         if nrow.get("reclaim_core_pct") is not None:
             bits.append(f"reclaimable {nrow['reclaim_core_pct']}%")
         elif nrow.get("headroom_stale"):
@@ -109,16 +122,31 @@ def render(doc: dict, out=None) -> None:
                             f"({cache['hits']}h/{cache['misses']}m)")
         print(f"NODE {name}  " + "  ".join(bits), file=out)
         if nrow.get("chips"):
+            # VIRT/SPILL columns appear only when the document carries
+            # overcommit state (HBMOvercommit on at the monitor) — a
+            # gate-off document renders exactly the pre-vtovc table
+            show_virt = any(ch.get("virt_hbm_bytes") is not None
+                            or ch.get("spilled_bytes") is not None
+                            for ch in nrow["chips"])
+            oc_hdr = f" {'virt':>8} {'spill':>8}" if show_virt else ""
             print(f"  {'chip':>4} {'uuid':<20} {'quota':>7} {'used':>7} "
-                  f"{'reclaim':>8} {'hbm-reclaim':>11}", file=out)
+                  f"{'reclaim':>8} {'hbm-reclaim':>11}{oc_hdr}",
+                  file=out)
             for ch in nrow["chips"]:
+                extra = ""
+                if show_virt:
+                    # per-chip spilled bytes are node-local truth (the
+                    # vmem ledger); remote chips render "-" like the
+                    # other live columns
+                    extra = (f" {_gib(ch.get('virt_hbm_bytes')):>8}"
+                             f" {_gib(ch.get('spilled_bytes')):>8}")
                 print(f"  {ch.get('index', '?'):>4} "
                       f"{str(ch.get('uuid', ''))[:20]:<20} "
                       f"{_pct(ch.get('alloc_core_pct')):>7} "
                       f"{_pct(ch.get('used_core_pct')):>7} "
                       f"{_pct(ch.get('reclaim_core_pct')):>8} "
-                      f"{_gib(ch.get('reclaim_hbm_bytes')):>11}",
-                      file=out)
+                      f"{_gib(ch.get('reclaim_hbm_bytes')):>11}"
+                      f"{extra}", file=out)
 
     # the document's tenant cut already merges cluster quota rows with
     # the node-local ledger rows (rollup.collect), so the ?pod=/?node=

@@ -529,6 +529,13 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     failpoints.arm("quota.lease", "crash", p=0.2, count=1)
     failpoints.arm("quota.revoke", rng.choice(["crash", "partial-write"]),
                    p=0.2, count=1)
+    # vtovc sites: driven by the dedicated spill chaos tests
+    # (test_overcommit.py — the e2e loop here never spills), armed so
+    # the full-coverage assertion stays the honest catalog check
+    failpoints.arm("spill.copy", "partial-write", p=0.3,
+                   count=rng.randint(1, 2))
+    failpoints.arm("spill.budget", "error", p=0.2,
+                   count=rng.randint(1, 2))
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 

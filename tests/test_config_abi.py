@@ -39,6 +39,10 @@ int main() {
   printf("dev.mesh_y %zu\n", offsetof(VtpuDevice, mesh_y));
   printf("dev.mesh_z %zu\n", offsetof(VtpuDevice, mesh_z));
   printf("dev.lease_core %zu\n", offsetof(VtpuDevice, lease_core));
+  printf("dev.virtual_hbm_bytes %zu\n",
+         offsetof(VtpuDevice, virtual_hbm_bytes));
+  printf("dev.spill_budget_bytes %zu\n",
+         offsetof(VtpuDevice, spill_budget_bytes));
   printf("cfg.magic %zu\n", offsetof(VtpuConfig, magic));
   printf("cfg.version %zu\n", offsetof(VtpuConfig, version));
   printf("cfg.pod_uid %zu\n", offsetof(VtpuConfig, pod_uid));
@@ -61,6 +65,7 @@ int main() {
   printf("tc_cal.excess_us %zu\n", offsetof(TcCalibration, excess_us));
   printf("vmem_file_size %zu\n", sizeof(VmemFile));
   printf("vmem_entry_size %zu\n", sizeof(VmemEntry));
+  printf("vmem.spilled %zu\n", offsetof(VmemEntry, spilled));
   printf("step_header_size %zu\n", sizeof(StepRingHeader));
   printf("step_record_size %zu\n", sizeof(StepRecord));
   printf("step_file_size %zu\n", kStepRingFileSize);
@@ -76,6 +81,9 @@ int main() {
   printf("sr.hbm_highwater_bytes %zu\n",
          offsetof(StepRecord, hbm_highwater_bytes));
   printf("sr.flags %zu\n", offsetof(StepRecord, flags));
+  printf("sr.spilled_bytes %zu\n", offsetof(StepRecord, spilled_bytes));
+  printf("sr.spill_events %zu\n", offsetof(StepRecord, spill_events));
+  printf("sr.fill_events %zu\n", offsetof(StepRecord, fill_events));
   return 0;
 }
 """
@@ -112,6 +120,7 @@ class TestCrossLanguageLayout:
         assert int(cxx_layout["tc_proc_size"]) == tc_watcher.PROC_SIZE
         assert int(cxx_layout["vmem_file_size"]) == vmem.FILE_SIZE
         assert int(cxx_layout["vmem_entry_size"]) == vmem.ENTRY_SIZE
+        assert int(cxx_layout["vmem.spilled"]) == 40   # v3 spill field
 
     def test_device_offsets(self, cxx_layout):
         for name, off in vc.DEVICE_OFFSETS.items():
@@ -147,7 +156,8 @@ class TestVtpuConfigRoundtrip:
                 real_memory=16 * 2**30, hard_core=50, soft_core=80,
                 core_limit=vc.CORE_LIMIT_SOFT, memory_limit=True,
                 memory_oversold=False, host_index=3, mesh=(1, 2, 0),
-                lease_core=25)])
+                lease_core=25, virtual_hbm_bytes=24 * 2**30,
+                spill_budget_bytes=32 * 2**30)])
 
     def test_pack_unpack(self):
         cfg = self._sample()
@@ -162,16 +172,22 @@ class TestVtpuConfigRoundtrip:
         assert dev.core_limit == vc.CORE_LIMIT_SOFT
         assert dev.mesh == (1, 2, 0)
         assert dev.lease_core == 25
+        assert dev.virtual_hbm_bytes == 24 * 2**30
+        assert dev.spill_budget_bytes == 32 * 2**30
 
     def test_v3_defaults_zero(self):
-        """A gate-off config (no class, no leases) carries zeros in every
-        v3 field — the lease delta is byte-identical to the old pad."""
+        """A gate-off config (no class, no leases, no overcommit)
+        carries zeros in every v3/v4 field — the lease delta is
+        byte-identical to the old pad, and the v4 spill pair writes
+        only zeros beyond the v3 layout."""
         back = vc.VtpuConfig.unpack(vc.VtpuConfig(
             pod_uid="u", devices=[vc.DeviceConfig(
                 uuid="X", total_memory=1, real_memory=1)]).pack())
         assert back.workload_class == vc.WORKLOAD_CLASS_NONE
         assert back.quota_epoch == 0
         assert back.devices[0].lease_core == 0
+        assert back.devices[0].virtual_hbm_bytes == 0
+        assert back.devices[0].spill_budget_bytes == 0
 
     def test_file_roundtrip_atomic(self, tmp_path):
         path = str(tmp_path / "cfg" / "vtpu.config")
@@ -333,6 +349,65 @@ class TestTcUtilFile:
         f2.close()
 
 
+# ---------------------------------------------------------------------------
+# vtovc satellite: vmem.py <-> enforce.cc stale-reap parity. Both sides
+# clamp VTPU_VMEM_STALE_S through ONE function each (_stale_reap_ns /
+# VmemStaleReapNsFromEnv, header-inline so this probe compiles the exact
+# production code). The v3 spilled field makes divergence load-bearing:
+# a side that reaps a dead spiller earlier frees spill budget the other
+# side still charges, and the node invariant Σspilled <= budget splits.
+# ---------------------------------------------------------------------------
+
+STALE_PROBE_SRC = r"""
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include "vtpu_config.h"
+int main(int argc, char** argv) {
+  // argv[1]: the raw VTPU_VMEM_STALE_S value ("UNSET" = no env var)
+  const char* v = (argc > 1 && strcmp(argv[1], "UNSET") != 0)
+                      ? argv[1] : nullptr;
+  printf("%llu\n",
+         (unsigned long long)vtpu::VmemStaleReapNsFromEnv(v));
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cxx_stale_probe(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("staleprobe")
+    src = tmp / "stale_probe.cc"
+    src.write_text(STALE_PROBE_SRC)
+    exe = tmp / "stale_probe"
+    subprocess.run(
+        ["g++", "-std=c++17", f"-I{REPO}/library/include", str(src),
+         "-o", str(exe)], check=True, capture_output=True)
+    return str(exe)
+
+
+class TestStaleReapParity:
+    # the clamp matrix: default, plain values, <=0, NaN/garbage (atof
+    # -> 0.0 / float() -> ValueError, both land on the 120 s default),
+    # scientific notation, and the huge-value cap applied BEFORE the
+    # fp->int conversion
+    CASES = ["UNSET", "120", "0.5", "3", "0", "-5", "nan", "abc", "",
+             "1e9", "1e12", "inf"]
+
+    def test_both_sides_clamp_identically(self, cxx_stale_probe,
+                                          monkeypatch):
+        for raw in self.CASES:
+            if raw == "UNSET":
+                monkeypatch.delenv("VTPU_VMEM_STALE_S", raising=False)
+            else:
+                monkeypatch.setenv("VTPU_VMEM_STALE_S", raw)
+            py_ns = vmem._stale_reap_ns()
+            out = subprocess.run([cxx_stale_probe, raw],
+                                 check=True, capture_output=True,
+                                 text=True).stdout.strip()
+            assert int(out) == py_ns, f"VTPU_VMEM_STALE_S={raw!r}"
+
+
 class TestVmemLedger:
     def test_record_and_total(self, tmp_path):
         led = vmem.VmemLedger(str(tmp_path / "vmem.config"), create=True)
@@ -363,6 +438,31 @@ class TestVmemLedger:
         led.record(me, 0, 100)
         led.record(me, 3, 200)
         led.clear_pid(me)
+        assert led.entries() == []
+        led.close()
+
+    def test_spilled_accounting(self, tmp_path):
+        """v3: spilled bytes ride the resident entry, never count
+        against the device's resident total, survive a resident-zero
+        dip, and are reaped with a dead owner."""
+        led = vmem.VmemLedger(str(tmp_path / "vmem.config"), create=True)
+        me = os.getpid()
+        led.record(me, 0, 2**30)
+        led.record_spilled(me, 0, 2**20)
+        assert led.device_total(0) == 2**30        # resident only
+        assert led.device_spilled_total(0) == 2**20
+        assert led.node_spilled_total() == 2**20
+        # resident drops to zero but the host-pool claim survives
+        led.record(me, 0, 0)
+        assert led.device_total(0) == 0
+        assert led.node_spilled_total() == 2**20
+        # pool drained: the slot frees entirely
+        led.record_spilled(me, 0, 0)
+        assert led.entries() == []
+        # a dead spiller's budget claim is reaped like resident bytes
+        led._write_entry(0, vmem.VmemEntry(4_000_000, 0, 0, 1,
+                                           spilled=2**25))
+        assert led.node_spilled_total() == 0
         assert led.entries() == []
         led.close()
 

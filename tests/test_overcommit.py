@@ -1,0 +1,893 @@
+"""vtovc suite: HBM oversubscription with the host-spill tier.
+
+Covers the tentpole contracts:
+- the node-overcommit codec: roundtrip, stale/garbage/NaN decay to
+  no-signal (ratio 1.0), use-time staleness re-judgement, the spill
+  penalty's soft-hint currency, and the memoized virtual-registry
+  scaling (ratio 1.0 = the identical physical object);
+- the policy engine: no signal / too few tenants means ratio 1.0,
+  confidence decays the lift linearly, classes are independent, and
+  the whole chain runs off REAL configs + step rings;
+- virtual admission in BOTH scheduler paths: a pod that cannot fit
+  physically places against physical × ratio, the spill-rate penalty
+  steers placement away from a thrashing node, and the vtexplain
+  record carries the exact spill term + virtual/physical split;
+- gate-off byte-contract: placement parity gate-on-vs-off in BOTH
+  modes for pods on non-overcommitted nodes, no vtpu_node_spill_*
+  series, /utilization byte-identical;
+- the spill pool: LRU victim choice, budget guard pre-write, torn
+  spill (spill.copy partial-write) never corrupts the vmem ledger, a
+  crashed spiller's host-pool bytes are reaped, and the per-node
+  invariants hold at every chaos round and converge after crashes;
+- satellite: the headroom annotation's workload-class mix rides the
+  codec + snapshot observe-only with no score change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from vtpu_manager import explain
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vmem, vtpu_config as vc
+from vtpu_manager.config.node_config import NodeConfig
+from vtpu_manager.device.types import fake_chip
+from vtpu_manager.explain import doctor
+from vtpu_manager.manager.device_manager import DeviceManager
+from vtpu_manager.overcommit import (NodeOvercommit, OvercommitPolicy,
+                                     OvercommitPublisher, SpillBudgetError,
+                                     SpillPool, assert_node_invariants,
+                                     parse_overcommit, ratio_for_class,
+                                     spill_penalty, virtual_registry)
+from vtpu_manager.overcommit import ratio as oc_mod
+from vtpu_manager.overcommit import spill as spill_mod
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.failpoints import CrashFailpoint
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.telemetry import stepring
+from vtpu_manager.tpu.discovery import FakeBackend
+from vtpu_manager.util import consts
+from vtpu_manager.utilization import UtilizationLedger
+from vtpu_manager.utilization import headroom as hr_mod
+from vtpu_manager.utilization.ledger import STALENESS_S
+
+GIB = 2**30
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    failpoints.disable()
+    yield
+    failpoints.disable()
+    explain.reset()
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+class TestOvercommitCodec:
+    def _rollup(self, ts=None, **kw):
+        defaults = dict(ratios={"lat": 1.2, "thr": 1.8, "def": 1.4},
+                        spill_frac=0.25, spilled_bytes=3 * GIB,
+                        ts=time.time() if ts is None else ts)
+        defaults.update(kw)
+        return NodeOvercommit(**defaults)
+
+    def test_roundtrip(self):
+        oc = self._rollup()
+        back = parse_overcommit(oc.encode())
+        assert back.ratios == {"lat": 1.2, "thr": 1.8, "def": 1.4}
+        assert back.spill_frac == 0.25
+        assert back.spilled_bytes == 3 * GIB
+        assert back.max_ratio() == 1.8
+
+    def test_stale_and_garbage_decay_to_none(self):
+        oc = self._rollup()
+        assert parse_overcommit(None) is None
+        assert parse_overcommit("") is None
+        assert parse_overcommit("garbage") is None
+        assert parse_overcommit("lat:1.2|0.1:5") is None    # no stamp
+        assert parse_overcommit("lat:nan|0.1:5@" +
+                                f"{time.time():.3f}") is None
+        assert parse_overcommit("lat:1.2|nan:5@" +
+                                f"{time.time():.3f}") is None
+        stale = self._rollup(ts=time.time()
+                             - oc_mod.MAX_OVERCOMMIT_AGE_S - 5)
+        assert parse_overcommit(stale.encode()) is None
+
+    def test_ratio_for_class_rejudges_staleness_at_use_time(self):
+        """The snapshot caches the parsed object; a dead publisher
+        emits no more events, so the ADMISSION ratio must decay to 1.0
+        at use time — admitting against phantom capacity is the one
+        failure mode this plane must never have."""
+        ts = time.time()
+        oc = parse_overcommit(self._rollup(ts=ts).encode(), now=ts + 1)
+        assert oc is not None
+        assert ratio_for_class(
+            oc, consts.WORKLOAD_CLASS_THROUGHPUT, now=ts + 2) == 1.8
+        late = ts + oc_mod.MAX_OVERCOMMIT_AGE_S + 10
+        assert ratio_for_class(
+            oc, consts.WORKLOAD_CLASS_THROUGHPUT, now=late) == 1.0
+        assert spill_penalty(oc, now=late) == 0.0
+
+    def test_class_selection_and_default(self):
+        oc = self._rollup()
+        assert ratio_for_class(
+            oc, consts.WORKLOAD_CLASS_LATENCY_CRITICAL) == 1.2
+        assert ratio_for_class(oc, "") == 1.4           # unclassified
+        no_def = self._rollup(ratios={"lat": 1.5})
+        assert ratio_for_class(no_def, "") == 1.0       # no def key
+        assert ratio_for_class(None, "") == 1.0
+
+    def test_spill_penalty_currency(self):
+        """Same soft-hint currency as the pressure penalty: a fully-
+        thrashing node loses SPILL_SCORE_WEIGHT, never more — it can
+        reorder fits, never outweigh the +100 gang bonus."""
+        oc = self._rollup(spill_frac=1.0)
+        assert spill_penalty(oc) == oc_mod.SPILL_SCORE_WEIGHT
+        assert spill_penalty(self._rollup(spill_frac=0.0)) == 0.0
+        assert spill_penalty(None) == 0.0
+
+    def test_ratio_clamps(self):
+        wild = parse_overcommit(
+            f"def:99.0|0.0:0@{time.time():.3f}")
+        assert wild.ratios["def"] == oc_mod.MAX_RATIO
+        negative = parse_overcommit(
+            f"def:0.2|0.0:0@{time.time():.3f}")
+        assert negative.ratios["def"] == 1.0
+
+
+class TestVirtualRegistry:
+    def test_identity_at_ratio_one(self):
+        from vtpu_manager.device.types import fake_registry
+        reg = fake_registry(2)
+        assert virtual_registry(reg, 1.0) is reg
+        assert virtual_registry(None, 2.0) is None
+
+    def test_scaling_and_memoization(self):
+        from vtpu_manager.device.types import fake_registry
+        reg = fake_registry(2)
+        scaled = virtual_registry(reg, 2.0)
+        assert scaled is not reg
+        for orig, virt in zip(reg.chips, scaled.chips):
+            assert virt.memory == orig.memory * 2
+            assert virt.uuid == orig.uuid
+            assert virt.coords == orig.coords
+        # memoized per (registry, quantized ratio): a steady ratio
+        # costs one copy, not one per pass
+        assert virtual_registry(reg, 2.0) is scaled
+        assert virtual_registry(reg, 2.004) is scaled  # quantized
+        assert virtual_registry(reg, 1.5) is not scaled
+        # the physical registry's own memo is untouched
+        assert reg.healthy_totals()[2] == sum(c.memory for c in reg.chips)
+
+
+# ---------------------------------------------------------------------------
+# policy engine
+# ---------------------------------------------------------------------------
+
+def _mk_config(base, pod_uid, container, hard_core=80,
+               total_memory=8 * GIB, host_index=0, uuid="TPU-FAKE-0000",
+               workload_class=vc.WORKLOAD_CLASS_NONE):
+    path = os.path.join(base, f"{pod_uid}_{container}", "config",
+                        "vtpu.config")
+    vc.write_config(path, vc.VtpuConfig(
+        pod_uid=pod_uid, pod_name=pod_uid, pod_namespace="ml",
+        container_name=container, workload_class=workload_class,
+        devices=[vc.DeviceConfig(uuid=uuid, total_memory=total_memory,
+                                 real_memory=total_memory,
+                                 hard_core=hard_core,
+                                 host_index=host_index)]))
+    return path
+
+
+def _mk_ring(base, pod_uid, container):
+    d = os.path.join(base, f"{pod_uid}_{container}",
+                     consts.TELEMETRY_SUBDIR)
+    os.makedirs(d, exist_ok=True)
+    return stepring.StepRingWriter(
+        os.path.join(d, consts.STEP_RING_NAME))
+
+
+class TestPolicyEngine:
+    def _ledger_with_class(self, tmp_path, n=3, hbm_frac=0.25,
+                           wl=vc.WORKLOAD_CLASS_THROUGHPUT):
+        """n tenants of one class whose rings report a high-water at
+        hbm_frac of their 8 GiB allocation."""
+        base = str(tmp_path / "mgr")
+        writers = []
+        for i in range(n):
+            _mk_config(base, f"uid-{i}", "main", workload_class=wl)
+            writers.append(_mk_ring(base, f"uid-{i}", "main"))
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        ledger.fold(now_mono=0.0)
+        for w in writers:
+            for _ in range(5):
+                w.record(duration_ns=10**8,
+                         hbm_highwater_bytes=int(8 * GIB * hbm_frac))
+        ledger.fold(now_mono=10.0)
+        for w in writers:
+            w.close()
+        return ledger
+
+    def test_ratio_from_measured_highwater(self, tmp_path):
+        """Three throughput tenants touching 25% of their declared HBM
+        -> the thr ratio approaches 1/(0.25*1.2) ≈ 3.3 (confidence 1),
+        while unsampled classes stay at exactly 1.0."""
+        ledger = self._ledger_with_class(tmp_path, hbm_frac=0.25)
+        oc = OvercommitPolicy(ledger).compute()
+        assert oc.ratios["thr"] > 2.5
+        assert oc.ratios["lat"] == 1.0
+        assert oc.ratios["def"] == 1.0
+
+    def test_no_signal_means_ratio_one(self, tmp_path):
+        """Configs with NO ring samples must never oversell: allocated
+        -but-never-observed working sets are unknown, not small."""
+        base = str(tmp_path / "mgr")
+        for i in range(3):
+            _mk_config(base, f"uid-{i}", "main",
+                       workload_class=vc.WORKLOAD_CLASS_THROUGHPUT)
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        ledger.fold(now_mono=0.0)
+        oc = OvercommitPolicy(ledger).compute()
+        assert oc.ratios == {"lat": 1.0, "thr": 1.0, "def": 1.0}
+
+    def test_single_tenant_is_not_evidence(self, tmp_path):
+        ledger = self._ledger_with_class(tmp_path, n=1, hbm_frac=0.1)
+        oc = OvercommitPolicy(ledger).compute()
+        assert oc.ratios["thr"] == 1.0      # MIN_CLASS_TENANTS gate
+
+    def test_staleness_decays_ratio_toward_one(self, tmp_path):
+        ledger = self._ledger_with_class(tmp_path, hbm_frac=0.25)
+        now = time.time()
+        fresh = OvercommitPolicy(ledger).compute(now_wall=now)
+        half = OvercommitPolicy(ledger).compute(
+            now_wall=now + STALENESS_S / 2)
+        dead = OvercommitPolicy(ledger).compute(
+            now_wall=now + STALENESS_S + 1)
+        assert fresh.ratios["thr"] > half.ratios["thr"] > 1.0
+        assert dead.ratios["thr"] == 1.0
+
+    def test_publisher_patches_annotation(self, tmp_path):
+        ledger = self._ledger_with_class(tmp_path, hbm_frac=0.25)
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-a",
+                                      "annotations": {}}})
+        pub = OvercommitPublisher(client, "node-a",
+                                  OvercommitPolicy(ledger), fold=False)
+        oc = pub.publish_once()
+        raw = client.get_node("node-a")["metadata"]["annotations"][
+            consts.node_overcommit_annotation()]
+        back = parse_overcommit(raw)
+        assert back is not None
+        assert back.ratios == oc.ratios
+
+
+# ---------------------------------------------------------------------------
+# satellite: workload-class mix on the headroom annotation
+# ---------------------------------------------------------------------------
+
+class TestClassMixSatellite:
+    def test_ledger_class_mix_and_codec(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-l", "main",
+                   workload_class=vc.WORKLOAD_CLASS_LATENCY)
+        _mk_config(base, "uid-t1", "main",
+                   workload_class=vc.WORKLOAD_CLASS_THROUGHPUT)
+        _mk_config(base, "uid-t2", "main",
+                   workload_class=vc.WORKLOAD_CLASS_THROUGHPUT)
+        _mk_config(base, "uid-u", "main")
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        ledger.fold(now_mono=0.0)
+        # unclassified tenants are never counterparties, so they are
+        # absent from the mix — which also keeps the wire bytes
+        # unchanged on class-less deployments (old-parser safety)
+        assert ledger.class_mix() == {"lat": 1, "thr": 2}
+        hr = ledger.headroom()
+        back = hr_mod.parse_headroom(hr.encode())
+        assert back.class_mix == {"lat": 1, "thr": 2}
+        # a mix-less publisher's wire bytes are unchanged (old shape)
+        old = hr_mod.NodeHeadroom(
+            chips={0: hr_mod.ChipHeadroom(80, 30, 40, GIB)},
+            ts=time.time())
+        assert "mix=" not in old.encode()
+        assert hr_mod.parse_headroom(old.encode()).class_mix == {}
+        # a class-LESS node (nothing stamps workload classes) publishes
+        # the exact pre-mix wire shape end to end
+        base2 = str(tmp_path / "mgr2")
+        _mk_config(base2, "uid-plain", "main")
+        plain = UtilizationLedger("node-b", [fake_chip(0)],
+                                  base_dir=base2)
+        plain.fold(now_mono=0.0)
+        assert plain.class_mix() == {}
+        assert "mix=" not in plain.headroom().encode()
+
+    def test_snapshot_carries_mix_observe_only(self):
+        """Both scheduler paths decode the mix (it rides the parsed
+        NodeHeadroom onto the NodeEntry); no score reads it — placement
+        parity with and without the mix segment."""
+        results = {}
+        for tag in ("without", "with"):
+            client = _registered_cluster(("node-a", "node-b"))
+            mix = {"thr": 2} if tag == "with" else {}
+            ann = hr_mod.NodeHeadroom(
+                chips={0: hr_mod.ChipHeadroom(80, 30, 50, 0)},
+                ts=time.time(), class_mix=mix).encode()
+            client.patch_node_annotations(
+                "node-a",
+                {consts.node_reclaimable_headroom_annotation(): ann})
+            snap = ClusterSnapshot(client)
+            snap.start()
+            entry = snap.entry("node-a")
+            assert (entry.headroom.class_mix == mix), tag
+            pred = FilterPredicate(client, snapshot=snap,
+                                   utilization_hint=True)
+            r = pred.filter({"Pod": _vtpu_pod()})
+            assert not r.error
+            results[tag] = r.node_names
+        assert results["without"] == results["with"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler: virtual admission + thrash backoff, both data paths
+# ---------------------------------------------------------------------------
+
+def _registered_cluster(node_names=("node-a", "node-b"), chips=2):
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in node_names:
+        client.add_node({"metadata": {"name": name, "annotations": {}}})
+        mgr = DeviceManager(name, client,
+                            node_config=NodeConfig(device_split_count=4),
+                            backends=[FakeBackend(n_chips=chips)])
+        mgr.init_devices()
+        mgr.register_node()
+    return client
+
+
+def _vtpu_pod(uid="oc-pod-1", name="p1", cores=10, memory_mib=1024,
+              workload_class=""):
+    anns = {}
+    if workload_class:
+        anns[consts.workload_class_annotation()] = workload_class
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": anns},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): 1,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): memory_mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def _publish_overcommit(client, node, ratios=None, spill_frac=0.0,
+                        spilled=0):
+    oc = NodeOvercommit(ratios=ratios or {"def": 2.0},
+                        spill_frac=spill_frac, spilled_bytes=spilled,
+                        ts=time.time())
+    client.patch_node_annotations(
+        node, {consts.node_overcommit_annotation(): oc.encode()})
+
+
+# one fake v5e chip = 16 GiB; a 12 GiB pod fits alone, two only fit
+# against a >= 1.5x virtual capacity
+BIG_MIB = 12 * 1024
+
+
+class TestVirtualAdmission:
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_overcommit_admits_past_physical(self, mode):
+        """Two 12 GiB pods on one 16 GiB chip: physically impossible,
+        admitted against 2x virtual capacity — in BOTH data paths."""
+        client = _registered_cluster(("node-a",), chips=1)
+        _publish_overcommit(client, "node-a", {"def": 2.0})
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        gate_off = FilterPredicate(client, snapshot=snap)
+        first = gate_off.filter({"Pod": _vtpu_pod(memory_mib=BIG_MIB)})
+        assert first.node_names == ["node-a"]
+        rejected = gate_off.filter(
+            {"Pod": _vtpu_pod(uid="oc-pod-2", name="p2",
+                              memory_mib=BIG_MIB)})
+        assert rejected.error, "physical admission must reject pod 2"
+
+        client2 = _registered_cluster(("node-a",), chips=1)
+        _publish_overcommit(client2, "node-a", {"def": 2.0})
+        snap2 = None
+        if mode == "snapshot":
+            snap2 = ClusterSnapshot(client2)
+            snap2.start()
+        gate_on = FilterPredicate(client2, snapshot=snap2,
+                                  hbm_overcommit=True)
+        assert gate_on.filter(
+            {"Pod": _vtpu_pod(memory_mib=BIG_MIB)}).node_names == \
+            ["node-a"]
+        second = gate_on.filter(
+            {"Pod": _vtpu_pod(uid="oc-pod-2", name="p2",
+                              memory_mib=BIG_MIB)})
+        assert second.node_names == ["node-a"], second.error
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_class_ratio_selects_admission(self, mode):
+        """The pod's webhook-normalized class picks ITS ratio: a
+        latency-critical pod admits only against the lat ratio."""
+        client = _registered_cluster(("node-a",), chips=1)
+        _publish_overcommit(client, "node-a",
+                            {"lat": 1.0, "thr": 2.0, "def": 1.0})
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap,
+                               hbm_overcommit=True)
+        first = pred.filter({"Pod": _vtpu_pod(
+            memory_mib=BIG_MIB,
+            workload_class=consts.WORKLOAD_CLASS_THROUGHPUT)})
+        assert first.node_names == ["node-a"]
+        # a latency-critical sibling sees ratio 1.0: no room left
+        lat = pred.filter({"Pod": _vtpu_pod(
+            uid="oc-lat", name="lat", memory_mib=BIG_MIB,
+            workload_class=consts.WORKLOAD_CLASS_LATENCY_CRITICAL)})
+        assert lat.error
+        # a throughput sibling admits against 2x
+        thr = pred.filter({"Pod": _vtpu_pod(
+            uid="oc-thr", name="thr", memory_mib=BIG_MIB,
+            workload_class=consts.WORKLOAD_CLASS_THROUGHPUT)})
+        assert thr.node_names == ["node-a"], thr.error
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_stale_policy_admits_physically_only(self, mode):
+        """A dead policy publisher decays to the physical gate — the
+        scheduler never admits against capacity nobody measures."""
+        client = _registered_cluster(("node-a",), chips=1)
+        stale = NodeOvercommit(
+            ratios={"def": 2.0}, ts=time.time()
+            - oc_mod.MAX_OVERCOMMIT_AGE_S - 10)
+        client.patch_node_annotations(
+            "node-a",
+            {consts.node_overcommit_annotation(): stale.encode()})
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap,
+                               hbm_overcommit=True)
+        assert pred.filter(
+            {"Pod": _vtpu_pod(memory_mib=BIG_MIB)}).node_names
+        second = pred.filter({"Pod": _vtpu_pod(
+            uid="oc-pod-2", name="p2", memory_mib=BIG_MIB)})
+        assert second.error
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_spill_rate_steers_placement(self, mode):
+        """The thrash-backoff term: two equal nodes, one actively
+        servicing spills — the pod lands on the quiet one."""
+        client = _registered_cluster(("node-a", "node-b"))
+        _publish_overcommit(client, "node-a", {"def": 1.0},
+                            spill_frac=0.8, spilled=4 * GIB)
+        _publish_overcommit(client, "node-b", {"def": 1.0},
+                            spill_frac=0.0)
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap,
+                               hbm_overcommit=True)
+        r = pred.filter({"Pod": _vtpu_pod()})
+        assert r.node_names == ["node-b"], \
+            "spill-rate pressure must back off the thrashing node"
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_placement_parity_gate_on_vs_off(self, mode):
+        """The acceptance byte-contract: for pods on non-overcommitted
+        nodes (no annotation published) placement is identical with
+        the gate on and off, in BOTH scheduler modes."""
+        placements = {}
+        for gate in (False, True):
+            client = _registered_cluster()
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap,
+                                   hbm_overcommit=gate)
+            names = []
+            for i in range(3):
+                pod = _vtpu_pod(uid=f"par-{i}", name=f"par-{i}")
+                r = pred.filter({"Pod": pod})
+                assert not r.error
+                client.add_pod(pod)
+                names.append(r.node_names[0])
+            placements[gate] = names
+        assert placements[False] == placements[True]
+
+    def test_explain_records_spill_and_virtual_split(self, tmp_path):
+        """The audit record carries the exact spill penalty and the
+        admission ratio, and the total equation extends to
+        base - pressure - storm - spill + gang + headroom_term."""
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        client = _registered_cluster(("node-a",), chips=1)
+        _publish_overcommit(client, "node-a", {"def": 2.0},
+                            spill_frac=0.5)
+        pred = FilterPredicate(client, hbm_overcommit=True)
+        r = pred.filter({"Pod": _vtpu_pod(memory_mib=BIG_MIB)})
+        assert r.node_names == ["node-a"]
+        explain.flush()
+        records, _ = doctor.read_records(str(tmp_path / "ex"))
+        cands = [c for rec in records
+                 for c in rec.get("candidates", [])]
+        assert cands, "the pass must be audited"
+        c = cands[0]
+        assert c["virt_ratio"] == 2.0
+        assert c["spill"] == pytest.approx(
+            0.5 * oc_mod.SPILL_SCORE_WEIGHT)
+        assert c["total"] == pytest.approx(
+            c["base"] - c["pressure"] - c["storm"] - c["spill"]
+            + c["gang_bonus"] + c["headroom_term"])
+
+
+# ---------------------------------------------------------------------------
+# spill pool: LRU, budget, chaos, reaping, invariants
+# ---------------------------------------------------------------------------
+
+class TestSpillPool:
+    def _pool(self, tmp_path, budget=100 * 1024):
+        led = vmem.VmemLedger(str(tmp_path / "vmem.config"), create=True)
+        pool = SpillPool(str(tmp_path / "spill"), budget_bytes=budget,
+                         ledger=led, owner_token=0xABC)
+        return pool, led
+
+    def test_spill_fill_roundtrip_and_ledger(self, tmp_path):
+        pool, led = self._pool(tmp_path)
+        payload = b"w" * 4096
+        pool.spill(0, "weights", payload)
+        assert led.node_spilled_total() == 4096
+        assert pool.spill_events == 1
+        assert pool.fill(0, "weights") == payload
+        assert led.node_spilled_total() == 0
+        assert pool.fill(0, "weights") is None
+        led.close()
+
+    def test_budget_guard_pre_write(self, tmp_path):
+        pool, led = self._pool(tmp_path, budget=8192)
+        pool.spill(0, "a", b"x" * 6000)
+        with pytest.raises(SpillBudgetError):
+            pool.spill(0, "b", b"y" * 3000)
+        # the failed spill left no file and no accounting
+        assert led.node_spilled_total() == 6000
+        files, total = spill_mod.pool_totals(pool.pool_dir)
+        assert (files, total) == (1, 6000)
+        led.close()
+
+    def test_budget_is_node_wide_across_processes(self, tmp_path):
+        """Two spillers share one budget through the ledger: the guard
+        reads Σ spilled from the vmem file, not local state."""
+        led = vmem.VmemLedger(str(tmp_path / "vmem.config"), create=True)
+        a = SpillPool(str(tmp_path / "spill"), budget_bytes=10000,
+                      ledger=led, owner_token=1, pid=os.getpid())
+        # a co-tenant's live claim (our own pid so it is not reaped)
+        led.record_spilled(os.getpid(), 1, 7000, owner_token=2)
+        with pytest.raises(SpillBudgetError):
+            a.spill(0, "big", b"z" * 5000)
+        a.spill(0, "small", b"z" * 2000)
+        led.close()
+
+    def test_lru_victim_choice(self):
+        cands = [("hot", 40, 300), ("cold", 30, 10), ("warm", 40, 100)]
+        assert SpillPool.choose_victims(cands, 50) == ["cold", "warm"]
+        assert SpillPool.choose_victims(cands, 200) == []   # uncoverable
+        assert SpillPool.choose_victims([], 1) == []
+
+    def test_torn_spill_never_corrupts_ledger(self, tmp_path):
+        """spill.copy partial-write: the copy dies mid-write. Only a
+        .tmp orphan exists, the vmem ledger is untouched, the budget is
+        intact, and the reaper deletes the orphan — the invariants
+        converge."""
+        pool, led = self._pool(tmp_path)
+        failpoints.enable(seed=7)
+        failpoints.arm("spill.copy", "partial-write")
+        with pytest.raises(CrashFailpoint):
+            pool.spill(0, "torn", b"t" * 8192)
+        failpoints.disable()
+        assert led.node_spilled_total() == 0          # ledger untouched
+        files, total = spill_mod.pool_totals(pool.pool_dir)
+        assert (files, total) == (0, 0)               # no pool file
+        orphans = [n for n in os.listdir(pool.pool_dir)
+                   if ".tmp." in n]
+        assert orphans, "the torn copy leaves only a tmp orphan"
+        assert pool.fill(0, "torn") is None
+        # the reaper clears the orphan once stale
+        assert spill_mod.reap_pool(pool.pool_dir, stale_s=0.0) == 1
+        assert not [n for n in os.listdir(pool.pool_dir)
+                    if ".tmp." in n]
+        assert_node_invariants(led, {0: GIB}, pool.budget_bytes)
+        led.close()
+
+    def test_injected_budget_exhaustion(self, tmp_path):
+        pool, led = self._pool(tmp_path)
+        failpoints.enable(seed=3)
+        failpoints.arm("spill.budget", "error", exc=SpillBudgetError,
+                       count=1)
+        with pytest.raises(SpillBudgetError):
+            pool.spill(0, "b", b"x" * 128)
+        failpoints.disable()
+        assert led.node_spilled_total() == 0
+        pool.spill(0, "b", b"x" * 128)     # recovers after the injection
+        assert led.node_spilled_total() == 128
+        led.close()
+
+    def test_crashed_spiller_reaped(self, tmp_path, monkeypatch):
+        """A spiller that died holding host-pool bytes: its pool files
+        AND its ledger budget claim are both reclaimed (independently
+        — either side converges without the other)."""
+        monkeypatch.setenv("VTPU_VMEM_STALE_S", "0.01")
+        led = vmem.VmemLedger(str(tmp_path / "vmem.config"), create=True)
+        dead_pid = 4_000_000
+        pool_dir = str(tmp_path / "spill")
+        dead = SpillPool(pool_dir, budget_bytes=10**6, ledger=led,
+                         owner_token=0xDEAD, pid=dead_pid)
+        dead.spill(0, "orphan", b"o" * 2048)
+        # rewrite the ledger row as the dead pid's (SpillPool records
+        # under its ctor pid already) and age it out
+        assert led.node_spilled_total() == 2048
+        time.sleep(0.02)
+        # the ledger's own dead+stale rule reclaims the budget...
+        assert led.node_spilled_total() == 0
+        # ...and the pool reaper reclaims the host RAM
+        assert spill_mod.reap_pool(pool_dir, stale_s=0.0) == 1
+        assert spill_mod.pool_totals(pool_dir) == (0, 0)
+        led.close()
+
+    def test_invariants_guard(self, tmp_path):
+        led = vmem.VmemLedger(str(tmp_path / "vmem.config"), create=True)
+        me = os.getpid()
+        led.record(me, 0, 10 * GIB)
+        assert_node_invariants(led, {0: 16 * GIB}, 8 * GIB)
+        led.record(me, 0, 17 * GIB)
+        with pytest.raises(AssertionError, match="resident"):
+            assert_node_invariants(led, {0: 16 * GIB}, 8 * GIB)
+        led.record(me, 0, GIB)
+        led.record_spilled(me, 0, 9 * GIB)
+        with pytest.raises(AssertionError, match="spill pool"):
+            assert_node_invariants(led, {0: 16 * GIB}, 8 * GIB)
+        led.close()
+
+    def test_chaos_rounds_converge(self, tmp_path, monkeypatch):
+        """Seeded chaos over spill/fill rounds with both sites armed:
+        the invariants hold at EVERY round, and after the injections
+        drain the pool still round-trips payloads intact."""
+        monkeypatch.setenv("VTPU_VMEM_STALE_S", "120")
+        led = vmem.VmemLedger(str(tmp_path / "vmem.config"), create=True)
+        budget = 64 * 1024
+        pool = SpillPool(str(tmp_path / "spill"), budget_bytes=budget,
+                         ledger=led, owner_token=0xC0)
+        failpoints.enable(seed=11)
+        failpoints.arm("spill.copy", "partial-write", p=0.3, count=3)
+        failpoints.arm("spill.budget", "error", exc=SpillBudgetError,
+                       p=0.2, count=2)
+        alive: dict[str, bytes] = {}
+        for i in range(40):
+            buf = f"b{i % 8}"
+            payload = bytes([i % 251]) * (1024 + 17 * i)
+            try:
+                if buf in alive:
+                    got = pool.fill(0, buf)
+                    assert got == alive.pop(buf)
+                else:
+                    pool.spill(0, buf, payload)
+                    alive[buf] = payload
+            except (CrashFailpoint, SpillBudgetError):
+                alive.pop(buf, None)     # the op did not commit
+            assert_node_invariants(led, {0: GIB}, budget)
+            assert led.node_spilled_total() == \
+                sum(len(v) for v in alive.values())
+        failpoints.disable()
+        spill_mod.reap_pool(pool.pool_dir, stale_s=0.0)
+        for buf, payload in list(alive.items()):
+            assert pool.fill(0, buf) == payload
+        assert led.node_spilled_total() == 0
+        led.close()
+
+
+# ---------------------------------------------------------------------------
+# collector series + rollup document gating
+# ---------------------------------------------------------------------------
+
+class TestGateContracts:
+    def test_collector_spill_series_gated(self, tmp_path):
+        from vtpu_manager.metrics.collector import NodeCollector
+        base = str(tmp_path / "mgr")
+        os.makedirs(base, exist_ok=True)
+        off = NodeCollector("node-a", [fake_chip(0)], base_dir=base,
+                            tc_path=str(tmp_path / "no-tc"),
+                            vmem_path=str(tmp_path / "no-vmem"),
+                            pod_resources_socket=str(tmp_path / "s"),
+                            kubelet_checkpoint=str(tmp_path / "c"))
+        assert "vtpu_node_spill" not in off.render()
+        on = NodeCollector("node-a", [fake_chip(0)], base_dir=base,
+                           tc_path=str(tmp_path / "no-tc"),
+                           vmem_path=str(tmp_path / "no-vmem"),
+                           pod_resources_socket=str(tmp_path / "s"),
+                           kubelet_checkpoint=str(tmp_path / "c"),
+                           overcommit_enabled=True,
+                           spill_dir=str(tmp_path / "spill"))
+        text = on.render()
+        for series in ("vtpu_node_spill_step_fraction",
+                       "vtpu_node_spilled_bytes",
+                       "vtpu_node_spill_pool_bytes",
+                       "vtpu_node_spill_events_total",
+                       "vtpu_node_fill_events_total"):
+            assert series in text
+        # overcommit alone must NOT leak vtuse series (its ledger is
+        # fold-only)
+        assert "vtpu_utilization_allocated_core_percent{" not in text
+
+    def test_rollup_document_byte_identical_gate_off(self, tmp_path):
+        """The vtqm pattern: an overcommit-off document carries no
+        overcommit/spill fields at all."""
+        from vtpu_manager.utilization.rollup import ClusterRollup
+        base = str(tmp_path / "mgr")
+        os.makedirs(base, exist_ok=True)
+        client = _registered_cluster(("node-a",))
+        _publish_overcommit(client, "node-a", {"def": 2.0},
+                            spill_frac=0.4)
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        off = ClusterRollup(ledger, client=client).collect()
+        assert "spill" not in off["node"]
+        for nrow in off["nodes"]:
+            assert "overcommit_ratio" not in nrow
+            assert "spill_frac" not in nrow
+            for ch in nrow["chips"]:
+                assert "virt_hbm_bytes" not in ch
+                assert "spilled_bytes" not in ch
+        on = ClusterRollup(ledger, client=client,
+                           overcommit=True).collect()
+        nrow = [r for r in on["nodes"] if r["node"] == "node-a"][0]
+        assert nrow["overcommit_ratio"] == 2.0
+        assert nrow["spill_frac"] == 0.4
+        assert nrow["chips"][0]["virt_hbm_bytes"] == \
+            nrow["chips"][0]["memory_bytes"] * 2
+        assert "spill" in on["node"]
+
+    def test_vtpu_smi_renders_virt_spill_columns(self, tmp_path):
+        """The CLI grows VIRT/SPILL columns + the oversubscription
+        line only for overcommit documents."""
+        import io
+
+        from scripts.vtpu_smi import render
+        from vtpu_manager.utilization.rollup import ClusterRollup
+        base = str(tmp_path / "mgr")
+        os.makedirs(base, exist_ok=True)
+        client = _registered_cluster(("node-a",))
+        _publish_overcommit(client, "node-a", {"def": 1.6},
+                            spill_frac=0.3, spilled=2 * GIB)
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        doc_on = ClusterRollup(ledger, client=client,
+                               overcommit=True).collect()
+        out = io.StringIO()
+        render(doc_on, out=out)
+        text = out.getvalue()
+        assert "oversub 1.60x" in text
+        assert "virt" in text and "spill" in text
+        assert "spilling 30% of steps" in text
+        doc_off = ClusterRollup(ledger, client=client).collect()
+        out_off = io.StringIO()
+        render(doc_off, out=out_off)
+        assert "oversub" not in out_off.getvalue()
+        assert "virt" not in out_off.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# v4 config stamping through Allocate (plugin wiring)
+# ---------------------------------------------------------------------------
+
+class TestPluginStamping:
+    def _alloc(self, tmp_path, enabled, policy=None):
+        from collections import Counter as _C  # noqa: F401
+        from vtpu_manager.deviceplugin.vnum import VnumPlugin, device_id
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-a",
+                                      "annotations": {}}})
+        mgr = DeviceManager("node-a", client,
+                            node_config=NodeConfig(device_split_count=4),
+                            backends=[FakeBackend(n_chips=1)])
+        mgr.init_devices()
+        mgr.register_node()
+        base = str(tmp_path / "mgr")
+        plugin = VnumPlugin(mgr, client, "node-a", base_dir=base)
+        plugin.hbm_overcommit_enabled = enabled
+        plugin.overcommit_policy = policy
+        plugin.spill_budget_bytes = 32 * GIB if enabled else 0
+        pod = _vtpu_pod(
+            uid="alloc-uid", name="alloc-pod", memory_mib=4096,
+            workload_class=consts.WORKLOAD_CLASS_THROUGHPUT
+            if enabled else "")
+        pred = FilterPredicate(client)
+        r = pred.filter({"Pod": pod})
+        assert not r.error
+        pod["metadata"]["annotations"].update(
+            client.get_pod("default", "alloc-pod")["metadata"]
+            ["annotations"])
+        client.add_pod(pod)
+        from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+        chip = mgr.chips[0]
+        req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[device_id(chip.uuid, 0)])])
+        resp = plugin.allocate(req)
+        cfg = vc.read_config(os.path.join(
+            base, "alloc-uid_main", "config", "vtpu.config"))
+        return resp.container_responses[0], cfg
+
+    def test_gate_off_writes_v3_zeros_and_no_env(self, tmp_path):
+        resp, cfg = self._alloc(tmp_path, enabled=False)
+        assert cfg.devices[0].virtual_hbm_bytes == 0
+        assert cfg.devices[0].spill_budget_bytes == 0
+        assert consts.ENV_SPILL_POOL_DIR not in resp.envs
+
+    def test_gate_on_stamps_virtual_and_arms_pool(self, tmp_path):
+        class _FixedPolicy:
+            class ledger:  # noqa: N801 — duck-typed attr
+                pass
+
+            @staticmethod
+            def compute(now_wall=None):
+                return NodeOvercommit(ratios={"thr": 1.5, "def": 1.0},
+                                      ts=time.time())
+
+        resp, cfg = self._alloc(tmp_path, enabled=True,
+                                policy=_FixedPolicy())
+        dev = cfg.devices[0]
+        assert dev.virtual_hbm_bytes == int(dev.real_memory * 1.5)
+        assert dev.spill_budget_bytes == 32 * GIB
+        assert resp.envs[consts.ENV_SPILL_POOL_DIR] == consts.SPILL_DIR
+        assert cfg.workload_class == vc.WORKLOAD_CLASS_THROUGHPUT
+
+
+# ---------------------------------------------------------------------------
+# step-ring spill block end to end (writer -> ledger -> signal)
+# ---------------------------------------------------------------------------
+
+class TestSpillSignalChain:
+    def test_ring_spill_fields_fold_into_node_signal(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-s", "main")
+        w = _mk_ring(base, "uid-s", "main")
+        ledger = UtilizationLedger("node-a", [fake_chip(0)],
+                                   base_dir=base)
+        ledger.fold(now_mono=0.0)
+        for i in range(10):
+            spilling = i < 4           # 4 of 10 steps paid a transition
+            w.record(duration_ns=10**8, spilled_bytes=2 * GIB,
+                     spill_events=1 if spilling else 0,
+                     fill_events=1 if spilling else 0)
+        ledger.fold(now_mono=10.0)
+        w.close()
+        frac, spilled = ledger.node_spill_signal()
+        assert frac == pytest.approx(0.4)
+        assert spilled == 2 * GIB
+        assert ledger.spill_events_total == 4
+        assert ledger.fill_events_total == 4
+        # the policy rollup carries the same signal
+        oc = OvercommitPolicy(ledger).compute()
+        assert oc.spill_frac == pytest.approx(0.4)
+        assert oc.spilled_bytes == 2 * GIB
+        # quiet ring ages out of the thrash signal
+        frac_late, _ = ledger.node_spill_signal(
+            now_wall=time.time() + STALENESS_S + 1)
+        assert frac_late == 0.0

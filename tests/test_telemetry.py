@@ -214,10 +214,13 @@ class TestStepRing:
         """The committed offsets (consumed by the C++ mirror's
         static_asserts and the ABI golden) match the live fmt strings."""
         assert stepring.HEADER_SIZE == 80
-        assert stepring.RECORD_SIZE == 56
+        assert stepring.RECORD_SIZE == 72     # v2: +16B spill block
         assert stepring.HEADER_OFFSETS["writes"] == 24
         assert stepring.HEADER_OFFSETS["trace_id"] == 32
         assert stepring.RECORD_OFFSETS["flags"] == 48
+        assert stepring.RECORD_OFFSETS["spilled_bytes"] == 56
+        assert stepring.RECORD_OFFSETS["spill_events"] == 64
+        assert stepring.RECORD_OFFSETS["fill_events"] == 68
         assert stepring.FILE_SIZE == \
             stepring.HEADER_SIZE + \
             stepring.RING_CAPACITY * stepring.RECORD_SIZE

@@ -429,8 +429,9 @@ class TestAbiDrift:
 
     def test_vmem_entry_change_fails(self, tmp_path):
         src = self._real("vmem.py")
-        src = src.replace('_ENTRY_FMT = "<iiQQQQ"',
-                          '_ENTRY_FMT = "<iiQQQQQ"')
+        assert '_ENTRY_FMT = "<iiQQQQQ"' in src   # v3 layout
+        src = src.replace('_ENTRY_FMT = "<iiQQQQQ"',
+                          '_ENTRY_FMT = "<iiQQQQQQ"')
         findings = lint(tmp_path, {"config/vmem.py": src},
                         select=self.SELECT)
         assert any("vmem._ENTRY_FMT" in f.message for f in findings)

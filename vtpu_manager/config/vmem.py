@@ -24,7 +24,13 @@ from vtpu_manager.util import consts
 from vtpu_manager.util.flock import FileLock
 
 MAGIC = 0x4D454D56          # "VMEM"
-VERSION = 2
+# v3 (vtovc): each entry grew a trailing spilled u64 — bytes this
+# tenant-process currently holds in the node's host-RAM spill pool.
+# Resident (`bytes`) and spilled are disjoint: the alloc-path cap check
+# sums resident only (spilled HBM is free by definition), while the
+# node's spill budget bounds Σ spilled. Strict version check — plugin,
+# daemon and shim ship together per node, the config-ABI rule.
+VERSION = 3
 MAX_ENTRIES = 1024
 
 
@@ -47,16 +53,19 @@ _HEADER_FMT = "<IIii"       # magic, version, max_entries, pad
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
 
 # entry: pid i32, host_index i32, bytes u64, last_update_ns u64,
-# owner_token u64, activity u64 — the pid alone cannot identify a tenant
+# owner_token u64, activity u64, spilled u64 — the pid alone cannot
+# identify a tenant
 # across pid namespaces (a container's getpid() is meaningless to other
 # containers and to the host daemon), so self/other classification keys on
 # a namespace-independent token derived from pod identity; activity is a
 # monotonic submit counter the shim bumps per Execute, which the node
 # watcher differentiates per tick to apportion chip duty-cycle over
-# residents (libtpu metrics are chip-level only)
-_ENTRY_FMT = "<iiQQQQ"
+# residents (libtpu metrics are chip-level only); spilled (v3, vtovc) is
+# the tenant's live host-pool footprint, bounded node-wide by the spill
+# budget and reaped with the entry when the owner dies
+_ENTRY_FMT = "<iiQQQQQ"
 ENTRY_SIZE = struct.calcsize(_ENTRY_FMT)
-assert ENTRY_SIZE == 40
+assert ENTRY_SIZE == 48
 
 FILE_SIZE = HEADER_SIZE + MAX_ENTRIES * ENTRY_SIZE
 
@@ -91,6 +100,7 @@ class VmemEntry:
     last_update_ns: int
     owner_token: int = 0
     activity: int = 0
+    spilled: int = 0
 
 
 def _pid_alive(pid: int) -> bool:
@@ -140,14 +150,14 @@ class VmemLedger:
             self._fd = None
 
     def _entry(self, i: int) -> VmemEntry:
-        pid, hidx, nbytes, ts, token, activity = struct.unpack_from(
+        pid, hidx, nbytes, ts, token, activity, spilled = struct.unpack_from(
             _ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE)
-        return VmemEntry(pid, hidx, nbytes, ts, token, activity)
+        return VmemEntry(pid, hidx, nbytes, ts, token, activity, spilled)
 
     def _write_entry(self, i: int, e: VmemEntry) -> None:
         struct.pack_into(_ENTRY_FMT, self._mm, HEADER_SIZE + i * ENTRY_SIZE,
                          e.pid, e.host_index, e.bytes, e.last_update_ns,
-                         e.owner_token, e.activity)
+                         e.owner_token, e.activity, e.spilled)
 
     # -- API ----------------------------------------------------------------
 
@@ -162,13 +172,18 @@ class VmemLedger:
             for i in range(MAX_ENTRIES):
                 e = self._entry(i)
                 if e.pid == pid and e.host_index == host_index:
-                    if nbytes == 0:
+                    if nbytes == 0 and e.spilled == 0:
+                        # nothing resident AND nothing in the host pool:
+                        # the slot is truly free (a tenant with live
+                        # spilled bytes keeps its entry — the budget
+                        # accounting must survive a resident-zero dip)
                         self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                     else:
-                        # updates must not reset the submit counter
+                        # updates must not reset the submit counter or
+                        # the spilled footprint
                         self._write_entry(
                             i, VmemEntry(pid, host_index, nbytes, now,
-                                         token, e.activity))
+                                         token, e.activity, e.spilled))
                     return
                 if e.pid == 0 and free_slot is None:
                     free_slot = i
@@ -210,6 +225,83 @@ class VmemLedger:
                     self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
                     continue
                 total += e.bytes
+        return total
+
+    def record_spilled(self, pid: int, host_index: int, spilled: int,
+                       owner_token: int | None = None) -> None:
+        """vtovc: set this pid's host-pool footprint on a device. Shares
+        the resident entry (one row per (pid, chip) — budget accounting
+        and liveness reap cover both sides at once); a spill by a tenant
+        with no resident bytes yet claims a zero-byte slot."""
+        now = time.monotonic_ns()
+        token = owner_token if owner_token is not None \
+            else owner_token_from_env()
+        with self._lock:
+            free_slot = None
+            for i in range(MAX_ENTRIES):
+                e = self._entry(i)
+                if e.pid == pid and e.host_index == host_index:
+                    if spilled == 0 and e.bytes == 0:
+                        self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
+                    else:
+                        e.spilled = spilled
+                        e.last_update_ns = now
+                        self._write_entry(i, e)
+                    return
+                if e.pid == 0 and free_slot is None:
+                    free_slot = i
+            if spilled == 0:
+                return
+            if free_slot is None:
+                self._reap_locked()
+                for i in range(MAX_ENTRIES):
+                    if self._entry(i).pid == 0:
+                        free_slot = i
+                        break
+            if free_slot is None:
+                raise RuntimeError("vmem ledger full")
+            self._write_entry(free_slot,
+                              VmemEntry(pid, host_index, 0, now, token,
+                                        spilled=spilled))
+
+    def node_spilled_total(self, exclude_pid: int | None = None) -> int:
+        """Σ live spilled bytes across the node — what the spill budget
+        bounds. Same dead+stale reap rule as device_total: a crashed
+        spiller's host-pool claim must not pin budget forever (the
+        SpillPool reaper deletes the pool files; this clears the
+        accounting row)."""
+        total = 0
+        now = time.monotonic_ns()
+        stale_ns = _stale_reap_ns()
+        with self._lock:
+            for i in range(MAX_ENTRIES):
+                e = self._entry(i)
+                if e.pid == 0:
+                    continue
+                if exclude_pid is not None and e.pid == exclude_pid:
+                    continue
+                if not _pid_alive(e.pid) and \
+                        now - e.last_update_ns > stale_ns:
+                    self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
+                    continue
+                total += e.spilled
+        return total
+
+    def device_spilled_total(self, host_index: int) -> int:
+        """Σ live spilled bytes attributed to one chip's tenants."""
+        total = 0
+        now = time.monotonic_ns()
+        stale_ns = _stale_reap_ns()
+        with self._lock:
+            for i in range(MAX_ENTRIES):
+                e = self._entry(i)
+                if e.pid == 0 or e.host_index != host_index:
+                    continue
+                if not _pid_alive(e.pid) and \
+                        now - e.last_update_ns > stale_ns:
+                    self._write_entry(i, VmemEntry(0, 0, 0, 0, 0))
+                    continue
+                total += e.spilled
         return total
 
     def bump_activity(self, pid: int, host_index: int, n: int = 1,

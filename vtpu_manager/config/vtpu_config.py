@@ -34,7 +34,15 @@ MAGIC = 0x55505456          # "VTPU" little-endian
 # co-tenant, <0 lent to one; 0 = no lease, byte-identical to the old
 # pad). Size/offset changes only in the header (+8), device layout
 # unchanged.
-VERSION = 3
+# v4 (vtovc, HBM oversubscription): the device struct grew two trailing
+# u64s — virtual_hbm_bytes (the per-chip VIRTUAL capacity the scheduler
+# admitted this tenant against: physical × the node's class ratio; 0 =
+# HBMOvercommit off, the shim's physical-exhaustion check keeps its
+# pre-v4 hard-fail shape) and spill_budget_bytes (the node's host-RAM
+# spill budget: the bound on Σ spilled bytes across the node's tenants,
+# accounted in the vmem ledger's per-entry spilled field). Gate off
+# writes zeros in both — the v3 semantics byte-for-byte.
+VERSION = 4
 MAX_DEVICE_COUNT = 64
 UUID_LEN = 64
 NAME_LEN = 64
@@ -57,10 +65,11 @@ CORE_LIMIT_SOFT = 2      # balance policy: elastic hard_core..soft_core
 # vtpu_device_t: uuid[64], total_memory u64, real_memory u64,
 # hard_core i32, soft_core i32, core_limit i32, memory_limit i32,
 # memory_oversold i32, host_index i32, mesh_x/y/z i32, lease_core i32
-# (v3: the former pad — signed borrowed/lent core-% delta)
-_DEVICE_FMT = "<64sQQ10i"
+# (v3: the former pad — signed borrowed/lent core-% delta),
+# virtual_hbm_bytes u64 + spill_budget_bytes u64 (v4, vtovc)
+_DEVICE_FMT = "<64sQQ10iQQ"
 DEVICE_SIZE = struct.calcsize(_DEVICE_FMT)
-assert DEVICE_SIZE == 120
+assert DEVICE_SIZE == 136
 
 # vtpu_config_t header: magic u32, version u32, pod_uid[48], pod_name[64],
 # pod_namespace[64], container_name[64], device_count i32, compat_mode i32,
@@ -109,6 +118,14 @@ class DeviceConfig:
     # rate is clamp(hard_core + lease_core, 0, 100). 0 byte-identical
     # to the pre-v3 pad, so gate-off configs are unchanged on the wire.
     lease_core: int = 0
+    # vtovc (HBMOvercommit gate; both 0 when off = v3 semantics): the
+    # chip's VIRTUAL capacity the scheduler admitted against (physical ×
+    # the node's class ratio) — when > real_memory the shim's
+    # physical-exhaustion check gains a spill arm instead of hard-
+    # failing — and the node's host-RAM spill budget bounding Σ spilled
+    # bytes in the vmem ledger.
+    virtual_hbm_bytes: int = 0
+    spill_budget_bytes: int = 0
 
     def pack(self) -> bytes:
         return struct.pack(
@@ -116,17 +133,20 @@ class DeviceConfig:
             self.real_memory, self.hard_core, self.soft_core,
             self.core_limit, 1 if self.memory_limit else 0,
             1 if self.memory_oversold else 0, self.host_index,
-            self.mesh[0], self.mesh[1], self.mesh[2], self.lease_core)
+            self.mesh[0], self.mesh[1], self.mesh[2], self.lease_core,
+            self.virtual_hbm_bytes, self.spill_budget_bytes)
 
     @staticmethod
     def unpack(raw: bytes) -> "DeviceConfig":
         (uuid, total, real, hard, soft, climit, mlimit, oversold, hidx,
-         mx, my, mz, lease) = struct.unpack(_DEVICE_FMT, raw)
+         mx, my, mz, lease, virt, spill) = struct.unpack(_DEVICE_FMT, raw)
         return DeviceConfig(uuid=_from_cstr(uuid), total_memory=total,
                             real_memory=real, hard_core=hard, soft_core=soft,
                             core_limit=climit, memory_limit=bool(mlimit),
                             memory_oversold=bool(oversold), host_index=hidx,
-                            mesh=(mx, my, mz), lease_core=lease)
+                            mesh=(mx, my, mz), lease_core=lease,
+                            virtual_hbm_bytes=virt,
+                            spill_budget_bytes=spill)
 
 
 @dataclass
@@ -229,7 +249,8 @@ DEVICE_OFFSETS = {
     "uuid": 0, "total_memory": 64, "real_memory": 72, "hard_core": 80,
     "soft_core": 84, "core_limit": 88, "memory_limit": 92,
     "memory_oversold": 96, "host_index": 100, "mesh_x": 104, "mesh_y": 108,
-    "mesh_z": 112, "lease_core": 116,
+    "mesh_z": 112, "lease_core": 116, "virtual_hbm_bytes": 120,
+    "spill_budget_bytes": 128,
 }
 HEADER_OFFSETS = {
     "magic": 0, "version": 4, "pod_uid": 8, "pod_name": 56,

@@ -56,6 +56,13 @@ class VnumPlugin(DevicePluginServicer):
     step_telemetry_enabled = False           # gated: StepTelemetry (vttel)
     compile_cache_enabled = False            # gated: CompileCache (vtcc)
     quota_market_enabled = False             # gated: QuotaMarket (vtqm)
+    hbm_overcommit_enabled = False           # gated: HBMOvercommit (vtovc)
+    # vtovc: the node's live policy engine (OvercommitPolicy | None) —
+    # Allocate stamps each chip's virtual capacity from the CURRENT
+    # per-class ratio, and the node host-RAM spill budget rides every
+    # device entry (0 = gate off, the v3 zeros)
+    overcommit_policy = None
+    spill_budget_bytes = 0
 
     def __init__(self, manager: DeviceManager, client: KubeClient,
                  node_name: str, node_config: NodeConfig | None = None,
@@ -321,6 +328,26 @@ class VnumPlugin(DevicePluginServicer):
             str(i) for i in host_indices)
         resp.envs[consts.ENV_TPU_VISIBLE_DEVICES] = ",".join(
             str(i) for i in host_indices)
+        # vtovc: the chip's virtual capacity is stamped from the
+        # CURRENT per-class ratio (the same policy engine the node
+        # annotation publishes, so the shim and the scheduler agree on
+        # the admitted split); gate off = ratio 1.0 and zeros below
+        oc_ratio = 1.0
+        if self.hbm_overcommit_enabled and pod is not None:
+            from vtpu_manager import quota
+            from vtpu_manager.overcommit import ratio as oc_mod
+            oc = None
+            if self.overcommit_policy is not None:
+                try:
+                    oc = self.overcommit_policy.compute()
+                except Exception:  # noqa: BLE001 — a torn policy fold
+                    # degrades THIS allocation to physical admission
+                    # (ratio 1.0, the safe direction), never fails it
+                    log.warning("overcommit policy compute failed; "
+                                "allocating at physical capacity",
+                                exc_info=True)
+            oc_ratio = oc_mod.ratio_for_class(
+                oc, quota.workload_class_of(pod))
         devices = []
         for i, claim in enumerate(claims):
             if claim.memory:
@@ -346,7 +373,15 @@ class VnumPlugin(DevicePluginServicer):
                 real_memory=real_mem, hard_core=claim.cores,
                 soft_core=soft, core_limit=core_limit,
                 memory_limit=claim.memory > 0, memory_oversold=oversold,
-                host_index=claim.host_index, mesh=mesh))
+                host_index=claim.host_index, mesh=mesh,
+                # vtovc: virtual chip capacity + node spill budget
+                # (zeros when the gate is off — the v3 wire bytes)
+                virtual_hbm_bytes=(int(real_mem * oc_ratio)
+                                   if self.hbm_overcommit_enabled
+                                   else 0),
+                spill_budget_bytes=(self.spill_budget_bytes
+                                    if self.hbm_overcommit_enabled
+                                    else 0)))
             resp.devices.append(pb.DeviceSpec(
                 container_path=f"/dev/accel{claim.host_index}",
                 host_path=f"/dev/accel{claim.host_index}",
@@ -389,10 +424,12 @@ class VnumPlugin(DevicePluginServicer):
                                 cc_host, e, uid, cont)
             # vtqm: the webhook-normalized workload class rides into the
             # config ABI so the shim and the node's market manager agree
-            # on which side of the market this tenant sits; gate off =
-            # WORKLOAD_CLASS_NONE = the zero bytes v2 carried
+            # on which side of the market this tenant sits; vtovc reads
+            # the same field for its per-class ratio samples. Both
+            # gates off = WORKLOAD_CLASS_NONE = the zero bytes v2
+            # carried.
             wl_class = vc.WORKLOAD_CLASS_NONE
-            if self.quota_market_enabled:
+            if self.quota_market_enabled or self.hbm_overcommit_enabled:
                 from vtpu_manager import quota
                 wl_class = quota.workload_class_abi(
                     quota.workload_class_of(pod))
@@ -430,6 +467,21 @@ class VnumPlugin(DevicePluginServicer):
             for path in (consts.LOCK_DIR, consts.VMEM_DIR):
                 resp.mounts.append(pb.Mount(container_path=path,
                                             host_path=path, read_only=False))
+            if self.hbm_overcommit_enabled:
+                # vtovc: the host-RAM spill pool lives under VMEM_DIR
+                # (already mounted read-write above), so arming is one
+                # mkdir + the env the shim's spill tier keys on — and,
+                # like the compile-cache pair, the env only appears when
+                # the directory actually exists
+                try:
+                    os.makedirs(consts.SPILL_DIR, exist_ok=True)
+                    resp.envs[consts.ENV_SPILL_POOL_DIR] = \
+                        consts.SPILL_DIR
+                except OSError as e:
+                    log.warning("spill pool dir %s unavailable (%s); "
+                                "tenant %s/%s runs without the host "
+                                "spill tier", consts.SPILL_DIR, e, uid,
+                                cont)
             if ctx is not None and ctx.sampled:
                 # tenant-side spans (shim register / first-execute) spool
                 # into the node trace dir — mounted read-write like the

@@ -106,23 +106,34 @@ class DecisionBuilder:
     def candidate(self, node: str, base: float, pressure: float,
                   storm: float, gang_bonus: float, headroom_input: float,
                   topology: str, total: float,
-                  headroom_term: float = 0.0) -> None:
+                  headroom_term: float = 0.0, spill: float = 0.0,
+                  virt_ratio: float = 1.0) -> None:
         """One scored candidate with the EXACT values applied:
-        ``total == base - pressure - storm + gang_bonus +
+        ``total == base - pressure - storm - spill + gang_bonus +
         headroom_term`` holds by construction (asserted end-to-end by
-        test_explain/test_quota). ``headroom_input`` is the raw vtuse
-        signal; ``headroom_term`` is what the QuotaMarket gate actually
-        scored from it (0.0 when the gate is off, the pod is not
-        latency-critical, or the signal was stale — the observe-only
-        shape PR 8/9 recorded). Past the cap the record keeps the TOP
-        candidates by total (a raised FilterPredicate.candidate_limit
-        must never evict the eventual winner from its own record — the
+        test_explain/test_quota/test_overcommit). ``headroom_input`` is
+        the raw vtuse signal; ``headroom_term`` is what the QuotaMarket
+        gate actually scored from it (0.0 when the gate is off, the pod
+        is not latency-critical, or the signal was stale — the
+        observe-only shape PR 8/9 recorded). ``spill`` is the vtovc
+        spill-rate penalty (0.0 unless HBMOvercommit scored a thrashing
+        node) and ``virt_ratio`` the oversubscription ratio this
+        candidate was ADMITTED under — the virtual/physical split in
+        the audit trail (1.0 = physical admission, the pre-vtovc
+        shape). Past the cap the record keeps the TOP candidates by
+        total (a raised FilterPredicate.candidate_limit must never
+        evict the eventual winner from its own record — the
         reproduce-the-winner invariant), and counts what it dropped."""
         row = {"node": node, "base": base, "pressure": pressure,
                "storm": storm, "gang_bonus": gang_bonus,
                "headroom_input": headroom_input,
                "headroom_term": headroom_term,
                "topology": topology, "total": total}
+        if spill or virt_ratio != 1.0:
+            # vtovc terms appear only when the gate actually shaped the
+            # candidate — gate-off records keep their exact prior shape
+            row["spill"] = spill
+            row["virt_ratio"] = virt_ratio
         cands = self.record["candidates"]
         if len(cands) < MAX_CANDIDATES:
             cands.append(row)

@@ -72,7 +72,9 @@ class NodeCollector:
                  vmem_path: str = consts.VMEM_NODE_CONFIG,
                  pod_resources_socket: str | None = None,
                  kubelet_checkpoint: str | None = None,
-                 utilization_enabled: bool = False):
+                 utilization_enabled: bool = False,
+                 overcommit_enabled: bool = False,
+                 spill_dir: str = consts.SPILL_DIR):
         self.node_name = node_name
         self.chips = chips
         self.base_dir = base_dir
@@ -118,11 +120,22 @@ class NodeCollector:
         self.util_ledger = None
         self.util_fold_budget_s = float(
             os.environ.get("VTPU_UTIL_FOLD_BUDGET_S", "0.25"))
-        if utilization_enabled:
+        # vtovc (HBMOvercommit gate; off = no spill series at all): the
+        # node spill signal folds off the SAME ledger's ring tail, so
+        # enabling overcommit alone still builds one — the policy
+        # engine's measurements and these series must share a fold.
+        self.overcommit_enabled = overcommit_enabled
+        self.spill_dir = spill_dir
+        if utilization_enabled or overcommit_enabled:
             from vtpu_manager.utilization import UtilizationLedger
             self.util_ledger = UtilizationLedger(
                 node_name, chips, base_dir=base_dir, tc_path=tc_path)
-            self._feed_errors["utilization"] = 0.0
+            if utilization_enabled:
+                self._feed_errors["utilization"] = 0.0
+        # gate-off contract: UtilizationLedger off must keep rendering
+        # ZERO vtuse series even when the ledger object exists for the
+        # overcommit fold
+        self.utilization_enabled = utilization_enabled
 
     def _kubelet_view(self, force: bool = False
                       ) -> pod_resources.KubeletView:
@@ -526,18 +539,66 @@ class NodeCollector:
         # confidence decay is what prevents stale claims, never a
         # blocked scrape.
         if self.util_ledger is not None:
-            self._feed_errors["utilization"] = 0.0
+            if self.utilization_enabled:
+                self._feed_errors["utilization"] = 0.0
             try:
                 if self.util_ledger.fold(
-                        budget_s=self.util_fold_budget_s):
+                        budget_s=self.util_fold_budget_s) \
+                        and self.utilization_enabled:
                     self._feed_errors["utilization"] = 1.0
             except Exception:  # noqa: BLE001 — any fold failure
                 # (including an injected util.fold error) must cost the
                 # feed flag, never the scrape
-                self._feed_errors["utilization"] = 1.0
+                if self.utilization_enabled:
+                    self._feed_errors["utilization"] = 1.0
                 log.warning("utilization ledger fold failed",
                             exc_info=True)
-            text += self.util_ledger.render()
+            if self.utilization_enabled:
+                text += self.util_ledger.render()
+        # vtovc: node spill series (HBMOvercommit on only — gate off
+        # renders none of these families): the step rings' spill signal
+        # plus the pool directory's ground truth, so thrash
+        # (spill_frac), footprint (ring gauge vs pool bytes) and
+        # lifetime churn (the counters) are all scrapeable.
+        if self.overcommit_enabled and self.util_ledger is not None:
+            from vtpu_manager.overcommit.spill import pool_totals
+            frac, ring_bytes = self.util_ledger.node_spill_signal()
+            pool_files, pool_bytes = pool_totals(self.spill_dir)
+            lines = [
+                "# HELP vtpu_node_spill_step_fraction Fraction of "
+                "recent steps that paid a host-tier spill or fill",
+                "# TYPE vtpu_node_spill_step_fraction gauge",
+                f'vtpu_node_spill_step_fraction{{node="'
+                f'{self.node_name}"}} {round(frac, 4):g}',
+                "# HELP vtpu_node_spilled_bytes Live host-pool "
+                "footprint reported by tenant step rings",
+                "# TYPE vtpu_node_spilled_bytes gauge",
+                f'vtpu_node_spilled_bytes{{node="{self.node_name}"}} '
+                f"{ring_bytes}",
+                "# HELP vtpu_node_spill_pool_bytes Bytes currently in "
+                "the node's spill pool directory",
+                "# TYPE vtpu_node_spill_pool_bytes gauge",
+                f'vtpu_node_spill_pool_bytes{{node="{self.node_name}"}} '
+                f"{pool_bytes}",
+                "# HELP vtpu_node_spill_pool_files Files currently in "
+                "the node's spill pool directory",
+                "# TYPE vtpu_node_spill_pool_files gauge",
+                f'vtpu_node_spill_pool_files{{node="{self.node_name}"}} '
+                f"{pool_files}",
+                "# HELP vtpu_node_spill_events_total HBM->host "
+                "demotions observed across tenant step rings",
+                "# TYPE vtpu_node_spill_events_total counter",
+                f'vtpu_node_spill_events_total{{node="'
+                f'{self.node_name}"}} '
+                f"{self.util_ledger.spill_events_total}",
+                "# HELP vtpu_node_fill_events_total host->HBM "
+                "promotions observed across tenant step rings",
+                "# TYPE vtpu_node_fill_events_total counter",
+                f'vtpu_node_fill_events_total{{node="'
+                f'{self.node_name}"}} '
+                f"{self.util_ledger.fill_events_total}",
+            ]
+            text += "\n".join(lines) + "\n"
         # self-observability: the scrape's own duration and per-feed
         # last-error flags, rendered last so a wedged feed still reports
         self._last_scrape_s = time.perf_counter() - t0

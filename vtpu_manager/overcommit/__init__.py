@@ -1,0 +1,29 @@
+"""vtovc — HBM oversubscription with a host-spill tier (HBMOvercommit).
+
+The overcommit plane, the TPU analogue of the reference's UVA-oversold
+mode: schedule against *virtual* HBM larger than physical, let the shim
+demote cold buffers to a host-RAM pool when physical runs out, and back
+the scheduler off nodes that are actively thrashing.
+
+- :mod:`ratio` — the node-overcommit annotation codec (per-class safe
+  ratios + spill-rate, staleness-stamped) and the virtual-registry
+  scaling both scheduler paths admit against;
+- :mod:`policy` — the node-side policy engine computing safe ratios
+  from vtuse's step-ring HBM high-water percentiles, plus the publisher
+  daemon;
+- :mod:`spill` — the host-RAM spill pool: LRU demotion, per-node
+  budget accounted in the vmem ledger, crash reaping, and the node
+  invariants the chaos harness asserts.
+"""
+
+from vtpu_manager.overcommit.ratio import (NodeOvercommit,  # noqa: F401
+                                           SPILL_SCORE_WEIGHT,
+                                           parse_overcommit,
+                                           ratio_for_class,
+                                           spill_penalty,
+                                           virtual_registry)
+from vtpu_manager.overcommit.policy import (OvercommitPolicy,  # noqa: F401
+                                            OvercommitPublisher)
+from vtpu_manager.overcommit.spill import (SpillBudgetError,  # noqa: F401
+                                           SpillPool,
+                                           assert_node_invariants)

@@ -1,0 +1,156 @@
+"""vtovc policy engine: per-class safe oversubscription ratios.
+
+The node-side answer to "how much virtual HBM can this node safely
+advertise, per workload class?" — computed from vtuse's measured
+ground truth, never from declared caps:
+
+- per tenant, the step-ring **HBM high-water** is the working-set
+  envelope (the high-water IS the burst envelope — the same reasoning
+  the headroom ledger applies to HBM reclaim);
+- per class, the p95 of ``highwater / allocated`` across the class's
+  tenants (with a safety factor) is the fraction of declared HBM the
+  class demonstrably touches — the inverse is the raw safe ratio;
+- the class's **minimum tenant confidence** gates the whole claim:
+  ratio = 1 + (raw - 1) × conf, so a class whose samples are going
+  stale decays linearly back to 1.0 and a never-sampled class IS 1.0
+  (no signal means no oversubscription — the headroom discipline,
+  because the scheduler will ADMIT against this number).
+
+Latency-critical tenants get a tighter safety factor than throughput
+ones: an underestimated working set costs a serving tenant a spill
+stall on its critical path, while a training step merely slows.
+
+The publisher rides the device-plugin daemon (the node-annotation
+owner, same shape as Pressure/HeadroomPublisher) and folds the node's
+live spill signal (step-ring spill/fill deltas + the vmem ledger's
+host-pool footprint) into the same annotation, so the scheduler's
+thrash-backoff reads one codec.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from vtpu_manager.overcommit.ratio import MAX_RATIO, NodeOvercommit
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+# working-set percentile across a class's tenants: the envelope the
+# ratio must cover (p95 — one outlier tenant caps the class, a tail
+# beyond that is what the spill tier exists for)
+HIGHWATER_PERCENTILE = 0.95
+
+# safety headroom multiplied onto the measured envelope fraction
+# before inversion — latency-critical working sets get more slack
+SAFETY_FACTOR = {"lat": 1.5, "thr": 1.2, "def": 1.35}
+
+# a class's envelope fraction is floored here before inversion: even a
+# provably tiny working set never advertises more than MAX_RATIO
+MIN_ENVELOPE_FRACTION = 1.0 / MAX_RATIO
+
+# minimum evidence before any oversubscription: below this many
+# distinct sampled tenants in a class the ratio stays 1.0 (one tenant's
+# high-water says nothing about the mix the virtual capacity will admit)
+MIN_CLASS_TENANTS = 2
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (no numpy in the
+    node daemon's hot loop)."""
+    if not sorted_vals:
+        return 1.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class OvercommitPolicy:
+    """Fold one node's vtuse ledger into a NodeOvercommit rollup."""
+
+    def __init__(self, ledger, max_ratio: float = MAX_RATIO):
+        self.ledger = ledger
+        self.max_ratio = max_ratio
+
+    def compute(self, now_wall: float | None = None) -> NodeOvercommit:
+        now_wall = time.time() if now_wall is None else now_wall
+        samples = self.ledger.hbm_fraction_samples(now_wall)
+        ratios: dict[str, float] = {}
+        for key in ("lat", "thr", "def"):
+            ratios[key] = self._class_ratio(key, samples.get(key, []))
+        spill_frac, spilled_bytes = \
+            self.ledger.node_spill_signal(now_wall)
+        return NodeOvercommit(ratios=ratios, spill_frac=spill_frac,
+                              spilled_bytes=spilled_bytes, ts=now_wall)
+
+    def _class_ratio(self, key: str,
+                     samples: list[tuple[float, float]]) -> float:
+        """One class's safe ratio from its (fraction, confidence)
+        samples. Confidence gating is the MIN across the class — the
+        stalest tenant's decay bounds the whole claim, because the
+        admitted mix includes tenants like it."""
+        live = [(f, c) for f, c in samples if c > 0.0]
+        if len(live) < MIN_CLASS_TENANTS:
+            return 1.0
+        fracs = sorted(min(max(f, 0.0), 1.0) for f, _ in live)
+        envelope = _percentile(fracs, HIGHWATER_PERCENTILE) \
+            * SAFETY_FACTOR[key]
+        envelope = max(envelope, MIN_ENVELOPE_FRACTION)
+        raw = min(1.0 / envelope, self.max_ratio)
+        conf = min(c for _, c in live)
+        return round(1.0 + (raw - 1.0) * conf, 2)
+
+
+class OvercommitPublisher:
+    """Daemon loop: compute the policy, patch the node annotation.
+
+    Device-plugin side behind the HBMOvercommit gate — the exact shape
+    of Pressure/HeadroomPublisher (per-tick failure tolerance; the
+    codec's timestamp ages a silent publisher out to ratio 1.0 on the
+    scheduler side, which is the safe direction)."""
+
+    def __init__(self, client, node_name: str, policy: OvercommitPolicy,
+                 retry_policy=None, interval_s: float = 15.0,
+                 fold: bool = True):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.policy = policy
+        self.retry_policy = retry_policy or RetryPolicy(max_attempts=3,
+                                                        deadline_s=10.0)
+        self.interval_s = interval_s
+        # False when another daemon loop (e.g. a shared ledger's owner)
+        # already folds: two folders would race one cursor state
+        self.fold = fold
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def publish_once(self) -> NodeOvercommit:
+        if self.fold:
+            self.policy.ledger.fold()
+        oc = self.policy.compute()
+        self.retry_policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_overcommit_annotation(): oc.encode()}),
+            op="overcommit.policy_patch")
+        return oc
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.publish_once()
+                except Exception:  # noqa: BLE001 — advisory signal; the
+                    # annotation timestamp decays a silent failure to
+                    # ratio 1.0 (the safe direction)
+                    log.warning("overcommit policy publish failed",
+                                exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtovc-policy")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,200 @@
+"""Node-overcommit annotation: vtovc's feedback edge into the scheduler.
+
+Same codec family as the vttel pressure and vtuse headroom annotations —
+parse-cheap on purpose (the snapshot path decodes it per node event, the
+TTL path per candidate), staleness explicit by timestamp:
+
+    "<class>:<ratio>;...|<spill_frac>:<spilled_bytes>@<wall_ts>"
+
+one ``;``-separated segment per workload class (``lat`` / ``thr`` /
+``def`` for unclassified), ratios as decimals >= 1.0, then the node's
+measured spill activity: ``spill_frac`` is the fraction of recent steps
+that paid a spill or fill (the thrash signal), ``spilled_bytes`` the
+live host-pool footprint. A publisher that goes dark decays to ratio
+1.0 and zero spill signal — an oversubscription claim that outlives its
+publisher is worse than no claim, because the scheduler would admit
+pods against capacity nobody is measuring anymore.
+
+Two consumers, two disciplines:
+
+- **ratio_for_class** feeds ADMISSION (virtual capacity). Staleness is
+  judged at parse time AND re-judged at use time (the pressure-penalty
+  rule: the snapshot caches the parsed object and a dead publisher
+  emits no further events);
+- **spill_penalty** feeds SCORING — a soft penalty in the same currency
+  as the pressure term (reorders fits, never vetoes one), so a node
+  actively servicing spills repels new pods before it thrashes harder.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+from vtpu_manager.util import consts
+
+# a policy rollup older than this reads as no-signal => ratio 1.0
+# (publisher cadence is seconds; the pressure/headroom constant family)
+MAX_OVERCOMMIT_AGE_S = 120.0
+FUTURE_SKEW_TOLERANCE_S = 5.0
+
+# hard bound on any published ratio: even a unanimous working-set
+# signal never oversells a chip more than 4x (the bench's density
+# headline needs 1.5-2x; 4x is the runaway backstop)
+MAX_RATIO = 4.0
+
+# scoring weight for the spill-rate penalty: a node where every recent
+# step paid a spill/fill loses this many points — the same currency as
+# the vttel pressure penalty (reorders fits, never vetoes; strictly
+# below the +100 gang bonus so gang locality still wins)
+SPILL_SCORE_WEIGHT = 50.0
+
+# wire keys per workload class; "def" covers unclassified tenants
+CLASS_KEYS = {
+    consts.WORKLOAD_CLASS_LATENCY_CRITICAL: "lat",
+    consts.WORKLOAD_CLASS_THROUGHPUT: "thr",
+    "": "def",
+}
+
+
+@dataclass(frozen=True)
+class NodeOvercommit:
+    """Decoded node-overcommit policy rollup."""
+
+    ratios: dict[str, float] = field(default_factory=dict)  # key -> ratio
+    spill_frac: float = 0.0        # fraction of recent steps spilling
+    spilled_bytes: int = 0         # live host-pool footprint
+    ts: float = 0.0
+
+    def encode(self) -> str:
+        body = ";".join(f"{k}:{r:.2f}"
+                        for k, r in sorted(self.ratios.items()))
+        return (f"{body}|{self.spill_frac:.4f}:{self.spilled_bytes}"
+                f"@{self.ts:.3f}")
+
+    def max_ratio(self) -> float:
+        return max(self.ratios.values(), default=1.0)
+
+
+def parse_overcommit(raw: str | None, now: float | None = None,
+                     max_age_s: float = MAX_OVERCOMMIT_AGE_S
+                     ) -> NodeOvercommit | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    every bad shape degrades to no-signal (ratio 1.0 everywhere), never
+    to a wrong oversubscription claim."""
+    if not raw:
+        return None
+    body, sep, ts_raw = raw.rpartition("@")
+    if not sep:
+        return None
+    try:
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(ts):
+        return None
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+        return None
+    classes, sep, spill_raw = body.rpartition("|")
+    if not sep:
+        return None
+    frac_raw, _, bytes_raw = spill_raw.partition(":")
+    try:
+        spill_frac = float(frac_raw)
+        spilled_bytes = int(bytes_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(spill_frac):
+        return None
+    ratios: dict[str, float] = {}
+    for seg in classes.split(";"):
+        if not seg:
+            continue
+        key, _, ratio_raw = seg.partition(":")
+        try:
+            ratio = float(ratio_raw)
+        except (TypeError, ValueError):
+            return None
+        if not math.isfinite(ratio):
+            # NaN parses but poisons every capacity product downstream
+            return None
+        ratios[key] = min(max(ratio, 1.0), MAX_RATIO)
+    return NodeOvercommit(ratios=ratios,
+                          spill_frac=min(max(spill_frac, 0.0), 1.0),
+                          spilled_bytes=max(spilled_bytes, 0), ts=ts)
+
+
+def _fresh(oc: "NodeOvercommit | None", now: float | None) -> bool:
+    if oc is None:
+        return False
+    now = time.time() if now is None else now
+    return -FUTURE_SKEW_TOLERANCE_S <= now - oc.ts <= MAX_OVERCOMMIT_AGE_S
+
+
+def ratio_for_class(oc: "NodeOvercommit | None", workload_class: str,
+                    now: float | None = None) -> float:
+    """The admission ratio for one pod's workload class. Staleness is
+    re-judged HERE, not only at parse time — the snapshot path caches
+    the parsed rollup on the NodeEntry and a dead publisher emits no
+    further node events, so a use-time check is what decays the claim
+    to 1.0 instead of admitting against phantom capacity forever."""
+    if not _fresh(oc, now):
+        return 1.0
+    key = CLASS_KEYS.get(workload_class, "def")
+    ratio = oc.ratios.get(key)
+    if ratio is None:
+        ratio = oc.ratios.get("def", 1.0)
+    return min(max(ratio, 1.0), MAX_RATIO)
+
+
+def spill_penalty(oc: "NodeOvercommit | None",
+                  now: float | None = None) -> float:
+    """Score points to subtract for a node's live spill activity — the
+    thrash-backoff term. Soft like the pressure penalty: a thrashing
+    node with the only free chips still schedules. Stale signal = 0.0
+    (the byte-identical pre-vtovc score)."""
+    if not _fresh(oc, now):
+        return 0.0
+    return SPILL_SCORE_WEIGHT * oc.spill_frac
+
+
+# ---------------------------------------------------------------------------
+# Virtual-registry scaling: the one place virtual capacity enters the
+# scheduler's accounting. Both data paths admit with the SAME scaled
+# registry (fast_free_totals pre-gate and the allocator's per-chip
+# placement both read ChipSpec.memory), so the virtual/physical split
+# cannot drift between the gate and the allocation.
+# ---------------------------------------------------------------------------
+
+def virtual_registry(registry, ratio: float):
+    """A view of ``registry`` with every healthy chip's HBM scaled by
+    ``ratio``. Ratio <= 1.0 returns the registry itself (the gate-off /
+    no-signal identity — zero allocations, byte-identical objects).
+
+    Scaled copies are memoized ON the registry object (the same idiom
+    as its healthy_totals memo): registries are decode-cached and
+    shared across passes, ratios are quantized to 2 decimals by the
+    codec, so a node's steady ratio costs one copy, not one per pass.
+    ChipSpec is frozen — copies never alias the originals' capacity.
+    """
+    if registry is None or ratio <= 1.0:
+        return registry
+    ratio = round(min(ratio, MAX_RATIO), 2)
+    cache = getattr(registry, "_virtual_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(registry, "_virtual_cache", cache)
+    scaled = cache.get(ratio)
+    if scaled is not None:
+        return scaled
+    import dataclasses
+
+    from vtpu_manager.device.types import NodeDeviceRegistry
+    scaled = NodeDeviceRegistry(
+        chips=[dataclasses.replace(c, memory=int(c.memory * ratio))
+               for c in registry.chips],
+        mesh=registry.mesh, mesh_domain=registry.mesh_domain)
+    cache[ratio] = scaled
+    return scaled

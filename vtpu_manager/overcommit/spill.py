@@ -1,0 +1,284 @@
+"""Host-RAM spill pool: the vtovc demotion tier (Python side).
+
+The contract mirror of the C++ shim's spill arm (enforce.cc), the same
+way ``config/vmem.py`` mirrors the ledger the shim mmaps: one node-
+shared pool directory holds each tenant's demoted buffers as files,
+the vmem ledger's per-entry ``spilled`` field accounts every byte, and
+the per-node spill budget bounds the sum. The chaos harness and the
+density bench drive THIS implementation; real tenants go through the
+shim, which follows the identical protocol on the identical files.
+
+Protocol (crash-ordered so a torn spill can never corrupt accounting):
+
+1. budget check under the pool lock (Σ spilled + incoming <= budget,
+   re-read from the ledger — the pre-write invariant guard);
+2. payload lands in ``<name>.tmp`` and is fsynced, then atomically
+   renamed to the pool file. A crash mid-copy (the ``spill.copy``
+   failpoint's partial-write) leaves only a ``.tmp`` orphan: the pool
+   file namespace and the ledger are untouched, and the reaper deletes
+   the orphan;
+3. only after the rename does the ledger's spilled counter move — the
+   file IS the commit point, exactly like vtpu.config's tmp+rename.
+
+Fill reverses the order: ledger first (the budget frees optimistically;
+a crash between ledger and unlink leaves an orphan file the reaper
+reconciles), then the file is read and removed.
+
+Pool files are self-describing (``<token>-<pid>-<chip>-<buf>.spill``)
+so the reaper can attribute every byte without a sidecar index: a dead
+owner's files are deleted and the vmem ledger's own dead+stale reap
+clears the accounting row — the two converge without coordination.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from vtpu_manager.config import vmem as vmem_mod
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import consts
+from vtpu_manager.util.flock import FileLock
+
+log = logging.getLogger(__name__)
+
+SPILL_SUFFIX = ".spill"
+
+
+class SpillBudgetError(RuntimeError):
+    """The node's host-RAM spill budget cannot absorb this demotion."""
+
+
+def _pool_name(token: int, pid: int, host_index: int, buf_id: str) -> str:
+    return f"{token:016x}-{pid}-{host_index}-{buf_id}{SPILL_SUFFIX}"
+
+
+def _parse_pool_name(name: str) -> tuple[int, int, int, str] | None:
+    if not name.endswith(SPILL_SUFFIX):
+        return None
+    parts = name[: -len(SPILL_SUFFIX)].split("-", 3)
+    if len(parts) != 4:
+        return None
+    try:
+        return (int(parts[0], 16), int(parts[1]), int(parts[2]), parts[3])
+    except ValueError:
+        return None
+
+
+class SpillPool:
+    """One tenant-process's handle on the node-shared spill pool."""
+
+    def __init__(self, pool_dir: str = consts.SPILL_DIR,
+                 budget_bytes: int = 0,
+                 ledger: "vmem_mod.VmemLedger | None" = None,
+                 owner_token: int | None = None,
+                 pid: int | None = None):
+        self.pool_dir = pool_dir
+        self.budget_bytes = budget_bytes
+        self.ledger = ledger
+        self.owner_token = owner_token if owner_token is not None \
+            else vmem_mod.owner_token_from_env()
+        self.pid = pid if pid is not None else os.getpid()
+        os.makedirs(pool_dir, exist_ok=True)
+        # budget admission is cross-process: two spillers must not both
+        # pass the same last slice of budget (the pre-write guard)
+        self._lock = FileLock(os.path.join(pool_dir, ".budget.lock"))
+        # this process's live spilled bytes per chip (the ledger mirror)
+        self._spilled: dict[int, int] = {}
+        self.spill_events = 0
+        self.fill_events = 0
+
+    # -- demotion ------------------------------------------------------------
+
+    def spill(self, host_index: int, buf_id: str, payload: bytes) -> int:
+        """Demote one buffer to the host pool. Returns bytes moved.
+        Raises SpillBudgetError when the node budget cannot absorb it —
+        the caller's allocation then fails exactly as it would have
+        pre-vtovc (the spill arm only ever converts failures into
+        successes, never successes into failures)."""
+        nbytes = len(payload)
+        path = os.path.join(self.pool_dir, _pool_name(
+            self.owner_token, self.pid, host_index, buf_id))
+        with self._lock:
+            # pre-write invariant guard: Σ spilled (cluster-truth from
+            # the ledger, else local) + incoming must fit the budget
+            failpoints.fire("spill.budget", buf=buf_id,
+                            host_index=host_index)
+            spilled_now = (self.ledger.node_spilled_total()
+                           if self.ledger is not None
+                           else sum(self._spilled.values()))
+            if self.budget_bytes and \
+                    spilled_now + nbytes > self.budget_bytes:
+                raise SpillBudgetError(
+                    f"spill budget exhausted: {spilled_now}B live + "
+                    f"{nbytes}B > {self.budget_bytes}B")
+            tmp = f"{path}.tmp.{self.pid}"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                # the copy crash window: partial-write tears the TMP
+                # file (never the pool file), then simulated death —
+                # the ledger below is unreached, so accounting is clean
+                failpoints.fire("spill.copy", buf=buf_id, path=tmp,
+                                host_index=host_index)
+                os.fsync(f.fileno())
+            os.rename(tmp, path)      # the commit point
+            self._spilled[host_index] = \
+                self._spilled.get(host_index, 0) + nbytes
+            self.spill_events += 1
+            if self.ledger is not None:
+                self.ledger.record_spilled(
+                    self.pid, host_index,
+                    self._spilled[host_index],
+                    owner_token=self.owner_token)
+        return nbytes
+
+    # -- promotion -----------------------------------------------------------
+
+    def fill(self, host_index: int, buf_id: str) -> bytes | None:
+        """Promote one buffer back out of the host pool; None when the
+        pool holds no such buffer (already filled, or reaped)."""
+        path = os.path.join(self.pool_dir, _pool_name(
+            self.owner_token, self.pid, host_index, buf_id))
+        with self._lock:
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+            except OSError:
+                return None
+            self._spilled[host_index] = max(
+                0, self._spilled.get(host_index, 0) - len(payload))
+            self.fill_events += 1
+            if self.ledger is not None:
+                self.ledger.record_spilled(
+                    self.pid, host_index,
+                    self._spilled[host_index],
+                    owner_token=self.owner_token)
+            try:
+                os.unlink(path)
+            except OSError:
+                # orphan: the reaper reconciles (accounting already
+                # settled — an orphan only wastes host RAM, never
+                # budget, and never resurrects as a double fill
+                # because this process's _spilled no longer covers it)
+                log.warning("spill pool file %s not removed", path)
+        return payload
+
+    def spilled_bytes(self, host_index: int | None = None) -> int:
+        if host_index is None:
+            return sum(self._spilled.values())
+        return self._spilled.get(host_index, 0)
+
+    # -- LRU victim selection ------------------------------------------------
+
+    @staticmethod
+    def choose_victims(candidates: list[tuple[str, int, int]],
+                       need_bytes: int) -> list[str]:
+        """Coldest-first victim set covering ``need_bytes``.
+        ``candidates`` are (buf_id, bytes, last_touch_ns) of RESIDENT
+        buffers — the same LRU-by-last-Execute-touch order the shim
+        applies to its tracked buffers. Returns [] when the candidates
+        cannot cover the need (the caller then fails the allocation;
+        a partial eviction would thrash without helping)."""
+        if need_bytes <= 0:
+            return []
+        total = sum(b for _, b, _ in candidates)
+        if total < need_bytes:
+            return []
+        victims: list[str] = []
+        covered = 0
+        for buf_id, nbytes, _touch in sorted(candidates,
+                                             key=lambda c: (c[2], c[0])):
+            victims.append(buf_id)
+            covered += nbytes
+            if covered >= need_bytes:
+                break
+        return victims
+
+
+# ---------------------------------------------------------------------------
+# Reaping + invariants (the chaos harness's contract surface)
+# ---------------------------------------------------------------------------
+
+def reap_pool(pool_dir: str = consts.SPILL_DIR,
+              stale_s: float | None = None) -> int:
+    """Delete pool files whose owner is dead (plus torn ``.tmp``
+    orphans past the staleness window). The vmem ledger reaps the
+    matching accounting rows by its own dead+stale rule, so bytes and
+    budget converge from either side after a crash. Returns files
+    removed. Runs in the node daemon (the vmem-reaper's cadence)."""
+    if stale_s is None:
+        stale_s = vmem_mod._stale_reap_ns() / 1e9
+    removed = 0
+    try:
+        names = os.listdir(pool_dir)
+    except OSError:
+        return 0
+    now = time.time()
+    for name in names:
+        path = os.path.join(pool_dir, name)
+        if ".tmp." in name:
+            # a torn spill's leftover: the rename never happened, no
+            # accounting references it — age it out conservatively
+            try:
+                if now - os.path.getmtime(path) > stale_s:
+                    os.unlink(path)
+                    removed += 1
+            except OSError:
+                continue
+            continue
+        parsed = _parse_pool_name(name)
+        if parsed is None:
+            continue
+        _token, pid, _hidx, _buf = parsed
+        try:
+            dead = not vmem_mod._pid_alive(pid)
+            stale = now - os.path.getmtime(path) > stale_s
+        except OSError:
+            continue
+        if dead and stale:
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                log.warning("could not reap spill file %s", path)
+    return removed
+
+
+def pool_totals(pool_dir: str = consts.SPILL_DIR) -> tuple[int, int]:
+    """(files, bytes) currently in the pool — the rollup/vtpu-smi view
+    and the reconciliation side of the ledger's spilled sum."""
+    files = total = 0
+    try:
+        names = os.listdir(pool_dir)
+    except OSError:
+        return 0, 0
+    for name in names:
+        if _parse_pool_name(name) is None:
+            continue
+        try:
+            total += os.path.getsize(os.path.join(pool_dir, name))
+            files += 1
+        except OSError:
+            continue
+    return files, total
+
+
+def assert_node_invariants(ledger: "vmem_mod.VmemLedger",
+                           chip_capacity: dict[int, int],
+                           budget_bytes: int) -> None:
+    """The per-node safety contract, checked pre-write by spill() and
+    at every chaos round: Σ resident physical HBM per chip <= chip
+    capacity, and Σ spilled bytes <= the node spill budget. Raises
+    AssertionError with the offending sums."""
+    for host_index, capacity in chip_capacity.items():
+        resident = ledger.device_total(host_index)
+        assert resident <= capacity, (
+            f"chip {host_index}: resident {resident}B > physical "
+            f"{capacity}B — the spill tier failed to keep residency "
+            f"under the physical cap")
+    if budget_bytes:
+        spilled = ledger.node_spilled_total()
+        assert spilled <= budget_bytes, (
+            f"node spill pool {spilled}B > budget {budget_bytes}B")

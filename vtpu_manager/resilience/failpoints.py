@@ -108,6 +108,16 @@ SITES: dict[str, str] = {
                     "configs (crash = plugin restart mid-revoke: the "
                     "start() rule revokes carried leases and restores "
                     "base truth before new market activity)",
+    "spill.copy": "overcommit/spill.py SpillPool.spill, after the tmp "
+                  "pool file is written and before fsync+rename "
+                  "(partial-write = a torn spill mid-copy: only a .tmp "
+                  "orphan exists, the pool namespace and the vmem "
+                  "ledger are untouched, the reaper deletes it)",
+    "spill.budget": "overcommit/spill.py SpillPool.spill, at the "
+                    "pre-write budget guard (error = budget exhausted: "
+                    "the caller's allocation fails exactly as it would "
+                    "have pre-vtovc — the spill arm only ever converts "
+                    "failures into successes)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

@@ -50,6 +50,7 @@ from vtpu_manager.device import types as dt
 from vtpu_manager.compilecache import antistorm
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
+from vtpu_manager.overcommit import ratio as oc_mod
 from vtpu_manager.resilience import failpoints
 from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.scheduler import gang, reason as R
@@ -105,9 +106,21 @@ class FilterPredicate:
                  fence=None, shard_selector=None,
                  anti_storm: bool = False,
                  utilization_hint: bool = False,
-                 quota_market: bool = False):
+                 quota_market: bool = False,
+                 hbm_overcommit: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtovc (HBMOvercommit gate; default off = byte-identical
+        # placement in BOTH data paths): admit the memory axis against
+        # VIRTUAL capacity — physical × the node's published per-class
+        # ratio (overcommit/ratio.py codec; no/stale signal = 1.0 = the
+        # physical gate) — and subtract a spill-rate penalty so nodes
+        # actively servicing host-tier spills repel new pods before
+        # they thrash harder. Decoded per-candidate on the TTL path, at
+        # event-apply on the snapshot path (NodeEntry.overcommit); the
+        # virtual/physical split and the spill term ride the vtexplain
+        # candidate record. Rides filter_kwargs so vtha shards inherit.
+        self.hbm_overcommit = hbm_overcommit
         # vtqm (QuotaMarket gate; default off = byte-identical scores):
         # the reclaimable-headroom input both paths have decoded
         # observe-only since PR 8 becomes a REAL score term — but only
@@ -618,6 +631,13 @@ class FilterPredicate:
             from vtpu_manager.quota import workload_class_of
             hr_term = (workload_class_of(pod)
                        == consts.WORKLOAD_CLASS_LATENCY_CRITICAL)
+        # vtovc: the pod's class selects which published ratio admits it
+        # (one annotation read per pass; gate off => "" is never used
+        # because no overcommit object is ever decoded)
+        oc_class = ""
+        if self.hbm_overcommit:
+            from vtpu_manager.quota import workload_class_of
+            oc_class = workload_class_of(pod)
         if snap is not None:
             # walk the snapshot's incrementally maintained capacity rank
             # — no per-pass O(nodes) ranking, no decode
@@ -625,13 +645,13 @@ class FilterPredicate:
                 snap, req, candidates, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
                 reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
-                explain_b=explain_b, hr_term=hr_term)
+                explain_b=explain_b, hr_term=hr_term, oc_class=oc_class)
         else:
             scored = self._ttl_scored(
                 req, candidates, by_node, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
                 reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
-                explain_b=explain_b, hr_term=hr_term)
+                explain_b=explain_b, hr_term=hr_term, oc_class=oc_class)
 
         if not scored:
             result.error = reasons.summary() or "no schedulable vtpu node"
@@ -707,8 +727,8 @@ class FilterPredicate:
                     gang_domains: set, gang_siblings: list,
                     prefer_origin, result: FilterResult, reasons,
                     now: float, pod_fp: str = "", pod_uid: str = "",
-                    explain_b=None, hr_term: bool = False
-                    ) -> list[ScoredNode]:
+                    explain_b=None, hr_term: bool = False,
+                    oc_class: str = "") -> list[ScoredNode]:
         """TTL-path ranking: gate + rank every surviving node on fast
         free totals (memoized registry totals minus claim sums — no
         DeviceUsage materialized), then build the full usage view lazily,
@@ -716,6 +736,7 @@ class FilterPredicate:
         ranked = []
         reg_ann = consts.node_device_register_annotation()
         hr_ann = consts.node_reclaimable_headroom_annotation()
+        oc_ann = consts.node_overcommit_annotation()
         now_visible: set[str] = set()
         req_number, req_cores, req_memory = (
             req.total_number(), req.total_cores(), req.total_memory())
@@ -756,8 +777,24 @@ class FilterPredicate:
             free_number, free_cores, free_memory = dt.fast_free_totals(
                 registry,
                 [c for _, c in counted] + [e.claims for _, e in assumed])
+            # vtovc: the memory axis may admit against VIRTUAL capacity
+            # — physical free plus (ratio-1)×healthy HBM, a safe
+            # overestimate the allocator below re-validates against the
+            # exactly-scaled per-chip registry. Decoded per candidate
+            # (the ISSUE'd TTL-path discipline, same cost class as the
+            # pressure parse); gate off = no parse, bonus 0.
+            overcommit = None
+            oc_ratio = 1.0
+            if self.hbm_overcommit:
+                overcommit = oc_mod.parse_overcommit(
+                    (meta.get("annotations") or {}).get(oc_ann), now=now)
+                oc_ratio = oc_mod.ratio_for_class(overcommit, oc_class,
+                                                  now=now)
+            mem_bonus = (int((oc_ratio - 1.0)
+                             * registry.healthy_totals()[2])
+                         if oc_ratio > 1.0 else 0)
             if (free_number < req_number or free_cores < req_cores
-                    or free_memory < req_memory):
+                    or free_memory + mem_bonus < req_memory):
                 result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
                 if explain_b is not None:
@@ -786,7 +823,7 @@ class FilterPredicate:
                       if explain_b is not None or hr_term else None)
             ranked.append((free_cores + (free_memory >> 24) + free_number,
                            name, registry, counted, assumed, pressure,
-                           storm, hr_raw))
+                           storm, hr_raw, overcommit, oc_ratio))
         if now_visible:
             self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
@@ -804,7 +841,8 @@ class FilterPredicate:
         # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
         for rank, (_, name, registry, counted, assumed, pressure,
-                   storm, hr_raw) in enumerate(ranked):
+                   storm, hr_raw, overcommit, oc_ratio) \
+                in enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
             self._allocate_node(name, registry, counted, assumed, req,
@@ -814,7 +852,9 @@ class FilterPredicate:
                                 storm_recent=storm,
                                 headroom=util_headroom.parse_headroom(
                                     hr_raw) if hr_raw else None,
-                                explain_b=explain_b, hr_term=hr_term)
+                                explain_b=explain_b, hr_term=hr_term,
+                                overcommit=overcommit,
+                                oc_ratio=oc_ratio)
         return scored
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
@@ -823,8 +863,8 @@ class FilterPredicate:
                          gang_siblings: list, prefer_origin,
                          result: FilterResult, reasons,
                          now: float, pod_fp: str = "", pod_uid: str = "",
-                         explain_b=None, hr_term: bool = False
-                         ) -> list[ScoredNode]:
+                         explain_b=None, hr_term: bool = False,
+                         oc_class: str = "") -> list[ScoredNode]:
         """Snapshot-path candidate walk. The capacity rank is maintained
         by the snapshot O(log n) per event, so the pass walks its head in
         policy order (ascending for binpack, descending for spread) and
@@ -889,8 +929,20 @@ class FilterPredicate:
                     entry, [e.claims for _, e in assumed], now)
             else:
                 free = entry.base_free
+            # vtovc: virtual memory admission — the ratio was decoded
+            # at event-apply time (NodeEntry.overcommit); class lookup
+            # + staleness re-judgement happen per visit, so a dead
+            # publisher decays to the physical gate without any event
+            overcommit = entry.overcommit if self.hbm_overcommit \
+                else None
+            oc_ratio = (oc_mod.ratio_for_class(overcommit, oc_class,
+                                               now=now)
+                        if overcommit is not None else 1.0)
+            mem_bonus = (int((oc_ratio - 1.0)
+                             * entry.registry.healthy_totals()[2])
+                         if oc_ratio > 1.0 else 0)
             if (free[0] < req_number or free[1] < req_cores
-                    or free[2] < req_memory):
+                    or free[2] + mem_bonus < req_memory):
                 result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
                 reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
                 if explain_b is not None:
@@ -912,7 +964,9 @@ class FilterPredicate:
                                 headroom=entry.headroom
                                 if explain_b is not None or hr_term
                                 else None,
-                                explain_b=explain_b, hr_term=hr_term)
+                                explain_b=explain_b, hr_term=hr_term,
+                                overcommit=overcommit,
+                                oc_ratio=oc_ratio)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -950,13 +1004,22 @@ class FilterPredicate:
                        result: FilterResult, reasons,
                        pressure=None, storm_fp: str = "",
                        storm_recent=(), headroom=None,
-                       explain_b=None, hr_term: bool = False) -> None:
+                       explain_b=None, hr_term: bool = False,
+                       overcommit=None, oc_ratio: float = 1.0) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
         drift between them (and so the vtexplain breakdown is assembled
         HERE, where the actual score arithmetic runs: the record carries
         the exact values applied, not a re-derivation that could
         diverge)."""
+        # vtovc: admission runs against the VIRTUAL registry — every
+        # healthy chip's HBM scaled by the pod-class ratio (memoized
+        # copy; ratio 1.0 returns the physical registry object itself,
+        # so the gate-off pass is byte-identical). The allocator's
+        # per-chip placement therefore respects the virtual per-chip
+        # caps exactly, not just a node-total approximation.
+        if oc_ratio > 1.0:
+            registry = oc_mod.virtual_registry(registry, oc_ratio)
         # the gate already decoded/filtered everything this needs —
         # build the usage view from its outputs, never recompute
         info = NodeInfo.from_registry(name, registry, counted)
@@ -1000,6 +1063,15 @@ class FilterPredicate:
         if storm_fp:
             storm_pen = antistorm.storm_penalty(storm_fp, storm_recent)
             score -= storm_pen
+        # vtovc thrash backoff: a node actively servicing host-tier
+        # spills repels new pods — soft like pressure/storm (reorders
+        # fits, never vetoes one; a thrashing node with the only free
+        # chips still schedules), staleness re-judged at use time so a
+        # dead publisher's last panic decays to no penalty.
+        spill_pen = 0.0
+        if overcommit is not None:
+            spill_pen = oc_mod.spill_penalty(overcommit)
+            score -= spill_pen
         gang_bonus = 0.0
         if gang_domains and registry.mesh_domain in gang_domains:
             # keeping the gang on one multi-host slice outweighs any
@@ -1020,16 +1092,19 @@ class FilterPredicate:
         if explain_b is not None:
             # the audit record gets the exact terms just applied, plus
             # the raw headroom input — total == base - pressure - storm
-            # + gang_bonus + headroom_term holds by construction
+            # - spill + gang_bonus + headroom_term holds by construction
             # (headroom_term is 0.0 unless the QuotaMarket gate scored
-            # it) and is asserted end-to-end by test_explain/test_quota
+            # it, spill 0.0 unless HBMOvercommit did) and is asserted
+            # end-to-end by test_explain/test_quota/test_overcommit;
+            # virt_ratio records the virtual/physical admission split
             explain_b.candidate(
                 name, base=base, pressure=pressure_pen, storm=storm_pen,
                 gang_bonus=gang_bonus,
                 headroom_input=util_headroom.headroom_score_input(
                     headroom),
                 topology=alloc_result.topology_kind, total=score,
-                headroom_term=headroom_term)
+                headroom_term=headroom_term, spill=spill_pen,
+                virt_ratio=oc_ratio)
         scored.append(ScoredNode(name, score, alloc_result))
 
     # -- commit: annotation patch is the only cross-process channel ---------

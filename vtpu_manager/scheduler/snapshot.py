@@ -52,6 +52,7 @@ from vtpu_manager.device.claims import container_kinds, effective_claims
 from vtpu_manager.resilience import failpoints
 from vtpu_manager.resilience.policy import (CircuitBreaker,
                                             CircuitOpenError, RetryPolicy)
+from vtpu_manager.overcommit import ratio as oc_mod
 from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
 from vtpu_manager.util.gangname import resolve_gang_name
@@ -69,12 +70,14 @@ class NodeEntry:
 
     __slots__ = ("name", "node", "labels", "registry", "resident",
                  "counted", "conditional", "base_free", "rank_key",
-                 "generation", "pressure", "fp_recent", "headroom")
+                 "generation", "pressure", "fp_recent", "headroom",
+                 "overcommit")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
                  base_free: tuple, rank_key: int, generation: int,
-                 pressure=None, fp_recent=(), headroom=None):
+                 pressure=None, fp_recent=(), headroom=None,
+                 overcommit=None):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -89,6 +92,11 @@ class NodeEntry:
         # this PR (logged + counted, never scored) and staleness is
         # re-judged at use time so a dead publisher decays
         self.headroom = headroom
+        # vtovc overcommit policy rollup (NodeOvercommit | None),
+        # decoded at event apply/relist like pressure; the filter
+        # re-judges staleness + class at every visit, so a dead policy
+        # publisher decays to the physical admission gate
+        self.overcommit = overcommit
         # vtcc anti-storm: residents' (program_fingerprint, placed_ts)
         # pairs inside the storm window at build time; decay is
         # re-judged at penalty time (a quiet node emits no events)
@@ -245,6 +253,7 @@ class ClusterSnapshot:
         self._entries: dict[str, NodeEntry] = {}
         self._node_pressure: dict[str, object] = {}   # name -> NodePressure
         self._node_headroom: dict[str, object] = {}   # name -> NodeHeadroom
+        self._node_overcommit: dict[str, object] = {}  # -> NodeOvercommit
         self._pods: dict[str, dict] = {}              # uid -> pod (ALL pods)
         self._pod_node: dict[str, str] = {}           # uid -> nodeName | ""
         self._pod_class: dict[str, tuple] = {}        # uid -> (claims, expiry)
@@ -500,6 +509,7 @@ class ClusterSnapshot:
                     self._entries = entries
                     self._node_pressure.pop(name, None)
                     self._node_headroom.pop(name, None)
+                    self._node_overcommit.pop(name, None)
                     self._publish_rank_locked(name, None)
                     self.generation += 1
             return
@@ -514,10 +524,13 @@ class ClusterSnapshot:
             anns.get(consts.node_pressure_annotation()))
         node_headroom = util_headroom.parse_headroom(
             anns.get(consts.node_reclaimable_headroom_annotation()))
+        node_overcommit = oc_mod.parse_overcommit(
+            anns.get(consts.node_overcommit_annotation()))
         labels = meta.get("labels") or {}
         with self._lock:
             self._node_pressure[name] = node_pressure
             self._node_headroom[name] = node_headroom
+            self._node_overcommit[name] = node_overcommit
             self.generation += 1
             entry = self._build_entry_locked(name, node, labels, registry)
             if name in self._entries:
@@ -725,7 +738,8 @@ class ClusterSnapshot:
                          pressure=self._node_pressure.get(name),
                          fp_recent=tuple(antistorm.recent_from_pods(
                              resident.values(), time.time())),
-                         headroom=self._node_headroom.get(name))
+                         headroom=self._node_headroom.get(name),
+                         overcommit=self._node_overcommit.get(name))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -790,6 +804,7 @@ class ClusterSnapshot:
             self._all_pods_cache = None
             self._node_pressure = {}
             self._node_headroom = {}
+            self._node_overcommit = {}
             entries: dict[str, NodeEntry] = {}
             for node in nodes:
                 meta = node.get("metadata") or {}
@@ -804,6 +819,8 @@ class ClusterSnapshot:
                     anns.get(consts.node_pressure_annotation()))
                 self._node_headroom[name] = util_headroom.parse_headroom(
                     anns.get(consts.node_reclaimable_headroom_annotation()))
+                self._node_overcommit[name] = oc_mod.parse_overcommit(
+                    anns.get(consts.node_overcommit_annotation()))
                 entries[name] = self._build_entry_locked(
                     name, node, meta.get("labels") or {}, registry)
             self._entries = entries
@@ -883,6 +900,7 @@ class ClusterSnapshot:
                 entry.name, entry.node, entry.labels, entry.registry,
                 entry.resident, entry.counted, live, entry.base_free,
                 rank_key, self.generation, pressure=entry.pressure,
-                fp_recent=entry.fp_recent, headroom=entry.headroom)
+                fp_recent=entry.fp_recent, headroom=entry.headroom,
+                overcommit=entry.overcommit)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

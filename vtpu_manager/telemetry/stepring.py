@@ -39,7 +39,14 @@ from dataclasses import dataclass
 from vtpu_manager.util.flock import byte_range_write_lock
 
 MAGIC = 0x54535456          # "VTST" little-endian
-VERSION = 1
+# v2 (vtovc): the record grew a spill block — spilled_bytes (the
+# tenant's host-pool footprint at step end, a gauge) and
+# spill/fill_events (tier transitions since the previous record) — the
+# channel that carries the shim's spill activity to the collector's
+# vtpu_node_spill_* series and the scheduler's spill-rate pressure
+# input. Strict version check, the config-ABI rule: rings are recreated
+# per container and plugin + shim + monitor ship together per node.
+VERSION = 2
 RING_CAPACITY = 256          # records; ~memory of the last 256 steps
 TRACE_ID_LEN = 48            # same bound as vtpu_config's pod_uid
 
@@ -53,10 +60,11 @@ assert HEADER_SIZE == 80
 
 # record: seq u64 (per-record seqlock), index u64, start_mono_ns u64,
 # duration_ns u64, throttle_wait_ns u64, hbm_highwater_bytes u64,
-# flags u32, pad u32
-_RECORD_FMT = "<QQQQQQIi"
+# flags u32, pad u32, spilled_bytes u64, spill_events u32,
+# fill_events u32 (v2 spill block, vtovc)
+_RECORD_FMT = "<QQQQQQIiQII"
 RECORD_SIZE = struct.calcsize(_RECORD_FMT)
-assert RECORD_SIZE == 56
+assert RECORD_SIZE == 72
 
 FILE_SIZE = HEADER_SIZE + RING_CAPACITY * RECORD_SIZE
 
@@ -78,6 +86,9 @@ class StepRecord:
     throttle_wait_ns: int = 0
     hbm_highwater_bytes: int = 0
     flags: int = 0
+    spilled_bytes: int = 0       # host-pool footprint at step end (gauge)
+    spill_events: int = 0        # HBM→host demotions since last record
+    fill_events: int = 0         # host→HBM promotions since last record
 
     @property
     def compiled(self) -> bool:
@@ -140,7 +151,8 @@ class StepRingWriter:
 
     def record(self, duration_ns: int, throttle_wait_ns: int = 0,
                hbm_highwater_bytes: int = 0, compiled: bool = False,
-               start_mono_ns: int | None = None) -> None:
+               start_mono_ns: int | None = None, spilled_bytes: int = 0,
+               spill_events: int = 0, fill_events: int = 0) -> None:
         """Publish one step record (the hot path). Seqlock bracket per
         the shared-mmap protocol: odd seq first, payload, even seq last
         — ``seq | 1`` so a crashed writer's odd leftover can't invert
@@ -155,7 +167,8 @@ class StepRingWriter:
         struct.pack_into(_RECORD_FMT, self._mm, off, wseq, index,
                          start_mono_ns, duration_ns, throttle_wait_ns,
                          hbm_highwater_bytes,
-                         FLAG_COMPILE if compiled else 0, 0)
+                         FLAG_COMPILE if compiled else 0, 0,
+                         spilled_bytes, spill_events, fill_events)
         struct.pack_into("<Q", self._mm, off, wseq + 1)  # even: stable
         self._writes = index + 1
         struct.pack_into("<Q", self._mm, _WRITES_OFFSET, self._writes)
@@ -239,14 +252,15 @@ class StepRingReader:
                 time.sleep(0.0002)
                 continue
             (_, rec_index, start_ns, dur_ns, wait_ns, hbm, flags,
-             _pad) = struct.unpack_from(_RECORD_FMT, self._mm, off)
+             _pad, spilled, spills, fills) = struct.unpack_from(
+                 _RECORD_FMT, self._mm, off)
             seq2, = struct.unpack_from("<Q", self._mm, off)
             if seq1 != seq2:
                 continue
             if rec_index != index:
                 return None     # lapped: slot already holds a newer step
             return StepRecord(rec_index, start_ns, dur_ns, wait_ns, hbm,
-                              flags)
+                              flags, spilled, spills, fills)
         return None
 
     def poll(self, cursor: int) -> tuple[list[StepRecord], int, int]:
@@ -288,4 +302,5 @@ HEADER_OFFSETS = {
 RECORD_OFFSETS = {
     "seq": 0, "index": 8, "start_mono_ns": 16, "duration_ns": 24,
     "throttle_wait_ns": 32, "hbm_highwater_bytes": 40, "flags": 48,
+    "spilled_bytes": 56, "spill_events": 64, "fill_events": 68,
 }

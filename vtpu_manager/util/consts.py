@@ -271,6 +271,18 @@ def node_quota_lease_annotation() -> str:
     return _ann("node-quota-leases")
 
 
+def node_overcommit_annotation() -> str:
+    """vtovc per-node oversubscription policy (HBMOvercommit gate):
+    per-workload-class safe HBM ratios plus the node's measured
+    spill-rate, published by the device-plugin daemon's policy engine
+    (overcommit/policy.py) over the registry channel —
+    ``"<class>:<ratio>;...|<spill_frac>:<spilled_bytes>@<ts>"``. Same
+    staleness-by-timestamp family as the pressure/headroom codecs: a
+    dead publisher decays to ratio 1.0 / no spill signal, never pins a
+    stale oversubscription claim the scheduler would admit against."""
+    return _ann("node-overcommit")
+
+
 def node_reclaimable_headroom_annotation() -> str:
     """vtuse reclaimable-headroom rollup (same codec family as the
     pressure annotation, utilization/headroom.py): per-chip
@@ -412,6 +424,16 @@ COMPILE_CACHE_DIR = f"{MANAGER_BASE_DIR}/{COMPILE_CACHE_SUBDIR}"
 LOCK_DIR = "/tmp/.vtpu_lock"                        # per-device OFD locks
 VMEM_DIR = "/tmp/.vmem_node"
 VMEM_NODE_CONFIG = f"{VMEM_DIR}/vmem_node.config"
+
+# vtovc host-RAM spill pool: ONE node-shared dir (mounted read-write
+# into overcommitted containers like the lock/vmem dirs) holding each
+# tenant's demoted buffers as pool files; Σ file bytes is bounded by
+# the per-node spill budget accounted in the vmem ledger.
+SPILL_DIR = f"{VMEM_DIR}/spill"
+# "true" + pool dir: the shim's spill tier armed (injected by Allocate
+# alongside the v4 config fields; the env mirrors the config switch the
+# same way the compile-cache pair does)
+ENV_SPILL_POOL_DIR = "VTPU_SPILL_POOL_DIR"
 PIDS_CONFIG_NAME = "pids.config"
 
 DEVICES_JSON_NAME = "devices.json"                  # plugin-local record

@@ -31,6 +31,7 @@ COMPILE_CACHE = "CompileCache"          # vtcc node-local compile cache
 UTILIZATION_LEDGER = "UtilizationLedger"  # vtuse per-tenant utilization ledger
 DECISION_EXPLAIN = "DecisionExplain"    # vtexplain per-decision audit trail
 QUOTA_MARKET = "QuotaMarket"            # vtqm elastic quota market
+HBM_OVERCOMMIT = "HBMOvercommit"        # vtovc virtual HBM + host-spill tier
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -110,6 +111,22 @@ _KNOWN = {
     # headroom signal becomes a REAL score term for latency-critical
     # pods.
     QUOTA_MARKET: False,
+    # Default off: byte-identical — no overcommit annotation published,
+    # configs carry virtual_hbm_bytes=0/spill_budget_bytes=0 (the v3
+    # zeros), no spill pool exists, no vtpu_node_spill_* series, and
+    # placement is byte-identical in BOTH scheduler data paths (parity
+    # asserted gate-on-vs-off for pods on non-overcommitted nodes). On,
+    # the node's policy engine (vtpu_manager/overcommit/) computes
+    # per-workload-class safe oversubscription ratios from vtuse's
+    # step-ring HBM high-water percentiles (confidence-gated,
+    # staleness-decayed — no signal means ratio 1.0), both scheduler
+    # paths admit against physical × ratio with the virtual/physical
+    # split audited in vtexplain, a spill-rate pressure term backs the
+    # scheduler off thrashing nodes, and the C++ shim's alloc-path cap
+    # check gains a spill arm: cold buffers (LRU by last-Execute touch)
+    # demote to a host-RAM pool bounded by the per-node spill budget
+    # accounted in the vmem ledger.
+    HBM_OVERCOMMIT: False,
 }
 
 

@@ -52,13 +52,26 @@ class ChipHeadroom:
 class NodeHeadroom:
     chips: dict[int, ChipHeadroom] = field(default_factory=dict)
     ts: float = 0.0
+    # vtovc satellite (ROADMAP item a, class-mix-aware packing): the
+    # resident workload-class mix — distinct tenants per class key
+    # ("lat"/"thr"/"def") — so a later headroom score term can prefer
+    # nodes with lender-class counterparties. Decoded on both scheduler
+    # paths OBSERVE-ONLY (it rides this object onto the NodeEntry);
+    # no score reads it yet.
+    class_mix: dict[str, int] = field(default_factory=dict)
 
     def encode(self) -> str:
-        body = ";".join(
+        segs = []
+        if self.class_mix:
+            # leading typed segment; emitted only when non-empty so a
+            # mix-less publisher's wire bytes are unchanged
+            segs.append("mix=" + ",".join(
+                f"{k}:{n}" for k, n in sorted(self.class_mix.items())))
+        segs += [
             f"{idx}:{ch.alloc_core_pct:.1f}:{ch.used_core_pct:.1f}:"
             f"{ch.reclaim_core_pct:.1f}:{ch.reclaim_hbm_bytes}"
-            for idx, ch in sorted(self.chips.items()))
-        return f"{body}@{self.ts:.3f}"
+            for idx, ch in sorted(self.chips.items())]
+        return f"{';'.join(segs)}@{self.ts:.3f}"
 
     def total_reclaim_core_pct(self) -> float:
         return sum(c.reclaim_core_pct for c in self.chips.values())
@@ -84,8 +97,19 @@ def parse_headroom(raw: str | None, now: float | None = None,
     if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
         return None
     chips: dict[int, ChipHeadroom] = {}
+    class_mix: dict[str, int] = {}
     for seg in body.split(";"):
         if not seg:
+            continue
+        if seg.startswith("mix="):
+            # class-mix segment (vtovc satellite); garbage inside it
+            # invalidates the whole rollup like any other segment
+            for pair in seg[4:].split(","):
+                key, _, n_raw = pair.partition(":")
+                try:
+                    class_mix[key] = max(int(n_raw), 0)
+                except (TypeError, ValueError):
+                    return None
             continue
         parts = seg.split(":")
         if len(parts) != 5:
@@ -106,7 +130,7 @@ def parse_headroom(raw: str | None, now: float | None = None,
             used_core_pct=max(used, 0.0),
             reclaim_core_pct=min(max(reclaim, 0.0), 100.0 * 64),
             reclaim_hbm_bytes=max(hbm, 0))
-    return NodeHeadroom(chips=chips, ts=ts)
+    return NodeHeadroom(chips=chips, ts=ts, class_mix=class_mix)
 
 
 def headroom_is_fresh(hr: "NodeHeadroom | None",

@@ -81,7 +81,8 @@ class _TenantChip:
     __slots__ = ("pod_uid", "container", "pod_name", "pod_namespace",
                  "trace_id", "host_index", "uuid", "alloc_core_pct",
                  "alloc_hbm", "used_ewma", "used_var", "wait_frac",
-                 "hbm_highwater", "last_sample_wall", "samples")
+                 "hbm_highwater", "last_sample_wall", "samples",
+                 "workload_class")
 
     def __init__(self, pod_uid: str, container: str, host_index: int,
                  uuid: str):
@@ -100,6 +101,10 @@ class _TenantChip:
         self.hbm_highwater = 0
         self.last_sample_wall = 0.0
         self.samples = 0
+        # vtqm ABI class (vtovc reads it): WORKLOAD_CLASS_* int from the
+        # tenant's config — keys the overcommit policy's per-class
+        # ratios and the headroom annotation's class mix
+        self.workload_class = 0
 
     def observe_used(self, used_pct: float, now_wall: float) -> None:
         used_pct = min(max(used_pct, 0.0), 100.0)
@@ -192,6 +197,15 @@ class UtilizationLedger:
         self.folds_dropped_total = 0
         self.last_fold_s = 0.0
         self.last_fold_wall = 0.0
+        # vtovc: per-ring spill activity from the v2 step records —
+        # (steps, spilling_steps, spilled_bytes_gauge, wall_ts); the
+        # node spill signal the overcommit policy publishes — plus the
+        # cumulative event counters the collector's vtpu_node_spill_*
+        # series export
+        self._ring_spill: dict[tuple[str, str],
+                               tuple[int, int, int, float]] = {}
+        self.spill_events_total = 0
+        self.fill_events_total = 0
 
     # -- discovery (same dir shapes as the collector's config join) ---------
 
@@ -271,6 +285,7 @@ class UtilizationLedger:
                 state.pod_namespace = cfg.pod_namespace
                 state.alloc_core_pct = float(dev.hard_core)
                 state.alloc_hbm = int(dev.total_memory)
+                state.workload_class = int(cfg.workload_class)
         # a removed tenant's rows go with it (same lifecycle as the
         # per-container limit gauges — the reaper owns stale dirs)
         for key in list(self._states):
@@ -279,6 +294,7 @@ class UtilizationLedger:
         for tkey in list(self._cursors):
             if tkey not in seen_rings:
                 del self._cursors[tkey]
+                self._ring_spill.pop(tkey, None)
 
         tc_util = self._tc_util_by_token()
 
@@ -340,6 +356,17 @@ class UtilizationLedger:
             finally:
                 reader.close()
 
+        if records:
+            # vtovc spill signal: steps that paid a tier transition this
+            # window + the footprint gauge off the newest record; a ring
+            # gone quiet keeps its last value and ages out by wall ts
+            spilling = sum(1 for r in records
+                           if r.spill_events or r.fill_events)
+            self._ring_spill[tkey] = (len(records), spilling,
+                                      records[-1].spilled_bytes, now_wall)
+            self.spill_events_total += sum(r.spill_events
+                                           for r in records)
+            self.fill_events_total += sum(r.fill_events for r in records)
         window_s = (now_mono - cur.last_poll_monotonic
                     if cur.last_poll_monotonic is not None else 0.0)
         dur_sum = sum(r.duration_ns for r in records) / 1e9
@@ -376,6 +403,64 @@ class UtilizationLedger:
                     state.hbm_highwater, int(hbm_hw * hbm_share))
         cur.last_poll_monotonic = now_mono
         return 0
+
+    # -- vtovc policy inputs -------------------------------------------------
+
+    _CLASS_KEYS = {1: "lat", 2: "thr"}     # ABI ints -> wire keys
+
+    def hbm_fraction_samples(self, now_wall: float | None = None
+                             ) -> dict[str, list[tuple[float, float]]]:
+        """Per workload class, (highwater/allocated, confidence) per
+        sampled tenant×chip — the overcommit policy's percentile input.
+        Confidence carries the staleness decay, so the policy's
+        min-confidence gate decays a dark class back to ratio 1.0."""
+        now_wall = time.time() if now_wall is None else now_wall
+        out: dict[str, list[tuple[float, float]]] = {}
+        for s in self._states.values():
+            if s.alloc_hbm <= 0 or not s.samples:
+                continue
+            key = self._CLASS_KEYS.get(s.workload_class, "def")
+            out.setdefault(key, []).append(
+                (min(s.hbm_highwater / s.alloc_hbm, 1.0),
+                 s.confidence(now_wall)))
+        return out
+
+    def node_spill_signal(self, now_wall: float | None = None
+                          ) -> tuple[float, int]:
+        """(spill_frac, spilled_bytes) across the node's rings:
+        fraction of recent steps that paid a spill/fill plus the live
+        host-pool footprint sum — the thrash signal the scheduler's
+        spill-rate pressure term reads. Rings silent past the staleness
+        budget drop out (a dead writer must not pin a thrash claim)."""
+        now_wall = time.time() if now_wall is None else now_wall
+        steps = spilling = spilled = 0
+        for n, spill_n, gauge, ts in self._ring_spill.values():
+            if now_wall - ts > STALENESS_S:
+                continue
+            steps += n
+            spilling += spill_n
+            spilled += gauge
+        frac = spilling / steps if steps else 0.0
+        return min(max(frac, 0.0), 1.0), spilled
+
+    def class_mix(self) -> dict[str, int]:
+        """Distinct resident CLASSIFIED tenants per workload-class key
+        — the headroom annotation's mix segment (ROADMAP item a: lets a
+        later score term prefer nodes with lender-class
+        counterparties). Unclassified tenants are deliberately absent:
+        they are never market counterparties (they neither lend nor
+        borrow), and omitting them keeps the annotation's wire bytes
+        unchanged on every deployment that stamps no classes — a
+        pre-mix parser rejects the whole rollup on an unknown segment,
+        so the mix must only appear where class-aware components (which
+        ship with the new codec) are in play."""
+        seen: dict[str, set] = {}
+        for s in self._states.values():
+            key = self._CLASS_KEYS.get(s.workload_class)
+            if key is None:
+                continue
+            seen.setdefault(key, set()).add((s.pod_uid, s.container))
+        return {k: len(v) for k, v in seen.items()}
 
     # -- outputs -------------------------------------------------------------
 
@@ -426,7 +511,8 @@ class UtilizationLedger:
                 used_core_pct=row["used_core_pct"],
                 reclaim_core_pct=row["reclaim_core_pct"],
                 reclaim_hbm_bytes=row["reclaim_hbm_bytes"])
-        return NodeHeadroom(chips=chips, ts=now_wall)
+        return NodeHeadroom(chips=chips, ts=now_wall,
+                            class_mix=self.class_mix())
 
     def to_wire(self, now_wall: float | None = None) -> dict:
         now_wall = time.time() if now_wall is None else now_wall

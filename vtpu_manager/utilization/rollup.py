@@ -41,7 +41,8 @@ class ClusterRollup:
     def __init__(self, ledger: UtilizationLedger, client=None,
                  cache_root: str | None = None,
                  fold_budget_s: float | None = None,
-                 quota_dir: str | None = None):
+                 quota_dir: str | None = None,
+                 overcommit: bool = False):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
@@ -49,6 +50,10 @@ class ClusterRollup:
         # ledger. None (gate off) = the document carries no lease
         # fields at all — byte-identical /utilization
         self.quota_dir = quota_dir
+        # vtovc (HBMOvercommit gate): False = the document carries no
+        # overcommit/spill fields at all — byte-identical /utilization
+        # (the vtqm pattern, asserted by test_overcommit)
+        self.overcommit = overcommit
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -64,6 +69,11 @@ class ClusterRollup:
         errors: list[str] = []
         if self.client is None:
             return rows, errors
+        # vtovc: ONE vmem-ledger scan per collect for the local node's
+        # per-chip SPILL column (the PR-10 one-generation rule — not
+        # one open+mmap+scan per chip)
+        local_spilled = self._local_spilled_by_chip() \
+            if self.overcommit else {}
         try:
             nodes = self.client.list_nodes()
         except Exception as e:  # noqa: BLE001 — the rollup degrades to
@@ -75,6 +85,7 @@ class ClusterRollup:
         reg_ann = consts.node_device_register_annotation()
         hr_ann = consts.node_reclaimable_headroom_annotation()
         pr_ann = consts.node_pressure_annotation()
+        oc_ann = consts.node_overcommit_annotation()
         for node in nodes:
             meta = node.get("metadata") or {}
             anns = meta.get("annotations") or {}
@@ -83,12 +94,17 @@ class ClusterRollup:
             headroom = hr_mod.parse_headroom(anns.get(hr_ann), now=now)
             pressure = tel_pressure.parse_pressure(anns.get(pr_ann),
                                                    now=now)
+            overcommit = None
+            if self.overcommit:
+                from vtpu_manager.overcommit import ratio as oc_mod
+                overcommit = oc_mod.parse_overcommit(anns.get(oc_ann),
+                                                     now=now)
             chips = []
             if registry is not None:
                 for chip in registry.chips:
                     ch = headroom.chips.get(chip.index) \
                         if headroom else None
-                    chips.append({
+                    row = {
                         "index": chip.index, "uuid": chip.uuid,
                         "memory_bytes": chip.memory,
                         "split_count": chip.split_count,
@@ -101,7 +117,22 @@ class ClusterRollup:
                             ch.reclaim_core_pct if ch else None,
                         "reclaim_hbm_bytes":
                             ch.reclaim_hbm_bytes if ch else None,
-                    })
+                    }
+                    if self.overcommit:
+                        # vtpu-smi's VIRT column: the chip's capacity
+                        # under the node's widest published class ratio
+                        # (None = no live policy => physical admission)
+                        row["virt_hbm_bytes"] = (
+                            int(chip.memory * overcommit.max_ratio())
+                            if overcommit else None)
+                        # SPILL column: per-chip host-pool bytes are
+                        # node-local truth (the vmem ledger); remote
+                        # chips carry None like the other live columns
+                        row["spilled_bytes"] = (
+                            local_spilled.get(chip.index, 0)
+                            if name == self.ledger.node_name
+                            and local_spilled is not None else None)
+                    chips.append(row)
             row_extra = {}
             if self.quota_dir:
                 # raw lease-summary annotation rides to the quota fold
@@ -109,6 +140,18 @@ class ClusterRollup:
                 # document stays byte-identical
                 row_extra["_quota_lease_raw"] = anns.get(
                     consts.node_quota_lease_annotation())
+            if self.overcommit:
+                # vtovc node fields (gate on only — off keeps the
+                # document byte-identical): the published per-class
+                # ratios + the node's live spill signal
+                row_extra["overcommit_ratios"] = \
+                    dict(overcommit.ratios) if overcommit else None
+                row_extra["overcommit_ratio"] = \
+                    overcommit.max_ratio() if overcommit else None
+                row_extra["spill_frac"] = \
+                    overcommit.spill_frac if overcommit else None
+                row_extra["spilled_bytes"] = \
+                    overcommit.spilled_bytes if overcommit else None
             rows.append({
                 **row_extra,
                 "node": name,
@@ -244,6 +287,25 @@ class ClusterRollup:
                        for l in leases[-64:]],
         }
 
+    def _local_spilled_by_chip(self) -> "dict[int, int] | None":
+        """Live host-pool bytes per chip off the node's vmem ledger —
+        ONE open+scan per collect (vtovc; None when the ledger is
+        absent/unreadable — the smi column renders '-', never a
+        guess)."""
+        try:
+            from vtpu_manager.config.vmem import VmemLedger
+            led = VmemLedger(consts.VMEM_NODE_CONFIG)
+            try:
+                out: dict[int, int] = {}
+                for e in led.entries():
+                    out[e.host_index] = out.get(e.host_index, 0) \
+                        + e.spilled
+                return out
+            finally:
+                led.close()
+        except (OSError, ValueError):
+            return None
+
     def _compile_cache_state(self) -> dict | None:
         if not self.cache_root:
             return None
@@ -290,6 +352,20 @@ class ClusterRollup:
                     dict(t, node=self.ledger.node_name, live=True))
         local = self.ledger.to_wire(now)
         local["compile_cache"] = self._compile_cache_state()
+        if self.overcommit:
+            # vtovc local truth (gate on only): ring-reported spill
+            # activity plus the pool directory's ground-truth bytes
+            from vtpu_manager.overcommit.spill import pool_totals
+            spill_frac, ring_bytes = self.ledger.node_spill_signal(now)
+            pool_files, pool_bytes = pool_totals()
+            local["spill"] = {
+                "spill_frac": round(spill_frac, 4),
+                "spilled_bytes": ring_bytes,
+                "pool_files": pool_files,
+                "pool_bytes": pool_bytes,
+                "spill_events_total": self.ledger.spill_events_total,
+                "fill_events_total": self.ledger.fill_events_total,
+            }
         quota = self._fold_quota_leases(tenant_rows, node_rows, now)
         live_nodes = [r for r in node_rows
                       if r["reclaim_core_pct"] is not None]
