@@ -98,6 +98,14 @@ test-explain: ## vtexplain suite: ring bounds/drops, gate-off contracts, reason-
 test-quotamarket: ## vtqm suite: class stamping, lease ledger, market policy + conservation invariant, headroom score term both modes, replay/smi CLIs, 24-seed reclaim-under-crash chaos (CHAOS_SEED=n reproduces one seed)
 	$(PYTEST) tests/test_quota.py -q
 
+.PHONY: test-clustercache
+test-clustercache: ## vtcs suite: advertisement codec, peer fetch ladder + torn-fetch chaos, warm-preference parity in both scheduler modes, victim-cost ordering
+	$(PYTEST) tests/test_clustercache.py -q
+
+.PHONY: bench-clustercache
+bench-clustercache: ## vtcs headline bench: M-node fleet cold start — one compile fleet-wide, cold-node TTFS at warm-node order (asserted; writes BENCH_VTCS_r12.json)
+	python scripts/bench_clustercache.py
+
 .PHONY: bench-compilecache
 bench-compilecache: ## vtcc headline bench: N-replica gang cold start, cache off/cold/warm (1 compile + N-1 hits asserted)
 	python scripts/bench_compilecache.py
@@ -115,7 +123,7 @@ bench-overcommit: ## vtovc headline bench: pods-per-chip density gate off/on (>=
 	python scripts/bench_overcommit.py
 
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-utilization test-explain test-quotamarket test-overcommit bench-overcommit ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit bench-overcommit bench-clustercache ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

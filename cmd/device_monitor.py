@@ -77,7 +77,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.metrics.collector import NodeCollector
     from vtpu_manager.tpu.discovery import FakeBackend, discover
 
-    from vtpu_manager.util.featuregates import (DECISION_EXPLAIN,
+    from vtpu_manager.util.featuregates import (CLUSTER_COMPILE_CACHE,
+                                                DECISION_EXPLAIN,
                                                 HBM_OVERCOMMIT,
                                                 QUOTA_MARKET,
                                                 UTILIZATION_LEDGER,
@@ -93,6 +94,7 @@ def main(argv: list[str] | None = None) -> int:
     explain_on = gates.enabled(DECISION_EXPLAIN)
     quota_on = gates.enabled(QUOTA_MARKET)
     overcommit_on = gates.enabled(HBM_OVERCOMMIT)
+    cluster_cache_on = gates.enabled(CLUSTER_COMPILE_CACHE)
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
@@ -142,7 +144,10 @@ def main(argv: list[str] | None = None) -> int:
             # vtovc: per-node oversubscription ratios + spill state
             # fold into /utilization only when the overcommit gate is
             # on (off = byte-identical document, the vtqm pattern)
-            overcommit=overcommit_on)
+            overcommit=overcommit_on,
+            # vtcs: per-node warm-keys columns (vtpu-smi's WARM view)
+            # fold in only when the cluster-cache gate is on
+            cluster_cache=cluster_cache_on)
 
     import hmac
 
@@ -275,6 +280,34 @@ def main(argv: list[str] | None = None) -> int:
                 {"error": f"explain rollup failed: {e}"}, status=503)
         return web.json_response(doc, status=status)
 
+    async def cache_entry(request):
+        # vtcs peer-serving route (ClusterCompileCache gate; off = no
+        # route at all, matching "zero fetch I/O"): raw checksummed
+        # entries from the node cache, READ-SIDE VERIFIED — a corrupt
+        # entry is quarantined and 404s, never distributed. Same bearer
+        # auth as /metrics; the file read runs in an executor thread so
+        # a slow disk can never stall the scrape path, which this route
+        # deliberately is not.
+        if not authorized(request):
+            return web.Response(status=401, text="unauthorized\n")
+        import asyncio
+
+        from vtpu_manager.clustercache import (advertise as cc_advertise,
+                                               read_entry_for_serving)
+        key = request.query.get("key", "")
+        if not cc_advertise.valid_entry_key(key):
+            # the key becomes a file name under entries/ — anything but
+            # 64 lowercase hex is a protocol error (or path traversal)
+            return web.Response(status=400, text="bad entry key\n")
+        cache_root = os.path.join(args.base_dir,
+                                  consts.COMPILE_CACHE_SUBDIR)
+        raw = await asyncio.get_running_loop().run_in_executor(
+            None, read_entry_for_serving, cache_root, key)
+        if raw is None:
+            return web.Response(status=404, text="no such entry\n")
+        return web.Response(body=raw,
+                            content_type="application/octet-stream")
+
     app = web.Application()
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
@@ -287,6 +320,10 @@ def main(argv: list[str] | None = None) -> int:
         # same gate-off contract as /utilization: no route, not an
         # empty document
         app.router.add_get("/explain", explain_route)
+    if cluster_cache_on:
+        # same gate-off contract: no /cache/entry route, so a node not
+        # running the cluster tier can never be fetched from
+        app.router.add_get("/cache/entry", cache_entry)
     if args.debug_endpoints:
         # stack traces disclose internals: opt-in AND behind the same
         # bearer auth as /metrics when a token is configured

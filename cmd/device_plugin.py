@@ -67,6 +67,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="seconds between compile-cache evictor "
                              "passes (also reaps crashed writers' temp "
                              "files and folds dead tenants' stats)")
+    parser.add_argument("--cache-advertise-endpoint", default=None,
+                        help="ClusterCompileCache gate: host:port of "
+                             "THIS node's device-monitor, embedded in "
+                             "the warm-keys advertisement so cold "
+                             "peers fetch entries from its "
+                             "/cache/entry route (default: "
+                             "$NODE_IP:9394 when NODE_IP is set, else "
+                             "warmth is advertised scheduler-only and "
+                             "peers cannot fetch from this node)")
     parser.add_argument("--spill-budget-gib", type=float, default=16.0,
                         help="vtovc (HBMOvercommit): node host-RAM spill "
                              "budget in GiB — the bound on Σ spilled "
@@ -96,7 +105,9 @@ def main(argv: list[str] | None = None) -> int:
                                                      HealthWatcher)
     from vtpu_manager.manager.watcher import FakeSampler, TcWatcherDaemon
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import (CLIENT_MODE, COMPILE_CACHE,
+    from vtpu_manager.util.featuregates import (CLIENT_MODE,
+                                                CLUSTER_COMPILE_CACHE,
+                                                COMPILE_CACHE,
                                                 CORE_PLUGIN,
                                                 FAULT_INJECTION,
                                                 HBM_OVERCOMMIT,
@@ -213,6 +224,15 @@ def main(argv: list[str] | None = None) -> int:
     # vtcc: Allocate mounts the node-shared compile cache read-write and
     # injects the arming env + config field; off = nothing injected
     vnum.compile_cache_enabled = gates.enabled(COMPILE_CACHE)
+    # vtcs: the cluster tier requires the node store underneath it —
+    # ClusterCompileCache without CompileCache is a config error that
+    # degrades loudly to node-local behavior, never silently half-arms
+    cluster_cache_on = gates.enabled(CLUSTER_COMPILE_CACHE)
+    if cluster_cache_on and not gates.enabled(COMPILE_CACHE):
+        log.warning("ClusterCompileCache=true requires CompileCache=true;"
+                    " the cluster tier stays disarmed")
+        cluster_cache_on = False
+    vnum.cluster_cache_enabled = cluster_cache_on
     # vtqm: Allocate stamps the webhook-normalized workload class into
     # the v3 config ABI; off = WORKLOAD_CLASS_NONE (the zero bytes)
     vnum.quota_market_enabled = gates.enabled(QUOTA_MARKET)
@@ -336,6 +356,7 @@ def main(argv: list[str] | None = None) -> int:
     # eviction to the byte budget, crashed-writer temp reaping, and
     # dead-tenant stats folding — so tenant compile paths never pay it
     cache_evictor_stop = None
+    advertiser = None
     if gates.enabled(COMPILE_CACHE):
         import threading
         from vtpu_manager.compilecache import CompileCache
@@ -364,6 +385,26 @@ def main(argv: list[str] | None = None) -> int:
                              name="vtcc-evictor").start()
             log.info("compile cache at %s (budget %d MiB)",
                      cache_root, args.compile_cache_budget_mb)
+
+        # vtcs advertiser: this daemon (the node-annotation owner)
+        # publishes the node's hottest verified entries and fans every
+        # peer's advertisement into peers.json under the cache root, so
+        # in-container fetchers resolve warm peers without a client
+        if cluster_cache_on and node_cache is not None:
+            from vtpu_manager.clustercache import CacheAdvertiser
+            endpoint = args.cache_advertise_endpoint
+            if endpoint is None:
+                node_ip = os.environ.get("NODE_IP", "")
+                endpoint = f"{node_ip}:9394" if node_ip else ""
+            if not endpoint:
+                log.warning("no --cache-advertise-endpoint / NODE_IP: "
+                            "warm keys advertise scheduler-only; peers "
+                            "cannot fetch from this node")
+            advertiser = CacheAdvertiser(client, args.node_name,
+                                         cache_root, endpoint=endpoint)
+            advertiser.start()
+            log.info("cluster cache advertiser running (endpoint %r)",
+                     endpoint)
 
     # vttel pressure rollup: this daemon (the node-annotation owner)
     # scans the step rings and patches the node-pressure annotation the
@@ -446,6 +487,26 @@ def main(argv: list[str] | None = None) -> int:
         log.info("quota market manager running (ledger %s)",
                  market.ledger.path)
 
+    # victim-cost rollup: whenever either cheap-victim signal source is
+    # armed (vtqm lease ledger / vtovc spill residency), this daemon
+    # (the node-annotation owner) publishes the per-tenant rollup the
+    # DecisionExplain-gated preemption victim ordering consumes —
+    # priority stays primary; a stale rollup degrades to the
+    # byte-identical priority-only sort on the scheduler side
+    victimcost_pub = None
+    if gates.enabled(QUOTA_MARKET) or gates.enabled(HBM_OVERCOMMIT):
+        from vtpu_manager.quota.victimcost import VictimCostPublisher
+        victimcost_pub = VictimCostPublisher(
+            client, args.node_name,
+            args.base_dir or consts.MANAGER_BASE_DIR,
+            vmem_path=vmem_path,
+            include_leases=gates.enabled(QUOTA_MARKET),
+            include_spill=gates.enabled(HBM_OVERCOMMIT))
+        victimcost_pub.start()
+        log.info("victim-cost publisher running (leases=%s spill=%s)",
+                 gates.enabled(QUOTA_MARKET),
+                 gates.enabled(HBM_OVERCOMMIT))
+
     controller = None
     if gates.enabled(RESCHEDULE):
         from vtpu_manager.scheduler.lease import read_lease_state
@@ -480,6 +541,10 @@ def main(argv: list[str] | None = None) -> int:
             registry_srv.stop()
         if cache_evictor_stop is not None:
             cache_evictor_stop.set()
+        if advertiser:
+            advertiser.stop()
+        if victimcost_pub:
+            victimcost_pub.stop()
         if pressure_pub:
             pressure_pub.stop()
         if headroom_pub:

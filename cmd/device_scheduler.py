@@ -90,7 +90,8 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.scheduler.preempt import PreemptPredicate
     from vtpu_manager.scheduler.routes import SchedulerAPI, run_server
     from vtpu_manager.scheduler.serial import SerialLocker
-    from vtpu_manager.util.featuregates import (COMPILE_CACHE,
+    from vtpu_manager.util.featuregates import (CLUSTER_COMPILE_CACHE,
+                                                COMPILE_CACHE,
                                                 DECISION_EXPLAIN,
                                                 FAULT_INJECTION,
                                                 HBM_OVERCOMMIT,
@@ -151,6 +152,11 @@ def main(argv: list[str] | None = None) -> int:
         # SchedulerHA branch's shards inherit it for free (exactly how
         # they inherit the vttel pressure penalty)
         anti_storm=gates.enabled(COMPILE_CACHE),
+        # vtcs: warm-preference — a fingerprint-carrying pod prefers
+        # nodes already advertising its compiled artifact (soft bonus,
+        # audited as warm_term in vtexplain); same filter_kwargs
+        # ride-along so vtha shards inherit it
+        cluster_cache=gates.enabled(CLUSTER_COMPILE_CACHE),
         # vtuse: observe-only headroom tap (trace span + metric) —
         # same filter_kwargs ride-along so vtha shards inherit it
         utilization_hint=gates.enabled(UTILIZATION_LEDGER),

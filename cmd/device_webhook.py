@@ -40,7 +40,8 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
     from vtpu_manager.util import consts
-    from vtpu_manager.util.featuregates import (COMPILE_CACHE,
+    from vtpu_manager.util.featuregates import (CLUSTER_COMPILE_CACHE,
+                                                COMPILE_CACHE,
                                                 HBM_OVERCOMMIT,
                                                 QUOTA_MARKET, TRACING,
                                                 FeatureGates)
@@ -76,11 +77,15 @@ def main(argv: list[str] | None = None) -> int:
 
     api = WebhookAPI(scheduler_name=args.scheduler_name,
                      dra_convert=args.dra_convert, client=client,
-                     # vtcc: mirror the tenant-declared program
+                     # vtcc/vtcs: mirror the tenant-declared program
                      # fingerprint into the scheduler-readable
-                     # annotation (gate off = no new patches, byte-
-                     # identical admission behavior)
-                     stamp_fingerprint=gates.enabled(COMPILE_CACHE),
+                     # annotation (both gates off = no new patches,
+                     # byte-identical admission behavior; the vtcs
+                     # warm-preference and anti-storm terms both key
+                     # on this one stamp)
+                     stamp_fingerprint=(
+                         gates.enabled(COMPILE_CACHE)
+                         or gates.enabled(CLUSTER_COMPILE_CACHE)),
                      # vtqm + vtovc: normalize the declared workload
                      # class into the one annotation the scheduler's
                      # headroom term, the overcommit plane's per-class

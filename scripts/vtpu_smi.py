@@ -114,6 +114,15 @@ def render(doc: dict, out=None) -> None:
         if nrow.get("quota_lent_core_pct") is not None:
             bits.append(f"lent {nrow['quota_lent_core_pct']}% across "
                         f"{nrow.get('quota_leases', 0)} lease(s)")
+        # vtcs: warm-keys column (cluster-cache documents only — a
+        # gate-off document renders exactly the prior line). Shows how
+        # many compiled programs this node can seed the fleet with,
+        # naming the hottest few fingerprints.
+        if nrow.get("warm_keys") is not None:
+            fps = nrow.get("warm_fps") or []
+            named = ",".join(fps[:3]) + ("…" if len(fps) > 3 else "")
+            bits.append(f"warm {nrow['warm_keys']} key(s)"
+                        + (f" [{named}]" if named else ""))
         if nrow.get("local"):
             cache = local.get("compile_cache")
             if cache:

@@ -185,10 +185,18 @@ def main(argv: list[str] | None = None) -> int:
                       f"throttle-wait {s['throttle_wait_frac'] * 100:.1f}%"
                       f"  hbm-hw {s['hbm_highwater_bytes']}")
             for c in compiles:
+                # vtcs: the fetch-vs-compile outcome rides the same
+                # splice — "fetch" = the artifact was seeded from a
+                # warm peer, no compile ran on this node at all
+                hint = ""
+                if c['outcome'] == 'miss':
+                    hint = "  <- this tenant compiled; replicas hit"
+                elif c['outcome'] == 'fetch':
+                    hint = ("  <- seeded from a warm peer; "
+                            "no compile on this node")
                 print(f"  compile-cache: {c['outcome']} "
                       f"({c['dur_s'] * 1000:.3f} ms, key {c['key']})"
-                      + ("" if c['outcome'] != 'miss' else
-                         "  <- this tenant compiled; replicas hit"))
+                      + hint)
             for u in util:
                 print(f"  utilization [{u['container']}]: "
                       f"used {u['used_core_pct']:.1f}% of "

@@ -507,6 +507,14 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     failpoints.arm("cache.write", "partial-write", p=0.3,
                    count=rng.randint(1, 2))
     failpoints.arm("cache.lease", "crash", p=0.2, count=1)
+    # vtcs sites: driven by the dedicated cluster-cache chaos tests
+    # (test_clustercache.py — the e2e loop here never fetches or
+    # advertises), armed so the full-coverage assertion stays the
+    # honest catalog check
+    failpoints.arm("cache.fetch", rng.choice(["error", "partial-write"]),
+                   p=0.3, count=rng.randint(1, 2))
+    failpoints.arm("cache.advertise", "error", p=0.3,
+                   count=rng.randint(1, 2))
     # vtuse sites: driven by the dedicated utilization chaos tests
     # (test_utilization.py — the e2e loop here never folds the ledger
     # or serves /utilization), armed so the full-coverage assertion

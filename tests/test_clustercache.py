@@ -1,0 +1,1056 @@
+"""vtcs suite: warm-keys advertisement codec, the peer-fetch ladder
+(live HTTP, torn-fetch chaos, crashed-fetcher lease takeover), the
+warm-preference scheduler term in BOTH data paths, the victim-cost
+preemption refinement, and every gate-off contract — no annotation, no
+/cache/entry route, zero fetch I/O, placement byte-identical.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.clustercache import advertise
+from vtpu_manager.clustercache.advertise import (CacheAdvertiser,
+                                                 NodeWarmKeys,
+                                                 parse_warm_keys,
+                                                 warm_term)
+from vtpu_manager.clustercache.fetch import (ClusterCompileCache,
+                                             read_entry_for_serving)
+from vtpu_manager.compilecache.cache import CompileCache
+from vtpu_manager.device import types as dt
+from vtpu_manager.device.claims import DeviceClaim, PodDeviceClaims
+from vtpu_manager.quota import victimcost as vc_mod
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.failpoints import CrashFailpoint
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.preempt import PreemptPredicate
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.util import consts
+from vtpu_manager.utilization import headroom as hr_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KEY_A = "a" * 64
+KEY_B = "b" * 64
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def vtpu_pod(name="p1", number=1, cores=25, memory_mib=1024,
+             annotations=None, node_name=None, priority=0):
+    pod = {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}",
+                     "annotations": annotations or {}},
+        "spec": {"priority": priority, "containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): number,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): memory_mib}}}]},
+        "status": {"phase": "Pending"},
+    }
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    return pod
+
+
+def fp_ann(fp):
+    return {consts.program_fingerprint_annotation(): fp}
+
+
+def warm_ann(fp, key=KEY_A, endpoint="127.0.0.1:1", ts=None):
+    ts = time.time() if ts is None else ts
+    return {consts.node_cache_keys_annotation():
+            f"{endpoint}|{fp}={key}@{ts:.3f}"}
+
+
+def two_node_cluster(extra_ann=None, warm_node=None):
+    client = FakeKubeClient()
+    for i in range(2):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2),
+                               uuid_prefix=f"TPU-N{i}")
+        node = dt.fake_node(f"node-{i}", reg)
+        if warm_node == f"node-{i}" and extra_ann:
+            node["metadata"]["annotations"].update(extra_ann)
+        client.add_node(node)
+    return client
+
+
+def place(pred, client, pod):
+    client.add_pod(pod)
+    result = pred.filter({"Pod": pod})
+    assert not result.error, result.error
+    assert len(result.node_names) == 1
+    return result.node_names[0]
+
+
+def serve_root(root):
+    """Per-test /cache/entry server over one cache root — the monitor
+    route's exact read path. Returns (endpoint, counter, server)."""
+    counter = {"requests": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            counter["requests"] += 1
+            parsed = urlparse(self.path)
+            key = (parse_qs(parsed.query).get("key") or [""])[0]
+            raw = read_entry_for_serving(root, key)
+            if raw is None:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return f"127.0.0.1:{srv.server_port}", counter, srv
+
+
+def write_peers(root, key, endpoint, node="peer-0", ts=None):
+    doc = {"ts": time.time() if ts is None else ts,
+           "peers": [{"node": node, "endpoint": endpoint,
+                      "keys": {key: "prog"}}]}
+    with open(os.path.join(root, consts.CACHE_PEERS_NAME), "w") as f:
+        json.dump(doc, f)
+
+
+@pytest.fixture
+def armed_failpoints():
+    failpoints.enable(seed=7)
+    yield
+    failpoints.disable()
+
+
+# ---------------------------------------------------------------------------
+# advertisement codec
+# ---------------------------------------------------------------------------
+
+class TestAdvertiseCodec:
+    def test_roundtrip(self):
+        now = round(time.time(), 3)     # encode() carries ms precision
+        w = NodeWarmKeys("10.0.0.5:9394",
+                         (("prog-a", KEY_A), ("prog-b", KEY_B)), now)
+        p = parse_warm_keys(w.encode(), now=now + 1)
+        assert p == w
+        assert p.fps == {"prog-a", "prog-b"}
+        assert p.keys == {KEY_A, KEY_B}
+
+    def test_bounds_and_order_preserved(self):
+        now = time.time()
+        pairs = tuple((f"fp{i}", ("%02x" % i) * 32)
+                      for i in range(advertise.MAX_AD_KEYS + 4))
+        w = NodeWarmKeys("h:1", pairs, now)
+        p = parse_warm_keys(w.encode(), now=now)
+        assert len(p.pairs) == advertise.MAX_AD_KEYS
+        # hottest-first order survives the wire
+        assert p.pairs == pairs[:advertise.MAX_AD_KEYS]
+
+    def test_staleness_and_garbage(self):
+        now = time.time()
+        enc = NodeWarmKeys("h:1", (("fp", KEY_A),), now).encode()
+        assert parse_warm_keys(enc, now=now) is not None
+        stale = now + advertise.MAX_AD_AGE_S + 10
+        assert parse_warm_keys(enc, now=stale) is None
+        assert parse_warm_keys(None) is None
+        assert parse_warm_keys("") is None
+        assert parse_warm_keys("garbage") is None
+        assert parse_warm_keys(f"no-pipe@{now}") is None
+        assert parse_warm_keys(f"h:1|fp={KEY_A}@nan", now=now) is None
+        assert parse_warm_keys("x" * (advertise.MAX_AD_LEN + 1)) is None
+
+    def test_malformed_pair_skipped_not_fatal(self):
+        now = time.time()
+        raw = (f"h:1|fp-good={KEY_A},bad-key=zz,=nokey,"
+               f"we/ird={KEY_B}@{now:.3f}")
+        p = parse_warm_keys(raw, now=now)
+        assert p is not None
+        assert p.pairs == (("fp-good", KEY_A),)
+
+    def test_warm_term_staleness_rejudged_at_use(self):
+        now = time.time()
+        w = NodeWarmKeys("h:1", (("prog", KEY_A),), now)
+        assert warm_term(w, "prog", now=now) == \
+            advertise.WARM_SCORE_WEIGHT
+        assert warm_term(w, "other", now=now) == 0.0
+        assert warm_term(w, "", now=now) == 0.0
+        assert warm_term(None, "prog", now=now) == 0.0
+        # the parsed object is cached on NodeEntry — a dead advertiser
+        # must decay AT USE TIME, not only at parse time
+        late = now + advertise.MAX_AD_AGE_S + 5
+        assert warm_term(w, "prog", now=late) == 0.0
+
+    def test_markers_and_scan(self, tmp_path):
+        root = str(tmp_path / "cc")
+        cc = CompileCache(root)
+        cc.put(KEY_A, b"exe-a")
+        cc.put(KEY_B, b"exe-b")
+        advertise.record_fingerprint(root, "prog-a", KEY_A)
+        time.sleep(0.02)
+        advertise.record_fingerprint(root, "prog-b", KEY_B)
+        # hottest (most recently used) first
+        assert advertise.scan_warm_pairs(root) == \
+            [("prog-b", KEY_B), ("prog-a", KEY_A)]
+        # refreshing a marker reorders
+        time.sleep(0.02)
+        advertise.record_fingerprint(root, "prog-a", KEY_A)
+        assert advertise.scan_warm_pairs(root)[0] == ("prog-a", KEY_A)
+        # a marker whose entry was evicted is never advertised — a
+        # fetch against it could only 404
+        os.unlink(cc.entry_path(KEY_B))
+        assert advertise.scan_warm_pairs(root) == [("prog-a", KEY_A)]
+        # a weird fp lands under its SANITIZED name (the match side
+        # sanitizes identically); unsalvageable fps / bad keys never land
+        advertise.record_fingerprint(root, 'we"ird/', KEY_A)
+        advertise.record_fingerprint(root, '"//"', KEY_A)
+        advertise.record_fingerprint(root, "ok", "not-a-key")
+        names = set(os.listdir(os.path.join(root, advertise.FPS_SUBDIR)))
+        assert names == {"weird", "prog-a", "prog-b"}
+
+
+# ---------------------------------------------------------------------------
+# advertiser daemon + peers fan-in
+# ---------------------------------------------------------------------------
+
+class TestAdvertiser:
+    def _fleet(self, tmp_path, n=3):
+        client = FakeKubeClient(upsert_on_patch=True)
+        roots = []
+        for i in range(n):
+            root = str(tmp_path / f"node-{i}" / "cc")
+            os.makedirs(root, exist_ok=True)
+            roots.append(root)
+            client.add_node({"metadata": {"name": f"node-{i}",
+                                          "annotations": {}}})
+        return client, roots
+
+    def test_publish_patches_annotation(self, tmp_path):
+        client, roots = self._fleet(tmp_path, n=1)
+        cc = CompileCache(roots[0])
+        cc.put(KEY_A, b"exe")
+        advertise.record_fingerprint(roots[0], "prog", KEY_A)
+        adv = CacheAdvertiser(client, "node-0", roots[0],
+                              endpoint="1.2.3.4:9394")
+        adv.publish_once()
+        node = client.get_node("node-0")
+        raw = node["metadata"]["annotations"][
+            consts.node_cache_keys_annotation()]
+        w = parse_warm_keys(raw)
+        assert w is not None and w.endpoint == "1.2.3.4:9394"
+        assert w.pairs == (("prog", KEY_A),)
+
+    def test_fan_in_excludes_self_and_fetchless(self, tmp_path):
+        client, roots = self._fleet(tmp_path, n=3)
+        now = time.time()
+        # node-1 advertises fetchably, node-2 scheduler-only (no
+        # endpoint), node-0 is us
+        client.patch_node_annotations("node-1", {
+            consts.node_cache_keys_annotation():
+                NodeWarmKeys("9.9.9.9:1", (("prog", KEY_A),),
+                             now).encode()})
+        client.patch_node_annotations("node-2", {
+            consts.node_cache_keys_annotation():
+                NodeWarmKeys("", (("prog", KEY_B),), now).encode()})
+        adv = CacheAdvertiser(client, "node-0", roots[0],
+                              endpoint="1.1.1.1:1")
+        assert adv.refresh_peers() == 1
+        peers = advertise.read_peers(roots[0])
+        assert [p["node"] for p in peers] == ["node-1"]
+        assert peers[0]["keys"] == {KEY_A: "prog"}
+
+    def test_read_peers_staleness_and_garbage(self, tmp_path):
+        root = str(tmp_path / "cc")
+        os.makedirs(root)
+        path = os.path.join(root, consts.CACHE_PEERS_NAME)
+        assert advertise.read_peers(root) == []          # absent
+        with open(path, "w") as f:
+            f.write("{torn")
+        assert advertise.read_peers(root) == []          # torn
+        with open(path, "w") as f:
+            json.dump({"ts": time.time() - advertise.PEERS_STALE_S - 60,
+                       "peers": [{"node": "x"}]}, f)
+        assert advertise.read_peers(root) == []          # stale fan-in
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), "peers": [{"node": "x"}]}, f)
+        assert advertise.read_peers(root) == [{"node": "x"}]
+
+    def test_advertise_failpoint_decays_to_no_signal(self, tmp_path,
+                                                     armed_failpoints):
+        """cache.advertise error: the publish fails BEFORE the patch —
+        the stale annotation (or none) is what peers see, and the
+        codec's timestamp ages it to no-signal rather than ghost
+        warmth."""
+        client, roots = self._fleet(tmp_path, n=1)
+        adv = CacheAdvertiser(client, "node-0", roots[0], endpoint="h:1")
+        failpoints.arm("cache.advertise", "error", count=1)
+        from vtpu_manager.client.kube import KubeError
+        with pytest.raises(KubeError):
+            adv.publish_once()
+        anns = client.get_node("node-0")["metadata"]["annotations"]
+        assert consts.node_cache_keys_annotation() not in anns
+        # next tick succeeds — the daemon loop's per-tick tolerance
+        adv.publish_once()
+        assert consts.node_cache_keys_annotation() in \
+            client.get_node("node-0")["metadata"]["annotations"]
+
+
+# ---------------------------------------------------------------------------
+# peer fetch (live HTTP)
+# ---------------------------------------------------------------------------
+
+class TestPeerFetch:
+    def test_cold_node_fetches_instead_of_compiling(self, tmp_path):
+        seed_root = str(tmp_path / "warm" / "cc")
+        cold_root = str(tmp_path / "cold" / "cc")
+        os.makedirs(cold_root)
+        CompileCache(seed_root).put(KEY_A, b"the-executable")
+        endpoint, counter, srv = serve_root(seed_root)
+        try:
+            write_peers(cold_root, KEY_A, endpoint)
+            cc = ClusterCompileCache(cold_root)
+
+            def never():
+                raise AssertionError("cold node must not compile")
+
+            payload, outcome = cc.get_or_compile(
+                KEY_A, never, fingerprint="prog")
+            assert (payload, outcome) == (b"the-executable", "fetch")
+            assert counter["requests"] == 1
+            assert cc.stats.peer_fetches == 1
+            assert cc.stats.peer_fetch_failures == 0
+            # the entry LANDED verified — the next tenant on this node
+            # is a plain local hit, and the marker advertises onward
+            assert cc.get_or_compile(KEY_A, never)[1] == "hit"
+            assert counter["requests"] == 1      # no second fetch
+            assert advertise.scan_warm_pairs(cold_root) == \
+                [("prog", KEY_A)]
+        finally:
+            srv.shutdown()
+
+    def test_dead_peer_falls_open_to_compile(self, tmp_path):
+        root = str(tmp_path / "cc")
+        os.makedirs(root)
+        write_peers(root, KEY_A, "127.0.0.1:1")     # nothing listens
+        cc = ClusterCompileCache(root, fetch_timeout_s=0.5)
+        payload, outcome = cc.get_or_compile(KEY_A, lambda: b"local")
+        assert (payload, outcome) == (b"local", "miss")
+        assert cc.stats.peer_fetch_failures == 1
+
+    def test_corrupt_served_payload_never_lands(self, tmp_path):
+        """A peer serving garbage (torn transit, hostile peer): the
+        read-back verify fails, the rung is charged, the compile
+        runs — the garbage never becomes a servable entry."""
+        root = str(tmp_path / "cc")
+        os.makedirs(root)
+
+        class Garbage(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b"\x00garbage-not-an-entry"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Garbage)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            write_peers(root, KEY_A, f"127.0.0.1:{srv.server_port}")
+            cc = ClusterCompileCache(root)
+            payload, outcome = cc.get_or_compile(KEY_A, lambda: b"real")
+            assert (payload, outcome) == (b"real", "miss")
+            assert cc.stats.peer_fetch_failures == 1
+            # the landed entry is OUR compile, not the garbage
+            assert cc.get(KEY_A) == b"real"
+            assert os.listdir(cc.tmp_dir) == []   # staging cleaned
+        finally:
+            srv.shutdown()
+
+    def test_breaker_stops_hammering_dead_peer(self, tmp_path):
+        root = str(tmp_path / "cc")
+        os.makedirs(root)
+        cc = ClusterCompileCache(root, fetch_timeout_s=0.2)
+        for i in range(4):
+            write_peers(root, KEY_A, "127.0.0.1:1")
+            assert cc._fetch_remote(KEY_A) is None
+        breaker = cc._breaker("127.0.0.1:1")
+        assert not breaker.allow()
+        # an open breaker costs zero connection attempts
+        fails_before = cc.stats.peer_fetch_failures
+        assert cc._fetch_remote(KEY_A) is None
+        assert cc.stats.peer_fetch_failures == fails_before
+
+    def test_no_peers_file_zero_fetch_io(self, tmp_path):
+        seed_root = str(tmp_path / "warm" / "cc")
+        CompileCache(seed_root).put(KEY_A, b"exe")
+        endpoint, counter, srv = serve_root(seed_root)
+        try:
+            root = str(tmp_path / "cold" / "cc")
+            os.makedirs(root)
+            cc = ClusterCompileCache(root)
+            assert cc.get_or_compile(KEY_A, lambda: b"local")[1] == "miss"
+            assert counter["requests"] == 0
+        finally:
+            srv.shutdown()
+
+    def test_serving_read_verifies_and_quarantines(self, tmp_path):
+        root = str(tmp_path / "cc")
+        cc = CompileCache(root)
+        cc.put(KEY_A, b"exe")
+        raw = read_entry_for_serving(root, KEY_A)
+        assert raw is not None
+        assert CompileCache._verify(KEY_A, raw) == b"exe"
+        # path traversal / malformed keys are rejected outright
+        assert read_entry_for_serving(root, "../" + KEY_A[3:]) is None
+        assert read_entry_for_serving(root, "") is None
+        # a corrupt on-disk entry 404s AND is quarantined
+        with open(cc.entry_path(KEY_B), "wb") as f:
+            f.write(b"torn")
+        assert read_entry_for_serving(root, KEY_B) is None
+        assert not os.path.exists(cc.entry_path(KEY_B))
+        assert len(os.listdir(cc.quarantine_dir)) == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: torn fetch, crashed fetcher
+# ---------------------------------------------------------------------------
+
+class TestChaosFetch:
+    def test_injected_error_falls_open_to_compile(self, tmp_path,
+                                                  armed_failpoints):
+        seed_root = str(tmp_path / "warm" / "cc")
+        CompileCache(seed_root).put(KEY_A, b"exe")
+        endpoint, _c, srv = serve_root(seed_root)
+        try:
+            root = str(tmp_path / "cold" / "cc")
+            os.makedirs(root)
+            write_peers(root, KEY_A, endpoint)
+            cc = ClusterCompileCache(root)
+            failpoints.arm("cache.fetch", "error", count=1)
+            payload, outcome = cc.get_or_compile(KEY_A, lambda: b"local")
+            assert (payload, outcome) == (b"local", "miss")
+            assert cc.stats.peer_fetch_failures == 1
+            # the NEXT miss (failpoint exhausted) fetches fine
+            os.unlink(cc.entry_path(KEY_A))
+            assert cc.get_or_compile(KEY_A, lambda: b"x")[1] == "fetch"
+        finally:
+            srv.shutdown()
+
+    def test_torn_fetch_never_served(self, tmp_path, armed_failpoints):
+        """cache.fetch partial-write: the staged download is torn and
+        the fetcher crashes — no entry (torn or whole) lands, only a
+        .tmp orphan the evictor reaps; a later reader sees a miss."""
+        seed_root = str(tmp_path / "warm" / "cc")
+        CompileCache(seed_root).put(KEY_A, b"X" * 4096)
+        endpoint, _c, srv = serve_root(seed_root)
+        try:
+            root = str(tmp_path / "cold" / "cc")
+            os.makedirs(root)
+            write_peers(root, KEY_A, endpoint)
+            cc = ClusterCompileCache(root)
+            failpoints.arm("cache.fetch", "partial-write", count=1)
+            with pytest.raises(CrashFailpoint):
+                cc.get_or_compile(KEY_A, lambda: b"never")
+            assert os.listdir(cc.entries_dir) == []
+            assert cc.get(KEY_A) is None         # miss, never torn bytes
+            orphans = os.listdir(cc.tmp_dir)
+            assert len(orphans) == 1 and ".fetch." in orphans[0]
+            # the evictor reaps the crashed fetcher's staging
+            cc2 = CompileCache(root, stale_lease_s=0.0)
+            cc2.evict(budget_bytes=1 << 30, now=time.time() + 10)
+            assert os.listdir(cc2.tmp_dir) == []
+        finally:
+            srv.shutdown()
+
+    def test_crashed_fetcher_lease_taken_over(self, tmp_path):
+        """A fetcher dying mid-download (REAL process death: partial-
+        write tears its staging, the kernel releases its lease flock) —
+        a successor takes the lease over within the stale budget and
+        seeds the node from the same peer."""
+        seed_root = str(tmp_path / "warm" / "cc")
+        CompileCache(seed_root).put(KEY_A, b"the-artifact")
+        endpoint, _c, srv = serve_root(seed_root)
+        root = str(tmp_path / "cold" / "cc")
+        os.makedirs(root)
+        write_peers(root, KEY_A, endpoint)
+        crasher = (
+            "import os, sys\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from vtpu_manager.resilience import failpoints\n"
+            "from vtpu_manager.clustercache import ClusterCompileCache\n"
+            "failpoints.enable(seed=1)\n"
+            "failpoints.arm('cache.fetch', 'partial-write', count=1)\n"
+            f"cc = ClusterCompileCache({root!r})\n"
+            f"try:\n"
+            f"    cc.get_or_compile({KEY_A!r}, lambda: b'never')\n"
+            "except BaseException:\n"
+            "    os._exit(0)\n"
+            "os._exit(3)\n")
+        try:
+            res = subprocess.run([sys.executable, "-c", crasher],
+                                 timeout=60)
+            assert res.returncode == 0
+            cc = ClusterCompileCache(root, stale_lease_s=1.0)
+            assert os.listdir(cc.lease_dir)      # dead fetcher's lease
+            t0 = time.monotonic()
+            payload, outcome = cc.get_or_compile(
+                KEY_A, lambda: b"never", timeout_s=30)
+            assert (payload, outcome) == (b"the-artifact", "fetch")
+            assert time.monotonic() - t0 < 6.0   # takeover, not deadline
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# warm-preference scheduling (both data paths)
+# ---------------------------------------------------------------------------
+
+class TestWarmPlacement:
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_fp_pod_prefers_warm_node(self, mode):
+        client = two_node_cluster(extra_ann=warm_ann("prog"),
+                                  warm_node="node-1")
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap, cluster_cache=True)
+        # binpack default without warmth is node-0; the advertised
+        # artifact pulls the fp pod to node-1
+        assert place(pred, client, vtpu_pod("plain")) == "node-0"
+        assert place(pred, client,
+                     vtpu_pod("fp", annotations=fp_ann("prog"))) \
+            == "node-1"
+        # a DIFFERENT program gets no pull
+        assert place(pred, client,
+                     vtpu_pod("other", annotations=fp_ann("prog2"))) \
+            == "node-0"
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_stale_advertisement_decays(self, mode):
+        stale = time.time() - advertise.MAX_AD_AGE_S - 30
+        client = two_node_cluster(
+            extra_ann=warm_ann("prog", ts=stale), warm_node="node-1")
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap, cluster_cache=True)
+        assert place(pred, client,
+                     vtpu_pod("fp", annotations=fp_ann("prog"))) \
+            == "node-0"       # no phantom warmth
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_soft_never_vetoes_capacity(self, mode):
+        """Only ONE node fits; the other is warm — the pod still lands
+        on the node with capacity (warm attracts, never gates)."""
+        client = FakeKubeClient()
+        big = dt.fake_registry(4, mesh_shape=(2, 2), uuid_prefix="TPU-B")
+        tiny = dt.fake_registry(1, mesh_shape=(1, 1),
+                                uuid_prefix="TPU-T")
+        client.add_node(dt.fake_node("roomy", big))
+        warm_node = dt.fake_node("warm-full", tiny)
+        warm_node["metadata"]["annotations"].update(warm_ann("prog"))
+        client.add_node(warm_node)
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+        pred = FilterPredicate(client, snapshot=snap, cluster_cache=True)
+        # 4 chips cannot fit on the 1-chip warm node
+        assert place(pred, client,
+                     vtpu_pod("fp", number=4,
+                              annotations=fp_ann("prog"))) == "roomy"
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_gate_off_byte_identical(self, mode, monkeypatch):
+        """cluster_cache off (default): warm_term must never run, and
+        placements with the annotation present match an
+        annotation-free cluster exactly — in both data paths."""
+        def boom(*a, **k):
+            raise AssertionError("warm_term called with gate off")
+        import vtpu_manager.scheduler.filter as filter_mod
+        monkeypatch.setattr(filter_mod.cc_advertise, "warm_term", boom)
+
+        def run(with_warm: bool) -> list[str]:
+            client = two_node_cluster(
+                extra_ann=warm_ann("prog") if with_warm else None,
+                warm_node="node-1" if with_warm else None)
+            snap = None
+            if mode == "snapshot":
+                snap = ClusterSnapshot(client)
+                snap.start()
+            pred = FilterPredicate(client, snapshot=snap)  # default off
+            return [place(pred, client,
+                          vtpu_pod(f"p{i}", annotations=fp_ann("prog")))
+                    for i in range(4)]
+
+        assert run(True) == run(False)
+
+    def test_snapshot_warm_index_maintained(self):
+        client = two_node_cluster(extra_ann=warm_ann("prog"),
+                                  warm_node="node-1")
+        snap = ClusterSnapshot(client)
+        snap.start()
+        assert snap.warm_nodes("prog") == ("node-1",)
+        assert snap.warm_nodes("other") == ()
+        # advertisement drops the fp -> index retires it
+        node = client.get_node("node-1")
+        node["metadata"]["annotations"].pop(
+            consts.node_cache_keys_annotation())
+        snap.apply_event("nodes", {"type": "MODIFIED", "object": node})
+        assert snap.warm_nodes("prog") == ()
+        # re-advertise then DELETE the node -> retired again
+        node["metadata"]["annotations"].update(warm_ann("prog"))
+        snap.apply_event("nodes", {"type": "MODIFIED", "object": node})
+        assert snap.warm_nodes("prog") == ("node-1",)
+        snap.apply_event("nodes", {"type": "DELETED", "object": node})
+        assert snap.warm_nodes("prog") == ()
+
+    def test_explain_records_warm_term_exact(self, tmp_path):
+        from vtpu_manager import explain
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        try:
+            client = two_node_cluster(extra_ann=warm_ann("prog"),
+                                      warm_node="node-1")
+            pred = FilterPredicate(client, cluster_cache=True)
+            assert place(pred, client,
+                         vtpu_pod("fp", annotations=fp_ann("prog"))) \
+                == "node-1"
+            rec = explain.recorder()._buf[-1]
+            rows = {c["node"]: c for c in rec["candidates"]}
+            warm_row = rows["node-1"]
+            assert warm_row["warm_term"] == advertise.WARM_SCORE_WEIGHT
+            assert "warm_term" not in rows["node-0"]  # unscored = absent
+            for row in rows.values():
+                assert row["total"] == pytest.approx(
+                    row["base"] - row["pressure"] - row["storm"]
+                    - row.get("spill", 0.0) + row["gang_bonus"]
+                    + row["headroom_term"] + row.get("warm_term", 0.0))
+        finally:
+            explain.reset()
+
+
+# ---------------------------------------------------------------------------
+# victim-cost codec + collection (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestVictimCostCodec:
+    def test_roundtrip_lookup_staleness(self):
+        now = time.time()
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-leased-1": (True, 0.0), "uid-spill-22": (False, 0.75)},
+            ts=now)
+        p = vc_mod.parse_victim_costs(vc.encode(), now=now)
+        assert p.tenants == vc.tenants
+        # lookup joins by uid prefix (full uids are longer on the wire)
+        assert p.lookup("uid-leased-1-rest-of-uid") == (True, 0.0)
+        assert p.lookup("uid-unknown") is None
+        assert vc_mod.victim_costs_fresh(p, now=now)
+        late = now + vc_mod.MAX_VICTIM_COST_AGE_S + 10
+        assert not vc_mod.victim_costs_fresh(p, now=late)
+        assert vc_mod.parse_victim_costs(vc.encode(), now=late) is None
+
+    def test_garbage_rows_skipped(self):
+        now = time.time()
+        raw = (f"uid-ok:l:0.5;bad;x:y;u2:-:nan;u3:q:0.1;"
+               f"uid-two:-:2.5@{now:.3f}")
+        p = vc_mod.parse_victim_costs(raw, now=now)
+        assert p.tenants == {"uid-ok": (True, 0.5),
+                             "uid-two": (False, 1.0)}   # frac clamped
+        assert vc_mod.parse_victim_costs("junk") is None
+        assert vc_mod.parse_victim_costs("a:l:0.1@inf") is None
+
+    def test_collect_folds_leases_and_spill(self, tmp_path):
+        from vtpu_manager.config.vmem import VmemLedger, fnv64
+        from vtpu_manager.quota.ledger import QuotaLeaseLedger
+        base = str(tmp_path / "mgr")
+        # two tenants with on-disk configs (the shared walk's shape)
+        for entry in ("uid-borrower_main", "uid-spiller_main"):
+            d = os.path.join(base, entry, "config")
+            os.makedirs(d)
+            with open(os.path.join(d, "vtpu.config"), "wb") as f:
+                f.write(b"\0")
+        ledger = QuotaLeaseLedger(base)
+        ledger.grant(0, "uid-lender/main", "uid-borrower/main", 20,
+                     ttl_s=60.0)
+        vmem_path = str(tmp_path / "vmem.config")
+        vm = VmemLedger(vmem_path, create=True)
+        token = fnv64("uid-spiller/main")
+        vm.record(os.getpid(), 0, 1 << 20, owner_token=token)
+        vm.record_spilled(os.getpid(), 0, 3 << 20, owner_token=token)
+        vm.close()
+        vc = vc_mod.collect_victim_costs(base, vmem_path=vmem_path)
+        assert vc.lookup("uid-borrower") == (True, 0.0)
+        leased, frac = vc.lookup("uid-spiller")
+        assert not leased and frac == pytest.approx(0.75)
+        # source toggles: the gate-scoped publisher arms each column
+        # independently
+        vc2 = vc_mod.collect_victim_costs(base, vmem_path=vmem_path,
+                                          include_leases=False)
+        assert vc2.lookup("uid-borrower") is None
+        # broken sources degrade to absent rows, never raise
+        vc3 = vc_mod.collect_victim_costs(base, vmem_path="/nonexistent",
+                                          include_leases=False)
+        assert vc3.tenants == {}
+
+    def test_publisher_patches_annotation(self, tmp_path):
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "node-1",
+                                      "annotations": {}}})
+        pub = vc_mod.VictimCostPublisher(
+            client, "node-1", str(tmp_path / "mgr"),
+            vmem_path=str(tmp_path / "none.vmem"))
+        pub.publish_once()
+        raw = client.get_node("node-1")["metadata"]["annotations"][
+            consts.node_victim_cost_annotation()]
+        assert vc_mod.parse_victim_costs(raw) is not None
+
+
+# ---------------------------------------------------------------------------
+# victim ordering with lease/spill refinements (satellite 1, preempt)
+# ---------------------------------------------------------------------------
+
+class TestVictimCostOrdering:
+    def _cluster(self, vc_ann=None, headroom=False, headroom_ts=None,
+                 headroom_chips=None):
+        """One 2-chip node, two equal-priority victims. No headroom by
+        default — the victim-cost rollup alone must be able to engage
+        the utilization ordering."""
+        client = FakeKubeClient()
+        reg = dt.fake_registry(2, mesh_shape=(2, 1), uuid_prefix="TPU-V")
+        node = dt.fake_node("node-v", reg)
+        if vc_ann is not None:
+            node["metadata"]["annotations"][
+                consts.node_victim_cost_annotation()] = vc_ann
+        if headroom:
+            node["metadata"]["annotations"][
+                consts.node_reclaimable_headroom_annotation()] = \
+                hr_mod.NodeHeadroom(chips=headroom_chips or {
+                    0: hr_mod.ChipHeadroom(90.0, 85.0, 0.0, 0),
+                    1: hr_mod.ChipHeadroom(90.0, 85.0, 0.0, 0)},
+                    ts=headroom_ts if headroom_ts is not None
+                    else time.time()).encode()
+        client.add_node(node)
+        for name, chip in (("victim-base", reg.chips[0]),
+                           ("victim-cheap", reg.chips[1])):
+            claims = PodDeviceClaims()
+            claims.add("main", DeviceClaim(chip.uuid, chip.index, 90,
+                                           2**30))
+            victim = vtpu_pod(name, node_name="node-v", priority=1,
+                              annotations={
+                                  consts.real_allocated_annotation():
+                                      claims.encode()})
+            victim["status"]["phase"] = "Running"
+            client.add_pod(victim)
+        return client
+
+    def _preempt(self, client, hint=True):
+        pred = PreemptPredicate(client, victim_order_hint=hint)
+        return pred.preempt({
+            "Pod": vtpu_pod("pre", cores=80, priority=100),
+            "NodeNameToVictims": {"node-v": {"Pods": []}}})
+
+    @staticmethod
+    def _names(res):
+        return [p["metadata"]["name"]
+                for p in res.node_to_victims["node-v"].pods]
+
+    def test_lease_holder_is_cheaper_victim(self):
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-victim-che": (True, 0.0)}, ts=time.time())
+        res = self._preempt(self._cluster(vc_ann=vc.encode()))
+        assert self._names(res) == ["victim-cheap"]
+
+    def test_spilled_tenant_is_cheaper_victim(self):
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-victim-che": (False, 0.9),
+             "uid-victim-bas": (False, 0.05)}, ts=time.time())
+        res = self._preempt(self._cluster(vc_ann=vc.encode()))
+        assert self._names(res) == ["victim-cheap"]
+
+    def test_lease_outranks_spill_and_utilization(self):
+        """Key order: a leased victim beats a merely-spilled one even
+        when the headroom rollup says both are equally busy."""
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-victim-che": (True, 0.0),
+             "uid-victim-bas": (False, 0.95)}, ts=time.time())
+        res = self._preempt(self._cluster(vc_ann=vc.encode(),
+                                          headroom=True))
+        assert self._names(res) == ["victim-cheap"]
+
+    def test_stale_headroom_never_feeds_sort_keys(self, monkeypatch):
+        """A fresh victim-cost rollup alone engages the utilization
+        ordering — but a headroom rollup gone stale SINCE the snapshot
+        cached it (dead publisher, no further node events; the TTL
+        path nulls stale headroom at parse, so only the snapshot path
+        can carry one) must not smuggle its est-used keys into the
+        sort. Identical vc rows + a dead publisher claiming
+        victim-cheap is idle: the keys are all-neutral, so the
+        deterministic uid tiebreak picks victim-base — never the stale
+        idleness claim."""
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-victim-che": (False, 0.0),
+             "uid-victim-bas": (False, 0.0)}, ts=time.time())
+        client = self._cluster(
+            vc_ann=vc.encode(), headroom=True,
+            headroom_chips={
+                0: hr_mod.ChipHeadroom(90.0, 85.0, 0.0, 0),   # base busy
+                1: hr_mod.ChipHeadroom(90.0, 2.0, 80.0, 0)})  # cheap idle
+        snap = ClusterSnapshot(client)
+        snap.start()                 # headroom fresh at event-apply
+        assert snap.entry("node-v").headroom is not None
+        import vtpu_manager.scheduler.preempt as preempt_mod
+        monkeypatch.setattr(preempt_mod.hr_mod, "headroom_is_fresh",
+                            lambda hr, now=None: False)
+        pred = PreemptPredicate(client, snapshot=snap,
+                                victim_order_hint=True)
+        res = pred.preempt({
+            "Pod": vtpu_pod("pre", cores=80, priority=100),
+            "NodeNameToVictims": {"node-v": {"Pods": []}}})
+        assert self._names(res) == ["victim-base"]
+
+    def test_stale_rollup_degrades_to_priority_order(self):
+        stale_ts = time.time() - vc_mod.MAX_VICTIM_COST_AGE_S - 60
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-victim-che": (True, 0.9)}, ts=stale_ts)
+        res = self._preempt(self._cluster(vc_ann=vc.encode()))
+        # no fresh signal at all -> the byte-identical priority-only
+        # sort (first resident victim, as in the pre-vtcs tree)
+        assert self._names(res) == ["victim-base"]
+
+    def test_hint_off_ignores_rollup(self):
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-victim-che": (True, 0.9)}, ts=time.time())
+        res = self._preempt(self._cluster(vc_ann=vc.encode()),
+                            hint=False)
+        assert self._names(res) == ["victim-base"]
+
+    def test_priority_still_primary(self):
+        vc = vc_mod.NodeVictimCosts(
+            {"uid-victim-che": (True, 0.9)}, ts=time.time())
+        client = self._cluster(vc_ann=vc.encode())
+        cheap = client.get_pod("default", "victim-cheap")
+        cheap["spec"]["priority"] = 50    # leased BUT higher priority
+        client.add_pod(cheap)
+        res = self._preempt(client)
+        assert self._names(res) == ["victim-base"]
+
+    def test_audit_rows_carry_cost_inputs(self, tmp_path):
+        from vtpu_manager import explain
+        explain.configure("scheduler", spool_dir=str(tmp_path / "ex"),
+                          flush_at=10**9)
+        try:
+            vc = vc_mod.NodeVictimCosts(
+                {"uid-victim-che": (True, 0.25)}, ts=time.time())
+            self._preempt(self._cluster(vc_ann=vc.encode()))
+            rec = next(r for r in explain.recorder()._buf
+                       if r["kind"] == "preempt")
+            vlog = rec["nodes"]["node-v"]
+            assert vlog["ordering"] == "utilization"
+            assert vlog["victim_costs_fresh"] is True
+            kept = {v["name"]: v for v in vlog["victims"]}
+            assert kept["victim-cheap"]["leased"] is True
+            assert kept["victim-cheap"]["spilled_frac"] == 0.25
+        finally:
+            explain.reset()
+
+
+# ---------------------------------------------------------------------------
+# runtime-client + plugin gate contracts
+# ---------------------------------------------------------------------------
+
+class TestGateContracts:
+    def test_runtime_client_constructs_cluster_tier(self, tmp_path,
+                                                    monkeypatch):
+        from vtpu_manager.runtime import client as rt
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE, "true")
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE_DIR,
+                           str(tmp_path / "cc"))
+        monkeypatch.setenv(consts.ENV_CLUSTER_CACHE, "true")
+        rt._reset_compile_cache()
+        try:
+            cc = rt.compile_cache()
+            assert isinstance(cc, ClusterCompileCache)
+        finally:
+            rt._reset_compile_cache()
+
+    def test_runtime_client_gate_off_plain_node_cache(self, tmp_path,
+                                                      monkeypatch):
+        from vtpu_manager.runtime import client as rt
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE, "true")
+        monkeypatch.setenv(consts.ENV_COMPILE_CACHE_DIR,
+                           str(tmp_path / "cc"))
+        monkeypatch.delenv(consts.ENV_CLUSTER_CACHE, raising=False)
+        rt._reset_compile_cache()
+        try:
+            cc = rt.compile_cache()
+            assert type(cc) is CompileCache       # not the cluster tier
+            cc.get_or_compile("k", lambda: b"exe")
+            # zero vtcs artifacts: no marker dir, and _fetch_remote is
+            # the base no-op (no peers read, no sockets)
+            assert not os.path.exists(
+                os.path.join(str(tmp_path / "cc"), advertise.FPS_SUBDIR))
+            assert cc._fetch_remote("k") is None
+        finally:
+            rt._reset_compile_cache()
+
+    def test_vnum_injects_cluster_env_only_when_gated(self, tmp_path):
+        from tests.test_compilecache import allocate_one, make_plugin
+        from vtpu_manager.deviceplugin.api import deviceplugin_pb2 as pb
+        # base gate on, cluster off: no VTPU_CLUSTER_CACHE
+        cresp, _ = allocate_one(tmp_path, gate_on=True)
+        assert consts.ENV_CLUSTER_CACHE not in cresp.envs
+        # both on: the env rides next to the compile-cache pair
+        plugin, client, mgr, device_id = make_plugin(
+            tmp_path / "b", gate_on=True)
+        plugin.cluster_cache_enabled = True
+        chip = mgr.chips[0]
+        claims = PodDeviceClaims()
+        claims.add("main", DeviceClaim(chip.uuid, chip.index, 50,
+                                       2 << 30))
+        client.add_pod({
+            "metadata": {"name": "p1", "namespace": "default",
+                         "uid": "uid-p1", "annotations": {
+                             consts.pre_allocated_annotation():
+                                 claims.encode(),
+                             consts.predicate_node_annotation():
+                                 "node-1"}},
+            "spec": {"nodeName": "node-1",
+                     "containers": [{"name": "main"}]},
+            "status": {"phase": "Pending"}})
+        req = pb.AllocateRequest()
+        req.container_requests.add().devicesIDs.append(
+            device_id(chip.uuid, 0))
+        resp = plugin.allocate(req)
+        assert resp.container_responses[0].envs[
+            consts.ENV_CLUSTER_CACHE] == "true"
+
+
+# ---------------------------------------------------------------------------
+# monitor /cache/entry route (live subprocess e2e)
+# ---------------------------------------------------------------------------
+
+class TestMonitorRoute:
+    @staticmethod
+    def _free_port():
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _run_monitor(self, tmp_path, gate_on):
+        port = self._free_port()
+        base = str(tmp_path / "mgr")
+        cc = CompileCache(os.path.join(base,
+                                       consts.COMPILE_CACHE_SUBDIR))
+        cc.put(KEY_A, b"served-exe")
+        argv = [sys.executable,
+                os.path.join(REPO, "cmd/device_monitor.py"),
+                "--port", str(port), "--host", "127.0.0.1",
+                "--node-name", "node-1", "--fake-chips", "1",
+                "--base-dir", base,
+                "--tc-path", str(tmp_path / "none.tc"),
+                "--vmem-path", str(tmp_path / "none.vmem"),
+                "--trace-spool-dir", str(tmp_path / "spool")]
+        if gate_on:
+            argv += ["--feature-gates", "ClusterCompileCache=true"]
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        return port, proc
+
+    def _wait_healthy(self, port, proc):
+        import urllib.request
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"monitor died: {proc.stdout.read()}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    if r.status == 200:
+                        return
+            except OSError:
+                time.sleep(0.2)
+        raise AssertionError("monitor never became healthy")
+
+    def test_gate_on_serves_verified_entries(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        port, proc = self._run_monitor(tmp_path, gate_on=True)
+        try:
+            self._wait_healthy(port, proc)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/cache/entry?key={KEY_A}",
+                    timeout=10) as r:
+                raw = r.read()
+            assert CompileCache._verify(KEY_A, raw) == b"served-exe"
+            # unknown key -> 404; malformed key -> 400 (never a path)
+            for key, code in ((KEY_B, 404), ("..%2Fetc", 400)):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/cache/entry?key={key}",
+                        timeout=10)
+                assert ei.value.code == code
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_gate_off_no_route(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        port, proc = self._run_monitor(tmp_path, gate_on=False)
+        try:
+            self._wait_healthy(port, proc)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/cache/entry?key={KEY_A}",
+                    timeout=10)
+            assert ei.value.code == 404          # no route at all
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# node metrics: the new fetch counters render
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_fetch_counters_in_node_render(self, tmp_path):
+        from vtpu_manager.compilecache.cache import render_node_metrics
+        root = str(tmp_path / "warm" / "cc")
+        CompileCache(root).put(KEY_A, b"exe")
+        endpoint, _c, srv = serve_root(root)
+        try:
+            cold = str(tmp_path / "cold" / "cc")
+            os.makedirs(cold)
+            write_peers(cold, KEY_A, endpoint)
+            cc = ClusterCompileCache(cold)
+            assert cc.get_or_compile(KEY_A, lambda: b"x")[1] == "fetch"
+            text = render_node_metrics(cold, "node-1")
+            assert 'vtpu_compile_cache_peer_fetches_total' \
+                '{node="node-1"} 1' in text
+            assert 'vtpu_compile_cache_peer_fetch_failures_total' \
+                '{node="node-1"} 0' in text
+        finally:
+            srv.shutdown()

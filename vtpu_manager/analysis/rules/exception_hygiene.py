@@ -5,7 +5,8 @@ kubelet plugin doesn't crash anything — it silently mis-schedules pods,
 drops health flips, or wedges allocations, which is strictly worse. In
 the control-plane packages (scheduler/, manager/, deviceplugin/,
 kubeletplugin/, trace/, client/, resilience/, telemetry/,
-compilecache/, utilization/, explain/, quota/, overcommit/) every
+compilecache/, clustercache/, utilization/, explain/, quota/,
+overcommit/) every
 ``except Exception`` / bare ``except`` must either
 re-raise or log before continuing; bare ``except:`` is always flagged
 (it also eats SystemExit/KeyboardInterrupt).
@@ -27,8 +28,8 @@ RULE = "exception-hygiene"
 
 SCOPED_DIRS = ("scheduler", "manager", "deviceplugin", "kubeletplugin",
                "trace", "client", "resilience", "telemetry",
-               "compilecache", "utilization", "explain", "quota",
-               "overcommit")
+               "compilecache", "clustercache", "utilization", "explain",
+               "quota", "overcommit")
 
 _LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
                 "critical", "log"}

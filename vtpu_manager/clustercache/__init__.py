@@ -1,0 +1,41 @@
+"""vtcs: cluster compile-artifact seeding — the fleet tier over vtcc.
+
+vtcc (compilecache/) makes a NODE compile once; the cluster still
+compiles once *per node*: an autoscaling burst that adds N nodes pays N
+full XLA compiles of the same program fingerprint. This package closes
+that gap with three pieces, all riding channels that already exist:
+
+- ``advertise`` — each device-plugin publishes a compact node
+  annotation of its hottest verified cache entries (bounded,
+  LRU-ordered hottest-first, the pressure/headroom staleness-codec
+  family) and fans every OTHER node's advertisement into a
+  ``peers.json`` under the cache root, so in-container fetchers
+  resolve warm peers without a kube client — warmth is visible
+  cluster-wide with **no new control channel**.
+- ``fetch`` — the node cache's ``get_or_compile`` miss path grows a
+  fetch arm (``ClusterCompileCache``): under the existing born-flock'd
+  single-flight lease (one fetcher per node per key; waiters reuse
+  it), download the checksummed entry from an advertising peer's
+  monitor (``/cache/entry?key=``), re-verify the 24B header before the
+  atomic tmp+fsync+rename ``put``, and **fall open to a real compile**
+  on any failure shape — peer gone, torn payload, timeout budget
+  exceeded — via per-peer circuit breakers (the PR 4 ``KubeResilience``
+  discipline).
+- warm-preference scheduling — the shared ``_allocate_node`` body adds
+  a soft ``warm_term`` bonus for fingerprint-carrying pods on nodes
+  advertising that fingerprint (both data paths; the snapshot keeps a
+  copy-on-write fp→nodes index), recorded in the vtexplain candidate
+  breakdown so spread-vs-warm is auditable.
+
+Everything is behind the ``ClusterCompileCache`` gate (default off =
+byte-identical: no annotation, no peers file, no ``/cache/entry``
+route, zero fetch I/O, placement untouched in both scheduler modes).
+Measured (scripts/bench_clustercache.py): fleet-wide compiles for one
+shared fingerprint = 1 across the simulated fleet, cold-*node*
+time-to-first-step at warm-node order.
+"""
+
+from vtpu_manager.clustercache.advertise import (  # noqa: F401
+    CacheAdvertiser, NodeWarmKeys, parse_warm_keys, warm_term)
+from vtpu_manager.clustercache.fetch import (  # noqa: F401
+    ClusterCompileCache, FetchError, read_entry_for_serving)

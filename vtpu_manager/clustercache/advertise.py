@@ -1,0 +1,365 @@
+"""vtcs warm-keys advertisement: which entries a node can seed peers with.
+
+Wire format (the pressure/headroom/overcommit parse-cheap family,
+staleness explicit by timestamp)::
+
+    "<endpoint>|<fp>=<entry_key>,<fp>=<entry_key>,...@<wall_ts>"
+
+- ``endpoint`` — ``host:port`` of this node's device-monitor, whose
+  auth-gated ``/cache/entry?key=`` route serves the raw checksummed
+  entries (empty = scheduler-visible warmth only, peers cannot fetch);
+- one ``fp=key`` pair per advertised entry, **hottest first** (LRU
+  order by last use), bounded at :data:`MAX_AD_KEYS` so the annotation
+  stays registry-channel sized no matter how big the store grows;
+- ``fp`` is the sanitized program fingerprint (the scheduler's match
+  unit — a pod annotation names a program, not an artifact), ``key``
+  the full 64-hex content address (the fetcher's match unit — an
+  artifact is only reusable when topology + runtime versions hash
+  identically, and the peer must hold EXACTLY that key).
+
+A stale advertisement must decay to no-signal: ``warm_term`` re-judges
+the timestamp at score time (the snapshot caches the parsed object and
+a dead advertiser emits no further node events), and the fetch side
+re-checks it before trusting the peers file. Garbage — unparseable
+body, bad timestamp — reads as None; an individually malformed pair is
+skipped (one corrupt segment must not blind the scheduler to the rest).
+
+The fingerprint→key join the advertisement needs is recorded by the
+cluster cache client at ``get_or_compile`` time as tiny marker files
+under ``<root>/fps/`` (``fps/<fp>`` containing the entry key, mtime =
+last use), so the advertiser scans markers, not payloads.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+
+from vtpu_manager.compilecache.keys import sanitize_fingerprint
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+# staleness family constants (pressure/headroom/overcommit values)
+MAX_AD_AGE_S = 120.0
+FUTURE_SKEW_TOLERANCE_S = 5.0
+
+# bound on advertised pairs: the annotation must stay registry-channel
+# sized; 8 hottest keys cover a node's live program set (a node serves
+# a handful of models, not its whole LRU history)
+MAX_AD_KEYS = 8
+
+# defensive parse bound — an adversarial/corrupt annotation must not
+# cost an unbounded split in the scheduler's event path
+MAX_AD_LEN = 4096
+
+# scoring weight of the warm-preference bonus: enough to beat packing
+# noise and a moderate anti-storm penalty (10/placement), below the
+# pressure ceiling (50) and far below the +100 gang bonus — a gang
+# stays on its slice, a stalling node still repels, but among otherwise
+# comparable nodes the one holding the artifact wins.
+WARM_SCORE_WEIGHT = 30.0
+
+_KEY_RE = re.compile(r"^[0-9a-f]{64}$")
+
+# how stale the advertiser-maintained peers file may be before the
+# fetch side treats the fleet as unknown (covers an advertiser that
+# died after its last fan-in; generous because every entry re-verifies)
+PEERS_STALE_S = 300.0
+
+
+def valid_entry_key(key: str) -> bool:
+    """Whether ``key`` is a well-formed content address (64 lowercase
+    hex). The serving route MUST check this — the key becomes a file
+    name under entries/, and anything else is path traversal."""
+    return bool(_KEY_RE.match(key or ""))
+
+
+@dataclass(frozen=True)
+class NodeWarmKeys:
+    """Decoded warm-keys advertisement."""
+
+    endpoint: str                       # "host:port" | "" (no fetch)
+    pairs: tuple                        # ((fp, key), ...) hottest first
+    ts: float
+
+    @property
+    def fps(self) -> frozenset:
+        return frozenset(fp for fp, _k in self.pairs)
+
+    @property
+    def keys(self) -> frozenset:
+        return frozenset(k for _fp, k in self.pairs)
+
+    def encode(self) -> str:
+        body = ",".join(f"{fp}={key}" for fp, key in self.pairs)
+        return f"{self.endpoint}|{body}@{self.ts:.3f}"
+
+
+def parse_warm_keys(raw: str | None, now: float | None = None,
+                    max_age_s: float = MAX_AD_AGE_S
+                    ) -> NodeWarmKeys | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    every bad shape degrades to no-signal, never to phantom warmth the
+    scheduler would chase or the fetcher would dial."""
+    if not raw or len(raw) > MAX_AD_LEN:
+        return None
+    body, sep, ts_raw = raw.rpartition("@")
+    if not sep:
+        return None
+    try:
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(ts):
+        return None
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+        return None
+    endpoint, sep, pairs_raw = body.partition("|")
+    if not sep:
+        return None
+    pairs = []
+    for seg in pairs_raw.split(","):
+        if not seg:
+            continue
+        fp, _, key = seg.partition("=")
+        # a malformed pair is skipped, not fatal: one corrupt segment
+        # must not blind consumers to the rest of the advertisement
+        if not fp or fp != sanitize_fingerprint(fp) \
+                or not valid_entry_key(key):
+            continue
+        pairs.append((fp, key))
+        if len(pairs) >= MAX_AD_KEYS:
+            break
+    return NodeWarmKeys(endpoint=endpoint, pairs=tuple(pairs), ts=ts)
+
+
+def warm_is_fresh(warm: "NodeWarmKeys | None",
+                  now: float | None = None) -> bool:
+    if warm is None:
+        return False
+    now = time.time() if now is None else now
+    return -FUTURE_SKEW_TOLERANCE_S <= now - warm.ts <= MAX_AD_AGE_S
+
+
+def warm_term(warm: "NodeWarmKeys | None", fingerprint: str,
+              now: float | None = None) -> float:
+    """Score points to ADD for one node already warm for the pod's
+    program fingerprint. Soft like pressure/storm (reorders fits, never
+    vetoes one), and staleness is re-judged HERE at score time — the
+    snapshot path caches the parsed advertisement on the NodeEntry and
+    a dead advertiser emits no further node events, so without a
+    use-time check phantom warmth would attract pods forever."""
+    if not fingerprint or not warm_is_fresh(warm, now):
+        return 0.0
+    return WARM_SCORE_WEIGHT if fingerprint in warm.fps else 0.0
+
+
+# ---------------------------------------------------------------------------
+# fingerprint markers: the fp -> entry-key join the advertiser scans
+# ---------------------------------------------------------------------------
+
+FPS_SUBDIR = "fps"
+
+
+def record_fingerprint(root: str, fingerprint: str, key: str) -> None:
+    """Land/refresh one ``fps/<fp>`` marker (content = entry key,
+    mtime = last use). Atomic tmp+rename like every other store write;
+    best-effort — the marker is advertisement metadata, and a full
+    disk must cost fleet seeding, never the tenant's compile."""
+    fp = sanitize_fingerprint(fingerprint)
+    if not fp or not valid_entry_key(key):
+        return
+    fps_dir = os.path.join(root, FPS_SUBDIR)
+    path = os.path.join(fps_dir, fp)
+    try:
+        try:
+            with open(path) as f:
+                if f.read() == key:
+                    os.utime(path)      # refresh the LRU signal only
+                    return
+        except OSError:
+            pass
+        os.makedirs(fps_dir, exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(key)
+        os.rename(tmp, path)
+    except OSError:
+        log.debug("fingerprint marker write failed for %s", fp,
+                  exc_info=True)
+
+
+def scan_warm_pairs(root: str, max_keys: int = MAX_AD_KEYS) -> list:
+    """((fp, key), ...) hottest-first from the marker dir, advertising
+    only keys whose entry actually exists and is at least header-sized
+    — a marker whose entry was evicted (or torn down to a stub) must
+    not draw fetches that can only 404."""
+    fps_dir = os.path.join(root, FPS_SUBDIR)
+    entries_dir = os.path.join(root, "entries")
+    rows = []
+    try:
+        names = os.listdir(fps_dir)
+    except OSError:
+        return []
+    for name in names:
+        if name.endswith(".tmp"):
+            continue
+        fp = sanitize_fingerprint(name)
+        if fp != name:
+            continue
+        path = os.path.join(fps_dir, name)
+        try:
+            mtime = os.stat(path).st_mtime
+            with open(path) as f:
+                key = f.read().strip()
+        except OSError:
+            continue
+        if not valid_entry_key(key):
+            continue
+        try:
+            if os.stat(os.path.join(entries_dir, key)).st_size < 24:
+                continue
+        except OSError:
+            continue
+        rows.append((mtime, fp, key))
+    rows.sort(reverse=True)
+    return [(fp, key) for _m, fp, key in rows[:max_keys]]
+
+
+# ---------------------------------------------------------------------------
+# advertiser daemon (device-plugin side: the node-annotation owner)
+# ---------------------------------------------------------------------------
+
+class CacheAdvertiser:
+    """Publish this node's warm keys and fan the fleet's in.
+
+    Each tick: (1) scan the marker dir, encode the advertisement, patch
+    the node annotation (the pressure-publisher discipline — failures
+    tolerated per tick, the timestamp ages a silent death out);
+    (2) LIST nodes over the client the daemon already holds, parse every
+    OTHER node's advertisement, and materialize the result as
+    ``peers.json`` under the cache root so in-container fetchers — which
+    have the mount but no kube client — resolve peers from a file, the
+    ``pids.config`` shape.
+    """
+
+    def __init__(self, client, node_name: str, cache_root: str,
+                 endpoint: str = "", policy=None,
+                 interval_s: float = 15.0,
+                 max_keys: int = MAX_AD_KEYS):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.cache_root = cache_root
+        self.endpoint = endpoint
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            deadline_s=10.0)
+        self.interval_s = interval_s
+        self.max_keys = max_keys
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def advertisement(self, now: float | None = None) -> NodeWarmKeys:
+        now = time.time() if now is None else now
+        return NodeWarmKeys(
+            endpoint=self.endpoint,
+            pairs=tuple(scan_warm_pairs(self.cache_root, self.max_keys)),
+            ts=now)
+
+    def publish_once(self) -> NodeWarmKeys:
+        ad = self.advertisement()
+        # chaos: a failed publish must decay peers to no-signal via the
+        # annotation's own timestamp — never crash the daemon loop
+        failpoints.fire("cache.advertise", node=self.node_name)
+        self.policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_cache_keys_annotation(): ad.encode()}),
+            op="clustercache.advertise_patch")
+        return ad
+
+    # -- peer fan-in ---------------------------------------------------------
+
+    def refresh_peers(self, now: float | None = None) -> int:
+        """One LIST over the registry channel -> ``peers.json``. Returns
+        peers written. The file carries its own timestamp so fetchers
+        can judge ITS staleness independently of each embedded
+        advertisement's (both are re-checked fetch-side)."""
+        now = time.time() if now is None else now
+        nodes = self.client.list_nodes()
+        peers = []
+        ann = consts.node_cache_keys_annotation()
+        for node in nodes:
+            meta = node.get("metadata") or {}
+            name = meta.get("name", "")
+            if not name or name == self.node_name:
+                continue
+            warm = parse_warm_keys(
+                (meta.get("annotations") or {}).get(ann), now=now)
+            if warm is None or not warm.endpoint or not warm.pairs:
+                continue
+            peers.append({"node": name, "endpoint": warm.endpoint,
+                          "keys": {key: fp for fp, key in warm.pairs}})
+        doc = {"ts": now, "peers": peers}
+        path = os.path.join(self.cache_root, consts.CACHE_PEERS_NAME)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, separators=(",", ":"))
+        os.rename(tmp, path)
+        return len(peers)
+
+    def tick(self) -> None:
+        self.publish_once()
+        self.refresh_peers()
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — advisory plane: a
+                    # failed tick costs freshness only, and both the
+                    # annotation and peers.json carry timestamps that
+                    # age silent failures out to no-signal
+                    log.warning("cache advertisement tick failed",
+                                exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtcs-advertiser")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def read_peers(cache_root: str, now: float | None = None) -> list[dict]:
+    """The fetch side's peer resolution: parse ``peers.json``, judge its
+    staleness, return the peer rows. Any failure shape — absent file,
+    torn JSON, stale fan-in — reads as "no peers" (the fetch arm then
+    falls open to a compile, never to an error)."""
+    path = os.path.join(cache_root, consts.CACHE_PEERS_NAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+    try:
+        ts = float(doc.get("ts", 0.0))
+    except (TypeError, ValueError):
+        return []
+    now = time.time() if now is None else now
+    if not math.isfinite(ts) or \
+            not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= PEERS_STALE_S:
+        return []
+    peers = doc.get("peers")
+    return peers if isinstance(peers, list) else []

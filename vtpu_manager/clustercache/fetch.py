@@ -1,0 +1,234 @@
+"""vtcs peer fetch: satisfy a compile-cache miss from a warm peer.
+
+Both ends of the wire live here. The **serving** end
+(:func:`read_entry_for_serving`) backs the device-monitor's auth-gated
+``/cache/entry?key=`` route: a verified read of the raw checksummed
+entry (24B header included, so the fetcher re-verifies end-to-end) that
+quarantines corruption exactly like a local reader — the route can
+never become a distribution channel for torn executables. The
+**fetching** end (:class:`ClusterCompileCache`) plugs into the node
+cache's single-flight miss path via the ``_fetch_remote`` hook:
+
+1. resolve peers advertising EXACTLY this entry key from the
+   advertiser-maintained ``peers.json`` (clustercache/advertise.py —
+   the registry-channel fan-in materialized under the cache root, so
+   in-container fetchers need no kube client);
+2. download under the lease the caller already holds (one fetcher per
+   node per key; waiters reuse whatever lands), each attempt bounded
+   by its own timeout and the whole ladder by a total budget sized
+   under the single-flight stale-lease window;
+3. stage the payload to a temp file (the ``cache.fetch`` failpoint's
+   partial-write tears it THERE — the torn-download state), read it
+   back, and re-verify magic/length/checksum before returning it for
+   the atomic ``put``;
+4. fall open on every failure shape — peer gone, HTTP error, torn
+   payload, budget exceeded — returning None so the caller compiles.
+   Per-peer circuit breakers (the PR 4 discipline) stop a dead peer
+   from taxing every subsequent miss with a connect timeout.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import secrets
+import time
+import urllib.error
+import urllib.request
+
+from vtpu_manager.clustercache import advertise
+from vtpu_manager.compilecache.cache import CompileCache
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.policy import CircuitBreaker
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+# per-attempt and whole-ladder budgets: the ladder must resolve (fetch
+# or give up) well inside the single-flight stale-lease window (300 s)
+# so waiters never judge a live fetcher dead mid-download
+FETCH_TIMEOUT_S = 10.0
+FETCH_TOTAL_BUDGET_S = 30.0
+MAX_PEERS_TRIED = 3
+
+# an executable entry larger than this is not one of ours — bound the
+# download so a confused/malicious peer cannot balloon tenant memory
+MAX_FETCH_BYTES = 1 << 30
+
+
+class FetchError(Exception):
+    """One peer attempt failed (transport, HTTP status, oversize)."""
+
+
+def fetch_entry(endpoint: str, key: str,
+                timeout_s: float = FETCH_TIMEOUT_S,
+                token: str | None = None) -> bytes:
+    """Download one raw entry (header + payload) from a peer monitor.
+    Raises FetchError on any failure; the caller's ladder decides what
+    that costs (never more than falling open to a compile)."""
+    url = f"http://{endpoint}/cache/entry?key={key}"
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            raw = resp.read(MAX_FETCH_BYTES + 1)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise FetchError(f"peer {endpoint} fetch failed: {e}") from e
+    if len(raw) > MAX_FETCH_BYTES:
+        raise FetchError(f"peer {endpoint} entry exceeds "
+                         f"{MAX_FETCH_BYTES} bytes")
+    return raw
+
+
+def read_entry_for_serving(root: str, key: str) -> bytes | None:
+    """The monitor route's read: raw verified entry bytes (header
+    included) or None (absent/corrupt — corrupt is quarantined by the
+    one-racer rename, same as a local reader). Never the scrape path;
+    never serves bytes that fail the checksum."""
+    if not advertise.valid_entry_key(key):
+        return None
+    path = os.path.join(root, "entries", key)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    if CompileCache._verify(key, raw) is None:
+        dst = os.path.join(root, "quarantine", f"{key}.{time.time_ns()}")
+        try:
+            os.rename(path, dst)
+            log.error("compile cache entry %s failed verification at "
+                      "serve time; quarantined to %s", key, dst)
+        except OSError:
+            pass
+        return None
+    return raw
+
+
+class ClusterCompileCache(CompileCache):
+    """The node cache plus the peer-fetch miss arm and the fingerprint
+    markers the advertiser scans. Construction cost over the base class
+    is nil (the marker dir is made lazily on first record); with no
+    peers file present the fetch arm is one failed open() per miss
+    (and misses are compile-scale rare)."""
+
+    def __init__(self, root: str, token: str | None = None,
+                 fetch_timeout_s: float = FETCH_TIMEOUT_S,
+                 total_budget_s: float = FETCH_TOTAL_BUDGET_S,
+                 **kwargs):
+        super().__init__(root, **kwargs)
+        self.token = token if token is not None else \
+            os.environ.get(consts.ENV_CACHE_PEER_TOKEN) or None
+        self.fetch_timeout_s = fetch_timeout_s
+        self.total_budget_s = total_budget_s
+        # per-endpoint breakers: a dead peer must stop costing connect
+        # timeouts after a few misses, and recover by probe
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    # -- fingerprint markers -------------------------------------------------
+
+    def get_or_compile(self, key: str, compile_fn,
+                       timeout_s: float = 600.0, ctx=None,
+                       fingerprint: str = "") -> tuple[bytes, str]:
+        payload, outcome = super().get_or_compile(
+            key, compile_fn, timeout_s=timeout_s, ctx=ctx)
+        if fingerprint and outcome != "timeout":
+            # the marker records "this node can seed <fp> via <key>" —
+            # a timeout outcome landed nothing, so it advertises nothing
+            advertise.record_fingerprint(self.root, fingerprint, key)
+        return payload, outcome
+
+    # -- the fetch arm (runs under the population lease) ---------------------
+
+    def _peer_endpoints(self, key: str) -> list[tuple[str, str]]:
+        """(node, endpoint) rows advertising exactly this entry key,
+        in the advertiser's fan-in order."""
+        out = []
+        for peer in advertise.read_peers(self.root):
+            if not isinstance(peer, dict):
+                continue
+            keys = peer.get("keys")
+            endpoint = peer.get("endpoint", "")
+            if endpoint and isinstance(keys, dict) and key in keys:
+                out.append((peer.get("node", ""), endpoint))
+        return out
+
+    def _breaker(self, endpoint: str) -> CircuitBreaker:
+        breaker = self._breakers.get(endpoint)
+        if breaker is None:
+            breaker = CircuitBreaker(name=f"cache.fetch[{endpoint}]",
+                                     failure_threshold=3,
+                                     reset_timeout_s=30.0)
+            self._breakers[endpoint] = breaker
+        return breaker
+
+    def _fetch_remote(self, key: str) -> bytes | None:
+        """Resolve peers, download, verify; None = compile locally.
+        Every failure shape is absorbed HERE (counted, breaker-fed,
+        logged) except CrashFailpoint — which, being process-death
+        semantics, must leave the lease exactly as real death would."""
+        peers = self._peer_endpoints(key)
+        if not peers:
+            return None
+        deadline = time.monotonic() + self.total_budget_s
+        tried = 0
+        for node, endpoint in peers:
+            if tried >= MAX_PEERS_TRIED or time.monotonic() >= deadline:
+                break
+            breaker = self._breaker(endpoint)
+            if not breaker.allow():
+                continue
+            tried += 1
+            attempt_s = min(self.fetch_timeout_s,
+                            max(0.1, deadline - time.monotonic()))
+            tmp = os.path.join(
+                self.tmp_dir,
+                f"{key}.fetch.{os.getpid()}.{secrets.token_hex(4)}")
+            try:
+                raw = fetch_entry(endpoint, key, timeout_s=attempt_s,
+                                  token=self.token)
+                # stage + read back: the partial-write failpoint tears
+                # the staged bytes exactly where a dropped connection
+                # would, and what we VERIFY is what we later put()
+                with open(tmp, "wb") as f:
+                    f.write(raw)
+                failpoints.fire("cache.fetch", key=key, path=tmp,
+                                peer=node)
+                with open(tmp, "rb") as f:
+                    staged = f.read()
+                payload = self._verify(key, staged)
+                if payload is None:
+                    raise FetchError(
+                        f"peer {endpoint} served a torn/corrupt entry "
+                        f"({len(staged)} bytes)")
+            except Exception as e:  # noqa: BLE001 — the ladder's whole
+                # contract: ANY failure shape (transport, injected
+                # error, torn payload) costs one rung, never the tenant
+                # — CrashFailpoint is a BaseException and deliberately
+                # NOT caught here: it propagates like real process
+                # death, leaving the lease AND the torn staging file
+                # exactly as a killed fetcher would (the evictor reaps
+                # the temp; a waiter takes the lease over)
+                breaker.record_failure()
+                self.stats.peer_fetch_failures += 1
+                self._flush_stats()
+                log.warning("peer fetch of %s from %s failed: %s",
+                            key[:16], endpoint, e)
+                self._unlink_quiet(tmp)
+                continue
+            self._unlink_quiet(tmp)
+            breaker.record_success()
+            self.stats.peer_fetches += 1
+            self._flush_stats()
+            log.info("compile cache entry %s seeded from peer %s (%s)",
+                     key[:16], node, endpoint)
+            return payload
+        return None
+
+    @staticmethod
+    def _unlink_quiet(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass

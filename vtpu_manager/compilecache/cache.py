@@ -83,8 +83,12 @@ _STATS_DEAD_AGE_S = 60.0
 
 _POLL_S = 0.05                # waiter poll cadence while a lease is held
 
+# peer_fetches / peer_fetch_failures are vtcs counters: the cluster
+# tier (clustercache/fetch.py) bumps them when a miss is satisfied by a
+# peer download instead of a compile. Plain node-local clients simply
+# never increment them; stats files lacking the keys fold as zero.
 STAT_FIELDS = ("hits", "misses", "single_flight_waits", "evictions",
-               "quarantined")
+               "quarantined", "peer_fetches", "peer_fetch_failures")
 
 
 def _fnv1a64(data: bytes) -> int:
@@ -437,12 +441,24 @@ class CompileCache:
                     key=key[:16])
         return payload, outcome
 
+    def _fetch_remote(self, key: str) -> bytes | None:
+        """vtcs hook: attempt to satisfy a miss from a warm peer BEFORE
+        compiling. Runs only under the population lease (the existing
+        single-flight discipline: one fetcher per node per key, waiters
+        reuse whatever it lands). The node-local base class has no
+        peers — this returns None, which IS the gate-off contract: zero
+        fetch I/O, the compile arm runs exactly as before. The cluster
+        tier (clustercache.fetch.ClusterCompileCache) overrides it with
+        the advertisement-resolved download + verify ladder."""
+        return None
+
     def _get_or_compile(self, key: str, compile_fn,
                         timeout_s: float) -> tuple[bytes, str]:
         """Stat contract: one op counts exactly one of hits (served from
-        cache, including after a single-flight wait) or misses (this
-        process compiled — timeout fail-open included); waits add
-        single_flight_waits on top. The polling loop uses the stat-free
+        cache, including after a single-flight wait or a peer fetch) or
+        misses (this process compiled — timeout fail-open included);
+        waits add single_flight_waits on top, peer fetches add
+        peer_fetches on top. The polling loop uses the stat-free
         _lookup so waiting never fabricates misses."""
         payload = self._lookup(key)
         if payload is not None:
@@ -466,6 +482,25 @@ class CompileCache:
                     # holding the lease — waiters must take over within
                     # the stale budget, not block to their deadline
                     failpoints.fire("cache.lease", key=key)
+                    # vtcs: a warm peer beats a compile. The fetch runs
+                    # under the same lease the compile would (one
+                    # fetcher per node per key; waiters reuse the
+                    # landed entry), and ANY failure shape inside it —
+                    # peer gone, torn payload, timeout — returns None
+                    # and falls open to the real compile below.
+                    fetched = self._fetch_remote(key)
+                    if fetched is not None:
+                        try:
+                            self.put(key, fetched)
+                        except OSError:
+                            log.warning(
+                                "compile cache put of fetched entry "
+                                "failed for %s; serving unshared", key,
+                                exc_info=True)
+                        self.release_lease(key)
+                        self.stats.hits += 1
+                        self._flush_stats()
+                        return fetched, "fetch"
                     payload = compile_fn()
                     try:
                         self.put(key, payload)
@@ -745,6 +780,8 @@ def render_node_metrics(root: str, node_name: str) -> str:
         "# TYPE vtpu_compile_cache_single_flight_waits_total counter",
         "# TYPE vtpu_compile_cache_evictions_total counter",
         "# TYPE vtpu_compile_cache_quarantined_total counter",
+        "# TYPE vtpu_compile_cache_peer_fetches_total counter",
+        "# TYPE vtpu_compile_cache_peer_fetch_failures_total counter",
         "# TYPE vtpu_compile_cache_entries gauge",
         "# TYPE vtpu_compile_cache_size_bytes gauge",
     ]

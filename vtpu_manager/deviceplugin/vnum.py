@@ -55,6 +55,10 @@ class VnumPlugin(DevicePluginServicer):
     preferred_allocation_available = False   # gated: HonorPreAllocatedDeviceIDs
     step_telemetry_enabled = False           # gated: StepTelemetry (vttel)
     compile_cache_enabled = False            # gated: CompileCache (vtcc)
+    cluster_cache_enabled = False            # gated: ClusterCompileCache
+                                             # (vtcs; requires vtcc — the
+                                             # node store is the landing
+                                             # surface either way)
     quota_market_enabled = False             # gated: QuotaMarket (vtqm)
     hbm_overcommit_enabled = False           # gated: HBMOvercommit (vtovc)
     # vtovc: the node's live policy engine (OvercommitPolicy | None) —
@@ -508,6 +512,13 @@ class VnumPlugin(DevicePluginServicer):
                 resp.envs[consts.ENV_COMPILE_CACHE] = "true"
                 resp.envs[consts.ENV_COMPILE_CACHE_DIR] = \
                     consts.COMPILE_CACHE_DIR
+                if self.cluster_cache_enabled:
+                    # vtcs: the cluster tier arms only on top of a
+                    # mounted node cache (cc_ok) — the runtime client
+                    # then constructs a ClusterCompileCache whose miss
+                    # path peer-fetches via the peers.json the
+                    # advertiser maintains under the same mount
+                    resp.envs[consts.ENV_CLUSTER_CACHE] = "true"
             if self.step_telemetry_enabled:
                 # vttel: the per-container telemetry subdir (next to the
                 # read-only config) is the ONE writable surface the

@@ -107,11 +107,18 @@ class DecisionBuilder:
                   storm: float, gang_bonus: float, headroom_input: float,
                   topology: str, total: float,
                   headroom_term: float = 0.0, spill: float = 0.0,
-                  virt_ratio: float = 1.0) -> None:
+                  virt_ratio: float = 1.0,
+                  warm_term: float = 0.0) -> None:
         """One scored candidate with the EXACT values applied:
         ``total == base - pressure - storm - spill + gang_bonus +
-        headroom_term`` holds by construction (asserted end-to-end by
-        test_explain/test_quota/test_overcommit). ``headroom_input`` is
+        headroom_term + warm_term`` holds by construction (asserted
+        end-to-end by test_explain/test_quota/test_overcommit/
+        test_clustercache). ``warm_term`` is the vtcs warm-preference
+        bonus (0.0 unless the ClusterCompileCache gate scored a node
+        advertising the pod's fingerprint — recorded only then, so
+        gate-off records keep their exact prior shape; the spread-vs-
+        warm tension against the anti-storm penalty is auditable from
+        the row alone). ``headroom_input`` is
         the raw vtuse signal; ``headroom_term`` is what the QuotaMarket
         gate actually scored from it (0.0 when the gate is off, the pod
         is not latency-critical, or the signal was stale — the
@@ -134,6 +141,9 @@ class DecisionBuilder:
             # candidate — gate-off records keep their exact prior shape
             row["spill"] = spill
             row["virt_ratio"] = virt_ratio
+        if warm_term:
+            # vtcs: same appear-only-when-scored rule as the vtovc terms
+            row["warm_term"] = warm_term
         cands = self.record["candidates"]
         if len(cands) < MAX_CANDIDATES:
             cands.append(row)

@@ -1,0 +1,253 @@
+"""Preemption victim-cost rollup: which residents are CHEAP to evict.
+
+Closes the two carried victim-ordering gaps (ROADMAP quota item (c) +
+vtovc item (c)): priority stays the primary preemption key, but among
+equal-priority candidates two node-local facts make a victim strictly
+cheaper than its measured utilization alone suggests —
+
+- **lease state** (vtqm): a tenant holding an active quota *borrow*
+  lease is running on capacity that is revocable/expiring by contract;
+  evicting it destroys less durable entitlement than evicting a
+  base-allocation tenant of the same priority.
+- **spill residency** (vtovc): a tenant whose working set is mostly
+  host-resident (vmem ``spilled`` / (resident + spilled)) has already
+  lost its HBM locality — eviction forfeits little the spill tier
+  hasn't forfeited, and frees the HBM pressure that drove the spilling.
+
+Both facts live in node-local files (the quota lease ledger, the vmem
+ledger) the scheduler can't read, so the device-plugin publishes a
+compact per-tenant rollup over the registry channel::
+
+    "<uid12>:<lease_flag>:<spill_frac>;...@<wall_ts>"
+
+``uid12`` is the pod-uid prefix (the victim join key), ``lease_flag``
+``l``/``-`` (active borrow lease or not), ``spill_frac`` a 0..1
+decimal. Staleness-by-timestamp family like pressure/headroom: the
+preempt path re-judges freshness at use time, and a stale or absent
+rollup degrades the victim sort to the byte-identical priority-only
+(or utilization-only) order — an eviction justified by a dead
+publisher's claims would be a real pod killed over a ghost signal.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass
+
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+MAX_VICTIM_COST_AGE_S = 120.0
+FUTURE_SKEW_TOLERANCE_S = 5.0
+
+UID_PREFIX_LEN = 12
+
+# bound the annotation: a node hosts tens of tenants, not thousands —
+# and the parse is per preempt candidate, so it must stay cheap
+MAX_TENANTS = 64
+MAX_VC_LEN = 4096
+
+
+@dataclass(frozen=True)
+class NodeVictimCosts:
+    """Decoded rollup: uid-prefix -> (holds_lease, spill_frac)."""
+
+    tenants: dict
+    ts: float
+
+    def encode(self) -> str:
+        body = ";".join(
+            f"{uid}:{'l' if leased else '-'}:{frac:.3f}"
+            for uid, (leased, frac) in sorted(self.tenants.items()))
+        return f"{body}@{self.ts:.3f}"
+
+    def lookup(self, pod_uid: str) -> tuple[bool, float] | None:
+        """(holds_lease, spill_frac) for a victim, joined by uid
+        prefix; None = this tenant has no published row (no signal,
+        which must read as 'not cheaper', never as 'cheapest')."""
+        return self.tenants.get((pod_uid or "")[:UID_PREFIX_LEN])
+
+
+def parse_victim_costs(raw: str | None, now: float | None = None,
+                       max_age_s: float = MAX_VICTIM_COST_AGE_S
+                       ) -> NodeVictimCosts | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    the codec-family contract: garbage degrades to no-signal, and
+    no-signal degrades the ordering to the priority-only sort."""
+    if not raw or len(raw) > MAX_VC_LEN:
+        return None
+    body, sep, ts_raw = raw.rpartition("@")
+    if not sep:
+        return None
+    try:
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(ts):
+        return None
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+        return None
+    tenants: dict = {}
+    for seg in body.split(";"):
+        if not seg:
+            continue
+        parts = seg.split(":")
+        if len(parts) != 3:
+            continue        # one malformed row never blinds the rest
+        uid, flag, frac_raw = parts
+        if not uid or flag not in ("l", "-"):
+            continue
+        try:
+            frac = float(frac_raw)
+        except (TypeError, ValueError):
+            continue
+        if not math.isfinite(frac):
+            continue
+        tenants[uid[:UID_PREFIX_LEN]] = (flag == "l",
+                                         min(max(frac, 0.0), 1.0))
+        if len(tenants) >= MAX_TENANTS:
+            break
+    return NodeVictimCosts(tenants=tenants, ts=ts)
+
+
+def victim_costs_fresh(vc: "NodeVictimCosts | None",
+                       now: float | None = None) -> bool:
+    """Use-time freshness re-judgement (the pressure-penalty rule: the
+    snapshot caches the parsed object and a dead publisher emits no
+    further node events)."""
+    if vc is None:
+        return False
+    now = time.time() if now is None else now
+    return -FUTURE_SKEW_TOLERANCE_S <= now - vc.ts \
+        <= MAX_VICTIM_COST_AGE_S
+
+
+# ---------------------------------------------------------------------------
+# collection (device-plugin side: where the ledgers live)
+# ---------------------------------------------------------------------------
+
+def collect_victim_costs(base_dir: str, vmem_path: str | None = None,
+                         include_leases: bool = True,
+                         include_spill: bool = True,
+                         now: float | None = None) -> NodeVictimCosts:
+    """Fold the node's quota lease ledger and vmem ledger into one
+    rollup. Either source may be disabled (its gate off) or broken —
+    a tenant simply gets no row / a partial row, and absent rows read
+    as 'not cheaper' on the preempt side."""
+    now = time.time() if now is None else now
+    tenants: dict = {}
+
+    def row(uid: str) -> list:
+        key = uid[:UID_PREFIX_LEN]
+        got = tenants.get(key)
+        if got is None:
+            got = [False, 0.0]
+            tenants[key] = got
+        return got
+
+    if include_leases:
+        try:
+            from vtpu_manager.quota.ledger import QuotaLeaseLedger
+            ledger = QuotaLeaseLedger(base_dir)
+            if ledger.exists():
+                for lease in ledger.snapshot(now=now).active:
+                    borrower = lease.get("borrower", "")
+                    uid = borrower.partition("/")[0]
+                    if uid:
+                        row(uid)[0] = True
+        except Exception:  # noqa: BLE001 — a torn ledger costs the
+            # lease column only; the codec's absent-row semantics carry
+            log.warning("victim-cost lease fold failed", exc_info=True)
+
+    if include_spill:
+        try:
+            from vtpu_manager.config.tenantdirs import \
+                iter_container_config_paths
+            from vtpu_manager.config.vmem import VmemLedger, fnv64
+            # resident/spilled bytes per owner token, then joined back
+            # to pod uids through the one shared tenant-dir walk (the
+            # vtuse join rule — one labeling, or joins desynchronize)
+            by_token: dict[int, list] = {}
+            ledger = VmemLedger(vmem_path or consts.VMEM_NODE_CONFIG)
+            try:
+                for entry in ledger.entries():
+                    tot = by_token.setdefault(entry.owner_token,
+                                              [0, 0])
+                    tot[0] += entry.bytes
+                    tot[1] += entry.spilled
+            finally:
+                ledger.close()
+            for pod_uid, label, _path, _dra in \
+                    iter_container_config_paths(base_dir):
+                tot = by_token.get(fnv64(f"{pod_uid}/{label}"))
+                if tot is None:
+                    continue
+                resident, spilled = tot
+                if resident + spilled <= 0:
+                    continue
+                frac = spilled / (resident + spilled)
+                got = row(pod_uid)
+                got[1] = max(got[1], frac)
+        except Exception:  # noqa: BLE001 — same posture: the spill
+            # column degrades to 0.0, never to a wrong eviction
+            log.warning("victim-cost spill fold failed", exc_info=True)
+
+    return NodeVictimCosts(
+        tenants={k: (v[0], v[1]) for k, v in tenants.items()}, ts=now)
+
+
+class VictimCostPublisher:
+    """Daemon loop: collect the rollup, patch the node annotation —
+    the pressure-publisher discipline (per-tick failure tolerance, the
+    timestamp ages a silent death out to no-signal)."""
+
+    def __init__(self, client, node_name: str, base_dir: str,
+                 vmem_path: str | None = None,
+                 include_leases: bool = True,
+                 include_spill: bool = True,
+                 policy=None, interval_s: float = 15.0):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.base_dir = base_dir
+        self.vmem_path = vmem_path
+        self.include_leases = include_leases
+        self.include_spill = include_spill
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            deadline_s=10.0)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def publish_once(self) -> NodeVictimCosts:
+        vc = collect_victim_costs(
+            self.base_dir, vmem_path=self.vmem_path,
+            include_leases=self.include_leases,
+            include_spill=self.include_spill)
+        self.policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_victim_cost_annotation(): vc.encode()}),
+            op="victimcost.patch")
+        return vc
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.publish_once()
+                except Exception:  # noqa: BLE001 — advisory signal;
+                    # the annotation timestamp ages silence out
+                    log.warning("victim-cost publish failed",
+                                exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtpu-victimcost")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -79,6 +79,19 @@ SITES: dict[str, str] = {
                    "is acquired and before the compile runs (crash = a "
                    "dead lease holder waiters must take over within the "
                    "stale-lease budget)",
+    "cache.fetch": "clustercache/fetch.py _fetch_remote, after the peer "
+                   "payload is staged to a temp file and before the "
+                   "read-back verify (error = peer/transport failure the "
+                   "ladder must absorb by compiling; latency = a slow "
+                   "peer the timeout budget must bound; partial-write = "
+                   "a torn payload mid-download that must fail "
+                   "verification and never land as a servable entry)",
+    "cache.advertise": "clustercache/advertise.py publish_once, after "
+                       "the advertisement is encoded and before the "
+                       "node-annotation patch (error = a failed publish "
+                       "the annotation's own timestamp ages out — "
+                       "peers decay to no-signal, never fetch from a "
+                       "ghost)",
     "util.fold": "utilization/ledger.py fold entry (the scrape-time "
                  "ledger fold; error = a torn fold the collector must "
                  "flag without blocking /metrics, headroom decays to "

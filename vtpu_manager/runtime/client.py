@@ -333,6 +333,21 @@ def compile_cache():
     from vtpu_manager.compilecache import CompileCache
     root = os.environ.get(consts.ENV_COMPILE_CACHE_DIR) or \
         consts.COMPILE_CACHE_DIR
+    if os.environ.get(consts.ENV_CLUSTER_CACHE) == "true":
+        # vtcs: the cluster tier — same store, plus the peer-fetch miss
+        # arm resolving warm peers from the advertiser-maintained
+        # peers.json under the mount. Off (the default) constructs the
+        # plain node-local client: zero fetch I/O, no fps/ markers.
+        from vtpu_manager.clustercache import ClusterCompileCache
+        try:
+            _compile_cache = ClusterCompileCache(root)
+        except OSError as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "cluster compile cache unavailable at %s (%s); "
+                "compiling uncached", root, e)
+            _compile_cache = None
+        return _compile_cache
     try:
         _compile_cache = CompileCache(root)
     except OSError as e:

@@ -47,6 +47,7 @@ from vtpu_manager.device.allocator.request import (AllocationRequest,
                                                    RequestError,
                                                    build_allocation_request)
 from vtpu_manager.device import types as dt
+from vtpu_manager.clustercache import advertise as cc_advertise
 from vtpu_manager.compilecache import antistorm
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
@@ -107,9 +108,23 @@ class FilterPredicate:
                  anti_storm: bool = False,
                  utilization_hint: bool = False,
                  quota_market: bool = False,
-                 hbm_overcommit: bool = False):
+                 hbm_overcommit: bool = False,
+                 cluster_cache: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtcs (ClusterCompileCache gate; default off = byte-identical
+        # placement in BOTH data paths): a fingerprint-carrying pod
+        # gets a soft warm_term bonus on nodes whose warm-keys
+        # advertisement names its program — the artifact is already
+        # there, so landing there starts at warm-node speed without a
+        # fetch. Soft like pressure/storm (reorders fits, never vetoes
+        # one), staleness re-judged at score time (a dead advertiser's
+        # phantom warmth decays), decoded per-candidate on the TTL
+        # path and at event-apply on the snapshot path (NodeEntry.
+        # warm), and the term rides the vtexplain candidate record so
+        # spread-vs-warm is auditable. Rides filter_kwargs so vtha
+        # shards inherit it.
+        self.cluster_cache = cluster_cache
         # vtovc (HBMOvercommit gate; default off = byte-identical
         # placement in BOTH data paths): admit the memory axis against
         # VIRTUAL capacity — physical × the node's published per-class
@@ -616,12 +631,17 @@ class FilterPredicate:
 
         assumed_by_node = self._assumed_by_node()
         spread = req.node_policy == consts.NODE_POLICY_SPREAD
-        # vtcc anti-storm (gate off => "" => zero extra work, scores
-        # byte-identical): the pod's program fingerprint keys the
-        # recently-placed-same-program penalty both paths apply; the
-        # uid keeps a re-filtered committed pod from repelling itself
-        # through the unbound-commitment scan
-        pod_fp = antistorm.pod_fingerprint(pod) if self.anti_storm else ""
+        # The program fingerprint keys TWO soft terms, each behind its
+        # own gate (both off => "" => zero extra work, scores
+        # byte-identical): vtcc anti-storm repels the next replica from
+        # nodes that just took one (spread the cold wave), vtcs
+        # warm-preference attracts replicas to nodes ALREADY advertising
+        # the compiled artifact. The uid keeps a re-filtered committed
+        # pod from repelling itself through the unbound-commitment scan.
+        fp = (antistorm.pod_fingerprint(pod)
+              if (self.anti_storm or self.cluster_cache) else "")
+        pod_fp = fp if self.anti_storm else ""       # storm signal key
+        warm_fp = fp if self.cluster_cache else ""   # vtcs warm key
         pod_uid = (pod.get("metadata") or {}).get("uid", "")
         # vtqm: the headroom term scores only latency-critical pods
         # (one webhook-normalized annotation read per pass; gate off or
@@ -645,13 +665,15 @@ class FilterPredicate:
                 snap, req, candidates, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
                 reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
-                explain_b=explain_b, hr_term=hr_term, oc_class=oc_class)
+                explain_b=explain_b, hr_term=hr_term, oc_class=oc_class,
+                warm_fp=warm_fp)
         else:
             scored = self._ttl_scored(
                 req, candidates, by_node, assumed_by_node, spread,
                 gang_domains, gang_siblings, prefer_origin, result,
                 reasons, now, pod_fp=pod_fp, pod_uid=pod_uid,
-                explain_b=explain_b, hr_term=hr_term, oc_class=oc_class)
+                explain_b=explain_b, hr_term=hr_term, oc_class=oc_class,
+                warm_fp=warm_fp)
 
         if not scored:
             result.error = reasons.summary() or "no schedulable vtpu node"
@@ -728,7 +750,8 @@ class FilterPredicate:
                     prefer_origin, result: FilterResult, reasons,
                     now: float, pod_fp: str = "", pod_uid: str = "",
                     explain_b=None, hr_term: bool = False,
-                    oc_class: str = "") -> list[ScoredNode]:
+                    oc_class: str = "",
+                    warm_fp: str = "") -> list[ScoredNode]:
         """TTL-path ranking: gate + rank every surviving node on fast
         free totals (memoized registry totals minus claim sums — no
         DeviceUsage materialized), then build the full usage view lazily,
@@ -737,6 +760,7 @@ class FilterPredicate:
         reg_ann = consts.node_device_register_annotation()
         hr_ann = consts.node_reclaimable_headroom_annotation()
         oc_ann = consts.node_overcommit_annotation()
+        warm_ann = consts.node_cache_keys_annotation()
         now_visible: set[str] = set()
         req_number, req_cores, req_memory = (
             req.total_number(), req.total_cores(), req.total_memory())
@@ -821,9 +845,16 @@ class FilterPredicate:
             # pass carries None.
             hr_raw = ((meta.get("annotations") or {}).get(hr_ann)
                       if explain_b is not None or hr_term else None)
+            # vtcs: same raw-ride discipline as headroom — one dict-get
+            # per ranked node, parsed only for nodes the allocation
+            # loop actually visits (and only for fingerprinted pods
+            # under the gate; every other pass carries None)
+            warm_raw = ((meta.get("annotations") or {}).get(warm_ann)
+                        if warm_fp else None)
             ranked.append((free_cores + (free_memory >> 24) + free_number,
                            name, registry, counted, assumed, pressure,
-                           storm, hr_raw, overcommit, oc_ratio))
+                           storm, hr_raw, overcommit, oc_ratio,
+                           warm_raw))
         if now_visible:
             self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
@@ -841,7 +872,7 @@ class FilterPredicate:
         # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
         for rank, (_, name, registry, counted, assumed, pressure,
-                   storm, hr_raw, overcommit, oc_ratio) \
+                   storm, hr_raw, overcommit, oc_ratio, warm_raw) \
                 in enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
@@ -854,7 +885,9 @@ class FilterPredicate:
                                     hr_raw) if hr_raw else None,
                                 explain_b=explain_b, hr_term=hr_term,
                                 overcommit=overcommit,
-                                oc_ratio=oc_ratio)
+                                oc_ratio=oc_ratio, warm_fp=warm_fp,
+                                warm=cc_advertise.parse_warm_keys(
+                                    warm_raw) if warm_raw else None)
         return scored
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
@@ -864,7 +897,8 @@ class FilterPredicate:
                          result: FilterResult, reasons,
                          now: float, pod_fp: str = "", pod_uid: str = "",
                          explain_b=None, hr_term: bool = False,
-                         oc_class: str = "") -> list[ScoredNode]:
+                         oc_class: str = "",
+                         warm_fp: str = "") -> list[ScoredNode]:
         """Snapshot-path candidate walk. The capacity rank is maintained
         by the snapshot O(log n) per event, so the pass walks its head in
         policy order (ascending for binpack, descending for spread) and
@@ -905,6 +939,14 @@ class FilterPredicate:
         visited = 0
         lazy_gate = candidates is None
         fp_overlay = self._recent_fp_overlay(now) if pod_fp else {}
+        # vtcs: one O(1) reverse lookup per pass on the snapshot's
+        # copy-on-write fp→nodes index — only indexed nodes carry their
+        # parsed advertisement into scoring (warm_term still re-judges
+        # staleness per use; the index and NodeEntry.warm are updated
+        # by the same event apply, so membership is never narrower
+        # than the entry's own fps)
+        warm_set = frozenset(snap.warm_nodes(warm_fp)) if warm_fp \
+            else frozenset()
 
         def visit(entry) -> None:
             nonlocal visited
@@ -966,7 +1008,9 @@ class FilterPredicate:
                                 else None,
                                 explain_b=explain_b, hr_term=hr_term,
                                 overcommit=overcommit,
-                                oc_ratio=oc_ratio)
+                                oc_ratio=oc_ratio, warm_fp=warm_fp,
+                                warm=entry.warm if name in warm_set
+                                else None)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -1005,7 +1049,8 @@ class FilterPredicate:
                        pressure=None, storm_fp: str = "",
                        storm_recent=(), headroom=None,
                        explain_b=None, hr_term: bool = False,
-                       overcommit=None, oc_ratio: float = 1.0) -> None:
+                       overcommit=None, oc_ratio: float = 1.0,
+                       warm_fp: str = "", warm=None) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
         drift between them (and so the vtexplain breakdown is assembled
@@ -1079,6 +1124,16 @@ class FilterPredicate:
             # off-slice pays DCN for every gang collective
             gang_bonus = 100.0
             score += gang_bonus
+        warm_bonus = 0.0
+        if warm_fp:
+            # vtcs warm-preference: this node advertises the pod's
+            # program — landing here starts at warm-node speed with no
+            # fetch at all. Soft like pressure/storm (reorders fits,
+            # never vetoes one), staleness re-judged inside warm_term
+            # so a dead advertiser's claim decays to 0.0 (the
+            # byte-identical pre-vtcs score).
+            warm_bonus = cc_advertise.warm_term(warm, warm_fp)
+            score += warm_bonus
         headroom_term = 0.0
         if hr_term:
             # vtqm (QuotaMarket gate + latency-critical pod): prefer
@@ -1092,11 +1147,13 @@ class FilterPredicate:
         if explain_b is not None:
             # the audit record gets the exact terms just applied, plus
             # the raw headroom input — total == base - pressure - storm
-            # - spill + gang_bonus + headroom_term holds by construction
-            # (headroom_term is 0.0 unless the QuotaMarket gate scored
-            # it, spill 0.0 unless HBMOvercommit did) and is asserted
-            # end-to-end by test_explain/test_quota/test_overcommit;
-            # virt_ratio records the virtual/physical admission split
+            # - spill + gang_bonus + headroom_term + warm_term holds by
+            # construction (headroom_term is 0.0 unless the QuotaMarket
+            # gate scored it, spill 0.0 unless HBMOvercommit did,
+            # warm_term 0.0 unless ClusterCompileCache did) and is
+            # asserted end-to-end by test_explain/test_quota/
+            # test_overcommit/test_clustercache; virt_ratio records the
+            # virtual/physical admission split
             explain_b.candidate(
                 name, base=base, pressure=pressure_pen, storm=storm_pen,
                 gang_bonus=gang_bonus,
@@ -1104,7 +1161,7 @@ class FilterPredicate:
                     headroom),
                 topology=alloc_result.topology_kind, total=score,
                 headroom_term=headroom_term, spill=spill_pen,
-                virt_ratio=oc_ratio)
+                virt_ratio=oc_ratio, warm_term=warm_bonus)
         scored.append(ScoredNode(name, score, alloc_result))
 
     # -- commit: annotation patch is the only cross-process channel ---------

@@ -34,6 +34,7 @@ from vtpu_manager.device.allocator.allocator import (AllocationFailure,
 from vtpu_manager.device.allocator.request import (RequestError,
                                                    build_allocation_request)
 from vtpu_manager.device.types import NodeInfo, get_pod_device_claims
+from vtpu_manager.quota import victimcost as vc_mod
 from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.util import consts
 from vtpu_manager.utilization import headroom as hr_mod
@@ -371,31 +372,39 @@ class PreemptPredicate:
         return count
 
     def _node_signals(self, node_name: str, node: dict):
-        """(NodeHeadroom | None, NodePressure | None) for one candidate
-        node — snapshot entries carry both pre-decoded; the TTL path
-        parses the annotations of the node object it already fetched.
-        Called only when the victim hint or explain recording is armed,
-        so the gate-off preempt pass does zero extra work."""
+        """(NodeHeadroom | None, NodePressure | None,
+        NodeVictimCosts | None) for one candidate node — snapshot
+        entries carry all three pre-decoded; the TTL path parses the
+        annotations of the node object it already fetched. Called only
+        when the victim hint or explain recording is armed, so the
+        gate-off preempt pass does zero extra work."""
         if self._snapshot is not None:
             entry = self._snapshot.entry(node_name)
             if entry is None:
-                return None, None
-            return entry.headroom, entry.pressure
+                return None, None, None
+            return entry.headroom, entry.pressure, entry.victim_costs
         anns = (node.get("metadata") or {}).get("annotations") or {}
         return (hr_mod.parse_headroom(
                     anns.get(consts.node_reclaimable_headroom_annotation())),
                 tel_pressure.parse_pressure(
-                    anns.get(consts.node_pressure_annotation())))
+                    anns.get(consts.node_pressure_annotation())),
+                vc_mod.parse_victim_costs(
+                    anns.get(consts.node_victim_cost_annotation())))
 
     @staticmethod
-    def _victim_inputs(pod: dict, headroom) -> dict:
+    def _victim_inputs(pod: dict, headroom, victim_costs=None) -> dict:
         """The per-victim ordering inputs, recorded verbatim in the
         preempt decision record. Estimated utilization = the chip's
         measured used % apportioned to this victim by its quota share
         of the chip's allocation (the vtuse ledger's own fallback
         apportioning rule); burstiness = the chip's headroom discount
         (alloc - used - reclaimable), the part of the idle quota the
-        ledger refused to call reclaimable, likewise apportioned."""
+        ledger refused to call reclaimable, likewise apportioned.
+        ``leased``/``spilled_frac`` come from the node's victim-cost
+        rollup (quota/victimcost.py): an active borrow lease and a
+        host-resident working set each make eviction cheaper, and both
+        land in the record so the ordering is auditable against its
+        own inputs (None = no published row for this tenant)."""
         meta = pod.get("metadata") or {}
         claims = get_pod_device_claims(pod)
         row: dict = {"uid": meta.get("uid", ""),
@@ -403,6 +412,11 @@ class PreemptPredicate:
                      "priority": _pod_priority(pod),
                      "est_used_core_pct": None,
                      "burst_core_pct": None}
+        if victim_costs is not None:
+            cost = victim_costs.lookup(row["uid"])
+            row["leased"] = cost[0] if cost is not None else None
+            row["spilled_frac"] = round(cost[1], 3) \
+                if cost is not None else None
         if claims is None:
             return row
         alloc = 0.0
@@ -425,17 +439,30 @@ class PreemptPredicate:
             row["burst_core_pct"] = round(burst, 2)
         return row
 
-    def _victim_order_key(self, pod: dict, headroom) -> tuple:
+    def _victim_order_key(self, pod: dict, headroom,
+                          victim_costs=None) -> tuple:
         """Extra-victim ordering under the hint: priority first (the
-        unchanged primary), then measured-idle tenants before busy
-        ones, spikier before smoother among equals, uid for
-        determinism. Victims without a chip-level signal sort after
-        measured ones in their priority class — "prefer low-utilization"
-        requires evidence of low utilization."""
-        row = self._victim_inputs(pod, headroom)
+        unchanged primary), then the victim-cost refinements —
+        lease-holders before base allocations (a revocable/expiring
+        quota lease is a strictly cheaper victim: its capacity was
+        leaving anyway), mostly-spilled tenants before HBM-resident
+        ones (their locality is already forfeit) — then measured-idle
+        tenants before busy ones, spikier before smoother among
+        equals, uid for determinism. Victims without a chip-level
+        signal sort after measured ones in their priority class —
+        "prefer low-utilization" requires evidence of low utilization.
+        With no fresh victim-cost rollup the lease/spill keys are
+        (1, -0.0) for every victim, i.e. the byte-identical pre-vtcs
+        ordering; freshness is the CALLER's judgement (_validate_node
+        passes None for a stale rollup)."""
+        row = self._victim_inputs(pod, headroom, victim_costs)
         est = row["est_used_core_pct"]
         burst = row["burst_core_pct"]
+        leased = row.get("leased") or False
+        spilled = row.get("spilled_frac") or 0.0
         return (row["priority"],
+                0 if leased else 1,
+                -spilled,
                 est if est is not None else float("inf"),
                 -(burst if burst is not None else 0.0),
                 row["uid"])
@@ -472,13 +499,28 @@ class PreemptPredicate:
         # cached headroom's freshness is re-judged at use time — a dead
         # publisher degrades the ordering to priority-only, never to an
         # ordering justified by stale utilization claims
-        headroom = pressure = None
+        headroom = pressure = victim_costs = None
         if self.victim_order_hint or victim_log is not None:
-            headroom, pressure = self._node_signals(node_name, node)
+            headroom, pressure, victim_costs = \
+                self._node_signals(node_name, node)
         hr_fresh = hr_mod.headroom_is_fresh(headroom)
+        # the victim-cost rollup (lease state + spill residency) is a
+        # second, independent ordering input: stale/absent degrades it
+        # to None HERE so every downstream key reads the byte-identical
+        # neutral values — never an eviction justified by a dead
+        # publisher's claims
+        vc_fresh = vc_mod.victim_costs_fresh(victim_costs)
+        if not vc_fresh:
+            victim_costs = None
         ordering = ("utilization"
-                    if self.victim_order_hint and hr_fresh
+                    if self.victim_order_hint and (hr_fresh or vc_fresh)
                     else "priority")
+        # a fresh victim-cost rollup alone may engage the utilization
+        # ordering — the stale headroom object still feeds the audit
+        # rows below (flagged headroom_fresh=False) but must never feed
+        # the SORT keys, or a dead publisher's est-used claims decide
+        # who gets evicted
+        order_headroom = headroom if hr_fresh else None
         added_uids: list[str] = []
         spared: list[dict] = []
 
@@ -511,8 +553,8 @@ class PreemptPredicate:
                     and not self._violates_pdb(p, pdb_cache))
             if ordering == "utilization":
                 extras = sorted(
-                    pool, key=lambda p: self._victim_order_key(p,
-                                                               headroom))
+                    pool, key=lambda p: self._victim_order_key(
+                        p, order_headroom, victim_costs))
             else:
                 extras = sorted(pool, key=_pod_priority)
             ok = False
@@ -553,11 +595,14 @@ class PreemptPredicate:
             added_set = set(added_uids)
 
             def row(pod: dict, role: str) -> dict:
-                return dict(self._victim_inputs(pod, headroom), role=role)
+                return dict(self._victim_inputs(pod, headroom,
+                                                victim_costs),
+                            role=role)
 
             victim_log.update(
                 result="kept", ordering=ordering,
                 headroom_fresh=hr_fresh,
+                victim_costs_fresh=vc_fresh,
                 pressure_frac=pressure.throttle_frac
                 if pressure is not None else None,
                 pdb_violations=exact,

@@ -46,7 +46,9 @@ import threading
 import time
 
 from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.clustercache import advertise as cc_advertise
 from vtpu_manager.compilecache import antistorm
+from vtpu_manager.quota import victimcost as vc_mod
 from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import container_kinds, effective_claims
 from vtpu_manager.resilience import failpoints
@@ -71,13 +73,13 @@ class NodeEntry:
     __slots__ = ("name", "node", "labels", "registry", "resident",
                  "counted", "conditional", "base_free", "rank_key",
                  "generation", "pressure", "fp_recent", "headroom",
-                 "overcommit")
+                 "overcommit", "warm", "victim_costs")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
                  base_free: tuple, rank_key: int, generation: int,
                  pressure=None, fp_recent=(), headroom=None,
-                 overcommit=None):
+                 overcommit=None, warm=None, victim_costs=None):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -97,6 +99,15 @@ class NodeEntry:
         # re-judges staleness + class at every visit, so a dead policy
         # publisher decays to the physical admission gate
         self.overcommit = overcommit
+        # vtcs warm-keys advertisement (NodeWarmKeys | None), decoded at
+        # event apply/relist like pressure; warm_term re-judges
+        # staleness at score time so a dead advertiser's phantom warmth
+        # decays instead of attracting pods forever
+        self.warm = warm
+        # victim-cost rollup (NodeVictimCosts | None), decoded at event
+        # apply/relist; the preempt path re-judges freshness at use
+        # time, degrading the victim sort to priority-only
+        self.victim_costs = victim_costs
         # vtcc anti-storm: residents' (program_fingerprint, placed_ts)
         # pairs inside the storm window at build time; decay is
         # re-judged at penalty time (a quiet node emits no events)
@@ -254,6 +265,14 @@ class ClusterSnapshot:
         self._node_pressure: dict[str, object] = {}   # name -> NodePressure
         self._node_headroom: dict[str, object] = {}   # name -> NodeHeadroom
         self._node_overcommit: dict[str, object] = {}  # -> NodeOvercommit
+        self._node_warm: dict[str, object] = {}       # -> NodeWarmKeys
+        self._node_victim_costs: dict[str, object] = {}  # -> NodeVictimCosts
+        # vtcs warm index: fingerprint -> (node, ...) for every node
+        # advertising that fp. Copy-on-write tuples (the unbound-fp
+        # pattern) so passes/tools read lock-free; maintained at node
+        # event apply + relist, retired when a node's advertisement
+        # drops the fp, goes stale-garbage, or the node is deleted.
+        self._warm_fp_nodes: dict[str, tuple] = {}
         self._pods: dict[str, dict] = {}              # uid -> pod (ALL pods)
         self._pod_node: dict[str, str] = {}           # uid -> nodeName | ""
         self._pod_class: dict[str, tuple] = {}        # uid -> (claims, expiry)
@@ -510,6 +529,8 @@ class ClusterSnapshot:
                     self._node_pressure.pop(name, None)
                     self._node_headroom.pop(name, None)
                     self._node_overcommit.pop(name, None)
+                    self._node_victim_costs.pop(name, None)
+                    self._set_warm_locked(name, None)
                     self._publish_rank_locked(name, None)
                     self.generation += 1
             return
@@ -526,11 +547,17 @@ class ClusterSnapshot:
             anns.get(consts.node_reclaimable_headroom_annotation()))
         node_overcommit = oc_mod.parse_overcommit(
             anns.get(consts.node_overcommit_annotation()))
+        node_warm = cc_advertise.parse_warm_keys(
+            anns.get(consts.node_cache_keys_annotation()))
+        node_victim_costs = vc_mod.parse_victim_costs(
+            anns.get(consts.node_victim_cost_annotation()))
         labels = meta.get("labels") or {}
         with self._lock:
             self._node_pressure[name] = node_pressure
             self._node_headroom[name] = node_headroom
             self._node_overcommit[name] = node_overcommit
+            self._node_victim_costs[name] = node_victim_costs
+            self._set_warm_locked(name, node_warm)
             self.generation += 1
             entry = self._build_entry_locked(name, node, labels, registry)
             if name in self._entries:
@@ -625,6 +652,43 @@ class ClusterSnapshot:
                          if e[0] != uid)
             self._unbound_fp_nodes[node] = kept + ((uid, fp, ts),)
             self._pod_unbound[uid] = node
+
+    def _set_warm_locked(self, name: str, warm) -> None:
+        """Maintain the per-fingerprint warm-node tuples under _lock;
+        each mutated fingerprint publishes a fresh tuple (copy-on-
+        write, the unbound-fp contract) so readers never see a tuple
+        shrink mid-iteration. Both callers parse with the default max
+        age, so a stale-at-ingest or garbage advertisement arrives
+        here as None and clears the node's fps (no-signal); an entry
+        that indexed fresh and aged SINCE (dead advertiser, no further
+        events) stays indexed — warm_term re-judges the ts that
+        travels with NodeEntry.warm at every score."""
+        old = self._node_warm.get(name)
+        old_fps = old.fps if old is not None else frozenset()
+        new_fps = warm.fps if warm is not None else frozenset()
+        for fp in old_fps - new_fps:
+            kept = tuple(n for n in self._warm_fp_nodes.get(fp, ())
+                         if n != name)
+            if kept:
+                self._warm_fp_nodes[fp] = kept
+            else:
+                self._warm_fp_nodes.pop(fp, None)
+        for fp in new_fps - old_fps:
+            have = self._warm_fp_nodes.get(fp, ())
+            if name not in have:
+                self._warm_fp_nodes[fp] = have + (name,)
+        if warm is None:
+            self._node_warm.pop(name, None)
+        else:
+            self._node_warm[name] = warm
+
+    def warm_nodes(self, fingerprint: str) -> tuple:
+        """Nodes currently advertising ``fingerprint`` in their warm-
+        keys annotation — the vtcs key→nodes index, lock-free read of a
+        copy-on-write tuple. Callers judging warmth must still re-check
+        freshness on the node's NodeEntry.warm (this index trades a
+        little staleness for O(1) reverse lookup)."""
+        return self._warm_fp_nodes.get(fingerprint, ())
 
     def unbound_fp(self, name: str) -> tuple:
         """((uid, fingerprint, commit_ts), ...) of committed-but-unbound
@@ -739,7 +803,9 @@ class ClusterSnapshot:
                          fp_recent=tuple(antistorm.recent_from_pods(
                              resident.values(), time.time())),
                          headroom=self._node_headroom.get(name),
-                         overcommit=self._node_overcommit.get(name))
+                         overcommit=self._node_overcommit.get(name),
+                         warm=self._node_warm.get(name),
+                         victim_costs=self._node_victim_costs.get(name))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -805,6 +871,9 @@ class ClusterSnapshot:
             self._node_pressure = {}
             self._node_headroom = {}
             self._node_overcommit = {}
+            self._node_warm = {}
+            self._node_victim_costs = {}
+            self._warm_fp_nodes = {}
             entries: dict[str, NodeEntry] = {}
             for node in nodes:
                 meta = node.get("metadata") or {}
@@ -821,6 +890,10 @@ class ClusterSnapshot:
                     anns.get(consts.node_reclaimable_headroom_annotation()))
                 self._node_overcommit[name] = oc_mod.parse_overcommit(
                     anns.get(consts.node_overcommit_annotation()))
+                self._node_victim_costs[name] = vc_mod.parse_victim_costs(
+                    anns.get(consts.node_victim_cost_annotation()))
+                self._set_warm_locked(name, cc_advertise.parse_warm_keys(
+                    anns.get(consts.node_cache_keys_annotation())))
                 entries[name] = self._build_entry_locked(
                     name, node, meta.get("labels") or {}, registry)
             self._entries = entries
@@ -901,6 +974,7 @@ class ClusterSnapshot:
                 entry.resident, entry.counted, live, entry.base_free,
                 rank_key, self.generation, pressure=entry.pressure,
                 fp_recent=entry.fp_recent, headroom=entry.headroom,
-                overcommit=entry.overcommit)
+                overcommit=entry.overcommit, warm=entry.warm,
+                victim_costs=entry.victim_costs)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

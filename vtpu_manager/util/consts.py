@@ -283,6 +283,36 @@ def node_overcommit_annotation() -> str:
     return _ann("node-overcommit")
 
 
+def node_cache_keys_annotation() -> str:
+    """vtcs warm-cache advertisement (ClusterCompileCache gate): the
+    node's hottest compile-cache entries as
+    ``"<endpoint>|<fp>=<entry_key>,...@<ts>"`` — bounded, LRU-ordered
+    hottest-first, published by the device-plugin advertiser over the
+    registry channel (clustercache/advertise.py). Two consumers: the
+    scheduler's warm-preference term matches the pod fingerprint
+    against the advertised ``fp`` list, and a cold node's peer fetch
+    matches its computed entry key exactly and downloads from
+    ``endpoint`` (the advertising node's monitor ``/cache/entry``
+    route). Same staleness-by-timestamp family as the pressure /
+    headroom / overcommit codecs: a dead advertiser decays to
+    no-signal, never pins phantom warmth."""
+    return _ann("node-cache-keys")
+
+
+def node_victim_cost_annotation() -> str:
+    """Preemption victim-cost rollup (published when QuotaMarket and/or
+    HBMOvercommit is on; consumed by the DecisionExplain-gated victim
+    ordering): ``"<uid12>:<lease_flag>:<spill_frac>;...@<ts>"`` — per
+    resident tenant, whether it holds an active (hence revocable/
+    expiring) quota lease and what fraction of its working set is
+    host-resident (vmem ``spilled`` / (resident + spilled)). Both make
+    a victim strictly cheaper to evict: borrowed quota dies with its
+    lease anyway, and a mostly-spilled tenant's HBM is already gone.
+    Same staleness family as the codecs above — stale/absent degrades
+    the victim sort to the byte-identical priority-only order."""
+    return _ann("node-victim-costs")
+
+
 def node_reclaimable_headroom_annotation() -> str:
     """vtuse reclaimable-headroom rollup (same codec family as the
     pressure annotation, utilization/headroom.py): per-chip
@@ -363,6 +393,16 @@ ENV_STEP_TELEMETRY = "VTPU_STEP_TELEMETRY"  # "true": step ring armed
 ENV_STEP_RING_PATH = "VTPU_STEP_RING_PATH"  # tenant-side ring file path
 ENV_COMPILE_CACHE = "VTPU_COMPILE_CACHE"    # "true": node compile cache armed
 ENV_COMPILE_CACHE_DIR = "VTPU_COMPILE_CACHE_DIR"  # in-container cache dir
+# "true": the vtcs cluster tier armed on top of the node cache — the
+# runtime client constructs a ClusterCompileCache whose miss path
+# peer-fetches verified artifacts (clustercache/fetch.py) before
+# compiling; requires ENV_COMPILE_CACHE (the node store is the landing
+# surface either way)
+ENV_CLUSTER_CACHE = "VTPU_CLUSTER_CACHE"
+# optional bearer token the peer fetcher presents to a peer monitor's
+# auth-gated /cache/entry route (operators mount a dedicated secret;
+# unset = unauthenticated fetch against token-less monitors)
+ENV_CACHE_PEER_TOKEN = "VTPU_CACHE_PEER_TOKEN"
 # tenant-declared program fingerprint (deployment template env); the
 # webhook mirrors it into the program-fingerprint annotation so the
 # scheduler's anti-storm spreading sees it without spec parsing
@@ -420,6 +460,11 @@ STEP_RING_NAME = "step_telemetry.ring"
 # containers at the same path it occupies on the host.
 COMPILE_CACHE_SUBDIR = "compilecache"
 COMPILE_CACHE_DIR = f"{MANAGER_BASE_DIR}/{COMPILE_CACHE_SUBDIR}"
+# vtcs peer map: the device-plugin advertiser's fan-in of every OTHER
+# node's warm-keys annotation, materialized as a file under the cache
+# root so in-container fetchers resolve peers without a kube client —
+# the same registry-channel-to-shared-file shape as pids.config.
+CACHE_PEERS_NAME = "peers.json"
 
 LOCK_DIR = "/tmp/.vtpu_lock"                        # per-device OFD locks
 VMEM_DIR = "/tmp/.vmem_node"

@@ -28,6 +28,7 @@ FAULT_INJECTION = "FaultInjection"      # vtfault failpoint registry
 STEP_TELEMETRY = "StepTelemetry"        # vttel per-tenant step rings
 SCHEDULER_HA = "SchedulerHA"            # vtha sharded active-active scheduler
 COMPILE_CACHE = "CompileCache"          # vtcc node-local compile cache
+CLUSTER_COMPILE_CACHE = "ClusterCompileCache"  # vtcs peer-seeded fleet tier
 UTILIZATION_LEDGER = "UtilizationLedger"  # vtuse per-tenant utilization ledger
 DECISION_EXPLAIN = "DecisionExplain"    # vtexplain per-decision audit trail
 QUOTA_MARKET = "QuotaMarket"            # vtqm elastic quota market
@@ -78,6 +79,21 @@ _KNOWN = {
     # same-program gang cold start into ONE compile, and simultaneous
     # same-fingerprint starts spread across nodes as a soft preference.
     COMPILE_CACHE: False,
+    # Default off: byte-identical — no warm-keys annotation published,
+    # no peers.json, no monitor /cache/entry route, tenants construct
+    # the plain node-local CompileCache (zero fetch I/O), and the
+    # scheduler's warm-preference term is never evaluated so placement
+    # is byte-identical in BOTH data paths. On (requires CompileCache —
+    # the node store is the landing surface), the fleet seeds itself:
+    # each node advertises its hottest verified entry keys over the
+    # registry channel (clustercache/advertise.py), a cold node's miss
+    # path downloads the checksummed artifact from an advertising
+    # peer's monitor under the existing single-flight lease instead of
+    # compiling (clustercache/fetch.py, fail-open on every failure
+    # shape), and fingerprint-carrying pods get a soft scheduling bonus
+    # on nodes already warm for their program — so an N-node
+    # autoscaling burst pays ONE compile fleet-wide, not one per node.
+    CLUSTER_COMPILE_CACHE: False,
     # Default off: zero new files/env/annotations/series and placement
     # byte-identical in both scheduler modes. On, the node folds step
     # rings + configs + the duty feed into a per-tenant utilization

@@ -42,7 +42,8 @@ class ClusterRollup:
                  cache_root: str | None = None,
                  fold_budget_s: float | None = None,
                  quota_dir: str | None = None,
-                 overcommit: bool = False):
+                 overcommit: bool = False,
+                 cluster_cache: bool = False):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
@@ -54,6 +55,9 @@ class ClusterRollup:
         # overcommit/spill fields at all — byte-identical /utilization
         # (the vtqm pattern, asserted by test_overcommit)
         self.overcommit = overcommit
+        # vtcs (ClusterCompileCache gate): False = the document carries
+        # no warm-keys fields at all — byte-identical /utilization
+        self.cluster_cache = cluster_cache
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -152,6 +156,21 @@ class ClusterRollup:
                     overcommit.spill_frac if overcommit else None
                 row_extra["spilled_bytes"] = \
                     overcommit.spilled_bytes if overcommit else None
+            if self.cluster_cache:
+                # vtcs warm-keys fields (gate on only — off keeps the
+                # document byte-identical): which programs this node
+                # can seed the fleet with, from its advertisement
+                from vtpu_manager.clustercache import advertise as \
+                    cc_advertise
+                warm = cc_advertise.parse_warm_keys(
+                    anns.get(consts.node_cache_keys_annotation()),
+                    now=now)
+                row_extra["warm_keys"] = \
+                    len(warm.pairs) if warm else None
+                # wire order is hottest-first — preserve it, vtpu-smi
+                # shows the first few as "the hottest"
+                row_extra["warm_fps"] = \
+                    [fp for fp, _k in warm.pairs] if warm else None
             rows.append({
                 **row_extra,
                 "node": name,
