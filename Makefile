@@ -114,6 +114,14 @@ bench-compilecache: ## vtcc headline bench: N-replica gang cold start, cache off
 bench-quotamarket: ## vtqm headline bench: bursty inference + steady training co-location, market off/on (burst p99 >=2x, training >=95% retained, reclaim bound asserted; writes BENCH_VTQM_r10.json)
 	python scripts/bench_quotamarket.py
 
+.PHONY: test-ici
+test-ici: ## vtici suite: link-graph torus properties, contention vs brute force, link-aware placement parity both modes, codec staleness matrix, publisher chaos, v5 stamp matrix, class-mix term, ad-cap review
+	$(PYTEST) tests/test_ici.py -q
+
+.PHONY: bench-ici
+bench-ici: ## vtici headline bench: co-resident communicator boxes, capacity-only vs link-aware placement — worst-link contention + modeled all-reduce step time reduction, gate-off parity (asserted; writes BENCH_VTICI_r13.json)
+	python scripts/bench_ici.py
+
 .PHONY: test-overcommit
 test-overcommit: ## vtovc suite: ratio codec + policy percentiles, virtual admission parity both modes, spill pool chaos (torn copy / budget / crashed-spiller reap), gate-off byte-contracts
 	$(PYTEST) tests/test_overcommit.py -q
@@ -123,7 +131,7 @@ bench-overcommit: ## vtovc headline bench: pods-per-chip density gate off/on (>=
 	python scripts/bench_overcommit.py
 
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit bench-overcommit bench-clustercache ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici bench-overcommit bench-clustercache bench-ici ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

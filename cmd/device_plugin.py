@@ -76,6 +76,13 @@ def main(argv: list[str] | None = None) -> int:
                              "$NODE_IP:9394 when NODE_IP is set, else "
                              "warmth is advertised scheduler-only and "
                              "peers cannot fetch from this node)")
+    parser.add_argument("--cache-ad-max-pairs", type=int, default=None,
+                        help="ClusterCompileCache gate: how many "
+                             "hottest fp=key pairs the warm-keys "
+                             "advertisement carries (default 8, hard "
+                             "ceiling 32 — the ceiling keeps the "
+                             "worst-case encoding inside the 8 KiB "
+                             "registry-channel budget)")
     parser.add_argument("--spill-budget-gib", type=float, default=16.0,
                         help="vtovc (HBMOvercommit): node host-RAM spill "
                              "budget in GiB — the bound on Σ spilled "
@@ -112,6 +119,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 FAULT_INJECTION,
                                                 HBM_OVERCOMMIT,
                                                 HONOR_PREALLOC_IDS,
+                                                ICI_LINK_AWARE,
                                                 MEMORY_PLUGIN,
                                                 QUOTA_MARKET, RESCHEDULE,
                                                 STEP_TELEMETRY, TC_WATCHER,
@@ -242,6 +250,9 @@ def main(argv: list[str] | None = None) -> int:
     vnum.hbm_overcommit_enabled = gates.enabled(HBM_OVERCOMMIT)
     if gates.enabled(HBM_OVERCOMMIT):
         vnum.spill_budget_bytes = int(args.spill_budget_gib * 2**30)
+    # vtici: Allocate stamps the webhook-normalized ICI link share into
+    # the v5 config ABI; off = 0 (the v4 wire bytes, shim unshaped)
+    vnum.ici_link_aware_enabled = gates.enabled(ICI_LINK_AWARE)
     plugins = [vnum]
     if gates.enabled(CORE_PLUGIN):
         plugins.append(VcorePlugin(manager))
@@ -392,6 +403,8 @@ def main(argv: list[str] | None = None) -> int:
         # in-container fetchers resolve warm peers without a client
         if cluster_cache_on and node_cache is not None:
             from vtpu_manager.clustercache import CacheAdvertiser
+            from vtpu_manager.clustercache.advertise import (
+                MAX_AD_KEYS, MAX_AD_KEYS_LIMIT)
             endpoint = args.cache_advertise_endpoint
             if endpoint is None:
                 node_ip = os.environ.get("NODE_IP", "")
@@ -400,8 +413,16 @@ def main(argv: list[str] | None = None) -> int:
                 log.warning("no --cache-advertise-endpoint / NODE_IP: "
                             "warm keys advertise scheduler-only; peers "
                             "cannot fetch from this node")
+            max_pairs = args.cache_ad_max_pairs
+            if max_pairs is None:
+                max_pairs = MAX_AD_KEYS
+            elif not 1 <= max_pairs <= MAX_AD_KEYS_LIMIT:
+                log.warning("--cache-ad-max-pairs=%d outside 1..%d; "
+                            "clamping", max_pairs, MAX_AD_KEYS_LIMIT)
+                max_pairs = max(1, min(max_pairs, MAX_AD_KEYS_LIMIT))
             advertiser = CacheAdvertiser(client, args.node_name,
-                                         cache_root, endpoint=endpoint)
+                                         cache_root, endpoint=endpoint,
+                                         max_keys=max_pairs)
             advertiser.start()
             log.info("cluster cache advertiser running (endpoint %r)",
                      endpoint)
@@ -466,6 +487,29 @@ def main(argv: list[str] | None = None) -> int:
         overcommit_pub.start()
         log.info("overcommit policy publisher running (budget %.1f GiB)",
                  args.spill_budget_gib)
+
+    # vtici link-load rollup: this daemon (the node-annotation owner)
+    # folds every resident tenant's communicator box (the mesh coords
+    # its vtpu.config carries) into per-ICI-link load — vtuse duty when
+    # fresh, allocated core % fallback — and publishes it for both
+    # scheduler paths to score worst-link contention against. Its OWN
+    # ledger instance, the same cursor-privacy rule as the market's.
+    linkload_pub = None
+    if gates.enabled(ICI_LINK_AWARE):
+        from vtpu_manager.topology import LinkLoadPublisher
+        ll_ledger = None
+        if gates.enabled(UTILIZATION_LEDGER):
+            from vtpu_manager.utilization import UtilizationLedger as _LL
+            ll_ledger = _LL(args.node_name, chips,
+                            base_dir=args.base_dir
+                            or consts.MANAGER_BASE_DIR,
+                            tc_path=consts.TC_UTIL_CONFIG)
+        linkload_pub = LinkLoadPublisher(
+            client, args.node_name, manager.mesh,
+            args.base_dir or consts.MANAGER_BASE_DIR, ledger=ll_ledger)
+        linkload_pub.start()
+        log.info("ICI link-load publisher running (mesh %s, duty=%s)",
+                 manager.mesh.shape, ll_ledger is not None)
 
     # vtqm quota market: this daemon (the config writer) lends a chip's
     # measured-idle, confidence-gated headroom between co-tenants in
@@ -545,6 +589,8 @@ def main(argv: list[str] | None = None) -> int:
             advertiser.stop()
         if victimcost_pub:
             victimcost_pub.stop()
+        if linkload_pub:
+            linkload_pub.stop()
         if pressure_pub:
             pressure_pub.stop()
         if headroom_pub:

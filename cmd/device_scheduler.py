@@ -95,6 +95,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 DECISION_EXPLAIN,
                                                 FAULT_INJECTION,
                                                 HBM_OVERCOMMIT,
+                                                ICI_LINK_AWARE,
                                                 QUOTA_MARKET,
                                                 SCHEDULER_HA,
                                                 SCHEDULER_SNAPSHOT,
@@ -169,7 +170,13 @@ def main(argv: list[str] | None = None) -> int:
         # ratio) + the spill-rate thrash-backoff penalty; off =
         # byte-identical placement in both data paths. Same
         # filter_kwargs ride-along, so vtha shards inherit it.
-        hbm_overcommit=gates.enabled(HBM_OVERCOMMIT))
+        hbm_overcommit=gates.enabled(HBM_OVERCOMMIT),
+        # vtici: worst-link-contention scoring — the submesh search's
+        # link dimension + the soft link_term penalty, both fed by the
+        # node's published link-load rollup; off = byte-identical
+        # placement in both data paths. Same filter_kwargs ride-along,
+        # so vtha shards inherit it.
+        ici_link_aware=gates.enabled(ICI_LINK_AWARE))
     # vtexplain satellite: preemption victim ordering gains the vttel/
     # vtuse utilization inputs behind the same gate as the audit trail
     # (the ordering applied is recorded per victim, so it is auditable);

@@ -43,6 +43,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.util.featuregates import (CLUSTER_COMPILE_CACHE,
                                                 COMPILE_CACHE,
                                                 HBM_OVERCOMMIT,
+                                                ICI_LINK_AWARE,
                                                 QUOTA_MARKET, TRACING,
                                                 FeatureGates)
     from vtpu_manager.webhook.server import WebhookAPI, run_server
@@ -94,7 +95,11 @@ def main(argv: list[str] | None = None) -> int:
                      # patches)
                      stamp_workload_class=(
                          gates.enabled(QUOTA_MARKET)
-                         or gates.enabled(HBM_OVERCOMMIT)))
+                         or gates.enabled(HBM_OVERCOMMIT)),
+                     # vtici: normalize the declared ICI link share
+                     # into the one annotation the plugin's v5 config
+                     # stamping reads (gate off = no new patches)
+                     stamp_ici_link_pct=gates.enabled(ICI_LINK_AWARE))
     logging.getLogger(__name__).info("vtpu-webhook on %s:%d", args.host,
                                      args.port)
     run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)

@@ -76,6 +76,9 @@ struct Metrics {
   // vtqm: adopted quota-market lease generations (config re-reads that
   // actually changed the enforced rates)
   Counter quota_reloads{"quota_reloads"};
+  // vtici: multi-chip (collective-heavy) submissions that blocked in
+  // the ICI link-share token bucket (ici_link_pct shaping)
+  Counter ici_throttle_waits{"ici_throttle_waits"};
   // vtcc: Execute-path compile-cache client outcomes (non-Python
   // tenants arming off the config header's compile_cache_dir)
   Counter compile_cache_hits{"compile_cache_hits"};
@@ -109,6 +112,14 @@ struct alignas(128) DeviceHot {
   // vtovc: this process's live host-pool bytes for the chip (published
   // to the vmem entry's spilled field, bounded by spill_budget_bytes)
   std::atomic<int64_t> spilled_bytes{0};
+  // vtici ICI link-share bucket (armed when ici_link_pct in (0,100)):
+  // link-time microsecond budget refilled at ici_link_pct% of wall
+  // time, charged only by multi-chip dispatch — the collective-heavy
+  // pattern whose traffic occupies ICI links. Separate from tokens_us
+  // on purpose: a tenant may be under its core share yet over its
+  // link share (and vice versa).
+  std::atomic<int64_t> ici_tokens_us{0};
+  std::atomic<uint64_t> ici_last_refill_ns{0};
   // Observation-overhead calibration: host-observed completion spans carry
   // a fixed per-op transport+observation latency (remote PJRT tunnels add
   // ~ms of RTT to every span). An idle-time probe (min of an H2D and a D2H

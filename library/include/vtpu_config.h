@@ -30,7 +30,11 @@ constexpr uint32_t kConfigMagic = 0x55505456;  // "VTPU"
 // admitted VIRTUAL chip capacity; > real_memory arms the spill tier)
 // and spill_budget_bytes (node host-RAM budget bounding Σ spilled in
 // the vmem ledger). Gate off writes zeros — v3 semantics byte-for-byte.
-constexpr uint32_t kConfigVersion = 4;
+// v5 (vtici): the device struct grew ici_link_pct (the tenant's ICI
+// link-bandwidth share for collective-heavy — multi-chip — dispatch;
+// the shim shapes it with a dedicated token bucket) + explicit pad.
+// 0 = unshaped; gate off writes zeros — v4 semantics byte-for-byte.
+constexpr uint32_t kConfigVersion = 5;
 constexpr int kMaxDeviceCount = 64;
 constexpr int kUuidLen = 64;
 constexpr int kNameLen = 64;
@@ -82,14 +86,20 @@ struct VtpuDevice {
   // (bound on Σ spilled bytes across tenants, vmem-ledger accounted).
   uint64_t virtual_hbm_bytes;
   uint64_t spill_budget_bytes;
+  // vtici (v5; 0 when ICILinkAware is off): percentage of the node's
+  // ICI link bandwidth this tenant's multi-chip dispatch may consume.
+  // 0 or >= 100 = unshaped; the ICI token bucket arms only in (0,100).
+  int32_t ici_link_pct;
+  uint32_t ici_pad_;
 };
-static_assert(sizeof(VtpuDevice) == 136, "VtpuDevice ABI size");
+static_assert(sizeof(VtpuDevice) == 144, "VtpuDevice ABI size");
 static_assert(offsetof(VtpuDevice, total_memory) == 64, "ABI");
 static_assert(offsetof(VtpuDevice, hard_core) == 80, "ABI");
 static_assert(offsetof(VtpuDevice, mesh_x) == 104, "ABI");
 static_assert(offsetof(VtpuDevice, lease_core) == 116, "ABI");
 static_assert(offsetof(VtpuDevice, virtual_hbm_bytes) == 120, "ABI");
 static_assert(offsetof(VtpuDevice, spill_budget_bytes) == 128, "ABI");
+static_assert(offsetof(VtpuDevice, ici_link_pct) == 136, "ABI");
 
 struct VtpuConfig {
   uint32_t magic;
@@ -117,7 +127,7 @@ static_assert(offsetof(VtpuConfig, compile_cache_dir) == 256, "ABI");
 static_assert(offsetof(VtpuConfig, workload_class) == 320, "ABI");
 static_assert(offsetof(VtpuConfig, quota_epoch) == 324, "ABI");
 static_assert(offsetof(VtpuConfig, devices) == 328, "ABI");
-static_assert(sizeof(VtpuConfig) == 328 + 64 * 136 + 8, "VtpuConfig ABI");
+static_assert(sizeof(VtpuConfig) == 328 + 64 * 144 + 8, "VtpuConfig ABI");
 
 inline uint64_t Fnv1a64(const char* data) {
   uint64_t h = 0xCBF29CE484222325ull;

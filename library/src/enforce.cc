@@ -1238,6 +1238,10 @@ void AdoptQuotaLocked(const VtpuConfig& fresh) {
     dev.soft_core = nd->soft_core;
     dev.core_limit = nd->core_limit;
     dev.lease_core = nd->lease_core;
+    // vtici: a rewrite may also retune the tenant's ICI link share
+    // (same 4-byte aligned benign-race idiom as the fields above);
+    // the ICI bucket reads it fresh on every multi-chip dispatch
+    dev.ici_link_pct = nd->ici_link_pct;
     if (new_eff < old_eff) {
       // Revoke: accumulated borrowed credit must not outlive the
       // lease. Clamp the balance to one window's grant at the NEW
@@ -2339,6 +2343,92 @@ void RateLimit(int slot, int64_t cost_us) {
   }
 }
 
+// vtici: ICI link-share shaping for collective-heavy dispatch. A
+// multi-chip launch (ndev > 1 in WrappedExecute) is the dispatch shape
+// whose collectives occupy ICI links; when the v5 config grants this
+// tenant ici_link_pct in (0,100), each such launch pays its exec-cost
+// EMA (the best available proxy for the collective's link occupancy —
+// collectives overlap the compute window they serialize behind) into a
+// dedicated per-device token bucket refilled at ici_link_pct% of wall
+// time, capped at one window's grant so an idle tenant cannot bank
+// unbounded burst credit. Over-share dispatch blocks in 2 ms quanta —
+// the SAME wait accounting (g_throttle_wait_ns -> step ring -> vtuse
+// ledger -> pressure annotation) as the core bucket, so shaped tenants
+// are visible to the whole observability chain — and fails open after
+// 10 s exactly like RateLimit (a wedged limiter must never hang a
+// training step forever). ici_link_pct 0 (gate off / v4 configs) or
+// >= 100 = one int load, no bucket, byte-identical behavior.
+void IciRateLimit(int slot, int64_t cost_us) {
+  ShimState& s = State();
+  const VtpuDevice* cfg = DeviceCfg(slot);
+  if (!cfg) return;
+  int pct = cfg->ici_link_pct;
+  if (pct <= 0 || pct >= 100) return;
+  DeviceHot& hot = s.hot[slot];
+  int64_t cap = (int64_t)pct * kWindowUs / 100;
+  uint64_t now = NowNs();
+  uint64_t last = hot.ici_last_refill_ns.exchange(now,
+                                                  std::memory_order_relaxed);
+  if (last == 0) {
+    // first shaped dispatch: seed one window's grant
+    hot.ici_tokens_us.store(cap, std::memory_order_relaxed);
+  } else if (now > last) {
+    int64_t add = (int64_t)((now - last) / 1000) * pct / 100;
+    if (add > 0) {
+      int64_t cur = hot.ici_tokens_us.load(std::memory_order_relaxed);
+      int64_t next;
+      do {
+        next = cur + add;
+        if (next > cap) next = cap;
+      } while (next != cur &&
+               !hot.ici_tokens_us.compare_exchange_weak(
+                   cur, next, std::memory_order_relaxed));
+    }
+  }
+  // pay into debt (the GAP-bypass spirit: the submission itself is not
+  // delayed — the debt throttles the FOLLOWING collective-heavy work),
+  // with the core bucket's debt-floor discipline: a cost the share can
+  // never repay inside the fail-open budget must not accumulate into
+  // unbounded debt, or every later launch stalls the full 10 s forever
+  // and even a raised share pays minutes of back-rent. Floor at 10
+  // granted windows (~1 s recovery at the granted rate — the same
+  // bound WatcherTick enforces on the core tokens).
+  hot.ici_tokens_us.fetch_sub(cost_us, std::memory_order_relaxed);
+  int64_t floor_us = -10 * cap;
+  int64_t cur_bal = hot.ici_tokens_us.load(std::memory_order_relaxed);
+  while (cur_bal < floor_us &&
+         !hot.ici_tokens_us.compare_exchange_weak(
+             cur_bal, floor_us, std::memory_order_relaxed)) {
+  }
+  if (hot.ici_tokens_us.load(std::memory_order_relaxed) >= 0) return;
+  g_metrics.ici_throttle_waits.Bump();
+  uint64_t wait_start = NowNs();
+  while (hot.ici_tokens_us.load(std::memory_order_relaxed) < 0) {
+    if (NowNs() - wait_start > 10ull * 1000 * 1000 * 1000) {
+      VTPU_LOG(kLogError,
+               "ici limiter stuck on device %d (share %d%%); failing open",
+               cfg->host_index, pct);
+      return;
+    }
+    uint64_t sleep_start = NowNs();
+    usleep(kTickSleepUs);
+    g_throttle_wait_ns.fetch_add(NowNs() - sleep_start,
+                                 std::memory_order_relaxed);
+    // a quota/market rewrite may lift or tighten the share mid-wait
+    MaybeAdoptQuota();
+    int cur_pct = cfg->ici_link_pct;
+    if (cur_pct <= 0 || cur_pct >= 100) return;     // share lifted
+    uint64_t tick = NowNs();
+    uint64_t prev = hot.ici_last_refill_ns.exchange(
+        tick, std::memory_order_relaxed);
+    if (tick > prev) {
+      int64_t add = (int64_t)((tick - prev) / 1000) * cur_pct / 100;
+      if (add > 0)
+        hot.ici_tokens_us.fetch_add(add, std::memory_order_relaxed);
+    }
+  }
+}
+
 void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
                    uint64_t end_ns, bool measured) {
   ShimState& s = State();
@@ -2720,6 +2810,16 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
     for (size_t d = 0; d < ndev; d++) {
       int slot = args->execute_device ? first_slot : (int)d;
       if (slot < s.device_count) RateLimit(slot, cost);
+    }
+    if (ndev > 1) {
+      // vtici: a multi-chip launch is collective-heavy dispatch — its
+      // all-reduce/all-gather traffic occupies the ICI links between
+      // the chips it spans — so it additionally pays the tenant's ICI
+      // link-share bucket (no-op unless the v5 config granted a share)
+      for (size_t d = 0; d < ndev; d++) {
+        int slot = (int)d;
+        if (slot < s.device_count) IciRateLimit(slot, cost);
+      }
     }
     g_metrics.execs.Bump();
   }

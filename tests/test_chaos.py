@@ -544,6 +544,12 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
                    count=rng.randint(1, 2))
     failpoints.arm("spill.budget", "error", p=0.2,
                    count=rng.randint(1, 2))
+    # vtici site: driven by the dedicated publisher chaos test
+    # (test_ici.py — the e2e loop here runs no link-load publisher),
+    # armed so the full-coverage assertion stays the honest catalog
+    # check
+    failpoints.arm("ici.publish", "error", p=0.3,
+                   count=rng.randint(1, 2))
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 

@@ -152,13 +152,22 @@ class TestAdvertiseCodec:
 
     def test_bounds_and_order_preserved(self):
         now = time.time()
+        # the PUBLISHER default (MAX_AD_KEYS) bounds what a node
+        # advertises; the PARSER accepts up to the hard ceiling
+        # (MAX_AD_KEYS_LIMIT — the --cache-ad-max-pairs cap review) so
+        # a peer running a raised bound is never silently truncated
         pairs = tuple((f"fp{i}", ("%02x" % i) * 32)
                       for i in range(advertise.MAX_AD_KEYS + 4))
         w = NodeWarmKeys("h:1", pairs, now)
         p = parse_warm_keys(w.encode(), now=now)
-        assert len(p.pairs) == advertise.MAX_AD_KEYS
+        assert p.pairs == pairs          # under the ceiling: all parse
+        over = tuple((f"fp{i}", ("%02x" % (i % 256)) * 32)
+                     for i in range(advertise.MAX_AD_KEYS_LIMIT + 4))
+        p = parse_warm_keys(NodeWarmKeys("h:1", over, now).encode(),
+                            now=now)
+        assert len(p.pairs) == advertise.MAX_AD_KEYS_LIMIT
         # hottest-first order survives the wire
-        assert p.pairs == pairs[:advertise.MAX_AD_KEYS]
+        assert p.pairs == over[:advertise.MAX_AD_KEYS_LIMIT]
 
     def test_staleness_and_garbage(self):
         now = time.time()

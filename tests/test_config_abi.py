@@ -43,6 +43,7 @@ int main() {
          offsetof(VtpuDevice, virtual_hbm_bytes));
   printf("dev.spill_budget_bytes %zu\n",
          offsetof(VtpuDevice, spill_budget_bytes));
+  printf("dev.ici_link_pct %zu\n", offsetof(VtpuDevice, ici_link_pct));
   printf("cfg.magic %zu\n", offsetof(VtpuConfig, magic));
   printf("cfg.version %zu\n", offsetof(VtpuConfig, version));
   printf("cfg.pod_uid %zu\n", offsetof(VtpuConfig, pod_uid));
@@ -157,7 +158,7 @@ class TestVtpuConfigRoundtrip:
                 core_limit=vc.CORE_LIMIT_SOFT, memory_limit=True,
                 memory_oversold=False, host_index=3, mesh=(1, 2, 0),
                 lease_core=25, virtual_hbm_bytes=24 * 2**30,
-                spill_budget_bytes=32 * 2**30)])
+                spill_budget_bytes=32 * 2**30, ici_link_pct=40)])
 
     def test_pack_unpack(self):
         cfg = self._sample()
@@ -174,12 +175,13 @@ class TestVtpuConfigRoundtrip:
         assert dev.lease_core == 25
         assert dev.virtual_hbm_bytes == 24 * 2**30
         assert dev.spill_budget_bytes == 32 * 2**30
+        assert dev.ici_link_pct == 40
 
     def test_v3_defaults_zero(self):
-        """A gate-off config (no class, no leases, no overcommit)
-        carries zeros in every v3/v4 field — the lease delta is
-        byte-identical to the old pad, and the v4 spill pair writes
-        only zeros beyond the v3 layout."""
+        """A gate-off config (no class, no leases, no overcommit, no
+        link share) carries zeros in every v3/v4/v5 field — the lease
+        delta is byte-identical to the old pad, the v4 spill pair and
+        the v5 ici_link_pct write only zeros beyond the v3 layout."""
         back = vc.VtpuConfig.unpack(vc.VtpuConfig(
             pod_uid="u", devices=[vc.DeviceConfig(
                 uuid="X", total_memory=1, real_memory=1)]).pack())
@@ -188,6 +190,7 @@ class TestVtpuConfigRoundtrip:
         assert back.devices[0].lease_core == 0
         assert back.devices[0].virtual_hbm_bytes == 0
         assert back.devices[0].spill_budget_bytes == 0
+        assert back.devices[0].ici_link_pct == 0
 
     def test_file_roundtrip_atomic(self, tmp_path):
         path = str(tmp_path / "cfg" / "vtpu.config")
@@ -675,21 +678,23 @@ int main(int argc, char** argv) {
   QuotaReloader qr(argv[1]);
   VtpuConfig cfg;
   if (!qr.Check(&cfg)) return 3;     // first read adopts the baseline
-  printf("epoch %u class %d lease %d cache_dir %s eff %d\n",
+  printf("epoch %u class %d lease %d cache_dir %s eff %d ici %d\n",
          cfg.quota_epoch, cfg.workload_class, cfg.devices[0].lease_core,
          cfg.compile_cache_dir,
          EffectiveCorePct(cfg.devices[0].hard_core,
-                          cfg.devices[0].lease_core));
+                          cfg.devices[0].lease_core),
+         cfg.devices[0].ici_link_pct);
   if (qr.Check(&cfg)) return 4;      // unchanged: no re-adopt
   fflush(stdout);
   // wait (the token-wait loop shape) for the Python side's rewrite
   for (int i = 0; i < 5000; i++) {
     usleep(2000);
     if (qr.Check(&cfg)) {
-      printf("adopt %u lease %d eff %d\n", cfg.quota_epoch,
+      printf("adopt %u lease %d eff %d ici %d\n", cfg.quota_epoch,
              cfg.devices[0].lease_core,
              EffectiveCorePct(cfg.devices[0].hard_core,
-                              cfg.devices[0].lease_core));
+                              cfg.devices[0].lease_core),
+             cfg.devices[0].ici_link_pct);
       fflush(stdout);
       break;
     }
@@ -736,7 +741,8 @@ class TestCxxQuotaAndCacheClient:
         cfg_path = str(tmp_path / "vtpu.config")
         dev = vc.DeviceConfig(uuid="TPU-Q", total_memory=1 << 30,
                               real_memory=1 << 30, hard_core=40,
-                              core_limit=vc.CORE_LIMIT_HARD)
+                              core_limit=vc.CORE_LIMIT_HARD,
+                              ici_link_pct=30)
         cfg = vc.VtpuConfig(
             pod_uid="uid-q", quota_epoch=7,
             workload_class=vc.WORKLOAD_CLASS_LATENCY,
@@ -747,16 +753,19 @@ class TestCxxQuotaAndCacheClient:
                                 stdout=subprocess.PIPE, text=True)
         try:
             line = proc.stdout.readline().split()
-            # the C++ reloader reads every v3 field Python wrote
+            # the C++ reloader reads every v3/v5 field Python wrote
             assert line == ["epoch", "7", "class", "1", "lease", "0",
-                            "cache_dir", "/cache/mount", "eff", "40"]
-            # quota-market grant: rewrite with a bumped epoch; the
-            # probe's wait loop must adopt it
+                            "cache_dir", "/cache/mount", "eff", "40",
+                            "ici", "30"]
+            # quota-market grant: rewrite with a bumped epoch (and a
+            # retuned ICI share); the probe's wait loop must adopt it
             dev.lease_core = 25
+            dev.ici_link_pct = 55
             cfg.quota_epoch = 8
             vc.write_config(cfg_path, cfg)
             line = proc.stdout.readline().split()
-            assert line == ["adopt", "8", "lease", "25", "eff", "65"]
+            assert line == ["adopt", "8", "lease", "25", "eff", "65",
+                            "ici", "55"]
             # store interop: C++ verifies the Python-written entry...
             assert proc.stdout.readline().strip() == \
                 "py_payload hello-from-python"

@@ -53,12 +53,26 @@ FUTURE_SKEW_TOLERANCE_S = 5.0
 
 # bound on advertised pairs: the annotation must stay registry-channel
 # sized; 8 hottest keys cover a node's live program set (a node serves
-# a handful of models, not its whole LRU history)
+# a handful of models, not its whole LRU history). Operators with
+# wider program sets may raise it per node via --cache-ad-max-pairs,
+# bounded at MAX_AD_KEYS_LIMIT — the cap review's hard ceiling, chosen
+# so the WORST-CASE encoding (max-length fingerprints, full endpoint)
+# still fits the 8 KiB registry-channel budget with headroom
+# (test_ici.py asserts this red-on-overflow, so the ceiling cannot
+# silently outgrow the budget).
 MAX_AD_KEYS = 8
+MAX_AD_KEYS_LIMIT = 32
+
+# the registry-channel budget one advertisement may occupy: node
+# annotations share the object's 256 KiB ceiling with the registry /
+# pressure / headroom / overcommit channels, so each advertisement is
+# held to 8 KiB
+AD_BYTE_BUDGET = 8192
 
 # defensive parse bound — an adversarial/corrupt annotation must not
-# cost an unbounded split in the scheduler's event path
-MAX_AD_LEN = 4096
+# cost an unbounded split in the scheduler's event path. Equal to the
+# byte budget: anything a compliant advertiser can publish parses.
+MAX_AD_LEN = AD_BYTE_BUDGET
 
 # scoring weight of the warm-preference bonus: enough to beat packing
 # noise and a moderate anti-storm penalty (10/placement), below the
@@ -137,7 +151,10 @@ def parse_warm_keys(raw: str | None, now: float | None = None,
                 or not valid_entry_key(key):
             continue
         pairs.append((fp, key))
-        if len(pairs) >= MAX_AD_KEYS:
+        if len(pairs) >= MAX_AD_KEYS_LIMIT:
+            # parse up to the hard ceiling, not the publisher DEFAULT:
+            # a peer running --cache-ad-max-pairs above 8 must not have
+            # its tail silently dropped by every consumer
             break
     return NodeWarmKeys(endpoint=endpoint, pairs=tuple(pairs), ts=ts)
 
@@ -264,7 +281,9 @@ class CacheAdvertiser:
         self.policy = policy or RetryPolicy(max_attempts=3,
                                             deadline_s=10.0)
         self.interval_s = interval_s
-        self.max_keys = max_keys
+        # bounded at the hard ceiling so no flag value can push the
+        # encoded advertisement past the registry-channel byte budget
+        self.max_keys = max(1, min(max_keys, MAX_AD_KEYS_LIMIT))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 

@@ -42,7 +42,13 @@ MAGIC = 0x55505456          # "VTPU" little-endian
 # spill budget: the bound on Σ spilled bytes across the node's tenants,
 # accounted in the vmem ledger's per-entry spilled field). Gate off
 # writes zeros in both — the v3 semantics byte-for-byte.
-VERSION = 4
+# v5 (vtici, ICI link shaping): the device struct grew ici_link_pct
+# (i32, the webhook-normalized percentage of the node's ICI link
+# bandwidth this tenant's collective-heavy — multi-chip — dispatch may
+# consume; the shim shapes it with a dedicated token bucket alongside
+# the core-% one) plus explicit trailing pad to keep 8-byte alignment.
+# 0 = unshaped, the v4 semantics byte-for-byte; gate off writes 0.
+VERSION = 5
 MAX_DEVICE_COUNT = 64
 UUID_LEN = 64
 NAME_LEN = 64
@@ -66,10 +72,11 @@ CORE_LIMIT_SOFT = 2      # balance policy: elastic hard_core..soft_core
 # hard_core i32, soft_core i32, core_limit i32, memory_limit i32,
 # memory_oversold i32, host_index i32, mesh_x/y/z i32, lease_core i32
 # (v3: the former pad — signed borrowed/lent core-% delta),
-# virtual_hbm_bytes u64 + spill_budget_bytes u64 (v4, vtovc)
-_DEVICE_FMT = "<64sQQ10iQQ"
+# virtual_hbm_bytes u64 + spill_budget_bytes u64 (v4, vtovc),
+# ici_link_pct i32 + pad u32 (v5, vtici)
+_DEVICE_FMT = "<64sQQ10iQQiI"
 DEVICE_SIZE = struct.calcsize(_DEVICE_FMT)
-assert DEVICE_SIZE == 136
+assert DEVICE_SIZE == 144
 
 # vtpu_config_t header: magic u32, version u32, pod_uid[48], pod_name[64],
 # pod_namespace[64], container_name[64], device_count i32, compat_mode i32,
@@ -126,6 +133,11 @@ class DeviceConfig:
     # bytes in the vmem ledger.
     virtual_hbm_bytes: int = 0
     spill_budget_bytes: int = 0
+    # vtici (v5; 0 when ICILinkAware is off = v4 semantics): the
+    # percentage of the node's ICI link bandwidth this tenant's
+    # multi-chip (collective-heavy) dispatch may consume — the shim
+    # shapes it with a dedicated token bucket; 0 or >=100 = unshaped.
+    ici_link_pct: int = 0
 
     def pack(self) -> bytes:
         return struct.pack(
@@ -134,19 +146,22 @@ class DeviceConfig:
             self.core_limit, 1 if self.memory_limit else 0,
             1 if self.memory_oversold else 0, self.host_index,
             self.mesh[0], self.mesh[1], self.mesh[2], self.lease_core,
-            self.virtual_hbm_bytes, self.spill_budget_bytes)
+            self.virtual_hbm_bytes, self.spill_budget_bytes,
+            self.ici_link_pct, 0)
 
     @staticmethod
     def unpack(raw: bytes) -> "DeviceConfig":
         (uuid, total, real, hard, soft, climit, mlimit, oversold, hidx,
-         mx, my, mz, lease, virt, spill) = struct.unpack(_DEVICE_FMT, raw)
+         mx, my, mz, lease, virt, spill, ici,
+         _pad) = struct.unpack(_DEVICE_FMT, raw)
         return DeviceConfig(uuid=_from_cstr(uuid), total_memory=total,
                             real_memory=real, hard_core=hard, soft_core=soft,
                             core_limit=climit, memory_limit=bool(mlimit),
                             memory_oversold=bool(oversold), host_index=hidx,
                             mesh=(mx, my, mz), lease_core=lease,
                             virtual_hbm_bytes=virt,
-                            spill_budget_bytes=spill)
+                            spill_budget_bytes=spill,
+                            ici_link_pct=ici)
 
 
 @dataclass
@@ -250,7 +265,7 @@ DEVICE_OFFSETS = {
     "soft_core": 84, "core_limit": 88, "memory_limit": 92,
     "memory_oversold": 96, "host_index": 100, "mesh_x": 104, "mesh_y": 108,
     "mesh_z": 112, "lease_core": 116, "virtual_hbm_bytes": 120,
-    "spill_budget_bytes": 128,
+    "spill_budget_bytes": 128, "ici_link_pct": 136,
 }
 HEADER_OFFSETS = {
     "magic": 0, "version": 4, "pod_uid": 8, "pod_name": 56,

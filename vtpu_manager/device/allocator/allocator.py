@@ -108,7 +108,8 @@ def _allocate_container(info: NodeInfo, req: AllocationRequest,
                         prefer_origin: tuple[int, int] | None,
                         reasons: R.FailureReasons,
                         prefer_uuids: set[str] | None = None,
-                        anchor_cells: set | None = None
+                        anchor_cells: set | None = None,
+                        link_load: dict | None = None
                         ) -> tuple[list[DeviceUsage], str, float]:
     candidates = _filter_devices(info, req, cont, reasons)
     if len(candidates) < cont.number:
@@ -125,7 +126,8 @@ def _allocate_container(info: NodeInfo, req: AllocationRequest,
             free_specs, cont.number, info.registry.mesh,
             prefer_origin=prefer_origin,
             binpack=req.device_policy == consts.DEVICE_POLICY_BINPACK,
-            anchor_cells=anchor_cells)
+            anchor_cells=anchor_cells,
+            link_load=link_load)
         if sel is not None and (sel.kind == "rect" or not strict):
             by_uuid = {u.spec.uuid: u for u in candidates}
             return ([by_uuid[c.uuid] for c in sel.chips], sel.kind, sel.score)
@@ -168,7 +170,8 @@ def _request_kinds(req: AllocationRequest
 
 def allocate(info: NodeInfo, req: AllocationRequest,
              prefer_origin: tuple[int, int] | None = None,
-             anchor_cells: set | None = None) -> AllocationResult:
+             anchor_cells: set | None = None,
+             link_load: dict | None = None) -> AllocationResult:
     """Allocate every claiming container of the pod on this node.
 
     Concurrent claimers (app containers + sidecars) are allocated first on
@@ -182,6 +185,11 @@ def allocate(info: NodeInfo, req: AllocationRequest,
     charge, not the sum (reference: init_container_vgpu_support_design.md
     §3-4: per-physical-device lifecycle peaks).
 
+    link_load (vtici, ICILinkAware gate): per-link co-resident traffic
+    handed through to the submesh search so box choice inside the node
+    avoids contended ICI rings; None (default) keeps the search
+    byte-identical to the pre-vtici tree.
+
     Raises AllocationFailure with aggregated reasons when the pod does not
     fit. On success returns the claims and the charged NodeInfo copy.
     """
@@ -193,7 +201,8 @@ def allocate(info: NodeInfo, req: AllocationRequest,
         reasons = R.FailureReasons()
         picked, k, s = _allocate_container(work, req, cont, prefer_origin,
                                            reasons,
-                                           anchor_cells=anchor_cells)
+                                           anchor_cells=anchor_cells,
+                                           link_load=link_load)
         if k != "any":
             kind, score = k, max(score, s)
         for usage in picked:
@@ -229,7 +238,8 @@ def allocate(info: NodeInfo, req: AllocationRequest,
         picked, _, _ = _allocate_container(view, req, cont, init_origin,
                                            reasons,
                                            prefer_uuids=pod_chips,
-                                           anchor_cells=anchor_cells)
+                                           anchor_cells=anchor_cells,
+                                           link_load=link_load)
         for usage in picked:
             claim = DeviceClaim(uuid=usage.spec.uuid,
                                 host_index=usage.spec.index,

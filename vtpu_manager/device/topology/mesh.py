@@ -26,11 +26,19 @@ Cell = tuple[int, int, int]
 
 @dataclass(frozen=True)
 class MeshSelection:
-    """Result of a topology-aware pick."""
+    """Result of a topology-aware pick.
+
+    ``worst_link``/``diameter`` are the vtici link dimension: the max
+    co-resident load on any ICI link internal to the selection and its
+    torus-hop diameter — populated only when the caller supplied a
+    link-load map (ICILinkAware gate on with a fresh signal; the
+    defaults are the byte-identical gate-off shape)."""
 
     chips: tuple[ChipSpec, ...]
     kind: str          # "rect" | "greedy"
     score: float       # higher is better (used to compare nodes)
+    worst_link: float = 0.0
+    diameter: int = 0
 
     @property
     def indices(self) -> list[int]:
@@ -102,10 +110,26 @@ def _min_dist_to_anchor(cells: list[Cell], anchor: set[Cell],
     return best
 
 
+def _shape_diameter(shape: Cell, mesh: MeshSpec) -> int:
+    """Torus-hop diameter of an axis-aligned box window of ``shape`` —
+    a function of the shape alone, not the origin (every window of one
+    shape has the same internal distances on a torus)."""
+    total = 0
+    for axis in range(3):
+        extent, size = shape[axis], mesh.shape[axis]
+        d = extent - 1
+        if mesh.wrap[axis] and size:
+            d = min(d, size - extent + 1) if extent < size else \
+                size // 2
+        total += max(d, 0)
+    return total
+
+
 def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                    prefer_origin: tuple[int, int] | None = None,
                    binpack: bool = True,
-                   anchor_cells: set[Cell] | None = None
+                   anchor_cells: set[Cell] | None = None,
+                   link_load: dict | None = None
                    ) -> MeshSelection | None:
     """Choose n chips from free_chips forming the best sub-mesh.
 
@@ -124,18 +148,32 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
     closest to the anchor wins; the bonus is capped below one cube-ness
     step, so it never trades a worse box shape for adjacency.
 
+    link_load: vtici (ICILinkAware gate): per-link co-resident traffic
+    (topology/links.py LinkId -> load). When provided, every candidate
+    box gains a link dimension — worst-link contention first (weighted
+    ABOVE the 10-point cube-ness step, so a compact box on a contended
+    ring loses to a slightly-less-cubic quiet one: the measured
+    spread-vs-binpack tradeoff), then torus-hop diameter as the
+    tie-break among equally-quiet shapes. None (the default) is the
+    gate-off identity: scores are byte-identical to the pre-vtici
+    search.
+
     Returns None when fewer than n chips are free.
     """
     if n <= 0 or len(free_chips) < n:
         return None
+    from vtpu_manager.topology import linkload as ll_mod
+    from vtpu_manager.topology.links import box_diameter, worst_link_load
     by_cell: dict[Cell, ChipSpec] = {c.coords: c for c in free_chips}
     if len(by_cell) < n:
         # duplicate coordinates = malformed registry; never index past it
         return None
     sx, sy, sz = mesh.shape
 
-    best: tuple[float, list[ChipSpec]] | None = None
+    best: tuple[float, list[ChipSpec], float, int] | None = None
     for shape in _box_shapes(n, mesh.shape):
+        shape_diam = _shape_diameter(shape, mesh) \
+            if link_load is not None else 0
         for oz in range(sz):
             for oy in range(sy):
                 for ox in range(sx):
@@ -145,8 +183,14 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                     if any(c not in by_cell for c in cells):
                         continue
                     # Exact free box. Score: cube-ness, alignment,
-                    # sibling adjacency, anchoring.
+                    # sibling adjacency, anchoring (+ the vtici link
+                    # dimension when a load map rides along).
                     score = 1000.0 - (max(shape) - min(shape)) * 10
+                    worst = 0.0
+                    if link_load is not None:
+                        worst = worst_link_load(cells, link_load, mesh)
+                        score -= ll_mod.LINK_BOX_WEIGHT * worst \
+                            + ll_mod.LINK_DIAMETER_WEIGHT * shape_diam
                     if prefer_origin is not None and \
                             (ox, oy) == tuple(prefer_origin):
                         score += 100
@@ -162,13 +206,15 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                     anchor = (ox + oy + oz) * 0.01
                     score += -anchor if binpack else anchor
                     if best is None or score > best[0]:
-                        best = (score, [by_cell[c] for c in cells])
+                        best = (score, [by_cell[c] for c in cells],
+                                worst, shape_diam)
     if best is not None:
-        return MeshSelection(tuple(best[1]), "rect", best[0])
+        return MeshSelection(tuple(best[1]), "rect", best[0],
+                             worst_link=best[2], diameter=best[3])
 
     # Greedy fallback: grow the most compact cluster from each seed.
     cells = list(by_cell)
-    best_greedy: tuple[float, list[ChipSpec]] | None = None
+    best_greedy: tuple[float, list[ChipSpec], float] | None = None
     for seed in cells:
         chosen = [seed]
         remaining = [c for c in cells if c != seed]
@@ -177,13 +223,22 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                 _pairwise_manhattan([c, ch], mesh) for ch in chosen))
             chosen.append(remaining.pop(0))
         cost = float(_pairwise_manhattan(chosen, mesh))
+        worst = 0.0
+        if link_load is not None:
+            # same link dimension as the rect search, in greedy-cost
+            # units (lower is better)
+            worst = worst_link_load(chosen, link_load, mesh)
+            cost += ll_mod.LINK_BOX_WEIGHT * worst
         if anchor_cells:
             cost += _min_dist_to_anchor(chosen, anchor_cells, mesh)
         if best_greedy is None or cost < best_greedy[0]:
-            best_greedy = (cost, [by_cell[c] for c in chosen])
+            best_greedy = (cost, [by_cell[c] for c in chosen], worst)
     assert best_greedy is not None
-    cost, chips = best_greedy
-    return MeshSelection(tuple(chips), "greedy", 100.0 - cost)
+    cost, chips, worst = best_greedy
+    diam = box_diameter([c.coords for c in chips], mesh) \
+        if link_load is not None else 0
+    return MeshSelection(tuple(chips), "greedy", 100.0 - cost,
+                         worst_link=worst, diameter=diam)
 
 
 def group_by_host(free_chips: list[ChipSpec]) -> dict[int, list[ChipSpec]]:

@@ -61,6 +61,7 @@ class VnumPlugin(DevicePluginServicer):
                                              # surface either way)
     quota_market_enabled = False             # gated: QuotaMarket (vtqm)
     hbm_overcommit_enabled = False           # gated: HBMOvercommit (vtovc)
+    ici_link_aware_enabled = False           # gated: ICILinkAware (vtici)
     # vtovc: the node's live policy engine (OvercommitPolicy | None) —
     # Allocate stamps each chip's virtual capacity from the CURRENT
     # per-class ratio, and the node host-RAM spill budget rides every
@@ -336,6 +337,21 @@ class VnumPlugin(DevicePluginServicer):
         # CURRENT per-class ratio (the same policy engine the node
         # annotation publishes, so the shim and the scheduler agree on
         # the admitted split); gate off = ratio 1.0 and zeros below
+        # vtici: the webhook-normalized ICI link share rides into every
+        # device entry of the v5 config so the shim's ICI token bucket
+        # shapes this tenant's multi-chip dispatch; gate off or
+        # absent/garbage annotation = 0 = unshaped (the v4 wire bytes).
+        # The webhook validated 1..100 at admission; an un-admitted
+        # value that skipped normalization is re-validated, not trusted.
+        ici_pct = 0
+        if self.ici_link_aware_enabled and pod is not None:
+            raw = anns.get(consts.ici_link_pct_annotation(), "")
+            try:
+                pct = int(str(raw).strip()) if raw else 0
+            except (TypeError, ValueError):
+                pct = 0
+            if 1 <= pct <= 100:
+                ici_pct = pct
         oc_ratio = 1.0
         if self.hbm_overcommit_enabled and pod is not None:
             from vtpu_manager import quota
@@ -385,7 +401,10 @@ class VnumPlugin(DevicePluginServicer):
                                    else 0),
                 spill_budget_bytes=(self.spill_budget_bytes
                                     if self.hbm_overcommit_enabled
-                                    else 0)))
+                                    else 0),
+                # vtici: the tenant's ICI link share (0 when the gate
+                # is off — the v4 wire bytes)
+                ici_link_pct=ici_pct))
             resp.devices.append(pb.DeviceSpec(
                 container_path=f"/dev/accel{claim.host_index}",
                 host_path=f"/dev/accel{claim.host_index}",

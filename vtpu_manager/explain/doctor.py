@@ -291,7 +291,8 @@ def diff_decisions(a: dict, b: dict) -> dict:
         deltas = {k: round(cb[k] - ca[k], 6)
                   for k in ("base", "pressure", "storm", "gang_bonus",
                             "headroom_input", "headroom_term", "spill",
-                            "warm_term", "total")
+                            "warm_term", "link_term", "mix_term",
+                            "total")
                   if isinstance(ca.get(k), (int, float))
                   and isinstance(cb.get(k), (int, float))}
         rows.append({"node": node, "total": [ca["total"], cb["total"]],

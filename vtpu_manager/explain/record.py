@@ -108,12 +108,21 @@ class DecisionBuilder:
                   topology: str, total: float,
                   headroom_term: float = 0.0, spill: float = 0.0,
                   virt_ratio: float = 1.0,
-                  warm_term: float = 0.0) -> None:
+                  warm_term: float = 0.0,
+                  link_term: float = 0.0,
+                  mix_term: float = 0.0) -> None:
         """One scored candidate with the EXACT values applied:
-        ``total == base - pressure - storm - spill + gang_bonus +
-        headroom_term + warm_term`` holds by construction (asserted
-        end-to-end by test_explain/test_quota/test_overcommit/
-        test_clustercache). ``warm_term`` is the vtcs warm-preference
+        ``total == base - pressure - storm - spill - link_term +
+        gang_bonus + headroom_term + mix_term + warm_term`` holds by
+        construction (asserted end-to-end by test_explain/test_quota/
+        test_overcommit/test_clustercache/test_ici). ``link_term`` is
+        the vtici worst-link-contention penalty (0.0 unless the
+        ICILinkAware gate scored a fresh link-load signal — recorded
+        only then, so gate-off records keep their exact prior shape;
+        the spread-vs-binpack tradeoff is auditable from the row
+        alone). ``mix_term`` is the class-mix-aware packing bonus (0.0
+        unless QuotaMarket scored a latency-critical pod against a
+        fresh lender-bearing mix). ``warm_term`` is the vtcs warm-preference
         bonus (0.0 unless the ClusterCompileCache gate scored a node
         advertising the pod's fingerprint — recorded only then, so
         gate-off records keep their exact prior shape; the spread-vs-
@@ -144,6 +153,12 @@ class DecisionBuilder:
         if warm_term:
             # vtcs: same appear-only-when-scored rule as the vtovc terms
             row["warm_term"] = warm_term
+        if link_term:
+            # vtici: same appear-only-when-scored rule
+            row["link_term"] = link_term
+        if mix_term:
+            # class-mix packing: same appear-only-when-scored rule
+            row["mix_term"] = mix_term
         cands = self.record["candidates"]
         if len(cands) < MAX_CANDIDATES:
             cands.append(row)

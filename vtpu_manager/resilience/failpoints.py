@@ -131,6 +131,12 @@ SITES: dict[str, str] = {
                     "the caller's allocation fails exactly as it would "
                     "have pre-vtovc — the spill arm only ever converts "
                     "failures into successes)",
+    "ici.publish": "topology/linkload.py LinkLoadPublisher."
+                   "publish_once, after the rollup is encoded and "
+                   "before the node-annotation patch (error = a failed "
+                   "publish the annotation's own timestamp ages out — "
+                   "the scheduler's link_term decays to no-signal, "
+                   "never steers on a ghost's contention claim)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

@@ -58,6 +58,8 @@ from vtpu_manager.scheduler import gang, reason as R
 from vtpu_manager.scheduler import snapshot as snap_mod
 from vtpu_manager.scheduler.lease import LeaseLostError
 from vtpu_manager.telemetry import pressure as tel_pressure
+from vtpu_manager.topology import linkload as tl_mod
+from vtpu_manager.topology.links import worst_link_load
 from vtpu_manager.util import consts
 from vtpu_manager.utilization import headroom as util_headroom
 
@@ -109,9 +111,24 @@ class FilterPredicate:
                  utilization_hint: bool = False,
                  quota_market: bool = False,
                  hbm_overcommit: bool = False,
-                 cluster_cache: bool = False):
+                 cluster_cache: bool = False,
+                 ici_link_aware: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtici (ICILinkAware gate; default off = byte-identical
+        # placement in BOTH data paths): score gang/ICI candidates by
+        # worst-link contention with co-resident tenants — the node's
+        # published link-load rollup (topology/linkload.py codec; TTL
+        # path decodes per visited candidate, snapshot path at
+        # event-apply on NodeEntry.linkload) feeds (1) the submesh
+        # search's link dimension (box choice INSIDE a node avoids
+        # contended rings) and (2) a soft link_term penalty in the
+        # shared _allocate_node body (node choice ACROSS the cluster
+        # repels hot fabrics — reorders fits, never vetoes one).
+        # Staleness re-judged at use time (load_map), every
+        # per-candidate link score rides the vtexplain breakdown, and
+        # the term rides filter_kwargs so vtha shards inherit it.
+        self.ici_link_aware = ici_link_aware
         # vtcs (ClusterCompileCache gate; default off = byte-identical
         # placement in BOTH data paths): a fingerprint-carrying pod
         # gets a soft warm_term bonus on nodes whose warm-keys
@@ -761,6 +778,7 @@ class FilterPredicate:
         hr_ann = consts.node_reclaimable_headroom_annotation()
         oc_ann = consts.node_overcommit_annotation()
         warm_ann = consts.node_cache_keys_annotation()
+        ll_ann = consts.node_ici_link_load_annotation()
         now_visible: set[str] = set()
         req_number, req_cores, req_memory = (
             req.total_number(), req.total_cores(), req.total_memory())
@@ -851,10 +869,15 @@ class FilterPredicate:
             # under the gate; every other pass carries None)
             warm_raw = ((meta.get("annotations") or {}).get(warm_ann)
                         if warm_fp else None)
+            # vtici: same raw-ride discipline — the link-load rollup
+            # decodes only for nodes the allocation loop visits (gate
+            # off = no dict-get, no parse, byte-identical scores)
+            ll_raw = ((meta.get("annotations") or {}).get(ll_ann)
+                      if self.ici_link_aware else None)
             ranked.append((free_cores + (free_memory >> 24) + free_number,
                            name, registry, counted, assumed, pressure,
                            storm, hr_raw, overcommit, oc_ratio,
-                           warm_raw))
+                           warm_raw, ll_raw))
         if now_visible:
             self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
@@ -872,8 +895,8 @@ class FilterPredicate:
         # only placement optimality, never schedulability.
         scored: list[ScoredNode] = []
         for rank, (_, name, registry, counted, assumed, pressure,
-                   storm, hr_raw, overcommit, oc_ratio, warm_raw) \
-                in enumerate(ranked):
+                   storm, hr_raw, overcommit, oc_ratio, warm_raw,
+                   ll_raw) in enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
             self._allocate_node(name, registry, counted, assumed, req,
@@ -887,7 +910,9 @@ class FilterPredicate:
                                 overcommit=overcommit,
                                 oc_ratio=oc_ratio, warm_fp=warm_fp,
                                 warm=cc_advertise.parse_warm_keys(
-                                    warm_raw) if warm_raw else None)
+                                    warm_raw) if warm_raw else None,
+                                linkload=tl_mod.parse_link_load(
+                                    ll_raw) if ll_raw else None)
         return scored
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
@@ -1010,7 +1035,9 @@ class FilterPredicate:
                                 overcommit=overcommit,
                                 oc_ratio=oc_ratio, warm_fp=warm_fp,
                                 warm=entry.warm if name in warm_set
-                                else None)
+                                else None,
+                                linkload=entry.linkload
+                                if self.ici_link_aware else None)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -1050,7 +1077,8 @@ class FilterPredicate:
                        storm_recent=(), headroom=None,
                        explain_b=None, hr_term: bool = False,
                        overcommit=None, oc_ratio: float = 1.0,
-                       warm_fp: str = "", warm=None) -> None:
+                       warm_fp: str = "", warm=None,
+                       linkload=None) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
         drift between them (and so the vtexplain breakdown is assembled
@@ -1077,10 +1105,17 @@ class FilterPredicate:
         # because they are committed before they carry a nodeName
         anchor = gang.sibling_anchor_cells(
             name, gang_siblings, registry) if gang_siblings else None
+        # vtici: the load map decodes the cached rollup ONCE per
+        # candidate, re-judging staleness at use time (a dead
+        # publisher's last contention claim decays to None = the
+        # byte-identical pre-vtici search + score)
+        link_load = tl_mod.load_map(linkload) \
+            if linkload is not None else None
         try:
             alloc_result = allocate(info, req,
                                     prefer_origin=prefer_origin,
-                                    anchor_cells=anchor)
+                                    anchor_cells=anchor,
+                                    link_load=link_load)
         except AllocationFailure as f:
             why = f.reasons.summary() or "allocation failed"
             result.failed_nodes[name] = why
@@ -1134,7 +1169,25 @@ class FilterPredicate:
             # byte-identical pre-vtcs score).
             warm_bonus = cc_advertise.warm_term(warm, warm_fp)
             score += warm_bonus
+        link_pen = 0.0
+        if link_load is not None:
+            # vtici: worst-link contention of the chips just chosen —
+            # the cross-node leg of the link dimension (the submesh
+            # search already avoided hot rings INSIDE the node; this
+            # penalty repels the whole selection from nodes whose
+            # fabric is busy). Computed from the final effective claim
+            # set so every topology kind (rect/greedy/host/any) pays
+            # the same honest metric. Soft like pressure/storm:
+            # reorders fits, never vetoes one.
+            chips = registry.chip_by_uuid()
+            cells = {chips[c.uuid].coords
+                     for c in alloc_result.effective.all_claims()
+                     if c.uuid in chips}
+            link_pen = tl_mod.link_term(
+                worst_link_load(cells, link_load, registry.mesh))
+            score -= link_pen
         headroom_term = 0.0
+        mix_term = 0.0
         if hr_term:
             # vtqm (QuotaMarket gate + latency-critical pod): prefer
             # nodes with fresh lendable headroom — the market can
@@ -1144,15 +1197,26 @@ class FilterPredicate:
             # byte-identical pre-market score.
             headroom_term = util_headroom.headroom_score_term(headroom)
             score += headroom_term
+            # class-mix-aware packing (ROADMAP quota item (a), the PR
+            # 11 observe-only decode made real): a borrower-class pod
+            # prefers nodes whose resident mix contains throughput
+            # LENDERS — the market only pays off with counterparties.
+            # Same staleness rule as the headroom term (the mix rides
+            # the same annotation): stale/absent mix = 0.0 = the
+            # byte-identical pre-mix score.
+            mix_term = util_headroom.class_mix_term(headroom)
+            score += mix_term
         if explain_b is not None:
             # the audit record gets the exact terms just applied, plus
             # the raw headroom input — total == base - pressure - storm
-            # - spill + gang_bonus + headroom_term + warm_term holds by
-            # construction (headroom_term is 0.0 unless the QuotaMarket
-            # gate scored it, spill 0.0 unless HBMOvercommit did,
-            # warm_term 0.0 unless ClusterCompileCache did) and is
-            # asserted end-to-end by test_explain/test_quota/
-            # test_overcommit/test_clustercache; virt_ratio records the
+            # - spill - link_term + gang_bonus + headroom_term +
+            # mix_term + warm_term holds by construction (headroom_term
+            # /mix_term are 0.0 unless the QuotaMarket gate scored
+            # them, spill 0.0 unless HBMOvercommit did, warm_term 0.0
+            # unless ClusterCompileCache did, link_term 0.0 unless
+            # ICILinkAware did) and is asserted end-to-end by
+            # test_explain/test_quota/test_overcommit/
+            # test_clustercache/test_ici; virt_ratio records the
             # virtual/physical admission split
             explain_b.candidate(
                 name, base=base, pressure=pressure_pen, storm=storm_pen,
@@ -1161,7 +1225,8 @@ class FilterPredicate:
                     headroom),
                 topology=alloc_result.topology_kind, total=score,
                 headroom_term=headroom_term, spill=spill_pen,
-                virt_ratio=oc_ratio, warm_term=warm_bonus)
+                virt_ratio=oc_ratio, warm_term=warm_bonus,
+                link_term=link_pen, mix_term=mix_term)
         scored.append(ScoredNode(name, score, alloc_result))
 
     # -- commit: annotation patch is the only cross-process channel ---------

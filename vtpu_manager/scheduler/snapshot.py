@@ -56,6 +56,7 @@ from vtpu_manager.resilience.policy import (CircuitBreaker,
                                             CircuitOpenError, RetryPolicy)
 from vtpu_manager.overcommit import ratio as oc_mod
 from vtpu_manager.telemetry import pressure as tel_pressure
+from vtpu_manager.topology import linkload as tl_mod
 from vtpu_manager.util import consts
 from vtpu_manager.util.gangname import resolve_gang_name
 from vtpu_manager.utilization import headroom as util_headroom
@@ -73,13 +74,14 @@ class NodeEntry:
     __slots__ = ("name", "node", "labels", "registry", "resident",
                  "counted", "conditional", "base_free", "rank_key",
                  "generation", "pressure", "fp_recent", "headroom",
-                 "overcommit", "warm", "victim_costs")
+                 "overcommit", "warm", "victim_costs", "linkload")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
                  base_free: tuple, rank_key: int, generation: int,
                  pressure=None, fp_recent=(), headroom=None,
-                 overcommit=None, warm=None, victim_costs=None):
+                 overcommit=None, warm=None, victim_costs=None,
+                 linkload=None):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -108,6 +110,11 @@ class NodeEntry:
         # apply/relist; the preempt path re-judges freshness at use
         # time, degrading the victim sort to priority-only
         self.victim_costs = victim_costs
+        # vtici link-load rollup (NodeLinkLoad | None), decoded at
+        # event apply/relist like pressure; the filter re-judges
+        # staleness at every visit (load_map), so a dead publisher
+        # decays to no link signal instead of steering on a ghost
+        self.linkload = linkload
         # vtcc anti-storm: residents' (program_fingerprint, placed_ts)
         # pairs inside the storm window at build time; decay is
         # re-judged at penalty time (a quiet node emits no events)
@@ -267,6 +274,7 @@ class ClusterSnapshot:
         self._node_overcommit: dict[str, object] = {}  # -> NodeOvercommit
         self._node_warm: dict[str, object] = {}       # -> NodeWarmKeys
         self._node_victim_costs: dict[str, object] = {}  # -> NodeVictimCosts
+        self._node_linkload: dict[str, object] = {}   # -> NodeLinkLoad
         # vtcs warm index: fingerprint -> (node, ...) for every node
         # advertising that fp. Copy-on-write tuples (the unbound-fp
         # pattern) so passes/tools read lock-free; maintained at node
@@ -530,6 +538,7 @@ class ClusterSnapshot:
                     self._node_headroom.pop(name, None)
                     self._node_overcommit.pop(name, None)
                     self._node_victim_costs.pop(name, None)
+                    self._node_linkload.pop(name, None)
                     self._set_warm_locked(name, None)
                     self._publish_rank_locked(name, None)
                     self.generation += 1
@@ -551,12 +560,15 @@ class ClusterSnapshot:
             anns.get(consts.node_cache_keys_annotation()))
         node_victim_costs = vc_mod.parse_victim_costs(
             anns.get(consts.node_victim_cost_annotation()))
+        node_linkload = tl_mod.parse_link_load(
+            anns.get(consts.node_ici_link_load_annotation()))
         labels = meta.get("labels") or {}
         with self._lock:
             self._node_pressure[name] = node_pressure
             self._node_headroom[name] = node_headroom
             self._node_overcommit[name] = node_overcommit
             self._node_victim_costs[name] = node_victim_costs
+            self._node_linkload[name] = node_linkload
             self._set_warm_locked(name, node_warm)
             self.generation += 1
             entry = self._build_entry_locked(name, node, labels, registry)
@@ -805,7 +817,8 @@ class ClusterSnapshot:
                          headroom=self._node_headroom.get(name),
                          overcommit=self._node_overcommit.get(name),
                          warm=self._node_warm.get(name),
-                         victim_costs=self._node_victim_costs.get(name))
+                         victim_costs=self._node_victim_costs.get(name),
+                         linkload=self._node_linkload.get(name))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -873,6 +886,7 @@ class ClusterSnapshot:
             self._node_overcommit = {}
             self._node_warm = {}
             self._node_victim_costs = {}
+            self._node_linkload = {}
             self._warm_fp_nodes = {}
             entries: dict[str, NodeEntry] = {}
             for node in nodes:
@@ -892,6 +906,8 @@ class ClusterSnapshot:
                     anns.get(consts.node_overcommit_annotation()))
                 self._node_victim_costs[name] = vc_mod.parse_victim_costs(
                     anns.get(consts.node_victim_cost_annotation()))
+                self._node_linkload[name] = tl_mod.parse_link_load(
+                    anns.get(consts.node_ici_link_load_annotation()))
                 self._set_warm_locked(name, cc_advertise.parse_warm_keys(
                     anns.get(consts.node_cache_keys_annotation())))
                 entries[name] = self._build_entry_locked(
@@ -975,6 +991,7 @@ class ClusterSnapshot:
                 rank_key, self.generation, pressure=entry.pressure,
                 fp_recent=entry.fp_recent, headroom=entry.headroom,
                 overcommit=entry.overcommit, warm=entry.warm,
-                victim_costs=entry.victim_costs)
+                victim_costs=entry.victim_costs,
+                linkload=entry.linkload)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

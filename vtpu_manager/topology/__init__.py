@@ -1,0 +1,45 @@
+"""vtici: the ICI link-capacity plane (ICILinkAware gate, default off).
+
+The reference's signature placement feature scores NVLink link weights
+(pkg/device/gpuallocator/besteffort_policy.go); the TPU-native analogue
+models each node's ICI mesh as an explicit **link graph** — one edge per
+physical torus link — and makes link *contention* a measured, scored,
+audited, shim-enforceable quantity:
+
+- :mod:`links` — the graph itself: edges derived from ``MeshSpec``
+  (2-D/3-D torus with per-axis wrap), each resident tenant's
+  communicator box folded into per-link load, and the worst-link
+  contention of any candidate chip selection computable in one pass;
+- :mod:`linkload` — the feedback edge into the scheduler: a compact
+  per-node link-load annotation in the pressure/headroom
+  staleness-codec family, published by the device-plugin daemon
+  (vtuse duty signal when fresh, allocated core % fallback) and
+  decoded by BOTH scheduler data paths (TTL per candidate, snapshot
+  at event-apply/relist).
+
+Gate off = byte-identical: no annotation published, the scheduler
+never parses or scores link state, ``select_submesh`` keeps its exact
+pre-vtici box choice, and configs carry ``ici_link_pct=0`` (the v4
+wire bytes).
+"""
+
+from vtpu_manager.topology.links import (LinkGraph, box_diameter,
+                                         fold_box_load, internal_links,
+                                         worst_link_load)
+from vtpu_manager.topology.linkload import (LINK_BOX_WEIGHT,
+                                            LINK_SCORE_WEIGHT,
+                                            LINK_TERM_CAP,
+                                            LinkLoadPublisher,
+                                            NodeLinkLoad,
+                                            compute_link_load,
+                                            link_term, load_is_fresh,
+                                            load_map, parse_link_load,
+                                            tenant_weight)
+
+__all__ = [
+    "LinkGraph", "internal_links", "fold_box_load", "worst_link_load",
+    "box_diameter", "NodeLinkLoad", "parse_link_load", "link_term",
+    "load_map", "load_is_fresh", "compute_link_load", "tenant_weight",
+    "LINK_SCORE_WEIGHT", "LINK_TERM_CAP", "LINK_BOX_WEIGHT",
+    "LinkLoadPublisher",
+]

@@ -1,0 +1,281 @@
+"""Node link-load annotation: vtici's feedback edge into the scheduler.
+
+Same codec family as the vttel pressure / vtuse headroom / vtovc
+overcommit annotations — parse-cheap on purpose (the snapshot path
+decodes it per node event, the TTL path per visited candidate),
+staleness explicit by timestamp:
+
+    "<x>.<y>.<z>.<axis>:<load>;...@<wall_ts>"
+
+one ``;``-separated segment per LOADED link (zero-load links are
+omitted), identified by its origin cell + axis (links.py LinkId), load
+in chip-duty units (one fully-busy tenant box = 1.0 on each of its
+internal links; co-resident boxes stack). The timestamp makes
+staleness explicit — a publisher that goes dark must decay to
+"no signal" (link_term 0.0, the byte-identical pre-vtici score), never
+pin its last contention claim forever.
+
+Per-tenant traffic weight, per the vtuse precedence rule: the measured
+duty/step signal when the ledger has a fresh sample for the tenant,
+the allocated core %% fallback otherwise (allocated-but-unmeasured
+traffic is assumed worst-case — the safe direction for a contention
+signal the scheduler steers AWAY from).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+
+from vtpu_manager.device.types import MeshSpec
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.topology.links import fold_box_load
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+# staleness family constants (pressure/headroom/overcommit values)
+MAX_LINK_AGE_S = 120.0
+FUTURE_SKEW_TOLERANCE_S = 5.0
+
+# defensive parse bounds: a 64-chip 4x4x4 wrapped torus has 192 links;
+# the segment cap covers it with headroom, the length cap bounds the
+# split cost an adversarial annotation can impose on the event path
+MAX_LINK_SEGMENTS = 256
+MAX_LINK_LEN = 6144
+
+# scoring weight of the link-contention penalty: one fully-contended
+# bottleneck link (load 1.0 = a whole busy tenant box already on it)
+# costs 40 points — above the vtcs warm bonus (30) and any packing
+# delta, below the pressure ceiling (50) and far below the +100 gang
+# bonus, so gang locality still wins and a hot node is repelled, never
+# vetoed. Capped so stacked residents cannot outvote the gang bonus.
+LINK_SCORE_WEIGHT = 40.0
+LINK_TERM_CAP = 40.0
+
+# within-node box choice (select_submesh link dimension): contention
+# outweighs the 10-point cube-ness step — a compact box on a contended
+# ring loses to a slightly-less-cubic quiet one, which is exactly the
+# measured spread-vs-binpack tradeoff this plane exists to make — and
+# diameter breaks ties among equally-quiet boxes
+LINK_BOX_WEIGHT = 50.0
+LINK_DIAMETER_WEIGHT = 0.5
+
+
+@dataclass(frozen=True)
+class NodeLinkLoad:
+    """Decoded per-node link-load rollup."""
+
+    links: dict = field(default_factory=dict)   # LinkId -> load
+    ts: float = 0.0
+
+    def encode(self) -> str:
+        segs = []
+        for (cell, axis), load in sorted(self.links.items()):
+            if load <= 0.0:
+                continue
+            segs.append(f"{cell[0]}.{cell[1]}.{cell[2]}.{axis}"
+                        f":{load:.3f}")
+            if len(segs) >= MAX_LINK_SEGMENTS:
+                break
+        return f"{';'.join(segs)}@{self.ts:.3f}"
+
+
+def parse_link_load(raw: str | None, now: float | None = None,
+                    max_age_s: float = MAX_LINK_AGE_S
+                    ) -> NodeLinkLoad | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    every bad shape degrades to no-signal, never to a wrong contention
+    claim the scheduler would steer on."""
+    if not raw or len(raw) > MAX_LINK_LEN:
+        return None
+    body, sep, ts_raw = raw.rpartition("@")
+    if not sep:
+        return None
+    try:
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(ts):
+        return None
+    now = time.time() if now is None else now
+    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+        return None
+    out: dict = {}
+    segments = 0
+    for seg in body.split(";"):
+        if not seg:
+            continue
+        segments += 1
+        if segments > MAX_LINK_SEGMENTS:
+            return None
+        key, _, load_raw = seg.partition(":")
+        parts = key.split(".")
+        if len(parts) != 4:
+            return None
+        try:
+            x, y, z, axis = (int(parts[0]), int(parts[1]),
+                             int(parts[2]), int(parts[3]))
+            load = float(load_raw)
+        except (TypeError, ValueError):
+            return None
+        if not math.isfinite(load):
+            # NaN parses but poisons every max() downstream — the
+            # garbage-means-no-signal rule of the whole codec family
+            return None
+        if not 0 <= axis <= 2:
+            return None
+        out[((x, y, z), axis)] = max(load, 0.0)
+    return NodeLinkLoad(links=out, ts=ts)
+
+
+def load_is_fresh(ll: "NodeLinkLoad | None",
+                  now: float | None = None) -> bool:
+    """Use-time staleness verdict (the pressure-penalty rule): the
+    snapshot path caches the parsed object on the NodeEntry and a dead
+    publisher emits no further node events, so every consumer must
+    re-judge freshness at the moment it scores on it."""
+    if ll is None:
+        return False
+    now = time.time() if now is None else now
+    return -FUTURE_SKEW_TOLERANCE_S <= now - ll.ts <= MAX_LINK_AGE_S
+
+
+def load_map(ll: "NodeLinkLoad | None",
+             now: float | None = None) -> dict | None:
+    """The LinkId -> load dict for scoring, or None when the signal is
+    absent or stale — None is the gate-off identity (zero link
+    evaluation, byte-identical placement)."""
+    if not load_is_fresh(ll, now):
+        return None
+    return ll.links
+
+
+def link_term(worst_link: float) -> float:
+    """Score points to SUBTRACT for a candidate selection's worst-link
+    contention. Soft like pressure/storm/spill: reorders fits, never
+    vetoes one — a contended node with the only free chips still
+    schedules."""
+    if worst_link <= 0.0:
+        return 0.0
+    return min(worst_link * LINK_SCORE_WEIGHT, LINK_TERM_CAP)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant traffic weights (publisher side)
+# ---------------------------------------------------------------------------
+
+def tenant_weight(alloc_core_frac: float,
+                  duty_frac: float | None) -> float:
+    """One tenant's per-link traffic weight: the measured duty
+    fraction when the vtuse signal is fresh, the allocated core
+    fraction otherwise (0 allocation = uncapped tenant = 1.0, the
+    worst-case assumption a steering signal must make)."""
+    if duty_frac is not None:
+        return min(max(duty_frac, 0.0), 1.0)
+    if alloc_core_frac <= 0.0:
+        return 1.0
+    return min(alloc_core_frac, 1.0)
+
+
+def compute_link_load(base_dir: str, mesh: MeshSpec, ledger=None,
+                      now: float | None = None) -> NodeLinkLoad:
+    """Fold every resident tenant's communicator box into per-link
+    load. Tenant boxes come from the per-container vtpu.config files
+    (the devices' mesh coords ARE the box — the same chips the
+    scheduler allocated); weights from the vtuse ledger when fresh,
+    allocated core %% otherwise."""
+    from vtpu_manager.config import tenantdirs
+    now = time.time() if now is None else now
+    duty: dict[tuple[str, str], tuple[float, int]] = {}
+    if ledger is not None:
+        try:
+            ledger.fold()
+            for s in ledger.tenants():
+                if s.confidence(now) <= 0.0:
+                    continue
+                tot, n = duty.get((s.pod_uid, s.container), (0.0, 0))
+                duty[(s.pod_uid, s.container)] = \
+                    (tot + s.used_ewma / 100.0, n + 1)
+        except Exception:  # noqa: BLE001 — the duty feed is advisory;
+            # a torn fold degrades this tick to the allocated fallback
+            log.warning("ledger fold failed; link load falls back to "
+                        "allocated weights", exc_info=True)
+            duty = {}
+    load: dict = {}
+    for pod_uid, label, cfg, _is_dra, _mtime in \
+            tenantdirs.iter_container_configs(base_dir):
+        if not cfg.devices:
+            continue
+        cells = {tuple(d.mesh) for d in cfg.devices}
+        if len(cells) < 2:
+            continue            # no internal links, no ICI traffic
+        alloc = sum(d.hard_core for d in cfg.devices) \
+            / (100.0 * len(cfg.devices))
+        d = duty.get((pod_uid, label))
+        duty_frac = (d[0] / d[1]) if d and d[1] else None
+        fold_box_load(load, cells,
+                      tenant_weight(alloc, duty_frac), mesh)
+    return NodeLinkLoad(links=load, ts=now)
+
+
+# ---------------------------------------------------------------------------
+# publisher daemon (device-plugin side: the node-annotation owner)
+# ---------------------------------------------------------------------------
+
+class LinkLoadPublisher:
+    """Daemon loop: fold resident boxes, patch the node annotation.
+
+    Runs in the device-plugin daemon behind the ICILinkAware gate (the
+    PressurePublisher discipline: failures tolerated per tick — the
+    signal is advisory, and the annotation's own timestamp ages a
+    silent death out to no-signal on the scheduler side)."""
+
+    def __init__(self, client, node_name: str, mesh: MeshSpec,
+                 base_dir: str, ledger=None, policy=None,
+                 interval_s: float = 15.0):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.mesh = mesh
+        self.base_dir = base_dir
+        self.ledger = ledger
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            deadline_s=10.0)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def publish_once(self) -> NodeLinkLoad:
+        ll = compute_link_load(self.base_dir, self.mesh,
+                               ledger=self.ledger)
+        # chaos: a failed publish must decay the scheduler to
+        # no-signal via the annotation's own timestamp — never crash
+        # the daemon loop or wedge the other publishers
+        failpoints.fire("ici.publish", node=self.node_name)
+        self.policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_ici_link_load_annotation(): ll.encode()}),
+            op="topology.linkload_patch")
+        return ll
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.publish_once()
+                except Exception:  # noqa: BLE001 — advisory signal;
+                    # the annotation timestamp ages a silent failure
+                    # out to no-signal (link_term decays to 0.0)
+                    log.warning("link-load publish failed",
+                                exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtici-linkload")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

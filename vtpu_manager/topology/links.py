@@ -1,0 +1,151 @@
+"""ICI link graph: explicit per-link capacity/load over a torus mesh.
+
+The mesh the registry already publishes (``MeshSpec``: shape + per-axis
+wrap) becomes an explicit edge set. A link is identified by its
+**origin cell and axis** — the edge from ``cell`` to
+``cell + 1 (mod size)`` along that axis. This representation is exact
+for a torus: a wrapped axis of size n contributes n links per ring
+(including the physically distinct wrap link on a size-2 axis, where
+cells 0 and 1 are joined by TWO links), a non-wrapped axis n-1, and a
+size-1 axis none.
+
+Load model: a tenant whose communicator box spans cells C contributes
+its traffic weight to EVERY link internal to C — the uniform-per-link
+profile of ring all-reduce, which sends ~(2(n-1)/n)·bytes over each
+ring link regardless of ring length. Worst-link contention of a
+candidate selection is then ``max over its internal links of the
+folded resident load`` (the candidate's own weight shifts every
+internal link equally, so it cancels out of any cross-box or
+cross-node comparison).
+
+Everything here is pure arithmetic over small node meshes (<= 64
+chips); no I/O, no staleness — the codec in linkload.py owns the wire
+format and the staleness rules.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+
+from vtpu_manager.device.types import MeshSpec
+
+Cell = tuple[int, int, int]
+# (origin cell, axis): the link from origin to origin+1 (mod size) on
+# that axis — see the module docstring for why this is exact on a torus
+LinkId = tuple[Cell, int]
+
+# uniform relative capacity per physical ICI link; the contention
+# metric is load / capacity, so relative units are all scoring needs
+LINK_CAPACITY = 1.0
+
+
+class LinkGraph:
+    """The edge set of one node's ICI mesh. Immutable after build;
+    instances are memoized per MeshSpec (meshes are frozen dataclasses
+    shared via the registry decode cache)."""
+
+    __slots__ = ("mesh", "links")
+
+    def __init__(self, mesh: MeshSpec, links: frozenset):
+        self.mesh = mesh
+        self.links = links          # frozenset[LinkId]
+
+    @staticmethod
+    @functools.lru_cache(maxsize=256)
+    def from_mesh(mesh: MeshSpec) -> "LinkGraph":
+        links = set()
+        sx, sy, sz = mesh.shape
+        for cell in itertools.product(range(sx), range(sy), range(sz)):
+            for axis in range(3):
+                lid = link_from(cell, axis, mesh)
+                if lid is not None:
+                    links.add(lid)
+        return LinkGraph(mesh, frozenset(links))
+
+    def capacity(self, link: LinkId) -> float:  # noqa: ARG002 — uniform
+        return LINK_CAPACITY
+
+    def total_capacity(self) -> float:
+        return LINK_CAPACITY * len(self.links)
+
+
+def link_from(cell: Cell, axis: int, mesh: MeshSpec) -> LinkId | None:
+    """The link leaving ``cell`` in +axis direction, or None when the
+    mesh has no such physical link (size-1 axis, or past the edge of a
+    non-wrapping axis)."""
+    size = mesh.shape[axis]
+    if size <= 1:
+        return None
+    if cell[axis] == size - 1 and not mesh.wrap[axis]:
+        return None
+    return (cell, axis)
+
+
+def link_endpoints(link: LinkId, mesh: MeshSpec) -> tuple[Cell, Cell]:
+    cell, axis = link
+    other = list(cell)
+    other[axis] = (cell[axis] + 1) % mesh.shape[axis]
+    return cell, tuple(other)
+
+
+def internal_links(cells, mesh: MeshSpec) -> list[LinkId]:
+    """Links with BOTH endpoints inside ``cells`` — the edges a
+    communicator box spanning those cells puts collective traffic on.
+    One pass over |cells| x 3 axes."""
+    cell_set = set(cells)
+    out = []
+    for cell in cell_set:
+        for axis in range(3):
+            lid = link_from(cell, axis, mesh)
+            if lid is None:
+                continue
+            if link_endpoints(lid, mesh)[1] in cell_set:
+                out.append(lid)
+    return out
+
+
+def fold_box_load(load: dict, cells, weight: float,
+                  mesh: MeshSpec) -> None:
+    """Fold one tenant's communicator box into a per-link load map
+    (LinkId -> load). Uniform per internal link (the ring all-reduce
+    profile); a single-chip box has no internal links and folds
+    nothing."""
+    if weight <= 0.0:
+        return
+    for lid in internal_links(cells, mesh):
+        load[lid] = load.get(lid, 0.0) + weight
+
+
+def worst_link_load(cells, load: dict | None, mesh: MeshSpec) -> float:
+    """Worst-link contention of a candidate selection: the max folded
+    resident load (per unit capacity) over the selection's internal
+    links. 0.0 for empty/absent load, single-chip selections, or
+    selections whose links carry no resident traffic."""
+    if not load:
+        return 0.0
+    worst = 0.0
+    for lid in internal_links(cells, mesh):
+        v = load.get(lid, 0.0) / LINK_CAPACITY
+        if v > worst:
+            worst = v
+    return worst
+
+
+def _axis_dist(a: int, b: int, size: int, wrap: bool) -> int:
+    d = abs(a - b)
+    return min(d, size - d) if wrap and size else d
+
+
+def box_diameter(cells, mesh: MeshSpec) -> int:
+    """Max pairwise torus-manhattan distance inside the selection —
+    the ICI hop bound of its collectives (the secondary link
+    dimension, after worst-link contention)."""
+    cells = list(cells)
+    worst = 0
+    for c1, c2 in itertools.combinations(cells, 2):
+        d = sum(_axis_dist(c1[i], c2[i], mesh.shape[i], mesh.wrap[i])
+                for i in range(3))
+        if d > worst:
+            worst = d
+    return worst

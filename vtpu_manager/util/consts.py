@@ -313,6 +313,31 @@ def node_victim_cost_annotation() -> str:
     return _ann("node-victim-costs")
 
 
+def node_ici_link_load_annotation() -> str:
+    """vtici per-node ICI link-load rollup (ICILinkAware gate):
+    per-link folded resident traffic —
+    ``"<x>.<y>.<z>.<axis>:<load>;...@<ts>"`` (topology/linkload.py) —
+    published by the device-plugin daemon over the registry channel so
+    both scheduler paths can score any candidate chip selection's
+    worst-link contention in one pass. Same staleness-by-timestamp
+    family as the pressure/headroom/overcommit codecs: a dead
+    publisher decays to no-signal (link_term 0.0), never pins a stale
+    contention claim the scheduler would steer on."""
+    return _ann("node-ici-link-load")
+
+
+def ici_link_pct_annotation() -> str:
+    """vtici per-tenant interconnect share (ICILinkAware gate): the
+    percentage of the node's ICI link bandwidth this tenant's
+    collective-heavy dispatch may consume, declared on the pod (or via
+    the ``VTPU_ICI_LINK_PCT`` container env the deployment template
+    already owns) and normalized by the webhook at admission — the one
+    annotation the device plugin stamps into the v5 config ABI so the
+    C++ shim's ICI token bucket shapes multi-chip dispatch. 0/absent =
+    unshaped (the v4 semantics byte-for-byte)."""
+    return _ann("ici-link-pct")
+
+
 def node_reclaimable_headroom_annotation() -> str:
     """vtuse reclaimable-headroom rollup (same codec family as the
     pressure annotation, utilization/headroom.py): per-chip
@@ -410,6 +435,10 @@ ENV_PROGRAM_FINGERPRINT = "VTPU_PROGRAM_FINGERPRINT"
 # tenant-declared workload class (vtqm; same env-to-annotation
 # normalization as the fingerprint — no tenant code changes)
 ENV_WORKLOAD_CLASS = "VTPU_WORKLOAD_CLASS"
+# tenant-declared ICI link share percentage (vtici; same
+# env-to-annotation normalization — the webhook validates 1..100 and
+# the plugin stamps it into the v5 config ABI for shim-side shaping)
+ENV_ICI_LINK_PCT = "VTPU_ICI_LINK_PCT"
 ENV_REGISTRY_SOCKET = "VTPU_REGISTRY_SOCKET"  # registry socket override
 ENV_POD_NAME = "VTPU_POD_NAME"
 ENV_POD_NAMESPACE = "VTPU_POD_NAMESPACE"

@@ -33,6 +33,7 @@ UTILIZATION_LEDGER = "UtilizationLedger"  # vtuse per-tenant utilization ledger
 DECISION_EXPLAIN = "DecisionExplain"    # vtexplain per-decision audit trail
 QUOTA_MARKET = "QuotaMarket"            # vtqm elastic quota market
 HBM_OVERCOMMIT = "HBMOvercommit"        # vtovc virtual HBM + host-spill tier
+ICI_LINK_AWARE = "ICILinkAware"         # vtici link-contention-aware placement
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -143,6 +144,24 @@ _KNOWN = {
     # demote to a host-RAM pool bounded by the per-node spill budget
     # accounted in the vmem ledger.
     HBM_OVERCOMMIT: False,
+    # Default off: byte-identical — no link-load annotation published,
+    # the scheduler never parses or scores link state (placement is
+    # byte-identical in BOTH data paths; select_submesh keeps its
+    # exact pre-vtici box choice), the webhook stamps no ici-link-pct
+    # annotation, and configs carry ici_link_pct=0 (the v4 wire
+    # bytes) so the shim's ICI shaping stays disarmed. On, the node
+    # models its ICI mesh as an explicit link-capacity graph
+    # (vtpu_manager/topology/): each resident tenant's communicator
+    # box folds measured (vtuse duty, allocated fallback) traffic
+    # into per-link load published over the registry channel; both
+    # scheduler paths score gang/ICI candidates by worst-link
+    # contention (a soft link_term audited in vtexplain, plus a link
+    # dimension inside the submesh search) so spread-vs-binpack
+    # becomes a measured, auditable tradeoff; and the C++ shim
+    # throttles a tenant's multi-chip (collective-heavy) dispatch to
+    # its webhook-declared ICI link share with the existing
+    # token-bucket machinery.
+    ICI_LINK_AWARE: False,
 }
 
 

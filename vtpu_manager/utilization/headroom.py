@@ -191,3 +191,34 @@ def headroom_term_from_input(score_input: float) -> float:
     carry the raw input; applying the cap here keeps the two
     derivations one formula."""
     return min(max(score_input, 0.0), HEADROOM_TERM_CAP)
+
+
+# class-mix-aware packing (ROADMAP quota item (a); the PR 11
+# observe-only resident class-mix decode made a REAL soft term): a
+# latency-critical borrower prefers nodes with throughput LENDER
+# residents, because reclaimable headroom without a lender-class
+# counterparty is headroom the market cannot actually lend. Small on
+# purpose — a counterparty tiebreak inside the headroom currency, not
+# a new axis: per-lender bonus 5, capped at 15 (strictly below the
+# headroom cap 50, the pressure ceiling 50, and the +100 gang bonus).
+MIX_TERM_PER_LENDER = 5.0
+MIX_TERM_CAP = 15.0
+
+# wire key of the lender class in the class_mix segment
+# (overcommit/ratio.py CLASS_KEYS: throughput tenants lend)
+_LENDER_MIX_KEY = "thr"
+
+
+def class_mix_term(hr: "NodeHeadroom | None",
+                   now: float | None = None) -> float:
+    """vtqm satellite: the class-mix score term for a latency-critical
+    pod under the QuotaMarket gate. Rides the SAME annotation (and so
+    the same staleness budget) as the headroom term: a stale or absent
+    rollup — or one without the mix segment — contributes exactly 0.0,
+    the byte-identical pre-mix score, in BOTH scheduler data paths."""
+    if hr is None or not headroom_is_fresh(hr, now):
+        return 0.0
+    lenders = hr.class_mix.get(_LENDER_MIX_KEY, 0)
+    if lenders <= 0:
+        return 0.0
+    return min(lenders * MIX_TERM_PER_LENDER, MIX_TERM_CAP)

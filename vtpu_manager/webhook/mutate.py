@@ -60,7 +60,8 @@ def _escape(path: str) -> str:
 def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
                set_scheduler: bool = True,
                stamp_fingerprint: bool = False,
-               stamp_workload_class: bool = False) -> MutateResult:
+               stamp_workload_class: bool = False,
+               stamp_ici_link_pct: bool = False) -> MutateResult:
     result = MutateResult()
     if not requests_vtpu(pod):
         return result
@@ -79,6 +80,11 @@ def mutate_pod(pod: dict, scheduler_name: str = consts.DEFAULT_SCHEDULER_NAME,
             # term and the plugin's config ABI stamping both key on
             # this one normalized annotation
             _stamp_workload_class(pod, result)
+        if stamp_ici_link_pct:
+            # vtici (ICILinkAware gate): the device plugin stamps this
+            # one normalized annotation into the v5 config ABI so the
+            # shim shapes the tenant's collective-heavy dispatch
+            _stamp_ici_link_pct(pod, result)
         if ctx is not None:
             for ann, value in sorted(trace.annotation_values(ctx).items()):
                 # "add" replaces an existing member (RFC 6902 §4.1), so a
@@ -163,6 +169,49 @@ def _stamp_workload_class(pod: dict, result: MutateResult) -> None:
                 "op": "remove",
                 "path": f"/metadata/annotations/{_escape(ann)}"})
         return
+    if anns.get(ann) != clean:
+        result.patches.append({
+            "op": "add",   # add replaces an existing member (RFC 6902)
+            "path": f"/metadata/annotations/{_escape(ann)}",
+            "value": clean})
+
+
+def _stamp_ici_link_pct(pod: dict, result: MutateResult) -> None:
+    """Normalize the tenant-declared ICI link share into the one
+    annotation downstream readers use (the program-fingerprint rule: a
+    pre-set annotation wins over the ``VTPU_ICI_LINK_PCT`` container
+    env, both are validated — an integer percentage in 1..100 — and
+    garbage is removed with a warning rather than flowing into the
+    device plugin's config stamping)."""
+    meta = pod.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    ann = consts.ici_link_pct_annotation()
+    raw = anns.get(ann)
+    if not raw:
+        for cont in ((pod.get("spec") or {}).get("containers") or []):
+            for env in (cont.get("env") or []):
+                if env.get("name") == consts.ENV_ICI_LINK_PCT \
+                        and env.get("value"):
+                    raw = env["value"]
+                    break
+            if raw:
+                break
+    if not raw:
+        return
+    try:
+        pct = int(str(raw).strip())
+    except (TypeError, ValueError):
+        pct = -1
+    if not 1 <= pct <= 100:
+        result.warnings.append(
+            f"annotation {ann}={raw!r} is not an integer percentage "
+            "in 1..100; removed")
+        if ann in anns:
+            result.patches.append({
+                "op": "remove",
+                "path": f"/metadata/annotations/{_escape(ann)}"})
+        return
+    clean = str(pct)
     if anns.get(ann) != clean:
         result.patches.append({
             "op": "add",   # add replaces an existing member (RFC 6902)

@@ -39,7 +39,8 @@ class WebhookAPI:
     def __init__(self, scheduler_name: str | None = None,
                  dra_convert: bool = False, client=None,
                  stamp_fingerprint: bool = False,
-                 stamp_workload_class: bool = False):
+                 stamp_workload_class: bool = False,
+                 stamp_ici_link_pct: bool = False):
         from vtpu_manager.util import consts
         self.scheduler_name = scheduler_name or consts.DEFAULT_SCHEDULER_NAME
         self.dra_convert = dra_convert   # rewrite vtpu-* into ResourceClaims
@@ -49,6 +50,8 @@ class WebhookAPI:
         self.stamp_fingerprint = stamp_fingerprint
         # vtqm (QuotaMarket gate): normalize the declared workload class
         self.stamp_workload_class = stamp_workload_class
+        # vtici (ICILinkAware gate): normalize the declared ICI share
+        self.stamp_ici_link_pct = stamp_ici_link_pct
         self.stats = {"mutate": 0, "validate": 0, "errors": 0}
 
     def build_app(self) -> web.Application:
@@ -75,7 +78,8 @@ class WebhookAPI:
             result = mutate_pod(
                 pod, scheduler_name=self.scheduler_name,
                 stamp_fingerprint=self.stamp_fingerprint,
-                stamp_workload_class=self.stamp_workload_class)
+                stamp_workload_class=self.stamp_workload_class,
+                stamp_ici_link_pct=self.stamp_ici_link_pct)
             patches = list(result.patches)
             warnings = list(result.warnings)
             if self.dra_convert:
