@@ -122,6 +122,14 @@ test-ici: ## vtici suite: link-graph torus properties, contention vs brute force
 bench-ici: ## vtici headline bench: co-resident communicator boxes, capacity-only vs link-aware placement — worst-link contention + modeled all-reduce step time reduction, gate-off parity (asserted; writes BENCH_VTICI_r13.json)
 	python scripts/bench_ici.py
 
+.PHONY: test-comm
+test-comm: ## vtcomm suite: v3 comm-block ledger fold, publisher preference chain + fallback audit, gate-off byte-contracts, torn-fold chaos, borrowed-vs-used replay check, fleet overcommit view
+	$(PYTEST) tests/test_comm.py -q
+
+.PHONY: bench-comm
+bench-comm: ## vtcomm headline bench: measured comm-intensity MAE vs ground truth beats the duty chain and the 1.6x model, measured-fed steering both scheduler modes (asserted; writes BENCH_VTCOMM_r14.json)
+	python scripts/bench_comm.py
+
 .PHONY: test-overcommit
 test-overcommit: ## vtovc suite: ratio codec + policy percentiles, virtual admission parity both modes, spill pool chaos (torn copy / budget / crashed-spiller reap), gate-off byte-contracts
 	$(PYTEST) tests/test_overcommit.py -q
@@ -131,7 +139,7 @@ bench-overcommit: ## vtovc headline bench: pods-per-chip density gate off/on (>=
 	python scripts/bench_overcommit.py
 
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici bench-overcommit bench-clustercache bench-ici ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm bench-overcommit bench-clustercache bench-ici bench-comm ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -78,6 +78,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.tpu.discovery import FakeBackend, discover
 
     from vtpu_manager.util.featuregates import (CLUSTER_COMPILE_CACHE,
+                                                COMM_TELEMETRY,
                                                 DECISION_EXPLAIN,
                                                 HBM_OVERCOMMIT,
                                                 QUOTA_MARKET,
@@ -95,6 +96,7 @@ def main(argv: list[str] | None = None) -> int:
     quota_on = gates.enabled(QUOTA_MARKET)
     overcommit_on = gates.enabled(HBM_OVERCOMMIT)
     cluster_cache_on = gates.enabled(CLUSTER_COMPILE_CACHE)
+    comm_on = gates.enabled(COMM_TELEMETRY)
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
@@ -107,7 +109,9 @@ def main(argv: list[str] | None = None) -> int:
         kubelet_checkpoint=args.kubelet_checkpoint,
         utilization_enabled=util_on,
         # vtovc: the vtpu_node_spill_* series (gate off = none)
-        overcommit_enabled=overcommit_on)
+        overcommit_enabled=overcommit_on,
+        # vtcomm: the vtpu_tenant_comm_* series (gate off = none)
+        comm_enabled=comm_on)
 
     # one registry-channel client shared by the vtuse /utilization and
     # vtexplain /explain fan-ins; no client degrades both to the
@@ -147,7 +151,11 @@ def main(argv: list[str] | None = None) -> int:
             overcommit=overcommit_on,
             # vtcs: per-node warm-keys columns (vtpu-smi's WARM view)
             # fold in only when the cluster-cache gate is on
-            cluster_cache=cluster_cache_on)
+            cluster_cache=cluster_cache_on,
+            # vtcomm: measured per-tenant comm rows (time fraction,
+            # bytes/step, intensity) fold in only when the comm gate is
+            # on (off = byte-identical document, the vtqm pattern)
+            comm=comm_on)
 
     import hmac
 

@@ -114,6 +114,7 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.util import consts
     from vtpu_manager.util.featuregates import (CLIENT_MODE,
                                                 CLUSTER_COMPILE_CACHE,
+                                                COMM_TELEMETRY,
                                                 COMPILE_CACHE,
                                                 CORE_PLUGIN,
                                                 FAULT_INJECTION,
@@ -229,6 +230,17 @@ def main(argv: list[str] | None = None) -> int:
     # vttel: Allocate mounts the per-container telemetry subdir
     # read-write and injects the step-ring env; off = nothing injected
     vnum.step_telemetry_enabled = gates.enabled(STEP_TELEMETRY)
+    # vtcomm: Allocate additionally arms the shim's measured-
+    # communication accumulators (the ring's v3 comm block + the honest
+    # ICI currency). Rides the step ring: CommTelemetry without
+    # StepTelemetry has no wire and degrades loudly to disarmed.
+    comm_on = gates.enabled(COMM_TELEMETRY)
+    if comm_on and not gates.enabled(STEP_TELEMETRY):
+        log.warning("CommTelemetry=true requires StepTelemetry=true "
+                    "(the step ring is the comm block's wire); the "
+                    "comm plane stays disarmed")
+        comm_on = False
+    vnum.comm_telemetry_enabled = comm_on
     # vtcc: Allocate mounts the node-shared compile cache read-write and
     # injects the arming env + config field; off = nothing injected
     vnum.compile_cache_enabled = gates.enabled(COMPILE_CACHE)
@@ -340,6 +352,7 @@ def main(argv: list[str] | None = None) -> int:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from vtpu_manager.resilience.policy import render_resilience_metrics
+        from vtpu_manager.topology import linkload as linkload_mod
 
         class _MetricsHandler(BaseHTTPRequestHandler):
             def do_GET(self):
@@ -347,7 +360,12 @@ def main(argv: list[str] | None = None) -> int:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = (render_resilience_metrics() + "\n").encode()
+                # linkload weight-source audit rides the same process-
+                # local surface (empty until an ICILinkAware publisher
+                # ran — no publisher, no new series)
+                body = (render_resilience_metrics() + "\n"
+                        + linkload_mod.render_fallback_metrics(
+                            args.node_name)).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))
@@ -506,10 +524,15 @@ def main(argv: list[str] | None = None) -> int:
                             tc_path=consts.TC_UTIL_CONFIG)
         linkload_pub = LinkLoadPublisher(
             client, args.node_name, manager.mesh,
-            args.base_dir or consts.MANAGER_BASE_DIR, ledger=ll_ledger)
+            args.base_dir or consts.MANAGER_BASE_DIR, ledger=ll_ledger,
+            # vtcomm: prefer the measured comm-duty signal (needs the
+            # ledger to fold the v3 comm block) over the compute-duty
+            # heuristic; off keeps the pre-vtcomm chain byte-for-byte
+            comm=comm_on and ll_ledger is not None)
         linkload_pub.start()
-        log.info("ICI link-load publisher running (mesh %s, duty=%s)",
-                 manager.mesh.shape, ll_ledger is not None)
+        log.info("ICI link-load publisher running (mesh %s, duty=%s, "
+                 "comm=%s)", manager.mesh.shape, ll_ledger is not None,
+                 comm_on and ll_ledger is not None)
 
     # vtqm quota market: this daemon (the config writer) lends a chip's
     # measured-idle, confidence-gated headroom between co-tenants in

@@ -27,6 +27,7 @@
 #include <cstdint>
 #include <mutex>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "vtpu_config.h"
@@ -120,6 +121,15 @@ struct alignas(128) DeviceHot {
   // link share (and vice versa).
   std::atomic<int64_t> ici_tokens_us{0};
   std::atomic<uint64_t> ici_last_refill_ns{0};
+  // vtcomm honest ICI currency (armed by VTPU_COMM_TELEMETRY): EMA of
+  // this slot's MEASURED multi-chip (collective) spans + the wall
+  // stamp of the newest sample. While fresh (CommCostUs), the ICI
+  // bucket charges this instead of the exec-cost EMA — the exec EMA
+  // prices the whole program, this prices the dispatch shape that
+  // actually occupies links. Unarmed, both stay 0 and the bucket's
+  // currency is byte-identical to pre-v3.
+  std::atomic<int64_t> comm_cost_us{0};
+  std::atomic<uint64_t> comm_last_ns{0};
   // Observation-overhead calibration: host-observed completion spans carry
   // a fixed per-op transport+observation latency (remote PJRT tunnels add
   // ~ms of RTT to every span). An idle-time probe (min of an H2D and a D2H
@@ -197,6 +207,10 @@ struct ShimState {
   // serve a new executable the old one's cost/gate data)
   std::mutex cost_mu;
   std::unordered_map<PJRT_LoadedExecutable*, double> exec_cost_us;
+  // vtcomm: executables ever launched multi-chip (the collective-heavy
+  // dispatch shape); their measured spans feed the per-slot comm EMA.
+  // Evicted with exec_cost_us on LoadedExecutable_Destroy.
+  std::unordered_set<PJRT_LoadedExecutable*> multichip_exes;
   struct ExecFactsEntry {
     size_t num_outputs = 0;
     int64_t gate_bytes = 0;

@@ -36,12 +36,34 @@ constexpr uint32_t kStepRingMagic = 0x54535456;  // "VTST"
 // spill/fill event deltas — the channel carrying the shim's host-tier
 // activity to the collector's vtpu_node_spill_* series. Strict version
 // check; rings are recreated per container and ship with the node.
-constexpr uint32_t kStepRingVersion = 2;
+// v3 (vtcomm): records grew a comm block — comm_time_ns (measured
+// collective + transfer span time), bytes_transferred, and
+// collective_count — the measured-communication channel feeding the
+// vtuse comm-intensity ledger and the honest ICI-bucket currency.
+// CommTelemetry off writes zeros in all three.
+constexpr uint32_t kStepRingVersion = 3;
 constexpr int kStepRingCapacity = 256;
 constexpr int kStepTraceIdLen = 48;
 
 // StepRecord.flags
 constexpr uint32_t kStepFlagCompile = 0x1;  // step paid a compile
+
+// Staleness budget of the measured-collective signal (mirror of
+// stepring.COMM_SIGNAL_STALENESS_NS): the ICI token bucket charges the
+// measured collective-time EMA only while the last measured collective
+// is younger than this; otherwise it falls back to the exec-cost EMA —
+// the exact pre-v3 currency, so CommTelemetry off is byte-identical.
+constexpr uint64_t kCommSignalStalenessNs = 10ull * 1000 * 1000 * 1000;
+
+// The ICI bucket's charge-selection rule (header-only so the
+// test_config_abi g++ probe asserts it against the Python mirror
+// stepring.comm_cost_us without the cmake build).
+inline int64_t CommCostUs(int64_t comm_ema_us, uint64_t comm_age_ns,
+                          int64_t exec_cost_us) {
+  if (comm_ema_us > 0 && comm_age_ns <= kCommSignalStalenessNs)
+    return comm_ema_us;
+  return exec_cost_us;
+}
 
 struct StepRingHeader {
   uint32_t magic;
@@ -71,8 +93,13 @@ struct StepRecord {
   uint64_t spilled_bytes;  // host-pool footprint at step end (gauge)
   uint32_t spill_events;   // HBM->host demotions since last record
   uint32_t fill_events;    // host->HBM promotions since last record
+  // v3 comm block (vtcomm; zeros when CommTelemetry is off)
+  uint64_t comm_time_ns;       // measured collective+transfer span time
+  uint64_t bytes_transferred;  // bytes observed moving since last record
+  uint32_t collective_count;   // multi-chip dispatches since last record
+  uint32_t pad2_;
 };
-static_assert(sizeof(StepRecord) == 72, "StepRecord ABI size");
+static_assert(sizeof(StepRecord) == 96, "StepRecord ABI size");
 static_assert(offsetof(StepRecord, index) == 8, "ABI");
 static_assert(offsetof(StepRecord, duration_ns) == 24, "ABI");
 static_assert(offsetof(StepRecord, throttle_wait_ns) == 32, "ABI");
@@ -81,6 +108,9 @@ static_assert(offsetof(StepRecord, flags) == 48, "ABI");
 static_assert(offsetof(StepRecord, spilled_bytes) == 56, "ABI");
 static_assert(offsetof(StepRecord, spill_events) == 64, "ABI");
 static_assert(offsetof(StepRecord, fill_events) == 68, "ABI");
+static_assert(offsetof(StepRecord, comm_time_ns) == 72, "ABI");
+static_assert(offsetof(StepRecord, bytes_transferred) == 80, "ABI");
+static_assert(offsetof(StepRecord, collective_count) == 88, "ABI");
 
 constexpr size_t kStepRingFileSize =
     sizeof(StepRingHeader) + kStepRingCapacity * sizeof(StepRecord);
@@ -177,7 +207,9 @@ class StepRingWriter {
   void Record(uint64_t duration_ns, uint64_t throttle_wait_ns,
               uint64_t hbm_highwater_bytes, bool compiled,
               uint64_t start_mono_ns = 0, uint64_t spilled_bytes = 0,
-              uint32_t spill_events = 0, uint32_t fill_events = 0) {
+              uint32_t spill_events = 0, uint32_t fill_events = 0,
+              uint64_t comm_time_ns = 0, uint64_t bytes_transferred = 0,
+              uint32_t collective_count = 0) {
     if (!mm_) return;
     if (start_mono_ns == 0) {
       struct timespec ts;
@@ -203,6 +235,10 @@ class StepRingWriter {
     rec->spilled_bytes = spilled_bytes;
     rec->spill_events = spill_events;
     rec->fill_events = fill_events;
+    rec->comm_time_ns = comm_time_ns;
+    rec->bytes_transferred = bytes_transferred;
+    rec->collective_count = collective_count;
+    rec->pad2_ = 0;
     __atomic_store_n(&rec->seq, wseq + 1, __ATOMIC_RELEASE);  // even
     writes_ = index + 1;
     __atomic_store_n(&Header()->writes, writes_, __ATOMIC_RELEASE);

@@ -719,6 +719,48 @@ bool SpillTierArmed() {
 std::atomic<uint32_t> g_spill_events_window{0};
 std::atomic<uint32_t> g_fill_events_window{0};
 
+// ---------------------------------------------------------------------------
+// vtcomm measured-communication accumulators. Window counters feed the
+// shim's own step-ring records (exchanged to 0 per record); the
+// cumulative totals are exported for the Python runtime client, whose
+// writer owns the ring for Python tenants (the throttle-wait pattern).
+// All of it is one cached-env branch when CommTelemetry is off.
+// ---------------------------------------------------------------------------
+
+std::atomic<uint64_t> g_comm_time_window_ns{0};
+std::atomic<uint64_t> g_comm_bytes_window{0};
+std::atomic<uint32_t> g_collectives_window{0};
+
+bool CommTelemetryArmed() {
+  static int armed = [] {
+    const char* v = getenv("VTPU_COMM_TELEMETRY");
+    return (v && strcmp(v, "true") == 0) ? 1 : 0;
+  }();
+  return armed == 1;
+}
+
+std::atomic<uint64_t> g_comm_time_ns_total{0};
+std::atomic<uint64_t> g_comm_bytes_total{0};
+std::atomic<uint64_t> g_collectives_total{0};
+
+// One observed data movement (H2D/D2H transfer or collective payload):
+// bytes always, span time when the observer measured one.
+void AccumulateComm(uint64_t span_ns, uint64_t bytes, bool collective) {
+  if (!CommTelemetryArmed()) return;
+  if (span_ns) {
+    g_comm_time_window_ns.fetch_add(span_ns, std::memory_order_relaxed);
+    g_comm_time_ns_total.fetch_add(span_ns, std::memory_order_relaxed);
+  }
+  if (bytes) {
+    g_comm_bytes_window.fetch_add(bytes, std::memory_order_relaxed);
+    g_comm_bytes_total.fetch_add(bytes, std::memory_order_relaxed);
+  }
+  if (collective) {
+    g_collectives_window.fetch_add(1, std::memory_order_relaxed);
+    g_collectives_total.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
 bool TrySpillCold(int slot, int64_t need);
 void HandleSpillDestroy(PJRT_Buffer* buf);
 PJRT_Error* WrappedBufferDestroy(PJRT_Buffer_Destroy_Args* args);
@@ -829,6 +871,9 @@ PJRT_Error* WrappedBufferFromHostBuffer(
   }
   TrackBuffer(args->buffer, slot, bytes, args->dims, args->num_dims,
               args->type);
+  // vtcomm: H2D payload bytes (no span — the copy completes async
+  // behind the buffer's ready event, which the busy path already owns)
+  if (bytes > 0) AccumulateComm(0, (uint64_t)bytes, false);
   return nullptr;
 }
 
@@ -2208,6 +2253,23 @@ extern "C" uint64_t vtpu_throttle_wait_ns_total() {
   return g_throttle_wait_ns.load(std::memory_order_relaxed);
 }
 
+// vtcomm counterparts for the Python-owned ring: cumulative measured
+// collective/transfer time, bytes moved, and multi-chip dispatch count.
+// The Python writer charges each record the deltas (the throttle-wait
+// pattern), so shim-measured communication reaches the ring whichever
+// language owns it.
+extern "C" uint64_t vtpu_comm_time_ns_total() {
+  return g_comm_time_ns_total.load(std::memory_order_relaxed);
+}
+
+extern "C" uint64_t vtpu_comm_bytes_total() {
+  return g_comm_bytes_total.load(std::memory_order_relaxed);
+}
+
+extern "C" uint64_t vtpu_collectives_total() {
+  return g_collectives_total.load(std::memory_order_relaxed);
+}
+
 // vttel/vtuse: the Execute hook's step-ring writer, so non-Python
 // tenants (anything driving PJRT through this shim without the Python
 // runtime client) appear in the utilization ledger too. Armed lazily on
@@ -2262,6 +2324,15 @@ void RecordStepRing(int slot, uint64_t start_ns, uint64_t end_ns,
                       g_spill_events_window.exchange(
                           0, std::memory_order_relaxed),
                       g_fill_events_window.exchange(
+                          0, std::memory_order_relaxed),
+                      // vtcomm v3 comm block: measured communication
+                      // since the previous record (zeros when the
+                      // CommTelemetry env never armed an accumulator)
+                      g_comm_time_window_ns.exchange(
+                          0, std::memory_order_relaxed),
+                      g_comm_bytes_window.exchange(
+                          0, std::memory_order_relaxed),
+                      g_collectives_window.exchange(
                           0, std::memory_order_relaxed));
 }
 
@@ -2429,6 +2500,20 @@ void IciRateLimit(int slot, int64_t cost_us) {
   }
 }
 
+// vtcomm: the charge a multi-chip dispatch pays into the ICI bucket —
+// the slot's measured collective-time EMA while the signal is fresh
+// (CommCostUs, the cross-language-asserted rule), the exec-cost EMA
+// otherwise. CommTelemetry off never writes comm_cost_us, so the
+// fallback branch is the byte-identical pre-v3 behavior.
+int64_t IciDispatchCostUs(DeviceHot& hot, int64_t exec_cost_us) {
+  int64_t comm = hot.comm_cost_us.load(std::memory_order_relaxed);
+  uint64_t last = hot.comm_last_ns.load(std::memory_order_relaxed);
+  if (comm <= 0 || last == 0) return exec_cost_us;
+  uint64_t now = NowNs();
+  uint64_t age = now > last ? now - last : 0;
+  return CommCostUs(comm, age, exec_cost_us);
+}
+
 void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
                    uint64_t end_ns, bool measured) {
   ShimState& s = State();
@@ -2443,14 +2528,39 @@ void OnExecuteDone(int slot, PJRT_LoadedExecutable* exe, uint64_t start_ns,
     // Cost EMA uses the raw duration (coverage clamping below is about
     // busy accounting, not per-program cost).
     int64_t raw_us = (int64_t)((end_ns - start_ns) / 1000);
-    std::lock_guard<std::mutex> g(s.cost_mu);
-    auto it = s.exec_cost_us.find(exe);
-    if (it == s.exec_cost_us.end()) {
-      first_execute = true;
-      s.exec_cost_us[exe] = (double)raw_us;
-    } else {
-      it->second =
-          (1 - kCostEmaAlpha) * it->second + kCostEmaAlpha * raw_us;
+    bool multichip = false;
+    {
+      std::lock_guard<std::mutex> g(s.cost_mu);
+      auto it = s.exec_cost_us.find(exe);
+      if (it == s.exec_cost_us.end()) {
+        first_execute = true;
+        s.exec_cost_us[exe] = (double)raw_us;
+      } else {
+        it->second =
+            (1 - kCostEmaAlpha) * it->second + kCostEmaAlpha * raw_us;
+      }
+      multichip = s.multichip_exes.count(exe) != 0;
+    }
+    if (multichip && CommTelemetryArmed()) {
+      // vtcomm: a MEASURED multi-chip span is the collective-heavy
+      // window — it feeds the step ring's comm block and this slot's
+      // collective-time EMA (the ICI bucket's honest currency while
+      // fresh; see IciDispatchCostUs). Ring accumulation happens on
+      // slot 0 ONLY: a multi-chip launch completes once per device
+      // (every launch spans slot 0 — execute_device implies ndev==1,
+      // never multichip), and counting each device's overlapping span
+      // would inflate the tenant's comm time and collective count by
+      // the box size.
+      if (slot == 0)
+        AccumulateComm(end_ns - start_ns, 0, /*collective=*/true);
+      DeviceHot& hot = s.hot[slot];
+      int64_t prev = hot.comm_cost_us.load(std::memory_order_relaxed);
+      int64_t next = prev <= 0
+                         ? raw_us
+                         : (int64_t)((1 - kCostEmaAlpha) * prev +
+                                     kCostEmaAlpha * raw_us);
+      hot.comm_cost_us.store(next, std::memory_order_relaxed);
+      hot.comm_last_ns.store(NowNs(), std::memory_order_relaxed);
     }
   }
   if (measured) {
@@ -2654,6 +2764,7 @@ PJRT_Error* WrappedLoadedExecutableDestroy(
     std::lock_guard<std::mutex> g(s.cost_mu);
     s.exec_cost_us.erase(args->executable);
     s.exec_facts.erase(args->executable);
+    s.multichip_exes.erase(args->executable);
   }
   return g_real_loaded_destroy ? g_real_loaded_destroy(args) : nullptr;
 }
@@ -2815,10 +2926,21 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
       // vtici: a multi-chip launch is collective-heavy dispatch — its
       // all-reduce/all-gather traffic occupies the ICI links between
       // the chips it spans — so it additionally pays the tenant's ICI
-      // link-share bucket (no-op unless the v5 config granted a share)
+      // link-share bucket (no-op unless the v5 config granted a share).
+      // vtcomm: the executable is remembered as multi-chip so its
+      // measured spans feed the collective-time EMA, and each slot is
+      // charged the HONEST currency — the measured collective EMA
+      // while fresh, the exec-cost EMA otherwise (CommCostUs; unarmed
+      // CommTelemetry never measures one, so the fallback is the
+      // byte-identical pre-v3 charge).
+      if (CommTelemetryArmed()) {
+        std::lock_guard<std::mutex> g(s.cost_mu);
+        s.multichip_exes.insert(args->executable);
+      }
       for (size_t d = 0; d < ndev; d++) {
         int slot = (int)d;
-        if (slot < s.device_count) IciRateLimit(slot, cost);
+        if (slot < s.device_count)
+          IciRateLimit(slot, IciDispatchCostUs(s.hot[slot], cost));
       }
     }
     g_metrics.execs.Bump();
@@ -2865,6 +2987,13 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
     } else if (tracked > 0) {
       s.hot[slot].used_bytes.fetch_add(tracked,
                                        std::memory_order_relaxed);
+    }
+    if (ndev > 1 && tracked > 0) {
+      // vtcomm: a multi-chip launch's per-device output bytes are the
+      // collective's result payload — an honest LOWER bound on bytes
+      // its all-reduce/all-gather moved over the links (ring all-reduce
+      // sends ~2(n-1)/n x payload). One branch when unarmed.
+      AccumulateComm(0, (uint64_t)tracked, /*collective=*/false);
     }
     // Completion timing: our own ReadyEvent awaited on a dedicated thread.
     // (Caller-provided device_complete_events are NOT used: some PJRT
@@ -2913,6 +3042,7 @@ int SlotOfBuffer(PJRT_Buffer* buf) {
 struct TransferTiming {
   int slot;
   uint64_t start_ns;
+  uint64_t bytes = 0;   // vtcomm: D2H payload size for the comm block
 };
 
 void TransferDoneCallback(PJRT_Error* error, void* user_arg) {
@@ -2920,6 +3050,11 @@ void TransferDoneCallback(PJRT_Error* error, void* user_arg) {
   uint64_t end = NowNs();
   VTPU_LOG(kLogDebug, "transfer done slot=%d span_us=%lld", t->slot, (long long)((end - t->start_ns) / 1000));
   OnExecuteDone(t->slot, nullptr, t->start_ns, end);
+  // vtcomm: the measured D2H span + its payload bytes are data
+  // movement the chip really performed — the transfer leg of the step
+  // ring's comm block (the existing busy-accounting span, reused)
+  AccumulateComm(end > t->start_ns ? end - t->start_ns : 0, t->bytes,
+                 /*collective=*/false);
   delete t;
   if (error) {
     PJRT_Error_Destroy_Args dargs;
@@ -2947,7 +3082,7 @@ PJRT_Error* WrappedToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
     return err;  // size query or unmanaged device: nothing to time
   ShimState& s = State();
   if (s.real_api->PJRT_Event_OnReady) {
-    auto* timing = new TransferTiming{slot, start};
+    auto* timing = new TransferTiming{slot, start, args->dst_size};
     PJRT_Event_OnReady_Args oargs;
     memset(&oargs, 0, sizeof(oargs));
     oargs.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;

@@ -141,6 +141,41 @@ def _print_human(doc: dict, flips_only: bool) -> None:
               f"{row['candidates']} candidates with signal)")
 
 
+def check_borrowed_used(doc: dict) -> tuple[int, list[str]]:
+    """vtqm evidence loop (quota item (d), observe-only leg): replay a
+    recorded /utilization document's per-lease borrowed-vs-used rows
+    against the document's OWN tenant rows — the vtuse apportioning
+    rule, re-derived: used_of_borrowed = clamp(used - base_alloc, 0,
+    pct). Returns (rows checked, mismatch descriptions); a non-empty
+    mismatch list means the monitor's fold and the recorded evidence
+    disagree — the signal quota-grant tuning must not trust."""
+    quota = doc.get("quota") or {}
+    rows = quota.get("borrowed_used") or []
+    by_row = {}
+    for t in doc.get("tenants") or []:
+        key = (t.get("pod_uid", ""),
+               str(t.get("container", "")).split("/", 1)[0],
+               t.get("chip_index"))
+        by_row[key] = t
+    mismatches: list[str] = []
+    for bu in rows:
+        uid, _, label = str(bu.get("borrower", "")).partition("/")
+        t = by_row.get((uid, label.split("/", 1)[0], bu.get("chip")))
+        pct = int(bu.get("pct", 0))
+        used = t.get("used_core_pct") if t else None
+        base = t.get("allocated_core_pct") if t else None
+        expect = None
+        if used is not None and base is not None and pct > 0:
+            expect = round(min(max(float(used) - float(base), 0.0),
+                               float(pct)), 2)
+        got = bu.get("used_of_borrowed_pct")
+        if got != expect:
+            mismatches.append(
+                f"lease {bu.get('id')}: recorded used_of_borrowed "
+                f"{got} != re-derived {expect}")
+    return len(rows), mismatches
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="vtpu-replay", description=__doc__,
@@ -153,9 +188,37 @@ def main(argv: list[str] | None = None) -> int:
                              "trace id)")
     parser.add_argument("--flips-only", action="store_true",
                         help="print only the passes that flip")
+    parser.add_argument("--utilization-file", default=None,
+                        help="replay-check a recorded /utilization "
+                             "document's per-lease borrowed-vs-used "
+                             "rows against its own tenant rows (the "
+                             "vtuse apportioning rule re-derived); "
+                             "exit 1 on any mismatch")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine output")
     args = parser.parse_args(argv)
+
+    if args.utilization_file:
+        try:
+            with open(args.utilization_file) as f:
+                udoc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"vtpu-replay: cannot read {args.utilization_file}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        checked, mismatches = check_borrowed_used(udoc)
+        out = {"leases_checked": checked, "mismatches": mismatches}
+        if args.as_json:
+            print(json.dumps(out, indent=2))
+        else:
+            print(f"checked {checked} borrowed-vs-used lease row(s) "
+                  f"against the document's tenant rows")
+            for m in mismatches:
+                print(f"  MISMATCH {m}")
+            if checked and not mismatches:
+                print("  all rows re-derive exactly (vtuse "
+                      "apportioning rule)")
+        return 1 if mismatches else 0
 
     from vtpu_manager.util import consts
     explain_dir = args.explain_dir or consts.EXPLAIN_DIR

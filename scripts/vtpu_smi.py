@@ -84,6 +84,35 @@ def render(doc: dict, out=None) -> None:
           f"({cluster.get('nodes_with_signal', 0)} node(s) reporting)"
           f"{market}",
           file=out)
+    # vtovc fleet policy view (overcommit documents only — a gate-off
+    # document renders exactly the prior header): per-class ratio
+    # spread across publishing nodes + the fleet spill-rate headline
+    oc = doc.get("overcommit")
+    if oc is not None:
+        spread = "  ".join(
+            f"{cls} {v['min_ratio']:.2f}-{v['max_ratio']:.2f}x "
+            f"on {v['nodes']} node(s)"
+            for cls, v in sorted((oc.get("classes") or {}).items()))
+        spill = (f"spill {oc.get('fleet_spill_frac_mean', 0) * 100:.1f}%"
+                 f" mean/{oc.get('fleet_spill_frac_max', 0) * 100:.1f}% "
+                 f"max of steps/"
+                 f"{_gib(oc.get('fleet_spilled_bytes', 0)).strip()}")
+        print(f"  oversub fleet: {oc.get('nodes_publishing', 0)} "
+              f"node(s) publishing"
+              + (f"  {spread}" if spread else "") + f"  {spill}",
+              file=out)
+    # vtqm evidence loop (market documents only): per-lease
+    # borrowed-vs-used — did the borrower use what it borrowed?
+    for bu in (quota or {}).get("borrowed_used") or []:
+        used = bu.get("used_of_borrowed_pct")
+        util = bu.get("utilization")
+        verdict = "no live signal" if used is None else (
+            f"used {used}% of {bu.get('pct', 0)}% borrowed "
+            f"({util * 100:.0f}%)")
+        print(f"  lease {str(bu.get('id', ''))[:12]:<12} "
+              f"chip {bu.get('chip', '?')} "
+              f"{str(bu.get('borrower', ''))[:28]:<28} {verdict}",
+              file=out)
     for err in doc.get("errors") or []:
         print(f"  warning: {err}", file=out)
 
@@ -169,9 +198,16 @@ def render(doc: dict, out=None) -> None:
             t.get("lent_core_pct") is not None
             or t.get("borrowed_core_pct") is not None for t in tenants)
         market_hdr = f" {'lent':>6} {'borrow':>6}" if show_market else ""
+        # vtcomm: COMM column (measured comm link-duty + intensity)
+        # appears only when the document carries comm state
+        # (CommTelemetry on at the monitor) — a gate-off document
+        # renders exactly the pre-vtcomm table
+        show_comm = any(t.get("comm_duty_frac") is not None
+                        for t in tenants)
+        comm_hdr = f" {'comm':>11}" if show_comm else ""
         print(f"{'POD':<28} {'container':<12} {'node':<12} {'chip':>4} "
               f"{'quota':>7} {'used':>7} {'wait':>6} {'hbm-hw':>8} "
-              f"{'conf':>9}{market_hdr}", file=out)
+              f"{'conf':>9}{market_hdr}{comm_hdr}", file=out)
         for t in tenants:
             pod = t.get("pod_name") or t.get("pod_uid", "?")
             ns = t.get("pod_namespace", "")
@@ -184,6 +220,16 @@ def render(doc: dict, out=None) -> None:
                 market_cols = (
                     f" {'-' if lent is None else f'{lent}%':>6}"
                     f" {'-' if borrowed is None else f'{borrowed}%':>6}")
+            comm_cols = ""
+            if show_comm:
+                cf = t.get("comm_duty_frac")
+                ci = t.get("comm_intensity")
+                if cf is None:
+                    comm_cols = f" {'-':>11}"
+                else:
+                    cell = f"{cf * 100:4.1f}%" + (
+                        f" x{ci:.2f}" if ci is not None else "")
+                    comm_cols = f" {cell:>11}"
             print(f"{label[:28]:<28} {t.get('container', '')[:12]:<12} "
                   f"{t.get('node', '')[:12]:<12} "
                   f"{t.get('chip_index', '?'):>4} "
@@ -191,7 +237,7 @@ def render(doc: dict, out=None) -> None:
                   f"{_pct(t.get('used_core_pct')):>7} "
                   f"{'-' if wait is None else f'{wait * 100:4.1f}%':>6} "
                   f"{_gib(t.get('hbm_highwater_bytes')):>8} "
-                  f"{_conf(t):>9}{market_cols}", file=out)
+                  f"{_conf(t):>9}{market_cols}{comm_cols}", file=out)
     else:
         print("(no tenant rows)", file=out)
 

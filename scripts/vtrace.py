@@ -176,6 +176,15 @@ def main(argv: list[str] | None = None) -> int:
         else:
             _print_timeline(tl)
             for s in steps:
+                # vtcomm splice: the comm keys exist only when the ring
+                # carries a measured comm block (CommTelemetry armed) —
+                # a gate-off report prints exactly the pre-vtcomm line
+                comm = ""
+                if "comm_time_frac" in s:
+                    comm = (f"  comm {s['comm_time_frac'] * 100:.1f}% "
+                            f"of step/"
+                            f"{s['comm_bytes_per_step']} B/step/"
+                            f"{s['collectives']} collective(s)")
                 print(f"  steps [{s['container']}]: "
                       f"{s['steps_total']} total "
                       f"({s['steps_resident']} resident, "
@@ -183,7 +192,7 @@ def main(argv: list[str] | None = None) -> int:
                       f"p50 {s['p50_s'] * 1000:.3f} ms  "
                       f"p99 {s['p99_s'] * 1000:.3f} ms  "
                       f"throttle-wait {s['throttle_wait_frac'] * 100:.1f}%"
-                      f"  hbm-hw {s['hbm_highwater_bytes']}")
+                      f"  hbm-hw {s['hbm_highwater_bytes']}{comm}")
             for c in compiles:
                 # vtcs: the fetch-vs-compile outcome rides the same
                 # splice — "fetch" = the artifact was seeded from a

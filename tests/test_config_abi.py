@@ -85,6 +85,13 @@ int main() {
   printf("sr.spilled_bytes %zu\n", offsetof(StepRecord, spilled_bytes));
   printf("sr.spill_events %zu\n", offsetof(StepRecord, spill_events));
   printf("sr.fill_events %zu\n", offsetof(StepRecord, fill_events));
+  printf("sr.comm_time_ns %zu\n", offsetof(StepRecord, comm_time_ns));
+  printf("sr.bytes_transferred %zu\n",
+         offsetof(StepRecord, bytes_transferred));
+  printf("sr.collective_count %zu\n",
+         offsetof(StepRecord, collective_count));
+  printf("comm_staleness_ns %llu\n",
+         (unsigned long long)kCommSignalStalenessNs);
   return 0;
 }
 """
@@ -144,6 +151,11 @@ class TestCrossLanguageLayout:
                 stepring.HEADER_OFFSETS[name], name
         for name, off in stepring.RECORD_OFFSETS.items():
             assert int(cxx_layout[f"sr.{name}"]) == off, name
+        # vtcomm: the ICI-currency staleness budget is ABI too — the
+        # C++ CommCostUs and the Python mirror must judge freshness
+        # against the same constant
+        assert int(cxx_layout["comm_staleness_ns"]) == \
+            stepring.COMM_SIGNAL_STALENESS_NS
 
 
 class TestVtpuConfigRoundtrip:
@@ -636,9 +648,15 @@ int main(int argc, char** argv) {
   int n = atoi(argv[2]);
   for (int i = 0; i < n; i++) {
     // FLAG_COMPILE on the stream's very first record, mirroring the
-    // shim's first-execute convention
-    w.Record(4000000ull, 1000000ull, 1ull << 20, w.writes() == 0,
-             1000000ull * (w.writes() + 1));
+    // shim's first-execute convention. The v3 comm block carries
+    // index-correlated values so a torn or misaligned read cannot
+    // round-trip by accident.
+    uint64_t idx = w.writes();
+    w.Record(4000000ull, 1000000ull, 1ull << 20, idx == 0,
+             1000000ull * (idx + 1), 0, 0, 0,
+             /*comm_time_ns=*/500000ull * (idx + 1),
+             /*bytes_transferred=*/(1ull << 20) * (idx + 1),
+             /*collective_count=*/(uint32_t)(idx + 1));
   }
   printf("%llu\n", (unsigned long long)w.writes());
   return 0;
@@ -804,13 +822,20 @@ class TestCxxStepRingWriter:
             assert records[2].throttle_wait_ns == 1_000_000
             assert records[2].hbm_highwater_bytes == 1 << 20
             assert records[3].start_mono_ns == 4_000_000
+            # v3 comm block, C++ writer -> Python reader, every field
+            # index-correlated (a misaligned read cannot pass)
+            for r in records:
+                assert r.comm_time_ns == 500_000 * (r.index + 1)
+                assert r.bytes_transferred == (1 << 20) * (r.index + 1)
+                assert r.collective_count == r.index + 1
         finally:
             reader.close()
 
     def test_restart_continues_sequence(self, cxx_ring_writer, tmp_path):
         """A restarted C++ writer continues the monotone sequence, so
         the monitor's cursor tail never resets (the Python writer's
-        contract, satisfied by the mirror)."""
+        contract, satisfied by the mirror) — and the comm block keeps
+        its per-index values across the writer generations."""
         from vtpu_manager.telemetry import stepring
         ring = str(tmp_path / "step_telemetry.ring")
         subprocess.run([cxx_ring_writer, ring, "3"], check=True,
@@ -823,8 +848,47 @@ class TestCxxStepRingWriter:
             records, head, dropped = reader.poll(3)   # cursor-tailed
             assert head == 5 and dropped == 0
             assert [r.index for r in records] == [3, 4]
+            assert [r.collective_count for r in records] == [4, 5]
+            assert records[0].comm_time_ns == 500_000 * 4
         finally:
             reader.close()
+
+    def test_v2_reader_on_v3_ring_gracefully_skips(self, tmp_path):
+        """Mixed-version node mid-upgrade: a pre-v3 reader encountering
+        a v3 ring (and a v3 reader encountering a leftover v2 file)
+        must SKIP the ring — the strict-version ValueError every
+        consumer (collector scan, ledger fold) already catches and
+        charges to that tenant's freshness — never serve records whose
+        spill/comm fields would be read from the wrong offsets."""
+        from vtpu_manager.telemetry import stepring
+        ring = str(tmp_path / "step_telemetry.ring")
+        w = stepring.StepRingWriter(ring)
+        w.record(duration_ns=1_000_000)
+        w.close()
+        # a v2 reader's strict check is version==2 && record_size==72;
+        # simulate it on this v3 file: both fields differ, so the
+        # constructor-time ValueError fires exactly like ours below
+        raw = open(ring, "rb").read()
+        version, = struct.unpack_from("<I", raw, 4)
+        rec_size, = struct.unpack_from("<i", raw, 12)
+        assert (version, rec_size) == (3, 96)   # what a v2 reader sees
+        # and a v3 reader on a leftover v2 ring refuses cleanly
+        v2 = bytearray(raw)
+        struct.pack_into("<I", v2, 4, 2)      # version
+        struct.pack_into("<i", v2, 12, 72)    # record_size
+        v2_path = str(tmp_path / "v2.ring")
+        with open(v2_path, "wb") as f:
+            f.write(bytes(v2))
+        with pytest.raises(ValueError, match="bad step ring"):
+            stepring.StepRingReader(v2_path)
+        # the collector's scan charges it as unreadable, not a crash
+        from vtpu_manager.telemetry import TenantStepTelemetry
+        base = tmp_path / "base" / "uid-v2_main" / "telemetry"
+        base.mkdir(parents=True)
+        with open(base / "step_telemetry.ring", "wb") as f:
+            f.write(bytes(v2))
+        agg = TenantStepTelemetry(str(tmp_path / "base"))
+        assert agg.scan() == 1    # one existing-but-unreadable ring
 
     def test_yields_to_live_python_writer(self, cxx_ring_writer,
                                           tmp_path):
@@ -845,3 +909,59 @@ class TestCxxStepRingWriter:
         out = subprocess.run([cxx_ring_writer, ring, "2"], check=True,
                              capture_output=True, text=True)
         assert out.stdout.strip() == "3"   # continues after handover
+
+
+COMM_COST_PROBE_SRC = r"""
+#include <cstdio>
+#include <cstdlib>
+#include "vtpu_telemetry.h"
+int main(int argc, char** argv) {
+  // argv: <comm_ema_us> <age_ns> <exec_cost_us>
+  printf("%lld\n", (long long)vtpu::CommCostUs(
+      atoll(argv[1]), strtoull(argv[2], nullptr, 10), atoll(argv[3])));
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cxx_comm_cost_probe(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("commcostprobe")
+    src = tmp / "comm_cost_probe.cc"
+    src.write_text(COMM_COST_PROBE_SRC)
+    exe = tmp / "comm_cost_probe"
+    subprocess.run(
+        ["g++", "-std=c++17", f"-I{REPO}/library/include", str(src),
+         "-o", str(exe)], check=True, capture_output=True)
+    return str(exe)
+
+
+class TestCommCostParity:
+    """vtcomm honest-currency rule, cross-language: the shim's ICI
+    bucket (CommCostUs) and the Python mirror (stepring.comm_cost_us)
+    must pick the same charge for every freshness shape — fresh
+    measured signal, exactly-at-budget, just-stale, never-measured."""
+
+    CASES = [
+        (500, 1_000, 900),                       # fresh: measured wins
+        (500, 10_000_000_000, 900),              # exactly at budget
+        (500, 10_000_000_001, 900),              # one ns stale
+        (0, 0, 900),                             # never measured
+        (1, 9_999_999_999, 7),                   # tiny but fresh
+        (123456, 20_000_000_000, 777),           # long dark
+    ]
+
+    def test_both_sides_choose_identically(self, cxx_comm_cost_probe):
+        from vtpu_manager.telemetry import stepring
+        for ema, age, exec_cost in self.CASES:
+            out = subprocess.run(
+                [cxx_comm_cost_probe, str(ema), str(age), str(exec_cost)],
+                check=True, capture_output=True, text=True).stdout.strip()
+            assert int(out) == stepring.comm_cost_us(ema, age, exec_cost), \
+                (ema, age, exec_cost)
+
+    def test_selection_semantics(self):
+        from vtpu_manager.telemetry import stepring
+        assert stepring.comm_cost_us(500, 1_000, 900) == 500
+        assert stepring.comm_cost_us(500, 10**10 + 1, 900) == 900
+        assert stepring.comm_cost_us(0, 0, 900) == 900

@@ -214,13 +214,16 @@ class TestStepRing:
         """The committed offsets (consumed by the C++ mirror's
         static_asserts and the ABI golden) match the live fmt strings."""
         assert stepring.HEADER_SIZE == 80
-        assert stepring.RECORD_SIZE == 72     # v2: +16B spill block
+        assert stepring.RECORD_SIZE == 96     # v3: +24B comm block
         assert stepring.HEADER_OFFSETS["writes"] == 24
         assert stepring.HEADER_OFFSETS["trace_id"] == 32
         assert stepring.RECORD_OFFSETS["flags"] == 48
         assert stepring.RECORD_OFFSETS["spilled_bytes"] == 56
         assert stepring.RECORD_OFFSETS["spill_events"] == 64
         assert stepring.RECORD_OFFSETS["fill_events"] == 68
+        assert stepring.RECORD_OFFSETS["comm_time_ns"] == 72
+        assert stepring.RECORD_OFFSETS["bytes_transferred"] == 80
+        assert stepring.RECORD_OFFSETS["collective_count"] == 88
         assert stepring.FILE_SIZE == \
             stepring.HEADER_SIZE + \
             stepring.RING_CAPACITY * stepring.RECORD_SIZE

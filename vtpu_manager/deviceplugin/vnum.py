@@ -54,6 +54,9 @@ class VnumPlugin(DevicePluginServicer):
     pre_start_required = True
     preferred_allocation_available = False   # gated: HonorPreAllocatedDeviceIDs
     step_telemetry_enabled = False           # gated: StepTelemetry (vttel)
+    comm_telemetry_enabled = False           # gated: CommTelemetry (vtcomm;
+                                             # rides the step ring — armed
+                                             # only alongside StepTelemetry)
     compile_cache_enabled = False            # gated: CompileCache (vtcc)
     cluster_cache_enabled = False            # gated: ClusterCompileCache
                                              # (vtcs; requires vtcc — the
@@ -554,6 +557,14 @@ class VnumPlugin(DevicePluginServicer):
                     resp.envs[consts.ENV_STEP_TELEMETRY] = "true"
                     resp.envs[consts.ENV_STEP_RING_PATH] = os.path.join(
                         tel_cont, consts.STEP_RING_NAME)
+                    if self.comm_telemetry_enabled:
+                        # vtcomm: arm the shim's measured-communication
+                        # accumulators (collective/transfer spans +
+                        # bytes into the ring's v3 comm block, honest
+                        # ICI currency). Injected only alongside the
+                        # ring env — the ring is the wire; gate off
+                        # leaves the comm block zeroed pad.
+                        resp.envs[consts.ENV_COMM_TELEMETRY] = "true"
                 except OSError as e:
                     log.warning("telemetry dir %s unavailable (%s); "
                                 "tenant %s/%s runs untelemetered",

@@ -74,7 +74,8 @@ class NodeCollector:
                  kubelet_checkpoint: str | None = None,
                  utilization_enabled: bool = False,
                  overcommit_enabled: bool = False,
-                 spill_dir: str = consts.SPILL_DIR):
+                 spill_dir: str = consts.SPILL_DIR,
+                 comm_enabled: bool = False):
         self.node_name = node_name
         self.chips = chips
         self.base_dir = base_dir
@@ -104,8 +105,12 @@ class NodeCollector:
             os.environ.get("VTPU_KUBELET_VIEW_TTL_S", "10"))
         # vttel: cursor-tailed step rings folded into cumulative per-pod
         # histograms across scrapes (the collector is the long-lived
-        # state holder; the rings only remember RING_CAPACITY steps)
-        self.telemetry = TenantStepTelemetry(base_dir)
+        # state holder; the rings only remember RING_CAPACITY steps).
+        # vtcomm (CommTelemetry gate): the same fold also accumulates
+        # the v3 comm block into the vtpu_tenant_comm_* families; off
+        # renders zero comm series — the gate-off contract.
+        self.comm_enabled = comm_enabled
+        self.telemetry = TenantStepTelemetry(base_dir, comm=comm_enabled)
         # self-observability: per-feed last-scrape-error flags (a wedged
         # config/ledger read must be visible, not silently-stale gauges)
         self._feed_errors: dict[str, float] = {

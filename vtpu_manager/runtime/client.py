@@ -182,12 +182,11 @@ def register_client(timeout_s: float = 5.0) -> bool:
             return False
 
 
-def _shim_throttle_wait_source():
-    """ctypes accessor for the shim's cumulative token-bucket wait
-    counter (``vtpu_throttle_wait_ns_total``), or None when no shim is
-    loaded or it predates the export. dlopen of the already-loaded shim
-    resolves to the same handle, so the counter read is the live one the
-    throttle loop is bumping in this very process."""
+def _shim_counter_source(symbol: str):
+    """ctypes accessor for one of the shim's cumulative counters, or
+    None when no shim is loaded or it predates the export. dlopen of
+    the already-loaded shim resolves to the same handle, so the read is
+    the live counter the shim is bumping in this very process."""
     shim = os.environ.get(consts.ENV_TPU_LIBRARY_PATH) or \
         os.environ.get("VTPU_SHIM_PATH")
     if not shim or not os.path.exists(shim):
@@ -195,7 +194,7 @@ def _shim_throttle_wait_source():
     try:
         import ctypes
         lib = ctypes.CDLL(shim)
-        fn = lib.vtpu_throttle_wait_ns_total
+        fn = getattr(lib, symbol)
         fn.restype = ctypes.c_uint64
         fn.argtypes = []
         fn()   # probe: a broken export must disarm here, not per step
@@ -204,24 +203,65 @@ def _shim_throttle_wait_source():
         return None
 
 
+def _shim_throttle_wait_source():
+    """The shim's cumulative token-bucket wait counter accessor."""
+    return _shim_counter_source("vtpu_throttle_wait_ns_total")
+
+
+def _shim_comm_sources():
+    """(comm_time_ns, comm_bytes, collectives) total accessors, or None
+    when the CommTelemetry env is unarmed or the shim predates the
+    exports — the comm block then stays zeros (the gate-off wire
+    contract). All three must resolve: a partial set would write
+    records whose comm fields disagree with each other."""
+    if os.environ.get(consts.ENV_COMM_TELEMETRY) != "true":
+        return None
+    fns = tuple(_shim_counter_source(sym) for sym in
+                ("vtpu_comm_time_ns_total", "vtpu_comm_bytes_total",
+                 "vtpu_collectives_total"))
+    return fns if all(fns) else None
+
+
 class _ShimWaitStepRing:
     """StepRingWriter wrapper charging each record the shim's REAL
     token-bucket wait since the previous record. Before this, the
     throttle-wait field was whatever the caller measured (usually 0 —
     the wait hides inside the jitted step call), so the node pressure
     annotation understated quota stalls exactly when they mattered.
-    Callers passing an explicit throttle_wait_ns keep their value."""
+    Callers passing an explicit throttle_wait_ns keep their value.
 
-    __slots__ = ("ring", "_wait_total_fn", "_last_wait_ns")
+    vtcomm: when the CommTelemetry env armed the shim's comm counters,
+    each record is also auto-charged the measured collective/transfer
+    deltas (comm time, bytes moved, multi-chip dispatches) — the Python
+    tenant cannot see its own collectives (they hide inside the jitted
+    call exactly like quota stalls), so the shim's measurement is the
+    only honest source. Unarmed, the comm fields stay zeros."""
 
-    def __init__(self, ring, wait_total_fn):
+    __slots__ = ("ring", "_wait_total_fn", "_last_wait_ns",
+                 "_comm_fns", "_last_comm")
+
+    def __init__(self, ring, wait_total_fn, comm_fns=None):
         self.ring = ring
         self._wait_total_fn = wait_total_fn
         self._last_wait_ns = int(wait_total_fn())
+        self._comm_fns = comm_fns
+        self._last_comm = tuple(int(fn()) for fn in comm_fns) \
+            if comm_fns else (0, 0, 0)
 
     @property
     def writes(self) -> int:
         return self.ring.writes
+
+    def _comm_deltas(self) -> tuple[int, int, int]:
+        if not self._comm_fns:
+            return 0, 0, 0
+        totals = tuple(int(fn()) for fn in self._comm_fns)
+        # a reloaded shim restarts its counters at 0; negative deltas
+        # re-baseline, never poison the ring (the wait-counter rule)
+        deltas = tuple(max(0, t - last)
+                       for t, last in zip(totals, self._last_comm))
+        self._last_comm = totals
+        return deltas
 
     def record(self, duration_ns: int, throttle_wait_ns: int | None = None,
                hbm_highwater_bytes: int = 0, compiled: bool = False,
@@ -238,9 +278,13 @@ class _ShimWaitStepRing:
             delta = total - self._last_wait_ns
             self._last_wait_ns = total
             throttle_wait_ns = max(0, delta)
+        comm_ns, comm_bytes, collectives = self._comm_deltas()
         self.ring.record(duration_ns, throttle_wait_ns=throttle_wait_ns,
                          hbm_highwater_bytes=hbm_highwater_bytes,
-                         compiled=compiled, start_mono_ns=start_mono_ns)
+                         compiled=compiled, start_mono_ns=start_mono_ns,
+                         comm_time_ns=comm_ns,
+                         bytes_transferred=comm_bytes,
+                         collective_count=collectives)
 
     def close(self) -> None:
         self.ring.close()
@@ -278,7 +322,8 @@ def step_telemetry():
         # reflects actual token-bucket stalls, not caller guesses)
         wait_fn = _shim_throttle_wait_source()
         if wait_fn is not None:
-            _step_telemetry = _ShimWaitStepRing(_step_telemetry, wait_fn)
+            _step_telemetry = _ShimWaitStepRing(
+                _step_telemetry, wait_fn, comm_fns=_shim_comm_sources())
         # clean unmap/unlock on interpreter exit — otherwise the GC'd
         # lock context tears down after Python's import machinery and
         # spams a harmless-but-ugly shutdown traceback

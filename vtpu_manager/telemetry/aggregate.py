@@ -35,6 +35,9 @@ DURATION_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 # HBM high-water ladder: 16 MiB .. 64 GiB covers v5e..v5p per-chip HBM
 HBM_BUCKETS_BYTES = tuple(1 << s for s in range(24, 37))
+# vtcomm per-step bytes-moved ladder: 1 KiB .. 4 GiB in powers of 4
+# (loss-scalar readbacks up to full-gradient all-reduces)
+COMM_BUCKETS_BYTES = tuple(1 << s for s in range(10, 33, 2))
 
 STEP_HIST = "vtpu_tenant_step_duration_seconds"
 WAIT_HIST = "vtpu_tenant_throttle_wait_seconds"
@@ -45,6 +48,10 @@ DROPS = "vtpu_tenant_step_ring_dropped_total"
 INFO = "vtpu_tenant_step_info"
 PRESSURE_FRAC = "vtpu_node_pressure_throttle_frac"
 PRESSURE_HEADROOM = "vtpu_node_pressure_hbm_headroom_bytes"
+# vtcomm families (CommTelemetry gate on only — off renders none)
+COMM_HIST = "vtpu_tenant_comm_time_seconds"
+COMM_BYTES_HIST = "vtpu_tenant_comm_bytes"
+COMM_FRAC = "vtpu_tenant_comm_time_fraction"
 
 
 class _Hist:
@@ -80,7 +87,8 @@ class _TenantState:
     __slots__ = ("pod_uid", "container", "trace_id", "cursor", "dropped",
                  "step_hist", "wait_hist", "hbm_hist", "hbm_highwater",
                  "window_frac", "window_rate", "last_poll_monotonic",
-                 "primed")
+                 "primed", "comm_hist", "comm_bytes_hist",
+                 "comm_window_frac")
 
     def __init__(self, pod_uid: str, container: str):
         self.pod_uid = pod_uid
@@ -100,12 +108,19 @@ class _TenantState:
         self.window_frac = 0.0
         self.window_rate = 0.0
         self.last_poll_monotonic = 0.0
+        # vtcomm (folded only when the aggregator's comm flag is on):
+        # per-step measured comm-time / bytes-moved histograms + the
+        # comm fraction of step time over the last window
+        self.comm_hist = _Hist(DURATION_BUCKETS_S)
+        self.comm_bytes_hist = _Hist(COMM_BUCKETS_BYTES)
+        self.comm_window_frac = 0.0
 
     def fold(self, records: list[stepring.StepRecord], dropped: int,
-             now_monotonic: float) -> None:
+             now_monotonic: float, comm: bool = False) -> None:
         self.dropped += dropped
         dur_sum = 0.0
         wait_sum = 0.0
+        comm_sum = 0.0
         for rec in records:
             dur = rec.duration_ns / 1e9
             wait = rec.throttle_wait_ns / 1e9
@@ -116,11 +131,26 @@ class _TenantState:
                                      rec.hbm_highwater_bytes)
             dur_sum += dur
             wait_sum += wait
+            # the ledger's no-signal rule: an all-zero comm block (gate
+            # off at the shim, pre-arm, pre-v3 writer) is NOT a
+            # measured zero — only comm-carrying records feed the
+            # histograms, and a tenant with none stays series-less
+            if comm and (rec.comm_time_ns or rec.bytes_transferred
+                         or rec.collective_count):
+                self.comm_hist.observe(rec.comm_time_ns / 1e9)
+                self.comm_bytes_hist.observe(rec.bytes_transferred)
+                comm_sum += rec.comm_time_ns / 1e9
         if records:
             # window derivatives from the records themselves, not the
             # poll interval: wall-vs-step time needs no clock agreement
             # with the tenant, and an idle window decays both to 0
             self.window_frac = wait_sum / dur_sum if dur_sum else 0.0
+            if comm and self.comm_hist.count:
+                # comm-measured tenants only: a window of genuine zero
+                # comm decays the gauge, but a never-measured tenant
+                # keeps no gauge at all (no signal != measured zero)
+                self.comm_window_frac = comm_sum / dur_sum \
+                    if dur_sum else 0.0
             if self.last_poll_monotonic:
                 window_s = max(now_monotonic - self.last_poll_monotonic,
                                1e-9)
@@ -132,14 +162,21 @@ class _TenantState:
                 - self.last_poll_monotonic > 0:
             self.window_frac = 0.0
             self.window_rate = 0.0
+            self.comm_window_frac = 0.0
         self.last_poll_monotonic = now_monotonic
 
 
 class TenantStepTelemetry:
     """Node-wide scan/fold/render over every tenant's step ring."""
 
-    def __init__(self, base_dir: str = consts.MANAGER_BASE_DIR):
+    def __init__(self, base_dir: str = consts.MANAGER_BASE_DIR,
+                 comm: bool = False):
         self.base_dir = base_dir
+        # vtcomm (CommTelemetry gate): fold + render the comm block's
+        # histograms and the comm-fraction gauge. Off (the default) is
+        # the gate-off contract — zero vtpu_tenant_comm_* series even
+        # though v3 rings carry the (zeroed) block.
+        self.comm = comm
         self._tenants: dict[tuple[str, str], _TenantState] = {}
 
     # -- discovery (same dir shapes as the collector's config join) ---------
@@ -193,7 +230,7 @@ class TenantStepTelemetry:
                 if not state.primed:
                     state.primed = True
                     dropped = 0
-                state.fold(records, dropped, now)
+                state.fold(records, dropped, now, comm=self.comm)
             finally:
                 reader.close()
         return failed
@@ -257,6 +294,37 @@ class TenantStepTelemetry:
                          f'pod_uid="{s.pod_uid}",'
                          f'container="{s.container}"}} '
                          f"{round(s.window_rate, 3)}")
+        if self.comm:
+            # vtcomm families (gate on only — the off branch renders
+            # exactly the pre-v3 text, asserted byte-identical). Only
+            # comm-MEASURED tenants get series: an unarmed tenant's
+            # zeroed comm pad must not render as "measured zero"
+            # (headers stay discoverable, the vttel convention).
+            measured = [s for s in tenants if s.comm_hist.count]
+            lines += [f"# HELP {COMM_HIST} Measured collective+transfer "
+                      f"time inside each step (v3 comm block)",
+                      f"# TYPE {COMM_HIST} histogram"]
+            for s in measured:
+                labels = (f'node="{node_name}",pod_uid="{s.pod_uid}",'
+                          f'container="{s.container}"')
+                s.comm_hist.render(COMM_HIST, labels, lines)
+            lines += [f"# HELP {COMM_BYTES_HIST} Bytes observed moving "
+                      f"per step (H2D/D2H transfers + collective "
+                      f"payload lower bound)",
+                      f"# TYPE {COMM_BYTES_HIST} histogram"]
+            for s in measured:
+                labels = (f'node="{node_name}",pod_uid="{s.pod_uid}",'
+                          f'container="{s.container}"')
+                s.comm_bytes_hist.render(COMM_BYTES_HIST, labels, lines)
+            lines += [f"# HELP {COMM_FRAC} Fraction of step time spent "
+                      f"in measured communication over the last scrape "
+                      f"window",
+                      f"# TYPE {COMM_FRAC} gauge"]
+            for s in measured:
+                lines.append(f'{COMM_FRAC}{{node="{node_name}",'
+                             f'pod_uid="{s.pod_uid}",'
+                             f'container="{s.container}"}} '
+                             f"{round(s.comm_window_frac, 6)}")
         lines += [f"# HELP {DROPS} Step records overwritten before the "
                   f"monitor tailed them (reader lagged the ring)",
                   f"# TYPE {DROPS} counter"]
@@ -326,7 +394,7 @@ def step_stats_for_pod(base_dir: str, *keys: str) -> list[dict]:
             durs = sorted(r.duration_ns / 1e9 for r in records)
             waits = [r.throttle_wait_ns / 1e9 for r in records]
             dur_sum = sum(durs)
-            out.append({
+            row = {
                 "pod_uid": pod_uid,
                 "container": container,
                 "trace_id": reader.trace_id,
@@ -339,7 +407,21 @@ def step_stats_for_pod(base_dir: str, *keys: str) -> list[dict]:
                     sum(waits) / dur_sum, 6) if dur_sum else 0.0,
                 "hbm_highwater_bytes": max(
                     (r.hbm_highwater_bytes for r in records), default=0),
-            })
+            }
+            # vtcomm splice: present ONLY when the ring carries a
+            # measured comm block (CommTelemetry armed this tenant) —
+            # a gate-off ring's zeroed pad adds no keys, so the CLI
+            # output stays byte-identical
+            comm_ns = sum(r.comm_time_ns for r in records)
+            comm_bytes = sum(r.bytes_transferred for r in records)
+            collectives = sum(r.collective_count for r in records)
+            if comm_ns or comm_bytes or collectives:
+                row["comm_time_frac"] = round(
+                    comm_ns / 1e9 / dur_sum, 6) if dur_sum else 0.0
+                row["comm_bytes_per_step"] = (
+                    comm_bytes // len(records)) if records else 0
+                row["collectives"] = collectives
+            out.append(row)
         finally:
             reader.close()
     return out

@@ -46,9 +46,35 @@ MAGIC = 0x54535456          # "VTST" little-endian
 # vtpu_node_spill_* series and the scheduler's spill-rate pressure
 # input. Strict version check, the config-ABI rule: rings are recreated
 # per container and plugin + shim + monitor ship together per node.
-VERSION = 2
+# v3 (vtcomm): a comm block — comm_time_ns (measured collective +
+# transfer span time inside the step), bytes_transferred (bytes
+# observed moving: H2D/D2H transfers plus multi-chip collective
+# payloads) and collective_count (multi-chip dispatches) — the channel
+# that makes communication a MEASURED per-step quantity (the vtuse
+# comm-intensity feed and the honest ICI-bucket currency both read
+# it). CommTelemetry off writes zeros in all three: the v3 wire
+# carries nothing beyond zeroed pad, the gate-off contract.
+VERSION = 3
 RING_CAPACITY = 256          # records; ~memory of the last 256 steps
 TRACE_ID_LEN = 48            # same bound as vtpu_config's pod_uid
+
+# Staleness budget of the shim's measured-collective signal (mirrored
+# by vtpu_telemetry.h kCommSignalStalenessNs + CommCostUs): the ICI
+# token bucket charges the measured collective-time EMA only while the
+# last measured collective is younger than this; older (or absent —
+# CommTelemetry off never measures one) falls back to the exec-cost
+# EMA, the exact pre-v3 currency.
+COMM_SIGNAL_STALENESS_NS = 10_000_000_000
+
+
+def comm_cost_us(comm_ema_us: int, comm_age_ns: int,
+                 exec_cost_us: int) -> int:
+    """Python mirror of vtpu_telemetry.h CommCostUs — the ICI bucket's
+    charge-selection rule, asserted identical cross-language by the
+    test_config_abi g++ probe."""
+    if comm_ema_us > 0 and 0 <= comm_age_ns <= COMM_SIGNAL_STALENESS_NS:
+        return comm_ema_us
+    return exec_cost_us
 
 # header: magic u32, version u32, capacity i32, record_size i32,
 # writer_pid i32, pad i32, writes u64 (total records ever published),
@@ -61,10 +87,12 @@ assert HEADER_SIZE == 80
 # record: seq u64 (per-record seqlock), index u64, start_mono_ns u64,
 # duration_ns u64, throttle_wait_ns u64, hbm_highwater_bytes u64,
 # flags u32, pad u32, spilled_bytes u64, spill_events u32,
-# fill_events u32 (v2 spill block, vtovc)
-_RECORD_FMT = "<QQQQQQIiQII"
+# fill_events u32 (v2 spill block, vtovc), comm_time_ns u64,
+# bytes_transferred u64, collective_count u32, pad2 u32 (v3 comm
+# block, vtcomm; zeros when CommTelemetry is off)
+_RECORD_FMT = "<QQQQQQIiQIIQQII"
 RECORD_SIZE = struct.calcsize(_RECORD_FMT)
-assert RECORD_SIZE == 72
+assert RECORD_SIZE == 96
 
 FILE_SIZE = HEADER_SIZE + RING_CAPACITY * RECORD_SIZE
 
@@ -89,6 +117,9 @@ class StepRecord:
     spilled_bytes: int = 0       # host-pool footprint at step end (gauge)
     spill_events: int = 0        # HBM→host demotions since last record
     fill_events: int = 0         # host→HBM promotions since last record
+    comm_time_ns: int = 0        # measured collective+transfer span time
+    bytes_transferred: int = 0   # bytes observed moving since last record
+    collective_count: int = 0    # multi-chip dispatches since last record
 
     @property
     def compiled(self) -> bool:
@@ -152,7 +183,9 @@ class StepRingWriter:
     def record(self, duration_ns: int, throttle_wait_ns: int = 0,
                hbm_highwater_bytes: int = 0, compiled: bool = False,
                start_mono_ns: int | None = None, spilled_bytes: int = 0,
-               spill_events: int = 0, fill_events: int = 0) -> None:
+               spill_events: int = 0, fill_events: int = 0,
+               comm_time_ns: int = 0, bytes_transferred: int = 0,
+               collective_count: int = 0) -> None:
         """Publish one step record (the hot path). Seqlock bracket per
         the shared-mmap protocol: odd seq first, payload, even seq last
         — ``seq | 1`` so a crashed writer's odd leftover can't invert
@@ -168,7 +201,9 @@ class StepRingWriter:
                          start_mono_ns, duration_ns, throttle_wait_ns,
                          hbm_highwater_bytes,
                          FLAG_COMPILE if compiled else 0, 0,
-                         spilled_bytes, spill_events, fill_events)
+                         spilled_bytes, spill_events, fill_events,
+                         comm_time_ns, bytes_transferred,
+                         collective_count, 0)
         struct.pack_into("<Q", self._mm, off, wseq + 1)  # even: stable
         self._writes = index + 1
         struct.pack_into("<Q", self._mm, _WRITES_OFFSET, self._writes)
@@ -252,7 +287,8 @@ class StepRingReader:
                 time.sleep(0.0002)
                 continue
             (_, rec_index, start_ns, dur_ns, wait_ns, hbm, flags,
-             _pad, spilled, spills, fills) = struct.unpack_from(
+             _pad, spilled, spills, fills, comm_ns, comm_bytes,
+             collectives, _pad2) = struct.unpack_from(
                  _RECORD_FMT, self._mm, off)
             seq2, = struct.unpack_from("<Q", self._mm, off)
             if seq1 != seq2:
@@ -260,7 +296,8 @@ class StepRingReader:
             if rec_index != index:
                 return None     # lapped: slot already holds a newer step
             return StepRecord(rec_index, start_ns, dur_ns, wait_ns, hbm,
-                              flags, spilled, spills, fills)
+                              flags, spilled, spills, fills, comm_ns,
+                              comm_bytes, collectives)
         return None
 
     def poll(self, cursor: int) -> tuple[list[StepRecord], int, int]:
@@ -303,4 +340,5 @@ RECORD_OFFSETS = {
     "seq": 0, "index": 8, "start_mono_ns": 16, "duration_ns": 24,
     "throttle_wait_ns": 32, "hbm_highwater_bytes": 40, "flags": 48,
     "spilled_bytes": 56, "spill_events": 64, "fill_events": 68,
+    "comm_time_ns": 72, "bytes_transferred": 80, "collective_count": 88,
 }

@@ -32,8 +32,12 @@ from vtpu_manager.topology.linkload import (LINK_BOX_WEIGHT,
                                             LinkLoadPublisher,
                                             NodeLinkLoad,
                                             compute_link_load,
-                                            link_term, load_is_fresh,
-                                            load_map, parse_link_load,
+                                            fallback_totals, link_term,
+                                            load_is_fresh, load_map,
+                                            measured_total,
+                                            parse_link_load,
+                                            render_fallback_metrics,
+                                            reset_fallback_totals,
                                             tenant_weight)
 
 __all__ = [
@@ -41,5 +45,6 @@ __all__ = [
     "box_diameter", "NodeLinkLoad", "parse_link_load", "link_term",
     "load_map", "load_is_fresh", "compute_link_load", "tenant_weight",
     "LINK_SCORE_WEIGHT", "LINK_TERM_CAP", "LINK_BOX_WEIGHT",
-    "LinkLoadPublisher",
+    "LinkLoadPublisher", "fallback_totals", "measured_total",
+    "render_fallback_metrics", "reset_fallback_totals",
 ]

@@ -169,11 +169,19 @@ def link_term(worst_link: float) -> float:
 # ---------------------------------------------------------------------------
 
 def tenant_weight(alloc_core_frac: float,
-                  duty_frac: float | None) -> float:
-    """One tenant's per-link traffic weight: the measured duty
-    fraction when the vtuse signal is fresh, the allocated core
-    fraction otherwise (0 allocation = uncapped tenant = 1.0, the
-    worst-case assumption a steering signal must make)."""
+                  duty_frac: float | None,
+                  comm_frac: float | None = None) -> float:
+    """One tenant's per-link traffic weight, by the vtcomm precedence
+    rule — each step one notch less measured than the last:
+
+    1. ``comm_frac``: the tenant's MEASURED comm link-duty (v3 comm
+       block via the vtuse ledger) — the links' own accounting;
+    2. ``duty_frac``: the measured COMPUTE duty share — the pre-vtcomm
+       heuristic that assumes link duty tracks compute duty;
+    3. allocated core fraction (0 allocation = uncapped tenant = 1.0,
+       the worst-case assumption a steering signal must make)."""
+    if comm_frac is not None:
+        return min(max(comm_frac, 0.0), 1.0)
     if duty_frac is not None:
         return min(max(duty_frac, 0.0), 1.0)
     if alloc_core_frac <= 0.0:
@@ -181,16 +189,79 @@ def tenant_weight(alloc_core_frac: float,
     return min(alloc_core_frac, 1.0)
 
 
+# Publisher-side weight-source audit (the vtcomm small fix: a torn fold
+# used to degrade to allocated weights SILENTLY). Module-level like the
+# resilience counters: the device-plugin's /metrics handler renders
+# them, tests read them directly.
+FALLBACK_REASONS = ("duty", "allocated", "torn_fold")
+_fallback_total: dict[str, int] = {}
+_measured_total = 0
+
+
+def bump_fallback(reason: str) -> None:
+    _fallback_total[reason] = _fallback_total.get(reason, 0) + 1
+
+
+def fallback_totals() -> dict[str, int]:
+    return dict(_fallback_total)
+
+
+def measured_total() -> int:
+    return _measured_total
+
+
+def reset_fallback_totals() -> None:
+    """Test hook (the resilience-counter pattern)."""
+    global _measured_total
+    _fallback_total.clear()
+    _measured_total = 0
+
+
+def render_fallback_metrics(node: str) -> str:
+    """Prometheus text for the publisher's weight-source audit; empty
+    until a publisher ran (no ICILinkAware publisher = no new series,
+    the gate-off contract)."""
+    if not _fallback_total and not _measured_total:
+        return ""
+    lines = [
+        "# HELP vtpu_linkload_fallback_total Link-load tenant weights "
+        "published from a fallback source (duty = no measured comm "
+        "signal, allocated = no fresh duty either, torn_fold = the "
+        "ledger fold failed and the whole tick degraded to allocated)",
+        "# TYPE vtpu_linkload_fallback_total counter",
+    ]
+    for reason in FALLBACK_REASONS:
+        if reason in _fallback_total:
+            lines.append(
+                f'vtpu_linkload_fallback_total{{node="{node}",'
+                f'reason="{reason}"}} {_fallback_total[reason]}')
+    lines += [
+        "# HELP vtpu_linkload_measured_total Link-load tenant weights "
+        "published from the measured comm signal",
+        "# TYPE vtpu_linkload_measured_total counter",
+        f'vtpu_linkload_measured_total{{node="{node}"}} '
+        f"{_measured_total}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 def compute_link_load(base_dir: str, mesh: MeshSpec, ledger=None,
-                      now: float | None = None) -> NodeLinkLoad:
+                      now: float | None = None, comm: bool = False,
+                      sources: dict | None = None) -> NodeLinkLoad:
     """Fold every resident tenant's communicator box into per-link
     load. Tenant boxes come from the per-container vtpu.config files
     (the devices' mesh coords ARE the box — the same chips the
-    scheduler allocated); weights from the vtuse ledger when fresh,
-    allocated core %% otherwise."""
+    scheduler allocated); weights by the vtcomm precedence rule:
+    measured comm duty (``comm=True`` + a fresh v3 comm signal) ->
+    measured compute duty -> allocated core %%. Every tenant's chosen
+    source is recorded in ``sources`` (tkey -> "measured"/"duty"/
+    "allocated") and the module fallback counters, so a degraded
+    publish is auditable instead of silent."""
     from vtpu_manager.config import tenantdirs
+    global _measured_total
     now = time.time() if now is None else now
     duty: dict[tuple[str, str], tuple[float, int]] = {}
+    comm_sig: dict[tuple[str, str], tuple[float, float]] = {}
     if ledger is not None:
         try:
             ledger.fold()
@@ -200,11 +271,17 @@ def compute_link_load(base_dir: str, mesh: MeshSpec, ledger=None,
                 tot, n = duty.get((s.pod_uid, s.container), (0.0, 0))
                 duty[(s.pod_uid, s.container)] = \
                     (tot + s.used_ewma / 100.0, n + 1)
+            if comm:
+                comm_sig = ledger.comm_signals(now)
         except Exception:  # noqa: BLE001 — the duty feed is advisory;
             # a torn fold degrades this tick to the allocated fallback
+            # — RECORDED (vtpu_linkload_fallback_total{torn_fold}), so
+            # a publisher silently serving allocated weights is visible
             log.warning("ledger fold failed; link load falls back to "
                         "allocated weights", exc_info=True)
             duty = {}
+            comm_sig = {}
+            bump_fallback("torn_fold")
     load: dict = {}
     for pod_uid, label, cfg, _is_dra, _mtime in \
             tenantdirs.iter_container_configs(base_dir):
@@ -215,10 +292,24 @@ def compute_link_load(base_dir: str, mesh: MeshSpec, ledger=None,
             continue            # no internal links, no ICI traffic
         alloc = sum(d.hard_core for d in cfg.devices) \
             / (100.0 * len(cfg.devices))
-        d = duty.get((pod_uid, label))
+        tkey = (pod_uid, label)
+        d = duty.get(tkey)
         duty_frac = (d[0] / d[1]) if d and d[1] else None
+        cs = comm_sig.get(tkey)
+        comm_frac = cs[0] if cs else None
+        if comm_frac is not None:
+            source = "measured"
+            _measured_total += 1
+        elif duty_frac is not None:
+            source = "duty"
+            bump_fallback("duty")
+        else:
+            source = "allocated"
+            bump_fallback("allocated")
+        if sources is not None:
+            sources[tkey] = source
         fold_box_load(load, cells,
-                      tenant_weight(alloc, duty_frac), mesh)
+                      tenant_weight(alloc, duty_frac, comm_frac), mesh)
     return NodeLinkLoad(links=load, ts=now)
 
 
@@ -236,22 +327,33 @@ class LinkLoadPublisher:
 
     def __init__(self, client, node_name: str, mesh: MeshSpec,
                  base_dir: str, ledger=None, policy=None,
-                 interval_s: float = 15.0):
+                 interval_s: float = 15.0, comm: bool = False):
         from vtpu_manager.resilience.policy import RetryPolicy
         self.client = client
         self.node_name = node_name
         self.mesh = mesh
         self.base_dir = base_dir
         self.ledger = ledger
+        # vtcomm (CommTelemetry gate): prefer each tenant's MEASURED
+        # comm link-duty over the compute-duty heuristic. Off keeps the
+        # pre-vtcomm chain byte-for-byte.
+        self.comm = comm
         self.policy = policy or RetryPolicy(max_attempts=3,
                                             deadline_s=10.0)
         self.interval_s = interval_s
+        # weight source of the last publish per tenant (the audit view
+        # tests and the doc surface read): tkey -> measured/duty/
+        # allocated
+        self.last_sources: dict = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def publish_once(self) -> NodeLinkLoad:
+        sources: dict = {}
         ll = compute_link_load(self.base_dir, self.mesh,
-                               ledger=self.ledger)
+                               ledger=self.ledger, comm=self.comm,
+                               sources=sources)
+        self.last_sources = sources
         # chaos: a failed publish must decay the scheduler to
         # no-signal via the annotation's own timestamp — never crash
         # the daemon loop or wedge the other publishers

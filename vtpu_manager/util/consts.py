@@ -416,6 +416,11 @@ ENV_TRACE_SAMPLED = "VTPU_TRACE_SAMPLED"    # "true"/"false"
 ENV_TRACE_DIR = "VTPU_TRACE_DIR"            # tenant spool dir override
 ENV_STEP_TELEMETRY = "VTPU_STEP_TELEMETRY"  # "true": step ring armed
 ENV_STEP_RING_PATH = "VTPU_STEP_RING_PATH"  # tenant-side ring file path
+# "true": vtcomm measured-communication accumulation armed (the shim
+# measures collective/transfer spans + bytes into the v3 comm block and
+# the ICI bucket switches to the measured collective-time currency);
+# rides on top of ENV_STEP_TELEMETRY — the ring is the wire
+ENV_COMM_TELEMETRY = "VTPU_COMM_TELEMETRY"
 ENV_COMPILE_CACHE = "VTPU_COMPILE_CACHE"    # "true": node compile cache armed
 ENV_COMPILE_CACHE_DIR = "VTPU_COMPILE_CACHE_DIR"  # in-container cache dir
 # "true": the vtcs cluster tier armed on top of the node cache — the

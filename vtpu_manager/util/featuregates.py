@@ -34,6 +34,7 @@ DECISION_EXPLAIN = "DecisionExplain"    # vtexplain per-decision audit trail
 QUOTA_MARKET = "QuotaMarket"            # vtqm elastic quota market
 HBM_OVERCOMMIT = "HBMOvercommit"        # vtovc virtual HBM + host-spill tier
 ICI_LINK_AWARE = "ICILinkAware"         # vtici link-contention-aware placement
+COMM_TELEMETRY = "CommTelemetry"        # vtcomm measured communication plane
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -162,6 +163,23 @@ _KNOWN = {
     # its webhook-declared ICI link share with the existing
     # token-bucket machinery.
     ICI_LINK_AWARE: False,
+    # Default off: byte-identical — the v3 step ring's comm block stays
+    # zeroed pad on the wire (no accumulation env injected, the shim's
+    # accumulators never arm), the collector renders no
+    # vtpu_tenant_comm_* series, /utilization carries no comm fields,
+    # the link-load publisher keeps today's duty-weighted fallback
+    # chain byte-for-byte, and the shim's ICI bucket keeps charging the
+    # exec-cost EMA. On, communication becomes a MEASURED quantity:
+    # enforce.cc accumulates actual collective/transfer span time and
+    # bytes moved into the ring's comm block, the vtuse ledger derives
+    # a per-tenant measured comm-intensity (EWMA + confidence,
+    # staleness decays to no-signal), LinkLoadPublisher prefers
+    # measured comm duty over the compute-duty heuristic
+    # (measured -> duty -> allocated, each step audited in
+    # vtpu_linkload_fallback_total), and the ICI token bucket charges
+    # the measured collective-time EMA while fresh — honest currency
+    # on hardware.
+    COMM_TELEMETRY: False,
 }
 
 

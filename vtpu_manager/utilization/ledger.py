@@ -75,6 +75,56 @@ FOLDS_DROPPED = "vtpu_utilization_folds_dropped_total"
 FOLD_SECONDS = "vtpu_utilization_fold_seconds"
 
 
+class _CommStat:
+    """vtcomm per-ring measured-communication EWMA state.
+
+    Built ONLY from records whose comm block is non-zero: a gate-off
+    (or pre-arm) ring writes zeroed pad there, and reading those zeros
+    as "measured zero communication" would flip the link-load
+    publisher's weight chain on nodes where nothing is measured — the
+    gate-off byte-identical contract. No comm bytes on the wire means
+    no signal, never a zero claim."""
+
+    __slots__ = ("duty_ewma", "bytes_per_step_ewma", "collectives_total",
+                 "samples", "last_sample_wall")
+
+    def __init__(self) -> None:
+        self.duty_ewma = 0.0          # comm seconds per wall second
+        self.bytes_per_step_ewma = 0.0
+        self.collectives_total = 0
+        self.samples = 0
+        self.last_sample_wall = 0.0
+
+    def observe(self, duty_frac: float, bytes_per_step: float,
+                collectives: int, now_wall: float) -> None:
+        duty_frac = min(max(duty_frac, 0.0), 1.0)
+        if self.samples == 0:
+            # seed with the first sample (the observe_used rule): a 0
+            # start would understate a steady communicator for the
+            # whole warm-up — the wrong direction for a signal the
+            # scheduler steers contention away from
+            self.duty_ewma = duty_frac
+            self.bytes_per_step_ewma = bytes_per_step
+        else:
+            self.duty_ewma += EWMA_ALPHA * (duty_frac - self.duty_ewma)
+            self.bytes_per_step_ewma += EWMA_ALPHA * (
+                bytes_per_step - self.bytes_per_step_ewma)
+        self.collectives_total += collectives
+        self.samples += 1
+        self.last_sample_wall = now_wall
+
+    def confidence(self, now_wall: float) -> float:
+        """1 fresh -> 0 no-signal, linear over the staleness budget —
+        the _TenantChip rule, so a dead writer's last comm claim decays
+        back to the duty-weighted behavior byte-for-byte."""
+        if not self.samples or not self.last_sample_wall:
+            return 0.0
+        age = now_wall - self.last_sample_wall
+        if age < 0:
+            return 1.0
+        return max(0.0, 1.0 - age / STALENESS_S)
+
+
 class _TenantChip:
     """EWMA state for one (pod_uid, container) x chip partition."""
 
@@ -206,6 +256,12 @@ class UtilizationLedger:
                                tuple[int, int, int, float]] = {}
         self.spill_events_total = 0
         self.fill_events_total = 0
+        # vtcomm: per-ring measured-communication EWMA off the v3 comm
+        # block — the measured comm-intensity feed the link-load
+        # publisher prefers over the compute-duty heuristic
+        self._ring_comm: dict[tuple[str, str], _CommStat] = {}
+        self.comm_bytes_total = 0
+        self.collectives_total = 0
 
     # -- discovery (same dir shapes as the collector's config join) ---------
 
@@ -295,6 +351,7 @@ class UtilizationLedger:
             if tkey not in seen_rings:
                 del self._cursors[tkey]
                 self._ring_spill.pop(tkey, None)
+                self._ring_comm.pop(tkey, None)
 
         tc_util = self._tc_util_by_token()
 
@@ -369,6 +426,28 @@ class UtilizationLedger:
             self.fill_events_total += sum(r.fill_events for r in records)
         window_s = (now_mono - cur.last_poll_monotonic
                     if cur.last_poll_monotonic is not None else 0.0)
+        # vtcomm: a window with ANY non-zero comm block is a measured
+        # communication sample; all-zero comm blocks (gate off, pre-arm
+        # shim, or a ring older than v3's writer) are NO signal — the
+        # publisher must keep its duty-weighted behavior byte-for-byte
+        comm_ns = sum(r.comm_time_ns for r in records)
+        comm_bytes = sum(r.bytes_transferred for r in records)
+        collectives = sum(r.collective_count for r in records)
+        if comm_ns or comm_bytes or collectives:
+            # lifetime totals accumulate UNCONDITIONALLY: the first
+            # fold after a monitor restart has no window (the EWMA
+            # below genuinely needs one) but its ring backlog still
+            # HAPPENED — dropping it would undercount the movement
+            # counters by up to a full ring per restart
+            self.comm_bytes_total += comm_bytes
+            self.collectives_total += collectives
+            if window_s > 0:
+                stat = self._ring_comm.get(tkey)
+                if stat is None:
+                    stat = self._ring_comm[tkey] = _CommStat()
+                stat.observe(comm_ns / 1e9 / window_s,
+                             comm_bytes / len(records), collectives,
+                             now_wall)
         dur_sum = sum(r.duration_ns for r in records) / 1e9
         wait_sum = sum(r.throttle_wait_ns for r in records) / 1e9
         hbm_hw = max((r.hbm_highwater_bytes for r in records), default=0)
@@ -442,6 +521,66 @@ class UtilizationLedger:
             spilled += gauge
         frac = spilling / steps if steps else 0.0
         return min(max(frac, 0.0), 1.0), spilled
+
+    # -- vtcomm measured comm-intensity feed ---------------------------------
+
+    def comm_signals(self, now_wall: float | None = None
+                     ) -> dict[tuple[str, str], tuple[float, float]]:
+        """Per tenant ring, (measured comm link-duty EWMA, confidence)
+        — the link-load publisher's preferred weight source. Only
+        tenants with a live confidence appear: staleness decays a dead
+        comm writer out of the map entirely, so the publisher's
+        fallback chain lands on today's duty-weighted behavior
+        byte-for-byte (the acceptance contract)."""
+        now_wall = time.time() if now_wall is None else now_wall
+        out: dict[tuple[str, str], tuple[float, float]] = {}
+        for tkey, stat in self._ring_comm.items():
+            conf = stat.confidence(now_wall)
+            if conf <= 0.0:
+                continue
+            out[tkey] = (stat.duty_ewma, conf)
+        return out
+
+    def _compute_duty_of(self, tkey: tuple[str, str]) -> float:
+        """The tenant's mean measured compute duty across its chips in
+        [0,1] — the denominator of the measured comm-intensity figure
+        (comm duty per unit compute duty, the bench's modeled-constant
+        replacement)."""
+        vals = [s.used_ewma / 100.0 for s in self._states.values()
+                if (s.pod_uid, s.container) == tkey and s.samples]
+        return sum(vals) / len(vals) if vals else 0.0
+
+    def comm_rows(self, now_wall: float | None = None) -> list[dict]:
+        """Per-tenant measured-communication rows for /utilization and
+        vtpu-smi (CommTelemetry documents only)."""
+        now_wall = time.time() if now_wall is None else now_wall
+        rows = []
+        for tkey in sorted(self._ring_comm):
+            stat = self._ring_comm[tkey]
+            conf = stat.confidence(now_wall)
+            compute_duty = self._compute_duty_of(tkey)
+            rows.append({
+                "pod_uid": tkey[0],
+                "container": tkey[1],
+                # wall-denominated on purpose (comm seconds per wall
+                # second — the link-occupancy figure the publisher
+                # weighs); the STEP-denominated figure is
+                # comm_time_frac in the vtrace splice and the
+                # vtpu_tenant_comm_time_fraction gauge — distinct
+                # names, distinct denominators
+                "comm_duty_frac": round(stat.duty_ewma, 4),
+                "comm_bytes_per_step": int(stat.bytes_per_step_ewma),
+                "collectives_total": stat.collectives_total,
+                # measured comm-intensity: link duty per unit compute
+                # duty — the honest replacement for bench_ici's modeled
+                # 1.6x constant (None until compute duty is measured)
+                "comm_intensity": round(
+                    stat.duty_ewma / compute_duty, 3)
+                    if compute_duty > 0 else None,
+                "confidence": round(conf, 3),
+                "stale": conf <= 0.0,
+            })
+        return rows
 
     def class_mix(self) -> dict[str, int]:
         """Distinct resident CLASSIFIED tenants per workload-class key

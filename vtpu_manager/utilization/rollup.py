@@ -43,7 +43,8 @@ class ClusterRollup:
                  fold_budget_s: float | None = None,
                  quota_dir: str | None = None,
                  overcommit: bool = False,
-                 cluster_cache: bool = False):
+                 cluster_cache: bool = False,
+                 comm: bool = False):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
@@ -58,6 +59,10 @@ class ClusterRollup:
         # vtcs (ClusterCompileCache gate): False = the document carries
         # no warm-keys fields at all — byte-identical /utilization
         self.cluster_cache = cluster_cache
+        # vtcomm (CommTelemetry gate): False = the document carries no
+        # comm fields at all — byte-identical /utilization (the vtqm
+        # pattern)
+        self.comm = comm
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -295,6 +300,45 @@ class ClusterRollup:
                 row["borrowed_core_pct"] = delta
             elif delta < 0:
                 row["lent_core_pct"] = -delta
+        # vtcomm-PR quota satellite (ROADMAP quota item (d), the
+        # observe-only evidence leg): per active lease, did the
+        # borrower USE what it borrowed? The borrower's measured
+        # used%% comes from the vtuse ledger's apportioning rule (ring
+        # busy fraction split by allocated-core share — the same
+        # figure the tenant rows carry), so the borrowed-vs-used
+        # verdict is re-derivable from any recorded /utilization
+        # document: used_of_borrowed = clamp(used - base_alloc, 0,
+        # pct). vtpu_replay.py --utilization-file replays exactly that
+        # equation over a saved document.
+        by_row = {}
+        for row in tenant_rows:
+            key = (row.get("pod_uid", ""),
+                   str(row.get("container", "")).split("/", 1)[0],
+                   row.get("chip_index"))
+            by_row[key] = row
+        borrowed_used = []
+        for lease in active:
+            uid, _, label = str(lease.get("borrower", "")).partition("/")
+            key = (uid, label.split("/", 1)[0], lease.get("chip"))
+            row = by_row.get(key)
+            pct = int(lease.get("pct", 0))
+            used = row.get("used_core_pct") if row else None
+            base = row.get("allocated_core_pct") if row else None
+            used_of_borrowed = None
+            if used is not None and base is not None and pct > 0:
+                used_of_borrowed = round(
+                    min(max(float(used) - float(base), 0.0),
+                        float(pct)), 2)
+            borrowed_used.append({
+                "id": lease.get("id"),
+                "chip": lease.get("chip"),
+                "borrower": lease.get("borrower"),
+                "pct": pct,
+                "used_of_borrowed_pct": used_of_borrowed,
+                "utilization": round(used_of_borrowed / pct, 3)
+                    if used_of_borrowed is not None and pct else None,
+                "live": used is not None,
+            })
         return {
             "leases_active": len(active),
             "lent_core_pct_total": sum(int(l.get("pct", 0))
@@ -304,6 +348,7 @@ class ClusterRollup:
                         ("id", "chip", "lender", "borrower", "pct",
                          "granted_at", "ttl_s", "state")}
                        for l in leases[-64:]],
+            "borrowed_used": borrowed_used,
         }
 
     def _local_spilled_by_chip(self) -> "dict[int, int] | None":
@@ -363,13 +408,15 @@ class ClusterRollup:
         # vtpu-smi treat both alike — cluster rows take precedence
         present = {(t["pod_uid"], t["container"], t["chip_index"])
                    for t in tenant_rows}
-        for t in self.ledger.to_wire(now)["tenants"]:
+        local = self.ledger.to_wire(now)   # ONE wire derivation per
+        # request: the merge below and the document's node block must
+        # agree anyway, and the per-tenant row assembly is not free
+        for t in local["tenants"]:
             key = (t["pod_uid"], t["container"].split("/", 1)[0],
                    t["chip_index"])
             if key not in present:
                 tenant_rows.append(
                     dict(t, node=self.ledger.node_name, live=True))
-        local = self.ledger.to_wire(now)
         local["compile_cache"] = self._compile_cache_state()
         if self.overcommit:
             # vtovc local truth (gate on only): ring-reported spill
@@ -385,6 +432,33 @@ class ClusterRollup:
                 "spill_events_total": self.ledger.spill_events_total,
                 "fill_events_total": self.ledger.fill_events_total,
             }
+        if self.comm:
+            # vtcomm local truth (gate on only — off keeps the document
+            # byte-identical): measured per-tenant communication rows
+            # plus lifetime movement counters, and the comm columns
+            # spliced onto this node's live tenant rows (per base
+            # container — the ring is per tenant, not per chip)
+            comm_rows = self.ledger.comm_rows(now)
+            local["comm"] = {
+                "tenants": comm_rows,
+                "comm_bytes_total": self.ledger.comm_bytes_total,
+                "collectives_total": self.ledger.collectives_total,
+            }
+            # staleness ladder: a dead comm writer's last EWMA must
+            # never splice onto a live row as a current measurement —
+            # decayed tenants keep their (stale-flagged) entry in the
+            # comm block above but lose the COMM columns, the same
+            # decay comm_signals() applies for the publisher
+            by_tenant = {(c["pod_uid"],
+                          c["container"].split("/", 1)[0]): c
+                         for c in comm_rows if not c["stale"]}
+            for row in tenant_rows:
+                c = by_tenant.get(
+                    (row.get("pod_uid", ""),
+                     str(row.get("container", "")).split("/", 1)[0]))
+                if c is not None and row.get("live"):
+                    row["comm_duty_frac"] = c["comm_duty_frac"]
+                    row["comm_intensity"] = c["comm_intensity"]
         quota = self._fold_quota_leases(tenant_rows, node_rows, now)
         live_nodes = [r for r in node_rows
                       if r["reclaim_core_pct"] is not None]
@@ -405,6 +479,43 @@ class ClusterRollup:
         }
         if quota is not None:
             doc["quota"] = quota
+        if self.overcommit:
+            # vtcomm-PR vtovc satellite (ROADMAP vtovc item (a)): the
+            # fleet-level overcommit policy view — which classes
+            # oversubscribe where (per-class ratio spread across the
+            # publishing nodes) plus the fleet spill-rate headline —
+            # folded from the SAME node annotations the per-node rows
+            # decode. Gate off = no key at all (byte-identical).
+            per_class: dict[str, list] = {}
+            spill_fracs = []
+            spilled_sum = 0
+            publishing = 0
+            for nrow in node_rows:
+                ratios = nrow.get("overcommit_ratios")
+                if ratios is None:
+                    continue
+                publishing += 1
+                for cls, ratio in ratios.items():
+                    per_class.setdefault(cls, []).append(float(ratio))
+                if nrow.get("spill_frac") is not None:
+                    spill_fracs.append(float(nrow["spill_frac"]))
+                spilled_sum += int(nrow.get("spilled_bytes") or 0)
+            doc["overcommit"] = {
+                "nodes_publishing": publishing,
+                "classes": {
+                    cls: {
+                        "nodes": len(vals),
+                        "min_ratio": round(min(vals), 3),
+                        "max_ratio": round(max(vals), 3),
+                        "mean_ratio": round(sum(vals) / len(vals), 3),
+                    } for cls, vals in sorted(per_class.items())},
+                "fleet_spill_frac_mean": round(
+                    sum(spill_fracs) / len(spill_fracs), 4)
+                    if spill_fracs else 0.0,
+                "fleet_spill_frac_max": round(max(spill_fracs), 4)
+                    if spill_fracs else 0.0,
+                "fleet_spilled_bytes": spilled_sum,
+            }
         return doc
 
 
