@@ -130,6 +130,14 @@ test-comm: ## vtcomm suite: v3 comm-block ledger fold, publisher preference chai
 bench-comm: ## vtcomm headline bench: measured comm-intensity MAE vs ground truth beats the duty chain and the 1.6x model, measured-fed steering both scheduler modes (asserted; writes BENCH_VTCOMM_r14.json)
 	python scripts/bench_comm.py
 
+.PHONY: test-slo
+test-slo: ## vtslo suite: attribution arithmetic, ring v4 roundtrip/skip, detector+cause matrix, history spools, stalecodec consolidation, gate-off contracts, /slo + --why-slow e2e, grant-step feedback
+	$(PYTEST) tests/test_slo.py -q
+
+.PHONY: bench-slo
+bench-slo: ## vtslo headline bench: four injected causes (quota revoke, spill thrash, ICI contention, cold compile) each attributed to its plane with zero cross-attribution (asserted; writes BENCH_VTSLO_r15.json)
+	python scripts/bench_slo.py
+
 .PHONY: test-overcommit
 test-overcommit: ## vtovc suite: ratio codec + policy percentiles, virtual admission parity both modes, spill pool chaos (torn copy / budget / crashed-spiller reap), gate-off byte-contracts
 	$(PYTEST) tests/test_overcommit.py -q
@@ -139,7 +147,7 @@ bench-overcommit: ## vtovc headline bench: pods-per-chip density gate off/on (>=
 	python scripts/bench_overcommit.py
 
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm bench-overcommit bench-clustercache bench-ici bench-comm ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo bench-overcommit bench-clustercache bench-ici bench-comm bench-slo ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

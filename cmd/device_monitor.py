@@ -49,8 +49,12 @@ def main(argv: list[str] | None = None) -> int:
                              "cluster view; DecisionExplain=true arms "
                              "the vtexplain /explain fan-in (decision "
                              "audit + pending-pod doctor) over the "
-                             "node's explain spools (default off = no "
-                             "new series, no routes)")
+                             "node's explain spools; SLOAttribution="
+                             "true arms the vtslo goodput/attribution "
+                             "plane: vtpu_tenant_goodput_*/vtpu_slo_* "
+                             "series and the /slo doctor route "
+                             "(default off = no new series, no "
+                             "routes)")
     parser.add_argument("--explain-dir", default=consts.EXPLAIN_DIR,
                         help="vtexplain decision spool dir served by "
                              "/explain behind the DecisionExplain gate "
@@ -82,6 +86,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 DECISION_EXPLAIN,
                                                 HBM_OVERCOMMIT,
                                                 QUOTA_MARKET,
+                                                SLO_ATTRIBUTION,
                                                 UTILIZATION_LEDGER,
                                                 FeatureGates)
 
@@ -97,6 +102,7 @@ def main(argv: list[str] | None = None) -> int:
     overcommit_on = gates.enabled(HBM_OVERCOMMIT)
     cluster_cache_on = gates.enabled(CLUSTER_COMPILE_CACHE)
     comm_on = gates.enabled(COMM_TELEMETRY)
+    slo_on = gates.enabled(SLO_ATTRIBUTION)
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
@@ -111,7 +117,11 @@ def main(argv: list[str] | None = None) -> int:
         # vtovc: the vtpu_node_spill_* series (gate off = none)
         overcommit_enabled=overcommit_on,
         # vtcomm: the vtpu_tenant_comm_* series (gate off = none)
-        comm_enabled=comm_on)
+        comm_enabled=comm_on,
+        # vtslo: goodput/overhead/regression series + the /slo ledger
+        # (gate off = no ledger object, no series, no spools)
+        slo_enabled=slo_on,
+        quota_dir=args.base_dir if quota_on else None)
 
     # one registry-channel client shared by the vtuse /utilization and
     # vtexplain /explain fan-ins; no client degrades both to the
@@ -155,7 +165,10 @@ def main(argv: list[str] | None = None) -> int:
             # vtcomm: measured per-tenant comm rows (time fraction,
             # bytes/step, intensity) fold in only when the comm gate is
             # on (off = byte-identical document, the vtqm pattern)
-            comm=comm_on)
+            comm=comm_on,
+            # vtslo: goodput columns + the fleet SLO block fold in only
+            # when the slo gate is on (off = byte-identical document)
+            slo_ledger=collector.slo_ledger)
 
     import hmac
 
@@ -288,6 +301,36 @@ def main(argv: list[str] | None = None) -> int:
                 {"error": f"explain rollup failed: {e}"}, status=503)
         return web.json_response(doc, status=status)
 
+    async def slo_route(request):
+        # vtslo: the attribution plane's document — per-tenant goodput,
+        # component splits, and attributed regression verdicts; ?pod=
+        # cuts it to one pod's doctor verdict. Same bearer auth as
+        # /metrics; the ring fold runs in an executor thread and every
+        # failure (including a wedged fold) answers HERE with 503,
+        # never on the /metrics path (the vtexplain rollup pattern).
+        if not authorized(request):
+            return web.json_response({"error": "unauthorized"},
+                                     status=401)
+        import asyncio
+
+        from vtpu_manager.slo import doctor as slo_doctor
+        pod = request.query.get("pod", "")
+
+        def collect():
+            collector.slo_ledger.fold()
+            doc = collector.slo_ledger.document()
+            if pod:
+                return slo_doctor.why_slow_from_document(doc, pod)
+            return 200, doc
+        try:
+            status, doc = await asyncio.get_running_loop() \
+                .run_in_executor(None, collect)
+        except Exception as e:  # noqa: BLE001 — a wedged attribution
+            # plane serves an explicit error, never a hang
+            return web.json_response(
+                {"error": f"slo rollup failed: {e}"}, status=503)
+        return web.json_response(doc, status=status)
+
     async def cache_entry(request):
         # vtcs peer-serving route (ClusterCompileCache gate; off = no
         # route at all, matching "zero fetch I/O"): raw checksummed
@@ -328,6 +371,9 @@ def main(argv: list[str] | None = None) -> int:
         # same gate-off contract as /utilization: no route, not an
         # empty document
         app.router.add_get("/explain", explain_route)
+    if slo_on:
+        # same gate-off contract: no /slo route at all (404)
+        app.router.add_get("/slo", slo_route)
     if cluster_cache_on:
         # same gate-off contract: no /cache/entry route, so a node not
         # running the cluster tier can never be fetched from

@@ -248,6 +248,34 @@ inline uint64_t VmemStaleReapNsFromEnv(const char* v) {
   return (uint64_t)(s * 1e9);
 }
 
+// vtovc Execute-output shape capture (vtovc item (b)) — the SHARED
+// spill-recipe rule with Python's overcommit.spill mirror, header-
+// inline so enforce.cc and the g++-probe parity row compile the SAME
+// functions. A captured (dims, element-type) pair is only a safe
+// re-materialization recipe when the LOGICAL size it implies equals
+// the buffer's on-device size: a padded/tiled layout spilled as a
+// flat host copy would refill into a differently-sized buffer, and a
+// zero-element or overflowing shape is no recipe at all.
+inline int64_t SpillLogicalBytes(const int64_t* dims, size_t num_dims,
+                                 int64_t elem_bytes) {
+  if (elem_bytes <= 0) return 0;
+  const int64_t kCap = 9000000000000000000LL;  // overflow guard
+  int64_t elems = 1;
+  for (size_t i = 0; i < num_dims; i++) {
+    int64_t d = dims ? dims[i] : 0;
+    if (d <= 0) return 0;          // zero/negative dim: no recipe
+    if (elems > kCap / d) return 0;
+    elems *= d;
+  }
+  if (elems > kCap / elem_bytes) return 0;
+  return elems * elem_bytes;
+}
+
+inline bool SpillShapeCaptureOk(int64_t logical_bytes,
+                                int64_t on_device_bytes) {
+  return logical_bytes > 0 && logical_bytes == on_device_bytes;
+}
+
 // ---------------------------------------------------------------------------
 // pids.config (CLIENT compat mode: registry-attested container pid set)
 // ---------------------------------------------------------------------------

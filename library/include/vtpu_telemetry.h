@@ -41,7 +41,11 @@ constexpr uint32_t kStepRingMagic = 0x54535456;  // "VTST"
 // collective_count — the measured-communication channel feeding the
 // vtuse comm-intensity ledger and the honest ICI-bucket currency.
 // CommTelemetry off writes zeros in all three.
-constexpr uint32_t kStepRingVersion = 3;
+// v4 (vtslo): spill_fill_time_ns — measured wall time inside the
+// host-tier demotion/promotion paths (TrySpillCold + FillSpilled), so
+// the SLO attribution plane's spill-fill component is measured like
+// the comm spans. An unarmed spill tier writes zero.
+constexpr uint32_t kStepRingVersion = 4;
 constexpr int kStepRingCapacity = 256;
 constexpr int kStepTraceIdLen = 48;
 
@@ -98,8 +102,10 @@ struct StepRecord {
   uint64_t bytes_transferred;  // bytes observed moving since last record
   uint32_t collective_count;   // multi-chip dispatches since last record
   uint32_t pad2_;
+  // v4 (vtslo; zero when the spill tier never measured a span)
+  uint64_t spill_fill_time_ns;  // host-tier spill+fill span time
 };
-static_assert(sizeof(StepRecord) == 96, "StepRecord ABI size");
+static_assert(sizeof(StepRecord) == 104, "StepRecord ABI size");
 static_assert(offsetof(StepRecord, index) == 8, "ABI");
 static_assert(offsetof(StepRecord, duration_ns) == 24, "ABI");
 static_assert(offsetof(StepRecord, throttle_wait_ns) == 32, "ABI");
@@ -111,6 +117,7 @@ static_assert(offsetof(StepRecord, fill_events) == 68, "ABI");
 static_assert(offsetof(StepRecord, comm_time_ns) == 72, "ABI");
 static_assert(offsetof(StepRecord, bytes_transferred) == 80, "ABI");
 static_assert(offsetof(StepRecord, collective_count) == 88, "ABI");
+static_assert(offsetof(StepRecord, spill_fill_time_ns) == 96, "ABI");
 
 constexpr size_t kStepRingFileSize =
     sizeof(StepRingHeader) + kStepRingCapacity * sizeof(StepRecord);
@@ -209,7 +216,8 @@ class StepRingWriter {
               uint64_t start_mono_ns = 0, uint64_t spilled_bytes = 0,
               uint32_t spill_events = 0, uint32_t fill_events = 0,
               uint64_t comm_time_ns = 0, uint64_t bytes_transferred = 0,
-              uint32_t collective_count = 0) {
+              uint32_t collective_count = 0,
+              uint64_t spill_fill_time_ns = 0) {
     if (!mm_) return;
     if (start_mono_ns == 0) {
       struct timespec ts;
@@ -239,6 +247,7 @@ class StepRingWriter {
     rec->bytes_transferred = bytes_transferred;
     rec->collective_count = collective_count;
     rec->pad2_ = 0;
+    rec->spill_fill_time_ns = spill_fill_time_ns;
     __atomic_store_n(&rec->seq, wseq + 1, __ATOMIC_RELEASE);  // even
     writes_ = index + 1;
     __atomic_store_n(&Header()->writes, writes_, __ATOMIC_RELEASE);

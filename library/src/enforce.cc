@@ -719,6 +719,25 @@ bool SpillTierArmed() {
 std::atomic<uint32_t> g_spill_events_window{0};
 std::atomic<uint32_t> g_fill_events_window{0};
 
+// vtslo v4: measured wall time spent inside the host-tier demotion
+// (TrySpillCold) and promotion (FillSpilled) paths — the spill-fill
+// component of the SLO attribution plane, accumulated per record like
+// the comm spans (window exchanged per record, total exported for the
+// Python-owned ring via vtpu_spill_fill_ns_total).
+std::atomic<uint64_t> g_spill_fill_window_ns{0};
+std::atomic<uint64_t> g_spill_fill_ns_total{0};
+
+void AccumulateSpillFill(uint64_t span_ns) {
+  if (!span_ns) return;
+  g_spill_fill_window_ns.fetch_add(span_ns, std::memory_order_relaxed);
+  g_spill_fill_ns_total.fetch_add(span_ns, std::memory_order_relaxed);
+}
+
+// a promotion may cascade into further demotions (FillSpilled ->
+// ReserveMemory -> TrySpillCold); only the OUTERMOST span accumulates
+// or the cascade's wall time would count twice
+thread_local int g_spill_fill_depth = 0;
+
 // ---------------------------------------------------------------------------
 // vtcomm measured-communication accumulators. Window counters feed the
 // shim's own step-ring records (exchanged to 0 per record); the
@@ -856,6 +875,43 @@ void TrackBuffer(PJRT_Buffer* buf, int slot, int64_t bytes,
   }
   RecordOwnBytes(slot);
   g_metrics.mem_charged.Bump();
+}
+
+// vtovc item (b): Execute OUTPUTS become spill candidates too. An
+// activation-heavy tenant's working set is made of execution outputs,
+// not host uploads — before this, only BufferFromHostBuffer /
+// CreateUninitializedBuffer shapes were observed, so such tenants had
+// NO demotion victims and the spill arm failed them straight to the
+// pre-v4 rejection. The shape is queried from the buffer itself
+// (Buffer_Dimensions + Buffer_ElementType) and trusted only when the
+// logical size matches the on-device size (SpillShapeCaptureOk, the
+// header-shared rule): a padded/tiled layout cannot be re-materialized
+// from a flat host copy. Queried only when the spill tier is armed —
+// two extra PJRT calls per output buy nothing on an unarmed node.
+void TrackExecOutput(PJRT_Buffer* buf, int slot, int64_t bytes) {
+  ShimState& s = State();
+  if (SpillTierArmed() && s.real_api->PJRT_Buffer_Dimensions &&
+      s.real_api->PJRT_Buffer_ElementType) {
+    PJRT_Buffer_Dimensions_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    dargs.buffer = buf;
+    PJRT_Buffer_ElementType_Args targs;
+    memset(&targs, 0, sizeof(targs));
+    targs.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    targs.buffer = buf;
+    if (!ConsumeError(s.real_api->PJRT_Buffer_Dimensions(&dargs)) &&
+        !ConsumeError(s.real_api->PJRT_Buffer_ElementType(&targs))) {
+      int64_t logical = SpillLogicalBytes(dargs.dims, dargs.num_dims,
+                                          ElementBytes(targs.type));
+      if (SpillShapeCaptureOk(logical, bytes)) {
+        TrackBuffer(buf, slot, bytes, dargs.dims, dargs.num_dims,
+                    targs.type);
+        return;
+      }
+    }
+  }
+  TrackBuffer(buf, slot, bytes);
 }
 
 PJRT_Error* WrappedBufferFromHostBuffer(
@@ -1988,7 +2044,7 @@ bool SpillOne(PJRT_Buffer* buf, const ShimState::BufRec& rec) {
 // The ReserveMemory spill arm. The caller holds the device lock, so
 // concurrent reserves cannot double-spend the HBM this frees; the vmem
 // lock is only taken inside RecordOwnBytes.
-bool TrySpillCold(int slot, int64_t need) {
+bool TrySpillColdLocked(int slot, int64_t need) {
   const VtpuDevice* cfg = DeviceCfg(slot);
   ShimState& s = State();
   if (!cfg || need <= 0) return false;
@@ -2054,11 +2110,36 @@ bool TrySpillCold(int slot, int64_t need) {
   return true;
 }
 
+// vtslo v4: the measured spill-fill component — the demotion wrapper
+// times the whole arm (it only runs when the spill path engages), the
+// promotion wrapper charges only calls that found spill state (the
+// common not-spilled lookup must not read as host-tier time).
+bool TrySpillCold(int slot, int64_t need) {
+  uint64_t t0 = NowNs();
+  g_spill_fill_depth++;
+  bool ok = TrySpillColdLocked(slot, need);
+  if (--g_spill_fill_depth == 0) AccumulateSpillFill(NowNs() - t0);
+  return ok;
+}
+
+PJRT_Error* FillSpilledInner(PJRT_Buffer* buf, PJRT_Buffer** out);
+
 // promote one demoted buffer back to HBM. Returns the replacement, or
 // nullptr with *err set when HBM could not be made (the caller fails
 // its operation with that error); nullptr with *err unset means `buf`
 // was not spilled at all.
 PJRT_Error* FillSpilled(PJRT_Buffer* buf, PJRT_Buffer** out) {
+  uint64_t t0 = NowNs();
+  g_spill_fill_depth++;
+  PJRT_Error* err = FillSpilledInner(buf, out);
+  bool outermost = --g_spill_fill_depth == 0;
+  // err set or a replacement produced <=> the handle really held spill
+  // state and the step paid host-tier work for it
+  if (outermost && (err || *out)) AccumulateSpillFill(NowNs() - t0);
+  return err;
+}
+
+PJRT_Error* FillSpilledInner(PJRT_Buffer* buf, PJRT_Buffer** out) {
   ShimState& s = State();
   *out = nullptr;
   ShimState::SpillRec rec;
@@ -2270,6 +2351,13 @@ extern "C" uint64_t vtpu_collectives_total() {
   return g_collectives_total.load(std::memory_order_relaxed);
 }
 
+// vtslo v4: cumulative measured host-tier spill+fill wall time, for the
+// Python-owned ring (the throttle-wait/comm pattern — the Python step
+// loop cannot see the host-tier work hiding inside its jitted call).
+extern "C" uint64_t vtpu_spill_fill_ns_total() {
+  return g_spill_fill_ns_total.load(std::memory_order_relaxed);
+}
+
 // vttel/vtuse: the Execute hook's step-ring writer, so non-Python
 // tenants (anything driving PJRT through this shim without the Python
 // runtime client) appear in the utilization ledger too. Armed lazily on
@@ -2333,6 +2421,11 @@ void RecordStepRing(int slot, uint64_t start_ns, uint64_t end_ns,
                       g_comm_bytes_window.exchange(
                           0, std::memory_order_relaxed),
                       g_collectives_window.exchange(
+                          0, std::memory_order_relaxed),
+                      // vtslo v4: measured host-tier spill+fill time
+                      // since the previous record (zero when the spill
+                      // tier never engaged)
+                      g_spill_fill_window_ns.exchange(
                           0, std::memory_order_relaxed));
 }
 
@@ -2975,7 +3068,10 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
         if (ConsumeError(s.real_api->PJRT_Buffer_OnDeviceSizeInBytes(&bargs)))
           continue;
         int64_t bytes = (int64_t)bargs.on_device_size_in_bytes;
-        TrackBuffer(buf, slot, bytes);
+        // vtovc item (b): capture the output's shape so activation-
+        // heavy tenants have spill victims (shape-verified; plain
+        // tracking when the tier is unarmed or the shape is unsafe)
+        TrackExecOutput(buf, slot, bytes);
         tracked += bytes;
       }
     }

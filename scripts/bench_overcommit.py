@@ -308,6 +308,56 @@ def simulate_steps(n_tenants_per_chip, tag, results):
     return results[tag]
 
 
+def activation_capture():
+    """vtovc item (b): an ACTIVATION-heavy tenant — working set made of
+    Execute outputs, zero host uploads — must now have spill victims.
+    Pre-capture, only BufferFromHostBuffer/CreateUninitializedBuffer
+    shapes were observed, so such a tenant had no candidates and the
+    spill arm failed straight to rejection; with Execute-output shape
+    capture, outputs whose logical size matches the on-device size
+    (spill_shape_capture_ok — the g++-probe-asserted shared rule)
+    are candidates, and the overflow actually demotes through the real
+    SpillPool."""
+    from vtpu_manager.overcommit.spill import (spill_logical_bytes,
+                                               spill_shape_capture_ok)
+    tmp = tempfile.mkdtemp(prefix="vtovc-activation-")
+    ledger = vmem.VmemLedger(os.path.join(tmp, "vmem.config"),
+                             create=True)
+    pool = SpillPool(os.path.join(tmp, "spill"),
+                     budget_bytes=SPILL_BUDGET_MIB, ledger=ledger,
+                     owner_token=4242, pid=os.getpid())
+    # eight activation outputs (fp32, clean layouts) + one padded
+    # layout the capture rule must REFUSE (logical != on-device)
+    outputs = []
+    for j in range(8):
+        dims = [64, 4 * (j + 1)]
+        logical = spill_logical_bytes(dims, 4)
+        outputs.append((f"act{j}", dims, logical, logical))
+    outputs.append(("padded", [64, 4], spill_logical_bytes([64, 4], 4),
+                    2 * spill_logical_bytes([64, 4], 4)))
+    # the PRE-capture rule never observed an output's shape, so its
+    # logical size is unknown (0) — run the SAME shared predicate over
+    # that state instead of asserting a constant
+    old_rule_candidates = sum(
+        1 for _name, _dims, _logical, on_dev in outputs
+        if spill_shape_capture_ok(0, on_dev))
+    candidates = [(name, dims) for name, dims, logical, on_dev
+                  in outputs if spill_shape_capture_ok(logical, on_dev)]
+    spilled = 0
+    for name, _dims in candidates[:4]:      # overflow worth 4 buffers
+        pool.spill(0, name, b"\0")
+        spilled += 1
+    ledger.close()
+    return {
+        "outputs": len(outputs),
+        "candidates_old_rule": old_rule_candidates,
+        "candidates_new_rule": len(candidates),
+        "padded_refused": not any(n == "padded"
+                                  for n, _ in candidates),
+        "spill_events": spilled,
+    }
+
+
 def thrash_backoff():
     """Gate on, node-a publishing a live spill-rate: placements must
     steer to the quiet node."""
@@ -355,6 +405,7 @@ def main(argv=None) -> int:
     p99_on = results["steps_on"]["p99_ms"]
 
     placements = thrash_backoff()
+    activation = activation_capture()
 
     doc = {
         "bench": "overcommit",
@@ -381,6 +432,7 @@ def main(argv=None) -> int:
             "p99_regression_x": round(p99_on / p99_off, 3),
         },
         "thrash_backoff": placements,
+        "activation_capture": activation,
         "asserts": {
             "density_uplift_x": round(density_x, 2),
             "density_uplift_min": DENSITY_MIN,
@@ -399,6 +451,13 @@ def main(argv=None) -> int:
         f"p99 {p99_on}ms > {P99_REGRESSION_BOUND}x baseline {p99_off}ms"
     assert placements["node-quiet"] >= 6, \
         f"thrash backoff did not steer placement: {placements}"
+    # vtovc item (b): activation-heavy tenants now spill — outputs
+    # gained candidates under the shape-verified capture rule (and the
+    # padded layout stayed refused)
+    assert activation["candidates_old_rule"] == 0
+    assert activation["candidates_new_rule"] >= 8, activation
+    assert activation["padded_refused"], activation
+    assert activation["spill_events"] > 0, activation
 
     out_path = os.path.join(REPO, "BENCH_VTOVC_r11.json")
     with open(out_path, "w") as f:

@@ -1,0 +1,213 @@
+#!/usr/bin/env python3
+"""vtslo attribution bench: four injected causes, four correct verdicts.
+
+The plane's headline claim is **attribution**, so the bench injects four
+known root causes into synthetic tenant workloads — each through the
+exact channel the real plane would see it on — and asserts the detector
+names the responsible plane for every one, with ZERO cross-attribution
+(no tenant earns a verdict of another tenant's cause, and a steady
+control tenant earns none at all):
+
+1. **quota revoke** (vtqm): a borrower's throttle-wait jumps mid-stream
+   AND the node's lease ledger records the revoke — the verdict must be
+   ``throttle-spike`` and its cause join must name the lease;
+2. **spill thrash** (vtovc): the v4 ``spill_fill_time_ns`` field plus
+   spill/fill event counts rise — ``spill-thrash``;
+3. **ICI contention** (vtici/vtcomm): measured collective time inflates
+   at constant collective count — ``comm-inflation``;
+4. **cold compile** (vtcc): FLAG_COMPILE steps with compile-dominated
+   durations appear (a cache-miss storm) — ``compile-storm``.
+
+Everything flows through the REAL machinery: StepRingWriter (v4 wire),
+the attribution arithmetic, the history fold, the detectors, and the
+doctor. A fifth steady tenant is the false-positive control. Writes
+BENCH_VTSLO_r15.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from vtpu_manager.quota.ledger import QuotaLeaseLedger     # noqa: E402
+from vtpu_manager.slo import doctor as slo_doctor          # noqa: E402
+from vtpu_manager.slo import slo_stats_for_pod             # noqa: E402
+from vtpu_manager.telemetry import stepring                # noqa: E402
+
+STEADY_STEPS = 96          # 6 detector windows of baseline
+REGRESSED_STEPS = 64       # 4 windows of the injected cause
+BASE_STEP_NS = 10_000_000  # 10 ms steady step
+
+
+def _write_ring(base: str, uid: str, records: list[dict]) -> None:
+    entry = os.path.join(base, f"{uid}_main")
+    os.makedirs(os.path.join(entry, "telemetry"), exist_ok=True)
+    w = stepring.StepRingWriter(
+        os.path.join(entry, "telemetry", "step_telemetry.ring"),
+        trace_id=f"tr-{uid}")
+    for kw in records:
+        w.record(**kw)
+    w.close()
+
+
+def build_workloads(base: str, now: float) -> dict[str, str]:
+    """Inject the four causes (+ the steady control); returns
+    uid -> expected verdict kind ("" = none)."""
+    steady = [dict(duration_ns=BASE_STEP_NS,
+                   throttle_wait_ns=200_000)] * STEADY_STEPS
+
+    # 1. quota revoke: the throttle plane's measured wait jumps, and
+    # the ledger carries the revoke event the cause join must find
+    _write_ring(base, "uid-quota", steady + [
+        dict(duration_ns=18_000_000,
+             throttle_wait_ns=8_600_000)] * REGRESSED_STEPS)
+    ledger = QuotaLeaseLedger(base, clock=lambda: now)
+    lease, _ = ledger.grant(0, "uid-lender/main", "uid-quota/main",
+                            20, 30.0, now - 120.0)
+    ledger.settle([lease["id"]], "revoked", now - 30.0)
+
+    # 2. spill thrash: the v4 measured spill-fill time + event counts
+    _write_ring(base, "uid-spill", steady + [
+        dict(duration_ns=16_500_000, spill_fill_time_ns=6_700_000,
+             spill_events=3, fill_events=2,
+             spilled_bytes=64 << 20)] * REGRESSED_STEPS)
+
+    # 3. ICI contention: measured collective spans inflate at constant
+    # collective count (the link got crowded, not the program chattier)
+    comm_steady = [dict(duration_ns=BASE_STEP_NS,
+                        comm_time_ns=1_200_000, collective_count=1,
+                        bytes_transferred=4 << 20)] * STEADY_STEPS
+    _write_ring(base, "uid-ici", comm_steady + [
+        dict(duration_ns=15_500_000, comm_time_ns=6_800_000,
+             collective_count=1,
+             bytes_transferred=4 << 20)] * REGRESSED_STEPS)
+
+    # 4. cold compile: FLAG_COMPILE steps dominate (cache-miss storm),
+    # then the stream settles back to steady
+    _write_ring(base, "uid-compile", steady + [
+        dict(duration_ns=45_000_000, compiled=True)] * 20 + [
+        dict(duration_ns=BASE_STEP_NS)] * (REGRESSED_STEPS - 20))
+
+    # 5. steady control: must earn NO verdict
+    _write_ring(base, "uid-steady", [
+        dict(duration_ns=BASE_STEP_NS,
+             throttle_wait_ns=150_000)] * (STEADY_STEPS
+                                           + REGRESSED_STEPS))
+
+    return {"uid-quota": "throttle-spike",
+            "uid-spill": "spill-thrash",
+            "uid-ici": "comm-inflation",
+            "uid-compile": "compile-storm",
+            "uid-steady": ""}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    t0 = time.perf_counter()
+    now = time.time()
+
+    base = tempfile.mkdtemp(prefix="vtslo-bench-")
+    expected = build_workloads(base, now)
+
+    per_tenant = {}
+    confusion: dict[str, dict[str, int]] = {}
+    cross = 0
+    for uid, want in expected.items():
+        rows = slo_stats_for_pod(base, uid, quota_dir=base)
+        assert rows, f"no slo rows for {uid}"
+        row = rows[0]
+        kinds = sorted({v["kind"] for v in row["verdicts"]})
+        confusion[uid] = {}
+        for v in row["verdicts"]:
+            confusion[uid][v["kind"]] = \
+                confusion[uid].get(v["kind"], 0) + 1
+        wrong = [k for k in kinds if k != want]
+        cross += len(wrong)
+        per_tenant[uid] = {
+            "expected": want or None,
+            "verdict_kinds": kinds,
+            "goodput": row["goodput_ratio"],
+            "components_frac": row["components_frac"],
+            "verdicts": row["verdicts"],
+        }
+
+    # doctor verdicts (the operator surface) for the quota case: the
+    # cause join must NAME the revoked lease
+    _st, quota_doc = slo_doctor.why_slow_offline(base, "uid-quota",
+                                                 quota_dir=base)
+    quota_cause = (per_tenant["uid-quota"]["verdicts"][0]
+                   if per_tenant["uid-quota"]["verdicts"] else {})
+    lease_named = bool((quota_cause.get("cause") or {}).get("lease_id"))
+
+    doc = {
+        "bench": "slo",
+        "revision": 15,
+        "scenario": {
+            "steady_steps": STEADY_STEPS,
+            "regressed_steps": REGRESSED_STEPS,
+            "base_step_ms": BASE_STEP_NS / 1e6,
+            "causes": ["quota-revoke", "spill-thrash",
+                       "ici-contention", "cold-compile",
+                       "steady-control"],
+        },
+        "per_tenant": per_tenant,
+        "confusion": confusion,
+        "doctor_quota": {
+            "verdict": quota_doc.get("verdict"),
+            "summary": quota_doc.get("summary"),
+            "lease_named": lease_named,
+        },
+        "asserts": {
+            "correct_attributions": sum(
+                1 for uid, want in expected.items() if want
+                and per_tenant[uid]["verdict_kinds"] == [want]),
+            "correct_attributions_min": 4,
+            "cross_attributions": cross,
+            "cross_attributions_max": 0,
+            "steady_false_positives": len(
+                per_tenant["uid-steady"]["verdict_kinds"]),
+        },
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+    # the headline assertions: every injected cause names ITS plane,
+    # nothing names anyone else's, the control stays clean, and the
+    # quota verdict carries the lease that coincides
+    for uid, want in expected.items():
+        got = per_tenant[uid]["verdict_kinds"]
+        if want:
+            assert got == [want], f"{uid}: expected [{want}], got {got}"
+        else:
+            assert got == [], f"control fired: {got}"
+    assert cross == 0, f"{cross} cross-attribution(s)"
+    assert lease_named, "quota verdict did not name the revoked lease"
+    assert quota_doc.get("verdict") == "regressed", quota_doc
+
+    out_path = os.path.join(REPO, "BENCH_VTSLO_r15.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        for uid, want in expected.items():
+            got = per_tenant[uid]["verdict_kinds"]
+            print(f"{uid:<14} expected {want or '(none)':<16} "
+                  f"got {got or '(none)'}")
+        print(f"doctor(uid-quota): {quota_doc.get('summary')}")
+        print(f"4/4 causes attributed, 0 cross-attributions; "
+              f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

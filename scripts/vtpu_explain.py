@@ -4,9 +4,19 @@
 Usage:
     python scripts/vtpu_explain.py --pod <uid>          # latest decision
     python scripts/vtpu_explain.py --why-pending <pod>  # doctor verdict
+    python scripts/vtpu_explain.py --why-slow <pod>     # vtslo doctor
     python scripts/vtpu_explain.py --pod <uid> --diff   # last two passes
     python scripts/vtpu_explain.py --list               # audited pods
     python scripts/vtpu_explain.py --pod <uid> --json   # machine output
+
+``--why-slow`` answers the OTHER doctor question — not "why is my pod
+pending" but "why is my running job slow": the vtslo attribution
+plane's verdict for the pod (step-time split into compute / throttle /
+comm / spill-fill / compile, plus attributed regressions joined to the
+responsible plane's events). It asks the monitor's ``/slo`` route when
+``--slo-endpoint`` is given, else replays the pod's step ring offline
+from ``--base-dir`` — the same math either way, because attribution is
+pure record arithmetic.
 
 Reads the per-process JSONL decision spools the DecisionExplain gate
 produces (default dir: the shared node explain dir; --explain-dir for
@@ -83,6 +93,19 @@ def main(argv: list[str] | None = None) -> int:
                         help="pod uid / trace id / name to explain")
     parser.add_argument("--why-pending", default="", metavar="POD",
                         help="doctor verdict only for this pod")
+    parser.add_argument("--why-slow", default="", metavar="POD",
+                        help="vtslo doctor verdict: step-time "
+                             "attribution + regressions for this pod")
+    parser.add_argument("--slo-endpoint", default="",
+                        help="monitor /slo URL for --why-slow (unset: "
+                             "replay the pod's ring offline from "
+                             "--base-dir)")
+    parser.add_argument("--base-dir", default=consts.MANAGER_BASE_DIR,
+                        help="container-config root for the offline "
+                             "--why-slow replay (default: %(default)s)")
+    parser.add_argument("--token-file", default=None,
+                        help="bearer token for an auth-gated monitor "
+                             "(--slo-endpoint)")
     parser.add_argument("--diff", action="store_true",
                         help="compare the pod's two most recent "
                              "decisions' breakdowns (needs --pod)")
@@ -93,14 +116,63 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--json", action="store_true", dest="as_json")
     args = parser.parse_args(argv)
 
-    if not (args.pod or args.why_pending or args.list_pods):
+    if not (args.pod or args.why_pending or args.why_slow
+            or args.list_pods):
         parser.print_usage(sys.stderr)
-        print("vtpu-explain: one of --pod / --why-pending / --list "
-              "required", file=sys.stderr)
+        print("vtpu-explain: one of --pod / --why-pending / "
+              "--why-slow / --list required", file=sys.stderr)
         return 2
     if args.diff and not args.pod:
         print("vtpu-explain: --diff needs --pod", file=sys.stderr)
         return 2
+
+    if args.why_slow:
+        from vtpu_manager.slo import doctor as slo_doctor
+        if args.slo_endpoint:
+            import json as _json
+            import urllib.error
+            import urllib.parse
+            import urllib.request
+            url = args.slo_endpoint + (
+                "&" if "?" in args.slo_endpoint else "?") + \
+                f"pod={urllib.parse.quote(args.why_slow)}"
+            req = urllib.request.Request(url)
+            if args.token_file:
+                with open(args.token_file) as f:
+                    req.add_header("Authorization",
+                                   f"Bearer {f.read().strip()}")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    verdict = _json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                verdict = None
+                if e.code == 404:
+                    # a gate-ON monitor's unknown-pod 404 carries the
+                    # doctor's JSON; a gate-OFF monitor's 404 is
+                    # aiohttp's plain-text no-such-route body
+                    try:
+                        verdict = _json.loads(e.read().decode())
+                    except ValueError:
+                        pass
+                if verdict is None:
+                    print(f"vtpu-explain: {url}: HTTP {e.code} (is "
+                          f"the monitor running with "
+                          f"SLOAttribution=true?)", file=sys.stderr)
+                    return 1
+            except (OSError, ValueError) as e:
+                print(f"vtpu-explain: {url}: {e} (is the monitor "
+                      f"running with SLOAttribution=true?)",
+                      file=sys.stderr)
+                return 1
+        else:
+            _status, verdict = slo_doctor.why_slow_offline(
+                args.base_dir, args.why_slow, quota_dir=args.base_dir)
+        if args.as_json:
+            print(json.dumps(verdict, indent=2))
+        else:
+            for line in slo_doctor.format_verdict(verdict):
+                print(line)
+        return 0 if verdict.get("verdict") != "no-records" else 1
 
     if args.list_pods:
         # collect() reads the spools itself; its spool_drops field is

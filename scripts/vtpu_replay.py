@@ -164,10 +164,12 @@ def check_borrowed_used(doc: dict) -> tuple[int, list[str]]:
         pct = int(bu.get("pct", 0))
         used = t.get("used_core_pct") if t else None
         base = t.get("allocated_core_pct") if t else None
-        expect = None
-        if used is not None and base is not None and pct > 0:
-            expect = round(min(max(float(used) - float(base), 0.0),
-                               float(pct)), 2)
+        # the SAME formula the live fold and the grant-step feedback
+        # use (quota.market.borrowed_used_verdict) — one derivation
+        from vtpu_manager.quota.market import borrowed_used_verdict
+        expect = borrowed_used_verdict(used, base, pct)
+        if expect is not None:
+            expect = round(expect, 2)
         got = bu.get("used_of_borrowed_pct")
         if got != expect:
             mismatches.append(

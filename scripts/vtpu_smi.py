@@ -101,6 +101,22 @@ def render(doc: dict, out=None) -> None:
               f"node(s) publishing"
               + (f"  {spread}" if spread else "") + f"  {spill}",
               file=out)
+    # vtslo fleet SLO headline (slo documents only — a gate-off
+    # document renders exactly the prior header): fleet goodput plus
+    # the attributed-regression count
+    slo = doc.get("slo")
+    if slo is not None:
+        gp = slo.get("goodput_mean")
+        gpm = slo.get("goodput_min")
+        parts = [f"SLO: {slo.get('tenants_with_signal', 0)}/"
+                 f"{slo.get('tenants', 0)} tenant(s) reporting"]
+        if gp is not None:
+            parts.append(f"goodput {gp * 100:.1f}% mean"
+                         + (f"/{gpm * 100:.1f}% min"
+                            if gpm is not None else ""))
+        parts.append(f"{slo.get('regressions', 0)} attributed "
+                     f"regression(s)")
+        print("  " + "  ".join(parts), file=out)
     # vtqm evidence loop (market documents only): per-lease
     # borrowed-vs-used — did the borrower use what it borrowed?
     for bu in (quota or {}).get("borrowed_used") or []:
@@ -205,9 +221,15 @@ def render(doc: dict, out=None) -> None:
         show_comm = any(t.get("comm_duty_frac") is not None
                         for t in tenants)
         comm_hdr = f" {'comm':>11}" if show_comm else ""
+        # vtslo: GOODPUT column (useful-compute fraction of the latest
+        # attributed window) appears only when the document carries slo
+        # state — a gate-off document renders exactly the prior table
+        show_slo = any(t.get("goodput_ratio") is not None
+                       for t in tenants)
+        slo_hdr = f" {'goodput':>8}" if show_slo else ""
         print(f"{'POD':<28} {'container':<12} {'node':<12} {'chip':>4} "
               f"{'quota':>7} {'used':>7} {'wait':>6} {'hbm-hw':>8} "
-              f"{'conf':>9}{market_hdr}{comm_hdr}", file=out)
+              f"{'conf':>9}{market_hdr}{comm_hdr}{slo_hdr}", file=out)
         for t in tenants:
             pod = t.get("pod_name") or t.get("pod_uid", "?")
             ns = t.get("pod_namespace", "")
@@ -230,6 +252,11 @@ def render(doc: dict, out=None) -> None:
                     cell = f"{cf * 100:4.1f}%" + (
                         f" x{ci:.2f}" if ci is not None else "")
                     comm_cols = f" {cell:>11}"
+            slo_cols = ""
+            if show_slo:
+                gp = t.get("goodput_ratio")
+                slo_cols = (f" {'-':>8}" if gp is None
+                            else f" {gp * 100:7.1f}%")
             print(f"{label[:28]:<28} {t.get('container', '')[:12]:<12} "
                   f"{t.get('node', '')[:12]:<12} "
                   f"{t.get('chip_index', '?'):>4} "
@@ -237,7 +264,8 @@ def render(doc: dict, out=None) -> None:
                   f"{_pct(t.get('used_core_pct')):>7} "
                   f"{'-' if wait is None else f'{wait * 100:4.1f}%':>6} "
                   f"{_gib(t.get('hbm_highwater_bytes')):>8} "
-                  f"{_conf(t):>9}{market_cols}{comm_cols}", file=out)
+                  f"{_conf(t):>9}{market_cols}{comm_cols}{slo_cols}",
+                  file=out)
     else:
         print("(no tenant rows)", file=out)
 

@@ -164,6 +164,15 @@ def main(argv: list[str] | None = None) -> int:
             explain_doctor.records_for_pod(exp_records,
                                            tl.pod_uid or args.pod)
         decision = explain_doctor.latest_decision(exp_trail)
+        # vtslo splice: the per-step component decomposition off the
+        # SAME ring (pure record arithmetic, so the offline splice is
+        # the live plane's math) — which slice of each step was
+        # compute vs throttle vs comm vs spill-fill vs compile, plus
+        # any attributed regression verdicts
+        from vtpu_manager.slo import slo_stats_for_pod
+        slo_rows = slo_stats_for_pod(args.steps_dir, tl.trace_id,
+                                     tl.pod_uid or args.pod,
+                                     quota_dir=args.steps_dir)
         if args.as_json:
             print(json.dumps({"timeline": tl.to_wire(),
                               "critical_path": assemble.critical_path(tl),
@@ -171,7 +180,8 @@ def main(argv: list[str] | None = None) -> int:
                               "compile_cache": compiles,
                               "utilization": util,
                               "placement_headroom": placement_headroom,
-                              "placement_decision": decision},
+                              "placement_decision": decision,
+                              "slo": slo_rows},
                              indent=2))
         else:
             _print_timeline(tl)
@@ -214,6 +224,15 @@ def main(argv: list[str] | None = None) -> int:
                       f"{u['throttle_wait_frac'] * 100:.1f}%  "
                       f"hbm-hw {u['hbm_highwater_bytes']}"
                       f"/{u['allocated_hbm_bytes']}")
+            for s in slo_rows:
+                comps = "  ".join(
+                    f"{name.replace('_', '-')} {frac * 100:.1f}%"
+                    for name, frac in s["components_frac"].items()
+                    if frac > 0)
+                print(f"  slo [{s['container']}]: goodput "
+                      f"{s['goodput_ratio'] * 100:.1f}%  {comps}")
+                for v in s["verdicts"]:
+                    print(f"    [{v['kind']}] {v['summary']}")
             for h in placement_headroom:
                 sig = ("reclaimable "
                        f"{h['reclaim_core_pct']}% core on the node"

@@ -90,8 +90,11 @@ int main() {
          offsetof(StepRecord, bytes_transferred));
   printf("sr.collective_count %zu\n",
          offsetof(StepRecord, collective_count));
+  printf("sr.spill_fill_time_ns %zu\n",
+         offsetof(StepRecord, spill_fill_time_ns));
   printf("comm_staleness_ns %llu\n",
          (unsigned long long)kCommSignalStalenessNs);
+  printf("step_version %u\n", kStepRingVersion);
   return 0;
 }
 """
@@ -156,6 +159,9 @@ class TestCrossLanguageLayout:
         # against the same constant
         assert int(cxx_layout["comm_staleness_ns"]) == \
             stepring.COMM_SIGNAL_STALENESS_NS
+        # vtslo: both sides must agree the wire is v4 — a drifted
+        # version constant would make every shim-written ring skipped
+        assert int(cxx_layout["step_version"]) == stepring.VERSION == 4
 
 
 class TestVtpuConfigRoundtrip:
@@ -648,15 +654,16 @@ int main(int argc, char** argv) {
   int n = atoi(argv[2]);
   for (int i = 0; i < n; i++) {
     // FLAG_COMPILE on the stream's very first record, mirroring the
-    // shim's first-execute convention. The v3 comm block carries
-    // index-correlated values so a torn or misaligned read cannot
-    // round-trip by accident.
+    // shim's first-execute convention. The v3 comm block and the v4
+    // spill-fill field carry index-correlated values so a torn or
+    // misaligned read cannot round-trip by accident.
     uint64_t idx = w.writes();
     w.Record(4000000ull, 1000000ull, 1ull << 20, idx == 0,
              1000000ull * (idx + 1), 0, 0, 0,
              /*comm_time_ns=*/500000ull * (idx + 1),
              /*bytes_transferred=*/(1ull << 20) * (idx + 1),
-             /*collective_count=*/(uint32_t)(idx + 1));
+             /*collective_count=*/(uint32_t)(idx + 1),
+             /*spill_fill_time_ns=*/250000ull * (idx + 1));
   }
   printf("%llu\n", (unsigned long long)w.writes());
   return 0;
@@ -822,12 +829,14 @@ class TestCxxStepRingWriter:
             assert records[2].throttle_wait_ns == 1_000_000
             assert records[2].hbm_highwater_bytes == 1 << 20
             assert records[3].start_mono_ns == 4_000_000
-            # v3 comm block, C++ writer -> Python reader, every field
-            # index-correlated (a misaligned read cannot pass)
+            # v3 comm block + v4 spill-fill, C++ writer -> Python
+            # reader, every field index-correlated (a misaligned read
+            # cannot pass)
             for r in records:
                 assert r.comm_time_ns == 500_000 * (r.index + 1)
                 assert r.bytes_transferred == (1 << 20) * (r.index + 1)
                 assert r.collective_count == r.index + 1
+                assert r.spill_fill_time_ns == 250_000 * (r.index + 1)
         finally:
             reader.close()
 
@@ -850,43 +859,57 @@ class TestCxxStepRingWriter:
             assert [r.index for r in records] == [3, 4]
             assert [r.collective_count for r in records] == [4, 5]
             assert records[0].comm_time_ns == 500_000 * 4
+            assert records[0].spill_fill_time_ns == 250_000 * 4
         finally:
             reader.close()
 
-    def test_v2_reader_on_v3_ring_gracefully_skips(self, tmp_path):
-        """Mixed-version node mid-upgrade: a pre-v3 reader encountering
-        a v3 ring (and a v3 reader encountering a leftover v2 file)
+    def test_v3_reader_on_v4_ring_gracefully_skips(self, tmp_path):
+        """Mixed-version node mid-upgrade: a pre-v4 reader encountering
+        a v4 ring (and a v4 reader encountering a leftover v3 file)
         must SKIP the ring — the strict-version ValueError every
         consumer (collector scan, ledger fold) already catches and
         charges to that tenant's freshness — never serve records whose
-        spill/comm fields would be read from the wrong offsets."""
+        spill-fill field would be read from the wrong offsets. The
+        exact v2<->v3 rule, carried forward."""
         from vtpu_manager.telemetry import stepring
         ring = str(tmp_path / "step_telemetry.ring")
         w = stepring.StepRingWriter(ring)
         w.record(duration_ns=1_000_000)
         w.close()
-        # a v2 reader's strict check is version==2 && record_size==72;
-        # simulate it on this v3 file: both fields differ, so the
+        # a v3 reader's strict check is version==3 && record_size==96;
+        # simulate it on this v4 file: both fields differ, so the
         # constructor-time ValueError fires exactly like ours below
         raw = open(ring, "rb").read()
         version, = struct.unpack_from("<I", raw, 4)
         rec_size, = struct.unpack_from("<i", raw, 12)
-        assert (version, rec_size) == (3, 96)   # what a v2 reader sees
-        # and a v3 reader on a leftover v2 ring refuses cleanly
-        v2 = bytearray(raw)
-        struct.pack_into("<I", v2, 4, 2)      # version
-        struct.pack_into("<i", v2, 12, 72)    # record_size
-        v2_path = str(tmp_path / "v2.ring")
-        with open(v2_path, "wb") as f:
-            f.write(bytes(v2))
+        assert (version, rec_size) == (4, 104)  # what a v3 reader sees
+        # and a v4 reader on a leftover v3 ring refuses cleanly: a real
+        # v3 file is smaller than the v4 mmap length (ValueError at
+        # map time), and even a v4-SIZED file carrying v3 header fields
+        # fails the strict version check — either way the reader never
+        # serves records from the wrong offsets
+        v3 = bytearray(raw[:stepring.HEADER_SIZE + 256 * 96])
+        struct.pack_into("<I", v3, 4, 3)      # version
+        struct.pack_into("<i", v3, 12, 96)    # record_size
+        v3_path = str(tmp_path / "v3.ring")
+        with open(v3_path, "wb") as f:
+            f.write(bytes(v3))
+        with pytest.raises(ValueError):
+            stepring.StepRingReader(v3_path)
+        v3_padded = bytearray(raw)
+        struct.pack_into("<I", v3_padded, 4, 3)
+        struct.pack_into("<i", v3_padded, 12, 96)
+        v3_padded_path = str(tmp_path / "v3_padded.ring")
+        with open(v3_padded_path, "wb") as f:
+            f.write(bytes(v3_padded))
         with pytest.raises(ValueError, match="bad step ring"):
-            stepring.StepRingReader(v2_path)
+            stepring.StepRingReader(v3_padded_path)
         # the collector's scan charges it as unreadable, not a crash
         from vtpu_manager.telemetry import TenantStepTelemetry
-        base = tmp_path / "base" / "uid-v2_main" / "telemetry"
+        base = tmp_path / "base" / "uid-v3_main" / "telemetry"
         base.mkdir(parents=True)
         with open(base / "step_telemetry.ring", "wb") as f:
-            f.write(bytes(v2))
+            f.write(bytes(v3))
         agg = TenantStepTelemetry(str(tmp_path / "base"))
         assert agg.scan() == 1    # one existing-but-unreadable ring
 
@@ -934,6 +957,70 @@ def cxx_comm_cost_probe(tmp_path_factory):
         ["g++", "-std=c++17", f"-I{REPO}/library/include", str(src),
          "-o", str(exe)], check=True, capture_output=True)
     return str(exe)
+
+
+SPILL_SHAPE_PROBE_SRC = r"""
+#include <cstdio>
+#include <cstdlib>
+#include "vtpu_config.h"
+int main(int argc, char** argv) {
+  // argv: <elem_bytes> <on_device_bytes> <dim>...
+  int64_t elem = atoll(argv[1]);
+  int64_t on_dev = atoll(argv[2]);
+  int64_t dims[16];
+  size_t n = 0;
+  for (int i = 3; i < argc && n < 16; i++) dims[n++] = atoll(argv[i]);
+  int64_t logical = vtpu::SpillLogicalBytes(dims, n, elem);
+  printf("%lld %d\n", (long long)logical,
+         vtpu::SpillShapeCaptureOk(logical, on_dev) ? 1 : 0);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cxx_spill_shape_probe(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("spillshapeprobe")
+    src = tmp / "spill_shape_probe.cc"
+    src.write_text(SPILL_SHAPE_PROBE_SRC)
+    exe = tmp / "spill_shape_probe"
+    subprocess.run(
+        ["g++", "-std=c++17", f"-I{REPO}/library/include", str(src),
+         "-o", str(exe)], check=True, capture_output=True)
+    return str(exe)
+
+
+class TestSpillShapeCaptureParity:
+    """vtovc item (b): the Execute-output shape-capture rule — whether
+    an observed (dims, element-type) pair is a safe spill recipe — must
+    judge identically in the shim (vtpu_config.h) and the Python
+    contract mirror (overcommit/spill.py), or the bench's candidate
+    model and the shim's real demotions would diverge."""
+
+    CASES = [
+        # (elem_bytes, on_device_bytes, dims)
+        (4, 4096, [32, 32]),            # clean activation: capturable
+        (4, 8192, [32, 32]),            # padded layout: logical != dev
+        (2, 2, []),                     # scalar: capturable
+        (4, 0, [0, 128]),               # zero-element: no recipe
+        (4, 4, [-1, 1]),                # negative dim: no recipe
+        (0, 4096, [32, 32]),            # invalid element size
+        (8, 4096, [1 << 31, 1 << 31, 4]),   # overflow: no recipe
+        (1, 9_000_000_000_000_000_000, [3_000_000_000_000_000_000, 3]),
+    ]
+
+    def test_both_sides_judge_identically(self, cxx_spill_shape_probe):
+        from vtpu_manager.overcommit.spill import (spill_logical_bytes,
+                                                   spill_shape_capture_ok)
+        for elem, on_dev, dims in self.CASES:
+            out = subprocess.run(
+                [cxx_spill_shape_probe, str(elem), str(on_dev)]
+                + [str(d) for d in dims],
+                check=True, capture_output=True, text=True).stdout.split()
+            logical = spill_logical_bytes(dims, elem)
+            ok = spill_shape_capture_ok(logical, on_dev)
+            assert int(out[0]) == logical, (elem, on_dev, dims)
+            assert int(out[1]) == (1 if ok else 0), (elem, on_dev, dims)
 
 
 class TestCommCostParity:

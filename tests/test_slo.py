@@ -1,0 +1,880 @@
+"""vtslo suite: attribution arithmetic, ring v4, detectors + causes,
+history spools, stalecodec consolidation, gate-off contracts, the /slo
+route + --why-slow doctor e2e, and the quota grant-step satellite."""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vtpu_manager import slo
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.quota.ledger import QuotaLeaseLedger
+from vtpu_manager.quota.market import (QuotaMarketManager,
+                                       borrowed_used_verdict,
+                                       scaled_grant_step)
+from vtpu_manager.slo import attribution, detect, doctor, history
+from vtpu_manager.telemetry import stepring
+from vtpu_manager.util import consts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rec(duration=10_000_000, throttle=0, comm=0, spill_fill=0,
+        compiled=False, spills=0, fills=0, collectives=0, index=0):
+    return stepring.StepRecord(
+        index=index, start_mono_ns=0, duration_ns=duration,
+        throttle_wait_ns=throttle, comm_time_ns=comm,
+        spill_fill_time_ns=spill_fill,
+        flags=stepring.FLAG_COMPILE if compiled else 0,
+        spill_events=spills, fill_events=fills,
+        collective_count=collectives)
+
+
+def mk_ring(base, uid, records, cont="main", trace_id=""):
+    entry = os.path.join(base, f"{uid}_{cont}")
+    os.makedirs(os.path.join(entry, "telemetry"), exist_ok=True)
+    # the live fold reaches rings through the ONE tenantdirs walk, and
+    # that walk is keyed on the tenant's vtpu.config — write one
+    cfg_path = os.path.join(entry, "config", "vtpu.config")
+    if not os.path.exists(cfg_path):
+        vc.write_config(cfg_path, vc.VtpuConfig(
+            pod_uid=uid, container_name=cont,
+            devices=[vc.DeviceConfig(
+                uuid="TPU-0", total_memory=1 << 30,
+                real_memory=1 << 30, hard_core=50, host_index=0)]))
+    path = os.path.join(entry, "telemetry", consts.STEP_RING_NAME)
+    w = stepring.StepRingWriter(path, trace_id=trace_id or f"tr-{uid}")
+    for kw in records:
+        w.record(**kw)
+    w.close()
+    return path
+
+
+STEADY = [dict(duration_ns=10_000_000, throttle_wait_ns=200_000)] * 96
+
+
+# ---------------------------------------------------------------------------
+# attribution: pure arithmetic, reproducible from the record alone
+# ---------------------------------------------------------------------------
+
+class TestAttribution:
+    def test_components_sum_exactly_to_duration(self):
+        r = rec(duration=10_000, throttle=2_000, comm=1_500,
+                spill_fill=500)
+        comps = slo.attribute(r)
+        assert sum(comps.values()) == 10_000
+        assert comps == {"compute": 6_000, "throttle": 2_000,
+                         "comm": 1_500, "spill_fill": 500, "compile": 0}
+
+    def test_clamp_rule_scales_overlapping_observers(self):
+        # throttle+comm+spill > duration: proportional scale, exact sum
+        r = rec(duration=1_000, throttle=400, comm=300, spill_fill=500)
+        comps = slo.attribute(r)
+        assert sum(comps.values()) == 1_000
+        assert all(v >= 0 for v in comps.values())
+        # proportions preserved (integer floor)
+        assert comps["throttle"] == 400 * 1_000 // 1_200
+        assert comps["spill_fill"] == 500 * 1_000 // 1_200
+
+    def test_compile_step_residual_goes_to_compile(self):
+        r = rec(duration=40_000, throttle=5_000, compiled=True)
+        comps = slo.attribute(r)
+        assert comps["compile"] == 35_000 and comps["compute"] == 0
+        r2 = rec(duration=40_000, throttle=5_000)
+        comps2 = slo.attribute(r2)
+        assert comps2["compute"] == 35_000 and comps2["compile"] == 0
+
+    def test_reproducible_pure(self):
+        r = rec(duration=9_999, throttle=1_234, comm=777, spill_fill=11)
+        assert slo.attribute(r) == slo.attribute(r)
+
+    def test_goodput_ratio(self):
+        assert slo.goodput_ratio({"compute": 75, "throttle": 25}) \
+            == 0.75
+        assert slo.goodput_ratio({}) == 1.0        # empty window
+
+    def test_fold_window(self):
+        w = attribution.fold_window(
+            [rec(duration=10_000, throttle=1_000, index=i,
+                 collectives=1) for i in range(10)], ts=100.0)
+        assert w.steps == 10 and w.duration_ns == 100_000
+        assert w.collectives == 10
+        assert w.component_frac("throttle") == pytest.approx(0.1)
+        assert attribution.fold_window([], ts=1.0) is None
+
+
+# ---------------------------------------------------------------------------
+# step ring v4 (python side; the cross-language probes live in
+# test_config_abi)
+# ---------------------------------------------------------------------------
+
+class TestRingV4:
+    def test_v4_roundtrip(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        w.record(duration_ns=5_000_000, spill_fill_time_ns=123_456)
+        w.close()
+        r = stepring.StepRingReader(path)
+        try:
+            records, head, dropped = r.poll(0)
+            assert head == 1 and dropped == 0
+            assert records[0].spill_fill_time_ns == 123_456
+        finally:
+            r.close()
+
+    def test_v3_reader_shape_refused(self, tmp_path):
+        """v3<->v4 graceful skip: a v4 reader refuses a leftover v3
+        ring (wrong version/record_size AND wrong mmap length), a v3
+        reader's strict check refuses the v4 file — either direction is
+        a clean skip the collector charges as unreadable."""
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        w.record(duration_ns=1)
+        w.close()
+        raw = open(path, "rb").read()
+        version, = struct.unpack_from("<I", raw, 4)
+        rec_size, = struct.unpack_from("<i", raw, 12)
+        assert (version, rec_size) == (4, 104)
+        v3 = bytearray(raw[:stepring.HEADER_SIZE + 256 * 96])
+        struct.pack_into("<I", v3, 4, 3)
+        struct.pack_into("<i", v3, 12, 96)
+        v3_path = str(tmp_path / "v3.ring")
+        with open(v3_path, "wb") as f:
+            f.write(bytes(v3))
+        with pytest.raises(ValueError):
+            stepring.StepRingReader(v3_path)
+
+    def test_restart_continuation(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = stepring.StepRingWriter(path)
+        for _ in range(3):
+            w.record(duration_ns=1, spill_fill_time_ns=7)
+        w.close()
+        w2 = stepring.StepRingWriter(path)
+        assert w2.writes == 3          # sequence continues
+        w2.record(duration_ns=2, spill_fill_time_ns=9)
+        w2.close()
+        r = stepring.StepRingReader(path)
+        try:
+            records, head, _ = r.poll(0)
+            assert head == 4
+            assert [x.spill_fill_time_ns for x in records] \
+                == [7, 7, 7, 9]
+        finally:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# detectors: the cause matrix, staleness, no false positives
+# ---------------------------------------------------------------------------
+
+def replay(records, quota_dir=None, tenant="uid-x/main"):
+    _w, verdicts = slo.replay_records(records, quota_dir=quota_dir,
+                                      tenant=tenant)
+    return verdicts
+
+
+class TestDetectors:
+    def mk(self, spike_kw, n_steady=96, n_spike=64):
+        steady = [rec(duration=10_000_000, throttle=200_000, index=i)
+                  for i in range(n_steady)]
+        spike = [rec(index=n_steady + i, **spike_kw)
+                 for i in range(n_spike)]
+        return steady + spike
+
+    def test_throttle_spike(self):
+        v = replay(self.mk(dict(duration=18_000_000,
+                                throttle=8_600_000)))
+        assert [x.kind for x in v] == ["throttle-spike"]
+        assert v[0].dominant == "throttle"
+        assert v[0].step_time_ratio > 1.25
+        assert v[0].cause["plane"] == "quota"
+
+    def test_spill_thrash(self):
+        v = replay(self.mk(dict(duration=16_000_000,
+                                spill_fill=6_300_000, spills=3,
+                                fills=2)))
+        assert [x.kind for x in v] == ["spill-thrash"]
+        assert v[0].cause["spill_events"] > 0
+
+    def test_comm_inflation(self):
+        v = replay(self.mk(dict(duration=15_000_000, comm=6_500_000,
+                                collectives=1)))
+        assert [x.kind for x in v] == ["comm-inflation"]
+        assert v[0].cause["collectives"] > 0
+
+    def test_compile_storm(self):
+        v = replay(self.mk(dict(duration=45_000_000, compiled=True),
+                           n_spike=32))
+        assert [x.kind for x in v] == ["compile-storm"]
+        assert v[0].cause["compile_steps"] > 0
+
+    def test_steady_no_false_positive(self):
+        v = replay([rec(duration=10_000_000, throttle=150_000, index=i)
+                    for i in range(160)])
+        assert v == []
+
+    def test_noisy_but_steady_no_false_positive(self):
+        # variance is the tenant's license to wobble: +-20% jitter must
+        # not trip the envelope gate
+        import random
+        rng = random.Random(7)
+        v = replay([rec(duration=int(10_000_000 *
+                                     rng.uniform(0.8, 1.2)), index=i)
+                    for i in range(160)])
+        assert v == []
+
+    def test_staleness_reseeds_to_no_signal(self):
+        """A silence gap past the budget abandons the baseline: the
+        post-gap window is NOT judged against pre-gap state."""
+        det = detect.RegressionDetector()
+        for i in range(6):
+            w = attribution.fold_window(
+                [rec(duration=10_000_000, index=i)], ts=float(i))
+            assert det.observe("t/c", w, now=float(i)) is None
+        # regressed window but AFTER a gap > STALENESS_S: no verdict
+        late = 1000.0 + detect.STALENESS_S
+        w = attribution.fold_window(
+            [rec(duration=50_000_000, throttle=40_000_000)], ts=late)
+        assert det.observe("t/c", w, now=late) is None
+        base = det.baseline("t/c")
+        assert base.samples == 1          # re-seeded, not judged
+
+    def test_quota_cause_joins_ledger(self, tmp_path):
+        now = time.time()
+        ledger = QuotaLeaseLedger(str(tmp_path), clock=lambda: now)
+        lease, _ = ledger.grant(0, "uid-l/main", "uid-x/main", 20,
+                                30.0, now - 60.0)
+        ledger.settle([lease["id"]], "revoked", now - 10.0)
+        v = replay(self.mk(dict(duration=18_000_000,
+                                throttle=8_600_000)),
+                   quota_dir=str(tmp_path))
+        assert v[0].cause["lease_id"] == lease["id"]
+        assert "coincides with quota revoked lease" in v[0].summary
+
+    def test_one_verdict_per_episode(self):
+        v = replay(self.mk(dict(duration=18_000_000,
+                                throttle=8_600_000), n_spike=128))
+        # the episode suppression: a persisting condition is ONE
+        # verdict, not one per window
+        assert len(v) == 1
+
+
+# ---------------------------------------------------------------------------
+# history: bounded rings, spool persistence, torn-line chaos
+# ---------------------------------------------------------------------------
+
+class TestHistory:
+    def w(self, ts, mean=10_000_000.0):
+        return attribution.WindowSample(
+            ts=ts, steps=4, duration_ns=int(mean * 4),
+            step_mean_ns=mean, step_p95_ns=int(mean),
+            components_ns={"compute": int(mean * 4)}, goodput=1.0)
+
+    def test_ring_bounded(self, tmp_path):
+        h = history.SloHistory(str(tmp_path), windows_per_tenant=8)
+        for i in range(40):
+            h.record("t/c", self.w(float(i)))
+        ws = h.windows("t/c")
+        assert len(ws) == 8 and ws[-1].ts == 39.0 and ws[0].ts == 32.0
+
+    def test_spool_roundtrip_and_reseed(self, tmp_path):
+        h = history.SloHistory(str(tmp_path))
+        for i in range(5):
+            h.record("t/c", self.w(float(i)))
+        assert h.flush() == 5
+        h2 = history.SloHistory(str(tmp_path))
+        assert h2.reseed() == 5
+        assert [w.ts for w in h2.windows("t/c")] == [0.0, 1.0, 2.0,
+                                                     3.0, 4.0]
+
+    def test_torn_spool_line_skipped_never_fatal(self, tmp_path):
+        h = history.SloHistory(str(tmp_path))
+        h.record("t/c", self.w(1.0))
+        h.flush()
+        # crash mid-append: a torn half-line plus garbage
+        with open(h.spool_path, "a") as f:
+            f.write('{"kind": "slo_window", "tenant": "t/c", "ts"')
+        with open(h.spool_path, "a") as f:
+            f.write("\nnot-json-at-all\n")
+        h2 = history.SloHistory(str(tmp_path))
+        assert h2.reseed() == 1          # the good line survives
+
+    def test_rotation_bounds_spool(self, tmp_path):
+        h = history.SloHistory(str(tmp_path), max_spool_bytes=512)
+        for i in range(64):
+            h.record("t/c", self.w(float(i)))
+            h.flush()
+        names = [n for n in os.listdir(str(tmp_path))
+                 if n.endswith(".jsonl")]
+        assert any(".prev" in n for n in names)
+        for n in names:
+            assert os.path.getsize(os.path.join(str(tmp_path), n)) \
+                < 2 * 512 + 512          # cap + one trailing append
+
+    def test_unwritable_spool_counts_drops(self, tmp_path):
+        # the spool DIR path is occupied by a file: makedirs raises
+        # (chmod tricks don't bind under root, this always does)
+        spool = tmp_path / "sub"
+        spool.write_text("not a directory")
+        h = history.SloHistory(str(spool))
+        h.record("t/c", self.w(1.0))
+        h.flush()
+        assert h.dropped_total == 1
+
+    def test_ledger_restart_continuation(self, tmp_path):
+        """A restarted SloLedger re-seeds detector baselines from the
+        spools: the FIRST post-restart fold can already judge."""
+        base = str(tmp_path / "mgr")
+        os.makedirs(base)
+        ring = mk_ring(base, "uid-1", STEADY[:24])
+        led = slo.SloLedger("n1", base_dir=base, start_flusher=False)
+        led.fold()
+        # three more baseline windows (one fold each — the writer
+        # continues the sequence, the cursor tails it)
+        for _ in range(3):
+            w = stepring.StepRingWriter(ring)
+            for _i in range(24):
+                w.record(duration_ns=10_000_000,
+                         throttle_wait_ns=200_000)
+            w.close()
+            led.fold()
+        assert len(led.history.windows("uid-1/main")) == 4
+        assert led.recent_verdicts == []
+        led.history.flush()
+        # restart: new ledger (new process in spirit) re-seeds the
+        # baseline, then the spike arrives
+        w = stepring.StepRingWriter(ring)
+        for _i in range(96):
+            w.record(duration_ns=19_000_000,
+                     throttle_wait_ns=9_000_000)
+        w.close()
+        led2 = slo.SloLedger("n1", base_dir=base, start_flusher=False)
+        assert len(led2.history.windows("uid-1/main")) == 4  # reseeded
+        led2.fold()
+        kinds = {v.kind for v in led2.recent_verdicts}
+        assert kinds == {"throttle-spike"}
+
+
+# ---------------------------------------------------------------------------
+# stalecodec consolidation: wire bytes + staleness verdicts identical
+# per codec (satellite c)
+# ---------------------------------------------------------------------------
+
+class TestStaleCodecConsolidation:
+    NOW = 1_700_000_000.0
+
+    def test_pressure_wire_and_verdicts(self):
+        from vtpu_manager.telemetry.pressure import (NodePressure,
+                                                     parse_pressure)
+        p = NodePressure(0.4321, 123456789, self.NOW)
+        # the pre-consolidation wire bytes, verbatim
+        assert p.encode() == f"0.4321:123456789@{self.NOW:.3f}"
+        assert parse_pressure(p.encode(), now=self.NOW).throttle_frac \
+            == pytest.approx(0.4321)
+        assert parse_pressure(p.encode(), now=self.NOW + 121) is None
+        assert parse_pressure(p.encode(), now=self.NOW - 6) is None
+        assert parse_pressure("nan:5@" + str(self.NOW)) is None
+        assert parse_pressure("garbage") is None
+
+    def test_headroom_wire_and_verdicts(self):
+        from vtpu_manager.utilization.headroom import (ChipHeadroom,
+                                                       NodeHeadroom,
+                                                       parse_headroom)
+        hr = NodeHeadroom(
+            chips={0: ChipHeadroom(80.0, 30.5, 20.0, 1 << 30)},
+            ts=self.NOW, class_mix={"thr": 2})
+        assert hr.encode() == \
+            f"mix=thr:2;0:80.0:30.5:20.0:{1 << 30}@{self.NOW:.3f}"
+        back = parse_headroom(hr.encode(), now=self.NOW)
+        assert back.chips[0].alloc_core_pct == 80.0
+        assert back.class_mix == {"thr": 2}
+        assert parse_headroom(hr.encode(), now=self.NOW + 121) is None
+
+    def test_overcommit_wire_and_verdicts(self):
+        from vtpu_manager.overcommit.ratio import (NodeOvercommit,
+                                                   parse_overcommit)
+        oc = NodeOvercommit(ratios={"thr": 1.75}, spill_frac=0.1234,
+                            spilled_bytes=42, ts=self.NOW)
+        assert oc.encode() == f"thr:1.75|0.1234:42@{self.NOW:.3f}"
+        back = parse_overcommit(oc.encode(), now=self.NOW)
+        assert back.ratios == {"thr": 1.75}
+        assert parse_overcommit(oc.encode(), now=self.NOW + 121) is None
+
+    def test_warm_keys_wire_and_verdicts(self):
+        from vtpu_manager.clustercache.advertise import (NodeWarmKeys,
+                                                         parse_warm_keys)
+        key = "ab" * 32
+        warm = NodeWarmKeys(endpoint="10.0.0.1:9394",
+                            pairs=(("fp1", key),), ts=self.NOW)
+        assert warm.encode() == \
+            f"10.0.0.1:9394|fp1={key}@{self.NOW:.3f}"
+        back = parse_warm_keys(warm.encode(), now=self.NOW)
+        assert back.pairs == (("fp1", key),)
+        assert parse_warm_keys(warm.encode(), now=self.NOW + 121) \
+            is None
+        assert parse_warm_keys("x" * 9000, now=self.NOW) is None
+
+    def test_victim_cost_wire_and_verdicts(self):
+        from vtpu_manager.quota.victimcost import (NodeVictimCosts,
+                                                   parse_victim_costs)
+        vcst = NodeVictimCosts(tenants={"uid-abcdef12345": (True,
+                                                            0.25)},
+                               ts=self.NOW)
+        assert vcst.encode() == \
+            f"uid-abcdef12345:l:0.250@{self.NOW:.3f}"
+        back = parse_victim_costs(vcst.encode(), now=self.NOW)
+        assert back.lookup("uid-abcdef12345xyz") == (True, 0.25)
+        assert parse_victim_costs(vcst.encode(), now=self.NOW + 121) \
+            is None
+
+    def test_lease_summary_wire_and_verdicts(self):
+        from vtpu_manager.quota import parse_lease_summary
+        raw = f"0:15:2@{self.NOW:.3f}"
+        assert parse_lease_summary(raw, now=self.NOW) == \
+            {0: {"lent_core_pct": 15, "leases": 2}}
+        assert parse_lease_summary(raw, now=self.NOW + 121) is None
+
+    def test_one_copy_of_the_rules(self):
+        """Every codec's skew constant IS the shared one (changing
+        stalecodec changes all of them at once — the consolidation)."""
+        from vtpu_manager.clustercache import advertise
+        from vtpu_manager.overcommit import ratio
+        from vtpu_manager.quota import victimcost
+        from vtpu_manager.telemetry import pressure
+        from vtpu_manager.util import stalecodec
+        from vtpu_manager.utilization import headroom
+        for mod in (pressure, headroom, ratio, advertise, victimcost):
+            assert mod.FUTURE_SKEW_TOLERANCE_S is \
+                stalecodec.FUTURE_SKEW_TOLERANCE_S
+
+
+# ---------------------------------------------------------------------------
+# gate-off contracts
+# ---------------------------------------------------------------------------
+
+class TestGateContracts:
+    def test_collector_gate_off_no_series_no_spools(self, tmp_path):
+        from vtpu_manager.metrics.collector import NodeCollector
+        base = str(tmp_path / "mgr")
+        os.makedirs(base)
+        mk_ring(base, "uid-1", STEADY[:8])
+        off = NodeCollector("n1", [], base_dir=base,
+                            tc_path=str(tmp_path / "no.tc"),
+                            vmem_path=str(tmp_path / "no.vmem"))
+        text = off.render()
+        assert "vtpu_tenant_goodput_ratio" not in text
+        assert "vtpu_tenant_overhead_seconds" not in text
+        assert "vtpu_slo_regressions_total" not in text
+        assert 'feed="slo"' not in text
+        assert off.slo_ledger is None
+        assert not os.path.isdir(os.path.join(base, "slo"))
+
+    def test_collector_gate_on_series(self, tmp_path):
+        from vtpu_manager.metrics.collector import NodeCollector
+        base = str(tmp_path / "mgr")
+        os.makedirs(base)
+        mk_ring(base, "uid-1", STEADY[:8])
+        on = NodeCollector("n1", [], base_dir=base,
+                           tc_path=str(tmp_path / "no.tc"),
+                           vmem_path=str(tmp_path / "no.vmem"),
+                           slo_enabled=True)
+        text = on.render()
+        assert 'vtpu_tenant_goodput_ratio{node="n1",' \
+            'pod_uid="uid-1"' in text
+        assert 'component="throttle"' in text
+        assert 'vtpu_slo_regressions_total{node="n1",' \
+            'kind="throttle-spike"} 0' in text
+        assert 'feed="slo"' in text
+
+    def test_rollup_gate_off_byte_identical_document(self, tmp_path):
+        from vtpu_manager.utilization.ledger import UtilizationLedger
+        from vtpu_manager.utilization.rollup import ClusterRollup
+        base = str(tmp_path / "mgr")
+        os.makedirs(base)
+        mk_ring(base, "uid-1", STEADY[:8])
+        now = time.time()
+        led = UtilizationLedger("n1", [], base_dir=base)
+        doc_off = ClusterRollup(led, client=None).collect(now=now)
+        assert "slo" not in doc_off
+        assert "slo" not in doc_off["node"]
+        assert not any("goodput_ratio" in t
+                       for t in doc_off["tenants"])
+        slo_led = slo.SloLedger("n1", base_dir=base,
+                                start_flusher=False)
+        doc_on = ClusterRollup(led, client=None,
+                               slo_ledger=slo_led).collect(now=now)
+        assert "slo" in doc_on and "slo" in doc_on["node"]
+        # minus the slo keys, the documents agree
+        stripped = {k: v for k, v in doc_on.items() if k != "slo"}
+        node_stripped = {k: v for k, v in doc_on["node"].items()
+                         if k != "slo"}
+        stripped["node"] = node_stripped
+        for row in stripped["tenants"]:
+            row.pop("goodput_ratio", None)
+        # the ledger fold's own wall time is timing noise, not wire
+        stripped["node"].pop("last_fold_s", None)
+        off_cmp = dict(doc_off, node={
+            k: v for k, v in doc_off["node"].items()
+            if k != "last_fold_s"})
+        assert stripped == off_cmp
+
+    def test_smi_renders_goodput_and_headline(self, tmp_path):
+        doc = {
+            "cluster": {"nodes": 1, "chips": 1,
+                        "reclaimable_core_pct": 0,
+                        "nodes_with_signal": 1},
+            "node": {},
+            "nodes": [],
+            "slo": {"tenants": 1, "tenants_with_signal": 1,
+                    "goodput_mean": 0.8123, "goodput_min": 0.8123,
+                    "regressions": 2},
+            "tenants": [{"pod_uid": "u1", "pod_name": "p1",
+                         "container": "main", "node": "n1",
+                         "chip_index": 0, "allocated_core_pct": 50,
+                         "used_core_pct": 30.0, "live": True,
+                         "goodput_ratio": 0.8123}],
+            "errors": [],
+        }
+        p = tmp_path / "doc.json"
+        p.write_text(json.dumps(doc))
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/vtpu_smi.py"),
+             "--from-file", str(p)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "SLO:" in out.stdout and "81.2% mean" in out.stdout
+        assert "goodput" in out.stdout
+        assert "81.2%" in out.stdout
+        # a gate-off document renders the pre-vtslo table
+        doc.pop("slo")
+        doc["tenants"][0].pop("goodput_ratio")
+        p.write_text(json.dumps(doc))
+        out2 = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/vtpu_smi.py"),
+             "--from-file", str(p)],
+            capture_output=True, text=True, timeout=60)
+        assert "SLO:" not in out2.stdout
+        assert "goodput" not in out2.stdout
+
+
+# ---------------------------------------------------------------------------
+# doctor: verdict shapes + the CLI
+# ---------------------------------------------------------------------------
+
+class TestDoctor:
+    def test_no_records_404(self, tmp_path):
+        st, docd = doctor.why_slow_offline(str(tmp_path), "nope")
+        assert st == 404 and docd["verdict"] == "no-records"
+
+    def test_healthy(self, tmp_path):
+        base = str(tmp_path)
+        mk_ring(base, "uid-ok", STEADY)
+        st, docd = doctor.why_slow_offline(base, "uid-ok")
+        assert st == 200 and docd["verdict"] == "healthy"
+
+    def test_regressed_with_cause(self, tmp_path):
+        base = str(tmp_path)
+        now = time.time()
+        ledger = QuotaLeaseLedger(base, clock=lambda: now)
+        lease, _ = ledger.grant(0, "uid-l/main", "uid-slow/main", 20,
+                                30.0, now - 60.0)
+        ledger.settle([lease["id"]], "revoked", now - 5.0)
+        mk_ring(base, "uid-slow", STEADY + [
+            dict(duration_ns=18_000_000,
+                 throttle_wait_ns=8_600_000)] * 64)
+        st, docd = doctor.why_slow_offline(base, "uid-slow",
+                                           quota_dir=base)
+        assert st == 200 and docd["verdict"] == "regressed"
+        assert lease["id"] in docd["summary"]
+        lines = doctor.format_verdict(docd)
+        assert any("throttle" in ln for ln in lines)
+
+    def test_stale_from_document(self):
+        docd = {"tenants": [{"pod_uid": "u1", "container": "main",
+                             "trace_id": "", "goodput_ratio": 0.5,
+                             "stale": True}],
+                "verdicts": []}
+        st, out = doctor.why_slow_from_document(docd, "u1")
+        assert st == 200 and out["verdict"] == "stale"
+
+    def test_cli_why_slow_offline(self, tmp_path):
+        base = str(tmp_path)
+        mk_ring(base, "uid-cli", STEADY + [
+            dict(duration_ns=17_000_000, comm_time_ns=6_400_000,
+                 collective_count=2)] * 64)
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/vtpu_explain.py"),
+             "--why-slow", "uid-cli", "--base-dir", base, "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr + out.stdout
+        docd = json.loads(out.stdout)
+        assert docd["verdict"] == "regressed"
+        assert any(v["kind"] == "comm-inflation"
+                   for v in docd["regressions"])
+        missing = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/vtpu_explain.py"),
+             "--why-slow", "uid-none", "--base-dir", base],
+            capture_output=True, text=True, timeout=60)
+        assert missing.returncode == 1
+
+    def test_vtrace_splice(self, tmp_path):
+        """--pod splices the component decomposition (JSON block) when
+        a timeline and a ring share the pod uid."""
+        from vtpu_manager.trace.recorder import Span, SpanRecorder
+        base = str(tmp_path / "mgr")
+        spool = str(tmp_path / "trace")
+        os.makedirs(base)
+        mk_ring(base, "uid-tr", STEADY[:16], trace_id="tr-uid-tr")
+        recd = SpanRecorder("scheduler", spool)
+        recd.record(Span(stage="scheduler.filter", trace_id="tr-uid-tr",
+                         pod_uid="uid-tr", start_s=1.0, dur_s=0.1))
+        recd.flush()
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/vtrace.py"),
+             "--pod", "uid-tr", "--spool-dir", spool,
+             "--steps-dir", base, "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr + out.stdout
+        docd = json.loads(out.stdout)
+        assert docd["slo"], "slo splice missing"
+        assert docd["slo"][0]["components_frac"]["compute"] > 0.9
+
+
+# ---------------------------------------------------------------------------
+# the live monitor: /slo route (gate on), 404 (gate off)
+# ---------------------------------------------------------------------------
+
+class TestMonitorSloRoute:
+    @staticmethod
+    def _free_port():
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    @staticmethod
+    def _wait_healthy(port, proc, deadline_s=30):
+        import urllib.request
+        t0 = time.time()
+        while time.time() - t0 < deadline_s:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"monitor exited rc={proc.returncode}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=1) as r:
+                    if r.status == 200:
+                        return
+            except OSError:
+                time.sleep(0.2)
+        raise AssertionError("monitor never became healthy")
+
+    def _run(self, tmp_path, gate_on):
+        port = self._free_port()
+        base = str(tmp_path / "mgr")
+        os.makedirs(base, exist_ok=True)
+        mk_ring(base, "uid-e2e", STEADY + [
+            dict(duration_ns=18_000_000,
+                 throttle_wait_ns=8_600_000)] * 64)
+        argv = [sys.executable,
+                os.path.join(REPO, "cmd/device_monitor.py"),
+                "--port", str(port), "--host", "127.0.0.1",
+                "--node-name", "node-1", "--fake-chips", "1",
+                "--base-dir", base,
+                "--tc-path", str(tmp_path / "none.tc"),
+                "--vmem-path", str(tmp_path / "none.vmem"),
+                "--trace-spool-dir", str(tmp_path / "spool")]
+        if gate_on:
+            argv += ["--feature-gates", "SLOAttribution=true"]
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        return port, proc
+
+    def test_slo_route_and_doctor_cut(self, tmp_path):
+        import urllib.request
+        port, proc = self._run(tmp_path, gate_on=True)
+        try:
+            self._wait_healthy(port, proc)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/slo", timeout=10) as r:
+                docd = json.loads(r.read().decode())
+            assert docd["node"] == "node-1"
+            rows = {t["pod_uid"]: t for t in docd["tenants"]}
+            assert "uid-e2e" in rows
+            assert rows["uid-e2e"]["goodput_ratio"] < 0.85
+            # ?pod= cut: the doctor verdict for one pod
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/slo?pod=uid-e2e",
+                    timeout=10) as r:
+                verdict = json.loads(r.read().decode())
+            assert verdict["verdict"] in ("regressed", "healthy")
+            # the scrape carries the new families
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as r:
+                metrics = r.read().decode()
+            assert "vtpu_tenant_goodput_ratio{" in metrics
+            assert "vtpu_slo_regressions_total{" in metrics
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_gate_off_no_route_no_series(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        port, proc = self._run(tmp_path, gate_on=False)
+        try:
+            self._wait_healthy(port, proc)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/slo", timeout=10)
+            assert err.value.code == 404
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as r:
+                metrics = r.read().decode()
+            assert "vtpu_tenant_goodput_ratio" not in metrics
+            assert "vtpu_slo_" not in metrics
+            # no history spools appear under the base dir either
+            assert not os.path.isdir(
+                os.path.join(str(tmp_path / "mgr"), "slo"))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# quota satellite (a): grant_step scaled by borrowed-vs-used
+# ---------------------------------------------------------------------------
+
+class FakeState:
+    def __init__(self, uid, cont, chip, used, var, wait, reclaim,
+                 conf=1.0):
+        self.pod_uid, self.container, self.host_index = uid, cont, chip
+        self.used_ewma, self.used_var, self.wait_frac = used, var, wait
+        self._reclaim, self._conf = reclaim, conf
+
+    def confidence(self, now):
+        return self._conf
+
+    def reclaim_core_pct(self, now):
+        return self._reclaim * self._conf
+
+
+class FakeUtil:
+    def __init__(self, states):
+        self.states = states
+
+    def fold(self, **kw):
+        pass
+
+    def tenants(self):
+        return self.states
+
+
+def write_tenant(base, uid, cls, hard, chip=0, cont="main"):
+    d = os.path.join(base, f"{uid}_{cont}", "config")
+    cfg = vc.VtpuConfig(
+        pod_uid=uid, container_name=cont, workload_class=cls,
+        devices=[vc.DeviceConfig(
+            uuid=f"TPU-{chip}", total_memory=1 << 30,
+            real_memory=1 << 30, hard_core=hard,
+            core_limit=vc.CORE_LIMIT_HARD, host_index=chip)])
+    vc.write_config(os.path.join(d, "vtpu.config"), cfg)
+
+
+class TestGrantStepFeedback:
+    def test_verdict_formula(self):
+        assert borrowed_used_verdict(55.0, 40, 20) == 15.0
+        assert borrowed_used_verdict(70.0, 40, 20) == 20.0   # clamped
+        assert borrowed_used_verdict(35.0, 40, 20) == 0.0
+        assert borrowed_used_verdict(None, 40, 20) is None
+        assert borrowed_used_verdict(55.0, None, 20) is None
+        assert borrowed_used_verdict(55.0, 40, 0) is None
+
+    def test_scaled_step_matrix(self):
+        # well-used doubles toward max_borrow
+        assert scaled_grant_step(10, 10, 40, 52.0, 40, 10) == (20, 1.0)
+        assert scaled_grant_step(30, 10, 40, 80.0, 40, 35) == (40, 1.0)
+        # unused halves + earlier expiry
+        assert scaled_grant_step(10, 10, 40, 40.0, 40, 10) == (5, 0.5)
+        assert scaled_grant_step(1, 10, 40, 40.0, 40, 10) == (1, 0.5)
+        # in between holds; no verdict resets to base
+        assert scaled_grant_step(20, 10, 40, 44.0, 40, 10) == (20, 1.0)
+        assert scaled_grant_step(20, 10, 40, None, 40, 10) == (10, 1.0)
+        assert scaled_grant_step(20, 10, 40, 50.0, 40, 0) == (10, 1.0)
+
+    def _market(self, tmp_path, borrower_used):
+        base = str(tmp_path)
+        write_tenant(base, "train", vc.WORKLOAD_CLASS_THROUGHPUT, 60)
+        write_tenant(base, "infer", vc.WORKLOAD_CLASS_LATENCY, 40)
+        util = FakeUtil([
+            FakeState("train", "main", 0, 10.0, 0.25, 0.0, 60.0),
+            FakeState("infer", "main", 0, borrower_used, 1.0, 0.6,
+                      0.0)])
+        return QuotaMarketManager("node-t", base, util), base
+
+    def test_well_used_borrower_step_grows(self, tmp_path):
+        m, base = self._market(tmp_path, borrower_used=55.0)
+        m.tick()
+        first = QuotaLeaseLedger(base).active()
+        assert [l["pct"] for l in first] == [10]     # base step
+        m.tick()
+        leases = sorted(QuotaLeaseLedger(base).active(),
+                        key=lambda l: l["granted_at"])
+        # borrowed 10, used 55-40=15 -> clamped 10/10 = well-used:
+        # the second grant's step doubled
+        assert [l["pct"] for l in leases] == [10, 20]
+        assert leases[1]["ttl_s"] == m.lease_ttl_s
+
+    def test_unused_borrower_step_shrinks_and_expires_earlier(
+            self, tmp_path):
+        m, base = self._market(tmp_path, borrower_used=40.0)
+        m.tick()
+        m.tick()
+        leases = sorted(QuotaLeaseLedger(base).active(),
+                        key=lambda l: l["granted_at"])
+        # borrowed 10, used 0 of it: halved step, halved TTL
+        assert [l["pct"] for l in leases] == [10, 5]
+        assert leases[1]["ttl_s"] == m.lease_ttl_s / 2
+
+    def test_replay_from_recorded_ledger(self, tmp_path):
+        """The step the market chose is re-derivable from the recorded
+        ledger + the recorded utilization rows alone — the same pure
+        functions, replayed (quota item (d)'s evidence contract)."""
+        m, base = self._market(tmp_path, borrower_used=55.0)
+        m.tick()
+        m.tick()
+        leases = sorted(QuotaLeaseLedger(base).leases(),
+                        key=lambda l: l["granted_at"])
+        # recorded evidence: lease 1's pct was active when lease 2 was
+        # granted; the borrower's recorded used/base rows
+        borrowed_before = leases[0]["pct"]
+        used, base_alloc = 55.0, 40
+        step, ttl_factor = scaled_grant_step(
+            m.grant_step_pct, m.grant_step_pct, m.max_borrow_pct,
+            used, base_alloc, borrowed_before)
+        assert leases[1]["pct"] == min(step, 40 - borrowed_before,
+                                       60 - borrowed_before - 5)
+        assert leases[1]["ttl_s"] == m.lease_ttl_s * ttl_factor
+
+    def test_conservation_invariant_untouched(self, tmp_path):
+        from vtpu_manager.quota.market import sum_effective_by_chip
+        m, base = self._market(tmp_path, borrower_used=55.0)
+        for _ in range(6):
+            m.tick()
+            for chip, total in sum_effective_by_chip(base).items():
+                assert total <= 100, (chip, total)

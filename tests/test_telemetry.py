@@ -214,7 +214,7 @@ class TestStepRing:
         """The committed offsets (consumed by the C++ mirror's
         static_asserts and the ABI golden) match the live fmt strings."""
         assert stepring.HEADER_SIZE == 80
-        assert stepring.RECORD_SIZE == 96     # v3: +24B comm block
+        assert stepring.RECORD_SIZE == 104    # v4: +8B spill-fill time
         assert stepring.HEADER_OFFSETS["writes"] == 24
         assert stepring.HEADER_OFFSETS["trace_id"] == 32
         assert stepring.RECORD_OFFSETS["flags"] == 48

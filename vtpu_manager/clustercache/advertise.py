@@ -43,13 +43,13 @@ from dataclasses import dataclass
 
 from vtpu_manager.compilecache.keys import sanitize_fingerprint
 from vtpu_manager.resilience import failpoints
-from vtpu_manager.util import consts
+from vtpu_manager.util import consts, stalecodec
 
 log = logging.getLogger(__name__)
 
 # staleness family constants (pressure/headroom/overcommit values)
 MAX_AD_AGE_S = 120.0
-FUTURE_SKEW_TOLERANCE_S = 5.0
+FUTURE_SKEW_TOLERANCE_S = stalecodec.FUTURE_SKEW_TOLERANCE_S
 
 # bound on advertised pairs: the annotation must stay registry-channel
 # sized; 8 hottest keys cover a node's live program set (a node serves
@@ -114,7 +114,7 @@ class NodeWarmKeys:
 
     def encode(self) -> str:
         body = ",".join(f"{fp}={key}" for fp, key in self.pairs)
-        return f"{self.endpoint}|{body}@{self.ts:.3f}"
+        return stalecodec.stamp(f"{self.endpoint}|{body}", self.ts)
 
 
 def parse_warm_keys(raw: str | None, now: float | None = None,
@@ -123,19 +123,11 @@ def parse_warm_keys(raw: str | None, now: float | None = None,
     """Decode the annotation; None when absent, malformed, or stale —
     every bad shape degrades to no-signal, never to phantom warmth the
     scheduler would chase or the fetcher would dial."""
-    if not raw or len(raw) > MAX_AD_LEN:
+    split = stalecodec.split_stamp(raw, max_len=MAX_AD_LEN)
+    if split is None:
         return None
-    body, sep, ts_raw = raw.rpartition("@")
-    if not sep:
-        return None
-    try:
-        ts = float(ts_raw)
-    except (TypeError, ValueError):
-        return None
-    if not math.isfinite(ts):
-        return None
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now, max_age_s):
         return None
     endpoint, sep, pairs_raw = body.partition("|")
     if not sep:
@@ -163,8 +155,7 @@ def warm_is_fresh(warm: "NodeWarmKeys | None",
                   now: float | None = None) -> bool:
     if warm is None:
         return False
-    now = time.time() if now is None else now
-    return -FUTURE_SKEW_TOLERANCE_S <= now - warm.ts <= MAX_AD_AGE_S
+    return stalecodec.is_fresh(warm.ts, now, MAX_AD_AGE_S)
 
 
 def warm_term(warm: "NodeWarmKeys | None", fingerprint: str,

@@ -75,7 +75,9 @@ class NodeCollector:
                  utilization_enabled: bool = False,
                  overcommit_enabled: bool = False,
                  spill_dir: str = consts.SPILL_DIR,
-                 comm_enabled: bool = False):
+                 comm_enabled: bool = False,
+                 slo_enabled: bool = False,
+                 quota_dir: str | None = None):
         self.node_name = node_name
         self.chips = chips
         self.base_dir = base_dir
@@ -141,6 +143,21 @@ class NodeCollector:
         # ZERO vtuse series even when the ledger object exists for the
         # overcommit fold
         self.utilization_enabled = utilization_enabled
+        # vtslo (SLOAttribution gate; off = no ledger object, no
+        # vtpu_tenant_goodput_*/vtpu_tenant_overhead_*/vtpu_slo_*
+        # series, no history spools, no feed label — the gate-off
+        # contract). On, every scrape folds the tenant rings through
+        # the attribution plane with the SloLedger's OWN cursors (the
+        # market-manager rule: the vtuse ledger's cursors are never
+        # raced by a second consumer).
+        self.slo_enabled = slo_enabled
+        self.slo_ledger = None
+        if slo_enabled:
+            from vtpu_manager.slo import SloLedger
+            self.slo_ledger = SloLedger(
+                node_name, base_dir=base_dir,
+                quota_dir=quota_dir or base_dir)
+            self._feed_errors["slo"] = 0.0
 
     def _kubelet_view(self, force: bool = False
                       ) -> pod_resources.KubeletView:
@@ -604,6 +621,21 @@ class NodeCollector:
                 f"{self.util_ledger.fill_events_total}",
             ]
             text += "\n".join(lines) + "\n"
+        # vtslo: the attribution fold + goodput/overhead/regression
+        # series (SLOAttribution on only — gate off has no ledger
+        # object and this block is one None check). A failed fold flags
+        # the slo feed error and keeps serving; the detectors' own
+        # staleness rule is what prevents stale claims.
+        if self.slo_ledger is not None:
+            self._feed_errors["slo"] = 0.0
+            try:
+                if self.slo_ledger.fold():
+                    self._feed_errors["slo"] = 1.0
+            except Exception:  # noqa: BLE001 — any fold failure must
+                # cost the feed flag, never the scrape
+                self._feed_errors["slo"] = 1.0
+                log.warning("slo ledger fold failed", exc_info=True)
+            text += self.slo_ledger.render()
         # self-observability: the scrape's own duration and per-feed
         # last-error flags, rendered last so a wedged feed still reports
         self._last_scrape_s = time.perf_counter() - t0

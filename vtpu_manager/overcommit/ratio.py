@@ -29,15 +29,14 @@ Two consumers, two disciplines:
 from __future__ import annotations
 
 import math
-import time
 from dataclasses import dataclass, field
 
-from vtpu_manager.util import consts
+from vtpu_manager.util import consts, stalecodec
 
 # a policy rollup older than this reads as no-signal => ratio 1.0
 # (publisher cadence is seconds; the pressure/headroom constant family)
 MAX_OVERCOMMIT_AGE_S = 120.0
-FUTURE_SKEW_TOLERANCE_S = 5.0
+FUTURE_SKEW_TOLERANCE_S = stalecodec.FUTURE_SKEW_TOLERANCE_S
 
 # hard bound on any published ratio: even a unanimous working-set
 # signal never oversells a chip more than 4x (the bench's density
@@ -70,8 +69,9 @@ class NodeOvercommit:
     def encode(self) -> str:
         body = ";".join(f"{k}:{r:.2f}"
                         for k, r in sorted(self.ratios.items()))
-        return (f"{body}|{self.spill_frac:.4f}:{self.spilled_bytes}"
-                f"@{self.ts:.3f}")
+        return stalecodec.stamp(
+            f"{body}|{self.spill_frac:.4f}:{self.spilled_bytes}",
+            self.ts)
 
     def max_ratio(self) -> float:
         return max(self.ratios.values(), default=1.0)
@@ -83,19 +83,11 @@ def parse_overcommit(raw: str | None, now: float | None = None,
     """Decode the annotation; None when absent, malformed, or stale —
     every bad shape degrades to no-signal (ratio 1.0 everywhere), never
     to a wrong oversubscription claim."""
-    if not raw:
+    split = stalecodec.split_stamp(raw)
+    if split is None:
         return None
-    body, sep, ts_raw = raw.rpartition("@")
-    if not sep:
-        return None
-    try:
-        ts = float(ts_raw)
-    except (TypeError, ValueError):
-        return None
-    if not math.isfinite(ts):
-        return None
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now, max_age_s):
         return None
     classes, sep, spill_raw = body.rpartition("|")
     if not sep:
@@ -129,8 +121,7 @@ def parse_overcommit(raw: str | None, now: float | None = None,
 def _fresh(oc: "NodeOvercommit | None", now: float | None) -> bool:
     if oc is None:
         return False
-    now = time.time() if now is None else now
-    return -FUTURE_SKEW_TOLERANCE_S <= now - oc.ts <= MAX_OVERCOMMIT_AGE_S
+    return stalecodec.is_fresh(oc.ts, now, MAX_OVERCOMMIT_AGE_S)
 
 
 def ratio_for_class(oc: "NodeOvercommit | None", workload_class: str,

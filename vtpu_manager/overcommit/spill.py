@@ -50,6 +50,45 @@ class SpillBudgetError(RuntimeError):
     """The node's host-RAM spill budget cannot absorb this demotion."""
 
 
+# ---------------------------------------------------------------------------
+# Execute-output shape capture (vtovc item (b)): the Python mirror of
+# vtpu_config.h SpillLogicalBytes / SpillShapeCaptureOk — the rule
+# deciding whether an observed (dims, element-type) pair is a SAFE
+# spill-recipe. Asserted identical cross-language by the g++ probe in
+# tests/test_config_abi.py; the density bench classifies its simulated
+# activation buffers with this exact predicate.
+# ---------------------------------------------------------------------------
+
+_SPILL_BYTES_CAP = 9_000_000_000_000_000_000
+
+
+def spill_logical_bytes(dims, elem_bytes: int) -> int:
+    """Logical byte size a (dims, element-size) recipe implies; 0 when
+    the shape is no recipe at all (zero/negative dim, non-positive
+    element size, or int64 overflow) — mirror of the C++ helper."""
+    if elem_bytes <= 0:
+        return 0
+    elems = 1
+    for d in dims or ():
+        d = int(d)
+        if d <= 0:
+            return 0
+        if elems > _SPILL_BYTES_CAP // d:
+            return 0
+        elems *= d
+    if elems > _SPILL_BYTES_CAP // elem_bytes:
+        return 0
+    return elems * elem_bytes
+
+
+def spill_shape_capture_ok(logical_bytes: int,
+                           on_device_bytes: int) -> bool:
+    """Whether the captured shape may mark a buffer SPILLABLE: only
+    when the logical size equals the on-device size — a padded/tiled
+    layout spilled as a flat host copy would refill wrong."""
+    return logical_bytes > 0 and logical_bytes == on_device_bytes
+
+
 def _pool_name(token: int, pid: int, host_index: int, buf_id: str) -> str:
     return f"{token:016x}-{pid}-{host_index}-{buf_id}{SPILL_SUFFIX}"
 

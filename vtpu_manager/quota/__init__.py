@@ -22,8 +22,6 @@ score change, configs carry the zero bytes the pre-v3 layout carried.
 
 from __future__ import annotations
 
-import math
-
 from vtpu_manager.config import vtpu_config as vc
 from vtpu_manager.quota.ledger import (QuotaLeaseLedger, STATE_EXPIRED,
                                        STATE_GRANTED, STATE_REVOKED,
@@ -68,20 +66,12 @@ def parse_lease_summary(raw: str | None, now: float | None = None,
     ``{chip: {"lent_core_pct": int, "leases": int}}``; None when
     absent, malformed, or stale — every bad shape degrades to
     no-signal, never to a wrong lent/borrowed claim."""
-    import time as _time
-    if raw is None:
+    from vtpu_manager.util import stalecodec
+    split = stalecodec.split_stamp(raw)
+    if split is None:
         return None
-    body, sep, ts_raw = raw.rpartition("@")
-    if not sep:
-        return None
-    try:
-        ts = float(ts_raw)
-    except (TypeError, ValueError):
-        return None
-    if not math.isfinite(ts):
-        return None
-    now = _time.time() if now is None else now
-    if not -5.0 <= now - ts <= max_age_s:
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now, max_age_s):
         return None
     out: dict[int, dict] = {}
     for seg in body.split(";"):

@@ -64,6 +64,55 @@ REVOKE_MARGIN_PCT = 2.0
 # oscillates lease-on/lease-off every pass)
 GRANT_HEADROOM_PCT = 5.0
 
+# vtslo-PR quota satellite (ROADMAP item (d), the feedback leg): the
+# borrowed-vs-used verdict thresholds scaling the NEXT grant's step.
+# A borrower measurably using >= WELL_USED of what it borrowed earns a
+# doubled step (toward max_borrow — the evidence says the demand is
+# real); one using < UNUSED gets a halved step AND a halved TTL
+# (earlier expiry — borrowed-but-idle quota is exactly what the
+# observe-only PR 14 rows exposed). In between, the step holds. The
+# grant/revoke hysteresis (GRANT_HEADROOM + lender cooldown) and the
+# per-chip <=100% conservation guard are untouched: this scales HOW
+# MUCH is offered, never whether offering is safe.
+WELL_USED_UTILIZATION = 0.6
+UNUSED_UTILIZATION = 0.2
+
+
+def borrowed_used_verdict(used_pct, base_alloc_pct, borrowed_pct):
+    """used-of-borrowed core % — clamp(used - base_alloc, 0, borrowed).
+
+    THE one formula (PR 14's /utilization ``borrowed_used`` rows, the
+    ``vtpu_replay.py --utilization-file`` check, and the grant-step
+    scaling all call it), so a recorded document replays the market's
+    own arithmetic exactly. None = unjudgeable (no live signal)."""
+    if used_pct is None or base_alloc_pct is None:
+        return None
+    borrowed = float(borrowed_pct)
+    if borrowed <= 0:
+        return None
+    return min(max(float(used_pct) - float(base_alloc_pct), 0.0),
+               borrowed)
+
+
+def scaled_grant_step(prev_step: int, base_step: int, max_borrow: int,
+                      used_pct, base_alloc_pct, borrowed_pct
+                      ) -> tuple[int, float]:
+    """(next step pct, ttl factor) from the borrowed-vs-used verdict —
+    pure, so recorded ledgers + utilization documents replay it.
+    ``prev_step`` is the borrower's current step (base_step when it has
+    no history); no verdict (nothing borrowed / no live signal) resets
+    to the base step and full TTL."""
+    used_of = borrowed_used_verdict(used_pct, base_alloc_pct,
+                                    borrowed_pct)
+    if used_of is None:
+        return base_step, 1.0
+    utilization = used_of / float(borrowed_pct)
+    if utilization >= WELL_USED_UTILIZATION:
+        return min(max(prev_step * 2, 1), max_borrow), 1.0
+    if utilization < UNUSED_UTILIZATION:
+        return max(prev_step // 2, 1), 0.5
+    return prev_step, 1.0
+
 
 def effective_core(hard: int, lease: int) -> int:
     """clamp(hard + lease, 0, 100) — the C++ EffectiveCorePct mirror."""
@@ -131,6 +180,11 @@ class QuotaMarketManager:
         # lender must re-prove idleness across passes, not within one)
         self._lender_cooldown: dict[str, float] = {}
         self.cooldown_s = 2.0 * interval_s
+        # borrower -> evidence-scaled grant step (quota item (d)'s
+        # feedback leg): grows toward max_borrow while the borrower
+        # measurably uses what it borrows, shrinks (with earlier
+        # expiry) while it does not — pruned with tenant churn
+        self._borrower_step: dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -163,6 +217,10 @@ class QuotaMarketManager:
         self._lender_cooldown = {k: t for k, t
                                  in self._lender_cooldown.items()
                                  if t > now}
+        # departed borrowers drop their learned step the same way
+        self._borrower_step = {k: v for k, v
+                               in self._borrower_step.items()
+                               if k in tenants}
         self._expire(now)
         # one ledger read per phase (each phase may mutate it): every
         # decision inside a phase sees ONE generation
@@ -254,6 +312,10 @@ class QuotaMarketManager:
         states = {(s.pod_uid, s.container, s.host_index): s
                   for s in self.util.tenants()}
         deltas = view.deltas
+        # quota item (d): the borrowed-vs-used step is scaled AT MOST
+        # ONCE per borrower per tick — a multi-chip borrower must not
+        # compound the doubling/halving once per chip it sits on
+        tick_step: dict[str, tuple[int, float]] = {}
 
         def tenant_state(key: str, chip: int):
             uid, _, label = key.partition("/")
@@ -284,7 +346,7 @@ class QuotaMarketManager:
                                                     delta),
                                self.max_borrow_pct - max(delta, 0))
                     if room > 0:
-                        borrowers.append((t, dev, state, room))
+                        borrowers.append((t, dev, state, room, delta))
                 elif cls == vc.WORKLOAD_CLASS_THROUGHPUT:
                     if state is None:
                         continue
@@ -305,14 +367,31 @@ class QuotaMarketManager:
             # most-stalled borrower first; most-idle lender first
             borrowers.sort(key=lambda b: -b[2].wait_frac)
             lenders.sort(key=lambda l: -l[3])
-            for bt, bdev, bstate, room in borrowers:
+            for bt, bdev, bstate, room, delta in borrowers:
+                # quota item (d) feedback: the borrower's NEXT step is
+                # scaled by whether it measurably used what it already
+                # borrowed (THE shared formula — replayable from a
+                # recorded ledger + utilization document). Unused
+                # borrowers also get a halved TTL: idle borrowed quota
+                # expires back to its lender sooner.
+                if bt.key not in tick_step:
+                    tick_step[bt.key] = scaled_grant_step(
+                        self._borrower_step.get(bt.key,
+                                                self.grant_step_pct),
+                        self.grant_step_pct, self.max_borrow_pct,
+                        bstate.used_ewma
+                        if bstate.confidence(now) > 0 else None,
+                        bdev.hard_core, max(delta, 0))
+                    self._borrower_step[bt.key] = tick_step[bt.key][0]
+                step, ttl_factor = tick_step[bt.key]
+                ttl_s = self.lease_ttl_s * ttl_factor
                 for i, (lt, ldev, lstate, lendable) in \
                         enumerate(lenders):
-                    pct = int(min(self.grant_step_pct, room, lendable))
+                    pct = int(min(step, room, lendable))
                     if pct < 1:
                         continue
                     lease, epoch = self.ledger.grant(
-                        chip, lt.key, bt.key, pct, self.lease_ttl_s,
+                        chip, lt.key, bt.key, pct, ttl_s,
                         now)
                     # crash window: granted in the ledger, not yet in
                     # any config (partial-write tears the ledger); the

@@ -37,12 +37,12 @@ import threading
 import time
 from dataclasses import dataclass
 
-from vtpu_manager.util import consts
+from vtpu_manager.util import consts, stalecodec
 
 log = logging.getLogger(__name__)
 
 MAX_VICTIM_COST_AGE_S = 120.0
-FUTURE_SKEW_TOLERANCE_S = 5.0
+FUTURE_SKEW_TOLERANCE_S = stalecodec.FUTURE_SKEW_TOLERANCE_S
 
 UID_PREFIX_LEN = 12
 
@@ -63,7 +63,7 @@ class NodeVictimCosts:
         body = ";".join(
             f"{uid}:{'l' if leased else '-'}:{frac:.3f}"
             for uid, (leased, frac) in sorted(self.tenants.items()))
-        return f"{body}@{self.ts:.3f}"
+        return stalecodec.stamp(body, self.ts)
 
     def lookup(self, pod_uid: str) -> tuple[bool, float] | None:
         """(holds_lease, spill_frac) for a victim, joined by uid
@@ -78,19 +78,11 @@ def parse_victim_costs(raw: str | None, now: float | None = None,
     """Decode the annotation; None when absent, malformed, or stale —
     the codec-family contract: garbage degrades to no-signal, and
     no-signal degrades the ordering to the priority-only sort."""
-    if not raw or len(raw) > MAX_VC_LEN:
+    split = stalecodec.split_stamp(raw, max_len=MAX_VC_LEN)
+    if split is None:
         return None
-    body, sep, ts_raw = raw.rpartition("@")
-    if not sep:
-        return None
-    try:
-        ts = float(ts_raw)
-    except (TypeError, ValueError):
-        return None
-    if not math.isfinite(ts):
-        return None
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now, max_age_s):
         return None
     tenants: dict = {}
     for seg in body.split(";"):
@@ -122,9 +114,7 @@ def victim_costs_fresh(vc: "NodeVictimCosts | None",
     further node events)."""
     if vc is None:
         return False
-    now = time.time() if now is None else now
-    return -FUTURE_SKEW_TOLERANCE_S <= now - vc.ts \
-        <= MAX_VICTIM_COST_AGE_S
+    return stalecodec.is_fresh(vc.ts, now, MAX_VICTIM_COST_AGE_S)
 
 
 # ---------------------------------------------------------------------------

@@ -222,6 +222,16 @@ def _shim_comm_sources():
     return fns if all(fns) else None
 
 
+def _shim_spill_fill_source():
+    """The shim's cumulative host-tier spill+fill time accessor, or
+    None when the spill tier is unarmed for this pod (no pool env —
+    HBMOvercommit off) or the shim predates the export: the v4 field
+    then stays zero, the zeros-on-the-wire contract."""
+    if not os.environ.get(consts.ENV_SPILL_POOL_DIR):
+        return None
+    return _shim_counter_source("vtpu_spill_fill_ns_total")
+
+
 class _ShimWaitStepRing:
     """StepRingWriter wrapper charging each record the shim's REAL
     token-bucket wait since the previous record. Before this, the
@@ -238,15 +248,23 @@ class _ShimWaitStepRing:
     only honest source. Unarmed, the comm fields stay zeros."""
 
     __slots__ = ("ring", "_wait_total_fn", "_last_wait_ns",
-                 "_comm_fns", "_last_comm")
+                 "_comm_fns", "_last_comm", "_spill_fill_fn",
+                 "_last_spill_fill_ns")
 
-    def __init__(self, ring, wait_total_fn, comm_fns=None):
+    def __init__(self, ring, wait_total_fn, comm_fns=None,
+                 spill_fill_fn=None):
         self.ring = ring
         self._wait_total_fn = wait_total_fn
         self._last_wait_ns = int(wait_total_fn())
         self._comm_fns = comm_fns
         self._last_comm = tuple(int(fn()) for fn in comm_fns) \
             if comm_fns else (0, 0, 0)
+        # vtslo v4: the measured host-tier spill+fill time hides inside
+        # the jitted call exactly like quota stalls — the shim's
+        # counter is the only honest source (None = field stays zero)
+        self._spill_fill_fn = spill_fill_fn
+        self._last_spill_fill_ns = int(spill_fill_fn()) \
+            if spill_fill_fn else 0
 
     @property
     def writes(self) -> int:
@@ -279,12 +297,19 @@ class _ShimWaitStepRing:
             self._last_wait_ns = total
             throttle_wait_ns = max(0, delta)
         comm_ns, comm_bytes, collectives = self._comm_deltas()
+        spill_fill_ns = 0
+        if self._spill_fill_fn is not None:
+            total = int(self._spill_fill_fn())
+            # reloaded-shim re-baseline, the wait-counter rule
+            spill_fill_ns = max(0, total - self._last_spill_fill_ns)
+            self._last_spill_fill_ns = total
         self.ring.record(duration_ns, throttle_wait_ns=throttle_wait_ns,
                          hbm_highwater_bytes=hbm_highwater_bytes,
                          compiled=compiled, start_mono_ns=start_mono_ns,
                          comm_time_ns=comm_ns,
                          bytes_transferred=comm_bytes,
-                         collective_count=collectives)
+                         collective_count=collectives,
+                         spill_fill_time_ns=spill_fill_ns)
 
     def close(self) -> None:
         self.ring.close()
@@ -323,7 +348,8 @@ def step_telemetry():
         wait_fn = _shim_throttle_wait_source()
         if wait_fn is not None:
             _step_telemetry = _ShimWaitStepRing(
-                _step_telemetry, wait_fn, comm_fns=_shim_comm_sources())
+                _step_telemetry, wait_fn, comm_fns=_shim_comm_sources(),
+                spill_fill_fn=_shim_spill_fill_source())
         # clean unmap/unlock on interpreter exit — otherwise the GC'd
         # lock context tears down after Python's import machinery and
         # spams a harmless-but-ugly shutdown traceback

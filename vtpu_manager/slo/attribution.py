@@ -1,0 +1,163 @@
+"""vtslo step-time attribution: one step record -> named components.
+
+The whole plane rests on this decomposition being **pure arithmetic
+over one v4 step record** — no ambient state, no clocks — so a verdict
+is reproducible offline from the ring bytes alone (the vtexplain
+"winner reproducible from the record alone" rule, applied to time):
+
+- ``throttle``  — ``throttle_wait_ns``: wall time stalled in the core /
+  ICI token buckets (the vtqm/vtici planes' measured cost);
+- ``comm``      — ``comm_time_ns``: measured collective + transfer span
+  time (the vtcomm plane);
+- ``spill_fill`` — ``spill_fill_time_ns``: measured host-tier demotion
+  + promotion time (the vtovc plane; v4's new field);
+- ``compile``   — the FLAG_COMPILE step's residual: a first-execute
+  step's non-overhead time is compilation + warm-up (the vtcc plane's
+  cost), so the residual is attributed there, not to compute;
+- ``compute``   — everything left on a non-compile step: the tenant's
+  useful work, the numerator of the **goodput ratio**.
+
+Clamp rule: the overhead fields are measured by different observers and
+may overlap inside one step (a throttled collective counts in both
+buckets), so when their sum exceeds the step duration each is scaled by
+``duration / sum`` — the components always sum EXACTLY to the duration
+and no component is ever negative. The rule is deterministic, so the
+scaled decomposition stays reproducible from the record.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from vtpu_manager.telemetry import stepring
+
+# component names, stable wire order (metrics labels, /slo documents,
+# the vtrace splice and the doctor all use these exact strings)
+COMPONENTS = ("compute", "throttle", "comm", "spill_fill", "compile")
+
+# the overhead components (everything except the residual pair)
+OVERHEAD_COMPONENTS = ("throttle", "comm", "spill_fill", "compile")
+
+
+def attribute(record: "stepring.StepRecord") -> dict[str, int]:
+    """Decompose one step record into per-component nanoseconds.
+
+    Invariants (asserted by test_slo): every value >= 0, and
+    ``sum(components.values()) == record.duration_ns`` exactly.
+    """
+    dur = max(int(record.duration_ns), 0)
+    raw = {
+        "throttle": max(int(record.throttle_wait_ns), 0),
+        "comm": max(int(record.comm_time_ns), 0),
+        "spill_fill": max(int(record.spill_fill_time_ns), 0),
+    }
+    overhead = sum(raw.values())
+    if overhead > dur and overhead > 0:
+        # overlapping observers: scale proportionally into the step
+        # (integer floor keeps the sum <= dur; the remainder goes to
+        # the residual so the total still balances exactly)
+        raw = {k: v * dur // overhead for k, v in raw.items()}
+        overhead = sum(raw.values())
+    residual = dur - overhead
+    out = {"compute": 0, "compile": 0, **raw}
+    if record.compiled:
+        out["compile"] = residual
+    else:
+        out["compute"] = residual
+    return out
+
+
+def goodput_ratio(components: dict[str, int]) -> float:
+    """Useful-compute fraction of one decomposition (or a summed window
+    of them): compute / total. A window that is ALL overhead is 0.0; an
+    empty window has no ratio and reads 1.0 (nothing was lost)."""
+    total = sum(components.values())
+    if total <= 0:
+        return 1.0
+    return components.get("compute", 0) / total
+
+
+@dataclass
+class WindowSample:
+    """One downsampled window of a tenant's step stream — the history
+    ring's unit. Built by :func:`fold_window` from consecutive ring
+    records; every field re-derivable from those records."""
+
+    ts: float = 0.0              # wall stamp of the fold
+    steps: int = 0
+    duration_ns: int = 0         # sum of step durations
+    step_mean_ns: float = 0.0
+    step_p95_ns: int = 0
+    components_ns: dict = None   # component -> summed ns
+    goodput: float = 1.0
+    spill_events: int = 0
+    fill_events: int = 0
+    collectives: int = 0
+    compile_steps: int = 0
+
+    def component_frac(self, name: str) -> float:
+        """The component's share of the window's total step time."""
+        if self.duration_ns <= 0:
+            return 0.0
+        return (self.components_ns or {}).get(name, 0) / self.duration_ns
+
+    def to_wire(self) -> dict:
+        return {
+            "ts": round(self.ts, 3),
+            "steps": self.steps,
+            "step_mean_ns": int(self.step_mean_ns),
+            "step_p95_ns": self.step_p95_ns,
+            "components_ns": dict(self.components_ns or {}),
+            "goodput": round(self.goodput, 4),
+            "spill_events": self.spill_events,
+            "fill_events": self.fill_events,
+            "collectives": self.collectives,
+            "compile_steps": self.compile_steps,
+        }
+
+    @classmethod
+    def from_wire(cls, doc: dict) -> "WindowSample":
+        return cls(
+            ts=float(doc.get("ts", 0.0)),
+            steps=int(doc.get("steps", 0)),
+            duration_ns=sum(int(v) for v in
+                            (doc.get("components_ns") or {}).values()),
+            step_mean_ns=float(doc.get("step_mean_ns", 0.0)),
+            step_p95_ns=int(doc.get("step_p95_ns", 0)),
+            components_ns={str(k): int(v) for k, v in
+                           (doc.get("components_ns") or {}).items()},
+            goodput=float(doc.get("goodput", 1.0)),
+            spill_events=int(doc.get("spill_events", 0)),
+            fill_events=int(doc.get("fill_events", 0)),
+            collectives=int(doc.get("collectives", 0)),
+            compile_steps=int(doc.get("compile_steps", 0)))
+
+
+def fold_window(records: list, ts: float) -> WindowSample | None:
+    """Fold consecutive step records into one WindowSample; None on an
+    empty window (no sample — freshness decay handles silence, the
+    vtuse rule: an empty poll is never a measurement of zero)."""
+    if not records:
+        return None
+    comps = {name: 0 for name in COMPONENTS}
+    durations = []
+    spill_ev = fill_ev = collectives = compile_steps = 0
+    for rec in records:
+        for name, ns in attribute(rec).items():
+            comps[name] += ns
+        durations.append(int(rec.duration_ns))
+        spill_ev += int(rec.spill_events)
+        fill_ev += int(rec.fill_events)
+        collectives += int(rec.collective_count)
+        if rec.compiled:
+            compile_steps += 1
+    durations.sort()
+    dur_sum = sum(durations)
+    p95 = durations[min(len(durations) - 1,
+                        int(0.95 * (len(durations) - 1) + 0.5))]
+    return WindowSample(
+        ts=ts, steps=len(records), duration_ns=dur_sum,
+        step_mean_ns=dur_sum / len(records), step_p95_ns=p95,
+        components_ns=comps, goodput=goodput_ratio(comps),
+        spill_events=spill_ev, fill_events=fill_ev,
+        collectives=collectives, compile_steps=compile_steps)

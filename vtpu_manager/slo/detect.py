@@ -1,0 +1,326 @@
+"""vtslo regression detectors: EWMA+variance over window history.
+
+The vtuse math family applied to the attribution plane: per tenant,
+seed-first EWMA + EWMA-variance of the windowed step-time mean plus an
+EWMA of every component's time share, judged window by window. A
+regression fires when the new window's mean clears BOTH gates —
+
+- **envelope**: ``mean > ewma + K * sigma`` (a steady-but-noisy tenant
+  never trips; variance is its license to wobble), and
+- **relative**: ``mean > ewma * REL_THRESHOLD`` (a near-zero-variance
+  tenant needs a material regression, not a microsecond);
+
+and the verdict is NAMED by the **dominant component**: the component
+whose share of step time grew the most against its own baseline. That
+is what makes the answer "71% of the regression is throttle-wait", not
+"something is slow" — and each name joins the responsible plane's own
+events (:func:`join_cause`) so the verdict carries a cause, not just a
+symptom.
+
+Staleness is explicit (the ledger rule): a tenant silent past the
+budget decays to **no-signal** — its baseline is abandoned and re-seeds
+on revival, because judging a revived tenant against pre-silence state
+would attribute the gap itself as a regression.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+
+from vtpu_manager.slo.attribution import (COMPONENTS, OVERHEAD_COMPONENTS,
+                                          WindowSample)
+
+log = logging.getLogger(__name__)
+
+# the vtuse family constants
+EWMA_ALPHA = 0.3
+SIGMA_K = 2.0
+STALENESS_S = 120.0
+
+# windows of baseline required before any verdict may fire (a tenant's
+# very first windows ARE the baseline — judging them against themselves
+# would be noise)
+MIN_BASELINE_WINDOWS = 3
+
+# relative gate: the new mean must exceed the baseline by this factor
+REL_THRESHOLD = 1.25
+# goodput gate: absolute drop below baseline that counts as a loss
+GOODPUT_DROP_ABS = 0.10
+
+# verdict kind per dominant component (compute-dominant regressions are
+# honest "the work itself got slower" drift — unattributed by design)
+KIND_BY_COMPONENT = {
+    "throttle": "throttle-spike",
+    "spill_fill": "spill-thrash",
+    "comm": "comm-inflation",
+    "compile": "compile-storm",
+    "compute": "step-time-drift",
+}
+KINDS = tuple(KIND_BY_COMPONENT.values()) + ("goodput-drop",)
+
+# which plane a verdict kind indicts (the cause join's address book)
+PLANE_BY_KIND = {
+    "throttle-spike": "quota",
+    "spill-thrash": "overcommit",
+    "comm-inflation": "ici-comm",
+    "compile-storm": "compile-cache",
+    "step-time-drift": "compute",
+    "goodput-drop": "compute",
+}
+
+
+@dataclass
+class Verdict:
+    """One detected regression, attributed."""
+
+    kind: str
+    tenant: str
+    ts: float
+    step_time_ratio: float        # window mean / baseline mean
+    goodput: float
+    baseline_goodput: float
+    dominant: str                 # component that grew the most
+    dominant_share: float         # its share of the window's step time
+    component_delta: dict         # component -> share delta vs baseline
+    cause: dict = field(default_factory=dict)
+    summary: str = ""
+
+    def to_wire(self) -> dict:
+        return {
+            "kind": self.kind, "tenant": self.tenant,
+            "ts": round(self.ts, 3),
+            "step_time_ratio": round(self.step_time_ratio, 3),
+            "goodput": round(self.goodput, 4),
+            "baseline_goodput": round(self.baseline_goodput, 4),
+            "dominant": self.dominant,
+            "dominant_share": round(self.dominant_share, 4),
+            "component_delta": {k: round(v, 4) for k, v
+                                in self.component_delta.items()},
+            "cause": dict(self.cause),
+            "summary": self.summary,
+        }
+
+
+class _TenantBaseline:
+    """EWMA state for one tenant's window stream."""
+
+    __slots__ = ("mean_ewma", "mean_var", "goodput_ewma", "frac_ewma",
+                 "samples", "last_ts", "episode_active")
+
+    def __init__(self) -> None:
+        self.mean_ewma = 0.0
+        self.mean_var = 0.0
+        self.goodput_ewma = 1.0
+        self.frac_ewma = {name: 0.0 for name in COMPONENTS}
+        self.samples = 0
+        self.last_ts = 0.0
+        # one verdict per regression EPISODE: while the condition
+        # persists (the EWMA is still catching up to the new level),
+        # follow-up windows must not re-fire — and must not fire a
+        # DIFFERENT kind off the half-adjusted baseline, which is
+        # where cross-attribution noise would come from
+        self.episode_active = False
+
+    def observe(self, w: WindowSample) -> None:
+        if self.samples == 0:
+            # seed with the first sample (the observe_used rule): a 0
+            # start would read every tenant's warm-up as a regression
+            self.mean_ewma = w.step_mean_ns
+            self.mean_var = 0.0
+            self.goodput_ewma = w.goodput
+            for name in COMPONENTS:
+                self.frac_ewma[name] = w.component_frac(name)
+        else:
+            delta = w.step_mean_ns - self.mean_ewma
+            self.mean_ewma += EWMA_ALPHA * delta
+            self.mean_var = ((1.0 - EWMA_ALPHA) * self.mean_var
+                             + EWMA_ALPHA * delta * delta)
+            self.goodput_ewma += EWMA_ALPHA * (w.goodput
+                                               - self.goodput_ewma)
+            for name in COMPONENTS:
+                self.frac_ewma[name] += EWMA_ALPHA * (
+                    w.component_frac(name) - self.frac_ewma[name])
+        self.samples += 1
+        self.last_ts = w.ts
+
+    def stale(self, now: float) -> bool:
+        return self.samples > 0 and now - self.last_ts > STALENESS_S
+
+
+class RegressionDetector:
+    """Per-tenant window judge. Feed windows in causal order (the
+    history ring's order); verdicts come back attributed."""
+
+    def __init__(self, quota_dir: str | None = None):
+        self.quota_dir = quota_dir
+        self._baselines: dict[str, _TenantBaseline] = {}
+        self.regressions_total: dict[str, int] = {}
+
+    def forget(self, live_tenants: set[str]) -> None:
+        for key in list(self._baselines):
+            if key not in live_tenants:
+                del self._baselines[key]
+
+    def baseline(self, tenant: str) -> _TenantBaseline | None:
+        return self._baselines.get(tenant)
+
+    def observe(self, tenant: str, window: WindowSample,
+                now: float | None = None) -> Verdict | None:
+        """Judge one window against the tenant's baseline, then fold it
+        in. At most ONE verdict per window — named by the dominant
+        component — so an injected cause can never cross-attribute."""
+        now = time.time() if now is None else now
+        base = self._baselines.get(tenant)
+        if base is None:
+            base = self._baselines[tenant] = _TenantBaseline()
+        if base.stale(window.ts):
+            # silence past the budget: no-signal — abandon the old
+            # baseline rather than judging across the gap
+            self._baselines[tenant] = base = _TenantBaseline()
+        verdict = None
+        if base.samples >= MIN_BASELINE_WINDOWS and base.mean_ewma > 0:
+            verdict = self._judge(tenant, window, base)
+        if verdict is None:
+            base.episode_active = False     # clean window ends episode
+        elif base.episode_active:
+            verdict = None                  # mid-episode: one verdict
+        else:
+            base.episode_active = True
+        base.observe(window)
+        if verdict is not None:
+            self.regressions_total[verdict.kind] = \
+                self.regressions_total.get(verdict.kind, 0) + 1
+        return verdict
+
+    def _judge(self, tenant: str, w: WindowSample,
+               base: _TenantBaseline) -> Verdict | None:
+        sigma = math.sqrt(max(base.mean_var, 0.0))
+        envelope = base.mean_ewma + SIGMA_K * sigma
+        regressed = (w.step_mean_ns > envelope
+                     and w.step_mean_ns > base.mean_ewma * REL_THRESHOLD)
+        goodput_lost = (w.goodput
+                        < base.goodput_ewma - GOODPUT_DROP_ABS)
+        if not regressed and not goodput_lost:
+            return None
+        delta = {name: w.component_frac(name) - base.frac_ewma[name]
+                 for name in COMPONENTS}
+        if regressed:
+            # the dominant component is the one whose SHARE of step
+            # time grew the most; overhead components win ties against
+            # compute (an unchanged-compute step that got slower is an
+            # overhead story whenever any overhead grew at all)
+            dominant = max(
+                COMPONENTS,
+                key=lambda n: (delta[n],
+                               n in OVERHEAD_COMPONENTS))
+            kind = KIND_BY_COMPONENT[dominant]
+        else:
+            # goodput fell without the step slowing: overhead displaced
+            # compute inside the same wall time
+            dominant = max(OVERHEAD_COMPONENTS, key=lambda n: delta[n])
+            kind = "goodput-drop"
+        ratio = w.step_mean_ns / base.mean_ewma if base.mean_ewma else 1.0
+        verdict = Verdict(
+            kind=kind, tenant=tenant, ts=w.ts,
+            step_time_ratio=ratio, goodput=w.goodput,
+            baseline_goodput=base.goodput_ewma,
+            dominant=dominant,
+            dominant_share=w.component_frac(dominant),
+            component_delta=delta,
+            cause=join_cause(kind, tenant, w,
+                             quota_dir=self.quota_dir, now=w.ts))
+        verdict.summary = summarize(verdict)
+        return verdict
+
+
+# how far back a plane event may be and still "coincide" with the
+# window that regressed (publisher cadences are seconds; two market
+# passes is a generous join window)
+CAUSE_JOIN_WINDOW_S = 600.0
+
+
+def join_cause(kind: str, tenant: str, window: WindowSample,
+               quota_dir: str | None = None,
+               now: float | None = None) -> dict:
+    """Join the verdict to the responsible plane's own events — the
+    difference between "throttle-wait rose" and "coincides with quota
+    revoke lease q42-0-3". Every join degrades gracefully: a missing or
+    torn plane source yields the plane name with no event, never an
+    error (the verdict is still correct, just less specific)."""
+    now = time.time() if now is None else now
+    cause: dict = {"plane": PLANE_BY_KIND.get(kind, "unknown")}
+    if kind == "throttle-spike" and quota_dir:
+        try:
+            from vtpu_manager.quota.ledger import (STATE_GRANTED,
+                                                   QuotaLeaseLedger)
+            uid = tenant.partition("/")[0]
+            events = []
+            for lease in QuotaLeaseLedger(quota_dir).leases():
+                if not str(lease.get("borrower", "")).startswith(uid):
+                    continue
+                if lease.get("state") == STATE_GRANTED:
+                    continue
+                age = now - float(lease.get("updated_at", 0.0))
+                if 0 <= age <= CAUSE_JOIN_WINDOW_S:
+                    events.append(lease)
+            if events:
+                events.sort(key=lambda l: -float(
+                    l.get("updated_at", 0.0)))
+                ev = events[0]
+                cause.update({
+                    "event": ev.get("state"),
+                    "lease_id": ev.get("id"),
+                    "lease_pct": ev.get("pct"),
+                    "chip": ev.get("chip"),
+                    "epoch": ev.get("epoch"),
+                    "event_age_s": round(
+                        now - float(ev.get("updated_at", 0.0)), 1),
+                })
+        except Exception:  # noqa: BLE001 — a torn lease ledger costs
+            # the join specificity only, never the verdict
+            log.warning("slo cause join: quota ledger unreadable",
+                        exc_info=True)
+    elif kind == "spill-thrash":
+        cause.update({"spill_events": window.spill_events,
+                      "fill_events": window.fill_events,
+                      "spill_fill_ms": round(
+                          (window.components_ns or {}).get(
+                              "spill_fill", 0) / 1e6, 2)})
+    elif kind == "comm-inflation":
+        cause.update({"collectives": window.collectives,
+                      "comm_ms": round(
+                          (window.components_ns or {}).get(
+                              "comm", 0) / 1e6, 2)})
+    elif kind == "compile-storm":
+        cause.update({"compile_steps": window.compile_steps,
+                      "compile_ms": round(
+                          (window.components_ns or {}).get(
+                              "compile", 0) / 1e6, 2)})
+    return cause
+
+
+def summarize(v: Verdict) -> str:
+    """The doctor's one-liner: 'step mean +38%: 71% throttle-wait,
+    coincides with quota revoke lease q12-0-3'."""
+    pct = (v.step_time_ratio - 1.0) * 100.0
+    head = (f"step mean {pct:+.0f}%" if v.kind != "goodput-drop"
+            else f"goodput {v.baseline_goodput:.2f} -> {v.goodput:.2f}")
+    comp = f"{v.dominant_share * 100:.0f}% {v.dominant.replace('_', '-')}"
+    tail = ""
+    c = v.cause
+    if c.get("lease_id"):
+        tail = (f", coincides with quota {c.get('event', 'revoke')} "
+                f"lease {c['lease_id']} ({c.get('event_age_s', '?')}s "
+                f"ago, epoch {c.get('epoch', '?')})")
+    elif v.kind == "spill-thrash":
+        tail = (f", {c.get('spill_events', 0)} spill/"
+                f"{c.get('fill_events', 0)} fill events in the window")
+    elif v.kind == "comm-inflation":
+        tail = f", {c.get('collectives', 0)} collectives in the window"
+    elif v.kind == "compile-storm":
+        tail = (f", {c.get('compile_steps', 0)} compile-paying step(s) "
+                f"in the window")
+    return f"{head}: {comp}{tail}"

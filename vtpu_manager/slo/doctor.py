@@ -1,0 +1,126 @@
+"""vtslo doctor: "why is my job slow" folded into one ranked verdict.
+
+Two entry points, one verdict shape (the vtexplain doctor discipline —
+the response contract lives HERE, shared by the monitor route and the
+CLI, so they cannot drift):
+
+- :func:`why_slow_from_document` — cut a live ``/slo`` document (the
+  monitor's ledger state) down to one pod's verdict;
+- :func:`why_slow_offline` — no monitor needed: replay the pod's ring
+  resident records through the same attribution + detector math
+  (possible because attribution is pure record arithmetic).
+
+The verdict ranks the pod's recent regressions newest-first, leads with
+the dominant one's summary ("step mean +38%: 71% throttle-wait,
+coincides with quota revoke lease q12-…"), and degrades explicitly:
+no ring/rows -> ("no-records", 404-shaped), steady -> "healthy",
+signal older than the staleness budget -> "stale" (never a live claim
+off a dead writer — the pressure-codec rule).
+"""
+
+from __future__ import annotations
+
+import time
+
+from vtpu_manager.slo import detect, slo_stats_for_pod
+
+
+def _match_row(row: dict, pod_key: str) -> bool:
+    key = pod_key or ""
+    return key in (row.get("pod_uid"), row.get("trace_id")) or \
+        (key and str(row.get("pod_uid", "")).startswith(key))
+
+
+def _verdict_doc(pod_key: str, row: dict, verdicts: list[dict],
+                 now: float, stale: bool) -> dict:
+    verdicts = sorted(verdicts, key=lambda v: -float(v.get("ts", 0.0)))
+    if stale:
+        status, headline = "stale", (
+            "signal is stale (writer silent past the staleness "
+            "budget) — last window is historical, not live")
+    elif verdicts:
+        status, headline = "regressed", verdicts[0].get("summary", "")
+    else:
+        status, headline = "healthy", (
+            f"no regression detected; goodput "
+            f"{row.get('goodput_ratio', 1.0):.2f}")
+    return {
+        "pod": pod_key,
+        "verdict": status,
+        "summary": headline,
+        "goodput_ratio": row.get("goodput_ratio"),
+        "components_frac": row.get("components_frac"),
+        "step_p95_ms": row.get("step_p95_ms"),
+        "regressions": verdicts,
+        "generated_at": now,
+    }
+
+
+def why_slow_from_document(doc: dict, pod_key: str,
+                           now: float | None = None
+                           ) -> tuple[int, dict]:
+    """(http_status, verdict document) off a collected /slo document."""
+    now = time.time() if now is None else now
+    rows = [r for r in (doc.get("tenants") or [])
+            if _match_row(r, pod_key)]
+    if not rows:
+        return 404, {"pod": pod_key, "verdict": "no-records",
+                     "summary": "no SLO signal recorded for this pod "
+                                "(gate off, no telemetry, or never "
+                                "scheduled here)"}
+    row = rows[0]
+    uid = row.get("pod_uid", "")
+    verdicts = [v for v in (doc.get("verdicts") or [])
+                if str(v.get("tenant", "")).startswith(uid)]
+    return 200, _verdict_doc(pod_key, row, verdicts, now,
+                             stale=bool(row.get("stale")))
+
+
+def why_slow_offline(base_dir: str, pod_key: str,
+                     quota_dir: str | None = None,
+                     chunk: int = 16, now: float | None = None
+                     ) -> tuple[int, dict]:
+    """(http-shaped status, verdict) replayed from the ring alone."""
+    now = time.time() if now is None else now
+    rows = slo_stats_for_pod(base_dir, pod_key, chunk=chunk,
+                             quota_dir=quota_dir)
+    if not rows:
+        return 404, {"pod": pod_key, "verdict": "no-records",
+                     "summary": "no step ring found for this pod under "
+                                f"{base_dir}"}
+    row = rows[0]
+    # offline replay stamps the newest window "now", so the signal is
+    # as fresh as the ring bytes themselves — staleness here means an
+    # EMPTY ring, which slo_stats_for_pod already filtered out
+    return 200, _verdict_doc(pod_key, row, row.get("verdicts") or [],
+                             now, stale=False)
+
+
+def format_verdict(doc: dict) -> list[str]:
+    """Human lines for the CLI (one copy; tests snapshot it)."""
+    lines = [f"slo doctor: {doc.get('verdict')} — {doc.get('summary')}"]
+    comps = doc.get("components_frac") or {}
+    if comps:
+        split = "  ".join(
+            f"{name.replace('_', '-')} {frac * 100:.1f}%"
+            for name, frac in comps.items() if frac > 0)
+        lines.append(f"  step-time split: {split}")
+    if doc.get("goodput_ratio") is not None:
+        p95 = doc.get("step_p95_ms")
+        lines.append(
+            f"  goodput {doc['goodput_ratio']:.2f}"
+            + (f"  step p95 {p95:.1f} ms" if p95 is not None else ""))
+    for v in (doc.get("regressions") or [])[:5]:
+        lines.append(f"  [{v.get('kind')}] {v.get('summary')}")
+    extra = len(doc.get("regressions") or []) - 5
+    if extra > 0:
+        lines.append(f"  (+{extra} earlier regression(s))")
+    return lines
+
+
+__all__ = ["why_slow_from_document", "why_slow_offline",
+           "format_verdict"]
+
+# re-export for callers that want the staleness constant next to the
+# verdicts it governs
+STALENESS_S = detect.STALENESS_S

@@ -1,0 +1,237 @@
+"""vtslo per-tenant history: bounded window ring + crash-safe spool.
+
+The detectors need *memory* — a baseline to judge a regression against
+— but the step rings only remember RING_CAPACITY steps and the monitor
+can restart at any time. This module keeps, per tenant, a bounded ring
+of downsampled :class:`~vtpu_manager.slo.attribution.WindowSample`
+objects, persisted with the span-ring/spool discipline the trace and
+explain planes use:
+
+- ``record()`` appends to the in-memory ring under a short lock and at
+  most WAKES the background flusher — zero I/O on the fold path (a
+  hung disk must never stall the monitor's scrape);
+- the flusher (and atexit) appends JSONL to a per-process spool under a
+  ``FileLock``, rotating at the byte cap to a single ``.prev``
+  generation, so one process is bounded at ~2x the cap;
+- a restarted monitor **re-seeds** its rings from the spools (newest
+  windows last), so the detectors' baselines survive restarts instead
+  of re-learning from scratch — the restart-continuation contract;
+- a torn spool line (crash mid-append) is SKIPPED, never fatal — the
+  chaos rule every spool reader on the node follows.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from vtpu_manager.slo.attribution import WindowSample
+from vtpu_manager.util.flock import FileLock
+
+log = logging.getLogger(__name__)
+
+SPOOL_SUFFIX = ".jsonl"
+# windows retained per tenant: at the default ~15 s publish cadence a
+# 64-window ring remembers ~16 minutes — enough for "since epoch 12"
+# verdicts without unbounded growth
+DEFAULT_WINDOWS_PER_TENANT = 64
+DEFAULT_MAX_SPOOL_BYTES = 4 * 2**20
+DEFAULT_FLUSH_INTERVAL_S = 2.0
+
+
+class SloHistory:
+    """Bounded per-tenant window history with spool persistence."""
+
+    def __init__(self, spool_dir: str,
+                 windows_per_tenant: int = DEFAULT_WINDOWS_PER_TENANT,
+                 max_spool_bytes: int = DEFAULT_MAX_SPOOL_BYTES):
+        self.spool_dir = spool_dir
+        self.windows_per_tenant = max(2, windows_per_tenant)
+        self.max_spool_bytes = max_spool_bytes
+        self.spool_path = os.path.join(
+            spool_dir, f"slo.{os.getpid()}{SPOOL_SUFFIX}")
+        self._lock = threading.Lock()
+        # tenant key "pod_uid/container" -> list[WindowSample] (oldest
+        # first, bounded)
+        self._rings: dict[str, list[WindowSample]] = {}
+        self._pending: list[tuple[str, WindowSample]] = []
+        self.dropped_total = 0
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    # -- hot path (called from the ledger fold) ------------------------------
+
+    def record(self, tenant: str, window: WindowSample) -> None:
+        """Append one window — ring mutation under the short lock only,
+        never I/O. A pending-spool backlog past one ring's worth drops
+        the oldest pending line and counts it (backpressure must not
+        reach the fold)."""
+        with self._lock:
+            ring = self._rings.setdefault(tenant, [])
+            ring.append(window)
+            if len(ring) > self.windows_per_tenant:
+                del ring[:len(ring) - self.windows_per_tenant]
+            self._pending.append((tenant, window))
+            if len(self._pending) > 4 * self.windows_per_tenant:
+                del self._pending[0]
+                self.dropped_total += 1
+        self._wake.set()
+
+    def forget(self, live_tenants: set[str]) -> None:
+        """Drop rings for removed tenants (the ledger's lifecycle rule:
+        the reaper owns stale dirs, the history follows the configs)."""
+        with self._lock:
+            for key in list(self._rings):
+                if key not in live_tenants:
+                    del self._rings[key]
+
+    def windows(self, tenant: str) -> list[WindowSample]:
+        with self._lock:
+            return list(self._rings.get(tenant, ()))
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    # -- spool ---------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Drain pending windows to the per-process spool (flusher
+        thread / atexit only). An unwritable spool counts the loss and
+        keeps the in-memory rings serving — the trace-recorder rule."""
+        with self._lock:
+            pending = self._pending
+            self._pending = []
+        if not pending:
+            return 0
+        lines = [json.dumps({"kind": "slo_window", "tenant": t,
+                             **w.to_wire()}, separators=(",", ":"))
+                 for t, w in pending]
+        try:
+            os.makedirs(self.spool_dir, exist_ok=True)
+            with FileLock(f"{self.spool_path}.flock"):
+                self._rotate_if_large()
+                with open(self.spool_path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+        except OSError:
+            with self._lock:
+                self.dropped_total += len(pending)
+            return 0
+        return len(pending)
+
+    def _rotate_if_large(self) -> None:
+        try:
+            size = os.path.getsize(self.spool_path)
+        except OSError:
+            return
+        if size < self.max_spool_bytes:
+            return
+        prev = self.spool_path[:-len(SPOOL_SUFFIX)] \
+            + f".prev{SPOOL_SUFFIX}"
+        os.replace(self.spool_path, prev)
+
+    def reseed(self) -> int:
+        """Restart continuation: re-read every spool under the dir
+        (``.prev`` generations first, torn lines skipped) and rebuild
+        the bounded rings, so a restarted monitor's detectors judge
+        against the pre-restart baseline. Returns windows loaded."""
+        loaded = 0
+        for tenant, window in read_spools(self.spool_dir):
+            with self._lock:
+                ring = self._rings.setdefault(tenant, [])
+                ring.append(window)
+                if len(ring) > self.windows_per_tenant:
+                    del ring[:len(ring) - self.windows_per_tenant]
+            loaded += 1
+        # windows may interleave across spool generations: re-sort each
+        # ring by stamp so the detectors replay them in causal order
+        with self._lock:
+            for ring in self._rings.values():
+                ring.sort(key=lambda w: w.ts)
+        return loaded
+
+    # -- flusher thread ------------------------------------------------------
+
+    def start_flusher(self,
+                      interval_s: float = DEFAULT_FLUSH_INTERVAL_S
+                      ) -> None:
+        import atexit
+
+        def loop():
+            while not self._stop:
+                self._wake.wait(interval_s)
+                self._wake.clear()
+                self.flush()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtslo-history")
+        self._thread.start()
+        atexit.register(self.flush)
+
+    def stop_flusher(self) -> None:
+        self._stop = True
+        self._wake.set()
+
+
+def read_spools(spool_dir: str):
+    """Yield (tenant, WindowSample) from every slo spool under the dir,
+    oldest generation first. Torn/garbage lines are skipped, never
+    fatal (chaos contract)."""
+    if not os.path.isdir(spool_dir):
+        return
+    names = sorted(
+        n for n in os.listdir(spool_dir)
+        if n.startswith("slo.") and n.endswith(SPOOL_SUFFIX))
+    # .prev generations are older: read them before their successors
+    names.sort(key=lambda n: (".prev" not in n, n))
+    for name in names:
+        path = os.path.join(spool_dir, name)
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue        # torn line: skipped, never fatal
+            if doc.get("kind") != "slo_window":
+                continue
+            tenant = str(doc.get("tenant", ""))
+            if not tenant:
+                continue
+            try:
+                yield tenant, WindowSample.from_wire(doc)
+            except (TypeError, ValueError):
+                continue
+
+
+def reap_stale_spools(spool_dir: str, max_age_s: float = 24 * 3600.0,
+                      now: float | None = None) -> int:
+    """Delete slo spools (and flocks) untouched past the TTL — dead
+    monitors' leftovers; live ones re-stamp mtime every flush."""
+    removed = 0
+    if not os.path.isdir(spool_dir):
+        return removed
+    cutoff = (time.time() if now is None else now) - max_age_s
+    for name in os.listdir(spool_dir):
+        if not name.startswith("slo."):
+            continue
+        if not (name.endswith(SPOOL_SUFFIX)
+                or name.endswith(f"{SPOOL_SUFFIX}.flock")):
+            continue
+        path = os.path.join(spool_dir, name)
+        try:
+            if os.path.getmtime(path) < cutoff:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            continue
+    return removed

@@ -23,7 +23,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from vtpu_manager.util import consts
+from vtpu_manager.util import consts, stalecodec
 
 log = logging.getLogger(__name__)
 
@@ -31,10 +31,8 @@ log = logging.getLogger(__name__)
 # seconds; 120 s means "daemon gone for two minutes")
 MAX_PRESSURE_AGE_S = 120.0
 
-# a stamp slightly in the future is node/scheduler clock skew (and the
-# encode's millisecond rounding), not a signal to distrust; beyond this
-# it reads as no-signal like any other garbage
-FUTURE_SKEW_TOLERANCE_S = 5.0
+# re-exported for existing importers; the one copy lives in stalecodec
+FUTURE_SKEW_TOLERANCE_S = stalecodec.FUTURE_SKEW_TOLERANCE_S
 
 # scoring weight: a fully-stalled node (frac 1.0) loses this many score
 # points — bigger than any packing/topology delta, smaller than the +100
@@ -49,8 +47,9 @@ class NodePressure:
     ts: float
 
     def encode(self) -> str:
-        return (f"{self.throttle_frac:.4f}:"
-                f"{self.hbm_headroom_bytes}@{self.ts:.3f}")
+        return stalecodec.stamp(
+            f"{self.throttle_frac:.4f}:{self.hbm_headroom_bytes}",
+            self.ts)
 
 
 def parse_pressure(raw: str | None,
@@ -59,23 +58,22 @@ def parse_pressure(raw: str | None,
                    ) -> NodePressure | None:
     """Decode the annotation; None when absent, malformed, or stale —
     every bad shape degrades to no-signal, never to a wrong penalty."""
-    if not raw:
+    split = stalecodec.split_stamp(raw)
+    if split is None:
         return None
-    body, _, ts_raw = raw.partition("@")
+    body, ts = split
     frac_raw, _, headroom_raw = body.partition(":")
     try:
         frac = float(frac_raw)
         headroom = int(headroom_raw)
-        ts = float(ts_raw)
     except (TypeError, ValueError):
         return None
-    if not (math.isfinite(frac) and math.isfinite(ts)):
+    if not math.isfinite(frac):
         # "nan" parses as float but poisons every comparison downstream:
         # min/max pass NaN through and a NaN score corrupts the whole
         # node ordering — garbage must mean no-signal
         return None
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+    if not stalecodec.is_fresh(ts, now, max_age_s):
         return None
     return NodePressure(min(max(frac, 0.0), 1.0), max(headroom, 0), ts)
 
@@ -89,9 +87,7 @@ def pressure_penalty(pressure: "NodePressure | None",
     would pin forever instead of decaying to no-signal."""
     if pressure is None:
         return 0.0
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - pressure.ts \
-            <= MAX_PRESSURE_AGE_S:
+    if not stalecodec.is_fresh(pressure.ts, now, MAX_PRESSURE_AGE_S):
         return 0.0
     return PRESSURE_SCORE_WEIGHT * pressure.throttle_frac
 

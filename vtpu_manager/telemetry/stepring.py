@@ -54,7 +54,15 @@ MAGIC = 0x54535456          # "VTST" little-endian
 # comm-intensity feed and the honest ICI-bucket currency both read
 # it). CommTelemetry off writes zeros in all three: the v3 wire
 # carries nothing beyond zeroed pad, the gate-off contract.
-VERSION = 3
+# v4 (vtslo): spill_fill_time_ns — the wall time the step spent inside
+# the shim's host-tier demotions (TrySpillCold) and promotions
+# (FillSpilled), accumulated per record exactly like the comm spans —
+# so the SLO attribution plane's spill-fill component is MEASURED, not
+# inferred from event counts. An unarmed spill tier (HBMOvercommit
+# off) never measures one and the field stays zero — the same
+# zeros-on-the-wire contract the v2 spill block and v3 comm block keep
+# when their planes are off.
+VERSION = 4
 RING_CAPACITY = 256          # records; ~memory of the last 256 steps
 TRACE_ID_LEN = 48            # same bound as vtpu_config's pod_uid
 
@@ -89,10 +97,12 @@ assert HEADER_SIZE == 80
 # flags u32, pad u32, spilled_bytes u64, spill_events u32,
 # fill_events u32 (v2 spill block, vtovc), comm_time_ns u64,
 # bytes_transferred u64, collective_count u32, pad2 u32 (v3 comm
-# block, vtcomm; zeros when CommTelemetry is off)
-_RECORD_FMT = "<QQQQQQIiQIIQQII"
+# block, vtcomm; zeros when CommTelemetry is off),
+# spill_fill_time_ns u64 (v4, vtslo; zeros when the spill tier never
+# measured a demotion/promotion span)
+_RECORD_FMT = "<QQQQQQIiQIIQQIIQ"
 RECORD_SIZE = struct.calcsize(_RECORD_FMT)
-assert RECORD_SIZE == 96
+assert RECORD_SIZE == 104
 
 FILE_SIZE = HEADER_SIZE + RING_CAPACITY * RECORD_SIZE
 
@@ -120,6 +130,7 @@ class StepRecord:
     comm_time_ns: int = 0        # measured collective+transfer span time
     bytes_transferred: int = 0   # bytes observed moving since last record
     collective_count: int = 0    # multi-chip dispatches since last record
+    spill_fill_time_ns: int = 0  # measured host-tier spill+fill span time
 
     @property
     def compiled(self) -> bool:
@@ -185,7 +196,8 @@ class StepRingWriter:
                start_mono_ns: int | None = None, spilled_bytes: int = 0,
                spill_events: int = 0, fill_events: int = 0,
                comm_time_ns: int = 0, bytes_transferred: int = 0,
-               collective_count: int = 0) -> None:
+               collective_count: int = 0,
+               spill_fill_time_ns: int = 0) -> None:
         """Publish one step record (the hot path). Seqlock bracket per
         the shared-mmap protocol: odd seq first, payload, even seq last
         — ``seq | 1`` so a crashed writer's odd leftover can't invert
@@ -203,7 +215,7 @@ class StepRingWriter:
                          FLAG_COMPILE if compiled else 0, 0,
                          spilled_bytes, spill_events, fill_events,
                          comm_time_ns, bytes_transferred,
-                         collective_count, 0)
+                         collective_count, 0, spill_fill_time_ns)
         struct.pack_into("<Q", self._mm, off, wseq + 1)  # even: stable
         self._writes = index + 1
         struct.pack_into("<Q", self._mm, _WRITES_OFFSET, self._writes)
@@ -288,7 +300,7 @@ class StepRingReader:
                 continue
             (_, rec_index, start_ns, dur_ns, wait_ns, hbm, flags,
              _pad, spilled, spills, fills, comm_ns, comm_bytes,
-             collectives, _pad2) = struct.unpack_from(
+             collectives, _pad2, spill_fill_ns) = struct.unpack_from(
                  _RECORD_FMT, self._mm, off)
             seq2, = struct.unpack_from("<Q", self._mm, off)
             if seq1 != seq2:
@@ -297,7 +309,7 @@ class StepRingReader:
                 return None     # lapped: slot already holds a newer step
             return StepRecord(rec_index, start_ns, dur_ns, wait_ns, hbm,
                               flags, spilled, spills, fills, comm_ns,
-                              comm_bytes, collectives)
+                              comm_bytes, collectives, spill_fill_ns)
         return None
 
     def poll(self, cursor: int) -> tuple[list[StepRecord], int, int]:
@@ -341,4 +353,5 @@ RECORD_OFFSETS = {
     "throttle_wait_ns": 32, "hbm_highwater_bytes": 40, "flags": 48,
     "spilled_bytes": 56, "spill_events": 64, "fill_events": 68,
     "comm_time_ns": 72, "bytes_transferred": 80, "collective_count": 88,
+    "spill_fill_time_ns": 96,
 }

@@ -35,6 +35,7 @@ QUOTA_MARKET = "QuotaMarket"            # vtqm elastic quota market
 HBM_OVERCOMMIT = "HBMOvercommit"        # vtovc virtual HBM + host-spill tier
 ICI_LINK_AWARE = "ICILinkAware"         # vtici link-contention-aware placement
 COMM_TELEMETRY = "CommTelemetry"        # vtcomm measured communication plane
+SLO_ATTRIBUTION = "SLOAttribution"      # vtslo goodput + step-time attribution
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -180,6 +181,23 @@ _KNOWN = {
     # the measured collective-time EMA while fresh — honest currency
     # on hardware.
     COMM_TELEMETRY: False,
+    # Default off: byte-identical — no vtpu_tenant_goodput_*/
+    # vtpu_tenant_overhead_*/vtpu_slo_* series on the scrape, no /slo
+    # route, no history spools under the base dir, the /utilization
+    # document carries no slo fields, and placement is untouched in
+    # both scheduler modes (the plane is observe-only by design). On,
+    # the monitor folds every tenant's v4 step ring through the SLO
+    # attribution plane (vtpu_manager/slo/): each step decomposes into
+    # compute / throttle-wait / comm / spill-fill / compile components
+    # (pure arithmetic over the record — reproducible offline), bounded
+    # per-tenant histories of downsampled windows persist across
+    # monitor restarts via crash-safe spools, EWMA+variance detectors
+    # flag step-time drift / goodput drops / throttle spikes / spill
+    # thrash / comm inflation, and every verdict joins the responsible
+    # plane's own events (quota lease settles, spill counters,
+    # collective counts, compile flags) so "why is my job slow" has ONE
+    # answer instead of five metric families.
+    SLO_ATTRIBUTION: False,
 }
 
 

@@ -1,0 +1,70 @@
+"""The ``…@ts`` staleness-stamped annotation codec, shared.
+
+Five planes publish node state over the registry channel as compact
+annotations whose wire format ends in ``@<wall_ts>`` — pressure
+(telemetry/pressure.py), reclaimable headroom (utilization/headroom.py),
+overcommit ratios (overcommit/ratio.py), warm cache keys
+(clustercache/advertise.py), and victim costs (quota/victimcost.py).
+Each grew its own copy of the same three rules:
+
+- **stamp**: the timestamp is appended as ``@{ts:.3f}`` (millisecond
+  rounding — the skew tolerance absorbs it);
+- **split**: the stamp is taken from the LAST ``@`` (bodies never
+  contain one today, but rpartition keeps a garbage body from eating a
+  valid stamp), a missing/non-float/non-finite stamp is no-signal;
+- **freshness**: ``-skew <= now - ts <= max_age`` — a stamp slightly in
+  the future is clock skew plus the encoder's rounding, anything beyond
+  the budget is a dead publisher whose claim must decay to no-signal,
+  and freshness is RE-JUDGED at use time (the snapshot path caches the
+  parsed object and a dead publisher emits no further node events).
+
+This module is the one copy of those rules. Each codec keeps its own
+age budget and body grammar; the stamp bytes and the staleness verdicts
+are asserted byte-identical per codec by tests/test_slo.py.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+# a stamp slightly in the future is node/scheduler clock skew (and the
+# encode's millisecond rounding), not a signal to distrust; beyond this
+# it reads as no-signal like any other garbage
+FUTURE_SKEW_TOLERANCE_S = 5.0
+
+
+def stamp(body: str, ts: float) -> str:
+    """Append the wall-clock stamp — the one encoder every codec uses
+    (``@{ts:.3f}``; changing this changes five wire formats at once)."""
+    return f"{body}@{ts:.3f}"
+
+
+def split_stamp(raw: str | None, max_len: int | None = None
+                ) -> tuple[str, float] | None:
+    """(body, ts) off the last ``@``; None when absent, over the
+    defensive length bound, missing the separator, or carrying a
+    non-float / non-finite stamp — every bad shape is no-signal."""
+    if not raw:
+        return None
+    if max_len is not None and len(raw) > max_len:
+        return None
+    body, sep, ts_raw = raw.rpartition("@")
+    if not sep:
+        return None
+    try:
+        ts = float(ts_raw)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(ts):
+        return None
+    return body, ts
+
+
+def is_fresh(ts: float, now: float | None = None,
+             max_age_s: float = 120.0,
+             skew_s: float = FUTURE_SKEW_TOLERANCE_S) -> bool:
+    """The freshness verdict every codec applies at parse time AND
+    re-judges at use time."""
+    now = time.time() if now is None else now
+    return -skew_s <= now - ts <= max_age_s

@@ -26,8 +26,9 @@ flips it on against that recorded evidence.
 from __future__ import annotations
 
 import math
-import time
 from dataclasses import dataclass, field
+
+from vtpu_manager.util import stalecodec
 
 # staleness budget: the publisher cadence is seconds; a rollup older
 # than this reads as no-signal (same constant family as
@@ -35,9 +36,8 @@ from dataclasses import dataclass, field
 # want a TIGHTER bound here than the soft pressure penalty needs)
 MAX_HEADROOM_AGE_S = 120.0
 
-# a stamp slightly in the future is clock skew plus the encoder's
-# millisecond rounding, not a reason to distrust the rollup
-FUTURE_SKEW_TOLERANCE_S = 5.0
+# re-exported for existing importers; the one copy lives in stalecodec
+FUTURE_SKEW_TOLERANCE_S = stalecodec.FUTURE_SKEW_TOLERANCE_S
 
 
 @dataclass(frozen=True)
@@ -71,7 +71,7 @@ class NodeHeadroom:
             f"{idx}:{ch.alloc_core_pct:.1f}:{ch.used_core_pct:.1f}:"
             f"{ch.reclaim_core_pct:.1f}:{ch.reclaim_hbm_bytes}"
             for idx, ch in sorted(self.chips.items())]
-        return f"{';'.join(segs)}@{self.ts:.3f}"
+        return stalecodec.stamp(";".join(segs), self.ts)
 
     def total_reclaim_core_pct(self) -> float:
         return sum(c.reclaim_core_pct for c in self.chips.values())
@@ -82,19 +82,11 @@ def parse_headroom(raw: str | None, now: float | None = None,
                    ) -> NodeHeadroom | None:
     """Decode the annotation; None when absent, malformed, or stale —
     every bad shape degrades to no-signal, never to a wrong claim."""
-    if not raw:
+    split = stalecodec.split_stamp(raw)
+    if split is None:
         return None
-    body, sep, ts_raw = raw.rpartition("@")
-    if not sep:
-        return None
-    try:
-        ts = float(ts_raw)
-    except (TypeError, ValueError):
-        return None
-    if not math.isfinite(ts):
-        return None
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now, max_age_s):
         return None
     chips: dict[int, ChipHeadroom] = {}
     class_mix: dict[str, int] = {}
@@ -141,8 +133,7 @@ def headroom_is_fresh(hr: "NodeHeadroom | None",
     NodeHeadroom must re-judge freshness at the moment it acts on it."""
     if hr is None:
         return False
-    now = time.time() if now is None else now
-    return -FUTURE_SKEW_TOLERANCE_S <= now - hr.ts <= MAX_HEADROOM_AGE_S
+    return stalecodec.is_fresh(hr.ts, now, MAX_HEADROOM_AGE_S)
 
 
 def headroom_score_input(hr: "NodeHeadroom | None",
@@ -158,8 +149,7 @@ def headroom_score_input(hr: "NodeHeadroom | None",
     input, capped) so recorded decisions replay exactly."""
     if hr is None:
         return 0.0
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - hr.ts <= MAX_HEADROOM_AGE_S:
+    if not stalecodec.is_fresh(hr.ts, now, MAX_HEADROOM_AGE_S):
         return 0.0
     return hr.total_reclaim_core_pct()
 

@@ -44,7 +44,8 @@ class ClusterRollup:
                  quota_dir: str | None = None,
                  overcommit: bool = False,
                  cluster_cache: bool = False,
-                 comm: bool = False):
+                 comm: bool = False,
+                 slo_ledger=None):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
@@ -63,6 +64,11 @@ class ClusterRollup:
         # comm fields at all — byte-identical /utilization (the vtqm
         # pattern)
         self.comm = comm
+        # vtslo (SLOAttribution gate): None = the document carries no
+        # slo fields at all — byte-identical /utilization. Set, it is
+        # the collector's SloLedger (already folded on the scrape
+        # path; this fold only tops up since the last one)
+        self.slo_ledger = slo_ledger
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -324,11 +330,13 @@ class ClusterRollup:
             pct = int(lease.get("pct", 0))
             used = row.get("used_core_pct") if row else None
             base = row.get("allocated_core_pct") if row else None
-            used_of_borrowed = None
-            if used is not None and base is not None and pct > 0:
-                used_of_borrowed = round(
-                    min(max(float(used) - float(base), 0.0),
-                        float(pct)), 2)
+            # THE shared formula (quota.market.borrowed_used_verdict):
+            # the grant-step feedback and the replay check consume the
+            # same arithmetic these rows publish
+            from vtpu_manager.quota.market import borrowed_used_verdict
+            used_of_borrowed = borrowed_used_verdict(used, base, pct)
+            if used_of_borrowed is not None:
+                used_of_borrowed = round(used_of_borrowed, 2)
             borrowed_used.append({
                 "id": lease.get("id"),
                 "chip": lease.get("chip"),
@@ -459,6 +467,36 @@ class ClusterRollup:
                 if c is not None and row.get("live"):
                     row["comm_duty_frac"] = c["comm_duty_frac"]
                     row["comm_intensity"] = c["comm_intensity"]
+        slo_fleet = None
+        if self.slo_ledger is not None:
+            # vtslo local truth (gate on only — off keeps the document
+            # byte-identical): the GOODPUT column on this node's live
+            # tenant rows plus the fleet SLO headline block. Stale
+            # attribution rows keep their (flagged) entry in the slo
+            # block but never splice onto a live row — the comm-column
+            # decay rule.
+            try:
+                self.slo_ledger.fold(now_wall=now)
+            except Exception as e:  # noqa: BLE001 — a torn fold serves
+                # the last fold's state plus an error row
+                log.warning("slo fold failed in rollup: %s", e)
+                fold_errors.append(f"slo_fold: {e}")
+            slo_doc = self.slo_ledger.document(now)
+            local["slo"] = {
+                "tenants": slo_doc["tenants"],
+                "verdicts": slo_doc["verdicts"][-16:],
+                "regressions_total": slo_doc["regressions_total"],
+            }
+            slo_fleet = {**slo_doc["fleet"]}
+            by_tenant = {(r["pod_uid"], r["container"].split("/", 1)[0])
+                         : r for r in slo_doc["tenants"]
+                         if not r["stale"]}
+            for row in tenant_rows:
+                s = by_tenant.get(
+                    (row.get("pod_uid", ""),
+                     str(row.get("container", "")).split("/", 1)[0]))
+                if s is not None and row.get("live"):
+                    row["goodput_ratio"] = s["goodput_ratio"]
         quota = self._fold_quota_leases(tenant_rows, node_rows, now)
         live_nodes = [r for r in node_rows
                       if r["reclaim_core_pct"] is not None]
@@ -479,6 +517,8 @@ class ClusterRollup:
         }
         if quota is not None:
             doc["quota"] = quota
+        if slo_fleet is not None:
+            doc["slo"] = slo_fleet
         if self.overcommit:
             # vtcomm-PR vtovc satellite (ROADMAP vtovc item (a)): the
             # fleet-level overcommit policy view — which classes
