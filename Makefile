@@ -45,8 +45,8 @@ clean: ## Remove build artifacts
 ##@ Test
 
 .PHONY: lint
-lint: ## Project-native static analysis (vtlint) + ruff baseline when available
-	python scripts/vtlint.py vtpu_manager/
+lint: ## Project-native static analysis (vtlint, incl. the C++ shim pass) + ruff baseline when available
+	python scripts/vtlint.py vtpu_manager/ cmd/
 	@if command -v ruff >/dev/null 2>&1; then \
 	  ruff check .; \
 	else \
@@ -146,8 +146,12 @@ test-overcommit: ## vtovc suite: ratio codec + policy percentiles, virtual admis
 bench-overcommit: ## vtovc headline bench: pods-per-chip density gate off/on (>=1.5x at bounded p99 step-time regression, thrash backoff asserted; writes BENCH_VTOVC_r11.json)
 	python scripts/bench_overcommit.py
 
+.PHONY: test-abi-san
+test-abi-san: ## ABI probe suite rebuilt with ASan+UBSan (skips clean when g++/libasan absent)
+	VTPU_ABI_SAN=1 $(PYTEST) tests/test_config_abi.py -q
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo bench-overcommit bench-clustercache bench-ici bench-comm bench-slo ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo test-abi-san bench-overcommit bench-clustercache bench-ici bench-comm bench-slo ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench, sanitized ABI probes
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from vtpu_manager.analysis import all_rules, run_analysis          # noqa: E402
 from vtpu_manager.analysis.core import (load_project, render_human,  # noqa: E402
                                         render_json)
-from vtpu_manager.analysis.rules import abi_drift                  # noqa: E402
+from vtpu_manager.analysis.rules import abi_drift, abi_mirror      # noqa: E402
 
 
 def _update_abi_golden(paths: list[str], golden: str | None) -> int:
@@ -42,6 +42,15 @@ def _update_abi_golden(paths: list[str], golden: str | None) -> int:
               f"under {', '.join(paths)}; the golden must cover all of "
               f"them — run against the package root", file=sys.stderr)
         return 2
+    # the C++ leg of the three-way anchor: struct layouts, constexprs,
+    # and static_assert claims parsed straight from the shim headers
+    cxx = abi_mirror.compute_cxx_layout(project)
+    if cxx:
+        layout["cxx"] = cxx
+    else:
+        print(f"vtlint: no library/ shim sources adjacent to "
+              f"{', '.join(paths)}; writing the golden without a cxx "
+              f"section", file=sys.stderr)
     path = golden or str(abi_drift.DEFAULT_GOLDEN)
     with open(path, "w") as f:
         json.dump(layout, f, indent=2, sort_keys=True)
@@ -81,7 +90,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = args.paths or [os.path.join(repo_root, "vtpu_manager")]
+    # cmd/ carries the entrypoint assemblies (filter_kwargs et al.) that
+    # the ride-along rule checks against the package
+    paths = args.paths or [os.path.join(repo_root, "vtpu_manager"),
+                           os.path.join(repo_root, "cmd")]
     for path in paths:
         if not os.path.exists(path):
             print(f"vtlint: no such path: {path}", file=sys.stderr)

@@ -13,10 +13,11 @@ import textwrap
 
 from vtpu_manager.analysis import all_rules, run_analysis
 from vtpu_manager.analysis.core import load_project
-from vtpu_manager.analysis.rules import abi_drift
+from vtpu_manager.analysis.rules import abi_drift, abi_mirror
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "vtpu_manager")
+CMD = os.path.join(REPO, "cmd")
 VTLINT = os.path.join(REPO, "scripts", "vtlint.py")
 
 
@@ -780,6 +781,515 @@ class TestRetryHygiene:
 
 
 # ---------------------------------------------------------------------------
+# abi-mirror (C++ headers <-> Python packers <-> golden, compiler-free)
+
+
+def _live(rel: str) -> str:
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+class TestAbiMirror:
+    SELECT = {"abi-mirror"}
+
+    def _tree(self) -> dict[str, str]:
+        """Pristine copies of every file the rule triangulates: the two
+        ABI headers plus the four Python packers."""
+        return {
+            "library/include/vtpu_config.h":
+                _live("library/include/vtpu_config.h"),
+            "library/include/vtpu_telemetry.h":
+                _live("library/include/vtpu_telemetry.h"),
+            "config/vtpu_config.py": _live("vtpu_manager/config/vtpu_config.py"),
+            "config/tc_watcher.py": _live("vtpu_manager/config/tc_watcher.py"),
+            "config/vmem.py": _live("vtpu_manager/config/vmem.py"),
+            "telemetry/stepring.py":
+                _live("vtpu_manager/telemetry/stepring.py"),
+        }
+
+    def test_pristine_tree_clean(self, tmp_path):
+        findings = lint(tmp_path, self._tree(), select=self.SELECT)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_header_offset_drift_red_without_gxx(self, tmp_path):
+        # widen flags: every later StepRecord field shifts, no compiler
+        # involved — the parse alone must go red
+        tree = self._tree()
+        hdr = tree["library/include/vtpu_telemetry.h"]
+        assert "uint32_t flags;" in hdr
+        tree["library/include/vtpu_telemetry.h"] = hdr.replace(
+            "uint32_t flags;", "uint64_t flags;")
+        findings = lint(tmp_path, tree, select=self.SELECT)
+        assert rules_hit(findings) == {"abi-mirror"}
+        # the header's own static_asserts flip FALSE at lint time
+        assert any("is FALSE under the parsed layout" in f.message
+                   for f in findings)
+        # drift vs the golden names the field and both offsets
+        assert any("StepRecord.spilled_bytes is at offset 64" in f.message
+                   and "golden says 56" in f.message for f in findings)
+        # and the Python packer leg disagrees too (three-way check)
+        assert any("RECORD_OFFSETS" in f.message for f in findings)
+
+    def test_dropped_static_assert_red(self, tmp_path):
+        tree = self._tree()
+        pin = ('static_assert(offsetof(StepRecord, throttle_wait_ns) == 32,'
+               ' "ABI");\n')
+        hdr = tree["library/include/vtpu_telemetry.h"]
+        assert pin in hdr
+        tree["library/include/vtpu_telemetry.h"] = hdr.replace(pin, "")
+        findings = lint(tmp_path, tree, select=self.SELECT)
+        assert any("was dropped from the ABI headers" in f.message
+                   and "throttle_wait_ns" in f.message for f in findings)
+
+    def test_header_only_constant_drift_red(self, tmp_path):
+        tree = self._tree()
+        hdr = tree["library/include/vtpu_telemetry.h"]
+        assert "constexpr uint32_t kStepRingVersion = 4;" in hdr
+        tree["library/include/vtpu_telemetry.h"] = hdr.replace(
+            "constexpr uint32_t kStepRingVersion = 4;",
+            "constexpr uint32_t kStepRingVersion = 5;")
+        findings = lint(tmp_path, tree, select=self.SELECT)
+        # red against the golden AND against stepring.VERSION
+        assert any("kStepRingVersion = 5" in f.message
+                   and "golden says 4" in f.message for f in findings)
+        assert any("VERSION" in f.message and "stepring" in f.path
+                   for f in findings)
+
+    def test_no_cpp_modules_is_silent(self, tmp_path):
+        findings = lint(tmp_path, {
+            "config/vtpu_config.py":
+                _live("vtpu_manager/config/vtpu_config.py"),
+        }, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# fail-open
+
+
+class TestFailOpen:
+    SELECT = {"fail-open"}
+
+    def test_throw_and_abort_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"library/src/enforce.cc": """
+            namespace vtpu {
+            int Execute(int x) {
+              if (x < 0) {
+                throw 1;
+              }
+              return x;
+            }
+            void Die() { abort(); }
+            }
+            """}, select=self.SELECT)
+        assert rules_hit(findings) == {"fail-open"}
+        assert any("'throw'" in f.message for f in findings)
+        assert any("'abort(...)'" in f.message for f in findings)
+
+    def test_exit_identifier_and_member_calls_stay_legal(self, tmp_path):
+        findings = lint(tmp_path, {"library/src/loader.cc": """
+            namespace vtpu {
+            int exit_code = 0;
+            void Child() { _exit(2); }
+            void Forward(Handler* h) { h->exit(); }
+            int Read(State* s) { return s->exit; }
+            }
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = lint(tmp_path, {"library/src/enforce.cc": """
+            namespace vtpu {
+            void Guard() {
+              // vtlint: disable=fail-open -- unreachable by construction
+              throw 1;
+            }
+            }
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# cxx-seqlock
+
+
+_GOOD_CXX_WRITER = """
+    struct Rec { unsigned long long seq; unsigned long long value; };
+
+    void Record(Rec* rec, unsigned long long v) {
+      unsigned long long seq = __atomic_load_n(&rec->seq, 0);
+      unsigned long long wseq = seq | 1;
+      __atomic_store_n(&rec->seq, wseq, 3);
+      rec->value = v;
+      __atomic_store_n(&rec->seq, wseq + 1, 3);
+    }
+    """
+
+
+class TestCxxSeqlock:
+    SELECT = {"cxx-seqlock"}
+
+    def test_good_writer_clean(self, tmp_path):
+        findings = lint(tmp_path,
+                        {"library/src/ring.cc": _GOOD_CXX_WRITER},
+                        select=self.SELECT)
+        assert findings == []
+
+    def test_payload_after_even_bump(self, tmp_path):
+        src = _GOOD_CXX_WRITER.replace(
+            "      rec->value = v;\n"
+            "      __atomic_store_n(&rec->seq, wseq + 1, 3);",
+            "      __atomic_store_n(&rec->seq, wseq + 1, 3);\n"
+            "      rec->value = v;")
+        findings = lint(tmp_path, {"library/src/ring.cc": src},
+                        select=self.SELECT)
+        assert any("AFTER the even seq bump" in f.message for f in findings)
+
+    def test_plain_seq_store(self, tmp_path):
+        src = _GOOD_CXX_WRITER.replace(
+            "__atomic_store_n(&rec->seq, wseq, 3);", "rec->seq = wseq;")
+        findings = lint(tmp_path, {"library/src/ring.cc": src},
+                        select=self.SELECT)
+        assert any("plain store" in f.message for f in findings)
+
+    def test_missing_odd_force(self, tmp_path):
+        src = _GOOD_CXX_WRITER.replace("seq | 1", "seq + 1")
+        findings = lint(tmp_path, {"library/src/ring.cc": src},
+                        select=self.SELECT)
+        assert any("without forcing" in f.message for f in findings)
+
+    def test_bare_global_counter_in_writer(self, tmp_path):
+        src = _GOOD_CXX_WRITER.replace(
+            "struct Rec { unsigned long long seq; unsigned long long "
+            "value; };",
+            "struct Rec { unsigned long long seq; unsigned long long "
+            "value; };\nunsigned long long g_writes = 0;").replace(
+            "      rec->value = v;",
+            "      rec->value = v;\n      g_writes += 1;")
+        findings = lint(tmp_path, {"library/src/ring.cc": src},
+                        select=self.SELECT)
+        assert any("bare write to shared non-atomic g_writes" in f.message
+                   for f in findings)
+
+    def test_non_writer_functions_out_of_scope(self, tmp_path):
+        findings = lint(tmp_path, {"library/src/init.cc": """
+            unsigned long long g_inits = 0;
+
+            void Init(Rec* rec) {
+              rec->value = 0;
+              g_inits += 1;
+            }
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# stalecodec
+
+
+class TestStalecodec:
+    SELECT = {"stalecodec"}
+
+    def test_adhoc_split_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"topology/mod.py": """
+            def parse(raw):
+                body, ts = raw.rsplit("@", 1)
+                return body, float(ts)
+            """}, select=self.SELECT)
+        assert any("split_stamp" in f.message for f in findings)
+
+    def test_adhoc_stamp_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"topology/mod.py": """
+            import time
+
+            def encode(body):
+                return f"{body}@{time.time():.3f}"
+            """}, select=self.SELECT)
+        assert any("stalecodec.stamp" in f.message for f in findings)
+
+    def test_adhoc_freshness_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"topology/mod.py": """
+            import time
+
+            def fresh(ts):
+                if time.time() - ts > 120.0:
+                    return None
+                return ts
+            """}, select=self.SELECT)
+        assert any("is_fresh" in f.message for f in findings)
+
+    def test_mtime_comparisons_exempt(self, tmp_path):
+        findings = lint(tmp_path, {"topology/mod.py": """
+            import os
+            import time
+
+            def recently_written(path):
+                return time.time() - os.path.getmtime(path) < 5.0
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_stalecodec_module_itself_exempt(self, tmp_path):
+        findings = lint(tmp_path, {"util/stalecodec.py": """
+            def split_stamp(raw):
+                body, _, ts = raw.rpartition("@")
+                return body, float(ts)
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = lint(tmp_path, {"topology/mod.py": """
+            import time
+
+            def gc_cutoff(records):
+                cutoff = time.time() - 7 * 24 * 3600
+                return {k: v for k, v in records.items()
+                        # vtlint: disable=stalecodec -- local GC cutoff
+                        if v >= cutoff}
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# ring-io
+
+
+class TestRingIo:
+    SELECT = {"ring-io"}
+
+    def test_io_inside_record_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"trace/spool.py": """
+            class Spool:
+                def record(self, entry):
+                    with open(self._path, "a") as f:
+                        f.write(entry)
+
+                def flush(self):
+                    pass
+            """}, select=self.SELECT)
+        assert any("record()" in f.message and "performs I/O" in f.message
+                   for f in findings)
+
+    def test_io_under_ring_lock_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"trace/spool.py": """
+            class Spool:
+                def record(self, entry):
+                    with self._lock:
+                        self._ring.append(entry)
+
+                def flush(self):
+                    with self._lock:
+                        self._file.write(b"x")
+            """}, select=self.SELECT)
+        assert any("while holding" in f.message for f in findings)
+
+    def test_snapshot_then_write_shape_clean(self, tmp_path):
+        findings = lint(tmp_path, {"trace/spool.py": """
+            class Spool:
+                def record(self, entry):
+                    with self._lock:
+                        self._ring.append(entry)
+
+                def flush(self):
+                    with self._lock:
+                        batch = list(self._ring)
+                        self._ring.clear()
+                    self._file.write(b"".join(batch))
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_cross_process_filelock_exempt(self, tmp_path):
+        findings = lint(tmp_path, {"trace/spool.py": """
+            class Spool:
+                def record(self, entry):
+                    with self._lock:
+                        self._ring.append(entry)
+
+                def flush(self):
+                    with FileLock(self._path):
+                        self._file.write(b"x")
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_class_without_flusher_out_of_scope(self, tmp_path):
+        findings = lint(tmp_path, {"config/packer.py": """
+            class Packer:
+                def record(self, entry):
+                    with open(self._path, "a") as f:
+                        f.write(entry)
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# predicate-ride-along
+
+
+_FILTER_SRC = """
+    class FilterPredicate:
+        def __init__(self, client, serialize=True, anti_storm=False,
+                     candidate_limit=64, snapshot=None):
+            self.client = client
+    """
+
+
+class TestPredicateRideAlong:
+    SELECT = {"predicate-ride-along"}
+
+    def test_behavioral_kwarg_at_call_site_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/filter.py": _FILTER_SRC,
+            "cmd_like/sched.py": """
+                from vtpu_manager.scheduler.filter import FilterPredicate
+
+                def make(client, filter_kwargs):
+                    return FilterPredicate(client, anti_storm=True,
+                                           **filter_kwargs)
+                """}, select=self.SELECT)
+        assert any("anti_storm" in f.message
+                   and "ride the shared filter_kwargs" in f.message
+                   for f in findings)
+
+    def test_infra_kwargs_clean(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/filter.py": _FILTER_SRC,
+            "cmd_like/sched.py": """
+                from vtpu_manager.scheduler.filter import FilterPredicate
+
+                def make(client, snap, filter_kwargs):
+                    return FilterPredicate(client, snapshot=snap,
+                                           **filter_kwargs)
+                """}, select=self.SELECT)
+        assert findings == []
+
+    def test_assembly_typo_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/filter.py": _FILTER_SRC,
+            "cmd_like/sched.py": """
+                filter_kwargs = dict(serialize=True, anti_storm=False,
+                                     anti_strom=True)
+                """}, select=self.SELECT)
+        assert any("'anti_strom'" in f.message for f in findings)
+
+    def test_assembly_missing_gate_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/filter.py": _FILTER_SRC,
+            "cmd_like/sched.py": """
+                filter_kwargs = dict(serialize=True)
+                """}, select=self.SELECT)
+        assert any("missing the FilterPredicate gate 'anti_storm'"
+                   in f.message for f in findings)
+
+    def test_passthrough_assembly_clean(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/filter.py": _FILTER_SRC,
+            "scheduler/shard_like.py": """
+                def build(filter_kwargs):
+                    filter_kwargs = dict(filter_kwargs or {})
+                    return filter_kwargs
+                """}, select=self.SELECT)
+        assert findings == []
+
+    def test_tree_without_filter_module_skipped(self, tmp_path):
+        findings = lint(tmp_path, {"cmd_like/sched.py": """
+            filter_kwargs = dict(whatever=True)
+            """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# failpoint-catalog
+
+
+_FAILPOINTS_SRC = """
+    SITES: dict[str, str] = {
+        "scheduler.bind_patch": "after the allocating patch",
+    }
+
+    def fire(site, **kw):
+        return None
+    """
+
+
+class TestFailpointCatalog:
+    SELECT = {"failpoint-catalog"}
+
+    def test_unregistered_fire_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "resilience/failpoints.py": _FAILPOINTS_SRC,
+            "scheduler/mod.py": """
+                from vtpu_manager.resilience import failpoints
+
+                def f():
+                    failpoints.fire("scheduler.not_in_sites")
+                """}, select=self.SELECT)
+        assert any("not registered in SITES" in f.message
+                   for f in findings)
+
+    def test_registered_fire_clean(self, tmp_path):
+        findings = lint(tmp_path, {
+            "resilience/failpoints.py": _FAILPOINTS_SRC,
+            "scheduler/mod.py": """
+                from vtpu_manager.resilience import failpoints
+
+                def f():
+                    failpoints.fire("scheduler.bind_patch")
+                """}, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# metrics-registry
+
+
+class TestMetricsRegistry:
+    SELECT = {"metrics-registry"}
+
+    def test_duplicate_home_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "metrics/a.py": 'SERIES = "vtpu_foo_total"\n',
+            "metrics/b.py": 'SERIES = "vtpu_foo_total"\n',
+        }, select=self.SELECT)
+        assert any("is also defined in" in f.message for f in findings)
+
+    def test_convention_violation_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "metrics/a.py": 'SERIES = "vtpu_FooTotal"\n',
+        }, select=self.SELECT)
+        assert any("naming convention" in f.message for f in findings)
+
+    def test_type_exposition_lines_checked(self, tmp_path):
+        findings = lint(tmp_path, {
+            "metrics/a.py":
+                'LINE = "# TYPE vtpu_Bad_Name counter\\n"\n',
+        }, select=self.SELECT)
+        assert any("naming convention" in f.message for f in findings)
+
+    def test_undocumented_series_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "metrics/a.py": 'SERIES = "vtpu_foo_total"\n',
+            "docs/telemetry.md": "# telemetry\n\nno tables here\n",
+        }, select=self.SELECT)
+        assert any("not documented anywhere" in f.message
+                   for f in findings)
+
+    def test_documented_series_clean(self, tmp_path):
+        findings = lint(tmp_path, {
+            "metrics/a.py": 'SERIES = "vtpu_foo_total"\n',
+            "docs/telemetry.md":
+                "| `vtpu_foo_total` | counter | a thing |\n",
+        }, select=self.SELECT)
+        assert findings == []
+
+    def test_prefix_and_bare_literals_exempt(self, tmp_path):
+        findings = lint(tmp_path, {
+            "metrics/a.py": ('PREFIX = "vtpu_compile_cache_"\n'
+                             'DRIVER = "vtpu"\n'
+                             'PKG = "vtpu_manager"\n'),
+        }, select=self.SELECT)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # CLI + meta
 
 
@@ -827,25 +1337,31 @@ class TestCli:
         proc = self._run("--list-rules")
         assert proc.returncode == 0
         for rule in ("lock-discipline", "seqlock-protocol", "abi-drift",
+                     "abi-mirror", "fail-open", "cxx-seqlock",
+                     "stalecodec", "ring-io", "predicate-ride-along",
+                     "failpoint-catalog", "metrics-registry",
                      "featuregate-hygiene", "exception-hygiene",
                      "retry-hygiene"):
             assert rule in proc.stdout
 
     def test_live_tree_clean_via_cli(self):
-        proc = self._run(PKG)
+        proc = self._run(PKG, CMD)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "clean" in proc.stdout
 
 
 class TestMeta:
     def test_live_tree_is_vtlint_clean(self):
-        findings = run_analysis([PKG], all_rules())
+        findings = run_analysis([PKG, CMD], all_rules())
         assert findings == [], "\n".join(f.render() for f in findings)
 
     def test_golden_matches_live_layout(self):
         project, errors = load_project([PKG])
         assert errors == []
         layout = abi_drift.compute_layout(project)
+        cxx = abi_mirror.compute_cxx_layout(project)
+        if cxx:
+            layout["cxx"] = cxx
         golden = json.loads(abi_drift.DEFAULT_GOLDEN.read_text())
         assert layout == golden
 
@@ -853,3 +1369,10 @@ class TestMeta:
         golden = json.loads(abi_drift.DEFAULT_GOLDEN.read_text())
         for key, (_, names) in abi_drift.TRACKED.items():
             assert set(golden[key]) == set(names)
+
+    def test_golden_cxx_tracks_declared_surface(self):
+        golden = json.loads(abi_drift.DEFAULT_GOLDEN.read_text())
+        cxx = golden["cxx"]
+        assert set(cxx["structs"]) == set(abi_mirror.GOLDEN_STRUCTS)
+        assert set(cxx["constants"]) == set(abi_mirror.GOLDEN_CONSTANTS)
+        assert cxx["static_asserts"] == sorted(cxx["static_asserts"])

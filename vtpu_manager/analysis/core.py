@@ -77,8 +77,11 @@ class Module:
                 m = _SUPPRESS_RE.search(tok.string)
                 if not m:
                     continue
-                rules = {r.strip() for r in m.group(1).split(",")
-                         if r.strip()}
+                # a rule name never contains whitespace: cut each comma
+                # part at the first space so an ASCII "-- justification"
+                # tail doesn't corrupt the rule
+                rules = {r.split()[0] for r in m.group(1).split(",")
+                         if r.split()}
                 out.setdefault(tok.start[0], set()).update(rules)
         except tokenize.TokenError:
             pass
@@ -100,13 +103,24 @@ class Module:
 
 
 class Project:
-    def __init__(self, modules: list[Module], roots: list[str]):
+    def __init__(self, modules: list[Module], roots: list[str],
+                 cpp_modules: list | None = None):
         self.modules = modules
         self.roots = roots
+        # CppModule instances (analysis/cpp.py) for the shim sources
+        # adjacent to the roots; empty when no library/ tree is present
+        # (fixture projects), so C++ rules degrade to no-ops there
+        self.cpp_modules = cpp_modules or []
 
     def find_module(self, relpath_suffix: str) -> Module | None:
         """First module whose path ends with the given suffix (posix)."""
         for mod in self.modules:
+            if Path(mod.path).as_posix().endswith(relpath_suffix):
+                return mod
+        return None
+
+    def find_cpp_module(self, relpath_suffix: str):
+        for mod in self.cpp_modules:
             if Path(mod.path).as_posix().endswith(relpath_suffix):
                 return mod
         return None
@@ -151,6 +165,8 @@ def collect_py_files(paths: Iterable[str]) -> list[str]:
 
 
 def load_project(paths: Iterable[str]) -> tuple[Project, list[Finding]]:
+    from vtpu_manager.analysis import cpp
+
     modules: list[Module] = []
     errors: list[Finding] = []
     for path in collect_py_files(paths):
@@ -162,7 +178,11 @@ def load_project(paths: Iterable[str]) -> tuple[Project, list[Finding]]:
         except (OSError, UnicodeDecodeError) as e:
             errors.append(Finding("parse-error", path, 0,
                                   f"cannot read: {e}"))
-    return Project(modules, [str(p) for p in paths]), errors
+    roots = [str(p) for p in paths]
+    cpp_modules, cpp_errors = cpp.load_cpp_modules(roots)
+    for path, line, message in cpp_errors:
+        errors.append(Finding("parse-error", path, line, message))
+    return Project(modules, roots, cpp_modules=cpp_modules), errors
 
 
 def run_analysis(paths: Iterable[str], rules: Iterable[Rule],
@@ -173,6 +193,10 @@ def run_analysis(paths: Iterable[str], rules: Iterable[Rule],
     silently shrink its coverage."""
     project, findings = load_project(paths)
     by_path = {mod.path: mod for mod in project.modules}
+    # C++ modules share the same suppression contract (``// vtlint:
+    # disable=rule`` on the line or the line above); duck-typed
+    # is_suppressed keeps the filter below uniform
+    by_path.update({mod.path: mod for mod in project.cpp_modules})
     for rule in rules:
         raw: list[Finding] = []
         for mod in project.modules:

@@ -1,0 +1,825 @@
+"""Token-level C++ analysis for the cross-language conformance rules.
+
+The shim side of the L3 binary ABI lives in ``library/include/*.h`` and
+``library/src/*.cc``; the Python side in ``config/``+``telemetry/``.
+Keeping them honest previously required g++ (tests/test_config_abi.py
+compiles probe programs) — this module gives vtlint a compiler-free view
+of the same facts, in the framework's no-import/no-side-effect style:
+everything is derived from source text, nothing is compiled or executed.
+
+What it is NOT: a C++ front end. It is a lexer plus three narrow passes
+tuned to the shim's deliberately-restrained dialect (POD structs with
+explicit padding, constexpr integer constants, ``static_assert`` layout
+pins, free functions and plain methods):
+
+- ``constexpr`` integer folding (hex/dec/suffixed literals, arithmetic,
+  shifts, ``sizeof``/``offsetof`` over parsed structs);
+- struct layout computation under the ABI's own rules (little-endian,
+  natural alignment, trailing padding to the struct's alignment) — the
+  same model the static_asserts pin, so a drifted field moves both;
+- ``static_assert`` extraction and evaluation against the parsed layout;
+- function-body token streams for the protocol rules (fail-open,
+  cxx-seqlock).
+
+Suppressions mirror the Python side: ``// vtlint: disable=<rule>`` on the
+flagged line or the line directly above.
+
+Limits (documented in docs/static_analysis.md): no templates beyond
+recognizing ``std::atomic<T>`` declarations textually, no bitfields, no
+``#pragma pack``, no multiple inheritance — none of which the ABI surface
+uses, and a struct using them parses as *incomplete*, which the abi-mirror
+rule reports rather than silently skipping.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+_SUPPRESS_RE = re.compile(r"vtlint:\s*disable=([\w\-, ]+)")
+
+# identifiers that look like ``name (...) {`` but open control flow, not a
+# function definition
+_NON_FUNCTIONS = frozenset({
+    "if", "else", "for", "while", "switch", "do", "return", "sizeof",
+    "catch", "defined", "alignof", "offsetof", "static_assert", "assert",
+    "new", "delete", "throw", "case", "alignas", "decltype", "noexcept",
+})
+
+# natural sizes of the primitive types the ABI surface uses; alignment ==
+# size for all of them on the LP64 targets the shim supports
+PRIMITIVE_SIZES = {
+    "char": 1, "bool": 1, "int8_t": 1, "uint8_t": 1, "signed": 4,
+    "int16_t": 2, "uint16_t": 2, "short": 2,
+    "int": 4, "unsigned": 4, "int32_t": 4, "uint32_t": 4, "float": 4,
+    "int64_t": 8, "uint64_t": 8, "double": 8, "size_t": 8, "ssize_t": 8,
+    "long": 8, "time_t": 8, "off_t": 8, "uintptr_t": 8, "intptr_t": 8,
+}
+
+INTEGRAL_TYPES = frozenset(PRIMITIVE_SIZES) - {"float", "double", "bool"}
+
+
+class CppParseError(Exception):
+    def __init__(self, message: str, line: int):
+        super().__init__(message)
+        self.line = line
+
+
+@dataclass(frozen=True)
+class Tok:
+    kind: str   # id | num | str | char | punct
+    value: str
+    line: int
+
+
+@dataclass
+class FieldLayout:
+    name: str
+    type_name: str
+    offset: int
+    size: int
+    align: int
+    array_len: int | None
+    line: int
+
+
+@dataclass
+class StructLayout:
+    name: str
+    line: int
+    fields: list[FieldLayout] = field(default_factory=list)
+    size: int = 0
+    align: int = 1
+    complete: bool = True
+    error: str = ""
+
+    def offset_of(self, name: str) -> int | None:
+        for f in self.fields:
+            if f.name == name:
+                return f.offset
+        return None
+
+
+@dataclass
+class StaticAssert:
+    line: int
+    raw: str                 # the condition text, whitespace-normalized
+    ok: bool | None          # None: not statically evaluable
+    kind: str = ""           # "sizeof" | "offsetof" | ""
+    struct: str = ""
+    field: str = ""
+    expected: int | None = None   # folded RHS when kind is set
+
+    def signature(self) -> str:
+        """Stable identity for the golden (drop the line, keep the claim)."""
+        if self.kind == "sizeof":
+            return f"sizeof({self.struct})=={self.expected}"
+        if self.kind == "offsetof":
+            return f"offsetof({self.struct},{self.field})=={self.expected}"
+        return self.raw
+
+
+@dataclass
+class CppFunction:
+    name: str
+    qualname: str            # Class::name when the definition is scoped
+    line: int
+    tokens: list[Tok]        # body tokens, braces excluded
+
+
+@dataclass
+class GlobalVar:
+    name: str
+    type_text: str
+    line: int
+    atomic: bool
+    thread_local: bool
+    integral: bool
+
+
+def tokenize(text: str) -> tuple[list[Tok], dict[int, set[str]]]:
+    """(tokens, suppressions). Comments and preprocessor directives are
+    consumed here; ``vtlint: disable=`` comments feed the suppression map
+    (line of the comment, same two-line coverage as the Python side)."""
+    tokens: list[Tok] = []
+    suppress: dict[int, set[str]] = {}
+    i, n, line = 0, len(text), 1
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        if text.startswith("//", i):
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            m = _SUPPRESS_RE.search(text[i:j])
+            if m:
+                rules = {r.split()[0] for r in m.group(1).split(",")
+                         if r.split()}
+                suppress.setdefault(line, set()).update(rules)
+            i = j
+            continue
+        if text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            chunk = text[i:j + 2]
+            m = _SUPPRESS_RE.search(chunk)
+            if m:
+                rules = {r.split()[0] for r in m.group(1).split(",")
+                         if r.split()}
+                suppress.setdefault(line, set()).update(rules)
+            line += chunk.count("\n")
+            i = j + 2
+            continue
+        if c == "#" and (not tokens or tokens[-1].line != line):
+            # preprocessor directive: consume to end of line, honoring
+            # backslash continuations (guards/includes are not analyzed)
+            while i < n:
+                j = text.find("\n", i)
+                if j < 0:
+                    i = n
+                    break
+                if text[j - 1] == "\\":
+                    line += 1
+                    i = j + 1
+                    continue
+                i = j
+                break
+            continue
+        if c == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            tokens.append(Tok("str", text[i:j + 1], line))
+            i = j + 1
+            continue
+        if c == "'":
+            j = i + 1
+            while j < n and text[j] != "'":
+                j += 2 if text[j] == "\\" else 1
+            tokens.append(Tok("char", text[i:j + 1], line))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and text[i + 1].isdigit()):
+            j = i
+            while j < n and (text[j].isalnum() or text[j] in "."
+                             or (text[j] in "+-" and text[j - 1] in "eEpP")):
+                j += 1
+            tokens.append(Tok("num", text[i:j], line))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (text[j].isalnum() or text[j] == "_"):
+                j += 1
+            tokens.append(Tok("id", text[i:j], line))
+            i = j
+            continue
+        # multi-char operators that the rules care to see whole
+        for op in ("<<=", ">>=", "->", "::", "<<", ">>", "<=", ">=", "==",
+                   "!=", "&&", "||", "+=", "-=", "*=", "/=", "|=", "&=",
+                   "^=", "++", "--"):
+            if text.startswith(op, i):
+                tokens.append(Tok("punct", op, line))
+                i += len(op)
+                break
+        else:
+            tokens.append(Tok("punct", c, line))
+            i += 1
+    return tokens, suppress
+
+
+def parse_int_literal(text: str) -> int | None:
+    t = text.rstrip("uUlL")
+    try:
+        if t.lower().startswith("0x"):
+            return int(t, 16)
+        if t.lower().startswith("0b"):
+            return int(t, 2)
+        if any(ch in t for ch in ".eE") and not t.lower().startswith("0x"):
+            f = float(t)
+            return int(f) if f == int(f) else None
+        if t.startswith("0") and len(t) > 1:
+            return int(t, 8)
+        return int(t)
+    except ValueError:
+        return None
+
+
+class _Eval:
+    """Recursive-descent folder over a token slice: the constexpr dialect
+    (ints, names, sizeof/offsetof, arithmetic/shift/bit/compare ops)."""
+
+    def __init__(self, toks: list[Tok], env: dict[str, int],
+                 structs: dict[str, StructLayout]):
+        self.toks = toks
+        self.env = env
+        self.structs = structs
+        self.pos = 0
+
+    def peek(self) -> Tok | None:
+        return self.toks[self.pos] if self.pos < len(self.toks) else None
+
+    def take(self) -> Tok:
+        tok = self.peek()
+        if tok is None:
+            raise CppParseError("unexpected end of expression", 0)
+        self.pos += 1
+        return tok
+
+    def expect(self, value: str) -> None:
+        tok = self.take()
+        if tok.value != value:
+            raise CppParseError(f"expected {value!r}, got {tok.value!r}",
+                                tok.line)
+
+    def parse(self) -> int:
+        val = self.ternary()
+        if self.peek() is not None:
+            tok = self.peek()
+            raise CppParseError(f"trailing token {tok.value!r}", tok.line)
+        return val
+
+    def ternary(self) -> int:
+        cond = self.binary(0)
+        if self.peek() and self.peek().value == "?":
+            self.take()
+            a = self.ternary()
+            self.expect(":")
+            b = self.ternary()
+            return a if cond else b
+        return cond
+
+    _LEVELS = [["||"], ["&&"], ["|"], ["^"], ["&"],
+               ["==", "!="], ["<", "<=", ">", ">="],
+               ["<<", ">>"], ["+", "-"], ["*", "/", "%"]]
+
+    def binary(self, level: int) -> int:
+        if level >= len(self._LEVELS):
+            return self.unary()
+        val = self.binary(level + 1)
+        while (self.peek() and self.peek().kind == "punct"
+               and self.peek().value in self._LEVELS[level]):
+            op = self.take().value
+            rhs = self.binary(level + 1)
+            val = _apply(op, val, rhs)
+        return val
+
+    def unary(self) -> int:
+        tok = self.peek()
+        if tok and tok.kind == "punct" and tok.value in ("-", "+", "~", "!"):
+            self.take()
+            val = self.unary()
+            return {"-": -val, "+": val, "~": ~val,
+                    "!": int(not val)}[tok.value]
+        return self.primary()
+
+    def primary(self) -> int:
+        tok = self.take()
+        if tok.kind == "num":
+            val = parse_int_literal(tok.value)
+            if val is None:
+                raise CppParseError(f"non-integer literal {tok.value!r}",
+                                    tok.line)
+            return val
+        if tok.kind == "char" and len(tok.value) == 3:
+            return ord(tok.value[1])
+        if tok.kind == "punct" and tok.value == "(":
+            val = self.ternary()
+            self.expect(")")
+            return val
+        if tok.kind == "id":
+            if tok.value == "sizeof":
+                self.expect("(")
+                name = self._qualified_name()
+                self.expect(")")
+                return self._sizeof(name, tok.line)
+            if tok.value == "offsetof":
+                self.expect("(")
+                name = self._qualified_name()
+                self.expect(",")
+                member = self.take()
+                self.expect(")")
+                layout = self.structs.get(name)
+                off = layout.offset_of(member.value) if layout else None
+                if off is None or not layout.complete:
+                    raise CppParseError(
+                        f"offsetof({name}, {member.value}) unknown",
+                        tok.line)
+                return off
+            if tok.value in ("true", "false"):
+                return int(tok.value == "true")
+            if tok.value in self.env:
+                return self.env[tok.value]
+            raise CppParseError(f"unknown name {tok.value!r}", tok.line)
+        raise CppParseError(f"unexpected token {tok.value!r}", tok.line)
+
+    def _qualified_name(self) -> str:
+        parts = [self.take().value]
+        while self.peek() and self.peek().value == "::":
+            self.take()
+            parts.append(self.take().value)
+        return parts[-1]   # namespaces don't affect layout lookup
+
+    def _sizeof(self, name: str, line: int) -> int:
+        if name in PRIMITIVE_SIZES:
+            return PRIMITIVE_SIZES[name]
+        layout = self.structs.get(name)
+        if layout is not None and layout.complete:
+            return layout.size
+        raise CppParseError(f"sizeof({name}) unknown", line)
+
+
+def _apply(op: str, a: int, b: int) -> int:
+    if op == "||":
+        return int(bool(a) or bool(b))
+    if op == "&&":
+        return int(bool(a) and bool(b))
+    table = {
+        "|": a | b, "^": a ^ b, "&": a & b, "==": int(a == b),
+        "!=": int(a != b), "<": int(a < b), "<=": int(a <= b),
+        ">": int(a > b), ">=": int(a >= b), "<<": a << b, ">>": a >> b,
+        "+": a + b, "-": a - b, "*": a * b,
+        "/": a // b if b else 0, "%": a % b if b else 0,
+    }
+    return table[op]
+
+
+def fold_tokens(toks: list[Tok], env: dict[str, int],
+                structs: dict[str, StructLayout]) -> int:
+    return _Eval(toks, env, structs).parse()
+
+
+_GLOBAL_DECL_RE = re.compile(
+    r"^(?:static\s+)?(?:thread_local\s+)?"
+    r"(?P<type>(?:std::atomic<[^>\n]+>|const\s+\w+"
+    r"|(?:unsigned\s+)?long\s+long(?:\s+int)?|unsigned\s+\w+|[\w:]+)"
+    r"(?:\s*[*&])?)\s+"
+    r"(?P<name>g_\w+)\s*(?:=|\{|;)", re.MULTILINE)
+
+
+class CppModule:
+    """One lexed+parsed C++ source file plus its suppression map."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.tokens, self.suppressions = tokenize(text)
+        self.env: dict[str, int] = {}
+        self.env_lines: dict[str, int] = {}
+        self.structs: dict[str, StructLayout] = {}
+        self.static_asserts: list[StaticAssert] = []
+        self.functions: list[CppFunction] = []
+        self.globals: dict[str, GlobalVar] = {}
+        self._parse_globals()
+        self._parse_top_level()
+        self._parse_functions()
+
+    @classmethod
+    def load(cls, path: str) -> "CppModule":
+        return cls(path, Path(path).read_text())
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        for cand in (line, line - 1):
+            if rule in self.suppressions.get(cand, ()):
+                return True
+        return False
+
+    # -- file-scope variable survey (cxx-seqlock) -------------------------
+
+    def _parse_globals(self) -> None:
+        """File-scope ``g_*`` declarations, by the shim's own idiom:
+        declarations start in column 0 (everything indented is function
+        or class scope)."""
+        for m in _GLOBAL_DECL_RE.finditer(self.text):
+            prefix = self.text[:m.start()]
+            type_text = m.group("type")
+            self.globals[m.group("name")] = GlobalVar(
+                name=m.group("name"), type_text=type_text,
+                line=prefix.count("\n") + 1,
+                atomic="atomic" in type_text,
+                thread_local="thread_local" in m.group(0),
+                integral=type_text.split()[-1] in INTEGRAL_TYPES,
+            )
+
+    # -- declarations: constexpr / enum / struct / static_assert ----------
+
+    def _parse_top_level(self) -> None:
+        toks = self.tokens
+        i, n = 0, len(toks)
+        while i < n:
+            tok = toks[i]
+            if tok.kind != "id":
+                i += 1
+                continue
+            if tok.value in ("constexpr", "enum", "static_assert"):
+                handler = {"constexpr": self._parse_constexpr,
+                           "enum": self._parse_enum,
+                           "static_assert": self._parse_static_assert}
+                i = handler[tok.value](i)
+                continue
+            if tok.value == "struct" and i + 2 < n \
+                    and toks[i + 1].kind == "id" \
+                    and toks[i + 2].value == "{":
+                i = self._parse_struct(i)
+                continue
+            i += 1
+
+    def _find(self, start: int, value: str) -> int:
+        for j in range(start, len(self.tokens)):
+            if self.tokens[j].value == value:
+                return j
+        return len(self.tokens)
+
+    def _match_brace(self, open_idx: int) -> int:
+        """Index of the ``}`` matching the ``{`` at open_idx."""
+        depth = 0
+        for j in range(open_idx, len(self.tokens)):
+            v = self.tokens[j].value
+            if v == "{":
+                depth += 1
+            elif v == "}":
+                depth -= 1
+                if depth == 0:
+                    return j
+        return len(self.tokens) - 1
+
+    def _parse_constexpr(self, i: int) -> int:
+        # constexpr <type...> <name> = <expr> ;
+        toks = self.tokens
+        end = self._find(i, ";")
+        eq = self._find(i, "=")
+        if eq >= end:
+            return end + 1
+        name = toks[eq - 1]
+        if name.kind == "id":
+            try:
+                self.env[name.value] = fold_tokens(
+                    toks[eq + 1:end], self.env, self.structs)
+                self.env_lines[name.value] = name.line
+            except (CppParseError, KeyError, ZeroDivisionError,
+                    OverflowError):
+                pass   # non-integer constexpr (string, fp): not layout
+        return end + 1
+
+    def _parse_enum(self, i: int) -> int:
+        # enum [class] Name [: base] { A [= expr], B, ... };
+        toks = self.tokens
+        j = i + 1
+        if j < len(toks) and toks[j].value in ("class", "struct"):
+            j += 1
+        if j >= len(toks) or toks[j].kind != "id":
+            return i + 1
+        name = toks[j].value
+        j += 1
+        base = "int"
+        if j < len(toks) and toks[j].value == ":":
+            base = toks[j + 1].value
+            j += 2
+        if j >= len(toks) or toks[j].value != "{":
+            return j   # forward declaration / enum-typed variable
+        close = self._match_brace(j)
+        size = PRIMITIVE_SIZES.get(base, 4)
+        self.structs[name] = StructLayout(
+            name=name, line=toks[i].line, size=size, align=size)
+        # enumerators are constants usable by later folds
+        next_val = 0
+        k = j + 1
+        while k < close:
+            if toks[k].kind == "id":
+                ename = toks[k].value
+                if k + 1 < close and toks[k + 1].value == "=":
+                    stop = k + 2
+                    depth = 0
+                    while stop < close:
+                        v = toks[stop].value
+                        if v == "(":
+                            depth += 1
+                        elif v == ")":
+                            depth -= 1
+                        elif v == "," and depth == 0:
+                            break
+                        stop += 1
+                    try:
+                        next_val = fold_tokens(toks[k + 2:stop], self.env,
+                                               self.structs)
+                    except (CppParseError, KeyError):
+                        next_val = 0
+                    k = stop
+                self.env[ename] = next_val
+                next_val += 1
+            k += 1
+        return close + 1
+
+    def _parse_struct(self, i: int) -> int:
+        toks = self.tokens
+        name = toks[i + 1].value
+        open_idx = i + 2
+        close = self._match_brace(open_idx)
+        layout = StructLayout(name=name, line=toks[i].line)
+        offset = 0
+        j = open_idx + 1
+        while j < close:
+            tok = toks[j]
+            if tok.kind != "id":
+                j += 1
+                continue
+            # one member: [const] type name [\[dim\]]* ;
+            stmt_end = j
+            depth = 0
+            while stmt_end < close:
+                v = toks[stmt_end].value
+                if v in ("(", "["):
+                    depth += 1
+                elif v in (")", "]"):
+                    depth -= 1
+                elif v == ";" and depth == 0:
+                    break
+                elif v == "{":
+                    # nested definition or method body: not a POD member
+                    layout.complete = False
+                    layout.error = (f"non-POD construct at line "
+                                    f"{toks[stmt_end].line}")
+                    stmt_end = self._match_brace(stmt_end)
+                    depth = 0
+                stmt_end += 1
+            member = toks[j:stmt_end]
+            j = stmt_end + 1
+            parsed = self._parse_member(member)
+            if parsed is None:
+                if member and member[0].value not in ("public", "private",
+                                                      "protected", "using",
+                                                      "friend"):
+                    layout.complete = False
+                    layout.error = layout.error or (
+                        f"unparsed member near line {member[0].line}")
+                continue
+            fname, type_name, elem_size, elem_align, array_len, line = parsed
+            if elem_size is None:
+                layout.complete = False
+                layout.error = (f"unknown member type {type_name!r} at "
+                                f"line {line}")
+                continue
+            pad = (-offset) % elem_align
+            offset += pad
+            total = elem_size * (array_len if array_len is not None else 1)
+            layout.fields.append(FieldLayout(
+                name=fname, type_name=type_name, offset=offset,
+                size=total, align=elem_align, array_len=array_len,
+                line=line))
+            offset += total
+            layout.align = max(layout.align, elem_align)
+        layout.size = offset + ((-offset) % layout.align)
+        self.structs[name] = layout
+        return close + 1
+
+    def _parse_member(self, toks: list[Tok]
+                      ) -> tuple[str, str, int | None, int, int | None,
+                                 int] | None:
+        """(name, type, elem_size, elem_align, array_len, line); None for
+        non-member statements (access specifiers, methods — the caller
+        decides whether that breaks completeness)."""
+        toks = [t for t in toks if t.value not in ("const", "volatile",
+                                                   "mutable", "struct")]
+        if not toks:
+            return None
+        # find the declarator name: last id before `[` or end
+        bracket = next((k for k, t in enumerate(toks) if t.value == "["),
+                       len(toks))
+        if bracket == 0 or toks[bracket - 1].kind != "id":
+            return None
+        name_tok = toks[bracket - 1]
+        type_toks = toks[:bracket - 1]
+        if not type_toks or any(t.value in ("(", ")") for t in toks):
+            return None   # method / function pointer: not a POD member
+        type_name = type_toks[-1].value
+        if any(t.value == "*" for t in type_toks):
+            elem_size, elem_align = 8, 8
+            type_name += "*"
+        elif type_name in PRIMITIVE_SIZES:
+            base = PRIMITIVE_SIZES[type_name]
+            # `unsigned long long x` styles: widest keyword wins
+            widths = [PRIMITIVE_SIZES[t.value] for t in type_toks
+                      if t.value in PRIMITIVE_SIZES]
+            base = max(widths) if widths else base
+            if [t.value for t in type_toks].count("long") == 2:
+                base = 8
+            elem_size = elem_align = base
+        elif type_name in self.structs:
+            sub = self.structs[type_name]
+            if not sub.complete:
+                return (name_tok.value, type_name, None, 1, None,
+                        name_tok.line)
+            elem_size, elem_align = sub.size, sub.align
+        else:
+            return (name_tok.value, type_name, None, 1, None,
+                    name_tok.line)
+        array_len: int | None = None
+        if bracket < len(toks):
+            closing = next((k for k in range(bracket + 1, len(toks))
+                            if toks[k].value == "]"), len(toks))
+            try:
+                array_len = fold_tokens(toks[bracket + 1:closing],
+                                        self.env, self.structs)
+            except (CppParseError, KeyError):
+                return (name_tok.value, type_name, None, elem_align,
+                        None, name_tok.line)
+        return (name_tok.value, type_name, elem_size, elem_align,
+                array_len, name_tok.line)
+
+    def _parse_static_assert(self, i: int) -> int:
+        toks = self.tokens
+        if i + 1 >= len(toks) or toks[i + 1].value != "(":
+            return i + 1
+        depth = 0
+        end = i + 1
+        cond_end = None
+        for j in range(i + 1, len(toks)):
+            v = toks[j].value
+            if v == "(":
+                depth += 1
+            elif v == ")":
+                depth -= 1
+                if depth == 0:
+                    end = j
+                    break
+            elif v == "," and depth == 1 and cond_end is None:
+                cond_end = j
+        cond = toks[i + 2:cond_end if cond_end is not None else end]
+        raw = " ".join(t.value for t in cond)
+        sa = StaticAssert(line=toks[i].line, raw=raw, ok=None)
+        try:
+            sa.ok = bool(fold_tokens(cond, self.env, self.structs))
+        except (CppParseError, KeyError, ZeroDivisionError):
+            sa.ok = None
+        self._classify_assert(sa, cond)
+        self.static_asserts.append(sa)
+        return end + 1
+
+    def _classify_assert(self, sa: StaticAssert, cond: list[Tok]) -> None:
+        """Recognize the two pinned shapes: sizeof(T) == N and
+        offsetof(T, f) == N (N may be any foldable expression)."""
+        vals = [t.value for t in cond]
+        if "==" not in vals:
+            return
+        eq = vals.index("==")
+        lhs, rhs = cond[:eq], cond[eq + 1:]
+        try:
+            expected = fold_tokens(rhs, self.env, self.structs)
+        except (CppParseError, KeyError):
+            return
+        lv = [t.value for t in lhs]
+        if len(lv) >= 4 and lv[0] == "sizeof" and lv[1] == "(" \
+                and lv[-1] == ")":
+            sa.kind, sa.struct, sa.expected = "sizeof", lv[-2], expected
+        elif len(lv) >= 6 and lv[0] == "offsetof" and lv[1] == "(" \
+                and lv[-1] == ")":
+            comma = lv.index(",") if "," in lv else -1
+            if comma > 2:
+                sa.kind = "offsetof"
+                sa.struct = lv[comma - 1]
+                sa.field = lv[comma + 1]
+                sa.expected = expected
+
+    # -- function bodies (fail-open, cxx-seqlock) --------------------------
+
+    def _parse_functions(self) -> None:
+        toks = self.tokens
+        i, n = 0, len(toks)
+        while i < n - 2:
+            tok = toks[i]
+            if (tok.kind != "id" or tok.value in _NON_FUNCTIONS
+                    or toks[i + 1].value != "("):
+                i += 1
+                continue
+            # find the matching `)` of the parameter list
+            depth = 0
+            close = None
+            for j in range(i + 1, n):
+                v = toks[j].value
+                if v == "(":
+                    depth += 1
+                elif v == ")":
+                    depth -= 1
+                    if depth == 0:
+                        close = j
+                        break
+                elif v in (";", "{"):
+                    break
+            if close is None:
+                i += 1
+                continue
+            j = close + 1
+            while j < n and toks[j].kind == "id" \
+                    and toks[j].value in ("const", "noexcept", "override",
+                                          "final"):
+                j += 1
+            if j < n and toks[j].value == ":":
+                # constructor initializer list: skip to the body brace
+                depth = 0
+                while j < n and not (toks[j].value == "{" and depth == 0):
+                    if toks[j].value in ("(", "{"):
+                        depth += 1 if toks[j].value == "(" else 0
+                    if toks[j].value == ")":
+                        depth -= 1
+                    j += 1
+            if j >= n or toks[j].value != "{":
+                i += 1
+                continue
+            body_close = self._match_brace(j)
+            qual = tok.value
+            if i >= 2 and toks[i - 1].value == "::" \
+                    and toks[i - 2].kind == "id":
+                qual = f"{toks[i - 2].value}::{tok.value}"
+            self.functions.append(CppFunction(
+                name=tok.value, qualname=qual, line=tok.line,
+                tokens=toks[j + 1:body_close]))
+            i = body_close + 1
+
+
+def collect_cpp_files(roots: Iterable[str]) -> list[str]:
+    """The shim sources adjacent to the linted roots: for each root, the
+    first of ``<root>/library`` or ``<root>/../library`` that exists
+    contributes ``include/*.h`` + ``src/*.cc`` (the analyzed dialect; the
+    cmake test harness under ``library/test`` is not shim code)."""
+    seen: set[str] = set()
+    files: list[str] = []
+    for root in roots:
+        r = Path(root)
+        if r.is_file():
+            r = r.parent
+        for base in (r, r.parent):
+            lib = base / "library"
+            if not lib.is_dir():
+                continue
+            for sub in (sorted((lib / "include").glob("*.h"))
+                        + sorted((lib / "src").glob("*.cc"))):
+                key = str(sub.resolve())
+                if key not in seen:
+                    seen.add(key)
+                    files.append(str(sub))
+            break
+    return files
+
+
+def load_cpp_modules(roots: Iterable[str]
+                     ) -> tuple[list[CppModule], list[tuple[str, int, str]]]:
+    """(modules, errors) — errors as (path, line, message) tuples so the
+    caller can surface them as parse-error findings without a core
+    import cycle."""
+    modules: list[CppModule] = []
+    errors: list[tuple[str, int, str]] = []
+    for path in collect_cpp_files(roots):
+        try:
+            modules.append(CppModule.load(path))
+        except (OSError, UnicodeDecodeError) as e:
+            errors.append((path, 0, f"cannot read: {e}"))
+        except CppParseError as e:
+            errors.append((path, e.line, f"cannot parse: {e}"))
+    return modules, errors

@@ -4,13 +4,23 @@ from __future__ import annotations
 
 from vtpu_manager.analysis.core import Rule
 from vtpu_manager.analysis.rules.abi_drift import AbiDriftRule
+from vtpu_manager.analysis.rules.abi_mirror import AbiMirrorRule
+from vtpu_manager.analysis.rules.cxx_seqlock import CxxSeqlockRule
 from vtpu_manager.analysis.rules.exception_hygiene import \
     ExceptionHygieneRule
+from vtpu_manager.analysis.rules.fail_open import FailOpenRule
+from vtpu_manager.analysis.rules.failpoint_catalog import \
+    FailpointCatalogRule
 from vtpu_manager.analysis.rules.featuregate_hygiene import \
     FeaturegateHygieneRule
 from vtpu_manager.analysis.rules.lock_discipline import LockDisciplineRule
+from vtpu_manager.analysis.rules.metrics_registry import MetricsRegistryRule
+from vtpu_manager.analysis.rules.predicate_ride_along import \
+    PredicateRideAlongRule
 from vtpu_manager.analysis.rules.retry_hygiene import RetryHygieneRule
+from vtpu_manager.analysis.rules.ring_io import RingIoRule
 from vtpu_manager.analysis.rules.seqlock_protocol import SeqlockProtocolRule
+from vtpu_manager.analysis.rules.stalecodec import StalecodecRule
 
 
 def all_rules(abi_golden: str | None = None) -> list[Rule]:
@@ -19,6 +29,16 @@ def all_rules(abi_golden: str | None = None) -> list[Rule]:
         LockDisciplineRule(),
         SeqlockProtocolRule(),
         AbiDriftRule(golden_path=abi_golden),
+        # cross-language conformance (the cpp pass, analysis/cpp.py)
+        AbiMirrorRule(golden_path=abi_golden),
+        FailOpenRule(),
+        CxxSeqlockRule(),
+        # plane-protocol rules
+        StalecodecRule(),
+        RingIoRule(),
+        PredicateRideAlongRule(),
+        FailpointCatalogRule(),
+        MetricsRegistryRule(),
         FeaturegateHygieneRule(),
         ExceptionHygieneRule(),
         RetryHygieneRule(),

@@ -1,0 +1,494 @@
+"""abi-mirror: the C++ shim headers, the Python packers, and the golden
+must tell the same layout story — checked three ways, without a compiler.
+
+The L3 binary ABI exists in three places: ``library/include/vtpu_config.h``
++ ``vtpu_telemetry.h`` (the shim's structs, pinned by ``static_assert``),
+the Python ``struct`` packers (config/vtpu_config.py, config/tc_watcher.py,
+config/vmem.py, telemetry/stepring.py — whose derived offsets abi-drift
+already anchors to ``abi_golden.json``), and the golden itself. Before this
+rule, a header edit was only caught when g++ compiled the probe programs at
+test time; now the headers are parsed (analysis/cpp.py) and every leg of
+the triangle is compared at lint time:
+
+- C++ vs Python: struct field offsets vs the ``*_OFFSETS`` tables, derived
+  sizes (``sizeof``/``offsetof``) vs the packers' ``*_SIZE`` constants, and
+  shared scalar constants (magics, versions, capacities) pairwise.
+- C++ vs golden: parsed struct layouts and constants vs the golden's
+  ``cxx`` section — so editing only the header is red, exactly like
+  editing only the packer already is.
+- static_asserts: every assert in the two ABI headers must *evaluate true*
+  under the parsed layout (a drifted offset flips its own assert red at
+  lint time), and the set of assert claims is itself golden-anchored — a
+  DROPPED static_assert is a finding, because deleting the pin is the
+  first move of an accidental ABI break.
+
+A drift in any one source against the other two yields findings naming the
+field and both offsets. Intentional ABI bumps stay a two-step edit:
+change all mirrors AND ``python scripts/vtlint.py --update-abi-golden``.
+
+The rule is a silent no-op when the project has no C++ modules (fixture
+trees without a ``library/``) — the Python-only abi-drift rule still
+covers those.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+from typing import Iterable
+
+from vtpu_manager.analysis.constfold import Unfoldable, fold_expr, \
+    fold_module_constants
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule
+from vtpu_manager.analysis.rules.abi_drift import DEFAULT_GOLDEN, \
+    compute_layout
+
+RULE = "abi-mirror"
+
+# the two headers whose static_asserts pin the cross-language ABI
+ABI_HEADERS = ("vtpu_config.h", "vtpu_telemetry.h")
+
+# structs frozen into the golden's cxx section (the full ABI surface)
+GOLDEN_STRUCTS = (
+    "VtpuDevice", "VtpuConfig", "TcProcUtil", "TcDeviceRecord",
+    "TcUtilFile", "TcCalibration", "VmemEntry", "VmemFile",
+    "PidsFileHeader", "StepRingHeader", "StepRecord",
+)
+
+# constexprs frozen into the golden's cxx section
+GOLDEN_CONSTANTS = (
+    "kConfigMagic", "kConfigVersion", "kMaxDeviceCount", "kUuidLen",
+    "kNameLen", "kPodUidLen", "kCacheDirLen",
+    "kTcUtilMagic", "kTcUtilVersion2", "kMaxProcs", "kMaxExcessPoints",
+    "kVmemMagic", "kVmemVersion", "kVmemMaxEntries", "kPidsMagic",
+    "kStepRingMagic", "kStepRingVersion", "kStepRingCapacity",
+    "kStepTraceIdLen", "kStepFlagCompile", "kCommSignalStalenessNs",
+    "kStepRingFileSize",
+)
+
+# C++ struct -> (python module suffix, offsets-table name, skipped C++
+# fields). Explicit padding (pad_, pad2_, ici_pad_) is skipped when the
+# Python table doesn't carry it — the pads still move the asserts and the
+# neighbor offsets, so they stay pinned transitively.
+FIELD_MIRRORS = (
+    ("VtpuDevice", "config/vtpu_config.py", "DEVICE_OFFSETS", ()),
+    # devices[] starts the body (HEADER_SIZE == offsetof) and the trailer
+    # (checksum) is not part of the header table
+    ("VtpuConfig", "config/vtpu_config.py", "HEADER_OFFSETS",
+     ("devices", "checksum")),
+    ("StepRingHeader", "telemetry/stepring.py", "HEADER_OFFSETS", ()),
+    ("StepRecord", "telemetry/stepring.py", "RECORD_OFFSETS", ()),
+)
+
+_PAD_RE = re.compile(r"(^|_)pad\d*$")
+
+# python derived constant == expression over the parsed C++ layout
+# (py module key is abi_drift's TRACKED key; the callable gets
+# (structs, env) and may raise KeyError when the C++ side is missing)
+SIZE_MIRRORS = (
+    ("vtpu_config", "DEVICE_SIZE", "sizeof(VtpuDevice)",
+     lambda s, e: s["VtpuDevice"].size),
+    ("vtpu_config", "HEADER_SIZE", "offsetof(VtpuConfig, devices)",
+     lambda s, e: s["VtpuConfig"].offset_of("devices")),
+    ("vtpu_config", "CONFIG_SIZE", "sizeof(VtpuConfig)",
+     lambda s, e: s["VtpuConfig"].size),
+    ("tc_watcher", "HEADER_SIZE", "offsetof(TcUtilFile, records)",
+     lambda s, e: s["TcUtilFile"].offset_of("records")),
+    ("tc_watcher", "PROC_SIZE", "sizeof(TcProcUtil)",
+     lambda s, e: s["TcProcUtil"].size),
+    ("tc_watcher", "RECORD_SIZE", "sizeof(TcDeviceRecord)",
+     lambda s, e: s["TcDeviceRecord"].size),
+    ("tc_watcher", "CAL_SIZE", "sizeof(TcCalibration)",
+     lambda s, e: s["TcCalibration"].size),
+    ("tc_watcher", "CAL_OFFSET", "sizeof(TcUtilFile)",
+     lambda s, e: s["TcUtilFile"].size),
+    ("tc_watcher", "FILE_SIZE", "sizeof(TcUtilFile)+sizeof(TcCalibration)",
+     lambda s, e: s["TcUtilFile"].size + s["TcCalibration"].size),
+    ("vmem", "HEADER_SIZE", "offsetof(VmemFile, entries)",
+     lambda s, e: s["VmemFile"].offset_of("entries")),
+    ("vmem", "ENTRY_SIZE", "sizeof(VmemEntry)",
+     lambda s, e: s["VmemEntry"].size),
+    ("vmem", "FILE_SIZE", "sizeof(VmemFile)",
+     lambda s, e: s["VmemFile"].size),
+    ("stepring", "HEADER_SIZE", "sizeof(StepRingHeader)",
+     lambda s, e: s["StepRingHeader"].size),
+    ("stepring", "RECORD_SIZE", "sizeof(StepRecord)",
+     lambda s, e: s["StepRecord"].size),
+    ("stepring", "FILE_SIZE", "kStepRingFileSize",
+     lambda s, e: e["kStepRingFileSize"]),
+)
+
+# scalar constants shared across the language boundary
+CONSTANT_PAIRS = (
+    ("vtpu_config", "MAGIC", "kConfigMagic"),
+    ("vtpu_config", "VERSION", "kConfigVersion"),
+    ("vtpu_config", "MAX_DEVICE_COUNT", "kMaxDeviceCount"),
+    ("vtpu_config", "UUID_LEN", "kUuidLen"),
+    ("vtpu_config", "NAME_LEN", "kNameLen"),
+    ("vtpu_config", "POD_UID_LEN", "kPodUidLen"),
+    ("vtpu_config", "CACHE_DIR_LEN", "kCacheDirLen"),
+    ("tc_watcher", "MAGIC", "kTcUtilMagic"),
+    ("tc_watcher", "VERSION", "kTcUtilVersion2"),
+    ("tc_watcher", "MAX_DEVICE_COUNT", "kMaxDeviceCount"),
+    ("tc_watcher", "MAX_PROCS", "kMaxProcs"),
+    ("tc_watcher", "MAX_EXCESS_POINTS", "kMaxExcessPoints"),
+    ("vmem", "MAGIC", "kVmemMagic"),
+    ("vmem", "VERSION", "kVmemVersion"),
+    ("vmem", "MAX_ENTRIES", "kVmemMaxEntries"),
+    ("stepring", "MAGIC", "kStepRingMagic"),
+    ("stepring", "VERSION", "kStepRingVersion"),
+    ("stepring", "RING_CAPACITY", "kStepRingCapacity"),
+    ("stepring", "TRACE_ID_LEN", "kStepTraceIdLen"),
+    ("stepring", "FLAG_COMPILE", "kStepFlagCompile"),
+    ("stepring", "COMM_SIGNAL_STALENESS_NS", "kCommSignalStalenessNs"),
+)
+
+# TRACKED keys -> module suffixes (mirrors abi_drift.TRACKED's first slot)
+_PY_SUFFIX = {
+    "vtpu_config": "config/vtpu_config.py",
+    "tc_watcher": "config/tc_watcher.py",
+    "vmem": "config/vmem.py",
+    "stepring": "telemetry/stepring.py",
+}
+
+
+def _merge(project: Project):
+    """(structs, env, env_owner) across all C++ modules, in load order
+    (headers before sources — collect_cpp_files guarantees it)."""
+    structs: dict = {}
+    env: dict[str, int] = {}
+    owner: dict[str, tuple] = {}   # name -> (module, line)
+    for mod in project.cpp_modules:
+        structs.update(mod.structs)
+        env.update(mod.env)
+        for name, line in mod.env_lines.items():
+            owner[name] = (mod, line)
+        for name, s in mod.structs.items():
+            owner.setdefault(f"struct:{name}", (mod, s.line))
+    return structs, env, owner
+
+
+def compute_cxx_layout(project: Project) -> dict:
+    """The golden's ``cxx`` section: struct sizes+field offsets, the
+    frozen constexprs, and the static_assert claims of the ABI headers.
+    Empty dict when the project has no C++ modules."""
+    if not project.cpp_modules:
+        return {}
+    structs, env, _ = _merge(project)
+    out: dict = {"structs": {}, "constants": {}, "static_asserts": []}
+    for name in GOLDEN_STRUCTS:
+        s = structs.get(name)
+        if s is None or not s.complete:
+            continue
+        out["structs"][name] = {
+            "size": s.size,
+            "fields": {f.name: f.offset for f in s.fields},
+        }
+    for name in GOLDEN_CONSTANTS:
+        if name in env:
+            out["constants"][name] = env[name]
+    sigs: set[str] = set()
+    for mod in project.cpp_modules:
+        if not mod.path.endswith(ABI_HEADERS):
+            continue
+        sigs.update(sa.signature() for sa in mod.static_asserts)
+    out["static_asserts"] = sorted(sigs)
+    return out
+
+
+def _py_offsets(module: Module, table_name: str
+                ) -> tuple[dict[str, int], int] | None:
+    """(field -> offset, assign line) folded out of a dict literal."""
+    env = fold_module_constants(module.tree)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == table_name
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        table: dict[str, int] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                return None
+            try:
+                table[k.value] = int(fold_expr(v, env))
+            except (Unfoldable, TypeError, ValueError):
+                return None
+        return table, node.lineno
+    return None
+
+
+class AbiMirrorRule(Rule):
+    name = RULE
+    description = ("C++ shim headers, Python struct packers, and "
+                   "abi_golden.json agree on every ABI layout "
+                   "(three-way, compiler-free)")
+
+    def __init__(self, golden_path: str | None = None):
+        self.golden_path = Path(golden_path) if golden_path \
+            else DEFAULT_GOLDEN
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        if not project.cpp_modules:
+            return []
+        structs, env, owner = _merge(project)
+        out: list[Finding] = []
+        anchor = project.cpp_modules[0]
+
+        out.extend(self._check_asserts_hold(project))
+
+        try:
+            golden = json.loads(self.golden_path.read_text()).get("cxx")
+        except FileNotFoundError:
+            golden = None
+        except (OSError, json.JSONDecodeError) as e:
+            return out + [Finding(RULE, anchor.path, 1,
+                                  f"golden ABI file unreadable: {e}")]
+        if golden is None:
+            out.append(Finding(
+                RULE, anchor.path, 1,
+                f"no 'cxx' section in {self.golden_path.name} — the C++ "
+                f"layouts are unanchored; regenerate with 'python "
+                f"scripts/vtlint.py --update-abi-golden'"))
+            golden = {}
+
+        out.extend(self._check_golden_structs(
+            project, structs, owner, golden.get("structs", {})))
+        out.extend(self._check_golden_constants(
+            env, owner, anchor, golden.get("constants", {})))
+        out.extend(self._check_golden_asserts(
+            project, golden.get("static_asserts", [])))
+        out.extend(self._check_py_fields(project, structs, golden))
+        out.extend(self._check_py_sizes(project, structs, env))
+        out.extend(self._check_py_constants(project, env, owner))
+        return out
+
+    # -- leg 1: the headers' own static_asserts must hold ------------------
+
+    def _check_asserts_hold(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.cpp_modules:
+            if not mod.path.endswith(ABI_HEADERS):
+                continue
+            for sa in mod.static_asserts:
+                if sa.ok is True:
+                    continue
+                if sa.ok is False:
+                    out.append(Finding(
+                        RULE, mod.path, sa.line,
+                        f"static_assert({sa.raw}) is FALSE under the "
+                        f"parsed layout — a field drifted away from its "
+                        f"pin; every mapped reader would misread this "
+                        f"struct"))
+                else:
+                    out.append(Finding(
+                        RULE, mod.path, sa.line,
+                        f"static_assert({sa.raw}) is not statically "
+                        f"evaluable by the cpp pass — ABI pins must stay "
+                        f"in the sizeof/offsetof == constant dialect"))
+        return out
+
+    # -- leg 2: C++ vs golden ---------------------------------------------
+
+    def _check_golden_structs(self, project, structs, owner,
+                              golden_structs) -> list[Finding]:
+        out: list[Finding] = []
+        anchor = project.cpp_modules[0]
+        for name in GOLDEN_STRUCTS:
+            s = structs.get(name)
+            want = golden_structs.get(name)
+            if s is None or not s.complete:
+                why = s.error if s is not None else "not found"
+                out.append(Finding(
+                    RULE, anchor.path, s.line if s else 1,
+                    f"ABI struct {name} could not be fully parsed "
+                    f"({why}) — the cpp pass must see the whole layout"))
+                continue
+            mod, line = owner.get(f"struct:{name}", (anchor, s.line))
+            if want is None:
+                out.append(Finding(
+                    RULE, mod.path, line,
+                    f"struct {name} is not in the golden's cxx section; "
+                    f"regenerate with --update-abi-golden"))
+                continue
+            if s.size != want.get("size"):
+                out.append(Finding(
+                    RULE, mod.path, line,
+                    f"ABI drift: sizeof({name}) = {s.size} in the header "
+                    f"but the committed golden says {want.get('size')} — "
+                    f"if intentional, bump the golden: python "
+                    f"scripts/vtlint.py --update-abi-golden"))
+            want_fields = want.get("fields", {})
+            live_fields = {f.name: f for f in s.fields}
+            for fname, f in live_fields.items():
+                if fname not in want_fields:
+                    out.append(Finding(
+                        RULE, mod.path, f.line,
+                        f"field {name}.{fname} (offset {f.offset}) is not "
+                        f"in the golden; intentional layout additions "
+                        f"need an --update-abi-golden bump"))
+                elif f.offset != want_fields[fname]:
+                    out.append(Finding(
+                        RULE, mod.path, f.line,
+                        f"ABI drift: {name}.{fname} is at offset "
+                        f"{f.offset} in the header but the golden says "
+                        f"{want_fields[fname]}"))
+            for fname in want_fields:
+                if fname not in live_fields:
+                    out.append(Finding(
+                        RULE, mod.path, line,
+                        f"field {name}.{fname} (golden offset "
+                        f"{want_fields[fname]}) was removed from the "
+                        f"header but is still in the golden"))
+        return out
+
+    def _check_golden_constants(self, env, owner, anchor,
+                                golden_constants) -> list[Finding]:
+        out: list[Finding] = []
+        for name in GOLDEN_CONSTANTS:
+            live = env.get(name)
+            want = golden_constants.get(name)
+            mod, line = owner.get(name, (anchor, 1))
+            if live is None:
+                out.append(Finding(
+                    RULE, mod.path, line,
+                    f"constexpr {name} is gone (or no longer foldable) "
+                    f"from the shim headers but is part of the frozen "
+                    f"ABI surface"))
+            elif want is None:
+                out.append(Finding(
+                    RULE, mod.path, line,
+                    f"constexpr {name} = {live} is not in the golden's "
+                    f"cxx constants; regenerate with --update-abi-golden"))
+            elif live != want:
+                out.append(Finding(
+                    RULE, mod.path, line,
+                    f"ABI drift: constexpr {name} = {live} in the header "
+                    f"but the committed golden says {want}"))
+        return out
+
+    def _check_golden_asserts(self, project,
+                              golden_sigs: list) -> list[Finding]:
+        out: list[Finding] = []
+        live: dict[str, tuple] = {}
+        header_mods = [m for m in project.cpp_modules
+                       if m.path.endswith(ABI_HEADERS)]
+        for mod in header_mods:
+            for sa in mod.static_asserts:
+                live[sa.signature()] = (mod, sa)
+        anchor = header_mods[0] if header_mods else project.cpp_modules[0]
+        for sig in golden_sigs:
+            if sig not in live:
+                out.append(Finding(
+                    RULE, anchor.path, 1,
+                    f"static_assert pin '{sig}' was dropped from the ABI "
+                    f"headers — deleting a layout pin is the first step "
+                    f"of an accidental ABI break; restore it or bump the "
+                    f"golden"))
+        for sig, (mod, sa) in live.items():
+            if sig not in golden_sigs:
+                out.append(Finding(
+                    RULE, mod.path, sa.line,
+                    f"static_assert pin '{sig}' is not in the golden; "
+                    f"new pins need an --update-abi-golden bump"))
+        return out
+
+    # -- leg 3: C++ vs the Python packers (and py vs golden) ---------------
+
+    def _check_py_fields(self, project, structs, golden) -> list[Finding]:
+        out: list[Finding] = []
+        golden_structs = golden.get("structs", {})
+        for cxx_name, suffix, table_name, skip in FIELD_MIRRORS:
+            pymod = project.find_module(suffix)
+            s = structs.get(cxx_name)
+            if pymod is None or s is None or not s.complete:
+                continue   # missing struct already reported above
+            parsed = _py_offsets(pymod, table_name)
+            if parsed is None:
+                out.append(Finding(
+                    RULE, pymod.path, 1,
+                    f"{table_name} must stay a literal "
+                    f"str->int dict — it is the Python leg of the "
+                    f"{cxx_name} ABI mirror"))
+                continue
+            table, table_line = parsed
+            want_fields = golden_structs.get(cxx_name, {}).get("fields", {})
+            py_seen: set[str] = set()
+            for f in s.fields:
+                norm = f.name.rstrip("_")
+                if norm in skip or f.name in skip:
+                    continue
+                if norm not in table:
+                    if _PAD_RE.search(norm):
+                        continue   # explicit padding: py tables omit it
+                    out.append(Finding(
+                        RULE, pymod.path, table_line,
+                        f"{cxx_name}.{f.name} (offset {f.offset}) has no "
+                        f"entry in {table_name} — the Python mirror must "
+                        f"track every ABI field"))
+                    continue
+                py_seen.add(norm)
+                if table[norm] != f.offset:
+                    out.append(Finding(
+                        RULE, pymod.path, table_line,
+                        f"ABI drift: {cxx_name}.{f.name} is at offset "
+                        f"{f.offset} in the C++ header but "
+                        f"{table_name}[{norm!r}] says {table[norm]}"))
+            cxx_norms = {f.name.rstrip("_") for f in s.fields}
+            for fname, off in table.items():
+                if fname not in cxx_norms:
+                    out.append(Finding(
+                        RULE, pymod.path, table_line,
+                        f"{table_name}[{fname!r}] = {off} has no "
+                        f"matching field in C++ struct {cxx_name}"))
+                g = want_fields.get(fname, want_fields.get(fname + "_"))
+                if g is not None and g != off:
+                    out.append(Finding(
+                        RULE, pymod.path, table_line,
+                        f"ABI drift: {table_name}[{fname!r}] = {off} but "
+                        f"the golden pins {cxx_name}.{fname} at {g}"))
+        return out
+
+    def _check_py_sizes(self, project, structs, env) -> list[Finding]:
+        out: list[Finding] = []
+        layout = compute_layout(project)
+        for key, py_name, descr, fn in SIZE_MIRRORS:
+            py_vals = layout.get(key)
+            pymod = project.find_module(_PY_SUFFIX[key])
+            if not py_vals or pymod is None or py_name not in py_vals:
+                continue   # abi-drift reports unfoldable/missing names
+            try:
+                cxx_val = fn(structs, env)
+            except (KeyError, AttributeError, TypeError):
+                continue   # missing struct already reported above
+            if cxx_val is None:
+                continue
+            if py_vals[py_name] != cxx_val:
+                out.append(Finding(
+                    RULE, pymod.path, 1,
+                    f"ABI drift: {key}.{py_name} = {py_vals[py_name]} in "
+                    f"the Python packer but the C++ headers derive "
+                    f"{descr} = {cxx_val}"))
+        return out
+
+    def _check_py_constants(self, project, env, owner) -> list[Finding]:
+        out: list[Finding] = []
+        layout = compute_layout(project)
+        for key, py_name, cxx_name in CONSTANT_PAIRS:
+            py_vals = layout.get(key)
+            pymod = project.find_module(_PY_SUFFIX[key])
+            if not py_vals or pymod is None or py_name not in py_vals:
+                continue
+            if cxx_name not in env:
+                continue   # missing constexpr already reported above
+            if py_vals[py_name] != env[cxx_name]:
+                out.append(Finding(
+                    RULE, pymod.path, 1,
+                    f"ABI drift: {key}.{py_name} = {py_vals[py_name]!r} "
+                    f"in Python but constexpr {cxx_name} = "
+                    f"{env[cxx_name]!r} in the C++ header"))
+        return out

@@ -1,0 +1,180 @@
+"""cxx-seqlock: the C++ side of the shared-mmap seqlock protocol.
+
+The Python seqlock-protocol rule pins the reader/writer discipline for
+the mmap'd rings on the Python side; this is its mirror over the shim's
+``StepRingWriter`` (and any future C++ ring writer): a *writer function*
+— any function that stores to a ``->seq`` field — must keep the exact
+bracket the readers validate against:
+
+- the write sequence is forced odd with ``| 1`` (a crashed writer's odd
+  leftover must not invert parity and let a torn read validate);
+- ``seq`` is only ever published with ``__atomic_store_n`` (a plain
+  store can tear and lets the compiler sink it across the payload);
+- the bracket has two atomic seq stores — odd first, ``wseq + 1`` (even)
+  last — with every payload store in between: a payload store after the
+  even bump escapes the bracket and readers can validate a half-written
+  record;
+- shared mutable state outside the record (non-atomic integral ``g_*``
+  counters) is not written bare inside a writer function unless the
+  function holds a lock (``lock_guard``/``unique_lock``/
+  ``pthread_mutex_lock``) — lock-free writers publish derived counters
+  (e.g. the ring head) with atomic stores after the even bump.
+
+Functions without a ``->seq`` store are out of scope: init paths
+(``CreateAtomically``) fill local structs before publish-by-rename, and
+locked paths (``RecordStepRing``) are the lock-discipline rules' domain.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Project, Rule
+
+RULE = "cxx-seqlock"
+
+_LOCK_TOKENS = frozenset({
+    "lock_guard", "unique_lock", "scoped_lock", "pthread_mutex_lock",
+})
+
+
+def _is_plain_assign(toks, i) -> bool:
+    """toks[i] starts a bare `name =` / `name +=` / `name ++` write (not
+    ==, not a member access on something else, not an address-of)."""
+    if i > 0 and toks[i - 1].value in (".", "->", "&"):
+        return False
+    if i + 1 >= len(toks):
+        return False
+    nxt = toks[i + 1].value
+    return nxt in ("=", "+=", "-=", "|=", "&=", "^=", "++", "--") or \
+        (i > 0 and toks[i - 1].value in ("++", "--"))
+
+
+class CxxSeqlockRule(Rule):
+    name = RULE
+    description = ("C++ ring writers keep the seqlock bracket: |1 odd "
+                   "first, atomic seq stores, payload before the even "
+                   "bump, atomics on shared g_* counters")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for mod in project.cpp_modules:
+            for fn in mod.functions:
+                out.extend(self._check_function(mod, fn))
+        return out
+
+    def _check_function(self, mod, fn) -> list[Finding]:
+        toks = fn.tokens
+        atomic_seq_stores: list[int] = []   # index of __atomic_store_n
+        seq_bases: set[str] = set()
+        for i, tok in enumerate(toks):
+            if tok.value == "__atomic_store_n" and i + 5 < len(toks) \
+                    and toks[i + 1].value == "(" \
+                    and toks[i + 2].value == "&" \
+                    and toks[i + 3].kind == "id" \
+                    and toks[i + 4].value == "->" \
+                    and toks[i + 5].value == "seq":
+                atomic_seq_stores.append(i)
+                seq_bases.add(toks[i + 3].value)
+        plain_seq_stores = [
+            i for i, tok in enumerate(toks)
+            if tok.value == "seq" and i > 1 and toks[i - 1].value == "->"
+            and toks[i - 2].kind == "id"
+            and (i < 3 or toks[i - 3].value != "&")
+            and i + 1 < len(toks) and toks[i + 1].value == "="
+        ]
+        if not atomic_seq_stores and not plain_seq_stores:
+            return []   # not a seqlock writer
+
+        out: list[Finding] = []
+        for i in plain_seq_stores:
+            seq_bases.add(toks[i - 2].value)
+            out.append(Finding(
+                RULE, mod.path, toks[i].line,
+                f"{fn.qualname}: plain store to "
+                f"{toks[i - 2].value}->seq — seq must be published with "
+                f"__atomic_store_n (release) so it cannot tear or sink "
+                f"across the payload"))
+
+        vals = [t.value for t in toks]
+        has_odd_force = any(
+            v == "|" and i + 1 < len(vals) and vals[i + 1] == "1"
+            for i, v in enumerate(vals))
+        if not has_odd_force:
+            out.append(Finding(
+                RULE, mod.path, fn.line,
+                f"{fn.qualname} writes a seqlock record without forcing "
+                f"the write sequence odd (`seq | 1`) — a crashed "
+                f"writer's leftover odd value would invert parity and "
+                f"torn reads could validate"))
+        if len(atomic_seq_stores) == 1:
+            out.append(Finding(
+                RULE, mod.path, toks[atomic_seq_stores[0]].line,
+                f"{fn.qualname} has only one atomic seq store — the "
+                f"bracket needs both: odd (writing) before the payload, "
+                f"even (wseq + 1) after it"))
+        if atomic_seq_stores:
+            last = atomic_seq_stores[-1]
+            close = self._call_end(toks, last + 1)
+            if not any(vals[j] == "+" and vals[j + 1] == "1"
+                       for j in range(last, min(close, len(vals) - 1))):
+                out.append(Finding(
+                    RULE, mod.path, toks[last].line,
+                    f"{fn.qualname}: the final seq store does not bump "
+                    f"to even (`wseq + 1`) — readers never see the "
+                    f"record become valid"))
+            out.extend(self._payload_after_bracket(
+                mod, fn, toks, close, seq_bases))
+        out.extend(self._bare_global_writes(mod, fn, toks))
+        return out
+
+    @staticmethod
+    def _call_end(toks, open_idx) -> int:
+        depth = 0
+        for j in range(open_idx, len(toks)):
+            if toks[j].value == "(":
+                depth += 1
+            elif toks[j].value == ")":
+                depth -= 1
+                if depth == 0:
+                    return j
+        return len(toks)
+
+    def _payload_after_bracket(self, mod, fn, toks, close,
+                               seq_bases) -> list[Finding]:
+        out = []
+        for j in range(close, len(toks) - 3):
+            if toks[j].kind == "id" and toks[j].value in seq_bases \
+                    and toks[j + 1].value == "->" \
+                    and toks[j + 2].kind == "id" \
+                    and toks[j + 3].value == "=" \
+                    and (j == 0 or toks[j - 1].value != "&"):
+                out.append(Finding(
+                    RULE, mod.path, toks[j].line,
+                    f"{fn.qualname}: payload store to "
+                    f"{toks[j].value}->{toks[j + 2].value} AFTER the "
+                    f"even seq bump — it escapes the bracket, so a "
+                    f"reader can validate a record that is still being "
+                    f"written"))
+        return out
+
+    def _bare_global_writes(self, mod, fn, toks) -> list[Finding]:
+        held_lock = any(t.value in _LOCK_TOKENS for t in toks)
+        if held_lock:
+            return []
+        out = []
+        for i, tok in enumerate(toks):
+            if tok.kind != "id" or not tok.value.startswith("g_"):
+                continue
+            gv = mod.globals.get(tok.value)
+            if gv is None or gv.atomic or gv.thread_local \
+                    or not gv.integral:
+                continue
+            if _is_plain_assign(toks, i):
+                out.append(Finding(
+                    RULE, mod.path, tok.line,
+                    f"{fn.qualname}: bare write to shared non-atomic "
+                    f"{tok.value} inside a lock-free seqlock writer — "
+                    f"make it std::atomic or move the write under a "
+                    f"lock"))
+        return out

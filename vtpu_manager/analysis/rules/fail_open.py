@@ -1,0 +1,83 @@
+"""fail-open: the shim must never turn an enforcement failure into a
+workload failure.
+
+The shim sits inside every tenant process (LD_PRELOAD-analog); its cache
+client, quota reloader, and telemetry writer are conveniences layered on
+the Execute hot path. The discipline PRs 7/10/15 each hand-verified is
+that every failure in that layer degrades to the *uncached / unthrottled /
+unrecorded* behavior: a missing config file means no enforcement, a torn
+ring means a dropped sample, a dead cache daemon means a slow compile —
+never a crashed training step. C++ gives that discipline exactly one
+escape hatch to police: control flow that terminates or unwinds into the
+host (``throw``, ``abort``, ``exit``, ``std::terminate``, ``assert``).
+
+This rule flags those tokens in every shim source. There is no allowlist
+of "cold" functions: the shim's only entry points are the wrapped PJRT
+calls, so everything in it is transitively on the Execute hot path (the
+loader's fork/exec child uses ``_exit``, a different identifier, which
+stays legal — a child that failed exec has no host to fail open into).
+Genuinely unreachable guards take a written ``// vtlint:
+disable=fail-open`` justification.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Project, Rule
+
+RULE = "fail-open"
+
+# identifiers that end or unwind the host process when reached
+BANNED_CALLS = frozenset({
+    "abort", "exit", "quick_exit", "_Exit", "terminate", "assert",
+})
+
+_EXPLAIN = {
+    "throw": ("unwinds into the host runtime — a tenant step dies "
+              "because enforcement hiccuped"),
+    "abort": "kills the host process",
+    "exit": "kills the host process (and skips its atexit ordering)",
+    "quick_exit": "kills the host process",
+    "_Exit": "kills the host process",
+    "terminate": "kills the host process",
+    "assert": ("is abort() in disguise on a non-NDEBUG build; encode "
+               "the invariant as a degrade-and-count branch instead"),
+}
+
+
+class FailOpenRule(Rule):
+    name = RULE
+    description = ("shim failure paths degrade to uncached/unrecorded "
+                   "behavior — no throw/abort/exit on the Execute "
+                   "hot path")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for mod in project.cpp_modules:
+            toks = mod.tokens
+            for i, tok in enumerate(toks):
+                if tok.kind != "id":
+                    continue
+                if tok.value == "throw":
+                    # `throw()` as a legacy exception-spec would be the
+                    # only benign form; the shim doesn't use it, and a
+                    # rethrow/`throw x` both start with the keyword
+                    out.append(Finding(
+                        RULE, mod.path, tok.line,
+                        f"'throw' on the shim hot path "
+                        f"{_EXPLAIN['throw']}; degrade to the "
+                        f"unenforced behavior and count the failure"))
+                    continue
+                if tok.value in BANNED_CALLS:
+                    # only calls: `exit` as a field/variable name stays
+                    # legal, `std::abort` reaches here via the last id
+                    if i + 1 < len(toks) and toks[i + 1].value == "(" \
+                            and (i == 0 or toks[i - 1].value
+                                 not in (".", "->")):
+                        out.append(Finding(
+                            RULE, mod.path, tok.line,
+                            f"'{tok.value}(...)' on the shim hot path "
+                            f"{_EXPLAIN[tok.value]}; enforcement "
+                            f"failures must fail open"))
+        return out

@@ -1,0 +1,165 @@
+"""failpoint-catalog: every fault-injection site is registered, armed by
+the chaos suite, and documented — checked at lint time.
+
+resilience/failpoints.py keeps the authoritative ``SITES`` catalog;
+``fire()`` at an unregistered site raises only when the chaos harness is
+armed, so a typo'd site name is a fault-injection point that silently
+never fires — the chaos suite believes it covered a path it never
+touched. The runtime assertion in tests/test_chaos.py
+(``arm_everything``) catches catalog drift only when that test runs;
+this rule promotes the whole triangle to lint:
+
+- every ``failpoints.fire("<site>")`` literal in the tree is in SITES;
+- every SITES entry is armed by ``arm_everything``'s catalog in
+  tests/test_chaos.py (a site nobody arms is dead chaos coverage);
+- every SITES entry appears in docs/resilience.md's site table (the
+  operator-facing contract for what can be injected where);
+- ``arm_everything`` arms no site that SITES doesn't know.
+
+tests/ and docs/ live outside the linted packages, so this rule reads
+them relative to the repo root derived from failpoints.py's own path;
+trees without those files (rule fixtures) skip the corresponding legs.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule, \
+    dotted_parts
+
+RULE = "failpoint-catalog"
+
+_FAILPOINTS_SUFFIX = "resilience/failpoints.py"
+
+
+def _sites_table(mod: Module) -> tuple[dict[str, int], int]:
+    """(site -> key line, SITES assign line). Handles both ``SITES = {``
+    and the annotated ``SITES: dict[str, str] = {`` forms."""
+    for node in ast.walk(mod.tree):
+        value = None
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SITES"
+                for t in node.targets):
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "SITES":
+            value = node.value
+        if isinstance(value, ast.Dict):
+            sites = {k.value: k.lineno for k in value.keys
+                     if isinstance(k, ast.Constant)
+                     and isinstance(k.value, str)}
+            return sites, node.lineno
+    return {}, 1
+
+
+def _first_str_arg(node: ast.Call) -> str | None:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _literal_calls(tree: ast.AST, method: str,
+                   require_module: str | None = "failpoints"
+                   ) -> list[tuple[str, int]]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        parts = dotted_parts(node.func)
+        if not parts or parts[-1] != method:
+            continue
+        if require_module is not None and \
+                (len(parts) < 2 or parts[-2] != require_module):
+            continue
+        site = _first_str_arg(node)
+        if site is not None:
+            out.append((site, node.lineno))
+    return out
+
+
+class FailpointCatalogRule(Rule):
+    name = RULE
+    description = ("every failpoints site is in SITES, armed by "
+                   "test_chaos.arm_everything, and documented in "
+                   "docs/resilience.md")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        fp_mod = project.find_module(_FAILPOINTS_SUFFIX)
+        if fp_mod is None:
+            return []
+        sites, sites_line = _sites_table(fp_mod)
+        out: list[Finding] = []
+
+        fired: dict[str, tuple[str, int]] = {}
+        for mod in project.modules:
+            for site, line in _literal_calls(mod.tree, "fire"):
+                fired.setdefault(site, (mod.path, line))
+                if site not in sites:
+                    out.append(Finding(
+                        RULE, mod.path, line,
+                        f"failpoints.fire({site!r}) is not registered "
+                        f"in SITES — the chaos harness can never arm "
+                        f"it, so this injection point is silently dead"))
+
+        repo_root = Path(fp_mod.path).resolve().parents[2]
+        out.extend(self._check_armed(fp_mod, sites, sites_line,
+                                     repo_root))
+        out.extend(self._check_docs(fp_mod, sites, repo_root))
+        return out
+
+    def _check_armed(self, fp_mod: Module, sites: dict[str, int],
+                     sites_line: int, repo_root: Path) -> list[Finding]:
+        chaos_path = repo_root / "tests" / "test_chaos.py"
+        try:
+            chaos_tree = ast.parse(chaos_path.read_text(),
+                                   filename=str(chaos_path))
+        except (OSError, SyntaxError):
+            return []   # fixture tree without the chaos suite
+        arm_fn = next(
+            (n for n in ast.walk(chaos_tree)
+             if isinstance(n, ast.FunctionDef)
+             and n.name == "arm_everything"), None)
+        if arm_fn is None:
+            return [Finding(
+                RULE, fp_mod.path, sites_line,
+                f"{chaos_path.name} has no arm_everything — the chaos "
+                f"suite's exhaustive-arming catalog is the coverage "
+                f"proof for SITES")]
+        armed = {site: line for site, line
+                 in _literal_calls(arm_fn, "arm", require_module=None)}
+        out = []
+        for site in sorted(set(sites) - set(armed)):
+            out.append(Finding(
+                RULE, fp_mod.path, sites[site],
+                f"SITES entry {site!r} is never armed by "
+                f"test_chaos.arm_everything — an unarmed site is dead "
+                f"chaos coverage; add it to the arming catalog"))
+        for site in sorted(set(armed) - set(sites)):
+            out.append(Finding(
+                RULE, str(chaos_path), armed[site],
+                f"arm_everything arms {site!r}, which is not in "
+                f"failpoints.SITES — arming an unknown site raises at "
+                f"chaos-run time"))
+        return out
+
+    def _check_docs(self, fp_mod: Module, sites: dict[str, int],
+                    repo_root: Path) -> list[Finding]:
+        doc_path = repo_root / "docs" / "resilience.md"
+        try:
+            doc_text = doc_path.read_text()
+        except OSError:
+            return []   # fixture tree without docs
+        out = []
+        for site in sorted(sites):
+            if f"`{site}`" not in doc_text:
+                out.append(Finding(
+                    RULE, fp_mod.path, sites[site],
+                    f"SITES entry {site!r} is missing from "
+                    f"docs/resilience.md's site table — the docs are "
+                    f"the operator contract for what chaos can inject"))
+        return out

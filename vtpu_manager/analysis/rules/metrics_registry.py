@@ -1,0 +1,151 @@
+"""metrics-registry: every ``vtpu_*`` series has one home, one spelling,
+and a row in the docs.
+
+Metric names are API: dashboards, alerts, and the replay tooling select
+on them long after the emitting code moved. The repo now has four ways
+to mint a series (prometheus_client constructors in metrics/collector.py,
+module-level name constants in telemetry/aggregate.py +
+utilization/ledger.py, hand-rendered ``# TYPE`` exposition lines in
+ha/shard.py + resilience/policy.py, and ctypes symbol names in
+runtime/client.py) — which is exactly how copy-paste drift happens: the
+same family re-defined in two surfaces with a one-character difference,
+or a new series that never reaches the telemetry docs. This rule pins:
+
+- **one home**: a series name is mentioned by exactly one module (the
+  modules that *define* and *render* a family are one surface; a second
+  module spelling the same literal is a copy that will drift);
+- **convention**: anything that starts ``vtpu`` must be
+  ``vtpu_<lowercase_snake>`` — no camelCase, no double underscores, no
+  trailing separators (checked on full-string literals and ``# TYPE``
+  exposition lines);
+- **documented**: every series appears in some table in docs/*.md
+  (found via the repo root derived from the linted packages), so the
+  operator-facing inventory cannot lag the code.
+
+Detection is deliberately literal-based (full-string constants matching
+the naming shape, plus names inside ``# TYPE`` lines) — label values,
+resource strings, and prose don't match the shape, and the analysis/
+package itself (whose rule messages quote series names) is excluded.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule
+
+RULE = "metrics-registry"
+
+_SERIES_RE = re.compile(r"^vtpu_[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+_TYPE_RE = re.compile(r"#\s*TYPE\s+(\S+)\s")
+# a failed *attempt* at a series name: has the prefix and at least one
+# more component, and is not a prefix-building literal (trailing "_") —
+# bare "vtpu" driver/resource identifiers are not series attempts
+_VTPUISH_RE = re.compile(r"^vtpu_[A-Za-z0-9_]*[A-Za-z0-9]$")
+
+
+def _mentions(module: Module) -> dict[str, int]:
+    """series -> first mention line in this module."""
+    out: dict[str, int] = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        if _SERIES_RE.match(node.value):
+            out.setdefault(node.value, node.lineno)
+        for m in _TYPE_RE.finditer(node.value):
+            if m.group(1).startswith("vtpu"):
+                out.setdefault(m.group(1), node.lineno)
+    return out
+
+
+def _convention_violations(module: Module) -> Iterable[Finding]:
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        candidates = []
+        if _VTPUISH_RE.match(node.value):
+            candidates.append(node.value)
+        candidates.extend(m.group(1) for m in _TYPE_RE.finditer(node.value)
+                          if m.group(1).startswith("vtpu"))
+        for name in candidates:
+            if name == "vtpu_manager":
+                continue   # the package name, not a series
+            if not _SERIES_RE.match(name):
+                yield Finding(
+                    RULE, module.path, node.lineno,
+                    f"{name!r} does not match the series naming "
+                    f"convention vtpu_<lowercase_snake> — alerts and "
+                    f"dashboards select on exact spellings")
+
+
+class MetricsRegistryRule(Rule):
+    name = RULE
+    description = ("every vtpu_* series has exactly one defining module, "
+                   "follows the naming convention, and is documented in "
+                   "docs/")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        out: list[Finding] = []
+        homes: dict[str, tuple[str, int]] = {}
+        all_series: dict[str, tuple[str, int]] = {}
+        for mod in project.modules:
+            rel = Path(mod.path).as_posix()
+            if "/analysis/" in rel:
+                continue   # rule sources quote series names in messages
+            out.extend(_convention_violations(mod))
+            for name, line in _mentions(mod).items():
+                all_series.setdefault(name, (mod.path, line))
+                prior = homes.get(name)
+                if prior is None:
+                    homes[name] = (mod.path, line)
+                elif prior[0] != mod.path:
+                    out.append(Finding(
+                        RULE, mod.path, line,
+                        f"series {name!r} is also defined in "
+                        f"{prior[0]}:{prior[1]} — one family, one "
+                        f"module; a second spelling is a copy that "
+                        f"will drift"))
+        out.extend(self._check_docs(project, all_series))
+        return out
+
+    def _check_docs(self, project: Project,
+                    all_series: dict[str, tuple[str, int]]
+                    ) -> list[Finding]:
+        docs_dir = self._docs_dir(project)
+        if docs_dir is None:
+            return []   # fixture tree without docs
+        doc_text = ""
+        for doc in sorted(docs_dir.glob("*.md")):
+            try:
+                doc_text += doc.read_text()
+            except OSError:
+                continue
+        out = []
+        for name in sorted(all_series):
+            if name not in doc_text:
+                path, line = all_series[name]
+                out.append(Finding(
+                    RULE, path, line,
+                    f"series {name!r} is not documented anywhere in "
+                    f"docs/*.md — the telemetry tables are the "
+                    f"operator-facing inventory; add a row (family "
+                    f"tables cover their _bucket/_sum/_count "
+                    f"expansions)"))
+        return out
+
+    @staticmethod
+    def _docs_dir(project: Project) -> Path | None:
+        for root in project.roots:
+            r = Path(root)
+            if r.is_file():
+                r = r.parent
+            for base in (r, r.parent):
+                docs = base / "docs"
+                if docs.is_dir():
+                    return docs
+        return None

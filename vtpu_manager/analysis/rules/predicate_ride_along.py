@@ -1,0 +1,140 @@
+"""predicate-ride-along: new FilterPredicate inputs ride filter_kwargs.
+
+The scheduler builds its FilterPredicate twice: once on the plain path
+(cmd/device_scheduler.py) and once per vtha shard
+(scheduler/shard.py), which re-creates predicates after every lease
+acquisition. The repo's contract since the vtha PR is that every
+*behavioral* input — the feature-gate booleans and tuning scalars —
+rides ONE ``filter_kwargs = dict(...)`` assembly that both paths splat,
+so a shard inherits new gates for free; only *infrastructure* wiring
+(client, snapshot, policy, fence, shard_selector) differs per call site.
+PRs 12–15 each added a gate and review each re-checked the ride-along by
+hand; a gate passed directly at one call site silently runs with the
+default in the other data path — the classic "works until HA is on" bug.
+
+Mechanically, against FilterPredicate.__init__'s actual signature:
+
+- call sites may pass only infrastructure kwargs explicitly (a
+  behavioral kwarg must come through ``**filter_kwargs``);
+- keyword-only ``filter_kwargs = dict(...)`` assemblies may only name
+  real ``__init__`` parameters (a typo'd gate silently no-ops —
+  ``dict()`` accepts anything, ``__init__`` rejects it only at the call);
+- every bool-default parameter (the gates) appears in each keyword-only
+  assembly, so turning a gate on cannot be forgotten in one path.
+
+Pass-through assemblies (``dict(filter_kwargs or {})``) and trees
+without scheduler/filter.py are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule, \
+    dotted_parts
+
+RULE = "predicate-ride-along"
+
+_CLASS = "FilterPredicate"
+_FILTER_MODULE = "scheduler/filter.py"
+_KWARGS_NAME = "filter_kwargs"
+
+
+def _signature(project: Project
+               ) -> tuple[set[str], set[str], set[str]] | None:
+    """(all params, infra params, bool-gate params) from the live
+    __init__ — the rule tracks the real signature, not a frozen copy."""
+    mod = project.find_module(_FILTER_MODULE)
+    if mod is None:
+        return None
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == _CLASS):
+            continue
+        for fn in node.body:
+            if isinstance(fn, ast.FunctionDef) and fn.name == "__init__":
+                args = fn.args.args[1:]   # drop self
+                defaults = fn.args.defaults
+                pad = [None] * (len(args) - len(defaults))
+                all_params, infra, gates = set(), set(), set()
+                for arg, default in zip(args, pad + list(defaults)):
+                    all_params.add(arg.arg)
+                    if default is None or (
+                            isinstance(default, ast.Constant)
+                            and default.value is None):
+                        infra.add(arg.arg)
+                    elif isinstance(default, ast.Constant) \
+                            and isinstance(default.value, bool):
+                        gates.add(arg.arg)
+                return all_params, infra, gates
+    return None
+
+
+class PredicateRideAlongRule(Rule):
+    name = RULE
+    description = ("FilterPredicate behavioral inputs ride the shared "
+                   "filter_kwargs assembly so vtha shards inherit them")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        sig = _signature(project)
+        if sig is None:
+            return []
+        all_params, infra, gates = sig
+        out: list[Finding] = []
+        for mod in project.modules:
+            if mod.path.endswith(_FILTER_MODULE):
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    out.extend(self._check_call(mod, node, infra))
+                elif isinstance(node, ast.Assign):
+                    out.extend(self._check_assembly(
+                        mod, node, all_params, gates))
+        return out
+
+    def _check_call(self, mod: Module, node: ast.Call,
+                    infra: set[str]) -> Iterable[Finding]:
+        parts = dotted_parts(node.func)
+        if not parts or parts[-1] != _CLASS:
+            return
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in infra:
+                continue   # **splat / infrastructure wiring
+            yield Finding(
+                RULE, mod.path, node.lineno,
+                f"{_CLASS}({kw.arg}=...) passes a behavioral input "
+                f"directly at one call site — it must ride the shared "
+                f"{_KWARGS_NAME} assembly, or the vtha shard path "
+                f"(scheduler/shard.py) silently runs with the default")
+
+    def _check_assembly(self, mod: Module, node: ast.Assign,
+                        all_params: set[str],
+                        gates: set[str]) -> Iterable[Finding]:
+        if len(node.targets) != 1:
+            return
+        target = node.targets[0]
+        if not (isinstance(target, ast.Name)
+                and target.id == _KWARGS_NAME):
+            return
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id == "dict"):
+            return
+        if call.args:
+            return   # pass-through copy (dict(filter_kwargs or {}))
+        named = {kw.arg for kw in call.keywords if kw.arg is not None}
+        for name in sorted(named - all_params):
+            yield Finding(
+                RULE, mod.path, node.lineno,
+                f"{_KWARGS_NAME} names {name!r}, which is not a "
+                f"{_CLASS}.__init__ parameter — dict() accepts the "
+                f"typo, the predicate rejects it only when this path "
+                f"runs")
+        for name in sorted(gates - named):
+            yield Finding(
+                RULE, mod.path, node.lineno,
+                f"{_KWARGS_NAME} is missing the {_CLASS} gate "
+                f"{name!r} — every bool gate rides the assembly so "
+                f"both the plain and the vtha-shard data path see the "
+                f"same decision")

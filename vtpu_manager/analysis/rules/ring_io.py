@@ -1,0 +1,131 @@
+"""ring-io: spool-family ``record()`` is zero-I/O; the flusher owns disk.
+
+The trace/explain/slo spool family shares one two-phase shape: a hot
+``record()`` that appends to a bounded in-memory ring under a short lock
+(and at most WAKES the flusher), and a background ``flush()`` that owns
+every byte of disk I/O. The shape exists so a hung disk can never stall a
+filter pass, an Allocate, or a span exit — backpressure becomes a counted
+drop, not a blocked hot path. Lock-discipline already bans *blocking
+calls* under module-level locks; this rule generalizes the promise to the
+spool family's own locks and entry points, which review re-checked by
+hand in PRs 12/14/15:
+
+- in any class that has both a recorder method (``record*``) and a
+  flusher method (``flush*``/``_flush*``), the recorder bodies must not
+  perform I/O (open/os.write/os.replace/json.dump/Path.write_text/...),
+  not even outside the lock — the flusher owns the spool;
+- in every method of such a class, no I/O inside a ``with <lock>`` block
+  (the snapshot-under-lock, write-after-release shape ``flush()`` uses).
+  The cross-process spool flock (``FileLock``) is the one exception: it
+  exists to coordinate the I/O itself and is never taken on a hot path.
+
+Ring writers without a flusher sibling (the mmap packers in
+config/telemetry — their stores ARE the record) are out of scope; so are
+one-shot writers with no hot path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule, \
+    dotted_name, dotted_parts
+
+RULE = "ring-io"
+
+# call signatures that reach the filesystem
+_IO_CALLS = frozenset({
+    "open", "os.open", "os.write", "os.replace", "os.rename", "os.fsync",
+    "os.fdatasync", "os.link", "os.unlink", "os.remove", "os.makedirs",
+    "os.truncate", "os.ftruncate", "json.dump", "pickle.dump",
+    "shutil.copy", "shutil.copyfile", "shutil.move",
+})
+_IO_METHODS = frozenset({
+    "write", "writelines", "write_text", "write_bytes", "read_text",
+    "read_bytes", "unlink", "mkdir", "touch", "rename", "replace",
+    "flush_to_disk",
+})
+_LOCK_HINTS = ("lock", "mutex")
+
+
+def _is_io_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name in _IO_CALLS:
+        return True
+    parts = dotted_parts(node.func)
+    return len(parts) > 1 and parts[-1] in _IO_METHODS
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr).lower()
+    terminal = name.rsplit(".", 1)[-1].rstrip("_")
+    # FileLock/flock contexts coordinate file I/O across processes; the
+    # zero-I/O promise is about the in-process ring lock
+    if "filelock" in terminal or "flock" in terminal:
+        return False
+    return (any(h in terminal for h in _LOCK_HINTS)
+            or terminal in ("mu", "_mu"))
+
+
+class RingIoRule(Rule):
+    name = RULE
+    description = ("spool-family record() bodies are zero-I/O; disk "
+                   "writes belong to the flusher, and never run under "
+                   "the ring lock")
+
+    def check_module(self, module: Module,
+                     project: Project) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = [n for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            recorders = [m for m in methods
+                         if m.name.startswith("record")]
+            flushers = [m for m in methods
+                        if m.name.lstrip("_").startswith("flush")]
+            if not recorders or not flushers:
+                continue
+            for m in recorders:
+                out.extend(self._no_io(module, node, m))
+            for m in methods:
+                out.extend(self._no_io_under_lock(module, node, m))
+        return out
+
+    def _no_io(self, module: Module, cls: ast.ClassDef,
+               fn: ast.FunctionDef) -> Iterable[Finding]:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) and _is_io_call(sub):
+                yield Finding(
+                    RULE, module.path, sub.lineno,
+                    f"{cls.name}.{fn.name}() performs I/O "
+                    f"({dotted_name(sub.func)}) — the spool pattern's "
+                    f"hot path must only append to the ring and wake "
+                    f"the flusher; a hung disk here stalls every "
+                    f"instrumented caller")
+
+    def _no_io_under_lock(self, module: Module, cls: ast.ClassDef,
+                          fn: ast.FunctionDef) -> Iterable[Finding]:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.With):
+                continue
+            if not any(_is_lock_ctx(item) for item in sub.items):
+                continue
+            for inner in sub.body:
+                for call in ast.walk(inner):
+                    if isinstance(call, ast.Call) and _is_io_call(call):
+                        yield Finding(
+                            RULE, module.path, call.lineno,
+                            f"{cls.name}.{fn.name}() performs I/O "
+                            f"({dotted_name(call.func)}) while holding "
+                            f"the ring lock — snapshot under the lock, "
+                            f"write after releasing it (the flush() "
+                            f"shape), or record() blocks behind the "
+                            f"disk")
+        return ()

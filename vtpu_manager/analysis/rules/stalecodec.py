@@ -1,0 +1,177 @@
+"""stalecodec: one codec for ``…@ts`` stamps and staleness verdicts.
+
+util/stalecodec.py is the single copy of the three rules every
+staleness-stamped annotation obeys (stamp as ``@{ts:.3f}``, split off the
+LAST ``@`` with garbage→no-signal, freshness as ``-skew <= now - ts <=
+max_age`` re-judged at use time). PRs 11–15 kept finding planes that
+re-derived one of the three by hand and got an edge wrong — a parse that
+eats a garbage body, an ad-hoc freshness compare with no future-skew
+bound (so one node with a fast clock publishes immortal claims), a
+staleness verdict frozen at parse time. This rule makes those reviews
+mechanical; outside util/stalecodec.py it flags:
+
+- **ad-hoc splits**: ``raw.rpartition("@")`` / ``partition`` / ``split``
+  / ``rsplit`` on the stamp separator — use ``split_stamp`` (it already
+  rejects non-float and non-finite stamps);
+- **ad-hoc stamping**: an f-string whose literal part ends in ``@``
+  followed by a float-formatted value or a ``ts``/``now``/
+  ``time.time()`` expression — use ``stamp`` (one encoder, five wire
+  formats);
+- **ad-hoc freshness**: ``time.time() - x`` (directly, or via a local
+  assigned from it) used in a comparison — use ``is_fresh``, which
+  carries the future-skew bound everyone forgets. File-mtime ages
+  (reaping spools, config startup grace) are a different protocol — a
+  local kernel clock can't skew against itself — so comparisons whose
+  operands mention ``mtime`` stay legal.
+
+Genuine exceptions (e.g. a flock-liveness payload that is not a registry
+annotation) take a written ``# vtlint: disable=stalecodec``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from vtpu_manager.analysis.core import Finding, Module, Project, Rule, \
+    dotted_name
+
+RULE = "stalecodec"
+
+_SPLIT_METHODS = frozenset({"rpartition", "partition", "split", "rsplit"})
+_TS_NAMES = frozenset({"ts", "now", "timestamp"})
+
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) == "time.time")
+
+
+def _mentions_mtime(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "mtime" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "mtime" in sub.attr.lower():
+            return True
+    return False
+
+
+def _is_ts_expr(node: ast.AST) -> bool:
+    """Does the formatted expression smell like a wall-clock stamp?"""
+    for sub in ast.walk(node):
+        if _is_time_time(sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _TS_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _TS_NAMES:
+            return True
+    return False
+
+
+def _float_format_spec(fv: ast.FormattedValue) -> bool:
+    spec = fv.format_spec
+    if not isinstance(spec, ast.JoinedStr):
+        return False
+    text = "".join(v.value for v in spec.values
+                   if isinstance(v, ast.Constant))
+    return text.endswith("f")
+
+
+class StalecodecRule(Rule):
+    name = RULE
+    description = ("@ts stamps are encoded/split/freshness-judged only "
+                   "through util/stalecodec.py")
+
+    def check_module(self, module: Module,
+                     project: Project) -> Iterable[Finding]:
+        if module.path.endswith("util/stalecodec.py"):
+            return []
+        out: list[Finding] = []
+        # locals assigned (exactly once) from a `time.time() - x` delta:
+        # comparing them later is the same ad-hoc freshness judgement
+        age_locals: dict[str, int] = {}
+        assign_counts: dict[str, int] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                assign_counts[name] = assign_counts.get(name, 0) + 1
+                if self._is_age_delta(node.value):
+                    age_locals[name] = node.lineno
+        age_locals = {n: ln for n, ln in age_locals.items()
+                      if assign_counts.get(n) == 1}
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_split(module, node))
+            elif isinstance(node, ast.JoinedStr):
+                out.extend(self._check_stamp(module, node))
+            elif isinstance(node, ast.Compare):
+                out.extend(self._check_freshness(module, node, age_locals))
+        return out
+
+    def _check_split(self, module: Module,
+                     node: ast.Call) -> Iterable[Finding]:
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _SPLIT_METHODS):
+            return []
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "@"):
+            return []
+        return [Finding(
+            RULE, module.path, node.lineno,
+            f"ad-hoc @ts split via .{func.attr}('@') — use "
+            f"util/stalecodec.split_stamp, which takes the LAST '@' and "
+            f"turns non-float/non-finite stamps into no-signal instead "
+            f"of a crash or a garbage timestamp")]
+
+    def _check_stamp(self, module: Module,
+                     node: ast.JoinedStr) -> Iterable[Finding]:
+        values = node.values
+        for i, part in enumerate(values):
+            if not (isinstance(part, ast.Constant)
+                    and isinstance(part.value, str)
+                    and part.value.endswith("@")):
+                continue
+            if i + 1 >= len(values):
+                continue
+            nxt = values[i + 1]
+            if not isinstance(nxt, ast.FormattedValue):
+                continue
+            if _float_format_spec(nxt) or _is_ts_expr(nxt.value):
+                return [Finding(
+                    RULE, module.path, node.lineno,
+                    f"ad-hoc @ts stamp in an f-string — use "
+                    f"util/stalecodec.stamp so every plane encodes "
+                    f"'@{{ts:.3f}}' identically (one encoder, one wire "
+                    f"format to version)")]
+        return []
+
+    @staticmethod
+    def _is_age_delta(node: ast.AST) -> bool:
+        return (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and _is_time_time(node.left)
+                and not _mentions_mtime(node.right))
+
+    def _check_freshness(self, module: Module, node: ast.Compare,
+                         age_locals: dict[str, int]) -> Iterable[Finding]:
+        operands = [node.left, *node.comparators]
+        if any(_mentions_mtime(op) for op in operands):
+            return []
+        for op in operands:
+            direct = self._is_age_delta(op) or (
+                isinstance(op, ast.BinOp) and isinstance(op.op, ast.Sub)
+                and _is_time_time(op.right))
+            via_local = (isinstance(op, ast.Name)
+                         and op.id in age_locals)
+            if direct or via_local:
+                return [Finding(
+                    RULE, module.path, node.lineno,
+                    f"ad-hoc wall-clock staleness comparison — use "
+                    f"util/stalecodec.is_fresh, which bounds future "
+                    f"skew (a publisher with a fast clock must read as "
+                    f"no-signal, not as immortally fresh) and is "
+                    f"re-judged at use time")]
+        return []

@@ -50,6 +50,7 @@ import time
 
 from vtpu_manager import trace
 from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import stalecodec
 from vtpu_manager.util.flock import FileLock, LockTimeout
 
 log = logging.getLogger(__name__)
@@ -312,17 +313,20 @@ class CompileCache:
                 raw = f.read()
         except OSError:
             return None
-        pid_raw, _, ts_raw = raw.partition("@")
+        split = stalecodec.split_stamp(raw)
+        if split is None:
+            return 0, 0.0
         try:
-            return int(pid_raw), float(ts_raw)
+            return int(split[0]), split[1]
         except ValueError:
             return 0, 0.0
 
     def _lease_stale(self, path: str, pid: int, ts: float) -> bool:
-        age = time.time() - ts
-        # a far-future stamp is garbage (clock step / corruption); a
-        # wedged live compiler is bounded by the stale budget
-        if age > self.stale_lease_s or age < -self.stale_lease_s:
+        # a far-future stamp is garbage (clock step / corruption) — the
+        # skew bound mirrors the stale budget symmetrically; a wedged
+        # live compiler is bounded by that same budget
+        if not stalecodec.is_fresh(ts, max_age_s=self.stale_lease_s,
+                                   skew_s=self.stale_lease_s):
             return True
         # liveness = the holder's flock, which the kernel releases on
         # any process death and which works across container PID
@@ -342,7 +346,7 @@ class CompileCache:
         payload written), or None when an existing lease won the race
         (EEXIST)."""
         tmp = f"{path}.{os.getpid()}.{secrets.token_hex(4)}.tmp"
-        payload = f"{os.getpid()}@{time.time()}".encode()
+        payload = stalecodec.stamp(str(os.getpid()), time.time()).encode()
         fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
         try:
             os.write(fd, payload)

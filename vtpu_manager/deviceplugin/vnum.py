@@ -610,8 +610,13 @@ class VnumPlugin(DevicePluginServicer):
         # any kubelet checkpoint lifetime; stale entries must not shadow a
         # new tenant's record in PreStartContainer)
         cutoff = time.time() - 7 * 24 * 3600
+        # not a cross-node staleness signal: these records are written and
+        # read by this node's own plugin, so there is no publisher clock to
+        # skew against, and a future-stamped record (local clock step) must
+        # SURVIVE the GC — is_fresh's skew bound would prune a live
+        # allocation's record.
         records = {k: v for k, v in records.items()
-                   if v.get("ts", 0) >= cutoff}
+                   if v.get("ts", 0) >= cutoff}  # vtlint: disable=stalecodec
         records[f"{pod_uid}/{cont}"] = {
             "devices": dev_ids,
             "claims": [c.to_wire() for c in claims],

@@ -45,6 +45,7 @@ from vtpu_manager.quota.ledger import (QuotaLeaseLedger, STATE_EXPIRED,
                                        STATE_GRANTED, STATE_REVOKED)
 from vtpu_manager.resilience import failpoints
 from vtpu_manager.util import consts
+from vtpu_manager.util import stalecodec
 
 log = logging.getLogger(__name__)
 
@@ -485,7 +486,7 @@ class QuotaMarketManager:
         body = ";".join(f"{chip}:{lent}:{count}"
                         for chip, (lent, count)
                         in sorted(per_chip.items()))
-        return f"{body}@{now:.3f}"
+        return stalecodec.stamp(body, now)
 
     def _publish(self, now: float) -> None:
         if self.client is None:

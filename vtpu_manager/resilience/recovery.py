@@ -38,24 +38,20 @@ from __future__ import annotations
 import time
 
 from vtpu_manager.util import consts
+from vtpu_manager.util import stalecodec
 
 
 def encode_bind_intent(node: str, ts: float | None = None) -> str:
-    return f"{node}@{ts if ts is not None else time.time()}"
+    return stalecodec.stamp(node, ts if ts is not None else time.time())
 
 
 def parse_bind_intent(value: str | None) -> tuple[str, float] | None:
     """(node, wall-seconds) or None for absent/malformed. Malformed reads
     as absent — reaping must never trigger off garbage it cannot date."""
-    if not value:
+    split = stalecodec.split_stamp(value)
+    if split is None or not split[0]:
         return None
-    node, sep, raw_ts = value.rpartition("@")
-    if not sep or not node:
-        return None
-    try:
-        return node, float(raw_ts)
-    except ValueError:
-        return None
+    return split
 
 
 def intent_expired(anns: dict, now: float, ttl_s: float) -> bool:

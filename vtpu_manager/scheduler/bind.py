@@ -19,6 +19,7 @@ from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.scheduler.lease import LeaseLostError
 from vtpu_manager.scheduler.serial import SerialLocker
 from vtpu_manager.util import consts
+from vtpu_manager.util import stalecodec
 
 log = logging.getLogger(__name__)
 
@@ -100,7 +101,10 @@ class BindPredicate:
                       f"target {node!r}"), pod
 
         ts = consts.parse_predicate_time(anns)
-        if ts and (time.time() - ts) > self.freshness_s:
+        # is_fresh also rejects a far-future stamp (skewed filter clock):
+        # trusting it would honor the commitment forever, and re-filtering
+        # is the safe direction
+        if ts and not stalecodec.is_fresh(ts, max_age_s=self.freshness_s):
             return BindResult(
                 error="pre-allocation expired; re-filter needed"), pod
 

@@ -57,6 +57,7 @@ from vtpu_manager.resilience.policy import (CircuitBreaker,
 from vtpu_manager.overcommit import ratio as oc_mod
 from vtpu_manager.telemetry import pressure as tel_pressure
 from vtpu_manager.topology import linkload as tl_mod
+from vtpu_manager.util import stalecodec
 from vtpu_manager.util import consts
 from vtpu_manager.util.gangname import resolve_gang_name
 from vtpu_manager.utilization import headroom as util_headroom
@@ -635,8 +636,10 @@ class ClusterSnapshot:
         if not raw:
             return None
         ts = consts.parse_predicate_time(anns)
-        if ts is None or not 0 <= time.time() - ts \
-                <= antistorm.STORM_WINDOW_S:
+        # skew_s=0: a committed-but-unbound signal from the FUTURE is not
+        # a storm yet (same zero future tolerance as before the codec)
+        if ts is None or not stalecodec.is_fresh(
+                ts, max_age_s=antistorm.STORM_WINDOW_S, skew_s=0.0):
             return None
         fp = antistorm.sanitize_fingerprint(raw)
         if not fp:

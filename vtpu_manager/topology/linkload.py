@@ -34,12 +34,12 @@ from vtpu_manager.device.types import MeshSpec
 from vtpu_manager.resilience import failpoints
 from vtpu_manager.topology.links import fold_box_load
 from vtpu_manager.util import consts
+from vtpu_manager.util import stalecodec
 
 log = logging.getLogger(__name__)
 
 # staleness family constants (pressure/headroom/overcommit values)
 MAX_LINK_AGE_S = 120.0
-FUTURE_SKEW_TOLERANCE_S = 5.0
 
 # defensive parse bounds: a 64-chip 4x4x4 wrapped torus has 192 links;
 # the segment cap covers it with headroom, the length cap bounds the
@@ -81,7 +81,7 @@ class NodeLinkLoad:
                         f":{load:.3f}")
             if len(segs) >= MAX_LINK_SEGMENTS:
                 break
-        return f"{';'.join(segs)}@{self.ts:.3f}"
+        return stalecodec.stamp(";".join(segs), self.ts)
 
 
 def parse_link_load(raw: str | None, now: float | None = None,
@@ -90,19 +90,11 @@ def parse_link_load(raw: str | None, now: float | None = None,
     """Decode the annotation; None when absent, malformed, or stale —
     every bad shape degrades to no-signal, never to a wrong contention
     claim the scheduler would steer on."""
-    if not raw or len(raw) > MAX_LINK_LEN:
+    split = stalecodec.split_stamp(raw, max_len=MAX_LINK_LEN)
+    if split is None:
         return None
-    body, sep, ts_raw = raw.rpartition("@")
-    if not sep:
-        return None
-    try:
-        ts = float(ts_raw)
-    except (TypeError, ValueError):
-        return None
-    if not math.isfinite(ts):
-        return None
-    now = time.time() if now is None else now
-    if not -FUTURE_SKEW_TOLERANCE_S <= now - ts <= max_age_s:
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now=now, max_age_s=max_age_s):
         return None
     out: dict = {}
     segments = 0
@@ -140,8 +132,7 @@ def load_is_fresh(ll: "NodeLinkLoad | None",
     re-judge freshness at the moment it scores on it."""
     if ll is None:
         return False
-    now = time.time() if now is None else now
-    return -FUTURE_SKEW_TOLERANCE_S <= now - ll.ts <= MAX_LINK_AGE_S
+    return stalecodec.is_fresh(ll.ts, now=now, max_age_s=MAX_LINK_AGE_S)
 
 
 def load_map(ll: "NodeLinkLoad | None",
