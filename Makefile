@@ -146,12 +146,20 @@ test-overcommit: ## vtovc suite: ratio codec + policy percentiles, virtual admis
 bench-overcommit: ## vtovc headline bench: pods-per-chip density gate off/on (>=1.5x at bounded p99 step-time regression, thrash backoff asserted; writes BENCH_VTOVC_r11.json)
 	python scripts/bench_overcommit.py
 
+.PHONY: test-autopilot
+test-autopilot: ## vtpilot suite: election+fencing, hysteresis/cooldown/rate-limit guards, the three remediations through real channels, gang-migration e2e, crash-mid-migration reap convergence, gate-off byte-contracts both modes, one-cluster-scanner election
+	$(PYTEST) tests/test_autopilot.py -q
+
+.PHONY: bench-autopilot
+bench-autopilot: ## vtpilot headline bench: PR-15's four injected causes re-run with the autopilot on — >=3/4 remediated within K windows, zero steady-state actions, zero flapping, crash-mid-migration convergence (asserted; writes BENCH_VTAP_r17.json)
+	python scripts/bench_autopilot.py
+
 .PHONY: test-abi-san
 test-abi-san: ## ABI probe suite rebuilt with ASan+UBSan (skips clean when g++/libasan absent)
 	VTPU_ABI_SAN=1 $(PYTEST) tests/test_config_abi.py -q
 
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo test-abi-san bench-overcommit bench-clustercache bench-ici bench-comm bench-slo ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench, sanitized ABI probes
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo test-autopilot test-abi-san bench-overcommit bench-clustercache bench-ici bench-comm bench-slo bench-autopilot ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench, vtpilot autopilot suite + bench, sanitized ABI probes
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -87,6 +87,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 HBM_OVERCOMMIT,
                                                 QUOTA_MARKET,
                                                 SLO_ATTRIBUTION,
+                                                SLO_AUTOPILOT,
                                                 UTILIZATION_LEDGER,
                                                 FeatureGates)
 
@@ -103,6 +104,14 @@ def main(argv: list[str] | None = None) -> int:
     cluster_cache_on = gates.enabled(CLUSTER_COMPILE_CACHE)
     comm_on = gates.enabled(COMM_TELEMETRY)
     slo_on = gates.enabled(SLO_ATTRIBUTION)
+    autopilot_on = gates.enabled(SLO_AUTOPILOT)
+    if autopilot_on and not slo_on:
+        # the controller consumes vtslo verdicts — without the
+        # attribution plane there is nothing to act on (the vtcs/vtcc
+        # dependent-gate pattern: warn and disarm, never half-run)
+        logging.getLogger(__name__).warning(
+            "SLOAutopilot requires SLOAttribution; autopilot disabled")
+        autopilot_on = False
 
     backends = [FakeBackend(n_chips=args.fake_chips)] if args.fake_chips \
         else None
@@ -140,7 +149,70 @@ def main(argv: list[str] | None = None) -> int:
                 "node-local cut only")
             return None
 
-    fan_client = build_fan_client() if (util_on or explain_on) else None
+    fan_client = build_fan_client() \
+        if (util_on or explain_on or autopilot_on) else None
+
+    # vtpilot: the elected remediation loop rides the monitor (the
+    # process that already holds the /slo fan-in); gate off = no lease,
+    # no loop, no ledger file, no series, no route
+    autopilot = None
+    autopilot_migrator = None
+    if autopilot_on and fan_client is None:
+        logging.getLogger(__name__).warning(
+            "SLOAutopilot needs a cluster client; autopilot disabled")
+        autopilot_on = False
+    if autopilot_on:
+        import threading as _threading
+
+        from vtpu_manager.autopilot import (ActionContext,
+                                            AutopilotController,
+                                            GangMigrator,
+                                            default_actions,
+                                            reap_stale_migrations)
+        _node = args.node_name or "unknown"
+
+        def _base_for(node):
+            # the monitor can rewrite configs only on ITS node; actions
+            # elsewhere ride cluster channels (annotations, rebinds)
+            return args.base_dir if node == _node else None
+
+        autopilot_migrator = GangMigrator(fan_client, _base_for)
+        _ctx = ActionContext(fan_client, _base_for,
+                             migrator=autopilot_migrator)
+
+        def _verdict_feed():
+            collector.slo_ledger.fold()
+            doc = collector.slo_ledger.document()
+            out = []
+            for v in doc.get("verdicts", []):
+                v = dict(v)
+                v.setdefault("node", doc.get("node", ""))
+                out.append(v)
+            return out
+
+        autopilot = AutopilotController(
+            fan_client, f"{_node}-monitor", args.base_dir,
+            _verdict_feed, default_actions(_ctx))
+        # a fresh leader's first duty: reap the predecessor's stale
+        # migration intents (its token now outranks theirs)
+        autopilot.on_takeover = lambda: reap_stale_migrations(
+            fan_client, _base_for, migrator=autopilot_migrator)
+
+        _autopilot_stop = _threading.Event()
+
+        def _autopilot_loop():
+            while not _autopilot_stop.wait(15.0):
+                try:
+                    autopilot.tick()
+                except Exception as e:  # noqa: BLE001 — one bad tick
+                    # must not kill the loop; the lease keeps leading
+                    logging.getLogger(__name__).warning(
+                        "autopilot tick failed: %s", e)
+
+        _threading.Thread(target=_autopilot_loop, daemon=True,
+                          name="vtpilot").start()
+        logging.getLogger(__name__).info(
+            "autopilot controller running (holder %s-monitor)", _node)
 
     # vtuse cluster fan-in (gate on only): node/pod annotations over the
     # existing registry channel
@@ -168,7 +240,10 @@ def main(argv: list[str] | None = None) -> int:
             comm=comm_on,
             # vtslo: goodput columns + the fleet SLO block fold in only
             # when the slo gate is on (off = byte-identical document)
-            slo_ledger=collector.slo_ledger)
+            slo_ledger=collector.slo_ledger,
+            # vtpilot: the autopilot action headline folds in only when
+            # the autopilot gate is on (off = byte-identical document)
+            action_ledger=autopilot.ledger if autopilot else None)
 
     import hmac
 
@@ -211,6 +286,12 @@ def main(argv: list[str] | None = None) -> int:
             # records lost at the scheduler's ring are counted here too
             from vtpu_manager.explain import doctor as explain_doctor
             text += explain_doctor.render_spool_metrics(args.explain_dir)
+        if autopilot is not None:
+            # vtpilot leader/action/migration series (gate off = the
+            # render is never called, zero new series)
+            from vtpu_manager.autopilot import render_autopilot_metrics
+            text += render_autopilot_metrics(autopilot,
+                                             autopilot_migrator)
         # vtfault retry/breaker/failpoint counters for this process
         text += render_resilience_metrics() + "\n"
         return web.Response(text=text, content_type="text/plain")
@@ -331,6 +412,43 @@ def main(argv: list[str] | None = None) -> int:
                 {"error": f"slo rollup failed: {e}"}, status=503)
         return web.json_response(doc, status=status)
 
+    async def autopilot_route(request):
+        # vtpilot: leadership, guard counters, and the recent action
+        # trail (verdict -> action -> outcome, fence-stamped). Names
+        # pods/tenants: same bearer auth as /metrics; the ledger read
+        # runs in an executor thread and failures answer HERE with 503.
+        if not authorized(request):
+            return web.json_response({"error": "unauthorized"},
+                                     status=401)
+        import asyncio
+
+        def collect():
+            mig = autopilot_migrator
+            return {
+                "holder": autopilot.holder,
+                "leader": autopilot.is_leader(),
+                "verdicts_total": autopilot.verdicts_total,
+                "actions_total": dict(autopilot.actions_total),
+                "suppressed_total": dict(autopilot.suppressed_total),
+                "action_failures_total":
+                    autopilot.action_failures_total,
+                "migrations": {
+                    "total": mig.migrations_total,
+                    "failures": mig.migration_failures_total,
+                    "reaped": mig.reaped_total,
+                    "last_freeze_ms": round(mig.last_freeze_ms, 1),
+                },
+                "actions": autopilot.ledger.actions()[-50:],
+            }
+        try:
+            doc = await asyncio.get_running_loop() \
+                .run_in_executor(None, collect)
+        except Exception as e:  # noqa: BLE001 — a wedged control plane
+            # serves an explicit error, never a hang
+            return web.json_response(
+                {"error": f"autopilot rollup failed: {e}"}, status=503)
+        return web.json_response(doc)
+
     async def cache_entry(request):
         # vtcs peer-serving route (ClusterCompileCache gate; off = no
         # route at all, matching "zero fetch I/O"): raw checksummed
@@ -374,6 +492,9 @@ def main(argv: list[str] | None = None) -> int:
     if slo_on:
         # same gate-off contract: no /slo route at all (404)
         app.router.add_get("/slo", slo_route)
+    if autopilot is not None:
+        # same gate-off contract: no /autopilot route at all (404)
+        app.router.add_get("/autopilot", autopilot_route)
     if cluster_cache_on:
         # same gate-off contract: no /cache/entry route, so a node not
         # running the cluster tier can never be fetched from

@@ -123,6 +123,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 ICI_LINK_AWARE,
                                                 MEMORY_PLUGIN,
                                                 QUOTA_MARKET, RESCHEDULE,
+                                                SLO_AUTOPILOT,
                                                 STEP_TELEMETRY, TC_WATCHER,
                                                 TPU_TOPOLOGY, TRACING,
                                                 UTILIZATION_LEDGER,
@@ -577,6 +578,15 @@ def main(argv: list[str] | None = None) -> int:
     controller = None
     if gates.enabled(RESCHEDULE):
         from vtpu_manager.scheduler.lease import read_lease_state
+        # vtpilot: one controller fleet-wide wins the coordination
+        # lease and pays the cluster-scan LIST; the rest stay
+        # node-scoped. Gate off = probe None = everyone scans on
+        # cadence, byte-identical pre-vtpilot behavior.
+        scan_probe = None
+        if gates.enabled(SLO_AUTOPILOT):
+            from vtpu_manager.autopilot import coordination_scan_probe
+            scan_probe = coordination_scan_probe(
+                client, args.node_name, namespace=args.lease_namespace)
         controller = RescheduleController(
             client, args.node_name,
             known_uuids={c.uuid for c in chips},
@@ -587,8 +597,35 @@ def main(argv: list[str] | None = None) -> int:
             # fencing token + lease liveness before the wall-clock rule;
             # unstamped intents (HA off) never trigger the probe
             lease_probe=lambda shard: read_lease_state(
-                client, shard, namespace=args.lease_namespace))
+                client, shard, namespace=args.lease_namespace),
+            cluster_scan_leader=scan_probe)
         controller.start()
+
+    # vtpilot node-side reaper: a dead migrator's fence-stamped intent
+    # must never leave THIS node's tenants frozen — every node reaps
+    # its own configs on a slow cadence (the successor leader reaps
+    # fleet-wide on takeover; the shim's VTPU_FREEZE_MAX_S fail-open is
+    # the last backstop). Gate off = no thread, no lease reads.
+    reaper_stop = None
+    if gates.enabled(SLO_AUTOPILOT):
+        import threading
+
+        from vtpu_manager.autopilot import reap_stale_migrations
+        reap_base = args.base_dir or consts.MANAGER_BASE_DIR
+        base_for = lambda node: \
+            reap_base if node == args.node_name else None
+        reaper_stop = threading.Event()
+
+        def _reap_loop():
+            while not reaper_stop.wait(15.0):
+                try:
+                    reap_stale_migrations(client, base_for)
+                except Exception as e:
+                    log.warning("migration reap pass failed: %s", e)
+
+        threading.Thread(target=_reap_loop, daemon=True,
+                         name="vtpilot-reap").start()
+        log.info("autopilot migration reaper running")
 
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
@@ -620,6 +657,8 @@ def main(argv: list[str] | None = None) -> int:
             headroom_pub.stop()
         if market:
             market.stop()
+        if reaper_stop is not None:
+            reaper_stop.set()
         if controller:
             controller.stop()
         health.stop()

@@ -34,7 +34,15 @@ constexpr uint32_t kConfigMagic = 0x55505456;  // "VTPU"
 // link-bandwidth share for collective-heavy — multi-chip — dispatch;
 // the shim shapes it with a dedicated token bucket) + explicit pad.
 // 0 = unshaped; gate off writes zeros — v4 semantics byte-for-byte.
-constexpr uint32_t kConfigVersion = 5;
+// v6 (vtpilot): header grew migration_freeze (i32 bool — the
+// autopilot's freeze request: the shim parks dispatch at the
+// token-wait entry and drains in-flight Executes while set, with a
+// bounded fail-open so a dead controller never parks a tenant
+// forever) + freeze_epoch (u32, bumped on every freeze/unfreeze
+// transition; rides the quota_epoch adoption channel so a parked
+// shim sees the flag within one throttle quantum). Gate off writes
+// zeros in both — v5 semantics byte-for-byte.
+constexpr uint32_t kConfigVersion = 6;
 constexpr int kMaxDeviceCount = 64;
 constexpr int kUuidLen = 64;
 constexpr int kNameLen = 64;
@@ -118,6 +126,13 @@ struct VtpuConfig {
   // grant/revoke written into this config. The shim compares the
   // on-disk value against the loaded one in its token-wait loop.
   uint32_t quota_epoch;
+  // vtpilot (v6; both 0 when SLOAutopilot is off): the autopilot's
+  // freeze request. Non-zero migration_freeze parks dispatch at the
+  // token-wait entry and drains in-flight Executes; freeze_epoch
+  // bumps on every freeze/unfreeze transition and is adopted through
+  // the same epoch re-read loop as quota_epoch.
+  int32_t migration_freeze;
+  uint32_t freeze_epoch;
   VtpuDevice devices[kMaxDeviceCount];
   uint32_t checksum;  // FNV-1a over all preceding bytes
   uint32_t pad_;
@@ -126,8 +141,10 @@ static_assert(offsetof(VtpuConfig, device_count) == 248, "ABI");
 static_assert(offsetof(VtpuConfig, compile_cache_dir) == 256, "ABI");
 static_assert(offsetof(VtpuConfig, workload_class) == 320, "ABI");
 static_assert(offsetof(VtpuConfig, quota_epoch) == 324, "ABI");
-static_assert(offsetof(VtpuConfig, devices) == 328, "ABI");
-static_assert(sizeof(VtpuConfig) == 328 + 64 * 144 + 8, "VtpuConfig ABI");
+static_assert(offsetof(VtpuConfig, migration_freeze) == 328, "ABI");
+static_assert(offsetof(VtpuConfig, freeze_epoch) == 332, "ABI");
+static_assert(offsetof(VtpuConfig, devices) == 336, "ABI");
+static_assert(sizeof(VtpuConfig) == 336 + 64 * 144 + 8, "VtpuConfig ABI");
 
 inline uint64_t Fnv1a64(const char* data) {
   uint64_t h = 0xCBF29CE484222325ull;

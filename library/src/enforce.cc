@@ -1366,6 +1366,18 @@ void AdoptQuotaLocked(const VtpuConfig& fresh) {
   }
   s.config.workload_class = fresh.workload_class;
   s.config.quota_epoch = fresh.quota_epoch;
+  // vtpilot: the migration freeze rides the same rewrite channel as a
+  // lease (both fields 4-byte aligned ints, the benign-race idiom
+  // above). FreezePark re-reads them every quantum, so a freeze lands
+  // within one quantum + one re-read and an unfreeze releases every
+  // parked dispatcher on its next wakeup.
+  if (fresh.migration_freeze != s.config.migration_freeze) {
+    VTPU_LOG(kLogInfo, "migration freeze %s (epoch %u -> %u)",
+             fresh.migration_freeze ? "engaged" : "released",
+             s.config.freeze_epoch, fresh.freeze_epoch);
+  }
+  s.config.migration_freeze = fresh.migration_freeze;
+  s.config.freeze_epoch = fresh.freeze_epoch;
 }
 
 // Called from the token-wait loop (each ~2 ms quantum), RateLimit
@@ -2334,6 +2346,16 @@ extern "C" uint64_t vtpu_throttle_wait_ns_total() {
   return g_throttle_wait_ns.load(std::memory_order_relaxed);
 }
 
+// vtpilot: wall time parked under a migration freeze, kept SEPARATE
+// from g_throttle_wait_ns on purpose — a freeze park must not read as
+// throttle-wait, or every migration would surface as a throttle-spike
+// verdict and the autopilot would chase its own remediation's tail.
+std::atomic<uint64_t> g_freeze_wait_ns{0};
+
+extern "C" uint64_t vtpu_freeze_wait_ns_total() {
+  return g_freeze_wait_ns.load(std::memory_order_relaxed);
+}
+
 // vtcomm counterparts for the Python-owned ring: cumulative measured
 // collective/transfer time, bytes moved, and multi-chip dispatch count.
 // The Python writer charges each record the deltas (the throttle-wait
@@ -2429,6 +2451,55 @@ void RecordStepRing(int slot, uint64_t start_ns, uint64_t end_ns,
                           0, std::memory_order_relaxed));
 }
 
+// vtpilot: migration freeze — park new dispatch at the token-wait
+// entry until the controller clears the v6 migration_freeze flag.
+// In-flight Executes are NOT cancelled; they complete and decrement
+// hot.inflight, which is exactly the drain the migrator polls for.
+// The park applies to every tenant regardless of quota class (a freeze
+// quiesces dispatch, not budget), accumulates into g_freeze_wait_ns
+// (never g_throttle_wait_ns — see that counter's comment), and fails
+// open after VTPU_FREEZE_MAX_S (default 120 s) so a dead controller
+// can never park a training step forever; the token-aware reapers own
+// the durable cleanup. Unfrozen fast path: one int load.
+void FreezePark(int slot) {
+  ShimState& s = State();
+  // Re-read up front so even a tenant that never blocks in the
+  // token-wait loop notices a freshly-written freeze within a quantum
+  // (one atomic load+compare in the common case — see MaybeAdoptQuota).
+  MaybeAdoptQuota();
+  if (s.config.migration_freeze == 0) return;
+  int64_t max_s = 120;
+  const char* env = getenv("VTPU_FREEZE_MAX_S");
+  if (env && *env) {
+    int64_t v = atoll(env);
+    if (v > 0) max_s = v;
+  }
+  uint32_t epoch = s.config.freeze_epoch;
+  uint64_t start = NowNs();
+  VTPU_LOG(kLogInfo, "device %d dispatch parked: migration freeze epoch %u",
+           slot, epoch);
+  while (s.config.migration_freeze != 0) {
+    if (NowNs() - start > (uint64_t)max_s * 1000ull * 1000 * 1000) {
+      VTPU_LOG(kLogError,
+               "migration freeze epoch %u held > %lld s; failing open "
+               "(controller dead or unfreeze rewrite lost)",
+               epoch, (long long)max_s);
+      return;
+    }
+    uint64_t sleep_start = NowNs();
+    usleep(kTickSleepUs);
+    g_freeze_wait_ns.fetch_add(NowNs() - sleep_start,
+                               std::memory_order_relaxed);
+    // the unfreeze rides the same config rewrite channel as a quota
+    // grant: re-read each quantum so release lands within one quantum
+    MaybeAdoptQuota();
+  }
+  VTPU_LOG(kLogInfo,
+           "device %d dispatch released: freeze epoch %u cleared after "
+           "%llu ms", slot, epoch,
+           (unsigned long long)((NowNs() - start) / 1000000));
+}
+
 void RateLimit(int slot, int64_t cost_us) {
   ShimState& s = State();
   const VtpuDevice* cfg = DeviceCfg(slot);
@@ -2437,6 +2508,9 @@ void RateLimit(int slot, int64_t cost_us) {
   // core-limited: an unlimited tenant's activity still determines how much
   // of the chip's duty cycle its limited co-tenants are charged for.
   BumpActivity(slot);
+  // vtpilot: freeze check precedes the core-limit early return — an
+  // unlimited tenant's migration must still quiesce its dispatch.
+  FreezePark(slot);
   if (cfg->core_limit == kCoreLimitNone) return;
   StartWatcherOnce();
   // vtqm: an actively-submitting borrower must notice a revoke even

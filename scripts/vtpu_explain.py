@@ -167,6 +167,15 @@ def main(argv: list[str] | None = None) -> int:
         else:
             _status, verdict = slo_doctor.why_slow_offline(
                 args.base_dir, args.why_slow, quota_dir=args.base_dir)
+        # vtpilot trail: splice this pod's recent autopilot actions
+        # next to the verdict. Gate off => no ledger file under the
+        # base dir => the verdict (and its rendering) is byte-identical
+        try:
+            from vtpu_manager.autopilot import ActionLedger
+            slo_doctor.splice_action_trail(
+                verdict, ActionLedger(args.base_dir).actions())
+        except (OSError, ValueError, TypeError):
+            pass
         if args.as_json:
             print(json.dumps(verdict, indent=2))
         else:

@@ -117,6 +117,22 @@ def render(doc: dict, out=None) -> None:
         parts.append(f"{slo.get('regressions', 0)} attributed "
                      f"regression(s)")
         print("  " + "  ".join(parts), file=out)
+    # vtpilot headline (autopilot documents only — a gate-off rollup
+    # carries no "autopilot" key, so the prior output is byte-identical)
+    ap = doc.get("autopilot")
+    if ap is not None:
+        parts = [f"AUTOPILOT: {ap.get('actions_last_hour', 0)} "
+                 f"action(s) last hour"]
+        by = ap.get("by_action") or {}
+        if by:
+            parts.append("  ".join(f"{name} x{count}"
+                                   for name, count in sorted(by.items())))
+        last = ap.get("last_action") or {}
+        last_act = (last.get("action") or {}).get("action")
+        if last_act:
+            parts.append(f"last: {last_act} -> "
+                         f"{str(last.get('tenant', ''))[:28]}")
+        print("  " + "  ".join(parts), file=out)
     # vtqm evidence loop (market documents only): per-lease
     # borrowed-vs-used — did the borrower use what it borrowed?
     for bu in (quota or {}).get("borrowed_used") or []:

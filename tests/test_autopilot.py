@@ -1,0 +1,927 @@
+"""vtpilot suite: the elected remediation controller + live gang migration.
+
+Covers, in order: the audit primitives (action ledger, token buckets),
+the guard stack (hysteresis, cooldown, per-tenant AND per-node rate
+limits, the both-or-neither bucket rule), election + fencing on the
+real ShardLease machinery, each remediation through the REAL channel it
+owns (vtqm ledger + config rewrite, overcommit annotation clamp, vtici
+link-load target scoring), gang migration end to end, crash-mid-
+migration convergence (age rule and token rule separately, idempotent
+re-reap), the one-cluster-scanner election for the reschedule
+controller, the CLI splices, and the gate-off byte-contracts.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vtpu_manager.autopilot import (ACTION_COOLDOWN_S, AUTOPILOT_SHARD,
+                                    ActionContext, ActionLedger,
+                                    AutopilotController, GangMigrator,
+                                    TokenBucket, coordination_scan_probe,
+                                    reap_stale_migrations,
+                                    render_autopilot_metrics)
+from vtpu_manager.autopilot import actions as ap_actions
+from vtpu_manager.autopilot import migrate as ap_migrate
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.controller.reschedule import RescheduleController
+from vtpu_manager.overcommit.ratio import NodeOvercommit, parse_overcommit
+from vtpu_manager.overcommit.spill import SpillBudgetError
+from vtpu_manager.quota.ledger import QuotaLeaseLedger
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.resilience.failpoints import CrashFailpoint
+from vtpu_manager.scheduler.lease import ShardLease, parse_fence
+from vtpu_manager.slo import doctor as slo_doctor
+from vtpu_manager.topology.linkload import NodeLinkLoad
+from vtpu_manager.util import consts
+from vtpu_manager.util.featuregates import SLO_AUTOPILOT, FeatureGates
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GIB = 1 << 30
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures
+# ---------------------------------------------------------------------------
+
+def _mk_config(base, uid, cont="main", host_index=0):
+    path = os.path.join(base, f"{uid}_{cont}", "config", "vtpu.config")
+    vc.write_config(path, vc.VtpuConfig(
+        pod_uid=uid, pod_name=uid, pod_namespace="ml",
+        container_name=cont,
+        devices=[vc.DeviceConfig(uuid=f"TPU-FAKE-{host_index:04d}",
+                                 total_memory=8 * GIB,
+                                 real_memory=8 * GIB, hard_core=80,
+                                 host_index=host_index)]))
+    return path
+
+
+def _pod(name, uid, node="n-src", ns="ml"):
+    return {"metadata": {"name": name, "namespace": ns, "uid": uid,
+                         "annotations": {}},
+            "spec": {"nodeName": node, "containers": [{"name": "main"}]},
+            "status": {"phase": "Running"}}
+
+
+def _node(name, annotations=None):
+    return {"metadata": {"name": name, "annotations": annotations or {}}}
+
+
+def _verdict(kind="throttle-spike", tenant="uid-1/main", node="n-src",
+             onset=100.0, ts=None):
+    return {"kind": kind, "tenant": tenant, "node": node,
+            "ts": onset if ts is None else ts,
+            "episode_onset_ts": onset, "summary": f"{kind} injected"}
+
+
+class Feed:
+    """Mutable verdict feed: tests set .batch between ticks."""
+
+    def __init__(self):
+        self.batch = []
+
+    def __call__(self):
+        return list(self.batch)
+
+
+class StubLease:
+    """Always-fresh leadership with a fixed token, for guard-stack
+    tests that are not about the election itself."""
+
+    def __init__(self, token=7):
+        self.token = token
+
+    def held_fresh(self):
+        return True
+
+    def confirm(self):
+        pass
+
+    def try_acquire(self):
+        return True
+
+    def fence_annotations(self):
+        from vtpu_manager.scheduler.lease import encode_fence
+        return {consts.shard_fence_annotation():
+                encode_fence(AUTOPILOT_SHARD, self.token)}
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _ok_actions(kind="throttle-spike"):
+    calls = []
+
+    def fn(v, fence):
+        calls.append((v, fence))
+        return {"action": "retune-quota", "ok": True}
+
+    return calls, {kind: fn}
+
+
+def _controller(tmp_path, feed, actions, **kw):
+    kw.setdefault("lease", StubLease())
+    return AutopilotController(FakeKubeClient(), "t-mon", str(tmp_path),
+                               feed, actions, **kw)
+
+
+# ---------------------------------------------------------------------------
+# audit primitives
+# ---------------------------------------------------------------------------
+
+class TestActionLedger:
+    def test_roundtrip_since_and_torn_tail(self, tmp_path):
+        led = ActionLedger(str(tmp_path))
+        led.record({"kind": "autopilot", "ts": 10.0, "tenant": "a"})
+        led.record({"kind": "autopilot", "ts": 20.0, "tenant": "b"})
+        with open(led.path, "a") as f:
+            f.write('{"kind": "autopilot", "ts": 30.0, "tena')  # torn
+        assert [r["tenant"] for r in led.actions()] == ["a", "b"]
+        assert [r["tenant"] for r in led.actions(since=15.0)] == ["b"]
+
+    def test_missing_file_reads_empty(self, tmp_path):
+        assert ActionLedger(str(tmp_path / "nowhere")).actions() == []
+
+
+class TestTokenBucket:
+    def test_capacity_refill_and_nonconsuming_peek(self):
+        b = TokenBucket(2, 100.0, clock=lambda: 0.0)
+        assert b.peek("k", 0.0) and b.peek("k", 0.0)  # peek never takes
+        assert b.take("k", 0.0) and b.take("k", 0.0)
+        assert not b.take("k", 0.0)
+        assert not b.peek("k", 50.0)   # half a token back is not one
+        assert b.peek("k", 100.0)
+        assert b.take("k", 100.0)
+        assert not b.take("k", 100.0)
+
+
+# ---------------------------------------------------------------------------
+# the guard stack
+# ---------------------------------------------------------------------------
+
+class TestGuards:
+    def test_hysteresis_needs_two_distinct_episodes(self, tmp_path):
+        feed = Feed()
+        calls, actions = _ok_actions()
+        c = _controller(tmp_path, feed, actions)
+        feed.batch = [_verdict(onset=100.0)]
+        assert c.tick(now=1000.0) == []
+        assert c.suppressed_total["hysteresis"] == 1
+        # the SAME episode re-presenting is still one episode
+        feed.batch = [_verdict(onset=100.0)]
+        assert c.tick(now=1010.0) == []
+        assert c.suppressed_total["hysteresis"] == 2
+        # a second DISTINCT onset satisfies the guard
+        feed.batch = [_verdict(onset=300.0)]
+        taken = c.tick(now=1020.0)
+        assert len(taken) == 1 and len(calls) == 1
+        rec = taken[0]
+        assert rec["kind"] == "autopilot"
+        assert parse_fence(rec["fence"]) == (AUTOPILOT_SHARD, 7)
+        assert rec["action"]["ok"] is True
+        # and it landed in the on-disk ledger, verdict attached
+        (entry,) = c.ledger.actions()
+        assert entry["tenant"] == "uid-1/main"
+        assert entry["verdict"]["kind"] == "throttle-spike"
+
+    def test_cooldown_suppresses_then_releases(self, tmp_path):
+        feed = Feed()
+        calls, actions = _ok_actions()
+        c = _controller(tmp_path, feed, actions, hysteresis_episodes=1)
+        feed.batch = [_verdict(onset=100.0)]
+        assert len(c.tick(now=1000.0)) == 1
+        # a fresh episode inside the cooldown is suppressed
+        feed.batch = [_verdict(onset=200.0)]
+        assert c.tick(now=1000.0 + ACTION_COOLDOWN_S / 2) == []
+        assert c.suppressed_total["cooldown"] == 1
+        # past the cooldown it acts again
+        feed.batch = [_verdict(onset=300.0)]
+        assert len(c.tick(now=1000.0 + ACTION_COOLDOWN_S + 1)) == 1
+        assert len(calls) == 2
+
+    def test_unmapped_kind_suppressed_without_burning_tokens(
+            self, tmp_path):
+        feed = Feed()
+        c = _controller(tmp_path, feed, {}, hysteresis_episodes=1)
+        feed.batch = [_verdict(kind="compile-storm", onset=1.0)]
+        assert c.tick(now=1000.0) == []
+        feed.batch = [_verdict(kind="compile-storm", onset=2.0)]
+        assert c.tick(now=1000.0) == []
+        assert c.suppressed_total["no-action"] == 2
+        # neither bucket was debited by the refusals
+        assert c.tenant_bucket.take("uid-1/main", 1000.0)
+        assert c.tenant_bucket.take("uid-1/main", 1000.0)
+        assert not c.tenant_bucket.take("uid-1/main", 1000.0)
+
+    def test_tenant_rate_limit(self, tmp_path):
+        feed = Feed()
+        calls, actions = _ok_actions()
+        c = _controller(tmp_path, feed, actions, hysteresis_episodes=1,
+                        cooldown_s=0.0)
+        for i in range(3):
+            feed.batch = [_verdict(onset=float(i + 1))]
+            c.tick(now=1000.0)
+        assert len(calls) == 2          # TENANT_BUCKET_CAPACITY
+        assert c.suppressed_total["rate-limit-tenant"] == 1
+
+    def test_node_rate_limit_spares_tenant_tokens(self, tmp_path):
+        feed = Feed()
+        calls, actions = _ok_actions()
+        c = _controller(tmp_path, feed, actions, hysteresis_episodes=1,
+                        cooldown_s=0.0)
+        for i in range(5):
+            feed.batch = [_verdict(tenant=f"uid-{i}/main",
+                                   onset=float(i + 1))]
+            c.tick(now=1000.0)
+        assert len(calls) == 4          # NODE_BUCKET_CAPACITY
+        assert c.suppressed_total["rate-limit-node"] == 1
+        # both-or-neither: the refused tenant's own bucket untouched
+        assert c.tenant_bucket.take("uid-4/main", 1000.0)
+
+    def test_failed_action_recorded_and_cooled_down(self, tmp_path):
+        feed = Feed()
+
+        def boom(v, fence):
+            raise RuntimeError("lever jammed")
+
+        c = _controller(tmp_path, feed, {"throttle-spike": boom},
+                        hysteresis_episodes=1)
+        feed.batch = [_verdict(onset=1.0)]
+        taken = c.tick(now=1000.0)
+        assert taken[0]["action"] == {"action": "throttle-spike",
+                                      "ok": False,
+                                      "error": "lever jammed"}
+        assert c.action_failures_total == 1
+        # a failure still starts the cooldown — no retry storm
+        feed.batch = [_verdict(onset=2.0)]
+        assert c.tick(now=1001.0) == []
+        assert c.suppressed_total["cooldown"] == 1
+
+    def test_metrics_render_and_gate_off_empty(self, tmp_path):
+        assert render_autopilot_metrics(None) == ""
+        feed = Feed()
+        calls, actions = _ok_actions()
+        c = _controller(tmp_path, feed, actions, hysteresis_episodes=1)
+        feed.batch = [_verdict(onset=1.0),
+                      _verdict(kind="goodput-drop", onset=1.0)]
+        c.tick(now=1000.0)
+        mig = GangMigrator(FakeKubeClient(), lambda n: None)
+        text = render_autopilot_metrics(c, mig)
+        assert 'vtpu_autopilot_leader{holder="t-mon"} 1' in text
+        assert "vtpu_autopilot_verdicts_total 2" in text
+        assert ('vtpu_autopilot_actions_total{action="throttle-spike"}'
+                " 1") in text
+        assert ('vtpu_autopilot_suppressed_total{reason="no-action"} 1'
+                ) in text
+        assert "vtpu_autopilot_action_failures_total 0" in text
+        assert "vtpu_migration_total 0" in text
+        assert "vtpu_migration_last_freeze_ms 0.0" in text
+
+
+# ---------------------------------------------------------------------------
+# election + fencing on the real lease machinery
+# ---------------------------------------------------------------------------
+
+class TestElection:
+    def test_one_leads_takeover_bumps_token_and_reaps(self, tmp_path):
+        client = FakeKubeClient()
+        wall, mono = Clock(1000.0), Clock(0.0)
+        feed = Feed()
+        calls_a, actions_a = _ok_actions()
+        calls_b, actions_b = _ok_actions()
+        a = AutopilotController(
+            client, "mon-a", str(tmp_path / "a"), feed, actions_a,
+            hysteresis_episodes=1,
+            lease=ShardLease(client, AUTOPILOT_SHARD, "mon-a",
+                             monotonic=mono, wall=wall))
+        b = AutopilotController(
+            client, "mon-b", str(tmp_path / "b"), feed, actions_b,
+            hysteresis_episodes=1,
+            lease=ShardLease(client, AUTOPILOT_SHARD, "mon-b",
+                             monotonic=mono, wall=wall))
+        feed.batch = [_verdict(onset=1.0)]
+        taken_a = a.tick(now=wall())
+        taken_b = b.tick(now=wall())
+        assert len(taken_a) == 1 and taken_b == []
+        assert a.is_leader() and not b.is_leader()
+        token_a = parse_fence(taken_a[0]["fence"])[1]
+        # depose a (its renew never lands); b's takeover bumps the
+        # fencing token and fires the reap hook exactly once
+        reaps = []
+        b.on_takeover = lambda: reaps.append(True)
+        wall.advance(40.0)
+        mono.advance(40.0)
+        feed.batch = [_verdict(onset=2.0)]
+        taken_b = b.tick(now=wall())
+        assert len(taken_b) == 1
+        assert parse_fence(taken_b[0]["fence"])[1] > token_a
+        assert reaps == [True]
+        # the deposed leader cannot act against the live lease
+        feed.batch = [_verdict(onset=3.0)]
+        assert a.tick(now=wall()) == []
+        # staying leader does not re-fire the takeover hook
+        feed.batch = []
+        b.tick(now=wall())
+        assert reaps == [True]
+
+
+# ---------------------------------------------------------------------------
+# the three remediations, through their real channels
+# ---------------------------------------------------------------------------
+
+class TestRetuneQuota:
+    def test_grants_lease_and_rewrites_config(self, tmp_path):
+        base = str(tmp_path / "n-src")
+        path = _mk_config(base, "uid-q")
+        ctx = ActionContext(FakeKubeClient(),
+                            lambda n: base if n == "n-src" else None,
+                            clock=lambda: 5000.0)
+        out = ap_actions.retune_quota(
+            ctx, _verdict(tenant="uid-q/main"), "autopilot:3")
+        assert out["ok"] and out["grants"]
+        cfg = vc.read_config(path)
+        assert cfg.devices[0].lease_core == ap_actions.GRANT_STEP_PCT
+        assert cfg.quota_epoch == out["epoch"] > 0
+        # the grant went through the vtqm ledger: lender "autopilot",
+        # TTL'd so it expires on its own if the autopilot dies
+        mine = [le for le in QuotaLeaseLedger(base).leases()
+                if le["lender"] == "autopilot"]
+        assert len(mine) == 1
+        assert mine[0]["borrower"] == "uid-q"
+        assert mine[0]["ttl_s"] > 0
+
+    def test_missing_base_dir_is_an_outcome_not_an_error(self):
+        ctx = ActionContext(FakeKubeClient(), lambda n: None)
+        out = ap_actions.retune_quota(ctx, _verdict(), "autopilot:1")
+        assert out == {"action": "retune-quota", "ok": False,
+                       "reason": "no-base-dir", "node": "n-src"}
+
+
+class StubMigrator:
+    def __init__(self, ok=True):
+        self.ok = ok
+        self.calls = []
+
+    def migrate(self, pod, target, fence):
+        self.calls.append((pod["metadata"]["uid"], target, fence))
+        return {"ok": self.ok, "target": target}
+
+
+class TestRelieveSpill:
+    def test_clamps_overcommit_one_step(self):
+        client = FakeKubeClient()
+        oc = NodeOvercommit(ratios={"throughput": 2.0, "latency": 1.5},
+                            spill_frac=0.3, spilled_bytes=GIB,
+                            ts=5000.0)
+        client.add_node(_node("n-src", {
+            consts.node_overcommit_annotation(): oc.encode()}))
+        ctx = ActionContext(client, lambda n: None,
+                            clock=lambda: 5000.0)
+        out = ap_actions.relieve_spill(
+            ctx, _verdict(kind="spill-thrash"), "autopilot:2")
+        assert out["action"] == "clamp-overcommit" and out["ok"]
+        raw = client.get_node("n-src")["metadata"]["annotations"][
+            consts.node_overcommit_annotation()]
+        after = parse_overcommit(raw, now=5000.0)
+        assert after.ratios == {"throughput": 1.75, "latency": 1.25}
+
+    def test_at_floor_escalates_to_migrating_the_tenant(self):
+        client = FakeKubeClient()
+        oc = NodeOvercommit(ratios={"throughput": 1.0}, spill_frac=0.4,
+                            spilled_bytes=GIB, ts=5000.0)
+        client.add_node(_node("n-src", {
+            consts.node_overcommit_annotation(): oc.encode()}))
+        client.add_node(_node("n-quiet"))
+        client.add_pod(_pod("thrash-0", "uid-1"))
+        mig = StubMigrator()
+        ctx = ActionContext(client, lambda n: None, migrator=mig,
+                            clock=lambda: 5000.0)
+        out = ap_actions.relieve_spill(
+            ctx, _verdict(kind="spill-thrash"), "autopilot:2")
+        assert out["action"] == "migrate-thrashing" and out["ok"]
+        # the source node is excluded from the candidate set
+        assert mig.calls == [("uid-1", "n-quiet", "autopilot:2")]
+
+
+class TestReplaceGang:
+    def _client(self, now):
+        client = FakeKubeClient()
+
+        def ann(worst):
+            return NodeLinkLoad(links={((0, 0, 0), 0): worst},
+                                ts=now).encode()
+
+        client.add_node(_node("n-src", {
+            consts.node_ici_link_load_annotation(): ann(0.9)}))
+        client.add_node(_node("n-busy", {
+            consts.node_ici_link_load_annotation(): ann(0.6)}))
+        client.add_node(_node("n-quiet", {
+            consts.node_ici_link_load_annotation(): ann(0.1)}))
+        return client
+
+    def test_quietest_node_by_worst_link(self):
+        now = 5000.0
+        ctx = ActionContext(self._client(now), lambda n: None,
+                            clock=lambda: now)
+        name, worst = ap_actions.quietest_node(ctx, exclude=("n-src",))
+        assert name == "n-quiet" and worst == pytest.approx(0.1)
+
+    def test_replaces_gang_on_quietest_submesh(self):
+        now = 5000.0
+        client = self._client(now)
+        client.add_pod(_pod("gang-0", "uid-g"))
+        mig = StubMigrator()
+        ctx = ActionContext(client, lambda n: None, migrator=mig,
+                            clock=lambda: now)
+        out = ap_actions.replace_gang(
+            ctx, _verdict(kind="comm-inflation", tenant="uid-g/main"),
+            "autopilot:4")
+        assert out["ok"] and out["target"] == "n-quiet"
+        assert out["action"] == "replace-gang"
+        assert mig.calls == [("uid-g", "n-quiet", "autopilot:4")]
+
+    def test_no_migrator_reports_not_raises(self):
+        ctx = ActionContext(FakeKubeClient(), lambda n: None)
+        out = ap_actions.replace_gang(
+            ctx, _verdict(kind="comm-inflation"), "autopilot:1")
+        assert out == {"action": "replace-gang", "ok": False,
+                       "reason": "no-migrator"}
+
+
+# ---------------------------------------------------------------------------
+# gang migration end to end
+# ---------------------------------------------------------------------------
+
+def _mig_setup(tmp_path, uid="uid-m"):
+    client = FakeKubeClient()
+    client.add_node(_node("n-src"))
+    client.add_node(_node("n-dst"))
+    client.add_pod(_pod("gang-0", uid))
+    bases = {"n-src": str(tmp_path / "n-src"),
+             "n-dst": str(tmp_path / "n-dst")}
+    path = _mk_config(bases["n-src"], uid)
+    return client, bases, path
+
+
+class TestGangMigration:
+    def test_end_to_end(self, tmp_path):
+        client, bases, path = _mig_setup(tmp_path)
+        frozen_seen = []
+
+        def drain_check(pod):
+            # mid-flight the source config must be frozen (flag set,
+            # both epochs bumped so the shim's re-read loop adopts it)
+            cfg = vc.read_config(path)
+            frozen_seen.append((cfg.migration_freeze, cfg.freeze_epoch,
+                                cfg.quota_epoch))
+            return True
+
+        mig = GangMigrator(client, bases.get, drain_check=drain_check)
+        out = mig.migrate(client.get_pod("ml", "gang-0"), "n-dst",
+                          "autopilot:5")
+        assert out["ok"] and out["source"] == "n-src"
+        assert out["configs_frozen"] == 1 and out["drained"]
+        assert frozen_seen == [(1, 1, 1)]
+        # rebind went through the normal path: one annotation patch
+        # with the bind shape, then the Binding POST
+        assert ("ml", "gang-0", "n-dst") in client.bindings
+        anns = client.get_pod("ml", "gang-0")["metadata"]["annotations"]
+        assert consts.migration_intent_annotation() not in anns
+        assert anns[consts.allocation_status_annotation()] == \
+            consts.ALLOC_STATUS_SUCCEED
+        assert anns[consts.shard_fence_annotation()] == "autopilot:5"
+        assert anns[consts.predicate_node_annotation()] == "n-dst"
+        # the source config unfroze; every flip bumped both epochs
+        cfg = vc.read_config(path)
+        assert cfg.migration_freeze == 0
+        assert cfg.freeze_epoch == 2 and cfg.quota_epoch == 2
+        assert mig.migrations_total == 1
+        assert mig.last_freeze_ms >= 0.0
+
+    def test_demotion_budget_guarded_with_invariants(self, tmp_path):
+        client, bases, path = _mig_setup(tmp_path)
+        committed, checks = [], []
+
+        class Pool:
+            def spill(self, host_index, buf_id, payload):
+                if len(committed) >= 2:
+                    raise SpillBudgetError("host pool exhausted")
+                committed.append((host_index, buf_id, len(payload)))
+
+        bufs = [(0, f"buf-{i}", b"x" * 10) for i in range(4)]
+        mig = GangMigrator(
+            client, bases.get,
+            spill_pool_for_node=lambda n: Pool() if n == "n-src"
+            else None,
+            resident_buffers=lambda pod, node: list(bufs),
+            invariant_check=lambda: checks.append(True))
+        out = mig.migrate(client.get_pod("ml", "gang-0"), "n-dst",
+                          "autopilot:1")
+        # budget exhaustion stops demoting but does NOT fail the
+        # migration — what stays resident refills cold on the target
+        assert out["ok"]
+        assert out["spilled"] == {"buffers": 2, "bytes": 20}
+        # invariants re-proved before EVERY commit, incl. the refused one
+        assert len(checks) == 3 and len(committed) == 2
+
+    def test_failed_bind_unfreezes_in_place(self, tmp_path):
+        client, bases, path = _mig_setup(tmp_path)
+
+        def bad_bind(ns, name, node):
+            raise RuntimeError("apiserver said no")
+
+        client.bind_pod = bad_bind
+        mig = GangMigrator(client, bases.get)
+        out = mig.migrate(client.get_pod("ml", "gang-0"), "n-dst",
+                          "autopilot:1")
+        assert out["ok"] is False and "apiserver said no" in out["error"]
+        assert mig.migration_failures_total == 1
+        # rolled back in place: unfrozen, trail closed, gang unmoved
+        cfg = vc.read_config(path)
+        assert cfg.migration_freeze == 0 and cfg.freeze_epoch == 2
+        anns = client.get_pod("ml", "gang-0")["metadata"]["annotations"]
+        assert consts.migration_intent_annotation() not in anns
+        assert client.bindings == []
+
+    def test_intent_codec_roundtrip_and_garbage(self):
+        raw = ap_migrate.encode_migration_intent("n-src", "n-dst",
+                                                 "autopilot:9", 123.5)
+        assert ap_migrate.parse_migration_intent(raw) == \
+            ("n-src", "n-dst", "autopilot:9", 123.5)
+        for bad in (None, "", "garbage", "no-sep@123.5",
+                    "one|sep-only@123.5", "src||autopilot:1@123.5"):
+            assert ap_migrate.parse_migration_intent(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# crash-mid-migration convergence
+# ---------------------------------------------------------------------------
+
+class TestCrashConvergence:
+    @pytest.fixture(autouse=True)
+    def _failpoints(self):
+        failpoints.enable(seed=7)
+        yield
+        failpoints.disable()
+
+    def test_crash_at_freeze_reaped_by_age(self, tmp_path):
+        client, bases, path = _mig_setup(tmp_path)
+        mig = GangMigrator(client, bases.get)
+        failpoints.arm("migrate.freeze", "crash")
+        with pytest.raises(CrashFailpoint):
+            mig.migrate(client.get_pod("ml", "gang-0"), "n-dst",
+                        "autopilot:1")
+        anns = client.get_pod("ml", "gang-0")["metadata"]["annotations"]
+        parsed = ap_migrate.parse_migration_intent(
+            anns[consts.migration_intent_annotation()])
+        assert parsed[:3] == ("n-src", "n-dst", "autopilot:1")
+        ts = parsed[3]
+        # current incarnation, inside the TTL: a live migration, left
+        # alone (no lease readable -> the wall-clock rule governs)
+        assert reap_stale_migrations(client, bases.get, now=ts + 1.0,
+                                     lease_probe=lambda: None) == []
+        # aged out: reaped — trail cleared, counter bumped
+        reaper = GangMigrator(client, bases.get)
+        assert reap_stale_migrations(
+            client, bases.get,
+            now=ts + ap_migrate.MIGRATION_INTENT_TTL_S + 1.0,
+            lease_probe=lambda: None, migrator=reaper) == ["gang-0"]
+        assert reaper.reaped_total == 1
+        cfg = vc.read_config(path)
+        assert cfg.migration_freeze == 0
+        anns = client.get_pod("ml", "gang-0")["metadata"]["annotations"]
+        assert consts.migration_intent_annotation() not in anns
+        # idempotent: a second pass finds nothing and bumps nothing
+        assert reap_stale_migrations(
+            client, bases.get, now=ts + 120.0,
+            lease_probe=lambda: None, migrator=reaper) == []
+        assert reaper.reaped_total == 1
+        assert vc.read_config(path).freeze_epoch == 0  # never frozen
+
+    def test_crash_at_refill_reaped_by_token(self, tmp_path):
+        client, bases, path = _mig_setup(tmp_path)
+        mig = GangMigrator(client, bases.get)
+        failpoints.arm("migrate.refill", "crash")
+        with pytest.raises(CrashFailpoint):
+            mig.migrate(client.get_pod("ml", "gang-0"), "n-dst",
+                        "autopilot:1")
+        # the crash window: rebound but still frozen, intent still up
+        assert vc.read_config(path).migration_freeze == 1
+        assert ("ml", "gang-0", "n-dst") in client.bindings
+        # a successor incarnation (token 2 > 1) reaps INSIDE the TTL —
+        # the dead leader's work will never finish, no point waiting
+        class Live:
+            token = 2
+
+        assert reap_stale_migrations(
+            client, bases.get, now=time.time(),
+            lease_probe=lambda: Live()) == ["gang-0"]
+        cfg = vc.read_config(path)
+        assert cfg.migration_freeze == 0 and cfg.freeze_epoch == 2
+        anns = client.get_pod("ml", "gang-0")["metadata"]["annotations"]
+        assert consts.migration_intent_annotation() not in anns
+        # no double ownership: exactly one binding for the pod
+        assert client.bindings.count(("ml", "gang-0", "n-dst")) == 1
+
+    def test_crash_failpoint_flies_past_the_controller(self, tmp_path):
+        feed = Feed()
+        calls, actions = _ok_actions()
+        c = _controller(tmp_path, feed, actions, hysteresis_episodes=1)
+        failpoints.arm("autopilot.act", "crash")
+        feed.batch = [_verdict(onset=1.0)]
+        with pytest.raises(CrashFailpoint):
+            c.tick(now=1000.0)
+        assert calls == []
+
+    def test_error_failpoint_counts_as_action_failure(self, tmp_path):
+        feed = Feed()
+        calls, actions = _ok_actions()
+        c = _controller(tmp_path, feed, actions, hysteresis_episodes=1)
+        failpoints.arm("autopilot.act", "error")
+        feed.batch = [_verdict(onset=1.0)]
+        taken = c.tick(now=1000.0)
+        assert taken[0]["action"]["ok"] is False
+        assert c.action_failures_total == 1
+        assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: ONE reschedule controller pays the cluster scan
+# ---------------------------------------------------------------------------
+
+class TestCoordinationScan:
+    def _controllers(self, client, probes):
+        return [RescheduleController(client, f"node-{i}",
+                                     checkpoint_path="/nonexistent",
+                                     intent_scan_every=1,
+                                     cluster_scan_leader=probe)
+                for i, probe in enumerate(probes)]
+
+    def _count_cluster_lists(self, client):
+        calls = []
+        orig = client.list_pods
+
+        def counting(namespace=None, node_name=None,
+                     field_selector=None):
+            if node_name is None and field_selector is None:
+                calls.append(1)
+            return orig(namespace=namespace, node_name=node_name,
+                        field_selector=field_selector)
+
+        client.list_pods = counting
+        return calls
+
+    def test_exactly_one_controller_pays_the_cluster_list(self):
+        client = FakeKubeClient()
+        probes = [coordination_scan_probe(client, f"node-{i}")
+                  for i in range(3)]
+        ctls = self._controllers(client, probes)
+        calls = self._count_cluster_lists(client)
+        for ctl in ctls:
+            ctl.reconcile_once()
+        assert len(calls) == 1
+        # the election is sticky: a second round still has ONE scanner
+        for ctl in ctls:
+            ctl.reconcile_once()
+        assert len(calls) == 2
+
+    def test_probe_raising_falls_back_to_scanning(self):
+        client = FakeKubeClient()
+
+        def broken():
+            raise RuntimeError("lease backend down")
+
+        (ctl,) = self._controllers(client, [broken])
+        calls = self._count_cluster_lists(client)
+        ctl.reconcile_once()
+        # a never-reaped crash window costs correctness; duplicate
+        # LISTs only cost load — the fallback scans
+        assert len(calls) == 1
+
+    def test_probe_none_keeps_pre_vtpilot_shape(self):
+        client = FakeKubeClient()
+        ctls = self._controllers(client, [None, None])
+        calls = self._count_cluster_lists(client)
+        for ctl in ctls:
+            ctl.reconcile_once()
+        assert len(calls) == 2          # everyone scans, as before
+
+
+# ---------------------------------------------------------------------------
+# CLI splices (gate off = byte-identical output)
+# ---------------------------------------------------------------------------
+
+def _load_script(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCLISurfaces:
+    def test_splice_action_trail_gate_off_identical(self):
+        doc = {"pod": "uid-1", "verdict": "healthy", "summary": "s"}
+        before = dict(doc)
+        base_lines = slo_doctor.format_verdict(doc)
+        # no actions / no match: the document and rendering are
+        # byte-identical — no key is ever added
+        assert slo_doctor.splice_action_trail(doc, []) == before
+        slo_doctor.splice_action_trail(
+            doc, [{"tenant": "uid-other/main", "ts": 1.0}])
+        assert doc == before
+        assert slo_doctor.format_verdict(doc) == base_lines
+
+    def test_splice_action_trail_renders_newest_first(self):
+        doc = {"pod": "uid-1", "verdict": "regressed", "summary": "s"}
+        base_lines = slo_doctor.format_verdict(doc)
+        slo_doctor.splice_action_trail(doc, [
+            {"tenant": "uid-1/main", "ts": 1.0, "fence": "autopilot:3",
+             "action": {"action": "replace-gang", "ok": False,
+                        "error": "no pod"}},
+            {"tenant": "uid-1/main", "ts": 2.0, "fence": "autopilot:4",
+             "action": {"action": "retune-quota", "ok": True}},
+            {"tenant": "uid-1/main", "ts": 3.0, "fence": "autopilot:4",
+             "action": {"action": "suppressed", "reason": "cooldown"}},
+        ])
+        lines = slo_doctor.format_verdict(doc)
+        assert lines[:len(base_lines)] == base_lines
+        assert lines[len(base_lines):] == [
+            "  autopilot: suppressed (cooldown)  fence autopilot:4",
+            "  autopilot: retune-quota ok  fence autopilot:4",
+            "  autopilot: replace-gang FAILED: no pod  fence "
+            "autopilot:3",
+        ]
+
+    def test_smi_autopilot_headline(self, capsys):
+        smi = _load_script("vtpu_smi")
+        doc = {"nodes": [], "pods": []}
+        smi.render(doc)
+        off = capsys.readouterr().out
+        assert "AUTOPILOT:" not in off   # gate off: no key, no line
+        doc["autopilot"] = {
+            "actions_last_hour": 3,
+            "by_action": {"retune-quota": 2, "replace-gang": 1},
+            "last_action": {"tenant": "uid-1/main",
+                            "action": {"action": "replace-gang"}},
+        }
+        smi.render(doc)
+        on = capsys.readouterr().out
+        line = [ln for ln in on.splitlines() if "AUTOPILOT:" in ln]
+        assert line and "3 action(s) last hour" in line[0]
+        assert "replace-gang x1" in line[0]
+        assert "retune-quota x2" in line[0]
+        assert "last: replace-gang -> uid-1/main" in line[0]
+        # the headline is additive: everything before it is unchanged
+        assert on.replace(line[0] + "\n", "") == off
+
+
+# ---------------------------------------------------------------------------
+# gate-off contracts
+# ---------------------------------------------------------------------------
+
+class TestGateOff:
+    def test_gate_defaults_off(self):
+        assert FeatureGates().enabled(SLO_AUTOPILOT) is False
+
+    def test_no_controller_no_lease_traffic_no_ledger(self, tmp_path):
+        # the cmd hosts construct NOTHING when the gate is off; here we
+        # assert the primitives themselves are inert until constructed:
+        # a fresh fake client has no lease objects and the base dir has
+        # no ledger file
+        client = FakeKubeClient()
+        assert client.leases == {} and client.lease_history == []
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "autopilot_actions.jsonl"))
+
+    def test_default_config_carries_v5_wire_zeroes(self):
+        cfg = vc.VtpuConfig()
+        assert cfg.migration_freeze == 0 and cfg.freeze_epoch == 0
+
+
+# ---------------------------------------------------------------------------
+# monitor e2e: the /autopilot route and the dependent-gate rule
+# ---------------------------------------------------------------------------
+
+class TestMonitorE2E:
+    @staticmethod
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    @staticmethod
+    def _wait_healthy(port, proc, deadline_s=30):
+        import urllib.request
+        t0 = time.time()
+        while time.time() - t0 < deadline_s:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"monitor exited rc={proc.returncode}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=1) as r:
+                    if r.status == 200:
+                        return
+            except OSError:
+                time.sleep(0.2)
+        raise AssertionError("monitor never became healthy")
+
+    def _run(self, tmp_path, gates):
+        port = self._free_port()
+        base = str(tmp_path / "mgr")
+        os.makedirs(base, exist_ok=True)
+        argv = [sys.executable,
+                os.path.join(REPO, "cmd/device_monitor.py"),
+                "--port", str(port), "--host", "127.0.0.1",
+                "--node-name", "node-1", "--fake-chips", "1",
+                "--base-dir", base, "--fake-client",
+                "--tc-path", str(tmp_path / "none.tc"),
+                "--vmem-path", str(tmp_path / "none.vmem"),
+                "--trace-spool-dir", str(tmp_path / "spool")]
+        if gates:
+            argv += ["--feature-gates", gates]
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        return port, base, proc
+
+    def test_gate_on_route_and_series(self, tmp_path):
+        import urllib.request
+        port, base, proc = self._run(
+            tmp_path, "SLOAttribution=true,SLOAutopilot=true")
+        try:
+            self._wait_healthy(port, proc)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/autopilot",
+                    timeout=10) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["holder"] == "node-1-monitor"
+            assert set(doc) >= {"leader", "verdicts_total",
+                                "actions_total", "suppressed_total",
+                                "migrations", "actions"}
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as r:
+                metrics = r.read().decode()
+            assert 'vtpu_autopilot_leader{holder="node-1-monitor"}' \
+                in metrics
+            assert "vtpu_migration_total" in metrics
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_gate_off_no_route_no_series_no_ledger(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        port, base, proc = self._run(tmp_path, "SLOAttribution=true")
+        try:
+            self._wait_healthy(port, proc)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/autopilot", timeout=10)
+            assert err.value.code == 404
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as r:
+                metrics = r.read().decode()
+            assert "vtpu_autopilot_" not in metrics
+            assert "vtpu_migration_" not in metrics
+            assert not os.path.exists(
+                os.path.join(base, "autopilot_actions.jsonl"))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_dependent_gate_disarms_without_slo(self, tmp_path):
+        # SLOAutopilot without SLOAttribution has no verdict feed to
+        # act on: warn + disarm (the vtcs/vtcc dependent-gate pattern)
+        import urllib.error
+        import urllib.request
+        port, base, proc = self._run(tmp_path, "SLOAutopilot=true")
+        try:
+            self._wait_healthy(port, proc)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/autopilot", timeout=10)
+            assert err.value.code == 404
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

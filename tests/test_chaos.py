@@ -550,6 +550,14 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     # check
     failpoints.arm("ici.publish", "error", p=0.3,
                    count=rng.randint(1, 2))
+    # vtpilot sites: driven by the dedicated autopilot chaos tests
+    # (test_autopilot.py — the e2e loop here runs no autopilot), armed
+    # so the full-coverage assertion stays the honest catalog check
+    failpoints.arm("autopilot.act", "error", p=0.2,
+                   count=rng.randint(1, 2))
+    failpoints.arm("migrate.freeze", rng.choice(["crash", "error"]),
+                   p=0.2, count=1)
+    failpoints.arm("migrate.refill", "crash", p=0.2, count=1)
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 

@@ -101,6 +101,9 @@ int main() {
   printf("cfg.workload_class %zu\n",
          offsetof(VtpuConfig, workload_class));
   printf("cfg.quota_epoch %zu\n", offsetof(VtpuConfig, quota_epoch));
+  printf("cfg.migration_freeze %zu\n",
+         offsetof(VtpuConfig, migration_freeze));
+  printf("cfg.freeze_epoch %zu\n", offsetof(VtpuConfig, freeze_epoch));
   printf("tc_file_size %zu\n", sizeof(TcUtilFile));
   printf("tc_record_size %zu\n", sizeof(TcDeviceRecord));
   printf("tc_proc_size %zu\n", sizeof(TcProcUtil));
@@ -212,6 +215,7 @@ class TestVtpuConfigRoundtrip:
             pod_uid="uid-123", pod_name="trainer", pod_namespace="ml",
             container_name="main", compat_mode=0x05,
             workload_class=vc.WORKLOAD_CLASS_LATENCY, quota_epoch=42,
+            migration_freeze=1, freeze_epoch=3,
             devices=[vc.DeviceConfig(
                 uuid="TPU-ABC", total_memory=8 * 2**30,
                 real_memory=16 * 2**30, hard_core=50, soft_core=80,
@@ -227,6 +231,8 @@ class TestVtpuConfigRoundtrip:
         assert back.compat_mode == 0x05
         assert back.workload_class == vc.WORKLOAD_CLASS_LATENCY
         assert back.quota_epoch == 42
+        assert back.migration_freeze == 1
+        assert back.freeze_epoch == 3
         dev = back.devices[0]
         assert dev.uuid == "TPU-ABC"
         assert dev.total_memory == 8 * 2**30
@@ -239,14 +245,17 @@ class TestVtpuConfigRoundtrip:
 
     def test_v3_defaults_zero(self):
         """A gate-off config (no class, no leases, no overcommit, no
-        link share) carries zeros in every v3/v4/v5 field — the lease
-        delta is byte-identical to the old pad, the v4 spill pair and
-        the v5 ici_link_pct write only zeros beyond the v3 layout."""
+        link share, no freeze) carries zeros in every v3/v4/v5/v6
+        field — the lease delta is byte-identical to the old pad, the
+        v4 spill pair, the v5 ici_link_pct and the v6 freeze pair
+        write only zeros beyond the v3 layout."""
         back = vc.VtpuConfig.unpack(vc.VtpuConfig(
             pod_uid="u", devices=[vc.DeviceConfig(
                 uuid="X", total_memory=1, real_memory=1)]).pack())
         assert back.workload_class == vc.WORKLOAD_CLASS_NONE
         assert back.quota_epoch == 0
+        assert back.migration_freeze == 0
+        assert back.freeze_epoch == 0
         assert back.devices[0].lease_core == 0
         assert back.devices[0].virtual_hbm_bytes == 0
         assert back.devices[0].spill_budget_bytes == 0
@@ -274,6 +283,19 @@ class TestVtpuConfigRoundtrip:
         raw[0] = 0
         # checksum still matches? no - magic is inside checksummed region
         with pytest.raises(ValueError):
+            vc.VtpuConfig.unpack(bytes(raw))
+
+    def test_v5_stamp_refused(self):
+        """v5<->v6 graceful skip, Python side: a config stamped with the
+        prior version is refused with a clean version error (never a
+        misparse of the shorter header) — mixed-version node
+        mid-upgrade. Checksum is recomputed so the refusal is
+        specifically the version check."""
+        raw = bytearray(self._sample().pack())
+        struct.pack_into("<I", raw, 4, vc.VERSION - 1)
+        struct.pack_into("<II", raw, vc.CONFIG_SIZE - 8,
+                         vc._fnv1a(bytes(raw[: vc.CONFIG_SIZE - 8])), 0)
+        with pytest.raises(ValueError, match="version"):
             vc.VtpuConfig.unpack(bytes(raw))
 
     def test_too_many_devices(self):
@@ -762,6 +784,17 @@ int main(int argc, char** argv) {
       break;
     }
   }
+  // v6 graceful skip + freeze adoption: a wrong-version rewrite must
+  // be refused (Check() false, prior config kept — epoch 9 never
+  // surfaces), and the NEXT valid v6 rewrite — carrying the
+  // migration-freeze pair — adopts cleanly.
+  for (int i = 0; i < 5000; i++) {
+    usleep(2000);
+    if (qr.Check(&cfg)) break;
+  }
+  printf("adopt2 %u freeze %d fepoch %u\n", cfg.quota_epoch,
+         cfg.migration_freeze, cfg.freeze_epoch);
+  fflush(stdout);
   // cache client interop against the Python store
   CompileCacheClient cc(argv[2]);
   if (!cc.ok()) return 5;
@@ -827,6 +860,28 @@ class TestCxxQuotaAndCacheClient:
             line = proc.stdout.readline().split()
             assert line == ["adopt", "8", "lease", "25", "eff", "65",
                             "ici", "55"]
+            # v5<->v6 graceful skip, C++ side: a stale-version rewrite
+            # (valid checksum, version stamped back down) is refused —
+            # epoch 9 must never surface — then the next valid v6
+            # rewrite, carrying the migration-freeze pair, adopts.
+            raw = bytearray(cfg.pack())
+            struct.pack_into("<I", raw, 4, vc.VERSION - 1)
+            struct.pack_into("<I", raw,
+                             vc.HEADER_OFFSETS["quota_epoch"], 9)
+            struct.pack_into(
+                "<II", raw, vc.CONFIG_SIZE - 8,
+                vc._fnv1a(bytes(raw[: vc.CONFIG_SIZE - 8])), 0)
+            stale = cfg_path + ".stale"
+            with open(stale, "wb") as fh:
+                fh.write(bytes(raw))
+            os.replace(stale, cfg_path)
+            time.sleep(0.2)          # several probe poll quanta
+            cfg.quota_epoch = 10
+            cfg.migration_freeze = 1
+            cfg.freeze_epoch = 1
+            vc.write_config(cfg_path, cfg)
+            line = proc.stdout.readline().split()
+            assert line == ["adopt2", "10", "freeze", "1", "fepoch", "1"]
             # store interop: C++ verifies the Python-written entry...
             assert proc.stdout.readline().strip() == \
                 "py_payload hello-from-python"

@@ -264,6 +264,43 @@ class TestDetectors:
         # verdict, not one per window
         assert len(v) == 1
 
+    def test_cause_join_anchors_at_episode_onset(self, tmp_path):
+        """Red-on-bug for the fixed-window join: two settled leases
+        STRADDLE the episode — lease A revoked before the onset, lease
+        B revoked mid-incident. A verdict re-fired late in the incident
+        must still name A (the cause precedes its effect); the old
+        fixed 600 s window anchored at the verdict's own ts named the
+        newer, unrelated B."""
+        ledger = QuotaLeaseLedger(str(tmp_path), clock=lambda: 0.0)
+        lease_a, _ = ledger.grant(0, "uid-l/main", "uid-x/main", 20,
+                                  30.0, 1.0)
+        lease_b, _ = ledger.grant(0, "uid-l/main", "uid-x/main", 10,
+                                  30.0, 1.0)
+        ledger.settle([lease_a["id"]], "revoked", 4.0)   # pre-onset
+        det = detect.RegressionDetector(quota_dir=str(tmp_path))
+        fold = attribution.fold_window
+        for i in range(6):
+            w = fold([rec(duration=10_000_000, throttle=200_000)],
+                     ts=float(i))
+            assert det.observe("uid-x/main", w, now=float(i)) is None
+        # onset at ts 6: the incident begins
+        w = fold([rec(duration=18_000_000, throttle=8_600_000)], ts=6.0)
+        v1 = det.observe("uid-x/main", w, now=6.0)
+        assert v1 is not None and v1.cause["lease_id"] == lease_a["id"]
+        assert v1.episode_onset_ts == 6.0
+        # one clean window closes the episode without ending the
+        # incident; lease B settles in that gap (MID-incident)
+        w = fold([rec(duration=10_000_000, throttle=200_000)], ts=7.0)
+        assert det.observe("uid-x/main", w, now=7.0) is None
+        ledger.settle([lease_b["id"]], "revoked", 7.5)
+        # the incident re-fires within EPISODE_REJOIN_S: the verdict
+        # keeps the ORIGINAL onset and must still blame A, not B
+        w = fold([rec(duration=24_000_000, throttle=14_600_000)], ts=9.0)
+        v2 = det.observe("uid-x/main", w, now=9.0)
+        assert v2 is not None
+        assert v2.episode_onset_ts == 6.0
+        assert v2.cause["lease_id"] == lease_a["id"]
+
 
 # ---------------------------------------------------------------------------
 # history: bounded rings, spool persistence, torn-line chaos

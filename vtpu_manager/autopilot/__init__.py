@@ -1,0 +1,56 @@
+"""vtpilot — SLO autopilot: elected remediation + live gang migration.
+
+The closed-loop layer above vtslo: the detector plane names a cause
+("throttle-spike coincides with lease q42-0-3 revoke"), this plane acts
+on it through the planes that already own the levers — quota leases for
+throttle, the overcommit annotation for spill thrash, the vtici
+link-load scores for comm inflation — never through a side channel.
+
+Gate contract (``SLOAutopilot``, default off = byte-identical): no
+``autopilot`` lease is created or read, no controller loop runs, no
+action is ever taken (placement untouched in BOTH scheduler modes), no
+action ledger exists under the base dir, no ``vtpu_autopilot_*`` /
+``vtpu_migration_*`` series render, the monitor registers no
+``/autopilot`` route, configs carry ``migration_freeze=0`` /
+``freeze_epoch=0`` (the v5 wire bytes), and vtpu-smi / ``--why-slow``
+output is byte-identical.
+
+Why ELECTED: every remediation here is a cluster-visible mutation
+(annotation patches, quota grants, a rebind). Two autopilots acting on
+the same verdict stream would fight — migrate the same gang twice,
+double-clamp a node — so exactly one instance leads, behind the same
+ShardLease machinery vtha schedulers use (shard name ``autopilot``),
+and every action it takes is stamped with the lease's monotone fencing
+token. A deposed leader's in-flight migration is recognizable by its
+stale token and reaped by the successor (migrate.py).
+
+Why BOUNDED: a controller that reacts to every verdict instantly will
+chase noise and amplify it (act on a spike, the migration itself costs
+a window, the detector flags the migration...). Three independent
+guards, all of which must pass: hysteresis (a cause must persist across
+>= 2 distinct detector episodes), cooldown (no action on a tenant
+within ACTION_COOLDOWN_S of its last), and token buckets per tenant AND
+per node. Every action AND every suppression is auditable (vtexplain
+``kind=autopilot`` + the on-disk action ledger).
+"""
+
+from vtpu_manager.autopilot.controller import (ACTION_COOLDOWN_S,
+                                               AUTOPILOT_SHARD,
+                                               COORDINATION_SHARD,
+                                               HYSTERESIS_EPISODES,
+                                               ActionLedger,
+                                               AutopilotController,
+                                               TokenBucket,
+                                               coordination_scan_probe,
+                                               render_autopilot_metrics)
+from vtpu_manager.autopilot.actions import ActionContext, default_actions
+from vtpu_manager.autopilot.migrate import (GangMigrator,
+                                            reap_stale_migrations)
+
+__all__ = [
+    "ACTION_COOLDOWN_S", "AUTOPILOT_SHARD", "COORDINATION_SHARD",
+    "HYSTERESIS_EPISODES", "ActionContext", "ActionLedger",
+    "AutopilotController", "GangMigrator", "TokenBucket",
+    "coordination_scan_probe", "default_actions",
+    "reap_stale_migrations", "render_autopilot_metrics",
+]

@@ -1,0 +1,221 @@
+"""vtpilot remediations: one bounded executor per named cause.
+
+Each action goes through the plane that already owns the lever — no
+side channels, so every mutation is visible to that plane's own audit
+and reclaim machinery:
+
+- **throttle-spike -> retune quota**: grant the tenant a bounded,
+  TTL'd quota lease through the vtqm ledger (lender ``autopilot``) and
+  rewrite its config's ``lease_core``/``quota_epoch`` — the SAME
+  adoption channel the market manager uses, so the C++ shim picks the
+  raise up in its token-wait re-read and the lease expires on its own
+  if the autopilot dies.
+- **spill-thrash -> clamp overcommit, or migrate**: shrink every class
+  ratio in the node's overcommit annotation one step (the scheduler
+  stops admitting against the phantom capacity immediately). When the
+  node is already at ratio 1.0 the clamp has nothing left to give, so
+  the action escalates to migrating the thrashing tenant off the box.
+  The clamp holds until the node's own policy publisher re-rolls; the
+  action cooldown covers that window, and a re-offending node just
+  gets clamped again.
+- **comm-inflation -> re-place the gang**: score candidate nodes by
+  their published vtici link-load (worst contended link, the exact
+  signal the scheduler's link_term reads), pick the quietest, and
+  live-migrate the gang there (migrate.py). Submesh-level placement on
+  the target is the scheduler's job at bind — the autopilot only picks
+  the box.
+
+Every executor returns an outcome dict (never raises for policy
+outcomes — "nothing to clamp" is an outcome, not an error) and the
+controller records it verbatim.
+"""
+
+from __future__ import annotations
+
+import time
+
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.tenantdirs import iter_container_config_paths
+from vtpu_manager.overcommit.ratio import NodeOvercommit, parse_overcommit
+from vtpu_manager.quota.ledger import QuotaLeaseLedger
+from vtpu_manager.quota.market import scaled_grant_step
+from vtpu_manager.topology.linkload import load_map, parse_link_load
+from vtpu_manager.util import consts
+
+# quota-retune bounds (the market's own vocabulary: a step is a lease
+# pct, the TTL makes every grant self-expiring)
+GRANT_STEP_PCT = 10
+MAX_BORROW_PCT = 40
+LEASE_TTL_S = 60.0
+
+# one overcommit clamp step: every class ratio shrinks by this much,
+# floored at 1.0 (no oversubscription)
+CLAMP_STEP = 0.25
+
+
+class ActionContext:
+    """Everything the executors need, injectable for tests/bench.
+
+    ``base_dir_for_node(node)`` resolves a node name to its tenant
+    config base dir (the bench maps each fake node to a tmp dir; a
+    real deployment resolves the node's hostPath). ``pod_for_tenant``
+    finds the pod object to migrate; the default scans the client by
+    pod UID (the tenant key's first segment)."""
+
+    def __init__(self, client, base_dir_for_node, migrator=None,
+                 candidate_nodes=None, pod_for_tenant=None,
+                 clock=time.time):
+        self.client = client
+        self.base_dir_for_node = base_dir_for_node
+        self.migrator = migrator
+        self.candidate_nodes = candidate_nodes or \
+            (lambda: sorted(getattr(client, "nodes", {}) or {}))
+        self.pod_for_tenant = pod_for_tenant or self._pod_by_uid
+        self.clock = clock
+
+    def _pod_by_uid(self, tenant: str):
+        uid = tenant.partition("/")[0]
+        for pod in self.client.list_pods():
+            meta = pod.get("metadata", {})
+            if meta.get("uid") == uid:
+                return pod
+        return None
+
+
+def default_actions(ctx: ActionContext) -> dict:
+    """kind -> executor registry for AutopilotController."""
+    return {
+        "throttle-spike": lambda v, fence: retune_quota(ctx, v, fence),
+        "spill-thrash": lambda v, fence: relieve_spill(ctx, v, fence),
+        "comm-inflation": lambda v, fence: replace_gang(ctx, v, fence),
+    }
+
+
+# -- throttle-spike ----------------------------------------------------------
+
+def retune_quota(ctx: ActionContext, verdict: dict,
+                 fence: str) -> dict:
+    node = str(verdict.get("node", ""))
+    tenant = str(verdict.get("tenant", ""))
+    base = ctx.base_dir_for_node(node)
+    if not base:
+        return {"action": "retune-quota", "ok": False,
+                "reason": "no-base-dir", "node": node}
+    uid = tenant.partition("/")[0]
+    targets = [(label, path) for cfg_uid, label, path, _dra in
+               iter_container_config_paths(base) if cfg_uid == uid]
+    if not targets:
+        return {"action": "retune-quota", "ok": False,
+                "reason": "no-config", "tenant": tenant}
+    now = ctx.clock()
+    # no live utilization verdict plumbed here => scaled_grant_step
+    # resets to the base step with full TTL; the market's own feedback
+    # leg takes over sizing on subsequent passes
+    step, ttl_factor = scaled_grant_step(
+        GRANT_STEP_PCT, GRANT_STEP_PCT, MAX_BORROW_PCT,
+        None, None, None)
+    ledger = QuotaLeaseLedger(base, clock=ctx.clock)
+    granted = []
+    epoch = 0
+    for label, path in targets:
+        try:
+            cfg = vc.read_config(path)
+        except (OSError, ValueError):
+            continue    # a writer's crash window; next episode retries
+        for dev in cfg.devices:
+            lease, epoch = ledger.grant(
+                dev.host_index, "autopilot", uid, step,
+                LEASE_TTL_S * ttl_factor, now=now)
+            dev.lease_core += step
+            granted.append({"lease_id": lease["id"],
+                            "chip": dev.host_index, "pct": step})
+        cfg.quota_epoch = epoch
+        vc.write_config(path, cfg)
+    if not granted:
+        return {"action": "retune-quota", "ok": False,
+                "reason": "no-config", "tenant": tenant}
+    return {"action": "retune-quota", "ok": True, "tenant": tenant,
+            "node": node, "fence": fence, "epoch": epoch,
+            "ttl_s": LEASE_TTL_S * ttl_factor, "grants": granted}
+
+
+# -- spill-thrash ------------------------------------------------------------
+
+def relieve_spill(ctx: ActionContext, verdict: dict,
+                  fence: str) -> dict:
+    node = str(verdict.get("node", ""))
+    now = ctx.clock()
+    raw = None
+    if node:
+        node_obj = ctx.client.get_node(node) or {}
+        raw = (node_obj.get("metadata", {}).get("annotations", {})
+               or {}).get(consts.node_overcommit_annotation())
+    oc = parse_overcommit(raw, now=now)
+    if oc is not None and oc.max_ratio() > 1.0:
+        clamped = {k: max(1.0, round(r - CLAMP_STEP, 2))
+                   for k, r in oc.ratios.items()}
+        patched = NodeOvercommit(ratios=clamped,
+                                 spill_frac=oc.spill_frac,
+                                 spilled_bytes=oc.spilled_bytes,
+                                 ts=now)
+        ctx.client.patch_node_annotations(node, {
+            consts.node_overcommit_annotation(): patched.encode()})
+        return {"action": "clamp-overcommit", "ok": True, "node": node,
+                "fence": fence, "ratios_before": dict(oc.ratios),
+                "ratios_after": clamped}
+    # nothing left to clamp (ratio already 1.0, or no fresh policy
+    # signal): the node is thrashing at physical capacity, so move the
+    # thrashing tenant instead of starving it further
+    return _migrate_tenant(ctx, verdict, fence,
+                           action="migrate-thrashing",
+                           exclude=(node,))
+
+
+# -- comm-inflation ----------------------------------------------------------
+
+def quietest_node(ctx: ActionContext, exclude=(),
+                  now: float | None = None):
+    """(node, worst_link) with the LOWEST worst-link contention among
+    candidates publishing fresh link-load; a node with no fresh signal
+    scores 0.0 (an idle mesh and an unmeasured one look the same here —
+    the scheduler's link_term applies the same no-signal identity)."""
+    now = ctx.clock() if now is None else now
+    best = None
+    for name in ctx.candidate_nodes():
+        if name in exclude:
+            continue
+        node_obj = ctx.client.get_node(name) or {}
+        raw = (node_obj.get("metadata", {}).get("annotations", {})
+               or {}).get(consts.node_ici_link_load_annotation())
+        lm = load_map(parse_link_load(raw, now=now), now=now)
+        worst = max(lm.values()) if lm else 0.0
+        if best is None or worst < best[1]:
+            best = (name, worst)
+    return best
+
+
+def replace_gang(ctx: ActionContext, verdict: dict,
+                 fence: str) -> dict:
+    return _migrate_tenant(ctx, verdict, fence, action="replace-gang",
+                           exclude=(str(verdict.get("node", "")),))
+
+
+def _migrate_tenant(ctx: ActionContext, verdict: dict, fence: str,
+                    action: str, exclude=()) -> dict:
+    tenant = str(verdict.get("tenant", ""))
+    if ctx.migrator is None:
+        return {"action": action, "ok": False, "reason": "no-migrator"}
+    pod = ctx.pod_for_tenant(tenant)
+    if pod is None:
+        return {"action": action, "ok": False, "reason": "no-pod",
+                "tenant": tenant}
+    choice = quietest_node(ctx, exclude=exclude)
+    if choice is None:
+        return {"action": action, "ok": False,
+                "reason": "no-target-node", "tenant": tenant}
+    target, worst = choice
+    outcome = ctx.migrator.migrate(pod, target, fence)
+    return {"action": action, "ok": bool(outcome.get("ok")),
+            "tenant": tenant, "target": target,
+            "target_worst_link": round(worst, 3),
+            "migration": outcome}

@@ -1,0 +1,411 @@
+"""vtpilot controller: the elected verdict-to-action loop.
+
+One instance fleet-wide leads (ShardLease on the ``autopilot`` shard —
+the exact vtha election/fencing machinery, not a parallel one);
+followers tick cheaply and take over on lease expiry. The leader
+consumes vtslo verdicts (the monitor's /slo fan-in, injected as a
+callable so tests and the bench drive it directly), pushes each through
+three independent guards — hysteresis, cooldown, token buckets per
+tenant AND per node — and dispatches the survivors to the per-cause
+action registry (actions.py). Every action carries the lease's fencing
+token; every action and every suppression lands in the vtexplain spool
+(``kind=autopilot``) and the on-disk JSONL action ledger.
+
+All ``vtpu_autopilot_*`` / ``vtpu_migration_*`` series literals live in
+THIS module (the metrics one-home rule); migration counts are folded in
+from the migrator by :func:`render_autopilot_metrics`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.scheduler.lease import LeaseLostError, ShardLease
+from vtpu_manager.util.flock import FileLock
+
+log = logging.getLogger(__name__)
+
+# the one fleet-wide election unit; vtha shard names are node pools,
+# this one names a control loop — same lease object shape either way
+AUTOPILOT_SHARD = "autopilot"
+
+# the sibling coordination lease: elects ONE reschedule controller
+# fleet-wide to pay the cluster-scan LIST (device plugins compete for
+# this one; the monitor-side remediation loop competes for
+# AUTOPILOT_SHARD — two elections, one machinery)
+COORDINATION_SHARD = "autopilot-coord"
+
+ACTION_LEDGER_NAME = "autopilot_actions.jsonl"
+
+# a cause must be emitted by this many DISTINCT detector episodes
+# (distinct episode_onset_ts) before the controller acts — one episode
+# is a spike, two is a pattern. Paired with detect.py's one-verdict-
+# per-episode rule this bounds the controller's reaction rate to the
+# detector's episode rate, not its window rate.
+HYSTERESIS_EPISODES = 2
+
+# no second action on the same tenant within this many seconds of the
+# last, whatever the cause — remediations need time to show up in the
+# detector's windows before the controller may conclude they failed
+ACTION_COOLDOWN_S = 180.0
+
+# token buckets: burst capacity + steady refill, per tenant and per
+# node. The node bucket is the wider one — a node-wide incident (bad
+# link, thrashing neighbor) surfaces as several tenants' verdicts and
+# must not turn into an action storm on one box.
+TENANT_BUCKET_CAPACITY = 2
+TENANT_BUCKET_REFILL_S = 300.0     # one token per 5 min
+NODE_BUCKET_CAPACITY = 4
+NODE_BUCKET_REFILL_S = 150.0
+
+# suppression reasons (ledger + metrics label vocabulary)
+SUPPRESS_HYSTERESIS = "hysteresis"
+SUPPRESS_COOLDOWN = "cooldown"
+SUPPRESS_TENANT_BUCKET = "rate-limit-tenant"
+SUPPRESS_NODE_BUCKET = "rate-limit-node"
+SUPPRESS_NO_ACTION = "no-action"
+SUPPRESS_REASONS = (SUPPRESS_HYSTERESIS, SUPPRESS_COOLDOWN,
+                    SUPPRESS_TENANT_BUCKET, SUPPRESS_NODE_BUCKET,
+                    SUPPRESS_NO_ACTION)
+
+# bound on remembered episode onsets per (tenant, kind) — hysteresis
+# needs "at least N distinct", never the full history
+_MAX_EPISODES_KEPT = 8
+
+
+class ActionLedger:
+    """Append-only JSONL record of every action taken — the durable
+    half of the audit trail (vtexplain is the queryable half; this file
+    survives monitor restarts and feeds the bench's flap assertions).
+    Same crash discipline as the quota ledger: writes under a FileLock
+    on a sibling ``.flock``, reads tolerate a torn final line."""
+
+    def __init__(self, base_dir: str, clock=time.time):
+        self.path = os.path.join(base_dir, ACTION_LEDGER_NAME)
+        self.clock = clock
+
+    def record(self, action: dict) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        line = json.dumps(action, separators=(",", ":"))
+        with FileLock(f"{self.path}.flock"):
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def actions(self, since: float = 0.0) -> list[dict]:
+        """Recorded actions with ts >= since; a torn trailing line (a
+        writer's crash window) reads as absent, never as an error."""
+        try:
+            with open(self.path) as f:
+                raw = f.read()
+        except OSError:
+            return []
+        out = []
+        for line in raw.splitlines():
+            try:
+                rec = json.loads(line)
+            except (ValueError, TypeError):
+                continue
+            if isinstance(rec, dict) and \
+                    float(rec.get("ts", 0.0)) >= since:
+                out.append(rec)
+        return out
+
+
+class TokenBucket:
+    """Keyed token buckets with continuous refill — the rate limiter
+    both per-tenant and per-node guards share. ``peek`` and ``take``
+    are split so the controller can require BOTH buckets before
+    consuming from either (no tenant token burned on a node refusal)."""
+
+    def __init__(self, capacity: int, refill_s: float, clock=time.time):
+        self.capacity = float(capacity)
+        self.refill_s = float(refill_s)
+        self.clock = clock
+        self._level: dict[str, tuple[float, float]] = {}  # key -> (tokens, ts)
+
+    def _refreshed(self, key: str, now: float) -> float:
+        tokens, ts = self._level.get(key, (self.capacity, now))
+        if now > ts:
+            tokens = min(self.capacity,
+                         tokens + (now - ts) / self.refill_s)
+        return tokens
+
+    def peek(self, key: str, now: float | None = None) -> bool:
+        now = self.clock() if now is None else now
+        return self._refreshed(key, now) >= 1.0
+
+    def take(self, key: str, now: float | None = None) -> bool:
+        now = self.clock() if now is None else now
+        tokens = self._refreshed(key, now)
+        if tokens < 1.0:
+            self._level[key] = (tokens, now)
+            return False
+        self._level[key] = (tokens - 1.0, now)
+        return True
+
+
+def acquire_or_confirm(lease: ShardLease) -> bool:
+    """One election step, shared by both loops: renew if leading,
+    otherwise try to take over an expired lease. Never blocks on a
+    live foreign lease; False means follow this tick."""
+    try:
+        if lease.held_fresh():
+            lease.confirm()
+            return True
+        return lease.try_acquire()
+    except LeaseLostError:
+        return False
+
+
+def coordination_scan_probe(client, holder: str,
+                            namespace: str | None = None):
+    """``cluster_scan_leader`` factory for RescheduleController: the
+    controller whose probe wins the COORDINATION_SHARD lease pays the
+    cluster LIST; everyone else keeps node-scoped passes. A probe that
+    raises falls back to scanning inside the controller (reschedule.py)
+    — duplicate LISTs cost load, a never-reaped crash window costs
+    correctness."""
+    kwargs = {} if namespace is None else {"namespace": namespace}
+    lease = ShardLease(client, COORDINATION_SHARD, holder, **kwargs)
+    return lambda: acquire_or_confirm(lease)
+
+
+class _CauseState:
+    """Hysteresis memory for one (tenant, kind)."""
+
+    __slots__ = ("onsets", "last_action_ts")
+
+    def __init__(self):
+        self.onsets: list[float] = []   # distinct episode onsets seen
+        self.last_action_ts = 0.0
+
+
+class AutopilotController:
+    """The elected loop. ``verdict_feed()`` returns the current batch
+    of verdict wire dicts (each at least kind/tenant/episode_onset_ts,
+    plus node when the fan-in knows it); ``actions`` maps verdict kind
+    to ``fn(verdict, fence) -> outcome dict`` (actions.default_actions).
+    """
+
+    def __init__(self, client, holder: str, base_dir: str,
+                 verdict_feed, actions: dict,
+                 ttl_s: float = 15.0,
+                 cooldown_s: float = ACTION_COOLDOWN_S,
+                 hysteresis_episodes: int = HYSTERESIS_EPISODES,
+                 lease: ShardLease | None = None,
+                 clock=time.time):
+        self.holder = holder
+        self.verdict_feed = verdict_feed
+        self.actions = actions
+        self.cooldown_s = cooldown_s
+        self.hysteresis_episodes = hysteresis_episodes
+        self.clock = clock
+        self.lease = lease if lease is not None else ShardLease(
+            client, AUTOPILOT_SHARD, holder, ttl_s=ttl_s)
+        self.ledger = ActionLedger(base_dir, clock=clock)
+        self.tenant_bucket = TokenBucket(TENANT_BUCKET_CAPACITY,
+                                         TENANT_BUCKET_REFILL_S, clock)
+        self.node_bucket = TokenBucket(NODE_BUCKET_CAPACITY,
+                                       NODE_BUCKET_REFILL_S, clock)
+        self._causes: dict[tuple[str, str], _CauseState] = {}
+        # counters read by render_autopilot_metrics (one home)
+        self.verdicts_total = 0
+        self.actions_total: dict[str, int] = {}
+        self.suppressed_total: dict[str, int] = {}
+        self.action_failures_total = 0
+
+    # -- leadership ----------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self.lease.held_fresh()
+
+    def _lead(self) -> bool:
+        """Acquire-or-renew; False demotes this tick to a follower.
+        A fresh takeover's first duty is reaping the predecessor's
+        stale migration intents — its lease token outranks theirs."""
+        was_leader = self.lease.held_fresh()
+        leading = acquire_or_confirm(self.lease)
+        if leading and not was_leader:
+            self._on_takeover()
+        return leading
+
+    def _on_takeover(self) -> None:
+        """Hook point (wired by the daemon host to
+        migrate.reap_stale_migrations); a bare controller does nothing.
+        """
+        if getattr(self, "on_takeover", None) is not None:
+            try:
+                self.on_takeover()
+            except Exception as exc:
+                log.warning("autopilot takeover hook failed: %s", exc)
+
+    # -- the loop body -------------------------------------------------------
+
+    def tick(self, now: float | None = None) -> list[dict]:
+        """One pass: elect, consume verdicts, guard, act. Returns the
+        actions taken (empty for followers and all-suppressed passes).
+        """
+        now = self.clock() if now is None else now
+        if not self._lead():
+            return []
+        taken = []
+        for verdict in self.verdict_feed() or []:
+            self.verdicts_total += 1
+            decision = self._consider(verdict, now)
+            if decision is not None:
+                taken.append(decision)
+        return taken
+
+    def _consider(self, verdict: dict, now: float) -> dict | None:
+        tenant = str(verdict.get("tenant", ""))
+        kind = str(verdict.get("kind", ""))
+        node = str(verdict.get("node", ""))
+        state = self._causes.setdefault((tenant, kind), _CauseState())
+        onset = float(verdict.get("episode_onset_ts", 0.0))
+        if onset and onset not in state.onsets:
+            state.onsets.append(onset)
+            del state.onsets[:-_MAX_EPISODES_KEPT]
+        if len(state.onsets) < self.hysteresis_episodes:
+            return self._suppress(SUPPRESS_HYSTERESIS, verdict, now)
+        if now - state.last_action_ts < self.cooldown_s:
+            return self._suppress(SUPPRESS_COOLDOWN, verdict, now)
+        fn = self.actions.get(kind)
+        if fn is None:
+            return self._suppress(SUPPRESS_NO_ACTION, verdict, now)
+        # both-or-neither: require both buckets before consuming either,
+        # so a node-limited verdict doesn't silently drain tenant tokens
+        if not self.tenant_bucket.peek(tenant, now):
+            return self._suppress(SUPPRESS_TENANT_BUCKET, verdict, now)
+        if node and not self.node_bucket.peek(node, now):
+            return self._suppress(SUPPRESS_NODE_BUCKET, verdict, now)
+        self.tenant_bucket.take(tenant, now)
+        if node:
+            self.node_bucket.take(node, now)
+        return self._act(fn, verdict, tenant, kind, state, now)
+
+    def _act(self, fn, verdict: dict, tenant: str, kind: str,
+             state: _CauseState, now: float) -> dict | None:
+        # the fence is read through fence_annotations() — a freshness-
+        # checked read, so a deposed leader cannot stamp a stale token
+        try:
+            fence = next(iter(
+                self.lease.fence_annotations().values()))
+        except LeaseLostError:
+            return None
+        try:
+            failpoints.fire("autopilot.act", tenant=tenant, kind=kind)
+            outcome = fn(verdict, fence)
+        except Exception as exc:    # CrashFailpoint (BaseException) flies
+            log.warning("autopilot action %s for %s failed: %s",
+                        kind, tenant, exc)
+            self.action_failures_total += 1
+            outcome = {"action": kind, "ok": False, "error": str(exc)}
+        # the action landed (or measurably failed): start the cooldown
+        # and demand fresh episodes either way — retrying a failed
+        # remediation every tick is exactly the flap the guards exist
+        # to prevent
+        state.last_action_ts = now
+        state.onsets.clear()
+        self.actions_total[kind] = self.actions_total.get(kind, 0) + 1
+        record = {
+            "kind": "autopilot", "ts": round(now, 3),
+            "holder": self.holder, "fence": fence,
+            "tenant": tenant, "verdict": dict(verdict),
+            "action": outcome,
+        }
+        self.ledger.record(record)
+        self._explain(record)
+        return record
+
+    def _suppress(self, reason: str, verdict: dict,
+                  now: float) -> None:
+        self.suppressed_total[reason] = \
+            self.suppressed_total.get(reason, 0) + 1
+        # suppressions are decisions too — auditable, but only in the
+        # in-memory vtexplain ring (a per-window ledger line per
+        # suppressed verdict would grow the file with steady noise)
+        self._explain({
+            "kind": "autopilot", "ts": round(now, 3),
+            "holder": self.holder,
+            "tenant": str(verdict.get("tenant", "")),
+            "verdict": dict(verdict),
+            "action": {"action": "suppressed", "reason": reason},
+        })
+        return None
+
+    @staticmethod
+    def _explain(record: dict) -> None:
+        from vtpu_manager import explain
+        explain.record_raw(record)
+
+
+def render_autopilot_metrics(controller: "AutopilotController | None",
+                             migrator=None) -> str:
+    """Prometheus text for the autopilot plane; empty when no
+    controller exists (the gate-off contract: zero new series). The ONE
+    home of every vtpu_autopilot_* / vtpu_migration_* literal —
+    migration counts are attributes on the migrator, rendered here."""
+    if controller is None:
+        return ""
+    lines = [
+        "# HELP vtpu_autopilot_leader 1 when this process holds the "
+        "fleet autopilot lease",
+        "# TYPE vtpu_autopilot_leader gauge",
+        f'vtpu_autopilot_leader{{holder="{controller.holder}"}} '
+        f"{1 if controller.is_leader() else 0}",
+        "# HELP vtpu_autopilot_verdicts_total SLO verdicts consumed by "
+        "the leader loop",
+        "# TYPE vtpu_autopilot_verdicts_total counter",
+        f"vtpu_autopilot_verdicts_total {controller.verdicts_total}",
+        "# HELP vtpu_autopilot_actions_total Remediations dispatched, "
+        "by verdict kind",
+        "# TYPE vtpu_autopilot_actions_total counter",
+    ]
+    for kind in sorted(controller.actions_total):
+        lines.append(f'vtpu_autopilot_actions_total{{action="{kind}"}} '
+                     f"{controller.actions_total[kind]}")
+    lines += [
+        "# HELP vtpu_autopilot_suppressed_total Verdicts the guards "
+        "held back (hysteresis, cooldown, rate limits)",
+        "# TYPE vtpu_autopilot_suppressed_total counter",
+    ]
+    for reason in SUPPRESS_REASONS:
+        if reason in controller.suppressed_total:
+            lines.append(
+                f'vtpu_autopilot_suppressed_total{{reason="{reason}"}} '
+                f"{controller.suppressed_total[reason]}")
+    lines += [
+        "# HELP vtpu_autopilot_action_failures_total Dispatched "
+        "remediations that raised",
+        "# TYPE vtpu_autopilot_action_failures_total counter",
+        "vtpu_autopilot_action_failures_total "
+        f"{controller.action_failures_total}",
+    ]
+    if migrator is not None:
+        lines += [
+            "# HELP vtpu_migration_total Live gang migrations "
+            "completed end to end",
+            "# TYPE vtpu_migration_total counter",
+            f"vtpu_migration_total {migrator.migrations_total}",
+            "# HELP vtpu_migration_failures_total Migrations that "
+            "failed or were abandoned mid-flight",
+            "# TYPE vtpu_migration_failures_total counter",
+            "vtpu_migration_failures_total "
+            f"{migrator.migration_failures_total}",
+            "# HELP vtpu_migration_reaped_total Stale migration "
+            "intents unfrozen by a successor or the age-out reaper",
+            "# TYPE vtpu_migration_reaped_total counter",
+            f"vtpu_migration_reaped_total {migrator.reaped_total}",
+            "# HELP vtpu_migration_last_freeze_ms Wall milliseconds "
+            "the last migration held its tenant frozen",
+            "# TYPE vtpu_migration_last_freeze_ms gauge",
+            f"vtpu_migration_last_freeze_ms "
+            f"{migrator.last_freeze_ms:.1f}",
+        ]
+    return "\n".join(lines) + "\n"

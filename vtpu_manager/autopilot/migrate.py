@@ -1,0 +1,332 @@
+"""vtpilot live gang migration: freeze -> drain -> spill -> rebind -> refill.
+
+The primitive rides three existing planes instead of inventing one:
+
+- **freeze** is a v6 config rewrite (``migration_freeze=1``,
+  ``freeze_epoch`` + ``quota_epoch`` bumped) — the SAME benign-race
+  adoption channel quota leases use, so the C++ shim parks dispatch at
+  the token-wait entry within one tick quantum and in-flight Executes
+  drain naturally (enforce.cc FreezePark). The shim's
+  ``VTPU_FREEZE_MAX_S`` fail-open bounds the worst case where every
+  software layer below dies.
+- **spill demotion** goes through the vtovc SpillPool — budget-guarded
+  by its ledger, with the caller-wired per-chip invariant check run
+  before every commit, so a migration can never overdraw the host pool
+  or double-account a chip.
+- **rebind** goes through the normal scheduler bind shape: allocating
+  status + bind-intent + fence annotations in one patch, then the
+  Binding POST — so the reschedule controller's existing reapers
+  understand a migration's crash window without new rules.
+
+Crash model: the fence-stamped migration-intent annotation is written
+BEFORE anything is frozen. A migrator that dies mid-flight (chaos:
+CrashFailpoint at ``migrate.freeze`` / ``migrate.refill``) leaves the
+intent + possibly-frozen configs; :func:`reap_stale_migrations` —
+run by the successor leader and by node reconcile passes — unfreezes
+any tenant whose intent token predates the current ``autopilot`` lease
+incarnation or whose intent aged out. Frozen tenants always unfreeze;
+no pod ends double-owned.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.config.tenantdirs import iter_container_config_paths
+from vtpu_manager.overcommit.spill import SpillBudgetError
+from vtpu_manager.resilience import failpoints, recovery
+from vtpu_manager.scheduler.lease import parse_fence, read_lease_state
+from vtpu_manager.util import consts, stalecodec
+
+log = logging.getLogger(__name__)
+
+# a migration intent older than this is reaped on age alone (the
+# token-aware rule reaps deposed leaders' intents sooner); kept BELOW
+# the shim's 120 s freeze fail-open so software unfreezes first and
+# the shim backstop never normally fires
+MIGRATION_INTENT_TTL_S = 60.0
+
+# drain polling bounds — attempt-bounded (not just wall-bounded) so an
+# injected clock that never advances cannot spin the loop forever
+DRAIN_TIMEOUT_S = 30.0
+DRAIN_POLL_S = 0.05
+
+
+def encode_migration_intent(source: str, target: str, fence: str,
+                            ts: float | None = None) -> str:
+    """``<source>|<target>|<fence>@<wall-seconds>`` — ``|`` because the
+    fence itself carries a ``:``. The SOURCE rides the intent because
+    the rebind step rewrites the pod's nodeName to the target: a reaper
+    arriving after a refill-crash would otherwise resolve "source" to
+    the target node and leave the true source's configs frozen until
+    the shim's fail-open."""
+    return stalecodec.stamp(f"{source}|{target}|{fence}",
+                            ts if ts is not None else time.time())
+
+
+def parse_migration_intent(value: str | None
+                           ) -> tuple[str, str, str, float] | None:
+    """(source, target, fence, ts) or None — malformed reads as absent,
+    the reap-never-off-garbage posture of parse_bind_intent."""
+    split = stalecodec.split_stamp(value)
+    if split is None:
+        return None
+    body, ts = split
+    parts = body.split("|", 2)
+    if len(parts) != 3 or not parts[1]:
+        return None
+    source, target, fence = parts
+    return source, target, fence, ts
+
+
+def set_tenant_freeze(base_dir: str | None, uid: str,
+                      frozen: bool) -> int:
+    """Rewrite every config of ``uid`` under ``base_dir`` with the
+    freeze flag; returns configs touched. Bumps freeze_epoch AND
+    quota_epoch — the latter is the shim's re-read trigger, the former
+    is what its park/release logs name."""
+    if not base_dir:
+        return 0
+    touched = 0
+    for cfg_uid, _label, path, _dra in \
+            iter_container_config_paths(base_dir):
+        if cfg_uid != uid:
+            continue
+        try:
+            cfg = vc.read_config(path)
+        except (OSError, ValueError):
+            continue    # writer's crash window; the next pass retries
+        flag = 1 if frozen else 0
+        if cfg.migration_freeze == flag:
+            continue    # idempotent: a reaper re-run must not bump epochs
+        cfg.migration_freeze = flag
+        cfg.freeze_epoch += 1
+        cfg.quota_epoch += 1
+        vc.write_config(path, cfg)
+        touched += 1
+    return touched
+
+
+class GangMigrator:
+    """One migration at a time, fence-stamped, intent-trail protected.
+
+    ``base_dir_for_node(node)`` resolves tenant config dirs;
+    ``spill_pool_for_node(node)`` / ``resident_buffers(pod, node)`` /
+    ``invariant_check()`` wire the vtovc demotion step (all optional —
+    a gang with nothing resident migrates without touching the pool);
+    ``drain_check(pod)`` reports whether in-flight Executes finished
+    (None = trust the shim's natural drain)."""
+
+    def __init__(self, client, base_dir_for_node, clock=time.time,
+                 spill_pool_for_node=None, resident_buffers=None,
+                 invariant_check=None, drain_check=None,
+                 drain_timeout_s: float = DRAIN_TIMEOUT_S,
+                 drain_poll_s: float = DRAIN_POLL_S, sleep=time.sleep):
+        self.client = client
+        self.base_dir_for_node = base_dir_for_node
+        self.clock = clock
+        self.spill_pool_for_node = spill_pool_for_node
+        self.resident_buffers = resident_buffers
+        self.invariant_check = invariant_check
+        self.drain_check = drain_check
+        self.drain_timeout_s = drain_timeout_s
+        self.drain_poll_s = drain_poll_s
+        self.sleep = sleep
+        # counters rendered by controller.render_autopilot_metrics
+        self.migrations_total = 0
+        self.migration_failures_total = 0
+        self.reaped_total = 0
+        self.last_freeze_ms = 0.0
+
+    # -- the timeline --------------------------------------------------------
+
+    def migrate(self, pod: dict, target: str, fence: str) -> dict:
+        meta = pod.get("metadata", {})
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        uid = meta.get("uid", "")
+        source = pod.get("spec", {}).get("nodeName") or \
+            (meta.get("annotations", {}) or {}).get(
+                consts.predicate_node_annotation(), "")
+        t0 = self.clock()
+        # (1) the crash trail lands before anything freezes: from here
+        # on, a dead migrator is a reapable record, not a stuck tenant
+        self.client.patch_pod_annotations(ns, name, {
+            consts.migration_intent_annotation():
+                encode_migration_intent(source, target, fence, t0)})
+        freeze_t = 0.0
+        try:
+            # (2) freeze: the shim parks at token-wait entry next quantum
+            failpoints.fire("migrate.freeze", pod=name, node=source)
+            frozen = set_tenant_freeze(
+                self.base_dir_for_node(source), uid, True)
+            freeze_t = self.clock()
+            # (3) drain in-flight Executes
+            drained = self._drain(pod)
+            # (4) demote resident HBM to the host spill tier
+            demoted = self._demote(pod, source)
+            # (5) rebind through the normal path: the same one-patch
+            # commit a scheduler bind makes, then the Binding POST
+            self.client.patch_pod_annotations(ns, name, {
+                consts.allocation_status_annotation():
+                    consts.ALLOC_STATUS_ALLOCATING,
+                consts.bind_intent_annotation():
+                    recovery.encode_bind_intent(target, self.clock()),
+                consts.shard_fence_annotation(): fence,
+                consts.predicate_node_annotation(): target,
+            })
+            self.client.bind_pod(ns, name, target)
+            # (6) refill: unfreeze so the target's shim admits dispatch;
+            # the source unfreezes too (its shim drains out, and a
+            # frozen orphan config must never outlive the migration)
+            failpoints.fire("migrate.refill", pod=name, node=target)
+            set_tenant_freeze(self.base_dir_for_node(source), uid,
+                              False)
+            set_tenant_freeze(self.base_dir_for_node(target), uid,
+                              False)
+            # (7) close the trail
+            self.client.patch_pod_annotations(ns, name, {
+                consts.migration_intent_annotation(): None,
+                consts.allocation_status_annotation():
+                    consts.ALLOC_STATUS_SUCCEED,
+            })
+        except Exception as exc:
+            # a FAILED migration (not a crashed one — CrashFailpoint is
+            # a BaseException and flies past this) rolls back in-place:
+            # unfreeze the source and close the trail, leaving the gang
+            # where it was
+            log.warning("migration of %s/%s to %s failed: %s; "
+                        "unfreezing in place", ns, name, target, exc)
+            self.migration_failures_total += 1
+            self._abandon(ns, name, source, uid)
+            return {"ok": False, "error": str(exc), "pod": name,
+                    "source": source, "target": target}
+        self.migrations_total += 1
+        if freeze_t:
+            self.last_freeze_ms = max(self.clock() - freeze_t, 0.0) \
+                * 1000.0
+        return {"ok": True, "pod": name, "source": source,
+                "target": target, "configs_frozen": frozen,
+                "drained": drained, "spilled": demoted,
+                "freeze_ms": round(self.last_freeze_ms, 1),
+                "total_ms": round((self.clock() - t0) * 1000.0, 1)}
+
+    def _abandon(self, ns: str, name: str, source: str,
+                 uid: str) -> None:
+        try:
+            set_tenant_freeze(self.base_dir_for_node(source), uid,
+                              False)
+            self.client.patch_pod_annotations(ns, name, {
+                consts.migration_intent_annotation(): None})
+        except Exception as exc:
+            # rollback itself failed (node gone, apiserver down): the
+            # intent is still on the pod, so the reaper finishes this
+            log.warning("migration rollback for %s/%s incomplete (%s); "
+                        "leaving the intent trail for the reaper",
+                        ns, name, exc)
+
+    def _drain(self, pod: dict) -> bool:
+        if self.drain_check is None:
+            return True
+        deadline = self.clock() + self.drain_timeout_s
+        attempts = max(int(self.drain_timeout_s / self.drain_poll_s), 1)
+        for _ in range(attempts):
+            if self.drain_check(pod):
+                return True
+            if self.clock() >= deadline:
+                break
+            self.sleep(self.drain_poll_s)
+        return False    # proceed anyway: the freeze holds new dispatch
+
+    def _demote(self, pod: dict, source: str) -> dict:
+        if self.spill_pool_for_node is None or \
+                self.resident_buffers is None:
+            return {"buffers": 0, "bytes": 0}
+        pool = self.spill_pool_for_node(source)
+        if pool is None:
+            return {"buffers": 0, "bytes": 0}
+        buffers = 0
+        total = 0
+        for host_index, buf_id, payload in \
+                self.resident_buffers(pod, source):
+            # per-chip + budget invariants re-proved before EVERY
+            # commit — a demotion must never be the write that breaks
+            # the node's accounting
+            if self.invariant_check is not None:
+                self.invariant_check()
+            try:
+                pool.spill(host_index, buf_id, payload)
+            except SpillBudgetError:
+                # budget exhausted: stop demoting — what stays resident
+                # just migrates as a cold refill later
+                break
+            buffers += 1
+            total += len(payload)
+        return {"buffers": buffers, "bytes": total}
+
+
+# -- the convergence half -----------------------------------------------------
+
+def reap_stale_migrations(client, base_dir_for_node,
+                          now: float | None = None,
+                          intent_ttl_s: float = MIGRATION_INTENT_TTL_S,
+                          lease_probe=None,
+                          migrator: GangMigrator | None = None
+                          ) -> list[str]:
+    """Unfreeze tenants whose migration intent is provably dead; the
+    successor leader's first duty and part of every node reconcile.
+
+    Two independent staleness rules, either suffices:
+
+    - **token**: the intent's fence token predates the live
+      ``autopilot`` lease incarnation — its stamping leader is deposed,
+      so whatever it was mid-way through will never finish;
+    - **age**: the intent outlived MIGRATION_INTENT_TTL_S — covers the
+      no-lease and lease-unreadable shapes by wall clock alone.
+
+    An intent stamped by the CURRENT incarnation and inside its TTL is
+    a live migration and is left alone. Returns reaped pod names."""
+    now = time.time() if now is None else now
+    if lease_probe is None:
+        from vtpu_manager.autopilot.controller import AUTOPILOT_SHARD
+        lease_probe = lambda: read_lease_state(client, AUTOPILOT_SHARD)
+    lease = None
+    lease_read = False
+    reaped = []
+    for pod in client.list_pods():
+        meta = pod.get("metadata", {})
+        anns = meta.get("annotations", {}) or {}
+        parsed = parse_migration_intent(
+            anns.get(consts.migration_intent_annotation()))
+        if parsed is None:
+            continue
+        source, target, fence_raw, ts = parsed
+        stale = now - ts > intent_ttl_s
+        if not stale:
+            pf = parse_fence(fence_raw)
+            if pf is not None:
+                if not lease_read:
+                    lease = lease_probe()
+                    lease_read = True
+                if lease is not None and lease.token > pf[1]:
+                    stale = True
+        if not stale:
+            continue
+        uid = meta.get("uid", "")
+        # unfreeze wherever the dead migration may have left the flag:
+        # the intent's source (NOT the pod's nodeName — a refill-crash
+        # happens after the rebind already points that at the target),
+        # the intended target, and wherever the pod sits now
+        landed = pod.get("spec", {}).get("nodeName") or \
+            anns.get(consts.predicate_node_annotation(), "")
+        for node in {source, target, landed} - {""}:
+            set_tenant_freeze(base_dir_for_node(node), uid, False)
+        client.patch_pod_annotations(
+            meta.get("namespace", "default"), meta.get("name", ""),
+            {consts.migration_intent_annotation(): None})
+        if migrator is not None:
+            migrator.reaped_total += 1
+        reaped.append(meta.get("name", ""))
+    return reaped

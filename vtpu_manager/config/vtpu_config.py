@@ -48,7 +48,16 @@ MAGIC = 0x55505456          # "VTPU" little-endian
 # consume; the shim shapes it with a dedicated token bucket alongside
 # the core-% one) plus explicit trailing pad to keep 8-byte alignment.
 # 0 = unshaped, the v4 semantics byte-for-byte; gate off writes 0.
-VERSION = 5
+# v6 (vtpilot, live gang migration): header grew migration_freeze (i32
+# bool — the autopilot's per-container freeze request; the shim parks
+# dispatch at the token-wait entry and drains in-flight Executes while
+# it is set, with a bounded fail-open so a dead controller can never
+# park a tenant forever) + freeze_epoch (u32, bumped on every freeze/
+# unfreeze transition so the shim's epoch-adoption channel — the same
+# quota_epoch re-read loop — picks the flag up within one throttle
+# quantum). Gate off writes zeros in both — the v5 semantics
+# byte-for-byte; device layout unchanged.
+VERSION = 6
 MAX_DEVICE_COUNT = 64
 UUID_LEN = 64
 NAME_LEN = 64
@@ -80,10 +89,11 @@ assert DEVICE_SIZE == 144
 
 # vtpu_config_t header: magic u32, version u32, pod_uid[48], pod_name[64],
 # pod_namespace[64], container_name[64], device_count i32, compat_mode i32,
-# compile_cache_dir[64], workload_class i32, quota_epoch u32
-_HEADER_FMT = "<II48s64s64s64sii64siI"
+# compile_cache_dir[64], workload_class i32, quota_epoch u32,
+# migration_freeze i32, freeze_epoch u32 (v6, vtpilot)
+_HEADER_FMT = "<II48s64s64s64sii64siIiI"
 HEADER_SIZE = struct.calcsize(_HEADER_FMT)
-assert HEADER_SIZE == 328
+assert HEADER_SIZE == 336
 
 _FOOTER_FMT = "<II"        # checksum u32, pad u32
 CONFIG_SIZE = HEADER_SIZE + MAX_DEVICE_COUNT * DEVICE_SIZE + \
@@ -184,6 +194,15 @@ class VtpuConfig:
     # grant/revoke it writes into this config; the shim re-reads the
     # file when the on-disk epoch differs from the one it loaded.
     quota_epoch: int = 0
+    # vtpilot (v6; both 0 when SLOAutopilot is off = v5 semantics):
+    # the autopilot's freeze request. Non-zero migration_freeze parks
+    # the shim's dispatch at the token-wait entry and drains in-flight
+    # Executes (bounded fail-open — a dead controller never parks a
+    # tenant forever); freeze_epoch bumps on every freeze/unfreeze
+    # transition and rides the quota_epoch adoption channel, so the
+    # flag reaches a parked shim within one throttle quantum.
+    migration_freeze: int = 0
+    freeze_epoch: int = 0
     devices: list[DeviceConfig] = field(default_factory=list)
 
     def pack(self) -> bytes:
@@ -197,7 +216,8 @@ class VtpuConfig:
             _cstr(self.container_name, NAME_LEN),
             len(self.devices), self.compat_mode,
             _cstr(self.compile_cache_dir, CACHE_DIR_LEN),
-            self.workload_class, self.quota_epoch & 0xFFFFFFFF)
+            self.workload_class, self.quota_epoch & 0xFFFFFFFF,
+            self.migration_freeze, self.freeze_epoch & 0xFFFFFFFF)
         for dev in self.devices:
             body += dev.pack()
         body += b"\0" * (DEVICE_SIZE * (MAX_DEVICE_COUNT - len(self.devices)))
@@ -219,8 +239,8 @@ class VtpuConfig:
         if _fnv1a(raw[: CONFIG_SIZE - 8]) != checksum:
             raise ValueError("config checksum mismatch (torn write?)")
         (magic, version, pod_uid, pod_name, pod_ns, cont_name, count,
-         compat, cache_dir, wl_class,
-         quota_epoch) = struct.unpack_from(_HEADER_FMT, raw, 0)
+         compat, cache_dir, wl_class, quota_epoch, migration_freeze,
+         freeze_epoch) = struct.unpack_from(_HEADER_FMT, raw, 0)
         if magic != MAGIC:
             raise ValueError(f"bad magic {magic:#x}")
         if version != VERSION:
@@ -234,7 +254,9 @@ class VtpuConfig:
                          compat_mode=compat,
                          compile_cache_dir=_from_cstr(cache_dir),
                          workload_class=wl_class,
-                         quota_epoch=quota_epoch)
+                         quota_epoch=quota_epoch,
+                         migration_freeze=migration_freeze,
+                         freeze_epoch=freeze_epoch)
         for i in range(count):
             off = HEADER_SIZE + i * DEVICE_SIZE
             cfg.devices.append(
@@ -271,5 +293,5 @@ HEADER_OFFSETS = {
     "magic": 0, "version": 4, "pod_uid": 8, "pod_name": 56,
     "pod_namespace": 120, "container_name": 184, "device_count": 248,
     "compat_mode": 252, "compile_cache_dir": 256, "workload_class": 320,
-    "quota_epoch": 324,
+    "quota_epoch": 324, "migration_freeze": 328, "freeze_epoch": 332,
 }

@@ -53,7 +53,8 @@ class RescheduleController:
                  resilience: KubeResilience | None = None,
                  intent_ttl_s: float = consts.DEFAULT_STUCK_GRACE_S,
                  registry=None, intent_scan_every: int = 4,
-                 lease_probe=None, clock=time.time):
+                 lease_probe=None, cluster_scan_leader=None,
+                 clock=time.time):
         self.client = client
         self.node_name = node_name
         # vtha: ``lease_probe(shard) -> LeaseState | None`` (typically
@@ -85,6 +86,16 @@ class RescheduleController:
         # O(node) not O(cluster), the original load profile. 1 = scan
         # every pass (the chaos harness does).
         self.intent_scan_every = max(1, intent_scan_every)
+        # vtpilot: ``cluster_scan_leader() -> bool`` elects ONE
+        # controller fleet-wide to pay the cluster LIST (wired to the
+        # autopilot/coordination lease's held_fresh when the
+        # SLOAutopilot gate is on). Non-leaders keep their node-scoped
+        # passes untouched. None (the default) = byte-identical
+        # pre-vtpilot behavior: every controller scans on cadence. A
+        # RAISING probe falls back to scanning — duplicate LISTs cost
+        # apiserver load, a never-reaped crash window costs
+        # correctness.
+        self.cluster_scan_leader = cluster_scan_leader
         self._pass_index = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -100,6 +111,14 @@ class RescheduleController:
         self._pass_index += 1
         cluster_scan = (self._pass_index % self.intent_scan_every) == 1 \
             or self.intent_scan_every == 1
+        if cluster_scan and self.cluster_scan_leader is not None:
+            try:
+                cluster_scan = bool(self.cluster_scan_leader())
+            except Exception as e:
+                # a broken probe must degrade to the pre-vtpilot shape
+                # (everyone scans), never to nobody-reaps
+                log.warning("cluster-scan leader probe failed (%s); "
+                            "scanning anyway", e)
         try:
             if cluster_scan:
                 # the crash-window reaper must see pods COMMITTED to

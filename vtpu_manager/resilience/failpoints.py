@@ -137,6 +137,26 @@ SITES: dict[str, str] = {
                    "publish the annotation's own timestamp ages out — "
                    "the scheduler's link_term decays to no-signal, "
                    "never steers on a ghost's contention claim)",
+    "autopilot.act": "autopilot/controller.py _act, after every guard "
+                     "passed and before the remediation dispatches "
+                     "(error = a failed action that must start the "
+                     "cooldown like a success — retry storms are the "
+                     "flap the guards exist to prevent; crash = leader "
+                     "death mid-decision the successor's election "
+                     "absorbs)",
+    "migrate.freeze": "autopilot/migrate.py migrate, after the intent "
+                      "trail lands and before the tenant's configs "
+                      "freeze (crash = a dead migrator whose intent "
+                      "the token-aware reaper must unfreeze and clear; "
+                      "error = a failed freeze that rolls back in "
+                      "place)",
+    "migrate.refill": "autopilot/migrate.py migrate, after the rebind "
+                      "lands and before the unfreeze rewrites (crash = "
+                      "the worst window — tenant frozen, pod already "
+                      "rebound — the reaper must unfreeze on BOTH "
+                      "source and target so the gang never stays "
+                      "parked; the shim's VTPU_FREEZE_MAX_S fail-open "
+                      "is the last-resort backstop)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

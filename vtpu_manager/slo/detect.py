@@ -85,6 +85,8 @@ class Verdict:
     dominant: str                 # component that grew the most
     dominant_share: float         # its share of the window's step time
     component_delta: dict         # component -> share delta vs baseline
+    episode_onset_ts: float = 0.0  # ts of the window that opened the
+                                   # incident (cause-join anchor)
     cause: dict = field(default_factory=dict)
     summary: str = ""
 
@@ -99,6 +101,7 @@ class Verdict:
             "dominant_share": round(self.dominant_share, 4),
             "component_delta": {k: round(v, 4) for k, v
                                 in self.component_delta.items()},
+            "episode_onset_ts": round(self.episode_onset_ts, 3),
             "cause": dict(self.cause),
             "summary": self.summary,
         }
@@ -108,7 +111,8 @@ class _TenantBaseline:
     """EWMA state for one tenant's window stream."""
 
     __slots__ = ("mean_ewma", "mean_var", "goodput_ewma", "frac_ewma",
-                 "samples", "last_ts", "episode_active")
+                 "samples", "last_ts", "episode_active",
+                 "episode_onset_ts", "episode_end_ts")
 
     def __init__(self) -> None:
         self.mean_ewma = 0.0
@@ -123,6 +127,14 @@ class _TenantBaseline:
         # DIFFERENT kind off the half-adjusted baseline, which is
         # where cross-attribution noise would come from
         self.episode_active = False
+        # episode BOUNDS, for the cause join: onset is the ts of the
+        # window that opened the incident (a one-window clean gap does
+        # not reset it — see EPISODE_REJOIN_S), end is the ts of the
+        # clean window that last closed one. The join anchors at the
+        # onset, so a long-lived episode cannot blame a plane event
+        # that happened mid-episode, after the regression began.
+        self.episode_onset_ts = 0.0
+        self.episode_end_ts = 0.0
 
     def observe(self, w: WindowSample) -> None:
         if self.samples == 0:
@@ -182,12 +194,25 @@ class RegressionDetector:
             self._baselines[tenant] = base = _TenantBaseline()
         verdict = None
         if base.samples >= MIN_BASELINE_WINDOWS and base.mean_ewma > 0:
-            verdict = self._judge(tenant, window, base)
+            # resolve the episode ONSET before judging: the cause join
+            # anchors at the onset, not at the current window — a
+            # verdict re-fired late in a long incident must not blame
+            # a plane event that happened after the incident began
+            rejoin = (base.episode_onset_ts > 0
+                      and base.episode_end_ts > 0
+                      and window.ts - base.episode_end_ts
+                      <= EPISODE_REJOIN_S)
+            onset = (base.episode_onset_ts
+                     if (base.episode_active or rejoin) else window.ts)
+            verdict = self._judge(tenant, window, base, onset)
         if verdict is None:
+            if base.episode_active:
+                base.episode_end_ts = window.ts
             base.episode_active = False     # clean window ends episode
         elif base.episode_active:
             verdict = None                  # mid-episode: one verdict
         else:
+            base.episode_onset_ts = verdict.episode_onset_ts
             base.episode_active = True
         base.observe(window)
         if verdict is not None:
@@ -196,7 +221,8 @@ class RegressionDetector:
         return verdict
 
     def _judge(self, tenant: str, w: WindowSample,
-               base: _TenantBaseline) -> Verdict | None:
+               base: _TenantBaseline,
+               onset: float | None = None) -> Verdict | None:
         sigma = math.sqrt(max(base.mean_var, 0.0))
         envelope = base.mean_ewma + SIGMA_K * sigma
         regressed = (w.step_mean_ns > envelope
@@ -230,27 +256,46 @@ class RegressionDetector:
             dominant=dominant,
             dominant_share=w.component_frac(dominant),
             component_delta=delta,
+            episode_onset_ts=onset if onset else w.ts,
             cause=join_cause(kind, tenant, w,
-                             quota_dir=self.quota_dir, now=w.ts))
+                             quota_dir=self.quota_dir, now=w.ts,
+                             episode_onset=onset))
         verdict.summary = summarize(verdict)
         return verdict
 
 
 # how far back a plane event may be and still "coincide" with the
-# window that regressed (publisher cadences are seconds; two market
-# passes is a generous join window)
+# EPISODE ONSET (publisher cadences are seconds; two market passes is
+# a generous join window). The anchor is the onset, not the verdict's
+# own ts: a long-lived episode re-fires verdicts late, and anchoring
+# at "now" would let those blame an unrelated lease settled AFTER the
+# regression already began.
 CAUSE_JOIN_WINDOW_S = 600.0
+
+# a clean gap no longer than this between two episodes of the same
+# tenant is ONE incident: the re-fired verdict keeps the original
+# onset (matches the staleness budget — silence past it re-seeds the
+# baseline anyway, so a longer memory could never be consulted)
+EPISODE_REJOIN_S = 120.0
 
 
 def join_cause(kind: str, tenant: str, window: WindowSample,
                quota_dir: str | None = None,
-               now: float | None = None) -> dict:
+               now: float | None = None,
+               episode_onset: float | None = None) -> dict:
     """Join the verdict to the responsible plane's own events — the
     difference between "throttle-wait rose" and "coincides with quota
     revoke lease q42-0-3". Every join degrades gracefully: a missing or
     torn plane source yields the plane name with no event, never an
-    error (the verdict is still correct, just less specific)."""
+    error (the verdict is still correct, just less specific).
+
+    ``episode_onset`` anchors the quota join at the detector's episode
+    bounds: only leases settled AT OR BEFORE the onset can be named (a
+    cause precedes its effect), within CAUSE_JOIN_WINDOW_S looking
+    back from the onset. A fresh episode's onset IS the verdict window
+    so the single-episode behavior is unchanged."""
     now = time.time() if now is None else now
+    anchor = episode_onset if episode_onset else now
     cause: dict = {"plane": PLANE_BY_KIND.get(kind, "unknown")}
     if kind == "throttle-spike" and quota_dir:
         try:
@@ -263,7 +308,7 @@ def join_cause(kind: str, tenant: str, window: WindowSample,
                     continue
                 if lease.get("state") == STATE_GRANTED:
                     continue
-                age = now - float(lease.get("updated_at", 0.0))
+                age = anchor - float(lease.get("updated_at", 0.0))
                 if 0 <= age <= CAUSE_JOIN_WINDOW_S:
                     events.append(lease)
             if events:

@@ -96,6 +96,31 @@ def why_slow_offline(base_dir: str, pod_key: str,
                              now, stale=False)
 
 
+def splice_action_trail(doc: dict, actions: list[dict] | None,
+                        limit: int = 5) -> dict:
+    """Attach the autopilot's recent actions for this pod to a verdict.
+
+    Mutates and returns ``doc``. The match rule mirrors the verdict
+    join above (action tenant keys are uid-prefixed, the pod key may be
+    a prefix of the uid or vice versa). Gate-off byte-identical: with
+    no ledger (or no matching record) the document — and therefore
+    :func:`format_verdict` output — is unchanged; no key is added.
+    """
+    key = str(doc.get("pod") or "")
+    if not key or not actions:
+        return doc
+    mine = []
+    for rec in actions:
+        tenant = str(rec.get("tenant") or "")
+        if tenant and (tenant.startswith(key) or key.startswith(tenant)):
+            mine.append(rec)
+    if not mine:
+        return doc
+    mine.sort(key=lambda r: -float(r.get("ts", 0.0)))
+    doc["autopilot_actions"] = mine[:limit]
+    return doc
+
+
 def format_verdict(doc: dict) -> list[str]:
     """Human lines for the CLI (one copy; tests snapshot it)."""
     lines = [f"slo doctor: {doc.get('verdict')} — {doc.get('summary')}"]
@@ -115,11 +140,21 @@ def format_verdict(doc: dict) -> list[str]:
     extra = len(doc.get("regressions") or []) - 5
     if extra > 0:
         lines.append(f"  (+{extra} earlier regression(s))")
+    for rec in doc.get("autopilot_actions") or []:
+        act = rec.get("action") or {}
+        name = act.get("action", "?")
+        if name == "suppressed":
+            what = f"suppressed ({act.get('reason')})"
+        elif act.get("ok", True):
+            what = f"{name} ok"
+        else:
+            what = f"{name} FAILED: {act.get('error')}"
+        lines.append(f"  autopilot: {what}  fence {rec.get('fence')}")
     return lines
 
 
 __all__ = ["why_slow_from_document", "why_slow_offline",
-           "format_verdict"]
+           "format_verdict", "splice_action_trail"]
 
 # re-export for callers that want the staleness constant next to the
 # verdicts it governs

@@ -146,6 +146,17 @@ def bind_intent_annotation() -> str:
     return _ann("bind-intent")
 
 
+def migration_intent_annotation() -> str:
+    """vtpilot crash trail for the live-migration window:
+    ``<source>|<target>|<fence>@<wall-seconds>`` stamped on the pod BEFORE
+    the tenant is frozen, so an autopilot crash mid-migration leaves a
+    dated, fence-stamped record. A successor leader (whose lease carries
+    a higher fencing token) or the age-out reaper unfreezes the tenant
+    and clears the trail (autopilot/migrate.py) — the shim's
+    VTPU_FREEZE_MAX_S fail-open is only the backstop behind this."""
+    return _ann("migration-intent")
+
+
 def shard_fence_annotation() -> str:
     """vtha fencing stamp ``<shard>:<token>`` written by an HA scheduler
     in the SAME patch as the pre-allocation (filter commit) and the

@@ -36,6 +36,7 @@ HBM_OVERCOMMIT = "HBMOvercommit"        # vtovc virtual HBM + host-spill tier
 ICI_LINK_AWARE = "ICILinkAware"         # vtici link-contention-aware placement
 COMM_TELEMETRY = "CommTelemetry"        # vtcomm measured communication plane
 SLO_ATTRIBUTION = "SLOAttribution"      # vtslo goodput + step-time attribution
+SLO_AUTOPILOT = "SLOAutopilot"          # vtpilot elected remediation controller
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -198,6 +199,32 @@ _KNOWN = {
     # collective counts, compile flags) so "why is my job slow" has ONE
     # answer instead of five metric families.
     SLO_ATTRIBUTION: False,
+    # Default off: byte-identical — no autopilot lease is created or
+    # read, no controller loop runs, no action is ever taken (placement
+    # stays untouched in BOTH scheduler modes), no action ledger exists
+    # under the base dir, no vtpu_autopilot_*/vtpu_migration_* series
+    # render, the monitor registers no /autopilot route, configs carry
+    # migration_freeze=0/freeze_epoch=0 (the v5 wire bytes), and
+    # vtpu-smi / --why-slow output is byte-identical. On, an ELECTED
+    # node daemon (one `autopilot` lease fleet-wide, vtha machinery,
+    # monotone fencing token stamped on every action) consumes vtslo
+    # regression verdicts and maps each named cause to a bounded,
+    # audited remediation through existing planes: comm-inflation ->
+    # re-place the gang on a quieter submesh (vtici worst-link scoring
+    # picks the target), spill-thrash -> shrink the node's overcommit
+    # ratio one step and/or migrate the thrashing tenant, throttle-
+    # spike -> retune quota leases via the scaled_grant_step rule.
+    # Every action is rate-limited (token buckets per tenant AND per
+    # node), hysteresis-guarded (a verdict must persist >= 2 detector
+    # episodes; no action within N windows of the last), and recorded
+    # as a vtexplain kind=autopilot decision plus an on-disk action
+    # ledger. The live-migration primitive (autopilot/migrate.py)
+    # rides a v6 config freeze flag: the shim parks dispatch at the
+    # token-wait entry and drains in-flight Executes, the vtovc tier
+    # demotes resident buffers to the host pool (budget-guarded), the
+    # pod rebinds through the normal fence-stamped bind path, and the
+    # target refills on first touch.
+    SLO_AUTOPILOT: False,
 }
 
 

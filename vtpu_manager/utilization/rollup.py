@@ -45,7 +45,8 @@ class ClusterRollup:
                  overcommit: bool = False,
                  cluster_cache: bool = False,
                  comm: bool = False,
-                 slo_ledger=None):
+                 slo_ledger=None,
+                 action_ledger=None):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
@@ -69,6 +70,11 @@ class ClusterRollup:
         # the collector's SloLedger (already folded on the scrape
         # path; this fold only tops up since the last one)
         self.slo_ledger = slo_ledger
+        # vtpilot (SLOAutopilot gate): None = the document carries no
+        # autopilot block at all — byte-identical /utilization (the
+        # vtqm pattern). Set, it is the controller's on-disk
+        # ActionLedger; the block summarizes the last hour's actions.
+        self.action_ledger = action_ledger
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -519,6 +525,28 @@ class ClusterRollup:
             doc["quota"] = quota
         if slo_fleet is not None:
             doc["slo"] = slo_fleet
+        if self.action_ledger is not None:
+            # vtpilot fleet headline (gate off = no key at all): what
+            # the autopilot did in the last hour, by action, plus the
+            # most recent action so vtpu-smi's one-liner needs no
+            # second fetch
+            try:
+                recent = self.action_ledger.actions(since=now - 3600.0)
+            except Exception as e:  # noqa: BLE001 — a torn ledger read
+                # degrades to an empty trail, never a failed rollup
+                log.warning("autopilot ledger read failed: %s", e)
+                fold_errors.append(f"autopilot_ledger: {e}")
+                recent = []
+            by_action: dict[str, int] = {}
+            for rec in recent:
+                name = str((rec.get("action") or {}).get("action",
+                                                         "unknown"))
+                by_action[name] = by_action.get(name, 0) + 1
+            doc["autopilot"] = {
+                "actions_last_hour": len(recent),
+                "by_action": by_action,
+                "last_action": recent[-1] if recent else None,
+            }
         if self.overcommit:
             # vtcomm-PR vtovc satellite (ROADMAP vtovc item (a)): the
             # fleet-level overcommit policy view — which classes
