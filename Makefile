@@ -158,8 +158,20 @@ bench-autopilot: ## vtpilot headline bench: PR-15's four injected causes re-run 
 test-abi-san: ## ABI probe suite rebuilt with ASan+UBSan (skips clean when g++/libasan absent)
 	VTPU_ABI_SAN=1 $(PYTEST) tests/test_config_abi.py -q
 
+.PHONY: test-scale
+test-scale: ## vtscale suite: fence epoch codec, plan object, bind waves, rolling reshard, cross-shard spill, webhook HA, gate-off byte-contracts
+	$(PYTEST) tests/test_scale.py -q
+
+.PHONY: bench-scale
+bench-scale: ## vtscale headline bench: 50k nodes/100k pods, pipelined binds >=5x serial over a simulated RTT, placement parity, rolling-reshard chaos (asserted; writes BENCH_VTSCALE_r18.json). bench-scale-quick is the CI smoke.
+	python scripts/bench_scale.py
+
+.PHONY: bench-scale-quick
+bench-scale-quick: ## vtscale bench at smoke scale (no artifact written)
+	python scripts/bench_scale.py --quick
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo test-autopilot test-abi-san bench-overcommit bench-clustercache bench-ici bench-comm bench-slo bench-autopilot ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench, vtpilot autopilot suite + bench, sanitized ABI probes
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo test-autopilot test-scale test-abi-san bench-overcommit bench-clustercache bench-ici bench-comm bench-slo bench-autopilot bench-scale-quick ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench, vtpilot autopilot suite + bench, vtscale suite + smoke bench, sanitized ABI probes
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -578,6 +578,12 @@ def main(argv: list[str] | None = None) -> int:
     controller = None
     if gates.enabled(RESCHEDULE):
         from vtpu_manager.scheduler.lease import read_lease_state
+        from vtpu_manager.scheduler.plan import read_plan
+
+        def plan_epoch_probe() -> int:
+            state = read_plan(client, namespace=args.lease_namespace)
+            return state.epoch if state is not None else 0
+
         # vtpilot: one controller fleet-wide wins the coordination
         # lease and pays the cluster-scan LIST; the rest stay
         # node-scoped. Gate off = probe None = everyone scans on
@@ -598,7 +604,12 @@ def main(argv: list[str] | None = None) -> int:
             # unstamped intents (HA off) never trigger the probe
             lease_probe=lambda shard: read_lease_state(
                 client, shard, namespace=args.lease_namespace),
-            cluster_scan_leader=scan_probe)
+            cluster_scan_leader=scan_probe,
+            # vtscale: intents stamped with a plan epoch older than the
+            # published plan's are reaped immediately — their partition
+            # was superseded by a rolling reshard. Unstamped intents
+            # (epoch 0, gate off) never trigger the probe.
+            plan_probe=plan_epoch_probe)
         controller.start()
 
     # vtpilot node-side reaper: a dead migrator's fence-stamped intent

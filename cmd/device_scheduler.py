@@ -64,6 +64,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--scheduler-id", default="",
                         help="holder identity on shard leases (default: "
                              "<hostname>-<pid>, unique per incarnation)")
+    parser.add_argument("--bind-wave-max", type=int, default=32,
+                        help="ScalePipeline gate: max pods coalesced "
+                             "into one bind-commit wave (one lease CAS "
+                             "amortized across the wave)")
+    parser.add_argument("--bind-wave-wait-ms", type=float, default=2.0,
+                        help="ScalePipeline gate: how long a wave "
+                             "leader waits for the wave to fill before "
+                             "committing what it has")
+    parser.add_argument("--bind-wave-workers", type=int, default=8,
+                        help="ScalePipeline gate: threads issuing the "
+                             "per-pod patch/Binding calls of a wave")
     parser.add_argument("--trace-sampling-rate", type=float, default=1.0,
                         help="fraction of traced pods whose scheduler "
                              "spans are recorded (Tracing gate)")
@@ -97,6 +108,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 HBM_OVERCOMMIT,
                                                 ICI_LINK_AWARE,
                                                 QUOTA_MARKET,
+                                                SCALE_PIPELINE,
                                                 SCHEDULER_HA,
                                                 SCHEDULER_SNAPSHOT,
                                                 SERIAL_BIND_NODE,
@@ -185,6 +197,16 @@ def main(argv: list[str] | None = None) -> int:
     preempt_kwargs = dict(
         victim_order_hint=gates.enabled(DECISION_EXPLAIN))
 
+    # vtscale (default off = byte-identical): wave-batched bind commits,
+    # the published dynamic shard plan (HA branch), cross-shard gang
+    # spill. The wave knobs ride one dict so both branches and the
+    # bench harness assemble pipelines identically.
+    scale_on = gates.enabled(SCALE_PIPELINE)
+    pipeline_kwargs = dict(
+        max_wave=args.bind_wave_max,
+        max_wait_s=args.bind_wave_wait_ms / 1000.0,
+        workers=args.bind_wave_workers)
+
     if gates.enabled(SCHEDULER_HA):
         # vtha (default off): N replicas run active-active over a
         # node-pool shard plan — each leads the shards whose lease it
@@ -196,6 +218,18 @@ def main(argv: list[str] | None = None) -> int:
                                                   ShardedScheduler)
         holder = args.scheduler_id or \
             f"{socket.gethostname()}-{os.getpid()}"
+        plan_epoch = 0
+        if scale_on:
+            # vtscale dynamic plans: publish this replica's --shard-pools
+            # as the cluster's plan (idempotent — same spec never bumps
+            # the epoch, so a rolling fleet restart is a no-op; a CHANGED
+            # spec bumps it and every replica reshards rolling on its
+            # next tick, old-epoch commits fence-rejected)
+            from vtpu_manager.scheduler.plan import publish_plan
+            state = publish_plan(client, args.shard_pools, holder,
+                                 namespace=args.lease_namespace)
+            if state is not None:
+                plan_epoch = state.epoch
         sharded = ShardedScheduler(
             client, ShardPlan.parse(args.shard_pools), holder,
             lease_ttl_s=args.lease_ttl,
@@ -203,7 +237,10 @@ def main(argv: list[str] | None = None) -> int:
             use_snapshot=gates.enabled(SCHEDULER_SNAPSHOT),
             filter_kwargs=filter_kwargs,
             preempt_kwargs=preempt_kwargs,
-            bind_locker=SerialLocker(gates.enabled(SERIAL_BIND_NODE)))
+            bind_locker=SerialLocker(gates.enabled(SERIAL_BIND_NODE)),
+            scale_pipeline=scale_on,
+            pipeline_kwargs=pipeline_kwargs,
+            plan_spec=args.shard_pools, plan_epoch=plan_epoch)
         sharded.start(snapshot_poll_s=args.snapshot_poll_ms / 1000.0)
         api = SchedulerAPI(sharded, sharded, sharded,
                            debug_endpoints=args.debug_endpoints,
@@ -221,16 +258,24 @@ def main(argv: list[str] | None = None) -> int:
             snapshot.start_background(poll_s=args.snapshot_poll_ms / 1000.0)
 
         bind_locker = SerialLocker(gates.enabled(SERIAL_BIND_NODE))
+        bind_pred = BindPredicate(client, locker=bind_locker)
+        pipeline = None
+        if scale_on:
+            # no fence in single-scheduler mode — stage B is skipped and
+            # the wave is pure round-trip pipelining
+            from vtpu_manager.scheduler.bindpipe import BindCommitPipeline
+            pipeline = BindCommitPipeline(bind_pred, **pipeline_kwargs)
         api = SchedulerAPI(
             # SerialFilterNode (default on, matching FilterPredicate's own
             # default): --feature-gates=SerialFilterNode=false trades the
             # double-booking defense for raw filter throughput (the assumed
             # cache still covers committed placements)
             FilterPredicate(client, snapshot=snapshot, **filter_kwargs),
-            BindPredicate(client, locker=bind_locker),
+            pipeline if pipeline is not None else bind_pred,
             PreemptPredicate(client, snapshot=snapshot, **preempt_kwargs),
             debug_endpoints=args.debug_endpoints,
-            snapshot=snapshot, explain_dir=explain_dir,
+            snapshot=snapshot, pipeline=pipeline,
+            explain_dir=explain_dir,
             explain_token_file=args.explain_token_file)
 
     from vtpu_manager.util.tlsreload import serving_context

@@ -32,6 +32,16 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--trace-spool-dir", default=None,
                         help="vtrace span spool directory (default: the "
                              "shared node trace dir)")
+    parser.add_argument("--lease-ttl", type=float, default=15.0,
+                        help="WebhookHA gate: active-mutator lease TTL "
+                             "seconds (renew cadence TTL/3; a dead "
+                             "active is succeeded within one TTL)")
+    parser.add_argument("--lease-namespace", default="vtpu-system",
+                        help="namespace holding the webhook "
+                             "coordination Lease")
+    parser.add_argument("--webhook-id", default="",
+                        help="holder identity on the webhook lease "
+                             "(default: <hostname>-<pid>)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -45,6 +55,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 HBM_OVERCOMMIT,
                                                 ICI_LINK_AWARE,
                                                 QUOTA_MARKET, TRACING,
+                                                WEBHOOK_HA,
                                                 FeatureGates)
     from vtpu_manager.webhook.server import WebhookAPI, run_server
 
@@ -76,6 +87,48 @@ def main(argv: list[str] | None = None) -> int:
             "no API server access; DRA claim-sharing validation and "
             "claim-template creation are disabled")
 
+    ha_lease = None
+    if gates.enabled(WEBHOOK_HA):
+        # vtscale webhook HA: one replica wins the webhook coordination
+        # lease (its own object name — never colliding with a scheduler
+        # shard lease) and is the sole active mutator; the rest serve
+        # validates and report unready. The ticker below is the only
+        # lease I/O — handlers read held_fresh() locally.
+        if client is None:
+            logging.getLogger(__name__).error(
+                "WebhookHA needs API server access for the coordination "
+                "lease; running single-active semantics is impossible "
+                "without it — gate ignored")
+        else:
+            import socket
+            import threading
+            import time as time_mod
+            from vtpu_manager.scheduler.lease import (LeaseLostError,
+                                                      ShardLease)
+            holder = args.webhook_id or \
+                f"{socket.gethostname()}-{os.getpid()}"
+            ha_lease = ShardLease(client, "webhook", holder,
+                                  ttl_s=args.lease_ttl,
+                                  namespace=args.lease_namespace,
+                                  object_name="vtpu-webhook-active")
+
+            def ha_tick():
+                while True:
+                    try:
+                        if ha_lease.held:
+                            ha_lease.renew()
+                        else:
+                            ha_lease.try_acquire()
+                    except LeaseLostError:
+                        pass        # standby again; retry next tick
+                    except Exception as e:
+                        logging.getLogger(__name__).warning(
+                            "webhook lease tick failed: %s", e)
+                    time_mod.sleep(args.lease_ttl / 3.0)
+
+            threading.Thread(target=ha_tick, daemon=True,
+                             name="vtpu-webhook-lease").start()
+
     api = WebhookAPI(scheduler_name=args.scheduler_name,
                      dra_convert=args.dra_convert, client=client,
                      # vtcc/vtcs: mirror the tenant-declared program
@@ -99,7 +152,8 @@ def main(argv: list[str] | None = None) -> int:
                      # vtici: normalize the declared ICI link share
                      # into the one annotation the plugin's v5 config
                      # stamping reads (gate off = no new patches)
-                     stamp_ici_link_pct=gates.enabled(ICI_LINK_AWARE))
+                     stamp_ici_link_pct=gates.enabled(ICI_LINK_AWARE),
+                     ha_lease=ha_lease)
     logging.getLogger(__name__).info("vtpu-webhook on %s:%d", args.host,
                                      args.port)
     run_server(api, host=args.host, port=args.port, ssl_context=ssl_ctx)

@@ -1,0 +1,488 @@
+#!/usr/bin/env python3
+"""vtscale control-plane bench: 50k nodes / 100k pods on the fake clientset.
+
+Four legs, every number measured through the REAL predicates:
+
+1. **pods/s curve** — the PR 3 filter-throughput curve extended one
+   order of magnitude up the node axis (5k -> 50k nodes), both data
+   paths. Rates are sustained (whole-run) figures; each point drives a
+   pod count bounded to keep the single-core run short — the full
+   100k-pod drive is leg 2's commit phase.
+2. **bind throughput** — the headline. A LatencyClient charges every
+   apiserver round-trip a simulated RTT; the serial path pays
+   GET + intent-patch + lease-confirm + Binding per pod, the
+   ScalePipeline wave amortizes the confirm and overlaps the rest.
+   Sustained pods/s measured both ways at 50k nodes with 100k
+   committed pods; asserted >= 5x.
+3. **placement parity replay** — the same pod stream replayed under
+   TTL vs snapshot and gate-off (serial) vs gate-on (pipelined):
+   byte-identical placements, every Binding exactly on its committed
+   node. The pipeline may only change throughput, never placement.
+4. **rolling reshard chaos** — gate-on ShardedScheduler committing a
+   pod stream while ``--shard-pools`` changes mid-stream (epoch bump,
+   rolling adoption) with bind.batch crash/error faults armed, across
+   seeds. The PR 4 reapers converge every torn wave: zero dropped,
+   zero duplicated placements, fences stamped with the live epoch.
+
+Writes BENCH_VTSCALE_r18.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from vtpu_manager.client.fake import FakeKubeClient        # noqa: E402
+from vtpu_manager.device import types as dt                # noqa: E402
+from vtpu_manager.resilience import failpoints             # noqa: E402
+from vtpu_manager.scheduler import plan as plan_mod        # noqa: E402
+from vtpu_manager.scheduler.bind import BindPredicate      # noqa: E402
+from vtpu_manager.scheduler.bindpipe import (              # noqa: E402
+    BindCommitPipeline)
+from vtpu_manager.scheduler.filter import FilterPredicate  # noqa: E402
+from vtpu_manager.scheduler.lease import ShardLease        # noqa: E402
+from vtpu_manager.scheduler.serial import SerialLocker     # noqa: E402
+from vtpu_manager.scheduler.shard import (                 # noqa: E402
+    ShardPlan, ShardedScheduler)
+from vtpu_manager.scheduler.snapshot import (              # noqa: E402
+    ClusterSnapshot)
+from vtpu_manager.util import consts                       # noqa: E402
+
+NS = "vtpu-system"
+RTT_S = 0.0005           # simulated apiserver round-trip (0.5 ms)
+
+
+class LatencyClient(FakeKubeClient):
+    """FakeKubeClient that charges a fixed RTT per apiserver call on the
+    bind-path methods. This is what makes the pipeline comparison
+    honest: in-process dict ops are ~free, so without a simulated wire
+    the serial path would look as fast as the batched one."""
+
+    rtt_s = RTT_S
+
+    def _rtt(self):
+        time.sleep(self.rtt_s)
+
+    def get_pod(self, namespace, name):
+        self._rtt()
+        return super().get_pod(namespace, name)
+
+    def patch_pod_annotations(self, namespace, name, annotations):
+        self._rtt()
+        return super().patch_pod_annotations(namespace, name,
+                                             annotations)
+
+    def bind_pod(self, namespace, name, node):
+        self._rtt()
+        return super().bind_pod(namespace, name, node)
+
+    def get_lease(self, namespace, name):
+        self._rtt()
+        return super().get_lease(namespace, name)
+
+    def update_lease(self, namespace, name, annotations, version):
+        self._rtt()
+        return super().update_lease(namespace, name, annotations,
+                                    version)
+
+
+def build_cluster(client, n_nodes, chips=4, pools=()):
+    for i in range(n_nodes):
+        reg = dt.fake_registry(chips, mesh_shape=(2, chips // 2),
+                               uuid_prefix=f"TPU-N{i:05d}")
+        node = dt.fake_node(f"node-{i:05d}", reg)
+        if pools:
+            node["metadata"].setdefault("labels", {})[
+                consts.node_pool_label()] = pools[i % len(pools)]
+        client.add_node(node)
+
+
+def vtpu_pod(i, policy="binpack"):
+    return {
+        "metadata": {"name": f"pod-{i:06d}", "namespace": "default",
+                     "uid": f"uid-{i:06d}",
+                     "annotations": {
+                         consts.node_policy_annotation(): policy}},
+        "spec": {"containers": [{"name": "main", "resources": {"limits": {
+            consts.vtpu_number_resource(): 1,
+            consts.vtpu_cores_resource(): 25,
+            consts.vtpu_memory_resource(): 1024}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+# ---------------------------------------------------------------------------
+# leg 1: the pods/s filter curve, 5k -> 50k nodes, both data paths
+# ---------------------------------------------------------------------------
+
+def filter_curve():
+    points = []
+    for n_nodes, mode, n_pods in ((5_000, "ttl", 200),
+                                  (5_000, "snapshot", 2_000),
+                                  (50_000, "ttl", 30),
+                                  (50_000, "snapshot", 10_000)):
+        client = FakeKubeClient(copy_on_read=False)
+        build_cluster(client, n_nodes)
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+            pred = FilterPredicate(client, snapshot=snap)
+        else:
+            pred = FilterPredicate(client, pods_ttl_s=0.25)
+        pods = [vtpu_pod(i) for i in range(n_pods)]
+        placed = 0
+        t0 = time.perf_counter()
+        for pod in pods:
+            client.add_pod(pod)
+            if snap is not None:
+                snap.ensure_fresh()
+            if pred.filter({"Pod": pod}).node_names:
+                placed += 1
+        wall = time.perf_counter() - t0
+        points.append({"nodes": n_nodes, "mode": mode, "pods": n_pods,
+                       "placed": placed,
+                       "pods_per_s": round(n_pods / wall, 1),
+                       "wall_s": round(wall, 2)})
+        print(f"  filter {n_nodes:6d} nodes {mode:8s} "
+              f"{n_pods:6d} pods -> {points[-1]['pods_per_s']:9.1f} "
+              f"pods/s")
+    return points
+
+
+# ---------------------------------------------------------------------------
+# leg 2: bind throughput, serial vs pipelined, at the 100k-pod point
+# ---------------------------------------------------------------------------
+
+def bind_throughput(n_nodes=50_000, n_pods=100_000, serial_sample=3_000,
+                    piped=20_000):
+    """Commit n_pods at n_nodes via the snapshot path, then measure the
+    bind phase with the RTT-charging client: a serial sample and a
+    pipelined bulk, both sustained pods/s over their whole run."""
+    client = LatencyClient(copy_on_read=False)
+    client.rtt_s = 0.0               # free build/commit phase
+    build_cluster(client, n_nodes)
+    snap = ClusterSnapshot(client)
+    snap.start()
+    lease = ShardLease(client, "shard0", "bench", ttl_s=36_000.0,
+                       namespace=NS)
+    assert lease.try_acquire()
+    pred = FilterPredicate(client, snapshot=snap, fence=lease)
+    committed = []
+    for i in range(n_pods):
+        pod = vtpu_pod(i)
+        client.add_pod(pod)
+        snap.ensure_fresh()
+        result = pred.filter({"Pod": pod})
+        if result.node_names:
+            committed.append((pod["metadata"]["name"],
+                              result.node_names[0]))
+    assert len(committed) >= serial_sample + piped, len(committed)
+
+    client.rtt_s = RTT_S             # the wire turns on for the binds
+    # the single-core commit phase takes tens of minutes of wall clock
+    # for 100k pods, so the oldest intent stamps would fail the default
+    # pre-allocation freshness window (commits and binds interleave in
+    # production); the bind phase itself is still fully timed
+    serial_pred = BindPredicate(client, locker=SerialLocker(False),
+                                fence=lease, freshness_s=36_000.0)
+
+    sample = committed[:serial_sample]
+    t0 = time.perf_counter()
+    for name, node in sample:
+        res = serial_pred.bind({"PodName": name,
+                                "PodNamespace": "default", "Node": node})
+        assert not res.error, res.error
+    serial_s = time.perf_counter() - t0
+    serial_rate = serial_sample / serial_s
+
+    pipeline = BindCommitPipeline(serial_pred, max_wave=64,
+                                  max_wait_s=0.002, workers=32)
+    bulk = committed[serial_sample:serial_sample + piped]
+    errors = []
+
+    def one(item):
+        name, node = item
+        res = pipeline.bind({"PodName": name, "PodNamespace": "default",
+                             "Node": node})
+        if res.error:
+            errors.append((name, res.error))
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=64) as pool:
+        list(pool.map(one, bulk))
+    piped_s = time.perf_counter() - t0
+    pipeline.shutdown()
+    assert not errors, errors[:3]
+    piped_rate = len(bulk) / piped_s
+
+    # every Binding landed exactly on its committed node, exactly once
+    bound = {}
+    for _ns, name, node in client.bindings:
+        assert name not in bound, f"duplicate Binding for {name}"
+        bound[name] = node
+    for name, node in sample + bulk:
+        assert bound[name] == node, (name, node, bound[name])
+
+    speedup = piped_rate / serial_rate
+    print(f"  bind @{n_nodes} nodes/{n_pods} committed pods "
+          f"(rtt={RTT_S * 1e3:.2f} ms): serial {serial_rate:.0f} "
+          f"pods/s, pipelined {piped_rate:.0f} pods/s "
+          f"({speedup:.1f}x), {pipeline.waves} waves")
+    return {"nodes": n_nodes, "pods_committed": len(committed),
+            "rtt_ms": RTT_S * 1e3,
+            "serial_pods_per_s": round(serial_rate, 1),
+            "pipelined_pods_per_s": round(piped_rate, 1),
+            "speedup": round(speedup, 2),
+            "waves": pipeline.waves,
+            "wave_pods": pipeline.wave_pods,
+            "degraded": pipeline.degraded}
+
+
+# ---------------------------------------------------------------------------
+# leg 3: placement parity replay
+# ---------------------------------------------------------------------------
+
+def parity_replay(n_nodes=300, n_pods=1_500):
+    """The same pod stream through TTL vs snapshot, then bound serial
+    vs pipelined: placements byte-identical, bindings exactly-once on
+    the committed node."""
+    placements = {}
+    for mode in ("ttl", "snapshot"):
+        client = FakeKubeClient(copy_on_read=False)
+        build_cluster(client, n_nodes)
+        snap = None
+        if mode == "snapshot":
+            snap = ClusterSnapshot(client)
+            snap.start()
+            pred = FilterPredicate(client, snapshot=snap)
+        else:
+            pred = FilterPredicate(client, pods_ttl_s=0.0)
+        lease = ShardLease(client, "shard0", "bench", ttl_s=3600.0,
+                           namespace=NS)
+        assert lease.try_acquire()
+        placed = {}
+        for i in range(n_pods):
+            pod = vtpu_pod(i)
+            client.add_pod(pod)
+            if snap is not None:
+                snap.ensure_fresh()
+            result = pred.filter({"Pod": pod})
+            if result.node_names:
+                placed[pod["metadata"]["name"]] = result.node_names[0]
+        placements[mode] = placed
+        if mode == "snapshot":
+            # bind half serial (gate-off), half pipelined (gate-on):
+            # the Binding set must be identical either way
+            bind_pred = BindPredicate(client, locker=SerialLocker(False))
+            pipeline = BindCommitPipeline(bind_pred, max_wave=16,
+                                          max_wait_s=0.001, workers=8)
+            items = sorted(placed.items())
+            half = len(items) // 2
+            for name, node in items[:half]:
+                res = bind_pred.bind({"PodName": name,
+                                      "PodNamespace": "default",
+                                      "Node": node})
+                assert not res.error, res.error
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                results = list(pool.map(
+                    lambda it: pipeline.bind(
+                        {"PodName": it[0], "PodNamespace": "default",
+                         "Node": it[1]}), items[half:]))
+            pipeline.shutdown()
+            assert all(not r.error for r in results)
+            bound = {}
+            for _ns, name, node in client.bindings:
+                assert name not in bound
+                bound[name] = node
+            assert bound == placed
+    assert placements["ttl"] == placements["snapshot"], \
+        "TTL and snapshot paths disagreed on placements"
+    print(f"  parity @{n_nodes} nodes/{n_pods} pods: "
+          f"{len(placements['ttl'])} placements identical across "
+          f"ttl/snapshot and serial/pipelined binds")
+    return {"nodes": n_nodes, "pods": n_pods,
+            "placed": len(placements["ttl"]), "identical": True}
+
+
+# ---------------------------------------------------------------------------
+# leg 4: rolling reshard under chaos
+# ---------------------------------------------------------------------------
+
+def reshard_chaos(seeds=(1, 2, 3), n_nodes=60, n_pods=240):
+    """Gate-on sharded scheduler committing a stream while the shard
+    plan changes mid-stream and bind.batch faults fire. Every pod must
+    end bound exactly once; late-epoch commits must carry the new
+    epoch."""
+    from vtpu_manager.controller.reschedule import RescheduleController
+
+    results = []
+    for seed in seeds:
+        client = FakeKubeClient()
+        build_cluster(client, n_nodes, pools=("pool-a", "pool-b", ""))
+        plan_mod.publish_plan(client, "pool-a", "bench", namespace=NS,
+                              now=time.time())
+        sched = ShardedScheduler(
+            client, ShardPlan.parse("pool-a"), "bench",
+            lease_ttl_s=3600.0, lease_namespace=NS, use_snapshot=True,
+            scale_pipeline=True,
+            pipeline_kwargs=dict(max_wave=16, max_wait_s=0.001,
+                                 workers=8, patience_s=0.3),
+            plan_spec="pool-a", plan_epoch=1)
+        for unit in sched.units:
+            unit.snapshot.start()
+        sched.tick()
+
+        failpoints.enable(seed=seed)
+        failpoints.arm("bind.batch", "error", p=0.05)
+        deaths = 0
+        lock = threading.Lock()
+
+        def commit_and_bind(i):
+            nonlocal deaths
+            pod = vtpu_pod(i)
+            client.add_pod(pod)
+            for unit in sched.units:
+                if unit.snapshot is not None:
+                    unit.snapshot.ensure_fresh()
+            result = sched.filter({"Pod": pod})
+            if result.error:
+                return False
+            try:
+                res = sched.bind({"PodName": pod["metadata"]["name"],
+                                  "PodNamespace": "default",
+                                  "Node": result.node_names[0]})
+                return not res.error
+            except BaseException:     # torn wave: simulated death
+                with lock:
+                    deaths += 1
+                return False
+
+        late_epoch_ok = True
+        pending = []
+        for i in range(n_pods):
+            if i == n_pods // 2:
+                # the rolling reshard, mid-stream: no restart, next
+                # tick adopts epoch 2
+                plan_mod.publish_plan(client, "pool-a;pool-b", "bench",
+                                      namespace=NS, now=time.time())
+                sched.tick()
+                assert sched.plan_epoch == 2
+            if not commit_and_bind(i):
+                pending.append(i)
+            elif i > n_pods // 2:
+                anns = client.get_pod(
+                    "default", f"pod-{i:06d}")["metadata"].get(
+                        "annotations") or {}
+                stamp = anns.get(consts.shard_fence_annotation(), "")
+                if not stamp.endswith("+2"):
+                    late_epoch_ok = False
+        failpoints.disable()
+
+        # the reapers converge the torn/failed remainder: clear stale
+        # commitments, then re-filter + re-bind until drained
+        ctl = RescheduleController(client, "node-00000",
+                                   intent_ttl_s=0.0,
+                                   intent_scan_every=1,
+                                   plan_probe=lambda: sched.plan_epoch,
+                                   clock=lambda: time.time() + 3600.0)
+        for _round in range(6):
+            if not pending:
+                break
+            ctl.reconcile_once()
+            still = []
+            for i in pending:
+                if not commit_and_bind(i):
+                    still.append(i)
+            pending = still
+        sched.stop()
+
+        bound = {}
+        dups = 0
+        for _ns, name, node in client.bindings:
+            if name in bound:
+                dups += 1
+            bound[name] = node
+        dropped = n_pods - len(bound)
+        results.append({"seed": seed, "pods": n_pods,
+                        "bound": len(bound), "dropped": dropped,
+                        "duplicated": dups, "wave_deaths": deaths,
+                        "late_epoch_stamped": late_epoch_ok,
+                        "spills": sum(u.spills for u in sched.units)})
+        print(f"  reshard seed {seed}: {len(bound)}/{n_pods} bound, "
+              f"dropped={dropped} dup={dups} deaths={deaths} "
+              f"epoch-2 stamps={'ok' if late_epoch_ok else 'MISSING'}")
+        assert dropped == 0, f"seed {seed}: {dropped} pods dropped"
+        assert dups == 0, f"seed {seed}: {dups} duplicate bindings"
+        assert late_epoch_ok, f"seed {seed}: stale epoch stamps"
+    return results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--quick", action="store_true",
+                        help="small scale (CI smoke), no artifact")
+    args = parser.parse_args(argv)
+    t0 = time.perf_counter()
+
+    if args.quick:
+        print("filter pods/s curve (quick):")
+        curve = []
+        print("bind throughput (quick):")
+        bind = bind_throughput(n_nodes=2_000, n_pods=4_000,
+                               serial_sample=500, piped=2_000)
+        print("placement parity replay:")
+        parity = parity_replay(n_nodes=100, n_pods=400)
+        print("rolling reshard chaos:")
+        chaos = reshard_chaos(seeds=(1,), n_nodes=30, n_pods=120)
+    else:
+        print("filter pods/s curve:")
+        curve = filter_curve()
+        print("bind throughput:")
+        bind = bind_throughput()
+        print("placement parity replay:")
+        parity = parity_replay()
+        print("rolling reshard chaos:")
+        chaos = reshard_chaos()
+
+    assert bind["speedup"] >= 5.0, \
+        f"pipelined bind speedup {bind['speedup']}x < 5x"
+
+    doc = {
+        "bench": "scale",
+        "revision": 18,
+        "scenario": {
+            "nodes": bind["nodes"],
+            "pods": bind["pods_committed"],
+            "rtt_ms": bind["rtt_ms"],
+            "quick": args.quick,
+        },
+        "filter_pods_per_s": curve,
+        "bind_throughput": bind,
+        "placement_parity": parity,
+        "reshard_chaos": chaos,
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    if not args.quick:
+        out_path = os.path.join(REPO, "BENCH_VTSCALE_r18.json")
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"bind speedup {bind['speedup']}x (>=5x asserted); "
+              f"wrote {out_path}")
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -558,6 +558,11 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     failpoints.arm("migrate.freeze", rng.choice(["crash", "error"]),
                    p=0.2, count=1)
     failpoints.arm("migrate.refill", "crash", p=0.2, count=1)
+    # vtscale: fires inside a bind wave after a pod's intent patch and
+    # before the wave's single confirm — crash = a torn wave (N torn
+    # serial binds), error = that pod degrades to the serial path
+    failpoints.arm("bind.batch", rng.choice(["crash", "error"]),
+                   p=0.2, count=rng.randint(1, 2))
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 
@@ -1221,3 +1226,102 @@ def test_gate_off_pipeline_records_zero_injections(tmp_path):
     assert snap["total"] == 0
     assert snap["evaluations"] == 0
     assert harness.controller.reconcile_failures_total == 0
+
+
+def test_chaos_torn_bind_wave_converges(tmp_path):
+    """A bind.batch crash tears a pipelined wave mid-commit: the leader
+    thread dies with every staged pod's intent+fence patch already on
+    the apiserver and zero Bindings posted. Followers outlive it (their
+    patience expires, they degrade to the serial path and finish), and
+    the torn leader pod is exactly the PR 4 crash-window shape — the
+    reschedule controller's intent reaper must clear it, and the
+    re-filter + serial re-bind must converge to exactly-once bindings."""
+    import threading as _threading
+    import time as _time
+
+    from vtpu_manager.device import types as _dt
+    from vtpu_manager.scheduler.bindpipe import BindCommitPipeline
+    from vtpu_manager.scheduler.serial import SerialLocker
+
+    client = FakeKubeClient()
+    reg = _dt.fake_registry(4, mesh_shape=(2, 2), uuid_prefix="TPU-w")
+    client.add_node(_dt.fake_node(NODE, reg))
+    lease = lease_mod.ShardLease(client, "shard0", "S0", ttl_s=60.0,
+                                 namespace="vtpu-system")
+    assert lease.try_acquire()
+    filter_pred = FilterPredicate(client, fence=lease)
+    bind_pred = BindPredicate(client, locker=SerialLocker(False),
+                              fence=lease)
+    pipeline = BindCommitPipeline(bind_pred, max_wave=3, max_wait_s=0.3,
+                                  patience_s=0.3)
+
+    pods = {}
+    for i in range(3):
+        pod = vtpu_pod(f"wave-{i}", f"uid-wave-{i}")
+        _apply_annotation_patches(pod, mutate_pod(pod).patches)
+        client.add_pod(pod)
+        result = filter_pred.filter({"Pod": pod})
+        assert not result.error, result.error
+        pods[f"wave-{i}"] = result.node_names[0]
+
+    failpoints.enable(seed=23)
+    failpoints.arm("bind.batch", "crash", p=1.0, count=1)
+    deaths: list[str] = []
+    barrier = _threading.Barrier(len(pods))
+
+    def scheduler_thread(name: str, node: str) -> None:
+        barrier.wait()
+        try:
+            pipeline.bind({"PodName": name, "PodNamespace": "default",
+                           "Node": node})
+        except BaseException:      # noqa: B036 — simulated process death
+            deaths.append(name)
+
+    threads = [_threading.Thread(target=scheduler_thread, args=(n, t))
+               for n, t in pods.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    failpoints.disable()
+
+    # exactly one thread "died" (the wave leader); the survivors
+    # degraded past their patience and serial-bound their own pods
+    assert len(deaths) == 1, deaths
+    bound = {name for _ns, name, _node in client.bindings}
+    torn = set(pods) - bound
+    assert torn, "the crash must leave at least the leader's pod unbound"
+    for name in torn:
+        anns = client.get_pod("default", name)["metadata"]["annotations"]
+        # the torn shape: commitment + intent trail on the apiserver,
+        # no Binding — the exact crash window the PR 4 reaper owns
+        assert anns.get(consts.bind_intent_annotation())
+        assert anns.get(consts.predicate_node_annotation())
+
+    # the reaper (clock far past the intent TTL, lease still live so
+    # only the wall-clock rule fires) clears every torn commitment
+    ctl = RescheduleController(client, NODE, intent_ttl_s=10.0,
+                               intent_scan_every=1,
+                               clock=lambda: _time.time() + 1000.0)
+    ctl.reconcile_once()
+    assert {n for _ns, n in ctl.requeued} == torn
+    for name in torn:
+        anns = client.get_pod("default", name)["metadata"].get(
+            "annotations") or {}
+        assert not anns.get(consts.predicate_node_annotation())
+
+    # requeued pods re-filter and serial re-bind: full convergence
+    for name in sorted(torn):
+        pod = client.get_pod("default", name)
+        result = filter_pred.filter({"Pod": pod})
+        assert not result.error, result.error
+        bres = bind_pred.bind({"PodName": name,
+                               "PodNamespace": "default",
+                               "Node": result.node_names[0]})
+        assert not bres.error, bres.error
+
+    # exactly-once: every pod bound once, no duplicate Bindings
+    names = [n for _ns, n, _node in client.bindings]
+    assert sorted(names) == sorted(pods)
+    assert pipeline.degraded >= 1
+    pipeline.shutdown()

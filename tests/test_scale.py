@@ -1,0 +1,633 @@
+"""vtscale unit suite: fence epoch codec, the published plan object,
+wave-batched bind commits, rolling reshard, cross-shard gang spill,
+webhook HA, and the gate-off byte-identity contract.
+
+The 50k-node/100k-pod end-to-end evidence lives in
+scripts/bench_scale.py (BENCH_VTSCALE_r18.json); this file proves the
+mechanisms pod by pod.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config.vmem import fnv64
+from vtpu_manager.device import types as dt
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.scheduler import lease as lease_mod
+from vtpu_manager.scheduler import plan as plan_mod
+from vtpu_manager.scheduler.bind import BindPredicate
+from vtpu_manager.scheduler.bindpipe import BindCommitPipeline
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.lease import LeaseLostError, ShardLease
+from vtpu_manager.scheduler.serial import SerialLocker
+from vtpu_manager.scheduler.shard import ShardPlan, ShardedScheduler
+from vtpu_manager.util import consts
+from vtpu_manager.util.featuregates import (SCALE_PIPELINE, WEBHOOK_HA,
+                                            FeatureGates)
+from vtpu_manager.webhook.mutate import mutate_pod
+from vtpu_manager.webhook.server import WebhookAPI
+
+TTL = 10.0
+NS = "vtpu-system"
+
+
+class Clock:
+    def __init__(self, start: float = 1000.0):
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def apply_patches(pod: dict, patches: list[dict]) -> None:
+    for patch in patches:
+        path = patch["path"]
+        if path == "/metadata/annotations":
+            pod.setdefault("metadata", {}).setdefault("annotations", {})
+            continue
+        prefix = "/metadata/annotations/"
+        if not path.startswith(prefix):
+            continue
+        key = path[len(prefix):].replace("~1", "/").replace("~0", "~")
+        pod["metadata"]["annotations"][key] = patch["value"]
+
+
+def vtpu_pod(name: str, uid: str, chips: int = 1) -> dict:
+    pod = {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": {}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): chips,
+                consts.vtpu_cores_resource(): 25,
+                consts.vtpu_memory_resource(): 1024}}}]},
+        "status": {"phase": "Pending"},
+    }
+    apply_patches(pod, mutate_pod(pod).patches)
+    return pod
+
+
+def add_node(client, name: str, chips: int = 4, pool: str = "") -> None:
+    mesh = (2, chips // 2) if chips > 1 else (1, 1)
+    reg = dt.fake_registry(chips, mesh_shape=mesh,
+                           uuid_prefix=f"TPU-{name}")
+    node = dt.fake_node(name, reg)
+    if pool:
+        node["metadata"].setdefault("labels", {})[
+            consts.node_pool_label()] = pool
+    client.add_node(node)
+
+
+@pytest.fixture(autouse=True)
+def _no_failpoints():
+    failpoints.disable()
+    yield
+    failpoints.disable()
+
+
+# ===========================================================================
+# Fence epoch codec
+# ===========================================================================
+
+class TestFenceEpochCodec:
+    def test_epoch_zero_is_byte_identical(self):
+        # the pre-vtscale wire format, bit for bit: gate-off clusters
+        # keep writing and parsing exactly what PR 6 shipped
+        assert lease_mod.encode_fence("shard0", 7) == "shard0:7"
+        assert lease_mod.encode_fence("shard0", 7, epoch=0) == "shard0:7"
+
+    def test_epoch_suffix(self):
+        assert lease_mod.encode_fence("shard0", 7, epoch=3) == \
+            "shard0:7+3"
+
+    def test_parse_fence_compat_both_forms(self):
+        # every existing consumer reads (shard, token) regardless of
+        # whether the stamp carries an epoch
+        assert lease_mod.parse_fence("shard0:7") == ("shard0", 7)
+        assert lease_mod.parse_fence("shard0:7+3") == ("shard0", 7)
+
+    def test_parse_fence_epoch(self):
+        assert lease_mod.parse_fence_epoch("shard0:7") == \
+            ("shard0", 7, 0)
+        assert lease_mod.parse_fence_epoch("shard0:7+3") == \
+            ("shard0", 7, 3)
+        assert lease_mod.parse_fence_epoch("a:b:7+2") == ("a:b", 7, 2)
+
+    def test_parse_rejects_garbage(self):
+        for raw in (None, "", "shard0", "shard0:x", "shard0:7+x",
+                    "shard0:7+-2", "shard0:+2"):
+            assert lease_mod.parse_fence_epoch(raw) is None, raw
+            assert lease_mod.parse_fence(raw) is None, raw
+
+    def test_roundtrip(self):
+        for shard, token, epoch in (("s", 1, 0), ("a:b", 99, 12)):
+            raw = lease_mod.encode_fence(shard, token, epoch)
+            assert lease_mod.parse_fence_epoch(raw) == \
+                (shard, token, epoch)
+
+
+# ===========================================================================
+# The published plan object
+# ===========================================================================
+
+class TestPlanObject:
+    def test_publish_creates_epoch_one(self):
+        client, clock = FakeKubeClient(), Clock()
+        state = plan_mod.publish_plan(client, "pool-a;pool-b", "S0",
+                                      namespace=NS, now=clock())
+        assert state is not None
+        assert state.epoch == 1 and state.spec == "pool-a;pool-b"
+        read = plan_mod.read_plan(client, NS)
+        assert read.epoch == 1 and read.spec == "pool-a;pool-b"
+        assert read.holder == "S0"
+
+    def test_republish_same_spec_is_idempotent(self):
+        client, clock = FakeKubeClient(), Clock()
+        plan_mod.publish_plan(client, "pool-a", "S0", namespace=NS,
+                              now=clock())
+        # a rolling fleet restart republishes the same --shard-pools
+        # from every replica: the epoch must NOT move
+        state = plan_mod.publish_plan(client, "pool-a", "S1",
+                                      namespace=NS, now=clock())
+        assert state.epoch == 1
+
+    def test_changed_spec_bumps_epoch(self):
+        client, clock = FakeKubeClient(), Clock()
+        plan_mod.publish_plan(client, "pool-a", "S0", namespace=NS,
+                              now=clock())
+        state = plan_mod.publish_plan(client, "pool-a;pool-b", "S0",
+                                      namespace=NS, now=clock())
+        assert state.epoch == 2 and state.spec == "pool-a;pool-b"
+
+    def test_read_absent_is_none(self):
+        assert plan_mod.read_plan(FakeKubeClient(), NS) is None
+
+
+# ===========================================================================
+# Wave-batched bind commits
+# ===========================================================================
+
+class _Rig:
+    """One shard's filter+bind pair fronted by a pipeline."""
+
+    def __init__(self, n_nodes: int = 4, fence: bool = True,
+                 max_wave: int = 8, max_wait_s: float = 0.05):
+        self.client = FakeKubeClient()
+        self.clock = Clock()
+        for i in range(n_nodes):
+            add_node(self.client, f"node-{i}")
+        self.lease = None
+        if fence:
+            self.lease = ShardLease(self.client, "shard0", "S0",
+                                    ttl_s=TTL, namespace=NS,
+                                    monotonic=self.clock,
+                                    wall=self.clock)
+            assert self.lease.try_acquire()
+        self.filter_pred = FilterPredicate(self.client, fence=self.lease)
+        self.bind_pred = BindPredicate(self.client,
+                                       locker=SerialLocker(False),
+                                       fence=self.lease)
+        self.pipeline = BindCommitPipeline(self.bind_pred,
+                                           max_wave=max_wave,
+                                           max_wait_s=max_wait_s,
+                                           patience_s=1.0)
+
+    def commit(self, pod: dict) -> str:
+        self.client.add_pod(pod)
+        result = self.filter_pred.filter({"Pod": pod})
+        assert not result.error, result.error
+        return result.node_names[0]
+
+    def anns(self, name: str) -> dict:
+        return self.client.get_pod("default", name)["metadata"].get(
+            "annotations") or {}
+
+
+class TestBindPipeline:
+    def test_wave_binds_every_pod_with_serial_bytes(self):
+        rig = _Rig()
+        targets = {}
+        for i in range(6):
+            pod = vtpu_pod(f"p{i}", f"uid-{i}")
+            targets[f"p{i}"] = rig.commit(pod)
+        results = {}
+        barrier = threading.Barrier(len(targets))
+
+        def one(name, node):
+            barrier.wait()
+            results[name] = rig.pipeline.bind(
+                {"PodName": name, "PodNamespace": "default",
+                 "Node": node})
+
+        threads = [threading.Thread(target=one, args=(n, t))
+                   for n, t in targets.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for name in targets:
+            assert not results[name].error, (name, results[name].error)
+            anns = rig.anns(name)
+            # the exact serial-path commit bytes: allocating status,
+            # intent trail, fencing stamp — then the Binding
+            assert anns.get(consts.allocation_status_annotation()) == \
+                consts.ALLOC_STATUS_ALLOCATING
+            assert anns.get(consts.bind_intent_annotation())
+            assert anns.get(consts.shard_fence_annotation()) == "shard0:1"
+        assert rig.pipeline.wave_pods == 6
+        # one confirm CAS per wave, not per pod: waves <= renewals spent
+        assert rig.pipeline.waves <= 3
+
+    def test_deterministic_rejection_uses_serial_error(self):
+        rig = _Rig()
+        pod = vtpu_pod("naked", "uid-naked")
+        rig.client.add_pod(pod)   # never filtered: no pre-allocation
+        result = rig.pipeline.bind({"PodName": "naked",
+                                    "PodNamespace": "default",
+                                    "Node": "node-0"})
+        assert result.error == "pod has no vtpu pre-allocation"
+
+    def test_wrong_node_rejected_like_serial(self):
+        rig = _Rig()
+        pod = vtpu_pod("p0", "uid-0")
+        node = rig.commit(pod)
+        other = next(f"node-{i}" for i in range(4)
+                     if f"node-{i}" != node)
+        result = rig.pipeline.bind({"PodName": "p0",
+                                    "PodNamespace": "default",
+                                    "Node": other})
+        assert "predicate node" in result.error
+
+    def test_confirm_failure_fails_the_wave_with_fence_error(self):
+        rig = _Rig()
+        pod = vtpu_pod("p0", "uid-0")
+        node = rig.commit(pod)
+        # a peer (on its own, later clock) steals the lease while this
+        # replica still believes itself fresh: stage A stages the pod,
+        # and the wave's single confirm CAS must reject the bind
+        # exactly like the serial path
+        thief_clock = Clock(rig.clock.t + TTL + 1)
+        thief = ShardLease(rig.client, "shard0", "B", ttl_s=TTL,
+                           namespace=NS, monotonic=thief_clock,
+                           wall=thief_clock)
+        assert thief.try_acquire()
+        result = rig.pipeline.bind({"PodName": "p0",
+                                    "PodNamespace": "default",
+                                    "Node": node})
+        assert result.error.startswith(
+            "bind rejected at commit (lease fence)")
+        assert rig.pipeline.confirm_failures == 1
+        # the torn intent is on the apiserver — the reapable trail
+        assert rig.anns("p0").get(consts.bind_intent_annotation())
+
+    def test_per_pod_error_degrades_that_pod_to_serial(self):
+        rig = _Rig()
+        pod = vtpu_pod("p0", "uid-0")
+        node = rig.commit(pod)
+        failpoints.enable(seed=1)
+        failpoints.arm("bind.batch", "error", p=1.0, count=1)
+        result = rig.pipeline.bind({"PodName": "p0",
+                                    "PodNamespace": "default",
+                                    "Node": node})
+        # the injected fault burned the one count inside the wave; the
+        # degraded serial retry converges
+        assert not result.error, result.error
+        assert rig.pipeline.degraded == 1
+        assert rig.anns("p0").get(consts.allocation_status_annotation())
+
+    def test_unfenced_pipeline_skips_confirm(self):
+        rig = _Rig(fence=False)
+        pod = vtpu_pod("p0", "uid-0")
+        node = rig.commit(pod)
+        result = rig.pipeline.bind({"PodName": "p0",
+                                    "PodNamespace": "default",
+                                    "Node": node})
+        assert not result.error
+        assert consts.shard_fence_annotation() not in rig.anns("p0")
+
+    def test_epoch_rides_the_fence_stamp(self):
+        rig = _Rig()
+        rig.lease.epoch = 4
+        pod = vtpu_pod("p0", "uid-0")
+        node = rig.commit(pod)
+        assert rig.anns("p0").get(consts.shard_fence_annotation()) == \
+            "shard0:1+4"
+        result = rig.pipeline.bind({"PodName": "p0",
+                                    "PodNamespace": "default",
+                                    "Node": node})
+        assert not result.error
+
+
+# ===========================================================================
+# Rolling reshard (dynamic plans)
+# ===========================================================================
+
+class TestRollingReshard:
+    def _sched(self, client, clock, spec="pool-a", epoch=1):
+        return ShardedScheduler(
+            client, ShardPlan.parse(spec), "S0",
+            lease_ttl_s=TTL, lease_namespace=NS,
+            scale_pipeline=True, plan_spec=spec, plan_epoch=epoch,
+            monotonic=clock, wall=clock)
+
+    def test_adoption_rebuilds_units_and_bumps_fences(self):
+        client, clock = FakeKubeClient(), Clock()
+        add_node(client, "node-a", pool="pool-a")
+        add_node(client, "node-b", pool="pool-b")
+        plan_mod.publish_plan(client, "pool-a", "S0", namespace=NS,
+                              now=clock())
+        sched = self._sched(client, clock)
+        sched.tick()
+        assert sched.units[0].lease.held_fresh()
+        old_unit = sched.units[0]
+
+        # commit a pod under epoch 1 so the reshard has a stale stamp
+        # to fence off
+        # uid chosen so the pod homes to shard0 (pool-a) under BOTH the
+        # 2-unit epoch-1 plan and the 3-unit epoch-2 plan
+        pod = vtpu_pod("victim", "uid-victim-5")
+        client.add_pod(pod)
+        result = sched.filter({"Pod": pod})
+        assert not result.error, result.error
+        stamp = client.get_pod("default", "victim")["metadata"][
+            "annotations"][consts.shard_fence_annotation()]
+        assert stamp.endswith("+1")
+
+        # --shard-pools change published: epoch 2, new partition
+        plan_mod.publish_plan(client, "pool-a;pool-b", "S0",
+                              namespace=NS, now=clock())
+        sched.tick()
+        assert sched.plan_epoch == 2
+        assert len(sched.units) == 3          # pool-a; pool-b; catch-all
+        for unit in sched.units:
+            assert unit.lease.epoch == 2
+        # same holder, new incarnation: the token CAS-bumped, so the
+        # old unit's in-flight confirm dies at commit like a fenced-off
+        # ex-leader — no TTL wait, no restart
+        assert sched.units[0].lease.token == 2
+        with pytest.raises(LeaseLostError):
+            old_unit.lease.confirm()
+        # the epoch-1 commitment was reaped by the takeover replay; the
+        # pod re-enters scheduling and recommits under the new stamp
+        anns = client.get_pod("default", "victim")["metadata"].get(
+            "annotations") or {}
+        assert not anns.get(consts.predicate_node_annotation())
+        result = sched.filter(
+            {"Pod": client.get_pod("default", "victim")})
+        assert not result.error, result.error
+        stamp = client.get_pod("default", "victim")["metadata"][
+            "annotations"][consts.shard_fence_annotation()]
+        assert stamp.endswith("+2")
+
+    def test_same_spec_republish_keeps_units(self):
+        client, clock = FakeKubeClient(), Clock()
+        add_node(client, "node-a", pool="pool-a")
+        plan_mod.publish_plan(client, "pool-a", "S0", namespace=NS,
+                              now=clock())
+        sched = self._sched(client, clock)
+        sched.tick()
+        units = sched.units
+        plan_mod.publish_plan(client, "pool-a", "S1", namespace=NS,
+                              now=clock())
+        sched.tick()
+        assert sched.units is units           # no rebuild
+
+    def test_reaper_reaps_old_epoch_immediately(self):
+        from vtpu_manager.controller.reschedule import (
+            RescheduleController)
+        from vtpu_manager.resilience import recovery
+        client, clock = FakeKubeClient(), Clock()
+        pod = vtpu_pod("stale", "uid-stale")
+        anns = pod["metadata"]["annotations"]
+        anns[consts.pre_allocated_annotation()] = "enc"
+        anns[consts.predicate_node_annotation()] = "node-1"
+        # FRESH intent (0.1s old), stamped by a shard name that does
+        # not even exist in the new plan, under a LIVE-looking lease —
+        # only the epoch rule can reap this one
+        anns[consts.bind_intent_annotation()] = \
+            recovery.encode_bind_intent("node-1", clock() - 0.1)
+        anns[consts.shard_fence_annotation()] = "oldshard9:1+1"
+        client.add_pod(pod)
+        ctl = RescheduleController(client, "node-1", intent_ttl_s=10.0,
+                                   intent_scan_every=1,
+                                   plan_probe=lambda: 2, clock=clock)
+        ctl.reconcile_once()
+        anns = client.get_pod("default", "stale")["metadata"].get(
+            "annotations") or {}
+        assert not anns.get(consts.predicate_node_annotation())
+        assert ("default", "stale") in ctl.requeued
+
+    def test_reaper_protects_current_epoch(self):
+        from vtpu_manager.controller.reschedule import (
+            RescheduleController)
+        from vtpu_manager.resilience import recovery
+        client, clock = FakeKubeClient(), Clock()
+        pod = vtpu_pod("fresh", "uid-fresh")
+        anns = pod["metadata"]["annotations"]
+        anns[consts.pre_allocated_annotation()] = "enc"
+        anns[consts.predicate_node_annotation()] = "node-1"
+        anns[consts.bind_intent_annotation()] = \
+            recovery.encode_bind_intent("node-1", clock() - 0.1)
+        anns[consts.shard_fence_annotation()] = "shard0:1+2"
+        client.add_pod(pod)
+        ctl = RescheduleController(client, "node-1", intent_ttl_s=10.0,
+                                   intent_scan_every=1,
+                                   plan_probe=lambda: 2, clock=clock)
+        ctl.reconcile_once()
+        assert client.get_pod("default", "fresh")["metadata"][
+            "annotations"].get(consts.predicate_node_annotation())
+
+
+# ===========================================================================
+# Cross-shard gang spill
+# ===========================================================================
+
+def _gang_name_for_shard(n_shards: int, want: int) -> str:
+    for i in range(1000):
+        name = f"gang-{i}"
+        if fnv64(f"gang/default/{name}") % n_shards == want:
+            return name
+    raise AssertionError("no gang name hashes to the wanted shard")
+
+
+class TestCrossShardSpill:
+    def _sched(self, client, clock):
+        sched = ShardedScheduler(
+            client, ShardPlan.parse("pool-a"), "S0",
+            lease_ttl_s=TTL, lease_namespace=NS, use_snapshot=True,
+            scale_pipeline=True, monotonic=clock, wall=clock)
+        for unit in sched.units:
+            unit.snapshot.start()
+        sched.tick()
+        return sched
+
+    def test_gang_spills_to_neighbor_under_owner_fence(self):
+        client, clock = FakeKubeClient(), Clock()
+        # shard0 (pool-a) owns one TINY node; the catch-all shard has
+        # the headroom
+        add_node(client, "node-small", chips=1, pool="pool-a")
+        add_node(client, "node-big", chips=4)
+        sched = self._sched(client, clock)
+
+        gang = _gang_name_for_shard(2, want=0)   # homed to shard0
+        pod = vtpu_pod("member-0", "uid-m0", chips=2)
+        pod["metadata"]["annotations"][
+            consts.gang_name_annotation()] = gang
+        client.add_pod(pod)
+        assert sched.unit_for_pod(pod).spec.name == "shard0"
+
+        result = sched.filter({"Pod": pod})
+        assert not result.error, result.error
+        anns = client.get_pod("default", "member-0")["metadata"][
+            "annotations"]
+        # placed on the NEIGHBOR's node, stamped with the OWNER's fence
+        assert anns[consts.predicate_node_annotation()] == "node-big"
+        assert anns[consts.shard_fence_annotation()].startswith(
+            "shard0:")
+        assert sched.units[0].spills == 1
+        # and the spilled pod binds (node-routed to the neighbor unit,
+        # which this process also leads)
+        bres = sched.bind({"PodName": "member-0",
+                           "PodNamespace": "default",
+                           "Node": "node-big"})
+        assert not bres.error, bres.error
+
+    def test_non_gang_pod_never_spills(self):
+        client, clock = FakeKubeClient(), Clock()
+        add_node(client, "node-small", chips=1, pool="pool-a")
+        add_node(client, "node-big", chips=4)
+        sched = self._sched(client, clock)
+        # a solo pod homed to shard0 that cannot fit there stays failed
+        for i in range(1000):
+            uid = f"uid-solo-{i}"
+            if fnv64(uid) % 2 == 0:
+                break
+        pod = vtpu_pod("solo", uid, chips=2)
+        client.add_pod(pod)
+        assert sched.unit_for_pod(pod).spec.name == "shard0"
+        result = sched.filter({"Pod": pod})
+        assert result.error
+        assert sched.units[0].spills == 0
+
+
+# ===========================================================================
+# Webhook HA
+# ===========================================================================
+
+class TestWebhookHA:
+    def _review(self):
+        return {"request": {"uid": "u1",
+                            "object": vtpu_pod("w", "uid-w")}}
+
+    def _run(self, api, scenario):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient as HttpClient
+        from aiohttp.test_utils import TestServer
+
+        async def main():
+            async with HttpClient(TestServer(api.build_app())) as http:
+                await scenario(http)
+        asyncio.run(main())
+
+    def test_active_mutator_serves(self):
+        client, clock = FakeKubeClient(), Clock()
+        lease = ShardLease(client, "webhook", "W0", ttl_s=TTL,
+                           namespace=NS,
+                           object_name="vtpu-webhook-active",
+                           monotonic=clock, wall=clock)
+        assert lease.try_acquire()
+        api = WebhookAPI(ha_lease=lease)
+
+        async def scenario(http):
+            resp = await http.post("/pods/mutate", json=self._review())
+            assert resp.status == 200
+            assert (await http.get("/readyz")).status == 200
+            text = await (await http.get("/metrics")).text()
+            assert "vtpu_webhook_ha_active 1" in text
+        self._run(api, scenario)
+
+    def test_passive_refuses_mutates_but_validates(self):
+        client, clock = FakeKubeClient(), Clock()
+        leader = ShardLease(client, "webhook", "W0", ttl_s=TTL,
+                            namespace=NS,
+                            object_name="vtpu-webhook-active",
+                            monotonic=clock, wall=clock)
+        assert leader.try_acquire()
+        passive = ShardLease(client, "webhook", "W1", ttl_s=TTL,
+                             namespace=NS,
+                             object_name="vtpu-webhook-active",
+                             monotonic=clock, wall=clock)
+        assert not passive.try_acquire()
+        api = WebhookAPI(ha_lease=passive)
+
+        async def scenario(http):
+            resp = await http.post("/pods/mutate", json=self._review())
+            assert resp.status == 503
+            # standby: unready (endpoints drop it) but healthy (no
+            # restart) and still validating (pure, no writes)
+            assert (await http.get("/readyz")).status == 503
+            assert (await http.get("/healthz")).status == 200
+            resp = await http.post("/pods/validate",
+                                   json=self._review())
+            assert resp.status == 200
+            text = await (await http.get("/metrics")).text()
+            assert "vtpu_webhook_ha_refusals_total 1" in text
+        self._run(api, scenario)
+
+    def test_webhook_lease_has_its_own_object(self):
+        # the webhook lease must never collide with a scheduler shard
+        # lease of the same shard name
+        client, clock = FakeKubeClient(), Clock()
+        web = ShardLease(client, "webhook", "W0", ttl_s=TTL,
+                         namespace=NS,
+                         object_name="vtpu-webhook-active",
+                         monotonic=clock, wall=clock)
+        sched = ShardLease(client, "webhook", "S0", ttl_s=TTL,
+                           namespace=NS, monotonic=clock, wall=clock)
+        assert web.try_acquire()
+        assert sched.try_acquire()            # different Lease objects
+        assert web.object_name != sched.object_name
+
+
+# ===========================================================================
+# Gate-off contract
+# ===========================================================================
+
+class TestGateOff:
+    def test_gates_default_off(self):
+        gates = FeatureGates()
+        assert not gates.enabled(SCALE_PIPELINE)
+        assert not gates.enabled(WEBHOOK_HA)
+
+    def test_sharded_scheduler_has_no_pipelines_by_default(self):
+        client, clock = FakeKubeClient(), Clock()
+        sched = ShardedScheduler(client, ShardPlan.parse(""), "S0",
+                                 lease_ttl_s=TTL, lease_namespace=NS,
+                                 monotonic=clock, wall=clock)
+        assert all(u.pipeline is None for u in sched.units)
+        assert not sched.scale_pipeline
+        # no plan lease is ever read or written
+        sched.tick()
+        assert plan_mod.read_plan(client, NS) is None
+
+    def test_fence_stamp_bytes_unchanged_without_plan(self):
+        client, clock = FakeKubeClient(), Clock()
+        lease = ShardLease(client, "shard0", "S0", ttl_s=TTL,
+                           namespace=NS, monotonic=clock, wall=clock)
+        assert lease.try_acquire()
+        assert lease.fence_annotations()[
+            consts.shard_fence_annotation()] == "shard0:1"
+
+    def test_ha_metrics_without_scale_block(self):
+        client, clock = FakeKubeClient(), Clock()
+        sched = ShardedScheduler(client, ShardPlan.parse(""), "S0",
+                                 lease_ttl_s=TTL, lease_namespace=NS,
+                                 monotonic=clock, wall=clock)
+        text = sched.render_ha_metrics()
+        assert "vtpu_scale_plan_epoch" not in text
+        assert "vtpu_bind_waves_total" not in text

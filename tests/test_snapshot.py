@@ -694,3 +694,88 @@ class TestAssumedClockMonotonic:
                        pred._assumed_by_node().values()) == 1
         finally:
             monkeypatch.undo()
+
+
+# ---------------------------------------------------------------------------
+# vtscale rank mechanics: lazy walk, overlay/tombstones, O(1) digest
+# ---------------------------------------------------------------------------
+
+class TestRankWalk:
+    def test_walk_matches_materialized_rank_both_directions(self):
+        client, regs = make_cluster(8)
+        snap = snap_for(client)
+        # churn enough updates to populate the overlay and tombstones
+        for i in range(6):
+            client.add_pod(real_alloc_pod(f"p{i}", regs[i % 8],
+                                          f"node-{i % 8:04d}",
+                                          cores=10 * (i % 3 + 1)))
+        snap.ensure_fresh()
+        items = snap.rank_items()
+        assert list(snap.rank_walk()) == items
+        assert list(snap.rank_walk(reverse=True)) == items[::-1]
+        assert items == sorted(items)
+
+    def test_overlay_update_then_revert_keeps_one_item_per_node(self):
+        client, regs = make_cluster(4)
+        snap = snap_for(client)
+        # load node-0001 then free it again: the rank structures hold
+        # two generations of its item plus a tombstone, but the walk
+        # must surface exactly one (the live one)
+        client.add_pod(real_alloc_pod("load", regs[1], "node-0001",
+                                      cores=80))
+        snap.ensure_fresh()
+        client.delete_pod("default", "load")
+        snap.ensure_fresh()
+        names = [name for _k, name in snap.rank_walk()]
+        assert sorted(names) == [f"node-{i:04d}" for i in range(4)]
+        assert len(names) == len(set(names))
+
+    def test_compaction_preserves_order_and_digest(self):
+        client, regs = make_cluster(6)
+        snap = snap_for(client)
+        # enough churn to cross the max(64, n/8) compaction threshold
+        # several times over
+        for round_ in range(40):
+            for i in range(6):
+                client.add_pod(real_alloc_pod(
+                    f"r{round_}-n{i}", regs[i], f"node-{i:04d}",
+                    cores=5, chip_index=round_ % 4))
+            snap.ensure_fresh()
+            if round_ % 2:
+                for i in range(6):
+                    client.delete_pod("default", f"r{round_}-n{i}")
+                snap.ensure_fresh()
+        items = snap.rank_items()
+        assert items == sorted(items)
+        assert len(items) == 6
+        nodes, key_sum = snap.capacity_digest()
+        assert nodes == 6
+        assert key_sum == sum(k for k, _ in items)
+
+    def test_capacity_digest_moves_with_load(self):
+        client, regs = make_cluster(2)
+        snap = snap_for(client)
+        before = snap.capacity_digest()
+        assert before[0] == 2
+        client.add_pod(real_alloc_pod("hog", regs[0], "node-0000",
+                                      cores=80, memory=4096))
+        snap.ensure_fresh()
+        after = snap.capacity_digest()
+        assert after[0] == 2
+        # rank keys grow with free capacity, so loading a node must
+        # strictly shrink the digest sum
+        assert after[1] < before[1]
+
+    def test_walk_is_safe_against_concurrent_update(self):
+        client, regs = make_cluster(4)
+        snap = snap_for(client)
+        walk = snap.rank_walk()
+        first = next(walk)
+        # a mid-walk update to a not-yet-yielded node: the stale item
+        # stops matching _rank_of and is skipped, never yielded twice
+        remaining = [name for _k, name in walk]
+        client.add_pod(real_alloc_pod("mid", regs[2], "node-0002",
+                                      cores=80))
+        snap.ensure_fresh()
+        seen = [first[1]] + remaining
+        assert len(seen) == len(set(seen)) == 4

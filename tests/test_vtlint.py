@@ -1048,6 +1048,38 @@ class TestStalecodec:
             """}, select=self.SELECT)
         assert findings == []
 
+    def test_adhoc_fence_split_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"controller/mod.py": """
+            def shard_of(fence_raw):
+                shard, _, rest = fence_raw.rpartition(":")
+                return shard, rest.split("+")
+            """}, select=self.SELECT)
+        assert any("parse_fence" in f.message for f in findings)
+
+    def test_adhoc_epoch_split_flagged(self, tmp_path):
+        findings = lint(tmp_path, {"controller/mod.py": """
+            def epoch_of(pod):
+                fence = pod["metadata"]["annotations"].get("fence")
+                return fence.rsplit("+", 1)
+            """}, select=self.SELECT)
+        assert any("parse_fence_epoch" in f.message for f in findings)
+
+    def test_fence_split_in_lease_module_exempt(self, tmp_path):
+        findings = lint(tmp_path, {"scheduler/lease.py": """
+            def parse_fence_epoch(raw):
+                body, _, fence_epoch = raw.partition("+")
+                return body.rsplit(":", 1), fence_epoch
+            """}, select=self.SELECT)
+        assert findings == []
+
+    def test_non_fence_colon_split_clean(self, tmp_path):
+        findings = lint(tmp_path, {"util/mod.py": """
+            def host_port(addr):
+                host, _, port = addr.rpartition(":")
+                return host, int(port)
+            """}, select=self.SELECT)
+        assert findings == []
+
 
 # ---------------------------------------------------------------------------
 # ring-io
@@ -1193,6 +1225,58 @@ class TestPredicateRideAlong:
         findings = lint(tmp_path, {"cmd_like/sched.py": """
             filter_kwargs = dict(whatever=True)
             """}, select=self.SELECT)
+        assert findings == []
+
+    def test_pipeline_kwargs_typo_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/bindpipe.py": """
+                class BindCommitPipeline:
+                    def __init__(self, serial, max_wave=32,
+                                 max_wait_s=0.002, workers=8,
+                                 patience_s=5.0):
+                        self.serial = serial
+                """,
+            "cmd_like/sched.py": """
+                pipeline_kwargs = dict(max_wave=64, max_wiat_s=0.001)
+                """}, select=self.SELECT)
+        assert any("'max_wiat_s'" in f.message
+                   and "BindCommitPipeline" in f.message
+                   for f in findings)
+
+    def test_pipeline_knob_at_call_site_flagged(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/bindpipe.py": """
+                class BindCommitPipeline:
+                    def __init__(self, serial, max_wave=32,
+                                 patience_s=5.0):
+                        self.serial = serial
+                """,
+            "scheduler/shard_like.py": """
+                from vtpu_manager.scheduler.bindpipe import \
+                    BindCommitPipeline
+
+                def build(pred, pipeline_kwargs):
+                    return BindCommitPipeline(pred, patience_s=0.5,
+                                              **pipeline_kwargs)
+                """}, select=self.SELECT)
+        assert any("patience_s" in f.message
+                   and "ride the shared pipeline_kwargs" in f.message
+                   for f in findings)
+
+    def test_pipeline_splat_clean(self, tmp_path):
+        findings = lint(tmp_path, {
+            "scheduler/bindpipe.py": """
+                class BindCommitPipeline:
+                    def __init__(self, serial, max_wave=32):
+                        self.serial = serial
+                """,
+            "scheduler/shard_like.py": """
+                from vtpu_manager.scheduler.bindpipe import \
+                    BindCommitPipeline
+
+                def build(pred, pipeline_kwargs):
+                    return BindCommitPipeline(pred, **pipeline_kwargs)
+                """}, select=self.SELECT)
         assert findings == []
 
 

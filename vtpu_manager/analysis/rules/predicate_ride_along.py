@@ -40,16 +40,28 @@ _CLASS = "FilterPredicate"
 _FILTER_MODULE = "scheduler/filter.py"
 _KWARGS_NAME = "filter_kwargs"
 
+# vtscale rides the same contract: BindCommitPipeline tuning (wave
+# size, drain wait, worker pool, follower patience) is assembled ONCE
+# as ``pipeline_kwargs = dict(...)`` in cmd/device_scheduler.py and
+# splatted by both the plain path and every vtha shard
+# (scheduler/shard.py) — a knob passed directly at one call site runs
+# with the default in the other data path
+_CONTRACTS = (
+    (_CLASS, _FILTER_MODULE, _KWARGS_NAME),
+    ("BindCommitPipeline", "scheduler/bindpipe.py", "pipeline_kwargs"),
+)
 
-def _signature(project: Project
+
+def _signature(project: Project, class_name: str, module_path: str
                ) -> tuple[set[str], set[str], set[str]] | None:
     """(all params, infra params, bool-gate params) from the live
     __init__ — the rule tracks the real signature, not a frozen copy."""
-    mod = project.find_module(_FILTER_MODULE)
+    mod = project.find_module(module_path)
     if mod is None:
         return None
     for node in ast.walk(mod.tree):
-        if not (isinstance(node, ast.ClassDef) and node.name == _CLASS):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == class_name):
             continue
         for fn in node.body:
             if isinstance(fn, ast.FunctionDef) and fn.name == "__init__":
@@ -76,45 +88,50 @@ class PredicateRideAlongRule(Rule):
                    "filter_kwargs assembly so vtha shards inherit them")
 
     def finalize(self, project: Project) -> Iterable[Finding]:
-        sig = _signature(project)
-        if sig is None:
-            return []
-        all_params, infra, gates = sig
         out: list[Finding] = []
-        for mod in project.modules:
-            if mod.path.endswith(_FILTER_MODULE):
+        for class_name, module_path, kwargs_name in _CONTRACTS:
+            sig = _signature(project, class_name, module_path)
+            if sig is None:
                 continue
-            for node in ast.walk(mod.tree):
-                if isinstance(node, ast.Call):
-                    out.extend(self._check_call(mod, node, infra))
-                elif isinstance(node, ast.Assign):
-                    out.extend(self._check_assembly(
-                        mod, node, all_params, gates))
+            all_params, infra, gates = sig
+            for mod in project.modules:
+                if mod.path.endswith(module_path):
+                    continue
+                for node in ast.walk(mod.tree):
+                    if isinstance(node, ast.Call):
+                        out.extend(self._check_call(
+                            mod, node, infra, class_name, kwargs_name))
+                    elif isinstance(node, ast.Assign):
+                        out.extend(self._check_assembly(
+                            mod, node, all_params, gates, class_name,
+                            kwargs_name))
         return out
 
-    def _check_call(self, mod: Module, node: ast.Call,
-                    infra: set[str]) -> Iterable[Finding]:
+    def _check_call(self, mod: Module, node: ast.Call, infra: set[str],
+                    class_name: str,
+                    kwargs_name: str) -> Iterable[Finding]:
         parts = dotted_parts(node.func)
-        if not parts or parts[-1] != _CLASS:
+        if not parts or parts[-1] != class_name:
             return
         for kw in node.keywords:
             if kw.arg is None or kw.arg in infra:
                 continue   # **splat / infrastructure wiring
             yield Finding(
                 RULE, mod.path, node.lineno,
-                f"{_CLASS}({kw.arg}=...) passes a behavioral input "
+                f"{class_name}({kw.arg}=...) passes a behavioral input "
                 f"directly at one call site — it must ride the shared "
-                f"{_KWARGS_NAME} assembly, or the vtha shard path "
+                f"{kwargs_name} assembly, or the vtha shard path "
                 f"(scheduler/shard.py) silently runs with the default")
 
     def _check_assembly(self, mod: Module, node: ast.Assign,
-                        all_params: set[str],
-                        gates: set[str]) -> Iterable[Finding]:
+                        all_params: set[str], gates: set[str],
+                        class_name: str,
+                        kwargs_name: str) -> Iterable[Finding]:
         if len(node.targets) != 1:
             return
         target = node.targets[0]
         if not (isinstance(target, ast.Name)
-                and target.id == _KWARGS_NAME):
+                and target.id == kwargs_name):
             return
         call = node.value
         if not (isinstance(call, ast.Call)
@@ -127,14 +144,14 @@ class PredicateRideAlongRule(Rule):
         for name in sorted(named - all_params):
             yield Finding(
                 RULE, mod.path, node.lineno,
-                f"{_KWARGS_NAME} names {name!r}, which is not a "
-                f"{_CLASS}.__init__ parameter — dict() accepts the "
+                f"{kwargs_name} names {name!r}, which is not a "
+                f"{class_name}.__init__ parameter — dict() accepts the "
                 f"typo, the predicate rejects it only when this path "
                 f"runs")
         for name in sorted(gates - named):
             yield Finding(
                 RULE, mod.path, node.lineno,
-                f"{_KWARGS_NAME} is missing the {_CLASS} gate "
+                f"{kwargs_name} is missing the {class_name} gate "
                 f"{name!r} — every bool gate rides the assembly so "
                 f"both the plain and the vtha-shard data path see the "
                 f"same decision")

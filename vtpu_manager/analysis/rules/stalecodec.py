@@ -24,6 +24,14 @@ mechanical; outside util/stalecodec.py it flags:
   local kernel clock can't skew against itself — so comparisons whose
   operands mention ``mtime`` stay legal.
 
+vtscale extends the same discipline to the shard-fence wire format
+(``<shard>:<token>[+<epoch>]``), whose sole encoder/decoder lives in
+scheduler/lease.py (``encode_fence`` / ``parse_fence`` /
+``parse_fence_epoch``). Outside that module, splitting a fence-ish
+value on ``":"`` or ``"+"`` by hand re-derives the codec — and gets the
+epoch-0 compat form (no ``+`` suffix) or shard names containing ``":"``
+wrong, exactly the drift the plan-epoch rollout cannot afford.
+
 Genuine exceptions (e.g. a flock-liveness payload that is not a registry
 annotation) take a written ``# vtlint: disable=stalecodec``.
 """
@@ -101,9 +109,12 @@ class StalecodecRule(Rule):
         age_locals = {n: ln for n, ln in age_locals.items()
                       if assign_counts.get(n) == 1}
 
+        fence_exempt = module.path.endswith("scheduler/lease.py")
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Call):
                 out.extend(self._check_split(module, node))
+                if not fence_exempt:
+                    out.extend(self._check_fence_split(module, node))
             elif isinstance(node, ast.JoinedStr):
                 out.extend(self._check_stamp(module, node))
             elif isinstance(node, ast.Compare):
@@ -125,6 +136,40 @@ class StalecodecRule(Rule):
             f"util/stalecodec.split_stamp, which takes the LAST '@' and "
             f"turns non-float/non-finite stamps into no-signal instead "
             f"of a crash or a garbage timestamp")]
+
+    def _check_fence_split(self, module: Module,
+                           node: ast.Call) -> Iterable[Finding]:
+        """An ad-hoc split of a fence-named value on the fence wire
+        separators re-derives the shard-fence codec by hand."""
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _SPLIT_METHODS):
+            return []
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value in (":", "+")):
+            return []
+        receiver = func.value
+        fenceish = False
+        for sub in ast.walk(receiver):
+            if isinstance(sub, ast.Name) and "fence" in sub.id.lower():
+                fenceish = True
+            elif isinstance(sub, ast.Attribute) \
+                    and "fence" in sub.attr.lower():
+                fenceish = True
+            elif isinstance(sub, ast.Constant) \
+                    and isinstance(sub.value, str) \
+                    and "fence" in sub.value.lower():
+                fenceish = True
+        if not fenceish:
+            return []
+        return [Finding(
+            RULE, module.path, node.lineno,
+            f"ad-hoc shard-fence split via "
+            f".{func.attr}({node.args[0].value!r}) — use "
+            f"scheduler/lease.py's parse_fence / parse_fence_epoch "
+            f"(the sole fence codec): a hand split gets the epoch-0 "
+            f"compat form (no '+' suffix) or shard names containing "
+            f"':' wrong")]
 
     def _check_stamp(self, module: Module,
                      node: ast.JoinedStr) -> Iterable[Finding]:

@@ -54,6 +54,7 @@ class RescheduleController:
                  intent_ttl_s: float = consts.DEFAULT_STUCK_GRACE_S,
                  registry=None, intent_scan_every: int = 4,
                  lease_probe=None, cluster_scan_leader=None,
+                 plan_probe=None,
                  clock=time.time):
         self.client = client
         self.node_name = node_name
@@ -66,6 +67,17 @@ class RescheduleController:
         # alone; a stale token is reapable immediately. None (single
         # scheduler) keeps the PR 4 wall-clock rule untouched.
         self.lease_probe = lease_probe
+        # vtscale: ``plan_probe() -> int`` returns the current published
+        # shard-plan epoch (typically a closure over plan.read_plan).
+        # With it, an intent whose fence stamp carries an OLDER epoch is
+        # reapable immediately — its partition was superseded by a
+        # rolling reshard, so its commit-time confirm() can never land
+        # (the new-epoch incarnation CAS-bumped the token), even when
+        # the stamped shard name no longer exists in the new plan and
+        # no lease probe can vouch for it. None (no published plan, or
+        # gate off) = byte-identical pre-vtscale behavior.
+        self.plan_probe = plan_probe
+        self._plan_epoch_cache: int | None = None
         self._clock = clock
         self._lease_states: dict[str, object] = {}
         self.known_uuids = known_uuids or set()
@@ -144,8 +156,10 @@ class RescheduleController:
             return 0
         self.consecutive_failures = 0
         # lease states probed at most once per shard per pass (the
-        # committed list can hold many pods of one shard)
+        # committed list can hold many pods of one shard); the plan
+        # epoch likewise — one probe per pass, not per pod
         self._lease_states: dict[str, object] = {}
+        self._plan_epoch_cache = None
         now = self._clock()
         # registrations only exist for pods allocated (hence bound) on
         # THIS node, so the resident set is the right liveness truth for
@@ -246,9 +260,25 @@ class RescheduleController:
           longer succeed) and the commitment is stale by definition —
           reapable without any wall-clock wait;
         - no usable lease signal (no stamp, probe failed, lease gone) ->
-          the PR 4 wall-clock rule."""
-        fence = lease_mod.parse_fence(
+          the PR 4 wall-clock rule.
+
+        vtscale adds one rule ahead of all of these: a stamp whose plan
+        EPOCH is older than the published plan's is reapable
+        immediately — a rolling reshard fenced that whole partition off,
+        token comparisons within it no longer mean anything."""
+        fence = lease_mod.parse_fence_epoch(
             (anns or {}).get(consts.shard_fence_annotation()))
+        if fence is not None and self.plan_probe is not None \
+                and fence[2] > 0:
+            if self._plan_epoch_cache is None:
+                try:
+                    self._plan_epoch_cache = int(self.plan_probe() or 0)
+                except Exception:
+                    # a failing probe must degrade to the lease/wall-
+                    # clock rules, not block reaping
+                    self._plan_epoch_cache = 0
+            if 0 < fence[2] < self._plan_epoch_cache:
+                return True
         if fence is not None and self.lease_probe is not None:
             if fence[0] not in self._lease_states:
                 self._lease_states[fence[0]] = self.lease_probe(fence[0])

@@ -123,8 +123,17 @@ def routing_rejection(pod: dict, shard: str, why: str) -> None:
 
 def bind_outcome(namespace: str, name: str, node: str,
                  pod_uid: str = "", trace_id: str = "",
-                 error: str = "", shard: str = "") -> None:
-    """The bind verdict joining a decision record to its Binding."""
+                 error: str = "", shard: str = "",
+                 batch: str = "", plan_epoch: int = 0) -> None:
+    """The bind verdict joining a decision record to its Binding.
+
+    ``batch``/``plan_epoch`` (vtscale): a bind committed through the
+    pipelined wave stamps its batch id and the shard-plan epoch it was
+    fenced under, so a ``vtpu_explain --pod`` trail stays per-pod
+    complete — the doctor can name the exact wave (and plan generation)
+    a pod's bind rode without cross-referencing other pods' records.
+    Both default empty/0 and are omitted from the record then, keeping
+    gate-off records byte-identical."""
     if _rec is None:
         return
     import time
@@ -135,6 +144,10 @@ def bind_outcome(namespace: str, name: str, node: str,
            "error": error[:512]}
     if shard:
         rec["shard"] = shard
+    if batch:
+        rec["batch"] = batch
+    if plan_epoch:
+        rec["plan_epoch"] = plan_epoch
     _rec.record(rec)
 
 

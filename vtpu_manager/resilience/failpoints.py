@@ -49,6 +49,12 @@ SITES: dict[str, str] = {
                                "patch, before the assumed-cache insert",
     "scheduler.bind_patch": "bind.py, between the allocating/intent patch "
                             "and the Binding POST (the bind crash window)",
+    "bind.batch": "scheduler/bindpipe.py, after each pod's intent patch "
+                  "lands within a wave and before the wave's single lease "
+                  "confirm (crash = a TORN WAVE: some pods of the batch "
+                  "carry intents, none carry Bindings — the PR 4 reapers "
+                  "must converge every one of them; error = the pod "
+                  "degrades to the serial bind path, never the wave)",
     "snapshot.apply": "snapshot.py apply_event, before decode/apply",
     "plugin.allocate": "vnum.py _allocate_container, inside the Allocate "
                        "try block",

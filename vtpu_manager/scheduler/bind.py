@@ -75,8 +75,55 @@ class BindPredicate:
                 trace_id=anns.get(consts.trace_id_annotation(), ""),
                 error=result.error,
                 shard=getattr(self.fence, "shard", "")
-                if self.fence is not None else "")
+                if self.fence is not None else "",
+                plan_epoch=getattr(self.fence, "epoch", 0)
+                if self.fence is not None else 0)
         return result
+
+    def validate_commitment(self, pod: dict, node: str) -> str:
+        """The pre-Binding checks, shared with the vtscale commit
+        pipeline (scheduler/bindpipe.py): returns an error string, or ""
+        when the pod's pre-allocation matches ``node`` and is fresh."""
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        predicate_node = anns.get(consts.predicate_node_annotation())
+        if not predicate_node:
+            return "pod has no vtpu pre-allocation"
+        if predicate_node != node:
+            # kube-scheduler picked a different node than the filter
+            # committed to; binding there would detach the claim from its
+            # devices (reference :54-142 fails the bind the same way).
+            return (f"predicate node {predicate_node!r} != bind "
+                    f"target {node!r}")
+        ts = consts.parse_predicate_time(anns)
+        # is_fresh also rejects a far-future stamp (skewed filter clock):
+        # trusting it would honor the commitment forever, and re-filtering
+        # is the safe direction
+        if ts and not stalecodec.is_fresh(ts, max_age_s=self.freshness_s):
+            return "pre-allocation expired; re-filter needed"
+        return ""
+
+    def commit_patch(self, pod: dict, node: str) -> dict | None:
+        """The allocating+intent+fence patch for this pod, or None when
+        the plugin already fulfilled the commitment (never downgrade a
+        completed allocation's status back to "allocating"). Shared with
+        the pipeline so batched waves patch the exact serial bytes.
+        Raises LeaseLostError via fence_annotations when leadership
+        cannot be locally proven."""
+        anns = (pod.get("metadata") or {}).get("annotations") or {}
+        if anns.get(consts.real_allocated_annotation()):
+            return None
+        patch = {
+            consts.allocation_status_annotation():
+                consts.ALLOC_STATUS_ALLOCATING,
+            consts.bind_intent_annotation():
+                recovery.encode_bind_intent(node)}
+        if self.fence is not None:
+            # the fencing token rides the same patch: the intent trail
+            # names the leader incarnation (and, under a shard plan, the
+            # plan epoch), so a takeover replay reaps by token, not
+            # guesswork
+            patch.update(self.fence.fence_annotations())
+        return patch
 
     def _bind_locked(self, ns: str, name: str,
                      node: str) -> tuple[BindResult, dict | None]:
@@ -89,24 +136,10 @@ class BindPredicate:
             return BindResult(error=f"pod fetch failed: {e}"), None
         anns = (pod.get("metadata") or {}).get("annotations") or {}
 
-        predicate_node = anns.get(consts.predicate_node_annotation())
-        if not predicate_node:
-            return BindResult(error="pod has no vtpu pre-allocation"), pod
-        if predicate_node != node:
-            # kube-scheduler picked a different node than the filter
-            # committed to; binding there would detach the claim from its
-            # devices (reference :54-142 fails the bind the same way).
-            return BindResult(
-                error=f"predicate node {predicate_node!r} != bind "
-                      f"target {node!r}"), pod
-
+        invalid = self.validate_commitment(pod, node)
+        if invalid:
+            return BindResult(error=invalid), pod
         ts = consts.parse_predicate_time(anns)
-        # is_fresh also rejects a far-future stamp (skewed filter clock):
-        # trusting it would honor the commitment forever, and re-filtering
-        # is the safe direction
-        if ts and not stalecodec.is_fresh(ts, max_age_s=self.freshness_s):
-            return BindResult(
-                error="pre-allocation expired; re-filter needed"), pod
 
         # the bind span carries the filter's commit wall time, so the
         # assembled timeline shows filter-commit -> bind queueing (the
@@ -118,26 +151,14 @@ class BindPredicate:
             try:
                 # the plugin may have fulfilled the commitment BEFORE the
                 # Binding lands (its pending scan accepts predicate-node
-                # pods to bridge watch lag): never downgrade a completed
-                # allocation's status back to "allocating" — just bind
-                already_allocated = bool(
-                    anns.get(consts.real_allocated_annotation()))
-                if not already_allocated:
-                    # the bind-intent rides the SAME patch as the
-                    # allocating status: it is on the apiserver before
-                    # the Binding POST, so a crash in the window below
-                    # leaves a reapable trail (resilience/recovery.py)
-                    # instead of a wedged pod
-                    patch = {
-                        consts.allocation_status_annotation():
-                            consts.ALLOC_STATUS_ALLOCATING,
-                        consts.bind_intent_annotation():
-                            recovery.encode_bind_intent(node)}
-                    if self.fence is not None:
-                        # the fencing token rides the same patch: the
-                        # intent trail names the leader incarnation, so
-                        # a takeover replay reaps by token, not guesswork
-                        patch.update(self.fence.fence_annotations())
+                # pods to bridge watch lag); commit_patch returns None
+                # then — just bind. Otherwise the bind-intent rides the
+                # SAME patch as the allocating status: it is on the
+                # apiserver before the Binding POST, so a crash in the
+                # window below leaves a reapable trail
+                # (resilience/recovery.py) instead of a wedged pod.
+                patch = self.commit_patch(pod, node)
+                if patch is not None:
                     self.policy.run(
                         lambda: self.client.patch_pod_annotations(
                             ns, name, patch),

@@ -1,0 +1,332 @@
+"""vtscale pipelined bind commit: one lease CAS amortized over a wave.
+
+The serial bind path (scheduler/bind.py) spends 3–4 sequential apiserver
+round-trips per pod: GET pod, PATCH allocating+intent+fence, the lease
+``confirm()`` CAS, POST Binding. Under load those round-trips — not
+CPU — are the bind ceiling. This module batches the hot path per shard:
+concurrent extender bind calls coalesce into a **wave** executed by one
+leader thread in three stages:
+
+- **Stage A (concurrent, per pod)**: GET + the exact serial-path checks
+  (``BindPredicate.validate_commitment``) + the exact serial-path
+  allocating+intent+fence patch (``BindPredicate.commit_patch``) —
+  byte-identical patch bytes, issued across the wave by a small thread
+  pool instead of one at a time.
+- **Stage B (once per wave)**: a single ``fence.confirm()`` — the CAS
+  lease renew — for the whole wave.
+- **Stage C (concurrent, per pod)**: the Binding POSTs.
+
+Safety is the PR 6 fencing argument unchanged: every pod's intent+fence
+patch is on the apiserver BEFORE the wave's confirm, and no Binding is
+posted unless that confirm succeeds. A crash anywhere in the window
+leaves per-pod intent trails (never per-wave state) that the PR 4
+reapers and the takeover replay converge pod by pod — a torn wave is
+just N torn serial binds. The ``bind.batch`` failpoint fires inside
+stage A, after each pod's patch, to prove exactly that in chaos runs.
+
+Degradation discipline: any per-pod *fault* (apiserver error, injected
+error, unexpected exception) degrades THAT pod to the serial path after
+the wave's serial sections release — never the wave. Deterministic
+rejections (no pre-allocation, wrong node, expired commitment) return
+the serial path's exact error strings directly. A failed wave confirm
+fails every pod in the wave with the serial path's fence-rejection
+error — their intents are the same reapable trail a serial fence
+rejection leaves.
+
+Same-pod ordering: the wave enters the bind SerialLocker section of
+every pod it carries for the full patch→confirm→bind span (one global
+section when SerialBindNode serializes everything), and a pod appearing
+twice in one wave keeps only its first occurrence — the duplicate
+degrades to the serial path, which queues on the pod's section behind
+the wave.
+
+Gate story (ScalePipeline, default off): this module is never
+constructed; binds run scheduler/bind.py unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from vtpu_manager import explain
+from vtpu_manager.client.kube import KubeError
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.scheduler.bind import BindPredicate, BindResult
+from vtpu_manager.scheduler.lease import LeaseLostError
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+DEFAULT_MAX_WAVE = 32
+DEFAULT_MAX_WAIT_S = 0.002
+DEFAULT_WORKERS = 8
+# a follower gives up on its wave leader (crashed mid-wave, chaos) and
+# converges through the serial path on its own
+FOLLOWER_PATIENCE_S = 5.0
+
+
+class _Waiter:
+    __slots__ = ("ns", "name", "node", "event", "result")
+
+    def __init__(self, ns: str, name: str, node: str):
+        self.ns = ns
+        self.name = name
+        self.node = node
+        self.event = threading.Event()
+        self.result: BindResult | None = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.ns}/{self.name}"
+
+    def finish(self, result: BindResult) -> None:
+        self.result = result
+        self.event.set()
+
+
+class BindCommitPipeline:
+    """Wave-batching front of one shard's BindPredicate.
+
+    Exposes the same ``bind(args) -> BindResult`` surface; callers block
+    until their pod's commit completes (the extender contract is
+    synchronous), but across callers the apiserver traffic is pipelined.
+    """
+
+    def __init__(self, serial: BindPredicate,
+                 max_wave: int = DEFAULT_MAX_WAVE,
+                 max_wait_s: float = DEFAULT_MAX_WAIT_S,
+                 workers: int = DEFAULT_WORKERS,
+                 patience_s: float = FOLLOWER_PATIENCE_S):
+        self.serial = serial
+        self.max_wave = max(1, int(max_wave))
+        self.max_wait_s = max(0.0, float(max_wait_s))
+        self.patience_s = float(patience_s)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, int(workers)),
+            thread_name_prefix="vtpu-bindwave")
+        self._queue: list[_Waiter] = []
+        self._cond = threading.Condition()
+        self._leader = threading.Lock()
+        # counters rendered by render_pipeline_metrics (one home for the
+        # vtpu_bind_wave_* series — metrics-registry rule)
+        self.waves = 0
+        self.wave_pods = 0
+        self.degraded = 0
+        self.confirm_failures = 0
+
+    # -- public surface ------------------------------------------------------
+
+    def bind(self, args: dict) -> BindResult:
+        ns = args.get("PodNamespace") or args.get("podNamespace") or "default"
+        name = args.get("PodName") or args.get("podName") or ""
+        node = args.get("Node") or args.get("node") or ""
+        w = _Waiter(ns, name, node)
+        with self._cond:
+            self._queue.append(w)
+            self._cond.notify_all()
+        deadline = time.monotonic() + self.patience_s
+        while True:
+            if self._leader.acquire(blocking=False):
+                try:
+                    if not w.event.is_set():
+                        self._lead_wave()
+                finally:
+                    self._leader.release()
+            if w.event.wait(0.05):
+                return w.result if w.result is not None else BindResult(
+                    error="bind wave produced no result")
+            if time.monotonic() > deadline:
+                # wave leader died (chaos crash) with our pod possibly
+                # half-committed: the serial path re-patches the same
+                # bytes and converges, exactly like a bind retry
+                self._forget(w)
+                self.degraded += 1
+                return self._serial_bind(w)
+
+    def stats(self) -> dict:
+        return {"waves": self.waves, "wave_pods": self.wave_pods,
+                "degraded": self.degraded,
+                "confirm_failures": self.confirm_failures}
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # -- wave machinery ------------------------------------------------------
+
+    def _forget(self, w: _Waiter) -> None:
+        with self._cond:
+            if w in self._queue:
+                self._queue.remove(w)
+
+    def _serial_bind(self, w: _Waiter) -> BindResult:
+        return self.serial.bind({"PodNamespace": w.ns, "PodName": w.name,
+                                 "Node": w.node})
+
+    def _drain(self) -> list[_Waiter]:
+        """Wait briefly for the wave to fill, then take it."""
+        deadline = time.monotonic() + self.max_wait_s
+        with self._cond:
+            while len(self._queue) < self.max_wave:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cond.wait(timeout=left):
+                    break
+            wave, self._queue = (self._queue[:self.max_wave],
+                                 self._queue[self.max_wave:])
+            return wave
+
+    def _lead_wave(self) -> None:
+        wave = self._drain()
+        if not wave:
+            return
+        self.waves += 1
+        self.wave_pods += len(wave)
+        batch_seq = self.waves
+        fence = self.serial.fence
+        shard = getattr(fence, "shard", "") if fence is not None else ""
+        epoch = getattr(fence, "epoch", 0) if fence is not None else 0
+        batch_id = f"{shard or 'solo'}:w{batch_seq}"
+
+        # first occurrence per pod rides the wave; duplicates degrade to
+        # the serial path, which queues behind the wave's section
+        seen: set[str] = set()
+        unique: list[_Waiter] = []
+        degrade: list[_Waiter] = []
+        for w in wave:
+            if w.key in seen:
+                degrade.append(w)
+            else:
+                seen.add(w.key)
+                unique.append(w)
+
+        done: dict[str, tuple[BindResult, dict | None]] = {}
+        with contextlib.ExitStack() as stack:
+            locker = self.serial.locker
+            if getattr(locker, "_serialize_all", False):
+                # SerialBindNode: one global section covers the wave —
+                # entering it per pod would self-deadlock, and the
+                # gate's semantics (no concurrent bind I/O) still hold
+                stack.enter_context(locker.section())
+            else:
+                for w in unique:
+                    stack.enter_context(locker.section(w.key))
+
+            staged: list[tuple[_Waiter, dict | None]] = []
+            futures = {w: self._pool.submit(self._stage_patch, w)
+                       for w in unique}
+            for w, fut in futures.items():
+                try:
+                    verdict, pod = fut.result()
+                except Exception as e:
+                    # any per-pod fault — apiserver error, lost local
+                    # lease freshness, an injected error — degrades THAT
+                    # pod to the serial path; CrashFailpoint is a
+                    # BaseException and tears the whole wave like a real
+                    # process death would
+                    log.debug("wave %s: pod %s degrades to serial (%s)",
+                              batch_id, w.key, e)
+                    degrade.append(w)
+                    continue
+                if verdict is not None:
+                    done[w.key] = (verdict, pod)       # deterministic
+                else:
+                    staged.append((w, pod))
+
+            confirm_err = ""
+            if fence is not None and staged:
+                try:
+                    # ONE CAS renew fences the whole wave: every staged
+                    # pod's intent+fence patch is already on the
+                    # apiserver, and no Binding below is posted unless
+                    # this succeeds — the serial safety window, amortized
+                    fence.confirm()
+                except LeaseLostError as e:
+                    self.confirm_failures += 1
+                    confirm_err = (f"bind rejected at commit "
+                                   f"(lease fence): {e}")
+
+            if confirm_err:
+                for w, pod in staged:
+                    done[w.key] = (BindResult(error=confirm_err), pod)
+            else:
+                binds = {w: self._pool.submit(self._stage_binding, w)
+                         for w, _pod in staged}
+                pods = dict(staged)
+                for w, fut in binds.items():
+                    try:
+                        fut.result()
+                    except KubeError as e:
+                        done[w.key] = (BindResult(error=f"bind failed: "
+                                                        f"{e}"), pods[w])
+                        continue
+                    done[w.key] = (BindResult(), pods[w])
+
+        for w in unique:
+            if w.key not in done:
+                continue
+            result, pod = done[w.key]
+            self._explain(w, result, pod, batch_id, epoch, shard)
+            w.finish(result)
+        self.degraded += len(degrade)
+        for w in degrade:
+            w.finish(self._serial_bind(w))
+
+    def _stage_patch(self, w: _Waiter
+                     ) -> tuple[BindResult | None, dict | None]:
+        """(deterministic verdict | None, pod). Raises on faults — the
+        caller degrades the pod to the serial path then."""
+        pod = self.serial.policy.run(
+            lambda: self.serial.client.get_pod(w.ns, w.name),
+            op="bind.get_pod")
+        invalid = self.serial.validate_commitment(pod, w.node)
+        if invalid:
+            return BindResult(error=invalid), pod
+        patch = self.serial.commit_patch(pod, w.node)
+        if patch is not None:
+            self.serial.policy.run(
+                lambda: self.serial.client.patch_pod_annotations(
+                    w.ns, w.name, patch),
+                op="bind.patch")
+        uid = (pod.get("metadata") or {}).get("uid", "")
+        failpoints.fire("bind.batch", pod_uid=uid, node=w.node)
+        return None, pod
+
+    def _stage_binding(self, w: _Waiter) -> None:
+        self.serial.policy.run(
+            lambda: self.serial.client.bind_pod(w.ns, w.name, w.node),
+            op="bind.binding")
+
+    def _explain(self, w: _Waiter, result: BindResult, pod: dict | None,
+                 batch_id: str, epoch: int, shard: str) -> None:
+        if not explain.is_enabled():
+            return
+        meta = (pod or {}).get("metadata") or {}
+        anns = meta.get("annotations") or {}
+        explain.bind_outcome(
+            w.ns, w.name, w.node, pod_uid=meta.get("uid", ""),
+            trace_id=anns.get(consts.trace_id_annotation(), ""),
+            error=result.error, shard=shard, batch=batch_id,
+            plan_epoch=epoch)
+
+
+def render_pipeline_metrics(pipelines: list[BindCommitPipeline]) -> str:
+    """The vtpu_bind_wave_* exposition block; "" with no pipelines so
+    the gate-off scrape stays byte-identical."""
+    if not pipelines:
+        return ""
+    waves = sum(p.waves for p in pipelines)
+    pods = sum(p.wave_pods for p in pipelines)
+    degraded = sum(p.degraded for p in pipelines)
+    confirm = sum(p.confirm_failures for p in pipelines)
+    return (
+        "# TYPE vtpu_bind_waves_total counter\n"
+        f"vtpu_bind_waves_total {waves}\n"
+        "# TYPE vtpu_bind_wave_pods_total counter\n"
+        f"vtpu_bind_wave_pods_total {pods}\n"
+        "# TYPE vtpu_bind_wave_degraded_total counter\n"
+        f"vtpu_bind_wave_degraded_total {degraded}\n"
+        "# TYPE vtpu_bind_wave_confirm_failures_total counter\n"
+        f"vtpu_bind_wave_confirm_failures_total {confirm}\n")

@@ -402,10 +402,16 @@ class FilterPredicate:
 
     # -- entry --------------------------------------------------------------
 
-    def filter(self, args: dict) -> FilterResult:
+    def filter(self, args: dict, fence_override=None) -> FilterResult:
+        # fence_override (vtscale cross-shard gang spill): the OWNER
+        # shard's lease, when this predicate runs a spill pass on behalf
+        # of a neighboring shard — the commitment must carry the owning
+        # leader's fence, not this shard's (ScalePipeline gate; None =
+        # byte-identical)
         pod = args.get("Pod") or args.get("pod") or {}
+        fence = fence_override if fence_override is not None else self.fence
         if self._snapshot is not None:
-            return self._filter_snapshot(args, pod)
+            return self._filter_snapshot(args, pod, fence)
         nodes = self._candidate_nodes(args)
         try:
             req = build_allocation_request(pod)
@@ -428,10 +434,12 @@ class FilterPredicate:
                 # takes _serial_lock, so nothing can deadlock on it.
                 with self._serial_lock:
                     # vtlint: disable=lock-discipline — see above
-                    return self._filter_locked(pod, req, nodes)
-            return self._filter_locked(pod, req, nodes)
+                    return self._filter_locked(pod, req, nodes,
+                                               fence=fence)
+            return self._filter_locked(pod, req, nodes, fence=fence)
 
-    def _filter_snapshot(self, args: dict, pod: dict) -> FilterResult:
+    def _filter_snapshot(self, args: dict, pod: dict,
+                         fence=None) -> FilterResult:
         """SchedulerSnapshot entry: same pass, fed from the watch-driven
         snapshot instead of TTL LISTs. The snapshot pump is its own trace
         stage so apply-lag is attributable per pod."""
@@ -478,10 +486,10 @@ class FilterPredicate:
                 with self._serial_lock:
                     # vtlint: disable=lock-discipline — see above
                     result = self._filter_locked(pod, req, candidates,
-                                                 snap=snap)
+                                                 snap=snap, fence=fence)
             else:
                 result = self._filter_locked(pod, req, candidates,
-                                             snap=snap)
+                                             snap=snap, fence=fence)
         for name in missing:
             result.failed_nodes.setdefault(
                 name, "node not yet in scheduler snapshot")
@@ -548,7 +556,7 @@ class FilterPredicate:
         return out
 
     def _filter_locked(self, pod: dict, req: AllocationRequest,
-                       nodes: list, snap=None) -> FilterResult:
+                       nodes: list, snap=None, fence=None) -> FilterResult:
         """One pass. ``nodes`` carries node dicts on the TTL path and
         snapshot NodeEntry objects when ``snap`` is set; both converge on
         the same ranked tuples, so ordering/allocation/commit below are
@@ -563,7 +571,7 @@ class FilterPredicate:
         # only (zero I/O) — the decision hot path never pays disk.
         explain_b = explain.pass_builder(
             pod, "snapshot" if snap is not None else "ttl",
-            fence=self.fence)
+            fence=fence)
         if explain_b is not None:
             explain_b.set_request(req)
 
@@ -703,7 +711,7 @@ class FilterPredicate:
         ordered = order_nodes(scored)
         best = ordered[0]
         try:
-            self._commit(pod, req, best)
+            self._commit(pod, req, best, fence)
         except LeaseLostError as e:
             # vtha: the shard lease expired (or was taken over) between
             # pass start and commit — the pass must fail WITHOUT writing
@@ -1056,8 +1064,9 @@ class FilterPredicate:
                 if visited >= self.candidate_limit and scored:
                     break
                 visit(entry)
-        rank = snap.rank_items()
-        for _key, name in (reversed(rank) if spread else rank):
+        # lazy rank walk (vtscale): a head-limited pass visits
+        # candidate_limit items without materializing the 50k-node rank
+        for _key, name in snap.rank_walk(reverse=spread):
             if visited >= self.candidate_limit and scored:
                 break
             if name in gang_names:
@@ -1232,18 +1241,20 @@ class FilterPredicate:
     # -- commit: annotation patch is the only cross-process channel ---------
 
     def _commit(self, pod: dict, req: AllocationRequest,
-                best: ScoredNode) -> None:
+                best: ScoredNode, fence=None) -> None:
         meta = pod.get("metadata") or {}
         anns = {
             consts.pre_allocated_annotation(): best.result.claims.encode(),
             consts.predicate_node_annotation(): best.name,
             consts.predicate_time_annotation(): str(time.time()),
         }
-        if self.fence is not None:
+        if fence is not None:
             # the fencing token rides the SAME patch as the commitment:
             # every pre-allocation names the leader incarnation that made
-            # it, and a locally expired lease raises before any write
-            anns.update(self.fence.fence_annotations())
+            # it (on a spill pass, the OWNER shard's leader — not the
+            # shard whose nodes are being committed), and a locally
+            # expired lease raises before any write
+            anns.update(fence.fence_annotations())
         if req.gang_name:
             origin = gang.chosen_origin(best.result.node_info,
                                         best.result.claims)

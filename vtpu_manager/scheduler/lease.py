@@ -84,24 +84,51 @@ def lease_object_name(shard: str) -> str:
     return f"vtpu-scheduler-{shard}"
 
 
-def encode_fence(shard: str, token: int) -> str:
-    """The pod-annotation stamp: ``<shard>:<token>``."""
+def encode_fence(shard: str, token: int, epoch: int = 0) -> str:
+    """The pod-annotation stamp: ``<shard>:<token>`` — or, when the
+    commitment was made under a vtscale shard *plan* (ScalePipeline gate,
+    scheduler/plan.py), ``<shard>:<token>+<epoch>``. Epoch 0 (gate off,
+    or no plan published) emits the exact historical two-field form, so
+    the gate-off wire bytes are unchanged. This module is the ONLY
+    encoder/decoder of the fence wire form — reapers and routers must go
+    through parse_fence/parse_fence_epoch, never ad-hoc splits (the
+    stalecodec lint rule enforces this)."""
+    if epoch:
+        return f"{shard}:{token}+{epoch}"
     return f"{shard}:{token}"
 
 
 def parse_fence(value: str | None) -> tuple[str, int] | None:
     """(shard, token) or None for absent/malformed — garbage reads as
     absent, same posture as parse_bind_intent (a reaper must never act
-    on a stamp it cannot interpret)."""
+    on a stamp it cannot interpret). Epoch-suffixed stamps parse to the
+    same (shard, token) pair: consumers that predate plans keep working
+    and judge staleness by token alone."""
+    full = parse_fence_epoch(value)
+    if full is None:
+        return None
+    return full[0], full[1]
+
+
+def parse_fence_epoch(value: str | None) -> tuple[str, int, int] | None:
+    """(shard, token, epoch) or None. Stamps without an epoch suffix —
+    every stamp written before vtscale, and every stamp written with the
+    gate off — read as epoch 0, which no plan ever rejects (plan epochs
+    start at 1)."""
     if not value:
         return None
     shard, sep, raw = value.rpartition(":")
     if not sep or not shard:
         return None
+    raw, plus, raw_epoch = raw.partition("+")
     try:
-        return shard, int(raw)
+        token = int(raw)
+        epoch = int(raw_epoch) if plus else 0
     except ValueError:
         return None
+    if epoch < 0:
+        return None
+    return shard, token, epoch
 
 
 @dataclass
@@ -174,12 +201,21 @@ class ShardLease:
                  namespace: str = DEFAULT_LEASE_NAMESPACE,
                  policy: RetryPolicy | None = None,
                  monotonic: Callable[[], float] = time.monotonic,
-                 wall: Callable[[], float] = time.time):
+                 wall: Callable[[], float] = time.time,
+                 object_name: str | None = None):
         self.client = client
         self.shard = shard
         self.holder = holder
         self.ttl_s = ttl_s
         self.namespace = namespace
+        # the apiserver Lease object backing this election. Defaults to
+        # the per-shard scheduler name; the webhook HA election reuses
+        # this class with its own object (WebhookHA gate).
+        self.object_name = object_name or lease_object_name(shard)
+        # plan epoch folded into fence stamps (vtscale). 0 = no plan:
+        # fence_annotations emits the historical two-field form. The
+        # ShardedScheduler sets this when a shard plan is adopted.
+        self.epoch = 0
         # lease traffic is light (one renew per ttl/3 per shard) but must
         # absorb throttling blips; conflicts (409) are terminal for the
         # policy and classified here
@@ -221,7 +257,7 @@ class ShardLease:
                 f"shard {self.shard}: lease not held fresh "
                 f"(held={self.held})")
         return {consts.shard_fence_annotation():
-                encode_fence(self.shard, self.token)}
+                encode_fence(self.shard, self.token, self.epoch)}
 
     # -- acquisition / renewal (CAS through the apiserver) ------------------
 
@@ -245,7 +281,7 @@ class ShardLease:
         try:
             lease = self.policy.run(
                 lambda: self.client.get_lease(
-                    self.namespace, lease_object_name(self.shard)),
+                    self.namespace, self.object_name),
                 op="lease.get")
         except KubeError as e:
             if e.status == 404:
@@ -278,7 +314,7 @@ class ShardLease:
             try:
                 created = self.policy.run(
                     lambda: self.client.create_lease(
-                        self.namespace, lease_object_name(self.shard),
+                        self.namespace, self.object_name,
                         self._annotations(1)),
                     op="lease.create")
             except KubeError as e:
@@ -322,7 +358,7 @@ class ShardLease:
         try:
             updated = self.policy.run(
                 lambda: self.client.update_lease(
-                    self.namespace, lease_object_name(self.shard),
+                    self.namespace, self.object_name,
                     self._annotations(token), version),
                 op="lease.cas")
         except KubeError as e:
@@ -355,7 +391,7 @@ class ShardLease:
             try:
                 updated = self.policy.run(
                     lambda: self.client.update_lease(
-                        self.namespace, lease_object_name(self.shard),
+                        self.namespace, self.object_name,
                         self._annotations(self.token), self._version),
                     op="lease.renew")
             except KubeError as e:
@@ -412,7 +448,7 @@ class ShardLease:
             try:
                 self.policy.run(
                     lambda: self.client.update_lease(
-                        self.namespace, lease_object_name(self.shard),
+                        self.namespace, self.object_name,
                         anns, self._version),
                     op="lease.release")
             except KubeError as e:

@@ -1,0 +1,156 @@
+"""vtscale dynamic shard plans: the cluster's shard layout as a CAS'd
+apiserver object instead of a per-replica flag.
+
+Before this module, the shard layout lived only in each replica's
+``--shard-pools`` argv: changing it meant restarting every scheduler
+replica, and a half-rolled fleet ran two layouts at once with nothing to
+arbitrate between them. The plan object fixes both:
+
+- **One authoritative layout.** A single Lease object
+  (``vtpu-scheduler-plan`` in the lease namespace) carries the
+  ``--shard-pools`` spec string and a monotonically increasing **plan
+  epoch** in its annotations, CAS'd through ``metadata.resourceVersion``
+  exactly like the shard leader leases (scheduler/lease.py). Publishing
+  the same spec twice is a no-op; publishing a different spec bumps the
+  epoch by one.
+
+- **Rolling reshard, fenced.** Every replica polls the plan on its
+  maintenance tick. On an epoch bump it rebuilds its shard units to the
+  new layout in place — no restart — and folds the new epoch into every
+  fence stamp it writes (``<shard>:<token>+<epoch>``,
+  lease.encode_fence). Commitments stamped under an older epoch are
+  thereby *fence-rejected exactly like a stale leader's*: the takeover
+  replay and the reschedule controller's reaper treat epoch-stale stamps
+  as reapable trails, and the bind path refuses to post a Binding for
+  them. The safety argument is the PR 6 fencing argument unchanged —
+  the epoch is just a second monotone component in the same stamp.
+
+The spec annotation body rides the shared ``…@ts`` staleness codec
+(util/stalecodec.py) so operators can see *when* the layout last moved;
+the epoch — not the stamp — is the authority (a plan never expires, it
+is only superseded).
+
+Gate story (ScalePipeline, default off): no plan object is created or
+read, every fence stamp keeps the two-field form, and `--shard-pools`
+behaves exactly as before.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+
+from vtpu_manager.client.kube import KubeClient, KubeError
+from vtpu_manager.util import stalecodec
+
+log = logging.getLogger(__name__)
+
+PLAN_OBJECT_NAME = "vtpu-scheduler-plan"
+
+# Plan annotation keys (protocol state in annotations, resourceVersion
+# as the CAS handle — the ShardLease pattern)
+PLAN_SPEC_ANN = "vtpu-manager.io/plan-spec"
+PLAN_EPOCH_ANN = "vtpu-manager.io/plan-epoch"
+PLAN_HOLDER_ANN = "vtpu-manager.io/plan-holder"
+
+# one publish retry on CAS conflict: the loser re-reads and either
+# adopts the winner's identical spec or re-CASes on top of it
+_PUBLISH_ATTEMPTS = 3
+
+
+@dataclass(frozen=True)
+class PlanState:
+    """Decoded view of the cluster shard plan, as any replica reads it."""
+
+    epoch: int
+    spec: str          # the --shard-pools grammar (shard.ShardPlan.parse)
+    published_ts: float
+    holder: str
+
+
+def encode_plan_annotations(spec: str, epoch: int, holder: str,
+                            ts: float) -> dict:
+    return {
+        PLAN_SPEC_ANN: stalecodec.stamp(spec, ts),
+        PLAN_EPOCH_ANN: str(epoch),
+        PLAN_HOLDER_ANN: holder,
+    }
+
+
+def decode_plan(lease: dict | None) -> PlanState | None:
+    """PlanState from the plan object; None when absent or garbage.
+    An undecodable plan reads as no-plan — replicas keep their argv
+    layout at epoch 0 rather than guessing at a corrupt one."""
+    if lease is None:
+        return None
+    anns = (lease.get("metadata") or {}).get("annotations") or {}
+    stamped = stalecodec.split_stamp(anns.get(PLAN_SPEC_ANN))
+    if stamped is None:
+        return None
+    spec, ts = stamped
+    try:
+        epoch = int(anns.get(PLAN_EPOCH_ANN, ""))
+    except (TypeError, ValueError):
+        return None
+    if not spec or epoch < 1:
+        return None
+    return PlanState(epoch=epoch, spec=spec, published_ts=ts,
+                     holder=anns.get(PLAN_HOLDER_ANN, ""))
+
+
+def read_plan(client: KubeClient, namespace: str) -> PlanState | None:
+    """One-shot plan probe. None means "no usable plan" — absent,
+    undecodable, or the read failed transiently — and the caller keeps
+    its current layout (argv at epoch 0, or the last adopted plan)."""
+    try:
+        lease = client.get_lease(namespace, PLAN_OBJECT_NAME)
+    except KubeError as e:
+        if e.status != 404:
+            log.warning("plan read failed (%s); keeping current layout",
+                        e)
+        return None
+    return decode_plan(lease)
+
+
+def publish_plan(client: KubeClient, spec: str, holder: str,
+                 namespace: str, now: float | None = None) -> PlanState:
+    """Publish ``spec`` as the cluster shard plan, bumping the epoch iff
+    the spec actually changed. Idempotent and CAS-safe: concurrent
+    publishers of the same spec converge on one epoch; of different
+    specs, on the last CAS winner. Raises KubeError when the apiserver
+    stays unreachable."""
+    if now is None:
+        now = time.time()
+    last_err: KubeError | None = None
+    for _ in range(_PUBLISH_ATTEMPTS):
+        try:
+            lease = client.get_lease(namespace, PLAN_OBJECT_NAME)
+        except KubeError as e:
+            if e.status != 404:
+                raise
+            lease = None
+        current = decode_plan(lease)
+        if current is not None and current.spec == spec:
+            return current
+        epoch = (current.epoch if current is not None else 0) + 1
+        anns = encode_plan_annotations(spec, epoch, holder, now)
+        try:
+            if lease is None:
+                client.create_lease(namespace, PLAN_OBJECT_NAME, anns)
+            else:
+                version = (lease.get("metadata") or {}).get(
+                    "resourceVersion", "")
+                client.update_lease(namespace, PLAN_OBJECT_NAME, anns,
+                                    version)
+        except KubeError as e:
+            if e.status == 409:
+                last_err = e
+                continue       # lost the race; re-read and re-judge
+            raise
+        log.info("shard plan published: epoch=%d spec=%r by %s",
+                 epoch, spec, holder)
+        return PlanState(epoch=epoch, spec=spec, published_ts=now,
+                         holder=holder)
+    raise last_err if last_err is not None else KubeError(
+        409, "plan publish kept conflicting")

@@ -28,7 +28,7 @@ class SchedulerAPI:
     def __init__(self, filter_pred: FilterPredicate, bind_pred: BindPredicate,
                  preempt_pred: PreemptPredicate,
                  debug_endpoints: bool = False,
-                 snapshot=None, ha=None,
+                 snapshot=None, ha=None, pipeline=None,
                  explain_dir: str | None = None,
                  explain_token_file: str | None = None):
         self.filter_pred = filter_pred
@@ -50,6 +50,11 @@ class SchedulerAPI:
         # above are then its routing facade); /metrics grows the
         # per-shard leader/token/handoff block and each shard's snapshot
         self.ha = ha
+        # ScalePipeline gate, non-HA branch: the BindCommitPipeline
+        # fronting bind_pred (bind_pred IS the pipeline then); /metrics
+        # grows its wave counters. Under vtha the pipelines are
+        # per-shard and render through render_ha_metrics instead.
+        self.pipeline = pipeline
         self.stats = {"filter": 0, "bind": 0, "preempt": 0, "errors": 0}
         self._started = time.time()
 
@@ -172,6 +177,12 @@ class SchedulerAPI:
             lines.append(
                 f'vtpu_scheduler_requests_total{{endpoint="{k}"}} {v}')
         breakers = []
+        if self.pipeline is not None:
+            from vtpu_manager.scheduler.bindpipe import \
+                render_pipeline_metrics
+            block = render_pipeline_metrics([self.pipeline])
+            if block:
+                lines.append(block.rstrip("\n"))
         if self.ha is not None:
             # vtha: per-shard leadership, fencing tokens, handoffs, reaps
             lines.append(self.ha.render_ha_metrics())

@@ -48,7 +48,10 @@ from vtpu_manager.config.vmem import fnv64
 from vtpu_manager.resilience import failpoints, recovery
 from vtpu_manager.resilience.policy import RetryPolicy
 from vtpu_manager.scheduler import lease as lease_mod
+from vtpu_manager.scheduler import plan as plan_mod
 from vtpu_manager.scheduler.bind import BindPredicate, BindResult
+from vtpu_manager.scheduler.bindpipe import (BindCommitPipeline,
+                                             render_pipeline_metrics)
 from vtpu_manager.scheduler.filter import FilterPredicate, FilterResult
 from vtpu_manager.scheduler.lease import LeaseLostError, ShardLease
 from vtpu_manager.scheduler.preempt import PreemptPredicate, PreemptResult
@@ -161,13 +164,17 @@ class ShardUnit:
     def __init__(self, spec: ShardSpec, lease: ShardLease,
                  snapshot: ClusterSnapshot | None,
                  filter_pred: FilterPredicate, bind_pred: BindPredicate,
-                 preempt_pred: PreemptPredicate):
+                 preempt_pred: PreemptPredicate,
+                 pipeline: BindCommitPipeline | None = None):
         self.spec = spec
         self.lease = lease
         self.snapshot = snapshot
         self.filter_pred = filter_pred
         self.bind_pred = bind_pred
         self.preempt_pred = preempt_pred
+        # vtscale (ScalePipeline gate; None = serial binds, byte-
+        # identical): the shard's wave-batched commit pipeline
+        self.pipeline = pipeline
         # takeover replay completed under the current token; reset on
         # every acquisition so a re-acquired shard replays again. The
         # lock keeps the tick thread and an opportunistic request-path
@@ -177,6 +184,8 @@ class ShardUnit:
         self.handoffs = 0
         self.takeover_reaps = 0
         self.fence_rejections = 0
+        # gangs this shard placed on a neighbor's nodes (vtscale spill)
+        self.spills = 0
 
 
 class ShardedScheduler:
@@ -196,6 +205,9 @@ class ShardedScheduler:
                  preempt_kwargs: dict | None = None,
                  policy_factory=None, snapshot_factory=None,
                  bind_locker=None,
+                 scale_pipeline: bool = False,
+                 pipeline_kwargs: dict | None = None,
+                 plan_spec: str = "", plan_epoch: int = 0,
                  monotonic=time.monotonic, wall=time.time):
         self.client = client
         self.plan = plan
@@ -203,55 +215,92 @@ class ShardedScheduler:
         self.lease_ttl_s = lease_ttl_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        make_policy = policy_factory or (lambda: None)
-        filter_kwargs = dict(filter_kwargs or {})
+        # everything a unit is built from is kept on self, because
+        # vtscale plan adoption rebuilds the whole unit list at a new
+        # epoch (no process restart)
+        self._lease_namespace = lease_namespace
+        self._use_snapshot = use_snapshot
+        self._make_policy = policy_factory or (lambda: None)
+        self._filter_kwargs = dict(filter_kwargs or {})
         # preempt_kwargs rides exactly like filter_kwargs so the
         # vtexplain victim-order hint reaches every shard's predicate
-        preempt_kwargs = dict(preempt_kwargs or {})
-        self.units: list[ShardUnit] = []
+        self._preempt_kwargs = dict(preempt_kwargs or {})
+        self._snapshot_factory = snapshot_factory
+        self._bind_locker = bind_locker
+        self._monotonic = monotonic
+        self._wall = wall
+        # vtscale (ScalePipeline gate, resolved by the caller): wave-
+        # batched bind commits, the published shard plan with its epoch,
+        # cross-shard gang spill. All defaults = byte-identical vtha.
+        self.scale_pipeline = bool(scale_pipeline)
+        self._pipeline_kwargs = dict(pipeline_kwargs or {})
+        self.plan_spec = plan_spec
+        self.plan_epoch = int(plan_epoch)
+        self._started = False
+        self._snapshot_poll_s = 1.0
+        self.units: list[ShardUnit] = self._build_units(plan,
+                                                        self.plan_epoch)
+        # takeover replay pages through the cluster pod list; keep its
+        # own retry budget (it runs on the tick thread, not a request)
+        self._replay_policy = self._make_policy() or RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, deadline_s=5.0)
+
+    def _build_units(self, plan: ShardPlan,
+                     epoch: int) -> list[ShardUnit]:
+        # One ShardUnit per shard of the given plan, fence-stamping the
+        # given epoch. Selectors close over the plan ARGUMENT (never
+        # self.plan) so units built for a new epoch cannot read the old
+        # partition mid-swap.
+        units: list[ShardUnit] = []
         for spec in plan.shards:
-            lease = ShardLease(client, spec.name, holder,
-                               ttl_s=lease_ttl_s,
-                               namespace=lease_namespace,
-                               policy=make_policy(),
-                               monotonic=monotonic, wall=wall)
-            selector = self._shard_selector(spec)
+            lease = ShardLease(self.client, spec.name, self.holder,
+                               ttl_s=self.lease_ttl_s,
+                               namespace=self._lease_namespace,
+                               policy=self._make_policy(),
+                               monotonic=self._monotonic,
+                               wall=self._wall)
+            # the plan epoch folds into every fence this lease stamps
+            # (epoch 0 emits no suffix — byte-identical pre-plan wire)
+            lease.epoch = epoch
+            selector = self._shard_selector(plan, spec)
             snapshot = None
-            if use_snapshot:
+            if self._use_snapshot:
                 node_selector = (
-                    lambda node, s=spec: s.owns_labels(
+                    lambda node, s=spec, p=plan: s.owns_labels(
                         (node.get("metadata") or {}).get("labels") or {},
-                        self.plan.named_pools))
-                if snapshot_factory is not None:
+                        p.named_pools))
+                if self._snapshot_factory is not None:
                     # test hook: the chaos harness injects snapshots with
                     # forgiving breakers / fast policies
-                    snapshot = snapshot_factory(node_selector)
+                    snapshot = self._snapshot_factory(node_selector)
                 else:
                     snapshot = ClusterSnapshot(self.client,
                                                node_selector=node_selector)
             filter_pred = FilterPredicate(
-                client, snapshot=snapshot, fence=lease,
+                self.client, snapshot=snapshot, fence=lease,
                 shard_selector=selector,
-                policy=make_policy(), **filter_kwargs)
+                policy=self._make_policy(), **self._filter_kwargs)
             # bind_locker is shared across shards on purpose: the
             # SerialBindNode gate promises GLOBAL bind ordering in this
             # process, and shard boundaries must not weaken it
-            bind_pred = BindPredicate(client, locker=bind_locker,
+            bind_pred = BindPredicate(self.client,
+                                      locker=self._bind_locker,
                                       fence=lease,
-                                      policy=make_policy())
-            preempt_pred = PreemptPredicate(client, snapshot=snapshot,
-                                            **preempt_kwargs)
-            self.units.append(ShardUnit(spec, lease, snapshot,
-                                        filter_pred, bind_pred,
-                                        preempt_pred))
-        # takeover replay pages through the cluster pod list; keep its
-        # own retry budget (it runs on the tick thread, not a request)
-        self._replay_policy = make_policy() or RetryPolicy(
-            max_attempts=3, base_delay_s=0.05, deadline_s=5.0)
+                                      policy=self._make_policy())
+            preempt_pred = PreemptPredicate(self.client,
+                                            snapshot=snapshot,
+                                            **self._preempt_kwargs)
+            pipeline = None
+            if self.scale_pipeline:
+                pipeline = BindCommitPipeline(bind_pred,
+                                              **self._pipeline_kwargs)
+            units.append(ShardUnit(spec, lease, snapshot,
+                                   filter_pred, bind_pred,
+                                   preempt_pred, pipeline=pipeline))
+        return units
 
-    def _shard_selector(self, spec: ShardSpec):
-        return lambda labels: spec.owns_labels(labels,
-                                               self.plan.named_pools)
+    def _shard_selector(self, plan: ShardPlan, spec: ShardSpec):
+        return lambda labels: spec.owns_labels(labels, plan.named_pools)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -260,6 +309,8 @@ class ShardedScheduler:
         """Production entry: seed + background-watch every shard snapshot
         (hot standby keeps them warm even for shards we don't lead) and
         run the lease tick on a daemon thread (default cadence ttl/3)."""
+        self._started = True
+        self._snapshot_poll_s = snapshot_poll_s
         for unit in self.units:
             if unit.snapshot is not None:
                 unit.snapshot.start_background(poll_s=snapshot_poll_s)
@@ -282,6 +333,8 @@ class ShardedScheduler:
         for unit in self.units:
             if unit.snapshot is not None:
                 unit.snapshot.stop_background()
+            if unit.pipeline is not None:
+                unit.pipeline.shutdown()
             if unit.lease.held:
                 unit.lease.release()
 
@@ -292,8 +345,74 @@ class ShardedScheduler:
         try to acquire what is free/expired, replay after acquisition.
         Deterministic and thread-free by itself — the chaos harness
         drives it directly."""
+        self._check_plan()
         for unit in self.units:
             self._maintain(unit)
+
+    # -- dynamic shard plans (vtscale) --------------------------------------
+
+    def _check_plan(self) -> None:
+        """Adopt a newer published shard plan, rolling. Old-epoch units
+        are torn down AFTER the new ones are routable; their in-flight
+        binds die safely at the commit fence — building new ShardLease
+        objects for the same shard names takes the same-holder/new-
+        incarnation acquisition path, which CAS-bumps the token, so an
+        old unit's confirm() 409s exactly like a fenced-off ex-leader.
+        Commitments stamped with the old epoch are reaped by takeover
+        replay (below) and by the reschedule controller's intent reaper
+        — no replica restart, no dropped or doubled placement."""
+        if not self.scale_pipeline:
+            return
+        state = plan_mod.read_plan(self.client,
+                                   namespace=self._lease_namespace)
+        if state is None or state.epoch <= self.plan_epoch:
+            return
+        if state.spec == self.plan_spec:
+            # same partition republished at a higher epoch: advance the
+            # fence stamps in place, keep the units
+            self.plan_epoch = state.epoch
+            for unit in self.units:
+                unit.lease.epoch = state.epoch
+            return
+        self._adopt_plan(state)
+
+    def _adopt_plan(self, state) -> None:
+        try:
+            new_plan = ShardPlan.parse(state.spec)
+        except ValueError as e:
+            log.error("vtscale: published plan epoch %d unparseable "
+                      "(%s); staying on epoch %d", state.epoch, e,
+                      self.plan_epoch)
+            return
+        log.warning("vtscale: adopting shard plan epoch %d (spec %r, "
+                    "was epoch %d)", state.epoch, state.spec,
+                    self.plan_epoch)
+        old_units = self.units
+        new_units = self._build_units(new_plan, state.epoch)
+        # swap order matters: the new routing must be in place before
+        # the old units lose their snapshots, so a request arriving
+        # mid-adoption sees a complete plan (worst case it bounces off
+        # a not-yet-acquired lease and retries onto the leader)
+        self.plan = new_plan
+        self.plan_spec = state.spec
+        self.plan_epoch = state.epoch
+        self.units = new_units
+        if self._started:
+            for unit in new_units:
+                if unit.snapshot is not None:
+                    unit.snapshot.start_background(
+                        poll_s=self._snapshot_poll_s)
+        for unit in new_units:
+            self._maintain(unit)
+        for unit in old_units:
+            if unit.snapshot is not None:
+                unit.snapshot.stop_background()
+            if unit.pipeline is not None:
+                unit.pipeline.shutdown()
+        # old leases are NOT released: shard names shared with the new
+        # plan were already taken over by the token bump above, and
+        # names the new plan dropped just expire by TTL — releasing
+        # here would race the new incarnation's record
 
     def _maintain(self, unit: ShardUnit) -> None:
         lease = unit.lease
@@ -357,10 +476,17 @@ class ShardedScheduler:
         for pod in pods:
             meta = pod.get("metadata") or {}
             anns = meta.get("annotations") or {}
-            fence = lease_mod.parse_fence(
+            fence = lease_mod.parse_fence_epoch(
                 anns.get(consts.shard_fence_annotation()))
-            if fence is None or fence[0] != unit.spec.name \
-                    or fence[1] >= my_token:
+            if fence is None or fence[0] != unit.spec.name:
+                continue
+            # vtscale: a commitment stamped under an older plan epoch
+            # belonged to a superseded partition — fence-reject it like
+            # a stale leader's even when its token reads current. (Old-
+            # epoch stamps naming shards the new plan dropped entirely
+            # are the reschedule controller's intent reaper's job.)
+            stale_epoch = 0 < fence[2] < self.plan_epoch
+            if fence[1] >= my_token and not stale_epoch:
                 continue
             if anns.get(consts.real_allocated_annotation()):
                 continue
@@ -446,7 +572,51 @@ class ShardedScheduler:
             # DecisionExplain gate is off)
             explain.routing_rejection(pod, unit.spec.name, why)
             return FilterResult(error=why)
-        return unit.filter_pred.filter(args)
+        result = unit.filter_pred.filter(args)
+        if self.scale_pipeline and result.error:
+            spilled = self._spill_filter(args, pod, unit)
+            if spilled is not None:
+                return spilled
+        return result
+
+    def _spill_filter(self, args: dict, pod: dict,
+                      owner: ShardUnit) -> FilterResult | None:
+        """vtscale cross-shard gang spill: a gang member its home shard
+        cannot place may land on a neighbor shard's nodes — chosen by
+        the O(1) capacity digest, committed under the OWNER shard's
+        lease + fence (fence_override), so ownership, bind routing and
+        takeover replay all still follow the stamp. The digest only
+        nominates; the neighbor's filter pass re-validates real
+        capacity against its snapshot. None = no spill (caller returns
+        the owner's verdict unchanged)."""
+        gang, _ = resolve_gang_name(pod)
+        if not gang:
+            return None
+        candidates = []
+        for unit in self.units:
+            if unit is owner or unit.snapshot is None:
+                continue
+            nodes, key_sum = unit.snapshot.capacity_digest()
+            if nodes:
+                # rank_key is the filter's free-capacity scalar; its
+                # shard-wide sum orders neighbors by headroom
+                candidates.append((key_sum, unit.spec.index, unit))
+        candidates.sort(reverse=True)
+        for _key_sum, _idx, unit in candidates[:2]:
+            try:
+                result = unit.filter_pred.filter(
+                    args, fence_override=owner.lease)
+            except LeaseLostError:
+                # the owner's lease died between the serving check and
+                # the spill commit — the pod re-enters scheduling
+                return None
+            if not result.error:
+                owner.spills += 1
+                log.info("vtscale: gang %s spilled from shard %s to "
+                         "shard %s nodes", gang, owner.spec.name,
+                         unit.spec.name)
+                return result
+        return None
 
     def _unit_for_node(self, node_name: str) -> ShardUnit | None:
         """Owning unit by bind-target node. The filter only places a pod
@@ -469,6 +639,7 @@ class ShardedScheduler:
         name = args.get("PodName") or args.get("podName") or ""
         node = args.get("Node") or args.get("node") or ""
         unit = self._unit_for_node(node)
+        pod = None
         if unit is None:
             # TTL mode / watch lag: route by the pod's fence stamp (one
             # GET; BindPredicate re-fetches inside its serial section for
@@ -480,9 +651,28 @@ class ShardedScheduler:
                     error=f"pod fetch failed routing bind: {e}")
             unit = self.unit_for_pod(pod)
         why = self._serving(unit)
+        if why is not None and self.scale_pipeline:
+            # a spilled gang member binds onto a NEIGHBOR shard's node:
+            # the node lookup names the neighbor, but the commitment's
+            # fence stamp names the owner — re-route by the stamp before
+            # rejecting (the owner's lease covers the spilled bind)
+            if pod is None:
+                try:
+                    pod = self.client.get_pod(ns, name)
+                except KubeError:
+                    pod = None
+            if pod is not None:
+                owner = self.unit_for_pod(pod)
+                if owner is not unit:
+                    unit = owner
+                    why = self._serving(unit)
         if why is not None:
             unit.fence_rejections += 1
             return BindResult(error=why)
+        if unit.pipeline is not None:
+            # vtscale: wave-batched commit — per-pod serial sections and
+            # verdicts preserved, one lease confirm per wave
+            return unit.pipeline.bind(args)
         return unit.bind_pred.bind(args)
 
     def preempt(self, args: dict) -> PreemptResult:
@@ -527,4 +717,16 @@ class ShardedScheduler:
                         f'vtpu_ha_shard_snapshot_staleness_seconds'
                         f'{{shard="{unit.spec.name}"}} '
                         f"{unit.snapshot.staleness_s():.6f}")
+        if self.scale_pipeline:
+            lines.append("# TYPE vtpu_scale_plan_epoch gauge")
+            lines.append(f"vtpu_scale_plan_epoch {self.plan_epoch}")
+            lines.append("# TYPE vtpu_scale_spills_total counter")
+            for unit in self.units:
+                lines.append(f'vtpu_scale_spills_total{{shard='
+                             f'"{unit.spec.name}"}} {unit.spills}')
+            pipe_block = render_pipeline_metrics(
+                [u.pipeline for u in self.units
+                 if u.pipeline is not None])
+            if pipe_block:
+                lines.append(pipe_block)
         return "\n".join(lines)

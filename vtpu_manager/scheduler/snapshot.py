@@ -303,10 +303,29 @@ class ClusterSnapshot:
         # incrementally maintained capacity rank: ascending (rank_key,
         # name) for every node with a decoded registry. The filter's
         # TTL path sorts all nodes per pass (O(n log n) per decision);
-        # here one event costs a bisect remove+insert and a pass just
-        # walks the head — rank once on change, not once per decision.
+        # here a pass just walks the head — rank once on change, not
+        # once per decision. Structure (vtscale, 50k-node fix): a
+        # compacted immutable **main** list plus a small sorted
+        # **overlay** of post-compaction updates and a tombstone count.
+        # One event is an O(log overlay) insort + O(1) bookkeeping —
+        # the previous copy-on-write list paid an O(n) copy PER EVENT,
+        # which at 50k nodes is ~400KB of allocator churn per pod
+        # update. Readers lazily merge main+overlay, skipping items
+        # that no longer match _rank_of (the per-name truth); when
+        # overlay+tombstones exceed n/8 the rank compacts (amortized
+        # O(log n) per event). main is only ever REPLACED, overlay only
+        # ever grows in place between compactions, so a lock-free
+        # walker capturing both refs stays safe mid-compaction.
         self._rank: list[tuple[int, str]] = []
         self._rank_of: dict[str, tuple[int, str]] = {}
+        self._rank_overlay: list[tuple[int, str]] = []
+        self._rank_dead = 0          # stale slots across main+overlay
+        self._rank_version = 0
+        self._rank_cache: list[tuple[int, str]] | None = None
+        self._rank_cache_version = -1
+        # incremental capacity digest: sum of every ranked node's
+        # rank_key, O(1) to read — the cross-shard gang-spill digest
+        self._rank_key_sum = 0
         self._all_pods_cache: list[dict] | None = None
         self._pods_rv = ""
         self._nodes_rv = ""
@@ -758,25 +777,35 @@ class ClusterSnapshot:
 
     def _publish_rank_locked(self, name: str,
                              entry: NodeEntry | None) -> None:
-        """Keep the sorted capacity rank in sync with one entry swap:
-        bisect out the old position, bisect in the new. The list is
-        copy-on-write — passes iterate the published object lock-free
-        (forward AND reversed), so an in-place del/insort pair would
-        transiently shrink it and permanently terminate a concurrent
-        iterator mid-walk. One O(n) copy per event is noise next to the
-        O(n log n) sort per PASS this structure replaces. Entries
-        without a registry never rank (the filter gate fails them)."""
-        rank = self._rank.copy()
+        """Keep the capacity rank in sync with one entry swap: retire
+        the old item by tombstone (readers validate every item against
+        _rank_of, so the stale copy in main/overlay is simply skipped),
+        insort the new item into the small overlay, and compact when
+        the garbage fraction crosses n/8. O(log n) amortized per event,
+        zero per-event copies. Entries without a registry never rank
+        (the filter gate fails them)."""
         old = self._rank_of.pop(name, None)
         if old is not None:
-            i = bisect.bisect_left(rank, old)
-            if i < len(rank) and rank[i] == old:
-                del rank[i]
+            self._rank_dead += 1
+            self._rank_key_sum -= old[0]
         if entry is not None and entry.registry is not None:
             item = (entry.rank_key, name)
-            bisect.insort(rank, item)
+            bisect.insort(self._rank_overlay, item)
             self._rank_of[name] = item
-        self._rank = rank
+            self._rank_key_sum += item[0]
+        self._rank_version += 1
+        if (len(self._rank_overlay) + self._rank_dead
+                > max(64, len(self._rank_of) // 8)):
+            self._compact_rank_locked()
+
+    def _compact_rank_locked(self) -> None:
+        """Fold overlay + tombstones back into one sorted main list.
+        O(n log n), amortized over the >= n/8 events that triggered it
+        — O(log n) per event. Replaces main wholesale (never mutates),
+        so in-flight walkers finish on the generation they captured."""
+        self._rank = sorted(self._rank_of.values())
+        self._rank_overlay = []
+        self._rank_dead = 0
 
     def _build_entry_locked(self, name: str, node: dict, labels: dict,
                             registry) -> NodeEntry:
@@ -916,12 +945,14 @@ class ClusterSnapshot:
                 entries[name] = self._build_entry_locked(
                     name, node, meta.get("labels") or {}, registry)
             self._entries = entries
-            self._rank = sorted((entry.rank_key, name)
-                                for name, entry in entries.items()
-                                if entry.registry is not None)
             self._rank_of = {name: (entry.rank_key, name)
                              for name, entry in entries.items()
                              if entry.registry is not None}
+            self._rank = sorted(self._rank_of.values())
+            self._rank_overlay = []
+            self._rank_dead = 0
+            self._rank_version += 1
+            self._rank_key_sum = sum(k for k, _ in self._rank)
             self._nodes_rv = nodes_rv
             self._pods_rv = pods_rv
 
@@ -955,13 +986,74 @@ class ClusterSnapshot:
         return list(members.values())
 
     def rank_items(self) -> list[tuple[int, str]]:
-        """The published ascending (rank_key, name) capacity rank. The
-        returned list object is immutable (updates publish a fresh
-        copy), so iterating it — forward or reversed — is safe against
-        concurrent events; it may merely be one generation stale, and
-        every visited node is re-validated against exact totals before
-        allocation."""
-        return self._rank
+        """The ascending (rank_key, name) capacity rank, materialized.
+        Cached until the next rank-changing event, so repeated reads of
+        an unchanged cluster are O(1); after a change the first caller
+        pays one O(n) merge. Passes that only walk the head should use
+        ``rank_walk`` instead — it never materializes."""
+        version = self._rank_version
+        cache = self._rank_cache
+        if cache is not None and self._rank_cache_version == version:
+            return cache
+        items = list(self.rank_walk())
+        self._rank_cache = items
+        self._rank_cache_version = version
+        return items
+
+    def rank_walk(self, reverse: bool = False):
+        """Lazily walk the capacity rank in order (ascending, or
+        descending with ``reverse``): an on-the-fly merge of the
+        compacted main list and the update overlay, yielding only items
+        that still match the per-name truth (_rank_of). Lock-free and
+        safe against concurrent events: main is replaced never mutated,
+        the overlay is captured by copy (small — bounded by the n/8
+        compaction threshold), a node updated mid-walk simply stops
+        matching, and the seen-set drops the duplicate items an
+        update-then-revert can leave across generations. A head-limited
+        pass therefore costs O(head · log) plus that small copy — it no
+        longer rides on materializing all n items."""
+        main = self._rank
+        overlay = list(self._rank_overlay)   # small: bounded by n/8
+        rank_of = self._rank_of
+        seen: set[str] = set()
+        if reverse:
+            i, j = len(main) - 1, len(overlay) - 1
+            while i >= 0 or j >= 0:
+                if j < 0 or (i >= 0 and main[i] >= overlay[j]):
+                    item = main[i]
+                    i -= 1
+                else:
+                    item = overlay[j]
+                    j -= 1
+                name = item[1]
+                if name not in seen and rank_of.get(name) == item:
+                    seen.add(name)
+                    yield item
+        else:
+            i, j = 0, 0
+            while i < len(main) or j < len(overlay):
+                if j >= len(overlay) or (i < len(main)
+                                         and main[i] <= overlay[j]):
+                    item = main[i]
+                    i += 1
+                else:
+                    item = overlay[j]
+                    j += 1
+                name = item[1]
+                if name not in seen and rank_of.get(name) == item:
+                    seen.add(name)
+                    yield item
+
+    def capacity_digest(self) -> tuple[int, int]:
+        """(ranked_nodes, rank_key_sum): the O(1) free-capacity digest
+        the vtscale cross-shard gang spill compares across shards. The
+        rank_key is already the filter's free-capacity ordering scalar;
+        its sum over a shard's snapshot is a cheap, monotone-enough
+        proxy for "how much room this shard has" — the spill pass
+        re-validates real capacity on the target shard's entries, so
+        the digest only has to pick a *plausible* neighbor, never a
+        provably correct one."""
+        return len(self._rank_of), self._rank_key_sum
 
     def prune_expired(self, name: str, now: float) -> None:
         """Drop conditionals whose grace expired (no watch event marks

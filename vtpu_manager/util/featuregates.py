@@ -37,6 +37,8 @@ ICI_LINK_AWARE = "ICILinkAware"         # vtici link-contention-aware placement
 COMM_TELEMETRY = "CommTelemetry"        # vtcomm measured communication plane
 SLO_ATTRIBUTION = "SLOAttribution"      # vtslo goodput + step-time attribution
 SLO_AUTOPILOT = "SLOAutopilot"          # vtpilot elected remediation controller
+SCALE_PIPELINE = "ScalePipeline"        # vtscale batched bind + dynamic plans
+WEBHOOK_HA = "WebhookHA"                # vtscale lease-elected webhook replicas
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -225,6 +227,38 @@ _KNOWN = {
     # pod rebinds through the normal fence-stamped bind path, and the
     # target refills on first touch.
     SLO_AUTOPILOT: False,
+    # Default off: byte-identical — binds run the existing serial path
+    # (get → patch → confirm → Binding, one lease CAS per pod), fence
+    # stamps keep the exact two-field `<shard>:<token>` wire form (no
+    # epoch suffix is ever emitted), no plan object is created or read,
+    # a `--shard-pools` change still requires restarting every replica,
+    # gangs never spill across shard boundaries, and no vtpu_scale_*/
+    # vtpu_bind_wave_* series render. On, the control plane scales out:
+    # (1) binds flow through a per-shard commit pipeline
+    # (scheduler/bindpipe.py) that coalesces the allocating+intent+fence
+    # patches, ONE lease confirm() CAS, and the Binding POSTs across a
+    # wave of pods — the fencing-token safety argument is unchanged
+    # because every pod's intent+fence patch lands BEFORE the single
+    # confirm and no Binding is posted unless that confirm succeeds;
+    # a pod that fails any wave stage degrades to the serial path alone,
+    # never the wave; (2) shard plans become a CAS'd apiserver object
+    # (scheduler/plan.py) whose epoch is folded into the fence stamp
+    # (`<shard>:<token>+<epoch>`), so `--shard-pools` changes reshard
+    # rolling — old-epoch commitments are fence-rejected and reaped
+    # exactly like a stale leader's, with zero replica restarts; and
+    # (3) a gang too large for its home shard's free capacity consults
+    # the cross-shard capacity digest and places on the roomiest
+    # neighbor's nodes under the OWNER shard's lease + fence.
+    SCALE_PIPELINE: False,
+    # Default off: byte-identical — the webhook neither creates nor
+    # reads any lease, every replica serves mutates, and /readyz answers
+    # from serving state alone. On, replicas elect ONE active mutator
+    # through the same ShardLease CAS machinery the scheduler shards
+    # use (object `vtpu-webhook`): passive replicas refuse mutating
+    # admission with 503 (the apiserver retries per failurePolicy) and
+    # report unready so the Service routes around them; read-only
+    # validate paths stay served everywhere (docs/ha.md runbook).
+    WEBHOOK_HA: False,
 }
 
 

@@ -40,7 +40,8 @@ class WebhookAPI:
                  dra_convert: bool = False, client=None,
                  stamp_fingerprint: bool = False,
                  stamp_workload_class: bool = False,
-                 stamp_ici_link_pct: bool = False):
+                 stamp_ici_link_pct: bool = False,
+                 ha_lease=None):
         from vtpu_manager.util import consts
         self.scheduler_name = scheduler_name or consts.DEFAULT_SCHEDULER_NAME
         self.dra_convert = dra_convert   # rewrite vtpu-* into ResourceClaims
@@ -52,6 +53,17 @@ class WebhookAPI:
         self.stamp_workload_class = stamp_workload_class
         # vtici (ICILinkAware gate): normalize the declared ICI share
         self.stamp_ici_link_pct = stamp_ici_link_pct
+        # vtscale webhook HA (WebhookHA gate; None = byte-identical):
+        # a ShardLease — under its OWN Lease object name, reusing the
+        # scheduler's whole acquire/renew/fence machinery — elects ONE
+        # active mutator. Passives keep serving validates (pure, no
+        # writes) but refuse mutates with 503, and /readyz reports
+        # unready so Service endpoints drop them; the apiserver's retry
+        # lands the AdmissionReview on the leader. The entrypoint runs
+        # the renew ticker; handlers only read the cheap local
+        # held_fresh() — no lease I/O ever rides the admission path.
+        self.ha_lease = ha_lease
+        self.ha_refusals = 0
         self.stats = {"mutate": 0, "validate": 0, "errors": 0}
 
     def build_app(self) -> web.Application:
@@ -61,7 +73,10 @@ class WebhookAPI:
         app.router.add_post("/resourceclaims/validate",
                             self.handle_claim_validate)
         app.router.add_get("/healthz", self.handle_healthz)
-        app.router.add_get("/readyz", self.handle_healthz)
+        app.router.add_get("/readyz", self.handle_readyz)
+        if self.ha_lease is not None:
+            # gate off = no new routes: /metrics exists only under HA
+            app.router.add_get("/metrics", self.handle_metrics)
         return app
 
     async def _review(self, request: web.Request
@@ -73,6 +88,15 @@ class WebhookAPI:
 
     async def handle_mutate(self, request: web.Request) -> web.Response:
         self.stats["mutate"] += 1
+        if self.ha_lease is not None and not self.ha_lease.held_fresh():
+            # standby replica: refusing (NOT failing open) is the safe
+            # direction — a mutate served by two replicas straddling a
+            # lease handoff could stamp diverging defaults; the 503 is
+            # retried by the apiserver and lands on the leader
+            self.ha_refusals += 1
+            return web.Response(
+                status=503, text="webhook standby: not the active "
+                                 "mutator; retry lands on the leader")
         try:
             uid, pod, dry_run = await self._review(request)
             result = mutate_pod(
@@ -186,6 +210,29 @@ class WebhookAPI:
 
     async def handle_healthz(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")
+
+    async def handle_readyz(self, request: web.Request) -> web.Response:
+        """Liveness and readiness diverge under WebhookHA: a standby is
+        perfectly healthy (healthz ok — do not restart it) but unready
+        (drop it from Service endpoints so admission traffic prefers
+        the active mutator without waiting for a 503 retry)."""
+        if self.ha_lease is not None and not self.ha_lease.held_fresh():
+            return web.Response(status=503,
+                                text="standby: lease not held")
+        return web.Response(text="ok")
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        lines = ["# TYPE vtpu_webhook_requests_total counter"]
+        for k, v in self.stats.items():
+            lines.append(
+                f'vtpu_webhook_requests_total{{endpoint="{k}"}} {v}')
+        lines.append("# TYPE vtpu_webhook_ha_active gauge")
+        lines.append(f"vtpu_webhook_ha_active "
+                     f"{1 if self.ha_lease.held_fresh() else 0}")
+        lines.append("# TYPE vtpu_webhook_ha_refusals_total counter")
+        lines.append(f"vtpu_webhook_ha_refusals_total {self.ha_refusals}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
 
 def run_server(api: WebhookAPI, host: str = "0.0.0.0", port: int = 8443,
