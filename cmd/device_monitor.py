@@ -85,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 COMM_TELEMETRY,
                                                 DECISION_EXPLAIN,
                                                 HBM_OVERCOMMIT,
+                                                HEALTH_PLANE,
                                                 QUOTA_MARKET,
                                                 SLO_ATTRIBUTION,
                                                 SLO_AUTOPILOT,
@@ -104,6 +105,7 @@ def main(argv: list[str] | None = None) -> int:
     cluster_cache_on = gates.enabled(CLUSTER_COMPILE_CACHE)
     comm_on = gates.enabled(COMM_TELEMETRY)
     slo_on = gates.enabled(SLO_ATTRIBUTION)
+    health_on = gates.enabled(HEALTH_PLANE)
     autopilot_on = gates.enabled(SLO_AUTOPILOT)
     if autopilot_on and not slo_on:
         # the controller consumes vtslo verdicts — without the
@@ -188,6 +190,20 @@ def main(argv: list[str] | None = None) -> int:
                 v = dict(v)
                 v.setdefault("node", doc.get("node", ""))
                 out.append(v)
+            if health_on:
+                # vtheal: every node's fresh chip-health annotation
+                # folds into chip-failure verdicts on the SAME wire —
+                # the whole guard chain (hysteresis, cooldown, token
+                # buckets, fence) applies to rescues unchanged. Gate
+                # off = no extra feed leg, no rescue dispatches.
+                from vtpu_manager.health import chip_failure_verdicts
+                try:
+                    out.extend(chip_failure_verdicts(fan_client,
+                                                     _base_for))
+                except Exception as e:  # noqa: BLE001 — a wedged
+                    # health fold must not starve the vtslo leg
+                    logging.getLogger(__name__).warning(
+                        "chip-failure verdict fold failed: %s", e)
             return out
 
         autopilot = AutopilotController(
@@ -243,7 +259,11 @@ def main(argv: list[str] | None = None) -> int:
             slo_ledger=collector.slo_ledger,
             # vtpilot: the autopilot action headline folds in only when
             # the autopilot gate is on (off = byte-identical document)
-            action_ledger=autopilot.ledger if autopilot else None)
+            action_ledger=autopilot.ledger if autopilot else None,
+            # vtheal: per-chip HEALTH column + the unhealthy-chip fleet
+            # headline fold in only when the health gate is on (off =
+            # byte-identical document, the vtqm pattern)
+            health=health_on)
 
     import hmac
 
@@ -292,6 +312,12 @@ def main(argv: list[str] | None = None) -> int:
             from vtpu_manager.autopilot import render_autopilot_metrics
             text += render_autopilot_metrics(autopilot,
                                              autopilot_migrator)
+        if health_on:
+            # vtheal rescue outcomes (this process dispatches rescues;
+            # the node-side chip families render in the device-plugin).
+            # Gate off = the render is never called, zero new series.
+            from vtpu_manager.health import metrics as health_metrics
+            text += health_metrics.render_rescue_metrics()
         # vtfault retry/breaker/failpoint counters for this process
         text += render_resilience_metrics() + "\n"
         return web.Response(text=text, content_type="text/plain")

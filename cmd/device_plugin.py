@@ -119,6 +119,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 CORE_PLUGIN,
                                                 FAULT_INJECTION,
                                                 HBM_OVERCOMMIT,
+                                                HEALTH_PLANE,
                                                 HONOR_PREALLOC_IDS,
                                                 ICI_LINK_AWARE,
                                                 MEMORY_PLUGIN,
@@ -300,6 +301,31 @@ def main(argv: list[str] | None = None) -> int:
     health = HealthWatcher(manager, device_node_probe)
     health.start()
 
+    # vtheal chip-health publisher: this daemon (the node-annotation
+    # owner) folds the probe verdicts, the shims' step-ring evidence
+    # (stall vs exec-error streaks) and ICI link probes through the
+    # suspect->degraded->failed ladder and publishes the chip-health
+    # annotation both scheduler paths cordon against. Staleness LIFTS
+    # the cordon (a dead publisher un-fences the node); the legacy
+    # HealthWatcher registry flip above stays the non-decaying
+    # backstop. Gate off = no thread, no annotation, no series.
+    health_pub = None
+    if gates.enabled(HEALTH_PLANE):
+        from vtpu_manager.health import ChipHealthPublisher
+        chip_by_index = {c.index: c for c in chips}
+        health_pub = ChipHealthPublisher(
+            client, args.node_name,
+            {c.index: c.coords for c in chips},
+            args.base_dir or consts.MANAGER_BASE_DIR,
+            # the SAME probe contract HealthWatcher runs (external cmd
+            # or device-node presence), adapted chip-index -> ChipSpec;
+            # make_external_probe's None fail-open verdict is a
+            # no-sample to the ladder, never chip evidence
+            probe=lambda index: device_node_probe(chip_by_index[index]),
+            mesh=manager.mesh if gates.enabled(TPU_TOPOLOGY) else None)
+        health_pub.start()
+        log.info("chip-health publisher running (%d chips)", len(chips))
+
     # VMemoryNode: pre-create the cross-process vmem ledger so container
     # shims can map it from their first allocation (the TC watcher also
     # creates it lazily, but that couples the ledger to the watcher gate)
@@ -364,9 +390,19 @@ def main(argv: list[str] | None = None) -> int:
                 # linkload weight-source audit rides the same process-
                 # local surface (empty until an ICILinkAware publisher
                 # ran — no publisher, no new series)
-                body = (render_resilience_metrics() + "\n"
+                text = (render_resilience_metrics() + "\n"
                         + linkload_mod.render_fallback_metrics(
-                            args.node_name)).encode()
+                            args.node_name))
+                if gates.enabled(HEALTH_PLANE):
+                    # vtheal node-side chip families (this process
+                    # runs the publisher; the monitor renders the
+                    # rescue family). Gate off = render never called,
+                    # zero new series.
+                    from vtpu_manager.health import \
+                        metrics as health_metrics
+                    text += health_metrics.render_health_metrics(
+                        args.node_name)
+                body = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))
@@ -672,6 +708,8 @@ def main(argv: list[str] | None = None) -> int:
             reaper_stop.set()
         if controller:
             controller.stop()
+        if health_pub:
+            health_pub.stop()
         health.stop()
         manager.stop()
     return 0

@@ -106,6 +106,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 DECISION_EXPLAIN,
                                                 FAULT_INJECTION,
                                                 HBM_OVERCOMMIT,
+                                                HEALTH_PLANE,
                                                 ICI_LINK_AWARE,
                                                 QUOTA_MARKET,
                                                 SCALE_PIPELINE,
@@ -188,7 +189,14 @@ def main(argv: list[str] | None = None) -> int:
         # node's published link-load rollup; off = byte-identical
         # placement in both data paths. Same filter_kwargs ride-along,
         # so vtha shards inherit it.
-        ici_link_aware=gates.enabled(ICI_LINK_AWARE))
+        ici_link_aware=gates.enabled(ICI_LINK_AWARE),
+        # vtheal: the fenced cordon — degraded/failed chips from the
+        # node's chip-health annotation become a HARD admission gate
+        # (capacity-shaped, audited as UnhealthyChip/DegradedLink) and
+        # failed ICI edges hard-exclude submesh candidates; off =
+        # byte-identical placement in both data paths. Same
+        # filter_kwargs ride-along, so vtha shards inherit it.
+        health_plane=gates.enabled(HEALTH_PLANE))
     # vtexplain satellite: preemption victim ordering gains the vttel/
     # vtuse utilization inputs behind the same gate as the audit trail
     # (the ordering applied is recorded per victim, so it is auditable);

@@ -51,6 +51,12 @@ constexpr int kStepTraceIdLen = 48;
 
 // StepRecord.flags
 constexpr uint32_t kStepFlagCompile = 0x1;  // step paid a compile
+// vtheal: the step's Execute (or a transfer inside it) returned an
+// error the shim recovered from. A new bit in the existing v4 flags
+// field — no layout change, no version bump; readers that don't know
+// the bit only test kStepFlagCompile. The health plane reads trailing
+// streaks of it as dead-chip evidence.
+constexpr uint32_t kStepFlagExecError = 0x2;
 
 // Staleness budget of the measured-collective signal (mirror of
 // stepring.COMM_SIGNAL_STALENESS_NS): the ICI token bucket charges the
@@ -217,7 +223,8 @@ class StepRingWriter {
               uint32_t spill_events = 0, uint32_t fill_events = 0,
               uint64_t comm_time_ns = 0, uint64_t bytes_transferred = 0,
               uint32_t collective_count = 0,
-              uint64_t spill_fill_time_ns = 0) {
+              uint64_t spill_fill_time_ns = 0,
+              bool exec_error = false) {
     if (!mm_) return;
     if (start_mono_ns == 0) {
       struct timespec ts;
@@ -238,7 +245,8 @@ class StepRingWriter {
     rec->duration_ns = duration_ns;
     rec->throttle_wait_ns = throttle_wait_ns;
     rec->hbm_highwater_bytes = hbm_highwater_bytes;
-    rec->flags = compiled ? kStepFlagCompile : 0;
+    rec->flags = (compiled ? kStepFlagCompile : 0) |
+                 (exec_error ? kStepFlagExecError : 0);
     rec->pad_ = 0;
     rec->spilled_bytes = spilled_bytes;
     rec->spill_events = spill_events;

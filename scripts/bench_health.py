@@ -1,0 +1,589 @@
+#!/usr/bin/env python3
+"""vtheal headline bench: detect -> cordon -> rescue, end to end.
+
+Four legs, every lever pulled through its real channel (no mocks past
+the fake apiserver):
+
+- **detection**: a mid-step chip failure is injected as the real
+  evidence the plane consumes — the probe command starts failing AND
+  the resident's step ring grows a trailing exec-error streak — and a
+  real ChipHealthPublisher must walk the ladder to FAILED in exactly
+  ESCALATE_FOLDS ticks (the debounce contract: one fold is a spike,
+  two is a verdict), publishing only non-healthy chips on the wire.
+- **cordon**: the published annotation must fence BOTH scheduler data
+  paths (TTL caches and the watch-driven snapshot) with the structured
+  ``UnhealthyChip`` attribution, a failed ICI edge must HARD-exclude
+  the ici-strict submesh with ``DegradedLink``, and the gate off must
+  place byte-identically to a clean cluster — in both modes.
+- **rescue**: an elected AutopilotController consumes the real
+  ``chip_failure_verdicts`` feed window by window; every gang resident
+  on the failed chip must be rescued (live-migrated to the quietest
+  healthy node, never INTO a cordoned one) in the FIRST
+  hysteresis-eligible window — the first window with >= 2 distinct
+  publisher episodes — with zero flapping, zero actions on the
+  healthy-chip resident, and per-chip core/HBM + single-binding
+  invariants checked every round. A one-node fleet must degrade to the
+  bounded park-and-retry outcome, never an error.
+- **chaos**: a controller crash mid-rescue (CrashFailpoint at
+  ``health.rescue`` / ``migrate.freeze`` / ``migrate.refill``, three
+  seeds each) always converges by reap — configs unfreeze, the intent
+  trail clears, no pod ends double-owned, a re-reap is idempotent.
+
+Writes BENCH_VTHEAL_r19.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from vtpu_manager.autopilot import (AUTOPILOT_SHARD, ActionContext,   # noqa: E402
+                                    AutopilotController, GangMigrator,
+                                    default_actions,
+                                    reap_stale_migrations)
+from vtpu_manager.autopilot import migrate as ap_migrate              # noqa: E402
+from vtpu_manager.client.fake import FakeKubeClient                   # noqa: E402
+from vtpu_manager.config import vtpu_config as vc                     # noqa: E402
+from vtpu_manager.health import codec, ladder, rescue                 # noqa: E402
+from vtpu_manager.health import metrics as health_metrics             # noqa: E402
+from vtpu_manager.health.publisher import ChipHealthPublisher         # noqa: E402
+from vtpu_manager.resilience import failpoints                       # noqa: E402
+from vtpu_manager.scheduler.filter import FilterPredicate             # noqa: E402
+from vtpu_manager.scheduler.lease import ShardLease                   # noqa: E402
+from vtpu_manager.scheduler import reason as R                        # noqa: E402
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot           # noqa: E402
+from vtpu_manager.telemetry import stepring                           # noqa: E402
+from vtpu_manager.topology.linkload import NodeLinkLoad               # noqa: E402
+from vtpu_manager.util import consts                                  # noqa: E402
+from vtpu_manager.device import types as dt                           # noqa: E402
+
+GIB = 1 << 30
+BASE_STEP_NS = 10_000_000
+STEADY_STEPS = 48
+EXEC_ERROR_STEPS = 6           # > signals.EXEC_STREAK_N
+K_WINDOWS = 5                  # rescue must land well inside these
+WINDOW_S = 300.0               # controller cadence (> cooldown)
+PUBLISH_S = 15.0               # publisher cadence inside a window
+CHIP_CORE_CAP = 100            # per-chip slot invariant
+CHIP_HBM_CAP = 8 * GIB         # per-chip memory invariant
+
+
+def _mk_config(base, uid, host_indexes=(0,), hard_core=40,
+               total_memory=2 * GIB):
+    path = os.path.join(base, f"{uid}_main", "config", "vtpu.config")
+    vc.write_config(path, vc.VtpuConfig(
+        pod_uid=uid, pod_name=uid, pod_namespace="ml",
+        container_name="main",
+        devices=[vc.DeviceConfig(uuid=f"TPU-FAKE-{i:04d}",
+                                 total_memory=total_memory,
+                                 real_memory=total_memory,
+                                 hard_core=hard_core, host_index=i)
+                 for i in host_indexes]))
+    return path
+
+
+def _write_ring(base, uid, records):
+    d = os.path.join(base, f"{uid}_main", consts.TELEMETRY_SUBDIR)
+    os.makedirs(d, exist_ok=True)
+    w = stepring.StepRingWriter(os.path.join(d, consts.STEP_RING_NAME),
+                                trace_id=f"tr-{uid}")
+    for kw in records:
+        w.record(**kw)
+    w.close()
+
+
+STEADY = [dict(duration_ns=BASE_STEP_NS)] * STEADY_STEPS
+# the injected failure: the tenant keeps submitting, the chip stopped
+# executing — a trailing FLAG_EXEC_ERROR streak on the wire
+FAILING = STEADY + [dict(duration_ns=BASE_STEP_NS, exec_error=True)
+                    ] * EXEC_ERROR_STEPS
+# a lower-goodput resident (heavy throttle-wait): the rescue-priority
+# tie the verdict order must break goodput-DESCENDING
+THROTTLED = [dict(duration_ns=BASE_STEP_NS,
+                  throttle_wait_ns=4_000_000)] * STEADY_STEPS
+
+
+def _pod(name="p1", uid=None, number=1, cores=10, node=None,
+         annotations=None, phase="Pending"):
+    spec = {"containers": [{
+        "name": "main", "resources": {"limits": {
+            consts.vtpu_number_resource(): number,
+            consts.vtpu_cores_resource(): cores,
+            consts.vtpu_memory_resource(): 1024}}}]}
+    if node:
+        spec["nodeName"] = node
+    return {"metadata": {"name": name, "namespace": "ml",
+                         "uid": uid or f"uid-{name}",
+                         "annotations": annotations or {}},
+            "spec": spec, "status": {"phase": phase}}
+
+
+def _pred(client, mode, **kw):
+    snap = None
+    if mode == "snapshot":
+        snap = ClusterSnapshot(client)
+        snap.start()
+    return FilterPredicate(client, snapshot=snap, **kw)
+
+
+def _link_ann(worst, now):
+    return NodeLinkLoad(links={((0, 0, 0), 0): worst}, ts=now).encode()
+
+
+# ---------------------------------------------------------------------------
+# leg 1: detection
+# ---------------------------------------------------------------------------
+
+def run_detection(doc: dict) -> dict:
+    base = tempfile.mkdtemp(prefix="vtheal-det-")
+    _mk_config(base, "uid-g0", host_indexes=(0,))
+    _mk_config(base, "uid-g1", host_indexes=(1,))
+    _write_ring(base, "uid-g0", STEADY)
+    _write_ring(base, "uid-g1", STEADY)
+    client = FakeKubeClient(upsert_on_patch=True)
+    client.add_node({"metadata": {"name": "n-src", "annotations": {}}})
+
+    failed_box = {"failed": False}
+    pub = ChipHealthPublisher(
+        client, "n-src", {0: (0, 0, 0), 1: (1, 0, 0)}, base,
+        probe=lambda i: not (failed_box["failed"] and i == 0))
+
+    t0 = time.time()
+    healthy_wire = pub.publish_once(now=t0)
+    assert not healthy_wire.chips, "healthy fleet published chip states"
+
+    # the mid-step failure: probe flips AND the resident's ring grows
+    # the exec-error streak — two independent signals, one chip
+    failed_box["failed"] = True
+    _write_ring(base, "uid-g0", FAILING)
+
+    states = []
+    ticks_to_failed = None
+    for k in range(1, 5):
+        # the healthy neighbor keeps stepping (a still ring would read
+        # as a stalled tenant: suspect, correctly, but not this leg)
+        _write_ring(base, "uid-g1",
+                    STEADY + [dict(duration_ns=BASE_STEP_NS)] * k)
+        health = pub.publish_once(now=t0 + k * PUBLISH_S)
+        state = health.chips.get(0, (codec.HEALTHY, 0.0))[0]
+        states.append(state)
+        if state == codec.FAILED and ticks_to_failed is None:
+            ticks_to_failed = k
+            break
+    assert ticks_to_failed is not None, f"never failed: {states}"
+    assert ticks_to_failed <= ladder.ESCALATE_FOLDS, states
+
+    # the wire: only the failed chip rides it; the healthy neighbor is
+    # absent, and the scheduler-side decode agrees
+    back = rescue.node_chip_health(client, "n-src",
+                                   now=t0 + ticks_to_failed * PUBLISH_S)
+    assert back is not None and back.chips[0][0] == codec.FAILED
+    assert 1 not in back.chips
+    rendered = health_metrics.render_health_metrics("n-src")
+    assert 'vtpu_chip_health_flips_total{node="n-src",to="failed"}' \
+        in rendered
+
+    doc["detection"] = {
+        "signals": ["probe", "exec"],
+        "publish_ticks_to_failed": ticks_to_failed,
+        "escalate_folds": ladder.ESCALATE_FOLDS,
+        "states_per_tick": states,
+        "wire_chips": {str(i): s for i, (s, _c) in back.chips.items()},
+    }
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# leg 2: cordon, both scheduler modes
+# ---------------------------------------------------------------------------
+
+def _cordon_cluster(annotate, states=None, links=frozenset(), chips=2,
+                    mesh_shape=(2, 1)):
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in ("node-a", "node-b"):
+        reg = dt.fake_registry(chips, mesh_shape=mesh_shape,
+                               uuid_prefix=name.upper())
+        client.add_node(dt.fake_node(name, reg))
+    if annotate:
+        wire = codec.NodeChipHealth(chips=states or {}, links=links,
+                                    ts=time.time()).encode()
+        client.patch_node_annotations(
+            "node-a", {consts.node_chip_health_annotation(): wire})
+    return client
+
+
+def run_cordon(doc: dict) -> dict:
+    modes = {}
+    for mode in ("ttl", "snapshot"):
+        row = {}
+        # failed chips fence the node with the cordon's own reason code
+        client = _cordon_cluster(True, {0: (codec.FAILED, 0.9),
+                                        1: (codec.FAILED, 0.9)})
+        pod = _pod()
+        client.add_pod(pod)
+        result = _pred(client, mode, health_plane=True).filter(
+            {"Pod": pod})
+        assert result.node_names == ["node-b"], result.node_names
+        assert result.failed_nodes["node-a"] == R.UNHEALTHY_CHIP
+        row["chip_cordon"] = {"placed": result.node_names,
+                              "reason": result.failed_nodes["node-a"]}
+
+        # a failed ICI edge on a 2x2 mesh leaves no 4-chip box avoiding
+        # it: ici-strict placement must name the link, not capacity
+        client = _cordon_cluster(True, links=frozenset({((0, 0, 0), 0)}),
+                                 chips=4, mesh_shape=(2, 2))
+        strict = _pod(name="p-strict", number=4, annotations={
+            consts.topology_mode_annotation(): "ici-strict"})
+        client.add_pod(strict)
+        result = _pred(client, mode, health_plane=True).filter(
+            {"Pod": strict})
+        assert R.DEGRADED_LINK in result.failed_nodes["node-a"]
+        row["dead_link"] = {"reason": result.failed_nodes["node-a"]}
+
+        # gate off: the annotation present must place byte-identically
+        # to a clean cluster
+        shapes = {}
+        for tag in ("annotated", "clean"):
+            client = _cordon_cluster(tag == "annotated",
+                                     {0: (codec.FAILED, 0.9),
+                                      1: (codec.FAILED, 0.9)})
+            pod = _pod(name=f"p-{tag}", uid="uid-par")
+            client.add_pod(pod)
+            r = _pred(client, mode).filter({"Pod": pod})
+            shapes[tag] = (r.node_names, dict(r.failed_nodes))
+        assert shapes["annotated"] == shapes["clean"]
+        row["gate_off_parity"] = True
+        modes[mode] = row
+
+    # both data paths agree on every verdict
+    assert modes["ttl"] == modes["snapshot"]
+    doc["cordon"] = {"modes": modes, "modes_agree": True}
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# leg 3: rescue through the elected autopilot
+# ---------------------------------------------------------------------------
+
+def run_rescue(doc: dict) -> dict:
+    base = tempfile.mkdtemp(prefix="vtheal-resc-")
+    # two residents on the doomed chip (hot = full goodput, warm =
+    # throttle-bound), one on the healthy neighbor that must never move
+    _mk_config(base, "uid-hot", host_indexes=(0,))
+    _mk_config(base, "uid-warm", host_indexes=(0,))
+    _mk_config(base, "uid-safe", host_indexes=(1,))
+    _write_ring(base, "uid-hot", FAILING)
+    _write_ring(base, "uid-warm", THROTTLED)
+    _write_ring(base, "uid-safe", STEADY)
+
+    t0 = time.time()
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name, worst in (("n-src", 0.85), ("n-busy", 0.60),
+                        ("n-quiet", 0.05)):
+        client.add_node({"metadata": {"name": name, "annotations": {
+            consts.node_ici_link_load_annotation():
+                _link_ann(worst, t0)}}})
+    for name, uid in (("gang-hot", "uid-hot"), ("gang-warm", "uid-warm"),
+                      ("gang-safe", "uid-safe")):
+        client.add_pod(_pod(name=name, uid=uid, node="n-src",
+                            phase="Running"))
+
+    def base_for(node):
+        return base if node == "n-src" else None
+
+    pub = ChipHealthPublisher(
+        client, "n-src", {0: (0, 0, 0), 1: (1, 0, 0)}, base,
+        probe=lambda i: i != 0)
+    migrator = GangMigrator(client, base_for)
+    # the executors judge annotation freshness on their own clock —
+    # it must ride the simulated windows, not the wall
+    clock_box = {"now": t0}
+    ctx = ActionContext(client, base_for, migrator=migrator,
+                        clock=lambda: clock_box["now"])
+    feed_box = {"batch": []}
+    controller = AutopilotController(
+        client, "bench-mon", base, lambda: feed_box["batch"],
+        default_actions(ctx),
+        lease=ShardLease(client, AUTOPILOT_SHARD, "bench-mon"))
+
+    def check_invariants(tag):
+        # per-chip slot/HBM: the source node's resident configs never
+        # oversubscribe a chip, any round, rescue in flight or not
+        per_chip: dict[int, list[int]] = {}
+        from vtpu_manager.config import tenantdirs
+        for _uid, _label, cfg, _d, _m in \
+                tenantdirs.iter_container_configs(base):
+            for dev in cfg.devices:
+                got = per_chip.setdefault(dev.host_index, [0, 0])
+                got[0] += dev.hard_core
+                got[1] += dev.total_memory
+        for chip, (core, hbm) in per_chip.items():
+            assert core <= CHIP_CORE_CAP, \
+                f"{tag}: chip {chip} core oversubscribed: {core}"
+            assert hbm <= CHIP_HBM_CAP, \
+                f"{tag}: chip {chip} HBM oversubscribed: {hbm}"
+        # no pod is ever double-owned
+        owners = [(b[0], b[1]) for b in client.bindings]
+        assert len(owners) == len(set(owners)), client.bindings
+
+    episodes_seen: set[float] = set()
+    first_eligible = None
+    first_rescue: dict[str, int] = {}
+    actions_by_tenant: dict[str, list] = {}
+    windows = []
+    for i in range(K_WINDOWS):
+        now_i = t0 + i * WINDOW_S
+        # the publisher's 15 s cadence inside this window (two ticks:
+        # the ladder's ESCALATE_FOLDS debounce completes in-window)
+        for k in range(2):
+            health = pub.publish_once(now=now_i + k * PUBLISH_S)
+        # the link-load annotations stay fresh (the rescue targets the
+        # measured-quietest node, not a stale ghost)
+        for name, worst in (("n-busy", 0.60), ("n-quiet", 0.05)):
+            client.patch_node_annotations(name, {
+                consts.node_ici_link_load_annotation():
+                    _link_ann(worst, now_i)})
+        clock_box["now"] = now_i + PUBLISH_S
+        feed_box["batch"] = rescue.chip_failure_verdicts(
+            client, base_for, now=now_i + PUBLISH_S)
+        for v in feed_box["batch"]:
+            episodes_seen.add(v["episode_onset_ts"])
+        if first_eligible is None and len(episodes_seen) >= 2:
+            first_eligible = i
+        taken = controller.tick(now=now_i + PUBLISH_S)
+        for rec in taken:
+            uid = rec["tenant"].partition("/")[0]
+            actions_by_tenant.setdefault(uid, []).append(rec)
+            first_rescue.setdefault(uid, i)
+            if rec["action"].get("ok") and \
+                    not rec["action"].get("parked"):
+                # the migration unwound before the gang left: the
+                # source config must already be unfrozen
+                cfg = vc.read_config(os.path.join(
+                    base, f"{uid}_main", "config", "vtpu.config"))
+                assert cfg.migration_freeze == 0, uid
+                # the rescue's physical effect: the gang LEFT the node
+                # — its tenant partition goes with it (the lever itself
+                # was pulled through the real migration above)
+                shutil.rmtree(os.path.join(base, f"{uid}_main"),
+                              ignore_errors=True)
+        check_invariants(f"window {i}")
+        windows.append({"window": i,
+                        "verdicts": [v["tenant"] for v in
+                                     feed_box["batch"]],
+                        "actions": [r["action"].get("action")
+                                    for r in taken]})
+
+    # every doomed resident rescued in the FIRST eligible window; the
+    # healthy-chip resident untouched; nobody acted on twice
+    assert first_eligible is not None
+    assert set(first_rescue) == {"uid-hot", "uid-warm"}, first_rescue
+    assert all(w == first_eligible for w in first_rescue.values()), \
+        (first_rescue, first_eligible)
+    assert "uid-safe" not in actions_by_tenant
+    assert all(len(a) == 1 for a in actions_by_tenant.values())
+    for uid, recs in actions_by_tenant.items():
+        act = recs[0]["action"]
+        assert act["action"] == "rescue-gang" and act["ok"], act
+        assert act["target"] == "n-quiet", act
+        assert recs[0]["fence"].startswith("autopilot:")
+    # verdict priority: the full-goodput gang outranks the throttled one
+    w_eligible = windows[first_eligible]["verdicts"]
+    assert w_eligible.index("uid-hot/main") < \
+        w_eligible.index("uid-warm/main"), w_eligible
+    # the migration landed as fenced bindings on the quiet node
+    assert ("ml", "gang-hot", "n-quiet") in client.bindings
+    assert ("ml", "gang-warm", "n-quiet") in client.bindings
+    assert "migrated" in health_metrics.render_rescue_metrics()
+    tail_actions = sum(len(w["actions"])
+                       for w in windows[first_eligible + 1:])
+    assert tail_actions == 0, windows
+
+    # park-and-retry: a one-node fleet has no rescue target — the
+    # outcome is parked (ok, bounded retry), never an error
+    pclient = FakeKubeClient(upsert_on_patch=True)
+    pclient.add_node({"metadata": {"name": "n-only", "annotations": {}}})
+    pclient.add_pod(_pod(name="gang-p", uid="uid-p", node="n-only",
+                         phase="Running"))
+    pctx = ActionContext(pclient, lambda n: None,
+                         migrator=GangMigrator(pclient, lambda n: None))
+    parked = default_actions(pctx)["chip-failure"](
+        {"kind": "chip-failure", "tenant": "uid-p/main", "node": "n-only",
+         "chips": [0], "episode_onset_ts": t0, "goodput": 1.0},
+        "autopilot:1")
+    assert parked["ok"] and parked.get("parked"), parked
+
+    doc["rescue"] = {
+        "windows": windows,
+        "first_eligible_window": first_eligible,
+        "first_rescue_window": first_rescue,
+        "rescued": sorted(actions_by_tenant),
+        "targets": {u: a[0]["action"]["target"]
+                    for u, a in actions_by_tenant.items()},
+        "tail_windows_actions": tail_actions,
+        "suppressed_total": dict(controller.suppressed_total),
+        "park_outcome": {k: parked[k] for k in
+                         ("action", "ok", "parked", "reason")},
+    }
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# leg 4: crash-mid-rescue chaos
+# ---------------------------------------------------------------------------
+
+def run_chaos(doc: dict) -> dict:
+    """Crash at every window of the rescue timeline, three seeds each:
+    convergence means configs unfreeze, the intent trail clears, no
+    pod ends double-owned, and a re-reap finds nothing."""
+    rounds = []
+    failpoints.enable(seed=19)
+    try:
+        for site in ("health.rescue", "migrate.freeze",
+                     "migrate.refill"):
+            for seed in range(3):
+                base = tempfile.mkdtemp(prefix="vtheal-chaos-")
+                client = FakeKubeClient(upsert_on_patch=True)
+                client.add_node({"metadata": {"name": "n-src",
+                                              "annotations": {}}})
+                client.add_node({"metadata": {"name": "n-dst",
+                                              "annotations": {}}})
+                client.add_pod(_pod(name="gang-x", uid="uid-x",
+                                    node="n-src", phase="Running"))
+                path = _mk_config(base, "uid-x")
+
+                def base_for(node, _b=base):
+                    return _b if node == "n-src" else None
+
+                ctx = ActionContext(client, base_for,
+                                    migrator=GangMigrator(client,
+                                                          base_for))
+                verdict = {"kind": "chip-failure",
+                           "tenant": "uid-x/main", "node": "n-src",
+                           "chips": [0],
+                           "episode_onset_ts": time.time(),
+                           "goodput": 1.0}
+                failpoints.arm(site, "crash")
+                crashed = False
+                try:
+                    default_actions(ctx)["chip-failure"](verdict,
+                                                         "autopilot:1")
+                except BaseException:   # CrashFailpoint IS the crash
+                    crashed = True
+                finally:
+                    failpoints.disarm(site)
+                assert crashed, f"{site}: crash failpoint never fired"
+                anns = client.get_pod(
+                    "ml", "gang-x")["metadata"]["annotations"]
+                intent = ap_migrate.parse_migration_intent(
+                    anns.get(consts.migration_intent_annotation()))
+                # health.rescue fires BEFORE the migrator: a window-1
+                # crash leaves NOTHING torn; the migrate windows leave
+                # the reapable trail
+                if site == "health.rescue":
+                    assert intent is None, site
+                else:
+                    assert intent is not None, site
+                reaped = reap_stale_migrations(
+                    client, base_for, now=time.time(),
+                    lease_probe=lambda: type("L", (), {"token": 2})())
+                cfg = vc.read_config(path)
+                anns = client.get_pod(
+                    "ml", "gang-x")["metadata"]["annotations"]
+                owners = [(b[0], b[1]) for b in client.bindings]
+                converged = (
+                    cfg.migration_freeze == 0
+                    and consts.migration_intent_annotation() not in anns
+                    and len(owners) == len(set(owners))
+                    and (reaped == [] if site == "health.rescue"
+                         else reaped == ["gang-x"]))
+                re_reap = reap_stale_migrations(
+                    client, base_for, now=time.time(),
+                    lease_probe=lambda: type("L", (), {"token": 2})())
+                rounds.append({"site": site, "seed": seed,
+                               "frozen_after": cfg.migration_freeze,
+                               "reaped": reaped,
+                               "converged": bool(converged),
+                               "re_reap_empty": re_reap == []})
+                assert converged, rounds[-1]
+                assert re_reap == [], rounds[-1]
+    finally:
+        failpoints.disable()
+    doc["chaos"] = {"rounds": rounds,
+                    "converged": sum(1 for r in rounds
+                                     if r["converged"]),
+                    "total": len(rounds)}
+    assert doc["chaos"]["converged"] == doc["chaos"]["total"] >= 8
+    return doc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    t0 = time.perf_counter()
+    health_metrics.reset_health_totals()
+
+    doc = {
+        "bench": "health",
+        "revision": 19,
+        "scenario": {
+            "windows": K_WINDOWS,
+            "window_s": WINDOW_S,
+            "publish_s": PUBLISH_S,
+            "escalate_folds": ladder.ESCALATE_FOLDS,
+            "chip_core_cap": CHIP_CORE_CAP,
+            "chip_hbm_cap_bytes": CHIP_HBM_CAP,
+        },
+    }
+    run_detection(doc)
+    run_cordon(doc)
+    run_rescue(doc)
+    run_chaos(doc)
+    doc["asserts"] = {
+        "detection_ticks": doc["detection"]["publish_ticks_to_failed"],
+        "cordon_modes_agree": doc["cordon"]["modes_agree"],
+        "rescued": doc["rescue"]["rescued"],
+        "rescue_window": doc["rescue"]["first_eligible_window"],
+        "tail_windows_actions": doc["rescue"]["tail_windows_actions"],
+        "chaos_converged":
+            f"{doc['chaos']['converged']}/{doc['chaos']['total']}",
+    }
+    doc["wall_s"] = round(time.perf_counter() - t0, 2)
+
+    out_path = os.path.join(REPO, "BENCH_VTHEAL_r19.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        det = doc["detection"]
+        print(f"detection: failed in {det['publish_ticks_to_failed']} "
+              f"publish tick(s) (debounce floor "
+              f"{det['escalate_folds']}) on signals "
+              f"{'+'.join(det['signals'])}")
+        print("cordon: UnhealthyChip + DegradedLink attributed, both "
+              "scheduler modes agree, gate-off parity holds")
+        resc = doc["rescue"]
+        print(f"rescue: {len(resc['rescued'])}/2 doomed gangs rescued "
+              f"in window {resc['first_eligible_window']} (the first "
+              f"hysteresis-eligible), targets "
+              f"{sorted(set(resc['targets'].values()))}, park outcome "
+              f"{resc['park_outcome']['reason']}")
+        print(f"chaos: {doc['chaos']['converged']}/"
+              f"{doc['chaos']['total']} crash-mid-rescue rounds "
+              f"converged; wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,20 @@ def _print_decision(rec: dict) -> None:
               + ", ".join(f"{code} x{n}" for code, n in ranked))
 
 
+# vtheal: the two cordon reason codes get an operator hint — a pod
+# rejected by the health plane is waiting on a chip, not on capacity,
+# and the fix (watch the annotation decay, or the rescue) is different
+_CORDON_HINTS = {
+    "UnhealthyChip": (
+        "health-plane cordon: a chip on this node is degraded/failed; "
+        "lifts when the chip-health annotation reports healthy or goes "
+        "stale (vtpu-smi shows the HEALTH column)"),
+    "DegradedLink": (
+        "health-plane cordon: a failed ICI link leaves no submesh box "
+        "avoiding it; lifts with link recovery or signal staleness"),
+}
+
+
 def _print_doctor(verdict: dict) -> None:
     print(f"doctor: {verdict.get('verdict')} — {verdict.get('summary')}")
     for r in verdict.get("reasons") or []:
@@ -79,6 +93,9 @@ def _print_doctor(verdict: dict) -> None:
         print(f"  {r['nodes']} node(s) {r['reason']}"
               + (f" (e.g. {r['example']})" if r.get("example") else "")
               + stuck)
+        hint = _CORDON_HINTS.get(r.get("reason", ""))
+        if hint:
+            print(f"      -> {hint}")
     if verdict.get("passes"):
         print(f"  {verdict['passes']} recorded pass(es), last "
               f"{verdict.get('age_s', 0):.1f}s ago")

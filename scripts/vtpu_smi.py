@@ -133,6 +133,19 @@ def render(doc: dict, out=None) -> None:
             parts.append(f"last: {last_act} -> "
                          f"{str(last.get('tenant', ''))[:28]}")
         print("  " + "  ".join(parts), file=out)
+    # vtheal fleet headline (health documents only — a gate-off rollup
+    # carries no "health" key, so the prior output is byte-identical):
+    # how many chips the cordon currently holds out, broken down by
+    # ladder state, plus how many nodes are publishing the annotation
+    health = doc.get("health")
+    if health is not None:
+        by = health.get("by_state") or {}
+        spread = "  ".join(f"{state} x{count}"
+                           for state, count in sorted(by.items()))
+        print(f"  HEALTH: {health.get('nodes_publishing', 0)} node(s) "
+              f"publishing  {health.get('unhealthy_chips', 0)} "
+              f"unhealthy chip(s)" + (f"  {spread}" if spread else ""),
+              file=out)
     # vtqm evidence loop (market documents only): per-lease
     # borrowed-vs-used — did the borrower use what it borrowed?
     for bu in (quota or {}).get("borrowed_used") or []:
@@ -199,8 +212,15 @@ def render(doc: dict, out=None) -> None:
                             or ch.get("spilled_bytes") is not None
                             for ch in nrow["chips"])
             oc_hdr = f" {'virt':>8} {'spill':>8}" if show_virt else ""
+            # vtheal: HEALTH column appears only when the document
+            # carries chip-health state (HealthPlane on at the monitor)
+            # — a gate-off document renders exactly the prior table
+            show_health = any(ch.get("health") is not None
+                              for ch in nrow["chips"])
+            health_hdr = f" {'health':>9}" if show_health else ""
             print(f"  {'chip':>4} {'uuid':<20} {'quota':>7} {'used':>7} "
-                  f"{'reclaim':>8} {'hbm-reclaim':>11}{oc_hdr}",
+                  f"{'reclaim':>8} {'hbm-reclaim':>11}{oc_hdr}"
+                  f"{health_hdr}",
                   file=out)
             for ch in nrow["chips"]:
                 extra = ""
@@ -210,6 +230,8 @@ def render(doc: dict, out=None) -> None:
                     # other live columns
                     extra = (f" {_gib(ch.get('virt_hbm_bytes')):>8}"
                              f" {_gib(ch.get('spilled_bytes')):>8}")
+                if show_health:
+                    extra += f" {ch.get('health') or '-':>9}"
                 print(f"  {ch.get('index', '?'):>4} "
                       f"{str(ch.get('uuid', ''))[:20]:<20} "
                       f"{_pct(ch.get('alloc_core_pct')):>7} "

@@ -558,6 +558,16 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     failpoints.arm("migrate.freeze", rng.choice(["crash", "error"]),
                    p=0.2, count=1)
     failpoints.arm("migrate.refill", "crash", p=0.2, count=1)
+    # vtheal sites: driven by the dedicated health chaos tests (the
+    # crash-mid-rescue test below + test_health.py — the e2e loop here
+    # runs no publisher and no autopilot), armed so the full-coverage
+    # assertion stays the honest catalog check
+    failpoints.arm("health.probe", rng.choice(["error", "latency"]),
+                   latency_s=0.0005, p=0.2, count=rng.randint(1, 2))
+    failpoints.arm("health.flip", rng.choice(["crash", "error"]),
+                   p=0.2, count=1)
+    failpoints.arm("health.rescue", rng.choice(["crash", "error"]),
+                   p=0.2, count=1)
     # vtscale: fires inside a bind wave after a pod's intent patch and
     # before the wave's single confirm — crash = a torn wave (N torn
     # serial binds), error = that pod degrades to the serial path
@@ -1325,3 +1335,99 @@ def test_chaos_torn_bind_wave_converges(tmp_path):
     assert sorted(names) == sorted(pods)
     assert pipeline.degraded >= 1
     pipeline.shutdown()
+
+
+# ===========================================================================
+# vtheal crash-mid-rescue: leader death anywhere in the rescue window
+# must converge through the PR 17 migration reapers
+# ===========================================================================
+
+def test_chaos_crash_mid_rescue_converges(tmp_path):
+    """Two crash windows of a chip-failure rescue. (1) death at
+    health.rescue — before any freeze or intent is written: nothing is
+    torn and the successor's next eligible window simply retries; the
+    retry must also skip a health-cordoned candidate node (never rescue
+    INTO a draining box). (2) death at migrate.refill — the worst
+    shape: gang rebound to the target but still frozen, intent trail
+    up — a successor leader's higher fencing token reaps INSIDE the
+    TTL: unfrozen, trail cleared, exactly one binding."""
+    import time as _time
+
+    from vtpu_manager.autopilot import (ActionContext, GangMigrator,
+                                        reap_stale_migrations)
+    from vtpu_manager.autopilot import actions as ap_actions
+    from vtpu_manager.config import vtpu_config as vc
+    from vtpu_manager.health.codec import NodeChipHealth
+
+    gib = 1 << 30
+    client = FakeKubeClient()
+    now = _time.time()
+    # n-bad publishes a fresh failed-chip cordon; it sorts FIRST among
+    # candidates, so only the rescue's exclusion keeps it out
+    client.add_node({"metadata": {"name": "n-bad", "annotations": {
+        consts.node_chip_health_annotation():
+            NodeChipHealth(chips={0: ("failed", 0.9)},
+                           ts=now).encode()}}})
+    client.add_node({"metadata": {"name": "n-dst", "annotations": {}}})
+    client.add_node({"metadata": {"name": "n-src", "annotations": {}}})
+    bases = {n: str(tmp_path / n) for n in ("n-src", "n-dst", "n-bad")}
+
+    def add_gang(name: str, uid: str) -> str:
+        client.add_pod({
+            "metadata": {"name": name, "namespace": "ml", "uid": uid,
+                         "annotations": {}},
+            "spec": {"nodeName": "n-src",
+                     "containers": [{"name": "main"}]},
+            "status": {"phase": "Running"}})
+        path = os.path.join(bases["n-src"], f"{uid}_main", "config",
+                            "vtpu.config")
+        vc.write_config(path, vc.VtpuConfig(
+            pod_uid=uid, pod_name=name, pod_namespace="ml",
+            container_name="main",
+            devices=[vc.DeviceConfig(uuid="TPU-FAKE-0000",
+                                     total_memory=gib, real_memory=gib,
+                                     hard_core=80, host_index=0)]))
+        return path
+
+    def verdict(uid: str) -> dict:
+        return {"kind": "chip-failure", "tenant": f"{uid}/main",
+                "node": "n-src", "chips": [0],
+                "episode_onset_ts": now, "goodput": 1.0}
+
+    path0 = add_gang("gang-0", "uid-r0")
+    path1 = add_gang("gang-1", "uid-r1")
+    mig = GangMigrator(client, bases.get)
+    ctx = ActionContext(client, bases.get, migrator=mig)
+    failpoints.enable(seed=31)
+
+    # window 1: death before dispatch — nothing torn, nothing to reap
+    failpoints.arm("health.rescue", "crash", p=1.0, count=1)
+    with pytest.raises(failpoints.CrashFailpoint):
+        ap_actions.rescue_gang(ctx, verdict("uid-r0"), "autopilot:1")
+    anns = client.get_pod("ml", "gang-0")["metadata"]["annotations"]
+    assert consts.migration_intent_annotation() not in anns
+    assert vc.read_config(path0).migration_freeze == 0
+    # the successor's retry rescues cleanly AND skips the cordoned box
+    out = ap_actions.rescue_gang(ctx, verdict("uid-r0"), "autopilot:2")
+    assert out["ok"] and out["target"] == "n-dst"
+    assert ("ml", "gang-0", "n-dst") in client.bindings
+    assert vc.read_config(path0).migration_freeze == 0
+
+    # window 2: death after the rebind, before the unfreeze rewrites
+    failpoints.arm("migrate.refill", "crash", p=1.0, count=1)
+    with pytest.raises(failpoints.CrashFailpoint):
+        ap_actions.rescue_gang(ctx, verdict("uid-r1"), "autopilot:2")
+    failpoints.disable()
+    assert vc.read_config(path1).migration_freeze == 1
+    assert ("ml", "gang-1", "n-dst") in client.bindings
+    reaper = GangMigrator(client, bases.get)
+    reaped = reap_stale_migrations(
+        client, bases.get, now=_time.time(),
+        lease_probe=lambda: type("L", (), {"token": 3})(),
+        migrator=reaper)
+    assert reaped == ["gang-1"]
+    assert reaper.reaped_total == 1
+    assert vc.read_config(path1).migration_freeze == 0
+    anns = client.get_pod("ml", "gang-1")["metadata"]["annotations"]
+    assert consts.migration_intent_annotation() not in anns
+    assert client.bindings.count(("ml", "gang-1", "n-dst")) == 1

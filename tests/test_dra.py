@@ -552,6 +552,11 @@ class TestDraHealth:
         assert published == []
 
         bad.add(chips[0].uuid)
+        # the vtheal flip hysteresis: the streak must complete before
+        # the slice republishes — a single probe blip is not a flip
+        for _ in range(watcher._watcher.flip_after - 1):
+            assert watcher.check_once() == []
+        assert published == []
         assert [c.uuid for c in watcher.check_once()] == [chips[0].uuid]
         devices = published[-1]["spec"]["devices"]
         by_health = {}
@@ -568,13 +573,16 @@ class TestDraHealth:
                    for d in devices)
 
     def test_probe_exception_is_unhealthy(self, state):
+        """A raising probe is unhealthy evidence, debounced by the
+        vtheal flip_after streak like any failed verdict."""
         from vtpu_manager.kubeletplugin.health import DraHealthWatcher
         chips = [fake_chip(0)]
         seen = []
         watcher = DraHealthWatcher(
             chips, probe=lambda c: (_ for _ in ()).throw(OSError("io")),
             on_change=seen.append)
-        watcher.check_once()
+        for _ in range(watcher._watcher.flip_after):
+            watcher.check_once()
         assert not chips[0].healthy and seen
 
 
@@ -591,6 +599,9 @@ class TestDraHealth:
         watcher = DraHealthWatcher(chips,
                                    probe=lambda c: c.uuid not in bad,
                                    on_change=flaky_publish)
+        for _ in range(watcher._watcher.flip_after - 1):
+            watcher.check_once()      # streak building: no flip yet
+        assert calls == []
         watcher.check_once()          # flip + failed publish
         assert calls == [1] and watcher._dirty
         watcher.check_once()          # no new flip, but dirty -> retried

@@ -1,0 +1,638 @@
+"""vtheal: the chip/link health plane (ISSUE r19).
+
+Covers the detect -> cordon -> rescue chain plus the gate-off
+byte-contract:
+
+- codec: annotation roundtrip, garbage-means-no-signal parsing, the
+  staleness decay direction (a dead publisher UN-cordons);
+- ladder: no single signal cordons (stall alone = suspect forever),
+  probe alone degrades, corroboration fails, fold-count hysteresis in
+  both directions, linear evidence decay, link edge debounce;
+- signals: step-ring stall/exec-error evidence off REAL rings;
+- the probe fail-open fix: a probe that cannot RUN proves nothing
+  about any chip (None + audit counter, never a flip), and the
+  HealthWatcher flip_after streak;
+- publisher: evidence in, one stalecodec annotation out, flips
+  counted, exec-failures fail-open;
+- cordon in BOTH scheduler paths: UnhealthyChip / DegradedLink
+  attribution, stale-signal un-cordon, and gate-off placement parity;
+- rescue fold: failed chips -> chip-failure verdicts (goodput
+  DESCENDING, degraded keeps residents), target exclusion;
+- /utilization rollup: per-chip HEALTH field + fleet headline, absent
+  byte-identical when the gate is off.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.device import types as dt
+from vtpu_manager.health import codec, ladder, rescue, signals
+from vtpu_manager.health import metrics as health_metrics
+from vtpu_manager.health.publisher import ChipHealthPublisher
+from vtpu_manager.manager.device_manager import (DeviceManager,
+                                                 HealthWatcher,
+                                                 make_external_probe)
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.scheduler import reason as R
+from vtpu_manager.telemetry import stepring
+from vtpu_manager.util import consts
+
+GIB = 2**30
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    failpoints.disable()
+    health_metrics.reset_health_totals()
+    yield
+    failpoints.disable()
+    health_metrics.reset_health_totals()
+
+
+def _mk_config(base, pod_uid, container="main", host_indexes=(0,),
+               hard_core=80, total_memory=8 * GIB):
+    path = os.path.join(base, f"{pod_uid}_{container}", "config",
+                        "vtpu.config")
+    vc.write_config(path, vc.VtpuConfig(
+        pod_uid=pod_uid, pod_name=pod_uid, pod_namespace="ml",
+        container_name=container,
+        devices=[vc.DeviceConfig(uuid=f"TPU-FAKE-{i:04d}",
+                                 total_memory=total_memory,
+                                 real_memory=total_memory,
+                                 hard_core=hard_core, host_index=i)
+                 for i in host_indexes]))
+    return path
+
+
+def _mk_ring(base, pod_uid, container="main"):
+    d = os.path.join(base, f"{pod_uid}_{container}",
+                     consts.TELEMETRY_SUBDIR)
+    os.makedirs(d, exist_ok=True)
+    return stepring.StepRingWriter(
+        os.path.join(d, consts.STEP_RING_NAME))
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+class TestHealthCodec:
+    def test_roundtrip_with_links(self):
+        ts = time.time()
+        h = codec.NodeChipHealth(
+            chips={0: (codec.FAILED, 0.9), 3: (codec.SUSPECT, 0.3)},
+            links=frozenset({((0, 1, 0), 1)}), ts=ts)
+        back = codec.parse_chip_health(h.encode(), now=ts + 1)
+        assert back is not None
+        assert back.chips == {0: (codec.FAILED, 0.9),
+                              3: (codec.SUSPECT, 0.3)}
+        assert back.links == frozenset({((0, 1, 0), 1)})
+        assert abs(back.ts - ts) < 1.0
+
+    def test_healthy_chips_omitted_from_wire(self):
+        h = codec.NodeChipHealth(
+            chips={0: (codec.HEALTHY, 0.0), 1: (codec.DEGRADED, 0.6)},
+            ts=time.time())
+        wire = h.encode()
+        assert "0:" not in wire.split("@")[0].split("|")[0].split(";")[0] \
+            or wire.startswith("1:")
+        back = codec.parse_chip_health(wire)
+        assert 0 not in back.chips and 1 in back.chips
+
+    def test_empty_body_is_clean_bill(self):
+        h = codec.NodeChipHealth(ts=time.time())
+        back = codec.parse_chip_health(h.encode())
+        assert back is not None
+        assert back.chips == {} and back.links == frozenset()
+        assert codec.cordon_mask(back) == frozenset()
+
+    def test_garbage_means_no_signal(self):
+        ts = f"{time.time():.3f}"
+        for raw in (None, "", "not-a-codec",
+                    f"0:exploded:0.9@{ts}",            # unknown state
+                    f"0:failed:nan@{ts}",              # NaN confidence
+                    f"-1:failed:0.9@{ts}",             # negative index
+                    f"0:failed@{ts}",                  # missing conf
+                    f"|L0.0.0.5:failed@{ts}",          # bad axis
+                    f"|L0.0.0:failed@{ts}",            # short link key
+                    f"|L0.0.0.1:flaky@{ts}",           # bad verdict
+                    "0:failed:0.9@not-a-ts"):
+            assert codec.parse_chip_health(raw) is None, raw
+
+    def test_staleness_uncordons(self):
+        """The decay direction of the whole plane: a dead publisher's
+        last claim must never keep rejecting capacity."""
+        old = time.time() - codec.MAX_HEALTH_AGE_S - 5
+        wire = codec.NodeChipHealth(chips={0: (codec.FAILED, 0.9)},
+                                    ts=old).encode()
+        assert codec.parse_chip_health(wire) is None
+        # and a cached parse (the snapshot path) re-judges at use time
+        fresh_then = codec.parse_chip_health(wire, now=old + 1)
+        assert fresh_then is not None
+        assert codec.cordon_mask(fresh_then, now=time.time()) == \
+            frozenset()
+        assert codec.failed_chips(fresh_then, now=time.time()) == \
+            frozenset()
+        assert codec.dead_links(fresh_then, now=time.time()) == \
+            frozenset()
+
+    def test_cordon_mask_excludes_suspect(self):
+        h = codec.NodeChipHealth(
+            chips={0: (codec.SUSPECT, 0.3), 1: (codec.DEGRADED, 0.6),
+                   2: (codec.FAILED, 0.9)},
+            ts=time.time())
+        assert codec.cordon_mask(h) == frozenset({1, 2})
+        # rescue drains only FAILED (degraded keeps its residents)
+        assert codec.failed_chips(h) == frozenset({2})
+
+    def test_masked_registry_identity_and_memo(self):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2))
+        assert codec.masked_registry(reg, frozenset()) is reg
+        mask = frozenset({1, 3})
+        masked = codec.masked_registry(reg, mask)
+        assert masked is not reg
+        assert [c.healthy for c in masked.chips] == \
+            [True, False, True, False]
+        assert [c.healthy for c in reg.chips] == [True] * 4
+        # memoized per (registry, mask): the TTL path's repeated visits
+        assert codec.masked_registry(reg, mask) is masked
+
+
+# ---------------------------------------------------------------------------
+# ladder
+# ---------------------------------------------------------------------------
+
+class TestLadder:
+    def test_stall_alone_never_cordons(self):
+        """A wedged tenant is real but not the chip's fault: stall
+        evidence alone pins at suspect forever."""
+        chip = ladder.ChipLadder()
+        for t in range(0, 100, 10):
+            chip.observe("stall", True, float(t))
+            chip.fold(float(t))
+        assert chip.state == codec.SUSPECT
+
+    def test_probe_alone_degrades_after_hysteresis(self):
+        chip = ladder.ChipLadder()
+        chip.observe("probe", True, 0.0)
+        assert chip.fold(0.0) == codec.HEALTHY      # fold 1: pending
+        chip.observe("probe", True, 1.0)
+        assert chip.fold(1.0) == codec.DEGRADED     # fold 2: escalate
+        # probe alone never reaches FAILED (0.60 < 0.80)
+        chip.observe("probe", True, 2.0)
+        assert chip.fold(2.0) == codec.DEGRADED
+
+    def test_probe_plus_corroboration_fails(self):
+        chip = ladder.ChipLadder()
+        for t in (0.0, 1.0):
+            chip.observe("probe", True, t)
+            chip.observe("exec", True, t)
+            chip.fold(t)
+        assert chip.state == codec.FAILED
+
+    def test_recovery_needs_more_folds_than_escalation(self):
+        chip = ladder.ChipLadder()
+        for t in (0.0, 1.0):
+            chip.observe("probe", True, t)
+            chip.fold(t)
+        assert chip.state == codec.DEGRADED
+        chip.observe("probe", False, 2.0)           # healthy: retract
+        for i in range(ladder.RECOVER_FOLDS - 1):
+            assert chip.fold(2.0 + i) == codec.DEGRADED
+        assert chip.fold(10.0) == codec.HEALTHY
+
+    def test_evidence_decays_to_zero(self):
+        chip = ladder.ChipLadder()
+        chip.observe("probe", True, 0.0)
+        full = chip.confidence(0.0)
+        half = chip.confidence(ladder.SIGNAL_TTL_S / 2)
+        assert full == ladder.SIGNAL_WEIGHTS["probe"]
+        assert abs(half - full / 2) < 1e-9
+        assert chip.confidence(ladder.SIGNAL_TTL_S + 1) == 0.0
+
+    def test_unknown_signal_rejected(self):
+        with pytest.raises(ValueError):
+            ladder.ChipLadder().observe("vibes", True, 0.0)
+
+    def test_link_debounce_both_directions(self):
+        node = ladder.NodeHealthLadder()
+        lid = ((0, 0, 0), 0)
+        node.observe_link(lid, True)
+        assert node.failed_links() == frozenset()   # one bad = noise
+        node.observe_link(lid, True)
+        assert node.failed_links() == frozenset({lid})
+        node.observe_link(lid, False)
+        assert node.failed_links() == frozenset({lid})
+        node.observe_link(lid, False)
+        assert node.failed_links() == frozenset()
+
+    def test_node_fold_records_flips(self):
+        node = ladder.NodeHealthLadder(clock=lambda: 0.0)
+        node.observe_chip(0, "probe", True, now=0.0)
+        node.fold(0.0)
+        node.observe_chip(0, "probe", True, now=1.0)
+        health = node.fold(1.0)
+        assert node.last_flips == [(0, codec.HEALTHY, codec.DEGRADED)]
+        assert health.chips[0][0] == codec.DEGRADED
+
+
+# ---------------------------------------------------------------------------
+# ring signals
+# ---------------------------------------------------------------------------
+
+class TestRingSignals:
+    def test_exec_error_streak_is_trailing(self):
+        recs = [stepring.StepRecord(index=i, start_mono_ns=0,
+                                    duration_ns=1,
+                                    flags=stepring.FLAG_EXEC_ERROR
+                                    if err else 0)
+                for i, err in enumerate([True, False, True, True])]
+        assert signals.exec_error_streak(recs) == 2
+        assert signals.exec_error_streak(recs[:2]) == 0
+        assert signals.exec_error_streak([]) == 0
+
+    def test_stall_tracker_verdicts(self):
+        t = signals.StallTracker(stall_after_s=10.0)
+        assert t.observe("k", 0, 0.0) is None       # never stepped
+        assert t.observe("k", 5, 1.0) is False      # progressing
+        assert t.observe("k", 9, 2.0) is False      # progressing
+        assert t.observe("k", 9, 5.0) is None       # still, in budget
+        assert t.observe("k", 9, 13.0) is True      # stalled
+        assert t.observe("k", 10, 14.0) is False    # recovered
+
+    def test_collect_ring_evidence(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-a", host_indexes=(0, 1))
+        w = _mk_ring(base, "uid-a")
+        for _ in range(signals.EXEC_STREAK_N):
+            w.record(duration_ns=10**8, exec_error=True)
+        w.close()
+        tracker = signals.StallTracker()
+        ev = signals.collect_ring_evidence(base, tracker, time.time())
+        # exec streak asserts on EVERY chip of the allocation; no
+        # stall verdict yet (first sighting)
+        assert ev == {0: {"stall": False, "exec": True},
+                      1: {"stall": False, "exec": True}}
+        # a chip with no residents contributes nothing
+        assert 2 not in ev
+
+
+# ---------------------------------------------------------------------------
+# the probe fail-open fix (satellite a)
+# ---------------------------------------------------------------------------
+
+class TestProbeFailOpen:
+    def test_external_probe_verdict_vocabulary(self):
+        chip = dt.fake_chip(0)
+        assert make_external_probe("/bin/true")(chip) is True
+        assert make_external_probe("/bin/false")(chip) is False
+        before = health_metrics.probe_exec_failures()
+        assert make_external_probe(
+            "/nonexistent/vtpu-health-probe")(chip) is None
+        assert health_metrics.probe_exec_failures() == before + 1
+
+    def test_watcher_flip_needs_streak(self):
+        """One transient probe blip used to de-advertise the chip on
+        the spot; now flip_after consecutive failures are required and
+        a None verdict neither extends nor resets the streak."""
+        client = FakeKubeClient()
+        mgr = DeviceManager("n1", client)
+        mgr.chips = dt.fake_registry(1).chips
+        flips = []
+        mgr.mark_unhealthy = lambda uuid: flips.append(("down", uuid))
+        mgr.mark_healthy = lambda uuid: flips.append(("up", uuid))
+        verdicts = iter([False, False, None, False, True])
+        watcher = HealthWatcher(mgr, lambda chip: next(verdicts),
+                                flip_after=3)
+        for _ in range(4):
+            watcher.check_once()
+        # fail, fail, None (no evidence), fail -> streak 3 -> flip
+        assert flips == [("down", mgr.chips[0].uuid)]
+        mgr.chips = [dt.fake_chip(0, healthy=False)]   # frozen spec
+        watcher.check_once()            # recovery is immediate
+        assert flips[-1] == ("up", mgr.chips[0].uuid)
+
+    def test_watcher_single_blip_no_flip(self):
+        client = FakeKubeClient()
+        mgr = DeviceManager("n1", client)
+        mgr.chips = dt.fake_registry(1).chips
+        flips = []
+        mgr.mark_unhealthy = lambda uuid: flips.append(uuid)
+        verdicts = iter([False, True, False, True])
+        watcher = HealthWatcher(mgr, lambda chip: next(verdicts),
+                                flip_after=3)
+        for _ in range(4):
+            watcher.check_once()
+        assert flips == []
+
+
+# ---------------------------------------------------------------------------
+# publisher
+# ---------------------------------------------------------------------------
+
+class TestPublisher:
+    def _publisher(self, tmp_path, probe, chips=2, **kw):
+        client = FakeKubeClient(upsert_on_patch=True)
+        client.add_node({"metadata": {"name": "n1", "annotations": {}}})
+        pub = ChipHealthPublisher(
+            client, "n1", {i: (i, 0, 0) for i in range(chips)},
+            str(tmp_path / "mgr"), probe=probe, **kw)
+        return client, pub
+
+    def _annotation(self, client):
+        return (client.get_node("n1")["metadata"]["annotations"]
+                .get(consts.node_chip_health_annotation()))
+
+    def test_bad_probe_publishes_degraded(self, tmp_path):
+        client, pub = self._publisher(
+            tmp_path, lambda index: index != 0)
+        pub.publish_once(now=time.time())
+        first = codec.parse_chip_health(self._annotation(client))
+        assert first.chips.get(0, (codec.HEALTHY,))[0] == codec.SUSPECT \
+            or 0 not in first.chips     # fold 1: still pending
+        pub.publish_once(now=time.time())
+        second = codec.parse_chip_health(self._annotation(client))
+        assert second.chips[0][0] == codec.DEGRADED
+        assert 1 not in second.chips    # healthy chip: absent from wire
+        assert "degraded" in health_metrics.render_health_metrics("n1")
+
+    def test_exec_failure_fails_open(self, tmp_path):
+        def broken(index):
+            raise OSError("no such binary")
+        client, pub = self._publisher(tmp_path, broken)
+        before = health_metrics.probe_exec_failures()
+        health = pub.publish_once(now=time.time())
+        assert health.chips == {}       # no evidence either way
+        assert health_metrics.probe_exec_failures() == before + 2
+        parsed = codec.parse_chip_health(self._annotation(client))
+        assert parsed is not None and parsed.chips == {}
+
+    def test_ring_evidence_feeds_ladder(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-a", host_indexes=(0,))
+        w = _mk_ring(base, "uid-a")
+        for _ in range(signals.EXEC_STREAK_N):
+            w.record(duration_ns=10**8, exec_error=True)
+        w.close()
+        client, pub = self._publisher(
+            tmp_path, lambda index: False)   # probe corroborates
+        now = time.time()
+        pub.publish_once(now=now)
+        health = pub.publish_once(now=now + 1)
+        # probe (0.60) + exec (0.35) >= FAILED_AT on chip 0; chip 1 has
+        # no residents, so the probe alone holds it at degraded
+        assert health.chips[0][0] == codec.FAILED
+        assert health.chips[1][0] == codec.DEGRADED
+
+    def test_gate_off_renders_no_series(self):
+        assert health_metrics.render_health_metrics("n1") == ""
+        assert health_metrics.render_rescue_metrics() == ""
+
+
+# ---------------------------------------------------------------------------
+# cordon: both scheduler paths
+# ---------------------------------------------------------------------------
+
+def _health_cluster(cordon_node=None, states=None, ts=None,
+                    links=frozenset(), chips=2):
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in ("node-a", "node-b"):
+        reg = dt.fake_registry(chips, mesh_shape=(chips, 1),
+                               uuid_prefix=name.upper())
+        client.add_node(dt.fake_node(name, reg))
+    if cordon_node:
+        wire = codec.NodeChipHealth(
+            chips=states or {}, links=links,
+            ts=time.time() if ts is None else ts).encode()
+        client.patch_node_annotations(
+            cordon_node, {consts.node_chip_health_annotation(): wire})
+    return client
+
+
+def _pod(name="p1", number=1, cores=10, annotations=None):
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}",
+                     "annotations": annotations or {}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): number,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): 1024}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def _pred(client, mode, **kw):
+    snap = None
+    if mode == "snapshot":
+        snap = ClusterSnapshot(client)
+        snap.start()
+    return FilterPredicate(client, snapshot=snap, **kw)
+
+
+class TestCordon:
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_failed_chips_cordon_with_attribution(self, mode):
+        client = _health_cluster(
+            "node-a", {0: (codec.FAILED, 0.9), 1: (codec.FAILED, 0.9)})
+        pred = _pred(client, mode, health_plane=True)
+        pod = _pod()
+        client.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert result.node_names == ["node-b"]
+        # the cordon — not real exhaustion — shaped the verdict
+        assert result.failed_nodes["node-a"] == R.UNHEALTHY_CHIP
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_degraded_cordons_admissions_too(self, mode):
+        client = _health_cluster(
+            "node-a",
+            {0: (codec.DEGRADED, 0.6), 1: (codec.DEGRADED, 0.6)})
+        pred = _pred(client, mode, health_plane=True)
+        pod = _pod()
+        client.add_pod(pod)
+        assert pred.filter({"Pod": pod}).node_names == ["node-b"]
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_suspect_schedules_normally(self, mode):
+        client = _health_cluster(
+            "node-a", {0: (codec.SUSPECT, 0.3), 1: (codec.SUSPECT, 0.3)})
+        pred = _pred(client, mode, health_plane=True)
+        pod = _pod()
+        client.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert not result.error
+        assert "node-a" not in result.failed_nodes
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_stale_signal_uncordons(self, mode):
+        client = _health_cluster(
+            "node-a", {0: (codec.FAILED, 0.9), 1: (codec.FAILED, 0.9)},
+            ts=time.time() - codec.MAX_HEALTH_AGE_S - 5)
+        pred = _pred(client, mode, health_plane=True)
+        pod = _pod()
+        client.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert not result.error
+        assert "node-a" not in result.failed_nodes
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_dead_link_hard_excludes_submesh(self, mode):
+        """A failed ICI edge on a 2x2 mesh leaves no 4-chip box
+        avoiding it: ici-strict placement must reject the node and
+        name the cordon, not capacity."""
+        client = FakeKubeClient(upsert_on_patch=True)
+        reg = dt.fake_registry(4, mesh_shape=(2, 2))
+        client.add_node(dt.fake_node("node-a", reg))
+        wire = codec.NodeChipHealth(
+            links=frozenset({((0, 0, 0), 0)}), ts=time.time()).encode()
+        client.patch_node_annotations(
+            "node-a", {consts.node_chip_health_annotation(): wire})
+        pred = _pred(client, mode, health_plane=True)
+        pod = _pod(number=4, annotations={
+            consts.topology_mode_annotation(): "ici-strict"})
+        client.add_pod(pod)
+        result = pred.filter({"Pod": pod})
+        assert result.error
+        assert R.DEGRADED_LINK in result.failed_nodes["node-a"]
+        # gate off: the same annotation changes nothing
+        pred_off = _pred(client, mode)
+        ok = pred_off.filter({"Pod": _pod(name="p2", number=4,
+                                          annotations={
+                                              consts
+                                              .topology_mode_annotation():
+                                              "ici-strict"})})
+        assert not ok.error
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_gate_off_placement_is_byte_identical(self, mode):
+        """The annotation present but the gate off must place exactly
+        like no annotation at all — in BOTH data paths."""
+        results = {}
+        for tag in ("annotated", "clean"):
+            client = _health_cluster(
+                "node-a" if tag == "annotated" else None,
+                {0: (codec.FAILED, 0.9), 1: (codec.FAILED, 0.9)})
+            pred = _pred(client, mode)          # health_plane=False
+            pod = _pod()
+            client.add_pod(pod)
+            r = pred.filter({"Pod": pod})
+            results[tag] = (r.node_names, dict(r.failed_nodes))
+        assert results["annotated"] == results["clean"]
+
+
+# ---------------------------------------------------------------------------
+# rescue fold
+# ---------------------------------------------------------------------------
+
+class TestRescueFold:
+    def _client(self, states, node="n-bad", ts=None):
+        client = FakeKubeClient(upsert_on_patch=True)
+        wire = codec.NodeChipHealth(
+            chips=states, ts=time.time() if ts is None else ts).encode()
+        client.add_node({"metadata": {
+            "name": node,
+            "annotations": {consts.node_chip_health_annotation(): wire}}})
+        client.add_node({"metadata": {"name": "n-ok", "annotations": {}}})
+        return client
+
+    def test_verdicts_goodput_descending(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-busy", host_indexes=(0,))
+        _mk_config(base, "uid-idle", host_indexes=(0,))
+        _mk_config(base, "uid-safe", host_indexes=(1,))
+        client = self._client({0: (codec.FAILED, 0.9)})
+        health = rescue.node_chip_health(client, "n-bad")
+        goodputs = {"uid-busy": 0.95, "uid-idle": 0.40}
+        verdicts = rescue.rescue_verdicts(
+            "n-bad", base, health,
+            goodput_for=lambda uid, cont: goodputs.get(uid, 1.0))
+        # only residents of the FAILED chip, most productive first
+        assert [v["tenant"] for v in verdicts] == \
+            ["uid-busy/main", "uid-idle/main"]
+        v = verdicts[0]
+        assert v["kind"] == "chip-failure" and v["node"] == "n-bad"
+        assert v["chips"] == [0]
+        assert v["episode_onset_ts"] == round(health.ts, 3)
+
+    def test_degraded_keeps_residents(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-a", host_indexes=(0,))
+        client = self._client({0: (codec.DEGRADED, 0.6)})
+        health = rescue.node_chip_health(client, "n-bad")
+        assert rescue.rescue_verdicts("n-bad", base, health) == []
+
+    def test_unhealthy_nodes_is_the_exclusion_set(self):
+        client = self._client({0: (codec.DEGRADED, 0.6)})
+        assert rescue.unhealthy_nodes(client) == {"n-bad"}
+        stale = self._client({0: (codec.FAILED, 0.9)},
+                             ts=time.time() - codec.MAX_HEALTH_AGE_S - 5)
+        assert rescue.unhealthy_nodes(stale) == set()
+
+    def test_cluster_feed_skips_nodes_without_base(self, tmp_path):
+        base = str(tmp_path / "mgr")
+        _mk_config(base, "uid-a", host_indexes=(0,))
+        client = self._client({0: (codec.FAILED, 0.9)})
+        out = rescue.chip_failure_verdicts(
+            client, lambda n: base if n == "n-bad" else "",
+            goodput_for=lambda uid, cont: 1.0)
+        assert [v["tenant"] for v in out] == ["uid-a/main"]
+
+    def test_ring_goodput_neutral_prior(self, tmp_path):
+        assert rescue.ring_goodput(str(tmp_path), "ghost", "main") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# /utilization rollup (the vtpu-smi HEALTH column's source)
+# ---------------------------------------------------------------------------
+
+class TestRollupHealth:
+    def _doc(self, health_gate, annotate=True, tmp_path="/tmp"):
+        from vtpu_manager.utilization import UtilizationLedger
+        from vtpu_manager.utilization.rollup import ClusterRollup
+        client = FakeKubeClient(upsert_on_patch=True)
+        reg = dt.fake_registry(2)
+        client.add_node(dt.fake_node("node-a", reg))
+        if annotate:
+            wire = codec.NodeChipHealth(
+                chips={0: (codec.FAILED, 0.9)}, ts=time.time()).encode()
+            client.patch_node_annotations(
+                "node-a", {consts.node_chip_health_annotation(): wire})
+        ledger = UtilizationLedger("node-a", reg.chips,
+                                   base_dir=str(tmp_path))
+        return ClusterRollup(ledger, client,
+                             health=health_gate).collect()
+
+    def test_gate_on_headline_and_chip_field(self, tmp_path):
+        doc = self._doc(True, tmp_path=tmp_path)
+        assert doc["health"] == {"nodes_publishing": 1,
+                                 "unhealthy_chips": 1,
+                                 "by_state": {"failed": 1}}
+        chips = {c["index"]: c for c in doc["nodes"][0]["chips"]}
+        assert chips[0]["health"] == codec.FAILED
+        assert chips[1]["health"] == codec.HEALTHY
+
+    def test_gate_off_document_is_byte_identical(self, tmp_path):
+        """Annotation present, gate off: no "health" key anywhere —
+        the document a pre-vtheal monitor produced."""
+        doc = self._doc(False, tmp_path=tmp_path)
+        assert "health" not in doc
+        for ch in doc["nodes"][0]["chips"]:
+            assert "health" not in ch
+        assert "unhealthy_chips" not in doc["nodes"][0]
+
+    def test_no_annotation_counts_nothing(self, tmp_path):
+        doc = self._doc(True, annotate=False, tmp_path=tmp_path)
+        assert doc["health"] == {"nodes_publishing": 0,
+                                 "unhealthy_chips": 0, "by_state": {}}
+        for ch in doc["nodes"][0]["chips"]:
+            assert ch["health"] == codec.HEALTHY

@@ -116,6 +116,9 @@ class TestHealth:
         assert flips[-1] == ("node-a-chip-2", True)
 
     def test_health_watcher_probe_drives_flips(self, client, tmp_path):
+        """The vtheal flip hysteresis: ``flip_after`` CONSECUTIVE
+        failed probes de-advertise a chip (one blip used to), recovery
+        is immediate."""
         mgr = make_manager(client, tmp_path)
         mgr.init_devices()
         mgr.register_node()
@@ -126,6 +129,10 @@ class TestHealth:
         assert all(c.healthy for c in mgr.chips)
 
         bad.add("node-a-chip-0")
+        for _ in range(watcher.flip_after - 1):
+            watcher.check_once()
+            # a blip below the streak never flips
+            assert all(c.healthy for c in mgr.chips)
         watcher.check_once()
         assert [c.healthy for c in mgr.chips] == [False, True, True, True]
 
@@ -134,11 +141,17 @@ class TestHealth:
         assert all(c.healthy for c in mgr.chips)
 
     def test_probe_exception_means_unhealthy(self, client, tmp_path):
+        """A RAISING probe is unhealthy evidence (the chip-side verdict
+        failed), still debounced by the streak — unlike the OSError
+        launch-failure leg inside make_external_probe, which is
+        fail-open (None, no evidence)."""
         mgr = make_manager(client, tmp_path)
         mgr.init_devices()
 
         def probe(chip):
             raise RuntimeError("libtpu probe crashed")
 
-        HealthWatcher(mgr, probe=probe).check_once()
+        watcher = HealthWatcher(mgr, probe=probe)
+        for _ in range(watcher.flip_after):
+            watcher.check_once()
         assert not any(c.healthy for c in mgr.chips)

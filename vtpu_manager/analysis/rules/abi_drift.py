@@ -45,7 +45,7 @@ TRACKED: dict[str, tuple[str, list[str]]] = {
     "stepring": ("telemetry/stepring.py", [
         "MAGIC", "VERSION", "RING_CAPACITY", "TRACE_ID_LEN",
         "_HEADER_FMT", "HEADER_SIZE", "_RECORD_FMT", "RECORD_SIZE",
-        "FILE_SIZE", "FLAG_COMPILE",
+        "FILE_SIZE", "FLAG_COMPILE", "FLAG_EXEC_ERROR",
         # v3 comm block: the ICI-currency staleness budget is ABI too —
         # the C++ CommCostUs and the Python mirror must agree on it
         "COMM_SIGNAL_STALENESS_NS",

@@ -64,8 +64,8 @@ GOLDEN_CONSTANTS = (
     "kTcUtilMagic", "kTcUtilVersion2", "kMaxProcs", "kMaxExcessPoints",
     "kVmemMagic", "kVmemVersion", "kVmemMaxEntries", "kPidsMagic",
     "kStepRingMagic", "kStepRingVersion", "kStepRingCapacity",
-    "kStepTraceIdLen", "kStepFlagCompile", "kCommSignalStalenessNs",
-    "kStepRingFileSize",
+    "kStepTraceIdLen", "kStepFlagCompile", "kStepFlagExecError",
+    "kCommSignalStalenessNs", "kStepRingFileSize",
 )
 
 # C++ struct -> (python module suffix, offsets-table name, skipped C++
@@ -142,6 +142,7 @@ CONSTANT_PAIRS = (
     ("stepring", "RING_CAPACITY", "kStepRingCapacity"),
     ("stepring", "TRACE_ID_LEN", "kStepTraceIdLen"),
     ("stepring", "FLAG_COMPILE", "kStepFlagCompile"),
+    ("stepring", "FLAG_EXEC_ERROR", "kStepFlagExecError"),
     ("stepring", "COMM_SIGNAL_STALENESS_NS", "kCommSignalStalenessNs"),
 )
 

@@ -88,6 +88,7 @@ def default_actions(ctx: ActionContext) -> dict:
         "throttle-spike": lambda v, fence: retune_quota(ctx, v, fence),
         "spill-thrash": lambda v, fence: relieve_spill(ctx, v, fence),
         "comm-inflation": lambda v, fence: replace_gang(ctx, v, fence),
+        "chip-failure": lambda v, fence: rescue_gang(ctx, v, fence),
     }
 
 
@@ -198,6 +199,52 @@ def replace_gang(ctx: ActionContext, verdict: dict,
                  fence: str) -> dict:
     return _migrate_tenant(ctx, verdict, fence, action="replace-gang",
                            exclude=(str(verdict.get("node", "")),))
+
+
+# -- chip-failure (vtheal) ---------------------------------------------------
+
+def rescue_gang(ctx: ActionContext, verdict: dict, fence: str) -> dict:
+    """Drain one gang off a failed chip through the SAME migration
+    timeline as replace-gang — freeze, SpillPool demotion when the
+    target is tight, fenced rebind, reaped intent trail — with two
+    health-specific legs: the target set excludes every node the
+    health plane itself is cordoning (never rescue INTO a draining
+    box), and "no target" degrades to a bounded park-and-retry outcome
+    instead of a failure (the cooldown + fresh-episode guards bound
+    the retry rate; the gang stays schedulable the moment capacity or
+    the cordon's decay frees a box)."""
+    from vtpu_manager.health import metrics as health_metrics
+    from vtpu_manager.health.rescue import unhealthy_nodes
+    from vtpu_manager.resilience import failpoints
+    tenant = str(verdict.get("tenant", ""))
+    node = str(verdict.get("node", ""))
+    failpoints.fire("health.rescue", tenant=tenant, node=node)
+    if ctx.migrator is None:
+        health_metrics.bump_rescue("failed")
+        return {"action": "rescue-gang", "ok": False,
+                "reason": "no-migrator", "tenant": tenant}
+    pod = ctx.pod_for_tenant(tenant)
+    if pod is None:
+        health_metrics.bump_rescue("failed")
+        return {"action": "rescue-gang", "ok": False,
+                "reason": "no-pod", "tenant": tenant}
+    exclude = {node} | unhealthy_nodes(ctx.client, now=ctx.clock())
+    choice = quietest_node(ctx, exclude=exclude)
+    if choice is None:
+        # bounded park-and-retry: an OUTCOME, not an error — recorded,
+        # cooldown started, retried on the next eligible episode
+        health_metrics.bump_rescue("parked")
+        return {"action": "rescue-gang", "ok": True, "parked": True,
+                "reason": "no-target-node", "tenant": tenant,
+                "node": node}
+    target, worst = choice
+    outcome = ctx.migrator.migrate(pod, target, fence)
+    ok = bool(outcome.get("ok"))
+    health_metrics.bump_rescue("migrated" if ok else "failed")
+    return {"action": "rescue-gang", "ok": ok, "tenant": tenant,
+            "node": node, "target": target,
+            "target_worst_link": round(worst, 3),
+            "migration": outcome}
 
 
 def _migrate_tenant(ctx: ActionContext, verdict: dict, fence: str,

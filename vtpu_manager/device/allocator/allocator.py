@@ -109,7 +109,8 @@ def _allocate_container(info: NodeInfo, req: AllocationRequest,
                         reasons: R.FailureReasons,
                         prefer_uuids: set[str] | None = None,
                         anchor_cells: set | None = None,
-                        link_load: dict | None = None
+                        link_load: dict | None = None,
+                        dead_links: frozenset | None = None
                         ) -> tuple[list[DeviceUsage], str, float]:
     candidates = _filter_devices(info, req, cont, reasons)
     if len(candidates) < cont.number:
@@ -127,10 +128,16 @@ def _allocate_container(info: NodeInfo, req: AllocationRequest,
             prefer_origin=prefer_origin,
             binpack=req.device_policy == consts.DEVICE_POLICY_BINPACK,
             anchor_cells=anchor_cells,
-            link_load=link_load)
+            link_load=link_load,
+            dead_links=dead_links)
         if sel is not None and (sel.kind == "rect" or not strict):
             by_uuid = {u.spec.uuid: u for u in candidates}
             return ([by_uuid[c.uuid] for c in sel.chips], sel.kind, sel.score)
+        if sel is None and dead_links:
+            # enough free chips existed, so a None selection means the
+            # vtheal dead-link exclusion eliminated every rect box AND
+            # every greedy cluster — name the cordon, not "capacity"
+            reasons.add(R.DEGRADED_LINK, info.name)
         if strict:
             reasons.add(R.NODE_TOPOLOGY_UNSATISFIED, info.name)
             raise AllocationFailure(reasons)
@@ -171,7 +178,8 @@ def _request_kinds(req: AllocationRequest
 def allocate(info: NodeInfo, req: AllocationRequest,
              prefer_origin: tuple[int, int] | None = None,
              anchor_cells: set | None = None,
-             link_load: dict | None = None) -> AllocationResult:
+             link_load: dict | None = None,
+             dead_links: frozenset | None = None) -> AllocationResult:
     """Allocate every claiming container of the pod on this node.
 
     Concurrent claimers (app containers + sidecars) are allocated first on
@@ -190,6 +198,11 @@ def allocate(info: NodeInfo, req: AllocationRequest,
     avoids contended ICI rings; None (default) keeps the search
     byte-identical to the pre-vtici tree.
 
+    dead_links (vtheal, HealthPlane gate): probe-confirmed failed ICI
+    edges — a HARD submesh exclusion (no box/cluster may cross one),
+    reported as DegradedLink when it eliminates every candidate. None
+    (default) keeps the search byte-identical to the pre-vtheal tree.
+
     Raises AllocationFailure with aggregated reasons when the pod does not
     fit. On success returns the claims and the charged NodeInfo copy.
     """
@@ -202,7 +215,8 @@ def allocate(info: NodeInfo, req: AllocationRequest,
         picked, k, s = _allocate_container(work, req, cont, prefer_origin,
                                            reasons,
                                            anchor_cells=anchor_cells,
-                                           link_load=link_load)
+                                           link_load=link_load,
+                                           dead_links=dead_links)
         if k != "any":
             kind, score = k, max(score, s)
         for usage in picked:
@@ -239,7 +253,8 @@ def allocate(info: NodeInfo, req: AllocationRequest,
                                            reasons,
                                            prefer_uuids=pod_chips,
                                            anchor_cells=anchor_cells,
-                                           link_load=link_load)
+                                           link_load=link_load,
+                                           dead_links=dead_links)
         for usage in picked:
             claim = DeviceClaim(uuid=usage.spec.uuid,
                                 host_index=usage.spec.index,

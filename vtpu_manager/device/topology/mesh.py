@@ -129,7 +129,8 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                    prefer_origin: tuple[int, int] | None = None,
                    binpack: bool = True,
                    anchor_cells: set[Cell] | None = None,
-                   link_load: dict | None = None
+                   link_load: dict | None = None,
+                   dead_links: frozenset | None = None
                    ) -> MeshSelection | None:
     """Choose n chips from free_chips forming the best sub-mesh.
 
@@ -158,12 +159,25 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
     gate-off identity: scores are byte-identical to the pre-vtici
     search.
 
+    dead_links: vtheal (HealthPlane gate): probe-confirmed FAILED ICI
+    edges (topology/links.py LinkIds). A HARD exclusion, unlike the
+    soft link_load dimension: any candidate set whose internal links
+    cross a dead edge is rejected in both the rect and greedy arms — a
+    communicator group spanning a dead link deadlocks its collectives,
+    which no score tradeoff can buy back. None/empty is the gate-off
+    identity. When exclusion eliminates every candidate the search
+    returns None (callers report DegradedLink); scattered "any"-mode
+    picks stay legal because a non-adjacent selection has no internal
+    link riding the dead edge.
+
     Returns None when fewer than n chips are free.
     """
     if n <= 0 or len(free_chips) < n:
         return None
     from vtpu_manager.topology import linkload as ll_mod
-    from vtpu_manager.topology.links import box_diameter, worst_link_load
+    from vtpu_manager.topology.links import (box_diameter, internal_links,
+                                             worst_link_load)
+    dead = dead_links or frozenset()
     by_cell: dict[Cell, ChipSpec] = {c.coords: c for c in free_chips}
     if len(by_cell) < n:
         # duplicate coordinates = malformed registry; never index past it
@@ -181,6 +195,9 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
                     if cells is None:
                         continue
                     if any(c not in by_cell for c in cells):
+                        continue
+                    if dead and not dead.isdisjoint(
+                            internal_links(cells, mesh)):
                         continue
                     # Exact free box. Score: cube-ness, alignment,
                     # sibling adjacency, anchoring (+ the vtici link
@@ -222,6 +239,8 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
             remaining.sort(key=lambda c: min(
                 _pairwise_manhattan([c, ch], mesh) for ch in chosen))
             chosen.append(remaining.pop(0))
+        if dead and not dead.isdisjoint(internal_links(chosen, mesh)):
+            continue
         cost = float(_pairwise_manhattan(chosen, mesh))
         worst = 0.0
         if link_load is not None:
@@ -233,7 +252,11 @@ def select_submesh(free_chips: list[ChipSpec], n: int, mesh: MeshSpec,
             cost += _min_dist_to_anchor(chosen, anchor_cells, mesh)
         if best_greedy is None or cost < best_greedy[0]:
             best_greedy = (cost, [by_cell[c] for c in chosen], worst)
-    assert best_greedy is not None
+    if best_greedy is None:
+        # only reachable via dead-link exclusion: every compact cluster
+        # crossed a failed edge (without `dead` the greedy arm always
+        # produces a candidate)
+        return None
     cost, chips, worst = best_greedy
     diam = box_diameter([c.coords for c in chips], mesh) \
         if link_load is not None else 0

@@ -1,0 +1,46 @@
+"""vtheal — the chip/link health plane (HealthPlane gate).
+
+detect -> cordon -> rescue, closing the loop the reference closes for
+GPUs with NVML XID/ECC watchers and DeviceTaints:
+
+- **detect** (ladder.py, signals.py, publisher.py): the node folds the
+  probe command, shim-side step-ring evidence (stall, exec-error
+  streaks) and ICI link probes through a suspect -> degraded -> failed
+  ladder with hysteresis + confidence decay, published as a stalecodec
+  chip-health annotation (codec.py).
+- **cordon** (codec.cordon_mask / masked_registry, consumed by both
+  scheduler paths): degraded/failed chips become a HARD admission gate
+  — capacity-shaped, audited as UnhealthyChip/DegradedLink — and
+  select_submesh excludes boxes crossing failed chips/links.
+- **rescue** (rescue.py + autopilot actions.rescue_gang): failed chips
+  synthesize chip-failure verdicts the autopilot remediates through
+  the PR 17 live-migration timeline under its existing guards, with
+  bounded park-and-retry when no capacity exists.
+
+Gate off = byte-identical everywhere: no annotation, no series, no
+mask, no verdicts. The legacy manager.HealthWatcher whole-chip flip is
+untouched either way — it is the non-decaying backstop this plane's
+staleness-decays-to-no-cordon rule leans on.
+"""
+
+from vtpu_manager.health import codec, ladder, metrics, rescue, signals
+from vtpu_manager.health.codec import (NodeChipHealth, cordon_mask,
+                                       dead_links, failed_chips,
+                                       health_is_fresh, masked_registry,
+                                       parse_chip_health)
+from vtpu_manager.health.ladder import ChipLadder, NodeHealthLadder
+from vtpu_manager.health.publisher import ChipHealthPublisher
+from vtpu_manager.health.rescue import (chip_failure_verdicts,
+                                        rescue_verdicts,
+                                        unhealthy_nodes)
+from vtpu_manager.health.signals import StallTracker, \
+    collect_ring_evidence
+
+__all__ = [
+    "ChipHealthPublisher", "ChipLadder", "NodeChipHealth",
+    "NodeHealthLadder", "StallTracker", "chip_failure_verdicts",
+    "codec", "collect_ring_evidence", "cordon_mask", "dead_links",
+    "failed_chips", "health_is_fresh", "ladder", "masked_registry",
+    "metrics", "parse_chip_health", "rescue", "rescue_verdicts",
+    "signals", "unhealthy_nodes",
+]

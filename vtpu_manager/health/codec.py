@@ -1,0 +1,215 @@
+"""Node chip-health annotation: vtheal's cordon edge into the scheduler.
+
+Same codec family as the vttel pressure / vtuse headroom / vtovc
+overcommit / vtici link-load annotations — parse-cheap on purpose (the
+snapshot path decodes it per node event, the TTL path per visited
+candidate), staleness explicit by timestamp:
+
+    "<chip>:<state>:<conf>;...|L<x>.<y>.<z>.<axis>:failed;...@<wall_ts>"
+
+one ``;``-separated segment per NON-HEALTHY chip (healthy chips are
+omitted — an empty body is a clean bill of health), state the debounced
+output of the suspect -> degraded -> failed ladder (ladder.py) and
+``conf`` its 0-1 confidence; failed ICI link edges (links.py LinkId,
+probe-confirmed dead neighbors) ride after the ``|``. The timestamp
+makes staleness explicit — a publisher that goes dark must decay to
+"no signal", which here means the cordon LIFTS: the scheduler never
+keeps rejecting capacity on a dead publisher's last claim. That decay
+direction is safe because the legacy registry ``healthy`` flip
+(manager.HealthWatcher re-advertising the chip) is the non-decaying
+backstop for a truly dead chip.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from vtpu_manager.util import stalecodec
+
+# ladder vocabulary (wire + metrics label values). HEALTHY never
+# appears on the wire — absence IS the healthy encoding.
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+DEGRADED = "degraded"
+FAILED = "failed"
+STATES = (HEALTHY, SUSPECT, DEGRADED, FAILED)
+
+# the hard-gate subset: suspect chips schedule normally (a wedged
+# tenant must not cordon its neighbors' capacity), degraded/failed
+# chips are excluded like exhausted capacity
+CORDON_STATES = frozenset({DEGRADED, FAILED})
+
+# staleness family constant (pressure/headroom/overcommit/link-load)
+MAX_HEALTH_AGE_S = 120.0
+
+# defensive parse bounds, the linkload values: 64 chips + 192 torus
+# links fit with headroom, the length cap bounds adversarial split cost
+MAX_HEALTH_SEGMENTS = 256
+MAX_HEALTH_LEN = 6144
+
+
+@dataclass(frozen=True)
+class NodeChipHealth:
+    """Decoded per-node chip/link health rollup."""
+
+    chips: dict = field(default_factory=dict)   # index -> (state, conf)
+    links: frozenset = frozenset()              # failed LinkIds
+    ts: float = 0.0
+
+    def encode(self) -> str:
+        segs = []
+        for index, (state, conf) in sorted(self.chips.items()):
+            if state == HEALTHY:
+                continue
+            segs.append(f"{index}:{state}:{min(max(conf, 0.0), 1.0):.2f}")
+            if len(segs) >= MAX_HEALTH_SEGMENTS:
+                break
+        body = ";".join(segs)
+        if self.links:
+            lsegs = [f"L{c[0]}.{c[1]}.{c[2]}.{axis}:failed"
+                     for (c, axis) in sorted(self.links)]
+            body += "|" + ";".join(lsegs[:MAX_HEALTH_SEGMENTS])
+        return stalecodec.stamp(body, self.ts)
+
+
+def _parse_chip_seg(seg: str, out: dict) -> bool:
+    parts = seg.split(":")
+    if len(parts) != 3:
+        return False
+    try:
+        index = int(parts[0])
+        conf = float(parts[2])
+    except (TypeError, ValueError):
+        return False
+    if index < 0 or parts[1] not in STATES or not math.isfinite(conf):
+        # NaN confidence parses but poisons every comparison downstream
+        # — the garbage-means-no-signal rule of the whole codec family
+        return False
+    out[index] = (parts[1], min(max(conf, 0.0), 1.0))
+    return True
+
+
+def _parse_link_seg(seg: str, out: set) -> bool:
+    key, _, verdict = seg.partition(":")
+    if verdict != "failed" or not key.startswith("L"):
+        return False
+    parts = key[1:].split(".")
+    if len(parts) != 4:
+        return False
+    try:
+        x, y, z, axis = (int(parts[0]), int(parts[1]),
+                         int(parts[2]), int(parts[3]))
+    except (TypeError, ValueError):
+        return False
+    if not 0 <= axis <= 2:
+        return False
+    out.add(((x, y, z), axis))
+    return True
+
+
+def parse_chip_health(raw: str | None, now: float | None = None,
+                      max_age_s: float = MAX_HEALTH_AGE_S
+                      ) -> NodeChipHealth | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    every bad shape degrades to no-signal (no cordon), never to a wrong
+    health claim the scheduler would reject capacity on."""
+    split = stalecodec.split_stamp(raw, max_len=MAX_HEALTH_LEN)
+    if split is None:
+        return None
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now=now, max_age_s=max_age_s):
+        return None
+    chip_part, sep, link_part = body.partition("|")
+    chips: dict = {}
+    links: set = set()
+    segments = 0
+    for seg in chip_part.split(";"):
+        if not seg:
+            continue
+        segments += 1
+        if segments > MAX_HEALTH_SEGMENTS or \
+                not _parse_chip_seg(seg, chips):
+            return None
+    if sep:
+        for seg in link_part.split(";"):
+            if not seg:
+                continue
+            segments += 1
+            if segments > MAX_HEALTH_SEGMENTS or \
+                    not _parse_link_seg(seg, links):
+                return None
+    return NodeChipHealth(chips=chips, links=frozenset(links), ts=ts)
+
+
+def health_is_fresh(ch: "NodeChipHealth | None",
+                    now: float | None = None) -> bool:
+    """Use-time staleness verdict (the pressure-penalty rule): the
+    snapshot path caches the parsed object on the NodeEntry and a dead
+    publisher emits no further node events, so every consumer must
+    re-judge freshness at the moment it gates on it."""
+    if ch is None:
+        return False
+    return stalecodec.is_fresh(ch.ts, now=now,
+                               max_age_s=MAX_HEALTH_AGE_S)
+
+
+def cordon_mask(ch: "NodeChipHealth | None",
+                now: float | None = None) -> frozenset:
+    """Chip indices the hard admission gate must exclude — degraded or
+    failed under a FRESH signal. Empty is the gate-off identity (no
+    mask, byte-identical placement); a stale signal UN-cordons (see
+    module docstring for why that direction is the safe one)."""
+    if not health_is_fresh(ch, now):
+        return frozenset()
+    return frozenset(i for i, (state, _conf) in ch.chips.items()
+                     if state in CORDON_STATES)
+
+
+def failed_chips(ch: "NodeChipHealth | None",
+                 now: float | None = None) -> frozenset:
+    """The FAILED subset of the mask — what the rescue plane drains
+    (degraded chips cordon new admissions but keep their residents)."""
+    if not health_is_fresh(ch, now):
+        return frozenset()
+    return frozenset(i for i, (state, _conf) in ch.chips.items()
+                     if state == FAILED)
+
+
+def dead_links(ch: "NodeChipHealth | None",
+               now: float | None = None) -> frozenset:
+    """Failed LinkIds for submesh exclusion, or empty when the signal
+    is absent/stale — same no-signal identity as the chip mask."""
+    if not health_is_fresh(ch, now):
+        return frozenset()
+    return ch.links
+
+
+def masked_registry(registry, mask: frozenset):
+    """``registry`` with every chip in ``mask`` flipped unhealthy — the
+    cordon's whole admission story: healthy_totals, fast_free_totals
+    and the allocator's per-device UNHEALTHY rejection all key off
+    ``ChipSpec.healthy``, so one masked view makes the hard gate exact
+    in both scheduler paths with zero new per-chip logic.
+
+    An empty mask returns ``registry`` itself (the no-signal identity),
+    and masked views are memoized on the registry object keyed by mask
+    — the overcommit ``virtual_registry`` discipline, so the TTL path's
+    repeated visits to one snapshot cost one rebuild per distinct mask.
+    """
+    if not mask:
+        return registry
+    cache = getattr(registry, "_health_mask_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(registry, "_health_mask_cache", cache)
+    got = cache.get(mask)
+    if got is not None:
+        return got
+    import dataclasses
+    chips = [dataclasses.replace(c, healthy=False)
+             if c.index in mask else c for c in registry.chips]
+    masked = type(registry)(chips=chips, mesh=registry.mesh,
+                            mesh_domain=registry.mesh_domain)
+    cache[mask] = masked
+    return masked

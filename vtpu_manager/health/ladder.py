@@ -1,0 +1,197 @@
+"""The suspect -> degraded -> failed ladder: vtheal's debouncer.
+
+One chip's health verdict folds MULTIPLE independent evidence streams
+(signals.py collects them, the publisher feeds them in):
+
+    probe   the node's --health-probe-cmd verdict for the chip — the
+            strongest single signal (it asks the hardware directly)
+    stall   a resident tenant's step ring stopped advancing — alone
+            this is a WEDGED TENANT, not a dead chip (the whole reason
+            a single signal must not cordon); corroborated by a bad
+            probe it's the classic dead-chip shape
+    exec    an Execute-error streak in a resident ring (the shim-side
+            FLAG_EXEC_ERROR evidence, stepring v4 flag bit)
+    link    a probe-confirmed dead neighbor link touching the chip
+
+Each observation carries a per-signal weight and decays linearly to
+zero over SIGNAL_TTL_S (vtuse-style confidence decay: evidence is a
+claim about NOW, not a latched fault). The chip's confidence is the
+capped sum; thresholds map it to the ladder state. The weights are
+chosen so no single signal reaches the cordon bar (stall alone =
+suspect forever) while probe + any corroboration clears FAILED.
+
+Hysteresis, both directions: stepping INTO the cordon set
+(degraded/failed) must persist ESCALATE_FOLDS consecutive folds —
+one noisy tick is a spike, two is a pattern (the autopilot's
+HYSTERESIS_EPISODES discipline) — and stepping DOWN must persist
+RECOVER_FOLDS, so a flapping chip doesn't whipsaw the scheduler's
+admission gate or the rescue plane.
+
+Links are simpler — there is no wedged-tenant ambiguity on an edge:
+LINK_FAIL_PROBES consecutive probe-confirmed failures mark the edge
+failed, LINK_CLEAR_PROBES consecutive healthy probes clear it.
+"""
+
+from __future__ import annotations
+
+import time
+
+from vtpu_manager.health import codec
+
+# evidence weights: calibrated against the thresholds below so that
+# stall alone < DEGRADED_AT (wedged tenant never cordons), probe alone
+# crosses DEGRADED_AT (the hardware's own word is enough to stop NEW
+# admissions), and probe + any second signal crosses FAILED_AT
+# (corroborated dead chip -> drain the residents)
+SIGNAL_WEIGHTS = {
+    "probe": 0.60,
+    "stall": 0.30,
+    "exec": 0.35,
+    "link": 0.45,
+}
+
+# evidence half-life: an observation's contribution decays linearly to
+# zero over this window; a signal that stops re-asserting ages out and
+# the ladder steps back down through the recovery hysteresis
+SIGNAL_TTL_S = 60.0
+
+# confidence -> state thresholds
+SUSPECT_AT = 0.25
+DEGRADED_AT = 0.55
+FAILED_AT = 0.80
+
+# fold-count hysteresis (see module docstring)
+ESCALATE_FOLDS = 2
+RECOVER_FOLDS = 3
+
+# link edge debounce
+LINK_FAIL_PROBES = 2
+LINK_CLEAR_PROBES = 2
+
+_RANK = {codec.HEALTHY: 0, codec.SUSPECT: 1,
+         codec.DEGRADED: 2, codec.FAILED: 3}
+
+
+def state_for(confidence: float) -> str:
+    if confidence >= FAILED_AT:
+        return codec.FAILED
+    if confidence >= DEGRADED_AT:
+        return codec.DEGRADED
+    if confidence >= SUSPECT_AT:
+        return codec.SUSPECT
+    return codec.HEALTHY
+
+
+class ChipLadder:
+    """Per-chip evidence fold + debounced state."""
+
+    __slots__ = ("state", "_evidence", "_pending", "_pending_folds")
+
+    def __init__(self):
+        self.state = codec.HEALTHY
+        self._evidence: dict[str, float] = {}   # signal -> last bad ts
+        self._pending: str | None = None
+        self._pending_folds = 0
+
+    def observe(self, signal: str, bad: bool, now: float) -> None:
+        """Record one evidence sample. A healthy sample RETRACTS the
+        signal immediately (the decay window is for signals that go
+        silent, not ones that answer 'fine')."""
+        if signal not in SIGNAL_WEIGHTS:
+            raise ValueError(f"unknown health signal {signal!r}")
+        if bad:
+            self._evidence[signal] = now
+        else:
+            self._evidence.pop(signal, None)
+
+    def confidence(self, now: float) -> float:
+        total = 0.0
+        for signal, ts in self._evidence.items():
+            age = now - ts
+            if age < 0 or age >= SIGNAL_TTL_S:
+                continue
+            total += SIGNAL_WEIGHTS[signal] * (1.0 - age / SIGNAL_TTL_S)
+        return min(total, 1.0)
+
+    def active_signals(self, now: float) -> tuple[str, ...]:
+        return tuple(sorted(
+            s for s, ts in self._evidence.items()
+            if 0 <= now - ts < SIGNAL_TTL_S))
+
+    def fold(self, now: float) -> str:
+        """One debounce step: judge the evidence, apply the fold-count
+        hysteresis, return the (possibly unchanged) state."""
+        target = state_for(self.confidence(now))
+        if target == self.state:
+            self._pending, self._pending_folds = None, 0
+            return self.state
+        if target != self._pending:
+            self._pending, self._pending_folds = target, 0
+        self._pending_folds += 1
+        escalating = _RANK[target] > _RANK[self.state]
+        if escalating and target not in codec.CORDON_STATES:
+            # suspect is advisory (no cordon) — flag it immediately so
+            # the annotation carries early warning without debounce lag
+            need = 1
+        elif escalating:
+            need = ESCALATE_FOLDS
+        else:
+            need = RECOVER_FOLDS
+        if self._pending_folds >= need:
+            self.state = target
+            self._pending, self._pending_folds = None, 0
+        return self.state
+
+
+class NodeHealthLadder:
+    """All of one node's chip ladders + link edge debounce; ``fold()``
+    produces the codec object the publisher stamps, and records the
+    state flips the flip failpoint/metrics fire on."""
+
+    def __init__(self, clock=time.time):
+        self.clock = clock
+        self.chips: dict[int, ChipLadder] = {}
+        # LinkId -> [bad_streak, good_streak, failed]
+        self._links: dict = {}
+        self.last_flips: list[tuple] = []   # (subject, old, new)
+
+    def chip(self, index: int) -> ChipLadder:
+        got = self.chips.get(index)
+        if got is None:
+            got = self.chips[index] = ChipLadder()
+        return got
+
+    def observe_chip(self, index: int, signal: str, bad: bool,
+                     now: float | None = None) -> None:
+        now = self.clock() if now is None else now
+        self.chip(index).observe(signal, bad, now)
+
+    def observe_link(self, link, bad: bool) -> None:
+        streaks = self._links.setdefault(link, [0, 0, False])
+        if bad:
+            streaks[0] += 1
+            streaks[1] = 0
+            if streaks[0] >= LINK_FAIL_PROBES:
+                streaks[2] = True
+        else:
+            streaks[1] += 1
+            streaks[0] = 0
+            if streaks[1] >= LINK_CLEAR_PROBES:
+                streaks[2] = False
+
+    def failed_links(self) -> frozenset:
+        return frozenset(l for l, s in self._links.items() if s[2])
+
+    def fold(self, now: float | None = None) -> codec.NodeChipHealth:
+        now = self.clock() if now is None else now
+        self.last_flips = []
+        chips: dict = {}
+        for index, ladder in sorted(self.chips.items()):
+            old = ladder.state
+            new = ladder.fold(now)
+            if new != old:
+                self.last_flips.append((index, old, new))
+            if new != codec.HEALTHY:
+                chips[index] = (new, round(ladder.confidence(now), 2))
+        return codec.NodeChipHealth(chips=chips,
+                                    links=self.failed_links(), ts=now)

@@ -1,0 +1,128 @@
+"""vtheal telemetry counters — the ONE home of every
+``vtpu_chip_health_*`` / ``vtpu_health_rescue_*`` literal (the
+metrics-registry one-home rule; docs/telemetry.md carries the
+operator inventory).
+
+Module-level like the resilience and linkload counters: the
+device-plugin's /metrics handler renders the node-side families when
+the HealthPlane gate armed a publisher, the monitor's handler renders
+the rescue family when the autopilot dispatched a chip-failure action;
+both render "" until something bumped — a gate-off process emits zero
+new series, the byte-identical contract.
+"""
+
+from __future__ import annotations
+
+from vtpu_manager.health import codec
+
+RESCUE_OUTCOMES = ("migrated", "parked", "failed")
+
+_chip_states: dict[int, str] = {}      # last published state per chip
+_flip_total: dict[str, int] = {}       # to-state -> flips
+_probe_exec_failures = 0               # probe cmd failed to EXECUTE
+_rescue_total: dict[str, int] = {}     # outcome -> rescues
+_published = False
+
+
+def set_chip_states(states: dict) -> None:
+    """Last published ladder output (index -> state, non-healthy only),
+    recorded by the publisher after each fold."""
+    global _published
+    _chip_states.clear()
+    _chip_states.update(states)
+    _published = True
+
+
+def bump_flip(to_state: str) -> None:
+    _flip_total[to_state] = _flip_total.get(to_state, 0) + 1
+
+
+def bump_probe_exec_failure() -> None:
+    """The probe COMMAND failed to run (OSError/timeout) — fail-open
+    evidence quality, not chip evidence (the satellite fix's audit
+    counter: a misconfigured probe must be visible, never a flip)."""
+    global _probe_exec_failures
+    _probe_exec_failures += 1
+
+
+def probe_exec_failures() -> int:
+    return _probe_exec_failures
+
+
+def bump_rescue(outcome: str) -> None:
+    _rescue_total[outcome] = _rescue_total.get(outcome, 0) + 1
+
+
+def rescue_totals() -> dict[str, int]:
+    return dict(_rescue_total)
+
+
+def reset_health_totals() -> None:
+    """Test hook (the resilience-counter pattern)."""
+    global _probe_exec_failures, _published
+    _chip_states.clear()
+    _flip_total.clear()
+    _rescue_total.clear()
+    _probe_exec_failures = 0
+    _published = False
+
+
+def render_health_metrics(node: str) -> str:
+    """Node-side families; empty until a HealthPlane publisher ran (no
+    publisher = no new series, the gate-off contract)."""
+    if not _published and not _flip_total and not _probe_exec_failures:
+        return ""
+    lines = [
+        "# HELP vtpu_chip_health_state Debounced ladder state per chip "
+        "(1 = the chip currently holds this state; healthy chips emit "
+        "no series)",
+        "# TYPE vtpu_chip_health_state gauge",
+    ]
+    for index in sorted(_chip_states):
+        state = _chip_states[index]
+        if state == codec.HEALTHY:
+            continue
+        lines.append(f'vtpu_chip_health_state{{node="{node}",'
+                     f'chip="{index}",state="{state}"}} 1')
+    lines += [
+        "# HELP vtpu_chip_health_unhealthy Chips currently outside the "
+        "healthy state (the fleet headline input)",
+        "# TYPE vtpu_chip_health_unhealthy gauge",
+        f'vtpu_chip_health_unhealthy{{node="{node}"}} '
+        f"{sum(1 for s in _chip_states.values() if s != codec.HEALTHY)}",
+        "# HELP vtpu_chip_health_flips_total Ladder state transitions "
+        "published, by destination state",
+        "# TYPE vtpu_chip_health_flips_total counter",
+    ]
+    for state in codec.STATES:
+        if state in _flip_total:
+            lines.append(f'vtpu_chip_health_flips_total{{node="{node}",'
+                         f'to="{state}"}} {_flip_total[state]}')
+    lines += [
+        "# HELP vtpu_chip_health_probe_exec_failures_total Health-probe "
+        "commands that failed to EXECUTE (fail-open: counted, never a "
+        "flip)",
+        "# TYPE vtpu_chip_health_probe_exec_failures_total counter",
+        f"vtpu_chip_health_probe_exec_failures_total"
+        f'{{node="{node}"}} {_probe_exec_failures}',
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def render_rescue_metrics() -> str:
+    """Monitor-side family; empty until the autopilot dispatched a
+    chip-failure rescue (same gate-off contract)."""
+    if not _rescue_total:
+        return ""
+    lines = [
+        "# HELP vtpu_health_rescue_total Gang rescues dispatched for "
+        "failed chips, by outcome (migrated, parked = bounded "
+        "park-and-retry, failed)",
+        "# TYPE vtpu_health_rescue_total counter",
+    ]
+    for outcome in RESCUE_OUTCOMES:
+        if outcome in _rescue_total:
+            lines.append(f'vtpu_health_rescue_total'
+                         f'{{outcome="{outcome}"}} '
+                         f"{_rescue_total[outcome]}")
+    return "\n".join(lines) + "\n"

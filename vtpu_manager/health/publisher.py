@@ -1,0 +1,162 @@
+"""The node's health publisher: evidence in, one annotation out.
+
+Runs in the device-plugin daemon behind the HealthPlane gate (the
+LinkLoadPublisher discipline: failures tolerated per tick — and here
+the decay direction matters doubly: a dead publisher's annotation ages
+out and the cordon LIFTS, with the legacy registry ``healthy`` flip as
+the non-decaying backstop).
+
+Per tick: (1) run the chip probe per chip — the same external command
+contract as manager.HealthWatcher, but with exec-failure fail-open
+(a probe that cannot RUN proves nothing about the chip; it bumps the
+audit counter and the ladder sees no sample); (2) collect shim-side
+ring evidence (signals.py stall/exec); (3) probe ICI neighbor links
+when a link prober is wired; (4) fold the ladder, fire the flip
+failpoint/counters for every state transition, and patch the
+stalecodec annotation.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from vtpu_manager.health import metrics as health_metrics
+from vtpu_manager.health import signals
+from vtpu_manager.health.ladder import NodeHealthLadder
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.topology.links import LinkGraph
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+
+class ChipHealthPublisher:
+    """Daemon loop: probe + fold + patch.
+
+    ``chips`` maps chip index -> mesh cell (or None when the node has
+    no mesh) — the registry's own view, so a failed link's endpoints
+    resolve back to chip indices. ``probe(index)`` returns the chip
+    verdict (True healthy / False unhealthy) or None for no-sample;
+    it must raise OSError only for exec-failure (the fail-open leg).
+    ``link_probe(link_id)`` likewise returns the edge verdict or None.
+    """
+
+    def __init__(self, client, node_name: str, chips: dict,
+                 base_dir: str, probe=None, link_probe=None,
+                 mesh=None, policy=None, interval_s: float = 15.0,
+                 clock=time.time):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.chips = dict(chips)
+        self.base_dir = base_dir
+        self.probe = probe
+        self.link_probe = link_probe
+        self.mesh = mesh
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            deadline_s=10.0)
+        self.interval_s = interval_s
+        self.clock = clock
+        self.ladder = NodeHealthLadder(clock=clock)
+        self.tracker = signals.StallTracker()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- evidence ------------------------------------------------------------
+
+    def _probe_chips(self, now: float) -> None:
+        if self.probe is None:
+            return
+        for index in self.chips:
+            failpoints.fire("health.probe", node=self.node_name,
+                            chip=index)
+            try:
+                verdict = self.probe(index)
+            except OSError:
+                # the probe failed to RUN: fail-open — no evidence
+                # either way, only the audit counter (the satellite
+                # fix's contract, shared with manager.HealthWatcher)
+                health_metrics.bump_probe_exec_failure()
+                continue
+            if verdict is None:
+                continue
+            self.ladder.observe_chip(index, "probe", not verdict, now)
+
+    def _probe_links(self, now: float) -> None:
+        if self.link_probe is None or self.mesh is None:
+            return
+        cell_to_chip = {cell: i for i, cell in self.chips.items()
+                        if cell is not None}
+        for lid in sorted(LinkGraph.from_mesh(self.mesh).links):
+            verdict = self.link_probe(lid)
+            if verdict is None:
+                continue
+            self.ladder.observe_link(lid, not verdict)
+        # a probe-confirmed dead edge is chip evidence for BOTH
+        # endpoints (the ladder's weakest cordon-capable signal: one
+        # dead link alone is suspect; with a failing probe it compounds)
+        from vtpu_manager.topology.links import link_endpoints
+        failed = self.ladder.failed_links()
+        touched = set()
+        for lid in failed:
+            for cell in link_endpoints(lid, self.mesh):
+                index = cell_to_chip.get(cell)
+                if index is not None:
+                    touched.add(index)
+        for index, cell in self.chips.items():
+            if cell is None:
+                continue
+            self.ladder.observe_chip(index, "link", index in touched,
+                                     now)
+
+    # -- the tick ------------------------------------------------------------
+
+    def publish_once(self, now: float | None = None):
+        now = self.clock() if now is None else now
+        self._probe_chips(now)
+        ring_ev = signals.collect_ring_evidence(self.base_dir,
+                                                self.tracker, now)
+        for index, ev in ring_ev.items():
+            if index not in self.chips:
+                continue
+            self.ladder.observe_chip(index, "stall", ev["stall"], now)
+            self.ladder.observe_chip(index, "exec", ev["exec"], now)
+        self._probe_links(now)
+        health = self.ladder.fold(now)
+        for index, old, new in self.ladder.last_flips:
+            # chaos: a crash here must leave the LAST published state
+            # standing until the annotation ages out — never a torn one
+            failpoints.fire("health.flip", node=self.node_name,
+                            chip=index, to=new)
+            health_metrics.bump_flip(new)
+            log.info("chip %s/%d health %s -> %s", self.node_name,
+                     index, old, new)
+        health_metrics.set_chip_states(
+            {i: s for i, (s, _c) in health.chips.items()})
+        self.policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_chip_health_annotation():
+                 health.encode()}),
+            op="health.publish_patch")
+        return health
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.publish_once()
+                except Exception:  # noqa: BLE001 — advisory signal;
+                    # the annotation timestamp ages a silent failure
+                    # out to no-signal (the cordon lifts, the legacy
+                    # registry flip backstops a truly dead chip)
+                    log.warning("chip-health publish failed",
+                                exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtheal-publisher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,134 @@
+"""Rescue-side fold: failed chips -> chip-failure verdicts.
+
+The autopilot consumes vtslo verdicts; this module makes chip failure
+speak the same wire dialect so the WHOLE guard chain (hysteresis,
+cooldown, dual token buckets, fence stamping, vtexplain + ledger audit)
+applies unchanged — a chip failure is just one more cause with one more
+executor (actions.rescue_gang), not a parallel control loop.
+
+Verdict shape: ``{"kind": "chip-failure", "tenant": "<uid>/<label>",
+"node", "chips": [...], "episode_onset_ts", "goodput"}``. The onset is
+the health annotation's OWN fold timestamp, so each publisher tick is a
+distinct detector episode — HYSTERESIS_EPISODES=2 means a gang is
+rescued in the first autopilot window after the SECOND tick that still
+says failed, never off one noisy fold (the bench's "first
+hysteresis-eligible window" clock).
+
+Priority: verdicts sort by vtslo goodput DESCENDING — the most
+productive gang is rescued first (it loses the most per stranded
+second), and the ordering is the tie-breaker the token buckets see
+when a failed chip hosts more gangs than one window may move.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from vtpu_manager.health import codec
+from vtpu_manager.util import consts
+
+
+def ring_goodput(base_dir: str, pod_uid: str, container: str) -> float:
+    """The tenant's vtslo goodput ratio straight off its step ring;
+    1.0 (the neutral prior) when the ring is absent or unreadable —
+    an unmeasured gang is assumed fully productive, the safe direction
+    for a rescue PRIORITY (it only moves the gang up the queue)."""
+    from vtpu_manager.slo.attribution import attribute, goodput_ratio
+    from vtpu_manager.telemetry import stepring
+    ring_path = os.path.join(base_dir, f"{pod_uid}_{container}",
+                             consts.TELEMETRY_SUBDIR,
+                             consts.STEP_RING_NAME)
+    if not os.path.isfile(ring_path):
+        return 1.0
+    try:
+        reader = stepring.StepRingReader(ring_path)
+    except (OSError, ValueError):
+        return 1.0
+    try:
+        records, _, _ = reader.poll(0)
+    finally:
+        reader.close()
+    if not records:
+        return 1.0
+    comps: dict[str, int] = {}
+    for rec in records:
+        for name, ns in attribute(rec).items():
+            comps[name] = comps.get(name, 0) + ns
+    return goodput_ratio(comps)
+
+
+def node_chip_health(client, node: str,
+                     now: float | None = None):
+    """The node's parsed, freshness-judged health annotation (or None
+    — no signal, no cordon, no rescue)."""
+    node_obj = client.get_node(node) or {}
+    raw = (node_obj.get("metadata", {}).get("annotations", {})
+           or {}).get(consts.node_chip_health_annotation())
+    return codec.parse_chip_health(raw, now=now)
+
+
+def unhealthy_nodes(client, now: float | None = None) -> set:
+    """Nodes whose fresh health annotation cordons ANY chip — the
+    rescue executor's target-exclusion set (never migrate a gang onto
+    a box the same plane is draining)."""
+    out = set()
+    for name in sorted(getattr(client, "nodes", {}) or {}):
+        ch = node_chip_health(client, name, now=now)
+        if codec.cordon_mask(ch, now=now):
+            out.add(name)
+    return out
+
+
+def rescue_verdicts(node: str, base_dir: str, health,
+                    now: float | None = None,
+                    goodput_for=None) -> list[dict]:
+    """Chip-failure verdicts for every gang resident on a FAILED chip
+    of ``node`` (degraded chips cordon admissions but keep their
+    residents), goodput-descending."""
+    from vtpu_manager.config import tenantdirs
+    now = time.time() if now is None else now
+    failed = codec.failed_chips(health, now=now)
+    if not failed:
+        return []
+    if goodput_for is None:
+        goodput_for = lambda uid, cont: ring_goodput(base_dir, uid, cont)  # noqa: E731
+    out = []
+    for pod_uid, label, cfg, _is_dra, _mtime in \
+            tenantdirs.iter_container_configs(base_dir):
+        chips = sorted(d.host_index for d in cfg.devices
+                       if d.host_index in failed)
+        if not chips:
+            continue
+        container = label.partition("/")[0]
+        out.append({
+            "kind": "chip-failure",
+            "tenant": f"{pod_uid}/{label}",
+            "node": node,
+            "chips": chips,
+            "episode_onset_ts": round(health.ts, 3),
+            "goodput": round(goodput_for(pod_uid, container), 4),
+        })
+    out.sort(key=lambda v: (-v["goodput"], v["tenant"]))
+    return out
+
+
+def chip_failure_verdicts(client, base_dir_for_node,
+                          now: float | None = None,
+                          goodput_for=None) -> list[dict]:
+    """Cluster-wide verdict feed leg: every node's fresh health
+    annotation folded into chip-failure verdicts. The monitor chains
+    this with the vtslo /slo fan-in into one ``verdict_feed`` —
+    both speak the same wire shape by construction."""
+    now = time.time() if now is None else now
+    out: list[dict] = []
+    for name in sorted(getattr(client, "nodes", {}) or {}):
+        health = node_chip_health(client, name, now=now)
+        if health is None:
+            continue
+        base = base_dir_for_node(name)
+        if not base:
+            continue
+        out.extend(rescue_verdicts(name, base, health, now=now,
+                                   goodput_for=goodput_for))
+    return out

@@ -1,0 +1,114 @@
+"""Shim-side health evidence: what the step rings say about the chips.
+
+The probe command asks the hardware; this module asks the TENANTS — the
+two views disagree in exactly the ways the ladder's weights encode:
+
+- **stall**: a resident ring's ``writes`` head stopped advancing for
+  STALL_AFTER_S. Alone this is a WEDGED TENANT (deadlocked input
+  pipeline, a debugger, a crashed trainer) — real, but not the chip's
+  fault; corroborated by a failing probe it is the dead-chip shape.
+  Only rings that ever progressed can stall: a tenant that hasn't
+  taken its first step yet is starting up, not stuck.
+- **exec**: a trailing streak of FLAG_EXEC_ERROR records (>=
+  EXEC_STREAK_N). One errored step is a retry the runtime absorbed; a
+  streak is a chip that stopped executing while the tenant keeps
+  submitting — strong evidence even when the probe (which may exercise
+  a different code path) still passes.
+
+Evidence is per-tenant but verdicts are per-chip: a signal folds onto
+every chip of the tenant's allocation (the ring doesn't say which chip
+errored; the ladder's confidence decay and the probe's per-chip verdict
+do the narrowing). Multiple residents OR together — one stalled tenant
+among healthy ones keeps the signal asserted, because the healthy ones
+prove nothing about the stalled one's chips beyond what the probe says.
+"""
+
+from __future__ import annotations
+
+import os
+
+from vtpu_manager.telemetry import stepring
+from vtpu_manager.util import consts
+
+# a ring must sit still this long before it counts as stalled — several
+# multiples of any sane step time, far under the ladder's SIGNAL_TTL_S
+STALL_AFTER_S = 20.0
+
+# trailing exec-error records before the streak asserts
+EXEC_STREAK_N = 3
+
+
+def exec_error_streak(records) -> int:
+    """Length of the trailing run of exec-error records."""
+    streak = 0
+    for rec in reversed(records):
+        if not rec.exec_error:
+            break
+        streak += 1
+    return streak
+
+
+class StallTracker:
+    """Per-ring progress memory across evidence passes. ``observe``
+    returns the stall verdict: True (stalled past the budget), False
+    (progressing — retracts the signal), None (no verdict: never
+    stepped, or sitting still but inside the budget)."""
+
+    def __init__(self, stall_after_s: float = STALL_AFTER_S):
+        self.stall_after_s = stall_after_s
+        # key -> (last writes head, ts of last observed advance)
+        self._seen: dict[str, tuple[int, float]] = {}
+
+    def observe(self, key: str, writes: int, now: float) -> bool | None:
+        last = self._seen.get(key)
+        if last is None or writes != last[0]:
+            self._seen[key] = (writes, now)
+            return False if writes > 0 and last is not None else None
+        if writes == 0:
+            return None             # never stepped: startup, not stall
+        if now - last[1] >= self.stall_after_s:
+            return True
+        return None
+
+    def forget(self, key: str) -> None:
+        self._seen.pop(key, None)
+
+
+def collect_ring_evidence(base_dir: str, tracker: StallTracker,
+                          now: float,
+                          streak_n: int = EXEC_STREAK_N) -> dict:
+    """One pass over the node's tenant partitions: chip index ->
+    {"stall": bool, "exec": bool} for every chip with at least one
+    resident ring (chips with no residents contribute nothing — the
+    probe is their only witness). Unreadable rings/configs are skipped,
+    the reader-side crash-window rule."""
+    from vtpu_manager.config import tenantdirs
+    evidence: dict[int, dict[str, bool]] = {}
+    for pod_uid, label, cfg, _is_dra, _mtime in \
+            tenantdirs.iter_container_configs(base_dir):
+        if not cfg.devices:
+            continue
+        container = label.partition("/")[0]
+        ring_path = os.path.join(base_dir, f"{pod_uid}_{container}",
+                                 consts.TELEMETRY_SUBDIR,
+                                 consts.STEP_RING_NAME)
+        if not os.path.isfile(ring_path):
+            continue
+        try:
+            reader = stepring.StepRingReader(ring_path)
+        except (OSError, ValueError):
+            continue
+        try:
+            writes = reader.head() or 0
+            records, _, _ = reader.poll(max(0, writes - 16))
+        finally:
+            reader.close()
+        stalled = tracker.observe(f"{pod_uid}/{label}", writes, now)
+        erroring = exec_error_streak(records) >= streak_n
+        for dev in cfg.devices:
+            got = evidence.setdefault(dev.host_index,
+                                      {"stall": False, "exec": False})
+            if stalled is True:
+                got["stall"] = True
+            got["exec"] = got["exec"] or erroring
+    return evidence

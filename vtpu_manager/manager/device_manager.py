@@ -27,13 +27,20 @@ def make_external_probe(cmd: str, timeout_s: float = 5.0):
     """Per-chip health probe wrapping an operator-supplied command:
     ``<cmd> <index> <uuid>``, exit 0 = healthy. No event stream exists on
     this runtime (the reference rides NVML XID events), so a richer
-    runtime-metrics probe plugs in here. Launch failures are logged (a
-    missing binary would otherwise silently de-advertise every chip) and
-    the timeout stays below the watcher poll interval so one wedged probe
-    cannot stall the whole pass by minutes."""
+    runtime-metrics probe plugs in here. The timeout stays below the
+    watcher poll interval so one wedged probe cannot stall the whole
+    pass by minutes.
+
+    Verdict vocabulary (the vtheal fix): exit 0 -> True, nonzero exit
+    or timeout -> False (the probe RAN and reported the chip sick), a
+    LAUNCH failure -> None (fail-open: a missing or misconfigured
+    binary proves nothing about any chip — it used to return False and
+    de-advertise the entire node on the first pass). Launch failures
+    bump the audit counter so a probe that never runs is visible
+    instead of silently healthy."""
     import subprocess
 
-    def probe(chip) -> bool:
+    def probe(chip) -> bool | None:
         try:
             return subprocess.run(
                 [cmd, str(chip.index), chip.uuid],
@@ -44,9 +51,11 @@ def make_external_probe(cmd: str, timeout_s: float = 5.0):
             return False
         except OSError as e:
             log.error("health probe %s failed to launch: %s "
-                      "(misconfigured --health-probe-cmd marks every "
-                      "chip unhealthy)", cmd, e)
-            return False
+                      "(fail-open: no chip evidence either way)",
+                      cmd, e)
+            from vtpu_manager.health import metrics as health_metrics
+            health_metrics.bump_probe_exec_failure()
+            return None
 
     return probe
 
@@ -196,20 +205,33 @@ class HealthWatcher:
     ``manager`` is structural: anything with a ``chips`` list and
     ``mark_unhealthy``/``mark_healthy`` — a DeviceManager here, a plain
     chip-list target in the DRA path (kubeletplugin.health).
+
+    Flip-side hysteresis (the vtheal fix): a chip flips unhealthy only
+    after ``flip_after`` CONSECUTIVE failed probes — one transient
+    probe blip used to de-advertise the chip and kill its residents'
+    scheduling on the spot. A None verdict (the probe failed to RUN,
+    fail-open) is no evidence: it neither extends nor resets the
+    streak. Recovery stays immediate — re-advertising a healthy chip
+    late only wastes capacity, but re-advertising a sick one early
+    schedules tenants onto it.
     """
 
     def __init__(self, manager,
-                 probe: Callable[[ChipSpec], bool],
-                 interval_s: float = 10.0):
+                 probe: Callable[[ChipSpec], "bool | None"],
+                 interval_s: float = 10.0, flip_after: int = 3):
         self.manager = manager
         self.probe = probe
         self.interval_s = interval_s
+        self.flip_after = max(1, int(flip_after))
+        self._fail_streak: dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def check_once(self) -> None:
+        from vtpu_manager.resilience import failpoints
         for chip in list(self.manager.chips):
-            ok = False
+            failpoints.fire("health.probe", chip=chip.uuid)
+            ok: bool | None = False
             try:
                 ok = self.probe(chip)
             except Exception:
@@ -218,10 +240,18 @@ class HealthWatcher:
                 # identical to a sick chip
                 log.warning("health probe raised for chip %s; treating "
                             "as unhealthy", chip.uuid, exc_info=True)
-            if not ok and chip.healthy:
-                log.error("device %s failed health probe", chip.uuid)
-                self.manager.mark_unhealthy(chip.uuid)
-            elif ok and not chip.healthy:
+            if ok is None:
+                continue    # exec-failure: fail-open, streak unchanged
+            if not ok:
+                streak = self._fail_streak.get(chip.uuid, 0) + 1
+                self._fail_streak[chip.uuid] = streak
+                if streak >= self.flip_after and chip.healthy:
+                    log.error("device %s failed %d consecutive health "
+                              "probes", chip.uuid, streak)
+                    self.manager.mark_unhealthy(chip.uuid)
+                continue
+            self._fail_streak.pop(chip.uuid, None)
+            if not chip.healthy:
                 log.info("device %s recovered", chip.uuid)
                 self.manager.mark_healthy(chip.uuid)
 

@@ -163,6 +163,25 @@ SITES: dict[str, str] = {
                       "source and target so the gang never stays "
                       "parked; the shim's VTPU_FREEZE_MAX_S fail-open "
                       "is the last-resort backstop)",
+    "health.probe": "manager/device_manager.py HealthWatcher."
+                    "check_once per chip AND health/publisher.py "
+                    "_probe_chips (error/latency = a probe pass that "
+                    "fails or drags — fail-open, no flip, only the "
+                    "exec-failure counter; crash = watcher death "
+                    "mid-pass the next interval absorbs)",
+    "health.flip": "health/publisher.py publish_once, per ladder state "
+                   "transition and before the annotation patch (crash "
+                   "= the LAST published state stands until the "
+                   "stalecodec timestamp ages the cordon out — a torn "
+                   "flip can never publish; error = a lost publish "
+                   "tick the next interval replays)",
+    "health.rescue": "autopilot/actions.py rescue_gang, after the "
+                     "guards passed and before the migration "
+                     "dispatches (crash = leader death mid-rescue: "
+                     "the intent trail + PR 17 reapers unfreeze the "
+                     "gang and the successor's next eligible window "
+                     "retries; error = a failed rescue that starts "
+                     "the cooldown like a success)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

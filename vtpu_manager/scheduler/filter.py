@@ -51,6 +51,7 @@ from vtpu_manager.clustercache import advertise as cc_advertise
 from vtpu_manager.compilecache import antistorm
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
+from vtpu_manager.health import codec as health_codec
 from vtpu_manager.overcommit import ratio as oc_mod
 from vtpu_manager.resilience import failpoints
 from vtpu_manager.resilience.policy import RetryPolicy
@@ -112,9 +113,28 @@ class FilterPredicate:
                  quota_market: bool = False,
                  hbm_overcommit: bool = False,
                  cluster_cache: bool = False,
-                 ici_link_aware: bool = False):
+                 ici_link_aware: bool = False,
+                 health_plane: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtheal (HealthPlane gate; default off = byte-identical
+        # placement in BOTH data paths): the node-published chip-health
+        # annotation (health/codec.py; suspect chips schedule normally,
+        # degraded/failed chips cordon) becomes a HARD admission gate —
+        # capacity-shaped, not score-shaped: the fast capacity pre-gate
+        # and the allocator both run against a masked registry view
+        # (codec.masked_registry flips cordoned chips unhealthy), so
+        # every existing capacity rule excludes them with zero new
+        # per-chip logic, and probe-confirmed dead ICI links are a hard
+        # submesh exclusion (select_submesh dead_links). Audited as
+        # UnhealthyChip / DegradedLink in failed_nodes + vtexplain.
+        # Staleness re-judged at every visit: a dead health publisher
+        # UN-cordons (the legacy registry healthy flip is the
+        # non-decaying backstop). TTL path raw-rides the annotation per
+        # visited candidate, snapshot path decodes at event-apply
+        # (NodeEntry.chiphealth). Rides filter_kwargs so vtha shards
+        # inherit it.
+        self.health_plane = health_plane
         # vtici (ICILinkAware gate; default off = byte-identical
         # placement in BOTH data paths): score gang/ICI candidates by
         # worst-link contention with co-resident tenants — the node's
@@ -787,6 +807,7 @@ class FilterPredicate:
         oc_ann = consts.node_overcommit_annotation()
         warm_ann = consts.node_cache_keys_annotation()
         ll_ann = consts.node_ici_link_load_annotation()
+        hp_ann = consts.node_chip_health_annotation()
         now_visible: set[str] = set()
         req_number, req_cores, req_memory = (
             req.total_number(), req.total_cores(), req.total_memory())
@@ -824,9 +845,23 @@ class FilterPredicate:
                     now_visible.update(retired)
                     assumed = [(u, e) for u, e in assumed
                                if u not in visible]
+            # vtheal: the cordon is a masked registry view — every
+            # capacity rule below (totals, mem_bonus, the allocator's
+            # per-device gate) runs against it, so degraded/failed
+            # chips are excluded exactly like exhausted capacity.
+            # Raw-ride discipline like headroom/warm (one dict-get +
+            # cheap parse per candidate under the gate; off = None =
+            # byte-identical), staleness re-judged by cordon_mask.
+            h_health = (health_codec.parse_chip_health(
+                (meta.get("annotations") or {}).get(hp_ann), now=now)
+                if self.health_plane else None)
+            h_mask = health_codec.cordon_mask(h_health, now=now)
+            h_dead = health_codec.dead_links(h_health, now=now)
+            gate_reg = health_codec.masked_registry(registry, h_mask)
+            claim_sets = ([c for _, c in counted]
+                          + [e.claims for _, e in assumed])
             free_number, free_cores, free_memory = dt.fast_free_totals(
-                registry,
-                [c for _, c in counted] + [e.claims for _, e in assumed])
+                gate_reg, claim_sets)
             # vtovc: the memory axis may admit against VIRTUAL capacity
             # — physical free plus (ratio-1)×healthy HBM, a safe
             # overestimate the allocator below re-validates against the
@@ -841,14 +876,21 @@ class FilterPredicate:
                 oc_ratio = oc_mod.ratio_for_class(overcommit, oc_class,
                                                   now=now)
             mem_bonus = (int((oc_ratio - 1.0)
-                             * registry.healthy_totals()[2])
+                             * gate_reg.healthy_totals()[2])
                          if oc_ratio > 1.0 else 0)
             if (free_number < req_number or free_cores < req_cores
                     or free_memory + mem_bonus < req_memory):
-                result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
-                reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
+                why = R.NODE_INSUFFICIENT_CAPACITY
+                if h_mask and self._fits_unmasked(
+                        registry, claim_sets, oc_ratio,
+                        req_number, req_cores, req_memory):
+                    # the cordon — not real exhaustion — shaped this
+                    # verdict: audit it as the health gate it is
+                    why = R.UNHEALTHY_CHIP
+                result.failed_nodes[name] = why
+                reasons.add(why, name)
                 if explain_b is not None:
-                    explain_b.reject(name, R.NODE_INSUFFICIENT_CAPACITY)
+                    explain_b.reject(name, why)
                 continue
             pressure = tel_pressure.parse_pressure(
                 (meta.get("annotations") or {}).get(
@@ -882,10 +924,13 @@ class FilterPredicate:
             # off = no dict-get, no parse, byte-identical scores)
             ll_raw = ((meta.get("annotations") or {}).get(ll_ann)
                       if self.ici_link_aware else None)
+            # gate_reg rides in the registry slot: the gang-domain sort
+            # reads only mesh_domain (mask-invariant) and the allocator
+            # must see the SAME cordoned view the gate admitted against
             ranked.append((free_cores + (free_memory >> 24) + free_number,
-                           name, registry, counted, assumed, pressure,
+                           name, gate_reg, counted, assumed, pressure,
                            storm, hr_raw, overcommit, oc_ratio,
-                           warm_raw, ll_raw))
+                           warm_raw, ll_raw, h_mask, h_dead))
         if now_visible:
             self._drop_assumed(now_visible)
         # binpack wants the least-free node first, spread the most-free.
@@ -904,7 +949,7 @@ class FilterPredicate:
         scored: list[ScoredNode] = []
         for rank, (_, name, registry, counted, assumed, pressure,
                    storm, hr_raw, overcommit, oc_ratio, warm_raw,
-                   ll_raw) in enumerate(ranked):
+                   ll_raw, h_mask, h_dead) in enumerate(ranked):
             if rank >= self.candidate_limit and scored:
                 break
             self._allocate_node(name, registry, counted, assumed, req,
@@ -920,8 +965,23 @@ class FilterPredicate:
                                 warm=cc_advertise.parse_warm_keys(
                                     warm_raw) if warm_raw else None,
                                 linkload=tl_mod.parse_link_load(
-                                    ll_raw) if ll_raw else None)
+                                    ll_raw) if ll_raw else None,
+                                health_mask=h_mask, health_dead=h_dead)
         return scored
+
+    @staticmethod
+    def _fits_unmasked(registry, claim_sets: list, oc_ratio: float,
+                       req_number: int, req_cores: int,
+                       req_memory: int) -> bool:
+        """Whether the UNMASKED registry would have admitted the pod —
+        the cordon-attribution probe behind the UnhealthyChip reason
+        (runs only for nodes that both carry a cordon mask AND failed
+        the masked gate, so the steady state never pays it)."""
+        free = dt.fast_free_totals(registry, claim_sets)
+        bonus = (int((oc_ratio - 1.0) * registry.healthy_totals()[2])
+                 if oc_ratio > 1.0 else 0)
+        return (free[0] >= req_number and free[1] >= req_cores
+                and free[2] + bonus >= req_memory)
 
     def _snapshot_scored(self, snap, req: AllocationRequest,
                          candidates: list, assumed_by_node: dict,
@@ -999,7 +1059,24 @@ class FilterPredicate:
                 snap.prune_expired(name, now)
                 entry = snap.entry(name) or entry
             assumed = assumed_left.get(name, [])
-            if entry.conditional or assumed:
+            # vtheal: cordoned chips gate exactly like the TTL path —
+            # the parsed rollup was cached at event-apply
+            # (NodeEntry.chiphealth), staleness re-judged per visit by
+            # cordon_mask, and a non-empty mask forces the exact-totals
+            # recompute against the masked view (cordoned nodes are the
+            # rare case; the steady state keeps the precomputed triple)
+            h_health = entry.chiphealth if self.health_plane else None
+            h_mask = health_codec.cordon_mask(h_health, now=now)
+            h_dead = health_codec.dead_links(h_health, now=now)
+            gate_reg = entry.registry
+            if h_mask:
+                gate_reg = health_codec.masked_registry(entry.registry,
+                                                        h_mask)
+                free = dt.fast_free_totals(
+                    gate_reg,
+                    [c for _, c in snap_mod.entry_counted(entry, now)]
+                    + [e.claims for _, e in assumed])
+            elif entry.conditional or assumed:
                 free = snap_mod.entry_free_totals(
                     entry, [e.claims for _, e in assumed], now)
             else:
@@ -1014,14 +1091,22 @@ class FilterPredicate:
                                                now=now)
                         if overcommit is not None else 1.0)
             mem_bonus = (int((oc_ratio - 1.0)
-                             * entry.registry.healthy_totals()[2])
+                             * gate_reg.healthy_totals()[2])
                          if oc_ratio > 1.0 else 0)
             if (free[0] < req_number or free[1] < req_cores
                     or free[2] + mem_bonus < req_memory):
-                result.failed_nodes[name] = R.NODE_INSUFFICIENT_CAPACITY
-                reasons.add(R.NODE_INSUFFICIENT_CAPACITY, name)
+                why = R.NODE_INSUFFICIENT_CAPACITY
+                if h_mask and self._fits_unmasked(
+                        entry.registry,
+                        [c for _, c in snap_mod.entry_counted(entry,
+                                                              now)]
+                        + [e.claims for _, e in assumed],
+                        oc_ratio, req_number, req_cores, req_memory):
+                    why = R.UNHEALTHY_CHIP
+                result.failed_nodes[name] = why
+                reasons.add(why, name)
                 if explain_b is not None:
-                    explain_b.reject(name, R.NODE_INSUFFICIENT_CAPACITY)
+                    explain_b.reject(name, why)
                 return
             visited += 1
             storm = (self._storm_for_node(
@@ -1029,7 +1114,7 @@ class FilterPredicate:
                 unbound=tuple(e for e in snap.unbound_fp(name)
                               if e[0] != pod_uid))
                      if pod_fp else ())
-            self._allocate_node(name, entry.registry,
+            self._allocate_node(name, gate_reg,
                                 snap_mod.entry_counted(entry, now),
                                 assumed, req, prefer_origin,
                                 gang_siblings, gang_domains, scored,
@@ -1045,7 +1130,8 @@ class FilterPredicate:
                                 warm=entry.warm if name in warm_set
                                 else None,
                                 linkload=entry.linkload
-                                if self.ici_link_aware else None)
+                                if self.ici_link_aware else None,
+                                health_mask=h_mask, health_dead=h_dead)
 
         # gang-domain candidates walk first regardless of global rank
         # (same bump the TTL sort applies): the +100 scoring bonus is
@@ -1087,7 +1173,9 @@ class FilterPredicate:
                        explain_b=None, hr_term: bool = False,
                        overcommit=None, oc_ratio: float = 1.0,
                        warm_fp: str = "", warm=None,
-                       linkload=None) -> None:
+                       linkload=None,
+                       health_mask: frozenset = frozenset(),
+                       health_dead: frozenset = frozenset()) -> None:
         """Full allocation + scoring for one capacity-gated node — the
         one body both data paths share, so placement semantics cannot
         drift between them (and so the vtexplain breakdown is assembled
@@ -1124,8 +1212,15 @@ class FilterPredicate:
             alloc_result = allocate(info, req,
                                     prefer_origin=prefer_origin,
                                     anchor_cells=anchor,
-                                    link_load=link_load)
+                                    link_load=link_load,
+                                    dead_links=health_dead or None)
         except AllocationFailure as f:
+            if health_mask and f.reasons.counts.get(R.UNHEALTHY):
+                # vtheal: the registry handed in was the masked cordon
+                # view, so the allocator's generic Unhealthy rejections
+                # on this node include cordoned chips — surface the
+                # health-plane cause alongside (the doctor keys off it)
+                f.reasons.add(R.UNHEALTHY_CHIP, name)
             why = f.reasons.summary() or "allocation failed"
             result.failed_nodes[name] = why
             # ONE derivation (explain.reason_code) feeds both the event

@@ -18,6 +18,13 @@ TYPE_EXCLUDED = "TypeExcluded"
 UUID_EXCLUDED = "UuidExcluded"
 UNHEALTHY = "Unhealthy"
 
+# vtheal cordon reasons (HealthPlane gate): the health plane marked a
+# chip degraded/failed (hard admission gate, capacity-shaped) or a
+# required ICI link failed so no submesh box avoids it. Device- and
+# node-level respectively — the doctor renders both as cordons.
+UNHEALTHY_CHIP = "UnhealthyChip"
+DEGRADED_LINK = "DegradedLink"
+
 # Node-level reasons
 NODE_NO_DEVICES = "NodeNoDevices"
 NODE_INSUFFICIENT_CAPACITY = "NodeInsufficientCapacity"

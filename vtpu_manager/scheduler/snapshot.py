@@ -48,6 +48,7 @@ import time
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.clustercache import advertise as cc_advertise
 from vtpu_manager.compilecache import antistorm
+from vtpu_manager.health import codec as health_codec
 from vtpu_manager.quota import victimcost as vc_mod
 from vtpu_manager.device import types as dt
 from vtpu_manager.device.claims import container_kinds, effective_claims
@@ -75,14 +76,15 @@ class NodeEntry:
     __slots__ = ("name", "node", "labels", "registry", "resident",
                  "counted", "conditional", "base_free", "rank_key",
                  "generation", "pressure", "fp_recent", "headroom",
-                 "overcommit", "warm", "victim_costs", "linkload")
+                 "overcommit", "warm", "victim_costs", "linkload",
+                 "chiphealth")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
                  base_free: tuple, rank_key: int, generation: int,
                  pressure=None, fp_recent=(), headroom=None,
                  overcommit=None, warm=None, victim_costs=None,
-                 linkload=None):
+                 linkload=None, chiphealth=None):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -116,6 +118,12 @@ class NodeEntry:
         # staleness at every visit (load_map), so a dead publisher
         # decays to no link signal instead of steering on a ghost
         self.linkload = linkload
+        # vtheal chip-health rollup (NodeChipHealth | None), decoded at
+        # event apply/relist like pressure; cordon_mask/dead_links
+        # re-judge staleness at every visit, so a dead publisher
+        # UN-cordons (the legacy registry healthy flip is the
+        # non-decaying backstop for a truly dead chip)
+        self.chiphealth = chiphealth
         # vtcc anti-storm: residents' (program_fingerprint, placed_ts)
         # pairs inside the storm window at build time; decay is
         # re-judged at penalty time (a quiet node emits no events)
@@ -276,6 +284,7 @@ class ClusterSnapshot:
         self._node_warm: dict[str, object] = {}       # -> NodeWarmKeys
         self._node_victim_costs: dict[str, object] = {}  # -> NodeVictimCosts
         self._node_linkload: dict[str, object] = {}   # -> NodeLinkLoad
+        self._node_chiphealth: dict[str, object] = {}  # -> NodeChipHealth
         # vtcs warm index: fingerprint -> (node, ...) for every node
         # advertising that fp. Copy-on-write tuples (the unbound-fp
         # pattern) so passes/tools read lock-free; maintained at node
@@ -559,6 +568,7 @@ class ClusterSnapshot:
                     self._node_overcommit.pop(name, None)
                     self._node_victim_costs.pop(name, None)
                     self._node_linkload.pop(name, None)
+                    self._node_chiphealth.pop(name, None)
                     self._set_warm_locked(name, None)
                     self._publish_rank_locked(name, None)
                     self.generation += 1
@@ -582,6 +592,8 @@ class ClusterSnapshot:
             anns.get(consts.node_victim_cost_annotation()))
         node_linkload = tl_mod.parse_link_load(
             anns.get(consts.node_ici_link_load_annotation()))
+        node_chiphealth = health_codec.parse_chip_health(
+            anns.get(consts.node_chip_health_annotation()))
         labels = meta.get("labels") or {}
         with self._lock:
             self._node_pressure[name] = node_pressure
@@ -589,6 +601,7 @@ class ClusterSnapshot:
             self._node_overcommit[name] = node_overcommit
             self._node_victim_costs[name] = node_victim_costs
             self._node_linkload[name] = node_linkload
+            self._node_chiphealth[name] = node_chiphealth
             self._set_warm_locked(name, node_warm)
             self.generation += 1
             entry = self._build_entry_locked(name, node, labels, registry)
@@ -850,7 +863,8 @@ class ClusterSnapshot:
                          overcommit=self._node_overcommit.get(name),
                          warm=self._node_warm.get(name),
                          victim_costs=self._node_victim_costs.get(name),
-                         linkload=self._node_linkload.get(name))
+                         linkload=self._node_linkload.get(name),
+                         chiphealth=self._node_chiphealth.get(name))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -919,6 +933,7 @@ class ClusterSnapshot:
             self._node_warm = {}
             self._node_victim_costs = {}
             self._node_linkload = {}
+            self._node_chiphealth = {}
             self._warm_fp_nodes = {}
             entries: dict[str, NodeEntry] = {}
             for node in nodes:
@@ -940,6 +955,9 @@ class ClusterSnapshot:
                     anns.get(consts.node_victim_cost_annotation()))
                 self._node_linkload[name] = tl_mod.parse_link_load(
                     anns.get(consts.node_ici_link_load_annotation()))
+                self._node_chiphealth[name] = \
+                    health_codec.parse_chip_health(
+                        anns.get(consts.node_chip_health_annotation()))
                 self._set_warm_locked(name, cc_advertise.parse_warm_keys(
                     anns.get(consts.node_cache_keys_annotation())))
                 entries[name] = self._build_entry_locked(
@@ -1087,6 +1105,6 @@ class ClusterSnapshot:
                 fp_recent=entry.fp_recent, headroom=entry.headroom,
                 overcommit=entry.overcommit, warm=entry.warm,
                 victim_costs=entry.victim_costs,
-                linkload=entry.linkload)
+                linkload=entry.linkload, chiphealth=entry.chiphealth)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

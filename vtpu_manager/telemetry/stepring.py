@@ -107,6 +107,14 @@ assert RECORD_SIZE == 104
 FILE_SIZE = HEADER_SIZE + RING_CAPACITY * RECORD_SIZE
 
 FLAG_COMPILE = 0x1           # step paid a compile / first-execute
+# vtheal: the step's Execute (or a transfer inside it) returned an
+# error the shim/runtime recovered from. A NEW BIT in the existing v4
+# flags field — no layout change, no version bump: v4 readers that
+# don't know the bit see it as reserved-zero semantics (they only test
+# FLAG_COMPILE), and the health plane's signals.py reads trailing
+# streaks of it as dead-chip evidence (one errored step is a retry;
+# a streak is a chip that stopped executing).
+FLAG_EXEC_ERROR = 0x2
 
 _WRITES_OFFSET = 24          # header offset of the u64 writes counter
 _TRACE_ID_OFFSET = 32
@@ -135,6 +143,10 @@ class StepRecord:
     @property
     def compiled(self) -> bool:
         return bool(self.flags & FLAG_COMPILE)
+
+    @property
+    def exec_error(self) -> bool:
+        return bool(self.flags & FLAG_EXEC_ERROR)
 
 
 class StepRingWriter:
@@ -197,7 +209,8 @@ class StepRingWriter:
                spill_events: int = 0, fill_events: int = 0,
                comm_time_ns: int = 0, bytes_transferred: int = 0,
                collective_count: int = 0,
-               spill_fill_time_ns: int = 0) -> None:
+               spill_fill_time_ns: int = 0,
+               exec_error: bool = False) -> None:
         """Publish one step record (the hot path). Seqlock bracket per
         the shared-mmap protocol: odd seq first, payload, even seq last
         — ``seq | 1`` so a crashed writer's odd leftover can't invert
@@ -209,10 +222,11 @@ class StepRingWriter:
         seq, = struct.unpack_from("<Q", self._mm, off)
         wseq = seq | 1
         struct.pack_into("<Q", self._mm, off, wseq)      # odd: writing
+        flags = (FLAG_COMPILE if compiled else 0) | \
+            (FLAG_EXEC_ERROR if exec_error else 0)
         struct.pack_into(_RECORD_FMT, self._mm, off, wseq, index,
                          start_mono_ns, duration_ns, throttle_wait_ns,
-                         hbm_highwater_bytes,
-                         FLAG_COMPILE if compiled else 0, 0,
+                         hbm_highwater_bytes, flags, 0,
                          spilled_bytes, spill_events, fill_events,
                          comm_time_ns, bytes_transferred,
                          collective_count, 0, spill_fill_time_ns)
@@ -287,6 +301,12 @@ class StepRingReader:
             if w1 == w2:
                 return w1
         return None
+
+    def head(self) -> int | None:
+        """Public head counter (total records ever published), or None
+        when it never stabilizes — the vtheal stall signal polls this
+        instead of tailing records: progress is the head advancing."""
+        return self._writes()
 
     def read_record(self, index: int, retries: int = 8
                     ) -> StepRecord | None:

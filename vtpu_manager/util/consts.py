@@ -349,6 +349,21 @@ def ici_link_pct_annotation() -> str:
     return _ann("ici-link-pct")
 
 
+def node_chip_health_annotation() -> str:
+    """vtheal per-node chip/link health rollup (HealthPlane gate):
+    ``"<chip>:<state>:<conf>;...|L<x>.<y>.<z>.<axis>:failed;...@<ts>"``
+    (health/codec.py) — only non-healthy chips appear (absent = healthy),
+    state is the suspect -> degraded -> failed ladder's debounced output
+    and ``conf`` its 0-1 confidence; failed ICI link edges ride after
+    the ``|``. Published by the device-plugin's health publisher over
+    the registry channel. Same staleness-by-timestamp family as the
+    pressure/headroom/overcommit codecs: a dead publisher decays to
+    no-signal — an aged-out annotation UN-cordons (the scheduler never
+    keeps rejecting capacity on a ghost's claim), which is safe because
+    the legacy registry ``healthy`` flip is the non-decaying backstop."""
+    return _ann("node-chip-health")
+
+
 def node_reclaimable_headroom_annotation() -> str:
     """vtuse reclaimable-headroom rollup (same codec family as the
     pressure annotation, utilization/headroom.py): per-chip

@@ -39,6 +39,7 @@ SLO_ATTRIBUTION = "SLOAttribution"      # vtslo goodput + step-time attribution
 SLO_AUTOPILOT = "SLOAutopilot"          # vtpilot elected remediation controller
 SCALE_PIPELINE = "ScalePipeline"        # vtscale batched bind + dynamic plans
 WEBHOOK_HA = "WebhookHA"                # vtscale lease-elected webhook replicas
+HEALTH_PLANE = "HealthPlane"            # vtheal detect->cordon->rescue plane
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -259,6 +260,27 @@ _KNOWN = {
     # report unready so the Service routes around them; read-only
     # validate paths stay served everywhere (docs/ha.md runbook).
     WEBHOOK_HA: False,
+    # Default off: byte-identical — no chip-health annotation is
+    # published or parsed (the legacy whole-chip HealthWatcher flip is
+    # untouched), placement is byte-identical in BOTH scheduler data
+    # paths (no health mask, no dead-link submesh exclusion, no
+    # UnhealthyChip/DegradedLink rejections), no vtpu_chip_health_*/
+    # vtpu_health_rescue_* series render, /utilization carries no
+    # health fields, vtpu-smi shows no HEALTH column, and the autopilot
+    # never sees a chip-failure verdict. On, the node folds the
+    # existing probe command with shim-side evidence (step-ring stall,
+    # Execute-error streaks) and ICI link-down probes through a
+    # suspect -> degraded -> failed ladder with hysteresis + confidence
+    # decay (vtpu_manager/health/), publishes it as a stalecodec
+    # chip-health annotation, both scheduler paths cordon degraded/
+    # failed chips as a HARD admission gate (capacity-shaped, audited
+    # as UnhealthyChip/DegradedLink in vtexplain) with select_submesh
+    # excluding boxes crossing failed chips/links, and the autopilot
+    # gains a chip-failure cause that drains/migrates resident gangs
+    # priority-ordered by vtslo goodput under the existing fence/
+    # cooldown/token-bucket guards, converging through the PR 17
+    # migration reapers on crash.
+    HEALTH_PLANE: False,
 }
 
 

@@ -46,7 +46,8 @@ class ClusterRollup:
                  cluster_cache: bool = False,
                  comm: bool = False,
                  slo_ledger=None,
-                 action_ledger=None):
+                 action_ledger=None,
+                 health: bool = False):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
@@ -75,6 +76,12 @@ class ClusterRollup:
         # vtqm pattern). Set, it is the controller's on-disk
         # ActionLedger; the block summarizes the last hour's actions.
         self.action_ledger = action_ledger
+        # vtheal (HealthPlane gate): False = the document carries no
+        # health fields at all — byte-identical /utilization (the vtqm
+        # pattern). On, each chip row gains the ladder state off the
+        # node's chip-health annotation and the document a fleet
+        # unhealthy-chip headline (vtpu-smi's HEALTH column).
+        self.health = health
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -107,6 +114,7 @@ class ClusterRollup:
         hr_ann = consts.node_reclaimable_headroom_annotation()
         pr_ann = consts.node_pressure_annotation()
         oc_ann = consts.node_overcommit_annotation()
+        hp_ann = consts.node_chip_health_annotation()
         for node in nodes:
             meta = node.get("metadata") or {}
             anns = meta.get("annotations") or {}
@@ -120,6 +128,11 @@ class ClusterRollup:
                 from vtpu_manager.overcommit import ratio as oc_mod
                 overcommit = oc_mod.parse_overcommit(anns.get(oc_ann),
                                                      now=now)
+            chiphealth = None
+            if self.health:
+                from vtpu_manager.health import codec as health_codec
+                chiphealth = health_codec.parse_chip_health(
+                    anns.get(hp_ann), now=now)
             chips = []
             if registry is not None:
                 for chip in registry.chips:
@@ -153,6 +166,15 @@ class ClusterRollup:
                             local_spilled.get(chip.index, 0)
                             if name == self.ledger.node_name
                             and local_spilled is not None else None)
+                    if self.health:
+                        # vtheal HEALTH column: the debounced ladder
+                        # state off the fresh annotation; absence (or
+                        # a stale/dark publisher) reads healthy — the
+                        # cordon's own decay direction
+                        state, _conf = chiphealth.chips.get(
+                            chip.index, ("healthy", 0.0)) \
+                            if chiphealth else ("healthy", 0.0)
+                        row["health"] = state
                     chips.append(row)
             row_extra = {}
             if self.quota_dir:
@@ -173,6 +195,16 @@ class ClusterRollup:
                     overcommit.spill_frac if overcommit else None
                 row_extra["spilled_bytes"] = \
                     overcommit.spilled_bytes if overcommit else None
+            if self.health:
+                # vtheal node fields (gate on only — off keeps the
+                # document byte-identical): the fresh cordon headcount
+                # and the publish timestamp (None = no fresh signal =
+                # no cordon on this node)
+                row_extra["unhealthy_chips"] = (
+                    sum(1 for s, _c in chiphealth.chips.values()
+                        if s != "healthy") if chiphealth else 0)
+                row_extra["health_ts"] = \
+                    chiphealth.ts if chiphealth else None
             if self.cluster_cache:
                 # vtcs warm-keys fields (gate on only — off keeps the
                 # document byte-identical): which programs this node
@@ -546,6 +578,28 @@ class ClusterRollup:
                 "actions_last_hour": len(recent),
                 "by_action": by_action,
                 "last_action": recent[-1] if recent else None,
+            }
+        if self.health:
+            # vtheal fleet headline (gate off = no key at all): how
+            # many chips the fleet is currently cordoning and where
+            # the ladder put them — folded from the SAME chip rows the
+            # per-node cut decodes, so the headline and the HEALTH
+            # column can never disagree
+            by_state: dict[str, int] = {}
+            unhealthy = 0
+            publishing = 0
+            for nrow in node_rows:
+                if nrow.get("health_ts") is not None:
+                    publishing += 1
+                for ch in nrow["chips"]:
+                    state = ch.get("health")
+                    if state and state != "healthy":
+                        unhealthy += 1
+                        by_state[state] = by_state.get(state, 0) + 1
+            doc["health"] = {
+                "nodes_publishing": publishing,
+                "unhealthy_chips": unhealthy,
+                "by_state": by_state,
             }
         if self.overcommit:
             # vtcomm-PR vtovc satellite (ROADMAP vtovc item (a)): the
