@@ -170,8 +170,16 @@ bench-scale: ## vtscale headline bench: 50k nodes/100k pods, pipelined binds >=5
 bench-scale-quick: ## vtscale bench at smoke scale (no artifact written)
 	python scripts/bench_scale.py --quick
 
+.PHONY: test-frag
+test-frag: ## vtfrag suite: codec staleness matrix, score vs select_submesh, TTL/snapshot tap parity, gate-off byte-contracts, forecaster-vs-FilterPredicate agreement, publisher, history ring, elected scan lease
+	$(PYTEST) tests/test_frag.py -q
+
+.PHONY: bench-frag
+bench-frag: ## vtfrag headline bench: packed->checkered churn holds free capacity flat while the score crosses the alarm bar; doctor == scheduler for every gang class in both modes; gate-off identity (asserted; writes BENCH_VTFRAG_r20.json)
+	python scripts/bench_frag.py
+
 .PHONY: verify
-verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo test-autopilot test-scale test-abi-san bench-overcommit bench-clustercache bench-ici bench-comm bench-slo bench-autopilot bench-scale-quick ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench, vtpilot autopilot suite + bench, vtscale suite + smoke bench, sanitized ABI probes
+verify: lint test test-trace test-snapshot test-chaos test-telemetry test-ha test-compilecache test-clustercache test-utilization test-explain test-quotamarket test-overcommit test-ici test-comm test-slo test-autopilot test-scale test-frag test-abi-san bench-overcommit bench-clustercache bench-ici bench-comm bench-slo bench-autopilot bench-scale-quick bench-frag ## Default verify flow: static analysis, the suite, vtrace e2e, snapshot suite, chaos invariants, vttel e2e, vtha leases+multi-scheduler chaos, vtcc cache suite, vtcs fleet-seeding suite + bench, vtuse ledger suite, vtexplain audit suite, vtqm market suite, vtovc overcommit suite + density bench, vtici link-plane suite + bench, vtcomm comm-plane suite + bench, vtslo attribution suite + bench, vtpilot autopilot suite + bench, vtscale suite + smoke bench, vtfrag observatory suite + bench, sanitized ABI probes
 
 .PHONY: test-shim
 test-shim: build ## C harness alone against the fake PJRT plugin

@@ -84,8 +84,11 @@ def main(argv: list[str] | None = None) -> int:
     from vtpu_manager.util.featuregates import (CLUSTER_COMPILE_CACHE,
                                                 COMM_TELEMETRY,
                                                 DECISION_EXPLAIN,
+                                                FAULT_INJECTION,
+                                                FRAG_OBSERVATORY,
                                                 HBM_OVERCOMMIT,
                                                 HEALTH_PLANE,
+                                                ICI_LINK_AWARE,
                                                 QUOTA_MARKET,
                                                 SLO_ATTRIBUTION,
                                                 SLO_AUTOPILOT,
@@ -98,6 +101,13 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         logging.getLogger(__name__).error("bad --feature-gates: %s", e)
         return 2
+    if gates.enabled(FAULT_INJECTION):
+        # chaos/staging only: VTPU_FAILPOINTS arms seeded injections
+        # (vtfault); with the gate off every site is one dict lookup
+        from vtpu_manager.resilience import failpoints
+        failpoints.enable(
+            seed=int(os.environ.get("VTPU_FAILPOINTS_SEED", "0") or 0))
+        failpoints.arm_spec(os.environ.get("VTPU_FAILPOINTS", ""))
     util_on = gates.enabled(UTILIZATION_LEDGER)
     explain_on = gates.enabled(DECISION_EXPLAIN)
     quota_on = gates.enabled(QUOTA_MARKET)
@@ -107,6 +117,8 @@ def main(argv: list[str] | None = None) -> int:
     slo_on = gates.enabled(SLO_ATTRIBUTION)
     health_on = gates.enabled(HEALTH_PLANE)
     autopilot_on = gates.enabled(SLO_AUTOPILOT)
+    frag_on = gates.enabled(FRAG_OBSERVATORY)
+    ici_on = gates.enabled(ICI_LINK_AWARE)
     if autopilot_on and not slo_on:
         # the controller consumes vtslo verdicts — without the
         # attribution plane there is nothing to act on (the vtcs/vtcc
@@ -152,7 +164,7 @@ def main(argv: list[str] | None = None) -> int:
             return None
 
     fan_client = build_fan_client() \
-        if (util_on or explain_on or autopilot_on) else None
+        if (util_on or explain_on or autopilot_on or frag_on) else None
 
     # vtpilot: the elected remediation loop rides the monitor (the
     # process that already holds the /slo fan-in); gate off = no lease,
@@ -263,7 +275,26 @@ def main(argv: list[str] | None = None) -> int:
             # vtheal: per-chip HEALTH column + the unhealthy-chip fleet
             # headline fold in only when the health gate is on (off =
             # byte-identical document, the vtqm pattern)
-            health=health_on)
+            health=health_on,
+            # vtfrag: per-node frag rollups + the fleet placeability
+            # block fold in only when the frag gate is on (off =
+            # byte-identical document, the vtqm pattern)
+            frag=frag_on)
+
+    # vtfrag placeability history (gate off = no object, no spool
+    # files, no flusher thread): a restarted monitor re-seeds its ring
+    # from the spools, the flusher persists new samples off the collect
+    # path, and dead monitors' leftovers are reaped on start
+    frag_history = None
+    if frag_on:
+        from vtpu_manager.fragmentation.history import (FragHistory,
+                                                        reap_stale_spools
+                                                        as frag_reap)
+        _frag_dir = os.path.join(args.base_dir, "frag")
+        frag_reap(_frag_dir)
+        frag_history = FragHistory(_frag_dir)
+        frag_history.reseed()
+        frag_history.start_flusher()
 
     import hmac
 
@@ -318,6 +349,13 @@ def main(argv: list[str] | None = None) -> int:
             # Gate off = the render is never called, zero new series.
             from vtpu_manager.health import metrics as health_metrics
             text += health_metrics.render_rescue_metrics()
+        if frag_on:
+            # vtfrag what-if verdict counter (gate off = the render is
+            # never called, zero new series; "" until a /fragmentation
+            # probe ran). A rollup fault 503s /fragmentation — it can
+            # never reach this render, which only reads a local dict.
+            from vtpu_manager.fragmentation import metrics as frag_metrics
+            text += frag_metrics.render_forecast_metrics()
         # vtfault retry/breaker/failpoint counters for this process
         text += render_resilience_metrics() + "\n"
         return web.Response(text=text, content_type="text/plain")
@@ -368,6 +406,13 @@ def main(argv: list[str] | None = None) -> int:
             # an explicit error, never a hang or a half-truth
             return web.json_response(
                 {"error": f"utilization rollup failed: {e}"}, status=503)
+        if frag_history is not None and "fragmentation" in doc:
+            # vtfrag: every fleet collect is a history sample — ring
+            # append + flusher wake only, zero I/O on this path
+            from vtpu_manager.fragmentation.history import \
+                sample_from_rollup
+            frag_history.record(
+                sample_from_rollup(doc["fragmentation"]))
         return web.json_response(filter_document(
             doc, node=request.query.get("node", ""),
             pod=request.query.get("pod", "")))
@@ -475,6 +520,58 @@ def main(argv: list[str] | None = None) -> int:
                 {"error": f"autopilot rollup failed: {e}"}, status=503)
         return web.json_response(doc)
 
+    async def fragmentation_route(request):
+        # vtfrag what-if doctor: "would a k-pod N-chip gang place right
+        # now, and if not, which term kills each node" — answered by
+        # replaying the REAL FilterPredicate against a mirror of the
+        # live cluster (fragmentation/forecast.py), under the same
+        # placement-shaping gates this monitor runs with. Names nodes:
+        # same bearer auth as /metrics. The mirror LISTs + replay run
+        # in an executor thread; every failure — including injected
+        # frag.rollup faults — answers HERE with 503, never on the
+        # /metrics path (the vtexplain rollup pattern).
+        if not authorized(request):
+            return web.json_response({"error": "unauthorized"},
+                                     status=401)
+        import asyncio
+
+        from vtpu_manager.fragmentation import (forecast as frag_forecast,
+                                                metrics as frag_metrics)
+        try:
+            gang = int(request.query.get("gang", "1"))
+            pods = int(request.query.get("pods", "1"))
+        except ValueError:
+            return web.json_response(
+                {"error": "gang and pods must be integers"}, status=400)
+
+        def collect():
+            return frag_forecast.what_if(
+                fan_client, gang, pods=pods,
+                predicate_kwargs={
+                    # mirror this monitor's own placement-shaping
+                    # gates so the replayed verdict matches what the
+                    # real scheduler would rule
+                    "health_plane": health_on,
+                    "hbm_overcommit": overcommit_on,
+                    "ici_link_aware": ici_on,
+                })
+        try:
+            doc = await asyncio.get_running_loop() \
+                .run_in_executor(None, collect)
+        except ValueError as e:
+            # out-of-catalog probe shape: caller error, not a fault
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:  # noqa: BLE001 — a wedged forecaster
+            # serves an explicit error, never a hang or a half-truth
+            frag_metrics.bump_forecast("error")
+            return web.json_response(
+                {"error": f"fragmentation forecast failed: {e}"},
+                status=503)
+        frag_metrics.bump_forecast(doc["verdict"])
+        if frag_history is not None:
+            doc["history"] = frag_history.series()[-32:]
+        return web.json_response(doc)
+
     async def cache_entry(request):
         # vtcs peer-serving route (ClusterCompileCache gate; off = no
         # route at all, matching "zero fetch I/O"): raw checksummed
@@ -521,6 +618,9 @@ def main(argv: list[str] | None = None) -> int:
     if autopilot is not None:
         # same gate-off contract: no /autopilot route at all (404)
         app.router.add_get("/autopilot", autopilot_route)
+    if frag_on and fan_client is not None:
+        # same gate-off contract: no /fragmentation route at all (404)
+        app.router.add_get("/fragmentation", fragmentation_route)
     if cluster_cache_on:
         # same gate-off contract: no /cache/entry route, so a node not
         # running the cluster tier can never be fetched from

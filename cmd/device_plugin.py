@@ -118,6 +118,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 COMPILE_CACHE,
                                                 CORE_PLUGIN,
                                                 FAULT_INJECTION,
+                                                FRAG_OBSERVATORY,
                                                 HBM_OVERCOMMIT,
                                                 HEALTH_PLANE,
                                                 HONOR_PREALLOC_IDS,
@@ -326,6 +327,29 @@ def main(argv: list[str] | None = None) -> int:
         health_pub.start()
         log.info("chip-health publisher running (%d chips)", len(chips))
 
+    # vtfrag node-annotation publisher: this daemon (the node-annotation
+    # owner) rolls the node's largest-placeable-box-per-gang-class view
+    # from the registry + resident vtpu.configs and publishes it for the
+    # monitor's fleet rollup. When the health plane runs in-process its
+    # ladder's dead-link set folds in (the same exclusions the
+    # scheduler's submesh search honors); otherwise the score is
+    # link-blind but still honors chip health flags. Gate off = no
+    # thread, no annotation, no series.
+    frag_pub = None
+    if gates.enabled(FRAG_OBSERVATORY):
+        from vtpu_manager.fragmentation.publisher import FragPublisher
+        frag_dead_fn = None
+        if health_pub is not None:
+            frag_dead_fn = \
+                lambda: frozenset(health_pub.ladder.failed_links())
+        frag_pub = FragPublisher(
+            client, args.node_name, manager.registry(),
+            args.base_dir or consts.MANAGER_BASE_DIR,
+            dead_links_fn=frag_dead_fn)
+        frag_pub.start()
+        log.info("fragmentation publisher running (links=%s)",
+                 frag_dead_fn is not None)
+
     # VMemoryNode: pre-create the cross-process vmem ledger so container
     # shims can map it from their first allocation (the TC watcher also
     # creates it lazily, but that couples the ledger to the watcher gate)
@@ -402,6 +426,15 @@ def main(argv: list[str] | None = None) -> int:
                         metrics as health_metrics
                     text += health_metrics.render_health_metrics(
                         args.node_name)
+                if gates.enabled(FRAG_OBSERVATORY):
+                    # vtfrag node-side score/placeable-gangs families
+                    # ("" until the publisher's first tick; gate off =
+                    # render never called, zero new series)
+                    from vtpu_manager.fragmentation import \
+                        metrics as frag_metrics
+                    text += frag_metrics.render_node_frag(
+                        args.node_name,
+                        frag_pub.last if frag_pub else None)
                 body = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
@@ -612,7 +645,9 @@ def main(argv: list[str] | None = None) -> int:
                  gates.enabled(HBM_OVERCOMMIT))
 
     controller = None
+    scan_ticker = None
     if gates.enabled(RESCHEDULE):
+        from vtpu_manager.controller.scanlease import ScanLeaseTicker
         from vtpu_manager.scheduler.lease import read_lease_state
         from vtpu_manager.scheduler.plan import read_plan
 
@@ -620,15 +655,18 @@ def main(argv: list[str] | None = None) -> int:
             state = read_plan(client, namespace=args.lease_namespace)
             return state.epoch if state is not None else 0
 
-        # vtpilot: one controller fleet-wide wins the coordination
-        # lease and pays the cluster-scan LIST; the rest stay
-        # node-scoped. Gate off = probe None = everyone scans on
-        # cadence, byte-identical pre-vtpilot behavior.
-        scan_probe = None
-        if gates.enabled(SLO_AUTOPILOT):
-            from vtpu_manager.autopilot import coordination_scan_probe
-            scan_probe = coordination_scan_probe(
-                client, args.node_name, namespace=args.lease_namespace)
+        # vtfrag satellite (the vtscale leftover closed): the
+        # cluster-scan election rides its OWN activity lease under the
+        # Reschedule gate — always on, no longer coupled to
+        # SLOAutopilot. The entrypoint runs the renew ticker (the
+        # webhook-HA pattern); the controller's probe reads only the
+        # local held_fresh(), so no lease I/O ever rides a reconcile
+        # pass, and an unproven lease fails open to scanning (the
+        # controller's existing catch) — one LIST per round fleet-wide
+        # when the lease works, the pre-election shape when it doesn't.
+        scan_ticker = ScanLeaseTicker(client, args.node_name,
+                                      namespace=args.lease_namespace)
+        scan_ticker.start()
         controller = RescheduleController(
             client, args.node_name,
             known_uuids={c.uuid for c in chips},
@@ -640,7 +678,7 @@ def main(argv: list[str] | None = None) -> int:
             # unstamped intents (HA off) never trigger the probe
             lease_probe=lambda shard: read_lease_state(
                 client, shard, namespace=args.lease_namespace),
-            cluster_scan_leader=scan_probe,
+            cluster_scan_leader=scan_ticker.probe,
             # vtscale: intents stamped with a plan epoch older than the
             # published plan's are reaped immediately — their partition
             # was superseded by a rolling reshard. Unstamped intents
@@ -708,6 +746,10 @@ def main(argv: list[str] | None = None) -> int:
             reaper_stop.set()
         if controller:
             controller.stop()
+        if scan_ticker:
+            scan_ticker.stop()
+        if frag_pub:
+            frag_pub.stop()
         if health_pub:
             health_pub.stop()
         health.stop()

@@ -105,6 +105,7 @@ def main(argv: list[str] | None = None) -> int:
                                                 COMPILE_CACHE,
                                                 DECISION_EXPLAIN,
                                                 FAULT_INJECTION,
+                                                FRAG_OBSERVATORY,
                                                 HBM_OVERCOMMIT,
                                                 HEALTH_PLANE,
                                                 ICI_LINK_AWARE,
@@ -196,7 +197,14 @@ def main(argv: list[str] | None = None) -> int:
         # failed ICI edges hard-exclude submesh candidates; off =
         # byte-identical placement in both data paths. Same
         # filter_kwargs ride-along, so vtha shards inherit it.
-        health_plane=gates.enabled(HEALTH_PLANE))
+        health_plane=gates.enabled(HEALTH_PLANE),
+        # vtfrag: observe-only per-node fragmentation tap in the shared
+        # _allocate_node body (largest placeable box per gang class vs
+        # free capacity, /metrics + the monitor's what-if doctor read
+        # it); off = no stash, no series, byte-identical placement in
+        # both data paths. Same filter_kwargs ride-along, so vtha
+        # shards inherit it.
+        frag_observatory=gates.enabled(FRAG_OBSERVATORY))
     # vtexplain satellite: preemption victim ordering gains the vttel/
     # vtuse utilization inputs behind the same gate as the audit trail
     # (the ordering applied is recorded per victim, so it is auditable);

@@ -5,6 +5,7 @@ Usage:
     python scripts/vtpu_explain.py --pod <uid>          # latest decision
     python scripts/vtpu_explain.py --why-pending <pod>  # doctor verdict
     python scripts/vtpu_explain.py --why-slow <pod>     # vtslo doctor
+    python scripts/vtpu_explain.py --why-unplaceable 8  # vtfrag doctor
     python scripts/vtpu_explain.py --pod <uid> --diff   # last two passes
     python scripts/vtpu_explain.py --list               # audited pods
     python scripts/vtpu_explain.py --pod <uid> --json   # machine output
@@ -17,6 +18,13 @@ responsible plane's events). It asks the monitor's ``/slo`` route when
 ``--slo-endpoint`` is given, else replays the pod's step ring offline
 from ``--base-dir`` — the same math either way, because attribution is
 pure record arithmetic.
+
+``--why-unplaceable N`` asks the THIRD doctor question — before any pod
+exists: "would an N-chip gang place right now, and if not, which term
+kills each node". It asks the monitor's ``/fragmentation`` what-if
+route (FragObservatory gate), which replays the REAL filter predicate
+against the live fleet state — the verdict is the scheduler's own, not
+a heuristic. ``--pods k`` probes a k-pod gang (each pod N chips).
 
 Reads the per-process JSONL decision spools the DecisionExplain gate
 produces (default dir: the shared node explain dir; --explain-dir for
@@ -113,6 +121,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--why-slow", default="", metavar="POD",
                         help="vtslo doctor verdict: step-time "
                              "attribution + regressions for this pod")
+    parser.add_argument("--why-unplaceable", type=int, default=0,
+                        metavar="GANG",
+                        help="vtfrag doctor: would a GANG-chip gang "
+                             "place right now, and if not, why not")
+    parser.add_argument("--pods", type=int, default=1, metavar="K",
+                        help="probe a K-pod gang for --why-unplaceable "
+                             "(default: %(default)s)")
+    parser.add_argument("--frag-endpoint",
+                        default="http://127.0.0.1:9394/fragmentation",
+                        help="monitor /fragmentation URL for "
+                             "--why-unplaceable (default: %(default)s)")
     parser.add_argument("--slo-endpoint", default="",
                         help="monitor /slo URL for --why-slow (unset: "
                              "replay the pod's ring offline from "
@@ -134,14 +153,70 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     if not (args.pod or args.why_pending or args.why_slow
-            or args.list_pods):
+            or args.why_unplaceable or args.list_pods):
         parser.print_usage(sys.stderr)
         print("vtpu-explain: one of --pod / --why-pending / "
-              "--why-slow / --list required", file=sys.stderr)
+              "--why-slow / --why-unplaceable / --list required",
+              file=sys.stderr)
         return 2
     if args.diff and not args.pod:
         print("vtpu-explain: --diff needs --pod", file=sys.stderr)
         return 2
+
+    if args.why_unplaceable:
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+        url = args.frag_endpoint + (
+            "&" if "?" in args.frag_endpoint else "?") + \
+            f"gang={args.why_unplaceable}&pods={args.pods}"
+        req = urllib.request.Request(url)
+        if args.token_file:
+            with open(args.token_file) as f:
+                req.add_header("Authorization",
+                               f"Bearer {f.read().strip()}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                verdict = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            body = ""
+            try:
+                body = e.read().decode()[:256]
+            except OSError:
+                pass
+            print(f"vtpu-explain: {url}: HTTP {e.code} {body} (is the "
+                  f"monitor running with FragObservatory=true?)",
+                  file=sys.stderr)
+            return 1
+        except (OSError, ValueError) as e:
+            print(f"vtpu-explain: {url}: {e} (is the monitor running "
+                  f"with FragObservatory=true?)", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(verdict, indent=2))
+            return 0 if verdict.get("verdict") == "placeable" else 1
+        gang, pods = verdict.get("gang"), verdict.get("pods")
+        shape = (f"{pods} pod(s) x {gang} chip(s)" if (pods or 1) > 1
+                 else f"{gang} chip(s)")
+        print(f"doctor: {verdict.get('verdict')} — a {shape} gang, "
+              f"judged by the live filter predicate")
+        for node in verdict.get("placed") or []:
+            print(f"  would land on {node}")
+        if verdict.get("error"):
+            print(f"  probe error: {verdict['error']}")
+        blockers = verdict.get("blockers") or {}
+        for node, why in sorted(blockers.items()):
+            code = why.get("reason_code", "?")
+            print(f"  {node}: {code} — {why.get('detail', '')}")
+            hint = _CORDON_HINTS.get(code)
+            if hint:
+                print(f"      -> {hint}")
+        hist = verdict.get("history") or []
+        if hist:
+            tail = hist[-1]
+            print(f"  fleet frag score {tail.get('score', 0):.3f} "
+                  f"({len(hist)} sample(s) of history on the monitor)")
+        return 0 if verdict.get("verdict") == "placeable" else 1
 
     if args.why_slow:
         from vtpu_manager.slo import doctor as slo_doctor

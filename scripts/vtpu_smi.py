@@ -146,6 +146,24 @@ def render(doc: dict, out=None) -> None:
               f"publishing  {health.get('unhealthy_chips', 0)} "
               f"unhealthy chip(s)" + (f"  {spread}" if spread else ""),
               file=out)
+    # vtfrag fleet placeability headline (fragmentation documents only
+    # — a gate-off rollup carries no "fragmentation" key, so the prior
+    # output is byte-identical): how many gangs of each class the fleet
+    # could place RIGHT NOW, plus the mean frag score across reporting
+    # nodes — free capacity that can't host a box is the whole story
+    frag = doc.get("fragmentation")
+    if frag is not None:
+        gangs = frag.get("placeable_gangs") or {}
+        hist = "  ".join(f"{cls}-chip x{count}"
+                         for cls, count in sorted(
+                             gangs.items(), key=lambda kv: int(kv[0])))
+        score = frag.get("fleet_score")
+        print(f"  FRAG: {frag.get('nodes_publishing', 0)} node(s) "
+              f"publishing  "
+              f"score {'-' if score is None else f'{score:.3f}'}  "
+              f"{frag.get('free_chips', 0)} free chip(s)"
+              + (f"  placeable: {hist}" if hist else ""),
+              file=out)
     # vtqm evidence loop (market documents only): per-lease
     # borrowed-vs-used — did the borrower use what it borrowed?
     for bu in (quota or {}).get("borrowed_used") or []:
@@ -179,6 +197,15 @@ def render(doc: dict, out=None) -> None:
             bits.append(f"spilling {nrow['spill_frac'] * 100:.0f}% "
                         f"of steps/{_gib(nrow.get('spilled_bytes', 0))}"
                         .strip())
+        # vtfrag: per-node FRAG bit (fragmentation documents only — a
+        # gate-off document renders exactly the prior line): the frag
+        # score plus the largest gang class this node can still host
+        if nrow.get("frag_score") is not None:
+            classes = nrow.get("frag_classes") or {}
+            hosting = [int(c) for c, n in classes.items() if n > 0]
+            best = f" best {max(hosting)}-chip" if hosting else ""
+            bits.append(f"frag {nrow['frag_score']:.3f} "
+                        f"({nrow.get('frag_free_chips', 0)} free{best})")
         if nrow.get("reclaim_core_pct") is not None:
             bits.append(f"reclaimable {nrow['reclaim_core_pct']}%")
         elif nrow.get("headroom_stale"):

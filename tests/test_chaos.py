@@ -573,6 +573,14 @@ def arm_everything(harness: ChaosHarness, seed: int) -> None:
     # serial binds), error = that pod degrades to the serial path
     failpoints.arm("bind.batch", rng.choice(["crash", "error"]),
                    p=0.2, count=rng.randint(1, 2))
+    # vtfrag sites: driven by the dedicated fragmentation chaos tests
+    # below (the e2e loop here runs no frag publisher and no what-if
+    # route), armed so the full-coverage assertion stays the honest
+    # catalog check
+    failpoints.arm("frag.publish", rng.choice(["crash", "error"]),
+                   p=0.2, count=rng.randint(1, 2))
+    failpoints.arm("frag.rollup", rng.choice(["error", "latency"]),
+                   latency_s=0.0005, p=0.2, count=rng.randint(1, 2))
     assert set(failpoints.armed_sites()) == set(failpoints.SITES), \
         "chaos must cover every registered site"
 
@@ -1431,3 +1439,111 @@ def test_chaos_crash_mid_rescue_converges(tmp_path):
     anns = client.get_pod("ml", "gang-1")["metadata"]["annotations"]
     assert consts.migration_intent_annotation() not in anns
     assert client.bindings.count(("ml", "gang-1", "n-dst")) == 1
+
+
+def test_chaos_torn_frag_publish_decays_to_no_signal(tmp_path):
+    """A frag.publish fault tears the annotation update. The contract:
+    fragmentation is a pure OBSERVATION — a torn publish must decay to
+    no-signal (consumers drop the stale stamp at use), never to a
+    wrong-but-fresh-looking number, and the next clean tick repairs the
+    plane with no reconciliation step."""
+    import time as _time
+
+    from vtpu_manager.device import types as _dt
+    from vtpu_manager.fragmentation import codec as frag_codec
+    from vtpu_manager.fragmentation import metrics as frag_metrics
+    from vtpu_manager.fragmentation.publisher import FragPublisher
+
+    client = FakeKubeClient(upsert_on_patch=True)
+    reg = _dt.fake_registry(4, mesh_shape=(4, 1))
+    client.add_node(_dt.fake_node("n1", reg))
+    pub = FragPublisher(client, "n1", reg, str(tmp_path))
+
+    failpoints.enable(seed=17)
+    failpoints.arm("frag.publish", "error", p=1.0, count=1)
+    with pytest.raises(KubeError):
+        pub.publish_once()
+    anns = client.get_node("n1")["metadata"].get("annotations") or {}
+    assert consts.node_frag_annotation() not in anns, \
+        "torn publish must not leave a partial annotation"
+
+    # the clean retry heals the plane...
+    failpoints.disable()
+    nf = pub.publish_once()
+    raw = client.get_node("n1")["metadata"]["annotations"][
+        consts.node_frag_annotation()]
+    assert frag_codec.parse_frag(raw, now=_time.time()) is not None
+
+    # ...and if the publisher then dies for good, the signal AGES OUT
+    # rather than pinning the last rollup forever: stale-at-use
+    later = nf.ts + frag_codec.MAX_FRAG_AGE_S + 1
+    assert frag_codec.parse_frag(raw, now=later) is None
+    assert frag_metrics.render_node_frag("n1", nf, now=later) == ""
+
+
+def test_chaos_frag_rollup_fault_503s_doctor_never_metrics(tmp_path):
+    """An injected frag.rollup fault must answer on /fragmentation
+    with an explicit 503 — and NEVER leak onto /metrics, which other
+    scrapers depend on (the vtexplain isolation rule). Run against a
+    real monitor subprocess with the failpoint armed via env, the same
+    arming path an operator would use."""
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    base = str(tmp_path / "mgr")
+    os.makedirs(base, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["VTPU_FAILPOINTS"] = "frag.rollup=error(503,p=1.0)"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "cmd/device_monitor.py"),
+         "--port", str(port), "--host", "127.0.0.1",
+         "--node-name", "node-1", "--fake-chips", "1",
+         "--base-dir", base, "--fake-client",
+         "--tc-path", str(tmp_path / "none.tc"),
+         "--vmem-path", str(tmp_path / "none.vmem"),
+         "--trace-spool-dir", str(tmp_path / "spool"),
+         "--feature-gates", "FragObservatory=true,FaultInjection=true"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        t0 = _time.time()
+        while _time.time() - t0 < 30:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"monitor exited rc={proc.returncode}:\n"
+                    f"{proc.stdout.read()[-2000:]}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=1) as r:
+                    if r.status == 200:
+                        break
+            except OSError:
+                _time.sleep(0.2)
+        else:
+            raise AssertionError("monitor never became healthy")
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fragmentation?gang=1",
+                timeout=10)
+        assert err.value.code == 503, \
+            "injected rollup fault must answer as an explicit 503"
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200, "/metrics must never absorb the fault"
+            text = r.read().decode()
+        assert 'vtpu_frag_forecast_total{verdict="error"} 1' in text
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,771 @@
+"""vtfrag: fleet fragmentation & placeability observatory (ISSUE r20).
+
+Covers the score -> publish -> rollup -> forecast chain plus the
+gate-off byte-contract:
+
+- codec: annotation roundtrip, garbage-means-no-signal parsing, the
+  staleness matrix (fresh / aged-out / future-skew) re-judged at use;
+- score: the shared core both residency representations (claim sets,
+  config uuids) feed — box counts via the REAL select_submesh, the
+  flat-free-capacity-while-largest-box-collapses signal, unhealthy
+  chips and dead ICI links excluded;
+- scheduler tap: TTL-vs-snapshot parity on identical state, gate-off
+  byte-identity (no stash, no series, and — monkeypatch-raise — the
+  observe-only discipline: a torn rollup never shapes placement);
+- forecaster: verdict agreement with the REAL FilterPredicate in BOTH
+  data paths, including cordoned chips and dead links; blockers carry
+  the shared reason codes; the live cluster sees zero writes;
+- publisher: config residency in, one stalecodec annotation out,
+  link-blind degradation on a torn dead-link probe;
+- /utilization rollup: fleet placeability block + per-node FRAG
+  fields, absent byte-identical when the gate is off;
+- history: bounded ring, spool flush/reseed, torn-line skip, rotation;
+- satellite (the vtscale leftover): the reschedule-controller cluster
+  scan rides its own activity lease — exactly ONE cluster-wide LIST
+  per round across N controllers, probe fails open to scanning.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+
+import pytest
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.config import vtpu_config as vc
+from vtpu_manager.controller.reschedule import RescheduleController
+from vtpu_manager.controller.scanlease import ScanLeaseTicker
+from vtpu_manager.device import types as dt
+from vtpu_manager.fragmentation import codec, forecast, history, score
+from vtpu_manager.fragmentation import metrics as frag_metrics
+from vtpu_manager.fragmentation.publisher import (FragPublisher,
+                                                  compute_node_frag)
+from vtpu_manager.health import codec as health_codec
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.scheduler.filter import FilterPredicate
+from vtpu_manager.scheduler.snapshot import ClusterSnapshot
+from vtpu_manager.util import consts
+
+GIB = 2**30
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    failpoints.disable()
+    frag_metrics.reset_forecast_totals()
+    yield
+    failpoints.disable()
+    frag_metrics.reset_forecast_totals()
+
+
+def _pod(name="p1", number=1, cores=10, annotations=None):
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}",
+                     "annotations": annotations or {}},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): number,
+                consts.vtpu_cores_resource(): cores,
+                consts.vtpu_memory_resource(): 1024}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def _pred(client, mode, **kw):
+    snap = None
+    if mode == "snapshot":
+        snap = ClusterSnapshot(client)
+        snap.start()
+    return FilterPredicate(client, snapshot=snap, **kw)
+
+
+class _Claims:
+    """Minimal PodDeviceClaims stand-in: all_claims() -> .uuid objs."""
+
+    def __init__(self, uuids):
+        self._uuids = list(uuids)
+
+    def all_claims(self):
+        return [type("C", (), {"uuid": u})() for u in self._uuids]
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+class TestFragCodec:
+    def test_roundtrip(self):
+        ts = time.time()
+        nf = codec.NodeFrag(classes={1: 8, 2: 4, 4: 2, 8: 1, 16: 0},
+                            free=8, score=0.0, ts=ts)
+        back = codec.parse_frag(nf.encode(), now=ts + 1)
+        assert back is not None
+        assert back.classes == nf.classes
+        assert back.free == 8 and back.score == 0.0
+        assert abs(back.ts - ts) < 1.0
+        assert back.largest() == 8
+
+    def test_staleness_matrix_rejudged_at_use(self):
+        ts = time.time()
+        wire = codec.NodeFrag(classes={1: 1}, free=1, score=0.0,
+                              ts=ts).encode()
+        # fresh inside the window
+        assert codec.parse_frag(wire, now=ts + 1) is not None
+        assert codec.parse_frag(
+            wire, now=ts + codec.MAX_FRAG_AGE_S - 1) is not None
+        # aged out: no-signal, never a pinned claim
+        assert codec.parse_frag(
+            wire, now=ts + codec.MAX_FRAG_AGE_S + 1) is None
+        # future skew: a fast publisher clock must read as no-signal
+        assert codec.parse_frag(wire, now=ts - 30) is None
+        # the use-time re-judgement on an already-parsed object
+        nf = codec.parse_frag(wire, now=ts + 1)
+        assert codec.frag_is_fresh(nf, now=ts + 1)
+        assert not codec.frag_is_fresh(
+            nf, now=ts + codec.MAX_FRAG_AGE_S + 1)
+        assert not codec.frag_is_fresh(None)
+
+    def test_garbage_means_no_signal(self):
+        ts = f"{time.time():.3f}"
+        for raw in (
+                None, "", "not-a-codec",
+                f"1:2|8@{ts}",                   # missing score field
+                f"1;2|8|0.5@{ts}",               # segment missing ':'
+                f"x:2|8|0.5@{ts}",               # non-int class
+                f"1:-2|8|0.5@{ts}",              # negative count
+                f"1:2|-8|0.5@{ts}",              # negative free
+                f"1:2|8|nan@{ts}",               # NaN poisons means
+                f"1:2|8|0.5@not-a-ts",           # garbage stamp
+                ";".join(f"{i}:1" for i in range(1, 40))
+                + f"|8|0.5@{ts}",                # segment bomb
+                "1:2|8|0.5@" + "9" * 600,        # oversize raw
+        ):
+            assert codec.parse_frag(raw, now=time.time()) is None, raw
+
+    def test_score_clamped_on_parse(self):
+        ts = time.time()
+        raw = f"1:1|1|7.5@{ts:.3f}"
+        nf = codec.parse_frag(raw, now=ts)
+        assert nf is not None and nf.score == 1.0
+        assert not math.isnan(nf.score)
+
+
+# ---------------------------------------------------------------------------
+# score
+# ---------------------------------------------------------------------------
+
+class TestFragScore:
+    def test_empty_node_is_one_solid_box(self):
+        reg = dt.fake_registry(8, mesh_shape=(8, 1))
+        nf = score.node_frag(reg, [])
+        assert nf.free == 8
+        assert nf.classes == {1: 8, 2: 4, 4: 2, 8: 1, 16: 0}
+        assert nf.score == 0.0
+
+    def test_checkerboard_shatters_score_while_free_stays_flat(self):
+        """The headline signal: alternate-chip residency keeps HALF
+        the capacity free yet kills every multi-chip box — raw free
+        capacity is flat, the frag score jumps."""
+        reg = dt.fake_registry(8, mesh_shape=(8, 1))
+        packed_half = score.node_frag(
+            reg, [_Claims([c.uuid for c in reg.chips[:4]])])
+        checkered = score.node_frag(
+            reg, [_Claims([c.uuid for c in reg.chips
+                           if c.index % 2 == 0])])
+        assert packed_half.free == checkered.free == 4
+        assert packed_half.classes[4] == 1 and packed_half.score == 0.0
+        assert checkered.classes[2] == 0 and checkered.classes[4] == 0
+        assert checkered.score == 0.75          # 1 - 1/4
+        assert checkered.score > packed_half.score
+
+    def test_full_and_empty_pools_score_zero(self):
+        reg = dt.fake_registry(4, mesh_shape=(4, 1))
+        full = score.node_frag(
+            reg, [_Claims([c.uuid for c in reg.chips])])
+        assert full.free == 0 and full.score == 0.0
+        assert full.classes == {1: 0, 2: 0, 4: 0, 8: 0, 16: 0}
+
+    def test_unhealthy_chips_never_free(self):
+        import dataclasses
+        reg = dt.fake_registry(4, mesh_shape=(4, 1))
+        sick = dataclasses.replace(reg.chips[0], healthy=False)
+        reg = dataclasses.replace(
+            reg, chips=(sick,) + tuple(reg.chips[1:]))
+        nf = score.node_frag(reg, [])
+        assert nf.free == 3
+        assert nf.classes[4] == 0
+
+    def test_dead_link_kills_the_spanning_box(self):
+        reg = dt.fake_registry(4, mesh_shape=(2, 2))
+        clean = score.node_frag(reg, [])
+        assert clean.classes[4] == 1
+        dead = {lid for lid in _mesh_links(reg.mesh)}
+        # any one dead edge on a 2x2 leaves no 4-box avoiding it
+        one = frozenset([sorted(dead)[0]])
+        cut = score.node_frag(reg, [], dead_links=one)
+        assert cut.classes[4] == 0
+        assert cut.score > clean.score
+
+    def test_both_residency_representations_agree(self):
+        """Claim-set caller (scheduler tap) and uuid-set caller
+        (publisher) must report identical numbers on identical
+        residency."""
+        reg = dt.fake_registry(8, mesh_shape=(8, 1))
+        resident = [c.uuid for c in reg.chips if c.index % 2 == 0]
+        via_claims = score.node_frag(reg, [_Claims(resident)], now=1.0)
+        free = [c for c in reg.chips
+                if c.healthy and c.uuid not in set(resident)]
+        via_uuids = score.frag_from_free(free, reg.mesh, now=1.0)
+        assert via_claims == via_uuids
+
+
+def _mesh_links(mesh):
+    from vtpu_manager.topology.links import LinkGraph
+    return LinkGraph.from_mesh(mesh).links
+
+
+# ---------------------------------------------------------------------------
+# scheduler tap: parity + gate-off byte-identity
+# ---------------------------------------------------------------------------
+
+def _tap_cluster():
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in ("node-a", "node-b"):
+        reg = dt.fake_registry(4, mesh_shape=(4, 1),
+                               uuid_prefix=name.upper())
+        client.add_node(dt.fake_node(name, reg))
+    return client
+
+
+class TestSchedulerTap:
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_ttl_vs_snapshot_score_parity(self, mode):
+        """Both data paths hand the tap identical state, so the
+        stashed rollups must agree number-for-number (ts excluded —
+        it only stamps the wire)."""
+        stashes = {}
+        for m in ("ttl", "snapshot"):
+            client = _tap_cluster()
+            pred = _pred(client, m, frag_observatory=True)
+            p1 = _pod("p1", number=2, cores=100)
+            client.add_pod(p1)
+            r1 = pred.filter({"Pod": p1})
+            assert not r1.error
+            # second pass sees p1's commitment through the assumed
+            # cache — the tap must fold it in
+            p2 = _pod("p2", number=1, cores=100)
+            client.add_pod(p2)
+            r2 = pred.filter({"Pod": p2})
+            assert not r2.error
+            stashes[m] = {
+                node: (nf.classes, nf.free, nf.score)
+                for node, nf in pred.frag_last.items()}
+        assert stashes["ttl"] == stashes[mode]
+        assert stashes["ttl"], "the tap must have stashed rollups"
+        # the pass that saw p1 committed reports reduced capacity
+        # somewhere: not every node can still be one solid 4-box
+        frees = [v[1] for v in stashes["ttl"].values()]
+        assert min(frees) < 4
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_gate_off_no_stash_no_series(self, mode):
+        client = _tap_cluster()
+        pred = _pred(client, mode)              # frag_observatory=False
+        pod = _pod()
+        client.add_pod(pod)
+        assert not pred.filter({"Pod": pod}).error
+        assert pred.frag_last == {}
+        assert frag_metrics.render_sched_frag(pred.frag_last) == ""
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_observe_only_torn_rollup_never_shapes_placement(
+            self, mode, monkeypatch):
+        """The monkeypatch-raise byte-identity proof: with the gate ON
+        and the score function EXPLODING on every call, placement must
+        match the gate-off pass exactly — the tap is observe-only."""
+        def boom(*a, **kw):
+            raise RuntimeError("torn rollup")
+
+        results = {}
+        for tag, kw in (("off", {}), ("on-torn",
+                                      {"frag_observatory": True})):
+            if tag == "on-torn":
+                monkeypatch.setattr(score, "node_frag", boom)
+            client = _tap_cluster()
+            pred = _pred(client, mode, **kw)
+            pod = _pod(number=2, cores=100)
+            client.add_pod(pod)
+            r = pred.filter({"Pod": pod})
+            results[tag] = (r.error, list(r.node_names),
+                            dict(r.failed_nodes))
+        assert results["off"] == results["on-torn"]
+
+    def test_scheduler_metrics_gate_off_byte_identical(self):
+        """Scrape with the gate off after passes == scrape with the
+        gate on before any pass (the stash is empty either way), and
+        neither carries a frag series."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from vtpu_manager.scheduler.bind import BindPredicate
+        from vtpu_manager.scheduler.preempt import PreemptPredicate
+        from vtpu_manager.scheduler.routes import SchedulerAPI
+
+        def scrape(pred, client):
+            api = SchedulerAPI(pred, BindPredicate(client),
+                               PreemptPredicate(client))
+            api.stats = {"filter": 0, "bind": 0, "preempt": 0,
+                         "errors": 0}
+
+            async def go():
+                async with TestClient(
+                        TestServer(api.build_app())) as http:
+                    resp = await http.get("/metrics")
+                    return await resp.text()
+            return asyncio.run(go())
+
+        client_off = _tap_cluster()
+        pred_off = _pred(client_off, "ttl")
+        pod = _pod()
+        client_off.add_pod(pod)
+        assert not pred_off.filter({"Pod": pod}).error
+        text_off = scrape(pred_off, client_off)
+
+        client_on = _tap_cluster()
+        pred_on = _pred(client_on, "ttl", frag_observatory=True)
+        text_on_unarmed = scrape(pred_on, client_on)
+        assert "vtpu_frag" not in text_off
+        assert text_off == text_on_unarmed
+
+        # and once a pass runs with the gate on, the series appear
+        pod2 = _pod("p2")
+        client_on.add_pod(pod2)
+        assert not pred_on.filter({"Pod": pod2}).error
+        text_on = scrape(pred_on, client_on)
+        assert "vtpu_frag_score" in text_on
+        assert "vtpu_placeable_gangs" in text_on
+
+    def test_stale_stash_entries_drop_at_render(self):
+        old = codec.NodeFrag(classes={1: 4}, free=4, score=0.0,
+                             ts=time.time() - codec.MAX_FRAG_AGE_S - 5)
+        assert frag_metrics.render_sched_frag({"node-a": old}) == ""
+        assert frag_metrics.render_node_frag("node-a", old) == ""
+        assert frag_metrics.render_node_frag("node-a", None) == ""
+
+
+# ---------------------------------------------------------------------------
+# forecaster vs the real scheduler
+# ---------------------------------------------------------------------------
+
+def _forecast_cluster(chips=4, mesh=(4, 1), nodes=("node-a", "node-b"),
+                      cordon=None, links=frozenset()):
+    client = FakeKubeClient(upsert_on_patch=True)
+    for name in nodes:
+        reg = dt.fake_registry(chips, mesh_shape=mesh,
+                               uuid_prefix=name.upper())
+        client.add_node(dt.fake_node(name, reg))
+    if cordon:
+        wire = health_codec.NodeChipHealth(
+            chips={i: (health_codec.FAILED, 0.9)
+                   for i in range(chips)} if not links else {},
+            links=links, ts=time.time()).encode()
+        for name in cordon:
+            client.patch_node_annotations(
+                name, {consts.node_chip_health_annotation(): wire})
+    return client
+
+
+class TestForecast:
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    @pytest.mark.parametrize("gang", [1, 2, 4, 8])
+    def test_verdict_agrees_with_real_scheduler(self, mode, gang):
+        client = _forecast_cluster()
+        doc = forecast.what_if(client, gang)
+        # the ground truth: an identical probe through a REAL predicate
+        # over an identical (separate) cluster, in the requested mode
+        real_client = _forecast_cluster()
+        pred = _pred(real_client, mode)
+        probe = forecast.probe_pod(gang)
+        real_client.add_pod(probe)
+        result = pred.filter({"Pod": probe})
+        real_placeable = not result.error and bool(result.node_names)
+        assert (doc["verdict"] == "placeable") == real_placeable, \
+            f"gang={gang}: forecaster and scheduler disagree"
+        if doc["verdict"] == "placeable":
+            assert doc["placed"] and doc["pods_placed"] == 1
+        else:
+            assert doc["blockers"]
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_cordoned_chips_shape_the_verdict(self, mode):
+        """A fleet whose every node is health-cordoned places nothing
+        — and the forecaster (given the monitor's health gate) must
+        agree with the real scheduler AND name the cordon code."""
+        client = _forecast_cluster(cordon=("node-a", "node-b"))
+        doc = forecast.what_if(client, 1,
+                               predicate_kwargs={"health_plane": True})
+        real_client = _forecast_cluster(cordon=("node-a", "node-b"))
+        pred = _pred(real_client, mode, health_plane=True)
+        probe = forecast.probe_pod(1)
+        real_client.add_pod(probe)
+        result = pred.filter({"Pod": probe})
+        assert result.error and doc["verdict"] == "unplaceable"
+        assert set(doc["blockers"]) == {"node-a", "node-b"}
+        assert all(b["reason_code"] == "UnhealthyChip"
+                   for b in doc["blockers"].values())
+
+    @pytest.mark.parametrize("mode", ["ttl", "snapshot"])
+    def test_dead_links_shape_the_verdict(self, mode):
+        """One dead ICI edge per 2x2 node: 4-chip boxes die, 2-chip
+        boxes survive — forecaster and scheduler must agree on both."""
+        links = frozenset({((0, 0, 0), 0)})
+        kw = {"health_plane": True}
+        client = _forecast_cluster(mesh=(2, 2), cordon=("node-a",
+                                                        "node-b"),
+                                   links=links)
+        four = forecast.what_if(client, 4, predicate_kwargs=kw)
+        two = forecast.what_if(client, 2, predicate_kwargs=kw)
+        assert four["verdict"] == "unplaceable"
+        assert two["verdict"] == "placeable"
+        real_client = _forecast_cluster(mesh=(2, 2),
+                                        cordon=("node-a", "node-b"),
+                                        links=links)
+        pred = _pred(real_client, mode, health_plane=True)
+        probe = forecast.probe_pod(4)
+        real_client.add_pod(probe)
+        result = pred.filter({"Pod": probe})
+        assert result.error, "real scheduler must also refuse the 4-box"
+        assert any("DegradedLink" in str(w)
+                   for w in result.failed_nodes.values())
+
+    def test_multi_pod_gang_books_capacity_sequentially(self):
+        """Two nodes of one 4-box each: a 2-pod 4-chip gang places
+        (one per node); a 3-pod gang runs out and reports how far it
+        got."""
+        client = _forecast_cluster()
+        ok = forecast.what_if(client, 4, pods=2)
+        assert ok["verdict"] == "placeable"
+        assert sorted(ok["placed"]) == ["node-a", "node-b"]
+        over = forecast.what_if(client, 4, pods=3)
+        assert over["verdict"] == "unplaceable"
+        assert over["pods_placed"] == 2
+        assert over["blockers"]
+
+    def test_live_cluster_sees_zero_writes(self):
+        client = _forecast_cluster()
+        before_pods = len(client.list_pods())
+        forecast.what_if(client, 4, pods=2)
+        assert len(client.list_pods()) == before_pods
+        for pod in client.list_pods():
+            assert "vtfrag-whatif" not in pod["metadata"]["name"]
+
+    def test_out_of_catalog_probe_shapes_are_caller_errors(self):
+        client = _forecast_cluster()
+        with pytest.raises(ValueError):
+            forecast.what_if(client, 3)
+        with pytest.raises(ValueError):
+            forecast.what_if(client, 1, pods=0)
+        with pytest.raises(ValueError):
+            forecast.what_if(client, 1,
+                             pods=forecast.MAX_PROBE_PODS + 1)
+
+    def test_forecast_metrics_render_after_bumps_only(self):
+        assert frag_metrics.render_forecast_metrics() == ""
+        frag_metrics.bump_forecast("placeable")
+        frag_metrics.bump_forecast("error")
+        text = frag_metrics.render_forecast_metrics()
+        assert 'vtpu_frag_forecast_total{verdict="placeable"} 1' in text
+        assert 'vtpu_frag_forecast_total{verdict="error"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# publisher
+# ---------------------------------------------------------------------------
+
+def _resident_config(base, pod_uid, uuids):
+    path = os.path.join(base, f"{pod_uid}_main", "config",
+                        "vtpu.config")
+    vc.write_config(path, vc.VtpuConfig(
+        pod_uid=pod_uid, pod_name=pod_uid, pod_namespace="ml",
+        container_name="main",
+        devices=[vc.DeviceConfig(uuid=u, total_memory=GIB,
+                                 real_memory=GIB, hard_core=100,
+                                 host_index=i)
+                 for i, u in enumerate(uuids)]))
+    return path
+
+
+class TestPublisher:
+    def test_config_residency_feeds_the_score(self, tmp_path):
+        reg = dt.fake_registry(8, mesh_shape=(8, 1))
+        base = str(tmp_path)
+        _resident_config(base, "uid-a",
+                         [c.uuid for c in reg.chips if c.index % 2])
+        nf = compute_node_frag(reg, base)
+        assert nf.free == 4
+        assert nf.classes[2] == 0 and nf.score == 0.75
+
+    def test_publish_once_patches_stalecodec_annotation(self, tmp_path):
+        client = FakeKubeClient(upsert_on_patch=True)
+        reg = dt.fake_registry(4, mesh_shape=(4, 1))
+        client.add_node(dt.fake_node("n1", reg))
+        pub = FragPublisher(client, "n1", reg, str(tmp_path))
+        nf = pub.publish_once()
+        assert pub.last is nf
+        raw = (client.get_node("n1")["metadata"]["annotations"]
+               [consts.node_frag_annotation()])
+        back = codec.parse_frag(raw, now=time.time())
+        assert back is not None
+        assert back.free == 4 and back.classes[4] == 1
+
+    def test_torn_dead_link_probe_publishes_link_blind(self, tmp_path):
+        client = FakeKubeClient(upsert_on_patch=True)
+        reg = dt.fake_registry(4, mesh_shape=(2, 2))
+        client.add_node(dt.fake_node("n1", reg))
+
+        def boom():
+            raise RuntimeError("probe torn")
+
+        pub = FragPublisher(client, "n1", reg, str(tmp_path),
+                            dead_links_fn=boom)
+        nf = pub.publish_once()
+        # the link-blind score still published — chips' own health
+        # flags honored, no tick skipped
+        assert nf.classes[4] == 1
+
+
+# ---------------------------------------------------------------------------
+# /utilization rollup
+# ---------------------------------------------------------------------------
+
+def _rollup(client, frag, tmp_path):
+    from vtpu_manager.utilization import UtilizationLedger
+    from vtpu_manager.utilization.rollup import ClusterRollup
+    ledger = UtilizationLedger("n1", [], base_dir=str(tmp_path))
+    return ClusterRollup(ledger, client, frag=frag)
+
+
+class TestUtilizationRollup:
+    def _cluster(self, annotate=True):
+        client = FakeKubeClient(upsert_on_patch=True)
+        for name in ("n1", "n2"):
+            reg = dt.fake_registry(4, mesh_shape=(4, 1),
+                                   uuid_prefix=name.upper())
+            client.add_node(dt.fake_node(name, reg))
+        if annotate:
+            wire = codec.NodeFrag(
+                classes={1: 4, 2: 2, 4: 1, 8: 0, 16: 0}, free=4,
+                score=0.0, ts=time.time()).encode()
+            for name in ("n1", "n2"):
+                client.patch_node_annotations(
+                    name, {consts.node_frag_annotation(): wire})
+        return client
+
+    def test_fleet_block_folds_fresh_nodes(self, tmp_path):
+        doc = _rollup(self._cluster(), frag=True,
+                      tmp_path=tmp_path).collect()
+        frag = doc["fragmentation"]
+        assert frag["nodes_publishing"] == 2
+        assert frag["fleet_score"] == 0.0
+        assert frag["free_chips"] == 8
+        assert frag["placeable_gangs"]["4"] == 2
+        assert {r["node"] for r in frag["nodes"]} == {"n1", "n2"}
+        rows = {r["node"]: r for r in doc["nodes"]}
+        assert rows["n1"]["frag_score"] == 0.0
+        assert rows["n1"]["frag_classes"]["4"] == 1
+
+    def test_stale_annotation_drops_to_no_signal(self, tmp_path):
+        client = self._cluster(annotate=False)
+        wire = codec.NodeFrag(
+            classes={1: 4}, free=4, score=0.0,
+            ts=time.time() - codec.MAX_FRAG_AGE_S - 10).encode()
+        client.patch_node_annotations(
+            "n1", {consts.node_frag_annotation(): wire})
+        doc = _rollup(client, frag=True, tmp_path=tmp_path).collect()
+        assert doc["fragmentation"]["nodes_publishing"] == 0
+        rows = {r["node"]: r for r in doc["nodes"]}
+        assert rows["n1"]["frag_score"] is None
+        assert rows["n1"]["frag_ts"] is None
+
+    def test_gate_off_document_byte_identical(self, tmp_path):
+        import json
+        now = time.time()
+        annotated = _rollup(self._cluster(), frag=False,
+                            tmp_path=tmp_path).collect(now=now)
+        clean = _rollup(self._cluster(annotate=False), frag=False,
+                        tmp_path=tmp_path).collect(now=now)
+        assert "fragmentation" not in annotated
+        for row in annotated["nodes"]:
+            assert "frag_score" not in row
+
+        def scrub(doc):
+            # only wall-clock noise may differ between the two folds
+            for key in ("ts", "last_fold_s", "last_fold_wall"):
+                doc["node"].pop(key, None)
+                doc.pop(key, None)
+            return json.dumps(doc, sort_keys=True)
+        assert scrub(annotated) == scrub(clean)
+
+
+# ---------------------------------------------------------------------------
+# history
+# ---------------------------------------------------------------------------
+
+class TestHistory:
+    def test_ring_bounded_and_series_cut(self, tmp_path):
+        h = history.FragHistory(str(tmp_path), samples=4)
+        for i in range(10):
+            h.record({"ts": float(i), "score": 0.1, "classes": {}})
+        assert len(h.series()) == 4
+        assert [s["ts"] for s in h.series()] == [6.0, 7.0, 8.0, 9.0]
+        assert [s["ts"] for s in h.series(since=8.0)] == [8.0, 9.0]
+
+    def test_flush_reseed_roundtrip(self, tmp_path):
+        h = history.FragHistory(str(tmp_path))
+        for i in range(3):
+            h.record(history.sample_from_rollup(
+                {"fleet_score": 0.25, "placeable_gangs": {"4": 2}},
+                now=100.0 + i))
+        assert h.flush() == 3
+        # a restarted monitor re-seeds from the spool
+        h2 = history.FragHistory(str(tmp_path))
+        assert h2.reseed() == 3
+        assert [s["ts"] for s in h2.series()] == [100.0, 101.0, 102.0]
+        assert h2.series()[0]["score"] == 0.25
+        assert h2.series()[0]["classes"] == {"4": 2}
+
+    def test_torn_spool_line_skipped_never_fatal(self, tmp_path):
+        h = history.FragHistory(str(tmp_path))
+        h.record({"ts": 1.0, "score": 0.5, "classes": {}})
+        h.flush()
+        with open(h.spool_path, "a") as f:
+            f.write('{"kind": "frag_sample", "ts": 2.0, "scor')
+        h2 = history.FragHistory(str(tmp_path))
+        assert h2.reseed() == 1
+        assert h2.series()[0]["ts"] == 1.0
+
+    def test_rotation_bounds_the_spool(self, tmp_path):
+        h = history.FragHistory(str(tmp_path), max_spool_bytes=256)
+        for round_ in range(6):
+            for i in range(8):
+                h.record({"ts": float(round_ * 8 + i), "score": 0.5,
+                          "classes": {"1": 1, "2": 1}})
+            h.flush()
+        prev = h.spool_path[:-len(history.SPOOL_SUFFIX)] \
+            + f".prev{history.SPOOL_SUFFIX}"
+        assert os.path.exists(prev)
+        assert os.path.getsize(h.spool_path) <= 2 * 256 + 1024
+        # reseed reads BOTH generations, oldest first, re-sorted
+        h2 = history.FragHistory(str(tmp_path))
+        h2.reseed()
+        ts = [s["ts"] for s in h2.series()]
+        assert ts == sorted(ts) and len(ts) > 8
+
+    def test_reap_stale_spools(self, tmp_path):
+        h = history.FragHistory(str(tmp_path))
+        h.record({"ts": 1.0, "score": 0.0, "classes": {}})
+        h.flush()
+        old = time.time() - 25 * 3600
+        os.utime(h.spool_path, (old, old))
+        assert history.reap_stale_spools(str(tmp_path)) >= 1
+        assert not os.path.exists(h.spool_path)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the elected reschedule-controller cluster scan
+# ---------------------------------------------------------------------------
+
+class _CountingClient(FakeKubeClient):
+    def __init__(self):
+        super().__init__(upsert_on_patch=True)
+        self.cluster_lists = 0
+        self.node_lists = 0
+
+    def list_pods(self, namespace=None, node_name=None,
+                  field_selector=None):
+        if node_name:
+            self.node_lists += 1
+        else:
+            self.cluster_lists += 1
+        return super().list_pods(namespace=namespace,
+                                 node_name=node_name,
+                                 field_selector=field_selector)
+
+
+class TestScanLease:
+    def _fleet(self, n=3):
+        client = _CountingClient()
+        tickers = [ScanLeaseTicker(client, f"node-{i}")
+                   for i in range(n)]
+        controllers = [
+            RescheduleController(client, f"node-{i}",
+                                 intent_scan_every=1,
+                                 cluster_scan_leader=tickers[i].probe)
+            for i in range(n)]
+        return client, tickers, controllers
+
+    def test_exactly_one_cluster_list_per_round(self):
+        """The vtscale leftover closed: 3 controllers, one shared
+        apiserver — each cadence round pays exactly ONE cluster-wide
+        pod LIST fleet-wide; followers keep their node-scoped passes."""
+        client, tickers, controllers = self._fleet()
+        for t in tickers:
+            t.tick_once()
+        leaders = [t for t in tickers if t.lease.held]
+        assert len(leaders) == 1, "exactly one scan leader elected"
+        for round_ in range(3):
+            client.cluster_lists = 0
+            client.node_lists = 0
+            for t in tickers:
+                t.tick_once()            # renew / stand by
+            for c in controllers:
+                c.reconcile_once()
+            assert client.cluster_lists == 1, \
+                f"round {round_}: exactly one cluster LIST fleet-wide"
+            assert client.node_lists == 2, \
+                f"round {round_}: followers stay node-scoped"
+
+    def test_unproven_probe_fails_open_to_scanning(self):
+        """Before the ticker ever completes a lease round-trip the
+        probe raises and the controller's existing catch scans anyway
+        — 'not leader' must never silently mean 'nobody reaps'."""
+        client = _CountingClient()
+        ticker = ScanLeaseTicker(client, "node-0")  # never ticked
+        with pytest.raises(RuntimeError):
+            ticker.probe()
+        ctl = RescheduleController(client, "node-0",
+                                   intent_scan_every=1,
+                                   cluster_scan_leader=ticker.probe)
+        ctl.reconcile_once()
+        assert client.cluster_lists == 1
+
+    def test_failed_tick_reverts_to_fail_open(self):
+        client, tickers, _ = self._fleet(1)
+        ticker = tickers[0]
+        ticker.tick_once()
+        assert ticker.probe() is True
+
+        def boom(*a, **kw):
+            from vtpu_manager.client.kube import KubeError
+            raise KubeError(503, "apiserver down")
+
+        ticker.lease.try_acquire = boom
+        ticker.lease.renew = boom
+        ticker.lease.held = False
+        with pytest.raises(Exception):
+            ticker.tick_once()
+        assert ticker.tick_failures_total == 1
+        with pytest.raises(RuntimeError):
+            ticker.probe()
+
+    def test_release_hands_the_lease_over(self):
+        client, tickers, _ = self._fleet(2)
+        tickers[0].tick_once()
+        assert tickers[0].lease.held
+        tickers[0].stop()
+        tickers[1].tick_once()
+        assert tickers[1].lease.held

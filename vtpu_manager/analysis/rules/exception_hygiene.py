@@ -6,7 +6,7 @@ drops health flips, or wedges allocations, which is strictly worse. In
 the control-plane packages (scheduler/, manager/, deviceplugin/,
 kubeletplugin/, trace/, client/, resilience/, telemetry/,
 compilecache/, clustercache/, utilization/, explain/, quota/,
-overcommit/, topology/, slo/, autopilot/) every
+overcommit/, topology/, slo/, autopilot/, fragmentation/) every
 ``except Exception`` / bare ``except`` must either
 re-raise or log before continuing; bare ``except:`` is always flagged
 (it also eats SystemExit/KeyboardInterrupt).
@@ -29,7 +29,8 @@ RULE = "exception-hygiene"
 SCOPED_DIRS = ("scheduler", "manager", "deviceplugin", "kubeletplugin",
                "trace", "client", "resilience", "telemetry",
                "compilecache", "clustercache", "utilization", "explain",
-               "quota", "overcommit", "topology", "slo", "autopilot")
+               "quota", "overcommit", "topology", "slo", "autopilot",
+               "fragmentation")
 
 _LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
                 "critical", "log"}

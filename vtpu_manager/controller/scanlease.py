@@ -1,0 +1,115 @@
+"""Elected reschedule-controller cluster scan (the vtscale leftover).
+
+The committed-but-unbound reaper needs ONE cluster-wide pod LIST per
+cadence round — those pods carry only the predicate-node annotation,
+which no field selector reaches — but pre-this-module every controller
+paid it unless the SLOAutopilot gate happened to be on (the reaper's
+leadership rode the autopilot coordination lease, with lease I/O on
+every probe call). This module gives the scan its OWN activity lease
+under the Reschedule gate, the webhook-HA pattern:
+
+- the entrypoint runs the **renew ticker** (one background thread per
+  controller: acquire when vacant, renew while held, stand by on a
+  live foreign lease — the ShardLease machinery unchanged, under its
+  own Lease object so it never contends with scheduler shards);
+- the controller's ``cluster_scan_leader`` probe reads only the cheap
+  local ``held_fresh()`` — **no lease I/O ever rides the reconcile
+  path** (the webhook handlers' no-I/O rule);
+- the probe **fails open to scanning**: while the lease machinery is
+  unproven (apiserver unreachable, ticker not yet run) it raises, and
+  the controller's existing fallback scans anyway — duplicate LISTs
+  cost apiserver load, a never-reaped crash window costs correctness.
+
+Followers keep their node-scoped passes untouched either way.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from vtpu_manager.scheduler.lease import (DEFAULT_LEASE_NAMESPACE,
+                                          LeaseLostError, ShardLease)
+
+log = logging.getLogger(__name__)
+
+# the shard name on the dedicated Lease object; distinct from every
+# scheduler shard and from the autopilot coordination shard, so scan
+# leadership never couples to either plane's election
+RESCHEDULE_SCAN_SHARD = "reschedule-scan"
+DEFAULT_TICK_S = 5.0
+
+
+class ScanLeaseTicker:
+    """Background renew ticker + local-read probe for the scan lease."""
+
+    def __init__(self, client, holder: str,
+                 namespace: str = DEFAULT_LEASE_NAMESPACE,
+                 ttl_s: float = 30.0, tick_s: float = DEFAULT_TICK_S):
+        self.lease = ShardLease(client, RESCHEDULE_SCAN_SHARD, holder,
+                                ttl_s=ttl_s, namespace=namespace)
+        self.tick_s = tick_s
+        # True once any tick completed its lease I/O without raising —
+        # before that (and after an I/O-failing tick) the probe must
+        # fail open: "not leader" would silently mean "nobody scans"
+        self._proven = False
+        self.tick_failures_total = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- ticker (entrypoint-run, the webhook renew-ticker rule) --------------
+
+    def tick_once(self) -> None:
+        """One maintenance step: renew while held (a definitive loss
+        re-enters the acquire race immediately), acquire when vacant,
+        stand by on a live foreign lease."""
+        try:
+            if self.lease.held:
+                try:
+                    self.lease.renew()
+                except LeaseLostError:
+                    self.lease.try_acquire()
+            else:
+                self.lease.try_acquire()
+            self._proven = True
+        except Exception:
+            # apiserver trouble: leadership is unproven, the probe
+            # fails open until a tick succeeds again
+            self._proven = False
+            self.tick_failures_total += 1
+            raise
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.tick_s):
+                try:
+                    self.tick_once()
+                except Exception as e:  # noqa: BLE001 — lease trouble
+                    # must not kill the ticker; the probe is already
+                    # failing open and the next tick retries
+                    log.warning("scan-lease tick failed: %s", e)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtscan-lease")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.lease.held:
+            try:
+                self.lease.release()
+            except Exception:  # noqa: BLE001 — best-effort handoff;
+                # the TTL expires the lease for the next acquirer
+                log.debug("scan-lease release failed", exc_info=True)
+
+    # -- probe (reconcile-path, local reads ONLY) ----------------------------
+
+    def probe(self) -> bool:
+        """``cluster_scan_leader`` value: am I the scan leader right
+        now? Pure local reads (held_fresh is a clock compare). Raises
+        while leadership is unproven — the controller's existing
+        fail-open catch scans anyway."""
+        if not self._proven and not self.lease.held_fresh():
+            raise RuntimeError(
+                "scan lease unproven (ticker has not completed a "
+                "lease round-trip); failing open to scanning")
+        return self.lease.held_fresh()

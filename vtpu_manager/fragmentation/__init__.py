@@ -1,0 +1,21 @@
+"""vtfrag: fleet fragmentation & placeability observatory.
+
+Everything here is behind the FragObservatory gate and observe-only:
+the score is computed from the same state the scheduler places on, the
+forecaster replays the real FilterPredicate against a mirror, and the
+gate off leaves every surface byte-identical. See docs/fragmentation.md.
+"""
+
+from vtpu_manager.fragmentation.codec import (   # noqa: F401
+    MAX_FRAG_AGE_S,
+    NodeFrag,
+    frag_is_fresh,
+    parse_frag,
+)
+from vtpu_manager.fragmentation.score import (   # noqa: F401
+    GANG_CLASSES,
+    frag_from_free,
+    free_chips,
+    node_frag,
+    placeable_boxes,
+)

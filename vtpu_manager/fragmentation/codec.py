@@ -1,0 +1,114 @@
+"""Node fragmentation annotation: vtfrag's wire format.
+
+Same codec family as the pressure / headroom / overcommit / link-load /
+chip-health annotations — parse-cheap on purpose (the snapshot path
+decodes it per node event, the rollup per fleet collect), staleness
+explicit by timestamp:
+
+    "<class>:<count>;...|<free>|<score>@<wall_ts>"
+
+one ``;``-separated segment per gang-size class (1/2/4/8/16 chips by
+default) carrying the number of DISJOINT contiguous boxes of that size
+still placeable on the node's free healthy chips, then the free-chip
+total, then the scalar frag score (``1 - largest_placeable/free``; 0.0
+on an empty node, 1.0 when nothing places at all). The timestamp makes
+staleness explicit — a publisher that goes dark must decay to
+"no signal" (the node drops out of the fleet rollup and its series),
+never pin a placeability claim an operator would capacity-plan on.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from vtpu_manager.util import stalecodec
+
+# staleness family constant (pressure/headroom/overcommit/health value)
+MAX_FRAG_AGE_S = 120.0
+
+# defensive parse bounds: the class list is fixed-small (5 entries for
+# the default 1/2/4/8/16 ladder); the caps bound the split cost an
+# adversarial annotation can impose on the event path
+MAX_FRAG_SEGMENTS = 16
+MAX_FRAG_LEN = 512
+
+
+@dataclass(frozen=True)
+class NodeFrag:
+    """Decoded per-node fragmentation rollup."""
+
+    classes: dict = field(default_factory=dict)   # gang size -> box count
+    free: int = 0
+    score: float = 0.0
+    ts: float = 0.0
+
+    def encode(self) -> str:
+        segs = [f"{size}:{count}"
+                for size, count in sorted(self.classes.items())]
+        body = (";".join(segs[:MAX_FRAG_SEGMENTS])
+                + f"|{self.free}|{self.score:.4f}")
+        return stalecodec.stamp(body, self.ts)
+
+    def largest(self) -> int:
+        """Largest gang-size class with at least one placeable box."""
+        return max((s for s, n in self.classes.items() if n > 0),
+                   default=0)
+
+
+def parse_frag(raw: str | None, now: float | None = None,
+               max_age_s: float = MAX_FRAG_AGE_S) -> NodeFrag | None:
+    """Decode the annotation; None when absent, malformed, or stale —
+    every bad shape degrades to no-signal, never to a wrong
+    placeability claim the rollup would report."""
+    split = stalecodec.split_stamp(raw, max_len=MAX_FRAG_LEN)
+    if split is None:
+        return None
+    body, ts = split
+    if not stalecodec.is_fresh(ts, now=now, max_age_s=max_age_s):
+        return None
+    parts = body.split("|")
+    if len(parts) != 3:
+        return None
+    class_part, free_raw, score_raw = parts
+    classes: dict = {}
+    segments = 0
+    for seg in class_part.split(";"):
+        if not seg:
+            continue
+        segments += 1
+        if segments > MAX_FRAG_SEGMENTS:
+            return None
+        size_raw, sep, count_raw = seg.partition(":")
+        if not sep:
+            return None
+        try:
+            size = int(size_raw)
+            count = int(count_raw)
+        except (TypeError, ValueError):
+            return None
+        if size <= 0 or count < 0:
+            return None
+        classes[size] = count
+    try:
+        free = int(free_raw)
+        score = float(score_raw)
+    except (TypeError, ValueError):
+        return None
+    if free < 0 or not math.isfinite(score):
+        # NaN parses but poisons every rollup mean downstream — the
+        # garbage-means-no-signal rule of the whole codec family
+        return None
+    return NodeFrag(classes=classes, free=free,
+                    score=min(max(score, 0.0), 1.0), ts=ts)
+
+
+def frag_is_fresh(nf: "NodeFrag | None",
+                  now: float | None = None) -> bool:
+    """Use-time staleness verdict (the pressure-penalty rule): the
+    snapshot path caches the parsed object on the NodeEntry and a dead
+    publisher emits no further node events, so every consumer must
+    re-judge freshness at the moment it reports on it."""
+    if nf is None:
+        return False
+    return stalecodec.is_fresh(nf.ts, now=now, max_age_s=MAX_FRAG_AGE_S)

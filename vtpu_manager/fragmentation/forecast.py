@@ -1,0 +1,119 @@
+"""The vtfrag what-if doctor: "would this gang place RIGHT NOW?"
+
+Answers the monitor's ``/fragmentation?gang=N[&pods=k]`` by replaying
+the REAL ``FilterPredicate`` — not a lookalike heuristic — against a
+write-swallowing mirror of the live cluster state: nodes and pods are
+listed from the real client, seeded into a ``FakeKubeClient``, and k
+synthetic whole-chip gang probe pods are driven through an actual
+filter pass there. Commits land harmlessly in the mirror (probe i's
+placement is accounted against probe i+1 through the predicate's own
+assumed cache — exactly how a real k-pod gang admission wave books
+capacity), the live cluster sees zero writes, and the per-node kill
+terms are the pass's own ``failed_nodes`` reasons reduced through
+``explain.reason_code`` — the same one-derivation rule the audit
+records follow, so the doctor and the scheduler cannot disagree about
+why a node refused.
+"""
+
+from __future__ import annotations
+
+import time
+
+from vtpu_manager.client.fake import FakeKubeClient
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import consts
+
+# gang sizes the route accepts — the published class ladder; anything
+# else is a caller error (400), not a silent misreading
+PROBE_GANG_SIZES = (1, 2, 4, 8, 16)
+MAX_PROBE_PODS = 64
+
+
+def probe_pod(gang: int, index: int = 0, pods: int = 1) -> dict:
+    """One synthetic whole-chip gang member: ``gang`` chips at 100
+    cores each (per-chip core clamping makes 100 cores exclusive — the
+    probe competes for FREE chips only, matching the frag score's
+    chip-granular definition) under ici-strict topology, so "places"
+    means a CONTIGUOUS box the way a real gang demands one."""
+    name = f"vtfrag-whatif-{index}"
+    anns = {consts.topology_mode_annotation(): "ici-strict"}
+    if pods > 1:
+        anns[consts.gang_name_annotation()] = "vtfrag-whatif"
+        anns[consts.gang_size_annotation()] = str(pods)
+    return {
+        "metadata": {"name": name, "namespace": "vtfrag-whatif",
+                     "uid": f"uid-{name}", "annotations": anns},
+        "spec": {"containers": [{
+            "name": "main", "resources": {"limits": {
+                consts.vtpu_number_resource(): gang,
+                consts.vtpu_cores_resource(): 100,
+                consts.vtpu_memory_resource(): 1024}}}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def mirror_client(nodes: list, pods: list) -> FakeKubeClient:
+    """Seed a write-swallowing mirror with the live listing. The fake
+    deep-copies on add, so the mirror cannot alias live objects."""
+    mirror = FakeKubeClient(upsert_on_patch=True)
+    for node in nodes:
+        mirror.add_node(node)
+    for pod in pods:
+        mirror.add_pod(pod)
+    return mirror
+
+
+def what_if(client, gang: int, pods: int = 1,
+            predicate_kwargs: dict | None = None,
+            now: float | None = None) -> dict:
+    """The full what-if verdict document. ``client`` is the monitor's
+    fan client (listed once, never written); ``predicate_kwargs``
+    mirrors the monitor's own placement-shaping gates (health_plane,
+    hbm_overcommit, ...) into the replayed predicate so the verdict
+    matches what the real scheduler would rule under the same gates.
+
+    Raises ValueError on out-of-catalog probe shapes (the route's 400)
+    and lets client/list errors propagate (the route's 503).
+    """
+    if gang not in PROBE_GANG_SIZES:
+        raise ValueError(f"gang must be one of {PROBE_GANG_SIZES}, "
+                         f"got {gang}")
+    if not 1 <= pods <= MAX_PROBE_PODS:
+        raise ValueError(f"pods must be 1..{MAX_PROBE_PODS}, got {pods}")
+    # chaos: a rollup/forecast fault must 503 THIS route only — the
+    # metrics scrape never runs this code path
+    failpoints.fire("frag.rollup", gang=gang, pods=pods)
+    # deferred: scheduler is an optional dependency edge for the
+    # monitor process; importing at call time keeps the module cheap
+    # for spool-only consumers
+    from vtpu_manager.scheduler.filter import FilterPredicate
+    from vtpu_manager import explain
+
+    mirror = mirror_client(client.list_nodes(),
+                           client.list_pods(field_selector="spec.nodeName!="))
+    pred = FilterPredicate(mirror, **(predicate_kwargs or {}))
+    placed: list[str] = []
+    blockers: dict[str, dict] = {}
+    error = ""
+    for i in range(pods):
+        probe = probe_pod(gang, index=i, pods=pods)
+        mirror.add_pod(probe)
+        result = pred.filter({"Pod": probe})
+        if result.error or not result.node_names:
+            error = result.error or "no node fits"
+            for node, why in sorted(result.failed_nodes.items()):
+                blockers[node] = {"reason_code":
+                                  explain.reason_code(str(why)),
+                                  "detail": str(why)[:256]}
+            break
+        # the pass committed the best candidate into the mirror — read
+        # it back off the probe's own annotations (the real channel)
+        committed = mirror.get_pod("vtfrag-whatif",
+                                   probe["metadata"]["name"])
+        placed.append((committed["metadata"].get("annotations") or {})
+                      .get(consts.predicate_node_annotation(), ""))
+    verdict = "placeable" if len(placed) == pods else "unplaceable"
+    return {"gang": gang, "pods": pods, "verdict": verdict,
+            "pods_placed": len(placed), "placed": placed,
+            "error": error, "blockers": blockers,
+            "ts": time.time() if now is None else now}

@@ -1,0 +1,228 @@
+"""vtfrag placeability history: bounded time-series ring + spool.
+
+"When did we lose 16-chip placeability" is only answerable after the
+fact if someone remembered — the monitor can restart at any time and
+the rollup only knows *now*. This module keeps a bounded ring of fleet
+placeability samples, persisted with the span-ring/spool discipline
+the trace / explain / slo planes use:
+
+- ``record()`` appends to the in-memory ring under a short lock and at
+  most WAKES the background flusher — zero I/O on the collect path (a
+  hung disk must never stall the monitor's scrape);
+- the flusher (and atexit) appends JSONL to a per-process spool under
+  a ``FileLock``, rotating at the byte cap to a single ``.prev``
+  generation, so one process is bounded at ~2x the cap;
+- a restarted monitor **re-seeds** its ring from the spools so the
+  history survives restarts instead of starting blind;
+- a torn spool line (crash mid-append) is SKIPPED, never fatal — the
+  chaos rule every spool reader on the node follows.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from vtpu_manager.util.flock import FileLock
+
+log = logging.getLogger(__name__)
+
+SPOOL_SUFFIX = ".jsonl"
+# samples retained: at the default ~15 s rollup cadence a 960-sample
+# ring remembers ~4 hours of fleet placeability — enough to date a
+# lost-placeability incident without unbounded growth
+DEFAULT_SAMPLES = 960
+DEFAULT_MAX_SPOOL_BYTES = 4 * 2**20
+DEFAULT_FLUSH_INTERVAL_S = 2.0
+
+
+def sample_from_rollup(frag_block: dict,
+                       now: float | None = None) -> dict:
+    """One history sample from a /utilization fragmentation block —
+    kept wire-small on purpose (ts, fleet score, per-class placeable
+    totals); per-node detail stays in the live rollup."""
+    return {"ts": time.time() if now is None else now,
+            "score": float(frag_block.get("fleet_score", 0.0)),
+            "classes": {str(k): int(v) for k, v in
+                        (frag_block.get("placeable_gangs")
+                         or {}).items()}}
+
+
+class FragHistory:
+    """Bounded fleet placeability history with spool persistence."""
+
+    def __init__(self, spool_dir: str,
+                 samples: int = DEFAULT_SAMPLES,
+                 max_spool_bytes: int = DEFAULT_MAX_SPOOL_BYTES):
+        self.spool_dir = spool_dir
+        self.samples = max(2, samples)
+        self.max_spool_bytes = max_spool_bytes
+        self.spool_path = os.path.join(
+            spool_dir, f"frag.{os.getpid()}{SPOOL_SUFFIX}")
+        self._lock = threading.Lock()
+        self._ring: list[dict] = []      # oldest first, bounded
+        self._pending: list[dict] = []
+        self.dropped_total = 0
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    # -- hot path (called from the rollup collect) ---------------------------
+
+    def record(self, sample: dict) -> None:
+        """Append one sample — ring mutation under the short lock only,
+        never I/O. A pending-spool backlog past 4x the ring drops the
+        oldest pending line and counts it (backpressure must not reach
+        the collect)."""
+        with self._lock:
+            self._ring.append(sample)
+            if len(self._ring) > self.samples:
+                del self._ring[:len(self._ring) - self.samples]
+            self._pending.append(sample)
+            if len(self._pending) > 4 * self.samples:
+                del self._pending[0]
+                self.dropped_total += 1
+        self._wake.set()
+
+    def series(self, since: float = 0.0) -> list[dict]:
+        with self._lock:
+            return [s for s in self._ring
+                    if float(s.get("ts", 0.0)) >= since]
+
+    # -- spool ---------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Drain pending samples to the per-process spool (flusher
+        thread / atexit only). An unwritable spool counts the loss and
+        keeps the in-memory ring serving — the trace-recorder rule."""
+        with self._lock:
+            pending = self._pending
+            self._pending = []
+        if not pending:
+            return 0
+        lines = [json.dumps({"kind": "frag_sample", **s},
+                            separators=(",", ":"))
+                 for s in pending]
+        try:
+            os.makedirs(self.spool_dir, exist_ok=True)
+            with FileLock(f"{self.spool_path}.flock"):
+                self._rotate_if_large()
+                with open(self.spool_path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+        except OSError:
+            with self._lock:
+                self.dropped_total += len(pending)
+            return 0
+        return len(pending)
+
+    def _rotate_if_large(self) -> None:
+        try:
+            size = os.path.getsize(self.spool_path)
+        except OSError:
+            return
+        if size < self.max_spool_bytes:
+            return
+        prev = self.spool_path[:-len(SPOOL_SUFFIX)] \
+            + f".prev{SPOOL_SUFFIX}"
+        os.replace(self.spool_path, prev)
+
+    def reseed(self) -> int:
+        """Restart continuation: re-read every spool under the dir
+        (``.prev`` generations first, torn lines skipped), rebuild the
+        bounded ring, re-sort by ts so interleaved generations replay
+        in causal order. Returns samples loaded."""
+        loaded = 0
+        for sample in read_spools(self.spool_dir):
+            with self._lock:
+                self._ring.append(sample)
+                if len(self._ring) > self.samples:
+                    del self._ring[:len(self._ring) - self.samples]
+            loaded += 1
+        with self._lock:
+            self._ring.sort(key=lambda s: float(s.get("ts", 0.0)))
+        return loaded
+
+    # -- flusher thread ------------------------------------------------------
+
+    def start_flusher(self,
+                      interval_s: float = DEFAULT_FLUSH_INTERVAL_S
+                      ) -> None:
+        import atexit
+
+        def loop():
+            while not self._stop:
+                self._wake.wait(interval_s)
+                self._wake.clear()
+                self.flush()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtfrag-history")
+        self._thread.start()
+        atexit.register(self.flush)
+
+    def stop_flusher(self) -> None:
+        self._stop = True
+        self._wake.set()
+
+
+def read_spools(spool_dir: str):
+    """Yield samples from every frag spool under the dir, oldest
+    generation first. Torn/garbage lines are skipped, never fatal
+    (chaos contract)."""
+    if not os.path.isdir(spool_dir):
+        return
+    names = sorted(
+        n for n in os.listdir(spool_dir)
+        if n.startswith("frag.") and n.endswith(SPOOL_SUFFIX))
+    # .prev generations are older: read them before their successors
+    names.sort(key=lambda n: (".prev" not in n, n))
+    for name in names:
+        path = os.path.join(spool_dir, name)
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue        # torn line: skipped, never fatal
+            if doc.get("kind") != "frag_sample":
+                continue
+            try:
+                yield {"ts": float(doc.get("ts", 0.0)),
+                       "score": float(doc.get("score", 0.0)),
+                       "classes": {str(k): int(v) for k, v in
+                                   (doc.get("classes") or {}).items()}}
+            except (TypeError, ValueError):
+                continue
+
+
+def reap_stale_spools(spool_dir: str, max_age_s: float = 24 * 3600.0,
+                      now: float | None = None) -> int:
+    """Delete frag spools (and flocks) untouched past the TTL — dead
+    monitors' leftovers; live ones re-stamp mtime every flush."""
+    removed = 0
+    if not os.path.isdir(spool_dir):
+        return removed
+    cutoff = (time.time() if now is None else now) - max_age_s
+    for name in os.listdir(spool_dir):
+        if not name.startswith("frag."):
+            continue
+        if not (name.endswith(SPOOL_SUFFIX)
+                or name.endswith(f"{SPOOL_SUFFIX}.flock")):
+            continue
+        path = os.path.join(spool_dir, name)
+        try:
+            if os.path.getmtime(path) < cutoff:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            continue
+    return removed

@@ -1,0 +1,105 @@
+"""vtfrag Prometheus surfaces — the ONE home of every series literal.
+
+``vtpu_frag_score`` / ``vtpu_placeable_gangs`` render on the node
+exporter (device-plugin /metrics, fed by the publisher's last rollup)
+and on the scheduler /metrics (fed by the per-pass stash the shared
+``_allocate_node`` tap maintains); ``vtpu_frag_forecast_total`` counts
+the monitor's /fragmentation what-if verdicts. All three families are
+gate-conditional by construction: every render function returns ""
+until the FragObservatory machinery actually produced state, so the
+gate-off scrape stays byte-identical (the metrics-registry rule's
+one-home discipline keeps the literals out of every other module).
+"""
+
+from __future__ import annotations
+
+from vtpu_manager.fragmentation.codec import NodeFrag, frag_is_fresh
+
+# /fragmentation what-if verdicts by outcome (the monitor bumps these;
+# module-level like the resilience counters: the route handler bumps,
+# /metrics renders, tests read directly)
+FORECAST_VERDICTS = ("placeable", "unplaceable", "error")
+_forecast_total: dict[str, int] = {}
+
+
+def bump_forecast(verdict: str) -> None:
+    _forecast_total[verdict] = _forecast_total.get(verdict, 0) + 1
+
+
+def forecast_totals() -> dict[str, int]:
+    return dict(_forecast_total)
+
+
+def reset_forecast_totals() -> None:
+    """Test hook (the resilience-counter pattern)."""
+    _forecast_total.clear()
+
+
+def _frag_block(rows: list, now: float | None = None) -> str:
+    """Shared body for both gauge surfaces: ``rows`` is a list of
+    (node, NodeFrag); stale/absent entries are skipped at render time —
+    the staleness-re-judged-at-use rule, so a dead publisher's node
+    drops off the scrape instead of pinning its last claim."""
+    fresh = [(node, nf) for node, nf in rows
+             if frag_is_fresh(nf, now=now)]
+    if not fresh:
+        return ""
+    lines = [
+        "# HELP vtpu_frag_score Node fragmentation score: "
+        "1 - largest placeable contiguous box / free chips "
+        "(0 = one solid box, -> 1 = shattered)",
+        "# TYPE vtpu_frag_score gauge",
+    ]
+    for node, nf in fresh:
+        lines.append(f'vtpu_frag_score{{node="{node}"}} {nf.score:.4f}')
+    lines += [
+        "# HELP vtpu_placeable_gangs Disjoint contiguous gang boxes "
+        "still placeable on the node's free healthy chips, per "
+        "gang-size class",
+        "# TYPE vtpu_placeable_gangs gauge",
+    ]
+    for node, nf in fresh:
+        for size in sorted(nf.classes):
+            lines.append(
+                f'vtpu_placeable_gangs{{node="{node}",'
+                f'class="{size}"}} {nf.classes[size]}')
+    return "\n".join(lines) + "\n"
+
+
+def render_node_frag(node: str, nf: "NodeFrag | None",
+                     now: float | None = None) -> str:
+    """Node-exporter block (device-plugin /metrics): the publisher's
+    last computed rollup; "" until one ran (no FragObservatory
+    publisher = no new series, the gate-off contract)."""
+    if nf is None:
+        return ""
+    return _frag_block([(node, nf)], now=now)
+
+
+def render_sched_frag(frag_by_node: dict,
+                      now: float | None = None) -> str:
+    """Scheduler /metrics block: the per-candidate stash both data
+    paths maintain in the shared ``_allocate_node`` tap; "" when the
+    gate is off (the stash is never populated) so the gate-off scrape
+    stays byte-identical."""
+    if not frag_by_node:
+        return ""
+    return _frag_block(sorted(frag_by_node.items()), now=now)
+
+
+def render_forecast_metrics() -> str:
+    """Monitor /metrics block for the what-if doctor; "" until a
+    /fragmentation probe ran (gate off = no route = no bumps)."""
+    if not _forecast_total:
+        return ""
+    lines = [
+        "# HELP vtpu_frag_forecast_total /fragmentation what-if "
+        "verdicts by outcome",
+        "# TYPE vtpu_frag_forecast_total counter",
+    ]
+    for verdict in FORECAST_VERDICTS:
+        if verdict in _forecast_total:
+            lines.append(
+                f'vtpu_frag_forecast_total{{verdict="{verdict}"}} '
+                f"{_forecast_total[verdict]}")
+    return "\n".join(lines) + "\n"

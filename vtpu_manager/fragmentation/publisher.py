@@ -1,0 +1,111 @@
+"""vtfrag node-annotation publisher (device-plugin side).
+
+The node's own authoritative view of its fragmentation: residency from
+the per-container vtpu.config files (the SAME source of truth the
+link-load and pressure publishers fold — the devices a config names
+ARE the chips the scheduler allocated), health from the registry's own
+chip flags plus whatever dead-link set the caller's health probe
+reports, rolled up by the shared ``score`` core and patched as the
+``node_frag_annotation`` with a stalecodec timestamp. A publisher that
+goes dark decays to no-signal through the timestamp — the rollup drops
+the node rather than capacity-planning on its last claim.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from vtpu_manager.fragmentation.codec import NodeFrag
+from vtpu_manager.fragmentation.score import frag_from_free
+from vtpu_manager.resilience import failpoints
+from vtpu_manager.util import consts
+
+log = logging.getLogger(__name__)
+
+
+def compute_node_frag(registry, base_dir: str,
+                      dead_links: frozenset = frozenset(),
+                      now: float | None = None) -> NodeFrag:
+    """The plugin-side rollup: free = healthy registry chips carrying
+    no resident config device (chip-granular — any resident split
+    claims the whole chip for gang-box purposes, matching the
+    scheduler tap's claim-set definition)."""
+    from vtpu_manager.config import tenantdirs
+    claimed: set[str] = set()
+    for _uid, _label, cfg, _is_dra, _mtime in \
+            tenantdirs.iter_container_configs(base_dir):
+        for dev in cfg.devices:
+            claimed.add(dev.uuid)
+    free = [c for c in registry.chips
+            if c.healthy and c.uuid not in claimed]
+    return frag_from_free(free, registry.mesh, dead_links=dead_links,
+                          now=time.time() if now is None else now)
+
+
+class FragPublisher:
+    """Daemon loop: roll up the node's fragmentation, patch the node
+    annotation (the LinkLoadPublisher discipline: failures tolerated
+    per tick — the signal is advisory, and the annotation's own
+    timestamp ages a silent death out to no-signal fleet-wide)."""
+
+    def __init__(self, client, node_name: str, registry,
+                 base_dir: str, dead_links_fn=None, policy=None,
+                 interval_s: float = 15.0):
+        from vtpu_manager.resilience.policy import RetryPolicy
+        self.client = client
+        self.node_name = node_name
+        self.registry = registry
+        self.base_dir = base_dir
+        # optional probe for the node's current dead-ICI-link set (the
+        # health plane's view when that gate is armed); None = no link
+        # exclusions, chips' own healthy flags still honored
+        self.dead_links_fn = dead_links_fn
+        self.policy = policy or RetryPolicy(max_attempts=3,
+                                            deadline_s=10.0)
+        self.interval_s = interval_s
+        # last computed rollup, for the plugin /metrics surface
+        self.last: NodeFrag | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def publish_once(self) -> NodeFrag:
+        dead: frozenset = frozenset()
+        if self.dead_links_fn is not None:
+            try:
+                dead = frozenset(self.dead_links_fn() or ())
+            except Exception:  # noqa: BLE001 — the link probe is
+                # advisory; a torn probe publishes the link-blind score
+                # rather than skipping the tick
+                log.warning("dead-link probe failed; frag publish "
+                            "proceeds link-blind", exc_info=True)
+        nf = compute_node_frag(self.registry, self.base_dir,
+                               dead_links=dead)
+        self.last = nf
+        # chaos: a failed publish must decay the fleet view to
+        # no-signal via the annotation's own timestamp — never crash
+        # the daemon loop or wedge the other publishers
+        failpoints.fire("frag.publish", node=self.node_name)
+        self.policy.run(
+            lambda: self.client.patch_node_annotations(
+                self.node_name,
+                {consts.node_frag_annotation(): nf.encode()}),
+            op="fragmentation.frag_patch")
+        return nf
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.publish_once()
+                except Exception:  # noqa: BLE001 — advisory signal;
+                    # the annotation timestamp ages a silent failure
+                    # out to no-signal (node drops from the rollup)
+                    log.warning("frag publish failed", exc_info=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="vtfrag-publisher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,108 @@
+"""The per-node fragmentation score (FragObservatory gate).
+
+One pure function both scheduler data paths, the device-plugin
+publisher, and the bench share, so the reported number cannot drift
+between surfaces: given a registry view and the claim sets consuming
+it, how many DISJOINT contiguous boxes of each gang-size class still
+place on the free chips — using the EXACT submesh machinery the
+allocator uses (``select_submesh``: cube-preferred shapes, torus wrap,
+dead-ICI-link exclusion), so "placeable" here means placeable by the
+real allocator, not by a lookalike heuristic. Greedy (scattered)
+fallback picks do NOT count for multi-chip classes: fragmentation is
+precisely the loss of ici-strict-grade contiguous windows.
+
+The scalar score is ``1 - largest_placeable_box / free_chips``: 0.0
+when the whole free pool forms one box (or nothing is free — an empty
+pool is full, not fragmented), approaching 1.0 as churn shatters free
+capacity into slivers no large gang fits. The signal a naive free-HBM
+gauge misses by construction: raw free capacity stays flat while the
+largest box collapses.
+
+Chip-granular on purpose: a chip with ANY resident claim (even a
+fractional vtpu-cores split) is not free for a gang box — gangs take
+whole chips, and the defrag planner this plane feeds moves whole
+tenants. Cordoned chips are excluded by handing in the health-masked
+registry view (the callers in ``_allocate_node`` already hold it);
+this module only honors ``ChipSpec.healthy``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from vtpu_manager.device.topology.mesh import select_submesh
+from vtpu_manager.fragmentation.codec import NodeFrag
+
+# the gang-size ladder published per node: powers of two up to the
+# largest multi-host slice class the benches model. A class larger
+# than the node's mesh simply reports 0 placeable boxes.
+GANG_CLASSES = (1, 2, 4, 8, 16)
+
+
+def free_chips(registry, claim_sets: list) -> list:
+    """The chips a new gang box may use: healthy (the caller folds the
+    cordon mask in by passing the masked registry view) and carrying
+    ZERO resident claims."""
+    claimed: set[str] = set()
+    for claims in claim_sets:
+        for claim in claims.all_claims():
+            claimed.add(claim.uuid)
+    return [c for c in registry.chips
+            if c.healthy and c.uuid not in claimed]
+
+
+def placeable_boxes(free: list, n: int, mesh,
+                    dead_links: frozenset = frozenset()) -> int:
+    """How many DISJOINT contiguous n-chip boxes place on ``free`` —
+    greedy repeated ``select_submesh`` with the chosen chips removed
+    each round. Greedy disjoint packing is not guaranteed optimal for
+    arbitrary shapes, but it is the same box-choice order the real
+    allocator would commit under sequential admission, which is the
+    honest definition of "how many such gangs could land"."""
+    if n <= 0 or len(free) < n:
+        return 0
+    pool = list(free)
+    count = 0
+    while len(pool) >= n:
+        sel = select_submesh(pool, n, mesh,
+                             dead_links=dead_links or None)
+        if sel is None or (n > 1 and sel.kind != "rect"):
+            # the greedy fallback is a SCATTERED pick — legal for a
+            # topology-indifferent tenant, but not a contiguous box,
+            # which is the thing fragmentation destroys. Same bar the
+            # allocator holds ici-strict gangs to (sel.kind == "rect").
+            break
+        taken = {c.uuid for c in sel.chips}
+        pool = [c for c in pool if c.uuid not in taken]
+        count += 1
+    return count
+
+
+def frag_from_free(free: list, mesh, *,
+                   dead_links: frozenset = frozenset(),
+                   classes: tuple = GANG_CLASSES,
+                   now: float | None = None) -> NodeFrag:
+    """The rollup from an already-computed free-chip list — the shared
+    core under both claim-set callers (scheduler tap) and uuid-set
+    callers (device-plugin publisher, which knows residency as config
+    device uuids, not claim objects)."""
+    counts = {n: placeable_boxes(free, n, mesh, dead_links=dead_links)
+              for n in classes}
+    largest = max((n for n, c in counts.items() if c > 0), default=0)
+    score = 1.0 - (largest / len(free)) if free else 0.0
+    return NodeFrag(classes=counts, free=len(free),
+                    score=max(score, 0.0),
+                    ts=time.time() if now is None else now)
+
+
+def node_frag(registry, claim_sets: list, *,
+              dead_links: frozenset = frozenset(),
+              classes: tuple = GANG_CLASSES,
+              now: float | None = None) -> NodeFrag:
+    """The full per-node rollup: per-class disjoint box counts, free
+    total, scalar score. Pure over its inputs (the clock only stamps
+    the wire ts), so TTL-vs-snapshot parity is a property of the
+    callers handing in identical state — asserted by test_frag."""
+    free = free_chips(registry, claim_sets)
+    return frag_from_free(free, registry.mesh, dead_links=dead_links,
+                          classes=classes, now=now)

@@ -182,6 +182,17 @@ SITES: dict[str, str] = {
                      "gang and the successor's next eligible window "
                      "retries; error = a failed rescue that starts "
                      "the cooldown like a success)",
+    "frag.publish": "fragmentation/publisher.py FragPublisher."
+                    "publish_once, after the rollup is encoded and "
+                    "before the node-annotation patch (error = a "
+                    "failed publish the annotation's own timestamp "
+                    "ages out — the fleet rollup drops the node to "
+                    "no-signal, never capacity-plans on a ghost's "
+                    "placeability claim)",
+    "frag.rollup": "fragmentation/forecast.py what_if entry (the "
+                   "monitor's /fragmentation what-if doctor; "
+                   "error/latency must 503 only that route, never "
+                   "/metrics or a scheduling pass)",
 }
 
 ACTIONS = ("error", "latency", "crash", "partial-write")

@@ -51,6 +51,7 @@ from vtpu_manager.clustercache import advertise as cc_advertise
 from vtpu_manager.compilecache import antistorm
 from vtpu_manager.device.claims import PodDeviceClaims
 from vtpu_manager.device.types import NodeInfo
+from vtpu_manager.fragmentation import score as frag_score
 from vtpu_manager.health import codec as health_codec
 from vtpu_manager.overcommit import ratio as oc_mod
 from vtpu_manager.resilience import failpoints
@@ -114,9 +115,32 @@ class FilterPredicate:
                  hbm_overcommit: bool = False,
                  cluster_cache: bool = False,
                  ici_link_aware: bool = False,
-                 health_plane: bool = False):
+                 health_plane: bool = False,
+                 frag_observatory: bool = False):
         self.client = client
         self.serialize = serialize
+        # vtfrag (FragObservatory gate; default off = byte-identical
+        # scrapes and ZERO extra work in BOTH data paths): OBSERVE-ONLY
+        # — at the top of the shared _allocate_node body (before the
+        # overcommit virtual-registry scaling, on the health-masked
+        # view both paths hand in) the candidate's fragmentation rollup
+        # (score.node_frag: per-gang-class disjoint placeable boxes via
+        # the REAL select_submesh with the pass's dead-link set, scalar
+        # 1 - largest/free score) is computed and stashed per node for
+        # the /metrics frag block. Never touches the score, the
+        # capacity gates, or the result: placement is byte-identical
+        # with the gate on or off, and a torn rollup costs the evidence
+        # for that candidate, never the placement (the _observe
+        # discipline). Because the tap runs in the SHARED body on
+        # caller-handed state, TTL and snapshot report identical values
+        # on identical state — the parity test_frag asserts. Rides
+        # filter_kwargs so vtha shards inherit it.
+        self.frag_observatory = frag_observatory
+        # node -> NodeFrag from the last pass that visited it (plain
+        # dict assignment — GIL-atomic, same discipline as the
+        # headroom-observed counter); the scheduler /metrics frag block
+        # renders it gate-on, stays {} forever gate-off
+        self.frag_last: dict = {}
         # vtheal (HealthPlane gate; default off = byte-identical
         # placement in BOTH data paths): the node-published chip-health
         # annotation (health/codec.py; suspect chips schedule normally,
@@ -1182,6 +1206,23 @@ class FilterPredicate:
         HERE, where the actual score arithmetic runs: the record carries
         the exact values applied, not a re-derivation that could
         diverge)."""
+        # vtfrag observe-only tap: BEFORE the overcommit scaling below,
+        # on the exact (health-masked) registry + claim state the pass
+        # places against — the one point both data paths fund with
+        # identical inputs, so TTL and snapshot report the same rollup
+        # on the same state. A torn rollup may cost the evidence for
+        # this candidate, never the placement (the _observe discipline).
+        if self.frag_observatory:
+            try:
+                self.frag_last[name] = frag_score.node_frag(
+                    registry,
+                    [c for _uid, c in counted]
+                    + [e.claims for _uid, e in assumed],
+                    dead_links=health_dead)
+            except Exception:  # noqa: BLE001 — observe-only: the frag
+                # signal is advisory and must never fail a pass
+                log.warning("frag rollup failed for %s", name,
+                            exc_info=True)
         # vtovc: admission runs against the VIRTUAL registry — every
         # healthy chip's HBM scaled by the pod-class ratio (memoized
         # copy; ratio 1.0 returns the physical registry object itself,

@@ -228,6 +228,18 @@ class SchedulerAPI:
             lines.append(
                 f"vtpu_scheduler_headroom_observed_total "
                 f"{sum(p.headroom_observed for p in armed)}")
+        # vtfrag per-candidate rollups (FragObservatory gate; "" when
+        # off — the stash is never populated — so the gate-off scrape
+        # stays byte-identical): the shared _allocate_node tap's last
+        # NodeFrag per visited node, stale entries dropped at render
+        frag_by_node: dict = {}
+        for p in preds:
+            frag_by_node.update(getattr(p, "frag_last", None) or {})
+        if frag_by_node:
+            from vtpu_manager.fragmentation import metrics as frag_metrics
+            frag_block = frag_metrics.render_sched_frag(frag_by_node)
+            if frag_block:
+                lines.append(frag_block.rstrip("\n"))
         # vtexplain counters (DecisionExplain gate; "" when off so the
         # gate-off scrape stays byte-identical): audited passes,
         # per-reason rejection tallies, and ring drops — the drop

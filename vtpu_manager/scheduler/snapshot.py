@@ -48,6 +48,7 @@ import time
 from vtpu_manager.client.kube import KubeClient, KubeError
 from vtpu_manager.clustercache import advertise as cc_advertise
 from vtpu_manager.compilecache import antistorm
+from vtpu_manager.fragmentation import codec as frag_codec
 from vtpu_manager.health import codec as health_codec
 from vtpu_manager.quota import victimcost as vc_mod
 from vtpu_manager.device import types as dt
@@ -77,14 +78,14 @@ class NodeEntry:
                  "counted", "conditional", "base_free", "rank_key",
                  "generation", "pressure", "fp_recent", "headroom",
                  "overcommit", "warm", "victim_costs", "linkload",
-                 "chiphealth")
+                 "chiphealth", "frag")
 
     def __init__(self, name: str, node: dict, labels: dict, registry,
                  resident: dict, counted: list, conditional: list,
                  base_free: tuple, rank_key: int, generation: int,
                  pressure=None, fp_recent=(), headroom=None,
                  overcommit=None, warm=None, victim_costs=None,
-                 linkload=None, chiphealth=None):
+                 linkload=None, chiphealth=None, frag=None):
         self.name = name
         self.node = node                  # raw node object (shared ref)
         self.labels = labels
@@ -124,6 +125,12 @@ class NodeEntry:
         # UN-cordons (the legacy registry healthy flip is the
         # non-decaying backstop for a truly dead chip)
         self.chiphealth = chiphealth
+        # vtfrag node-published fragmentation rollup (NodeFrag | None),
+        # decoded at event apply/relist like pressure; observe-only —
+        # the rollup/smi surfaces re-judge staleness at report time
+        # (frag_is_fresh), so a dead publisher's node drops to
+        # no-signal instead of pinning its last placeability claim
+        self.frag = frag
         # vtcc anti-storm: residents' (program_fingerprint, placed_ts)
         # pairs inside the storm window at build time; decay is
         # re-judged at penalty time (a quiet node emits no events)
@@ -285,6 +292,7 @@ class ClusterSnapshot:
         self._node_victim_costs: dict[str, object] = {}  # -> NodeVictimCosts
         self._node_linkload: dict[str, object] = {}   # -> NodeLinkLoad
         self._node_chiphealth: dict[str, object] = {}  # -> NodeChipHealth
+        self._node_frag: dict[str, object] = {}       # -> NodeFrag
         # vtcs warm index: fingerprint -> (node, ...) for every node
         # advertising that fp. Copy-on-write tuples (the unbound-fp
         # pattern) so passes/tools read lock-free; maintained at node
@@ -569,6 +577,7 @@ class ClusterSnapshot:
                     self._node_victim_costs.pop(name, None)
                     self._node_linkload.pop(name, None)
                     self._node_chiphealth.pop(name, None)
+                    self._node_frag.pop(name, None)
                     self._set_warm_locked(name, None)
                     self._publish_rank_locked(name, None)
                     self.generation += 1
@@ -594,6 +603,8 @@ class ClusterSnapshot:
             anns.get(consts.node_ici_link_load_annotation()))
         node_chiphealth = health_codec.parse_chip_health(
             anns.get(consts.node_chip_health_annotation()))
+        node_frag = frag_codec.parse_frag(
+            anns.get(consts.node_frag_annotation()))
         labels = meta.get("labels") or {}
         with self._lock:
             self._node_pressure[name] = node_pressure
@@ -602,6 +613,7 @@ class ClusterSnapshot:
             self._node_victim_costs[name] = node_victim_costs
             self._node_linkload[name] = node_linkload
             self._node_chiphealth[name] = node_chiphealth
+            self._node_frag[name] = node_frag
             self._set_warm_locked(name, node_warm)
             self.generation += 1
             entry = self._build_entry_locked(name, node, labels, registry)
@@ -864,7 +876,8 @@ class ClusterSnapshot:
                          warm=self._node_warm.get(name),
                          victim_costs=self._node_victim_costs.get(name),
                          linkload=self._node_linkload.get(name),
-                         chiphealth=self._node_chiphealth.get(name))
+                         chiphealth=self._node_chiphealth.get(name),
+                         frag=self._node_frag.get(name))
 
     # -- relist (seed + 410 recovery) ---------------------------------------
 
@@ -934,6 +947,7 @@ class ClusterSnapshot:
             self._node_victim_costs = {}
             self._node_linkload = {}
             self._node_chiphealth = {}
+            self._node_frag = {}
             self._warm_fp_nodes = {}
             entries: dict[str, NodeEntry] = {}
             for node in nodes:
@@ -958,6 +972,8 @@ class ClusterSnapshot:
                 self._node_chiphealth[name] = \
                     health_codec.parse_chip_health(
                         anns.get(consts.node_chip_health_annotation()))
+                self._node_frag[name] = frag_codec.parse_frag(
+                    anns.get(consts.node_frag_annotation()))
                 self._set_warm_locked(name, cc_advertise.parse_warm_keys(
                     anns.get(consts.node_cache_keys_annotation())))
                 entries[name] = self._build_entry_locked(
@@ -1105,6 +1121,7 @@ class ClusterSnapshot:
                 fp_recent=entry.fp_recent, headroom=entry.headroom,
                 overcommit=entry.overcommit, warm=entry.warm,
                 victim_costs=entry.victim_costs,
-                linkload=entry.linkload, chiphealth=entry.chiphealth)
+                linkload=entry.linkload, chiphealth=entry.chiphealth,
+                frag=entry.frag)
             self._entries[name] = pruned
             self._publish_rank_locked(name, pruned)

@@ -364,6 +364,23 @@ def node_chip_health_annotation() -> str:
     return _ann("node-chip-health")
 
 
+def node_frag_annotation() -> str:
+    """vtfrag per-node fragmentation/placeability rollup
+    (FragObservatory gate):
+    ``"<class>:<count>;...|<free>|<score>@<ts>"``
+    (fragmentation/codec.py) — per gang-size class the number of
+    DISJOINT contiguous boxes still placeable on the node's free,
+    healthy, un-cordoned chips (dead ICI links excluded like the
+    allocator excludes them), the free-chip total, and the scalar frag
+    score (1 - largest-placeable-box/free). Published by the
+    device-plugin daemon over the registry channel. Same
+    staleness-by-timestamp family as the pressure/headroom/overcommit
+    codecs: a dead publisher decays to no-signal (the node drops out of
+    the fleet rollup and its series), never pins a stale placeability
+    claim an operator would capacity-plan on."""
+    return _ann("node-frag")
+
+
 def node_reclaimable_headroom_annotation() -> str:
     """vtuse reclaimable-headroom rollup (same codec family as the
     pressure annotation, utilization/headroom.py): per-chip

@@ -40,6 +40,7 @@ SLO_AUTOPILOT = "SLOAutopilot"          # vtpilot elected remediation controller
 SCALE_PIPELINE = "ScalePipeline"        # vtscale batched bind + dynamic plans
 WEBHOOK_HA = "WebhookHA"                # vtscale lease-elected webhook replicas
 HEALTH_PLANE = "HealthPlane"            # vtheal detect->cordon->rescue plane
+FRAG_OBSERVATORY = "FragObservatory"    # vtfrag fragmentation observatory
 
 _KNOWN = {
     CORE_PLUGIN: False,
@@ -281,6 +282,31 @@ _KNOWN = {
     # cooldown/token-bucket guards, converging through the PR 17
     # migration reapers on crash.
     HEALTH_PLANE: False,
+    # Default off: byte-identical — no frag annotation is published or
+    # parsed, NodeEntry carries frag=None, no vtpu_frag_score/
+    # vtpu_placeable_gangs/vtpu_frag_forecast_total series render on
+    # any scrape, the monitor registers no /fragmentation route,
+    # /utilization carries no fragmentation block, vtpu-smi shows no
+    # FRAG column/headline, no history ring or spool exists under the
+    # base dir, and placement is untouched in BOTH scheduler data paths
+    # (the score is an observe-only tap off the shared _allocate_node
+    # inputs — it never feeds a score term). On, the fleet gains a
+    # placeability observatory (vtpu_manager/fragmentation/): each node
+    # publishes its largest placeable contiguous box per gang-size
+    # class (1/2/4/8/16 chips, cube-preferred via the existing
+    # select_submesh machinery with cordon masks and dead ICI links
+    # folded in) vs. total free chips plus a scalar frag score
+    # (1 - largest/free) as a stalecodec node annotation; both
+    # scheduler paths stash the identical score per visited candidate
+    # (parity asserted); the monitor's /utilization grows a
+    # fragmentation block and /fragmentation?gang=N[&pods=k] answers
+    # "would this gang place right now, and which term kills each
+    # node" by replaying the REAL FilterPredicate against a
+    # write-swallowing mirror of the cluster state; and a bounded
+    # placeability time-series ring + JSONL spool answers "when did we
+    # lose 16-chip placeability" after the fact. The ROADMAP defrag
+    # planner consumes this score; the planner itself is future work.
+    FRAG_OBSERVATORY: False,
 }
 
 

@@ -47,7 +47,8 @@ class ClusterRollup:
                  comm: bool = False,
                  slo_ledger=None,
                  action_ledger=None,
-                 health: bool = False):
+                 health: bool = False,
+                 frag: bool = False):
         self.ledger = ledger
         self.client = client
         self.cache_root = cache_root
@@ -82,6 +83,13 @@ class ClusterRollup:
         # node's chip-health annotation and the document a fleet
         # unhealthy-chip headline (vtpu-smi's HEALTH column).
         self.health = health
+        # vtfrag (FragObservatory gate): False = the document carries
+        # no fragmentation fields at all — byte-identical /utilization
+        # (the vtqm pattern). On, each node row gains its published
+        # frag rollup and the document a fleet placeability block
+        # (vtpu-smi's FRAG column + headline, the what-if doctor's
+        # fleet context).
+        self.frag = frag
         # same knob the collector's scrape fold uses; parsed ONCE here
         # (a malformed env value fails at construction, not per request)
         if fold_budget_s is None:
@@ -133,6 +141,11 @@ class ClusterRollup:
                 from vtpu_manager.health import codec as health_codec
                 chiphealth = health_codec.parse_chip_health(
                     anns.get(hp_ann), now=now)
+            frag = None
+            if self.frag:
+                from vtpu_manager.fragmentation import codec as frag_codec
+                frag = frag_codec.parse_frag(
+                    anns.get(consts.node_frag_annotation()), now=now)
             chips = []
             if registry is not None:
                 for chip in registry.chips:
@@ -205,6 +218,20 @@ class ClusterRollup:
                         if s != "healthy") if chiphealth else 0)
                 row_extra["health_ts"] = \
                     chiphealth.ts if chiphealth else None
+            if self.frag:
+                # vtfrag node fields (gate on only — off keeps the
+                # document byte-identical): the node's published
+                # placeability rollup; None across the board = no
+                # fresh signal (dark/stale publisher), which the fleet
+                # block below counts but never averages in
+                row_extra["frag_score"] = \
+                    round(frag.score, 4) if frag else None
+                row_extra["frag_free_chips"] = \
+                    frag.free if frag else None
+                row_extra["frag_classes"] = (
+                    {str(k): v for k, v in sorted(frag.classes.items())}
+                    if frag else None)
+                row_extra["frag_ts"] = frag.ts if frag else None
             if self.cluster_cache:
                 # vtcs warm-keys fields (gate on only — off keeps the
                 # document byte-identical): which programs this node
@@ -600,6 +627,44 @@ class ClusterRollup:
                 "nodes_publishing": publishing,
                 "unhealthy_chips": unhealthy,
                 "by_state": by_state,
+            }
+        if self.frag:
+            # vtfrag fleet placeability block (gate off = no key at
+            # all): the per-class placeable-gang histogram summed over
+            # every fresh-publishing node, the fleet frag score (mean
+            # over the same set), and the per-node rows — folded from
+            # the SAME decoded annotations the node rows carry, so the
+            # headline and the FRAG column can never disagree. This is
+            # the block the FragHistory samples and the forecaster
+            # contextualizes.
+            gangs: dict[str, int] = {}
+            scores = []
+            free_sum = 0
+            publishing = 0
+            frag_rows = []
+            for nrow in node_rows:
+                if nrow.get("frag_ts") is None:
+                    continue
+                publishing += 1
+                scores.append(float(nrow["frag_score"]))
+                free_sum += int(nrow.get("frag_free_chips") or 0)
+                for cls, count in (nrow.get("frag_classes")
+                                   or {}).items():
+                    gangs[cls] = gangs.get(cls, 0) + int(count)
+                frag_rows.append({
+                    "node": nrow["node"],
+                    "score": nrow["frag_score"],
+                    "free_chips": nrow["frag_free_chips"],
+                    "classes": nrow["frag_classes"],
+                })
+            doc["fragmentation"] = {
+                "nodes_publishing": publishing,
+                "fleet_score": round(sum(scores) / len(scores), 4)
+                    if scores else 0.0,
+                "free_chips": free_sum,
+                "placeable_gangs": {k: gangs[k]
+                                    for k in sorted(gangs, key=int)},
+                "nodes": frag_rows,
             }
         if self.overcommit:
             # vtcomm-PR vtovc satellite (ROADMAP vtovc item (a)): the
